@@ -1,0 +1,149 @@
+"""Binary IDs for tasks/actors/objects/workers/jobs.
+
+TPU-native analog of the reference's ID scheme (reference: src/ray/common/id.h):
+ObjectIDs embed the creating TaskID plus a return index so lineage is recoverable
+from the ID alone; ActorIDs embed the JobID. IDs are fixed-width random bytes,
+hex-printable, hashable, and picklable.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_JOB_ID_SIZE = 4
+_ACTOR_ID_SIZE = 12  # job(4) + unique(8)
+_TASK_ID_SIZE = 16  # actor(12) + unique(4)
+_OBJECT_ID_SIZE = 20  # task(16) + index(4)
+_WORKER_ID_SIZE = 16
+_NODE_ID_SIZE = 16
+_PLACEMENT_GROUP_ID_SIZE = 12
+
+
+class BaseID:
+    SIZE = 0
+    __slots__ = ("_bytes", "_hash")
+
+    def __init__(self, binary: bytes):
+        if len(binary) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}"
+            )
+        self._bytes = binary
+        self._hash = hash((type(self).__name__, binary))
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\xff" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\xff" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._bytes.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = _JOB_ID_SIZE
+
+    _counter = 0
+    _lock = threading.Lock()
+
+    @classmethod
+    def from_int(cls, value: int) -> "JobID":
+        return cls(value.to_bytes(cls.SIZE, "little"))
+
+    @classmethod
+    def next(cls) -> "JobID":
+        with cls._lock:
+            cls._counter += 1
+            return cls.from_int(cls._counter)
+
+
+class ActorID(BaseID):
+    SIZE = _ACTOR_ID_SIZE
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(job_id.binary() + os.urandom(cls.SIZE - JobID.SIZE))
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[: JobID.SIZE])
+
+
+class TaskID(BaseID):
+    SIZE = _TASK_ID_SIZE
+
+    @classmethod
+    def for_normal_task(cls, job_id: JobID) -> "TaskID":
+        actor_part = job_id.binary() + b"\x00" * (ActorID.SIZE - JobID.SIZE)
+        return cls(actor_part + os.urandom(cls.SIZE - ActorID.SIZE))
+
+    @classmethod
+    def for_actor_task(cls, actor_id: ActorID) -> "TaskID":
+        return cls(actor_id.binary() + os.urandom(cls.SIZE - ActorID.SIZE))
+
+    @classmethod
+    def for_actor_creation(cls, actor_id: ActorID) -> "TaskID":
+        return cls(actor_id.binary() + b"\x00" * (cls.SIZE - ActorID.SIZE))
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._bytes[: ActorID.SIZE])
+
+
+class ObjectID(BaseID):
+    SIZE = _OBJECT_ID_SIZE
+
+    @classmethod
+    def for_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        return cls(task_id.binary() + index.to_bytes(4, "little"))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        # Put objects use the high bit of the index to avoid colliding with
+        # return-object indices.
+        return cls(task_id.binary() + (put_index | 0x8000_0000).to_bytes(4, "little"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[: TaskID.SIZE])
+
+    def return_index(self) -> int:
+        return int.from_bytes(self._bytes[TaskID.SIZE :], "little") & 0x7FFF_FFFF
+
+    def is_put(self) -> bool:
+        return bool(int.from_bytes(self._bytes[TaskID.SIZE :], "little") & 0x8000_0000)
+
+
+class WorkerID(BaseID):
+    SIZE = _WORKER_ID_SIZE
+
+
+class NodeID(BaseID):
+    SIZE = _NODE_ID_SIZE
+
+
+class PlacementGroupID(BaseID):
+    SIZE = _PLACEMENT_GROUP_ID_SIZE

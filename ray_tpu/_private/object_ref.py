@@ -1,0 +1,76 @@
+"""ObjectRef: a future handle to an object in the store.
+
+Analog of the reference ObjectRef (python/ray/_raylet.pyx ObjectRef): compares
+and hashes by ID, picklable (serializing a ref inside a task argument or
+return value keeps it a reference — the borrowing protocol; resolution happens
+only through ``get``). Supports ``await`` when used inside async actors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu._private.ids import ObjectID, TaskID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_hint", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_hint: Optional[str] = None):
+        self._id = object_id
+        self._owner_hint = owner_hint
+
+    # -- identity ---------------------------------------------------------
+
+    def object_id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def task_id(self) -> TaskID:
+        return self._id.task_id()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __reduce__(self):
+        return (ObjectRef, (self._id, self._owner_hint))
+
+    # -- future interface -------------------------------------------------
+
+    def is_ready(self) -> bool:
+        from ray_tpu._private.worker import global_worker
+        return global_worker.runtime.store.contains(self._id)
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        import concurrent.futures
+        import threading
+
+        from ray_tpu._private.worker import global_worker
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        runtime = global_worker.runtime
+
+        def _wait():
+            try:
+                fut.set_result(runtime.get([self], timeout=None)[0])
+            except BaseException as e:  # noqa: BLE001 - propagate to future
+                fut.set_exception(e)
+
+        threading.Thread(target=_wait, daemon=True).start()
+        return fut
+
+    def __await__(self):
+        import asyncio
+        return asyncio.wrap_future(self.future()).__await__()

@@ -1,0 +1,174 @@
+"""Single-node object store.
+
+The analog of the reference's in-process memory store + plasma store
+(reference: src/ray/core_worker/store_provider/memory_store/memory_store.h,
+src/ray/object_manager/plasma/store.h). Objects are immutable once sealed;
+``get`` blocks until the object is sealed or the store is told the object
+failed (in which case the stored error is raised at the caller).
+
+Two payload kinds are supported:
+
+* **Inline values** — Python objects stored by reference (thread-backend fast
+  path; the zero-copy analog of plasma buffers shared within one address
+  space). Mutation of gotten objects is undefined behavior, as with plasma.
+* **Serialized values** — bytes produced by the serializer; deserialized on
+  first get and cached.
+
+Reference counting: the driver owns all objects in round 1 (single-node);
+``free`` evicts explicitly. Distributed ownership arrives with the multi-node
+store.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.exceptions import GetTimeoutError, ObjectFreedError, ObjectLostError
+
+
+@dataclass
+class _Entry:
+    event: threading.Event = field(default_factory=threading.Event)
+    value: Any = None
+    serialized: Optional[bytes] = None
+    deserialized: bool = False
+    is_exception: bool = False
+    freed: bool = False
+    size_bytes: int = 0
+    create_time: float = 0.0
+
+
+class ObjectStore:
+    def __init__(self, deserializer: Optional[Callable[[bytes], Any]] = None):
+        self._entries: Dict[ObjectID, _Entry] = {}
+        self._lock = threading.Lock()
+        self._deserializer = deserializer
+        self._total_bytes = 0
+
+    def set_deserializer(self, fn: Callable[[bytes], Any]) -> None:
+        self._deserializer = fn
+
+    def _entry(self, object_id: ObjectID) -> _Entry:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                entry = _Entry()
+                self._entries[object_id] = entry
+            return entry
+
+    # -- write side -------------------------------------------------------
+
+    def put_inline(self, object_id: ObjectID, value: Any,
+                   is_exception: bool = False) -> None:
+        entry = self._entry(object_id)
+        with self._lock:
+            # Objects are immutable once sealed (plasma semantics): first
+            # write wins, racing writers (e.g. a completing task vs. a kill
+            # sealing errors) are dropped.
+            if entry.event.is_set():
+                return
+            entry.value = value
+            entry.deserialized = True
+            entry.is_exception = is_exception
+            entry.create_time = time.time()
+            entry.event.set()
+
+    def put_serialized(self, object_id: ObjectID, payload: bytes,
+                       is_exception: bool = False) -> None:
+        entry = self._entry(object_id)
+        with self._lock:
+            if entry.event.is_set():
+                return
+            entry.serialized = payload
+            entry.is_exception = is_exception
+            entry.size_bytes = len(payload)
+            entry.create_time = time.time()
+            self._total_bytes += len(payload)
+            entry.event.set()
+
+    # -- read side --------------------------------------------------------
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            entry = self._entries.get(object_id)
+        return entry is not None and entry.event.is_set() and not entry.freed
+
+    def wait_ready(self, object_id: ObjectID, timeout: Optional[float]) -> bool:
+        entry = self._entry(object_id)
+        return entry.event.wait(timeout)
+
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
+        """Return the stored value (deserializing if needed).
+
+        Raises the stored exception if the object holds an error; raises
+        GetTimeoutError on timeout. The caller is responsible for re-raising
+        TaskError causes appropriately.
+        """
+        entry = self._entry(object_id)
+        if not entry.event.wait(timeout):
+            raise GetTimeoutError(
+                f"Get timed out waiting for object {object_id.hex()} "
+                f"after {timeout}s.")
+        if entry.freed:
+            raise ObjectFreedError(
+                f"Object {object_id.hex()} was freed and is no longer available.")
+        if not entry.deserialized:
+            if self._deserializer is None:
+                raise ObjectLostError(object_id.hex())
+            value = self._deserializer(entry.serialized)
+            entry.value = value
+            entry.deserialized = True
+        if entry.is_exception:
+            raise entry.value
+        return entry.value
+
+    def get_if_exception(self, object_id: ObjectID) -> Optional[BaseException]:
+        entry = self._entry(object_id)
+        if not entry.event.is_set() or not entry.is_exception:
+            return None
+        if not entry.deserialized and self._deserializer is not None:
+            entry.value = self._deserializer(entry.serialized)
+            entry.deserialized = True
+        return entry.value
+
+    # -- lifecycle --------------------------------------------------------
+
+    def free(self, object_ids) -> None:
+        with self._lock:
+            for oid in object_ids:
+                entry = self._entries.get(oid)
+                if entry is not None:
+                    entry.freed = True
+                    entry.value = None
+                    self._total_bytes -= entry.size_bytes
+                    entry.serialized = None
+                    entry.event.set()
+
+    def fail_all_pending(self, exc: BaseException) -> None:
+        """Seal every unsealed entry with the given error (used at shutdown so
+        blocked gets raise instead of hanging forever)."""
+        with self._lock:
+            for entry in self._entries.values():
+                if not entry.event.is_set():
+                    entry.value = exc
+                    entry.deserialized = True
+                    entry.is_exception = True
+                    entry.event.set()
+
+    def evict_all(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._total_bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            sealed = sum(1 for e in self._entries.values() if e.event.is_set())
+            return {
+                "num_objects": len(self._entries),
+                "num_sealed": sealed,
+                "total_serialized_bytes": self._total_bytes,
+            }

@@ -1,0 +1,89 @@
+"""Node resource detection — TPU chips as first-class resources.
+
+The reference autodetects GPUs and assigns CUDA_VISIBLE_DEVICES
+(python/ray/_private/resource_spec.py:175 _autodetect_num_gpus). Here the
+accelerator layer is TPU-native: chips come from ``jax.devices()``; the ICI
+topology (e.g. v4-8) is exposed as an ``accelerator_type:TPU-<gen>`` marker
+resource plus node metadata used by placement groups to map bundles onto mesh
+slices.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class NodeResources:
+    num_cpus: float
+    num_tpus: float
+    memory_bytes: float
+    tpu_platform: str = ""  # e.g. "tpu v4"
+    tpu_topology: str = ""  # e.g. "2x2x1"
+    custom: Dict[str, float] = field(default_factory=dict)
+
+    def to_resource_map(self) -> Dict[str, float]:
+        resources = {"CPU": self.num_cpus, "memory": self.memory_bytes}
+        if self.num_tpus:
+            resources["TPU"] = self.num_tpus
+            if self.tpu_platform:
+                marker = "accelerator_type:" + self.tpu_platform.upper().replace(" ", "-")
+                resources[marker] = 1.0
+        resources.update(self.custom)
+        return resources
+
+
+def _autodetect_num_tpus() -> tuple[float, str]:
+    """Count local TPU chips without initializing a TPU runtime if possible.
+
+    Honors TPU_VISIBLE_CHIPS/TPU_CHIPS_PER_HOST overrides; otherwise asks JAX
+    (only if JAX has already been imported or detection is explicitly enabled,
+    to keep `init()` cheap on CPU-only hosts and to avoid grabbing the chips
+    from the scheduler process).
+    """
+    env = os.environ.get("RAY_TPU_NUM_CHIPS")
+    if env is not None:
+        return float(env), os.environ.get("RAY_TPU_PLATFORM", "tpu")
+    visible = os.environ.get("TPU_VISIBLE_CHIPS")
+    if visible:
+        return float(len([c for c in visible.split(",") if c.strip() != ""])), "tpu"
+    import sys
+    if "jax" in sys.modules:
+        try:
+            import jax
+            devices = [d for d in jax.devices() if d.platform == "tpu"]
+            if devices:
+                return float(len(devices)), getattr(
+                    devices[0], "device_kind", "tpu")
+        except Exception:  # noqa: BLE001 - no TPU runtime present
+            pass
+    return 0.0, ""
+
+
+def detect_node_resources(
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    memory: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+) -> NodeResources:
+    if num_cpus is None:
+        num_cpus = float(os.cpu_count() or 1)
+    platform = ""
+    if num_tpus is None:
+        num_tpus, platform = _autodetect_num_tpus()
+    if memory is None:
+        try:
+            page = os.sysconf("SC_PAGE_SIZE")
+            phys = os.sysconf("SC_PHYS_PAGES")
+            memory = float(page * phys) * 0.7
+        except (ValueError, OSError):
+            memory = 8e9
+    return NodeResources(
+        num_cpus=float(num_cpus),
+        num_tpus=float(num_tpus),
+        memory_bytes=float(memory),
+        tpu_platform=platform,
+        custom=dict(resources or {}),
+    )

@@ -1,0 +1,161 @@
+"""Resource accounting and admission control.
+
+Single-node analog of the reference's two-level scheduler
+(src/ray/raylet/scheduling/cluster_task_manager.h picks a node;
+local_task_manager.h acquires resources and dispatches). Round 1 runs one
+node, so this class does the local half: fixed-point-free float resource
+vectors, placement-group bundle reservations (the 2-phase
+Prepare/Commit collapses to one phase on a single node), and feasibility
+checks so infeasible tasks error loudly instead of hanging.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ray_tpu._private.ids import PlacementGroupID
+from ray_tpu.exceptions import PlacementGroupError
+
+_EPS = 1e-9
+
+
+def _fits(avail: Dict[str, float], need: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + _EPS >= v for k, v in need.items())
+
+
+def _sub(avail: Dict[str, float], need: Dict[str, float]) -> None:
+    for k, v in need.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def _add(avail: Dict[str, float], need: Dict[str, float]) -> None:
+    for k, v in need.items():
+        avail[k] = avail.get(k, 0.0) + v
+
+
+class _Bundle:
+    def __init__(self, resources: Dict[str, float]):
+        self.reserved = dict(resources)
+        self.available = dict(resources)
+
+
+class ResourceScheduler:
+    def __init__(self, total: Dict[str, float]):
+        self._lock = threading.Lock()
+        self.total: Dict[str, float] = dict(total)
+        self.available: Dict[str, float] = dict(total)
+        self._placement_groups: Dict[PlacementGroupID, List[_Bundle]] = {}
+
+    # -- feasibility ------------------------------------------------------
+
+    def is_feasible(self, resources: Dict[str, float],
+                    pg_id: Optional[PlacementGroupID] = None,
+                    bundle_index: int = -1) -> bool:
+        with self._lock:
+            if pg_id is not None:
+                bundles = self._placement_groups.get(pg_id)
+                if bundles is None:
+                    return False
+                if bundle_index >= 0:
+                    if bundle_index >= len(bundles):
+                        return False
+                    return _fits(bundles[bundle_index].reserved, resources)
+                return any(_fits(b.reserved, resources) for b in bundles)
+            return _fits(self.total, resources)
+
+    # -- acquire/release --------------------------------------------------
+
+    def try_acquire(self, resources: Dict[str, float],
+                    pg_id: Optional[PlacementGroupID] = None,
+                    bundle_index: int = -1) -> Optional[int]:
+        """Acquire resources; returns the bundle index used (or -1 for the
+        global pool), or None if not currently available."""
+        with self._lock:
+            if pg_id is not None:
+                bundles = self._placement_groups.get(pg_id)
+                if bundles is None:
+                    return None
+                if bundle_index >= len(bundles):
+                    return None
+                candidates = (
+                    [bundle_index] if bundle_index >= 0
+                    else range(len(bundles)))
+                for i in candidates:
+                    if _fits(bundles[i].available, resources):
+                        _sub(bundles[i].available, resources)
+                        return i
+                return None
+            if _fits(self.available, resources):
+                _sub(self.available, resources)
+                return -1
+            return None
+
+    def force_acquire(self, resources: Dict[str, float],
+                      pg_id: Optional[PlacementGroupID] = None,
+                      bundle_index: int = -1) -> None:
+        """Acquire without availability check (may transiently overcommit).
+
+        Used when a worker unblocks from a nested ``get`` and reclaims the
+        resources it released while blocked — the analog of the reference's
+        NotifyUnblocked path, where the raylet tolerates transient
+        oversubscription rather than deadlocking."""
+        with self._lock:
+            if pg_id is not None:
+                bundles = self._placement_groups.get(pg_id)
+                if bundles is not None and 0 <= bundle_index < len(bundles):
+                    _sub(bundles[bundle_index].available, resources)
+                return
+            _sub(self.available, resources)
+
+    def release(self, resources: Dict[str, float],
+                pg_id: Optional[PlacementGroupID] = None,
+                bundle_index: int = -1) -> None:
+        with self._lock:
+            if pg_id is not None:
+                bundles = self._placement_groups.get(pg_id)
+                if bundles is not None and 0 <= bundle_index < len(bundles):
+                    _add(bundles[bundle_index].available, resources)
+                return
+            _add(self.available, resources)
+
+    # -- placement groups -------------------------------------------------
+
+    def create_placement_group(
+            self, pg_id: PlacementGroupID,
+            bundles: List[Dict[str, float]]) -> None:
+        with self._lock:
+            need: Dict[str, float] = {}
+            for b in bundles:
+                _add(need, b)
+            if not _fits(self.total, need):
+                raise PlacementGroupError(
+                    f"Placement group bundles {bundles} are infeasible on this "
+                    f"cluster (total resources {self.total}).")
+            if not _fits(self.available, need):
+                raise PlacementGroupError(
+                    f"Placement group bundles {bundles} cannot be reserved now "
+                    f"(available {self.available}). Round 1 has no wait queue "
+                    "for PG creation.")
+            _sub(self.available, need)
+            self._placement_groups[pg_id] = [_Bundle(b) for b in bundles]
+
+    def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
+        with self._lock:
+            bundles = self._placement_groups.pop(pg_id, None)
+            if bundles is None:
+                return
+            for b in bundles:
+                _add(self.available, b.reserved)
+
+    def placement_group_exists(self, pg_id: PlacementGroupID) -> bool:
+        with self._lock:
+            return pg_id in self._placement_groups
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "total": dict(self.total),
+                "available": dict(self.available),
+                "num_placement_groups": len(self._placement_groups),
+            }

@@ -1,0 +1,60 @@
+"""Serialization context: cloudpickle with framework-object passthrough.
+
+Analog of the reference's SerializationContext (python/ray/_private/
+serialization.py). cloudpickle handles closures/lambdas/dynamic classes;
+ObjectRef / ActorHandle define ``__reduce__`` so they travel as IDs (borrow
+semantics). Large numpy/jax arrays are serialized out-of-band via pickle5
+buffers when the transport supports it; the shared-memory store path (native
+C++ store) restores zero-copy.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+
+class Serializer:
+    """Pickles values; collects out-of-band buffers for zero-copy transports."""
+
+    def serialize(self, value: Any) -> bytes:
+        return cloudpickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def serialize_oob(self, value: Any) -> Tuple[bytes, List[pickle.PickleBuffer]]:
+        buffers: List[pickle.PickleBuffer] = []
+        payload = cloudpickle.dumps(
+            value, protocol=5, buffer_callback=buffers.append)
+        return payload, buffers
+
+    def deserialize(self, payload: bytes, buffers=None) -> Any:
+        if buffers:
+            return pickle.loads(payload, buffers=buffers)
+        return pickle.loads(payload)
+
+
+_serializer = Serializer()
+
+
+def serialize(value: Any) -> bytes:
+    return _serializer.serialize(value)
+
+
+def deserialize(payload: bytes) -> Any:
+    return _serializer.deserialize(payload)
+
+
+def dumps_function(fn) -> bytes:
+    """Pickle a function/class definition for shipping to workers.
+
+    Analog of the reference's function export to GCS KV
+    (python/ray/_private/function_manager.py); here the pickled definition is
+    cached by the runtime and shipped with the first task that needs it.
+    """
+    return cloudpickle.dumps(fn, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def loads_function(payload: bytes):
+    return pickle.loads(payload)

@@ -1,0 +1,113 @@
+"""Task specifications and option validation.
+
+Analog of the reference's TaskSpecification (src/ray/common/task/task_spec.h)
+plus the central option table (python/ray/_private/ray_option_utils.py).
+Resources are floats; ``num_cpus`` defaults to 1 for tasks and 0 for actors,
+matching the reference's defaults. TPU chips are a first-class resource
+(``num_tpus``) instead of GPUs.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, ObjectID, TaskID
+
+TPU_RESOURCE = "TPU"
+CPU_RESOURCE = "CPU"
+MEMORY_RESOURCE = "memory"
+
+
+class TaskKind(enum.Enum):
+    NORMAL = 0
+    ACTOR_CREATION = 1
+    ACTOR_TASK = 2
+
+
+_COMMON_OPTIONS = {
+    "num_cpus", "num_tpus", "num_gpus", "resources", "memory", "name",
+    "num_returns", "max_retries", "retry_exceptions", "scheduling_strategy",
+    "placement_group", "placement_group_bundle_index", "runtime_env",
+    "max_concurrency", "lifetime", "max_restarts", "max_task_retries",
+    "namespace", "get_if_exists", "concurrency_groups", "label_selector",
+    "accelerator_type", "_metadata",
+}
+
+
+def validate_options(options: Dict[str, Any], for_actor: bool) -> Dict[str, Any]:
+    for key in options:
+        if key not in _COMMON_OPTIONS:
+            raise ValueError(
+                f"Invalid option keyword {key!r}. Valid options: "
+                f"{sorted(_COMMON_OPTIONS)}")
+    for res_key in ("num_cpus", "num_tpus", "num_gpus", "memory"):
+        val = options.get(res_key)
+        if val is not None and (not isinstance(val, (int, float)) or val < 0):
+            raise ValueError(f"{res_key} must be a non-negative number, got {val!r}")
+    resources = options.get("resources")
+    if resources is not None:
+        if not isinstance(resources, dict):
+            raise ValueError("resources must be a dict of name -> quantity")
+        for k, v in resources.items():
+            if k in (CPU_RESOURCE, TPU_RESOURCE, "GPU"):
+                raise ValueError(
+                    f"Use num_cpus/num_tpus/num_gpus instead of resources[{k!r}]")
+            if not isinstance(v, (int, float)) or v < 0:
+                raise ValueError(f"resources[{k!r}] must be non-negative")
+    num_returns = options.get("num_returns")
+    if num_returns is not None:
+        if num_returns != "dynamic" and (
+                not isinstance(num_returns, int) or num_returns < 0):
+            raise ValueError("num_returns must be a non-negative int or 'dynamic'")
+    if for_actor:
+        max_restarts = options.get("max_restarts")
+        if max_restarts is not None and (
+                not isinstance(max_restarts, int) or max_restarts < -1):
+            raise ValueError("max_restarts must be an int >= -1")
+    return options
+
+
+def resources_from_options(options: Dict[str, Any], for_actor: bool) -> Dict[str, float]:
+    resources: Dict[str, float] = {}
+    num_cpus = options.get("num_cpus")
+    if num_cpus is None:
+        num_cpus = 0 if for_actor else 1
+    if num_cpus:
+        resources[CPU_RESOURCE] = float(num_cpus)
+    num_tpus = options.get("num_tpus") or options.get("num_gpus")
+    if num_tpus:
+        resources[TPU_RESOURCE] = float(num_tpus)
+    memory = options.get("memory")
+    if memory:
+        resources[MEMORY_RESOURCE] = float(memory)
+    for k, v in (options.get("resources") or {}).items():
+        if v:
+            resources[k] = float(v)
+    return resources
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    kind: TaskKind
+    function_id: bytes  # key into the runtime's function table
+    args: Tuple[Any, ...]  # flattened; ObjectRefs appear in arg_deps positions
+    kwargs: Dict[str, Any]
+    resources: Dict[str, float]
+    num_returns: Any  # int or "dynamic"
+    name: str = ""
+    max_retries: int = 3
+    retry_exceptions: Any = False  # False | True | list of exception types
+    actor_id: Optional[ActorID] = None
+    method_name: str = ""
+    sequence_number: int = 0  # per-handle ordering for actor tasks
+    caller_handle_id: str = ""  # which ActorHandle issued the call
+    placement_group_id: Optional[Any] = None
+    placement_group_bundle_index: int = -1
+    scheduling_strategy: Any = None
+    return_ids: List[ObjectID] = field(default_factory=list)
+    # Filled at submission: ObjectRef deps that must be resolved pre-dispatch.
+    dependencies: List[ObjectID] = field(default_factory=list)
+    attempt_number: int = 0

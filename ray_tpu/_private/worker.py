@@ -1,0 +1,229 @@
+"""Global worker state and the top-level API implementations.
+
+Analog of the reference's python/ray/_private/worker.py (ray.init/get/put/
+wait/kill/cancel/get_actor live here; the module-level ``global_worker``
+mirrors the reference's singleton).
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu._private.ids import JobID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.resource_spec import detect_node_resources
+from ray_tpu._private.runtime import Runtime
+
+logger = logging.getLogger("ray_tpu")
+
+
+class Worker:
+    def __init__(self):
+        self._runtime: Optional[Runtime] = None
+        self._lock = threading.Lock()
+        self.job_id: Optional[JobID] = None
+        self.namespace: str = "default"
+
+    @property
+    def connected(self) -> bool:
+        return self._runtime is not None
+
+    @property
+    def runtime(self) -> Runtime:
+        if self._runtime is None:
+            # Auto-init on first use, matching the reference's behavior of
+            # implicit ray.init() in ray.get/put/remote.
+            init()
+        return self._runtime
+
+    def set_runtime(self, runtime: Optional[Runtime], job_id=None):
+        self._runtime = runtime
+        self.job_id = job_id
+
+
+global_worker = Worker()
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    num_gpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    object_store_memory: Optional[int] = None,
+    namespace: Optional[str] = None,
+    ignore_reinit_error: bool = False,
+    logging_level: int = logging.INFO,
+    include_dashboard: Optional[bool] = None,
+    runtime_env: Optional[dict] = None,
+    _memory: Optional[float] = None,
+    **kwargs,
+) -> "ClientContext":
+    """Start (or connect to) a cluster.
+
+    Round 1 runs a single-node in-process cluster; ``address`` other than
+    None/"local"/"auto" is reserved for the multi-node control plane.
+    """
+    with global_worker._lock:
+        if global_worker.connected:
+            if ignore_reinit_error:
+                return ClientContext(global_worker)
+            raise RuntimeError(
+                "Calling init() again after it has already been called. "
+                "Pass ignore_reinit_error=True to suppress this error.")
+        if address not in (None, "local", "auto"):
+            raise NotImplementedError(
+                f"Connecting to a remote cluster at {address!r} is not yet "
+                "supported; multi-node arrives with the gRPC control plane.")
+        if num_tpus is None and num_gpus is not None:
+            # GPU-option compatibility: the reference's num_gpus maps onto
+            # the accelerator resource, which is TPU here.
+            num_tpus = num_gpus
+        node = detect_node_resources(
+            num_cpus=num_cpus, num_tpus=num_tpus, memory=_memory,
+            resources=resources)
+        job_id = JobID.next()
+        runtime = Runtime(node, job_id)
+        global_worker.set_runtime(runtime, job_id)
+        if namespace:
+            global_worker.namespace = namespace
+        logging.basicConfig(level=logging_level)
+        atexit.register(_atexit_shutdown)
+        return ClientContext(global_worker)
+
+
+def _atexit_shutdown():
+    try:
+        shutdown()
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def shutdown() -> None:
+    with global_worker._lock:
+        if global_worker._runtime is not None:
+            global_worker._runtime.shutdown()
+            global_worker.set_runtime(None)
+            global_worker.namespace = "default"
+
+
+def is_initialized() -> bool:
+    return global_worker.connected
+
+
+class ClientContext:
+    """Return value of ``init`` — address info + context-manager support."""
+
+    def __init__(self, worker: Worker):
+        self._worker = worker
+        self.address_info = {
+            "node_id": "local",
+            "address": "local",
+            "num_cpus": worker.runtime.node_resources.num_cpus,
+            "num_tpus": worker.runtime.node_resources.num_tpus,
+        }
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        shutdown()
+
+    def __getitem__(self, key):
+        return self.address_info[key]
+
+
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("Calling put() on an ObjectRef is not allowed.")
+    return global_worker.runtime.put(value)
+
+
+def get(object_refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None) -> Any:
+    is_single = isinstance(object_refs, ObjectRef)
+    refs = [object_refs] if is_single else list(object_refs)
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(
+                f"get() expects ObjectRef or a list of ObjectRefs, got "
+                f"{type(r).__name__}")
+    values = global_worker.runtime.get(refs, timeout)
+    return values[0] if is_single else values
+
+
+def wait(object_refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    refs = list(object_refs)
+    if len(set(refs)) != len(refs):
+        raise ValueError("wait() expects a list of unique ObjectRefs.")
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError("wait() expects a list of ObjectRefs.")
+    if num_returns <= 0:
+        raise ValueError("num_returns must be > 0")
+    if num_returns > len(refs):
+        raise ValueError(
+            f"num_returns ({num_returns}) cannot exceed the number of refs "
+            f"({len(refs)})")
+    return global_worker.runtime.wait(refs, num_returns, timeout, fetch_local)
+
+
+def kill(actor, *, no_restart: bool = True) -> None:
+    from ray_tpu.actor import ActorHandle
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle.")
+    global_worker.runtime.kill_actor(actor._actor_id, no_restart)
+
+
+def cancel(object_ref: ObjectRef, *, force: bool = False,
+           recursive: bool = True) -> None:
+    global_worker.runtime.cancel(object_ref, force)
+
+
+def get_actor(name: str, namespace: Optional[str] = None):
+    from ray_tpu.actor import ActorHandle
+    runtime = global_worker.runtime
+    actor_id = runtime.get_named_actor(
+        name, namespace or global_worker.namespace)
+    state = runtime.actor_state(actor_id)
+    cls = runtime.functions.load(state.creation_spec.function_id)
+    return ActorHandle(actor_id, cls, name=name)
+
+
+def cluster_resources() -> Dict[str, float]:
+    return global_worker.runtime.cluster_resources()
+
+
+def available_resources() -> Dict[str, float]:
+    return global_worker.runtime.available_resources()
+
+
+def nodes() -> List[dict]:
+    runtime = global_worker.runtime
+    return [{
+        "NodeID": "local",
+        "Alive": True,
+        "Resources": runtime.cluster_resources(),
+        "node:__internal_head__": 1.0,
+    }]
+
+
+def free(object_refs: Sequence[ObjectRef]) -> None:
+    global_worker.runtime.store.free([r.object_id() for r in object_refs])
+
+
+def get_tpu_ids() -> List[int]:
+    """TPU chips assigned to the current task/actor (analog of the
+    reference's get_gpu_ids, python/ray/_private/worker.py:832)."""
+    from ray_tpu._private.runtime import current_task_spec
+    spec = current_task_spec()
+    if spec is None:
+        return []
+    n = int(spec.resources.get("TPU", 0))
+    return list(range(n))

@@ -1,0 +1,172 @@
+"""Actors: @remote classes, ActorClass, ActorHandle, ActorMethod.
+
+Analog of the reference's python/ray/actor.py: ``Cls.remote(...)`` creates the
+actor and returns a handle; ``handle.method.remote(...)`` submits ordered
+actor tasks. Handles are picklable (they travel as actor IDs and re-bind to
+the actor on deserialization).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import task_spec as ts
+from ray_tpu._private.ids import ActorID, TaskID
+from ray_tpu._private.task_spec import TaskKind, TaskSpec, validate_options
+from ray_tpu._private.worker import global_worker
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        return self._handle._actor_method_call(
+            self._method_name, args, kwargs,
+            num_returns=self._num_returns)
+
+    def options(self, num_returns: int = 1, name: str = "", **_ignored):
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method {self._method_name!r} cannot be called directly; "
+            "use .remote().")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, cls: Optional[type] = None,
+                 name: str = ""):
+        import uuid
+        self._actor_id = actor_id
+        self._cls = cls
+        self._name = name
+        # Per-handle ordering state (each handle instance gets its own
+        # sequence, matching the reference's per-handle call ordering).
+        self._handle_id = uuid.uuid4().hex
+        self._seq = 0
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return ActorMethod(self, item)
+
+    def _actor_method_call(self, method_name, args, kwargs, num_returns=1):
+        runtime = global_worker.runtime
+        self._seq += 1
+        state = runtime.actor_state(self._actor_id)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_task(self._actor_id),
+            kind=TaskKind.ACTOR_TASK,
+            function_id=(state.creation_spec.function_id
+                         if state is not None else b""),
+            args=tuple(args),
+            kwargs=dict(kwargs),
+            resources={},
+            num_returns=num_returns,
+            name=f"{(self._cls.__name__ if self._cls else 'Actor')}."
+                 f"{method_name}",
+            max_retries=0,
+            actor_id=self._actor_id,
+            method_name=method_name,
+            sequence_number=self._seq,
+            caller_handle_id=self._handle_id,
+        )
+        refs = runtime.submit_actor_task(spec)
+        if num_returns == 0:
+            return None
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __reduce__(self):
+        return (_rebind_actor_handle, (self._actor_id, self._name))
+
+    def __repr__(self):
+        cls_name = self._cls.__name__ if self._cls else "Actor"
+        return f"ActorHandle({cls_name}, {self._actor_id.hex()})"
+
+    def _ray_kill(self, no_restart: bool = True):
+        global_worker.runtime.kill_actor(self._actor_id, no_restart)
+
+
+def _rebind_actor_handle(actor_id: ActorID, name: str) -> ActorHandle:
+    runtime = global_worker.runtime
+    state = runtime.actor_state(actor_id)
+    cls = None
+    if state is not None:
+        try:
+            cls = runtime.functions.load(state.creation_spec.function_id)
+        except KeyError:
+            cls = None
+    return ActorHandle(actor_id, cls, name)
+
+
+class ActorClass:
+    def __init__(self, cls: type, options: Dict[str, Any]):
+        self._cls = cls
+        self._default_options = validate_options(options, for_actor=True)
+        self._exported: tuple = ("", None)
+        self.__name__ = cls.__name__
+        self.__qualname__ = getattr(cls, "__qualname__", cls.__name__)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__!r} cannot be instantiated "
+            "directly. Use Cls.remote() instead.")
+
+    def options(self, **options) -> "ActorClass":
+        merged = {**self._default_options, **options}
+        clone = ActorClass(self._cls, merged)
+        clone._exported = self._exported
+        return clone
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._remote(args, kwargs, self._default_options)
+
+    def _remote(self, args, kwargs, options) -> ActorHandle:
+        runtime = global_worker.runtime
+        session, function_id = self._exported
+        if session != runtime.session_id:
+            function_id = runtime.register_function(self._cls)
+            self._exported = (runtime.session_id, function_id)
+        actor_id = ActorID.of(runtime.job_id)
+        name = options.get("name") or ""
+        namespace = options.get("namespace") or global_worker.namespace
+        get_if_exists = bool(options.get("get_if_exists"))
+        strategy = options.get("scheduling_strategy")
+        pg = options.get("placement_group")
+        if pg is not None and strategy is None:
+            from ray_tpu.util.scheduling_strategies import (
+                PlacementGroupSchedulingStrategy)
+            strategy = PlacementGroupSchedulingStrategy(
+                placement_group=pg,
+                placement_group_bundle_index=options.get(
+                    "placement_group_bundle_index", -1))
+        from ray_tpu.util.scheduling_strategies import validate_strategy
+        validate_strategy(strategy)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_creation(actor_id),
+            kind=TaskKind.ACTOR_CREATION,
+            function_id=function_id,
+            args=tuple(args),
+            kwargs=dict(kwargs),
+            resources=ts.resources_from_options(options, for_actor=True),
+            num_returns=1,
+            name=f"{self._cls.__name__}.__init__",
+            max_retries=0,
+            actor_id=actor_id,
+            scheduling_strategy=strategy,
+        )
+        actual_id = runtime.create_actor(
+            spec,
+            max_restarts=options.get("max_restarts", 0),
+            max_concurrency=options.get("max_concurrency", 1),
+            name=name,
+            namespace=namespace,
+            get_if_exists=get_if_exists,
+        )
+        return ActorHandle(actual_id, self._cls, name)

@@ -1,0 +1,103 @@
+"""User-facing exception types.
+
+Mirrors the reference's python/ray/exceptions.py surface (RayError hierarchy):
+task errors wrap the remote traceback; actor errors mark dead actors; object
+loss / cancellation / timeout are distinct types so callers can catch narrowly.
+"""
+
+from __future__ import annotations
+
+import traceback as _tb
+
+
+class RayError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayError):
+    """A task raised an exception during execution.
+
+    Re-raised at ``get`` with the remote traceback embedded, wrapping the
+    original exception as ``cause`` (reference: python/ray/exceptions.py
+    RayTaskError).
+    """
+
+    def __init__(self, cause: BaseException, remote_traceback: str = "",
+                 task_name: str = ""):
+        self.cause = cause
+        self.remote_traceback = remote_traceback
+        self.task_name = task_name
+        super().__init__(str(cause))
+
+    def __str__(self):
+        msg = f"Task {self.task_name or '<unknown>'} failed: "
+        msg += f"{type(self.cause).__name__}: {self.cause}"
+        if self.remote_traceback:
+            msg += "\n\nRemote traceback:\n" + self.remote_traceback
+        return msg
+
+    @classmethod
+    def from_exception(cls, exc: BaseException, task_name: str = "") -> "TaskError":
+        return cls(exc, "".join(_tb.format_exception(exc)), task_name)
+
+
+# Alias matching the reference name.
+RayTaskError = TaskError
+
+
+class ActorError(RayError):
+    """An actor task cannot complete because the actor died."""
+
+    def __init__(self, actor_id=None, message: str = ""):
+        self.actor_id = actor_id
+        super().__init__(message or f"The actor {actor_id} died unexpectedly.")
+
+
+RayActorError = ActorError
+
+
+class ActorDiedError(ActorError):
+    pass
+
+
+class ActorUnavailableError(ActorError):
+    pass
+
+
+class WorkerCrashedError(RayError):
+    """The worker process executing the task died."""
+
+
+class ObjectLostError(RayError):
+    def __init__(self, object_id_hex: str = ""):
+        super().__init__(f"Object {object_id_hex} was lost and cannot be reconstructed.")
+
+
+class ObjectFreedError(RayError):
+    pass
+
+
+class GetTimeoutError(RayError, TimeoutError):
+    """``get`` did not complete within the requested timeout."""
+
+
+class TaskCancelledError(RayError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__("This task or its dependency was cancelled.")
+
+
+class RuntimeEnvSetupError(RayError):
+    pass
+
+
+class OutOfMemoryError(RayError):
+    pass
+
+
+class PlacementGroupError(RayError):
+    pass
+
+
+class CrossLanguageError(RayError):
+    pass

@@ -1,0 +1,106 @@
+"""@remote functions.
+
+Analog of the reference's python/ray/remote_function.py: the decorator wraps
+the function in a RemoteFunction whose ``.remote(...)`` submits a task and
+returns ObjectRef(s); ``.options(...)`` overrides call options.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict
+
+from ray_tpu._private import task_spec as ts
+from ray_tpu._private.ids import TaskID
+from ray_tpu._private.task_spec import TaskKind, TaskSpec, validate_options
+from ray_tpu._private.worker import global_worker
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Dict[str, Any]):
+        self._function = fn
+        self._default_options = validate_options(options, for_actor=False)
+        # Export cache keyed by runtime session (a new init() gets a fresh
+        # function table, so the export must be redone).
+        self._exported: tuple = ("", None)
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._function.__name__!r} cannot be called "
+            "directly. Use .remote() instead.")
+
+    def options(self, **options) -> "RemoteFunction":
+        merged = {**self._default_options, **options}
+        clone = RemoteFunction(self._function, merged)
+        clone._exported = self._exported
+        return clone
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._default_options)
+
+    def _function_id_for(self, runtime):
+        session, fn_id = self._exported
+        if session != runtime.session_id:
+            fn_id = runtime.register_function(self._function)
+            self._exported = (runtime.session_id, fn_id)
+        return fn_id
+
+    def _remote(self, args, kwargs, options):
+        runtime = global_worker.runtime
+        function_id = self._function_id_for(runtime)
+        num_returns = options.get("num_returns", 1)
+        if num_returns is None:
+            num_returns = 1
+        strategy = options.get("scheduling_strategy")
+        pg = options.get("placement_group")
+        if pg is not None and strategy is None:
+            from ray_tpu.util.scheduling_strategies import (
+                PlacementGroupSchedulingStrategy)
+            strategy = PlacementGroupSchedulingStrategy(
+                placement_group=pg,
+                placement_group_bundle_index=options.get(
+                    "placement_group_bundle_index", -1))
+        from ray_tpu.util.scheduling_strategies import validate_strategy
+        validate_strategy(strategy)
+        spec = TaskSpec(
+            task_id=TaskID.for_normal_task(runtime.job_id),
+            kind=TaskKind.NORMAL,
+            function_id=function_id,
+            args=tuple(args),
+            kwargs=dict(kwargs),
+            resources=ts.resources_from_options(options, for_actor=False),
+            num_returns=num_returns,
+            name=options.get("name") or self._function.__qualname__,
+            max_retries=options.get("max_retries", 3),
+            retry_exceptions=options.get("retry_exceptions", False),
+            scheduling_strategy=strategy,
+        )
+        refs = runtime.submit_task(spec)
+        if num_returns == 0:
+            return None
+        if num_returns == 1 or num_returns == "dynamic":
+            return refs[0]
+        return refs
+
+
+def remote(*args, **kwargs):
+    """``@remote`` / ``@remote(**options)`` for functions and classes."""
+    from ray_tpu.actor import ActorClass
+
+    def decorate(target, options):
+        if isinstance(target, type):
+            return ActorClass(target, options)
+        if not callable(target):
+            raise TypeError(
+                "@remote must decorate a function or a class, got "
+                f"{type(target).__name__}")
+        return RemoteFunction(target, options)
+
+    if len(args) == 1 and not kwargs and (callable(args[0])):
+        return decorate(args[0], {})
+    if args:
+        raise TypeError(
+            "@remote takes keyword options only, e.g. "
+            "@remote(num_cpus=2, num_tpus=1)")
+    return lambda target: decorate(target, kwargs)

@@ -1,0 +1,56 @@
+"""Runtime context (analog of python/ray/runtime_context.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu._private.runtime import current_task_spec
+from ray_tpu._private.worker import global_worker
+
+
+class RuntimeContext:
+    @property
+    def job_id(self):
+        return global_worker.job_id
+
+    @property
+    def node_id(self) -> str:
+        return "local"
+
+    def get_job_id(self) -> str:
+        return global_worker.job_id.hex() if global_worker.job_id else ""
+
+    def get_node_id(self) -> str:
+        return "local"
+
+    def get_task_id(self) -> Optional[str]:
+        spec = current_task_spec()
+        return spec.task_id.hex() if spec else None
+
+    def get_actor_id(self) -> Optional[str]:
+        spec = current_task_spec()
+        if spec is not None and spec.actor_id is not None:
+            return spec.actor_id.hex()
+        return None
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        spec = current_task_spec()
+        if spec is None or spec.actor_id is None:
+            return False
+        state = global_worker.runtime.actor_state(spec.actor_id)
+        return bool(state and state.num_restarts > 0)
+
+    def get_assigned_resources(self) -> dict:
+        spec = current_task_spec()
+        return dict(spec.resources) if spec else {}
+
+    def get_runtime_env_string(self) -> str:
+        return "{}"
+
+
+_runtime_context = RuntimeContext()
+
+
+def get_runtime_context() -> RuntimeContext:
+    return _runtime_context

@@ -1,0 +1,101 @@
+"""Placement groups: gang-scheduled resource bundles.
+
+Analog of python/ray/util/placement_group.py. On a TPU cluster a PG's bundles
+describe a mesh slice (one bundle per host, each with that host's chips);
+``placement_group_table`` exposes the reserved topology so Train can build
+the `jax.sharding.Mesh` that matches the reservation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu._private.ids import PlacementGroupID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.worker import global_worker
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+_pg_table: Dict[PlacementGroupID, dict] = {}
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID,
+                 bundles: List[Dict[str, float]], strategy: str,
+                 name: str = ""):
+        self.id = pg_id
+        self._bundles = bundles
+        self._strategy = strategy
+        self._name = name
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return list(self._bundles)
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self._bundles)
+
+    def ready(self) -> ObjectRef:
+        """Returns a ref that resolves when the PG is reserved. Round-1
+        reservation is synchronous, so this is an already-resolved ref."""
+        return global_worker.runtime.put(self)
+
+    def wait(self, timeout_seconds: float = 30) -> bool:
+        return global_worker.runtime.scheduler.placement_group_exists(self.id)
+
+    def __reduce__(self):
+        return (PlacementGroup,
+                (self.id, self._bundles, self._strategy, self._name))
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str = "", lifetime: Optional[str] = None,
+                    _max_cpu_fraction_per_node: Optional[float] = None
+                    ) -> PlacementGroup:
+    if strategy not in VALID_STRATEGIES:
+        raise ValueError(
+            f"Invalid placement group strategy {strategy!r}; must be one of "
+            f"{VALID_STRATEGIES}")
+    if not bundles:
+        raise ValueError("placement_group requires at least one bundle")
+    for b in bundles:
+        if not isinstance(b, dict) or not b:
+            raise ValueError(f"Invalid bundle {b!r}: must be a non-empty dict")
+        if any(v < 0 for v in b.values()):
+            raise ValueError(f"Invalid bundle {b!r}: negative resources")
+    runtime = global_worker.runtime
+    pg_id = runtime.create_placement_group(bundles, strategy, name)
+    pg = PlacementGroup(pg_id, bundles, strategy, name)
+    _pg_table[pg_id] = {
+        "placement_group_id": pg_id.hex(),
+        "name": name,
+        "bundles": {i: dict(b) for i, b in enumerate(bundles)},
+        "strategy": strategy,
+        "state": "CREATED",
+    }
+    return pg
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    global_worker.runtime.remove_placement_group(pg.id)
+    entry = _pg_table.get(pg.id)
+    if entry is not None:
+        entry["state"] = "REMOVED"
+
+
+def placement_group_table(pg: Optional[PlacementGroup] = None) -> dict:
+    if pg is not None:
+        return dict(_pg_table.get(pg.id, {}))
+    return {k.hex(): dict(v) for k, v in _pg_table.items()}
+
+
+def get_current_placement_group() -> Optional[PlacementGroup]:
+    from ray_tpu._private.runtime import current_task_spec
+    spec = current_task_spec()
+    if spec is None:
+        return None
+    strategy = spec.scheduling_strategy
+    if strategy is not None and getattr(strategy, "placement_group", None):
+        return strategy.placement_group
+    return None

@@ -1,0 +1,43 @@
+"""Scheduling strategies (analog of python/ray/util/scheduling_strategies.py).
+
+On TPU clusters a placement group maps onto an ICI mesh slice; the bundle
+index selects the host within the slice.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class PlacementGroupSchedulingStrategy:
+    def __init__(self, placement_group,
+                 placement_group_bundle_index: int = -1,
+                 placement_group_capture_child_tasks: Optional[bool] = None):
+        self.placement_group = placement_group
+        self.placement_group_bundle_index = placement_group_bundle_index
+        self.placement_group_capture_child_tasks = (
+            placement_group_capture_child_tasks)
+
+
+class NodeAffinitySchedulingStrategy:
+    def __init__(self, node_id: str, soft: bool = False):
+        self.node_id = node_id
+        self.soft = soft
+
+
+# String strategies: "DEFAULT" (hybrid pack/spread) and "SPREAD".
+DEFAULT = "DEFAULT"
+SPREAD = "SPREAD"
+
+
+def validate_strategy(strategy) -> None:
+    """Eagerly reject malformed strategies at call time."""
+    if strategy is None or isinstance(strategy, str):
+        return
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        pg = strategy.placement_group
+        idx = strategy.placement_group_bundle_index
+        if pg is not None and idx is not None and idx >= pg.bundle_count:
+            raise ValueError(
+                f"placement_group_bundle_index {idx} is out of range for a "
+                f"placement group with {pg.bundle_count} bundles")

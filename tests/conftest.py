@@ -1,0 +1,44 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh.
+
+The analog of the reference's fake-GPU test fixtures: multi-chip sharding is
+exercised on `xla_force_host_platform_device_count=8` CPU devices (SURVEY.md
+§4: fake TPU backend), so the suite runs anywhere; the real chip is used only
+by bench.py.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+
+import pytest  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Fresh small cluster per test (analog of the reference's
+    ray_start_regular fixture, python/ray/tests/conftest.py:294)."""
+    ray_tpu.shutdown()
+    ctx = ray_tpu.init(num_cpus=8, num_tpus=0, _memory=1e9)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_shared():
+    """Module-scoped cluster for cheap read-only tests."""
+    ray_tpu.shutdown()
+    ctx = ray_tpu.init(num_cpus=8, num_tpus=0, _memory=1e9)
+    yield ctx
+    ray_tpu.shutdown()

@@ -1,0 +1,196 @@
+"""Actor tests: lifecycle, ordering, named actors, async actors, kill.
+
+Modeled on the reference's python/ray/tests/test_actor.py coverage.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.exceptions import ActorDiedError, ActorError, TaskError
+
+
+@ray.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+    def get(self):
+        return self.n
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote()
+    assert ray.get(c.incr.remote()) == 1
+    assert ray.get(c.incr.remote(5)) == 6
+    assert ray.get(c.get.remote()) == 6
+
+
+def test_actor_constructor_args(ray_start_regular):
+    c = Counter.remote(start=100)
+    assert ray.get(c.get.remote()) == 100
+
+
+def test_actor_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(50)]
+    assert ray.get(refs) == list(range(1, 51))
+
+
+def test_actor_method_error(ray_start_regular):
+    @ray.remote
+    class Bad:
+        def fail(self):
+            raise KeyError("nope")
+
+        def ok(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(TaskError):
+        ray.get(b.fail.remote())
+    # Method errors don't kill the actor.
+    assert ray.get(b.ok.remote()) == 1
+
+
+def test_actor_constructor_error(ray_start_regular):
+    @ray.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("init fail")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises((TaskError, ActorError)):
+        ray.get(b.m.remote(), timeout=5)
+
+
+def test_actor_direct_instantiation_rejected(ray_start_regular):
+    with pytest.raises(TypeError):
+        Counter()
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="global_counter").remote(start=7)
+    h = ray.get_actor("global_counter")
+    assert ray.get(h.get.remote()) == 7
+
+
+def test_named_actor_duplicate_rejected(ray_start_regular):
+    Counter.options(name="dup").remote()
+    with pytest.raises(ValueError):
+        Counter.options(name="dup").remote()
+
+
+def test_get_if_exists(ray_start_regular):
+    a = Counter.options(name="gie", get_if_exists=True).remote(start=1)
+    ray.get(a.incr.remote())
+    b = Counter.options(name="gie", get_if_exists=True).remote(start=999)
+    assert ray.get(b.get.remote()) == 2  # same actor, not a new one
+
+
+def test_missing_named_actor(ray_start_regular):
+    with pytest.raises(ValueError):
+        ray.get_actor("does_not_exist")
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    assert ray.get(c.incr.remote()) == 1
+    ray.kill(c)
+    with pytest.raises(ActorError):
+        ray.get(c.incr.remote(), timeout=5)
+
+
+def test_kill_unnames_actor(ray_start_regular):
+    c = Counter.options(name="killme").remote()
+    ray.get(c.get.remote())
+    ray.kill(c)
+    with pytest.raises(ValueError):
+        ray.get_actor("killme")
+
+
+def test_actor_handle_pickling(ray_start_regular):
+    import pickle
+    c = Counter.remote(start=3)
+    ray.get(c.get.remote())
+    h = pickle.loads(pickle.dumps(c))
+    assert ray.get(h.get.remote()) == 3
+
+
+def test_actor_handle_passed_to_task(ray_start_regular):
+    @ray.remote
+    def use(handle):
+        return ray.get(handle.incr.remote(10))
+
+    c = Counter.remote()
+    assert ray.get(use.remote(c)) == 10
+    assert ray.get(c.get.remote()) == 10
+
+
+def test_actor_resources(ray_start_regular):
+    before = ray.available_resources().get("CPU", 0)
+    c = Counter.options(num_cpus=2).remote()
+    ray.get(c.get.remote())
+    during = ray.available_resources().get("CPU", 0)
+    assert during == before - 2
+    ray.kill(c)
+    time.sleep(0.1)
+    assert ray.available_resources().get("CPU", 0) == before
+
+
+def test_max_concurrency_threadpool(ray_start_regular):
+    @ray.remote
+    class Slow:
+        def work(self):
+            time.sleep(0.4)
+            return 1
+
+    s = Slow.options(max_concurrency=4).remote()
+    start = time.monotonic()
+    ray.get([s.work.remote() for _ in range(4)])
+    elapsed = time.monotonic() - start
+    assert elapsed < 1.2, f"expected concurrent execution, took {elapsed:.2f}s"
+
+
+def test_async_actor(ray_start_regular):
+    @ray.remote
+    class AsyncActor:
+        def __init__(self):
+            self.events = []
+
+        async def slow_then(self, tag, delay):
+            self.events.append(f"start-{tag}")
+            await asyncio.sleep(delay)
+            self.events.append(f"end-{tag}")
+            return tag
+
+        async def get_events(self):
+            return self.events
+
+    a = AsyncActor.remote()
+    r1 = a.slow_then.remote("a", 0.3)
+    r2 = a.slow_then.remote("b", 0.01)
+    assert ray.get([r1, r2]) == ["a", "b"]
+    events = ray.get(a.get_events.remote())
+    # Interleaving proves both coroutines ran concurrently.
+    assert events[:2] == ["start-a", "start-b"]
+
+
+def test_actor_in_placement_group(ray_start_regular):
+    from ray_tpu.util import placement_group, remove_placement_group
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}])
+    c = Counter.options(
+        num_cpus=1, placement_group=pg,
+        placement_group_bundle_index=0).remote()
+    assert ray.get(c.incr.remote()) == 1
+    ray.kill(c)
+    remove_placement_group(pg)

@@ -1,0 +1,157 @@
+"""Actor failure-path regression tests (kill sealing, resource accounting,
+ordering under construction) — modeled on python/ray/tests/test_actor_failures.py."""
+
+import time
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.exceptions import ActorError
+
+
+def test_kill_seals_queued_tasks(ray_start_regular):
+    @ray.remote
+    class Slow:
+        def block(self, t):
+            time.sleep(t)
+            return "done"
+
+        def quick(self):
+            return 1
+
+    s = Slow.remote()
+    blocker = s.block.remote(5)
+    queued = [s.quick.remote() for _ in range(5)]
+    time.sleep(0.1)
+    ray.kill(s)
+    # Every queued call must raise, never hang.
+    for ref in queued:
+        with pytest.raises(ActorError):
+            ray.get(ref, timeout=5)
+    del blocker
+
+
+def test_double_kill_does_not_inflate_resources(ray_start_regular):
+    @ray.remote(num_cpus=2)
+    class A:
+        def ping(self):
+            return 1
+
+    total = ray.cluster_resources()["CPU"]
+    a = A.remote()
+    ray.get(a.ping.remote())
+    ray.kill(a)
+    ray.kill(a)
+    time.sleep(0.1)
+    assert ray.available_resources()["CPU"] == total
+
+
+def test_failed_init_releases_resources(ray_start_regular):
+    @ray.remote(num_cpus=8)  # the whole node
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("nope")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises((ray.exceptions.TaskError, ActorError)):
+        ray.get(b.m.remote(), timeout=5)
+
+    # The reservation must be gone: a full-node task should still run.
+    @ray.remote(num_cpus=8)
+    def needs_everything():
+        return "ok"
+
+    assert ray.get(needs_everything.remote(), timeout=10) == "ok"
+
+
+def test_ordering_during_slow_init(ray_start_regular):
+    @ray.remote
+    class SlowInit:
+        def __init__(self):
+            time.sleep(0.3)
+            self.log = []
+
+        def append(self, x):
+            self.log.append(x)
+            return list(self.log)
+
+    s = SlowInit.remote()
+    refs = [s.append.remote(i) for i in range(10)]
+    assert ray.get(refs[-1]) == list(range(10))
+
+
+def test_kill_during_init(ray_start_regular):
+    @ray.remote
+    class SlowInit:
+        def __init__(self):
+            time.sleep(1)
+
+        def m(self):
+            return 1
+
+    s = SlowInit.remote()
+    time.sleep(0.05)
+    ray.kill(s)
+    with pytest.raises(ActorError):
+        ray.get(s.m.remote(), timeout=5)
+
+
+def test_dynamic_returns(ray_start_regular):
+    @ray.remote(num_returns="dynamic")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    ref = gen.remote(4)
+    item_refs = ray.get(ref)
+    assert len(item_refs) == 4
+    assert ray.get(list(item_refs)) == [0, 10, 20, 30]
+
+
+def test_out_of_range_bundle_index_rejected(ray_start_regular):
+    from ray_tpu.util import placement_group, remove_placement_group
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy)
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}])
+
+    @ray.remote
+    def f():
+        return 1
+
+    strategy = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=5)
+    with pytest.raises(ValueError):
+        f.options(scheduling_strategy=strategy).remote()
+    # The scheduler must still be live afterwards.
+    assert ray.get(f.remote(), timeout=5) == 1
+    remove_placement_group(pg)
+
+
+def test_shutdown_unblocks_pending_get(ray_start_regular):
+    import threading
+
+    @ray.remote
+    def never():
+        time.sleep(60)
+
+    ref = never.remote()
+    result = {}
+
+    def blocked_get():
+        try:
+            ray.get(ref)
+            result["outcome"] = "value"
+        except Exception as e:  # noqa: BLE001
+            result["outcome"] = type(e).__name__
+
+    t = threading.Thread(target=blocked_get)
+    t.start()
+    time.sleep(0.2)
+    ray.shutdown()
+    t.join(timeout=5)
+    assert not t.is_alive(), "get() must not hang across shutdown"
+    assert result["outcome"] != "value"

@@ -1,0 +1,70 @@
+"""Placement group tests (modeled on python/ray/tests/test_placement_group.py)."""
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu.exceptions import PlacementGroupError
+from ray_tpu.util import (placement_group, placement_group_table,
+                          remove_placement_group)
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+def test_pg_reserves_resources(ray_start_regular):
+    before = ray.available_resources()["CPU"]
+    pg = placement_group([{"CPU": 4}])
+    assert ray.available_resources()["CPU"] == before - 4
+    remove_placement_group(pg)
+    assert ray.available_resources()["CPU"] == before
+
+
+def test_pg_infeasible_rejected(ray_start_regular):
+    with pytest.raises(PlacementGroupError):
+        placement_group([{"CPU": 10_000}])
+
+
+def test_pg_invalid_strategy(ray_start_regular):
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
+
+
+def test_task_in_bundle(ray_start_regular):
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}])
+
+    @ray.remote(num_cpus=2)
+    def f():
+        return "ran"
+
+    strategy = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=1)
+    assert ray.get(f.options(scheduling_strategy=strategy).remote()) == "ran"
+    remove_placement_group(pg)
+
+
+def test_bundle_capacity_enforced(ray_start_regular):
+    pg = placement_group([{"CPU": 1}])
+
+    @ray.remote(num_cpus=4)
+    def f():
+        return 1
+
+    strategy = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    with pytest.raises((ray.exceptions.TaskError, ValueError)):
+        ray.get(f.options(scheduling_strategy=strategy).remote(), timeout=5)
+    remove_placement_group(pg)
+
+
+def test_pg_table(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], name="mesh_slice_0")
+    table = placement_group_table(pg)
+    assert table["name"] == "mesh_slice_0"
+    assert table["strategy"] == "PACK"
+    assert table["state"] == "CREATED"
+    remove_placement_group(pg)
+
+
+def test_pg_ready_and_wait(ray_start_regular):
+    pg = placement_group([{"CPU": 1}])
+    assert ray.get(pg.ready(), timeout=5) is not None
+    assert pg.wait(1)
+    remove_placement_group(pg)
