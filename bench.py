@@ -1,0 +1,104 @@
+"""Headline benchmark: flagship GPT training throughput + MFU on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured MFU / 0.40 (the north-star target from BASELINE.json:
+GPT-J fine-tune at >=40% MFU; here measured on the single available chip with
+the chip-sized preset).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+# Peak bf16 matmul FLOP/s per chip by platform.
+PEAK_FLOPS = {
+    "tpu v4": 275e12,
+    "tpu v5 lite": 197e12,  # v5e
+    "tpu v5": 459e12,  # v5p
+    "tpu v5p": 459e12,
+    "tpu v6 lite": 918e12,  # v6e/trillium
+    "cpu": 1e11,  # nominal, for local smoke runs only
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return PEAK_FLOPS["cpu"]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import gpt
+    from ray_tpu.parallel import MeshConfig, ShardingRules, build_mesh
+    from ray_tpu.parallel.train_step import (default_optimizer,
+                                             init_train_state,
+                                             make_train_step)
+
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    if on_tpu:
+        preset, batch, seq, steps, warmup = "gpt-410m", 16, 1024, 10, 2
+    else:
+        preset, batch, seq, steps, warmup = "gpt-tiny", 4, 128, 5, 1
+
+    cfg = gpt.config(preset, max_seq_len=seq)
+    n_devices = 1
+    mesh = build_mesh(
+        MeshConfig(dp=1, fsdp=1, tp=1, sp=1, ep=1),
+        devices=[device])
+    rules = ShardingRules(batch=None, embed=None, heads=None, kv_heads=None,
+                          mlp=None, vocab=None)
+    optimizer = default_optimizer(learning_rate=1e-4)
+    state = init_train_state(cfg, mesh, rules, optimizer, seed=0)
+    step = make_train_step(cfg, mesh, rules, optimizer)
+
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        toks = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
+        return {
+            "tokens": jnp.asarray(toks[:, :-1], jnp.int32),
+            "targets": jnp.asarray(toks[:, 1:], jnp.int32),
+        }
+
+    data = make_batch()
+    for _ in range(warmup):
+        state, metrics = step(state, data)
+    float(metrics["loss"])  # full device sync (block_until_ready is not
+    # sufficient on the remote-tunnel backend)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, data)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+    n_params = cfg.num_params()
+    # Training FLOPs: 6N per token (fwd+bwd) + remat recompute is not counted
+    # as useful FLOPs (standard MFU convention), + attention term.
+    attn_flops = 12 * cfg.n_layers * cfg.d_model * seq
+    flops_per_token = 6.0 * n_params + attn_flops
+    mfu = tokens_per_sec * flops_per_token / (
+        _peak_flops(device) * n_devices)
+
+    result = {
+        "metric": f"{preset}_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -261,6 +261,10 @@ class Runtime:
         # blocking tasks (e.g. sleeping) don't starve the pool.
         self._max_workers = max_workers or max(
             64, int(node_resources.num_cpus) * 8)
+        # Chip-slot allocator: tasks with integer num_tpus get distinct chip
+        # ids (the analog of the reference's CUDA_VISIBLE_DEVICES assignment,
+        # python/ray/_private/utils.py get_cuda_visible_devices).
+        self._free_tpu_ids = list(range(int(node_resources.num_tpus)))
         self._task_events: List[dict] = []  # lightweight task-event buffer
 
     # ------------------------------------------------------------------
@@ -429,6 +433,10 @@ class Runtime:
             exc = self.store.get_if_exception(d)
             if exc is not None:
                 self._store_error(spec, exc)
+                if spec.kind == TaskKind.ACTOR_TASK:
+                    # The handle's sequence must still advance, or every
+                    # later call on this handle would wait forever.
+                    self._abort_actor_task_seq(spec)
                 return
         if spec.kind == TaskKind.ACTOR_TASK:
             self._dispatch_actor_task(spec)
@@ -481,6 +489,10 @@ class Runtime:
                     self._ready.pop(i)
                     self._inflight[spec.task_id] = spec
                     spec._acquired_bundle = acquired  # type: ignore[attr-defined]
+                    n_tpus = int(spec.resources.get("TPU", 0))
+                    if n_tpus >= 1 and len(self._free_tpu_ids) >= n_tpus:
+                        spec._tpu_ids = [  # type: ignore[attr-defined]
+                            self._free_tpu_ids.pop() for _ in range(n_tpus)]
                     launched = (spec, worker)
                     break
             if launched is None or launched is True:
@@ -599,6 +611,11 @@ class Runtime:
         acquired = getattr(spec, "_acquired_bundle", -1)
         self.scheduler.release(spec.resources, pg_id,
                                bundle if bundle >= 0 else acquired)
+        tpu_ids = getattr(spec, "_tpu_ids", None)
+        if tpu_ids:
+            with self._lock:
+                self._free_tpu_ids.extend(tpu_ids)
+            spec._tpu_ids = None  # type: ignore[attr-defined]
         with self._lock:
             self._inflight.pop(spec.task_id, None)
         self._return_worker(worker)
@@ -612,8 +629,13 @@ class Runtime:
                      max_concurrency: int, name: str = "",
                      namespace: str = "default",
                      get_if_exists: bool = False) -> ActorID:
-        if name:
-            with self._lock:
+        actor_id = spec.actor_id
+        state = ActorState(actor_id, spec, max_restarts, max_concurrency,
+                           name, namespace)
+        with self._lock:
+            # Uniqueness check + registration atomically, so concurrent
+            # creates with the same name cannot both succeed.
+            if name:
                 existing = self._named_actors.get((namespace, name))
                 if existing is not None:
                     if get_if_exists:
@@ -621,13 +643,8 @@ class Runtime:
                     raise ValueError(
                         f"Actor name {name!r} already taken in namespace "
                         f"{namespace!r}")
-        actor_id = spec.actor_id
-        state = ActorState(actor_id, spec, max_restarts, max_concurrency,
-                           name, namespace)
-        with self._lock:
-            self._actors[actor_id] = state
-            if name:
                 self._named_actors[(namespace, name)] = actor_id
+            self._actors[actor_id] = state
         spec.return_ids = [ObjectID.for_return(spec.task_id, 1)]
         self._record_event(spec, "SUBMITTED")
         self._resolve_dependencies(spec)
@@ -669,6 +686,11 @@ class Runtime:
         acquired = getattr(spec, "_acquired_bundle", -1)
         self.scheduler.release(spec.resources, pg_id,
                                bundle if bundle >= 0 else acquired)
+        tpu_ids = getattr(spec, "_tpu_ids", None)
+        if tpu_ids:
+            with self._lock:
+                self._free_tpu_ids.extend(tpu_ids)
+            spec._tpu_ids = None  # type: ignore[attr-defined]
 
     def _run_actor_creation(self, spec: TaskSpec, worker: Executor) -> None:
         state = self._actors[spec.actor_id]
@@ -719,6 +741,10 @@ class Runtime:
             self._release_actor_resources(state)
             for queued in unfinished:
                 self._store_error(queued, err)
+            with self._lock:
+                if state.name:
+                    self._named_actors.pop((state.namespace, state.name),
+                                           None)
         with self._lock:
             self._inflight.pop(spec.task_id, None)
         self._return_worker(worker)
@@ -747,6 +773,39 @@ class Runtime:
         self._resolve_dependencies(spec)
         return refs
 
+    def _abort_actor_task_seq(self, spec: TaskSpec) -> None:
+        """Mark a sealed-without-running actor task's sequence number as
+        satisfied so later tasks on the same handle still execute."""
+        state = self._actors.get(spec.actor_id)
+        if state is None:
+            return
+        with state.lock:
+            state.unfinished.pop(spec.task_id, None)
+            handle = spec.caller_handle_id or "default"
+            seq_state = state.seq_state.setdefault(
+                handle, {"next": 1, "waiting": {}, "aborted": set()})
+            seq_state.setdefault("aborted", set()).add(spec.sequence_number)
+            self._drain_actor_seq(state, seq_state)
+
+    def _drain_actor_seq(self, state: ActorState, seq_state: dict) -> None:
+        """Submit all consecutively-ready tasks. Caller holds state.lock."""
+        aborted = seq_state.setdefault("aborted", set())
+        while True:
+            nxt = seq_state["next"]
+            if nxt in aborted:
+                aborted.discard(nxt)
+                seq_state["next"] += 1
+                continue
+            if nxt not in seq_state["waiting"]:
+                return
+            ready = seq_state["waiting"].pop(nxt)
+            seq_state["next"] += 1
+            if state.created.is_set() and state.executor is not None:
+                state.executor.submit(
+                    lambda s=ready: self._run_actor_task(s, state))
+            else:
+                state.pre_creation_queue.append(ready)
+
     def _dispatch_actor_task(self, spec: TaskSpec) -> None:
         """Called when the task's deps are resolved. Enforces per-handle
         submission order: a task only reaches the executor when every earlier
@@ -763,16 +822,9 @@ class Runtime:
                 return
             handle = spec.caller_handle_id or "default"
             seq_state = state.seq_state.setdefault(
-                handle, {"next": 1, "waiting": {}})
+                handle, {"next": 1, "waiting": {}, "aborted": set()})
             seq_state["waiting"][spec.sequence_number] = spec
-            while seq_state["next"] in seq_state["waiting"]:
-                ready = seq_state["waiting"].pop(seq_state["next"])
-                seq_state["next"] += 1
-                if state.created.is_set() and state.executor is not None:
-                    state.executor.submit(
-                        lambda s=ready: self._run_actor_task(s, state))
-                else:
-                    state.pre_creation_queue.append(ready)
+            self._drain_actor_seq(state, seq_state)
 
     def _finish_actor_task(self, spec: TaskSpec, state: ActorState) -> None:
         with state.lock:
@@ -831,6 +883,10 @@ class Runtime:
         state = self._actors.get(actor_id)
         if state is None:
             return
+        if not no_restart and (state.max_restarts == -1
+                               or state.num_restarts < state.max_restarts):
+            self._restart_actor(state)
+            return
         with state.lock:
             if state.dead:
                 return
@@ -856,6 +912,89 @@ class Runtime:
         with self._lock:
             if state.name:
                 self._named_actors.pop((state.namespace, state.name), None)
+        self._dispatch()
+
+    def _restart_actor(self, state: ActorState) -> None:
+        """Restart an actor in place: stop the current instance, fail its
+        in-flight tasks, and re-run the creation task on a fresh executor
+        (reference: max_restarts semantics, gcs_actor_manager.h:88 — state is
+        lost unless the actor checkpoints itself)."""
+        cause = ActorDiedError(
+            state.actor_id,
+            f"Actor {state.actor_id} is restarting; in-flight tasks failed.")
+        with state.lock:
+            state.num_restarts += 1
+            old_executor = state.executor
+            state.executor = None
+            state.instance = None
+            state.created.clear()
+            unfinished = list(state.unfinished.values())
+            state.unfinished.clear()
+            state.pre_creation_queue.clear()
+            if old_executor is not None:
+                old_executor.stop()
+            # Sequence slots held by the failed tasks must not block the
+            # restarted actor.
+            for spec in unfinished:
+                handle = spec.caller_handle_id or "default"
+                seq_state = state.seq_state.setdefault(
+                    handle, {"next": 1, "waiting": {}, "aborted": set()})
+                if spec.sequence_number >= seq_state["next"]:
+                    seq_state.setdefault("aborted", set()).add(
+                        spec.sequence_number)
+            for seq_state in state.seq_state.values():
+                self._drain_actor_seq(state, seq_state)
+        for spec in unfinished:
+            self._store_error(spec, cause)
+        # Re-run the creation task (a fresh TaskSpec attempt on the same
+        # actor id); resources were never released, so dispatch reuses the
+        # original reservation by running creation on a pool worker directly.
+        creation = state.creation_spec
+        worker = None
+        with self._lock:
+            worker = self._pop_worker()
+        if worker is None:
+            # Pool exhausted; queue through the normal path without
+            # re-acquiring resources.
+            worker = SerialThreadExecutor(
+                WorkerID.from_random(), name="ray_tpu-restart")
+            with self._lock:
+                self._all_workers.append(worker)
+        # Reset the creation return object is not possible (sealed); restart
+        # success is observable via task results.
+        worker.submit(lambda: self._run_actor_creation_restart(
+            creation, worker, state))
+
+    def _run_actor_creation_restart(self, spec: TaskSpec, worker: Executor,
+                                    state: ActorState) -> None:
+        try:
+            cls = self.functions.load(spec.function_id)
+            args, kwargs = self._resolve_args(spec)
+            instance = cls(*args, **kwargs)
+            executor = self._make_actor_executor(state)
+            with state.lock:
+                if state.dead:
+                    executor.stop()
+                else:
+                    state.instance = instance
+                    state.executor = executor
+                    state.created.set()
+                    for queued in state.pre_creation_queue:
+                        executor.submit(
+                            lambda s=queued: self._run_actor_task(s, state))
+                    state.pre_creation_queue.clear()
+        except BaseException as e:  # noqa: BLE001
+            err = TaskError(e, traceback.format_exc(), f"{spec.name}.restart")
+            with state.lock:
+                state.dead = True
+                state.death_cause = err
+                state.created.set()
+                unfinished = list(state.unfinished.values())
+                state.unfinished.clear()
+            for queued in unfinished:
+                self._store_error(queued, err)
+            self._release_actor_resources(state)
+        self._return_worker(worker)
         self._dispatch()
 
     def get_named_actor(self, name: str, namespace: str = "default") -> ActorID:
@@ -889,6 +1028,8 @@ class Runtime:
                         pending.cancelled = True
                         self._store_error(pending.spec,
                                           TaskCancelledError(task_id))
+                        if pending.spec.kind == TaskKind.ACTOR_TASK:
+                            self._abort_actor_task_seq(pending.spec)
                         return
         # Running tasks on thread executors cannot be interrupted; the result
         # is discarded lazily (the reference kills the worker process here).

@@ -219,11 +219,18 @@ def free(object_refs: Sequence[ObjectRef]) -> None:
 
 
 def get_tpu_ids() -> List[int]:
-    """TPU chips assigned to the current task/actor (analog of the
-    reference's get_gpu_ids, python/ray/_private/worker.py:832)."""
+    """TPU chip ids assigned to the current task/actor (analog of the
+    reference's get_gpu_ids, python/ray/_private/worker.py:832). Concurrent
+    tasks receive disjoint chip sets; fractional requests (<1 chip) share
+    and get []."""
     from ray_tpu._private.runtime import current_task_spec
     spec = current_task_spec()
     if spec is None:
         return []
-    n = int(spec.resources.get("TPU", 0))
-    return list(range(n))
+    ids = getattr(spec, "_tpu_ids", None)
+    if ids is None and spec.actor_id is not None:
+        # Actor methods inherit the chips reserved at actor creation.
+        state = global_worker.runtime.actor_state(spec.actor_id)
+        if state is not None:
+            ids = getattr(state.creation_spec, "_tpu_ids", None)
+    return sorted(ids or [])

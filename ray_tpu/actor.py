@@ -40,14 +40,17 @@ class ActorMethod:
 class ActorHandle:
     def __init__(self, actor_id: ActorID, cls: Optional[type] = None,
                  name: str = ""):
+        import itertools
         import uuid
         self._actor_id = actor_id
         self._cls = cls
         self._name = name
         # Per-handle ordering state (each handle instance gets its own
         # sequence, matching the reference's per-handle call ordering).
+        # itertools.count.__next__ is atomic, so concurrent .remote() calls
+        # from multiple threads sharing this handle get unique seq numbers.
         self._handle_id = uuid.uuid4().hex
-        self._seq = 0
+        self._seq_counter = itertools.count(1)
 
     def __getattr__(self, item):
         if item.startswith("_"):
@@ -56,7 +59,7 @@ class ActorHandle:
 
     def _actor_method_call(self, method_name, args, kwargs, num_returns=1):
         runtime = global_worker.runtime
-        self._seq += 1
+        seq = next(self._seq_counter)
         state = runtime.actor_state(self._actor_id)
         spec = TaskSpec(
             task_id=TaskID.for_actor_task(self._actor_id),
@@ -72,7 +75,7 @@ class ActorHandle:
             max_retries=0,
             actor_id=self._actor_id,
             method_name=method_name,
-            sequence_number=self._seq,
+            sequence_number=seq,
             caller_handle_id=self._handle_id,
         )
         refs = runtime.submit_actor_task(spec)
@@ -137,17 +140,8 @@ class ActorClass:
         name = options.get("name") or ""
         namespace = options.get("namespace") or global_worker.namespace
         get_if_exists = bool(options.get("get_if_exists"))
-        strategy = options.get("scheduling_strategy")
-        pg = options.get("placement_group")
-        if pg is not None and strategy is None:
-            from ray_tpu.util.scheduling_strategies import (
-                PlacementGroupSchedulingStrategy)
-            strategy = PlacementGroupSchedulingStrategy(
-                placement_group=pg,
-                placement_group_bundle_index=options.get(
-                    "placement_group_bundle_index", -1))
-        from ray_tpu.util.scheduling_strategies import validate_strategy
-        validate_strategy(strategy)
+        from ray_tpu.util.scheduling_strategies import strategy_from_options
+        strategy = strategy_from_options(options)
         spec = TaskSpec(
             task_id=TaskID.for_actor_creation(actor_id),
             kind=TaskKind.ACTOR_CREATION,

@@ -1,0 +1,3 @@
+from ray_tpu.models import gpt
+
+__all__ = ["gpt"]

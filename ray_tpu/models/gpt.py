@@ -1,0 +1,324 @@
+"""GPT family — the flagship model (GPT-J-6B architecture), TPU-first.
+
+This is the model behind the north-star benchmark (BASELINE.json: GPT-J-6B
+fine-tune at ≥40% MFU): rotary position embeddings and the GPT-J *parallel*
+residual block (one LayerNorm feeding attention and MLP simultaneously —
+one fewer sequential matmul chain, friendlier to MXU pipelining). Design
+choices for TPU:
+
+* **Pure-pytree params + functional apply** — no module framework between
+  the arrays and GSPMD; every parameter carries a logical-axis name so
+  sharding is a `ShardingRules` table (parallel/sharding.py).
+* **`lax.scan` over stacked layer params** — one compiled block body
+  regardless of depth: O(1) XLA compile time, and GSPMD shards the stacked
+  weights with a leading `layers` axis.
+* **bf16 activations/matmuls, fp32 softmax & layernorm accumulation** —
+  MXU-native without numerics drift.
+* **Static shapes everywhere**; causal masking via iota comparison, no
+  dynamic slicing in the hot path.
+
+Capability parity note: the reference has no model zoo of its own (models
+come from torch); this module is the JAX equivalent of what
+`transformers.GPTJForCausalLM` provides to the reference's Train examples
+(reference: release/air_tests/air_benchmarks/workloads/torch_benchmark.py
+trains torchvision models; the GPT-J fine-tune config is driver-supplied).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ray_tpu.parallel.sharding import ShardingRules
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50400
+    n_layers: int = 28
+    d_model: int = 4096
+    n_heads: int = 16
+    n_kv_heads: Optional[int] = None  # != n_heads → GQA/MQA
+    d_ff: int = 16384
+    max_seq_len: int = 2048
+    rotary_dim: int = 64  # GPT-J applies rotary to a prefix of head_dim
+    parallel_block: bool = True  # GPT-J parallel attn+MLP residual
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16  # activation/compute dtype
+    param_dtype: Any = jnp.float32
+    remat: bool = True  # checkpoint each block (HBM ⇄ FLOPs trade)
+    attn_impl: str = "dot"  # "dot" | "flash" | "ring"
+    layernorm_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    def num_params(self) -> int:
+        d, f, v, L = self.d_model, self.d_ff, self.vocab_size, self.n_layers
+        kvh = self.kv_heads * self.head_dim
+        per_layer = d * d + 2 * d * kvh + d * d + 2 * d * f + f + d + 2 * d
+        head = 0 if self.tie_embeddings else v * d + v
+        return v * d + L * per_layer + 2 * d + head
+
+
+# -- presets ------------------------------------------------------------
+
+PRESETS: Dict[str, GPTConfig] = {
+    # The north-star model (matches EleutherAI/gpt-j-6b hyperparameters).
+    "gptj-6b": GPTConfig(),
+    # Single-v5e-chip benchmark model.
+    "gpt-410m": GPTConfig(
+        vocab_size=50304, n_layers=24, d_model=1024, n_heads=16,
+        d_ff=4096, rotary_dim=32, max_seq_len=1024),
+    "gpt2-124m": GPTConfig(
+        vocab_size=50304, n_layers=12, d_model=768, n_heads=12, d_ff=3072,
+        rotary_dim=32, max_seq_len=1024),
+    # Test-size configs.
+    "gpt-tiny": GPTConfig(
+        vocab_size=256, n_layers=2, d_model=64, n_heads=4, d_ff=128,
+        rotary_dim=8, max_seq_len=128, dtype=jnp.float32, remat=False),
+    "gpt-micro": GPTConfig(
+        vocab_size=512, n_layers=4, d_model=128, n_heads=8, d_ff=512,
+        rotary_dim=16, max_seq_len=256, dtype=jnp.float32, remat=False),
+}
+
+
+def config(name: str, **overrides) -> GPTConfig:
+    cfg = PRESETS[name]
+    return replace(cfg, **overrides) if overrides else cfg
+
+
+# -- parameter init + sharding specs -----------------------------------
+
+def init(cfg: GPTConfig, key: jax.Array) -> Dict[str, Any]:
+    """Initialize parameters (GPT-2-style scaled normal init)."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    d, f, v, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers
+    h, kvh, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    pd = cfg.param_dtype
+    std = 0.02
+    out_std = std / math.sqrt(2 * L)
+
+    def norm(k, shape, s=std):
+        return (jax.random.normal(k, shape, jnp.float32) * s).astype(pd)
+
+    ks = jax.random.split(k_layers, 6)
+
+    def stack(k, shape, s=std):
+        # One leading layers axis for lax.scan.
+        return norm(k, (L,) + shape, s)
+
+    layers = {
+        "ln1_scale": jnp.ones((L, d), pd),
+        "ln1_bias": jnp.zeros((L, d), pd),
+        "wq": stack(ks[0], (d, h, hd)),
+        "wk": stack(ks[1], (d, kvh, hd)),
+        "wv": stack(ks[2], (d, kvh, hd)),
+        "wo": stack(ks[3], (h, hd, d), out_std),
+        "w_in": stack(ks[4], (d, f)),
+        "b_in": jnp.zeros((L, f), pd),
+        "w_out": stack(ks[5], (f, d), out_std),
+        "b_out": jnp.zeros((L, d), pd),
+    }
+    if not cfg.parallel_block:
+        layers["ln2_scale"] = jnp.ones((L, d), pd)
+        layers["ln2_bias"] = jnp.zeros((L, d), pd)
+    params = {
+        "wte": norm(k_embed, (v, d)),
+        "layers": layers,
+        "lnf_scale": jnp.ones((d,), pd),
+        "lnf_bias": jnp.zeros((d,), pd),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(k_head, (d, v))
+        params["lm_head_bias"] = jnp.zeros((v,), pd)
+    return params
+
+
+def param_specs(cfg: GPTConfig, rules: ShardingRules) -> Dict[str, Any]:
+    """PartitionSpec pytree matching init()'s structure."""
+    r = rules
+    layers = {
+        "ln1_scale": r.spec("layers", "embed"),
+        "ln1_bias": r.spec("layers", "embed"),
+        "wq": r.spec("layers", "embed", "heads", "head_dim"),
+        "wk": r.spec("layers", "embed", "kv_heads", "head_dim"),
+        "wv": r.spec("layers", "embed", "kv_heads", "head_dim"),
+        "wo": r.spec("layers", "heads", "head_dim", "embed"),
+        "w_in": r.spec("layers", "embed", "mlp"),
+        "b_in": r.spec("layers", "mlp"),
+        "w_out": r.spec("layers", "mlp", "embed"),
+        "b_out": r.spec("layers", "embed"),
+    }
+    if not cfg.parallel_block:
+        layers["ln2_scale"] = r.spec("layers", "embed")
+        layers["ln2_bias"] = r.spec("layers", "embed")
+    specs = {
+        "wte": r.spec("vocab", "embed"),
+        "layers": layers,
+        "lnf_scale": r.spec("embed"),
+        "lnf_bias": r.spec("embed"),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = r.spec("embed", "vocab")
+        specs["lm_head_bias"] = r.spec("vocab")
+    return specs
+
+
+def batch_spec(rules: ShardingRules) -> PartitionSpec:
+    return rules.spec("batch", "sequence")
+
+
+# -- forward ------------------------------------------------------------
+
+def _layernorm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rotary(x, positions, rotary_dim):
+    """Apply GPT-J (interleaved) rotary embedding to the first rotary_dim
+    dims of each head. x: [B, S, H, D], positions: [B, S]."""
+    if rotary_dim == 0:
+        return x
+    rot, rest = x[..., :rotary_dim], x[..., rotary_dim:]
+    half = rotary_dim // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = rot[..., :half], rot[..., half:]
+    rot_out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return jnp.concatenate([rot_out, rest], axis=-1)
+
+
+def _dot_attention(q, k, v, cfg: GPTConfig):
+    """Causal attention; fp32 softmax. q,k,v: [B, S, H, D]/[B, S, KVH, D]."""
+    B, S, H, D = q.shape
+    kvh = k.shape[2]
+    if kvh != H:  # GQA: repeat KV heads
+        rep = H // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+    causal = qpos >= kpos
+    logits = jnp.where(causal[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _attention(q, k, v, cfg: GPTConfig):
+    if cfg.attn_impl == "dot":
+        return _dot_attention(q, k, v, cfg)
+    if cfg.attn_impl == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=True)
+    if cfg.attn_impl == "ring":
+        from ray_tpu.ops.ring_attention import ring_attention
+        return ring_attention(q, k, v, axis_name="sp")
+    raise ValueError(f"Unknown attn_impl {cfg.attn_impl!r}")
+
+
+def _block(cfg: GPTConfig, x, layer, positions):
+    """One transformer block. x: [B, S, D]."""
+    dt = cfg.dtype
+    h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"],
+                   cfg.layernorm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(dt))
+    q = _rotary(q, positions, cfg.rotary_dim)
+    k = _rotary(k, positions, cfg.rotary_dim)
+    attn = _attention(q, k, v, cfg)
+    attn_out = jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(dt))
+
+    if cfg.parallel_block:
+        mlp_in = h  # GPT-J: shared LN feeds both branches
+    else:
+        x = x + attn_out
+        mlp_in = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"],
+                            cfg.layernorm_eps)
+    ff = jnp.einsum("bsd,df->bsf", mlp_in, layer["w_in"].astype(dt))
+    ff = jax.nn.gelu(ff + layer["b_in"].astype(dt))
+    mlp_out = jnp.einsum("bsf,fd->bsd", ff, layer["w_out"].astype(dt))
+    mlp_out = mlp_out + layer["b_out"].astype(dt)
+
+    if cfg.parallel_block:
+        return x + attn_out + mlp_out
+    return x + mlp_out
+
+
+def forward(params: Dict[str, Any], cfg: GPTConfig, tokens: jax.Array,
+            positions: Optional[jax.Array] = None) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, vocab] (compute dtype)."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
+
+    block = partial(_block, cfg)
+    if cfg.remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_body(carry, layer):
+        return block(carry, layer, positions), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = _layernorm(x, params["lnf_scale"], params["lnf_bias"],
+                   cfg.layernorm_eps)
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(cfg.dtype))
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x,
+                            params["lm_head"].astype(cfg.dtype))
+        logits = logits + params["lm_head_bias"].astype(cfg.dtype)
+    return logits
+
+
+def loss_fn(params: Dict[str, Any], cfg: GPTConfig, tokens: jax.Array,
+            targets: jax.Array, mask: Optional[jax.Array] = None,
+            z_loss: float = 0.0) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross-entropy in fp32 (+ optional z-loss regularizer)."""
+    logits = forward(params, cfg, tokens).astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - tgt_logit
+    if z_loss:
+        nll = nll + z_loss * logz ** 2
+    if mask is None:
+        mask = jnp.ones_like(nll)
+    mask = mask.astype(jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / denom
+    acc = ((logits.argmax(-1) == targets) * mask).sum() / denom
+    return loss, {"loss": loss, "accuracy": acc,
+                  "perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
+
+
+def flops_per_token(cfg: GPTConfig) -> float:
+    """Approximate training FLOPs/token (6N + attention quadratic term)."""
+    n = cfg.num_params()
+    attn = 12 * cfg.n_layers * cfg.d_model * cfg.max_seq_len
+    return 6.0 * n + attn

@@ -1,0 +1,21 @@
+from ray_tpu.parallel.mesh import (AXIS_ORDER, MeshConfig, build_mesh,
+                                   single_device_mesh)
+from ray_tpu.parallel.sharding import (ShardingRules, context_parallel_rules,
+                                       dp_rules, fsdp_rules, named_sharding,
+                                       shard_tree, tp_fsdp_rules,
+                                       tree_shardings)
+
+__all__ = [
+    "AXIS_ORDER",
+    "MeshConfig",
+    "ShardingRules",
+    "build_mesh",
+    "context_parallel_rules",
+    "dp_rules",
+    "fsdp_rules",
+    "named_sharding",
+    "shard_tree",
+    "single_device_mesh",
+    "tp_fsdp_rules",
+    "tree_shardings",
+]

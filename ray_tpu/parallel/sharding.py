@@ -1,0 +1,85 @@
+"""Logical-axis sharding rules → PartitionSpecs.
+
+The GSPMD replacement for the reference's wrapper-based strategies
+(torch DDP/FSDP in train/torch/train_loop_utils.py): models annotate each
+parameter/activation dimension with a *logical* axis name; a ShardingRules
+table maps logical names to mesh axes. Swapping DP↔FSDP↔TP↔SP is a rules
+change — the model code never changes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple, Union
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+
+@dataclass(frozen=True)
+class ShardingRules:
+    """Maps logical dimension names to mesh axes (None = replicated)."""
+
+    batch: MeshAxes = ("dp", "fsdp")
+    sequence: MeshAxes = None  # set to "sp" for context parallelism
+    embed: MeshAxes = "fsdp"  # weight-sharding axis (ZeRO-3 analog)
+    heads: MeshAxes = "tp"
+    kv_heads: MeshAxes = "tp"
+    head_dim: MeshAxes = None
+    mlp: MeshAxes = "tp"
+    vocab: MeshAxes = "tp"
+    expert: MeshAxes = "ep"
+    layers: MeshAxes = None  # leading axis of scan-stacked params
+
+    def spec(self, *logical_axes: Optional[str]) -> PartitionSpec:
+        parts = []
+        for name in logical_axes:
+            if name is None:
+                parts.append(None)
+            else:
+                parts.append(getattr(self, name))
+        return PartitionSpec(*parts)
+
+
+# Rules presets ---------------------------------------------------------
+
+def dp_rules() -> ShardingRules:
+    """Pure data parallelism: replicate weights, shard batch."""
+    return ShardingRules(embed=None, heads=None, kv_heads=None, mlp=None,
+                         vocab=None)
+
+
+def fsdp_rules() -> ShardingRules:
+    """Fully-sharded DP (ZeRO-3): weights sharded over fsdp, no TP."""
+    return ShardingRules(heads=None, kv_heads=None, mlp=None, vocab=None)
+
+
+def tp_fsdp_rules() -> ShardingRules:
+    """2D: Megatron TP on heads/mlp/vocab + FSDP on the embed dim."""
+    return ShardingRules()
+
+
+def context_parallel_rules() -> ShardingRules:
+    """TP+FSDP+sequence sharding (ring attention over sp)."""
+    return ShardingRules(sequence="sp")
+
+
+# Helpers ---------------------------------------------------------------
+
+def named_sharding(mesh, spec: PartitionSpec) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def tree_shardings(mesh, spec_tree):
+    """Map a pytree of PartitionSpecs to NamedShardings on `mesh`."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), spec_tree,
+        is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+
+def shard_tree(tree, mesh, spec_tree):
+    """Device_put a pytree with the given specs (zero-copy when possible)."""
+    shardings = tree_shardings(mesh, spec_tree)
+    return jax.device_put(tree, shardings)

@@ -52,17 +52,8 @@ class RemoteFunction:
         num_returns = options.get("num_returns", 1)
         if num_returns is None:
             num_returns = 1
-        strategy = options.get("scheduling_strategy")
-        pg = options.get("placement_group")
-        if pg is not None and strategy is None:
-            from ray_tpu.util.scheduling_strategies import (
-                PlacementGroupSchedulingStrategy)
-            strategy = PlacementGroupSchedulingStrategy(
-                placement_group=pg,
-                placement_group_bundle_index=options.get(
-                    "placement_group_bundle_index", -1))
-        from ray_tpu.util.scheduling_strategies import validate_strategy
-        validate_strategy(strategy)
+        from ray_tpu.util.scheduling_strategies import strategy_from_options
+        strategy = strategy_from_options(options)
         spec = TaskSpec(
             task_id=TaskID.for_normal_task(runtime.job_id),
             kind=TaskKind.NORMAL,

@@ -30,6 +30,20 @@ DEFAULT = "DEFAULT"
 SPREAD = "SPREAD"
 
 
+def strategy_from_options(options: dict):
+    """Build + validate the scheduling strategy from call options (shared by
+    RemoteFunction._remote and ActorClass._remote)."""
+    strategy = options.get("scheduling_strategy")
+    pg = options.get("placement_group")
+    if pg is not None and strategy is None:
+        strategy = PlacementGroupSchedulingStrategy(
+            placement_group=pg,
+            placement_group_bundle_index=options.get(
+                "placement_group_bundle_index", -1))
+    validate_strategy(strategy)
+    return strategy
+
+
 def validate_strategy(strategy) -> None:
     """Eagerly reject malformed strategies at call time."""
     if strategy is None or isinstance(strategy, str):

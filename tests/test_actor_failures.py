@@ -131,6 +131,79 @@ def test_out_of_range_bundle_index_rejected(ray_start_regular):
     remove_placement_group(pg)
 
 
+def test_dep_failure_does_not_stall_handle(ray_start_regular):
+    """A failed dependency must not block later calls on the same handle."""
+    @ray.remote(max_retries=0)
+    def bad():
+        raise RuntimeError("dep failed")
+
+    @ray.remote
+    class A:
+        def m(self, x=None):
+            return "ok"
+
+    a = A.remote()
+    failing = a.m.remote(bad.remote())
+    following = a.m.remote()
+    with pytest.raises(ray.exceptions.TaskError):
+        ray.get(failing, timeout=5)
+    assert ray.get(following, timeout=5) == "ok"
+
+
+def test_concurrent_handle_submissions(ray_start_regular):
+    """Multiple driver threads sharing one handle must not lose calls."""
+    import threading
+
+    @ray.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    refs = []
+    refs_lock = threading.Lock()
+
+    def submit_many():
+        local = [c.incr.remote() for _ in range(20)]
+        with refs_lock:
+            refs.extend(local)
+
+    threads = [threading.Thread(target=submit_many) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    values = ray.get(refs, timeout=15)
+    assert sorted(values) == list(range(1, 81))
+
+
+def test_actor_restart(ray_start_regular):
+    @ray.remote(max_restarts=1)
+    class A:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    a = A.remote()
+    assert ray.get(a.incr.remote()) == 1
+    ray.kill(a, no_restart=False)
+    time.sleep(0.3)
+    # Restarted: state reset, still alive.
+    assert ray.get(a.incr.remote(), timeout=10) == 1
+    assert ray.get_runtime_context is not None
+    # Second kill exhausts max_restarts=1.
+    ray.kill(a, no_restart=False)
+    with pytest.raises(ActorError):
+        ray.get(a.incr.remote(), timeout=5)
+
+
 def test_shutdown_unblocks_pending_get(ray_start_regular):
     import threading
 

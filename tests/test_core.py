@@ -285,9 +285,14 @@ def test_tpu_resource_accounting():
 
     @ray.remote(num_tpus=2)
     def use_tpu():
+        time.sleep(0.2)
         return ray.get_tpu_ids()
 
-    assert ray.get(use_tpu.remote()) == [0, 1]
+    # Two concurrent 2-chip tasks must get disjoint chip sets.
+    a, b = ray.get([use_tpu.remote(), use_tpu.remote()])
+    assert len(a) == 2 and len(b) == 2
+    assert not (set(a) & set(b)), f"chip collision: {a} vs {b}"
+    assert set(a) | set(b) <= {0, 1, 2, 3}
     assert ray.cluster_resources()["TPU"] == 4.0
     ray.shutdown()
 
