@@ -1,0 +1,78 @@
+"""AIR configs: ScalingConfig / RunConfig / FailureConfig / CheckpointConfig.
+
+Analog of the reference's python/ray/air/config.py. The TPU-native
+ScalingConfig speaks chips and mesh axes instead of GPUs: ``use_tpu`` +
+``tpus_per_worker`` reserve chips, and ``mesh`` carries the parallelism
+layout the trainer should build (one worker per TPU host; in-worker
+parallelism is the mesh's job, not the worker count's).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many train workers and what each reserves.
+
+    reference: python/ray/air/config.py ScalingConfig (num_workers,
+    use_gpu, resources_per_worker, trainer_resources).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: Optional[float] = None
+    resources_per_worker: Optional[Dict[str, float]] = None
+    trainer_resources: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    # TPU-native: the mesh each worker should build over its chips
+    # (a parallel.MeshConfig); None -> pure DP over workers.
+    mesh: Optional[Any] = None
+
+    @property
+    def use_gpu(self) -> bool:  # reference-compat alias
+        return self.use_tpu
+
+    def worker_resources(self) -> Dict[str, float]:
+        resources = dict(self.resources_per_worker or {})
+        resources.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            resources.setdefault(
+                "TPU", self.tpus_per_worker
+                if self.tpus_per_worker is not None else 1.0)
+        return resources
+
+    def as_placement_group_bundles(self):
+        return [self.worker_resources() for _ in range(self.num_workers)]
+
+
+@dataclass
+class FailureConfig:
+    """reference: air/config.py FailureConfig (max_failures)."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """reference: air/config.py CheckpointConfig."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = False
+
+
+@dataclass
+class RunConfig:
+    """reference: air/config.py RunConfig."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    stop: Optional[Dict[str, Any]] = None
+    verbose: int = 1

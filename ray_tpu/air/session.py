@@ -1,0 +1,121 @@
+"""Train/Tune session: the in-loop API (report, world rank, checkpoint).
+
+Analog of the reference's python/ray/air/session.py:41 (session.report) and
+train/_internal/session.py (_TrainSession's bounded result queue). Each train
+worker / trial has a _Session bound to its execution context; ``report``
+blocks on a size-1 queue until the driver consumes the result — exactly the
+reference's backpressure semantics.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+class StopSession(BaseException):
+    """Raised inside report() when the driver stopped this worker/trial
+    (e.g. an early-stopping scheduler). Inherits BaseException so user
+    ``except Exception`` blocks don't swallow it."""
+
+
+class _Session:
+    def __init__(self, world_rank: int = 0, world_size: int = 1,
+                 local_rank: int = 0, trial_id: str = "",
+                 trial_name: str = "", config: Optional[dict] = None,
+                 checkpoint: Optional[Checkpoint] = None,
+                 dataset_shards: Optional[dict] = None):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.trial_id = trial_id
+        self.trial_name = trial_name
+        self.config = config or {}
+        self.loaded_checkpoint = checkpoint
+        self.dataset_shards = dataset_shards or {}
+        # Size-1 queue: the worker blocks in report() until the driver drains
+        # (reference: train/_internal/session.py:63 queue.Queue(1)).
+        self.result_queue: "queue.Queue" = queue.Queue(1)
+        self.continue_event = threading.Event()
+        self.stop_requested = False
+        self.finished = False
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        if self.stop_requested:
+            raise StopSession()
+        self.result_queue.put({"metrics": dict(metrics),
+                               "checkpoint": checkpoint})
+        self.continue_event.wait()
+        self.continue_event.clear()
+        if self.stop_requested:
+            raise StopSession()
+
+
+# One session per OS thread: train workers are actor threads, so
+# thread-local storage gives each worker its own session.
+_local = threading.local()
+
+
+def _set_session(session: Optional[_Session]) -> None:
+    _local.session = session
+
+
+def _get_session() -> Optional[_Session]:
+    return getattr(_local, "session", None)
+
+
+def _require_session() -> _Session:
+    s = _get_session()
+    if s is None:
+        raise RuntimeError(
+            "No session active: this API must be called inside a train loop "
+            "or Tune trainable run by JaxTrainer/Tuner.")
+    return s
+
+
+# -- public API (reference: air/session.py) ------------------------------
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    _require_session().report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return _require_session().loaded_checkpoint
+
+
+def get_world_rank() -> int:
+    return _require_session().world_rank
+
+
+def get_world_size() -> int:
+    return _require_session().world_size
+
+
+def get_local_rank() -> int:
+    return _require_session().local_rank
+
+
+def get_trial_id() -> str:
+    return _require_session().trial_id
+
+
+def get_trial_name() -> str:
+    return _require_session().trial_name
+
+
+def get_config() -> dict:
+    return dict(_require_session().config)
+
+
+def get_dataset_shard(name: str = "train"):
+    shard = _require_session().dataset_shards.get(name)
+    if shard is None:
+        raise KeyError(
+            f"No dataset shard named {name!r} was passed to the trainer "
+            f"(available: {list(_require_session().dataset_shards)})")
+    return shard

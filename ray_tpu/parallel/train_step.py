@@ -49,10 +49,23 @@ def init_train_state(cfg: gpt.GPTConfig, mesh,
         return gpt.init(cfg, key)
 
     params = _init_params(jax.random.PRNGKey(seed))
-    # Optimizer state inherits param shardings through GSPMD propagation.
+    # Optimizer state inherits param shardings through GSPMD propagation —
+    # except leaves with no data dependence on params (e.g. adam's step
+    # count), which XLA places on a single device; replicate those onto the
+    # mesh so the train step sees one consistent device set.
     opt_state = jax.jit(optimizer.init)(params)
-    return {"params": params, "opt_state": opt_state,
-            "step": jnp.zeros((), jnp.int32)}
+
+    def _ensure_on_mesh(x):
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None and getattr(
+                sharding, "num_devices", 1) == mesh.size:
+            return x
+        return jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
+
+    opt_state = jax.tree.map(_ensure_on_mesh, opt_state)
+    step = jax.device_put(jnp.zeros((), jnp.int32),
+                          NamedSharding(mesh, PartitionSpec()))
+    return {"params": params, "opt_state": opt_state, "step": step}
 
 
 def make_train_step(cfg: gpt.GPTConfig, mesh,

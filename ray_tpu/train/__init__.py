@@ -1,0 +1,23 @@
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                ScalingConfig)
+from ray_tpu.air.result import Result
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.base_trainer import BaseTrainer, DataParallelTrainer
+from ray_tpu.train.jax import JaxBackendConfig, JaxTrainer, prepare_mesh
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "BaseTrainer",
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxBackendConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "prepare_mesh",
+]

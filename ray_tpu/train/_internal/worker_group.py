@@ -1,0 +1,160 @@
+"""WorkerGroup: the gang of train-worker actors.
+
+Analog of the reference's train/_internal/worker_group.py:92 (WorkerGroup of
+actors created inside the trainer's placement group). Each TrainWorker runs
+the user's train loop on a side thread and streams results through its
+session queue; the driver drains via ``get_next_result`` actor calls —
+the same protocol as the reference's ``start_training``/``get_next_results``
+(train/_internal/backend_executor.py:315,414).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air import session as air_session
+from ray_tpu.air.session import StopSession, _Session
+from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
+                                          remove_placement_group)
+
+
+@ray_tpu.remote
+class TrainWorker:
+    """One rank of the training gang."""
+
+    def __init__(self, world_rank: int, world_size: int):
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.session: Optional[_Session] = None
+        self.thread: Optional[threading.Thread] = None
+        self.env: Dict[str, str] = {}
+
+    def setup_env(self, env: Dict[str, str]) -> None:
+        """Backend hook: set process env (e.g. jax.distributed coordinator)."""
+        import os
+        self.env.update(env)
+        os.environ.update(env)
+
+    def get_metadata(self) -> dict:
+        import socket
+        return {"rank": self.world_rank, "hostname": socket.gethostname(),
+                "tpu_ids": ray_tpu.get_tpu_ids()}
+
+    def _jax_distributed_init(self) -> None:
+        from ray_tpu.train.jax import distributed_init_if_needed
+        distributed_init_if_needed()
+
+    def start_training(self, train_fn: Callable, config: dict,
+                       trial_info: dict,
+                       checkpoint=None, dataset_shards: Optional[dict] = None
+                       ) -> None:
+        self.session = _Session(
+            world_rank=self.world_rank,
+            world_size=self.world_size,
+            local_rank=self.world_rank,  # single-node: local == world
+            trial_id=trial_info.get("trial_id", ""),
+            trial_name=trial_info.get("trial_name", ""),
+            config=config,
+            checkpoint=checkpoint,
+            dataset_shards=dataset_shards,
+        )
+        sess = self.session
+
+        def _run():
+            air_session._set_session(sess)
+            try:
+                try:
+                    result = train_fn(config) if _wants_config(train_fn) \
+                        else train_fn()
+                    sess.result_queue.put(
+                        {"finished": True, "result": result})
+                except StopSession:
+                    sess.result_queue.put({"finished": True, "stopped": True})
+                except BaseException as e:  # noqa: BLE001
+                    import traceback
+                    sess.result_queue.put({
+                        "finished": True, "error": e,
+                        "traceback": traceback.format_exc()})
+            finally:
+                air_session._set_session(None)
+
+        self.thread = threading.Thread(
+            target=_run, name=f"train-rank-{self.world_rank}", daemon=True)
+        self.thread.start()
+
+    def get_next_result(self, timeout: Optional[float] = None) -> dict:
+        """Blocks until the worker reports or finishes, then lets it
+        continue. timeout=None blocks indefinitely (a dead train thread
+        always pushes a finished sentinel, so this cannot hang silently);
+        pass a float to surface report gaps as {'timeout': True}."""
+        import queue as _q
+        try:
+            item = self.session.result_queue.get(timeout=timeout)
+        except _q.Empty:
+            return {"timeout": True}
+        if not item.get("finished"):
+            self.session.continue_event.set()
+        return item
+
+    def request_stop(self) -> None:
+        if self.session is not None:
+            self.session.stop_requested = True
+            self.session.continue_event.set()
+
+    def shutdown(self) -> None:
+        self.request_stop()
+
+
+def _wants_config(fn: Callable) -> bool:
+    import inspect
+    try:
+        return len(inspect.signature(fn).parameters) >= 1
+    except (TypeError, ValueError):
+        return False
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int,
+                 resources_per_worker: Dict[str, float],
+                 placement_strategy: str = "PACK",
+                 bundles: Optional[List[Dict[str, float]]] = None):
+        self.num_workers = num_workers
+        self._pg: Optional[PlacementGroup] = placement_group(
+            bundles or [dict(resources_per_worker)
+                        for _ in range(num_workers)],
+            strategy=placement_strategy)
+        self.workers: List[Any] = []
+        for rank in range(num_workers):
+            worker_cls = TrainWorker.options(
+                num_cpus=resources_per_worker.get("CPU", 1),
+                num_tpus=resources_per_worker.get("TPU", 0),
+                resources={k: v for k, v in resources_per_worker.items()
+                           if k not in ("CPU", "TPU", "memory")},
+                placement_group=self._pg,
+                placement_group_bundle_index=rank,
+                max_concurrency=4,
+            )
+            self.workers.append(worker_cls.remote(rank, num_workers))
+
+    def execute(self, method: str, *args, **kwargs) -> List[Any]:
+        """Call a method on every worker, gather results."""
+        refs = [getattr(w, method).remote(*args, **kwargs)
+                for w in self.workers]
+        return ray_tpu.get(refs)
+
+    def execute_async(self, method: str, *args, **kwargs):
+        return [getattr(w, method).remote(*args, **kwargs)
+                for w in self.workers]
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.get(w.shutdown.remote(), timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
+            ray_tpu.kill(w)
+        if self._pg is not None:
+            remove_placement_group(self._pg)
+            self._pg = None

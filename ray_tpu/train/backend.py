@@ -1,0 +1,32 @@
+"""Backend plugin interface (reference: python/ray/train/backend.py:43,55)."""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ray_tpu.train._internal.worker_group import WorkerGroup
+
+
+class BackendConfig:
+    """Declarative config; backend_cls points at the runtime hooks."""
+
+    @property
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Runtime hooks invoked by the BackendExecutor around training."""
+
+    def on_start(self, worker_group: "WorkerGroup",
+                 backend_config: BackendConfig) -> None:
+        pass
+
+    def on_training_start(self, worker_group: "WorkerGroup",
+                          backend_config: BackendConfig) -> None:
+        pass
+
+    def on_shutdown(self, worker_group: "WorkerGroup",
+                    backend_config: BackendConfig) -> None:
+        pass

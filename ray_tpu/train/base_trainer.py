@@ -1,0 +1,133 @@
+"""BaseTrainer + DataParallelTrainer.
+
+Analog of the reference's train/base_trainer.py:344 (fit) and
+train/data_parallel_trainer.py:56. The reference routes fit() through a
+single-trial Tune run; here fit() drives the BackendExecutor directly and
+Tune composes *on top of* trainers (same observable behavior, one less
+inversion).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.air.result import Result
+from ray_tpu.train._internal.backend_executor import BackendExecutor
+from ray_tpu.train.backend import BackendConfig
+
+
+class BaseTrainer:
+    def __init__(self, *, scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.datasets = datasets or {}
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self) -> Callable:
+        """Adapter for Tune: a function trainable that runs this trainer with
+        per-trial config overrides and re-reports its results."""
+        trainer = self
+
+        def _trainable(config: dict):
+            from ray_tpu.air import session as tune_session
+            sub = trainer._with_config_overrides(config)
+
+            def relay(metrics):
+                tune_session.report(metrics)
+                return True
+
+            result = sub._fit_with_callback(relay)
+            return result.metrics
+
+        return _trainable
+
+    def _with_config_overrides(self, config: dict) -> "BaseTrainer":
+        return self
+
+    def _fit_with_callback(self, callback) -> Result:
+        raise NotImplementedError
+
+
+class DataParallelTrainer(BaseTrainer):
+    """Runs train_loop_per_worker on every rank of the gang.
+
+    reference: train/data_parallel_trainer.py:347 training_loop.
+    """
+
+    _backend_config_cls = BackendConfig
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[dict] = None,
+                 backend_config: Optional[BackendConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
+        super().__init__(scaling_config=scaling_config,
+                         run_config=run_config,
+                         resume_from_checkpoint=resume_from_checkpoint,
+                         datasets=datasets)
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.backend_config = backend_config or self._backend_config_cls()
+
+    def _with_config_overrides(self, config: dict) -> "DataParallelTrainer":
+        merged = {**self.train_loop_config, **(config or {})}
+        return type(self)(
+            self.train_loop_per_worker,
+            train_loop_config=merged,
+            backend_config=self.backend_config,
+            scaling_config=self.scaling_config,
+            run_config=self.run_config,
+            resume_from_checkpoint=self.resume_from_checkpoint,
+            datasets=self.datasets,
+        )
+
+    def _shard_datasets(self, num_workers: int):
+        """Per-worker dataset shards: Datasets split across ranks
+        (reference: train/_internal/dataset_spec.py per-epoch splitting)."""
+        if not self.datasets:
+            return None
+        shards = [dict() for _ in range(num_workers)]
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "split") and num_workers > 1:
+                parts = ds.split(num_workers, equal=True)
+            else:
+                parts = [ds] * num_workers
+            for rank in range(num_workers):
+                shards[rank][name] = (parts[rank]
+                                      if rank < len(parts) else parts[-1])
+        return shards
+
+    def fit(self) -> Result:
+        return self._fit_with_callback(None)
+
+    def _fit_with_callback(self, callback) -> Result:
+        executor = BackendExecutor(
+            self.backend_config, self.scaling_config,
+            (self.run_config.failure_config
+             if self.run_config else None))
+        executor.start()
+        trial_info = {"trial_id": uuid.uuid4().hex[:8],
+                      "trial_name": self.run_config.name or "train"}
+        try:
+            return executor.run(
+                self.train_loop_per_worker,
+                self.train_loop_config,
+                trial_info,
+                checkpoint=self.resume_from_checkpoint,
+                dataset_shards_per_worker=self._shard_datasets(
+                    self.scaling_config.num_workers),
+                result_callback=callback,
+            )
+        finally:
+            executor.shutdown()

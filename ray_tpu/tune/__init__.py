@@ -1,0 +1,56 @@
+"""Tune: distributed hyperparameter search (reference: python/ray/tune)."""
+
+from ray_tpu.air.session import get_checkpoint, get_trial_id, get_trial_name
+from ray_tpu.air.session import report  # tune.report == session.report
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.search import (choice, grid_search, loguniform, quniform,
+                                 randint, sample_from, uniform)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
+
+
+def with_resources(trainable, resources: dict):
+    """Attach per-trial resource requests (reference: tune.with_resources)."""
+    mapped = {}
+    for key, value in resources.items():
+        if key in ("cpu", "CPU", "num_cpus"):
+            mapped["num_cpus"] = value
+        elif key in ("tpu", "TPU", "num_tpus", "gpu", "GPU", "num_gpus"):
+            mapped["num_tpus"] = value
+        else:
+            mapped.setdefault("resources", {})[key] = value
+    trainable._tune_resources = mapped
+    return trainable
+
+
+def with_parameters(trainable, **kwargs):
+    """Bind large constant objects to the trainable
+    (reference: tune.with_parameters)."""
+    import functools
+
+    @functools.wraps(trainable)
+    def wrapped(config):
+        return trainable(config, **kwargs)
+
+    return wrapped
+
+
+__all__ = [
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "ResultGrid",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "get_trial_id",
+    "get_trial_name",
+    "grid_search",
+    "loguniform",
+    "quniform",
+    "randint",
+    "report",
+    "sample_from",
+    "uniform",
+    "with_parameters",
+    "with_resources",
+]

@@ -1,0 +1,69 @@
+"""Trial schedulers: FIFO and ASHA (async successive halving).
+
+Analog of the reference's tune/schedulers/async_hyperband.py
+(AsyncHyperBandScheduler/ASHAScheduler): rungs at
+min_t * reduction_factor^k; when a trial reaches a rung, it continues only
+if its metric is in the top 1/reduction_factor quantile of results recorded
+at that rung.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, metrics: dict) -> str:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: str = None, mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4):
+        self.time_attr = time_attr
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung milestone -> {trial_id: signed metric at crossing}
+        self._rungs: Dict[int, Dict[str, float]] = {}
+        rung = grace_period
+        while rung < max_t:
+            self._rungs[rung] = {}
+            rung *= reduction_factor
+
+    def set_metric(self, metric: str, mode: str):
+        if self.metric is None:
+            self.metric = metric
+            self.mode = mode
+
+    def on_result(self, trial_id: str, metrics: dict) -> str:
+        t = metrics.get(self.time_attr)
+        value = metrics.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        signed = value if self.mode == "max" else -value
+        for rung in sorted(self._rungs, reverse=True):
+            if t < rung:
+                continue
+            recorded = self._rungs[rung]
+            # Record this trial's value at its first crossing of the rung.
+            recorded.setdefault(trial_id, signed)
+            # Decide on every report past the rung (not just at crossing):
+            # a weak trial that crossed before enough peers had recorded is
+            # still cut as soon as the quantile is established.
+            if len(recorded) >= self.rf:
+                ordered = sorted(recorded.values(), reverse=True)
+                cutoff = ordered[max(0, len(ordered) // self.rf - 1)]
+                if recorded[trial_id] < cutoff:
+                    return STOP
+            break
+        return CONTINUE

@@ -1,0 +1,135 @@
+"""Search spaces + variant generation.
+
+Analog of the reference's tune/search/ (sample.py Domains,
+basic_variant.py BasicVariantGenerator): grid_search entries expand as a
+cross-product, Domain objects sample per trial, num_samples multiplies the
+grid — matching reference semantics.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, Iterator, List
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low, high):
+        import math
+        self.log_low, self.log_high = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+        return math.exp(rng.uniform(self.log_low, self.log_high))
+
+
+class Choice(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class RandInt(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class QUniform(Domain):
+    def __init__(self, low, high, q):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        value = rng.uniform(self.low, self.high)
+        return round(value / self.q) * self.q
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def choice(categories) -> Choice:
+    return Choice(categories)
+
+
+def randint(low, high) -> RandInt:
+    return RandInt(low, high)
+
+
+def quniform(low, high, q) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def grid_search(values) -> Dict[str, list]:
+    return {"grid_search": list(values)}
+
+
+def _is_grid(value) -> bool:
+    return isinstance(value, dict) and set(value.keys()) == {"grid_search"}
+
+
+def _expand_grid(space: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Cross-product over grid_search entries (non-recursive keys only at
+    top level; nested dicts are recursed)."""
+    variants: List[Dict[str, Any]] = [{}]
+    for key, value in space.items():
+        if _is_grid(value):
+            variants = [dict(v, **{key: g}) for v in variants
+                        for g in value["grid_search"]]
+        elif isinstance(value, dict) and not _is_grid(value):
+            subvariants = _expand_grid(value)
+            variants = [dict(v, **{key: sub}) for v in variants
+                        for sub in subvariants]
+        else:
+            variants = [dict(v, **{key: value}) for v in variants]
+    return variants
+
+
+def _sample_domains(config: Dict[str, Any], rng: random.Random
+                    ) -> Dict[str, Any]:
+    out = {}
+    for key, value in config.items():
+        if isinstance(value, Domain):
+            out[key] = value.sample(rng)
+        elif isinstance(value, dict):
+            out[key] = _sample_domains(value, rng)
+        elif callable(value) and getattr(value, "_tune_sample_fn", False):
+            out[key] = value(None)
+        else:
+            out[key] = value
+    return out
+
+
+def sample_from(fn):
+    """tune.sample_from equivalent."""
+    fn._tune_sample_fn = True
+    return fn
+
+
+def generate_variants(param_space: Dict[str, Any], num_samples: int,
+                      seed: int = 0) -> Iterator[Dict[str, Any]]:
+    rng = random.Random(seed)
+    grid = _expand_grid(param_space or {})
+    for _ in range(num_samples):
+        for variant in grid:
+            yield _sample_domains(variant, rng)

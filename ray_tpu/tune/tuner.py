@@ -1,0 +1,186 @@
+"""Tuner + trial runner + ResultGrid.
+
+Analog of the reference's tune/tuner.py:44 (Tuner.fit) and the
+TrialRunner.step event loop (tune/execution/trial_runner.py:268,931): each
+trial is an actor (reference: ray_trial_executor.py:191); the runner
+multiplexes trial results with ray.wait, feeds the scheduler, and stops
+trials early on its decision.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.air.config import RunConfig
+from ray_tpu.air.result import Result
+from ray_tpu.train._internal.worker_group import TrainWorker
+from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+from ray_tpu.tune.search import generate_variants
+
+logger = logging.getLogger("ray_tpu.tune")
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Any = None
+    search_alg: Any = None  # reserved; basic variant generation built in
+    seed: int = 0
+
+
+@dataclass
+class _Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    actor: Any = None
+    history: List[dict] = field(default_factory=list)
+    iteration: int = 0
+    error: Optional[BaseException] = None
+    done: bool = False
+    stopped: bool = False
+
+
+class ResultGrid:
+    def __init__(self, results: List[Result], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    @property
+    def errors(self):
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("Specify metric= (none set in TuneConfig)")
+        candidates = []
+        for r in self._results:
+            values = [h[metric] for h in r.metrics_history if metric in h]
+            if not values:
+                continue
+            best = max(values) if mode == "max" else min(values)
+            candidates.append((best, r))
+        if not candidates:
+            raise ValueError(f"No trial reported metric {metric!r}")
+        candidates.sort(key=lambda t: t[0], reverse=(mode == "max"))
+        return candidates[0][1]
+
+    def get_dataframe(self):
+        import pandas as pd
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics)
+            row["trial_id"] = r.trial_id
+            row.update({f"config/{k}": v for k, v in r.config.items()})
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class Tuner:
+    def __init__(self, trainable: Callable = None, *,
+                 param_space: Optional[dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        from ray_tpu.train.base_trainer import BaseTrainer
+        if isinstance(trainable, BaseTrainer):
+            self._trainable = trainable.as_trainable()
+        else:
+            self._trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self._trial_resources = getattr(
+            trainable, "_tune_resources", None) or {"num_cpus": 1}
+
+    def fit(self) -> ResultGrid:
+        cfg = self.tune_config
+        scheduler = cfg.scheduler or FIFOScheduler()
+        if hasattr(scheduler, "set_metric") and cfg.metric:
+            scheduler.set_metric(cfg.metric, cfg.mode)
+        trials = [
+            _Trial(trial_id=f"trial_{i:05d}_{uuid.uuid4().hex[:4]}",
+                   config=variant)
+            for i, variant in enumerate(
+                generate_variants(self.param_space, cfg.num_samples,
+                                  cfg.seed))
+        ]
+        max_concurrent = cfg.max_concurrent_trials or len(trials)
+        pending = list(trials)
+        running: Dict[Any, _Trial] = {}  # outstanding result ref -> trial
+
+        def launch(trial: _Trial):
+            actor_cls = TrainWorker.options(**self._trial_resources)
+            trial.actor = actor_cls.remote(0, 1)
+            # Don't block on creation: actor tasks are ordered, so the
+            # result stream ref resolves once the trial actually starts —
+            # trials queue naturally behind available resources.
+            trial.actor.start_training.remote(
+                self._trainable, trial.config,
+                {"trial_id": trial.trial_id, "trial_name": trial.trial_id})
+            ref = trial.actor.get_next_result.remote()
+            running[ref] = trial
+
+        while pending and len(running) < max_concurrent:
+            launch(pending.pop(0))
+
+        while running:
+            ready, _ = ray_tpu.wait(list(running.keys()), num_returns=1,
+                                    timeout=None)
+            ref = ready[0]
+            trial = running.pop(ref)
+            payload = ray_tpu.get(ref)
+            if payload.get("finished") or payload.get("timeout"):
+                trial.done = True
+                trial.error = payload.get("error")
+                if payload.get("timeout"):
+                    trial.error = TimeoutError("trial timed out")
+                ray_tpu.kill(trial.actor)
+                if pending:
+                    launch(pending.pop(0))
+                continue
+            metrics = dict(payload.get("metrics", {}))
+            trial.iteration += 1
+            metrics.setdefault("training_iteration", trial.iteration)
+            trial.history.append(metrics)
+            decision = scheduler.on_result(trial.trial_id, metrics)
+            if decision == STOP or self._hit_stop_criteria(metrics):
+                trial.stopped = True
+                trial.actor.request_stop.remote()
+            # Re-arm the result stream for this trial.
+            ref = trial.actor.get_next_result.remote()
+            running[ref] = trial
+
+        results = [
+            Result(metrics=t.history[-1] if t.history else {},
+                   metrics_history=t.history, config=t.config,
+                   error=t.error, trial_id=t.trial_id)
+            for t in trials
+        ]
+        errs = [r for r in results if r.error is not None]
+        if errs:
+            logger.warning("%d/%d trials errored", len(errs), len(results))
+        return ResultGrid(results, cfg.metric, cfg.mode)
+
+    def _hit_stop_criteria(self, metrics: dict) -> bool:
+        stop = self.run_config.stop if self.run_config else None
+        if not stop:
+            return False
+        return any(metrics.get(k) is not None and metrics[k] >= v
+                   for k, v in stop.items())

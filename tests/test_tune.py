@@ -1,0 +1,156 @@
+"""Tune tests (modeled on the reference's tune/tests coverage)."""
+
+import pytest
+
+import ray_tpu as ray
+from ray_tpu import tune
+from ray_tpu.air import RunConfig, ScalingConfig
+from ray_tpu.tune import ASHAScheduler, TuneConfig, Tuner
+
+
+def test_grid_search(ray_start_regular):
+    def trainable(config):
+        tune.report({"score": config["a"] * 10 + config["b"]})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"a": tune.grid_search([1, 2, 3]),
+                     "b": tune.grid_search([0, 1])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+    )
+    results = tuner.fit()
+    assert len(results) == 6
+    best = results.get_best_result()
+    assert best.metrics["score"] == 31
+    assert best.config == {"a": 3, "b": 1}
+
+
+def test_random_sampling(ray_start_regular):
+    def trainable(config):
+        tune.report({"value": config["lr"]})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"lr": tune.loguniform(1e-5, 1e-1)},
+        tune_config=TuneConfig(metric="value", mode="min", num_samples=8),
+    )
+    results = tuner.fit()
+    assert len(results) == 8
+    values = [r.metrics["value"] for r in results]
+    assert all(1e-5 <= v <= 1e-1 for v in values)
+    assert len(set(values)) > 1  # actually sampled
+
+
+def test_num_samples_multiplies_grid(ray_start_regular):
+    def trainable(config):
+        tune.report({"x": config["g"]})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"g": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(num_samples=3, metric="x", mode="max"),
+    )
+    assert len(tuner.fit()) == 6
+
+
+def test_trial_errors_recorded(ray_start_regular):
+    def trainable(config):
+        if config["i"] == 1:
+            raise ValueError("bad trial")
+        tune.report({"ok": 1})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"i": tune.grid_search([0, 1, 2])},
+        tune_config=TuneConfig(metric="ok", mode="max"),
+    )
+    results = tuner.fit()
+    assert len(results.errors) == 1
+    assert results.get_best_result().metrics["ok"] == 1
+
+
+def test_asha_stops_bad_trials(ray_start_regular):
+    def trainable(config):
+        for i in range(20):
+            tune.report({"score": config["quality"] * (i + 1)})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"quality": tune.grid_search([1, 2, 3, 4, 5, 6, 7, 8])},
+        tune_config=TuneConfig(
+            metric="score", mode="max",
+            scheduler=ASHAScheduler(max_t=20, grace_period=2,
+                                    reduction_factor=4)),
+    )
+    results = tuner.fit()
+    best = results.get_best_result()
+    assert best.config["quality"] == 8
+    # Early stopping must have cut at least one weak trial short.
+    lengths = [len(r.metrics_history) for r in results]
+    assert min(lengths) < 20
+
+
+def test_stop_criteria(ray_start_regular):
+    def trainable(config):
+        for i in range(100):
+            tune.report({"iters": i})
+
+    tuner = Tuner(
+        trainable,
+        tune_config=TuneConfig(metric="iters", mode="max"),
+        run_config=RunConfig(stop={"iters": 5}),
+    )
+    results = tuner.fit()
+    assert len(results[0].metrics_history) <= 8
+
+
+def test_tuner_over_trainer(ray_start_regular):
+    from ray_tpu.air import session
+    from ray_tpu.train import DataParallelTrainer
+
+    def loop(config):
+        session.report({"final": config["x"] * 2})
+
+    trainer = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1))
+    tuner = Tuner(
+        trainer,
+        param_space={"x": tune.grid_search([1, 5])},
+        tune_config=TuneConfig(metric="final", mode="max"),
+    )
+    results = tuner.fit()
+    assert len(results) == 2
+    assert results.get_best_result().metrics["final"] == 10
+
+
+def test_tuner_over_jax_trainer(ray_start_regular):
+    """Regression: the trainer-clone path must work for JaxTrainer
+    (its __init__ signature differs from DataParallelTrainer's)."""
+    from ray_tpu.air import session
+    from ray_tpu.train import JaxTrainer
+
+    def loop(config):
+        session.report({"final": config["x"] * 3})
+
+    trainer = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=1))
+    results = Tuner(
+        trainer,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="final", mode="max"),
+    ).fit()
+    assert not results.errors
+    assert results.get_best_result().metrics["final"] == 6
+
+
+def test_with_parameters_and_resources(ray_start_regular):
+    big_object = list(range(1000))
+
+    def trainable(config, data=None):
+        tune.report({"n": len(data) + config["k"]})
+
+    wrapped = tune.with_parameters(trainable, data=big_object)
+    wrapped = tune.with_resources(wrapped, {"cpu": 2})
+    tuner = Tuner(wrapped, param_space={"k": tune.grid_search([0, 1])},
+                  tune_config=TuneConfig(metric="n", mode="max"))
+    results = tuner.fit()
+    assert results.get_best_result().metrics["n"] == 1001
