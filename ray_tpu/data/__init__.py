@@ -1,0 +1,37 @@
+"""ray_tpu.data: distributed datasets on the object store.
+
+TPU-first analog of the reference's python/ray/data: blocks are pyarrow
+tables in the object store; transforms run as tasks/actor pools; the default
+batch format is numpy dicts ready for jax.device_put, and ``iter_jax_batches``
+/ ``Dataset.split`` feed per-host shards into a JaxTrainer mesh.
+"""
+
+from ray_tpu.data import aggregate
+from ray_tpu.data._internal.compute import (ActorPoolStrategy,
+                                            TaskPoolStrategy)
+from ray_tpu.data.aggregate import (AggregateFn, Count, Max, Mean, Min, Std,
+                                    Sum)
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.dataset import Dataset, GroupedDataset
+from ray_tpu.data.dataset_pipeline import DatasetPipeline
+from ray_tpu.data.preprocessors import (BatchMapper, Chain, Concatenator,
+                                        LabelEncoder, MinMaxScaler,
+                                        OneHotEncoder, Preprocessor,
+                                        SimpleImputer, StandardScaler)
+from ray_tpu.data.read_api import (from_arrow, from_items, from_jax,
+                                   from_numpy, from_pandas, range,
+                                   range_tensor, read_binary_files, read_csv,
+                                   read_datasource, read_json, read_numpy,
+                                   read_parquet, read_text)
+
+__all__ = [
+    "ActorPoolStrategy", "AggregateFn", "BatchMapper", "Block",
+    "BlockAccessor", "BlockMetadata", "Chain", "Concatenator", "Count",
+    "Dataset", "DatasetPipeline", "GroupedDataset", "LabelEncoder", "Max",
+    "Mean", "Min", "MinMaxScaler", "OneHotEncoder", "Preprocessor",
+    "SimpleImputer", "StandardScaler", "Std", "Sum", "TaskPoolStrategy",
+    "aggregate", "from_arrow", "from_items", "from_jax", "from_numpy",
+    "from_pandas", "range", "range_tensor", "read_binary_files", "read_csv",
+    "read_datasource", "read_json", "read_numpy", "read_parquet",
+    "read_text",
+]

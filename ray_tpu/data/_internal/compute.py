@@ -1,0 +1,169 @@
+"""Compute strategies: tasks vs. autoscaling actor pools.
+
+Analog of the reference's python/ray/data/_internal/compute.py
+(TaskPoolStrategy / ActorPoolStrategy): a one-to-one stage maps a block
+transform over every block either as independent tasks (default) or on a
+pool of long-lived actors (amortizing expensive UDF construction, e.g. a
+model loaded onto a TPU chip for batch inference).
+
+Both paths stream: at most ``max_in_flight`` block transforms are
+outstanding, and results are yielded as they finish (the round-1 analog of
+the reference's streaming executor backpressure,
+data/_internal/execution/streaming_executor.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+
+
+@dataclass
+class TaskPoolStrategy:
+    size: Optional[int] = None  # max concurrent tasks (None = unbounded-ish)
+
+
+class ActorPoolStrategy:
+    """Autoscaling pool of UDF actors (reference: compute.py ActorPoolStrategy:
+    min_size..max_size actors, each processing blocks serially)."""
+
+    def __init__(self, min_size: int = 1, max_size: Optional[int] = None,
+                 max_tasks_in_flight_per_actor: int = 2):
+        if max_size is None:
+            max_size = max(min_size, 2)
+        if min_size < 1 or max_size < min_size:
+            raise ValueError("Need 1 <= min_size <= max_size")
+        self.min_size = min_size
+        self.max_size = max_size
+        self.max_tasks_in_flight_per_actor = max_tasks_in_flight_per_actor
+
+
+ComputeStrategy = Any  # TaskPoolStrategy | ActorPoolStrategy | str
+
+
+def resolve_compute(compute) -> ComputeStrategy:
+    if compute is None or compute == "tasks":
+        return TaskPoolStrategy()
+    if compute == "actors":
+        return ActorPoolStrategy()
+    if isinstance(compute, (TaskPoolStrategy, ActorPoolStrategy)):
+        return compute
+    raise ValueError(f"Unknown compute strategy: {compute!r}")
+
+
+def _apply_transform(block: Block, fn_bytes: bytes,
+                     meta_only: bool) -> Tuple[Block, BlockMetadata]:
+    """Worker-side: run a pickled block transform."""
+    import cloudpickle
+    fn = cloudpickle.loads(fn_bytes)
+    out = fn(block)
+    acc = BlockAccessor.for_block(out)
+    return out, acc.get_metadata()
+
+
+_transform_task = None
+
+
+def _get_transform_task(num_cpus: float):
+    global _transform_task
+    if _transform_task is None:
+        _transform_task = ray_tpu.remote(_apply_transform)
+    return _transform_task.options(num_cpus=num_cpus, num_returns=2)
+
+
+class _BlockTransformActor:
+    """Actor wrapper executing a (possibly stateful) block transform.
+
+    For callable-class UDFs the class is constructed once here and reused
+    for every block (reference: data/_internal/compute.py BlockWorker).
+    """
+
+    def __init__(self, fn_constructor_bytes: Optional[bytes]):
+        import cloudpickle
+        self._udf_instance = None
+        if fn_constructor_bytes is not None:
+            ctor, args, kwargs = cloudpickle.loads(fn_constructor_bytes)
+            self._udf_instance = ctor(*args, **kwargs)
+
+    def ready(self):
+        return True
+
+    def apply(self, block: Block, fn_bytes: bytes):
+        import cloudpickle
+        fn = cloudpickle.loads(fn_bytes)
+        if self._udf_instance is not None:
+            out = fn(block, self._udf_instance)
+        else:
+            out = fn(block)
+        acc = BlockAccessor.for_block(out)
+        return out, acc.get_metadata()
+
+
+def map_blocks_streaming(
+    blocks: List["ray_tpu.ObjectRef"],
+    transform: Callable[[Block], Block],
+    compute: ComputeStrategy,
+    num_cpus: float = 1.0,
+    udf_constructor: Optional[tuple] = None,
+) -> Iterator[Tuple["ray_tpu.ObjectRef", "ray_tpu.ObjectRef"]]:
+    """Yield (block_ref, meta_ref) pairs in input order, streaming with
+    bounded in-flight work."""
+    import cloudpickle
+    fn_bytes = cloudpickle.dumps(transform)
+
+    if isinstance(compute, ActorPoolStrategy):
+        yield from _map_blocks_actor_pool(
+            blocks, fn_bytes, compute, num_cpus, udf_constructor)
+        return
+
+    max_in_flight = compute.size or max(8, len(blocks))
+    task = _get_transform_task(num_cpus)
+    in_flight: List[tuple] = []  # (block_out_ref, meta_ref)
+    i = 0
+    results: List[tuple] = []
+    while i < len(blocks) or in_flight:
+        while i < len(blocks) and len(in_flight) < max_in_flight:
+            refs = task.remote(blocks[i], fn_bytes, False)
+            in_flight.append(refs)
+            i += 1
+        # Pop the head in order (order matters for datasets); wait on it.
+        head = in_flight.pop(0)
+        ray_tpu.wait([head[1]], num_returns=1)
+        yield head
+
+
+def _map_blocks_actor_pool(blocks, fn_bytes, strategy: ActorPoolStrategy,
+                           num_cpus, udf_constructor):
+    import cloudpickle
+    ctor_bytes = (cloudpickle.dumps(udf_constructor)
+                  if udf_constructor is not None else None)
+    ActorCls = ray_tpu.remote(_BlockTransformActor)
+    n_actors = min(strategy.max_size, max(strategy.min_size, len(blocks)))
+    pool = [ActorCls.options(num_cpus=num_cpus).remote(ctor_bytes)
+            for _ in range(n_actors)]
+    # Round-robin with per-actor in-flight cap; yield in input order.
+    pending: List[tuple] = []  # (out_refs,) ordered
+    per_actor: Dict[int, int] = {i: 0 for i in range(n_actors)}
+    cap = strategy.max_tasks_in_flight_per_actor
+    i = 0
+    queue: List[tuple] = []
+    while i < len(blocks) or queue:
+        # Fill: assign next block to the least-loaded actor with room.
+        while i < len(blocks):
+            target = min(per_actor, key=per_actor.get)
+            if per_actor[target] >= cap:
+                break
+            refs = pool[target].apply.options(num_returns=2).remote(
+                blocks[i], fn_bytes)
+            queue.append((refs, target))
+            per_actor[target] += 1
+            i += 1
+        refs, target = queue.pop(0)
+        ray_tpu.wait([refs[1]], num_returns=1)
+        per_actor[target] -= 1
+        yield refs
+    for a in pool:
+        ray_tpu.kill(a)

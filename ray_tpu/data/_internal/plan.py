@@ -1,0 +1,117 @@
+"""Lazy execution plan: fused one-to-one stages + all-to-all stages.
+
+Analog of the reference's data/_internal/plan.py (ExecutionPlan + stage
+fusion) and the logical planner (data/_internal/logical/): a Dataset holds
+input blocks plus a chain of stages; execution fuses adjacent one-to-one
+stages into a single task per block (so `.map_batches(f).filter(g)` costs
+one task per block, not two) and materializes all-to-all stages (shuffle,
+sort, repartition) through the 2-stage push-based shuffle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.data._internal.compute import (ComputeStrategy, TaskPoolStrategy,
+                                            map_blocks_streaming,
+                                            resolve_compute)
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+
+
+@dataclass
+class OneToOneStage:
+    """A per-block transform (map_batches / map / filter / flat_map /...)."""
+
+    name: str
+    transform: Callable[[Block], Block]
+    compute: ComputeStrategy = field(default_factory=TaskPoolStrategy)
+    num_cpus: float = 1.0
+    udf_constructor: Optional[tuple] = None
+
+    def can_fuse_with(self, other: "OneToOneStage") -> bool:
+        # Actor stages don't fuse (each needs its own pool); plain task
+        # stages with matching resources fuse freely.
+        return (isinstance(self.compute, TaskPoolStrategy)
+                and isinstance(other.compute, TaskPoolStrategy)
+                and self.num_cpus == other.num_cpus
+                and self.udf_constructor is None
+                and other.udf_constructor is None)
+
+    def fuse(self, other: "OneToOneStage") -> "OneToOneStage":
+        first, second = self.transform, other.transform
+
+        def fused(block):
+            return second(first(block))
+
+        return OneToOneStage(
+            name=f"{self.name}->{other.name}", transform=fused,
+            compute=other.compute, num_cpus=max(self.num_cpus, other.num_cpus))
+
+
+@dataclass
+class AllToAllStage:
+    """A global re-organization (shuffle / sort / repartition / groupby).
+
+    ``fn(block_refs, metas) -> (block_refs, metas)``.
+    """
+
+    name: str
+    fn: Callable[[List[Any], List[BlockMetadata]],
+                 Tuple[List[Any], List[BlockMetadata]]]
+
+
+class ExecutionPlan:
+    def __init__(self, blocks: List[Any], metadata: List[BlockMetadata],
+                 stages: Optional[List[Any]] = None):
+        self._in_blocks = list(blocks)
+        self._in_metadata = list(metadata)
+        self._stages: List[Any] = list(stages or [])
+        self._out: Optional[Tuple[List[Any], List[BlockMetadata]]] = None
+
+    def with_stage(self, stage) -> "ExecutionPlan":
+        if self._out is not None:
+            # Build on the materialized snapshot to avoid recomputation.
+            return ExecutionPlan(self._out[0], self._out[1], [stage])
+        return ExecutionPlan(self._in_blocks, self._in_metadata,
+                             self._stages + [stage])
+
+    def stage_names(self) -> List[str]:
+        return [s.name for s in self._stages]
+
+    def _fused_stages(self) -> List[Any]:
+        fused: List[Any] = []
+        for stage in self._stages:
+            if (fused and isinstance(stage, OneToOneStage)
+                    and isinstance(fused[-1], OneToOneStage)
+                    and fused[-1].can_fuse_with(stage)):
+                fused[-1] = fused[-1].fuse(stage)
+            else:
+                fused.append(stage)
+        return fused
+
+    def execute(self) -> Tuple[List[Any], List[BlockMetadata]]:
+        if self._out is not None:
+            return self._out
+        blocks, metas = self._in_blocks, self._in_metadata
+        for stage in self._fused_stages():
+            if isinstance(stage, OneToOneStage):
+                out_blocks, out_meta_refs = [], []
+                for block_ref, meta_ref in map_blocks_streaming(
+                        blocks, stage.transform, stage.compute,
+                        stage.num_cpus, stage.udf_constructor):
+                    out_blocks.append(block_ref)
+                    out_meta_refs.append(meta_ref)
+                blocks = out_blocks
+                metas = ray_tpu.get(out_meta_refs)
+            else:
+                blocks, metas = stage.fn(blocks, metas)
+        self._out = (blocks, metas)
+        return self._out
+
+    def is_executed(self) -> bool:
+        return self._out is not None
+
+    def clear_cache(self) -> None:
+        self._out = None

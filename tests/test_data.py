@@ -1,0 +1,300 @@
+"""Tests for ray_tpu.data (model: reference python/ray/data/tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+from ray_tpu.data import ActorPoolStrategy
+
+
+def test_range_count_take(ray_shared):
+    ds = data.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert ds.num_blocks() == 4
+    rows = ds.take(5)
+    assert rows == [{"id": i} for i in range(5)]
+
+
+def test_from_items_simple(ray_shared):
+    ds = data.from_items([1, 2, 3, 4, 5], parallelism=2)
+    assert ds.count() == 5
+    assert sorted(ds.take_all()) == [1, 2, 3, 4, 5]
+
+
+def test_from_items_dicts(ray_shared):
+    ds = data.from_items([{"a": i, "b": i * 2} for i in range(10)])
+    assert ds.count() == 10
+    assert ds.take(1) == [{"a": 0, "b": 0}]
+
+
+def test_map_batches_numpy(ray_shared):
+    ds = data.range(32, parallelism=2)
+    out = ds.map_batches(lambda b: {"id": b["id"] * 2})
+    vals = [r["id"] for r in out.take_all()]
+    assert vals == [i * 2 for i in range(32)]
+
+
+def test_map_batches_pandas(ray_shared):
+    ds = data.range(10, parallelism=2)
+
+    def add_col(df):
+        df = df.copy()
+        df["y"] = df["id"] + 1
+        return df
+
+    out = ds.map_batches(add_col, batch_format="pandas")
+    assert out.take(2) == [{"id": 0, "y": 1}, {"id": 1, "y": 2}]
+
+
+def test_map_batches_fusion(ray_shared):
+    ds = data.range(20, parallelism=2)
+    out = ds.map_batches(lambda b: {"id": b["id"] + 1}).map_batches(
+        lambda b: {"id": b["id"] * 10})
+    assert out._plan.stage_names() == ["map_batches", "map_batches"]
+    vals = [r["id"] for r in out.take_all()]
+    assert vals == [(i + 1) * 10 for i in range(20)]
+
+
+def test_map_filter_flat_map(ray_shared):
+    ds = data.range(10, parallelism=2)
+    out = ds.map(lambda r: {"id": r["id"] + 100})
+    assert out.take(1) == [{"id": 100}]
+    out2 = ds.filter(lambda r: r["id"] % 2 == 0)
+    assert out2.count() == 5
+    ds3 = data.from_items([1, 2, 3])
+    out3 = ds3.flat_map(lambda x: [x, x])
+    assert out3.count() == 6
+
+
+def test_actor_pool_strategy(ray_shared):
+    ds = data.range(16, parallelism=4)
+    out = ds.map_batches(lambda b: {"id": b["id"] + 1},
+                         compute=ActorPoolStrategy(1, 2))
+    assert sorted(r["id"] for r in out.take_all()) == list(range(1, 17))
+
+
+def test_map_batches_callable_class(ray_shared):
+    class AddN:
+        def __init__(self, n):
+            self.n = n
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.n}
+
+    ds = data.range(8, parallelism=2)
+    out = ds.map_batches(AddN, fn_constructor_args=(5,),
+                         compute=ActorPoolStrategy(1, 1))
+    assert sorted(r["id"] for r in out.take_all()) == list(range(5, 13))
+
+
+def test_repartition(ray_shared):
+    ds = data.range(100, parallelism=2)
+    out = ds.repartition(10)
+    assert out.num_blocks() == 10
+    assert out.count() == 100
+    # non-shuffling repartition preserves global order
+    assert [r["id"] for r in out.take_all()] == list(range(100))
+
+
+def test_random_shuffle(ray_shared):
+    ds = data.range(100, parallelism=4)
+    out = ds.random_shuffle(seed=42)
+    vals = [r["id"] for r in out.take_all()]
+    assert sorted(vals) == list(range(100))
+    assert vals != list(range(100))
+
+
+def test_sort(ray_shared):
+    rng = np.random.default_rng(0)
+    items = [{"x": int(v)} for v in rng.permutation(50)]
+    ds = data.from_items(items, parallelism=4)
+    out = ds.sort("x")
+    assert [r["x"] for r in out.take_all()] == list(range(50))
+    out_desc = ds.sort("x", descending=True)
+    assert [r["x"] for r in out_desc.take_all()] == list(range(49, -1, -1))
+
+
+def test_groupby_aggregate(ray_shared):
+    items = [{"k": i % 3, "v": i} for i in range(30)]
+    ds = data.from_items(items, parallelism=4)
+    out = ds.groupby("k").sum("v")
+    rows = {r["k"]: r["sum(v)"] for r in out.take_all()}
+    expect = {}
+    for i in range(30):
+        expect[i % 3] = expect.get(i % 3, 0) + i
+    assert rows == expect
+
+
+def test_global_aggregates(ray_shared):
+    ds = data.range(100, parallelism=4)
+    assert ds.sum("id") == sum(range(100))
+    assert ds.min("id") == 0
+    assert ds.max("id") == 99
+    assert ds.mean("id") == pytest.approx(49.5)
+    assert ds.std("id") == pytest.approx(np.std(np.arange(100), ddof=1))
+
+
+def test_split(ray_shared):
+    ds = data.range(100, parallelism=10)
+    shards = ds.split(4)
+    assert len(shards) == 4
+    assert sum(s.count() for s in shards) == 100
+    eq = ds.split(3, equal=True)
+    counts = [s.count() for s in eq]
+    assert counts == [33, 33, 33]
+
+
+def test_split_at_indices(ray_shared):
+    ds = data.range(10, parallelism=2)
+    a, b, c = ds.split_at_indices([3, 7])
+    assert [r["id"] for r in a.take_all()] == [0, 1, 2]
+    assert [r["id"] for r in b.take_all()] == [3, 4, 5, 6]
+    assert [r["id"] for r in c.take_all()] == [7, 8, 9]
+
+
+def test_limit_union_zip(ray_shared):
+    ds = data.range(10, parallelism=2)
+    assert ds.limit(4).count() == 4
+    u = ds.union(data.range(5))
+    assert u.count() == 15
+    z = data.range(6, parallelism=2).zip(
+        data.range(6, parallelism=3).map_batches(
+            lambda b: {"y": b["id"] * 2}))
+    rows = z.take_all()
+    assert rows[3] == {"id": 3, "y": 6}
+
+
+def test_iter_batches(ray_shared):
+    ds = data.range(25, parallelism=3)
+    batches = list(ds.iter_batches(batch_size=10))
+    assert [len(b["id"]) for b in batches] == [10, 10, 5]
+    batches = list(ds.iter_batches(batch_size=10, drop_last=True))
+    assert [len(b["id"]) for b in batches] == [10, 10]
+    # pandas format
+    dfb = next(iter(ds.iter_batches(batch_size=5, batch_format="pandas")))
+    assert list(dfb.columns) == ["id"]
+
+
+def test_iter_jax_batches(ray_shared):
+    ds = data.range(8, parallelism=2)
+    batch = next(iter(ds.iter_jax_batches(batch_size=4)))
+    import jax
+    assert isinstance(batch["id"], jax.Array)
+    assert batch["id"].shape == (4,)
+
+
+def test_parquet_roundtrip(ray_shared, tmp_path):
+    ds = data.range(20, parallelism=2)
+    path = str(tmp_path / "pq")
+    ds.write_parquet(path)
+    back = data.read_parquet(path)
+    assert back.count() == 20
+    assert sorted(r["id"] for r in back.take_all()) == list(range(20))
+    assert back.input_files()
+
+
+def test_csv_json_roundtrip(ray_shared, tmp_path):
+    ds = data.from_items([{"a": i, "b": f"s{i}"} for i in range(10)],
+                         parallelism=2)
+    csv_path = str(tmp_path / "csv")
+    ds.write_csv(csv_path)
+    assert data.read_csv(csv_path).count() == 10
+    json_path = str(tmp_path / "json")
+    ds.write_json(json_path)
+    back = data.read_json(json_path)
+    assert back.count() == 10
+    assert back.take(1) == [{"a": 0, "b": "s0"}]
+
+
+def test_numpy_roundtrip(ray_shared, tmp_path):
+    ds = data.from_numpy(np.arange(12).reshape(6, 2))
+    assert ds.count() == 6
+    batch = next(iter(ds.iter_batches(batch_size=6)))
+    np.testing.assert_array_equal(batch["data"],
+                                  np.arange(12).reshape(6, 2))
+    path = str(tmp_path / "np")
+    ds.write_numpy(path)
+    assert data.read_numpy(path).count() == 6
+
+
+def test_range_tensor(ray_shared):
+    ds = data.range_tensor(10, shape=(2, 2), parallelism=2)
+    batch = next(iter(ds.iter_batches(batch_size=10)))
+    assert batch["data"].shape == (10, 2, 2)
+    assert batch["data"][3, 0, 0] == 3
+
+
+def test_select_drop_add_columns(ray_shared):
+    ds = data.from_items([{"a": i, "b": i * 2, "c": 0} for i in range(5)])
+    assert ds.select_columns(["a"]).take(1) == [{"a": 0}]
+    assert set(ds.drop_columns(["c"]).take(1)[0]) == {"a", "b"}
+    out = ds.add_column("d", lambda df: df["a"] + df["b"])
+    assert out.take(2)[1]["d"] == 3
+
+
+def test_unique_and_schema(ray_shared):
+    ds = data.from_items([{"k": i % 3} for i in range(9)])
+    assert ds.unique("k") == [0, 1, 2]
+    assert "k" in ds.columns()
+
+
+def test_preprocessors(ray_shared):
+    ds = data.from_items([{"x": float(i), "label": "ab"[i % 2]}
+                          for i in range(10)])
+    scaler = data.StandardScaler(["x"])
+    out = scaler.fit_transform(ds)
+    vals = np.array([r["x"] for r in out.take_all()])
+    assert abs(vals.mean()) < 1e-9
+    le = data.LabelEncoder("label")
+    out2 = le.fit_transform(ds)
+    assert set(r["label"] for r in out2.take_all()) == {0, 1}
+    mm = data.MinMaxScaler(["x"])
+    out3 = mm.fit_transform(ds)
+    vals3 = [r["x"] for r in out3.take_all()]
+    assert min(vals3) == 0.0 and max(vals3) == 1.0
+    chain = data.Chain(data.MinMaxScaler(["x"]),
+                       data.Concatenator(include=["x"]))
+    out4 = chain.fit_transform(ds)
+    assert out4.take(1)[0]["concat_out"] == [0.0]
+
+
+def test_batch_mapper_one_hot(ray_shared):
+    ds = data.from_items([{"c": "xy"[i % 2]} for i in range(4)])
+    ohe = data.OneHotEncoder(["c"])
+    out = ohe.fit_transform(ds)
+    row = out.take(1)[0]
+    assert row["c_x"] == 1.0 and row["c_y"] == 0.0
+
+
+def test_dataset_pipeline(ray_shared):
+    ds = data.range(20, parallelism=4)
+    pipe = ds.window(blocks_per_window=2)
+    assert pipe.count() == 20
+    pipe2 = ds.repeat(3)
+    assert pipe2.count() == 60
+    mapped = ds.window(blocks_per_window=2).map_batches(
+        lambda b: {"id": b["id"] + 1})
+    assert sorted(r["id"] for r in
+                  [row for row in mapped.iter_rows()]) == list(range(1, 21))
+
+
+def test_read_text(ray_shared, tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("hello\nworld\n")
+    ds = data.read_text(str(p))
+    assert [r["text"] for r in ds.take_all()] == ["hello", "world"]
+
+
+def test_randomize_block_order(ray_shared):
+    ds = data.range(40, parallelism=8).randomize_block_order(seed=1)
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(40))
+
+
+def test_local_shuffle_iter(ray_shared):
+    ds = data.range(32, parallelism=2)
+    batches = list(ds.iter_batches(batch_size=16,
+                                   local_shuffle_buffer_size=16,
+                                   local_shuffle_seed=7))
+    all_vals = sorted(v for b in batches for v in b["id"].tolist())
+    assert all_vals == list(range(32))
