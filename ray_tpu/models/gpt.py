@@ -234,8 +234,28 @@ def _attention(q, k, v, cfg: GPTConfig):
         from ray_tpu.ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=True)
     if cfg.attn_impl == "ring":
-        from ray_tpu.ops.ring_attention import ring_attention
-        return ring_attention(q, k, v, axis_name="sp")
+        from ray_tpu.ops.ring_attention import make_ring_attention
+        from ray_tpu.parallel.mesh import current_mesh
+        mesh = current_mesh()
+        if mesh is None or "sp" not in mesh.axis_names:
+            raise ValueError(
+                "attn_impl='ring' needs a registered mesh with an 'sp' "
+                "axis (parallel.mesh.set_current_mesh; make_train_step/"
+                "make_eval_step do this automatically)")
+        # Activation layout [B, S, H, D]: batch over (dp, fsdp), sequence
+        # over the ring axis, heads over tp. Head axes whose size doesn't
+        # divide tp (GQA/MQA) stay replicated; ring_attention's local
+        # _repeat_kv bridges sharded-q / replicated-kv heads.
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        tp = sizes.get("tp", 1)
+        H, kvh = q.shape[2], k.shape[2]
+        q_spec = PartitionSpec(("dp", "fsdp"), "sp",
+                               "tp" if H % tp == 0 else None, None)
+        kv_spec = PartitionSpec(("dp", "fsdp"), "sp",
+                                "tp" if kvh % tp == 0 else None, None)
+        fn = make_ring_attention(mesh, "sp", causal=True, q_spec=q_spec,
+                                 kv_spec=kv_spec)
+        return fn(q, k, v)
     raise ValueError(f"Unknown attn_impl {cfg.attn_impl!r}")
 
 

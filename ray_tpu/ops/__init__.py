@@ -1,0 +1,13 @@
+"""ray_tpu.ops: TPU kernels (Pallas) and memory-efficient attention.
+
+Green-field capability relative to the reference (SURVEY.md §2.5: no
+sequence/context parallelism exists in-tree): blockwise attention, a Pallas
+flash-attention kernel for the MXU, and ring attention over the ICI ring
+(sequence-parallel mesh axis).
+"""
+
+from ray_tpu.ops.blockwise_attention import blockwise_attention
+from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.ring_attention import ring_attention
+
+__all__ = ["blockwise_attention", "flash_attention", "ring_attention"]

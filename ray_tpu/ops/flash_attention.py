@@ -1,0 +1,132 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Forward pass tiles Q over the grid and streams KV blocks through VMEM with
+the online-softmax recurrence, keeping the MXU fed with [blk_q, D] x
+[D, blk_k] matmuls (pallas_guide.md: grid/BlockSpec + fori_loop pattern).
+Backward pass is a custom VJP that recomputes attention blockwise in jnp
+(blockwise_attention.py) — O(S) memory, no saved probability matrix.
+
+On non-TPU backends the kernel runs in interpreter mode so the same code
+path is testable on the CPU mesh (SURVEY.md §4: fake-TPU strategy).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ray_tpu.ops.blockwise_attention import blockwise_attention
+
+_NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_q: int, blk_k: int,
+                      seq_len: int, causal: bool, scale: float):
+    """Grid: (batch*heads, num_q_blocks). q_ref: [blk_q, D] tile;
+    k_ref/v_ref: [S, D] for this (b, h); o_ref: [blk_q, D]."""
+    qi = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32) * scale
+    D = q.shape[-1]
+
+    q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+        jnp.int32, (blk_q, blk_k), 0)
+
+    n_k = seq_len // blk_k
+
+    def body(kb, carry):
+        m, l, o = carry
+        k_blk = k_ref[pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = kb * blk_k + jax.lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
+            mask = q_pos >= k_pos
+            logits = jnp.where(mask, logits, _NEG_INF)
+        m_new = jnp.maximum(m, logits.max(-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        l_new = l * corr + p.sum(-1)
+        o_new = o * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, o_new
+
+    m0 = jnp.full((blk_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((blk_q,), jnp.float32)
+    o0 = jnp.zeros((blk_q, D), jnp.float32)
+    if causal:
+        # Only KV blocks at or before this Q block's last row contribute.
+        n_iter = jnp.minimum(pl.cdiv((qi + 1) * blk_q, blk_k), n_k)
+    else:
+        n_iter = n_k
+    m, l, o = jax.lax.fori_loop(0, n_iter, body, (m0, l0, o0))
+    o_ref[...] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, blk_q: int, blk_k: int):
+    B, S, H, D = q.shape
+    kvh = k.shape[2]
+    if kvh != H:
+        rep = H // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(D)
+    blk_q = min(blk_q, S)
+    blk_k = min(blk_k, S)
+    if S % blk_q or S % blk_k:
+        # Ragged tail: fall back to the jnp blockwise path.
+        return blockwise_attention(q, k, v, causal=causal)
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, blk_q=blk_q, blk_k=blk_k, seq_len=S,
+        causal=causal, scale=scale)
+    interpret = jax.default_backend() != "tpu"
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, S // blk_q),
+        in_specs=[
+            pl.BlockSpec((None, blk_q, D), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, S, D), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, S, D), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, blk_q, D), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = True, blk_q: int = 128,
+                    blk_k: int = 128):
+    """q: [B, S, H, D], k/v: [B, S, KVH, D] → [B, S, H, D]."""
+    return _flash_forward(q, k, v, causal, blk_q, blk_k)
+
+
+def _fwd(q, k, v, causal, blk_q, blk_k):
+    out = _flash_forward(q, k, v, causal, blk_q, blk_k)
+    return out, (q, k, v)
+
+
+def _bwd(causal, blk_q, blk_k, residuals, g):
+    q, k, v = residuals
+    # Recompute through the O(S)-memory jnp recurrence; its VJP is exact.
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

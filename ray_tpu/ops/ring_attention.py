@@ -1,0 +1,91 @@
+"""Ring attention: exact causal attention over a sequence-parallel mesh axis.
+
+Green-field capability (SURVEY.md §2.5/§5: the reference has no sequence/
+context parallelism). Each device holds a contiguous sequence shard of
+Q/K/V. KV shards rotate around the ring with `jax.lax.ppermute` — which XLA
+lowers to ICI neighbor transfers on TPU — while every device folds each
+arriving KV shard into its online-softmax accumulators
+(blockwise_attention.attention_chunk). The score matrix never exceeds
+[B, H, S/n, S/n]; sequence length scales linearly with ring size.
+
+Must run inside a `shard_map` (or pmap) that binds ``axis_name``; the
+parallel train step wires this under the `sp` mesh axis
+(parallel/train_step.py).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.blockwise_attention import _repeat_kv, attention_chunk
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True
+                   ) -> jax.Array:
+    """q,k,v: local shards [B, S_local, H|KVH, D] → [B, S_local, H, D].
+
+    The KV pair travels the ring; step i processes the shard originally
+    owned by device (my_index + i) mod n. Causality is enforced with global
+    positions, so fully-future shards contribute nothing (their probability
+    mass underflows to zero) and the result is exactly the unsharded causal
+    attention.
+    """
+    B, Sl, H, D = q.shape
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    k, v = _repeat_kv(k, v, H)
+    scale = 1.0 / math.sqrt(D)
+
+    qt = q.transpose(0, 2, 1, 3).astype(jnp.float32)
+    kt = k.transpose(0, 2, 1, 3).astype(jnp.float32)
+    vt = v.transpose(0, 2, 1, 3).astype(jnp.float32)
+
+    q_pos = my * Sl + jnp.arange(Sl)
+    m0 = jnp.full((B, H, Sl), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, H, Sl), jnp.float32)
+    o0 = jnp.zeros((B, H, Sl, D), jnp.float32)
+
+    # Receive from the right neighbor so step i holds shard (my + i) % n.
+    perm = [(j, (j - 1) % n) for j in range(n)]
+
+    def body(carry, i):
+        m, l, o, kc, vc = carry
+        src = (my + i) % n
+        k_pos = src * Sl + jnp.arange(Sl)
+        m, l, o = attention_chunk(qt, kc, vc, m, l, o, q_pos, k_pos,
+                                  causal, scale)
+        kc = jax.lax.ppermute(kc, axis_name, perm)
+        vc = jax.lax.ppermute(vc, axis_name, perm)
+        return (m, l, o, kc, vc), None
+
+    (m, l, o, _, _), _ = jax.lax.scan(
+        body, (m0, l0, o0, kt, vt), jnp.arange(n))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def make_ring_attention(mesh, axis_name: str = "sp", causal: bool = True,
+                        q_spec=None, kv_spec=None):
+    """shard_map wrapper: full arrays in, full arrays out. By default only
+    the sequence dimension is sharded (over ``axis_name``); callers running
+    under a larger mesh pass explicit ``q_spec``/``kv_spec`` for the
+    batch/head axes (e.g. the model's ring path, models/gpt.py)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if q_spec is None:
+        q_spec = P(None, axis_name, None, None)
+    if kv_spec is None:
+        kv_spec = q_spec
+
+    @partial(shard_map, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
+             out_specs=q_spec, check_vma=False)
+    def fn(q, k, v):
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+
+    return fn

@@ -104,3 +104,21 @@ def single_device_mesh():
     """A 1-device mesh with all axes size 1 — lets the same sharded program
     run unmodified on one chip."""
     return build_mesh(MeshConfig(dp=1, fsdp=1, tp=1, sp=1, ep=1))
+
+
+# -- current-mesh registry ----------------------------------------------
+# Ops that need an explicit shard_map (ring attention) read the ambient
+# mesh here; make_train_step / user code set it. A registry rather than a
+# parameter because the mesh must be static at trace time while model code
+# only receives (params, cfg, batch).
+
+_CURRENT_MESH = None
+
+
+def set_current_mesh(mesh) -> None:
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+def current_mesh():
+    return _CURRENT_MESH

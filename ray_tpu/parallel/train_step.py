@@ -68,6 +68,23 @@ def init_train_state(cfg: gpt.GPTConfig, mesh,
     return {"params": params, "opt_state": opt_state, "step": step}
 
 
+def _with_mesh_registered(jitted, mesh):
+    """Register ``mesh`` as the current mesh around every call, not once at
+    build time: jit traces lazily (first call / new shapes), so the registry
+    must hold THIS step's mesh whenever a trace may happen — two steps built
+    over different meshes would otherwise trace against the wrong one."""
+    import functools
+
+    from ray_tpu.parallel import mesh as mesh_mod
+
+    @functools.wraps(jitted)
+    def wrapped(*args, **kwargs):
+        mesh_mod.set_current_mesh(mesh)
+        return jitted(*args, **kwargs)
+
+    return wrapped
+
+
 def make_train_step(cfg: gpt.GPTConfig, mesh,
                     rules: Optional[ShardingRules] = None,
                     optimizer: Optional[optax.GradientTransformation] = None,
@@ -121,7 +138,7 @@ def make_train_step(cfg: gpt.GPTConfig, mesh,
         return ({"params": params, "opt_state": opt_state,
                  "step": state["step"] + 1}, metrics)
 
-    return jax.jit(step, donate_argnums=(0,))
+    return _with_mesh_registered(jax.jit(step, donate_argnums=(0,)), mesh)
 
 
 def make_eval_step(cfg: gpt.GPTConfig, mesh,
@@ -139,4 +156,4 @@ def make_eval_step(cfg: gpt.GPTConfig, mesh,
                                  batch["targets"], batch.get("mask"))
         return metrics
 
-    return jax.jit(step)
+    return _with_mesh_registered(jax.jit(step), mesh)
