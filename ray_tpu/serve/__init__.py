@@ -1,0 +1,231 @@
+"""ray_tpu.serve: model serving on the actor runtime.
+
+Analog of the reference's python/ray/serve (SURVEY.md §2.6): a controller
+actor reconciles deployment replicas; handles route requests with
+power-of-two replica picking; @serve.batch coalesces concurrent requests
+into one call (on TPU: one pjit batch); an aiohttp proxy provides HTTP
+ingress; autoscaling follows ongoing-request load. TPU-first difference:
+replicas typically hold a compiled pjit program + sharded params, so
+`num_replicas` maps to chips/slices, and batching targets MXU-shaped
+batches.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.handle import DeploymentHandle
+
+__all__ = ["Application", "Deployment", "DeploymentHandle", "batch",
+           "delete", "deployment", "get_app_handle", "get_deployment_handle",
+           "ingress", "run", "shutdown", "status", "start"]
+
+
+class Deployment:
+    """Produced by @serve.deployment; immutable config + .bind()/.deploy().
+
+    Reference: serve/deployment.py Deployment (options: num_replicas,
+    ray_actor_options, max_concurrent_queries, autoscaling_config,
+    route_prefix, user_config)."""
+
+    def __init__(self, func_or_class, name: str, config: Dict[str, Any]):
+        self._func_or_class = func_or_class
+        self.name = name
+        self._config = dict(config)
+
+    def options(self, **kwargs) -> "Deployment":
+        cfg = {**self._config, **kwargs}
+        name = cfg.pop("name", self.name)
+        return Deployment(self._func_or_class, name, cfg)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    @property
+    def num_replicas(self) -> int:
+        return self._config.get("num_replicas") or 1
+
+    @property
+    def route_prefix(self) -> Optional[str]:
+        rp = self._config.get("route_prefix", "/" + self.name)
+        return rp
+
+    def _deploy(self, init_args, init_kwargs, controller,
+                route_prefix: Optional[str] = "__unset__") -> None:
+        import cloudpickle
+        cfg = self._config
+        autoscaling = cfg.get("autoscaling_config")
+        num_replicas = cfg.get("num_replicas")
+        if autoscaling and num_replicas is None:
+            num_replicas = autoscaling.get("min_replicas", 1)
+        rp = self.route_prefix if route_prefix == "__unset__" else \
+            route_prefix
+        version = cfg.get("version") or uuid.uuid4().hex
+        ray_tpu.get(controller.deploy.remote(
+            self.name,
+            cloudpickle.dumps(self._func_or_class),
+            init_args, init_kwargs,
+            num_replicas or 1,
+            cfg.get("ray_actor_options") or {},
+            rp,
+            cfg.get("max_concurrent_queries", 100),
+            autoscaling,
+            version,
+        ))
+
+
+class Application:
+    """A bound deployment DAG node (reference: serve DAG API
+    deployment_graph.py / Application). Bound arguments may themselves be
+    Applications — they deploy first and are replaced by handles."""
+
+    def __init__(self, deployment: Deployment, args, kwargs):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: Optional[int] = None,
+               ray_actor_options: Optional[dict] = None,
+               max_concurrent_queries: int = 100,
+               autoscaling_config: Optional[dict] = None,
+               route_prefix: Optional[str] = "__default__",
+               user_config: Any = None,
+               version: Optional[str] = None,
+               **_ignored):
+    """``@serve.deployment`` / ``@serve.deployment(num_replicas=...)``."""
+
+    def decorate(target):
+        dep_name = name or target.__name__
+        cfg = {
+            "num_replicas": num_replicas,
+            "ray_actor_options": ray_actor_options,
+            "max_concurrent_queries": max_concurrent_queries,
+            "autoscaling_config": autoscaling_config,
+            "user_config": user_config,
+            "version": version,
+        }
+        if route_prefix != "__default__":
+            cfg["route_prefix"] = route_prefix
+        return Deployment(target, dep_name, cfg)
+
+    if _func_or_class is not None:
+        return decorate(_func_or_class)
+    return decorate
+
+
+def ingress(_app=None, **_kwargs):
+    """FastAPI-style ingress shim: returns the class unchanged (the aiohttp
+    proxy handles raw HTTP; FastAPI integration is out of scope — the
+    reference's @serve.ingress(app) wraps a FastAPI app)."""
+
+    def decorate(cls):
+        return cls
+
+    return decorate if _app is None else decorate(_app)
+
+
+def _deploy_application(app: Application, controller,
+                        route_prefix="__unset__") -> DeploymentHandle:
+    """Deploy bottom-up: bound Application args become handles."""
+    def resolve(v):
+        if isinstance(v, Application):
+            return _deploy_application(v, controller, route_prefix=None)
+        return v
+
+    args = tuple(resolve(a) for a in app.args)
+    kwargs = {k: resolve(v) for k, v in app.kwargs.items()}
+    app.deployment._deploy(args, kwargs, controller,
+                           route_prefix=route_prefix)
+    return DeploymentHandle(app.deployment.name, controller)
+
+
+def run(target: Union[Application, Deployment], *,
+        host: str = "127.0.0.1", port: Optional[int] = None,
+        route_prefix: str = "__unset__", name: str = "default",
+        _blocking: bool = False) -> DeploymentHandle:
+    """Deploy an application; returns its entry handle (reference:
+    serve/api.py:455 serve.run). Pass ``port`` to also start HTTP ingress
+    (port=0 picks an ephemeral port; see http_port())."""
+    from ray_tpu.serve._private.controller import get_or_create_controller
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    controller = get_or_create_controller()
+    if isinstance(target, Deployment):
+        target = target.bind()
+    handle = _deploy_application(target, controller,
+                                 route_prefix=route_prefix)
+    if port is not None:
+        start(host=host, port=port)
+    return handle
+
+
+_proxy = None
+_proxy_port: Optional[int] = None
+
+
+def start(detached: bool = False, host: str = "127.0.0.1",
+          port: int = 8000, **_ignored):
+    """Start the HTTP proxy (reference: serve.start / http_options)."""
+    global _proxy, _proxy_port
+    if _proxy is not None:
+        return _proxy
+    from ray_tpu.serve._private.http_proxy import HTTPProxyActor
+    cls = ray_tpu.remote(HTTPProxyActor)
+    _proxy = cls.options(name="_serve_http_proxy",
+                         get_if_exists=True).remote(host, port)
+    _proxy_port = ray_tpu.get(_proxy.ready.remote())
+    return _proxy
+
+
+def http_port() -> Optional[int]:
+    """The bound ingress port (useful with port=0)."""
+    return _proxy_port
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def status() -> Dict[str, Any]:
+    from ray_tpu.serve._private.controller import get_or_create_controller
+    controller = get_or_create_controller()
+    return ray_tpu.get(controller.list_deployments.remote())
+
+
+def delete(name: str) -> None:
+    from ray_tpu.serve._private.controller import get_or_create_controller
+    controller = get_or_create_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name))
+
+
+def shutdown() -> None:
+    global _proxy, _proxy_port
+    from ray_tpu.serve._private.controller import (CONTROLLER_NAME,
+                                                   get_or_create_controller)
+    if not ray_tpu.is_initialized():
+        return
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        controller = None
+    if controller is not None:
+        ray_tpu.get(controller.shutdown.remote())
+        ray_tpu.kill(controller)
+    if _proxy is not None:
+        try:
+            ray_tpu.get(_proxy.shutdown.remote())
+        except Exception:  # noqa: BLE001
+            pass
+        ray_tpu.kill(_proxy)
+        _proxy = None
+        _proxy_port = None

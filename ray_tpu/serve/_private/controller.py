@@ -1,0 +1,201 @@
+"""ServeController: the deployment control plane.
+
+Analog of the reference's serve/controller.py:64 ServeController +
+_private/deployment_state.py: a singleton async actor that owns desired
+state (deployments, replica counts), reconciles actual replica actors
+toward it, restarts failed replicas, and serves membership (with a version
+counter standing in for the reference's LongPollHost push channel,
+_private/long_poll.py:68 — routers poll the version and refresh on change).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.replica import ReplicaActor
+
+logger = logging.getLogger("ray_tpu.serve")
+
+CONTROLLER_NAME = "_serve_controller"
+
+
+class DeploymentInfo:
+    def __init__(self, name: str, deployment_def_bytes: bytes,
+                 init_args, init_kwargs, num_replicas: int,
+                 ray_actor_options: dict, route_prefix: Optional[str],
+                 max_concurrent_queries: int,
+                 autoscaling_config: Optional[dict], version: str):
+        self.name = name
+        self.deployment_def_bytes = deployment_def_bytes
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.num_replicas = num_replicas
+        self.ray_actor_options = ray_actor_options or {}
+        self.route_prefix = route_prefix
+        self.max_concurrent_queries = max_concurrent_queries
+        self.autoscaling_config = autoscaling_config
+        self.version = version
+        self.replicas: List[Any] = []  # live ActorHandles
+
+
+class ServeController:
+    """deploy/delete mutate desired state; a reconcile pass runs after every
+    mutation and periodically from the autoscale tick."""
+
+    def __init__(self):
+        self._deployments: Dict[str, DeploymentInfo] = {}
+        self._membership_version = 0
+        self._replica_seq = 0
+
+    # -- desired state ---------------------------------------------------
+
+    async def deploy(self, name: str, deployment_def_bytes: bytes,
+                     init_args, init_kwargs, num_replicas: int,
+                     ray_actor_options: dict, route_prefix: Optional[str],
+                     max_concurrent_queries: int,
+                     autoscaling_config: Optional[dict],
+                     version: str) -> bool:
+        existing = self._deployments.get(name)
+        info = DeploymentInfo(name, deployment_def_bytes, init_args,
+                              init_kwargs, num_replicas, ray_actor_options,
+                              route_prefix, max_concurrent_queries,
+                              autoscaling_config, version)
+        if existing is not None:
+            if existing.version == version and \
+                    existing.num_replicas == num_replicas:
+                return False
+            # Code/config change: replace replicas (simple rolling=all).
+            info.replicas = [] if existing.version != version else \
+                existing.replicas
+            if existing.version != version:
+                for r in existing.replicas:
+                    ray_tpu.kill(r)
+        self._deployments[name] = info
+        await self._reconcile(name)
+        return True
+
+    async def delete_deployment(self, name: str) -> bool:
+        info = self._deployments.pop(name, None)
+        if info is None:
+            return False
+        for r in info.replicas:
+            ray_tpu.kill(r)
+        self._membership_version += 1
+        return True
+
+    async def shutdown(self) -> bool:
+        for name in list(self._deployments):
+            await self.delete_deployment(name)
+        return True
+
+    # -- reconciliation --------------------------------------------------
+
+    async def _reconcile(self, name: str) -> None:
+        info = self._deployments.get(name)
+        if info is None:
+            return
+        while len(info.replicas) < info.num_replicas:
+            self._replica_seq += 1
+            cls = ray_tpu.remote(ReplicaActor)
+            opts = dict(info.ray_actor_options)
+            opts.setdefault("max_concurrency", info.max_concurrent_queries)
+            opts["name"] = f"_serve_replica::{name}::{self._replica_seq}"
+            opts["max_restarts"] = 3
+            replica = cls.options(**opts).remote(
+                name, info.deployment_def_bytes, info.init_args,
+                info.init_kwargs)
+            info.replicas.append(replica)
+        while len(info.replicas) > info.num_replicas:
+            victim = info.replicas.pop()
+            ray_tpu.kill(victim)
+        self._membership_version += 1
+        # Wait for replicas to become ready so run() returns a usable app.
+        for r in info.replicas:
+            ray_tpu.get(r.ready.remote())
+
+    async def check_health(self, name: str) -> int:
+        """Probe replicas; restart any that died. Returns live count
+        (reference: deployment_state health-check loop)."""
+        info = self._deployments.get(name)
+        if info is None:
+            return 0
+        live = []
+        for r in info.replicas:
+            try:
+                ray_tpu.get([r.ready.remote()], timeout=5)
+                live.append(r)
+            except Exception:  # noqa: BLE001 - dead replica
+                logger.warning("Replica of %s failed health check", name)
+        info.replicas = live
+        await self._reconcile(name)
+        return len(live)
+
+    # -- membership / routing -------------------------------------------
+
+    async def membership_version(self) -> int:
+        return self._membership_version
+
+    async def get_replicas(self, name: str):
+        info = self._deployments.get(name)
+        if info is None:
+            raise ValueError(f"Deployment {name!r} does not exist")
+        return (self._membership_version, info.replicas,
+                info.max_concurrent_queries)
+
+    async def list_deployments(self) -> Dict[str, dict]:
+        return {
+            name: {
+                "num_replicas": info.num_replicas,
+                "live_replicas": len(info.replicas),
+                "route_prefix": info.route_prefix,
+                "version": info.version,
+                "autoscaling_config": info.autoscaling_config,
+            }
+            for name, info in self._deployments.items()
+        }
+
+    async def get_routes(self) -> Dict[str, str]:
+        return {info.route_prefix: name
+                for name, info in self._deployments.items()
+                if info.route_prefix}
+
+    # -- autoscaling -----------------------------------------------------
+
+    async def autoscale_tick(self) -> Dict[str, int]:
+        """One autoscaling pass (reference: _private/autoscaling_policy.py:
+        replicas sized to ongoing-requests / target). Called periodically by
+        the proxy or tests."""
+        decisions = {}
+        for name, info in self._deployments.items():
+            cfg = info.autoscaling_config
+            if not cfg:
+                continue
+            target = cfg.get("target_num_ongoing_requests_per_replica", 1)
+            min_r = cfg.get("min_replicas", 1)
+            max_r = cfg.get("max_replicas", max(info.num_replicas, 1))
+            total_ongoing = 0
+            for r in info.replicas:
+                try:
+                    total_ongoing += ray_tpu.get(
+                        [r.num_ongoing.remote()], timeout=5)[0]
+                except Exception:  # noqa: BLE001
+                    pass
+            desired = max(min_r, min(max_r, round(total_ongoing / target)
+                                     if target else min_r))
+            if desired != info.num_replicas:
+                info.num_replicas = desired
+                await self._reconcile(name)
+            decisions[name] = info.num_replicas
+        return decisions
+
+
+def get_or_create_controller():
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        cls = ray_tpu.remote(ServeController)
+        return cls.options(name=CONTROLLER_NAME, get_if_exists=True,
+                           max_concurrency=16).remote()

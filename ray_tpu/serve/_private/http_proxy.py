@@ -1,0 +1,112 @@
+"""HTTP proxy: aiohttp ingress routing to deployment replicas.
+
+Analog of the reference's serve/_private/http_proxy.py:218 HTTPProxy (there
+uvicorn/starlette; aiohttp here — starlette is not in this image). One
+proxy actor binds the port, matches the longest route prefix, and awaits
+the replica response off the event loop thread. The controller stays
+off-path (routes refresh only when membership changes).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Dict, Optional
+
+
+class Request:
+    """What a deployment callable receives for an HTTP request (the
+    starlette-Request stand-in)."""
+
+    def __init__(self, method: str, path: str, query_params: Dict[str, str],
+                 body: bytes, headers: Dict[str, str]):
+        self.method = method
+        self.path = path
+        self.query_params = query_params
+        self.body = body
+        self.headers = headers
+
+    def json(self):
+        import json
+        return json.loads(self.body) if self.body else None
+
+
+class HTTPProxyActor:
+    def __init__(self, host: str, port: int):
+        self._host = host
+        self._port = port
+        self._routes: Dict[str, Any] = {}  # prefix -> DeploymentHandle
+        self._version = -1
+        self._runner = None
+        self._started = asyncio.Event()
+
+    async def ready(self) -> int:
+        """Start the server; returns the bound port."""
+        from aiohttp import web
+
+        from ray_tpu.serve._private.controller import \
+            get_or_create_controller
+        self._controller = get_or_create_controller()
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self._host, self._port)
+        await site.start()
+        # Resolve the actual port (0 = ephemeral).
+        for sock in site._server.sockets:  # noqa: SLF001
+            self._port = sock.getsockname()[1]
+            break
+        self._started.set()
+        return self._port
+
+    async def _refresh_routes(self):
+        import ray_tpu
+        version = await asyncio.to_thread(
+            lambda: ray_tpu.get(
+                self._controller.membership_version.remote()))
+        if version == self._version:
+            return
+        routes = await asyncio.to_thread(
+            lambda: ray_tpu.get(self._controller.get_routes.remote()))
+        from ray_tpu.serve.handle import DeploymentHandle
+        self._routes = {prefix: DeploymentHandle(name, self._controller)
+                        for prefix, name in routes.items()}
+        self._version = version
+
+    async def _handle(self, request):
+        import ray_tpu
+        from aiohttp import web
+        await self._refresh_routes()
+        path = "/" + request.match_info["tail"]
+        # Longest matching prefix wins (reference: route table matching).
+        match = None
+        for prefix in sorted(self._routes, key=len, reverse=True):
+            if path == prefix or path.startswith(
+                    prefix.rstrip("/") + "/") or prefix == "/":
+                match = prefix
+                break
+        if match is None:
+            return web.json_response(
+                {"error": f"no deployment at {path}"}, status=404)
+        handle = self._routes[match]
+        body = await request.read()
+        req = Request(request.method, path, dict(request.query),
+                      body, dict(request.headers))
+        ref = handle.remote(req)
+        try:
+            result = await asyncio.to_thread(
+                lambda: ray_tpu.get([ref], timeout=60)[0])
+        except Exception as e:  # noqa: BLE001 - surface as 500
+            return web.json_response({"error": str(e)}, status=500)
+        if isinstance(result, bytes):
+            return web.Response(body=result)
+        if isinstance(result, str):
+            return web.Response(text=result)
+        return web.json_response(result)
+
+    async def shutdown(self) -> bool:
+        if self._runner is not None:
+            await self._runner.cleanup()
+        return True

@@ -1,0 +1,62 @@
+"""Replica actor: hosts one copy of a deployment.
+
+Analog of the reference's serve/_private/replica.py:260 RayServeReplica:
+unwraps the deployment definition (class or function), constructs it once
+(handles to other deployments arrive through init args — the DAG
+composition path), then serves `handle_request` calls. Async methods are
+awaited; `@serve.batch` methods batch transparently (serve/batching.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+from typing import Any
+
+
+class ReplicaActor:
+    def __init__(self, deployment_name: str, deployment_def_bytes: bytes,
+                 init_args, init_kwargs):
+        import cloudpickle
+        self._deployment_name = deployment_name
+        deployment_def = cloudpickle.loads(deployment_def_bytes)
+        self._is_function = inspect.isfunction(deployment_def)
+        if self._is_function:
+            self._callable = deployment_def
+        else:
+            self._callable = deployment_def(*(init_args or ()),
+                                            **(init_kwargs or {}))
+        self._ongoing = 0
+
+    async def ready(self) -> bool:
+        return True
+
+    async def num_ongoing(self) -> int:
+        return self._ongoing
+
+    async def reconfigure(self, user_config: Any) -> bool:
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            result = fn(user_config)
+            if inspect.iscoroutine(result):
+                await result
+        return True
+
+    async def handle_request(self, method_name: str, args, kwargs):
+        self._ongoing += 1
+        try:
+            if self._is_function:
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method_name or "__call__")
+            if inspect.iscoroutinefunction(fn):
+                return await fn(*args, **kwargs)
+            # Sync handlers run off the event loop so concurrent requests
+            # overlap and num_ongoing reflects true load (reference:
+            # replica.py runs sync callables in a thread pool).
+            result = await asyncio.to_thread(fn, *args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            return result
+        finally:
+            self._ongoing -= 1

@@ -1,0 +1,64 @@
+"""Router: replica selection for a deployment.
+
+Analog of the reference's serve/_private/router.py:261 (assign_request
+:298): keeps a cached replica list refreshed when the controller's
+membership version moves (the pull flavor of the reference's long-poll
+push), and picks the less-loaded of two random replicas (power-of-two
+choices) using each replica's last-known ongoing count.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Router:
+    def __init__(self, controller, deployment_name: str):
+        self._controller = controller
+        self._name = deployment_name
+        self._version = -1
+        self._replicas: List[Any] = []
+        self._max_queries = 1
+        self._lock = threading.Lock()
+        self._rr = 0
+
+    def _refresh(self) -> None:
+        current = ray_tpu.get(self._controller.membership_version.remote())
+        with self._lock:
+            if current == self._version and self._replicas:
+                return
+        version, replicas, max_q = ray_tpu.get(
+            self._controller.get_replicas.remote(self._name))
+        with self._lock:
+            self._version = version
+            self._replicas = list(replicas)
+            self._max_queries = max_q
+
+    def pick_replica(self):
+        self._refresh()
+        with self._lock:
+            replicas = list(self._replicas)
+            self._rr += 1
+            rr = self._rr
+        if not replicas:
+            raise RuntimeError(
+                f"Deployment {self._name!r} has no live replicas")
+        if len(replicas) == 1:
+            return replicas[0]
+        # Power-of-two choices on sampled ongoing counts.
+        a, b = random.sample(replicas, 2)
+        try:
+            qa, qb = ray_tpu.get([a.num_ongoing.remote(),
+                                  b.num_ongoing.remote()], timeout=5)
+        except Exception:  # noqa: BLE001 - fall back to round robin
+            return replicas[rr % len(replicas)]
+        return a if qa <= qb else b
+
+    def assign_request(self, method_name: str, args, kwargs):
+        """Returns an ObjectRef of the replica call."""
+        replica = self.pick_replica()
+        return replica.handle_request.remote(method_name, args, kwargs)

@@ -1,0 +1,106 @@
+"""@serve.batch: transparent dynamic request batching.
+
+Analog of the reference's serve/batching.py: an async method decorated with
+``@serve.batch`` receives a *list* of inputs; concurrent callers are
+coalesced until ``max_batch_size`` requests are queued or
+``batch_wait_timeout_s`` elapses, then the underlying function runs once
+and each caller gets its element of the returned list. The core TPU win:
+replicas batch independent HTTP/handle requests into one MXU-sized
+``pjit`` call.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn, max_batch_size: int, timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._timeout = timeout_s
+        self._queue: Optional[asyncio.Queue] = None
+        self._loop_task = None
+
+    def _ensure_loop(self):
+        if self._queue is None:
+            self._queue = asyncio.Queue()
+            self._loop_task = asyncio.get_event_loop().create_task(
+                self._batch_loop())
+
+    async def _batch_loop(self):
+        while True:
+            first = await self._queue.get()
+            batch = [first]
+            deadline = asyncio.get_event_loop().time() + self._timeout
+            while len(batch) < self._max:
+                remaining = deadline - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    break
+                try:
+                    item = await asyncio.wait_for(self._queue.get(),
+                                                  remaining)
+                    batch.append(item)
+                except asyncio.TimeoutError:
+                    break
+            args = [item[0] for item in batch]
+            futures = [item[1] for item in batch]
+            try:
+                results = await self._fn(args)
+                if len(results) != len(batch):
+                    raise ValueError(
+                        f"@serve.batch function returned {len(results)} "
+                        f"results for a batch of {len(batch)}")
+                for fut, res in zip(futures, results):
+                    if not fut.done():
+                        fut.set_result(res)
+            except Exception as e:  # noqa: BLE001 - propagate per caller
+                for fut in futures:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+    async def submit(self, arg):
+        self._ensure_loop()
+        fut = asyncio.get_event_loop().create_future()
+        await self._queue.put((arg, fut))
+        return await fut
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """``@serve.batch`` / ``@serve.batch(max_batch_size=…)``."""
+
+    def decorator(fn):
+        queues = {}  # per-instance (or one for free functions)
+
+        if not asyncio.iscoroutinefunction(fn):
+            raise TypeError("@serve.batch requires an async function")
+
+        @functools.wraps(fn)
+        async def wrapper(*args):
+            # Method: (self, item); function: (item,)
+            if len(args) == 2:
+                owner, arg = args
+                key = id(owner)
+                bound = functools.partial(fn, owner)
+            elif len(args) == 1:
+                owner, arg = None, args[0]
+                key = None
+                bound = fn
+            else:
+                raise TypeError(
+                    "@serve.batch functions take exactly one request "
+                    "argument")
+            q = queues.get(key)
+            if q is None:
+                q = _BatchQueue(bound, max_batch_size, batch_wait_timeout_s)
+                queues[key] = q
+            return await q.submit(arg)
+
+        return wrapper
+
+    if _fn is not None:
+        return decorator(_fn)
+    return decorator

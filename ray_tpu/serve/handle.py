@@ -1,0 +1,50 @@
+"""DeploymentHandle: Python-side entry point to a deployment.
+
+Analog of the reference's serve/handle.py RayServeHandle:
+``handle.remote(*args)`` routes to a replica and returns an ObjectRef;
+``handle.method.remote(...)`` targets a specific method. Handles pickle by
+name and re-bind through the controller, so they can be passed into other
+deployments (DAG composition) or tasks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ray_tpu.serve._private.router import Router
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method_name: str):
+        self._handle = handle
+        self._method_name = method_name
+
+    def remote(self, *args, **kwargs):
+        return self._handle._router.assign_request(
+            self._method_name, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller=None):
+        from ray_tpu.serve._private.controller import \
+            get_or_create_controller
+        self.deployment_name = deployment_name
+        self._controller = controller or get_or_create_controller()
+        self._router = Router(self._controller, deployment_name)
+
+    def remote(self, *args, **kwargs):
+        return self._router.assign_request("__call__", args, kwargs)
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return _MethodCaller(self, item)
+
+    def options(self, **_kwargs) -> "DeploymentHandle":
+        return self
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name,))
+
+    def __repr__(self):
+        return f"DeploymentHandle({self.deployment_name!r})"

@@ -1,0 +1,255 @@
+"""Host-driven collective communication across actors/tasks.
+
+API-compatible analog of the reference's `ray.util.collective`
+(python/ray/util/collective/collective.py:258-655: init_collective_group /
+allreduce / broadcast / allgather / reducescatter / barrier / send / recv).
+
+The backend story is TPU-first (SURVEY.md §2.4): *inside* a jitted program,
+collectives are XLA ICI collectives (psum/all_gather — see parallel/ and
+ops/ring_attention.py) and never touch this module. This module covers the
+reference's *host-driven* use case — actors exchanging arrays outside jit —
+which the reference backs with NCCL/Gloo process groups. Here the rendezvous
+point is a named coordinator actor (the same pattern the reference uses to
+exchange the NCCL unique id), and the reduction itself runs in jax on the
+contributing host.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_COORD_NAME = "_ray_tpu_collective_coordinator"
+_local = threading.local()  # per-worker-thread group registry
+
+
+class _Coordinator:
+    """Async rendezvous actor: collects one contribution per rank, computes
+    the collective result once, and hands it to every waiter."""
+
+    def __init__(self):
+        import asyncio
+        self._rounds: Dict[str, dict] = {}
+        self._lock = asyncio.Lock()
+
+    async def contribute(self, key: str, rank: int, world: int, data,
+                         combine: str):
+        import asyncio
+        async with self._lock:
+            st = self._rounds.get(key)
+            if st is None:
+                st = {"parts": {}, "event": asyncio.Event(), "result": None,
+                      "consumed": 0}
+                self._rounds[key] = st
+            st["parts"][rank] = data
+            if len(st["parts"]) == world:
+                st["result"] = _combine(st["parts"], world, combine)
+                st["event"].set()
+        await st["event"].wait()
+        async with self._lock:
+            st["consumed"] += 1
+            result = st["result"]
+            if st["consumed"] == world:
+                del self._rounds[key]
+        return result
+
+    async def put_p2p(self, key: str, data):
+        import asyncio
+        async with self._lock:
+            st = self._rounds.get(key)
+            if st is None:
+                st = {"parts": {}, "event": asyncio.Event(), "result": None,
+                      "consumed": 0}
+                self._rounds[key] = st
+            st["result"] = data
+            st["event"].set()
+        return True
+
+    async def get_p2p(self, key: str):
+        import asyncio
+        async with self._lock:
+            st = self._rounds.get(key)
+            if st is None:
+                st = {"parts": {}, "event": asyncio.Event(), "result": None,
+                      "consumed": 0}
+                self._rounds[key] = st
+        await st["event"].wait()
+        async with self._lock:
+            result = st["result"]
+            del self._rounds[key]
+        return result
+
+
+def _combine(parts: Dict[int, Any], world: int, combine: str):
+    ordered = [parts[r] for r in range(world)]
+    if combine == "gather":
+        return ordered
+    if combine in ("sum", "product", "min", "max"):
+        import jax.numpy as jnp
+        op = {"sum": jnp.add, "product": jnp.multiply,
+              "min": jnp.minimum, "max": jnp.maximum}[combine]
+        acc = jnp.asarray(ordered[0])
+        for p in ordered[1:]:
+            acc = op(acc, jnp.asarray(p))
+        return np.asarray(acc)
+    if combine == "barrier":
+        return None
+    raise ValueError(combine)
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+class _GroupState:
+    __slots__ = ("world_size", "rank", "round_ids")
+
+    def __init__(self, world_size: int, rank: int):
+        self.world_size = world_size
+        self.rank = rank
+        self.round_ids: Dict[str, int] = {}
+
+    def next_round(self, op: str) -> int:
+        n = self.round_ids.get(op, 0)
+        self.round_ids[op] = n + 1
+        return n
+
+
+def _groups() -> Dict[str, _GroupState]:
+    if not hasattr(_local, "groups"):
+        _local.groups = {}
+    return _local.groups
+
+
+def _coordinator():
+    try:
+        return ray_tpu.get_actor(_COORD_NAME)
+    except ValueError:
+        coord_cls = ray_tpu.remote(_Coordinator)
+        return coord_cls.options(name=_COORD_NAME,
+                                 get_if_exists=True).remote()
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "tpu",
+                          group_name: str = "default") -> None:
+    """Each participant calls this once with its rank (reference:
+    collective.py:151 imperative path). Registry is per worker thread —
+    actors with max_concurrency=1 (the default) are safe."""
+    if rank >= world_size:
+        raise ValueError(f"rank {rank} >= world_size {world_size}")
+    _coordinator()  # ensure it exists before the first collective
+    _groups()[group_name] = _GroupState(world_size, rank)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _groups().pop(group_name, None)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups()
+
+
+def get_rank(group_name: str = "default") -> int:
+    g = _groups().get(group_name)
+    return -1 if g is None else g.rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    g = _groups().get(group_name)
+    return -1 if g is None else g.world_size
+
+
+def _run(group_name: str, op: str, data, combine: str):
+    g = _groups().get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"Collective group {group_name!r} is not initialized on this "
+            "worker; call init_collective_group first")
+    rnd = g.next_round(op)
+    key = f"{group_name}:{op}:{rnd}"
+    coord = _coordinator()
+    return ray_tpu.get(
+        coord.contribute.remote(key, g.rank, g.world_size, data, combine))
+
+
+def allreduce(tensor, group_name: str = "default",
+              op: str = ReduceOp.SUM):
+    """Returns the reduced array (the reference mutates in place; jax arrays
+    are immutable, so the result is returned)."""
+    return _run(group_name, f"allreduce-{op}", np.asarray(tensor), op)
+
+
+def allgather(tensor, group_name: str = "default") -> List[Any]:
+    return _run(group_name, "allgather", np.asarray(tensor), "gather")
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _groups().get(group_name)
+    if g is None:
+        raise RuntimeError(f"group {group_name!r} not initialized")
+    parts = _run(group_name, "broadcast",
+                 np.asarray(tensor) if g.rank == src_rank else None,
+                 "gather")
+    return parts[src_rank]
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: str = ReduceOp.SUM):
+    g = _groups().get(group_name)
+    result = _run(group_name, f"reduce-{op}", np.asarray(tensor), op)
+    return result if g.rank == dst_rank else tensor
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: str = ReduceOp.SUM):
+    """Reduce then return this rank's 1/world slice along axis 0."""
+    g = _groups().get(group_name)
+    full = _run(group_name, f"reducescatter-{op}", np.asarray(tensor), op)
+    chunks = np.array_split(full, g.world_size, axis=0)
+    return chunks[g.rank]
+
+
+def barrier(group_name: str = "default") -> None:
+    _run(group_name, "barrier", None, "barrier")
+
+
+def send(tensor, dst_rank: int, group_name: str = "default") -> None:
+    g = _groups().get(group_name)
+    if g is None:
+        raise RuntimeError(f"group {group_name!r} not initialized")
+    n = g.round_ids.get(f"p2p-{g.rank}-{dst_rank}", 0)
+    g.round_ids[f"p2p-{g.rank}-{dst_rank}"] = n + 1
+    key = f"{group_name}:p2p:{g.rank}->{dst_rank}:{n}"
+    ray_tpu.get(_coordinator().put_p2p.remote(key, np.asarray(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    g = _groups().get(group_name)
+    if g is None:
+        raise RuntimeError(f"group {group_name!r} not initialized")
+    n = g.round_ids.get(f"p2p-{src_rank}-{g.rank}", 0)
+    g.round_ids[f"p2p-{src_rank}-{g.rank}"] = n + 1
+    key = f"{group_name}:p2p:{src_rank}->{g.rank}:{n}"
+    return ray_tpu.get(_coordinator().get_p2p.remote(key))
+
+
+def create_collective_group(actors: List[Any], world_size: int,
+                            ranks: List[int], backend: str = "tpu",
+                            group_name: str = "default"):
+    """Declarative setup (reference: collective.py:151): initializes the
+    group on each actor by invoking its ``init_collective_group`` method if
+    it has one, else an injected generic call is required from the actor
+    itself."""
+    refs = []
+    for actor, rank in zip(actors, ranks):
+        refs.append(actor.init_collective_group.remote(
+            world_size, rank, backend, group_name))
+    return ray_tpu.get(refs)

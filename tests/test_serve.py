@@ -1,0 +1,196 @@
+"""Tests for ray_tpu.serve (model: reference python/ray/serve/tests)."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_session():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_function_deployment(serve_session):
+    @serve.deployment
+    def echo(x):
+        return {"got": x}
+
+    handle = serve.run(echo.bind())
+    out = ray_tpu.get(handle.remote("hi"))
+    assert out == {"got": "hi"}
+
+
+def test_class_deployment_with_state(serve_session):
+    @serve.deployment(num_replicas=1)
+    class Counter:
+        def __init__(self, start):
+            self.count = start
+
+        def __call__(self, inc):
+            self.count += inc
+            return self.count
+
+        def value(self):
+            return self.count
+
+    handle = serve.run(Counter.bind(10))
+    assert ray_tpu.get(handle.remote(5)) == 15
+    assert ray_tpu.get(handle.remote(1)) == 16
+    assert ray_tpu.get(handle.value.remote()) == 16
+
+
+def test_multi_replica_routing(serve_session):
+    @serve.deployment(num_replicas=3)
+    class Who:
+        def __init__(self):
+            import uuid
+            self.id = uuid.uuid4().hex
+
+        def __call__(self, _):
+            return self.id
+
+    handle = serve.run(Who.bind())
+    ids = set(ray_tpu.get([handle.remote(None) for _ in range(30)]))
+    assert len(ids) >= 2  # requests spread over replicas
+
+
+def test_composition_dag(serve_session):
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            doubled = ray_tpu.get(self.pre.remote(x))
+            return doubled + 1
+
+    handle = serve.run(Model.bind(Preprocess.bind()))
+    assert ray_tpu.get(handle.remote(10)) == 21
+
+
+def test_batching(serve_session):
+    @serve.deployment(max_concurrent_queries=64)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        async def __call__(self, items):
+            self.batch_sizes.append(len(items))
+            return [i * 10 for i in items]
+
+        def sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind())
+    refs = [handle.remote(i) for i in range(16)]
+    out = ray_tpu.get(refs)
+    assert sorted(out) == [i * 10 for i in range(16)]
+    sizes = ray_tpu.get(handle.sizes.remote())
+    assert max(sizes) > 1  # some coalescing happened
+
+
+def test_status_and_delete(serve_session):
+    @serve.deployment(num_replicas=2)
+    def f(x):
+        return x
+
+    serve.run(f.bind())
+    st = serve.status()
+    assert st["f"]["num_replicas"] == 2
+    assert st["f"]["live_replicas"] == 2
+    serve.delete("f")
+    assert "f" not in serve.status()
+
+
+def test_redeploy_new_version(serve_session):
+    @serve.deployment(version="v1")
+    def api(x):
+        return "v1"
+
+    handle = serve.run(api.bind())
+    assert ray_tpu.get(handle.remote(None)) == "v1"
+
+    @serve.deployment(name="api", version="v2")
+    def api2(x):
+        return "v2"
+
+    handle = serve.run(api2.bind())
+    assert ray_tpu.get(handle.remote(None)) == "v2"
+
+
+def test_autoscaling(serve_session):
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_num_ongoing_requests_per_replica": 1})
+    class Slow:
+        def __call__(self, _):
+            time.sleep(0.5)
+            return 1
+
+    handle = serve.run(Slow.bind())
+    assert serve.status()["Slow"]["live_replicas"] == 1
+    refs = [handle.remote(None) for _ in range(6)]
+    time.sleep(0.1)  # let requests become "ongoing"
+    controller = get_or_create_controller()
+    decisions = ray_tpu.get(controller.autoscale_tick.remote())
+    assert decisions["Slow"] >= 2  # scaled up under load
+    ray_tpu.get(refs)
+    # Drained: next tick scales back toward min.
+    decisions = ray_tpu.get(controller.autoscale_tick.remote())
+    assert decisions["Slow"] == 1
+
+
+def test_http_proxy(serve_session):
+    import json
+    import urllib.request
+
+    @serve.deployment(route_prefix="/api")
+    def api(request):
+        data = request.json()
+        return {"doubled": data["x"] * 2}
+
+    serve.run(api.bind(), port=0)
+    port = serve.http_port()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api",
+        data=json.dumps({"x": 21}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        body = json.loads(resp.read())
+    assert body == {"doubled": 42}
+
+
+def test_http_404(serve_session):
+    import urllib.error
+    import urllib.request
+
+    @serve.deployment(route_prefix="/known")
+    def known(request):
+        return "ok"
+
+    serve.run(known.bind(), port=0)
+    port = serve.http_port()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/known", timeout=10) as resp:
+        assert resp.read() == b"ok"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/unknown", timeout=10)
+    assert e.value.code == 404

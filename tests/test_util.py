@@ -1,0 +1,161 @@
+"""Tests for util.collective / ActorPool / Queue (model: reference
+python/ray/util/collective/tests, test_actor_pool.py, test_queue.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Queue
+
+
+@ray_tpu.remote
+class _Worker:
+    def __init__(self, rank, world):
+        self.rank = rank
+        self.world = world
+
+    def init_collective_group(self, world_size, rank, backend, group_name):
+        from ray_tpu.util import collective
+        collective.init_collective_group(world_size, rank, backend,
+                                         group_name)
+        return True
+
+    def do_allreduce(self, value):
+        from ray_tpu.util import collective
+        return collective.allreduce(np.array([value], dtype=np.float32))
+
+    def do_allgather(self):
+        from ray_tpu.util import collective
+        return collective.allgather(np.array([self.rank]))
+
+    def do_broadcast(self):
+        from ray_tpu.util import collective
+        return collective.broadcast(np.array([42.0 + self.rank]), src_rank=1)
+
+    def do_reducescatter(self):
+        from ray_tpu.util import collective
+        return collective.reducescatter(
+            np.arange(self.world, dtype=np.float32))
+
+    def do_barrier(self):
+        from ray_tpu.util import collective
+        collective.barrier()
+        return self.rank
+
+    def do_send(self, dst):
+        from ray_tpu.util import collective
+        collective.send(np.array([self.rank * 100]), dst)
+        return True
+
+    def do_recv(self, src):
+        from ray_tpu.util import collective
+        return collective.recv(src)
+
+    def rank_info(self):
+        from ray_tpu.util import collective
+        return (collective.get_rank(),
+                collective.get_collective_group_size())
+
+
+def _make_group(n):
+    from ray_tpu.util import collective
+    workers = [_Worker.remote(i, n) for i in range(n)]
+    collective.create_collective_group(workers, n, list(range(n)))
+    return workers
+
+
+def test_collective_allreduce(ray_start_regular):
+    workers = _make_group(4)
+    out = ray_tpu.get([w.do_allreduce.remote(float(i + 1))
+                       for i, w in enumerate(workers)])
+    for o in out:
+        assert o[0] == pytest.approx(1 + 2 + 3 + 4)
+
+
+def test_collective_allgather_broadcast(ray_start_regular):
+    workers = _make_group(3)
+    gathered = ray_tpu.get([w.do_allgather.remote() for w in workers])
+    for g in gathered:
+        assert [int(x[0]) for x in g] == [0, 1, 2]
+    bcast = ray_tpu.get([w.do_broadcast.remote() for w in workers])
+    for b in bcast:
+        assert b[0] == pytest.approx(43.0)  # rank 1's value
+
+
+def test_collective_reducescatter_barrier_rank(ray_start_regular):
+    workers = _make_group(2)
+    rs = ray_tpu.get([w.do_reducescatter.remote() for w in workers])
+    assert rs[0][0] == pytest.approx(0.0)  # sum of [0,1] over 2 ranks → [0],[2]
+    assert rs[1][0] == pytest.approx(2.0)
+    assert sorted(ray_tpu.get([w.do_barrier.remote() for w in workers])) == [0, 1]
+    info = ray_tpu.get(workers[1].rank_info.remote())
+    assert info == (1, 2)
+
+
+def test_collective_send_recv(ray_start_regular):
+    workers = _make_group(2)
+    send_ref = workers[0].do_send.remote(1)
+    out = ray_tpu.get(workers[1].do_recv.remote(0))
+    assert ray_tpu.get(send_ref) is True
+    assert out[0] == 0
+
+
+def test_actor_pool_map(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def double(self, x):
+            return x * 2
+
+    pool = ActorPool([A.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [i * 2 for i in range(8)]
+
+
+def test_actor_pool_unordered_and_reuse(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def work(self, x):
+            return x + 1
+
+    pool = ActorPool([A.remote()])
+    out = sorted(pool.map_unordered(lambda a, v: a.work.remote(v), range(5)))
+    assert out == [1, 2, 3, 4, 5]
+    assert pool.has_free()
+    a = pool.pop_idle()
+    assert a is not None
+    pool.push(a)
+
+
+def test_queue_basic(ray_start_regular):
+    q = Queue(maxsize=4)
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.put_nowait_batch([1, 2, 3])
+    assert q.get_nowait_batch(3) == [1, 2, 3]
+    q.shutdown()
+
+
+def test_queue_blocking_producer_consumer(ray_start_regular):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    @ray_tpu.remote
+    def consumer(q, n):
+        return [q.get(timeout=5) for _ in range(n)]
+
+    pref = producer.remote(q, 5)
+    cref = consumer.remote(q, 5)
+    assert ray_tpu.get(cref) == list(range(5))
+    assert ray_tpu.get(pref)
