@@ -130,6 +130,11 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> ActorHandle:
         return self._remote(args, kwargs, self._default_options)
 
+    def bind(self, *args, **kwargs):
+        """Build a ClassNode DAG node (reference: python/ray/dag/)."""
+        from ray_tpu.dag import ClassNode
+        return ClassNode(self, args, kwargs)
+
     def _remote(self, args, kwargs, options) -> ActorHandle:
         runtime = global_worker.runtime
         session, function_id = self._exported

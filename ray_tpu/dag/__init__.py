@@ -1,0 +1,168 @@
+"""Ray DAG API: lazily-bound task/actor call graphs.
+
+Analog of the reference's python/ray/dag/ (FunctionNode, ClassNode,
+InputNode, dag_node.py execute): ``fn.bind(*args)`` builds a DAG node
+instead of submitting; ``node.execute(input)`` walks the graph, submits
+every bound call as a task with parent ObjectRefs as arguments, and returns
+the root's ObjectRef. Workflows compile these DAGs into durable executions
+(ray_tpu/workflow).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["DAGNode", "FunctionNode", "InputNode", "ClassNode",
+           "ClassMethodNode", "InputAttributeNode"]
+
+
+class DAGNode:
+    def __init__(self):
+        self._stable_uuid = uuid.uuid4().hex
+
+    def execute(self, *args, **kwargs):
+        """Execute the DAG rooted here; returns ObjectRef (or value for
+        InputNode)."""
+        cache: Dict[str, Any] = {}
+        input_value = args[0] if args else None
+        return self._execute_recursive(cache, input_value)
+
+    def _execute_recursive(self, cache: Dict[str, Any], input_value):
+        raise NotImplementedError
+
+    def _resolve_arg(self, arg, cache, input_value):
+        if isinstance(arg, DAGNode):
+            return arg._execute_recursive(cache, input_value)
+        return arg
+
+
+class InputNode(DAGNode):
+    """Placeholder for the runtime input (reference: dag/input_node.py).
+    Supports context-manager style: ``with InputNode() as inp:``."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return InputAttributeNode(self, item)
+
+    def __getitem__(self, key):
+        return InputAttributeNode(self, key, is_item=True)
+
+    def _execute_recursive(self, cache, input_value):
+        return input_value
+
+
+class InputAttributeNode(DAGNode):
+    def __init__(self, parent: InputNode, key, is_item: bool = False):
+        super().__init__()
+        self._parent = parent
+        self._key = key
+        self._is_item = is_item
+
+    def _execute_recursive(self, cache, input_value):
+        value = self._parent._execute_recursive(cache, input_value)
+        if self._is_item:
+            return value[self._key]
+        return getattr(value, self._key)
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_function, args: Tuple, kwargs: Dict):
+        super().__init__()
+        self._remote_function = remote_function
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    def _execute_recursive(self, cache, input_value):
+        if self._stable_uuid in cache:
+            return cache[self._stable_uuid]
+        args = [self._resolve_arg(a, cache, input_value)
+                for a in self._bound_args]
+        kwargs = {k: self._resolve_arg(v, cache, input_value)
+                  for k, v in self._bound_kwargs.items()}
+        ref = self._remote_function.remote(*args, **kwargs)
+        cache[self._stable_uuid] = ref
+        return ref
+
+    # -- workflow compilation hooks -------------------------------------
+
+    @property
+    def fn(self):
+        return self._remote_function
+
+    @property
+    def bound_args(self):
+        return self._bound_args
+
+    @property
+    def bound_kwargs(self):
+        return self._bound_kwargs
+
+    def get_options(self) -> dict:
+        return dict(self._remote_function._default_options)
+
+
+class ClassNode(DAGNode):
+    """A bound actor constructor; method calls on it create
+    ClassMethodNodes (reference: dag/class_node.py)."""
+
+    def __init__(self, actor_class, args: Tuple, kwargs: Dict):
+        super().__init__()
+        self._actor_class = actor_class
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return _UnboundMethod(self, item)
+
+    def _execute_recursive(self, cache, input_value):
+        if self._stable_uuid in cache:
+            return cache[self._stable_uuid]
+        args = [self._resolve_arg(a, cache, input_value)
+                for a in self._bound_args]
+        kwargs = {k: self._resolve_arg(v, cache, input_value)
+                  for k, v in self._bound_kwargs.items()}
+        handle = self._actor_class.remote(*args, **kwargs)
+        cache[self._stable_uuid] = handle
+        return handle
+
+
+class _UnboundMethod:
+    def __init__(self, class_node: ClassNode, method_name: str):
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def bind(self, *args, **kwargs) -> "ClassMethodNode":
+        return ClassMethodNode(self._class_node, self._method_name, args,
+                               kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method_name: str,
+                 args: Tuple, kwargs: Dict):
+        super().__init__()
+        self._class_node = class_node
+        self._method_name = method_name
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    def _execute_recursive(self, cache, input_value):
+        if self._stable_uuid in cache:
+            return cache[self._stable_uuid]
+        handle = self._class_node._execute_recursive(cache, input_value)
+        args = [self._resolve_arg(a, cache, input_value)
+                for a in self._bound_args]
+        kwargs = {k: self._resolve_arg(v, cache, input_value)
+                  for k, v in self._bound_kwargs.items()}
+        ref = getattr(handle, self._method_name).remote(*args, **kwargs)
+        cache[self._stable_uuid] = ref
+        return ref

@@ -39,6 +39,12 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs, self._default_options)
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node instead of submitting (reference:
+        python/ray/dag/: fn.bind → FunctionNode)."""
+        from ray_tpu.dag import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def _function_id_for(self, runtime):
         session, fn_id = self._exported
         if session != runtime.session_id:

@@ -1,0 +1,22 @@
+"""ray_tpu.rllib: reinforcement learning on the actor runtime, JAX-first.
+
+Subset of the reference's rllib (SURVEY.md §2.6): Algorithm/AlgorithmConfig
+driver, WorkerSet rollout actors (CPU envs), JAXPolicy actor-critic
+compiled by XLA, PPO, SampleBatch, replay buffers. The learner update is a
+jitted functional step — pjit over a learner mesh is the multi-GPU-learner
+equivalent.
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.evaluation.rollout_worker import RolloutWorker
+from ray_tpu.rllib.evaluation.worker_set import WorkerSet
+from ray_tpu.rllib.policy.jax_policy import JAXPolicy, compute_gae
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
+                                                ReplayBuffer)
+
+__all__ = ["Algorithm", "AlgorithmConfig", "JAXPolicy", "PPO", "PPOConfig",
+           "PrioritizedReplayBuffer", "ReplayBuffer", "RolloutWorker",
+           "SampleBatch", "WorkerSet", "compute_gae"]

@@ -1,0 +1,125 @@
+"""Algorithm: the RLlib driver loop.
+
+Analog of the reference's rllib/algorithms/algorithm.py:150 (step :744,
+training_step :1322): owns a WorkerSet and a learner policy; each train()
+call broadcasts weights, samples, runs the algorithm's update, and returns
+a result dict. Tune-compatible: implements the Trainable protocol surface
+(train/save/restore/stop) so Tuner can tune algorithms.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.evaluation.worker_set import WorkerSet
+from ray_tpu.rllib.policy.jax_policy import JAXPolicy
+
+
+class Algorithm:
+    _default_config_class = AlgorithmConfig
+
+    def __init__(self, config: Optional[AlgorithmConfig] = None, env=None,
+                 **kwargs):
+        if config is None:
+            config = self.get_default_config()
+        if env is not None:
+            config.env = env
+        self.config = config
+        self.iteration = 0
+        self._timesteps_total = 0
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        env_creator = config.env_creator()
+        probe_env = env_creator({})
+        self.local_policy = JAXPolicy(
+            obs_dim=int(np.prod(probe_env.observation_space.shape)),
+            action_space=probe_env.action_space,
+            hiddens=tuple(config.fcnet_hiddens),
+            seed=config.seed,
+        )
+        probe_env.close() if hasattr(probe_env, "close") else None
+        self.workers = WorkerSet(
+            env_creator, config.policy_config(),
+            num_workers=max(config.num_rollout_workers, 1),
+            seed=config.seed,
+            num_cpus_per_worker=config.num_cpus_per_worker)
+        self.setup(config)
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return cls._default_config_class(algo_class=cls)
+
+    def setup(self, config: AlgorithmConfig) -> None:
+        """Algorithm-specific initialization (optimizers etc.)."""
+
+    # -- Trainable protocol ---------------------------------------------
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.monotonic()
+        self.iteration += 1
+        results = self.training_step()
+        stats = self.workers.episode_stats()
+        results.update(stats)
+        results.update({
+            "training_iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+            "time_this_iter_s": time.monotonic() - t0,
+        })
+        return results
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def get_weights(self):
+        return self.local_policy.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.local_policy.set_weights(weights)
+
+    def compute_single_action(self, obs, explore: bool = False):
+        import jax
+        obs = np.asarray(obs, np.float32).reshape(1, -1)
+        if explore:
+            key = jax.random.PRNGKey(int(time.monotonic_ns()) % (2**31))
+            a, _, _ = self.local_policy.compute_actions(obs, key)
+            return a[0]
+        logits = self.local_policy.logits(
+            self.local_policy.params, obs)
+        if self.local_policy.discrete:
+            return int(np.asarray(logits).argmax(-1)[0])
+        return np.asarray(logits)[0]
+
+    def save(self, checkpoint_dir: Optional[str] = None) -> str:
+        import os
+        import tempfile
+        checkpoint_dir = checkpoint_dir or tempfile.mkdtemp(
+            prefix="rllib_ckpt_")
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump({
+                "weights": self.get_weights(),
+                "iteration": self.iteration,
+                "timesteps_total": self._timesteps_total,
+            }, f)
+        return checkpoint_dir
+
+    def restore(self, checkpoint_path: str) -> None:
+        import os
+        if os.path.isdir(checkpoint_path):
+            checkpoint_path = os.path.join(checkpoint_path,
+                                           "algorithm_state.pkl")
+        with open(checkpoint_path, "rb") as f:
+            state = pickle.load(f)
+        self.set_weights(state["weights"])
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps_total"]
+
+    def stop(self) -> None:
+        self.workers.stop()

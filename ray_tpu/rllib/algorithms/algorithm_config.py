@@ -1,0 +1,126 @@
+"""AlgorithmConfig: fluent configuration (reference:
+rllib/algorithms/algorithm_config.py — .environment()/.rollouts()/
+.training()/.framework()/.resources() chaining, frozen into an Algorithm).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Optional, Sequence, Type
+
+
+class AlgorithmConfig:
+    def __init__(self, algo_class: Optional[type] = None):
+        self.algo_class = algo_class
+        # environment
+        self.env: Any = None
+        self.env_config: Dict[str, Any] = {}
+        # rollouts
+        self.num_rollout_workers: int = 2
+        self.rollout_fragment_length: int = 256
+        self.num_cpus_per_worker: float = 1.0
+        # training
+        self.gamma: float = 0.99
+        self.lr: float = 5e-4
+        self.train_batch_size: int = 512
+        self.fcnet_hiddens: Sequence[int] = (64, 64)
+        self.seed: int = 0
+        # framework (always jax here; kept for API parity)
+        self.framework_str: str = "jax"
+        # algo-specific fields live on subclass-free dicts
+        self.extra: Dict[str, Any] = {}
+
+    # -- fluent sections -------------------------------------------------
+
+    def environment(self, env=None, *, env_config: Optional[dict] = None
+                    ) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def rollouts(self, *, num_rollout_workers: Optional[int] = None,
+                 rollout_fragment_length: Optional[int] = None,
+                 **_ignored) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self.num_rollout_workers = num_rollout_workers
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    env_runners = rollouts  # new-stack alias
+
+    def training(self, *, gamma: Optional[float] = None,
+                 lr: Optional[float] = None,
+                 train_batch_size: Optional[int] = None,
+                 model: Optional[dict] = None,
+                 **kwargs) -> "AlgorithmConfig":
+        if gamma is not None:
+            self.gamma = gamma
+        if lr is not None:
+            self.lr = lr
+        if train_batch_size is not None:
+            self.train_batch_size = train_batch_size
+        if model:
+            if "fcnet_hiddens" in model:
+                self.fcnet_hiddens = tuple(model["fcnet_hiddens"])
+        self.extra.update(kwargs)
+        return self
+
+    def framework(self, framework: str = "jax") -> "AlgorithmConfig":
+        if framework not in ("jax", "tf2", "torch"):
+            raise ValueError(framework)
+        self.framework_str = "jax"  # everything compiles to XLA here
+        return self
+
+    def resources(self, **_ignored) -> "AlgorithmConfig":
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None, **_ignored
+                  ) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def evaluation(self, **_ignored) -> "AlgorithmConfig":
+        return self
+
+    # -- build -----------------------------------------------------------
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {k: v for k, v in self.__dict__.items()
+             if k not in ("algo_class",)}
+        d.update(d.pop("extra"))
+        return d
+
+    def build(self, env=None):
+        if env is not None:
+            self.env = env
+        if self.algo_class is None:
+            raise ValueError("No algo_class bound to this config")
+        return self.algo_class(config=self)
+
+    def env_creator(self) -> Callable:
+        env = self.env
+        env_config = self.env_config
+
+        def create(cfg):
+            merged = {**env_config, **(cfg or {})}
+            if callable(env) and not isinstance(env, str):
+                return env(merged)
+            import gymnasium as gym
+            return gym.make(env)
+
+        return create
+
+    def policy_config(self) -> Dict[str, Any]:
+        return {
+            "gamma": self.gamma,
+            "lambda": self.extra.get("lambda", 0.95),
+            "fcnet_hiddens": tuple(self.fcnet_hiddens),
+            "env_config": self.env_config,
+        }

@@ -1,0 +1,127 @@
+"""PPO: clipped-surrogate policy optimization with a jitted JAX learner.
+
+Analog of the reference's rllib/algorithms/ppo (torch loss in
+ppo_torch_policy.py): sample via WorkerSet, normalize advantages, run
+several epochs of minibatch SGD on the jit-compiled clipped surrogate +
+value + entropy loss. On TPU the update jits onto the chip; scaling to a
+learner mesh is `pjit` over the batch axis (the reference's multi-GPU
+learner thread equivalent, SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or PPO)
+        self.clip_param = 0.2
+        self.num_sgd_iter = 8
+        self.sgd_minibatch_size = 128
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.kl_target = 0.02
+        self.lambda_ = 0.95
+        self.lr = 3e-4
+
+    def training(self, *, clip_param=None, num_sgd_iter=None,
+                 sgd_minibatch_size=None, vf_loss_coeff=None,
+                 entropy_coeff=None, **kwargs) -> "PPOConfig":
+        super().training(**kwargs)
+        if clip_param is not None:
+            self.clip_param = clip_param
+        if num_sgd_iter is not None:
+            self.num_sgd_iter = num_sgd_iter
+        if sgd_minibatch_size is not None:
+            self.sgd_minibatch_size = sgd_minibatch_size
+        if vf_loss_coeff is not None:
+            self.vf_loss_coeff = vf_loss_coeff
+        if entropy_coeff is not None:
+            self.entropy_coeff = entropy_coeff
+        return self
+
+
+class PPO(Algorithm):
+    _default_config_class = PPOConfig
+
+    def setup(self, config: PPOConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        policy = self.local_policy
+        self._optimizer = optax.adam(config.lr)
+        self._opt_state = self._optimizer.init(policy.params)
+        clip = config.clip_param
+        vf_coeff = config.vf_loss_coeff
+        ent_coeff = config.entropy_coeff
+
+        def loss_fn(params, mb):
+            logp = policy.logp(params, mb["obs"], mb["actions"])
+            ratio = jnp.exp(logp - mb["old_logp"])
+            adv = mb["advantages"]
+            surrogate = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+            values = policy._value(params, mb["obs"])
+            vf_loss = jnp.mean((values - mb["value_targets"]) ** 2)
+            entropy = jnp.mean(policy.entropy(params, mb["obs"]))
+            total = (-jnp.mean(surrogate) + vf_coeff * vf_loss
+                     - ent_coeff * entropy)
+            approx_kl = jnp.mean(mb["old_logp"] - logp)
+            return total, {"policy_loss": -jnp.mean(surrogate),
+                           "vf_loss": vf_loss, "entropy": entropy,
+                           "approx_kl": approx_kl}
+
+        def update(params, opt_state, mb):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        self._update_jit = jax.jit(update)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        config: PPOConfig = self.config
+        weights_ref = __import__("ray_tpu").put(self.get_weights())
+        self.workers.sync_weights(weights_ref)
+        per_worker = max(
+            config.train_batch_size // self.workers.num_workers(), 1)
+        batch = self.workers.sample(per_worker)
+        self._timesteps_total += len(batch)
+
+        adv = batch[SampleBatch.ADVANTAGES]
+        adv = (adv - adv.mean()) / max(adv.std(), 1e-6)
+        train_arrays = {
+            "obs": batch[SampleBatch.OBS].astype(np.float32),
+            "actions": batch[SampleBatch.ACTIONS],
+            "old_logp": batch[SampleBatch.ACTION_LOGP].astype(np.float32),
+            "advantages": adv.astype(np.float32),
+            "value_targets":
+                batch[SampleBatch.VALUE_TARGETS].astype(np.float32),
+        }
+        sb = SampleBatch(train_arrays)
+        params = self.local_policy.params
+        opt_state = self._opt_state
+        last_metrics: Dict[str, Any] = {}
+        mb_size = min(config.sgd_minibatch_size, len(sb))
+        for epoch in range(config.num_sgd_iter):
+            for mb in sb.minibatches(mb_size, seed=epoch):
+                device_mb = {k: jnp.asarray(v) for k, v in mb.items()}
+                params, opt_state, metrics = self._update_jit(
+                    params, opt_state, device_mb)
+                last_metrics = metrics
+        self.local_policy.params = params
+        self._opt_state = opt_state
+        return {k: float(v) for k, v in last_metrics.items()}

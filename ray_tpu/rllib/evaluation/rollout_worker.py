@@ -1,0 +1,113 @@
+"""RolloutWorker: environment-sampling actor.
+
+Analog of the reference's rllib/evaluation/rollout_worker.py:165 (sample
+:878): owns env instances + a policy copy, steps them for
+rollout_fragment_length, postprocesses with GAE, returns a SampleBatch.
+Created as actors by WorkerSet; weights sync via set_weights before every
+sampling round.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.policy.jax_policy import JAXPolicy, compute_gae
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+def _make_env(env_creator, env_config):
+    env = env_creator(env_config or {})
+    return env
+
+
+class RolloutWorker:
+    def __init__(self, env_creator: Callable, policy_config: Dict[str, Any],
+                 worker_index: int = 0, seed: int = 0):
+        import jax
+        self.env = _make_env(env_creator, policy_config.get("env_config"))
+        obs_space = self.env.observation_space
+        self.policy = JAXPolicy(
+            obs_dim=int(np.prod(obs_space.shape)),
+            action_space=self.env.action_space,
+            hiddens=policy_config.get("fcnet_hiddens", (64, 64)),
+            seed=seed + worker_index,
+        )
+        self.gamma = policy_config.get("gamma", 0.99)
+        self.lam = policy_config.get("lambda", 0.95)
+        self.worker_index = worker_index
+        self._key = jax.random.PRNGKey(1000 + seed + worker_index)
+        self._obs, _ = self.env.reset(seed=seed + worker_index)
+        self._eps_id = worker_index * 1_000_000
+        self._episode_reward = 0.0
+        self._episode_len = 0
+        self.completed_rewards: list = []
+        self.completed_lengths: list = []
+
+    def set_weights(self, weights) -> bool:
+        self.policy.set_weights(weights)
+        return True
+
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    def sample(self, num_steps: int) -> SampleBatch:
+        import jax
+        rows = {k: [] for k in (
+            SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.REWARDS,
+            SampleBatch.TERMINATEDS, SampleBatch.TRUNCATEDS,
+            SampleBatch.ACTION_LOGP, SampleBatch.VF_PREDS,
+            SampleBatch.EPS_ID)}
+        for _ in range(num_steps):
+            obs = np.asarray(self._obs, np.float32).reshape(-1)
+            self._key, sub = jax.random.split(self._key)
+            action, logp, value = self.policy.compute_actions(
+                obs[None], sub)
+            act_env = (int(action[0]) if self.policy.discrete
+                       else np.asarray(action[0]))
+            nxt, reward, terminated, truncated, _ = self.env.step(act_env)
+            rows[SampleBatch.OBS].append(obs)
+            rows[SampleBatch.ACTIONS].append(action[0])
+            rows[SampleBatch.REWARDS].append(np.float32(reward))
+            rows[SampleBatch.TERMINATEDS].append(np.float32(terminated))
+            rows[SampleBatch.TRUNCATEDS].append(np.float32(truncated))
+            rows[SampleBatch.ACTION_LOGP].append(logp[0])
+            rows[SampleBatch.VF_PREDS].append(value[0])
+            rows[SampleBatch.EPS_ID].append(self._eps_id)
+            self._episode_reward += float(reward)
+            self._episode_len += 1
+            if terminated or truncated:
+                self.completed_rewards.append(self._episode_reward)
+                self.completed_lengths.append(self._episode_len)
+                self._episode_reward = 0.0
+                self._episode_len = 0
+                self._eps_id += 1
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nxt
+        batch = SampleBatch(rows)
+        # GAE per episode fragment; bootstrap truncated/continuing tails.
+        fragments = []
+        for frag in batch.split_by_episode():
+            last_terminated = frag[SampleBatch.TERMINATEDS][-1] > 0
+            if last_terminated:
+                last_value = 0.0
+            else:
+                bootstrap_obs = np.asarray(self._obs, np.float32).reshape(-1)
+                last_value = float(self.policy.compute_values(
+                    bootstrap_obs[None])[0])
+            fragments.append(compute_gae(frag, self.gamma, self.lam,
+                                         last_value))
+        return SampleBatch.concat_samples(fragments)
+
+    def episode_stats(self, window: int = 100) -> Dict[str, float]:
+        rewards = self.completed_rewards[-window:]
+        lengths = self.completed_lengths[-window:]
+        return {
+            "episodes": len(self.completed_rewards),
+            "episode_reward_mean": float(np.mean(rewards)) if rewards
+            else float("nan"),
+            "episode_len_mean": float(np.mean(lengths)) if lengths
+            else float("nan"),
+        }

@@ -1,0 +1,140 @@
+"""JAXPolicy: actor-critic policy as pure pytree params + jitted functions.
+
+The TPU-native replacement for the reference's rllib/policy/torch_policy_v2
+(SURVEY.md §2.6: "JAX policy + learner"): an MLP torso with policy and value
+heads, categorical (Discrete) or diagonal-gaussian (Box) action
+distributions, fully functional (params in, actions/losses out) so the
+learner jits/pjits the update and rollout workers run the same apply
+function with device_put weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+def _mlp_init(key, sizes: Sequence[int]):
+    params = []
+    for i in range(len(sizes) - 1):
+        key, k1, k2 = jax.random.split(key, 3)
+        w = jax.random.normal(k1, (sizes[i], sizes[i + 1])) * jnp.sqrt(
+            2.0 / sizes[i])
+        b = jnp.zeros((sizes[i + 1],))
+        params.append({"w": w, "b": b})
+    return params
+
+
+def _mlp_apply(params, x, activate_last=False):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1 or activate_last:
+            x = jnp.tanh(x)
+    return x
+
+
+class JAXPolicy:
+    """Holds params + jitted fns. Not itself an actor — rollout workers and
+    the learner each own one."""
+
+    def __init__(self, obs_dim: int, action_space: Any,
+                 hiddens: Sequence[int] = (64, 64), seed: int = 0):
+        import gymnasium as gym
+        self.obs_dim = obs_dim
+        self.action_space = action_space
+        self.discrete = isinstance(action_space, gym.spaces.Discrete)
+        self.act_dim = (int(action_space.n) if self.discrete
+                        else int(np.prod(action_space.shape)))
+        key = jax.random.PRNGKey(seed)
+        k_pi, k_vf, k_logstd = jax.random.split(key, 3)
+        out = self.act_dim
+        self.params = {
+            "pi": _mlp_init(k_pi, [obs_dim, *hiddens, out]),
+            "vf": _mlp_init(k_vf, [obs_dim, *hiddens, 1]),
+        }
+        if not self.discrete:
+            self.params["log_std"] = jnp.zeros((self.act_dim,))
+        self._sample_jit = jax.jit(self._sample)
+        self._value_jit = jax.jit(self._value)
+
+    # -- functional core -------------------------------------------------
+
+    def logits(self, params, obs):
+        return _mlp_apply(params["pi"], obs)
+
+    def _value(self, params, obs):
+        return _mlp_apply(params["vf"], obs)[..., 0]
+
+    def logp(self, params, obs, actions):
+        logits = self.logits(params, obs)
+        if self.discrete:
+            logp_all = jax.nn.log_softmax(logits)
+            return jnp.take_along_axis(
+                logp_all, actions[..., None].astype(jnp.int32), -1)[..., 0]
+        log_std = params["log_std"]
+        var = jnp.exp(2 * log_std)
+        return (-0.5 * (((actions - logits) ** 2) / var
+                        + 2 * log_std + jnp.log(2 * jnp.pi))).sum(-1)
+
+    def entropy(self, params, obs):
+        logits = self.logits(params, obs)
+        if self.discrete:
+            p = jax.nn.softmax(logits)
+            return -(p * jax.nn.log_softmax(logits)).sum(-1)
+        return (params["log_std"] + 0.5 * jnp.log(2 * jnp.pi * jnp.e)).sum()
+
+    def _sample(self, params, obs, key):
+        logits = self.logits(params, obs)
+        value = self._value(params, obs)
+        if self.discrete:
+            action = jax.random.categorical(key, logits)
+            logp = jax.nn.log_softmax(logits)[
+                jnp.arange(obs.shape[0]), action]
+            return action, logp, value
+        std = jnp.exp(params["log_std"])
+        noise = jax.random.normal(key, logits.shape)
+        action = logits + std * noise
+        logp = self.logp(params, obs, action)
+        return action, logp, value
+
+    # -- worker-side API -------------------------------------------------
+
+    def compute_actions(self, obs: np.ndarray, key) -> Tuple[np.ndarray,
+                                                             np.ndarray,
+                                                             np.ndarray]:
+        a, logp, v = self._sample_jit(self.params, jnp.asarray(obs), key)
+        return np.asarray(a), np.asarray(logp), np.asarray(v)
+
+    def compute_values(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self._value_jit(self.params, jnp.asarray(obs)))
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
+def compute_gae(batch: SampleBatch, gamma: float = 0.99,
+                lam: float = 0.95, last_value: float = 0.0) -> SampleBatch:
+    """GAE(λ) advantages + value targets over one episode fragment
+    (reference: rllib/evaluation/postprocessing.py compute_advantages)."""
+    rewards = batch[SampleBatch.REWARDS].astype(np.float64)
+    values = batch[SampleBatch.VF_PREDS].astype(np.float64)
+    terminated = batch[SampleBatch.TERMINATEDS]
+    n = len(rewards)
+    next_values = np.append(values[1:], last_value)
+    deltas = rewards + gamma * next_values * (1 - terminated) - values
+    adv = np.zeros(n)
+    acc = 0.0
+    for t in reversed(range(n)):
+        acc = deltas[t] + gamma * lam * (1 - terminated[t]) * acc
+        adv[t] = acc
+    batch[SampleBatch.ADVANTAGES] = adv.astype(np.float32)
+    batch[SampleBatch.VALUE_TARGETS] = (adv + values).astype(np.float32)
+    return batch

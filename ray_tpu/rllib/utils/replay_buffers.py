@@ -1,0 +1,76 @@
+"""Replay buffers (reference: rllib/utils/replay_buffers/replay_buffer.py):
+uniform ReplayBuffer + PrioritizedReplayBuffer over SampleBatch storage."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int = 100_000, seed: Optional[int] = None):
+        self.capacity = capacity
+        self._rows: List[dict] = []
+        self._next = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def add(self, batch: SampleBatch) -> None:
+        for i in range(len(batch)):
+            row = {k: v[i] for k, v in batch.items()}
+            if len(self._rows) < self.capacity:
+                self._rows.append(row)
+            else:
+                self._rows[self._next] = row
+                self._next = (self._next + 1) % self.capacity
+
+    def sample(self, num_items: int) -> SampleBatch:
+        idx = self._rng.integers(0, len(self._rows), num_items)
+        keys = self._rows[0].keys()
+        return SampleBatch(
+            {k: np.stack([self._rows[i][k] for i in idx]) for k in keys})
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritization (alpha) with IS weights (beta)."""
+
+    def __init__(self, capacity: int = 100_000, alpha: float = 0.6,
+                 seed: Optional[int] = None):
+        super().__init__(capacity, seed)
+        self.alpha = alpha
+        self._priorities: List[float] = []
+        self._max_priority = 1.0
+
+    def add(self, batch: SampleBatch) -> None:
+        for i in range(len(batch)):
+            row = {k: v[i] for k, v in batch.items()}
+            if len(self._rows) < self.capacity:
+                self._rows.append(row)
+                self._priorities.append(self._max_priority)
+            else:
+                self._rows[self._next] = row
+                self._priorities[self._next] = self._max_priority
+                self._next = (self._next + 1) % self.capacity
+
+    def sample(self, num_items: int, beta: float = 0.4) -> SampleBatch:
+        pri = np.asarray(self._priorities) ** self.alpha
+        probs = pri / pri.sum()
+        idx = self._rng.choice(len(self._rows), num_items, p=probs)
+        weights = (len(self._rows) * probs[idx]) ** (-beta)
+        weights = weights / weights.max()
+        keys = self._rows[0].keys()
+        out = SampleBatch(
+            {k: np.stack([self._rows[i][k] for i in idx]) for k in keys})
+        out["weights"] = weights.astype(np.float32)
+        out["batch_indexes"] = idx
+        return out
+
+    def update_priorities(self, idx, priorities) -> None:
+        for i, p in zip(idx, priorities):
+            self._priorities[int(i)] = float(abs(p)) + 1e-6
+            self._max_priority = max(self._max_priority, self._priorities[int(i)])

@@ -1,0 +1,229 @@
+"""ray_tpu.workflow: durable DAG execution.
+
+Analog of the reference's python/ray/workflow (workflow_executor.py,
+workflow_storage.py, workflow_state_from_dag.py): a Ray DAG
+(ray_tpu/dag) runs with every task's result checkpointed to storage; a
+crashed/cancelled workflow resumes from the last completed task instead of
+recomputing. Task identity is the node's position in the DAG (stable
+topological naming), so resume replays structure, not uuids.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.dag import DAGNode, FunctionNode, InputAttributeNode, InputNode
+
+__all__ = ["init", "run", "run_async", "resume", "get_output", "get_status",
+           "list_all", "delete", "cancel"]
+
+_storage_dir: Optional[str] = None
+
+# Workflow statuses (reference: workflow/common.py WorkflowStatus).
+RUNNING = "RUNNING"
+SUCCESSFUL = "SUCCESSFUL"
+FAILED = "FAILED"
+CANCELED = "CANCELED"
+RESUMABLE = "RESUMABLE"
+
+
+def init(storage: Optional[str] = None) -> None:
+    global _storage_dir
+    if storage is None:
+        storage = os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "ray_tpu_workflows")
+    _storage_dir = storage
+    os.makedirs(storage, exist_ok=True)
+
+
+def _storage() -> str:
+    if _storage_dir is None:
+        init()
+    return _storage_dir
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(_storage(), workflow_id)
+
+
+def _task_key(node: DAGNode, counter: Dict[str, int]) -> str:
+    """Stable name: class name + topological visit index."""
+    base = type(node).__name__
+    if isinstance(node, FunctionNode):
+        base = node.fn._function.__name__
+    idx = counter.get(base, 0)
+    counter[base] = idx + 1
+    return f"{base}_{idx}"
+
+
+class _WorkflowStorage:
+    def __init__(self, workflow_id: str):
+        self.workflow_id = workflow_id
+        self.dir = _wf_dir(workflow_id)
+        os.makedirs(os.path.join(self.dir, "tasks"), exist_ok=True)
+
+    def save_dag(self, dag: DAGNode, input_value: Any) -> None:
+        import cloudpickle
+        with open(os.path.join(self.dir, "dag.pkl"), "wb") as f:
+            cloudpickle.dump({"dag": dag, "input": input_value}, f)
+
+    def load_dag(self) -> Tuple[DAGNode, Any]:
+        import cloudpickle
+        with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
+            data = cloudpickle.load(f)
+        return data["dag"], data["input"]
+
+    def set_status(self, status: str) -> None:
+        with open(os.path.join(self.dir, "status"), "w") as f:
+            f.write(status)
+
+    def get_status(self) -> Optional[str]:
+        try:
+            with open(os.path.join(self.dir, "status")) as f:
+                return f.read().strip()
+        except FileNotFoundError:
+            return None
+
+    def has_task(self, key: str) -> bool:
+        return os.path.exists(
+            os.path.join(self.dir, "tasks", key + ".pkl"))
+
+    def save_task(self, key: str, value: Any) -> None:
+        path = os.path.join(self.dir, "tasks", key + ".pkl")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, path)  # atomic: no partial checkpoints on crash
+
+    def load_task(self, key: str) -> Any:
+        with open(os.path.join(self.dir, "tasks", key + ".pkl"),
+                  "rb") as f:
+            return pickle.load(f)
+
+    def save_output(self, value: Any) -> None:
+        self.save_task("__output__", value)
+
+    def load_output(self) -> Any:
+        return self.load_task("__output__")
+
+
+def _execute_node(node: DAGNode, storage: _WorkflowStorage,
+                  counter: Dict[str, int], cache: Dict[str, Any],
+                  input_value: Any) -> Any:
+    """Post-order execution with per-task checkpointing. Returns the node's
+    *value* (checkpointing forces materialization at each step, matching
+    the reference's per-task durability)."""
+    if node._stable_uuid in cache:
+        return cache[node._stable_uuid]
+    if isinstance(node, InputNode):
+        return input_value
+    if isinstance(node, InputAttributeNode):
+        value = _execute_node(node._parent, storage, counter, cache,
+                              input_value)
+        out = value[node._key] if node._is_item else getattr(
+            value, node._key)
+        cache[node._stable_uuid] = out
+        return out
+    if not isinstance(node, FunctionNode):
+        raise TypeError(
+            f"Workflows support function DAGs; got {type(node).__name__} "
+            "(actor nodes are not durable)")
+    key = _task_key(node, counter)
+    # Resolve children first so their keys are assigned deterministically
+    # even on the resume path.
+    args = [
+        _execute_node(a, storage, counter, cache, input_value)
+        if isinstance(a, DAGNode) else a for a in node.bound_args]
+    kwargs = {
+        k: _execute_node(v, storage, counter, cache, input_value)
+        if isinstance(v, DAGNode) else v
+        for k, v in node.bound_kwargs.items()}
+    if storage.has_task(key):
+        result = storage.load_task(key)
+    else:
+        result = ray_tpu.get(node.fn.remote(*args, **kwargs))
+        storage.save_task(key, result)
+    cache[node._stable_uuid] = result
+    return result
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        input_value: Any = None) -> Any:
+    """Execute a DAG durably; returns the output (reference:
+    workflow.run)."""
+    return ray_tpu.get(run_async(dag, workflow_id=workflow_id,
+                                 input_value=input_value))
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              input_value: Any = None):
+    """Returns an ObjectRef of the workflow output."""
+    import uuid as uuid_mod
+    workflow_id = workflow_id or f"workflow-{uuid_mod.uuid4().hex[:8]}"
+    storage = _WorkflowStorage(workflow_id)
+    storage.save_dag(dag, input_value)
+    storage.set_status(RUNNING)
+
+    @ray_tpu.remote
+    def _driver(wf_id: str):
+        st = _WorkflowStorage(wf_id)
+        dag, input_value = st.load_dag()
+        try:
+            out = _execute_node(dag, st, {}, {}, input_value)
+            st.save_output(out)
+            st.set_status(SUCCESSFUL)
+            return out
+        except BaseException:
+            st.set_status(FAILED)
+            raise
+
+    return _driver.remote(workflow_id)
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run from storage; completed tasks load from checkpoints."""
+    storage = _WorkflowStorage(workflow_id)
+    dag, input_value = storage.load_dag()
+    storage.set_status(RUNNING)
+    try:
+        out = _execute_node(dag, storage, {}, {}, input_value)
+        storage.save_output(out)
+        storage.set_status(SUCCESSFUL)
+        return out
+    except BaseException:
+        storage.set_status(FAILED)
+        raise
+
+
+def get_output(workflow_id: str) -> Any:
+    return _WorkflowStorage(workflow_id).load_output()
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    return _WorkflowStorage(workflow_id).get_status()
+
+
+def list_all(status_filter: Optional[str] = None
+             ) -> List[Tuple[str, Optional[str]]]:
+    out = []
+    root = _storage()
+    for wf_id in sorted(os.listdir(root)):
+        if not os.path.isdir(os.path.join(root, wf_id)):
+            continue
+        status = _WorkflowStorage(wf_id).get_status()
+        if status_filter is None or status == status_filter:
+            out.append((wf_id, status))
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    import shutil
+    shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)
+
+
+def cancel(workflow_id: str) -> None:
+    _WorkflowStorage(workflow_id).set_status(CANCELED)

@@ -1,0 +1,132 @@
+"""Tests for the DAG API + workflow durability (model: reference
+python/ray/dag/tests, workflow/tests)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+
+
+def test_function_dag_execute(ray_start_regular):
+    @ray_tpu.remote
+    def a(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def b(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def combine(x, y):
+        return x + y
+
+    with InputNode() as inp:
+        dag = combine.bind(a.bind(inp), b.bind(inp))
+    assert ray_tpu.get(dag.execute(10)) == 31  # (10+1) + (10*2)
+
+
+def test_shared_node_executes_once(ray_start_regular):
+    calls = []
+
+    @ray_tpu.remote
+    def source():
+        import time
+        return time.monotonic_ns()
+
+    @ray_tpu.remote
+    def identity(x):
+        return x
+
+    src = source.bind()
+    with InputNode() as inp:
+        pass
+    @ray_tpu.remote
+    def pair(x, y):
+        return (x, y)
+    dag = pair.bind(identity.bind(src), identity.bind(src))
+    x, y = ray_tpu.get(dag.execute())
+    assert x == y  # diamond dependency ran once
+
+
+def test_class_node_dag(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    counter = Counter.bind(100)
+    dag = counter.add.bind(5)
+    assert ray_tpu.get(dag.execute()) == 105
+
+
+def test_workflow_run_and_status(ray_start_regular, tmp_path):
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def add(x, y):
+        return x + y
+
+    dag = add.bind(double.bind(3), double.bind(4))
+    out = workflow.run(dag, workflow_id="wf1")
+    assert out == 14
+    assert workflow.get_status("wf1") == workflow.SUCCESSFUL
+    assert workflow.get_output("wf1") == 14
+    assert ("wf1", "SUCCESSFUL") in workflow.list_all()
+
+
+def test_workflow_resume_skips_completed(ray_start_regular, tmp_path):
+    workflow.init(str(tmp_path))
+    marker = tmp_path / "side_effects.txt"
+
+    @ray_tpu.remote
+    def step_one():
+        with open(marker, "a") as f:
+            f.write("one\n")
+        return 1
+
+    @ray_tpu.remote
+    def flaky(x):
+        flag = marker.parent / "fail_flag"
+        if flag.exists():
+            raise RuntimeError("injected failure")
+        return x + 100
+
+    (tmp_path / "fail_flag").touch()
+    dag = flaky.bind(step_one.bind())
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="wf2")
+    assert workflow.get_status("wf2") == workflow.FAILED
+    # step_one committed its checkpoint before the failure.
+    (tmp_path / "fail_flag").unlink()
+    out = workflow.resume("wf2")
+    assert out == 101
+    # step_one ran exactly once across both attempts.
+    assert open(marker).read().count("one") == 1
+    assert workflow.get_status("wf2") == workflow.SUCCESSFUL
+
+
+def test_workflow_input_and_delete(ray_start_regular, tmp_path):
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def scale(x, factor):
+        return x * factor
+
+    with InputNode() as inp:
+        dag = scale.bind(inp["value"], inp["factor"])
+    out = workflow.run(dag, workflow_id="wf3",
+                       input_value={"value": 6, "factor": 7})
+    assert out == 42
+    workflow.delete("wf3")
+    assert ("wf3", workflow.SUCCESSFUL) not in workflow.list_all()
