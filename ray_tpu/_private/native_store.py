@@ -1,0 +1,199 @@
+"""Python binding for the native shared-memory object store.
+
+ctypes wrapper over src/ray_tpu_native/shm_store.cc (the plasma analog —
+reference: src/ray/object_manager/plasma/client.cc). Large numpy arrays are
+written once into the shm arena and read back as ZERO-COPY numpy views over
+the mapping; `jax.device_put` on such a view is the host→TPU transfer with
+no intermediate host copy.
+
+The library builds on demand with g++ (no pip deps); if no compiler is
+available the caller falls back to the pure-Python store.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import subprocess
+import threading
+import uuid
+from typing import Optional, Tuple
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "src", "ray_tpu_native")
+_BUILD_DIR = os.path.join(os.path.dirname(_SRC), "..", "build")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build_library() -> Optional[str]:
+    src = os.path.join(_SRC, "shm_store.cc")
+    if not os.path.exists(src):
+        return None
+    build_dir = os.path.abspath(_BUILD_DIR)
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, "libshm_store.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", out, src,
+             "-lrt"],
+            check=True, capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return None
+    return out
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = _build_library()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        lib.shm_store_open.restype = ctypes.c_void_p
+        lib.shm_store_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                       ctypes.c_int]
+        lib.shm_store_close.argtypes = [ctypes.c_void_p]
+        lib.shm_store_unlink.argtypes = [ctypes.c_void_p]
+        lib.shm_store_create.restype = ctypes.c_int64
+        lib.shm_store_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_uint64]
+        lib.shm_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_get.restype = ctypes.c_int64
+        lib.shm_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.POINTER(ctypes.c_uint64)]
+        lib.shm_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_used_bytes.restype = ctypes.c_uint64
+        lib.shm_store_used_bytes.argtypes = [ctypes.c_void_p]
+        lib.shm_store_num_objects.restype = ctypes.c_uint64
+        lib.shm_store_num_objects.argtypes = [ctypes.c_void_p]
+        lib.shm_store_write.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.c_char_p, ctypes.c_uint64]
+        _lib = lib
+        return _lib
+
+
+def native_store_available() -> bool:
+    return _load() is not None
+
+
+class NativeObjectStore:
+    """One shm arena. put/get numpy arrays (zero-copy reads) or raw bytes."""
+
+    def __init__(self, capacity: int = 1 << 30, name: Optional[str] = None,
+                 create: bool = True):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native store library unavailable")
+        self._lib = lib
+        self.name = name or f"/ray_tpu_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+        self.capacity = capacity
+        self._handle = lib.shm_store_open(self.name.encode(), capacity,
+                                          1 if create else 0)
+        if not self._handle:
+            raise RuntimeError(f"failed to open shm store {self.name}")
+        # Map the arena read-only in Python for zero-copy views. When
+        # attaching, the real size comes from the file (the creator chose
+        # the capacity).
+        fd = os.open(f"/dev/shm{self.name}", os.O_RDONLY)
+        try:
+            real_size = os.fstat(fd).st_size
+            self.capacity = real_size
+            self._map = mmap.mmap(fd, real_size, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        self._closed = False
+
+    # -- raw bytes -------------------------------------------------------
+
+    def put_bytes(self, object_id: str, payload: bytes) -> bool:
+        oid = object_id.encode()
+        off = self._lib.shm_store_create(self._handle, oid, len(payload))
+        if off == -2:
+            return True  # already stored (idempotent puts)
+        if off < 0:
+            return False
+        self._lib.shm_store_write(self._handle, off, payload, len(payload))
+        self._lib.shm_store_seal(self._handle, oid)
+        return True
+
+    def get_bytes(self, object_id: str) -> Optional[memoryview]:
+        """Zero-copy view; caller must release(object_id) when done."""
+        size = ctypes.c_uint64()
+        off = self._lib.shm_store_get(self._handle, object_id.encode(),
+                                      ctypes.byref(size))
+        if off < 0:
+            return None
+        return memoryview(self._map)[off:off + size.value]
+
+    # -- numpy arrays ----------------------------------------------------
+
+    def put_array(self, object_id: str, arr: np.ndarray) -> bool:
+        """Header (dtype/shape) + raw buffer in one allocation."""
+        arr = np.ascontiguousarray(arr)
+        header = f"{arr.dtype.str}|{','.join(map(str, arr.shape))}".encode()
+        meta = len(header).to_bytes(4, "little") + header
+        payload = meta + arr.tobytes()
+        return self.put_bytes(object_id, payload)
+
+    def get_array(self, object_id: str) -> Optional[np.ndarray]:
+        """Returns a READ-ONLY zero-copy view into shared memory."""
+        view = self.get_bytes(object_id)
+        if view is None:
+            return None
+        hlen = int.from_bytes(view[:4], "little")
+        dtype_str, shape_str = bytes(view[4:4 + hlen]).decode().split("|")
+        shape = tuple(int(x) for x in shape_str.split(",")) if shape_str \
+            else ()
+        data = view[4 + hlen:]
+        arr = np.frombuffer(data, dtype=np.dtype(dtype_str)).reshape(shape)
+        return arr
+
+    # -- lifecycle -------------------------------------------------------
+
+    def contains(self, object_id: str) -> bool:
+        return bool(self._lib.shm_store_contains(self._handle,
+                                                 object_id.encode()))
+
+    def release(self, object_id: str) -> None:
+        self._lib.shm_store_release(self._handle, object_id.encode())
+
+    def delete(self, object_id: str) -> bool:
+        return self._lib.shm_store_delete(self._handle,
+                                          object_id.encode()) == 0
+
+    def used_bytes(self) -> int:
+        return self._lib.shm_store_used_bytes(self._handle)
+
+    def num_objects(self) -> int:
+        return self._lib.shm_store_num_objects(self._handle)
+
+    def close(self, unlink: bool = True) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if unlink:
+            self._lib.shm_store_unlink(self._handle)
+        self._lib.shm_store_close(self._handle)
+        try:
+            self._map.close()
+        except BufferError:
+            # Zero-copy views are still alive; the mapping is reclaimed
+            # when they are garbage collected (the unlink above already
+            # removed the name).
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter shutdown
+            pass

@@ -21,6 +21,7 @@ store.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -38,16 +39,44 @@ class _Entry:
     deserialized: bool = False
     is_exception: bool = False
     freed: bool = False
+    in_native: bool = False
     size_bytes: int = 0
     create_time: float = 0.0
 
 
 class ObjectStore:
-    def __init__(self, deserializer: Optional[Callable[[bytes], Any]] = None):
+    # Arrays at or above this size go to the native shm store (plasma
+    # analog); below it, inline references win (same address space).
+    NATIVE_THRESHOLD = 1 << 20
+
+    def __init__(self, deserializer: Optional[Callable[[bytes], Any]] = None,
+                 native_capacity: int = 0):
         self._entries: Dict[ObjectID, _Entry] = {}
         self._lock = threading.Lock()
         self._deserializer = deserializer
         self._total_bytes = 0
+        self._native = None
+        if native_capacity > 0 and os.environ.get(
+                "RAY_TPU_NATIVE_STORE", "1") != "0":
+            try:
+                from ray_tpu._private.native_store import NativeObjectStore
+                self._native = NativeObjectStore(capacity=native_capacity)
+            except Exception:  # noqa: BLE001 - no compiler: pure-Python path
+                self._native = None
+
+    @property
+    def native(self):
+        return self._native
+
+    def _try_put_native(self, object_id: ObjectID, value: Any) -> bool:
+        """Large contiguous numpy arrays go to the shm arena; gets return
+        zero-copy read-only views (reference: plasma put/get of tensors)."""
+        import numpy as np
+        if self._native is None or not isinstance(value, np.ndarray):
+            return False
+        if value.nbytes < self.NATIVE_THRESHOLD or value.dtype == object:
+            return False
+        return self._native.put_array(object_id.hex(), value)
 
     def set_deserializer(self, fn: Callable[[bytes], Any]) -> None:
         self._deserializer = fn
@@ -65,13 +94,19 @@ class ObjectStore:
     def put_inline(self, object_id: ObjectID, value: Any,
                    is_exception: bool = False) -> None:
         entry = self._entry(object_id)
+        in_native = (not is_exception
+                     and self._try_put_native(object_id, value))
         with self._lock:
             # Objects are immutable once sealed (plasma semantics): first
             # write wins, racing writers (e.g. a completing task vs. a kill
             # sealing errors) are dropped.
             if entry.event.is_set():
                 return
-            entry.value = value
+            if in_native:
+                entry.in_native = True
+                entry.size_bytes = value.nbytes
+            else:
+                entry.value = value
             entry.deserialized = True
             entry.is_exception = is_exception
             entry.create_time = time.time()
@@ -116,6 +151,19 @@ class ObjectStore:
         if entry.freed:
             raise ObjectFreedError(
                 f"Object {object_id.hex()} was freed and is no longer available.")
+        if entry.in_native:
+            # First get pins the object (one store-held reference) and
+            # caches the zero-copy view; eviction can't touch it until
+            # free(). Reference: plasma client Get holds a buffer ref.
+            if entry.value is None:
+                arr = self._native.get_array(object_id.hex()) \
+                    if self._native is not None else None
+                if arr is None:
+                    raise ObjectLostError(
+                        f"Object {object_id.hex()} was evicted from the "
+                        "shared-memory store.")
+                entry.value = arr
+            return entry.value
         if not entry.deserialized:
             if self._deserializer is None:
                 raise ObjectLostError(object_id.hex())
@@ -143,6 +191,10 @@ class ObjectStore:
                 entry = self._entries.get(oid)
                 if entry is not None:
                     entry.freed = True
+                    if entry.in_native and self._native is not None:
+                        if entry.value is not None:
+                            self._native.release(oid.hex())
+                        self._native.delete(oid.hex())
                     entry.value = None
                     self._total_bytes -= entry.size_bytes
                     entry.serialized = None

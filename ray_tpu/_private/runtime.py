@@ -242,7 +242,11 @@ class Runtime:
         self.session_id = uuid.uuid4().hex
         self.job_id = job_id
         self.node_resources = node_resources
-        self.store = ObjectStore(deserializer=serialization.deserialize)
+        # Shared-memory arena sized like the reference's object store
+        # (30% of memory, services.py object_store_memory default).
+        self.store = ObjectStore(
+            deserializer=serialization.deserialize,
+            native_capacity=int(node_resources.memory_bytes * 0.3))
         self.scheduler = ResourceScheduler(node_resources.to_resource_map())
         self.functions = FunctionTable()
         self._lock = threading.RLock()
@@ -1088,3 +1092,5 @@ class Runtime:
         self.store.fail_all_pending(
             RayError("The runtime was shut down while this object was "
                      "still pending."))
+        if self.store.native is not None:
+            self.store.native.close()

@@ -1,0 +1,486 @@
+// shm_store.cc — single-host shared-memory object store (C ABI).
+//
+// TPU-native equivalent of the reference's plasma store
+// (src/ray/object_manager/plasma/store.h:55, plasma_allocator.cc,
+// eviction_policy.cc): one POSIX shared-memory arena per node holding BOTH
+// object payloads and ALL store metadata (entry table, free list, LRU
+// chain, process-shared mutex), so any process on the host maps the same
+// file and gets the same store — no broker process or socket protocol in
+// the loop (plasma needs one because its metadata lives in the store
+// server; putting metadata in the arena removes that hop).
+//
+// Layout:  [ Header | EntryTable | FreeBlockPool | data region ]
+// - Entry table: open-addressing hash (linear probe, tombstones).
+// - Allocator: first-fit over a shm-resident free-block list, coalescing.
+// - Eviction: LRU over sealed refcount-0 entries, evicted under pressure.
+// - Locking: one pthread process-shared robust mutex in the header.
+//
+// The Python binding (ray_tpu/_private/native_store.py) wraps payload
+// offsets as zero-copy numpy views; jax.device_put on a view is the
+// host->TPU DMA with no intermediate copy.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 -o libshm_store.so shm_store.cc
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <pthread.h>
+#include <string>
+#include <sys/mman.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x5261795450553031ULL;  // "RayTPU01"
+constexpr uint32_t kMaxIdLen = 63;
+
+enum EntryState : uint8_t {
+  kEmpty = 0,
+  kCreated = 1,
+  kSealed = 2,
+  kTombstone = 3,
+};
+
+struct Entry {
+  char id[kMaxIdLen + 1];
+  uint8_t state;
+  uint8_t in_lru;
+  int32_t refcount;
+  uint64_t offset;
+  uint64_t size;        // payload size
+  uint64_t alloc_size;  // aligned allocation size
+  int32_t lru_prev;     // entry index or -1
+  int32_t lru_next;
+};
+
+struct FreeBlock {
+  uint64_t offset;
+  uint64_t size;
+  int32_t next;  // pool index or -1
+  uint8_t used;  // slot in use
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;
+  uint64_t data_off;
+  uint64_t data_size;
+  uint64_t used;
+  uint32_t max_objects;
+  uint32_t num_objects;
+  int32_t free_head;  // free-block list head (pool index)
+  int32_t lru_head;   // least-recently-used entry index
+  int32_t lru_tail;
+  pthread_mutex_t mu;
+};
+
+class ShmStore {
+ public:
+  ShmStore(const char* name, uint64_t capacity, bool create)
+      : name_(name) {
+    int flags = create ? (O_RDWR | O_CREAT | O_EXCL) : O_RDWR;
+    fd_ = shm_open(name, flags, 0600);
+    bool we_created = fd_ >= 0 && create;
+    if (fd_ < 0 && create) {  // exists: attach instead
+      fd_ = shm_open(name, O_RDWR, 0600);
+      we_created = false;
+    }
+    if (fd_ < 0) return;
+    if (we_created && ftruncate(fd_, (off_t)capacity) != 0) {
+      close(fd_);
+      fd_ = -1;
+      return;
+    }
+    if (!we_created) {
+      // Attach: read capacity from the header (map a page first).
+      void* probe = mmap(nullptr, sizeof(Header), PROT_READ, MAP_SHARED,
+                         fd_, 0);
+      if (probe == MAP_FAILED) {
+        close(fd_);
+        fd_ = -1;
+        return;
+      }
+      capacity = static_cast<Header*>(probe)->capacity;
+      munmap(probe, sizeof(Header));
+    }
+    capacity_ = capacity;
+    base_ = static_cast<uint8_t*>(mmap(nullptr, capacity,
+                                       PROT_READ | PROT_WRITE, MAP_SHARED,
+                                       fd_, 0));
+    if (base_ == MAP_FAILED) {
+      base_ = nullptr;
+      close(fd_);
+      fd_ = -1;
+      return;
+    }
+    hdr_ = reinterpret_cast<Header*>(base_);
+    if (we_created) Init();
+    entries_ = reinterpret_cast<Entry*>(base_ + sizeof(Header));
+    pool_ = reinterpret_cast<FreeBlock*>(
+        base_ + sizeof(Header) + sizeof(Entry) * hdr_->max_objects);
+  }
+
+  ~ShmStore() {
+    if (base_) munmap(base_, capacity_);
+    if (fd_ >= 0) close(fd_);
+  }
+
+  bool ok() const { return base_ != nullptr && hdr_->magic == kMagic; }
+  uint8_t* base() const { return base_; }
+  void unlink_shm() { shm_unlink(name_.c_str()); }
+
+  int64_t Create(const char* id, uint64_t size) {
+    size_t idlen = strnlen(id, kMaxIdLen + 1);
+    if (idlen > kMaxIdLen) return -3;
+    Lock l(hdr_);
+    int32_t idx = FindLocked(id);
+    if (idx >= 0) return -2;  // exists
+    uint64_t alloc = (size ? size : 1);
+    alloc = (alloc + 63) & ~uint64_t(63);
+    int64_t off = AllocLocked(alloc);
+    while (off < 0 && EvictOneLocked()) off = AllocLocked(alloc);
+    if (off < 0) return -1;
+    idx = InsertLocked(id);
+    if (idx < 0) {
+      FreeRegionLocked((uint64_t)off, alloc);
+      return -4;  // table full
+    }
+    Entry& e = entries_[idx];
+    e.state = kCreated;
+    e.refcount = 1;  // creator ref until seal
+    e.offset = (uint64_t)off;
+    e.size = size;
+    e.alloc_size = alloc;
+    e.in_lru = 0;
+    hdr_->used += alloc;
+    hdr_->num_objects++;
+    return off;
+  }
+
+  int Seal(const char* id) {
+    Lock l(hdr_);
+    int32_t idx = FindLocked(id);
+    if (idx < 0) return -1;
+    Entry& e = entries_[idx];
+    e.state = kSealed;
+    if (--e.refcount == 0) LruPushLocked(idx);
+    return 0;
+  }
+
+  int64_t Get(const char* id, uint64_t* size) {
+    Lock l(hdr_);
+    int32_t idx = FindLocked(id);
+    if (idx < 0) return -1;
+    Entry& e = entries_[idx];
+    if (e.state != kSealed) return -1;
+    LruPopLocked(idx);
+    e.refcount++;
+    *size = e.size;
+    return (int64_t)e.offset;
+  }
+
+  int Release(const char* id) {
+    Lock l(hdr_);
+    int32_t idx = FindLocked(id);
+    if (idx < 0) return -1;
+    Entry& e = entries_[idx];
+    if (e.refcount <= 0) return -1;
+    if (--e.refcount == 0 && e.state == kSealed) LruPushLocked(idx);
+    return 0;
+  }
+
+  int Delete(const char* id) {
+    Lock l(hdr_);
+    int32_t idx = FindLocked(id);
+    if (idx < 0) return -1;
+    if (entries_[idx].refcount > 0) return -2;
+    RemoveLocked(idx);
+    return 0;
+  }
+
+  int Contains(const char* id) {
+    Lock l(hdr_);
+    int32_t idx = FindLocked(id);
+    return idx >= 0 && entries_[idx].state == kSealed;
+  }
+
+  uint64_t UsedBytes() {
+    Lock l(hdr_);
+    return hdr_->used;
+  }
+
+  uint64_t NumObjects() {
+    Lock l(hdr_);
+    return hdr_->num_objects;
+  }
+
+ private:
+  struct Lock {
+    explicit Lock(Header* h) : h_(h) {
+      int rc = pthread_mutex_lock(&h->mu);
+      if (rc == EOWNERDEAD) pthread_mutex_consistent(&h->mu);
+    }
+    ~Lock() { pthread_mutex_unlock(&h_->mu); }
+    Header* h_;
+  };
+
+  void Init() {
+    memset(base_, 0, sizeof(Header));
+    hdr_->capacity = capacity_;
+    // Size the entry table to ~capacity/64KB objects, clamped.
+    uint32_t max_objects = (uint32_t)(capacity_ / 65536);
+    if (max_objects < 1024) max_objects = 1024;
+    if (max_objects > 1 << 20) max_objects = 1 << 20;
+    hdr_->max_objects = max_objects;
+    uint64_t meta = sizeof(Header) + sizeof(Entry) * (uint64_t)max_objects +
+                    sizeof(FreeBlock) * (uint64_t)max_objects * 2;
+    meta = (meta + 4095) & ~uint64_t(4095);
+    hdr_->data_off = meta;
+    hdr_->data_size = capacity_ - meta;
+    hdr_->free_head = -1;
+    hdr_->lru_head = hdr_->lru_tail = -1;
+    memset(base_ + sizeof(Header), 0,
+           sizeof(Entry) * (uint64_t)max_objects +
+               sizeof(FreeBlock) * (uint64_t)max_objects * 2);
+    // One initial free block spanning the data region.
+    auto* pool = reinterpret_cast<FreeBlock*>(
+        base_ + sizeof(Header) + sizeof(Entry) * max_objects);
+    pool[0] = {hdr_->data_off, hdr_->data_size, -1, 1};
+    hdr_->free_head = 0;
+    pthread_mutexattr_t attr;
+    pthread_mutexattr_init(&attr);
+    pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&hdr_->mu, &attr);
+    pthread_mutexattr_destroy(&attr);
+    __sync_synchronize();
+    hdr_->magic = kMagic;
+  }
+
+  static uint64_t Hash(const char* id) {
+    uint64_t h = 1469598103934665603ULL;  // FNV-1a
+    for (const char* p = id; *p; ++p) {
+      h ^= (uint8_t)*p;
+      h *= 1099511628211ULL;
+    }
+    return h;
+  }
+
+  int32_t FindLocked(const char* id) {
+    uint32_t n = hdr_->max_objects;
+    uint32_t i = (uint32_t)(Hash(id) % n);
+    for (uint32_t probes = 0; probes < n; ++probes, i = (i + 1) % n) {
+      Entry& e = entries_[i];
+      if (e.state == kEmpty) return -1;
+      if (e.state != kTombstone && strcmp(e.id, id) == 0) return (int32_t)i;
+    }
+    return -1;
+  }
+
+  int32_t InsertLocked(const char* id) {
+    uint32_t n = hdr_->max_objects;
+    if (hdr_->num_objects >= n - 1) return -1;
+    uint32_t i = (uint32_t)(Hash(id) % n);
+    for (uint32_t probes = 0; probes < n; ++probes, i = (i + 1) % n) {
+      Entry& e = entries_[i];
+      if (e.state == kEmpty || e.state == kTombstone) {
+        strncpy(e.id, id, kMaxIdLen);
+        e.id[kMaxIdLen] = '\0';
+        e.lru_prev = e.lru_next = -1;
+        return (int32_t)i;
+      }
+    }
+    return -1;
+  }
+
+  void RemoveLocked(int32_t idx) {
+    Entry& e = entries_[idx];
+    LruPopLocked(idx);
+    FreeRegionLocked(e.offset, e.alloc_size);
+    hdr_->used -= e.alloc_size;
+    hdr_->num_objects--;
+    e.state = kTombstone;
+    e.refcount = 0;
+  }
+
+  // -- shm-resident first-fit allocator -------------------------------
+
+  int32_t AllocPoolSlotLocked() {
+    uint32_t slots = hdr_->max_objects * 2;
+    for (uint32_t i = 0; i < slots; ++i) {
+      if (!pool_[i].used) {
+        pool_[i].used = 1;
+        return (int32_t)i;
+      }
+    }
+    return -1;
+  }
+
+  int64_t AllocLocked(uint64_t size) {
+    int32_t prev = -1;
+    for (int32_t cur = hdr_->free_head; cur >= 0;
+         prev = cur, cur = pool_[cur].next) {
+      FreeBlock& b = pool_[cur];
+      if (b.size < size) continue;
+      uint64_t off = b.offset;
+      if (b.size == size) {
+        if (prev < 0) {
+          hdr_->free_head = b.next;
+        } else {
+          pool_[prev].next = b.next;
+        }
+        b.used = 0;
+      } else {
+        b.offset += size;
+        b.size -= size;
+      }
+      return (int64_t)off;
+    }
+    return -1;
+  }
+
+  void FreeRegionLocked(uint64_t off, uint64_t size) {
+    // Insert sorted by offset, coalescing neighbors.
+    int32_t prev = -1, cur = hdr_->free_head;
+    while (cur >= 0 && pool_[cur].offset < off) {
+      prev = cur;
+      cur = pool_[cur].next;
+    }
+    // Coalesce with prev.
+    if (prev >= 0 && pool_[prev].offset + pool_[prev].size == off) {
+      pool_[prev].size += size;
+      // Then maybe with cur.
+      if (cur >= 0 &&
+          pool_[prev].offset + pool_[prev].size == pool_[cur].offset) {
+        pool_[prev].size += pool_[cur].size;
+        pool_[prev].next = pool_[cur].next;
+        pool_[cur].used = 0;
+      }
+      return;
+    }
+    // Coalesce with cur.
+    if (cur >= 0 && off + size == pool_[cur].offset) {
+      pool_[cur].offset = off;
+      pool_[cur].size += size;
+      return;
+    }
+    int32_t slot = AllocPoolSlotLocked();
+    if (slot < 0) return;  // leak the region rather than corrupt (rare)
+    pool_[slot].offset = off;
+    pool_[slot].size = size;
+    pool_[slot].next = cur;
+    if (prev < 0) {
+      hdr_->free_head = slot;
+    } else {
+      pool_[prev].next = slot;
+    }
+  }
+
+  // -- LRU of evictable entries ---------------------------------------
+
+  void LruPushLocked(int32_t idx) {
+    Entry& e = entries_[idx];
+    if (e.in_lru) return;
+    e.in_lru = 1;
+    e.lru_prev = hdr_->lru_tail;
+    e.lru_next = -1;
+    if (hdr_->lru_tail >= 0) entries_[hdr_->lru_tail].lru_next = idx;
+    hdr_->lru_tail = idx;
+    if (hdr_->lru_head < 0) hdr_->lru_head = idx;
+  }
+
+  void LruPopLocked(int32_t idx) {
+    Entry& e = entries_[idx];
+    if (!e.in_lru) return;
+    e.in_lru = 0;
+    if (e.lru_prev >= 0) {
+      entries_[e.lru_prev].lru_next = e.lru_next;
+    } else {
+      hdr_->lru_head = e.lru_next;
+    }
+    if (e.lru_next >= 0) {
+      entries_[e.lru_next].lru_prev = e.lru_prev;
+    } else {
+      hdr_->lru_tail = e.lru_prev;
+    }
+    e.lru_prev = e.lru_next = -1;
+  }
+
+  bool EvictOneLocked() {
+    int32_t idx = hdr_->lru_head;
+    if (idx < 0) return false;
+    RemoveLocked(idx);
+    return true;
+  }
+
+  std::string name_;
+  uint64_t capacity_ = 0;
+  int fd_ = -1;
+  uint8_t* base_ = nullptr;
+  Header* hdr_ = nullptr;
+  Entry* entries_ = nullptr;
+  FreeBlock* pool_ = nullptr;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* shm_store_open(const char* name, uint64_t capacity, int create) {
+  auto* s = new ShmStore(name, capacity, create != 0);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void shm_store_close(void* store) { delete static_cast<ShmStore*>(store); }
+
+void shm_store_unlink(void* store) {
+  static_cast<ShmStore*>(store)->unlink_shm();
+}
+
+int64_t shm_store_create(void* store, const char* id, uint64_t size) {
+  return static_cast<ShmStore*>(store)->Create(id, size);
+}
+
+int shm_store_seal(void* store, const char* id) {
+  return static_cast<ShmStore*>(store)->Seal(id);
+}
+
+int64_t shm_store_get(void* store, const char* id, uint64_t* size) {
+  return static_cast<ShmStore*>(store)->Get(id, size);
+}
+
+int shm_store_release(void* store, const char* id) {
+  return static_cast<ShmStore*>(store)->Release(id);
+}
+
+int shm_store_delete(void* store, const char* id) {
+  return static_cast<ShmStore*>(store)->Delete(id);
+}
+
+int shm_store_contains(void* store, const char* id) {
+  return static_cast<ShmStore*>(store)->Contains(id);
+}
+
+uint64_t shm_store_used_bytes(void* store) {
+  return static_cast<ShmStore*>(store)->UsedBytes();
+}
+
+uint64_t shm_store_num_objects(void* store) {
+  return static_cast<ShmStore*>(store)->NumObjects();
+}
+
+void shm_store_write(void* store, int64_t offset, const uint8_t* src,
+                     uint64_t size) {
+  memcpy(static_cast<ShmStore*>(store)->base() + offset, src, size);
+}
+
+const uint8_t* shm_store_pointer(void* store, int64_t offset) {
+  return static_cast<ShmStore*>(store)->base() + offset;
+}
+
+}  // extern "C"
