@@ -121,6 +121,12 @@ class ResourceScheduler:
 
     # -- placement groups -------------------------------------------------
 
+    def placement_groups(self) -> Dict[PlacementGroupID, List[Dict[str, float]]]:
+        """Snapshot of reserved bundles per PG (state API)."""
+        with self._lock:
+            return {pg_id: [dict(b.reserved) for b in bundles]
+                    for pg_id, bundles in self._placement_groups.items()}
+
     def create_placement_group(
             self, pg_id: PlacementGroupID,
             bundles: List[Dict[str, float]]) -> None:

@@ -1,0 +1,78 @@
+"""Timeline + memory summaries.
+
+Analog of the reference's python/ray/_private/state.py (timeline :851,
+chrome_tracing_dump :435, memory_summary via internal_api): converts the
+runtime's task-event buffer into chrome://tracing JSON and renders object-
+store summaries for the CLI.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.worker import global_worker
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome-tracing events (phase X) for every RUNNING→FINISHED/FAILED
+    task pair. Load the output in chrome://tracing or Perfetto."""
+    rt = global_worker.runtime
+    if rt is None:
+        raise RuntimeError("ray_tpu is not initialized")
+    starts: Dict[str, Dict[str, Any]] = {}
+    trace: List[Dict[str, Any]] = []
+    for ev in rt.task_events():
+        if ev["status"] == "RUNNING":
+            starts[ev["task_id"]] = ev
+        elif ev["status"] in ("FINISHED", "FAILED"):
+            start = starts.pop(ev["task_id"], None)
+            if start is None:
+                continue
+            trace.append({
+                "cat": "task",
+                "name": ev["name"],
+                "ph": "X",
+                "ts": start["time"] * 1e6,
+                "dur": (ev["time"] - start["time"]) * 1e6,
+                "pid": "ray_tpu",
+                "tid": ev["task_id"][:8],
+                "args": {"status": ev["status"]},
+            })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+def memory_summary() -> str:
+    rt = global_worker.runtime
+    if rt is None:
+        raise RuntimeError("ray_tpu is not initialized")
+    stats = rt.store.stats()
+    lines = [
+        "Object store summary:",
+        f"  objects: {stats['num_objects']} "
+        f"(sealed: {stats['num_sealed']})",
+        f"  serialized bytes: {stats['total_serialized_bytes']}",
+    ]
+    if rt.store.native is not None:
+        lines.append(
+            f"  shm arena: {rt.store.native.num_objects()} objects, "
+            f"{rt.store.native.used_bytes()} / "
+            f"{rt.store.native.capacity} bytes")
+    return "\n".join(lines)
+
+
+def status_summary() -> str:
+    import ray_tpu
+    total = ray_tpu.cluster_resources()
+    avail = ray_tpu.available_resources()
+    lines = ["Resources:"]
+    for k in sorted(total):
+        lines.append(f"  {k}: {avail.get(k, 0):g} / {total[k]:g} available")
+    from ray_tpu.experimental.state.api import summarize_tasks
+    summary = summarize_tasks()
+    lines.append(f"Tasks: {summary['total']} total "
+                 f"{summary['by_state']}")
+    return "\n".join(lines)

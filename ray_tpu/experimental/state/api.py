@@ -1,0 +1,121 @@
+"""State API: programmatic cluster introspection.
+
+Analog of the reference's python/ray/experimental/state/api.py
+(list_actors :736, list_tasks :959, list_objects :1003, list_nodes,
+list_placement_groups, summarize_tasks) backed by the runtime's live state
+instead of the GCS/dashboard aggregator.
+"""
+
+from __future__ import annotations
+
+from collections import Counter as _Counter
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.worker import global_worker
+
+
+def _runtime():
+    rt = global_worker.runtime
+    if rt is None:
+        raise RuntimeError("ray_tpu is not initialized")
+    return rt
+
+
+def list_actors(filters: Optional[List[tuple]] = None,
+                limit: int = 1000) -> List[Dict[str, Any]]:
+    rt = _runtime()
+    out = []
+    for actor_id, state in list(rt._actors.items()):
+        row = {
+            "actor_id": actor_id.hex(),
+            "class_name": state.creation_spec.name.replace(".__init__", ""),
+            "state": "DEAD" if state.dead else (
+                "ALIVE" if state.created.is_set() else "PENDING_CREATION"),
+            "name": state.name,
+            "namespace": state.namespace,
+            "num_restarts": state.num_restarts,
+            "pending_tasks": len(state.unfinished),
+        }
+        out.append(row)
+    return _apply_filters(out, filters)[:limit]
+
+
+def list_tasks(filters: Optional[List[tuple]] = None,
+               limit: int = 10_000) -> List[Dict[str, Any]]:
+    rt = _runtime()
+    latest: Dict[str, Dict[str, Any]] = {}
+    for ev in rt.task_events():
+        row = latest.setdefault(ev["task_id"], {
+            "task_id": ev["task_id"], "name": ev["name"],
+            "state": None, "start_time": None, "end_time": None})
+        row["state"] = ev["status"]
+        if ev["status"] == "RUNNING":
+            row["start_time"] = ev["time"]
+        elif ev["status"] in ("FINISHED", "FAILED"):
+            row["end_time"] = ev["time"]
+    return _apply_filters(list(latest.values()), filters)[:limit]
+
+
+def list_objects(filters: Optional[List[tuple]] = None,
+                 limit: int = 10_000) -> List[Dict[str, Any]]:
+    rt = _runtime()
+    out = []
+    with rt.store._lock:
+        entries = list(rt.store._entries.items())
+    for oid, entry in entries:
+        out.append({
+            "object_id": oid.hex(),
+            "sealed": entry.event.is_set(),
+            "is_exception": entry.is_exception,
+            "freed": entry.freed,
+            "in_native_store": entry.in_native,
+            "size_bytes": entry.size_bytes,
+        })
+    return _apply_filters(out, filters)[:limit]
+
+
+def list_nodes(filters: Optional[List[tuple]] = None) -> List[Dict[str, Any]]:
+    import ray_tpu
+    return ray_tpu.nodes()
+
+
+def list_placement_groups(filters: Optional[List[tuple]] = None
+                          ) -> List[Dict[str, Any]]:
+    rt = _runtime()
+    out = []
+    for pg_id, bundles in rt.scheduler.placement_groups().items():
+        out.append({"placement_group_id": pg_id.hex(),
+                    "bundles": bundles})
+    return _apply_filters(out, filters)
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    tasks = list_tasks()
+    by_state = _Counter(t["state"] for t in tasks)
+    by_name = _Counter(t["name"] for t in tasks)
+    return {"total": len(tasks),
+            "by_state": dict(by_state),
+            "by_name": dict(by_name.most_common(50))}
+
+
+def summarize_objects() -> Dict[str, Any]:
+    rt = _runtime()
+    stats = rt.store.stats()
+    if rt.store.native is not None:
+        stats["native_objects"] = rt.store.native.num_objects()
+        stats["native_used_bytes"] = rt.store.native.used_bytes()
+    return stats
+
+
+def _apply_filters(rows: List[Dict[str, Any]],
+                   filters: Optional[List[tuple]]) -> List[Dict[str, Any]]:
+    if not filters:
+        return rows
+    for key, op, value in filters:
+        if op == "=":
+            rows = [r for r in rows if str(r.get(key)) == str(value)]
+        elif op == "!=":
+            rows = [r for r in rows if str(r.get(key)) != str(value)]
+        else:
+            raise ValueError(f"Unsupported filter op {op!r}")
+    return rows

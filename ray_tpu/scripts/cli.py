@@ -1,0 +1,114 @@
+"""ray-tpu CLI.
+
+Analog of the reference's python/ray/scripts/scripts.py subset
+(`ray status/memory/timeline/list`, scripts.py:529,2390-2403) plus
+`bench`. argparse instead of click (no extra deps); single-node commands
+initialize a local runtime on demand.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _ensure_init():
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+
+
+def cmd_status(args) -> int:
+    _ensure_init()
+    from ray_tpu._private.state import status_summary
+    print(status_summary())
+    return 0
+
+
+def cmd_memory(args) -> int:
+    _ensure_init()
+    from ray_tpu._private.state import memory_summary
+    print(memory_summary())
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    _ensure_init()
+    from ray_tpu._private.state import timeline
+    out = args.output or "timeline.json"
+    events = timeline(out)
+    print(f"Wrote {len(events)} events to {out}")
+    return 0
+
+
+def cmd_list(args) -> int:
+    _ensure_init()
+    from ray_tpu.experimental.state import api
+    fn = {
+        "actors": api.list_actors,
+        "tasks": api.list_tasks,
+        "objects": api.list_objects,
+        "nodes": api.list_nodes,
+        "placement-groups": api.list_placement_groups,
+    }[args.resource]
+    print(json.dumps(fn(), indent=2, default=str))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    _ensure_init()
+    from ray_tpu.experimental.state import api
+    fn = {"tasks": api.summarize_tasks,
+          "objects": api.summarize_objects}[args.resource]
+    print(json.dumps(fn(), indent=2, default=str))
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    from ray_tpu.util.metrics import export_prometheus
+    print(export_prometheus())
+    return 0
+
+
+def cmd_devices(args) -> int:
+    import jax
+    for d in jax.devices():
+        print(f"{d.id}: {d.device_kind} (process {d.process_index}, "
+              f"platform {d.platform})")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ray-tpu",
+        description="TPU-native distributed computing framework CLI")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("status", help="cluster resource + task summary")
+    sub.add_parser("memory", help="object store summary")
+    p = sub.add_parser("timeline", help="dump chrome://tracing JSON")
+    p.add_argument("-o", "--output", default=None)
+    p = sub.add_parser("list", help="list cluster state")
+    p.add_argument("resource", choices=["actors", "tasks", "objects",
+                                        "nodes", "placement-groups"])
+    p = sub.add_parser("summary", help="summarize cluster state")
+    p.add_argument("resource", choices=["tasks", "objects"])
+    sub.add_parser("metrics", help="print Prometheus metrics")
+    sub.add_parser("devices", help="list visible accelerator devices")
+
+    args = parser.parse_args(argv)
+    handler = {
+        "status": cmd_status,
+        "memory": cmd_memory,
+        "timeline": cmd_timeline,
+        "list": cmd_list,
+        "summary": cmd_summary,
+        "metrics": cmd_metrics,
+        "devices": cmd_devices,
+    }[args.command]
+    return handler(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

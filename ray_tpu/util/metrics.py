@@ -1,0 +1,156 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Analog of the reference's python/ray/util/metrics.py:19,155 (Counter,
+Gauge, Histogram over the C++ OpenCensus pipeline, stats/metric.h). Here a
+process-local registry aggregates tagged series; ``export_prometheus``
+renders the text exposition format the reference's metrics agent serves to
+Prometheus.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REGISTRY: Dict[str, "Metric"] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+class Metric:
+    metric_type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name:
+            raise ValueError("metric name required")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._series: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+        with _REGISTRY_LOCK:
+            existing = _REGISTRY.get(name)
+            if existing is not None:
+                # Re-registration returns the same series store (the
+                # reference keys metrics globally by name too).
+                self.__dict__ = existing.__dict__
+            else:
+                _REGISTRY[name] = self
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple[str, ...]:
+        merged = {**self._default_tags, **(tags or {})}
+        extra = set(merged) - set(self.tag_keys)
+        if extra:
+            raise ValueError(f"Unknown tag keys {sorted(extra)}; declared "
+                             f"tag_keys={self.tag_keys}")
+        return tuple(merged.get(k, "") for k in self.tag_keys)
+
+    def series(self) -> Dict[Tuple[str, ...], float]:
+        with self._lock:
+            return dict(self._series)
+
+
+class Counter(Metric):
+    metric_type = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("Counters only increase")
+        key = self._key(tags)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    metric_type = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._series[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    metric_type = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        self.boundaries = sorted(boundaries or
+                                 [0.001, 0.01, 0.1, 1, 10, 100, 1000])
+        super().__init__(name, description, tag_keys)
+        if not hasattr(self, "_buckets"):
+            self._buckets: Dict[Tuple[str, ...], List[int]] = {}
+            self._sums: Dict[Tuple[str, ...], float] = {}
+            self._counts: Dict[Tuple[str, ...], int] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(tags)
+        with self._lock:
+            buckets = self._buckets.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            buckets[bisect.bisect_left(self.boundaries, value)] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._counts[key] = self._counts.get(key, 0) + 1
+            self._series[key] = value  # last observation
+
+    def percentile(self, q: float,
+                   tags: Optional[Dict[str, str]] = None) -> float:
+        """Approximate percentile from bucket boundaries."""
+        key = self._key(tags)
+        with self._lock:
+            buckets = self._buckets.get(key)
+            total = self._counts.get(key, 0)
+        if not buckets or not total:
+            return float("nan")
+        target = q / 100.0 * total
+        run = 0
+        for i, c in enumerate(buckets):
+            run += c
+            if run >= target:
+                return self.boundaries[min(i, len(self.boundaries) - 1)]
+        return self.boundaries[-1]
+
+
+def registry() -> Dict[str, Metric]:
+    with _REGISTRY_LOCK:
+        return dict(_REGISTRY)
+
+
+def clear_registry() -> None:
+    with _REGISTRY_LOCK:
+        _REGISTRY.clear()
+
+
+def export_prometheus() -> str:
+    """Prometheus text exposition of every registered metric (what the
+    reference's per-node metrics agent serves, metrics_agent.py:189)."""
+    lines: List[str] = []
+    for name, metric in sorted(registry().items()):
+        safe = name.replace("-", "_").replace(".", "_")
+        if metric.description:
+            lines.append(f"# HELP {safe} {metric.description}")
+        lines.append(f"# TYPE {safe} {metric.metric_type}")
+        for key, value in metric.series().items():
+            if metric.tag_keys:
+                tags = ",".join(f'{k}="{v}"'
+                                for k, v in zip(metric.tag_keys, key))
+                lines.append(f"{safe}{{{tags}}} {value}")
+            else:
+                lines.append(f"{safe} {value}")
+        if isinstance(metric, Histogram):
+            for key, count in metric._counts.items():
+                tags = ",".join(f'{k}="{v}"'
+                                for k, v in zip(metric.tag_keys, key))
+                prefix = f"{safe}_count{{{tags}}}" if tags else \
+                    f"{safe}_count"
+                lines.append(f"{prefix} {count}")
+    return "\n".join(lines) + "\n"

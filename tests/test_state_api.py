@@ -1,0 +1,106 @@
+"""Tests for state API, timeline, metrics, CLI (model: reference
+python/ray/tests/test_state_api.py, test_metrics_agent.py)."""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental.state import api as state_api
+from ray_tpu.util import metrics
+
+
+def test_list_tasks_and_actors(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    ray_tpu.get([f.remote() for _ in range(3)])
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+
+    tasks = state_api.list_tasks()
+    finished = [t for t in tasks if t["state"] == "FINISHED"]
+    assert len(finished) >= 4
+    actors = state_api.list_actors()
+    assert len(actors) == 1
+    assert actors[0]["state"] == "ALIVE"
+    assert actors[0]["class_name"] == "A"
+    filtered = state_api.list_actors(filters=[("state", "=", "DEAD")])
+    assert filtered == []
+    summary = state_api.summarize_tasks()
+    assert summary["total"] >= 4
+
+
+def test_list_objects(ray_start_regular):
+    ref = ray_tpu.put({"k": 1})
+    objs = state_api.list_objects()
+    assert any(o["object_id"] == ref.object_id().hex() for o in objs)
+    assert state_api.summarize_objects()["num_objects"] >= 1
+
+
+def test_timeline(ray_start_regular, tmp_path):
+    from ray_tpu._private.state import timeline
+
+    @ray_tpu.remote
+    def work():
+        import time
+        time.sleep(0.01)
+        return 1
+
+    ray_tpu.get([work.remote() for _ in range(3)])
+    out = str(tmp_path / "timeline.json")
+    events = timeline(out)
+    assert len(events) >= 3
+    data = json.load(open(out))
+    assert data[0]["ph"] == "X"
+    assert data[0]["dur"] > 0
+
+
+def test_metrics():
+    metrics.clear_registry()
+    c = metrics.Counter("test_requests", "reqs", tag_keys=("route",))
+    c.inc(1, tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    c.inc(5, tags={"route": "/b"})
+    assert c.series()[("/a",)] == 3
+    g = metrics.Gauge("test_inflight")
+    g.set(7)
+    h = metrics.Histogram("test_latency", boundaries=[0.1, 1, 10])
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = metrics.export_prometheus()
+    assert 'test_requests{route="/a"} 3' in text
+    assert "test_inflight 7" in text
+    assert "test_latency_count 4" in text
+    assert h.percentile(50) == 1
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        c.inc(1, tags={"bogus": "x"})
+
+
+def test_metrics_reregistration():
+    metrics.clear_registry()
+    c1 = metrics.Counter("shared_counter")
+    c1.inc(3)
+    c2 = metrics.Counter("shared_counter")
+    c2.inc(4)
+    assert c1.series()[()] == 7
+
+
+def test_cli_smoke(ray_start_regular, tmp_path, capsys):
+    from ray_tpu.scripts.cli import main
+    assert main(["status"]) == 0
+    assert main(["memory"]) == 0
+    assert main(["list", "nodes"]) == 0
+    assert main(["summary", "tasks"]) == 0
+    out = str(tmp_path / "t.json")
+    assert main(["timeline", "-o", out]) == 0
+    captured = capsys.readouterr()
+    assert "Resources:" in captured.out
