@@ -1,0 +1,479 @@
+"""Cluster-level resource scheduling: pick a node, then acquire on it.
+
+The multi-node analog of the reference's two-level scheduler:
+`ClusterTaskManager::ScheduleAndDispatchTasks` picks a node with a pluggable
+policy (src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h — pack
+until a utilization threshold, then spread), and the chosen node's
+`LocalTaskManager` acquires resources. Here every node is virtual (the
+process hosts all of them), but the accounting, policies, and failure
+semantics mirror the reference:
+
+* **Hybrid (DEFAULT)**: prefer nodes in id order while their critical
+  resource utilization stays below the 50% threshold, else pick the
+  least-utilized feasible node (spread).
+* **SPREAD**: least-utilized feasible node, round-robin tie-break.
+* **NodeAffinity**: the named node, falling back to hybrid iff ``soft``.
+* **Placement groups** are reserved across nodes with PACK / SPREAD /
+  STRICT_PACK / STRICT_SPREAD bundle policies
+  (src/ray/raylet/scheduling/policy/bundle_scheduling_policy.h); on a TPU
+  cluster a PG maps onto an ICI slice, so STRICT_PACK == one host and each
+  bundle is one host's worth of chips.
+
+Node death releases nothing back (the node's resources vanish with it);
+the runtime handles task retry / actor restart / object reconstruction.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import NodeID, PlacementGroupID
+from ray_tpu._private.scheduler import ResourceScheduler, _fits
+from ray_tpu.exceptions import PlacementGroupError
+
+# Reference default: RAY_scheduler_spread_threshold = 0.5
+# (src/ray/common/ray_config_def.h).
+SPREAD_THRESHOLD = 0.5
+
+
+class NodeState:
+    def __init__(self, node_id: NodeID, resources: Dict[str, float],
+                 is_head: bool = False, labels: Optional[dict] = None):
+        self.node_id = node_id
+        self.resources = dict(resources)
+        self.local = ResourceScheduler(dict(resources))
+        self.alive = True
+        self.is_head = is_head
+        self.labels = dict(labels or {})
+        # Per-node TPU chip-slot allocator (the analog of per-node
+        # CUDA_VISIBLE_DEVICES assignment in the reference).
+        self.free_tpu_ids: List[int] = list(range(int(resources.get("TPU", 0))))
+
+    def utilization(self) -> float:
+        """Max used-fraction over resources with nonzero capacity (the
+        'critical resource utilization' of the hybrid policy)."""
+        worst = 0.0
+        total = self.local.total
+        avail = self.local.available
+        for key, cap in total.items():
+            if cap <= 0 or key.startswith("node:"):
+                continue
+            used = cap - avail.get(key, 0.0)
+            worst = max(worst, used / cap)
+        return worst
+
+
+class _PGBundle:
+    __slots__ = ("node_id", "resources", "available")
+
+    def __init__(self, node_id: NodeID, resources: Dict[str, float]):
+        self.node_id = node_id
+        self.resources = dict(resources)
+        self.available = dict(resources)
+
+
+class _PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, strategy: str,
+                 bundles: List[_PGBundle]):
+        self.pg_id = pg_id
+        self.strategy = strategy
+        self.bundles = bundles
+
+
+class ClusterResourceScheduler:
+    """Owns every NodeState; all acquire/release flows through here."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._nodes: Dict[NodeID, NodeState] = {}
+        self._node_order: List[NodeID] = []
+        self._pgs: Dict[PlacementGroupID, _PlacementGroup] = {}
+        self._spread_rr = 0  # round-robin cursor for SPREAD ties
+
+    # -- membership -------------------------------------------------------
+
+    def add_node(self, resources: Dict[str, float], is_head: bool = False,
+                 labels: Optional[dict] = None) -> NodeID:
+        node_id = NodeID.from_random()
+        resources = dict(resources)
+        # Every node advertises its identity resource, like the reference's
+        # node:<ip> resource used by NodeAffinity internals.
+        resources.setdefault(f"node:{node_id.hex()[:12]}", 1.0)
+        if is_head:
+            resources.setdefault("node:__internal_head__", 1.0)
+        with self._lock:
+            state = NodeState(node_id, resources, is_head, labels)
+            self._nodes[node_id] = state
+            self._node_order.append(node_id)
+        return node_id
+
+    def remove_node(self, node_id: NodeID) -> Optional[NodeState]:
+        with self._lock:
+            state = self._nodes.get(node_id)
+            if state is None or not state.alive:
+                return None
+            state.alive = False
+            self._node_order.remove(node_id)
+            return state
+
+    def node(self, node_id: NodeID) -> Optional[NodeState]:
+        return self._nodes.get(node_id)
+
+    def alive_nodes(self) -> List[NodeState]:
+        with self._lock:
+            return [self._nodes[n] for n in self._node_order]
+
+    def nodes_snapshot(self) -> List[dict]:
+        with self._lock:
+            out = []
+            for node_id, state in self._nodes.items():
+                out.append({
+                    "NodeID": node_id.hex(),
+                    "Alive": state.alive,
+                    "Resources": dict(state.resources),
+                    "Available": dict(state.local.available)
+                    if state.alive else {},
+                    "IsHead": state.is_head,
+                    "Labels": dict(state.labels),
+                })
+            return out
+
+    # -- aggregate views (state API / ray.cluster_resources) --------------
+
+    @property
+    def total(self) -> Dict[str, float]:
+        agg: Dict[str, float] = {}
+        with self._lock:
+            for node_id in self._node_order:
+                for k, v in self._nodes[node_id].local.total.items():
+                    agg[k] = agg.get(k, 0.0) + v
+        return agg
+
+    @property
+    def available(self) -> Dict[str, float]:
+        agg: Dict[str, float] = {}
+        with self._lock:
+            for node_id in self._node_order:
+                for k, v in self._nodes[node_id].local.available.items():
+                    agg[k] = agg.get(k, 0.0) + v
+        return agg
+
+    # -- node selection ---------------------------------------------------
+
+    def _candidate_nodes(self, strategy) -> Tuple[List[NodeState], bool]:
+        """Returns (ordered candidates, hard_affinity_failed_ok)."""
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        with self._lock:
+            ordered = [self._nodes[n] for n in self._node_order]
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            target = None
+            for state in ordered:
+                if state.node_id.hex().startswith(strategy.node_id) or \
+                        strategy.node_id == state.node_id.hex():
+                    target = state
+                    break
+            if target is not None and target.alive:
+                if strategy.soft:
+                    return [target] + [s for s in ordered if s is not target], True
+                return [target], False
+            if strategy.soft:
+                return ordered, True
+            return [], False
+        if strategy == "SPREAD":
+            with self._lock:
+                self._spread_rr += 1
+                rr = self._spread_rr
+            ranked = sorted(
+                ordered, key=lambda s: (round(s.utilization(), 6),))
+            if ranked:
+                # rotate equal-utilization prefix for round-robin behavior
+                lowest = round(ranked[0].utilization(), 6)
+                prefix = [s for s in ranked
+                          if round(s.utilization(), 6) == lowest]
+                rest = ranked[len(prefix):]
+                k = rr % len(prefix)
+                ranked = prefix[k:] + prefix[:k] + rest
+            return ranked, False
+        # DEFAULT / hybrid: pack onto nodes (in id order) under the spread
+        # threshold, else least-utilized first.
+        under = [s for s in ordered if s.utilization() < SPREAD_THRESHOLD]
+        over = sorted((s for s in ordered if s not in under),
+                      key=lambda s: s.utilization())
+        return under + over, False
+
+    def is_feasible(self, resources: Dict[str, float],
+                    pg_id: Optional[PlacementGroupID] = None,
+                    bundle_index: int = -1, strategy=None) -> bool:
+        with self._lock:
+            if pg_id is not None:
+                pg = self._pgs.get(pg_id)
+                if pg is None:
+                    return False
+                bundles = (pg.bundles if bundle_index < 0
+                           else pg.bundles[bundle_index:bundle_index + 1])
+                return any(_fits(b.resources, resources) for b in bundles)
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        if isinstance(strategy, NodeAffinitySchedulingStrategy) and \
+                not strategy.soft:
+            nodes, _ = self._candidate_nodes(strategy)
+            return any(_fits(s.local.total, resources) for s in nodes)
+        return any(_fits(s.local.total, resources)
+                   for s in self.alive_nodes())
+
+    def try_acquire(self, resources: Dict[str, float],
+                    pg_id: Optional[PlacementGroupID] = None,
+                    bundle_index: int = -1,
+                    strategy=None) -> Optional[Tuple[NodeID, int]]:
+        """Pick a node + acquire. Returns (node_id, bundle_index_used) or
+        None if nothing is available right now. bundle_index_used is -1 when
+        acquiring from a node's global pool."""
+        if pg_id is not None:
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+                if pg is None:
+                    return None
+                candidates = ([bundle_index] if bundle_index >= 0
+                              else range(len(pg.bundles)))
+                for i in candidates:
+                    if i >= len(pg.bundles):
+                        return None
+                    b = pg.bundles[i]
+                    node = self._nodes.get(b.node_id)
+                    if node is None or not node.alive:
+                        continue
+                    if _fits(b.available, resources):
+                        for k, v in resources.items():
+                            b.available[k] = b.available.get(k, 0.0) - v
+                        return b.node_id, i
+                return None
+        candidates, _ = self._candidate_nodes(strategy)
+        for state in candidates:
+            if not state.alive:
+                continue
+            if state.local.try_acquire(resources) is not None:
+                return state.node_id, -1
+        return None
+
+    def release(self, resources: Dict[str, float],
+                node_id: Optional[NodeID] = None,
+                pg_id: Optional[PlacementGroupID] = None,
+                bundle_index: int = -1) -> None:
+        if pg_id is not None and bundle_index >= 0:
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+                if pg is None or bundle_index >= len(pg.bundles):
+                    return
+                b = pg.bundles[bundle_index]
+                node = self._nodes.get(b.node_id)
+                if node is None or not node.alive:
+                    return  # resources died with the node
+                for k, v in resources.items():
+                    b.available[k] = b.available.get(k, 0.0) + v
+            return
+        if node_id is None:
+            return
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                return
+        node.local.release(resources)
+
+    def force_acquire(self, resources: Dict[str, float],
+                      node_id: Optional[NodeID] = None,
+                      pg_id: Optional[PlacementGroupID] = None,
+                      bundle_index: int = -1) -> None:
+        """Re-acquire previously released resources without an availability
+        check (unblock path; may transiently overcommit)."""
+        if pg_id is not None and bundle_index >= 0:
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+                if pg is None or bundle_index >= len(pg.bundles):
+                    return
+                b = pg.bundles[bundle_index]
+                for k, v in resources.items():
+                    b.available[k] = b.available.get(k, 0.0) - v
+            return
+        if node_id is None:
+            return
+        with self._lock:
+            node = self._nodes.get(node_id)
+        if node is not None and node.alive:
+            node.local.force_acquire(resources)
+
+    # -- TPU chip slots ---------------------------------------------------
+
+    def take_tpu_ids(self, node_id: NodeID, n: int) -> Optional[List[int]]:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or len(node.free_tpu_ids) < n:
+                return None
+            return [node.free_tpu_ids.pop() for _ in range(n)]
+
+    def return_tpu_ids(self, node_id: NodeID, ids: List[int]) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None and node.alive:
+                node.free_tpu_ids.extend(ids)
+
+    # -- placement groups -------------------------------------------------
+
+    def create_placement_group(self, pg_id: PlacementGroupID,
+                               bundles: List[Dict[str, float]],
+                               strategy: str = "PACK") -> None:
+        """Reserve bundles across nodes. The reference does 2-phase
+        Prepare/Commit across raylets (gcs_placement_group_scheduler.h:265);
+        with virtual nodes under one lock, prepare+commit is atomic."""
+        with self._lock:
+            alive = [self._nodes[n] for n in self._node_order]
+            if not alive:
+                raise PlacementGroupError("No alive nodes.")
+            placed = self._place_bundles(bundles, strategy, alive)
+            if placed is None:
+                raise PlacementGroupError(
+                    f"Placement group bundles {bundles} cannot be reserved "
+                    f"with strategy {strategy} on the current cluster "
+                    f"(nodes: {[dict(s.local.available) for s in alive]}).")
+            pg_bundles = []
+            for node_state, bundle_resources in placed:
+                node_state.local.force_acquire(bundle_resources)
+                pg_bundles.append(
+                    _PGBundle(node_state.node_id, bundle_resources))
+            self._pgs[pg_id] = _PlacementGroup(pg_id, strategy, pg_bundles)
+
+    def _place_bundles(self, bundles: List[Dict[str, float]], strategy: str,
+                       alive: List[NodeState]):
+        """Dry-run bundle→node assignment. Returns [(NodeState, bundle)] or
+        None if infeasible. Mutates nothing."""
+        shadow = {s.node_id: dict(s.local.available) for s in alive}
+
+        def fits(node_id, need):
+            return _fits(shadow[node_id], need)
+
+        def take(node_id, need):
+            for k, v in need.items():
+                shadow[node_id][k] = shadow[node_id].get(k, 0.0) - v
+
+        placed: List[Tuple[NodeState, Dict[str, float]]] = []
+        if strategy == "STRICT_PACK":
+            for state in alive:
+                if all(_fits_cumulative(shadow[state.node_id], bundles)):
+                    for b in bundles:
+                        take(state.node_id, b)
+                        placed.append((state, b))
+                    return placed
+            return None
+        if strategy == "STRICT_SPREAD":
+            if len(bundles) > len(alive):
+                return None
+            used = set()
+            for b in bundles:
+                chosen = None
+                for state in sorted(alive, key=lambda s: s.utilization()):
+                    if state.node_id in used:
+                        continue
+                    if fits(state.node_id, b):
+                        chosen = state
+                        break
+                if chosen is None:
+                    return None
+                used.add(chosen.node_id)
+                take(chosen.node_id, b)
+                placed.append((chosen, b))
+            return placed
+        if strategy == "SPREAD":
+            for i, b in enumerate(bundles):
+                ranked = sorted(alive, key=lambda s: s.utilization())
+                chosen = None
+                for state in ranked[i % len(ranked):] + ranked[:i % len(ranked)]:
+                    if fits(state.node_id, b):
+                        chosen = state
+                        break
+                if chosen is None:
+                    return None
+                take(chosen.node_id, b)
+                placed.append((chosen, b))
+            return placed
+        # PACK (default): fewest nodes — first-fit in node order.
+        for b in bundles:
+            chosen = None
+            for state in alive:
+                if fits(state.node_id, b):
+                    chosen = state
+                    break
+            if chosen is None:
+                return None
+            take(chosen.node_id, b)
+            placed.append((chosen, b))
+        return placed
+
+    def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
+        with self._lock:
+            pg = self._pgs.pop(pg_id, None)
+            if pg is None:
+                return
+            for b in pg.bundles:
+                node = self._nodes.get(b.node_id)
+                if node is not None and node.alive:
+                    node.local.release(b.resources)
+
+    def placement_group_exists(self, pg_id: PlacementGroupID) -> bool:
+        with self._lock:
+            return pg_id in self._pgs
+
+    def placement_groups(self) -> Dict[PlacementGroupID, List[Dict[str, float]]]:
+        with self._lock:
+            return {pg_id: [dict(b.resources) for b in pg.bundles]
+                    for pg_id, pg in self._pgs.items()}
+
+    def placement_group_table(self) -> List[dict]:
+        with self._lock:
+            return [{
+                "placement_group_id": pg_id.hex(),
+                "strategy": pg.strategy,
+                "bundles": [
+                    {"node_id": b.node_id.hex(), "resources": dict(b.resources)}
+                    for b in pg.bundles],
+            } for pg_id, pg in self._pgs.items()]
+
+    def reschedule_lost_bundles(self) -> List[PlacementGroupID]:
+        """Re-reserve PG bundles whose node is no longer alive (the
+        reference's PG rescheduling on node failure). Called on node death
+        AND on node addition, so a bundle that couldn't be re-placed at
+        death time lands as soon as capacity appears. Returns PGs touched."""
+        touched = []
+        with self._lock:
+            for pg in self._pgs.values():
+                for b in pg.bundles:
+                    home = self._nodes.get(b.node_id)
+                    if home is not None and home.alive:
+                        continue
+                    touched.append(pg.pg_id)
+                    for state in (self._nodes[n] for n in self._node_order):
+                        if state.local.try_acquire(b.resources) is not None:
+                            b.node_id = state.node_id
+                            b.available = dict(b.resources)
+                            break
+        return touched
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "total": self.total,
+                "available": self.available,
+                "num_nodes": len(self._node_order),
+                "num_placement_groups": len(self._pgs),
+            }
+
+
+def _fits_cumulative(avail: Dict[str, float], bundles: List[Dict[str, float]]):
+    remaining = dict(avail)
+    for b in bundles:
+        ok = _fits(remaining, b)
+        yield ok
+        if not ok:
+            return
+        for k, v in b.items():
+            remaining[k] = remaining.get(k, 0.0) - v

@@ -144,10 +144,22 @@ class ObjectStore:
         TaskError causes appropriately.
         """
         entry = self._entry(object_id)
-        if not entry.event.wait(timeout):
-            raise GetTimeoutError(
-                f"Get timed out waiting for object {object_id.hex()} "
-                f"after {timeout}s.")
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        while True:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            if not entry.event.wait(remaining):
+                raise GetTimeoutError(
+                    f"Get timed out waiting for object {object_id.hex()} "
+                    f"after {timeout}s.")
+            with self._lock:
+                # Re-check under the lock: a concurrent invalidate() (node
+                # death → reconstruction) may have un-sealed the entry
+                # between the wait and here; loop back and wait for the
+                # reconstructed value instead of reading reset fields.
+                if entry.event.is_set():
+                    break
         if entry.freed:
             raise ObjectFreedError(
                 f"Object {object_id.hex()} was freed and is no longer available.")
@@ -199,6 +211,36 @@ class ObjectStore:
                     self._total_bytes -= entry.size_bytes
                     entry.serialized = None
                     entry.event.set()
+
+    def invalidate(self, object_ids) -> None:
+        """Un-seal objects whose primary copy was lost (node death) so a
+        lineage re-execution can write them again. Blocked getters keep
+        waiting on the same entry and wake when the reconstructed value is
+        sealed (reference: object_recovery_manager.h:68-94 — a lost object
+        returns to 'pending' while its creating task is resubmitted)."""
+        with self._lock:
+            for oid in object_ids:
+                entry = self._entries.get(oid)
+                if entry is None:
+                    continue
+                if entry.freed or not entry.event.is_set():
+                    # freed: accounting already settled, and a user-freed
+                    # object must not be resurrected by reconstruction.
+                    # unsealed: nothing to invalidate.
+                    continue
+                if entry.in_native and self._native is not None:
+                    if entry.value is not None:
+                        self._native.release(oid.hex())
+                    self._native.delete(oid.hex())
+                self._total_bytes -= entry.size_bytes
+                entry.value = None
+                entry.serialized = None
+                entry.deserialized = False
+                entry.is_exception = False
+                entry.freed = False
+                entry.in_native = False
+                entry.size_bytes = 0
+                entry.event.clear()
 
     def fail_all_pending(self, exc: BaseException) -> None:
         """Seal every unsealed entry with the given error (used at shutdown so

@@ -34,14 +34,15 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private import serialization
-from ray_tpu._private.ids import (ActorID, JobID, ObjectID, PlacementGroupID,
-                                  TaskID, WorkerID)
+from ray_tpu._private.cluster_scheduler import ClusterResourceScheduler
+from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,
+                                  PlacementGroupID, TaskID, WorkerID)
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import ObjectStore
 from ray_tpu._private.resource_spec import NodeResources
-from ray_tpu._private.scheduler import ResourceScheduler
 from ray_tpu._private.task_spec import TaskKind, TaskSpec
 from ray_tpu.exceptions import (ActorDiedError, GetTimeoutError,
+                                NodeDiedError, ObjectLostError,
                                 TaskCancelledError, TaskError)
 
 logger = logging.getLogger("ray_tpu")
@@ -70,11 +71,22 @@ class FunctionTable:
         self._lock = threading.Lock()
 
     def export(self, fn: Callable) -> bytes:
-        payload = serialization.dumps_function(fn)
-        fn_id = hashlib.sha1(payload).digest()
+        try:
+            payload = serialization.dumps_function(fn)
+        except Exception:  # noqa: BLE001
+            # Unpicklable closure (locks, events, ...): legal on the
+            # in-process thread backend where the live object is shared;
+            # the process backend would reject this at spawn time.
+            payload = None
+        if payload is not None:
+            fn_id = hashlib.sha1(payload).digest()
+        else:
+            import os as _os
+            fn_id = _os.urandom(20)
         with self._lock:
             if fn_id not in self._by_id:
-                self._by_id[fn_id] = payload
+                if payload is not None:
+                    self._by_id[fn_id] = payload
                 self._loaded[fn_id] = fn
         return fn_id
 
@@ -247,7 +259,9 @@ class Runtime:
         self.store = ObjectStore(
             deserializer=serialization.deserialize,
             native_capacity=int(node_resources.memory_bytes * 0.3))
-        self.scheduler = ResourceScheduler(node_resources.to_resource_map())
+        self.scheduler = ClusterResourceScheduler()
+        self.head_node_id = self.scheduler.add_node(
+            node_resources.to_resource_map(), is_head=True)
         self.functions = FunctionTable()
         self._lock = threading.RLock()
         self._idle_workers: List[Executor] = []
@@ -265,15 +279,27 @@ class Runtime:
         # blocking tasks (e.g. sleeping) don't starve the pool.
         self._max_workers = max_workers or max(
             64, int(node_resources.num_cpus) * 8)
-        # Chip-slot allocator: tasks with integer num_tpus get distinct chip
-        # ids (the analog of the reference's CUDA_VISIBLE_DEVICES assignment,
-        # python/ray/_private/utils.py get_cuda_visible_devices).
-        self._free_tpu_ids = list(range(int(node_resources.num_tpus)))
         self._task_events: List[dict] = []  # lightweight task-event buffer
+        self._infeasible_warned: set = set()
+        # Lineage: creating TaskSpec per return object, for reconstruction
+        # after node loss (reference: task_manager.h TaskResubmissionInterface
+        # + object_recovery_manager.h). Bounded; puts are not reconstructable.
+        self._lineage: Dict[ObjectID, TaskSpec] = {}
+        self._object_locations: Dict[ObjectID, NodeID] = {}
 
     # ------------------------------------------------------------------
     # Object API
     # ------------------------------------------------------------------
+
+    def free_objects(self, oids: List[ObjectID]) -> None:
+        """Free object values and drop their lineage/location bookkeeping
+        (the reference prunes lineage when refs go out of scope; here the
+        explicit free() is the pruning point)."""
+        self.store.free(oids)
+        with self._lock:
+            for oid in oids:
+                self._lineage.pop(oid, None)
+                self._object_locations.pop(oid, None)
 
     def put(self, value: Any) -> ObjectRef:
         with self._lock:
@@ -293,10 +319,10 @@ class Runtime:
         spec = current_task_spec() if blocking else None
         released = False
         if spec is not None and spec.resources:
-            pg_id, bundle = self._pg_key(spec)
-            acquired = getattr(spec, "_acquired_bundle", -1)
-            bidx = bundle if bundle >= 0 else acquired
-            self.scheduler.release(spec.resources, pg_id, bidx)
+            pg_id, _ = self._pg_key(spec)
+            node_id = getattr(spec, "_node_id", None)
+            bidx = getattr(spec, "_acquired_bundle", -1)
+            self.scheduler.release(spec.resources, node_id, pg_id, bidx)
             released = True
             self._dispatch()
         try:
@@ -309,7 +335,8 @@ class Runtime:
             return results
         finally:
             if released:
-                self.scheduler.force_acquire(spec.resources, pg_id, bidx)
+                self.scheduler.force_acquire(
+                    spec.resources, node_id, pg_id, bidx)
 
     def wait(self, refs: List[ObjectRef], num_returns: int,
              timeout: Optional[float], fetch_local: bool = True):
@@ -363,6 +390,10 @@ class Runtime:
         refs = [ObjectRef(oid) for oid in spec.return_ids]
         if spec.num_returns == 0:
             refs = []
+        with self._lock:
+            if len(self._lineage) < 1_000_000:
+                for oid in spec.return_ids:
+                    self._lineage[oid] = spec
         self._record_event(spec, "SUBMITTED")
         self._resolve_dependencies(spec)
         return refs
@@ -473,30 +504,72 @@ class Runtime:
                     return
                 for i, spec in enumerate(self._ready):
                     pg_id, bundle = self._pg_key(spec)
-                    if not self.scheduler.is_feasible(spec.resources, pg_id, bundle):
-                        self._ready.pop(i)
-                        self._store_error(spec, ValueError(
-                            f"Task {spec.name} requires {spec.resources} which "
-                            f"exceeds cluster capacity "
-                            f"{self.scheduler.total}"))
-                        launched = True  # re-enter loop
-                        break
+                    if not self.scheduler.is_feasible(
+                            spec.resources, pg_id, bundle,
+                            spec.scheduling_strategy):
+                        # Hard node-affinity to a dead/unknown node can never
+                        # succeed: fail fast (reference behavior). Anything
+                        # else stays queued as autoscaler demand — the
+                        # reference warns and waits for the cluster to grow.
+                        from ray_tpu.util.scheduling_strategies import (
+                            NodeAffinitySchedulingStrategy)
+                        strategy = spec.scheduling_strategy
+                        if pg_id is not None:
+                            # PG-targeted infeasibility can never be fixed by
+                            # cluster growth: either the PG was removed, or
+                            # the bundle's fixed capacity is exceeded.
+                            self._ready.pop(i)
+                            if self.scheduler.placement_group_exists(pg_id):
+                                msg = (f"Task {spec.name} requires "
+                                       f"{spec.resources} which exceeds the "
+                                       "capacity of its placement group "
+                                       "bundle.")
+                            else:
+                                msg = (f"Task {spec.name} was scheduled into "
+                                       "a placement group that does not "
+                                       "exist (removed or never created).")
+                            self._store_error(spec, ValueError(msg))
+                            launched = True  # re-enter loop
+                            break
+                        if isinstance(strategy,
+                                      NodeAffinitySchedulingStrategy) and \
+                                not strategy.soft:
+                            self._ready.pop(i)
+                            self._store_error(spec, ValueError(
+                                f"Task {spec.name} has hard node affinity to "
+                                f"node {strategy.node_id}, which is not alive "
+                                "or lacks the required resources."))
+                            launched = True  # re-enter loop
+                            break
+                        if spec.task_id not in self._infeasible_warned:
+                            self._infeasible_warned.add(spec.task_id)
+                            logger.warning(
+                                "Task %s requires %s which no alive node "
+                                "satisfies (cluster total: %s). It will stay "
+                                "pending until the cluster grows (autoscaler "
+                                "demand).", spec.name, spec.resources,
+                                self.scheduler.total)
+                        continue
                     acquired = self.scheduler.try_acquire(
-                        spec.resources, pg_id, bundle)
+                        spec.resources, pg_id, bundle,
+                        strategy=spec.scheduling_strategy)
                     if acquired is None:
                         continue
+                    node_id, bidx = acquired
                     worker = self._pop_worker()
                     if worker is None:
-                        self.scheduler.release(spec.resources, pg_id,
-                                               bundle if bundle >= 0 else acquired)
+                        self.scheduler.release(spec.resources, node_id,
+                                               pg_id, bidx)
                         continue
                     self._ready.pop(i)
                     self._inflight[spec.task_id] = spec
-                    spec._acquired_bundle = acquired  # type: ignore[attr-defined]
+                    spec._node_id = node_id  # type: ignore[attr-defined]
+                    spec._acquired_bundle = bidx  # type: ignore[attr-defined]
+                    spec.invalidated = False
                     n_tpus = int(spec.resources.get("TPU", 0))
-                    if n_tpus >= 1 and len(self._free_tpu_ids) >= n_tpus:
-                        spec._tpu_ids = [  # type: ignore[attr-defined]
-                            self._free_tpu_ids.pop() for _ in range(n_tpus)]
+                    if n_tpus >= 1:
+                        spec._tpu_ids = (  # type: ignore[attr-defined]
+                            self.scheduler.take_tpu_ids(node_id, n_tpus))
                     launched = (spec, worker)
                     break
             if launched is None or launched is True:
@@ -537,6 +610,19 @@ class Runtime:
         return args, kwargs
 
     def _store_results(self, spec: TaskSpec, result: Any) -> None:
+        if getattr(spec, "invalidated", False):
+            # The task's node died while it ran; a retry owns the return
+            # objects now (reference: a worker on a dead node can't deliver).
+            return
+        node_id = getattr(spec, "_node_id", None)
+        if node_id is not None:
+            with self._lock:
+                # Same bound as _lineage: past it, objects are simply not
+                # reconstructable (the maps must not grow without limit in
+                # long-running drivers).
+                if len(self._object_locations) < 1_000_000:
+                    for oid in spec.return_ids:
+                        self._object_locations[oid] = node_id
         n = spec.num_returns
         if n == 0:
             return
@@ -565,7 +651,8 @@ class Runtime:
 
     def _store_error(self, spec: TaskSpec, exc: BaseException) -> None:
         if not isinstance(exc, (TaskError, ActorDiedError, TaskCancelledError,
-                                GetTimeoutError)):
+                                GetTimeoutError, NodeDiedError,
+                                ObjectLostError)):
             exc = TaskError.from_exception(exc, spec.name)
         for oid in spec.return_ids:
             self.store.put_inline(oid, exc, is_exception=True)
@@ -591,12 +678,22 @@ class Runtime:
             args, kwargs = self._resolve_args(spec)
             _task_context.spec = spec
             try:
-                result = fn(*args, **kwargs)
+                if spec.runtime_env:
+                    from ray_tpu._private import runtime_env as _renv
+                    _renv.setup(spec.runtime_env)
+                    with _renv.applied(spec.runtime_env):
+                        result = fn(*args, **kwargs)
+                else:
+                    result = fn(*args, **kwargs)
             finally:
                 _task_context.spec = None
             self._store_results(spec, result)
             self._record_event(spec, "FINISHED")
         except BaseException as e:  # noqa: BLE001
+            if getattr(spec, "invalidated", False):
+                self._return_worker(worker)
+                self._dispatch()
+                return
             err = e if isinstance(e, TaskError) else TaskError(
                 e, traceback.format_exc(), spec.name)
             if self._should_retry(spec, err):
@@ -611,14 +708,18 @@ class Runtime:
 
     def _finish_task(self, spec: TaskSpec, worker: Executor,
                      retried: bool = False) -> None:
-        pg_id, bundle = self._pg_key(spec)
-        acquired = getattr(spec, "_acquired_bundle", -1)
-        self.scheduler.release(spec.resources, pg_id,
-                               bundle if bundle >= 0 else acquired)
+        if getattr(spec, "invalidated", False):
+            # remove_node already released this node's resources wholesale.
+            self._return_worker(worker)
+            self._dispatch()
+            return
+        pg_id, _ = self._pg_key(spec)
+        node_id = getattr(spec, "_node_id", None)
+        bidx = getattr(spec, "_acquired_bundle", -1)
+        self.scheduler.release(spec.resources, node_id, pg_id, bidx)
         tpu_ids = getattr(spec, "_tpu_ids", None)
-        if tpu_ids:
-            with self._lock:
-                self._free_tpu_ids.extend(tpu_ids)
+        if tpu_ids and node_id is not None:
+            self.scheduler.return_tpu_ids(node_id, tpu_ids)
             spec._tpu_ids = None  # type: ignore[attr-defined]
         with self._lock:
             self._inflight.pop(spec.task_id, None)
@@ -686,14 +787,13 @@ class Runtime:
                 state.resources_released = True
                 return
             state.resources_released = True
-        pg_id, bundle = self._pg_key(spec)
-        acquired = getattr(spec, "_acquired_bundle", -1)
-        self.scheduler.release(spec.resources, pg_id,
-                               bundle if bundle >= 0 else acquired)
+        pg_id, _ = self._pg_key(spec)
+        node_id = getattr(spec, "_node_id", None)
+        bidx = getattr(spec, "_acquired_bundle", -1)
+        self.scheduler.release(spec.resources, node_id, pg_id, bidx)
         tpu_ids = getattr(spec, "_tpu_ids", None)
-        if tpu_ids:
-            with self._lock:
-                self._free_tpu_ids.extend(tpu_ids)
+        if tpu_ids and node_id is not None:
+            self.scheduler.return_tpu_ids(node_id, tpu_ids)
             spec._tpu_ids = None  # type: ignore[attr-defined]
 
     def _run_actor_creation(self, spec: TaskSpec, worker: Executor) -> None:
@@ -703,9 +803,21 @@ class Runtime:
             args, kwargs = self._resolve_args(spec)
             _task_context.spec = spec
             try:
-                instance = cls(*args, **kwargs)
+                if spec.runtime_env:
+                    from ray_tpu._private import runtime_env as _renv
+                    _renv.setup(spec.runtime_env)
+                    with _renv.applied(spec.runtime_env):
+                        instance = cls(*args, **kwargs)
+                else:
+                    instance = cls(*args, **kwargs)
             finally:
                 _task_context.spec = None
+            if spec.invalidated:
+                # Node died mid-__init__; a cloned creation owns the actor
+                # now. Discard this thread's work entirely.
+                self._return_worker(worker)
+                self._dispatch()
+                return
             executor = self._make_actor_executor(state)
             killed = False
             with state.lock:
@@ -730,6 +842,10 @@ class Runtime:
                 self.store.put_inline(spec.return_ids[0], None)
                 self._record_event(spec, "FINISHED")
         except BaseException as e:  # noqa: BLE001
+            if spec.invalidated:
+                self._return_worker(worker)
+                self._dispatch()
+                return
             err = TaskError(e, traceback.format_exc(),
                             f"{spec.name}.__init__")
             with state.lock:
@@ -750,7 +866,8 @@ class Runtime:
                     self._named_actors.pop((state.namespace, state.name),
                                            None)
         with self._lock:
-            self._inflight.pop(spec.task_id, None)
+            if self._inflight.get(spec.task_id) is spec:
+                self._inflight.pop(spec.task_id, None)
         self._return_worker(worker)
         self._dispatch()
 
@@ -1046,11 +1163,175 @@ class Runtime:
                                strategy: str = "PACK",
                                name: str = "") -> PlacementGroupID:
         pg_id = PlacementGroupID.from_random()
-        self.scheduler.create_placement_group(pg_id, bundles)
+        self.scheduler.create_placement_group(pg_id, bundles, strategy)
         return pg_id
 
     def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
         self.scheduler.remove_placement_group(pg_id)
+        self._dispatch()
+
+    # ------------------------------------------------------------------
+    # Node membership (cluster_utils.Cluster / autoscaler entry points)
+    # ------------------------------------------------------------------
+
+    def add_node(self, resources: Dict[str, float],
+                 labels: Optional[dict] = None) -> NodeID:
+        node_id = self.scheduler.add_node(resources, labels=labels)
+        # Bundles orphaned by an earlier node death land here if they fit.
+        self.scheduler.reschedule_lost_bundles()
+        self._dispatch()  # new capacity may unblock queued tasks
+        return node_id
+
+    def remove_node(self, node_id: NodeID) -> None:
+        """Simulate node failure: running tasks there fail (and retry
+        elsewhere within budget), actors restart elsewhere (max_restarts),
+        objects whose primary copy lived there are reconstructed from
+        lineage (reference: NodeManager death handling + ObjectRecovery)."""
+        state = self.scheduler.remove_node(node_id)
+        if state is None:
+            return
+        # 1) In-flight tasks on the dead node.
+        with self._lock:
+            doomed = [s for s in self._inflight.values()
+                      if getattr(s, "_node_id", None) == node_id
+                      and s.kind != TaskKind.ACTOR_CREATION]
+        for spec in doomed:
+            spec.invalidated = True
+            with self._lock:
+                self._inflight.pop(spec.task_id, None)
+            self._retry_after_node_death(spec, node_id)
+        # 2) Actors homed on the dead node.
+        with self._lock:
+            actors_snapshot = list(self._actors.values())
+        dead_actors = [a for a in actors_snapshot
+                       if getattr(a.creation_spec, "_node_id", None) == node_id
+                       and not a.dead]
+        for actor in dead_actors:
+            self._handle_actor_node_death(actor, node_id)
+        # 3) Lost objects → lineage reconstruction.
+        self._recover_lost_objects(node_id)
+        # 4) PG bundles on the dead node move to live nodes (best effort).
+        self.scheduler.reschedule_lost_bundles()
+        self._dispatch()
+
+    def _retry_after_node_death(self, spec: TaskSpec, node_id: NodeID) -> None:
+        err = NodeDiedError(
+            f"Task {spec.name} failed: node {node_id.hex()[:12]} died while "
+            "it was running.")
+        if spec.attempt_number < spec.max_retries:
+            # Clone: the original spec stays invalidated so its (still
+            # running) zombie thread can't store results or double-release.
+            retry = spec.clone_for_retry()
+            with self._lock:
+                for oid in retry.return_ids:
+                    if oid in self._lineage:
+                        self._lineage[oid] = retry
+            logger.warning("Node %s died; retrying task %s (attempt %d/%d)",
+                           node_id.hex()[:12], spec.name,
+                           retry.attempt_number, retry.max_retries)
+            self._resolve_dependencies(retry)
+        else:
+            # Seal the error directly (the spec stays invalidated so the
+            # zombie thread skips its own bookkeeping).
+            for oid in spec.return_ids:
+                self.store.put_inline(oid, err, is_exception=True)
+            self._record_event(spec, "FAILED")
+
+    def _handle_actor_node_death(self, state: ActorState,
+                                 node_id: NodeID) -> None:
+        cause = ActorDiedError(
+            state.actor_id,
+            f"The actor died because its node {node_id.hex()[:12]} died.")
+        can_restart = (state.max_restarts == -1
+                       or state.num_restarts < state.max_restarts)
+        with state.lock:
+            old_executor = state.executor
+            state.executor = None
+            state.instance = None
+            state.created.clear()
+            unfinished = list(state.unfinished.values())
+            state.unfinished.clear()
+            state.pre_creation_queue.clear()
+            if old_executor is not None:
+                old_executor.stop()
+            if can_restart:
+                state.num_restarts += 1
+                for spec in unfinished:
+                    handle = spec.caller_handle_id or "default"
+                    seq_state = state.seq_state.setdefault(
+                        handle, {"next": 1, "waiting": {}, "aborted": set()})
+                    if spec.sequence_number >= seq_state["next"]:
+                        seq_state["aborted"].add(spec.sequence_number)
+                for seq_state in state.seq_state.values():
+                    self._drain_actor_seq(state, seq_state)
+            else:
+                state.dead = True
+                state.death_cause = cause
+                state.created.set()
+        for spec in unfinished:
+            self._store_error(spec, cause)
+        if not can_restart:
+            with self._lock:
+                if state.name:
+                    self._named_actors.pop((state.namespace, state.name), None)
+            return
+        # Re-dispatch a CLONE of the creation task through the normal path so
+        # the actor comes up on an alive node with a fresh acquisition. The
+        # original spec stays invalidated: if its __init__ is still running
+        # on a zombie thread, that thread discards its work.
+        state.creation_spec.invalidated = True
+        creation = state.creation_spec.clone_for_retry()
+        with state.lock:
+            state.creation_spec = creation
+            state.resources_released = False
+        logger.warning("Node %s died; restarting actor %s elsewhere "
+                       "(restart %d)", node_id.hex()[:12],
+                       state.name or state.actor_id.hex()[:8],
+                       state.num_restarts)
+        with self._lock:
+            self._ready.append(creation)
+
+    def _recover_lost_objects(self, node_id: NodeID) -> None:
+        with self._lock:
+            lost = [oid for oid, nid in self._object_locations.items()
+                    if nid == node_id]
+            for oid in lost:
+                self._object_locations.pop(oid, None)
+        to_reconstruct: Dict[TaskID, TaskSpec] = {}
+        plain_lost: List[ObjectID] = []
+        for oid in lost:
+            if not self.store.contains(oid):
+                continue
+            spec = self._lineage.get(oid)
+            if spec is None or spec.kind == TaskKind.ACTOR_TASK or \
+                    getattr(spec, "invalidated", False) or \
+                    spec.attempt_number >= spec.max_retries:
+                # No lineage, or the retry budget is spent: reconstruction
+                # would re-run a task the user bounded (reference seals
+                # ObjectReconstructionFailedError in this case).
+                plain_lost.append(oid)
+            else:
+                to_reconstruct[spec.task_id] = spec
+        invalidate = [oid for spec in to_reconstruct.values()
+                      for oid in spec.return_ids]
+        self.store.invalidate(invalidate)
+        for oid in plain_lost:
+            # No lineage (e.g. ray.put or actor-task result): unrecoverable.
+            self.store.invalidate([oid])
+            self.store.put_inline(oid, ObjectLostError(
+                f"Object {oid.hex()} was on node {node_id.hex()[:12]} which "
+                "died, and it cannot be reconstructed (no task lineage, or "
+                "the task's retry budget is exhausted)."),
+                is_exception=True)
+        for spec in to_reconstruct.values():
+            logger.warning("Reconstructing objects of task %s after node %s "
+                           "death", spec.name, node_id.hex()[:12])
+            clone = spec.clone_for_retry()
+            with self._lock:
+                for oid in clone.return_ids:
+                    if oid in self._lineage:
+                        self._lineage[oid] = clone
+            self._resolve_dependencies(clone)
 
     # ------------------------------------------------------------------
     # Introspection / lifecycle
@@ -1068,6 +1349,13 @@ class Runtime:
 
     def task_events(self) -> List[dict]:
         return list(self._task_events)
+
+    def pending_resource_demand(self) -> List[Dict[str, float]]:
+        """Resource shapes of queued-but-unschedulable tasks (the analog of
+        the reference's backlog/demand report feeding autoscaler
+        LoadMetrics)."""
+        with self._lock:
+            return [dict(s.resources) for s in self._ready if s.resources]
 
     def cluster_resources(self) -> Dict[str, float]:
         return dict(self.scheduler.total)

@@ -1,0 +1,120 @@
+"""Runtime environments (analog of python/ray/_private/runtime_env/).
+
+The reference materializes per-task/actor environments (conda/pip/
+working_dir/py_modules/env_vars) through a per-node agent before the worker
+starts (dashboard/modules/runtime_env/runtime_env_agent.py:162). On the
+in-process thread backend the environment is necessarily process-shared, so
+the supported subset is what composes safely:
+
+* ``env_vars`` — applied around task execution under a global lock (visible
+  to the task body via os.environ, restored after).
+* ``working_dir`` / ``py_modules`` — validated + prepended to sys.path once
+  per unique URI (the reference's URI cache, _private/runtime_env/uri_cache.py).
+* ``pip`` / ``conda`` — validated and recorded; actual installation requires
+  the process worker backend and is rejected with RuntimeEnvSetupError unless
+  the packages are already importable.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.exceptions import RuntimeEnvSetupError
+
+_KNOWN_FIELDS = {"env_vars", "working_dir", "py_modules", "pip", "conda",
+                 "config", "excludes"}
+
+_path_cache: set = set()
+_env_lock = threading.RLock()
+
+
+def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    if not runtime_env:
+        return {}
+    unknown = set(runtime_env) - _KNOWN_FIELDS
+    if unknown:
+        raise ValueError(
+            f"Unknown runtime_env fields {sorted(unknown)}; supported: "
+            f"{sorted(_KNOWN_FIELDS)}")
+    env_vars = runtime_env.get("env_vars")
+    if env_vars is not None:
+        if not isinstance(env_vars, dict) or not all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in env_vars.items()):
+            raise ValueError("runtime_env['env_vars'] must be Dict[str, str]")
+    wd = runtime_env.get("working_dir")
+    if wd is not None and not os.path.isdir(wd):
+        raise ValueError(
+            f"runtime_env['working_dir'] {wd!r} is not a directory")
+    return dict(runtime_env)
+
+
+def setup(runtime_env: Dict[str, Any]) -> None:
+    """One-time setup of the path-based parts (URI-cached)."""
+    wd = runtime_env.get("working_dir")
+    if wd:
+        wd = os.path.abspath(wd)
+        if wd not in _path_cache:
+            sys.path.insert(0, wd)
+            _path_cache.add(wd)
+    for mod_path in runtime_env.get("py_modules") or []:
+        mod_path = os.path.abspath(mod_path)
+        parent = os.path.dirname(mod_path)
+        if parent not in _path_cache:
+            sys.path.insert(0, parent)
+            _path_cache.add(parent)
+    for pkg in runtime_env.get("pip") or []:
+        dist_name = pkg.split("==")[0].split(">=")[0].split("[")[0].strip()
+        mod_name = dist_name.replace("-", "_")
+        if importlib.util.find_spec(mod_name) is not None:
+            continue
+        # Distribution name != module name (scikit-learn→sklearn,
+        # Pillow→PIL): check installed distribution metadata.
+        try:
+            import importlib.metadata as _md
+            _md.distribution(dist_name)
+            continue
+        except Exception:  # noqa: BLE001 - PackageNotFoundError et al.
+            pass
+        raise RuntimeEnvSetupError(
+            f"runtime_env['pip'] requires {pkg!r} which is not installed; "
+            "in-process workers cannot install packages (no network). "
+            "Pre-install it or drop the requirement.")
+
+
+class applied:
+    """Context manager applying env_vars around a task body.
+
+    The lock is held only while mutating os.environ (set on enter, restore
+    on exit), NOT across the task body — holding it for the body would
+    serialize every env_vars task and deadlock nested ``ray.get`` chains.
+    The cost: concurrent tasks with *conflicting* env_vars can observe each
+    other's values (os.environ is process-global on the thread backend; the
+    reference gets true isolation from process workers)."""
+
+    def __init__(self, runtime_env: Optional[Dict[str, Any]]):
+        self._env_vars = (runtime_env or {}).get("env_vars") or {}
+        self._saved: Dict[str, Optional[str]] = {}
+
+    def __enter__(self):
+        if not self._env_vars:
+            return self
+        with _env_lock:
+            for k, v in self._env_vars.items():
+                self._saved[k] = os.environ.get(k)
+                os.environ[k] = v
+        return self
+
+    def __exit__(self, *exc):
+        if not self._env_vars:
+            return
+        with _env_lock:
+            for k, old in self._saved.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old

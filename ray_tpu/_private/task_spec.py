@@ -56,6 +56,9 @@ def validate_options(options: Dict[str, Any], for_actor: bool) -> Dict[str, Any]
                     f"Use num_cpus/num_tpus/num_gpus instead of resources[{k!r}]")
             if not isinstance(v, (int, float)) or v < 0:
                 raise ValueError(f"resources[{k!r}] must be non-negative")
+    if options.get("runtime_env") is not None:
+        from ray_tpu._private import runtime_env as _renv
+        _renv.validate(options["runtime_env"])
     num_returns = options.get("num_returns")
     if num_returns is not None:
         if num_returns != "dynamic" and (
@@ -111,3 +114,20 @@ class TaskSpec:
     # Filled at submission: ObjectRef deps that must be resolved pre-dispatch.
     dependencies: List[ObjectID] = field(default_factory=list)
     attempt_number: int = 0
+    runtime_env: Optional[Dict[str, Any]] = None
+    # Set when the task's node died mid-run: results are discarded, a retry
+    # owns the return objects (multi-node failure semantics).
+    invalidated: bool = False
+
+    def clone_for_retry(self) -> "TaskSpec":
+        """Fresh spec for a node-death retry/reconstruction. The original
+        stays invalidated forever (its zombie thread must not store results
+        or release resources); the clone shares return_ids so the retry
+        seals the same objects, but carries none of the original's placement
+        state (_node_id/_acquired_bundle/_tpu_ids live only on instances
+        that went through dispatch)."""
+        import dataclasses
+        clone = dataclasses.replace(self)
+        clone.attempt_number = self.attempt_number + 1
+        clone.invalidated = False
+        return clone

@@ -122,7 +122,7 @@ class ClientContext:
     def __init__(self, worker: Worker):
         self._worker = worker
         self.address_info = {
-            "node_id": "local",
+            "node_id": worker.runtime.head_node_id.hex(),
             "address": "local",
             "num_cpus": worker.runtime.node_resources.num_cpus,
             "num_tpus": worker.runtime.node_resources.num_tpus,
@@ -205,17 +205,12 @@ def available_resources() -> Dict[str, float]:
 
 
 def nodes() -> List[dict]:
-    runtime = global_worker.runtime
-    return [{
-        "NodeID": "local",
-        "Alive": True,
-        "Resources": runtime.cluster_resources(),
-        "node:__internal_head__": 1.0,
-    }]
+    return global_worker.runtime.scheduler.nodes_snapshot()
 
 
 def free(object_refs: Sequence[ObjectRef]) -> None:
-    global_worker.runtime.store.free([r.object_id() for r in object_refs])
+    global_worker.runtime.free_objects(
+        [r.object_id() for r in object_refs])
 
 
 def get_tpu_ids() -> List[int]:

@@ -159,6 +159,7 @@ class ActorClass:
             max_retries=0,
             actor_id=actor_id,
             scheduling_strategy=strategy,
+            runtime_env=options.get("runtime_env"),
         )
         actual_id = runtime.create_actor(
             spec,

@@ -1,0 +1,12 @@
+from ray_tpu.autoscaler.autoscaler import LoadMetrics, StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import (FakeMultiNodeProvider,
+                                              NodeProvider,
+                                              TPUPodNodeProvider)
+
+__all__ = [
+    "StandardAutoscaler",
+    "LoadMetrics",
+    "NodeProvider",
+    "FakeMultiNodeProvider",
+    "TPUPodNodeProvider",
+]

@@ -1,0 +1,181 @@
+"""The autoscaler loop (analog of autoscaler/_private/autoscaler.py:168).
+
+`StandardAutoscaler.update()` mirrors the reference's control loop: read
+LoadMetrics (pending resource demand + per-node idleness from the cluster
+scheduler, the analog of GCS resource reports), bin-pack unmet demand onto
+configured node types (resource_demand_scheduler.py), launch via the
+NodeProvider, and terminate nodes idle past the timeout. TPU specifics: a
+node type whose config names an ``accelerator_type`` launches whole pod
+slices atomically (TPUPodNodeProvider), because a slice is the unit of both
+scheduling (an ICI mesh) and failure.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import (NODE_KIND_WORKER,
+                                              TAG_RAY_NODE_KIND,
+                                              TAG_RAY_USER_NODE_TYPE,
+                                              NodeProvider)
+
+
+class LoadMetrics:
+    """Cluster load snapshot (analog of autoscaler/_private/load_metrics.py;
+    source = the in-process cluster scheduler instead of GCS reports)."""
+
+    def __init__(self):
+        self.pending_demand: List[Dict[str, float]] = []
+        self.node_utilization: Dict[str, float] = {}
+        self.node_idle_since: Dict[str, float] = {}
+        self._last_update = 0.0
+
+    def update(self) -> None:
+        from ray_tpu._private.worker import global_worker
+        runtime = global_worker.runtime
+        self.pending_demand = runtime.pending_resource_demand()
+        now = time.time()
+        for state in runtime.scheduler.alive_nodes():
+            node_id = state.node_id.hex()
+            util = state.utilization()
+            self.node_utilization[node_id] = util
+            if util > 0:
+                self.node_idle_since.pop(node_id, None)
+            else:
+                self.node_idle_since.setdefault(node_id, now)
+        self._last_update = now
+
+
+def _fits(capacity: Dict[str, float], demand: Dict[str, float]) -> bool:
+    return all(capacity.get(k, 0.0) >= v for k, v in demand.items()
+               if not k.startswith("node:"))
+
+
+class StandardAutoscaler:
+    """Config schema (subset of the reference's cluster YAML):
+
+    .. code-block:: python
+
+        {
+          "max_workers": 8,
+          "idle_timeout_minutes": 5,
+          "available_node_types": {
+            "cpu-worker": {"resources": {"CPU": 4},
+                           "min_workers": 0, "max_workers": 4},
+            "tpu-v4-8": {"node_config": {"accelerator_type": "v4-8"},
+                         "resources": {"TPU": 4, "CPU": 8},
+                         "min_workers": 0, "max_workers": 2},
+          },
+        }
+    """
+
+    def __init__(self, provider: NodeProvider, config: Dict[str, Any],
+                 load_metrics: Optional[LoadMetrics] = None):
+        self.provider = provider
+        self.config = dict(config)
+        self.load_metrics = load_metrics or LoadMetrics()
+        self.node_types: Dict[str, dict] = dict(
+            config.get("available_node_types", {}))
+        self.max_workers = int(config.get("max_workers", 8))
+        self.idle_timeout_s = float(
+            config.get("idle_timeout_minutes", 5)) * 60.0
+        self.num_launches = 0
+        self.num_terminations = 0
+
+    # -- views ------------------------------------------------------------
+
+    def workers_of_type(self, type_name: str) -> List[str]:
+        return self.provider.non_terminated_nodes(
+            {TAG_RAY_USER_NODE_TYPE: type_name})
+
+    def total_workers(self) -> List[str]:
+        return self.provider.non_terminated_nodes(
+            {TAG_RAY_NODE_KIND: NODE_KIND_WORKER})
+
+    # -- the loop body ----------------------------------------------------
+
+    def update(self) -> Dict[str, int]:
+        """One reconcile pass. Returns {"launched": n, "terminated": m}."""
+        self.load_metrics.update()
+        launched = self._scale_up()
+        terminated = self._scale_down()
+        return {"launched": launched, "terminated": terminated}
+
+    def _scale_up(self) -> int:
+        # Enforce per-type min_workers first.
+        launched = 0
+        for type_name, spec in self.node_types.items():
+            want = int(spec.get("min_workers", 0))
+            have = len(self.workers_of_type(type_name))
+            if have < want:
+                n = want - have
+                self._launch(type_name, n)
+                launched += n
+        # Bin-pack unmet demand: demands that no alive node can ever fit
+        # need a new node of a type whose resources cover them.
+        unmet = self._unmet_demand()
+        for demand in unmet:
+            if len(self.total_workers()) + launched >= self.max_workers:
+                break
+            type_name = self._pick_node_type(demand)
+            if type_name is None:
+                continue
+            spec = self.node_types[type_name]
+            if len(self.workers_of_type(type_name)) >= int(
+                    spec.get("max_workers", self.max_workers)):
+                continue
+            self._launch(type_name, 1)
+            launched += 1
+        return launched
+
+    def _unmet_demand(self) -> List[Dict[str, float]]:
+        from ray_tpu._private.worker import global_worker
+        runtime = global_worker.runtime
+        caps = [dict(s.local.total)
+                for s in runtime.scheduler.alive_nodes()]
+        unmet = []
+        for demand in self.load_metrics.pending_demand:
+            if not any(_fits(cap, demand) for cap in caps):
+                unmet.append(demand)
+        return unmet
+
+    def _pick_node_type(self, demand: Dict[str, float]) -> Optional[str]:
+        best = None
+        best_size = float("inf")
+        for type_name, spec in self.node_types.items():
+            resources = spec.get("resources", {})
+            if _fits(resources, demand):
+                size = sum(v for v in resources.values())
+                if size < best_size:
+                    best, best_size = type_name, size
+        return best
+
+    def _launch(self, type_name: str, count: int) -> None:
+        spec = self.node_types[type_name]
+        node_config = dict(spec.get("node_config", {}))
+        if "resources" not in node_config and "resources" in spec:
+            node_config["resources"] = dict(spec["resources"])
+        self.provider.create_node(
+            node_config,
+            {TAG_RAY_NODE_KIND: NODE_KIND_WORKER,
+             TAG_RAY_USER_NODE_TYPE: type_name},
+            count)
+        self.num_launches += count
+
+    def _scale_down(self) -> int:
+        now = time.time()
+        terminated = 0
+        for type_name, spec in self.node_types.items():
+            keep = int(spec.get("min_workers", 0))
+            workers = self.workers_of_type(type_name)
+            for node_id in workers:
+                if len(self.workers_of_type(type_name)) <= keep:
+                    break
+                idle_since = self.load_metrics.node_idle_since.get(node_id)
+                if idle_since is not None and \
+                        now - idle_since > self.idle_timeout_s:
+                    self.provider.terminate_node(node_id)
+                    self.num_terminations += 1
+                    terminated += 1
+        return terminated

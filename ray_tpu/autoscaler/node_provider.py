@@ -1,0 +1,175 @@
+"""Pluggable node providers (analog of python/ray/autoscaler/node_provider.py:13).
+
+The reference's `NodeProvider` abstracts the cloud behind create/terminate/
+list/tag operations; concrete providers exist for AWS/GCP/Azure/local/fake.
+Here the same interface drives virtual nodes (FakeMultiNodeProvider — the
+analog of autoscaler/_private/fake_multi_node/node_provider.py used by
+test_autoscaler_fake_multinode.py) and TPU pod slices (TPUPodNodeProvider —
+the TPU-native provider the reference never had: one "node" is one TPU host
+of a pod slice, carrying its chips as schedulable resources).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+TAG_RAY_NODE_KIND = "ray-node-kind"
+TAG_RAY_NODE_STATUS = "ray-node-status"
+TAG_RAY_USER_NODE_TYPE = "ray-user-node-type"
+NODE_KIND_HEAD = "head"
+NODE_KIND_WORKER = "worker"
+STATUS_UP_TO_DATE = "up-to-date"
+
+
+class NodeProvider:
+    """Interface; mirrors the reference's abstract methods."""
+
+    def __init__(self, provider_config: Dict[str, Any], cluster_name: str):
+        self.provider_config = dict(provider_config or {})
+        self.cluster_name = cluster_name
+
+    def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
+        raise NotImplementedError
+
+    def is_running(self, node_id: str) -> bool:
+        raise NotImplementedError
+
+    def is_terminated(self, node_id: str) -> bool:
+        return not self.is_running(node_id)
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        raise NotImplementedError
+
+    def set_node_tags(self, node_id: str, tags: Dict[str, str]) -> None:
+        raise NotImplementedError
+
+    def create_node(self, node_config: Dict[str, Any],
+                    tags: Dict[str, str], count: int) -> None:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def internal_ip(self, node_id: str) -> str:
+        return node_id
+
+    def external_ip(self, node_id: str) -> str:
+        return node_id
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Launches virtual nodes into the live in-process cluster."""
+
+    def __init__(self, provider_config: Optional[Dict[str, Any]] = None,
+                 cluster_name: str = "fake"):
+        super().__init__(provider_config or {}, cluster_name)
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, dict] = {}  # provider node id -> record
+
+    def _runtime(self):
+        from ray_tpu._private.worker import global_worker
+        return global_worker.runtime
+
+    def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
+        with self._lock:
+            out = []
+            for node_id, rec in self._nodes.items():
+                if rec["terminated"]:
+                    continue
+                if all(rec["tags"].get(k) == v
+                       for k, v in (tag_filters or {}).items()):
+                    out.append(node_id)
+            return out
+
+    def is_running(self, node_id: str) -> bool:
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            return rec is not None and not rec["terminated"]
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._nodes[node_id]["tags"])
+
+    def set_node_tags(self, node_id: str, tags: Dict[str, str]) -> None:
+        with self._lock:
+            self._nodes[node_id]["tags"].update(tags)
+
+    def create_node(self, node_config: Dict[str, Any],
+                    tags: Dict[str, str], count: int) -> None:
+        resources = dict(node_config.get("resources", {"CPU": 1}))
+        resources.setdefault("memory", 1 << 30)
+        runtime = self._runtime()
+        for _ in range(count):
+            vnode_id = runtime.add_node(resources)
+            tags = dict(tags)
+            tags.setdefault(TAG_RAY_NODE_STATUS, STATUS_UP_TO_DATE)
+            with self._lock:
+                self._nodes[vnode_id.hex()] = {
+                    "tags": dict(tags),
+                    "resources": resources,
+                    "vnode_id": vnode_id,
+                    "terminated": False,
+                }
+
+    def terminate_node(self, node_id: str) -> None:
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec is None or rec["terminated"]:
+                return
+            rec["terminated"] = True
+            vnode_id = rec["vnode_id"]
+        self._runtime().remove_node(vnode_id)
+
+
+# TPU pod slice topologies: accelerator type -> (hosts, chips per host).
+# One autoscaler "node" = one host of the slice (4 chips on v4/v5p hosts,
+# 8 on v5e/v6e single-host topologies vary; this table covers the common
+# slices the JaxTrainer mesh config understands).
+TPU_POD_TOPOLOGIES = {
+    "v4-8": (1, 4),
+    "v4-16": (2, 4),
+    "v4-32": (4, 4),
+    "v4-64": (8, 4),
+    "v4-128": (16, 4),
+    "v5p-8": (1, 4),
+    "v5p-16": (2, 4),
+    "v5litepod-8": (1, 8),
+    "v5litepod-16": (2, 8),
+    "v6e-8": (1, 8),
+}
+
+
+class TPUPodNodeProvider(FakeMultiNodeProvider):
+    """Models TPU pod slices: `create_node` with an ``accelerator_type``
+    node_config brings up every host of the slice at once (a slice is atomic
+    — it fails and scales as a unit, unlike GPU nodes), each host carrying
+    its chips plus an ``accelerator_type:TPU-<gen>`` constraint resource the
+    way the reference auto-adds accelerator_type:<X> for GPUs
+    (python/ray/_private/resource_spec.py:181-186)."""
+
+    def create_node(self, node_config: Dict[str, Any],
+                    tags: Dict[str, str], count: int) -> None:
+        acc = node_config.get("accelerator_type", "v4-8")
+        if acc not in TPU_POD_TOPOLOGIES:
+            raise ValueError(
+                f"Unknown TPU pod topology {acc!r}; known: "
+                f"{sorted(TPU_POD_TOPOLOGIES)}")
+        hosts, chips = TPU_POD_TOPOLOGIES[acc]
+        gen = acc.split("-")[0].split("litepod")[0].upper()
+        for _ in range(count):
+            slice_tags = dict(tags)
+            slice_tags["tpu-slice"] = acc
+            cfg = {
+                "resources": {
+                    "CPU": float(node_config.get("cpus_per_host", 8)),
+                    "TPU": float(chips),
+                    f"accelerator_type:TPU-{gen}": 1.0,
+                    f"TPU-{acc}-head": 1.0,  # rank-0 host marker
+                },
+            }
+            super().create_node(cfg, slice_tags, 1)
+            for _ in range(hosts - 1):
+                host_cfg = {"resources": dict(cfg["resources"])}
+                del host_cfg["resources"][f"TPU-{acc}-head"]
+                super().create_node(host_cfg, slice_tags, 1)

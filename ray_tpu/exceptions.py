@@ -64,6 +64,12 @@ class ActorUnavailableError(ActorError):
     pass
 
 
+class NodeDiedError(RayError):
+    """The node a task was running on died (reference: node failure surfaces
+    as RayTaskError with a node-death cause; here it is first-class)."""
+    pass
+
+
 class WorkerCrashedError(RayError):
     """The worker process executing the task died."""
 

@@ -1,0 +1,4 @@
+from ray_tpu.job_submission.job_manager import (JobDetails, JobManager,
+                                                JobStatus, JobSubmissionClient)
+
+__all__ = ["JobSubmissionClient", "JobManager", "JobStatus", "JobDetails"]

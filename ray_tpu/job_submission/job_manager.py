@@ -1,0 +1,249 @@
+"""Job submission (analog of dashboard/modules/job/).
+
+The reference runs each submitted job's entrypoint as a subprocess supervised
+by a JobSupervisor actor, with status persisted to GCS KV and logs streamed
+to per-job files (dashboard/modules/job/job_manager.py); the SDK/CLI talk to
+it over REST (modules/job/sdk.py:40). Here the JobManager supervises the
+subprocess directly (same contract: entrypoint shell command, env injection
+via runtime_env, log capture, status polling, stop); JobSubmissionClient is
+the SDK facade the CLI and user code share.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class JobStatus(str, enum.Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    STOPPED = "STOPPED"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+
+    def is_terminal(self) -> bool:
+        return self in (JobStatus.STOPPED, JobStatus.SUCCEEDED,
+                        JobStatus.FAILED)
+
+
+@dataclass
+class JobDetails:
+    job_id: str
+    submission_id: str
+    entrypoint: str
+    status: JobStatus
+    message: str = ""
+    start_time: Optional[float] = None
+    end_time: Optional[float] = None
+    metadata: Dict[str, str] = field(default_factory=dict)
+    runtime_env: Dict[str, Any] = field(default_factory=dict)
+
+
+class _Job:
+    def __init__(self, details: JobDetails, log_path: str):
+        self.details = details
+        self.log_path = log_path
+        self.process: Optional[subprocess.Popen] = None
+        self.monitor: Optional[threading.Thread] = None
+
+
+class JobManager:
+    """Supervises job subprocesses. One per (head) runtime."""
+
+    def __init__(self, log_dir: Optional[str] = None):
+        import tempfile
+        self._jobs: Dict[str, _Job] = {}
+        self._lock = threading.Lock()
+        # "ray-tpu" (hyphen): an importable dir name here would shadow the
+        # package for any driver whose cwd is the temp dir.
+        self._log_dir = log_dir or os.path.join(
+            tempfile.gettempdir(), "ray-tpu", "job_logs")
+        os.makedirs(self._log_dir, exist_ok=True)
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:16]}"
+        with self._lock:
+            if submission_id in self._jobs:
+                raise ValueError(
+                    f"Job {submission_id!r} already exists.")
+        details = JobDetails(
+            job_id=submission_id, submission_id=submission_id,
+            entrypoint=entrypoint, status=JobStatus.PENDING,
+            metadata=dict(metadata or {}),
+            runtime_env=dict(runtime_env or {}))
+        log_path = os.path.join(self._log_dir, f"{submission_id}.log")
+        job = _Job(details, log_path)
+        with self._lock:
+            self._jobs[submission_id] = job
+        self._start(job)
+        return submission_id
+
+    def _start(self, job: _Job) -> None:
+        env = dict(os.environ)
+        renv = job.details.runtime_env
+        env.update(renv.get("env_vars") or {})
+        env["RAY_TPU_JOB_ID"] = job.details.submission_id
+        cwd = renv.get("working_dir") or None
+        log_file = open(job.log_path, "wb")
+        try:
+            job.process = subprocess.Popen(
+                job.details.entrypoint, shell=True, env=env, cwd=cwd,
+                stdout=log_file, stderr=subprocess.STDOUT)
+        except OSError as e:
+            job.details.status = JobStatus.FAILED
+            job.details.message = f"Failed to start: {e}"
+            log_file.close()
+            return
+        job.details.status = JobStatus.RUNNING
+        job.details.start_time = time.time()
+        job.monitor = threading.Thread(
+            target=self._monitor, args=(job, log_file), daemon=True)
+        job.monitor.start()
+
+    def _monitor(self, job: _Job, log_file) -> None:
+        code = job.process.wait()
+        log_file.close()
+        job.details.end_time = time.time()
+        if job.details.status == JobStatus.STOPPED:
+            return
+        if code == 0:
+            job.details.status = JobStatus.SUCCEEDED
+            job.details.message = "Job finished successfully."
+        else:
+            job.details.status = JobStatus.FAILED
+            job.details.message = f"Job failed with exit code {code}."
+
+    def get_job_status(self, submission_id: str) -> JobStatus:
+        return self._job(submission_id).details.status
+
+    def get_job_info(self, submission_id: str) -> JobDetails:
+        return self._job(submission_id).details
+
+    def get_job_logs(self, submission_id: str) -> str:
+        job = self._job(submission_id)
+        try:
+            with open(job.log_path, "rb") as f:
+                return f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+
+    def stop_job(self, submission_id: str) -> bool:
+        job = self._job(submission_id)
+        if job.details.status.is_terminal() or job.process is None:
+            return False
+        job.details.status = JobStatus.STOPPED
+        job.details.message = "Job was intentionally stopped."
+        job.process.terminate()
+        try:
+            job.process.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            job.process.kill()
+        return True
+
+    def delete_job(self, submission_id: str) -> bool:
+        job = self._job(submission_id)
+        if not job.details.status.is_terminal():
+            raise RuntimeError(
+                f"Job {submission_id!r} is {job.details.status}; stop it "
+                "before deleting.")
+        with self._lock:
+            del self._jobs[submission_id]
+        return True
+
+    def list_jobs(self) -> List[JobDetails]:
+        with self._lock:
+            return [j.details for j in self._jobs.values()]
+
+    def _job(self, submission_id: str) -> _Job:
+        with self._lock:
+            job = self._jobs.get(submission_id)
+        if job is None:
+            raise ValueError(f"Job {submission_id!r} does not exist.")
+        return job
+
+
+_default_manager: Optional[JobManager] = None
+_default_lock = threading.Lock()
+
+
+def _manager() -> JobManager:
+    global _default_manager
+    with _default_lock:
+        if _default_manager is None:
+            _default_manager = JobManager()
+        return _default_manager
+
+
+class JobSubmissionClient:
+    """SDK facade (analog of dashboard/modules/job/sdk.py:40). ``address``
+    is accepted for API parity; the in-process manager serves all of them."""
+
+    def __init__(self, address: Optional[str] = None):
+        self.address = address or "local"
+        self._manager = _manager()
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        return self._manager.submit_job(
+            entrypoint=entrypoint, submission_id=submission_id,
+            runtime_env=runtime_env, metadata=metadata)
+
+    def get_job_status(self, submission_id: str) -> JobStatus:
+        return self._manager.get_job_status(submission_id)
+
+    def get_job_info(self, submission_id: str) -> JobDetails:
+        return self._manager.get_job_info(submission_id)
+
+    def get_job_logs(self, submission_id: str) -> str:
+        return self._manager.get_job_logs(submission_id)
+
+    def stop_job(self, submission_id: str) -> bool:
+        return self._manager.stop_job(submission_id)
+
+    def delete_job(self, submission_id: str) -> bool:
+        return self._manager.delete_job(submission_id)
+
+    def list_jobs(self) -> List[JobDetails]:
+        return self._manager.list_jobs()
+
+    def tail_job_logs(self, submission_id: str, timeout: float = 60.0):
+        """Generator yielding log chunks until the job reaches a terminal
+        state (SDK parity with the reference's async log tailing). Reads
+        incrementally from the last offset (no full-file re-reads)."""
+        log_path = self._manager._job(submission_id).log_path
+        offset = 0
+        deadline = time.monotonic() + timeout
+
+        def _read_new():
+            nonlocal offset
+            try:
+                with open(log_path, "rb") as f:
+                    f.seek(offset)
+                    chunk = f.read()
+            except FileNotFoundError:
+                return ""
+            offset += len(chunk)
+            return chunk.decode(errors="replace")
+
+        while time.monotonic() < deadline:
+            chunk = _read_new()
+            if chunk:
+                yield chunk
+            if self.get_job_status(submission_id).is_terminal():
+                final = _read_new()
+                if final:
+                    yield final
+                return
+            time.sleep(0.2)

@@ -72,6 +72,7 @@ class RemoteFunction:
             max_retries=options.get("max_retries", 3),
             retry_exceptions=options.get("retry_exceptions", False),
             scheduling_strategy=strategy,
+            runtime_env=options.get("runtime_env"),
         )
         refs = runtime.submit_task(spec)
         if num_returns == 0:

@@ -15,13 +15,23 @@ class RuntimeContext:
 
     @property
     def node_id(self) -> str:
-        return "local"
+        return self.get_node_id()
 
     def get_job_id(self) -> str:
         return global_worker.job_id.hex() if global_worker.job_id else ""
 
     def get_node_id(self) -> str:
-        return "local"
+        """Node the current task runs on (driver: the head node)."""
+        spec = current_task_spec()
+        rt = global_worker.runtime
+        node_id = getattr(spec, "_node_id", None) if spec else None
+        if node_id is None and spec is not None and spec.actor_id is not None:
+            state = rt.actor_state(spec.actor_id)
+            if state is not None:
+                node_id = getattr(state.creation_spec, "_node_id", None)
+        if node_id is None:
+            node_id = rt.head_node_id
+        return node_id.hex()
 
     def get_task_id(self) -> Optional[str]:
         spec = current_task_spec()

@@ -79,6 +79,48 @@ def cmd_devices(args) -> int:
     return 0
 
 
+def cmd_job(args) -> int:
+    """`ray-tpu job submit/status/logs/stop/list` (analog of the reference's
+    `ray job` CLI, dashboard/modules/job/cli.py)."""
+    from ray_tpu.job_submission import JobSubmissionClient
+    client = JobSubmissionClient(getattr(args, "address", None))
+    if args.job_command == "submit":
+        runtime_env = None
+        if args.working_dir:
+            runtime_env = {"working_dir": args.working_dir}
+        import shlex
+        entrypoint = list(args.entrypoint)
+        if entrypoint and entrypoint[0] == "--":
+            entrypoint = entrypoint[1:]
+        job_id = client.submit_job(
+            entrypoint=" ".join(shlex.quote(t) for t in entrypoint),
+            runtime_env=runtime_env,
+            submission_id=args.submission_id)
+        print(job_id)
+        if args.wait:
+            for chunk in client.tail_job_logs(job_id, timeout=args.timeout):
+                sys.stdout.write(chunk)
+            status = client.get_job_status(job_id)
+            print(f"Job {job_id} finished: {status.value}")
+            return 0 if status.value == "SUCCEEDED" else 1
+        return 0
+    if args.job_command == "status":
+        print(client.get_job_status(args.job_id).value)
+        return 0
+    if args.job_command == "logs":
+        print(client.get_job_logs(args.job_id), end="")
+        return 0
+    if args.job_command == "stop":
+        stopped = client.stop_job(args.job_id)
+        print("stopped" if stopped else "already terminal")
+        return 0
+    if args.job_command == "list":
+        for j in client.list_jobs():
+            print(f"{j.submission_id}\t{j.status.value}\t{j.entrypoint}")
+        return 0
+    return 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="ray-tpu",
@@ -97,6 +139,20 @@ def main(argv=None) -> int:
     sub.add_parser("metrics", help="print Prometheus metrics")
     sub.add_parser("devices", help="list visible accelerator devices")
 
+    p = sub.add_parser("job", help="submit and manage jobs")
+    jsub = p.add_subparsers(dest="job_command", required=True)
+    ps = jsub.add_parser("submit", help="run an entrypoint as a job")
+    ps.add_argument("--submission-id", default=None)
+    ps.add_argument("--working-dir", default=None)
+    ps.add_argument("--wait", action="store_true",
+                    help="stream logs until the job finishes")
+    ps.add_argument("--timeout", type=float, default=3600.0)
+    ps.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        pj = jsub.add_parser(name)
+        pj.add_argument("job_id")
+    jsub.add_parser("list")
+
     args = parser.parse_args(argv)
     handler = {
         "status": cmd_status,
@@ -106,6 +162,7 @@ def main(argv=None) -> int:
         "summary": cmd_summary,
         "metrics": cmd_metrics,
         "devices": cmd_devices,
+        "job": cmd_job,
     }[args.command]
     return handler(args)
 

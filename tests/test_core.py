@@ -224,13 +224,20 @@ def test_options_override(ray_start_regular):
     assert res.get("CPU") == 2.0
 
 
-def test_infeasible_task_errors(ray_start_regular):
+def test_infeasible_task_stays_pending(ray_start_regular):
+    """Infeasible tasks queue as autoscaler demand instead of failing
+    (reference behavior: a warning + pending until the cluster grows)."""
+    from ray_tpu.exceptions import GetTimeoutError
+
     @ray.remote(num_cpus=10_000)
     def f():
         return 1
 
-    with pytest.raises((TaskError, ValueError)):
-        ray.get(f.remote(), timeout=5)
+    ref = f.remote()
+    with pytest.raises(GetTimeoutError):
+        ray.get(ref, timeout=0.5)
+    rt = ray._private.worker.global_worker.runtime
+    assert {"CPU": 10_000.0} in rt.pending_resource_demand()
 
 
 def test_invalid_option_rejected(ray_start_regular):

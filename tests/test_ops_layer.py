@@ -1,0 +1,151 @@
+"""Ops layer: autoscaler, runtime_env, job submission."""
+
+import os
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (FakeMultiNodeProvider, LoadMetrics,
+                                StandardAutoscaler, TPUPodNodeProvider)
+
+
+@pytest.fixture
+def small_cluster():
+    ray_tpu.shutdown()
+    ctx = ray_tpu.init(num_cpus=1, _memory=1e9)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_autoscaler_scales_up_for_demand(small_cluster):
+    provider = FakeMultiNodeProvider()
+    autoscaler = StandardAutoscaler(provider, {
+        "max_workers": 4,
+        "idle_timeout_minutes": 60,
+        "available_node_types": {
+            "big-cpu": {"resources": {"CPU": 8},
+                        "min_workers": 0, "max_workers": 2},
+        },
+    })
+
+    # Demand a task no current node can fit.
+    @ray_tpu.remote(num_cpus=8)
+    def big():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    ref = big.remote()
+    time.sleep(0.1)  # let it land in the pending queue
+    result = autoscaler.update()
+    assert result["launched"] == 1
+    # The queued task now runs on the launched node.
+    assert ray_tpu.get(ref, timeout=10)
+    assert len(autoscaler.total_workers()) == 1
+
+
+def test_autoscaler_respects_min_and_max(small_cluster):
+    provider = FakeMultiNodeProvider()
+    autoscaler = StandardAutoscaler(provider, {
+        "max_workers": 3,
+        "available_node_types": {
+            "w": {"resources": {"CPU": 2},
+                  "min_workers": 2, "max_workers": 3},
+        },
+    })
+    autoscaler.update()
+    assert len(autoscaler.workers_of_type("w")) == 2
+    autoscaler.update()  # no new demand: stays at min
+    assert len(autoscaler.workers_of_type("w")) == 2
+
+
+def test_autoscaler_terminates_idle_nodes(small_cluster):
+    provider = FakeMultiNodeProvider()
+    autoscaler = StandardAutoscaler(provider, {
+        "max_workers": 2,
+        "idle_timeout_minutes": 0.0001,  # ~6ms
+        "available_node_types": {
+            "w": {"resources": {"CPU": 2}, "min_workers": 0,
+                  "max_workers": 2},
+        },
+    })
+    provider.create_node({"resources": {"CPU": 2}},
+                         {"ray-node-kind": "worker",
+                          "ray-user-node-type": "w"}, 1)
+    autoscaler.load_metrics.update()
+    time.sleep(0.05)
+    result = autoscaler.update()
+    assert result["terminated"] == 1
+    assert len(autoscaler.total_workers()) == 0
+
+
+def test_tpu_pod_provider_launches_whole_slice(small_cluster):
+    provider = TPUPodNodeProvider()
+    provider.create_node({"accelerator_type": "v4-16"}, {}, 1)
+    # v4-16 = 2 hosts x 4 chips.
+    assert ray_tpu.cluster_resources().get("TPU", 0) == 8
+    nodes = [n for n in ray_tpu.nodes() if n["Resources"].get("TPU")]
+    assert len(nodes) == 2
+    heads = [n for n in nodes
+             if any(k.startswith("TPU-v4-16-head")
+                    for k in n["Resources"])]
+    assert len(heads) == 1
+
+
+def test_runtime_env_env_vars(small_cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_TEST_VAR": "hello"}})
+    def read_env():
+        return os.environ.get("MY_TEST_VAR")
+
+    assert ray_tpu.get(read_env.remote()) == "hello"
+    assert os.environ.get("MY_TEST_VAR") is None  # restored
+
+
+def test_runtime_env_validation(small_cluster):
+    with pytest.raises(ValueError):
+        @ray_tpu.remote(runtime_env={"bogus_field": 1})
+        def bad():
+            pass
+    with pytest.raises(ValueError):
+        @ray_tpu.remote(runtime_env={"env_vars": {"X": 123}})
+        def bad2():
+            pass
+
+
+def test_job_submission_end_to_end(tmp_path):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('job ran ok')\"")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if client.get_job_status(job_id).is_terminal():
+            break
+        time.sleep(0.1)
+    assert client.get_job_status(job_id) == JobStatus.SUCCEEDED
+    assert "job ran ok" in client.get_job_logs(job_id)
+
+
+def test_job_failure_and_stop():
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+    client = JobSubmissionClient()
+    bad = client.submit_job(entrypoint=f"{sys.executable} -c 'import sys; sys.exit(3)'")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if client.get_job_status(bad).is_terminal():
+            break
+        time.sleep(0.1)
+    assert client.get_job_status(bad) == JobStatus.FAILED
+    assert "exit code 3" in client.get_job_info(bad).message
+
+    slow = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+    time.sleep(0.3)
+    assert client.stop_job(slow)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if client.get_job_status(slow).is_terminal():
+            break
+        time.sleep(0.1)
+    assert client.get_job_status(slow) == JobStatus.STOPPED
+    assert any(j.submission_id == slow for j in client.list_jobs())
