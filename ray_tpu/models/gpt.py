@@ -53,8 +53,21 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16  # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True  # checkpoint each block (HBM ⇄ FLOPs trade)
-    attn_impl: str = "dot"  # "dot" | "flash" | "ring"
+    attn_impl: str = "dot"  # "dot" | "flash" | "ring" | "ulysses"
     layernorm_eps: float = 1e-5
+    # Mixture-of-experts: n_experts > 0 replaces every block's dense FFN
+    # with a top-k routed MoE FFN (expert weights sharded over the "ep"
+    # mesh axis; dispatch/combine einsums lower to ICI all-to-all under
+    # GSPMD). The reference has no EP at all (SURVEY.md §2.5) — this is a
+    # new TPU-native capability.
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
 
     @property
     def head_dim(self) -> int:
@@ -67,7 +80,11 @@ class GPTConfig:
     def num_params(self) -> int:
         d, f, v, L = self.d_model, self.d_ff, self.vocab_size, self.n_layers
         kvh = self.kv_heads * self.head_dim
-        per_layer = d * d + 2 * d * kvh + d * d + 2 * d * f + f + d + 2 * d
+        if self.n_experts:
+            ffn = self.n_experts * (2 * d * f + f) + d * self.n_experts
+        else:
+            ffn = 2 * d * f + f
+        per_layer = d * d + 2 * d * kvh + d * d + ffn + d + 2 * d
         head = 0 if self.tie_embeddings else v * d + v
         return v * d + L * per_layer + 2 * d + head
 
@@ -91,6 +108,14 @@ PRESETS: Dict[str, GPTConfig] = {
     "gpt-micro": GPTConfig(
         vocab_size=512, n_layers=4, d_model=128, n_heads=8, d_ff=512,
         rotary_dim=16, max_seq_len=256, dtype=jnp.float32, remat=False),
+    # MoE variants (expert parallelism over the "ep" mesh axis).
+    "gpt-moe-tiny": GPTConfig(
+        vocab_size=256, n_layers=2, d_model=64, n_heads=4, d_ff=128,
+        rotary_dim=8, max_seq_len=128, dtype=jnp.float32, remat=False,
+        n_experts=4),
+    "gpt-moe-8x410m": GPTConfig(
+        vocab_size=50304, n_layers=24, d_model=1024, n_heads=16,
+        d_ff=4096, rotary_dim=32, max_seq_len=1024, n_experts=8),
 }
 
 
@@ -126,11 +151,19 @@ def init(cfg: GPTConfig, key: jax.Array) -> Dict[str, Any]:
         "wk": stack(ks[1], (d, kvh, hd)),
         "wv": stack(ks[2], (d, kvh, hd)),
         "wo": stack(ks[3], (h, hd, d), out_std),
-        "w_in": stack(ks[4], (d, f)),
-        "b_in": jnp.zeros((L, f), pd),
-        "w_out": stack(ks[5], (f, d), out_std),
         "b_out": jnp.zeros((L, d), pd),
     }
+    if cfg.is_moe:
+        E = cfg.n_experts
+        layers["router"] = stack(ks[4], (d, E))
+        k_in, k_out = jax.random.split(ks[5])
+        layers["w_in"] = norm(k_in, (L, E, d, f))
+        layers["b_in"] = jnp.zeros((L, E, f), pd)
+        layers["w_out"] = norm(k_out, (L, E, f, d), out_std)
+    else:
+        layers["w_in"] = stack(ks[4], (d, f))
+        layers["b_in"] = jnp.zeros((L, f), pd)
+        layers["w_out"] = stack(ks[5], (f, d), out_std)
     if not cfg.parallel_block:
         layers["ln2_scale"] = jnp.ones((L, d), pd)
         layers["ln2_bias"] = jnp.zeros((L, d), pd)
@@ -156,11 +189,17 @@ def param_specs(cfg: GPTConfig, rules: ShardingRules) -> Dict[str, Any]:
         "wk": r.spec("layers", "embed", "kv_heads", "head_dim"),
         "wv": r.spec("layers", "embed", "kv_heads", "head_dim"),
         "wo": r.spec("layers", "heads", "head_dim", "embed"),
-        "w_in": r.spec("layers", "embed", "mlp"),
-        "b_in": r.spec("layers", "mlp"),
-        "w_out": r.spec("layers", "mlp", "embed"),
         "b_out": r.spec("layers", "embed"),
     }
+    if cfg.is_moe:
+        layers["router"] = r.spec("layers", "embed", None)
+        layers["w_in"] = r.spec("layers", "expert", "embed", "mlp")
+        layers["b_in"] = r.spec("layers", "expert", "mlp")
+        layers["w_out"] = r.spec("layers", "expert", "mlp", "embed")
+    else:
+        layers["w_in"] = r.spec("layers", "embed", "mlp")
+        layers["b_in"] = r.spec("layers", "mlp")
+        layers["w_out"] = r.spec("layers", "mlp", "embed")
     if not cfg.parallel_block:
         layers["ln2_scale"] = r.spec("layers", "embed")
         layers["ln2_bias"] = r.spec("layers", "embed")
@@ -256,11 +295,71 @@ def _attention(q, k, v, cfg: GPTConfig):
         fn = make_ring_attention(mesh, "sp", causal=True, q_spec=q_spec,
                                  kv_spec=kv_spec)
         return fn(q, k, v)
+    if cfg.attn_impl == "ulysses":
+        from ray_tpu.ops.ulysses import make_ulysses_attention
+        from ray_tpu.parallel.mesh import current_mesh
+        mesh = current_mesh()
+        if mesh is None or "sp" not in mesh.axis_names:
+            raise ValueError(
+                "attn_impl='ulysses' needs a registered mesh with an 'sp' "
+                "axis (parallel.mesh.set_current_mesh)")
+        return make_ulysses_attention(mesh)(q, k, v)
     raise ValueError(f"Unknown attn_impl {cfg.attn_impl!r}")
 
 
+def _moe_ffn(cfg: GPTConfig, h, layer):
+    """Top-k routed mixture-of-experts FFN with capacity-based token drop.
+
+    Dispatch/combine are dense einsums against one-hot routing tensors (the
+    canonical GSPMD MoE formulation): with ``w_in``/``w_out`` sharded over
+    the "ep" mesh axis, XLA lowers the [tokens → experts] einsum to an ICI
+    all-to-all — no hand-written communication. Returns (out, aux_loss)
+    where aux_loss is the Switch-style load-balancing term.
+    h: [B, S, d] → out [B, S, d]."""
+    dt = cfg.dtype
+    B, S, d = h.shape
+    E = cfg.n_experts
+    K = min(cfg.expert_top_k, E)
+    C = max(1, int(cfg.capacity_factor * S * K / E))
+
+    router_logits = jnp.einsum(
+        "bsd,de->bse", h.astype(jnp.float32),
+        layer["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [B, S, E] fp32
+    gate_vals, gate_idx = jax.lax.top_k(probs, K)  # [B, S, K]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)  # [B,S,K,E]
+
+    # Position of each assignment within its expert's buffer, counted in
+    # (sequence, k) order; assignments past capacity C are dropped.
+    flat = onehot.reshape(B, S * K, E)
+    pos = (jnp.cumsum(flat, axis=1) - flat).reshape(B, S, K, E)
+    keep = onehot * (pos < C)
+    cap_onehot = jax.nn.one_hot(
+        jnp.minimum(pos, C - 1).astype(jnp.int32), C,
+        dtype=jnp.float32)  # [B, S, K, E, C]
+    dispatch = (keep[..., None] * cap_onehot).sum(axis=2)  # [B, S, E, C]
+    combine = (gate_vals[..., None, None] * keep[..., None]
+               * cap_onehot).sum(axis=2)  # [B, S, E, C]
+
+    x_e = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(dt), h)
+    y = jnp.einsum("ebcd,edf->ebcf", x_e, layer["w_in"].astype(dt))
+    y = jax.nn.gelu(y + layer["b_in"][:, None, None, :].astype(dt))
+    y = jnp.einsum("ebcf,efd->ebcd", y, layer["w_out"].astype(dt))
+    out = jnp.einsum("bsec,ebcd->bsd", combine.astype(dt), y)
+
+    # Load-balancing aux (Switch Transformer): E * Σ_e f_e · p_e, where f_e
+    # is the fraction of tokens whose top-1 choice is e and p_e the mean
+    # router probability for e.
+    f_e = onehot[:, :, 0, :].mean(axis=(0, 1))
+    p_e = probs.mean(axis=(0, 1))
+    aux = E * jnp.sum(f_e * p_e)
+    return out, aux
+
+
 def _block(cfg: GPTConfig, x, layer, positions):
-    """One transformer block. x: [B, S, D]."""
+    """One transformer block. x: [B, S, D]. Returns (x, aux_loss)."""
     dt = cfg.dtype
     h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"],
                    cfg.layernorm_eps)
@@ -278,19 +377,25 @@ def _block(cfg: GPTConfig, x, layer, positions):
         x = x + attn_out
         mlp_in = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"],
                             cfg.layernorm_eps)
-    ff = jnp.einsum("bsd,df->bsf", mlp_in, layer["w_in"].astype(dt))
-    ff = jax.nn.gelu(ff + layer["b_in"].astype(dt))
-    mlp_out = jnp.einsum("bsf,fd->bsd", ff, layer["w_out"].astype(dt))
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.is_moe:
+        mlp_out, aux = _moe_ffn(cfg, mlp_in, layer)
+    else:
+        ff = jnp.einsum("bsd,df->bsf", mlp_in, layer["w_in"].astype(dt))
+        ff = jax.nn.gelu(ff + layer["b_in"].astype(dt))
+        mlp_out = jnp.einsum("bsf,fd->bsd", ff, layer["w_out"].astype(dt))
     mlp_out = mlp_out + layer["b_out"].astype(dt)
 
     if cfg.parallel_block:
-        return x + attn_out + mlp_out
-    return x + mlp_out
+        return x + attn_out + mlp_out, aux
+    return x + mlp_out, aux
 
 
-def forward(params: Dict[str, Any], cfg: GPTConfig, tokens: jax.Array,
-            positions: Optional[jax.Array] = None) -> jax.Array:
-    """tokens [B, S] int32 → logits [B, S, vocab] (compute dtype)."""
+def forward_with_aux(params: Dict[str, Any], cfg: GPTConfig,
+                     tokens: jax.Array,
+                     positions: Optional[jax.Array] = None):
+    """tokens [B, S] int32 → (logits [B, S, vocab], aux_loss scalar).
+    aux_loss is the summed MoE load-balancing term (0 for dense models)."""
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -302,9 +407,12 @@ def forward(params: Dict[str, Any], cfg: GPTConfig, tokens: jax.Array,
             block, policy=jax.checkpoint_policies.nothing_saveable)
 
     def scan_body(carry, layer):
-        return block(carry, layer, positions), None
+        x, aux = carry
+        x, a = block(x, layer, positions)
+        return (x, aux + a), None
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    (x, aux), _ = jax.lax.scan(
+        scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"])
     x = _layernorm(x, params["lnf_scale"], params["lnf_bias"],
                    cfg.layernorm_eps)
     if cfg.tie_embeddings:
@@ -313,14 +421,22 @@ def forward(params: Dict[str, Any], cfg: GPTConfig, tokens: jax.Array,
         logits = jnp.einsum("bsd,dv->bsv", x,
                             params["lm_head"].astype(cfg.dtype))
         logits = logits + params["lm_head_bias"].astype(cfg.dtype)
-    return logits
+    return logits, aux
+
+
+def forward(params: Dict[str, Any], cfg: GPTConfig, tokens: jax.Array,
+            positions: Optional[jax.Array] = None) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, vocab] (compute dtype)."""
+    return forward_with_aux(params, cfg, tokens, positions)[0]
 
 
 def loss_fn(params: Dict[str, Any], cfg: GPTConfig, tokens: jax.Array,
             targets: jax.Array, mask: Optional[jax.Array] = None,
             z_loss: float = 0.0) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Next-token cross-entropy in fp32 (+ optional z-loss regularizer)."""
-    logits = forward(params, cfg, tokens).astype(jnp.float32)
+    """Next-token cross-entropy in fp32 (+ optional z-loss regularizer and,
+    for MoE configs, the router load-balancing aux term)."""
+    logits, aux = forward_with_aux(params, cfg, tokens)
+    logits = logits.astype(jnp.float32)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     tgt_logit = jnp.take_along_axis(
         logits, targets[..., None], axis=-1)[..., 0]
@@ -331,14 +447,27 @@ def loss_fn(params: Dict[str, Any], cfg: GPTConfig, tokens: jax.Array,
         mask = jnp.ones_like(nll)
     mask = mask.astype(jnp.float32)
     denom = jnp.maximum(mask.sum(), 1.0)
-    loss = (nll * mask).sum() / denom
+    ce = (nll * mask).sum() / denom
+    loss = ce
+    if cfg.is_moe:
+        loss = ce + cfg.router_aux_weight * aux
     acc = ((logits.argmax(-1) == targets) * mask).sum() / denom
+    # Perplexity from the cross-entropy alone (not the aux-regularized
+    # loss), so MoE and dense perplexities are comparable.
     return loss, {"loss": loss, "accuracy": acc,
-                  "perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
+                  "perplexity": jnp.exp(jnp.minimum(ce, 20.0))}
 
 
 def flops_per_token(cfg: GPTConfig) -> float:
-    """Approximate training FLOPs/token (6N + attention quadratic term)."""
+    """Approximate training FLOPs/token (6N_active + attention quadratic
+    term). For MoE, only the top-k routed experts do work per token, so the
+    FFN share counts k experts, not all of them (MFU must not be inflated
+    by inactive experts)."""
     n = cfg.num_params()
+    if cfg.is_moe:
+        d, f, L, E = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.n_experts
+        K = min(cfg.expert_top_k, E)
+        inactive_ffn = L * (E - K) * (2 * d * f + f)
+        n -= inactive_ffn
     attn = 12 * cfg.n_layers * cfg.d_model * cfg.max_seq_len
     return 6.0 * n + attn

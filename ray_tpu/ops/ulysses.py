@@ -1,0 +1,82 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence re-sharding.
+
+The second long-context strategy next to ring attention (ops/ring_attention
+.py). Ring keeps the sequence sharded and rotates KV blocks around the ICI
+ring; Ulysses instead re-shards twice per attention call with all-to-all:
+
+    [B, S/sp, H, D]  --all_to_all-->  [B, S, H/sp, D]
+    (sequence-sharded activations)     (full sequence, head-sharded)
+
+so each device runs *exact* full-sequence attention for its head group, then
+the inverse all-to-all restores sequence sharding for the MLP. Preferable to
+ring when n_heads >= sp and sequence lengths are moderate (two all-to-alls
+cost less than a full ring pass of KV blocks); ring wins at extreme lengths.
+The reference has no sequence parallelism of any kind (SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def _full_causal_attention(q, k, v):
+    """Exact fp32-softmax causal attention on full sequences.
+    q,k,v: [B, S, H, D] (H = local head group)."""
+    B, S, H, D = q.shape
+    kvh = k.shape[2]
+    if kvh != H:
+        rep = H // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+    logits = jnp.where((qpos >= kpos)[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def make_ulysses_attention(mesh, seq_axis: str = "sp",
+                           batch_spec=("dp", "fsdp"),
+                           inner: Optional[Callable] = None) -> Callable:
+    """Returns attention(q, k, v) over sequence-sharded [B, S, H, D] inputs.
+
+    Requires n_heads (and kv_heads) divisible by the seq_axis size. ``inner``
+    defaults to exact causal attention; pass a flash kernel for long-S.
+    """
+    inner = inner or _full_causal_attention
+    sp = dict(zip(mesh.axis_names, mesh.devices.shape))[seq_axis]
+    spec = P(batch_spec, seq_axis, None, None)
+
+    def per_shard(q, k, v):
+        # local: [B, S/sp, H, D] → [B, S, H/sp, D]
+        def scatter_heads(x):
+            return jax.lax.all_to_all(
+                x, seq_axis, split_axis=2, concat_axis=1, tiled=True)
+
+        def gather_seq(x):
+            return jax.lax.all_to_all(
+                x, seq_axis, split_axis=1, concat_axis=2, tiled=True)
+
+        o = inner(scatter_heads(q), scatter_heads(k), scatter_heads(v))
+        return gather_seq(o)
+
+    mapped = shard_map(per_shard, mesh=mesh,
+                       in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+
+    def attention(q, k, v):
+        if q.shape[2] % sp or k.shape[2] % sp:
+            raise ValueError(
+                f"Ulysses needs n_heads divisible by {seq_axis} size {sp}; "
+                f"got q heads {q.shape[2]}, kv heads {k.shape[2]}")
+        return mapped(q, k, v)
+
+    return attention

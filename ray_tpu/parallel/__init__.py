@@ -1,5 +1,7 @@
 from ray_tpu.parallel.mesh import (AXIS_ORDER, MeshConfig, build_mesh,
                                    single_device_mesh)
+from ray_tpu.parallel.pipeline import (make_pipeline_fn, sequential_apply,
+                                       stage_param_specs)
 from ray_tpu.parallel.sharding import (ShardingRules, context_parallel_rules,
                                        dp_rules, fsdp_rules, named_sharding,
                                        shard_tree, tp_fsdp_rules,
@@ -13,9 +15,12 @@ __all__ = [
     "context_parallel_rules",
     "dp_rules",
     "fsdp_rules",
+    "make_pipeline_fn",
     "named_sharding",
+    "sequential_apply",
     "shard_tree",
     "single_device_mesh",
+    "stage_param_specs",
     "tp_fsdp_rules",
     "tree_shardings",
 ]

@@ -10,11 +10,13 @@ XLA/GSPMD insert the collectives over ICI/DCN:
 * ``fsdp`` — fully-sharded data parallelism (ZeRO-3-equivalent: params and
              optimizer state sharded over this axis, all-gathered per layer)
 * ``tp``   — tensor (Megatron-style model) parallelism
-* ``sp``   — sequence/context parallelism (ring attention lives here)
+* ``sp``   — sequence/context parallelism (ring attention / Ulysses)
 * ``ep``   — expert parallelism for MoE layers
+* ``pp``   — pipeline parallelism (GPipe schedule over shard_map +
+             ppermute, parallel/pipeline.py)
 
 Batch dimensions shard over (dp, fsdp); weights over (fsdp, tp); sequence
-over sp; experts over ep.
+over sp; experts over ep; pipeline stages over pp.
 """
 
 from __future__ import annotations
@@ -25,7 +27,7 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-AXIS_ORDER = ("dp", "fsdp", "tp", "sp", "ep")
+AXIS_ORDER = ("dp", "fsdp", "tp", "sp", "ep", "pp")
 # Axes over which a batch is sharded.
 BATCH_AXES = ("dp", "fsdp")
 
@@ -44,6 +46,7 @@ class MeshConfig:
     tp: int = 1
     sp: int = 1
     ep: int = 1
+    pp: int = 1
 
     def resolve(self, n_devices: int) -> "MeshConfig":
         sizes = {a: getattr(self, a) for a in AXIS_ORDER}

@@ -1,0 +1,112 @@
+"""Pipeline parallelism via shard_map + collective permute (GPipe schedule).
+
+XLA has no native pipeline primitive (SURVEY.md §7 "hard parts"), so stages
+are laid out the TPU way: stage parameters are stacked on a leading axis
+sharded over the "pp" mesh axis, every device runs the SAME compiled tick
+body, and activations flow stage→stage over ICI with `lax.ppermute`. A
+microbatch enters stage 0 each tick; after `n_stages + n_micro - 1` ticks
+every microbatch has drained through the last stage. Gradients flow through
+ppermute's transpose (reverse permute), so `jax.grad` of a pipelined forward
+is itself a pipelined backward.
+
+The reference has NO pipeline parallelism at all (SURVEY.md §2.5 — only
+actors + send/recv building blocks users could assemble); this module is a
+new TPU-native capability.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def stage_param_specs(param_tree, axis: str = "pp"):
+    """PartitionSpecs sharding the leading (stage) axis of every leaf."""
+    return jax.tree.map(lambda _: P(axis), param_tree)
+
+
+def make_pipeline_fn(stage_fn: Callable, n_stages: int, mesh,
+                     axis: str = "pp") -> Callable:
+    """Build pipelined_apply(stage_params, micro_inputs) -> outputs.
+
+    * ``stage_fn(params_one_stage, x) -> y`` — one stage's computation;
+      x and y must have identical shape/dtype (inter-stage activations).
+    * ``stage_params`` — pytree whose leaves have leading dim ``n_stages``,
+      sharded over the ``axis`` mesh dimension (see stage_param_specs).
+    * ``micro_inputs`` — [n_micro, micro_batch, ...] microbatches.
+
+    Returns [n_micro, micro_batch, ...] outputs (replicated). Differentiable.
+    """
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh has no {axis!r} axis: {mesh.axis_names}")
+    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    if axis_size != n_stages:
+        raise ValueError(
+            f"n_stages={n_stages} must equal the {axis!r} mesh axis size "
+            f"({axis_size}); one stage per mesh slice.")
+
+    shift = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def per_device(stage_params, xs):
+        # stage_params leaves: [1, ...] (this device's stage); xs replicated.
+        local = jax.tree.map(lambda p: p[0], stage_params)
+        stage = jax.lax.axis_index(axis)
+        n_micro = xs.shape[0]
+        n_ticks = n_micro + n_stages - 1
+
+        def tick(carry, t):
+            received, outputs = carry
+            # Stage 0 pulls microbatch t from the input stream (clipped index
+            # is harmless: the value is masked out-of-window by the output
+            # collection below); later stages consume what the previous
+            # stage sent last tick.
+            x_t = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+            inp = jnp.where(stage == 0, x_t, received)
+            y = stage_fn(local, inp)
+            # Last stage emits microbatch t-(n_stages-1) this tick.
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            in_window = (t >= n_stages - 1) & (stage == n_stages - 1)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                outputs, y, out_idx, 0)
+            outputs = jnp.where(in_window, updated, outputs)
+            received = jax.lax.ppermute(y, axis, shift)
+            return (received, outputs), None
+
+        zeros_out = jnp.zeros(xs.shape, xs.dtype)
+        init = (jnp.zeros(xs.shape[1:], xs.dtype), zeros_out)
+        (_, outputs), _ = jax.lax.scan(
+            tick, init, jnp.arange(n_ticks))
+        # Only the last stage holds real outputs; psum-mask to replicate.
+        outputs = jnp.where(stage == n_stages - 1, outputs, 0)
+        return jax.lax.psum(outputs, axis)
+
+    def pipelined(stage_params, micro_inputs):
+        in_param_specs = stage_param_specs(stage_params, axis)
+        mapped = shard_map(
+            per_device, mesh=mesh,
+            in_specs=(in_param_specs, P()),
+            out_specs=P(),
+            check_vma=False)
+        return mapped(stage_params, micro_inputs)
+
+    return pipelined
+
+
+def sequential_apply(stage_fn: Callable, stage_params, micro_inputs):
+    """Reference semantics of make_pipeline_fn (no pipelining): apply the
+    stage stack to every microbatch in order. Used by tests to check the
+    pipelined schedule is numerically identical."""
+    n_stages = jax.tree.leaves(stage_params)[0].shape[0]
+
+    def apply_all(x):
+        for s in range(n_stages):
+            params_s = jax.tree.map(lambda p: p[s], stage_params)
+            x = stage_fn(params_s, x)
+        return x
+
+    return jax.vmap(apply_all)(micro_inputs)

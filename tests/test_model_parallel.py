@@ -15,7 +15,7 @@ from ray_tpu.parallel.train_step import (default_optimizer, init_train_state,
 def test_mesh_config_resolve():
     cfg = MeshConfig(dp=2, fsdp=-1, tp=2).resolve(8)
     assert cfg.fsdp == 2
-    assert cfg.shape() == (2, 2, 2, 1, 1)
+    assert cfg.shape() == (2, 2, 2, 1, 1, 1)
     with pytest.raises(ValueError):
         MeshConfig(dp=3, fsdp=1, tp=1).resolve(8)
     with pytest.raises(ValueError):
@@ -24,7 +24,7 @@ def test_mesh_config_resolve():
 
 def test_build_mesh_axes():
     mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
-    assert mesh.axis_names == ("dp", "fsdp", "tp", "sp", "ep")
+    assert mesh.axis_names == ("dp", "fsdp", "tp", "sp", "ep", "pp")
     assert dict(mesh.shape)["tp"] == 2
 
 
