@@ -1,18 +1,21 @@
-"""Trial schedulers: FIFO and ASHA (async successive halving).
+"""Trial schedulers: FIFO, ASHA, HyperBand, median stopping, and PBT.
 
-Analog of the reference's tune/schedulers/async_hyperband.py
-(AsyncHyperBandScheduler/ASHAScheduler): rungs at
-min_t * reduction_factor^k; when a trial reaches a rung, it continues only
-if its metric is in the top 1/reduction_factor quantile of results recorded
-at that rung.
+Analogs of the reference's tune/schedulers/: async_hyperband.py
+(ASHAScheduler), hyperband.py (HyperBandScheduler), median_stopping_rule.py
+(MedianStoppingRule), and pbt.py (PopulationBasedTraining). Schedulers see
+every trial report via ``on_result`` and return CONTINUE / STOP / EXPLOIT;
+EXPLOIT (PBT only) tells the runner to restart the trial from a stronger
+trial's checkpoint with a mutated config (``exploit_info``).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+EXPLOIT = "EXPLOIT"
 
 
 class FIFOScheduler:
