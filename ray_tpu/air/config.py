@@ -76,3 +76,5 @@ class RunConfig:
     checkpoint_config: Optional[CheckpointConfig] = None
     stop: Optional[Dict[str, Any]] = None
     verbose: int = 1
+    # Tune Callback instances (reference: air/config.py RunConfig.callbacks)
+    callbacks: Optional[list] = None

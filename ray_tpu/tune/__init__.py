@@ -2,9 +2,15 @@
 
 from ray_tpu.air.session import get_checkpoint, get_trial_id, get_trial_name
 from ray_tpu.air.session import report  # tune.report == session.report
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
-from ray_tpu.tune.search import (choice, grid_search, loguniform, quniform,
-                                 randint, sample_from, uniform)
+from ray_tpu.tune.callbacks import (Callback, CSVLoggerCallback,
+                                    JsonLoggerCallback)
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     HyperBandScheduler, MedianStoppingRule,
+                                     PopulationBasedTraining)
+from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
+                                 Searcher, TPESearcher, choice, grid_search,
+                                 loguniform, quniform, randint, sample_from,
+                                 uniform)
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 
@@ -36,8 +42,18 @@ def with_parameters(trainable, **kwargs):
 
 __all__ = [
     "ASHAScheduler",
+    "BasicVariantGenerator",
+    "Callback",
+    "CSVLoggerCallback",
+    "ConcurrencyLimiter",
     "FIFOScheduler",
+    "HyperBandScheduler",
+    "JsonLoggerCallback",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
     "ResultGrid",
+    "Searcher",
+    "TPESearcher",
     "TuneConfig",
     "Tuner",
     "choice",

@@ -1,0 +1,115 @@
+"""Tune callbacks + loggers.
+
+Analog of the reference's tune/callback.py (Callback hooks invoked by the
+trial-runner event loop) and tune/logger/ (CSVLoggerCallback,
+JsonLoggerCallback writing per-trial progress files under the experiment
+directory).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+class Callback:
+    """Hooks the trial runner invokes (reference: tune/callback.py)."""
+
+    def setup(self, **info) -> None:
+        pass
+
+    def on_trial_start(self, trial_id: str, config: dict) -> None:
+        pass
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          error: Optional[BaseException] = None) -> None:
+        pass
+
+    def on_experiment_end(self, results: List[Any]) -> None:
+        pass
+
+
+class LoggerCallback(Callback):
+    """Base for per-trial file loggers; resolves each trial's directory."""
+
+    def __init__(self, experiment_dir: Optional[str] = None):
+        self._experiment_dir = experiment_dir
+        self._trial_dirs: Dict[str, str] = {}
+
+    def setup(self, experiment_dir: Optional[str] = None, **info) -> None:
+        if experiment_dir is not None:
+            self._experiment_dir = experiment_dir
+
+    def _trial_dir(self, trial_id: str) -> str:
+        if trial_id not in self._trial_dirs:
+            base = self._experiment_dir or os.path.join(
+                os.path.expanduser("~"), "ray_tpu_results")
+            path = os.path.join(base, trial_id)
+            os.makedirs(path, exist_ok=True)
+            self._trial_dirs[trial_id] = path
+        return self._trial_dirs[trial_id]
+
+
+def _flatten(d: dict, prefix: str = "") -> dict:
+    out = {}
+    for key, value in d.items():
+        name = f"{prefix}{key}"
+        if isinstance(value, dict):
+            out.update(_flatten(value, name + "/"))
+        else:
+            out[name] = value
+    return out
+
+
+class CSVLoggerCallback(LoggerCallback):
+    """progress.csv per trial (reference: tune/logger/csv.py)."""
+
+    def __init__(self, experiment_dir: Optional[str] = None):
+        super().__init__(experiment_dir)
+        self._files: Dict[str, Any] = {}
+        self._writers: Dict[str, csv.DictWriter] = {}
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        flat = _flatten(result)
+        if trial_id not in self._writers:
+            path = os.path.join(self._trial_dir(trial_id), "progress.csv")
+            f = open(path, "w", newline="")
+            writer = csv.DictWriter(f, fieldnames=list(flat.keys()),
+                                    extrasaction="ignore")
+            writer.writeheader()
+            self._files[trial_id] = f
+            self._writers[trial_id] = writer
+        self._writers[trial_id].writerow(flat)
+        self._files[trial_id].flush()
+
+    def on_trial_complete(self, trial_id, error=None) -> None:
+        f = self._files.pop(trial_id, None)
+        if f is not None:
+            f.close()
+        self._writers.pop(trial_id, None)
+
+    def on_experiment_end(self, results) -> None:
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+        self._writers.clear()
+
+
+class JsonLoggerCallback(LoggerCallback):
+    """result.json (one JSON line per report) per trial
+    (reference: tune/logger/json.py)."""
+
+    def on_trial_start(self, trial_id: str, config: dict) -> None:
+        path = os.path.join(self._trial_dir(trial_id), "params.json")
+        with open(path, "w") as f:
+            json.dump(config, f, default=repr)
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        path = os.path.join(self._trial_dir(trial_id), "result.json")
+        with open(path, "a") as f:
+            f.write(json.dumps(result, default=repr) + "\n")

@@ -9,7 +9,7 @@ grid — matching reference semantics.
 from __future__ import annotations
 
 import random
-from typing import Any, Dict, Iterator, List
+from typing import Any, Dict, Iterator, List, Optional
 
 
 class Domain:
@@ -111,6 +111,10 @@ def _sample_domains(config: Dict[str, Any], rng: random.Random
     for key, value in config.items():
         if isinstance(value, Domain):
             out[key] = value.sample(rng)
+        elif _is_grid(value):
+            # Unexpanded grid marker (searcher path, where there is no
+            # upfront cross-product): sample one of the grid values.
+            out[key] = rng.choice(value["grid_search"])
         elif isinstance(value, dict):
             out[key] = _sample_domains(value, rng)
         elif callable(value) and getattr(value, "_tune_sample_fn", False):
@@ -133,3 +137,174 @@ def generate_variants(param_space: Dict[str, Any], num_samples: int,
     for _ in range(num_samples):
         for variant in grid:
             yield _sample_domains(variant, rng)
+
+
+# -- Searcher interface (reference: tune/search/searcher.py Searcher) -----
+
+class Searcher:
+    """Suggests configs and learns from completed trials.
+
+    Analog of the reference's tune/search/searcher.py: ``suggest`` returns
+    the next config (or None when exhausted), ``on_trial_complete`` feeds
+    the final result back.
+    """
+
+    def set_search_properties(self, metric: str, mode: str,
+                              param_space: Dict[str, Any],
+                              num_samples: Optional[int] = None) -> None:
+        self.metric = metric
+        self.mode = mode
+        self.param_space = param_space
+
+    def expected_trials(self, num_samples: int) -> int:
+        """Total trials this searcher intends to produce for the runner's
+        ``num_samples`` setting (grid-expanding searchers return more)."""
+        return num_samples
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product x num_samples with Domain sampling — the default
+    searcher (reference: tune/search/basic_variant.py)."""
+
+    def __init__(self, num_samples: int = 1, seed: int = 0):
+        self.num_samples = num_samples
+        self.seed = seed
+        self._it: Optional[Iterator[Dict[str, Any]]] = None
+
+    def set_search_properties(self, metric, mode, param_space,
+                              num_samples=None):
+        super().set_search_properties(metric, mode, param_space)
+        if num_samples is not None:
+            self.num_samples = max(self.num_samples, num_samples)
+        self._it = generate_variants(param_space, self.num_samples,
+                                     self.seed)
+
+    def expected_trials(self, num_samples: int) -> int:
+        grid = len(_expand_grid(self.param_space or {}))
+        return max(self.num_samples, num_samples) * max(grid, 1)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        try:
+            return next(self._it)
+        except StopIteration:
+            return None
+
+
+class TPESearcher(Searcher):
+    """Tree-structured-Parzen-style sequential searcher (the native analog
+    of the reference's external-library searchers, tune/search/hyperopt/).
+
+    After ``n_initial_points`` random configs, observations are split at the
+    ``gamma`` quantile into good/bad sets; candidates are drawn from the
+    good set's kernel density and scored by the good/bad density ratio.
+    Numeric Domains only; non-numeric keys fall back to random sampling.
+    """
+
+    def __init__(self, n_initial_points: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: int = 0):
+        self.n_initial = n_initial_points
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._observations: List[tuple] = []  # (config, signed score)
+        self._pending: Dict[str, Dict[str, Any]] = {}
+
+    def _numeric_keys(self) -> List[str]:
+        return [k for k, v in self.param_space.items()
+                if isinstance(v, (Uniform, LogUniform, RandInt, QUniform))]
+
+    def _random_config(self) -> Dict[str, Any]:
+        return _sample_domains(self.param_space, self._rng)
+
+    @staticmethod
+    def _kde_logpdf(x: float, points: List[float], bandwidth: float
+                    ) -> float:
+        import math
+        if not points:
+            return 0.0
+        total = 0.0
+        for p in points:
+            z = (x - p) / bandwidth
+            total += math.exp(-0.5 * z * z)
+        return math.log(total / (len(points) * bandwidth) + 1e-12)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        keys = self._numeric_keys()
+        if len(self._observations) < self.n_initial or not keys:
+            config = self._random_config()
+            self._pending[trial_id] = config
+            return config
+        ordered = sorted(self._observations, key=lambda o: -o[1])
+        n_good = max(1, int(len(ordered) * self.gamma))
+        good = [c for c, _ in ordered[:n_good]]
+        bad = [c for c, _ in ordered[n_good:]] or good
+        best, best_score = None, None
+        for _ in range(self.n_candidates):
+            candidate = self._random_config()
+            # Mutate candidate toward the good set on numeric keys.
+            donor = self._rng.choice(good)
+            for key in keys:
+                if self._rng.random() < 0.75:
+                    candidate[key] = donor[key]
+            score = 0.0
+            for key in keys:
+                values_g = [c[key] for c in good]
+                values_b = [c[key] for c in bad]
+                spread = (max(values_g + values_b) -
+                          min(values_g + values_b)) or 1.0
+                bw = max(spread / 4.0, 1e-9)
+                score += (self._kde_logpdf(candidate[key], values_g, bw) -
+                          self._kde_logpdf(candidate[key], values_b, bw))
+            if best_score is None or score > best_score:
+                best, best_score = candidate, score
+        self._pending[trial_id] = best
+        return best
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        config = self._pending.pop(trial_id, None)
+        if config is None or error or not result:
+            return
+        value = result.get(self.metric)
+        if value is None:
+            return
+        signed = value if self.mode == "max" else -value
+        self._observations.append((config, signed))
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps in-flight suggestions (reference: tune/search/
+    concurrency_limiter.py)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def set_search_properties(self, metric, mode, param_space,
+                              num_samples=None):
+        super().set_search_properties(metric, mode, param_space)
+        self.searcher.set_search_properties(metric, mode, param_space,
+                                            num_samples)
+
+    def expected_trials(self, num_samples: int) -> int:
+        return self.searcher.expected_trials(num_samples)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._live) >= self.max_concurrent:
+            return None
+        config = self.searcher.suggest(trial_id)
+        if config is not None:
+            self._live.add(trial_id)
+        return config
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)

@@ -3,13 +3,18 @@
 Analog of the reference's tune/tuner.py:44 (Tuner.fit) and the
 TrialRunner.step event loop (tune/execution/trial_runner.py:268,931): each
 trial is an actor (reference: ray_trial_executor.py:191); the runner
-multiplexes trial results with ray.wait, feeds the scheduler, and stops
-trials early on its decision.
+multiplexes trial results with ray.wait, feeds the scheduler and searcher,
+stops trials early on scheduler decisions, restarts trials from donor
+checkpoints on PBT EXPLOIT, invokes callbacks, and snapshots experiment
+state for ``Tuner.restore`` (reference: tune/execution/trial_runner.py
+checkpointing + tuner.py Tuner.restore).
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -18,10 +23,12 @@ import ray_tpu
 from ray_tpu.air.config import RunConfig
 from ray_tpu.air.result import Result
 from ray_tpu.train._internal.worker_group import TrainWorker
-from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
-from ray_tpu.tune.search import generate_variants
+from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, FIFOScheduler, STOP
+from ray_tpu.tune.search import Searcher, generate_variants
 
 logger = logging.getLogger("ray_tpu.tune")
+
+_EXPERIMENT_STATE_FILE = "experiment_state.json"
 
 
 @dataclass
@@ -31,7 +38,7 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Any = None
-    search_alg: Any = None  # reserved; basic variant generation built in
+    search_alg: Optional[Searcher] = None
     seed: int = 0
 
 
@@ -45,6 +52,7 @@ class _Trial:
     error: Optional[BaseException] = None
     done: bool = False
     stopped: bool = False
+    checkpoint: Any = None
 
 
 class ResultGrid:
@@ -97,7 +105,8 @@ class Tuner:
     def __init__(self, trainable: Callable = None, *,
                  param_space: Optional[dict] = None,
                  tune_config: Optional[TuneConfig] = None,
-                 run_config: Optional[RunConfig] = None):
+                 run_config: Optional[RunConfig] = None,
+                 _restored_state: Optional[dict] = None):
         from ray_tpu.train.base_trainer import BaseTrainer
         if isinstance(trainable, BaseTrainer):
             self._trainable = trainable.as_trainable()
@@ -108,24 +117,134 @@ class Tuner:
         self.run_config = run_config or RunConfig()
         self._trial_resources = getattr(
             trainable, "_tune_resources", None) or {"num_cpus": 1}
+        self._restored_state = _restored_state
+
+    # -- restore (reference: tune/tuner.py Tuner.restore) -----------------
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable) -> "Tuner":
+        """Resume an interrupted experiment from its directory: finished
+        trials keep their recorded results, unfinished ones rerun."""
+        state_file = os.path.join(path, _EXPERIMENT_STATE_FILE)
+        with open(state_file) as f:
+            state = json.load(f)
+        tune_config = TuneConfig(
+            metric=state["metric"], mode=state["mode"],
+            num_samples=state["num_samples"])
+        run_config = RunConfig(name=state.get("name"),
+                               storage_path=state.get("storage_path"))
+        return cls(trainable, param_space={},
+                   tune_config=tune_config, run_config=run_config,
+                   _restored_state=state)
+
+    def experiment_dir(self) -> Optional[str]:
+        if not self.run_config.storage_path:
+            return None
+        name = self.run_config.name or "tune_experiment"
+        return os.path.join(self.run_config.storage_path, name)
+
+    def _snapshot(self, trials: List[_Trial], num_created: int,
+                  pending_configs: Optional[list] = None) -> None:
+        exp_dir = self.experiment_dir()
+        if not exp_dir:
+            return
+        os.makedirs(exp_dir, exist_ok=True)
+        state = {
+            "pending_configs": _jsonable(pending_configs or []),
+            "metric": self.tune_config.metric,
+            "mode": self.tune_config.mode,
+            "num_samples": self.tune_config.num_samples,
+            "name": self.run_config.name,
+            "storage_path": self.run_config.storage_path,
+            "num_created": num_created,
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "config": _jsonable(t.config),
+                    "done": t.done,
+                    "error": repr(t.error) if t.error is not None else None,
+                    "history": _jsonable(t.history),
+                }
+                for t in trials
+            ],
+        }
+        tmp = os.path.join(exp_dir, _EXPERIMENT_STATE_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, os.path.join(exp_dir, _EXPERIMENT_STATE_FILE))
+
+    # -- the event loop ---------------------------------------------------
 
     def fit(self) -> ResultGrid:
         cfg = self.tune_config
         scheduler = cfg.scheduler or FIFOScheduler()
         if hasattr(scheduler, "set_metric") and cfg.metric:
             scheduler.set_metric(cfg.metric, cfg.mode)
-        trials = [
-            _Trial(trial_id=f"trial_{i:05d}_{uuid.uuid4().hex[:4]}",
-                   config=variant)
-            for i, variant in enumerate(
-                generate_variants(self.param_space, cfg.num_samples,
-                                  cfg.seed))
-        ]
-        max_concurrent = cfg.max_concurrent_trials or len(trials)
-        pending = list(trials)
+        searcher = cfg.search_alg
+        if searcher is not None:
+            searcher.set_search_properties(cfg.metric, cfg.mode,
+                                           self.param_space,
+                                           num_samples=cfg.num_samples)
+            target_trials = searcher.expected_trials(cfg.num_samples)
+            variants = None
+        else:
+            variants = list(generate_variants(
+                self.param_space, cfg.num_samples, cfg.seed))
+            target_trials = len(variants)
+
+        callbacks = list(getattr(self.run_config, "callbacks", None) or [])
+        for cb in callbacks:
+            cb.setup(experiment_dir=self.experiment_dir())
+
+        trials: List[_Trial] = []
+        num_created = 0
+
+        # Restored experiments: replay finished trials, requeue the rest.
+        restore_queue: List[_Trial] = []
+        if self._restored_state is not None:
+            for ts in self._restored_state["trials"]:
+                trial = _Trial(trial_id=ts["trial_id"], config=ts["config"],
+                               history=ts["history"], done=ts["done"])
+                num_created += 1
+                if ts["done"] and ts["error"] is None:
+                    trials.append(trial)
+                else:
+                    trial.done = False
+                    trial.history = []
+                    restore_queue.append(trial)
+            for config in self._restored_state.get("pending_configs", []):
+                trial = _Trial(
+                    trial_id=(f"trial_{num_created:05d}_"
+                              f"{uuid.uuid4().hex[:4]}"),
+                    config=config)
+                num_created += 1
+                restore_queue.append(trial)
+            target_trials = num_created
+
+        max_concurrent = cfg.max_concurrent_trials or max(target_trials, 1)
         running: Dict[Any, _Trial] = {}  # outstanding result ref -> trial
 
-        def launch(trial: _Trial):
+        def next_trial() -> Optional[_Trial]:
+            nonlocal num_created
+            if restore_queue:
+                return restore_queue.pop(0)
+            if num_created >= target_trials:
+                return None
+            if searcher is not None:
+                trial_id = f"trial_{num_created:05d}_{uuid.uuid4().hex[:4]}"
+                config = searcher.suggest(trial_id)
+                if config is None:
+                    return None  # exhausted or concurrency-limited
+                num_created += 1
+                return _Trial(trial_id=trial_id, config=config)
+            config = variants[num_created]
+            trial = _Trial(
+                trial_id=f"trial_{num_created:05d}_{uuid.uuid4().hex[:4]}",
+                config=config)
+            num_created += 1
+            return trial
+
+        def launch(trial: _Trial, checkpoint=None):
             actor_cls = TrainWorker.options(**self._trial_resources)
             trial.actor = actor_cls.remote(0, 1)
             # Don't block on creation: actor tasks are ordered, so the
@@ -133,12 +252,25 @@ class Tuner:
             # trials queue naturally behind available resources.
             trial.actor.start_training.remote(
                 self._trainable, trial.config,
-                {"trial_id": trial.trial_id, "trial_name": trial.trial_id})
+                {"trial_id": trial.trial_id, "trial_name": trial.trial_id},
+                checkpoint)
             ref = trial.actor.get_next_result.remote()
             running[ref] = trial
+            if trial not in trials:
+                trials.append(trial)
+            if hasattr(scheduler, "on_trial_start"):
+                scheduler.on_trial_start(trial.trial_id, trial.config)
+            for cb in callbacks:
+                cb.on_trial_start(trial.trial_id, trial.config)
 
-        while pending and len(running) < max_concurrent:
-            launch(pending.pop(0))
+        def fill_slots():
+            while len(running) < max_concurrent:
+                trial = next_trial()
+                if trial is None:
+                    break
+                launch(trial)
+
+        fill_slots()
 
         while running:
             ready, _ = ray_tpu.wait(list(running.keys()), num_returns=1,
@@ -152,14 +284,44 @@ class Tuner:
                 if payload.get("timeout"):
                     trial.error = TimeoutError("trial timed out")
                 ray_tpu.kill(trial.actor)
-                if pending:
-                    launch(pending.pop(0))
+                if searcher is not None:
+                    searcher.on_trial_complete(
+                        trial.trial_id,
+                        trial.history[-1] if trial.history else None,
+                        error=trial.error is not None)
+                for cb in callbacks:
+                    cb.on_trial_complete(trial.trial_id, trial.error)
+                self._snapshot(trials, num_created,
+                               variants[num_created:] if variants else [])
+                fill_slots()
                 continue
             metrics = dict(payload.get("metrics", {}))
+            if payload.get("checkpoint") is not None:
+                trial.checkpoint = payload["checkpoint"]
             trial.iteration += 1
             metrics.setdefault("training_iteration", trial.iteration)
             trial.history.append(metrics)
+            for cb in callbacks:
+                cb.on_trial_result(trial.trial_id, metrics)
             decision = scheduler.on_result(trial.trial_id, metrics)
+            if decision == EXPLOIT:
+                donor_id, new_config = scheduler.exploit_info(trial.trial_id)
+                donor = next((t for t in trials
+                              if t.trial_id == donor_id), None)
+                donor_ckpt = donor.checkpoint if donor is not None else None
+                if donor_ckpt is not None:
+                    logger.info("PBT: %s exploits %s",
+                                trial.trial_id, donor_id)
+                    # Restart this trial from the donor's checkpoint with
+                    # the mutated config (reference: pbt.py _exploit).
+                    ray_tpu.kill(trial.actor)
+                    trial.config = new_config
+                    launch(trial, checkpoint=donor_ckpt)
+                    continue
+                # Donor has no checkpoint yet: restarting would lose all
+                # progress for nothing — keep the trial running
+                # (reference pbt.py skips checkpointless exploits).
+                decision = CONTINUE
             if decision == STOP or self._hit_stop_criteria(metrics):
                 trial.stopped = True
                 trial.actor.request_stop.remote()
@@ -170,9 +332,14 @@ class Tuner:
         results = [
             Result(metrics=t.history[-1] if t.history else {},
                    metrics_history=t.history, config=t.config,
-                   error=t.error, trial_id=t.trial_id)
+                   error=t.error, trial_id=t.trial_id,
+                   checkpoint=t.checkpoint)
             for t in trials
         ]
+        self._snapshot(trials, num_created,
+                       variants[num_created:] if variants else [])
+        for cb in callbacks:
+            cb.on_experiment_end(results)
         errs = [r for r in results if r.error is not None]
         if errs:
             logger.warning("%d/%d trials errored", len(errs), len(results))
@@ -184,3 +351,17 @@ class Tuner:
             return False
         return any(metrics.get(k) is not None and metrics[k] >= v
                    for k, v in stop.items())
+
+
+def _jsonable(obj):
+    """Deep-copy obj keeping only JSON-serializable leaves (repr others)."""
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        pass
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    return repr(obj)

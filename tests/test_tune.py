@@ -154,3 +154,191 @@ def test_with_parameters_and_resources(ray_start_regular):
                   tune_config=TuneConfig(metric="n", mode="max"))
     results = tuner.fit()
     assert results.get_best_result().metrics["n"] == 1001
+
+
+def test_hyperband_stops_bad_trials(ray_start_regular):
+    from ray_tpu.tune import HyperBandScheduler
+
+    def trainable(config):
+        for i in range(30):
+            tune.report({"score": config["q"] * (i + 1)})
+
+    results = Tuner(
+        trainable,
+        param_space={"q": tune.grid_search(list(range(1, 10)))},
+        tune_config=TuneConfig(
+            metric="score", mode="max",
+            scheduler=HyperBandScheduler(max_t=27, reduction_factor=3)),
+    ).fit()
+    assert results.get_best_result().config["q"] == 9
+    lengths = [len(r.metrics_history) for r in results]
+    assert min(lengths) < 27
+
+
+def test_median_stopping_rule(ray_start_regular):
+    from ray_tpu.tune import MedianStoppingRule
+
+    def trainable(config):
+        for i in range(15):
+            tune.report({"score": config["q"] * (i + 1)})
+
+    results = Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([1, 2, 5, 6, 7, 8])},
+        tune_config=TuneConfig(
+            metric="score", mode="max",
+            scheduler=MedianStoppingRule(grace_period=3,
+                                         min_samples_required=3)),
+    ).fit()
+    assert results.get_best_result().config["q"] == 8
+    lengths = [len(r.metrics_history) for r in results]
+    assert min(lengths) < 15  # below-median trials were cut
+
+
+def test_pbt_exploits_checkpoints(ray_start_regular):
+    """Weak PBT trials must restart from a stronger trial's checkpoint with
+    a mutated config (the EXPLOIT protocol)."""
+    from ray_tpu.air.checkpoint import Checkpoint
+    from ray_tpu.tune import PopulationBasedTraining
+
+    def trainable(config):
+        ckpt = tune.get_checkpoint()
+        level = ckpt.to_dict()["level"] if ckpt is not None else 0
+        for i in range(12):
+            level += config["rate"]
+            tune.report({"level": level},
+                        checkpoint=Checkpoint.from_dict({"level": level}))
+
+    pbt = PopulationBasedTraining(
+        perturbation_interval=3,
+        hyperparam_mutations={"rate": tune.uniform(0.1, 10.0)},
+        quantile_fraction=0.5, seed=3)
+    results = Tuner(
+        trainable,
+        param_space={"rate": tune.grid_search([0.1, 0.2, 8.0, 9.0])},
+        tune_config=TuneConfig(metric="level", mode="max", scheduler=pbt),
+    ).fit()
+    assert not results.errors
+    assert pbt.num_perturbations > 0
+    # An exploited weak trial inherits a strong trial's level: every trial's
+    # final level should be far above what the weak configs alone reach
+    # (0.2-rate trial alone caps at 12*0.2 = 2.4 without exploiting).
+    final_levels = sorted(
+        max(h["level"] for h in r.metrics_history) for r in results)
+    assert final_levels[0] > 2.4
+
+
+def test_tpe_searcher_converges(ray_start_regular):
+    from ray_tpu.tune import TPESearcher
+
+    def trainable(config):
+        x = config["x"]
+        tune.report({"loss": (x - 3.0) ** 2})
+
+    results = Tuner(
+        trainable,
+        param_space={"x": tune.uniform(-10.0, 10.0)},
+        tune_config=TuneConfig(metric="loss", mode="min", num_samples=30,
+                               search_alg=TPESearcher(n_initial_points=8,
+                                                      seed=0),
+                               max_concurrent_trials=4),
+    ).fit()
+    assert len(results) == 30
+    best = results.get_best_result()
+    # TPE should get meaningfully closer to x=3 than random's expected best.
+    assert abs(best.config["x"] - 3.0) < 1.5
+
+
+def test_concurrency_limiter(ray_start_regular):
+    from ray_tpu.tune import BasicVariantGenerator, ConcurrencyLimiter
+
+    def trainable(config):
+        tune.report({"v": config["x"]})
+
+    searcher = ConcurrencyLimiter(BasicVariantGenerator(num_samples=6),
+                                  max_concurrent=2)
+    results = Tuner(
+        trainable,
+        param_space={"x": tune.uniform(0, 1)},
+        tune_config=TuneConfig(metric="v", mode="max", num_samples=6,
+                               search_alg=searcher),
+    ).fit()
+    assert len(results) == 6
+    assert not results.errors
+
+
+def test_logger_callbacks(ray_start_regular, tmp_path):
+    from ray_tpu.tune import CSVLoggerCallback, JsonLoggerCallback
+
+    def trainable(config):
+        for i in range(3):
+            tune.report({"step": i, "x": config["x"]})
+
+    results = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="x", mode="max"),
+        run_config=RunConfig(
+            name="exp", storage_path=str(tmp_path),
+            callbacks=[CSVLoggerCallback(), JsonLoggerCallback()]),
+    ).fit()
+    assert not results.errors
+    exp_dir = tmp_path / "exp"
+    trial_dirs = [d for d in exp_dir.iterdir() if d.is_dir()]
+    assert len(trial_dirs) == 2
+    for d in trial_dirs:
+        csv_lines = (d / "progress.csv").read_text().strip().splitlines()
+        assert len(csv_lines) == 4  # header + 3 reports
+        json_lines = (d / "result.json").read_text().strip().splitlines()
+        assert len(json_lines) == 3
+        import json as _json
+        assert _json.loads((d / "params.json").read_text())["x"] in (1, 2)
+
+
+def test_experiment_snapshot_and_restore(ray_start_regular, tmp_path):
+    def trainable(config):
+        tune.report({"v": config["x"] * 10})
+
+    run_config = RunConfig(name="resume_exp", storage_path=str(tmp_path))
+    results = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=TuneConfig(metric="v", mode="max"),
+        run_config=run_config,
+    ).fit()
+    assert len(results) == 3
+    state_file = tmp_path / "resume_exp" / "experiment_state.json"
+    assert state_file.exists()
+
+    # Restore: all trials finished, so results come back without rerunning.
+    restored = Tuner.restore(str(tmp_path / "resume_exp"), trainable)
+    results2 = restored.fit()
+    assert len(results2) == 3
+    assert results2.get_best_result().metrics["v"] == 30
+
+
+def test_restore_requeues_pending_variants(ray_start_regular, tmp_path):
+    """A crash before all variants launch must not lose the unlaunched ones:
+    the snapshot stores pending configs and restore requeues them."""
+    import json as _json
+
+    # Simulate a crashed run: 1 of 4 grid points finished, 3 still pending.
+    exp_dir = tmp_path / "crashed"
+    exp_dir.mkdir()
+    state = {
+        "metric": "v", "mode": "max", "num_samples": 1,
+        "name": "crashed", "storage_path": str(tmp_path),
+        "num_created": 1,
+        "pending_configs": [{"x": 2}, {"x": 3}, {"x": 4}],
+        "trials": [{"trial_id": "trial_00000_dead", "config": {"x": 1},
+                    "done": True, "error": None,
+                    "history": [{"v": 10, "training_iteration": 1}]}],
+    }
+    (exp_dir / "experiment_state.json").write_text(_json.dumps(state))
+
+    def trainable(config):
+        tune.report({"v": config["x"] * 10})
+
+    results = Tuner.restore(str(exp_dir), trainable).fit()
+    assert len(results) == 4
+    assert results.get_best_result().metrics["v"] == 40
