@@ -1,3 +1,3 @@
-from ray_tpu.models import gpt
+from ray_tpu.models import diffusion, gpt, llama, vit
 
-__all__ = ["gpt"]
+__all__ = ["diffusion", "gpt", "llama", "vit"]

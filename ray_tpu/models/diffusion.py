@@ -1,0 +1,355 @@
+"""Denoising diffusion UNet + DDIM sampler, TPU-first.
+
+The model behind the rebuild's Serve batch-inference config
+(BASELINE.json: "Ray Serve Stable-Diffusion batch inference on TPU
+replicas"; the reference itself ships no diffusion model — it serves
+torch/diffusers models through generic Serve deployments,
+reference: python/ray/serve/_private/replica.py).
+
+Design: NHWC convolutions (`lax.conv_general_dilated` with dimension
+numbers XLA maps onto the MXU), GroupNorm in fp32, sinusoidal timestep
+embedding injected per res-block, self-attention at the lowest
+resolution, fixed down/up factor of 2 per stage. Params are a pure
+pytree; sampling is a `lax.scan` over DDIM steps so the entire sampler
+is one compiled program.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel.sharding import ShardingRules
+
+
+@dataclass(frozen=True)
+class UNetConfig:
+    image_size: int = 32
+    in_channels: int = 3
+    base_channels: int = 128
+    channel_mults: Tuple[int, ...] = (1, 2, 2)
+    n_res_blocks: int = 2
+    n_groups: int = 32
+    time_dim: int = 512
+    n_timesteps: int = 1000
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def stage_channels(self) -> Tuple[int, ...]:
+        return tuple(self.base_channels * m for m in self.channel_mults)
+
+
+PRESETS: Dict[str, UNetConfig] = {
+    "ddpm-cifar": UNetConfig(),
+    "sd-base": UNetConfig(
+        image_size=64, in_channels=4, base_channels=192,
+        channel_mults=(1, 2, 3, 4), n_res_blocks=2),
+    # Test-size config.
+    "unet-tiny": UNetConfig(
+        image_size=16, in_channels=3, base_channels=16,
+        channel_mults=(1, 2), n_res_blocks=1, n_groups=4, time_dim=32,
+        n_timesteps=50, dtype=jnp.float32),
+}
+
+
+def config(name: str, **overrides) -> UNetConfig:
+    cfg = PRESETS[name]
+    return replace(cfg, **overrides) if overrides else cfg
+
+
+# -- primitives ---------------------------------------------------------
+
+_CONV_DN = ("NHWC", "HWIO", "NHWC")
+
+
+def _conv(x, w, b=None, stride=1):
+    out = jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=_CONV_DN)
+    if b is not None:
+        out = out + b.astype(x.dtype)
+    return out
+
+
+def _groupnorm(x, scale, bias, n_groups, eps=1e-5):
+    B, H, W, C = x.shape
+    g = min(n_groups, C)
+    while C % g:
+        g -= 1
+    x32 = x.astype(jnp.float32).reshape(B, H, W, g, C // g)
+    mu = x32.mean(axis=(1, 2, 4), keepdims=True)
+    var = ((x32 - mu) ** 2).mean(axis=(1, 2, 4), keepdims=True)
+    y = ((x32 - mu) * jax.lax.rsqrt(var + eps)).reshape(B, H, W, C)
+    return (y * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def timestep_embedding(t, dim):
+    """Sinusoidal embedding. t: [B] int/float → [B, dim] fp32."""
+    half = dim // 2
+    freqs = jnp.exp(
+        -math.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+# -- init ---------------------------------------------------------------
+
+def _conv_init(key, kh, kw, cin, cout, pd, scale=1.0):
+    fan_in = kh * kw * cin
+    std = scale / math.sqrt(fan_in)
+    return (jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+            * std).astype(pd)
+
+
+def _dense_init(key, cin, cout, pd, scale=1.0):
+    std = scale / math.sqrt(cin)
+    return (jax.random.normal(key, (cin, cout), jnp.float32)
+            * std).astype(pd)
+
+
+def _res_block_init(key, cin, cout, time_dim, pd):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    p = {
+        "gn1_scale": jnp.ones((cin,), pd), "gn1_bias": jnp.zeros((cin,), pd),
+        "conv1": _conv_init(k1, 3, 3, cin, cout, pd),
+        "conv1_b": jnp.zeros((cout,), pd),
+        "time_w": _dense_init(k2, time_dim, cout, pd),
+        "time_b": jnp.zeros((cout,), pd),
+        "gn2_scale": jnp.ones((cout,), pd), "gn2_bias": jnp.zeros((cout,), pd),
+        "conv2": _conv_init(k3, 3, 3, cout, cout, pd, scale=1e-2),
+        "conv2_b": jnp.zeros((cout,), pd),
+    }
+    if cin != cout:
+        p["skip"] = _conv_init(k4, 1, 1, cin, cout, pd)
+    return p
+
+
+def _attn_init(key, c, pd):
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    return {
+        "gn_scale": jnp.ones((c,), pd), "gn_bias": jnp.zeros((c,), pd),
+        "wq": _dense_init(k1, c, c, pd),
+        "wk": _dense_init(k2, c, c, pd),
+        "wv": _dense_init(k3, c, c, pd),
+        "wo": _dense_init(k4, c, c, pd, scale=1e-2),
+    }
+
+
+def init(cfg: UNetConfig, key: jax.Array) -> Dict[str, Any]:
+    pd = cfg.param_dtype
+    chans = cfg.stage_channels
+    keys = iter(jax.random.split(key, 256))
+
+    params: Dict[str, Any] = {
+        "time_w1": _dense_init(next(keys), cfg.time_dim // 4, cfg.time_dim,
+                               pd),
+        "time_b1": jnp.zeros((cfg.time_dim,), pd),
+        "time_w2": _dense_init(next(keys), cfg.time_dim, cfg.time_dim, pd),
+        "time_b2": jnp.zeros((cfg.time_dim,), pd),
+        "conv_in": _conv_init(next(keys), 3, 3, cfg.in_channels, chans[0],
+                              pd),
+        "conv_in_b": jnp.zeros((chans[0],), pd),
+    }
+
+    down = []
+    cin = chans[0]
+    for si, c in enumerate(chans):
+        blocks = []
+        for _ in range(cfg.n_res_blocks):
+            blocks.append(_res_block_init(next(keys), cin, c, cfg.time_dim,
+                                          pd))
+            cin = c
+        stage = {"blocks": blocks}
+        if si < len(chans) - 1:
+            stage["down"] = _conv_init(next(keys), 3, 3, c, c, pd)
+            stage["down_b"] = jnp.zeros((c,), pd)
+        down.append(stage)
+    params["down"] = down
+
+    mid_c = chans[-1]
+    params["mid1"] = _res_block_init(next(keys), mid_c, mid_c, cfg.time_dim,
+                                     pd)
+    params["mid_attn"] = _attn_init(next(keys), mid_c, pd)
+    params["mid2"] = _res_block_init(next(keys), mid_c, mid_c, cfg.time_dim,
+                                     pd)
+
+    up = []
+    for si in reversed(range(len(chans))):
+        c = chans[si]
+        blocks = []
+        for _ in range(cfg.n_res_blocks):
+            # Input = current features + same-resolution skip.
+            blocks.append(_res_block_init(next(keys), cin + c, c,
+                                          cfg.time_dim, pd))
+            cin = c
+        stage = {"blocks": blocks}
+        if si > 0:
+            stage["up"] = _conv_init(next(keys), 3, 3, c, c, pd)
+            stage["up_b"] = jnp.zeros((c,), pd)
+        up.append(stage)
+    params["up"] = up
+
+    params["gn_out_scale"] = jnp.ones((chans[0],), pd)
+    params["gn_out_bias"] = jnp.zeros((chans[0],), pd)
+    params["conv_out"] = _conv_init(next(keys), 3, 3, chans[0],
+                                    cfg.in_channels, pd, scale=1e-2)
+    params["conv_out_b"] = jnp.zeros((cfg.in_channels,), pd)
+    return params
+
+
+def param_specs(cfg: UNetConfig, rules: ShardingRules):
+    """Replicated weights (conv UNets are batch-parallel; batch over dp)."""
+    from jax.sharding import PartitionSpec
+    return jax.tree.map(lambda _: PartitionSpec(), init_shapes(cfg))
+
+
+def init_shapes(cfg: UNetConfig):
+    return jax.eval_shape(lambda k: init(cfg, k), jax.random.PRNGKey(0))
+
+
+def batch_spec(rules: ShardingRules):
+    return rules.spec("batch", None, None, None)
+
+
+# -- forward ------------------------------------------------------------
+
+def _res_block(cfg, p, x, temb):
+    h = _groupnorm(x, p["gn1_scale"], p["gn1_bias"], cfg.n_groups)
+    h = _conv(jax.nn.silu(h), p["conv1"], p["conv1_b"])
+    t = jnp.einsum("bt,tc->bc", jax.nn.silu(temb),
+                   p["time_w"].astype(temb.dtype)) + p["time_b"].astype(
+                       temb.dtype)
+    h = h + t[:, None, None, :].astype(h.dtype)
+    h = _groupnorm(h, p["gn2_scale"], p["gn2_bias"], cfg.n_groups)
+    h = _conv(jax.nn.silu(h), p["conv2"], p["conv2_b"])
+    skip = _conv(x, p["skip"]) if "skip" in p else x
+    return skip + h
+
+
+def _self_attention(cfg, p, x):
+    B, H, W, C = x.shape
+    h = _groupnorm(x, p["gn_scale"], p["gn_bias"], cfg.n_groups)
+    flat = h.reshape(B, H * W, C)
+    q = jnp.einsum("bnc,cd->bnd", flat, p["wq"].astype(flat.dtype))
+    k = jnp.einsum("bnc,cd->bnd", flat, p["wk"].astype(flat.dtype))
+    v = jnp.einsum("bnc,cd->bnd", flat, p["wv"].astype(flat.dtype))
+    logits = (jnp.einsum("bqc,bkc->bqk", q, k)
+              / math.sqrt(C)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(flat.dtype)
+    out = jnp.einsum("bqk,bkc->bqc", probs, v)
+    out = jnp.einsum("bnc,cd->bnd", out, p["wo"].astype(flat.dtype))
+    return x + out.reshape(B, H, W, C)
+
+
+def _downsample(x, w, b):
+    return _conv(x, w, b, stride=2)
+
+
+def _upsample(x, w, b):
+    B, H, W, C = x.shape
+    x = jnp.repeat(jnp.repeat(x, 2, axis=1), 2, axis=2)
+    return _conv(x, w, b)
+
+
+def forward(params: Dict[str, Any], cfg: UNetConfig, x: jax.Array,
+            t: jax.Array) -> jax.Array:
+    """Predict noise. x: [B, H, W, C] (compute dtype), t: [B] int32."""
+    dt = cfg.dtype
+    x = x.astype(dt)
+    temb = timestep_embedding(t, cfg.time_dim // 4)
+    temb = jnp.einsum("bt,td->bd", temb, params["time_w1"].astype(
+        jnp.float32)) + params["time_b1"].astype(jnp.float32)
+    temb = jnp.einsum("bt,td->bd", jax.nn.silu(temb),
+                      params["time_w2"].astype(jnp.float32)) + \
+        params["time_b2"].astype(jnp.float32)
+
+    h = _conv(x, params["conv_in"], params["conv_in_b"])
+    skips = [h]
+    for si, stage in enumerate(params["down"]):
+        for p in stage["blocks"]:
+            h = _res_block(cfg, p, h, temb)
+            skips.append(h)
+        if "down" in stage:
+            h = _downsample(h, stage["down"], stage["down_b"])
+            skips.append(h)
+
+    h = _res_block(cfg, params["mid1"], h, temb)
+    h = _self_attention(cfg, params["mid_attn"], h)
+    h = _res_block(cfg, params["mid2"], h, temb)
+
+    for si, stage in enumerate(params["up"]):
+        for p in stage["blocks"]:
+            h = jnp.concatenate([h, skips.pop()], axis=-1)
+            h = _res_block(cfg, p, h, temb)
+        if "up" in stage:
+            h = _upsample(h, stage["up"], stage["up_b"])
+            skips.pop()  # consume the post-downsample skip at this res
+
+    h = _groupnorm(h, params["gn_out_scale"], params["gn_out_bias"],
+                   cfg.n_groups)
+    out = _conv(jax.nn.silu(h), params["conv_out"], params["conv_out_b"])
+    return out.astype(jnp.float32)
+
+
+# -- diffusion process --------------------------------------------------
+
+def make_schedule(cfg: UNetConfig):
+    """Linear beta schedule → (betas, alphas_bar) as fp32 [T]."""
+    betas = jnp.linspace(1e-4, 0.02, cfg.n_timesteps, dtype=jnp.float32)
+    alphas_bar = jnp.cumprod(1.0 - betas)
+    return betas, alphas_bar
+
+
+def loss_fn(params: Dict[str, Any], cfg: UNetConfig, images: jax.Array,
+            key: jax.Array) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Simple DDPM epsilon-prediction MSE loss."""
+    _, alphas_bar = make_schedule(cfg)
+    B = images.shape[0]
+    k_t, k_eps = jax.random.split(key)
+    t = jax.random.randint(k_t, (B,), 0, cfg.n_timesteps)
+    eps = jax.random.normal(k_eps, images.shape, jnp.float32)
+    ab = alphas_bar[t][:, None, None, None]
+    x_t = jnp.sqrt(ab) * images.astype(jnp.float32) + jnp.sqrt(1 - ab) * eps
+    pred = forward(params, cfg, x_t, t)
+    loss = ((pred - eps) ** 2).mean()
+    return loss, {"loss": loss}
+
+
+def ddim_sample(params: Dict[str, Any], cfg: UNetConfig, key: jax.Array,
+                batch: int, n_steps: int = 50,
+                eta: float = 0.0) -> jax.Array:
+    """DDIM sampler as one `lax.scan` — the whole reverse process is a
+    single compiled program (jit this for Serve TPU replicas)."""
+    _, alphas_bar = make_schedule(cfg)
+    ts = jnp.linspace(cfg.n_timesteps - 1, 0, n_steps).astype(jnp.int32)
+    shape = (batch, cfg.image_size, cfg.image_size, cfg.in_channels)
+    k_init, k_noise = jax.random.split(key)
+    x = jax.random.normal(k_init, shape, jnp.float32)
+
+    def step(carry, idx):
+        x, k = carry
+        t = ts[idx]
+        t_next = jnp.where(idx + 1 < n_steps, ts[jnp.minimum(
+            idx + 1, n_steps - 1)], -1)
+        ab_t = alphas_bar[t]
+        ab_next = jnp.where(t_next >= 0, alphas_bar[jnp.maximum(t_next, 0)],
+                            1.0)
+        eps = forward(params, cfg, x, jnp.full((batch,), t, jnp.int32))
+        x0 = (x - jnp.sqrt(1 - ab_t) * eps) / jnp.sqrt(ab_t)
+        x0 = jnp.clip(x0, -3.0, 3.0)
+        sigma = eta * jnp.sqrt((1 - ab_next) / (1 - ab_t)) * jnp.sqrt(
+            1 - ab_t / ab_next)
+        k, sub = jax.random.split(k)
+        noise = jax.random.normal(sub, shape, jnp.float32)
+        dir_xt = jnp.sqrt(jnp.maximum(1 - ab_next - sigma ** 2, 0.0)) * eps
+        x = jnp.sqrt(ab_next) * x0 + dir_xt + sigma * noise
+        return (x, k), None
+
+    (x, _), _ = jax.lax.scan(step, (x, k_noise), jnp.arange(n_steps))
+    return x

@@ -1,0 +1,274 @@
+"""Llama family — RMSNorm / SwiGLU / full-dim rotary / GQA, TPU-first.
+
+Same design stance as models/gpt.py (pure-pytree params, `lax.scan` over
+stacked layers, bf16 matmuls with fp32 norm/softmax, logical-axis sharding
+via ShardingRules) but the Llama architecture: sequential pre-norm blocks,
+RMSNorm without bias, SwiGLU FFN, rotary applied to the full head dim with
+the half-rotation (non-interleaved) convention, grouped-query attention,
+and no biases anywhere.
+
+Capability parity note: the reference has no model zoo (models come from
+torch/transformers; e.g. its Train examples fine-tune HF models —
+reference: python/ray/train/huggingface/huggingface_trainer.py). This
+module is the JAX equivalent of `transformers.LlamaForCausalLM` for the
+rebuild's Train/Serve paths.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ray_tpu.parallel.sharding import ShardingRules
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    n_layers: int = 32
+    d_model: int = 4096
+    n_heads: int = 32
+    n_kv_heads: Optional[int] = None  # != n_heads → GQA
+    d_ff: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    attn_impl: str = "dot"  # "dot" | "flash" | "ring" | "ulysses"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    def num_params(self) -> int:
+        d, f, v, L = self.d_model, self.d_ff, self.vocab_size, self.n_layers
+        kvh = self.kv_heads * self.head_dim
+        per_layer = (d * d + 2 * d * kvh + d * d  # q, k, v, o
+                     + 3 * d * f                   # gate, up, down
+                     + 2 * d)                      # two RMSNorm scales
+        head = 0 if self.tie_embeddings else v * d
+        return v * d + L * per_layer + d + head
+
+
+PRESETS: Dict[str, LlamaConfig] = {
+    "llama2-7b": LlamaConfig(),
+    "llama3-8b": LlamaConfig(
+        vocab_size=128256, n_layers=32, d_model=4096, n_heads=32,
+        n_kv_heads=8, d_ff=14336, max_seq_len=8192, rope_theta=500000.0),
+    "tinyllama-1b": LlamaConfig(
+        vocab_size=32000, n_layers=22, d_model=2048, n_heads=32,
+        n_kv_heads=4, d_ff=5632, max_seq_len=2048),
+    # Test-size configs.
+    "llama-tiny": LlamaConfig(
+        vocab_size=256, n_layers=2, d_model=64, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=128, dtype=jnp.float32, remat=False),
+    "llama-micro": LlamaConfig(
+        vocab_size=512, n_layers=4, d_model=128, n_heads=8, n_kv_heads=4,
+        d_ff=256, max_seq_len=256, dtype=jnp.float32, remat=False),
+}
+
+
+def config(name: str, **overrides) -> LlamaConfig:
+    cfg = PRESETS[name]
+    return replace(cfg, **overrides) if overrides else cfg
+
+
+# -- init + sharding specs ----------------------------------------------
+
+def init(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    d, f, v, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers
+    h, kvh, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    pd = cfg.param_dtype
+    std = 0.02
+    out_std = std / math.sqrt(2 * L)
+
+    def norm(k, shape, s=std):
+        return (jax.random.normal(k, shape, jnp.float32) * s).astype(pd)
+
+    ks = jax.random.split(k_layers, 7)
+
+    def stack(k, shape, s=std):
+        return norm(k, (L,) + shape, s)
+
+    layers = {
+        "attn_norm": jnp.ones((L, d), pd),
+        "wq": stack(ks[0], (d, h, hd)),
+        "wk": stack(ks[1], (d, kvh, hd)),
+        "wv": stack(ks[2], (d, kvh, hd)),
+        "wo": stack(ks[3], (h, hd, d), out_std),
+        "ffn_norm": jnp.ones((L, d), pd),
+        "w_gate": stack(ks[4], (d, f)),
+        "w_up": stack(ks[5], (d, f)),
+        "w_down": stack(ks[6], (f, d), out_std),
+    }
+    params = {
+        "wte": norm(k_embed, (v, d)),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), pd),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = norm(k_head, (d, v))
+    return params
+
+
+def param_specs(cfg: LlamaConfig, rules: ShardingRules) -> Dict[str, Any]:
+    r = rules
+    layers = {
+        "attn_norm": r.spec("layers", "embed"),
+        "wq": r.spec("layers", "embed", "heads", "head_dim"),
+        "wk": r.spec("layers", "embed", "kv_heads", "head_dim"),
+        "wv": r.spec("layers", "embed", "kv_heads", "head_dim"),
+        "wo": r.spec("layers", "heads", "head_dim", "embed"),
+        "ffn_norm": r.spec("layers", "embed"),
+        "w_gate": r.spec("layers", "embed", "mlp"),
+        "w_up": r.spec("layers", "embed", "mlp"),
+        "w_down": r.spec("layers", "mlp", "embed"),
+    }
+    specs = {
+        "wte": r.spec("vocab", "embed"),
+        "layers": layers,
+        "final_norm": r.spec("embed"),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = r.spec("embed", "vocab")
+    return specs
+
+
+def batch_spec(rules: ShardingRules) -> PartitionSpec:
+    return rules.spec("batch", "sequence")
+
+
+# -- forward ------------------------------------------------------------
+
+def _rmsnorm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    var = (x32 * x32).mean(-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rotary(x, positions, theta):
+    """Llama (half-rotation) rotary over the full head dim.
+    x: [B, S, H, D], positions: [B, S]."""
+    D = x.shape[-1]
+    half = D // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def _dot_attention(q, k, v, cfg: LlamaConfig):
+    B, S, H, D = q.shape
+    kvh = k.shape[2]
+    if kvh != H:
+        rep = H // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = 1.0 / math.sqrt(D)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+    logits = jnp.where((qpos >= kpos)[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _attention(q, k, v, cfg: LlamaConfig):
+    if cfg.attn_impl == "dot":
+        return _dot_attention(q, k, v, cfg)
+    if cfg.attn_impl == "flash":
+        from ray_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=True)
+    if cfg.attn_impl in ("ring", "ulysses"):
+        from ray_tpu.models import gpt as _gpt
+        # Reuse GPT's mesh-aware dispatch; the semantics (causal,
+        # [B, S, H, D] layout) are identical.
+        proxy = _gpt.GPTConfig(attn_impl=cfg.attn_impl)
+        return _gpt._attention(q, k, v, proxy)
+    raise ValueError(f"Unknown attn_impl {cfg.attn_impl!r}")
+
+
+def _block(cfg: LlamaConfig, x, layer, positions):
+    dt = cfg.dtype
+    h = _rmsnorm(x, layer["attn_norm"], cfg.rms_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(dt))
+    q = _rotary(q, positions, cfg.rope_theta)
+    k = _rotary(k, positions, cfg.rope_theta)
+    attn = _attention(q, k, v, cfg)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(dt))
+
+    h = _rmsnorm(x, layer["ffn_norm"], cfg.rms_eps)
+    gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(dt))
+    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt))
+    ff = jax.nn.silu(gate) * up
+    return x + jnp.einsum("bsf,fd->bsd", ff, layer["w_down"].astype(dt))
+
+
+def forward(params: Dict[str, Any], cfg: LlamaConfig, tokens: jax.Array,
+            positions: Optional[jax.Array] = None) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, vocab] (compute dtype)."""
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = jnp.take(params["wte"], tokens, axis=0).astype(cfg.dtype)
+
+    block = partial(_block, cfg)
+    if cfg.remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_body(x, layer):
+        return block(x, layer, positions), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = _rmsnorm(x, params["final_norm"], cfg.rms_eps)
+    if cfg.tie_embeddings:
+        return jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(cfg.dtype))
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(cfg.dtype))
+
+
+def loss_fn(params: Dict[str, Any], cfg: LlamaConfig, tokens: jax.Array,
+            targets: jax.Array, mask: Optional[jax.Array] = None,
+            z_loss: float = 0.0) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross-entropy in fp32 (+ optional z-loss)."""
+    logits = forward(params, cfg, tokens).astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - tgt_logit
+    if z_loss:
+        nll = nll + z_loss * logz ** 2
+    if mask is None:
+        mask = jnp.ones_like(nll)
+    mask = mask.astype(jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / denom
+    acc = ((logits.argmax(-1) == targets) * mask).sum() / denom
+    return loss, {"loss": loss, "accuracy": acc,
+                  "perplexity": jnp.exp(jnp.minimum(loss, 20.0))}
+
+
+def flops_per_token(cfg: LlamaConfig) -> float:
+    attn = 12 * cfg.n_layers * cfg.d_model * cfg.max_seq_len
+    return 6.0 * cfg.num_params() + attn

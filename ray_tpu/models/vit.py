@@ -1,0 +1,251 @@
+"""Vision Transformer — image classification, TPU-first.
+
+Same design stance as models/gpt.py: pure-pytree params, `lax.scan` over
+stacked layers, bf16 matmuls with fp32 norm/softmax, logical-axis sharding
+via ShardingRules. Patch embedding is a reshape + one big matmul (not a
+conv) so the whole model is matmuls on the MXU.
+
+Capability parity note: the reference's Train/AIR image benchmarks train
+torchvision models (reference:
+release/air_tests/air_benchmarks/workloads/torch_benchmark.py); this is
+the rebuild's JAX vision model for those paths.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ray_tpu.parallel.sharding import ShardingRules
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    n_channels: int = 3
+    n_classes: int = 1000
+    n_layers: int = 12
+    d_model: int = 768
+    n_heads: int = 12
+    d_ff: int = 3072
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    layernorm_eps: float = 1e-6
+    remat: bool = False
+    pool: str = "cls"  # "cls" | "mean"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.n_channels * self.patch_size ** 2
+
+    @property
+    def seq_len(self) -> int:
+        return self.n_patches + (1 if self.pool == "cls" else 0)
+
+    def num_params(self) -> int:
+        d, f, L = self.d_model, self.d_ff, self.n_layers
+        per_layer = 4 * d * d + 2 * d * f + f + d + 4 * d
+        return (self.patch_dim * d + d + self.seq_len * d
+                + L * per_layer + 2 * d + d * self.n_classes
+                + self.n_classes + (d if self.pool == "cls" else 0))
+
+
+PRESETS: Dict[str, ViTConfig] = {
+    "vit-b16": ViTConfig(),
+    "vit-l16": ViTConfig(n_layers=24, d_model=1024, n_heads=16, d_ff=4096),
+    "vit-s16": ViTConfig(n_layers=12, d_model=384, n_heads=6, d_ff=1536),
+    # Test-size configs.
+    "vit-tiny": ViTConfig(
+        image_size=32, patch_size=8, n_classes=10, n_layers=2, d_model=64,
+        n_heads=4, d_ff=128, dtype=jnp.float32),
+}
+
+
+def config(name: str, **overrides) -> ViTConfig:
+    cfg = PRESETS[name]
+    return replace(cfg, **overrides) if overrides else cfg
+
+
+# -- init + sharding specs ----------------------------------------------
+
+def init(cfg: ViTConfig, key: jax.Array) -> Dict[str, Any]:
+    k_patch, k_pos, k_layers, k_head, k_cls = jax.random.split(key, 5)
+    d, f, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    h, hd = cfg.n_heads, cfg.head_dim
+    pd = cfg.param_dtype
+    std = 0.02
+    out_std = std / math.sqrt(2 * L)
+
+    def norm(k, shape, s=std):
+        return (jax.random.normal(k, shape, jnp.float32) * s).astype(pd)
+
+    ks = jax.random.split(k_layers, 6)
+
+    def stack(k, shape, s=std):
+        return norm(k, (L,) + shape, s)
+
+    layers = {
+        "ln1_scale": jnp.ones((L, d), pd),
+        "ln1_bias": jnp.zeros((L, d), pd),
+        "wq": stack(ks[0], (d, h, hd)),
+        "wk": stack(ks[1], (d, h, hd)),
+        "wv": stack(ks[2], (d, h, hd)),
+        "wo": stack(ks[3], (h, hd, d), out_std),
+        "ln2_scale": jnp.ones((L, d), pd),
+        "ln2_bias": jnp.zeros((L, d), pd),
+        "w_in": stack(ks[4], (d, f)),
+        "b_in": jnp.zeros((L, f), pd),
+        "w_out": stack(ks[5], (f, d), out_std),
+        "b_out": jnp.zeros((L, d), pd),
+    }
+    params = {
+        "patch_proj": norm(k_patch, (cfg.patch_dim, d)),
+        "patch_bias": jnp.zeros((d,), pd),
+        "pos_embed": norm(k_pos, (cfg.seq_len, d)),
+        "layers": layers,
+        "lnf_scale": jnp.ones((d,), pd),
+        "lnf_bias": jnp.zeros((d,), pd),
+        "head_w": norm(k_head, (d, cfg.n_classes)),
+        "head_b": jnp.zeros((cfg.n_classes,), pd),
+    }
+    if cfg.pool == "cls":
+        params["cls_token"] = norm(k_cls, (d,))
+    return params
+
+
+def param_specs(cfg: ViTConfig, rules: ShardingRules) -> Dict[str, Any]:
+    r = rules
+    layers = {
+        "ln1_scale": r.spec("layers", "embed"),
+        "ln1_bias": r.spec("layers", "embed"),
+        "wq": r.spec("layers", "embed", "heads", "head_dim"),
+        "wk": r.spec("layers", "embed", "heads", "head_dim"),
+        "wv": r.spec("layers", "embed", "heads", "head_dim"),
+        "wo": r.spec("layers", "heads", "head_dim", "embed"),
+        "ln2_scale": r.spec("layers", "embed"),
+        "ln2_bias": r.spec("layers", "embed"),
+        "w_in": r.spec("layers", "embed", "mlp"),
+        "b_in": r.spec("layers", "mlp"),
+        "w_out": r.spec("layers", "mlp", "embed"),
+        "b_out": r.spec("layers", "embed"),
+    }
+    specs = {
+        "patch_proj": r.spec(None, "embed"),
+        "patch_bias": r.spec("embed"),
+        "pos_embed": r.spec(None, "embed"),
+        "layers": layers,
+        "lnf_scale": r.spec("embed"),
+        "lnf_bias": r.spec("embed"),
+        "head_w": r.spec("embed", "vocab"),
+        "head_b": r.spec("vocab"),
+    }
+    if cfg.pool == "cls":
+        specs["cls_token"] = r.spec("embed")
+    return specs
+
+
+def batch_spec(rules: ShardingRules) -> PartitionSpec:
+    """Spec for image batches [B, H, W, C]."""
+    return rules.spec("batch", None, None, None)
+
+
+# -- forward ------------------------------------------------------------
+
+def _layernorm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def patchify(cfg: ViTConfig, images: jax.Array) -> jax.Array:
+    """[B, H, W, C] → [B, n_patches, patch_dim] by pure reshape/transpose."""
+    B, H, W, C = images.shape
+    p = cfg.patch_size
+    x = images.reshape(B, H // p, p, W // p, p, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # [B, Hp, Wp, p, p, C]
+    return x.reshape(B, (H // p) * (W // p), p * p * C)
+
+
+def _block(cfg: ViTConfig, x, layer):
+    dt = cfg.dtype
+    h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"],
+                   cfg.layernorm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(dt))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(dt))
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    logits = (jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+              ).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(dt))
+
+    h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"],
+                   cfg.layernorm_eps)
+    ff = jnp.einsum("bsd,df->bsf", h, layer["w_in"].astype(dt))
+    ff = jax.nn.gelu(ff + layer["b_in"].astype(dt))
+    ff = jnp.einsum("bsf,fd->bsd", ff, layer["w_out"].astype(dt))
+    return x + ff + layer["b_out"].astype(dt)
+
+
+def forward(params: Dict[str, Any], cfg: ViTConfig,
+            images: jax.Array) -> jax.Array:
+    """images [B, H, W, C] float → logits [B, n_classes] (fp32)."""
+    dt = cfg.dtype
+    patches = patchify(cfg, images.astype(dt))
+    x = (jnp.einsum("bpd,de->bpe", patches, params["patch_proj"].astype(dt))
+         + params["patch_bias"].astype(dt))
+    if cfg.pool == "cls":
+        cls = jnp.broadcast_to(
+            params["cls_token"].astype(dt), (x.shape[0], 1, cfg.d_model))
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(dt)
+
+    block = partial(_block, cfg)
+    if cfg.remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_body(x, layer):
+        return block(x, layer), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = _layernorm(x, params["lnf_scale"], params["lnf_bias"],
+                   cfg.layernorm_eps)
+    pooled = x[:, 0] if cfg.pool == "cls" else x.mean(axis=1)
+    logits = (jnp.einsum("bd,dc->bc", pooled, params["head_w"].astype(dt))
+              + params["head_b"].astype(dt))
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: Dict[str, Any], cfg: ViTConfig, images: jax.Array,
+            labels: jax.Array) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Softmax cross-entropy classification loss (fp32)."""
+    logits = forward(params, cfg, images)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    loss = (logz - tgt).mean()
+    acc = (logits.argmax(-1) == labels).mean()
+    return loss, {"loss": loss, "accuracy": acc}
+
+
+def flops_per_image(cfg: ViTConfig) -> float:
+    return 6.0 * cfg.num_params() * cfg.seq_len

@@ -104,10 +104,22 @@ class JsonLoggerCallback(LoggerCallback):
     """result.json (one JSON line per report) per trial
     (reference: tune/logger/json.py)."""
 
+    def __init__(self, experiment_dir: Optional[str] = None):
+        super().__init__(experiment_dir)
+        self._seen: set = set()
+
     def on_trial_start(self, trial_id: str, config: dict) -> None:
         path = os.path.join(self._trial_dir(trial_id), "params.json")
         with open(path, "w") as f:
             json.dump(config, f, default=repr)
+        if trial_id not in self._seen:
+            # First start in this process: truncate any stale result.json
+            # left by a pre-restore run of the same trial (a PBT exploit
+            # relaunch in the same process keeps appending).
+            self._seen.add(trial_id)
+            result_path = os.path.join(self._trial_dir(trial_id),
+                                       "result.json")
+            open(result_path, "w").close()
 
     def on_trial_result(self, trial_id: str, result: dict) -> None:
         path = os.path.join(self._trial_dir(trial_id), "result.json")

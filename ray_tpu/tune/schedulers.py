@@ -94,7 +94,8 @@ class HyperBandScheduler:
         self.eta = reduction_factor
         # s_max + 1 brackets per generation; bracket s holds up to eta^s
         # trials starting with budget r = max_t / eta^s.
-        self.s_max = int(math.log(max_t) / math.log(self.eta))
+        # round() before int(): log(1000)/log(10) = 2.999... must give 3.
+        self.s_max = int(round(math.log(max_t) / math.log(self.eta), 10))
         # Flat list of live brackets: (milestones, capacity, count). A new
         # generation of brackets is appended when all existing ones fill,
         # as the reference creates fresh bracket cohorts on demand.
@@ -312,11 +313,23 @@ class PopulationBasedTraining:
         donor = self._rng.choice(upper)
         donor_config = self._configs.get(donor, {})
         new_config = self._explore(donor_config)
-        self._configs[trial_id] = dict(new_config)
+        # Tentative until the runner confirms: if the donor has no
+        # checkpoint yet the runner aborts, and this trial's recorded
+        # config must stay what it is actually running.
         self._exploit[trial_id] = (donor, new_config)
-        self.num_perturbations += 1
         return EXPLOIT
 
     def exploit_info(self, trial_id: str) -> Tuple[str, dict]:
-        """(donor_trial_id, mutated_config) for a trial told to EXPLOIT."""
-        return self._exploit.pop(trial_id)
+        """(donor_trial_id, mutated_config) for a trial told to EXPLOIT.
+        Peek only — the runner then calls commit_exploit or abort_exploit."""
+        return self._exploit[trial_id]
+
+    def commit_exploit(self, trial_id: str) -> None:
+        """The runner actually restarted the trial from the donor."""
+        donor, new_config = self._exploit.pop(trial_id)
+        self._configs[trial_id] = dict(new_config)
+        self.num_perturbations += 1
+
+    def abort_exploit(self, trial_id: str) -> None:
+        """The exploit was skipped (e.g. donor had no checkpoint)."""
+        self._exploit.pop(trial_id, None)

@@ -169,6 +169,14 @@ class Searcher:
                           error: bool = False) -> None:
         pass
 
+    def register_completed(self, trial_id: str, config: Dict[str, Any],
+                           result: Optional[dict],
+                           error: bool = False) -> None:
+        """Feed an externally-recorded completed trial (restore replay):
+        like on_trial_complete but with the config supplied, since the
+        searcher never suggested it in this process."""
+        pass
+
 
 class BasicVariantGenerator(Searcher):
     """Grid cross-product x num_samples with Domain sampling — the default
@@ -270,6 +278,12 @@ class TPESearcher(Searcher):
 
     def on_trial_complete(self, trial_id, result=None, error=False):
         config = self._pending.pop(trial_id, None)
+        self._observe(config, result, error)
+
+    def register_completed(self, trial_id, config, result, error=False):
+        self._observe(config, result, error)
+
+    def _observe(self, config, result, error):
         if config is None or error or not result:
             return
         value = result.get(self.metric)
@@ -308,3 +322,6 @@ class ConcurrencyLimiter(Searcher):
     def on_trial_complete(self, trial_id, result=None, error=False):
         self._live.discard(trial_id)
         self.searcher.on_trial_complete(trial_id, result, error)
+
+    def register_completed(self, trial_id, config, result, error=False):
+        self.searcher.register_completed(trial_id, config, result, error)

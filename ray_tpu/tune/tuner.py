@@ -122,18 +122,37 @@ class Tuner:
     # -- restore (reference: tune/tuner.py Tuner.restore) -----------------
 
     @classmethod
-    def restore(cls, path: str, trainable: Callable) -> "Tuner":
+    def restore(cls, path: str, trainable: Callable, *,
+                param_space: Optional[dict] = None,
+                tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None) -> "Tuner":
         """Resume an interrupted experiment from its directory: finished
-        trials keep their recorded results, unfinished ones rerun."""
+        trials keep their recorded results, unfinished ones rerun.
+
+        Schedulers, searchers, stop criteria, and callbacks are not
+        serialized in the experiment snapshot — pass the same ``tune_config``
+        / ``run_config`` objects used for the original run to keep their
+        semantics on the resumed trials (reference: tune/tuner.py
+        Tuner.restore takes the re-specified trainable the same way)."""
         state_file = os.path.join(path, _EXPERIMENT_STATE_FILE)
         with open(state_file) as f:
             state = json.load(f)
-        tune_config = TuneConfig(
-            metric=state["metric"], mode=state["mode"],
-            num_samples=state["num_samples"])
-        run_config = RunConfig(name=state.get("name"),
-                               storage_path=state.get("storage_path"))
-        return cls(trainable, param_space={},
+        if tune_config is None:
+            tune_config = TuneConfig(
+                metric=state["metric"], mode=state["mode"],
+                num_samples=state["num_samples"])
+        else:
+            tune_config.metric = tune_config.metric or state["metric"]
+            if tune_config.num_samples < state["num_samples"]:
+                tune_config.num_samples = state["num_samples"]
+        if run_config is None:
+            run_config = RunConfig(name=state.get("name"),
+                                   storage_path=state.get("storage_path"))
+        else:
+            run_config.name = run_config.name or state.get("name")
+            run_config.storage_path = (run_config.storage_path
+                                       or state.get("storage_path"))
+        return cls(trainable, param_space=param_space or {},
                    tune_config=tune_config, run_config=run_config,
                    _restored_state=state)
 
@@ -208,6 +227,12 @@ class Tuner:
                 num_created += 1
                 if ts["done"] and ts["error"] is None:
                     trials.append(trial)
+                    if searcher is not None:
+                        # Replay the recorded outcome so the searcher's
+                        # model includes pre-crash observations.
+                        searcher.register_completed(
+                            trial.trial_id, trial.config,
+                            trial.history[-1] if trial.history else None)
                 else:
                     trial.done = False
                     trial.history = []
@@ -219,7 +244,14 @@ class Tuner:
                     config=config)
                 num_created += 1
                 restore_queue.append(trial)
-            target_trials = num_created
+            if searcher is not None:
+                # A restored searcher keeps producing its remaining samples.
+                target_trials = max(num_created, target_trials)
+            else:
+                target_trials = num_created
+            # The placeholder variants built from the (empty) restore
+            # param_space must never leak into snapshots as pending work.
+            variants = None
 
         max_concurrent = cfg.max_concurrent_trials or max(target_trials, 1)
         running: Dict[Any, _Trial] = {}  # outstanding result ref -> trial
@@ -314,13 +346,16 @@ class Tuner:
                                 trial.trial_id, donor_id)
                     # Restart this trial from the donor's checkpoint with
                     # the mutated config (reference: pbt.py _exploit).
+                    scheduler.commit_exploit(trial.trial_id)
                     ray_tpu.kill(trial.actor)
                     trial.config = new_config
                     launch(trial, checkpoint=donor_ckpt)
                     continue
                 # Donor has no checkpoint yet: restarting would lose all
                 # progress for nothing — keep the trial running
-                # (reference pbt.py skips checkpointless exploits).
+                # (reference pbt.py skips checkpointless exploits). The
+                # scheduler must forget the tentative exploit too.
+                scheduler.abort_exploit(trial.trial_id)
                 decision = CONTINUE
             if decision == STOP or self._hit_stop_criteria(metrics):
                 trial.stopped = True

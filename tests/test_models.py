@@ -1,0 +1,210 @@
+"""Model-zoo tests: Llama, ViT, diffusion UNet (GPT is covered in
+test_model_parallel.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import diffusion, llama, vit
+from ray_tpu.parallel import MeshConfig, build_mesh
+from ray_tpu.parallel.sharding import (ShardingRules, shard_tree,
+                                       tp_fsdp_rules)
+
+
+# -- Llama --------------------------------------------------------------
+
+def test_llama_forward_shape():
+    cfg = llama.config("llama-tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = llama.forward(params, cfg, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits, np.float32)).all()
+
+
+def test_llama_causality():
+    cfg = llama.config("llama-tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(1))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, (1, 12))
+    a = llama.forward(params, cfg, jnp.asarray(toks, jnp.int32))
+    toks2 = toks.copy()
+    toks2[0, -1] = (toks2[0, -1] + 1) % cfg.vocab_size
+    b = llama.forward(params, cfg, jnp.asarray(toks2, jnp.int32))
+    # Changing the last token must not affect logits at earlier positions.
+    np.testing.assert_allclose(np.asarray(a[0, :-1]), np.asarray(b[0, :-1]),
+                               atol=1e-5)
+
+
+def test_llama_param_count_matches_init():
+    cfg = llama.config("llama-tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert actual == cfg.num_params()
+
+
+def test_llama_gqa_fewer_kv_heads():
+    cfg = llama.config("llama-tiny")
+    assert cfg.kv_heads == 2 and cfg.n_heads == 4
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    assert params["layers"]["wk"].shape == (
+        cfg.n_layers, cfg.d_model, 2, cfg.head_dim)
+
+
+def test_llama_loss_decreases():
+    cfg = llama.config("llama-tiny")
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 17)), jnp.int32)
+    tokens, targets = toks[:, :-1], toks[:, 1:]
+
+    @jax.jit
+    def step(params):
+        (loss, m), grads = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, cfg, tokens, targets),
+            has_aux=True)(params)
+        params = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+        return params, loss
+
+    params, first = step(params)
+    for _ in range(10):
+        params, loss = step(params)
+    assert float(loss) < float(first)
+
+
+def test_llama_sharded_forward():
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    cfg = llama.config("llama-micro")
+    rules = tp_fsdp_rules()
+    params = llama.init(cfg, jax.random.PRNGKey(0))
+    specs = llama.param_specs(cfg, rules)
+    sharded = shard_tree(params, mesh, specs)
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    expect = llama.forward(params, cfg, tokens)
+    with mesh:
+        got = jax.jit(lambda p, t: llama.forward(p, cfg, t))(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(expect), np.asarray(got),
+                               atol=2e-3)
+
+
+# -- ViT ----------------------------------------------------------------
+
+def test_vit_forward_shape():
+    cfg = vit.config("vit-tiny")
+    params = vit.init(cfg, jax.random.PRNGKey(0))
+    images = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    logits = vit.forward(params, cfg, images)
+    assert logits.shape == (2, 10)
+
+
+def test_vit_param_count_matches_init():
+    cfg = vit.config("vit-tiny")
+    params = vit.init(cfg, jax.random.PRNGKey(0))
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert actual == cfg.num_params()
+
+
+def test_vit_patchify_roundtrip():
+    cfg = vit.config("vit-tiny")
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+    patches = vit.patchify(cfg, imgs)
+    assert patches.shape == (2, cfg.n_patches, cfg.patch_dim)
+    # First patch = top-left 8x8 tile, flattened row-major.
+    np.testing.assert_allclose(
+        np.asarray(patches[0, 0]),
+        np.asarray(imgs[0, :8, :8, :]).reshape(-1))
+
+
+def test_vit_training_learns():
+    cfg = vit.config("vit-tiny")
+    params = vit.init(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    # Two trivially separable classes (bright vs dark images).
+    images = np.concatenate([
+        rng.normal(2.0, 0.1, (8, 32, 32, 3)),
+        rng.normal(-2.0, 0.1, (8, 32, 32, 3))]).astype(np.float32)
+    labels = np.array([0] * 8 + [1] * 8, np.int32)
+    images, labels = jnp.asarray(images), jnp.asarray(labels)
+
+    @jax.jit
+    def step(params):
+        (loss, m), grads = jax.value_and_grad(
+            lambda p: vit.loss_fn(p, cfg, images, labels),
+            has_aux=True)(params)
+        params = jax.tree.map(lambda p, g: p - 0.05 * g, params, grads)
+        return params, m
+
+    for _ in range(20):
+        params, m = step(params)
+    assert float(m["accuracy"]) >= 0.9
+
+
+def test_vit_sharded_forward():
+    mesh = build_mesh(MeshConfig(dp=4, tp=2))
+    cfg = vit.config("vit-tiny")
+    rules = ShardingRules(batch="dp", embed=None, heads="tp",
+                          kv_heads="tp", mlp="tp", vocab=None)
+    params = vit.init(cfg, jax.random.PRNGKey(0))
+    sharded = shard_tree(params, mesh, vit.param_specs(cfg, rules))
+    images = jnp.zeros((4, 32, 32, 3), jnp.float32)
+    expect = vit.forward(params, cfg, images)
+    with mesh:
+        got = jax.jit(lambda p, x: vit.forward(p, cfg, x))(sharded, images)
+    np.testing.assert_allclose(np.asarray(expect), np.asarray(got),
+                               atol=2e-3)
+
+
+# -- Diffusion ----------------------------------------------------------
+
+def test_unet_forward_shape():
+    cfg = diffusion.config("unet-tiny")
+    params = diffusion.init(cfg, jax.random.PRNGKey(0))
+    x = jnp.zeros((2, 16, 16, 3), jnp.float32)
+    t = jnp.zeros((2,), jnp.int32)
+    out = diffusion.forward(params, cfg, x, t)
+    assert out.shape == (2, 16, 16, 3)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_unet_timestep_conditioning():
+    cfg = diffusion.config("unet-tiny")
+    params = diffusion.init(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1, 16, 16, 3)), jnp.float32)
+    a = diffusion.forward(params, cfg, x, jnp.array([0], jnp.int32))
+    b = diffusion.forward(params, cfg, x, jnp.array([40], jnp.int32))
+    assert np.abs(np.asarray(a) - np.asarray(b)).max() > 1e-6
+
+
+def test_unet_loss_decreases():
+    cfg = diffusion.config("unet-tiny")
+    params = diffusion.init(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.normal(size=(4, 16, 16, 3)) * 0.1, jnp.float32)
+
+    @jax.jit
+    def step(params, key):
+        (loss, _), grads = jax.value_and_grad(
+            lambda p: diffusion.loss_fn(p, cfg, images, key),
+            has_aux=True)(params)
+        params = jax.tree.map(lambda p, g: p - 0.01 * g, params, grads)
+        return params, loss
+
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for i in range(15):
+        key, sub = jax.random.split(key)
+        params, loss = step(params, sub)
+        losses.append(float(loss))
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+def test_ddim_sample_shapes_and_finite():
+    cfg = diffusion.config("unet-tiny")
+    params = diffusion.init(cfg, jax.random.PRNGKey(0))
+    out = diffusion.ddim_sample(params, cfg, jax.random.PRNGKey(1),
+                                batch=2, n_steps=4)
+    assert out.shape == (2, 16, 16, 3)
+    assert np.isfinite(np.asarray(out)).all()
