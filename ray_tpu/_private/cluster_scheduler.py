@@ -468,6 +468,20 @@ class ClusterResourceScheduler:
             }
 
 
+def make_cluster_scheduler():
+    """Native C++ engine (src/ray_tpu_native/sched.cc) when it builds;
+    this pure-Python implementation otherwise. Both expose identical
+    semantics (tests/test_native_sched.py asserts decision parity)."""
+    try:
+        from ray_tpu._private.native_sched import (
+            NativeClusterResourceScheduler, native_sched_available)
+        if native_sched_available():
+            return NativeClusterResourceScheduler()
+    except Exception:  # noqa: BLE001 - any native failure → Python engine
+        pass
+    return ClusterResourceScheduler()
+
+
 def _fits_cumulative(avail: Dict[str, float], bundles: List[Dict[str, float]]):
     remaining = dict(avail)
     for b in bundles:

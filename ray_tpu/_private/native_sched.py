@@ -1,0 +1,503 @@
+"""Python binding for the native cluster resource scheduler.
+
+ctypes wrapper over src/ray_tpu_native/sched.cc — the native analog of the
+reference's C++ scheduling stack (fixed-point resource vectors,
+hybrid/spread policies, placement-group bundle placement; reference:
+src/ray/raylet/scheduling/cluster_resource_scheduler.h:44,
+scheduling/policy/hybrid_scheduling_policy.h,
+scheduling/policy/bundle_scheduling_policy.h).
+
+``NativeClusterResourceScheduler`` is drop-in compatible with the Python
+``ClusterResourceScheduler`` (cluster_scheduler.py): the runtime picks the
+native engine when the library builds (RAY_TPU_NATIVE_SCHED=0 disables),
+and every scheduling decision — node selection, admission accounting, PG
+bundle ledger — happens in C++.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import NodeID, PlacementGroupID
+from ray_tpu.exceptions import PlacementGroupError
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "src", "ray_tpu_native")
+_BUILD_DIR = os.path.abspath(os.path.join(os.path.dirname(_SRC), "..",
+                                          "build"))
+_lib = None
+_lib_lock = threading.Lock()
+
+_PG_STRATEGIES = {"PACK": 0, "SPREAD": 1, "STRICT_PACK": 2,
+                  "STRICT_SPREAD": 3}
+
+
+def _build_library() -> Optional[str]:
+    src = os.path.join(_SRC, "sched.cc")
+    if not os.path.exists(src):
+        return None
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    out = os.path.join(_BUILD_DIR, "libsched.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", out, src],
+            check=True, capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return None
+    return out
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = _build_library()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        P, I, L, D, C = (ctypes.c_void_p, ctypes.c_int, ctypes.c_int64,
+                        ctypes.c_double, ctypes.c_char_p)
+        lib.rsched_create.restype = P
+        lib.rsched_destroy.argtypes = [P]
+        lib.rsched_add_node.restype = L
+        lib.rsched_add_node.argtypes = [P, C]
+        lib.rsched_remove_node.restype = I
+        lib.rsched_remove_node.argtypes = [P, L]
+        lib.rsched_node_alive.restype = I
+        lib.rsched_node_alive.argtypes = [P, L]
+        lib.rsched_num_nodes.restype = L
+        lib.rsched_num_nodes.argtypes = [P]
+        lib.rsched_node_resources.restype = L
+        lib.rsched_node_resources.argtypes = [P, L, I, C, L]
+        lib.rsched_utilization.restype = D
+        lib.rsched_utilization.argtypes = [P, L]
+        lib.rsched_fits.restype = I
+        lib.rsched_fits.argtypes = [P, L, I, C]
+        lib.rsched_try_acquire_on.restype = I
+        lib.rsched_try_acquire_on.argtypes = [P, L, C]
+        lib.rsched_release_on.argtypes = [P, L, C]
+        lib.rsched_force_acquire_on.argtypes = [P, L, C]
+        lib.rsched_pick_and_acquire.restype = L
+        lib.rsched_pick_and_acquire.argtypes = [P, C, I]
+        lib.rsched_pg_create.restype = L
+        lib.rsched_pg_create.argtypes = [P, C, I]
+        lib.rsched_pg_remove.restype = I
+        lib.rsched_pg_remove.argtypes = [P, L]
+        lib.rsched_pg_exists.restype = I
+        lib.rsched_pg_exists.argtypes = [P, L]
+        lib.rsched_pg_num_bundles.restype = I
+        lib.rsched_pg_num_bundles.argtypes = [P, L]
+        lib.rsched_pg_bundle_node.restype = L
+        lib.rsched_pg_bundle_node.argtypes = [P, L, I]
+        lib.rsched_pg_bundle_resources.restype = L
+        lib.rsched_pg_bundle_resources.argtypes = [P, L, I, I, C, L]
+        lib.rsched_pg_try_acquire.restype = I
+        lib.rsched_pg_try_acquire.argtypes = [P, L, I, C]
+        lib.rsched_pg_release.argtypes = [P, L, I, C]
+        lib.rsched_pg_force_acquire.argtypes = [P, L, I, C]
+        lib.rsched_pg_reschedule_lost.restype = L
+        lib.rsched_pg_reschedule_lost.argtypes = [
+            P, ctypes.POINTER(L), L]
+        _lib = lib
+        return _lib
+
+
+def native_sched_available() -> bool:
+    if os.environ.get("RAY_TPU_NATIVE_SCHED", "1") == "0":
+        return False
+    return _load() is not None
+
+
+def _encode(resources: Dict[str, float]) -> bytes:
+    return ";".join(f"{k}={float(v):.10g}"
+                    for k, v in resources.items()).encode()
+
+
+def _decode(raw: bytes) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    if not raw:
+        return out
+    for part in raw.decode().split(";"):
+        k, _, v = part.partition("=")
+        out[k] = float(v)
+    return out
+
+
+class _LocalView:
+    """NodeState.local-compatible view (total/available) over the native
+    node; consumers (autoscaler, state API) read these as dicts."""
+
+    __slots__ = ("_sched", "_handle")
+
+    def __init__(self, sched: "NativeClusterResourceScheduler",
+                 handle: int):
+        self._sched = sched
+        self._handle = handle
+
+    def _read(self, which: int) -> Dict[str, float]:
+        lib = self._sched._lib
+        cap = 4096
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = lib.rsched_node_resources(self._sched._h, self._handle,
+                                          which, buf, cap)
+            if n < 0:
+                return {}
+            if n < cap:
+                return _decode(buf.value)
+            cap = n + 1
+
+    @property
+    def total(self) -> Dict[str, float]:
+        return self._read(0)
+
+    @property
+    def available(self) -> Dict[str, float]:
+        return self._read(1)
+
+
+class NodeStateView:
+    """NodeState-compatible handle onto a native node."""
+
+    def __init__(self, sched: "NativeClusterResourceScheduler",
+                 node_id: NodeID, handle: int, resources: Dict[str, float],
+                 is_head: bool, labels: Optional[dict]):
+        self.node_id = node_id
+        self.resources = dict(resources)
+        self.is_head = is_head
+        self.labels = dict(labels or {})
+        self.free_tpu_ids: List[int] = list(
+            range(int(resources.get("TPU", 0))))
+        self._sched = sched
+        self._handle = handle
+        self.local = _LocalView(sched, handle)
+
+    @property
+    def alive(self) -> bool:
+        return bool(self._sched._lib.rsched_node_alive(
+            self._sched._h, self._handle))
+
+    def utilization(self) -> float:
+        return float(self._sched._lib.rsched_utilization(
+            self._sched._h, self._handle))
+
+
+class NativeClusterResourceScheduler:
+    """Drop-in ClusterResourceScheduler backed by the C++ engine."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native scheduler library unavailable")
+        self._lib = lib
+        self._h = lib.rsched_create()
+        self._lock = threading.RLock()
+        self._nodes: Dict[NodeID, NodeStateView] = {}
+        self._order: List[NodeID] = []
+        self._handles: Dict[int, NodeID] = {}
+        self._pgs: Dict[PlacementGroupID, int] = {}  # pg id -> native handle
+        self._pg_strategies: Dict[PlacementGroupID, str] = {}
+
+    def __del__(self):
+        try:
+            self._lib.rsched_destroy(self._h)
+        except Exception:  # noqa: BLE001 - interpreter shutdown
+            pass
+
+    # -- membership -------------------------------------------------------
+
+    def add_node(self, resources: Dict[str, float], is_head: bool = False,
+                 labels: Optional[dict] = None) -> NodeID:
+        node_id = NodeID.from_random()
+        resources = dict(resources)
+        resources.setdefault(f"node:{node_id.hex()[:12]}", 1.0)
+        if is_head:
+            resources.setdefault("node:__internal_head__", 1.0)
+        with self._lock:
+            handle = self._lib.rsched_add_node(self._h, _encode(resources))
+            if handle < 0:
+                raise RuntimeError("native add_node failed")
+            view = NodeStateView(self, node_id, handle, resources, is_head,
+                                 labels)
+            self._nodes[node_id] = view
+            self._order.append(node_id)
+            self._handles[handle] = node_id
+        return node_id
+
+    def remove_node(self, node_id: NodeID) -> Optional[NodeStateView]:
+        with self._lock:
+            view = self._nodes.get(node_id)
+            if view is None:
+                return None
+            if self._lib.rsched_remove_node(self._h, view._handle) != 0:
+                return None
+            self._order.remove(node_id)
+            return view
+
+    def node(self, node_id: NodeID) -> Optional[NodeStateView]:
+        return self._nodes.get(node_id)
+
+    def alive_nodes(self) -> List[NodeStateView]:
+        with self._lock:
+            return [self._nodes[n] for n in self._order]
+
+    def nodes_snapshot(self) -> List[dict]:
+        with self._lock:
+            out = []
+            for node_id, view in self._nodes.items():
+                alive = view.alive
+                out.append({
+                    "NodeID": node_id.hex(),
+                    "Alive": alive,
+                    "Resources": dict(view.resources),
+                    "Available": view.local.available if alive else {},
+                    "IsHead": view.is_head,
+                    "Labels": dict(view.labels),
+                })
+            return out
+
+    # -- aggregate views --------------------------------------------------
+
+    @property
+    def total(self) -> Dict[str, float]:
+        agg: Dict[str, float] = {}
+        for view in self.alive_nodes():
+            for k, v in view.local.total.items():
+                agg[k] = agg.get(k, 0.0) + v
+        return agg
+
+    @property
+    def available(self) -> Dict[str, float]:
+        agg: Dict[str, float] = {}
+        for view in self.alive_nodes():
+            for k, v in view.local.available.items():
+                agg[k] = agg.get(k, 0.0) + v
+        return agg
+
+    # -- selection + accounting -------------------------------------------
+
+    def _affinity_target(self, strategy) -> Optional[NodeStateView]:
+        with self._lock:
+            for view in self._nodes.values():
+                if view.node_id.hex().startswith(strategy.node_id) or \
+                        strategy.node_id == view.node_id.hex():
+                    return view
+        return None
+
+    def is_feasible(self, resources: Dict[str, float],
+                    pg_id: Optional[PlacementGroupID] = None,
+                    bundle_index: int = -1, strategy=None) -> bool:
+        raw = _encode(resources)
+        if pg_id is not None:
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+            if pg is None:
+                return False
+            n = self._lib.rsched_pg_num_bundles(self._h, pg)
+            idxs = [bundle_index] if bundle_index >= 0 else range(n)
+            for i in idxs:
+                if i >= n:
+                    return False
+                reserved = self._pg_bundle_resources(pg, i, 0)
+                if all(reserved.get(k, 0.0) + 1e-9 >= v
+                       for k, v in resources.items()):
+                    return True
+            return False
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        if isinstance(strategy, NodeAffinitySchedulingStrategy) and \
+                not strategy.soft:
+            target = self._affinity_target(strategy)
+            return target is not None and target.alive and bool(
+                self._lib.rsched_fits(self._h, target._handle, 0, raw))
+        return any(
+            self._lib.rsched_fits(self._h, view._handle, 0, raw)
+            for view in self.alive_nodes())
+
+    def try_acquire(self, resources: Dict[str, float],
+                    pg_id: Optional[PlacementGroupID] = None,
+                    bundle_index: int = -1,
+                    strategy=None) -> Optional[Tuple[NodeID, int]]:
+        raw = _encode(resources)
+        if pg_id is not None:
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+            if pg is None:
+                return None
+            used = self._lib.rsched_pg_try_acquire(self._h, pg,
+                                                   bundle_index, raw)
+            if used < 0:
+                return None
+            handle = self._lib.rsched_pg_bundle_node(self._h, pg, used)
+            return self._handles.get(handle), used
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            target = self._affinity_target(strategy)
+            if target is not None and target.alive:
+                if self._lib.rsched_try_acquire_on(
+                        self._h, target._handle, raw) == 0:
+                    return target.node_id, -1
+                if not strategy.soft:
+                    return None
+            elif not strategy.soft:
+                return None
+            handle = self._lib.rsched_pick_and_acquire(self._h, raw, 0)
+            if handle < 0:
+                return None
+            return self._handles.get(handle), -1
+        policy = 1 if strategy == "SPREAD" else 0
+        handle = self._lib.rsched_pick_and_acquire(self._h, raw, policy)
+        if handle < 0:
+            return None
+        return self._handles.get(handle), -1
+
+    def release(self, resources: Dict[str, float],
+                node_id: Optional[NodeID] = None,
+                pg_id: Optional[PlacementGroupID] = None,
+                bundle_index: int = -1) -> None:
+        raw = _encode(resources)
+        if pg_id is not None and bundle_index >= 0:
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+            if pg is not None:
+                self._lib.rsched_pg_release(self._h, pg, bundle_index, raw)
+            return
+        if node_id is None:
+            return
+        view = self._nodes.get(node_id)
+        if view is not None:
+            self._lib.rsched_release_on(self._h, view._handle, raw)
+
+    def force_acquire(self, resources: Dict[str, float],
+                      node_id: Optional[NodeID] = None,
+                      pg_id: Optional[PlacementGroupID] = None,
+                      bundle_index: int = -1) -> None:
+        raw = _encode(resources)
+        if pg_id is not None and bundle_index >= 0:
+            with self._lock:
+                pg = self._pgs.get(pg_id)
+            if pg is not None:
+                self._lib.rsched_pg_force_acquire(self._h, pg, bundle_index,
+                                                  raw)
+            return
+        if node_id is None:
+            return
+        view = self._nodes.get(node_id)
+        if view is not None:
+            self._lib.rsched_force_acquire_on(self._h, view._handle, raw)
+
+    # -- TPU chip slots ---------------------------------------------------
+
+    def take_tpu_ids(self, node_id: NodeID, n: int) -> Optional[List[int]]:
+        with self._lock:
+            view = self._nodes.get(node_id)
+            if view is None or len(view.free_tpu_ids) < n:
+                return None
+            return [view.free_tpu_ids.pop() for _ in range(n)]
+
+    def return_tpu_ids(self, node_id: NodeID, ids: List[int]) -> None:
+        with self._lock:
+            view = self._nodes.get(node_id)
+            if view is not None and view.alive:
+                view.free_tpu_ids.extend(ids)
+
+    # -- placement groups -------------------------------------------------
+
+    def create_placement_group(self, pg_id: PlacementGroupID,
+                               bundles: List[Dict[str, float]],
+                               strategy: str = "PACK") -> None:
+        encoded = "|".join(_encode(b).decode() for b in bundles).encode()
+        code = _PG_STRATEGIES.get(strategy, 0)
+        with self._lock:
+            if not self._order:
+                raise PlacementGroupError("No alive nodes.")
+            handle = self._lib.rsched_pg_create(self._h, encoded, code)
+            if handle < 0:
+                raise PlacementGroupError(
+                    f"Placement group bundles {bundles} cannot be reserved "
+                    f"with strategy {strategy} on the current cluster "
+                    f"(nodes: {[v.local.available for v in self.alive_nodes()]}).")
+            self._pgs[pg_id] = handle
+            self._pg_strategies[pg_id] = strategy
+
+    def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
+        with self._lock:
+            handle = self._pgs.pop(pg_id, None)
+            self._pg_strategies.pop(pg_id, None)
+        if handle is not None:
+            self._lib.rsched_pg_remove(self._h, handle)
+
+    def placement_group_exists(self, pg_id: PlacementGroupID) -> bool:
+        with self._lock:
+            return pg_id in self._pgs
+
+    def _pg_bundle_resources(self, handle: int, bundle: int,
+                             which: int) -> Dict[str, float]:
+        cap = 4096
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.rsched_pg_bundle_resources(self._h, handle, bundle,
+                                                     which, buf, cap)
+            if n < 0:
+                return {}
+            if n < cap:
+                return _decode(buf.value)
+            cap = n + 1
+
+    def placement_groups(self):
+        out = {}
+        with self._lock:
+            items = list(self._pgs.items())
+        for pg_id, handle in items:
+            n = self._lib.rsched_pg_num_bundles(self._h, handle)
+            out[pg_id] = [self._pg_bundle_resources(handle, i, 0)
+                          for i in range(n)]
+        return out
+
+    def placement_group_table(self) -> List[dict]:
+        rows = []
+        with self._lock:
+            items = list(self._pgs.items())
+        for pg_id, handle in items:
+            n = self._lib.rsched_pg_num_bundles(self._h, handle)
+            bundles = []
+            for i in range(n):
+                node_handle = self._lib.rsched_pg_bundle_node(self._h,
+                                                              handle, i)
+                node_id = self._handles.get(node_handle)
+                bundles.append({
+                    "node_id": node_id.hex() if node_id else None,
+                    "resources": self._pg_bundle_resources(handle, i, 0),
+                })
+            rows.append({
+                "placement_group_id": pg_id.hex(),
+                "strategy": self._pg_strategies.get(pg_id, "PACK"),
+                "bundles": bundles,
+            })
+        return rows
+
+    def reschedule_lost_bundles(self) -> List[PlacementGroupID]:
+        cap = max(len(self._pgs), 1)
+        out = (ctypes.c_int64 * cap)()
+        count = self._lib.rsched_pg_reschedule_lost(self._h, out, cap)
+        touched_handles = {out[i] for i in range(min(count, cap))}
+        with self._lock:
+            return [pg_id for pg_id, h in self._pgs.items()
+                    if h in touched_handles]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "total": self.total,
+                "available": self.available,
+                "num_nodes": len(self._order),
+                "num_placement_groups": len(self._pgs),
+            }

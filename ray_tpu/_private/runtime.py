@@ -34,7 +34,8 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private import serialization
-from ray_tpu._private.cluster_scheduler import ClusterResourceScheduler
+from ray_tpu._private.cluster_scheduler import (ClusterResourceScheduler,
+                                                make_cluster_scheduler)
 from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,
                                   PlacementGroupID, TaskID, WorkerID)
 from ray_tpu._private.object_ref import ObjectRef
@@ -259,7 +260,7 @@ class Runtime:
         self.store = ObjectStore(
             deserializer=serialization.deserialize,
             native_capacity=int(node_resources.memory_bytes * 0.3))
-        self.scheduler = ClusterResourceScheduler()
+        self.scheduler = make_cluster_scheduler()
         self.head_node_id = self.scheduler.add_node(
             node_resources.to_resource_map(), is_head=True)
         self.functions = FunctionTable()

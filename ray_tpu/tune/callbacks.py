@@ -108,6 +108,12 @@ class JsonLoggerCallback(LoggerCallback):
         super().__init__(experiment_dir)
         self._seen: set = set()
 
+    def setup(self, experiment_dir: Optional[str] = None, **info) -> None:
+        super().setup(experiment_dir=experiment_dir, **info)
+        # Scope the truncation guard to one fit(): a restore that reuses
+        # this callback instance must truncate stale result.json again.
+        self._seen.clear()
+
     def on_trial_start(self, trial_id: str, config: dict) -> None:
         path = os.path.join(self._trial_dir(trial_id), "params.json")
         with open(path, "w") as f:

@@ -177,6 +177,12 @@ class Searcher:
         searcher never suggested it in this process."""
         pass
 
+    def register_pending(self, trial_id: str,
+                         config: Dict[str, Any]) -> None:
+        """Adopt an externally-created in-flight trial (restore requeue) so
+        its eventual on_trial_complete is credited to this config."""
+        pass
+
 
 class BasicVariantGenerator(Searcher):
     """Grid cross-product x num_samples with Domain sampling — the default
@@ -283,6 +289,9 @@ class TPESearcher(Searcher):
     def register_completed(self, trial_id, config, result, error=False):
         self._observe(config, result, error)
 
+    def register_pending(self, trial_id, config):
+        self._pending[trial_id] = dict(config)
+
     def _observe(self, config, result, error):
         if config is None or error or not result:
             return
@@ -325,3 +334,9 @@ class ConcurrencyLimiter(Searcher):
 
     def register_completed(self, trial_id, config, result, error=False):
         self.searcher.register_completed(trial_id, config, result, error)
+
+    def register_pending(self, trial_id, config):
+        # The requeued trial occupies a concurrency slot like any other
+        # in-flight suggestion; it frees on completion.
+        self._live.add(trial_id)
+        self.searcher.register_pending(trial_id, config)

@@ -134,6 +134,8 @@ class Tuner:
         / ``run_config`` objects used for the original run to keep their
         semantics on the resumed trials (reference: tune/tuner.py
         Tuner.restore takes the re-specified trainable the same way)."""
+        import dataclasses
+
         state_file = os.path.join(path, _EXPERIMENT_STATE_FILE)
         with open(state_file) as f:
             state = json.load(f)
@@ -142,16 +144,27 @@ class Tuner:
                 metric=state["metric"], mode=state["mode"],
                 num_samples=state["num_samples"])
         else:
-            tune_config.metric = tune_config.metric or state["metric"]
+            # Merge into a copy — never mutate the caller's object.
+            updates = {}
+            if tune_config.metric is None:
+                # metric and mode travel together: backfilling one from the
+                # snapshot but not the other could flip the optimization
+                # direction.
+                updates["metric"] = state["metric"]
+                updates["mode"] = state["mode"]
             if tune_config.num_samples < state["num_samples"]:
-                tune_config.num_samples = state["num_samples"]
+                updates["num_samples"] = state["num_samples"]
+            tune_config = dataclasses.replace(tune_config, **updates)
         if run_config is None:
             run_config = RunConfig(name=state.get("name"),
                                    storage_path=state.get("storage_path"))
         else:
-            run_config.name = run_config.name or state.get("name")
-            run_config.storage_path = (run_config.storage_path
-                                       or state.get("storage_path"))
+            updates = {}
+            if run_config.name is None:
+                updates["name"] = state.get("name")
+            if run_config.storage_path is None:
+                updates["storage_path"] = state.get("storage_path")
+            run_config = dataclasses.replace(run_config, **updates)
         return cls(trainable, param_space=param_space or {},
                    tune_config=tune_config, run_config=run_config,
                    _restored_state=state)
@@ -237,6 +250,12 @@ class Tuner:
                     trial.done = False
                     trial.history = []
                     restore_queue.append(trial)
+                    if searcher is not None:
+                        # Register as pending so the searcher credits the
+                        # rerun's completion to this config (it never
+                        # suggest()-ed the trial in this process).
+                        searcher.register_pending(trial.trial_id,
+                                                  trial.config)
             for config in self._restored_state.get("pending_configs", []):
                 trial = _Trial(
                     trial_id=(f"trial_{num_created:05d}_"

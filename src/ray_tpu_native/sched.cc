@@ -1,0 +1,551 @@
+// sched.cc — native cluster resource scheduler (C ABI).
+//
+// TPU-native equivalent of the reference's C++ scheduling stack:
+// fixed-point resource vectors (src/ray/raylet/scheduling/fixed_point.h),
+// per-node accounting (scheduling/local_resource_manager.h:42), hybrid
+// pack-then-spread node selection
+// (scheduling/policy/hybrid_scheduling_policy.h:20-35), SPREAD with
+// round-robin tie-break, and placement-group bundle placement with
+// PACK / SPREAD / STRICT_PACK / STRICT_SPREAD strategies
+// (scheduling/policy/bundle_scheduling_policy.h) plus lost-bundle
+// rescheduling on node death.
+//
+// Resources cross the ABI as strings: "CPU=4;TPU=8;memory=1e9".
+// Bundle lists use '|' between bundles: "CPU=1;TPU=2|CPU=2".
+// Values are doubles, stored as int64 fixed-point in 1e-4 units (the
+// reference's kResourceUnitScaling).
+//
+// Python binding: ray_tpu/_private/native_sched.py. Thread safety: one
+// mutex per scheduler instance (all calls lock, like the reference's
+// ClusterResourceScheduler usage under the raylet main loop).
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 -o libsched.so sched.cc
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kScale = 10000;  // 1e-4 resource units
+constexpr double kSpreadThreshold = 0.5;
+
+using ResVec = std::map<int, int64_t>;  // interned name id -> fixed-point
+
+struct Node {
+  ResVec total;
+  ResVec avail;
+  bool alive = true;
+};
+
+struct Bundle {
+  int64_t node = -1;
+  ResVec reserved;
+  ResVec avail;
+};
+
+struct PlacementGroup {
+  int strategy = 0;
+  std::vector<Bundle> bundles;
+  bool alive = true;
+};
+
+struct Sched {
+  std::mutex mu;
+  std::vector<std::string> names;               // intern table
+  std::unordered_map<std::string, int> ids;
+  std::vector<Node> nodes;                      // handle = index
+  std::vector<int64_t> order;                   // insertion order, alive only
+  std::vector<PlacementGroup> pgs;              // handle = index
+  uint64_t spread_rr = 0;
+
+  int intern(const std::string& s) {
+    auto it = ids.find(s);
+    if (it != ids.end()) return it->second;
+    int id = static_cast<int>(names.size());
+    names.push_back(s);
+    ids.emplace(s, id);
+    return id;
+  }
+};
+
+// "CPU=4;TPU=8" -> ResVec. Returns false on parse error.
+bool ParseRes(Sched* s, const char* str, ResVec* out) {
+  out->clear();
+  if (str == nullptr) return true;
+  const char* p = str;
+  while (*p) {
+    const char* eq = strchr(p, '=');
+    if (eq == nullptr) return false;
+    const char* end = strchr(eq + 1, ';');
+    std::string name(p, eq - p);
+    double v = atof(std::string(eq + 1, end ? end - eq - 1
+                                            : strlen(eq + 1)).c_str());
+    int64_t fixed = llround(v * kScale);
+    if (fixed != 0 || v == 0.0) (*out)[s->intern(name)] = fixed;
+    if (end == nullptr) break;
+    p = end + 1;
+  }
+  return true;
+}
+
+int64_t FormatRes(const Sched* s, const ResVec& v, char* buf, int64_t cap) {
+  int64_t off = 0;
+  for (const auto& kv : v) {
+    int n = snprintf(buf + off, cap > off ? cap - off : 0, "%s%s=%.10g",
+                     off ? ";" : "", s->names[kv.first].c_str(),
+                     static_cast<double>(kv.second) / kScale);
+    if (n < 0) return -1;
+    off += n;
+  }
+  if (off < cap) buf[off] = '\0';
+  return off;  // required length (excl. NUL); caller re-calls if >= cap
+}
+
+bool Fits(const ResVec& avail, const ResVec& need) {
+  for (const auto& kv : need) {
+    auto it = avail.find(kv.first);
+    int64_t have = it == avail.end() ? 0 : it->second;
+    if (have < kv.second) return false;
+  }
+  return true;
+}
+
+void Sub(ResVec* avail, const ResVec& need) {
+  for (const auto& kv : need) (*avail)[kv.first] -= kv.second;
+}
+
+void Add(ResVec* avail, const ResVec& need) {
+  for (const auto& kv : need) (*avail)[kv.first] += kv.second;
+}
+
+// Max used-fraction over capacity resources, skipping node:* identity
+// resources (the hybrid policy's "critical resource utilization").
+double Utilization(const Sched* s, const Node& n) {
+  double worst = 0.0;
+  for (const auto& kv : n.total) {
+    if (kv.second <= 0) continue;
+    const std::string& name = s->names[kv.first];
+    if (name.rfind("node:", 0) == 0) continue;
+    auto it = n.avail.find(kv.first);
+    int64_t avail = it == n.avail.end() ? 0 : it->second;
+    double used = static_cast<double>(kv.second - avail) / kv.second;
+    if (used > worst) worst = used;
+  }
+  return worst;
+}
+
+double RoundedUtil(const Sched* s, const Node& n) {
+  return std::round(Utilization(s, n) * 1e6) / 1e6;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rsched_create() { return new Sched(); }
+
+void rsched_destroy(void* h) { delete static_cast<Sched*>(h); }
+
+int64_t rsched_add_node(void* h, const char* res) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  Node n;
+  if (!ParseRes(s, res, &n.total)) return -1;
+  n.avail = n.total;
+  int64_t handle = static_cast<int64_t>(s->nodes.size());
+  s->nodes.push_back(std::move(n));
+  s->order.push_back(handle);
+  return handle;
+}
+
+int rsched_remove_node(void* h, int64_t node) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (node < 0 || node >= static_cast<int64_t>(s->nodes.size()) ||
+      !s->nodes[node].alive)
+    return -1;
+  s->nodes[node].alive = false;
+  s->order.erase(std::find(s->order.begin(), s->order.end(), node));
+  return 0;
+}
+
+int rsched_node_alive(void* h, int64_t node) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return node >= 0 && node < static_cast<int64_t>(s->nodes.size()) &&
+         s->nodes[node].alive;
+}
+
+int64_t rsched_num_nodes(void* h) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return static_cast<int64_t>(s->order.size());
+}
+
+// which: 0 = total, 1 = available. Returns required length (excl. NUL),
+// or -1 on bad node.
+int64_t rsched_node_resources(void* h, int64_t node, int which, char* buf,
+                              int64_t cap) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (node < 0 || node >= static_cast<int64_t>(s->nodes.size())) return -1;
+  const Node& n = s->nodes[node];
+  return FormatRes(s, which == 0 ? n.total : n.avail, buf, cap);
+}
+
+double rsched_utilization(void* h, int64_t node) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (node < 0 || node >= static_cast<int64_t>(s->nodes.size())) return 0.0;
+  return Utilization(s, s->nodes[node]);
+}
+
+int rsched_fits(void* h, int64_t node, int which, const char* res) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (node < 0 || node >= static_cast<int64_t>(s->nodes.size())) return 0;
+  ResVec need;
+  if (!ParseRes(s, res, &need)) return 0;
+  const Node& n = s->nodes[node];
+  return Fits(which == 0 ? n.total : n.avail, need);
+}
+
+int rsched_try_acquire_on(void* h, int64_t node, const char* res) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (node < 0 || node >= static_cast<int64_t>(s->nodes.size()) ||
+      !s->nodes[node].alive)
+    return -1;
+  ResVec need;
+  if (!ParseRes(s, res, &need)) return -1;
+  Node& n = s->nodes[node];
+  if (!Fits(n.avail, need)) return -1;
+  Sub(&n.avail, need);
+  return 0;
+}
+
+void rsched_release_on(void* h, int64_t node, const char* res) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (node < 0 || node >= static_cast<int64_t>(s->nodes.size()) ||
+      !s->nodes[node].alive)
+    return;  // resources died with the node
+  ResVec need;
+  if (!ParseRes(s, res, &need)) return;
+  Add(&s->nodes[node].avail, need);
+}
+
+void rsched_force_acquire_on(void* h, int64_t node, const char* res) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (node < 0 || node >= static_cast<int64_t>(s->nodes.size()) ||
+      !s->nodes[node].alive)
+    return;
+  ResVec need;
+  if (!ParseRes(s, res, &need)) return;
+  Sub(&s->nodes[node].avail, need);  // may transiently overcommit
+}
+
+// strategy: 0 = DEFAULT/hybrid (pack in id order under the spread
+// threshold, else least-utilized), 1 = SPREAD (least-utilized,
+// round-robin tie-break). Returns the chosen node handle (resources
+// acquired) or -1.
+int64_t rsched_pick_and_acquire(void* h, const char* res, int strategy) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  ResVec need;
+  if (!ParseRes(s, res, &need)) return -1;
+
+  std::vector<int64_t> candidates;
+  if (strategy == 1) {
+    uint64_t rr = ++s->spread_rr;
+    std::vector<int64_t> ranked(s->order);
+    std::stable_sort(ranked.begin(), ranked.end(),
+                     [&](int64_t a, int64_t b) {
+                       return RoundedUtil(s, s->nodes[a]) <
+                              RoundedUtil(s, s->nodes[b]);
+                     });
+    if (!ranked.empty()) {
+      double lowest = RoundedUtil(s, s->nodes[ranked[0]]);
+      size_t np = 0;
+      while (np < ranked.size() &&
+             RoundedUtil(s, s->nodes[ranked[np]]) == lowest)
+        ++np;
+      size_t k = rr % np;
+      for (size_t i = 0; i < np; ++i)
+        candidates.push_back(ranked[(k + i) % np]);
+      for (size_t i = np; i < ranked.size(); ++i)
+        candidates.push_back(ranked[i]);
+    }
+  } else {
+    std::vector<int64_t> over;
+    for (int64_t id : s->order) {
+      if (Utilization(s, s->nodes[id]) < kSpreadThreshold)
+        candidates.push_back(id);
+      else
+        over.push_back(id);
+    }
+    std::stable_sort(over.begin(), over.end(), [&](int64_t a, int64_t b) {
+      return Utilization(s, s->nodes[a]) < Utilization(s, s->nodes[b]);
+    });
+    candidates.insert(candidates.end(), over.begin(), over.end());
+  }
+
+  for (int64_t id : candidates) {
+    Node& n = s->nodes[id];
+    if (!n.alive) continue;
+    if (Fits(n.avail, need)) {
+      Sub(&n.avail, need);
+      return id;
+    }
+  }
+  return -1;
+}
+
+// -- placement groups ---------------------------------------------------
+
+// strategy: 0 PACK, 1 SPREAD, 2 STRICT_PACK, 3 STRICT_SPREAD.
+// Returns pg handle or -1 if infeasible.
+int64_t rsched_pg_create(void* h, const char* bundles_str, int strategy) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+
+  std::vector<ResVec> bundles;
+  {
+    std::string all(bundles_str ? bundles_str : "");
+    size_t pos = 0;
+    while (pos <= all.size()) {
+      size_t bar = all.find('|', pos);
+      std::string one = all.substr(
+          pos, bar == std::string::npos ? std::string::npos : bar - pos);
+      ResVec v;
+      if (!ParseRes(s, one.c_str(), &v)) return -1;
+      bundles.push_back(std::move(v));
+      if (bar == std::string::npos) break;
+      pos = bar + 1;
+    }
+  }
+  if (bundles.empty()) return -1;
+
+  std::vector<int64_t> alive(s->order);
+  if (alive.empty()) return -1;
+  // Shadow availability for the dry run.
+  std::unordered_map<int64_t, ResVec> shadow;
+  for (int64_t id : alive) shadow[id] = s->nodes[id].avail;
+
+  std::vector<std::pair<int64_t, const ResVec*>> placed;
+  auto by_util = [&](std::vector<int64_t> ids) {
+    std::stable_sort(ids.begin(), ids.end(), [&](int64_t a, int64_t b) {
+      return Utilization(s, s->nodes[a]) < Utilization(s, s->nodes[b]);
+    });
+    return ids;
+  };
+
+  if (strategy == 2) {  // STRICT_PACK: all bundles on one node
+    bool done = false;
+    for (int64_t id : alive) {
+      ResVec rem = shadow[id];
+      bool ok = true;
+      for (const auto& b : bundles) {
+        if (!Fits(rem, b)) { ok = false; break; }
+        Sub(&rem, b);
+      }
+      if (ok) {
+        for (const auto& b : bundles) placed.emplace_back(id, &b);
+        done = true;
+        break;
+      }
+    }
+    if (!done) return -1;
+  } else if (strategy == 3) {  // STRICT_SPREAD: distinct node per bundle
+    if (bundles.size() > alive.size()) return -1;
+    std::vector<char> used(s->nodes.size(), 0);
+    for (const auto& b : bundles) {
+      int64_t chosen = -1;
+      for (int64_t id : by_util(alive)) {
+        if (used[id]) continue;
+        if (Fits(shadow[id], b)) { chosen = id; break; }
+      }
+      if (chosen < 0) return -1;
+      used[chosen] = 1;
+      Sub(&shadow[chosen], b);
+      placed.emplace_back(chosen, &b);
+    }
+  } else if (strategy == 1) {  // SPREAD: best-effort distinct, rotating
+    for (size_t i = 0; i < bundles.size(); ++i) {
+      std::vector<int64_t> ranked = by_util(alive);
+      size_t k = i % ranked.size();
+      std::rotate(ranked.begin(), ranked.begin() + k, ranked.end());
+      int64_t chosen = -1;
+      for (int64_t id : ranked)
+        if (Fits(shadow[id], bundles[i])) { chosen = id; break; }
+      if (chosen < 0) return -1;
+      Sub(&shadow[chosen], bundles[i]);
+      placed.emplace_back(chosen, &bundles[i]);
+    }
+  } else {  // PACK: first-fit in node order
+    for (const auto& b : bundles) {
+      int64_t chosen = -1;
+      for (int64_t id : alive)
+        if (Fits(shadow[id], b)) { chosen = id; break; }
+      if (chosen < 0) return -1;
+      Sub(&shadow[chosen], b);
+      placed.emplace_back(chosen, &b);
+    }
+  }
+
+  PlacementGroup pg;
+  pg.strategy = strategy;
+  for (auto& [node_id, bres] : placed) {
+    Sub(&s->nodes[node_id].avail, *bres);  // commit
+    Bundle b;
+    b.node = node_id;
+    b.reserved = *bres;
+    b.avail = *bres;
+    pg.bundles.push_back(std::move(b));
+  }
+  int64_t handle = static_cast<int64_t>(s->pgs.size());
+  s->pgs.push_back(std::move(pg));
+  return handle;
+}
+
+int rsched_pg_remove(void* h, int64_t pg) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (pg < 0 || pg >= static_cast<int64_t>(s->pgs.size()) ||
+      !s->pgs[pg].alive)
+    return -1;
+  PlacementGroup& p = s->pgs[pg];
+  p.alive = false;
+  for (const Bundle& b : p.bundles)
+    if (b.node >= 0 && s->nodes[b.node].alive)
+      Add(&s->nodes[b.node].avail, b.reserved);
+  return 0;
+}
+
+int rsched_pg_exists(void* h, int64_t pg) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return pg >= 0 && pg < static_cast<int64_t>(s->pgs.size()) &&
+         s->pgs[pg].alive;
+}
+
+int rsched_pg_num_bundles(void* h, int64_t pg) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (pg < 0 || pg >= static_cast<int64_t>(s->pgs.size())) return 0;
+  return static_cast<int>(s->pgs[pg].bundles.size());
+}
+
+int64_t rsched_pg_bundle_node(void* h, int64_t pg, int bundle) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (pg < 0 || pg >= static_cast<int64_t>(s->pgs.size())) return -1;
+  const PlacementGroup& p = s->pgs[pg];
+  if (bundle < 0 || bundle >= static_cast<int>(p.bundles.size())) return -1;
+  return p.bundles[bundle].node;
+}
+
+// which: 0 = reserved, 1 = available.
+int64_t rsched_pg_bundle_resources(void* h, int64_t pg, int bundle,
+                                   int which, char* buf, int64_t cap) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (pg < 0 || pg >= static_cast<int64_t>(s->pgs.size())) return -1;
+  const PlacementGroup& p = s->pgs[pg];
+  if (bundle < 0 || bundle >= static_cast<int>(p.bundles.size())) return -1;
+  const Bundle& b = p.bundles[bundle];
+  return FormatRes(s, which == 0 ? b.reserved : b.avail, buf, cap);
+}
+
+// Acquire inside a PG. bundle_index -1 = any bundle. Returns the bundle
+// index used, or -1.
+int rsched_pg_try_acquire(void* h, int64_t pg, int bundle_index,
+                          const char* res) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (pg < 0 || pg >= static_cast<int64_t>(s->pgs.size()) ||
+      !s->pgs[pg].alive)
+    return -1;
+  ResVec need;
+  if (!ParseRes(s, res, &need)) return -1;
+  PlacementGroup& p = s->pgs[pg];
+  int lo = bundle_index >= 0 ? bundle_index : 0;
+  int hi = bundle_index >= 0 ? bundle_index + 1
+                             : static_cast<int>(p.bundles.size());
+  for (int i = lo; i < hi && i < static_cast<int>(p.bundles.size()); ++i) {
+    Bundle& b = p.bundles[i];
+    if (b.node < 0 || !s->nodes[b.node].alive) continue;
+    if (Fits(b.avail, need)) {
+      Sub(&b.avail, need);
+      return i;
+    }
+  }
+  return -1;
+}
+
+void rsched_pg_release(void* h, int64_t pg, int bundle, const char* res) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (pg < 0 || pg >= static_cast<int64_t>(s->pgs.size())) return;
+  PlacementGroup& p = s->pgs[pg];
+  if (bundle < 0 || bundle >= static_cast<int>(p.bundles.size())) return;
+  Bundle& b = p.bundles[bundle];
+  if (b.node < 0 || !s->nodes[b.node].alive) return;
+  ResVec need;
+  if (!ParseRes(s, res, &need)) return;
+  Add(&b.avail, need);
+}
+
+void rsched_pg_force_acquire(void* h, int64_t pg, int bundle,
+                             const char* res) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (pg < 0 || pg >= static_cast<int64_t>(s->pgs.size())) return;
+  PlacementGroup& p = s->pgs[pg];
+  if (bundle < 0 || bundle >= static_cast<int>(p.bundles.size())) return;
+  ResVec need;
+  if (!ParseRes(s, res, &need)) return;
+  Sub(&p.bundles[bundle].avail, need);
+}
+
+// Re-place bundles whose node died onto alive nodes (in insertion order).
+// Writes touched pg handles into out (up to cap); returns the count.
+int64_t rsched_pg_reschedule_lost(void* h, int64_t* out, int64_t cap) {
+  Sched* s = static_cast<Sched*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  int64_t count = 0;
+  for (int64_t pg_id = 0; pg_id < static_cast<int64_t>(s->pgs.size());
+       ++pg_id) {
+    PlacementGroup& p = s->pgs[pg_id];
+    if (!p.alive) continue;
+    bool touched = false;
+    for (Bundle& b : p.bundles) {
+      if (b.node >= 0 && s->nodes[b.node].alive) continue;
+      touched = true;
+      b.node = -1;
+      for (int64_t id : s->order) {
+        Node& n = s->nodes[id];
+        if (Fits(n.avail, b.reserved)) {
+          Sub(&n.avail, b.reserved);
+          b.node = id;
+          b.avail = b.reserved;
+          break;
+        }
+      }
+    }
+    if (touched && count < cap) out[count] = pg_id;
+    if (touched) ++count;
+  }
+  return count;
+}
+
+}  // extern "C"

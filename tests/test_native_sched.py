@@ -1,0 +1,208 @@
+"""Native C++ cluster scheduler: build, semantics, and decision parity
+with the pure-Python engine (both must schedule identically)."""
+
+import pytest
+
+from ray_tpu._private.cluster_scheduler import ClusterResourceScheduler
+from ray_tpu._private.ids import PlacementGroupID
+from ray_tpu.exceptions import PlacementGroupError
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+native_sched = pytest.importorskip("ray_tpu._private.native_sched")
+
+if not native_sched.native_sched_available():
+    pytest.skip("native scheduler library unavailable",
+                allow_module_level=True)
+
+
+def make_engines():
+    return (native_sched.NativeClusterResourceScheduler(),
+            ClusterResourceScheduler())
+
+
+def test_add_node_and_aggregates():
+    nat = native_sched.NativeClusterResourceScheduler()
+    n1 = nat.add_node({"CPU": 4.0, "TPU": 8.0, "memory": 1e9})
+    n2 = nat.add_node({"CPU": 2.0})
+    total = nat.total
+    assert total["CPU"] == 6.0 and total["TPU"] == 8.0
+    assert nat.node(n1).alive and nat.node(n2).alive
+    assert nat.node(n1).local.available["TPU"] == 8.0
+
+
+def test_acquire_release_accounting():
+    nat = native_sched.NativeClusterResourceScheduler()
+    n1 = nat.add_node({"CPU": 4.0})
+    got = nat.try_acquire({"CPU": 3.0})
+    assert got is not None and got[0] == n1
+    assert nat.available["CPU"] == 1.0
+    assert nat.try_acquire({"CPU": 2.0}) is None
+    nat.release({"CPU": 3.0}, node_id=n1)
+    assert nat.available["CPU"] == 4.0
+
+
+def test_fractional_resources_fixed_point():
+    nat = native_sched.NativeClusterResourceScheduler()
+    n1 = nat.add_node({"CPU": 1.0})
+    # 10 x 0.1 must fit exactly (fixed-point, no float drift).
+    for _ in range(10):
+        assert nat.try_acquire({"CPU": 0.1}) is not None
+    assert nat.try_acquire({"CPU": 0.1}) is None
+    for _ in range(10):
+        nat.release({"CPU": 0.1}, node_id=n1)
+    assert nat.available["CPU"] == 1.0
+
+
+def test_hybrid_packs_first_node_under_threshold():
+    for engine in make_engines():
+        n1 = engine.add_node({"CPU": 10.0})
+        n2 = engine.add_node({"CPU": 10.0})
+        # Hybrid packs onto n1 until 50% utilization, then spills to n2.
+        homes = [engine.try_acquire({"CPU": 1.0})[0] for _ in range(10)]
+        assert homes[:5] == [n1] * 5, f"{type(engine).__name__}: {homes}"
+        assert n2 in homes[5:]
+
+
+def test_spread_round_robins():
+    for engine in make_engines():
+        n1 = engine.add_node({"CPU": 10.0})
+        n2 = engine.add_node({"CPU": 10.0})
+        homes = [engine.try_acquire({"CPU": 1.0}, strategy="SPREAD")[0]
+                 for _ in range(4)]
+        # Alternates between the two equally-utilized nodes.
+        assert {homes[0], homes[1]} == {n1, n2}
+        assert {homes[2], homes[3]} == {n1, n2}
+
+
+def test_node_affinity_hard_and_soft():
+    for engine in make_engines():
+        n1 = engine.add_node({"CPU": 2.0})
+        n2 = engine.add_node({"CPU": 2.0})
+        hard = NodeAffinitySchedulingStrategy(node_id=n2.hex(), soft=False)
+        got = engine.try_acquire({"CPU": 1.0}, strategy=hard)
+        assert got is not None and got[0] == n2
+        # Hard affinity to a full node fails even with capacity elsewhere.
+        assert engine.try_acquire({"CPU": 2.0}, strategy=hard) is None
+        soft = NodeAffinitySchedulingStrategy(node_id=n2.hex(), soft=True)
+        got = engine.try_acquire({"CPU": 2.0}, strategy=soft)
+        assert got is not None and got[0] == n1
+
+
+def test_node_death_releases_nothing():
+    for engine in make_engines():
+        n1 = engine.add_node({"CPU": 4.0})
+        n2 = engine.add_node({"CPU": 4.0})
+        engine.try_acquire({"CPU": 4.0})
+        state = engine.remove_node(n1)
+        assert state is not None
+        assert engine.total.get("CPU", 0.0) == 4.0
+        # Releasing onto the dead node is a no-op.
+        engine.release({"CPU": 4.0}, node_id=n1)
+        assert engine.available.get("CPU", 0.0) == 4.0
+        assert engine.remove_node(n1) is None  # double-remove
+
+
+def test_pg_pack_and_acquire():
+    for engine in make_engines():
+        n1 = engine.add_node({"CPU": 4.0})
+        engine.add_node({"CPU": 4.0})
+        pg = PlacementGroupID.from_random()
+        engine.create_placement_group(
+            pg, [{"CPU": 2.0}, {"CPU": 2.0}], "PACK")
+        assert engine.placement_group_exists(pg)
+        # PACK put both bundles on n1; its pool is exhausted.
+        assert engine.node(n1).local.available["CPU"] == 0.0
+        got = engine.try_acquire({"CPU": 2.0}, pg_id=pg, bundle_index=0)
+        assert got is not None and got[0] == n1 and got[1] == 0
+        assert engine.try_acquire({"CPU": 1.0}, pg_id=pg,
+                                  bundle_index=0) is None
+        engine.release({"CPU": 2.0}, pg_id=pg, bundle_index=0)
+        got = engine.try_acquire({"CPU": 2.0}, pg_id=pg, bundle_index=-1)
+        assert got is not None
+        engine.remove_placement_group(pg)
+        assert not engine.placement_group_exists(pg)
+        # PG removal returns ALL bundle reservations (in-bundle acquires
+        # borrowed from the bundle, not the global pool).
+        assert engine.available["CPU"] == 8.0
+
+
+def test_pg_strict_spread_needs_enough_nodes():
+    for engine in make_engines():
+        engine.add_node({"CPU": 4.0})
+        pg = PlacementGroupID.from_random()
+        with pytest.raises(PlacementGroupError):
+            engine.create_placement_group(
+                pg, [{"CPU": 1.0}, {"CPU": 1.0}], "STRICT_SPREAD")
+        engine.add_node({"CPU": 4.0})
+        engine.create_placement_group(
+            pg, [{"CPU": 1.0}, {"CPU": 1.0}], "STRICT_SPREAD")
+        table = engine.placement_group_table()
+        nodes = {b["node_id"] for row in table for b in row["bundles"]}
+        assert len(nodes) == 2
+
+
+def test_pg_strict_pack_one_node():
+    for engine in make_engines():
+        engine.add_node({"CPU": 2.0})
+        engine.add_node({"CPU": 4.0})
+        pg = PlacementGroupID.from_random()
+        engine.create_placement_group(
+            pg, [{"CPU": 2.0}, {"CPU": 2.0}], "STRICT_PACK")
+        table = engine.placement_group_table()
+        nodes = {b["node_id"] for row in table for b in row["bundles"]}
+        assert len(nodes) == 1
+
+
+def test_pg_infeasible_raises():
+    for engine in make_engines():
+        engine.add_node({"CPU": 2.0})
+        pg = PlacementGroupID.from_random()
+        with pytest.raises(PlacementGroupError):
+            engine.create_placement_group(pg, [{"CPU": 100.0}], "PACK")
+        assert not engine.placement_group_exists(pg)
+
+
+def test_pg_reschedule_lost_bundles():
+    for engine in make_engines():
+        n1 = engine.add_node({"CPU": 4.0})
+        n2 = engine.add_node({"CPU": 4.0})
+        pg = PlacementGroupID.from_random()
+        engine.create_placement_group(pg, [{"CPU": 2.0}], "PACK")
+        # Bundle lands on n1 (PACK, first-fit). Kill n1.
+        engine.remove_node(n1)
+        touched = engine.reschedule_lost_bundles()
+        assert touched == [pg]
+        table = engine.placement_group_table()
+        assert table[0]["bundles"][0]["node_id"] == n2.hex()
+        assert engine.node(n2).local.available["CPU"] == 2.0
+
+
+def test_utilization_and_views():
+    nat = native_sched.NativeClusterResourceScheduler()
+    n1 = nat.add_node({"CPU": 4.0, "TPU": 8.0})
+    view = nat.node(n1)
+    assert view.utilization() == 0.0
+    nat.try_acquire({"TPU": 8.0})
+    assert view.utilization() == 1.0  # critical resource = TPU
+    snap = nat.nodes_snapshot()
+    assert snap[0]["Alive"] and snap[0]["Available"]["TPU"] == 0.0
+
+
+def test_runtime_uses_native_scheduler():
+    """End-to-end: the runtime picks the native engine when available."""
+    import ray_tpu
+    from ray_tpu._private.native_sched import NativeClusterResourceScheduler
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0, _memory=1e9)
+    try:
+        runtime = ray_tpu._private.worker.global_worker.runtime
+        assert isinstance(runtime.scheduler, NativeClusterResourceScheduler)
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get([f.remote(i) for i in range(20)]) == \
+            list(range(1, 21))
+    finally:
+        ray_tpu.shutdown()
