@@ -46,10 +46,18 @@ def main():
     on_tpu = device.platform == "tpu"
     if on_tpu:
         preset, batch, seq, steps, warmup = "gpt-410m", 16, 1024, 10, 2
+        # The tuned single-chip recipe: Pallas flash attention (no S x S
+        # materialisation), selective rematerialisation (save rotary q/k/v +
+        # attention output + pre-GELU FFN; recompute only layernorms), and
+        # chunked cross-entropy (the [tokens, vocab] fp32 logits never exist
+        # whole). Measured on v5e: ~0.47 MFU vs 0.35 for full remat + dot.
+        overrides = dict(attn_impl="flash", remat_policy="selective",
+                         loss_chunk=2048)
     else:
         preset, batch, seq, steps, warmup = "gpt-tiny", 4, 128, 5, 1
+        overrides = {}
 
-    cfg = gpt.config(preset, max_seq_len=seq)
+    cfg = gpt.config(preset, max_seq_len=seq, **overrides)
     n_devices = 1
     mesh = build_mesh(
         MeshConfig(dp=1, fsdp=1, tp=1, sp=1, ep=1),

@@ -176,10 +176,14 @@ class ClusterResourceScheduler:
                     break
             if target is not None and target.alive:
                 if strategy.soft:
-                    return [target] + [s for s in ordered if s is not target], True
+                    rest = self._hybrid_order(
+                        [s for s in ordered if s is not target])
+                    return [target] + rest, True
                 return [target], False
             if strategy.soft:
-                return ordered, True
+                # Soft affinity falls back to the DEFAULT/hybrid policy —
+                # same ordering the native engine's pick_and_acquire uses.
+                return self._hybrid_order(ordered), True
             return [], False
         if strategy == "SPREAD":
             with self._lock:
@@ -198,10 +202,16 @@ class ClusterResourceScheduler:
             return ranked, False
         # DEFAULT / hybrid: pack onto nodes (in id order) under the spread
         # threshold, else least-utilized first.
+        return self._hybrid_order(ordered), False
+
+    @staticmethod
+    def _hybrid_order(ordered):
+        """Hybrid-policy candidate order (pack under the spread threshold in
+        insertion order, then least-utilized first)."""
         under = [s for s in ordered if s.utilization() < SPREAD_THRESHOLD]
         over = sorted((s for s in ordered if s not in under),
                       key=lambda s: s.utilization())
-        return under + over, False
+        return under + over
 
     def is_feasible(self, resources: Dict[str, float],
                     pg_id: Optional[PlacementGroupID] = None,

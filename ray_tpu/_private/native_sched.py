@@ -17,7 +17,9 @@ bundle ledger — happens in C++.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
+import platform
 import subprocess
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -41,16 +43,43 @@ def _build_library() -> Optional[str]:
     if not os.path.exists(src):
         return None
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    out = os.path.join(_BUILD_DIR, "libsched.so")
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+    # Key the artifact on source hash + machine (not mtime): checkouts
+    # reset mtimes, and a stale or cross-platform binary (shared build/ on
+    # NFS or a copied checkout) must never be preferred over a rebuild.
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:12]
+    stem = f"libsched-{digest}-{platform.machine()}"
+    out = os.path.join(_BUILD_DIR, f"{stem}.so")
+    if os.path.exists(out):
         return out
+    tmp = f"{out}.tmp{os.getpid()}"
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", out, src],
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, src],
             check=True, capture_output=True, timeout=120)
-    except (subprocess.SubprocessError, FileNotFoundError):
+        os.replace(tmp, out)
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        _cleanup_artifacts(_BUILD_DIR, "libsched-", keep=None, tmp=tmp)
         return None
+    _cleanup_artifacts(_BUILD_DIR, "libsched-", keep=os.path.basename(out),
+                       tmp=None)
     return out
+
+
+def _cleanup_artifacts(build_dir: str, prefix: str, keep: Optional[str],
+                       tmp: Optional[str]) -> None:
+    """Remove a failed compile's temp file and superseded hash-named .so
+    files so build/ doesn't grow without bound across source edits."""
+    try:
+        if tmp and os.path.exists(tmp):
+            os.unlink(tmp)
+        if keep is not None:
+            for name in os.listdir(build_dir):
+                if (name.startswith(prefix) and name.endswith(".so")
+                        and name != keep):
+                    os.unlink(os.path.join(build_dir, name))
+    except OSError:
+        pass
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -120,6 +149,21 @@ def _encode(resources: Dict[str, float]) -> bytes:
                     for k, v in resources.items()).encode()
 
 
+def _read_encoded(fn, *args) -> Dict[str, float]:
+    """Call a native getter that writes an encoded resource map into a
+    caller-provided buffer (returning the needed length), growing the buffer
+    until it fits. Returns {} on a negative (error) length."""
+    cap = 4096
+    while True:
+        buf = ctypes.create_string_buffer(cap)
+        n = fn(*args, buf, cap)
+        if n < 0:
+            return {}
+        if n < cap:
+            return _decode(buf.value)
+        cap = n + 1
+
+
 def _decode(raw: bytes) -> Dict[str, float]:
     out: Dict[str, float] = {}
     if not raw:
@@ -142,17 +186,8 @@ class _LocalView:
         self._handle = handle
 
     def _read(self, which: int) -> Dict[str, float]:
-        lib = self._sched._lib
-        cap = 4096
-        while True:
-            buf = ctypes.create_string_buffer(cap)
-            n = lib.rsched_node_resources(self._sched._h, self._handle,
-                                          which, buf, cap)
-            if n < 0:
-                return {}
-            if n < cap:
-                return _decode(buf.value)
-            cap = n + 1
+        return _read_encoded(self._sched._lib.rsched_node_resources,
+                             self._sched._h, self._handle, which)
 
     @property
     def total(self) -> Dict[str, float]:
@@ -441,16 +476,8 @@ class NativeClusterResourceScheduler:
 
     def _pg_bundle_resources(self, handle: int, bundle: int,
                              which: int) -> Dict[str, float]:
-        cap = 4096
-        while True:
-            buf = ctypes.create_string_buffer(cap)
-            n = self._lib.rsched_pg_bundle_resources(self._h, handle, bundle,
-                                                     which, buf, cap)
-            if n < 0:
-                return {}
-            if n < cap:
-                return _decode(buf.value)
-            cap = n + 1
+        return _read_encoded(self._lib.rsched_pg_bundle_resources,
+                             self._h, handle, bundle, which)
 
     def placement_groups(self):
         out = {}

@@ -80,6 +80,9 @@ def detect_node_resources(
             memory = float(page * phys) * 0.7
         except (ValueError, OSError):
             memory = 8e9
+    from ray_tpu._private.task_spec import validate_resource_name
+    for name in (resources or {}):
+        validate_resource_name(name)
     return NodeResources(
         num_cpus=float(num_cpus),
         num_tpus=float(num_tpus),

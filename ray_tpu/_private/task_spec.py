@@ -36,6 +36,20 @@ _COMMON_OPTIONS = {
 }
 
 
+def validate_resource_name(name: Any) -> None:
+    """Reject names the schedulers cannot represent. The native engine's
+    C ABI encodes resource maps as ``name=value;...`` (and PG bundles with
+    ``|``), so separator/control characters in a name would silently corrupt
+    its parse; both engines enforce the same rule for decision parity."""
+    if not isinstance(name, str) or not name:
+        raise ValueError(
+            f"Resource name must be a non-empty string, got {name!r}")
+    if any(c in "=;|" or ord(c) < 32 for c in name):
+        raise ValueError(
+            f"Invalid resource name {name!r}: must not contain '=', ';', "
+            "'|' or control characters")
+
+
 def validate_options(options: Dict[str, Any], for_actor: bool) -> Dict[str, Any]:
     for key in options:
         if key not in _COMMON_OPTIONS:
@@ -51,6 +65,7 @@ def validate_options(options: Dict[str, Any], for_actor: bool) -> Dict[str, Any]
         if not isinstance(resources, dict):
             raise ValueError("resources must be a dict of name -> quantity")
         for k, v in resources.items():
+            validate_resource_name(k)
             if k in (CPU_RESOURCE, TPU_RESOURCE, "GPU"):
                 raise ValueError(
                     f"Use num_cpus/num_tpus/num_gpus instead of resources[{k!r}]")

@@ -33,6 +33,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec
 
 from ray_tpu.parallel.sharding import ShardingRules
@@ -53,6 +54,19 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16  # activation/compute dtype
     param_dtype: Any = jnp.float32
     remat: bool = True  # checkpoint each block (HBM ⇄ FLOPs trade)
+    # "full": save only block boundaries, recompute everything in backward
+    # (lowest memory). "selective": additionally save the named tensors
+    # tagged in _block (rotary q/k/v, attention output, pre-activation FFN)
+    # — the expensive-to-recompute matmul outputs — cutting backward
+    # recompute to layernorms + the attention quadratic term for ~2.5x less
+    # activation memory than no remat at all.
+    remat_policy: str = "full"  # "full" | "selective"
+    # Tokens per cross-entropy chunk (0 = unchunked). The [tokens, vocab]
+    # fp32 logits and their cotangent are the single largest activation in
+    # training; chunking streams them through a lax.scan so peak HBM holds
+    # one chunk instead of the full batch (each chunk's logits matmul is
+    # recomputed in backward — ~2*d*vocab extra FLOPs/token, a few percent).
+    loss_chunk: int = 0
     attn_impl: str = "dot"  # "dot" | "flash" | "ring" | "ulysses"
     layernorm_eps: float = 1e-5
     # Mixture-of-experts: n_experts > 0 replaces every block's dense FFN
@@ -366,9 +380,10 @@ def _block(cfg: GPTConfig, x, layer, positions):
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
     k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(dt))
     v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(dt))
-    q = _rotary(q, positions, cfg.rotary_dim)
-    k = _rotary(k, positions, cfg.rotary_dim)
-    attn = _attention(q, k, v, cfg)
+    q = checkpoint_name(_rotary(q, positions, cfg.rotary_dim), "attn_q")
+    k = checkpoint_name(_rotary(k, positions, cfg.rotary_dim), "attn_k")
+    v = checkpoint_name(v, "attn_v")
+    attn = checkpoint_name(_attention(q, k, v, cfg), "attn_raw")
     attn_out = jnp.einsum("bshk,hkd->bsd", attn, layer["wo"].astype(dt))
 
     if cfg.parallel_block:
@@ -381,7 +396,9 @@ def _block(cfg: GPTConfig, x, layer, positions):
     if cfg.is_moe:
         mlp_out, aux = _moe_ffn(cfg, mlp_in, layer)
     else:
-        ff = jnp.einsum("bsd,df->bsf", mlp_in, layer["w_in"].astype(dt))
+        ff = checkpoint_name(
+            jnp.einsum("bsd,df->bsf", mlp_in, layer["w_in"].astype(dt)),
+            "ffn_in")
         ff = jax.nn.gelu(ff + layer["b_in"].astype(dt))
         mlp_out = jnp.einsum("bsf,fd->bsd", ff, layer["w_out"].astype(dt))
     mlp_out = mlp_out + layer["b_out"].astype(dt)
@@ -391,11 +408,10 @@ def _block(cfg: GPTConfig, x, layer, positions):
     return x + mlp_out, aux
 
 
-def forward_with_aux(params: Dict[str, Any], cfg: GPTConfig,
-                     tokens: jax.Array,
-                     positions: Optional[jax.Array] = None):
-    """tokens [B, S] int32 → (logits [B, S, vocab], aux_loss scalar).
-    aux_loss is the summed MoE load-balancing term (0 for dense models)."""
+def hidden_states(params: Dict[str, Any], cfg: GPTConfig,
+                  tokens: jax.Array,
+                  positions: Optional[jax.Array] = None):
+    """tokens [B, S] int32 → (final-layernormed hidden [B, S, d], aux)."""
     B, S = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -403,8 +419,16 @@ def forward_with_aux(params: Dict[str, Any], cfg: GPTConfig,
 
     block = partial(_block, cfg)
     if cfg.remat:
-        block = jax.checkpoint(
-            block, policy=jax.checkpoint_policies.nothing_saveable)
+        if cfg.remat_policy == "selective":
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "attn_q", "attn_k", "attn_v", "attn_raw", "ffn_in")
+        elif cfg.remat_policy == "full":
+            policy = jax.checkpoint_policies.nothing_saveable
+        else:
+            raise ValueError(
+                f"Unknown remat_policy {cfg.remat_policy!r}; "
+                "expected 'full' or 'selective'")
+        block = jax.checkpoint(block, policy=policy)
 
     def scan_body(carry, layer):
         x, aux = carry
@@ -415,13 +439,24 @@ def forward_with_aux(params: Dict[str, Any], cfg: GPTConfig,
         scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"])
     x = _layernorm(x, params["lnf_scale"], params["lnf_bias"],
                    cfg.layernorm_eps)
+    return x, aux
+
+
+def _head(params: Dict[str, Any], cfg: GPTConfig, x: jax.Array) -> jax.Array:
+    """Hidden [..., d] → logits [..., vocab] (compute dtype)."""
     if cfg.tie_embeddings:
-        logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(cfg.dtype))
-    else:
-        logits = jnp.einsum("bsd,dv->bsv", x,
-                            params["lm_head"].astype(cfg.dtype))
-        logits = logits + params["lm_head_bias"].astype(cfg.dtype)
-    return logits, aux
+        return jnp.einsum("...d,vd->...v", x, params["wte"].astype(cfg.dtype))
+    logits = jnp.einsum("...d,dv->...v", x, params["lm_head"].astype(cfg.dtype))
+    return logits + params["lm_head_bias"].astype(cfg.dtype)
+
+
+def forward_with_aux(params: Dict[str, Any], cfg: GPTConfig,
+                     tokens: jax.Array,
+                     positions: Optional[jax.Array] = None):
+    """tokens [B, S] int32 → (logits [B, S, vocab], aux_loss scalar).
+    aux_loss is the summed MoE load-balancing term (0 for dense models)."""
+    x, aux = hidden_states(params, cfg, tokens, positions)
+    return _head(params, cfg, x), aux
 
 
 def forward(params: Dict[str, Any], cfg: GPTConfig, tokens: jax.Array,
@@ -430,12 +465,9 @@ def forward(params: Dict[str, Any], cfg: GPTConfig, tokens: jax.Array,
     return forward_with_aux(params, cfg, tokens, positions)[0]
 
 
-def loss_fn(params: Dict[str, Any], cfg: GPTConfig, tokens: jax.Array,
-            targets: jax.Array, mask: Optional[jax.Array] = None,
-            z_loss: float = 0.0) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Next-token cross-entropy in fp32 (+ optional z-loss regularizer and,
-    for MoE configs, the router load-balancing aux term)."""
-    logits, aux = forward_with_aux(params, cfg, tokens)
+def _ce_stats(logits: jax.Array, targets: jax.Array, mask: jax.Array,
+              z_loss: float) -> Tuple[jax.Array, jax.Array]:
+    """fp32 CE pieces for one [..., vocab] logits slab → (Σ nll·m, Σ hit·m)."""
     logits = logits.astype(jnp.float32)
     logz = jax.scipy.special.logsumexp(logits, axis=-1)
     tgt_logit = jnp.take_along_axis(
@@ -443,15 +475,58 @@ def loss_fn(params: Dict[str, Any], cfg: GPTConfig, tokens: jax.Array,
     nll = logz - tgt_logit
     if z_loss:
         nll = nll + z_loss * logz ** 2
+    hits = (logits.argmax(-1) == targets).astype(jnp.float32)
+    return (nll * mask).sum(), (hits * mask).sum()
+
+
+def loss_fn(params: Dict[str, Any], cfg: GPTConfig, tokens: jax.Array,
+            targets: jax.Array, mask: Optional[jax.Array] = None,
+            z_loss: float = 0.0) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Next-token cross-entropy in fp32 (+ optional z-loss regularizer and,
+    for MoE configs, the router load-balancing aux term).
+
+    With ``cfg.loss_chunk > 0`` the head matmul + fp32 softmax run chunked
+    under a rematerialized lax.scan, so the [tokens, vocab] fp32 logits
+    never exist whole (see GPTConfig.loss_chunk)."""
+    x, aux = hidden_states(params, cfg, tokens)
+    B, S = tokens.shape
     if mask is None:
-        mask = jnp.ones_like(nll)
-    mask = mask.astype(jnp.float32)
-    denom = jnp.maximum(mask.sum(), 1.0)
-    ce = (nll * mask).sum() / denom
+        mask32 = jnp.ones((B, S), jnp.float32)
+    else:
+        mask32 = mask.astype(jnp.float32)
+    denom = jnp.maximum(mask32.sum(), 1.0)
+
+    T = B * S
+    chunk = cfg.loss_chunk
+    if chunk and T % chunk and T > chunk:
+        # Requested chunk doesn't divide the token count: use the largest
+        # divisor <= chunk rather than silently materializing full logits
+        # (defeating the feature's memory bound).
+        chunk = max(c for c in range(1, chunk + 1) if T % c == 0)
+    if chunk and T > chunk:
+        d = x.shape[-1]
+        xf = x.reshape(T // chunk, chunk, d)
+        tf = targets.reshape(T // chunk, chunk)
+        mf = mask32.reshape(T // chunk, chunk)
+
+        @jax.checkpoint
+        def chunk_stats(carry, xtm):
+            x_c, t_c, m_c = xtm
+            nll_sum, hit_sum = _ce_stats(
+                _head(params, cfg, x_c), t_c, m_c, z_loss)
+            return (carry[0] + nll_sum, carry[1] + hit_sum), None
+
+        (nll_sum, hit_sum), _ = jax.lax.scan(
+            chunk_stats, (jnp.zeros((), jnp.float32),) * 2, (xf, tf, mf))
+    else:
+        nll_sum, hit_sum = _ce_stats(
+            _head(params, cfg, x), targets, mask32, z_loss)
+
+    ce = nll_sum / denom
     loss = ce
     if cfg.is_moe:
         loss = ce + cfg.router_aux_weight * aux
-    acc = ((logits.argmax(-1) == targets) * mask).sum() / denom
+    acc = hit_sum / denom
     # Perplexity from the cross-entropy alone (not the aux-regularized
     # loss), so MoE and dense perplexities are comparable.
     return loss, {"loss": loss, "accuracy": acc,

@@ -59,9 +59,12 @@ def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
             f"{VALID_STRATEGIES}")
     if not bundles:
         raise ValueError("placement_group requires at least one bundle")
+    from ray_tpu._private.task_spec import validate_resource_name
     for b in bundles:
         if not isinstance(b, dict) or not b:
             raise ValueError(f"Invalid bundle {b!r}: must be a non-empty dict")
+        for res_name in b:
+            validate_resource_name(res_name)
         if any(v < 0 for v in b.values()):
             raise ValueError(f"Invalid bundle {b!r}: negative resources")
     runtime = global_worker.runtime

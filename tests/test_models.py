@@ -208,3 +208,59 @@ def test_ddim_sample_shapes_and_finite():
                                 batch=2, n_steps=4)
     assert out.shape == (2, 16, 16, 3)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_gpt_loss_chunk_matches_unchunked():
+    """Chunked CE (incl. non-divisor chunk sizes) must match the unchunked
+    path in loss, metrics, and gradients (models/gpt.py loss_chunk)."""
+    from ray_tpu.models import gpt
+
+    cfg = gpt.config("gpt-tiny")
+    params = gpt.init(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 128)), jnp.int32)
+    tgts = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 128)), jnp.int32)
+    mask = jnp.asarray(rng.integers(0, 2, (2, 128)), jnp.float32)
+
+    base_loss, base_m = gpt.loss_fn(params, cfg, toks, tgts, mask,
+                                    z_loss=1e-4)
+    base_g = jax.grad(
+        lambda p: gpt.loss_fn(p, cfg, toks, tgts, mask, z_loss=1e-4)[0]
+    )(params)
+    for chunk in (64, 100):  # 100 does not divide 256 → divisor fallback
+        ccfg = gpt.config("gpt-tiny", loss_chunk=chunk)
+        loss, m = gpt.loss_fn(params, ccfg, toks, tgts, mask, z_loss=1e-4)
+        np.testing.assert_allclose(float(loss), float(base_loss), rtol=1e-6)
+        np.testing.assert_allclose(float(m["accuracy"]),
+                                   float(base_m["accuracy"]), rtol=1e-6)
+        g = jax.grad(
+            lambda p: gpt.loss_fn(p, ccfg, toks, tgts, mask, z_loss=1e-4)[0]
+        )(params)
+        err = jax.tree.reduce(max, jax.tree.map(
+            lambda a, b: float(jnp.abs(a - b).max()), g, base_g))
+        assert err < 1e-6, f"chunk={chunk} grad err {err}"
+
+
+def test_gpt_selective_remat_matches_full():
+    """remat_policy='selective' must be a pure memory/compute trade: same
+    loss and gradients as 'full' (models/gpt.py remat_policy)."""
+    from ray_tpu.models import gpt
+
+    rng = np.random.default_rng(1)
+    toks = jnp.asarray(rng.integers(0, 256, (2, 128)), jnp.int32)
+    tgts = jnp.asarray(rng.integers(0, 256, (2, 128)), jnp.int32)
+    cfg_full = gpt.config("gpt-tiny", remat=True, remat_policy="full")
+    cfg_sel = gpt.config("gpt-tiny", remat=True, remat_policy="selective")
+    params = gpt.init(cfg_full, jax.random.PRNGKey(0))
+    l_full = gpt.loss_fn(params, cfg_full, toks, tgts)[0]
+    l_sel = gpt.loss_fn(params, cfg_sel, toks, tgts)[0]
+    np.testing.assert_allclose(float(l_sel), float(l_full), rtol=1e-6)
+    g_full = jax.grad(lambda p: gpt.loss_fn(p, cfg_full, toks, tgts)[0])(params)
+    g_sel = jax.grad(lambda p: gpt.loss_fn(p, cfg_sel, toks, tgts)[0])(params)
+    err = jax.tree.reduce(max, jax.tree.map(
+        lambda a, b: float(jnp.abs(a - b).max()), g_full, g_sel))
+    assert err < 1e-5, f"selective remat grad err {err}"
+    with pytest.raises(ValueError):
+        gpt.loss_fn(params, gpt.config("gpt-tiny", remat=True,
+                                       remat_policy="Selective"),
+                    toks, tgts)

@@ -111,6 +111,75 @@ def test_flash_gqa():
                                atol=1e-5, rtol=1e-5)
 
 
+def test_flash_kernel_path_fwd_and_lse():
+    """S=256 with 128-lane blocks runs the real Pallas kernels (not the
+    blockwise fallback); interpret mode emulates TPU bf16 matmuls, so the
+    reference must be compared under 'highest' matmul precision."""
+    from ray_tpu.ops.flash_attention import _flash_forward, _pick_block
+
+    assert _pick_block(256, 1024) == 256
+    assert _pick_block(1536, 1024) == 768  # multiple of 128, not of 1024
+    assert _pick_block(100, 1024) == 0  # ragged → fallback
+    with jax.default_matmul_precision("highest"):
+        q, k, v = _qkv(S=256)
+        out, lse = _flash_forward(q, k, v, True, 128, 128)
+        assert lse is not None, "kernel path not taken"
+        ref = _dot_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4, rtol=1e-4)
+        # lse matches direct logsumexp of the masked logits
+        B, S, H, D = q.shape
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(D)
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (S, S), 1)
+        logits = jnp.where((qpos >= kpos)[None, None], logits, -1e30)
+        lse_ref = jax.scipy.special.logsumexp(logits, -1)
+        np.testing.assert_allclose(
+            np.asarray(lse.reshape(B, H, S)), np.asarray(lse_ref),
+            atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_kernel_backward(causal):
+    """Pallas dq/dk/dv kernels (blk >= 128) against the dot reference."""
+    with jax.default_matmul_precision("highest"):
+        q, k, v = _qkv(S=256)
+
+        def loss_ref(q, k, v):
+            return (_dot_reference(q, k, v, causal) ** 2).sum()
+
+        def loss_fl(q, k, v):
+            return (flash_attention(q, k, v, causal, 128, 256) ** 2).sum()
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_fl = jax.grad(loss_fl, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_fl):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=2e-3, rtol=1e-3)
+
+
+def test_flash_kernel_backward_gqa():
+    with jax.default_matmul_precision("highest"):
+        B, S, H, D = 2, 256, 8, 32
+        ks = jax.random.split(jax.random.PRNGKey(5), 3)
+        q = jax.random.normal(ks[0], (B, S, H, D))
+        k = jax.random.normal(ks[1], (B, S, 2, D))
+        v = jax.random.normal(ks[2], (B, S, 2, D))
+
+        def loss_ref(q, k, v):
+            ref = _dot_reference(q, jnp.repeat(k, 4, 2), jnp.repeat(v, 4, 2))
+            return (ref ** 2).sum()
+
+        def loss_fl(q, k, v):
+            return (flash_attention(q, k, v, True, 128, 128) ** 2).sum()
+
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        g_fl = jax.grad(loss_fl, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_fl):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=2e-3, rtol=1e-3)
+
+
 def _sp_mesh(n=4):
     devices = np.array(jax.devices("cpu")[:n])
     return jax.sharding.Mesh(devices, ("sp",))
