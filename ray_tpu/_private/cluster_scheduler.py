@@ -478,14 +478,16 @@ class ClusterResourceScheduler:
             }
 
 
-def make_cluster_scheduler():
+def make_cluster_scheduler(use_native: bool = True):
     """Native C++ engine (src/ray_tpu_native/sched.cc) when it builds;
     this pure-Python implementation otherwise. Both expose identical
-    semantics (tests/test_native_sched.py asserts decision parity)."""
+    semantics (tests/test_native_sched.py asserts decision parity).
+    ``use_native=False`` (the use_native_scheduler config flag) forces the
+    Python engine; the RAY_TPU_NATIVE_SCHED=0 env var also disables."""
     try:
         from ray_tpu._private.native_sched import (
             NativeClusterResourceScheduler, native_sched_available)
-        if native_sched_available():
+        if use_native and native_sched_available():
             return NativeClusterResourceScheduler()
     except Exception:  # noqa: BLE001 - any native failure → Python engine
         pass

@@ -1,0 +1,90 @@
+"""Shared build/load machinery for the native (C++) runtime components.
+
+Each component lives in src/ray_tpu_native/<name>.cc and is compiled on
+demand into build/lib<name>-<srchash>-<machine>.so. Artifacts are keyed by
+source hash + machine so a stale or cross-platform binary is never preferred
+over a rebuild (checkout mtimes are meaningless), mirroring how the
+reference pins its bazel outputs to the source tree state.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import platform
+import subprocess
+import threading
+from typing import Dict, List, Optional
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "src", "ray_tpu_native")
+# <repo>/build — NOT <repo>/src/build (dirname(_SRC) is <repo>/src).
+_BUILD_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(_SRC), os.pardir, "build"))
+
+_locks: Dict[str, threading.Lock] = {}
+_locks_guard = threading.Lock()
+
+
+def _lock_for(name: str) -> threading.Lock:
+    with _locks_guard:
+        return _locks.setdefault(name, threading.Lock())
+
+
+def cleanup_artifacts(build_dir: str, prefix: str, keep: Optional[str],
+                      tmp: Optional[str]) -> None:
+    """Remove a failed compile's temp file and superseded hash-named .so
+    files so build/ doesn't grow without bound across source edits."""
+    try:
+        if tmp and os.path.exists(tmp):
+            os.unlink(tmp)
+        if keep is not None:
+            for fname in os.listdir(build_dir):
+                if (fname.startswith(prefix) and fname.endswith(".so")
+                        and fname != keep):
+                    os.unlink(os.path.join(build_dir, fname))
+    except OSError:
+        pass
+
+
+def build_library(name: str, extra_flags: Optional[List[str]] = None
+                  ) -> Optional[str]:
+    """Compile src/ray_tpu_native/<name>.cc into a shared library and return
+    its path (cached by source hash + machine). None if unbuildable."""
+    src = os.path.join(_SRC, f"{name}.cc")
+    if not os.path.exists(src):
+        return None
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:12]
+    prefix = f"lib{name}-"
+    out = os.path.join(
+        _BUILD_DIR, f"{prefix}{digest}-{platform.machine()}.so")
+    with _lock_for(name):
+        if os.path.exists(out):
+            return out
+        tmp = f"{out}.tmp{os.getpid()}"
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp,
+                 src] + (extra_flags or []),
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, out)
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            cleanup_artifacts(_BUILD_DIR, prefix, keep=None, tmp=tmp)
+            return None
+        cleanup_artifacts(_BUILD_DIR, prefix, keep=os.path.basename(out),
+                          tmp=None)
+    return out
+
+
+def load_library(name: str, extra_flags: Optional[List[str]] = None
+                 ) -> Optional[ctypes.CDLL]:
+    path = build_library(name, extra_flags)
+    if path is None:
+        return None
+    try:
+        return ctypes.CDLL(path)
+    except OSError:
+        return None

@@ -17,20 +17,13 @@ bundle ledger — happens in C++.
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import os
-import platform
-import subprocess
 import threading
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._private.ids import NodeID, PlacementGroupID
 from ray_tpu.exceptions import PlacementGroupError
 
-_SRC = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "src", "ray_tpu_native")
-_BUILD_DIR = os.path.abspath(os.path.join(os.path.dirname(_SRC), "..",
-                                          "build"))
 _lib = None
 _lib_lock = threading.Lock()
 
@@ -39,47 +32,8 @@ _PG_STRATEGIES = {"PACK": 0, "SPREAD": 1, "STRICT_PACK": 2,
 
 
 def _build_library() -> Optional[str]:
-    src = os.path.join(_SRC, "sched.cc")
-    if not os.path.exists(src):
-        return None
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    # Key the artifact on source hash + machine (not mtime): checkouts
-    # reset mtimes, and a stale or cross-platform binary (shared build/ on
-    # NFS or a copied checkout) must never be preferred over a rebuild.
-    with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:12]
-    stem = f"libsched-{digest}-{platform.machine()}"
-    out = os.path.join(_BUILD_DIR, f"{stem}.so")
-    if os.path.exists(out):
-        return out
-    tmp = f"{out}.tmp{os.getpid()}"
-    try:
-        subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, src],
-            check=True, capture_output=True, timeout=120)
-        os.replace(tmp, out)
-    except (subprocess.SubprocessError, FileNotFoundError, OSError):
-        _cleanup_artifacts(_BUILD_DIR, "libsched-", keep=None, tmp=tmp)
-        return None
-    _cleanup_artifacts(_BUILD_DIR, "libsched-", keep=os.path.basename(out),
-                       tmp=None)
-    return out
-
-
-def _cleanup_artifacts(build_dir: str, prefix: str, keep: Optional[str],
-                       tmp: Optional[str]) -> None:
-    """Remove a failed compile's temp file and superseded hash-named .so
-    files so build/ doesn't grow without bound across source edits."""
-    try:
-        if tmp and os.path.exists(tmp):
-            os.unlink(tmp)
-        if keep is not None:
-            for name in os.listdir(build_dir):
-                if (name.startswith(prefix) and name.endswith(".so")
-                        and name != keep):
-                    os.unlink(os.path.join(build_dir, name))
-    except OSError:
-        pass
+    from ray_tpu._private.native_build import build_library
+    return build_library("sched")
 
 
 def _load() -> Optional[ctypes.CDLL]:

@@ -13,53 +13,21 @@ available the caller falls back to the pure-Python store.
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import mmap
 import os
-import platform
-import subprocess
 import threading
 import uuid
 from typing import Optional, Tuple
 
 import numpy as np
 
-_SRC = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__)))), "src", "ray_tpu_native")
-_BUILD_DIR = os.path.join(os.path.dirname(_SRC), "..", "build")
 _lib = None
 _lib_lock = threading.Lock()
 
 
 def _build_library() -> Optional[str]:
-    src = os.path.join(_SRC, "shm_store.cc")
-    if not os.path.exists(src):
-        return None
-    build_dir = os.path.abspath(_BUILD_DIR)
-    os.makedirs(build_dir, exist_ok=True)
-    # Hash+machine-keyed artifact: never trust a binary whose source has
-    # changed (checkout mtimes are meaningless) or one built for another
-    # platform (shared build/ dirs).
-    from ray_tpu._private.native_sched import _cleanup_artifacts
-    with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:12]
-    out = os.path.join(
-        build_dir, f"libshm_store-{digest}-{platform.machine()}.so")
-    if os.path.exists(out):
-        return out
-    tmp = f"{out}.tmp{os.getpid()}"
-    try:
-        subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, src,
-             "-lrt"],
-            check=True, capture_output=True, timeout=120)
-        os.replace(tmp, out)
-    except (subprocess.SubprocessError, FileNotFoundError, OSError):
-        _cleanup_artifacts(build_dir, "libshm_store-", keep=None, tmp=tmp)
-        return None
-    _cleanup_artifacts(build_dir, "libshm_store-",
-                       keep=os.path.basename(out), tmp=None)
-    return out
+    from ray_tpu._private.native_build import build_library
+    return build_library("shm_store", extra_flags=["-lrt"])
 
 
 def _load() -> Optional[ctypes.CDLL]:

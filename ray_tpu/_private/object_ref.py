@@ -14,11 +14,34 @@ from ray_tpu._private.ids import ObjectID, TaskID
 
 
 class ObjectRef:
-    __slots__ = ("_id", "_owner_hint", "__weakref__")
+    __slots__ = ("_id", "_owner_hint", "_registered", "__weakref__")
 
     def __init__(self, object_id: ObjectID, owner_hint: Optional[str] = None):
         self._id = object_id
         self._owner_hint = owner_hint
+        # Ownership bookkeeping (reference: reference_count.h local refs):
+        # every live handle holds one local reference; the owner frees the
+        # value when the count hits zero.
+        self._registered = False
+        try:
+            from ray_tpu._private.worker import global_worker
+            runtime = getattr(global_worker, "_runtime", None)
+            if runtime is not None:
+                runtime.refs.add_local(object_id)
+                self._registered = True
+        except Exception:  # noqa: BLE001 - never fail handle creation
+            pass
+
+    def __del__(self):
+        if not getattr(self, "_registered", False):
+            return
+        try:
+            from ray_tpu._private.worker import global_worker
+            runtime = getattr(global_worker, "_runtime", None)
+            if runtime is not None:
+                runtime.on_ref_deleted(self._id)
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
 
     # -- identity ---------------------------------------------------------
 

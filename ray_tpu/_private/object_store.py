@@ -50,13 +50,13 @@ class ObjectStore:
     NATIVE_THRESHOLD = 1 << 20
 
     def __init__(self, deserializer: Optional[Callable[[bytes], Any]] = None,
-                 native_capacity: int = 0):
+                 native_capacity: int = 0, use_native: bool = True):
         self._entries: Dict[ObjectID, _Entry] = {}
         self._lock = threading.Lock()
         self._deserializer = deserializer
         self._total_bytes = 0
         self._native = None
-        if native_capacity > 0 and os.environ.get(
+        if use_native and native_capacity > 0 and os.environ.get(
                 "RAY_TPU_NATIVE_STORE", "1") != "0":
             try:
                 from ray_tpu._private.native_store import NativeObjectStore
@@ -183,7 +183,14 @@ class ObjectStore:
             entry.value = value
             entry.deserialized = True
         if entry.is_exception:
-            raise entry.value
+            # Raise a shallow copy: `raise` attaches the caller's traceback
+            # to the exception object, and the traceback's frames hold the
+            # very ObjectRef being fetched — raising the stored instance
+            # would make the object pin itself (a refcount leak cycle).
+            import copy
+            exc = copy.copy(entry.value)
+            exc.__traceback__ = None
+            raise exc
         return entry.value
 
     def get_if_exception(self, object_id: ObjectID) -> Optional[BaseException]:
@@ -202,6 +209,8 @@ class ObjectStore:
             for oid in object_ids:
                 entry = self._entries.get(oid)
                 if entry is not None:
+                    if entry.freed:
+                        continue  # idempotent: never double-settle accounting
                     entry.freed = True
                     if entry.in_native and self._native is not None:
                         if entry.value is not None:

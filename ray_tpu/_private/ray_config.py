@@ -1,0 +1,239 @@
+"""RayConfig: the typed runtime flag table.
+
+Python face of the native flag system (src/ray_tpu_native/config.cc — the
+analog of the reference's RAY_CONFIG macro table,
+src/ray/common/ray_config_def.h). Flags carry typed defaults compiled into
+the native library, overridable per-process by ``RAY_TPU_<name>``
+environment variables and per-cluster by the ``_system_config`` dict passed
+to ``ray_tpu.init`` — the same precedence the reference implements.
+
+Falls back to a pure-Python table (same defaults, same precedence) when the
+native library is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Any, Dict, Optional
+
+_PY_DEFAULTS: Dict[str, Any] = {
+    # Kept in sync with kDefaults in config.cc; the parity test
+    # (tests/test_ray_config.py) diffs the two tables.
+    "scheduler_spread_threshold": 0.5,
+    "max_pending_lease_requests_per_scheduling_category": 10,
+    "worker_prestart_count": 1,
+    "worker_cap_multiplier": 8,
+    "worker_cap_min": 64,
+    "task_retry_delay_ms": 0,
+    "actor_restart_backoff_ms": 0,
+    "max_task_events": 100_000,
+    "lineage_max_entries": 1_000_000,
+    "object_locations_max_entries": 1_000_000,
+    "object_store_memory_fraction": 0.3,
+    "object_store_full_delay_ms": 100,
+    "object_spilling_threshold_bytes": 0,
+    "object_spilling_directory": "",
+    "gc_sweep_interval_ms": 500,
+    "health_check_period_ms": 1000,
+    "health_check_failure_threshold": 5,
+    "node_death_grace_ms": 0,
+    "metrics_report_interval_ms": 10_000,
+    "task_events_enabled": True,
+    "memory_monitor_refresh_ms": 250,
+    "memory_usage_threshold": 0.95,
+    "testing_submit_delay_us": 0,
+    "testing_dispatch_delay_us": 0,
+    "testing_store_delay_us": 0,
+    "testing_rpc_failure_pct": 0,
+    "tpu_autodetect": True,
+    "tpu_chips_per_host_default": 4,
+    "ici_topology": "",
+    "use_native_scheduler": True,
+    "use_native_object_store": True,
+    "use_native_refcount": True,
+}
+
+_lib = None
+_lib_failed = False
+_lib_lock = threading.Lock()
+
+
+def _load():
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        from ray_tpu._private.native_build import load_library
+        lib = load_library("config")
+        if lib is None:
+            _lib_failed = True
+            return None
+        P, I, L, D, C = (ctypes.c_void_p, ctypes.c_int, ctypes.c_int64,
+                        ctypes.c_double, ctypes.c_char_p)
+        lib.rcfg_create.restype = P
+        lib.rcfg_create.argtypes = [C]
+        lib.rcfg_destroy.argtypes = [P]
+        lib.rcfg_has.restype = I
+        lib.rcfg_has.argtypes = [P, C, ctypes.POINTER(I)]
+        lib.rcfg_get_int.restype = L
+        lib.rcfg_get_int.argtypes = [P, C, L]
+        lib.rcfg_get_double.restype = D
+        lib.rcfg_get_double.argtypes = [P, C, D]
+        lib.rcfg_get_bool.restype = I
+        lib.rcfg_get_bool.argtypes = [P, C, I]
+        lib.rcfg_get_str.restype = L
+        lib.rcfg_get_str.argtypes = [P, C, ctypes.c_char_p, L]
+        lib.rcfg_set.restype = I
+        lib.rcfg_set.argtypes = [P, C, C]
+        lib.rcfg_dump.restype = L
+        lib.rcfg_dump.argtypes = [P, ctypes.c_char_p, L]
+        _lib = lib
+        return _lib
+
+
+def native_config_available() -> bool:
+    if os.environ.get("RAY_TPU_NATIVE_CONFIG", "1") == "0":
+        return False
+    return _load() is not None
+
+
+def _encode_overrides(overrides: Optional[Dict[str, Any]]) -> bytes:
+    if not overrides:
+        return b""
+    parts = []
+    for k, v in overrides.items():
+        if isinstance(v, bool):
+            v = "true" if v else "false"
+        parts.append(f"{k}={v}")
+    return ";".join(parts).encode()
+
+
+class NativeRayConfig:
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None):
+        self._lib = _load()
+        self._h = self._lib.rcfg_create(_encode_overrides(overrides))
+
+    def __del__(self):
+        try:
+            self._lib.rcfg_destroy(self._h)
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+    def _type_of(self, name: str) -> Optional[int]:
+        t = ctypes.c_int(0)
+        if not self._lib.rcfg_has(self._h, name.encode(), ctypes.byref(t)):
+            return None
+        return t.value
+
+    def get(self, name: str):
+        t = self._type_of(name)
+        if t is None:
+            raise AttributeError(f"Unknown config flag {name!r}")
+        key = name.encode()
+        if t == 0:
+            return int(self._lib.rcfg_get_int(self._h, key, 0))
+        if t == 1:
+            return float(self._lib.rcfg_get_double(self._h, key, 0.0))
+        if t == 2:
+            return bool(self._lib.rcfg_get_bool(self._h, key, 0))
+        cap = 256
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.rcfg_get_str(self._h, key, buf, cap)
+            if n < 0:
+                return ""
+            if n < cap:
+                return buf.value.decode()
+            cap = n + 1
+
+    def set(self, name: str, value: Any) -> None:
+        if isinstance(value, bool):
+            value = "true" if value else "false"
+        if not self._lib.rcfg_set(self._h, name.encode(), str(value).encode()):
+            raise AttributeError(f"Unknown config flag {name!r}")
+
+    def dump(self) -> Dict[str, str]:
+        cap = 1 << 14
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.rcfg_dump(self._h, buf, cap)
+            if n < cap:
+                break
+            cap = n + 1
+        out = {}
+        for row in buf.value.decode().split(";"):
+            if row:
+                k, _, v = row.partition("=")
+                out[k] = v
+        return out
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.get(name)
+
+
+class PyRayConfig:
+    """Pure-Python twin (same defaults, same env/override precedence)."""
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None):
+        values = dict(_PY_DEFAULTS)
+        for name, default in _PY_DEFAULTS.items():
+            env = os.environ.get(f"RAY_TPU_{name}")
+            if env is not None:
+                values[name] = self._coerce(default, env)
+        for name, val in (overrides or {}).items():
+            if name in values:
+                values[name] = self._coerce(values[name], val)
+        object.__setattr__(self, "_values", values)
+
+    @staticmethod
+    def _coerce(default: Any, val: Any) -> Any:
+        if isinstance(default, bool):
+            if isinstance(val, str):
+                return val.lower() in ("1", "true", "yes", "on")
+            return bool(val)
+        if isinstance(default, int):
+            try:
+                return int(float(val))
+            except (TypeError, ValueError):
+                return 0
+        if isinstance(default, float):
+            try:
+                return float(val)
+            except (TypeError, ValueError):
+                return 0.0
+        return str(val)
+
+    def get(self, name: str):
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(f"Unknown config flag {name!r}") from None
+
+    def set(self, name: str, value: Any) -> None:
+        if name not in self._values:
+            raise AttributeError(f"Unknown config flag {name!r}")
+        self._values[name] = self._coerce(self._values[name], value)
+
+    def dump(self) -> Dict[str, str]:
+        out = {}
+        for k, v in self._values.items():
+            if isinstance(v, bool):
+                out[k] = "true" if v else "false"
+            else:
+                out[k] = str(v)
+        return out
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.get(name)
+
+
+def make_ray_config(overrides: Optional[Dict[str, Any]] = None):
+    if native_config_available():
+        return NativeRayConfig(overrides)
+    return PyRayConfig(overrides)

@@ -1,0 +1,336 @@
+"""Reference counting (distributed GC) — native engine + Python fallback.
+
+The ownership model of the reference's ReferenceCounter
+(src/ray/core_worker/reference_count.h:61): every object created by this
+process (put / task return) is *owned* here; language handles hold local
+references, pending submitted tasks hold dependency references, serialized
+handles in other workers are borrowers, and stored values pin the objects
+their payload contains. When an owned object's combined count reaches zero
+its value is freed from the store and its lineage pruned.
+
+The native engine (src/ray_tpu_native/refcount.cc) is used when buildable;
+``RAY_TPU_NATIVE_REFCOUNT=0`` forces the pure-Python twin. Both expose the
+same interface and must make identical decisions (tests/test_refcount.py
+runs the parity suite against each).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Dict, List, Optional, Set
+
+from ray_tpu._private.ids import ObjectID
+
+_lib = None
+_lib_failed = False
+_lib_lock = threading.Lock()
+
+
+def _load():
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        from ray_tpu._private.native_build import load_library
+        lib = load_library("refcount")
+        if lib is None:
+            _lib_failed = True
+            return None
+        P, I, L, C = (ctypes.c_void_p, ctypes.c_int, ctypes.c_int64,
+                      ctypes.c_char_p)
+        lib.rrc_create.restype = P
+        lib.rrc_destroy.argtypes = [P]
+        lib.rrc_add_owned.argtypes = [P, C]
+        lib.rrc_add_local.argtypes = [P, C]
+        lib.rrc_remove_local.restype = L
+        lib.rrc_remove_local.argtypes = [P, C, ctypes.c_char_p, L]
+        lib.rrc_add_task_deps.argtypes = [P, C]
+        lib.rrc_remove_task_deps.restype = L
+        lib.rrc_remove_task_deps.argtypes = [P, C, ctypes.c_char_p, L]
+        lib.rrc_add_borrower.argtypes = [P, C, C]
+        lib.rrc_remove_borrower.restype = L
+        lib.rrc_remove_borrower.argtypes = [P, C, C, ctypes.c_char_p, L]
+        lib.rrc_add_contained.argtypes = [P, C, C]
+        lib.rrc_force_free.restype = L
+        lib.rrc_force_free.argtypes = [P, C, ctypes.c_char_p, L]
+        lib.rrc_has.restype = I
+        lib.rrc_has.argtypes = [P, C]
+        lib.rrc_local_count.restype = L
+        lib.rrc_local_count.argtypes = [P, C]
+        lib.rrc_num_tracked.restype = L
+        lib.rrc_num_tracked.argtypes = [P]
+        lib.rrc_dump.restype = L
+        lib.rrc_dump.argtypes = [P, ctypes.c_char_p, L]
+        _lib = lib
+        return _lib
+
+
+def native_refcount_available() -> bool:
+    if os.environ.get("RAY_TPU_NATIVE_REFCOUNT", "1") == "0":
+        return False
+    return _load() is not None
+
+
+class NativeReferenceCounter:
+    """ctypes wrapper over the C++ counter."""
+
+    def __init__(self):
+        self._lib = _load()
+        self._h = self._lib.rrc_create()
+
+    def __del__(self):
+        try:
+            self._lib.rrc_destroy(self._h)
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+    @staticmethod
+    def _ids(oids: List[ObjectID]) -> bytes:
+        return ";".join(o.hex() for o in oids).encode()
+
+    def _call_freeing(self, fn, *args) -> List[ObjectID]:
+        cap = 4096
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = fn(self._h, *args, buf, cap)
+            if n < cap:
+                raw = buf.value.decode()
+                if not raw:
+                    return []
+                return [ObjectID.from_hex(tok) for tok in raw.split(";")]
+            cap = n + 1
+
+    def add_owned(self, oid: ObjectID) -> None:
+        self._lib.rrc_add_owned(self._h, oid.hex().encode())
+
+    def add_local(self, oid: ObjectID) -> None:
+        self._lib.rrc_add_local(self._h, oid.hex().encode())
+
+    def remove_local(self, oid: ObjectID) -> List[ObjectID]:
+        return self._call_freeing(self._lib.rrc_remove_local,
+                                  oid.hex().encode())
+
+    def add_task_deps(self, oids: List[ObjectID]) -> None:
+        if oids:
+            self._lib.rrc_add_task_deps(self._h, self._ids(oids))
+
+    def remove_task_deps(self, oids: List[ObjectID]) -> List[ObjectID]:
+        if not oids:
+            return []
+        return self._call_freeing(self._lib.rrc_remove_task_deps,
+                                  self._ids(oids))
+
+    def add_borrower(self, oid: ObjectID, borrower: str) -> None:
+        self._lib.rrc_add_borrower(self._h, oid.hex().encode(),
+                                   borrower.encode())
+
+    def remove_borrower(self, oid: ObjectID, borrower: str) -> List[ObjectID]:
+        return self._call_freeing(self._lib.rrc_remove_borrower,
+                                  oid.hex().encode(), borrower.encode())
+
+    def add_contained(self, parent: ObjectID,
+                      children: List[ObjectID]) -> None:
+        if children:
+            self._lib.rrc_add_contained(self._h, parent.hex().encode(),
+                                        self._ids(children))
+
+    def force_free(self, oid: ObjectID) -> List[ObjectID]:
+        return self._call_freeing(self._lib.rrc_force_free,
+                                  oid.hex().encode())
+
+    def has(self, oid: ObjectID) -> bool:
+        return bool(self._lib.rrc_has(self._h, oid.hex().encode()))
+
+    def local_count(self, oid: ObjectID) -> int:
+        return int(self._lib.rrc_local_count(self._h, oid.hex().encode()))
+
+    def num_tracked(self) -> int:
+        return int(self._lib.rrc_num_tracked(self._h))
+
+    def dump(self) -> Dict[str, Dict[str, int]]:
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.rrc_dump(self._h, buf, cap)
+            if n < cap:
+                break
+            cap = n + 1
+        out: Dict[str, Dict[str, int]] = {}
+        raw = buf.value.decode()
+        if not raw:
+            return out
+        for row in raw.split(";"):
+            oid, _, counts = row.partition("=")
+            local, deps, cont, borrow = (int(x) for x in counts.split(","))
+            out[oid] = {"local": local, "task_deps": deps,
+                        "contained_in": cont, "borrowers": borrow}
+        return out
+
+
+class _Ref:
+    __slots__ = ("local", "task_deps", "contained_in", "borrowers",
+                 "contained", "owned", "value_live")
+
+    def __init__(self):
+        self.local = 0
+        self.task_deps = 0
+        self.contained_in = 0
+        self.borrowers: Set[str] = set()
+        self.contained: List[ObjectID] = []
+        self.owned = False
+        self.value_live = False
+
+    def freeable(self) -> bool:
+        return (self.owned and self.value_live and self.local == 0
+                and self.task_deps == 0 and self.contained_in == 0
+                and not self.borrowers)
+
+    def dead(self) -> bool:
+        return (not self.value_live and self.local == 0
+                and self.task_deps == 0 and self.contained_in == 0
+                and not self.borrowers)
+
+
+class PyReferenceCounter:
+    """Pure-Python twin of the native counter (decision parity)."""
+
+    def __init__(self):
+        self._refs: Dict[ObjectID, _Ref] = {}
+        self._lock = threading.Lock()
+
+    def _collect(self, oid: ObjectID, out: List[ObjectID]) -> None:
+        ref = self._refs.get(oid)
+        if ref is None or not ref.freeable():
+            return
+        children, ref.contained = ref.contained, []
+        ref.value_live = False
+        out.append(oid)
+        self._maybe_erase(oid)
+        for child in children:
+            cref = self._refs.get(child)
+            if cref is None:
+                continue
+            if cref.contained_in > 0:
+                cref.contained_in -= 1
+            self._collect(child, out)
+            self._maybe_erase(child)
+
+    def _maybe_erase(self, oid: ObjectID) -> None:
+        ref = self._refs.get(oid)
+        if ref is not None and ref.dead():
+            del self._refs[oid]
+
+    def add_owned(self, oid: ObjectID) -> None:
+        with self._lock:
+            ref = self._refs.setdefault(oid, _Ref())
+            ref.owned = True
+            ref.value_live = True
+
+    def add_local(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._refs.setdefault(oid, _Ref()).local += 1
+
+    def remove_local(self, oid: ObjectID) -> List[ObjectID]:
+        with self._lock:
+            freed: List[ObjectID] = []
+            ref = self._refs.get(oid)
+            if ref is not None:
+                if ref.local > 0:
+                    ref.local -= 1
+                self._collect(oid, freed)
+                self._maybe_erase(oid)
+            return freed
+
+    def add_task_deps(self, oids: List[ObjectID]) -> None:
+        with self._lock:
+            for oid in oids:
+                self._refs.setdefault(oid, _Ref()).task_deps += 1
+
+    def remove_task_deps(self, oids: List[ObjectID]) -> List[ObjectID]:
+        with self._lock:
+            freed: List[ObjectID] = []
+            for oid in oids:
+                ref = self._refs.get(oid)
+                if ref is None:
+                    continue
+                if ref.task_deps > 0:
+                    ref.task_deps -= 1
+                self._collect(oid, freed)
+                self._maybe_erase(oid)
+            return freed
+
+    def add_borrower(self, oid: ObjectID, borrower: str) -> None:
+        with self._lock:
+            self._refs.setdefault(oid, _Ref()).borrowers.add(borrower)
+
+    def remove_borrower(self, oid: ObjectID, borrower: str) -> List[ObjectID]:
+        with self._lock:
+            freed: List[ObjectID] = []
+            ref = self._refs.get(oid)
+            if ref is not None:
+                ref.borrowers.discard(borrower)
+                self._collect(oid, freed)
+                self._maybe_erase(oid)
+            return freed
+
+    def add_contained(self, parent: ObjectID,
+                      children: List[ObjectID]) -> None:
+        if not children:
+            return
+        with self._lock:
+            pref = self._refs.setdefault(parent, _Ref())
+            for child in children:
+                self._refs.setdefault(child, _Ref()).contained_in += 1
+                pref.contained.append(child)
+
+    def force_free(self, oid: ObjectID) -> List[ObjectID]:
+        with self._lock:
+            freed: List[ObjectID] = []
+            ref = self._refs.get(oid)
+            if ref is not None and ref.value_live:
+                children, ref.contained = ref.contained, []
+                ref.value_live = False
+                freed.append(oid)
+                self._maybe_erase(oid)
+                for child in children:
+                    cref = self._refs.get(child)
+                    if cref is None:
+                        continue
+                    if cref.contained_in > 0:
+                        cref.contained_in -= 1
+                    self._collect(child, freed)
+                    self._maybe_erase(child)
+            return freed
+
+    def has(self, oid: ObjectID) -> bool:
+        with self._lock:
+            ref = self._refs.get(oid)
+            return ref is not None and ref.value_live
+
+    def local_count(self, oid: ObjectID) -> int:
+        with self._lock:
+            ref = self._refs.get(oid)
+            return 0 if ref is None else ref.local
+
+    def num_tracked(self) -> int:
+        with self._lock:
+            return len(self._refs)
+
+    def dump(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {
+                oid.hex(): {"local": r.local, "task_deps": r.task_deps,
+                            "contained_in": r.contained_in,
+                            "borrowers": len(r.borrowers)}
+                for oid, r in self._refs.items()
+            }
+
+
+def make_reference_counter(use_native: bool = True):
+    """``use_native=False`` (the use_native_refcount config flag) forces the
+    Python twin; RAY_TPU_NATIVE_REFCOUNT=0 also disables."""
+    if use_native and native_refcount_available():
+        return NativeReferenceCounter()
+    return PyReferenceCounter()

@@ -154,6 +154,10 @@ class SerialThreadExecutor(Executor):
                 item()
             except BaseException:  # noqa: BLE001 - executor must survive
                 logger.exception("Uncaught error in worker loop")
+            # Drop the completed thunk NOW: an idle worker must not keep the
+            # last task's spec (and its ObjectRef args) alive until the next
+            # task arrives — that pins freed objects' refcounts.
+            del item
 
     def submit(self, thunk):
         self._queue.put(thunk)
@@ -250,17 +254,26 @@ class ActorState:
 
 class Runtime:
     def __init__(self, node_resources: NodeResources, job_id: JobID,
-                 max_workers: Optional[int] = None):
+                 max_workers: Optional[int] = None,
+                 system_config: Optional[Dict[str, Any]] = None):
         import uuid
         self.session_id = uuid.uuid4().hex
         self.job_id = job_id
         self.node_resources = node_resources
+        # Typed flag table (reference: RayConfig / ray_config_def.h):
+        # native C++ defaults overridable via RAY_TPU_<flag> env vars and
+        # the _system_config dict handed to init().
+        from ray_tpu._private.ray_config import make_ray_config
+        self.config = make_ray_config(system_config)
         # Shared-memory arena sized like the reference's object store
         # (30% of memory, services.py object_store_memory default).
         self.store = ObjectStore(
             deserializer=serialization.deserialize,
-            native_capacity=int(node_resources.memory_bytes * 0.3))
-        self.scheduler = make_cluster_scheduler()
+            native_capacity=int(node_resources.memory_bytes *
+                                self.config.object_store_memory_fraction),
+            use_native=self.config.use_native_object_store)
+        self.scheduler = make_cluster_scheduler(
+            use_native=self.config.use_native_scheduler)
         self.head_node_id = self.scheduler.add_node(
             node_resources.to_resource_map(), is_head=True)
         self.functions = FunctionTable()
@@ -279,7 +292,9 @@ class Runtime:
         # Worker cap: thread executors are cheap; cap well above CPU count so
         # blocking tasks (e.g. sleeping) don't starve the pool.
         self._max_workers = max_workers or max(
-            64, int(node_resources.num_cpus) * 8)
+            int(self.config.worker_cap_min),
+            int(node_resources.num_cpus) *
+            int(self.config.worker_cap_multiplier))
         self._task_events: List[dict] = []  # lightweight task-event buffer
         self._infeasible_warned: set = set()
         # Lineage: creating TaskSpec per return object, for reconstruction
@@ -287,27 +302,112 @@ class Runtime:
         # + object_recovery_manager.h). Bounded; puts are not reconstructable.
         self._lineage: Dict[ObjectID, TaskSpec] = {}
         self._object_locations: Dict[ObjectID, NodeID] = {}
+        # Ownership/reference counting (reference: reference_count.h):
+        # ObjectRef handles hold local refs, pending tasks hold dependency
+        # refs; when an owned object's counts hit zero its value is freed
+        # and lineage pruned. Native C++ engine with a Python twin.
+        from ray_tpu._private.refcount import make_reference_counter
+        self.refs = make_reference_counter(
+            use_native=self.config.use_native_refcount)
+        self._chaos_us = {
+            flag: int(self.config.get(flag))
+            for flag in ("testing_submit_delay_us",
+                         "testing_dispatch_delay_us",
+                         "testing_store_delay_us")
+        }
+        # Deferred-free queue: ObjectRef.__del__ can fire at any point —
+        # including inside the store's non-reentrant lock when a freed value
+        # drops the last handle to another object — so handle-death frees
+        # are drained by a dedicated GC thread instead of inline.
+        import collections
+        self._gc_queue: "collections.deque[ObjectID]" = collections.deque()
+        self._gc_event = threading.Event()
+        self._gc_thread = threading.Thread(
+            target=self._gc_loop, name="ray_tpu-refgc", daemon=True)
+        self._gc_thread.start()
 
     # ------------------------------------------------------------------
     # Object API
     # ------------------------------------------------------------------
 
     def free_objects(self, oids: List[ObjectID]) -> None:
-        """Free object values and drop their lineage/location bookkeeping
-        (the reference prunes lineage when refs go out of scope; here the
-        explicit free() is the pruning point)."""
+        """Explicitly free object values (``ray.free`` analog) regardless of
+        outstanding references, cascading to objects contained in them."""
+        cascade: Dict[ObjectID, None] = dict.fromkeys(oids)
+        for oid in oids:
+            # force_free returns the oid itself (when tracked) plus any
+            # contained objects it cascaded to; dedupe against the explicit
+            # list so nothing reaches store.free twice.
+            cascade.update(dict.fromkeys(self.refs.force_free(oid)))
+        self._free_now(list(cascade))
+
+    def _free_now(self, oids: List[ObjectID]) -> None:
+        """Drop freed objects' values and lineage/location bookkeeping (the
+        reference prunes lineage when refs go out of scope)."""
+        if not oids:
+            return
         self.store.free(oids)
         with self._lock:
             for oid in oids:
                 self._lineage.pop(oid, None)
                 self._object_locations.pop(oid, None)
 
+    def on_ref_deleted(self, oid: ObjectID) -> None:
+        """An ObjectRef handle was garbage collected. Runs inside __del__,
+        which can fire at ANY allocation (cyclic GC) — including while this
+        very thread holds the store lock or the reference counter's own
+        lock. So: strictly lock-free here (deque.append is atomic); the GC
+        thread performs the counter decrement and the freeing."""
+        self._gc_queue.append(oid)
+        self._gc_event.set()
+
+    def _gc_loop(self) -> None:
+        while True:
+            self._gc_event.wait(
+                timeout=self.config.gc_sweep_interval_ms / 1000.0)
+            self._gc_event.clear()
+            batch: List[ObjectID] = []
+            while self._gc_queue:
+                try:
+                    batch.append(self._gc_queue.popleft())
+                except IndexError:
+                    break
+            if batch:
+                try:
+                    freed: List[ObjectID] = []
+                    for oid in batch:
+                        freed.extend(self.refs.remove_local(oid))
+                    self._free_now(freed)
+                except Exception:  # noqa: BLE001 - GC must never die
+                    logger.exception("refcount GC sweep failed")
+            if self._shutdown and not self._gc_queue:
+                return
+
+    def _register_task_refs(self, spec: TaskSpec) -> None:
+        """Owner-side bookkeeping at submission: own the return objects and
+        pin the argument objects until the task completes."""
+        if spec.num_returns != 0:
+            for oid in spec.return_ids:
+                self.refs.add_owned(oid)
+        deps = self._find_dependencies(spec)
+        spec._dep_oids = deps  # type: ignore[attr-defined]
+        self.refs.add_task_deps(deps)
+
+    def _release_task_deps(self, spec: TaskSpec) -> None:
+        """Task reached a terminal state: drop its dependency pins."""
+        deps = getattr(spec, "_dep_oids", None)
+        if deps:
+            spec._dep_oids = None  # type: ignore[attr-defined]
+            self._free_now(self.refs.remove_task_deps(deps))
+
     def put(self, value: Any) -> ObjectRef:
         with self._lock:
             self._put_index += 1
             idx = self._put_index
         oid = ObjectID.for_put(TaskID.for_normal_task(self.job_id), idx)
+        self._chaos_delay("testing_store_delay_us")
         self.store.put_inline(oid, value)
+        self.refs.add_owned(oid)
         return ObjectRef(oid)
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float]) -> List[Any]:
@@ -383,8 +483,20 @@ class Runtime:
     def register_function(self, fn: Callable) -> bytes:
         return self.functions.export(fn)
 
+    def _chaos_delay(self, flag: str) -> None:
+        """Fault-injection hook (reference: asio_chaos.cc +
+        RAY_testing_asio_delay_us): sleep testing_*_delay_us microseconds
+        when the flag is nonzero, to surface ordering races in tests.
+        Values are snapshotted at init — submit/dispatch are hot paths, and
+        a per-call native config probe there is not free."""
+        us = self._chaos_us.get(flag, 0)
+        if us:
+            import time as _time
+            _time.sleep(us / 1e6)
+
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
         """Submit a normal task. Returns refs for its return objects."""
+        self._chaos_delay("testing_submit_delay_us")
         n = 1 if spec.num_returns == "dynamic" else spec.num_returns
         spec.return_ids = [
             ObjectID.for_return(spec.task_id, i + 1) for i in range(max(n, 1))]
@@ -392,9 +504,10 @@ class Runtime:
         if spec.num_returns == 0:
             refs = []
         with self._lock:
-            if len(self._lineage) < 1_000_000:
+            if len(self._lineage) < self.config.lineage_max_entries:
                 for oid in spec.return_ids:
                     self._lineage[oid] = spec
+        self._register_task_refs(spec)
         self._record_event(spec, "SUBMITTED")
         self._resolve_dependencies(spec)
         return refs
@@ -410,7 +523,10 @@ class Runtime:
         return deps
 
     def _resolve_dependencies(self, spec: TaskSpec) -> None:
-        deps = self._find_dependencies(spec)
+        # _register_task_refs already walked the args; reuse its list.
+        deps = getattr(spec, "_dep_oids", None)
+        if deps is None:
+            deps = self._find_dependencies(spec)
         spec.dependencies = deps
         unresolved = [d for d in deps if not self.store.contains(d)]
         if not unresolved:
@@ -498,6 +614,7 @@ class Runtime:
         return pg_id, bundle
 
     def _dispatch(self) -> None:
+        self._chaos_delay("testing_dispatch_delay_us")
         while True:
             launched = None
             with self._lock:
@@ -615,13 +732,15 @@ class Runtime:
             # The task's node died while it ran; a retry owns the return
             # objects now (reference: a worker on a dead node can't deliver).
             return
+        self._release_task_deps(spec)
         node_id = getattr(spec, "_node_id", None)
         if node_id is not None:
             with self._lock:
                 # Same bound as _lineage: past it, objects are simply not
                 # reconstructable (the maps must not grow without limit in
                 # long-running drivers).
-                if len(self._object_locations) < 1_000_000:
+                if len(self._object_locations) < \
+                        self.config.object_locations_max_entries:
                     for oid in spec.return_ids:
                         self._object_locations[oid] = node_id
         n = spec.num_returns
@@ -631,15 +750,18 @@ class Runtime:
             # Dynamic generator returns (reference: _raylet.pyx:624): each
             # yielded value becomes its own object; the declared return object
             # holds the list of refs.
+            if not self.refs.has(spec.return_ids[0]):
+                return  # every handle dropped while the task ran
             item_refs = []
             for i, item in enumerate(result):
                 oid = ObjectID.for_return(spec.task_id, i + 2)
                 self.store.put_inline(oid, item)
+                self.refs.add_owned(oid)
                 item_refs.append(ObjectRef(oid))
-            self.store.put_inline(spec.return_ids[0], item_refs)
+            self._store_if_referenced(spec.return_ids[0], item_refs)
             return
         if n == 1:
-            self.store.put_inline(spec.return_ids[0], result)
+            self._store_if_referenced(spec.return_ids[0], result)
             return
         if not isinstance(result, (tuple, list)) or len(result) != n:
             self._store_error(spec, ValueError(
@@ -648,15 +770,30 @@ class Runtime:
                 f"{len(result) if hasattr(result, '__len__') else 'n/a'}"))
             return
         for oid, value in zip(spec.return_ids, result):
-            self.store.put_inline(oid, value)
+            self._store_if_referenced(oid, value)
+
+    def _store_if_referenced(self, oid: ObjectID, value: Any,
+                             is_exception: bool = False) -> None:
+        """Store a task result unless every handle was already dropped.
+
+        The recheck AFTER the store closes the race with a handle dying
+        between the check and the seal: either the death happened before the
+        recheck (we free inline) or after it (the counter still tracked the
+        object, so remove_local returns it and the GC thread frees it)."""
+        if not self.refs.has(oid):
+            return
+        self.store.put_inline(oid, value, is_exception=is_exception)
+        if not self.refs.has(oid):
+            self.store.free([oid])
 
     def _store_error(self, spec: TaskSpec, exc: BaseException) -> None:
+        self._release_task_deps(spec)
         if not isinstance(exc, (TaskError, ActorDiedError, TaskCancelledError,
                                 GetTimeoutError, NodeDiedError,
                                 ObjectLostError)):
             exc = TaskError.from_exception(exc, spec.name)
         for oid in spec.return_ids:
-            self.store.put_inline(oid, exc, is_exception=True)
+            self._store_if_referenced(oid, exc, is_exception=True)
         self._record_event(spec, "FAILED")
 
     def _should_retry(self, spec: TaskSpec, exc: BaseException) -> bool:
@@ -752,6 +889,7 @@ class Runtime:
                 self._named_actors[(namespace, name)] = actor_id
             self._actors[actor_id] = state
         spec.return_ids = [ObjectID.for_return(spec.task_id, 1)]
+        self._register_task_refs(spec)
         self._record_event(spec, "SUBMITTED")
         self._resolve_dependencies(spec)
         return actor_id
@@ -840,6 +978,7 @@ class Runtime:
                 self._store_error(spec, state.death_cause)
                 self._release_actor_resources(state)
             else:
+                self._release_task_deps(spec)
                 self.store.put_inline(spec.return_ids[0], None)
                 self._record_event(spec, "FINISHED")
         except BaseException as e:  # noqa: BLE001
@@ -879,6 +1018,7 @@ class Runtime:
         refs = [ObjectRef(oid) for oid in spec.return_ids]
         if spec.num_returns == 0:
             refs = []
+        self._register_task_refs(spec)
         state = self._actors.get(spec.actor_id)
         if state is None or state.dead:
             cause = state.death_cause if state else None
@@ -1116,6 +1256,7 @@ class Runtime:
             for queued in unfinished:
                 self._store_error(queued, err)
             self._release_actor_resources(state)
+        self._release_task_deps(spec)
         self._return_worker(worker)
         self._dispatch()
 
@@ -1198,6 +1339,10 @@ class Runtime:
                       and s.kind != TaskKind.ACTOR_CREATION]
         for spec in doomed:
             spec.invalidated = True
+            # The zombie spec will never reach _store_results/_store_error,
+            # so its dependency pins must be dropped here (the retry clone
+            # re-pins its own).
+            self._release_task_deps(spec)
             with self._lock:
                 self._inflight.pop(spec.task_id, None)
             self._retry_after_node_death(spec, node_id)
@@ -1230,6 +1375,7 @@ class Runtime:
             logger.warning("Node %s died; retrying task %s (attempt %d/%d)",
                            node_id.hex()[:12], spec.name,
                            retry.attempt_number, retry.max_retries)
+            self._register_task_refs(retry)
             self._resolve_dependencies(retry)
         else:
             # Seal the error directly (the spec stays invalidated so the
@@ -1281,6 +1427,7 @@ class Runtime:
         # original spec stays invalidated: if its __init__ is still running
         # on a zombie thread, that thread discards its work.
         state.creation_spec.invalidated = True
+        self._release_task_deps(state.creation_spec)
         creation = state.creation_spec.clone_for_retry()
         with state.lock:
             state.creation_spec = creation
@@ -1289,6 +1436,7 @@ class Runtime:
                        "(restart %d)", node_id.hex()[:12],
                        state.name or state.actor_id.hex()[:8],
                        state.num_restarts)
+        self._register_task_refs(creation)
         with self._lock:
             self._ready.append(creation)
 
@@ -1332,6 +1480,7 @@ class Runtime:
                 for oid in clone.return_ids:
                     if oid in self._lineage:
                         self._lineage[oid] = clone
+            self._register_task_refs(clone)
             self._resolve_dependencies(clone)
 
     # ------------------------------------------------------------------
@@ -1340,7 +1489,7 @@ class Runtime:
 
     def _record_event(self, spec: TaskSpec, status: str) -> None:
         import time as _time
-        if len(self._task_events) < 100_000:
+        if len(self._task_events) < self.config.max_task_events:
             self._task_events.append({
                 "task_id": spec.task_id.hex(),
                 "name": spec.name,
@@ -1377,6 +1526,7 @@ class Runtime:
             state.created.set()
         for w in workers:
             w.stop()
+        self._gc_event.set()  # let the GC thread observe _shutdown and exit
         # Wake every blocked get with an error rather than hanging.
         self.store.fail_all_pending(
             RayError("The runtime was shut down while this object was "

@@ -62,6 +62,7 @@ def init(
     include_dashboard: Optional[bool] = None,
     runtime_env: Optional[dict] = None,
     _memory: Optional[float] = None,
+    _system_config: Optional[dict] = None,
     **kwargs,
 ) -> "ClientContext":
     """Start (or connect to) a cluster.
@@ -88,7 +89,7 @@ def init(
             num_cpus=num_cpus, num_tpus=num_tpus, memory=_memory,
             resources=resources)
         job_id = JobID.next()
-        runtime = Runtime(node, job_id)
+        runtime = Runtime(node, job_id, system_config=_system_config)
         global_worker.set_runtime(runtime, job_id)
         if namespace:
             global_worker.namespace = namespace

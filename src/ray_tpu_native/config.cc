@@ -1,0 +1,227 @@
+// Native runtime configuration flag table.
+//
+// C++ equivalent of the reference's RayConfig system
+// (src/ray/common/ray_config_def.h: the RAY_CONFIG(type, name, default)
+// macro table materialized as a singleton, overridable per-process via
+// RAY_<name> environment variables or a _system_config blob handed to every
+// process). Flags are typed (int64/double/bool/string); lookup is a hash
+// map probe. The Python side holds one handle per runtime and reads flags
+// through the flat C ABI (ray_tpu/_private/ray_config.py).
+
+#include <cctype>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+
+namespace {
+
+enum class Type { kInt, kDouble, kBool, kStr };
+
+struct Flag {
+  Type type;
+  int64_t i = 0;
+  double d = 0.0;
+  bool b = false;
+  std::string s;
+};
+
+struct Config {
+  std::mutex mu;
+  std::unordered_map<std::string, Flag> flags;
+};
+
+void set_from_string(Flag* f, const std::string& val) {
+  switch (f->type) {
+    case Type::kInt:
+      f->i = std::strtoll(val.c_str(), nullptr, 10);
+      break;
+    case Type::kDouble:
+      f->d = std::strtod(val.c_str(), nullptr);
+      break;
+    case Type::kBool: {
+      std::string low;
+      for (char c : val) low += static_cast<char>(std::tolower(c));
+      f->b = (low == "1" || low == "true" || low == "yes" || low == "on");
+      break;
+    }
+    case Type::kStr:
+      f->s = val;
+      break;
+  }
+}
+
+// The flag table. Mirrors the shape of ray_config_def.h: one row per flag
+// with a typed default. TPU-specific additions at the bottom.
+#define FLAG_INT(name, def) {#name, {Type::kInt, (def), 0.0, false, ""}}
+#define FLAG_DBL(name, def) {#name, {Type::kDouble, 0, (def), false, ""}}
+#define FLAG_BOOL(name, def) {#name, {Type::kBool, 0, 0.0, (def), ""}}
+#define FLAG_STR(name, def) {#name, {Type::kStr, 0, 0.0, false, (def)}}
+
+const std::unordered_map<std::string, Flag> kDefaults = {
+    // -- scheduling (raylet/scheduling defaults) --
+    FLAG_DBL(scheduler_spread_threshold, 0.5),
+    FLAG_INT(max_pending_lease_requests_per_scheduling_category, 10),
+    FLAG_INT(worker_prestart_count, 1),
+    FLAG_INT(worker_cap_multiplier, 8),
+    FLAG_INT(worker_cap_min, 64),
+    // -- task/actor lifecycle --
+    FLAG_INT(task_retry_delay_ms, 0),
+    FLAG_INT(actor_restart_backoff_ms, 0),
+    FLAG_INT(max_task_events, 100000),
+    FLAG_INT(lineage_max_entries, 1000000),
+    FLAG_INT(object_locations_max_entries, 1000000),
+    // -- object store --
+    FLAG_DBL(object_store_memory_fraction, 0.3),
+    FLAG_INT(object_store_full_delay_ms, 100),
+    FLAG_INT(object_spilling_threshold_bytes, 0),  // 0 = disabled
+    FLAG_STR(object_spilling_directory, ""),
+    // -- GC / refcounting --
+    FLAG_INT(gc_sweep_interval_ms, 500),
+    // -- failure detection --
+    FLAG_INT(health_check_period_ms, 1000),
+    FLAG_INT(health_check_failure_threshold, 5),
+    FLAG_INT(node_death_grace_ms, 0),
+    // -- metrics / events --
+    FLAG_INT(metrics_report_interval_ms, 10000),
+    FLAG_BOOL(task_events_enabled, true),
+    // -- memory monitor / OOM killing --
+    FLAG_INT(memory_monitor_refresh_ms, 250),
+    FLAG_DBL(memory_usage_threshold, 0.95),
+    // -- chaos / fault injection (reference: asio_chaos.cc,
+    //    RAY_testing_asio_delay_us) --
+    FLAG_INT(testing_submit_delay_us, 0),
+    FLAG_INT(testing_dispatch_delay_us, 0),
+    FLAG_INT(testing_store_delay_us, 0),
+    FLAG_INT(testing_rpc_failure_pct, 0),
+    // -- TPU-native additions --
+    FLAG_BOOL(tpu_autodetect, true),
+    FLAG_INT(tpu_chips_per_host_default, 4),
+    FLAG_STR(ici_topology, ""),
+    FLAG_BOOL(use_native_scheduler, true),
+    FLAG_BOOL(use_native_object_store, true),
+    FLAG_BOOL(use_native_refcount, true),
+};
+
+#undef FLAG_INT
+#undef FLAG_DBL
+#undef FLAG_BOOL
+#undef FLAG_STR
+
+}  // namespace
+
+extern "C" {
+
+// overrides: "name=value;name=value" (the _system_config analog). Env vars
+// RAY_TPU_<name> take precedence over defaults, overrides over both.
+void* rcfg_create(const char* overrides) {
+  auto* c = new Config();
+  c->flags = kDefaults;
+  for (auto& kv : c->flags) {
+    std::string env_name = "RAY_TPU_" + kv.first;
+    const char* env = std::getenv(env_name.c_str());
+    if (env != nullptr) set_from_string(&kv.second, env);
+  }
+  if (overrides != nullptr && *overrides) {
+    const char* p = overrides;
+    while (*p) {
+      const char* eq = std::strchr(p, '=');
+      if (eq == nullptr) break;
+      const char* end = std::strchr(eq, ';');
+      if (end == nullptr) end = eq + std::strlen(eq);
+      std::string name(p, eq - p);
+      std::string val(eq + 1, end - (eq + 1));
+      auto it = c->flags.find(name);
+      if (it != c->flags.end()) set_from_string(&it->second, val);
+      p = (*end == ';') ? end + 1 : end;
+    }
+  }
+  return c;
+}
+
+void rcfg_destroy(void* h) { delete static_cast<Config*>(h); }
+
+// Returns 1 if the flag exists (writing its type into *type: 0 int, 1
+// double, 2 bool, 3 str), 0 otherwise.
+int rcfg_has(void* h, const char* name, int* type) {
+  auto* c = static_cast<Config*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->flags.find(name);
+  if (it == c->flags.end()) return 0;
+  if (type != nullptr) *type = static_cast<int>(it->second.type);
+  return 1;
+}
+
+int64_t rcfg_get_int(void* h, const char* name, int64_t fallback) {
+  auto* c = static_cast<Config*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->flags.find(name);
+  return (it != c->flags.end() && it->second.type == Type::kInt)
+             ? it->second.i : fallback;
+}
+
+double rcfg_get_double(void* h, const char* name, double fallback) {
+  auto* c = static_cast<Config*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->flags.find(name);
+  return (it != c->flags.end() && it->second.type == Type::kDouble)
+             ? it->second.d : fallback;
+}
+
+int rcfg_get_bool(void* h, const char* name, int fallback) {
+  auto* c = static_cast<Config*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->flags.find(name);
+  return (it != c->flags.end() && it->second.type == Type::kBool)
+             ? (it->second.b ? 1 : 0) : fallback;
+}
+
+int64_t rcfg_get_str(void* h, const char* name, char* buf, int64_t cap) {
+  auto* c = static_cast<Config*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->flags.find(name);
+  if (it == c->flags.end() || it->second.type != Type::kStr) return -1;
+  int64_t needed = static_cast<int64_t>(it->second.s.size());
+  if (buf != nullptr && needed < cap) {
+    std::memcpy(buf, it->second.s.data(), it->second.s.size());
+    buf[it->second.s.size()] = '\0';
+  }
+  return needed;
+}
+
+// Runtime mutation (tests / chaos toggles).
+int rcfg_set(void* h, const char* name, const char* value) {
+  auto* c = static_cast<Config*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->flags.find(name);
+  if (it == c->flags.end()) return 0;
+  set_from_string(&it->second, value);
+  return 1;
+}
+
+// Dump all flags as "name=value;..." for the state API / debugging.
+int64_t rcfg_dump(void* h, char* buf, int64_t cap) {
+  auto* c = static_cast<Config*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  std::string out;
+  for (const auto& kv : c->flags) {
+    if (!out.empty()) out += ';';
+    out += kv.first + "=";
+    switch (kv.second.type) {
+      case Type::kInt: out += std::to_string(kv.second.i); break;
+      case Type::kDouble: out += std::to_string(kv.second.d); break;
+      case Type::kBool: out += kv.second.b ? "true" : "false"; break;
+      case Type::kStr: out += kv.second.s; break;
+    }
+  }
+  int64_t needed = static_cast<int64_t>(out.size());
+  if (buf != nullptr && needed < cap) {
+    std::memcpy(buf, out.data(), out.size());
+    buf[out.size()] = '\0';
+  }
+  return needed;
+}
+
+}  // extern "C"

@@ -1,0 +1,249 @@
+// Native reference counter: the ownership/borrowing distributed-GC core.
+//
+// C++ equivalent of the reference's ReferenceCounter
+// (src/ray/core_worker/reference_count.h:61): per-object ownership with
+// local references (language handles), submitted-task (dependency)
+// references, borrowers, and contained-object pins, with cascade collection
+// when a parent's value is released. The Python runtime calls in through a
+// flat C ABI (ids as hex strings, lists ';'-joined); when an object's
+// combined count reaches zero the removal call returns the freeable ids and
+// the owner frees them from the store and prunes lineage.
+//
+// Single mutex: operations are O(refs touched); the hot path
+// (add/remove_local) is a hash lookup + counter update.
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct Ref {
+  int64_t local = 0;        // language handles in this process
+  int64_t task_deps = 0;    // pending submitted tasks depending on it
+  int64_t contained_in = 0; // live parent values containing this object
+  std::unordered_set<std::string> borrowers;
+  std::vector<std::string> contained; // children pinned by our value
+  bool owned = false;       // created via put/task-return in this process
+  bool value_live = false;  // the store still holds the value
+
+  bool freeable() const {
+    return owned && value_live && local == 0 && task_deps == 0 &&
+           contained_in == 0 && borrowers.empty();
+  }
+};
+
+struct Counter {
+  std::mutex mu;
+  std::unordered_map<std::string, Ref> refs;
+
+  // Collect `oid` if freeable, cascading through contained children.
+  void collect(const std::string& oid, std::vector<std::string>* out) {
+    auto it = refs.find(oid);
+    if (it == refs.end() || !it->second.freeable()) return;
+    std::vector<std::string> children = std::move(it->second.contained);
+    it->second.value_live = false;
+    out->push_back(oid);
+    // Entry stays (callers may still hold dangling handles and call
+    // remove_local later); it is erased once fully unreferenced.
+    maybe_erase(oid);
+    for (const auto& child : children) {
+      auto cit = refs.find(child);
+      if (cit == refs.end()) continue;
+      if (cit->second.contained_in > 0) cit->second.contained_in--;
+      collect(child, out);
+      maybe_erase(child);
+    }
+  }
+
+  void maybe_erase(const std::string& oid) {
+    auto it = refs.find(oid);
+    if (it == refs.end()) return;
+    const Ref& r = it->second;
+    if (!r.value_live && r.local == 0 && r.task_deps == 0 &&
+        r.contained_in == 0 && r.borrowers.empty()) {
+      refs.erase(it);
+    }
+  }
+};
+
+std::vector<std::string> split(const char* s) {
+  std::vector<std::string> out;
+  if (s == nullptr || *s == '\0') return out;
+  const char* start = s;
+  for (const char* p = s;; ++p) {
+    if (*p == ';' || *p == '\0') {
+      if (p > start) out.emplace_back(start, p - start);
+      if (*p == '\0') break;
+      start = p + 1;
+    }
+  }
+  return out;
+}
+
+int64_t write_list(const std::vector<std::string>& items, char* buf,
+                   int64_t cap) {
+  std::string joined;
+  for (size_t i = 0; i < items.size(); ++i) {
+    if (i) joined += ';';
+    joined += items[i];
+  }
+  int64_t needed = static_cast<int64_t>(joined.size());
+  if (buf != nullptr && needed < cap) {
+    std::memcpy(buf, joined.data(), joined.size());
+    buf[joined.size()] = '\0';
+  }
+  return needed;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rrc_create() { return new Counter(); }
+void rrc_destroy(void* h) { delete static_cast<Counter*>(h); }
+
+// Object created in this process (put / task return); value is in the store.
+void rrc_add_owned(void* h, const char* oid) {
+  auto* c = static_cast<Counter*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  Ref& r = c->refs[oid];
+  r.owned = true;
+  r.value_live = true;
+}
+
+void rrc_add_local(void* h, const char* oid) {
+  auto* c = static_cast<Counter*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  c->refs[oid].local++;
+}
+
+int64_t rrc_remove_local(void* h, const char* oid, char* buf, int64_t cap) {
+  auto* c = static_cast<Counter*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  std::vector<std::string> freed;
+  auto it = c->refs.find(oid);
+  if (it != c->refs.end()) {
+    if (it->second.local > 0) it->second.local--;
+    c->collect(oid, &freed);
+    c->maybe_erase(oid);
+  }
+  return write_list(freed, buf, cap);
+}
+
+void rrc_add_task_deps(void* h, const char* oids) {
+  auto* c = static_cast<Counter*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  for (const auto& oid : split(oids)) c->refs[oid].task_deps++;
+}
+
+int64_t rrc_remove_task_deps(void* h, const char* oids, char* buf,
+                             int64_t cap) {
+  auto* c = static_cast<Counter*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  std::vector<std::string> freed;
+  for (const auto& oid : split(oids)) {
+    auto it = c->refs.find(oid);
+    if (it == c->refs.end()) continue;
+    if (it->second.task_deps > 0) it->second.task_deps--;
+    c->collect(oid, &freed);
+    c->maybe_erase(oid);
+  }
+  return write_list(freed, buf, cap);
+}
+
+void rrc_add_borrower(void* h, const char* oid, const char* borrower) {
+  auto* c = static_cast<Counter*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  c->refs[oid].borrowers.insert(borrower);
+}
+
+int64_t rrc_remove_borrower(void* h, const char* oid, const char* borrower,
+                            char* buf, int64_t cap) {
+  auto* c = static_cast<Counter*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  std::vector<std::string> freed;
+  auto it = c->refs.find(oid);
+  if (it != c->refs.end()) {
+    it->second.borrowers.erase(borrower);
+    c->collect(oid, &freed);
+    c->maybe_erase(oid);
+  }
+  return write_list(freed, buf, cap);
+}
+
+// Parent's stored value contains `children`: pin them while parent's value
+// lives. (Cross-process transfer analog of WrapObjectIds.)
+void rrc_add_contained(void* h, const char* parent, const char* children) {
+  auto* c = static_cast<Counter*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto kids = split(children);
+  Ref& p = c->refs[parent];
+  for (const auto& kid : kids) {
+    c->refs[kid].contained_in++;
+    p.contained.push_back(kid);
+  }
+}
+
+// Explicit free (ray.free analog): drop the value regardless of refcounts.
+int64_t rrc_force_free(void* h, const char* oid, char* buf, int64_t cap) {
+  auto* c = static_cast<Counter*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  std::vector<std::string> freed;
+  auto it = c->refs.find(oid);
+  if (it != c->refs.end() && it->second.value_live) {
+    std::vector<std::string> children = std::move(it->second.contained);
+    it->second.value_live = false;
+    freed.push_back(oid);
+    c->maybe_erase(oid);
+    for (const auto& child : children) {
+      auto cit = c->refs.find(child);
+      if (cit == c->refs.end()) continue;
+      if (cit->second.contained_in > 0) cit->second.contained_in--;
+      c->collect(child, &freed);
+      c->maybe_erase(child);
+    }
+  }
+  return write_list(freed, buf, cap);
+}
+
+int rrc_has(void* h, const char* oid) {
+  auto* c = static_cast<Counter*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->refs.find(oid);
+  return it != c->refs.end() && it->second.value_live ? 1 : 0;
+}
+
+int64_t rrc_local_count(void* h, const char* oid) {
+  auto* c = static_cast<Counter*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->refs.find(oid);
+  return it == c->refs.end() ? 0 : it->second.local;
+}
+
+int64_t rrc_num_tracked(void* h) {
+  auto* c = static_cast<Counter*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  return static_cast<int64_t>(c->refs.size());
+}
+
+// Debug/state-API dump: "oid=local,task_deps,contained_in,borrowers;..."
+int64_t rrc_dump(void* h, char* buf, int64_t cap) {
+  auto* c = static_cast<Counter*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  std::vector<std::string> rows;
+  rows.reserve(c->refs.size());
+  for (const auto& kv : c->refs) {
+    rows.push_back(kv.first + "=" + std::to_string(kv.second.local) + "," +
+                   std::to_string(kv.second.task_deps) + "," +
+                   std::to_string(kv.second.contained_in) + "," +
+                   std::to_string(kv.second.borrowers.size()));
+  }
+  return write_list(rows, buf, cap);
+}
+
+}  // extern "C"

@@ -1,0 +1,113 @@
+"""RayConfig flag table: native/Python parity, env + _system_config
+precedence, and chaos-injection plumbing."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ray_config import (_PY_DEFAULTS, NativeRayConfig,
+                                         PyRayConfig,
+                                         native_config_available)
+
+ENGINES = [PyRayConfig]
+if native_config_available():
+    ENGINES.append(NativeRayConfig)
+
+
+@pytest.fixture(params=ENGINES, ids=lambda e: e.__name__)
+def config_cls(request):
+    return request.param
+
+
+def test_defaults(config_cls):
+    cfg = config_cls()
+    assert cfg.scheduler_spread_threshold == 0.5
+    assert cfg.lineage_max_entries == 1_000_000
+    assert cfg.task_events_enabled is True
+    assert cfg.ici_topology == ""
+    assert cfg.testing_submit_delay_us == 0
+
+
+def test_overrides(config_cls):
+    cfg = config_cls({"lineage_max_entries": 5,
+                      "memory_usage_threshold": 0.5,
+                      "task_events_enabled": False,
+                      "ici_topology": "2x2x1"})
+    assert cfg.lineage_max_entries == 5
+    assert cfg.memory_usage_threshold == 0.5
+    assert cfg.task_events_enabled is False
+    assert cfg.ici_topology == "2x2x1"
+
+
+def test_env_override(config_cls, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_gc_sweep_interval_ms", "123")
+    cfg = config_cls()
+    assert cfg.gc_sweep_interval_ms == 123
+
+
+def test_explicit_override_beats_env(config_cls, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_gc_sweep_interval_ms", "123")
+    cfg = config_cls({"gc_sweep_interval_ms": 77})
+    assert cfg.gc_sweep_interval_ms == 77
+
+
+def test_unknown_flag_raises(config_cls):
+    cfg = config_cls()
+    with pytest.raises(AttributeError):
+        cfg.get("definitely_not_a_flag")
+    with pytest.raises(AttributeError):
+        cfg.set("definitely_not_a_flag", 1)
+
+
+def test_set_and_dump(config_cls):
+    cfg = config_cls()
+    cfg.set("health_check_failure_threshold", 9)
+    assert cfg.health_check_failure_threshold == 9
+    dump = cfg.dump()
+    assert dump["health_check_failure_threshold"] == "9"
+    assert set(dump) == set(_PY_DEFAULTS)
+
+
+@pytest.mark.skipif(not native_config_available(),
+                    reason="native config unavailable")
+def test_native_python_tables_match():
+    """The C++ kDefaults table and _PY_DEFAULTS must list the same flags
+    with the same default values."""
+    def norm(d):
+        out = {}
+        for k, v in d.items():
+            try:
+                out[k] = float(v)  # "0.500000" == "0.5"
+            except ValueError:
+                out[k] = v
+        return out
+
+    assert norm(NativeRayConfig().dump()) == norm(PyRayConfig().dump())
+
+
+def test_system_config_reaches_runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0, _memory=1e9,
+                 _system_config={"max_task_events": 7})
+    from ray_tpu._private.worker import global_worker
+    assert global_worker.runtime.config.max_task_events == 7
+    ray_tpu.shutdown()
+
+
+def test_chaos_delay_applies():
+    import time
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0, _memory=1e9,
+                 _system_config={"testing_submit_delay_us": 50_000})
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    t0 = time.monotonic()
+    ref = f.remote()
+    dt = time.monotonic() - t0
+    assert dt >= 0.045, f"chaos submit delay not applied ({dt:.3f}s)"
+    assert ray_tpu.get(ref) == 1
+    ray_tpu.shutdown()
