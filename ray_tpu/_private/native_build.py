@@ -88,3 +88,21 @@ def load_library(name: str, extra_flags: Optional[List[str]] = None
         return ctypes.CDLL(path)
     except OSError:
         return None
+
+
+_loaded: Dict[str, Optional[ctypes.CDLL]] = {}
+
+
+def load_library_cached(name: str,
+                        extra_flags: Optional[List[str]] = None,
+                        configure=None) -> Optional[ctypes.CDLL]:
+    """Memoized load (failure included). ``configure(lib)`` runs once per
+    process to set the ctypes argtypes/restypes — every native component
+    wrapper shares this caching pattern instead of re-implementing it."""
+    with _lock_for(f"load:{name}"):
+        if name not in _loaded:
+            lib = load_library(name, extra_flags)
+            if lib is not None and configure is not None:
+                configure(lib)
+            _loaded[name] = lib
+        return _loaded[name]

@@ -55,42 +55,31 @@ _PY_DEFAULTS: Dict[str, Any] = {
     "use_native_refcount": True,
 }
 
-_lib = None
-_lib_failed = False
-_lib_lock = threading.Lock()
-
-
 def _load():
-    global _lib, _lib_failed
-    with _lib_lock:
-        if _lib is not None or _lib_failed:
-            return _lib
-        from ray_tpu._private.native_build import load_library
-        lib = load_library("config")
-        if lib is None:
-            _lib_failed = True
-            return None
-        P, I, L, D, C = (ctypes.c_void_p, ctypes.c_int, ctypes.c_int64,
-                        ctypes.c_double, ctypes.c_char_p)
-        lib.rcfg_create.restype = P
-        lib.rcfg_create.argtypes = [C]
-        lib.rcfg_destroy.argtypes = [P]
-        lib.rcfg_has.restype = I
-        lib.rcfg_has.argtypes = [P, C, ctypes.POINTER(I)]
-        lib.rcfg_get_int.restype = L
-        lib.rcfg_get_int.argtypes = [P, C, L]
-        lib.rcfg_get_double.restype = D
-        lib.rcfg_get_double.argtypes = [P, C, D]
-        lib.rcfg_get_bool.restype = I
-        lib.rcfg_get_bool.argtypes = [P, C, I]
-        lib.rcfg_get_str.restype = L
-        lib.rcfg_get_str.argtypes = [P, C, ctypes.c_char_p, L]
-        lib.rcfg_set.restype = I
-        lib.rcfg_set.argtypes = [P, C, C]
-        lib.rcfg_dump.restype = L
-        lib.rcfg_dump.argtypes = [P, ctypes.c_char_p, L]
-        _lib = lib
-        return _lib
+    from ray_tpu._private.native_build import load_library_cached
+    return load_library_cached("config", configure=_configure)
+
+
+def _configure(lib) -> None:
+    P, I, L, D, C = (ctypes.c_void_p, ctypes.c_int, ctypes.c_int64,
+                     ctypes.c_double, ctypes.c_char_p)
+    lib.rcfg_create.restype = P
+    lib.rcfg_create.argtypes = [C]
+    lib.rcfg_destroy.argtypes = [P]
+    lib.rcfg_has.restype = I
+    lib.rcfg_has.argtypes = [P, C, ctypes.POINTER(I)]
+    lib.rcfg_get_int.restype = L
+    lib.rcfg_get_int.argtypes = [P, C, L]
+    lib.rcfg_get_double.restype = D
+    lib.rcfg_get_double.argtypes = [P, C, D]
+    lib.rcfg_get_bool.restype = I
+    lib.rcfg_get_bool.argtypes = [P, C, I]
+    lib.rcfg_get_str.restype = L
+    lib.rcfg_get_str.argtypes = [P, C, ctypes.c_char_p, L]
+    lib.rcfg_set.restype = I
+    lib.rcfg_set.argtypes = [P, C, C]
+    lib.rcfg_dump.restype = L
+    lib.rcfg_dump.argtypes = [P, ctypes.c_char_p, L]
 
 
 def native_config_available() -> bool:

@@ -23,48 +23,39 @@ from typing import Dict, List, Optional, Set
 
 from ray_tpu._private.ids import ObjectID
 
-_lib = None
-_lib_failed = False
-_lib_lock = threading.Lock()
-
-
 def _load():
-    global _lib, _lib_failed
-    with _lib_lock:
-        if _lib is not None or _lib_failed:
-            return _lib
-        from ray_tpu._private.native_build import load_library
-        lib = load_library("refcount")
-        if lib is None:
-            _lib_failed = True
-            return None
-        P, I, L, C = (ctypes.c_void_p, ctypes.c_int, ctypes.c_int64,
-                      ctypes.c_char_p)
-        lib.rrc_create.restype = P
-        lib.rrc_destroy.argtypes = [P]
-        lib.rrc_add_owned.argtypes = [P, C]
-        lib.rrc_add_local.argtypes = [P, C]
-        lib.rrc_remove_local.restype = L
-        lib.rrc_remove_local.argtypes = [P, C, ctypes.c_char_p, L]
-        lib.rrc_add_task_deps.argtypes = [P, C]
-        lib.rrc_remove_task_deps.restype = L
-        lib.rrc_remove_task_deps.argtypes = [P, C, ctypes.c_char_p, L]
-        lib.rrc_add_borrower.argtypes = [P, C, C]
-        lib.rrc_remove_borrower.restype = L
-        lib.rrc_remove_borrower.argtypes = [P, C, C, ctypes.c_char_p, L]
-        lib.rrc_add_contained.argtypes = [P, C, C]
-        lib.rrc_force_free.restype = L
-        lib.rrc_force_free.argtypes = [P, C, ctypes.c_char_p, L]
-        lib.rrc_has.restype = I
-        lib.rrc_has.argtypes = [P, C]
-        lib.rrc_local_count.restype = L
-        lib.rrc_local_count.argtypes = [P, C]
-        lib.rrc_num_tracked.restype = L
-        lib.rrc_num_tracked.argtypes = [P]
-        lib.rrc_dump.restype = L
-        lib.rrc_dump.argtypes = [P, ctypes.c_char_p, L]
-        _lib = lib
-        return _lib
+    from ray_tpu._private.native_build import load_library_cached
+    return load_library_cached("refcount", configure=_configure)
+
+
+def _configure(lib) -> None:
+    P, I, L, C = (ctypes.c_void_p, ctypes.c_int, ctypes.c_int64,
+                  ctypes.c_char_p)
+    lib.rrc_create.restype = P
+    lib.rrc_destroy.argtypes = [P]
+    lib.rrc_add_owned.argtypes = [P, C]
+    lib.rrc_add_local.argtypes = [P, C]
+    lib.rrc_remove_local.restype = L
+    lib.rrc_remove_local.argtypes = [P, C, ctypes.c_char_p, L]
+    lib.rrc_add_task_deps.argtypes = [P, C]
+    lib.rrc_remove_task_deps.restype = L
+    lib.rrc_remove_task_deps.argtypes = [P, C, ctypes.c_char_p, L]
+    lib.rrc_add_borrower.argtypes = [P, C, C]
+    lib.rrc_remove_borrower.restype = L
+    lib.rrc_remove_borrower.argtypes = [P, C, C, ctypes.c_char_p, L]
+    lib.rrc_add_contained.argtypes = [P, C, C]
+    lib.rrc_force_free.restype = L
+    lib.rrc_force_free.argtypes = [P, C, ctypes.c_char_p, L]
+    lib.rrc_last_freed.restype = L
+    lib.rrc_last_freed.argtypes = [P, ctypes.c_char_p, L]
+    lib.rrc_has.restype = I
+    lib.rrc_has.argtypes = [P, C]
+    lib.rrc_local_count.restype = L
+    lib.rrc_local_count.argtypes = [P, C]
+    lib.rrc_num_tracked.restype = L
+    lib.rrc_num_tracked.argtypes = [P]
+    lib.rrc_dump.restype = L
+    lib.rrc_dump.argtypes = [P, ctypes.c_char_p, L]
 
 
 def native_refcount_available() -> bool:
@@ -79,6 +70,9 @@ class NativeReferenceCounter:
     def __init__(self):
         self._lib = _load()
         self._h = self._lib.rrc_create()
+        # Serializes freeing mutations with their possible last_freed
+        # re-read — a concurrent mutation would overwrite the stash.
+        self._free_lock = threading.Lock()
 
     def __del__(self):
         try:
@@ -91,16 +85,21 @@ class NativeReferenceCounter:
         return ";".join(o.hex() for o in oids).encode()
 
     def _call_freeing(self, fn, *args) -> List[ObjectID]:
+        """Run a freeing mutation once; if the result overflowed the buffer,
+        re-read it via the read-only rrc_last_freed stash (never retry the
+        mutation — it would double-apply the decrement)."""
         cap = 4096
-        while True:
+        with self._free_lock:
             buf = ctypes.create_string_buffer(cap)
             n = fn(self._h, *args, buf, cap)
-            if n < cap:
-                raw = buf.value.decode()
-                if not raw:
-                    return []
-                return [ObjectID.from_hex(tok) for tok in raw.split(";")]
-            cap = n + 1
+            while n >= cap:
+                cap = n + 1
+                buf = ctypes.create_string_buffer(cap)
+                n = self._lib.rrc_last_freed(self._h, buf, cap)
+        raw = buf.value.decode()
+        if not raw:
+            return []
+        return [ObjectID.from_hex(tok) for tok in raw.split(";")]
 
     def add_owned(self, oid: ObjectID) -> None:
         self._lib.rrc_add_owned(self._h, oid.hex().encode())

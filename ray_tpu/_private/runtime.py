@@ -355,11 +355,11 @@ class Runtime:
     def on_ref_deleted(self, oid: ObjectID) -> None:
         """An ObjectRef handle was garbage collected. Runs inside __del__,
         which can fire at ANY allocation (cyclic GC) — including while this
-        very thread holds the store lock or the reference counter's own
-        lock. So: strictly lock-free here (deque.append is atomic); the GC
-        thread performs the counter decrement and the freeing."""
+        very thread holds the store lock, the reference counter's lock, or
+        even the GC event's internal (non-reentrant) condition lock. So:
+        strictly lock-free here — deque.append only; the GC thread's timed
+        poll (gc_sweep_interval_ms) picks the oid up."""
         self._gc_queue.append(oid)
-        self._gc_event.set()
 
     def _gc_loop(self) -> None:
         while True:
@@ -1332,19 +1332,25 @@ class Runtime:
         state = self.scheduler.remove_node(node_id)
         if state is None:
             return
-        # 1) In-flight tasks on the dead node.
+        # 1) In-flight tasks on the dead node. A task whose results are
+        # already sealed has effectively completed — its worker thread just
+        # hasn't deregistered yet; retrying it would double-execute (the
+        # lost-copy case is _recover_lost_objects' job, which re-runs from
+        # lineage exactly once).
         with self._lock:
-            doomed = [s for s in self._inflight.values()
-                      if getattr(s, "_node_id", None) == node_id
-                      and s.kind != TaskKind.ACTOR_CREATION]
+            doomed = [
+                s for s in self._inflight.values()
+                if getattr(s, "_node_id", None) == node_id
+                and s.kind != TaskKind.ACTOR_CREATION
+                and not (s.return_ids and all(
+                    self.store.contains(oid) for oid in s.return_ids))]
         for spec in doomed:
             spec.invalidated = True
-            # The zombie spec will never reach _store_results/_store_error,
-            # so its dependency pins must be dropped here (the retry clone
-            # re-pins its own).
-            self._release_task_deps(spec)
             with self._lock:
                 self._inflight.pop(spec.task_id, None)
+            # _retry_after_node_death releases the zombie spec's dependency
+            # pins AFTER the retry clone re-pins them (releasing first could
+            # free the args the retry still needs).
             self._retry_after_node_death(spec, node_id)
         # 2) Actors homed on the dead node.
         with self._lock:
@@ -1375,13 +1381,18 @@ class Runtime:
             logger.warning("Node %s died; retrying task %s (attempt %d/%d)",
                            node_id.hex()[:12], spec.name,
                            retry.attempt_number, retry.max_retries)
+            # Pin the retry's deps BEFORE dropping the zombie's pins, so
+            # shared argument objects never hit zero in between.
             self._register_task_refs(retry)
+            self._release_task_deps(spec)
             self._resolve_dependencies(retry)
         else:
             # Seal the error directly (the spec stays invalidated so the
-            # zombie thread skips its own bookkeeping).
+            # zombie thread skips its own bookkeeping). Skip objects whose
+            # every handle is gone — sealing them would leak forever.
+            self._release_task_deps(spec)
             for oid in spec.return_ids:
-                self.store.put_inline(oid, err, is_exception=True)
+                self._store_if_referenced(oid, err, is_exception=True)
             self._record_event(spec, "FAILED")
 
     def _handle_actor_node_death(self, state: ActorState,
@@ -1427,8 +1438,8 @@ class Runtime:
         # original spec stays invalidated: if its __init__ is still running
         # on a zombie thread, that thread discards its work.
         state.creation_spec.invalidated = True
-        self._release_task_deps(state.creation_spec)
-        creation = state.creation_spec.clone_for_retry()
+        doomed_creation = state.creation_spec
+        creation = doomed_creation.clone_for_retry()
         with state.lock:
             state.creation_spec = creation
             state.resources_released = False
@@ -1437,6 +1448,7 @@ class Runtime:
                        state.name or state.actor_id.hex()[:8],
                        state.num_restarts)
         self._register_task_refs(creation)
+        self._release_task_deps(doomed_creation)
         with self._lock:
             self._ready.append(creation)
 

@@ -7,16 +7,28 @@ jitted functional step — pjit over a learner mesh is the multi-GPU-learner
 equivalent.
 """
 
+from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.impala import Impala, ImpalaConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.registry import get_algorithm_class
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.evaluation.rollout_worker import RolloutWorker
 from ray_tpu.rllib.evaluation.worker_set import WorkerSet
+from ray_tpu.rllib.models.catalog import ModelCatalog
+from ray_tpu.rllib.offline import JsonReader, JsonWriter
 from ray_tpu.rllib.policy.jax_policy import JAXPolicy, compute_gae
+from ray_tpu.rllib.policy.q_policy import QPolicy
+from ray_tpu.rllib.policy.sac_policy import SACPolicy
 from ray_tpu.rllib.policy.sample_batch import SampleBatch
 from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
                                                 ReplayBuffer)
 
-__all__ = ["Algorithm", "AlgorithmConfig", "JAXPolicy", "PPO", "PPOConfig",
+__all__ = ["A2C", "A2CConfig", "Algorithm", "AlgorithmConfig", "DQN",
+           "DQNConfig", "Impala", "ImpalaConfig", "JAXPolicy", "JsonReader",
+           "JsonWriter", "ModelCatalog", "PPO", "PPOConfig", "QPolicy",
            "PrioritizedReplayBuffer", "ReplayBuffer", "RolloutWorker",
-           "SampleBatch", "WorkerSet", "compute_gae"]
+           "SAC", "SACConfig", "SACPolicy", "SampleBatch", "WorkerSet",
+           "compute_gae", "get_algorithm_class"]

@@ -1,0 +1,96 @@
+"""A2C: synchronous advantage actor-critic.
+
+Analog of the reference's rllib/algorithms/a2c: the PPO machinery without
+the clipped surrogate — one vanilla policy-gradient + value + entropy
+update per sampled batch (single epoch, whole batch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or A2C)
+        self.lr = 1e-3
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.grad_clip = 40.0
+
+    def training(self, *, vf_loss_coeff=None, entropy_coeff=None,
+                 grad_clip=None, **kwargs) -> "A2CConfig":
+        super().training(**kwargs)
+        if vf_loss_coeff is not None:
+            self.vf_loss_coeff = vf_loss_coeff
+        if entropy_coeff is not None:
+            self.entropy_coeff = entropy_coeff
+        if grad_clip is not None:
+            self.grad_clip = grad_clip
+        return self
+
+
+class A2C(Algorithm):
+    _default_config_class = A2CConfig
+
+    def setup(self, config: A2CConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        policy = self.local_policy
+        self._optimizer = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip),
+            optax.adam(config.lr))
+        self._opt_state = self._optimizer.init(policy.params)
+        vf_coeff = config.vf_loss_coeff
+        ent_coeff = config.entropy_coeff
+
+        def loss_fn(params, mb):
+            logp = policy.logp(params, mb["obs"], mb["actions"])
+            pg_loss = -(logp * mb["advantages"]).mean()
+            values = policy._value(params, mb["obs"])
+            vf_loss = jnp.mean((values - mb["value_targets"]) ** 2)
+            entropy = jnp.mean(policy.entropy(params, mb["obs"]))
+            total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        def update(params, opt_state, mb):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        self._update_jit = jax.jit(update)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        import ray_tpu
+        config: A2CConfig = self.config
+        weights_ref = ray_tpu.put(self.get_weights())
+        self.workers.sync_weights(weights_ref)
+        per_worker = max(
+            config.train_batch_size // self.workers.num_workers(), 1)
+        batch = self.workers.sample(per_worker)
+        self._timesteps_total += len(batch)
+        adv = batch[SampleBatch.ADVANTAGES]
+        batch[SampleBatch.ADVANTAGES] = (
+            (adv - adv.mean()) / max(adv.std(), 1e-8)).astype(np.float32)
+        device_mb = {k: jnp.asarray(v) for k, v in batch.items()
+                     if k in ("obs", "actions", "advantages",
+                              "value_targets")}
+        params, self._opt_state, metrics = self._update_jit(
+            self.local_policy.params, self._opt_state, device_mb)
+        self.local_policy.params = params
+        return {k: float(v) for k, v in metrics.items()}

@@ -36,13 +36,12 @@ class Algorithm:
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         env_creator = config.env_creator()
+        self._env_creator = env_creator
         probe_env = env_creator({})
-        self.local_policy = JAXPolicy(
-            obs_dim=int(np.prod(probe_env.observation_space.shape)),
-            action_space=probe_env.action_space,
-            hiddens=tuple(config.fcnet_hiddens),
-            seed=config.seed,
-        )
+        from ray_tpu.rllib.policy import make_policy
+        self.local_policy = make_policy(
+            config.policy_config(), probe_env.observation_space,
+            probe_env.action_space, seed=config.seed)
         probe_env.close() if hasattr(probe_env, "close") else None
         self.workers = WorkerSet(
             env_creator, config.policy_config(),
@@ -71,7 +70,42 @@ class Algorithm:
             "timesteps_total": self._timesteps_total,
             "time_this_iter_s": time.monotonic() - t0,
         })
+        interval = getattr(self.config, "evaluation_interval", 0)
+        if interval and self.iteration % interval == 0:
+            results["evaluation"] = self.evaluate()
         return results
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Greedy evaluation episodes on a fresh local env (analog of the
+        reference's Algorithm.evaluate with an evaluation WorkerSet;
+        single-env here since the local policy is the learner copy)."""
+        duration = getattr(self.config, "evaluation_duration", 3)
+        env = self._env_creator(self.config.env_config)
+        from ray_tpu.rllib.connectors import get_connectors
+        obs_conn, act_conn = get_connectors(
+            self.config.policy_config(), env.observation_space,
+            env.action_space)
+        rewards, lengths = [], []
+        for ep in range(duration):
+            obs, _ = env.reset(seed=10_000 + ep)
+            total, steps, done = 0.0, 0, False
+            while not done and steps < 10_000:
+                action = self.compute_single_action(obs_conn(obs))
+                if act_conn.connectors:
+                    action = act_conn(action)
+                obs, reward, terminated, truncated, _ = env.step(action)
+                total += float(reward)
+                steps += 1
+                done = terminated or truncated
+            rewards.append(total)
+            lengths.append(steps)
+        if hasattr(env, "close"):
+            env.close()
+        return {
+            "episode_reward_mean": float(np.mean(rewards)),
+            "episode_len_mean": float(np.mean(lengths)),
+            "episodes_this_eval": duration,
+        }
 
     def training_step(self) -> Dict[str, Any]:
         raise NotImplementedError
@@ -84,14 +118,23 @@ class Algorithm:
 
     def compute_single_action(self, obs, explore: bool = False):
         import jax
-        obs = np.asarray(obs, np.float32).reshape(1, -1)
+        policy = self.local_policy
+        obs = np.asarray(obs, np.float32)[None]
         if explore:
             key = jax.random.PRNGKey(int(time.monotonic_ns()) % (2**31))
-            a, _, _ = self.local_policy.compute_actions(obs, key)
-            return a[0]
-        logits = self.local_policy.logits(
-            self.local_policy.params, obs)
-        if self.local_policy.discrete:
+            a, _, _ = policy.compute_actions(obs, key)
+            return a[0] if policy.discrete is False else int(a[0])
+        if hasattr(policy, "compute_greedy"):
+            return policy.compute_greedy(obs)
+        if hasattr(policy, "q_values"):  # value-based: greedy = argmax Q
+            q = policy.q_values(policy.params, obs)
+            return int(np.asarray(q).argmax(-1)[0])
+        if hasattr(policy, "dist_params"):  # SAC: mean action
+            mu, _ = policy.dist_params(policy.params, obs)
+            a = np.tanh(np.asarray(mu)[0])
+            return policy.low + (a + 1.0) * 0.5 * (policy.high - policy.low)
+        logits = policy.logits(policy.params, obs)
+        if policy.discrete:
             return int(np.asarray(logits).argmax(-1)[0])
         return np.asarray(logits)[0]
 

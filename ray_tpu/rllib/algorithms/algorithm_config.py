@@ -27,6 +27,18 @@ class AlgorithmConfig:
         self.seed: int = 0
         # framework (always jax here; kept for API parity)
         self.framework_str: str = "jax"
+        # policy implementation (rllib/policy/__init__.py registry)
+        self.policy_class_name: str = "actor_critic"
+        # preprocessing / connectors
+        self.observation_filter: str = "NoFilter"
+        self.clip_actions: bool = True
+        self.conv_filters = None
+        # offline data (reference: rllib/offline/)
+        self.output: Any = None  # dir path → rollout workers write JSON
+        self.input_: Any = None  # dir path → train from offline JSON
+        # evaluation
+        self.evaluation_interval: int = 0
+        self.evaluation_duration: int = 3
         # algo-specific fields live on subclass-free dicts
         self.extra: Dict[str, Any] = {}
 
@@ -83,7 +95,25 @@ class AlgorithmConfig:
             self.seed = seed
         return self
 
-    def evaluation(self, **_ignored) -> "AlgorithmConfig":
+    def evaluation(self, *, evaluation_interval: Optional[int] = None,
+                   evaluation_duration: Optional[int] = None,
+                   **_ignored) -> "AlgorithmConfig":
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_duration is not None:
+            self.evaluation_duration = evaluation_duration
+        return self
+
+    def offline_data(self, *, output=None, input_=None,
+                     **_ignored) -> "AlgorithmConfig":
+        if output is not None:
+            self.output = output
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+    def exploration(self, **kwargs) -> "AlgorithmConfig":
+        self.extra.update(kwargs)
         return self
 
     # -- build -----------------------------------------------------------
@@ -122,5 +152,10 @@ class AlgorithmConfig:
             "gamma": self.gamma,
             "lambda": self.extra.get("lambda", 0.95),
             "fcnet_hiddens": tuple(self.fcnet_hiddens),
+            "conv_filters": self.conv_filters,
             "env_config": self.env_config,
+            "policy_class": self.policy_class_name,
+            "observation_filter": self.observation_filter,
+            "clip_actions": self.clip_actions,
+            "output": self.output,
         }

@@ -1,0 +1,192 @@
+"""DQN: double deep Q-learning with target network and replay.
+
+Analog of the reference's rllib/algorithms/dqn: epsilon-greedy rollout
+workers feed a (optionally prioritized) replay buffer; the learner runs a
+jitted double-DQN update (online net picks argmax actions, target net
+scores them) with Huber loss, syncing the target every
+``target_network_update_freq`` gradient steps and annealing epsilon over
+``epsilon_timesteps``. Supports offline input (config.offline_data) — the
+buffer is filled from JSON files instead of rollouts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
+                                                ReplayBuffer)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or DQN)
+        self.policy_class_name = "q"
+        self.lr = 5e-4
+        self.train_batch_size = 32
+        self.replay_buffer_capacity = 50_000
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.target_network_update_freq = 500  # gradient steps
+        self.num_train_batches_per_iteration = 32
+        self.double_q = True
+        self.prioritized_replay = False
+        self.prioritized_replay_alpha = 0.6
+        self.prioritized_replay_beta = 0.4
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.02
+        self.epsilon_timesteps = 10_000
+        self.tau = 1.0  # hard target sync by default
+
+    def training(self, *, replay_buffer_capacity=None,
+                 target_network_update_freq=None, double_q=None,
+                 prioritized_replay=None, epsilon_timesteps=None,
+                 epsilon_final=None, num_train_batches_per_iteration=None,
+                 num_steps_sampled_before_learning_starts=None,
+                 tau=None, **kwargs) -> "DQNConfig":
+        super().training(**kwargs)
+        for name, val in (
+                ("replay_buffer_capacity", replay_buffer_capacity),
+                ("target_network_update_freq", target_network_update_freq),
+                ("double_q", double_q),
+                ("prioritized_replay", prioritized_replay),
+                ("epsilon_timesteps", epsilon_timesteps),
+                ("epsilon_final", epsilon_final),
+                ("num_train_batches_per_iteration",
+                 num_train_batches_per_iteration),
+                ("num_steps_sampled_before_learning_starts",
+                 num_steps_sampled_before_learning_starts),
+                ("tau", tau)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class DQN(Algorithm):
+    _default_config_class = DQNConfig
+
+    def setup(self, config: DQNConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        policy = self.local_policy
+        self._optimizer = optax.adam(config.lr)
+        self._opt_state = self._optimizer.init(policy.params)
+        self._target_params = jax.tree.map(jnp.asarray, policy.params)
+        if config.prioritized_replay:
+            self._buffer: ReplayBuffer = PrioritizedReplayBuffer(
+                config.replay_buffer_capacity,
+                alpha=config.prioritized_replay_alpha, seed=config.seed)
+        else:
+            self._buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                        seed=config.seed)
+        self._grad_steps = 0
+        self._reader = None
+        if config.input_:
+            from ray_tpu.rllib.offline.json_reader import JsonReader
+            self._reader = JsonReader(config.input_)
+        gamma = config.gamma
+        double_q = config.double_q
+        tau = config.tau
+
+        def loss_fn(params, target_params, mb):
+            q_all = policy.q_values(params, mb["obs"])
+            q_taken = jnp.take_along_axis(
+                q_all, mb["actions"][..., None].astype(jnp.int32),
+                -1)[..., 0]
+            q_next_target = policy.q_values(target_params, mb["new_obs"])
+            if double_q:
+                a_star = policy.q_values(params, mb["new_obs"]).argmax(-1)
+                q_next = jnp.take_along_axis(
+                    q_next_target, a_star[..., None], -1)[..., 0]
+            else:
+                q_next = q_next_target.max(-1)
+            done = jnp.maximum(mb["terminateds"], 0.0)
+            target = mb["rewards"] + gamma * (1.0 - done) * q_next
+            td = q_taken - jax.lax.stop_gradient(target)
+            huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                              jnp.abs(td) - 0.5)
+            weights = mb.get("weights", jnp.ones_like(td))
+            return (weights * huber).mean(), td
+
+        def update(params, target_params, opt_state, mb):
+            (loss, td), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, mb)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        def soft_sync(params, target_params):
+            return jax.tree.map(lambda p, t: tau * p + (1 - tau) * t,
+                                params, target_params)
+
+        self._update_jit = jax.jit(update)
+        self._soft_sync_jit = jax.jit(soft_sync)
+
+    def _epsilon(self) -> float:
+        config: DQNConfig = self.config
+        frac = min(1.0, self._timesteps_total /
+                   max(config.epsilon_timesteps, 1))
+        return config.epsilon_initial + frac * (
+            config.epsilon_final - config.epsilon_initial)
+
+    def get_weights(self):
+        weights = self.local_policy.get_weights()  # {"params", "epsilon"}
+        weights["epsilon"] = self._epsilon()
+        return weights
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        import ray_tpu
+        config: DQNConfig = self.config
+        if self._reader is not None:
+            batch = SampleBatch.concat_samples(
+                [self._reader.next()
+                 for _ in range(config.num_train_batches_per_iteration)])
+        else:
+            weights_ref = ray_tpu.put(self.get_weights())
+            self.workers.sync_weights(weights_ref)
+            per_worker = max(
+                config.rollout_fragment_length, 1)
+            batch = self.workers.sample(per_worker)
+        self._timesteps_total += len(batch)
+        self._buffer.add(batch)
+
+        losses = []
+        if len(self._buffer) >= max(
+                config.num_steps_sampled_before_learning_starts,
+                config.train_batch_size):
+            params = self.local_policy.params
+            for _ in range(config.num_train_batches_per_iteration):
+                if config.prioritized_replay:
+                    mb = self._buffer.sample(
+                        config.train_batch_size,
+                        beta=config.prioritized_replay_beta)
+                else:
+                    mb = self._buffer.sample(config.train_batch_size)
+                device_mb = {k: jnp.asarray(v) for k, v in mb.items()
+                             if k in ("obs", "new_obs", "actions", "rewards",
+                                      "terminateds", "weights")}
+                params, self._opt_state, loss, td = self._update_jit(
+                    params, self._target_params, self._opt_state, device_mb)
+                losses.append(float(loss))
+                self._grad_steps += 1
+                if config.prioritized_replay:
+                    self._buffer.update_priorities(
+                        mb["batch_indexes"], np.asarray(td))
+                if self._grad_steps % config.target_network_update_freq == 0:
+                    self._target_params = self._soft_sync_jit(
+                        params, self._target_params)
+            self.local_policy.params = params
+        return {
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "epsilon": self._epsilon(),
+            "replay_buffer_size": len(self._buffer),
+            "gradient_steps_total": self._grad_steps,
+        }

@@ -1,0 +1,144 @@
+"""IMPALA: importance-weighted actor-learner with V-trace corrections.
+
+Analog of the reference's rllib/algorithms/impala: rollout workers sample
+with (slightly) stale weights; the learner corrects the off-policyness with
+V-trace (Espeholt et al. 2018) — clipped importance ratios rho/c reweight
+the TD errors into corrected value targets ``vs`` and policy-gradient
+advantages. The per-fragment V-trace recursion runs on host numpy (tiny),
+the gradient update is one jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+def vtrace(behavior_logp: np.ndarray, target_logp: np.ndarray,
+           rewards: np.ndarray, values: np.ndarray, bootstrap: float,
+           gamma: float, rho_clip: float = 1.0, c_clip: float = 1.0):
+    """Single-fragment V-trace: returns (vs, pg_advantages)."""
+    T = len(rewards)
+    rho = np.minimum(np.exp(target_logp - behavior_logp), rho_clip)
+    c = np.minimum(np.exp(target_logp - behavior_logp), c_clip)
+    values_tp1 = np.append(values[1:], bootstrap)
+    deltas = rho * (rewards + gamma * values_tp1 - values)
+    acc = 0.0
+    vs_minus_v = np.zeros(T, np.float32)
+    for t in range(T - 1, -1, -1):
+        acc = deltas[t] + gamma * c[t] * acc
+        vs_minus_v[t] = acc
+    vs = vs_minus_v + values
+    vs_tp1 = np.append(vs[1:], bootstrap)
+    pg_adv = rho * (rewards + gamma * vs_tp1 - values)
+    return vs.astype(np.float32), pg_adv.astype(np.float32)
+
+
+class ImpalaConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or Impala)
+        self.lr = 6e-4
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.vtrace_rho_clip = 1.0
+        self.vtrace_c_clip = 1.0
+
+    def training(self, *, vf_loss_coeff=None, entropy_coeff=None,
+                 vtrace_rho_clip=None, vtrace_c_clip=None,
+                 **kwargs) -> "ImpalaConfig":
+        super().training(**kwargs)
+        for name, val in (("vf_loss_coeff", vf_loss_coeff),
+                          ("entropy_coeff", entropy_coeff),
+                          ("vtrace_rho_clip", vtrace_rho_clip),
+                          ("vtrace_c_clip", vtrace_c_clip)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class Impala(Algorithm):
+    _default_config_class = ImpalaConfig
+
+    def setup(self, config: ImpalaConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        policy = self.local_policy
+        self._optimizer = optax.adam(config.lr)
+        self._opt_state = self._optimizer.init(policy.params)
+        vf_coeff = config.vf_loss_coeff
+        ent_coeff = config.entropy_coeff
+
+        def loss_fn(params, mb):
+            logp = policy.logp(params, mb["obs"], mb["actions"])
+            pg_loss = -(logp * mb["pg_advantages"]).mean()
+            values = policy._value(params, mb["obs"])
+            vf_loss = jnp.mean((values - mb["vs"]) ** 2)
+            entropy = jnp.mean(policy.entropy(params, mb["obs"]))
+            total = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy}
+
+        def update(params, opt_state, mb):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        self._update_jit = jax.jit(update)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        import ray_tpu
+        config: ImpalaConfig = self.config
+        weights_ref = ray_tpu.put(self.get_weights())
+        self.workers.sync_weights(weights_ref)
+        per_worker = max(
+            config.train_batch_size // self.workers.num_workers(), 1)
+        batch = self.workers.sample(per_worker)
+        self._timesteps_total += len(batch)
+
+        # V-trace per episode fragment against CURRENT params.
+        policy = self.local_policy
+        obs = np.asarray(batch[SampleBatch.OBS], np.float32)
+        target_logp = np.asarray(policy.logp(
+            policy.params, jnp.asarray(obs),
+            jnp.asarray(batch[SampleBatch.ACTIONS])))
+        values = np.asarray(policy._value(policy.params, jnp.asarray(obs)))
+        vs_all: List[np.ndarray] = []
+        adv_all: List[np.ndarray] = []
+        start = 0
+        for frag in batch.split_by_episode():
+            n = len(frag)
+            terminated = frag[SampleBatch.TERMINATEDS][-1] > 0
+            bootstrap = 0.0 if terminated else float(
+                values[start + n - 1])
+            vs, adv = vtrace(
+                np.asarray(frag[SampleBatch.ACTION_LOGP], np.float32),
+                target_logp[start:start + n],
+                np.asarray(frag[SampleBatch.REWARDS], np.float32),
+                values[start:start + n], bootstrap, config.gamma,
+                config.vtrace_rho_clip, config.vtrace_c_clip)
+            vs_all.append(vs)
+            adv_all.append(adv)
+            start += n
+        device_mb = {
+            "obs": jnp.asarray(obs),
+            "actions": jnp.asarray(batch[SampleBatch.ACTIONS]),
+            "vs": jnp.asarray(np.concatenate(vs_all)),
+            "pg_advantages": jnp.asarray(np.concatenate(adv_all)),
+        }
+        params, self._opt_state, metrics = self._update_jit(
+            policy.params, self._opt_state, device_mb)
+        policy.params = params
+        return {k: float(v) for k, v in metrics.items()}

@@ -1,0 +1,23 @@
+"""Algorithm registry (reference: rllib/algorithms/registry.py):
+name → (Algorithm class, default config)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Type
+
+
+def get_algorithm_class(name: str) -> Type:
+    from ray_tpu.rllib.algorithms.a2c import A2C
+    from ray_tpu.rllib.algorithms.dqn import DQN
+    from ray_tpu.rllib.algorithms.impala import Impala
+    from ray_tpu.rllib.algorithms.ppo import PPO
+    from ray_tpu.rllib.algorithms.sac import SAC
+
+    table = {"PPO": PPO, "DQN": DQN, "SAC": SAC, "A2C": A2C,
+             "IMPALA": Impala}
+    try:
+        return table[name.upper()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown algorithm {name!r}; available: {sorted(table)}"
+        ) from None

@@ -1,0 +1,194 @@
+"""SAC: soft actor-critic with twin Q critics and auto-tuned temperature.
+
+Analog of the reference's rllib/algorithms/sac: off-policy maximum-entropy
+RL for continuous control. The learner holds twin Q networks + polyak
+targets and a log-temperature tuned toward the -|A| target entropy; the
+squashed-Gaussian actor (policy/sac_policy.py) samples on the rollout
+workers. All three updates fuse into one jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or SAC)
+        self.policy_class_name = "sac"
+        self.lr = 3e-4
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.train_batch_size = 256
+        self.replay_buffer_capacity = 100_000
+        self.num_steps_sampled_before_learning_starts = 500
+        self.num_train_batches_per_iteration = 32
+        self.tau = 0.005
+        self.initial_alpha = 0.1
+        self.target_entropy: Any = "auto"
+
+    def training(self, *, tau=None, critic_lr=None, alpha_lr=None,
+                 initial_alpha=None, target_entropy=None,
+                 replay_buffer_capacity=None,
+                 num_train_batches_per_iteration=None,
+                 num_steps_sampled_before_learning_starts=None,
+                 **kwargs) -> "SACConfig":
+        super().training(**kwargs)
+        for name, val in (("tau", tau), ("critic_lr", critic_lr),
+                          ("alpha_lr", alpha_lr),
+                          ("initial_alpha", initial_alpha),
+                          ("target_entropy", target_entropy),
+                          ("replay_buffer_capacity", replay_buffer_capacity),
+                          ("num_train_batches_per_iteration",
+                           num_train_batches_per_iteration),
+                          ("num_steps_sampled_before_learning_starts",
+                           num_steps_sampled_before_learning_starts)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class SAC(Algorithm):
+    _default_config_class = SACConfig
+
+    def setup(self, config: SACConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models.catalog import mlp_apply, mlp_init
+
+        policy = self.local_policy
+        act_dim = policy.act_dim
+
+        # Twin Q networks over [obs, action] (flat obs only).
+        def q_apply(qparams, obs, act):
+            x = jnp.concatenate(
+                [obs.reshape((obs.shape[0], -1)), act], axis=-1)
+            return mlp_apply(qparams, x)[..., 0]
+
+        key = jax.random.PRNGKey(config.seed + 7)
+        k1, k2 = jax.random.split(key)
+        probe = self._env_creator(config.env_config)
+        q_in = int(np.prod(probe.observation_space.shape)) + act_dim
+        probe.close() if hasattr(probe, "close") else None
+        hiddens = list(config.fcnet_hiddens) + [1]
+        self._q_params = {
+            "q1": mlp_init(k1, [q_in, *hiddens]),
+            "q2": mlp_init(k2, [q_in, *hiddens]),
+        }
+        self._q_target = jax.tree.map(jnp.asarray, self._q_params)
+        self._log_alpha = jnp.asarray(np.log(config.initial_alpha))
+        self._actor_opt = optax.adam(config.lr)
+        self._critic_opt = optax.adam(config.critic_lr)
+        self._alpha_opt = optax.adam(config.alpha_lr)
+        self._actor_state = self._actor_opt.init(policy.params)
+        self._critic_state = self._critic_opt.init(self._q_params)
+        self._alpha_state = self._alpha_opt.init(self._log_alpha)
+        self._buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                    seed=config.seed)
+        target_entropy = (-float(act_dim)
+                          if config.target_entropy == "auto"
+                          else float(config.target_entropy))
+        gamma, tau = config.gamma, config.tau
+
+        def critic_loss(q_params, q_target, actor_params, log_alpha, mb,
+                        key):
+            next_a, next_logp = policy.logp_and_sample(
+                actor_params, mb["new_obs"], key)
+            q1_t = q_apply(q_target["q1"], mb["new_obs"], next_a)
+            q2_t = q_apply(q_target["q2"], mb["new_obs"], next_a)
+            alpha = jnp.exp(log_alpha)
+            q_next = jnp.minimum(q1_t, q2_t) - alpha * next_logp
+            done = mb["terminateds"]
+            target = mb["rewards"] + gamma * (1 - done) * q_next
+            target = jax.lax.stop_gradient(target)
+            q1 = q_apply(q_params["q1"], mb["obs"], mb["actions"])
+            q2 = q_apply(q_params["q2"], mb["obs"], mb["actions"])
+            return ((q1 - target) ** 2 + (q2 - target) ** 2).mean()
+
+        def actor_loss(actor_params, q_params, log_alpha, mb, key):
+            a, logp = policy.logp_and_sample(actor_params, mb["obs"], key)
+            q1 = q_apply(q_params["q1"], mb["obs"], a)
+            q2 = q_apply(q_params["q2"], mb["obs"], a)
+            q = jnp.minimum(q1, q2)
+            alpha = jax.lax.stop_gradient(jnp.exp(log_alpha))
+            return (alpha * logp - q).mean(), logp
+
+        def alpha_loss(log_alpha, logp):
+            return (-log_alpha * jax.lax.stop_gradient(
+                logp + target_entropy)).mean()
+
+        def update(actor_params, q_params, q_target, log_alpha,
+                   actor_state, critic_state, alpha_state, mb, key):
+            k1, k2 = jax.random.split(key)
+            c_loss, c_grads = jax.value_and_grad(critic_loss)(
+                q_params, q_target, actor_params, log_alpha, mb, k1)
+            c_updates, critic_state = self._critic_opt.update(
+                c_grads, critic_state, q_params)
+            q_params = optax.apply_updates(q_params, c_updates)
+
+            (a_loss, logp), a_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(actor_params, q_params,
+                                          log_alpha, mb, k2)
+            a_updates, actor_state = self._actor_opt.update(
+                a_grads, actor_state, actor_params)
+            actor_params = optax.apply_updates(actor_params, a_updates)
+
+            al_loss, al_grad = jax.value_and_grad(alpha_loss)(
+                log_alpha, logp)
+            al_update, alpha_state = self._alpha_opt.update(
+                al_grad, alpha_state, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, al_update)
+
+            q_target = jax.tree.map(
+                lambda p, t: tau * p + (1 - tau) * t, q_params, q_target)
+            metrics = {"critic_loss": c_loss, "actor_loss": a_loss,
+                       "alpha_loss": al_loss,
+                       "alpha": jnp.exp(log_alpha),
+                       "entropy": -logp.mean()}
+            return (actor_params, q_params, q_target, log_alpha,
+                    actor_state, critic_state, alpha_state, metrics)
+
+        self._update_jit = jax.jit(update)
+        self._key = jax.random.PRNGKey(config.seed + 99)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        import ray_tpu
+        config: SACConfig = self.config
+        weights_ref = ray_tpu.put(self.get_weights())
+        self.workers.sync_weights(weights_ref)
+        batch = self.workers.sample(max(config.rollout_fragment_length, 1))
+        self._timesteps_total += len(batch)
+        self._buffer.add(batch)
+        metrics_out: Dict[str, Any] = {}
+        if len(self._buffer) >= max(
+                config.num_steps_sampled_before_learning_starts,
+                config.train_batch_size):
+            actor_params = self.local_policy.params
+            for _ in range(config.num_train_batches_per_iteration):
+                mb = self._buffer.sample(config.train_batch_size)
+                device_mb = {k: jnp.asarray(v) for k, v in mb.items()
+                             if k in ("obs", "new_obs", "actions",
+                                      "rewards", "terminateds")}
+                self._key, sub = jax.random.split(self._key)
+                (actor_params, self._q_params, self._q_target,
+                 self._log_alpha, self._actor_state, self._critic_state,
+                 self._alpha_state, metrics) = self._update_jit(
+                    actor_params, self._q_params, self._q_target,
+                    self._log_alpha, self._actor_state, self._critic_state,
+                    self._alpha_state, device_mb, sub)
+            self.local_policy.params = actor_params
+            metrics_out = {k: float(v) for k, v in metrics.items()}
+        metrics_out["replay_buffer_size"] = len(self._buffer)
+        return metrics_out

@@ -1,0 +1,9 @@
+from ray_tpu.rllib.connectors.connector import (ActionConnectorPipeline,
+                                                ClipActions, Connector,
+                                                FlattenObs, MeanStdFilter,
+                                                ObsConnectorPipeline,
+                                                get_connectors)
+
+__all__ = ["ActionConnectorPipeline", "ClipActions", "Connector",
+           "FlattenObs", "MeanStdFilter", "ObsConnectorPipeline",
+           "get_connectors"]

@@ -2,9 +2,12 @@
 
 Analog of the reference's rllib/evaluation/rollout_worker.py:165 (sample
 :878): owns env instances + a policy copy, steps them for
-rollout_fragment_length, postprocesses with GAE, returns a SampleBatch.
-Created as actors by WorkerSet; weights sync via set_weights before every
-sampling round.
+rollout_fragment_length, postprocesses (GAE for actor-critic policies; raw
+transitions for off-policy ones), returns a SampleBatch. Created as actors
+by WorkerSet; weights sync via set_weights before every sampling round.
+Observations/actions pass through connector pipelines
+(rllib/connectors/connector.py), and sampled batches can be mirrored to
+offline JSON output (rllib/offline/json_writer.py).
 """
 
 from __future__ import annotations
@@ -13,7 +16,9 @@ from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
-from ray_tpu.rllib.policy.jax_policy import JAXPolicy, compute_gae
+from ray_tpu.rllib.connectors import get_connectors
+from ray_tpu.rllib.policy import make_policy
+from ray_tpu.rllib.policy.jax_policy import compute_gae
 from ray_tpu.rllib.policy.sample_batch import SampleBatch
 
 
@@ -28,12 +33,11 @@ class RolloutWorker:
         import jax
         self.env = _make_env(env_creator, policy_config.get("env_config"))
         obs_space = self.env.observation_space
-        self.policy = JAXPolicy(
-            obs_dim=int(np.prod(obs_space.shape)),
-            action_space=self.env.action_space,
-            hiddens=policy_config.get("fcnet_hiddens", (64, 64)),
-            seed=seed + worker_index,
-        )
+        self.policy = make_policy(policy_config, obs_space,
+                                  self.env.action_space,
+                                  seed=seed + worker_index)
+        self.obs_connectors, self.action_connectors = get_connectors(
+            policy_config, obs_space, self.env.action_space)
         self.gamma = policy_config.get("gamma", 0.99)
         self.lam = policy_config.get("lambda", 0.95)
         self.worker_index = worker_index
@@ -44,6 +48,11 @@ class RolloutWorker:
         self._episode_len = 0
         self.completed_rewards: list = []
         self.completed_lengths: list = []
+        self._writer = None
+        output_dir = policy_config.get("output")
+        if output_dir:
+            from ray_tpu.rllib.offline.json_writer import JsonWriter
+            self._writer = JsonWriter(output_dir, worker_index=worker_index)
 
     def set_weights(self, weights) -> bool:
         self.policy.set_weights(weights)
@@ -55,20 +64,27 @@ class RolloutWorker:
     def sample(self, num_steps: int) -> SampleBatch:
         import jax
         rows = {k: [] for k in (
-            SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.REWARDS,
-            SampleBatch.TERMINATEDS, SampleBatch.TRUNCATEDS,
-            SampleBatch.ACTION_LOGP, SampleBatch.VF_PREDS,
-            SampleBatch.EPS_ID)}
+            SampleBatch.OBS, SampleBatch.NEXT_OBS, SampleBatch.ACTIONS,
+            SampleBatch.REWARDS, SampleBatch.TERMINATEDS,
+            SampleBatch.TRUNCATEDS, SampleBatch.ACTION_LOGP,
+            SampleBatch.VF_PREDS, SampleBatch.EPS_ID)}
         for _ in range(num_steps):
-            obs = np.asarray(self._obs, np.float32).reshape(-1)
+            obs = np.asarray(self.obs_connectors(self._obs))
             self._key, sub = jax.random.split(self._key)
             action, logp, value = self.policy.compute_actions(
                 obs[None], sub)
-            act_env = (int(action[0]) if self.policy.discrete
-                       else np.asarray(action[0]))
+            act = action[0]
+            act_env = int(act) if self.policy.discrete else np.asarray(act)
+            if self.action_connectors.connectors:
+                act_env = self.action_connectors(act_env)
             nxt, reward, terminated, truncated, _ = self.env.step(act_env)
+            # NEXT_OBS passes the pipeline read-only: it must see the same
+            # normalization as OBS, but stateful filters only consume each
+            # frame once (at its OBS position next iteration).
             rows[SampleBatch.OBS].append(obs)
-            rows[SampleBatch.ACTIONS].append(action[0])
+            rows[SampleBatch.NEXT_OBS].append(
+                np.asarray(self.obs_connectors.apply_readonly(nxt)))
+            rows[SampleBatch.ACTIONS].append(act)
             rows[SampleBatch.REWARDS].append(np.float32(reward))
             rows[SampleBatch.TERMINATEDS].append(np.float32(terminated))
             rows[SampleBatch.TRUNCATEDS].append(np.float32(truncated))
@@ -86,7 +102,14 @@ class RolloutWorker:
                 self._obs, _ = self.env.reset()
             else:
                 self._obs = nxt
-        batch = SampleBatch(rows)
+        batch = self._postprocess(SampleBatch(rows))
+        if self._writer is not None:
+            self._writer.write(batch)
+        return batch
+
+    def _postprocess(self, batch: SampleBatch) -> SampleBatch:
+        if not getattr(self.policy, "needs_gae", True):
+            return batch
         # GAE per episode fragment; bootstrap truncated/continuing tails.
         fragments = []
         for frag in batch.split_by_episode():
@@ -94,7 +117,8 @@ class RolloutWorker:
             if last_terminated:
                 last_value = 0.0
             else:
-                bootstrap_obs = np.asarray(self._obs, np.float32).reshape(-1)
+                bootstrap_obs = np.asarray(
+                    self.obs_connectors.apply_readonly(self._obs))
                 last_value = float(self.policy.compute_values(
                     bootstrap_obs[None])[0])
             fragments.append(compute_gae(frag, self.gamma, self.lam,

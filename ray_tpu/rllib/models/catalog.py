@@ -1,0 +1,112 @@
+"""ModelCatalog: observation encoders from model config.
+
+Analog of the reference's rllib/models/catalog.py: maps (observation
+space, model config) to a network. Two encoder families, both pure
+pytree-params + functional apply so policies jit them:
+
+* MLP (``fcnet_hiddens``) for flat observations;
+* CNN (``conv_filters`` [[out_channels, kernel, stride], ...]) for image
+  observations (rank-3 HWC), lowered to ``lax.conv_general_dilated`` —
+  XLA tiles these onto the MXU on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_CONV_FILTERS = [[16, 4, 2], [32, 4, 2], [64, 3, 1]]
+
+
+def mlp_init(key, sizes: Sequence[int]):
+    params = []
+    for i in range(len(sizes) - 1):
+        key, k1 = jax.random.split(key)
+        w = jax.random.normal(k1, (sizes[i], sizes[i + 1])) * jnp.sqrt(
+            2.0 / sizes[i])
+        params.append({"w": w, "b": jnp.zeros((sizes[i + 1],))})
+    return params
+
+
+def mlp_apply(params, x, activate_last=False):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1 or activate_last:
+            x = jnp.tanh(x)
+    return x
+
+
+class ModelCatalog:
+    """Builds (init_fn, apply_fn, feature_dim) encoders."""
+
+    @staticmethod
+    def is_image_space(obs_space) -> bool:
+        shape = getattr(obs_space, "shape", None)
+        return shape is not None and len(shape) == 3
+
+    @staticmethod
+    def get_encoder(obs_space, model_config: Dict[str, Any]
+                    ) -> Tuple[Callable, Callable, int]:
+        """Returns (init(key) -> params, apply(params, obs) -> [B, F],
+        feature_dim). ``obs`` enters flattened for MLP, HWC for CNN."""
+        if ModelCatalog.is_image_space(obs_space):
+            return ModelCatalog._cnn_encoder(obs_space, model_config)
+        obs_dim = int(np.prod(obs_space.shape))
+        hiddens = tuple(model_config.get("fcnet_hiddens", (64, 64)))
+        sizes = [obs_dim, *hiddens]
+
+        def init(key):
+            return {"mlp": mlp_init(key, sizes)}
+
+        def apply(params, obs):
+            obs = obs.reshape((obs.shape[0], -1))
+            return mlp_apply(params["mlp"], obs, activate_last=True)
+
+        return init, apply, hiddens[-1] if hiddens else obs_dim
+
+    @staticmethod
+    def _cnn_encoder(obs_space, model_config):
+        h, w, c = obs_space.shape
+        filters = model_config.get("conv_filters") or DEFAULT_CONV_FILTERS
+        head_dim = int(model_config.get("post_fcnet_dim", 256))
+
+        # Compute output spatial dims (SAME padding, strided).
+        shapes = []
+        ch, hh, ww = c, h, w
+        for out_ch, k, s in filters:
+            hh = -(-hh // s)
+            ww = -(-ww // s)
+            shapes.append((ch, out_ch, k))
+            ch = out_ch
+        flat_dim = hh * ww * ch
+
+        def init(key):
+            convs = []
+            for in_ch, out_ch, k in shapes:
+                key, k1 = jax.random.split(key)
+                fan_in = in_ch * k * k
+                convs.append({
+                    "w": jax.random.normal(
+                        k1, (k, k, in_ch, out_ch)) * jnp.sqrt(2.0 / fan_in),
+                    "b": jnp.zeros((out_ch,)),
+                })
+            key, k2 = jax.random.split(key)
+            head = mlp_init(k2, [flat_dim, head_dim])
+            return {"convs": convs, "head": head}
+
+        strides = [s for _, _, s in filters]
+
+        def apply(params, obs):
+            x = obs.reshape((-1, h, w, c)).astype(jnp.float32)
+            for conv, s in zip(params["convs"], strides):
+                x = jax.lax.conv_general_dilated(
+                    x, conv["w"], window_strides=(s, s), padding="SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                x = jax.nn.relu(x + conv["b"])
+            x = x.reshape((x.shape[0], -1))
+            return mlp_apply(params["head"], x, activate_last=True)
+
+        return init, apply, head_dim

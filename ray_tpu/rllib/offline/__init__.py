@@ -1,0 +1,4 @@
+from ray_tpu.rllib.offline.json_reader import JsonReader
+from ray_tpu.rllib.offline.json_writer import JsonWriter
+
+__all__ = ["JsonReader", "JsonWriter"]

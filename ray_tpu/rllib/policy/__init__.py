@@ -1,0 +1,34 @@
+"""Policy registry: maps the ``policy_class`` name in a policy config to
+an implementation (the analog of the reference's per-framework policy
+classes resolved in rllib/algorithms/*/: torch_policy vs tf_policy — here
+they are all JAX)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+
+def make_policy(policy_config: Dict[str, Any], obs_space, action_space,
+                seed: int = 0):
+    """Instantiate the policy named by policy_config['policy_class']."""
+    name = policy_config.get("policy_class", "actor_critic")
+    model_config = {
+        "fcnet_hiddens": policy_config.get("fcnet_hiddens", (64, 64)),
+        "conv_filters": policy_config.get("conv_filters"),
+    }
+    if name == "actor_critic":
+        from ray_tpu.rllib.policy.jax_policy import JAXPolicy
+        return JAXPolicy(
+            obs_dim=int(np.prod(obs_space.shape)),
+            action_space=action_space,
+            hiddens=tuple(model_config["fcnet_hiddens"]),
+            seed=seed)
+    if name == "q":
+        from ray_tpu.rllib.policy.q_policy import QPolicy
+        return QPolicy(obs_space, action_space, model_config, seed=seed)
+    if name == "sac":
+        from ray_tpu.rllib.policy.sac_policy import SACPolicy
+        return SACPolicy(obs_space, action_space, model_config, seed=seed)
+    raise ValueError(f"Unknown policy_class {name!r}")

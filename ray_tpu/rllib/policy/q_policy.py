@@ -1,0 +1,79 @@
+"""QPolicy: epsilon-greedy Q-network policy for value-based algorithms.
+
+Rollout-side half of DQN (reference: rllib/algorithms/dqn — the torch
+DQNTorchPolicy's action sampler): the worker holds the online Q params and
+an exploration epsilon (synced from the learner with the weights, so the
+schedule is driven centrally); the learner (algorithms/dqn.py) owns the
+target network and the double-DQN update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.models.catalog import ModelCatalog, mlp_apply, mlp_init
+
+
+class QPolicy:
+    needs_gae = False
+
+    def __init__(self, obs_space, action_space: Any,
+                 model_config: Dict[str, Any] = None, seed: int = 0):
+        import gymnasium as gym
+        if not isinstance(action_space, gym.spaces.Discrete):
+            raise ValueError("QPolicy requires a Discrete action space")
+        self.discrete = True
+        self.action_space = action_space
+        self.act_dim = int(action_space.n)
+        model_config = model_config or {}
+        enc_init, self._encode, feat_dim = ModelCatalog.get_encoder(
+            obs_space, model_config)
+        key = jax.random.PRNGKey(seed)
+        k_enc, k_head = jax.random.split(key)
+        self.params = {
+            "encoder": enc_init(k_enc),
+            "head": mlp_init(k_head, [feat_dim, self.act_dim]),
+        }
+        self.epsilon = 1.0
+        self._q_jit = jax.jit(self.q_values)
+
+    # -- functional core -------------------------------------------------
+
+    def q_values(self, params, obs):
+        feats = self._encode(params["encoder"], obs)
+        return mlp_apply(params["head"], feats)
+
+    # -- worker-side API -------------------------------------------------
+
+    def compute_actions(self, obs: np.ndarray, key) -> Tuple[np.ndarray,
+                                                             np.ndarray,
+                                                             np.ndarray]:
+        q = self._q_jit(self.params, jnp.asarray(obs))
+        greedy = np.asarray(q.argmax(-1))
+        k1, k2 = jax.random.split(key)
+        explore = np.asarray(
+            jax.random.uniform(k1, (obs.shape[0],))) < self.epsilon
+        random_a = np.asarray(jax.random.randint(
+            k2, (obs.shape[0],), 0, self.act_dim))
+        actions = np.where(explore, random_a, greedy)
+        zeros = np.zeros((obs.shape[0],), np.float32)
+        return actions, zeros, zeros
+
+    def compute_values(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            self._q_jit(self.params, jnp.asarray(obs)).max(-1))
+
+    def get_weights(self):
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "epsilon": self.epsilon}
+
+    def set_weights(self, weights) -> None:
+        if isinstance(weights, dict) and "params" in weights:
+            self.params = jax.tree.map(jnp.asarray, weights["params"])
+            self.epsilon = float(weights.get("epsilon", self.epsilon))
+        else:
+            self.params = jax.tree.map(jnp.asarray, weights)

@@ -1,0 +1,90 @@
+"""SACPolicy: squashed-Gaussian actor for soft actor-critic.
+
+Rollout-side half of SAC (reference: rllib/algorithms/sac): a tanh-squashed
+diagonal-Gaussian actor rescaled to the Box bounds. The twin Q critics,
+their targets, and the temperature live in the learner (algorithms/sac.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.models.catalog import ModelCatalog, mlp_apply, mlp_init
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+class SACPolicy:
+    needs_gae = False
+
+    def __init__(self, obs_space, action_space: Any,
+                 model_config: Dict[str, Any] = None, seed: int = 0):
+        import gymnasium as gym
+        if not isinstance(action_space, gym.spaces.Box):
+            raise ValueError("SACPolicy requires a Box action space")
+        self.discrete = False
+        self.action_space = action_space
+        self.act_dim = int(np.prod(action_space.shape))
+        self.low = np.asarray(action_space.low, np.float32).reshape(-1)
+        self.high = np.asarray(action_space.high, np.float32).reshape(-1)
+        model_config = model_config or {}
+        enc_init, self._encode, feat_dim = ModelCatalog.get_encoder(
+            obs_space, model_config)
+        key = jax.random.PRNGKey(seed)
+        k_enc, k_head = jax.random.split(key)
+        self.params = {
+            "encoder": enc_init(k_enc),
+            # One head emitting [mu, log_std].
+            "head": mlp_init(k_head, [feat_dim, 2 * self.act_dim]),
+        }
+        self._sample_jit = jax.jit(self.sample)
+
+    # -- functional core -------------------------------------------------
+
+    def dist_params(self, params, obs):
+        feats = self._encode(params["encoder"], obs)
+        out = mlp_apply(params["head"], feats)
+        mu, log_std = jnp.split(out, 2, axis=-1)
+        return mu, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def sample(self, params, obs, key):
+        """Reparameterized squashed sample → (env_action, logp)."""
+        mu, log_std = self.dist_params(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mu.shape)
+        pre_tanh = mu + std * eps
+        a = jnp.tanh(pre_tanh)
+        # logp with tanh change-of-variables correction.
+        gauss_logp = (-0.5 * (eps ** 2 + 2 * log_std
+                              + jnp.log(2 * jnp.pi))).sum(-1)
+        correction = jnp.log(1 - a ** 2 + 1e-6).sum(-1)
+        logp = gauss_logp - correction
+        scaled = self.low + (a + 1.0) * 0.5 * (self.high - self.low)
+        return scaled, logp
+
+    def logp_and_sample(self, params, obs, key):
+        """Used by the learner's actor/critic losses (same math, jittable
+        inside a larger update)."""
+        return self.sample(params, obs, key)
+
+    # -- worker-side API -------------------------------------------------
+
+    def compute_actions(self, obs: np.ndarray, key) -> Tuple[np.ndarray,
+                                                             np.ndarray,
+                                                             np.ndarray]:
+        a, logp = self._sample_jit(self.params, jnp.asarray(obs), key)
+        zeros = np.zeros((obs.shape[0],), np.float32)
+        return np.asarray(a), np.asarray(logp), zeros
+
+    def compute_values(self, obs: np.ndarray) -> np.ndarray:
+        return np.zeros((obs.shape[0],), np.float32)
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.tree.map(jnp.asarray, weights)

@@ -40,6 +40,10 @@ struct Ref {
 struct Counter {
   std::mutex mu;
   std::unordered_map<std::string, Ref> refs;
+  // Result of the most recent freeing mutation. Mutating calls must commit
+  // exactly once, so when the caller's buffer is too small it re-reads this
+  // stash via rrc_last_freed instead of retrying the mutation.
+  std::string last_freed;
 
   // Collect `oid` if freeable, cascading through contained children.
   void collect(const std::string& oid, std::vector<std::string>* out) {
@@ -85,6 +89,15 @@ std::vector<std::string> split(const char* s) {
   return out;
 }
 
+int64_t write_str(const std::string& joined, char* buf, int64_t cap) {
+  int64_t needed = static_cast<int64_t>(joined.size());
+  if (buf != nullptr && needed < cap) {
+    std::memcpy(buf, joined.data(), joined.size());
+    buf[joined.size()] = '\0';
+  }
+  return needed;
+}
+
 int64_t write_list(const std::vector<std::string>& items, char* buf,
                    int64_t cap) {
   std::string joined;
@@ -92,12 +105,19 @@ int64_t write_list(const std::vector<std::string>& items, char* buf,
     if (i) joined += ';';
     joined += items[i];
   }
-  int64_t needed = static_cast<int64_t>(joined.size());
-  if (buf != nullptr && needed < cap) {
-    std::memcpy(buf, joined.data(), joined.size());
-    buf[joined.size()] = '\0';
+  return write_str(joined, buf, cap);
+}
+
+// Stash + write the result of a freeing mutation.
+int64_t commit_freed(Counter* c, const std::vector<std::string>& freed,
+                     char* buf, int64_t cap) {
+  std::string joined;
+  for (size_t i = 0; i < freed.size(); ++i) {
+    if (i) joined += ';';
+    joined += freed[i];
   }
-  return needed;
+  c->last_freed = joined;
+  return write_str(joined, buf, cap);
 }
 
 }  // namespace
@@ -132,7 +152,7 @@ int64_t rrc_remove_local(void* h, const char* oid, char* buf, int64_t cap) {
     c->collect(oid, &freed);
     c->maybe_erase(oid);
   }
-  return write_list(freed, buf, cap);
+  return commit_freed(c, freed, buf, cap);
 }
 
 void rrc_add_task_deps(void* h, const char* oids) {
@@ -153,7 +173,7 @@ int64_t rrc_remove_task_deps(void* h, const char* oids, char* buf,
     c->collect(oid, &freed);
     c->maybe_erase(oid);
   }
-  return write_list(freed, buf, cap);
+  return commit_freed(c, freed, buf, cap);
 }
 
 void rrc_add_borrower(void* h, const char* oid, const char* borrower) {
@@ -173,7 +193,7 @@ int64_t rrc_remove_borrower(void* h, const char* oid, const char* borrower,
     c->collect(oid, &freed);
     c->maybe_erase(oid);
   }
-  return write_list(freed, buf, cap);
+  return commit_freed(c, freed, buf, cap);
 }
 
 // Parent's stored value contains `children`: pin them while parent's value
@@ -208,7 +228,15 @@ int64_t rrc_force_free(void* h, const char* oid, char* buf, int64_t cap) {
       c->maybe_erase(child);
     }
   }
-  return write_list(freed, buf, cap);
+  return commit_freed(c, freed, buf, cap);
+}
+
+// Read-only re-read of the last freeing mutation's result (for the
+// grow-buffer retry: mutations must not run twice).
+int64_t rrc_last_freed(void* h, char* buf, int64_t cap) {
+  auto* c = static_cast<Counter*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  return write_str(c->last_freed, buf, cap);
 }
 
 int rrc_has(void* h, const char* oid) {
