@@ -380,7 +380,10 @@ class Runtime:
                     self._free_now(freed)
                 except Exception:  # noqa: BLE001 - GC must never die
                     logger.exception("refcount GC sweep failed")
-            if self._shutdown and not self._gc_queue:
+            if self._shutdown:
+                # Exit promptly (don't wait for the queue to drain): the
+                # whole store is being torn down, and shutdown() joins this
+                # thread before unmapping the native arena.
                 return
 
     def _register_task_refs(self, spec: TaskSpec) -> None:
@@ -1538,7 +1541,11 @@ class Runtime:
             state.created.set()
         for w in workers:
             w.stop()
-        self._gc_event.set()  # let the GC thread observe _shutdown and exit
+        # The GC thread must be fully stopped BEFORE the native store is
+        # closed: a free() racing close() would touch an unmapped arena
+        # (segfault). Wake it, let it observe _shutdown, and join.
+        self._gc_event.set()
+        self._gc_thread.join(timeout=5)
         # Wake every blocked get with an error rather than hanging.
         self.store.fail_all_pending(
             RayError("The runtime was shut down while this object was "

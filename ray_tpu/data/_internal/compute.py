@@ -100,70 +100,3 @@ class _BlockTransformActor:
             out = fn(block)
         acc = BlockAccessor.for_block(out)
         return out, acc.get_metadata()
-
-
-def map_blocks_streaming(
-    blocks: List["ray_tpu.ObjectRef"],
-    transform: Callable[[Block], Block],
-    compute: ComputeStrategy,
-    num_cpus: float = 1.0,
-    udf_constructor: Optional[tuple] = None,
-) -> Iterator[Tuple["ray_tpu.ObjectRef", "ray_tpu.ObjectRef"]]:
-    """Yield (block_ref, meta_ref) pairs in input order, streaming with
-    bounded in-flight work."""
-    import cloudpickle
-    fn_bytes = cloudpickle.dumps(transform)
-
-    if isinstance(compute, ActorPoolStrategy):
-        yield from _map_blocks_actor_pool(
-            blocks, fn_bytes, compute, num_cpus, udf_constructor)
-        return
-
-    max_in_flight = compute.size or max(8, len(blocks))
-    task = _get_transform_task(num_cpus)
-    in_flight: List[tuple] = []  # (block_out_ref, meta_ref)
-    i = 0
-    results: List[tuple] = []
-    while i < len(blocks) or in_flight:
-        while i < len(blocks) and len(in_flight) < max_in_flight:
-            refs = task.remote(blocks[i], fn_bytes, False)
-            in_flight.append(refs)
-            i += 1
-        # Pop the head in order (order matters for datasets); wait on it.
-        head = in_flight.pop(0)
-        ray_tpu.wait([head[1]], num_returns=1)
-        yield head
-
-
-def _map_blocks_actor_pool(blocks, fn_bytes, strategy: ActorPoolStrategy,
-                           num_cpus, udf_constructor):
-    import cloudpickle
-    ctor_bytes = (cloudpickle.dumps(udf_constructor)
-                  if udf_constructor is not None else None)
-    ActorCls = ray_tpu.remote(_BlockTransformActor)
-    n_actors = min(strategy.max_size, max(strategy.min_size, len(blocks)))
-    pool = [ActorCls.options(num_cpus=num_cpus).remote(ctor_bytes)
-            for _ in range(n_actors)]
-    # Round-robin with per-actor in-flight cap; yield in input order.
-    pending: List[tuple] = []  # (out_refs,) ordered
-    per_actor: Dict[int, int] = {i: 0 for i in range(n_actors)}
-    cap = strategy.max_tasks_in_flight_per_actor
-    i = 0
-    queue: List[tuple] = []
-    while i < len(blocks) or queue:
-        # Fill: assign next block to the least-loaded actor with room.
-        while i < len(blocks):
-            target = min(per_actor, key=per_actor.get)
-            if per_actor[target] >= cap:
-                break
-            refs = pool[target].apply.options(num_returns=2).remote(
-                blocks[i], fn_bytes)
-            queue.append((refs, target))
-            per_actor[target] += 1
-            i += 1
-        refs, target = queue.pop(0)
-        ray_tpu.wait([refs[1]], num_returns=1)
-        per_actor[target] -= 1
-        yield refs
-    for a in pool:
-        ray_tpu.kill(a)

@@ -1,0 +1,63 @@
+"""StreamingExecutor: pipelined execution of an operator chain.
+
+Analog of the reference's data/_internal/execution/streaming_executor.py:23
+— the event loop that moves RefBundles through the operator topology.
+Unlike the bulk path (stage N completes before stage N+1 starts), every
+operator runs concurrently: a block can be in stage 3 while later blocks
+are still being read, so the first output batch is available after one
+block traverses the chain, and peak memory is bounded by the operators'
+in-flight caps rather than the dataset size.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator, List
+
+from ray_tpu.data._internal.execution.interfaces import (ExecutionOptions,
+                                                         PhysicalOperator,
+                                                         RefBundle)
+
+
+class StreamingExecutor:
+    def __init__(self, options: ExecutionOptions = None):
+        self.options = options or ExecutionOptions()
+
+    def execute(self, operators: List[PhysicalOperator]
+                ) -> Iterator[RefBundle]:
+        """Run the chain (operators[0] is the input buffer) and yield the
+        final operator's bundles as they complete."""
+        if not operators:
+            return
+        try:
+            done_flags = [False] * len(operators)
+            while True:
+                progressed = False
+                # Move bundles downstream (upstream-first so a bundle can
+                # traverse several operators in one pass).
+                for i, op in enumerate(operators):
+                    if i > 0:
+                        op.work()
+                    is_last = i == len(operators) - 1
+                    if is_last:
+                        continue
+                    downstream = operators[i + 1]
+                    while op.has_next():
+                        downstream.add_input(op.get_next())
+                        progressed = True
+                    if op.completed() and not done_flags[i]:
+                        done_flags[i] = True
+                        downstream.all_inputs_done()
+                    downstream.work()
+                last = operators[-1]
+                while last.has_next():
+                    progressed = True
+                    yield last.get_next()
+                if last.completed():
+                    return
+                if not progressed:
+                    # Everything in flight — avoid a busy spin.
+                    time.sleep(0.002)
+        finally:
+            for op in operators:
+                op.shutdown()

@@ -15,7 +15,6 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import ray_tpu
 from ray_tpu.data._internal.compute import (ComputeStrategy, TaskPoolStrategy,
-                                            map_blocks_streaming,
                                             resolve_compute)
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 
@@ -91,23 +90,47 @@ class ExecutionPlan:
                 fused.append(stage)
         return fused
 
+    def _build_operators(self):
+        """Fused stages → physical operator chain (reference: the logical →
+        physical planning in data/_internal/logical/planner.py)."""
+        from ray_tpu.data._internal.execution import (AllToAllOperator,
+                                                      InputDataBuffer,
+                                                      MapOperator)
+        ops = [InputDataBuffer(self._in_blocks, self._in_metadata)]
+        for stage in self._fused_stages():
+            if isinstance(stage, OneToOneStage):
+                ops.append(MapOperator(
+                    stage.name, stage.transform, stage.compute,
+                    stage.num_cpus, stage.udf_constructor))
+            else:
+                ops.append(AllToAllOperator(stage.name, stage.fn))
+        return ops
+
+    def iter_execute(self):
+        """Stream (block_ref, metadata) pairs through the operator chain —
+        consecutive map stages with different compute strategies pipeline
+        against each other instead of materializing between them. Caches
+        the full result when fully consumed."""
+        if self._out is not None:
+            yield from zip(*self._out)
+            return
+        from ray_tpu.data._internal.execution import StreamingExecutor
+        out_blocks: List[Any] = []
+        out_metas: List[BlockMetadata] = []
+        for bundle in StreamingExecutor().execute(self._build_operators()):
+            for block_ref, meta in bundle.blocks:
+                if isinstance(meta, ray_tpu.ObjectRef):
+                    meta = ray_tpu.get(meta)
+                out_blocks.append(block_ref)
+                out_metas.append(meta)
+                yield block_ref, meta
+        self._out = (out_blocks, out_metas)
+
     def execute(self) -> Tuple[List[Any], List[BlockMetadata]]:
         if self._out is not None:
             return self._out
-        blocks, metas = self._in_blocks, self._in_metadata
-        for stage in self._fused_stages():
-            if isinstance(stage, OneToOneStage):
-                out_blocks, out_meta_refs = [], []
-                for block_ref, meta_ref in map_blocks_streaming(
-                        blocks, stage.transform, stage.compute,
-                        stage.num_cpus, stage.udf_constructor):
-                    out_blocks.append(block_ref)
-                    out_meta_refs.append(meta_ref)
-                blocks = out_blocks
-                metas = ray_tpu.get(out_meta_refs)
-            else:
-                blocks, metas = stage.fn(blocks, metas)
-        self._out = (blocks, metas)
+        for _ in self.iter_execute():
+            pass
         return self._out
 
     def is_executed(self) -> bool:

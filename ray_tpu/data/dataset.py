@@ -415,6 +415,14 @@ class Dataset:
                 yield row
 
     def _iter_blocks(self) -> Iterator[Block]:
+        if not self._plan.is_executed():
+            # Streaming execution: blocks flow through the whole operator
+            # chain as they're produced (reference: streaming_executor.py) —
+            # first batch latency is one block's traversal, not a full
+            # materialization.
+            for block_ref, _meta in self._plan.iter_execute():
+                yield ray_tpu.get(block_ref)
+            return
         blocks, _ = self._execute()
         # Prefetch one block ahead while the consumer processes the current
         # one (reference: block prefetching in iter_batches).

@@ -121,6 +121,29 @@ def cmd_job(args) -> int:
     return 1
 
 
+def cmd_serve(args) -> int:
+    """`ray-tpu serve deploy/status/shutdown` (analog of the reference's
+    `serve` CLI, serve/scripts.py)."""
+    import json
+
+    from ray_tpu import serve
+    if args.serve_command == "deploy":
+        from ray_tpu.serve.schema import apply_config
+        with open(args.config_file) as f:
+            config = json.load(f)
+        apply_config(config)
+        print("deployed")
+        return 0
+    if args.serve_command == "status":
+        print(json.dumps(serve.status(), indent=2))
+        return 0
+    if args.serve_command == "shutdown":
+        serve.shutdown()
+        print("shut down")
+        return 0
+    return 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="ray-tpu",
@@ -153,6 +176,13 @@ def main(argv=None) -> int:
         pj.add_argument("job_id")
     jsub.add_parser("list")
 
+    p = sub.add_parser("serve", help="deploy and inspect Serve apps")
+    ssub = p.add_subparsers(dest="serve_command", required=True)
+    pd = ssub.add_parser("deploy", help="deploy from a JSON config file")
+    pd.add_argument("config_file")
+    ssub.add_parser("status")
+    ssub.add_parser("shutdown")
+
     args = parser.parse_args(argv)
     handler = {
         "status": cmd_status,
@@ -163,6 +193,7 @@ def main(argv=None) -> int:
         "metrics": cmd_metrics,
         "devices": cmd_devices,
         "job": cmd_job,
+        "serve": cmd_serve,
     }[args.command]
     return handler(args)
 

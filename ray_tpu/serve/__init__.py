@@ -74,6 +74,7 @@ class Deployment:
             cfg.get("max_concurrent_queries", 100),
             autoscaling,
             version,
+            cfg.get("user_config"),
         ))
 
 
@@ -131,10 +132,15 @@ def ingress(_app=None, **_kwargs):
 
 def _deploy_application(app: Application, controller,
                         route_prefix="__unset__") -> DeploymentHandle:
-    """Deploy bottom-up: bound Application args become handles."""
+    """Deploy bottom-up: bound Application args become handles (recursing
+    into dict/list args, so e.g. DAGDriver's {route: app} map works)."""
     def resolve(v):
         if isinstance(v, Application):
             return _deploy_application(v, controller, route_prefix=None)
+        if isinstance(v, dict):
+            return {k: resolve(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return type(v)(resolve(x) for x in v)
         return v
 
     args = tuple(resolve(a) for a in app.args)

@@ -27,7 +27,8 @@ class DeploymentInfo:
                  init_args, init_kwargs, num_replicas: int,
                  ray_actor_options: dict, route_prefix: Optional[str],
                  max_concurrent_queries: int,
-                 autoscaling_config: Optional[dict], version: str):
+                 autoscaling_config: Optional[dict], version: str,
+                 user_config: Optional[Any] = None):
         self.name = name
         self.deployment_def_bytes = deployment_def_bytes
         self.init_args = init_args
@@ -38,6 +39,7 @@ class DeploymentInfo:
         self.max_concurrent_queries = max_concurrent_queries
         self.autoscaling_config = autoscaling_config
         self.version = version
+        self.user_config = user_config
         self.replicas: List[Any] = []  # live ActorHandles
 
 
@@ -57,12 +59,13 @@ class ServeController:
                      ray_actor_options: dict, route_prefix: Optional[str],
                      max_concurrent_queries: int,
                      autoscaling_config: Optional[dict],
-                     version: str) -> bool:
+                     version: str, user_config: Optional[Any] = None) -> bool:
         existing = self._deployments.get(name)
         info = DeploymentInfo(name, deployment_def_bytes, init_args,
                               init_kwargs, num_replicas, ray_actor_options,
                               route_prefix, max_concurrent_queries,
-                              autoscaling_config, version)
+                              autoscaling_config, version,
+                              user_config=user_config)
         if existing is not None:
             if existing.version == version and \
                     existing.num_replicas == num_replicas:
@@ -115,6 +118,10 @@ class ServeController:
         # Wait for replicas to become ready so run() returns a usable app.
         for r in info.replicas:
             ray_tpu.get(r.ready.remote())
+        if info.user_config is not None:
+            # Reference: user_config reaches each replica via reconfigure().
+            ray_tpu.get([r.reconfigure.remote(info.user_config)
+                         for r in info.replicas])
 
     async def check_health(self, name: str) -> int:
         """Probe replicas; restart any that died. Returns live count

@@ -1,0 +1,59 @@
+"""DAGDriver: HTTP entry deployment routing paths to bound applications.
+
+Analog of the reference's serve/drivers.py DAGDriver (the deployment-graph
+ingress, serve/deployment_graph.py): bind it with either a single
+application or a {route: application} dict; requests fan out to the bound
+handles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Union
+
+from ray_tpu import serve
+
+
+@serve.deployment(name="DAGDriver")
+class DAGDriver:
+    def __init__(self, dags: Union[Any, Dict[str, Any]]):
+        # Bound Applications arrive as DeploymentHandles after deploy.
+        if isinstance(dags, dict):
+            self._routes = dict(dags)
+            self._single = None
+        else:
+            self._routes = {}
+            self._single = dags
+
+    async def __call__(self, request) -> Any:
+        """HTTP entry: route on path for dict DAGs; pass the JSON body (or
+        raw body) to the target handle."""
+        try:
+            payload = request.json()
+        except Exception:  # noqa: BLE001 - not JSON
+            payload = getattr(request, "body", None)
+        if self._single is not None:
+            return serve_get(self._single.remote(payload))
+        path = getattr(request, "path", "/")
+        handle = self._routes.get(path)
+        if handle is None:
+            raise ValueError(f"No route for {path!r}; routes: "
+                             f"{sorted(self._routes)}")
+        return serve_get(handle.remote(payload))
+
+    def predict(self, payload) -> Any:
+        """Python-side entry (handle.predict.remote(x))."""
+        if self._single is not None:
+            return serve_get(self._single.remote(payload))
+        raise ValueError("predict() requires a single-dag driver")
+
+    def predict_with_route(self, route: str, payload) -> Any:
+        handle = self._routes.get(route)
+        if handle is None:
+            raise ValueError(f"No route {route!r}")
+        return serve_get(handle.remote(payload))
+
+
+def serve_get(ref):
+    """Resolve a handle call result (ObjectRef) inside a replica."""
+    import ray_tpu
+    return ray_tpu.get(ref)

@@ -1,0 +1,137 @@
+"""Declarative Serve config schemas + apply.
+
+Analog of the reference's serve/schema.py (pydantic ServeApplicationSchema
+consumed by `serve deploy` / the REST API): dataclass schemas with
+validation, a loader that resolves ``import_path`` strings, and
+``apply_config`` which reconciles a running Serve instance to the declared
+state.
+"""
+
+from __future__ import annotations
+
+import importlib
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class DeploymentSchema:
+    # None = "not set in the config": apply_config only overrides fields the
+    # operator actually declared (the code-declared value wins otherwise).
+    name: str
+    num_replicas: Optional[int] = None
+    max_concurrent_queries: Optional[int] = None
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    autoscaling_config: Optional[Dict[str, Any]] = None
+    user_config: Optional[Dict[str, Any]] = None
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValueError("Deployment name must be non-empty")
+        if self.num_replicas is not None and self.num_replicas < 0:
+            raise ValueError(
+                f"num_replicas must be >= 0, got {self.num_replicas}")
+        if self.autoscaling_config:
+            mn = self.autoscaling_config.get("min_replicas", 1)
+            mx = self.autoscaling_config.get("max_replicas", mn)
+            if mn > mx:
+                raise ValueError(
+                    f"min_replicas ({mn}) > max_replicas ({mx})")
+
+
+@dataclass
+class ServeApplicationSchema:
+    import_path: str
+    name: str = "default"
+    route_prefix: str = "/"
+    runtime_env: Dict[str, Any] = field(default_factory=dict)
+    deployments: List[DeploymentSchema] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ServeApplicationSchema":
+        deployments = [
+            DeploymentSchema(**dep) if not isinstance(dep, DeploymentSchema)
+            else dep
+            for dep in d.get("deployments", [])]
+        schema = ServeApplicationSchema(
+            import_path=d["import_path"],
+            name=d.get("name", "default"),
+            route_prefix=d.get("route_prefix", "/"),
+            runtime_env=d.get("runtime_env", {}),
+            deployments=deployments)
+        schema.validate()
+        return schema
+
+    def validate(self) -> None:
+        if ":" not in self.import_path:
+            raise ValueError(
+                f"import_path must look like 'module:attribute', got "
+                f"{self.import_path!r}")
+        for dep in self.deployments:
+            dep.validate()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+
+def _load_target(import_path: str):
+    module_name, _, attr = import_path.partition(":")
+    module = importlib.import_module(module_name)
+    target = module
+    for part in attr.split("."):
+        target = getattr(target, part)
+    return target
+
+
+def apply_config(config: Dict[str, Any]):
+    """Deploy the application declared by a config dict (the body of the
+    reference's `serve deploy config.yaml` / REST PUT /api/serve/applications).
+    Per-deployment overrides in ``deployments`` are applied over the bound
+    application before deploy. Returns the entry handle."""
+    import copy
+
+    from ray_tpu import serve
+    schema = ServeApplicationSchema.from_dict(config)
+    target = _load_target(schema.import_path)
+    overrides = {d.name: d for d in schema.deployments}
+
+    app = target
+    if isinstance(app, serve.Deployment):
+        app = app.bind()
+    # Deep-copy the bound graph: module-level Applications are shared, and
+    # overrides must not leak into later, unrelated serve.run() calls.
+    app = copy.deepcopy(app)
+
+    # Walk the bound application graph, applying per-deployment overrides
+    # (only fields the config actually set).
+    def override(application):
+        dep = application.deployment
+        o = overrides.get(dep.name)
+        if o is not None:
+            dep._config = dict(dep._config)
+            if o.num_replicas is not None:
+                dep._config["num_replicas"] = o.num_replicas
+            if o.max_concurrent_queries is not None:
+                dep._config["max_concurrent_queries"] = \
+                    o.max_concurrent_queries
+            if o.autoscaling_config is not None:
+                dep._config["autoscaling_config"] = o.autoscaling_config
+            if o.ray_actor_options:
+                dep._config["ray_actor_options"] = o.ray_actor_options
+            if o.user_config is not None:
+                dep._config["user_config"] = o.user_config
+        def walk(v):
+            if isinstance(v, serve.Application):
+                override(v)
+            elif isinstance(v, dict):
+                for x in v.values():
+                    walk(x)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    walk(x)
+
+        for a in list(application.args) + list(application.kwargs.values()):
+            walk(a)
+
+    override(app)
+    return serve.run(app, route_prefix=schema.route_prefix, port=None)

@@ -5,17 +5,21 @@ from ray_tpu.air.result import Result
 from ray_tpu.train.backend import Backend, BackendConfig
 from ray_tpu.train.base_trainer import BaseTrainer, DataParallelTrainer
 from ray_tpu.train.jax import JaxBackendConfig, JaxTrainer, prepare_mesh
+from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
 
 __all__ = [
     "Backend",
     "BackendConfig",
     "BaseTrainer",
+    "BatchPredictor",
     "Checkpoint",
     "CheckpointConfig",
     "DataParallelTrainer",
     "FailureConfig",
     "JaxBackendConfig",
+    "JaxPredictor",
     "JaxTrainer",
+    "Predictor",
     "Result",
     "RunConfig",
     "ScalingConfig",

@@ -1,0 +1,127 @@
+"""Predictors: checkpoint → batch inference.
+
+Analog of the reference's train/predictor.py + batch_predictor.py: a
+Predictor wraps restored model state and maps batches to predictions; a
+BatchPredictor runs a predictor over a Dataset with an autoscaling actor
+pool (each actor holds the model once — on TPU serving, a compiled pjit
+program per actor).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Type
+
+import numpy as np
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+
+class Predictor:
+    """Base predictor. Subclasses implement ``_predict_numpy``."""
+
+    def __init__(self, preprocessor=None):
+        self._preprocessor = preprocessor
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, **kwargs) -> "Predictor":
+        raise NotImplementedError
+
+    def predict(self, batch: Any) -> Any:
+        if self._preprocessor is not None:
+            batch = self._preprocessor.transform_batch(batch)
+        return self._predict_numpy(batch)
+
+    def _predict_numpy(self, batch: Any) -> Any:
+        raise NotImplementedError
+
+
+class JaxPredictor(Predictor):
+    """Predictor over a functional JAX model: ``apply_fn(params, batch)``.
+
+    The checkpoint holds {"params": pytree}; apply_fn is jitted once per
+    process so repeated batches reuse the compiled program.
+    """
+
+    def __init__(self, params, apply_fn: Callable, preprocessor=None,
+                 jit: bool = True):
+        super().__init__(preprocessor)
+        import jax
+        self.params = params
+        self._apply = jax.jit(apply_fn) if jit else apply_fn
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, *,
+                        apply_fn: Callable, **kwargs) -> "JaxPredictor":
+        data = checkpoint.to_dict()
+        params = data.get("params", data.get("model"))
+        if params is None:
+            raise ValueError(
+                "Checkpoint must contain 'params' (or 'model') for "
+                "JaxPredictor")
+        return cls(params, apply_fn,
+                   preprocessor=data.get("preprocessor"), **kwargs)
+
+    def _predict_numpy(self, batch: Any) -> Any:
+        import jax.numpy as jnp
+        if isinstance(batch, dict):
+            inp = {k: jnp.asarray(v) for k, v in batch.items()}
+        else:
+            inp = jnp.asarray(batch)
+        out = self._apply(self.params, inp)
+        import jax
+        return jax.tree.map(np.asarray, out)
+
+
+class BatchPredictor:
+    """Maps a Predictor over a Dataset (reference: batch_predictor.py):
+    one predictor instance per actor, batches stream through the actor
+    pool."""
+
+    def __init__(self, checkpoint: Checkpoint,
+                 predictor_cls: Type[Predictor], **predictor_kwargs):
+        self._checkpoint = checkpoint
+        self._predictor_cls = predictor_cls
+        self._predictor_kwargs = predictor_kwargs
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint,
+                        predictor_cls: Type[Predictor],
+                        **predictor_kwargs) -> "BatchPredictor":
+        return cls(checkpoint, predictor_cls, **predictor_kwargs)
+
+    def predict(self, dataset, *, batch_size: int = 256,
+                min_scoring_workers: int = 1,
+                max_scoring_workers: int = 2,
+                num_cpus_per_worker: float = 1.0,
+                feature_columns=None,
+                keep_columns=None):
+        """Returns a Dataset of prediction batches."""
+        from ray_tpu.data._internal.compute import ActorPoolStrategy
+
+        checkpoint = self._checkpoint
+        predictor_cls = self._predictor_cls
+        predictor_kwargs = self._predictor_kwargs
+
+        class _ScoringActor:
+            def __init__(self):
+                self.predictor = predictor_cls.from_checkpoint(
+                    checkpoint, **predictor_kwargs)
+
+            def __call__(self, batch: Dict[str, np.ndarray]):
+                inp = batch
+                if feature_columns:
+                    inp = {k: batch[k] for k in feature_columns}
+                out = self.predictor.predict(inp)
+                if not isinstance(out, dict):
+                    out = {"predictions": np.asarray(out)}
+                if keep_columns:
+                    for k in keep_columns:
+                        out[k] = batch[k]
+                return out
+
+        return dataset.map_batches(
+            _ScoringActor,
+            batch_size=batch_size,
+            compute=ActorPoolStrategy(min_size=min_scoring_workers,
+                                      max_size=max_scoring_workers),
+            num_cpus=num_cpus_per_worker)

@@ -3,14 +3,16 @@
 from ray_tpu.air.session import get_checkpoint, get_trial_id, get_trial_name
 from ray_tpu.air.session import report  # tune.report == session.report
 from ray_tpu.tune.callbacks import (Callback, CSVLoggerCallback,
-                                    JsonLoggerCallback)
+                                    JsonLoggerCallback,
+                                    MLflowLoggerCallback, SyncerCallback,
+                                    TBXLoggerCallback, WandbLoggerCallback)
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      HyperBandScheduler, MedianStoppingRule,
                                      PopulationBasedTraining)
-from ray_tpu.tune.search import (BasicVariantGenerator, ConcurrencyLimiter,
-                                 Searcher, TPESearcher, choice, grid_search,
-                                 loguniform, quniform, randint, sample_from,
-                                 uniform)
+from ray_tpu.tune.search import (BasicVariantGenerator, BayesOptSearch,
+                                 ConcurrencyLimiter, Searcher, TPESearcher,
+                                 TuneBOHB, choice, grid_search, loguniform,
+                                 quniform, randint, sample_from, uniform)
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 
@@ -43,17 +45,22 @@ def with_parameters(trainable, **kwargs):
 __all__ = [
     "ASHAScheduler",
     "BasicVariantGenerator",
+    "BayesOptSearch",
     "Callback",
     "CSVLoggerCallback",
     "ConcurrencyLimiter",
     "FIFOScheduler",
     "HyperBandScheduler",
     "JsonLoggerCallback",
+    "MLflowLoggerCallback",
     "MedianStoppingRule",
     "PopulationBasedTraining",
     "ResultGrid",
     "Searcher",
+    "SyncerCallback",
+    "TBXLoggerCallback",
     "TPESearcher",
+    "TuneBOHB",
     "TuneConfig",
     "Tuner",
     "choice",
@@ -67,6 +74,7 @@ __all__ = [
     "report",
     "sample_from",
     "uniform",
+    "WandbLoggerCallback",
     "with_parameters",
     "with_resources",
 ]

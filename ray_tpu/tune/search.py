@@ -340,3 +340,117 @@ class ConcurrencyLimiter(Searcher):
         # in-flight suggestion; it frees on completion.
         self._live.add(trial_id)
         self.searcher.register_pending(trial_id, config)
+
+
+class BayesOptSearch(Searcher):
+    """Gaussian-process Bayesian optimization (the native analog of the
+    reference's tune/search/bayesopt/ wrapper around bayes_opt).
+
+    Numeric domains are normalized to [0, 1] (log-scaled for LogUniform); a
+    GP with an RBF kernel is fit on completed observations (numpy Cholesky)
+    and the next config maximizes Expected Improvement over random
+    candidates. Non-numeric keys fall back to random sampling.
+    """
+
+    def __init__(self, n_initial_points: int = 6, n_candidates: int = 256,
+                 kernel_scale: float = 0.2, noise: float = 1e-6,
+                 seed: int = 0):
+        self.n_initial = n_initial_points
+        self.n_candidates = n_candidates
+        self.kernel_scale = kernel_scale
+        self.noise = noise
+        self._rng = random.Random(seed)
+        self._observations: List[tuple] = []  # (config, signed score)
+        self._pending: Dict[str, Dict[str, Any]] = {}
+
+    def _numeric_keys(self) -> List[str]:
+        return [k for k in sorted(self.param_space)
+                if isinstance(self.param_space[k],
+                              (Uniform, LogUniform, RandInt, QUniform))]
+
+    def _to_unit(self, key: str, value: float) -> float:
+        import math
+        dom = self.param_space[key]
+        if isinstance(dom, LogUniform):
+            lo, hi = dom.log_low, dom.log_high
+            return (math.log(value) - lo) / max(hi - lo, 1e-12)
+        lo, hi = float(dom.low), float(dom.high)
+        return (float(value) - lo) / max(hi - lo, 1e-12)
+
+    def _features(self, config: Dict[str, Any]):
+        import numpy as np
+        return np.asarray([self._to_unit(k, config[k])
+                           for k in self._numeric_keys()])
+
+    def _gp_posterior(self, X, y, Xc):
+        """GP posterior mean/std at candidates Xc (RBF kernel)."""
+        import numpy as np
+
+        def rbf(A, B):
+            d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / self.kernel_scale ** 2)
+
+        K = rbf(X, X) + self.noise * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, y))
+        Ks = rbf(X, Xc)
+        mu = Ks.T @ alpha
+        v = np.linalg.solve(L, Ks)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        return mu, np.sqrt(var)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        import numpy as np
+        keys = self._numeric_keys()
+        if len(self._observations) < self.n_initial or not keys:
+            config = _sample_domains(self.param_space, self._rng)
+            self._pending[trial_id] = config
+            return config
+        X = np.stack([self._features(c) for c, _ in self._observations])
+        y = np.asarray([s for _, s in self._observations], dtype=float)
+        y_mean, y_std = y.mean(), max(y.std(), 1e-9)
+        yn = (y - y_mean) / y_std
+        candidates = [_sample_domains(self.param_space, self._rng)
+                      for _ in range(self.n_candidates)]
+        Xc = np.stack([self._features(c) for c in candidates])
+        mu, sigma = self._gp_posterior(X, yn, Xc)
+        best = yn.max()
+        # Expected Improvement.
+        z = (mu - best) / sigma
+        phi = np.exp(-0.5 * z ** 2) / np.sqrt(2 * np.pi)
+        Phi = 0.5 * (1.0 + np.vectorize(math_erf)(z / np.sqrt(2)))
+        ei = sigma * (z * Phi + phi)
+        config = candidates[int(ei.argmax())]
+        self._pending[trial_id] = config
+        return config
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        config = self._pending.pop(trial_id, None)
+        self._observe(config, result, error)
+
+    def register_completed(self, trial_id, config, result, error=False):
+        self._observe(config, result, error)
+
+    def register_pending(self, trial_id, config):
+        self._pending[trial_id] = dict(config)
+
+    def _observe(self, config, result, error):
+        if config is None or error or not result:
+            return
+        value = result.get(self.metric)
+        if value is None:
+            return
+        signed = value if self.mode == "max" else -value
+        self._observations.append((config, signed))
+
+
+def math_erf(x: float) -> float:
+    import math
+    return math.erf(x)
+
+
+class TuneBOHB(TPESearcher):
+    """BOHB's model-based sampling component (reference: tune/search/bohb/
+    TuneBOHB): TPE-style good/bad density modeling. Pair it with
+    HyperBandScheduler — the combination is the reference's HB_BOHB
+    (successive halving driven by model-based suggestions)."""

@@ -194,3 +194,106 @@ def test_http_404(serve_session):
         urllib.request.urlopen(
             f"http://127.0.0.1:{port}/unknown", timeout=10)
     assert e.value.code == 404
+
+
+def test_schema_validation():
+    from ray_tpu.serve.schema import ServeApplicationSchema
+    with pytest.raises(ValueError):
+        ServeApplicationSchema.from_dict({"import_path": "nocolon"})
+    with pytest.raises(ValueError):
+        ServeApplicationSchema.from_dict({
+            "import_path": "m:a",
+            "deployments": [{"name": "d", "num_replicas": -1}]})
+    with pytest.raises(ValueError):
+        ServeApplicationSchema.from_dict({
+            "import_path": "m:a",
+            "deployments": [{"name": "d", "autoscaling_config":
+                             {"min_replicas": 5, "max_replicas": 2}}]})
+    schema = ServeApplicationSchema.from_dict({
+        "import_path": "mymod:app", "route_prefix": "/x",
+        "deployments": [{"name": "d", "num_replicas": 3}]})
+    assert schema.to_dict()["deployments"][0]["num_replicas"] == 3
+
+
+# Module-level target for apply_config's import_path resolution.
+@serve.deployment(name="echo_for_config", num_replicas=1)
+def _echo_target(payload):
+    return {"echo": payload}
+
+
+echo_app = _echo_target.bind()
+
+
+def test_apply_config_deploys_with_overrides(serve_session):
+    from ray_tpu.serve.schema import apply_config
+    handle = apply_config({
+        "import_path": "tests.test_serve:echo_app",
+        "deployments": [{"name": "echo_for_config", "num_replicas": 2}],
+    })
+    assert ray_tpu.get(handle.remote("hi")) == {"echo": "hi"}
+    status = serve.status()
+    assert status["echo_for_config"]["num_replicas"] == 2
+
+
+def test_dag_driver_routes(serve_session):
+    from ray_tpu.serve.drivers import DAGDriver
+
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    @serve.deployment
+    def negate(x):
+        return -x
+
+    app = DAGDriver.bind({"/double": double.bind(), "/negate": negate.bind()})
+    handle = serve.run(app, port=None)
+    assert ray_tpu.get(handle.predict_with_route.remote("/double", 21)) == 42
+    assert ray_tpu.get(handle.predict_with_route.remote("/negate", 5)) == -5
+
+
+def test_serve_cli_status_and_deploy(serve_session, tmp_path):
+    import json
+    from ray_tpu.scripts.cli import main as cli_main
+    cfg = {"import_path": "tests.test_serve:echo_app"}
+    cfg_file = tmp_path / "serve.json"
+    cfg_file.write_text(json.dumps(cfg))
+    assert cli_main(["serve", "deploy", str(cfg_file)]) == 0
+    assert cli_main(["serve", "status"]) == 0
+
+
+@serve.deployment(name="multi_echo", num_replicas=3)
+def _multi_echo(payload):
+    return payload
+
+
+multi_echo_app = _multi_echo.bind()
+
+
+def test_apply_config_partial_override_and_no_leak(serve_session):
+    from ray_tpu.serve.schema import apply_config
+    # Only user_config set: code-declared num_replicas=3 must survive.
+    apply_config({
+        "import_path": "tests.test_serve:multi_echo_app",
+        "deployments": [{"name": "multi_echo", "user_config": {"k": 1}}],
+    })
+    assert serve.status()["multi_echo"]["num_replicas"] == 3
+    # And the module-level Deployment object must be untouched.
+    assert _multi_echo._config.get("user_config") is None
+    assert _multi_echo._config["num_replicas"] == 3
+
+
+def test_user_config_reaches_reconfigure(serve_session):
+    @serve.deployment(name="cfgable", user_config={"threshold": 0.5})
+    class Cfgable:
+        def __init__(self):
+            self.threshold = None
+
+        def reconfigure(self, cfg):
+            self.threshold = cfg["threshold"]
+
+        def __call__(self, _):
+            return self.threshold
+
+    handle = serve.run(Cfgable.bind(), port=None)
+    assert ray_tpu.get(handle.remote(None)) == 0.5

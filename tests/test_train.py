@@ -3,6 +3,8 @@ test_data_parallel_trainer.py coverage)."""
 
 import tempfile
 
+import numpy as np
+
 import pytest
 
 import ray_tpu as ray
@@ -163,3 +165,40 @@ def test_jax_trainer_gpt_e2e(ray_start_regular):
                          resume_from_checkpoint=result.checkpoint)
     r2 = resumed.fit()
     assert len(r2.metrics_history) == 2  # steps 4 and 5 only
+
+
+def test_jax_predictor_from_checkpoint(ray_start_regular):
+    import jax.numpy as jnp
+    from ray_tpu.air.checkpoint import Checkpoint
+    from ray_tpu.train import JaxPredictor
+
+    params = {"w": jnp.asarray([[2.0], [3.0]])}
+
+    def apply_fn(p, x):
+        return x @ p["w"]
+
+    ckpt = Checkpoint.from_dict({"params": params})
+    pred = JaxPredictor.from_checkpoint(ckpt, apply_fn=apply_fn)
+    out = pred.predict(np.asarray([[1.0, 1.0], [2.0, 0.0]], np.float32))
+    np.testing.assert_allclose(out, [[5.0], [4.0]])
+
+
+def test_batch_predictor_over_dataset(ray_start_regular):
+    import jax.numpy as jnp
+    from ray_tpu import data as rdata
+    from ray_tpu.air.checkpoint import Checkpoint
+    from ray_tpu.train import BatchPredictor, JaxPredictor
+
+    params = {"scale": jnp.asarray(10.0)}
+
+    def apply_fn(p, batch):
+        return {"out": batch["x"] * p["scale"]}
+
+    ckpt = Checkpoint.from_dict({"params": params})
+    bp = BatchPredictor.from_checkpoint(ckpt, JaxPredictor,
+                                        apply_fn=apply_fn)
+    ds = rdata.from_numpy(np.arange(8, dtype=np.float32), column="x")
+    out = bp.predict(ds, batch_size=4, max_scoring_workers=2)
+    vals = sorted(v for b in out.iter_batches(batch_size=None)
+                  for v in b["out"])
+    assert vals == [float(10 * i) for i in range(8)]
