@@ -500,6 +500,11 @@ class Runtime:
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
         """Submit a normal task. Returns refs for its return objects."""
         self._chaos_delay("testing_submit_delay_us")
+        from ray_tpu.util import tracing
+        if tracing.is_tracing_enabled():
+            # Propagate the caller's span context inside the spec
+            # (reference: tracing_helper.py _DictPropagator).
+            spec.trace_ctx = tracing.inject_context()
         n = 1 if spec.num_returns == "dynamic" else spec.num_returns
         spec.return_ids = [
             ObjectID.for_return(spec.task_id, i + 1) for i in range(max(n, 1))]
@@ -819,13 +824,17 @@ class Runtime:
             args, kwargs = self._resolve_args(spec)
             _task_context.spec = spec
             try:
-                if spec.runtime_env:
-                    from ray_tpu._private import runtime_env as _renv
-                    _renv.setup(spec.runtime_env)
-                    with _renv.applied(spec.runtime_env):
+                from ray_tpu.util import tracing
+                with tracing.continue_context(
+                        getattr(spec, "trace_ctx", None),
+                        f"task::{spec.name}"):
+                    if spec.runtime_env:
+                        from ray_tpu._private import runtime_env as _renv
+                        _renv.setup(spec.runtime_env)
+                        with _renv.applied(spec.runtime_env):
+                            result = fn(*args, **kwargs)
+                    else:
                         result = fn(*args, **kwargs)
-                else:
-                    result = fn(*args, **kwargs)
             finally:
                 _task_context.spec = None
             self._store_results(spec, result)
@@ -1015,6 +1024,9 @@ class Runtime:
         self._dispatch()
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        from ray_tpu.util import tracing
+        if tracing.is_tracing_enabled():
+            spec.trace_ctx = tracing.inject_context()
         n = max(spec.num_returns, 1) if spec.num_returns != "dynamic" else 1
         spec.return_ids = [
             ObjectID.for_return(spec.task_id, i + 1) for i in range(n)]
@@ -1132,7 +1144,11 @@ class Runtime:
         try:
             _task_context.spec = spec
             try:
-                result = method(*args, **kwargs)
+                from ray_tpu.util import tracing
+                with tracing.continue_context(
+                        getattr(spec, "trace_ctx", None),
+                        f"actor_task::{spec.name}"):
+                    result = method(*args, **kwargs)
             finally:
                 _task_context.spec = None
             self._store_results(spec, result)
@@ -1551,4 +1567,10 @@ class Runtime:
             RayError("The runtime was shut down while this object was "
                      "still pending."))
         if self.store.native is not None:
-            self.store.native.close()
+            if self._gc_thread.is_alive():
+                # Better to leak the arena than unmap it under a live
+                # free() (the join timed out — should not happen).
+                logger.warning("GC thread still alive at shutdown; "
+                               "leaving the native arena mapped")
+            else:
+                self.store.native.close()

@@ -133,6 +133,9 @@ class TaskSpec:
     # Set when the task's node died mid-run: results are discarded, a retry
     # owns the return objects (multi-node failure semantics).
     invalidated: bool = False
+    # Tracing context propagated from the caller's active span (declared so
+    # clone_for_retry keeps retried tasks inside their trace).
+    trace_ctx: Optional[Dict[str, str]] = None
 
     def clone_for_retry(self) -> "TaskSpec":
         """Fresh spec for a node-death retry/reconstruction. The original

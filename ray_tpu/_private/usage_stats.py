@@ -1,0 +1,64 @@
+"""Usage stats: opt-out, local-only telemetry summary.
+
+Analog of the reference's _private/usage/usage_lib.py:94 — collects
+coarse usage counters per session. This rebuild never egresses anything:
+the report is written to the session's local temp dir only, and
+``RAY_TPU_USAGE_STATS_ENABLED=0`` disables even that.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict
+
+_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+_features: set = set()
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") != "0"
+
+
+def record_library_usage(name: str) -> None:
+    """Called by libraries on first use (train/tune/serve/data/rllib)."""
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _features.add(name)
+
+
+def record_extra_usage_tag(key: str, value: int = 1) -> None:
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _counters[key] = _counters.get(key, 0) + value
+
+
+def usage_report() -> Dict[str, Any]:
+    import ray_tpu
+    with _lock:
+        return {
+            "version": ray_tpu.__version__,
+            "collected_at": time.time(),
+            "libraries_used": sorted(_features),
+            "counters": dict(_counters),
+        }
+
+
+def write_usage_report(session_dir: str) -> str:
+    """Persist the report next to the session logs (never uploaded)."""
+    path = os.path.join(session_dir, "usage_stats.json")
+    os.makedirs(session_dir, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(usage_report(), f, indent=2)
+    return path
+
+
+def reset() -> None:
+    with _lock:
+        _counters.clear()
+        _features.clear()

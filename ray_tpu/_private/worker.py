@@ -77,6 +77,11 @@ def init(
             raise RuntimeError(
                 "Calling init() again after it has already been called. "
                 "Pass ignore_reinit_error=True to suppress this error.")
+        if address is not None and address.startswith("ray://"):
+            raise ValueError(
+                f"Thin-client connections use the client API: "
+                f"`api = ray_tpu.util.client.connect({address!r})` against "
+                "a driver running `ray_tpu.util.client.serve()`.")
         if address not in (None, "local", "auto"):
             raise NotImplementedError(
                 f"Connecting to a remote cluster at {address!r} is not yet "

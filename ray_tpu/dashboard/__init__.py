@@ -1,0 +1,3 @@
+from ray_tpu.dashboard.head import DashboardHead, start_dashboard
+
+__all__ = ["DashboardHead", "start_dashboard"]

@@ -1,0 +1,253 @@
+"""Dashboard head: the cluster's HTTP observability/ops endpoint.
+
+Analog of the reference's dashboard head process (dashboard/dashboard.py +
+dashboard/head.py with its pluggable modules): one aiohttp server exposing
+the state API, metrics, job submission, and Serve status as JSON (the
+reference's React client is a non-goal — SURVEY.md §7; consumers are the
+CLI, the SDK, and curl).
+
+Routes (all JSON unless noted):
+  GET  /api/version            — framework version + session
+  GET  /api/cluster_status     — resources, node table, demand
+  GET  /api/v0/{actors,tasks,objects,nodes,placement_groups} — state API
+  GET  /api/v0/tasks/summarize — task state counts
+  GET  /metrics                — Prometheus text format
+  GET  /api/jobs/              — list jobs
+  POST /api/jobs/              — submit {entrypoint, runtime_env?}
+  GET  /api/jobs/{id}          — job detail
+  GET  /api/jobs/{id}/logs     — {logs}
+  POST /api/jobs/{id}/stop
+  GET  /api/serve/applications — Serve status
+  PUT  /api/serve/applications — apply declarative Serve config
+  GET  /api/timeline           — chrome://tracing events
+  GET  /                       — minimal HTML index
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger("ray_tpu")
+
+
+class DashboardHead:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._runner = None
+        self._site = None
+        self._thread: Optional[threading.Thread] = None
+        self._loop = None
+        self._job_manager = None
+        self.bound_port: Optional[int] = None
+
+    # -- handlers --------------------------------------------------------
+
+    def _json(self, payload: Any, status: int = 200):
+        from aiohttp import web
+        return web.Response(text=json.dumps(payload, default=str),
+                            status=status, content_type="application/json")
+
+    async def _index(self, request):
+        from aiohttp import web
+        rows = "".join(
+            f"<li><a href='{path}'>{path}</a></li>"
+            for path in ("/api/version", "/api/cluster_status",
+                         "/api/v0/actors", "/api/v0/tasks",
+                         "/api/v0/nodes", "/api/jobs/", "/metrics",
+                         "/api/serve/applications", "/api/timeline"))
+        return web.Response(
+            text=f"<html><body><h2>ray_tpu dashboard</h2><ul>{rows}</ul>"
+                 "</body></html>",
+            content_type="text/html")
+
+    async def _version(self, request):
+        import ray_tpu
+        from ray_tpu._private.worker import global_worker
+        runtime = getattr(global_worker, "_runtime", None)
+        return self._json({
+            "version": ray_tpu.__version__,
+            "session_id": getattr(runtime, "session_id", None),
+        })
+
+    async def _cluster_status(self, request):
+        import ray_tpu
+        return self._json({
+            "cluster_resources": ray_tpu.cluster_resources(),
+            "available_resources": ray_tpu.available_resources(),
+            "nodes": ray_tpu.nodes(),
+        })
+
+    async def _state(self, request):
+        from ray_tpu.experimental.state import api as state_api
+        resource = request.match_info["resource"]
+        fns = {
+            "actors": state_api.list_actors,
+            "tasks": state_api.list_tasks,
+            "objects": state_api.list_objects,
+            "nodes": state_api.list_nodes,
+            "placement_groups": state_api.list_placement_groups,
+        }
+        if resource not in fns:
+            return self._json({"error": f"unknown resource {resource}"},
+                              status=404)
+        return self._json({"result": fns[resource]()})
+
+    async def _summarize_tasks(self, request):
+        from ray_tpu.experimental.state import api as state_api
+        return self._json({"result": state_api.summarize_tasks()})
+
+    async def _metrics(self, request):
+        from aiohttp import web
+
+        from ray_tpu.util.metrics import export_prometheus
+        return web.Response(text=export_prometheus(),
+                            content_type="text/plain")
+
+    async def _timeline(self, request):
+        from ray_tpu._private.state import timeline
+        return self._json(timeline())
+
+    # jobs ---------------------------------------------------------------
+
+    def _jobs(self):
+        if self._job_manager is None:
+            from ray_tpu.job_submission import JobManager
+            self._job_manager = JobManager()
+        return self._job_manager
+
+    async def _jobs_list(self, request):
+        return self._json({"jobs": [j.__dict__ for j in
+                                    self._jobs().list_jobs()]})
+
+    async def _jobs_submit(self, request):
+        body = await request.json()
+        if "entrypoint" not in body:
+            return self._json({"error": "entrypoint required"}, status=400)
+        job_id = self._jobs().submit_job(
+            entrypoint=body["entrypoint"],
+            runtime_env=body.get("runtime_env"),
+            submission_id=body.get("submission_id"))
+        return self._json({"submission_id": job_id})
+
+    async def _jobs_get(self, request):
+        try:
+            info = self._jobs().get_job_info(
+                request.match_info["job_id"])
+        except KeyError:
+            return self._json({"error": "no such job"}, status=404)
+        return self._json(info.__dict__)
+
+    async def _jobs_logs(self, request):
+        try:
+            logs = self._jobs().get_job_logs(request.match_info["job_id"])
+        except KeyError:
+            return self._json({"error": "no such job"}, status=404)
+        return self._json({"logs": logs})
+
+    async def _jobs_stop(self, request):
+        stopped = self._jobs().stop_job(request.match_info["job_id"])
+        return self._json({"stopped": stopped})
+
+    # serve --------------------------------------------------------------
+
+    async def _serve_get(self, request):
+        from ray_tpu import serve
+        try:
+            return self._json(serve.status())
+        except Exception as exc:  # noqa: BLE001 - serve not running
+            return self._json({"error": str(exc)}, status=503)
+
+    async def _serve_put(self, request):
+        from ray_tpu.serve.schema import apply_config
+        body = await request.json()
+        try:
+            apply_config(body)
+        except Exception as exc:  # noqa: BLE001 - config error → 400
+            return self._json({"error": str(exc)}, status=400)
+        return self._json({"status": "deployed"})
+
+    # -- lifecycle -------------------------------------------------------
+
+    def _build_app(self):
+        from aiohttp import web
+        app = web.Application()
+        app.router.add_get("/", self._index)
+        app.router.add_get("/api/version", self._version)
+        app.router.add_get("/api/cluster_status", self._cluster_status)
+        app.router.add_get("/api/v0/tasks/summarize", self._summarize_tasks)
+        app.router.add_get("/api/v0/{resource}", self._state)
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/api/timeline", self._timeline)
+        app.router.add_get("/api/jobs/", self._jobs_list)
+        app.router.add_post("/api/jobs/", self._jobs_submit)
+        app.router.add_get("/api/jobs/{job_id}", self._jobs_get)
+        app.router.add_get("/api/jobs/{job_id}/logs", self._jobs_logs)
+        app.router.add_post("/api/jobs/{job_id}/stop", self._jobs_stop)
+        app.router.add_get("/api/serve/applications", self._serve_get)
+        app.router.add_put("/api/serve/applications", self._serve_put)
+        return app
+
+    def start(self) -> int:
+        """Run the server on a daemon thread; returns the bound port."""
+        import asyncio
+
+        from aiohttp import web
+
+        ready = threading.Event()
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+
+            async def setup():
+                runner = web.AppRunner(self._build_app())
+                await runner.setup()
+                site = web.TCPSite(runner, self.host, self.port)
+                await site.start()
+                self._runner = runner
+                self.bound_port = runner.addresses[0][1]
+
+            loop.run_until_complete(setup())
+            ready.set()
+            loop.run_forever()
+
+        self._thread = threading.Thread(target=run, name="ray_tpu-dashboard",
+                                        daemon=True)
+        self._thread.start()
+        if not ready.wait(timeout=10):
+            raise RuntimeError("Dashboard failed to start within 10s")
+        return self.bound_port
+
+    def stop(self) -> None:
+        import asyncio
+        if self._loop is not None:
+            async def teardown():
+                if self._runner is not None:
+                    await self._runner.cleanup()
+            fut = asyncio.run_coroutine_threadsafe(teardown(), self._loop)
+            try:
+                fut.result(timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+_dashboard: Optional[DashboardHead] = None
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265
+                    ) -> DashboardHead:
+    """Start (or return) the process-wide dashboard head. port=0 picks an
+    ephemeral port (DashboardHead.bound_port)."""
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = DashboardHead(host, port)
+        _dashboard.start()
+    return _dashboard

@@ -86,7 +86,11 @@ class MapOperator(PhysicalOperator):
         self._per_actor[idx] = 0
 
     def add_input(self, bundle: RefBundle) -> None:
-        self._queue.append(bundle)
+        # Normalize to one block per queue entry — upstream bundles may
+        # group several blocks (RefBundle's contract), and every block must
+        # be launched.
+        for block_ref, meta in bundle.blocks:
+            self._queue.append(RefBundle([(block_ref, meta)]))
 
     def work(self) -> None:
         # Launch while capacity remains.

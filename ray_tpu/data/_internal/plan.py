@@ -90,18 +90,23 @@ class ExecutionPlan:
                 fused.append(stage)
         return fused
 
-    def _build_operators(self):
+    def _build_operators(self, options=None):
         """Fused stages → physical operator chain (reference: the logical →
         physical planning in data/_internal/logical/planner.py)."""
         from ray_tpu.data._internal.execution import (AllToAllOperator,
+                                                      ExecutionOptions,
                                                       InputDataBuffer,
                                                       MapOperator)
+        options = options or ExecutionOptions(
+            # Match the bulk path's old default: wide inputs run wide.
+            max_in_flight_per_operator=max(8, len(self._in_blocks)))
         ops = [InputDataBuffer(self._in_blocks, self._in_metadata)]
         for stage in self._fused_stages():
             if isinstance(stage, OneToOneStage):
                 ops.append(MapOperator(
                     stage.name, stage.transform, stage.compute,
-                    stage.num_cpus, stage.udf_constructor))
+                    stage.num_cpus, stage.udf_constructor,
+                    max_in_flight=options.max_in_flight_per_operator))
             else:
                 ops.append(AllToAllOperator(stage.name, stage.fn))
         return ops

@@ -121,6 +121,24 @@ def cmd_job(args) -> int:
     return 1
 
 
+def cmd_dashboard(args) -> int:
+    """`ray-tpu dashboard` — run the HTTP observability endpoint."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    head = start_dashboard(args.host, args.port)
+    print(f"Dashboard listening on http://{args.host}:{head.bound_port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        head.stop()
+    return 0
+
+
 def cmd_serve(args) -> int:
     """`ray-tpu serve deploy/status/shutdown` (analog of the reference's
     `serve` CLI, serve/scripts.py)."""
@@ -176,6 +194,10 @@ def main(argv=None) -> int:
         pj.add_argument("job_id")
     jsub.add_parser("list")
 
+    p = sub.add_parser("dashboard", help="run the HTTP dashboard")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8265)
+
     p = sub.add_parser("serve", help="deploy and inspect Serve apps")
     ssub = p.add_subparsers(dest="serve_command", required=True)
     pd = ssub.add_parser("deploy", help="deploy from a JSON config file")
@@ -194,6 +216,7 @@ def main(argv=None) -> int:
         "devices": cmd_devices,
         "job": cmd_job,
         "serve": cmd_serve,
+        "dashboard": cmd_dashboard,
     }[args.command]
     return handler(args)
 

@@ -69,6 +69,14 @@ class ServeController:
         if existing is not None:
             if existing.version == version and \
                     existing.num_replicas == num_replicas:
+                if existing.user_config != user_config:
+                    # Same code/scale, new user_config: deliver it via
+                    # reconfigure() without replica churn.
+                    existing.user_config = user_config
+                    if user_config is not None:
+                        ray_tpu.get([r.reconfigure.remote(user_config)
+                                     for r in existing.replicas])
+                    return True
                 return False
             # Code/config change: replace replicas (simple rolling=all).
             info.replicas = [] if existing.version != version else \
@@ -100,6 +108,7 @@ class ServeController:
         info = self._deployments.get(name)
         if info is None:
             return
+        new_replicas = []
         while len(info.replicas) < info.num_replicas:
             self._replica_seq += 1
             cls = ray_tpu.remote(ReplicaActor)
@@ -111,6 +120,7 @@ class ServeController:
                 name, info.deployment_def_bytes, info.init_args,
                 info.init_kwargs)
             info.replicas.append(replica)
+            new_replicas.append(replica)
         while len(info.replicas) > info.num_replicas:
             victim = info.replicas.pop()
             ray_tpu.kill(victim)
@@ -118,10 +128,12 @@ class ServeController:
         # Wait for replicas to become ready so run() returns a usable app.
         for r in info.replicas:
             ray_tpu.get(r.ready.remote())
-        if info.user_config is not None:
-            # Reference: user_config reaches each replica via reconfigure().
+        if info.user_config is not None and new_replicas:
+            # user_config reaches NEW replicas via reconfigure(); existing
+            # ones already have it (re-sending on every health tick would
+            # re-run potentially expensive reloads).
             ray_tpu.get([r.reconfigure.remote(info.user_config)
-                         for r in info.replicas])
+                         for r in new_replicas])
 
     async def check_health(self, name: str) -> int:
         """Probe replicas; restart any that died. Returns live count

@@ -25,12 +25,16 @@ class DAGDriver:
             self._single = dags
 
     async def __call__(self, request) -> Any:
-        """HTTP entry: route on path for dict DAGs; pass the JSON body (or
-        raw body) to the target handle."""
-        try:
-            payload = request.json()
-        except Exception:  # noqa: BLE001 - not JSON
-            payload = getattr(request, "body", None)
+        """HTTP entry: route on path for dict DAGs; pass the JSON body to
+        the target handle. Direct handle calls pass their argument through
+        unchanged (it has no .json())."""
+        if hasattr(request, "json"):
+            try:
+                payload = request.json()
+            except Exception:  # noqa: BLE001 - non-JSON body
+                payload = getattr(request, "body", None)
+        else:
+            payload = request
         if self._single is not None:
             return serve_get(self._single.remote(payload))
         path = getattr(request, "path", "/")

@@ -1,0 +1,6 @@
+from ray_tpu.util.client.client import (ClientAPI, ClientObjectRef,
+                                        RayTpuClient, connect)
+from ray_tpu.util.client.server import ClientServer, serve
+
+__all__ = ["ClientAPI", "ClientObjectRef", "ClientServer", "RayTpuClient",
+           "connect", "serve"]

@@ -1,0 +1,172 @@
+"""Thin client: drive a remote cluster over ``ray://host:port``.
+
+Analog of the reference's util/client/worker.py + client_builder.py: the
+client pickles functions/classes to the server-side driver and holds
+ClientObjectRef/ClientActorHandle stubs; get/put/wait/kill proxy over the
+socket protocol (server.py).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.util.client.server import _recv, _send
+
+
+class ClientObjectRef:
+    __slots__ = ("_hex", "_client")
+
+    def __init__(self, hex_id: str, client: "RayTpuClient"):
+        self._hex = hex_id
+        self._client = client
+
+    def hex(self) -> str:
+        return self._hex
+
+    def __repr__(self):
+        return f"ClientObjectRef({self._hex})"
+
+    def __hash__(self):
+        return hash(self._hex)
+
+    def __eq__(self, other):
+        return isinstance(other, ClientObjectRef) and \
+            other._hex == self._hex
+
+
+class ClientActorHandle:
+    def __init__(self, actor_id: str, client: "RayTpuClient"):
+        self._actor_id = actor_id
+        self._client = client
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+        return _ClientMethod(self, method)
+
+
+class _ClientMethod:
+    def __init__(self, handle: ClientActorHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> ClientObjectRef:
+        client = self._handle._client
+        reply = client._call({"op": "actor_call",
+                              "actor": self._handle._actor_id,
+                              "method": self._method,
+                              "args": args, "kwargs": kwargs})
+        return ClientObjectRef(reply["ref"], client)
+
+
+class _ClientRemoteFunction:
+    def __init__(self, fn, client: "RayTpuClient",
+                 options: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._client = client
+        self._options = options
+
+    def options(self, **opts) -> "_ClientRemoteFunction":
+        return _ClientRemoteFunction(self._fn, self._client, opts)
+
+    def remote(self, *args, **kwargs) -> ClientObjectRef:
+        wire_args = ["\0" + a.hex() if isinstance(a, ClientObjectRef)
+                     else a for a in args]
+        reply = self._client._call({
+            "op": "task", "fn": self._fn, "args": wire_args,
+            "kwargs": kwargs, "options": self._options})
+        return ClientObjectRef(reply["ref"], self._client)
+
+
+class _ClientRemoteClass:
+    def __init__(self, cls, client: "RayTpuClient",
+                 options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._client = client
+        self._options = options
+
+    def options(self, **opts) -> "_ClientRemoteClass":
+        return _ClientRemoteClass(self._cls, self._client, opts)
+
+    def remote(self, *args, **kwargs) -> ClientActorHandle:
+        reply = self._client._call({
+            "op": "actor_create", "cls": self._cls, "args": args,
+            "kwargs": kwargs, "options": self._options})
+        return ClientActorHandle(reply["actor"], self._client)
+
+
+class RayTpuClient:
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+        reply = self._call({"op": "ping"})
+        self.server_version = reply["version"]
+
+    def _call(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        import cloudpickle
+        with self._lock:
+            _send(self._sock, cloudpickle.dumps(msg))
+            raw = _recv(self._sock)
+        if raw is None:
+            raise ConnectionError("Client server closed the connection")
+        reply = cloudpickle.loads(raw)
+        if "error" in reply:
+            raise reply["error"]
+        return reply
+
+    # -- API mirroring the top-level surface ------------------------------
+
+    def remote(self, fn_or_class):
+        import inspect
+        if inspect.isclass(fn_or_class):
+            return _ClientRemoteClass(fn_or_class, self)
+        return _ClientRemoteFunction(fn_or_class, self)
+
+    def put(self, value: Any) -> ClientObjectRef:
+        return ClientObjectRef(self._call({"op": "put",
+                                           "value": value})["ref"], self)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ClientObjectRef)
+        ref_list = [refs] if single else list(refs)
+        values = self._call({"op": "get",
+                             "refs": [r.hex() for r in ref_list],
+                             "timeout": timeout})["values"]
+        return values[0] if single else values
+
+    def wait(self, refs: List[ClientObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None):
+        reply = self._call({"op": "wait",
+                            "refs": [r.hex() for r in refs],
+                            "num_returns": num_returns,
+                            "timeout": timeout})
+        by_hex = {r.hex(): r for r in refs}
+        return ([by_hex[h] for h in reply["ready"]],
+                [by_hex[h] for h in reply["pending"]])
+
+    def kill(self, handle: ClientActorHandle) -> None:
+        self._call({"op": "actor_kill", "actor": handle._actor_id})
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self._call({"op": "cluster_resources"})["resources"]
+
+    def disconnect(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+ClientAPI = RayTpuClient
+
+
+def connect(address: str) -> RayTpuClient:
+    """Connect to a client server. Accepts 'host:port' or
+    'ray://host:port'."""
+    if address.startswith("ray://"):
+        address = address[len("ray://"):]
+    host, _, port = address.partition(":")
+    return RayTpuClient(host or "127.0.0.1", int(port or 10001))

@@ -1,0 +1,154 @@
+"""Client server: hosts remote drivers over a socket protocol.
+
+Analog of the reference's util/client/server (server.py:96 RayletServicer):
+a driver process runs this server; thin clients connect over TCP and
+proxy put/get/task/actor calls into the server's runtime. Frames are
+length-prefixed cloudpickle messages (the reference uses gRPC; the wire
+format differs, the capability — remote drivers against a live cluster —
+is the same).
+
+SECURITY: the protocol executes pickled callables from connected clients,
+exactly like the reference's Ray Client; bind only on trusted interfaces.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+
+def _send(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv(sock: socket.socket) -> Optional[bytes]:
+    header = b""
+    while len(header) < 8:
+        chunk = sock.recv(8 - len(header))
+        if not chunk:
+            return None
+        header += chunk
+    (length,) = struct.unpack("<Q", header)
+    data = b""
+    while len(data) < length:
+        chunk = sock.recv(min(1 << 20, length - len(data)))
+        if not chunk:
+            return None
+        data += chunk
+    return data
+
+
+class _Session:
+    """Per-connection state: refs and actors the client knows by id."""
+
+    def __init__(self):
+        self.refs: Dict[str, Any] = {}
+        self.actors: Dict[str, Any] = {}
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        import cloudpickle
+        session = _Session()
+        while True:
+            raw = _recv(self.request)
+            if raw is None:
+                return
+            try:
+                msg = cloudpickle.loads(raw)
+                reply = self._dispatch(session, msg)
+            except BaseException as exc:  # noqa: BLE001 - ship to client
+                reply = {"error": exc}
+            _send(self.request, cloudpickle.dumps(reply))
+
+    def _dispatch(self, session: _Session, msg: Dict[str, Any]
+                  ) -> Dict[str, Any]:
+        op = msg["op"]
+        if op == "ping":
+            return {"ok": True, "version": ray_tpu.__version__}
+        if op == "put":
+            ref = ray_tpu.put(msg["value"])
+            session.refs[ref.hex()] = ref
+            return {"ref": ref.hex()}
+        if op == "get":
+            refs = [session.refs[h] for h in msg["refs"]]
+            return {"values": ray_tpu.get(refs, timeout=msg.get("timeout"))}
+        if op == "wait":
+            refs = [session.refs[h] for h in msg["refs"]]
+            ready, pending = ray_tpu.wait(
+                refs, num_returns=msg["num_returns"],
+                timeout=msg.get("timeout"))
+            return {"ready": [r.hex() for r in ready],
+                    "pending": [r.hex() for r in pending]}
+        if op == "task":
+            fn = msg["fn"]
+            args = [session.refs[a[1:]] if isinstance(a, str)
+                    and a.startswith("\0") else a for a in msg["args"]]
+            options = msg.get("options") or {}
+            remote_fn = ray_tpu.remote(fn)
+            if options:
+                remote_fn = remote_fn.options(**options)
+            ref = remote_fn.remote(*args, **msg.get("kwargs", {}))
+            session.refs[ref.hex()] = ref
+            return {"ref": ref.hex()}
+        if op == "actor_create":
+            cls = msg["cls"]
+            options = msg.get("options") or {}
+            remote_cls = ray_tpu.remote(cls)
+            if options:
+                remote_cls = remote_cls.options(**options)
+            handle = remote_cls.remote(*msg.get("args", ()),
+                                       **msg.get("kwargs", {}))
+            actor_id = handle._actor_id.hex()
+            session.actors[actor_id] = handle
+            return {"actor": actor_id}
+        if op == "actor_call":
+            handle = session.actors[msg["actor"]]
+            method = getattr(handle, msg["method"])
+            ref = method.remote(*msg.get("args", ()),
+                                **msg.get("kwargs", {}))
+            session.refs[ref.hex()] = ref
+            return {"ref": ref.hex()}
+        if op == "actor_kill":
+            handle = session.actors.pop(msg["actor"], None)
+            if handle is not None:
+                ray_tpu.kill(handle)
+            return {"ok": True}
+        if op == "free":
+            for h in msg["refs"]:
+                session.refs.pop(h, None)
+            return {"ok": True}
+        if op == "cluster_resources":
+            return {"resources": ray_tpu.cluster_resources()}
+        raise ValueError(f"Unknown op {op!r}")
+
+
+class _ThreadingTCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class ClientServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 10001):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._server = _ThreadingTCPServer((host, port), _Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="ray_tpu-client-server",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def serve(host: str = "127.0.0.1", port: int = 10001) -> ClientServer:
+    """Start the client server (``ray://host:port`` endpoint)."""
+    return ClientServer(host, port)

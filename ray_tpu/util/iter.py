@@ -1,0 +1,121 @@
+"""ParallelIterator: sharded, lazily-transformed distributed iterators.
+
+Analog of the reference's util/iter.py: ``from_items``/``from_range``
+shard data across actor-held iterators; ``for_each``/``filter``/``batch``
+chain lazily per shard; ``gather_sync`` round-robins results back to the
+driver.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator, List
+
+import ray_tpu
+
+
+class _ShardActor:
+    def __init__(self, items: List[Any]):
+        self._items = items
+        self._ops: List[tuple] = []
+
+    def apply_op(self, kind: str, fn_bytes: bytes) -> bool:
+        self._ops.append((kind, fn_bytes))
+        return True
+
+    def run(self) -> List[Any]:
+        import cloudpickle
+        out: Iterator[Any] = iter(self._items)
+        for kind, fn_bytes in self._ops:
+            fn = cloudpickle.loads(fn_bytes) if fn_bytes else None
+            if kind == "for_each":
+                out = map(fn, out)
+            elif kind == "filter":
+                out = filter(fn, out)
+            elif kind == "flatten":
+                out = (x for it in out for x in it)
+            elif kind == "batch":
+                size = fn  # int smuggled through pickle
+
+                def batcher(src, n):
+                    buf = []
+                    for x in src:
+                        buf.append(x)
+                        if len(buf) == n:
+                            yield buf
+                            buf = []
+                    if buf:
+                        yield buf
+
+                out = batcher(out, size)
+        return list(out)
+
+
+class ParallelIterator:
+    def __init__(self, shards: List[Any]):
+        self._shards = shards
+
+    @staticmethod
+    def from_items(items: List[Any], num_shards: int = 2
+                   ) -> "ParallelIterator":
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        cls = ray_tpu.remote(_ShardActor)
+        chunks: List[List[Any]] = [[] for _ in range(num_shards)]
+        for i, item in enumerate(items):
+            chunks[i % num_shards].append(item)
+        return ParallelIterator([cls.remote(c) for c in chunks])
+
+    @staticmethod
+    def from_range(n: int, num_shards: int = 2) -> "ParallelIterator":
+        return ParallelIterator.from_items(list(range(n)), num_shards)
+
+    def _chain(self, kind: str, payload) -> "ParallelIterator":
+        import cloudpickle
+        blob = cloudpickle.dumps(payload)
+        ray_tpu.get([s.apply_op.remote(kind, blob) for s in self._shards])
+        return self
+
+    def for_each(self, fn: Callable) -> "ParallelIterator":
+        return self._chain("for_each", fn)
+
+    def filter(self, fn: Callable) -> "ParallelIterator":
+        return self._chain("filter", fn)
+
+    def flatten(self) -> "ParallelIterator":
+        return self._chain("flatten", None)
+
+    def batch(self, n: int) -> "ParallelIterator":
+        return self._chain("batch", n)
+
+    def num_shards(self) -> int:
+        return len(self._shards)
+
+    def gather_sync(self) -> Iterator[Any]:
+        """Round-robin merge of all shards' results."""
+        results = ray_tpu.get([s.run.remote() for s in self._shards])
+        iters = [iter(r) for r in results]
+        while iters:
+            alive = []
+            for it in iters:
+                try:
+                    yield next(it)
+                    alive.append(it)
+                except StopIteration:
+                    pass
+            iters = alive
+
+    def take(self, n: int) -> List[Any]:
+        out = []
+        for item in self.gather_sync():
+            out.append(item)
+            if len(out) >= n:
+                break
+        return out
+
+    def stop(self) -> None:
+        for s in self._shards:
+            ray_tpu.kill(s)
+
+
+from_items = ParallelIterator.from_items
+from_range = ParallelIterator.from_range

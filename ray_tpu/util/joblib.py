@@ -1,0 +1,79 @@
+"""joblib backend over the task runtime.
+
+Analog of the reference's util/joblib/: ``register_ray()`` installs a
+joblib parallel backend whose batches run as cluster tasks, so
+``with joblib.parallel_backend("ray_tpu"): Parallel()(delayed(f)(x) ...)``
+fans out across the cluster.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import ray_tpu
+
+
+def register_ray() -> None:
+    """Register the 'ray_tpu' joblib backend (import-gated)."""
+    from joblib import register_parallel_backend
+    from joblib._parallel_backends import ParallelBackendBase
+
+    class _AsyncBatchResult:
+        """Future-like handle joblib polls: the batch runs as a task; a
+        watcher thread fires joblib's callback on completion (joblib's
+        retrieval protocol requires the callback to be asynchronous)."""
+
+        def __init__(self, ref, callback):
+            self._ref = ref
+            self._event = threading.Event()
+            self._result = None
+            self._error = None
+
+            def watch():
+                try:
+                    self._result = ray_tpu.get(ref)
+                except BaseException as exc:  # noqa: BLE001
+                    self._error = exc
+                finally:
+                    self._event.set()
+                    if callback is not None:
+                        callback(self)
+
+            threading.Thread(target=watch, daemon=True).start()
+
+        def get(self, timeout=None):
+            if not self._event.wait(timeout):
+                raise TimeoutError("joblib batch timed out")
+            if self._error is not None:
+                raise self._error
+            return self._result
+
+    class RayTpuBackend(ParallelBackendBase):
+        supports_timeout = True
+        uses_threads = False
+        supports_sharedmem = False
+        supports_retrieve_callback = True
+
+        def configure(self, n_jobs=1, parallel=None, **backend_args):
+            self.parallel = parallel
+            return self.effective_n_jobs(n_jobs)
+
+        def effective_n_jobs(self, n_jobs):
+            if n_jobs == 1:
+                return 1
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 1)) or 1
+            return cpus if n_jobs in (-1, None) else n_jobs
+
+        def apply_async(self, func, callback=None):
+            task = ray_tpu.remote(lambda: func())
+            return _AsyncBatchResult(task.remote(), callback)
+
+        def retrieve_result_callback(self, out):
+            return out.get()
+
+        def abort_everything(self, ensure_ready=True):
+            if ensure_ready:
+                self.configure(n_jobs=self.parallel.n_jobs,
+                               parallel=self.parallel)
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

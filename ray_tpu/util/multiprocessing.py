@@ -1,0 +1,167 @@
+"""multiprocessing.Pool API over the task runtime.
+
+Analog of the reference's util/multiprocessing/pool.py: a drop-in
+``Pool`` whose workers are cluster tasks — ``map``/``starmap``/``apply``
+(+async/unordered variants, chunking) schedule across the cluster instead
+of local forked processes.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs: List[Any], single: bool = False,
+                 chunked: bool = False):
+        self._refs = refs
+        self._single = single
+        self._chunked = chunked
+
+    def get(self, timeout: Optional[float] = None):
+        results = ray_tpu.get(self._refs, timeout=timeout)
+        if self._chunked:
+            results = [item for chunk in results for item in chunk]
+        return results[0] if self._single else results
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+
+class Pool:
+    """``ray_tpu.util.multiprocessing.Pool(processes=N)``."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = (), ray_remote_args: Optional[dict] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        cpus = ray_tpu.cluster_resources().get("CPU", 1)
+        self._processes = processes or max(int(cpus), 1)
+        self._initializer = initializer
+        self._initargs = initargs
+        self._remote_args = ray_remote_args or {}
+        self._closed = False
+
+    def _task(self, fn: Callable):
+        initializer, initargs = self._initializer, self._initargs
+
+        def runner(chunk):
+            if initializer is not None and not getattr(
+                    runner, "_initialized", False):
+                initializer(*initargs)
+                runner._initialized = True  # type: ignore[attr-defined]
+            return [fn(*args) if isinstance(args, tuple) else fn(args)
+                    for args in chunk]
+
+        return ray_tpu.remote(**self._remote_args)(runner) \
+            if self._remote_args else ray_tpu.remote(runner)
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    @staticmethod
+    def _chunks(iterable: Iterable, chunksize: int) -> List[List[Any]]:
+        it = iter(iterable)
+        out = []
+        while True:
+            chunk = list(itertools.islice(it, chunksize))
+            if not chunk:
+                return out
+            out.append(chunk)
+
+    def _default_chunksize(self, items: List[Any]) -> int:
+        return max(1, len(items) // (self._processes * 4) or 1)
+
+    # -- apply -----------------------------------------------------------
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: dict = None) -> AsyncResult:
+        self._check_open()
+        kwds = kwds or {}
+        task = ray_tpu.remote(lambda: fn(*args, **kwds))
+        return AsyncResult([task.remote()], single=True)
+
+    # -- map -------------------------------------------------------------
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        self._check_open()
+        items = list(iterable)
+        chunks = self._chunks(items, chunksize
+                              or self._default_chunksize(items))
+        task = self._task(fn)
+        return AsyncResult([task.remote(c) for c in chunks], chunked=True)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple],
+                chunksize: Optional[int] = None) -> List[Any]:
+        return self.map(fn, [tuple(args) for args in iterable], chunksize)
+
+    def starmap_async(self, fn: Callable, iterable: Iterable[tuple],
+                      chunksize: Optional[int] = None) -> AsyncResult:
+        return self.map_async(fn, [tuple(a) for a in iterable], chunksize)
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        self._check_open()
+        items = list(iterable)
+        chunks = self._chunks(items, chunksize
+                              or self._default_chunksize(items))
+        task = self._task(fn)
+        refs = [task.remote(c) for c in chunks]
+        for ref in refs:  # in order
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: Optional[int] = None):
+        self._check_open()
+        items = list(iterable)
+        chunks = self._chunks(items, chunksize
+                              or self._default_chunksize(items))
+        task = self._task(fn)
+        pending = [task.remote(c) for c in chunks]
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            yield from ray_tpu.get(ready[0])
+
+    # -- lifecycle --------------------------------------------------------
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("Pool is still open")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()

@@ -1,0 +1,154 @@
+"""Distributed tracing: spans propagated through task submission.
+
+Analog of the reference's util/tracing/tracing_helper.py (OpenTelemetry
+spans wrapping every .remote() with the context carried inside task specs,
+_DictPropagator :160): an OTel-compatible-shaped but dependency-free span
+recorder. Enable with ``enable_tracing()``; every task/actor call then
+records a span parented to the caller's active span, and ``get_spans()`` /
+``export_chrome_trace()`` expose the tree.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+_state = threading.local()
+_lock = threading.Lock()
+_spans: List["Span"] = []
+_enabled = False
+_MAX_SPANS = 100_000
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start_time: float
+    end_time: Optional[float] = None
+    attributes: Dict[str, Any] = field(default_factory=dict)
+
+    def end(self) -> None:
+        if self.end_time is None:
+            self.end_time = time.time()
+
+
+def enable_tracing() -> None:
+    """Turn span recording on (reference: ray.init(_tracing_startup_hook))."""
+    global _enabled
+    _enabled = True
+
+
+def disable_tracing() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_tracing_enabled() -> bool:
+    return _enabled
+
+
+def clear_spans() -> None:
+    with _lock:
+        _spans.clear()
+
+
+def current_span() -> Optional[Span]:
+    return getattr(_state, "span", None)
+
+
+def _record(span: Span) -> None:
+    with _lock:
+        if len(_spans) < _MAX_SPANS:
+            _spans.append(span)
+
+
+@contextlib.contextmanager
+def start_span(name: str, attributes: Optional[Dict[str, Any]] = None):
+    """Open a span as the thread's active context; nested spans (and remote
+    tasks submitted inside) are parented to it."""
+    if not _enabled:
+        yield None
+        return
+    parent = current_span()
+    span = Span(
+        name=name,
+        trace_id=parent.trace_id if parent else uuid.uuid4().hex[:16],
+        span_id=uuid.uuid4().hex[:8],
+        parent_id=parent.span_id if parent else None,
+        start_time=time.time(),
+        attributes=dict(attributes or {}),
+    )
+    _record(span)
+    prev = parent
+    _state.span = span
+    try:
+        yield span
+    finally:
+        span.end()
+        _state.span = prev
+
+
+def inject_context() -> Optional[Dict[str, str]]:
+    """Serialize the active span context for a task spec (the reference's
+    _DictPropagator.inject_current_context)."""
+    span = current_span()
+    if not _enabled or span is None:
+        return None
+    return {"trace_id": span.trace_id, "parent_id": span.span_id}
+
+
+@contextlib.contextmanager
+def continue_context(ctx: Optional[Dict[str, str]], name: str):
+    """Worker-side: run a task under the caller's trace context."""
+    if not _enabled or ctx is None:
+        yield None
+        return
+    span = Span(
+        name=name,
+        trace_id=ctx["trace_id"],
+        span_id=uuid.uuid4().hex[:8],
+        parent_id=ctx.get("parent_id"),
+        start_time=time.time(),
+    )
+    _record(span)
+    prev = current_span()
+    _state.span = span
+    try:
+        yield span
+    finally:
+        span.end()
+        _state.span = prev
+
+
+def get_spans(trace_id: Optional[str] = None) -> List[Span]:
+    with _lock:
+        spans = list(_spans)
+    if trace_id is not None:
+        spans = [s for s in spans if s.trace_id == trace_id]
+    return spans
+
+
+def export_chrome_trace() -> List[Dict[str, Any]]:
+    """Spans as chrome://tracing complete events (merges into the timeline
+    the state API already emits)."""
+    out = []
+    for s in get_spans():
+        end = s.end_time or time.time()
+        out.append({
+            "name": s.name,
+            "cat": "trace",
+            "ph": "X",
+            "ts": s.start_time * 1e6,
+            "dur": (end - s.start_time) * 1e6,
+            "pid": s.trace_id,
+            "tid": s.span_id,
+            "args": s.attributes,
+        })
+    return out

@@ -1,0 +1,200 @@
+"""Ops-layer tests: dashboard HTTP, ray client, tracing, usage stats,
+multiprocessing Pool, joblib backend, ParallelIterator."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_dashboard_endpoints(ray_start_regular):
+    from ray_tpu.dashboard.head import DashboardHead
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get([noop.remote() for _ in range(3)])
+    head = DashboardHead(port=0)
+    port = head.start()
+    try:
+        version = _get_json(port, "/api/version")
+        assert version["version"] == ray_tpu.__version__
+        status = _get_json(port, "/api/cluster_status")
+        assert status["cluster_resources"].get("CPU", 0) > 0
+        tasks = _get_json(port, "/api/v0/tasks")["result"]
+        assert any("noop" in t["name"] for t in tasks)
+        summary = _get_json(port, "/api/v0/tasks/summarize")["result"]
+        assert summary
+        # prometheus text endpoint answers
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            assert resp.status == 200
+        # unknown resource → 404
+        with pytest.raises(urllib.error.HTTPError):
+            _get_json(port, "/api/v0/bogus")
+    finally:
+        head.stop()
+
+
+def test_dashboard_job_rest(ray_start_regular):
+    from ray_tpu.dashboard.head import DashboardHead
+    head = DashboardHead(port=0)
+    port = head.start()
+    try:
+        body = json.dumps(
+            {"entrypoint": "python -c \"print('from-rest')\""}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/jobs/", data=body,
+            headers={"Content-Type": "application/json"})
+        sub = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        job_id = sub["submission_id"]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            info = _get_json(port, f"/api/jobs/{job_id}")
+            if info["status"] in ("SUCCEEDED", "FAILED"):
+                break
+            time.sleep(0.2)
+        assert info["status"] == "SUCCEEDED", info
+        logs = _get_json(port, f"/api/jobs/{job_id}/logs")["logs"]
+        assert "from-rest" in logs
+    finally:
+        head.stop()
+
+
+def test_ray_client_roundtrip(ray_start_regular):
+    from ray_tpu.util.client import connect, serve
+    server = serve(port=0)
+    try:
+        api = connect(f"ray://127.0.0.1:{server.port}")
+
+        def add(a, b):
+            return a + b
+
+        remote_add = api.remote(add)
+        ref = remote_add.remote(2, 3)
+        assert api.get(ref) == 5
+        data = api.put([1, 2, 3])
+        ref2 = remote_add.remote(data, [4])
+        assert api.get(ref2) == [1, 2, 3, 4]
+        ready, pending = api.wait([ref, ref2], num_returns=2)
+        assert len(ready) == 2 and not pending
+
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        handle = api.remote(Counter).remote()
+        assert api.get(handle.incr.remote()) == 1
+        assert api.get(handle.incr.remote()) == 2
+        api.kill(handle)
+        assert api.cluster_resources().get("CPU", 0) > 0
+        # errors propagate
+        def boom():
+            raise ValueError("client-side boom")
+        with pytest.raises(Exception, match="boom"):
+            api.get(api.remote(boom).remote())
+        api.disconnect()
+    finally:
+        server.stop()
+
+
+def test_tracing_spans_propagate(ray_start_regular):
+    from ray_tpu.util import tracing
+    tracing.enable_tracing()
+    tracing.clear_spans()
+    try:
+        @ray_tpu.remote
+        def traced_task():
+            return 7
+
+        with tracing.start_span("driver_op") as root:
+            ref = traced_task.remote()
+            assert ray_tpu.get(ref) == 7
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            spans = tracing.get_spans(trace_id=root.trace_id)
+            if len(spans) >= 2:
+                break
+            time.sleep(0.05)
+        names = {s.name for s in spans}
+        assert "driver_op" in names
+        assert any(n.startswith("task::") and "traced_task" in n
+                   for n in names)
+        child = next(s for s in spans
+                     if s.name.startswith("task::") and "traced_task" in s.name)
+        assert child.parent_id == root.span_id
+        events = tracing.export_chrome_trace()
+        assert any("traced_task" in e["name"] for e in events)
+    finally:
+        tracing.disable_tracing()
+        tracing.clear_spans()
+
+
+def test_usage_stats_local_only(tmp_path):
+    from ray_tpu._private import usage_stats
+    usage_stats.reset()
+    usage_stats.record_library_usage("train")
+    usage_stats.record_extra_usage_tag("tasks_submitted", 5)
+    report = usage_stats.usage_report()
+    assert report["libraries_used"] == ["train"]
+    assert report["counters"]["tasks_submitted"] == 5
+    path = usage_stats.write_usage_report(str(tmp_path))
+    assert json.load(open(path))["libraries_used"] == ["train"]
+
+
+def test_multiprocessing_pool(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=4) as pool:
+        assert pool.map(lambda x: x * x, range(20)) == \
+            [x * x for x in range(20)]
+        assert pool.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+        assert pool.apply(lambda a: a + 1, (41,)) == 42
+        async_res = pool.map_async(lambda x: x + 1, range(10))
+        assert async_res.get(timeout=30) == list(range(1, 11))
+        assert sorted(pool.imap_unordered(lambda x: -x, range(5))) == \
+            [-4, -3, -2, -1, 0]
+        assert list(pool.imap(lambda x: x * 10, range(4))) == [0, 10, 20, 30]
+    with pytest.raises(ValueError):
+        pool.map(lambda x: x, [1])
+
+
+def test_joblib_backend(ray_start_regular):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel(n_jobs=4)(
+            joblib.delayed(lambda x: x ** 2)(i) for i in range(12))
+    assert out == [i ** 2 for i in range(12)]
+
+
+def test_parallel_iterator(ray_start_regular):
+    from ray_tpu.util.iter import from_range
+
+    it = from_range(12, num_shards=3).for_each(lambda x: x * 2) \
+        .filter(lambda x: x % 4 == 0)
+    vals = sorted(it.gather_sync())
+    assert vals == [x * 2 for x in range(12) if (x * 2) % 4 == 0]
+    it.stop()
+
+    batched = from_range(10, num_shards=2).batch(3)
+    batches = list(batched.gather_sync())
+    assert sorted(x for b in batches for x in b) == list(range(10))
+    batched.stop()
