@@ -1,0 +1,183 @@
+"""Memory monitor + OOM worker-killing policies.
+
+Analogs of the reference's MemoryMonitor (src/ray/common/memory_monitor.h)
+and the raylet's worker-killing policies
+(src/ray/raylet/worker_killing_policy.h + _group_by_owner variant): a
+periodic poll of system/cgroup memory (native memmon.cc, /proc fallback in
+Python) that, above ``memory_usage_threshold``, picks a victim among the
+running tasks and fails it with an OutOfMemoryError — retriable tasks are
+preferred victims, newest first, so forward progress (the oldest work) is
+protected.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger("ray_tpu")
+
+
+def _load():
+    import ctypes
+
+    from ray_tpu._private.native_build import load_library_cached
+
+    def configure(lib):
+        lib.rmm_snapshot.restype = ctypes.c_int64
+        lib.rmm_snapshot.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.rmm_usage_fraction.restype = ctypes.c_double
+        lib.rmm_usage_fraction.argtypes = []
+
+    return load_library_cached("memmon", configure=configure)
+
+
+def memory_snapshot() -> Dict[str, int]:
+    """{'system_total', 'system_available', 'cgroup_limit', 'cgroup_used'}
+    in bytes (-1 unknown, cgroup_limit -2 unlimited)."""
+    lib = _load()
+    if lib is not None:
+        import ctypes
+        buf = ctypes.create_string_buffer(512)
+        lib.rmm_snapshot(buf, 512)
+        out = {}
+        for part in buf.value.decode().split(";"):
+            k, _, v = part.partition("=")
+            out[k] = int(v)
+        return out
+    # Python fallback (same fields).
+    out = {"system_total": -1, "system_available": -1,
+           "cgroup_limit": -1, "cgroup_used": -1}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    out["system_total"] = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    out["system_available"] = int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    try:
+        with open("/sys/fs/cgroup/memory.max") as f:
+            raw = f.read().strip()
+            out["cgroup_limit"] = -2 if raw == "max" else int(raw)
+        with open("/sys/fs/cgroup/memory.current") as f:
+            out["cgroup_used"] = int(f.read().strip())
+    except OSError:
+        pass
+    return out
+
+
+def usage_fraction(snapshot: Optional[Dict[str, int]] = None) -> float:
+    """Effective memory pressure in [0, 1]; -1 if unknown."""
+    s = snapshot or memory_snapshot()
+    if s.get("cgroup_limit", -1) > 0 and s.get("cgroup_used", -1) >= 0:
+        return s["cgroup_used"] / s["cgroup_limit"]
+    if s.get("system_total", -1) > 0 and s.get("system_available", -1) >= 0:
+        return 1.0 - s["system_available"] / s["system_total"]
+    return -1.0
+
+
+# -- worker-killing policies ----------------------------------------------
+
+
+def retriable_lifo_policy(tasks: List[Any]) -> Optional[Any]:
+    """The reference's RetriableLIFOWorkerKillingPolicy: prefer a task that
+    can retry; among those, the most recently started (its lost progress is
+    smallest)."""
+    def start_time(spec):
+        return getattr(spec, "_start_time", 0.0)
+
+    retriable = [t for t in tasks
+                 if t.attempt_number < t.max_retries]
+    pool = retriable or list(tasks)
+    if not pool:
+        return None
+    return max(pool, key=start_time)
+
+
+def group_by_owner_policy(tasks: List[Any]) -> Optional[Any]:
+    """The reference's GroupByOwner policy: find the owner (job/actor) with
+    the most running tasks and kill its newest retriable task — spreading
+    pain away from small owners."""
+    groups: Dict[Any, List[Any]] = {}
+    for t in tasks:
+        owner = t.actor_id or getattr(t.task_id, "job_id", lambda: None)()
+        groups.setdefault(owner, []).append(t)
+    if not groups:
+        return None
+    largest = max(groups.values(), key=len)
+    return retriable_lifo_policy(largest)
+
+
+POLICIES = {
+    "retriable_lifo": retriable_lifo_policy,
+    "group_by_owner": group_by_owner_policy,
+}
+
+
+class MemoryMonitor:
+    """Polls memory pressure every ``refresh_ms``; above ``threshold`` asks
+    the runtime for its running tasks, picks a victim via the policy, and
+    invokes ``kill_fn(spec)``."""
+
+    def __init__(self, threshold: float, refresh_ms: int,
+                 get_running_tasks: Callable[[], List[Any]],
+                 kill_fn: Callable[[Any], None],
+                 policy: str = "retriable_lifo",
+                 usage_fn: Callable[[], float] = usage_fraction,
+                 kill_cooldown_s: Optional[float] = None):
+        self.threshold = threshold
+        self.refresh_s = max(refresh_ms, 50) / 1000.0
+        self._get_running = get_running_tasks
+        self._kill = kill_fn
+        self._policy = POLICIES[policy]
+        self._usage = usage_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.kills = 0
+        # After a kill, back off before killing again: the victim (a thread
+        # in this backend) needs time to actually unwind and release memory;
+        # killing every poll would burn retry budgets without reclaiming
+        # anything (the reference kills whole worker processes).
+        self.kill_cooldown_s = (kill_cooldown_s if kill_cooldown_s is not None
+                                else max(10 * self.refresh_s, 2.0))
+        self._last_kill = float("-inf")
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="ray_tpu-memmon", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def check_once(self) -> Optional[Any]:
+        """One poll; returns the killed spec (tests drive this directly)."""
+        frac = self._usage()
+        if frac < 0 or frac < self.threshold:
+            return None
+        if time.monotonic() - self._last_kill < self.kill_cooldown_s:
+            return None
+        victim = self._policy(self._get_running())
+        if victim is None:
+            return None
+        logger.warning(
+            "Memory pressure %.0f%% above threshold %.0f%%: killing task "
+            "%s (attempt %d/%d)", frac * 100, self.threshold * 100,
+            victim.name, victim.attempt_number, victim.max_retries)
+        self._kill(victim)
+        self.kills += 1
+        self._last_kill = time.monotonic()
+        return victim
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.refresh_s):
+            try:
+                self.check_once()
+            except Exception:  # noqa: BLE001 - monitor must survive
+                logger.exception("memory monitor poll failed")

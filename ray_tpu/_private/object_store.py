@@ -31,6 +31,24 @@ from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import GetTimeoutError, ObjectFreedError, ObjectLostError
 
 
+def _estimate_size(value: Any) -> int:
+    """Cheap size estimate for inline values — exact for the payloads that
+    matter to spilling (arrays, bytes); containers of arrays count their
+    array contents one level deep."""
+    nbytes = getattr(value, "nbytes", None)
+    if isinstance(nbytes, int):
+        return nbytes
+    if isinstance(value, (bytes, bytearray)):
+        return len(value)
+    if isinstance(value, str):
+        return len(value)
+    if isinstance(value, (list, tuple)) and value:
+        return sum(_estimate_size(v) for v in value)
+    if isinstance(value, dict) and value:
+        return sum(_estimate_size(v) for v in value.values())
+    return 64
+
+
 @dataclass
 class _Entry:
     event: threading.Event = field(default_factory=threading.Event)
@@ -42,6 +60,8 @@ class _Entry:
     in_native: bool = False
     size_bytes: int = 0
     create_time: float = 0.0
+    spilled_path: Optional[str] = None
+    pinned: bool = False  # restored-and-read objects are not re-spilled
 
 
 class ObjectStore:
@@ -50,11 +70,24 @@ class ObjectStore:
     NATIVE_THRESHOLD = 1 << 20
 
     def __init__(self, deserializer: Optional[Callable[[bytes], Any]] = None,
-                 native_capacity: int = 0, use_native: bool = True):
+                 native_capacity: int = 0, use_native: bool = True,
+                 spill_threshold_bytes: int = 0,
+                 spill_directory: Optional[str] = None):
         self._entries: Dict[ObjectID, _Entry] = {}
         self._lock = threading.Lock()
         self._deserializer = deserializer
         self._total_bytes = 0
+        # Spilling (reference: raylet LocalObjectManager spill/restore +
+        # plasma fallback allocation): past the threshold, the coldest
+        # sealed values are cloudpickled to disk and restored on get.
+        self._spill_threshold = spill_threshold_bytes
+        self._spill_dir = spill_directory
+        self._spilled_bytes = 0
+        self._spill_count = 0
+        self._restore_count = 0
+        # Insertion-ordered spill candidates (puts are time-ordered, so the
+        # front is the coldest) — avoids O(n) victim scans under the lock.
+        self._spill_order: Dict[ObjectID, None] = {}
         self._native = None
         if use_native and native_capacity > 0 and os.environ.get(
                 "RAY_TPU_NATIVE_STORE", "1") != "0":
@@ -107,10 +140,16 @@ class ObjectStore:
                 entry.size_bytes = value.nbytes
             else:
                 entry.value = value
+                entry.size_bytes = _estimate_size(value)
+                self._total_bytes += entry.size_bytes
+                if (self._spill_threshold and not is_exception
+                        and entry.size_bytes > 0):
+                    self._spill_order[object_id] = None
             entry.deserialized = True
             entry.is_exception = is_exception
             entry.create_time = time.time()
             entry.event.set()
+        self._maybe_spill()
 
     def put_serialized(self, object_id: ObjectID, payload: bytes,
                        is_exception: bool = False) -> None:
@@ -124,6 +163,96 @@ class ObjectStore:
             entry.create_time = time.time()
             self._total_bytes += len(payload)
             entry.event.set()
+        self._maybe_spill()
+
+    # -- spilling ---------------------------------------------------------
+
+    def _maybe_spill(self) -> None:
+        """Spill coldest sealed values to disk while over the threshold
+        (reference: raylet/local_object_manager.h SpillObjects). Victims are
+        serialized outside the lock; a racing free/invalidate wins."""
+        if not self._spill_threshold or self._spill_dir is None:
+            return
+        import cloudpickle
+        while True:
+            with self._lock:
+                if self._total_bytes <= self._spill_threshold:
+                    return
+                victim = None
+                victim_id = None
+                # Pop from the insertion-ordered candidates: the front is
+                # the coldest; permanently ineligible entries fall out.
+                for oid in list(self._spill_order):
+                    entry = self._entries.get(oid)
+                    if entry is None or entry.freed or entry.pinned \
+                            or entry.spilled_path is not None \
+                            or entry.value is None \
+                            or entry.serialized is not None:
+                        # serialized retained → spilling frees no memory
+                        del self._spill_order[oid]
+                        continue
+                    if not entry.event.is_set():
+                        continue
+                    victim, victim_id = entry, oid
+                    del self._spill_order[oid]
+                    break
+                if victim is None:
+                    return
+                value = victim.value
+            try:
+                payload = cloudpickle.dumps(value)
+            except Exception:  # noqa: BLE001 - unpicklable: pin in memory
+                with self._lock:
+                    victim.pinned = True
+                continue
+            os.makedirs(self._spill_dir, exist_ok=True)
+            path = os.path.join(self._spill_dir,
+                                f"spilled-{victim_id.hex()}.bin")
+            with open(path, "w+b") as f:
+                f.write(payload)
+            with self._lock:
+                if victim.freed or not victim.event.is_set():
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    continue
+                victim.spilled_path = path
+                victim.value = None
+                self._total_bytes -= victim.size_bytes
+                self._spilled_bytes += victim.size_bytes
+                self._spill_count += 1
+
+    def _restore(self, entry: _Entry, object_id: ObjectID) -> Any:
+        """Load a spilled value back (reference: spilled-object restore)."""
+        import cloudpickle
+        try:
+            with open(entry.spilled_path, "rb") as f:
+                value = cloudpickle.loads(f.read())
+        except OSError as exc:
+            raise ObjectLostError(
+                f"Object {object_id.hex()} was spilled to "
+                f"{entry.spilled_path} which is no longer readable: {exc}")
+        with self._lock:
+            if entry.freed:
+                # A concurrent free() won: don't resurrect or touch the
+                # accounting (free already settled it).
+                return None
+            if entry.value is None and entry.spilled_path is not None:
+                entry.value = value
+                entry.pinned = True  # a reader holds it now; don't re-spill
+                self._total_bytes += entry.size_bytes
+                self._spilled_bytes -= entry.size_bytes
+                self._restore_count += 1
+            return entry.value
+
+    def spill_stats(self) -> dict:
+        with self._lock:
+            return {
+                "spilled_bytes_current": self._spilled_bytes,
+                "spill_count": self._spill_count,
+                "restore_count": self._restore_count,
+            }
 
     # -- read side --------------------------------------------------------
 
@@ -176,22 +305,35 @@ class ObjectStore:
                         "shared-memory store.")
                 entry.value = arr
             return entry.value
+        # Snapshot under the lock: a concurrent _maybe_spill may null
+        # entry.value at any moment; holding our own reference is safe.
+        with self._lock:
+            value = entry.value
+            needs_restore = (entry.spilled_path is not None
+                             and value is None)
+        if needs_restore:
+            value = self._restore(entry, object_id)
+            if value is None:
+                raise ObjectFreedError(
+                    f"Object {object_id.hex()} was freed and is no "
+                    "longer available.")
         if not entry.deserialized:
             if self._deserializer is None:
                 raise ObjectLostError(object_id.hex())
             value = self._deserializer(entry.serialized)
-            entry.value = value
-            entry.deserialized = True
+            with self._lock:
+                entry.value = value
+                entry.deserialized = True
         if entry.is_exception:
             # Raise a shallow copy: `raise` attaches the caller's traceback
             # to the exception object, and the traceback's frames hold the
             # very ObjectRef being fetched — raising the stored instance
             # would make the object pin itself (a refcount leak cycle).
             import copy
-            exc = copy.copy(entry.value)
+            exc = copy.copy(value)
             exc.__traceback__ = None
             raise exc
-        return entry.value
+        return value
 
     def get_if_exception(self, object_id: ObjectID) -> Optional[BaseException]:
         entry = self._entry(object_id)
@@ -216,8 +358,19 @@ class ObjectStore:
                         if entry.value is not None:
                             self._native.release(oid.hex())
                         self._native.delete(oid.hex())
+                    if entry.spilled_path is not None:
+                        try:
+                            os.unlink(entry.spilled_path)
+                        except OSError:
+                            pass
+                        if entry.value is None:
+                            self._spilled_bytes -= entry.size_bytes
+                        entry.spilled_path = None
+                    if not entry.in_native and (
+                            entry.value is not None
+                            or entry.serialized is not None):
+                        self._total_bytes -= entry.size_bytes
                     entry.value = None
-                    self._total_bytes -= entry.size_bytes
                     entry.serialized = None
                     entry.event.set()
 
@@ -241,7 +394,18 @@ class ObjectStore:
                     if entry.value is not None:
                         self._native.release(oid.hex())
                     self._native.delete(oid.hex())
-                self._total_bytes -= entry.size_bytes
+                if entry.spilled_path is not None:
+                    try:
+                        os.unlink(entry.spilled_path)
+                    except OSError:
+                        pass
+                    if entry.value is None:
+                        self._spilled_bytes -= entry.size_bytes
+                    entry.spilled_path = None
+                if not entry.in_native and (
+                        entry.value is not None
+                        or entry.serialized is not None):
+                    self._total_bytes -= entry.size_bytes
                 entry.value = None
                 entry.serialized = None
                 entry.deserialized = False
@@ -249,6 +413,7 @@ class ObjectStore:
                 entry.freed = False
                 entry.in_native = False
                 entry.size_bytes = 0
+                entry.pinned = False
                 entry.event.clear()
 
     def fail_all_pending(self, exc: BaseException) -> None:

@@ -1,0 +1,156 @@
+"""Publisher/subscriber with long-poll semantics — native + Python twin.
+
+The in-process analog of the reference's pubsub layer
+(src/ray/pubsub/publisher.h:298 / subscriber.h:329, the PubsubLongPolling
+rpc): channels keyed by (channel, key); subscribers long-poll for
+messages. Used for object-location / membership style notifications;
+ctypes releases the GIL around the native blocking poll so Python worker
+threads can park in it cheaply.
+"""
+
+from __future__ import annotations
+
+import collections
+import ctypes
+import os
+import threading
+from typing import Dict, Optional, Set, Tuple
+
+
+def _load():
+    from ray_tpu._private.native_build import load_library_cached
+
+    def configure(lib):
+        P, L, C = ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p
+        lib.rpb_create.restype = P
+        lib.rpb_destroy.argtypes = [P]
+        lib.rpb_subscribe.argtypes = [P, C, C, C]
+        lib.rpb_unsubscribe.argtypes = [P, C, C, C]
+        lib.rpb_drop_subscriber.argtypes = [P, C]
+        lib.rpb_publish.restype = L
+        lib.rpb_publish.argtypes = [P, C, C, C]
+        lib.rpb_poll.restype = L
+        lib.rpb_poll.argtypes = [P, C, L, ctypes.c_char_p, L]
+        lib.rpb_inbox_size.restype = L
+        lib.rpb_inbox_size.argtypes = [P, C]
+
+    return load_library_cached("pubsub", configure=configure)
+
+
+def native_pubsub_available() -> bool:
+    if os.environ.get("RAY_TPU_NATIVE_PUBSUB", "1") == "0":
+        return False
+    return _load() is not None
+
+
+class NativePubsub:
+    def __init__(self):
+        self._lib = _load()
+        self._h = self._lib.rpb_create()
+
+    def __del__(self):
+        try:
+            self._lib.rpb_destroy(self._h)
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+    def subscribe(self, sub_id: str, channel: str, key: str = "") -> None:
+        self._lib.rpb_subscribe(self._h, sub_id.encode(), channel.encode(),
+                                key.encode())
+
+    def unsubscribe(self, sub_id: str, channel: str, key: str = "") -> None:
+        self._lib.rpb_unsubscribe(self._h, sub_id.encode(),
+                                  channel.encode(), key.encode())
+
+    def drop_subscriber(self, sub_id: str) -> None:
+        self._lib.rpb_drop_subscriber(self._h, sub_id.encode())
+
+    def publish(self, channel: str, key: str, payload: str) -> int:
+        return int(self._lib.rpb_publish(
+            self._h, channel.encode(), key.encode(), payload.encode()))
+
+    def poll(self, sub_id: str, timeout: float = 1.0
+             ) -> Optional[Tuple[str, str, str]]:
+        """Block up to ``timeout`` seconds; returns (channel, key, payload)
+        or None on timeout."""
+        cap = 4096
+        timeout_ms = int(timeout * 1000)
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.rpb_poll(self._h, sub_id.encode(), timeout_ms,
+                                   buf, cap)
+            if n <= 0:
+                return None
+            if n < cap:
+                channel, key, payload = buf.value.decode().split("|", 2)
+                return channel, key, payload
+            cap = n + 1
+            timeout_ms = 0  # message already queued; re-read immediately
+
+    def inbox_size(self, sub_id: str) -> int:
+        return int(self._lib.rpb_inbox_size(self._h, sub_id.encode()))
+
+
+class PyPubsub:
+    """Pure-Python twin (decision parity; tests run both)."""
+
+    MAX_INBOX = 10_000
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._interests: Dict[str, Set[Tuple[str, str]]] = {}
+        self._inboxes: Dict[str, collections.deque] = {}
+        self._cvs: Dict[str, threading.Condition] = {}
+
+    def _cv(self, sub_id: str) -> threading.Condition:
+        return self._cvs.setdefault(sub_id, threading.Condition(self._lock))
+
+    def subscribe(self, sub_id: str, channel: str, key: str = "") -> None:
+        with self._lock:
+            self._interests.setdefault(sub_id, set()).add((channel, key))
+            self._inboxes.setdefault(sub_id, collections.deque())
+            self._cv(sub_id)
+
+    def unsubscribe(self, sub_id: str, channel: str, key: str = "") -> None:
+        with self._lock:
+            self._interests.get(sub_id, set()).discard((channel, key))
+
+    def drop_subscriber(self, sub_id: str) -> None:
+        with self._lock:
+            self._interests.pop(sub_id, None)
+            self._inboxes.pop(sub_id, None)
+            self._cvs.pop(sub_id, None)
+
+    def publish(self, channel: str, key: str, payload: str) -> int:
+        delivered = 0
+        with self._lock:
+            for sub_id, interests in self._interests.items():
+                if (channel, key) in interests or (channel, "") in interests:
+                    inbox = self._inboxes[sub_id]
+                    if len(inbox) >= self.MAX_INBOX:
+                        inbox.popleft()
+                    inbox.append((channel, key, payload))
+                    self._cvs[sub_id].notify_all()
+                    delivered += 1
+        return delivered
+
+    def poll(self, sub_id: str, timeout: float = 1.0
+             ) -> Optional[Tuple[str, str, str]]:
+        with self._lock:
+            if sub_id not in self._inboxes:
+                return None
+            inbox = self._inboxes[sub_id]
+            if not inbox:
+                self._cv(sub_id).wait_for(lambda: bool(inbox), timeout)
+            return inbox.popleft() if inbox else None
+
+    def inbox_size(self, sub_id: str) -> int:
+        with self._lock:
+            inbox = self._inboxes.get(sub_id)
+            return -1 if inbox is None else len(inbox)
+
+
+def make_pubsub(use_native: bool = True):
+    if use_native and native_pubsub_available():
+        return NativePubsub()
+    return PyPubsub()

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import hashlib
 import logging
+import os
 import queue
 import threading
 import traceback
@@ -267,11 +268,18 @@ class Runtime:
         self.config = make_ray_config(system_config)
         # Shared-memory arena sized like the reference's object store
         # (30% of memory, services.py object_store_memory default).
+        import tempfile
+        spill_dir = (self.config.object_spilling_directory
+                     or os.path.join(tempfile.gettempdir(), "ray_tpu_spill",
+                                     self.session_id))
         self.store = ObjectStore(
             deserializer=serialization.deserialize,
             native_capacity=int(node_resources.memory_bytes *
                                 self.config.object_store_memory_fraction),
-            use_native=self.config.use_native_object_store)
+            use_native=self.config.use_native_object_store,
+            spill_threshold_bytes=int(
+                self.config.object_spilling_threshold_bytes),
+            spill_directory=spill_dir)
         self.scheduler = make_cluster_scheduler(
             use_native=self.config.use_native_scheduler)
         self.head_node_id = self.scheduler.add_node(
@@ -309,12 +317,29 @@ class Runtime:
         from ray_tpu._private.refcount import make_reference_counter
         self.refs = make_reference_counter(
             use_native=self.config.use_native_refcount)
+        # Long-poll pubsub hub (reference: src/ray/pubsub/): task-state
+        # events publish here; consumers subscribe + poll.
+        from ray_tpu._private.pubsub import make_pubsub
+        self.pubsub = make_pubsub()
         self._chaos_us = {
             flag: int(self.config.get(flag))
             for flag in ("testing_submit_delay_us",
                          "testing_dispatch_delay_us",
                          "testing_store_delay_us")
         }
+        # OOM protection (reference: MemoryMonitor + worker-killing policy):
+        # poll memory pressure; above the threshold, fail the newest
+        # retriable running task.
+        self.memory_monitor = None
+        threshold = float(self.config.memory_usage_threshold)
+        refresh_ms = int(self.config.memory_monitor_refresh_ms)
+        if 0 < threshold < 1.0 and refresh_ms > 0:
+            from ray_tpu._private.memory_monitor import MemoryMonitor
+            self.memory_monitor = MemoryMonitor(
+                threshold, refresh_ms,
+                get_running_tasks=self._running_normal_tasks,
+                kill_fn=self._oom_kill_task)
+            self.memory_monitor.start()
         # Deferred-free queue: ObjectRef.__del__ can fire at any point —
         # including inside the store's non-reentrant lock when a freed value
         # drops the last handle to another object — so handle-death frees
@@ -397,10 +422,13 @@ class Runtime:
         self.refs.add_task_deps(deps)
 
     def _release_task_deps(self, spec: TaskSpec) -> None:
-        """Task reached a terminal state: drop its dependency pins."""
-        deps = getattr(spec, "_dep_oids", None)
-        if deps:
+        """Task reached a terminal state: drop its dependency pins.
+        Atomic: a completing worker and a killer (OOM / node death) may
+        race here; exactly one release happens."""
+        with self._lock:
+            deps = getattr(spec, "_dep_oids", None)
             spec._dep_oids = None  # type: ignore[attr-defined]
+        if deps:
             self._free_now(self.refs.remove_task_deps(deps))
 
     def put(self, value: Any) -> ObjectRef:
@@ -692,6 +720,9 @@ class Runtime:
                     spec._node_id = node_id  # type: ignore[attr-defined]
                     spec._acquired_bundle = bidx  # type: ignore[attr-defined]
                     spec.invalidated = False
+                    # App-level retries redispatch the same spec: re-arm the
+                    # exactly-once finalize claim for the new attempt.
+                    spec._finalized = False  # type: ignore[attr-defined]
                     n_tpus = int(spec.resources.get("TPU", 0))
                     if n_tpus >= 1:
                         spec._tpu_ids = (  # type: ignore[attr-defined]
@@ -703,6 +734,8 @@ class Runtime:
                     return
                 continue
             spec, worker = launched
+            import time as _time
+            spec._start_time = _time.monotonic()  # type: ignore[attr-defined]
             self._record_event(spec, "RUNNING")
             if spec.kind == TaskKind.ACTOR_CREATION:
                 worker.submit(lambda s=spec, w=worker: self._run_actor_creation(s, w))
@@ -856,13 +889,60 @@ class Runtime:
             self._store_error(spec, err)
         self._finish_task(spec, worker)
 
-    def _finish_task(self, spec: TaskSpec, worker: Executor,
-                     retried: bool = False) -> None:
-        if getattr(spec, "invalidated", False):
-            # remove_node already released this node's resources wholesale.
-            self._return_worker(worker)
-            self._dispatch()
-            return
+    def _running_normal_tasks(self) -> List[TaskSpec]:
+        with self._lock:
+            return [s for s in self._inflight.values()
+                    if s.kind == TaskKind.NORMAL]
+
+    def _oom_kill_task(self, spec: TaskSpec) -> None:
+        """Memory-monitor victim: discard the task's (still running) work
+        like a node-death zombie, release its resources, and retry within
+        budget or seal OutOfMemoryError (reference: raylet worker killing
+        + task OOM retry)."""
+        from ray_tpu.exceptions import OutOfMemoryError
+        with self._lock:
+            if spec.task_id not in self._inflight:
+                return
+        if spec.return_ids and all(
+                self.store.contains(oid) for oid in spec.return_ids):
+            return  # effectively completed; nothing to reclaim by killing
+        if not self._try_claim_finalize(spec):
+            return  # the worker finalized first
+        spec.invalidated = True
+        self._release_task_resources(spec)
+        if spec.attempt_number < spec.max_retries:
+            retry = spec.clone_for_retry()
+            with self._lock:
+                for oid in retry.return_ids:
+                    if oid in self._lineage:
+                        self._lineage[oid] = retry
+            self._register_task_refs(retry)
+            self._release_task_deps(spec)
+            self._record_event(spec, "OOM_RETRY")
+            self._resolve_dependencies(retry)
+        else:
+            err = OutOfMemoryError(
+                f"Task {spec.name} was killed by the memory monitor: node "
+                "memory usage exceeded the configured threshold "
+                "(memory_usage_threshold) and its retry budget is spent.")
+            self._release_task_deps(spec)
+            for oid in spec.return_ids:
+                self._store_if_referenced(oid, err, is_exception=True)
+            self._record_event(spec, "FAILED")
+        self._dispatch()
+
+    def _try_claim_finalize(self, spec: TaskSpec) -> bool:
+        """Exactly-once claim on a task's resource release: the finishing
+        worker and an asynchronous killer (OOM monitor, node death) race to
+        finalize; only the winner releases resources."""
+        with self._lock:
+            if getattr(spec, "_finalized", False):
+                return False
+            spec._finalized = True  # type: ignore[attr-defined]
+            self._inflight.pop(spec.task_id, None)
+            return True
+
+    def _release_task_resources(self, spec: TaskSpec) -> None:
         pg_id, _ = self._pg_key(spec)
         node_id = getattr(spec, "_node_id", None)
         bidx = getattr(spec, "_acquired_bundle", -1)
@@ -871,8 +951,14 @@ class Runtime:
         if tpu_ids and node_id is not None:
             self.scheduler.return_tpu_ids(node_id, tpu_ids)
             spec._tpu_ids = None  # type: ignore[attr-defined]
-        with self._lock:
-            self._inflight.pop(spec.task_id, None)
+
+    def _finish_task(self, spec: TaskSpec, worker: Executor,
+                     retried: bool = False) -> None:
+        if self._try_claim_finalize(spec) and not getattr(
+                spec, "invalidated", False):
+            # (invalidated + claimed: node death released the node's
+            # resources wholesale — nothing to give back here.)
+            self._release_task_resources(spec)
         self._return_worker(worker)
         self._dispatch()
 
@@ -1365,8 +1451,7 @@ class Runtime:
                     self.store.contains(oid) for oid in s.return_ids))]
         for spec in doomed:
             spec.invalidated = True
-            with self._lock:
-                self._inflight.pop(spec.task_id, None)
+            self._try_claim_finalize(spec)
             # _retry_after_node_death releases the zombie spec's dependency
             # pins AFTER the retry clone re-pins them (releasing first could
             # free the args the retry still needs).
@@ -1527,6 +1612,9 @@ class Runtime:
                 "status": status,
                 "time": _time.time(),
             })
+        # State transitions fan out on the pubsub hub (reference:
+        # TaskEventBuffer flush → GcsTaskManager → subscribers).
+        self.pubsub.publish("task_events", spec.task_id.hex(), status)
 
     def task_events(self) -> List[dict]:
         return list(self._task_events)
@@ -1557,6 +1645,8 @@ class Runtime:
             state.created.set()
         for w in workers:
             w.stop()
+        if self.memory_monitor is not None:
+            self.memory_monitor.stop()
         # The GC thread must be fully stopped BEFORE the native store is
         # closed: a free() racing close() would touch an unmapped arena
         # (segfault). Wake it, let it observe _shutdown, and join.

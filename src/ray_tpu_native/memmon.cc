@@ -1,0 +1,101 @@
+// Native memory monitor.
+//
+// C++ equivalent of the reference's MemoryMonitor
+// (src/ray/common/memory_monitor.h:31 MemorySnapshot): reads system memory
+// from /proc/meminfo and the process cgroup's limit/usage (v2 memory.max /
+// memory.current, v1 fallback), reporting the tighter of the two as the
+// effective bound — exactly the signal the raylet uses to drive its
+// worker-killing policy.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+namespace {
+
+// Parse "key:   12345 kB" style /proc/meminfo rows.
+int64_t meminfo_kb(const char* key) {
+  FILE* f = std::fopen("/proc/meminfo", "r");
+  if (f == nullptr) return -1;
+  char line[256];
+  int64_t out = -1;
+  size_t key_len = std::strlen(key);
+  while (std::fgets(line, sizeof(line), f) != nullptr) {
+    if (std::strncmp(line, key, key_len) == 0 && line[key_len] == ':') {
+      out = std::strtoll(line + key_len + 1, nullptr, 10);
+      break;
+    }
+  }
+  std::fclose(f);
+  return out;
+}
+
+int64_t read_int_file(const char* path) {
+  FILE* f = std::fopen(path, "r");
+  if (f == nullptr) return -1;
+  char buf[64];
+  int64_t out = -1;
+  if (std::fgets(buf, sizeof(buf), f) != nullptr) {
+    if (std::strncmp(buf, "max", 3) == 0) {
+      out = -2;  // "no limit"
+    } else {
+      out = std::strtoll(buf, nullptr, 10);
+    }
+  }
+  std::fclose(f);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Writes "system_total=B;system_available=B;cgroup_limit=B;cgroup_used=B"
+// (bytes; -1 unknown, cgroup_limit -2 = unlimited). Returns needed length.
+int64_t rmm_snapshot(char* buf, int64_t cap) {
+  int64_t total_kb = meminfo_kb("MemTotal");
+  int64_t avail_kb = meminfo_kb("MemAvailable");
+  int64_t limit = read_int_file("/sys/fs/cgroup/memory.max");
+  int64_t used = read_int_file("/sys/fs/cgroup/memory.current");
+  if (limit == -1) {  // cgroup v1 fallback
+    limit = read_int_file("/sys/fs/cgroup/memory/memory.limit_in_bytes");
+    used = read_int_file("/sys/fs/cgroup/memory/memory.usage_in_bytes");
+    // v1 reports "no limit" as a huge number (PAGE_COUNTER_MAX).
+    if (limit > (int64_t{1} << 60)) limit = -2;
+  }
+  std::string out =
+      "system_total=" +
+      std::to_string(total_kb < 0 ? -1 : total_kb * 1024) +
+      ";system_available=" +
+      std::to_string(avail_kb < 0 ? -1 : avail_kb * 1024) +
+      ";cgroup_limit=" + std::to_string(limit) +
+      ";cgroup_used=" + std::to_string(used);
+  int64_t needed = static_cast<int64_t>(out.size());
+  if (buf != nullptr && needed < cap) {
+    std::memcpy(buf, out.data(), out.size());
+    buf[out.size()] = '\0';
+  }
+  return needed;
+}
+
+// Effective usage fraction in [0,1] (or -1 unknown): cgroup bound if
+// limited, else system.
+double rmm_usage_fraction() {
+  char buf[256];
+  rmm_snapshot(buf, sizeof(buf));
+  int64_t total = -1, avail = -1, limit = -1, used = -1;
+  std::sscanf(buf,
+              "system_total=%ld;system_available=%ld;cgroup_limit=%ld;"
+              "cgroup_used=%ld",
+              &total, &avail, &limit, &used);
+  if (limit > 0 && used >= 0) {
+    return static_cast<double>(used) / static_cast<double>(limit);
+  }
+  if (total > 0 && avail >= 0) {
+    return 1.0 - static_cast<double>(avail) / static_cast<double>(total);
+  }
+  return -1.0;
+}
+
+}  // extern "C"

@@ -1,0 +1,145 @@
+// Native publisher/subscriber with long-poll semantics.
+//
+// C++ equivalent of the reference's object/GCS pubsub
+// (src/ray/pubsub/publisher.h:298, subscriber.h:329): channels keyed by
+// (channel, key); subscribers register interest and long-poll — the poll
+// blocks on a condition variable until a message lands or the timeout
+// passes, exactly the PubsubLongPolling rpc shape (core_worker.proto:408)
+// collapsed to an in-process API. Python callers poll from worker threads;
+// ctypes releases the GIL around the blocking call.
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+
+namespace {
+
+struct Subscriber {
+  std::deque<std::string> inbox;  // "channel|key|payload"
+  std::unordered_set<std::string> interests;  // "channel|key" ("" key = all)
+  std::condition_variable cv;
+  int pollers = 0;      // threads parked in rpb_poll
+  bool dropped = false; // drop requested while pollers were parked
+};
+
+struct Hub {
+  std::mutex mu;
+  std::unordered_map<std::string, Subscriber> subs;
+  int64_t max_inbox = 10000;
+};
+
+std::string topic(const char* channel, const char* key) {
+  return std::string(channel) + "|" + key;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rpb_create() { return new Hub(); }
+void rpb_destroy(void* h) { delete static_cast<Hub*>(h); }
+
+// Register interest: key "" subscribes to every key on the channel.
+void rpb_subscribe(void* h, const char* sub_id, const char* channel,
+                   const char* key) {
+  auto* hub = static_cast<Hub*>(h);
+  std::lock_guard<std::mutex> g(hub->mu);
+  hub->subs[sub_id].interests.insert(topic(channel, key));
+}
+
+void rpb_unsubscribe(void* h, const char* sub_id, const char* channel,
+                     const char* key) {
+  auto* hub = static_cast<Hub*>(h);
+  std::lock_guard<std::mutex> g(hub->mu);
+  auto it = hub->subs.find(sub_id);
+  if (it != hub->subs.end()) it->second.interests.erase(topic(channel, key));
+}
+
+void rpb_drop_subscriber(void* h, const char* sub_id) {
+  auto* hub = static_cast<Hub*>(h);
+  std::lock_guard<std::mutex> g(hub->mu);
+  auto it = hub->subs.find(sub_id);
+  if (it == hub->subs.end()) return;
+  if (it->second.pollers > 0) {
+    // A poller is parked on this subscriber's condition variable:
+    // destroying it now would be use-after-free. Mark dropped, wake the
+    // pollers; the last one out erases the entry.
+    it->second.dropped = true;
+    it->second.cv.notify_all();
+  } else {
+    hub->subs.erase(it);
+  }
+}
+
+// Fan a message out to every subscriber interested in (channel, key) or
+// (channel, ""). Returns the number of deliveries.
+int64_t rpb_publish(void* h, const char* channel, const char* key,
+                    const char* payload) {
+  auto* hub = static_cast<Hub*>(h);
+  std::lock_guard<std::mutex> g(hub->mu);
+  const std::string exact = topic(channel, key);
+  const std::string wild = topic(channel, "");
+  std::string msg = std::string(channel) + "|" + key + "|" + payload;
+  int64_t delivered = 0;
+  for (auto& kv : hub->subs) {
+    Subscriber& sub = kv.second;
+    if (sub.interests.count(exact) || sub.interests.count(wild)) {
+      if (static_cast<int64_t>(sub.inbox.size()) >= hub->max_inbox) {
+        sub.inbox.pop_front();  // drop oldest under backpressure
+      }
+      sub.inbox.push_back(msg);
+      sub.cv.notify_all();
+      delivered++;
+    }
+  }
+  return delivered;
+}
+
+// Long-poll: block until a message is available or timeout_ms elapses.
+// Writes "channel|key|payload"; returns needed length, 0 = timeout,
+// -1 = unknown subscriber. A too-small buffer leaves the message queued
+// (caller retries with a bigger buffer).
+int64_t rpb_poll(void* h, const char* sub_id, int64_t timeout_ms,
+                 char* buf, int64_t cap) {
+  auto* hub = static_cast<Hub*>(h);
+  std::unique_lock<std::mutex> lock(hub->mu);
+  auto it = hub->subs.find(sub_id);
+  if (it == hub->subs.end() || it->second.dropped) return -1;
+  Subscriber& sub = it->second;
+  sub.pollers++;
+  if (sub.inbox.empty() && !sub.dropped) {
+    sub.cv.wait_for(lock, std::chrono::milliseconds(timeout_ms),
+                    [&] { return !sub.inbox.empty() || sub.dropped; });
+  }
+  sub.pollers--;
+  if (sub.dropped) {
+    if (sub.pollers == 0) hub->subs.erase(sub_id);
+    return -1;
+  }
+  if (sub.inbox.empty()) return 0;
+  const std::string& msg = sub.inbox.front();
+  int64_t needed = static_cast<int64_t>(msg.size());
+  if (buf != nullptr && needed < cap) {
+    std::memcpy(buf, msg.data(), msg.size());
+    buf[msg.size()] = '\0';
+    sub.inbox.pop_front();
+  }
+  return needed;
+}
+
+int64_t rpb_inbox_size(void* h, const char* sub_id) {
+  auto* hub = static_cast<Hub*>(h);
+  std::lock_guard<std::mutex> g(hub->mu);
+  auto it = hub->subs.find(sub_id);
+  return (it == hub->subs.end() || it->second.dropped)
+             ? -1
+             : static_cast<int64_t>(it->second.inbox.size());
+}
+
+}  // extern "C"

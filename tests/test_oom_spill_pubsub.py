@@ -1,0 +1,241 @@
+"""Object spilling, memory monitor + OOM killing, and pubsub tests."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import JobID, ObjectID, TaskID
+
+
+# -- spilling -------------------------------------------------------------
+
+
+def _oid(i: int) -> ObjectID:
+    return ObjectID.for_return(TaskID.for_normal_task(JobID(b"\x02" * 4)), i)
+
+
+def test_store_spills_and_restores(tmp_path):
+    from ray_tpu._private.object_store import ObjectStore
+
+    store = ObjectStore(spill_threshold_bytes=3 * 1024,
+                        spill_directory=str(tmp_path), use_native=False)
+    oids = [_oid(i) for i in range(1, 6)]
+    for i, oid in enumerate(oids):
+        store.put_inline(oid, bytes([i]) * 1024)
+    stats = store.spill_stats()
+    assert stats["spill_count"] >= 2, stats
+    assert list(tmp_path.glob("spilled-*.bin"))
+    # All values still readable (spilled ones restore from disk).
+    for i, oid in enumerate(oids):
+        assert store.get(oid) == bytes([i]) * 1024
+    assert store.spill_stats()["restore_count"] >= 2
+    # Freeing removes spill files.
+    store.free(oids)
+    # restored entries were pinned in memory; any remaining files belong to
+    # entries freed while spilled
+    for oid in oids:
+        assert not store.contains(oid)
+
+
+def test_spill_end_to_end_via_system_config(tmp_path):
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0, _memory=1e9,
+                 _system_config={
+                     "object_spilling_threshold_bytes": 64 * 1024,
+                     "object_spilling_directory": str(tmp_path),
+                     "use_native_object_store": False,
+                 })
+    refs = [ray_tpu.put(np.full(16 * 1024, i, np.uint8)) for i in range(8)]
+    from ray_tpu._private.worker import global_worker
+    stats = global_worker.runtime.store.spill_stats()
+    assert stats["spill_count"] >= 1, stats
+    for i, ref in enumerate(refs):
+        np.testing.assert_array_equal(
+            ray_tpu.get(ref), np.full(16 * 1024, i, np.uint8))
+    ray_tpu.shutdown()
+
+
+# -- memory monitor / OOM -------------------------------------------------
+
+
+def test_memory_snapshot_and_fraction():
+    from ray_tpu._private.memory_monitor import (memory_snapshot,
+                                                 usage_fraction)
+    snap = memory_snapshot()
+    assert snap["system_total"] > 0
+    frac = usage_fraction(snap)
+    assert 0.0 <= frac <= 1.0
+
+
+def test_killing_policies():
+    from ray_tpu._private.memory_monitor import (group_by_owner_policy,
+                                                 retriable_lifo_policy)
+
+    class FakeSpec:
+        def __init__(self, name, attempt, max_retries, start, actor=None):
+            self.name = name
+            self.attempt_number = attempt
+            self.max_retries = max_retries
+            self._start_time = start
+            self.actor_id = actor
+            self.task_id = TaskID.for_normal_task(JobID(b"\x03" * 4))
+
+    exhausted = FakeSpec("exhausted", 3, 3, start=100.0)
+    old_retriable = FakeSpec("old", 0, 3, start=1.0)
+    new_retriable = FakeSpec("new", 0, 3, start=50.0)
+    # Prefer retriable; among them, the newest.
+    assert retriable_lifo_policy(
+        [exhausted, old_retriable, new_retriable]) is new_retriable
+    # Only exhausted tasks: still pick one (newest).
+    assert retriable_lifo_policy([exhausted]) is exhausted
+    assert retriable_lifo_policy([]) is None
+    # group_by_owner: the owner with more tasks loses one.
+    a1 = FakeSpec("a1", 0, 3, 1.0, actor="A")
+    a2 = FakeSpec("a2", 0, 3, 2.0, actor="A")
+    b1 = FakeSpec("b1", 0, 3, 9.0, actor="B")
+    assert group_by_owner_policy([a1, a2, b1]) is a2
+
+
+def test_monitor_kills_above_threshold():
+    from ray_tpu._private.memory_monitor import MemoryMonitor
+
+    class FakeSpec:
+        name = "victim"
+        attempt_number = 0
+        max_retries = 3
+        _start_time = 1.0
+
+    victim = FakeSpec()
+    killed = []
+    monitor = MemoryMonitor(
+        threshold=0.9, refresh_ms=100,
+        get_running_tasks=lambda: [victim],
+        kill_fn=killed.append,
+        usage_fn=lambda: 0.95)
+    assert monitor.check_once() is victim
+    assert killed == [victim]
+    # below threshold: no kill
+    monitor2 = MemoryMonitor(
+        threshold=0.9, refresh_ms=100,
+        get_running_tasks=lambda: [victim],
+        kill_fn=killed.append,
+        usage_fn=lambda: 0.5)
+    assert monitor2.check_once() is None
+
+
+def test_oom_kill_retries_then_seals(ray_start_regular):
+    """_oom_kill_task: within budget the task retries; past it the caller
+    sees OutOfMemoryError."""
+    from ray_tpu._private.worker import global_worker
+    runtime = global_worker.runtime
+    release = threading.Event()
+    attempts = []
+
+    @ray_tpu.remote(max_retries=1)
+    def hog():
+        attempts.append(1)
+        release.wait(10)
+        return "done"
+
+    ref = hog.remote()
+    deadline = time.monotonic() + 5
+    spec = None
+    while time.monotonic() < deadline and spec is None:
+        with runtime._lock:
+            for s in runtime._inflight.values():
+                if "hog" in s.name:
+                    spec = s
+        time.sleep(0.01)
+    assert spec is not None
+    runtime._oom_kill_task(spec)  # attempt 0 → retry
+    # the retry clone is pending/running; kill it too (budget now spent)
+    deadline = time.monotonic() + 5
+    clone = None
+    while time.monotonic() < deadline and clone is None:
+        with runtime._lock:
+            for s in runtime._inflight.values():
+                if "hog" in s.name and s is not spec:
+                    clone = s
+        time.sleep(0.01)
+    assert clone is not None and clone.attempt_number == 1
+    runtime._oom_kill_task(clone)
+    release.set()
+    with pytest.raises(ray_tpu.exceptions.OutOfMemoryError):
+        ray_tpu.get(ref, timeout=10)
+
+
+# -- pubsub ---------------------------------------------------------------
+
+from ray_tpu._private.pubsub import (NativePubsub, PyPubsub,  # noqa: E402
+                                     native_pubsub_available)
+
+PUBSUB_ENGINES = [PyPubsub]
+if native_pubsub_available():
+    PUBSUB_ENGINES.append(NativePubsub)
+
+
+@pytest.fixture(params=PUBSUB_ENGINES, ids=lambda e: e.__name__)
+def hub(request):
+    return request.param()
+
+
+def test_pubsub_exact_and_wildcard(hub):
+    hub.subscribe("s1", "objects", "key1")
+    hub.subscribe("s2", "objects", "")  # wildcard
+    assert hub.publish("objects", "key1", "hello") == 2
+    assert hub.poll("s1", timeout=1) == ("objects", "key1", "hello")
+    assert hub.poll("s2", timeout=1) == ("objects", "key1", "hello")
+    # s1 doesn't see other keys; s2 does.
+    assert hub.publish("objects", "key2", "x") == 1
+    assert hub.poll("s1", timeout=0.05) is None
+    assert hub.poll("s2", timeout=1) == ("objects", "key2", "x")
+
+
+def test_pubsub_long_poll_blocks_until_publish(hub):
+    hub.subscribe("s1", "chan", "")
+    got = []
+
+    def poller():
+        got.append(hub.poll("s1", timeout=5))
+
+    t = threading.Thread(target=poller)
+    t.start()
+    time.sleep(0.1)
+    hub.publish("chan", "k", "late")
+    t.join(timeout=5)
+    assert got == [("chan", "k", "late")]
+
+
+def test_pubsub_unsubscribe_and_drop(hub):
+    hub.subscribe("s1", "c", "")
+    hub.unsubscribe("s1", "c", "")
+    assert hub.publish("c", "k", "m") == 0
+    hub.subscribe("s1", "c", "")
+    hub.publish("c", "k", "m")
+    assert hub.inbox_size("s1") == 1
+    hub.drop_subscriber("s1")
+    assert hub.inbox_size("s1") == -1
+
+
+def test_runtime_publishes_task_events(ray_start_regular):
+    from ray_tpu._private.worker import global_worker
+    runtime = global_worker.runtime
+    runtime.pubsub.subscribe("watcher", "task_events", "")
+
+    @ray_tpu.remote
+    def evented():
+        return 1
+
+    ref = evented.remote()
+    assert ray_tpu.get(ref) == 1
+    statuses = set()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and "FINISHED" not in statuses:
+        msg = runtime.pubsub.poll("watcher", timeout=0.5)
+        if msg is not None:
+            statuses.add(msg[2])
+    assert {"SUBMITTED", "FINISHED"} <= statuses
+    runtime.pubsub.drop_subscriber("watcher")
