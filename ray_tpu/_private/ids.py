@@ -94,17 +94,33 @@ class ActorID(BaseID):
         return JobID(self._bytes[: JobID.SIZE])
 
 
+_task_unique_lock = threading.Lock()
+_task_unique_counter = int.from_bytes(os.urandom(4), "little")
+
+
+def _task_unique() -> bytes:
+    """Unique part of a TaskID. Only 4 bytes are available (TaskID layout:
+    actor(12) + unique(4)), so randomness would birthday-collide around
+    ~2^16 tasks — a long-running driver submits that in minutes. IDs are
+    minted by the owning driver process, so a randomly-seeded atomic
+    counter is collision-free for 2^32 tasks."""
+    global _task_unique_counter
+    with _task_unique_lock:
+        _task_unique_counter = (_task_unique_counter + 1) & 0xFFFFFFFF
+        return _task_unique_counter.to_bytes(4, "little")
+
+
 class TaskID(BaseID):
     SIZE = _TASK_ID_SIZE
 
     @classmethod
     def for_normal_task(cls, job_id: JobID) -> "TaskID":
         actor_part = job_id.binary() + b"\x00" * (ActorID.SIZE - JobID.SIZE)
-        return cls(actor_part + os.urandom(cls.SIZE - ActorID.SIZE))
+        return cls(actor_part + _task_unique())
 
     @classmethod
     def for_actor_task(cls, actor_id: ActorID) -> "TaskID":
-        return cls(actor_id.binary() + os.urandom(cls.SIZE - ActorID.SIZE))
+        return cls(actor_id.binary() + _task_unique())
 
     @classmethod
     def for_actor_creation(cls, actor_id: ActorID) -> "TaskID":

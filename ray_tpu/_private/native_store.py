@@ -133,7 +133,10 @@ class NativeObjectStore:
         if view is None:
             return None
         hlen = int.from_bytes(view[:4], "little")
-        dtype_str, shape_str = bytes(view[4:4 + hlen]).decode().split("|")
+        # rsplit: dtype.str itself starts with '|' for non-endian types
+        # (uint8 is '|u1'), so only the LAST separator splits the fields.
+        dtype_str, shape_str = bytes(
+            view[4:4 + hlen]).decode().rsplit("|", 1)
         shape = tuple(int(x) for x in shape_str.split(",")) if shape_str \
             else ()
         data = view[4 + hlen:]

@@ -1,0 +1,113 @@
+"""Core ops/s microbenchmark suite.
+
+Analog of the reference's release/microbenchmark harness
+(python/ray/_private/ray_perf.py:93-163): measures the runtime's primitive
+throughput/latency — task submission, actor calls, put/get — printing one
+line per metric. Run via ``python -m ray_tpu._private.ray_perf`` or
+``ray-tpu microbenchmark``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+import ray_tpu
+
+
+def timeit(name: str, fn: Callable[[], None], multiplier: int = 1,
+           duration: float = 2.0) -> Dict[str, float]:
+    """Run fn repeatedly for ~duration seconds; report ops/s."""
+    # warmup
+    fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < duration:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    rate = count * multiplier / dt
+    print(f"{name}: {rate:,.1f} ops/s ({count} iters in {dt:.2f}s)")
+    return {"name": name, "ops_per_s": rate}
+
+
+def main(duration: float = 2.0) -> List[Dict[str, float]]:
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    results = []
+
+    @ray_tpu.remote
+    def noop():
+        return 0
+
+    @ray_tpu.remote
+    def noop_arg(x):
+        return x
+
+    results.append(timeit(
+        "single_task_latency",
+        lambda: ray_tpu.get(noop.remote()), duration=duration))
+
+    def batch_tasks():
+        ray_tpu.get([noop.remote() for _ in range(100)])
+
+    results.append(timeit("tasks_per_second", batch_tasks, multiplier=100,
+                          duration=duration))
+
+    data = ray_tpu.put(np.zeros(1024, np.float32))
+
+    def tasks_with_arg():
+        ray_tpu.get([noop_arg.remote(data) for _ in range(100)])
+
+    results.append(timeit("tasks_with_shared_arg_per_second", tasks_with_arg,
+                          multiplier=100, duration=duration))
+
+    small = np.zeros(16, np.uint8)
+    results.append(timeit(
+        "put_small", lambda: ray_tpu.put(small), duration=duration))
+
+    big = np.zeros(1 << 20, np.uint8)
+    results.append(timeit(
+        "put_1mb", lambda: ray_tpu.put(big), duration=duration))
+
+    ref = ray_tpu.put(np.zeros(1 << 20, np.uint8))
+    results.append(timeit(
+        "get_1mb", lambda: ray_tpu.get(ref), duration=duration))
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    actor = Counter.remote()
+    results.append(timeit(
+        "actor_call_latency",
+        lambda: ray_tpu.get(actor.incr.remote()), duration=duration))
+
+    def actor_batch():
+        ray_tpu.get([actor.incr.remote() for _ in range(100)])
+
+    results.append(timeit("actor_calls_per_second", actor_batch,
+                          multiplier=100, duration=duration))
+
+    actors = [Counter.remote() for _ in range(8)]
+
+    def scatter_calls():
+        ray_tpu.get([a.incr.remote() for a in actors for _ in range(12)])
+
+    results.append(timeit("actor_calls_8_actors_per_second", scatter_calls,
+                          multiplier=96, duration=duration))
+    for a in actors:
+        ray_tpu.kill(a)
+    ray_tpu.kill(actor)
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -121,6 +121,14 @@ def cmd_job(args) -> int:
     return 1
 
 
+def cmd_microbenchmark(args) -> int:
+    """`ray-tpu microbenchmark` — the core ops/s suite (reference:
+    release/microbenchmark/run_microbenchmark.py)."""
+    from ray_tpu._private.ray_perf import main as perf_main
+    perf_main(duration=args.duration)
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     """`ray-tpu dashboard` — run the HTTP observability endpoint."""
     import time
@@ -194,6 +202,10 @@ def main(argv=None) -> int:
         pj.add_argument("job_id")
     jsub.add_parser("list")
 
+    p = sub.add_parser("microbenchmark",
+                       help="core ops/s suite (tasks, actors, put/get)")
+    p.add_argument("--duration", type=float, default=2.0)
+
     p = sub.add_parser("dashboard", help="run the HTTP dashboard")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8265)
@@ -217,6 +229,7 @@ def main(argv=None) -> int:
         "job": cmd_job,
         "serve": cmd_serve,
         "dashboard": cmd_dashboard,
+        "microbenchmark": cmd_microbenchmark,
     }[args.command]
     return handler(args)
 
