@@ -1,3 +1,3 @@
-from ray_tpu.models import diffusion, gpt, llama, vit
+from ray_tpu.models import diffusion, gpt, llama, t5, vit
 
-__all__ = ["diffusion", "gpt", "llama", "vit"]
+__all__ = ["diffusion", "gpt", "llama", "t5", "vit"]

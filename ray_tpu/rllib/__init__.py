@@ -15,6 +15,7 @@ from ray_tpu.rllib.algorithms.impala import Impala, ImpalaConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.registry import get_algorithm_class
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.algorithms.td3 import TD3, TD3Config
 from ray_tpu.rllib.evaluation.rollout_worker import RolloutWorker
 from ray_tpu.rllib.evaluation.worker_set import WorkerSet
 from ray_tpu.rllib.models.catalog import ModelCatalog
@@ -30,5 +31,6 @@ __all__ = ["A2C", "A2CConfig", "Algorithm", "AlgorithmConfig", "DQN",
            "DQNConfig", "Impala", "ImpalaConfig", "JAXPolicy", "JsonReader",
            "JsonWriter", "ModelCatalog", "PPO", "PPOConfig", "QPolicy",
            "PrioritizedReplayBuffer", "ReplayBuffer", "RolloutWorker",
-           "SAC", "SACConfig", "SACPolicy", "SampleBatch", "WorkerSet",
+           "SAC", "SACConfig", "SACPolicy", "SampleBatch", "TD3",
+           "TD3Config", "WorkerSet",
            "compute_gae", "get_algorithm_class"]

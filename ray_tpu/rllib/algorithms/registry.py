@@ -12,9 +12,10 @@ def get_algorithm_class(name: str) -> Type:
     from ray_tpu.rllib.algorithms.impala import Impala
     from ray_tpu.rllib.algorithms.ppo import PPO
     from ray_tpu.rllib.algorithms.sac import SAC
+    from ray_tpu.rllib.algorithms.td3 import TD3
 
     table = {"PPO": PPO, "DQN": DQN, "SAC": SAC, "A2C": A2C,
-             "IMPALA": Impala}
+             "IMPALA": Impala, "TD3": TD3}
     try:
         return table[name.upper()]
     except KeyError:

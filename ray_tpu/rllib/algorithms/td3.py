@@ -1,0 +1,195 @@
+"""TD3: twin-delayed deep deterministic policy gradient.
+
+Analog of the reference's rllib/algorithms/td3 (built on its DDPG stack):
+a deterministic actor with clipped Gaussian exploration noise, twin Q
+critics with polyak targets, target-policy smoothing noise, and delayed
+actor updates. Rollouts use a
+deterministic actor with fixed clipped Gaussian noise (TD3Policy); the
+learner update is one jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or TD3)
+        self.policy_class_name = "td3"  # deterministic + fixed noise
+        self.lr = 1e-3
+        self.critic_lr = 1e-3
+        self.train_batch_size = 256
+        self.replay_buffer_capacity = 100_000
+        self.num_steps_sampled_before_learning_starts = 500
+        self.num_train_batches_per_iteration = 32
+        self.tau = 0.005
+        self.policy_delay = 2
+        self.target_noise = 0.2
+        self.target_noise_clip = 0.5
+
+    def training(self, *, tau=None, critic_lr=None, policy_delay=None,
+                 target_noise=None, target_noise_clip=None,
+                 replay_buffer_capacity=None,
+                 num_train_batches_per_iteration=None,
+                 num_steps_sampled_before_learning_starts=None,
+                 **kwargs) -> "TD3Config":
+        super().training(**kwargs)
+        for name, val in (("tau", tau), ("critic_lr", critic_lr),
+                          ("policy_delay", policy_delay),
+                          ("target_noise", target_noise),
+                          ("target_noise_clip", target_noise_clip),
+                          ("replay_buffer_capacity", replay_buffer_capacity),
+                          ("num_train_batches_per_iteration",
+                           num_train_batches_per_iteration),
+                          ("num_steps_sampled_before_learning_starts",
+                           num_steps_sampled_before_learning_starts)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class TD3(Algorithm):
+    _default_config_class = TD3Config
+
+    def setup(self, config: TD3Config) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models.catalog import mlp_apply, mlp_init
+
+        policy = self.local_policy
+        act_dim = policy.act_dim
+        low = jnp.asarray(policy.low)
+        high = jnp.asarray(policy.high)
+
+        def det_action(actor_params, obs):
+            mu, _ = policy.dist_params(actor_params, obs)
+            return low + (jnp.tanh(mu) + 1.0) * 0.5 * (high - low)
+
+        self._det_action = jax.jit(det_action)
+
+        def q_apply(qparams, obs, act):
+            x = jnp.concatenate(
+                [obs.reshape((obs.shape[0], -1)), act], axis=-1)
+            return mlp_apply(qparams, x)[..., 0]
+
+        probe = self._env_creator(config.env_config)
+        q_in = int(np.prod(probe.observation_space.shape)) + act_dim
+        probe.close() if hasattr(probe, "close") else None
+        key = jax.random.PRNGKey(config.seed + 17)
+        k1, k2 = jax.random.split(key)
+        hiddens = list(config.fcnet_hiddens) + [1]
+        self._q_params = {"q1": mlp_init(k1, [q_in, *hiddens]),
+                          "q2": mlp_init(k2, [q_in, *hiddens])}
+        self._q_target = jax.tree.map(jnp.asarray, self._q_params)
+        self._actor_target = jax.tree.map(jnp.asarray, policy.params)
+        self._actor_opt = optax.adam(config.lr)
+        self._critic_opt = optax.adam(config.critic_lr)
+        self._actor_state = self._actor_opt.init(policy.params)
+        self._critic_state = self._critic_opt.init(self._q_params)
+        self._buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                    seed=config.seed)
+        self._updates = 0
+        gamma, tau = config.gamma, config.tau
+        noise, noise_clip = config.target_noise, config.target_noise_clip
+
+        def critic_loss(q_params, q_target, actor_target, mb, key):
+            next_a = det_action(actor_target, mb["new_obs"])
+            # Target-policy smoothing: clipped noise on the target action.
+            eps = jnp.clip(
+                jax.random.normal(key, next_a.shape) * noise,
+                -noise_clip, noise_clip) * (high - low) * 0.5
+            next_a = jnp.clip(next_a + eps, low, high)
+            q1_t = q_apply(q_target["q1"], mb["new_obs"], next_a)
+            q2_t = q_apply(q_target["q2"], mb["new_obs"], next_a)
+            target = mb["rewards"] + gamma * (1 - mb["terminateds"]) * \
+                jnp.minimum(q1_t, q2_t)
+            target = jax.lax.stop_gradient(target)
+            q1 = q_apply(q_params["q1"], mb["obs"], mb["actions"])
+            q2 = q_apply(q_params["q2"], mb["obs"], mb["actions"])
+            return ((q1 - target) ** 2 + (q2 - target) ** 2).mean()
+
+        def actor_loss(actor_params, q_params, mb):
+            a = det_action(actor_params, mb["obs"])
+            return -q_apply(q_params["q1"], mb["obs"], a).mean()
+
+        def update(actor_params, actor_target, q_params, q_target,
+                   actor_state, critic_state, mb, key, do_actor):
+            c_loss, c_grads = jax.value_and_grad(critic_loss)(
+                q_params, q_target, actor_target, mb, key)
+            c_updates, critic_state = self._critic_opt.update(
+                c_grads, critic_state, q_params)
+            q_params = optax.apply_updates(q_params, c_updates)
+
+            def actor_step(operand):
+                actor_params, actor_state = operand
+                a_loss, a_grads = jax.value_and_grad(actor_loss)(
+                    actor_params, q_params, mb)
+                a_updates, actor_state = self._actor_opt.update(
+                    a_grads, actor_state, actor_params)
+                return (optax.apply_updates(actor_params, a_updates),
+                        actor_state, a_loss)
+
+            def actor_skip(operand):
+                actor_params, actor_state = operand
+                return actor_params, actor_state, jnp.float32(0.0)
+
+            actor_params, actor_state, a_loss = jax.lax.cond(
+                do_actor, actor_step, actor_skip,
+                (actor_params, actor_state))
+            # Polyak targets (delayed with the actor in standard TD3; kept
+            # per-step-simple here, gated on do_actor like the actor).
+            polyak = lambda p, t: jnp.where(do_actor,
+                                            tau * p + (1 - tau) * t, t)
+            q_target = jax.tree.map(polyak, q_params, q_target)
+            actor_target = jax.tree.map(polyak, actor_params, actor_target)
+            return (actor_params, actor_target, q_params, q_target,
+                    actor_state, critic_state,
+                    {"critic_loss": c_loss, "actor_loss": a_loss})
+
+        self._update_jit = jax.jit(update)
+        self._key = jax.random.PRNGKey(config.seed + 31)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        import ray_tpu
+        config: TD3Config = self.config
+        weights_ref = ray_tpu.put(self.get_weights())
+        self.workers.sync_weights(weights_ref)
+        batch = self.workers.sample(max(config.rollout_fragment_length, 1))
+        self._timesteps_total += len(batch)
+        self._buffer.add(batch)
+        metrics_out: Dict[str, Any] = {}
+        if len(self._buffer) >= max(
+                config.num_steps_sampled_before_learning_starts,
+                config.train_batch_size):
+            actor_params = self.local_policy.params
+            for _ in range(config.num_train_batches_per_iteration):
+                mb = self._buffer.sample(config.train_batch_size)
+                device_mb = {k: jnp.asarray(v) for k, v in mb.items()
+                             if k in ("obs", "new_obs", "actions",
+                                      "rewards", "terminateds")}
+                self._key, sub = jax.random.split(self._key)
+                self._updates += 1
+                do_actor = jnp.bool_(
+                    self._updates % config.policy_delay == 0)
+                (actor_params, self._actor_target, self._q_params,
+                 self._q_target, self._actor_state, self._critic_state,
+                 metrics) = self._update_jit(
+                    actor_params, self._actor_target, self._q_params,
+                    self._q_target, self._actor_state, self._critic_state,
+                    device_mb, sub, do_actor)
+            self.local_policy.params = actor_params
+            metrics_out = {k: float(v) for k, v in metrics.items()}
+        metrics_out["replay_buffer_size"] = len(self._buffer)
+        return metrics_out

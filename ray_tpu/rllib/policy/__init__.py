@@ -31,4 +31,7 @@ def make_policy(policy_config: Dict[str, Any], obs_space, action_space,
     if name == "sac":
         from ray_tpu.rllib.policy.sac_policy import SACPolicy
         return SACPolicy(obs_space, action_space, model_config, seed=seed)
+    if name == "td3":
+        from ray_tpu.rllib.policy.sac_policy import TD3Policy
+        return TD3Policy(obs_space, action_space, model_config, seed=seed)
     raise ValueError(f"Unknown policy_class {name!r}")

@@ -88,3 +88,30 @@ class SACPolicy:
 
     def set_weights(self, weights) -> None:
         self.params = jax.tree.map(jnp.asarray, weights)
+
+
+class TD3Policy(SACPolicy):
+    """Deterministic actor + fixed Gaussian exploration noise (canonical
+    TD3 behavior policy). Reuses SACPolicy's network but ignores the
+    log_std head at rollout: TD3's actor loss trains only the mean, so the
+    sampled-std path would leave exploration scale untrained."""
+
+    EXPLORATION_SIGMA = 0.1  # fraction of the half action range
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+
+        def det(params, obs):
+            mu, _ = self.dist_params(params, obs)
+            a = jnp.tanh(mu)
+            return self.low + (a + 1.0) * 0.5 * (self.high - self.low)
+
+        self._det_jit = jax.jit(det)
+
+    def compute_actions(self, obs: np.ndarray, key):
+        a = np.asarray(self._det_jit(self.params, jnp.asarray(obs)))
+        noise = np.asarray(jax.random.normal(key, a.shape)) * \
+            self.EXPLORATION_SIGMA * (self.high - self.low) * 0.5
+        a = np.clip(a + noise, self.low, self.high)
+        zeros = np.zeros((obs.shape[0],), np.float32)
+        return a.astype(np.float32), zeros, zeros

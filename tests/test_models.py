@@ -264,3 +264,107 @@ def test_gpt_selective_remat_matches_full():
         gpt.loss_fn(params, gpt.config("gpt-tiny", remat=True,
                                        remat_policy="Selective"),
                     toks, tgts)
+
+
+# -- T5 (encoder-decoder) ----------------------------------------------
+
+
+def test_t5_forward_shape():
+    from ray_tpu.models import t5
+    cfg = t5.config("t5-tiny")
+    params = t5.init(cfg, jax.random.PRNGKey(0))
+    enc = jnp.zeros((2, 24), jnp.int32)
+    dec = jnp.zeros((2, 12), jnp.int32)
+    logits = t5.forward(params, cfg, enc, dec)
+    assert logits.shape == (2, 12, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_t5_param_count_matches_init():
+    from ray_tpu.models import t5
+    cfg = t5.config("t5-tiny")
+    params = t5.init(cfg, jax.random.PRNGKey(0))
+    actual = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    assert actual == cfg.num_params(), (actual, cfg.num_params())
+
+
+def test_t5_decoder_causality():
+    """Changing a future decoder token must not affect earlier logits;
+    changing any encoder token may affect all decoder positions."""
+    from ray_tpu.models import t5
+    rng = np.random.default_rng(0)
+    cfg = t5.config("t5-tiny")
+    params = t5.init(cfg, jax.random.PRNGKey(0))
+    enc = jnp.asarray(rng.integers(0, 256, (1, 16)), jnp.int32)
+    dec = jnp.asarray(rng.integers(0, 256, (1, 10)), jnp.int32)
+    base = np.asarray(t5.forward(params, cfg, enc, dec))
+    dec2 = dec.at[0, 7].set((dec[0, 7] + 1) % 256)
+    out2 = np.asarray(t5.forward(params, cfg, enc, dec2))
+    np.testing.assert_allclose(out2[0, :7], base[0, :7], atol=1e-5)
+    assert not np.allclose(out2[0, 7:], base[0, 7:])
+    enc2 = enc.at[0, 0].set((enc[0, 0] + 1) % 256)
+    out3 = np.asarray(t5.forward(params, cfg, enc2, dec))
+    assert not np.allclose(out3[0, 0], base[0, 0])
+
+
+def test_t5_overfits_seq2seq_batch():
+    """End-to-end learning check: a tiny T5 drives one fixed teacher-forced
+    copy batch to ~zero loss (generalized copying needs more capacity than
+    a CI-sized model; single-batch overfit proves every path — encoder,
+    cross-attention, decoder, tied head — carries gradient)."""
+    import optax
+    from ray_tpu.models import t5
+    cfg = t5.config("t5-tiny")
+    params = t5.init(cfg, jax.random.PRNGKey(0))
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+    rng = np.random.default_rng(0)
+    seq = rng.integers(2, 40, (4, 8))
+    enc = jnp.asarray(seq, jnp.int32)
+    dec_in = jnp.asarray(np.concatenate(
+        [np.zeros((4, 1)), seq[:, :-1]], 1), jnp.int32)
+    tgt = jnp.asarray(seq, jnp.int32)
+
+    @jax.jit
+    def step(params, opt_state):
+        (loss, metrics), grads = jax.value_and_grad(
+            lambda p: t5.loss_fn(p, cfg, enc, dec_in, tgt),
+            has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, metrics
+
+    for _ in range(250):
+        params, opt_state, metrics = step(params, opt_state)
+    assert float(metrics["accuracy"]) == 1.0, float(metrics["accuracy"])
+    assert float(metrics["loss"]) < 0.2, float(metrics["loss"])
+
+
+def test_t5_sharded_forward():
+    from ray_tpu.models import t5
+    devices = np.array(jax.devices("cpu")[:4]).reshape(2, 2)
+    mesh = jax.sharding.Mesh(devices, ("fsdp", "tp"))
+    rules = tp_fsdp_rules()
+    cfg = t5.config("t5-tiny")
+    params = t5.init(cfg, jax.random.PRNGKey(0))
+    sharded = shard_tree(params, mesh, t5.param_specs(cfg, rules))
+    enc = jnp.zeros((2, 16), jnp.int32)
+    dec = jnp.zeros((2, 8), jnp.int32)
+    out = jax.jit(lambda p: t5.forward(p, cfg, enc, dec))(sharded)
+    assert out.shape == (2, 8, cfg.vocab_size)
+
+
+def test_t5_decoder_rel_bias_covers_past():
+    """Regression: the unidirectional bucket computation once flipped the
+    sign, putting every causally-visible (past) pair in bucket 0 — the
+    decoder had no positional signal. Past distances must bucket
+    monotonically."""
+    from ray_tpu.models.t5 import _relative_buckets
+    q = jnp.arange(6)[:, None]
+    k = jnp.arange(6)[None, :]
+    b = np.asarray(_relative_buckets(q - k, False, 8, 32))
+    # strictly below the diagonal (visible past), buckets are nonzero and
+    # grow with distance
+    for i in range(1, 6):
+        for j in range(i):
+            assert b[i, j] > 0, (i, j, b)
+    assert b[5, 0] >= b[5, 3] > b[5, 4]

@@ -304,3 +304,25 @@ def test_algorithm_registry():
     assert get_algorithm_class("SAC") is SAC
     with pytest.raises(ValueError):
         get_algorithm_class("NOPE")
+
+
+def test_td3_pendulum_smoke(ray_start_regular):
+    from ray_tpu.rllib import TD3Config
+    config = (TD3Config()
+              .environment("Pendulum-v1")
+              .rollouts(num_rollout_workers=1, rollout_fragment_length=200)
+              .training(train_batch_size=64,
+                        num_steps_sampled_before_learning_starts=100,
+                        num_train_batches_per_iteration=8)
+              .debugging(seed=21))
+    algo = config.build()
+    for _ in range(2):
+        res = algo.train()
+    assert np.isfinite(res["critic_loss"])
+    assert np.isfinite(res["actor_loss"])
+    a = algo.compute_single_action(np.zeros(3, np.float32))
+    assert (-2.0 <= np.asarray(a)).all() and (np.asarray(a) <= 2.0).all()
+    # registry exposure
+    from ray_tpu.rllib import get_algorithm_class, TD3
+    assert get_algorithm_class("td3") is TD3
+    algo.stop()
