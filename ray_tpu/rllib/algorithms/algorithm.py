@@ -45,7 +45,9 @@ class Algorithm:
         probe_env.close() if hasattr(probe_env, "close") else None
         self.workers = WorkerSet(
             env_creator, config.policy_config(),
-            num_workers=max(config.num_rollout_workers, 1),
+            # 0 = offline algorithms (BC): no sampling actors at all.
+            num_workers=(0 if config.num_rollout_workers == 0
+                         else max(config.num_rollout_workers, 1)),
             seed=config.seed,
             num_cpus_per_worker=config.num_cpus_per_worker)
         self.setup(config)

@@ -1,0 +1,94 @@
+"""BC: behavior cloning from offline data.
+
+Analog of the reference's rllib/algorithms/bc (the offline-RL entry point
+over rllib/offline/): supervised imitation of logged actions read from
+JSON experience files — no environment interaction at all. The canonical
+consumer of JsonWriter output (`config.offline_data(input_=dir)`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or BC)
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.num_rollout_workers = 0  # offline: WorkerSet stays empty
+        self.num_train_batches_per_iteration = 16
+
+    def training(self, *, num_train_batches_per_iteration=None,
+                 **kwargs) -> "BCConfig":
+        super().training(**kwargs)
+        if num_train_batches_per_iteration is not None:
+            self.num_train_batches_per_iteration = \
+                num_train_batches_per_iteration
+        return self
+
+
+class BC(Algorithm):
+    _default_config_class = BCConfig
+
+    def __init__(self, config=None, **kwargs):
+        # Validate BEFORE Algorithm.__init__ spawns anything: a setup()-time
+        # failure would leak the already-created rollout actors.
+        cfg = config or self.get_default_config()
+        if not cfg.input_:
+            raise ValueError(
+                "BC is offline-only: set config.offline_data(input_=<dir "
+                "of JSON experience files written by JsonWriter>)")
+        super().__init__(config=config, **kwargs)
+
+    def setup(self, config: BCConfig) -> None:
+        import jax
+        import optax
+
+        from ray_tpu.rllib.offline.json_reader import JsonReader
+        self._reader = JsonReader(config.input_)
+        policy = self.local_policy
+        self._optimizer = optax.adam(config.lr)
+        self._opt_state = self._optimizer.init(policy.params)
+
+        def loss_fn(params, mb):
+            logp = policy.logp(params, mb["obs"], mb["actions"])
+            return -logp.mean()
+
+        def update(params, opt_state, mb):
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update_jit = jax.jit(update)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        config: BCConfig = self.config
+        losses = []
+        params = self.local_policy.params
+        for _ in range(config.num_train_batches_per_iteration):
+            fragment = self._reader.next()
+            self._timesteps_total += len(fragment)
+            # Fixed-size minibatches: honors train_batch_size and keeps the
+            # jitted update at one shape (no retrace per fragment length).
+            for mb in fragment.minibatches(
+                    min(config.train_batch_size, len(fragment))):
+                device_mb = {
+                    "obs": jnp.asarray(np.asarray(mb[SampleBatch.OBS],
+                                                  np.float32)),
+                    "actions": jnp.asarray(mb[SampleBatch.ACTIONS]),
+                }
+                params, self._opt_state, loss = self._update_jit(
+                    params, self._opt_state, device_mb)
+                losses.append(float(loss))
+        self.local_policy.params = params
+        return {"loss": float(np.mean(losses)),
+                "num_batches": len(losses)}
