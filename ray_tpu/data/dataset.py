@@ -309,6 +309,18 @@ class Dataset:
                                            [metas[j] for j in sel]))
         return out
 
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List["DataIterator"]:
+        """n disjoint iterators over this dataset — the per-worker Train
+        ingest handles (reference: dataset.streaming_split feeding one
+        DataIterator per train worker). Blocks are assigned round-robin;
+        execution streams through the operator pipeline on first use."""
+        if n <= 0:
+            raise ValueError(f"streaming_split requires n >= 1, got {n}")
+        return [DataIterator(self, shard_index=i, num_shards=n,
+                             equal=equal, locality_hints=locality_hints)
+                for i in range(n)]
+
     def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
         blocks, metas = self._execute()
         total = sum(m.num_rows or 0 for m in metas)
@@ -716,3 +728,45 @@ def _rows_to_block(rows: List[Any]) -> Block:
         except Exception:
             return pd.DataFrame(rows)
     return list(rows)
+
+
+class DataIterator:
+    """A shard-scoped iterator over a Dataset (reference: DataIterator
+    returned by streaming_split): each of the n iterators sees a disjoint
+    round-robin subset of blocks, exposing the same iteration surface the
+    full Dataset does (iter_batches / iter_rows / iter_jax_batches)."""
+
+    def __init__(self, dataset: Dataset, shard_index: int, num_shards: int,
+                 equal: bool = False, locality_hints=None):
+        self._dataset = dataset
+        self._shard_index = shard_index
+        self._num_shards = num_shards
+        self._equal = equal
+        self._locality_hints = locality_hints
+        self._shard: Optional[Dataset] = None
+
+    def _materialize_shard(self) -> Dataset:
+        if self._shard is None:
+            self._shard = self._dataset.split(
+                self._num_shards, equal=self._equal,
+                locality_hints=self._locality_hints)[self._shard_index]
+        return self._shard
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        return self._materialize_shard().iter_batches(**kwargs)
+
+    def iter_rows(self) -> Iterator[Any]:
+        return self._materialize_shard().iter_rows()
+
+    def iter_jax_batches(self, **kwargs) -> Iterator[Dict[str, Any]]:
+        return self._materialize_shard().iter_jax_batches(**kwargs)
+
+    def materialize(self) -> Dataset:
+        return self._materialize_shard().materialize()
+
+    def count(self) -> int:
+        return self._materialize_shard().count()
+
+    def __repr__(self):
+        return (f"DataIterator(shard={self._shard_index}/"
+                f"{self._num_shards})")

@@ -8,6 +8,7 @@ equivalent.
 """
 
 from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
@@ -28,7 +29,8 @@ from ray_tpu.rllib.policy.sample_batch import SampleBatch
 from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
                                                 ReplayBuffer)
 
-__all__ = ["A2C", "A2CConfig", "Algorithm", "AlgorithmConfig", "BC",
+__all__ = ["A2C", "A2CConfig", "APPO", "APPOConfig",
+           "Algorithm", "AlgorithmConfig", "BC",
            "BCConfig", "DQN",
            "DQNConfig", "Impala", "ImpalaConfig", "JAXPolicy", "JsonReader",
            "JsonWriter", "ModelCatalog", "PPO", "PPOConfig", "QPolicy",

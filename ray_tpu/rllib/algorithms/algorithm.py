@@ -45,8 +45,11 @@ class Algorithm:
         probe_env.close() if hasattr(probe_env, "close") else None
         self.workers = WorkerSet(
             env_creator, config.policy_config(),
-            # 0 = offline algorithms (BC): no sampling actors at all.
-            num_workers=(0 if config.num_rollout_workers == 0
+            # Zero sampling actors only for offline algorithms (input_ set);
+            # online algorithms keep the >=1 fallback — their training_step
+            # divides by worker count.
+            num_workers=(0 if (config.num_rollout_workers == 0
+                               and getattr(config, "input_", None))
                          else max(config.num_rollout_workers, 1)),
             seed=config.seed,
             num_cpus_per_worker=config.num_cpus_per_worker)

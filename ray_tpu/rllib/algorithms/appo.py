@@ -1,0 +1,125 @@
+"""APPO: asynchronous PPO — clipped surrogate over V-trace corrections.
+
+Analog of the reference's rllib/algorithms/appo (IMPALA's architecture
+with PPO's clipped loss): workers sample with slightly stale weights,
+V-trace (algorithms/impala.py) corrects the off-policyness into value
+targets and advantages, and the update applies the PPO clip against the
+behavior log-probs instead of a plain policy gradient.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.impala import compute_vtrace_targets
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class APPOConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or APPO)
+        self.lr = 5e-4
+        self.clip_param = 0.3
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.num_sgd_iter = 2
+        self.sgd_minibatch_size = 256
+        self.vtrace_rho_clip = 1.0
+        self.vtrace_c_clip = 1.0
+
+    def training(self, *, clip_param=None, vf_loss_coeff=None,
+                 entropy_coeff=None, num_sgd_iter=None,
+                 sgd_minibatch_size=None, vtrace_rho_clip=None,
+                 vtrace_c_clip=None, **kwargs) -> "APPOConfig":
+        super().training(**kwargs)
+        for name, val in (("clip_param", clip_param),
+                          ("vf_loss_coeff", vf_loss_coeff),
+                          ("entropy_coeff", entropy_coeff),
+                          ("num_sgd_iter", num_sgd_iter),
+                          ("sgd_minibatch_size", sgd_minibatch_size),
+                          ("vtrace_rho_clip", vtrace_rho_clip),
+                          ("vtrace_c_clip", vtrace_c_clip)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class APPO(Algorithm):
+    _default_config_class = APPOConfig
+
+    def setup(self, config: APPOConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        policy = self.local_policy
+        self._optimizer = optax.adam(config.lr)
+        self._opt_state = self._optimizer.init(policy.params)
+        clip = config.clip_param
+        vf_coeff = config.vf_loss_coeff
+        ent_coeff = config.entropy_coeff
+
+        def loss_fn(params, mb):
+            logp = policy.logp(params, mb["obs"], mb["actions"])
+            ratio = jnp.exp(logp - mb["behavior_logp"])
+            adv = mb["pg_advantages"]
+            surrogate = jnp.minimum(
+                ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+            values = policy._value(params, mb["obs"])
+            vf_loss = jnp.mean((values - mb["vs"]) ** 2)
+            entropy = jnp.mean(policy.entropy(params, mb["obs"]))
+            total = (-jnp.mean(surrogate) + vf_coeff * vf_loss
+                     - ent_coeff * entropy)
+            return total, {"policy_loss": -jnp.mean(surrogate),
+                           "vf_loss": vf_loss, "entropy": entropy}
+
+        def update(params, opt_state, mb):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        self._update_jit = jax.jit(update)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        import ray_tpu
+        config: APPOConfig = self.config
+        weights_ref = ray_tpu.put(self.get_weights())
+        self.workers.sync_weights(weights_ref)
+        per_worker = max(
+            config.train_batch_size // self.workers.num_workers(), 1)
+        batch = self.workers.sample(per_worker)
+        self._timesteps_total += len(batch)
+
+        policy = self.local_policy
+        obs, vs, pg_adv = compute_vtrace_targets(
+            policy, batch, config.gamma, config.vtrace_rho_clip,
+            config.vtrace_c_clip)
+        full = SampleBatch({
+            "obs": obs,
+            "actions": np.asarray(batch[SampleBatch.ACTIONS]),
+            "behavior_logp": np.asarray(batch[SampleBatch.ACTION_LOGP],
+                                        np.float32),
+            "vs": vs,
+            "pg_advantages": pg_adv,
+        })
+        params = policy.params
+        metrics = {}
+        for epoch in range(config.num_sgd_iter):
+            for mb in full.minibatches(
+                    min(config.sgd_minibatch_size, len(full)),
+                    seed=self.iteration * 97 + epoch):
+                device_mb = {k: jnp.asarray(v) for k, v in mb.items()}
+                params, self._opt_state, metrics = self._update_jit(
+                    params, self._opt_state, device_mb)
+        policy.params = params
+        return {k: float(v) for k, v in metrics.items()}

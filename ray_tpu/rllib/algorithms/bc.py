@@ -53,6 +53,7 @@ class BC(Algorithm):
 
         from ray_tpu.rllib.offline.json_reader import JsonReader
         self._reader = JsonReader(config.input_)
+        self._carry = None  # remainder rows between training steps
         policy = self.local_policy
         self._optimizer = optax.adam(config.lr)
         self._opt_state = self._optimizer.init(policy.params)
@@ -72,23 +73,29 @@ class BC(Algorithm):
     def training_step(self) -> Dict[str, Any]:
         import jax.numpy as jnp
         config: BCConfig = self.config
+        batch_size = config.train_batch_size
         losses = []
         params = self.local_policy.params
+        # Accumulate fragments into exact train_batch_size batches: one
+        # jitted shape (no retrace per fragment length), no rows dropped —
+        # the remainder carries over to the next training_step.
         for _ in range(config.num_train_batches_per_iteration):
-            fragment = self._reader.next()
-            self._timesteps_total += len(fragment)
-            # Fixed-size minibatches: honors train_batch_size and keeps the
-            # jitted update at one shape (no retrace per fragment length).
-            for mb in fragment.minibatches(
-                    min(config.train_batch_size, len(fragment))):
-                device_mb = {
-                    "obs": jnp.asarray(np.asarray(mb[SampleBatch.OBS],
-                                                  np.float32)),
-                    "actions": jnp.asarray(mb[SampleBatch.ACTIONS]),
-                }
-                params, self._opt_state, loss = self._update_jit(
-                    params, self._opt_state, device_mb)
-                losses.append(float(loss))
+            while (self._carry is None or len(self._carry) < batch_size):
+                fragment = self._reader.next()
+                self._carry = (fragment if self._carry is None else
+                               SampleBatch.concat_samples(
+                                   [self._carry, fragment]))
+            mb = self._carry.slice(0, batch_size)
+            self._carry = self._carry.slice(batch_size, len(self._carry))
+            self._timesteps_total += batch_size
+            device_mb = {
+                "obs": jnp.asarray(np.asarray(mb[SampleBatch.OBS],
+                                              np.float32)),
+                "actions": jnp.asarray(mb[SampleBatch.ACTIONS]),
+            }
+            params, self._opt_state, loss = self._update_jit(
+                params, self._opt_state, device_mb)
+            losses.append(float(loss))
         self.local_policy.params = params
         return {"loss": float(np.mean(losses)),
                 "num_batches": len(losses)}

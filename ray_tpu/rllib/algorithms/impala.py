@@ -39,6 +39,39 @@ def vtrace(behavior_logp: np.ndarray, target_logp: np.ndarray,
     return vs.astype(np.float32), pg_adv.astype(np.float32)
 
 
+
+
+def compute_vtrace_targets(policy, batch: SampleBatch, gamma: float,
+                           rho_clip: float, c_clip: float):
+    """Per-episode-fragment V-trace targets against the CURRENT policy:
+    returns (obs, vs, pg_advantages) as numpy arrays. Shared by IMPALA and
+    APPO (their losses differ; the correction does not)."""
+    import jax.numpy as jnp
+    obs = np.asarray(batch[SampleBatch.OBS], np.float32)
+    target_logp = np.asarray(policy.logp(
+        policy.params, jnp.asarray(obs),
+        jnp.asarray(batch[SampleBatch.ACTIONS])))
+    values = np.asarray(policy._value(policy.params, jnp.asarray(obs)))
+    vs_all: List[np.ndarray] = []
+    adv_all: List[np.ndarray] = []
+    start = 0
+    for frag in batch.split_by_episode():
+        n = len(frag)
+        terminated = frag[SampleBatch.TERMINATEDS][-1] > 0
+        # Truncation bootstrap approximates V(s_T) for V(s_{T+1}) (the
+        # post-fragment observation isn't in the batch).
+        bootstrap = 0.0 if terminated else float(values[start + n - 1])
+        vs, adv = vtrace(
+            np.asarray(frag[SampleBatch.ACTION_LOGP], np.float32),
+            target_logp[start:start + n],
+            np.asarray(frag[SampleBatch.REWARDS], np.float32),
+            values[start:start + n], bootstrap, gamma, rho_clip, c_clip)
+        vs_all.append(vs)
+        adv_all.append(adv)
+        start += n
+    return obs, np.concatenate(vs_all), np.concatenate(adv_all)
+
+
 class ImpalaConfig(AlgorithmConfig):
     def __init__(self, algo_class=None):
         super().__init__(algo_class=algo_class or Impala)
@@ -108,35 +141,15 @@ class Impala(Algorithm):
         batch = self.workers.sample(per_worker)
         self._timesteps_total += len(batch)
 
-        # V-trace per episode fragment against CURRENT params.
         policy = self.local_policy
-        obs = np.asarray(batch[SampleBatch.OBS], np.float32)
-        target_logp = np.asarray(policy.logp(
-            policy.params, jnp.asarray(obs),
-            jnp.asarray(batch[SampleBatch.ACTIONS])))
-        values = np.asarray(policy._value(policy.params, jnp.asarray(obs)))
-        vs_all: List[np.ndarray] = []
-        adv_all: List[np.ndarray] = []
-        start = 0
-        for frag in batch.split_by_episode():
-            n = len(frag)
-            terminated = frag[SampleBatch.TERMINATEDS][-1] > 0
-            bootstrap = 0.0 if terminated else float(
-                values[start + n - 1])
-            vs, adv = vtrace(
-                np.asarray(frag[SampleBatch.ACTION_LOGP], np.float32),
-                target_logp[start:start + n],
-                np.asarray(frag[SampleBatch.REWARDS], np.float32),
-                values[start:start + n], bootstrap, config.gamma,
-                config.vtrace_rho_clip, config.vtrace_c_clip)
-            vs_all.append(vs)
-            adv_all.append(adv)
-            start += n
+        obs, vs, pg_adv = compute_vtrace_targets(
+            policy, batch, config.gamma, config.vtrace_rho_clip,
+            config.vtrace_c_clip)
         device_mb = {
             "obs": jnp.asarray(obs),
             "actions": jnp.asarray(batch[SampleBatch.ACTIONS]),
-            "vs": jnp.asarray(np.concatenate(vs_all)),
-            "pg_advantages": jnp.asarray(np.concatenate(adv_all)),
+            "vs": jnp.asarray(vs),
+            "pg_advantages": jnp.asarray(pg_adv),
         }
         params, self._opt_state, metrics = self._update_jit(
             policy.params, self._opt_state, device_mb)

@@ -8,6 +8,7 @@ from typing import Tuple, Type
 
 def get_algorithm_class(name: str) -> Type:
     from ray_tpu.rllib.algorithms.a2c import A2C
+    from ray_tpu.rllib.algorithms.appo import APPO
     from ray_tpu.rllib.algorithms.bc import BC
     from ray_tpu.rllib.algorithms.dqn import DQN
     from ray_tpu.rllib.algorithms.impala import Impala
@@ -16,7 +17,7 @@ def get_algorithm_class(name: str) -> Type:
     from ray_tpu.rllib.algorithms.td3 import TD3
 
     table = {"PPO": PPO, "DQN": DQN, "SAC": SAC, "A2C": A2C,
-             "IMPALA": Impala, "TD3": TD3, "BC": BC}
+             "IMPALA": Impala, "TD3": TD3, "BC": BC, "APPO": APPO}
     try:
         return table[name.upper()]
     except KeyError:

@@ -359,3 +359,22 @@ def test_streaming_partial_consumption_no_cache(ray_shared):
     # a full pass still sees every row
     total = sum(len(b["id"]) for b in ds.iter_batches(batch_size=4))
     assert total == 32
+
+
+def test_streaming_split_disjoint_and_complete(ray_shared):
+    from ray_tpu import data as rdata
+
+    ds = rdata.range(48, parallelism=6).map_batches(
+        lambda b: {"id": b["id"] * 3})
+    its = ds.streaming_split(3)
+    assert len(its) == 3
+    shards = [sorted(v for b in it.iter_batches(batch_size=None)
+                     for v in b["id"]) for it in its]
+    # disjoint and complete
+    all_vals = sorted(v for s in shards for v in s)
+    assert all_vals == [3 * i for i in range(48)]
+    assert all(s for s in shards)
+    assert sum(it.count() for it in its) == 48
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        ds.streaming_split(0)

@@ -356,3 +356,19 @@ def test_bc_learns_from_offline_data(ray_start_regular, tmp_path):
     # BC without input_ is a config error
     with pytest.raises(ValueError):
         (BCConfig().environment("CartPole-v1")).build()
+
+
+def test_appo_cartpole_smoke(ray_start_regular):
+    from ray_tpu.rllib import APPOConfig
+    config = (APPOConfig()
+              .environment("CartPole-v1")
+              .rollouts(num_rollout_workers=2)
+              .training(train_batch_size=512, lr=2e-3)
+              .debugging(seed=13))
+    algo = config.build()
+    for _ in range(3):
+        res = algo.train()
+    assert np.isfinite(res["total_loss"])
+    from ray_tpu.rllib import APPO, get_algorithm_class
+    assert get_algorithm_class("appo") is APPO
+    algo.stop()

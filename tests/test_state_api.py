@@ -55,7 +55,14 @@ def test_timeline(ray_start_regular, tmp_path):
 
     ray_tpu.get([work.remote() for _ in range(3)])
     out = str(tmp_path / "timeline.json")
+    # get() unblocks when results are stored — the FINISHED event lands a
+    # hair later on the worker thread; poll briefly.
+    import time as _time
+    deadline = _time.monotonic() + 5
     events = timeline(out)
+    while len(events) < 3 and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+        events = timeline(out)
     assert len(events) >= 3
     data = json.load(open(out))
     assert data[0]["ph"] == "X"
