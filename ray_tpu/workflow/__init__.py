@@ -19,7 +19,8 @@ import ray_tpu
 from ray_tpu.dag import DAGNode, FunctionNode, InputAttributeNode, InputNode
 
 __all__ = ["init", "run", "run_async", "resume", "get_output", "get_status",
-           "list_all", "delete", "cancel"]
+           "list_all", "delete", "cancel",
+           "wait_for_event", "trigger_event"]
 
 _storage_dir: Optional[str] = None
 
@@ -227,3 +228,89 @@ def delete(workflow_id: str) -> None:
 
 def cancel(workflow_id: str) -> None:
     _WorkflowStorage(workflow_id).set_status(CANCELED)
+
+
+# -- events ----------------------------------------------------------------
+# Analog of the reference's workflow event system (workflow/
+# http_event_provider.py + workflow.wait_for_event): a workflow task can
+# block on an external event; `trigger_event` (callable from anywhere in
+# the cluster, including the dashboard's HTTP surface) releases it. Events
+# ride the runtime's pubsub hub and are checkpointed like any other task —
+# a resumed workflow does not re-wait for an event it already consumed.
+
+
+def _validate_event_key(event_key: str) -> None:
+    if not isinstance(event_key, str) or not event_key:
+        raise ValueError(f"event_key must be a non-empty string, got "
+                         f"{event_key!r}")
+    if "|" in event_key:
+        # '|' is the native pubsub wire separator.
+        raise ValueError(
+            f"Invalid event_key {event_key!r}: must not contain '|'")
+
+
+def _event_latch(runtime) -> Dict[str, Any]:
+    latch = getattr(runtime, "_workflow_event_latch", None)
+    if latch is None:
+        latch = runtime._workflow_event_latch = {}
+    return latch
+
+
+def wait_for_event(event_key: str, timeout: Optional[float] = None):
+    """A DAG node that resolves to the event's payload once
+    ``trigger_event(event_key, payload)`` fires. Events LATCH: a trigger
+    that arrives before the waiter subscribes (or while a workflow is
+    down pre-resume) is retained and delivered immediately; a later
+    trigger for the same key overwrites the latch."""
+    _validate_event_key(event_key)
+    from ray_tpu.remote_function import remote
+
+    # num_cpus=0: an event wait is parked I/O, not compute — it must not
+    # hold a worker CPU slot for a possibly unbounded time.
+    @remote(num_cpus=0)
+    def _wait_for_event(_key: str = event_key, _timeout=timeout):
+        import uuid as _uuid
+
+        from ray_tpu._private.worker import global_worker
+        runtime = global_worker.runtime
+        hub = runtime.pubsub
+        sub_id = f"workflow-event-{_uuid.uuid4().hex[:8]}"
+        hub.subscribe(sub_id, "workflow_events", _key)
+        try:
+            import time as _time
+            latch = _event_latch(runtime)
+            deadline = (None if _timeout is None
+                        else _time.monotonic() + _timeout)
+            while True:
+                # Latched (possibly pre-subscription) event wins.
+                if _key in latch:
+                    return latch[_key]
+                remaining = 1.0
+                if deadline is not None:
+                    remaining = min(1.0, deadline - _time.monotonic())
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"workflow event {_key!r} did not arrive "
+                            f"within {_timeout}s")
+                msg = hub.poll(sub_id, timeout=remaining)
+                if msg is not None:
+                    import pickle as _pickle
+                    return _pickle.loads(bytes.fromhex(msg[2]))
+        finally:
+            hub.drop_subscriber(sub_id)
+
+    return _wait_for_event.bind()
+
+
+def trigger_event(event_key: str, payload: Any = None) -> int:
+    """Deliver an event to workflow tasks waiting on ``event_key`` (and
+    latch it for waiters that haven't subscribed yet). Returns the number
+    of currently-parked waiters it reached directly."""
+    import pickle as _pickle
+
+    from ray_tpu._private.worker import global_worker
+    _validate_event_key(event_key)
+    runtime = global_worker.runtime
+    _event_latch(runtime)[event_key] = payload
+    return runtime.pubsub.publish(
+        "workflow_events", event_key, _pickle.dumps(payload).hex())

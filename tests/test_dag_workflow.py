@@ -130,3 +130,66 @@ def test_workflow_input_and_delete(ray_start_regular, tmp_path):
     assert out == 42
     workflow.delete("wf3")
     assert ("wf3", workflow.SUCCESSFUL) not in workflow.list_all()
+
+
+def test_workflow_waits_for_event(ray_start_regular):
+    """A workflow blocks on wait_for_event until trigger_event fires, and
+    the consumed event is checkpointed (resume doesn't re-wait)."""
+    import threading
+    import time
+
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def combine(base, event_payload):
+        return {"base": base, "event": event_payload}
+
+    dag = combine.bind(10, workflow.wait_for_event("approval", timeout=15))
+    result_box = {}
+
+    def run():
+        result_box["out"] = workflow.run(dag, workflow_id="evt-wf")
+
+    t = threading.Thread(target=run)
+    t.start()
+    time.sleep(0.4)
+    assert t.is_alive(), "workflow should still be waiting on the event"
+    # The latch makes delivery safe regardless of subscription timing.
+    workflow.trigger_event("approval", {"approved_by": "qa"})
+    t.join(timeout=15)
+    assert not t.is_alive()
+    assert result_box["out"] == {"base": 10,
+                                 "event": {"approved_by": "qa"}}
+    # Resume replays from checkpoints without waiting again.
+    assert workflow.resume("evt-wf") == result_box["out"]
+
+
+def test_workflow_event_timeout(ray_start_regular):
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def passthrough(x):
+        return x
+
+    dag = passthrough.bind(workflow.wait_for_event("never", timeout=0.3))
+    with pytest.raises(Exception, match="did not arrive"):
+        workflow.run(dag, workflow_id="evt-timeout")
+
+
+def test_workflow_event_latches_before_waiter(ray_start_regular):
+    """A trigger that fires before the waiter subscribes must not be lost
+    (the latch), and '|' in keys is rejected (native wire separator)."""
+    from ray_tpu import workflow
+
+    workflow.trigger_event("pre-fired", "early-payload")
+
+    @ray_tpu.remote
+    def passthrough(x):
+        return x
+
+    dag = passthrough.bind(workflow.wait_for_event("pre-fired", timeout=10))
+    assert workflow.run(dag, workflow_id="evt-latch") == "early-payload"
+    with pytest.raises(ValueError):
+        workflow.wait_for_event("bad|key")
+    with pytest.raises(ValueError):
+        workflow.trigger_event("bad|key")
