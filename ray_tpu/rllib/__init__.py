@@ -8,15 +8,23 @@ equivalent.
 """
 
 from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
+from ray_tpu.rllib.algorithms.a3c import A3C, A3CConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
+from ray_tpu.rllib.algorithms.ars import ARS, ARSConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.es import ES, ESConfig
 from ray_tpu.rllib.algorithms.impala import Impala, ImpalaConfig
+from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
+from ray_tpu.rllib.algorithms.pg import PG, PGConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.registry import get_algorithm_class
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.algorithms.simple_q import SimpleQ, SimpleQConfig
 from ray_tpu.rllib.algorithms.td3 import TD3, TD3Config
 from ray_tpu.rllib.evaluation.rollout_worker import RolloutWorker
 from ray_tpu.rllib.evaluation.worker_set import WorkerSet
@@ -29,12 +37,15 @@ from ray_tpu.rllib.policy.sample_batch import SampleBatch
 from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
                                                 ReplayBuffer)
 
-__all__ = ["A2C", "A2CConfig", "APPO", "APPOConfig",
-           "Algorithm", "AlgorithmConfig", "BC",
-           "BCConfig", "DQN",
-           "DQNConfig", "Impala", "ImpalaConfig", "JAXPolicy", "JsonReader",
-           "JsonWriter", "ModelCatalog", "PPO", "PPOConfig", "QPolicy",
+__all__ = ["A2C", "A2CConfig", "A3C", "A3CConfig", "APPO", "APPOConfig",
+           "ARS", "ARSConfig", "Algorithm", "AlgorithmConfig", "BC",
+           "BCConfig", "CQL", "CQLConfig", "DDPG", "DDPGConfig", "DQN",
+           "DQNConfig", "ES", "ESConfig", "Impala", "ImpalaConfig",
+           "JAXPolicy", "JsonReader",
+           "JsonWriter", "MARWIL", "MARWILConfig", "ModelCatalog", "PG",
+           "PGConfig", "PPO", "PPOConfig", "QPolicy",
            "PrioritizedReplayBuffer", "ReplayBuffer", "RolloutWorker",
-           "SAC", "SACConfig", "SACPolicy", "SampleBatch", "TD3",
+           "SAC", "SACConfig", "SACPolicy", "SampleBatch", "SimpleQ",
+           "SimpleQConfig", "TD3",
            "TD3Config", "WorkerSet",
            "compute_gae", "get_algorithm_class"]

@@ -1,0 +1,67 @@
+"""A3C: asynchronous advantage actor-critic.
+
+Analog of the reference's rllib/algorithms/a3c: same loss as A2C, but
+gradients are computed from per-worker batches and applied in *arrival
+order* — each worker samples with the weights it was handed at launch, so
+later updates in a round are computed against slightly stale parameters
+(the hogwild-style asynchrony that distinguishes A3C from A2C's
+synchronous barrier). One training_step launches every worker once and
+drains completions with ray.wait.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class A3CConfig(A2CConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or A3C)
+        self.lr = 1e-3
+        self.grad_clip = 40.0
+
+
+class A3C(A2C):
+    _default_config_class = A3CConfig
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        import ray_tpu
+        config: A3CConfig = self.config
+        workers = self.workers.remote_workers
+        per_worker = max(config.train_batch_size // len(workers), 1)
+        # Launch: every worker gets the current weights, then samples.
+        weights_ref = ray_tpu.put(self.get_weights())
+        pending = {}
+        for w in workers:
+            w.set_weights.remote(weights_ref)
+            pending[w.sample.remote(per_worker)] = w
+        metrics: Dict[str, Any] = {}
+        n_applied = 0
+        # Drain in completion order; each batch's gradient was computed
+        # from launch-time weights but is applied to the newest params.
+        while pending:
+            done, _ = ray_tpu.wait(list(pending), num_returns=1)
+            ref = done[0]
+            pending.pop(ref)
+            batch = ray_tpu.get(ref)
+            self._timesteps_total += len(batch)
+            adv = batch[SampleBatch.ADVANTAGES]
+            batch[SampleBatch.ADVANTAGES] = (
+                (adv - adv.mean()) / max(adv.std(), 1e-8)).astype(np.float32)
+            device_mb = {k: jnp.asarray(v) for k, v in batch.items()
+                         if k in ("obs", "actions", "advantages",
+                                  "value_targets")}
+            params, self._opt_state, metrics = self._update_jit(
+                self.local_policy.params, self._opt_state, device_mb)
+            self.local_policy.params = params
+            n_applied += 1
+        out = {k: float(v) for k, v in metrics.items()}
+        out["async_grad_updates"] = n_applied
+        return out

@@ -23,6 +23,9 @@ from ray_tpu.rllib.policy.jax_policy import JAXPolicy
 
 class Algorithm:
     _default_config_class = AlgorithmConfig
+    # Algorithms that run their own rollout/evaluation actors (ES/ARS)
+    # instead of the standard WorkerSet set this to keep it empty.
+    _own_rollout_actors = False
 
     def __init__(self, config: Optional[AlgorithmConfig] = None, env=None,
                  **kwargs):
@@ -48,8 +51,9 @@ class Algorithm:
             # Zero sampling actors only for offline algorithms (input_ set);
             # online algorithms keep the >=1 fallback — their training_step
             # divides by worker count.
-            num_workers=(0 if (config.num_rollout_workers == 0
-                               and getattr(config, "input_", None))
+            num_workers=(0 if (self._own_rollout_actors
+                               or (config.num_rollout_workers == 0
+                                   and getattr(config, "input_", None)))
                          else max(config.num_rollout_workers, 1)),
             seed=config.seed,
             num_cpus_per_worker=config.num_cpus_per_worker)
@@ -69,7 +73,10 @@ class Algorithm:
         self.iteration += 1
         results = self.training_step()
         stats = self.workers.episode_stats()
-        results.update(stats)
+        for k, v in stats.items():
+            # training_step wins if it already reported the metric (e.g.
+            # ES/ARS compute episode stats from their own evaluators).
+            results.setdefault(k, v)
         results.update({
             "training_iteration": self.iteration,
             "timesteps_total": self._timesteps_total,

@@ -53,7 +53,6 @@ class BC(Algorithm):
 
         from ray_tpu.rllib.offline.json_reader import JsonReader
         self._reader = JsonReader(config.input_)
-        self._carry = None  # remainder rows between training steps
         policy = self.local_policy
         self._optimizer = optax.adam(config.lr)
         self._opt_state = self._optimizer.init(policy.params)
@@ -76,17 +75,8 @@ class BC(Algorithm):
         batch_size = config.train_batch_size
         losses = []
         params = self.local_policy.params
-        # Accumulate fragments into exact train_batch_size batches: one
-        # jitted shape (no retrace per fragment length), no rows dropped —
-        # the remainder carries over to the next training_step.
         for _ in range(config.num_train_batches_per_iteration):
-            while (self._carry is None or len(self._carry) < batch_size):
-                fragment = self._reader.next()
-                self._carry = (fragment if self._carry is None else
-                               SampleBatch.concat_samples(
-                                   [self._carry, fragment]))
-            mb = self._carry.slice(0, batch_size)
-            self._carry = self._carry.slice(batch_size, len(self._carry))
+            mb = self._reader.next_batch(batch_size)
             self._timesteps_total += batch_size
             device_mb = {
                 "obs": jnp.asarray(np.asarray(mb[SampleBatch.OBS],

@@ -1,0 +1,110 @@
+"""CQL: conservative Q-learning for offline RL.
+
+Analog of the reference's rllib/algorithms/cql (built on its SAC stack,
+as here): SAC's twin-critic maximum-entropy update plus the CQL(H)
+conservative regularizer — for each critic, push down a log-sum-exp over
+out-of-distribution actions (uniform proposals and current-policy samples
+at s and s', importance-corrected by their log-densities) and push up the
+Q of the logged dataset actions. Offline-only: the replay buffer is filled
+once from JSON experience files and never touched by rollouts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class CQLConfig(SACConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or CQL)
+        self.num_rollout_workers = 0  # offline: WorkerSet stays empty
+        self.min_q_weight = 5.0
+        self.num_ood_actions = 4  # proposals per source per state
+        self.num_train_batches_per_iteration = 32
+
+    def training(self, *, min_q_weight=None, num_ood_actions=None,
+                 **kwargs) -> "CQLConfig":
+        super().training(**kwargs)
+        if min_q_weight is not None:
+            self.min_q_weight = min_q_weight
+        if num_ood_actions is not None:
+            self.num_ood_actions = num_ood_actions
+        return self
+
+
+class CQL(SAC):
+    _default_config_class = CQLConfig
+
+    def __init__(self, config=None, **kwargs):
+        cfg = config or self.get_default_config()
+        if not cfg.input_:
+            raise ValueError(
+                "CQL is offline-only: set "
+                "config.offline_data(input_=<dir of JSON experience files>)")
+        super().__init__(config=config, **kwargs)
+
+    def _conservative_penalty(self, q_apply, q_params, actor_params, mb,
+                              key):
+        import jax
+        import jax.numpy as jnp
+
+        config: CQLConfig = self.config
+        policy = self.local_policy
+        n = config.num_ood_actions
+        low = jnp.asarray(policy.low)
+        high = jnp.asarray(policy.high)
+        batch = mb["obs"].shape[0]
+        act_dim = policy.act_dim
+        k_rand, k_cur, k_next = jax.random.split(key, 3)
+
+        # Proposal set: uniform actions + policy samples at s and s',
+        # each importance-corrected by its proposal log-density (CQL(H)).
+        rand_a = jax.random.uniform(
+            k_rand, (n, batch, act_dim), minval=low, maxval=high)
+        log_unif = -jnp.log(high - low).sum()  # density of U[low, high]
+
+        def pi_samples(obs, key):
+            keys = jax.random.split(key, n)
+            return jax.vmap(
+                lambda k: policy.logp_and_sample(actor_params, obs, k)
+            )(keys)  # actions (n, B, A), logp (n, B)
+
+        cur_a, cur_logp = pi_samples(mb["obs"], k_cur)
+        next_a, next_logp = pi_samples(mb["new_obs"], k_next)
+
+        penalty = 0.0
+        for name in ("q1", "q2"):
+            def q_at(actions):
+                return jax.vmap(
+                    lambda a: q_apply(q_params[name], mb["obs"], a)
+                )(actions)  # (n, B)
+
+            cat = jnp.concatenate([
+                q_at(rand_a) - log_unif,
+                q_at(cur_a) - jax.lax.stop_gradient(cur_logp),
+                q_at(next_a) - jax.lax.stop_gradient(next_logp),
+            ], axis=0)  # (3n, B)
+            ood = jax.scipy.special.logsumexp(cat, axis=0) - jnp.log(3 * n)
+            data_q = q_apply(q_params[name], mb["obs"], mb["actions"])
+            penalty = penalty + (ood - data_q).mean()
+        return config.min_q_weight * penalty
+
+    def setup(self, config: CQLConfig) -> None:
+        super().setup(config)
+        from ray_tpu.rllib.offline.json_reader import JsonReader
+        data = JsonReader(config.input_).read_all()
+        self._buffer.add(data)
+        self._dataset_size = len(data)
+
+    def training_step(self) -> Dict[str, Any]:
+        config: CQLConfig = self.config
+        out = self._train_on_buffer(config.num_train_batches_per_iteration)
+        self._timesteps_total += (config.num_train_batches_per_iteration
+                                  * config.train_batch_size)
+        out["dataset_size"] = self._dataset_size
+        return out

@@ -1,0 +1,205 @@
+"""ES: OpenAI-style evolution strategies.
+
+Analog of the reference's rllib/algorithms/es: derivative-free policy
+search. A shared Gaussian noise table lives in the object store; evaluator
+actors draw antithetic perturbation pairs theta ± sigma*eps (eps = a slice
+of the table addressed by index, so only indices travel back), roll out
+one episode per perturbation, and the driver combines centered-rank
+weighted noise into a gradient estimate applied with Adam. No
+backpropagation anywhere — the policy network only runs forward, which
+makes ES trivially parallel across CPU actors while the MLP forward is
+still XLA-compiled.
+
+Differences from the reference: no observation mean/std filter (the
+connector-level MeanStd filter covers that capability elsewhere), and the
+policy is the standard catalog MLP rather than a bespoke ES net.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+
+
+def create_shared_noise(count: int = 1_000_000, seed: int = 42
+                        ) -> np.ndarray:
+    """The shared noise table (reference: es/utils.py create_shared_noise):
+    one big float32 Gaussian array; perturbations are random slices."""
+    return np.random.default_rng(seed).standard_normal(
+        count).astype(np.float32)
+
+
+def centered_ranks(x: np.ndarray) -> np.ndarray:
+    """Rank-transform returns to [-0.5, 0.5] (reference:
+    es/utils.py compute_centered_ranks) — scale-free fitness shaping."""
+    ranks = np.empty(x.size, dtype=np.float32)
+    ranks[x.ravel().argsort()] = np.arange(x.size, dtype=np.float32)
+    return (ranks / (x.size - 1) - 0.5).reshape(x.shape)
+
+
+class ESWorker:
+    """Perturbation evaluator actor: holds the env, a policy skeleton and
+    the noise table; evaluates antithetic pairs deterministically."""
+
+    def __init__(self, env_creator, policy_config, noise, worker_index=0,
+                 seed=0):
+        import jax
+
+        from ray_tpu.rllib.policy import make_policy
+        self.env = env_creator(policy_config.get("env_config") or {})
+        self.policy = make_policy(policy_config,
+                                  self.env.observation_space,
+                                  self.env.action_space, seed=seed)
+        from jax.flatten_util import ravel_pytree
+        _, self._unravel = ravel_pytree(self.policy.params)
+        self.noise = np.asarray(noise)
+        self._logits = jax.jit(self.policy.logits)
+        self._rng = np.random.default_rng(seed * 1000 + worker_index)
+        self.worker_index = worker_index
+
+    def _rollout(self, theta: np.ndarray, horizon: int):
+        params = self._unravel(theta)
+        obs, _ = self.env.reset(
+            seed=int(self._rng.integers(0, 2**31 - 1)))
+        total, steps, done = 0.0, 0, False
+        while not done and steps < horizon:
+            logits = np.asarray(self._logits(
+                params, np.asarray(obs, np.float32).reshape(1, -1)))
+            if self.policy.discrete:
+                action = int(logits.argmax(-1)[0])
+            else:
+                action = logits[0]
+            obs, reward, terminated, truncated, _ = self.env.step(action)
+            total += float(reward)
+            steps += 1
+            done = terminated or truncated
+        return total, steps
+
+    def do_rollouts(self, theta: np.ndarray, num_pairs: int, sigma: float,
+                    horizon: int) -> Dict[str, Any]:
+        theta = np.asarray(theta, np.float32)
+        dim = theta.size
+        indices, r_pos, r_neg, lengths = [], [], [], []
+        for _ in range(num_pairs):
+            idx = int(self._rng.integers(0, self.noise.size - dim + 1))
+            eps = self.noise[idx:idx + dim]
+            ret_p, len_p = self._rollout(theta + sigma * eps, horizon)
+            ret_n, len_n = self._rollout(theta - sigma * eps, horizon)
+            indices.append(idx)
+            r_pos.append(ret_p)
+            r_neg.append(ret_n)
+            lengths.extend((len_p, len_n))
+        return {
+            "noise_indices": np.asarray(indices, np.int64),
+            "returns_pos": np.asarray(r_pos, np.float32),
+            "returns_neg": np.asarray(r_neg, np.float32),
+            "lengths": np.asarray(lengths, np.int64),
+        }
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or ES)
+        self.num_rollout_workers = 2       # evaluator actors
+        self.noise_stdev = 0.05
+        self.stepsize = 0.03
+        self.num_rollout_pairs_per_worker = 10
+        self.episode_horizon = 1000
+        self.noise_table_size = 1_000_000
+        self.fcnet_hiddens = (32, 32)
+
+    def training(self, *, noise_stdev=None, stepsize=None,
+                 num_rollout_pairs_per_worker=None, episode_horizon=None,
+                 noise_table_size=None, **kwargs) -> "ESConfig":
+        super().training(**kwargs)
+        for name, val in (
+                ("noise_stdev", noise_stdev), ("stepsize", stepsize),
+                ("num_rollout_pairs_per_worker",
+                 num_rollout_pairs_per_worker),
+                ("episode_horizon", episode_horizon),
+                ("noise_table_size", noise_table_size)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class ES(Algorithm):
+    _default_config_class = ESConfig
+    _own_rollout_actors = True
+
+    def setup(self, config: ESConfig) -> None:
+        import optax
+        from jax.flatten_util import ravel_pytree
+
+        self._noise = create_shared_noise(config.noise_table_size,
+                                          seed=config.seed + 123)
+        noise_ref = ray_tpu.put(self._noise)
+        worker_cls = ray_tpu.remote(ESWorker)
+        self._es_workers = [
+            worker_cls.options(num_cpus=config.num_cpus_per_worker).remote(
+                self._env_creator, config.policy_config(), noise_ref,
+                worker_index=i + 1, seed=config.seed)
+            for i in range(max(config.num_rollout_workers, 1))]
+        theta, self._unravel = ravel_pytree(self.local_policy.params)
+        self._theta = np.asarray(theta, np.float32)
+        self._optimizer = optax.adam(config.stepsize)
+        self._opt_state = self._optimizer.init(self._theta)
+        self._episodes_total = 0
+
+    def _gradient(self, indices, returns_pos, returns_neg) -> np.ndarray:
+        """Centered-rank antithetic gradient estimate (maximization)."""
+        dim = self._theta.size
+        ranks = centered_ranks(
+            np.concatenate([returns_pos, returns_neg]))
+        w = ranks[:len(returns_pos)] - ranks[len(returns_pos):]
+        g = np.zeros(dim, np.float32)
+        for weight, idx in zip(w, indices):
+            g += weight * self._noise[idx:idx + dim]
+        return g / max(len(indices), 1)
+
+    def training_step(self) -> Dict[str, Any]:
+        import optax
+        config: ESConfig = self.config
+        theta_ref = ray_tpu.put(self._theta)
+        results = ray_tpu.get([
+            w.do_rollouts.remote(theta_ref,
+                                 config.num_rollout_pairs_per_worker,
+                                 config.noise_stdev,
+                                 config.episode_horizon)
+            for w in self._es_workers])
+        indices = np.concatenate([r["noise_indices"] for r in results])
+        returns_pos = np.concatenate([r["returns_pos"] for r in results])
+        returns_neg = np.concatenate([r["returns_neg"] for r in results])
+        lengths = np.concatenate([r["lengths"] for r in results])
+        self._timesteps_total += int(lengths.sum())
+        self._episodes_total += lengths.size
+
+        grad = self._gradient(indices, returns_pos, returns_neg)
+        # optax minimizes; ES ascends the return.
+        updates, self._opt_state = self._optimizer.update(
+            -grad, self._opt_state, self._theta)
+        self._theta = np.asarray(optax.apply_updates(self._theta, updates),
+                                 np.float32)
+        self.local_policy.params = self._unravel(self._theta)
+
+        all_returns = np.concatenate([returns_pos, returns_neg])
+        return {
+            "episode_reward_mean": float(all_returns.mean()),
+            "episode_reward_max": float(all_returns.max()),
+            "episode_len_mean": float(lengths.mean()),
+            "episodes_total": self._episodes_total,
+            "grad_norm": float(np.linalg.norm(grad)),
+            "update_ratio": float(
+                np.linalg.norm(np.asarray(updates))
+                / (np.linalg.norm(self._theta) + 1e-8)),
+        }
+
+    def stop(self) -> None:
+        for w in self._es_workers:
+            ray_tpu.kill(w)
+        super().stop()

@@ -1,0 +1,140 @@
+"""MARWIL: monotonic advantage re-weighted imitation learning.
+
+Analog of the reference's rllib/algorithms/marwil (of which its BC is the
+beta=0 special case): offline imitation where each logged action's
+log-likelihood is weighted by exp(beta * advantage), advantage = (return -
+V(s)) with a trained value head, normalized by a running estimate of the
+squared-advantage moving average. beta=0 reduces to plain BC with a value
+head; larger beta biases cloning toward better-than-average actions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.algorithms.pg import discounted_returns
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or MARWIL)
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.num_rollout_workers = 0  # offline: WorkerSet stays empty
+        self.num_train_batches_per_iteration = 16
+        self.beta = 1.0
+        self.vf_coeff = 1.0
+        # Decay for the running ||adv||^2 estimate (reference:
+        # marwil_torch_policy moving_average_sqd_adv_norm, update rate 1e-8
+        # per sample there; per-batch here).
+        self.moving_average_decay = 0.99
+
+    def training(self, *, beta=None, vf_coeff=None,
+                 moving_average_decay=None,
+                 num_train_batches_per_iteration=None,
+                 **kwargs) -> "MARWILConfig":
+        super().training(**kwargs)
+        for name, val in (("beta", beta), ("vf_coeff", vf_coeff),
+                          ("moving_average_decay", moving_average_decay),
+                          ("num_train_batches_per_iteration",
+                           num_train_batches_per_iteration)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class MARWIL(Algorithm):
+    _default_config_class = MARWILConfig
+
+    def __init__(self, config=None, **kwargs):
+        cfg = config or self.get_default_config()
+        if not cfg.input_:
+            raise ValueError(
+                "MARWIL is offline-only: set "
+                "config.offline_data(input_=<dir of JSON experience files>)")
+        super().__init__(config=config, **kwargs)
+
+    def setup(self, config: MARWILConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.offline.json_reader import JsonReader
+        self._reader = JsonReader(config.input_)
+        # Running E[adv^2] for weight normalization; initialized from the
+        # first minibatch so early weights don't explode (exp of a raw
+        # CartPole-scale return would overflow against a norm of 1).
+        self._adv_sq_norm = None
+        policy = self.local_policy
+        self._optimizer = optax.adam(config.lr)
+        self._opt_state = self._optimizer.init(policy.params)
+        beta, vf_coeff = config.beta, config.vf_coeff
+
+        def loss_fn(params, mb, adv_norm):
+            values = policy._value(params, mb["obs"])
+            adv = mb["returns"] - values
+            vf_loss = (adv ** 2).mean()
+            # Weight uses the *normalized*, gradient-stopped advantage;
+            # the exponent is clamped for numerical safety.
+            weight = jnp.exp(jnp.clip(beta * jax.lax.stop_gradient(
+                adv / jnp.sqrt(adv_norm + 1e-8)), -10.0, 10.0))
+            logp = policy.logp(params, mb["obs"], mb["actions"])
+            pi_loss = -(weight * logp).mean()
+            return pi_loss + vf_coeff * vf_loss, (
+                pi_loss, vf_loss, (adv ** 2).mean())
+
+        def update(params, opt_state, mb, adv_norm):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb, adv_norm)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            return optax.apply_updates(params, updates), opt_state, loss, aux
+
+        self._update_jit = jax.jit(update)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        config: MARWILConfig = self.config
+        batch_size = config.train_batch_size
+        losses, pi_losses, vf_losses = [], [], []
+        params = self.local_policy.params
+        def attach_returns(fragment):
+            fragment["returns"] = discounted_returns(fragment, config.gamma)
+            return fragment
+
+        for _ in range(config.num_train_batches_per_iteration):
+            mb = self._reader.next_batch(batch_size,
+                                         transform=attach_returns)
+            self._timesteps_total += batch_size
+            device_mb = {
+                "obs": jnp.asarray(np.asarray(mb[SampleBatch.OBS],
+                                              np.float32)),
+                "actions": jnp.asarray(mb[SampleBatch.ACTIONS]),
+                "returns": jnp.asarray(np.asarray(mb["returns"],
+                                                  np.float32)),
+            }
+            if self._adv_sq_norm is None:
+                values = np.asarray(self.local_policy._value(
+                    params, device_mb["obs"]))
+                adv0 = np.asarray(mb["returns"], np.float32) - values
+                self._adv_sq_norm = max(float((adv0 ** 2).mean()), 1e-8)
+            params, self._opt_state, loss, aux = self._update_jit(
+                params, self._opt_state, device_mb,
+                jnp.float32(self._adv_sq_norm))
+            pi_loss, vf_loss, adv_sq = aux
+            d = config.moving_average_decay
+            self._adv_sq_norm = (d * self._adv_sq_norm
+                                 + (1 - d) * float(adv_sq))
+            losses.append(float(loss))
+            pi_losses.append(float(pi_loss))
+            vf_losses.append(float(vf_loss))
+        self.local_policy.params = params
+        return {"loss": float(np.mean(losses)),
+                "policy_loss": float(np.mean(pi_losses)),
+                "vf_loss": float(np.mean(vf_losses)),
+                "adv_sq_norm": self._adv_sq_norm}

@@ -1,0 +1,99 @@
+"""PG: vanilla policy gradient (REINFORCE).
+
+Analog of the reference's rllib/algorithms/pg: the plain on-policy
+policy-gradient loss -logp(a|s) * R_t with discounted reward-to-go returns
+(no critic baseline, no surrogate clipping). The rollout workers still
+attach GAE fields, but PG trains on the Monte-Carlo value targets
+(advantages computed with an untrained critic reduce to TD-λ returns; we
+recompute pure reward-to-go here for fidelity to the reference's
+post_process_advantages with use_critic=False).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+def discounted_returns(batch: SampleBatch, gamma: float) -> np.ndarray:
+    """Per-episode discounted reward-to-go (the PG return target).
+
+    Resets at every episode boundary: termination, truncation (TimeLimit),
+    and eps_id seams — a concatenated multi-worker batch places unrelated
+    episodes back to back, and rewards must never bleed across them.
+    """
+    n = len(batch)
+    out = np.zeros(n, np.float64)
+    acc = 0.0
+    rewards = batch[SampleBatch.REWARDS].astype(np.float64)
+    terminated = np.asarray(batch[SampleBatch.TERMINATEDS])
+    truncated = batch.get(SampleBatch.TRUNCATEDS)
+    eps_id = batch.get(SampleBatch.EPS_ID)
+    for t in reversed(range(n)):
+        if (terminated[t]
+                or (truncated is not None and truncated[t])
+                or (eps_id is not None and t + 1 < n
+                    and eps_id[t] != eps_id[t + 1])):
+            acc = 0.0
+        acc = rewards[t] + gamma * acc
+        out[t] = acc
+    return out.astype(np.float32)
+
+
+class PGConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or PG)
+        self.lr = 4e-3
+
+
+class PG(Algorithm):
+    _default_config_class = PGConfig
+
+    def setup(self, config: PGConfig) -> None:
+        import jax
+        import optax
+
+        policy = self.local_policy
+        self._optimizer = optax.adam(config.lr)
+        self._opt_state = self._optimizer.init(policy.params)
+
+        def loss_fn(params, mb):
+            logp = policy.logp(params, mb["obs"], mb["actions"])
+            return -(logp * mb["returns"]).mean()
+
+        def update(params, opt_state, mb):
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update_jit = jax.jit(update)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        import ray_tpu
+        config: PGConfig = self.config
+        weights_ref = ray_tpu.put(self.get_weights())
+        self.workers.sync_weights(weights_ref)
+        per_worker = max(
+            config.train_batch_size // self.workers.num_workers(), 1)
+        batch = self.workers.sample(per_worker)
+        self._timesteps_total += len(batch)
+        returns = discounted_returns(batch, config.gamma)
+        # Standardize returns — the classic variance-reduction trick.
+        returns = (returns - returns.mean()) / max(returns.std(), 1e-8)
+        device_mb = {
+            "obs": jnp.asarray(batch[SampleBatch.OBS]),
+            "actions": jnp.asarray(batch[SampleBatch.ACTIONS]),
+            "returns": jnp.asarray(returns.astype(np.float32)),
+        }
+        params, self._opt_state, loss = self._update_jit(
+            self.local_policy.params, self._opt_state, device_mb)
+        self.local_policy.params = params
+        return {"policy_loss": float(loss)}

@@ -8,16 +8,26 @@ from typing import Tuple, Type
 
 def get_algorithm_class(name: str) -> Type:
     from ray_tpu.rllib.algorithms.a2c import A2C
+    from ray_tpu.rllib.algorithms.a3c import A3C
     from ray_tpu.rllib.algorithms.appo import APPO
+    from ray_tpu.rllib.algorithms.ars import ARS
     from ray_tpu.rllib.algorithms.bc import BC
+    from ray_tpu.rllib.algorithms.cql import CQL
+    from ray_tpu.rllib.algorithms.ddpg import DDPG
     from ray_tpu.rllib.algorithms.dqn import DQN
+    from ray_tpu.rllib.algorithms.es import ES
     from ray_tpu.rllib.algorithms.impala import Impala
+    from ray_tpu.rllib.algorithms.marwil import MARWIL
+    from ray_tpu.rllib.algorithms.pg import PG
     from ray_tpu.rllib.algorithms.ppo import PPO
     from ray_tpu.rllib.algorithms.sac import SAC
+    from ray_tpu.rllib.algorithms.simple_q import SimpleQ
     from ray_tpu.rllib.algorithms.td3 import TD3
 
-    table = {"PPO": PPO, "DQN": DQN, "SAC": SAC, "A2C": A2C,
-             "IMPALA": Impala, "TD3": TD3, "BC": BC, "APPO": APPO}
+    table = {"PPO": PPO, "DQN": DQN, "SAC": SAC, "A2C": A2C, "A3C": A3C,
+             "IMPALA": Impala, "TD3": TD3, "BC": BC, "APPO": APPO,
+             "PG": PG, "MARWIL": MARWIL, "DDPG": DDPG, "SIMPLEQ": SimpleQ,
+             "ES": ES, "ARS": ARS, "CQL": CQL}
     try:
         return table[name.upper()]
     except KeyError:

@@ -58,6 +58,12 @@ class SACConfig(AlgorithmConfig):
 class SAC(Algorithm):
     _default_config_class = SACConfig
 
+    def _conservative_penalty(self, q_apply, q_params, actor_params, mb,
+                              key):
+        """Extra critic-loss term; traced into the jitted update. CQL
+        overrides this with the conservative regularizer."""
+        return 0.0
+
     def setup(self, config: SACConfig) -> None:
         import jax
         import jax.numpy as jnp
@@ -101,8 +107,9 @@ class SAC(Algorithm):
 
         def critic_loss(q_params, q_target, actor_params, log_alpha, mb,
                         key):
+            k_target, k_penalty = jax.random.split(key)
             next_a, next_logp = policy.logp_and_sample(
-                actor_params, mb["new_obs"], key)
+                actor_params, mb["new_obs"], k_target)
             q1_t = q_apply(q_target["q1"], mb["new_obs"], next_a)
             q2_t = q_apply(q_target["q2"], mb["new_obs"], next_a)
             alpha = jnp.exp(log_alpha)
@@ -112,7 +119,10 @@ class SAC(Algorithm):
             target = jax.lax.stop_gradient(target)
             q1 = q_apply(q_params["q1"], mb["obs"], mb["actions"])
             q2 = q_apply(q_params["q2"], mb["obs"], mb["actions"])
-            return ((q1 - target) ** 2 + (q2 - target) ** 2).mean()
+            td = ((q1 - target) ** 2 + (q2 - target) ** 2).mean()
+            # Hook for conservative variants (CQL overrides; 0 for SAC).
+            return td + self._conservative_penalty(
+                q_apply, q_params, actor_params, mb, k_penalty)
 
         def actor_loss(actor_params, q_params, log_alpha, mb, key):
             a, logp = policy.logp_and_sample(actor_params, mb["obs"], key)
@@ -160,10 +170,31 @@ class SAC(Algorithm):
         self._update_jit = jax.jit(update)
         self._key = jax.random.PRNGKey(config.seed + 99)
 
-    def training_step(self) -> Dict[str, Any]:
+    def _train_on_buffer(self, num_batches: int) -> Dict[str, Any]:
+        """Run ``num_batches`` jitted SAC updates from the replay buffer
+        (shared by SAC's online loop and CQL's offline-only loop)."""
         import jax
         import jax.numpy as jnp
 
+        config: SACConfig = self.config
+        actor_params = self.local_policy.params
+        metrics: Dict[str, Any] = {}
+        for _ in range(num_batches):
+            mb = self._buffer.sample(config.train_batch_size)
+            device_mb = {k: jnp.asarray(v) for k, v in mb.items()
+                         if k in ("obs", "new_obs", "actions",
+                                  "rewards", "terminateds")}
+            self._key, sub = jax.random.split(self._key)
+            (actor_params, self._q_params, self._q_target,
+             self._log_alpha, self._actor_state, self._critic_state,
+             self._alpha_state, metrics) = self._update_jit(
+                actor_params, self._q_params, self._q_target,
+                self._log_alpha, self._actor_state, self._critic_state,
+                self._alpha_state, device_mb, sub)
+        self.local_policy.params = actor_params
+        return {k: float(v) for k, v in metrics.items()}
+
+    def training_step(self) -> Dict[str, Any]:
         import ray_tpu
         config: SACConfig = self.config
         weights_ref = ray_tpu.put(self.get_weights())
@@ -175,20 +206,7 @@ class SAC(Algorithm):
         if len(self._buffer) >= max(
                 config.num_steps_sampled_before_learning_starts,
                 config.train_batch_size):
-            actor_params = self.local_policy.params
-            for _ in range(config.num_train_batches_per_iteration):
-                mb = self._buffer.sample(config.train_batch_size)
-                device_mb = {k: jnp.asarray(v) for k, v in mb.items()
-                             if k in ("obs", "new_obs", "actions",
-                                      "rewards", "terminateds")}
-                self._key, sub = jax.random.split(self._key)
-                (actor_params, self._q_params, self._q_target,
-                 self._log_alpha, self._actor_state, self._critic_state,
-                 self._alpha_state, metrics) = self._update_jit(
-                    actor_params, self._q_params, self._q_target,
-                    self._log_alpha, self._actor_state, self._critic_state,
-                    self._alpha_state, device_mb, sub)
-            self.local_policy.params = actor_params
-            metrics_out = {k: float(v) for k, v in metrics.items()}
+            metrics_out = self._train_on_buffer(
+                config.num_train_batches_per_iteration)
         metrics_out["replay_buffer_size"] = len(self._buffer)
         return metrics_out

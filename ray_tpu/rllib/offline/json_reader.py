@@ -51,6 +51,23 @@ class JsonReader:
         self._line_idx += 1
         return SampleBatch({k: _decode_array(v) for k, v in row.items()})
 
+    def next_batch(self, batch_size: int, transform=None) -> SampleBatch:
+        """Accumulate fragments into an *exact*-size batch: one jitted
+        shape for the consumer, no rows dropped — the remainder carries
+        over to the next call. ``transform`` (optional) enriches each
+        fragment as it is read (e.g. MARWIL attaching return columns).
+        Shared by the offline learners (BC, MARWIL)."""
+        carry = getattr(self, "_carry", None)
+        while carry is None or len(carry) < batch_size:
+            fragment = self.next()
+            if transform is not None:
+                fragment = transform(fragment)
+            carry = (fragment if carry is None else
+                     SampleBatch.concat_samples([carry, fragment]))
+        out = carry.slice(0, batch_size)
+        self._carry = carry.slice(batch_size, len(carry))
+        return out
+
     def read_all(self) -> SampleBatch:
         """Concatenate every batch in every file (for small datasets)."""
         batches = []

@@ -372,3 +372,185 @@ def test_appo_cartpole_smoke(ray_start_regular):
     from ray_tpu.rllib import APPO, get_algorithm_class
     assert get_algorithm_class("appo") is APPO
     algo.stop()
+
+
+def test_pg_cartpole_learns(ray_start_regular):
+    from ray_tpu.rllib import PGConfig
+    config = (PGConfig()
+              .environment("CartPole-v1")
+              .rollouts(num_rollout_workers=2)
+              .training(lr=4e-3, train_batch_size=1024)
+              .debugging(seed=11))
+    algo = config.build()
+    results = [algo.train() for _ in range(12)]
+    first = results[0]["episode_reward_mean"]
+    last = results[-1]["episode_reward_mean"]
+    assert np.isfinite(results[-1]["policy_loss"])
+    assert last > 1.5 * first, f"no learning: {first:.1f} -> {last:.1f}"
+    algo.stop()
+
+
+def test_pg_discounted_returns():
+    from ray_tpu.rllib.algorithms.pg import discounted_returns
+    from ray_tpu.rllib import SampleBatch
+    batch = SampleBatch({
+        SampleBatch.REWARDS: [1.0, 1.0, 1.0, 2.0],
+        SampleBatch.TERMINATEDS: [0.0, 0.0, 1.0, 1.0],
+    })
+    out = discounted_returns(batch, gamma=0.5)
+    # Episode 1: [1 + .5*(1 + .5*1), 1 + .5*1, 1]; episode 2: [2].
+    np.testing.assert_allclose(out, [1.75, 1.5, 1.0, 2.0])
+
+
+def test_a3c_cartpole_smoke(ray_start_regular):
+    from ray_tpu.rllib import A3CConfig
+    config = (A3CConfig()
+              .environment("CartPole-v1")
+              .rollouts(num_rollout_workers=2)
+              .training(train_batch_size=512)
+              .debugging(seed=12))
+    algo = config.build()
+    for _ in range(3):
+        res = algo.train()
+    assert np.isfinite(res["total_loss"])
+    # one async gradient application per worker per step
+    assert res["async_grad_updates"] == 2
+    algo.stop()
+
+
+def test_ddpg_pendulum_smoke(ray_start_regular):
+    from ray_tpu.rllib import DDPG, DDPGConfig, get_algorithm_class
+    config = (DDPGConfig()
+              .environment("Pendulum-v1")
+              .rollouts(num_rollout_workers=1, rollout_fragment_length=200)
+              .training(train_batch_size=64,
+                        num_steps_sampled_before_learning_starts=100,
+                        num_train_batches_per_iteration=8)
+              .debugging(seed=22))
+    assert config.policy_delay == 1 and config.target_noise == 0.0
+    algo = config.build()
+    for _ in range(2):
+        res = algo.train()
+    assert np.isfinite(res["critic_loss"])
+    # actor updates every step (policy_delay=1) => loss nonzero
+    assert res["actor_loss"] != 0.0
+    assert get_algorithm_class("ddpg") is DDPG
+    algo.stop()
+
+
+def test_simpleq_cartpole_smoke(ray_start_regular):
+    from ray_tpu.rllib import SimpleQ, SimpleQConfig, get_algorithm_class
+    config = (SimpleQConfig()
+              .environment("CartPole-v1")
+              .rollouts(num_rollout_workers=1, rollout_fragment_length=200)
+              .training(train_batch_size=32,
+                        num_steps_sampled_before_learning_starts=100,
+                        num_train_batches_per_iteration=4,
+                        target_network_update_freq=8)
+              .debugging(seed=23))
+    assert config.double_q is False
+    algo = config.build()
+    for _ in range(2):
+        res = algo.train()
+    assert np.isfinite(res["loss"])
+    assert get_algorithm_class("simpleq") is SimpleQ
+    algo.stop()
+
+
+def test_marwil_learns_from_offline_data(ray_start_regular, tmp_path):
+    from ray_tpu.rllib import MARWILConfig, PPOConfig
+    out_dir = str(tmp_path / "exp")
+    gen = (PPOConfig()
+           .environment("CartPole-v1")
+           .rollouts(num_rollout_workers=1, rollout_fragment_length=400)
+           .offline_data(output=out_dir)
+           .debugging(seed=8)).build()
+    gen.train()
+    gen.stop()
+    marwil = (MARWILConfig()
+              .environment("CartPole-v1")
+              .offline_data(input_=out_dir)
+              .training(lr=5e-3, beta=1.0,
+                        num_train_batches_per_iteration=10)
+              .debugging(seed=9)).build()
+    first = marwil.train()["policy_loss"]
+    for _ in range(4):
+        res = marwil.train()
+    assert np.isfinite(res["policy_loss"]) and np.isfinite(res["vf_loss"])
+    assert res["policy_loss"] < first
+    assert res["adv_sq_norm"] > 0
+    marwil.stop()
+    with pytest.raises(ValueError):
+        (MARWILConfig().environment("CartPole-v1")).build()
+
+
+def test_es_cartpole_learns(ray_start_regular):
+    from ray_tpu.rllib import ESConfig
+    config = (ESConfig()
+              .environment("CartPole-v1")
+              .rollouts(num_rollout_workers=2)
+              .training(noise_stdev=0.1, stepsize=0.1,
+                        num_rollout_pairs_per_worker=8,
+                        episode_horizon=200,
+                        model={"fcnet_hiddens": [16]})
+              .debugging(seed=5))
+    algo = config.build()
+    results = [algo.train() for _ in range(8)]
+    first = results[0]["episode_reward_mean"]
+    best = max(r["episode_reward_mean"] for r in results)
+    assert np.isfinite(best)
+    assert results[-1]["episodes_total"] == 8 * 2 * 8 * 2
+    assert best > first, f"no improvement: first={first} best={best}"
+    # deterministic eval action is valid
+    a = algo.compute_single_action(np.zeros(4, np.float32))
+    assert a in (0, 1)
+    algo.stop()
+
+
+def test_ars_cartpole_smoke(ray_start_regular):
+    from ray_tpu.rllib import ARS, ARSConfig, get_algorithm_class
+    config = (ARSConfig()
+              .environment("CartPole-v1")
+              .rollouts(num_rollout_workers=2)
+              .training(noise_stdev=0.1, stepsize=0.1,
+                        num_rollout_pairs_per_worker=6, deltas_used=4,
+                        episode_horizon=200,
+                        model={"fcnet_hiddens": [16]})
+              .debugging(seed=6))
+    algo = config.build()
+    results = [algo.train() for _ in range(6)]
+    best = max(r["episode_reward_mean"] for r in results)
+    assert np.isfinite(best)
+    assert best > results[0]["episode_reward_mean"]
+    assert get_algorithm_class("ars") is ARS
+    algo.stop()
+
+
+def test_cql_pendulum_offline(ray_start_regular, tmp_path):
+    from ray_tpu.rllib import CQLConfig, SACConfig
+    out_dir = str(tmp_path / "exp")
+    gen = (SACConfig()
+           .environment("Pendulum-v1")
+           .rollouts(num_rollout_workers=1, rollout_fragment_length=500)
+           .offline_data(output=out_dir)
+           .debugging(seed=3)).build()
+    gen.train()
+    gen.stop()
+    cql = (CQLConfig()
+           .environment("Pendulum-v1")
+           .offline_data(input_=out_dir)
+           .training(train_batch_size=64, min_q_weight=5.0,
+                     num_ood_actions=2,
+                     num_train_batches_per_iteration=4)
+           .debugging(seed=4)).build()
+    for _ in range(2):
+        res = cql.train()
+    assert np.isfinite(res["critic_loss"])
+    assert np.isfinite(res["actor_loss"])
+    assert res["dataset_size"] >= 500
+    # action in bounds
+    a = cql.compute_single_action(np.zeros(3, np.float32))
+    assert (-2.0 <= np.asarray(a)).all() and (np.asarray(a) <= 2.0).all()
+    cql.stop()
+    with pytest.raises(ValueError):
+        (CQLConfig().environment("Pendulum-v1")).build()
