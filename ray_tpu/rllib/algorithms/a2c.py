@@ -73,9 +73,17 @@ class A2C(Algorithm):
 
         self._update_jit = jax.jit(update)
 
-    def training_step(self) -> Dict[str, Any]:
+    def _device_minibatch(self, batch: SampleBatch):
+        """Normalize advantages and stage the A2C loss inputs on device
+        (shared with A3C's per-worker async updates)."""
         import jax.numpy as jnp
+        adv = batch[SampleBatch.ADVANTAGES]
+        batch[SampleBatch.ADVANTAGES] = (
+            (adv - adv.mean()) / max(adv.std(), 1e-8)).astype(np.float32)
+        return {k: jnp.asarray(v) for k, v in batch.items()
+                if k in ("obs", "actions", "advantages", "value_targets")}
 
+    def training_step(self) -> Dict[str, Any]:
         import ray_tpu
         config: A2CConfig = self.config
         weights_ref = ray_tpu.put(self.get_weights())
@@ -84,13 +92,8 @@ class A2C(Algorithm):
             config.train_batch_size // self.workers.num_workers(), 1)
         batch = self.workers.sample(per_worker)
         self._timesteps_total += len(batch)
-        adv = batch[SampleBatch.ADVANTAGES]
-        batch[SampleBatch.ADVANTAGES] = (
-            (adv - adv.mean()) / max(adv.std(), 1e-8)).astype(np.float32)
-        device_mb = {k: jnp.asarray(v) for k, v in batch.items()
-                     if k in ("obs", "actions", "advantages",
-                              "value_targets")}
         params, self._opt_state, metrics = self._update_jit(
-            self.local_policy.params, self._opt_state, device_mb)
+            self.local_policy.params, self._opt_state,
+            self._device_minibatch(batch))
         self.local_policy.params = params
         return {k: float(v) for k, v in metrics.items()}

@@ -13,10 +13,7 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-import numpy as np
-
 from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
-from ray_tpu.rllib.policy.sample_batch import SampleBatch
 
 
 class A3CConfig(A2CConfig):
@@ -30,8 +27,6 @@ class A3C(A2C):
     _default_config_class = A3CConfig
 
     def training_step(self) -> Dict[str, Any]:
-        import jax.numpy as jnp
-
         import ray_tpu
         config: A3CConfig = self.config
         workers = self.workers.remote_workers
@@ -52,14 +47,9 @@ class A3C(A2C):
             pending.pop(ref)
             batch = ray_tpu.get(ref)
             self._timesteps_total += len(batch)
-            adv = batch[SampleBatch.ADVANTAGES]
-            batch[SampleBatch.ADVANTAGES] = (
-                (adv - adv.mean()) / max(adv.std(), 1e-8)).astype(np.float32)
-            device_mb = {k: jnp.asarray(v) for k, v in batch.items()
-                         if k in ("obs", "actions", "advantages",
-                                  "value_targets")}
             params, self._opt_state, metrics = self._update_jit(
-                self.local_policy.params, self._opt_state, device_mb)
+                self.local_policy.params, self._opt_state,
+                self._device_minibatch(batch))
             self.local_policy.params = params
             n_applied += 1
         out = {k: float(v) for k, v in metrics.items()}

@@ -97,7 +97,12 @@ class CQL(SAC):
     def setup(self, config: CQLConfig) -> None:
         super().setup(config)
         from ray_tpu.rllib.offline.json_reader import JsonReader
+        from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
         data = JsonReader(config.input_).read_all()
+        if len(data) > self._buffer.capacity:
+            # Offline training must see the whole dataset — never let the
+            # inherited online-replay capacity ring-drop rows silently.
+            self._buffer = ReplayBuffer(len(data), seed=config.seed)
         self._buffer.add(data)
         self._dataset_size = len(data)
 

@@ -61,6 +61,10 @@ class ESWorker:
         self._logits = jax.jit(self.policy.logits)
         self._rng = np.random.default_rng(seed * 1000 + worker_index)
         self.worker_index = worker_index
+        if not self.policy.discrete:
+            space = self.env.action_space
+            self._low = np.asarray(space.low, np.float32)
+            self._high = np.asarray(space.high, np.float32)
 
     def _rollout(self, theta: np.ndarray, horizon: int):
         params = self._unravel(theta)
@@ -73,7 +77,9 @@ class ESWorker:
             if self.policy.discrete:
                 action = int(logits.argmax(-1)[0])
             else:
-                action = logits[0]
+                # A perturbed unbounded head can leave the action space;
+                # clip like the clip_actions connector does elsewhere.
+                action = np.clip(logits[0], self._low, self._high)
             obs, reward, terminated, truncated, _ = self.env.step(action)
             total += float(reward)
             steps += 1
@@ -136,6 +142,13 @@ class ES(Algorithm):
         import optax
         from jax.flatten_util import ravel_pytree
 
+        theta0, _ = ravel_pytree(self.local_policy.params)
+        if int(theta0.size) > config.noise_table_size:
+            raise ValueError(
+                f"Policy has {int(theta0.size)} parameters but the shared "
+                f"noise table holds only {config.noise_table_size}; raise "
+                "config.training(noise_table_size=...) above the parameter "
+                "count")
         self._noise = create_shared_noise(config.noise_table_size,
                                           seed=config.seed + 123)
         noise_ref = ray_tpu.put(self._noise)
@@ -145,8 +158,8 @@ class ES(Algorithm):
                 self._env_creator, config.policy_config(), noise_ref,
                 worker_index=i + 1, seed=config.seed)
             for i in range(max(config.num_rollout_workers, 1))]
-        theta, self._unravel = ravel_pytree(self.local_policy.params)
-        self._theta = np.asarray(theta, np.float32)
+        _, self._unravel = ravel_pytree(self.local_policy.params)
+        self._theta = np.asarray(theta0, np.float32)
         self._optimizer = optax.adam(config.stepsize)
         self._opt_state = self._optimizer.init(self._theta)
         self._episodes_total = 0

@@ -104,7 +104,13 @@ class MARWIL(Algorithm):
         losses, pi_losses, vf_losses = [], [], []
         params = self.local_policy.params
         def attach_returns(fragment):
-            fragment["returns"] = discounted_returns(fragment, config.gamma)
+            # Bootstrap non-terminal fragment tails / truncations with the
+            # current value estimate, else those steps' returns miss all
+            # future reward and exp(beta*adv) silently drops them.
+            values_next = self.local_policy.compute_values(
+                np.asarray(fragment[SampleBatch.NEXT_OBS], np.float32))
+            fragment["returns"] = discounted_returns(
+                fragment, config.gamma, bootstrap_values=values_next)
             return fragment
 
         for _ in range(config.num_train_batches_per_iteration):

@@ -20,26 +20,39 @@ from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.policy.sample_batch import SampleBatch
 
 
-def discounted_returns(batch: SampleBatch, gamma: float) -> np.ndarray:
+def discounted_returns(batch: SampleBatch, gamma: float,
+                       bootstrap_values=None) -> np.ndarray:
     """Per-episode discounted reward-to-go (the PG return target).
 
     Resets at every episode boundary: termination, truncation (TimeLimit),
     and eps_id seams — a concatenated multi-worker batch places unrelated
     episodes back to back, and rewards must never bleed across them.
+
+    ``bootstrap_values`` (optional, aligned with ``new_obs``): at a
+    *non-terminal* boundary (truncation, eps_id seam, or an unterminated
+    batch tail) the return continues with gamma * V(new_obs[t]) instead of
+    0 — callers with a value head (MARWIL) pass V(new_obs); pure
+    Monte-Carlo PG leaves it None.
     """
     n = len(batch)
     out = np.zeros(n, np.float64)
-    acc = 0.0
     rewards = batch[SampleBatch.REWARDS].astype(np.float64)
     terminated = np.asarray(batch[SampleBatch.TERMINATEDS])
     truncated = batch.get(SampleBatch.TRUNCATEDS)
     eps_id = batch.get(SampleBatch.EPS_ID)
+
+    def bootstrap(t):
+        return (0.0 if bootstrap_values is None
+                else float(bootstrap_values[t]))
+
+    acc = bootstrap(n - 1) if n and not terminated[n - 1] else 0.0
     for t in reversed(range(n)):
-        if (terminated[t]
-                or (truncated is not None and truncated[t])
-                or (eps_id is not None and t + 1 < n
-                    and eps_id[t] != eps_id[t + 1])):
+        if terminated[t]:
             acc = 0.0
+        elif ((truncated is not None and truncated[t])
+              or (eps_id is not None and t + 1 < n
+                  and eps_id[t] != eps_id[t + 1])):
+            acc = bootstrap(t)
         acc = rewards[t] + gamma * acc
         out[t] = acc
     return out.astype(np.float32)

@@ -1,0 +1,160 @@
+"""Learner: one gradient engine over an RLModule.
+
+Analog of the reference's rllib/core/rl_trainer (RLTrainer): owns module
+params + optimizer state and exposes update(batch). Subclasses implement
+``compute_loss(params, batch) -> (loss, metrics)``; the base class builds
+a single jitted update from it. For SPMD scale-out the update can be
+compiled with explicit shardings (params replicated, batch split on the
+mesh's ``dp`` axis) so GSPMD inserts the gradient psum over ICI — the
+TPU-native form of the reference's multi-GPU data-parallel learner.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+
+
+@dataclass
+class LearnerConfig:
+    lr: float = 5e-4
+    grad_clip: float = 40.0
+    seed: int = 0
+    # PPO-family hyperparameters (used by PPOLearner).
+    clip_param: float = 0.2
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class Learner:
+    def __init__(self, module_spec: RLModuleSpec,
+                 config: Optional[LearnerConfig] = None, mesh=None):
+        self.module_spec = module_spec
+        self.config = config or LearnerConfig()
+        self.module = module_spec.build()
+        self._mesh = mesh
+        self._built = False
+
+    # -- to be implemented by algorithm learners ------------------------
+
+    def compute_loss(self, params, batch) -> Tuple[Any, Dict[str, Any]]:
+        raise NotImplementedError
+
+    # -- engine ----------------------------------------------------------
+
+    def build(self) -> "Learner":
+        import jax
+        import optax
+
+        if self._built:
+            return self
+        config = self.config
+        self.params = self.module.init(
+            jax.random.PRNGKey(config.seed))
+        self._optimizer = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip),
+            optax.adam(config.lr))
+        self.opt_state = self._optimizer.init(self.params)
+
+        def update_fn(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                self.compute_loss, has_aux=True)(params, batch)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        def grads_fn(params, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                self.compute_loss, has_aux=True)(params, batch)
+            metrics["total_loss"] = loss
+            return grads, metrics
+
+        def apply_fn(params, opt_state, grads):
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            return optax.apply_updates(params, updates), opt_state
+
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            replicated = NamedSharding(self._mesh, P())
+            batch_sharded = NamedSharding(self._mesh, P("dp"))
+            self._batch_sharding = batch_sharded
+            self._update_jit = jax.jit(
+                update_fn,
+                in_shardings=(replicated, replicated, batch_sharded),
+                out_shardings=(replicated, replicated, replicated))
+        else:
+            self._batch_sharding = None
+            self._update_jit = jax.jit(update_fn)
+        self._grads_jit = jax.jit(grads_fn)
+        self._apply_jit = jax.jit(apply_fn)
+        self._built = True
+        return self
+
+    def _device_batch(self, batch):
+        import jax
+        import jax.numpy as jnp
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        if self._batch_sharding is not None:
+            batch = jax.device_put(batch, self._batch_sharding)
+        return batch
+
+    def update(self, batch) -> Dict[str, float]:
+        """One synchronous gradient step on ``batch`` (globally sharded
+        over the mesh's dp axis in SPMD mode)."""
+        self.params, self.opt_state, metrics = self._update_jit(
+            self.params, self.opt_state, self._device_batch(batch))
+        return {k: float(v) for k, v in metrics.items()}
+
+    def compute_gradients(self, batch) -> Tuple[Any, Dict[str, float]]:
+        """Gradients only (remote-learner mode: the group averages)."""
+        import jax
+        import numpy as np
+        grads, metrics = self._grads_jit(self.params,
+                                         self._device_batch(batch))
+        return (jax.tree.map(np.asarray, grads),
+                {k: float(v) for k, v in metrics.items()})
+
+    def apply_gradients(self, grads) -> None:
+        self.params, self.opt_state = self._apply_jit(
+            self.params, self.opt_state, grads)
+
+    def get_weights(self):
+        import jax
+        import numpy as np
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        import jax
+        import jax.numpy as jnp
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
+class PPOLearner(Learner):
+    """PPO's clipped-surrogate loss on any RLModule exposing logp /
+    values / entropy through forward_train (the new-stack twin of
+    algorithms/ppo.py)."""
+
+    def compute_loss(self, params, batch):
+        import jax.numpy as jnp
+
+        config = self.config
+        out = self.module.forward_train(params, batch)
+        ratio = jnp.exp(out["logp"] - batch["logp_old"])
+        adv = batch["advantages"]
+        surrogate = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - config.clip_param,
+                     1 + config.clip_param) * adv)
+        pi_loss = -surrogate.mean()
+        vf_loss = ((out["values"] - batch["value_targets"]) ** 2).mean()
+        entropy = out["entropy"].mean()
+        total = (pi_loss + config.vf_loss_coeff * vf_loss
+                 - config.entropy_coeff * entropy)
+        return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}

@@ -1,0 +1,140 @@
+"""RLModule: the functional network unit of the new stack.
+
+Analog of the reference's rllib/core/rl_module/rl_module.py — the
+framework-agnostic module with forward_train / forward_exploration /
+forward_inference entry points — made JAX-idiomatic: a module is a pair of
+pure functions (``init(key) -> params``, forwards taking ``params``
+explicitly) so the Learner can jit/pjit them and rollout workers can run
+the identical apply with device_put weights.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+class RLModule:
+    """Base class. Subclasses define the param init and the three
+    forwards; all are pure (params in, tensors out)."""
+
+    def init(self, key) -> Any:
+        raise NotImplementedError
+
+    def forward_train(self, params, batch: Dict[str, Any]
+                      ) -> Dict[str, Any]:
+        """Outputs needed by the loss (logits, values, logps, ...)."""
+        raise NotImplementedError
+
+    def forward_exploration(self, params, obs, key):
+        """Stochastic actions for rollouts → (actions, extras dict)."""
+        raise NotImplementedError
+
+    def forward_inference(self, params, obs):
+        """Deterministic actions for serving/eval."""
+        raise NotImplementedError
+
+
+@dataclass
+class RLModuleSpec:
+    """Analog of the reference's SingleAgentRLModuleSpec: everything
+    needed to construct the module on any process."""
+
+    module_class: type
+    observation_space: Any = None
+    action_space: Any = None
+    model_config: Dict[str, Any] = field(default_factory=dict)
+
+    def build(self) -> "RLModule":
+        return self.module_class(self.observation_space,
+                                 self.action_space, self.model_config)
+
+
+class MLPActorCriticModule(RLModule):
+    """The catalog MLP actor-critic as an RLModule (discrete or Box)."""
+
+    def __init__(self, observation_space, action_space,
+                 model_config: Optional[Dict[str, Any]] = None):
+        import gymnasium as gym
+        import numpy as np
+
+        model_config = model_config or {}
+        self.hiddens = tuple(model_config.get("fcnet_hiddens", (64, 64)))
+        self.obs_dim = int(np.prod(observation_space.shape))
+        self.discrete = isinstance(action_space, gym.spaces.Discrete)
+        self.act_dim = (int(action_space.n) if self.discrete
+                        else int(np.prod(action_space.shape)))
+
+    def init(self, key):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.models.catalog import mlp_init
+        k_pi, k_vf = jax.random.split(key)
+        params = {
+            "pi": mlp_init(k_pi, [self.obs_dim, *self.hiddens,
+                                  self.act_dim]),
+            "vf": mlp_init(k_vf, [self.obs_dim, *self.hiddens, 1]),
+        }
+        if not self.discrete:
+            params["log_std"] = jnp.zeros((self.act_dim,))
+        return params
+
+    # -- distribution helpers -------------------------------------------
+
+    def _logits(self, params, obs):
+        from ray_tpu.rllib.models.catalog import mlp_apply
+        return mlp_apply(params["pi"], obs)
+
+    def _values(self, params, obs):
+        from ray_tpu.rllib.models.catalog import mlp_apply
+        return mlp_apply(params["vf"], obs)[..., 0]
+
+    def _logp(self, params, obs, actions):
+        import jax
+        import jax.numpy as jnp
+        logits = self._logits(params, obs)
+        if self.discrete:
+            logp_all = jax.nn.log_softmax(logits)
+            return jnp.take_along_axis(
+                logp_all, actions[..., None].astype(jnp.int32), -1)[..., 0]
+        log_std = params["log_std"]
+        var = jnp.exp(2 * log_std)
+        return (-0.5 * (((actions - logits) ** 2) / var
+                        + 2 * log_std + jnp.log(2 * jnp.pi))).sum(-1)
+
+    def _entropy(self, params, obs):
+        import jax
+        import jax.numpy as jnp
+        logits = self._logits(params, obs)
+        if self.discrete:
+            p = jax.nn.softmax(logits)
+            return -(p * jax.nn.log_softmax(logits)).sum(-1)
+        return (params["log_std"]
+                + 0.5 * jnp.log(2 * jnp.pi * jnp.e)).sum()
+
+    # -- RLModule API ----------------------------------------------------
+
+    def forward_train(self, params, batch):
+        obs = batch["obs"]
+        return {
+            "logits": self._logits(params, obs),
+            "values": self._values(params, obs),
+            "logp": self._logp(params, obs, batch["actions"]),
+            "entropy": self._entropy(params, obs),
+        }
+
+    def forward_exploration(self, params, obs, key):
+        import jax
+        import jax.numpy as jnp
+        logits = self._logits(params, obs)
+        if self.discrete:
+            actions = jax.random.categorical(key, logits)
+        else:
+            std = jnp.exp(params["log_std"])
+            actions = logits + std * jax.random.normal(key, logits.shape)
+        return actions, {"values": self._values(params, obs)}
+
+    def forward_inference(self, params, obs):
+        logits = self._logits(params, obs)
+        return logits.argmax(-1) if self.discrete else logits

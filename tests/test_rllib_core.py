@@ -1,0 +1,108 @@
+"""Tests for ray_tpu.rllib.core — the new-stack RLModule / Learner /
+LearnerGroup (model: reference rllib/core/rl_trainer tests, TPU-twisted:
+SPMD mode shards the update over the virtual 8-device mesh)."""
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.core import (LearnerConfig, LearnerGroup,
+                                MLPActorCriticModule, PPOLearner,
+                                RLModuleSpec)
+
+
+def _spec(discrete=True):
+    obs_space = gym.spaces.Box(-1, 1, (4,), np.float32)
+    act_space = (gym.spaces.Discrete(2) if discrete
+                 else gym.spaces.Box(-1, 1, (2,), np.float32))
+    return RLModuleSpec(MLPActorCriticModule, obs_space, act_space,
+                        {"fcnet_hiddens": (16,)})
+
+
+def _ppo_batch(n=64, seed=0, act_dim=2, discrete=True):
+    rng = np.random.default_rng(seed)
+    return {
+        "obs": rng.normal(size=(n, 4)).astype(np.float32),
+        "actions": (rng.integers(0, 2, size=n) if discrete
+                    else rng.normal(size=(n, act_dim)).astype(np.float32)),
+        "logp_old": np.full(n, -0.69, np.float32),
+        "advantages": rng.normal(size=n).astype(np.float32),
+        "value_targets": rng.normal(size=n).astype(np.float32),
+    }
+
+
+def test_rl_module_forwards():
+    import jax
+    module = _spec().build()
+    params = module.init(jax.random.PRNGKey(0))
+    batch = _ppo_batch(8)
+    out = module.forward_train(params, batch)
+    assert out["logits"].shape == (8, 2)
+    assert out["values"].shape == (8,)
+    assert out["logp"].shape == (8,)
+    actions, extras = module.forward_exploration(
+        params, batch["obs"], jax.random.PRNGKey(1))
+    assert actions.shape == (8,)
+    assert extras["values"].shape == (8,)
+    greedy = module.forward_inference(params, batch["obs"])
+    assert np.asarray(greedy).shape == (8,)
+    # continuous variant
+    module_c = _spec(discrete=False).build()
+    params_c = module_c.init(jax.random.PRNGKey(2))
+    a, _ = module_c.forward_exploration(
+        params_c, batch["obs"], jax.random.PRNGKey(3))
+    assert a.shape == (8, 2)
+
+
+def test_learner_spmd_update_decreases_loss():
+    import jax
+    assert len(jax.devices()) == 8  # conftest forces the virtual mesh
+    group = LearnerGroup(PPOLearner, _spec(),
+                         LearnerConfig(lr=5e-3, seed=1))
+    assert not group.is_remote
+    assert group.mesh.shape["dp"] == 8
+    batch = _ppo_batch(64, seed=2)
+    m0 = group.update(batch)
+    for _ in range(10):
+        m = group.update(batch)
+    assert np.isfinite(m["total_loss"])
+    assert m["total_loss"] < m0["total_loss"]
+    w = group.get_weights()
+    assert "pi" in w and "vf" in w
+
+
+def test_learner_group_remote_matches_full_batch_gradient(
+        ray_start_regular):
+    """Averaging per-shard gradients over 2 remote learners equals the
+    full-batch gradient (mean losses are linear in the shard means), so
+    remote-DP and a single learner walk the same trajectory."""
+    batch = _ppo_batch(64, seed=3)
+    remote = LearnerGroup(PPOLearner, _spec(),
+                          LearnerConfig(lr=1e-2, seed=7),
+                          num_remote_learners=2)
+    assert remote.is_remote
+    local = PPOLearner(_spec(), LearnerConfig(lr=1e-2, seed=7)).build()
+    # identical init (same seed) -> identical weights after one update
+    m_remote = remote.update(batch)
+    m_local = local.update(batch)
+    assert np.isfinite(m_remote["total_loss"])
+    w_r = remote.get_weights()
+    w_l = local.get_weights()
+    np.testing.assert_allclose(
+        w_r["pi"][0]["w"], w_l["pi"][0]["w"], rtol=1e-4, atol=1e-5)
+    # weight broadcast keeps the fleet in sync
+    remote.set_weights(w_l)
+    np.testing.assert_allclose(remote.get_weights()["vf"][0]["w"],
+                               w_l["vf"][0]["w"], rtol=1e-6)
+    remote.stop()
+
+
+def test_learner_batch_sharding_metadata():
+    """The SPMD learner really places the batch on the dp axis."""
+    group = LearnerGroup(PPOLearner, _spec(), LearnerConfig(seed=4))
+    learner = group._learner
+    db = learner._device_batch(_ppo_batch(64, seed=5))
+    sharding = db["obs"].sharding
+    assert sharding.num_devices == 8
+    # per-device shard is 1/8 of the rows
+    assert db["obs"].addressable_shards[0].data.shape[0] == 8
