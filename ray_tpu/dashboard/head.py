@@ -170,6 +170,36 @@ class DashboardHead:
             return self._json({"error": str(exc)}, status=400)
         return self._json({"status": "deployed"})
 
+    # workflow events ----------------------------------------------------
+
+    async def _workflows_list(self, request):
+        from ray_tpu import workflow
+        try:
+            rows = workflow.list_all()
+        except Exception as exc:  # noqa: BLE001 - storage not initialized
+            return self._json({"error": str(exc)}, status=503)
+        return self._json([{"workflow_id": wid, "status": status}
+                           for wid, status in rows])
+
+    async def _workflow_trigger_event(self, request):
+        """Analog of the reference's workflow/http_event_provider.py: an
+        external system POSTs here to release workflow tasks parked on
+        workflow.wait_for_event(event_key). Body (optional JSON) becomes
+        the event payload."""
+        from ray_tpu import workflow
+        event_key = request.match_info["event_key"]
+        try:
+            payload = await request.json()
+        except Exception:  # noqa: BLE001 - empty/non-JSON body → None
+            payload = None
+        try:
+            reached = workflow.trigger_event(event_key, payload)
+        except ValueError as exc:
+            return self._json({"error": str(exc)}, status=400)
+        except Exception as exc:  # noqa: BLE001 - runtime not up yet
+            return self._json({"error": str(exc)}, status=503)
+        return self._json({"event_key": event_key, "reached": reached})
+
     # -- lifecycle -------------------------------------------------------
 
     def _build_app(self):
@@ -189,6 +219,9 @@ class DashboardHead:
         app.router.add_post("/api/jobs/{job_id}/stop", self._jobs_stop)
         app.router.add_get("/api/serve/applications", self._serve_get)
         app.router.add_put("/api/serve/applications", self._serve_put)
+        app.router.add_get("/api/workflows/", self._workflows_list)
+        app.router.add_post("/api/workflows/events/{event_key}",
+                            self._workflow_trigger_event)
         return app
 
     def start(self) -> int:

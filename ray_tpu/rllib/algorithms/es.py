@@ -142,7 +142,7 @@ class ES(Algorithm):
         import optax
         from jax.flatten_util import ravel_pytree
 
-        theta0, _ = ravel_pytree(self.local_policy.params)
+        theta0, self._unravel = ravel_pytree(self.local_policy.params)
         if int(theta0.size) > config.noise_table_size:
             raise ValueError(
                 f"Policy has {int(theta0.size)} parameters but the shared "
@@ -158,7 +158,6 @@ class ES(Algorithm):
                 self._env_creator, config.policy_config(), noise_ref,
                 worker_index=i + 1, seed=config.seed)
             for i in range(max(config.num_rollout_workers, 1))]
-        _, self._unravel = ravel_pytree(self.local_policy.params)
         self._theta = np.asarray(theta0, np.float32)
         self._optimizer = optax.adam(config.stepsize)
         self._opt_state = self._optimizer.init(self._theta)

@@ -107,8 +107,12 @@ class MARWIL(Algorithm):
             # Bootstrap non-terminal fragment tails / truncations with the
             # current value estimate, else those steps' returns miss all
             # future reward and exp(beta*adv) silently drops them.
-            values_next = self.local_policy.compute_values(
-                np.asarray(fragment[SampleBatch.NEXT_OBS], np.float32))
+            # Datasets logged without new_obs fall back to pure
+            # reward-to-go (the pre-bootstrap behavior).
+            next_obs = fragment.get(SampleBatch.NEXT_OBS)
+            values_next = (None if next_obs is None else
+                           self.local_policy.compute_values(
+                               np.asarray(next_obs, np.float32)))
             fragment["returns"] = discounted_returns(
                 fragment, config.gamma, bootstrap_values=values_next)
             return fragment

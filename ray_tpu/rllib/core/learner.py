@@ -101,6 +101,19 @@ class Learner:
         import jax.numpy as jnp
         batch = {k: jnp.asarray(v) for k, v in batch.items()}
         if self._batch_sharding is not None:
+            ndp = self._mesh.shape["dp"]
+            size = next(iter(batch.values())).shape[0]
+            if size < ndp:
+                raise ValueError(
+                    f"Batch of {size} rows cannot be sharded over dp={ndp} "
+                    "devices; grow the batch or shrink the mesh")
+            if size % ndp:
+                # device_put requires equal shards; drop the remainder
+                # (< ndp rows) rather than crash on ragged batches.
+                self.last_dropped_rows = size % ndp
+                batch = {k: v[:size - size % ndp] for k, v in batch.items()}
+            else:
+                self.last_dropped_rows = 0
             batch = jax.device_put(batch, self._batch_sharding)
         return batch
 

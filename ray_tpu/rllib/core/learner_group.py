@@ -76,19 +76,26 @@ class LearnerGroup:
         if not self._remote:
             return self._learner.update(batch)
         import ray_tpu
-        n = len(self._learners)
         size = len(next(iter(batch.values())))
-        shard = max(size // n, 1)
+        # Never hand a learner an empty shard (mean over zero rows is NaN
+        # and would poison the averaged gradients); cover every row.
+        n = min(len(self._learners), size)
+        bounds = np.array_split(np.arange(size), n)
         shards = [
-            {k: np.asarray(v)[i * shard:(i + 1) * shard]
-             for k, v in batch.items()}
-            for i in range(n)]
+            {k: np.asarray(v)[idx[0]:idx[-1] + 1
+                              ] for k, v in batch.items()}
+            for idx in bounds]
         results = ray_tpu.get([
             lr.compute_gradients.remote(s)
             for lr, s in zip(self._learners, shards)])
         import jax
+        # Shards can differ by one row: weight each gradient by its share
+        # of the global batch so the average equals the full-batch grad.
+        weights = np.asarray([len(idx) / size for idx in bounds],
+                             np.float64)
         grads = jax.tree.map(
-            lambda *g: np.mean(np.stack(g), axis=0),
+            lambda *g: np.tensordot(weights, np.stack(g), axes=1).astype(
+                np.asarray(g[0]).dtype),
             *[g for g, _ in results])
         ray_tpu.get([lr.apply_gradients.remote(grads)
                      for lr in self._learners])

@@ -193,3 +193,61 @@ def test_workflow_event_latches_before_waiter(ray_start_regular):
         workflow.wait_for_event("bad|key")
     with pytest.raises(ValueError):
         workflow.trigger_event("bad|key")
+
+
+def test_workflow_http_event_provider(ray_start_regular, tmp_path):
+    """The dashboard's REST surface releases a parked workflow event
+    (analog of the reference's workflow/http_event_provider.py)."""
+    import json
+    import threading
+    import time
+    import urllib.request
+
+    from ray_tpu import workflow
+    from ray_tpu.dashboard.head import DashboardHead
+
+    workflow.init(str(tmp_path / "wf_storage"))
+    head = DashboardHead(port=0)
+    port = head.start()
+    try:
+        @ray_tpu.remote
+        def passthrough(x):
+            return x
+
+        dag = passthrough.bind(
+            workflow.wait_for_event("http-release", timeout=15))
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.update(
+                out=workflow.run(dag, workflow_id="http-evt-wf")))
+        t.start()
+        time.sleep(0.3)
+        assert t.is_alive()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/workflows/events/http-release",
+            data=json.dumps({"approved": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert resp["event_key"] == "http-release"
+        t.join(timeout=15)
+        assert not t.is_alive()
+        assert box["out"] == {"approved": True}
+        # listing endpoint shows the finished workflow
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/workflows/",
+                timeout=10) as r:
+            rows = json.loads(r.read())
+        assert {"workflow_id": "http-evt-wf",
+                "status": workflow.SUCCESSFUL} in rows
+        # bad key → 400
+        import urllib.error
+        req_bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/workflows/events/bad%7Ckey",
+            data=b"")
+        try:
+            urllib.request.urlopen(req_bad, timeout=10)
+            assert False, "expected HTTP 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        head.stop()

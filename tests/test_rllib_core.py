@@ -106,3 +106,34 @@ def test_learner_batch_sharding_metadata():
     assert sharding.num_devices == 8
     # per-device shard is 1/8 of the rows
     assert db["obs"].addressable_shards[0].data.shape[0] == 8
+
+
+def test_learner_spmd_ragged_batch_trims():
+    """Non-divisible batches train on the largest shardable prefix
+    instead of crashing; too-small batches fail with a clear error."""
+    group = LearnerGroup(PPOLearner, _spec(), LearnerConfig(seed=8))
+    m = group.update(_ppo_batch(67, seed=9))  # 67 % 8 == 3
+    assert np.isfinite(m["total_loss"])
+    assert group._learner.last_dropped_rows == 3
+    with pytest.raises(ValueError, match="cannot be sharded"):
+        group.update(_ppo_batch(4, seed=10))
+
+
+def test_learner_group_remote_ragged_and_tiny_batches(ray_start_regular):
+    """Uneven shards are weighted so no learner sees an empty batch and
+    every row contributes once."""
+    remote = LearnerGroup(PPOLearner, _spec(),
+                          LearnerConfig(lr=1e-2, seed=11),
+                          num_remote_learners=3)
+    local = PPOLearner(_spec(), LearnerConfig(lr=1e-2, seed=11)).build()
+    batch = _ppo_batch(65, seed=12)  # 65 rows over 3 learners: 22/22/21
+    m = remote.update(batch)
+    assert np.isfinite(m["total_loss"])
+    local.update(batch)
+    np.testing.assert_allclose(remote.get_weights()["pi"][0]["w"],
+                               local.get_weights()["pi"][0]["w"],
+                               rtol=1e-4, atol=1e-5)
+    # fewer rows than learners: only populated shards dispatch
+    m2 = remote.update(_ppo_batch(2, seed=13))
+    assert np.isfinite(m2["total_loss"])
+    remote.stop()
