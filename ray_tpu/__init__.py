@@ -17,7 +17,7 @@ from ray_tpu._private.worker import (ClientContext, available_resources,
                                      cancel, cluster_resources, free, get,
                                      get_actor, get_tpu_ids, init,
                                      is_initialized, kill, nodes, put,
-                                     shutdown, wait)
+                                     shutdown, start_head_server, wait)
 from ray_tpu.actor import ActorClass, ActorHandle
 from ray_tpu.remote_function import RemoteFunction, remote
 from ray_tpu.runtime_context import get_runtime_context
@@ -51,5 +51,6 @@ __all__ = [
     "put",
     "remote",
     "shutdown",
+    "start_head_server",
     "wait",
 ]

@@ -1,0 +1,549 @@
+"""Multi-process cluster substrate: head server + node daemons over TCP.
+
+The real-process analog of the reference's control plane (SURVEY.md §2.1:
+gRPC `src/ray/rpc/` + GCS server + raylets): the driver process acts as
+head (owner of all objects, scheduler authority — the collapsed
+GCS/owner model this runtime uses throughout), and **node daemons** are
+separate OS processes (possibly on other hosts) that register resources
+and execute user code pushed to them. The wire protocol is
+length-prefixed cloudpickle frames over one persistent TCP connection per
+node — the moral equivalent of the reference's PushTask gRPC stream, with
+connection death standing in for raylet health-check failure
+(gcs_health_check_manager.h): the head converts a dropped connection into
+`Runtime.remove_node`, which drives the existing retry / actor-restart /
+lineage-reconstruction machinery.
+
+Execution model ("remote call proxy"): scheduling, refcounting, retries,
+and result ownership all stay on the head; only the *user-code call*
+(`fn(*args)`, `cls(*args)`, `instance.method(*args)`) crosses the wire.
+A head worker thread blocks on the RPC while the daemon burns its own
+CPUs — so a task scheduled onto a remote node consumes that node's
+resources, exactly like a leased worker in the reference. Results return
+inline in the reply (the reference's small-result path,
+core_worker.cc PushTaskReply); daemon-resident big-object storage is the
+chunked ObjectManager pull, out of scope for this layer.
+
+Daemons run actors too: the instance lives in the daemon process
+(constructed there), and the head-side actor executor proxies each method
+call, preserving per-handle ordering. Daemon death restarts actors
+elsewhere through the normal node-death path.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+import traceback
+from typing import Any, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_FRAME = struct.Struct(">Q")
+_MAX_FRAME = 1 << 34  # 16 GiB sanity bound
+
+
+class RemoteNodeDiedError(RuntimeError):
+    """The node connection dropped while a call was in flight. NOT a
+    TaskError: the runtime treats it as a system failure (node death),
+    and the in-flight spec is invalidated/retried by remove_node."""
+
+
+# ---------------------------------------------------------------------------
+# Framing
+# ---------------------------------------------------------------------------
+
+
+def _send_frame(sock: socket.socket, payload: bytes,
+                lock: Optional[threading.Lock] = None) -> None:
+    data = _FRAME.pack(len(payload)) + payload
+    if lock is not None:
+        with lock:
+            sock.sendall(data)
+    else:
+        sock.sendall(data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (length,) = _FRAME.unpack(_recv_exact(sock, _FRAME.size))
+    if length > _MAX_FRAME:
+        raise ConnectionError(f"frame of {length} bytes exceeds bound")
+    return _recv_exact(sock, length)
+
+
+def _dumps(obj: Any) -> bytes:
+    from ray_tpu._private import serialization
+    return serialization.serialize(obj)
+
+
+def _loads(data: bytes) -> Any:
+    from ray_tpu._private import serialization
+    return serialization.deserialize(data)
+
+
+# ---------------------------------------------------------------------------
+# Head side
+# ---------------------------------------------------------------------------
+
+
+class _Pending:
+    __slots__ = ("event", "reply")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.reply: Optional[dict] = None
+
+
+class NodeConnection:
+    """Head-side handle to one node daemon: request/reply multiplexing
+    over the persistent socket (analog of the reference's per-raylet
+    rpc client with a ClientCallManager)."""
+
+    def __init__(self, sock: socket.socket, address: Tuple[str, int],
+                 resources: Dict[str, float], labels: Optional[dict]):
+        self._sock = sock
+        self.address = address
+        self.resources = resources
+        self.labels = labels or {}
+        self._send_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._pending: Dict[int, _Pending] = {}
+        self._req_counter = 0
+        self._closed = False
+        self._shipped_functions: set = set()
+        self.node_id = None  # set at registration
+        self._on_death = None
+
+    # -- plumbing --------------------------------------------------------
+
+    def _next_req(self) -> int:
+        with self._lock:
+            self._req_counter += 1
+            return self._req_counter
+
+    def _request(self, msg: dict, fn_resolver=None) -> dict:
+        """Send a request and block until its reply (or node death).
+
+        ``fn_resolver`` (if given) decides the message's fn_bytes field
+        *inside the send lock*: frames share one socket, so deciding
+        "already shipped" and sending must be atomic — otherwise a
+        concurrent first use could send fn_bytes=None ahead of the frame
+        actually carrying the bytes."""
+        req_id = self._next_req()
+        msg["req_id"] = req_id
+        waiter = _Pending()
+        with self._lock:
+            if self._closed:
+                raise RemoteNodeDiedError(
+                    f"node {self.address} connection is closed")
+            self._pending[req_id] = waiter
+        try:
+            with self._send_lock:
+                if fn_resolver is not None:
+                    msg["fn_bytes"] = fn_resolver()
+                _send_frame(self._sock, _dumps(msg))
+        except OSError as exc:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise RemoteNodeDiedError(
+                f"node {self.address} send failed: {exc}") from exc
+        except BaseException:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise
+        waiter.event.wait()
+        reply = waiter.reply
+        if reply is None or reply.get("type") == "died":
+            raise RemoteNodeDiedError(
+                f"node {self.address} died while a call was in flight")
+        return reply
+
+    def recv_loop(self) -> None:
+        """Reply pump; runs on a daemon thread owned by HeadServer."""
+        try:
+            while True:
+                reply = _loads(_recv_frame(self._sock))
+                with self._lock:
+                    waiter = self._pending.pop(reply.get("req_id"), None)
+                if waiter is not None:
+                    waiter.reply = reply
+                    waiter.event.set()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            pending = list(self._pending.values())
+            self._pending.clear()
+        on_death = self._on_death
+        if on_death is not None:
+            # Node-death bookkeeping FIRST (invalidate + retry in-flight
+            # specs), THEN wake blocked callers so they observe
+            # spec.invalidated and discard instead of double-retrying.
+            try:
+                on_death(self)
+            except Exception:  # noqa: BLE001 - never strand waiters
+                logger.exception("remote-node death handler failed")
+        for waiter in pending:
+            waiter.reply = {"type": "died"}
+            waiter.event.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- user-code proxies ----------------------------------------------
+
+    def _function_payload(self, fn_id: bytes, functions) -> Optional[bytes]:
+        if fn_id in self._shipped_functions:
+            return None
+        try:
+            payload = functions.get_bytes(fn_id)
+        except KeyError:
+            raise ValueError(
+                "This function/class captured objects that cannot be "
+                "serialized, so it cannot run on a remote node. Make it "
+                "importable/picklable, or pin it to the head node.")
+        self._shipped_functions.add(fn_id)
+        return payload
+
+    def _unpack(self, reply: dict, name: str) -> Any:
+        if reply["ok"]:
+            return _loads(reply["value"])
+        from ray_tpu.exceptions import TaskError
+        exc, remote_tb = _loads(reply["error"])
+        raise TaskError(exc, remote_tb, name)
+
+    def execute_task(self, spec, functions, args, kwargs) -> Any:
+        reply = self._request({
+            "type": "execute_task",
+            "fn_id": spec.function_id,
+            "payload": _dumps((args, kwargs)),
+            "name": spec.name,
+            "runtime_env": spec.runtime_env,
+            "tpu_ids": getattr(spec, "_tpu_ids", None),
+        }, fn_resolver=lambda: self._function_payload(
+            spec.function_id, functions))
+        return self._unpack(reply, spec.name)
+
+    def create_actor(self, spec, functions, args, kwargs) -> None:
+        reply = self._request({
+            "type": "create_actor",
+            "actor_id": spec.actor_id.hex(),
+            "fn_id": spec.function_id,
+            "payload": _dumps((args, kwargs)),
+            "name": spec.name,
+            "runtime_env": spec.runtime_env,
+            "tpu_ids": getattr(spec, "_tpu_ids", None),
+        }, fn_resolver=lambda: self._function_payload(
+            spec.function_id, functions))
+        self._unpack(reply, f"{spec.name}.__init__")
+
+    def call_actor_method(self, actor_id, method_name, name,
+                          args, kwargs) -> Any:
+        reply = self._request({
+            "type": "actor_call",
+            "actor_id": actor_id.hex(),
+            "method": method_name,
+            "payload": _dumps((args, kwargs)),
+            "name": name,
+        })
+        return self._unpack(reply, name)
+
+    def destroy_actor(self, actor_id) -> None:
+        try:
+            self._request({"type": "destroy_actor",
+                           "actor_id": actor_id.hex()})
+        except RemoteNodeDiedError:
+            pass  # best effort — the instance dies with the daemon anyway
+
+
+class RemoteActorInstance:
+    """Placeholder stored as ActorState.instance for daemon-resident
+    actors; method lookups return wire-call closures."""
+
+    def __init__(self, conn: NodeConnection, actor_id):
+        self.conn = conn
+        self.actor_id = actor_id
+
+    def bind_method(self, method_name: str, task_name: str):
+        def call(*args, **kwargs):
+            return self.conn.call_actor_method(
+                self.actor_id, method_name, task_name, args, kwargs)
+        return call
+
+
+class HeadServer:
+    """Listens for node-daemon registrations (the GCS node-manager
+    surface: register → add_node; disconnect → remove_node)."""
+
+    def __init__(self, runtime, host: str = "0.0.0.0", port: int = 0):
+        self.runtime = runtime
+        self._listener = socket.create_server((host, port))
+        self.address = self._listener.getsockname()[:2]
+        self._threads = []
+        self._conns: Dict[Any, NodeConnection] = {}
+        self._closed = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="ray_tpu-head-server",
+            daemon=True)
+
+    def start(self) -> Tuple[str, int]:
+        self._accept_thread.start()
+        return self.address
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                return
+            try:
+                register = _loads(_recv_frame(sock))
+                assert register["type"] == "register", register
+            except Exception:  # noqa: BLE001 - bad handshake: drop it
+                sock.close()
+                continue
+            conn = NodeConnection(sock, tuple(addr),
+                                  register["resources"],
+                                  register.get("labels"))
+            node_id = self.runtime.register_remote_node(conn)
+            conn.node_id = node_id
+            conn._on_death = self._on_conn_death
+            self._conns[node_id] = conn
+            _send_frame(sock, _dumps({"type": "registered",
+                                      "node_id": node_id.hex()}))
+            t = threading.Thread(target=conn.recv_loop,
+                                 name=f"ray_tpu-node-{node_id.hex()[:8]}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+            logger.info("Node daemon %s joined as %s with %s",
+                        addr, node_id.hex()[:12], register["resources"])
+
+    def _on_conn_death(self, conn: NodeConnection) -> None:
+        if self._closed:
+            return
+        self._conns.pop(conn.node_id, None)
+        self.runtime.unregister_remote_node(conn.node_id)
+
+    def stop(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for conn in list(self._conns.values()):
+            conn._on_death = None  # orderly shutdown, not node death
+            try:
+                _send_frame(conn._sock, _dumps({"type": "shutdown",
+                                                "req_id": 0}),
+                            conn._send_lock)
+            except OSError:
+                pass
+            conn.close()
+        self._conns.clear()
+
+
+# ---------------------------------------------------------------------------
+# Daemon side
+# ---------------------------------------------------------------------------
+
+
+class NodeDaemon:
+    """The per-node worker process (raylet + worker-pool analog): executes
+    pushed user code on local threads, hosts actor instances."""
+
+    def __init__(self, head_address: Tuple[str, int],
+                 resources: Dict[str, float],
+                 labels: Optional[dict] = None):
+        self.head_address = head_address
+        self.resources = resources
+        self.labels = labels or {}
+        self._functions: Dict[bytes, Any] = {}
+        self._actors: Dict[str, Any] = {}
+        self._actor_tpu_ids: Dict[str, Any] = {}
+        self._send_lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self.node_id_hex: Optional[str] = None
+
+    def _load_function(self, fn_id: bytes, fn_bytes: Optional[bytes]):
+        fn = self._functions.get(fn_id)
+        if fn is None:
+            from ray_tpu._private import serialization
+            if fn_bytes is None:
+                raise RuntimeError("head sent no bytes for unknown function")
+            fn = serialization.loads_function(fn_bytes)
+            self._functions[fn_id] = fn
+        return fn
+
+    def _reply(self, req_id: int, *, value: Any = None,
+               error: Optional[BaseException] = None,
+               tb: str = "") -> None:
+        if error is not None:
+            try:
+                payload = _dumps((error, tb))
+            except Exception:  # noqa: BLE001 - unpicklable exception
+                payload = _dumps((RuntimeError(
+                    f"{type(error).__name__}: {error}"), tb))
+            msg = {"req_id": req_id, "ok": False, "error": payload}
+        else:
+            msg = {"req_id": req_id, "ok": True, "value": _dumps(value)}
+        _send_frame(self._sock, _dumps(msg), self._send_lock)
+
+    def _handle(self, msg: dict) -> None:
+        req_id = msg.get("req_id", 0)
+        kind = msg.get("type")
+        try:
+            if kind == "execute_task":
+                fn = self._load_function(msg["fn_id"], msg.get("fn_bytes"))
+                args, kwargs = _loads(msg["payload"])
+                result = self._run_in_env(msg, fn, args, kwargs)
+                self._reply(req_id, value=result)
+            elif kind == "create_actor":
+                cls = self._load_function(msg["fn_id"], msg.get("fn_bytes"))
+                args, kwargs = _loads(msg["payload"])
+                instance = self._run_in_env(msg, cls, args, kwargs)
+                self._actors[msg["actor_id"]] = instance
+                self._actor_tpu_ids[msg["actor_id"]] = msg.get("tpu_ids")
+                self._reply(req_id, value=None)
+            elif kind == "actor_call":
+                instance = self._actors[msg["actor_id"]]
+                method = getattr(instance, msg["method"])
+                args, kwargs = _loads(msg["payload"])
+                # Methods inherit the chips reserved at actor creation.
+                msg = dict(msg,
+                           tpu_ids=self._actor_tpu_ids.get(msg["actor_id"]))
+                result = self._run_in_env(msg, method, args, kwargs)
+                import inspect
+                if inspect.iscoroutine(result):
+                    import asyncio
+                    result = asyncio.run(result)
+                self._reply(req_id, value=result)
+            elif kind == "destroy_actor":
+                self._actors.pop(msg["actor_id"], None)
+                self._actor_tpu_ids.pop(msg["actor_id"], None)
+                self._reply(req_id, value=None)
+            elif kind == "ping":
+                self._reply(req_id, value="pong")
+            elif kind == "shutdown":
+                self._stop.set()
+            else:
+                raise ValueError(f"unknown message type {kind!r}")
+        except BaseException as exc:  # noqa: BLE001 - ship to the head
+            try:
+                self._reply(req_id, error=exc, tb=traceback.format_exc())
+            except OSError:
+                pass
+
+    def _run_in_env(self, msg: dict, fn, args, kwargs):
+        # Publish the head-assigned chip ids through the worker context so
+        # ray_tpu.get_tpu_ids() works inside remotely executed tasks.
+        import types
+
+        from ray_tpu._private.runtime import _task_context
+        _task_context.spec = types.SimpleNamespace(
+            _tpu_ids=msg.get("tpu_ids"), actor_id=None,
+            name=msg.get("name", ""))
+        try:
+            renv = msg.get("runtime_env")
+            if renv:
+                from ray_tpu._private import runtime_env as _renv
+                _renv.setup(renv)
+                with _renv.applied(renv):
+                    return fn(*args, **kwargs)
+            return fn(*args, **kwargs)
+        finally:
+            _task_context.spec = None
+
+    def run(self) -> None:
+        """Connect, register, and serve until shutdown/EOF. Each request
+        runs on its own thread — the head's scheduler already bounds
+        concurrency by this node's declared resources."""
+        self._sock = socket.create_connection(self.head_address)
+        try:
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        _send_frame(self._sock, _dumps({
+            "type": "register",
+            "resources": self.resources,
+            "labels": self.labels,
+        }), self._send_lock)
+        ack = _loads(_recv_frame(self._sock))
+        assert ack["type"] == "registered", ack
+        self.node_id_hex = ack["node_id"]
+        logger.info("Registered with head %s as node %s",
+                    self.head_address, self.node_id_hex[:12])
+        try:
+            while not self._stop.is_set():
+                msg = _loads(_recv_frame(self._sock))
+                if msg.get("type") == "shutdown":
+                    break
+                threading.Thread(target=self._handle, args=(msg,),
+                                 daemon=True).start()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+
+def run_node(address: str, *, num_cpus: float = 1.0, num_tpus: float = 0.0,
+             memory: float = 1 << 30,
+             resources: Optional[Dict[str, float]] = None,
+             labels: Optional[dict] = None) -> None:
+    """Entry point for `ray-tpu start --address host:port` and
+    `python -m ray_tpu._private.multinode`."""
+    host, _, port = address.rpartition(":")
+    node_resources: Dict[str, float] = {"CPU": float(num_cpus),
+                                        "memory": float(memory)}
+    if num_tpus:
+        node_resources["TPU"] = float(num_tpus)
+    if resources:
+        node_resources.update(resources)
+    NodeDaemon((host or "127.0.0.1", int(port)), node_resources,
+               labels).run()
+
+
+def _main() -> None:
+    import argparse
+    import json
+    parser = argparse.ArgumentParser(
+        description="ray_tpu node daemon: join a head and execute tasks")
+    parser.add_argument("--address", required=True,
+                        help="head host:port (ray_tpu.start_head_server)")
+    parser.add_argument("--num-cpus", type=float, default=1.0)
+    parser.add_argument("--num-tpus", type=float, default=0.0)
+    parser.add_argument("--memory", type=float, default=float(1 << 30))
+    parser.add_argument("--resources", type=str, default=None,
+                        help='extra resources as JSON, e.g. \'{"spot": 1}\'')
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    run_node(args.address, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+             memory=args.memory,
+             resources=json.loads(args.resources) if args.resources
+             else None)
+
+
+if __name__ == "__main__":
+    _main()

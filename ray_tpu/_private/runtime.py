@@ -305,6 +305,11 @@ class Runtime:
             int(self.config.worker_cap_multiplier))
         self._task_events: List[dict] = []  # lightweight task-event buffer
         self._infeasible_warned: set = set()
+        # Real remote node daemons (multi-process cluster, _private/
+        # multinode.py): NodeID → NodeConnection. Virtual sim nodes
+        # (cluster_utils) never appear here.
+        self._remote_nodes: Dict[NodeID, Any] = {}
+        self._head_server = None
         # Lineage: creating TaskSpec per return object, for reconstruction
         # after node loss (reference: task_manager.h TaskResubmissionInterface
         # + object_recovery_manager.h). Bounded; puts are not reconstructable.
@@ -780,7 +785,11 @@ class Runtime:
                 # Same bound as _lineage: past it, objects are simply not
                 # reconstructable (the maps must not grow without limit in
                 # long-running drivers).
-                if len(self._object_locations) < \
+                # Remote-daemon results return inline and live in the
+                # HEAD's store — recording the daemon as their location
+                # would make its death discard values we still hold.
+                if node_id not in self._remote_nodes and \
+                        len(self._object_locations) < \
                         self.config.object_locations_max_entries:
                     for oid in spec.return_ids:
                         self._object_locations[oid] = node_id
@@ -861,13 +870,16 @@ class Runtime:
                 with tracing.continue_context(
                         getattr(spec, "trace_ctx", None),
                         f"task::{spec.name}"):
-                    if spec.runtime_env:
+                    # Remote tasks apply runtime_env daemon-side (the
+                    # request carries it); only local runs apply it here.
+                    if spec.runtime_env and self._remote_conn(spec) is None:
                         from ray_tpu._private import runtime_env as _renv
                         _renv.setup(spec.runtime_env)
                         with _renv.applied(spec.runtime_env):
-                            result = fn(*args, **kwargs)
+                            result = self._invoke_user(spec, fn, args,
+                                                       kwargs)
                     else:
-                        result = fn(*args, **kwargs)
+                        result = self._invoke_user(spec, fn, args, kwargs)
             finally:
                 _task_context.spec = None
             self._store_results(spec, result)
@@ -879,7 +891,13 @@ class Runtime:
                 return
             err = e if isinstance(e, TaskError) else TaskError(
                 e, traceback.format_exc(), spec.name)
-            if self._should_retry(spec, err):
+            # A dropped node connection is a SYSTEM failure (node death),
+            # not an application error — probe retry with the raw
+            # exception so the always-retriable path applies even when the
+            # death handler hasn't invalidated this spec yet.
+            from ray_tpu._private.multinode import RemoteNodeDiedError
+            probe = e if isinstance(e, RemoteNodeDiedError) else err
+            if self._should_retry(spec, probe):
                 spec.attempt_number += 1
                 self._finish_task(spec, worker, retried=True)
                 logger.warning("Retrying task %s (attempt %d/%d)", spec.name,
@@ -1040,13 +1058,15 @@ class Runtime:
             args, kwargs = self._resolve_args(spec)
             _task_context.spec = spec
             try:
-                if spec.runtime_env:
+                if spec.runtime_env and self._remote_conn(spec) is None:
                     from ray_tpu._private import runtime_env as _renv
                     _renv.setup(spec.runtime_env)
                     with _renv.applied(spec.runtime_env):
-                        instance = cls(*args, **kwargs)
+                        instance = self._invoke_actor_init(spec, cls, args,
+                                                           kwargs)
                 else:
-                    instance = cls(*args, **kwargs)
+                    instance = self._invoke_actor_init(spec, cls, args,
+                                                       kwargs)
             finally:
                 _task_context.spec = None
             if spec.invalidated:
@@ -1080,7 +1100,7 @@ class Runtime:
                 self.store.put_inline(spec.return_ids[0], None)
                 self._record_event(spec, "FINISHED")
         except BaseException as e:  # noqa: BLE001
-            if spec.invalidated:
+            if spec.invalidated or self._node_death_invalidated(spec, e):
                 self._return_worker(worker)
                 self._dispatch()
                 return
@@ -1203,7 +1223,12 @@ class Runtime:
             self._finish_actor_task(spec, state)
             return None
         try:
-            method = getattr(state.instance, spec.method_name)
+            from ray_tpu._private.multinode import RemoteActorInstance
+            if isinstance(state.instance, RemoteActorInstance):
+                method = state.instance.bind_method(spec.method_name,
+                                                    spec.name)
+            else:
+                method = getattr(state.instance, spec.method_name)
             args, kwargs = self._resolve_args(spec)
         except BaseException as e:  # noqa: BLE001
             self._store_error(spec, TaskError(e, traceback.format_exc(),
@@ -1266,6 +1291,10 @@ class Runtime:
             unfinished = list(state.unfinished.values())
             state.unfinished.clear()
             state.pre_creation_queue.clear()
+        try:
+            self._destroy_remote_instance(state)
+        except Exception:  # noqa: BLE001 - best effort only
+            pass
         # Seal every submitted-but-unfinished task so gets raise instead of
         # hanging (first-write-wins in the store keeps completed results).
         for spec in unfinished:
@@ -1289,6 +1318,10 @@ class Runtime:
         cause = ActorDiedError(
             state.actor_id,
             f"Actor {state.actor_id} is restarting; in-flight tasks failed.")
+        try:
+            self._destroy_remote_instance(state)
+        except Exception:  # noqa: BLE001 - best effort only
+            pass
         with state.lock:
             state.num_restarts += 1
             old_executor = state.executor
@@ -1337,7 +1370,7 @@ class Runtime:
         try:
             cls = self.functions.load(spec.function_id)
             args, kwargs = self._resolve_args(spec)
-            instance = cls(*args, **kwargs)
+            instance = self._invoke_actor_init(spec, cls, args, kwargs)
             executor = self._make_actor_executor(state)
             with state.lock:
                 if state.dead:
@@ -1351,6 +1384,14 @@ class Runtime:
                             lambda s=queued: self._run_actor_task(s, state))
                     state.pre_creation_queue.clear()
         except BaseException as e:  # noqa: BLE001
+            if getattr(spec, "invalidated", False) or \
+                    self._node_death_invalidated(spec, e):
+                # The node died under the restarting __init__; node-death
+                # handling owns the next restart attempt (including this
+                # spec's dependency pins — don't double-release).
+                self._return_worker(worker)
+                self._dispatch()
+                return
             err = TaskError(e, traceback.format_exc(), f"{spec.name}.restart")
             with state.lock:
                 state.dead = True
@@ -1428,6 +1469,82 @@ class Runtime:
         self.scheduler.reschedule_lost_bundles()
         self._dispatch()  # new capacity may unblock queued tasks
         return node_id
+
+    def start_head_server(self, host: str = "0.0.0.0",
+                          port: int = 0) -> Tuple[str, int]:
+        """Open the head's TCP registration endpoint so node-daemon
+        processes (`ray-tpu start --address host:port`) can join this
+        cluster (reference: GCS server accepting raylet registration)."""
+        if self._head_server is None:
+            from ray_tpu._private.multinode import HeadServer
+            self._head_server = HeadServer(self, host, port)
+            self._head_server.start()
+        return self._head_server.address
+
+    def register_remote_node(self, conn) -> NodeID:
+        # The connection must be visible BEFORE dispatch can place tasks
+        # on the new node — otherwise a queued task assigned to it would
+        # find no conn and silently run head-local.
+        node_id = self.scheduler.add_node(dict(conn.resources),
+                                          labels=conn.labels)
+        with self._lock:
+            self._remote_nodes[node_id] = conn
+        self.scheduler.reschedule_lost_bundles()
+        self._dispatch()
+        return node_id
+
+    def unregister_remote_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            self._remote_nodes.pop(node_id, None)
+        self.remove_node(node_id)
+
+    def _remote_conn(self, spec: TaskSpec):
+        node_id = getattr(spec, "_node_id", None)
+        if node_id is None:
+            return None
+        with self._lock:
+            return self._remote_nodes.get(node_id)
+
+    def _invoke_user(self, spec: TaskSpec, fn, args, kwargs):
+        """The user-code call seam: local nodes call directly; tasks
+        placed on a remote daemon proxy the call over its connection
+        (this head thread blocks while the daemon's CPUs do the work)."""
+        conn = self._remote_conn(spec)
+        if conn is None:
+            return fn(*args, **kwargs)
+        return conn.execute_task(spec, self.functions, args, kwargs)
+
+    def _invoke_actor_init(self, spec: TaskSpec, cls, args, kwargs):
+        conn = self._remote_conn(spec)
+        if conn is None:
+            return cls(*args, **kwargs)
+        from ray_tpu._private.multinode import RemoteActorInstance
+        conn.create_actor(spec, self.functions, args, kwargs)
+        return RemoteActorInstance(conn, spec.actor_id)
+
+    def _destroy_remote_instance(self, state: "ActorState") -> None:
+        """Best-effort teardown of a daemon-resident actor instance."""
+        from ray_tpu._private.multinode import RemoteActorInstance
+        instance = state.instance
+        if isinstance(instance, RemoteActorInstance):
+            instance.conn.destroy_actor(state.actor_id)
+
+    def _node_death_invalidated(self, spec: TaskSpec,
+                                exc: BaseException) -> bool:
+        """After a RemoteNodeDiedError, wait briefly for the connection's
+        death handler to invalidate the spec (it restarts actors / retries
+        tasks itself); returns whether this thread should discard its
+        work. Closes the race where the send side observes the dead socket
+        before the recv side has run remove_node."""
+        from ray_tpu._private.multinode import RemoteNodeDiedError
+        if not isinstance(exc, RemoteNodeDiedError):
+            return False
+        import time as _time
+        for _ in range(100):
+            if getattr(spec, "invalidated", False):
+                return True
+            _time.sleep(0.05)
+        return bool(getattr(spec, "invalidated", False))
 
     def remove_node(self, node_id: NodeID) -> None:
         """Simulate node failure: running tasks there fail (and retry
@@ -1634,7 +1751,11 @@ class Runtime:
 
     def shutdown(self) -> None:
         from ray_tpu.exceptions import RayError
+        if self._head_server is not None:
+            self._head_server.stop()
+            self._head_server = None
         with self._lock:
+            self._remote_nodes.clear()
             self._shutdown = True
             workers = list(self._all_workers)
             actors = list(self._actors.values())

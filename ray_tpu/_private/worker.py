@@ -122,6 +122,16 @@ def is_initialized() -> bool:
     return global_worker.connected
 
 
+def start_head_server(port: int = 0, host: str = "0.0.0.0"):
+    """Open this driver's node-registration endpoint so `ray-tpu start
+    --address host:port` daemons (other processes/hosts) can join the
+    cluster as schedulable nodes (reference: `ray start --head` GCS).
+    Returns (host, port)."""
+    if not is_initialized():
+        init()
+    return global_worker.runtime.start_head_server(host, port)
+
+
 class ClientContext:
     """Return value of ``init`` — address info + context-manager support."""
 

@@ -129,6 +129,37 @@ def cmd_microbenchmark(args) -> int:
     return 0
 
 
+def cmd_start(args) -> int:
+    """`ray-tpu start` — join (or head) a multi-process cluster
+    (reference: `ray start --head/--address`, scripts/scripts.py:529)."""
+    import json
+    import time
+
+    if args.head:
+        import ray_tpu
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+        host, port = ray_tpu.start_head_server(port=args.port)
+        print(f"Head node listening for node daemons on {host}:{port}")
+        print(f"Join with: ray-tpu start --address <this-host>:{port}")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            ray_tpu.shutdown()
+        return 0
+    if not args.address:
+        print("start requires --head or --address host:port",
+              file=sys.stderr)
+        return 1
+    from ray_tpu._private.multinode import run_node
+    run_node(args.address, num_cpus=args.num_cpus,
+             num_tpus=args.num_tpus, memory=args.memory,
+             resources=json.loads(args.resources) if args.resources
+             else None)
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     """`ray-tpu dashboard` — run the HTTP observability endpoint."""
     import time
@@ -206,6 +237,17 @@ def main(argv=None) -> int:
                        help="core ops/s suite (tasks, actors, put/get)")
     p.add_argument("--duration", type=float, default=2.0)
 
+    p = sub.add_parser("start", help="start a head or join as a node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None,
+                   help="head host:port to join as a node daemon")
+    p.add_argument("--port", type=int, default=6380)
+    p.add_argument("--num-cpus", type=float, default=1.0)
+    p.add_argument("--num-tpus", type=float, default=0.0)
+    p.add_argument("--memory", type=float, default=float(1 << 30))
+    p.add_argument("--resources", default=None,
+                   help="extra resources as JSON")
+
     p = sub.add_parser("dashboard", help="run the HTTP dashboard")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8265)
@@ -229,6 +271,7 @@ def main(argv=None) -> int:
         "job": cmd_job,
         "serve": cmd_serve,
         "dashboard": cmd_dashboard,
+        "start": cmd_start,
         "microbenchmark": cmd_microbenchmark,
     }[args.command]
     return handler(args)
