@@ -225,12 +225,16 @@ class NodeConnection:
 
     def _unpack(self, reply: dict, name: str) -> Any:
         if reply["ok"]:
+            if "stored_key" in reply:
+                return RemoteValueStub(self, reply["stored_key"],
+                                       reply["size"])
             return _loads(reply["value"])
         from ray_tpu.exceptions import TaskError
         exc, remote_tb = _loads(reply["error"])
         raise TaskError(exc, remote_tb, name)
 
-    def execute_task(self, spec, functions, args, kwargs) -> Any:
+    def execute_task(self, spec, functions, args, kwargs,
+                     store_limit: int = 0) -> Any:
         reply = self._request({
             "type": "execute_task",
             "fn_id": spec.function_id,
@@ -238,9 +242,23 @@ class NodeConnection:
             "name": spec.name,
             "runtime_env": spec.runtime_env,
             "tpu_ids": getattr(spec, "_tpu_ids", None),
+            "store_limit": store_limit,
         }, fn_resolver=lambda: self._function_payload(
             spec.function_id, functions))
         return self._unpack(reply, spec.name)
+
+    def fetch_object(self, key: str) -> bytes:
+        reply = self._request({"type": "fetch_object", "key": key})
+        if not reply["ok"]:
+            exc, remote_tb = _loads(reply["error"])
+            raise exc
+        return reply["raw"]
+
+    def free_object(self, key: str) -> None:
+        try:
+            self._request({"type": "free_object", "key": key})
+        except RemoteNodeDiedError:
+            pass  # the payload died with the daemon
 
     def create_actor(self, spec, functions, args, kwargs) -> None:
         reply = self._request({
@@ -256,13 +274,14 @@ class NodeConnection:
         self._unpack(reply, f"{spec.name}.__init__")
 
     def call_actor_method(self, actor_id, method_name, name,
-                          args, kwargs) -> Any:
+                          args, kwargs, store_limit: int = 0) -> Any:
         reply = self._request({
             "type": "actor_call",
             "actor_id": actor_id.hex(),
             "method": method_name,
             "payload": _dumps((args, kwargs)),
             "name": name,
+            "store_limit": store_limit,
         })
         return self._unpack(reply, name)
 
@@ -274,6 +293,41 @@ class NodeConnection:
             pass  # best effort — the instance dies with the daemon anyway
 
 
+class RemoteValueStub:
+    """Head-side handle to a result the daemon kept locally (it exceeded
+    remote_object_inline_limit_bytes): the ObjectStore materializes it on
+    first get via fetch(). Never pickled."""
+
+    __slots__ = ("conn", "key", "size")
+
+    def __init__(self, conn: "NodeConnection", key: str, size: int):
+        self.conn = conn
+        self.key = key
+        self.size = size
+
+    def fetch(self):
+        from ray_tpu.exceptions import ObjectLostError
+        try:
+            return _loads(self.conn.fetch_object(self.key))
+        except RemoteNodeDiedError as exc:
+            raise ObjectLostError(
+                f"Object payload {self.key} was on node "
+                f"{self.conn.address}, which died before it was fetched "
+                "(reconstruction, if possible, re-seals the object)."
+            ) from exc
+
+
+class RemoteArgMarker:
+    """Locality marker: an argument whose payload already lives in the
+    target daemon's object table travels as this tiny stub and is resolved
+    daemon-side — the task-arg analog of a plasma-local read."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key: str):
+        self.key = key
+
+
 class RemoteActorInstance:
     """Placeholder stored as ActorState.instance for daemon-resident
     actors; method lookups return wire-call closures."""
@@ -282,10 +336,12 @@ class RemoteActorInstance:
         self.conn = conn
         self.actor_id = actor_id
 
-    def bind_method(self, method_name: str, task_name: str):
+    def bind_method(self, method_name: str, task_name: str,
+                    store_limit: int = 0):
         def call(*args, **kwargs):
             return self.conn.call_actor_method(
-                self.actor_id, method_name, task_name, args, kwargs)
+                self.actor_id, method_name, task_name, args, kwargs,
+                store_limit)
         return call
 
 
@@ -314,21 +370,33 @@ class HeadServer:
                 sock, addr = self._listener.accept()
             except OSError:
                 return
+            node_id = None
             try:
                 register = _loads(_recv_frame(sock))
                 assert register["type"] == "register", register
-            except Exception:  # noqa: BLE001 - bad handshake: drop it
-                sock.close()
+                conn = NodeConnection(sock, tuple(addr),
+                                      register["resources"],
+                                      register.get("labels"))
+                node_id = self.runtime.register_remote_node(conn)
+                conn.node_id = node_id
+                conn._on_death = self._on_conn_death
+                self._conns[node_id] = conn
+                _send_frame(sock, _dumps({"type": "registered",
+                                          "node_id": node_id.hex()}))
+            except Exception:  # noqa: BLE001 - one bad join must not
+                # kill the accept thread or strand a half-registered node.
+                if node_id is not None:
+                    self._conns.pop(node_id, None)
+                    try:
+                        self.runtime.unregister_remote_node(node_id)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("rollback of failed node "
+                                         "registration failed")
+                try:
+                    sock.close()
+                except OSError:
+                    pass
                 continue
-            conn = NodeConnection(sock, tuple(addr),
-                                  register["resources"],
-                                  register.get("labels"))
-            node_id = self.runtime.register_remote_node(conn)
-            conn.node_id = node_id
-            conn._on_death = self._on_conn_death
-            self._conns[node_id] = conn
-            _send_frame(sock, _dumps({"type": "registered",
-                                      "node_id": node_id.hex()}))
             t = threading.Thread(target=conn.recv_loop,
                                  name=f"ray_tpu-node-{node_id.hex()[:8]}",
                                  daemon=True)
@@ -379,6 +447,9 @@ class NodeDaemon:
         self._functions: Dict[bytes, Any] = {}
         self._actors: Dict[str, Any] = {}
         self._actor_tpu_ids: Dict[str, Any] = {}
+        # Daemon-resident object table (local half of the data plane):
+        # big results stay here until the head fetches or frees them.
+        self._objects: Dict[str, bytes] = {}
         self._send_lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
@@ -408,18 +479,44 @@ class NodeDaemon:
             msg = {"req_id": req_id, "ok": True, "value": _dumps(value)}
         _send_frame(self._sock, _dumps(msg), self._send_lock)
 
+    def _reply_result(self, req_id: int, result: Any,
+                      store_limit: int) -> None:
+        """Small results return inline (the reference's PushTaskReply
+        path); big ones stay in this daemon's object table and only a
+        (key, size) stub travels back."""
+        payload = _dumps(result)
+        if store_limit and len(payload) > store_limit:
+            key = f"obj-{req_id}"
+            self._objects[key] = payload
+            msg = {"req_id": req_id, "ok": True, "stored_key": key,
+                   "size": len(payload)}
+        else:
+            msg = {"req_id": req_id, "ok": True, "value": payload}
+        _send_frame(self._sock, _dumps(msg), self._send_lock)
+
+    def _resolve_markers(self, args, kwargs):
+        def resolve(a):
+            if isinstance(a, RemoteArgMarker):
+                return _loads(self._objects[a.key])
+            return a
+        return ([resolve(a) for a in args],
+                {k: resolve(v) for k, v in kwargs.items()})
+
     def _handle(self, msg: dict) -> None:
         req_id = msg.get("req_id", 0)
         kind = msg.get("type")
         try:
             if kind == "execute_task":
                 fn = self._load_function(msg["fn_id"], msg.get("fn_bytes"))
-                args, kwargs = _loads(msg["payload"])
+                args, kwargs = self._resolve_markers(
+                    *_loads(msg["payload"]))
                 result = self._run_in_env(msg, fn, args, kwargs)
-                self._reply(req_id, value=result)
+                self._reply_result(req_id, result,
+                                   msg.get("store_limit", 0))
             elif kind == "create_actor":
                 cls = self._load_function(msg["fn_id"], msg.get("fn_bytes"))
-                args, kwargs = _loads(msg["payload"])
+                args, kwargs = self._resolve_markers(
+                    *_loads(msg["payload"]))
                 instance = self._run_in_env(msg, cls, args, kwargs)
                 self._actors[msg["actor_id"]] = instance
                 self._actor_tpu_ids[msg["actor_id"]] = msg.get("tpu_ids")
@@ -427,7 +524,8 @@ class NodeDaemon:
             elif kind == "actor_call":
                 instance = self._actors[msg["actor_id"]]
                 method = getattr(instance, msg["method"])
-                args, kwargs = _loads(msg["payload"])
+                args, kwargs = self._resolve_markers(
+                    *_loads(msg["payload"]))
                 # Methods inherit the chips reserved at actor creation.
                 msg = dict(msg,
                            tpu_ids=self._actor_tpu_ids.get(msg["actor_id"]))
@@ -436,10 +534,23 @@ class NodeDaemon:
                 if inspect.iscoroutine(result):
                     import asyncio
                     result = asyncio.run(result)
-                self._reply(req_id, value=result)
+                self._reply_result(req_id, result,
+                                   msg.get("store_limit", 0))
             elif kind == "destroy_actor":
                 self._actors.pop(msg["actor_id"], None)
                 self._actor_tpu_ids.pop(msg["actor_id"], None)
+                self._reply(req_id, value=None)
+            elif kind == "fetch_object":
+                raw = self._objects.get(msg["key"])
+                if raw is None:
+                    raise KeyError(
+                        f"object payload {msg['key']} is not resident on "
+                        "this node (already freed?)")
+                _send_frame(self._sock, _dumps(
+                    {"req_id": req_id, "ok": True, "raw": raw}),
+                    self._send_lock)
+            elif kind == "free_object":
+                self._objects.pop(msg["key"], None)
                 self._reply(req_id, value=None)
             elif kind == "ping":
                 self._reply(req_id, value="pong")
@@ -546,4 +657,9 @@ def _main() -> None:
 
 
 if __name__ == "__main__":
-    _main()
+    # `python -m` runs this file as __main__ — delegate to the canonical
+    # import so the daemon's classes are identical to the ones the head
+    # pickles by reference (isinstance across the wire depends on it).
+    from ray_tpu._private.multinode import _main as _canonical_main
+
+    _canonical_main()

@@ -62,6 +62,12 @@ class _Entry:
     create_time: float = 0.0
     spilled_path: Optional[str] = None
     pinned: bool = False  # restored-and-read objects are not re-spilled
+    # Sealed-but-elsewhere (node-daemon resident, multinode data plane):
+    # get() materializes through this callable exactly once. The daemon
+    # keeps the primary copy until the ref drops (plasma semantics: a get
+    # copies locally, the primary stays pinned on the producing node).
+    remote_fetch: Optional[Callable[[], Any]] = None
+    fetching: bool = False  # one pull at a time; other getters wait
 
 
 class ObjectStore:
@@ -150,6 +156,29 @@ class ObjectStore:
             entry.create_time = time.time()
             entry.event.set()
         self._maybe_spill()
+
+    def put_remote(self, object_id: ObjectID, fetch_fn: Callable[[], Any],
+                   size_bytes: int = 0) -> None:
+        """Seal an object whose value lives on another node (daemon-
+        resident large result): ready for contains/wait immediately,
+        materialized through ``fetch_fn`` on first get (the pull half of
+        the reference's ObjectManager data plane)."""
+        entry = self._entry(object_id)
+        with self._lock:
+            if entry.event.is_set():
+                return
+            entry.remote_fetch = fetch_fn
+            entry.size_bytes = size_bytes
+            entry.create_time = time.time()
+            entry.event.set()
+
+    def is_materialized(self, object_id: ObjectID) -> bool:
+        """True when the value is locally available (not a pending remote
+        fetch) — node death cannot lose a materialized object."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return (entry is not None and entry.event.is_set()
+                    and not entry.freed and entry.remote_fetch is None)
 
     def put_serialized(self, object_id: ObjectID, payload: bytes,
                        is_exception: bool = False) -> None:
@@ -287,8 +316,46 @@ class ObjectStore:
                 # death → reconstruction) may have un-sealed the entry
                 # between the wait and here; loop back and wait for the
                 # reconstructed value instead of reading reset fields.
-                if entry.event.is_set():
-                    break
+                if not entry.event.is_set():
+                    continue
+                fetch = entry.remote_fetch
+                if fetch is not None:
+                    if entry.fetching:
+                        fetch = "busy"  # another getter is pulling
+                    else:
+                        entry.fetching = True
+            if fetch == "busy":
+                # One transfer at a time: wait for the in-flight pull to
+                # memoize (or fail/invalidate), then re-evaluate.
+                time.sleep(0.01)
+                continue
+            if fetch is None:
+                break
+            try:
+                value = fetch()  # network pull, outside any lock
+            except BaseException:
+                with self._lock:
+                    entry.fetching = False
+                    # Node death may have raced us: if the entry was
+                    # invalidated (reconstruction pending) or re-sealed,
+                    # wait for the new value instead of failing the get.
+                    raced = (entry.remote_fetch is not fetch
+                             or not entry.event.is_set())
+                if raced:
+                    continue
+                raise
+            with self._lock:
+                entry.fetching = False
+                if entry.remote_fetch is fetch and not entry.freed:
+                    entry.value = value
+                    entry.deserialized = True
+                    entry.remote_fetch = None
+                    entry.size_bytes = _estimate_size(value)
+                    self._total_bytes += entry.size_bytes
+                    if self._spill_threshold and entry.size_bytes > 0:
+                        self._spill_order[object_id] = None
+            self._maybe_spill()
+            break
         if entry.freed:
             raise ObjectFreedError(
                 f"Object {object_id.hex()} was freed and is no longer available.")
@@ -372,6 +439,7 @@ class ObjectStore:
                         self._total_bytes -= entry.size_bytes
                     entry.value = None
                     entry.serialized = None
+                    entry.remote_fetch = None
                     entry.event.set()
 
     def invalidate(self, object_ids) -> None:
@@ -414,6 +482,7 @@ class ObjectStore:
                 entry.in_native = False
                 entry.size_bytes = 0
                 entry.pinned = False
+                entry.remote_fetch = None
                 entry.event.clear()
 
     def fail_all_pending(self, exc: BaseException) -> None:

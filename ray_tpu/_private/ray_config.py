@@ -35,6 +35,7 @@ _PY_DEFAULTS: Dict[str, Any] = {
     "object_store_full_delay_ms": 100,
     "object_spilling_threshold_bytes": 0,
     "object_spilling_directory": "",
+    "remote_object_inline_limit_bytes": 1 << 20,
     "gc_sweep_interval_ms": 500,
     "health_check_period_ms": 1000,
     "health_check_failure_threshold": 5,

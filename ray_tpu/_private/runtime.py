@@ -310,6 +310,9 @@ class Runtime:
         # (cluster_utils) never appear here.
         self._remote_nodes: Dict[NodeID, Any] = {}
         self._head_server = None
+        # ObjectID → (NodeID, daemon object key) for results resident on
+        # node daemons (fetched lazily; see ObjectStore.put_remote).
+        self._remote_values: Dict[ObjectID, Tuple[NodeID, str]] = {}
         # Lineage: creating TaskSpec per return object, for reconstruction
         # after node loss (reference: task_manager.h TaskResubmissionInterface
         # + object_recovery_manager.h). Bounded; puts are not reconstructable.
@@ -377,10 +380,21 @@ class Runtime:
         if not oids:
             return
         self.store.free(oids)
+        remote_frees = []
         with self._lock:
             for oid in oids:
                 self._lineage.pop(oid, None)
                 self._object_locations.pop(oid, None)
+                rv = self._remote_values.pop(oid, None)
+                if rv is not None:
+                    conn = self._remote_nodes.get(rv[0])
+                    if conn is not None:
+                        remote_frees.append((conn, rv[1]))
+        for conn, key in remote_frees:
+            try:
+                conn.free_object(key)
+            except Exception:  # noqa: BLE001 - best effort
+                pass
 
     def on_ref_deleted(self, oid: ObjectID) -> None:
         """An ObjectRef handle was garbage collected. Runs inside __del__,
@@ -766,11 +780,25 @@ class Runtime:
     # Execution (thread backend: runs in executor threads)
     # ------------------------------------------------------------------
 
-    def _resolve_args(self, spec: TaskSpec):
-        args = [self.store.get(a.object_id()) if isinstance(a, ObjectRef) else a
-                for a in spec.args]
-        kwargs = {k: self.store.get(v.object_id()) if isinstance(v, ObjectRef)
-                  else v for k, v in spec.kwargs.items()}
+    def _resolve_args(self, spec: TaskSpec, conn=None):
+        """Materialize ObjectRef args. With a target daemon connection,
+        arguments whose payload already lives on THAT daemon travel as
+        tiny markers and resolve locally there (plasma-local reads)."""
+        def resolve(a):
+            if not isinstance(a, ObjectRef):
+                return a
+            oid = a.object_id()
+            if conn is not None:
+                with self._lock:
+                    rv = self._remote_values.get(oid)
+                if rv is not None and rv[0] == conn.node_id and \
+                        not self.store.is_materialized(oid):
+                    from ray_tpu._private.multinode import RemoteArgMarker
+                    return RemoteArgMarker(rv[1])
+            return self.store.get(oid)
+
+        args = [resolve(a) for a in spec.args]
+        kwargs = {k: resolve(v) for k, v in spec.kwargs.items()}
         return args, kwargs
 
     def _store_results(self, spec: TaskSpec, result: Any) -> None:
@@ -811,7 +839,11 @@ class Runtime:
             self._store_if_referenced(spec.return_ids[0], item_refs)
             return
         if n == 1:
-            self._store_if_referenced(spec.return_ids[0], result)
+            from ray_tpu._private.multinode import RemoteValueStub
+            if isinstance(result, RemoteValueStub):
+                self._store_remote_result(spec.return_ids[0], result)
+            else:
+                self._store_if_referenced(spec.return_ids[0], result)
             return
         if not isinstance(result, (tuple, list)) or len(result) != n:
             self._store_error(spec, ValueError(
@@ -821,6 +853,28 @@ class Runtime:
             return
         for oid, value in zip(spec.return_ids, result):
             self._store_if_referenced(oid, value)
+
+    def _store_remote_result(self, oid: ObjectID, stub) -> None:
+        """Seal a daemon-resident result as a lazily-fetched store entry
+        (mirrors _store_if_referenced's dropped-handle handling: if nobody
+        can ever read it, free the daemon-side payload instead)."""
+        def drop():
+            try:
+                stub.conn.free_object(stub.key)
+            except Exception:  # noqa: BLE001 - best effort
+                pass
+
+        if not self.refs.has(oid):
+            drop()
+            return
+        with self._lock:
+            self._remote_values[oid] = (stub.conn.node_id, stub.key)
+        self.store.put_remote(oid, stub.fetch, stub.size)
+        if not self.refs.has(oid):
+            with self._lock:
+                self._remote_values.pop(oid, None)
+            self.store.free([oid])
+            drop()
 
     def _store_if_referenced(self, oid: ObjectID, value: Any,
                              is_exception: bool = False) -> None:
@@ -863,7 +917,7 @@ class Runtime:
     def _run_normal_task(self, spec: TaskSpec, worker: Executor) -> None:
         try:
             fn = self.functions.load(spec.function_id)
-            args, kwargs = self._resolve_args(spec)
+            args, kwargs = self._resolve_args(spec, self._remote_conn(spec))
             _task_context.spec = spec
             try:
                 from ray_tpu.util import tracing
@@ -1055,7 +1109,7 @@ class Runtime:
         state = self._actors[spec.actor_id]
         try:
             cls = self.functions.load(spec.function_id)
-            args, kwargs = self._resolve_args(spec)
+            args, kwargs = self._resolve_args(spec, self._remote_conn(spec))
             _task_context.spec = spec
             try:
                 if spec.runtime_env and self._remote_conn(spec) is None:
@@ -1224,12 +1278,15 @@ class Runtime:
             return None
         try:
             from ray_tpu._private.multinode import RemoteActorInstance
+            conn = None
             if isinstance(state.instance, RemoteActorInstance):
-                method = state.instance.bind_method(spec.method_name,
-                                                    spec.name)
+                conn = state.instance.conn
+                method = state.instance.bind_method(
+                    spec.method_name, spec.name,
+                    store_limit=self._result_store_limit(spec))
             else:
                 method = getattr(state.instance, spec.method_name)
-            args, kwargs = self._resolve_args(spec)
+            args, kwargs = self._resolve_args(spec, conn)
         except BaseException as e:  # noqa: BLE001
             self._store_error(spec, TaskError(e, traceback.format_exc(),
                                               spec.name))
@@ -1369,7 +1426,7 @@ class Runtime:
                                     state: ActorState) -> None:
         try:
             cls = self.functions.load(spec.function_id)
-            args, kwargs = self._resolve_args(spec)
+            args, kwargs = self._resolve_args(spec, self._remote_conn(spec))
             instance = self._invoke_actor_init(spec, cls, args, kwargs)
             executor = self._make_actor_executor(state)
             with state.lock:
@@ -1505,6 +1562,13 @@ class Runtime:
         with self._lock:
             return self._remote_nodes.get(node_id)
 
+    def _result_store_limit(self, spec: TaskSpec) -> int:
+        """Results above this size stay daemon-resident (single-return
+        tasks only — a multi-return tuple must come back whole)."""
+        if spec.num_returns != 1:
+            return 0
+        return int(self.config.remote_object_inline_limit_bytes)
+
     def _invoke_user(self, spec: TaskSpec, fn, args, kwargs):
         """The user-code call seam: local nodes call directly; tasks
         placed on a remote daemon proxy the call over its connection
@@ -1512,7 +1576,8 @@ class Runtime:
         conn = self._remote_conn(spec)
         if conn is None:
             return fn(*args, **kwargs)
-        return conn.execute_task(spec, self.functions, args, kwargs)
+        return conn.execute_task(spec, self.functions, args, kwargs,
+                                 store_limit=self._result_store_limit(spec))
 
     def _invoke_actor_init(self, spec: TaskSpec, cls, args, kwargs):
         conn = self._remote_conn(spec)
@@ -1583,6 +1648,7 @@ class Runtime:
             self._handle_actor_node_death(actor, node_id)
         # 3) Lost objects → lineage reconstruction.
         self._recover_lost_objects(node_id)
+        self._recover_remote_values(node_id)
         # 4) PG bundles on the dead node move to live nodes (best effort).
         self.scheduler.reschedule_lost_bundles()
         self._dispatch()
@@ -1679,17 +1745,40 @@ class Runtime:
                     if nid == node_id]
             for oid in lost:
                 self._object_locations.pop(oid, None)
+        # The sim keeps values in the head store with a virtual location;
+        # only sealed ("present") copies count as lost primaries.
+        self._reconstruct_or_seal(
+            lost, node_id,
+            skip=lambda oid: not self.store.contains(oid))
+
+    def _recover_remote_values(self, node_id: NodeID) -> None:
+        """Daemon-resident result payloads die with their daemon: values
+        the head already materialized are safe; the rest reconstruct from
+        lineage (within retry budget) or seal ObjectLostError."""
+        with self._lock:
+            lost = [oid for oid, (nid, _k) in self._remote_values.items()
+                    if nid == node_id]
+            for oid in lost:
+                self._remote_values.pop(oid, None)
+        self._reconstruct_or_seal(lost, node_id,
+                                  skip=self.store.is_materialized)
+
+    def _reconstruct_or_seal(self, lost: List[ObjectID], node_id: NodeID,
+                             skip) -> None:
+        """Shared node-death recovery policy: each lost object either
+        re-executes its creating task from lineage (within retry budget)
+        or seals ObjectLostError (reference: object_recovery_manager.h)."""
         to_reconstruct: Dict[TaskID, TaskSpec] = {}
         plain_lost: List[ObjectID] = []
         for oid in lost:
-            if not self.store.contains(oid):
+            if skip(oid):
                 continue
             spec = self._lineage.get(oid)
             if spec is None or spec.kind == TaskKind.ACTOR_TASK or \
                     getattr(spec, "invalidated", False) or \
                     spec.attempt_number >= spec.max_retries:
-                # No lineage, or the retry budget is spent: reconstruction
-                # would re-run a task the user bounded (reference seals
+                # No lineage (e.g. ray.put or actor-task result), or the
+                # retry budget is spent: unrecoverable (reference seals
                 # ObjectReconstructionFailedError in this case).
                 plain_lost.append(oid)
             else:
@@ -1698,7 +1787,6 @@ class Runtime:
                       for oid in spec.return_ids]
         self.store.invalidate(invalidate)
         for oid in plain_lost:
-            # No lineage (e.g. ray.put or actor-task result): unrecoverable.
             self.store.invalidate([oid])
             self.store.put_inline(oid, ObjectLostError(
                 f"Object {oid.hex()} was on node {node_id.hex()[:12]} which "

@@ -138,7 +138,10 @@ def cmd_start(args) -> int:
     if args.head:
         import ray_tpu
         if not ray_tpu.is_initialized():
-            ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+            ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+                         _memory=args.memory,
+                         resources=(json.loads(args.resources)
+                                    if args.resources else None))
         host, port = ray_tpu.start_head_server(port=args.port)
         print(f"Head node listening for node daemons on {host}:{port}")
         print(f"Join with: ray-tpu start --address <this-host>:{port}")

@@ -78,6 +78,9 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     FLAG_INT(object_store_full_delay_ms, 100),
     FLAG_INT(object_spilling_threshold_bytes, 0),  // 0 = disabled
     FLAG_STR(object_spilling_directory, ""),
+    // Results bigger than this stay in the producing node daemon's store
+    // and are fetched lazily (0 = always return inline).
+    FLAG_INT(remote_object_inline_limit_bytes, 1048576),
     // -- GC / refcounting --
     FLAG_INT(gc_sweep_interval_ms, 500),
     // -- failure detection --

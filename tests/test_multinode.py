@@ -211,3 +211,90 @@ def test_remote_tpu_ids_visible_in_daemon(ray_start_regular):
         if p.poll() is None:
             p.kill()
         p.wait(timeout=10)
+
+
+@pytest.fixture
+def head_small_inline_limit():
+    """Cluster whose remote results above 1000 bytes stay daemon-resident
+    (exercises the lazy-fetch data plane with small test payloads)."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0, _memory=1e9,
+                 _system_config={"remote_object_inline_limit_bytes": 1000})
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    p = _spawn_daemon(port, num_cpus=4, resources={"remote": 4})
+    try:
+        _wait_for_resource("remote", 4)
+        yield port, p
+    finally:
+        if p.poll() is None:
+            p.kill()
+        p.wait(timeout=10)
+        ray_tpu.shutdown()
+
+
+def test_big_results_stay_daemon_resident(head_small_inline_limit):
+    runtime = ray_tpu._private.worker.global_worker.runtime
+
+    @ray_tpu.remote(resources={"remote": 1})
+    def big():
+        return np.arange(100_000)  # ~800KB >> 1000B limit
+
+    ref = big.remote()
+    # the store seals a lazy entry: ready for wait, value not yet local
+    done, _ = ray_tpu.wait([ref], num_returns=1, timeout=30)
+    assert done == [ref]
+    oid = ref.object_id()
+    assert runtime._remote_values.get(oid) is not None
+    assert not runtime.store.is_materialized(oid)
+    # first get pulls it over the wire and memoizes
+    arr = ray_tpu.get(ref)
+    assert int(arr.sum()) == 4999950000
+    assert runtime.store.is_materialized(oid)
+
+
+def test_remote_arg_locality_markers(head_small_inline_limit):
+    """A daemon-resident value passed to a task on the same daemon is
+    resolved locally there, not round-tripped through the head."""
+    @ray_tpu.remote(resources={"remote": 1})
+    def produce():
+        return np.arange(50_000)
+
+    @ray_tpu.remote(resources={"remote": 1})
+    def consume(a):
+        return int(a.sum())
+
+    ref = produce.remote()
+    ray_tpu.wait([ref], timeout=30)
+    runtime = ray_tpu._private.worker.global_worker.runtime
+    oid = ref.object_id()
+    assert oid in runtime._remote_values  # still daemon-resident
+    assert ray_tpu.get(consume.remote(ref)) == 1249975000
+    # the head never materialized it: the arg traveled as a marker
+    assert not runtime.store.is_materialized(oid)
+
+
+def test_daemon_resident_value_reconstructed_on_death(
+        head_small_inline_limit):
+    port, p = head_small_inline_limit
+
+    @ray_tpu.remote(resources={"remote": 1}, max_retries=2)
+    def big(i):
+        return np.full(30_000, i)
+
+    ref = big.remote(7)
+    ray_tpu.wait([ref], timeout=30)
+    runtime = ray_tpu._private.worker.global_worker.runtime
+    assert ref.object_id() in runtime._remote_values
+    # second daemon joins, first dies before the value was fetched
+    p2 = _spawn_daemon(port, num_cpus=4, resources={"remote": 4})
+    try:
+        _wait_for_resource("remote", 8)
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=10)
+        # lineage re-executes the task on the survivor
+        arr = ray_tpu.get(ref, timeout=60)
+        assert arr.shape == (30_000,) and int(arr[0]) == 7
+    finally:
+        if p2.poll() is None:
+            p2.kill()
+        p2.wait(timeout=10)
