@@ -132,7 +132,8 @@ class NodeConnection:
             self._req_counter += 1
             return self._req_counter
 
-    def _request(self, msg: dict, fn_resolver=None) -> dict:
+    def _request(self, msg: dict, fn_resolver=None,
+                 timeout: Optional[float] = None) -> dict:
         """Send a request and block until its reply (or node death).
 
         ``fn_resolver`` (if given) decides the message's fn_bytes field
@@ -162,12 +163,26 @@ class NodeConnection:
             with self._lock:
                 self._pending.pop(req_id, None)
             raise
-        waiter.event.wait()
+        if not waiter.event.wait(timeout):
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise TimeoutError(
+                f"node {self.address} did not reply to "
+                f"{msg.get('type')} within {timeout}s")
         reply = waiter.reply
         if reply is None or reply.get("type") == "died":
             raise RemoteNodeDiedError(
                 f"node {self.address} died while a call was in flight")
         return reply
+
+    def _fire_and_forget(self, msg: dict) -> None:
+        """Send with req_id 0 — the daemon's reply (if any) is dropped by
+        the recv loop. Never blocks on the daemon (GC/teardown paths)."""
+        msg["req_id"] = 0
+        try:
+            _send_frame(self._sock, _dumps(msg), self._send_lock)
+        except OSError:
+            pass  # the daemon (and its state) is gone anyway
 
     def recv_loop(self) -> None:
         """Reply pump; runs on a daemon thread owned by HeadServer."""
@@ -247,18 +262,17 @@ class NodeConnection:
             spec.function_id, functions))
         return self._unpack(reply, spec.name)
 
-    def fetch_object(self, key: str) -> bytes:
-        reply = self._request({"type": "fetch_object", "key": key})
+    def fetch_object(self, key: str,
+                     timeout: Optional[float] = None) -> bytes:
+        reply = self._request({"type": "fetch_object", "key": key},
+                              timeout=timeout)
         if not reply["ok"]:
             exc, remote_tb = _loads(reply["error"])
             raise exc
         return reply["raw"]
 
     def free_object(self, key: str) -> None:
-        try:
-            self._request({"type": "free_object", "key": key})
-        except RemoteNodeDiedError:
-            pass  # the payload died with the daemon
+        self._fire_and_forget({"type": "free_object", "key": key})
 
     def create_actor(self, spec, functions, args, kwargs) -> None:
         reply = self._request({
@@ -286,11 +300,8 @@ class NodeConnection:
         return self._unpack(reply, name)
 
     def destroy_actor(self, actor_id) -> None:
-        try:
-            self._request({"type": "destroy_actor",
-                           "actor_id": actor_id.hex()})
-        except RemoteNodeDiedError:
-            pass  # best effort — the instance dies with the daemon anyway
+        self._fire_and_forget({"type": "destroy_actor",
+                               "actor_id": actor_id.hex()})
 
 
 class RemoteValueStub:
@@ -305,10 +316,10 @@ class RemoteValueStub:
         self.key = key
         self.size = size
 
-    def fetch(self):
+    def fetch(self, timeout=None):
         from ray_tpu.exceptions import ObjectLostError
         try:
-            return _loads(self.conn.fetch_object(self.key))
+            return _loads(self.conn.fetch_object(self.key, timeout=timeout))
         except RemoteNodeDiedError as exc:
             raise ObjectLostError(
                 f"Object payload {self.key} was on node "

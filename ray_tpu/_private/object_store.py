@@ -326,13 +326,28 @@ class ObjectStore:
                         entry.fetching = True
             if fetch == "busy":
                 # One transfer at a time: wait for the in-flight pull to
-                # memoize (or fail/invalidate), then re-evaluate.
+                # memoize (or fail/invalidate), then re-evaluate —
+                # honoring this getter's own deadline.
+                if deadline is not None and time.monotonic() > deadline:
+                    raise GetTimeoutError(
+                        f"Get timed out waiting for remote object "
+                        f"{object_id.hex()} after {timeout}s.")
                 time.sleep(0.01)
                 continue
             if fetch is None:
                 break
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
             try:
-                value = fetch()  # network pull, outside any lock
+                # Network pull, outside any lock; bounded by the caller's
+                # deadline (fetch_fn contract: optional timeout kwarg).
+                value = fetch(timeout=remaining)
+            except TimeoutError:
+                with self._lock:
+                    entry.fetching = False
+                raise GetTimeoutError(
+                    f"Get timed out pulling remote object "
+                    f"{object_id.hex()} after {timeout}s.")
             except BaseException:
                 with self._lock:
                     entry.fetching = False
@@ -354,6 +369,14 @@ class ObjectStore:
                     self._total_bytes += entry.size_bytes
                     if self._spill_threshold and entry.size_bytes > 0:
                         self._spill_order[object_id] = None
+                    raced = False
+                else:
+                    # Invalidate/re-seal won the race: discard this pull
+                    # and wait for the authoritative value (freed entries
+                    # fall through to the freed check below).
+                    raced = not entry.freed
+            if raced:
+                continue
             self._maybe_spill()
             break
         if entry.freed:

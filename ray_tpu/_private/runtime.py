@@ -841,7 +841,7 @@ class Runtime:
         if n == 1:
             from ray_tpu._private.multinode import RemoteValueStub
             if isinstance(result, RemoteValueStub):
-                self._store_remote_result(spec.return_ids[0], result)
+                self._store_remote_result(spec, spec.return_ids[0], result)
             else:
                 self._store_if_referenced(spec.return_ids[0], result)
             return
@@ -854,7 +854,8 @@ class Runtime:
         for oid, value in zip(spec.return_ids, result):
             self._store_if_referenced(oid, value)
 
-    def _store_remote_result(self, oid: ObjectID, stub) -> None:
+    def _store_remote_result(self, spec: TaskSpec, oid: ObjectID,
+                             stub) -> None:
         """Seal a daemon-resident result as a lazily-fetched store entry
         (mirrors _store_if_referenced's dropped-handle handling: if nobody
         can ever read it, free the daemon-side payload instead)."""
@@ -869,7 +870,21 @@ class Runtime:
             return
         with self._lock:
             self._remote_values[oid] = (stub.conn.node_id, stub.key)
+        if getattr(spec, "invalidated", False):
+            # The daemon died between task completion and this seal; the
+            # node-death retry owns the object now — never seal a fetch
+            # against a dead connection.
+            with self._lock:
+                self._remote_values.pop(oid, None)
+            return
         self.store.put_remote(oid, stub.fetch, stub.size)
+        if getattr(spec, "invalidated", False):
+            # remove_node raced the seal: un-seal so the retry (which the
+            # death handler already submitted) writes the real value.
+            with self._lock:
+                self._remote_values.pop(oid, None)
+            self.store.invalidate([oid])
+            return
         if not self.refs.has(oid):
             with self._lock:
                 self._remote_values.pop(oid, None)

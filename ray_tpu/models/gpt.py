@@ -68,6 +68,12 @@ class GPTConfig:
     # recomputed in backward — ~2*d*vocab extra FLOPs/token, a few percent).
     loss_chunk: int = 0
     attn_impl: str = "dot"  # "dot" | "flash" | "ring" | "ulysses"
+    # Flash-attention tile sizes. 512x512 keeps both the Q tile and the
+    # streamed KV tile comfortably in VMEM on v5e (measured ~4% faster
+    # than 1024x1024 on the 410M single-chip recipe); _pick_block clamps
+    # them for short sequences.
+    attn_blk_q: int = 512
+    attn_blk_k: int = 512
     layernorm_eps: float = 1e-5
     # Mixture-of-experts: n_experts > 0 replaces every block's dense FFN
     # with a top-k routed MoE FFN (expert weights sharded over the "ep"
@@ -285,7 +291,8 @@ def _attention(q, k, v, cfg: GPTConfig):
         return _dot_attention(q, k, v, cfg)
     if cfg.attn_impl == "flash":
         from ray_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=True,
+                               blk_q=cfg.attn_blk_q, blk_k=cfg.attn_blk_k)
     if cfg.attn_impl == "ring":
         from ray_tpu.ops.ring_attention import make_ring_attention
         from ray_tpu.parallel.mesh import current_mesh
