@@ -869,22 +869,15 @@ class Runtime:
             drop()
             return
         with self._lock:
+            # Atomic with remove_node's dooming (same lock): either the
+            # node death already invalidated this spec (the retry owns the
+            # object — never seal a fetch against a dead connection), or
+            # the seal lands first and node-death recovery reconstructs
+            # the daemon-resident value.
+            if getattr(spec, "invalidated", False):
+                return
             self._remote_values[oid] = (stub.conn.node_id, stub.key)
-        if getattr(spec, "invalidated", False):
-            # The daemon died between task completion and this seal; the
-            # node-death retry owns the object now — never seal a fetch
-            # against a dead connection.
-            with self._lock:
-                self._remote_values.pop(oid, None)
-            return
-        self.store.put_remote(oid, stub.fetch, stub.size)
-        if getattr(spec, "invalidated", False):
-            # remove_node raced the seal: un-seal so the retry (which the
-            # death handler already submitted) writes the real value.
-            with self._lock:
-                self._remote_values.pop(oid, None)
-            self.store.invalidate([oid])
-            return
+            self.store.put_remote(oid, stub.fetch, stub.size)
         if not self.refs.has(oid):
             with self._lock:
                 self._remote_values.pop(oid, None)
@@ -995,7 +988,8 @@ class Runtime:
             return  # effectively completed; nothing to reclaim by killing
         if not self._try_claim_finalize(spec):
             return  # the worker finalized first
-        spec.invalidated = True
+        with self._lock:  # atomic vs. _store_remote_result's seal
+            spec.invalidated = True
         self._release_task_resources(spec)
         if spec.attempt_number < spec.max_retries:
             retry = spec.clone_for_retry()
@@ -1646,8 +1640,15 @@ class Runtime:
                 and s.kind != TaskKind.ACTOR_CREATION
                 and not (s.return_ids and all(
                     self.store.contains(oid) for oid in s.return_ids))]
+            # Mark INSIDE the lock: _store_remote_result seals results
+            # under the same lock, so a completing remote task either
+            # sealed before this point (→ not doomed, recovery below
+            # reconstructs its daemon-resident value) or observes
+            # invalidated and discards — a stale seal can never shadow
+            # the retry.
+            for s in doomed:
+                s.invalidated = True
         for spec in doomed:
-            spec.invalidated = True
             self._try_claim_finalize(spec)
             # _retry_after_node_death releases the zombie spec's dependency
             # pins AFTER the retry clone re-pins them (releasing first could

@@ -26,6 +26,9 @@ from ray_tpu.rllib.algorithms.registry import get_algorithm_class
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.simple_q import SimpleQ, SimpleQConfig
 from ray_tpu.rllib.algorithms.td3 import TD3, TD3Config
+from ray_tpu.rllib.env import MultiAgentEnv
+from ray_tpu.rllib.evaluation.multi_agent_worker import (
+    MultiAgentRolloutWorker)
 from ray_tpu.rllib.evaluation.rollout_worker import RolloutWorker
 from ray_tpu.rllib.evaluation.worker_set import WorkerSet
 from ray_tpu.rllib.models.catalog import ModelCatalog
@@ -33,7 +36,7 @@ from ray_tpu.rllib.offline import JsonReader, JsonWriter
 from ray_tpu.rllib.policy.jax_policy import JAXPolicy, compute_gae
 from ray_tpu.rllib.policy.q_policy import QPolicy
 from ray_tpu.rllib.policy.sac_policy import SACPolicy
-from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch, SampleBatch
 from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
                                                 ReplayBuffer)
 
@@ -41,7 +44,8 @@ __all__ = ["A2C", "A2CConfig", "A3C", "A3CConfig", "APPO", "APPOConfig",
            "ARS", "ARSConfig", "Algorithm", "AlgorithmConfig", "BC",
            "BCConfig", "CQL", "CQLConfig", "DDPG", "DDPGConfig", "DQN",
            "DQNConfig", "ES", "ESConfig", "Impala", "ImpalaConfig",
-           "JAXPolicy", "JsonReader",
+           "JAXPolicy", "JsonReader", "MultiAgentBatch", "MultiAgentEnv",
+           "MultiAgentRolloutWorker",
            "JsonWriter", "MARWIL", "MARWILConfig", "ModelCatalog", "PG",
            "PGConfig", "PPO", "PPOConfig", "QPolicy",
            "PrioritizedReplayBuffer", "ReplayBuffer", "RolloutWorker",

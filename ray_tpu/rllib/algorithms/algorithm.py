@@ -26,6 +26,9 @@ class Algorithm:
     # Algorithms that run their own rollout/evaluation actors (ES/ARS)
     # instead of the standard WorkerSet set this to keep it empty.
     _own_rollout_actors = False
+    # Algorithms whose learner handles a policy map (PPO today); others
+    # reject config.multi_agent() up front instead of crashing in setup.
+    _supports_multi_agent = False
 
     def __init__(self, config: Optional[AlgorithmConfig] = None, env=None,
                  **kwargs):
@@ -42,9 +45,32 @@ class Algorithm:
         self._env_creator = env_creator
         probe_env = env_creator({})
         from ray_tpu.rllib.policy import make_policy
-        self.local_policy = make_policy(
-            config.policy_config(), probe_env.observation_space,
-            probe_env.action_space, seed=config.seed)
+        self.is_multi_agent = getattr(config, "is_multi_agent", False)
+        if self.is_multi_agent:
+            if not self._supports_multi_agent:
+                raise ValueError(
+                    f"{type(self).__name__} does not support "
+                    "config.multi_agent() yet; multi-agent training is "
+                    "available on PPO.")
+            if config.policy_mapping_fn is None:
+                raise ValueError(
+                    "Multi-agent configs need a policy_mapping_fn: "
+                    "config.multi_agent(policies=..., "
+                    "policy_mapping_fn=lambda agent_id: ...)")
+            from ray_tpu.rllib.evaluation.multi_agent_worker import (
+                resolve_policy_specs)
+            specs = resolve_policy_specs(
+                config.policies, config.policy_mapping_fn, probe_env)
+            self.local_policies = {
+                pid: make_policy(config.policy_config(), obs_space,
+                                 act_space, seed=config.seed + i)
+                for i, (pid, (obs_space, act_space)) in enumerate(
+                    sorted(specs.items()))}
+            self.local_policy = None
+        else:
+            self.local_policy = make_policy(
+                config.policy_config(), probe_env.observation_space,
+                probe_env.action_space, seed=config.seed)
         probe_env.close() if hasattr(probe_env, "close") else None
         self.workers = WorkerSet(
             env_creator, config.policy_config(),
@@ -91,6 +117,10 @@ class Algorithm:
         """Greedy evaluation episodes on a fresh local env (analog of the
         reference's Algorithm.evaluate with an evaluation WorkerSet;
         single-env here since the local policy is the learner copy)."""
+        if self.is_multi_agent:
+            # Joint greedy eval needs per-agent routing; the rollout
+            # workers' episode stats already track joint returns.
+            return self.workers.episode_stats()
         duration = getattr(self.config, "evaluation_duration", 3)
         env = self._env_creator(self.config.env_config)
         from ray_tpu.rllib.connectors import get_connectors
@@ -123,14 +153,29 @@ class Algorithm:
         raise NotImplementedError
 
     def get_weights(self):
+        if self.is_multi_agent:
+            return {pid: p.get_weights()
+                    for pid, p in self.local_policies.items()}
         return self.local_policy.get_weights()
 
     def set_weights(self, weights) -> None:
+        if self.is_multi_agent:
+            for pid, w in weights.items():
+                self.local_policies[pid].set_weights(w)
+            return
         self.local_policy.set_weights(weights)
 
-    def compute_single_action(self, obs, explore: bool = False):
+    def compute_single_action(self, obs, explore: bool = False,
+                              policy_id: Optional[str] = None):
         import jax
-        policy = self.local_policy
+        if self.is_multi_agent:
+            if policy_id is None:
+                raise ValueError(
+                    "Multi-agent algorithms need "
+                    "compute_single_action(obs, policy_id=...)")
+            policy = self.local_policies[policy_id]
+        else:
+            policy = self.local_policy
         obs = np.asarray(obs, np.float32)[None]
         if explore:
             key = jax.random.PRNGKey(int(time.monotonic_ns()) % (2**31))

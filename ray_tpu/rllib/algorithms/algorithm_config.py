@@ -39,6 +39,11 @@ class AlgorithmConfig:
         # evaluation
         self.evaluation_interval: int = 0
         self.evaluation_duration: int = 3
+        # multi-agent (reference: AlgorithmConfig.multi_agent):
+        # policies: {policy_id: (obs_space, act_space) | None (infer from
+        # the env's per-agent spaces)}; policy_mapping_fn: agent_id -> pid.
+        self.policies: Optional[Dict[str, Any]] = None
+        self.policy_mapping_fn: Optional[Callable[[str], str]] = None
         # algo-specific fields live on subclass-free dicts
         self.extra: Dict[str, Any] = {}
 
@@ -112,6 +117,19 @@ class AlgorithmConfig:
             self.input_ = input_
         return self
 
+    def multi_agent(self, *, policies: Optional[Dict[str, Any]] = None,
+                    policy_mapping_fn: Optional[Callable[[str], str]] = None,
+                    **_ignored) -> "AlgorithmConfig":
+        if policies is not None:
+            self.policies = dict(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    @property
+    def is_multi_agent(self) -> bool:
+        return bool(self.policies)
+
     def exploration(self, **kwargs) -> "AlgorithmConfig":
         self.extra.update(kwargs)
         return self
@@ -148,7 +166,14 @@ class AlgorithmConfig:
         return create
 
     def policy_config(self) -> Dict[str, Any]:
+        if self.is_multi_agent and self.policy_mapping_fn is None:
+            raise ValueError(
+                "Multi-agent configs need a policy_mapping_fn: "
+                "config.multi_agent(policies=..., "
+                "policy_mapping_fn=lambda agent_id: ...)")
         return {
+            "policies": self.policies,
+            "policy_mapping_fn": self.policy_mapping_fn,
             "gamma": self.gamma,
             "lambda": self.extra.get("lambda", 0.95),
             "fcnet_hiddens": tuple(self.fcnet_hiddens),

@@ -50,15 +50,17 @@ class PPOConfig(AlgorithmConfig):
 
 class PPO(Algorithm):
     _default_config_class = PPOConfig
+    _supports_multi_agent = True
 
-    def setup(self, config: PPOConfig) -> None:
+    def _build_update(self, policy, config: PPOConfig):
+        """One jitted clipped-surrogate update bound to ``policy``
+        (multi-agent builds one per policy in the map)."""
         import jax
         import jax.numpy as jnp
         import optax
 
-        policy = self.local_policy
-        self._optimizer = optax.adam(config.lr)
-        self._opt_state = self._optimizer.init(policy.params)
+        optimizer = optax.adam(config.lr)
+        opt_state = optimizer.init(policy.params)
         clip = config.clip_param
         vf_coeff = config.vf_loss_coeff
         ent_coeff = config.entropy_coeff
@@ -83,45 +85,72 @@ class PPO(Algorithm):
         def update(params, opt_state, mb):
             (loss, metrics), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, mb)
-            updates, opt_state = self._optimizer.update(grads, opt_state,
-                                                        params)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             metrics["total_loss"] = loss
             return params, opt_state, metrics
 
-        self._update_jit = jax.jit(update)
+        return jax.jit(update), opt_state
 
-    def training_step(self) -> Dict[str, Any]:
+    def setup(self, config: PPOConfig) -> None:
+        if self.is_multi_agent:
+            self._updates = {}
+            self._opt_states = {}
+            for pid, policy in self.local_policies.items():
+                self._updates[pid], self._opt_states[pid] = \
+                    self._build_update(policy, config)
+        else:
+            self._update_jit, self._opt_state = self._build_update(
+                self.local_policy, config)
+
+    def _sgd(self, policy, update_jit, opt_state, batch: SampleBatch,
+             config: PPOConfig) -> tuple:
+        """Minibatch-SGD a policy on its (GAE-complete) batch; returns
+        (opt_state, metrics)."""
         import jax.numpy as jnp
-        config: PPOConfig = self.config
-        weights_ref = __import__("ray_tpu").put(self.get_weights())
-        self.workers.sync_weights(weights_ref)
-        per_worker = max(
-            config.train_batch_size // self.workers.num_workers(), 1)
-        batch = self.workers.sample(per_worker)
-        self._timesteps_total += len(batch)
-
         adv = batch[SampleBatch.ADVANTAGES]
         adv = (adv - adv.mean()) / max(adv.std(), 1e-6)
-        train_arrays = {
+        sb = SampleBatch({
             "obs": batch[SampleBatch.OBS].astype(np.float32),
             "actions": batch[SampleBatch.ACTIONS],
             "old_logp": batch[SampleBatch.ACTION_LOGP].astype(np.float32),
             "advantages": adv.astype(np.float32),
             "value_targets":
                 batch[SampleBatch.VALUE_TARGETS].astype(np.float32),
-        }
-        sb = SampleBatch(train_arrays)
-        params = self.local_policy.params
-        opt_state = self._opt_state
+        })
+        params = policy.params
         last_metrics: Dict[str, Any] = {}
         mb_size = min(config.sgd_minibatch_size, len(sb))
         for epoch in range(config.num_sgd_iter):
             for mb in sb.minibatches(mb_size, seed=epoch):
                 device_mb = {k: jnp.asarray(v) for k, v in mb.items()}
-                params, opt_state, metrics = self._update_jit(
+                params, opt_state, metrics = update_jit(
                     params, opt_state, device_mb)
                 last_metrics = metrics
-        self.local_policy.params = params
-        self._opt_state = opt_state
-        return {k: float(v) for k, v in last_metrics.items()}
+        policy.params = params
+        return opt_state, {k: float(v) for k, v in last_metrics.items()}
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+        config: PPOConfig = self.config
+        weights_ref = ray_tpu.put(self.get_weights())
+        self.workers.sync_weights(weights_ref)
+        per_worker = max(
+            config.train_batch_size // self.workers.num_workers(), 1)
+        batch = self.workers.sample(per_worker)
+        self._timesteps_total += len(batch)
+
+        if self.is_multi_agent:
+            out: Dict[str, Any] = {}
+            for pid, sub in batch.policy_batches.items():
+                self._opt_states[pid], metrics = self._sgd(
+                    self.local_policies[pid], self._updates[pid],
+                    self._opt_states[pid], sub, config)
+                for k, v in metrics.items():
+                    out[f"{pid}/{k}"] = v
+            out["agent_steps_this_iter"] = batch.agent_steps()
+            return out
+        self._opt_state, metrics = self._sgd(
+            self.local_policy, self._update_jit, self._opt_state, batch,
+            config)
+        return metrics

@@ -1,0 +1,3 @@
+from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv
+
+__all__ = ["MultiAgentEnv"]

@@ -18,7 +18,14 @@ class WorkerSet:
     def __init__(self, env_creator: Callable, policy_config: Dict[str, Any],
                  num_workers: int, seed: int = 0,
                  num_cpus_per_worker: float = 1.0):
-        cls = ray_tpu.remote(RolloutWorker)
+        self.is_multi_agent = bool(policy_config.get("policies"))
+        if self.is_multi_agent:
+            from ray_tpu.rllib.evaluation.multi_agent_worker import (
+                MultiAgentRolloutWorker)
+            worker_cls = MultiAgentRolloutWorker
+        else:
+            worker_cls = RolloutWorker
+        cls = ray_tpu.remote(worker_cls)
         self._workers = [
             cls.options(num_cpus=num_cpus_per_worker).remote(
                 env_creator, policy_config, worker_index=i + 1, seed=seed)
@@ -35,9 +42,12 @@ class WorkerSet:
         ray_tpu.get([w.set_weights.remote(weights_ref)
                      for w in self._workers])
 
-    def sample(self, steps_per_worker: int) -> SampleBatch:
+    def sample(self, steps_per_worker: int):
         batches = ray_tpu.get([w.sample.remote(steps_per_worker)
                                for w in self._workers])
+        if self.is_multi_agent:
+            from ray_tpu.rllib.policy.sample_batch import MultiAgentBatch
+            return MultiAgentBatch.concat_samples(batches)
         return SampleBatch.concat_samples(batches)
 
     def episode_stats(self) -> Dict[str, float]:

@@ -77,3 +77,35 @@ class SampleBatch(dict):
                 out.append(self.slice(start, i))
                 start = i
         return out
+
+
+class MultiAgentBatch:
+    """Per-policy SampleBatches from one joint rollout (analog of the
+    reference's policy/sample_batch.py MultiAgentBatch): maps policy id →
+    SampleBatch, with env_steps counting JOINT environment steps (each of
+    which may contribute a row to several policies)."""
+
+    def __init__(self, policy_batches, env_steps: int):
+        self.policy_batches = dict(policy_batches)
+        self.count = int(env_steps)
+
+    def env_steps(self) -> int:
+        return self.count
+
+    def agent_steps(self) -> int:
+        return sum(len(b) for b in self.policy_batches.values())
+
+    def __len__(self) -> int:
+        return self.count
+
+    @staticmethod
+    def concat_samples(batches) -> "MultiAgentBatch":
+        merged = {}
+        steps = 0
+        for batch in batches:
+            steps += batch.count
+            for pid, sb in batch.policy_batches.items():
+                merged.setdefault(pid, []).append(sb)
+        return MultiAgentBatch(
+            {pid: SampleBatch.concat_samples(parts)
+             for pid, parts in merged.items()}, steps)
