@@ -9,6 +9,7 @@ equivalent.
 
 from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithms.a3c import A3C, A3CConfig
+from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.ars import ARS, ARSConfig
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
@@ -41,6 +42,7 @@ from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
                                                 ReplayBuffer)
 
 __all__ = ["A2C", "A2CConfig", "A3C", "A3CConfig", "APPO", "APPOConfig",
+           "ApexDQN", "ApexDQNConfig",
            "ARS", "ARSConfig", "Algorithm", "AlgorithmConfig", "BC",
            "BCConfig", "CQL", "CQLConfig", "DDPG", "DDPGConfig", "DQN",
            "DQNConfig", "ES", "ESConfig", "Impala", "ImpalaConfig",

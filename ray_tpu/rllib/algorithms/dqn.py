@@ -40,13 +40,17 @@ class DQNConfig(AlgorithmConfig):
         self.epsilon_final = 0.02
         self.epsilon_timesteps = 10_000
         self.tau = 1.0  # hard target sync by default
+        self.dueling = False  # dueling value/advantage streams
+        self.n_step = 1  # multi-step returns (learner bootstraps gamma^n)
+        self.per_worker_epsilon = False  # APEX exploration ladder
 
     def training(self, *, replay_buffer_capacity=None,
                  target_network_update_freq=None, double_q=None,
                  prioritized_replay=None, epsilon_timesteps=None,
                  epsilon_final=None, num_train_batches_per_iteration=None,
                  num_steps_sampled_before_learning_starts=None,
-                 tau=None, **kwargs) -> "DQNConfig":
+                 tau=None, dueling=None, n_step=None,
+                 per_worker_epsilon=None, **kwargs) -> "DQNConfig":
         super().training(**kwargs)
         for name, val in (
                 ("replay_buffer_capacity", replay_buffer_capacity),
@@ -59,10 +63,19 @@ class DQNConfig(AlgorithmConfig):
                  num_train_batches_per_iteration),
                 ("num_steps_sampled_before_learning_starts",
                  num_steps_sampled_before_learning_starts),
-                ("tau", tau)):
+                ("tau", tau), ("dueling", dueling), ("n_step", n_step),
+                ("per_worker_epsilon", per_worker_epsilon)):
             if val is not None:
                 setattr(self, name, val)
         return self
+
+    def policy_config(self) -> dict:
+        """DQN-family extensions (dueling heads, APEX epsilon ladder) —
+        kept off the generic base per its algo-specific-fields rule."""
+        base = super().policy_config()
+        base["dueling"] = self.dueling
+        base["per_worker_epsilon"] = self.per_worker_epsilon
+        return base
 
 
 class DQN(Algorithm):
@@ -106,7 +119,10 @@ class DQN(Algorithm):
             else:
                 q_next = q_next_target.max(-1)
             done = jnp.maximum(mb["terminateds"], 0.0)
-            target = mb["rewards"] + gamma * (1.0 - done) * q_next
+            # n-step rows carry their own bootstrap discount gamma^k
+            # (windows cut short at non-terminal boundaries have k < n).
+            disc = mb.get("n_step_discount", gamma)
+            target = mb["rewards"] + disc * (1.0 - done) * q_next
             td = q_taken - jax.lax.stop_gradient(target)
             huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
                               jnp.abs(td) - 0.5)
@@ -156,6 +172,9 @@ class DQN(Algorithm):
                 config.rollout_fragment_length, 1)
             batch = self.workers.sample(per_worker)
         self._timesteps_total += len(batch)
+        if config.n_step > 1:
+            from ray_tpu.rllib.utils.replay_buffers import n_step_transform
+            batch = n_step_transform(batch, config.n_step, config.gamma)
         self._buffer.add(batch)
 
         losses = []
@@ -172,7 +191,8 @@ class DQN(Algorithm):
                     mb = self._buffer.sample(config.train_batch_size)
                 device_mb = {k: jnp.asarray(v) for k, v in mb.items()
                              if k in ("obs", "new_obs", "actions", "rewards",
-                                      "terminateds", "weights")}
+                                      "terminateds", "weights",
+                                      "n_step_discount")}
                 params, self._opt_state, loss, td = self._update_jit(
                     params, self._target_params, self._opt_state, device_mb)
                 losses.append(float(loss))

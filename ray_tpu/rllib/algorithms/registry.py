@@ -9,6 +9,7 @@ from typing import Tuple, Type
 def get_algorithm_class(name: str) -> Type:
     from ray_tpu.rllib.algorithms.a2c import A2C
     from ray_tpu.rllib.algorithms.a3c import A3C
+    from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN
     from ray_tpu.rllib.algorithms.appo import APPO
     from ray_tpu.rllib.algorithms.ars import ARS
     from ray_tpu.rllib.algorithms.bc import BC
@@ -27,6 +28,7 @@ def get_algorithm_class(name: str) -> Type:
     table = {"PPO": PPO, "DQN": DQN, "SAC": SAC, "A2C": A2C, "A3C": A3C,
              "IMPALA": Impala, "TD3": TD3, "BC": BC, "APPO": APPO,
              "PG": PG, "MARWIL": MARWIL, "DDPG": DDPG, "SIMPLEQ": SimpleQ,
+             "APEX": ApexDQN, "APEX-DQN": ApexDQN,
              "ES": ES, "ARS": ARS, "CQL": CQL}
     try:
         return table[name.upper()]

@@ -38,6 +38,16 @@ class RolloutWorker:
                                   seed=seed + worker_index)
         self.obs_connectors, self.action_connectors = get_connectors(
             policy_config, obs_space, self.env.action_space)
+        if policy_config.get("per_worker_epsilon") and \
+                hasattr(self.policy, "epsilon"):
+            # APEX exploration ladder (Horgan et al. 2018): worker i of N
+            # keeps a FIXED epsilon = 0.4^(1 + 7*i/(N-1)) — a spread of
+            # exploration rates instead of one central schedule.
+            n = max(int(policy_config.get("num_workers", 1)), 1)
+            alpha = 7.0
+            frac = (worker_index - 1) / max(n - 1, 1)
+            self.policy.epsilon = 0.4 ** (1.0 + alpha * frac)
+            self.policy.fixed_epsilon = True
         self.gamma = policy_config.get("gamma", 0.99)
         self.lam = policy_config.get("lambda", 0.95)
         self.worker_index = worker_index

@@ -25,6 +25,9 @@ class WorkerSet:
             worker_cls = MultiAgentRolloutWorker
         else:
             worker_cls = RolloutWorker
+        # Workers that derive per-worker state (APEX exploration epsilons)
+        # need to know the fleet size.
+        policy_config = dict(policy_config, num_workers=num_workers)
         cls = ray_tpu.remote(worker_cls)
         self._workers = [
             cls.options(num_cpus=num_cpus_per_worker).remote(

@@ -17,6 +17,7 @@ def make_policy(policy_config: Dict[str, Any], obs_space, action_space,
     model_config = {
         "fcnet_hiddens": policy_config.get("fcnet_hiddens", (64, 64)),
         "conv_filters": policy_config.get("conv_filters"),
+        "dueling": policy_config.get("dueling", False),
     }
     if name == "actor_critic":
         from ray_tpu.rllib.policy.jax_policy import JAXPolicy

@@ -33,18 +33,35 @@ class QPolicy:
         enc_init, self._encode, feat_dim = ModelCatalog.get_encoder(
             obs_space, model_config)
         key = jax.random.PRNGKey(seed)
-        k_enc, k_head = jax.random.split(key)
-        self.params = {
-            "encoder": enc_init(k_enc),
-            "head": mlp_init(k_head, [feat_dim, self.act_dim]),
-        }
+        k_enc, k_head, k_value = jax.random.split(key, 3)
+        if model_config.get("dueling"):
+            # Dueling architecture (reference: dqn dueling=True): separate
+            # state-value and advantage streams, combined with the
+            # mean-advantage identifiability constraint.
+            self.params = {
+                "encoder": enc_init(k_enc),
+                "adv_head": mlp_init(k_head, [feat_dim, self.act_dim]),
+                "value_head": mlp_init(k_value, [feat_dim, 1]),
+            }
+        else:
+            self.params = {
+                "encoder": enc_init(k_enc),
+                "head": mlp_init(k_head, [feat_dim, self.act_dim]),
+            }
         self.epsilon = 1.0
+        # APEX-style per-worker exploration: a fixed epsilon survives
+        # weight broadcasts (set by RolloutWorker when configured).
+        self.fixed_epsilon = False
         self._q_jit = jax.jit(self.q_values)
 
     # -- functional core -------------------------------------------------
 
     def q_values(self, params, obs):
         feats = self._encode(params["encoder"], obs)
+        if "value_head" in params:
+            value = mlp_apply(params["value_head"], feats)
+            adv = mlp_apply(params["adv_head"], feats)
+            return value + adv - adv.mean(-1, keepdims=True)
         return mlp_apply(params["head"], feats)
 
     # -- worker-side API -------------------------------------------------
@@ -74,6 +91,7 @@ class QPolicy:
     def set_weights(self, weights) -> None:
         if isinstance(weights, dict) and "params" in weights:
             self.params = jax.tree.map(jnp.asarray, weights["params"])
-            self.epsilon = float(weights.get("epsilon", self.epsilon))
+            if not self.fixed_epsilon:
+                self.epsilon = float(weights.get("epsilon", self.epsilon))
         else:
             self.params = jax.tree.map(jnp.asarray, weights)

@@ -74,3 +74,55 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         for i, p in zip(idx, priorities):
             self._priorities[int(i)] = float(abs(p)) + 1e-6
             self._max_priority = max(self._max_priority, self._priorities[int(i)])
+
+
+def n_step_transform(batch: "SampleBatch", n: int,
+                     gamma: float) -> "SampleBatch":
+    """Rewrite transitions as n-step returns (reference: rllib's
+    adjust_nstep in replay-buffer utils): reward_t <- sum_i gamma^i
+    r_{t+i}, new_obs_t <- obs after the window, terminated_t <- whether
+    the window hit a terminal. Windows never cross episode boundaries
+    (terminated/truncated/eps_id seams). Windows cut short at a
+    non-terminal boundary cover k < n steps, so each row carries its own
+    bootstrap discount gamma^k in "n_step_discount" — the learner uses it
+    instead of a fixed gamma^n.
+    """
+    if n <= 1:
+        return batch
+    size = len(batch)
+    rewards = np.asarray(batch[SampleBatch.REWARDS], np.float64)
+    terminated = np.asarray(batch[SampleBatch.TERMINATEDS])
+    truncated = batch.get(SampleBatch.TRUNCATEDS)
+    eps_id = batch.get(SampleBatch.EPS_ID)
+    new_obs = np.asarray(batch[SampleBatch.NEXT_OBS])
+
+    def boundary(t):  # episode ends AFTER step t
+        return bool(terminated[t]) or \
+            (truncated is not None and bool(truncated[t])) or \
+            (eps_id is not None and t + 1 < size
+             and eps_id[t] != eps_id[t + 1])
+
+    out_r = np.zeros(size, np.float32)
+    out_disc = np.zeros(size, np.float32)
+    out_new_obs = new_obs.copy()
+    out_term = np.asarray(terminated, np.float32).copy()
+    for t in range(size):
+        acc, disc = 0.0, 1.0
+        for i in range(n):
+            j = t + i
+            if j >= size:
+                break
+            acc += disc * rewards[j]
+            disc *= gamma
+            out_new_obs[t] = new_obs[j]
+            out_term[t] = np.float32(terminated[j])
+            if boundary(j):
+                break
+        out_r[t] = acc
+        out_disc[t] = disc  # gamma^k for the k steps actually covered
+    out = SampleBatch(dict(batch))
+    out[SampleBatch.REWARDS] = out_r
+    out[SampleBatch.NEXT_OBS] = out_new_obs
+    out[SampleBatch.TERMINATEDS] = out_term
+    out["n_step_discount"] = out_disc
+    return out
