@@ -124,6 +124,10 @@ class NodeConnection:
         self._shipped_functions: set = set()
         self.node_id = None  # set at registration
         self._on_death = None
+        # Dedicated liveness socket (see HeadServer._health_check_loop):
+        # pings must not share the data channel — large frames or a full
+        # send buffer would stall them and fake a death (or hide one).
+        self.health_sock: Optional[socket.socket] = None
 
     # -- plumbing --------------------------------------------------------
 
@@ -222,6 +226,11 @@ class NodeConnection:
             self._sock.close()
         except OSError:
             pass
+        if self.health_sock is not None:
+            try:
+                self.health_sock.close()
+            except OSError:
+                pass
 
     # -- user-code proxies ----------------------------------------------
 
@@ -273,6 +282,9 @@ class NodeConnection:
 
     def free_object(self, key: str) -> None:
         self._fire_and_forget({"type": "free_object", "key": key})
+
+    def ping(self, timeout: Optional[float] = None) -> None:
+        self._request({"type": "ping"}, timeout=timeout)
 
     def create_actor(self, spec, functions, args, kwargs) -> None:
         reply = self._request({
@@ -370,10 +382,49 @@ class HeadServer:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ray_tpu-head-server",
             daemon=True)
+        # Liveness probing (reference: gcs_health_check_manager.h — the
+        # GCS health-checks every raylet): EOF catches a dead process,
+        # but a HUNG daemon keeps its socket open; periodic pings with a
+        # miss threshold convert that into node death too.
+        self._hb_period = float(
+            runtime.config.health_check_period_ms) / 1000.0
+        self._hb_threshold = int(
+            runtime.config.health_check_failure_threshold)
+        self._hb_thread = threading.Thread(
+            target=self._health_check_loop, name="ray_tpu-head-health",
+            daemon=True)
 
     def start(self) -> Tuple[str, int]:
         self._accept_thread.start()
+        if self._hb_period > 0:
+            self._hb_thread.start()
         return self.address
+
+    def _health_check_loop(self) -> None:
+        import time
+        misses: Dict[Any, int] = {}
+        while not self._closed:
+            time.sleep(self._hb_period)
+            for node_id, conn in list(self._conns.items()):
+                hc = conn.health_sock
+                if hc is None:
+                    continue  # channel still connecting — grace period
+                try:
+                    # Tiny frames on the dedicated socket: bounded by the
+                    # socket timeout, never queued behind data transfers
+                    # and never contending for the data send lock.
+                    hc.settimeout(self._hb_period * 2)
+                    _send_frame(hc, _dumps({"type": "ping"}))
+                    _loads(_recv_frame(hc))
+                    misses[node_id] = 0
+                except (OSError, ConnectionError, TimeoutError):
+                    misses[node_id] = misses.get(node_id, 0) + 1
+                    if misses[node_id] >= self._hb_threshold:
+                        logger.warning(
+                            "Node %s missed %d health checks; declaring "
+                            "it dead", node_id.hex()[:12],
+                            misses.pop(node_id))
+                        conn.close()  # → on_death → remove_node
 
     def _accept_loop(self) -> None:
         while not self._closed:
@@ -384,16 +435,34 @@ class HeadServer:
             node_id = None
             try:
                 register = _loads(_recv_frame(sock))
+                if register.get("type") == "health_channel":
+                    # Second connection from an already-registered daemon,
+                    # reserved for liveness pings.
+                    for conn in self._conns.values():
+                        if conn.node_id is not None and \
+                                conn.node_id.hex() == register["node_id"]:
+                            conn.health_sock = sock
+                            break
+                    else:
+                        sock.close()
+                    continue
                 assert register["type"] == "register", register
                 conn = NodeConnection(sock, tuple(addr),
                                       register["resources"],
                                       register.get("labels"))
-                node_id = self.runtime.register_remote_node(conn)
-                conn.node_id = node_id
-                conn._on_death = self._on_conn_death
-                self._conns[node_id] = conn
-                _send_frame(sock, _dumps({"type": "registered",
-                                          "node_id": node_id.hex()}))
+                # Registration makes the node schedulable, which can
+                # immediately dispatch queued tasks onto this connection
+                # from worker threads. Hold the send lock across
+                # register+ack so the "registered" handshake is ALWAYS
+                # the first frame the daemon reads — task frames queue
+                # behind it.
+                with conn._send_lock:
+                    node_id = self.runtime.register_remote_node(conn)
+                    conn.node_id = node_id
+                    conn._on_death = self._on_conn_death
+                    self._conns[node_id] = conn
+                    _send_frame(sock, _dumps({"type": "registered",
+                                              "node_id": node_id.hex()}))
             except Exception:  # noqa: BLE001 - one bad join must not
                 # kill the accept thread or strand a half-registered node.
                 if node_id is not None:
@@ -575,6 +644,19 @@ class NodeDaemon:
             except OSError:
                 pass
 
+    def _serve_health_channel(self) -> None:
+        """Dedicated liveness socket: echo pings on a thread of its own,
+        so the head can tell 'process hung' from 'data channel busy'."""
+        try:
+            hc = socket.create_connection(self.head_address)
+            _send_frame(hc, _dumps({"type": "health_channel",
+                                    "node_id": self.node_id_hex}))
+            while not self._stop.is_set():
+                _recv_frame(hc)
+                _send_frame(hc, _dumps({"type": "pong"}))
+        except (ConnectionError, OSError):
+            pass
+
     def _run_in_env(self, msg: dict, fn, args, kwargs):
         # Publish the head-assigned chip ids through the worker context so
         # ray_tpu.get_tpu_ids() works inside remotely executed tasks.
@@ -614,6 +696,9 @@ class NodeDaemon:
         self.node_id_hex = ack["node_id"]
         logger.info("Registered with head %s as node %s",
                     self.head_address, self.node_id_hex[:12])
+        threading.Thread(target=self._serve_health_channel,
+                         name="ray_tpu-daemon-health",
+                         daemon=True).start()
         try:
             while not self._stop.is_set():
                 msg = _loads(_recv_frame(self._sock))
@@ -659,12 +744,16 @@ def _main() -> None:
     parser.add_argument("--memory", type=float, default=float(1 << 30))
     parser.add_argument("--resources", type=str, default=None,
                         help='extra resources as JSON, e.g. \'{"spot": 1}\'')
+    parser.add_argument("--labels", type=str, default=None,
+                        help="node labels as JSON (autoscaler providers "
+                             "tag their nodes here)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     run_node(args.address, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
              memory=args.memory,
              resources=json.loads(args.resources) if args.resources
-             else None)
+             else None,
+             labels=json.loads(args.labels) if args.labels else None)
 
 
 if __name__ == "__main__":

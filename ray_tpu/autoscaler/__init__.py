@@ -1,5 +1,6 @@
 from ray_tpu.autoscaler.autoscaler import LoadMetrics, StandardAutoscaler
-from ray_tpu.autoscaler.node_provider import (FakeMultiNodeProvider,
+from ray_tpu.autoscaler.node_provider import (DaemonProcessNodeProvider,
+                                              FakeMultiNodeProvider,
                                               NodeProvider,
                                               TPUPodNodeProvider)
 
@@ -7,6 +8,7 @@ __all__ = [
     "StandardAutoscaler",
     "LoadMetrics",
     "NodeProvider",
+    "DaemonProcessNodeProvider",
     "FakeMultiNodeProvider",
     "TPUPodNodeProvider",
 ]

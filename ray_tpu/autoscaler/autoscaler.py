@@ -172,7 +172,8 @@ class StandardAutoscaler:
             for node_id in workers:
                 if len(self.workers_of_type(type_name)) <= keep:
                     break
-                idle_since = self.load_metrics.node_idle_since.get(node_id)
+                hex_id = self.provider.runtime_node_hex(node_id) or node_id
+                idle_since = self.load_metrics.node_idle_since.get(hex_id)
                 if idle_since is not None and \
                         now - idle_since > self.idle_timeout_s:
                     self.provider.terminate_node(node_id)

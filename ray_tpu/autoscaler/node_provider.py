@@ -57,25 +57,32 @@ class NodeProvider:
     def external_ip(self, node_id: str) -> str:
         return node_id
 
+    def runtime_node_hex(self, node_id: str) -> Optional[str]:
+        """Map a provider node id to the runtime's NodeID hex (providers
+        whose ids already ARE runtime ids — the virtual providers —
+        return it unchanged)."""
+        return node_id
 
-class FakeMultiNodeProvider(NodeProvider):
-    """Launches virtual nodes into the live in-process cluster."""
+
+class _RecordNodeProvider(NodeProvider):
+    """Shared bookkeeping for providers that track nodes as local records
+    (lock + id→record dict + tag filtering); subclasses define what
+    "alive" means and how nodes are created/terminated."""
 
     def __init__(self, provider_config: Optional[Dict[str, Any]] = None,
-                 cluster_name: str = "fake"):
+                 cluster_name: str = "local"):
         super().__init__(provider_config or {}, cluster_name)
         self._lock = threading.Lock()
         self._nodes: Dict[str, dict] = {}  # provider node id -> record
 
-    def _runtime(self):
-        from ray_tpu._private.worker import global_worker
-        return global_worker.runtime
+    def _is_alive(self, rec: dict) -> bool:
+        raise NotImplementedError
 
     def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
         with self._lock:
             out = []
             for node_id, rec in self._nodes.items():
-                if rec["terminated"]:
+                if not self._is_alive(rec):
                     continue
                 if all(rec["tags"].get(k) == v
                        for k, v in (tag_filters or {}).items()):
@@ -85,7 +92,7 @@ class FakeMultiNodeProvider(NodeProvider):
     def is_running(self, node_id: str) -> bool:
         with self._lock:
             rec = self._nodes.get(node_id)
-            return rec is not None and not rec["terminated"]
+            return rec is not None and self._is_alive(rec)
 
     def node_tags(self, node_id: str) -> Dict[str, str]:
         with self._lock:
@@ -94,6 +101,21 @@ class FakeMultiNodeProvider(NodeProvider):
     def set_node_tags(self, node_id: str, tags: Dict[str, str]) -> None:
         with self._lock:
             self._nodes[node_id]["tags"].update(tags)
+
+
+class FakeMultiNodeProvider(_RecordNodeProvider):
+    """Launches virtual nodes into the live in-process cluster."""
+
+    def __init__(self, provider_config: Optional[Dict[str, Any]] = None,
+                 cluster_name: str = "fake"):
+        super().__init__(provider_config, cluster_name)
+
+    def _runtime(self):
+        from ray_tpu._private.worker import global_worker
+        return global_worker.runtime
+
+    def _is_alive(self, rec: dict) -> bool:
+        return not rec["terminated"]
 
     def create_node(self, node_config: Dict[str, Any],
                     tags: Dict[str, str], count: int) -> None:
@@ -173,3 +195,105 @@ class TPUPodNodeProvider(FakeMultiNodeProvider):
                 host_cfg = {"resources": dict(cfg["resources"])}
                 del host_cfg["resources"][f"TPU-{acc}-head"]
                 super().create_node(host_cfg, slice_tags, 1)
+
+
+class DaemonProcessNodeProvider(_RecordNodeProvider):
+    """Launches REAL node-daemon processes against the live head server
+    (the analog of a cloud provider booting worker VMs that `ray start
+    --address=head` into the cluster): create_node spawns `python -m
+    ray_tpu._private.multinode` subprocesses, terminate_node signals them
+    (non-blocking; SIGKILL escalation on a later reconcile pass) — the
+    head's connection-death handling then removes the node exactly like a
+    cloud instance disappearing."""
+
+    _KILL_GRACE_S = 5.0
+
+    def __init__(self, provider_config: Optional[Dict[str, Any]] = None,
+                 cluster_name: str = "daemons"):
+        super().__init__(provider_config, cluster_name)
+        self._counter = 0
+        self._hex_cache: Dict[str, str] = {}
+        address = self.provider_config.get("head_address")
+        if not address:
+            # Default: open (or reuse) this driver's head server.
+            from ray_tpu._private.worker import (global_worker,
+                                                 start_head_server)
+            if not global_worker.connected:
+                raise RuntimeError(
+                    "DaemonProcessNodeProvider needs ray_tpu.init() first "
+                    "(or an explicit provider_config['head_address'])")
+            host, port = start_head_server(host="127.0.0.1")
+            address = f"127.0.0.1:{port}"
+        self.head_address = address
+
+    def _is_alive(self, rec: dict) -> bool:
+        import time
+        proc = rec["proc"]
+        if proc.poll() is not None:  # also reaps exited children
+            return False
+        # SIGTERM-ignoring daemon: escalate to SIGKILL after the grace.
+        asked = rec.get("terminate_requested")
+        if asked is not None and time.time() - asked > self._KILL_GRACE_S:
+            proc.kill()
+        return True
+
+    def create_node(self, node_config: Dict[str, Any],
+                    tags: Dict[str, str], count: int) -> None:
+        import json
+        import subprocess
+        import sys
+        resources = dict(node_config.get("resources", {"CPU": 1}))
+        num_cpus = float(resources.pop("CPU", 1))
+        num_tpus = float(resources.pop("TPU", 0))
+        memory = float(resources.pop("memory", 1 << 30))
+        for _ in range(count):
+            with self._lock:
+                self._counter += 1
+                provider_id = f"daemon-{self._counter}"
+            cmd = [sys.executable, "-m", "ray_tpu._private.multinode",
+                   "--address", self.head_address,
+                   "--num-cpus", str(num_cpus),
+                   "--num-tpus", str(num_tpus),
+                   "--memory", str(memory),
+                   # The daemon self-labels so the head-side runtime node
+                   # can be matched back to this provider node.
+                   "--labels", json.dumps({"provider_node_id":
+                                           provider_id})]
+            if resources:
+                cmd += ["--resources", json.dumps(resources)]
+            proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                    stderr=subprocess.DEVNULL)
+            node_tags = dict(tags)
+            node_tags.setdefault(TAG_RAY_NODE_STATUS, STATUS_UP_TO_DATE)
+            with self._lock:
+                self._nodes[provider_id] = {
+                    "proc": proc, "tags": node_tags,
+                    "resources": dict(node_config.get("resources", {})),
+                }
+
+    def terminate_node(self, node_id: str) -> None:
+        import time
+        with self._lock:
+            rec = self._nodes.get(node_id)
+            if rec is None:
+                return
+            rec.setdefault("terminate_requested", time.time())
+            proc = rec["proc"]
+        if proc.poll() is None:
+            proc.terminate()  # non-blocking; _is_alive escalates later
+
+    def internal_ip(self, node_id: str) -> str:
+        return "127.0.0.1"
+
+    external_ip = internal_ip
+
+    def runtime_node_hex(self, node_id: str) -> Optional[str]:
+        cached = self._hex_cache.get(node_id)
+        if cached is not None:
+            return cached
+        from ray_tpu._private.worker import global_worker
+        for node in global_worker.runtime.scheduler.nodes_snapshot():
+            pid = node["Labels"].get("provider_node_id")
+            if pid and node["Alive"]:
+                self._hex_cache[pid] = node["NodeID"]
+        return self._hex_cache.get(node_id)

@@ -298,3 +298,67 @@ def test_daemon_resident_value_reconstructed_on_death(
         if p2.poll() is None:
             p2.kill()
         p2.wait(timeout=10)
+
+
+def test_hung_daemon_detected_by_health_checks(ray_start_regular):
+    """A SIGSTOPped daemon keeps its socket open but stops replying; the
+    head's health-check loop (gcs_health_check_manager analog) declares
+    it dead and the node leaves the cluster."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0,
+                 _system_config={"health_check_period_ms": 150,
+                                 "health_check_failure_threshold": 3})
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    p = _spawn_daemon(port, num_cpus=2, resources={"remote": 2})
+    try:
+        _wait_for_resource("remote", 2)
+        p.send_signal(signal.SIGSTOP)  # hung, not dead: TCP stays open
+        deadline = time.monotonic() + 20
+        while ray_tpu.cluster_resources().get("remote", 0) > 0:
+            assert time.monotonic() < deadline, \
+                "health checks never declared the hung daemon dead"
+            time.sleep(0.2)
+    finally:
+        p.send_signal(signal.SIGCONT)
+        p.kill()
+        p.wait(timeout=10)
+        ray_tpu.shutdown()
+
+
+def test_autoscaler_launches_real_daemons(ray_start_regular):
+    """End to end: infeasible demand -> autoscaler launches a REAL daemon
+    process -> the task runs there; idle timeout terminates it."""
+    from ray_tpu.autoscaler import (DaemonProcessNodeProvider,
+                                    StandardAutoscaler)
+
+    provider = DaemonProcessNodeProvider()
+    autoscaler = StandardAutoscaler(provider, {
+        "max_workers": 2,
+        "idle_timeout_minutes": 0.0001,
+        "available_node_types": {
+            "burst-worker": {"resources": {"CPU": 2, "burst": 2},
+                             "min_workers": 0, "max_workers": 2},
+        },
+    })
+
+    @ray_tpu.remote(resources={"burst": 1})
+    def job():
+        import os
+        return os.getpid()
+
+    ref = job.remote()  # infeasible until the autoscaler acts
+    result = autoscaler.update()
+    assert result["launched"] == 1
+    _wait_for_resource("burst", 2)
+    pid = ray_tpu.get(ref, timeout=30)
+    assert pid != os.getpid()
+    # idle node is reaped once the timeout passes
+    deadline = time.monotonic() + 30
+    while autoscaler.num_terminations == 0:
+        assert time.monotonic() < deadline
+        time.sleep(0.3)
+        autoscaler.update()
+    deadline = time.monotonic() + 20
+    while ray_tpu.cluster_resources().get("burst", 0) > 0:
+        assert time.monotonic() < deadline
+        time.sleep(0.2)
