@@ -1,0 +1,72 @@
+"""Multi-process cluster demo: head + node daemons + autoscaler.
+
+Run it directly (spawns its own daemon subprocesses on this machine):
+
+    python examples/multinode_cluster.py
+
+Or run the pieces by hand across hosts:
+
+    # on the head host
+    python -c "import ray_tpu; ray_tpu.init(); \
+               print(ray_tpu.start_head_server(6380))"
+    # on each worker host
+    ray-tpu start --address head-host:6380 --num-cpus 8
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import ray_tpu
+
+
+def wait_for(resource, amount, timeout=30):
+    deadline = time.monotonic() + timeout
+    while ray_tpu.cluster_resources().get(resource, 0) < amount:
+        assert time.monotonic() < deadline, "node never joined"
+        time.sleep(0.2)
+
+
+def main():
+    ray_tpu.init(num_cpus=2)
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    print(f"head listening on {host}:{port}")
+
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.multinode",
+         "--address", f"127.0.0.1:{port}",
+         "--num-cpus", "4", "--resources", '{"worker": 4}'])
+    wait_for("worker", 4)
+    print("node joined:", ray_tpu.cluster_resources())
+
+    @ray_tpu.remote(resources={"worker": 1})
+    def where(x):
+        return os.getpid(), x * x
+
+    results = ray_tpu.get([where.remote(i) for i in range(8)])
+    print("task results (pid, x^2):", results)
+    assert all(pid != os.getpid() for pid, _ in results)
+
+    @ray_tpu.remote(resources={"worker": 1})
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    print("remote actor counts:", ray_tpu.get(
+        [c.bump.remote() for _ in range(3)]))
+
+    daemon.terminate()
+    daemon.wait(timeout=10)
+    time.sleep(1)
+    print("after daemon exit:", ray_tpu.cluster_resources())
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

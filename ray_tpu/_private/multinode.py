@@ -128,6 +128,8 @@ class NodeConnection:
         # pings must not share the data channel — large frames or a full
         # send buffer would stall them and fake a death (or hide one).
         self.health_sock: Optional[socket.socket] = None
+        import time
+        self.registered_at = time.monotonic()
 
     # -- plumbing --------------------------------------------------------
 
@@ -283,9 +285,6 @@ class NodeConnection:
     def free_object(self, key: str) -> None:
         self._fire_and_forget({"type": "free_object", "key": key})
 
-    def ping(self, timeout: Optional[float] = None) -> None:
-        self._request({"type": "ping"}, timeout=timeout)
-
     def create_actor(self, spec, functions, args, kwargs) -> None:
         reply = self._request({
             "type": "create_actor",
@@ -401,13 +400,26 @@ class HeadServer:
         return self.address
 
     def _health_check_loop(self) -> None:
+        """Sequential sweep with per-node socket timeouts: simple and
+        correct for the node counts this head targets; many
+        simultaneously-hung nodes would stretch a sweep (the reference
+        uses per-node async timers for that regime)."""
         import time
         misses: Dict[Any, int] = {}
+        # A daemon that never opens its health channel gets this long
+        # before it's declared unobservable (covers hang-before-connect).
+        channel_grace = self._hb_period * (self._hb_threshold + 5)
         while not self._closed:
             time.sleep(self._hb_period)
             for node_id, conn in list(self._conns.items()):
                 hc = conn.health_sock
                 if hc is None:
+                    if time.monotonic() - conn.registered_at > \
+                            channel_grace:
+                        logger.warning(
+                            "Node %s never opened its health channel; "
+                            "declaring it dead", node_id.hex()[:12])
+                        conn.close()
                     continue  # channel still connecting — grace period
                 try:
                     # Tiny frames on the dedicated socket: bounded by the
@@ -437,8 +449,9 @@ class HeadServer:
                 register = _loads(_recv_frame(sock))
                 if register.get("type") == "health_channel":
                     # Second connection from an already-registered daemon,
-                    # reserved for liveness pings.
-                    for conn in self._conns.values():
+                    # reserved for liveness pings. (Snapshot: recv/health
+                    # threads pop _conns concurrently.)
+                    for conn in list(self._conns.values()):
                         if conn.node_id is not None and \
                                 conn.node_id.hex() == register["node_id"]:
                             conn.health_sock = sock

@@ -213,6 +213,8 @@ class DaemonProcessNodeProvider(_RecordNodeProvider):
         super().__init__(provider_config, cluster_name)
         self._counter = 0
         self._hex_cache: Dict[str, str] = {}
+        self._alive_ids: set = set()
+        self._alive_checked_at = 0.0
         address = self.provider_config.get("head_address")
         if not address:
             # Default: open (or reuse) this driver's head server.
@@ -235,7 +237,32 @@ class DaemonProcessNodeProvider(_RecordNodeProvider):
         asked = rec.get("terminate_requested")
         if asked is not None and time.time() - asked > self._KILL_GRACE_S:
             proc.kill()
+        # Reconcile with the head's view: a daemon the health checks
+        # declared dead (hung process, socket still up) must not keep
+        # counting against max_workers — kill the leftover process.
+        if not rec.get("joined"):
+            if self._runtime_alive(rec["id"]):
+                rec["joined"] = True
+            return True  # still connecting to the head
+        if not self._runtime_alive(rec["id"]):
+            proc.kill()
+            return False
         return True
+
+    def _runtime_alive(self, provider_id: str) -> bool:
+        import time
+        now = time.monotonic()
+        if now - self._alive_checked_at > 1.0:
+            self._alive_ids = set()
+            from ray_tpu._private.worker import global_worker
+            if global_worker.connected:
+                for node in (global_worker.runtime.scheduler
+                             .nodes_snapshot()):
+                    pid = node["Labels"].get("provider_node_id")
+                    if pid and node["Alive"]:
+                        self._alive_ids.add(pid)
+            self._alive_checked_at = now
+        return provider_id in self._alive_ids
 
     def create_node(self, node_config: Dict[str, Any],
                     tags: Dict[str, str], count: int) -> None:
@@ -267,6 +294,7 @@ class DaemonProcessNodeProvider(_RecordNodeProvider):
             node_tags.setdefault(TAG_RAY_NODE_STATUS, STATUS_UP_TO_DATE)
             with self._lock:
                 self._nodes[provider_id] = {
+                    "id": provider_id,
                     "proc": proc, "tags": node_tags,
                     "resources": dict(node_config.get("resources", {})),
                 }
@@ -292,6 +320,10 @@ class DaemonProcessNodeProvider(_RecordNodeProvider):
         if cached is not None:
             return cached
         from ray_tpu._private.worker import global_worker
+        if not global_worker.connected:
+            # A provider pointed at a REMOTE head has no local runtime to
+            # consult — never auto-init a stray local cluster here.
+            return None
         for node in global_worker.runtime.scheduler.nodes_snapshot():
             pid = node["Labels"].get("provider_node_id")
             if pid and node["Alive"]:
