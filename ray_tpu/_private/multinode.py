@@ -411,7 +411,13 @@ class HeadServer:
         channel_grace = self._hb_period * (self._hb_threshold + 5)
         while not self._closed:
             time.sleep(self._hb_period)
-            for node_id, conn in list(self._conns.items()):
+            current = list(self._conns.items())
+            # Departed nodes (EOF path, grace kill) must not leak entries.
+            alive_ids = {nid for nid, _ in current}
+            for nid in list(misses):
+                if nid not in alive_ids:
+                    misses.pop(nid, None)
+            for node_id, conn in current:
                 hc = conn.health_sock
                 if hc is None:
                     if time.monotonic() - conn.registered_at > \
@@ -444,59 +450,71 @@ class HeadServer:
                 sock, addr = self._listener.accept()
             except OSError:
                 return
-            node_id = None
-            try:
-                register = _loads(_recv_frame(sock))
-                if register.get("type") == "health_channel":
-                    # Second connection from an already-registered daemon,
-                    # reserved for liveness pings. (Snapshot: recv/health
-                    # threads pop _conns concurrently.)
-                    for conn in list(self._conns.values()):
-                        if conn.node_id is not None and \
-                                conn.node_id.hex() == register["node_id"]:
-                            conn.health_sock = sock
-                            break
-                    else:
-                        sock.close()
-                    continue
-                assert register["type"] == "register", register
-                conn = NodeConnection(sock, tuple(addr),
-                                      register["resources"],
-                                      register.get("labels"))
-                # Registration makes the node schedulable, which can
-                # immediately dispatch queued tasks onto this connection
-                # from worker threads. Hold the send lock across
-                # register+ack so the "registered" handshake is ALWAYS
-                # the first frame the daemon reads — task frames queue
-                # behind it.
-                with conn._send_lock:
-                    node_id = self.runtime.register_remote_node(conn)
-                    conn.node_id = node_id
-                    conn._on_death = self._on_conn_death
-                    self._conns[node_id] = conn
-                    _send_frame(sock, _dumps({"type": "registered",
-                                              "node_id": node_id.hex()}))
-            except Exception:  # noqa: BLE001 - one bad join must not
-                # kill the accept thread or strand a half-registered node.
-                if node_id is not None:
-                    self._conns.pop(node_id, None)
-                    try:
-                        self.runtime.unregister_remote_node(node_id)
-                    except Exception:  # noqa: BLE001
-                        logger.exception("rollback of failed node "
-                                         "registration failed")
-                try:
+            # Handshake on a short-lived thread with a deadline: one
+            # stalled/silent client (port scanner, half-open socket) must
+            # not block the accept loop — with the health-channel grace
+            # kill, a blocked accept would take down every node whose
+            # channel assignment is pending.
+            threading.Thread(target=self._handshake, args=(sock, addr),
+                             name="ray_tpu-head-handshake",
+                             daemon=True).start()
+
+    def _handshake(self, sock: socket.socket, addr) -> None:
+        node_id = None
+        try:
+            sock.settimeout(15)
+            register = _loads(_recv_frame(sock))
+            sock.settimeout(None)
+            if register.get("type") == "health_channel":
+                # Second connection from an already-registered daemon,
+                # reserved for liveness pings. (Snapshot: recv/health
+                # threads pop _conns concurrently.)
+                for conn in list(self._conns.values()):
+                    if conn.node_id is not None and \
+                            conn.node_id.hex() == register["node_id"]:
+                        conn.health_sock = sock
+                        break
+                else:
                     sock.close()
-                except OSError:
-                    pass
-                continue
-            t = threading.Thread(target=conn.recv_loop,
-                                 name=f"ray_tpu-node-{node_id.hex()[:8]}",
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
-            logger.info("Node daemon %s joined as %s with %s",
-                        addr, node_id.hex()[:12], register["resources"])
+                return
+            assert register["type"] == "register", register
+            conn = NodeConnection(sock, tuple(addr),
+                                  register["resources"],
+                                  register.get("labels"))
+            # Registration makes the node schedulable, which can
+            # immediately dispatch queued tasks onto this connection
+            # from worker threads. Hold the send lock across
+            # register+ack so the "registered" handshake is ALWAYS
+            # the first frame the daemon reads — task frames queue
+            # behind it.
+            with conn._send_lock:
+                node_id = self.runtime.register_remote_node(conn)
+                conn.node_id = node_id
+                conn._on_death = self._on_conn_death
+                self._conns[node_id] = conn
+                _send_frame(sock, _dumps({"type": "registered",
+                                          "node_id": node_id.hex()}))
+        except Exception:  # noqa: BLE001 - one bad join must not
+            # strand a half-registered node.
+            if node_id is not None:
+                self._conns.pop(node_id, None)
+                try:
+                    self.runtime.unregister_remote_node(node_id)
+                except Exception:  # noqa: BLE001
+                    logger.exception("rollback of failed node "
+                                     "registration failed")
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        t = threading.Thread(target=conn.recv_loop,
+                             name=f"ray_tpu-node-{node_id.hex()[:8]}",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        logger.info("Node daemon %s joined as %s with %s",
+                    addr, node_id.hex()[:12], register["resources"])
 
     def _on_conn_death(self, conn: NodeConnection) -> None:
         if self._closed:
@@ -645,8 +663,6 @@ class NodeDaemon:
             elif kind == "free_object":
                 self._objects.pop(msg["key"], None)
                 self._reply(req_id, value=None)
-            elif kind == "ping":
-                self._reply(req_id, value="pong")
             elif kind == "shutdown":
                 self._stop.set()
             else:
@@ -659,16 +675,26 @@ class NodeDaemon:
 
     def _serve_health_channel(self) -> None:
         """Dedicated liveness socket: echo pings on a thread of its own,
-        so the head can tell 'process hung' from 'data channel busy'."""
-        try:
-            hc = socket.create_connection(self.head_address)
-            _send_frame(hc, _dumps({"type": "health_channel",
-                                    "node_id": self.node_id_hex}))
-            while not self._stop.is_set():
-                _recv_frame(hc)
-                _send_frame(hc, _dumps({"type": "pong"}))
-        except (ConnectionError, OSError):
-            pass
+        so the head can tell 'process hung' from 'data channel busy'.
+        The connect retries with backoff — the head declares nodes that
+        never open this channel dead, so one refused connect (listener
+        backlog during a mass join) must not be fatal."""
+        import time
+        backoff = 0.2
+        while not self._stop.is_set():
+            try:
+                hc = socket.create_connection(self.head_address,
+                                              timeout=10)
+                hc.settimeout(None)
+                _send_frame(hc, _dumps({"type": "health_channel",
+                                        "node_id": self.node_id_hex}))
+                while not self._stop.is_set():
+                    _recv_frame(hc)
+                    _send_frame(hc, _dumps({"type": "pong"}))
+                return
+            except (ConnectionError, OSError):
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
 
     def _run_in_env(self, msg: dict, fn, args, kwargs):
         # Publish the head-assigned chip ids through the worker context so

@@ -240,29 +240,36 @@ class DaemonProcessNodeProvider(_RecordNodeProvider):
         # Reconcile with the head's view: a daemon the health checks
         # declared dead (hung process, socket still up) must not keep
         # counting against max_workers — kill the leftover process.
+        alive_ids = self._runtime_alive_ids()
+        if alive_ids is None:
+            return True  # no runtime to consult — liveness unknown
         if not rec.get("joined"):
-            if self._runtime_alive(rec["id"]):
+            if rec["id"] in alive_ids:
                 rec["joined"] = True
             return True  # still connecting to the head
-        if not self._runtime_alive(rec["id"]):
+        if rec["id"] not in alive_ids:
             proc.kill()
             return False
         return True
 
-    def _runtime_alive(self, provider_id: str) -> bool:
+    def _runtime_alive_ids(self):
+        """Alive provider ids per the head's scheduler, memoized ~1s;
+        None when there is no local runtime to consult (a disconnected
+        driver must read as 'unknown', never as 'everything died')."""
         import time
+        from ray_tpu._private.worker import global_worker
+        if not global_worker.connected:
+            self._alive_checked_at = 0.0
+            return None
         now = time.monotonic()
         if now - self._alive_checked_at > 1.0:
             self._alive_ids = set()
-            from ray_tpu._private.worker import global_worker
-            if global_worker.connected:
-                for node in (global_worker.runtime.scheduler
-                             .nodes_snapshot()):
-                    pid = node["Labels"].get("provider_node_id")
-                    if pid and node["Alive"]:
-                        self._alive_ids.add(pid)
+            for node in global_worker.runtime.scheduler.nodes_snapshot():
+                pid = node["Labels"].get("provider_node_id")
+                if pid and node["Alive"]:
+                    self._alive_ids.add(pid)
             self._alive_checked_at = now
-        return provider_id in self._alive_ids
+        return self._alive_ids
 
     def create_node(self, node_config: Dict[str, Any],
                     tags: Dict[str, str], count: int) -> None:

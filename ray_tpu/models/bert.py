@@ -1,0 +1,256 @@
+"""BERT-style bidirectional encoder with a masked-LM head, TPU-first.
+
+The encoder-only family of the model zoo (alongside decoder-only
+GPT/Llama, the T5 encoder-decoder, the ViT vision encoder, and the
+diffusion UNet): learned absolute position + segment embeddings,
+post-layernorm transformer blocks (the original BERT residual order),
+GELU feed-forward, bidirectional self-attention with a padding mask, a
+tied-embedding masked-LM head, and the tanh [CLS] pooler.
+
+Same TPU design rules as models/gpt.py: pure-pytree params with logical
+axis names for GSPMD sharding, `lax.scan` over stacked layers (O(1)
+compile), bf16 matmuls with fp32 softmax/norm accumulation, static
+shapes throughout.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ray_tpu.parallel.sharding import ShardingRules
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_heads: int = 12
+    d_ff: int = 3072
+    n_layers: int = 12
+    max_seq_len: int = 512
+    n_segments: int = 2
+    layernorm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def num_params(self) -> int:
+        d, f = self.d_model, self.d_ff
+        attn = 4 * d * d + 4 * d  # qkvo weights + biases
+        ffn = 2 * d * f + f + d
+        block = attn + ffn + 4 * d  # + two layernorms (scale, bias)
+        embeds = (self.vocab_size + self.max_seq_len
+                  + self.n_segments) * d + 2 * d  # + embedding layernorm
+        pooler = d * d + d
+        mlm = d * d + d + 2 * d + self.vocab_size  # transform+ln+bias
+        return embeds + self.n_layers * block + pooler + mlm
+
+
+PRESETS: Dict[str, BertConfig] = {
+    "bert-base": BertConfig(),
+    "bert-large": BertConfig(d_model=1024, n_heads=16, d_ff=4096,
+                             n_layers=24),
+    "bert-tiny": BertConfig(vocab_size=256, d_model=64, n_heads=4,
+                            d_ff=128, n_layers=2, max_seq_len=64,
+                            dtype=jnp.float32, remat=False),
+}
+
+
+def config(name: str, **overrides) -> BertConfig:
+    cfg = PRESETS[name]
+    return replace(cfg, **overrides) if overrides else cfg
+
+
+# -- init + sharding specs ----------------------------------------------
+
+
+def init(cfg: BertConfig, key: jax.Array) -> Dict[str, Any]:
+    d, f, h, hd = cfg.d_model, cfg.d_ff, cfg.n_heads, cfg.head_dim
+    pd = cfg.param_dtype
+    std = 0.02
+    keys = jax.random.split(key, 8)
+
+    def norm(k, shape, s=std):
+        return (jax.random.normal(k, shape, jnp.float32) * s).astype(pd)
+
+    def layer(k):
+        ks = jax.random.split(k, 7)
+        return {
+            "wq": norm(ks[0], (d, h, hd)),
+            "wk": norm(ks[1], (d, h, hd)),
+            "wv": norm(ks[2], (d, h, hd)),
+            "wo": norm(ks[3], (h, hd, d)),
+            "bq": jnp.zeros((h, hd), pd), "bk": jnp.zeros((h, hd), pd),
+            "bv": jnp.zeros((h, hd), pd), "bo": jnp.zeros((d,), pd),
+            "ln1_s": jnp.ones((d,), pd), "ln1_b": jnp.zeros((d,), pd),
+            "wi": norm(ks[4], (d, f)), "bi": jnp.zeros((f,), pd),
+            "wo_ff": norm(ks[5], (f, d)), "bo_ff": jnp.zeros((d,), pd),
+            "ln2_s": jnp.ones((d,), pd), "ln2_b": jnp.zeros((d,), pd),
+        }
+
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[layer(k) for k in jax.random.split(keys[0], cfg.n_layers)])
+    return {
+        "wte": norm(keys[1], (cfg.vocab_size, d)),
+        "wpe": norm(keys[2], (cfg.max_seq_len, d)),
+        "wse": norm(keys[3], (cfg.n_segments, d)),
+        "emb_ln_s": jnp.ones((d,), pd), "emb_ln_b": jnp.zeros((d,), pd),
+        "layers": stacked,
+        "pooler_w": norm(keys[4], (d, d)), "pooler_b": jnp.zeros((d,), pd),
+        "mlm_w": norm(keys[5], (d, d)), "mlm_b": jnp.zeros((d,), pd),
+        "mlm_ln_s": jnp.ones((d,), pd), "mlm_ln_b": jnp.zeros((d,), pd),
+        "mlm_bias": jnp.zeros((cfg.vocab_size,), pd),
+    }
+
+
+def param_specs(cfg: BertConfig, rules: ShardingRules) -> Dict[str, Any]:
+    r = rules
+    layers = {
+        "wq": r.spec("layers", "embed", "heads", "head_dim"),
+        "wk": r.spec("layers", "embed", "heads", "head_dim"),
+        "wv": r.spec("layers", "embed", "heads", "head_dim"),
+        "wo": r.spec("layers", "heads", "head_dim", "embed"),
+        "bq": r.spec("layers", "heads", "head_dim"),
+        "bk": r.spec("layers", "heads", "head_dim"),
+        "bv": r.spec("layers", "heads", "head_dim"),
+        "bo": r.spec("layers", "embed"),
+        "ln1_s": r.spec("layers", None), "ln1_b": r.spec("layers", None),
+        "wi": r.spec("layers", "embed", "mlp"),
+        "bi": r.spec("layers", "mlp"),
+        "wo_ff": r.spec("layers", "mlp", "embed"),
+        "bo_ff": r.spec("layers", "embed"),
+        "ln2_s": r.spec("layers", None), "ln2_b": r.spec("layers", None),
+    }
+    return {
+        "wte": r.spec("vocab", "embed"),
+        "wpe": r.spec(None, "embed"),
+        "wse": r.spec(None, "embed"),
+        "emb_ln_s": PartitionSpec(), "emb_ln_b": PartitionSpec(),
+        "layers": layers,
+        "pooler_w": r.spec("embed", None), "pooler_b": PartitionSpec(),
+        "mlm_w": r.spec("embed", None), "mlm_b": PartitionSpec(),
+        "mlm_ln_s": PartitionSpec(), "mlm_ln_b": PartitionSpec(),
+        "mlm_bias": r.spec("vocab"),
+    }
+
+
+def batch_spec(rules: ShardingRules) -> PartitionSpec:
+    return rules.spec("batch", "sequence")
+
+
+# -- forward -------------------------------------------------------------
+
+
+def _layernorm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = ((x32 - mu) ** 2).mean(-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (out * scale + bias).astype(x.dtype)
+
+
+def _attention(x, layer, cfg: BertConfig, mask_bias):
+    q = jnp.einsum("bsd,dhk->bshk", x, layer["wq"].astype(cfg.dtype)) + \
+        layer["bq"].astype(cfg.dtype)
+    k = jnp.einsum("bsd,dhk->bshk", x, layer["wk"].astype(cfg.dtype)) + \
+        layer["bk"].astype(cfg.dtype)
+    v = jnp.einsum("bsd,dhk->bshk", x, layer["wv"].astype(cfg.dtype)) + \
+        layer["bv"].astype(cfg.dtype)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(cfg.head_dim)
+    logits = logits.astype(jnp.float32) + mask_bias  # fp32 softmax
+    probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return jnp.einsum("bqhd,hdm->bqm", ctx,
+                      layer["wo"].astype(cfg.dtype)) + \
+        layer["bo"].astype(cfg.dtype)
+
+
+def encode(params, cfg: BertConfig, tokens: jax.Array,
+           segment_ids: Optional[jax.Array] = None,
+           attention_mask: Optional[jax.Array] = None) -> jax.Array:
+    """→ [B, S, d_model] contextual embeddings. ``attention_mask`` is 1
+    for real tokens, 0 for padding (padding positions are excluded from
+    every token's attention)."""
+    B, S = tokens.shape
+    if segment_ids is None:
+        segment_ids = jnp.zeros_like(tokens)
+    if attention_mask is None:
+        attention_mask = jnp.ones_like(tokens)
+    x = (jnp.take(params["wte"], tokens, axis=0)
+         + params["wpe"][None, :S]
+         + jnp.take(params["wse"], segment_ids, axis=0))
+    x = _layernorm(x.astype(cfg.dtype), params["emb_ln_s"],
+                   params["emb_ln_b"], cfg.layernorm_eps)
+    # [B, 1, 1, S] additive bias: -inf on padding keys.
+    mask_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
+                          jnp.float32(-1e9))
+
+    def block(x, layer):
+        # Post-LN residual order (original BERT): LN(x + sublayer(x)).
+        attn = _attention(x, layer, cfg, mask_bias)
+        x = _layernorm(x + attn, layer["ln1_s"], layer["ln1_b"],
+                       cfg.layernorm_eps)
+        hidden = jax.nn.gelu(
+            jnp.einsum("bsd,df->bsf", x, layer["wi"].astype(cfg.dtype))
+            + layer["bi"].astype(cfg.dtype))
+        ffn = jnp.einsum("bsf,fd->bsd", hidden,
+                         layer["wo_ff"].astype(cfg.dtype)) + \
+            layer["bo_ff"].astype(cfg.dtype)
+        x = _layernorm(x + ffn, layer["ln2_s"], layer["ln2_b"],
+                       cfg.layernorm_eps)
+        return x, None
+
+    if cfg.remat:
+        block = jax.checkpoint(block)
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    return x
+
+
+def mlm_logits(params, cfg: BertConfig, tokens: jax.Array,
+               segment_ids: Optional[jax.Array] = None,
+               attention_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Masked-LM head over the tied embedding → [B, S, vocab] (fp32)."""
+    x = encode(params, cfg, tokens, segment_ids, attention_mask)
+    x = jax.nn.gelu(
+        jnp.einsum("bsd,de->bse", x, params["mlm_w"].astype(cfg.dtype))
+        + params["mlm_b"].astype(cfg.dtype))
+    x = _layernorm(x, params["mlm_ln_s"], params["mlm_ln_b"],
+                   cfg.layernorm_eps)
+    logits = jnp.einsum("bsd,vd->bsv", x.astype(jnp.float32),
+                        params["wte"].astype(jnp.float32))
+    return logits + params["mlm_bias"].astype(jnp.float32)
+
+
+def pooled(params, cfg: BertConfig, tokens: jax.Array,
+           segment_ids: Optional[jax.Array] = None,
+           attention_mask: Optional[jax.Array] = None) -> jax.Array:
+    """The tanh [CLS] pooler → [B, d_model] (sentence representation)."""
+    x = encode(params, cfg, tokens, segment_ids, attention_mask)
+    cls = x[:, 0].astype(jnp.float32)
+    return jnp.tanh(cls @ params["pooler_w"].astype(jnp.float32)
+                    + params["pooler_b"].astype(jnp.float32))
+
+
+def mlm_loss(params, cfg: BertConfig, tokens: jax.Array,
+             targets: jax.Array, mask_positions: jax.Array,
+             attention_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Mean cross-entropy over masked positions (mask_positions is 1
+    where a token was masked and must be predicted)."""
+    logits = mlm_logits(params, cfg, tokens,
+                        attention_mask=attention_mask)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, targets[..., None].astype(jnp.int32), -1)[..., 0]
+    weights = mask_positions.astype(jnp.float32)
+    return -(picked * weights).sum() / jnp.maximum(weights.sum(), 1.0)
