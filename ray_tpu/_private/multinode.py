@@ -130,6 +130,12 @@ class NodeConnection:
         self.health_sock: Optional[socket.socket] = None
         import time
         self.registered_at = time.monotonic()
+        # Chaos injection (reference: RAY_testing_* fault flags): each
+        # request fails with this probability — exercised by the chaos
+        # tests to prove retries survive a flaky control plane.
+        self.rpc_failure_pct = 0
+        import random
+        self._chaos_rng = random.Random(0xC4A05)
 
     # -- plumbing --------------------------------------------------------
 
@@ -261,6 +267,15 @@ class NodeConnection:
 
     def execute_task(self, spec, functions, args, kwargs,
                      store_limit: int = 0) -> Any:
+        # Chaos fires ONLY here: the normal-task submit path absorbs the
+        # injected failure through the system-retry budget. Actor calls,
+        # creation, and fetches have no per-request retry to hide behind,
+        # so injecting there would turn chaos into user-visible errors.
+        if self.rpc_failure_pct and \
+                self._chaos_rng.random() * 100 < self.rpc_failure_pct:
+            raise RemoteNodeDiedError(
+                f"injected RPC failure (testing_rpc_failure_pct="
+                f"{self.rpc_failure_pct})")
         reply = self._request({
             "type": "execute_task",
             "fn_id": spec.function_id,
@@ -487,6 +502,8 @@ class HeadServer:
             # register+ack so the "registered" handshake is ALWAYS
             # the first frame the daemon reads — task frames queue
             # behind it.
+            conn.rpc_failure_pct = int(
+                self.runtime.config.testing_rpc_failure_pct)
             with conn._send_lock:
                 node_id = self.runtime.register_remote_node(conn)
                 conn.node_id = node_id

@@ -362,3 +362,29 @@ def test_autoscaler_launches_real_daemons(ray_start_regular):
     while ray_tpu.cluster_resources().get("burst", 0) > 0:
         assert time.monotonic() < deadline
         time.sleep(0.2)
+
+
+def test_rpc_chaos_injection_survived_by_retries(ray_start_regular):
+    """testing_rpc_failure_pct makes control-plane requests randomly
+    fail; task retries absorb it (reference: RAY_testing_* chaos flags
+    exercised against a flaky RPC layer)."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0,
+                 _system_config={"testing_rpc_failure_pct": 20})
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    p = _spawn_daemon(port, num_cpus=4, resources={"remote": 4})
+    try:
+        _wait_for_resource("remote", 4)
+
+        @ray_tpu.remote(resources={"remote": 1}, max_retries=10)
+        def flaky_path(i):
+            return i * 3
+
+        out = ray_tpu.get([flaky_path.remote(i) for i in range(20)],
+                          timeout=120)
+        assert out == [i * 3 for i in range(20)]
+    finally:
+        if p.poll() is None:
+            p.kill()
+        p.wait(timeout=10)
+        ray_tpu.shutdown()
