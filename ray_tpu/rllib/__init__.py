@@ -1,10 +1,14 @@
 """ray_tpu.rllib: reinforcement learning on the actor runtime, JAX-first.
 
-Subset of the reference's rllib (SURVEY.md §2.6): Algorithm/AlgorithmConfig
-driver, WorkerSet rollout actors (CPU envs), JAXPolicy actor-critic
-compiled by XLA, PPO, SampleBatch, replay buffers. The learner update is a
-jitted functional step — pjit over a learner mesh is the multi-GPU-learner
-equivalent.
+The RL stack of the framework (reference: rllib, SURVEY.md §2.6):
+Algorithm/AlgorithmConfig driver, WorkerSet rollout actors (CPU envs),
+JAX policies compiled by XLA, 17 algorithms (PPO/APPO/DQN/APEX-DQN/
+SimpleQ/SAC/TD3/DDPG/CQL/A2C/A3C/IMPALA/PG/BC/MARWIL/ES/ARS),
+multi-agent training (MultiAgentEnv + policy maps), the new-stack
+core/ (RLModule/Learner/LearnerGroup — SPMD pjit or remote-actor
+data-parallel learners), connectors, offline JSON IO, replay buffers
+(prioritized + n-step), and the model catalog. Every learner update is a
+jitted functional step.
 """
 
 from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
