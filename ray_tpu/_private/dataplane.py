@@ -1,0 +1,271 @@
+"""Node-to-node object data plane.
+
+The distributed half of the plasma analog (reference:
+src/ray/object_manager/object_manager.h:117 node-to-node chunked pulls;
+plasma/client.cc cross-process shared memory). Each node daemon owns
+
+* a **NodeObjectTable** — the node's local object storage. Payloads go
+  into the native shared-memory arena (src/ray_tpu_native/shm_store.cc)
+  when it is available, so *worker processes on the same host attach the
+  arena by name and read zero-copy*; a plain heap dict is the fallback.
+* an **ObjectServer** — a TCP listener serving chunked object pulls to
+  peer daemons (reference: ObjectManagerService gRPC chunked transfer,
+  default 5MB chunks, pull_manager.h).
+
+Task arguments whose payload lives on another daemon travel as an
+:class:`ObjectMarker` naming the owner's object-server address; the
+executing daemon pulls the bytes **directly from the peer** — zero bytes
+transit the head. Pulled objects are cached in the local table, so
+subsequent tasks on the same node resolve locally (the locality property
+plasma gets from node-resident copies).
+
+Transfer accounting (``pulled_bytes`` / ``served_bytes`` per node,
+exposed through the daemon stats channel) exists so tests can assert the
+head really is out of the data path.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">q")  # signed: -1 = not found
+CHUNK_SIZE = 4 << 20  # reference: object_manager default chunk ~5MB
+
+
+class ObjectPullError(ConnectionError):
+    """A node-to-node object pull failed (owner unreachable or the object
+    is gone). The head treats this as a SYSTEM failure — the task retries
+    within its system budget while object reconstruction re-runs the
+    producing task (reference: pull retry + object_recovery_manager)."""
+
+
+class ObjectMarker:
+    """Wire marker for a task argument resident in a node object table.
+
+    ``owner_addr is None`` means "local to the target daemon" (the
+    plasma-local read). Otherwise the executing daemon pulls from
+    ``owner_addr`` (a peer daemon's object server)."""
+
+    __slots__ = ("key", "owner_addr", "size")
+
+    def __init__(self, key: str, owner_addr: Optional[Tuple[str, int]] = None,
+                 size: int = 0):
+        self.key = key
+        self.owner_addr = owner_addr
+        self.size = size
+
+
+class NodeObjectTable:
+    """Local object storage for one node: shm arena preferred (so sibling
+    worker processes map payloads zero-copy), heap dict fallback."""
+
+    def __init__(self, capacity: int = 0, arena_name: Optional[str] = None):
+        self._heap: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        self._arena = None
+        self.stats = {"pulled_bytes": 0, "served_bytes": 0,
+                      "pulls": 0, "serves": 0}
+        if capacity > 0:
+            try:
+                from ray_tpu._private.native_store import NativeObjectStore
+                self._arena = NativeObjectStore(capacity=capacity,
+                                                name=arena_name)
+            except Exception:  # noqa: BLE001 - no compiler → heap fallback
+                self._arena = None
+
+    @property
+    def arena_name(self) -> Optional[str]:
+        return self._arena.name if self._arena is not None else None
+
+    def put(self, key: str, payload: bytes) -> None:
+        if self._arena is not None and self._arena.put_bytes(key, payload):
+            return
+        with self._lock:
+            self._heap[key] = bytes(payload)
+
+    def get(self, key: str) -> Optional[bytes]:
+        """Payload bytes (a zero-copy shm view when arena-resident)."""
+        if self._arena is not None:
+            view = self._arena.get_bytes(key)
+            if view is not None:
+                return view
+        with self._lock:
+            return self._heap.get(key)
+
+    def contains(self, key: str) -> bool:
+        if self._arena is not None and self._arena.contains(key):
+            return True
+        with self._lock:
+            return key in self._heap
+
+    def free(self, key: str) -> None:
+        if self._arena is not None:
+            # Release the ref a prior get() may hold, then drop the entry.
+            try:
+                self._arena.release(key)
+            except Exception:  # noqa: BLE001
+                pass
+            self._arena.delete(key)
+        with self._lock:
+            self._heap.pop(key, None)
+
+    def keys(self):
+        with self._lock:
+            heap_keys = list(self._heap)
+        return heap_keys  # arena keys are not enumerable; callers track
+
+    def recv_into(self, key: str, size: int, sock: socket.socket) -> None:
+        """Stream ``size`` bytes from ``sock`` into the table — straight
+        into the shm arena when possible (no full-size heap staging)."""
+        if self._arena is not None:
+            off = self._arena.create(key, size)
+            if off is not None:
+                written = 0
+                try:
+                    while written < size:
+                        chunk = sock.recv(min(CHUNK_SIZE, size - written))
+                        if not chunk:
+                            raise ConnectionError(
+                                "peer closed mid-transfer")
+                        self._arena.write_at(off + written, chunk)
+                        written += len(chunk)
+                except BaseException:
+                    # Seal-then-free: an unsealed entry would leak.
+                    self._arena.seal(key)
+                    self._arena.delete(key)
+                    raise
+                self._arena.seal(key)
+                return
+        buf = bytearray(size)
+        view = memoryview(buf)
+        read = 0
+        while read < size:
+            n = sock.recv_into(view[read:], min(CHUNK_SIZE, size - read))
+            if n == 0:
+                raise ConnectionError("peer closed mid-transfer")
+            read += n
+        with self._lock:
+            self._heap[key] = bytes(buf)
+
+    def close(self) -> None:
+        if self._arena is not None:
+            try:
+                self._arena.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._arena = None
+        self._heap.clear()
+
+
+class ObjectServer:
+    """Serves chunked object pulls from this node's table to peers.
+
+    Protocol (one request per connection, like one chunked gRPC stream):
+    client sends a length-prefixed key; server replies an 8-byte signed
+    size (-1 = not here), then the raw payload."""
+
+    def __init__(self, table: NodeObjectTable, host: str = "0.0.0.0"):
+        self.table = table
+        self._listener = socket.create_server((host, 0))
+        self.port = self._listener.getsockname()[1]
+        self._closed = False
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="ray_tpu-object-server",
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_one, args=(sock,),
+                             daemon=True).start()
+
+    def _serve_one(self, sock: socket.socket) -> None:
+        try:
+            sock.settimeout(30)
+            (klen,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+            key = _recv_exact(sock, klen).decode()
+            payload = self.table.get(key)
+            if payload is None:
+                sock.sendall(_LEN.pack(-1))
+                return
+            size = len(payload)
+            sock.sendall(_LEN.pack(size))
+            view = memoryview(payload)
+            sent = 0
+            while sent < size:
+                n = sock.send(view[sent:sent + CHUNK_SIZE])
+                sent += n
+            self.table.stats["served_bytes"] += size
+            self.table.stats["serves"] += 1
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
+                timeout: float = 30.0, retries: int = 2) -> bytes:
+    """Pull one object from a peer's object server into the local table
+    and return its payload. Retries transient connect failures; raises
+    ObjectPullError when the owner is unreachable or lacks the object."""
+    last: Optional[BaseException] = None
+    for _ in range(retries + 1):
+        try:
+            with socket.create_connection(tuple(addr),
+                                          timeout=timeout) as sock:
+                sock.settimeout(timeout)
+                kb = key.encode()
+                sock.sendall(_LEN.pack(len(kb)) + kb)
+                (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+                if size < 0:
+                    raise ObjectPullError(
+                        f"object {key} is not resident on {addr} "
+                        "(freed or evicted before the pull)")
+                table.recv_into(key, size, sock)
+                table.stats["pulled_bytes"] += size
+                table.stats["pulls"] += 1
+                payload = table.get(key)
+                if payload is None:  # arena evicted it under pressure
+                    raise ObjectPullError(
+                        f"object {key} was evicted immediately after "
+                        "the pull (store too small?)")
+                return payload
+        except ObjectPullError as exc:
+            raise exc
+        except (OSError, ConnectionError) as exc:
+            last = exc
+            import time
+            time.sleep(0.2)
+    raise ObjectPullError(
+        f"pull of {key} from {addr} failed after {retries + 1} "
+        f"attempts: {last}")

@@ -111,11 +111,17 @@ class NodeConnection:
     rpc client with a ClientCallManager)."""
 
     def __init__(self, sock: socket.socket, address: Tuple[str, int],
-                 resources: Dict[str, float], labels: Optional[dict]):
+                 resources: Dict[str, float], labels: Optional[dict],
+                 object_addr: Optional[Tuple[str, int]] = None,
+                 store_name: Optional[str] = None):
         self._sock = sock
         self.address = address
         self.resources = resources
         self.labels = labels or {}
+        # The daemon's object-server endpoint (peer-to-peer data plane)
+        # and shm arena name (same-host zero-copy attach).
+        self.object_addr = tuple(object_addr) if object_addr else None
+        self.store_name = store_name
         self._send_lock = threading.Lock()
         self._lock = threading.Lock()
         self._pending: Dict[int, _Pending] = {}
@@ -136,6 +142,10 @@ class NodeConnection:
         self.rpc_failure_pct = 0
         import random
         self._chaos_rng = random.Random(0xC4A05)
+        # Bytes of object payload that transited the HEAD for this node
+        # (driver gets). Node-to-node pulls never touch this counter —
+        # tests assert the head is out of the task-arg data path.
+        self.head_fetch_bytes = 0
 
     # -- plumbing --------------------------------------------------------
 
@@ -295,6 +305,7 @@ class NodeConnection:
         if not reply["ok"]:
             exc, remote_tb = _loads(reply["error"])
             raise exc
+        self.head_fetch_bytes += len(reply["raw"])
         return reply["raw"]
 
     def free_object(self, key: str) -> None:
@@ -328,6 +339,11 @@ class NodeConnection:
     def destroy_actor(self, actor_id) -> None:
         self._fire_and_forget({"type": "destroy_actor",
                                "actor_id": actor_id.hex()})
+
+    def get_stats(self, timeout: Optional[float] = 10.0) -> dict:
+        """Daemon-side counters (object-transfer bytes, actor count)."""
+        reply = self._request({"type": "stats"}, timeout=timeout)
+        return _loads(reply["value"])
 
 
 class RemoteValueStub:
@@ -495,7 +511,9 @@ class HeadServer:
             assert register["type"] == "register", register
             conn = NodeConnection(sock, tuple(addr),
                                   register["resources"],
-                                  register.get("labels"))
+                                  register.get("labels"),
+                                  object_addr=register.get("object_addr"),
+                                  store_name=register.get("store_name"))
             # Registration makes the node schedulable, which can
             # immediately dispatch queued tasks onto this connection
             # from worker threads. Hold the send lock across
@@ -564,20 +582,33 @@ class HeadServer:
 
 class NodeDaemon:
     """The per-node worker process (raylet + worker-pool analog): executes
-    pushed user code on local threads, hosts actor instances."""
+    pushed user code on local threads, hosts actor instances. Owns the
+    node's object table (shm arena) + object server — the distributed
+    data plane's local half (_private/dataplane.py)."""
 
     def __init__(self, head_address: Tuple[str, int],
                  resources: Dict[str, float],
-                 labels: Optional[dict] = None):
+                 labels: Optional[dict] = None,
+                 object_store_memory: int = 1 << 28):
         self.head_address = head_address
         self.resources = resources
         self.labels = labels or {}
         self._functions: Dict[bytes, Any] = {}
+        # Raw fn_bytes cached by the single recv-loop thread BEFORE the
+        # request is handed to a handler thread. The head ships bytes only
+        # on first use; a concurrent second request (fn_bytes=None) could
+        # otherwise race the first handler's load and fail spuriously.
+        self._fn_raw: Dict[bytes, bytes] = {}
         self._actors: Dict[str, Any] = {}
         self._actor_tpu_ids: Dict[str, Any] = {}
-        # Daemon-resident object table (local half of the data plane):
-        # big results stay here until the head fetches or frees them.
-        self._objects: Dict[str, bytes] = {}
+        # Node object table (local half of the data plane): big results
+        # stay here — in the shm arena when available — until freed;
+        # peer daemons pull them directly over the object server.
+        from ray_tpu._private.dataplane import NodeObjectTable, ObjectServer
+        self._table = NodeObjectTable(capacity=object_store_memory)
+        self._object_server = ObjectServer(self._table)
+        import uuid as _uuid
+        self._uid = _uuid.uuid4().hex[:8]
         self._send_lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
@@ -587,6 +618,12 @@ class NodeDaemon:
         fn = self._functions.get(fn_id)
         if fn is None:
             from ray_tpu._private import serialization
+            if fn_bytes is None:
+                # The recv loop cached the raw bytes from the first frame
+                # that shipped them (frames are ordered on one socket, so
+                # by the time a fn_bytes=None request is READ, the cache
+                # is already populated).
+                fn_bytes = self._fn_raw.get(fn_id)
             if fn_bytes is None:
                 raise RuntimeError("head sent no bytes for unknown function")
             fn = serialization.loads_function(fn_bytes)
@@ -614,8 +651,10 @@ class NodeDaemon:
         (key, size) stub travels back."""
         payload = _dumps(result)
         if store_limit and len(payload) > store_limit:
-            key = f"obj-{req_id}"
-            self._objects[key] = payload
+            # Globally unique key: peer daemons cache pulled copies under
+            # the same name, so it must not collide across nodes.
+            key = f"obj-{self._uid}-{req_id}"
+            self._table.put(key, payload)
             msg = {"req_id": req_id, "ok": True, "stored_key": key,
                    "size": len(payload)}
         else:
@@ -623,9 +662,21 @@ class NodeDaemon:
         _send_frame(self._sock, _dumps(msg), self._send_lock)
 
     def _resolve_markers(self, args, kwargs):
+        from ray_tpu._private.dataplane import ObjectMarker, pull_object
+
         def resolve(a):
-            if isinstance(a, RemoteArgMarker):
-                return _loads(self._objects[a.key])
+            if isinstance(a, (ObjectMarker, RemoteArgMarker)):
+                payload = self._table.get(a.key)
+                if payload is None:
+                    owner = getattr(a, "owner_addr", None)
+                    if owner is None:
+                        raise KeyError(
+                            f"object payload {a.key} is not resident on "
+                            "this node (already freed?)")
+                    # Direct peer pull — the head never sees these bytes
+                    # (reference: ObjectManager node-to-node chunked pull).
+                    payload = pull_object(tuple(owner), a.key, self._table)
+                return _loads(payload)
             return a
         return ([resolve(a) for a in args],
                 {k: resolve(v) for k, v in kwargs.items()})
@@ -669,17 +720,22 @@ class NodeDaemon:
                 self._actor_tpu_ids.pop(msg["actor_id"], None)
                 self._reply(req_id, value=None)
             elif kind == "fetch_object":
-                raw = self._objects.get(msg["key"])
+                raw = self._table.get(msg["key"])
                 if raw is None:
                     raise KeyError(
                         f"object payload {msg['key']} is not resident on "
                         "this node (already freed?)")
                 _send_frame(self._sock, _dumps(
-                    {"req_id": req_id, "ok": True, "raw": raw}),
+                    {"req_id": req_id, "ok": True, "raw": bytes(raw)}),
                     self._send_lock)
             elif kind == "free_object":
-                self._objects.pop(msg["key"], None)
+                self._table.free(msg["key"])
                 self._reply(req_id, value=None)
+            elif kind == "stats":
+                self._reply(req_id, value={
+                    "transfer": dict(self._table.stats),
+                    "num_actors": len(self._actors),
+                })
             elif kind == "shutdown":
                 self._stop.set()
             else:
@@ -742,10 +798,15 @@ class NodeDaemon:
             self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass
+        # The IP this daemon uses to reach the head is the one peers (and
+        # the head) can reach IT on — advertise the object server there.
+        local_ip = self._sock.getsockname()[0]
         _send_frame(self._sock, _dumps({
             "type": "register",
             "resources": self.resources,
             "labels": self.labels,
+            "object_addr": (local_ip, self._object_server.port),
+            "store_name": self._table.arena_name,
         }), self._send_lock)
         ack = _loads(_recv_frame(self._sock))
         assert ack["type"] == "registered", ack
@@ -760,6 +821,11 @@ class NodeDaemon:
                 msg = _loads(_recv_frame(self._sock))
                 if msg.get("type") == "shutdown":
                     break
+                # Serialize function installation: cache raw bytes here on
+                # the recv thread, not in the handler threads.
+                fb = msg.get("fn_bytes")
+                if fb is not None and msg.get("fn_id") is not None:
+                    self._fn_raw.setdefault(msg["fn_id"], fb)
                 threading.Thread(target=self._handle, args=(msg,),
                                  daemon=True).start()
         except (ConnectionError, OSError):
@@ -769,6 +835,8 @@ class NodeDaemon:
                 self._sock.close()
             except OSError:
                 pass
+            self._object_server.close()
+            self._table.close()
 
 
 def run_node(address: str, *, num_cpus: float = 1.0, num_tpus: float = 0.0,

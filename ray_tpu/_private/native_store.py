@@ -117,6 +117,23 @@ class NativeObjectStore:
             return None
         return memoryview(self._map)[off:off + size.value]
 
+    # -- chunked writes (node-to-node pulls stream straight into shm) ----
+
+    def create(self, object_id: str, size: int) -> Optional[int]:
+        """Reserve an unsealed allocation; returns its arena offset (None
+        on failure/duplicate). Complete with write_at + seal."""
+        off = self._lib.shm_store_create(self._handle, object_id.encode(),
+                                         size)
+        if off < 0:
+            return None
+        return off
+
+    def write_at(self, offset: int, chunk: bytes) -> None:
+        self._lib.shm_store_write(self._handle, offset, chunk, len(chunk))
+
+    def seal(self, object_id: str) -> None:
+        self._lib.shm_store_seal(self._handle, object_id.encode())
+
     # -- numpy arrays ----------------------------------------------------
 
     def put_array(self, object_id: str, arr: np.ndarray) -> bool:

@@ -17,11 +17,11 @@ src/ray/raylet/local_task_manager.cc DispatchScheduledTasksToWorkers):
 * Failed tasks retry per ``max_retries``/``retry_exceptions``
   (reference: src/ray/core_worker/task_manager.cc retry path).
 
-Execution backends plug in beneath the executor interface; the default backend
-runs tasks on threads in the driver process (JAX/XLA releases the GIL during
-compute, so single-host TPU orchestration loses little), and the process
-backend forks real worker processes. Multi-node arrives with the gRPC control
-plane in a later round.
+Execution backends plug in beneath the executor interface. The default
+backend runs tasks on threads in the driver process (JAX/XLA releases the
+GIL during compute, so single-host TPU orchestration loses little).
+Multi-node runs through the head server + node daemons
+(_private/multinode.py).
 """
 
 from __future__ import annotations
@@ -382,19 +382,23 @@ class Runtime:
         self.store.free(oids)
         remote_frees = []
         with self._lock:
+            all_conns = list(self._remote_nodes.values())
             for oid in oids:
                 self._lineage.pop(oid, None)
                 self._object_locations.pop(oid, None)
                 rv = self._remote_values.pop(oid, None)
                 if rv is not None:
-                    conn = self._remote_nodes.get(rv[0])
-                    if conn is not None:
-                        remote_frees.append((conn, rv[1]))
-        for conn, key in remote_frees:
-            try:
-                conn.free_object(key)
-            except Exception:  # noqa: BLE001 - best effort
-                pass
+                    remote_frees.append(rv[1])
+        # Broadcast: peer daemons may hold PULLED copies of the object
+        # beyond the primary (the data plane caches pulls locally), so
+        # every node gets the eviction notice (reference: object pubsub
+        # eviction notifications).
+        for key in remote_frees:
+            for conn in all_conns:
+                try:
+                    conn.free_object(key)
+                except Exception:  # noqa: BLE001 - best effort
+                    pass
 
     def on_ref_deleted(self, oid: ObjectID) -> None:
         """An ObjectRef handle was garbage collected. Runs inside __del__,
@@ -782,8 +786,13 @@ class Runtime:
 
     def _resolve_args(self, spec: TaskSpec, conn=None):
         """Materialize ObjectRef args. With a target daemon connection,
-        arguments whose payload already lives on THAT daemon travel as
-        tiny markers and resolve locally there (plasma-local reads)."""
+        arguments whose payload lives in a node object table travel as
+        tiny markers: payload on THAT daemon → local read; payload on a
+        PEER daemon → the executing daemon pulls it directly from the
+        peer's object server (zero bytes through the head — reference:
+        object_manager.h node-to-node chunked pulls)."""
+        from ray_tpu._private.dataplane import ObjectMarker
+
         def resolve(a):
             if not isinstance(a, ObjectRef):
                 return a
@@ -791,10 +800,16 @@ class Runtime:
             if conn is not None:
                 with self._lock:
                     rv = self._remote_values.get(oid)
-                if rv is not None and rv[0] == conn.node_id and \
+                    owner_conn = (self._remote_nodes.get(rv[0])
+                                  if rv is not None else None)
+                if rv is not None and \
                         not self.store.is_materialized(oid):
-                    from ray_tpu._private.multinode import RemoteArgMarker
-                    return RemoteArgMarker(rv[1])
+                    if rv[0] == conn.node_id:
+                        return ObjectMarker(rv[1])
+                    if owner_conn is not None and \
+                            owner_conn.object_addr is not None:
+                        return ObjectMarker(rv[1],
+                                            owner_addr=owner_conn.object_addr)
             return self.store.get(oid)
 
         args = [resolve(a) for a in spec.args]
@@ -956,9 +971,15 @@ class Runtime:
             # A dropped node connection is a SYSTEM failure (node death),
             # not an application error — probe retry with the raw
             # exception so the always-retriable path applies even when the
-            # death handler hasn't invalidated this spec yet.
+            # death handler hasn't invalidated this spec yet. Likewise a
+            # failed node-to-node object pull (the arg's owner died): the
+            # retry waits on reconstruction, not the user's code.
+            from ray_tpu._private.dataplane import ObjectPullError
             from ray_tpu._private.multinode import RemoteNodeDiedError
             probe = e if isinstance(e, RemoteNodeDiedError) else err
+            if isinstance(err, TaskError) and \
+                    isinstance(err.cause, ObjectPullError):
+                probe = err.cause
             if self._should_retry(spec, probe):
                 spec.attempt_number += 1
                 self._finish_task(spec, worker, retried=True)
@@ -1536,7 +1557,7 @@ class Runtime:
         self._dispatch()  # new capacity may unblock queued tasks
         return node_id
 
-    def start_head_server(self, host: str = "0.0.0.0",
+    def start_head_server(self, host: str = "127.0.0.1",
                           port: int = 0) -> Tuple[str, int]:
         """Open the head's TCP registration endpoint so node-daemon
         processes (`ray-tpu start --address host:port`) can join this
@@ -1570,6 +1591,19 @@ class Runtime:
             return None
         with self._lock:
             return self._remote_nodes.get(node_id)
+
+    def remote_node_stats(self) -> Dict[str, dict]:
+        """Per-daemon counters (object-transfer bytes etc.), keyed by node
+        id hex — the observability hook for the node-to-node data plane."""
+        with self._lock:
+            conns = dict(self._remote_nodes)
+        out = {}
+        for node_id, conn in conns.items():
+            try:
+                out[node_id.hex()] = conn.get_stats()
+            except Exception:  # noqa: BLE001 - dying node mid-query
+                continue
+        return out
 
     def _result_store_limit(self, spec: TaskSpec) -> int:
         """Results above this size stay daemon-resident (single-return

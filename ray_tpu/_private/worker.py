@@ -122,11 +122,17 @@ def is_initialized() -> bool:
     return global_worker.connected
 
 
-def start_head_server(port: int = 0, host: str = "0.0.0.0"):
+def start_head_server(port: int = 0, host: str = "127.0.0.1"):
     """Open this driver's node-registration endpoint so `ray-tpu start
     --address host:port` daemons (other processes/hosts) can join the
     cluster as schedulable nodes (reference: `ray start --head` GCS).
-    Returns (host, port)."""
+    Returns (host, port).
+
+    SECURITY: the control-plane protocol is unauthenticated cloudpickle —
+    any peer that can reach the port gets arbitrary code execution (same
+    trust model as the reference's GCS). The default bind is loopback;
+    pass host="0.0.0.0" explicitly to serve a real multi-host cluster,
+    and only on a trusted network."""
     if not is_initialized():
         init()
     return global_worker.runtime.start_head_server(host, port)

@@ -189,16 +189,20 @@ class Dataset:
         def fn(blocks, metas, _seed=seed, _n=num_blocks):
             blocks, metas = shuffle_blocks(blocks, _n or len(blocks),
                                            mode="random", seed=_seed)
-            # Shuffle rows within each output block too.
-            def _permute(block, _s=_seed):
+            # Shuffle rows within each output block too. Each block gets
+            # its OWN stream (seed + index): a shared seed would apply the
+            # same permutation to equal-sized blocks, leaving the "random"
+            # shuffle structurally correlated across blocks.
+            def _permute(block, s):
                 acc = BlockAccessor.for_block(block)
                 n = acc.num_rows()
-                rng = np.random.default_rng(_s)
+                rng = np.random.default_rng(s)
                 return acc.take(rng.permutation(n).tolist())
-            out_blocks, out_metas = [], []
+            out_blocks = []
             task = ray_tpu.remote(_permute)
-            for b in blocks:
-                out_blocks.append(task.remote(b))
+            for i, b in enumerate(blocks):
+                out_blocks.append(task.remote(
+                    b, None if _seed is None else _seed + i))
             return out_blocks, metas
 
         return Dataset(self._plan.with_stage(

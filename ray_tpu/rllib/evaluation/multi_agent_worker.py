@@ -67,9 +67,12 @@ class MultiAgentRolloutWorker:
         output_dir = policy_config.get("output")
         for i, (pid, (obs_space, act_space)) in enumerate(
                 sorted(specs.items())):
+            # 1000× spacing decorrelates (worker, policy) pairs — plain
+            # seed + worker_index + i would give (w=1, i=1) and (w=2, i=0)
+            # identical PRNG streams (mirrors the _eps_id spacing below).
             self.policies[pid] = make_policy(
                 policy_config, obs_space, act_space,
-                seed=seed + worker_index + i)
+                seed=seed + 1000 * worker_index + i)
             # Per-policy connector pipelines (stateful filters like
             # MeanStd must track each policy's own observation stream).
             self.obs_connectors[pid], self.action_connectors[pid] = \
@@ -154,8 +157,8 @@ class MultiAgentRolloutWorker:
                 step_meta[agent_id] = (obs_arr, act, logp[0], value[0])
             nxt, rewards, terminateds, truncateds, _ = self.env.step(
                 actions)
-            done_all = bool(terminateds.get("__all__", False)
-                            or truncateds.get("__all__", False))
+            term_all = bool(terminateds.get("__all__", False))
+            done_all = bool(term_all or truncateds.get("__all__", False))
             for agent_id, (obs_arr, act, logp, value) in step_meta.items():
                 traj = self._traj(agent_id)
                 term = bool(terminateds.get(agent_id, False))
@@ -176,11 +179,20 @@ class MultiAgentRolloutWorker:
                 traj[SampleBatch.EPS_ID].append(self._eps_id)
                 self._episode_reward += reward
                 if term or trunc or done_all:
-                    self._flush_agent(agent_id, builders, terminated=term)
+                    # terminateds['__all__'] without a per-agent flag is a
+                    # genuine terminal for every agent (the MultiAgentEnv
+                    # contract: '__all__' ends the episode for everyone) —
+                    # bootstrapping gamma*V(last_obs) there would bias GAE
+                    # targets. Truncation ('__all__' in truncateds, or a
+                    # per-agent trunc) still bootstraps.
+                    self._flush_agent(
+                        agent_id, builders,
+                        terminated=term or (term_all and not trunc))
             self._episode_len += 1
             if done_all:
                 for agent_id in list(self._trajectories):
-                    self._flush_agent(agent_id, builders, terminated=False)
+                    self._flush_agent(agent_id, builders,
+                                      terminated=term_all)
                 self.completed_rewards.append(self._episode_reward)
                 self.completed_lengths.append(self._episode_len)
                 self._episode_reward = 0.0

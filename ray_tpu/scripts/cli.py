@@ -142,7 +142,8 @@ def cmd_start(args) -> int:
                          _memory=args.memory,
                          resources=(json.loads(args.resources)
                                     if args.resources else None))
-        host, port = ray_tpu.start_head_server(port=args.port)
+        host, port = ray_tpu.start_head_server(port=args.port,
+                                               host=args.host)
         print(f"Head node listening for node daemons on {host}:{port}")
         print(f"Join with: ray-tpu start --address <this-host>:{port}")
         try:
@@ -242,6 +243,9 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("start", help="start a head or join as a node")
     p.add_argument("--head", action="store_true")
+    p.add_argument("--host", default="0.0.0.0",
+                   help="head bind address (the control plane is "
+                        "unauthenticated: expose only on trusted networks)")
     p.add_argument("--address", default=None,
                    help="head host:port to join as a node daemon")
     p.add_argument("--port", type=int, default=6380)
