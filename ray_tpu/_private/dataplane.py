@@ -26,6 +26,7 @@ head really is out of the data path.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import socket
 import struct
@@ -89,14 +90,28 @@ class NodeObjectTable:
         with self._lock:
             self._heap[key] = bytes(payload)
 
-    def get(self, key: str) -> Optional[bytes]:
-        """Payload bytes (a zero-copy shm view when arena-resident)."""
+    @contextlib.contextmanager
+    def pinned(self, key: str):
+        """Context manager yielding the payload (a zero-copy shm view when
+        arena-resident, else bytes) with a read pin held for the duration,
+        or None if absent. The pin keeps eviction/free from recycling the
+        region mid-read (plasma semantics: client Get holds a buffer ref);
+        the view MUST NOT escape the block."""
         if self._arena is not None:
-            view = self._arena.get_bytes(key)
+            view = self._arena.get_bytes(key)  # takes an arena ref
             if view is not None:
-                return view
+                try:
+                    yield view
+                finally:
+                    try:
+                        view.release()
+                    except BufferError:
+                        pass  # transient exports; GC drops them shortly
+                    self._arena.release(key)
+                return
         with self._lock:
-            return self._heap.get(key)
+            payload = self._heap.get(key)
+        yield payload
 
     def contains(self, key: str) -> bool:
         if self._arena is not None and self._arena.contains(key):
@@ -106,19 +121,16 @@ class NodeObjectTable:
 
     def free(self, key: str) -> None:
         if self._arena is not None:
-            # Release the ref a prior get() may hold, then drop the entry.
-            try:
-                self._arena.release(key)
-            except Exception:  # noqa: BLE001
-                pass
+            # Read pins are balanced by pinned(); delete fails (-2) only
+            # while a concurrent read holds the entry — it then parks in
+            # the LRU when released and pressure evicts it.
             self._arena.delete(key)
         with self._lock:
             self._heap.pop(key, None)
 
-    def keys(self):
+    def _bump(self, counter: str, n: int = 1) -> None:
         with self._lock:
-            heap_keys = list(self._heap)
-        return heap_keys  # arena keys are not enumerable; callers track
+            self.stats[counter] += n
 
     def recv_into(self, key: str, size: int, sock: socket.socket) -> None:
         """Stream ``size`` bytes from ``sock`` into the table — straight
@@ -136,9 +148,9 @@ class NodeObjectTable:
                         self._arena.write_at(off + written, chunk)
                         written += len(chunk)
                 except BaseException:
-                    # Seal-then-free: an unsealed entry would leak.
-                    self._arena.seal(key)
-                    self._arena.delete(key)
+                    # Abort, never seal: a seal would momentarily publish
+                    # the half-written payload to concurrent readers.
+                    self._arena.abort(key)
                     raise
                 self._arena.seal(key)
                 return
@@ -168,9 +180,14 @@ class ObjectServer:
 
     Protocol (one request per connection, like one chunked gRPC stream):
     client sends a length-prefixed key; server replies an 8-byte signed
-    size (-1 = not here), then the raw payload."""
+    size (-1 = not here), then the raw payload.
 
-    def __init__(self, table: NodeObjectTable, host: str = "0.0.0.0"):
+    The caller binds this to the SAME interface the daemon advertises to
+    the head (its head-facing IP) — never unconditionally 0.0.0.0: object
+    payloads are served unauthenticated, exactly like the control plane,
+    so the exposure policy must match."""
+
+    def __init__(self, table: NodeObjectTable, host: str = "127.0.0.1"):
         self.table = table
         self._listener = socket.create_server((host, 0))
         self.port = self._listener.getsockname()[1]
@@ -193,20 +210,24 @@ class ObjectServer:
         try:
             sock.settimeout(30)
             (klen,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+            if klen <= 0 or klen > 4096:
+                return  # garbage request; keys are short
             key = _recv_exact(sock, klen).decode()
-            payload = self.table.get(key)
-            if payload is None:
-                sock.sendall(_LEN.pack(-1))
-                return
-            size = len(payload)
-            sock.sendall(_LEN.pack(size))
-            view = memoryview(payload)
-            sent = 0
-            while sent < size:
-                n = sock.send(view[sent:sent + CHUNK_SIZE])
-                sent += n
-            self.table.stats["served_bytes"] += size
-            self.table.stats["serves"] += 1
+            # The pin spans the whole send: a concurrent free cannot
+            # recycle the region under us mid-transfer.
+            with self.table.pinned(key) as payload:
+                if payload is None:
+                    sock.sendall(_LEN.pack(-1))
+                    return
+                size = len(payload)
+                sock.sendall(_LEN.pack(size))
+                sent = 0
+                while sent < size:
+                    # Transient slices only: nothing may still export the
+                    # pinned view's buffer when the context exits.
+                    sent += sock.send(payload[sent:sent + CHUNK_SIZE])
+            self.table._bump("served_bytes", size)
+            self.table._bump("serves")
         except (OSError, ConnectionError):
             pass
         finally:
@@ -234,10 +255,11 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
-                timeout: float = 30.0, retries: int = 2) -> bytes:
+                timeout: float = 30.0, retries: int = 2) -> None:
     """Pull one object from a peer's object server into the local table
-    and return its payload. Retries transient connect failures; raises
-    ObjectPullError when the owner is unreachable or lacks the object."""
+    (read it back with ``table.pinned``). Retries transient connect
+    failures; raises ObjectPullError when the owner is unreachable or
+    lacks the object."""
     last: Optional[BaseException] = None
     for _ in range(retries + 1):
         try:
@@ -252,14 +274,9 @@ def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
                         f"object {key} is not resident on {addr} "
                         "(freed or evicted before the pull)")
                 table.recv_into(key, size, sock)
-                table.stats["pulled_bytes"] += size
-                table.stats["pulls"] += 1
-                payload = table.get(key)
-                if payload is None:  # arena evicted it under pressure
-                    raise ObjectPullError(
-                        f"object {key} was evicted immediately after "
-                        "the pull (store too small?)")
-                return payload
+                table._bump("pulled_bytes", size)
+                table._bump("pulls")
+                return
         except ObjectPullError as exc:
             raise exc
         except (OSError, ConnectionError) as exc:

@@ -603,10 +603,11 @@ class NodeDaemon:
         self._actor_tpu_ids: Dict[str, Any] = {}
         # Node object table (local half of the data plane): big results
         # stay here — in the shm arena when available — until freed;
-        # peer daemons pull them directly over the object server.
-        from ray_tpu._private.dataplane import NodeObjectTable, ObjectServer
+        # peer daemons pull them directly over the object server (which
+        # binds lazily in run(), on the head-facing interface).
+        from ray_tpu._private.dataplane import NodeObjectTable
         self._table = NodeObjectTable(capacity=object_store_memory)
-        self._object_server = ObjectServer(self._table)
+        self._object_server = None
         import uuid as _uuid
         self._uid = _uuid.uuid4().hex[:8]
         self._send_lock = threading.Lock()
@@ -628,6 +629,9 @@ class NodeDaemon:
                 raise RuntimeError("head sent no bytes for unknown function")
             fn = serialization.loads_function(fn_bytes)
             self._functions[fn_id] = fn
+            # The loaded callable supersedes the raw bytes — dropping them
+            # keeps long-lived daemons from accreting every function blob.
+            self._fn_raw.pop(fn_id, None)
         return fn
 
     def _reply(self, req_id: int, *, value: Any = None,
@@ -662,21 +666,28 @@ class NodeDaemon:
         _send_frame(self._sock, _dumps(msg), self._send_lock)
 
     def _resolve_markers(self, args, kwargs):
-        from ray_tpu._private.dataplane import ObjectMarker, pull_object
+        from ray_tpu._private.dataplane import (ObjectMarker,
+                                                ObjectPullError, pull_object)
 
         def resolve(a):
             if isinstance(a, (ObjectMarker, RemoteArgMarker)):
-                payload = self._table.get(a.key)
-                if payload is None:
-                    owner = getattr(a, "owner_addr", None)
-                    if owner is None:
-                        raise KeyError(
-                            f"object payload {a.key} is not resident on "
-                            "this node (already freed?)")
-                    # Direct peer pull — the head never sees these bytes
-                    # (reference: ObjectManager node-to-node chunked pull).
-                    payload = pull_object(tuple(owner), a.key, self._table)
-                return _loads(payload)
+                with self._table.pinned(a.key) as payload:
+                    if payload is not None:
+                        return _loads(payload)
+                owner = getattr(a, "owner_addr", None)
+                if owner is None:
+                    raise KeyError(
+                        f"object payload {a.key} is not resident on "
+                        "this node (already freed?)")
+                # Direct peer pull — the head never sees these bytes
+                # (reference: ObjectManager node-to-node chunked pull).
+                pull_object(tuple(owner), a.key, self._table)
+                with self._table.pinned(a.key) as payload:
+                    if payload is None:  # evicted immediately (pressure)
+                        raise ObjectPullError(
+                            f"object {a.key} was evicted right after its "
+                            "pull (object store too small?)")
+                    return _loads(payload)
             return a
         return ([resolve(a) for a in args],
                 {k: resolve(v) for k, v in kwargs.items()})
@@ -720,13 +731,14 @@ class NodeDaemon:
                 self._actor_tpu_ids.pop(msg["actor_id"], None)
                 self._reply(req_id, value=None)
             elif kind == "fetch_object":
-                raw = self._table.get(msg["key"])
-                if raw is None:
-                    raise KeyError(
-                        f"object payload {msg['key']} is not resident on "
-                        "this node (already freed?)")
+                with self._table.pinned(msg["key"]) as raw:
+                    if raw is None:
+                        raise KeyError(
+                            f"object payload {msg['key']} is not resident "
+                            "on this node (already freed?)")
+                    data = bytes(raw)
                 _send_frame(self._sock, _dumps(
-                    {"req_id": req_id, "ok": True, "raw": bytes(raw)}),
+                    {"req_id": req_id, "ok": True, "raw": data}),
                     self._send_lock)
             elif kind == "free_object":
                 self._table.free(msg["key"])
@@ -799,8 +811,12 @@ class NodeDaemon:
         except OSError:
             pass
         # The IP this daemon uses to reach the head is the one peers (and
-        # the head) can reach IT on — advertise the object server there.
+        # the head) can reach IT on — bind AND advertise the object server
+        # there (object payloads are served unauthenticated, so the
+        # exposure policy must match the control plane's, never 0.0.0.0).
+        from ray_tpu._private.dataplane import ObjectServer
         local_ip = self._sock.getsockname()[0]
+        self._object_server = ObjectServer(self._table, host=local_ip)
         _send_frame(self._sock, _dumps({
             "type": "register",
             "resources": self.resources,
@@ -835,7 +851,8 @@ class NodeDaemon:
                 self._sock.close()
             except OSError:
                 pass
-            self._object_server.close()
+            if self._object_server is not None:
+                self._object_server.close()
             self._table.close()
 
 

@@ -53,6 +53,7 @@ def _load() -> Optional[ctypes.CDLL]:
                                       ctypes.POINTER(ctypes.c_uint64)]
         lib.shm_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.shm_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_store_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.shm_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.shm_store_used_bytes.restype = ctypes.c_uint64
         lib.shm_store_used_bytes.argtypes = [ctypes.c_void_p]
@@ -133,6 +134,10 @@ class NativeObjectStore:
 
     def seal(self, object_id: str) -> None:
         self._lib.shm_store_seal(self._handle, object_id.encode())
+
+    def abort(self, object_id: str) -> None:
+        """Discard an unsealed create() without ever publishing it."""
+        self._lib.shm_store_abort(self._handle, object_id.encode())
 
     # -- numpy arrays ----------------------------------------------------
 

@@ -190,6 +190,20 @@ class ShmStore {
     return 0;
   }
 
+  int Abort(const char* id) {
+    // Discard a CREATED (never sealed) entry, e.g. a node-to-node pull
+    // that died mid-transfer. Unlike Seal+Delete this never publishes
+    // the partial payload: the entry goes straight from kCreated to
+    // kTombstone under the lock, so no concurrent Get can pin it.
+    Lock l(hdr_);
+    int32_t idx = FindLocked(id);
+    if (idx < 0) return -1;
+    if (entries_[idx].state != kCreated) return -2;
+    entries_[idx].refcount = 0;  // drop the creator ref
+    RemoveLocked(idx);
+    return 0;
+  }
+
   int Delete(const char* id) {
     Lock l(hdr_);
     int32_t idx = FindLocked(id);
@@ -460,6 +474,10 @@ int shm_store_release(void* store, const char* id) {
 
 int shm_store_delete(void* store, const char* id) {
   return static_cast<ShmStore*>(store)->Delete(id);
+}
+
+int shm_store_abort(void* store, const char* id) {
+  return static_cast<ShmStore*>(store)->Abort(id);
 }
 
 int shm_store_contains(void* store, const char* id) {
