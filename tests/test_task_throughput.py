@@ -1,0 +1,88 @@
+"""Head-dispatch scalability: many concurrent remote tasks complete with
+a BOUNDED head thread count (the thread-per-call fix — reference:
+direct_task_transport's callback-driven client, release/benchmarks
+'10k+ simultaneously running tasks')."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _spawn_daemon(port, num_cpus):
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.multinode",
+         "--address", f"127.0.0.1:{port}",
+         "--num-cpus", str(num_cpus),
+         "--resources", json.dumps({"remote": 100})],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+@pytest.fixture
+def four_daemons(ray_start_regular):
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    procs = [_spawn_daemon(port, 8) for _ in range(4)]
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if ray_tpu.cluster_resources().get("remote", 0) >= 400:
+            break
+        time.sleep(0.1)
+    else:
+        raise TimeoutError("daemons never joined")
+    try:
+        yield
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=10)
+
+
+def test_10k_tasks_bounded_head_threads(four_daemons):
+    """10,000 concurrent trivial tasks over 4 daemons: all complete, and
+    the head never grows a thread per in-flight call."""
+
+    # worker_process False: this test measures HEAD dispatch scalability
+    # (thread boundedness + throughput), and the single-CPU CI box can't
+    # also afford a worker-subprocess hop per task.
+    @ray_tpu.remote(resources={"remote": 1}, num_cpus=1,
+                    runtime_env={"worker_process": False})
+    def tiny(i):
+        return i
+
+    base_threads = threading.active_count()
+    t0 = time.monotonic()
+    refs = [tiny.remote(i) for i in range(10_000)]
+    # Peak thread check mid-flight.
+    peak = 0
+    done = []
+
+    def probe():
+        while not done:
+            nonlocal_peak[0] = max(nonlocal_peak[0],
+                                   threading.active_count())
+            time.sleep(0.05)
+
+    nonlocal_peak = [0]
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    results = ray_tpu.get(refs, timeout=300)
+    elapsed = time.monotonic() - t0
+    done.append(True)
+    t.join(timeout=2)
+
+    assert results == list(range(10_000))
+    rate = 10_000 / elapsed
+    # Bounded: recv loops (4) + health (1) + completion pool (8) + a few
+    # dep waiters — nowhere near one-thread-per-task. Generous cap to
+    # stay robust on slow CI.
+    assert nonlocal_peak[0] - base_threads < 64, \
+        f"head grew {nonlocal_peak[0] - base_threads} threads"
+    print(f"\n10k remote tasks: {rate:.0f} tasks/s, "
+          f"peak extra threads {nonlocal_peak[0] - base_threads}")
+    assert rate > 200, f"remote task throughput too low: {rate:.0f}/s"
