@@ -1,0 +1,121 @@
+"""Persistent GCS tables: control-plane state that survives head death.
+
+The analog of the reference's GCS storage layer (gcs_server.cc:523 —
+in-memory vs Redis store; gcs/store_client/redis_store_client.h): the
+head persists its control-plane tables (internal KV, named-actor
+registry, job records) to a single file, atomically rewritten on every
+mutation. A NEW driver started with the same ``gcs_store_path`` (and
+head port) restores them: daemons reconnect with their resident actor
+ids, the head rebinds named actors to the live daemon instances, and
+``get_actor(name)`` answers again — head death is no longer cluster
+death.
+
+State that is deliberately NOT persisted (matching the reference's
+in-memory-GCS behavior for non-table state): in-flight tasks, object
+refs owned by the dead driver, and placement-group reservations —
+the driver that owned them is gone.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Any, Dict, Optional
+
+
+class GcsStore:
+    """One pickle file holding all persisted tables. Mutations rewrite
+    atomically (tmp + rename) — the file is always a consistent
+    snapshot, even through kill -9."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self.kv: Dict[str, Dict[bytes, bytes]] = {}
+        # actor_id hex → {"name", "namespace", "max_restarts",
+        #                 "max_concurrency"}
+        self.actors: Dict[str, Dict[str, Any]] = {}
+        self.jobs: Dict[str, Dict[str, Any]] = {}
+        if os.path.exists(path):
+            try:
+                with open(path, "rb") as f:
+                    data = pickle.load(f)
+                self.kv = data.get("kv", {})
+                self.actors = data.get("actors", {})
+                self.jobs = data.get("jobs", {})
+            except Exception:  # noqa: BLE001 - torn file: start fresh
+                pass
+
+    def _save_locked(self) -> None:
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True)
+        with open(tmp, "wb") as f:
+            pickle.dump({"kv": self.kv, "actors": self.actors,
+                         "jobs": self.jobs}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    # -- internal KV (reference: gcs_kv_manager.h InternalKV) ----------
+
+    def kv_put(self, namespace: str, key: bytes, value: bytes,
+               overwrite: bool = True) -> bool:
+        """Returns already_exists (reference internal_kv semantics)."""
+        with self._lock:
+            ns = self.kv.setdefault(namespace, {})
+            existed = key in ns
+            if overwrite or not existed:
+                ns[key] = value
+                self._save_locked()
+            return existed
+
+    def kv_get(self, namespace: str, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self.kv.get(namespace, {}).get(key)
+
+    def kv_del(self, namespace: str, key: bytes) -> bool:
+        with self._lock:
+            existed = self.kv.get(namespace, {}).pop(key, None) is not None
+            if existed:
+                self._save_locked()
+            return existed
+
+    def kv_keys(self, namespace: str, prefix: bytes = b"") -> list:
+        with self._lock:
+            return [k for k in self.kv.get(namespace, {})
+                    if k.startswith(prefix)]
+
+    # -- named actors --------------------------------------------------
+
+    def record_actor(self, actor_id_hex: str, name: str, namespace: str,
+                     max_restarts: int, max_concurrency: int,
+                     cls_bytes: Optional[bytes] = None,
+                     resources: Optional[Dict[str, float]] = None) -> None:
+        """cls_bytes: the pickled actor class, so a restarted head can
+        rebuild handles (method introspection) for rebound actors.
+        resources: the creation-time reservation, re-acquired on the
+        actor's node at rebind so a restarted head cannot double-book
+        what the resident instance still consumes."""
+        with self._lock:
+            self.actors[actor_id_hex] = {
+                "name": name, "namespace": namespace,
+                "max_restarts": max_restarts,
+                "max_concurrency": max_concurrency,
+                "cls_bytes": cls_bytes,
+                "resources": dict(resources or {}),
+            }
+            self._save_locked()
+
+    def remove_actor(self, actor_id_hex: str) -> None:
+        with self._lock:
+            if self.actors.pop(actor_id_hex, None) is not None:
+                self._save_locked()
+
+    # -- jobs ----------------------------------------------------------
+
+    def record_job(self, job_id: str, record: Dict[str, Any]) -> None:
+        with self._lock:
+            self.jobs[job_id] = record
+            self._save_locked()

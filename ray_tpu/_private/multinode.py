@@ -611,7 +611,8 @@ class HeadServer:
                 self.runtime.config.testing_rpc_failure_pct)
             conn.completion_pool = self.completion_pool
             with conn._send_lock:
-                node_id = self.runtime.register_remote_node(conn)
+                node_id = self.runtime.register_remote_node(conn,
+                                                            register)
                 conn.node_id = node_id
                 conn._on_death = self._on_conn_death
                 self._conns[node_id] = conn
@@ -701,6 +702,10 @@ class NodeDaemon:
         self._object_server = None
         import uuid as _uuid
         self._uid = _uuid.uuid4().hex[:8]
+        # Incremented per head session (reconnects): result keys embed it
+        # so a stale handler's late put can never overwrite an object a
+        # NEW session stored under the same (restarted) req_id.
+        self._session_n = 0
         self._send_lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
@@ -714,6 +719,9 @@ class NodeDaemon:
             "RAY_TPU_DAEMON_WORKER_PROCESSES", "1") != "0"
         self._pool = None
         self._pool_lock = threading.Lock()
+        self._session_registered = False
+        self._health_started = False
+        self._object_server_host: Optional[str] = None
 
     def _load_function(self, fn_id: bytes, fn_bytes: Optional[bytes]):
         fn = self._functions.get(fn_id)
@@ -734,9 +742,15 @@ class NodeDaemon:
             # function exports in GCS KV for the job's lifetime).
         return fn
 
-    def _reply(self, req_id: int, *, value: Any = None,
+    def _reply(self, sock, req_id: int, *, value: Any = None,
                error: Optional[BaseException] = None,
                tb: str = "") -> None:
+        """``sock`` is the session socket the REQUEST arrived on. After a
+        head restart, handler threads of the dead session still hold the
+        old (closed) socket — their replies raise OSError and are
+        dropped instead of reaching the new head with req_ids that
+        collide with the new session's counter (the restarted head
+        re-runs those tasks anyway)."""
         if error is not None:
             try:
                 payload = _dumps((error, tb))
@@ -746,9 +760,9 @@ class NodeDaemon:
             msg = {"req_id": req_id, "ok": False, "error": payload}
         else:
             msg = {"req_id": req_id, "ok": True, "value": _dumps(value)}
-        _send_frame(self._sock, _dumps(msg), self._send_lock)
+        _send_frame(sock, _dumps(msg), self._send_lock)
 
-    def _reply_result(self, req_id: int, result: Any,
+    def _reply_result(self, sock, req_id: int, result: Any,
                       store_limit: int) -> None:
         """Small results return inline (the reference's PushTaskReply
         path); big ones stay in this daemon's object table and only a
@@ -757,13 +771,13 @@ class NodeDaemon:
         if store_limit and len(payload) > store_limit:
             # Globally unique key: peer daemons cache pulled copies under
             # the same name, so it must not collide across nodes.
-            key = f"obj-{self._uid}-{req_id}"
+            key = f"obj-{self._uid}-s{self._session_n}-{req_id}"
             self._table.put(key, payload)
             msg = {"req_id": req_id, "ok": True, "stored_key": key,
                    "size": len(payload)}
         else:
             msg = {"req_id": req_id, "ok": True, "value": payload}
-        _send_frame(self._sock, _dumps(msg), self._send_lock)
+        _send_frame(sock, _dumps(msg), self._send_lock)
 
     def _resolve_markers(self, args, kwargs):
         from ray_tpu._private.dataplane import (ObjectMarker,
@@ -839,7 +853,7 @@ class NodeDaemon:
         return ([resolve(a) for a in args],
                 {k: resolve(v) for k, v in kwargs.items()})
 
-    def _execute_on_worker(self, msg: dict, req_id: int) -> None:
+    def _execute_on_worker(self, sock, msg: dict, req_id: int) -> None:
         """Run a pushed task on a leased worker subprocess and forward
         its (already serialized) result without re-encoding."""
         from ray_tpu._private.worker_process import (WorkerCrashedError,
@@ -888,7 +902,7 @@ class NodeDaemon:
         except WorkerCrashedError as exc:
             # Ships to the head as TaskError(cause=WorkerCrashedError),
             # which the head classifies as system-retriable.
-            self._reply(req_id, error=exc, tb=traceback.format_exc())
+            self._reply(sock, req_id, error=exc, tb=traceback.format_exc())
             return
         finally:
             pool.release(handle)
@@ -896,31 +910,31 @@ class NodeDaemon:
             payload = reply["value"]
             store_limit = msg.get("store_limit", 0)
             if store_limit and len(payload) > store_limit:
-                key = f"obj-{self._uid}-{req_id}"
+                key = f"obj-{self._uid}-s{self._session_n}-{req_id}"
                 self._table.put(key, payload)
                 out = {"req_id": req_id, "ok": True, "stored_key": key,
                        "size": len(payload)}
             else:
                 out = {"req_id": req_id, "ok": True, "value": payload}
-            _send_frame(self._sock, _dumps(out), self._send_lock)
+            _send_frame(sock, _dumps(out), self._send_lock)
         else:
-            _send_frame(self._sock, _dumps(
+            _send_frame(sock, _dumps(
                 {"req_id": req_id, "ok": False, "error": reply["error"]}),
                 self._send_lock)
 
-    def _handle(self, msg: dict) -> None:
+    def _handle(self, sock, msg: dict) -> None:
         req_id = msg.get("req_id", 0)
         kind = msg.get("type")
         try:
             if kind == "execute_task":
                 if self._task_uses_worker_process(msg):
-                    self._execute_on_worker(msg, req_id)
+                    self._execute_on_worker(sock, msg, req_id)
                     return
                 fn = self._load_function(msg["fn_id"], msg.get("fn_bytes"))
                 args, kwargs = self._resolve_markers(
                     *_loads(msg["payload"]))
                 result = self._run_in_env(msg, fn, args, kwargs)
-                self._reply_result(req_id, result,
+                self._reply_result(sock, req_id, result,
                                    msg.get("store_limit", 0))
             elif kind == "create_actor":
                 cls = self._load_function(msg["fn_id"], msg.get("fn_bytes"))
@@ -929,7 +943,7 @@ class NodeDaemon:
                 instance = self._run_in_env(msg, cls, args, kwargs)
                 self._actors[msg["actor_id"]] = instance
                 self._actor_tpu_ids[msg["actor_id"]] = msg.get("tpu_ids")
-                self._reply(req_id, value=None)
+                self._reply(sock, req_id, value=None)
             elif kind == "actor_call":
                 instance = self._actors[msg["actor_id"]]
                 method = getattr(instance, msg["method"])
@@ -943,12 +957,12 @@ class NodeDaemon:
                 if inspect.iscoroutine(result):
                     import asyncio
                     result = asyncio.run(result)
-                self._reply_result(req_id, result,
+                self._reply_result(sock, req_id, result,
                                    msg.get("store_limit", 0))
             elif kind == "destroy_actor":
                 self._actors.pop(msg["actor_id"], None)
                 self._actor_tpu_ids.pop(msg["actor_id"], None)
-                self._reply(req_id, value=None)
+                self._reply(sock, req_id, value=None)
             elif kind == "fetch_object":
                 with self._table.pinned(msg["key"]) as raw:
                     if raw is None:
@@ -956,14 +970,14 @@ class NodeDaemon:
                             f"object payload {msg['key']} is not resident "
                             "on this node (already freed?)")
                     data = bytes(raw)
-                _send_frame(self._sock, _dumps(
+                _send_frame(sock, _dumps(
                     {"req_id": req_id, "ok": True, "raw": data}),
                     self._send_lock)
             elif kind == "free_object":
                 self._table.free(msg["key"])
-                self._reply(req_id, value=None)
+                self._reply(sock, req_id, value=None)
             elif kind == "stats":
-                self._reply(req_id, value={
+                self._reply(sock, req_id, value={
                     "transfer": dict(self._table.stats),
                     "num_actors": len(self._actors),
                 })
@@ -973,7 +987,8 @@ class NodeDaemon:
                 raise ValueError(f"unknown message type {kind!r}")
         except BaseException as exc:  # noqa: BLE001 - ship to the head
             try:
-                self._reply(req_id, error=exc, tb=traceback.format_exc())
+                self._reply(sock, req_id, error=exc,
+                            tb=traceback.format_exc())
             except OSError:
                 pass
 
@@ -1020,10 +1035,64 @@ class NodeDaemon:
         finally:
             _task_context.spec = None
 
-    def run(self) -> None:
-        """Connect, register, and serve until shutdown/EOF. Each request
-        runs on its own thread — the head's scheduler already bounds
-        concurrency by this node's declared resources."""
+    def run(self, reconnect_window: float = 60.0) -> None:
+        """Connect, register, and serve. On connection loss (head died
+        or restarted) the daemon KEEPS its actors and object table and
+        retries the head address for ``reconnect_window`` seconds — a
+        restarted head (gcs_store_path persistence) rebinds the resident
+        actors on re-registration (reference: raylet surviving GCS
+        restart + resubscribe). An orderly head shutdown frame exits
+        immediately."""
+        import time as _time
+        ever_registered = False
+        deadline = _time.monotonic() + max(reconnect_window, 0.0)
+        backoff = 0.2
+        try:
+            while not self._stop.is_set():
+                self._session_registered = False
+                try:
+                    self._serve_once()
+                except (ConnectionError, OSError) as exc:
+                    if self._session_registered:
+                        pass  # live session dropped; fall through, retry
+                    elif reconnect_window <= 0:
+                        raise
+                    last_exc = exc
+                if self._stop.is_set():
+                    break
+                if self._session_registered:
+                    ever_registered = True
+                    # A real session dropped — fresh reconnect window.
+                    deadline = _time.monotonic() + reconnect_window
+                    backoff = 0.2
+                if reconnect_window <= 0 or _time.monotonic() >= deadline:
+                    if not ever_registered:
+                        raise ConnectionError(
+                            f"could not join head {self.head_address} "
+                            f"within {reconnect_window}s: {last_exc}")
+                    logger.warning(
+                        "Head %s unreachable for %.0fs; daemon exiting",
+                        self.head_address, reconnect_window)
+                    break
+                _time.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+        finally:
+            # Any exit path — orderly shutdown, window expiry, or an
+            # unexpected error (corrupt frame, bad ack) — releases the
+            # object server port, worker pool, and the shm arena.
+            self._teardown()
+
+    def _teardown(self) -> None:
+        if self._object_server is not None:
+            self._object_server.close()
+        if self._pool is not None:
+            self._pool.shutdown()
+        self._table.close()
+
+    def _serve_once(self) -> None:
+        """One connect-register-serve session against the head. Raises
+        ConnectionError/OSError when the connection drops."""
+        self._session_n += 1
         self._sock = socket.create_connection(self.head_address)
         try:
             self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -1035,46 +1104,61 @@ class NodeDaemon:
         # exposure policy must match the control plane's, never 0.0.0.0).
         from ray_tpu._private.dataplane import ObjectServer
         local_ip = self._sock.getsockname()[0]
-        self._object_server = ObjectServer(self._table, host=local_ip)
+        if self._object_server is not None and \
+                self._object_server_host != local_ip:
+            # The head-facing interface changed (multi-homed host / head
+            # moved): the advertised address must match the bind.
+            self._object_server.close()
+            self._object_server = None
+        if self._object_server is None:  # survives same-IP reconnects
+            self._object_server = ObjectServer(self._table, host=local_ip)
+            self._object_server_host = local_ip
         _send_frame(self._sock, _dumps({
             "type": "register",
             "resources": self.resources,
             "labels": self.labels,
             "object_addr": (local_ip, self._object_server.port),
             "store_name": self._table.arena_name,
+            # A restarted head (gcs persistence) rebinds these.
+            "resident_actors": list(self._actors.keys()),
         }), self._send_lock)
         ack = _loads(_recv_frame(self._sock))
         assert ack["type"] == "registered", ack
         self.node_id_hex = ack["node_id"]
+        self._session_registered = True
         logger.info("Registered with head %s as node %s",
                     self.head_address, self.node_id_hex[:12])
-        threading.Thread(target=self._serve_health_channel,
-                         name="ray_tpu-daemon-health",
-                         daemon=True).start()
+        if not self._health_started:
+            # Started ONCE per daemon (even across reconnects): the
+            # health thread reconnects on its own, re-announcing
+            # whatever node_id_hex currently holds.
+            self._health_started = True
+            threading.Thread(target=self._serve_health_channel,
+                             name="ray_tpu-daemon-health",
+                             daemon=True).start()
         try:
             while not self._stop.is_set():
                 msg = _loads(_recv_frame(self._sock))
                 if msg.get("type") == "shutdown":
+                    self._stop.set()
                     break
                 # Serialize function installation: cache raw bytes here on
                 # the recv thread, not in the handler threads.
                 fb = msg.get("fn_bytes")
                 if fb is not None and msg.get("fn_id") is not None:
                     self._fn_raw.setdefault(msg["fn_id"], fb)
-                threading.Thread(target=self._handle, args=(msg,),
+                # Pass THIS session's socket: a handler outliving the
+                # session replies into a closed socket (dropped), never
+                # into a later session whose fresh req_id counter would
+                # collide with this frame's req_id.
+                threading.Thread(target=self._handle,
+                                 args=(self._sock, msg),
                                  daemon=True).start()
-        except (ConnectionError, OSError):
-            pass
         finally:
             try:
                 self._sock.close()
             except OSError:
                 pass
-            if self._object_server is not None:
-                self._object_server.close()
-            if self._pool is not None:
-                self._pool.shutdown()
-            self._table.close()
 
 
 def run_node(address: str, *, num_cpus: float = 1.0, num_tpus: float = 0.0,

@@ -48,6 +48,7 @@ _PY_DEFAULTS: Dict[str, Any] = {
     "testing_dispatch_delay_us": 0,
     "testing_store_delay_us": 0,
     "testing_rpc_failure_pct": 0,
+    "gcs_store_path": "",
     "tpu_autodetect": True,
     "tpu_chips_per_host_default": 4,
     "ici_topology": "",

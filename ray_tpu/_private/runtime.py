@@ -31,6 +31,7 @@ import logging
 import os
 import queue
 import threading
+import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -354,6 +355,30 @@ class Runtime:
         # tasks always run in this chip-owning process.
         self._process_pool = None
         self._proc_tasks: Dict[TaskID, Any] = {}  # task_id → WorkerHandle
+        # GCS persistence (reference: gcs_server.cc:523 Redis-backed
+        # storage): with _system_config={"gcs_store_path": ...}, the
+        # internal KV + named-actor + job tables survive head death; a
+        # restarted head restores them and rebinds daemon-resident
+        # actors as their daemons reconnect.
+        self.gcs_store = None
+        self._kv_mem: Dict[str, Dict[bytes, bytes]] = {}
+        gcs_path = str(self.config.gcs_store_path or "")
+        if gcs_path:
+            from ray_tpu._private.gcs_store import GcsStore
+            self.gcs_store = GcsStore(gcs_path)
+            # Job table (reference: GcsJobManager): the driver's job
+            # record survives head death, so a post-restart head can
+            # answer "what ran here". Keyed process-uniquely: JobID is a
+            # per-process counter, so two driver processes sharing a
+            # store would otherwise clobber each other's records.
+            import uuid as _uuid
+            self._gcs_job_key = f"{job_id.hex()}-{_uuid.uuid4().hex[:8]}"
+            self.gcs_store.record_job(self._gcs_job_key, {
+                "job_id": job_id.hex(),
+                "pid": os.getpid(),
+                "status": "RUNNING",
+                "start_time": time.time(),
+            })
         # Deferred-free queue: ObjectRef.__del__ can fire at any point —
         # including inside the store's non-reentrant lock when a freed value
         # drops the last handle to another object — so handle-death frees
@@ -1219,6 +1244,17 @@ class Runtime:
                         f"{namespace!r}")
                 self._named_actors[(namespace, name)] = actor_id
             self._actors[actor_id] = state
+        if name and self.gcs_store is not None:
+            # Persist OUTSIDE the runtime lock — the store fsyncs a file
+            # per mutation; dispatch must not stall on disk I/O.
+            try:
+                cls_bytes = self.functions.get_bytes(spec.function_id)
+            except KeyError:
+                cls_bytes = None  # unpicklable: cannot survive restarts
+            self.gcs_store.record_actor(
+                actor_id.hex(), name, namespace, max_restarts,
+                max_concurrency, cls_bytes=cls_bytes,
+                resources=dict(spec.resources or {}))
         spec.return_ids = [ObjectID.for_return(spec.task_id, 1)]
         self._register_task_refs(spec)
         self._record_event(spec, "SUBMITTED")
@@ -1533,6 +1569,8 @@ class Runtime:
         with self._lock:
             if state.name:
                 self._named_actors.pop((state.namespace, state.name), None)
+        if self.gcs_store is not None:
+            self.gcs_store.remove_actor(actor_id.hex())
         self._dispatch()
 
     def _restart_actor(self, state: ActorState) -> None:
@@ -1720,7 +1758,42 @@ class Runtime:
             self._head_server.start()
         return self._head_server.address
 
-    def register_remote_node(self, conn) -> NodeID:
+    # -- internal KV (reference: gcs_kv_manager.h InternalKV) ----------
+
+    def kv_put(self, namespace: str, key: bytes, value: bytes,
+               overwrite: bool = True) -> bool:
+        """Returns already_exists (reference internal_kv semantics)."""
+        if self.gcs_store is not None:
+            return self.gcs_store.kv_put(namespace, key, value, overwrite)
+        with self._lock:
+            ns = self._kv_mem.setdefault(namespace, {})
+            existed = key in ns
+            if overwrite or not existed:
+                ns[key] = value
+            return existed
+
+    def kv_get(self, namespace: str, key: bytes):
+        if self.gcs_store is not None:
+            return self.gcs_store.kv_get(namespace, key)
+        with self._lock:
+            return self._kv_mem.get(namespace, {}).get(key)
+
+    def kv_del(self, namespace: str, key: bytes) -> bool:
+        if self.gcs_store is not None:
+            return self.gcs_store.kv_del(namespace, key)
+        with self._lock:
+            return self._kv_mem.get(namespace, {}).pop(key, None) \
+                is not None
+
+    def kv_keys(self, namespace: str, prefix: bytes = b"") -> list:
+        if self.gcs_store is not None:
+            return self.gcs_store.kv_keys(namespace, prefix)
+        with self._lock:
+            return [k for k in self._kv_mem.get(namespace, {})
+                    if k.startswith(prefix)]
+
+    def register_remote_node(self, conn, info: Optional[dict] = None
+                             ) -> NodeID:
         # The connection must be visible BEFORE dispatch can place tasks
         # on the new node — otherwise a queued task assigned to it would
         # find no conn and silently run head-local.
@@ -1728,9 +1801,106 @@ class Runtime:
                                           labels=conn.labels)
         with self._lock:
             self._remote_nodes[node_id] = conn
+        # A daemon reconnecting to a RESTARTED head announces the actor
+        # instances it still hosts; rebind the persisted named ones so
+        # get_actor(name) answers again (reference: GCS restart +
+        # RayletNotifyGCSRestart resubscription).
+        for actor_hex in (info or {}).get("resident_actors") or []:
+            try:
+                self._rebind_remote_actor(conn, node_id, actor_hex)
+            except Exception:  # noqa: BLE001 - best effort per actor
+                logger.exception("failed to rebind actor %s", actor_hex)
         self.scheduler.reschedule_lost_bundles()
         self._dispatch()
         return node_id
+
+    def _rebind_remote_actor(self, conn, node_id: NodeID,
+                             actor_hex: str) -> None:
+        from ray_tpu._private.multinode import RemoteActorInstance
+        rec = (self.gcs_store.actors.get(actor_hex)
+               if self.gcs_store is not None else None)
+        if rec is None:
+            return  # not a persisted actor (or persistence disabled)
+        actor_id = ActorID(bytes.fromhex(actor_hex))
+        cls_bytes = rec.get("cls_bytes")
+        if cls_bytes is not None:
+            # Export BEFORE taking the runtime lock (the function table
+            # has its own locking); an orphan export on the bail-out
+            # paths below is harmless.
+            fn_id = self.functions.export_bytes(cls_bytes)
+        resources = dict(rec.get("resources") or {})
+        stale = False
+        with self._lock:
+            existing = self._actors.get(actor_id)
+            if existing is not None and not existing.dead:
+                # Same-life daemon reconnect: refresh the wire proxy and
+                # the placement so node-death handling tracks the NEW
+                # connection.
+                existing.instance = RemoteActorInstance(conn, actor_id)
+                existing.creation_spec._node_id = node_id  # type: ignore
+                return
+            if existing is not None:
+                return  # died in this head's eyes; do not resurrect
+            name_owner = self._named_actors.get(
+                (rec["namespace"], rec["name"])) if rec["name"] else None
+            if name_owner is not None and name_owner != actor_id:
+                stale = True  # handled below, outside the lock
+            elif cls_bytes is None:
+                return  # unpicklable class: handles cannot be rebuilt
+            else:
+                # Name check and registration happen under ONE lock
+                # acquisition: a concurrent create_actor can never claim
+                # the name between our check and our insert.
+                spec = TaskSpec(
+                    task_id=TaskID.for_normal_task(self.job_id),
+                    kind=TaskKind.ACTOR_CREATION, function_id=fn_id,
+                    args=(), kwargs={}, resources=resources,
+                    num_returns=1, name=rec["name"] or "actor",
+                    actor_id=actor_id)
+                # Node-death bookkeeping must see where the instance
+                # lives, and release needs the acquire marker.
+                spec._node_id = node_id  # type: ignore[attr-defined]
+                spec._acquired_bundle = -1  # type: ignore[attr-defined]
+                # Rebound actors cannot be restarted in place (their
+                # creation args died with the old head) — max_restarts=0.
+                state = ActorState(actor_id, spec, 0,
+                                   rec["max_concurrency"],
+                                   rec["name"], rec["namespace"])
+                state.instance = RemoteActorInstance(conn, actor_id)
+                state.executor = self._make_actor_executor(state)
+                state.created.set()
+                self._actors[actor_id] = state
+                if rec["name"]:
+                    self._named_actors[(rec["namespace"], rec["name"])] = \
+                        actor_id
+        if stale:
+            # A NEW actor took this name on the restarted head before
+            # the old daemon reconnected — the live one wins; drop the
+            # stale record and tear down the zombie instance.
+            logger.warning(
+                "Not rebinding stale actor %s: name %r is taken by a "
+                "newer actor", actor_hex[:12], rec["name"])
+            if self.gcs_store is not None:
+                self.gcs_store.remove_actor(actor_hex)
+            # Deferred: the handshake thread holds conn._send_lock (the
+            # ack must be the daemon's first frame) and destroy_actor
+            # sends on that same non-reentrant lock — a direct call here
+            # deadlocks the registration. The helper thread parks on the
+            # lock and the destroy frame goes out right after the ack.
+            threading.Thread(
+                target=lambda: conn.destroy_actor(actor_id),
+                name="ray_tpu-stale-actor-destroy", daemon=True).start()
+            return
+        # The resident instance still consumes its creation resources on
+        # that node — re-reserve them so the restarted head cannot
+        # double-book the chips/CPUs (force: the node just (re)joined
+        # advertising its FULL capacity, and the actor's claim predates
+        # any new scheduling).
+        if resources:
+            self.scheduler.force_acquire(resources, node_id)
+        logger.info("Rebound daemon-resident actor %s (%s) after head "
+                    "restart", rec["name"] or actor_hex[:12],
+                    actor_hex[:12])
 
     def unregister_remote_node(self, node_id: NodeID) -> None:
         with self._lock:
@@ -2152,6 +2322,12 @@ class Runtime:
 
     def shutdown(self) -> None:
         from ray_tpu.exceptions import RayError
+        if self.gcs_store is not None:
+            rec = self.gcs_store.jobs.get(self._gcs_job_key)
+            if rec is not None:
+                rec = dict(rec, status="FINISHED",
+                           end_time=time.time())
+                self.gcs_store.record_job(self._gcs_job_key, rec)
         if self._head_server is not None:
             self._head_server.stop()
             self._head_server = None

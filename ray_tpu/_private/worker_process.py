@@ -233,6 +233,14 @@ class WorkerProcessPool:
             raise WorkerCrashedError("worker pool is shut down")
 
     def release(self, w: WorkerHandle) -> None:
+        if w.dead:
+            # Reap killed workers here (the force-cancel/OOM path kills
+            # with wait=False while holding the runtime lock): without
+            # the wait() the SIGKILLed process lingers as a zombie.
+            try:
+                w.proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 - already reaped / stuck
+                pass
         with self._lock:
             if not w.dead and not self._closed and w.actor_id is None:
                 self._idle.append(w)

@@ -30,6 +30,10 @@ class ScalingConfig:
     # TPU-native: the mesh each worker should build over its chips
     # (a parallel.MeshConfig); None -> pure DP over workers.
     mesh: Optional[Any] = None
+    # Runtime env for each train worker actor. {"worker_process": True}
+    # puts every rank in its own OS process — required for true
+    # multi-controller jax.distributed training on one host.
+    runtime_env: Optional[Dict[str, Any]] = None
 
     @property
     def use_gpu(self) -> bool:  # reference-compat alias

@@ -1,0 +1,51 @@
+"""Internal key-value store (reference: ray.experimental.internal_kv →
+GCS InternalKV, src/ray/gcs/gcs_server/gcs_kv_manager.h).
+
+Head-resident; persisted to disk when the cluster runs with
+``_system_config={"gcs_store_path": ...}`` so the table survives head
+restarts (the reference's Redis-backed mode)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def _runtime():
+    from ray_tpu._private.worker import global_worker
+    runtime = getattr(global_worker, "_runtime", None)
+    if runtime is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return runtime
+
+
+def _internal_kv_initialized() -> bool:
+    from ray_tpu._private.worker import global_worker
+    return getattr(global_worker, "_runtime", None) is not None
+
+
+def _as_bytes(v) -> bytes:
+    return v.encode() if isinstance(v, str) else bytes(v)
+
+
+def _internal_kv_put(key, value, overwrite: bool = True,
+                     namespace: str = "default") -> bool:
+    """Returns ``already_exists`` — True iff the key was present before
+    this put (reference: ray.experimental.internal_kv semantics)."""
+    return _runtime().kv_put(namespace, _as_bytes(key), _as_bytes(value),
+                             overwrite)
+
+
+def _internal_kv_get(key, namespace: str = "default") -> Optional[bytes]:
+    return _runtime().kv_get(namespace, _as_bytes(key))
+
+
+def _internal_kv_exists(key, namespace: str = "default") -> bool:
+    return _runtime().kv_get(namespace, _as_bytes(key)) is not None
+
+
+def _internal_kv_del(key, namespace: str = "default") -> bool:
+    return _runtime().kv_del(namespace, _as_bytes(key))
+
+
+def _internal_kv_list(prefix, namespace: str = "default") -> List[bytes]:
+    return _runtime().kv_keys(namespace, _as_bytes(prefix))

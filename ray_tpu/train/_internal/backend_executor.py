@@ -46,7 +46,8 @@ class BackendExecutor:
             self.scaling_config.num_workers,
             self.scaling_config.worker_resources(),
             self.scaling_config.placement_strategy,
-            bundles=self.scaling_config.as_placement_group_bundles())
+            bundles=self.scaling_config.as_placement_group_bundles(),
+            runtime_env=getattr(self.scaling_config, "runtime_env", None))
         self.backend.on_start(self.worker_group, self.backend_config)
 
     def run(self, train_fn: Callable, config: dict, trial_info: dict,

@@ -42,7 +42,7 @@ class TrainWorker:
         return {"rank": self.world_rank, "hostname": socket.gethostname(),
                 "tpu_ids": ray_tpu.get_tpu_ids()}
 
-    def _jax_distributed_init(self) -> None:
+    def jax_distributed_init(self) -> None:
         from ray_tpu.train.jax import distributed_init_if_needed
         distributed_init_if_needed()
 
@@ -119,7 +119,8 @@ class WorkerGroup:
     def __init__(self, num_workers: int,
                  resources_per_worker: Dict[str, float],
                  placement_strategy: str = "PACK",
-                 bundles: Optional[List[Dict[str, float]]] = None):
+                 bundles: Optional[List[Dict[str, float]]] = None,
+                 runtime_env: Optional[Dict[str, Any]] = None):
         self.num_workers = num_workers
         self._pg: Optional[PlacementGroup] = placement_group(
             bundles or [dict(resources_per_worker)
@@ -135,6 +136,7 @@ class WorkerGroup:
                 placement_group=self._pg,
                 placement_group_bundle_index=rank,
                 max_concurrency=4,
+                runtime_env=runtime_env,
             )
             self.workers.append(worker_cls.remote(rank, num_workers))
 

@@ -68,13 +68,38 @@ class _JaxBackend(Backend):
         # a follow-up call is not a barrier under max_concurrency > 1.
         ray_tpu.get(env_refs)
         if multi_process:
-            worker_group.execute("_jax_distributed_init")
+            worker_group.execute("jax_distributed_init")
 
 
 def distributed_init_if_needed() -> None:
-    """Call jax.distributed.initialize from coordinator env, once."""
+    """Call jax.distributed.initialize from coordinator env, once.
+
+    RAY_TPU_JAX_PLATFORM=cpu selects the CPU backend with gloo
+    cross-process collectives — the fake-TPU analog for testing true
+    multi-controller training on one host (SURVEY §4: fake accelerators
+    stand in for device fleets). Must run before the first device use."""
+    platform = os.environ.get("RAY_TPU_JAX_PLATFORM")
+    if platform == "cpu":
+        # One device per process: gloo cross-process collectives deadlock
+        # when xla_force_host_platform_device_count (inherited from the
+        # spawning test process) multiplies the local device count — and
+        # one-device-per-rank is the faithful analog of one-chip-per-host
+        # multi-controller TPU anyway. Must happen before backend init.
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = " ".join(
+            f for f in flags.split()
+            if "xla_force_host_platform_device_count" not in f)
+        os.environ["XLA_FLAGS"] = flags
+    import jax
+    if platform:
+        jax.config.update("jax_platforms", platform)
+        if platform == "cpu":
+            try:
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception:  # noqa: BLE001 - older jax: no gloo knob
+                pass
     if os.environ.get("JAX_COORDINATOR_ADDRESS"):
-        import jax
         try:
             jax.distributed.initialize(
                 coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],

@@ -103,6 +103,7 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     FLAG_BOOL(tpu_autodetect, true),
     FLAG_INT(tpu_chips_per_host_default, 4),
     FLAG_STR(ici_topology, ""),
+    FLAG_STR(gcs_store_path, ""),
     FLAG_BOOL(use_native_scheduler, true),
     FLAG_BOOL(use_native_object_store, true),
     FLAG_BOOL(use_native_refcount, true),

@@ -1,0 +1,120 @@
+"""True multi-controller Train e2e: ≥2 train-worker OS PROCESSES run
+jax.distributed.initialize (CPU backend + gloo cross-process
+collectives), build the SAME global mesh, and train data-parallel with
+loss parity against a single-process run.
+
+This is the deterministic-multi-controller hard part from SURVEY §7 —
+the thing `jax.distributed` + identical meshes must guarantee — finally
+exercised with real processes (the reference's analog:
+_TorchBackend.on_start's dist.init_process_group across Train worker
+actors, train/torch/config.py:113)."""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+
+# Worker processes cannot import the tests/ directory — ship this
+# module's functions by value.
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+from ray_tpu.air import session
+from ray_tpu.air.config import ScalingConfig
+from ray_tpu.train.jax import JaxBackendConfig, JaxTrainer
+
+STEPS = 40
+LR = 0.3
+
+
+def _global_data():
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    true_w = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    y = x @ true_w + 0.7
+    return x, y.astype(np.float32)
+
+
+def train_loop(config):
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.train.jax import distributed_init_if_needed
+    distributed_init_if_needed()
+    world = jax.process_count()
+    rank = jax.process_index()
+
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    x, y = config["data"]
+    n = x.shape[0]
+    per = n // world
+    local_x = x[rank * per:(rank + 1) * per]
+    local_y = y[rank * per:(rank + 1) * per]
+    with mesh:
+        dp = NamedSharding(mesh, P("dp"))
+        rep = NamedSharding(mesh, P())
+        gx = jax.make_array_from_process_local_data(dp, local_x, x.shape)
+        gy = jax.make_array_from_process_local_data(dp, local_y, y.shape)
+        w = jax.device_put(jnp.zeros((4,), jnp.float32), rep)
+        b = jax.device_put(jnp.zeros((), jnp.float32), rep)
+
+        @jax.jit
+        def step(w, b, gx, gy):
+            def loss_fn(w, b):
+                pred = gx @ w + b
+                return jnp.mean((pred - gy) ** 2)
+            loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(w, b)
+            return (w - LR * grads[0], b - LR * grads[1], loss)
+
+        for _ in range(STEPS):
+            w, b, loss = step(w, b, gx, gy)
+        session.report({
+            "loss": float(loss),
+            "w": np.asarray(w).tolist(),
+            "b": float(b),
+            "world": world,
+            "pid": os.getpid(),
+        })
+
+
+def _run(num_workers: int, force_distributed: bool):
+    trainer = JaxTrainer(
+        train_loop,
+        train_loop_config={"data": _global_data()},
+        backend_config=JaxBackendConfig(
+            force_distributed_init=force_distributed,
+            coordinator_port=47654),
+        scaling_config=ScalingConfig(
+            num_workers=num_workers,
+            resources_per_worker={"CPU": 1},
+            runtime_env={
+                "worker_process": True,
+                "env_vars": {"RAY_TPU_JAX_PLATFORM": "cpu"},
+            }),
+    )
+    return trainer.fit()
+
+
+def test_two_process_jax_distributed_loss_parity(ray_start_regular):
+    multi = _run(num_workers=2, force_distributed=True)
+    single = _run(num_workers=1, force_distributed=False)
+
+    m, s = multi.metrics, single.metrics
+    assert m["world"] == 2
+    assert s["world"] == 1
+    # Two REAL processes (not threads in one interpreter).
+    assert m["pid"] != s["pid"]
+    # Deterministic multi-controller parity: the data-parallel run over
+    # two processes computes the same trajectory as the single process.
+    assert m["loss"] == pytest.approx(s["loss"], rel=1e-5)
+    np.testing.assert_allclose(m["w"], s["w"], rtol=1e-5)
+    assert m["b"] == pytest.approx(s["b"], rel=1e-5)
+    # And it genuinely learned.
+    assert m["loss"] < 0.05
