@@ -32,6 +32,13 @@ class ExecutionOptions:
     ExecutionResources limits on the StreamingExecutor)."""
 
     max_in_flight_per_operator: int = 8
+    # Topology-wide cap on bytes buffered in flight (launched inputs +
+    # unconsumed outputs). When exceeded, upstream operators stop
+    # LAUNCHING (downstream keeps draining), so peak memory is bounded
+    # by this budget instead of the dataset size (reference: operator
+    # resource accounting in streaming_executor.py:23 /
+    # ExecutionResources).
+    max_in_flight_bytes: int = 512 * 1024 * 1024
 
 
 class PhysicalOperator:
@@ -52,8 +59,24 @@ class PhysicalOperator:
     def all_inputs_done(self) -> None:
         self._inputs_done = True
 
-    def work(self) -> None:
-        """Launch new tasks / collect finished ones (non-blocking)."""
+    def work(self, byte_budget: float = float("inf")) -> None:
+        """Launch new tasks / collect finished ones (non-blocking).
+        ``byte_budget`` is how many in-flight + output bytes this
+        operator may hold before it must stop LAUNCHING (collection
+        always proceeds); the executor derives it from
+        ``ExecutionOptions.max_in_flight_bytes`` minus what downstream
+        operators are already holding."""
+
+    def active_refs(self) -> List[Any]:
+        """Refs the executor may block on instead of sleep-polling: one
+        becoming ready means ``work`` can make progress."""
+        return []
+
+    def buffered_bytes(self) -> int:
+        """Bytes this operator holds in flight: launched-but-unfinished
+        inputs plus produced-but-unconsumed outputs. Drives topology
+        backpressure."""
+        return 0
 
     def has_next(self) -> bool:
         raise NotImplementedError

@@ -58,6 +58,10 @@ class MapOperator(PhysicalOperator):
         self._queue: List[RefBundle] = []       # not yet launched
         self._in_flight: List[tuple] = []       # ordered (out_ref, meta_ref)
         self._outputs: List[RefBundle] = []
+        self._in_flight_bytes = 0               # launched input payloads
+        self._out_bytes = 0                     # unconsumed output payloads
+        self._queue_bytes = 0                   # queued (unlaunched) inputs
+        self._launch_bytes: Dict[int, int] = {}  # id(refs) -> input bytes
         self._pool = None
         self._per_actor: Dict[int, int] = {}
         self._actor_cap = 0
@@ -91,10 +95,22 @@ class MapOperator(PhysicalOperator):
         # be launched.
         for block_ref, meta in bundle.blocks:
             self._queue.append(RefBundle([(block_ref, meta)]))
+            self._queue_bytes += self._meta_bytes(meta)
 
-    def work(self) -> None:
-        # Launch while capacity remains.
+    @staticmethod
+    def _meta_bytes(meta) -> int:
+        size = getattr(meta, "size_bytes", None)
+        return int(size) if size else 0
+
+    def work(self, byte_budget: float = float("inf")) -> None:
+        # Launch while count capacity remains AND the byte budget allows
+        # more in-flight/output payload. The first launch is always
+        # permitted when nothing is in flight (a single block larger than
+        # the whole budget must still make progress).
         while self._queue and len(self._in_flight) < self._max_in_flight:
+            if self._in_flight and \
+                    self._in_flight_bytes + self._out_bytes >= byte_budget:
+                break
             bundle = self._queue[0]
             block_ref = bundle.blocks[0][0]
             if self._pool is not None:
@@ -114,6 +130,10 @@ class MapOperator(PhysicalOperator):
             else:
                 refs = self._task.remote(block_ref, self._fn_bytes, False)
             self._queue.pop(0)
+            in_bytes = self._meta_bytes(bundle.blocks[0][1])
+            self._queue_bytes -= in_bytes
+            self._in_flight_bytes += in_bytes
+            self._launch_bytes[id(refs)] = in_bytes
             self._in_flight.append(refs)
         # Collect from the head (in-order): anything ready moves to outputs.
         while self._in_flight:
@@ -122,17 +142,30 @@ class MapOperator(PhysicalOperator):
             if not ready:
                 break
             self._in_flight.pop(0)
+            self._in_flight_bytes -= self._launch_bytes.pop(id(head), 0)
             if self._pool is not None:
                 target = self._actor_of.pop(id(head), None)
                 if target is not None:
                     self._per_actor[target] -= 1
-            self._outputs.append(RefBundle([(head[0], head[1])]))
+            # Resolve the (ready) metadata here: downstream operators and
+            # the executor's byte accounting get concrete sizes for free.
+            meta = ray_tpu.get(head[1])
+            self._out_bytes += self._meta_bytes(meta)
+            self._outputs.append(RefBundle([(head[0], meta)]))
+
+    def active_refs(self) -> List[Any]:
+        return [refs[1] for refs in self._in_flight]
+
+    def buffered_bytes(self) -> int:
+        return self._queue_bytes + self._in_flight_bytes + self._out_bytes
 
     def has_next(self) -> bool:
         return bool(self._outputs)
 
     def get_next(self) -> RefBundle:
-        return self._outputs.pop(0)
+        out = self._outputs.pop(0)
+        self._out_bytes -= self._meta_bytes(out.blocks[0][1])
+        return out
 
     def completed(self) -> bool:
         return (self._inputs_done and not self._queue
@@ -168,7 +201,9 @@ class AllToAllOperator(PhysicalOperator):
             self._in_blocks.append(block_ref)
             self._in_metas.append(meta)
 
-    def work(self) -> None:
+    def work(self, byte_budget: float = float("inf")) -> None:
+        # A barrier stage is exempt from launch throttling: it runs once
+        # over the full input set and blocks the chain until done.
         if self._inputs_done and not self._ran:
             self._ran = True
             metas = [ray_tpu.get(m) if isinstance(m, ray_tpu.ObjectRef)
