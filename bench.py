@@ -108,6 +108,49 @@ def bench_core_ops() -> dict:
     return out
 
 
+def bench_rllib() -> dict:
+    """The second north-star metric (BASELINE.json: "RLlib PPO Atari
+    with JAX policy learner: env-steps/sec"): PPO with the CNN policy on
+    the synthetic Atari-shaped env (84x84x4 uint8 after the deepmind
+    wrapper stack; reference harness: tuned_examples/ppo/atari-ppo.yaml)
+    — measures the full rollout(actors) + GAE + minibatch-SGD loop."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.env.atari import make_synthetic_atari
+
+    out = {}
+    ray_tpu.init(num_cpus=8)
+    try:
+        config = (PPOConfig()
+                  .environment(make_synthetic_atari,
+                               env_config={"drops": 8})
+                  .rollouts(num_rollout_workers=4,
+                            rollout_fragment_length=256)
+                  .training(lr=3e-4, train_batch_size=4096, num_sgd_iter=4,
+                            sgd_minibatch_size=512,
+                            model={"conv_filters": [[16, 8, 4], [32, 4, 2],
+                                                    [64, 3, 2]],
+                                   "post_fcnet_dim": 256})
+                  .debugging(seed=0))
+        algo = config.build()
+        algo.train()  # warmup: jit compile of policy fwd/bwd
+        t0 = _time.perf_counter()
+        iters = 2
+        for _ in range(iters):
+            res = algo.train()
+        dt = _time.perf_counter() - t0
+        steps = iters * config.train_batch_size
+        out["rllib_env_steps_per_sec"] = round(steps / dt, 1)
+        out["rllib_reward_mean"] = round(
+            float(res.get("episode_reward_mean", float("nan"))), 2)
+        algo.stop()
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -183,6 +226,10 @@ def main():
         extra = bench_core_ops()
     except Exception:  # noqa: BLE001 - extras must not sink the headline
         extra = {}
+    try:
+        extra.update(bench_rllib())
+    except Exception:  # noqa: BLE001 - extras must not sink the headline
+        extra.setdefault("rllib_env_steps_per_sec", None)
 
     result = {
         "metric": f"{preset}_train_tokens_per_sec_per_chip",

@@ -33,6 +33,7 @@ class AlgorithmConfig:
         self.observation_filter: str = "NoFilter"
         self.clip_actions: bool = True
         self.conv_filters = None
+        self.post_fcnet_dim: int = 256
         # offline data (reference: rllib/offline/)
         self.output: Any = None  # dir path → rollout workers write JSON
         self.input_: Any = None  # dir path → train from offline JSON
@@ -82,6 +83,11 @@ class AlgorithmConfig:
         if model:
             if "fcnet_hiddens" in model:
                 self.fcnet_hiddens = tuple(model["fcnet_hiddens"])
+            if "conv_filters" in model:
+                self.conv_filters = [list(f)
+                                     for f in model["conv_filters"]]
+            if "post_fcnet_dim" in model:
+                self.post_fcnet_dim = int(model["post_fcnet_dim"])
         self.extra.update(kwargs)
         return self
 
@@ -178,6 +184,7 @@ class AlgorithmConfig:
             "lambda": self.extra.get("lambda", 0.95),
             "fcnet_hiddens": tuple(self.fcnet_hiddens),
             "conv_filters": self.conv_filters,
+            "post_fcnet_dim": self.post_fcnet_dim,
             "env_config": self.env_config,
             "policy_class": self.policy_class_name,
             "observation_filter": self.observation_filter,

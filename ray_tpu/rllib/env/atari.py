@@ -1,0 +1,365 @@
+"""Atari preprocessing wrappers + a dependency-free Atari-shaped env.
+
+Analog of the reference's rllib/env/wrappers/atari_wrappers.py (the
+deepmind preprocessing stack: NoopReset, MaxAndSkip, EpisodicLife,
+FireReset, WarpFrame, ClipReward, FrameStack, wrap_deepmind) — rebuilt
+without cv2: the 84x84 warp is an area-weighted numpy resize, and frames
+stay uint8 end-to-end (the CNN catalog scales to [0,1] inside jit, so
+sample batches are 4x smaller than float32).
+
+Because ALE is not a baked-in dependency, :class:`SyntheticAtariEnv`
+provides a 210x160x3 uint8 game (Catch at Atari geometry: a falling ball,
+a player paddle, +1/-1 reward per drop) with real credit-assignment
+structure — a CNN policy must localize the ball and move the paddle to
+score. It drives the PPO pixels-per-second north-star bench
+(BASELINE.json: "RLlib PPO Atari with JAX policy learner") and the
+pixel-pipeline regression tests on any machine; plugging a real
+``gymnasium.make("ALE/...")`` env into ``wrap_deepmind`` uses the exact
+same wrapper stack.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+try:  # spaces only; the wrappers work with any gymnasium-API env
+    from gymnasium import spaces
+except ImportError:  # pragma: no cover - gymnasium is a baked-in dep
+    spaces = None
+
+
+# ---------------------------------------------------------------------------
+# Wrappers (gymnasium API: reset(seed=...) -> (obs, info);
+#           step(a) -> (obs, reward, terminated, truncated, info))
+# ---------------------------------------------------------------------------
+
+
+class _Wrapper:
+    """Minimal wrapper base (duck-typed; works with any gymnasium-API
+    env, including other wrappers)."""
+
+    def __init__(self, env):
+        self.env = env
+        self.observation_space = env.observation_space
+        self.action_space = env.action_space
+
+    def reset(self, *, seed: Optional[int] = None, options=None):
+        return self.env.reset(seed=seed)
+
+    def step(self, action):
+        return self.env.step(action)
+
+    def __getattr__(self, name):  # delegate e.g. .ale, .unwrapped
+        return getattr(self.env, name)
+
+    @property
+    def unwrapped(self):
+        return getattr(self.env, "unwrapped", self.env)
+
+
+class NoopResetEnv(_Wrapper):
+    """Start each episode with a random number of no-ops (reference:
+    atari_wrappers.py NoopResetEnv) so deterministic envs don't yield a
+    single start state."""
+
+    def __init__(self, env, noop_max: int = 30, noop_action: int = 0):
+        super().__init__(env)
+        self.noop_max = noop_max
+        self.noop_action = noop_action
+        self._rng = np.random.default_rng(0)
+
+    def reset(self, *, seed: Optional[int] = None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        obs, info = self.env.reset(seed=seed)
+        for _ in range(int(self._rng.integers(1, self.noop_max + 1))):
+            obs, _, terminated, truncated, info = self.env.step(
+                self.noop_action)
+            if terminated or truncated:
+                obs, info = self.env.reset()
+        return obs, info
+
+
+class MaxAndSkipEnv(_Wrapper):
+    """Repeat the action ``skip`` frames; observe the pixelwise max of
+    the last two (ALE sprites flicker on alternate frames)."""
+
+    def __init__(self, env, skip: int = 4):
+        super().__init__(env)
+        self.skip = skip
+
+    def step(self, action):
+        total = 0.0
+        frames = []
+        terminated = truncated = False
+        info = {}
+        obs = None
+        for _ in range(self.skip):
+            obs, reward, terminated, truncated, info = self.env.step(action)
+            frames.append(obs)
+            total += float(reward)
+            if terminated or truncated:
+                break
+        if len(frames) >= 2:
+            obs = np.maximum(frames[-1], frames[-2])
+        return obs, total, terminated, truncated, info
+
+
+class EpisodicLifeEnv(_Wrapper):
+    """End the learning episode on each life lost (value bootstraps stay
+    honest) while only truly resetting the game when it's over. Requires
+    an ALE-style ``lives()``; pass-through otherwise."""
+
+    def __init__(self, env):
+        super().__init__(env)
+        self._lives = 0
+        self._real_done = True
+
+    def _env_lives(self) -> Optional[int]:
+        ale = getattr(self.unwrapped, "ale", None)
+        if ale is not None:
+            return ale.lives()
+        lives = getattr(self.unwrapped, "lives", None)
+        return lives() if callable(lives) else None
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        self._real_done = terminated or truncated
+        lives = self._env_lives()
+        if lives is not None and 0 < lives < self._lives:
+            terminated = True
+        if lives is not None:
+            self._lives = lives
+        return obs, reward, terminated, truncated, info
+
+    def reset(self, *, seed: Optional[int] = None, options=None):
+        if self._real_done:
+            obs, info = self.env.reset(seed=seed)
+        else:  # life lost: keep playing from the current state
+            obs, _, terminated, truncated, info = self.env.step(0)
+            if terminated or truncated:
+                obs, info = self.env.reset(seed=seed)
+        lives = self._env_lives()
+        self._lives = lives if lives is not None else 0
+        return obs, info
+
+
+class FireResetEnv(_Wrapper):
+    """Press FIRE after reset for games that need it to start. Applied
+    only when the env's action meanings include FIRE."""
+
+    def __init__(self, env, fire_action: int = 1):
+        super().__init__(env)
+        self.fire_action = fire_action
+
+    def reset(self, *, seed: Optional[int] = None, options=None):
+        obs, info = self.env.reset(seed=seed)
+        obs, _, terminated, truncated, info = self.env.step(self.fire_action)
+        if terminated or truncated:
+            obs, info = self.env.reset(seed=seed)
+        return obs, info
+
+
+def _area_resize(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """Area-interpolated grayscale resize in pure numpy (the cv2
+    INTER_AREA replacement). Splits each axis into ``out`` nearly-equal
+    pixel bins and averages — exact for integer ratios, well-behaved for
+    210->84 / 160->84."""
+    h, w = img.shape
+    # Bin edges: out_h+1 monotone integers covering [0, h].
+    ye = (np.arange(out_h + 1) * h) // out_h
+    xe = (np.arange(out_w + 1) * w) // out_w
+    # Row-sum prefix trick: cumulative sums make each bin an O(1) slice.
+    csum = np.zeros((h + 1, w + 1), np.float64)
+    csum[1:, 1:] = np.cumsum(np.cumsum(img, axis=0), axis=1)
+    areas = ((ye[1:] - ye[:-1])[:, None] * (xe[1:] - xe[:-1])[None, :])
+    sums = (csum[ye[1:]][:, xe[1:]] - csum[ye[1:]][:, xe[:-1]]
+            - csum[ye[:-1]][:, xe[1:]] + csum[ye[:-1]][:, xe[:-1]])
+    return sums / areas
+
+
+class WarpFrame(_Wrapper):
+    """RGB -> grayscale, resized to ``dim``x``dim`` uint8 (the deepmind
+    84x84 warp)."""
+
+    LUMA = np.array([0.299, 0.587, 0.114], np.float32)
+
+    def __init__(self, env, dim: int = 84):
+        super().__init__(env)
+        self.dim = dim
+        if spaces is not None:
+            self.observation_space = spaces.Box(
+                0, 255, (dim, dim, 1), np.uint8)
+
+    def _warp(self, frame):
+        gray = np.asarray(frame, np.float32) @ self.LUMA
+        out = _area_resize(gray, self.dim, self.dim)
+        return np.clip(out, 0, 255).astype(np.uint8)[..., None]
+
+    def reset(self, *, seed: Optional[int] = None, options=None):
+        obs, info = self.env.reset(seed=seed)
+        return self._warp(obs), info
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        return self._warp(obs), reward, terminated, truncated, info
+
+
+class ClipRewardEnv(_Wrapper):
+    """sign(reward): the deepmind cross-game reward normalization."""
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        return obs, float(np.sign(reward)), terminated, truncated, info
+
+
+class FrameStackEnv(_Wrapper):
+    """Stack the last ``k`` frames on the channel axis (uint8 in, uint8
+    out): 84x84x1 k=4 -> 84x84x4, the velocity information a single
+    frame lacks."""
+
+    def __init__(self, env, k: int = 4):
+        super().__init__(env)
+        self.k = k
+        self._frames: list = []
+        shp = env.observation_space.shape
+        if spaces is not None:
+            self.observation_space = spaces.Box(
+                0, 255, (shp[0], shp[1], shp[2] * k), np.uint8)
+
+    def _obs(self):
+        return np.concatenate(self._frames, axis=-1)
+
+    def reset(self, *, seed: Optional[int] = None, options=None):
+        obs, info = self.env.reset(seed=seed)
+        self._frames = [obs] * self.k
+        return self._obs(), info
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        self._frames = self._frames[1:] + [obs]
+        return self._obs(), reward, terminated, truncated, info
+
+
+def _action_meanings(env) -> Tuple[str, ...]:
+    fn = getattr(getattr(env, "unwrapped", env), "get_action_meanings", None)
+    try:
+        return tuple(fn()) if callable(fn) else ()
+    except Exception:  # noqa: BLE001 - non-ALE env
+        return ()
+
+
+def wrap_deepmind(env, dim: int = 84, framestack: int = 4,
+                  frameskip: int = 4, episodic_life: bool = True,
+                  clip_rewards: bool = True, noop_max: int = 30):
+    """The full deepmind stack (reference: atari_wrappers.py
+    wrap_deepmind), in the canonical order."""
+    meanings = _action_meanings(env)
+    if noop_max > 0:
+        env = NoopResetEnv(env, noop_max=noop_max)
+    if frameskip > 1:
+        env = MaxAndSkipEnv(env, skip=frameskip)
+    if episodic_life:
+        env = EpisodicLifeEnv(env)
+    if "FIRE" in meanings:
+        env = FireResetEnv(env, fire_action=meanings.index("FIRE"))
+    env = WarpFrame(env, dim=dim)
+    if clip_rewards:
+        env = ClipRewardEnv(env)
+    if framestack > 1:
+        env = FrameStackEnv(env, k=framestack)
+    return env
+
+
+# ---------------------------------------------------------------------------
+# Synthetic Atari-shaped env
+# ---------------------------------------------------------------------------
+
+
+class SyntheticAtariEnv:
+    """Catch at Atari geometry: 210x160x3 uint8 frames, a ball falling
+    from a random column, a paddle on the bottom row driven by
+    {NOOP, LEFT, RIGHT}. +1 per catch, -1 per miss, ``drops`` drops per
+    episode. Solvable only by reading the pixels (ball x vs paddle x), so
+    a learning curve here certifies the full CNN pipeline.
+    """
+
+    BALL = 8        # ball edge, px
+    PADDLE_W = 24
+    PADDLE_H = 6
+    H, W = 210, 160
+    STEP_X = 8      # paddle speed px/step
+    FALL = 6        # ball speed px/step
+
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self.drops = int(config.get("drops", 8))
+        self.FALL = int(config.get("fall", self.FALL))
+        self._seed = int(config.get("seed", 0))
+        self._rng = np.random.default_rng(self._seed)
+        if spaces is not None:
+            self.observation_space = spaces.Box(
+                0, 255, (self.H, self.W, 3), np.uint8)
+            self.action_space = spaces.Discrete(3)
+        self._frame = np.zeros((self.H, self.W, 3), np.uint8)
+
+    def get_action_meanings(self):
+        return ["NOOP", "LEFT", "RIGHT"]
+
+    def _render(self) -> np.ndarray:
+        f = self._frame
+        f[:] = 0
+        by, bx = int(self.ball_y), int(self.ball_x)
+        f[max(by, 0):by + self.BALL, bx:bx + self.BALL, :] = (255, 255, 255)
+        py = self.H - self.PADDLE_H
+        px = int(self.paddle_x)
+        f[py:, px:px + self.PADDLE_W, :] = (92, 186, 92)
+        return f.copy()
+
+    def _new_drop(self):
+        self.ball_x = int(self._rng.integers(0, self.W - self.BALL))
+        self.ball_y = 0
+
+    def reset(self, *, seed: Optional[int] = None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self.paddle_x = (self.W - self.PADDLE_W) // 2
+        self.drops_left = self.drops
+        self._new_drop()
+        return self._render(), {}
+
+    def step(self, action):
+        action = int(action)
+        if action == 1:
+            self.paddle_x = max(self.paddle_x - self.STEP_X, 0)
+        elif action == 2:
+            self.paddle_x = min(self.paddle_x + self.STEP_X,
+                                self.W - self.PADDLE_W)
+        self.ball_y += self.FALL
+        reward = 0.0
+        if self.ball_y + self.BALL >= self.H - self.PADDLE_H:
+            caught = (self.paddle_x - self.BALL < self.ball_x
+                      < self.paddle_x + self.PADDLE_W)
+            reward = 1.0 if caught else -1.0
+            self.drops_left -= 1
+            if self.drops_left > 0:
+                self._new_drop()
+        terminated = self.drops_left <= 0
+        return self._render(), reward, terminated, False, {}
+
+
+def make_synthetic_atari(config: Optional[dict] = None):
+    """Env-creator for ``.environment(make_synthetic_atari)``: the
+    synthetic game under the standard deepmind wrapper stack (no
+    episodic-life/noop: the synthetic game has no lives and a random
+    first drop already decorrelates starts)."""
+    config = dict(config or {})
+    framestack = int(config.pop("framestack", 4))
+    frameskip = int(config.pop("frameskip", 1))
+    dim = int(config.pop("dim", 84))
+    env = SyntheticAtariEnv(config)
+    return wrap_deepmind(env, dim=dim, framestack=framestack,
+                         frameskip=frameskip, episodic_life=False,
+                         noop_max=0)

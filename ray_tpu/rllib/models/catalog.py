@@ -99,8 +99,16 @@ class ModelCatalog:
 
         strides = [s for _, _, s in filters]
 
+        # Pixel observations (uint8 spaces, e.g. wrapped Atari) are kept
+        # uint8 end-to-end on the host (4x smaller sample batches) and
+        # scaled to [0, 1] here, inside the jitted apply — the reference
+        # does the same normalization in its vision models.
+        scale = (np.float32(1.0 / 255.0)
+                 if getattr(obs_space, "dtype", None) == np.uint8
+                 else np.float32(1.0))
+
         def apply(params, obs):
-            x = obs.reshape((-1, h, w, c)).astype(jnp.float32)
+            x = obs.reshape((-1, h, w, c)).astype(jnp.float32) * scale
             for conv, s in zip(params["convs"], strides):
                 x = jax.lax.conv_general_dilated(
                     x, conv["w"], window_strides=(s, s), padding="SAME",

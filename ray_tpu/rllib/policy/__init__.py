@@ -17,6 +17,7 @@ def make_policy(policy_config: Dict[str, Any], obs_space, action_space,
     model_config = {
         "fcnet_hiddens": policy_config.get("fcnet_hiddens", (64, 64)),
         "conv_filters": policy_config.get("conv_filters"),
+        "post_fcnet_dim": policy_config.get("post_fcnet_dim", 256),
         "dueling": policy_config.get("dueling", False),
     }
     if name == "actor_critic":
@@ -25,7 +26,9 @@ def make_policy(policy_config: Dict[str, Any], obs_space, action_space,
             obs_dim=int(np.prod(obs_space.shape)),
             action_space=action_space,
             hiddens=tuple(model_config["fcnet_hiddens"]),
-            seed=seed)
+            seed=seed,
+            obs_space=obs_space,
+            model_config=model_config)
     if name == "q":
         from ray_tpu.rllib.policy.q_policy import QPolicy
         return QPolicy(obs_space, action_space, model_config, seed=seed)

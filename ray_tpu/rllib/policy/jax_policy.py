@@ -43,7 +43,9 @@ class JAXPolicy:
     the learner each own one."""
 
     def __init__(self, obs_dim: int, action_space: Any,
-                 hiddens: Sequence[int] = (64, 64), seed: int = 0):
+                 hiddens: Sequence[int] = (64, 64), seed: int = 0,
+                 obs_space: Any = None,
+                 model_config: Optional[Dict[str, Any]] = None):
         import gymnasium as gym
         self.obs_dim = obs_dim
         self.action_space = action_space
@@ -51,12 +53,27 @@ class JAXPolicy:
         self.act_dim = (int(action_space.n) if self.discrete
                         else int(np.prod(action_space.shape)))
         key = jax.random.PRNGKey(seed)
-        k_pi, k_vf, k_logstd = jax.random.split(key, 3)
+        k_enc, k_pi, k_vf, k_logstd = jax.random.split(key, 4)
         out = self.act_dim
-        self.params = {
-            "pi": _mlp_init(k_pi, [obs_dim, *hiddens, out]),
-            "vf": _mlp_init(k_vf, [obs_dim, *hiddens, 1]),
-        }
+        # Image observations get the catalog CNN as a SHARED torso with
+        # linear pi/vf heads (the standard Atari actor-critic shape —
+        # reference: models/catalog.py vision nets feeding both heads);
+        # vector observations keep the per-head MLP torsos.
+        self._enc_apply = None
+        from ray_tpu.rllib.models.catalog import ModelCatalog
+        if obs_space is not None and ModelCatalog.is_image_space(obs_space):
+            enc_init, self._enc_apply, feat = ModelCatalog.get_encoder(
+                obs_space, model_config or {})
+            self.params = {
+                "enc": enc_init(k_enc),
+                "pi": _mlp_init(k_pi, [feat, out]),
+                "vf": _mlp_init(k_vf, [feat, 1]),
+            }
+        else:
+            self.params = {
+                "pi": _mlp_init(k_pi, [obs_dim, *hiddens, out]),
+                "vf": _mlp_init(k_vf, [obs_dim, *hiddens, 1]),
+            }
         if not self.discrete:
             self.params["log_std"] = jnp.zeros((self.act_dim,))
         self._sample_jit = jax.jit(self._sample)
@@ -64,11 +81,16 @@ class JAXPolicy:
 
     # -- functional core -------------------------------------------------
 
+    def _torso(self, params, obs):
+        if self._enc_apply is not None:
+            return self._enc_apply(params["enc"], obs)
+        return obs
+
     def logits(self, params, obs):
-        return _mlp_apply(params["pi"], obs)
+        return _mlp_apply(params["pi"], self._torso(params, obs))
 
     def _value(self, params, obs):
-        return _mlp_apply(params["vf"], obs)[..., 0]
+        return _mlp_apply(params["vf"], self._torso(params, obs))[..., 0]
 
     def logp(self, params, obs, actions):
         logits = self.logits(params, obs)

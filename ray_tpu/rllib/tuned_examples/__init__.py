@@ -1,0 +1,154 @@
+"""Tuned examples: the regression-benchmark registry.
+
+Analog of the reference's rllib/tuned_examples/ YAMLs (e.g.
+ppo/atari-ppo.yaml, ppo/cartpole-ppo.yaml): each entry is a tuned config
+plus a stopping criterion (reward threshold within a training budget)
+that CI asserts — algorithms are regression-tested on LEARNING CURVES,
+not just finiteness. ``run_tuned_example`` is the harness the tests and
+``bench.py`` share.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class TunedExample:
+    name: str
+    build_config: Callable[[], Any]  # () -> AlgorithmConfig, built lazily
+    stop_reward: float               # pass when episode_reward_mean >= this
+    max_iters: int                   # within this many algo.train() calls
+    notes: str = ""
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+def _cartpole_ppo():
+    from ray_tpu.rllib import PPOConfig
+    return (PPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2)
+            .training(lr=1e-3, train_batch_size=1024, num_sgd_iter=10,
+                      sgd_minibatch_size=256)
+            .debugging(seed=7))
+
+
+def _cartpole_a2c():
+    from ray_tpu.rllib import A2CConfig
+    return (A2CConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=64)
+            .training(lr=2e-3, train_batch_size=512)
+            .debugging(seed=11))
+
+
+def _cartpole_dqn():
+    from ray_tpu.rllib import DQNConfig
+    return (DQNConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=1, rollout_fragment_length=256)
+            .training(lr=8e-4, train_batch_size=64,
+                      num_steps_sampled_before_learning_starts=500,
+                      num_train_batches_per_iteration=32,
+                      target_network_update_freq=128,
+                      epsilon_timesteps=3000, dueling=True,
+                      double_q=True)
+            .debugging(seed=5))
+
+
+def _pendulum_sac():
+    from ray_tpu.rllib import SACConfig
+    return (SACConfig()
+            .environment("Pendulum-v1")
+            .rollouts(num_rollout_workers=1, rollout_fragment_length=256)
+            .training(lr=3e-4, train_batch_size=256,
+                      num_steps_sampled_before_learning_starts=500,
+                      # 1 gradient step per env step (the canonical SAC
+                      # ratio) — at 32/iter the 100-episode reward window
+                      # barely moves inside the budget.
+                      num_train_batches_per_iteration=256, tau=0.005,
+                      model={"fcnet_hiddens": [256, 256]})
+            .debugging(seed=2))
+
+
+def _atari_ppo():
+    """The north-star shape (reference: tuned_examples/ppo/atari-ppo.yaml)
+    on the synthetic Catch game: pixels in, CNN policy, deepmind wrapper
+    stack. dim=42/framestack=2 keep the CPU regression affordable; the
+    bench runs the full 84x84x4."""
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.env.atari import make_synthetic_atari
+    return (PPOConfig()
+            .environment(make_synthetic_atari,
+                         env_config={"dim": 42, "framestack": 2,
+                                     "drops": 2, "fall": 14})
+            .rollouts(num_rollout_workers=2, rollout_fragment_length=128)
+            .training(lr=8e-4, train_batch_size=1024, num_sgd_iter=6,
+                      sgd_minibatch_size=256, entropy_coeff=0.01,
+                      model={"conv_filters": [[16, 8, 4], [32, 4, 2],
+                                              [32, 3, 2]],
+                             "post_fcnet_dim": 128})
+            .debugging(seed=17))
+
+
+TUNED_EXAMPLES: Dict[str, TunedExample] = {
+    "cartpole-ppo": TunedExample(
+        "cartpole-ppo", _cartpole_ppo, stop_reward=60.0, max_iters=20,
+        notes="reference: tuned_examples/ppo/cartpole-ppo.yaml"),
+    "cartpole-a2c": TunedExample(
+        "cartpole-a2c", _cartpole_a2c, stop_reward=50.0, max_iters=30,
+        notes="reference: tuned_examples/a2c/cartpole-a2c.yaml"),
+    "cartpole-dqn": TunedExample(
+        "cartpole-dqn", _cartpole_dqn, stop_reward=50.0, max_iters=40,
+        notes="reference: tuned_examples/dqn/cartpole-dqn.yaml"),
+    "pendulum-sac": TunedExample(
+        "pendulum-sac", _pendulum_sac, stop_reward=-500.0, max_iters=75,
+        notes="reference: tuned_examples/sac/pendulum-sac.yaml; random "
+              "policy ~= -1200, tuned SAC reaches > -500"),
+    "atari-ppo": TunedExample(
+        "atari-ppo", _atari_ppo, stop_reward=0.0, max_iters=30,
+        notes="reference: tuned_examples/ppo/atari-ppo.yaml; synthetic "
+              "Catch: random ~= -1.6/drop-pair, threshold 0 requires "
+              "pixel-driven paddle control"),
+}
+
+
+def run_tuned_example(name: str, *, max_iters: Optional[int] = None
+                      ) -> Dict[str, Any]:
+    """Train until the tuned stop_reward or the iteration budget; returns
+    {passed, iterations, first_reward, best_reward, last_reward,
+    env_steps_per_sec}."""
+    import time
+
+    ex = TUNED_EXAMPLES[name]
+    budget = max_iters if max_iters is not None else ex.max_iters
+    algo = ex.build_config().build()
+    first = best = last = float("-inf")
+    iters = 0
+    steps0 = 0
+    t0 = time.perf_counter()
+    try:
+        for i in range(budget):
+            res = algo.train()
+            iters = i + 1
+            last = res.get("episode_reward_mean", float("nan"))
+            if iters == 1:
+                first = last
+            if last == last and last > best:  # skip NaN (no episodes yet)
+                best = last
+            steps0 = res.get("timesteps_total", steps0)
+            if best >= ex.stop_reward:
+                break
+        dt = time.perf_counter() - t0
+    finally:
+        algo.stop()
+    return {
+        "name": name,
+        "passed": best >= ex.stop_reward,
+        "iterations": iters,
+        "first_reward": first,
+        "best_reward": best,
+        "last_reward": last,
+        "env_steps_per_sec": round(steps0 / dt, 1) if dt > 0 else 0.0,
+    }
