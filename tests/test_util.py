@@ -159,3 +159,60 @@ def test_queue_blocking_producer_consumer(ray_start_regular):
     cref = consumer.remote(q, 5)
     assert ray_tpu.get(cref) == list(range(5))
     assert ray_tpu.get(pref)
+
+
+def test_collective_ring_allreduce_large(ray_start_regular):
+    """Arrays above _INLINE_LIMIT take the ring path (scatter-reduce +
+    allgather over P2P refs): numerically identical to the star path,
+    but no single process carries world x bytes. Forced here by shrinking
+    the inline limit so a small array exercises the ring."""
+    import ray_tpu
+    from ray_tpu.util import collective as C
+    orig_limit = C._INLINE_LIMIT
+
+    @ray_tpu.remote
+    class RingWorker:
+        def __init__(self, rank, world):
+            self.rank, self.world = rank, world
+
+        def setup(self, world_size, rank):
+            from ray_tpu.util import collective
+            collective._INLINE_LIMIT = 0  # force ring + ref data path
+            collective.init_collective_group(world_size, rank, "tpu",
+                                             "ring")
+            return True
+
+        def do_allreduce(self, shape, op):
+            from ray_tpu.util import collective
+            arr = np.full(shape, float(self.rank + 1), np.float32)
+            arr[0] = self.rank  # non-uniform content
+            out = collective.allreduce(arr, "ring", op)
+            # The out-of-band data path really engaged: sends pinned
+            # ObjectRefs in the per-channel keep-alive window.
+            g = collective._groups()["ring"]
+            assert g.p2p_live and all(len(d) > 0
+                                      for d in g.p2p_live.values())
+            return out
+
+    world = 4
+    workers = [RingWorker.remote(r, world) for r in range(world)]
+    ray_tpu.get([w.setup.remote(world, r)
+                 for r, w in enumerate(workers)])
+    # Odd length: chunks split unevenly across the ring.
+    outs = ray_tpu.get([w.do_allreduce.remote((103,), "sum")
+                        for w in workers])
+    expected = np.full((103,), float(sum(r + 1 for r in range(world))),
+                       np.float32)
+    expected[0] = float(sum(range(world)))
+    for out in outs:
+        np.testing.assert_allclose(out, expected, rtol=1e-6)
+    try:
+        # max over the ring too
+        outs = ray_tpu.get([w.do_allreduce.remote((57,), "max")
+                            for w in workers])
+        for out in outs:
+            assert out[0] == world - 1 and out[1] == world
+    finally:
+        # Actors share this process (thread backend): restore the module
+        # global so later collective tests exercise the star path again.
+        C._INLINE_LIMIT = orig_limit
