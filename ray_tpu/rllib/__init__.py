@@ -2,8 +2,11 @@
 
 The RL stack of the framework (reference: rllib, SURVEY.md §2.6):
 Algorithm/AlgorithmConfig driver, WorkerSet rollout actors (CPU envs),
-JAX policies compiled by XLA, 17 algorithms (PPO/APPO/DQN/APEX-DQN/
-SimpleQ/SAC/TD3/DDPG/CQL/A2C/A3C/IMPALA/PG/BC/MARWIL/ES/ARS),
+JAX policies compiled by XLA, 22 algorithms (PPO/APPO/DQN/APEX-DQN/
+Rainbow/R2D2/QMIX/SimpleQ/SAC/TD3/DDPG/CQL/A2C/A3C/IMPALA/PG/BC/MARWIL/
+ES/ARS/BanditLinUCB/BanditLinTS — incl. distributional C51 + noisy
+nets, recurrent sequence replay with burn-in, monotonic multi-agent
+value factorization, and closed-form contextual bandits),
 multi-agent training (MultiAgentEnv + policy maps), the new-stack
 core/ (RLModule/Learner/LearnerGroup — SPMD pjit or remote-actor
 data-parallel learners), connectors, offline JSON IO, replay buffers
@@ -16,6 +19,10 @@ from ray_tpu.rllib.algorithms.a3c import A3C, A3CConfig
 from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.ars import ARS, ARSConfig
+from ray_tpu.rllib.algorithms.bandit import (BanditConfig, BanditLinTS,
+                                             BanditLinTSConfig,
+                                             BanditLinUCB,
+                                             BanditLinUCBConfig)
 from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
@@ -27,6 +34,9 @@ from ray_tpu.rllib.algorithms.impala import Impala, ImpalaConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.pg import PG, PGConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.qmix import QMix, QMixConfig
+from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
+from ray_tpu.rllib.algorithms.rainbow import Rainbow, RainbowConfig
 from ray_tpu.rllib.algorithms.registry import get_algorithm_class
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.simple_q import SimpleQ, SimpleQConfig
@@ -46,6 +56,8 @@ from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
                                                 ReplayBuffer)
 
 __all__ = ["A2C", "A2CConfig", "A3C", "A3CConfig", "APPO", "APPOConfig",
+           "BanditConfig", "BanditLinTS", "BanditLinTSConfig",
+           "BanditLinUCB", "BanditLinUCBConfig",
            "ApexDQN", "ApexDQNConfig",
            "ARS", "ARSConfig", "Algorithm", "AlgorithmConfig", "BC",
            "BCConfig", "CQL", "CQLConfig", "DDPG", "DDPGConfig", "DQN",
@@ -53,6 +65,8 @@ __all__ = ["A2C", "A2CConfig", "A3C", "A3CConfig", "APPO", "APPOConfig",
            "JAXPolicy", "JsonReader", "MultiAgentBatch", "MultiAgentEnv",
            "MultiAgentRolloutWorker",
            "JsonWriter", "MARWIL", "MARWILConfig", "ModelCatalog", "PG",
+           "QMix", "QMixConfig",
+           "R2D2", "R2D2Config", "Rainbow", "RainbowConfig",
            "PGConfig", "PPO", "PPOConfig", "QPolicy",
            "PrioritizedReplayBuffer", "ReplayBuffer", "RolloutWorker",
            "SAC", "SACConfig", "SACPolicy", "SampleBatch", "SimpleQ",

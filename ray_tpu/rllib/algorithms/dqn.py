@@ -102,11 +102,35 @@ class DQN(Algorithm):
         if config.input_:
             from ray_tpu.rllib.offline.json_reader import JsonReader
             self._reader = JsonReader(config.input_)
+        tau = config.tau
+        loss_fn = self._build_loss_fn(policy, config)
+        self._learn_key = jax.random.PRNGKey(config.seed + 99)
+
+        def update(params, target_params, opt_state, mb, key):
+            (loss, td), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, mb, key)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        def soft_sync(params, target_params):
+            return jax.tree.map(lambda p, t: tau * p + (1 - tau) * t,
+                                params, target_params)
+
+        self._update_jit = jax.jit(update)
+        self._soft_sync_jit = jax.jit(soft_sync)
+
+    def _build_loss_fn(self, policy, config):
+        """Returns loss_fn(params, target_params, mb, key) -> (loss, td).
+        Rainbow overrides this with the C51 distributional loss; the key
+        feeds noisy-net sampling and is unused here."""
+        import jax
+        import jax.numpy as jnp
         gamma = config.gamma
         double_q = config.double_q
-        tau = config.tau
 
-        def loss_fn(params, target_params, mb):
+        def loss_fn(params, target_params, mb, key):
             q_all = policy.q_values(params, mb["obs"])
             q_taken = jnp.take_along_axis(
                 q_all, mb["actions"][..., None].astype(jnp.int32),
@@ -129,20 +153,7 @@ class DQN(Algorithm):
             weights = mb.get("weights", jnp.ones_like(td))
             return (weights * huber).mean(), td
 
-        def update(params, target_params, opt_state, mb):
-            (loss, td), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, target_params, mb)
-            updates, opt_state = self._optimizer.update(grads, opt_state,
-                                                        params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, loss, td
-
-        def soft_sync(params, target_params):
-            return jax.tree.map(lambda p, t: tau * p + (1 - tau) * t,
-                                params, target_params)
-
-        self._update_jit = jax.jit(update)
-        self._soft_sync_jit = jax.jit(soft_sync)
+        return loss_fn
 
     def _epsilon(self) -> float:
         config: DQNConfig = self.config
@@ -193,8 +204,11 @@ class DQN(Algorithm):
                              if k in ("obs", "new_obs", "actions", "rewards",
                                       "terminateds", "weights",
                                       "n_step_discount")}
+                import jax as _jax
+                self._learn_key, k_step = _jax.random.split(self._learn_key)
                 params, self._opt_state, loss, td = self._update_jit(
-                    params, self._target_params, self._opt_state, device_mb)
+                    params, self._target_params, self._opt_state, device_mb,
+                    k_step)
                 losses.append(float(loss))
                 self._grad_steps += 1
                 if config.prioritized_replay:

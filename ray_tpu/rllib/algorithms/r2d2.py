@@ -1,0 +1,188 @@
+"""R2D2: recurrent replay distributed DQN (Kapturowski et al. 2019).
+
+Analog of the reference's rllib/algorithms/r2d2: DQN with an LSTM
+Q-network trained on stored SEQUENCES. Each sampled window seeds the
+LSTM with the hidden state recorded at collection time, burns in
+``burn_in`` steps without gradient (re-warming the recurrence under
+current weights), then TD-trains the remainder with double-Q targets and
+R2D2's invertible value rescaling. Inherits the DQN engine's rollout /
+target-sync / epsilon plumbing; replay and the update are sequence-
+shaped (utils/replay_buffers.py SequenceReplayBuffer).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.utils.replay_buffers import SequenceReplayBuffer
+
+
+class R2D2Config(DQNConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or R2D2)
+        self.policy_class_name = "r2d2"
+        self.seq_len = 10            # training window length
+        self.burn_in = 4             # no-gradient warmup steps per window
+        self.train_batch_size = 16   # sequences per minibatch
+        self.replay_buffer_capacity = 2000  # episodes
+        self.lstm_cell_size = 64
+        self.use_value_rescaling = True
+        self.n_step = 1              # within-sequence TD(0)
+        self.prioritized_replay = False  # uniform sequence sampling
+
+    def training(self, *, seq_len=None, burn_in=None, lstm_cell_size=None,
+                 use_value_rescaling=None, **kwargs) -> "R2D2Config":
+        super().training(**kwargs)
+        for name, val in (("seq_len", seq_len), ("burn_in", burn_in),
+                          ("lstm_cell_size", lstm_cell_size),
+                          ("use_value_rescaling", use_value_rescaling)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+    def policy_config(self):
+        base = super().policy_config()
+        base["lstm_cell_size"] = self.lstm_cell_size
+        return base
+
+
+class R2D2(DQN):
+    _default_config_class = R2D2Config
+
+    def setup(self, config: R2D2Config) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.policy.r2d2_policy import (value_rescale,
+                                                      value_rescale_inv)
+
+        if getattr(config, "input_", None):
+            raise ValueError(
+                "R2D2 trains on stored SEQUENCES with recurrent states "
+                "recorded at collection time; offline JSON input "
+                "(config.offline_data) carries neither and is not "
+                "supported.")
+        if config.prioritized_replay:
+            raise ValueError(
+                "R2D2 samples sequences uniformly; prioritized_replay "
+                "is not supported (set it False).")
+        policy = self.local_policy
+        self._optimizer = optax.adam(config.lr)
+        self._opt_state = self._optimizer.init(policy.params)
+        self._target_params = jax.tree.map(jnp.asarray, policy.params)
+        self._buffer = SequenceReplayBuffer(
+            config.replay_buffer_capacity, seed=config.seed)
+        self._grad_steps = 0
+        self._reader = None
+        gamma = config.gamma
+        double_q = config.double_q
+        burn_in = config.burn_in
+        tau = config.tau
+        rescale = config.use_value_rescaling
+
+        def loss_fn(params, target_params, mb):
+            obs = mb["obs"]                      # [B, T, ...]
+            h0, c0 = mb["h0"], mb["c0"]          # [B, hidden]
+            # Burn-in under stop_gradient: re-warm the recurrence with
+            # current weights, but only the post-burn-in steps train.
+            if burn_in > 0:
+                _, (h_b, c_b) = policy.q_seq(
+                    params, obs[:, :burn_in], h0, c0)
+                h_on = jax.lax.stop_gradient(h_b)
+                c_on = jax.lax.stop_gradient(c_b)
+                _, (h_tb, c_tb) = policy.q_seq(
+                    target_params, obs[:, :burn_in], h0, c0)
+            else:
+                h_on, c_on = h0, c0
+                h_tb, c_tb = h0, c0
+            train_obs = obs[:, burn_in:]
+            q_online, _ = policy.q_seq(params, train_obs, h_on, c_on)
+            q_target, _ = policy.q_seq(target_params, train_obs,
+                                       jax.lax.stop_gradient(h_tb),
+                                       jax.lax.stop_gradient(c_tb))
+            actions = mb["actions"][:, burn_in:].astype(jnp.int32)
+            rewards = mb["rewards"][:, burn_in:]
+            dones = jnp.maximum(mb["terminateds"][:, burn_in:], 0.0)
+            mask = mb["mask"][:, burn_in:]
+            q_taken = jnp.take_along_axis(
+                q_online, actions[..., None], -1)[..., 0]  # [B, T']
+            # Next-step targets within the window: shift by one; the last
+            # step of each window has no successor -> masked out.
+            if double_q:
+                a_star = q_online[:, 1:].argmax(-1)
+                q_next = jnp.take_along_axis(
+                    q_target[:, 1:], a_star[..., None], -1)[..., 0]
+            else:
+                q_next = q_target[:, 1:].max(-1)
+            if rescale:
+                q_next = value_rescale_inv(q_next)
+            target = rewards[:, :-1] + gamma * (1.0 - dones[:, :-1]) * \
+                q_next
+            if rescale:
+                target = value_rescale(target)
+            td = q_taken[:, :-1] - jax.lax.stop_gradient(target)
+            # Valid steps: real (mask) at t AND t+1 unless t is terminal
+            # (terminal steps bootstrap nothing and are always valid).
+            valid = mask[:, :-1] * jnp.maximum(
+                mask[:, 1:], dones[:, :-1])
+            huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                              jnp.abs(td) - 0.5)
+            denom = jnp.maximum(valid.sum(), 1.0)
+            return (huber * valid).sum() / denom, td
+
+        def update(params, target_params, opt_state, mb):
+            (loss, td), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, mb)
+            updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        def soft_sync(params, target_params):
+            return jax.tree.map(lambda p, t: tau * p + (1 - tau) * t,
+                                params, target_params)
+
+        self._update_jit = jax.jit(update)
+        self._soft_sync_jit = jax.jit(soft_sync)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        import ray_tpu
+        config: R2D2Config = self.config
+        weights_ref = ray_tpu.put(self.get_weights())
+        self.workers.sync_weights(weights_ref)
+        batch = self.workers.sample(max(config.rollout_fragment_length, 1))
+        self._timesteps_total += len(batch)
+        self._buffer.add(batch)
+
+        losses = []
+        if len(self._buffer) >= \
+                config.num_steps_sampled_before_learning_starts:
+            params = self.local_policy.params
+            for _ in range(config.num_train_batches_per_iteration):
+                mb = self._buffer.sample(config.train_batch_size,
+                                         config.seq_len)
+                device_mb = {k: jnp.asarray(v) for k, v in mb.items()
+                             if k in ("obs", "actions", "rewards",
+                                      "terminateds", "mask", "h0", "c0")}
+                params, self._opt_state, loss, _ = self._update_jit(
+                    params, self._target_params, self._opt_state,
+                    device_mb)
+                losses.append(float(loss))
+                self._grad_steps += 1
+                if self._grad_steps % \
+                        config.target_network_update_freq == 0:
+                    self._target_params = self._soft_sync_jit(
+                        params, self._target_params)
+            self.local_policy.params = params
+        return {
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "epsilon": self._epsilon(),
+            "replay_buffer_size": len(self._buffer),
+            "gradient_steps_total": self._grad_steps,
+        }

@@ -12,6 +12,7 @@ def get_algorithm_class(name: str) -> Type:
     from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN
     from ray_tpu.rllib.algorithms.appo import APPO
     from ray_tpu.rllib.algorithms.ars import ARS
+    from ray_tpu.rllib.algorithms.bandit import BanditLinTS, BanditLinUCB
     from ray_tpu.rllib.algorithms.bc import BC
     from ray_tpu.rllib.algorithms.cql import CQL
     from ray_tpu.rllib.algorithms.ddpg import DDPG
@@ -21,6 +22,9 @@ def get_algorithm_class(name: str) -> Type:
     from ray_tpu.rllib.algorithms.marwil import MARWIL
     from ray_tpu.rllib.algorithms.pg import PG
     from ray_tpu.rllib.algorithms.ppo import PPO
+    from ray_tpu.rllib.algorithms.qmix import QMix
+    from ray_tpu.rllib.algorithms.r2d2 import R2D2
+    from ray_tpu.rllib.algorithms.rainbow import Rainbow
     from ray_tpu.rllib.algorithms.sac import SAC
     from ray_tpu.rllib.algorithms.simple_q import SimpleQ
     from ray_tpu.rllib.algorithms.td3 import TD3
@@ -28,8 +32,10 @@ def get_algorithm_class(name: str) -> Type:
     table = {"PPO": PPO, "DQN": DQN, "SAC": SAC, "A2C": A2C, "A3C": A3C,
              "IMPALA": Impala, "TD3": TD3, "BC": BC, "APPO": APPO,
              "PG": PG, "MARWIL": MARWIL, "DDPG": DDPG, "SIMPLEQ": SimpleQ,
-             "APEX": ApexDQN, "APEX-DQN": ApexDQN,
-             "ES": ES, "ARS": ARS, "CQL": CQL}
+             "APEX": ApexDQN, "APEX-DQN": ApexDQN, "RAINBOW": Rainbow,
+             "R2D2": R2D2, "QMIX": QMix,
+             "ES": ES, "ARS": ARS, "CQL": CQL,
+             "BANDITLINUCB": BanditLinUCB, "BANDITLINTS": BanditLinTS}
     try:
         return table[name.upper()]
     except KeyError:

@@ -1,0 +1,64 @@
+"""Small multi-agent example envs for tests and tuned examples
+(reference: rllib/examples/env/ — two-step game, coordination tasks)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv
+
+try:
+    from gymnasium import spaces
+except ImportError:  # pragma: no cover
+    spaces = None
+
+
+class CoordinationGameEnv(MultiAgentEnv):
+    """Cooperative context matching (QMIX's home turf): each round both
+    agents observe the same one-hot context and must BOTH play the action
+    equal to the context index to score — the team earns 1.0 only on
+    joint success, split evenly, so credit assignment runs through the
+    team reward. ``rounds`` rounds per episode; optimal team return =
+    rounds; uniform-random = rounds / actions^2."""
+
+    def __init__(self, config: Optional[dict] = None):
+        config = dict(config or {})
+        self.rounds = int(config.get("rounds", 10))
+        self.n_contexts = int(config.get("n_contexts", 2))
+        self.n_actions = int(config.get("n_actions", 3))
+        self._seed = int(config.get("seed", 0))
+        self.agent_ids = {"a0", "a1"}
+        self._rng = np.random.default_rng(self._seed)
+        if spaces is not None:
+            self.observation_space = spaces.Box(
+                0.0, 1.0, (self.n_contexts,), np.float32)
+            self.action_space = spaces.Discrete(self.n_actions)
+        self._t = 0
+        self._ctx = 0
+
+    def _obs(self):
+        onehot = np.zeros(self.n_contexts, np.float32)
+        onehot[self._ctx] = 1.0
+        return {"a0": onehot.copy(), "a1": onehot.copy()}
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._ctx = int(self._rng.integers(self.n_contexts))
+        return self._obs(), {}
+
+    def step(self, action_dict):
+        match = all(int(action_dict[aid]) == self._ctx
+                    for aid in ("a0", "a1"))
+        r = 0.5 if match else 0.0
+        self._t += 1
+        done = self._t >= self.rounds
+        self._ctx = int(self._rng.integers(self.n_contexts))
+        obs = self._obs()
+        rewards = {"a0": r, "a1": r}
+        terms = {"a0": done, "a1": done, "__all__": done}
+        truncs = {"a0": False, "a1": False, "__all__": False}
+        return obs, rewards, terms, truncs, {}

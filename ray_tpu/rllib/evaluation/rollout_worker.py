@@ -83,6 +83,11 @@ class RolloutWorker:
             self._key, sub = jax.random.split(self._key)
             action, logp, value = self.policy.compute_actions(
                 obs[None], sub)
+            # Recurrent policies publish their PRE-step hidden state per
+            # transition (R2D2: the learner re-seeds the recurrence from
+            # any stored window start).
+            for k, v in getattr(self.policy, "state_rows", {}).items():
+                rows.setdefault(k, []).append(v)
             act = action[0]
             act_env = int(act) if self.policy.discrete else np.asarray(act)
             if self.action_connectors.connectors:
@@ -110,6 +115,9 @@ class RolloutWorker:
                 self._episode_len = 0
                 self._eps_id += 1
                 self._obs, _ = self.env.reset()
+                reset_state = getattr(self.policy, "reset_state", None)
+                if callable(reset_state):
+                    reset_state()  # recurrent state dies with the episode
             else:
                 self._obs = nxt
         batch = self._postprocess(SampleBatch(rows))

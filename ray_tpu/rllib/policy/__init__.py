@@ -19,6 +19,11 @@ def make_policy(policy_config: Dict[str, Any], obs_space, action_space,
         "conv_filters": policy_config.get("conv_filters"),
         "post_fcnet_dim": policy_config.get("post_fcnet_dim", 256),
         "dueling": policy_config.get("dueling", False),
+        "noisy": policy_config.get("noisy", True),
+        "num_atoms": policy_config.get("num_atoms", 51),
+        "lstm_cell_size": policy_config.get("lstm_cell_size", 64),
+        "v_min": policy_config.get("v_min", -10.0),
+        "v_max": policy_config.get("v_max", 10.0),
     }
     if name == "actor_critic":
         from ray_tpu.rllib.policy.jax_policy import JAXPolicy
@@ -35,6 +40,14 @@ def make_policy(policy_config: Dict[str, Any], obs_space, action_space,
     if name == "sac":
         from ray_tpu.rllib.policy.sac_policy import SACPolicy
         return SACPolicy(obs_space, action_space, model_config, seed=seed)
+    if name == "r2d2":
+        from ray_tpu.rllib.policy.r2d2_policy import R2D2Policy
+        return R2D2Policy(obs_space, action_space, model_config,
+                          seed=seed)
+    if name == "rainbow":
+        from ray_tpu.rllib.policy.rainbow_policy import RainbowPolicy
+        return RainbowPolicy(obs_space, action_space, model_config,
+                             seed=seed)
     if name == "td3":
         from ray_tpu.rllib.policy.sac_policy import TD3Policy
         return TD3Policy(obs_space, action_space, model_config, seed=seed)

@@ -1,0 +1,167 @@
+"""R2D2 policy: recurrent (LSTM) Q-network with stored hidden states.
+
+The policy half of R2D2 (Kapturowski et al. 2019; reference:
+rllib/algorithms/r2d2 + the torch RNN model stack): an encoder feeds an
+LSTM whose hidden state carries across env steps; the Q head (dueling)
+reads the LSTM output. Rollout workers step it statefully (the worker
+calls ``reset_state`` at episode boundaries and records the PRE-step
+hidden state into every transition via ``state_rows``), so the learner
+can re-run the recurrence from any stored position: sample a sequence
+window, seed the LSTM with the stored state, burn in a few steps without
+gradient, then TD-train the remainder (algorithms/r2d2.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.models.catalog import ModelCatalog, mlp_apply, mlp_init
+
+
+def lstm_init(key, in_dim: int, hidden: int) -> Dict[str, Any]:
+    k_w, _ = jax.random.split(key)
+    scale = 1.0 / np.sqrt(in_dim + hidden)
+    return {
+        "w": jax.random.normal(k_w, (in_dim + hidden, 4 * hidden)) * scale,
+        "b": jnp.zeros((4 * hidden,)),
+    }
+
+
+def lstm_step(params, h, c, x):
+    """One LSTM cell step. x: [B, in], h/c: [B, hidden]. Forget-gate bias
+    +1 (standard init: remember by default)."""
+    hidden = h.shape[-1]
+    z = jnp.concatenate([x, h], axis=-1) @ params["w"] + params["b"]
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c_new = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+    del hidden
+    return h_new, c_new
+
+
+def value_rescale(x, eps: float = 1e-3):
+    """h(x) = sign(x)(sqrt(|x|+1)-1) + eps*x (R2D2's invertible value
+    rescaling for raw-reward training)."""
+    return jnp.sign(x) * (jnp.sqrt(jnp.abs(x) + 1.0) - 1.0) + eps * x
+
+
+def value_rescale_inv(x, eps: float = 1e-3):
+    inner = jnp.sqrt(1.0 + 4.0 * eps * (jnp.abs(x) + 1.0 + eps)) - 1.0
+    return jnp.sign(x) * ((inner / (2.0 * eps)) ** 2 - 1.0)
+
+
+class R2D2Policy:
+    needs_gae = False
+
+    def __init__(self, obs_space, action_space: Any,
+                 model_config: Dict[str, Any] = None, seed: int = 0):
+        import gymnasium as gym
+        if not isinstance(action_space, gym.spaces.Discrete):
+            raise ValueError("R2D2Policy requires Discrete actions")
+        self.discrete = True
+        self.action_space = action_space
+        self.act_dim = int(action_space.n)
+        model_config = model_config or {}
+        self.hidden = int(model_config.get("lstm_cell_size", 64))
+        enc_init, self._encode, feat_dim = ModelCatalog.get_encoder(
+            obs_space, model_config)
+        key = jax.random.PRNGKey(seed)
+        k_enc, k_lstm, k_adv, k_val = jax.random.split(key, 4)
+        self.params = {
+            "encoder": enc_init(k_enc),
+            "lstm": lstm_init(k_lstm, feat_dim, self.hidden),
+            "adv_head": mlp_init(k_adv, [self.hidden, self.act_dim]),
+            "value_head": mlp_init(k_val, [self.hidden, 1]),
+        }
+        self.epsilon = 1.0
+        self.fixed_epsilon = False
+        self._h = np.zeros((1, self.hidden), np.float32)
+        self._c = np.zeros((1, self.hidden), np.float32)
+        self.state_rows: Dict[str, np.ndarray] = {}
+        self._step_jit = jax.jit(self._step)
+
+    # -- functional core -------------------------------------------------
+
+    def _q_from_h(self, params, h):
+        value = mlp_apply(params["value_head"], h)
+        adv = mlp_apply(params["adv_head"], h)
+        return value + adv - adv.mean(-1, keepdims=True)
+
+    def _step(self, params, obs, h, c):
+        feats = self._encode(params["encoder"], obs)
+        h, c = lstm_step(params["lstm"], h, c, feats)
+        return self._q_from_h(params, h), h, c
+
+    def q_seq(self, params, obs_seq, h0, c0):
+        """Run the recurrence over a [B, T, ...] window from (h0, c0).
+        Returns q [B, T, A] and the final state."""
+        def scan_fn(carry, obs_t):
+            h, c = carry
+            feats = self._encode(params["encoder"], obs_t)
+            h, c = lstm_step(params["lstm"], h, c, feats)
+            return (h, c), self._q_from_h(params, h)
+
+        obs_tmajor = jnp.moveaxis(obs_seq, 1, 0)  # [T, B, ...]
+        (h, c), q = jax.lax.scan(scan_fn, (h0, c0), obs_tmajor)
+        return jnp.moveaxis(q, 1, 0), (h, c)
+
+    # -- worker-side API -------------------------------------------------
+
+    def reset_state(self) -> None:
+        self._h = np.zeros((1, self.hidden), np.float32)
+        self._c = np.zeros((1, self.hidden), np.float32)
+
+    def compute_actions(self, obs: np.ndarray, key) -> Tuple[np.ndarray,
+                                                             np.ndarray,
+                                                             np.ndarray]:
+        # Record the PRE-step state: replaying the stored sequence from
+        # this state reproduces this step's Q values exactly.
+        self.state_rows = {"lstm_h": self._h[0].copy(),
+                           "lstm_c": self._c[0].copy()}
+        q, h, c = self._step_jit(self.params, jnp.asarray(obs),
+                                 jnp.asarray(self._h),
+                                 jnp.asarray(self._c))
+        self._h = np.asarray(h)
+        self._c = np.asarray(c)
+        greedy = np.asarray(q.argmax(-1))
+        k1, k2 = jax.random.split(key)
+        explore = np.asarray(
+            jax.random.uniform(k1, (obs.shape[0],))) < self.epsilon
+        rand = np.asarray(jax.random.randint(
+            k2, (obs.shape[0],), 0, self.act_dim))
+        actions = np.where(explore, rand, greedy)
+        zeros = np.zeros((obs.shape[0],), np.float32)
+        return actions, zeros, zeros
+
+    def compute_values(self, obs: np.ndarray) -> np.ndarray:
+        q, _, _ = self._step_jit(self.params, jnp.asarray(obs),
+                                 jnp.asarray(self._h),
+                                 jnp.asarray(self._c))
+        return np.asarray(q.max(-1))
+
+    def compute_greedy(self, obs: np.ndarray) -> int:
+        """Greedy eval step (Algorithm.compute_single_action/evaluate
+        dispatch): argmax Q, advancing the recurrent state — recurrent
+        evaluation is stateful by nature."""
+        q, h, c = self._step_jit(self.params, jnp.asarray(obs),
+                                 jnp.asarray(self._h),
+                                 jnp.asarray(self._c))
+        self._h = np.asarray(h)
+        self._c = np.asarray(c)
+        return int(np.asarray(q).argmax(-1)[0])
+
+    def get_weights(self):
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "epsilon": self.epsilon}
+
+    def set_weights(self, weights) -> None:
+        if isinstance(weights, dict) and "params" in weights:
+            self.params = jax.tree.map(jnp.asarray, weights["params"])
+            if not self.fixed_epsilon:
+                self.epsilon = float(weights.get("epsilon", self.epsilon))
+        else:
+            self.params = jax.tree.map(jnp.asarray, weights)

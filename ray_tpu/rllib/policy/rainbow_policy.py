@@ -1,0 +1,194 @@
+"""Rainbow Q-policy: noisy nets + C51 distributional value heads.
+
+The remaining two Rainbow components (Hessel et al. 2018) on top of the
+DQN stack's double-Q / dueling / n-step / prioritized replay (reference:
+rllib/algorithms/dqn with num_atoms > 1 + noisy=True):
+
+* **Noisy linear layers** (factorized Gaussian, Fortunato et al. 2017):
+  each head layer carries (w_mu, w_sigma, b_mu, b_sigma); a forward pass
+  under an explicit PRNG key perturbs weights with factorized noise, and
+  exploration comes from the noise itself — no epsilon schedule.
+* **C51 categorical distribution** (Bellemare et al. 2017): the head
+  emits ``num_atoms`` logits per action over a fixed support
+  [v_min, v_max]; Q(s,a) = sum_i p_i * z_i, and the learner minimizes
+  cross-entropy against the projected target distribution.
+
+Both compose with dueling: value and advantage streams each produce atom
+logits, combined with the mean-advantage constraint per atom.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.models.catalog import ModelCatalog
+
+
+def noisy_init(key, in_dim: int, out_dim: int) -> Dict[str, Any]:
+    """Factorized-noisy linear parameters (mu uniform, sigma 0.5/sqrt)."""
+    k_w, k_b = jax.random.split(key)
+    bound = 1.0 / np.sqrt(in_dim)
+    return {
+        "w_mu": jax.random.uniform(k_w, (in_dim, out_dim),
+                                   minval=-bound, maxval=bound),
+        "w_sigma": jnp.full((in_dim, out_dim), 0.5 / np.sqrt(in_dim)),
+        "b_mu": jax.random.uniform(k_b, (out_dim,),
+                                   minval=-bound, maxval=bound),
+        "b_sigma": jnp.full((out_dim,), 0.5 / np.sqrt(in_dim)),
+    }
+
+
+def _f(x):
+    return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
+
+
+def noisy_apply(params: Dict[str, Any], x, key=None):
+    """key=None -> deterministic mu-only pass (evaluation).
+
+    Noise is sampled independently PER BATCH ROW (Fortunato et al. eq. 10
+    in the batched setting): with shared noise, a whole gradient step
+    chases one correlated perturbation — observed as oscillating
+    collapse. The factorized form never materializes per-row weight
+    matrices: x (w_sigma o eps_in eps_out^T) == ((x o eps_in) w_sigma)
+    o eps_out."""
+    if key is None:
+        return x @ params["w_mu"] + params["b_mu"]
+    in_dim, out_dim = params["w_mu"].shape
+    batch = x.shape[0]
+    k_in, k_out = jax.random.split(key)
+    eps_in = _f(jax.random.normal(k_in, (batch, in_dim)))
+    eps_out = _f(jax.random.normal(k_out, (batch, out_dim)))
+    mu = x @ params["w_mu"] + params["b_mu"]
+    noise = ((x * eps_in) @ params["w_sigma"]) * eps_out \
+        + params["b_sigma"] * eps_out
+    return mu + noise
+
+
+class RainbowPolicy:
+    """Distributional noisy Q policy (policy_class "rainbow")."""
+
+    needs_gae = False
+
+    def __init__(self, obs_space, action_space: Any,
+                 model_config: Dict[str, Any] = None, seed: int = 0):
+        import gymnasium as gym
+        if not isinstance(action_space, gym.spaces.Discrete):
+            raise ValueError("RainbowPolicy requires Discrete actions")
+        self.discrete = True
+        self.action_space = action_space
+        self.act_dim = int(action_space.n)
+        model_config = model_config or {}
+        self.num_atoms = int(model_config.get("num_atoms", 51))
+        self.v_min = float(model_config.get("v_min", -10.0))
+        self.v_max = float(model_config.get("v_max", 10.0))
+        self.noisy = bool(model_config.get("noisy", True))
+        self.dueling = bool(model_config.get("dueling", True))
+        self.support = jnp.linspace(self.v_min, self.v_max,
+                                    self.num_atoms)
+        enc_init, self._encode, feat_dim = ModelCatalog.get_encoder(
+            obs_space, model_config)
+        key = jax.random.PRNGKey(seed)
+        k_enc, k_adv, k_val = jax.random.split(key, 3)
+        heads = {"adv": noisy_init(k_adv, feat_dim,
+                                   self.act_dim * self.num_atoms)}
+        if self.dueling:
+            heads["val"] = noisy_init(k_val, feat_dim, self.num_atoms)
+        self.params = {"encoder": enc_init(k_enc), **heads}
+        # Exploration: noisy nets explore via weight noise. epsilon kept
+        # for API parity (synced by the DQN learner) but unused when
+        # noisy=True.
+        self.epsilon = 0.0
+        self.fixed_epsilon = self.noisy
+        self._dist_jit = jax.jit(self.logits_dist)
+
+    # -- functional core -------------------------------------------------
+
+    def logits_dist(self, params, obs, key=None):
+        """-> [B, act_dim, num_atoms] log-probabilities."""
+        feats = self._encode(params["encoder"], obs)
+        k_adv = k_val = None
+        if self.noisy and key is not None:
+            k_adv, k_val = jax.random.split(key)
+        adv = noisy_apply(params["adv"], feats, k_adv).reshape(
+            (-1, self.act_dim, self.num_atoms))
+        if self.dueling:
+            val = noisy_apply(params["val"], feats, k_val).reshape(
+                (-1, 1, self.num_atoms))
+            logits = val + adv - adv.mean(axis=1, keepdims=True)
+        else:
+            logits = adv
+        return jax.nn.log_softmax(logits, axis=-1)
+
+    def q_values(self, params, obs, key=None):
+        """Expected values under the categorical distribution."""
+        log_p = self.logits_dist(params, obs, key)
+        return (jnp.exp(log_p) * self.support).sum(-1)
+
+    # -- worker-side API -------------------------------------------------
+
+    def compute_actions(self, obs: np.ndarray, key) -> Tuple[np.ndarray,
+                                                             np.ndarray,
+                                                             np.ndarray]:
+        k_noise, k_eps, k_rand = jax.random.split(key, 3)
+        log_p = self._dist_jit(self.params, jnp.asarray(obs),
+                               k_noise if self.noisy else None)
+        q = (jnp.exp(log_p) * self.support).sum(-1)
+        actions = np.asarray(q.argmax(-1))
+        if not self.noisy and self.epsilon > 0:
+            explore = np.asarray(
+                jax.random.uniform(k_eps, (obs.shape[0],))) < self.epsilon
+            rand = np.asarray(jax.random.randint(
+                k_rand, (obs.shape[0],), 0, self.act_dim))
+            actions = np.where(explore, rand, actions)
+        zeros = np.zeros((obs.shape[0],), np.float32)
+        return actions, zeros, zeros
+
+    def compute_values(self, obs: np.ndarray) -> np.ndarray:
+        log_p = self._dist_jit(self.params, jnp.asarray(obs), None)
+        return np.asarray(((jnp.exp(log_p) * self.support).sum(-1)
+                           ).max(-1))
+
+    def get_weights(self):
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "epsilon": self.epsilon}
+
+    def set_weights(self, weights) -> None:
+        if isinstance(weights, dict) and "params" in weights:
+            self.params = jax.tree.map(jnp.asarray, weights["params"])
+            if not self.fixed_epsilon:
+                self.epsilon = float(weights.get("epsilon", self.epsilon))
+        else:
+            self.params = jax.tree.map(jnp.asarray, weights)
+
+
+def project_distribution(next_log_p, rewards, discounts, dones, support,
+                         v_min: float, v_max: float):
+    """C51 categorical projection (Bellemare et al. 2017, alg. 1):
+    shift the support by r + gamma^k * z, clip to [v_min, v_max], and
+    distribute each atom's mass onto its two neighboring bins.
+
+    next_log_p: [B, num_atoms] log-probs of the chosen next action.
+    Returns [B, num_atoms] target probabilities (stop-gradient safe)."""
+    num_atoms = support.shape[0]
+    delta = (v_max - v_min) / (num_atoms - 1)
+    # Tz: [B, atoms] target support positions
+    tz = rewards[:, None] + (discounts * (1.0 - dones))[:, None] * \
+        support[None, :]
+    tz = jnp.clip(tz, v_min, v_max)
+    b = (tz - v_min) / delta                    # fractional bin index
+    lo = jnp.floor(b).astype(jnp.int32)
+    hi = jnp.ceil(b).astype(jnp.int32)
+    # When b lands exactly on a bin (lo == hi), give it full mass once.
+    eq = (lo == hi).astype(jnp.float32)
+    p_next = jnp.exp(next_log_p)                # [B, atoms]
+    m_lo = p_next * ((hi.astype(jnp.float32) - b) + eq)
+    m_hi = p_next * (b - lo.astype(jnp.float32))
+    target = jnp.zeros_like(p_next)
+    batch = jnp.arange(p_next.shape[0])[:, None]
+    target = target.at[batch, lo].add(m_lo)
+    target = target.at[batch, jnp.minimum(hi, num_atoms - 1)].add(m_hi)
+    return target

@@ -57,6 +57,61 @@ def _cartpole_dqn():
             .debugging(seed=5))
 
 
+def _cartpole_rainbow():
+    """Five of the six Rainbow components (C51 + double + dueling + PER
+    + 3-step). Noisy nets are implemented (policy/rainbow_policy.py) but
+    off here: noise-driven exploration is reliably outperformed by the
+    epsilon schedule at CartPole scale — q-value gaps outgrow the noise
+    within a few hundred steps."""
+    from ray_tpu.rllib import RainbowConfig
+    cfg = (RainbowConfig()
+           .environment("CartPole-v1")
+           .rollouts(num_rollout_workers=1, rollout_fragment_length=256)
+           .training(lr=8e-4, train_batch_size=64, v_min=0.0, v_max=120.0,
+                     noisy=False, prioritized_replay=True, n_step=3,
+                     epsilon_timesteps=3000,
+                     num_steps_sampled_before_learning_starts=500,
+                     num_train_batches_per_iteration=64,
+                     target_network_update_freq=64)
+           .debugging(seed=3))
+    cfg.epsilon_initial = 1.0
+    cfg.epsilon_final = 0.02
+    return cfg
+
+
+def _cartpole_r2d2():
+    """Recurrent replay DQN: LSTM Q-net on sequence windows with stored
+    hidden states + burn-in. CartPole learns through the recurrence
+    (slower and noisier than feed-forward DQN — the tuned threshold
+    reflects the method's variance at this scale)."""
+    from ray_tpu.rllib import R2D2Config
+    return (R2D2Config()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=1, rollout_fragment_length=256)
+            .training(lr=1e-3, train_batch_size=32, seq_len=10, burn_in=4,
+                      epsilon_timesteps=4000,
+                      num_steps_sampled_before_learning_starts=500,
+                      num_train_batches_per_iteration=64,
+                      target_network_update_freq=128)
+            .debugging(seed=3))
+
+
+def _coordination_qmix():
+    """QMIX on the cooperative coordination game: both agents must match
+    the shared context to score — team reward only, credit assigned
+    through the monotonic mixer."""
+    from ray_tpu.rllib import QMixConfig
+    from ray_tpu.rllib.env.examples import CoordinationGameEnv
+    return (QMixConfig()
+            .environment(CoordinationGameEnv,
+                         env_config={"rounds": 10, "n_contexts": 2})
+            .training(lr=5e-4, train_batch_size=32,
+                      rollout_steps_per_iteration=200,
+                      epsilon_timesteps=3000,
+                      num_train_batches_per_iteration=32)
+            .debugging(seed=7))
+
+
 def _pendulum_sac():
     from ray_tpu.rllib import SACConfig
     return (SACConfig()
@@ -102,6 +157,19 @@ TUNED_EXAMPLES: Dict[str, TunedExample] = {
     "cartpole-dqn": TunedExample(
         "cartpole-dqn", _cartpole_dqn, stop_reward=50.0, max_iters=40,
         notes="reference: tuned_examples/dqn/cartpole-dqn.yaml"),
+    "cartpole-rainbow": TunedExample(
+        "cartpole-rainbow", _cartpole_rainbow, stop_reward=65.0,
+        max_iters=30,
+        notes="reference: rllib/algorithms/dqn with num_atoms>1 (Rainbow "
+              "flags); C51 cross-entropy vs projected target"),
+    "cartpole-r2d2": TunedExample(
+        "cartpole-r2d2", _cartpole_r2d2, stop_reward=35.0, max_iters=70,
+        notes="reference: rllib/algorithms/r2d2"),
+    "coordination-qmix": TunedExample(
+        "coordination-qmix", _coordination_qmix, stop_reward=8.0,
+        max_iters=40,
+        notes="reference: rllib/algorithms/qmix; optimal team return 10, "
+              "uniform-random ~= 10/9 with 3 actions x 2 contexts"),
     "pendulum-sac": TunedExample(
         "pendulum-sac", _pendulum_sac, stop_reward=-500.0, max_iters=75,
         notes="reference: tuned_examples/sac/pendulum-sac.yaml; random "

@@ -126,3 +126,66 @@ def n_step_transform(batch: "SampleBatch", n: int,
     out[SampleBatch.TERMINATEDS] = out_term
     out["n_step_discount"] = out_disc
     return out
+
+
+class SequenceReplayBuffer:
+    """Episode-organized replay for recurrent learners (reference:
+    R2D2's sequence storage in rllib/algorithms/r2d2 + replay_buffers/
+    utils): stores whole episodes, samples fixed-length windows with the
+    recurrent state recorded at the window start, zero-padding short
+    windows with a validity mask."""
+
+    def __init__(self, capacity_episodes: int = 2000,
+                 seed: Optional[int] = None):
+        self.capacity = capacity_episodes
+        self._episodes: List[dict] = []
+        self._next = 0
+        self._steps = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._steps
+
+    def add(self, batch: SampleBatch) -> None:
+        for ep in batch.split_by_episode():
+            data = {k: np.asarray(v) for k, v in ep.items()}
+            self._steps += len(ep)
+            if len(self._episodes) < self.capacity:
+                self._episodes.append(data)
+            else:
+                evicted = self._episodes[self._next]
+                self._steps -= len(next(iter(evicted.values())))
+                self._episodes[self._next] = data
+                self._next = (self._next + 1) % self.capacity
+
+    def sample(self, num_seqs: int, seq_len: int) -> dict:
+        """-> dict of [num_seqs, seq_len, ...] arrays plus "mask"
+        [num_seqs, seq_len] (1 = real step) and "h0"/"c0" from the
+        stored per-step recurrent state at each window start."""
+        assert self._episodes, "sample() on an empty buffer"
+        keys = self._episodes[0].keys()
+        out = {k: [] for k in keys}
+        masks = []
+        for _ in range(num_seqs):
+            ep = self._episodes[self._rng.integers(len(self._episodes))]
+            ep_len = len(next(iter(ep.values())))
+            start = int(self._rng.integers(
+                0, max(ep_len - seq_len, 0) + 1))
+            end = min(start + seq_len, ep_len)
+            pad = seq_len - (end - start)
+            for k in keys:
+                window = ep[k][start:end]
+                if pad:
+                    window = np.concatenate(
+                        [window, np.zeros((pad,) + window.shape[1:],
+                                          window.dtype)])
+                out[k].append(window)
+            masks.append(np.concatenate(
+                [np.ones(end - start, np.float32),
+                 np.zeros(pad, np.float32)]))
+        stacked = {k: np.stack(v) for k, v in out.items()}
+        stacked["mask"] = np.stack(masks)
+        # Window-start recurrent state (stored pre-step by the policy).
+        stacked["h0"] = stacked.pop("lstm_h")[:, 0]
+        stacked["c0"] = stacked.pop("lstm_c")[:, 0]
+        return stacked
