@@ -856,10 +856,11 @@ class NodeDaemon:
     def _execute_on_worker(self, sock, msg: dict, req_id: int) -> None:
         """Run a pushed task on a leased worker subprocess and forward
         its (already serialized) result without re-encoding."""
+        from ray_tpu._private.runtime_env_pip import python_for_env
         from ray_tpu._private.worker_process import (WorkerCrashedError,
                                                      WorkerFnMissingError)
         pool = self._get_pool()
-        handle = pool.lease()
+        handle = pool.lease(python_for_env(msg.get("runtime_env")))
         try:
             args, kwargs = self._resolve_markers_for_worker(
                 *_loads(msg["payload"]))

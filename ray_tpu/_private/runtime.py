@@ -1003,8 +1003,11 @@ class Runtime:
                         getattr(spec, "trace_ctx", None),
                         f"task::{spec.name}"):
                     # Remote tasks apply runtime_env daemon-side (the
-                    # request carries it); only local runs apply it here.
-                    if spec.runtime_env and self._remote_conn(spec) is None:
+                    # request carries it) and process-worker tasks apply
+                    # it worker-side (where a pip venv is active); only
+                    # thread-local runs apply it here.
+                    if spec.runtime_env and self._remote_conn(spec) is None \
+                            and not self._use_process_worker(spec):
                         from ray_tpu._private import runtime_env as _renv
                         _renv.setup(spec.runtime_env)
                         with _renv.applied(spec.runtime_env):
@@ -1309,7 +1312,8 @@ class Runtime:
             args, kwargs = self._resolve_args(spec, self._remote_conn(spec))
             _task_context.spec = spec
             try:
-                if spec.runtime_env and self._remote_conn(spec) is None:
+                if spec.runtime_env and self._remote_conn(spec) is None \
+                        and not self._use_process_worker(spec):
                     from ray_tpu._private import runtime_env as _renv
                     _renv.setup(spec.runtime_env)
                     with _renv.applied(spec.runtime_env):
@@ -2005,8 +2009,11 @@ class Runtime:
         (force-cancel, OOM kill) surfaces as WorkerCrashedError."""
         from ray_tpu._private.worker_process import (WorkerCrashedError,
                                                      run_on_worker)
+        from ray_tpu._private.runtime_env_pip import python_for_env
         pool = self._get_process_pool()
-        handle = pool.lease()
+        # pip envs run under their venv interpreter (URI-cached venv,
+        # built on first use); pool reuse is keyed by interpreter.
+        handle = pool.lease(python_for_env(spec.runtime_env))
         handle.current_task = spec.task_id
         with self._lock:
             self._proc_tasks[spec.task_id] = handle
@@ -2046,8 +2053,9 @@ class Runtime:
             # (reference: dedicated workers for actors, worker_pool.h).
             from ray_tpu._private.worker_process import (
                 ProcessActorInstance, run_on_worker)
+            from ray_tpu._private.runtime_env_pip import python_for_env
             pool = self._get_process_pool()
-            handle = pool.lease()
+            handle = pool.lease(python_for_env(spec.runtime_env))
             handle.actor_id = spec.actor_id.hex()
             try:
                 msg = self._worker_exec_msg(spec, args, kwargs, handle,

@@ -68,22 +68,17 @@ def setup(runtime_env: Dict[str, Any]) -> None:
             sys.path.insert(0, parent)
             _path_cache.add(parent)
     for pkg in runtime_env.get("pip") or []:
-        dist_name = pkg.split("==")[0].split(">=")[0].split("[")[0].strip()
-        mod_name = dist_name.replace("-", "_")
-        if importlib.util.find_spec(mod_name) is not None:
+        # Shared resolver (runtime_env_pip.base_satisfies): version
+        # specifiers included, dist-metadata fallback for module!=dist
+        # names (scikit-learn -> sklearn).
+        from ray_tpu._private.runtime_env_pip import base_satisfies
+        if base_satisfies(pkg):
             continue
-        # Distribution name != module name (scikit-learn→sklearn,
-        # Pillow→PIL): check installed distribution metadata.
-        try:
-            import importlib.metadata as _md
-            _md.distribution(dist_name)
-            continue
-        except Exception:  # noqa: BLE001 - PackageNotFoundError et al.
-            pass
         raise RuntimeEnvSetupError(
-            f"runtime_env['pip'] requires {pkg!r} which is not installed; "
-            "in-process workers cannot install packages (no network). "
-            "Pre-install it or drop the requirement.")
+            f"runtime_env['pip'] requires {pkg!r} which is not satisfied "
+            "in this interpreter; in-process workers cannot install "
+            "packages (no network). Pre-install it, use a pip venv "
+            "worker (RAY_TPU_PIP_FIND_LINKS), or drop the requirement.")
 
 
 class applied:

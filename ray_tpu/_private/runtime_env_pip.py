@@ -1,0 +1,175 @@
+"""pip/venv runtime-env plugin: per-environment worker interpreters.
+
+Analog of the reference's _private/runtime_env/pip.py + uri_cache.py:
+a task/actor with ``runtime_env={"pip": [...]}`` runs in a worker process
+whose interpreter lives in a dedicated virtualenv, created once per
+unique requirement set (content-hash URI) and reused for the cluster's
+lifetime. The venv sees the base environment through
+``--system-site-packages`` (jax and friends stay importable without
+re-installing) and gets its OWN site-packages ahead of them.
+
+Offline policy (this environment has no network egress): requirements
+resolve from a local wheel directory when ``RAY_TPU_PIP_FIND_LINKS`` is
+set (``pip install --no-index --find-links ...`` into the venv); without
+one, each requirement must already be satisfied by the base environment
+(checked against installed distribution metadata) — anything else raises
+RuntimeEnvSetupError instead of silently running with missing deps.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from ray_tpu.exceptions import RuntimeEnvSetupError
+
+_CACHE_DEFAULT = "/tmp/ray_tpu_venvs"
+_lock = threading.Lock()          # guards the dicts below only
+_key_locks: Dict[str, threading.Lock] = {}  # per-venv build locks
+_ready: Dict[str, str] = {}  # (cache_dir, key) -> python executable
+
+
+def _dist_name(req: str) -> str:
+    return (req.split("==")[0].split(">=")[0].split("<=")[0]
+            .split("<")[0].split(">")[0].split("!=")[0].split("~=")[0]
+            .split("[")[0].split(";")[0].strip())
+
+
+def base_satisfies(req: str) -> bool:
+    """True iff the BASE environment satisfies this requirement,
+    VERSION SPECIFIERS INCLUDED — 'numpy==1.24.0' against an installed
+    numpy 2.0 is unsatisfied, not silently accepted. Shared by
+    runtime_env.setup's in-process check and the venv resolver."""
+    import importlib.metadata as md
+    import importlib.util
+    try:
+        from packaging.requirements import Requirement
+        parsed = Requirement(req)
+        name, specifier = parsed.name, parsed.specifier
+    except Exception:  # noqa: BLE001 - unparseable: fall back to prefix
+        name, specifier = _dist_name(req), None
+    version = None
+    try:
+        version = md.version(name)
+    except Exception:  # noqa: BLE001 - PackageNotFoundError et al.
+        if specifier is None or len(specifier) == 0:
+            # Unversioned requirement: a bare importable module (no dist
+            # metadata, e.g. a py_modules-style package) still counts.
+            return importlib.util.find_spec(
+                name.replace("-", "_")) is not None
+        return False
+    if specifier is None or len(specifier) == 0:
+        return True
+    return specifier.contains(version, prereleases=True)
+
+
+def venv_key(pip_list: List[str]) -> str:
+    """Content hash of the requirement set + base interpreter: the URI
+    under which the materialized venv is cached."""
+    payload = json.dumps([sorted(pip_list), sys.executable])
+    return hashlib.sha1(payload.encode()).hexdigest()[:16]
+
+
+def ensure_venv(pip_list: List[str],
+                cache_dir: Optional[str] = None) -> str:
+    """Create-or-reuse the venv for this requirement set; returns the
+    venv's python executable for worker spawning."""
+    pip_list = [str(p) for p in (pip_list or [])]
+    key = venv_key(pip_list)
+    base = cache_dir or os.environ.get("RAY_TPU_VENV_CACHE",
+                                       _CACHE_DEFAULT)
+    cache_key = f"{os.path.abspath(base)}:{key}"
+    # Per-venv build locks: a long first-use pip install must not stall
+    # leases of OTHER (especially already-cached) environments.
+    with _lock:
+        cached = _ready.get(cache_key)
+        if cached is not None:
+            return cached
+        key_lock = _key_locks.setdefault(cache_key, threading.Lock())
+    with key_lock:
+        with _lock:
+            cached = _ready.get(cache_key)
+            if cached is not None:
+                return cached
+        venv_dir = os.path.join(base, key)
+        python = os.path.join(venv_dir, "bin", "python")
+        if not os.path.exists(python):
+            _materialize(venv_dir, python, pip_list)
+        with _lock:
+            _ready[cache_key] = python
+        return python
+
+
+def _materialize(venv_dir: str, python: str, pip_list: List[str]) -> None:
+    import venv as _venv
+    find_links = os.environ.get("RAY_TPU_PIP_FIND_LINKS")
+    to_install = []
+    for req in pip_list:
+        if find_links:
+            to_install.append(req)
+        elif not base_satisfies(req):
+            raise RuntimeEnvSetupError(
+                f"runtime_env['pip'] requires {req!r}: not installed in "
+                "the base environment and no local wheel source is "
+                "configured (set RAY_TPU_PIP_FIND_LINKS to a wheel "
+                "directory; this cluster has no network egress).")
+    tmp = venv_dir + ".tmp"
+    if os.path.exists(tmp):
+        import shutil
+        shutil.rmtree(tmp)
+    # system_site_packages: the heavy base stack (jax et al.) stays
+    # visible; with_pip=False keeps creation fast — installs go through
+    # the BASE interpreter's pip with --target into the venv.
+    _venv.EnvBuilder(system_site_packages=True, with_pip=False,
+                     symlinks=True).create(tmp)
+    ver = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    site_dir = os.path.join(tmp, "lib", ver, "site-packages")
+    if sys.prefix != sys.base_prefix:
+        # The BASE interpreter is itself a virtualenv: EnvBuilder chains
+        # to the real python's system site-packages, skipping the base
+        # venv's. A .pth file restores visibility of the running env's
+        # site-packages (where the heavy stack actually lives).
+        import site as _site
+        paths = [p for p in _site.getsitepackages() if os.path.isdir(p)]
+        # addsitedir (not a bare path line): the base env's OWN .pth
+        # files — editable installs live there — must be processed too.
+        lines = [f"import site; site.addsitedir({p!r})" for p in paths]
+        with open(os.path.join(site_dir, "ray_tpu_base_env.pth"),
+                  "w") as f:
+            f.write("\n".join(lines) + "\n")
+    if to_install:
+        cmd = [sys.executable, "-m", "pip", "install", "--quiet",
+               "--no-index", "--find-links", find_links,
+               "--target", site_dir, *to_install]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeEnvSetupError(
+                f"pip install into the runtime venv failed "
+                f"({' '.join(to_install)}): {proc.stderr[-2000:]}")
+    # Atomic publish: concurrent creators race benignly — first rename
+    # wins, the loser's tree is discarded.
+    try:
+        os.makedirs(os.path.dirname(venv_dir), exist_ok=True)
+        os.rename(tmp, venv_dir)
+    except OSError:
+        import shutil
+        if os.path.exists(python):
+            shutil.rmtree(tmp, ignore_errors=True)
+        else:
+            raise
+
+
+def python_for_env(runtime_env: Optional[dict]) -> Optional[str]:
+    """The interpreter a worker for this env must run under, or None for
+    the base interpreter."""
+    pip_list = (runtime_env or {}).get("pip")
+    if not pip_list:
+        return None
+    return ensure_venv(list(pip_list))

@@ -155,8 +155,8 @@ class WorkerHandle:
 
 
 def _spawn_worker(store_name: Optional[str],
-                  env_overrides: Optional[Dict[str, str]] = None
-                  ) -> WorkerHandle:
+                  env_overrides: Optional[Dict[str, str]] = None,
+                  python_exe: Optional[str] = None) -> WorkerHandle:
     parent_sock, child_sock = socket.socketpair()
     env = dict(os.environ)
     # No TPU backend in workers: the chip is single-process (owned by the
@@ -167,7 +167,8 @@ def _spawn_worker(store_name: Optional[str],
     env["RAY_TPU_WORKER"] = "1"
     if env_overrides:
         env.update(env_overrides)
-    cmd = [sys.executable, "-m", "ray_tpu._private.worker_process",
+    cmd = [python_exe or sys.executable, "-m",
+           "ray_tpu._private.worker_process",
            "--fd", str(child_sock.fileno())]
     if store_name:
         cmd += ["--store", store_name]
@@ -192,37 +193,67 @@ def _spawn_worker(store_name: Optional[str],
 
 class WorkerProcessPool:
     """Leases worker subprocesses, reusing idle ones (reference:
-    WorkerPool caches started workers; PopWorker reuses before starting).
-    Dedicated (actor) workers never return to the idle pool."""
+    WorkerPool caches started workers keyed by runtime-env hash;
+    PopWorker reuses before starting). Idle workers are keyed by their
+    interpreter (base vs. a pip-venv python): a venv task never reuses a
+    base worker and vice versa. Dedicated (actor) workers never return
+    to the idle pool."""
 
     def __init__(self, store_name: Optional[str] = None,
                  max_workers: int = 64):
         self.store_name = store_name
         self.max_workers = max_workers
-        self._idle: list = []
+        self._idle: Dict[str, list] = {}
         self._all: list = []
         self._lock = threading.Lock()
         self._available = threading.Condition(self._lock)
         self._closed = False
 
-    def lease(self) -> WorkerHandle:
-        """Lease a worker, spawning up to max_workers; BLOCKS when the
-        pool is saturated until a worker is released (backpressure, not
+    def lease(self, python_exe: Optional[str] = None) -> WorkerHandle:
+        """Lease a worker for the given interpreter (None = base),
+        spawning up to max_workers total; BLOCKS when the pool is
+        saturated until a worker is released (backpressure, not
         failure — callers already queued behind the scheduler)."""
+        key = python_exe or ""
         while True:
+            evict = None
             with self._lock:
                 while True:
-                    while self._idle:
-                        w = self._idle.pop()
+                    idle = self._idle.setdefault(key, [])
+                    while idle:
+                        w = idle.pop()
                         if not w.dead and w.proc.poll() is None:
                             return w
+                        # Died while parked: without this, it counts
+                        # toward max_workers forever (capacity leak).
+                        w.dead = True
+                        if w in self._all:
+                            self._all.remove(w)
                     if self._closed:
                         raise WorkerCrashedError("worker pool is shut down")
                     if len([w for w in self._all if not w.dead]) \
                             < self.max_workers:
                         break
+                    # At capacity: evict an idle worker of ANOTHER
+                    # interpreter key to make room — otherwise a pool
+                    # full of idle base workers deadlocks the first
+                    # venv lease (reference: WorkerPool kills idle
+                    # workers of other runtime envs under pressure).
+                    for other, lst in self._idle.items():
+                        if other != key and lst:
+                            evict = lst.pop()
+                            if evict in self._all:
+                                self._all.remove(evict)
+                            break
+                    if evict is not None:
+                        break
                     self._available.wait(timeout=10)
-            w = _spawn_worker(self.store_name)
+            if evict is not None:
+                evict.stop()
+                evict = None
+                continue  # re-enter: capacity freed
+            w = _spawn_worker(self.store_name, python_exe=python_exe)
+            w.pool_key = key
             with self._lock:
                 if self._closed:
                     pass  # fall through; stop below
@@ -243,7 +274,8 @@ class WorkerProcessPool:
                 pass
         with self._lock:
             if not w.dead and not self._closed and w.actor_id is None:
-                self._idle.append(w)
+                self._idle.setdefault(
+                    getattr(w, "pool_key", ""), []).append(w)
             self._available.notify()
 
     def running_workers(self) -> list:

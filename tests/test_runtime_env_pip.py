@@ -1,0 +1,157 @@
+"""pip/venv runtime envs: per-requirement-set venv workers with a URI
+cache and offline wheel installs (reference: _private/runtime_env/pip.py
++ uri_cache.py)."""
+
+import os
+import sys
+import zipfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+from ray_tpu._private import runtime_env_pip as plugin
+
+
+def _make_wheel(dirpath: str, name: str = "rtp_testpkg",
+                version: str = "0.1") -> str:
+    """Hand-roll a minimal pure-python wheel (a zip with dist-info):
+    no network, no build backend."""
+    whl = os.path.join(dirpath, f"{name}-{version}-py3-none-any.whl")
+    dist = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(whl, "w") as z:
+        z.writestr(f"{name}/__init__.py",
+                   "MAGIC = 'installed-from-local-wheel'\n")
+        z.writestr(f"{dist}/METADATA",
+                   f"Metadata-Version: 2.1\nName: {name}\n"
+                   f"Version: {version}\n")
+        z.writestr(f"{dist}/WHEEL",
+                   "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-"
+                   "Purelib: true\nTag: py3-none-any\n")
+        z.writestr(f"{dist}/RECORD", "")
+    return whl
+
+
+def test_venv_key_is_content_addressed():
+    k1 = plugin.venv_key(["numpy", "einops"])
+    k2 = plugin.venv_key(["einops", "numpy"])  # order-insensitive
+    k3 = plugin.venv_key(["numpy"])
+    assert k1 == k2 and k1 != k3
+
+
+def test_ensure_venv_creates_and_caches(tmp_path):
+    py = plugin.ensure_venv(["numpy"], cache_dir=str(tmp_path))
+    assert os.path.exists(py)
+    assert str(tmp_path) in py
+    # Cached: same interpreter object back, no second venv dir.
+    assert plugin.ensure_venv(["numpy"], cache_dir=str(tmp_path)) == py
+    assert len(os.listdir(tmp_path)) == 1
+    # The venv python runs and sees base site-packages (numpy).
+    import subprocess
+    out = subprocess.run(
+        [py, "-c", "import numpy, sys; print(sys.prefix)"],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert str(tmp_path) in out.stdout
+
+
+def test_missing_requirement_raises(tmp_path, monkeypatch):
+    monkeypatch.delenv("RAY_TPU_PIP_FIND_LINKS", raising=False)
+    with pytest.raises(exceptions.RuntimeEnvSetupError):
+        plugin.ensure_venv(["definitely-not-a-real-package-xyz"],
+                           cache_dir=str(tmp_path))
+
+
+def test_local_wheel_install(tmp_path, monkeypatch):
+    """With RAY_TPU_PIP_FIND_LINKS, requirements install offline from
+    local wheels into the venv's own site-packages."""
+    wheels = tmp_path / "wheels"
+    wheels.mkdir()
+    _make_wheel(str(wheels))
+    monkeypatch.setenv("RAY_TPU_PIP_FIND_LINKS", str(wheels))
+    py = plugin.ensure_venv(["rtp_testpkg"],
+                            cache_dir=str(tmp_path / "venvs"))
+    import subprocess
+    out = subprocess.run(
+        [py, "-c", "import rtp_testpkg; print(rtp_testpkg.MAGIC)"],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "installed-from-local-wheel" in out.stdout
+    # The base interpreter must NOT see it (isolation).
+    out = subprocess.run(
+        [sys.executable, "-c", "import rtp_testpkg"],
+        capture_output=True, text=True)
+    assert out.returncode != 0
+
+
+def test_pip_env_task_runs_in_venv_worker(ray_start_regular, tmp_path,
+                                          monkeypatch):
+    """A pip runtime_env routes the task into a worker process running
+    under the venv interpreter; identical specs share one venv."""
+    monkeypatch.setenv("RAY_TPU_VENV_CACHE", str(tmp_path))
+    plugin._ready.clear()  # fresh cache dir for this test
+
+    @ray_tpu.remote(runtime_env={"pip": ["numpy"]})
+    def where():
+        import sys
+        return sys.prefix, os.getpid()
+
+    p1, pid1 = ray_tpu.get(where.remote())
+    p2, pid2 = ray_tpu.get(where.remote())
+    assert str(tmp_path) in p1          # venv interpreter, not base
+    assert p1 == p2                     # URI cache: one venv
+    assert pid1 != os.getpid()          # real worker process
+    plugin._ready.clear()
+
+
+def test_pip_env_wheel_package_visible_in_task(ray_start_regular,
+                                               tmp_path, monkeypatch):
+    wheels = tmp_path / "wheels"
+    wheels.mkdir()
+    _make_wheel(str(wheels), name="rtp_taskpkg")
+    monkeypatch.setenv("RAY_TPU_PIP_FIND_LINKS", str(wheels))
+    monkeypatch.setenv("RAY_TPU_VENV_CACHE", str(tmp_path / "venvs"))
+    plugin._ready.clear()
+
+    @ray_tpu.remote(runtime_env={"pip": ["rtp_taskpkg"]})
+    def use_it():
+        import rtp_taskpkg
+        return rtp_taskpkg.MAGIC
+
+    assert ray_tpu.get(use_it.remote()) == "installed-from-local-wheel"
+    plugin._ready.clear()
+
+
+def test_version_specifier_is_enforced(tmp_path, monkeypatch):
+    """A pinned requirement the base env can't satisfy must fail loudly,
+    not silently run the wrong version."""
+    monkeypatch.delenv("RAY_TPU_PIP_FIND_LINKS", raising=False)
+    import numpy
+    wrong_pin = f"numpy=={numpy.__version__}.post999"
+    with pytest.raises(exceptions.RuntimeEnvSetupError):
+        plugin.ensure_venv([wrong_pin], cache_dir=str(tmp_path))
+    # The matching pin passes.
+    ok = plugin.ensure_venv([f"numpy=={numpy.__version__}"],
+                            cache_dir=str(tmp_path))
+    assert os.path.exists(ok)
+
+
+def test_pool_evicts_other_key_idle_workers_at_capacity(ray_start_regular):
+    """A pool saturated with idle base-interpreter workers must evict one
+    to serve a lease for a different interpreter, not deadlock."""
+    from ray_tpu._private.worker_process import WorkerProcessPool
+    pool = WorkerProcessPool(max_workers=2)
+    try:
+        a = pool.lease()
+        b = pool.lease()
+        pool.release(a)
+        pool.release(b)
+        # Both idle under the base key; capacity full. A venv-keyed
+        # lease (any other interpreter path — base python works as a
+        # distinct key string) must evict and spawn.
+        w = pool.lease(python_exe=sys.executable)
+        assert not w.dead
+        assert w.pool_key == sys.executable
+        pool.release(w)
+    finally:
+        pool.shutdown()
