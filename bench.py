@@ -108,6 +108,45 @@ def bench_core_ops() -> dict:
     return out
 
 
+def bench_data_shuffle() -> dict:
+    """Single-host shuffle throughput (reference:
+    release_tests.yaml:3447 shuffle nightly — scaled to one host): a
+    multi-GB random_shuffle through the streaming executor + object
+    store, reported as MB/s."""
+    import time as _time
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rdata
+
+    out = {}
+    ray_tpu.init(num_cpus=8)
+    try:
+        n_blocks, rows_per_block, row_bytes = 32, 4096, 8 * 128
+        total_mb = n_blocks * rows_per_block * row_bytes / 1e6  # ~134MB
+
+        def gen(b):
+            ids = np.asarray(b["id"], np.int64)
+            return {"id": ids,
+                    "payload": np.random.default_rng(int(ids[0])).random(
+                        (len(ids), row_bytes // 8))}
+
+        ds = rdata.range(n_blocks * rows_per_block,
+                         parallelism=n_blocks).map_batches(gen)
+        ds = ds.materialize()  # payload generation OUTSIDE the timer
+        t0 = _time.perf_counter()
+        shuffled = ds.random_shuffle(seed=0)
+        count = shuffled.count()  # forces full execution
+        dt = _time.perf_counter() - t0
+        assert count == n_blocks * rows_per_block
+        out["shuffle_mb_per_sec"] = round(total_mb / dt, 1)
+        out["shuffle_data_mb"] = round(total_mb, 1)
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
 def bench_rllib() -> dict:
     """The second north-star metric (BASELINE.json: "RLlib PPO Atari
     with JAX policy learner: env-steps/sec"): PPO with the CNN policy on
@@ -230,6 +269,10 @@ def main():
         extra.update(bench_rllib())
     except Exception:  # noqa: BLE001 - extras must not sink the headline
         extra.setdefault("rllib_env_steps_per_sec", None)
+    try:
+        extra.update(bench_data_shuffle())
+    except Exception:  # noqa: BLE001 - extras must not sink the headline
+        extra.setdefault("shuffle_mb_per_sec", None)
 
     result = {
         "metric": f"{preset}_train_tokens_per_sec_per_chip",

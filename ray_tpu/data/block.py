@@ -179,10 +179,19 @@ def _numpy_dict_to_arrow(cols: Dict[str, np.ndarray]):
         v = np.asarray(v)
         if v.ndim <= 1:
             arrays.append(pa.array(v))
-        else:
-            # N-d tensors: fixed-shape list-of-lists column (round-1 analog
-            # of the reference's ArrowTensorArray extension type).
+        elif v[0].size == 0:
+            # Zero-size element shape: FixedSizeList(size=0) is invalid
+            # in arrow — keep the legacy list-of-lists representation.
             arrays.append(pa.array(v.tolist()))
+        else:
+            # N-d tensors: fixed-shape extension column, zero-copy from
+            # the contiguous values (reference:
+            # data/extensions/tensor_extension.py ArrowTensorArray).
+            # Like the reference's fixed-shape tensor type, every batch
+            # of a column must share one element shape (the shape is
+            # part of the arrow type).
+            from ray_tpu.data.extensions import ArrowTensorArray
+            arrays.append(ArrowTensorArray.from_numpy(v))
         names.append(k)
     return pa.table(arrays, names=names)
 
@@ -216,10 +225,15 @@ class ArrowBlockAccessor(BlockAccessor):
         return self._block
 
     def to_numpy(self, columns=None) -> Dict[str, np.ndarray]:
+        from ray_tpu.data.extensions import ArrowTensorType
         cols = columns or self._block.column_names
         out = {}
         for c in cols:
             col = self._block[c]
+            if isinstance(col.type, ArrowTensorType):
+                out[c] = col.combine_chunks().to_numpy(
+                    zero_copy_only=False)
+                continue
             try:
                 out[c] = col.to_numpy(zero_copy_only=False)
             except Exception:

@@ -1,0 +1,4 @@
+from ray_tpu.data.extensions.tensor_extension import (ArrowTensorArray,
+                                                      ArrowTensorType)
+
+__all__ = ["ArrowTensorArray", "ArrowTensorType"]
