@@ -121,6 +121,13 @@ PRESETS: Dict[str, GPTConfig] = {
     "gpt2-124m": GPTConfig(
         vocab_size=50304, n_layers=12, d_model=768, n_heads=12, d_ff=3072,
         rotary_dim=32, max_seq_len=1024),
+    # HBM-pressure benchmark model (GPT-neo-1.3B dims): adam state for
+    # 1.3B params (~10GB fp32 moments) cannot fit a 16GB chip next to
+    # params+grads — pairs with train_step.memory_efficient_optimizer
+    # (factored second moments) for the single-chip bench.
+    "gpt-1.3b": GPTConfig(
+        vocab_size=50304, n_layers=24, d_model=2048, n_heads=16,
+        d_ff=8192, rotary_dim=64, max_seq_len=1024),
     # Test-size configs.
     "gpt-tiny": GPTConfig(
         vocab_size=256, n_layers=2, d_model=64, n_heads=4, d_ff=128,

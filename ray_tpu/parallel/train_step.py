@@ -34,6 +34,26 @@ def default_optimizer(learning_rate=3e-4, weight_decay=0.1,
     )
 
 
+def memory_efficient_optimizer(learning_rate=1e-4,
+                               warmup_steps: int = 100,
+                               total_steps: int = 10_000
+                               ) -> optax.GradientTransformation:
+    """Adafactor: factored second moments, no first moment — optimizer
+    state shrinks from 2 fp32 copies of the params (adam, ~8 bytes/param)
+    to O(rows + cols) per matrix. The single-chip recipe for models
+    whose adam state would blow HBM (gpt-1.3b on a 16GB chip: params
+    2.6GB bf16 + grads 2.6GB + adam 10.4GB does not fit; with adafactor
+    the whole train state does). The ZeRO-equivalent GSPMD path shards
+    adam state across chips instead — this is the one-chip analog."""
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps,
+        max(total_steps, warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adafactor(learning_rate=schedule, momentum=None),
+    )
+
+
 def init_train_state(cfg: gpt.GPTConfig, mesh,
                      rules: Optional[ShardingRules] = None,
                      optimizer: Optional[optax.GradientTransformation] = None,
