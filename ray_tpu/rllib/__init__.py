@@ -17,6 +17,8 @@ jitted functional step.
 from ray_tpu.rllib.algorithms.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithms.a3c import A3C, A3CConfig
 from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN, ApexDQNConfig
+from ray_tpu.rllib.algorithms.apex_ddpg import (ApexDDPG,
+                                                ApexDDPGConfig)
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.ars import ARS, ARSConfig
 from ray_tpu.rllib.algorithms.bandit import (BanditConfig, BanditLinTS,
@@ -29,17 +31,22 @@ from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.dt import DT, DTConfig
 from ray_tpu.rllib.algorithms.es import ES, ESConfig
 from ray_tpu.rllib.algorithms.impala import Impala, ImpalaConfig
+from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.pg import PG, PGConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.qmix import QMix, QMixConfig
 from ray_tpu.rllib.algorithms.r2d2 import R2D2, R2D2Config
+from ray_tpu.rllib.algorithms.random_agent import (RandomAgent,
+                                                   RandomAgentConfig)
 from ray_tpu.rllib.algorithms.rainbow import Rainbow, RainbowConfig
 from ray_tpu.rllib.algorithms.registry import get_algorithm_class
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.simple_q import SimpleQ, SimpleQConfig
+from ray_tpu.rllib.algorithms.slateq import SlateQ, SlateQConfig
 from ray_tpu.rllib.algorithms.td3 import TD3, TD3Config
 from ray_tpu.rllib.env import MultiAgentEnv
 from ray_tpu.rllib.evaluation.multi_agent_worker import (
@@ -58,14 +65,16 @@ from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
 __all__ = ["A2C", "A2CConfig", "A3C", "A3CConfig", "APPO", "APPOConfig",
            "BanditConfig", "BanditLinTS", "BanditLinTSConfig",
            "BanditLinUCB", "BanditLinUCBConfig",
-           "ApexDQN", "ApexDQNConfig",
+           "ApexDQN", "ApexDQNConfig", "ApexDDPG", "ApexDDPGConfig",
+           "RandomAgent", "RandomAgentConfig",
            "ARS", "ARSConfig", "Algorithm", "AlgorithmConfig", "BC",
            "BCConfig", "CQL", "CQLConfig", "DDPG", "DDPGConfig", "DQN",
-           "DQNConfig", "ES", "ESConfig", "Impala", "ImpalaConfig",
+           "DQNConfig", "DT", "DTConfig", "ES", "ESConfig", "Impala", "ImpalaConfig",
            "JAXPolicy", "JsonReader", "MultiAgentBatch", "MultiAgentEnv",
            "MultiAgentRolloutWorker",
            "JsonWriter", "MARWIL", "MARWILConfig", "ModelCatalog", "PG",
-           "QMix", "QMixConfig",
+           "QMix", "QMixConfig", "MADDPG", "MADDPGConfig",
+           "SlateQ", "SlateQConfig",
            "R2D2", "R2D2Config", "Rainbow", "RainbowConfig",
            "PGConfig", "PPO", "PPOConfig", "QPolicy",
            "PrioritizedReplayBuffer", "ReplayBuffer", "RolloutWorker",

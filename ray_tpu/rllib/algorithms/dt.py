@@ -1,0 +1,381 @@
+"""DT: Decision Transformer — offline RL as sequence modeling.
+
+Analog of the reference's rllib/algorithms/dt (Chen et al. 2021): logged
+episodes become sequences of (return-to-go, observation, action) token
+triples; a small causal transformer is trained to predict each action
+from the tokens before it. At evaluation time the agent CONDITIONS on a
+high target return — writing the desired outcome into the prompt — and
+decrements it by the observed rewards as the episode unfolds, so the
+policy extracted from mixed-quality data can outperform the average
+behavior that produced it.
+
+Offline-only like bc.py: set ``config.offline_data(input_=<dir>)`` with
+JsonWriter output. Discrete action spaces train with cross-entropy; Box
+action spaces with MSE on tanh-squashed predictions. The transformer is
+self-contained (learned position embeddings, pre-LN blocks, causal mask
+over the 3K-token interleaving) — the models/gpt.py stack is an LM with
+token vocabularies, the wrong shape for continuous embeddings here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class DTConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or DT)
+        self.lr = 1e-3
+        self.train_batch_size = 64
+        self.num_rollout_workers = 0   # offline: WorkerSet stays empty
+        self.num_train_batches_per_iteration = 50
+        self.context_len = 20          # K timesteps = 3K tokens
+        self.embed_dim = 64
+        self.n_layers = 2
+        self.n_heads = 4
+        self.max_ep_len = 1000         # timestep-embedding table size
+        #: return-to-go the evaluator conditions on (reference: DTConfig
+        #: target_return); None = max return seen in the dataset.
+        self.target_return = None
+        self.rtg_scale = 100.0         # normalizes RTG token magnitudes
+
+    def training(self, *, context_len=None, embed_dim=None, n_layers=None,
+                 n_heads=None, target_return=None, rtg_scale=None,
+                 num_train_batches_per_iteration=None, max_ep_len=None,
+                 **kwargs) -> "DTConfig":
+        super().training(**kwargs)
+        for name, val in (("context_len", context_len),
+                          ("embed_dim", embed_dim),
+                          ("n_layers", n_layers), ("n_heads", n_heads),
+                          ("target_return", target_return),
+                          ("rtg_scale", rtg_scale),
+                          ("max_ep_len", max_ep_len),
+                          ("num_train_batches_per_iteration",
+                           num_train_batches_per_iteration)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class DT(Algorithm):
+    _default_config_class = DTConfig
+
+    def __init__(self, config=None, **kwargs):
+        cfg = config or self.get_default_config()
+        if not cfg.input_:
+            raise ValueError(
+                "DT is offline-only: set config.offline_data(input_=<dir "
+                "of JSON experience files written by JsonWriter>)")
+        super().__init__(config=config, **kwargs)
+
+    # -- model -----------------------------------------------------------
+
+    def _build_model(self, config: DTConfig):
+        import jax
+        import jax.numpy as jnp
+
+        D, H, L = config.embed_dim, config.n_heads, config.n_layers
+        K = config.context_len
+        obs_dim, act_dim = self._obs_dim, self._act_dim
+        discrete = self._discrete
+
+        def dense(key, din, dout):
+            k1, _ = jax.random.split(key)
+            return {"w": jax.random.normal(k1, (din, dout)) * 0.02,
+                    "b": jnp.zeros((dout,))}
+
+        def apply_dense(p, x):
+            return x @ p["w"] + p["b"]
+
+        key = jax.random.PRNGKey(config.seed)
+        ks = iter(jax.random.split(key, 16 + 8 * L))
+        act_in = act_dim  # one-hot width (discrete) or raw dims (Box)
+        params = {
+            "embed_rtg": dense(next(ks), 1, D),
+            "embed_obs": dense(next(ks), obs_dim, D),
+            "embed_act": dense(next(ks), act_in, D),
+            "embed_t": jax.random.normal(
+                next(ks), (config.max_ep_len, D)) * 0.02,
+            "head": dense(next(ks), D, act_dim),
+            "ln_f": {"g": jnp.ones((D,)), "b": jnp.zeros((D,))},
+            "blocks": [],
+        }
+        for _ in range(L):
+            params["blocks"].append({
+                "ln1": {"g": jnp.ones((D,)), "b": jnp.zeros((D,))},
+                "ln2": {"g": jnp.ones((D,)), "b": jnp.zeros((D,))},
+                "qkv": dense(next(ks), D, 3 * D),
+                "proj": dense(next(ks), D, D),
+                "fc1": dense(next(ks), D, 4 * D),
+                "fc2": dense(next(ks), 4 * D, D),
+            })
+
+        def ln(p, x):
+            mu = x.mean(-1, keepdims=True)
+            var = x.var(-1, keepdims=True)
+            return (x - mu) / jnp.sqrt(var + 1e-5) * p["g"] + p["b"]
+
+        def block(p, x, mask):
+            B, T, _ = x.shape
+            h = ln(p["ln1"], x)
+            qkv = apply_dense(p["qkv"], h).reshape(B, T, 3, H, D // H)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            att = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(D // H)
+            att = jnp.where(mask, att, -1e9)
+            att = jax.nn.softmax(att, -1)
+            out = jnp.einsum("bhts,bshd->bthd", att, v).reshape(B, T, D)
+            x = x + apply_dense(p["proj"], out)
+            h = ln(p["ln2"], x)
+            h = jax.nn.gelu(apply_dense(p["fc1"], h))
+            return x + apply_dense(p["fc2"], h)
+
+        causal = jnp.tril(jnp.ones((3 * K, 3 * K), bool))[None, None]
+
+        def forward(p, rtg, obs, act, timesteps, pad_mask):
+            """rtg [B,K,1], obs [B,K,obs_dim], act [B,K,act_in],
+            timesteps [B,K] int, pad_mask [B,K] -> action preds [B,K,.]
+            read from each OBS token position (sees rtg_t, obs_t and
+            everything before, not act_t)."""
+            B, K_, _ = obs.shape
+            te = p["embed_t"][timesteps]                      # [B,K,D]
+            tok_r = apply_dense(p["embed_rtg"], rtg) + te
+            tok_o = apply_dense(p["embed_obs"], obs) + te
+            tok_a = apply_dense(p["embed_act"], act) + te
+            # Interleave [r_0,o_0,a_0, r_1,o_1,a_1, ...] -> [B,3K,D].
+            x = jnp.stack([tok_r, tok_o, tok_a], axis=2).reshape(
+                B, 3 * K_, -1)
+            m = jnp.repeat(pad_mask, 3, axis=-1)              # [B,3K]
+            mask = causal[:, :, :3 * K_, :3 * K_] & \
+                m[:, None, None, :].astype(bool)
+            for bp in p["blocks"]:
+                x = block(bp, x, mask)
+            x = ln(p["ln_f"], x)
+            obs_tokens = x.reshape(B, K_, 3, -1)[:, :, 1]     # o_t slots
+            return apply_dense(p["head"], obs_tokens)         # [B,K,act]
+
+        return params, forward
+
+    # -- setup -----------------------------------------------------------
+
+    def setup(self, config: DTConfig) -> None:
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.offline.json_reader import JsonReader
+
+        pol = self.local_policy
+        self._obs_dim = pol.obs_dim
+        space = pol.action_space
+        self._discrete = isinstance(space, gym.spaces.Discrete)
+        self._act_dim = (int(space.n) if self._discrete
+                         else int(np.prod(space.shape)))
+
+        # Slice the dataset into episodes once, up front.
+        batch = JsonReader(config.input_).read_all()
+        obs = np.asarray(batch[SampleBatch.OBS], np.float32)
+        acts = np.asarray(batch[SampleBatch.ACTIONS])
+        if not self._discrete:
+            # Normalize logged Box actions to [-1, 1] — the range
+            # tanh(pred) is fit against; evaluate_env maps back.
+            lo = np.asarray(space.low, np.float32).reshape(-1)
+            hi = np.asarray(space.high, np.float32).reshape(-1)
+            acts = 2.0 * (np.asarray(acts, np.float32).reshape(
+                len(acts), -1) - lo) / np.maximum(hi - lo, 1e-8) - 1.0
+        rews = np.asarray(batch[SampleBatch.REWARDS], np.float32)
+        eps = np.asarray(batch[SampleBatch.EPS_ID])
+        self._episodes: List[Dict[str, np.ndarray]] = []
+        for e in np.unique(eps):
+            idx = np.where(eps == e)[0]
+            r = rews[idx]
+            rtg = np.cumsum(r[::-1])[::-1].copy()  # returns-to-go
+            self._episodes.append({
+                "obs": obs[idx], "actions": acts[idx], "rtg": rtg,
+                "timesteps": np.arange(len(idx)) % config.max_ep_len})
+        self._dataset_max_return = max(
+            float(ep["rtg"][0]) for ep in self._episodes)
+
+        self.params, self._forward = self._build_model(config)
+        self._optimizer = optax.adam(config.lr)
+        self._opt_state = self._optimizer.init(self.params)
+        forward = self._forward
+        discrete, act_dim = self._discrete, self._act_dim
+
+        def loss_fn(params, mb):
+            preds = forward(params, mb["rtg"], mb["obs"], mb["act_in"],
+                            mb["timesteps"], mb["mask"])
+            m = mb["mask"]
+            if discrete:
+                logp = jax.nn.log_softmax(preds, -1)
+                picked = jnp.take_along_axis(
+                    logp, mb["actions"][..., None].astype(jnp.int32),
+                    -1)[..., 0]
+                return -(picked * m).sum() / jnp.maximum(m.sum(), 1.0)
+            err = ((jnp.tanh(preds) - mb["actions"]) ** 2).mean(-1)
+            return (err * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+        def update(params, opt_state, mb):
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            updates, opt_state = self._optimizer.update(
+                grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update_jit = jax.jit(update)
+        self._forward_jit = jax.jit(forward)
+        self._rng = np.random.default_rng(config.seed)
+
+    def _sample_minibatch(self, config: DTConfig) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        K = config.context_len
+        B = config.train_batch_size
+        rows = {"rtg": [], "obs": [], "actions": [], "act_in": [],
+                "timesteps": [], "mask": []}
+        # Episodes weighted by length (reference DT samples timesteps
+        # uniformly over the dataset).
+        lens = np.asarray([len(ep["obs"]) for ep in self._episodes],
+                          np.float64)
+        p = lens / lens.sum()
+        for _ in range(B):
+            ep = self._episodes[self._rng.choice(len(self._episodes), p=p)]
+            T = len(ep["obs"])
+            end = int(self._rng.integers(1, T + 1))
+            start = max(0, end - K)
+            sl = slice(start, end)
+            n = end - start
+            pad = K - n
+
+            def padk(x, extra=()):
+                out = np.zeros((K,) + tuple(extra), np.float32)
+                v = np.asarray(x, np.float32)
+                out[pad:] = v.reshape((n,) + tuple(extra))
+                return out
+
+            rows["rtg"].append(padk(ep["rtg"][sl] / config.rtg_scale,
+                                    (1,)))
+            rows["obs"].append(padk(ep["obs"][sl], (self._obs_dim,)))
+            a = ep["actions"][sl]
+            # a_t rides in its own token AFTER o_t in the interleave, so
+            # the causal mask alone keeps it out of a_t's own prediction
+            # (read at the o_t position) — no shifting needed.
+            if self._discrete:
+                rows["actions"].append(padk(a))
+                onehot = np.zeros((K, self._act_dim), np.float32)
+                onehot[np.arange(pad, K), np.asarray(a, int)] = 1.0
+                rows["act_in"].append(onehot)
+            else:
+                av = padk(a, (self._act_dim,))
+                rows["actions"].append(av)
+                rows["act_in"].append(av)
+            ts = np.zeros(K, np.int32)
+            ts[pad:] = ep["timesteps"][sl]
+            rows["timesteps"].append(ts)
+            m = np.zeros(K, np.float32)
+            m[pad:] = 1.0
+            rows["mask"].append(m)
+        out = {k: jnp.asarray(np.stack(v)) for k, v in rows.items()}
+        out["timesteps"] = out["timesteps"].astype(jnp.int32)
+        if self._discrete:
+            out["actions"] = out["actions"].astype(jnp.int32)
+        return out
+
+    def training_step(self) -> Dict[str, Any]:
+        config: DTConfig = self.config
+        losses = []
+        params = self.params
+        for _ in range(config.num_train_batches_per_iteration):
+            mb = self._sample_minibatch(config)
+            self._timesteps_total += config.train_batch_size
+            params, self._opt_state, loss = self._update_jit(
+                params, self._opt_state, mb)
+            losses.append(float(loss))
+        self.params = params
+        return {"loss": float(np.mean(losses)),
+                "dataset_max_return": self._dataset_max_return,
+                "num_batches": len(losses)}
+
+    # -- return-conditioned rollout --------------------------------------
+
+    def evaluate_env(self, env, target_return: float = None,
+                     episodes: int = 5, seed: int = 0) -> float:
+        """Roll out with return-to-go conditioning (the DT inference
+        procedure): prompt with the target, decrement by observed
+        rewards each step."""
+        import jax.numpy as jnp
+        config: DTConfig = self.config
+        if target_return is None:
+            target_return = (config.target_return
+                             if config.target_return is not None
+                             else self._dataset_max_return)
+        K = config.context_len
+        total = 0.0
+        for e in range(episodes):
+            obs, _ = env.reset(seed=seed + e)
+            rtg = [float(target_return)]
+            obs_hist = [np.asarray(obs, np.float32).reshape(-1)]
+            act_hist: List[Any] = []
+            done = False
+            t = 0
+            while not done:
+                n = min(len(obs_hist), K)
+                pad = K - n
+                rtg_w = np.zeros((K, 1), np.float32)
+                rtg_w[pad:, 0] = np.asarray(rtg[-n:]) / config.rtg_scale
+                obs_w = np.zeros((K, self._obs_dim), np.float32)
+                obs_w[pad:] = np.stack(obs_hist[-n:])
+                # Window timesteps t-n+1..t: every action but the final
+                # one is known; the final a_t slot stays zero (the o_t
+                # position that predicts it never attends to it).
+                act_w = np.zeros((K, self._act_dim), np.float32)
+                prev = act_hist[-(n - 1):] if n > 1 else []
+                for i, a in enumerate(prev):
+                    if self._discrete:
+                        act_w[pad + i, int(a)] = 1.0
+                    else:
+                        act_w[pad + i] = a
+                ts = np.zeros(K, np.int32)
+                ts[pad:] = [min(t - n + 1 + i, config.max_ep_len - 1)
+                            for i in range(n)]
+                m = np.zeros(K, np.float32)
+                m[pad:] = 1.0
+                preds = self._forward_jit(
+                    self.params, jnp.asarray(rtg_w[None]),
+                    jnp.asarray(obs_w[None]), jnp.asarray(act_w[None]),
+                    jnp.asarray(ts[None]), jnp.asarray(m[None]))
+                pred = np.asarray(preds[0, -1])
+                if self._discrete:
+                    action = int(pred.argmax())
+                    hist_entry: Any = action
+                else:
+                    # Model space is the normalized [-1, 1] cube (same
+                    # normalization training fit against); map to env
+                    # bounds only for stepping.
+                    norm = np.tanh(pred)
+                    space = self.local_policy.action_space
+                    lo = np.asarray(space.low, np.float32)
+                    hi = np.asarray(space.high, np.float32)
+                    action = lo + (norm + 1.0) * 0.5 * (hi - lo)
+                    hist_entry = norm
+                obs, r, term, trunc, _ = env.step(action)
+                done = term or trunc
+                total += float(r)
+                t += 1
+                act_hist.append(hist_entry)
+                obs_hist.append(np.asarray(obs, np.float32).reshape(-1))
+                rtg.append(rtg[-1] - float(r))
+        return total / episodes
+
+    def get_weights(self):
+        import jax
+        return {"dt_params": jax.tree.map(np.asarray, self.params)}
+
+    def set_weights(self, weights) -> None:
+        import jax
+        import jax.numpy as jnp
+        self.params = jax.tree.map(jnp.asarray, weights["dt_params"])

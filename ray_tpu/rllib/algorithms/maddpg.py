@@ -1,0 +1,293 @@
+"""MADDPG: multi-agent DDPG with centralized critics.
+
+Analog of the reference's rllib/algorithms/maddpg (Lowe et al. 2017,
+"Multi-Agent Actor-Critic for Mixed Cooperative-Competitive
+Environments"): every agent keeps a DECENTRALIZED deterministic actor
+``mu_i(o_i)`` usable with only its own observation at execution time,
+but trains it against a CENTRALIZED critic ``Q_i(o_1..o_n, a_1..a_n)``
+that sees the joint observation and joint action — sidestepping the
+non-stationarity that breaks independent DDPG learners, because the
+joint-conditioned value function is stationary as the other agents'
+policies shift.
+
+Updates (per agent i, from a replay buffer of joint transitions):
+  * critic: TD toward ``r_i + gamma * Q_i'(o', mu_1'(o_1'),...,
+    mu_n'(o_n'))`` with target actors/critics (polyak-averaged),
+  * actor: deterministic policy gradient through the centralized critic
+    with agent i's action from its CURRENT actor and the other agents'
+    actions from the batch (the paper's Eq. 6 sampling approximation).
+
+Collection is in-algorithm (joint transitions must stay synchronized,
+like qmix.py); exploration is Gaussian action noise with linear decay.
+Env contract: a MultiAgentEnv with simultaneous Box actions
+(e.g. env/examples.py CooperativeNavEnv).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+
+class MADDPGConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or MADDPG)
+        self.actor_lr = 1e-3     # reference MADDPGConfig knobs
+        self.critic_lr = 1e-2
+        self.tau = 0.01
+        self.train_batch_size = 256
+        self.replay_buffer_capacity = 50_000
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.num_train_batches_per_iteration = 50
+        self.rollout_steps_per_iteration = 500
+        self.noise_initial = 0.5
+        self.noise_final = 0.05
+        self.noise_timesteps = 15_000
+
+    def training(self, *, actor_lr=None, critic_lr=None, tau=None,
+                 replay_buffer_capacity=None,
+                 num_steps_sampled_before_learning_starts=None,
+                 num_train_batches_per_iteration=None,
+                 rollout_steps_per_iteration=None, noise_timesteps=None,
+                 **kwargs) -> "MADDPGConfig":
+        super().training(**kwargs)
+        for name, val in (
+                ("actor_lr", actor_lr), ("critic_lr", critic_lr),
+                ("tau", tau),
+                ("replay_buffer_capacity", replay_buffer_capacity),
+                ("num_steps_sampled_before_learning_starts",
+                 num_steps_sampled_before_learning_starts),
+                ("num_train_batches_per_iteration",
+                 num_train_batches_per_iteration),
+                ("rollout_steps_per_iteration",
+                 rollout_steps_per_iteration),
+                ("noise_timesteps", noise_timesteps)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class MADDPG(Algorithm):
+    _default_config_class = MADDPGConfig
+    _own_rollout_actors = True
+    _supports_multi_agent = True
+
+    def setup(self, config: MADDPGConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models.catalog import mlp_apply, mlp_init
+
+        env = self._env_creator(config.env_config)
+        self._env = env
+        obs0, _ = env.reset(seed=config.seed)
+        self.agent_ids: List[str] = sorted(obs0.keys())
+        self.n = len(self.agent_ids)
+        self.obs_dims = [int(np.prod(
+            env.observation_space_for(a).shape)) for a in self.agent_ids]
+        self.act_dims = [int(np.prod(
+            env.action_space_for(a).shape)) for a in self.agent_ids]
+        self._act_lo = [np.asarray(env.action_space_for(a).low,
+                                   np.float32) for a in self.agent_ids]
+        self._act_hi = [np.asarray(env.action_space_for(a).high,
+                                   np.float32) for a in self.agent_ids]
+        joint_dim = sum(self.obs_dims) + sum(self.act_dims)
+        hiddens = list(config.fcnet_hiddens)
+
+        key = jax.random.PRNGKey(config.seed)
+        keys = jax.random.split(key, 2 * self.n)
+        self.params = {
+            "actors": [mlp_init(keys[2 * i],
+                                [self.obs_dims[i], *hiddens,
+                                 self.act_dims[i]])
+                       for i in range(self.n)],
+            "critics": [mlp_init(keys[2 * i + 1],
+                                 [joint_dim, *hiddens, 1])
+                        for i in range(self.n)],
+        }
+        self._target = jax.tree.map(jnp.asarray, self.params)
+        self._a_opt = optax.adam(config.actor_lr)
+        self._c_opt = optax.adam(config.critic_lr)
+        self._a_states = [self._a_opt.init(p)
+                          for p in self.params["actors"]]
+        self._c_states = [self._c_opt.init(p)
+                          for p in self.params["critics"]]
+        gamma, tau = config.gamma, config.tau
+        n = self.n
+        los = [jnp.asarray(lo.reshape(-1)) for lo in self._act_lo]
+        his = [jnp.asarray(hi.reshape(-1)) for hi in self._act_hi]
+
+        def act(actor, obs, j):
+            """Deterministic actor for agent j, rescaled from tanh's
+            [-1, 1] to the agent's Box bounds (same mapping as
+            td3.py det_action) so the whole action space is reachable."""
+            t = jnp.tanh(mlp_apply(actor, obs))
+            return los[j] + (t + 1.0) * 0.5 * (his[j] - los[j])
+
+        def critic(cr, obs_list, act_list):
+            return mlp_apply(cr, jnp.concatenate(
+                list(obs_list) + list(act_list), -1))[..., 0]
+
+        def critic_loss(cr_i, i, params_t, mb):
+            obs = [mb[f"obs_{j}"] for j in range(n)]
+            nxt = [mb[f"new_obs_{j}"] for j in range(n)]
+            acts = [mb[f"act_{j}"] for j in range(n)]
+            a_next = [act(params_t["actors"][j], nxt[j], j)
+                      for j in range(n)]
+            q_next = critic(params_t["critics"][i], nxt, a_next)
+            target = mb["rewards"][:, i] + gamma * \
+                (1.0 - mb["dones"][:, 0]) * q_next
+            q = critic(cr_i, obs, acts)
+            return ((q - jax.lax.stop_gradient(target)) ** 2).mean()
+
+        def actor_loss(actor_i, i, critics, mb):
+            obs = [mb[f"obs_{j}"] for j in range(n)]
+            acts = [mb[f"act_{j}"] for j in range(n)]
+            acts = acts[:i] + [act(actor_i, obs[i], i)] + acts[i + 1:]
+            return -critic(critics[i], obs, acts).mean()
+
+        def update(params, params_t, a_states, c_states, mb):
+            new_actors, new_critics = [], []
+            new_a_states, new_c_states = [], []
+            closses, alosses = [], []
+            for i in range(n):
+                cl, cg = jax.value_and_grad(critic_loss)(
+                    params["critics"][i], i, params_t, mb)
+                cu, cs = self._c_opt.update(cg, c_states[i],
+                                            params["critics"][i])
+                new_critics.append(optax.apply_updates(
+                    params["critics"][i], cu))
+                new_c_states.append(cs)
+                crit_now = [*params["critics"][:i], new_critics[i],
+                            *params["critics"][i + 1:]]
+                al, ag = jax.value_and_grad(actor_loss)(
+                    params["actors"][i], i, crit_now, mb)
+                au, s = self._a_opt.update(ag, a_states[i],
+                                           params["actors"][i])
+                new_actors.append(optax.apply_updates(
+                    params["actors"][i], au))
+                new_a_states.append(s)
+                closses.append(cl)
+                alosses.append(al)
+            params = {"actors": new_actors, "critics": new_critics}
+            params_t = jax.tree.map(
+                lambda t, p: (1 - tau) * t + tau * p, params_t, params)
+            return (params, params_t, new_a_states, new_c_states,
+                    sum(closses) / n, sum(alosses) / n)
+
+        self._update_jit = jax.jit(update)
+        self._act_jit = jax.jit(
+            lambda actors, obs_list: [act(a, o, j) for j, (a, o) in
+                                      enumerate(zip(actors, obs_list))])
+        self._rng = np.random.default_rng(config.seed)
+        self._buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                    seed=config.seed)
+        self._obs = obs0
+        self._episode_reward = 0.0
+        self._episode_rewards: List[float] = []
+
+    # -- acting ----------------------------------------------------------
+
+    def _noise(self) -> float:
+        c: MADDPGConfig = self.config
+        frac = min(1.0, self._timesteps_total / max(c.noise_timesteps, 1))
+        return c.noise_initial + frac * (c.noise_final - c.noise_initial)
+
+    def compute_actions(self, obs_dict, noise: float = 0.0
+                        ) -> Dict[str, np.ndarray]:
+        import jax.numpy as jnp
+        obs_list = [jnp.asarray(
+            np.asarray(obs_dict[a], np.float32).reshape(1, -1))
+            for a in self.agent_ids]
+        acts = self._act_jit(self.params["actors"], obs_list)
+        out = {}
+        for i, aid in enumerate(self.agent_ids):
+            a = np.asarray(acts[i][0], np.float32)
+            if noise > 0:
+                a = a + noise * self._rng.standard_normal(a.shape)
+            out[aid] = np.clip(a, self._act_lo[i], self._act_hi[i]
+                               ).astype(np.float32)
+        return out
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        config: MADDPGConfig = self.config
+        sigma = self._noise()
+        for _ in range(config.rollout_steps_per_iteration):
+            acts = self.compute_actions(self._obs, sigma)
+            nxt, rewards, terms, truncs, _ = self._env.step(acts)
+            terminated = bool(terms.get("__all__"))
+            done = terminated or bool(truncs.get("__all__"))
+            self._episode_reward += float(sum(rewards.values()))
+            row = {"rewards": np.asarray(
+                [rewards[a] for a in self.agent_ids], np.float32),
+                "dones": np.asarray([float(terminated)], np.float32)}
+            for j, aid in enumerate(self.agent_ids):
+                row[f"obs_{j}"] = np.asarray(self._obs[aid], np.float32)
+                row[f"act_{j}"] = acts[aid]
+                # nxt is always a valid observation; terminated rows are
+                # masked out of the bootstrap by "dones", and truncated
+                # rows NEED the real post-step obs to bootstrap through.
+                row[f"new_obs_{j}"] = np.asarray(nxt[aid], np.float32)
+            self._buffer.add(SampleBatch(
+                {k: np.asarray(v)[None] for k, v in row.items()}))
+            self._timesteps_total += 1
+            if done:
+                self._episode_rewards.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self._obs, _ = self._env.reset()
+            else:
+                self._obs = nxt
+
+        closses, alosses = [], []
+        if len(self._buffer) >= max(
+                config.num_steps_sampled_before_learning_starts,
+                config.train_batch_size):
+            params, target = self.params, self._target
+            a_states, c_states = self._a_states, self._c_states
+            for _ in range(config.num_train_batches_per_iteration):
+                sampled = self._buffer.sample(config.train_batch_size)
+                mb = {k: jnp.asarray(v) for k, v in sampled.items()}
+                (params, target, a_states, c_states, cl, al) = \
+                    self._update_jit(params, target, a_states,
+                                     c_states, mb)
+                closses.append(float(cl))
+                alosses.append(float(al))
+            self.params, self._target = params, target
+            self._a_states, self._c_states = a_states, c_states
+
+        window = self._episode_rewards[-100:]
+        return {
+            "critic_loss": float(np.mean(closses)) if closses else
+            float("nan"),
+            "actor_loss": float(np.mean(alosses)) if alosses else
+            float("nan"),
+            "noise_sigma": sigma,
+            "episode_reward_mean": (float(np.mean(window)) if window
+                                    else float("nan")),
+            "episodes_total": len(self._episode_rewards),
+        }
+
+    def get_weights(self):
+        import jax
+        return {"maddpg_params": jax.tree.map(np.asarray, self.params),
+                "maddpg_target": jax.tree.map(np.asarray, self._target)}
+
+    def set_weights(self, weights) -> None:
+        import jax
+        import jax.numpy as jnp
+        self.params = jax.tree.map(jnp.asarray, weights["maddpg_params"])
+        self._target = jax.tree.map(jnp.asarray,
+                                    weights["maddpg_target"])
+
+    def stop(self) -> None:
+        close = getattr(self._env, "close", None)
+        if callable(close):
+            close()

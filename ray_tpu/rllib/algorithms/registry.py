@@ -10,6 +10,7 @@ def get_algorithm_class(name: str) -> Type:
     from ray_tpu.rllib.algorithms.a2c import A2C
     from ray_tpu.rllib.algorithms.a3c import A3C
     from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN
+    from ray_tpu.rllib.algorithms.apex_ddpg import ApexDDPG
     from ray_tpu.rllib.algorithms.appo import APPO
     from ray_tpu.rllib.algorithms.ars import ARS
     from ray_tpu.rllib.algorithms.bandit import BanditLinTS, BanditLinUCB
@@ -17,24 +18,30 @@ def get_algorithm_class(name: str) -> Type:
     from ray_tpu.rllib.algorithms.cql import CQL
     from ray_tpu.rllib.algorithms.ddpg import DDPG
     from ray_tpu.rllib.algorithms.dqn import DQN
+    from ray_tpu.rllib.algorithms.dt import DT
     from ray_tpu.rllib.algorithms.es import ES
     from ray_tpu.rllib.algorithms.impala import Impala
+    from ray_tpu.rllib.algorithms.maddpg import MADDPG
     from ray_tpu.rllib.algorithms.marwil import MARWIL
     from ray_tpu.rllib.algorithms.pg import PG
     from ray_tpu.rllib.algorithms.ppo import PPO
     from ray_tpu.rllib.algorithms.qmix import QMix
     from ray_tpu.rllib.algorithms.r2d2 import R2D2
+    from ray_tpu.rllib.algorithms.random_agent import RandomAgent
     from ray_tpu.rllib.algorithms.rainbow import Rainbow
     from ray_tpu.rllib.algorithms.sac import SAC
     from ray_tpu.rllib.algorithms.simple_q import SimpleQ
+    from ray_tpu.rllib.algorithms.slateq import SlateQ
     from ray_tpu.rllib.algorithms.td3 import TD3
 
     table = {"PPO": PPO, "DQN": DQN, "SAC": SAC, "A2C": A2C, "A3C": A3C,
              "IMPALA": Impala, "TD3": TD3, "BC": BC, "APPO": APPO,
              "PG": PG, "MARWIL": MARWIL, "DDPG": DDPG, "SIMPLEQ": SimpleQ,
-             "APEX": ApexDQN, "APEX-DQN": ApexDQN, "RAINBOW": Rainbow,
-             "R2D2": R2D2, "QMIX": QMix,
-             "ES": ES, "ARS": ARS, "CQL": CQL,
+             "APEX": ApexDQN, "APEX-DQN": ApexDQN,
+             "APEX-DDPG": ApexDDPG, "RANDOM": RandomAgent, "RAINBOW": Rainbow,
+             "R2D2": R2D2, "QMIX": QMix, "MADDPG": MADDPG,
+             "SLATEQ": SlateQ,
+             "ES": ES, "ARS": ARS, "CQL": CQL, "DT": DT,
              "BANDITLINUCB": BanditLinUCB, "BANDITLINTS": BanditLinTS}
     try:
         return table[name.upper()]

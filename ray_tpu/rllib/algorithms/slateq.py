@@ -1,0 +1,283 @@
+"""SlateQ: reinforcement learning for slate-based recommendation.
+
+Analog of the reference's rllib/algorithms/slateq (Ie et al. 2019,
+"SlateQ: A Tractable Decomposition for Reinforcement Learning with
+Recommendation Sets"): the combinatorial slate action space is made
+tractable by decomposing the slate's value under a conditional-logit
+user-choice model into per-item long-term values:
+
+    Q(s, A) = sum_{i in A} P(click i | s, A) * Q_item(s, i)
+
+Two networks are learned jointly from logged interactions:
+  * a **choice model** ``v(s, doc)`` trained by cross-entropy on which
+    slate item the user actually clicked (the no-click option is a
+    constant-logit outside option, matching the env's ground truth), and
+  * an **item-level Q** ``Q_item(s, doc)`` trained by TD: on a click of
+    doc ``d``, target ``r + gamma * max_A' Q(s', A')`` where the max
+    enumerates all candidate slates using the decomposition (exact for
+    the default 10-choose-3 = 120 slates; the reference's policy likewise
+    scores all slates, slateq_tf_policy.py).
+
+Collection is in-algorithm (epsilon-greedy over the decomposed argmax
+slate) because slates of distinct indices do not fit the shared rollout
+workers' Discrete/Box policy contract — same stance as QMIX's joint
+collection (qmix.py).
+"""
+
+from __future__ import annotations
+
+from itertools import combinations
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+
+class SlateQConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or SlateQ)
+        self.lr = 1e-3
+        self.lr_choice_model = 1e-3  # reference SlateQConfig knob
+        self.train_batch_size = 64
+        self.replay_buffer_capacity = 20_000
+        self.num_steps_sampled_before_learning_starts = 500
+        self.num_train_batches_per_iteration = 64
+        self.target_network_update_freq = 200
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 3000
+        self.rollout_steps_per_iteration = 500
+        self.fcnet_hiddens_per_candidate = (64, 32)  # reference knob
+
+    def training(self, *, lr_choice_model=None,
+                 replay_buffer_capacity=None,
+                 num_steps_sampled_before_learning_starts=None,
+                 num_train_batches_per_iteration=None,
+                 target_network_update_freq=None, epsilon_timesteps=None,
+                 rollout_steps_per_iteration=None,
+                 fcnet_hiddens_per_candidate=None,
+                 **kwargs) -> "SlateQConfig":
+        super().training(**kwargs)
+        for name, val in (
+                ("lr_choice_model", lr_choice_model),
+                ("replay_buffer_capacity", replay_buffer_capacity),
+                ("num_steps_sampled_before_learning_starts",
+                 num_steps_sampled_before_learning_starts),
+                ("num_train_batches_per_iteration",
+                 num_train_batches_per_iteration),
+                ("target_network_update_freq", target_network_update_freq),
+                ("epsilon_timesteps", epsilon_timesteps),
+                ("rollout_steps_per_iteration",
+                 rollout_steps_per_iteration),
+                ("fcnet_hiddens_per_candidate",
+                 fcnet_hiddens_per_candidate)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class SlateQ(Algorithm):
+    _default_config_class = SlateQConfig
+    _own_rollout_actors = True
+
+    def setup(self, config: SlateQConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models.catalog import mlp_apply, mlp_init
+
+        env = self._env_creator(config.env_config)
+        self._env = env
+        self.C = env.num_candidates
+        self.k = env.slate_size
+        self.T = env.num_topics
+        self.doc_dim = self.T + 1
+        self.no_click_score = env.no_click_score
+        #: all unordered slates, [S, k] — the exact argmax domain.
+        self.slates = np.asarray(
+            list(combinations(range(self.C), self.k)), np.int32)
+
+        per_cand = list(config.fcnet_hiddens_per_candidate)
+        in_dim = self.T + self.doc_dim  # user ++ one doc's features
+        key = jax.random.PRNGKey(config.seed)
+        kq, kv = jax.random.split(key)
+        self.params = {
+            "q": mlp_init(kq, [in_dim, *per_cand, 1]),
+            "choice": mlp_init(kv, [in_dim, *per_cand, 1]),
+        }
+        self._target = jax.tree.map(jnp.asarray, self.params)
+        self._optimizer = optax.multi_transform(
+            {"q": optax.adam(config.lr),
+             "choice": optax.adam(config.lr_choice_model)},
+            {"q": "q", "choice": "choice"})
+        self._opt_state = self._optimizer.init(self.params)
+        gamma = config.gamma
+        slates = jnp.asarray(self.slates)            # [S, k]
+        ncs = float(self.no_click_score)
+
+        def per_item(net, user, docs):
+            """user [B,T], docs [B,C,doc_dim] -> [B,C] scalars."""
+            u = jnp.broadcast_to(user[:, None, :],
+                                 (user.shape[0], docs.shape[1],
+                                  user.shape[1]))
+            x = jnp.concatenate([u, docs], -1)
+            return mlp_apply(net, x)[..., 0]
+
+        def slate_values(params, user, docs):
+            """Decomposed Q(s, A) for every slate A -> [B, S]."""
+            q = per_item(params["q"], user, docs)        # [B, C]
+            v = per_item(params["choice"], user, docs)   # [B, C]
+            qs = q[:, slates]                            # [B, S, k]
+            vs = v[:, slates]                            # [B, S, k]
+            logits = jnp.concatenate(
+                [vs, jnp.full(vs.shape[:-1] + (1,), ncs)], -1)
+            p = jax.nn.softmax(logits, -1)[..., :-1]     # click probs
+            return (p * qs).sum(-1)                      # [B, S]
+
+        def loss_fn(params, target_params, mb):
+            user, docs = mb["user"], mb["docs"]
+            # Choice-model cross-entropy on observed clicks (null = k).
+            v = per_item(params["choice"], user, docs)   # [B, C]
+            vslate = jnp.take_along_axis(v, mb["slate"], -1)  # [B, k]
+            logits = jnp.concatenate(
+                [vslate, jnp.full((v.shape[0], 1), ncs)], -1)
+            logp = jax.nn.log_softmax(logits, -1)
+            pick = mb["pick"][:, 0]                      # k == null
+            choice_loss = -jnp.take_along_axis(
+                logp, pick[:, None], -1)[:, 0].mean()
+            # Item-level TD on the clicked doc only (no click => no
+            # item-level credit, per the paper's decomposition).
+            q = per_item(params["q"], user, docs)
+            clicked_doc = jnp.take_along_axis(
+                mb["slate"], jnp.minimum(pick, self.k - 1)[:, None], -1)
+            q_taken = jnp.take_along_axis(q, clicked_doc, -1)[:, 0]
+            next_best = slate_values(
+                target_params, mb["next_user"], mb["next_docs"]).max(-1)
+            target = mb["rewards"][:, 0] + gamma * \
+                (1.0 - mb["dones"][:, 0]) * next_best
+            clicked = (pick < self.k).astype(jnp.float32)
+            td = (q_taken - jax.lax.stop_gradient(target)) * clicked
+            q_loss = (td ** 2).sum() / jnp.maximum(clicked.sum(), 1.0)
+            return q_loss + choice_loss, (q_loss, choice_loss)
+
+        def update(params, target_params, opt_state, mb):
+            (_, (ql, cl)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, mb)
+            updates, opt_state = self._optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, ql, cl
+
+        def greedy_slate(params, user, docs):
+            return slate_values(params, user, docs).argmax(-1)
+
+        self._update_jit = jax.jit(update)
+        self._greedy_jit = jax.jit(greedy_slate)
+        self._slate_values_jit = jax.jit(slate_values)
+        self._rng = np.random.default_rng(config.seed)
+        self._buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                    seed=config.seed)
+        self._grad_steps = 0
+        self._obs, _ = env.reset(seed=config.seed)
+        self._episode_reward = 0.0
+        self._episode_rewards: List[float] = []
+
+    # -- acting ----------------------------------------------------------
+
+    def _epsilon(self) -> float:
+        c: SlateQConfig = self.config
+        frac = min(1.0, self._timesteps_total / max(c.epsilon_timesteps, 1))
+        return c.epsilon_initial + frac * (c.epsilon_final
+                                           - c.epsilon_initial)
+
+    def compute_slate(self, obs: np.ndarray, epsilon: float = 0.0
+                      ) -> np.ndarray:
+        """The decomposition-argmax slate (epsilon-greedy over it)."""
+        if self._rng.random() < epsilon:
+            return self._rng.choice(self.C, self.k, replace=False)
+        user, docs = self._env.split_obs(np.asarray(obs, np.float32))
+        import jax.numpy as jnp
+        s = int(self._greedy_jit(self.params, jnp.asarray(user[None]),
+                                 jnp.asarray(docs[None]))[0])
+        return self.slates[s]
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+        config: SlateQConfig = self.config
+        eps = self._epsilon()
+        for _ in range(config.rollout_steps_per_iteration):
+            user, docs = self._env.split_obs(self._obs)
+            slate = self.compute_slate(self._obs, eps)
+            nxt, r, term, trunc, info = self._env.step(slate)
+            nuser, ndocs = self._env.split_obs(nxt)
+            clicked = info.get("clicked", -1)  # slate POSITION, -1=null
+            pick = clicked if clicked >= 0 else self.k
+            self._episode_reward += r
+            row = {"user": user, "docs": docs,
+                   "slate": np.asarray(slate, np.int32),
+                   "pick": np.asarray([pick], np.int32),
+                   "rewards": np.asarray([r], np.float32),
+                   "dones": np.asarray([float(term)], np.float32),
+                   "next_user": nuser, "next_docs": ndocs}
+            self._buffer.add(SampleBatch(
+                {k: np.asarray(v)[None] for k, v in row.items()}))
+            self._timesteps_total += 1
+            if term or trunc:
+                self._episode_rewards.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self._obs, _ = self._env.reset()
+            else:
+                self._obs = nxt
+
+        q_losses, c_losses = [], []
+        if len(self._buffer) >= max(
+                config.num_steps_sampled_before_learning_starts,
+                config.train_batch_size):
+            params = self.params
+            for _ in range(config.num_train_batches_per_iteration):
+                sampled = self._buffer.sample(config.train_batch_size)
+                mb = {k: jnp.asarray(v) for k, v in sampled.items()}
+                params, self._opt_state, ql, cl = self._update_jit(
+                    params, self._target, self._opt_state, mb)
+                q_losses.append(float(ql))
+                c_losses.append(float(cl))
+                self._grad_steps += 1
+                if self._grad_steps % \
+                        config.target_network_update_freq == 0:
+                    self._target = jax.tree.map(jnp.asarray, params)
+            self.params = params
+
+        window = self._episode_rewards[-100:]
+        return {
+            "q_loss": float(np.mean(q_losses)) if q_losses else
+            float("nan"),
+            "choice_loss": float(np.mean(c_losses)) if c_losses else
+            float("nan"),
+            "epsilon": eps,
+            "episode_reward_mean": (float(np.mean(window)) if window
+                                    else float("nan")),
+            "episodes_total": len(self._episode_rewards),
+        }
+
+    def get_weights(self):
+        import jax
+        return {"slateq_params": jax.tree.map(np.asarray, self.params),
+                "slateq_target": jax.tree.map(np.asarray, self._target)}
+
+    def set_weights(self, weights) -> None:
+        import jax
+        import jax.numpy as jnp
+        self.params = jax.tree.map(jnp.asarray, weights["slateq_params"])
+        self._target = jax.tree.map(jnp.asarray,
+                                    weights["slateq_target"])
+
+    def stop(self) -> None:
+        close = getattr(self._env, "close", None)
+        if callable(close):
+            close()

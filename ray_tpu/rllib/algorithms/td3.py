@@ -16,7 +16,8 @@ import numpy as np
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
-from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+from ray_tpu.rllib.utils.replay_buffers import (PrioritizedReplayBuffer,
+                                                 ReplayBuffer)
 
 
 class TD3Config(AlgorithmConfig):
@@ -33,12 +34,17 @@ class TD3Config(AlgorithmConfig):
         self.policy_delay = 2
         self.target_noise = 0.2
         self.target_noise_clip = 0.5
+        self.prioritized_replay = False
+        self.prioritized_replay_alpha = 0.6
+        self.prioritized_replay_beta = 0.4
+        self.n_step = 1  # multi-step returns (learner bootstraps gamma^k)
 
     def training(self, *, tau=None, critic_lr=None, policy_delay=None,
                  target_noise=None, target_noise_clip=None,
                  replay_buffer_capacity=None,
                  num_train_batches_per_iteration=None,
                  num_steps_sampled_before_learning_starts=None,
+                 prioritized_replay=None, n_step=None,
                  **kwargs) -> "TD3Config":
         super().training(**kwargs)
         for name, val in (("tau", tau), ("critic_lr", critic_lr),
@@ -49,7 +55,9 @@ class TD3Config(AlgorithmConfig):
                           ("num_train_batches_per_iteration",
                            num_train_batches_per_iteration),
                           ("num_steps_sampled_before_learning_starts",
-                           num_steps_sampled_before_learning_starts)):
+                           num_steps_sampled_before_learning_starts),
+                          ("prioritized_replay", prioritized_replay),
+                          ("n_step", n_step)):
             if val is not None:
                 setattr(self, name, val)
         return self
@@ -95,8 +103,13 @@ class TD3(Algorithm):
         self._critic_opt = optax.adam(config.critic_lr)
         self._actor_state = self._actor_opt.init(policy.params)
         self._critic_state = self._critic_opt.init(self._q_params)
-        self._buffer = ReplayBuffer(config.replay_buffer_capacity,
-                                    seed=config.seed)
+        if config.prioritized_replay:
+            self._buffer: ReplayBuffer = PrioritizedReplayBuffer(
+                config.replay_buffer_capacity,
+                alpha=config.prioritized_replay_alpha, seed=config.seed)
+        else:
+            self._buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                        seed=config.seed)
         self._updates = 0
         gamma, tau = config.gamma, config.tau
         noise, noise_clip = config.target_noise, config.target_noise_clip
@@ -110,12 +123,17 @@ class TD3(Algorithm):
             next_a = jnp.clip(next_a + eps, low, high)
             q1_t = q_apply(q_target["q1"], mb["new_obs"], next_a)
             q2_t = q_apply(q_target["q2"], mb["new_obs"], next_a)
-            target = mb["rewards"] + gamma * (1 - mb["terminateds"]) * \
+            # n-step rows carry their own bootstrap discount gamma^k.
+            disc = mb.get("n_step_discount", gamma)
+            target = mb["rewards"] + disc * (1 - mb["terminateds"]) * \
                 jnp.minimum(q1_t, q2_t)
             target = jax.lax.stop_gradient(target)
             q1 = q_apply(q_params["q1"], mb["obs"], mb["actions"])
             q2 = q_apply(q_params["q2"], mb["obs"], mb["actions"])
-            return ((q1 - target) ** 2 + (q2 - target) ** 2).mean()
+            td = 0.5 * (q1 - target) + 0.5 * (q2 - target)
+            w = mb.get("weights", jnp.ones_like(target))
+            loss = (w * ((q1 - target) ** 2 + (q2 - target) ** 2)).mean()
+            return loss, td
 
         def actor_loss(actor_params, q_params, mb):
             a = det_action(actor_params, mb["obs"])
@@ -123,7 +141,8 @@ class TD3(Algorithm):
 
         def update(actor_params, actor_target, q_params, q_target,
                    actor_state, critic_state, mb, key, do_actor):
-            c_loss, c_grads = jax.value_and_grad(critic_loss)(
+            (c_loss, td), c_grads = jax.value_and_grad(
+                critic_loss, has_aux=True)(
                 q_params, q_target, actor_target, mb, key)
             c_updates, critic_state = self._critic_opt.update(
                 c_grads, critic_state, q_params)
@@ -152,7 +171,7 @@ class TD3(Algorithm):
             q_target = jax.tree.map(polyak, q_params, q_target)
             actor_target = jax.tree.map(polyak, actor_params, actor_target)
             return (actor_params, actor_target, q_params, q_target,
-                    actor_state, critic_state,
+                    actor_state, critic_state, td,
                     {"critic_loss": c_loss, "actor_loss": a_loss})
 
         self._update_jit = jax.jit(update)
@@ -168,6 +187,9 @@ class TD3(Algorithm):
         self.workers.sync_weights(weights_ref)
         batch = self.workers.sample(max(config.rollout_fragment_length, 1))
         self._timesteps_total += len(batch)
+        if config.n_step > 1:
+            from ray_tpu.rllib.utils.replay_buffers import n_step_transform
+            batch = n_step_transform(batch, config.n_step, config.gamma)
         self._buffer.add(batch)
         metrics_out: Dict[str, Any] = {}
         if len(self._buffer) >= max(
@@ -175,20 +197,29 @@ class TD3(Algorithm):
                 config.train_batch_size):
             actor_params = self.local_policy.params
             for _ in range(config.num_train_batches_per_iteration):
-                mb = self._buffer.sample(config.train_batch_size)
+                if config.prioritized_replay:
+                    mb = self._buffer.sample(
+                        config.train_batch_size,
+                        beta=config.prioritized_replay_beta)
+                else:
+                    mb = self._buffer.sample(config.train_batch_size)
                 device_mb = {k: jnp.asarray(v) for k, v in mb.items()
                              if k in ("obs", "new_obs", "actions",
-                                      "rewards", "terminateds")}
+                                      "rewards", "terminateds", "weights",
+                                      "n_step_discount")}
                 self._key, sub = jax.random.split(self._key)
                 self._updates += 1
                 do_actor = jnp.bool_(
                     self._updates % config.policy_delay == 0)
                 (actor_params, self._actor_target, self._q_params,
                  self._q_target, self._actor_state, self._critic_state,
-                 metrics) = self._update_jit(
+                 td, metrics) = self._update_jit(
                     actor_params, self._actor_target, self._q_params,
                     self._q_target, self._actor_state, self._critic_state,
                     device_mb, sub, do_actor)
+                if config.prioritized_replay:
+                    self._buffer.update_priorities(
+                        mb["batch_indexes"], np.asarray(td))
             self.local_policy.params = actor_params
             metrics_out = {k: float(v) for k, v in metrics.items()}
         metrics_out["replay_buffer_size"] = len(self._buffer)

@@ -62,3 +62,72 @@ class CoordinationGameEnv(MultiAgentEnv):
         terms = {"a0": done, "a1": done, "__all__": done}
         truncs = {"a0": False, "a1": False, "__all__": False}
         return obs, rewards, terms, truncs, {}
+
+
+class CooperativeNavEnv(MultiAgentEnv):
+    """Continuous cooperative navigation (MADDPG's home turf — the
+    "simple spread" task of the MPE suite the reference's MADDPG README
+    points at): ``n_agents`` point masses must cover ``n_agents``
+    landmarks in the 2D unit box. The team reward (shared equally) is
+    minus the sum over landmarks of the distance to the CLOSEST agent —
+    maximized only when the agents divide the landmarks among
+    themselves, which requires coordinating through the joint state.
+    Observations: own position ++ all landmark offsets ++ other agents'
+    positions. Actions: Box(2,) velocity in [-1, 1], integrated with
+    ``dt``."""
+
+    def __init__(self, config: Optional[dict] = None):
+        config = dict(config or {})
+        self.n_agents = int(config.get("n_agents", 2))
+        self.horizon = int(config.get("horizon", 25))
+        self.dt = float(config.get("dt", 0.15))
+        self.agent_ids = {f"a{i}" for i in range(self.n_agents)}
+        self._ids = sorted(self.agent_ids)
+        obs_dim = 2 + 2 * self.n_agents + 2 * (self.n_agents - 1)
+        if spaces is not None:
+            # Landmark offsets span [-3, 3]: positions clip at +-2 and
+            # landmarks spawn in [-1, 1].
+            self.observation_space = spaces.Box(
+                -3.0, 3.0, (obs_dim,), np.float32)
+            self.action_space = spaces.Box(-1.0, 1.0, (2,), np.float32)
+        self._rng = np.random.default_rng(config.get("seed", 0))
+        self._pos = None
+        self._landmarks = None
+        self._t = 0
+
+    def _obs(self):
+        out = {}
+        for i, aid in enumerate(self._ids):
+            others = np.delete(self._pos, i, axis=0)
+            out[aid] = np.concatenate(
+                [self._pos[i], (self._landmarks - self._pos[i]).ravel(),
+                 others.ravel()]).astype(np.float32)
+        return out
+
+    def _team_reward(self) -> float:
+        d = np.linalg.norm(
+            self._landmarks[:, None, :] - self._pos[None, :, :], axis=-1)
+        return float(-d.min(axis=1).sum())
+
+    def reset(self, *, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._pos = self._rng.uniform(-1, 1, (self.n_agents, 2))
+        self._landmarks = self._rng.uniform(-1, 1, (self.n_agents, 2))
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action_dict):
+        for i, aid in enumerate(self._ids):
+            a = np.clip(np.asarray(action_dict[aid], np.float64), -1, 1)
+            self._pos[i] = np.clip(self._pos[i] + self.dt * a, -2, 2)
+        self._t += 1
+        done = self._t >= self.horizon
+        r = self._team_reward() / self.n_agents
+        obs = self._obs()
+        rewards = {aid: r for aid in self._ids}
+        terms = {aid: done for aid in self._ids}
+        terms["__all__"] = done
+        truncs = {aid: False for aid in self._ids}
+        truncs["__all__"] = False
+        return obs, rewards, terms, truncs, {}
