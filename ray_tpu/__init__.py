@@ -14,6 +14,7 @@ cancel, get_actor, ...``.
 from ray_tpu import exceptions
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.worker import (ClientContext, available_resources,
+                                     cluster_usage,
                                      cancel, cluster_resources, free, get,
                                      get_actor, get_tpu_ids, init,
                                      is_initialized, kill, nodes, put,
@@ -35,6 +36,7 @@ __all__ = [
     "RemoteFunction",
     "__version__",
     "available_resources",
+    "cluster_usage",
     "cancel",
     "cluster_resources",
     "exceptions",

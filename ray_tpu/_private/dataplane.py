@@ -72,6 +72,10 @@ class NodeObjectTable:
         self._arena = None
         self.stats = {"pulled_bytes": 0, "served_bytes": 0,
                       "pulls": 0, "serves": 0}
+        # Best-effort usage accounting for the resource syncer (the
+        # arena additionally evicts under pressure, so this is an upper
+        # bound there — the syncer's view is advisory, not a ledger).
+        self._sizes: Dict[str, int] = {}
         if capacity > 0:
             try:
                 from ray_tpu._private.native_store import NativeObjectStore
@@ -85,6 +89,8 @@ class NodeObjectTable:
         return self._arena.name if self._arena is not None else None
 
     def put(self, key: str, payload: bytes) -> None:
+        with self._lock:
+            self._sizes[key] = len(payload)
         if self._arena is not None and self._arena.put_bytes(key, payload):
             return
         with self._lock:
@@ -127,6 +133,13 @@ class NodeObjectTable:
             self._arena.delete(key)
         with self._lock:
             self._heap.pop(key, None)
+            self._sizes.pop(key, None)
+
+    def usage(self) -> Dict[str, int]:
+        with self._lock:
+            return {"objects": len(self._sizes),
+                    "bytes": sum(self._sizes.values()),
+                    **self.stats}
 
     def _bump(self, counter: str, n: int = 1) -> None:
         with self._lock:
@@ -153,6 +166,8 @@ class NodeObjectTable:
                     self._arena.abort(key)
                     raise
                 self._arena.seal(key)
+                with self._lock:
+                    self._sizes[key] = size
                 return
         buf = bytearray(size)
         view = memoryview(buf)
@@ -164,6 +179,7 @@ class NodeObjectTable:
             read += n
         with self._lock:
             self._heap[key] = bytes(buf)
+            self._sizes[key] = size
 
     def close(self) -> None:
         if self._arena is not None:

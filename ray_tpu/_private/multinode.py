@@ -331,6 +331,8 @@ class NodeConnection:
             "name": spec.name,
             "runtime_env": spec.runtime_env,
             "tpu_ids": getattr(spec, "_tpu_ids", None),
+            "num_cpus": float(getattr(spec, "resources", {}).get(
+                "CPU", 1.0) or 0.0),
             "store_limit": store_limit,
         }
         with self._lock:
@@ -510,6 +512,10 @@ class HeadServer:
         self._hb_thread = threading.Thread(
             target=self._health_check_loop, name="ray_tpu-head-health",
             daemon=True)
+        # Cluster-wide usage view fed by daemon pong piggybacks
+        # (reference: ray_syncer receiver side in the GCS).
+        from ray_tpu._private.syncer import ClusterSyncState
+        self.syncer = ClusterSyncState()
 
     def start(self) -> Tuple[str, int]:
         self._accept_thread.start()
@@ -524,6 +530,7 @@ class HeadServer:
         uses per-node async timers for that regime)."""
         import time
         misses: Dict[Any, int] = {}
+        digest_sent: Dict[Any, int] = {}
         # A daemon that never opens its health channel gets this long
         # before it's declared unobservable (covers hang-before-connect).
         channel_grace = self._hb_period * (self._hb_threshold + 5)
@@ -535,6 +542,13 @@ class HeadServer:
             for nid in list(misses):
                 if nid not in alive_ids:
                     misses.pop(nid, None)
+            for nid in list(digest_sent):
+                if nid not in alive_ids:
+                    digest_sent.pop(nid, None)
+            # One digest per sweep, shipped to a node only when newer
+            # than what it last acked (the only-changed rule the
+            # daemon->head direction already follows).
+            digest = self.syncer.digest()
             for node_id, conn in current:
                 hc = conn.health_sock
                 if hc is None:
@@ -550,8 +564,16 @@ class HeadServer:
                     # socket timeout, never queued behind data transfers
                     # and never contending for the data send lock.
                     hc.settimeout(self._hb_period * 2)
-                    _send_frame(hc, _dumps({"type": "ping"}))
-                    _loads(_recv_frame(hc))
+                    ping: dict = {"type": "ping"}
+                    if digest["version"] > digest_sent.get(node_id, -1):
+                        ping["cluster_digest"] = digest
+                    _send_frame(hc, _dumps(ping))
+                    pong = _loads(_recv_frame(hc))
+                    if "cluster_digest" in ping:
+                        digest_sent[node_id] = digest["version"]
+                    sync = pong.get("sync")
+                    if sync:
+                        self.syncer.apply(node_id.hex(), sync)
                     misses[node_id] = 0
                 except (OSError, ConnectionError, TimeoutError):
                     misses[node_id] = misses.get(node_id, 0) + 1
@@ -644,6 +666,8 @@ class HeadServer:
         if self._closed:
             return
         self._conns.pop(conn.node_id, None)
+        if conn.node_id is not None:
+            self.syncer.remove_node(conn.node_id.hex())
         self.runtime.unregister_remote_node(conn.node_id)
 
     def stop(self) -> None:
@@ -668,6 +692,12 @@ class HeadServer:
 # ---------------------------------------------------------------------------
 # Daemon side
 # ---------------------------------------------------------------------------
+
+
+#: The NodeDaemon serving this process, if any — lets user code running
+#: in-daemon (TPU tasks, actor methods) read the gossiped cluster view
+#: locally via ray_tpu.cluster_usage() without a round-trip to the head.
+_current_daemon: Optional["NodeDaemon"] = None
 
 
 class NodeDaemon:
@@ -722,6 +752,49 @@ class NodeDaemon:
         self._session_registered = False
         self._health_started = False
         self._object_server_host: Optional[str] = None
+        # Resource-usage sync (reference: common/ray_syncer): changed
+        # component snapshots piggyback on health-channel pongs; the
+        # head's aggregated cluster digest rides back on pings.
+        from ray_tpu._private.syncer import (DigestCache,
+                                             NodeSyncReporter)
+        self.syncer_reporter = NodeSyncReporter()
+        self.cluster_digest = DigestCache()
+        self._inflight = 0
+        self._inflight_cpu = 0.0
+        self._inflight_lock = threading.Lock()
+        self._register_sync_collectors()
+
+    def _register_sync_collectors(self) -> None:
+        from ray_tpu._private import syncer as _sync
+
+        def resource_load():
+            with self._inflight_lock:
+                inflight = self._inflight
+                cpu_used = self._inflight_cpu
+            avail = dict(self.resources)
+            if "CPU" in avail:
+                avail["CPU"] = max(0.0, avail["CPU"] - cpu_used)
+            return {"total": dict(self.resources), "available": avail,
+                    "inflight_tasks": inflight,
+                    "actors": len(self._actors)}
+
+        def object_store():
+            return self._table.usage()
+
+        def memory():
+            try:
+                with open("/proc/self/status") as f:
+                    for line in f:
+                        if line.startswith("VmRSS:"):
+                            kb = int(line.split()[1])
+                            return {"rss_bytes": kb * 1024}
+            except OSError:
+                pass
+            return None
+
+        self.syncer_reporter.register(_sync.RESOURCE_LOAD, resource_load)
+        self.syncer_reporter.register(_sync.OBJECT_STORE, object_store)
+        self.syncer_reporter.register(_sync.MEMORY, memory)
 
     def _load_function(self, fn_id: bytes, fn_bytes: Optional[bytes]):
         fn = self._functions.get(fn_id)
@@ -923,6 +996,26 @@ class NodeDaemon:
                 {"req_id": req_id, "ok": False, "error": reply["error"]}),
                 self._send_lock)
 
+    #: frame kinds that run user code and hold node resources; data-
+    #: plane/control frames (fetch_object, stats, ...) never count.
+    _USER_CODE_KINDS = frozenset(
+        {"execute_task", "create_actor", "actor_call"})
+
+    def _handle_counted(self, sock, msg: dict) -> None:
+        counted = msg.get("type") in self._USER_CODE_KINDS
+        cpus = float(msg.get("num_cpus", 1.0)) if counted else 0.0
+        if counted:
+            with self._inflight_lock:
+                self._inflight += 1
+                self._inflight_cpu += cpus
+        try:
+            self._handle(sock, msg)
+        finally:
+            if counted:
+                with self._inflight_lock:
+                    self._inflight -= 1
+                    self._inflight_cpu -= cpus
+
     def _handle(self, sock, msg: dict) -> None:
         req_id = msg.get("req_id", 0)
         kind = msg.get("type")
@@ -1008,9 +1101,19 @@ class NodeDaemon:
                 hc.settimeout(None)
                 _send_frame(hc, _dumps({"type": "health_channel",
                                         "node_id": self.node_id_hex}))
+                # New channel == new peer state, BOTH directions: re-ship
+                # every component snapshot (a restarted head starts from
+                # nothing) and forget the old head's digest (the new
+                # head's version counter restarts near zero).
+                self.syncer_reporter.reset_peer()
+                self.cluster_digest.reset()
                 while not self._stop.is_set():
-                    _recv_frame(hc)
-                    _send_frame(hc, _dumps({"type": "pong"}))
+                    ping = _loads(_recv_frame(hc))
+                    self.cluster_digest.apply(
+                        ping.get("cluster_digest"))
+                    _send_frame(hc, _dumps(
+                        {"type": "pong",
+                         "sync": self.syncer_reporter.poll()}))
                 return
             except (ConnectionError, OSError):
                 time.sleep(backoff)
@@ -1045,6 +1148,8 @@ class NodeDaemon:
         restart + resubscribe). An orderly head shutdown frame exits
         immediately."""
         import time as _time
+        global _current_daemon
+        _current_daemon = self
         ever_registered = False
         deadline = _time.monotonic() + max(reconnect_window, 0.0)
         backoff = 0.2
@@ -1152,7 +1257,7 @@ class NodeDaemon:
                 # session replies into a closed socket (dropped), never
                 # into a later session whose fresh req_id counter would
                 # collide with this frame's req_id.
-                threading.Thread(target=self._handle,
+                threading.Thread(target=self._handle_counted,
                                  args=(self._sock, msg),
                                  daemon=True).start()
         finally:

@@ -1,0 +1,160 @@
+"""Resource-usage sync: the Ray-syncer analog.
+
+Analog of the reference's ``common/ray_syncer/ray_syncer.h:88``: each
+node owns a set of *components* (resource load, object-store usage,
+memory) whose snapshots carry **per-component version numbers**; only
+CHANGED snapshots are shipped, and a receiver applies a message only
+when its version is newer than the last applied one for that
+(node, component) — stale or duplicated deliveries are dropped, counted,
+and harmless, so the transport needs no ordering guarantees beyond
+"eventually delivers something recent".
+
+Topology (matching the head/daemon wire protocol in multinode.py rather
+than the reference's raylet-mesh gRPC streams): daemons piggyback their
+changed snapshots on health-channel pong frames (tiny, periodic, never
+queued behind data transfers), and the head piggybacks its aggregated
+**cluster digest** on ping frames — so every daemon converges on a view
+of cluster-wide resource usage without a second connection, and the head
+stops being the only process that can answer "what is the cluster
+doing" (the resource-gossip role of ``GrpcBasedResourceBroadcaster``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# Component names (reference: ray_syncer MessageType RESOURCE_VIEW /
+# COMMANDS; ours are usage-oriented).
+RESOURCE_LOAD = "resource_load"
+OBJECT_STORE = "object_store"
+MEMORY = "memory"
+
+
+class NodeSyncReporter:
+    """Daemon-side: collects component snapshots and emits only the
+    changed ones, each under a monotonically increasing version."""
+
+    def __init__(self) -> None:
+        self._collectors: Dict[str, Callable[[], Optional[dict]]] = {}
+        self._versions: Dict[str, int] = {}
+        self._last_payload: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def register(self, component: str,
+                 collect: Callable[[], Optional[dict]]) -> None:
+        with self._lock:
+            self._collectors[component] = collect
+
+    def reset_peer(self) -> None:
+        """Forget what the peer has seen (head restarted): every
+        component re-ships its current snapshot on the next poll, under
+        a BUMPED version — the new head must not drop it as stale."""
+        with self._lock:
+            self._last_payload.clear()
+
+    def poll(self) -> List[dict]:
+        """Collect every component; emit {component, version, payload}
+        for the ones whose payload changed since the last emit. A
+        collector returning None (or raising) is skipped this round —
+        a flaky gauge must not kill the health channel."""
+        out: List[dict] = []
+        with self._lock:
+            for comp, collect in self._collectors.items():
+                try:
+                    payload = collect()
+                except Exception:  # noqa: BLE001 - gauge failure != death
+                    continue
+                if payload is None:
+                    continue
+                if self._last_payload.get(comp) == payload:
+                    continue
+                version = self._versions.get(comp, 0) + 1
+                self._versions[comp] = version
+                self._last_payload[comp] = payload
+                out.append({"component": comp, "version": version,
+                            "payload": payload})
+        return out
+
+
+class ClusterSyncState:
+    """Receiver + aggregator: versioned only-newer application per
+    (node, component), and a cluster digest for gossip-back."""
+
+    def __init__(self) -> None:
+        self._applied: Dict[Tuple[str, str], int] = {}
+        self._view: Dict[str, Dict[str, dict]] = {}
+        self._lock = threading.Lock()
+        self.stale_drops = 0
+        self._digest_version = 0
+
+    def apply(self, node_id: str, messages: List[dict]) -> int:
+        """Apply a batch from one node; returns how many were NEW.
+        Messages at or below the last applied version are dropped."""
+        applied = 0
+        with self._lock:
+            for msg in messages:
+                comp = msg["component"]
+                key = (node_id, comp)
+                if msg["version"] <= self._applied.get(key, 0):
+                    self.stale_drops += 1
+                    continue
+                self._applied[key] = msg["version"]
+                self._view.setdefault(node_id, {})[comp] = msg["payload"]
+                applied += 1
+            if applied:
+                self._digest_version += 1
+        return applied
+
+    def remove_node(self, node_id: str) -> None:
+        with self._lock:
+            self._view.pop(node_id, None)
+            for key in [k for k in self._applied if k[0] == node_id]:
+                del self._applied[key]
+            self._digest_version += 1
+
+    def view(self) -> Dict[str, Dict[str, dict]]:
+        with self._lock:
+            return {n: dict(comps) for n, comps in self._view.items()}
+
+    def digest(self) -> dict:
+        """The gossip-back payload: per-node usage plus cluster totals,
+        stamped with a version so daemons can apply only-newer too."""
+        with self._lock:
+            totals: Dict[str, float] = {}
+            for comps in self._view.values():
+                load = comps.get(RESOURCE_LOAD, {})
+                for name, amt in load.get("available", {}).items():
+                    totals[name] = totals.get(name, 0.0) + float(amt)
+            return {"version": self._digest_version,
+                    "nodes": {n: dict(comps)
+                              for n, comps in self._view.items()},
+                    "available_total": totals}
+
+
+class DigestCache:
+    """Daemon-side holder of the head's cluster digest (only-newer)."""
+
+    def __init__(self) -> None:
+        self._digest: Optional[dict] = None
+        self._lock = threading.Lock()
+
+    def apply(self, digest: Optional[dict]) -> bool:
+        if not digest:
+            return False
+        with self._lock:
+            if self._digest is not None and \
+                    digest.get("version", 0) <= \
+                    self._digest.get("version", 0):
+                return False
+            self._digest = digest
+            return True
+
+    def reset(self) -> None:
+        """New head epoch (reconnect): any incoming version is newer."""
+        with self._lock:
+            self._digest = None
+
+    def get(self) -> Optional[dict]:
+        with self._lock:
+            return self._digest

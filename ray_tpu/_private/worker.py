@@ -230,6 +230,28 @@ def nodes() -> List[dict]:
     return global_worker.runtime.scheduler.nodes_snapshot()
 
 
+def cluster_usage() -> dict:
+    """Per-node resource/object-store/memory usage synced from the node
+    daemons (the ray-syncer view, _private/syncer.py — reference:
+    common/ray_syncer/ray_syncer.h gossip aggregated by the GCS). Keys:
+    ``nodes`` (node_id → component payloads), ``available_total``,
+    ``version``. Empty until daemons have reported (one health-check
+    period); the head node itself schedules in-process and is not
+    listed."""
+    srv = getattr(global_worker.runtime, "_head_server", None)
+    if srv is not None:
+        return srv.syncer.digest()
+    # In-daemon execution (TPU tasks / actor methods on a node daemon):
+    # serve the gossiped digest the head pushes on health pings.
+    from ray_tpu._private import multinode as _mn
+    daemon = _mn._current_daemon
+    if daemon is not None:
+        digest = daemon.cluster_digest.get()
+        if digest is not None:
+            return digest
+    return {"version": 0, "nodes": {}, "available_total": {}}
+
+
 def free(object_refs: Sequence[ObjectRef]) -> None:
     global_worker.runtime.free_objects(
         [r.object_id() for r in object_refs])

@@ -1,0 +1,220 @@
+"""Resource-usage syncer: versioned only-newer semantics and the
+health-channel gossip loop (reference: common/ray_syncer/ray_syncer.h:88
++ gcs resource broadcast)."""
+
+import json
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import syncer as sync
+
+
+# -- unit: reporter ------------------------------------------------------
+
+def test_reporter_emits_only_changes_with_monotonic_versions():
+    rep = sync.NodeSyncReporter()
+    state = {"v": 1}
+    rep.register("load", lambda: {"x": state["v"]})
+    msgs = rep.poll()
+    assert [(m["component"], m["version"]) for m in msgs] == [("load", 1)]
+    # Unchanged payload: nothing shipped, version not burned.
+    assert rep.poll() == []
+    state["v"] = 2
+    msgs = rep.poll()
+    assert msgs[0]["version"] == 2 and msgs[0]["payload"] == {"x": 2}
+
+
+def test_reporter_reset_peer_reships_under_new_version():
+    rep = sync.NodeSyncReporter()
+    rep.register("load", lambda: {"x": 1})
+    assert rep.poll()[0]["version"] == 1
+    rep.reset_peer()  # head restarted: same payload must re-ship...
+    msg = rep.poll()[0]
+    assert msg["payload"] == {"x": 1}
+    assert msg["version"] == 2  # ...under a NEWER version
+
+
+def test_reporter_survives_flaky_collector():
+    rep = sync.NodeSyncReporter()
+    rep.register("bad", lambda: 1 / 0)
+    rep.register("none", lambda: None)
+    rep.register("good", lambda: {"ok": True})
+    msgs = rep.poll()
+    assert [m["component"] for m in msgs] == ["good"]
+
+
+# -- unit: receiver ------------------------------------------------------
+
+def test_receiver_drops_stale_and_duplicate_versions():
+    st = sync.ClusterSyncState()
+    m1 = {"component": "load", "version": 1, "payload": {"x": 1}}
+    m2 = {"component": "load", "version": 2, "payload": {"x": 2}}
+    assert st.apply("n1", [m1]) == 1
+    assert st.apply("n1", [m1]) == 0          # duplicate
+    assert st.apply("n1", [m2, m1]) == 1      # stale after newer
+    assert st.stale_drops == 2
+    assert st.view()["n1"]["load"] == {"x": 2}
+    # Same versions from a DIFFERENT node are independent.
+    assert st.apply("n2", [m1]) == 1
+
+
+def test_receiver_digest_aggregates_and_versions():
+    st = sync.ClusterSyncState()
+    st.apply("n1", [{"component": sync.RESOURCE_LOAD, "version": 1,
+                     "payload": {"available": {"CPU": 3.0}}}])
+    st.apply("n2", [{"component": sync.RESOURCE_LOAD, "version": 1,
+                     "payload": {"available": {"CPU": 1.0,
+                                               "TPU": 4.0}}}])
+    d = st.digest()
+    assert d["available_total"] == {"CPU": 4.0, "TPU": 4.0}
+    v = d["version"]
+    st.remove_node("n2")
+    d2 = st.digest()
+    assert d2["available_total"] == {"CPU": 3.0}
+    assert d2["version"] > v
+    assert "n2" not in d2["nodes"]
+
+
+def test_digest_cache_only_newer():
+    c = sync.DigestCache()
+    assert not c.apply(None)
+    assert c.apply({"version": 2, "nodes": {}})
+    assert not c.apply({"version": 1, "nodes": {}})   # stale
+    assert not c.apply({"version": 2, "nodes": {}})   # duplicate
+    assert c.apply({"version": 3, "nodes": {"a": {}}})
+    assert c.get()["version"] == 3
+
+
+def test_digest_cache_reset_accepts_new_epoch():
+    """After a head restart the new head's version counter restarts near
+    zero; reset() must let its digests in."""
+    c = sync.DigestCache()
+    c.apply({"version": 500, "nodes": {}})
+    assert not c.apply({"version": 1, "nodes": {"fresh": {}}})
+    c.reset()
+    assert c.get() is None
+    assert c.apply({"version": 1, "nodes": {"fresh": {}}})
+
+
+def test_object_table_usage_accounting():
+    """put, peer-pull (recv_into), and free all keep the usage gauge
+    consistent — pulled objects must not be invisible to the syncer."""
+    import socket
+    import threading
+
+    from ray_tpu._private.dataplane import NodeObjectTable
+    t = NodeObjectTable(capacity=0)  # heap mode: deterministic
+    t.put("a", b"x" * 1000)
+    assert t.usage()["objects"] == 1 and t.usage()["bytes"] == 1000
+    # Peer pull path.
+    left, right = socket.socketpair()
+    payload = b"y" * 2048
+    sender = threading.Thread(target=left.sendall, args=(payload,))
+    sender.start()
+    t.recv_into("b", len(payload), right)
+    sender.join()
+    left.close()
+    right.close()
+    u = t.usage()
+    assert u["objects"] == 2 and u["bytes"] == 3048
+    t.free("a")
+    u = t.usage()
+    assert u["objects"] == 1 and u["bytes"] == 2048
+    t.close()
+
+
+# -- integration: real daemon over the health channel --------------------
+
+def _spawn_daemon(port, *, num_cpus=4, resources=None):
+    cmd = [sys.executable, "-m", "ray_tpu._private.multinode",
+           "--address", f"127.0.0.1:{port}",
+           "--num-cpus", str(num_cpus)]
+    if resources:
+        cmd += ["--resources", json.dumps(resources)]
+    return subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def test_cluster_usage_converges(ray_start_regular):
+    """A daemon's usage snapshots reach ray_tpu.cluster_usage() within a
+    few health periods, and object-store payloads reflect stored
+    objects."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0,
+                 _system_config={"health_check_period_ms": 100,
+                                 # Big results stay daemon-resident so
+                                 # the object_store component has bytes.
+                                 "remote_object_inline_limit_bytes": 1000})
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    p = _spawn_daemon(port, num_cpus=2, resources={"remote": 2})
+    try:
+        deadline = time.monotonic() + 20
+        while ray_tpu.cluster_resources().get("remote", 0) < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+
+        @ray_tpu.remote(resources={"remote": 1})
+        def big():
+            return np.zeros(100_000, np.uint8)
+
+        ref = big.remote()
+        assert ray_tpu.get(ref).nbytes == 100_000
+
+        def usage_ok():
+            u = ray_tpu.cluster_usage()
+            if len(u["nodes"]) != 1:
+                return False
+            comps = next(iter(u["nodes"].values()))
+            load = comps.get(sync.RESOURCE_LOAD)
+            store = comps.get(sync.OBJECT_STORE)
+            if not load or not store:
+                return False
+            assert load["total"]["remote"] == 2.0
+            assert "CPU" in load["available"]
+            # The 100KB result is daemon-resident.
+            return store["bytes"] >= 100_000 and store["objects"] >= 1
+
+        while not usage_ok():
+            assert time.monotonic() < deadline, ray_tpu.cluster_usage()
+            time.sleep(0.1)
+        assert ray_tpu.cluster_usage()["available_total"]["remote"] == 2.0
+        del ref
+    finally:
+        p.kill()
+        p.wait(timeout=10)
+        ray_tpu.shutdown()
+
+
+def test_cluster_usage_drops_dead_nodes(ray_start_regular):
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0,
+                 _system_config={"health_check_period_ms": 100,
+                                 "health_check_failure_threshold": 3})
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    p = _spawn_daemon(port, num_cpus=2, resources={"remote": 2})
+    try:
+        deadline = time.monotonic() + 20
+        while len(ray_tpu.cluster_usage()["nodes"]) < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        p.kill()
+        p.wait(timeout=10)
+        while len(ray_tpu.cluster_usage()["nodes"]) > 0:
+            assert time.monotonic() < deadline, \
+                "dead node never left the usage view"
+            time.sleep(0.1)
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait(timeout=10)
+        ray_tpu.shutdown()
+
+
+def test_cluster_usage_empty_without_head_server(ray_start_regular):
+    u = ray_tpu.cluster_usage()
+    assert u == {"version": 0, "nodes": {}, "available_total": {}}
