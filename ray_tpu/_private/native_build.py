@@ -79,6 +79,50 @@ def build_library(name: str, extra_flags: Optional[List[str]] = None
     return out
 
 
+#: Every native component linked into the sanitizer stress binary.
+STRESS_COMPONENTS = ("sched", "refcount", "pubsub", "shm_store",
+                     "config", "memmon")
+
+
+def build_stress_binary(sanitize: str) -> Optional[str]:
+    """Compile the multithreaded stress driver (stress.cc) plus every
+    native component into one executable under ``-fsanitize=<sanitize>``
+    (thread | address) — the analog of the reference's TSAN/ASAN bazel
+    configs (.bazelrc:92-116). Cached by the combined source hash; None
+    when g++ or the sanitizer runtime is unavailable."""
+    assert sanitize in ("thread", "address"), sanitize
+    srcs = [os.path.join(_SRC, "stress.cc")] + [
+        os.path.join(_SRC, f"{c}.cc") for c in STRESS_COMPONENTS]
+    if not all(os.path.exists(s) for s in srcs):
+        return None
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    digest = h.hexdigest()[:12]
+    prefix = f"stress-{sanitize}-"
+    out = os.path.join(
+        _BUILD_DIR, f"{prefix}{digest}-{platform.machine()}")
+    with _lock_for(f"stress:{sanitize}"):
+        if os.path.exists(out):
+            return out
+        tmp = f"{out}.tmp{os.getpid()}"
+        try:
+            subprocess.run(
+                ["g++", "-O1", "-g", "-std=c++17",
+                 f"-fsanitize={sanitize}", "-o", tmp] + srcs +
+                ["-lpthread", "-lrt"],
+                check=True, capture_output=True, timeout=300)
+            os.replace(tmp, out)
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            cleanup_artifacts(_BUILD_DIR, prefix, keep=None, tmp=tmp)
+            return None
+        cleanup_artifacts(_BUILD_DIR, prefix,
+                          keep=os.path.basename(out), tmp=None)
+    return out
+
+
 def load_library(name: str, extra_flags: Optional[List[str]] = None
                  ) -> Optional[ctypes.CDLL]:
     path = build_library(name, extra_flags)

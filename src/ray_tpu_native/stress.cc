@@ -1,0 +1,276 @@
+// Sanitizer stress driver for the native runtime components.
+//
+// Analog of the reference's TSAN/ASAN CI configs (.bazelrc:92-116): every
+// native library's C ABI is hammered from many threads at once while the
+// binary runs under -fsanitize=thread or -fsanitize=address (see
+// native_build.py build_stress_binary / tests/test_native_sanitize.py).
+// The driver exits 0 on a clean run; a sanitizer report fails the run
+// via halt_on_error/abort (asserted by the gated pytest).
+//
+// Intentionally cruel schedules: node churn during placement-group
+// rescheduling, subscriber drops during long-polls, object delete racing
+// reads, force-free racing borrower returns — the interleavings the
+// single-process Python tests can't reliably produce.
+
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+// C ABIs of the components under test (linked from their .cc files).
+extern "C" {
+// sched.cc
+void* rsched_create();
+void rsched_destroy(void*);
+int64_t rsched_add_node(void*, const char*);
+int rsched_remove_node(void*, int64_t);
+int64_t rsched_pick_and_acquire(void*, const char*, int);
+int rsched_try_acquire_on(void*, int64_t, const char*);
+void rsched_release_on(void*, int64_t, const char*);
+double rsched_utilization(void*);
+int64_t rsched_pg_create(void*, const char*, int);
+int rsched_pg_remove(void*, int64_t);
+int64_t rsched_pg_reschedule_lost(void*, int64_t*, int64_t);
+// refcount.cc
+void* rrc_create();
+void rrc_destroy(void*);
+void rrc_add_owned(void*, const char*);
+void rrc_add_local(void*, const char*);
+int64_t rrc_remove_local(void*, const char*, char*, int64_t);
+void rrc_add_borrower(void*, const char*, const char*);
+int64_t rrc_remove_borrower(void*, const char*, const char*, char*,
+                            int64_t);
+void rrc_add_contained(void*, const char*, const char*);
+int64_t rrc_force_free(void*, const char*, char*, int64_t);
+int64_t rrc_last_freed(void*, char*, int64_t);
+int rrc_has(void*, const char*);
+int64_t rrc_num_tracked(void*);
+// pubsub.cc
+void* rpb_create();
+void rpb_destroy(void*);
+void rpb_subscribe(void*, const char*, const char*, const char*);
+void rpb_unsubscribe(void*, const char*, const char*, const char*);
+void rpb_drop_subscriber(void*, const char*);
+int64_t rpb_publish(void*, const char*, const char*, const char*);
+int64_t rpb_poll(void*, const char*, int64_t, char*, int64_t);
+// shm_store.cc
+void* shm_store_open(const char*, uint64_t, int);
+void shm_store_close(void*);
+void shm_store_unlink(void*);
+int64_t shm_store_create(void*, const char*, uint64_t);
+int shm_store_seal(void*, const char*);
+int64_t shm_store_get(void*, const char*, uint64_t*);
+int shm_store_release(void*, const char*);
+int shm_store_delete(void*, const char*);
+int shm_store_abort(void*, const char*);
+uint64_t shm_store_used_bytes(void*);
+uint64_t shm_store_num_objects(void*);
+void shm_store_write(void*, int64_t, const uint8_t*, uint64_t);
+// config.cc
+void* rcfg_create(const char*);
+void rcfg_destroy(void*);
+int64_t rcfg_get_int(void*, const char*);
+int rcfg_set(void*, const char*, const char*);
+int64_t rcfg_dump(void*, char*, int64_t);
+// memmon.cc
+int64_t rmm_snapshot(char*, int64_t);
+double rmm_usage_fraction();
+}
+
+namespace {
+
+constexpr int kThreads = 4;
+// Per-thread op counts: tuned so the full suite finishes in a few
+// seconds natively (sanitizers run 5-15x slower; the gated test allows
+// minutes). The sched loop intentionally LEAKS half its nodes to grow
+// the scan set, so its cost is quadratic — keep its budget small.
+constexpr int kIters = 2000;
+constexpr int kSchedIters = 250;
+
+void stress_sched() {
+  void* s = rsched_create();
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back([s, t] {
+      for (int i = 0; i < kSchedIters; ++i) {
+        int64_t n = rsched_add_node(s, "CPU=4;memory=1000");
+        int64_t picked = rsched_pick_and_acquire(s, "CPU=1", i % 3);
+        if (picked >= 0) rsched_release_on(s, picked, "CPU=1");
+        if (rsched_try_acquire_on(s, n, "CPU=2") == 1) {
+          rsched_release_on(s, n, "CPU=2");
+        }
+        int64_t pg = rsched_pg_create(s, "CPU=1|CPU=1", t % 2);
+        if (pg >= 0 && i % 4 == 0) {
+          int64_t moved[8];
+          rsched_pg_reschedule_lost(s, moved, 8);
+        }
+        if (pg >= 0) rsched_pg_remove(s, pg);
+        rsched_utilization(s);
+        if (i % 2 == 0) rsched_remove_node(s, n);
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+  rsched_destroy(s);
+  std::puts("sched ok");
+}
+
+void stress_refcount() {
+  void* c = rrc_create();
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back([c, t] {
+      char buf[4096];
+      for (int i = 0; i < kIters; ++i) {
+        std::string oid = "o" + std::to_string(t) + "_" +
+                          std::to_string(i % 32);
+        std::string shared = "shared" + std::to_string(i % 8);
+        rrc_add_owned(c, oid.c_str());
+        rrc_add_local(c, oid.c_str());
+        rrc_add_borrower(c, shared.c_str(), "daemonA");
+        rrc_add_contained(c, oid.c_str(), shared.c_str());
+        rrc_remove_borrower(c, shared.c_str(), "daemonA", buf,
+                            sizeof(buf));
+        rrc_has(c, oid.c_str());
+        rrc_remove_local(c, oid.c_str(), buf, sizeof(buf));
+        if (i % 16 == 0) rrc_force_free(c, shared.c_str(), buf,
+                                        sizeof(buf));
+        rrc_last_freed(c, buf, sizeof(buf));
+        rrc_num_tracked(c);
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+  rrc_destroy(c);
+  std::puts("refcount ok");
+}
+
+void stress_pubsub() {
+  void* h = rpb_create();
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < 2; ++t) {  // publishers
+    ts.emplace_back([h, &stop] {
+      for (int i = 0; !stop.load() && i < kIters * 4; ++i) {
+        std::string key = "k" + std::to_string(i % 16);
+        rpb_publish(h, "obj_locations", key.c_str(), "payload");
+      }
+      stop.store(true);
+    });
+  }
+  for (int t = 0; t < kThreads; ++t) {  // subscriber churn + pollers
+    ts.emplace_back([h, t, &stop] {
+      std::string sub = "sub" + std::to_string(t);
+      char buf[1024];
+      int rounds = 0;
+      while (!stop.load() && rounds++ < kIters / 4) {
+        rpb_subscribe(h, sub.c_str(), "obj_locations",
+                      rounds % 2 ? "k1" : "");
+        rpb_poll(h, sub.c_str(), 1, buf, sizeof(buf));
+        if (rounds % 8 == 0) {
+          rpb_drop_subscriber(h, sub.c_str());
+        } else {
+          rpb_unsubscribe(h, sub.c_str(), "obj_locations",
+                          rounds % 2 ? "k1" : "");
+        }
+      }
+      rpb_drop_subscriber(h, sub.c_str());
+    });
+  }
+  for (auto& th : ts) th.join();
+  rpb_destroy(h);
+  std::puts("pubsub ok");
+}
+
+void stress_shm_store() {
+  std::string name = "/rtpu_stress_" + std::to_string(getpid());
+  void* s = shm_store_open(name.c_str(), 8 << 20, 1);
+  if (s == nullptr) {  // environments without /dev/shm: skip, not fail
+    std::puts("shm skipped");
+    return;
+  }
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back([s, t] {
+      uint8_t payload[512];
+      std::memset(payload, t, sizeof(payload));
+      for (int i = 0; i < kIters; ++i) {
+        // Keys deliberately COLLIDE across threads: create/seal/get/
+        // delete race on the same entries.
+        std::string id = "obj" + std::to_string(i % 16);
+        int64_t off = shm_store_create(s, id.c_str(), sizeof(payload));
+        if (off >= 0) {
+          shm_store_write(s, off, payload, sizeof(payload));
+          if (i % 32 == 0) {
+            shm_store_abort(s, id.c_str());
+          } else {
+            shm_store_seal(s, id.c_str());
+          }
+        }
+        uint64_t size = 0;
+        if (shm_store_get(s, id.c_str(), &size) >= 0) {
+          shm_store_release(s, id.c_str());
+        }
+        shm_store_used_bytes(s);
+        shm_store_num_objects(s);
+        if (i % 4 == 0) shm_store_delete(s, id.c_str());
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+  shm_store_unlink(s);
+  shm_store_close(s);
+  std::puts("shm ok");
+}
+
+void stress_config() {
+  void* c = rcfg_create("");
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back([c, t] {
+      char buf[8192];
+      for (int i = 0; i < kIters; ++i) {
+        rcfg_set(c, "health_check_period_ms",
+                 std::to_string(100 + i % 100).c_str());
+        rcfg_get_int(c, "health_check_period_ms");
+        if (i % 64 == 0) rcfg_dump(c, buf, sizeof(buf));
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+  rcfg_destroy(c);
+  std::puts("config ok");
+}
+
+void stress_memmon() {
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back([] {
+      char snap[512];
+      for (int i = 0; i < kIters / 4; ++i) {
+        rmm_snapshot(snap, sizeof(snap));
+        rmm_usage_fraction();
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+  std::puts("memmon ok");
+}
+
+}  // namespace
+
+int main() {
+  stress_sched();
+  stress_refcount();
+  stress_pubsub();
+  stress_shm_store();
+  stress_config();
+  stress_memmon();
+  std::puts("ALL STRESS OK");
+  return 0;
+}

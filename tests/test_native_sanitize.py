@@ -1,0 +1,55 @@
+"""Sanitizer stress harness for the native (C++) runtime components.
+
+Analog of the reference's --config=tsan / --config=asan CI runs
+(.bazelrc:92-116): src/ray_tpu_native/stress.cc hammers every
+component's C ABI from concurrent threads under ThreadSanitizer and
+AddressSanitizer; any data race / lock inversion / heap error fails the
+binary (halt_on_error) and therefore the test."""
+
+import os
+import subprocess
+
+import pytest
+
+from ray_tpu._private.native_build import build_stress_binary
+
+
+def _run(binary: str, env_extra: dict) -> subprocess.CompletedProcess:
+    env = dict(os.environ, **env_extra)
+    return subprocess.run([binary], capture_output=True, text=True,
+                          timeout=600, env=env)
+
+
+@pytest.mark.slow
+def test_tsan_stress_clean():
+    binary = build_stress_binary("thread")
+    if binary is None:
+        pytest.skip("g++ or TSAN runtime unavailable")
+    proc = _run(binary, {"TSAN_OPTIONS": "halt_on_error=1 exitcode=66"})
+    assert proc.returncode == 0, \
+        f"TSAN reported races:\n{proc.stdout}\n{proc.stderr[-4000:]}"
+    assert "ALL STRESS OK" in proc.stdout
+    assert "WARNING: ThreadSanitizer" not in proc.stderr
+
+
+@pytest.mark.slow
+def test_asan_stress_clean():
+    binary = build_stress_binary("address")
+    if binary is None:
+        pytest.skip("g++ or ASAN runtime unavailable")
+    proc = _run(binary, {"ASAN_OPTIONS": "detect_leaks=1"})
+    assert proc.returncode == 0, \
+        f"ASAN reported errors:\n{proc.stdout}\n{proc.stderr[-4000:]}"
+    assert "ALL STRESS OK" in proc.stdout
+    assert "ERROR: AddressSanitizer" not in proc.stderr
+    assert "LeakSanitizer" not in proc.stderr
+
+
+def test_stress_binary_caching():
+    """Same sources -> same artifact path (hash-keyed like the .so
+    builds); missing sanitizer support degrades to skip, not failure."""
+    a = build_stress_binary("thread")
+    if a is None:
+        pytest.skip("g++ unavailable")
+    assert build_stress_binary("thread") == a
+    assert os.path.basename(a).startswith("stress-thread-")
