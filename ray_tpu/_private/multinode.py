@@ -895,7 +895,7 @@ class NodeDaemon:
             return False
         return self._use_worker_processes or bool(
             renv.get("worker_process") or renv.get("pip")
-            or renv.get("venv"))
+            or renv.get("venv") or renv.get("conda"))
 
     def _resolve_markers_for_worker(self, args, kwargs):
         """Like _resolve_markers, but arena-resident payloads stay as

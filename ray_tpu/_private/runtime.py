@@ -1973,7 +1973,8 @@ class Runtime:
             return False
         if spec.resources.get("TPU", 0) > 0:
             return False
-        return bool(renv.get("worker_process") or renv.get("pip"))
+        return bool(renv.get("worker_process") or renv.get("pip")
+                    or renv.get("conda"))
 
     def _worker_exec_msg(self, spec: TaskSpec, args, kwargs, handle,
                          mode: str = "task", method: Optional[str] = None

@@ -26,7 +26,7 @@ from typing import Any, Dict, Optional
 from ray_tpu.exceptions import RuntimeEnvSetupError
 
 _KNOWN_FIELDS = {"env_vars", "working_dir", "py_modules", "pip", "conda",
-                 "config", "excludes", "worker_process"}
+                 "container", "config", "excludes", "worker_process"}
 
 _path_cache: set = set()
 _env_lock = threading.RLock()
@@ -50,6 +50,28 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
     if wd is not None and not os.path.isdir(wd):
         raise ValueError(
             f"runtime_env['working_dir'] {wd!r} is not a directory")
+    if runtime_env.get("pip") and runtime_env.get("conda"):
+        # Same exclusion as the reference's validation (a conda env
+        # already pins its own pip set; two interpreter-selecting
+        # plugins cannot both win).
+        raise ValueError(
+            "runtime_env cannot specify both 'pip' and 'conda'; put "
+            "pip packages inside the conda spec's dependencies "
+            "(- pip: [...]) instead")
+    conda_spec = runtime_env.get("conda")
+    if conda_spec is not None and not isinstance(conda_spec, (str, dict)):
+        raise ValueError(
+            "runtime_env['conda'] must be an env name (str) or an "
+            "environment.yml-style dict")
+    if runtime_env.get("container"):
+        # Declared parity gap, loudly: the reference's container plugin
+        # (_private/runtime_env/container.py) wraps workers in podman;
+        # this runtime has no container engine in its images.
+        raise ValueError(
+            "runtime_env['container'] is not supported: worker "
+            "processes run directly on the node (no container engine "
+            "in the TPU images). Use 'conda' or 'pip' for dependency "
+            "isolation.")
     return dict(runtime_env)
 
 
@@ -67,6 +89,17 @@ def setup(runtime_env: Dict[str, Any]) -> None:
         if parent not in _path_cache:
             sys.path.insert(0, parent)
             _path_cache.add(parent)
+    conda_spec = runtime_env.get("conda")
+    if conda_spec:
+        from ray_tpu._private.runtime_env_conda import (
+            interpreter_matches)
+        if not interpreter_matches(conda_spec):
+            raise RuntimeEnvSetupError(
+                f"runtime_env['conda'] = {conda_spec!r} requires a "
+                "worker running under that environment's interpreter; "
+                "this process is "
+                f"{sys.executable}. Enable worker processes (the "
+                "default) so the pool can lease a conda interpreter.")
     for pkg in runtime_env.get("pip") or []:
         # Shared resolver (runtime_env_pip.base_satisfies): version
         # specifiers included, dist-metadata fallback for module!=dist

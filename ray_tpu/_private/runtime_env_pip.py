@@ -168,8 +168,15 @@ def _materialize(venv_dir: str, python: str, pip_list: List[str]) -> None:
 
 def python_for_env(runtime_env: Optional[dict]) -> Optional[str]:
     """The interpreter a worker for this env must run under, or None for
-    the base interpreter."""
-    pip_list = (runtime_env or {}).get("pip")
+    the base interpreter. Dispatches across the interpreter-selecting
+    plugins: conda (runtime_env_conda) and pip/venv (this module);
+    validate() rejects specs naming both."""
+    env = runtime_env or {}
+    conda_spec = env.get("conda")
+    if conda_spec:
+        from ray_tpu._private.runtime_env_conda import conda_python
+        return conda_python(conda_spec)
+    pip_list = env.get("pip")
     if not pip_list:
         return None
     return ensure_venv(list(pip_list))

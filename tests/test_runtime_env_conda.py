@@ -1,0 +1,149 @@
+"""conda runtime envs: named-env resolution, content-hashed env
+creation, and worker-interpreter dispatch (reference:
+_private/runtime_env/conda.py). The build image ships no conda, so a
+fake binary on PATH drives the plugin — recording invocations and
+materializing env dirs whose python is a symlink to the base
+interpreter."""
+
+import os
+import stat
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+from ray_tpu._private import runtime_env as renv
+from ray_tpu._private import runtime_env_conda as plugin
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plugin_state(monkeypatch):
+    """The plugin memoizes the conda base and materialized envs per
+    process; tests must not see each other's state."""
+    monkeypatch.setattr(plugin, "_base_cache", None)
+    monkeypatch.setattr(plugin, "_ready", {})
+    monkeypatch.setattr(plugin, "_key_locks", {})
+    yield
+
+
+@pytest.fixture
+def fake_conda(tmp_path, monkeypatch):
+    """A fake `conda` executable: `info --base` prints the tmp base;
+    `env create -n NAME -f FILE` records the call and creates
+    envs/NAME/bin/python as a symlink to the running interpreter."""
+    base = tmp_path / "conda_base"
+    (base / "envs").mkdir(parents=True)
+    # A real conda env ships its own package set (the reference requires
+    # ray installed inside it); emulate with a base-chained venv (the
+    # pip plugin's machinery) moved into the envs directory.
+    import shutil as _shutil
+
+    from ray_tpu._private.runtime_env_pip import ensure_venv
+    venv_py = ensure_venv([], cache_dir=str(tmp_path / "seed"))
+    _shutil.move(os.path.dirname(os.path.dirname(venv_py)),
+                 base / "envs" / "preexisting")
+    log = tmp_path / "calls.log"
+    exe = tmp_path / "conda"
+    exe.write_text(f"""#!{sys.executable}
+import os, shutil, sys
+base = {str(base)!r}
+with open({str(log)!r}, "a") as f:
+    f.write(" ".join(sys.argv[1:]) + "\\n")
+args = sys.argv[1:]
+if args[:2] == ["info", "--base"]:
+    print(base)
+elif args[:2] == ["env", "create"]:
+    name = args[args.index("-n") + 1]
+    spec = open(args[args.index("-f") + 1]).read()
+    d = os.path.join(base, "envs", name, "bin")
+    os.makedirs(d, exist_ok=True)
+    os.symlink(sys.executable, os.path.join(d, "python"))
+    with open(os.path.join(base, "envs", name, "environment.yml"),
+              "w") as f:
+        f.write(spec)
+else:
+    sys.exit(2)
+""")
+    exe.chmod(exe.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("CONDA_EXE", str(exe))
+    return {"base": base, "log": log}
+
+
+# -- validation ----------------------------------------------------------
+
+def test_validate_rejects_pip_plus_conda():
+    with pytest.raises(ValueError, match="both 'pip' and 'conda'"):
+        renv.validate({"pip": ["numpy"], "conda": "myenv"})
+
+
+def test_validate_rejects_container():
+    with pytest.raises(ValueError, match="container"):
+        renv.validate({"container": {"image": "img:latest"}})
+
+
+def test_validate_rejects_bad_conda_type():
+    with pytest.raises(ValueError, match="env name"):
+        renv.validate({"conda": 42})
+
+
+def test_missing_conda_binary_raises(monkeypatch):
+    monkeypatch.delenv("CONDA_EXE", raising=False)
+    monkeypatch.setenv("PATH", "/nonexistent")
+    with pytest.raises(exceptions.RuntimeEnvSetupError,
+                       match="conda binary"):
+        plugin.conda_python("anything")
+
+
+# -- resolution & creation ----------------------------------------------
+
+def test_named_env_resolves(fake_conda):
+    py = plugin.conda_python("preexisting")
+    assert py == str(
+        fake_conda["base"] / "envs" / "preexisting" / "bin" / "python")
+
+
+def test_named_env_missing_raises(fake_conda):
+    with pytest.raises(exceptions.RuntimeEnvSetupError,
+                       match="does not exist"):
+        plugin.conda_python("no-such-env")
+
+
+def test_dict_spec_creates_once_and_caches(fake_conda):
+    spec = {"channels": ["conda-forge"],
+            "dependencies": ["cowpy=1.0", {"pip": ["einops"]}]}
+    py1 = plugin.conda_python(spec)
+    py2 = plugin.conda_python(spec)
+    assert py1 == py2 and os.path.exists(py1)
+    creates = [line for line in
+               fake_conda["log"].read_text().splitlines()
+               if line.startswith("env create")]
+    assert len(creates) == 1  # URI cache: one materialization
+    name = f"ray_tpu_{plugin.spec_key(spec)}"
+    assert f"/envs/{name}/" in py1
+    # The environment.yml the fake recorded round-trips the spec.
+    yml = (fake_conda["base"] / "envs" / name /
+           "environment.yml").read_text()
+    assert "conda-forge" in yml and "cowpy=1.0" in yml
+    assert "- pip:" in yml and "einops" in yml
+
+
+def test_interpreter_matches():
+    assert not plugin.interpreter_matches("someenv")
+    fake = f"/opt/conda/envs/someenv/bin/python"
+    import unittest.mock as mock
+    with mock.patch.object(sys, "executable", fake):
+        assert plugin.interpreter_matches("someenv")
+        assert not plugin.interpreter_matches("otherenv")
+
+
+# -- end to end: worker process under the conda interpreter --------------
+
+def test_task_runs_under_conda_interpreter(fake_conda,
+                                           ray_start_regular):
+    @ray_tpu.remote(runtime_env={"conda": "preexisting"})
+    def which_python():
+        return sys.executable
+
+    exe = ray_tpu.get(which_python.remote())
+    assert "/envs/preexisting/" in exe
