@@ -1,8 +1,32 @@
 from ray_tpu.autoscaler.autoscaler import LoadMetrics, StandardAutoscaler
+from ray_tpu.autoscaler.gcp import GCloudTPUNodeProvider
 from ray_tpu.autoscaler.node_provider import (DaemonProcessNodeProvider,
                                               FakeMultiNodeProvider,
                                               NodeProvider,
                                               TPUPodNodeProvider)
+
+#: Provider registry (reference: autoscaler/_private/providers.py
+#: _get_node_provider): cluster-config "provider.type" -> class.
+PROVIDER_TYPES = {
+    "fake_multinode": FakeMultiNodeProvider,
+    "tpu_pod": TPUPodNodeProvider,
+    "daemon_process": DaemonProcessNodeProvider,
+    "gcp_tpu": GCloudTPUNodeProvider,
+}
+
+
+def get_node_provider(provider_config: dict,
+                      cluster_name: str) -> NodeProvider:
+    """Instantiate the provider named by provider_config['type']."""
+    ptype = (provider_config or {}).get("type", "fake_multinode")
+    try:
+        cls = PROVIDER_TYPES[ptype]
+    except KeyError:
+        raise ValueError(
+            f"Unknown provider type {ptype!r}; available: "
+            f"{sorted(PROVIDER_TYPES)}") from None
+    return cls(provider_config, cluster_name)
+
 
 __all__ = [
     "StandardAutoscaler",
@@ -11,4 +35,7 @@ __all__ = [
     "DaemonProcessNodeProvider",
     "FakeMultiNodeProvider",
     "TPUPodNodeProvider",
+    "GCloudTPUNodeProvider",
+    "PROVIDER_TYPES",
+    "get_node_provider",
 ]

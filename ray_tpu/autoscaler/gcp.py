@@ -1,0 +1,234 @@
+"""GCP TPU-VM node provider: real provisioning through gcloud.
+
+Analog of the reference's cloud providers (`autoscaler/_private/gcp/
+node_provider.py` behind the `NodeProvider` plug-in seam,
+`autoscaler/node_provider.py:13`) specialized to TPU VMs: one provider
+node is one `gcloud compute tpus tpu-vm` instance (a single-host slice,
+or one host of a pod slice when ``accelerator_type`` names a multi-host
+topology — gcloud addresses the whole slice as one resource, matching
+the slice-is-atomic stance of TPUPodNodeProvider).
+
+Everything goes through the ``gcloud`` CLI — no SDK dependency — via a
+command-runner seam (``_gcloud``) the tests replace with a fake binary,
+the same way autoscaler tests fake the cloud in the reference
+(autoscaler/_private/fake_multi_node). gcloud itself is the source of
+truth: ``non_terminated_nodes`` lists live instances by cluster label,
+so externally-deleted TPUs disappear from the autoscaler's view without
+local bookkeeping.
+
+After creation, each TPU VM is bootstrapped into the cluster with
+``gcloud compute tpus tpu-vm ssh --command "ray-tpu start --address
+<head>"`` — the provisioning analog of the reference's
+``command_runner.py`` + `updater.py` SSH bootstrap.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import shutil
+import subprocess
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import (NodeProvider,
+                                              STATUS_UP_TO_DATE,
+                                              TAG_RAY_NODE_STATUS)
+
+logger = logging.getLogger(__name__)
+
+#: gcloud label keys (lowercase, [a-z0-9_-] only — GCP's constraint).
+LABEL_CLUSTER = "ray-tpu-cluster"
+LABEL_PREFIX = "ray-tpu-tag-"
+
+
+def _to_label_key(tag: str) -> str:
+    return LABEL_PREFIX + tag.lower().replace("_", "-")
+
+
+def _from_label_key(key: str) -> Optional[str]:
+    if not key.startswith(LABEL_PREFIX):
+        return None
+    return key[len(LABEL_PREFIX):]
+
+
+class GCloudTPUNodeProvider(NodeProvider):
+    """Provisions TPU VMs with gcloud. provider_config keys:
+
+    * ``project`` / ``zone`` — required GCP location.
+    * ``accelerator_type`` — e.g. ``v5litepod-8`` (default ``v4-8``).
+    * ``runtime_version`` — TPU software version (default
+      ``tpu-ubuntu2204-base``).
+    * ``head_address`` — ``host:port`` the booted node's daemon joins;
+      omit to skip the bootstrap ssh (e.g. when an init script in the
+      image handles it).
+    * ``gcloud_binary`` — override for tests (default: ``gcloud`` on
+      PATH).
+    * ``num_cpus`` / ``num_tpus`` — resources `ray-tpu start` advertises
+      per host (defaults 8 CPUs; chips inferred from accelerator_type's
+      trailing count).
+    """
+
+    def __init__(self, provider_config: Dict[str, Any],
+                 cluster_name: str):
+        super().__init__(provider_config, cluster_name)
+        for req in ("project", "zone"):
+            if not self.provider_config.get(req):
+                raise ValueError(
+                    f"GCloudTPUNodeProvider requires provider_config"
+                    f"[{req!r}]")
+        self._binary = self.provider_config.get("gcloud_binary") or \
+            shutil.which("gcloud")
+        if not self._binary:
+            raise RuntimeError(
+                "GCloudTPUNodeProvider requires the gcloud CLI on PATH "
+                "(or provider_config['gcloud_binary']). Install the "
+                "Google Cloud SDK on the head node.")
+        self._lock = threading.Lock()
+        self._counter = 0
+
+    # -- command runner seam --------------------------------------------
+
+    def _gcloud(self, *args: str, parse_json: bool = False,
+                check: bool = True) -> Any:
+        cmd = [self._binary, "compute", "tpus", "tpu-vm", *args,
+               "--project", self.provider_config["project"],
+               "--zone", self.provider_config["zone"]]
+        if parse_json:
+            cmd += ["--format", "json"]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=self.provider_config.get(
+                                  "gcloud_timeout_s", 600))
+        if check and proc.returncode != 0:
+            raise RuntimeError(
+                f"gcloud {' '.join(args[:2])} failed "
+                f"(exit {proc.returncode}): {proc.stderr[-1500:]}")
+        if parse_json:
+            return json.loads(proc.stdout or "null")
+        return proc
+
+    # -- provider interface ---------------------------------------------
+
+    def _list(self) -> List[dict]:
+        nodes = self._gcloud("list", parse_json=True) or []
+        out = []
+        for n in nodes:
+            labels = n.get("labels") or {}
+            if labels.get(LABEL_CLUSTER) == self.cluster_name:
+                out.append(n)
+        return out
+
+    @staticmethod
+    def _short_name(node: dict) -> str:
+        # gcloud reports fully-qualified names
+        # (projects/p/locations/z/nodes/NAME); the short name is the id
+        # every other gcloud verb accepts.
+        return node.get("name", "").rsplit("/", 1)[-1]
+
+    def non_terminated_nodes(self, tag_filters: Dict[str, str]
+                             ) -> List[str]:
+        out = []
+        for n in self._list():
+            if n.get("state") in ("DELETING", "TERMINATED"):
+                continue
+            tags = self._tags_of(n)
+            if all(tags.get(k) == v for k, v in tag_filters.items()):
+                out.append(self._short_name(n))
+        return out
+
+    def _describe(self, node_id: str) -> Optional[dict]:
+        proc = self._gcloud("describe", node_id, parse_json=True,
+                            check=False)
+        return proc if isinstance(proc, dict) else None
+
+    def is_running(self, node_id: str) -> bool:
+        node = self._describe(node_id)
+        return bool(node) and node.get("state") == "READY"
+
+    @staticmethod
+    def _tags_of(node: dict) -> Dict[str, str]:
+        tags = {}
+        for k, v in (node.get("labels") or {}).items():
+            tag = _from_label_key(k)
+            if tag is not None:
+                tags[tag] = v
+        return tags
+
+    def node_tags(self, node_id: str) -> Dict[str, str]:
+        node = self._describe(node_id)
+        return self._tags_of(node) if node else {}
+
+    def set_node_tags(self, node_id: str, tags: Dict[str, str]) -> None:
+        labels = ",".join(f"{_to_label_key(k)}={v}"
+                          for k, v in tags.items())
+        self._gcloud("update", node_id, "--update-labels", labels)
+
+    def create_node(self, node_config: Dict[str, Any],
+                    tags: Dict[str, str], count: int) -> None:
+        cfg = self.provider_config
+        acc = node_config.get("accelerator_type",
+                              cfg.get("accelerator_type", "v4-8"))
+        version = node_config.get("runtime_version",
+                                  cfg.get("runtime_version",
+                                          "tpu-ubuntu2204-base"))
+        labels = {LABEL_CLUSTER: self.cluster_name}
+        for k, v in dict(tags).items():
+            labels[_to_label_key(k)] = v
+        labels.setdefault(_to_label_key(TAG_RAY_NODE_STATUS),
+                          STATUS_UP_TO_DATE)
+        label_arg = ",".join(f"{k}={v}" for k, v in labels.items())
+        for _ in range(count):
+            with self._lock:
+                self._counter += 1
+                name = (f"{self.cluster_name}-tpu-"
+                        f"{self._counter:04d}")
+            self._gcloud("create", name,
+                         "--accelerator-type", acc,
+                         "--version", version,
+                         "--labels", label_arg)
+            self._bootstrap(name, acc)
+
+    def _bootstrap(self, name: str, acc: str) -> None:
+        """SSH the joined-cluster startup onto the fresh TPU VM (the
+        reference's updater.py role). ``--worker=all`` covers every host
+        of a multi-host slice."""
+        head = self.provider_config.get("head_address")
+        if not head:
+            return
+        chips = float(self.provider_config.get(
+            "num_tpus", acc.rsplit("-", 1)[-1]))
+        cpus = float(self.provider_config.get("num_cpus", 8))
+        labels = json.dumps({"provider_node_id": name})
+        start = (f"ray-tpu start --address {head} "
+                 f"--num-cpus {cpus} --num-tpus {chips} "
+                 f"--labels {labels!r}")
+        self._gcloud("ssh", name, "--worker=all", "--command", start)
+
+    def terminate_node(self, node_id: str) -> None:
+        self._gcloud("delete", node_id, "--quiet", check=False)
+
+    def internal_ip(self, node_id: str) -> str:
+        node = self._describe(node_id) or {}
+        eps = node.get("networkEndpoints") or []
+        return eps[0].get("ipAddress", "") if eps else ""
+
+    def external_ip(self, node_id: str) -> str:
+        node = self._describe(node_id) or {}
+        eps = node.get("networkEndpoints") or []
+        if eps:
+            access = eps[0].get("accessConfig") or {}
+            return access.get("externalIp", "") or \
+                eps[0].get("ipAddress", "")
+        return ""
+
+    def runtime_node_hex(self, node_id: str) -> Optional[str]:
+        """gcloud names are not runtime NodeIDs; the daemon self-labels
+        with provider_node_id like DaemonProcessNodeProvider would — a
+        disconnected driver reads as unknown."""
+        from ray_tpu._private.worker import global_worker
+        if not global_worker.connected:
+            return None
+        for node in global_worker.runtime.scheduler.nodes_snapshot():
+            if node["Labels"].get("provider_node_id") == node_id:
+                return node["NodeID"]
+        return None

@@ -160,7 +160,8 @@ def cmd_start(args) -> int:
     run_node(args.address, num_cpus=args.num_cpus,
              num_tpus=args.num_tpus, memory=args.memory,
              resources=json.loads(args.resources) if args.resources
-             else None)
+             else None,
+             labels=json.loads(args.labels) if args.labels else None)
     return 0
 
 
@@ -254,6 +255,9 @@ def main(argv=None) -> int:
     p.add_argument("--memory", type=float, default=float(1 << 30))
     p.add_argument("--resources", default=None,
                    help="extra resources as JSON")
+    p.add_argument("--labels", default=None,
+                   help="node labels as JSON (cloud providers tag their "
+                        "nodes here, e.g. provider_node_id)")
 
     p = sub.add_parser("dashboard", help="run the HTTP dashboard")
     p.add_argument("--host", default="127.0.0.1")

@@ -1,0 +1,180 @@
+"""GCloudTPUNodeProvider: real provisioning flow against a fake gcloud
+binary (reference: autoscaler/_private/gcp behind node_provider.py:13,
+faked the way fake_multi_node fakes the cloud)."""
+
+import json
+import os
+import stat
+import sys
+
+import pytest
+
+from ray_tpu.autoscaler.gcp import (GCloudTPUNodeProvider, LABEL_CLUSTER,
+                                    _from_label_key, _to_label_key)
+
+FAKE_GCLOUD = """#!{python}
+import json, os, sys
+state_path = os.environ["FAKE_GCLOUD_STATE"]
+
+
+def load():
+    try:
+        with open(state_path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return {{"nodes": {{}}, "calls": []}}
+
+
+def save(st):
+    with open(state_path, "w") as f:
+        json.dump(st, f)
+
+
+st = load()
+args = sys.argv[1:]
+st["calls"].append(args)
+assert args[:3] == ["compute", "tpus", "tpu-vm"], args
+verb = args[3]
+rest = args[4:]
+as_json = "--format" in rest
+
+
+def opt(name):
+    return rest[rest.index(name) + 1] if name in rest else None
+
+
+assert opt("--project") == "proj-1" and opt("--zone") == "us-central2-b"
+if verb == "create":
+    name = rest[0]
+    labels = dict(kv.split("=", 1)
+                  for kv in opt("--labels").split(","))
+    st["nodes"][name] = {{
+        "name": "projects/proj-1/locations/us-central2-b/nodes/" + name,
+        "state": "READY",
+        "labels": labels,
+        "acceleratorType": opt("--accelerator-type"),
+        "networkEndpoints": [{{"ipAddress": "10.0.0." +
+                               str(len(st["nodes"]) + 2),
+                               "accessConfig":
+                               {{"externalIp": "34.1.2.3"}}}}],
+    }}
+elif verb == "list":
+    print(json.dumps(list(st["nodes"].values())))
+elif verb == "describe":
+    node = st["nodes"].get(rest[0])
+    if node is None:
+        save(st)
+        sys.exit(1)
+    print(json.dumps(node))
+elif verb == "update":
+    node = st["nodes"][rest[0]]
+    for kv in opt("--update-labels").split(","):
+        k, v = kv.split("=", 1)
+        node["labels"][k] = v
+elif verb == "delete":
+    st["nodes"].pop(rest[0], None)
+elif verb == "ssh":
+    pass  # bootstrap command recorded via st["calls"]
+else:
+    save(st)
+    sys.exit(2)
+save(st)
+"""
+
+
+@pytest.fixture
+def provider(tmp_path, monkeypatch):
+    exe = tmp_path / "gcloud"
+    exe.write_text(FAKE_GCLOUD.format(python=sys.executable))
+    exe.chmod(exe.stat().st_mode | stat.S_IEXEC)
+    state = tmp_path / "state.json"
+    monkeypatch.setenv("FAKE_GCLOUD_STATE", str(state))
+    prov = GCloudTPUNodeProvider(
+        {"project": "proj-1", "zone": "us-central2-b",
+         "accelerator_type": "v5litepod-8",
+         "head_address": "10.0.0.1:6380",
+         "gcloud_binary": str(exe)},
+        cluster_name="c1")
+    prov._state_path = state  # test-only peek
+
+    def calls():
+        return json.load(open(state))["calls"]
+    prov._calls = calls
+    return prov
+
+
+def test_requires_project_zone_and_binary(tmp_path, monkeypatch):
+    with pytest.raises(ValueError, match="project"):
+        GCloudTPUNodeProvider({"zone": "z"}, "c")
+    monkeypatch.setenv("PATH", "/nonexistent")
+    with pytest.raises(RuntimeError, match="gcloud CLI"):
+        GCloudTPUNodeProvider({"project": "p", "zone": "z"}, "c")
+
+
+def test_label_key_roundtrip():
+    assert _from_label_key(_to_label_key("ray-node-status")) == \
+        "ray-node-status"
+    assert _from_label_key("unrelated") is None
+
+
+def test_create_list_describe_terminate(provider):
+    provider.create_node({}, {"ray-node-kind": "worker"}, count=2)
+    nodes = provider.non_terminated_nodes({})
+    assert len(nodes) == 2
+    assert all(n.startswith("c1-tpu-") for n in nodes)
+    # Tag filters work over the label mapping.
+    assert provider.non_terminated_nodes(
+        {"ray-node-kind": "worker"}) == nodes
+    assert provider.non_terminated_nodes(
+        {"ray-node-kind": "head"}) == []
+    assert provider.is_running(nodes[0])
+    assert provider.internal_ip(nodes[0]).startswith("10.0.0.")
+    assert provider.external_ip(nodes[0]) == "34.1.2.3"
+    provider.terminate_node(nodes[0])
+    assert provider.non_terminated_nodes({}) == [nodes[1]]
+    assert not provider.is_running(nodes[0])
+
+
+def test_create_passes_topology_and_bootstraps(provider):
+    provider.create_node({}, {}, count=1)
+    calls = provider._calls()
+    create = next(c for c in calls if c[3] == "create")
+    assert create[create.index("--accelerator-type") + 1] == \
+        "v5litepod-8"
+    ssh = next(c for c in calls if c[3] == "ssh")
+    cmd = ssh[ssh.index("--command") + 1]
+    assert "--worker=all" in ssh
+    assert "ray-tpu start --address 10.0.0.1:6380" in cmd
+    # Chips inferred from the topology's trailing count; the node
+    # self-labels with its provider id for runtime_node_hex matching.
+    assert "--num-tpus 8.0" in cmd
+    assert "provider_node_id" in cmd
+
+
+def test_set_and_get_node_tags(provider):
+    provider.create_node({}, {"a": "1"}, count=1)
+    (node,) = provider.non_terminated_nodes({})
+    assert provider.node_tags(node)["a"] == "1"
+    provider.set_node_tags(node, {"ray-node-status": "syncing"})
+    tags = provider.node_tags(node)
+    assert tags["ray-node-status"] == "syncing" and tags["a"] == "1"
+
+
+def test_other_clusters_invisible(provider, tmp_path):
+    provider.create_node({}, {}, count=1)
+    # A node from another cluster shows in gcloud list but not here.
+    state = json.load(open(os.environ["FAKE_GCLOUD_STATE"]))
+    state["nodes"]["other"] = {
+        "name": "projects/proj-1/locations/us-central2-b/nodes/other",
+        "state": "READY", "labels": {LABEL_CLUSTER: "c2"}}
+    json.dump(state, open(os.environ["FAKE_GCLOUD_STATE"], "w"))
+    assert provider.non_terminated_nodes({}) == \
+        provider.non_terminated_nodes({})
+    assert "other" not in provider.non_terminated_nodes({})
+
+
+def test_provider_registry():
+    from ray_tpu.autoscaler import PROVIDER_TYPES, get_node_provider
+    assert PROVIDER_TYPES["gcp_tpu"] is GCloudTPUNodeProvider
+    with pytest.raises(ValueError, match="Unknown provider type"):
+        get_node_provider({"type": "aws"}, "c")
