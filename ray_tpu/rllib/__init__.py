@@ -19,6 +19,8 @@ from ray_tpu.rllib.algorithms.a3c import A3C, A3CConfig
 from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.algorithms.apex_ddpg import (ApexDDPG,
                                                 ApexDDPGConfig)
+from ray_tpu.rllib.algorithms.alpha_zero import (AlphaZero,
+                                                 AlphaZeroConfig)
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.ars import ARS, ARSConfig
 from ray_tpu.rllib.algorithms.bandit import (BanditConfig, BanditLinTS,
@@ -29,7 +31,9 @@ from ray_tpu.rllib.algorithms.bc import BC, BCConfig
 from ray_tpu.rllib.algorithms.algorithm import Algorithm
 from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig
 from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig
+from ray_tpu.rllib.algorithms.ddppo import DDPPO, DDPPOConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.dt import DT, DTConfig
 from ray_tpu.rllib.algorithms.es import ES, ESConfig
@@ -67,6 +71,8 @@ __all__ = ["A2C", "A2CConfig", "A3C", "A3CConfig", "APPO", "APPOConfig",
            "BanditLinUCB", "BanditLinUCBConfig",
            "ApexDQN", "ApexDQNConfig", "ApexDDPG", "ApexDDPGConfig",
            "RandomAgent", "RandomAgentConfig",
+           "AlphaZero", "AlphaZeroConfig", "CRR", "CRRConfig",
+           "DDPPO", "DDPPOConfig",
            "ARS", "ARSConfig", "Algorithm", "AlgorithmConfig", "BC",
            "BCConfig", "CQL", "CQLConfig", "DDPG", "DDPGConfig", "DQN",
            "DQNConfig", "DT", "DTConfig", "ES", "ESConfig", "Impala", "ImpalaConfig",

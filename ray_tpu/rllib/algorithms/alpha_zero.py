@@ -1,0 +1,381 @@
+"""AlphaZero: one-player MCTS planning + learned policy/value priors.
+
+Analog of the reference's rllib/algorithms/alpha_zero (Silver et al.
+2017 adapted to single-player envs, with the "ranked rewards" (R2)
+strategy of Laterre et al. 2018): each move runs a PUCT tree search
+over CLONABLE env states (``get_state``/``set_state`` — the env is the
+simulator), guided by a policy/value network; visit counts become the
+policy training target, and the value head regresses the R2 binary
+reward (+1 when the episode return beats the rolling percentile of
+recent returns, -1 otherwise) — the single-player stand-in for
+two-player self-play win/loss that also normalizes rewards.
+
+Env contract (reference README): Discrete actions; observations either
+a plain vector or a dict ``{"obs": vec, "action_mask": 0/1 vec}``;
+``get_state() -> opaque`` and ``set_state(s)`` restore mid-episode.
+env/examples.py ClonableCartPole adapts CartPole (the reference's own
+example task). Exploration: Dirichlet noise on the root priors +
+sampling from visit counts; evaluation uses noiseless argmax.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+
+
+class AlphaZeroConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or AlphaZero)
+        self.lr = 5e-4
+        self.train_batch_size = 128
+        self.num_train_batches_per_iteration = 16
+        self.replay_buffer_capacity = 20_000
+        #: MCTS knobs (reference: alpha_zero.py mcts_config defaults).
+        self.num_simulations = 30
+        self.c_puct = 1.25
+        self.dirichlet_alpha = 0.3
+        self.dirichlet_epsilon = 0.25
+        self.temperature = 1.0
+        #: R2 ranked-rewards knobs.
+        self.ranked_rewards_percentile = 75
+        self.ranked_rewards_buffer = 100
+        self.episodes_per_iteration = 4
+        self.max_episode_steps = 200
+
+    def training(self, *, num_simulations=None, c_puct=None,
+                 dirichlet_alpha=None, dirichlet_epsilon=None,
+                 temperature=None, ranked_rewards_percentile=None,
+                 ranked_rewards_buffer=None, episodes_per_iteration=None,
+                 max_episode_steps=None, replay_buffer_capacity=None,
+                 num_train_batches_per_iteration=None,
+                 **kwargs) -> "AlphaZeroConfig":
+        super().training(**kwargs)
+        for name, val in (
+                ("num_simulations", num_simulations),
+                ("c_puct", c_puct),
+                ("dirichlet_alpha", dirichlet_alpha),
+                ("dirichlet_epsilon", dirichlet_epsilon),
+                ("temperature", temperature),
+                ("ranked_rewards_percentile", ranked_rewards_percentile),
+                ("ranked_rewards_buffer", ranked_rewards_buffer),
+                ("episodes_per_iteration", episodes_per_iteration),
+                ("max_episode_steps", max_episode_steps),
+                ("replay_buffer_capacity", replay_buffer_capacity),
+                ("num_train_batches_per_iteration",
+                 num_train_batches_per_iteration)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class _Node:
+    """One tree node: per-action visit/value/prior stats."""
+
+    __slots__ = ("n", "w", "p", "children", "legal")
+
+    def __init__(self, priors: np.ndarray, legal: np.ndarray):
+        a = len(priors)
+        self.n = np.zeros(a, np.float32)
+        self.w = np.zeros(a, np.float32)
+        self.p = priors
+        self.legal = legal
+        self.children: Dict[int, "_Node"] = {}
+
+    def q(self) -> np.ndarray:
+        return self.w / np.maximum(self.n, 1.0)
+
+
+def _split_obs(obs) -> tuple:
+    if isinstance(obs, dict):
+        return (np.asarray(obs["obs"], np.float32).reshape(-1),
+                np.asarray(obs["action_mask"], np.float32))
+    return np.asarray(obs, np.float32).reshape(-1), None
+
+
+class AlphaZero(Algorithm):
+    _default_config_class = AlphaZeroConfig
+    _own_rollout_actors = True
+
+    def setup(self, config: AlphaZeroConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models.catalog import mlp_apply, mlp_init
+
+        env = self._env_creator(config.env_config)
+        for attr in ("get_state", "set_state"):
+            if not hasattr(env, attr):
+                raise ValueError(
+                    "AlphaZero needs a clonable env exposing get_state/"
+                    "set_state (the env IS the MCTS simulator; see "
+                    "env/examples.py ClonableCartPole)")
+        self._env = env
+        obs0, _ = env.reset(seed=config.seed)
+        vec, mask = _split_obs(obs0)
+        self.obs_dim = len(vec)
+        self.n_actions = int(env.action_space.n)
+        hiddens = list(config.fcnet_hiddens)
+        key = jax.random.PRNGKey(config.seed)
+        kt, kp, kv = jax.random.split(key, 3)
+        self.params = {
+            "torso": mlp_init(kt, [self.obs_dim, *hiddens]),
+            "pi": mlp_init(kp, [hiddens[-1], self.n_actions]),
+            "v": mlp_init(kv, [hiddens[-1], 1]),
+        }
+        self._optimizer = optax.adam(config.lr)
+        self._opt_state = self._optimizer.init(self.params)
+
+        def priors_and_value(params, obs):
+            h = mlp_apply(params["torso"], obs, activate_last=True)
+            logits = mlp_apply(params["pi"], h)
+            v = jnp.tanh(mlp_apply(params["v"], h)[..., 0])
+            return logits, v
+
+        def loss_fn(params, mb):
+            logits, v = priors_and_value(params, mb["obs"])
+            # Illegal actions are masked out of the CE support.
+            logits = jnp.where(mb["mask"] > 0, logits, -1e9)
+            logp = jax.nn.log_softmax(logits, -1)
+            pi_loss = -(mb["tree_policy"] * logp).sum(-1).mean()
+            v_loss = ((v - mb["z"]) ** 2).mean()
+            return pi_loss + v_loss, (pi_loss, v_loss)
+
+        def update(params, opt_state, mb):
+            (_, (pl, vl)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            updates, opt_state = self._optimizer.update(
+                grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, pl, vl
+
+        self._pv_jit = jax.jit(priors_and_value)
+        self._update_jit = jax.jit(update)
+        self._rng = np.random.default_rng(config.seed)
+        self._buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                    seed=config.seed)
+        #: rolling episode returns for the R2 threshold.
+        self._returns_window: List[float] = []
+        self._episode_rewards: List[float] = []
+
+    # -- network wrapper -------------------------------------------------
+
+    def _evaluate(self, vec: np.ndarray, mask: Optional[np.ndarray]):
+        import jax.numpy as jnp
+        logits, v = self._pv_jit(self.params, jnp.asarray(vec[None]))
+        logits = np.asarray(logits[0], np.float64)
+        if mask is not None:
+            logits = np.where(mask > 0, logits, -1e9)
+        e = np.exp(logits - logits.max())
+        return e / e.sum(), float(v[0])
+
+    # -- MCTS ------------------------------------------------------------
+
+    def _expand(self, obs) -> _Node:
+        vec, mask = _split_obs(obs)
+        priors, value = self._evaluate(vec, mask)
+        legal = mask if mask is not None else \
+            np.ones(self.n_actions, np.float32)
+        return _Node(priors.astype(np.float32), legal), value
+
+    def _simulate(self, root: _Node, config: AlphaZeroConfig) -> None:
+        """One PUCT descent from the current env state (restored
+        afterwards). Undiscounted, board-game style: the backed-up
+        value is the net's [-1, 1] estimate at the leaf, or — at a
+        terminal — the RANKED transform of the env's sparse episode
+        score, keeping tree values and value-head targets on one
+        scale (the reference wraps the env in ranked_rewards.py for
+        exactly this)."""
+        env = self._env
+        saved = env.get_state()
+        node = root
+        path: List[tuple] = []
+        value = 0.0
+        while True:
+            total_n = node.n.sum()
+            u = config.c_puct * node.p * \
+                math.sqrt(total_n + 1e-8) / (1.0 + node.n)
+            score = node.q() + u
+            score = np.where(node.legal > 0, score, -np.inf)
+            a = int(score.argmax())
+            path.append((node, a))
+            obs, r, term, trunc, _ = env.step(a)
+            if term or trunc:
+                value = self._rank(float(r))
+                break
+            child = node.children.get(a)
+            if child is None:
+                child, value = self._expand(obs)
+                node.children[a] = child
+                break
+            node = child
+        for node, a in path:
+            node.n[a] += 1.0
+            node.w[a] += value
+        env.set_state(saved)
+
+    def _tree_policy(self, root: _Node,
+                     config: AlphaZeroConfig) -> np.ndarray:
+        counts = root.n ** (1.0 / max(config.temperature, 1e-3))
+        total = counts.sum()
+        if total <= 0:
+            legal = root.legal / root.legal.sum()
+            return legal.astype(np.float32)
+        return (counts / total).astype(np.float32)
+
+    def _search(self, obs, explore: bool):
+        """One full MCTS from the CURRENT env state: returns
+        (tree_policy, legal_mask). Exploration adds Dirichlet noise to
+        the root priors (the single code path self-play and
+        compute_action share)."""
+        config: AlphaZeroConfig = self.config
+        root, _ = self._expand(obs)
+        if explore:
+            noise = self._rng.dirichlet(
+                np.full(self.n_actions, config.dirichlet_alpha))
+            root.p = ((1 - config.dirichlet_epsilon) * root.p +
+                      config.dirichlet_epsilon *
+                      noise.astype(np.float32))
+        for _ in range(config.num_simulations):
+            self._simulate(root, config)
+        return self._tree_policy(root, config), root.legal
+
+    def compute_action(self, obs, explore: bool = False) -> int:
+        """MCTS move from the CURRENT env state (must correspond to
+        ``obs``). Exploit mode: argmax visit counts, no noise."""
+        pi, legal = self._search(obs, explore)
+        if explore:
+            return int(self._rng.choice(self.n_actions, p=pi))
+        return int(np.where(legal > 0, pi, 0.0).argmax())
+
+    # -- self-play + training -------------------------------------------
+
+    def _rank(self, episode_score: float) -> float:
+        """R2 transform WITHOUT recording: +-1 against the rolling
+        percentile (simulated episodes must not pollute the window)."""
+        config: AlphaZeroConfig = self.config
+        window = self._returns_window
+        if not window:
+            return 1.0
+        threshold = np.percentile(
+            window, config.ranked_rewards_percentile)
+        if episode_score > threshold:
+            return 1.0
+        if episode_score < threshold:
+            return -1.0
+        return float(self._rng.choice([-1.0, 1.0]))
+
+    def _env_running_score(self) -> float:
+        """Accumulated-but-unpaid score of the current episode, for
+        budget-exhausted self-play (ClonableCartPole exposes it as
+        episode_score; envs without the hook contribute 0)."""
+        hook = getattr(self._env, "episode_score", None)
+        return float(hook()) if callable(hook) else 0.0
+
+    def _ranked_reward(self, episode_return: float) -> float:
+        """Rank AND record — for completed self-play episodes."""
+        config: AlphaZeroConfig = self.config
+        z = self._rank(episode_return)
+        self._returns_window.append(episode_return)
+        del self._returns_window[:-config.ranked_rewards_buffer]
+        return z
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        config: AlphaZeroConfig = self.config
+        for _ in range(config.episodes_per_iteration):
+            obs, _ = self._env.reset()
+            rows = []
+            episode_return = 0.0
+            terminated = False
+            for _ in range(config.max_episode_steps):
+                vec, _ = _split_obs(obs)
+                pi, legal = self._search(obs, explore=True)
+                a = int(self._rng.choice(self.n_actions, p=pi))
+                rows.append({"obs": vec, "tree_policy": pi,
+                             "mask": legal})
+                obs, r, term, trunc, _ = self._env.step(a)
+                episode_return += float(r)
+                self._timesteps_total += 1
+                if term or trunc:
+                    terminated = True
+                    break
+            if not terminated:
+                # Sparse-score envs pay only at termination; an episode
+                # that outlives the step budget is the BEST outcome and
+                # must rank as such, not as 0.
+                episode_return += float(self._env_running_score())
+            z = self._ranked_reward(episode_return)
+            self._episode_rewards.append(episode_return)
+            for row in rows:
+                row["z"] = np.asarray([z], np.float32)
+                self._buffer.add(SampleBatch(
+                    {k: np.asarray(v)[None] for k, v in row.items()}))
+
+        pi_losses, v_losses = [], []
+        if len(self._buffer) >= config.train_batch_size:
+            params = self.params
+            for _ in range(config.num_train_batches_per_iteration):
+                sampled = self._buffer.sample(config.train_batch_size)
+                mb = {k: jnp.asarray(v) for k, v in sampled.items()}
+                mb["z"] = mb["z"][:, 0]
+                params, self._opt_state, pl, vl = self._update_jit(
+                    params, self._opt_state, mb)
+                pi_losses.append(float(pl))
+                v_losses.append(float(vl))
+            self.params = params
+
+        window = self._episode_rewards[-100:]
+        return {
+            "policy_loss": float(np.mean(pi_losses)) if pi_losses
+            else float("nan"),
+            "value_loss": float(np.mean(v_losses)) if v_losses
+            else float("nan"),
+            "episode_reward_mean": (float(np.mean(window)) if window
+                                    else float("nan")),
+            "episodes_total": len(self._episode_rewards),
+        }
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Noiseless-argmax MCTS episodes (exploit mode) — overrides the
+        base evaluate, whose flat-vector JAXPolicy path fits neither the
+        dict observations nor the tree search."""
+        config: AlphaZeroConfig = self.config
+        episodes = getattr(config, "evaluation_num_episodes", 3) or 3
+        rewards = []
+        for _ in range(episodes):
+            obs, _ = self._env.reset()
+            total, terminated = 0.0, False
+            for _ in range(config.max_episode_steps):
+                a = self.compute_action(obs, explore=False)
+                obs, r, term, trunc, _ = self._env.step(a)
+                total += float(r)
+                if term or trunc:
+                    terminated = True
+                    break
+            if not terminated:
+                total += float(self._env_running_score())
+            rewards.append(total)
+        return {"episode_reward_mean": float(np.mean(rewards)),
+                "episodes_this_eval": len(rewards)}
+
+    def get_weights(self):
+        import jax
+        return {"az_params": jax.tree.map(np.asarray, self.params)}
+
+    def set_weights(self, weights) -> None:
+        import jax
+        import jax.numpy as jnp
+        self.params = jax.tree.map(jnp.asarray, weights["az_params"])
+
+    def stop(self) -> None:
+        close = getattr(self._env, "close", None)
+        if callable(close):
+            close()

@@ -1,0 +1,210 @@
+"""CRR: critic-regularized regression for offline RL.
+
+Analog of the reference's rllib/algorithms/crr (Wang et al. 2020,
+"Critic Regularized Regression"): behavior cloning where each logged
+action's log-likelihood is WEIGHTED by its advantage under a learned
+critic — the policy imitates only the parts of the dataset the critic
+thinks beat the current policy, which filters mixed-quality data
+without ever evaluating out-of-distribution actions (the failure mode
+plain offline actor-critic hits).
+
+Updates from a once-loaded JSON dataset (bc.py's offline contract):
+  * critic: TD toward ``r + gamma * E_{a'~pi}[Q_target(s', a')]``
+    (exact expectation for Discrete; policy samples for Box),
+  * actor: ``-f(A(s,a)) * log pi(a|s)`` with ``A = Q(s,a) -
+    E_{a~pi}Q(s,a)`` and ``f`` either ``binary`` (1[A>0], the paper's
+    best-performing "indicator" variant) or ``exp`` (exp(A/beta),
+    clipped — the reference's weight_type choices).
+
+The actor is the standard JAXPolicy (so Algorithm.evaluate works
+unchanged); the critic is owned here: Q(s, .) vector head for Discrete,
+Q(s, a) scalar head for Box.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class CRRConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or CRR)
+        self.lr = 3e-4
+        self.critic_lr = 3e-4
+        self.train_batch_size = 256
+        self.num_rollout_workers = 0   # offline: WorkerSet stays empty
+        self.num_train_batches_per_iteration = 64
+        self.tau = 0.005
+        self.weight_type = "binary"    # "binary" | "exp"
+        self.beta = 1.0                # exp temperature
+        self.weight_clip = 20.0
+        self.n_action_samples = 4      # E_{a~pi}Q estimator (Box only)
+
+    def training(self, *, critic_lr=None, tau=None, weight_type=None,
+                 beta=None, weight_clip=None, n_action_samples=None,
+                 num_train_batches_per_iteration=None,
+                 **kwargs) -> "CRRConfig":
+        super().training(**kwargs)
+        for name, val in (("critic_lr", critic_lr), ("tau", tau),
+                          ("weight_type", weight_type), ("beta", beta),
+                          ("weight_clip", weight_clip),
+                          ("n_action_samples", n_action_samples),
+                          ("num_train_batches_per_iteration",
+                           num_train_batches_per_iteration)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class CRR(Algorithm):
+    _default_config_class = CRRConfig
+
+    def __init__(self, config=None, **kwargs):
+        cfg = config or self.get_default_config()
+        if not cfg.input_:
+            raise ValueError(
+                "CRR is offline-only: set config.offline_data("
+                "input_=<dir of JSON experience files>)")
+        if cfg.weight_type not in ("binary", "exp"):
+            raise ValueError(
+                f"weight_type must be 'binary' or 'exp', got "
+                f"{cfg.weight_type!r}")
+        super().__init__(config=config, **kwargs)
+
+    def setup(self, config: CRRConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models.catalog import mlp_apply, mlp_init
+        from ray_tpu.rllib.offline.json_reader import JsonReader
+
+        self._reader = JsonReader(config.input_)
+        policy = self.local_policy
+        discrete = policy.discrete
+        obs_dim = policy.obs_dim
+        act_dim = policy.act_dim
+        hiddens = list(config.fcnet_hiddens)
+        key = jax.random.PRNGKey(config.seed + 7)
+        q_out = act_dim if discrete else 1
+        q_in = obs_dim if discrete else obs_dim + act_dim
+        self._q_params = mlp_init(key, [q_in, *hiddens, q_out])
+        self._q_target = jax.tree.map(jnp.asarray, self._q_params)
+        self._actor_opt = optax.adam(config.lr)
+        self._critic_opt = optax.adam(config.critic_lr)
+        self._actor_state = self._actor_opt.init(policy.params)
+        self._critic_state = self._critic_opt.init(self._q_params)
+        gamma, tau = config.gamma, config.tau
+        beta, wclip = config.beta, config.weight_clip
+        binary = config.weight_type == "binary"
+        n_samples = config.n_action_samples
+
+        if discrete:
+            def q_all(qp, obs):
+                return mlp_apply(qp, obs)                     # [B, A]
+
+            def exp_q(qp, actor_params, obs):
+                logits = policy.logits(actor_params, obs)
+                pi = jax.nn.softmax(logits, -1)
+                return (pi * q_all(qp, obs)).sum(-1)          # [B]
+
+            def q_of(qp, obs, actions):
+                return jnp.take_along_axis(
+                    q_all(qp, obs),
+                    actions[..., None].astype(jnp.int32), -1)[..., 0]
+        else:
+            def q_of(qp, obs, actions):
+                x = jnp.concatenate([obs, actions], -1)
+                return mlp_apply(qp, x)[..., 0]
+
+            def exp_q(qp, actor_params, obs, key=None):
+                vals = []
+                for i in range(n_samples):
+                    k = jax.random.fold_in(key, i)
+                    a, _, _ = policy._sample(actor_params, obs, k)
+                    vals.append(q_of(qp, obs, a))
+                return jnp.stack(vals).mean(0)
+
+        def critic_loss(qp, q_target, actor_params, mb, key):
+            if discrete:
+                q_next = exp_q(q_target, actor_params, mb["new_obs"])
+            else:
+                q_next = exp_q(q_target, actor_params, mb["new_obs"],
+                               key=key)
+            target = mb["rewards"] + gamma * \
+                (1.0 - mb["terminateds"]) * q_next
+            q = q_of(qp, mb["obs"], mb["actions"])
+            return ((q - jax.lax.stop_gradient(target)) ** 2).mean()
+
+        def actor_loss(actor_params, qp, mb, key):
+            q = q_of(qp, mb["obs"], mb["actions"])
+            if discrete:
+                v = exp_q(qp, actor_params, mb["obs"])
+            else:
+                v = exp_q(qp, actor_params, mb["obs"], key=key)
+            adv = jax.lax.stop_gradient(q - v)
+            if binary:
+                w = (adv > 0).astype(jnp.float32)
+            else:
+                w = jnp.clip(jnp.exp(adv / beta), 0.0, wclip)
+            logp = policy.logp(actor_params, mb["obs"], mb["actions"])
+            return -(w * logp).mean(), w.mean()
+
+        def update(actor_params, qp, q_target, actor_state,
+                   critic_state, mb, key):
+            k1, k2 = jax.random.split(key)
+            c_loss, c_grads = jax.value_and_grad(critic_loss)(
+                qp, q_target, actor_params, mb, k1)
+            cu, critic_state = self._critic_opt.update(
+                c_grads, critic_state, qp)
+            qp = optax.apply_updates(qp, cu)
+            (a_loss, w_mean), a_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(actor_params, qp, mb, k2)
+            au, actor_state = self._actor_opt.update(
+                a_grads, actor_state, actor_params)
+            actor_params = optax.apply_updates(actor_params, au)
+            q_target = jax.tree.map(
+                lambda t, p: (1 - tau) * t + tau * p, q_target, qp)
+            return (actor_params, qp, q_target, actor_state,
+                    critic_state,
+                    {"critic_loss": c_loss, "actor_loss": a_loss,
+                     "weight_mean": w_mean})
+
+        self._update_jit = jax.jit(update)
+        self._key = jax.random.PRNGKey(config.seed + 13)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+        config: CRRConfig = self.config
+        params = self.local_policy.params
+        metrics = {}
+        for _ in range(config.num_train_batches_per_iteration):
+            mb = self._reader.next_batch(config.train_batch_size)
+            self._timesteps_total += config.train_batch_size
+            device_mb = {
+                "obs": jnp.asarray(np.asarray(
+                    mb[SampleBatch.OBS], np.float32)),
+                "actions": jnp.asarray(np.asarray(
+                    mb[SampleBatch.ACTIONS])),
+                "rewards": jnp.asarray(np.asarray(
+                    mb[SampleBatch.REWARDS], np.float32)),
+                "new_obs": jnp.asarray(np.asarray(
+                    mb[SampleBatch.NEXT_OBS], np.float32)),
+                "terminateds": jnp.asarray(np.asarray(
+                    mb[SampleBatch.TERMINATEDS], np.float32)),
+            }
+            self._key, sub = jax.random.split(self._key)
+            (params, self._q_params, self._q_target,
+             self._actor_state, self._critic_state, metrics) = \
+                self._update_jit(params, self._q_params, self._q_target,
+                                 self._actor_state, self._critic_state,
+                                 device_mb, sub)
+        self.local_policy.params = params
+        return {k: float(v) for k, v in metrics.items()}

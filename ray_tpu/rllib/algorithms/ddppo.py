@@ -1,0 +1,202 @@
+"""DDPPO: decentralized distributed PPO.
+
+Analog of the reference's rllib/algorithms/ddppo (Wijmans et al. 2019):
+sample batches NEVER travel to the driver — every rollout worker runs
+the full PPO minibatch-SGD loop on its OWN samples, all-reducing
+gradients with its peers once per minibatch (the reference does this
+with torch DDP over NCCL/Gloo; here the workers form a
+``ray_tpu.util.collective`` group and ring-allreduce the flattened
+gradient vector). All workers start from identical weights and apply
+identical averaged gradients with identical optimizer states, so their
+parameters stay bit-synchronized without any central learner; the
+driver only triggers iterations, aggregates metrics, and mirrors worker
+0's weights for checkpointing/evaluation.
+
+Scaling consequence (the reference's pitch): driver bandwidth drops
+from O(train_batch) per iteration to O(metrics), so rollout fleet size
+stops being bounded by the learner's ingest rate.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class DDPPOConfig(PPOConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or DDPPO)
+        self.num_rollout_workers = 2
+        #: steps EACH worker samples and learns on per iteration (the
+        #: reference's rollout_fragment_length * num_envs_per_worker;
+        #: train_batch_size is ignored by design — there is no central
+        #: batch).
+        self.steps_per_worker = 256
+
+
+def _flat(grads):
+    import jax
+    import jax.numpy as jnp
+    leaves, treedef = jax.tree.flatten(grads)
+    return (jnp.concatenate([jnp.ravel(g) for g in leaves]),
+            [g.shape for g in leaves], treedef)
+
+
+def _unflat(vec, shapes, treedef):
+    import jax
+    import jax.numpy as jnp
+    out, off = [], 0
+    for shp in shapes:
+        n = int(np.prod(shp)) if shp else 1
+        out.append(jnp.reshape(vec[off:off + n], shp))
+        off += n
+    return jax.tree.unflatten(treedef, out)
+
+
+def _worker_learn(worker, cfg: Dict[str, Any], iteration: int):
+    """Runs ON each rollout worker (via worker.apply): sample locally,
+    PPO-SGD locally, ring-allreduce gradients per minibatch. Every
+    worker must call this the same number of times with the same cfg —
+    the allreduces are collective."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.util import collective
+
+    policy = worker.policy
+    state = getattr(worker, "_ddppo", None)
+    if state is None or state.get("reset_epoch") != cfg["reset_epoch"]:
+        # reset_epoch bumps when the driver re-broadcast weights
+        # (restore/set_weights): fresh params need a fresh optimizer
+        # state on every worker, identically.
+        from ray_tpu.rllib.algorithms.ppo import make_ppo_loss
+        optimizer = optax.adam(cfg["lr"])
+        loss_fn = make_ppo_loss(policy, cfg["clip_param"],
+                                cfg["vf_loss_coeff"],
+                                cfg["entropy_coeff"])
+
+        def total_loss(params, mb):
+            return loss_fn(params, mb)[0]
+
+        grad_fn = jax.jit(jax.value_and_grad(total_loss))
+
+        def apply_fn(params, opt_state, flat_grad, shapes_treedef):
+            grads = _unflat(flat_grad, *shapes_treedef)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            return optax.apply_updates(params, updates), opt_state
+
+        state = {
+            "optimizer": optimizer,
+            "opt_state": optimizer.init(policy.params),
+            "grad_fn": grad_fn,
+            "apply_fn": jax.jit(apply_fn, static_argnums=(3,)),
+            "reset_epoch": cfg["reset_epoch"],
+        }
+        worker._ddppo = state
+
+    batch = worker.sample(cfg["steps_per_worker"])
+    adv = np.asarray(batch[SampleBatch.ADVANTAGES], np.float32)
+    adv = (adv - adv.mean()) / max(adv.std(), 1e-6)
+    sb = SampleBatch({
+        "obs": np.asarray(batch[SampleBatch.OBS], np.float32),
+        "actions": np.asarray(batch[SampleBatch.ACTIONS]),
+        "old_logp": np.asarray(batch[SampleBatch.ACTION_LOGP],
+                               np.float32),
+        "advantages": adv,
+        "value_targets": np.asarray(batch[SampleBatch.VALUE_TARGETS],
+                                    np.float32),
+    })
+    params = policy.params
+    mb_size = min(cfg["sgd_minibatch_size"], len(sb))
+    last_loss = 0.0
+    for epoch in range(cfg["num_sgd_iter"]):
+        # Same seed on every worker -> same MINIBATCH COUNT and order
+        # of collective calls (contents differ: local data).
+        for mb in sb.minibatches(mb_size, seed=1000 * iteration + epoch):
+            device_mb = {k: jnp.asarray(v) for k, v in mb.items()}
+            loss, grads = state["grad_fn"](params, device_mb)
+            vec, shapes, treedef = _flat(grads)
+            # DDPPO's core move: gradients average ACROSS workers here;
+            # no sample or gradient ever reaches the driver.
+            total = collective.allreduce(np.asarray(vec), op="sum",
+                                         group_name=cfg["group_name"])
+            avg = total / cfg["world_size"]
+            params, state["opt_state"] = state["apply_fn"](
+                params, state["opt_state"], jnp.asarray(avg),
+                (tuple(shapes), treedef))
+            last_loss = float(loss)
+    policy.params = params
+    # Episode stats flow through WorkerSet.episode_stats (Algorithm
+    # .train) — only scalars travel back here.
+    return {"steps": len(sb), "loss": last_loss}
+
+
+class DDPPO(PPO):
+    _default_config_class = DDPPOConfig
+    _supports_multi_agent = False
+
+    def setup(self, config: DDPPOConfig) -> None:
+        if self.workers.num_workers() < 2:
+            raise ValueError(
+                "DDPPO is decentralized across workers: set "
+                ".rollouts(num_rollout_workers=2) or more")
+        # No central learner state (PPO.setup would build one); workers
+        # bit-synchronize by averaging gradients, starting from the
+        # driver's initial weights.
+        import ray_tpu
+
+        from ray_tpu.util import collective
+        self._group_name = f"ddppo-{id(self):x}"
+        workers = self.workers.remote_workers
+        collective.create_collective_group(
+            workers, len(workers), list(range(len(workers))),
+            group_name=self._group_name)
+        #: bumps whenever driver weights must overwrite the workers'
+        #: (initial broadcast, restore(), set_weights()) — workers
+        #: rebuild their optimizer state when they see a new epoch.
+        self._reset_epoch = 0
+        self._weights_dirty = True
+
+    def set_weights(self, weights) -> None:
+        """Driver-side weight injection (restore(), manual set) must
+        reach the decentralized learners — mark for re-broadcast; the
+        next training_step ships them before learning."""
+        super().set_weights(weights)
+        self._weights_dirty = True
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+        config: DDPPOConfig = self.config
+        if self._weights_dirty:
+            self._reset_epoch += 1
+            self._weights_dirty = False
+            weights_ref = ray_tpu.put(self.get_weights())
+            self.workers.sync_weights(weights_ref)
+        cfg = {
+            "lr": config.lr,
+            "clip_param": config.clip_param,
+            "vf_loss_coeff": config.vf_loss_coeff,
+            "entropy_coeff": config.entropy_coeff,
+            "num_sgd_iter": config.num_sgd_iter,
+            "sgd_minibatch_size": config.sgd_minibatch_size,
+            "steps_per_worker": config.steps_per_worker,
+            "group_name": self._group_name,
+            "world_size": self.workers.num_workers(),
+            "reset_epoch": self._reset_epoch,
+        }
+        results = ray_tpu.get(
+            [w.apply.remote(_worker_learn, cfg, self.iteration)
+             for w in self.workers.remote_workers])
+        self._timesteps_total += sum(r["steps"] for r in results)
+        # Workers stay bit-identical; mirror worker 0 for save/evaluate.
+        self.local_policy.set_weights(
+            ray_tpu.get(self.workers.remote_workers[0]
+                        .get_weights.remote()))
+        return {"loss": float(np.mean([r["loss"] for r in results])),
+                "steps_this_iter": sum(r["steps"] for r in results)}

@@ -48,6 +48,35 @@ class PPOConfig(AlgorithmConfig):
         return self
 
 
+def make_ppo_loss(policy, clip: float, vf_coeff: float,
+                  ent_coeff: float):
+    """The clipped-surrogate PPO loss bound to ``policy`` — shared by
+    the central learner here and DDPPO's decentralized worker learners
+    (ddppo.py), so the two can never silently diverge. Returns
+    ``loss_fn(params, mb) -> (total, metrics)``."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(params, mb):
+        logp = policy.logp(params, mb["obs"], mb["actions"])
+        ratio = jnp.exp(logp - mb["old_logp"])
+        adv = mb["advantages"]
+        surrogate = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+        values = policy._value(params, mb["obs"])
+        vf_loss = jnp.mean((values - mb["value_targets"]) ** 2)
+        entropy = jnp.mean(policy.entropy(params, mb["obs"]))
+        total = (-jnp.mean(surrogate) + vf_coeff * vf_loss
+                 - ent_coeff * entropy)
+        approx_kl = jnp.mean(mb["old_logp"] - logp)
+        return total, {"policy_loss": -jnp.mean(surrogate),
+                       "vf_loss": vf_loss, "entropy": entropy,
+                       "approx_kl": approx_kl}
+
+    return loss_fn
+
+
 class PPO(Algorithm):
     _default_config_class = PPOConfig
     _supports_multi_agent = True
@@ -61,26 +90,9 @@ class PPO(Algorithm):
 
         optimizer = optax.adam(config.lr)
         opt_state = optimizer.init(policy.params)
-        clip = config.clip_param
-        vf_coeff = config.vf_loss_coeff
-        ent_coeff = config.entropy_coeff
-
-        def loss_fn(params, mb):
-            logp = policy.logp(params, mb["obs"], mb["actions"])
-            ratio = jnp.exp(logp - mb["old_logp"])
-            adv = mb["advantages"]
-            surrogate = jnp.minimum(
-                ratio * adv,
-                jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
-            values = policy._value(params, mb["obs"])
-            vf_loss = jnp.mean((values - mb["value_targets"]) ** 2)
-            entropy = jnp.mean(policy.entropy(params, mb["obs"]))
-            total = (-jnp.mean(surrogate) + vf_coeff * vf_loss
-                     - ent_coeff * entropy)
-            approx_kl = jnp.mean(mb["old_logp"] - logp)
-            return total, {"policy_loss": -jnp.mean(surrogate),
-                           "vf_loss": vf_loss, "entropy": entropy,
-                           "approx_kl": approx_kl}
+        loss_fn = make_ppo_loss(policy, config.clip_param,
+                                config.vf_loss_coeff,
+                                config.entropy_coeff)
 
         def update(params, opt_state, mb):
             (loss, metrics), grads = jax.value_and_grad(

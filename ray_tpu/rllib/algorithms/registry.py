@@ -11,12 +11,15 @@ def get_algorithm_class(name: str) -> Type:
     from ray_tpu.rllib.algorithms.a3c import A3C
     from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN
     from ray_tpu.rllib.algorithms.apex_ddpg import ApexDDPG
+    from ray_tpu.rllib.algorithms.alpha_zero import AlphaZero
     from ray_tpu.rllib.algorithms.appo import APPO
     from ray_tpu.rllib.algorithms.ars import ARS
     from ray_tpu.rllib.algorithms.bandit import BanditLinTS, BanditLinUCB
     from ray_tpu.rllib.algorithms.bc import BC
     from ray_tpu.rllib.algorithms.cql import CQL
+    from ray_tpu.rllib.algorithms.crr import CRR
     from ray_tpu.rllib.algorithms.ddpg import DDPG
+    from ray_tpu.rllib.algorithms.ddppo import DDPPO
     from ray_tpu.rllib.algorithms.dqn import DQN
     from ray_tpu.rllib.algorithms.dt import DT
     from ray_tpu.rllib.algorithms.es import ES
@@ -41,7 +44,8 @@ def get_algorithm_class(name: str) -> Type:
              "APEX-DDPG": ApexDDPG, "RANDOM": RandomAgent, "RAINBOW": Rainbow,
              "R2D2": R2D2, "QMIX": QMix, "MADDPG": MADDPG,
              "SLATEQ": SlateQ,
-             "ES": ES, "ARS": ARS, "CQL": CQL, "DT": DT,
+             "ES": ES, "ARS": ARS, "CQL": CQL, "DT": DT, "CRR": CRR,
+             "DDPPO": DDPPO, "ALPHAZERO": AlphaZero,
              "BANDITLINUCB": BanditLinUCB, "BANDITLINTS": BanditLinTS}
     try:
         return table[name.upper()]

@@ -131,3 +131,75 @@ class CooperativeNavEnv(MultiAgentEnv):
         truncs = {aid: False for aid in self._ids}
         truncs["__all__"] = False
         return obs, rewards, terms, truncs, {}
+
+
+class ClonableCartPole:
+    """Sparse-reward CartPole with ``get_state``/``set_state`` — the
+    clonable-env contract AlphaZero's MCTS needs (reference:
+    rllib/algorithms/alpha_zero README + its test task
+    examples/env/cartpole_sparse_rewards.py). Reward accumulates
+    silently and pays out ONLY at termination (the episode score) — the
+    board-game shape AlphaZero's undiscounted backup expects; the
+    algorithm's ranked-rewards transform then maps that score to +-1.
+    Observations are the prescribed dict {"obs", "action_mask"} (every
+    move legal here)."""
+
+    def __init__(self, config: Optional[dict] = None):
+        import gymnasium as gym
+        config = dict(config or {})
+        # UNWRAPPED: gym's TimeLimit wrapper counts every step — MCTS
+        # simulations would burn the episode budget and set_state cannot
+        # restore the wrapper's counter. AlphaZeroConfig.max_episode_steps
+        # bounds self-play episodes instead.
+        self._env = gym.make("CartPole-v1").unwrapped
+        self.action_space = self._env.action_space
+        # The DECLARED space matches the emitted dict (the reference
+        # declares a Dict space for its sparse-rewards CartPole too).
+        self.observation_space = spaces.Dict({
+            "obs": self._env.observation_space,
+            "action_mask": spaces.Box(0.0, 1.0, (self.action_space.n,),
+                                      np.float32),
+        })
+        self._steps = 0
+        self._running = 0.0
+
+    def _obs(self, raw):
+        return {"obs": np.asarray(raw, np.float32),
+                "action_mask": np.ones(self.action_space.n, np.float32)}
+
+    def reset(self, *, seed=None, options=None):
+        raw, info = self._env.reset(seed=seed, options=options)
+        self._steps = 0
+        self._running = 0.0
+        return self._obs(raw), info
+
+    def step(self, action):
+        raw, r, term, trunc, info = self._env.step(int(action))
+        self._steps += 1
+        self._running += float(r)
+        score = self._running if term else 0.0
+        return self._obs(raw), score, term, trunc, info
+
+    def get_state(self):
+        env = self._env.unwrapped
+        return (np.array(env.state, np.float64), self._steps,
+                self._running, env.steps_beyond_terminated)
+
+    def set_state(self, state):
+        arr, steps, running, beyond = state
+        env = self._env.unwrapped
+        env.state = tuple(arr.tolist())
+        # Restored states may predate a simulated termination — without
+        # this, post-restore steps hit gym's already-terminated warning
+        # path and return 0 reward.
+        env.steps_beyond_terminated = beyond
+        self._steps = steps
+        self._running = running
+
+    def episode_score(self) -> float:
+        """Accumulated-but-unpaid score (AlphaZero reads this when its
+        step budget ends an episode before the env does)."""
+        return self._running
+
+    def close(self):
+        self._env.close()

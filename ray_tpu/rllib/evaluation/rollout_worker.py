@@ -68,6 +68,24 @@ class RolloutWorker:
         self.policy.set_weights(weights)
         return True
 
+    def apply(self, fn, *args, **kwargs):
+        """Run ``fn(self, ...)`` on the worker (reference:
+        RolloutWorker.apply) — the seam algorithm-owned worker-side
+        logic ships through (DDPPO's decentralized learner lives in a
+        function applied here)."""
+        return fn(self, *args, **kwargs)
+
+    def init_collective_group(self, world_size: int, rank: int,
+                              backend: str = "tpu",
+                              group_name: str = "default"):
+        """Join a collective group (util/collective) from this worker —
+        what create_collective_group invokes (DDPPO's gradient
+        allreduce ring spans the rollout workers)."""
+        from ray_tpu.util import collective
+        collective.init_collective_group(world_size, rank, backend,
+                                         group_name)
+        return rank
+
     def get_weights(self):
         return self.policy.get_weights()
 

@@ -13,6 +13,12 @@ import numpy as np
 def make_policy(policy_config: Dict[str, Any], obs_space, action_space,
                 seed: int = 0):
     """Instantiate the policy named by policy_config['policy_class']."""
+    import gymnasium as gym
+    if isinstance(obs_space, gym.spaces.Dict) and "obs" in obs_space.spaces:
+        # The {"obs", "action_mask"} dict convention (AlphaZero envs,
+        # reference parametric-action envs): policies encode the inner
+        # observation; masks are the algorithm's concern.
+        obs_space = obs_space.spaces["obs"]
     name = policy_config.get("policy_class", "actor_critic")
     model_config = {
         "fcnet_hiddens": policy_config.get("fcnet_hiddens", (64, 64)),

@@ -149,6 +149,28 @@ def _spread_maddpg():
             .debugging(seed=0))
 
 
+def _cartpole_alphazero():
+    """AlphaZero's own example task (reference alpha_zero README):
+    MCTS over clonable CartPole. Random ~= 20; 30-simulation search
+    with learned priors passes 60 within the budget."""
+    from ray_tpu.rllib import AlphaZeroConfig
+    from ray_tpu.rllib.env.examples import ClonableCartPole
+    return (AlphaZeroConfig()
+            .environment(ClonableCartPole)
+            .debugging(seed=0))
+
+
+def _cartpole_ddppo():
+    """Decentralized PPO: 2 workers gradient-allreducing per minibatch;
+    the learning curve must track plain PPO's."""
+    from ray_tpu.rllib import DDPPOConfig
+    return (DDPPOConfig()
+            .environment("CartPole-v1")
+            .rollouts(num_rollout_workers=2)
+            .training(lr=5e-4, num_sgd_iter=4, sgd_minibatch_size=128)
+            .debugging(seed=0))
+
+
 def _atari_ppo():
     """The north-star shape (reference: tuned_examples/ppo/atari-ppo.yaml)
     on the synthetic Catch game: pixels in, CNN policy, deepmind wrapper
@@ -205,6 +227,18 @@ TUNED_EXAMPLES: Dict[str, TunedExample] = {
         "spread-maddpg", _spread_maddpg, stop_reward=-45.0, max_iters=14,
         notes="reference: rllib/algorithms/maddpg; random joint policy "
               "~= -66/episode, tuned MADDPG passes -45 by iteration ~8"),
+    "cartpole-alphazero": TunedExample(
+        "cartpole-alphazero", _cartpole_alphazero, stop_reward=60.0,
+        max_iters=35,
+        notes="reference: rllib/algorithms/alpha_zero (one-player MCTS "
+              "+ ranked rewards on sparse terminal scores); random "
+              "~= 20, the 100-episode reward window passes 60 around "
+              "iteration 25"),
+    "cartpole-ddppo": TunedExample(
+        "cartpole-ddppo", _cartpole_ddppo, stop_reward=60.0,
+        max_iters=30,
+        notes="reference: rllib/algorithms/ddppo; no central learner - "
+              "workers allreduce gradients per minibatch"),
     "atari-ppo": TunedExample(
         "atari-ppo", _atari_ppo, stop_reward=0.0, max_iters=30,
         notes="reference: tuned_examples/ppo/atari-ppo.yaml; synthetic "
