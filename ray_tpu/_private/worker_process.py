@@ -493,6 +493,20 @@ class _WorkerMain:
 
 def _main() -> None:
     import argparse
+
+    # Worker processes NEVER run TPU tasks (the chip is single-process;
+    # runtime._uses_worker_process and the daemon's routing both keep
+    # TPU work in the chip-owning process) — but site hooks that preload
+    # jax would otherwise initialize the TPU backend here and DEADLOCK
+    # on the chip's lockfile (/tmp/libtpu_lockfile) against the owning
+    # process. Env vars don't cut it (the same hooks override them);
+    # pin the platform in-process before any device use.
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 - no jax, nothing to pin
+        pass
+
     parser = argparse.ArgumentParser()
     parser.add_argument("--fd", type=int, required=True)
     parser.add_argument("--store", default=None)
