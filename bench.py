@@ -204,6 +204,42 @@ def bench_rllib() -> dict:
     return _json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def bench_diffusion() -> dict:
+    """BASELINE.json config 5 ("Ray Serve Stable-Diffusion batch
+    inference on TPU replicas"): DDIM sampling throughput of the
+    sd-base UNet — the jitted program a Serve TPU replica runs per
+    batched request (models/diffusion.py ddim_sample; Serve's batching
+    layer adds microseconds against the 50-step UNet loop, so the
+    replica's inner loop IS the number)."""
+    import time as _time
+
+    import jax
+
+    from ray_tpu.models import diffusion
+
+    device = jax.devices()[0]
+    cfg = diffusion.config("sd-base")
+    # Init on host then transfer once: the initializer is hundreds of
+    # small RNG ops — op-by-op over the remote-chip tunnel costs
+    # minutes; one device_put costs seconds.
+    with jax.default_device(jax.devices("cpu")[0]):
+        params = diffusion.init(cfg, jax.random.PRNGKey(0))
+    params = jax.device_put(params, device)
+    batch, n_steps = 8, 50
+    sample = jax.jit(lambda key: diffusion.ddim_sample(
+        params, cfg, key, batch, n_steps=n_steps))
+    out = sample(jax.random.PRNGKey(1))
+    float(out.sum())  # sync (block_until_ready insufficient on tunnel)
+    t0 = _time.perf_counter()
+    iters = 3
+    for i in range(iters):
+        out = sample(jax.random.PRNGKey(2 + i))
+    float(out.sum())
+    dt = _time.perf_counter() - t0
+    return {"diffusion_images_per_sec": round(iters * batch / dt, 2),
+            "diffusion_batch": batch, "diffusion_ddim_steps": n_steps}
+
+
 def _bench_gpt(preset: str, batch: int, seq: int, steps: int,
                warmup: int, overrides: dict, optimizer) -> dict:
     """One single-chip GPT training measurement -> tokens/s + MFU."""
@@ -301,6 +337,11 @@ def main():
         extra.update(bench_data_shuffle())
     except Exception:  # noqa: BLE001 - extras must not sink the headline
         extra.setdefault("shuffle_mb_per_sec", None)
+    if on_tpu:
+        try:
+            extra.update(bench_diffusion())
+        except Exception:  # noqa: BLE001 - extras never sink the headline
+            extra.setdefault("diffusion_images_per_sec", None)
 
     result = {
         "metric": f"{preset}_train_tokens_per_sec_per_chip",

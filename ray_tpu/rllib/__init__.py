@@ -35,6 +35,8 @@ from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig
 from ray_tpu.rllib.algorithms.ddpg import DDPG, DDPGConfig
 from ray_tpu.rllib.algorithms.ddppo import DDPPO, DDPPOConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.dreamer import (Dreamer,
+                                               DreamerConfig)
 from ray_tpu.rllib.algorithms.dt import DT, DTConfig
 from ray_tpu.rllib.algorithms.es import ES, ESConfig
 from ray_tpu.rllib.algorithms.impala import Impala, ImpalaConfig
@@ -72,7 +74,7 @@ __all__ = ["A2C", "A2CConfig", "A3C", "A3CConfig", "APPO", "APPOConfig",
            "ApexDQN", "ApexDQNConfig", "ApexDDPG", "ApexDDPGConfig",
            "RandomAgent", "RandomAgentConfig",
            "AlphaZero", "AlphaZeroConfig", "CRR", "CRRConfig",
-           "DDPPO", "DDPPOConfig",
+           "DDPPO", "DDPPOConfig", "Dreamer", "DreamerConfig",
            "ARS", "ARSConfig", "Algorithm", "AlgorithmConfig", "BC",
            "BCConfig", "CQL", "CQLConfig", "DDPG", "DDPGConfig", "DQN",
            "DQNConfig", "DT", "DTConfig", "ES", "ESConfig", "Impala", "ImpalaConfig",

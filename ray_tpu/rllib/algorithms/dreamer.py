@@ -1,0 +1,473 @@
+"""Dreamer (V1): learning behaviors by latent imagination.
+
+Analog of the reference's rllib/algorithms/dreamer (Hafner et al. 2020):
+a recurrent state-space world model (RSSM) is trained on replayed
+sequences, and the policy is trained entirely INSIDE the model — the
+actor unrolls imagined trajectories through the learned dynamics and
+maximizes lambda-returns of predicted rewards, backpropagating through
+the (reparameterized) latent transitions; the value function supplies
+the bootstrap. Real env steps are only ever used to fit the world
+model.
+
+Pieces (all Gaussian, the V1 formulation):
+  * RSSM: deterministic GRU path ``h_t = f(h_{t-1}, [z_{t-1}, a_{t-1}])``
+    with stochastic state ``z_t`` — prior ``p(z_t | h_t)`` for
+    imagination, posterior ``q(z_t | h_t, enc(o_t))`` for filtering.
+  * Heads: observation decoder (reconstruction), reward predictor.
+  * World-model loss: reconstruction MSE + reward MSE +
+    max(KL(q || p), free_nats).
+  * Behavior: tanh-Gaussian actor and value MLP on ``[h, z]``; imagined
+    H-step rollouts from every posterior state; TD(lambda) returns;
+    actor ascends them, value regresses them (stop-gradient).
+
+The reference is image-based (pixel conv encoder/decoder on DMC);
+vector observations use MLP encoder/decoder here — same latent
+machinery, CI-affordable (its own tuned task is Pendulum-scale). Box
+action spaces only, like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+
+class DreamerConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or Dreamer)
+        self.lr = 6e-4              # world model
+        self.actor_lr = 8e-5
+        self.critic_lr = 8e-5
+        self.deter_dim = 128        # GRU state
+        self.stoch_dim = 16         # z
+        self.hidden_dim = 128       # MLPs
+        self.batch_size = 32        # sequences per world-model batch
+        self.seq_len = 16
+        self.imagine_horizon = 12
+        self.free_nats = 1.0
+        self.kl_coeff = 1.0
+        self.lambda_ = 0.95
+        self.explore_noise = 0.3
+        self.num_train_batches_per_iteration = 40
+        self.rollout_steps_per_iteration = 400
+        self.prefill_steps = 1000   # random steps before learning
+        self.replay_capacity_steps = 50_000
+        #: env steps per policy decision (the reference's env wrapper
+        #: uses action repeat 2 on control tasks; rewards sum across
+        #: the repeat).
+        self.action_repeat = 2
+
+    def training(self, *, actor_lr=None, critic_lr=None, deter_dim=None,
+                 stoch_dim=None, hidden_dim=None, seq_len=None,
+                 imagine_horizon=None, free_nats=None, kl_coeff=None,
+                 explore_noise=None, prefill_steps=None,
+                 action_repeat=None,
+                 rollout_steps_per_iteration=None,
+                 num_train_batches_per_iteration=None,
+                 **kwargs) -> "DreamerConfig":
+        super().training(**kwargs)
+        for name, val in (
+                ("actor_lr", actor_lr), ("critic_lr", critic_lr),
+                ("deter_dim", deter_dim), ("stoch_dim", stoch_dim),
+                ("hidden_dim", hidden_dim), ("seq_len", seq_len),
+                ("imagine_horizon", imagine_horizon),
+                ("free_nats", free_nats), ("kl_coeff", kl_coeff),
+                ("explore_noise", explore_noise),
+                ("prefill_steps", prefill_steps),
+                ("action_repeat", action_repeat),
+                ("rollout_steps_per_iteration",
+                 rollout_steps_per_iteration),
+                ("num_train_batches_per_iteration",
+                 num_train_batches_per_iteration)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class Dreamer(Algorithm):
+    _default_config_class = DreamerConfig
+    _own_rollout_actors = True
+
+    def setup(self, config: DreamerConfig) -> None:
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models.catalog import mlp_apply, mlp_init
+        from ray_tpu.rllib.utils.replay_buffers import (
+            SequenceReplayBuffer)
+
+        env = self._env_creator(config.env_config)
+        if not isinstance(env.action_space, gym.spaces.Box):
+            raise ValueError(
+                "Dreamer supports Box action spaces (the reference is "
+                "likewise continuous-control only)")
+        self._env = env
+        self.obs_dim = int(np.prod(env.observation_space.shape))
+        self.act_dim = int(np.prod(env.action_space.shape))
+        self._act_lo = np.asarray(env.action_space.low, np.float32)
+        self._act_hi = np.asarray(env.action_space.high, np.float32)
+        D, Z, H = config.deter_dim, config.stoch_dim, config.hidden_dim
+
+        key = jax.random.PRNGKey(config.seed)
+        ks = iter(jax.random.split(key, 12))
+        self.params = {
+            "enc": mlp_init(next(ks), [self.obs_dim, H, H]),
+            # GRU over input [z, a] with state h.
+            "gru_x": mlp_init(next(ks), [Z + self.act_dim, 3 * D]),
+            "gru_h": mlp_init(next(ks), [D, 3 * D]),
+            "prior": mlp_init(next(ks), [D, H, 2 * Z]),
+            "post": mlp_init(next(ks), [D + H, H, 2 * Z]),
+            "dec": mlp_init(next(ks), [D + Z, H, H, self.obs_dim]),
+            "rew": mlp_init(next(ks), [D + Z, H, 1]),
+        }
+        self.actor_params = mlp_init(next(ks),
+                                     [D + Z, H, H, 2 * self.act_dim])
+        self.critic_params = mlp_init(next(ks), [D + Z, H, H, 1])
+        self._wm_opt = optax.adam(config.lr)
+        self._actor_opt = optax.adam(config.actor_lr)
+        self._critic_opt = optax.adam(config.critic_lr)
+        self._wm_state = self._wm_opt.init(self.params)
+        self._actor_state = self._actor_opt.init(self.actor_params)
+        self._critic_state = self._critic_opt.init(self.critic_params)
+
+        def gru(p, h, x):
+            gx = mlp_apply(p["gru_x"], x)
+            gh = mlp_apply(p["gru_h"], h)
+            xr, xu, xc = jnp.split(gx, 3, axis=-1)
+            hr, hu, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            u = jax.nn.sigmoid(xu + hu)
+            cand = jnp.tanh(xc + r * hc)
+            return u * h + (1 - u) * cand
+
+        def stats(raw):
+            mean, std = jnp.split(raw, 2, axis=-1)
+            return mean, jax.nn.softplus(std) + 0.1
+
+        def prior_of(p, h):
+            return stats(mlp_apply(p["prior"], h))
+
+        def post_of(p, h, emb):
+            return stats(mlp_apply(p["post"],
+                                   jnp.concatenate([h, emb], -1)))
+
+        def rssm_observe(p, obs_seq, act_seq, key):
+            """obs [B,T,obs], act [B,T,act] (a_t taken AFTER o_t) ->
+            posterior features [B,T,D+Z] + KL terms."""
+            B, T = obs_seq.shape[:2]
+            emb = mlp_apply(p["enc"], obs_seq)
+
+            def step(carry, t):
+                h, z, k = carry
+                k, sub = jax.random.split(k)
+                pm, ps = prior_of(p, h)
+                qm, qs = post_of(p, h, emb[:, t])
+                zq = qm + qs * jax.random.normal(sub, qm.shape)
+                kl = (jnp.log(ps / qs) +
+                      (qs ** 2 + (qm - pm) ** 2) / (2 * ps ** 2)
+                      - 0.5).sum(-1)
+                feat = jnp.concatenate([h, zq], -1)
+                h_next = gru(p, h, jnp.concatenate(
+                    [zq, act_seq[:, t]], -1))
+                return (h_next, zq, k), (feat, kl)
+
+            h0 = jnp.zeros((B, D))
+            z0 = jnp.zeros((B, Z))
+            (_, _, _), (feats, kls) = jax.lax.scan(
+                step, (h0, z0, key), jnp.arange(T))
+            # scan stacks on axis 0 -> [T,B,...]; put batch first.
+            return (jnp.moveaxis(feats, 0, 1),
+                    jnp.moveaxis(kls, 0, 1))
+
+        def actor_dist(ap, feat):
+            mean, std = stats(mlp_apply(ap, feat))
+            return mean, std
+
+        def actor_sample(ap, feat, key):
+            mean, std = actor_dist(ap, feat)
+            return jnp.tanh(mean + std * jax.random.normal(
+                key, mean.shape))
+
+        def imagine(p, ap, feat0, key, horizon):
+            """Roll the PRIOR forward under the actor from [B,D+Z]
+            starts; differentiable through z (reparameterized) for the
+            actor gradient."""
+            def step(carry, _):
+                h, z, k = carry
+                k, ka, kz = jax.random.split(k, 3)
+                feat = jnp.concatenate([h, z], -1)
+                a = actor_sample(ap, feat, ka)
+                h = gru(p, h, jnp.concatenate([z, a], -1))
+                pm, ps = prior_of(p, h)
+                z = pm + ps * jax.random.normal(kz, pm.shape)
+                return (h, z, k), jnp.concatenate([h, z], -1)
+
+            h0 = feat0[..., :D]
+            z0 = feat0[..., D:]
+            (_, _, _), feats = jax.lax.scan(
+                step, (h0, z0, key), None, length=horizon)
+            return jnp.moveaxis(feats, 0, 1)  # [B,H,D+Z]
+
+        gamma, lam = config.gamma, config.lambda_
+        free_nats, kl_coeff = config.free_nats, config.kl_coeff
+
+        def wm_loss(p, mb, key):
+            feats, kls = rssm_observe(p, mb["obs"], mb["actions"], key)
+            recon = mlp_apply(p["dec"], feats)
+            rew = mlp_apply(p["rew"], feats)[..., 0]
+            m = mb["mask"]
+            recon_loss = (((recon - mb["obs"]) ** 2).mean(-1) * m).sum() \
+                / jnp.maximum(m.sum(), 1.0)
+            rew_loss = (((rew - mb["rewards"]) ** 2) * m).sum() / \
+                jnp.maximum(m.sum(), 1.0)
+            kl = jnp.maximum((kls * m).sum() / jnp.maximum(m.sum(), 1.0),
+                             free_nats)
+            return recon_loss + rew_loss + kl_coeff * kl, \
+                (recon_loss, rew_loss, kl, feats)
+
+        def lambda_returns(rew, values):
+            """rew/values [B,H] along imagined states s_0..s_{H-1}:
+            G_t = r_t + gamma*((1-lam)*V(s_{t+1}) + lam*G_{t+1}),
+            seeded G_{H-1} = r_{H-1} + gamma*V(s_{H-1})."""
+            H_ = rew.shape[1]
+            seed = rew[:, -1] + gamma * values[:, -1]
+
+            def step(ret, t):
+                idx = H_ - 2 - t
+                ret = rew[:, idx] + gamma * (
+                    (1 - lam) * values[:, idx + 1] + lam * ret)
+                return ret, ret
+
+            _, rets = jax.lax.scan(step, seed, jnp.arange(H_ - 1))
+            # rets covers t=H-2..0 (reverse order); append the seed.
+            all_rets = jnp.concatenate(
+                [rets[::-1], seed[None]], axis=0)   # [H,B]
+            return jnp.moveaxis(all_rets, 0, 1)     # [B,H]
+
+        def behavior_losses(ap, cp, p, feats, key):
+            B = feats.shape[0] * feats.shape[1]
+            starts = jax.lax.stop_gradient(
+                feats.reshape(B, feats.shape[-1]))
+            imag = imagine(p, ap, starts, key,
+                           config.imagine_horizon)      # [B,H,D+Z]
+            rew = mlp_apply(p["rew"], imag)[..., 0]
+            values = mlp_apply(cp, imag)[..., 0]
+            rets = lambda_returns(rew, values)
+            actor_loss = -rets.mean()
+            critic_loss = ((mlp_apply(cp, jax.lax.stop_gradient(imag))
+                            [..., 0]
+                            - jax.lax.stop_gradient(rets)) ** 2).mean()
+            return actor_loss, critic_loss, rets
+
+        def update(p, ap, cp, wm_s, a_s, c_s, mb, key):
+            k1, k2, k3 = jax.random.split(key, 3)
+            (wl, (rl, rwl, kl, feats)), wg = jax.value_and_grad(
+                wm_loss, has_aux=True)(p, mb, k1)
+            wu, wm_s = self._wm_opt.update(wg, wm_s, p)
+            p = optax.apply_updates(p, wu)
+
+            def a_loss(ap_):
+                al, _, _ = behavior_losses(ap_, cp, p, feats, k2)
+                return al
+
+            al, ag = jax.value_and_grad(a_loss)(ap)
+            au, a_s = self._actor_opt.update(ag, a_s, ap)
+            ap = optax.apply_updates(ap, au)
+
+            def c_loss(cp_):
+                _, cl, _ = behavior_losses(ap, cp_, p, feats, k3)
+                return cl
+
+            cl, cg = jax.value_and_grad(c_loss)(cp)
+            cu, c_s = self._critic_opt.update(cg, c_s, cp)
+            cp = optax.apply_updates(cp, cu)
+            metrics = {"wm_loss": wl, "recon_loss": rl,
+                       "reward_loss": rwl, "kl": kl,
+                       "actor_loss": al, "critic_loss": cl}
+            return p, ap, cp, wm_s, a_s, c_s, metrics
+
+        # Filtering step for acting: advance (h, z) with the posterior.
+        def filter_step(p, h, z, a_prev, obs, key):
+            h = gru(p, h, jnp.concatenate([z, a_prev], -1))
+            emb = mlp_apply(p["enc"], obs)
+            qm, qs = post_of(p, h, emb)
+            z = qm + qs * jax.random.normal(key, qm.shape)
+            return h, z
+
+        self._update_jit = jax.jit(update)
+        self._filter_jit = jax.jit(filter_step)
+        self._actor_sample_jit = jax.jit(actor_sample)
+        self._D, self._Z = D, Z
+        self._key = jax.random.PRNGKey(config.seed + 5)
+        self._buffer = SequenceReplayBuffer(
+            capacity_episodes=max(
+                config.replay_capacity_steps // 50, 64),
+            seed=config.seed)
+        self._rng = np.random.default_rng(config.seed)
+        self._episode_rewards: List[float] = []
+        self._reset_episode_state()
+
+    def _reset_episode_state(self) -> None:
+        self._obs, _ = self._env.reset()
+        self._h = np.zeros(self._D, np.float32)
+        self._z = np.zeros(self._Z, np.float32)
+        self._a_prev = np.zeros(self.act_dim, np.float32)
+        self._episode_reward = 0.0
+        self._episode_rows: List[dict] = []
+
+    # -- acting ----------------------------------------------------------
+
+    def compute_single_action(self, obs, explore: bool = False,
+                              policy_id=None):
+        import jax
+        import jax.numpy as jnp
+        self._key, k1, k2 = jax.random.split(self._key, 3)
+        h, z = self._filter_jit(
+            self.params, jnp.asarray(self._h[None]),
+            jnp.asarray(self._z[None]),
+            jnp.asarray(self._a_prev[None]),
+            jnp.asarray(np.asarray(obs, np.float32).reshape(1, -1)), k1)
+        self._h = np.asarray(h[0])
+        self._z = np.asarray(z[0])
+        feat = jnp.concatenate([h, z], -1)
+        a = np.asarray(self._actor_sample_jit(
+            self.actor_params, feat, k2)[0])
+        if explore:
+            a = np.clip(a + self.config.explore_noise *
+                        self._rng.standard_normal(a.shape), -1, 1)
+        return self._act_lo + (a + 1.0) * 0.5 * (self._act_hi -
+                                                 self._act_lo)
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Noise-free episodes on a fresh env with a fresh filter state
+        (the base evaluate would thread the collection episode's
+        recurrent state into evaluation)."""
+        saved = (self._env, self._obs, self._h, self._z, self._a_prev,
+                 self._episode_reward, self._episode_rows)
+        eval_env = self._env_creator(self.config.env_config)
+        rewards = []
+        try:
+            for e in range(3):
+                self._env = eval_env
+                self._obs, _ = eval_env.reset(seed=10_000 + e)
+                self._h = np.zeros(self._D, np.float32)
+                self._z = np.zeros(self._Z, np.float32)
+                self._a_prev = np.zeros(self.act_dim, np.float32)
+                total, done = 0.0, False
+                while not done:
+                    a = self.compute_single_action(self._obs)
+                    self._obs, r, term, trunc, _ = eval_env.step(
+                        np.asarray(a, np.float32))
+                    norm = 2.0 * (a - self._act_lo) / np.maximum(
+                        self._act_hi - self._act_lo, 1e-8) - 1.0
+                    self._a_prev = np.asarray(norm,
+                                              np.float32).reshape(-1)
+                    total += float(r)
+                    done = term or trunc
+                rewards.append(total)
+        finally:
+            close = getattr(eval_env, "close", None)
+            if callable(close):
+                close()
+            (self._env, self._obs, self._h, self._z, self._a_prev,
+             self._episode_reward, self._episode_rows) = saved
+        return {"episode_reward_mean": float(np.mean(rewards)),
+                "episodes_this_eval": len(rewards)}
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        config: DreamerConfig = self.config
+        for _ in range(config.rollout_steps_per_iteration):
+            if self._timesteps_total < config.prefill_steps:
+                action = self._env.action_space.sample()
+                norm_a = 2.0 * (action - self._act_lo) / np.maximum(
+                    self._act_hi - self._act_lo, 1e-8) - 1.0
+            else:
+                action = self.compute_single_action(self._obs,
+                                                    explore=True)
+                norm_a = 2.0 * (action - self._act_lo) / np.maximum(
+                    self._act_hi - self._act_lo, 1e-8) - 1.0
+            r, term, trunc = 0.0, False, False
+            for _ in range(max(config.action_repeat, 1)):
+                nxt, r_i, term, trunc, _ = self._env.step(
+                    np.asarray(action, np.float32))
+                r += float(r_i)
+                if term or trunc:
+                    break
+            self._episode_rows.append({
+                "obs": np.asarray(self._obs, np.float32).reshape(-1),
+                "actions": np.asarray(norm_a, np.float32).reshape(-1),
+                "rewards": np.float32(r),
+                "terminateds": np.float32(term)})
+            self._episode_reward += float(r)
+            self._timesteps_total += 1
+            self._obs = nxt
+            self._a_prev = np.asarray(norm_a, np.float32).reshape(-1)
+            if term or trunc:
+                rows = self._episode_rows
+                batch = SampleBatch({
+                    k: np.stack([row[k] for row in rows])
+                    for k in rows[0]})
+                batch["eps_id"] = np.full(
+                    len(rows), len(self._episode_rewards), np.int64)
+                self._buffer.add(batch)
+                self._episode_rewards.append(self._episode_reward)
+                self._reset_episode_state()
+
+        metrics = {}
+        if self._timesteps_total >= config.prefill_steps and \
+                len(self._buffer) >= config.batch_size * config.seq_len:
+            import jax
+            p, ap, cp = (self.params, self.actor_params,
+                         self.critic_params)
+            for _ in range(config.num_train_batches_per_iteration):
+                mb = self._buffer.sample(config.batch_size,
+                                         seq_len=config.seq_len)
+                device_mb = {
+                    "obs": jnp.asarray(mb["obs"]),
+                    "actions": jnp.asarray(mb["actions"]),
+                    "rewards": jnp.asarray(mb["rewards"]),
+                    "mask": jnp.asarray(mb["mask"]),
+                }
+                self._key, sub = jax.random.split(self._key)
+                (p, ap, cp, self._wm_state, self._actor_state,
+                 self._critic_state, metrics) = self._update_jit(
+                    p, ap, cp, self._wm_state, self._actor_state,
+                    self._critic_state, device_mb, sub)
+            self.params, self.actor_params, self.critic_params = \
+                p, ap, cp
+            metrics = {k: float(v) for k, v in metrics.items()}
+
+        window = self._episode_rewards[-100:]
+        metrics.update({
+            "episode_reward_mean": (float(np.mean(window)) if window
+                                    else float("nan")),
+            "episodes_total": len(self._episode_rewards),
+        })
+        return metrics
+
+    def get_weights(self):
+        import jax
+        return {"wm": jax.tree.map(np.asarray, self.params),
+                "actor": jax.tree.map(np.asarray, self.actor_params),
+                "critic": jax.tree.map(np.asarray, self.critic_params)}
+
+    def set_weights(self, weights) -> None:
+        import jax
+        import jax.numpy as jnp
+        self.params = jax.tree.map(jnp.asarray, weights["wm"])
+        self.actor_params = jax.tree.map(jnp.asarray, weights["actor"])
+        self.critic_params = jax.tree.map(jnp.asarray,
+                                          weights["critic"])
+
+    def stop(self) -> None:
+        close = getattr(self._env, "close", None)
+        if callable(close):
+            close()

@@ -21,6 +21,7 @@ def get_algorithm_class(name: str) -> Type:
     from ray_tpu.rllib.algorithms.ddpg import DDPG
     from ray_tpu.rllib.algorithms.ddppo import DDPPO
     from ray_tpu.rllib.algorithms.dqn import DQN
+    from ray_tpu.rllib.algorithms.dreamer import Dreamer
     from ray_tpu.rllib.algorithms.dt import DT
     from ray_tpu.rllib.algorithms.es import ES
     from ray_tpu.rllib.algorithms.impala import Impala
@@ -45,7 +46,7 @@ def get_algorithm_class(name: str) -> Type:
              "R2D2": R2D2, "QMIX": QMix, "MADDPG": MADDPG,
              "SLATEQ": SlateQ,
              "ES": ES, "ARS": ARS, "CQL": CQL, "DT": DT, "CRR": CRR,
-             "DDPPO": DDPPO, "ALPHAZERO": AlphaZero,
+             "DDPPO": DDPPO, "ALPHAZERO": AlphaZero, "DREAMER": Dreamer,
              "BANDITLINUCB": BanditLinUCB, "BANDITLINTS": BanditLinTS}
     try:
         return table[name.upper()]

@@ -185,7 +185,11 @@ class SequenceReplayBuffer:
                  np.zeros(pad, np.float32)]))
         stacked = {k: np.stack(v) for k, v in out.items()}
         stacked["mask"] = np.stack(masks)
-        # Window-start recurrent state (stored pre-step by the policy).
-        stacked["h0"] = stacked.pop("lstm_h")[:, 0]
-        stacked["c0"] = stacked.pop("lstm_c")[:, 0]
+        # Window-start recurrent state (stored pre-step by the policy;
+        # absent for consumers with no per-step recurrent columns, e.g.
+        # Dreamer's world-model sequences).
+        if "lstm_h" in stacked:
+            stacked["h0"] = stacked.pop("lstm_h")[:, 0]
+        if "lstm_c" in stacked:
+            stacked["c0"] = stacked.pop("lstm_c")[:, 0]
         return stacked
