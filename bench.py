@@ -160,7 +160,8 @@ from ray_tpu.rllib import PPOConfig
 from ray_tpu.rllib.env.atari import make_synthetic_atari
 config = (PPOConfig()
           .environment(make_synthetic_atari, env_config={"drops": 8})
-          .rollouts(num_rollout_workers=4, rollout_fragment_length=256)
+          .rollouts(num_rollout_workers=4, rollout_fragment_length=256,
+                    num_envs_per_worker=8)
           .training(lr=3e-4, train_batch_size=BATCH, num_sgd_iter=4,
                     sgd_minibatch_size=256,
                     model={"conv_filters": [[16, 8, 4], [32, 4, 2],

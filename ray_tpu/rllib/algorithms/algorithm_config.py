@@ -17,6 +17,7 @@ class AlgorithmConfig:
         self.env_config: Dict[str, Any] = {}
         # rollouts
         self.num_rollout_workers: int = 2
+        self.num_envs_per_worker = 1
         self.rollout_fragment_length: int = 256
         self.num_cpus_per_worker: float = 1.0
         # training
@@ -60,11 +61,14 @@ class AlgorithmConfig:
 
     def rollouts(self, *, num_rollout_workers: Optional[int] = None,
                  rollout_fragment_length: Optional[int] = None,
+                 num_envs_per_worker: Optional[int] = None,
                  **_ignored) -> "AlgorithmConfig":
         if num_rollout_workers is not None:
             self.num_rollout_workers = num_rollout_workers
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if num_envs_per_worker is not None:
+            self.num_envs_per_worker = num_envs_per_worker
         return self
 
     env_runners = rollouts  # new-stack alias
@@ -190,4 +194,6 @@ class AlgorithmConfig:
             "observation_filter": self.observation_filter,
             "clip_actions": self.clip_actions,
             "output": self.output,
+            "num_envs_per_worker": getattr(
+                self, "num_envs_per_worker", 1),
         }

@@ -54,6 +54,36 @@ class RolloutWorker:
         self._key = jax.random.PRNGKey(1000 + seed + worker_index)
         self._obs, _ = self.env.reset(seed=seed + worker_index)
         self._eps_id = worker_index * 1_000_000
+        # Vectorized sampling (reference: num_envs_per_worker) batches
+        # policy inference over N sibling envs — one forward pass per
+        # step for ALL envs, the sampler-throughput lever. Recurrent
+        # policies (per-episode hidden state rows) stay on the serial
+        # path.
+        self.num_envs = max(int(policy_config.get(
+            "num_envs_per_worker", 1) or 1), 1)
+        # hasattr, not truthiness: recurrent policies expose state_rows
+        # from construction but only fill it after the first step.
+        if self.num_envs > 1 and not hasattr(self.policy, "state_rows"):
+            from ray_tpu.rllib.connectors import get_connectors as _gc
+            self._vec_envs = [self.env]
+            self._vec_obs_conn = [self.obs_connectors]
+            for i in range(1, self.num_envs):
+                env_i = _make_env(env_creator,
+                                  policy_config.get("env_config"))
+                obs_conn_i, _ = _gc(policy_config, obs_space,
+                                    env_i.action_space)
+                self._vec_envs.append(env_i)
+                self._vec_obs_conn.append(obs_conn_i)
+            self._vec_obs = [self._obs] + [
+                e.reset(seed=seed + worker_index + 7919 * i)[0]
+                for i, e in enumerate(self._vec_envs) if i > 0]
+            self._vec_eps = [self._eps_id + i
+                             for i in range(self.num_envs)]
+            self._eps_id += self.num_envs
+            self._vec_ep_reward = [0.0] * self.num_envs
+            self._vec_ep_len = [0] * self.num_envs
+        else:
+            self.num_envs = 1
         self._episode_reward = 0.0
         self._episode_len = 0
         self.completed_rewards: list = []
@@ -90,6 +120,8 @@ class RolloutWorker:
         return self.policy.get_weights()
 
     def sample(self, num_steps: int) -> SampleBatch:
+        if self.num_envs > 1:
+            return self._sample_vectorized(num_steps)
         import jax
         rows = {k: [] for k in (
             SampleBatch.OBS, SampleBatch.NEXT_OBS, SampleBatch.ACTIONS,
@@ -143,9 +175,84 @@ class RolloutWorker:
             self._writer.write(batch)
         return batch
 
-    def _postprocess(self, batch: SampleBatch) -> SampleBatch:
+    def _sample_vectorized(self, num_steps: int) -> SampleBatch:
+        """Round-robin N envs with BATCHED policy inference; emits
+        ceil(num_steps / N) steps per env. Each env keeps its own
+        stateful obs-connector pipeline, episode ids, and GAE bootstrap
+        (postprocessed per env so value targets never cross envs)."""
+        import jax
+        import numpy as np
+        steps_per_env = max((num_steps + self.num_envs - 1) //
+                            self.num_envs, 1)
+        N = self.num_envs
+        per_env_rows = [
+            {k: [] for k in (
+                SampleBatch.OBS, SampleBatch.NEXT_OBS,
+                SampleBatch.ACTIONS, SampleBatch.REWARDS,
+                SampleBatch.TERMINATEDS, SampleBatch.TRUNCATEDS,
+                SampleBatch.ACTION_LOGP, SampleBatch.VF_PREDS,
+                SampleBatch.EPS_ID)}
+            for _ in range(N)]
+        for _ in range(steps_per_env):
+            obs_batch = np.stack([
+                np.asarray(self._vec_obs_conn[i](self._vec_obs[i]))
+                for i in range(N)])
+            self._key, sub = jax.random.split(self._key)
+            actions, logps, values = self.policy.compute_actions(
+                obs_batch, sub)
+            for i in range(N):
+                act = actions[i]
+                act_env = (int(act) if self.policy.discrete
+                           else np.asarray(act))
+                if self.action_connectors.connectors:
+                    act_env = self.action_connectors(act_env)
+                nxt, reward, terminated, truncated, _ =                     self._vec_envs[i].step(act_env)
+                rows = per_env_rows[i]
+                rows[SampleBatch.OBS].append(obs_batch[i])
+                rows[SampleBatch.NEXT_OBS].append(np.asarray(
+                    self._vec_obs_conn[i].apply_readonly(nxt)))
+                rows[SampleBatch.ACTIONS].append(act)
+                rows[SampleBatch.REWARDS].append(np.float32(reward))
+                rows[SampleBatch.TERMINATEDS].append(
+                    np.float32(terminated))
+                rows[SampleBatch.TRUNCATEDS].append(
+                    np.float32(truncated))
+                rows[SampleBatch.ACTION_LOGP].append(logps[i])
+                rows[SampleBatch.VF_PREDS].append(values[i])
+                rows[SampleBatch.EPS_ID].append(self._vec_eps[i])
+                self._vec_ep_reward[i] += float(reward)
+                self._vec_ep_len[i] += 1
+                if terminated or truncated:
+                    self.completed_rewards.append(
+                        self._vec_ep_reward[i])
+                    self.completed_lengths.append(self._vec_ep_len[i])
+                    self._vec_ep_reward[i] = 0.0
+                    self._vec_ep_len[i] = 0
+                    self._vec_eps[i] = self._eps_id
+                    self._eps_id += 1
+                    self._vec_obs[i], _ = self._vec_envs[i].reset()
+                else:
+                    self._vec_obs[i] = nxt
+        batches = []
+        for i in range(N):
+            batch = SampleBatch(per_env_rows[i])
+            batches.append(self._postprocess(
+                batch, bootstrap_obs_raw=self._vec_obs[i],
+                obs_conn=self._vec_obs_conn[i]))
+        out = SampleBatch.concat_samples(batches)
+        if self._writer is not None:
+            self._writer.write(out)
+        return out
+
+    def _postprocess(self, batch: SampleBatch,
+                     bootstrap_obs_raw=None,
+                     obs_conn=None) -> SampleBatch:
         if not getattr(self.policy, "needs_gae", True):
             return batch
+        if bootstrap_obs_raw is None:
+            bootstrap_obs_raw = self._obs
+        if obs_conn is None:
+            obs_conn = self.obs_connectors
         # GAE per episode fragment; bootstrap truncated/continuing tails.
         fragments = []
         for frag in batch.split_by_episode():
@@ -154,7 +261,7 @@ class RolloutWorker:
                 last_value = 0.0
             else:
                 bootstrap_obs = np.asarray(
-                    self.obs_connectors.apply_readonly(self._obs))
+                    obs_conn.apply_readonly(bootstrap_obs_raw))
                 last_value = float(self.policy.compute_values(
                     bootstrap_obs[None])[0])
             fragments.append(compute_gae(frag, self.gamma, self.lam,
