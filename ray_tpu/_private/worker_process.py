@@ -444,8 +444,20 @@ class _WorkerMain:
             if renv:
                 from ray_tpu._private import runtime_env as _renv
                 _renv.setup(renv)
-                with _renv.applied(renv):
+                if mode == "actor_init":
+                    # A dedicated actor worker IS the actor's process:
+                    # its env_vars persist for the process lifetime
+                    # (reference actor runtime_env semantics), so
+                    # threads the actor spawns (e.g. Train loops
+                    # reading RAY_TPU_JAX_PLATFORM) and later method
+                    # calls all see them — the scoped form here lost a
+                    # race that deadlocked multi-controller training.
+                    import os as _os
+                    _os.environ.update(renv.get("env_vars") or {})
                     result = invoke()
+                else:
+                    with _renv.applied(renv):
+                        result = invoke()
             else:
                 result = invoke()
         finally:
@@ -493,6 +505,17 @@ class _WorkerMain:
 
 def _main() -> None:
     import argparse
+    import faulthandler
+
+    # Stack dumps on demand: `kill -USR1 <worker>` prints every thread
+    # to stderr (inherited from the spawning process) — the diagnostic
+    # channel for wedged workers, mirroring the reference's py-spy-based
+    # dashboard stack dumps.
+    faulthandler.enable()
+    try:
+        faulthandler.register(signal.SIGUSR1)
+    except (AttributeError, ValueError):  # non-main thread / platform
+        pass
 
     # Worker processes NEVER run TPU tasks (the chip is single-process;
     # runtime._uses_worker_process and the daemon's routing both keep

@@ -61,8 +61,20 @@ class TrainWorker:
             dataset_shards=dataset_shards,
         )
         sess = self.session
+        # The actor's runtime_env env_vars are APPLIED around this
+        # method call only — but the train loop runs in a thread that
+        # outlives it and reads env (e.g. RAY_TPU_JAX_PLATFORM in
+        # distributed_init_if_needed). Snapshot now, re-assert in the
+        # thread: losing this race left multi-controller workers
+        # initializing jax on the wrong platform/device count, where
+        # the first cross-process collective deadlocks.
+        import os
+        env_snapshot = dict(os.environ)
 
         def _run():
+            for k, v in env_snapshot.items():
+                if os.environ.get(k) != v:
+                    os.environ[k] = v
             air_session._set_session(sess)
             try:
                 try:
