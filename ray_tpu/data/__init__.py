@@ -21,8 +21,9 @@ from ray_tpu.data.preprocessors import (BatchMapper, Chain, Concatenator,
 from ray_tpu.data.read_api import (from_arrow, from_items, from_jax,
                                    from_numpy, from_pandas, range,
                                    range_tensor, read_binary_files, read_csv,
-                                   read_datasource, read_json, read_numpy,
-                                   read_parquet, read_text)
+                                   read_datasource, read_images, read_json,
+                                   read_numpy, read_parquet, read_text,
+                                   read_tfrecords)
 
 __all__ = [
     "ActorPoolStrategy", "AggregateFn", "BatchMapper", "Block",
@@ -31,7 +32,7 @@ __all__ = [
     "Mean", "Min", "MinMaxScaler", "OneHotEncoder", "Preprocessor",
     "SimpleImputer", "StandardScaler", "Std", "Sum", "TaskPoolStrategy",
     "aggregate", "from_arrow", "from_items", "from_jax", "from_numpy",
-    "from_pandas", "range", "range_tensor", "read_binary_files", "read_csv",
+    "from_pandas", "range", "range_tensor", "read_binary_files", "read_csv", "read_images", "read_tfrecords",
     "read_datasource", "read_json", "read_numpy", "read_parquet",
     "read_text",
 ]

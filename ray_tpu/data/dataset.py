@@ -587,6 +587,29 @@ class Dataset:
     def write_json(self, path: str, **kwargs) -> None:
         self._write_files(path, "json", **kwargs)
 
+    def write_tfrecords(self, path: str, **kwargs) -> None:
+        """One .tfrecord file of tf.train.Example records per block
+        (reference: Dataset.write_tfrecords) — no TF dependency
+        (data/tfrecord.py); block writes run as parallel tasks like the
+        other write formats."""
+        import os
+        os.makedirs(path, exist_ok=True)
+
+        def _write(block, idx, _path=path):
+            import os
+
+            from ray_tpu.data.tfrecord import (encode_example,
+                                               write_tfrecord_file)
+            acc = BlockAccessor.for_block(block)
+            records = [encode_example(row) for row in acc.iter_rows()]
+            fname = os.path.join(_path, f"part-{idx:05d}.tfrecord")
+            write_tfrecord_file(fname, records)
+            return fname
+
+        task = ray_tpu.remote(_write)
+        blocks, _ = self._execute()
+        ray_tpu.get([task.remote(b, i) for i, b in enumerate(blocks)])
+
     def write_numpy(self, path: str, column: str = "data", **kwargs) -> None:
         self._write_files(path, "numpy", column=column, **kwargs)
 

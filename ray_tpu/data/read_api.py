@@ -211,6 +211,64 @@ def read_binary_files(paths, *, parallelism: int = DEFAULT_PARALLELISM,
     return _read_files(paths, reader, parallelism=parallelism)
 
 
+def read_images(paths, *, parallelism: int = DEFAULT_PARALLELISM,
+                size: Optional[tuple] = None, mode: Optional[str] = None,
+                include_paths: bool = False, **kwargs) -> Dataset:
+    """Decode image files into a tensor column (reference:
+    data/datasource/image_datasource.py). ``size=(h, w)`` resizes,
+    ``mode`` converts (e.g. "RGB", "L"); images must share one shape
+    per file-group (resize or group accordingly)."""
+    def reader(f, _size=size, _mode=mode, _inc=include_paths):
+        from PIL import Image
+
+        from ray_tpu.data.block import _numpy_dict_to_arrow
+        img = Image.open(f)
+        if _mode:
+            img = img.convert(_mode)
+        if _size:
+            img = img.resize((_size[1], _size[0]))
+        arr = np.asarray(img)
+        cols = {"image": arr[None]}
+        if _inc:
+            cols["path"] = np.asarray([f])
+        return _numpy_dict_to_arrow(cols)
+
+    return _read_files(
+        paths, reader, parallelism=parallelism,
+        suffix=(".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp"))
+
+
+def read_tfrecords(paths, *, parallelism: int = DEFAULT_PARALLELISM,
+                   **kwargs) -> Dataset:
+    """Read TFRecord files of tf.train.Example protos WITHOUT a
+    TensorFlow dependency (reference: tfrecords_datasource.py imports
+    tf; ray_tpu/data/tfrecord.py speaks the wire formats directly).
+    Scalar features unwrap to scalars; multi-value features stay
+    lists."""
+    def reader(f):
+        import pyarrow as pa
+
+        from ray_tpu.data.tfrecord import (decode_example,
+                                           read_tfrecord_file)
+        rows = [decode_example(rec) for rec in read_tfrecord_file(f)]
+        cols: Dict[str, Any] = {}
+        names: List[str] = []
+        for row in rows:
+            for name in row:
+                if name not in cols:
+                    cols[name] = []
+                    names.append(name)
+        for row in rows:
+            for name in names:
+                vals = row.get(name, [])
+                cols[name].append(
+                    vals[0] if len(vals) == 1 else list(vals))
+        return pa.table(cols)
+
+    return _read_files(paths, reader, parallelism=parallelism,
+                       suffix=".tfrecord")
+
+
 def read_datasource(datasource, *, parallelism: int = DEFAULT_PARALLELISM,
                     **read_args) -> Dataset:
     """Custom datasource entry point (reference: read_api.py:237). A
