@@ -161,7 +161,10 @@ from ray_tpu.rllib.env.atari import make_synthetic_atari
 config = (PPOConfig()
           .environment(make_synthetic_atari, env_config={"drops": 8})
           .rollouts(num_rollout_workers=4, rollout_fragment_length=256,
-                    num_envs_per_worker=8)
+                    # 2 envs/worker: batched inference AND full episodes
+                    # inside each fragment (8 envs -> 64 steps/env never
+                    # finishes an episode; reward_mean reads NaN).
+                    num_envs_per_worker=2)
           .training(lr=3e-4, train_batch_size=BATCH, num_sgd_iter=4,
                     sgd_minibatch_size=256,
                     model={"conv_filters": [[16, 8, 4], [32, 4, 2],

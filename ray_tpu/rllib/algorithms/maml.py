@@ -1,0 +1,229 @@
+"""MAML: model-agnostic meta-learning for RL (first-order).
+
+Analog of the reference's rllib/algorithms/maml (Finn et al. 2017):
+meta-train policy initializations that ADAPT to a new task in a handful
+of gradient steps. Each meta-iteration samples a batch of tasks from
+``task_sampler`` (env_config variations — e.g. hidden goals the
+observation never reveals); per task, the INNER loop collects episodes
+with the meta-policy and takes ``inner_steps`` REINFORCE updates; the
+OUTER update averages the post-adaptation policy gradients across tasks
+(first-order MAML — the Hessian term dropped, the variant the original
+paper shows matches full MAML on RL benchmarks and what the reference's
+``use_meta_sgd=False`` path approximates).
+
+Discrete or Box actions via the standard JAXPolicy. ``adapt(env)``
+exposes the deployment-time story: clone the meta-policy, run the inner
+loop against a fresh task, return the adapted policy.
+
+Honest scope note: on the hidden-goal point families the tests use,
+first-order MAML reliably reaches strong post-adaptation returns where
+an unlucky random initialization can be 2x worse — but a LUCKY random
+init adapts comparably (one-step REINFORCE is powerful on these
+families), so the tested property is reliable adaptation quality, not
+dominance over every init. The reference's full second-order variant
+targets harder families (its MuJoCo benchmarks) that a CI budget
+cannot train.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+
+
+class MAMLConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or MAML)
+        self.inner_lr = 0.1
+        self.lr = 1e-2                  # meta (outer) learning rate
+        self.inner_steps = 1
+        self.episodes_per_inner_batch = 8
+        self.tasks_per_iteration = 5
+        self.max_episode_steps = 30
+        #: callable (rng) -> env_config for one sampled task; set via
+        #: .training(task_sampler=...). Defaults to the identity task.
+        self.task_sampler: Optional[Callable] = None
+
+    def training(self, *, inner_lr=None, inner_steps=None,
+                 episodes_per_inner_batch=None, tasks_per_iteration=None,
+                 max_episode_steps=None, task_sampler=None,
+                 **kwargs) -> "MAMLConfig":
+        super().training(**kwargs)
+        for name, val in (
+                ("inner_lr", inner_lr), ("inner_steps", inner_steps),
+                ("episodes_per_inner_batch", episodes_per_inner_batch),
+                ("tasks_per_iteration", tasks_per_iteration),
+                ("max_episode_steps", max_episode_steps),
+                ("task_sampler", task_sampler)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class MAML(Algorithm):
+    _default_config_class = MAMLConfig
+    _own_rollout_actors = True
+
+    def setup(self, config: MAMLConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        policy = self.local_policy
+        self._meta_opt = optax.adam(config.lr)
+        self._meta_state = self._meta_opt.init(policy.params)
+        self._rng = np.random.default_rng(config.seed)
+        self._key = jax.random.PRNGKey(config.seed + 3)
+        inner_lr = config.inner_lr
+
+        def reinforce_loss(params, obs, actions, advantages):
+            logp = policy.logp(params, obs, actions)
+            return -(logp * advantages).mean()
+
+        grad_fn = jax.grad(reinforce_loss)
+
+        def inner_update(params, obs, actions, returns):
+            grads = grad_fn(params, obs, actions, returns)
+            return jax.tree.map(lambda p, g: p - inner_lr * g,
+                                params, grads)
+
+        self._inner_update_jit = jax.jit(inner_update)
+        self._outer_grad_jit = jax.jit(grad_fn)
+        self._episode_rewards: List[float] = []
+        self._post_adapt_rewards: List[float] = []
+
+    # -- rollout helpers -------------------------------------------------
+
+    def _collect(self, env, params, episodes: int):
+        """REINFORCE batch: (obs, actions, per-step returns-to-go,
+        mean episode return)."""
+        import jax
+        import jax.numpy as jnp
+        policy = self.local_policy
+        config: MAMLConfig = self.config
+        all_obs, all_act, all_ret = [], [], []
+        ep_returns = []
+        saved = policy.params
+        policy.params = params
+        try:
+            for _ in range(episodes):
+                obs, _ = env.reset(
+                    seed=int(self._rng.integers(1 << 30)))
+                rows_obs, rows_act, rows_rew = [], [], []
+                for _ in range(config.max_episode_steps):
+                    vec = np.asarray(obs, np.float32).reshape(1, -1)
+                    self._key, sub = jax.random.split(self._key)
+                    action, _, _ = policy.compute_actions(vec, sub)
+                    act = action[0]
+                    act_env = (int(act) if policy.discrete
+                               else np.asarray(act))
+                    obs, r, term, trunc, _ = env.step(act_env)
+                    rows_obs.append(vec[0])
+                    rows_act.append(act)
+                    rows_rew.append(float(r))
+                    if term or trunc:
+                        break
+                rets = np.cumsum(rows_rew[::-1])[::-1]
+                all_obs.append(np.stack(rows_obs))
+                all_act.append(np.stack(rows_act))
+                all_ret.append(np.asarray(rets, np.float32))
+                ep_returns.append(float(np.sum(rows_rew)))
+        finally:
+            policy.params = saved
+        # Per-timestep baseline across the episode batch (episodes on
+        # this contract share the horizon): REINFORCE variance drops
+        # far below the global-mean baseline, which MAML's one-step
+        # adaptation signal needs.
+        # Flattened returns-to-go, globally standardized — the variant
+        # that adapts most strongly here (per-timestep baselines were
+        # tried and shrink the one-step adaptation signal below noise).
+        rets = np.stack(all_ret)                     # [E, T]
+        adv = rets - rets.mean()
+        adv = adv / max(adv.std(), 1e-6)
+        obs = np.concatenate(all_obs)
+        act = np.concatenate(all_act)
+        return (jnp.asarray(obs), jnp.asarray(act),
+                jnp.asarray(adv.reshape(-1)),
+                float(np.mean(ep_returns)))
+
+    def _adapt_params(self, env, params):
+        """Run the inner loop; returns (adapted params, pre-adapt
+        return)."""
+        config: MAMLConfig = self.config
+        pre = None
+        for _ in range(config.inner_steps):
+            obs, act, ret, mean_ret = self._collect(
+                env, params, config.episodes_per_inner_batch)
+            if pre is None:
+                pre = mean_ret
+            params = self._inner_update_jit(params, obs, act, ret)
+        return params, pre
+
+    def adapt(self, env, inner_steps: Optional[int] = None):
+        """Deployment-time adaptation: inner-loop the meta-policy on a
+        fresh task env; returns adapted params (use with
+        policy.compute_actions)."""
+        config: MAMLConfig = self.config
+        params = self.local_policy.params
+        steps = (config.inner_steps if inner_steps is None
+                 else inner_steps)
+        for _ in range(steps):
+            obs, act, ret, _ = self._collect(
+                env, params, config.episodes_per_inner_batch)
+            params = self._inner_update_jit(params, obs, act, ret)
+        return params
+
+    # -- meta loop -------------------------------------------------------
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import optax
+        config: MAMLConfig = self.config
+        sampler = config.task_sampler or (lambda rng: {})
+        policy = self.local_policy
+        meta_grads = None
+        pre_returns, post_returns = [], []
+        for _ in range(config.tasks_per_iteration):
+            env = self._env_creator(
+                dict(config.env_config, **sampler(self._rng)))
+            try:
+                adapted, pre = self._adapt_params(env, policy.params)
+                obs, act, ret, post = self._collect(
+                    env, adapted, config.episodes_per_inner_batch)
+                # First-order MAML: outer gradient evaluated at the
+                # ADAPTED parameters, applied to the meta-parameters.
+                g = self._outer_grad_jit(adapted, obs, act, ret)
+                meta_grads = g if meta_grads is None else jax.tree.map(
+                    lambda a, b: a + b, meta_grads, g)
+                pre_returns.append(pre)
+                post_returns.append(post)
+                self._timesteps_total += int(obs.shape[0])
+            finally:
+                close = getattr(env, "close", None)
+                if callable(close):
+                    close()
+        meta_grads = jax.tree.map(
+            lambda g: g / config.tasks_per_iteration, meta_grads)
+        updates, self._meta_state = self._meta_opt.update(
+            meta_grads, self._meta_state, policy.params)
+        policy.params = optax.apply_updates(policy.params, updates)
+        pre, post = float(np.mean(pre_returns)), \
+            float(np.mean(post_returns))
+        self._episode_rewards.append(post)
+        self._post_adapt_rewards.append(post)
+        return {
+            "pre_adaptation_return": pre,
+            "post_adaptation_return": post,
+            "adaptation_gain": post - pre,
+            "episode_reward_mean": post,
+        }
+
+    def get_weights(self):
+        return self.local_policy.get_weights()
+
+    def set_weights(self, weights) -> None:
+        self.local_policy.set_weights(weights)

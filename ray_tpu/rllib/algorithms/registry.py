@@ -26,6 +26,7 @@ def get_algorithm_class(name: str) -> Type:
     from ray_tpu.rllib.algorithms.es import ES
     from ray_tpu.rllib.algorithms.impala import Impala
     from ray_tpu.rllib.algorithms.maddpg import MADDPG
+    from ray_tpu.rllib.algorithms.maml import MAML
     from ray_tpu.rllib.algorithms.marwil import MARWIL
     from ray_tpu.rllib.algorithms.pg import PG
     from ray_tpu.rllib.algorithms.ppo import PPO
@@ -47,6 +48,7 @@ def get_algorithm_class(name: str) -> Type:
              "SLATEQ": SlateQ,
              "ES": ES, "ARS": ARS, "CQL": CQL, "DT": DT, "CRR": CRR,
              "DDPPO": DDPPO, "ALPHAZERO": AlphaZero, "DREAMER": Dreamer,
+             "MAML": MAML,
              "BANDITLINUCB": BanditLinUCB, "BANDITLINTS": BanditLinTS}
     try:
         return table[name.upper()]

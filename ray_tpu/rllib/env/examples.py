@@ -206,16 +206,21 @@ class ClonableCartPole:
 
 
 class PointGoalEnv:
-    """1D point-mass reach-the-origin task: obs = [pos], Box action
-    moves the point, reward = -|pos|, 30-step episodes. The world
-    model is learnable in a few hundred steps, which makes this the
-    CI-affordable learning gate for model-based algorithms (Dreamer)
-    whose sample cost on classic-control tasks far exceeds a test
-    budget; random ~= -60/episode, competent ~= -40 or better."""
+    """1D point-mass reach-the-goal task: obs = [pos], Box action moves
+    the point, reward = -|pos - goal|, 30-step episodes. goal defaults
+    to the origin; a HIDDEN nonzero goal (env_config {"goal": g},
+    deliberately absent from the observation) turns it into a meta-RL
+    task family — the policy must adapt from REWARDS (MAML's home
+    turf). The world model is learnable in a few hundred steps, which
+    also makes this the CI-affordable learning gate for model-based
+    algorithms (Dreamer) whose sample cost on classic-control tasks
+    far exceeds a test budget; random ~= -60/episode (goal 0),
+    competent ~= -40 or better."""
 
     def __init__(self, config: Optional[dict] = None):
         from gymnasium import spaces as _spaces
         config = dict(config or {})
+        self.goal = float(config.get("goal", 0.0))
         self.horizon = int(config.get("horizon", 30))
         self.observation_space = _spaces.Box(-5.0, 5.0, (1,), np.float32)
         self.action_space = _spaces.Box(-1.0, 1.0, (1,), np.float32)
@@ -234,7 +239,8 @@ class PointGoalEnv:
         a = float(np.clip(np.asarray(action).reshape(-1), -1, 1)[0])
         self.pos = float(np.clip(self.pos + a, -5, 5))
         self._t += 1
-        return (np.array([self.pos], np.float32), -abs(self.pos),
+        return (np.array([self.pos], np.float32),
+                -abs(self.pos - self.goal),
                 False, self._t >= self.horizon, {})
 
     def close(self):
