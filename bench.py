@@ -148,12 +148,21 @@ def bench_data_shuffle() -> dict:
 
 
 RLLIB_BENCH_SCRIPT = """
-import json, time
+import json, os, time
 BATCH = 2048
+os.environ.pop("XLA_FLAGS", None)
 import jax
-jax.config.update("jax_platforms", "cpu")  # batch-1 rollout inference
-# over the remote-TPU tunnel is latency-bound; RL rollouts are a CPU
-# workload (the reference samples on CPU workers too).
+# Rollouts stay on CPU (batch-small inference over the remote-TPU
+# tunnel is latency-bound; the reference samples on CPU workers too)
+# while the fused PPO learner jits onto the chip when one is reachable
+# — the reference's CPU-rollout/GPU-learner split as two jax backends.
+learner_backend = None
+try:
+    jax.config.update("jax_platforms", "cpu,axon")
+    jax.devices("axon")
+    learner_backend = "axon"
+except Exception:
+    jax.config.update("jax_platforms", "cpu")
 import ray_tpu
 ray_tpu.init(num_cpus=8)
 from ray_tpu.rllib import PPOConfig
@@ -166,15 +175,16 @@ config = (PPOConfig()
                     # finishes an episode; reward_mean reads NaN).
                     num_envs_per_worker=2)
           .training(lr=3e-4, train_batch_size=BATCH, num_sgd_iter=4,
-                    sgd_minibatch_size=256,
+                    sgd_minibatch_size=256, learner_backend=learner_backend,
                     model={"conv_filters": [[16, 8, 4], [32, 4, 2],
                                             [64, 3, 2]],
                            "post_fcnet_dim": 256})
           .debugging(seed=0))
 algo = config.build()
-algo.train()  # warmup: jit compile of policy fwd/bwd
+algo.train()  # warmup 1: policy fwd/bwd + learner program compiles
+algo.train()  # warmup 2: any lazily-compiled tail (chip-learner path)
 t0 = time.perf_counter()
-iters = 2
+iters = 3
 for _ in range(iters):
     res = algo.train()
 dt = time.perf_counter() - t0
@@ -182,6 +192,7 @@ print(json.dumps({
     "rllib_env_steps_per_sec": round(iters * BATCH / dt, 1),
     "rllib_reward_mean": round(
         float(res.get("episode_reward_mean", float("nan"))), 2),
+    "rllib_learner_backend": learner_backend or "cpu",
 }))
 algo.stop()
 ray_tpu.shutdown()

@@ -30,11 +30,19 @@ class PPOConfig(AlgorithmConfig):
         self.kl_target = 0.02
         self.lambda_ = 0.95
         self.lr = 3e-4
+        #: jax backend for the learner's fused SGD program (e.g. "tpu"
+        #: / "axon") while rollouts stay on the process default (cpu) —
+        #: the reference's CPU-rollout/GPU-learner split, expressed as
+        #: two jax backends in one process. None = process default.
+        self.learner_backend = None
 
     def training(self, *, clip_param=None, num_sgd_iter=None,
                  sgd_minibatch_size=None, vf_loss_coeff=None,
-                 entropy_coeff=None, **kwargs) -> "PPOConfig":
+                 entropy_coeff=None, learner_backend=None,
+                 **kwargs) -> "PPOConfig":
         super().training(**kwargs)
+        if learner_backend is not None:
+            self.learner_backend = learner_backend
         if clip_param is not None:
             self.clip_param = clip_param
         if num_sgd_iter is not None:
@@ -102,7 +110,39 @@ class PPO(Algorithm):
             metrics["total_loss"] = loss
             return params, opt_state, metrics
 
-        return jax.jit(update), opt_state
+        backend = getattr(config, "learner_backend", None)
+        if not backend:
+            # Process-default (CPU) learner: per-minibatch dispatch.
+            # XLA:CPU serializes intra-op threading inside while/scan
+            # bodies, so the fused program below is a ~8x PESSIMIZATION
+            # there — fusion pays only on an accelerator backend.
+            return jax.jit(update), opt_state
+
+        def run_epochs(params, opt_state, batch, perm):
+            """The WHOLE minibatch-SGD schedule as one program: scan
+            over [epochs*minibatches] permutation rows. One dispatch
+            and one host->device batch transfer per iteration instead
+            of one per minibatch — rollouts stay on host CPUs while
+            this jits onto the chip (the reference's CPU-rollout/
+            GPU-learner split as two jax backends in one process)."""
+            def one(carry, idx):
+                params, opt_state = carry
+                mb = jax.tree.map(lambda a: a[idx], batch)
+                params, opt_state, metrics = update(params, opt_state,
+                                                    mb)
+                return (params, opt_state), metrics
+
+            (params, opt_state), metrics = jax.lax.scan(
+                one, (params, opt_state), perm)
+            last = jax.tree.map(lambda m: m[-1], metrics)
+            # Params ALSO return as one flat vector: the host pulls one
+            # array instead of one round-trip per leaf (the tunnel
+            # charges per-transfer latency, not just bandwidth).
+            flat = jnp.concatenate(
+                [jnp.ravel(x) for x in jax.tree.leaves(params)])
+            return flat, opt_state, last
+
+        return jax.jit(run_epochs, backend=backend), opt_state
 
     def setup(self, config: PPOConfig) -> None:
         if self.is_multi_agent:
@@ -118,29 +158,85 @@ class PPO(Algorithm):
     def _sgd(self, policy, update_jit, opt_state, batch: SampleBatch,
              config: PPOConfig) -> tuple:
         """Minibatch-SGD a policy on its (GAE-complete) batch; returns
-        (opt_state, metrics)."""
+        (opt_state, metrics). With learner_backend set, runs the fused
+        run_epochs program on that device; otherwise per-minibatch
+        dispatch on the process default."""
+        import jax
         import jax.numpy as jnp
         adv = batch[SampleBatch.ADVANTAGES]
         adv = (adv - adv.mean()) / max(adv.std(), 1e-6)
-        sb = SampleBatch({
-            "obs": batch[SampleBatch.OBS].astype(np.float32),
-            "actions": batch[SampleBatch.ACTIONS],
-            "old_logp": batch[SampleBatch.ACTION_LOGP].astype(np.float32),
-            "advantages": adv.astype(np.float32),
-            "value_targets":
-                batch[SampleBatch.VALUE_TARGETS].astype(np.float32),
-        })
+        backend = getattr(config, "learner_backend", None)
+        if not backend:
+            sb = SampleBatch({
+                "obs": batch[SampleBatch.OBS].astype(np.float32),
+                "actions": batch[SampleBatch.ACTIONS],
+                "old_logp":
+                    batch[SampleBatch.ACTION_LOGP].astype(np.float32),
+                "advantages": adv.astype(np.float32),
+                "value_targets":
+                    batch[SampleBatch.VALUE_TARGETS].astype(np.float32),
+            })
+            params = policy.params
+            last_metrics: Dict[str, Any] = {}
+            mb_size = min(config.sgd_minibatch_size, len(sb))
+            for epoch in range(config.num_sgd_iter):
+                for mb in sb.minibatches(mb_size, seed=epoch):
+                    device_mb = {k: jnp.asarray(v)
+                                 for k, v in mb.items()}
+                    params, opt_state, metrics = update_jit(
+                        params, opt_state, device_mb)
+                    last_metrics = metrics
+            policy.params = params
+            return opt_state, {k: float(v)
+                               for k, v in last_metrics.items()}
+
+        # Fused path: each epoch permutes rows and covers floor(n/mb)
+        # minibatches (the remainder rotates between epochs through the
+        # permutation, matching the reference's drop-to-multiple).
+        n = len(batch)
+        mb_size = min(config.sgd_minibatch_size, n)
+        n_mb = max(n // mb_size, 1)
+        rng = np.random.default_rng(self.iteration)
+        perm = np.stack([
+            rng.permutation(n)[:n_mb * mb_size].reshape(n_mb, mb_size)
+            for _ in range(config.num_sgd_iter)]).reshape(
+                -1, mb_size).astype(np.int32)
+        learner_dev = jax.devices(backend)[0]
+
+        def put(a):
+            # device_put from a NUMPY array streams at full tunnel
+            # bandwidth; a committed cpu-jax array first goes through a
+            # ~40x slower device-to-device path.
+            return jax.device_put(np.asarray(a), learner_dev)
+
+        device_batch = {
+            "obs": put(np.asarray(batch[SampleBatch.OBS], np.float32)),
+            "actions": put(np.asarray(batch[SampleBatch.ACTIONS])),
+            "old_logp": put(np.asarray(
+                batch[SampleBatch.ACTION_LOGP], np.float32)),
+            "advantages": put(adv.astype(np.float32)),
+            "value_targets": put(np.asarray(
+                batch[SampleBatch.VALUE_TARGETS], np.float32)),
+        }
+        import jax.tree_util as jtu
         params = policy.params
-        last_metrics: Dict[str, Any] = {}
-        mb_size = min(config.sgd_minibatch_size, len(sb))
-        for epoch in range(config.num_sgd_iter):
-            for mb in sb.minibatches(mb_size, seed=epoch):
-                device_mb = {k: jnp.asarray(v) for k, v in mb.items()}
-                params, opt_state, metrics = update_jit(
-                    params, opt_state, device_mb)
-                last_metrics = metrics
-        policy.params = params
-        return opt_state, {k: float(v) for k, v in last_metrics.items()}
+        leaves, treedef = jtu.tree_flatten(params)
+        shapes = [np.shape(x) for x in leaves]
+        params_dev = jax.device_put(params, learner_dev)
+        opt_state = jax.device_put(opt_state, learner_dev)
+        flat, opt_state, metrics = update_jit(
+            params_dev, opt_state, device_batch, put(perm))
+        # One pull, then split host-side: worker weight sync and the
+        # driver's cpu-jitted evaluation path get HOST arrays without
+        # per-leaf tunnel round-trips.
+        flat_np = np.asarray(flat)
+        out, off = [], 0
+        for shp in shapes:
+            size = int(np.prod(shp)) if shp else 1
+            out.append(flat_np[off:off + size].reshape(shp))
+            off += size
+        policy.params = jtu.tree_unflatten(treedef, out)
+        return opt_state, {k: float(v) for k, v in metrics.items()}
 
     def training_step(self) -> Dict[str, Any]:
         import ray_tpu
