@@ -1,0 +1,140 @@
+"""Instrumented event substrate: per-handler latency/queue statistics.
+
+Analog of the reference's ``common/asio/instrumented_io_context`` +
+``common/event_stats.cc``: every handler class the control plane runs —
+head completion callbacks, health sweeps, accept/handshake, dispatch —
+records queue wait and run time under its name, and the aggregate view
+(count / total / mean / max / p50 / p99) is queryable at runtime (the
+reference prints it via ``RAY_event_stats``; here it feeds the
+dashboard's ``/api/event_stats`` and ``HeadServer.event_stats()``).
+
+Recording is lock-cheap (one mutex per named handler, ring buffer of
+recent samples for percentiles) and always-on: the reference gates on a
+flag because gRPC handler counts are huge; this control plane's handler
+rate is thread-scale, where the overhead is noise.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+#: ring-buffer size per handler for percentile estimates.
+_WINDOW = 512
+
+
+class _HandlerStats:
+    __slots__ = ("count", "total_run_s", "max_run_s", "total_queue_s",
+                 "max_queue_s", "recent_run_s", "lock")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total_run_s = 0.0
+        self.max_run_s = 0.0
+        self.total_queue_s = 0.0
+        self.max_queue_s = 0.0
+        self.recent_run_s: List[float] = []
+        self.lock = threading.Lock()
+
+    def record(self, run_s: float, queue_s: float = 0.0) -> None:
+        with self.lock:
+            self.count += 1
+            self.total_run_s += run_s
+            self.max_run_s = max(self.max_run_s, run_s)
+            self.total_queue_s += queue_s
+            self.max_queue_s = max(self.max_queue_s, queue_s)
+            self.recent_run_s.append(run_s)
+            del self.recent_run_s[:-_WINDOW]
+
+    def summary(self) -> Dict[str, Any]:
+        with self.lock:
+            recent = sorted(self.recent_run_s)
+            count = self.count
+
+            def pct(p: float) -> float:
+                if not recent:
+                    return 0.0
+                idx = min(int(p * len(recent)), len(recent) - 1)
+                return recent[idx]
+
+            return {
+                "count": count,
+                "total_run_ms": round(self.total_run_s * 1e3, 3),
+                "mean_run_ms": round(
+                    self.total_run_s / count * 1e3, 3) if count else 0.0,
+                "max_run_ms": round(self.max_run_s * 1e3, 3),
+                "p50_run_ms": round(pct(0.50) * 1e3, 3),
+                "p99_run_ms": round(pct(0.99) * 1e3, 3),
+                "total_queue_ms": round(self.total_queue_s * 1e3, 3),
+                "max_queue_ms": round(self.max_queue_s * 1e3, 3),
+            }
+
+
+class EventStats:
+    """Named-handler stats registry; one global instance serves the
+    whole process (the reference's per-io_context split collapses —
+    this control plane runs on threads, not loops)."""
+
+    def __init__(self) -> None:
+        self._handlers: Dict[str, _HandlerStats] = {}
+        self._lock = threading.Lock()
+
+    def _of(self, name: str) -> _HandlerStats:
+        with self._lock:
+            st = self._handlers.get(name)
+            if st is None:
+                st = self._handlers[name] = _HandlerStats()
+            return st
+
+    def record(self, name: str, run_s: float,
+               queue_s: float = 0.0) -> None:
+        self._of(name).record(run_s, queue_s)
+
+    def timed(self, name: str):
+        """Context manager timing a handler body."""
+        return _Timed(self, name)
+
+    def wrap(self, name: str, fn: Callable,
+             queued_at: Optional[float] = None) -> Callable:
+        """Wrap a callable for deferred execution (thread pools): queue
+        wait runs from ``queued_at`` (or wrap time) to invocation."""
+        q0 = time.monotonic() if queued_at is None else queued_at
+
+        def run(*args, **kwargs):
+            start = time.monotonic()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                end = time.monotonic()
+                self.record(name, end - start, start - q0)
+
+        return run
+
+    def summary(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            names = list(self._handlers)
+        return {name: self._of(name).summary() for name in names}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._handlers.clear()
+
+
+class _Timed:
+    __slots__ = ("_stats", "_name", "_t0")
+
+    def __init__(self, stats: EventStats, name: str):
+        self._stats = stats
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._stats.record(self._name, time.monotonic() - self._t0)
+
+
+#: process-global registry (reference: the RAY_event_stats singleton).
+GLOBAL = EventStats()

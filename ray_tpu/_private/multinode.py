@@ -242,7 +242,9 @@ class NodeConnection:
         pool = self.completion_pool
         if pool is not None:
             try:
-                pool.submit(callback, reply)
+                from ray_tpu._private.event_stats import GLOBAL
+                pool.submit(GLOBAL.wrap("head.task_completion",
+                                        callback), reply)
                 return
             except RuntimeError:
                 pass  # pool shut down — run inline (teardown path)
@@ -534,8 +536,11 @@ class HeadServer:
         # A daemon that never opens its health channel gets this long
         # before it's declared unobservable (covers hang-before-connect).
         channel_grace = self._hb_period * (self._hb_threshold + 5)
+        from ray_tpu._private.event_stats import GLOBAL
         while not self._closed:
             time.sleep(self._hb_period)
+            sweep_timer = GLOBAL.timed("head.health_sweep")
+            sweep_timer.__enter__()
             current = list(self._conns.items())
             # Departed nodes (EOF path, grace kill) must not leak entries.
             alive_ids = {nid for nid, _ in current}
@@ -583,6 +588,7 @@ class HeadServer:
                             "it dead", node_id.hex()[:12],
                             misses.pop(node_id))
                         conn.close()  # → on_death → remove_node
+            sweep_timer.__exit__()
 
     def _accept_loop(self) -> None:
         while not self._closed:
@@ -600,6 +606,10 @@ class HeadServer:
                              daemon=True).start()
 
     def _handshake(self, sock: socket.socket, addr) -> None:
+        import time as _time
+
+        from ray_tpu._private.event_stats import GLOBAL
+        _t0 = _time.monotonic()
         node_id = None
         try:
             sock.settimeout(15)
@@ -653,12 +663,15 @@ class HeadServer:
                 sock.close()
             except OSError:
                 pass
+            GLOBAL.record("head.handshake_failed",
+                          _time.monotonic() - _t0)
             return
         t = threading.Thread(target=conn.recv_loop,
                              name=f"ray_tpu-node-{node_id.hex()[:8]}",
                              daemon=True)
         t.start()
         self._threads.append(t)
+        GLOBAL.record("head.handshake", _time.monotonic() - _t0)
         logger.info("Node daemon %s joined as %s with %s",
                     addr, node_id.hex()[:12], register["resources"])
 
@@ -669,6 +682,12 @@ class HeadServer:
         if conn.node_id is not None:
             self.syncer.remove_node(conn.node_id.hex())
         self.runtime.unregister_remote_node(conn.node_id)
+
+    def event_stats(self):
+        """Per-handler latency/queue summaries (reference:
+        instrumented_io_context.stats() via RAY_event_stats)."""
+        from ray_tpu._private.event_stats import GLOBAL
+        return GLOBAL.summary()
 
     def stop(self) -> None:
         self._closed = True

@@ -208,6 +208,16 @@ class WorkerProcessPool:
         self._lock = threading.Lock()
         self._available = threading.Condition(self._lock)
         self._closed = False
+        # ALL spawns go through this single long-lived thread:
+        # PR_SET_PDEATHSIG binds to the spawning THREAD, so a worker
+        # forked from an ephemeral handler thread is SIGKILLed the
+        # moment that thread exits (the daemon runs one thread per
+        # request — its first worker died right after its first task).
+        # The spawner lives until pool shutdown; its death then reaps
+        # every worker, which is exactly the orphan protection wanted.
+        import concurrent.futures
+        self._spawner = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ray_tpu-worker-spawn")
 
     def lease(self, python_exe: Optional[str] = None) -> WorkerHandle:
         """Lease a worker for the given interpreter (None = base),
@@ -252,7 +262,9 @@ class WorkerProcessPool:
                 evict.stop()
                 evict = None
                 continue  # re-enter: capacity freed
-            w = _spawn_worker(self.store_name, python_exe=python_exe)
+            w = self._spawner.submit(
+                _spawn_worker, self.store_name,
+                python_exe=python_exe).result()
             w.pool_key = key
             with self._lock:
                 if self._closed:
@@ -292,6 +304,9 @@ class WorkerProcessPool:
         for w in workers:
             if not w.dead:
                 w.stop()
+        # Last: the spawner thread's death PDEATHSIG-kills any worker
+        # that somehow escaped the stop() sweep above.
+        self._spawner.shutdown(wait=False)
 
 
 # ---------------------------------------------------------------------------

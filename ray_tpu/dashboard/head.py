@@ -20,6 +20,7 @@ Routes (all JSON unless noted):
   GET  /api/serve/applications — Serve status
   PUT  /api/serve/applications — apply declarative Serve config
   GET  /api/timeline           — chrome://tracing events
+  GET  /api/event_stats        — control-plane handler latency stats
   GET  /                       — minimal HTML index
 """
 
@@ -58,7 +59,8 @@ class DashboardHead:
             for path in ("/api/version", "/api/cluster_status",
                          "/api/v0/actors", "/api/v0/tasks",
                          "/api/v0/nodes", "/api/jobs/", "/metrics",
-                         "/api/serve/applications", "/api/timeline"))
+                         "/api/serve/applications", "/api/timeline",
+                         "/api/event_stats"))
         return web.Response(
             text=f"<html><body><h2>ray_tpu dashboard</h2><ul>{rows}</ul>"
                  "</body></html>",
@@ -106,6 +108,12 @@ class DashboardHead:
         from ray_tpu.util.metrics import export_prometheus
         return web.Response(text=export_prometheus(),
                             content_type="text/plain")
+
+    async def _event_stats(self, request):
+        """Per-handler latency/queue stats of the control plane
+        (reference: RAY_event_stats / instrumented_io_context dumps)."""
+        from ray_tpu._private.event_stats import GLOBAL
+        return self._json(GLOBAL.summary())
 
     async def _timeline(self, request):
         from ray_tpu._private.state import timeline
@@ -212,6 +220,7 @@ class DashboardHead:
         app.router.add_get("/api/v0/{resource}", self._state)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/api/timeline", self._timeline)
+        app.router.add_get("/api/event_stats", self._event_stats)
         app.router.add_get("/api/jobs/", self._jobs_list)
         app.router.add_post("/api/jobs/", self._jobs_submit)
         app.router.add_get("/api/jobs/{job_id}", self._jobs_get)

@@ -1,0 +1,85 @@
+"""Instrumented event substrate: per-handler latency/queue stats
+(reference: common/asio/instrumented_io_context + event_stats.cc,
+surfaced by RAY_event_stats)."""
+
+import json
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.event_stats import GLOBAL, EventStats
+
+
+def test_record_and_summary_percentiles():
+    st = EventStats()
+    for ms in range(1, 101):
+        st.record("h", ms / 1000.0)
+    s = st.summary()["h"]
+    assert s["count"] == 100
+    assert s["max_run_ms"] == pytest.approx(100.0)
+    assert s["mean_run_ms"] == pytest.approx(50.5)
+    assert 45.0 <= s["p50_run_ms"] <= 56.0
+    assert 95.0 <= s["p99_run_ms"] <= 100.0
+
+
+def test_wrap_measures_queue_wait():
+    st = EventStats()
+    wrapped = st.wrap("cb", lambda: time.sleep(0.02))
+    time.sleep(0.05)  # queued
+    wrapped()
+    s = st.summary()["cb"]
+    assert s["count"] == 1
+    assert s["total_run_ms"] >= 15.0
+    assert s["total_queue_ms"] >= 40.0
+
+
+def test_timed_context_manager():
+    st = EventStats()
+    with st.timed("block"):
+        time.sleep(0.01)
+    assert st.summary()["block"]["count"] == 1
+    st.reset()
+    assert st.summary() == {}
+
+
+def test_head_handlers_recorded(ray_start_regular):
+    """Remote-daemon traffic populates the head's handler stats:
+    handshakes, health sweeps, and async task completions."""
+    GLOBAL.reset()
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.multinode",
+         "--address", f"127.0.0.1:{port}", "--num-cpus", "2",
+         "--resources", json.dumps({"evt": 2})],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 20
+        while ray_tpu.cluster_resources().get("evt", 0) < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+
+        @ray_tpu.remote(resources={"evt": 1})
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get([f.remote(i) for i in range(20)]) == \
+            list(range(1, 21))
+        from ray_tpu._private.worker import global_worker
+        stats = global_worker.runtime._head_server.event_stats()
+        assert stats["head.handshake"]["count"] >= 1
+        comp = stats["head.task_completion"]
+        assert comp["count"] >= 20
+        assert comp["mean_run_ms"] >= 0.0
+        # Health sweeps tick on the configured period.
+        deadline = time.monotonic() + 10
+        while "head.health_sweep" not in \
+                global_worker.runtime._head_server.event_stats():
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
