@@ -10,7 +10,8 @@ from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      HyperBandScheduler, MedianStoppingRule,
                                      PopulationBasedTraining)
 from ray_tpu.tune.search import (BasicVariantGenerator, BayesOptSearch,
-                                 ConcurrencyLimiter, Searcher, TPESearcher,
+                                 BOHBSearcher, ConcurrencyLimiter,
+                                 Searcher, TPESearcher,
                                  TuneBOHB, choice, grid_search, loguniform,
                                  quniform, randint, sample_from, uniform)
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
@@ -60,6 +61,7 @@ __all__ = [
     "SyncerCallback",
     "TBXLoggerCallback",
     "TPESearcher",
+    "BOHBSearcher",
     "TuneBOHB",
     "TuneConfig",
     "Tuner",

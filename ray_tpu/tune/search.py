@@ -302,6 +302,60 @@ class TPESearcher(Searcher):
         self._observations.append((config, signed))
 
 
+class BOHBSearcher(TPESearcher):
+    """Native BOHB (Falkner et al. 2018): TPE-style density-ratio
+    suggestions whose model is built from observations at the LARGEST
+    budget with enough samples — pair with HyperBandScheduler, whose
+    rungs stop trials at different training_iteration budgets, exactly
+    the reference's TuneBOHB + HB pairing (tune/search/bohb/ wraps the
+    external hpbandster; this is the in-tree equivalent).
+
+    Budgets are read from the completing trial's ``training_iteration``
+    (the scheduler's rung = how long the trial was allowed to run);
+    a model over high-budget observations transfers to suggestions for
+    new (low-budget) trials, which is BOHB's core move."""
+
+    def __init__(self, n_initial_points: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, min_points_per_budget: int = 6,
+                 seed: int = 0):
+        super().__init__(n_initial_points=n_initial_points, gamma=gamma,
+                         n_candidates=n_candidates, seed=seed)
+        self.min_points_per_budget = min_points_per_budget
+        self._by_budget: Dict[int, List[tuple]] = {}
+
+    def _observe(self, config, result, error):
+        if config is None or error or not result:
+            return
+        value = result.get(self.metric)
+        if value is None:
+            return
+        signed = value if self.mode == "max" else -value
+        budget = int(result.get("training_iteration", 1) or 1)
+        self._by_budget.setdefault(budget, []).append((config, signed))
+        self._refresh_model()
+
+    def _refresh_model(self) -> None:
+        """Point _observations at the largest budget with enough
+        samples (falling back to pooling everything below it)."""
+        for budget in sorted(self._by_budget, reverse=True):
+            rows = self._by_budget[budget]
+            if len(rows) >= self.min_points_per_budget:
+                self._observations = list(rows)
+                return
+        pooled: List[tuple] = []
+        for rows in self._by_budget.values():
+            pooled.extend(rows)
+        self._observations = pooled
+
+    def model_budget(self) -> Optional[int]:
+        """The budget whose observations currently drive suggestions
+        (None while pooling across budgets)."""
+        for budget in sorted(self._by_budget, reverse=True):
+            if len(self._by_budget[budget]) >=                     self.min_points_per_budget:
+                return budget
+        return None
+
+
 class ConcurrencyLimiter(Searcher):
     """Caps in-flight suggestions (reference: tune/search/
     concurrency_limiter.py)."""
@@ -449,8 +503,7 @@ def math_erf(x: float) -> float:
     return math.erf(x)
 
 
-class TuneBOHB(TPESearcher):
-    """BOHB's model-based sampling component (reference: tune/search/bohb/
-    TuneBOHB): TPE-style good/bad density modeling. Pair it with
-    HyperBandScheduler — the combination is the reference's HB_BOHB
-    (successive halving driven by model-based suggestions)."""
+#: Reference-named alias (tune/search/bohb/ TuneBOHB): the budget-aware
+#: searcher IS the BOHB sampling component; pair with HyperBandScheduler
+#: for the reference's HB_BOHB.
+TuneBOHB = BOHBSearcher

@@ -447,3 +447,49 @@ def test_bayesopt_loguniform_domain(ray_start_regular):
     )
     best = tuner.fit().get_best_result()
     assert 1e-4 <= best.config["lr"] <= 1e-1
+
+
+def test_bohb_budget_model_selection():
+    """BOHB models the largest budget with enough samples; below the
+    threshold it pools across budgets (reference: tune/search/bohb
+    pairing with HyperBand rungs)."""
+    from ray_tpu.tune import BOHBSearcher
+    s = BOHBSearcher(min_points_per_budget=3, seed=0)
+    s.set_search_properties("loss", "min", {"x": tune.uniform(0, 1)})
+    for i in range(2):
+        s._observe({"x": 0.1 * i}, {"loss": 1.0,
+                                    "training_iteration": 9}, False)
+    for i in range(4):
+        s._observe({"x": 0.2 * i}, {"loss": 2.0,
+                                    "training_iteration": 3}, False)
+    # Budget 9 has only 2 points -> model falls to budget 3 (4 points).
+    assert s.model_budget() == 3
+    assert len(s._observations) == 4
+    s._observe({"x": 0.9}, {"loss": 0.5, "training_iteration": 9}, False)
+    assert s.model_budget() == 9
+    assert len(s._observations) == 3
+
+
+def test_bohb_with_hyperband_converges(ray_start_regular):
+    """The BOHB pairing end to end: HyperBand rungs produce mixed-budget
+    completions; the searcher still homes in on the optimum."""
+    from ray_tpu.tune import BOHBSearcher, HyperBandScheduler
+
+    def trainable(config):
+        x = config["x"]
+        for i in range(8):
+            tune.report({"loss": (x - 3.0) ** 2 + 0.1 / (i + 1)})
+
+    results = Tuner(
+        trainable,
+        param_space={"x": tune.uniform(-10.0, 10.0)},
+        tune_config=TuneConfig(
+            metric="loss", mode="min", num_samples=30,
+            search_alg=BOHBSearcher(n_initial_points=8, seed=0),
+            scheduler=HyperBandScheduler(max_t=8, metric="loss",
+                                         mode="min"),
+            max_concurrent_trials=4),
+    ).fit()
+    assert len(results) == 30
+    best = results.get_best_result()
+    assert abs(best.config["x"] - 3.0) < 1.5
