@@ -316,12 +316,14 @@ def main():
         # adafactor (factored second moments; adam state alone would
         # blow the 16G chip), Pallas flash attention, FULL remat
         # (activation memory buys batch 12, which beats selective remat
-        # at its smaller max batch), chunked CE. Measured v5e sweep:
-        # batch 2/0.42, 4/0.51, 8/0.59, 12/0.61 MFU, 16 regresses.
+        # at its smaller max batch), chunked CE. Measured v5e sweeps:
+        # batch 2/0.42, 4/0.51, 8/0.59, 12/0.619, 13-16 regress;
+        # loss_chunk 4096 > 2048 (0.6177) > 6144; 512x512 attn tiles
+        # beat 1024-wide variants.
         head = _bench_gpt(
             "gpt-1.3b", batch=12, seq=1024, steps=6, warmup=2,
             overrides=dict(attn_impl="flash", remat_policy="full",
-                           loss_chunk=2048),
+                           loss_chunk=4096),
             optimizer=memory_efficient_optimizer(learning_rate=1e-4))
         preset = "gpt-1.3b"
         # Continuity metric: the round-1 headline model and recipe.
