@@ -43,6 +43,7 @@ from ray_tpu.rllib.algorithms.impala import Impala, ImpalaConfig
 from ray_tpu.rllib.algorithms.maddpg import MADDPG, MADDPGConfig
 from ray_tpu.rllib.algorithms.maml import MAML, MAMLConfig
 from ray_tpu.rllib.algorithms.marwil import MARWIL, MARWILConfig
+from ray_tpu.rllib.algorithms.mbmpo import MBMPO, MBMPOConfig
 from ray_tpu.rllib.algorithms.pg import PG, PGConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.qmix import QMix, QMixConfig
@@ -75,7 +76,7 @@ __all__ = ["A2C", "A2CConfig", "A3C", "A3CConfig", "APPO", "APPOConfig",
            "ApexDQN", "ApexDQNConfig", "ApexDDPG", "ApexDDPGConfig",
            "RandomAgent", "RandomAgentConfig",
            "AlphaZero", "AlphaZeroConfig", "CRR", "CRRConfig",
-           "DDPPO", "DDPPOConfig", "Dreamer", "DreamerConfig", "MAML", "MAMLConfig",
+           "DDPPO", "DDPPOConfig", "Dreamer", "DreamerConfig", "MAML", "MAMLConfig", "MBMPO", "MBMPOConfig",
            "ARS", "ARSConfig", "Algorithm", "AlgorithmConfig", "BC",
            "BCConfig", "CQL", "CQLConfig", "DDPG", "DDPGConfig", "DQN",
            "DQNConfig", "DT", "DTConfig", "ES", "ESConfig", "Impala", "ImpalaConfig",

@@ -1,0 +1,342 @@
+"""MBMPO: model-based meta-policy optimization.
+
+Analog of the reference's rllib/algorithms/mbmpo (Clavera et al. 2018):
+learn an ENSEMBLE of dynamics models from real transitions, then treat
+EACH model as a meta-learning task — the policy inner-adapts on
+imagined rollouts through model k and the outer (first-order) step
+averages the post-adaptation gradients across models. Model bias
+becomes task variation, so the meta-policy stays robust to any single
+model's errors while nearly all gradient steps come from imagination
+(the real env is touched only to refresh the transition buffer).
+
+Env contract (matching the reference's pairing with reward-aware envs):
+Box actions and a ``reward_fn(s, a, s') -> float`` the imagination can
+evaluate without the env (env/examples.py PointGoalEnv).
+
+Ensemble dynamics: K MLPs predicting normalized Δs from normalized
+(s, a); inputs/targets standardized by running statistics of the real
+buffer. ``dynamics_disagreement`` (std of ensemble predictions) is
+exposed — the classic model-uncertainty gauge.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm_config import AlgorithmConfig
+
+
+class MBMPOConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or MBMPO)
+        self.lr = 1e-2                  # meta (outer) policy lr
+        self.inner_lr = 0.1
+        self.dynamics_lr = 1e-3
+        self.ensemble_size = 3
+        self.dynamics_hiddens = (64, 64)
+        self.dynamics_epochs = 40
+        self.dynamics_batch_size = 256
+        self.real_steps_per_iteration = 400
+        self.imagined_episodes = 16
+        self.imagined_horizon = 20
+        self.max_episode_steps = 30
+        self.explore_noise = 0.3
+        self.buffer_capacity = 20_000
+
+    def training(self, *, inner_lr=None, dynamics_lr=None,
+                 ensemble_size=None, dynamics_hiddens=None,
+                 dynamics_epochs=None, dynamics_batch_size=None,
+                 real_steps_per_iteration=None, imagined_episodes=None,
+                 imagined_horizon=None, max_episode_steps=None,
+                 explore_noise=None, **kwargs) -> "MBMPOConfig":
+        super().training(**kwargs)
+        for name, val in (
+                ("inner_lr", inner_lr), ("dynamics_lr", dynamics_lr),
+                ("ensemble_size", ensemble_size),
+                ("dynamics_hiddens", dynamics_hiddens),
+                ("dynamics_epochs", dynamics_epochs),
+                ("dynamics_batch_size", dynamics_batch_size),
+                ("real_steps_per_iteration", real_steps_per_iteration),
+                ("imagined_episodes", imagined_episodes),
+                ("imagined_horizon", imagined_horizon),
+                ("max_episode_steps", max_episode_steps),
+                ("explore_noise", explore_noise)):
+            if val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class MBMPO(Algorithm):
+    _default_config_class = MBMPOConfig
+    _own_rollout_actors = True
+
+    def setup(self, config: MBMPOConfig) -> None:
+        import gymnasium as gym
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.rllib.models.catalog import mlp_apply, mlp_init
+
+        env = self._env_creator(config.env_config)
+        if not hasattr(env, "reward_fn"):
+            raise ValueError(
+                "MBMPO needs an env exposing reward_fn(s, a, s') — "
+                "imagined rollouts must be rewardable without the env "
+                "(see env/examples.py PointGoalEnv)")
+        if not isinstance(env.action_space, gym.spaces.Box):
+            raise ValueError("MBMPO supports Box action spaces")
+        self._env = env
+        policy = self.local_policy
+        self.obs_dim = policy.obs_dim
+        self.act_dim = policy.act_dim
+        K = config.ensemble_size
+        hid = list(config.dynamics_hiddens)
+        key = jax.random.PRNGKey(config.seed + 11)
+        ks = jax.random.split(key, K)
+        in_dim = self.obs_dim + self.act_dim
+        self.dyn_params = [
+            mlp_init(ks[k], [in_dim, *hid, self.obs_dim])
+            for k in range(K)]
+        self._dyn_opt = optax.adam(config.dynamics_lr)
+        self._dyn_states = [self._dyn_opt.init(p)
+                            for p in self.dyn_params]
+        self._meta_opt = optax.adam(config.lr)
+        self._meta_state = self._meta_opt.init(policy.params)
+        self._rng = np.random.default_rng(config.seed)
+        self._key = jax.random.PRNGKey(config.seed + 23)
+
+        # Running normalization stats (updated from the real buffer).
+        self._stats = {
+            "s_mean": np.zeros(self.obs_dim, np.float32),
+            "s_std": np.ones(self.obs_dim, np.float32),
+            "a_mean": np.zeros(self.act_dim, np.float32),
+            "a_std": np.ones(self.act_dim, np.float32),
+            "d_mean": np.zeros(self.obs_dim, np.float32),
+            "d_std": np.ones(self.obs_dim, np.float32),
+        }
+
+        def dyn_forward(p, stats, s, a):
+            x = jnp.concatenate(
+                [(s - stats["s_mean"]) / stats["s_std"],
+                 (a - stats["a_mean"]) / stats["a_std"]], -1)
+            delta_n = mlp_apply(p, x)
+            return s + delta_n * stats["d_std"] + stats["d_mean"]
+
+        def dyn_loss(p, stats, s, a, s_next):
+            pred_n = mlp_apply(p, jnp.concatenate(
+                [(s - stats["s_mean"]) / stats["s_std"],
+                 (a - stats["a_mean"]) / stats["a_std"]], -1))
+            target_n = ((s_next - s) - stats["d_mean"]) / stats["d_std"]
+            return ((pred_n - target_n) ** 2).mean()
+
+        def dyn_update(p, opt_state, stats, s, a, s_next):
+            loss, grads = jax.value_and_grad(dyn_loss)(
+                p, stats, s, a, s_next)
+            updates, opt_state = self._dyn_opt.update(grads, opt_state,
+                                                      p)
+            return optax.apply_updates(p, updates), opt_state, loss
+
+        def reinforce_loss(params, obs, actions, advantages):
+            logp = policy.logp(params, obs, actions)
+            return -(logp * advantages).mean()
+
+        grad_fn = jax.grad(reinforce_loss)
+        inner_lr = config.inner_lr
+
+        def inner_update(params, obs, actions, advantages):
+            grads = grad_fn(params, obs, actions, advantages)
+            return jax.tree.map(lambda p, g: p - inner_lr * g,
+                                params, grads)
+
+        self._dyn_forward_jit = jax.jit(dyn_forward)
+        self._dyn_update_jit = jax.jit(dyn_update)
+        self._inner_update_jit = jax.jit(inner_update)
+        self._outer_grad_jit = jax.jit(grad_fn)
+        self._buffer_s: List[np.ndarray] = []
+        self._buffer_a: List[np.ndarray] = []
+        self._buffer_ns: List[np.ndarray] = []
+        self._episode_rewards: List[float] = []
+
+    # -- real-env interaction -------------------------------------------
+
+    def _collect_real(self, steps: int) -> None:
+        import jax
+        config: MBMPOConfig = self.config
+        policy = self.local_policy
+        obs, _ = self._env.reset(
+            seed=int(self._rng.integers(1 << 30)))
+        ep_reward, ep_len = 0.0, 0
+        for _ in range(steps):
+            vec = np.asarray(obs, np.float32).reshape(1, -1)
+            self._key, sub = jax.random.split(self._key)
+            action, _, _ = policy.compute_actions(vec, sub)
+            a = np.asarray(action[0], np.float32)
+            a = a + config.explore_noise * \
+                self._rng.standard_normal(a.shape).astype(np.float32)
+            nxt, r, term, trunc, _ = self._env.step(a)
+            self._buffer_s.append(vec[0])
+            self._buffer_a.append(a)
+            self._buffer_ns.append(
+                np.asarray(nxt, np.float32).reshape(-1))
+            ep_reward += float(r)
+            ep_len += 1
+            self._timesteps_total += 1
+            if term or trunc or ep_len >= config.max_episode_steps:
+                self._episode_rewards.append(ep_reward)
+                ep_reward, ep_len = 0.0, 0
+                obs, _ = self._env.reset()
+            else:
+                obs = nxt
+        cap = config.buffer_capacity
+        del self._buffer_s[:-cap]
+        del self._buffer_a[:-cap]
+        del self._buffer_ns[:-cap]
+
+    def _fit_dynamics(self) -> float:
+        import jax.numpy as jnp
+        config: MBMPOConfig = self.config
+        s = np.stack(self._buffer_s)
+        a = np.stack(self._buffer_a)
+        ns = np.stack(self._buffer_ns)
+        d = ns - s
+        for name, arr in (("s", s), ("a", a), ("d", d)):
+            self._stats[f"{name}_mean"] = arr.mean(0).astype(np.float32)
+            self._stats[f"{name}_std"] = np.maximum(
+                arr.std(0), 1e-3).astype(np.float32)
+        stats = {k: jnp.asarray(v) for k, v in self._stats.items()}
+        n = len(s)
+        bs = min(config.dynamics_batch_size, n)
+        losses = []
+        for k in range(config.ensemble_size):
+            p, st = self.dyn_params[k], self._dyn_states[k]
+            # Each member sees its own bootstrap resample (the ensemble
+            # diversity mechanism).
+            rng = np.random.default_rng(1000 * k + self.iteration)
+            for _ in range(config.dynamics_epochs):
+                idx = rng.integers(0, n, bs)
+                p, st, loss = self._dyn_update_jit(
+                    p, st, stats,
+                    jnp.asarray(s[idx]), jnp.asarray(a[idx]),
+                    jnp.asarray(ns[idx]))
+            self.dyn_params[k], self._dyn_states[k] = p, st
+            losses.append(float(loss))
+        return float(np.mean(losses))
+
+    # -- imagination -----------------------------------------------------
+
+    def _imagine_batch(self, params, model_idx: int):
+        """Roll imagined episodes through ensemble member model_idx
+        under ``params``; returns REINFORCE arrays (obs, act, adv) and
+        the mean imagined return."""
+        import jax
+        import jax.numpy as jnp
+        config: MBMPOConfig = self.config
+        policy = self.local_policy
+        stats = {k: jnp.asarray(v) for k, v in self._stats.items()}
+        E, H = config.imagined_episodes, config.imagined_horizon
+        # Start states resampled from REAL data (the standard MBRL
+        # grounding for imagined rollouts).
+        idx = self._rng.integers(0, len(self._buffer_s), E)
+        s = jnp.asarray(np.stack([self._buffer_s[i] for i in idx]))
+        saved = policy.params
+        policy.params = params
+        obs_rows, act_rows, rew_rows = [], [], []
+        try:
+            for _ in range(H):
+                self._key, sub = jax.random.split(self._key)
+                a, _, _ = policy.compute_actions(np.asarray(s), sub)
+                a = jnp.asarray(a)
+                s_next = self._dyn_forward_jit(
+                    self.dyn_params[model_idx], stats, s, a)
+                r = np.asarray([
+                    self._env.reward_fn(np.asarray(s[i]),
+                                        np.asarray(a[i]),
+                                        np.asarray(s_next[i]))
+                    for i in range(E)], np.float32)
+                obs_rows.append(np.asarray(s))
+                act_rows.append(np.asarray(a))
+                rew_rows.append(r)
+                s = s_next
+        finally:
+            policy.params = saved
+        rew = np.stack(rew_rows, 1)              # [E, H]
+        rets = np.cumsum(rew[:, ::-1], axis=1)[:, ::-1]
+        adv = rets - rets.mean()
+        adv = adv / max(adv.std(), 1e-6)
+        obs = np.stack(obs_rows, 1).reshape(E * H, -1)
+        act = np.stack(act_rows, 1).reshape(E * H, -1)
+        import jax.numpy as jnp2
+        return (jnp2.asarray(obs), jnp2.asarray(act),
+                jnp2.asarray(adv.reshape(-1).astype(np.float32)),
+                float(rew.sum(1).mean()))
+
+    def dynamics_disagreement(self, s: np.ndarray, a: np.ndarray
+                              ) -> float:
+        """Std of ensemble next-state predictions — the model
+        uncertainty gauge."""
+        import jax.numpy as jnp
+        stats = {k: jnp.asarray(v) for k, v in self._stats.items()}
+        preds = [np.asarray(self._dyn_forward_jit(
+            p, stats, jnp.asarray(s), jnp.asarray(a)))
+            for p in self.dyn_params]
+        return float(np.stack(preds).std(0).mean())
+
+    # -- meta loop -------------------------------------------------------
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import optax
+        config: MBMPOConfig = self.config
+        policy = self.local_policy
+        self._collect_real(config.real_steps_per_iteration)
+        dyn_loss = self._fit_dynamics()
+
+        meta_grads = None
+        imag_returns = []
+        for k in range(config.ensemble_size):
+            obs, act, adv, _ = self._imagine_batch(policy.params, k)
+            adapted = self._inner_update_jit(policy.params, obs, act,
+                                             adv)
+            obs2, act2, adv2, post_ret = self._imagine_batch(adapted, k)
+            g = self._outer_grad_jit(adapted, obs2, act2, adv2)
+            meta_grads = g if meta_grads is None else jax.tree.map(
+                lambda x, y: x + y, meta_grads, g)
+            imag_returns.append(post_ret)
+        meta_grads = jax.tree.map(
+            lambda g: g / config.ensemble_size, meta_grads)
+        updates, self._meta_state = self._meta_opt.update(
+            meta_grads, self._meta_state, policy.params)
+        policy.params = optax.apply_updates(policy.params, updates)
+
+        window = self._episode_rewards[-50:]
+        return {
+            "dynamics_loss": dyn_loss,
+            "imagined_return_mean": float(np.mean(imag_returns)),
+            "episode_reward_mean": (float(np.mean(window)) if window
+                                    else float("nan")),
+            "episodes_total": len(self._episode_rewards),
+        }
+
+    def get_weights(self):
+        import jax
+        return {"policy": self.local_policy.get_weights(),
+                "dynamics": [jax.tree.map(np.asarray, p)
+                             for p in self.dyn_params],
+                "stats": dict(self._stats)}
+
+    def set_weights(self, weights) -> None:
+        import jax
+        import jax.numpy as jnp
+        self.local_policy.set_weights(weights["policy"])
+        self.dyn_params = [jax.tree.map(jnp.asarray, p)
+                           for p in weights["dynamics"]]
+        self._stats = dict(weights["stats"])
+
+    def stop(self) -> None:
+        close = getattr(self._env, "close", None)
+        if callable(close):
+            close()

@@ -28,6 +28,7 @@ def get_algorithm_class(name: str) -> Type:
     from ray_tpu.rllib.algorithms.maddpg import MADDPG
     from ray_tpu.rllib.algorithms.maml import MAML
     from ray_tpu.rllib.algorithms.marwil import MARWIL
+    from ray_tpu.rllib.algorithms.mbmpo import MBMPO
     from ray_tpu.rllib.algorithms.pg import PG
     from ray_tpu.rllib.algorithms.ppo import PPO
     from ray_tpu.rllib.algorithms.qmix import QMix
@@ -48,7 +49,7 @@ def get_algorithm_class(name: str) -> Type:
              "SLATEQ": SlateQ,
              "ES": ES, "ARS": ARS, "CQL": CQL, "DT": DT, "CRR": CRR,
              "DDPPO": DDPPO, "ALPHAZERO": AlphaZero, "DREAMER": Dreamer,
-             "MAML": MAML,
+             "MAML": MAML, "MBMPO": MBMPO,
              "BANDITLINUCB": BanditLinUCB, "BANDITLINTS": BanditLinTS}
     try:
         return table[name.upper()]

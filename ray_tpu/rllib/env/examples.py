@@ -243,5 +243,13 @@ class PointGoalEnv:
                 -abs(self.pos - self.goal),
                 False, self._t >= self.horizon, {})
 
+    def reward_fn(self, state, action, next_state) -> float:
+        """Known reward over (s, a, s') — the contract model-based
+        algorithms (MBMPO) need to roll imagined trajectories without
+        the env (the reference likewise pairs MBMPO with envs exposing
+        reward functions)."""
+        return -abs(float(np.asarray(next_state).reshape(-1)[0])
+                    - self.goal)
+
     def close(self):
         pass

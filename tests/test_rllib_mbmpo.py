@@ -1,0 +1,78 @@
+"""MBMPO: ensemble dynamics models as meta-learning tasks
+(reference: rllib/algorithms/mbmpo)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu  # noqa: F401
+
+
+def _cpu_jax():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _build(seed=0, **training):
+    from ray_tpu.rllib import MBMPOConfig
+    from ray_tpu.rllib.env.examples import PointGoalEnv
+    cfg = MBMPOConfig().environment(PointGoalEnv).debugging(seed=seed)
+    if training:
+        cfg = cfg.training(**training)
+    return cfg.build()
+
+
+def test_requires_reward_fn_and_box(ray_start_regular):
+    _cpu_jax()
+    from ray_tpu.rllib import MBMPOConfig
+    with pytest.raises(ValueError, match="reward_fn"):
+        (MBMPOConfig().environment("Pendulum-v1")
+         .debugging(seed=0)).build()
+
+
+def test_dynamics_ensemble_fits_and_disagrees(ray_start_regular):
+    """Member losses fall as the ensemble trains; bootstrap resamples
+    keep members distinct (nonzero prediction disagreement)."""
+    _cpu_jax()
+    algo = _build(dynamics_epochs=10)
+    first = algo.train()["dynamics_loss"]
+    for _ in range(3):
+        last = algo.train()["dynamics_loss"]
+    assert last < first, (first, last)
+    s = np.zeros((4, 1), np.float32)
+    a = np.full((4, 1), 0.5, np.float32)
+    d = algo.dynamics_disagreement(s, a)
+    assert d > 0.0
+    algo.stop()
+
+
+def test_imagination_uses_models_not_env(ray_start_regular):
+    """Imagined rollouts must not advance the real env."""
+    _cpu_jax()
+    algo = _build(dynamics_epochs=5)
+    algo.train()  # fills buffer + fits models
+    env_pos = algo._env.pos
+    env_t = algo._env._t
+    obs, act, adv, ret = algo._imagine_batch(
+        algo.local_policy.params, 0)
+    assert obs.shape[0] == (algo.config.imagined_episodes *
+                            algo.config.imagined_horizon)
+    assert algo._env.pos == env_pos and algo._env._t == env_t
+    assert np.isfinite(ret)
+    algo.stop()
+
+
+@pytest.mark.slow
+def test_mbmpo_learns_from_imagination(ray_start_regular):
+    """The model-based gate: nearly all gradient steps come from
+    imagined rollouts, yet REAL env return climbs from random (~-60)
+    past -25 within 15 iterations (observed ~-15)."""
+    _cpu_jax()
+    algo = _build()
+    best = -1e9
+    for _ in range(15):
+        res = algo.train()
+        r = res["episode_reward_mean"]
+        if r == r:
+            best = max(best, r)
+    assert best > -25.0, best
+    algo.stop()
