@@ -69,10 +69,16 @@ def test_head_handlers_recorded(ray_start_regular):
         assert ray_tpu.get([f.remote(i) for i in range(20)]) == \
             list(range(1, 21))
         from ray_tpu._private.worker import global_worker
-        stats = global_worker.runtime._head_server.event_stats()
-        assert stats["head.handshake"]["count"] >= 1
-        comp = stats["head.task_completion"]
-        assert comp["count"] >= 20
+        head = global_worker.runtime._head_server
+        assert head.event_stats()["head.handshake"]["count"] >= 1
+        # The wrap records AFTER the callback body returns, and get()
+        # resolves INSIDE it — poll briefly for the last completion.
+        deadline = time.monotonic() + 5
+        while head.event_stats().get(
+                "head.task_completion", {}).get("count", 0) < 20:
+            assert time.monotonic() < deadline, head.event_stats()
+            time.sleep(0.05)
+        comp = head.event_stats()["head.task_completion"]
         assert comp["mean_run_ms"] >= 0.0
         # Health sweeps tick on the configured period.
         deadline = time.monotonic() + 10
