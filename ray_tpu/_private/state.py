@@ -75,4 +75,27 @@ def status_summary() -> str:
     summary = summarize_tasks()
     lines.append(f"Tasks: {summary['total']} total "
                  f"{summary['by_state']}")
+    # Synced per-node usage (the ray-syncer view), when daemons report.
+    usage = ray_tpu.cluster_usage()
+    if usage.get("nodes"):
+        lines.append("Node usage (synced):")
+        for node_id, comps in sorted(usage["nodes"].items()):
+            load = comps.get("resource_load", {})
+            store = comps.get("object_store", {})
+            mem = comps.get("memory", {})
+            parts = []
+            if load:
+                avail_cpu = load.get("available", {}).get("CPU")
+                total_cpu = load.get("total", {}).get("CPU")
+                if total_cpu is not None:
+                    parts.append(f"CPU {avail_cpu:g}/{total_cpu:g}")
+                parts.append(f"inflight={load.get('inflight_tasks', 0)}")
+                parts.append(f"actors={load.get('actors', 0)}")
+            if store:
+                parts.append(
+                    f"store={store.get('bytes', 0) / 1e6:.1f}MB/"
+                    f"{store.get('objects', 0)}obj")
+            if mem.get("rss_bytes"):
+                parts.append(f"rss={mem['rss_bytes'] / 1e6:.0f}MB")
+            lines.append(f"  {node_id[:12]}: " + " ".join(parts))
     return "\n".join(lines)

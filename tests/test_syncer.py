@@ -218,3 +218,25 @@ def test_cluster_usage_drops_dead_nodes(ray_start_regular):
 def test_cluster_usage_empty_without_head_server(ray_start_regular):
     u = ray_tpu.cluster_usage()
     assert u == {"version": 0, "nodes": {}, "available_total": {}}
+
+
+def test_status_summary_includes_synced_usage(ray_start_regular):
+    """`ray-tpu status` surfaces the gossiped per-node usage."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, num_tpus=0,
+                 _system_config={"health_check_period_ms": 100})
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    p = _spawn_daemon(port, num_cpus=2, resources={"remote": 2})
+    try:
+        deadline = time.monotonic() + 20
+        while len(ray_tpu.cluster_usage()["nodes"]) < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        from ray_tpu._private.state import status_summary
+        out = status_summary()
+        assert "Node usage (synced):" in out
+        assert "CPU 2/2" in out and "rss=" in out
+    finally:
+        p.kill()
+        p.wait(timeout=10)
+        ray_tpu.shutdown()
