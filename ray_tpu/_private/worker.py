@@ -83,9 +83,18 @@ def init(
                 f"`api = ray_tpu.util.client.connect({address!r})` against "
                 "a driver running `ray_tpu.util.client.serve()`.")
         if address not in (None, "local", "auto"):
-            raise NotImplementedError(
-                f"Connecting to a remote cluster at {address!r} is not yet "
-                "supported; multi-node arrives with the gRPC control plane.")
+            # Design stance (differs from the reference): the DRIVER is
+            # the head. Remote machines join as node daemons (`ray-tpu
+            # start --address`), and remote DRIVERS attach through the
+            # thin client — there is no detached-GCS mode to connect to.
+            raise ValueError(
+                f"init(address={address!r}): this runtime has no "
+                "detached cluster to connect to — the driver IS the "
+                "head. To add this machine to a cluster as a worker "
+                f"node: `ray-tpu start --address {address}`. To drive "
+                "a remote cluster from here: `api = ray_tpu.util."
+                f"client.connect({address!r})` against a driver "
+                "running `ray_tpu.util.client.serve()`.")
         if num_tpus is None and num_gpus is not None:
             # GPU-option compatibility: the reference's num_gpus maps onto
             # the accelerator resource, which is TPU here.
