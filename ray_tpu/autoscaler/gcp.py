@@ -178,10 +178,11 @@ class GCloudTPUNodeProvider(NodeProvider):
                           STATUS_UP_TO_DATE)
         label_arg = ",".join(f"{k}={v}" for k, v in labels.items())
         for _ in range(count):
-            with self._lock:
-                self._counter += 1
-                name = (f"{self.cluster_name}-tpu-"
-                        f"{self._counter:04d}")
+            import uuid
+            # Unique across provider INSTANCES: a fresh launcher/
+            # autoscaler process must never reuse a live node's name
+            # (gcloud create would fail — or a fake overwrite it).
+            name = f"{self.cluster_name}-tpu-{uuid.uuid4().hex[:8]}"
             self._gcloud("create", name,
                          "--accelerator-type", acc,
                          "--version", version,

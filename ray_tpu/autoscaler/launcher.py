@@ -1,0 +1,95 @@
+"""Cluster launcher: `ray-tpu up / down cluster.yaml`.
+
+Analog of the reference's `ray up` / `ray down`
+(scripts/scripts.py:1216,1292 over autoscaler/commands.py): a YAML
+describes the provider and worker fleet; `up` creates the head-tagged
+node plus ``min_workers`` workers through the provider registry
+(`PROVIDER_TYPES`), `down` terminates every non-terminated node of the
+cluster. The reference's SSH/docker setup phase collapses here — node
+bootstrap is the provider's concern (GCloudTPUNodeProvider runs
+`ray-tpu start` over `gcloud ssh`; the daemon provider spawns joined
+processes directly).
+
+YAML schema (the subset of autoscaler/ray-schema.json this runtime
+uses)::
+
+    cluster_name: my-cluster
+    provider:
+      type: gcp_tpu            # PROVIDER_TYPES key
+      project: my-project
+      zone: us-central2-b
+      head_address: 10.0.0.2:6380
+    min_workers: 2
+    max_workers: 8             # recorded for the autoscaler
+    worker_nodes:              # provider-specific node_config
+      accelerator_type: v4-8
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ray_tpu.autoscaler.node_provider import (NODE_KIND_HEAD,
+                                              NODE_KIND_WORKER,
+                                              TAG_RAY_NODE_KIND,
+                                              TAG_RAY_USER_NODE_TYPE)
+
+
+def load_cluster_config(path: str) -> Dict[str, Any]:
+    import yaml
+    with open(path) as f:
+        config = yaml.safe_load(f) or {}
+    for req in ("cluster_name", "provider"):
+        if req not in config:
+            raise ValueError(f"cluster config needs a {req!r} field")
+    if "type" not in config["provider"]:
+        raise ValueError("provider needs a 'type' "
+                         "(one of the PROVIDER_TYPES keys)")
+    return config
+
+
+def _provider_for(config: Dict[str, Any]):
+    from ray_tpu.autoscaler import get_node_provider
+    return get_node_provider(config["provider"],
+                             config["cluster_name"])
+
+
+def up(config_path: str, *, no_head: bool = False) -> Dict[str, Any]:
+    """Create the cluster: one head node (unless the provider config
+    points at an existing head via ``head_address`` and ``no_head``)
+    plus ``min_workers`` workers. Idempotent: existing nodes of each
+    kind are counted, only the shortfall is created."""
+    config = load_cluster_config(config_path)
+    provider = _provider_for(config)
+    created: Dict[str, int] = {"head": 0, "workers": 0}
+    if not no_head and not config["provider"].get("head_address"):
+        heads = provider.non_terminated_nodes(
+            {TAG_RAY_NODE_KIND: NODE_KIND_HEAD})
+        if not heads:
+            provider.create_node(
+                dict(config.get("head_node", {})),
+                {TAG_RAY_NODE_KIND: NODE_KIND_HEAD,
+                 TAG_RAY_USER_NODE_TYPE: "head"}, 1)
+            created["head"] = 1
+    want = int(config.get("min_workers", 0))
+    have = len(provider.non_terminated_nodes(
+        {TAG_RAY_NODE_KIND: NODE_KIND_WORKER}))
+    if want > have:
+        provider.create_node(
+            dict(config.get("worker_nodes", {})),
+            {TAG_RAY_NODE_KIND: NODE_KIND_WORKER,
+             TAG_RAY_USER_NODE_TYPE: "worker"}, want - have)
+        created["workers"] = want - have
+    nodes = provider.non_terminated_nodes({})
+    return {"cluster_name": config["cluster_name"],
+            "created": created, "nodes": nodes}
+
+
+def down(config_path: str) -> List[str]:
+    """Terminate every non-terminated node of the cluster."""
+    config = load_cluster_config(config_path)
+    provider = _provider_for(config)
+    nodes = provider.non_terminated_nodes({})
+    for node_id in nodes:
+        provider.terminate_node(node_id)
+    return nodes

@@ -165,6 +165,26 @@ def cmd_start(args) -> int:
     return 0
 
 
+def cmd_up(args) -> int:
+    """`ray-tpu up cluster.yaml` (reference: `ray up`,
+    scripts/scripts.py:1216)."""
+    from ray_tpu.autoscaler.launcher import up
+    out = up(args.config_file, no_head=args.no_head)
+    print(f"cluster {out['cluster_name']}: created "
+          f"{out['created']['head']} head, "
+          f"{out['created']['workers']} workers; nodes now: "
+          f"{out['nodes']}")
+    return 0
+
+
+def cmd_down(args) -> int:
+    """`ray-tpu down cluster.yaml` (reference: `ray down`)."""
+    from ray_tpu.autoscaler.launcher import down
+    nodes = down(args.config_file)
+    print(f"terminated {len(nodes)} nodes: {nodes}")
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     """`ray-tpu dashboard` — run the HTTP observability endpoint."""
     import time
@@ -259,6 +279,14 @@ def main(argv=None) -> int:
                    help="node labels as JSON (cloud providers tag their "
                         "nodes here, e.g. provider_node_id)")
 
+    p = sub.add_parser("up", help="create a cluster from a YAML config")
+    p.add_argument("config_file")
+    p.add_argument("--no-head", action="store_true",
+                   help="only create workers (head runs elsewhere)")
+
+    p = sub.add_parser("down", help="terminate a cluster's nodes")
+    p.add_argument("config_file")
+
     p = sub.add_parser("dashboard", help="run the HTTP dashboard")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8265)
@@ -283,6 +311,8 @@ def main(argv=None) -> int:
         "serve": cmd_serve,
         "dashboard": cmd_dashboard,
         "start": cmd_start,
+        "up": cmd_up,
+        "down": cmd_down,
         "microbenchmark": cmd_microbenchmark,
     }[args.command]
     return handler(args)

@@ -178,3 +178,58 @@ def test_provider_registry():
     assert PROVIDER_TYPES["gcp_tpu"] is GCloudTPUNodeProvider
     with pytest.raises(ValueError, match="Unknown provider type"):
         get_node_provider({"type": "aws"}, "c")
+
+
+def test_cluster_launcher_up_down(provider, tmp_path, monkeypatch):
+    """`ray-tpu up/down` over the provider registry (reference:
+    `ray up` / `ray down`, scripts/scripts.py:1216,1292)."""
+    import yaml
+
+    from ray_tpu.autoscaler import launcher
+    cfg = {
+        "cluster_name": "c1",
+        "provider": dict(provider.provider_config, type="gcp_tpu"),
+        "min_workers": 2,
+        "worker_nodes": {"accelerator_type": "v4-8"},
+    }
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(cfg))
+    # provider config names a head_address -> the head runs elsewhere;
+    # up creates only the worker fleet.
+    out = launcher.up(str(path))
+    assert out["created"] == {"head": 0, "workers": 2}
+    assert len(out["nodes"]) == 2
+    # Idempotent: a second up creates nothing.
+    out2 = launcher.up(str(path))
+    assert out2["created"] == {"head": 0, "workers": 0}
+    assert len(out2["nodes"]) == 2
+    # Without head_address, up provisions a head node too.
+    cfg2 = dict(cfg, cluster_name="c1")
+    cfg2["provider"] = {k: v for k, v in cfg["provider"].items()
+                        if k != "head_address"}
+    path.write_text(yaml.safe_dump(cfg2))
+    out3 = launcher.up(str(path))
+    assert out3["created"] == {"head": 1, "workers": 0}
+    assert len(out3["nodes"]) == 3
+    # Worker node_config reached the provider.
+    calls = provider._calls()
+    creates = [c for c in calls if c[3] == "create"]
+    assert any("v4-8" in " ".join(c) for c in creates)
+    # Down terminates everything.
+    gone = launcher.down(str(path))
+    assert len(gone) == 3
+    assert launcher.down(str(path)) == []
+
+
+def test_launcher_validates_config(tmp_path):
+    import yaml
+
+    from ray_tpu.autoscaler import launcher
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(yaml.safe_dump({"provider": {"type": "gcp_tpu"}}))
+    with pytest.raises(ValueError, match="cluster_name"):
+        launcher.up(str(bad))
+    bad.write_text(yaml.safe_dump({"cluster_name": "x",
+                                   "provider": {}}))
+    with pytest.raises(ValueError, match="type"):
+        launcher.up(str(bad))
