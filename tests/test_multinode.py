@@ -388,3 +388,25 @@ def test_rpc_chaos_injection_survived_by_retries(ray_start_regular):
             p.kill()
         p.wait(timeout=10)
         ray_tpu.shutdown()
+
+
+def test_daemon_labels_reach_node_table(ray_start_regular):
+    """`ray-tpu start --labels` (the cloud providers' provider_node_id
+    self-tagging channel) lands in the head's node table."""
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.multinode",
+         "--address", f"127.0.0.1:{port}", "--num-cpus", "1",
+         "--resources", json.dumps({"lbl": 1}),
+         "--labels", json.dumps({"provider_node_id": "node-42",
+                                 "zone": "us-x1-a"})],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        _wait_for_resource("lbl", 1)
+        node = next(n for n in ray_tpu.nodes()
+                    if n["Labels"].get("provider_node_id") == "node-42")
+        assert node["Labels"]["zone"] == "us-x1-a"
+        assert node["Alive"]
+    finally:
+        p.kill()
+        p.wait(timeout=10)
