@@ -37,6 +37,13 @@ class DDPPOConfig(PPOConfig):
         #: batch).
         self.steps_per_worker = 256
 
+    def training(self, *, steps_per_worker=None,
+                 **kwargs) -> "DDPPOConfig":
+        super().training(**kwargs)
+        if steps_per_worker is not None:
+            self.steps_per_worker = steps_per_worker
+        return self
+
 
 def _flat(grads):
     import jax
