@@ -331,6 +331,7 @@ class NodeConnection:
             "fn_id": spec.function_id,
             "payload": _dumps((args, kwargs)),
             "name": spec.name,
+            "task_id": spec.task_id.hex(),
             "runtime_env": spec.runtime_env,
             "tpu_ids": getattr(spec, "_tpu_ids", None),
             "num_cpus": float(getattr(spec, "resources", {}).get(
@@ -374,6 +375,7 @@ class NodeConnection:
             "fn_id": spec.function_id,
             "payload": _dumps((args, kwargs)),
             "name": spec.name,
+            "task_id": spec.task_id.hex(),
             "runtime_env": spec.runtime_env,
             "tpu_ids": getattr(spec, "_tpu_ids", None),
             "store_limit": store_limit,
@@ -401,6 +403,7 @@ class NodeConnection:
             "fn_id": spec.function_id,
             "payload": _dumps((args, kwargs)),
             "name": spec.name,
+            "task_id": spec.task_id.hex(),
             "runtime_env": spec.runtime_env,
             "tpu_ids": getattr(spec, "_tpu_ids", None),
         }, fn_resolver=lambda: self._function_payload(
@@ -491,6 +494,7 @@ class HeadServer:
         self.address = self._listener.getsockname()[:2]
         self._threads = []
         self._conns: Dict[Any, NodeConnection] = {}
+        self._client_sessions: list = []
         self._closed = False
         # Shared continuation executor for async remote-task completions:
         # a SMALL fixed pool — head thread count stays bounded no matter
@@ -615,6 +619,32 @@ class HeadServer:
             sock.settimeout(15)
             register = _loads(_recv_frame(sock))
             sock.settimeout(None)
+            if register.get("type") == "client_runtime":
+                # A daemon/worker-side user-code process binding a
+                # connected runtime (client_runtime.py) — the anti-
+                # split-brain surface: nested submits, named actors,
+                # refs all resolve against THIS head.
+                from ray_tpu._private.client_runtime import ClientSession
+                from ray_tpu._private.worker import global_worker as _gw
+                session = ClientSession(
+                    self.runtime, sock, addr,
+                    on_close=self._client_sessions_discard)
+                _send_frame(sock, _dumps({
+                    "type": "client_registered",
+                    "job_id": self.runtime.job_id.hex(),
+                    "session_id": self.runtime.session_id,
+                    "namespace": _gw.namespace,
+                    "head_node_id": self.runtime.head_node_id.hex(),
+                    "num_cpus": self.runtime.node_resources.num_cpus,
+                    "num_tpus": self.runtime.node_resources.num_tpus,
+                }))
+                self._client_sessions.append(session)
+                threading.Thread(target=session.serve,
+                                 name="ray_tpu-client-session",
+                                 daemon=True).start()
+                GLOBAL.record("head.client_session",
+                              _time.monotonic() - _t0)
+                return
             if register.get("type") == "health_channel":
                 # Second connection from an already-registered daemon,
                 # reserved for liveness pings. (Snapshot: recv/health
@@ -675,6 +705,13 @@ class HeadServer:
         logger.info("Node daemon %s joined as %s with %s",
                     addr, node_id.hex()[:12], register["resources"])
 
+    def _client_sessions_discard(self, session) -> None:
+        """Dead client sessions must not accumulate under worker churn."""
+        try:
+            self._client_sessions.remove(session)
+        except ValueError:
+            pass
+
     def _on_conn_death(self, conn: NodeConnection) -> None:
         if self._closed:
             return
@@ -705,6 +742,9 @@ class HeadServer:
                 pass
             conn.close()
         self._conns.clear()
+        for session in self._client_sessions:
+            session.close()
+        self._client_sessions.clear()
         self.completion_pool.shutdown(wait=False)
 
 
@@ -902,8 +942,11 @@ class NodeDaemon:
         with self._pool_lock:
             if self._pool is None:
                 from ray_tpu._private.worker_process import WorkerProcessPool
+                # head_address: workers bind a ClientRuntime for nested
+                # ray_tpu API calls (see _private/client_runtime.py).
                 self._pool = WorkerProcessPool(
-                    store_name=self._table.arena_name)
+                    store_name=self._table.arena_name,
+                    head_address=self.head_address)
             return self._pool
 
     def _task_uses_worker_process(self, msg: dict) -> bool:
@@ -970,6 +1013,7 @@ class NodeDaemon:
                     "payload": _dumps((args, kwargs)),
                     "runtime_env": renv,
                     "name": msg.get("name", "task"),
+                    "task_id": msg.get("task_id"),
                 }
 
             def fn_payload():
@@ -1146,7 +1190,8 @@ class NodeDaemon:
         from ray_tpu._private.runtime import _task_context
         _task_context.spec = types.SimpleNamespace(
             _tpu_ids=msg.get("tpu_ids"), actor_id=None,
-            name=msg.get("name", ""))
+            name=msg.get("name", ""),
+            task_id_hex=msg.get("task_id"))
         try:
             renv = msg.get("runtime_env")
             if renv:

@@ -1204,14 +1204,60 @@ class Runtime:
             return True
 
     def _release_task_resources(self, spec: TaskSpec) -> None:
+        with self._lock:
+            # A blocked client get (client_get_release) already gave the
+            # resources back; consuming the flag here makes release
+            # exactly-once when the task finalizes mid-block.
+            blocked = getattr(spec, "_blocked_release", False)
+            spec._blocked_release = False  # type: ignore[attr-defined]
         pg_id, _ = self._pg_key(spec)
         node_id = getattr(spec, "_node_id", None)
         bidx = getattr(spec, "_acquired_bundle", -1)
-        self.scheduler.release(spec.resources, node_id, pg_id, bidx)
+        if not blocked:
+            self.scheduler.release(spec.resources, node_id, pg_id, bidx)
         tpu_ids = getattr(spec, "_tpu_ids", None)
         if tpu_ids and node_id is not None:
             self.scheduler.return_tpu_ids(node_id, tpu_ids)
             spec._tpu_ids = None  # type: ignore[attr-defined]
+
+    def client_get_release(self, task_id_hex: str) -> Optional[TaskSpec]:
+        """A client runtime's get blocked inside this running task:
+        release the task's resources so nested/dependent work can run
+        (the client-side analog of Runtime.get's own blocked-worker
+        release; reference: NotifyDirectCallTaskBlocked). Returns the
+        spec iff released — pass it to client_get_reacquire after."""
+        try:
+            task_id = TaskID(bytes.fromhex(task_id_hex))
+        except (ValueError, TypeError):
+            return None
+        with self._lock:
+            spec = self._inflight.get(task_id)
+            if spec is None or spec.kind != TaskKind.NORMAL or \
+                    not spec.resources:
+                return None
+            if getattr(spec, "_finalized", False) or \
+                    getattr(spec, "_blocked_release", False):
+                return None
+            spec._blocked_release = True  # type: ignore[attr-defined]
+        pg_id, _ = self._pg_key(spec)
+        self.scheduler.release(spec.resources,
+                               getattr(spec, "_node_id", None), pg_id,
+                               getattr(spec, "_acquired_bundle", -1))
+        self._dispatch()
+        return spec
+
+    def client_get_reacquire(self, spec: TaskSpec) -> None:
+        """Re-take the blocked task's resources once its get unblocked.
+        If the task finalized meanwhile, _release_task_resources consumed
+        the flag (and skipped its release) — nothing to re-take."""
+        with self._lock:
+            if not getattr(spec, "_blocked_release", False):
+                return
+            spec._blocked_release = False  # type: ignore[attr-defined]
+        pg_id, _ = self._pg_key(spec)
+        self.scheduler.force_acquire(
+            spec.resources, getattr(spec, "_node_id", None), pg_id,
+            getattr(spec, "_acquired_bundle", -1))
 
     def _finish_task(self, spec: TaskSpec, worker: Executor,
                      retried: bool = False) -> None:
@@ -1756,10 +1802,12 @@ class Runtime:
         """Open the head's TCP registration endpoint so node-daemon
         processes (`ray-tpu start --address host:port`) can join this
         cluster (reference: GCS server accepting raylet registration)."""
-        if self._head_server is None:
-            from ray_tpu._private.multinode import HeadServer
-            self._head_server = HeadServer(self, host, port)
-            self._head_server.start()
+        with self._lock:
+            if self._head_server is None:
+                from ray_tpu._private.multinode import HeadServer
+                server = HeadServer(self, host, port)
+                server.start()
+                self._head_server = server
         return self._head_server.address
 
     # -- internal KV (reference: gcs_kv_manager.h InternalKV) ----------
@@ -1954,12 +2002,22 @@ class Runtime:
     # -- process workers (reference: raylet WorkerPool) -----------------
 
     def _get_process_pool(self):
+        # Workers get a head address so nested ray_tpu API calls bind a
+        # ClientRuntime (the connected-runtime property; see
+        # _private/client_runtime.py) instead of an isolated auto-init.
+        # This opens the loopback head port implicitly — same trust model
+        # as the reference (every ray.init binds unauthenticated local
+        # ports); multi-tenant hosts share that exposure either way.
+        # start_head_server is idempotent + takes the lock itself; call it
+        # BEFORE taking the runtime lock here (no nested acquisition).
+        head_addr = self.start_head_server()
         with self._lock:
             if self._process_pool is None:
                 from ray_tpu._private.worker_process import WorkerProcessPool
                 native = self.store.native
                 self._process_pool = WorkerProcessPool(
-                    store_name=native.name if native is not None else None)
+                    store_name=native.name if native is not None else None,
+                    head_address=head_addr)
             return self._process_pool
 
     def _use_process_worker(self, spec: TaskSpec) -> bool:
@@ -1997,6 +2055,7 @@ class Runtime:
             "fn_id": spec.function_id,
             "fn_bytes": fn_bytes,
             "method": method,
+            "task_id": spec.task_id.hex(),
             "payload": serialization.serialize((args, kwargs)),
             "runtime_env": {k: v for k, v in (spec.runtime_env or
                                               {}).items()

@@ -34,9 +34,20 @@ class Worker:
 
     @property
     def runtime(self) -> Runtime:
+        rt = self._runtime
+        if rt is not None and getattr(rt, "is_client", False) and rt.closed:
+            # The head connection died (head restart): drop the stale
+            # client runtime so the next use reconnects.
+            with self._lock:
+                if self._runtime is rt:
+                    self.set_runtime(None)
         if self._runtime is None:
             # Auto-init on first use, matching the reference's behavior of
-            # implicit ray.init() in ray.get/put/remote.
+            # implicit ray.init() in ray.get/put/remote. In a daemon/worker
+            # execution context this binds a ClientRuntime wired to the
+            # head (never an isolated local runtime — the anti-split-brain
+            # rule; reference: every worker embeds a CoreWorker connected
+            # to the GCS, core_worker.cc:1762).
             init()
         return self._runtime
 
@@ -46,6 +57,21 @@ class Worker:
 
 
 global_worker = Worker()
+
+
+def _client_context_address():
+    """Detect a daemon/worker execution context: returns the head's
+    (host, port) when this process should bind a ClientRuntime, else
+    None (this process is — or may become — a head/driver)."""
+    from ray_tpu._private import multinode as _mn
+    daemon = _mn._current_daemon
+    if daemon is not None:
+        return tuple(daemon.head_address)
+    addr = os.environ.get("RAY_TPU_HEAD_ADDRESS")
+    if addr:
+        host, _, port = addr.rpartition(":")
+        return (host or "127.0.0.1", int(port))
+    return None
 
 
 def init(
@@ -77,6 +103,18 @@ def init(
             raise RuntimeError(
                 "Calling init() again after it has already been called. "
                 "Pass ignore_reinit_error=True to suppress this error.")
+        client_addr = _client_context_address()
+        if client_addr is not None:
+            # User code executing inside a node daemon or a worker
+            # subprocess: bind a head-connected ClientRuntime so nested
+            # .remote(), get_actor, refs, and PGs all resolve cluster-wide
+            # (_private/client_runtime.py; reference: CoreWorker-in-every-
+            # worker, gcs_actor_manager.cc:241 named-actor resolution).
+            from ray_tpu._private.client_runtime import ClientRuntime
+            runtime = ClientRuntime(client_addr)
+            global_worker.set_runtime(runtime, runtime.job_id)
+            global_worker.namespace = namespace or runtime.namespace
+            return ClientContext(global_worker)
         if address is not None and address.startswith("ray://"):
             raise ValueError(
                 f"Thin-client connections use the client API: "
@@ -223,7 +261,13 @@ def get_actor(name: str, namespace: Optional[str] = None):
     actor_id = runtime.get_named_actor(
         name, namespace or global_worker.namespace)
     state = runtime.actor_state(actor_id)
-    cls = runtime.functions.load(state.creation_spec.function_id)
+    try:
+        cls = runtime.functions.load(state.creation_spec.function_id)
+    except KeyError:
+        # Class bytes unavailable (unpicklable head-local class looked up
+        # from a client runtime): the handle still works — methods bind by
+        # name, the class is only cosmetic here.
+        cls = None
     return ActorHandle(actor_id, cls, name=name)
 
 

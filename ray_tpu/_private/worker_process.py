@@ -200,9 +200,19 @@ class WorkerProcessPool:
     to the idle pool."""
 
     def __init__(self, store_name: Optional[str] = None,
-                 max_workers: int = 64):
+                 max_workers: int = 64,
+                 head_address=None):
         self.store_name = store_name
         self.max_workers = max_workers
+        # Workers inherit the head address so nested ray_tpu API calls in
+        # user code bind a ClientRuntime wired to the head (the connected-
+        # runtime property; _private/client_runtime.py) instead of
+        # auto-initializing an isolated split-brain runtime.
+        self._env_overrides: Optional[Dict[str, str]] = None
+        if head_address is not None:
+            host, port = tuple(head_address)
+            self._env_overrides = {
+                "RAY_TPU_HEAD_ADDRESS": f"{host}:{port}"}
         self._idle: Dict[str, list] = {}
         self._all: list = []
         self._lock = threading.Lock()
@@ -264,6 +274,7 @@ class WorkerProcessPool:
                 continue  # re-enter: capacity freed
             w = self._spawner.submit(
                 _spawn_worker, self.store_name,
+                env_overrides=self._env_overrides,
                 python_exe=python_exe).result()
             w.pool_key = key
             with self._lock:
@@ -440,6 +451,15 @@ class _WorkerMain:
             fn = getattr(self._actor, msg["method"])
         else:
             fn = self._load_function(msg["fn_id"], msg.get("fn_bytes"))
+        # Task context: get_tpu_ids / nested client-runtime gets read it
+        # (a blocked nested get ships task_id so the head can release the
+        # task's resources while it waits).
+        import types as _types
+
+        from ray_tpu._private.runtime import _task_context
+        _task_context.spec = _types.SimpleNamespace(
+            _tpu_ids=None, actor_id=None, name=msg.get("name", ""),
+            task_id_hex=msg.get("task_id"))
         pinned_keys: list = []
         try:
             args, kwargs = _loads(msg["payload"])
@@ -476,6 +496,7 @@ class _WorkerMain:
             else:
                 result = invoke()
         finally:
+            _task_context.spec = None
             arena = self._arena
             for key in pinned_keys:
                 try:
