@@ -70,6 +70,7 @@ class NodeObjectTable:
         self._heap: Dict[str, bytes] = {}
         self._lock = threading.Lock()
         self._arena = None
+        self.admission = None  # Optional[PullAdmission]
         self.stats = {"pulled_bytes": 0, "served_bytes": 0,
                       "pulls": 0, "serves": 0}
         # Best-effort usage accounting for the resource syncer (the
@@ -191,6 +192,59 @@ class NodeObjectTable:
         self._heap.clear()
 
 
+#: Pull priority classes (reference: pull_manager.h BundlePriority —
+#: task ARGS beat task returns beat plain gets when budget is scarce).
+PULL_PRIORITY_TASK_ARGS = 0
+PULL_PRIORITY_WORKER_ARGS = 1
+PULL_PRIORITY_GET = 2
+
+
+class PullAdmission:
+    """Bounds bytes simultaneously in flight into one node's table
+    (reference: pull_manager.h:52 PullManager): a pull learns its size
+    from the serving peer's header, then waits here until the budget
+    admits it — highest-priority waiter first, FIFO within a class. An
+    object larger than the whole budget is admitted alone (head-of-line,
+    budget idle) rather than deadlocking."""
+
+    def __init__(self, max_inflight_bytes: int):
+        self.capacity = max(1, int(max_inflight_bytes))
+        self._inflight = 0
+        self._seq = 0
+        self._waiting: list = []  # sorted (priority, seq) keys
+        self._cond = threading.Condition()
+        self.stats = {"admitted": 0, "waited": 0, "peak_inflight": 0}
+
+    def acquire(self, nbytes: int, priority: int = PULL_PRIORITY_GET
+                ) -> None:
+        with self._cond:
+            self._seq += 1
+            me = (priority, self._seq)
+            import bisect
+            bisect.insort(self._waiting, me)
+            waited = False
+            while True:
+                fits = self._inflight + nbytes <= self.capacity or \
+                    (self._inflight == 0 and nbytes > self.capacity)
+                if fits and self._waiting[0] == me:
+                    self._waiting.pop(0)
+                    self._inflight += nbytes
+                    self.stats["admitted"] += 1
+                    if waited:
+                        self.stats["waited"] += 1
+                    self.stats["peak_inflight"] = max(
+                        self.stats["peak_inflight"], self._inflight)
+                    self._cond.notify_all()
+                    return
+                waited = True
+                self._cond.wait(timeout=1.0)
+
+    def release(self, nbytes: int) -> None:
+        with self._cond:
+            self._inflight -= nbytes
+            self._cond.notify_all()
+
+
 class ObjectServer:
     """Serves chunked object pulls from this node's table to peers.
 
@@ -271,12 +325,17 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
-                timeout: float = 30.0, retries: int = 2) -> None:
+                timeout: float = 30.0, retries: int = 2,
+                priority: int = PULL_PRIORITY_GET) -> None:
     """Pull one object from a peer's object server into the local table
     (read it back with ``table.pinned``). Retries transient connect
     failures; raises ObjectPullError when the owner is unreachable or
-    lacks the object."""
+    lacks the object. In-flight bytes are bounded by the table's
+    PullAdmission (if set): the size header is read first, admission is
+    acquired for the body (args-first priority), released when the body
+    lands."""
     last: Optional[BaseException] = None
+    admission = getattr(table, "admission", None)
     for _ in range(retries + 1):
         try:
             with socket.create_connection(tuple(addr),
@@ -289,7 +348,13 @@ def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
                     raise ObjectPullError(
                         f"object {key} is not resident on {addr} "
                         "(freed or evicted before the pull)")
-                table.recv_into(key, size, sock)
+                if admission is not None:
+                    admission.acquire(size, priority)
+                try:
+                    table.recv_into(key, size, sock)
+                finally:
+                    if admission is not None:
+                        admission.release(size)
                 table._bump("pulled_bytes", size)
                 table._bump("pulls")
                 return

@@ -23,6 +23,9 @@ _PY_DEFAULTS: Dict[str, Any] = {
     # (tests/test_ray_config.py) diffs the two tables.
     "scheduler_spread_threshold": 0.5,
     "max_pending_lease_requests_per_scheduling_category": 10,
+    "worker_lease_enabled": True,
+    "max_tasks_in_flight_per_worker": 10,
+    "pull_manager_max_inflight_bytes": 268435456,
     "worker_prestart_count": 1,
     "worker_cap_multiplier": 8,
     "worker_cap_min": 64,

@@ -64,6 +64,14 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     // -- scheduling (raylet/scheduling defaults) --
     FLAG_DBL(scheduler_spread_threshold, 0.5),
     FLAG_INT(max_pending_lease_requests_per_scheduling_category, 10),
+    // Worker leasing (reference: direct_task_transport.cc OnWorkerIdle):
+    // same-class tasks pipeline onto a leased daemon worker without
+    // per-task scheduler involvement, up to this many in flight.
+    FLAG_BOOL(worker_lease_enabled, true),
+    FLAG_INT(max_tasks_in_flight_per_worker, 10),
+    // Pull admission control (reference: pull_manager.h:52): bound on
+    // bytes simultaneously in flight into one node's object table.
+    FLAG_INT(pull_manager_max_inflight_bytes, 268435456),
     FLAG_INT(worker_prestart_count, 1),
     FLAG_INT(worker_cap_multiplier, 8),
     FLAG_INT(worker_cap_min, 64),
