@@ -237,15 +237,9 @@ class _ClientFunctions:
                 "serialized, so it cannot be submitted from a remote "
                 "worker context (the head must receive its bytes). Make "
                 f"it importable/picklable. Underlying error: {exc}")
-        fn_id = hashlib.sha1(payload).digest()
+        fn_id = self.export_bytes(payload)
         with self._lock:
-            known = fn_id in self._shipped
-            self._by_id.setdefault(fn_id, payload)
             self._loaded.setdefault(fn_id, fn)
-        if not known:
-            self._conn.request({"op": "reg_fn", "payload": payload})
-            with self._lock:
-                self._shipped.add(fn_id)
         return fn_id
 
     def export_bytes(self, payload: bytes) -> bytes:

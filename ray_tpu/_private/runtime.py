@@ -219,8 +219,22 @@ class AsyncioActorExecutor(Executor):
         asyncio.run_coroutine_threadsafe(_run(), self._loop)
 
     def stop(self, wait: bool = False):
+        import asyncio
         self.dead = True
-        self._loop.call_soon_threadsafe(self._loop.stop)
+
+        def _cancel_then_stop():
+            # Cancel parked tasks ON the loop so their cleanup runs here,
+            # now, while the runtime is alive — never later in a random
+            # thread's garbage collector (long-poll actor methods park
+            # for tens of seconds; see _acall's GeneratorExit guard).
+            for task in asyncio.all_tasks(self._loop):
+                task.cancel()
+            self._loop.call_later(0.1, self._loop.stop)
+
+        try:
+            self._loop.call_soon_threadsafe(_cancel_then_stop)
+        except RuntimeError:
+            pass  # loop already closed
         if wait:
             self._thread.join(timeout=5)
 
@@ -252,6 +266,36 @@ class ActorState:
         # Dep-resolved tasks that arrived before __init__ finished, in order.
         self.pre_creation_queue: List[TaskSpec] = []
         self.resources_released = False
+
+
+class _WorkerLease:
+    """One worker lease (reference: direct_task_transport.cc:174
+    OnWorkerIdle + lease_policy.cc): a single resource acquisition on a
+    remote daemon that a stream of same-scheduling-class tasks pipelines
+    onto. The daemon runs leased tasks serially on a dedicated executor
+    (with a worker subprocess pinned for the lease's lifetime), so one
+    acquisition still means one task *running* at a time — the up-to-
+    ``max_tasks_in_flight_per_worker`` extras ride the wire early instead
+    of paying a head dispatch round-trip each."""
+
+    __slots__ = ("lease_id", "class_key", "node_id", "resources", "pg_id",
+                 "bidx", "tpu_ids", "inflight", "dropped", "blocked")
+
+    def __init__(self, lease_id: str, class_key, node_id, resources,
+                 pg_id, bidx, tpu_ids):
+        self.lease_id = lease_id
+        self.class_key = class_key
+        self.node_id = node_id
+        self.resources = resources
+        self.pg_id = pg_id
+        self.bidx = bidx
+        self.tpu_ids = tpu_ids
+        self.inflight = 1  # the creating task
+        self.dropped = False
+        # The lease's RUNNING task is blocked in a nested get: skip new
+        # attaches and spill the daemon-side queue (deadlock safety —
+        # a child queued behind its blocked parent could never run).
+        self.blocked = False
 
 
 class Runtime:
@@ -290,6 +334,14 @@ class Runtime:
         self._idle_workers: List[Executor] = []
         self._all_workers: List[Executor] = []
         self._ready: List[TaskSpec] = []
+        # Leasable NORMAL tasks queue per scheduling class (reference:
+        # cluster_task_manager tasks_to_schedule_ by SchedulingClass):
+        # same-class tasks are placement-interchangeable, so dispatch
+        # probes ONE representative per class instead of scanning every
+        # queued task — O(#classes), not O(#tasks), when saturated.
+        from collections import deque as _deque
+        self._ready_by_class: Dict[Any, Any] = {}
+        self._deque = _deque
         self._pending_by_oid: Dict[ObjectID, List[_PendingTask]] = {}
         self._inflight: Dict[TaskID, TaskSpec] = {}
         self._actors: Dict[ActorID, ActorState] = {}
@@ -311,6 +363,26 @@ class Runtime:
         # (cluster_utils) never appear here.
         self._remote_nodes: Dict[NodeID, Any] = {}
         self._head_server = None
+        # Worker leases (reference: direct_task_transport.cc OnWorkerIdle):
+        # class_key -> live leases. Guarded by self._lock.
+        self._leases: Dict[Any, List[_WorkerLease]] = {}
+        self._lease_counter = 0
+        # Class keys dispatch saw feasible-but-capacity-blocked in its
+        # last full scan: a draining lease releases early iff a class
+        # OTHER than its own is starved (lease fairness without churn).
+        self._lease_contended: set = set()
+        self.lease_stats = {"created": 0, "attached": 0, "released": 0}
+        self._lease_window = max(
+            1, int(self.config.max_tasks_in_flight_per_worker))
+        self._lease_enabled = bool(self.config.worker_lease_enabled)
+        # Submit/completion hot-path flags, read once: config.get is a
+        # native ctypes round-trip — 5 per task adds up at 10k tasks/s.
+        self._cfg_inline_limit = int(
+            self.config.remote_object_inline_limit_bytes)
+        self._cfg_max_task_events = int(self.config.max_task_events)
+        self._cfg_lineage_max = int(self.config.lineage_max_entries)
+        self._cfg_obj_loc_max = int(
+            self.config.object_locations_max_entries)
         # ObjectID → (NodeID, daemon object key) for results resident on
         # node daemons (fetched lazily; see ObjectStore.put_remote).
         self._remote_values: Dict[ObjectID, Tuple[NodeID, str]] = {}
@@ -594,7 +666,7 @@ class Runtime:
         if spec.num_returns == 0:
             refs = []
         with self._lock:
-            if len(self._lineage) < self.config.lineage_max_entries:
+            if len(self._lineage) < self._cfg_lineage_max:
                 for oid in spec.return_ids:
                     self._lineage[oid] = spec
         self._register_task_refs(spec)
@@ -683,9 +755,7 @@ class Runtime:
         if spec.kind == TaskKind.ACTOR_TASK:
             self._dispatch_actor_task(spec)
         else:
-            with self._lock:
-                self._ready.append(spec)
-            self._dispatch()
+            self._dispatch_single(spec)
 
     # ------------------------------------------------------------------
     # Dispatch
@@ -703,6 +773,281 @@ class Runtime:
                 bundle = -1
         return pg_id, bundle
 
+    # ------------------------------------------------------------------
+    # Worker leases (reference: direct_task_transport.cc + lease_policy)
+    # ------------------------------------------------------------------
+
+    def _lease_class(self, spec: TaskSpec):
+        """Scheduling class for worker leasing (reference:
+        scheduling_class_util): tasks sharing one are placement-
+        interchangeable and may pipeline onto one lease. None means the
+        task is not leasable (actors, affinity/spread strategies — those
+        carry per-task placement intent)."""
+        key = getattr(spec, "_lease_key", False)
+        if key is not False:
+            return key
+        key = None
+        if self._lease_enabled and spec.kind == TaskKind.NORMAL:
+            strategy = spec.scheduling_strategy
+            pg_id, bundle = self._pg_key(spec)
+            if strategy is None or strategy == "DEFAULT" or pg_id is not None:
+                try:
+                    renv = repr(sorted((spec.runtime_env or {}).items()))
+                    res = tuple(sorted((spec.resources or {}).items()))
+                    key = (spec.function_id, res, renv, pg_id, bundle)
+                except TypeError:
+                    key = None
+        spec._lease_key = key  # type: ignore[attr-defined]
+        return key
+
+    def _find_lease(self, class_key) -> Optional[_WorkerLease]:
+        """An attachable live lease for this class (caller holds _lock)."""
+        for lease in self._leases.get(class_key, ()):
+            if not lease.dropped and not lease.blocked \
+                    and lease.inflight < self._lease_window \
+                    and lease.node_id in self._remote_nodes:
+                return lease
+        return None
+
+    def _lease_task_done(self, spec: TaskSpec, lease: _WorkerLease) -> None:
+        """Completion bookkeeping for a leased task. A lease that drains
+        either TAKES the next queued same-class task right here (so a
+        kept-alive lease always has a completion coming to re-evaluate
+        it — a passively "kept" idle lease would leak its resources if
+        the queued work later launched elsewhere or was cancelled) or
+        drops and releases. Contention from OTHER classes forces the
+        drop, so starved classes get the scheduler's arbitration."""
+        drop = False
+        next_spec = None
+        with self._lock:
+            lease.inflight -= 1
+            if lease.dropped:
+                return  # node death already tore it down
+            if lease.inflight <= 0:
+                starved_other = any(k != lease.class_key
+                                    for k in self._lease_contended)
+                dq = self._ready_by_class.get(lease.class_key)
+                if dq and not starved_other and not lease.blocked and \
+                        lease.node_id in self._remote_nodes:
+                    next_spec = dq.popleft()
+                    if not dq:
+                        del self._ready_by_class[lease.class_key]
+                    self._inflight[next_spec.task_id] = next_spec
+                    next_spec._node_id = lease.node_id
+                    next_spec._acquired_bundle = lease.bidx
+                    next_spec._lease = lease  # type: ignore[attr-defined]
+                    next_spec._tpu_ids = lease.tpu_ids
+                    lease.inflight += 1
+                    next_spec.invalidated = False
+                    next_spec._finalized = False
+                    self.lease_stats["attached"] += 1
+                else:
+                    lease.dropped = True
+                    lst = self._leases.get(lease.class_key)
+                    if lst is not None:
+                        try:
+                            lst.remove(lease)
+                        except ValueError:
+                            pass
+                        if not lst:
+                            del self._leases[lease.class_key]
+                    drop = True
+        if next_spec is not None:
+            self._launch(next_spec, None)
+            return
+        if drop:
+            self.scheduler.release(lease.resources, lease.node_id,
+                                   lease.pg_id, lease.bidx)
+            if lease.tpu_ids:
+                self.scheduler.return_tpu_ids(lease.node_id, lease.tpu_ids)
+            self.lease_stats["released"] += 1
+            conn = self._remote_nodes.get(lease.node_id)
+            if conn is not None:
+                conn.drop_lease(lease.lease_id)
+
+    def _drop_node_leases(self, node_id: NodeID) -> None:
+        """Node death: its leases vanish with it — the scheduler already
+        dropped the node's resources wholesale, so no release here."""
+        with self._lock:
+            for key in list(self._leases):
+                lst = self._leases[key]
+                for lease in lst[:]:
+                    if lease.node_id == node_id:
+                        lease.dropped = True
+                        lst.remove(lease)
+                if not lst:
+                    del self._leases[key]
+
+    def _try_launch_locked(self, spec: TaskSpec, blocked: list):
+        """Attempt to launch ONE ready spec (caller holds _lock; the spec
+        is NOT in self._ready from this method's point of view — callers
+        pop/skip-queue on non-None). Returns:
+
+        * ``(spec, worker)`` — launched; caller runs the launch tail
+          outside the lock (worker None = async remote send).
+        * ``"error"`` — failed fast (error stored); drop it.
+        * ``None`` — not launchable now; leave/put it in the queue.
+
+        Capacity-blocked class keys append to ``blocked`` (lease-fairness
+        signal)."""
+        class_key = self._lease_class(spec)
+        pg_id, bundle = self._pg_key(spec)
+        if not self.scheduler.is_feasible(
+                spec.resources, pg_id, bundle,
+                spec.scheduling_strategy):
+            # Hard node-affinity to a dead/unknown node can never
+            # succeed: fail fast (reference behavior). Anything
+            # else stays queued as autoscaler demand — the
+            # reference warns and waits for the cluster to grow.
+            from ray_tpu.util.scheduling_strategies import (
+                NodeAffinitySchedulingStrategy)
+            strategy = spec.scheduling_strategy
+            if pg_id is not None:
+                # PG-targeted infeasibility can never be fixed by
+                # cluster growth: either the PG was removed, or
+                # the bundle's fixed capacity is exceeded.
+                if self.scheduler.placement_group_exists(pg_id):
+                    msg = (f"Task {spec.name} requires "
+                           f"{spec.resources} which exceeds the "
+                           "capacity of its placement group "
+                           "bundle.")
+                else:
+                    msg = (f"Task {spec.name} was scheduled into "
+                           "a placement group that does not "
+                           "exist (removed or never created).")
+                self._store_error(spec, ValueError(msg))
+                return "error"
+            if isinstance(strategy,
+                          NodeAffinitySchedulingStrategy) and \
+                    not strategy.soft:
+                self._store_error(spec, ValueError(
+                    f"Task {spec.name} has hard node affinity to "
+                    f"node {strategy.node_id}, which is not alive "
+                    "or lacks the required resources."))
+                return "error"
+            if spec.task_id not in self._infeasible_warned:
+                self._infeasible_warned.add(spec.task_id)
+                logger.warning(
+                    "Task %s requires %s which no alive node "
+                    "satisfies (cluster total: %s). It will stay "
+                    "pending until the cluster grows (autoscaler "
+                    "demand).", spec.name, spec.resources,
+                    self.scheduler.total)
+            return None
+        acquired = self.scheduler.try_acquire(
+            spec.resources, pg_id, bundle,
+            strategy=spec.scheduling_strategy)
+        if acquired is None:
+            # No idle capacity: fall back to pipelining onto a live lease
+            # of this class (reference: pipelining SUPPLEMENTS additional
+            # lease requests, it never replaces them — idle CPUs always
+            # win over queueing behind a busy worker).
+            if class_key is not None:
+                lease = self._find_lease(class_key)
+                if lease is not None:
+                    self._inflight[spec.task_id] = spec
+                    spec._node_id = lease.node_id
+                    spec._acquired_bundle = lease.bidx
+                    spec._lease = lease  # type: ignore[attr-defined]
+                    spec._tpu_ids = lease.tpu_ids
+                    lease.inflight += 1
+                    spec.invalidated = False
+                    spec._finalized = False
+                    self.lease_stats["attached"] += 1
+                    return (spec, None)
+            blocked.append(class_key)
+            return None
+        node_id, bidx = acquired
+        # Normal tasks on a remote daemon take the ASYNC path:
+        # no head worker thread is parked for them (reference:
+        # callback-driven direct task transport) — head thread
+        # count stays flat as the cluster widens.
+        conn = self._remote_nodes.get(node_id)
+        if conn is not None and spec.kind == TaskKind.NORMAL:
+            worker = None
+        else:
+            worker = self._pop_worker()
+            if worker is None:
+                self.scheduler.release(spec.resources, node_id,
+                                       pg_id, bidx)
+                return None
+        self._inflight[spec.task_id] = spec
+        spec._node_id = node_id  # type: ignore[attr-defined]
+        spec._acquired_bundle = bidx  # type: ignore[attr-defined]
+        spec.invalidated = False
+        # App-level retries redispatch the same spec: re-arm the
+        # exactly-once finalize claim for the new attempt.
+        spec._finalized = False  # type: ignore[attr-defined]
+        n_tpus = int(spec.resources.get("TPU", 0))
+        if n_tpus >= 1:
+            spec._tpu_ids = (  # type: ignore[attr-defined]
+                self.scheduler.take_tpu_ids(node_id, n_tpus))
+        spec._lease = None  # type: ignore[attr-defined]
+        if worker is None and class_key is not None:
+            # First task of its class on this node: open a
+            # lease — followers pipeline onto it above.
+            self._lease_counter += 1
+            lease = _WorkerLease(
+                f"ls-{self._lease_counter}", class_key,
+                node_id, dict(spec.resources or {}), pg_id,
+                bidx, getattr(spec, "_tpu_ids", None))
+            self._leases.setdefault(class_key,
+                                    []).append(lease)
+            spec._lease = lease  # type: ignore[attr-defined]
+            self.lease_stats["created"] += 1
+        return (spec, worker)
+
+    def _launch(self, spec: TaskSpec, worker) -> None:
+        """Launch tail (outside the lock) for a _try_launch_locked hit."""
+        import time as _time
+        spec._start_time = _time.monotonic()  # type: ignore[attr-defined]
+        self._record_event(spec, "RUNNING")
+        if worker is None:
+            self._submit_remote_async(spec)
+        elif spec.kind == TaskKind.ACTOR_CREATION:
+            worker.submit(lambda s=spec, w=worker: self._run_actor_creation(s, w))
+        else:
+            worker.submit(lambda s=spec, w=worker: self._run_normal_task(s, w))
+
+    def _queue_ready_locked(self, spec: TaskSpec) -> None:
+        ck = self._lease_class(spec)
+        if ck is None:
+            self._ready.append(spec)
+        else:
+            dq = self._ready_by_class.get(ck)
+            if dq is None:
+                dq = self._ready_by_class[ck] = self._deque()
+            dq.append(spec)
+
+    def _ready_specs_locked(self):
+        """All queued-ready specs, class buckets first (caller holds
+        _lock; iteration order is the dispatch probe order)."""
+        for dq in self._ready_by_class.values():
+            yield from dq
+        yield from self._ready
+
+    def _dispatch_single(self, spec: TaskSpec) -> None:
+        """O(1) dispatch for one just-ready task — the submit hot path:
+        try a lease attach or a direct acquisition for THIS spec only and
+        queue it otherwise. Full _dispatch() scans remain the capacity-
+        freed path (completions, node joins)."""
+        self._chaos_delay("testing_dispatch_delay_us")
+        with self._lock:
+            if self._shutdown:
+                return
+            ck = self._lease_class(spec)
+            if ck is not None and self._ready_by_class.get(ck):
+                # FIFO within a class: earlier same-class submits go first.
+                self._ready_by_class[ck].append(spec)
+                return
+            res = self._try_launch_locked(spec, [])
+            if res is None:
+                self._queue_ready_locked(spec)
+                return
+            if res == "error":
+                return
+        self._launch(*res)
+
     def _dispatch(self) -> None:
         self._chaos_delay("testing_dispatch_delay_us")
         while True:
@@ -710,101 +1055,40 @@ class Runtime:
             with self._lock:
                 if self._shutdown:
                     return
-                for i, spec in enumerate(self._ready):
-                    pg_id, bundle = self._pg_key(spec)
-                    if not self.scheduler.is_feasible(
-                            spec.resources, pg_id, bundle,
-                            spec.scheduling_strategy):
-                        # Hard node-affinity to a dead/unknown node can never
-                        # succeed: fail fast (reference behavior). Anything
-                        # else stays queued as autoscaler demand — the
-                        # reference warns and waits for the cluster to grow.
-                        from ray_tpu.util.scheduling_strategies import (
-                            NodeAffinitySchedulingStrategy)
-                        strategy = spec.scheduling_strategy
-                        if pg_id is not None:
-                            # PG-targeted infeasibility can never be fixed by
-                            # cluster growth: either the PG was removed, or
-                            # the bundle's fixed capacity is exceeded.
-                            self._ready.pop(i)
-                            if self.scheduler.placement_group_exists(pg_id):
-                                msg = (f"Task {spec.name} requires "
-                                       f"{spec.resources} which exceeds the "
-                                       "capacity of its placement group "
-                                       "bundle.")
-                            else:
-                                msg = (f"Task {spec.name} was scheduled into "
-                                       "a placement group that does not "
-                                       "exist (removed or never created).")
-                            self._store_error(spec, ValueError(msg))
-                            launched = True  # re-enter loop
-                            break
-                        if isinstance(strategy,
-                                      NodeAffinitySchedulingStrategy) and \
-                                not strategy.soft:
-                            self._ready.pop(i)
-                            self._store_error(spec, ValueError(
-                                f"Task {spec.name} has hard node affinity to "
-                                f"node {strategy.node_id}, which is not alive "
-                                "or lacks the required resources."))
-                            launched = True  # re-enter loop
-                            break
-                        if spec.task_id not in self._infeasible_warned:
-                            self._infeasible_warned.add(spec.task_id)
-                            logger.warning(
-                                "Task %s requires %s which no alive node "
-                                "satisfies (cluster total: %s). It will stay "
-                                "pending until the cluster grows (autoscaler "
-                                "demand).", spec.name, spec.resources,
-                                self.scheduler.total)
+                blocked: list = []
+                # Class buckets: probe ONE representative per class —
+                # same-class tasks are interchangeable, so its verdict
+                # (launch / error / blocked) covers the whole bucket.
+                for ck, dq in self._ready_by_class.items():
+                    if not dq:
                         continue
-                    acquired = self.scheduler.try_acquire(
-                        spec.resources, pg_id, bundle,
-                        strategy=spec.scheduling_strategy)
-                    if acquired is None:
+                    res = self._try_launch_locked(dq[0], blocked)
+                    if res is None:
                         continue
-                    node_id, bidx = acquired
-                    # Normal tasks on a remote daemon take the ASYNC path:
-                    # no head worker thread is parked for them (reference:
-                    # callback-driven direct task transport) — head thread
-                    # count stays flat as the cluster widens.
-                    conn = self._remote_nodes.get(node_id)
-                    if conn is not None and spec.kind == TaskKind.NORMAL:
-                        worker = None
-                    else:
-                        worker = self._pop_worker()
-                        if worker is None:
-                            self.scheduler.release(spec.resources, node_id,
-                                                   pg_id, bidx)
-                            continue
-                    self._ready.pop(i)
-                    self._inflight[spec.task_id] = spec
-                    spec._node_id = node_id  # type: ignore[attr-defined]
-                    spec._acquired_bundle = bidx  # type: ignore[attr-defined]
-                    spec.invalidated = False
-                    # App-level retries redispatch the same spec: re-arm the
-                    # exactly-once finalize claim for the new attempt.
-                    spec._finalized = False  # type: ignore[attr-defined]
-                    n_tpus = int(spec.resources.get("TPU", 0))
-                    if n_tpus >= 1:
-                        spec._tpu_ids = (  # type: ignore[attr-defined]
-                            self.scheduler.take_tpu_ids(node_id, n_tpus))
-                    launched = (spec, worker)
+                    dq.popleft()
+                    if not dq:
+                        del self._ready_by_class[ck]
+                    launched = True if res == "error" else res
                     break
+                if launched is None:
+                    # Unleasable tasks: FIFO scan (original semantics).
+                    for i, spec in enumerate(self._ready):
+                        res = self._try_launch_locked(spec, blocked)
+                        if res is None:
+                            continue
+                        self._ready.pop(i)
+                        launched = True if res == "error" else res
+                        break
+                if launched is None:
+                    # Full scan completed: remember which classes were
+                    # capacity-blocked (lease fairness: a draining lease
+                    # releases early iff a DIFFERENT class is starved).
+                    self._lease_contended = set(blocked)
             if launched is None or launched is True:
                 if launched is None:
                     return
                 continue
-            spec, worker = launched
-            import time as _time
-            spec._start_time = _time.monotonic()  # type: ignore[attr-defined]
-            self._record_event(spec, "RUNNING")
-            if worker is None:
-                self._submit_remote_async(spec)
-            elif spec.kind == TaskKind.ACTOR_CREATION:
-                worker.submit(lambda s=spec, w=worker: self._run_actor_creation(s, w))
-            else:
-                worker.submit(lambda s=spec, w=worker: self._run_normal_task(s, w))
+            self._launch(*launched)
 
     def _pop_worker(self) -> Optional[Executor]:
         if self._idle_workers:
@@ -886,7 +1170,7 @@ class Runtime:
                 # would make its death discard values we still hold.
                 if node_id not in self._remote_nodes and \
                         len(self._object_locations) < \
-                        self.config.object_locations_max_entries:
+                        self._cfg_obj_loc_max:
                     for oid in spec.return_ids:
                         self._object_locations[oid] = node_id
         n = spec.num_returns
@@ -1077,21 +1361,21 @@ class Runtime:
                     raise RemoteNodeDiedError(
                         "task's node vanished before the send")
                 args, kwargs = self._resolve_args(spec, conn)
+                lease = getattr(spec, "_lease", None)
                 conn.execute_task_async(
                     spec, self.functions, args, kwargs,
                     self._result_store_limit(spec),
                     lambda reply: self._complete_remote_task(spec, conn,
-                                                             reply))
+                                                             reply),
+                    lease_id=lease.lease_id if lease is not None else None)
             except BaseException as e:  # noqa: BLE001
                 self._remote_task_error(spec, e)
 
-        pool = getattr(self._head_server, "completion_pool", None)
-        if pool is not None:
-            try:
-                pool.submit(send)
-                return
-            except RuntimeError:
-                pass  # shutting down — run inline
+        # Inline send: the frame write is microseconds (args were already
+        # resolved to values/markers when the task became ready), and a
+        # pool hop per task costs more than it hides at 5k+ tasks/s. The
+        # REPLY is still callback-driven — no head thread parks while the
+        # daemon works.
         send()
 
     def _complete_remote_task(self, spec: TaskSpec, conn, reply: dict
@@ -1204,6 +1488,24 @@ class Runtime:
             return True
 
     def _release_task_resources(self, spec: TaskSpec) -> None:
+        lease = getattr(spec, "_lease", None)
+        if lease is not None:
+            # The LEASE owns the acquisition; this task only rode it.
+            spec._lease = None  # type: ignore[attr-defined]
+            with self._lock:
+                blocked = getattr(spec, "_blocked_release", False)
+                spec._blocked_release = False  # type: ignore[attr-defined]
+            if blocked:
+                lease.blocked = False
+                if not lease.dropped:
+                    # Finalized while blocked in a nested get (lease
+                    # capacity was lent out): re-take it so the lease's
+                    # eventual drop releases exactly once.
+                    self.scheduler.force_acquire(
+                        lease.resources, lease.node_id,
+                        lease.pg_id, lease.bidx)
+            self._lease_task_done(spec, lease)
+            return
         with self._lock:
             # A blocked client get (client_get_release) already gave the
             # resources back; consuming the flag here makes release
@@ -1238,11 +1540,34 @@ class Runtime:
             if getattr(spec, "_finalized", False) or \
                     getattr(spec, "_blocked_release", False):
                 return None
+            lease = getattr(spec, "_lease", None)
+            if lease is not None and lease.dropped:
+                return None
             spec._blocked_release = True  # type: ignore[attr-defined]
-        pg_id, _ = self._pg_key(spec)
-        self.scheduler.release(spec.resources,
-                               getattr(spec, "_node_id", None), pg_id,
-                               getattr(spec, "_acquired_bundle", -1))
+            if lease is not None:
+                # INSIDE the lock: _find_lease/_lease_task_done read
+                # blocked under it — set-after-release would let a
+                # dispatch attach a same-class child to this lease in
+                # the window, landing it behind its blocked parent.
+                lease.blocked = True
+        if lease is not None:
+            # A leased task blocks its lease's serial executor, so lending
+            # out the LEASE's acquisition is safe: nothing else can run on
+            # it until this task's get unblocks (composition: nested work
+            # must be schedulable while the parent waits). Tasks already
+            # pipelined BEHIND the blocked one daemon-side could include
+            # the very child being waited on — spill them to free threads
+            # and stop attaching until the get returns.
+            self.scheduler.release(lease.resources, lease.node_id,
+                                   lease.pg_id, lease.bidx)
+            conn = self._remote_nodes.get(lease.node_id)
+            if conn is not None:
+                conn.spill_lease(lease.lease_id)
+        else:
+            pg_id, _ = self._pg_key(spec)
+            self.scheduler.release(spec.resources,
+                                   getattr(spec, "_node_id", None), pg_id,
+                                   getattr(spec, "_acquired_bundle", -1))
         self._dispatch()
         return spec
 
@@ -1254,6 +1579,13 @@ class Runtime:
             if not getattr(spec, "_blocked_release", False):
                 return
             spec._blocked_release = False  # type: ignore[attr-defined]
+            lease = getattr(spec, "_lease", None)
+        if lease is not None:
+            lease.blocked = False
+            if not lease.dropped:
+                self.scheduler.force_acquire(lease.resources, lease.node_id,
+                                             lease.pg_id, lease.bidx)
+            return
         pg_id, _ = self._pg_key(spec)
         self.scheduler.force_acquire(
             spec.resources, getattr(spec, "_node_id", None), pg_id,
@@ -1557,6 +1889,13 @@ class Runtime:
                         _task_context.spec = None
                     self._store_results(spec, result)
                     self._record_event(spec, "FINISHED")
+                except GeneratorExit:
+                    # The garbage collector is closing a stale parked
+                    # coroutine (its actor's loop died — possibly from an
+                    # already-shut-down runtime). Touching runtime/native
+                    # state from the collector's context deadlocks;
+                    # kill_actor sealed this task's refs already.
+                    raise
                 except BaseException as e:  # noqa: BLE001
                     self._store_error(spec, TaskError(
                         e, traceback.format_exc(), spec.name))
@@ -1744,6 +2083,12 @@ class Runtime:
                     self._ready.pop(i)
                     self._store_error(spec, TaskCancelledError(task_id))
                     return
+            for dq in self._ready_by_class.values():
+                for spec in dq:
+                    if spec.task_id == task_id:
+                        dq.remove(spec)
+                        self._store_error(spec, TaskCancelledError(task_id))
+                        return
             for waiters in self._pending_by_oid.values():
                 for pending in waiters:
                     if pending.spec.task_id == task_id:
@@ -1844,8 +2189,8 @@ class Runtime:
             return [k for k in self._kv_mem.get(namespace, {})
                     if k.startswith(prefix)]
 
-    def register_remote_node(self, conn, info: Optional[dict] = None
-                             ) -> NodeID:
+    def register_remote_node(self, conn, info: Optional[dict] = None,
+                             dispatch: bool = True) -> NodeID:
         # The connection must be visible BEFORE dispatch can place tasks
         # on the new node — otherwise a queued task assigned to it would
         # find no conn and silently run head-local.
@@ -1863,7 +2208,12 @@ class Runtime:
             except Exception:  # noqa: BLE001 - best effort per actor
                 logger.exception("failed to rebind actor %s", actor_hex)
         self.scheduler.reschedule_lost_bundles()
-        self._dispatch()
+        if dispatch:
+            # NOT under the caller's conn._send_lock (the handshake path
+            # passes dispatch=False): task sends are inline, and sending
+            # on a connection whose send lock the caller already holds
+            # would self-deadlock.
+            self._dispatch()
         return node_id
 
     def _rebind_remote_actor(self, conn, node_id: NodeID,
@@ -1984,7 +2334,7 @@ class Runtime:
         tasks only — a multi-return tuple must come back whole)."""
         if spec.num_returns != 1:
             return 0
-        return int(self.config.remote_object_inline_limit_bytes)
+        return self._cfg_inline_limit
 
     def _invoke_user(self, spec: TaskSpec, fn, args, kwargs):
         """The user-code call seam: local nodes call directly (thread
@@ -2163,6 +2513,7 @@ class Runtime:
         state = self.scheduler.remove_node(node_id)
         if state is None:
             return
+        self._drop_node_leases(node_id)
         # 1) In-flight tasks on the dead node. A task whose results are
         # already sealed has effectively completed — its worker thread just
         # hasn't deregistered yet; retrying it would double-execute (the
@@ -2361,7 +2712,7 @@ class Runtime:
 
     def _record_event(self, spec: TaskSpec, status: str) -> None:
         import time as _time
-        if len(self._task_events) < self.config.max_task_events:
+        if len(self._task_events) < self._cfg_max_task_events:
             self._task_events.append({
                 "task_id": spec.task_id.hex(),
                 "name": spec.name,
@@ -2380,7 +2731,8 @@ class Runtime:
         the reference's backlog/demand report feeding autoscaler
         LoadMetrics)."""
         with self._lock:
-            return [dict(s.resources) for s in self._ready if s.resources]
+            return [dict(s.resources) for s in self._ready_specs_locked()
+                    if s.resources]
 
     def cluster_resources(self) -> Dict[str, float]:
         return dict(self.scheduler.total)

@@ -286,6 +286,22 @@ class WorkerProcessPool:
             w.stop()
             raise WorkerCrashedError("worker pool is shut down")
 
+    def prestart(self, n: int) -> None:
+        """Spawn up to ``n`` base-interpreter workers into the idle pool
+        ahead of demand (reference: worker_pool.h PrestartWorkers): the
+        Popen returns immediately and the child warms up concurrently,
+        so the first real task pays a queue pop instead of a process
+        start."""
+        def one():
+            try:
+                self.release(self.lease(None))
+            except Exception:  # noqa: BLE001 - prestart is best-effort
+                pass
+
+        for _ in range(max(0, n)):
+            threading.Thread(target=one, daemon=True,
+                             name="ray_tpu-worker-prestart").start()
+
     def release(self, w: WorkerHandle) -> None:
         if w.dead:
             # Reap killed workers here (the force-cancel/OOM path kills
@@ -558,13 +574,22 @@ def _main() -> None:
     # TPU work in the chip-owning process) — but site hooks that preload
     # jax would otherwise initialize the TPU backend here and DEADLOCK
     # on the chip's lockfile (/tmp/libtpu_lockfile) against the owning
-    # process. Env vars don't cut it (the same hooks override them);
-    # pin the platform in-process before any device use.
-    try:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:  # noqa: BLE001 - no jax, nothing to pin
-        pass
+    # process. If a hook DID preload jax (it is in sys.modules despite
+    # the spawn env scrub), pin the platform in-process before any
+    # device use. Otherwise do NOT import jax here — that costs seconds
+    # on every worker spawn — and let the env pin cover a later lazy
+    # import by user code.
+    if "jax" in sys.modules:
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 - nothing to pin
+            pass
+    else:
+        # Hard assignment, not setdefault: a site hook that re-exported
+        # JAX_PLATFORMS (without importing jax) must not win — user code
+        # importing jax later gets CPU, never the daemon-owned chip.
+        os.environ["JAX_PLATFORMS"] = "cpu"
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--fd", type=int, required=True)
