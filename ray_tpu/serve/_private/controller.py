@@ -51,6 +51,17 @@ class ServeController:
         self._deployments: Dict[str, DeploymentInfo] = {}
         self._membership_version = 0
         self._replica_seq = 0
+        # Long-poll wakeup (reference: _private/long_poll.py:68
+        # LongPollHost): created lazily inside the actor's event loop;
+        # replaced on every bump so each change wakes ALL parked waiters.
+        self._changed = None
+
+    def _bump_membership(self) -> None:
+        self._membership_version += 1
+        ev = self._changed
+        self._changed = None
+        if ev is not None:
+            ev.set()
 
     # -- desired state ---------------------------------------------------
 
@@ -94,7 +105,7 @@ class ServeController:
             return False
         for r in info.replicas:
             ray_tpu.kill(r)
-        self._membership_version += 1
+        self._bump_membership()
         return True
 
     async def shutdown(self) -> bool:
@@ -124,7 +135,7 @@ class ServeController:
         while len(info.replicas) > info.num_replicas:
             victim = info.replicas.pop()
             ray_tpu.kill(victim)
-        self._membership_version += 1
+        self._bump_membership()
         # Wait for replicas to become ready so run() returns a usable app.
         for r in info.replicas:
             ray_tpu.get(r.ready.remote())
@@ -162,6 +173,37 @@ class ServeController:
         if info is None:
             raise ValueError(f"Deployment {name!r} does not exist")
         return (self._membership_version, info.replicas,
+                info.max_concurrent_queries)
+
+    async def listen_for_change(self, key, last_version: int,
+                                timeout_s: float = 30.0):
+        """Long-poll (reference: LongPollHost.listen_for_change): parks
+        until the membership version moves past ``last_version`` (or the
+        keepalive timeout), then returns the current snapshot for
+        ``key`` — ("replicas", name) or "routes". Routers/proxies call
+        this from a background thread; the REQUEST path never does."""
+        import asyncio
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout_s
+        while self._membership_version <= last_version:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                break
+            if self._changed is None:
+                self._changed = asyncio.Event()
+            try:
+                await asyncio.wait_for(self._changed.wait(), remaining)
+            except asyncio.TimeoutError:
+                break
+        if key == "routes":
+            return (self._membership_version, await self.get_routes())
+        name = key[1]
+        info = self._deployments.get(name)
+        if info is None:
+            # None (not []) = "no such deployment": routers fail requests
+            # fast instead of waiting out the replica-appearance window.
+            return (self._membership_version, None, 1)
+        return (self._membership_version, list(info.replicas),
                 info.max_concurrent_queries)
 
     async def list_deployments(self) -> Dict[str, dict]:
@@ -216,5 +258,7 @@ def get_or_create_controller():
         return ray_tpu.get_actor(CONTROLLER_NAME)
     except ValueError:
         cls = ray_tpu.remote(ServeController)
+        # Concurrency covers one parked long-poll per router/proxy on
+        # top of the control operations.
         return cls.options(name=CONTROLLER_NAME, get_if_exists=True,
-                           max_concurrency=16).remote()
+                           max_concurrency=128).remote()

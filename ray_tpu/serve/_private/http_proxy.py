@@ -58,27 +58,48 @@ class HTTPProxyActor:
         for sock in site._server.sockets:  # noqa: SLF001
             self._port = sock.getsockname()[1]
             break
+        # Route-table push (reference: LongPollClient in the proxy): a
+        # background task parks in the controller and refreshes the
+        # local table on change — the request path reads it locally.
+        self._route_poll = asyncio.ensure_future(self._poll_routes())
         self._started.set()
         return self._port
 
-    async def _refresh_routes(self):
+    async def _poll_routes(self):
         import ray_tpu
-        version = await asyncio.to_thread(
-            lambda: ray_tpu.get(
-                self._controller.membership_version.remote()))
-        if version == self._version:
-            return
-        routes = await asyncio.to_thread(
-            lambda: ray_tpu.get(self._controller.get_routes.remote()))
         from ray_tpu.serve.handle import DeploymentHandle
-        self._routes = {prefix: DeploymentHandle(name, self._controller)
-                        for prefix, name in routes.items()}
-        self._version = version
+        handles = {}  # name -> DeploymentHandle (stable across versions)
+        while True:
+            try:
+                version, routes = await asyncio.to_thread(
+                    lambda: ray_tpu.get(
+                        self._controller.listen_for_change.remote(
+                            "routes", self._version), timeout=90))
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - controller restarting
+                await asyncio.sleep(0.2)
+                continue
+            for name in list(handles):
+                if name not in routes.values():
+                    del handles[name]
+            self._routes = {
+                prefix: handles.setdefault(
+                    name, DeploymentHandle(name, self._controller))
+                for prefix, name in routes.items()}
+            self._version = version
+
+    async def _wait_for_routes(self, timeout: float = 15.0) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while not self._routes and \
+                asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.05)
 
     async def _handle(self, request):
         import ray_tpu
         from aiohttp import web
-        await self._refresh_routes()
+        if not self._routes:
+            await self._wait_for_routes()
         path = "/" + request.match_info["tail"]
         # Longest matching prefix wins (reference: route table matching).
         match = None
@@ -107,6 +128,9 @@ class HTTPProxyActor:
         return web.json_response(result)
 
     async def shutdown(self) -> bool:
+        poll = getattr(self, "_route_poll", None)
+        if poll is not None:
+            poll.cancel()
         if self._runner is not None:
             await self._runner.cleanup()
         return True

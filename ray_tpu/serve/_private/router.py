@@ -1,19 +1,26 @@
-"""Router: replica selection for a deployment.
+"""Router: replica selection for a deployment — controller OFF the
+request path.
 
 Analog of the reference's serve/_private/router.py:261 (assign_request
-:298): keeps a cached replica list refreshed when the controller's
-membership version moves (the pull flavor of the reference's long-poll
-push), and picks the less-loaded of two random replicas (power-of-two
-choices) using each replica's last-known ongoing count.
+:298) + _private/long_poll.py:68 LongPollClient: membership is PUSHED to
+the router through a controller long-poll running on a background thread,
+and per-replica load is tracked ROUTER-LOCALLY (incremented at assignment,
+decremented when the assigned ObjectRef completes). The request path does
+zero controller RPCs: pick the less-loaded of two random replicas
+(power-of-two choices) from the local table and call it.
 """
 
 from __future__ import annotations
 
+import logging
 import random
 import threading
-from typing import Any, List, Optional
+import time
+from typing import Any, Dict, List
 
 import ray_tpu
+
+logger = logging.getLogger("ray_tpu.serve")
 
 
 class Router:
@@ -24,41 +31,139 @@ class Router:
         self._replicas: List[Any] = []
         self._max_queries = 1
         self._lock = threading.Lock()
-        self._rr = 0
+        # actor_id hex -> requests assigned by THIS router still in
+        # flight (reference: router-local num_ongoing, no replica RPCs).
+        self._ongoing: Dict[str, int] = {}
+        self._outstanding: Dict[Any, str] = {}  # ObjectRef -> actor hex
+        self._have_work = threading.Event()
+        self._have_replicas = threading.Event()
+        self._polled = threading.Event()  # first membership answer seen
+        self._known = True  # deployment exists, per last poll
+        self._stop = False
+        self._threads_started = False
 
-    def _refresh(self) -> None:
-        current = ray_tpu.get(self._controller.membership_version.remote())
+    # -- background membership + completion tracking --------------------
+
+    def _ensure_threads(self) -> None:
+        if self._threads_started:
+            return
         with self._lock:
-            if current == self._version and self._replicas:
+            if self._threads_started:
                 return
-        version, replicas, max_q = ray_tpu.get(
-            self._controller.get_replicas.remote(self._name))
-        with self._lock:
-            self._version = version
-            self._replicas = list(replicas)
-            self._max_queries = max_q
+            self._threads_started = True
+        threading.Thread(target=self._poll_loop, daemon=True,
+                         name=f"serve-router-poll-{self._name}").start()
+        threading.Thread(target=self._drain_loop, daemon=True,
+                         name=f"serve-router-drain-{self._name}").start()
+
+    def _poll_loop(self) -> None:
+        """Long-poll membership (reference: LongPollClient): blocks in
+        the controller until the version moves, then refreshes the local
+        replica table. Never touched by the request path."""
+        from ray_tpu.exceptions import ActorError
+        while not self._stop:
+            try:
+                ver, replicas, max_q = ray_tpu.get(
+                    self._controller.listen_for_change.remote(
+                        ("replicas", self._name), self._version),
+                    timeout=90)
+            except ActorError:
+                break  # controller is gone: serve shut down
+            except Exception:  # noqa: BLE001 - transient: retry
+                time.sleep(0.2)
+                continue
+            with self._lock:
+                self._version = ver
+                self._known = replicas is not None
+                self._replicas = list(replicas or ())
+                live = set()
+                for r in self._replicas:
+                    hexid = r._actor_id.hex()
+                    live.add(hexid)
+                    self._ongoing.setdefault(hexid, 0)
+                for gone in set(self._ongoing) - live:
+                    del self._ongoing[gone]
+                self._max_queries = max_q
+            if self._replicas:
+                self._have_replicas.set()
+            else:
+                self._have_replicas.clear()
+            self._polled.set()
+
+    def _drain_loop(self) -> None:
+        """Decrement router-local load as assigned calls complete (the
+        thread owns the waiting; the request path never blocks)."""
+        while not self._stop:
+            with self._lock:
+                refs = list(self._outstanding)
+            if not refs:
+                self._have_work.wait(timeout=0.5)
+                self._have_work.clear()
+                continue
+            try:
+                done, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                       timeout=0.05)
+            except Exception:  # noqa: BLE001 - shutdown window
+                time.sleep(0.05)
+                continue
+            if not done:
+                continue
+            with self._lock:
+                for ref in done:
+                    hexid = self._outstanding.pop(ref, None)
+                    if hexid is not None and hexid in self._ongoing:
+                        self._ongoing[hexid] = max(
+                            0, self._ongoing[hexid] - 1)
+
+    def stop(self) -> None:
+        self._stop = True
+        self._have_work.set()
+
+    # -- request path (zero controller RPCs) -----------------------------
 
     def pick_replica(self):
-        self._refresh()
+        self._ensure_threads()
+        if not self._have_replicas.is_set():
+            # Fail fast on a deployment the controller does not know
+            # (the old direct get_replicas raised ValueError at once);
+            # wait out only the replica-appearance window for real ones.
+            if self._polled.wait(timeout=10) and not self._known:
+                raise ValueError(
+                    f"Deployment {self._name!r} does not exist")
+            if not self._have_replicas.wait(timeout=30):
+                raise RuntimeError(
+                    f"Deployment {self._name!r} has no live replicas")
         with self._lock:
-            replicas = list(self._replicas)
-            self._rr += 1
-            rr = self._rr
-        if not replicas:
-            raise RuntimeError(
-                f"Deployment {self._name!r} has no live replicas")
-        if len(replicas) == 1:
-            return replicas[0]
-        # Power-of-two choices on sampled ongoing counts.
-        a, b = random.sample(replicas, 2)
-        try:
-            qa, qb = ray_tpu.get([a.num_ongoing.remote(),
-                                  b.num_ongoing.remote()], timeout=5)
-        except Exception:  # noqa: BLE001 - fall back to round robin
-            return replicas[rr % len(replicas)]
-        return a if qa <= qb else b
+            replicas = self._replicas
+            if not replicas:
+                raise RuntimeError(
+                    f"Deployment {self._name!r} has no live replicas")
+            if len(replicas) == 1:
+                choice = replicas[0]
+            else:
+                # Power-of-two choices on LOCAL ongoing counts.
+                a, b = random.sample(replicas, 2)
+                qa = self._ongoing.get(a._actor_id.hex(), 0)
+                qb = self._ongoing.get(b._actor_id.hex(), 0)
+                choice = a if qa <= qb else b
+            hexid = choice._actor_id.hex()
+            self._ongoing[hexid] = self._ongoing.get(hexid, 0) + 1
+        return choice
 
     def assign_request(self, method_name: str, args, kwargs):
         """Returns an ObjectRef of the replica call."""
         replica = self.pick_replica()
-        return replica.handle_request.remote(method_name, args, kwargs)
+        try:
+            ref = replica.handle_request.remote(method_name, args, kwargs)
+        except BaseException:
+            # The pick already charged this replica; a failed submit has
+            # no completing ref to drain the charge back.
+            with self._lock:
+                hexid = replica._actor_id.hex()
+                if hexid in self._ongoing:
+                    self._ongoing[hexid] = max(0, self._ongoing[hexid] - 1)
+            raise
+        with self._lock:
+            self._outstanding[ref] = replica._actor_id.hex()
+        self._have_work.set()
+        return ref

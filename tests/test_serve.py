@@ -63,6 +63,40 @@ def test_multi_replica_routing(serve_session):
     assert len(ids) >= 2  # requests spread over replicas
 
 
+def test_request_path_zero_controller_rpcs(serve_session):
+    """The data plane stays off the controller (reference: long-poll
+    membership push + router-local ongoing counts): once a handle is
+    warm, N requests produce ZERO ServeController method calls — no
+    membership_version, get_replicas, or replica num_ongoing probes."""
+    @serve.deployment(num_replicas=2)
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Echo.bind())
+    # Warm the router (membership long-poll delivers the replica table).
+    assert ray_tpu.get(handle.remote("warm")) == "warm"
+    time.sleep(0.3)
+
+    from ray_tpu._private.worker import global_worker
+    events = global_worker._runtime.task_events()
+    before = len(events)
+    n = 40
+    assert ray_tpu.get([handle.remote(i) for i in range(n)],
+                       timeout=60) == list(range(n))
+    new = global_worker._runtime.task_events()[before:]
+    controller_calls = [e for e in new
+                        if "ServeController" in e.get("name", "")
+                        and "listen_for_change" not in e["name"]]
+    assert controller_calls == [], controller_calls
+    probes = [e for e in new if "num_ongoing" in e.get("name", "")]
+    assert probes == [], probes
+    # The replica calls themselves DID happen.
+    replica_calls = [e for e in new
+                     if "handle_request" in e.get("name", "")]
+    assert len(replica_calls) >= n
+
+
 def test_composition_dag(serve_session):
     @serve.deployment
     class Preprocess:
