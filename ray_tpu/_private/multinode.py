@@ -343,6 +343,13 @@ class NodeConnection:
             if "stored_key" in reply:
                 return RemoteValueStub(self, reply["stored_key"],
                                        reply["size"])
+            if "parts" in reply:
+                # Multi-return split: each element is inline or a
+                # daemon-resident stub of its own.
+                return [
+                    RemoteValueStub(self, p["stored_key"], p["size"])
+                    if "stored_key" in p else _loads(p["value"])
+                    for p in reply["parts"]]
             return _loads(reply["value"])
         from ray_tpu.exceptions import TaskError
         exc, remote_tb = _loads(reply["error"])
@@ -377,6 +384,8 @@ class NodeConnection:
                 "CPU", 1.0) or 0.0),
             "store_limit": store_limit,
         }
+        if isinstance(spec.num_returns, int) and spec.num_returns > 1:
+            msg["num_returns"] = spec.num_returns
         if lease_id is not None:
             msg["lease_id"] = lease_id
         with self._lock:
@@ -424,6 +433,8 @@ class NodeConnection:
             "runtime_env": spec.runtime_env,
             "tpu_ids": getattr(spec, "_tpu_ids", None),
             "store_limit": store_limit,
+            "num_returns": (spec.num_returns if
+                            isinstance(spec.num_returns, int) else 1),
         }, fn_resolver=lambda: self._function_payload(
             spec.function_id, functions))
         return self._unpack(reply, spec.name)
@@ -468,7 +479,8 @@ class NodeConnection:
         self._unpack(reply, f"{spec.name}.__init__")
 
     def call_actor_method(self, actor_id, method_name, name,
-                          args, kwargs, store_limit: int = 0) -> Any:
+                          args, kwargs, store_limit: int = 0,
+                          num_returns: int = 1) -> Any:
         reply = self._request({
             "type": "actor_call",
             "actor_id": actor_id.hex(),
@@ -476,6 +488,7 @@ class NodeConnection:
             "payload": _dumps((args, kwargs)),
             "name": name,
             "store_limit": store_limit,
+            "num_returns": num_returns,
         })
         return self._unpack(reply, name)
 
@@ -533,11 +546,11 @@ class RemoteActorInstance:
         self.actor_id = actor_id
 
     def bind_method(self, method_name: str, task_name: str,
-                    store_limit: int = 0):
+                    store_limit: int = 0, num_returns: int = 1):
         def call(*args, **kwargs):
             return self.conn.call_actor_method(
                 self.actor_id, method_name, task_name, args, kwargs,
-                store_limit)
+                store_limit, num_returns=num_returns)
         return call
 
 
@@ -1031,10 +1044,33 @@ class NodeDaemon:
         _send_frame(sock, _dumps(msg), self._send_lock)
 
     def _reply_result(self, sock, req_id: int, result: Any,
-                      store_limit: int) -> None:
+                      store_limit: int, num_returns: int = 1) -> None:
         """Small results return inline (the reference's PushTaskReply
         path); big ones stay in this daemon's object table and only a
-        (key, size) stub travels back."""
+        (key, size) stub travels back. Multi-return tasks split PER
+        ELEMENT — each return object is independently inline or
+        daemon-resident, so shuffle partials never transit the head."""
+        if num_returns > 1 and store_limit and \
+                isinstance(result, (tuple, list)) and \
+                len(result) == num_returns:
+            payloads = [_dumps(element) for element in result]
+            if sum(map(len, payloads)) > store_limit:
+                parts = []
+                for i, payload in enumerate(payloads):
+                    if len(payload) > store_limit:
+                        key = (f"obj-{self._uid}-s{self._session_n}-"
+                               f"{req_id}-r{i}")
+                        self._table.put(key, payload)
+                        parts.append({"stored_key": key,
+                                      "size": len(payload)})
+                    else:
+                        parts.append({"value": payload})
+                _send_frame(sock, _dumps({"req_id": req_id, "ok": True,
+                                          "parts": parts}),
+                            self._send_lock)
+                return
+            # Small total: the plain inline reply below is cheaper than
+            # per-element bookkeeping head-side.
         payload = _dumps(result)
         if store_limit and len(payload) > store_limit:
             # Globally unique key: peer daemons cache pulled copies under
@@ -1205,14 +1241,22 @@ class NodeDaemon:
         if reply.get("ok"):
             payload = reply["value"]
             store_limit = msg.get("store_limit", 0)
-            if store_limit and len(payload) > store_limit:
+            num_returns = msg.get("num_returns", 1)
+            if num_returns > 1 and store_limit and \
+                    len(payload) > store_limit:
+                # Split per return element (one extra deserialize on the
+                # big path only; small results forward untouched below).
+                self._reply_result(sock, req_id, _loads(payload),
+                                   store_limit, num_returns)
+            elif store_limit and len(payload) > store_limit:
                 key = f"obj-{self._uid}-s{self._session_n}-{req_id}"
                 self._table.put(key, payload)
                 out = {"req_id": req_id, "ok": True, "stored_key": key,
                        "size": len(payload)}
+                _send_frame(sock, _dumps(out), self._send_lock)
             else:
                 out = {"req_id": req_id, "ok": True, "value": payload}
-            _send_frame(sock, _dumps(out), self._send_lock)
+                _send_frame(sock, _dumps(out), self._send_lock)
         else:
             _send_frame(sock, _dumps(
                 {"req_id": req_id, "ok": False, "error": reply["error"]}),
@@ -1251,7 +1295,8 @@ class NodeDaemon:
                     *_loads(msg["payload"]))
                 result = self._run_in_env(msg, fn, args, kwargs)
                 self._reply_result(sock, req_id, result,
-                                   msg.get("store_limit", 0))
+                                   msg.get("store_limit", 0),
+                                   msg.get("num_returns", 1))
             elif kind == "create_actor":
                 cls = self._load_function(msg["fn_id"], msg.get("fn_bytes"))
                 args, kwargs = self._resolve_markers(
@@ -1274,7 +1319,8 @@ class NodeDaemon:
                     import asyncio
                     result = asyncio.run(result)
                 self._reply_result(sock, req_id, result,
-                                   msg.get("store_limit", 0))
+                                   msg.get("store_limit", 0),
+                                   msg.get("num_returns", 1))
             elif kind == "destroy_actor":
                 self._actors.pop(msg["actor_id"], None)
                 self._actor_tpu_ids.pop(msg["actor_id"], None)
@@ -1537,7 +1583,8 @@ class NodeDaemon:
 def run_node(address: str, *, num_cpus: float = 1.0, num_tpus: float = 0.0,
              memory: float = 1 << 30,
              resources: Optional[Dict[str, float]] = None,
-             labels: Optional[dict] = None) -> None:
+             labels: Optional[dict] = None,
+             object_store_memory: int = 1 << 28) -> None:
     """Entry point for `ray-tpu start --address host:port` and
     `python -m ray_tpu._private.multinode`."""
     host, _, port = address.rpartition(":")
@@ -1548,7 +1595,7 @@ def run_node(address: str, *, num_cpus: float = 1.0, num_tpus: float = 0.0,
     if resources:
         node_resources.update(resources)
     NodeDaemon((host or "127.0.0.1", int(port)), node_resources,
-               labels).run()
+               labels, object_store_memory=int(object_store_memory)).run()
 
 
 def _main() -> None:
@@ -1566,13 +1613,18 @@ def _main() -> None:
     parser.add_argument("--labels", type=str, default=None,
                         help="node labels as JSON (autoscaler providers "
                              "tag their nodes here)")
+    parser.add_argument("--object-store-memory", type=float,
+                        default=float(1 << 28),
+                        help="bytes for this node's object table (shm "
+                             "arena when available)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     run_node(args.address, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
              memory=args.memory,
              resources=json.loads(args.resources) if args.resources
              else None,
-             labels=json.loads(args.labels) if args.labels else None)
+             labels=json.loads(args.labels) if args.labels else None,
+             object_store_memory=int(args.object_store_memory))
 
 
 if __name__ == "__main__":

@@ -1203,8 +1203,15 @@ class Runtime:
                 f"{type(result).__name__} of length "
                 f"{len(result) if hasattr(result, '__len__') else 'n/a'}"))
             return
+        from ray_tpu._private.multinode import RemoteValueStub
         for oid, value in zip(spec.return_ids, result):
-            self._store_if_referenced(oid, value)
+            if isinstance(value, RemoteValueStub):
+                # Multi-return daemon task: big elements stay daemon-
+                # resident individually (shuffle partials ride the data
+                # plane, never the head).
+                self._store_remote_result(spec, oid, value)
+            else:
+                self._store_if_referenced(oid, value)
 
     def _store_remote_result(self, spec: TaskSpec, oid: ObjectID,
                              stub) -> None:
@@ -1864,7 +1871,10 @@ class Runtime:
                 conn = state.instance.conn
                 method = state.instance.bind_method(
                     spec.method_name, spec.name,
-                    store_limit=self._result_store_limit(spec))
+                    store_limit=self._result_store_limit(spec),
+                    num_returns=(spec.num_returns if
+                                 isinstance(spec.num_returns, int)
+                                 else 1))
             elif isinstance(state.instance, ProcessActorInstance):
                 to_process = True
                 method = state.instance.bind_method(
@@ -2330,9 +2340,11 @@ class Runtime:
         return out
 
     def _result_store_limit(self, spec: TaskSpec) -> int:
-        """Results above this size stay daemon-resident (single-return
-        tasks only — a multi-return tuple must come back whole)."""
-        if spec.num_returns != 1:
+        """Results above this size stay daemon-resident. Multi-return
+        tasks split PER ELEMENT daemon-side (shuffle partials must ride
+        the inter-daemon data plane, not the head); dynamic generators
+        come back whole (item count is unknown until unpacked)."""
+        if spec.num_returns == "dynamic" or spec.num_returns == 0:
             return 0
         return self._cfg_inline_limit
 

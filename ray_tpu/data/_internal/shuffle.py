@@ -55,10 +55,13 @@ _part_task_cache = {}
 
 
 def _partition_task(num_out: int):
+    # SPREAD (reference: data map tasks use the SPREAD strategy): shuffle
+    # stages must fan across nodes — default hybrid-pack would pile every
+    # map on one daemon and no data would ever ride the inter-node plane.
     key_ = num_out
     if key_ not in _part_task_cache:
         _part_task_cache[key_] = ray_tpu.remote(_partition_block).options(
-            num_returns=num_out)
+            num_returns=num_out, scheduling_strategy="SPREAD")
     return _part_task_cache[key_]
 
 
@@ -68,7 +71,8 @@ _reduce_task = None
 def _get_reduce_task():
     global _reduce_task
     if _reduce_task is None:
-        _reduce_task = ray_tpu.remote(_reduce_blocks).options(num_returns=2)
+        _reduce_task = ray_tpu.remote(_reduce_blocks).options(
+            num_returns=2, scheduling_strategy="SPREAD")
     return _reduce_task
 
 

@@ -250,3 +250,23 @@ def test_lease_survives_node_death(lease_cluster):
     with rt._lock:
         assert all(not lst or all(le.inflight >= 0 for le in lst)
                    for lst in rt._leases.values())
+
+
+def test_daemon_actor_multi_return_big_results(lease_cluster):
+    """Review regression: a daemon-resident actor method with
+    num_returns>1 whose elements exceed the inline limit — each element
+    must come back as its own daemon-resident object, not a single
+    opaque stub."""
+    import numpy as np
+
+    @ray_tpu.remote(resources={"lease": 1})
+    class Producer:
+        def make(self):
+            return np.full(1 << 19, 3, np.int64), np.full(8, 4, np.int64)
+
+    actor = Producer.remote()
+    big_ref, small_ref = actor.make.options(num_returns=2).remote()
+    big = ray_tpu.get(big_ref, timeout=60)
+    small = ray_tpu.get(small_ref, timeout=60)
+    assert int(big[0]) == 3 and big.nbytes == (1 << 19) * 8
+    assert list(small) == [4] * 8
