@@ -72,18 +72,41 @@ class Algorithm:
                 config.policy_config(), probe_env.observation_space,
                 probe_env.action_space, seed=config.seed)
         probe_env.close() if hasattr(probe_env, "close") else None
+        # Callable input_ = EXTERNAL experience source (reference:
+        # policy_server_input usage — config.offline_data(input_=lambda
+        # ctx: PolicyServerInput(ctx, host, port))): the algorithm trains
+        # from it instead of its own rollout workers; ctx hands the
+        # source the live training policy for server-side inference.
+        self.external_input = None
+        input_cfg = getattr(config, "input_", None)
+        if callable(input_cfg):
+            class InputContext:
+                policy = self.local_policy
+                gamma = getattr(config, "gamma", 0.99)
+                lam = getattr(config, "lambda_", 0.95)
+
+            self.external_input = input_cfg(InputContext())
         self.workers = WorkerSet(
             env_creator, config.policy_config(),
-            # Zero sampling actors only for offline algorithms (input_ set);
-            # online algorithms keep the >=1 fallback — their training_step
-            # divides by worker count.
+            # Zero sampling actors only for offline/external algorithms
+            # (input_ set); online algorithms keep the >=1 fallback —
+            # their training_step divides by worker count.
             num_workers=(0 if (self._own_rollout_actors
+                               or self.external_input is not None
                                or (config.num_rollout_workers == 0
                                    and getattr(config, "input_", None)))
                          else max(config.num_rollout_workers, 1)),
             seed=config.seed,
             num_cpus_per_worker=config.num_cpus_per_worker)
         self.setup(config)
+
+    def _sample_batch(self, per_worker: int):
+        """Training data for one step: the external input (client-server
+        RL) when configured, this algorithm's rollout workers otherwise."""
+        if self.external_input is not None:
+            return self.external_input.next_batch(
+                self.config.train_batch_size)
+        return self.workers.sample(per_worker)
 
     @classmethod
     def get_default_config(cls) -> AlgorithmConfig:
@@ -98,7 +121,9 @@ class Algorithm:
         t0 = time.monotonic()
         self.iteration += 1
         results = self.training_step()
-        stats = self.workers.episode_stats()
+        stats = (self.external_input.episode_stats()
+                 if self.external_input is not None
+                 else self.workers.episode_stats())
         for k, v in stats.items():
             # training_step wins if it already reported the metric (e.g.
             # ES/ARS compute episode stats from their own evaluators).

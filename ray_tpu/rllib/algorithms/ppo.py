@@ -241,11 +241,14 @@ class PPO(Algorithm):
     def training_step(self) -> Dict[str, Any]:
         import ray_tpu
         config: PPOConfig = self.config
-        weights_ref = ray_tpu.put(self.get_weights())
-        self.workers.sync_weights(weights_ref)
-        per_worker = max(
-            config.train_batch_size // self.workers.num_workers(), 1)
-        batch = self.workers.sample(per_worker)
+        if self.external_input is None:
+            weights_ref = ray_tpu.put(self.get_weights())
+            self.workers.sync_weights(weights_ref)
+            per_worker = max(
+                config.train_batch_size // self.workers.num_workers(), 1)
+        else:
+            per_worker = config.train_batch_size
+        batch = self._sample_batch(per_worker)
         self._timesteps_total += len(batch)
 
         if self.is_multi_agent:

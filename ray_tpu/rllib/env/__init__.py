@@ -1,3 +1,7 @@
+from ray_tpu.rllib.env.external_env import ExternalEnv
 from ray_tpu.rllib.env.multi_agent_env import MultiAgentEnv
+from ray_tpu.rllib.env.policy_client import PolicyClient
+from ray_tpu.rllib.env.policy_server_input import PolicyServerInput
 
-__all__ = ["MultiAgentEnv"]
+__all__ = ["ExternalEnv", "MultiAgentEnv", "PolicyClient",
+           "PolicyServerInput"]

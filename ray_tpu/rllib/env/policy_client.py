@@ -1,0 +1,130 @@
+"""PolicyClient: the environment-side half of client-server RL.
+
+Analog of the reference's rllib/env/policy_client.py:58 — a process that
+OWNS an environment (simulator, game, website backend) and connects to a
+learner's :class:`~ray_tpu.rllib.env.policy_server_input.PolicyServerInput`
+over HTTP. Two inference modes:
+
+* ``remote`` — every get_action round-trips to the server, which runs the
+  LIVE training policy (always-fresh actions; one RTT per step).
+* ``local`` — the client pulls policy weights every ``update_interval``
+  seconds and runs inference in-process (no per-step RTT; logged actions
+  ship to the server for training).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+import urllib.request
+from typing import Any, Optional
+
+__all__ = ["PolicyClient"]
+
+from ray_tpu.rllib.env.policy_server_input import (END_EPISODE, GET_ACTION,
+                                                   GET_WEIGHTS, LOG_ACTION,
+                                                   LOG_RETURNS,
+                                                   START_EPISODE)
+
+
+class PolicyClient:
+    def __init__(self, address: str, inference_mode: str = "remote",
+                 update_interval: float = 10.0,
+                 policy_config: Optional[dict] = None,
+                 observation_space=None, action_space=None):
+        if not address.startswith("http"):
+            address = f"http://{address}"
+        self.address = address
+        if inference_mode not in ("remote", "local"):
+            raise ValueError("inference_mode must be 'remote' or 'local'")
+        self.inference_mode = inference_mode
+        self._local_policy = None
+        self._update_interval = update_interval
+        self._stop = False
+        if inference_mode == "local":
+            if policy_config is None or observation_space is None or \
+                    action_space is None:
+                raise ValueError(
+                    "local inference needs policy_config, "
+                    "observation_space and action_space (the client "
+                    "builds its own policy copy)")
+            import jax
+
+            from ray_tpu.rllib.policy import make_policy
+            self._local_policy = make_policy(
+                policy_config, observation_space, action_space, seed=0)
+            self._key = jax.random.PRNGKey(0xC11E)
+            self.update_policy_weights()
+            threading.Thread(target=self._weight_update_loop,
+                             daemon=True,
+                             name="ray_tpu-policy-client-sync").start()
+
+    # -- wire ------------------------------------------------------------
+
+    def _send(self, **req) -> Any:
+        data = pickle.dumps(req)
+        http_req = urllib.request.Request(
+            self.address, data=data,
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(http_req, timeout=60) as resp:
+            reply = pickle.loads(resp.read())
+        if not reply.get("ok"):
+            raise RuntimeError(
+                f"policy server error: {reply.get('error')}")
+        return reply.get("result")
+
+    # -- episode API -----------------------------------------------------
+
+    def start_episode(self, episode_id: Optional[str] = None,
+                      training_enabled: bool = True) -> str:
+        return self._send(command=START_EPISODE, episode_id=episode_id,
+                          training_enabled=training_enabled)
+
+    def get_action(self, episode_id: str, observation):
+        if self._local_policy is not None:
+            import jax
+            import numpy as np
+            arr = np.asarray(observation)
+            self._key, sub = jax.random.split(self._key)
+            action, logp, value = self._local_policy.compute_actions(
+                arr[None], sub)
+            act = (int(action[0]) if self._local_policy.discrete
+                   else np.asarray(action[0]))
+            # Ship OUR logp/value with the transition: the synced local
+            # copy IS (a recent snapshot of) the training policy, so
+            # surrogate ratios stay meaningful server-side.
+            self._send(command=LOG_ACTION, episode_id=episode_id,
+                       observation=observation, action=act,
+                       logp=float(logp[0]), vf=float(value[0]))
+            return act
+        return self._send(command=GET_ACTION, episode_id=episode_id,
+                          observation=observation)
+
+    def log_action(self, episode_id: str, observation, action) -> None:
+        self._send(command=LOG_ACTION, episode_id=episode_id,
+                   observation=observation, action=action)
+
+    def log_returns(self, episode_id: str, reward: float,
+                    info: Optional[dict] = None) -> None:
+        self._send(command=LOG_RETURNS, episode_id=episode_id,
+                   reward=float(reward))
+
+    def end_episode(self, episode_id: str, observation) -> None:
+        self._send(command=END_EPISODE, episode_id=episode_id,
+                   observation=observation)
+
+    def update_policy_weights(self) -> None:
+        if self._local_policy is not None:
+            self._local_policy.set_weights(self._send(command=GET_WEIGHTS))
+
+    def _weight_update_loop(self) -> None:
+        while not self._stop:
+            time.sleep(self._update_interval)
+            try:
+                self.update_policy_weights()
+            except Exception:  # noqa: BLE001 - server restarting
+                pass
+
+    def stop(self) -> None:
+        self._stop = True

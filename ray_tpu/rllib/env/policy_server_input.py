@@ -1,0 +1,259 @@
+"""PolicyServerInput: train from environments living OUTSIDE the cluster.
+
+Analog of the reference's rllib/env/policy_server_input.py:26 — an HTTP
+server embedded in the learner process that external
+:class:`~ray_tpu.rllib.env.policy_client.PolicyClient` processes talk to:
+they query actions (server-side inference against the LIVE training
+policy), log rewards, and end episodes; completed fragments are
+GAE-postprocessed and queued as SampleBatches for the training loop.
+
+Use with ``config.offline_data(input_=lambda ctx:
+PolicyServerInput(ctx, host, port))`` — the algorithm then trains from
+the server's queue instead of its own rollout workers.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.policy.jax_policy import compute_gae
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+__all__ = ["PolicyServerInput"]
+
+# Wire commands (reference: policy_client.py Commands).
+START_EPISODE = "START_EPISODE"
+GET_ACTION = "GET_ACTION"
+LOG_ACTION = "LOG_ACTION"
+LOG_RETURNS = "LOG_RETURNS"
+END_EPISODE = "END_EPISODE"
+GET_WEIGHTS = "GET_WEIGHTS"
+
+
+class _Episode:
+    def __init__(self, episode_id: str, training_enabled: bool):
+        self.episode_id = episode_id
+        self.training_enabled = training_enabled
+        self.rows: Dict[str, list] = {k: [] for k in (
+            SampleBatch.OBS, SampleBatch.NEXT_OBS, SampleBatch.ACTIONS,
+            SampleBatch.REWARDS, SampleBatch.TERMINATEDS,
+            SampleBatch.TRUNCATEDS, SampleBatch.ACTION_LOGP,
+            SampleBatch.VF_PREDS, SampleBatch.EPS_ID)}
+        self.prev_obs = None
+        self.prev_action = None
+        self.prev_logp = 0.0
+        self.prev_vf = 0.0
+        self.pending_reward = 0.0
+        self.total_reward = 0.0
+        self.length = 0
+
+
+class PolicyServerInput:
+    """HTTP ingest for external experience + server-side inference.
+
+    ``ctx`` is whatever exposes ``policy`` (the live training policy) —
+    the :class:`InputContext` the algorithm passes to the ``input_``
+    callable. ``next_batch(min_rows)`` blocks until that much training
+    data arrived."""
+
+    def __init__(self, ctx, address: str = "127.0.0.1", port: int = 0,
+                 gamma: Optional[float] = None,
+                 lam: Optional[float] = None):
+        import jax
+        self._policy = ctx.policy if hasattr(ctx, "policy") else ctx
+        # GAE discounting follows the ALGORITHM's config (the ctx the
+        # input_ callable receives); explicit kwargs override.
+        self._gamma = (gamma if gamma is not None
+                       else getattr(ctx, "gamma", 0.99))
+        self._lam = lam if lam is not None else getattr(ctx, "lam", 0.95)
+        self._key = jax.random.PRNGKey(0xE17)
+        self._episodes: Dict[str, _Episode] = {}
+        self._lock = threading.Lock()
+        self._batches: "queue.Queue" = queue.Queue()
+        self._rows_ready = 0
+        self.episode_rewards: list = []
+        self.episode_lengths: list = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # noqa: N802 - stdlib API
+                pass  # no per-request stderr spam
+
+            def do_POST(self):  # noqa: N802 - stdlib API
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = pickle.loads(self.rfile.read(length))
+                    out = outer._handle(req)
+                    payload = pickle.dumps({"ok": True, "result": out})
+                except Exception as exc:  # noqa: BLE001 - ship to client
+                    payload = pickle.dumps({"ok": False,
+                                            "error": repr(exc)})
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._server = ThreadingHTTPServer((address, port), Handler)
+        self.address = address
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"ray_tpu-policy-server-{self.port}")
+        self._thread.start()
+
+    # -- command handling -------------------------------------------------
+
+    def _handle(self, req: dict) -> Any:
+        cmd = req["command"]
+        if cmd == START_EPISODE:
+            eid = req.get("episode_id") or __import__("uuid").uuid4().hex
+            with self._lock:
+                self._episodes[eid] = _Episode(
+                    eid, req.get("training_enabled", True))
+            return eid
+        if cmd == GET_WEIGHTS:
+            return self._policy.get_weights()
+        ep = self._episode(req["episode_id"])
+        if cmd == GET_ACTION:
+            return self._get_action(ep, req["observation"])
+        if cmd == LOG_ACTION:
+            return self._log_action(ep, req["observation"], req["action"],
+                                    logp=req.get("logp"),
+                                    vf=req.get("vf"))
+        if cmd == LOG_RETURNS:
+            ep.pending_reward += float(req["reward"])
+            ep.total_reward += float(req["reward"])
+            return None
+        if cmd == END_EPISODE:
+            return self._end_episode(ep, req["observation"])
+        raise ValueError(f"unknown command {cmd!r}")
+
+    def _episode(self, eid: str) -> _Episode:
+        with self._lock:
+            ep = self._episodes.get(eid)
+        if ep is None:
+            raise KeyError(f"episode {eid} not started")
+        return ep
+
+    def _record_prev(self, ep: _Episode, obs, done: bool) -> None:
+        """Seal the previous (obs, action) pair now that its reward and
+        successor observation are known."""
+        if ep.prev_obs is None:
+            return
+        rows = ep.rows
+        rows[SampleBatch.OBS].append(np.asarray(ep.prev_obs))
+        rows[SampleBatch.NEXT_OBS].append(np.asarray(obs))
+        rows[SampleBatch.ACTIONS].append(ep.prev_action)
+        rows[SampleBatch.REWARDS].append(np.float32(ep.pending_reward))
+        rows[SampleBatch.TERMINATEDS].append(np.float32(done))
+        rows[SampleBatch.TRUNCATEDS].append(np.float32(0.0))
+        rows[SampleBatch.ACTION_LOGP].append(np.float32(ep.prev_logp))
+        rows[SampleBatch.VF_PREDS].append(np.float32(ep.prev_vf))
+        rows[SampleBatch.EPS_ID].append(
+            abs(hash(ep.episode_id)) % (1 << 31))
+        ep.pending_reward = 0.0
+        ep.length += 1
+
+    def _get_action(self, ep: _Episode, obs):
+        import jax
+        self._record_prev(ep, obs, done=False)
+        arr = np.asarray(obs)
+        self._key, sub = jax.random.split(self._key)
+        action, logp, value = self._policy.compute_actions(arr[None], sub)
+        act = action[0]
+        ep.prev_obs = arr
+        ep.prev_action = act
+        ep.prev_logp = float(logp[0])
+        ep.prev_vf = float(value[0])
+        return (int(act) if getattr(self._policy, "discrete", True)
+                else np.asarray(act))
+
+    def _log_action(self, ep: _Episode, obs, action,
+                    logp: Optional[float] = None,
+                    vf: Optional[float] = None) -> None:
+        """Logged action: local-inference clients supply the logp/value
+        their (synced) policy copy computed — surrogate ratios stay
+        correct; without them (truly off-policy loggers), the value head
+        still evaluates the observation (GAE needs it) and logp is 0."""
+        self._record_prev(ep, obs, done=False)
+        arr = np.asarray(obs)
+        ep.prev_obs = arr
+        ep.prev_action = action
+        ep.prev_logp = float(logp) if logp is not None else 0.0
+        if vf is not None:
+            ep.prev_vf = float(vf)
+        else:
+            try:
+                ep.prev_vf = float(
+                    self._policy.compute_values(arr[None])[0])
+            except Exception:  # noqa: BLE001 - value head optional
+                ep.prev_vf = 0.0
+
+    def _end_episode(self, ep: _Episode, obs) -> None:
+        self._record_prev(ep, obs, done=True)
+        with self._lock:
+            self._episodes.pop(ep.episode_id, None)
+            self.episode_rewards.append(ep.total_reward)
+            self.episode_lengths.append(ep.length)
+        if ep.training_enabled and ep.rows[SampleBatch.OBS]:
+            batch = SampleBatch(
+                {k: np.asarray(v) for k, v in ep.rows.items()})
+            if getattr(self._policy, "needs_gae", True):
+                batch = compute_gae(batch, self._gamma, self._lam, 0.0)
+            self._batches.put(batch)
+            with self._lock:
+                self._rows_ready += len(batch)
+
+    # -- training-loop face ----------------------------------------------
+
+    def next(self) -> SampleBatch:
+        """One completed episode fragment (blocks)."""
+        return self._batches.get()
+
+    def next_batch(self, min_rows: int,
+                   timeout: Optional[float] = None) -> SampleBatch:
+        """Accumulate completed episodes until ``min_rows`` training rows
+        (reference: PolicyServerInput.next feeding train batches). With a
+        timeout, returns whatever arrived by the deadline (raises
+        queue.Empty only if NOTHING did)."""
+        import time as _time
+        deadline = (None if timeout is None
+                    else _time.monotonic() + timeout)
+        parts = [self._batches.get(timeout=timeout)]
+        rows = len(parts[0])
+        while rows < min_rows:
+            if deadline is not None:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+            else:
+                remaining = None
+            try:
+                part = self._batches.get(
+                    timeout=0.05 if remaining is None
+                    else min(0.05, remaining))
+            except queue.Empty:
+                continue
+            parts.append(part)
+            rows += len(part)
+        return SampleBatch.concat_samples(parts)
+
+    def episode_stats(self, window: int = 100) -> Dict[str, float]:
+        rewards = self.episode_rewards[-window:]
+        lengths = self.episode_lengths[-window:]
+        return {
+            "episodes": len(self.episode_rewards),
+            "episode_reward_mean": (float(np.mean(rewards)) if rewards
+                                    else float("nan")),
+            "episode_len_mean": (float(np.mean(lengths)) if lengths
+                                 else float("nan")),
+        }
+
+    def shutdown(self) -> None:
+        self._server.shutdown()

@@ -24,6 +24,12 @@ from ray_tpu.rllib.policy.sample_batch import SampleBatch
 
 def _make_env(env_creator, env_config):
     env = env_creator(env_config or {})
+    from ray_tpu.rllib.env.external_env import ExternalEnv, GymAdapter
+    if isinstance(env, ExternalEnv):
+        # Self-driving env (reference: external_env.py ExternalEnvWrapper):
+        # invert its queue protocol back into reset()/step() so the
+        # standard samplers (and their batched inference) drive it.
+        return GymAdapter(env)
     return env
 
 

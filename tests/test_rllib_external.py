@@ -1,0 +1,167 @@
+"""Client-server RL + external envs (reference: rllib/env/
+{external_env,policy_client,policy_server_input}.py + tests): envs that
+live outside the cluster query actions over HTTP and ship experience
+back; self-driving ExternalEnvs ride the standard samplers via the
+queue-protocol adapter."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import PPOConfig
+from ray_tpu.rllib.env import ExternalEnv, PolicyClient, PolicyServerInput
+
+
+@pytest.fixture
+def ray_session():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+def _drive_cartpole(client: PolicyClient, episodes: int,
+                    seed: int = 0) -> list:
+    rewards = []
+    import gymnasium as gym
+    env = gym.make("CartPole-v1")
+    for ep in range(episodes):
+        eid = client.start_episode()
+        obs, _ = env.reset(seed=seed + ep)
+        total, done = 0.0, False
+        while not done:
+            action = client.get_action(eid, obs)
+            obs, reward, terminated, truncated, _ = env.step(int(action))
+            client.log_returns(eid, reward)
+            total += reward
+            done = terminated or truncated
+        client.end_episode(eid, obs)
+        rewards.append(total)
+    return rewards
+
+
+def test_policy_client_server_cartpole_learns(ray_session):
+    """End to end: external CartPole processes query actions from a PPO
+    learner's PolicyServerInput; the policy improves on THEIR data
+    (reference: cartpole_client/server example)."""
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .rollouts(num_rollout_workers=0)
+              .training(train_batch_size=512, num_sgd_iter=6,
+                        sgd_minibatch_size=128, lr=5e-3,
+                        model={"fcnet_hiddens": [64, 64]})
+              .offline_data(input_=lambda ctx: PolicyServerInput(
+                  ctx, "127.0.0.1", 0))
+              .debugging(seed=0))
+    algo = config.build()
+    server: PolicyServerInput = algo.external_input
+    client = PolicyClient(f"127.0.0.1:{server.port}")
+
+    stop = threading.Event()
+
+    def feed():
+        while not stop.is_set():
+            try:
+                _drive_cartpole(client, episodes=4,
+                                seed=int(time.time()) % 100000)
+            except Exception:  # noqa: BLE001 - server shut down mid-episode
+                return
+
+    feeder = threading.Thread(target=feed, daemon=True)
+    feeder.start()
+    try:
+        first, best = None, -1.0
+        for _ in range(18):
+            result = algo.train()
+            rm = result.get("episode_reward_mean", float("nan"))
+            if first is None and rm == rm:
+                first = rm
+            if rm == rm:
+                best = max(best, rm)
+            if best >= 60:
+                break
+        assert first is not None, "no episode stats flowed"
+        assert best >= 60, (first, best)
+    finally:
+        stop.set()
+        server.shutdown()
+        algo.stop()
+
+
+def test_policy_client_local_inference(ray_session):
+    """Local-inference mode: the client runs its own policy copy (pulled
+    weights), logs actions to the server, experience still arrives."""
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .rollouts(num_rollout_workers=0)
+              .training(train_batch_size=128, num_sgd_iter=2,
+                        sgd_minibatch_size=64,
+                        model={"fcnet_hiddens": [32]})
+              .offline_data(input_=lambda ctx: PolicyServerInput(
+                  ctx, "127.0.0.1", 0))
+              .debugging(seed=0))
+    algo = config.build()
+    server: PolicyServerInput = algo.external_input
+    import gymnasium as gym
+    probe = gym.make("CartPole-v1")
+    client = PolicyClient(
+        f"127.0.0.1:{server.port}", inference_mode="local",
+        update_interval=1.0,
+        policy_config=config.policy_config(),
+        observation_space=probe.observation_space,
+        action_space=probe.action_space)
+    _drive_cartpole(client, episodes=6)
+    batch = server.next_batch(64, timeout=10)
+    assert len(batch) >= 64
+    assert np.asarray(batch["obs"]).shape[1] == 4
+    client.update_policy_weights()  # explicit pull works too
+    client.stop()
+    server.shutdown()
+    algo.stop()
+
+
+def test_external_env_rides_standard_sampler(ray_session):
+    """A self-driving ExternalEnv (its own thread calls get_action) is
+    sampled by the normal rollout machinery through the adapter — PPO
+    trains on it without env-specific plumbing."""
+
+    class SelfDrivingCartPole(ExternalEnv):
+        def __init__(self, _cfg=None):
+            import gymnasium as gym
+            env = gym.make("CartPole-v1")
+            super().__init__(action_space=env.action_space,
+                             observation_space=env.observation_space)
+            self._env = env
+
+        def run(self):
+            seed = 0
+            while True:
+                eid = self.start_episode()
+                obs, _ = self._env.reset(seed=seed)
+                seed += 1
+                done = False
+                while not done:
+                    action = self.get_action(eid, obs)
+                    obs, reward, term, trunc, _ = self._env.step(
+                        int(action))
+                    self.log_returns(eid, reward)
+                    done = term or trunc
+                self.end_episode(eid, obs)
+
+    config = (PPOConfig()
+              .environment(SelfDrivingCartPole)
+              .rollouts(num_rollout_workers=1,
+                        rollout_fragment_length=200)
+              .training(train_batch_size=200, num_sgd_iter=2,
+                        sgd_minibatch_size=64,
+                        model={"fcnet_hiddens": [32]})
+              .debugging(seed=0))
+    algo = config.build()
+    result = algo.train()
+    assert result["timesteps_total"] >= 200
+    result = algo.train()
+    assert result["timesteps_total"] >= 400
+    algo.stop()
