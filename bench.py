@@ -171,6 +171,86 @@ def bench_data_shuffle() -> dict:
     return out
 
 
+def bench_shuffle_multi_daemon() -> dict:
+    """Multi-daemon shuffle at GB scale (reference:
+    release_tests.yaml:3447 shuffle nightly): blocks are generated and
+    kept DAEMON-resident (the head has 1 CPU, so map/partition/reduce
+    tasks land on the two daemon processes), and the reduce stage's
+    cross-node arguments ride the daemon-to-daemon data plane under pull
+    admission control. Reports MB/s plus the bytes that actually moved
+    node-to-node. Size via RAY_TPU_BENCH_SHUFFLE_GB (default 2)."""
+    import json as _json
+    import os as _os
+    import subprocess
+    import sys
+    import time as _time
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data as rdata
+
+    out = {}
+    total_gb = float(_os.environ.get("RAY_TPU_BENCH_SHUFFLE_GB", "2"))
+    total_bytes = int(total_gb * (1 << 30))
+    # Partition count sized so map-stage sub-blocks (total / n_blocks^2)
+    # stay ABOVE remote_object_inline_limit_bytes: daemon-resident blocks
+    # are the point — inline-sized ones would round-trip via the head.
+    n_blocks = max(8, min(32, int((total_bytes / (2 << 20)) ** 0.5)))
+    row_bytes = 1024
+    rows = total_bytes // row_bytes
+    ray_tpu.init(num_cpus=1)  # head out of the compute: daemons do the work
+    procs = []
+    try:
+        host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+        store = int(total_bytes * 0.75)  # per daemon; headroom for 2x data
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.multinode",
+             "--address", f"127.0.0.1:{port}", "--num-cpus", "8",
+             "--object-store-memory", str(store)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            for _ in range(2)]
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("CPU", 0) >= 17:
+                break
+            _time.sleep(0.1)
+        else:
+            raise TimeoutError("shuffle daemons never registered")
+
+        def gen(b):
+            ids = np.asarray(b["id"], np.int64)
+            return {"id": ids,
+                    "payload": np.random.default_rng(int(ids[0])).random(
+                        (len(ids), row_bytes // 8))}
+
+        ds = rdata.range(rows, parallelism=n_blocks).map_batches(gen)
+        ds = ds.materialize()  # generation OUTSIDE the timer
+        t0 = _time.perf_counter()
+        count = ds.random_shuffle(seed=0).count()
+        dt = _time.perf_counter() - t0
+        assert count == rows, (count, rows)
+        pulled = 0
+        from ray_tpu._private.worker import global_worker
+        rt = global_worker._runtime
+        for conn in rt._remote_nodes.values():
+            stats = conn.get_stats()
+            pulled += stats.get("transfer", {}).get("pulled_bytes", 0)
+        out["shuffle_multi_mb_per_sec"] = round(total_bytes / 1e6 / dt, 1)
+        out["shuffle_multi_data_mb"] = round(total_bytes / 1e6, 1)
+        out["shuffle_multi_pulled_mb"] = round(pulled / 1e6, 1)
+        out["shuffle_multi_daemons"] = 2
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        ray_tpu.shutdown()
+    return out
+
+
 def bench_serve() -> dict:
     """Serving-plane throughput/latency (reference: release/serve_tests
     single_deployment_1k_noop_replica): HTTP QPS + p50/p95 latency
@@ -270,6 +350,85 @@ print(json.dumps({
 algo.stop()
 ray_tpu.shutdown()
 """
+
+
+RLLIB_DAEMON_BENCH_SCRIPT = """
+import json, os, subprocess, sys, time
+BATCH = 2048
+os.environ.pop("XLA_FLAGS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import ray_tpu
+# Head keeps ONE cpu (the learner); rollout actors land on the daemons
+# and their SampleBatches ship over the daemon->head channel — the
+# actual scale-out configuration (BASELINE: env-steps/s on a pod).
+ray_tpu.init(num_cpus=1)
+host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+procs = [subprocess.Popen(
+    [sys.executable, "-m", "ray_tpu._private.multinode",
+     "--address", f"127.0.0.1:{port}", "--num-cpus", "4"],
+    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    for _ in range(2)]
+import atexit
+atexit.register(lambda: [p.kill() for p in procs])
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline:
+    if ray_tpu.cluster_resources().get("CPU", 0) >= 9:
+        break
+    time.sleep(0.1)
+else:
+    raise TimeoutError("rllib bench daemons never registered")
+from ray_tpu.rllib import PPOConfig
+from ray_tpu.rllib.env.atari import make_synthetic_atari
+config = (PPOConfig()
+          .environment(make_synthetic_atari, env_config={"drops": 8})
+          .rollouts(num_rollout_workers=4, rollout_fragment_length=256,
+                    num_envs_per_worker=2)
+          .training(lr=3e-4, train_batch_size=BATCH, num_sgd_iter=2,
+                    sgd_minibatch_size=256,
+                    model={"conv_filters": [[16, 8, 4], [32, 4, 2],
+                                            [64, 3, 2]],
+                           "post_fcnet_dim": 256})
+          .debugging(seed=0))
+algo = config.build()
+from ray_tpu._private.worker import global_worker
+rt = global_worker._runtime
+on_daemons = sum(
+    1 for a in rt._actors.values()
+    if getattr(a.creation_spec, "_node_id", None) in rt._remote_nodes)
+algo.train()  # warmup: compiles + first weight sync
+t0 = time.perf_counter()
+iters = 2
+for _ in range(iters):
+    algo.train()
+dt = time.perf_counter() - t0
+print(json.dumps({
+    "rllib_daemon_env_steps_per_sec": round(iters * BATCH / dt, 1),
+    "rllib_rollout_actors_on_daemons": on_daemons,
+}))
+algo.stop()
+for p in procs:
+    p.kill()
+ray_tpu.shutdown()
+"""
+
+
+def bench_rllib_daemons() -> dict:
+    """Rollout scale-out: PPO env-steps/s with rollout actors placed on
+    node-daemon processes, SampleBatches riding the object plane back to
+    the head learner (the distributed-sampling configuration; the plain
+    rllib bench measures the single-process path)."""
+    import json as _json
+    import subprocess
+    import sys
+
+    proc = subprocess.run([sys.executable, "-c",
+                           RLLIB_DAEMON_BENCH_SCRIPT],
+                          capture_output=True, text=True, timeout=1200)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"rllib daemon bench failed: {proc.stderr[-1500:]}")
+    return _json.loads(proc.stdout.strip().splitlines()[-1])
 
 
 def bench_rllib() -> dict:
@@ -431,6 +590,10 @@ def main():
     except Exception:  # noqa: BLE001 - extras must not sink the headline
         extra.setdefault("rllib_env_steps_per_sec", None)
     try:
+        extra.update(bench_rllib_daemons())
+    except Exception:  # noqa: BLE001 - extras must not sink the headline
+        extra.setdefault("rllib_daemon_env_steps_per_sec", None)
+    try:
         extra.update(bench_data_shuffle())
     except Exception:  # noqa: BLE001 - extras must not sink the headline
         extra.setdefault("shuffle_mb_per_sec", None)
@@ -438,6 +601,10 @@ def main():
         extra.update(bench_serve())
     except Exception:  # noqa: BLE001 - extras must not sink the headline
         extra.setdefault("serve_qps", None)
+    try:
+        extra.update(bench_shuffle_multi_daemon())
+    except Exception:  # noqa: BLE001 - extras must not sink the headline
+        extra.setdefault("shuffle_multi_mb_per_sec", None)
     if on_tpu:
         try:
             extra.update(bench_diffusion())
