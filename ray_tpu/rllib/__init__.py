@@ -19,6 +19,8 @@ from ray_tpu.rllib.algorithms.a3c import A3C, A3CConfig
 from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.algorithms.apex_ddpg import (ApexDDPG,
                                                 ApexDDPGConfig)
+from ray_tpu.rllib.algorithms.alpha_star import (AlphaStar,
+                                                 AlphaStarConfig)
 from ray_tpu.rllib.algorithms.alpha_zero import (AlphaZero,
                                                  AlphaZeroConfig)
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
@@ -75,6 +77,7 @@ __all__ = ["A2C", "A2CConfig", "A3C", "A3CConfig", "APPO", "APPOConfig",
            "BanditLinUCB", "BanditLinUCBConfig",
            "ApexDQN", "ApexDQNConfig", "ApexDDPG", "ApexDDPGConfig",
            "RandomAgent", "RandomAgentConfig",
+           "AlphaStar", "AlphaStarConfig",
            "AlphaZero", "AlphaZeroConfig", "CRR", "CRRConfig",
            "DDPPO", "DDPPOConfig", "Dreamer", "DreamerConfig", "MAML", "MAMLConfig", "MBMPO", "MBMPOConfig",
            "ARS", "ARSConfig", "Algorithm", "AlgorithmConfig", "BC",

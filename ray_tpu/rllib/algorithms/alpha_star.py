@@ -1,0 +1,332 @@
+"""AlphaStar: league-based self-play training.
+
+Analog of the reference's rllib/algorithms/alpha_star/ (alpha_star.py +
+league_builder.py AlphaStarLeagueBuilder): a LEAGUE of policies trains by
+playing matches against each other in a two-player zero-sum
+MultiAgentEnv —
+
+* **main** — the flagship: plays PFSP matches against frozen league
+  snapshots (and self-play); snapshots itself into the league when its
+  league win-rate crosses ``win_rate_threshold_for_new_snapshot``.
+* **main exploiters** — train ONLY against the learning main (finding its
+  current weaknesses); snapshot-and-reset when they beat it reliably.
+* **league exploiters** — train against PFSP over the whole league
+  (finding global holes); snapshot when they beat the league.
+
+Matchmaking probabilities and the snapshot threshold mirror the
+reference's league builder knobs. PFSP (prioritized fictitious self-play)
+weights opponents by how HARD they are for the learner —
+(1 - win_rate)^2 — so training focuses where it loses.
+
+TPU-first shape: one process owns every league policy (flax params are
+cheap to hold; the big win is the shared jitted PPO update compiled ONCE
+and reused by all learners), matches run on the driver; the learner SGD
+is the same fused program PPO uses, so a ``learner_backend`` pushes all
+league learning onto the chip.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.policy import make_policy
+from ray_tpu.rllib.policy.jax_policy import compute_gae
+from ray_tpu.rllib.policy.sample_batch import SampleBatch
+
+_ROW_KEYS = (SampleBatch.OBS, SampleBatch.NEXT_OBS, SampleBatch.ACTIONS,
+             SampleBatch.REWARDS, SampleBatch.TERMINATEDS,
+             SampleBatch.TRUNCATEDS, SampleBatch.ACTION_LOGP,
+             SampleBatch.VF_PREDS, SampleBatch.EPS_ID)
+
+
+class AlphaStarConfig(PPOConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class=algo_class or AlphaStar)
+        self.num_rollout_workers = 0
+        # League composition (reference: league_builder.py knobs).
+        self.num_main_exploiters = 1
+        self.num_league_exploiters = 1
+        self.win_rate_threshold_for_new_snapshot = 0.7
+        self.prob_league_exploiter_match = 0.33
+        self.prob_main_exploiter_match = 0.33
+        self.prob_exploiter_vs_learning_main = 0.5
+        self.matches_per_iteration = 16
+        self.win_rate_ema = 0.15
+        self.max_league_size = 12
+
+    def league(self, *, num_main_exploiters=None,
+               num_league_exploiters=None,
+               win_rate_threshold_for_new_snapshot=None,
+               prob_league_exploiter_match=None,
+               prob_main_exploiter_match=None,
+               prob_exploiter_vs_learning_main=None,
+               matches_per_iteration=None, win_rate_ema=None,
+               max_league_size=None, **_ignored) -> "AlphaStarConfig":
+        for name, val in locals().items():
+            if name not in ("self", "_ignored") and val is not None:
+                setattr(self, name, val)
+        return self
+
+
+class AlphaStar(PPO):
+    """League self-play on a two-player zero-sum MultiAgentEnv whose
+    agents are ``p0``/``p1``."""
+
+    _default_config_class = AlphaStarConfig
+    _own_rollout_actors = True  # matches run on the driver's league loop
+
+    def setup(self, config: AlphaStarConfig) -> None:
+        import jax
+        env = self._env_creator(config.env_config or {})
+        if not {"p0", "p1"} <= set(getattr(env, "agent_ids", set())):
+            raise ValueError(
+                "AlphaStar needs a two-player MultiAgentEnv with agents "
+                "'p0' and 'p1'")
+        self._match_env = env
+        obs_space = env.observation_space
+        act_space = env.action_space
+        pcfg = config.policy_config()
+
+        def new_policy(seed):
+            return make_policy(pcfg, obs_space, act_space, seed=seed)
+
+        # Learning side of the league.
+        self.learning: Dict[str, Any] = {"main": new_policy(config.seed)}
+        for i in range(config.num_main_exploiters):
+            self.learning[f"main_exploiter_{i}"] = new_policy(
+                config.seed + 101 + i)
+        for i in range(config.num_league_exploiters):
+            self.learning[f"league_exploiter_{i}"] = new_policy(
+                config.seed + 202 + i)
+        # Shared fused PPO update: every policy has the same network
+        # shape, so ONE jitted program serves all learners.
+        self._updates = {}
+        self._opt_states = {}
+        for pid, policy in self.learning.items():
+            self._updates[pid], self._opt_states[pid] = \
+                self._build_update(policy, config)
+        # Frozen league: starts with a snapshot of the initial main.
+        self.league: Dict[str, Any] = {
+            "main_v0": jax.tree.map(np.asarray,
+                                    self.learning["main"].get_weights())}
+        self._frozen_policy = new_policy(config.seed + 999)  # evaluator
+        # EMA win-rates per (learner, opponent-name) pair.
+        self.win_rates: Dict[Tuple[str, str], float] = {}
+        self._snapshot_counter = {"main": 0}
+        self._rng = np.random.default_rng(config.seed)
+        self._key = jax.random.PRNGKey(config.seed ^ 0xA57A)
+
+    # -- matchmaking -----------------------------------------------------
+
+    def _pfsp_pick(self, learner: str,
+                   candidates: List[str]) -> str:
+        """Prioritized fictitious self-play: weight opponents by how
+        often they BEAT the learner — (1 - p_win)^2 (reference:
+        AlphaStar PFSP hard-opponent weighting)."""
+        weights = np.array([
+            (1.0 - self.win_rates.get((learner, c), 0.5)) ** 2 + 1e-3
+            for c in candidates])
+        return candidates[int(self._rng.choice(
+            len(candidates), p=weights / weights.sum()))]
+
+    def _pick_opponent(self, learner: str) -> Tuple[str, bool]:
+        """Returns (opponent_name, opponent_is_learning)."""
+        cfg: AlphaStarConfig = self.config
+        snapshots = list(self.league)
+        if learner.startswith("main_exploiter"):
+            # Main exploiters hunt the LEARNING main (sometimes its
+            # snapshots, so they generalize a little).
+            if self._rng.random() < cfg.prob_exploiter_vs_learning_main:
+                return "main", True
+            mains = [s for s in snapshots if s.startswith("main_v")]
+            return self._pfsp_pick(learner, mains or snapshots), False
+        if learner.startswith("league_exploiter"):
+            return self._pfsp_pick(learner, snapshots), False
+        # The main: mostly PFSP vs league, sometimes pure self-play.
+        r = self._rng.random()
+        if r < cfg.prob_league_exploiter_match + \
+                cfg.prob_main_exploiter_match:
+            return self._pfsp_pick(learner, snapshots), False
+        return "main", True
+
+    def _opponent_policy(self, name: str, is_learning: bool):
+        if is_learning:
+            return self.learning[name]
+        self._frozen_policy.set_weights(self.league[name])
+        return self._frozen_policy
+
+    # -- match loop ------------------------------------------------------
+
+    def _play_match(self, learner_pid: str, learner_policy,
+                    opponent_policy) -> Tuple[SampleBatch, float]:
+        """One episode, learner as a random side; returns the learner's
+        transition batch and its total (zero-sum) score."""
+        import jax
+        cfg: AlphaStarConfig = self.config
+        env = self._match_env
+        side = "p0" if self._rng.random() < 0.5 else "p1"
+        other = "p1" if side == "p0" else "p0"
+        pols = {side: learner_policy, other: opponent_policy}
+        rows = {k: [] for k in _ROW_KEYS}
+        obs, _ = env.reset()
+        score = 0.0
+        eps_id = int(self._rng.integers(1 << 31))
+        done = False
+        while not done:
+            actions = {}
+            meta = None
+            for agent, pol in pols.items():
+                if agent not in obs:
+                    continue
+                arr = np.asarray(obs[agent], np.float32)
+                self._key, sub = jax.random.split(self._key)
+                action, logp, value = pol.compute_actions(arr[None], sub)
+                act = action[0]
+                actions[agent] = (int(act) if pol.discrete
+                                  else np.asarray(act))
+                if agent == side:
+                    meta = (arr, act, float(logp[0]), float(value[0]))
+            nxt, rewards, terms, truncs, _ = env.step(actions)
+            done = bool(terms.get("__all__") or truncs.get("__all__"))
+            if meta is not None:
+                arr, act, logp, value = meta
+                reward = float(rewards.get(side, 0.0))
+                score += reward
+                rows[SampleBatch.OBS].append(arr)
+                rows[SampleBatch.NEXT_OBS].append(
+                    np.asarray(nxt.get(side, arr), np.float32))
+                rows[SampleBatch.ACTIONS].append(act)
+                rows[SampleBatch.REWARDS].append(np.float32(reward))
+                rows[SampleBatch.TERMINATEDS].append(np.float32(done))
+                rows[SampleBatch.TRUNCATEDS].append(np.float32(0.0))
+                rows[SampleBatch.ACTION_LOGP].append(np.float32(logp))
+                rows[SampleBatch.VF_PREDS].append(np.float32(value))
+                rows[SampleBatch.EPS_ID].append(eps_id)
+            obs = nxt
+        batch = SampleBatch({k: np.asarray(v) for k, v in rows.items()})
+        batch = compute_gae(batch, cfg.gamma, cfg.lambda_, 0.0)
+        return batch, score
+
+    def _note_result(self, learner: str, opponent: str,
+                     score: float) -> None:
+        cfg: AlphaStarConfig = self.config
+        won = 1.0 if score > 0 else (0.5 if score == 0 else 0.0)
+        key = (learner, opponent)
+        prev = self.win_rates.get(key, 0.5)
+        self.win_rates[key] = (1 - cfg.win_rate_ema) * prev + \
+            cfg.win_rate_ema * won
+
+    # -- league building -------------------------------------------------
+
+    def _league_win_rate(self, learner: str) -> float:
+        rates = [r for (lp, _op), r in self.win_rates.items()
+                 if lp == learner]
+        return float(np.mean(rates)) if rates else 0.5
+
+    def _build_league(self) -> List[str]:
+        """Snapshot learners that beat their opposition (reference:
+        league_builder.build_league): main adds a copy and keeps
+        learning; exploiters add a copy and RESET (hunt afresh)."""
+        import jax
+        cfg: AlphaStarConfig = self.config
+        added = []
+        for pid, policy in self.learning.items():
+            if len(self.league) >= cfg.max_league_size:
+                break  # cap holds even when several learners qualify
+            if self._league_win_rate(pid) < \
+                    cfg.win_rate_threshold_for_new_snapshot:
+                continue
+            base = "main" if pid == "main" else pid
+            self._snapshot_counter[base] = \
+                self._snapshot_counter.get(base, 0) + 1
+            name = f"{base}_v{self._snapshot_counter[base]}"
+            self.league[name] = jax.tree.map(np.asarray,
+                                             policy.get_weights())
+            added.append(name)
+            for key in [k for k in self.win_rates if k[0] == pid]:
+                del self.win_rates[key]  # fresh slate vs new opposition
+            if pid != "main":
+                # Exploiters restart from scratch after a successful hunt.
+                fresh = make_policy(
+                    cfg.policy_config(),
+                    self._match_env.observation_space,
+                    self._match_env.action_space,
+                    seed=int(self._rng.integers(1 << 30)))
+                policy.set_weights(fresh.get_weights())
+                self._opt_states[pid] = self._build_update(
+                    policy, cfg)[1]
+        return added
+
+    # -- Trainable -------------------------------------------------------
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: AlphaStarConfig = self.config
+        results: Dict[str, Any] = {}
+        for pid, policy in self.learning.items():
+            parts, scores = [], []
+            for _ in range(cfg.matches_per_iteration):
+                opp, opp_learning = self._pick_opponent(pid)
+                batch, score = self._play_match(
+                    pid, policy, self._opponent_policy(opp, opp_learning))
+                if len(batch):
+                    parts.append(batch)
+                scores.append(score)
+                if opp != pid:
+                    # Matches against the LEARNING main count too — a
+                    # main exploiter's snapshot criterion is beating the
+                    # live main, not just stale snapshots. Only pure
+                    # self-play (learner vs itself) is uninformative.
+                    self._note_result(pid, opp, score)
+            if not parts:
+                continue
+            batch = SampleBatch.concat_samples(parts)
+            self._timesteps_total += len(batch)
+            self._opt_states[pid], metrics = self._sgd(
+                policy, self._updates[pid], self._opt_states[pid],
+                batch, cfg)
+            results[f"{pid}/mean_score"] = float(np.mean(scores))
+            results[f"{pid}/league_win_rate"] = self._league_win_rate(pid)
+            for k, v in metrics.items():
+                results[f"{pid}/{k}"] = v
+        added = self._build_league()
+        results["league_size"] = len(self.league)
+        results["league_added"] = added
+        results["win_rates"] = {f"{a} vs {b}": round(r, 3)
+                                for (a, b), r in self.win_rates.items()}
+        return results
+
+    def win_rate_vs(self, snapshot: str, episodes: int = 50) -> float:
+        """Evaluation: learning main's empirical win-rate against a
+        frozen league snapshot."""
+        wins = 0.0
+        for _ in range(episodes):
+            _, score = self._play_match(
+                "main", self.learning["main"],
+                self._opponent_policy(snapshot, False))
+            wins += 1.0 if score > 0 else (0.5 if score == 0 else 0.0)
+        return wins / episodes
+
+    def get_weights(self):
+        """save()/restore() round-trip the WHOLE league state (the base
+        contract pickles get_weights; a bare main-params dict would lose
+        the frozen snapshots and win matrix)."""
+        import jax
+        return {
+            "learning": {pid: jax.tree.map(np.asarray, p.get_weights())
+                         for pid, p in self.learning.items()},
+            "league": self.league,
+            "win_rates": dict(self.win_rates),
+            "snapshot_counter": dict(self._snapshot_counter),
+        }
+
+    def set_weights(self, state: Dict[str, Any]) -> None:
+        for pid, w in state["learning"].items():
+            if pid in self.learning:
+                self.learning[pid].set_weights(w)
+        self.league = dict(state["league"])
+        self.win_rates = dict(state["win_rates"])
+        self._snapshot_counter = dict(state["snapshot_counter"])

@@ -11,6 +11,7 @@ def get_algorithm_class(name: str) -> Type:
     from ray_tpu.rllib.algorithms.a3c import A3C
     from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN
     from ray_tpu.rllib.algorithms.apex_ddpg import ApexDDPG
+    from ray_tpu.rllib.algorithms.alpha_star import AlphaStar
     from ray_tpu.rllib.algorithms.alpha_zero import AlphaZero
     from ray_tpu.rllib.algorithms.appo import APPO
     from ray_tpu.rllib.algorithms.ars import ARS
@@ -48,7 +49,8 @@ def get_algorithm_class(name: str) -> Type:
              "R2D2": R2D2, "QMIX": QMix, "MADDPG": MADDPG,
              "SLATEQ": SlateQ,
              "ES": ES, "ARS": ARS, "CQL": CQL, "DT": DT, "CRR": CRR,
-             "DDPPO": DDPPO, "ALPHAZERO": AlphaZero, "DREAMER": Dreamer,
+             "DDPPO": DDPPO, "ALPHAZERO": AlphaZero,
+             "ALPHASTAR": AlphaStar, "DREAMER": Dreamer,
              "MAML": MAML, "MBMPO": MBMPO,
              "BANDITLINUCB": BanditLinUCB, "BANDITLINTS": BanditLinTS}
     try:

@@ -253,3 +253,55 @@ class PointGoalEnv:
 
     def close(self):
         pass
+
+
+class TwoPlayerRepeatedRPS(MultiAgentEnv):
+    """Two-player repeated rock-paper-scissors — the competitive
+    self-play testbed for league training (AlphaStar's match shape at
+    CI scale). Each round both agents pick {0,1,2}; rewards are the
+    zero-sum payoff; observations are one-hot encodings of BOTH last
+    moves (own, opponent's) so a policy can learn to exploit an
+    opponent's conditional biases. Episodes run ``rounds`` rounds."""
+
+    agent_ids = {"p0", "p1"}
+
+    def __init__(self, config: Optional[dict] = None):
+        import gymnasium.spaces as _spaces
+        config = dict(config or {})
+        self.rounds = int(config.get("rounds", 8))
+        self.observation_space = _spaces.Box(0.0, 1.0, (6,), np.float32)
+        self.action_space = _spaces.Discrete(3)
+        self._t = 0
+        self._last = {"p0": None, "p1": None}
+
+    def _obs_for(self, me: str, other: str) -> np.ndarray:
+        obs = np.zeros(6, np.float32)
+        if self._last[me] is not None:
+            obs[self._last[me]] = 1.0
+            obs[3 + self._last[other]] = 1.0
+        return obs
+
+    def reset(self, *, seed=None, options=None):
+        self._t = 0
+        self._last = {"p0": None, "p1": None}
+        return ({"p0": self._obs_for("p0", "p1"),
+                 "p1": self._obs_for("p1", "p0")}, {})
+
+    def step(self, action_dict):
+        a0 = int(action_dict["p0"])
+        a1 = int(action_dict["p1"])
+        # 0 beats 2, 1 beats 0, 2 beats 1 (rock/paper/scissors cycle).
+        if a0 == a1:
+            r0 = 0.0
+        elif (a0 - a1) % 3 == 1:
+            r0 = 1.0
+        else:
+            r0 = -1.0
+        self._last = {"p0": a0, "p1": a1}
+        self._t += 1
+        done = self._t >= self.rounds
+        obs = {"p0": self._obs_for("p0", "p1"),
+               "p1": self._obs_for("p1", "p0")}
+        return (obs, {"p0": r0, "p1": -r0},
+                {"__all__": done, "p0": done, "p1": done},
+                {"__all__": False, "p0": False, "p1": False}, {})
