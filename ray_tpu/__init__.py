@@ -19,7 +19,7 @@ from ray_tpu._private.worker import (ClientContext, available_resources,
                                      get_actor, get_tpu_ids, init,
                                      is_initialized, kill, nodes, put,
                                      shutdown, start_head_server, wait)
-from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.actor import ActorClass, ActorHandle, method
 from ray_tpu.remote_function import RemoteFunction, remote
 from ray_tpu.runtime_context import get_runtime_context
 
@@ -30,6 +30,7 @@ get_gpu_ids = get_tpu_ids
 
 __all__ = [
     "ActorClass",
+    "method",
     "ActorHandle",
     "ClientContext",
     "ObjectRef",

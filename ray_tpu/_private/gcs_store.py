@@ -92,7 +92,9 @@ class GcsStore:
     def record_actor(self, actor_id_hex: str, name: str, namespace: str,
                      max_restarts: int, max_concurrency: int,
                      cls_bytes: Optional[bytes] = None,
-                     resources: Optional[Dict[str, float]] = None) -> None:
+                     resources: Optional[Dict[str, float]] = None,
+                     concurrency_groups: Optional[Dict[str, int]] = None
+                     ) -> None:
         """cls_bytes: the pickled actor class, so a restarted head can
         rebuild handles (method introspection) for rebound actors.
         resources: the creation-time reservation, re-acquired on the
@@ -105,6 +107,7 @@ class GcsStore:
                 "max_concurrency": max_concurrency,
                 "cls_bytes": cls_bytes,
                 "resources": dict(resources or {}),
+                "concurrency_groups": dict(concurrency_groups or {}),
             }
             self._save_locked()
 

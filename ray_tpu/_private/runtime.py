@@ -188,17 +188,59 @@ class ThreadPoolActorExecutor(Executor):
         self._pool.shutdown(wait=wait, cancel_futures=True)
 
 
+class ConcurrencyGroupExecutor(Executor):
+    """Named concurrency groups for sync actors (reference:
+    core_worker/transport/concurrency_group_manager.h): each group gets
+    its own sub-executor with its own limit — "io" calls never eat
+    "compute" slots — and per-group FIFO ordering holds (serial groups
+    are strictly ordered; pooled groups bound concurrency). Untagged
+    methods run on the default group (max_concurrency)."""
+
+    def __init__(self, worker_id: WorkerID, name: str,
+                 groups: Dict[str, int], max_concurrency: int):
+        super().__init__(worker_id)
+
+        def make(limit: int, suffix: str) -> Executor:
+            if limit <= 1:
+                return SerialThreadExecutor(worker_id, f"{name}-{suffix}")
+            return ThreadPoolActorExecutor(worker_id, f"{name}-{suffix}",
+                                           limit)
+
+        self._default = make(max(max_concurrency, 1), "default")
+        self._groups: Dict[str, Executor] = {
+            g: make(int(n), g) for g, n in groups.items()}
+
+    def submit(self, thunk):
+        self._default.submit(thunk)
+
+    def submit_group(self, group: Optional[str], thunk):
+        self._groups.get(group, self._default).submit(thunk)
+
+    def group_names(self):
+        return set(self._groups)
+
+    def stop(self, wait: bool = False):
+        self.dead = True
+        self._default.stop(wait)
+        for ex in self._groups.values():
+            ex.stop(wait)
+
+
 class AsyncioActorExecutor(Executor):
     """Actor executor for async actors: a dedicated event loop thread; each
     task runs as an asyncio task, so ``await`` interleaves calls the way the
     reference's fiber-based async actors do
-    (src/ray/core_worker/transport/fiber.h)."""
+    (src/ray/core_worker/transport/fiber.h). Named concurrency groups map
+    to per-group semaphores on the same loop."""
 
-    def __init__(self, worker_id: WorkerID, name: str, max_concurrency: int):
+    def __init__(self, worker_id: WorkerID, name: str, max_concurrency: int,
+                 groups: Optional[Dict[str, int]] = None):
         super().__init__(worker_id)
         import asyncio
         self._loop = asyncio.new_event_loop()
         self._sem = asyncio.Semaphore(max_concurrency)
+        self._group_sems = {g: asyncio.Semaphore(int(n))
+                            for g, n in (groups or {}).items()}
         self._thread = threading.Thread(
             target=self._loop.run_forever, name=name, daemon=True)
         self._thread.start()
@@ -208,10 +250,14 @@ class AsyncioActorExecutor(Executor):
         return self._loop
 
     def submit(self, thunk):
+        self.submit_group(None, thunk)
+
+    def submit_group(self, group: Optional[str], thunk):
         import asyncio
+        sem = self._group_sems.get(group, self._sem)
 
         async def _run():
-            async with self._sem:
+            async with sem:
                 result = thunk()
                 if asyncio.iscoroutine(result):
                     await result
@@ -242,12 +288,14 @@ class AsyncioActorExecutor(Executor):
 class ActorState:
     def __init__(self, actor_id: ActorID, creation_spec: TaskSpec,
                  max_restarts: int, max_concurrency: int, name: str = "",
-                 namespace: str = ""):
+                 namespace: str = "",
+                 concurrency_groups: Optional[Dict[str, int]] = None):
         self.actor_id = actor_id
         self.creation_spec = creation_spec
         self.max_restarts = max_restarts
         self.num_restarts = 0
         self.max_concurrency = max_concurrency
+        self.concurrency_groups = dict(concurrency_groups or {})
         self.name = name
         self.namespace = namespace
         self.executor: Optional[Executor] = None
@@ -1615,10 +1663,13 @@ class Runtime:
     def create_actor(self, spec: TaskSpec, *, max_restarts: int,
                      max_concurrency: int, name: str = "",
                      namespace: str = "default",
-                     get_if_exists: bool = False) -> ActorID:
+                     get_if_exists: bool = False,
+                     concurrency_groups: Optional[Dict[str, int]] = None
+                     ) -> ActorID:
         actor_id = spec.actor_id
         state = ActorState(actor_id, spec, max_restarts, max_concurrency,
-                           name, namespace)
+                           name, namespace,
+                           concurrency_groups=concurrency_groups)
         with self._lock:
             # Uniqueness check + registration atomically, so concurrent
             # creates with the same name cannot both succeed.
@@ -1642,7 +1693,8 @@ class Runtime:
             self.gcs_store.record_actor(
                 actor_id.hex(), name, namespace, max_restarts,
                 max_concurrency, cls_bytes=cls_bytes,
-                resources=dict(spec.resources or {}))
+                resources=dict(spec.resources or {}),
+                concurrency_groups=concurrency_groups)
         spec.return_ids = [ObjectID.for_return(spec.task_id, 1)]
         self._register_task_refs(spec)
         self._record_event(spec, "SUBMITTED")
@@ -1661,7 +1713,12 @@ class Runtime:
             ex: Executor = AsyncioActorExecutor(
                 wid, name, max(state.max_concurrency, 1000 if
                                state.max_concurrency <= 1 else
-                               state.max_concurrency))
+                               state.max_concurrency),
+                groups=state.concurrency_groups)
+        elif state.concurrency_groups:
+            ex = ConcurrencyGroupExecutor(wid, name,
+                                          state.concurrency_groups,
+                                          state.max_concurrency)
         elif state.max_concurrency > 1:
             ex = ThreadPoolActorExecutor(wid, name, state.max_concurrency)
         else:
@@ -1729,8 +1786,8 @@ class Runtime:
                     # Flush tasks that dep-resolved before creation finished,
                     # preserving their arrival order.
                     for queued in state.pre_creation_queue:
-                        executor.submit(
-                            lambda s=queued: self._run_actor_task(s, state))
+                        self._submit_to_actor_executor(executor, queued,
+                                                       state)
                     state.pre_creation_queue.clear()
             if killed:
                 self._store_error(spec, state.death_cause)
@@ -1770,6 +1827,19 @@ class Runtime:
         self._dispatch()
 
     def submit_actor_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        group = spec.concurrency_group
+        if group is not None:
+            gstate = self._actors.get(spec.actor_id)
+            if gstate is not None and \
+                    group not in gstate.concurrency_groups:
+                # Typos and group calls on group-less actors fail LOUDLY
+                # (reference: unknown concurrency group raises) — silent
+                # default-lane routing would fake isolation. Checked
+                # BEFORE any ref registration so nothing leaks.
+                raise ValueError(
+                    f"Actor {spec.actor_id.hex()[:8]} has no concurrency "
+                    f"group {group!r}; declared: "
+                    f"{sorted(gstate.concurrency_groups) or 'none'}")
         from ray_tpu.util import tracing
         if tracing.is_tracing_enabled():
             spec.trace_ctx = tracing.inject_context()
@@ -1824,8 +1894,8 @@ class Runtime:
             ready = seq_state["waiting"].pop(nxt)
             seq_state["next"] += 1
             if state.created.is_set() and state.executor is not None:
-                state.executor.submit(
-                    lambda s=ready: self._run_actor_task(s, state))
+                self._submit_to_actor_executor(state.executor, ready,
+                                               state)
             else:
                 state.pre_creation_queue.append(ready)
 
@@ -1848,6 +1918,19 @@ class Runtime:
                 handle, {"next": 1, "waiting": {}, "aborted": set()})
             seq_state["waiting"][spec.sequence_number] = spec
             self._drain_actor_seq(state, seq_state)
+
+    def _submit_to_actor_executor(self, executor, spec: TaskSpec,
+                                  state: ActorState) -> None:
+        """Per-method concurrency-group routing (reference:
+        concurrency_group_manager.h GetExecutor): tagged calls go to
+        their group's sub-executor; untagged (or group-less actors) use
+        the default path."""
+        group = getattr(spec, "concurrency_group", None)
+        if group is not None and hasattr(executor, "submit_group"):
+            executor.submit_group(
+                group, lambda s=spec: self._run_actor_task(s, state))
+        else:
+            executor.submit(lambda s=spec: self._run_actor_task(s, state))
 
     def _finish_actor_task(self, spec: TaskSpec, state: ActorState) -> None:
         with state.lock:
@@ -2042,8 +2125,8 @@ class Runtime:
                     state.executor = executor
                     state.created.set()
                     for queued in state.pre_creation_queue:
-                        executor.submit(
-                            lambda s=queued: self._run_actor_task(s, state))
+                        self._submit_to_actor_executor(executor, queued,
+                                                       state)
                     state.pre_creation_queue.clear()
         except BaseException as e:  # noqa: BLE001
             if getattr(spec, "invalidated", False) or \
@@ -2277,7 +2360,9 @@ class Runtime:
                 # creation args died with the old head) — max_restarts=0.
                 state = ActorState(actor_id, spec, 0,
                                    rec["max_concurrency"],
-                                   rec["name"], rec["namespace"])
+                                   rec["name"], rec["namespace"],
+                                   concurrency_groups=rec.get(
+                                       "concurrency_groups"))
                 state.instance = RemoteActorInstance(conn, actor_id)
                 state.executor = self._make_actor_executor(state)
                 state.created.set()

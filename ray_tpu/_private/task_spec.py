@@ -122,6 +122,9 @@ class TaskSpec:
     method_name: str = ""
     sequence_number: int = 0  # per-handle ordering for actor tasks
     caller_handle_id: str = ""  # which ActorHandle issued the call
+    # Named concurrency group this actor call routes to (reference:
+    # concurrency_group_manager.h); None = the actor's default group.
+    concurrency_group: Optional[str] = None
     placement_group_id: Optional[Any] = None
     placement_group_bundle_index: int = -1
     scheduling_strategy: Any = None

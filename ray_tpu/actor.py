@@ -18,18 +18,26 @@ from ray_tpu._private.worker import global_worker
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1,
+                 concurrency_group: Optional[str] = None):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def remote(self, *args, **kwargs):
         return self._handle._actor_method_call(
             self._method_name, args, kwargs,
-            num_returns=self._num_returns)
+            num_returns=self._num_returns,
+            concurrency_group=self._concurrency_group)
 
-    def options(self, num_returns: int = 1, name: str = "", **_ignored):
-        return ActorMethod(self._handle, self._method_name, num_returns)
+    def options(self, num_returns: Optional[int] = None, name: str = "",
+                concurrency_group: Optional[str] = None, **_ignored):
+        return ActorMethod(
+            self._handle, self._method_name,
+            self._num_returns if num_returns is None else num_returns,
+            concurrency_group=(concurrency_group or
+                               self._concurrency_group))
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -55,9 +63,18 @@ class ActorHandle:
     def __getattr__(self, item):
         if item.startswith("_"):
             raise AttributeError(item)
+        # @ray_tpu.method tags on the class set per-method defaults.
+        tag = getattr(getattr(self._cls, item, None),
+                      "__ray_tpu_method__", None) if self._cls else None
+        if tag:
+            return ActorMethod(
+                self, item,
+                num_returns=tag.get("num_returns", 1),
+                concurrency_group=tag.get("concurrency_group"))
         return ActorMethod(self, item)
 
-    def _actor_method_call(self, method_name, args, kwargs, num_returns=1):
+    def _actor_method_call(self, method_name, args, kwargs, num_returns=1,
+                           concurrency_group=None):
         runtime = global_worker.runtime
         seq = next(self._seq_counter)
         state = runtime.actor_state(self._actor_id)
@@ -77,6 +94,7 @@ class ActorHandle:
             method_name=method_name,
             sequence_number=seq,
             caller_handle_id=self._handle_id,
+            concurrency_group=concurrency_group,
         )
         refs = runtime.submit_actor_task(spec)
         if num_returns == 0:
@@ -168,5 +186,24 @@ class ActorClass:
             name=name,
             namespace=namespace,
             get_if_exists=get_if_exists,
+            concurrency_groups=options.get("concurrency_groups"),
         )
         return ActorHandle(actual_id, self._cls, name)
+
+
+def method(*, num_returns: int = 1,
+           concurrency_group: Optional[str] = None):
+    """Per-method defaults on actor classes (reference: ray.method):
+
+        @ray_tpu.remote(concurrency_groups={"io": 2, "compute": 4})
+        class A:
+            @ray_tpu.method(concurrency_group="io")
+            def fetch(self): ...
+
+    Handle calls route to the tagged group without per-call
+    ``.options(concurrency_group=...)``."""
+    def decorate(fn):
+        fn.__ray_tpu_method__ = {"num_returns": num_returns,
+                                 "concurrency_group": concurrency_group}
+        return fn
+    return decorate
