@@ -501,6 +501,15 @@ class NodeConnection:
         reply = self._request({"type": "stats"}, timeout=timeout)
         return _loads(reply["value"])
 
+    def profile(self, duration: float = 5.0, hz: int = 100,
+                fmt: str = "folded"):
+        """Ask the daemon to sample ITS OWN stacks (cooperative remote
+        profiling; reference: dashboard profile endpoints)."""
+        reply = self._request(
+            {"type": "profile", "duration": duration, "hz": hz,
+             "fmt": fmt}, timeout=duration + 30)
+        return _loads(reply["value"])
+
 
 class RemoteValueStub:
     """Head-side handle to a result the daemon kept locally (it exceeded
@@ -1131,7 +1140,8 @@ class NodeDaemon:
             return False
         return self._use_worker_processes or bool(
             renv.get("worker_process") or renv.get("pip")
-            or renv.get("venv") or renv.get("conda"))
+            or renv.get("venv") or renv.get("conda")
+            or renv.get("container"))
 
     def _resolve_markers_for_worker(self, args, kwargs):
         """Like _resolve_markers, but arena-resident payloads stay as
@@ -1171,11 +1181,15 @@ class NodeDaemon:
         from ray_tpu._private.worker_process import (WorkerCrashedError,
                                                      WorkerFnMissingError)
         pool = self._get_pool()
-        python = python_for_env(msg.get("runtime_env"))
+        renv = msg.get("runtime_env") or {}
+        python = python_for_env(renv)
+        container = renv.get("container")
         lease_ex = msg.get("_lease_exec")
-        if lease_ex is not None:
+        if lease_ex is not None and not container:
             # Leased task: the lease pins ONE worker subprocess for its
             # whole lifetime (reference: a granted lease IS a worker).
+            # Containerized tasks always pool-lease (the pool keys by
+            # image; pinning would mix images on one lease).
             handle = lease_ex.worker_handle
             if handle is None or handle.dead or \
                     lease_ex.worker_python != python:
@@ -1185,7 +1199,8 @@ class NodeDaemon:
                 lease_ex.worker_handle = handle
                 lease_ex.worker_python = python
         else:
-            handle = pool.lease(python)
+            handle = pool.lease(python, container=container)
+            lease_ex = None  # containerized: never pin
         try:
             args, kwargs = self._resolve_markers_for_worker(
                 *_loads(msg["payload"]))
@@ -1338,6 +1353,14 @@ class NodeDaemon:
             elif kind == "free_object":
                 self._table.free(msg["key"])
                 self._reply(sock, req_id, value=None)
+            elif kind == "profile":
+                # Self-sampled stacks (reference: profile_manager.py
+                # py-spy-on-demand, here cooperative — no ptrace).
+                from ray_tpu._private.profiling import profile_self
+                self._reply(sock, req_id, value=profile_self(
+                    min(float(msg.get("duration", 5.0)), 60.0),
+                    int(msg.get("hz", 100)),
+                    msg.get("fmt", "folded")))
             elif kind == "stats":
                 self._reply(sock, req_id, value={
                     "transfer": dict(self._table.stats),

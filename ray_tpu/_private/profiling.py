@@ -1,0 +1,123 @@
+"""On-demand CPU profiling: stack sampling without external tooling.
+
+Analog of the reference's dashboard profiling endpoints
+(dashboard/modules/reporter/profile_manager.py:54 — py-spy flamegraphs /
+speedscope traces on demand). py-spy is not a dependency here; instead
+every ray_tpu process can sample ITS OWN threads via
+``sys._current_frames`` at a fixed rate and emit collapsed ("folded")
+stacks or a speedscope document. Cross-process profiling works by asking
+the target process to sample itself: node daemons answer a ``profile``
+control message (multinode.py), so ``ray-tpu profile --node <id>``
+needs no ptrace and no extra binaries. When py-spy IS installed, it is
+preferred for arbitrary pids (native stacks, no cooperation needed).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["sample_self", "folded_to_speedscope", "profile_self",
+           "pyspy_available", "profile_pid_pyspy"]
+
+
+def sample_self(duration_s: float = 5.0, hz: int = 100,
+                skip_profiler: bool = True) -> Dict[str, int]:
+    """Sample every thread's Python stack for ``duration_s`` seconds at
+    ``hz``; returns collapsed stacks ("thr;outer;...;inner" -> count,
+    flamegraph.pl / speedscope input format)."""
+    counts: Dict[str, int] = {}
+    me = threading.get_ident()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    period = 1.0 / max(hz, 1)
+    deadline = time.monotonic() + duration_s
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if skip_profiler and ident == me:
+                continue
+            stack: List[str] = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                stack.append(f"{code.co_name} "
+                             f"({code.co_filename.rsplit('/', 1)[-1]}:"
+                             f"{f.f_lineno})")
+                f = f.f_back
+            name = names.get(ident) or str(ident)
+            key = ";".join([name] + stack[::-1])
+            counts[key] = counts.get(key, 0) + 1
+        time.sleep(period)
+    return counts
+
+
+def folded_to_speedscope(counts: Dict[str, int], name: str = "ray_tpu",
+                         hz: int = 100) -> dict:
+    """Collapsed stacks -> a speedscope 'sampled' profile document
+    (https://www.speedscope.app file-format-schema)."""
+    frame_index: Dict[str, int] = {}
+    frames: List[dict] = []
+    samples: List[List[int]] = []
+    weights: List[float] = []
+    dt = 1.0 / max(hz, 1)
+    for key, count in sorted(counts.items()):
+        stack_ids = []
+        for part in key.split(";"):
+            if part not in frame_index:
+                frame_index[part] = len(frames)
+                frames.append({"name": part})
+            stack_ids.append(frame_index[part])
+        samples.append(stack_ids)
+        weights.append(count * dt)
+    total = sum(weights) or 1.0
+    return {
+        "$schema": "https://www.speedscope.app/file-format-schema.json",
+        "shared": {"frames": frames},
+        "profiles": [{
+            "type": "sampled",
+            "name": name,
+            "unit": "seconds",
+            "startValue": 0,
+            "endValue": total,
+            "samples": samples,
+            "weights": weights,
+        }],
+        "name": name,
+        "activeProfileIndex": 0,
+        "exporter": "ray_tpu-profiler",
+    }
+
+
+def profile_self(duration_s: float = 5.0, hz: int = 100,
+                 fmt: str = "folded"):
+    """One-call self-profile: 'folded' text or 'speedscope' dict."""
+    counts = sample_self(duration_s, hz)
+    if fmt == "folded":
+        return "\n".join(f"{k} {v}" for k, v in sorted(counts.items()))
+    if fmt == "speedscope":
+        return folded_to_speedscope(counts, hz=hz)
+    raise ValueError(f"unknown profile format {fmt!r}")
+
+
+def pyspy_available() -> bool:
+    import shutil
+    return shutil.which("py-spy") is not None
+
+
+def profile_pid_pyspy(pid: int, duration_s: float = 5.0,
+                      fmt: str = "speedscope") -> bytes:
+    """Profile an arbitrary pid with py-spy (when installed): returns the
+    raw output file bytes (reference: profile_manager.py py-spy record)."""
+    import subprocess
+    import tempfile
+    suffix = ".speedscope.json" if fmt == "speedscope" else ".txt"
+    out = tempfile.NamedTemporaryFile(suffix=suffix, delete=False)
+    out.close()
+    pyspy_fmt = "speedscope" if fmt == "speedscope" else "raw"
+    subprocess.run(
+        ["py-spy", "record", "--pid", str(pid), "--duration",
+         str(int(duration_s)), "--format", pyspy_fmt, "--output", out.name],
+        check=True, capture_output=True, timeout=duration_s + 30)
+    with open(out.name, "rb") as f:
+        return f.read()

@@ -63,15 +63,24 @@ def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
         raise ValueError(
             "runtime_env['conda'] must be an env name (str) or an "
             "environment.yml-style dict")
-    if runtime_env.get("container"):
-        # Declared parity gap, loudly: the reference's container plugin
-        # (_private/runtime_env/container.py) wraps workers in podman;
-        # this runtime has no container engine in its images.
-        raise ValueError(
-            "runtime_env['container'] is not supported: worker "
-            "processes run directly on the node (no container engine "
-            "in the TPU images). Use 'conda' or 'pip' for dependency "
-            "isolation.")
+    container = runtime_env.get("container")
+    if container:
+        # Accepted when a container engine exists (reference:
+        # _private/runtime_env/container.py wraps workers in podman;
+        # here the worker's framed protocol rides stdio through
+        # `engine run -i` with /dev/shm shared for the object arena).
+        from ray_tpu._private.worker_process import container_engine
+        if not isinstance(container, dict) or not container.get("image"):
+            raise ValueError(
+                "runtime_env['container'] must be a dict with an "
+                "'image' (and optional 'run_options': [str], "
+                "'python': str)")
+        if container_engine() is None:
+            raise ValueError(
+                "runtime_env['container'] needs a container engine: "
+                "install docker or podman on every node (or set "
+                "RAY_TPU_CONTAINER_ENGINE), or use 'conda'/'pip' for "
+                "dependency isolation without containers.")
     return dict(runtime_env)
 
 

@@ -78,6 +78,47 @@ class ArenaArrayRef:
 # ---------------------------------------------------------------------------
 
 
+class _StdioTransport:
+    """Socket-shaped transport over a child's stdin/stdout pipes — the
+    CONTAINER transport: ``docker run -i`` cannot inherit a socketpair
+    fd across the container boundary, but stdio crosses it natively
+    (reference: _private/runtime_env/container.py wraps workers in
+    podman; the control channel must survive the wrap)."""
+
+    def __init__(self, proc: subprocess.Popen):
+        self._proc = proc
+
+    def sendall(self, data: bytes) -> None:
+        self._proc.stdin.write(data)
+        self._proc.stdin.flush()
+
+    def recv(self, n: int) -> bytes:
+        return self._proc.stdout.read1(n)
+
+    def settimeout(self, timeout) -> None:
+        pass  # pipes signal worker death via EOF, not timeouts
+
+    def close(self) -> None:
+        for stream in (self._proc.stdin, self._proc.stdout):
+            try:
+                stream.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def container_engine() -> Optional[str]:
+    """The available container engine binary (podman preferred, like the
+    reference), or None. RAY_TPU_CONTAINER_ENGINE overrides detection."""
+    import shutil
+    forced = os.environ.get("RAY_TPU_CONTAINER_ENGINE")
+    if forced:
+        return forced if shutil.which(forced) else None
+    for engine in ("podman", "docker"):
+        if shutil.which(engine):
+            return engine
+    return None
+
+
 class WorkerHandle:
     """One leased worker subprocess. At most one request in flight (the
     reference's workers are also one-task-at-a-time)."""
@@ -156,8 +197,9 @@ class WorkerHandle:
 
 def _spawn_worker(store_name: Optional[str],
                   env_overrides: Optional[Dict[str, str]] = None,
-                  python_exe: Optional[str] = None) -> WorkerHandle:
-    parent_sock, child_sock = socket.socketpair()
+                  python_exe: Optional[str] = None,
+                  container: Optional[Dict[str, Any]] = None
+                  ) -> WorkerHandle:
     env = dict(os.environ)
     # No TPU backend in workers: the chip is single-process (owned by the
     # spawning driver/daemon), and skipping the accelerator site hook
@@ -167,6 +209,9 @@ def _spawn_worker(store_name: Optional[str],
     env["RAY_TPU_WORKER"] = "1"
     if env_overrides:
         env.update(env_overrides)
+    if container:
+        return _spawn_container_worker(store_name, env, container)
+    parent_sock, child_sock = socket.socketpair()
     cmd = [python_exe or sys.executable, "-m",
            "ray_tpu._private.worker_process",
            "--fd", str(child_sock.fileno())]
@@ -189,6 +234,46 @@ def _spawn_worker(store_name: Optional[str],
                             preexec_fn=_die_with_parent)
     child_sock.close()
     return WorkerHandle(proc, parent_sock)
+
+
+def _spawn_container_worker(store_name: Optional[str],
+                            env: Dict[str, str],
+                            container: Dict[str, Any]) -> WorkerHandle:
+    """Spawn the worker INSIDE a container (reference:
+    _private/runtime_env/container.py): the engine runs the worker image
+    with /dev/shm shared (the object arena crosses the boundary as a
+    named shm mapping) and the framed protocol rides stdio."""
+    engine = container_engine()
+    if engine is None:
+        raise WorkerCrashedError(
+            "runtime_env['container'] needs docker or podman on PATH")
+    image = container.get("image")
+    if not image:
+        raise WorkerCrashedError(
+            "runtime_env['container'] must set 'image'")
+    cmd = [engine, "run", "--rm", "-i", "--network=host",
+           "-v", "/dev/shm:/dev/shm"]
+    for key in ("RAY_TPU_WORKER", "RAY_TPU_HEAD_ADDRESS"):
+        if env.get(key):
+            cmd += ["-e", f"{key}={env[key]}"]
+    cmd += list(container.get("run_options") or [])
+    cmd += [image, container.get("python", "python"), "-m",
+            "ray_tpu._private.worker_process", "--stdio"]
+    if store_name:
+        cmd += ["--store", store_name]
+
+    def _die_with_parent():
+        try:
+            import ctypes
+            ctypes.CDLL("libc.so.6", use_errno=True).prctl(
+                1, signal.SIGKILL, 0, 0, 0)
+        except Exception:  # noqa: BLE001 - non-Linux: best effort
+            pass
+
+    proc = subprocess.Popen(cmd, env=env, stdin=subprocess.PIPE,
+                            stdout=subprocess.PIPE,
+                            preexec_fn=_die_with_parent)
+    return WorkerHandle(proc, _StdioTransport(proc))
 
 
 class WorkerProcessPool:
@@ -229,12 +314,17 @@ class WorkerProcessPool:
         self._spawner = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="ray_tpu-worker-spawn")
 
-    def lease(self, python_exe: Optional[str] = None) -> WorkerHandle:
-        """Lease a worker for the given interpreter (None = base),
-        spawning up to max_workers total; BLOCKS when the pool is
-        saturated until a worker is released (backpressure, not
-        failure — callers already queued behind the scheduler)."""
+    def lease(self, python_exe: Optional[str] = None,
+              container: Optional[Dict[str, Any]] = None) -> WorkerHandle:
+        """Lease a worker for the given interpreter (None = base) or
+        container image, spawning up to max_workers total; BLOCKS when
+        the pool is saturated until a worker is released (backpressure,
+        not failure — callers already queued behind the scheduler).
+        Idle workers are keyed by interpreter AND image: a containerized
+        worker never serves a bare task or another image's."""
         key = python_exe or ""
+        if container:
+            key += f"|container:{container.get('image')}"
         while True:
             evict = None
             with self._lock:
@@ -275,7 +365,7 @@ class WorkerProcessPool:
             w = self._spawner.submit(
                 _spawn_worker, self.store_name,
                 env_overrides=self._env_overrides,
-                python_exe=python_exe).result()
+                python_exe=python_exe, container=container).result()
             w.pool_key = key
             with self._lock:
                 if self._closed:
@@ -592,9 +682,37 @@ def _main() -> None:
         os.environ["JAX_PLATFORMS"] = "cpu"
 
     parser = argparse.ArgumentParser()
-    parser.add_argument("--fd", type=int, required=True)
+    parser.add_argument("--fd", type=int, default=None)
+    parser.add_argument("--stdio", action="store_true",
+                        help="speak the framed protocol over stdio "
+                             "(container transport: fds cannot cross "
+                             "the container boundary)")
     parser.add_argument("--store", default=None)
     args = parser.parse_args()
+    if args.stdio:
+        # Claim the REAL stdout for frames, then point fd 1 at stderr so
+        # user-code prints can never corrupt the protocol stream.
+        real_out = os.fdopen(os.dup(1), "wb", buffering=0)
+        real_in = os.fdopen(os.dup(0), "rb", buffering=0)
+        os.dup2(2, 1)
+
+        class _StdioServer:
+            def recv(self, n):
+                return real_in.read(n) or b""
+
+            def sendall(self, data):
+                real_out.write(data)
+
+            def settimeout(self, timeout):
+                pass
+
+            def close(self):
+                pass
+
+        _WorkerMain(_StdioServer(), args.store).serve()
+        return
+    if args.fd is None:
+        parser.error("one of --fd or --stdio is required")
     sock = socket.socket(fileno=args.fd)
     _WorkerMain(sock, args.store).serve()
 

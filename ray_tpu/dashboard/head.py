@@ -210,6 +210,70 @@ class DashboardHead:
 
     # -- lifecycle -------------------------------------------------------
 
+    async def _profile(self, request):
+        """On-demand CPU profile (reference: dashboard
+        modules/reporter/profile_manager.py:54). Targets: the head
+        process (default), a node daemon (?node_id=, cooperative
+        self-sampling over the control channel), or an arbitrary pid
+        (?pid=, requires py-spy). ?fmt=folded|speedscope, ?duration=s."""
+        import asyncio
+
+        from ray_tpu._private.profiling import (profile_pid_pyspy,
+                                                profile_self,
+                                                pyspy_available)
+        duration = min(float(request.query.get("duration", 5)), 60.0)
+        hz = int(request.query.get("hz", 100))
+        fmt = request.query.get("fmt", "folded")
+        node_id = request.query.get("node_id")
+        pid = request.query.get("pid")
+        try:
+            if pid is not None:
+                import os
+                if int(pid) != os.getpid() and not pyspy_available():
+                    return self._json(
+                        {"error": "profiling a foreign pid needs py-spy "
+                                  "on PATH; use node_id= for daemons "
+                                  "(cooperative sampling) or omit pid "
+                                  "for the head process"}, status=501)
+                if int(pid) == os.getpid():
+                    result = await asyncio.to_thread(
+                        profile_self, duration, hz, fmt)
+                else:
+                    raw = await asyncio.to_thread(
+                        profile_pid_pyspy, int(pid), duration, fmt)
+                    from aiohttp import web
+                    return web.Response(body=raw)
+            elif node_id is not None:
+                from ray_tpu._private.worker import global_worker
+                runtime = global_worker.runtime
+                conn = None
+                for nid, c in runtime._remote_nodes.items():
+                    if nid.hex().startswith(node_id):
+                        conn = c
+                        break
+                if conn is None:
+                    return self._json(
+                        {"error": f"no live node matches {node_id!r}"},
+                        status=404)
+                result = await asyncio.to_thread(
+                    conn.profile, duration, hz, fmt)
+            else:
+                result = await asyncio.to_thread(
+                    profile_self, duration, hz, fmt)
+        except Exception as exc:  # noqa: BLE001 - surface to the caller
+            return self._json({"error": repr(exc)}, status=500)
+        if fmt == "speedscope":
+            return self._json(result)
+        from aiohttp import web
+        return web.Response(text=result)
+
+    async def _grafana(self, request):
+        """Generated Grafana dashboard JSON over this cluster's
+        Prometheus metrics (reference:
+        metrics/grafana_dashboard_factory.py)."""
+        from ray_tpu.dashboard.grafana import generate_dashboard
+        return self._json(generate_dashboard())
+
     def _build_app(self):
         from aiohttp import web
         app = web.Application()
@@ -231,6 +295,8 @@ class DashboardHead:
         app.router.add_get("/api/workflows/", self._workflows_list)
         app.router.add_post("/api/workflows/events/{event_key}",
                             self._workflow_trigger_event)
+        app.router.add_get("/api/profile", self._profile)
+        app.router.add_get("/api/grafana_dashboard", self._grafana)
         return app
 
     def start(self) -> int:

@@ -47,6 +47,12 @@ class MeshConfig:
     sp: int = 1
     ep: int = 1
     pp: int = 1
+    #: TPU pod slices joined over DCN (multi-slice training). The dp axis
+    #: is the one that crosses the slice boundary — gradient psums ride
+    #: DCN once per step while fsdp/tp/sp collectives stay on each
+    #: slice's ICI (the scaling-book layering; SURVEY §2.4 "DCN-aware
+    #: multi-slice meshes"). dp must be a multiple of `slices`.
+    slices: int = 1
 
     def resolve(self, n_devices: int) -> "MeshConfig":
         sizes = {a: getattr(self, a) for a in AXIS_ORDER}
@@ -65,7 +71,12 @@ class MeshConfig:
             raise ValueError(
                 f"Mesh axes {sizes} use {total} devices but {n_devices} "
                 "are available")
-        return MeshConfig(**sizes)
+        if self.slices > 1 and sizes["dp"] % self.slices != 0:
+            raise ValueError(
+                f"dp={sizes['dp']} must be a multiple of slices="
+                f"{self.slices}: the dp axis is the one crossing the "
+                "DCN slice boundary")
+        return MeshConfig(**sizes, slices=self.slices)
 
     @property
     def axis_names(self) -> Tuple[str, ...]:
@@ -94,12 +105,61 @@ def build_mesh(config: Optional[MeshConfig] = None,
         devices = jax.devices()
     config = (config or MeshConfig()).resolve(len(devices))
     shape = config.shape()
+    if config.slices > 1:
+        return _build_multi_slice_mesh(config, list(devices))
     try:
         from jax.experimental import mesh_utils
         dev_array = mesh_utils.create_device_mesh(
             shape, devices=list(devices))
     except Exception:  # noqa: BLE001 - virtual platforms may reject topology
         dev_array = np.array(list(devices)).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def _build_multi_slice_mesh(config: MeshConfig, devices: list):
+    """Hybrid DCN x ICI mesh (the multi-slice analog of the reference's
+    multi-node NCCL world): the OUTER positions of the dp axis enumerate
+    slices, so only dp collectives (gradient psum) cross DCN; every
+    fsdp/tp/sp/ep/pp collective stays inside one slice's ICI. Devices
+    group by their hardware ``slice_index`` when the platform reports it
+    (real multi-slice TPU), falling back to contiguous equal splits
+    (virtual/CPU validation meshes)."""
+    import jax
+    from jax.sharding import Mesh
+
+    n_slices = config.slices
+    if len(devices) % n_slices != 0:
+        raise ValueError(
+            f"{len(devices)} devices not divisible into {n_slices} slices")
+    per_slice = len(devices) // n_slices
+    by_slice: dict = {}
+    if all(getattr(d, "slice_index", None) is not None for d in devices):
+        for d in devices:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        if len(by_slice) != n_slices or any(
+                len(v) != per_slice for v in by_slice.values()):
+            raise ValueError(
+                f"hardware reports {len(by_slice)} slices with sizes "
+                f"{[len(v) for v in by_slice.values()]}, config wants "
+                f"{n_slices} x {per_slice}")
+        groups = [by_slice[k] for k in sorted(by_slice)]
+    else:
+        groups = [devices[i * per_slice:(i + 1) * per_slice]
+                  for i in range(n_slices)]
+    # Arrange each slice's devices over (dp_in, fsdp, tp, sp, ep, pp),
+    # then stack slices as the OUTER dp positions.
+    dp_in = config.dp // n_slices
+    inner_shape = (dp_in, config.fsdp, config.tp, config.sp,
+                   config.ep, config.pp)
+    slabs = []
+    for group in groups:
+        try:
+            from jax.experimental import mesh_utils
+            slabs.append(mesh_utils.create_device_mesh(
+                inner_shape, devices=group))
+        except Exception:  # noqa: BLE001 - virtual platforms
+            slabs.append(np.array(group).reshape(inner_shape))
+    dev_array = np.stack(slabs, axis=0).reshape(config.shape())
     return Mesh(dev_array, AXIS_ORDER)
 
 

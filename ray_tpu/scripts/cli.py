@@ -121,6 +121,45 @@ def cmd_job(args) -> int:
     return 1
 
 
+def cmd_profile(args) -> int:
+    """On-demand CPU profile of this driver process or a node daemon
+    (reference: py-spy-backed dashboard profiling); writes a speedscope
+    JSON (open at speedscope.app) or collapsed flamegraph stacks."""
+    _ensure_init()
+    import json as _json
+
+    from ray_tpu._private.profiling import profile_self
+    fmt = "speedscope" if args.output.endswith(".json") else "folded"
+    if args.node:
+        from ray_tpu._private.worker import global_worker
+        runtime = global_worker.runtime
+        conn = None
+        for nid, c in runtime._remote_nodes.items():
+            if nid.hex().startswith(args.node):
+                conn = c
+                break
+        if conn is None:
+            print(f"no live node matches {args.node!r}")
+            return 1
+        result = conn.profile(args.duration, args.hz, fmt)
+    else:
+        result = profile_self(args.duration, args.hz, fmt)
+    with open(args.output, "w") as f:
+        if fmt == "speedscope":
+            _json.dump(result, f)
+        else:
+            f.write(result)
+    print(f"Wrote {fmt} profile to {args.output}")
+    return 0
+
+
+def cmd_grafana(args) -> int:
+    from ray_tpu.dashboard.grafana import write_dashboards
+    for path in write_dashboards(args.out):
+        print(f"Wrote {path}")
+    return 0
+
+
 def cmd_microbenchmark(args) -> int:
     """`ray-tpu microbenchmark` — the core ops/s suite (reference:
     release/microbenchmark/run_microbenchmark.py)."""
@@ -258,6 +297,20 @@ def main(argv=None) -> int:
         pj.add_argument("job_id")
     jsub.add_parser("list")
 
+    p = sub.add_parser("profile", help="sample CPU stacks on demand "
+                                       "(driver or --node <id>)")
+    p.add_argument("--node", default=None,
+                   help="node id prefix to profile (default: this "
+                        "process)")
+    p.add_argument("--duration", type=float, default=5.0)
+    p.add_argument("--hz", type=int, default=100)
+    p.add_argument("--output", default="profile.speedscope.json",
+                   help=".json -> speedscope, anything else -> "
+                        "collapsed stacks")
+    p = sub.add_parser("grafana-dashboards",
+                       help="generate Grafana dashboard JSON for the "
+                            "cluster's Prometheus metrics")
+    p.add_argument("--out", default="grafana_dashboards")
     p = sub.add_parser("microbenchmark",
                        help="core ops/s suite (tasks, actors, put/get)")
     p.add_argument("--duration", type=float, default=2.0)
@@ -314,6 +367,8 @@ def main(argv=None) -> int:
         "up": cmd_up,
         "down": cmd_down,
         "microbenchmark": cmd_microbenchmark,
+        "profile": cmd_profile,
+        "grafana-dashboards": cmd_grafana,
     }[args.command]
     return handler(args)
 

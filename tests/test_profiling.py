@@ -1,0 +1,101 @@
+"""On-demand profiling + Grafana factory (reference:
+dashboard/modules/reporter/profile_manager.py:54,
+dashboard/modules/metrics/grafana_dashboard_factory.py)."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_self_sampler_folded_and_speedscope():
+    from ray_tpu._private.profiling import (folded_to_speedscope,
+                                            profile_self, sample_self)
+
+    stop = threading.Event()
+
+    def busy():
+        while not stop.is_set():
+            sum(i * i for i in range(200))
+
+    t = threading.Thread(target=busy, daemon=True, name="busy-loop")
+    t.start()
+    try:
+        counts = sample_self(duration_s=0.5, hz=200)
+        assert counts, "no samples collected"
+        assert any("busy-loop" in k and "busy" in k for k in counts), \
+            list(counts)[:3]
+        doc = folded_to_speedscope(counts)
+        assert doc["profiles"][0]["samples"]
+        assert len(doc["shared"]["frames"]) >= 2
+        json.dumps(doc)  # serializable
+        folded = profile_self(0.2, 100, "folded")
+        assert isinstance(folded, str) and ";" in folded
+    finally:
+        stop.set()
+
+
+def test_daemon_cooperative_profile(ray_start_regular):
+    """ray-tpu profile --node: the daemon samples ITS OWN stacks over
+    the control channel (no ptrace, no py-spy)."""
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.multinode",
+         "--address", f"127.0.0.1:{port}", "--num-cpus", "2",
+         "--resources", json.dumps({"prof": 1})],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("prof", 0) >= 1:
+                break
+            time.sleep(0.1)
+
+        @ray_tpu.remote(resources={"prof": 1},
+                        runtime_env={"worker_process": False})
+        def spin():
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 3.0:
+                sum(i for i in range(500))
+            return "done"
+
+        ref = spin.remote()
+        time.sleep(0.3)
+        from ray_tpu._private.worker import global_worker
+        rt = global_worker.runtime
+        conn = next(iter(rt._remote_nodes.values()))
+        folded = conn.profile(duration=1.0, hz=100, fmt="folded")
+        assert isinstance(folded, str) and folded
+        assert "spin" in folded, folded[:500]
+        doc = conn.profile(duration=0.3, hz=50, fmt="speedscope")
+        assert doc["profiles"][0]["samples"]
+        assert ray_tpu.get(ref, timeout=30) == "done"
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def test_grafana_dashboard_factory(tmp_path):
+    from ray_tpu.dashboard.grafana import (generate_dashboard,
+                                           write_dashboards)
+    from ray_tpu.util.metrics import Counter
+
+    Counter("grafana_test_metric", "custom metric for the factory test")
+    doc = generate_dashboard()
+    assert doc["panels"], "no panels generated"
+    titles = [p["title"] for p in doc["panels"]]
+    assert "Tasks finished / s" in titles
+    exprs = [t["expr"] for p in doc["panels"] for t in p["targets"]]
+    assert any("grafana_test_metric" in e for e in exprs), \
+        "live registry metric not auto-panelled"
+    for panel in doc["panels"]:
+        assert panel["targets"][0]["expr"]
+        assert panel["gridPos"]["w"] > 0
+    paths = write_dashboards(str(tmp_path))
+    loaded = json.loads(open(paths[0]).read())
+    assert loaded["uid"] == "ray-tpu-core"
