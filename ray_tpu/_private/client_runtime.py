@@ -391,23 +391,21 @@ class ClientRuntime:
             {"op": "submit_actor_task", "spec": _dumps(spec)})
         return self._refs_from_hex(reply["refs"])
 
-    def create_actor(self, spec, *, max_restarts: int, max_concurrency: int,
-                     name: str = "", namespace: str = "default",
-                     get_if_exists: bool = False) -> ActorID:
+    def create_actor(self, spec, **options) -> ActorID:
+        # Forward ALL options verbatim: the server applies them with
+        # Runtime.create_actor(spec, **opts), so a kwarg added to the head
+        # runtime (e.g. concurrency_groups) works from client contexts
+        # without this class naming it — the two signatures cannot drift
+        # and head-side defaults stay authoritative.
         reply = self._conn.request({
-            "op": "create_actor",
-            "spec": _dumps(spec),
-            "opts": {"max_restarts": max_restarts,
-                     "max_concurrency": max_concurrency,
-                     "name": name, "namespace": namespace,
-                     "get_if_exists": get_if_exists},
-        })
+            "op": "create_actor", "spec": _dumps(spec), "opts": options})
         actor_id = ActorID(bytes.fromhex(reply["actor_id"]))
         with self._actor_info_lock:
             self._actor_info[actor_id] = {
                 "exists": True, "fn_id": spec.function_id,
-                "name": name, "namespace": namespace, "dead": False,
-                "num_restarts": 0,
+                "name": options.get("name", ""),
+                "namespace": options.get("namespace", "default"),
+                "dead": False, "num_restarts": 0,
             }
         return actor_id
 
@@ -652,12 +650,7 @@ class ClientSession:
             # from the actor id (TaskID.for_actor_creation — 8 random
             # actor bytes, zero unique part), a shape head-minted normal/
             # actor task ids can never take.
-            opts = msg["opts"]
-            actor_id = rt.create_actor(
-                spec, max_restarts=opts["max_restarts"],
-                max_concurrency=opts["max_concurrency"],
-                name=opts["name"], namespace=opts["namespace"],
-                get_if_exists=opts["get_if_exists"])
+            actor_id = rt.create_actor(spec, **msg["opts"])
             return {"actor_id": actor_id.hex()}
         if op == "actor_info":
             state = rt.actor_state(ActorID(bytes.fromhex(msg["actor_id"])))

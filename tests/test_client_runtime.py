@@ -241,6 +241,97 @@ def test_nested_wait_and_cluster_introspection(head_with_daemons):
     assert remote_total == 4  # the daemon sees the WHOLE cluster
 
 
+def test_client_context_option_matrix(head_with_daemons):
+    """Composition matrix: every Runtime.create_actor / submit option
+    must work identically from client (daemon-executed) contexts.
+    ClientRuntime forwards **options verbatim so a kwarg added to the
+    head runtime cannot silently break nested code again (the round-3
+    concurrency_groups drift; reference: core_worker.cc:1827 CreateActor
+    carries the full options struct over RPC)."""
+    @ray_tpu.remote(resources={"remote": 1})
+    def matrix():
+        import ray_tpu as rt
+        results = {}
+
+        # -- concurrency groups (the round-3 break) ---------------------
+        @rt.remote(concurrency_groups={"io": 2, "compute": 1})
+        class Grouped:
+            def io_fetch(self):
+                return "io"
+
+            def work(self):
+                return "compute"
+
+        g = Grouped.remote()
+        results["concurrency_groups"] = rt.get([
+            g.io_fetch.options(concurrency_group="io").remote(),
+            g.work.remote()])
+
+        # -- max_restarts: kill the actor, it must come back ------------
+        @rt.remote(max_restarts=1)
+        class Phoenix:
+            def __init__(self):
+                import uuid
+                # Stable per-incarnation token: observing ANY new value
+                # proves a restart, robust to missed/late observations.
+                self.token = uuid.uuid4().hex
+
+            def get_token(self):
+                return self.token
+
+        p = Phoenix.remote()
+        before = rt.get(p.get_token.remote(), timeout=20)
+        rt.kill(p, no_restart=False)
+        import time as t
+        end = t.monotonic() + 30
+        revived = False
+        while t.monotonic() < end:
+            try:
+                if rt.get(p.get_token.remote(), timeout=5) != before:
+                    revived = True
+                    break
+            except Exception:
+                pass
+            t.sleep(0.2)
+        results["max_restarts"] = revived
+
+        # -- dynamic num_returns ---------------------------------------
+        @rt.remote(num_returns="dynamic")
+        def gen(n):
+            for i in range(n):
+                yield i * i
+
+        dyn = rt.get(gen.remote(3))
+        results["dynamic_num_returns"] = [rt.get(r) for r in dyn]
+
+        # -- runtime_env env_vars --------------------------------------
+        @rt.remote(runtime_env={"env_vars": {"MATRIX_PROBE": "yes"}})
+        def read_env():
+            import os
+            return os.environ.get("MATRIX_PROBE")
+
+        results["runtime_env"] = rt.get(read_env.remote())
+
+        # -- named + get_if_exists from client context ------------------
+        @rt.remote
+        class Named:
+            def ping(self):
+                return "pong"
+
+        a = Named.options(name="matrix-named", get_if_exists=True).remote()
+        b = Named.options(name="matrix-named", get_if_exists=True).remote()
+        results["named_get_if_exists"] = (
+            a._actor_id == b._actor_id and rt.get(a.ping.remote()))
+        return results
+
+    out = ray_tpu.get(matrix.remote(), timeout=120)
+    assert out["concurrency_groups"] == ["io", "compute"]
+    assert out["max_restarts"] is True
+    assert out["dynamic_num_returns"] == [0, 1, 4]
+    assert out["runtime_env"] == "yes"
+    assert out["named_get_if_exists"] == "pong"
+
+
 def test_nested_work_is_resource_accounted(head_with_daemons):
     """Nested submissions consume head-accounted resources: while a
     daemon-spawned child runs, the DRIVER sees the cluster's available
