@@ -119,8 +119,9 @@ def bench_core_ops() -> dict:
         rt = getattr(global_worker, "_runtime", None)
         if rt is not None and hasattr(rt, "lease_stats"):
             out["lease_stats"] = dict(rt.lease_stats)
-    except Exception:  # noqa: BLE001 - extras must not sink the headline
+    except Exception as exc:  # noqa: BLE001 - must not sink the headline
         out.setdefault("remote_tasks_per_sec", None)
+        out["remote_tasks_error"] = repr(exc)[:800]
     finally:
         for p in procs:
             try:
@@ -572,8 +573,9 @@ def main():
             extra["gpt410m_tokens_per_sec"] = round(
                 m410["tokens_per_sec"], 1)
             extra["gpt410m_mfu"] = round(m410["mfu"], 4)
-        except Exception:  # noqa: BLE001 - extras never sink the headline
+        except Exception as exc:  # noqa: BLE001 - never sink the headline
             extra.setdefault("gpt410m_mfu", None)
+            extra["gpt410m_error"] = repr(exc)[:800]
     else:
         head = _bench_gpt("gpt-tiny", batch=4, seq=128, steps=5,
                           warmup=1, overrides={},
@@ -581,35 +583,28 @@ def main():
         preset = "gpt-tiny"
     tokens_per_sec, mfu = head["tokens_per_sec"], head["mfu"]
 
-    try:
-        extra.update(bench_core_ops())
-    except Exception:  # noqa: BLE001 - extras must not sink the headline
-        pass
-    try:
-        extra.update(bench_rllib())
-    except Exception:  # noqa: BLE001 - extras must not sink the headline
-        extra.setdefault("rllib_env_steps_per_sec", None)
-    try:
-        extra.update(bench_rllib_daemons())
-    except Exception:  # noqa: BLE001 - extras must not sink the headline
-        extra.setdefault("rllib_daemon_env_steps_per_sec", None)
-    try:
-        extra.update(bench_data_shuffle())
-    except Exception:  # noqa: BLE001 - extras must not sink the headline
-        extra.setdefault("shuffle_mb_per_sec", None)
-    try:
-        extra.update(bench_serve())
-    except Exception:  # noqa: BLE001 - extras must not sink the headline
-        extra.setdefault("serve_qps", None)
-    try:
-        extra.update(bench_shuffle_multi_daemon())
-    except Exception:  # noqa: BLE001 - extras must not sink the headline
-        extra.setdefault("shuffle_multi_mb_per_sec", None)
+    # Extras must not sink the headline, but a failure is RECORDED, never
+    # silently nulled (reference: release/ray_release/result.py — release
+    # test results always carry their failure cause).
+    extras_suite = [
+        ("core_ops", "tasks_per_sec", bench_core_ops),
+        ("rllib", "rllib_env_steps_per_sec", bench_rllib),
+        ("rllib_daemon", "rllib_daemon_env_steps_per_sec",
+         bench_rllib_daemons),
+        ("shuffle", "shuffle_mb_per_sec", bench_data_shuffle),
+        ("serve", "serve_qps", bench_serve),
+        ("shuffle_multi", "shuffle_multi_mb_per_sec",
+         bench_shuffle_multi_daemon),
+    ]
     if on_tpu:
+        extras_suite.append(
+            ("diffusion", "diffusion_images_per_sec", bench_diffusion))
+    for key, metric, fn in extras_suite:
         try:
-            extra.update(bench_diffusion())
-        except Exception:  # noqa: BLE001 - extras never sink the headline
-            extra.setdefault("diffusion_images_per_sec", None)
+            extra.update(fn())
+        except Exception as exc:  # noqa: BLE001
+            extra.setdefault(metric, None)
+            extra[f"{key}_error"] = repr(exc)[:800]
 
     result = {
         "metric": f"{preset}_train_tokens_per_sec_per_chip",

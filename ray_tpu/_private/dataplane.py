@@ -27,7 +27,9 @@ head really is out of the data path.
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import logging
+import os
 import socket
 import struct
 import threading
@@ -64,19 +66,44 @@ class ObjectMarker:
 
 class NodeObjectTable:
     """Local object storage for one node: shm arena preferred (so sibling
-    worker processes map payloads zero-copy), heap dict fallback."""
+    worker processes map payloads zero-copy), heap dict fallback.
 
-    def __init__(self, capacity: int = 0, arena_name: Optional[str] = None):
+    With ``spill_dir`` set (and an arena), the table NEVER loses data to
+    memory pressure: arena auto-eviction is disabled, and when a put/pull
+    doesn't fit, cold (sealed, unpinned) objects are spilled to disk in
+    LRU order and restored transparently on the next read (reference:
+    raylet-orchestrated spill/restore, src/ray/raylet/
+    local_object_manager.h + object_manager/spilled_object_reader.h).
+    Losing an object then requires node death, not a busy shuffle."""
+
+    def __init__(self, capacity: int = 0, arena_name: Optional[str] = None,
+                 spill_dir: Optional[str] = None):
         self._heap: Dict[str, bytes] = {}
         self._lock = threading.Lock()
         self._arena = None
         self.admission = None  # Optional[PullAdmission]
         self.stats = {"pulled_bytes": 0, "served_bytes": 0,
-                      "pulls": 0, "serves": 0}
-        # Best-effort usage accounting for the resource syncer (the
-        # arena additionally evicts under pressure, so this is an upper
-        # bound there — the syncer's view is advisory, not a ledger).
+                      "pulls": 0, "serves": 0,
+                      "spilled_bytes": 0, "spilled_objects": 0,
+                      "restored_bytes": 0, "restores": 0}
+        # Best-effort usage accounting for the resource syncer (with
+        # spill enabled the arena never drops entries on its own, so
+        # this is exact there; the syncer's view is advisory anyway).
         self._sizes: Dict[str, int] = {}
+        #: key -> (disk path, payload size) for spilled objects. Entries
+        #: are registered BEFORE the arena copy is deleted, so a reader
+        #: always finds the object in at least one of the two places.
+        #: Guarded by self._lock (NEVER held across disk I/O — spilled-
+        #: object reads must not stall behind a bulk spill batch).
+        self._spilled: Dict[str, Tuple[str, int]] = {}
+        #: Freed-while-pinned keys (guarded by self._lock): with arena
+        #: auto-eviction disabled, a pinned entry survives free(); the
+        #: next spill pass must DELETE it, never spill-resurrect it.
+        self._doomed: set = set()
+        # Serializes victim selection across concurrent _make_room
+        # callers (one spill batch at a time); dict reads never take it.
+        self._spill_lock = threading.Lock()
+        self._spill_dir: Optional[str] = None
         if capacity > 0:
             try:
                 from ray_tpu._private.native_store import NativeObjectStore
@@ -84,6 +111,193 @@ class NodeObjectTable:
                                                 name=arena_name)
             except Exception:  # noqa: BLE001 - no compiler → heap fallback
                 self._arena = None
+        if self._arena is not None and spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+            self._spill_dir = spill_dir
+            self._arena.set_evict_disabled(True)
+
+    # -- disk spill / restore -------------------------------------------
+
+    def _spill_path(self, key: str) -> str:
+        return os.path.join(self._spill_dir,
+                            hashlib.sha1(key.encode()).hexdigest())
+
+    def _spill_one(self, key: str) -> int:
+        """Copy one sealed arena object to disk and drop the arena copy.
+        Returns bytes freed (0 if the object vanished or is pinned)."""
+        with self._lock:
+            doomed = key in self._doomed
+        if doomed:
+            # free() ran while a reader pinned this entry: reclaim, never
+            # spill — a resurrected freed object would leak on disk until
+            # daemon shutdown (nobody will ever free it again). free()
+            # already popped _sizes, so measure via a transient pin.
+            view = self._arena.get_bytes(key)
+            size = 0
+            if view is not None:
+                size = len(view)
+                try:
+                    view.release()
+                except BufferError:
+                    pass
+                self._arena.release(key)
+            if self._arena.delete(key):
+                with self._lock:
+                    self._doomed.discard(key)
+                return size
+            return 0  # still pinned; a later pass retries
+        view = self._arena.get_bytes(key)
+        if view is None:
+            return 0
+        size = len(view)
+        path = self._spill_path(key)
+        try:
+            with open(path + ".tmp", "wb") as f:
+                f.write(view)
+            os.replace(path + ".tmp", path)
+        except OSError:
+            logger.exception("spill of %s failed; keeping in-arena copy",
+                             key)
+            with contextlib.suppress(OSError):
+                os.unlink(path + ".tmp")
+            return 0
+        finally:
+            try:
+                view.release()
+            except BufferError:
+                pass
+            self._arena.release(key)
+        return self._register_spill(key, path, size, drop_arena=True)
+
+    def _register_spill(self, key: str, path: str, size: int,
+                        drop_arena: bool) -> int:
+        """Commit a written spill file: register it, drop the arena copy
+        (when one exists), and honor a free() that raced the disk write
+        — our read pin made free's arena delete fail and set _doomed, so
+        without the re-check the freed key would resurrect as a spill
+        record nobody ever frees. Returns bytes freed from the arena.
+
+        EVERY path re-checks liveness via _sizes (free() pops it): a
+        free() that fully completed during the disk write — including
+        one whose arena delete SUCCEEDED in the window between
+        _spill_one's pin release and this registration, leaving no
+        doomed marker — means the file must be discarded, never
+        registered."""
+        with self._lock:
+            live = key in self._sizes
+            if live:
+                self._spilled[key] = (path, size)
+        if not live:
+            with contextlib.suppress(OSError):
+                os.unlink(path)
+            return 0
+        deleted = self._arena.delete(key) if drop_arena else True
+        with self._lock:
+            doomed_now = key in self._doomed
+            if doomed_now:
+                self._spilled.pop(key, None)
+                if deleted:
+                    # Fully reclaimed. A FAILED delete keeps the
+                    # tombstone: the arena copy survives (reader pin)
+                    # and a later spill pass must still delete, not
+                    # spill, it.
+                    self._doomed.discard(key)
+        if doomed_now:
+            with contextlib.suppress(OSError):
+                os.unlink(path)
+            return size if deleted else 0
+        if not deleted:
+            # Pinned by a concurrent reader: both copies stay (harmless —
+            # the arena copy wins on read until pressure retries us).
+            return 0
+        self._bump("spilled_bytes", size)
+        self._bump("spilled_objects")
+        return size
+
+    def _make_room(self, nbytes: int) -> bool:
+        """Spill LRU victims until ~nbytes are freed (or nothing left to
+        spill). Returns True if any bytes were freed."""
+        if self._spill_dir is None:
+            return False
+        freed_any = False
+        with self._spill_lock:
+            remaining = max(nbytes, 1)
+            while remaining > 0:
+                victims = self._arena.lru_victims()
+                progress = False
+                for key in victims:
+                    freed = self._spill_one(key)
+                    if freed:
+                        progress = True
+                        freed_any = True
+                        remaining -= freed
+                        if remaining <= 0:
+                            break
+                if not progress:
+                    break
+        return freed_any
+
+    def _spill_payload(self, key: str, payload: bytes) -> bool:
+        """Write a payload that cannot fit the arena straight to disk.
+        False when the spill filesystem itself fails (caller falls back
+        to the heap — degraded, but the object is never lost)."""
+        path = self._spill_path(key)
+        try:
+            with open(path + ".tmp", "wb") as f:
+                f.write(payload)
+            os.replace(path + ".tmp", path)
+        except OSError:
+            logger.exception("direct spill of %s failed", key)
+            with contextlib.suppress(OSError):
+                os.unlink(path + ".tmp")
+            return False
+        self._register_spill(key, path, len(payload), drop_arena=False)
+        return True
+
+    def _read_spilled(self, key: str) -> Optional[bytes]:
+        """Read a spilled payload back and try to promote it into the
+        arena (so repeat reads are zero-copy again)."""
+        with self._lock:
+            rec = self._spilled.get(key)
+        if rec is None:
+            return None
+        path, size = rec
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            # Lost a promote race (winner popped the record and unlinked
+            # the file) or freed for real — the CALLER re-checks the
+            # arena before concluding the object is gone.
+            return None
+        self._bump("restored_bytes", size)
+        self._bump("restores")
+        promoted = self._arena.put_bytes(key, data) or \
+            (self._make_room(size) and self._arena.put_bytes(key, data))
+        if promoted:
+            # Cleanup must serialize against _spill_one (which runs
+            # wholly under _spill_lock): a pressure pass may have
+            # ALREADY re-spilled our promoted copy — popping ITS fresh
+            # registration and unlinking the file here, after it
+            # deleted the arena copy, would lose the object entirely.
+            # If the arena no longer holds the key, the spiller's
+            # registration is authoritative: keep it.
+            with self._spill_lock:
+                if self._arena.contains(key):
+                    with self._lock:
+                        self._spilled.pop(key, None)
+                        # free() may have raced the promote (it popped
+                        # _sizes/_spilled and unlinked the file while we
+                        # held the payload): with eviction disabled the
+                        # promoted copy would otherwise live forever.
+                        # The caller still gets the bytes — the read
+                        # legitimately raced the free.
+                        freed_meanwhile = key not in self._sizes
+                    if freed_meanwhile:
+                        self._arena.delete(key)
+                    with contextlib.suppress(OSError):
+                        os.unlink(path)
+        return data
 
     @property
     def arena_name(self) -> Optional[str]:
@@ -92,8 +306,21 @@ class NodeObjectTable:
     def put(self, key: str, payload: bytes) -> None:
         with self._lock:
             self._sizes[key] = len(payload)
-        if self._arena is not None and self._arena.put_bytes(key, payload):
-            return
+            self._doomed.discard(key)  # re-put revives a freed key
+        if self._arena is not None:
+            if self._arena.put_bytes(key, payload):
+                return
+            if self._spill_dir is not None:
+                # Arena full: spill cold objects and retry, falling back
+                # to writing THIS payload to disk when it simply cannot
+                # fit (bigger than the arena / everything else pinned).
+                if self._make_room(len(payload)) and \
+                        self._arena.put_bytes(key, payload):
+                    return
+                if self._spill_payload(key, payload):
+                    return
+                # Spill filesystem failed too (disk full): heap below —
+                # the last resort that can never lose the object.
         with self._lock:
             self._heap[key] = bytes(payload)
 
@@ -105,17 +332,35 @@ class NodeObjectTable:
         region mid-read (plasma semantics: client Get holds a buffer ref);
         the view MUST NOT escape the block."""
         if self._arena is not None:
-            view = self._arena.get_bytes(key)  # takes an arena ref
-            if view is not None:
-                try:
-                    yield view
-                finally:
+            # Retry while the object still EXISTS somewhere: under churn
+            # it ping-pongs between arena and disk (a promote winner pops
+            # the record+file while pressure re-spills it), so a fixed
+            # number of passes can miss a live object mid-transition.
+            # Terminates: absent from both places = truly gone. Capped
+            # defensively; one pass does real I/O, so spinning is
+            # bounded by actual transitions.
+            for _attempt in range(64):
+                view = self._arena.get_bytes(key)  # takes an arena ref
+                if view is not None:
                     try:
-                        view.release()
-                    except BufferError:
-                        pass  # transient exports; GC drops them shortly
-                    self._arena.release(key)
-                return
+                        yield view
+                    finally:
+                        try:
+                            view.release()
+                        except BufferError:
+                            pass  # transient exports; GC drops soon
+                        self._arena.release(key)
+                    return
+                if self._spill_dir is None:
+                    break
+                data = self._read_spilled(key)
+                if data is not None:
+                    yield data
+                    return
+                with self._lock:
+                    spilled_present = key in self._spilled
+                if not spilled_present and not self._arena.contains(key):
+                    break  # gone from both: freed (or never here)
         with self._lock:
             payload = self._heap.get(key)
         yield payload
@@ -124,17 +369,37 @@ class NodeObjectTable:
         if self._arena is not None and self._arena.contains(key):
             return True
         with self._lock:
+            if key in self._spilled:
+                return True
             return key in self._heap
 
     def free(self, key: str) -> None:
+        dead_pin = False
         if self._arena is not None:
             # Read pins are balanced by pinned(); delete fails (-2) only
-            # while a concurrent read holds the entry — it then parks in
-            # the LRU when released and pressure evicts it.
-            self._arena.delete(key)
+            # while a concurrent read holds the entry. With eviction
+            # disabled (spill mode) nothing would ever reclaim it, so
+            # mark it doomed: the next spill pass deletes instead of
+            # spilling (a freed object must never be resurrected to
+            # disk with no remaining freer).
+            dead_pin = not self._arena.delete(key) and \
+                self._spill_dir is not None and \
+                self._arena.contains(key)
+        # ONE lock block: _register_spill's liveness check (_sizes) and
+        # record registration must see free's mutations atomically — a
+        # pop of _spilled before _sizes in separate blocks let an
+        # in-flight spill re-register the freed key between them.
         with self._lock:
-            self._heap.pop(key, None)
+            if dead_pin:
+                self._doomed.add(key)
             self._sizes.pop(key, None)
+            rec = self._spilled.pop(key, None)
+            self._heap.pop(key, None)
+        if rec is not None:
+            try:
+                os.unlink(rec[0])
+            except OSError:
+                pass
 
     def usage(self) -> Dict[str, int]:
         with self._lock:
@@ -149,8 +414,38 @@ class NodeObjectTable:
     def recv_into(self, key: str, size: int, sock: socket.socket) -> None:
         """Stream ``size`` bytes from ``sock`` into the table — straight
         into the shm arena when possible (no full-size heap staging)."""
+        with self._lock:
+            # Re-receiving a key freed-while-pinned revives it (same as
+            # put): a stale doomed marker would make the next spill pass
+            # DELETE the live payload instead of spilling it.
+            self._doomed.discard(key)
         if self._arena is not None:
             off = self._arena.create(key, size)
+            if off is None and self._spill_dir is not None and \
+                    self._make_room(size):
+                off = self._arena.create(key, size)
+            if off is None and self._spill_dir is not None:
+                # Won't fit even after spilling: stream to disk directly.
+                path = self._spill_path(key)
+                try:
+                    with open(path + ".tmp", "wb") as f:
+                        read = 0
+                        while read < size:
+                            chunk = sock.recv(min(CHUNK_SIZE, size - read))
+                            if not chunk:
+                                raise ConnectionError(
+                                    "peer closed mid-transfer")
+                            f.write(chunk)
+                            read += len(chunk)
+                except BaseException:
+                    with contextlib.suppress(OSError):
+                        os.unlink(path + ".tmp")
+                    raise
+                os.replace(path + ".tmp", path)
+                with self._lock:
+                    self._sizes[key] = size
+                self._register_spill(key, path, size, drop_arena=False)
+                return
             if off is not None:
                 written = 0
                 try:
@@ -189,6 +484,14 @@ class NodeObjectTable:
             except Exception:  # noqa: BLE001
                 pass
             self._arena = None
+        with self._lock:
+            spilled = list(self._spilled.values())
+            self._spilled.clear()
+        for path, _size in spilled:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
         self._heap.clear()
 
 

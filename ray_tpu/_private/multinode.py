@@ -32,6 +32,7 @@ elsewhere through the normal node-death path.
 from __future__ import annotations
 
 import logging
+import os
 import socket
 import struct
 import threading
@@ -340,6 +341,8 @@ class NodeConnection:
 
     def _unpack(self, reply: dict, name: str) -> Any:
         if reply["ok"]:
+            if "mismatch_desc" in reply:
+                return MismatchedReturn(reply["mismatch_desc"])
             if "stored_key" in reply:
                 return RemoteValueStub(self, reply["stored_key"],
                                        reply["size"])
@@ -464,6 +467,16 @@ class NodeConnection:
         deadlock behind its own blocked parent."""
         self._fire_and_forget({"type": "spill_lease", "lease_id": lease_id})
 
+    def unspill_lease(self, lease_id: str) -> None:
+        """The blocked get returned (or the blocked task finalized): the
+        daemon resumes SERIAL execution for this lease. Frame ordering
+        makes this race-free — tasks the head attaches after clearing
+        ``blocked`` travel behind this frame, so only the tasks that
+        raced the spill window bypass the queue (sanctioned: the lease's
+        capacity was lent out for exactly that window)."""
+        self._fire_and_forget({"type": "unspill_lease",
+                               "lease_id": lease_id})
+
     def create_actor(self, spec, functions, args, kwargs) -> None:
         reply = self._request({
             "type": "create_actor",
@@ -509,6 +522,25 @@ class NodeConnection:
             {"type": "profile", "duration": duration, "hz": hz,
              "fmt": fmt}, timeout=duration + 30)
         return _loads(reply["value"])
+
+
+def describe_value(value) -> str:
+    """'<type> of length <n>' for num_returns-mismatch errors — one
+    wording shared by the daemon and head reporters."""
+    return (f"{type(value).__name__} of length "
+            f"{len(value) if hasattr(value, '__len__') else 'n/a'}")
+
+
+class MismatchedReturn:
+    """Marker for a num_returns>1 task whose oversized result had the
+    wrong shape: the daemon describes the value instead of storing a
+    stub nobody could ever consume (and that would leak in its table)
+    or shipping gigabytes to the head just to format an error."""
+
+    __slots__ = ("desc",)
+
+    def __init__(self, desc: str):
+        self.desc = desc
 
 
 class RemoteValueStub:
@@ -584,6 +616,9 @@ class HeadServer:
         # miss threshold convert that into node death too.
         self._hb_period = float(
             runtime.config.health_check_period_ms) / 1000.0
+        self._hb_timeout = float(
+            getattr(runtime.config, "health_check_timeout_ms",
+                    10 * runtime.config.health_check_period_ms)) / 1000.0
         self._hb_threshold = int(
             runtime.config.health_check_failure_threshold)
         self._hb_thread = threading.Thread(
@@ -643,7 +678,7 @@ class HeadServer:
                     # Tiny frames on the dedicated socket: bounded by the
                     # socket timeout, never queued behind data transfers
                     # and never contending for the data send lock.
-                    hc.settimeout(self._hb_period * 2)
+                    hc.settimeout(self._hb_timeout)
                     ping: dict = {"type": "ping"}
                     if digest["version"] > digest_sent.get(node_id, -1):
                         ping["cluster_digest"] = digest
@@ -854,10 +889,14 @@ class _LeaseExecutor:
         self.worker_handle = None  # pinned worker subprocess (if any)
         self.worker_python = None
         self.tasks_run = 0
-        # Sticky once set: a spilled lease had a task block in a nested
-        # get — tasks raced onto the wire before the head stopped
-        # attaching must also bypass the serial queue, or one could land
-        # behind the blocked parent it is a dependency of.
+        # Set while the lease's running task is blocked in a nested get:
+        # tasks that raced onto the wire before the head stopped
+        # attaching must bypass the serial queue, or one could land
+        # behind the blocked parent it is a dependency of. CLEARED by the
+        # head's unspill_lease when the get returns — without that, every
+        # later task would run on its own thread against ONE accounted
+        # acquisition for the lease's remaining life (unbounded node
+        # over-subscription).
         self.spilled = False
         self._thread = threading.Thread(
             target=self._run, name=f"ray_tpu-lease-{lease_id}", daemon=True)
@@ -889,6 +928,10 @@ class _LeaseExecutor:
             threading.Thread(target=self._daemon._handle_counted,
                              args=(sock, msg), daemon=True).start()
 
+    def unspill(self) -> None:
+        """Resume serial execution (the head cleared lease.blocked)."""
+        self.spilled = False
+
     def _run(self) -> None:
         while True:
             item = self._q.get()
@@ -907,6 +950,26 @@ class _LeaseExecutor:
                 pass
 
 
+def _reap_stale_spill_dirs(parent: str) -> None:
+    """Remove ray_tpu_spill_<pid> dirs whose owning process is dead
+    (reference: the raylet reclaims its spill directory on restart)."""
+    import shutil
+    try:
+        entries = os.listdir(parent)
+    except OSError:
+        return
+    for fname in entries:
+        if not fname.startswith("ray_tpu_spill_"):
+            continue
+        try:
+            pid = int(fname.rsplit("_", 1)[1])
+        except ValueError:
+            continue
+        if pid == os.getpid() or os.path.exists(f"/proc/{pid}"):
+            continue
+        shutil.rmtree(os.path.join(parent, fname), ignore_errors=True)
+
+
 class NodeDaemon:
     """The per-node daemon (raylet + worker-pool analog): executes pushed
     CPU tasks in real worker subprocesses (crash isolation — a dying
@@ -918,7 +981,8 @@ class NodeDaemon:
     def __init__(self, head_address: Tuple[str, int],
                  resources: Dict[str, float],
                  labels: Optional[dict] = None,
-                 object_store_memory: int = 1 << 28):
+                 object_store_memory: int = 1 << 28,
+                 spill_dir: Optional[str] = None):
         self.head_address = head_address
         self.resources = resources
         self.labels = labels or {}
@@ -937,7 +1001,32 @@ class NodeDaemon:
         from ray_tpu._private.dataplane import (NodeObjectTable,
                                                 PullAdmission)
         from ray_tpu._private.ray_config import make_ray_config
-        self._table = NodeObjectTable(capacity=object_store_memory)
+        # Disk spill keeps memory pressure from ever LOSING a block
+        # (reference: raylet spill/restore, local_object_manager.h).
+        # Directory precedence: explicit arg > the object_spilling_
+        # directory config flag (the same one the head store honors —
+        # a user pointing spill at NVMe scratch gets BOTH stores there)
+        # > a per-daemon dir under the system temp dir.
+        if spill_dir is None:
+            spill_dir = make_ray_config(None).object_spilling_directory \
+                or None
+        if spill_dir is None:
+            import tempfile
+            spill_dir = os.path.join(
+                tempfile.gettempdir(),
+                f"ray_tpu_spill_{os.getpid()}")
+        else:
+            spill_dir = os.path.join(
+                spill_dir, f"ray_tpu_spill_{os.getpid()}")
+        self._spill_dir = spill_dir
+        # Crashed daemons (SIGKILL/OOM) never run close(): reap sibling
+        # ray_tpu_spill_<pid> dirs whose pid is gone, in the background
+        # (a dead shuffle can leave tens of GB behind).
+        threading.Thread(target=_reap_stale_spill_dirs,
+                         args=(os.path.dirname(spill_dir),),
+                         name="ray_tpu-spill-reaper", daemon=True).start()
+        self._table = NodeObjectTable(capacity=object_store_memory,
+                                      spill_dir=spill_dir)
         # Pull admission control (reference: pull_manager.h:52): bounds
         # bytes in flight into this node, task args first.
         self._table.admission = PullAdmission(
@@ -1059,6 +1148,16 @@ class NodeDaemon:
         (key, size) stub travels back. Multi-return tasks split PER
         ELEMENT — each return object is independently inline or
         daemon-resident, so shuffle partials never transit the head."""
+        if num_returns > 1 and (not isinstance(result, (tuple, list))
+                                or len(result) != num_returns):
+            # Wrong shape for a multi-return task: the head will raise —
+            # describe the actual value here (it is already deserialized)
+            # rather than parking an unconsumable stub in the table.
+            _send_frame(sock, _dumps({
+                "req_id": req_id, "ok": True,
+                "mismatch_desc": describe_value(result)}),
+                self._send_lock)
+            return
         if num_returns > 1 and store_limit and \
                 isinstance(result, (tuple, list)) and \
                 len(result) == num_returns:
@@ -1146,11 +1245,30 @@ class NodeDaemon:
     def _resolve_markers_for_worker(self, args, kwargs):
         """Like _resolve_markers, but arena-resident payloads stay as
         ArenaRef markers: the worker attaches the same shm arena and
-        reads them zero-copy (no daemon→worker copy of big args)."""
+        reads them zero-copy (no daemon→worker copy of big args).
+
+        Every ArenaRef'd key is PINNED (arena refcount) for the dispatch;
+        the returned pin list must be released when the worker is done.
+        Without the pin, disk spill could evict the entry between this
+        resolve and the worker's read (plasma semantics: an argument of
+        a dispatched task holds a reference, local_task_manager.cc pins
+        args for the task's runtime)."""
         from ray_tpu._private.dataplane import (PULL_PRIORITY_TASK_ARGS,
                                                 ObjectMarker,
                                                 ObjectPullError, pull_object)
         from ray_tpu._private.worker_process import ArenaRef
+        pinned: list = []
+
+        def _pin_in_arena(arena, key) -> bool:
+            view = arena.get_bytes(key)
+            if view is None:
+                return False
+            try:
+                view.release()
+            except BufferError:
+                pass
+            pinned.append(key)  # arena refcount held until release_pins
+            return True
 
         def resolve(a):
             if isinstance(a, (ObjectMarker, RemoteArgMarker)):
@@ -1163,16 +1281,42 @@ class NodeDaemon:
                     pull_object(tuple(owner), a.key, self._table,
                                 priority=PULL_PRIORITY_TASK_ARGS)
                 arena = self._table._arena
-                if arena is not None and arena.contains(a.key):
-                    return ArenaRef(a.key)
+                if arena is not None:
+                    if _pin_in_arena(arena, a.key):
+                        return ArenaRef(a.key)
+                    # Spilled? A read restores+promotes it; retry the pin
+                    # so the worker still gets the zero-copy path. If
+                    # promotion failed (arena still full) use the bytes
+                    # we already read — never a second full disk read on
+                    # a node that is under memory pressure.
+                    if self._table._spill_dir is not None:
+                        data = self._table._read_spilled(a.key)
+                        if data is not None:
+                            if _pin_in_arena(arena, a.key):
+                                return ArenaRef(a.key)
+                            return _loads(data)
                 with self._table.pinned(a.key) as payload:
                     if payload is None:
                         raise ObjectPullError(
                             f"object {a.key} evicted right after pull")
                     return _loads(payload)
             return a
-        return ([resolve(a) for a in args],
-                {k: resolve(v) for k, v in kwargs.items()})
+        try:
+            return ([resolve(a) for a in args],
+                    {k: resolve(v) for k, v in kwargs.items()}, pinned)
+        except BaseException:
+            self._release_arena_pins(pinned)
+            raise
+
+    def _release_arena_pins(self, pinned) -> None:
+        arena = self._table._arena
+        if arena is None:
+            return
+        for key in pinned:
+            try:
+                arena.release(key)
+            except Exception:  # noqa: BLE001 - release is best-effort
+                pass
 
     def _execute_on_worker(self, sock, msg: dict, req_id: int) -> None:
         """Run a pushed task on a leased worker subprocess and forward
@@ -1201,8 +1345,9 @@ class NodeDaemon:
         else:
             handle = pool.lease(python, container=container)
             lease_ex = None  # containerized: never pin
+        arg_pins: list = []
         try:
-            args, kwargs = self._resolve_markers_for_worker(
+            args, kwargs, arg_pins = self._resolve_markers_for_worker(
                 *_loads(msg["payload"]))
             fn_id = msg["fn_id"]
 
@@ -1247,6 +1392,7 @@ class NodeDaemon:
             self._reply(sock, req_id, error=exc, tb=traceback.format_exc())
             return
         finally:
+            self._release_arena_pins(arg_pins)
             if lease_ex is not None:
                 if handle.dead:  # crashed: un-pin; next task re-leases
                     pool.release(handle)
@@ -1364,6 +1510,7 @@ class NodeDaemon:
             elif kind == "stats":
                 self._reply(sock, req_id, value={
                     "transfer": dict(self._table.stats),
+                    "table": self._table.usage(),
                     "num_actors": len(self._actors),
                     "leases": len(self._lease_executors),
                     "lease_tasks_total": self._lease_tasks_total,
@@ -1490,6 +1637,10 @@ class NodeDaemon:
         if self._pool is not None:
             self._pool.shutdown()
         self._table.close()
+        try:  # table.close() already unlinked every spilled file
+            os.rmdir(self._spill_dir)
+        except OSError:
+            pass
 
     def _serve_once(self) -> None:
         """One connect-register-serve session against the head. Raises
@@ -1567,6 +1718,10 @@ class NodeDaemon:
                     ex = self._lease_executors.get(lease_id)
                     if ex is not None:
                         ex.spill()
+                elif msg.get("type") == "unspill_lease":
+                    ex = self._lease_executors.get(lease_id)
+                    if ex is not None:
+                        ex.unspill()
                 elif lease_id is not None:
                     # Leased task: FIFO onto the lease's serial executor —
                     # no thread spawn, no per-task worker pool traffic.
@@ -1607,7 +1762,8 @@ def run_node(address: str, *, num_cpus: float = 1.0, num_tpus: float = 0.0,
              memory: float = 1 << 30,
              resources: Optional[Dict[str, float]] = None,
              labels: Optional[dict] = None,
-             object_store_memory: int = 1 << 28) -> None:
+             object_store_memory: int = 1 << 28,
+             spill_dir: Optional[str] = None) -> None:
     """Entry point for `ray-tpu start --address host:port` and
     `python -m ray_tpu._private.multinode`."""
     host, _, port = address.rpartition(":")
@@ -1618,7 +1774,8 @@ def run_node(address: str, *, num_cpus: float = 1.0, num_tpus: float = 0.0,
     if resources:
         node_resources.update(resources)
     NodeDaemon((host or "127.0.0.1", int(port)), node_resources,
-               labels, object_store_memory=int(object_store_memory)).run()
+               labels, object_store_memory=int(object_store_memory),
+               spill_dir=spill_dir).run()
 
 
 def _main() -> None:
@@ -1640,6 +1797,10 @@ def _main() -> None:
                         default=float(1 << 28),
                         help="bytes for this node's object table (shm "
                              "arena when available)")
+    parser.add_argument("--spill-dir", type=str, default=None,
+                        help="directory for disk spill of cold objects "
+                             "under memory pressure (default: a per-"
+                             "daemon dir under the system temp dir)")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
     run_node(args.address, num_cpus=args.num_cpus, num_tpus=args.num_tpus,
@@ -1647,7 +1808,8 @@ def _main() -> None:
              resources=json.loads(args.resources) if args.resources
              else None,
              labels=json.loads(args.labels) if args.labels else None,
-             object_store_memory=int(args.object_store_memory))
+             object_store_memory=int(args.object_store_memory),
+             spill_dir=args.spill_dir)
 
 
 if __name__ == "__main__":

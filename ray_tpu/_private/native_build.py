@@ -123,13 +123,20 @@ def build_stress_binary(sanitize: str) -> Optional[str]:
     return out
 
 
-def load_library(name: str, extra_flags: Optional[List[str]] = None
-                 ) -> Optional[ctypes.CDLL]:
+def load_library(name: str, extra_flags: Optional[List[str]] = None,
+                 keep_gil: bool = False) -> Optional[ctypes.CDLL]:
     path = build_library(name, extra_flags)
     if path is None:
         return None
     try:
-        return ctypes.CDLL(path)
+        # keep_gil (ctypes.PyDLL): microsecond-scale native calls (map
+        # insert under an uncontended mutex) must NOT release the GIL —
+        # a release/reacquire pair per call becomes a GIL handoff convoy
+        # under thread churn (profiled: 1.7us/call quiet, ~80us under an
+        # 8-worker task storm). ONLY safe for functions that never block:
+        # anything that waits (pubsub long-poll) or moves big payloads
+        # (shm memcpy) stays on CDLL.
+        return ctypes.PyDLL(path) if keep_gil else ctypes.CDLL(path)
     except OSError:
         return None
 
@@ -139,13 +146,14 @@ _loaded: Dict[str, Optional[ctypes.CDLL]] = {}
 
 def load_library_cached(name: str,
                         extra_flags: Optional[List[str]] = None,
-                        configure=None) -> Optional[ctypes.CDLL]:
+                        configure=None,
+                        keep_gil: bool = False) -> Optional[ctypes.CDLL]:
     """Memoized load (failure included). ``configure(lib)`` runs once per
     process to set the ctypes argtypes/restypes — every native component
     wrapper shares this caching pattern instead of re-implementing it."""
     with _lock_for(f"load:{name}"):
         if name not in _loaded:
-            lib = load_library(name, extra_flags)
+            lib = load_library(name, extra_flags, keep_gil=keep_gil)
             if lib is not None and configure is not None:
                 configure(lib)
             _loaded[name] = lib

@@ -44,7 +44,11 @@ def _load() -> Optional[ctypes.CDLL]:
         path = _build_library()
         if path is None:
             return None
-        lib = ctypes.CDLL(path)
+        # PyDLL: every call (feasibility probe, acquire, release) is a
+        # microsecond map walk on the dispatch hot path; releasing the
+        # GIL around it costs a handoff per call under thread churn.
+        # Nothing in sched.cc blocks (pure fixed-point arithmetic).
+        lib = ctypes.PyDLL(path)
         P, I, L, D, C = (ctypes.c_void_p, ctypes.c_int, ctypes.c_int64,
                         ctypes.c_double, ctypes.c_char_p)
         lib.rsched_create.restype = P

@@ -61,6 +61,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.shm_store_num_objects.argtypes = [ctypes.c_void_p]
         lib.shm_store_write.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                         ctypes.c_char_p, ctypes.c_uint64]
+        lib.shm_store_set_evict_disabled.argtypes = [ctypes.c_void_p,
+                                                     ctypes.c_int]
+        lib.shm_store_lru_victims.restype = ctypes.c_uint64
+        lib.shm_store_lru_victims.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p,
+                                              ctypes.c_uint64]
         _lib = lib
         return _lib
 
@@ -177,6 +183,22 @@ class NativeObjectStore:
     def delete(self, object_id: str) -> bool:
         return self._lib.shm_store_delete(self._handle,
                                           object_id.encode()) == 0
+
+    def set_evict_disabled(self, disabled: bool) -> None:
+        """When disabled, create() fails (-1) under pressure instead of
+        LRU-evicting — the owner spills victims to disk itself, so a
+        still-needed object can never be silently lost."""
+        self._lib.shm_store_set_evict_disabled(self._handle,
+                                               1 if disabled else 0)
+
+    def lru_victims(self, max_bytes: int = 1 << 16) -> list:
+        """Evictable (sealed, unpinned) object ids in LRU order."""
+        buf = ctypes.create_string_buffer(max_bytes)
+        n = self._lib.shm_store_lru_victims(self._handle, buf, max_bytes)
+        if n == 0:
+            return []
+        ids = bytes(buf.raw).split(b"\0")
+        return [i.decode() for i in ids[:int(n)]]
 
     def used_bytes(self) -> int:
         return self._lib.shm_store_used_bytes(self._handle)

@@ -40,7 +40,8 @@ _PY_DEFAULTS: Dict[str, Any] = {
     "object_spilling_directory": "",
     "remote_object_inline_limit_bytes": 1 << 20,
     "gc_sweep_interval_ms": 500,
-    "health_check_period_ms": 1000,
+    "health_check_period_ms": 3000,
+    "health_check_timeout_ms": 10000,
     "health_check_failure_threshold": 5,
     "node_death_grace_ms": 0,
     "metrics_report_interval_ms": 10_000,
@@ -62,7 +63,8 @@ _PY_DEFAULTS: Dict[str, Any] = {
 
 def _load():
     from ray_tpu._private.native_build import load_library_cached
-    return load_library_cached("config", configure=_configure)
+    return load_library_cached("config", configure=_configure,
+                               keep_gil=True)
 
 
 def _configure(lib) -> None:

@@ -25,7 +25,10 @@ from ray_tpu._private.ids import ObjectID
 
 def _load():
     from ray_tpu._private.native_build import load_library_cached
-    return load_library_cached("refcount", configure=_configure)
+    # keep_gil: add_local/add_owned run per object creation on the
+    # submit hot path — GIL release per microsecond call convoys.
+    return load_library_cached("refcount", configure=_configure,
+                               keep_gil=True)
 
 
 def _configure(lib) -> None:

@@ -1246,10 +1246,29 @@ class Runtime:
                 self._store_if_referenced(spec.return_ids[0], result)
             return
         if not isinstance(result, (tuple, list)) or len(result) != n:
+            from ray_tpu._private.multinode import (MismatchedReturn,
+                                                    RemoteValueStub,
+                                                    describe_value)
+            if isinstance(result, MismatchedReturn):
+                # Daemon detected the shape mismatch and described the
+                # real value instead of storing it (nothing to free).
+                desc = result.desc
+            elif isinstance(result, RemoteValueStub):
+                # Defensive: an oversized mismatched single-return stub.
+                # Describe by size (never ship the payload to the head
+                # just for an error string) and free the daemon copy —
+                # it must not sit in the node's table until session end.
+                desc = (f"a single daemon-resident value "
+                        f"({result.size} bytes)")
+                try:
+                    result.conn.free_object(result.key)
+                except Exception:  # noqa: BLE001 - best effort
+                    pass
+            else:
+                desc = describe_value(result)
             self._store_error(spec, ValueError(
                 f"Task {spec.name} declared num_returns={n} but returned "
-                f"{type(result).__name__} of length "
-                f"{len(result) if hasattr(result, '__len__') else 'n/a'}"))
+                f"{desc}"))
             return
         from ray_tpu._private.multinode import RemoteValueStub
         for oid, value in zip(spec.return_ids, result):
@@ -1551,7 +1570,6 @@ class Runtime:
                 blocked = getattr(spec, "_blocked_release", False)
                 spec._blocked_release = False  # type: ignore[attr-defined]
             if blocked:
-                lease.blocked = False
                 if not lease.dropped:
                     # Finalized while blocked in a nested get (lease
                     # capacity was lent out): re-take it so the lease's
@@ -1559,6 +1577,12 @@ class Runtime:
                     self.scheduler.force_acquire(
                         lease.resources, lease.node_id,
                         lease.pg_id, lease.bidx)
+                    # Unspill BEFORE clearing blocked: once blocked is
+                    # False a concurrent _dispatch may attach new tasks,
+                    # and their frames must travel BEHIND the unspill so
+                    # the daemon is serial again when they arrive.
+                    self._unspill_lease(lease)
+                lease.blocked = False
             self._lease_task_done(spec, lease)
             return
         with self._lock:
@@ -1576,6 +1600,15 @@ class Runtime:
         if tpu_ids and node_id is not None:
             self.scheduler.return_tpu_ids(node_id, tpu_ids)
             spec._tpu_ids = None  # type: ignore[attr-defined]
+
+    def _unspill_lease(self, lease) -> None:
+        """Tell the lease's daemon to resume serial execution (the
+        blocked get that spilled it returned). In-order frame delivery
+        keeps this race-free: tasks attached after ``blocked`` cleared
+        travel behind this frame."""
+        conn = self._remote_nodes.get(lease.node_id)
+        if conn is not None:
+            conn.unspill_lease(lease.lease_id)
 
     def client_get_release(self, task_id_hex: str) -> Optional[TaskSpec]:
         """A client runtime's get blocked inside this running task:
@@ -1636,10 +1669,13 @@ class Runtime:
             spec._blocked_release = False  # type: ignore[attr-defined]
             lease = getattr(spec, "_lease", None)
         if lease is not None:
-            lease.blocked = False
             if not lease.dropped:
                 self.scheduler.force_acquire(lease.resources, lease.node_id,
                                              lease.pg_id, lease.bidx)
+                # Before clearing blocked — see _release_task_resources:
+                # new attaches must queue BEHIND the unspill frame.
+                self._unspill_lease(lease)
+            lease.blocked = False
             return
         pg_id, _ = self._pg_key(spec)
         self.scheduler.force_acquire(

@@ -36,8 +36,10 @@ import signal
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
 import traceback
+import uuid
 from typing import Any, Dict, Optional
 
 logger = logging.getLogger(__name__)
@@ -193,6 +195,12 @@ class WorkerHandle:
             self.sock.close()
         except OSError:
             pass
+        cidfile = getattr(self, "cidfile", None)
+        if cidfile is not None:  # containerized: clean exit reaps the cid
+            try:
+                os.unlink(cidfile)
+            except OSError:
+                pass
 
 
 def _spawn_worker(store_name: Optional[str],
@@ -251,7 +259,17 @@ def _spawn_container_worker(store_name: Optional[str],
     if not image:
         raise WorkerCrashedError(
             "runtime_env['container'] must set 'image'")
-    cmd = [engine, "run", "--rm", "-i", "--network=host",
+    # PDEATHSIG below only kills the ENGINE CLIENT process; under docker
+    # the container itself runs under containerd and would outlive a
+    # crashed daemon despite --rm. --cidfile gives the daemon (or the
+    # next daemon on this host) a handle to reap strays; --init makes
+    # in-container signal handling sane (zombie-reaping PID 1).
+    cid_dir = os.path.join(tempfile.gettempdir(), "ray_tpu_containers")
+    os.makedirs(cid_dir, exist_ok=True)
+    _reap_stale_containers_once(engine, cid_dir)
+    cidfile = os.path.join(cid_dir, f"{os.getpid()}-{uuid.uuid4().hex}.cid")
+    cmd = [engine, "run", "--rm", "-i", "--init", "--network=host",
+           "--cidfile", cidfile,
            "-v", "/dev/shm:/dev/shm"]
     for key in ("RAY_TPU_WORKER", "RAY_TPU_HEAD_ADDRESS"):
         if env.get(key):
@@ -273,7 +291,50 @@ def _spawn_container_worker(store_name: Optional[str],
     proc = subprocess.Popen(cmd, env=env, stdin=subprocess.PIPE,
                             stdout=subprocess.PIPE,
                             preexec_fn=_die_with_parent)
-    return WorkerHandle(proc, _StdioTransport(proc))
+    handle = WorkerHandle(proc, _StdioTransport(proc))
+    handle.cidfile = cidfile
+    return handle
+
+
+_reaped = threading.Event()
+
+
+def _reap_stale_containers_once(engine: str, cid_dir: str) -> None:
+    """Housekeeping, off the spawn hot path: the first container lease
+    in this process kicks one background reap (each stale cid costs a
+    `docker rm -f` of up to 30s — never serialized into a dispatch)."""
+    if _reaped.is_set():
+        return
+    _reaped.set()
+    threading.Thread(target=_reap_stale_containers,
+                     args=(engine, cid_dir),
+                     name="ray_tpu-container-reaper", daemon=True).start()
+
+
+def _reap_stale_containers(engine: str, cid_dir: str) -> None:
+    """Kill containers whose spawning daemon died (its pid is gone but
+    the cidfile remains): the PDEATHSIG on the engine client cannot stop
+    a containerd-managed container."""
+    try:
+        entries = os.listdir(cid_dir)
+    except OSError:
+        return
+    for fname in entries:
+        if not fname.endswith(".cid"):
+            continue
+        path = os.path.join(cid_dir, fname)
+        try:
+            spawner_pid = int(fname.split("-", 1)[0])
+            if os.path.exists(f"/proc/{spawner_pid}"):
+                continue  # spawner alive: its container is legitimate
+            with open(path) as f:
+                cid = f.read().strip()
+            if cid:
+                subprocess.run([engine, "rm", "-f", cid],
+                               capture_output=True, timeout=30)
+            os.unlink(path)
+        except (OSError, ValueError, subprocess.SubprocessError):
+            continue
 
 
 class WorkerProcessPool:

@@ -40,6 +40,11 @@ class _Episode:
     def __init__(self, episode_id: str, training_enabled: bool):
         self.episode_id = episode_id
         self.training_enabled = training_enabled
+        # Serializes this episode's transition sequence: the HTTP server
+        # is threaded, so pipelined requests for ONE episode must not
+        # interleave _record_prev (a torn prev_* update corrupts the
+        # (obs, action, reward) alignment the GAE pass consumes).
+        self.lock = threading.Lock()
         self.rows: Dict[str, list] = {k: [] for k in (
             SampleBatch.OBS, SampleBatch.NEXT_OBS, SampleBatch.ACTIONS,
             SampleBatch.REWARDS, SampleBatch.TERMINATEDS,
@@ -120,18 +125,20 @@ class PolicyServerInput:
         if cmd == GET_WEIGHTS:
             return self._policy.get_weights()
         ep = self._episode(req["episode_id"])
-        if cmd == GET_ACTION:
-            return self._get_action(ep, req["observation"])
-        if cmd == LOG_ACTION:
-            return self._log_action(ep, req["observation"], req["action"],
-                                    logp=req.get("logp"),
-                                    vf=req.get("vf"))
-        if cmd == LOG_RETURNS:
-            ep.pending_reward += float(req["reward"])
-            ep.total_reward += float(req["reward"])
-            return None
-        if cmd == END_EPISODE:
-            return self._end_episode(ep, req["observation"])
+        with ep.lock:  # concurrent requests for one episode serialize
+            if cmd == GET_ACTION:
+                return self._get_action(ep, req["observation"])
+            if cmd == LOG_ACTION:
+                return self._log_action(ep, req["observation"],
+                                        req["action"],
+                                        logp=req.get("logp"),
+                                        vf=req.get("vf"))
+            if cmd == LOG_RETURNS:
+                ep.pending_reward += float(req["reward"])
+                ep.total_reward += float(req["reward"])
+                return None
+            if cmd == END_EPISODE:
+                return self._end_episode(ep, req["observation"])
         raise ValueError(f"unknown command {cmd!r}")
 
     def _episode(self, eid: str) -> _Episode:
@@ -164,7 +171,9 @@ class PolicyServerInput:
         import jax
         self._record_prev(ep, obs, done=False)
         arr = np.asarray(obs)
-        self._key, sub = jax.random.split(self._key)
+        with self._lock:  # concurrent episodes share the stream: a
+            # duplicated split would correlate their action sampling
+            self._key, sub = jax.random.split(self._key)
         action, logp, value = self._policy.compute_actions(arr[None], sub)
         act = action[0]
         ep.prev_obs = arr

@@ -101,8 +101,14 @@ class Router:
                 self._have_work.clear()
                 continue
             try:
-                done, _ = ray_tpu.wait(refs, num_returns=len(refs),
-                                       timeout=0.05)
+                # BLOCK for the first completion (condition-wait inside
+                # the runtime, not a 50ms poll — a router per deployment
+                # must not burn constant CPU), then scoop every other
+                # already-done ref in one non-blocking sweep.
+                done, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.5)
+                if done and len(refs) > 1:
+                    done, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                           timeout=0)
             except Exception:  # noqa: BLE001 - shutdown window
                 time.sleep(0.05)
                 continue

@@ -92,7 +92,11 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     // -- GC / refcounting --
     FLAG_INT(gc_sweep_interval_ms, 500),
     // -- failure detection --
-    FLAG_INT(health_check_period_ms, 1000),
+    // Reference tolerances (ray_config_def.h:739-745): a saturated host
+    // can starve the daemon's pong thread for seconds (GIL + 1-CPU
+    // boxes); 1s-period probing declared BUSY nodes dead mid-workload.
+    FLAG_INT(health_check_period_ms, 3000),
+    FLAG_INT(health_check_timeout_ms, 10000),
     FLAG_INT(health_check_failure_threshold, 5),
     FLAG_INT(node_death_grace_ms, 0),
     // -- metrics / events --

@@ -32,7 +32,7 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x5261795450553031ULL;  // "RayTPU01"
+constexpr uint64_t kMagic = 0x5261795450553032ULL;  // "RayTPU02"
 constexpr uint32_t kMaxIdLen = 63;
 
 enum EntryState : uint8_t {
@@ -69,6 +69,11 @@ struct Header {
   uint64_t used;
   uint32_t max_objects;
   uint32_t num_objects;
+  // When set, Create() never evicts to make room — it fails with -1 and
+  // the owning daemon spills LRU victims to disk instead (reference:
+  // raylet-orchestrated spill, src/ray/raylet/local_object_manager.h —
+  // plasma itself only reports OutOfMemory; the policy lives above it).
+  uint32_t evict_disabled;
   int32_t free_head;  // free-block list head (pool index)
   int32_t lru_head;   // least-recently-used entry index
   int32_t lru_tail;
@@ -139,7 +144,8 @@ class ShmStore {
     uint64_t alloc = (size ? size : 1);
     alloc = (alloc + 63) & ~uint64_t(63);
     int64_t off = AllocLocked(alloc);
-    while (off < 0 && EvictOneLocked()) off = AllocLocked(alloc);
+    while (off < 0 && !hdr_->evict_disabled && EvictOneLocked())
+      off = AllocLocked(alloc);
     if (off < 0) return -1;
     idx = InsertLocked(id);
     if (idx < 0) {
@@ -227,6 +233,29 @@ class ShmStore {
   uint64_t NumObjects() {
     Lock l(hdr_);
     return hdr_->num_objects;
+  }
+
+  void SetEvictDisabled(int v) {
+    Lock l(hdr_);
+    hdr_->evict_disabled = v ? 1 : 0;
+  }
+
+  // NUL-separated ids of evictable (sealed, refcount-0) entries in LRU
+  // order, head first, until the buffer is full. Returns the count
+  // written. The spilling daemon reads this to pick victims; each id is
+  // re-checked at delete time, so a stale snapshot is harmless.
+  uint64_t LruVictims(char* buf, uint64_t bufsize) {
+    Lock l(hdr_);
+    uint64_t count = 0, pos = 0;
+    for (int32_t idx = hdr_->lru_head; idx >= 0;
+         idx = entries_[idx].lru_next) {
+      size_t len = strnlen(entries_[idx].id, kMaxIdLen) + 1;
+      if (pos + len > bufsize) break;
+      memcpy(buf + pos, entries_[idx].id, len);
+      pos += len;
+      count++;
+    }
+    return count;
   }
 
  private:
@@ -490,6 +519,14 @@ uint64_t shm_store_used_bytes(void* store) {
 
 uint64_t shm_store_num_objects(void* store) {
   return static_cast<ShmStore*>(store)->NumObjects();
+}
+
+void shm_store_set_evict_disabled(void* store, int v) {
+  static_cast<ShmStore*>(store)->SetEvictDisabled(v);
+}
+
+uint64_t shm_store_lru_victims(void* store, char* buf, uint64_t bufsize) {
+  return static_cast<ShmStore*>(store)->LruVictims(buf, bufsize);
 }
 
 void shm_store_write(void* store, int64_t offset, const uint8_t* src,

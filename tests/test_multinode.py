@@ -307,6 +307,7 @@ def test_hung_daemon_detected_by_health_checks(ray_start_regular):
     ray_tpu.shutdown()
     ray_tpu.init(num_cpus=2, num_tpus=0,
                  _system_config={"health_check_period_ms": 150,
+                                 "health_check_timeout_ms": 300,
                                  "health_check_failure_threshold": 3})
     host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
     p = _spawn_daemon(port, num_cpus=2, resources={"remote": 2})

@@ -270,3 +270,93 @@ def test_daemon_actor_multi_return_big_results(lease_cluster):
     small = ray_tpu.get(small_ref, timeout=60)
     assert int(big[0]) == 3 and big.nbytes == (1 << 19) * 8
     assert list(small) == [4] * 8
+
+
+def test_lease_resumes_serial_after_unspill(ray_start_regular):
+    """A nested-get spill is WINDOWED, not sticky: once the blocked get
+    returns, the head's unspill_lease frame restores serial execution —
+    later same-class tasks must queue on the lease's serial executor
+    again, not fan out onto threads against its ONE accounted
+    acquisition (the over-subscription the sticky flag caused).
+    Reference: leased worker = one task at a time,
+    direct_task_transport.cc OnWorkerIdle."""
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    # ONE cpu on the daemon: exactly one lease can exist for the class.
+    p = _spawn_daemon(port, num_cpus=1, resources={"solo": 1})
+    try:
+        _wait_for_resource("solo", 1)
+
+        # One function = one scheduling class = one lease.
+        @ray_tpu.remote(num_cpus=1, resources={"solo": 0.01},
+                        runtime_env={"worker_process": False})
+        def task(mode):
+            import time as t
+
+            import ray_tpu as rt
+            if mode == "block":
+
+                @rt.remote(num_cpus=0, resources={"solo": 0.01})
+                def child():
+                    t.sleep(1.0)
+                    return "c"
+
+                out = rt.get(child.remote(), timeout=30)  # spills lease
+                t.sleep(1.0)  # unspilled now; keep the lease occupied
+                return out
+            t.sleep(0.3)
+            return mode
+
+        blocker = task.remote("block")
+        time.sleep(1.5)  # blocker past its nested get, inside the sleep
+        t0 = time.monotonic()
+        naps = [task.remote(f"nap{i}") for i in range(4)]
+        assert ray_tpu.get(naps, timeout=60) == [
+            f"nap{i}" for i in range(4)]
+        wall = time.monotonic() - t0
+        assert ray_tpu.get(blocker, timeout=60) == "c"
+        # Serial resumption: 4 x 0.3s naps queue BEHIND the blocker's
+        # remaining sleep on the serial executor (>= 1.2s, measured
+        # ~1.7s). The sticky-spill bug fanned them onto threads
+        # concurrently with the blocker (~0.35s wall).
+        assert wall >= 1.1, (
+            f"4 same-class 0.3s tasks finished in {wall:.2f}s while the "
+            "lease's task was still running - the lease is still "
+            "spilled (concurrent execution on one acquisition)")
+    finally:
+        if p.poll() is None:
+            p.kill()
+        p.wait(timeout=10)
+
+
+def test_daemon_num_returns_mismatch_reports_type_and_frees_stub(
+        lease_cluster):
+    """Advisor regression: a daemon task declaring num_returns=2 but
+    returning one OVERSIZED value (daemon-resident stub) must (a) report
+    the user's actual return type — not 'RemoteValueStub of length n/a'
+    — and (b) free the stub from the daemon table instead of leaking it
+    until session end."""
+    import numpy as np
+
+    def _daemon_object_count():
+        return sum(s.get("table", {}).get("objects", 0)
+                   for s in _daemon_stats())
+
+    @ray_tpu.remote(resources={"lease": 1}, num_returns=2, max_retries=0,
+                    runtime_env={"worker_process": False})
+    def wrong_shape():
+        return np.full(1 << 19, 7, np.int64)  # 4MB single value, not 2
+
+    r1, _r2 = wrong_shape.remote()
+    with pytest.raises(Exception) as exc_info:
+        ray_tpu.get(r1, timeout=60)
+    msg = str(exc_info.value)
+    assert "num_returns=2" in msg
+    assert "ndarray" in msg, f"real type hidden: {msg}"
+    assert "RemoteValueStub" not in msg
+    # The daemon-side payload is freed, not leaked: the table drains.
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if _daemon_object_count() == 0:
+            break
+        time.sleep(0.2)
+    assert _daemon_object_count() == 0, _daemon_stats()
