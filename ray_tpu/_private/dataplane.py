@@ -103,6 +103,7 @@ class NodeObjectTable:
         # Serializes victim selection across concurrent _make_room
         # callers (one spill batch at a time); dict reads never take it.
         self._spill_lock = threading.Lock()
+        self._spill_seq = 0  # per-write spill filename uniquifier
         self._spill_dir: Optional[str] = None
         if capacity > 0:
             try:
@@ -119,8 +120,16 @@ class NodeObjectTable:
     # -- disk spill / restore -------------------------------------------
 
     def _spill_path(self, key: str) -> str:
-        return os.path.join(self._spill_dir,
-                            hashlib.sha1(key.encode()).hexdigest())
+        # Unique per WRITE, not per key: free() unlinks its popped
+        # record's path outside the lock, so a deterministic name would
+        # let that deferred unlink destroy a racing re-put's fresh
+        # spill file. Each record carries its own path.
+        with self._lock:
+            self._spill_seq += 1
+            seq = self._spill_seq
+        return os.path.join(
+            self._spill_dir,
+            f"{hashlib.sha1(key.encode()).hexdigest()}-{seq}")
 
     def _spill_one(self, key: str) -> int:
         """Copy one sealed arena object to disk and drop the arena copy.
@@ -350,6 +359,7 @@ class NodeObjectTable:
                         except BufferError:
                             pass  # transient exports; GC drops soon
                         self._arena.release(key)
+                        self._reclaim_if_doomed(key)
                     return
                 if self._spill_dir is None:
                     break
@@ -365,13 +375,26 @@ class NodeObjectTable:
             payload = self._heap.get(key)
         yield payload
 
-    def contains(self, key: str) -> bool:
-        if self._arena is not None and self._arena.contains(key):
-            return True
+    def _reclaim_if_doomed(self, key: str) -> None:
+        """Freed-while-pinned entries reclaim when a read pin drops —
+        without this, a quiet workload (no further _make_room passes)
+        would hold the freed bytes in the no-evict arena forever."""
         with self._lock:
+            doomed = key in self._doomed
+        if doomed and self._arena.delete(key):
+            with self._lock:
+                self._doomed.discard(key)
+
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            if key in self._doomed:
+                return False  # freed; only awaiting physical reclaim
             if key in self._spilled:
                 return True
-            return key in self._heap
+            in_heap = key in self._heap
+        if in_heap:
+            return True
+        return self._arena is not None and self._arena.contains(key)
 
     def free(self, key: str) -> None:
         dead_pin = False

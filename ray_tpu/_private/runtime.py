@@ -340,10 +340,14 @@ class _WorkerLease:
         self.tpu_ids = tpu_ids
         self.inflight = 1  # the creating task
         self.dropped = False
-        # The lease's RUNNING task is blocked in a nested get: skip new
-        # attaches and spill the daemon-side queue (deadlock safety —
-        # a child queued behind its blocked parent could never run).
-        self.blocked = False
+        # COUNT of this lease's tasks blocked in nested gets (the serial
+        # task plus any bypass-thread tasks may block simultaneously):
+        # while nonzero, skip new attaches and spill the daemon-side
+        # queue (deadlock safety — a child queued behind its blocked
+        # parent could never run). A boolean cleared on the FIRST
+        # unblock re-enabled attaches behind a still-blocked executor.
+        # Falsy when 0, so `not lease.blocked` reads stay correct.
+        self.blocked = 0
 
 
 class Runtime:
@@ -1570,6 +1574,9 @@ class Runtime:
                 blocked = getattr(spec, "_blocked_release", False)
                 spec._blocked_release = False  # type: ignore[attr-defined]
             if blocked:
+                with self._lock:
+                    lease.blocked = max(0, lease.blocked - 1)
+                    last_blocked = lease.blocked == 0
                 if not lease.dropped:
                     # Finalized while blocked in a nested get (lease
                     # capacity was lent out): re-take it so the lease's
@@ -1577,12 +1584,12 @@ class Runtime:
                     self.scheduler.force_acquire(
                         lease.resources, lease.node_id,
                         lease.pg_id, lease.bidx)
-                    # Unspill BEFORE clearing blocked: once blocked is
-                    # False a concurrent _dispatch may attach new tasks,
-                    # and their frames must travel BEHIND the unspill so
-                    # the daemon is serial again when they arrive.
-                    self._unspill_lease(lease)
-                lease.blocked = False
+                    # Unspill only when the LAST blocked task unblocks,
+                    # and BEFORE any new attach can be emitted (its
+                    # frame must travel behind the unspill so the
+                    # daemon is serial again when it arrives).
+                    if last_blocked:
+                        self._unspill_lease(lease)
             self._lease_task_done(spec, lease)
             return
         with self._lock:
@@ -1637,7 +1644,7 @@ class Runtime:
                 # blocked under it — set-after-release would let a
                 # dispatch attach a same-class child to this lease in
                 # the window, landing it behind its blocked parent.
-                lease.blocked = True
+                lease.blocked += 1
         if lease is not None:
             # A leased task blocks its lease's serial executor, so lending
             # out the LEASE's acquisition is safe: nothing else can run on
@@ -1669,13 +1676,16 @@ class Runtime:
             spec._blocked_release = False  # type: ignore[attr-defined]
             lease = getattr(spec, "_lease", None)
         if lease is not None:
+            with self._lock:
+                lease.blocked = max(0, lease.blocked - 1)
+                last_blocked = lease.blocked == 0
             if not lease.dropped:
                 self.scheduler.force_acquire(lease.resources, lease.node_id,
                                              lease.pg_id, lease.bidx)
-                # Before clearing blocked — see _release_task_resources:
-                # new attaches must queue BEHIND the unspill frame.
-                self._unspill_lease(lease)
-            lease.blocked = False
+                # Last-unblock only, before clearing opens attaches —
+                # see _release_task_resources.
+                if last_blocked:
+                    self._unspill_lease(lease)
             return
         pg_id, _ = self._pg_key(spec)
         self.scheduler.force_acquire(

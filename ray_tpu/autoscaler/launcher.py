@@ -37,15 +37,14 @@ from ray_tpu.autoscaler.node_provider import (NODE_KIND_HEAD,
 
 def load_cluster_config(path: str) -> Dict[str, Any]:
     import yaml
+
+    from ray_tpu.autoscaler.schema import validate_cluster_config
     with open(path) as f:
         config = yaml.safe_load(f) or {}
-    for req in ("cluster_name", "provider"):
-        if req not in config:
-            raise ValueError(f"cluster config needs a {req!r} field")
-    if "type" not in config["provider"]:
-        raise ValueError("provider needs a 'type' "
-                         "(one of the PROVIDER_TYPES keys)")
-    return config
+    # Schema validation BEFORE touching the cloud (reference:
+    # autoscaler/ray-schema.json via commands.py _bootstrap_config): a
+    # typo'd key must fail here, not produce a cluster that never joins.
+    return validate_cluster_config(config)
 
 
 def _provider_for(config: Dict[str, Any]):
@@ -54,14 +53,73 @@ def _provider_for(config: Dict[str, Any]):
                              config["cluster_name"])
 
 
+def _make_runner(provider, node_id: str, config: Dict[str, Any]):
+    """Provider override first (fake/local providers run commands
+    locally); otherwise plain ssh from the YAML's auth section
+    (reference: NodeProvider.get_command_runner, node_provider.py)."""
+    get = getattr(provider, "get_command_runner", None)
+    if get is not None:
+        return get(node_id, config)
+    from ray_tpu.autoscaler.command_runner import SSHCommandRunner
+    auth = config.get("auth", {})
+    return SSHCommandRunner(
+        provider.external_ip(node_id),
+        ssh_user=auth.get("ssh_user", "ubuntu"),
+        ssh_key=auth.get("ssh_private_key"),
+        ssh_port=int(auth.get("ssh_port", 22)))
+
+
+def _bootstrap_nodes(provider, config: Dict[str, Any],
+                     node_ids: List[str], kind: str,
+                     head_address: str) -> List[str]:
+    """Run the updater lifecycle on freshly created nodes; returns ids
+    that FAILED bootstrap (reference: commands.py get_or_create_head_node
+    + NodeUpdaterThread per worker)."""
+    setup = list(config.get("setup_commands", ())) + list(
+        config.get(f"{kind}_setup_commands", ()))
+    start = list(config.get(f"{kind}_start_ray_commands", ()))
+    if not (setup or start or config.get("file_mounts")
+            or config.get("initialization_commands")):
+        return []  # provider self-joins its nodes (gcp_tpu does)
+    from ray_tpu.autoscaler.updater import NodeUpdater, run_updaters
+    updaters = [NodeUpdater(
+        node_id=node_id, provider=provider,
+        runner=_make_runner(provider, node_id, config),
+        file_mounts=config.get("file_mounts"),
+        initialization_commands=config.get("initialization_commands"),
+        setup_commands=setup, start_commands=start,
+        env={"RAY_TPU_HEAD_ADDRESS": head_address},
+    ) for node_id in node_ids]
+    return [u.node_id for u in run_updaters(updaters)]
+
+
+def _head_address(provider, config: Dict[str, Any]) -> str:
+    """The address workers join: explicit provider.head_address, else
+    the (possibly just-created) head node's internal IP + head_port
+    (reference: commands.py derives the head IP before worker updaters
+    run — a fresh cluster has no address in the YAML)."""
+    explicit = config["provider"].get("head_address", "")
+    if explicit:
+        return explicit
+    heads = provider.non_terminated_nodes(
+        {TAG_RAY_NODE_KIND: NODE_KIND_HEAD})
+    if not heads:
+        return ""
+    port = int(config["provider"].get("head_port", 6380))
+    return f"{provider.internal_ip(heads[0])}:{port}"
+
+
 def up(config_path: str, *, no_head: bool = False) -> Dict[str, Any]:
     """Create the cluster: one head node (unless the provider config
     points at an existing head via ``head_address`` and ``no_head``)
-    plus ``min_workers`` workers. Idempotent: existing nodes of each
-    kind are counted, only the shortfall is created."""
+    plus ``min_workers`` workers, then BOOTSTRAP each new node (file
+    mounts, setup commands, start commands) so a fresh VM installs and
+    joins without manual steps. Idempotent: existing nodes of each kind
+    are counted, only the shortfall is created."""
     config = load_cluster_config(config_path)
     provider = _provider_for(config)
     created: Dict[str, int] = {"head": 0, "workers": 0}
+    new_heads: List[str] = []
     if not no_head and not config["provider"].get("head_address"):
         heads = provider.non_terminated_nodes(
             {TAG_RAY_NODE_KIND: NODE_KIND_HEAD})
@@ -71,18 +129,29 @@ def up(config_path: str, *, no_head: bool = False) -> Dict[str, Any]:
                 {TAG_RAY_NODE_KIND: NODE_KIND_HEAD,
                  TAG_RAY_USER_NODE_TYPE: "head"}, 1)
             created["head"] = 1
+            new_heads = [n for n in provider.non_terminated_nodes(
+                {TAG_RAY_NODE_KIND: NODE_KIND_HEAD}) if n not in heads]
     want = int(config.get("min_workers", 0))
-    have = len(provider.non_terminated_nodes(
-        {TAG_RAY_NODE_KIND: NODE_KIND_WORKER}))
-    if want > have:
+    before = provider.non_terminated_nodes(
+        {TAG_RAY_NODE_KIND: NODE_KIND_WORKER})
+    if want > len(before):
         provider.create_node(
             dict(config.get("worker_nodes", {})),
             {TAG_RAY_NODE_KIND: NODE_KIND_WORKER,
-             TAG_RAY_USER_NODE_TYPE: "worker"}, want - have)
-        created["workers"] = want - have
+             TAG_RAY_USER_NODE_TYPE: "worker"}, want - len(before))
+        created["workers"] = want - len(before)
+    new_workers = [n for n in provider.non_terminated_nodes(
+        {TAG_RAY_NODE_KIND: NODE_KIND_WORKER}) if n not in before]
+    head_address = _head_address(provider, config)
+    # Head bootstraps FIRST: workers' start commands join its address.
+    failed = _bootstrap_nodes(provider, config, new_heads, "head",
+                              head_address) + \
+        _bootstrap_nodes(provider, config, new_workers, "worker",
+                         head_address)
     nodes = provider.non_terminated_nodes({})
     return {"cluster_name": config["cluster_name"],
-            "created": created, "nodes": nodes}
+            "created": created, "nodes": nodes,
+            "bootstrap_failed": failed}
 
 
 def down(config_path: str) -> List[str]:

@@ -109,6 +109,14 @@ class FakeMultiNodeProvider(_RecordNodeProvider):
     def __init__(self, provider_config: Optional[Dict[str, Any]] = None,
                  cluster_name: str = "fake"):
         super().__init__(provider_config, cluster_name)
+        #: Bootstrap commands executed against this provider's nodes,
+        #: recorded as (node_id, command) — the offline up/down test's
+        #: observability into the updater lifecycle.
+        self.command_log: list = []
+
+    def get_command_runner(self, node_id: str, config: dict):
+        from ray_tpu.autoscaler.command_runner import LocalCommandRunner
+        return LocalCommandRunner(node_id, record=self.command_log)
 
     def _runtime(self):
         from ray_tpu._private.worker import global_worker

@@ -1,0 +1,119 @@
+"""Cluster YAML validation (analog of autoscaler/ray-schema.json).
+
+The reference validates `ray up` YAML against a JSON schema before
+touching the cloud; a typo'd key silently ignored is a cluster that
+never comes up. Same contract here, hand-rolled (no jsonschema dep):
+required fields, per-field types, and unknown-key rejection with a
+did-you-mean hint.
+"""
+
+from __future__ import annotations
+
+import difflib
+from typing import Any, Dict
+
+#: field -> (type, required). Top-level cluster config.
+TOP_LEVEL = {
+    "cluster_name": (str, True),
+    "provider": (dict, True),
+    "min_workers": (int, False),
+    "max_workers": (int, False),
+    "head_node": (dict, False),
+    "worker_nodes": (dict, False),
+    "file_mounts": (dict, False),
+    "initialization_commands": (list, False),
+    "setup_commands": (list, False),
+    "head_setup_commands": (list, False),
+    "worker_setup_commands": (list, False),
+    "head_start_ray_commands": (list, False),
+    "worker_start_ray_commands": (list, False),
+    "idle_timeout_minutes": ((int, float), False),
+    "auth": (dict, False),
+}
+
+PROVIDER_FIELDS = {
+    "type": (str, True),
+    # provider-specific extras (project/zone/head_address/...) pass
+    # through unvalidated — each provider owns its own knobs, like the
+    # reference's per-provider schema sections.
+}
+
+AUTH_FIELDS = {
+    "ssh_user": (str, False),
+    "ssh_private_key": (str, False),
+    "ssh_port": (int, False),
+}
+
+
+class ClusterConfigError(ValueError):
+    """The YAML does not describe a launchable cluster."""
+
+
+def _type_name(tp) -> str:
+    if isinstance(tp, tuple):
+        return " or ".join(t.__name__ for t in tp)
+    return tp.__name__
+
+
+def _check_fields(section: Dict[str, Any], spec: Dict[str, Any],
+                  where: str, reject_unknown: bool) -> None:
+    for field, (tp, required) in spec.items():
+        if field not in section:
+            if required:
+                raise ClusterConfigError(
+                    f"{where}: missing required field {field!r}")
+            continue
+        if not isinstance(section[field], tp) or \
+                isinstance(section[field], bool):
+            raise ClusterConfigError(
+                f"{where}: {field!r} must be {_type_name(tp)}, got "
+                f"{type(section[field]).__name__}")
+    if reject_unknown:
+        for key in section:
+            if key not in spec:
+                hint = difflib.get_close_matches(key, spec, n=1)
+                suffix = f" (did you mean {hint[0]!r}?)" if hint else ""
+                raise ClusterConfigError(
+                    f"{where}: unknown field {key!r}{suffix}")
+
+
+def validate_cluster_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Raise ClusterConfigError on the first problem; returns the
+    config for chaining."""
+    if not isinstance(config, dict):
+        raise ClusterConfigError("cluster config must be a mapping")
+    _check_fields(config, TOP_LEVEL, "cluster config",
+                  reject_unknown=True)
+    _check_fields(config["provider"], PROVIDER_FIELDS, "provider",
+                  reject_unknown=False)
+    if "auth" in config:
+        _check_fields(config["auth"], AUTH_FIELDS, "auth",
+                      reject_unknown=True)
+    from ray_tpu.autoscaler import PROVIDER_TYPES
+    ptype = config["provider"]["type"]
+    if ptype not in PROVIDER_TYPES:
+        raise ClusterConfigError(
+            f"provider.type {ptype!r} is not one of "
+            f"{sorted(PROVIDER_TYPES)}")
+    lo = int(config.get("min_workers", 0))
+    hi = config.get("max_workers")
+    if lo < 0:
+        raise ClusterConfigError("min_workers must be >= 0")
+    if hi is not None and int(hi) < lo:
+        raise ClusterConfigError(
+            f"max_workers ({hi}) < min_workers ({lo})")
+    for list_field in ("initialization_commands", "setup_commands",
+                      "head_setup_commands", "worker_setup_commands",
+                      "head_start_ray_commands",
+                      "worker_start_ray_commands"):
+        for item in config.get(list_field, ()):
+            if not isinstance(item, str):
+                raise ClusterConfigError(
+                    f"{list_field} entries must be strings, got "
+                    f"{type(item).__name__}")
+    for target, source in (config.get("file_mounts") or {}).items():
+        if not isinstance(target, str) or not isinstance(source, str):
+            raise ClusterConfigError(
+                "file_mounts must map remote path (str) -> local "
+                "path (str)")
+    return config

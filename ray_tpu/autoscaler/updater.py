@@ -1,0 +1,98 @@
+"""Node updater: bootstrap a freshly created node until it joins.
+
+Analog of the reference's autoscaler/_private/updater.py (NodeUpdaterThread):
+wait for the node to answer ssh, sync file mounts, run initialization +
+setup commands, then the start command that launches the ray_tpu daemon
+pointed at the head — tagging node status through the same lifecycle the
+reference uses (waiting-for-ssh → syncing-files → setting-up-ray →
+up-to-date | update-failed) so `ray-tpu status` and tests can observe
+progress.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.command_runner import (CommandRunnerError,
+                                               CommandRunnerInterface,
+                                               wait_for_command_runner)
+from ray_tpu.autoscaler.node_provider import (STATUS_UP_TO_DATE,
+                                              TAG_RAY_NODE_STATUS)
+
+logger = logging.getLogger(__name__)
+
+STATUS_WAITING_FOR_SSH = "waiting-for-ssh"
+STATUS_SYNCING_FILES = "syncing-files"
+STATUS_SETTING_UP = "setting-up-ray"
+STATUS_UPDATE_FAILED = "update-failed"
+
+
+class NodeUpdater(threading.Thread):
+    """Bootstraps ONE node; run many concurrently for a fleet
+    (reference: updater.py:90 NodeUpdaterThread.run)."""
+
+    def __init__(self, *, node_id: str, provider,
+                 runner: CommandRunnerInterface,
+                 file_mounts: Optional[Dict[str, str]] = None,
+                 initialization_commands: Optional[List[str]] = None,
+                 setup_commands: Optional[List[str]] = None,
+                 start_commands: Optional[List[str]] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 ssh_deadline_s: float = 300.0):
+        super().__init__(name=f"ray_tpu-updater-{node_id}", daemon=True)
+        self.node_id = node_id
+        self.provider = provider
+        self.runner = runner
+        self.file_mounts = dict(file_mounts or {})
+        self.initialization_commands = list(initialization_commands or ())
+        self.setup_commands = list(setup_commands or ())
+        self.start_commands = list(start_commands or ())
+        self.env = dict(env or {})
+        self.ssh_deadline_s = ssh_deadline_s
+        self.error: Optional[Exception] = None
+
+    def _tag(self, status: str) -> None:
+        try:
+            self.provider.set_node_tags(
+                self.node_id, {TAG_RAY_NODE_STATUS: status})
+        except Exception:  # noqa: BLE001 - tagging is observability only
+            logger.exception("could not tag node %s", self.node_id)
+
+    def run(self) -> None:
+        try:
+            self._tag(STATUS_WAITING_FOR_SSH)
+            wait_for_command_runner(self.runner, self.ssh_deadline_s)
+            if self.file_mounts:
+                self._tag(STATUS_SYNCING_FILES)
+                for target, source in self.file_mounts.items():
+                    self.runner.run_rsync_up(source, target)
+            self._tag(STATUS_SETTING_UP)
+            # Initialization commands run on the RAW VM (docker/gcloud
+            # config); setup commands prepare the runtime (pip install);
+            # start commands launch the daemon (reference splits them
+            # the same way, commands.py).
+            for cmd in self.initialization_commands:
+                self.runner.run(cmd, environment_variables=self.env)
+            for cmd in self.setup_commands:
+                self.runner.run(cmd, environment_variables=self.env)
+            for cmd in self.start_commands:
+                self.runner.run(cmd, environment_variables=self.env)
+            self._tag(STATUS_UP_TO_DATE)
+        except Exception as exc:  # noqa: BLE001 - any failure tags the node
+            self.error = exc
+            self._tag(STATUS_UPDATE_FAILED)
+            logger.error("bootstrap of node %s failed: %s",
+                         self.node_id, exc)
+
+
+def run_updaters(updaters: List[NodeUpdater],
+                 timeout_s: float = 1800.0) -> List[NodeUpdater]:
+    """Start + join a batch; returns the FAILED updaters (empty = all
+    nodes bootstrapped)."""
+    for u in updaters:
+        u.start()
+    for u in updaters:
+        u.join(timeout=timeout_s)
+    return [u for u in updaters if u.error is not None or u.is_alive()]

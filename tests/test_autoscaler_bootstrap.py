@@ -1,0 +1,216 @@
+"""Node bootstrap path: `ray-tpu up` on fresh nodes runs the full
+updater lifecycle (wait → file mounts → init/setup/start commands →
+status tags) through a command runner, offline (reference:
+autoscaler/_private/command_runner.py + updater.py + ray-schema.json)."""
+
+import pytest
+import yaml
+
+import ray_tpu
+from ray_tpu.autoscaler import FakeMultiNodeProvider
+from ray_tpu.autoscaler.command_runner import (CommandRunnerError,
+                                               LocalCommandRunner)
+from ray_tpu.autoscaler.node_provider import (STATUS_UP_TO_DATE,
+                                              TAG_RAY_NODE_STATUS)
+from ray_tpu.autoscaler.schema import (ClusterConfigError,
+                                       validate_cluster_config)
+from ray_tpu.autoscaler.updater import (STATUS_UPDATE_FAILED, NodeUpdater,
+                                        run_updaters)
+
+
+# ---------------------------------------------------------------------------
+# Schema validation
+# ---------------------------------------------------------------------------
+
+
+def _valid_config():
+    return {
+        "cluster_name": "c1",
+        "provider": {"type": "fake_multinode"},
+        "min_workers": 1,
+        "max_workers": 4,
+        "setup_commands": ["echo setup"],
+        "worker_start_ray_commands": ["echo start"],
+    }
+
+
+def test_schema_accepts_valid_config():
+    assert validate_cluster_config(_valid_config())["cluster_name"] == "c1"
+
+
+@pytest.mark.parametrize("mutate,match", [
+    (lambda c: c.pop("cluster_name"), "cluster_name"),
+    (lambda c: c.pop("provider"), "provider"),
+    (lambda c: c.update(min_workers="three"), "min_workers"),
+    (lambda c: c.update(max_workers=0), "max_workers"),
+    (lambda c: c.update(setup_commands=[42]), "setup_commands"),
+    (lambda c: c["provider"].update(type="aws"), "provider.type"),
+    # Typo'd key is rejected WITH a hint (did-you-mean).
+    (lambda c: c.update(worker_nodess={}), "worker_nodes"),
+])
+def test_schema_rejects_bad_configs(mutate, match):
+    config = _valid_config()
+    mutate(config)
+    with pytest.raises(ClusterConfigError, match=match):
+        validate_cluster_config(config)
+
+
+# ---------------------------------------------------------------------------
+# Updater lifecycle (no cluster needed)
+# ---------------------------------------------------------------------------
+
+
+class _TagRecorder:
+    def __init__(self):
+        self.tags = {}
+        self.history = []
+
+    def set_node_tags(self, node_id, tags):
+        self.tags.setdefault(node_id, {}).update(tags)
+        self.history.append((node_id, dict(tags)))
+
+
+def test_updater_runs_commands_in_order(tmp_path):
+    provider = _TagRecorder()
+    log: list = []
+    marker = tmp_path / "mounted.txt"
+    marker_src = tmp_path / "src.txt"
+    marker_src.write_text("payload")
+    updater = NodeUpdater(
+        node_id="n1", provider=provider,
+        runner=LocalCommandRunner("n1", record=log),
+        file_mounts={str(marker): str(marker_src)},
+        initialization_commands=["echo init"],
+        setup_commands=["echo setup"],
+        start_commands=["echo start $RAY_TPU_HEAD_ADDRESS"],
+        env={"RAY_TPU_HEAD_ADDRESS": "10.0.0.1:6380"},
+        ssh_deadline_s=10)
+    assert run_updaters([updater]) == []
+    cmds = [c for _node, c in log]
+    # wait probe, rsync, then init -> setup -> start, strictly ordered.
+    assert cmds[0] == "uptime"
+    assert cmds[1].startswith("rsync ")
+    assert cmds[2:] == ["echo init", "echo setup",
+                        "echo start $RAY_TPU_HEAD_ADDRESS"]
+    assert marker.read_text() == "payload"
+    # Status lifecycle ended up-to-date.
+    assert provider.tags["n1"][TAG_RAY_NODE_STATUS] == STATUS_UP_TO_DATE
+    statuses = [t[TAG_RAY_NODE_STATUS] for n, t in provider.history]
+    assert statuses == ["waiting-for-ssh", "syncing-files",
+                        "setting-up-ray", "up-to-date"]
+
+
+def test_updater_failure_tags_node(tmp_path):
+    provider = _TagRecorder()
+    updater = NodeUpdater(
+        node_id="n2", provider=provider,
+        runner=LocalCommandRunner("n2"),
+        setup_commands=["exit 3"], ssh_deadline_s=10)
+    failed = run_updaters([updater])
+    assert [u.node_id for u in failed] == ["n2"]
+    assert isinstance(updater.error, CommandRunnerError)
+    assert updater.error.exit_code == 3
+    assert provider.tags["n2"][TAG_RAY_NODE_STATUS] == \
+        STATUS_UPDATE_FAILED
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: ray-tpu up/down with bootstrap, offline
+# ---------------------------------------------------------------------------
+
+
+def test_up_bootstraps_and_down_terminates(ray_start_regular, tmp_path,
+                                           monkeypatch):
+    """The VERDICT 'done when': a fake-provider end-to-end up/down with
+    setup + start commands passes offline — nodes come up tagged
+    up-to-date with the bootstrap command stream recorded."""
+    from ray_tpu.autoscaler import launcher
+    provider = FakeMultiNodeProvider({"type": "fake_multinode"}, "c1")
+    monkeypatch.setattr(launcher, "_provider_for", lambda config: provider)
+
+    config = {
+        "cluster_name": "c1",
+        "provider": {"type": "fake_multinode",
+                     "head_address": "10.0.0.1:6380"},
+        "min_workers": 2,
+        "worker_nodes": {"resources": {"CPU": 1}},
+        "setup_commands": ["echo setup"],
+        "worker_setup_commands": ["echo worker-setup"],
+        "worker_start_ray_commands": ["echo start"],
+    }
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(config))
+
+    out = launcher.up(str(path))
+    assert out["created"] == {"head": 0, "workers": 2}
+    assert out["bootstrap_failed"] == []
+    assert len(out["nodes"]) == 2
+    for node_id in out["nodes"]:
+        assert provider.node_tags(node_id)[TAG_RAY_NODE_STATUS] == \
+            STATUS_UP_TO_DATE
+    # Both nodes got the full ordered command stream; the head address
+    # is plumbed into the env for the start command.
+    for node_id in out["nodes"]:
+        cmds = [c for n, c in provider.command_log if n == node_id]
+        assert cmds == ["uptime", "echo setup", "echo worker-setup",
+                        "echo start"]
+    # Idempotent re-up: no new nodes, no re-bootstrap.
+    n_cmds = len(provider.command_log)
+    out2 = launcher.up(str(path))
+    assert out2["created"] == {"head": 0, "workers": 0}
+    assert len(provider.command_log) == n_cmds
+    # Down terminates the fleet.
+    gone = launcher.down(str(path))
+    assert len(gone) == 2
+    assert provider.non_terminated_nodes({}) == []
+
+
+def test_up_reports_bootstrap_failures(ray_start_regular, tmp_path,
+                                       monkeypatch):
+    from ray_tpu.autoscaler import launcher
+    provider = FakeMultiNodeProvider({"type": "fake_multinode"}, "c2")
+    monkeypatch.setattr(launcher, "_provider_for", lambda config: provider)
+    config = {
+        "cluster_name": "c2",
+        "provider": {"type": "fake_multinode",
+                     "head_address": "10.0.0.1:6380"},
+        "min_workers": 1,
+        "setup_commands": ["exit 7"],
+    }
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(config))
+    out = launcher.up(str(path))
+    assert len(out["bootstrap_failed"]) == 1
+    (node_id,) = out["bootstrap_failed"]
+    assert provider.node_tags(node_id)[TAG_RAY_NODE_STATUS] == \
+        STATUS_UPDATE_FAILED
+    launcher.down(str(path))
+
+
+def test_up_derives_head_address_for_workers(ray_start_regular, tmp_path,
+                                             monkeypatch):
+    """No head_address in the YAML: up() creates the head, derives its
+    address (internal_ip:head_port), and exports it to worker bootstrap
+    (reference: commands.py resolves the head IP before worker
+    updaters)."""
+    from ray_tpu.autoscaler import launcher
+    provider = FakeMultiNodeProvider({"type": "fake_multinode"}, "c3")
+    monkeypatch.setattr(launcher, "_provider_for", lambda config: provider)
+    addr_file = tmp_path / "addr.txt"
+    config = {
+        "cluster_name": "c3",
+        "provider": {"type": "fake_multinode", "head_port": 7001},
+        "min_workers": 1,
+        "worker_start_ray_commands": [
+            f'echo "$RAY_TPU_HEAD_ADDRESS" >> {addr_file}'],
+    }
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(config))
+    out = launcher.up(str(path))
+    assert out["created"] == {"head": 1, "workers": 1}
+    assert out["bootstrap_failed"] == []
+    head_id = [n for n in out["nodes"]
+               if provider.node_tags(n).get("ray-node-kind") == "head"][0]
+    expected = f"{provider.internal_ip(head_id)}:7001"
+    assert addr_file.read_text().strip() == expected
+    launcher.down(str(path))
