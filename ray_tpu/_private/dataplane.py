@@ -135,26 +135,27 @@ class NodeObjectTable:
         """Copy one sealed arena object to disk and drop the arena copy.
         Returns bytes freed (0 if the object vanished or is pinned)."""
         with self._lock:
-            doomed = key in self._doomed
-        if doomed:
-            # free() ran while a reader pinned this entry: reclaim, never
-            # spill — a resurrected freed object would leak on disk until
-            # daemon shutdown (nobody will ever free it again). free()
-            # already popped _sizes, so measure via a transient pin.
-            view = self._arena.get_bytes(key)
-            size = 0
-            if view is not None:
-                size = len(view)
-                try:
-                    view.release()
-                except BufferError:
-                    pass
-                self._arena.release(key)
-            if self._arena.delete(key):
-                with self._lock:
+            if key in self._doomed:
+                # free() ran while a reader pinned this entry: reclaim,
+                # never spill — a resurrected freed object would leak on
+                # disk until daemon shutdown (nobody will ever free it
+                # again). Delete under the lock: a racing put() revival
+                # (which discards doomed under this lock) can never have
+                # its live object destroyed. free() already popped
+                # _sizes, so size via a transient pin.
+                view = self._arena.get_bytes(key)
+                size = 0
+                if view is not None:
+                    size = len(view)
+                    try:
+                        view.release()
+                    except BufferError:
+                        pass
+                    self._arena.release(key)
+                if self._arena.delete(key):
                     self._doomed.discard(key)
-                return size
-            return 0  # still pinned; a later pass retries
+                    return size
+                return 0  # still pinned; a later pass retries
         view = self._arena.get_bytes(key)
         if view is None:
             return 0
@@ -378,11 +379,12 @@ class NodeObjectTable:
     def _reclaim_if_doomed(self, key: str) -> None:
         """Freed-while-pinned entries reclaim when a read pin drops —
         without this, a quiet workload (no further _make_room passes)
-        would hold the freed bytes in the no-evict arena forever."""
+        would hold the freed bytes in the no-evict arena forever.
+        The delete happens UNDER the lock (a leaf microsecond call): a
+        racing put() revival discards doomed under the same lock, so we
+        can never destroy a just-revived live object."""
         with self._lock:
-            doomed = key in self._doomed
-        if doomed and self._arena.delete(key):
-            with self._lock:
+            if key in self._doomed and self._arena.delete(key):
                 self._doomed.discard(key)
 
     def contains(self, key: str) -> bool:

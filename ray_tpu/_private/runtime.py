@@ -1574,9 +1574,7 @@ class Runtime:
                 blocked = getattr(spec, "_blocked_release", False)
                 spec._blocked_release = False  # type: ignore[attr-defined]
             if blocked:
-                with self._lock:
-                    lease.blocked = max(0, lease.blocked - 1)
-                    last_blocked = lease.blocked == 0
+                gate = self._unblock_lease_gated(lease)
                 if not lease.dropped:
                     # Finalized while blocked in a nested get (lease
                     # capacity was lent out): re-take it so the lease's
@@ -1584,12 +1582,8 @@ class Runtime:
                     self.scheduler.force_acquire(
                         lease.resources, lease.node_id,
                         lease.pg_id, lease.bidx)
-                    # Unspill only when the LAST blocked task unblocks,
-                    # and BEFORE any new attach can be emitted (its
-                    # frame must travel behind the unspill so the
-                    # daemon is serial again when it arrives).
-                    if last_blocked:
-                        self._unspill_lease(lease)
+                if gate:
+                    self._send_unspill_and_open(lease)
             self._lease_task_done(spec, lease)
             return
         with self._lock:
@@ -1608,14 +1602,33 @@ class Runtime:
             self.scheduler.return_tpu_ids(node_id, tpu_ids)
             spec._tpu_ids = None  # type: ignore[attr-defined]
 
-    def _unspill_lease(self, lease) -> None:
-        """Tell the lease's daemon to resume serial execution (the
-        blocked get that spilled it returned). In-order frame delivery
-        keeps this race-free: tasks attached after ``blocked`` cleared
-        travel behind this frame."""
-        conn = self._remote_nodes.get(lease.node_id)
-        if conn is not None:
-            conn.unspill_lease(lease.lease_id)
+    def _unblock_lease_gated(self, lease) -> bool:
+        """One task's blocked get returned: decrement the blocked count.
+        The LAST unblocker must hold the gate (blocked stays >=1, so no
+        _dispatch can attach) until the unspill frame is ON THE WIRE —
+        decrement-then-send would let an attach frame overtake the
+        unspill and execute on a still-spilled daemon executor. Returns
+        True iff the caller owns the gate and must follow with
+        _send_unspill_and_open."""
+        with self._lock:
+            lease.blocked -= 1
+            if lease.blocked == 0:
+                lease.blocked = 1  # gate: attaches stay closed
+                return True
+        return False
+
+    def _send_unspill_and_open(self, lease) -> None:
+        """Second half of the gated unblock: ship the unspill frame,
+        then open attaches (arithmetic decrement — a NEW blocked get
+        during the send may have incremented, and its spill frame
+        travels after ours, which the daemon applies in order)."""
+        if not lease.dropped:
+            conn = self._remote_nodes.get(lease.node_id)
+            if conn is not None:
+                conn.unspill_lease(lease.lease_id)
+        with self._lock:
+            lease.blocked -= 1
+        self._dispatch()
 
     def client_get_release(self, task_id_hex: str) -> Optional[TaskSpec]:
         """A client runtime's get blocked inside this running task:
@@ -1676,16 +1689,12 @@ class Runtime:
             spec._blocked_release = False  # type: ignore[attr-defined]
             lease = getattr(spec, "_lease", None)
         if lease is not None:
-            with self._lock:
-                lease.blocked = max(0, lease.blocked - 1)
-                last_blocked = lease.blocked == 0
+            gate = self._unblock_lease_gated(lease)
             if not lease.dropped:
                 self.scheduler.force_acquire(lease.resources, lease.node_id,
                                              lease.pg_id, lease.bidx)
-                # Last-unblock only, before clearing opens attaches —
-                # see _release_task_resources.
-                if last_blocked:
-                    self._unspill_lease(lease)
+            if gate:
+                self._send_unspill_and_open(lease)
             return
         pg_id, _ = self._pg_key(spec)
         self.scheduler.force_acquire(

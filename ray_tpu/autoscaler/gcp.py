@@ -205,6 +205,17 @@ class GCloudTPUNodeProvider(NodeProvider):
                  f"--labels {labels!r}")
         self._gcloud("ssh", name, "--worker=all", "--command", start)
 
+    def get_command_runner(self, node_id: str, config: dict):
+        """Bootstrap commands reach TPU VMs through gcloud's ssh wrapper
+        (keys/IAP handled by gcloud; plain ssh cannot reach them) —
+        the launcher's updater path uses this for YAMLs that carry
+        setup/start commands beyond the provider's own self-join."""
+        from ray_tpu.autoscaler.command_runner import \
+            GcloudSSHCommandRunner
+        return GcloudSSHCommandRunner(
+            node_id, project=self.provider_config["project"],
+            zone=self.provider_config["zone"])
+
     def terminate_node(self, node_id: str) -> None:
         self._gcloud("delete", node_id, "--quiet", check=False)
 

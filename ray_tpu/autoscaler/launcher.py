@@ -32,6 +32,7 @@ from typing import Any, Dict, List
 from ray_tpu.autoscaler.node_provider import (NODE_KIND_HEAD,
                                               NODE_KIND_WORKER,
                                               TAG_RAY_NODE_KIND,
+                                              TAG_RAY_NODE_STATUS,
                                               TAG_RAY_USER_NODE_TYPE)
 
 
@@ -142,12 +143,21 @@ def up(config_path: str, *, no_head: bool = False) -> Dict[str, Any]:
         created["workers"] = want - len(before)
     new_workers = [n for n in provider.non_terminated_nodes(
         {TAG_RAY_NODE_KIND: NODE_KIND_WORKER}) if n not in before]
+    # Re-up RETRIES update-failed nodes (reference: the updater re-runs
+    # on any non-up-to-date node): without this, a worker that failed
+    # its setup command counts toward min_workers forever and the
+    # cluster sits permanently degraded.
+    from ray_tpu.autoscaler.updater import STATUS_UPDATE_FAILED
+    retry_workers = [
+        n for n in before
+        if provider.node_tags(n).get(TAG_RAY_NODE_STATUS) ==
+        STATUS_UPDATE_FAILED]
     head_address = _head_address(provider, config)
     # Head bootstraps FIRST: workers' start commands join its address.
     failed = _bootstrap_nodes(provider, config, new_heads, "head",
                               head_address) + \
-        _bootstrap_nodes(provider, config, new_workers, "worker",
-                         head_address)
+        _bootstrap_nodes(provider, config, new_workers + retry_workers,
+                         "worker", head_address)
     nodes = provider.non_terminated_nodes({})
     return {"cluster_name": config["cluster_name"],
             "created": created, "nodes": nodes,

@@ -52,6 +52,7 @@ class NodeUpdater(threading.Thread):
         self.env = dict(env or {})
         self.ssh_deadline_s = ssh_deadline_s
         self.error: Optional[Exception] = None
+        self.abandoned = False  # overran run_updaters' shared deadline
 
     def _tag(self, status: str) -> None:
         try:
@@ -79,6 +80,12 @@ class NodeUpdater(threading.Thread):
                 self.runner.run(cmd, environment_variables=self.env)
             for cmd in self.start_commands:
                 self.runner.run(cmd, environment_variables=self.env)
+            if self.abandoned:
+                # run_updaters already reported this node failed (we
+                # overran its deadline): the tags must agree with that
+                # report, not flip to up-to-date afterwards.
+                self._tag(STATUS_UPDATE_FAILED)
+                return
             self._tag(STATUS_UP_TO_DATE)
         except Exception as exc:  # noqa: BLE001 - any failure tags the node
             self.error = exc
@@ -87,12 +94,26 @@ class NodeUpdater(threading.Thread):
                          self.node_id, exc)
 
 
+class BootstrapTimeout(RuntimeError):
+    """The node did not finish bootstrapping within the batch deadline."""
+
+
 def run_updaters(updaters: List[NodeUpdater],
                  timeout_s: float = 1800.0) -> List[NodeUpdater]:
-    """Start + join a batch; returns the FAILED updaters (empty = all
-    nodes bootstrapped)."""
+    """Start + join a batch under ONE shared deadline (N hung nodes cost
+    timeout_s total, not N * timeout_s); returns the FAILED updaters
+    (empty = all nodes bootstrapped). An overrunning updater is marked
+    abandoned so its eventual completion cannot tag the node up-to-date
+    in contradiction of this report."""
+    import time
     for u in updaters:
         u.start()
+    deadline = time.monotonic() + timeout_s
     for u in updaters:
-        u.join(timeout=timeout_s)
-    return [u for u in updaters if u.error is not None or u.is_alive()]
+        u.join(timeout=max(0.0, deadline - time.monotonic()))
+        if u.is_alive():
+            u.abandoned = True
+            u.error = BootstrapTimeout(
+                f"node {u.node_id} still bootstrapping after "
+                f"{timeout_s}s")
+    return [u for u in updaters if u.error is not None]

@@ -128,6 +128,13 @@ PRESETS: Dict[str, GPTConfig] = {
     "gpt-1.3b": GPTConfig(
         vocab_size=50304, n_layers=24, d_model=2048, n_heads=16,
         d_ff=8192, rotary_dim=64, max_seq_len=1024),
+    # Largest single-16GB-chip trainable point on the way to gptj-6b
+    # (GPT-neo-2.7B dims): bf16 params (5.3GB) + grads (5.3GB) +
+    # factored moments fit; the 6b config's params+grads alone are
+    # 24.2GB (see bench.py gptj6b feasibility probe).
+    "gpt-2.7b": GPTConfig(
+        vocab_size=50304, n_layers=32, d_model=2560, n_heads=32,
+        d_ff=10240, rotary_dim=64, max_seq_len=1024),
     # Test-size configs.
     "gpt-tiny": GPTConfig(
         vocab_size=256, n_layers=2, d_model=64, n_heads=4, d_ff=128,

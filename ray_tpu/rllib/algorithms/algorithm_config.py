@@ -17,6 +17,10 @@ class AlgorithmConfig:
         self.env_config: Dict[str, Any] = {}
         # rollouts
         self.num_rollout_workers: int = 2
+        # jax platform rollout workers pin THEIR process to ("cpu" —
+        # samplers never grab the learner's chip or a remote-TPU
+        # tunnel; None = leave the process default alone).
+        self.rollout_backend: Optional[str] = "cpu"
         self.num_envs_per_worker = 1
         self.rollout_fragment_length: int = 256
         self.num_cpus_per_worker: float = 1.0
@@ -62,6 +66,7 @@ class AlgorithmConfig:
     def rollouts(self, *, num_rollout_workers: Optional[int] = None,
                  rollout_fragment_length: Optional[int] = None,
                  num_envs_per_worker: Optional[int] = None,
+                 rollout_backend: Any = "__unset__",
                  **_ignored) -> "AlgorithmConfig":
         if num_rollout_workers is not None:
             self.num_rollout_workers = num_rollout_workers
@@ -69,6 +74,14 @@ class AlgorithmConfig:
             self.rollout_fragment_length = rollout_fragment_length
         if num_envs_per_worker is not None:
             self.num_envs_per_worker = num_envs_per_worker
+        # Rollout workers are CPU samplers by default (reference: rollout
+        # workers on CPU nodes, the learner owns the accelerator); pass
+        # rollout_backend=None to let workers take whatever jax default
+        # their process has (e.g. big-batch TPU inference rollouts).
+        # Sentinel, not None: None is a MEANINGFUL value here, and a
+        # later unrelated .rollouts() call must not silently reset it.
+        if rollout_backend != "__unset__":
+            self.rollout_backend = rollout_backend
         return self
 
     env_runners = rollouts  # new-stack alias
@@ -196,4 +209,5 @@ class AlgorithmConfig:
             "output": self.output,
             "num_envs_per_worker": getattr(
                 self, "num_envs_per_worker", 1),
+            "rollout_backend": getattr(self, "rollout_backend", "cpu"),
         }

@@ -53,6 +53,9 @@ def resolve_policy_specs(policies: Dict[str, Any],
 class MultiAgentRolloutWorker:
     def __init__(self, env_creator: Callable, policy_config: Dict[str, Any],
                  worker_index: int = 0, seed: int = 0):
+        from ray_tpu.rllib.evaluation.rollout_worker import \
+            _pin_rollout_backend
+        _pin_rollout_backend(policy_config.get("rollout_backend", "cpu"))
         import jax
         self.env = env_creator(policy_config.get("env_config") or {})
         policies = policy_config["policies"]

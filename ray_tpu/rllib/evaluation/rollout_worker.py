@@ -33,9 +33,30 @@ def _make_env(env_creator, env_config):
     return env
 
 
+def _pin_rollout_backend(backend) -> None:
+    """Pin THIS process's jax platform for sampling (reference: rollout
+    workers are CPU samplers; the learner owns the accelerator). In a
+    fresh daemon/worker process jax would otherwise grab the TPU
+    backend — per-step small-batch inference over a remote-chip tunnel
+    measures tunnel latency (~150ms/step: the 14x daemon-rollout
+    slowdown), and a pod of samplers would fight the learner for its
+    chip. No-op once jax is initialized: driver-resident workers share
+    the learner's process and must not flip its platform."""
+    if not backend:
+        return
+    try:
+        import jax
+        from jax._src import xla_bridge
+        if not getattr(xla_bridge, "_backends", None):
+            jax.config.update("jax_platforms", backend)
+    except Exception:  # noqa: BLE001 - sampling works on any backend
+        pass
+
+
 class RolloutWorker:
     def __init__(self, env_creator: Callable, policy_config: Dict[str, Any],
                  worker_index: int = 0, seed: int = 0):
+        _pin_rollout_backend(policy_config.get("rollout_backend", "cpu"))
         import jax
         self.env = _make_env(env_creator, policy_config.get("env_config"))
         obs_space = self.env.observation_space

@@ -214,3 +214,35 @@ def test_up_derives_head_address_for_workers(ray_start_regular, tmp_path,
     expected = f"{provider.internal_ip(head_id)}:7001"
     assert addr_file.read_text().strip() == expected
     launcher.down(str(path))
+
+
+def test_re_up_retries_failed_bootstrap(ray_start_regular, tmp_path,
+                                        monkeypatch):
+    """A worker that failed bootstrap is RETRIED by the next up() (the
+    reference updater re-runs on non-up-to-date nodes) — the cluster
+    does not sit permanently degraded below min_workers."""
+    from ray_tpu.autoscaler import launcher
+    provider = FakeMultiNodeProvider({"type": "fake_multinode"}, "c4")
+    monkeypatch.setattr(launcher, "_provider_for", lambda config: provider)
+    config = {
+        "cluster_name": "c4",
+        "provider": {"type": "fake_multinode",
+                     "head_address": "10.0.0.1:6380"},
+        "min_workers": 1,
+        "setup_commands": ["exit 9"],
+    }
+    path = tmp_path / "cluster.yaml"
+    path.write_text(yaml.safe_dump(config))
+    out = launcher.up(str(path))
+    (node_id,) = out["bootstrap_failed"]
+    assert provider.node_tags(node_id)[TAG_RAY_NODE_STATUS] == \
+        STATUS_UPDATE_FAILED
+    # Operator fixes the YAML; re-up re-bootstraps the broken node.
+    config["setup_commands"] = ["echo fixed"]
+    path.write_text(yaml.safe_dump(config))
+    out2 = launcher.up(str(path))
+    assert out2["created"] == {"head": 0, "workers": 0}
+    assert out2["bootstrap_failed"] == []
+    assert provider.node_tags(node_id)[TAG_RAY_NODE_STATUS] == \
+        STATUS_UP_TO_DATE
+    launcher.down(str(path))
