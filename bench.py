@@ -254,9 +254,13 @@ def bench_shuffle_multi_daemon() -> dict:
 
 def bench_serve() -> dict:
     """Serving-plane throughput/latency (reference: release/serve_tests
-    single_deployment_1k_noop_replica): HTTP QPS + p50/p95 latency
-    through proxy -> router -> replica with the controller OFF the
-    request path (long-poll membership + router-local load)."""
+    autoscaling_single_deployment + single_deployment_1k_noop_replica):
+    HTTP QPS + p50/p95 through proxy -> router -> replica with the
+    controller OFF the request path, measured ACROSS a replica-count
+    curve (1/2/4) — the scaling dimension release tests sweep. Replicas
+    do 10ms of IO-shaped work under a per-replica concurrency cap so
+    QPS is replica-bound (a GIL-holding busy loop or a pure noop would
+    flatten the curve)."""
     import concurrent.futures
     import time as _time
     import urllib.request
@@ -267,34 +271,47 @@ def bench_serve() -> dict:
     out = {}
     ray_tpu.init(num_cpus=8)
     try:
-        @serve.deployment(num_replicas=2, max_concurrent_queries=32)
-        class Noop:
-            def __call__(self, req):
-                return b"ok"
-
-        serve.run(Noop.bind(), route_prefix="/noop", port=0)
-        port = serve.http_port()
-        url = f"http://127.0.0.1:{port}/noop"
-
-        def one():
+        def one(url):
             t0 = _time.perf_counter()
             with urllib.request.urlopen(url, timeout=30) as resp:
                 resp.read()
             return _time.perf_counter() - t0
 
-        for _ in range(20):  # warmup: routes + router membership
-            one()
-        n, workers = 400, 16
-        lat = []
-        t0 = _time.perf_counter()
-        with concurrent.futures.ThreadPoolExecutor(workers) as pool:
-            for dt in pool.map(lambda _: one(), range(n)):
-                lat.append(dt)
-        wall = _time.perf_counter() - t0
-        lat.sort()
-        out["serve_qps"] = round(n / wall, 1)
-        out["serve_p50_ms"] = round(lat[n // 2] * 1000, 2)
-        out["serve_p95_ms"] = round(lat[int(n * 0.95)] * 1000, 2)
+        for replicas in (1, 2, 4):
+            # 10ms IO-shaped work + concurrency cap 2: each replica
+            # tops out at ~200 QPS, so QPS tracks the replica count —
+            # the replica-bound regime the release test sweeps (a
+            # GIL-holding busy loop would flatten the curve: replicas
+            # of one deployment share a process).
+            @serve.deployment(num_replicas=replicas,
+                              max_concurrent_queries=2,
+                              name=f"work{replicas}")
+            class Work:
+                def __call__(self, req):
+                    _time.sleep(0.010)
+                    return b"ok"
+
+            serve.run(Work.bind(), route_prefix=f"/work{replicas}",
+                      port=0)
+            url = f"http://127.0.0.1:{serve.http_port()}/work{replicas}"
+            for _ in range(20):  # warmup: routes + router membership
+                one(url)
+            n, workers = 400, 16
+            lat = []
+            t0 = _time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+                for dt in pool.map(lambda _: one(url), range(n)):
+                    lat.append(dt)
+            wall = _time.perf_counter() - t0
+            lat.sort()
+            out[f"serve_qps_r{replicas}"] = round(n / wall, 1)
+            out[f"serve_p50_ms_r{replicas}"] = round(
+                lat[n // 2] * 1000, 2)
+            out[f"serve_p95_ms_r{replicas}"] = round(
+                lat[int(n * 0.95)] * 1000, 2)
+        out["serve_qps"] = out["serve_qps_r2"]  # continuity metric
+        out["serve_p50_ms"] = out["serve_p50_ms_r2"]
+        out["serve_p95_ms"] = out["serve_p95_ms_r2"]
         serve.shutdown()
     finally:
         ray_tpu.shutdown()

@@ -110,7 +110,7 @@ class GcloudSSHCommandRunner(CommandRunnerInterface):
     GCP support predates TPU VMs)."""
 
     def __init__(self, node_id: str, *, project: str, zone: str,
-                 worker: int = 0):
+                 worker="all"):
         self.node_id = node_id
         self.project = project
         self.zone = zone

@@ -212,9 +212,12 @@ class GCloudTPUNodeProvider(NodeProvider):
         setup/start commands beyond the provider's own self-join."""
         from ray_tpu.autoscaler.command_runner import \
             GcloudSSHCommandRunner
+        # worker="all": YAML setup/start commands must hit EVERY host of
+        # a multi-host pod slice (the provider's own self-join path uses
+        # --worker=all for the same reason).
         return GcloudSSHCommandRunner(
             node_id, project=self.provider_config["project"],
-            zone=self.provider_config["zone"])
+            zone=self.provider_config["zone"], worker="all")
 
     def terminate_node(self, node_id: str) -> None:
         self._gcloud("delete", node_id, "--quiet", check=False)

@@ -143,19 +143,24 @@ def up(config_path: str, *, no_head: bool = False) -> Dict[str, Any]:
         created["workers"] = want - len(before)
     new_workers = [n for n in provider.non_terminated_nodes(
         {TAG_RAY_NODE_KIND: NODE_KIND_WORKER}) if n not in before]
-    # Re-up RETRIES update-failed nodes (reference: the updater re-runs
-    # on any non-up-to-date node): without this, a worker that failed
-    # its setup command counts toward min_workers forever and the
-    # cluster sits permanently degraded.
+    # Re-up RETRIES update-failed nodes of BOTH kinds (reference: the
+    # updater re-runs on any non-up-to-date node): without this, a node
+    # that failed its setup command counts toward the fleet forever and
+    # the cluster sits permanently degraded. One tag-filtered list call
+    # per kind — a per-node node_tags() scan would cost one provider
+    # RPC per worker on every routine re-up.
     from ray_tpu.autoscaler.updater import STATUS_UPDATE_FAILED
-    retry_workers = [
-        n for n in before
-        if provider.node_tags(n).get(TAG_RAY_NODE_STATUS) ==
-        STATUS_UPDATE_FAILED]
+    failed_filter = {TAG_RAY_NODE_STATUS: STATUS_UPDATE_FAILED}
+    retry_heads = [n for n in provider.non_terminated_nodes(
+        {TAG_RAY_NODE_KIND: NODE_KIND_HEAD, **failed_filter})
+        if n not in new_heads]
+    retry_workers = [n for n in provider.non_terminated_nodes(
+        {TAG_RAY_NODE_KIND: NODE_KIND_WORKER, **failed_filter})
+        if n not in new_workers]
     head_address = _head_address(provider, config)
     # Head bootstraps FIRST: workers' start commands join its address.
-    failed = _bootstrap_nodes(provider, config, new_heads, "head",
-                              head_address) + \
+    failed = _bootstrap_nodes(provider, config, new_heads + retry_heads,
+                              "head", head_address) + \
         _bootstrap_nodes(provider, config, new_workers + retry_workers,
                          "worker", head_address)
     nodes = provider.non_terminated_nodes({})

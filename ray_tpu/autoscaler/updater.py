@@ -53,6 +53,10 @@ class NodeUpdater(threading.Thread):
         self.ssh_deadline_s = ssh_deadline_s
         self.error: Optional[Exception] = None
         self.abandoned = False  # overran run_updaters' shared deadline
+        # Serializes the final tag against run_updaters' abandonment:
+        # without it, a thread past the abandoned check could land
+        # UP_TO_DATE after the deadline report said failed.
+        self._final_lock = threading.Lock()
 
     def _tag(self, status: str) -> None:
         try:
@@ -80,13 +84,14 @@ class NodeUpdater(threading.Thread):
                 self.runner.run(cmd, environment_variables=self.env)
             for cmd in self.start_commands:
                 self.runner.run(cmd, environment_variables=self.env)
-            if self.abandoned:
-                # run_updaters already reported this node failed (we
-                # overran its deadline): the tags must agree with that
-                # report, not flip to up-to-date afterwards.
-                self._tag(STATUS_UPDATE_FAILED)
-                return
-            self._tag(STATUS_UP_TO_DATE)
+            with self._final_lock:
+                if self.abandoned:
+                    # run_updaters already reported this node failed (we
+                    # overran its deadline): the tags must agree with
+                    # that report, not flip to up-to-date afterwards.
+                    self._tag(STATUS_UPDATE_FAILED)
+                    return
+                self._tag(STATUS_UP_TO_DATE)
         except Exception as exc:  # noqa: BLE001 - any failure tags the node
             self.error = exc
             self._tag(STATUS_UPDATE_FAILED)
@@ -112,8 +117,10 @@ def run_updaters(updaters: List[NodeUpdater],
     for u in updaters:
         u.join(timeout=max(0.0, deadline - time.monotonic()))
         if u.is_alive():
-            u.abandoned = True
-            u.error = BootstrapTimeout(
-                f"node {u.node_id} still bootstrapping after "
-                f"{timeout_s}s")
+            with u._final_lock:
+                u.abandoned = True
+                u.error = BootstrapTimeout(
+                    f"node {u.node_id} still bootstrapping after "
+                    f"{timeout_s}s")
+                u._tag(STATUS_UPDATE_FAILED)
     return [u for u in updaters if u.error is not None]

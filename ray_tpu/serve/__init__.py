@@ -167,6 +167,22 @@ def run(target: Union[Application, Deployment], *,
                                  route_prefix=route_prefix)
     if port is not None:
         start(host=host, port=port)
+    if _proxy is not None:
+        # serve.run returns only once the app is REACHABLE (reference:
+        # serve.run blocks until the application is RUNNING): the proxy
+        # refreshes its route table via long-poll, so without this wait
+        # a request issued right after a second run() 404s against the
+        # previous table.
+        prefix = target.deployment.route_prefix \
+            if route_prefix == "__unset__" else route_prefix
+        if prefix:
+            import time as _time
+            deadline = _time.monotonic() + 15
+            while _time.monotonic() < deadline:
+                if ray_tpu.get(_proxy.has_route.remote(prefix),
+                               timeout=15):
+                    break
+                _time.sleep(0.05)
     return handle
 
 

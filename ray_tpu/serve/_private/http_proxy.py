@@ -89,6 +89,11 @@ class HTTPProxyActor:
                 for prefix, name in routes.items()}
             self._version = version
 
+    async def has_route(self, prefix: str) -> bool:
+        """serve.run's readiness probe: has the long-poll delivered this
+        prefix to the local table yet?"""
+        return prefix in self._routes
+
     async def _wait_for_routes(self, timeout: float = 15.0) -> None:
         deadline = asyncio.get_event_loop().time() + timeout
         while not self._routes and \
