@@ -299,6 +299,19 @@ class _ClientScheduler:
             {"op": "pg_exists", "pg_id": pg_id.hex()})["exists"])
 
 
+def _attached_arena():
+    """The shm arena this WORKER process shares with its daemon (None
+    outside worker-subprocess contexts or when the arena is gone)."""
+    try:
+        from ray_tpu._private import worker_process as wp
+        executor = getattr(wp, "_current_executor", None)
+        if executor is not None:
+            return executor._get_arena()
+    except Exception:  # noqa: BLE001 - arena optional
+        pass
+    return None
+
+
 class ClientRuntime:
     """Head-connected runtime bound by worker.py when user code runs in a
     daemon/worker context. Implements the Runtime surface the API layer
@@ -322,6 +335,15 @@ class ClientRuntime:
         self.refs = _ClientRefs(self._enqueue_notice)
         self._actor_info: Dict[ActorID, dict] = {}
         self._actor_info_lock = threading.Lock()
+        # Node-resident put threshold: payloads at/above it stay in the
+        # creating node's table (same knob the head uses to decide
+        # inline vs daemon-resident results). Local config defaults —
+        # per-head _system_config overrides do not travel here, which
+        # only shifts the inline/local cutover, never correctness.
+        from ray_tpu._private.ray_config import make_ray_config
+        self._put_local_limit = int(
+            make_ray_config(None).remote_object_inline_limit_bytes
+            or (1 << 20))
         # Ordered ref-notice queue + flusher (see _ClientRefs).
         self._notices: "collections.deque" = collections.deque()
         self._notice_event = threading.Event()
@@ -454,8 +476,58 @@ class ClientRuntime:
     # -- objects --------------------------------------------------------
 
     def put(self, value: Any) -> ObjectRef:
-        reply = self._conn.request(
-            {"op": "put", "payload": serialization.serialize(value)})
+        payload = serialization.serialize(value)
+        ref = self._put_node_resident(payload)
+        if ref is not None:
+            return ref
+        reply = self._conn.request({"op": "put", "payload": payload})
+        return self._refs_from_hex([reply["ref"]])[0]
+
+    def _put_node_resident(self, payload: bytes) -> Optional[ObjectRef]:
+        """Distributed-ownership put (reference: owner-is-creator,
+        reference_count.h:61): a big payload created on a node STAYS in
+        that node's object table — only a directory registration goes to
+        the head, and readers pull the bytes over the node-to-node data
+        plane. In-daemon contexts write the daemon table directly;
+        worker subprocesses write the shared shm arena and the daemon
+        ADOPTS the entry (bookkeeping) during registration. Returns
+        None when this context cannot (or should not: small payloads
+        ship inline) keep the bytes local — caller falls back to the
+        head-stored put."""
+        if len(payload) < self._put_local_limit:
+            return None
+        import uuid
+
+        from ray_tpu._private import multinode as mn
+        key = f"cput-{uuid.uuid4().hex}"
+        daemon = mn._current_daemon
+        adopt = False
+        node_hex = None
+        arena = None
+        if daemon is not None and daemon.node_id_hex:
+            daemon._table.put(key, payload)
+            node_hex = daemon.node_id_hex
+        else:
+            node_hex = os.environ.get("RAY_TPU_NODE_ID")
+            arena = _attached_arena()
+            if not node_hex or arena is None or \
+                    not arena.put_bytes(key, payload):
+                return None  # no local store (thin client / arena full)
+            adopt = True
+        try:
+            reply = self._conn.request({
+                "op": "put_remote", "node": node_hex, "key": key,
+                "size": len(payload), "adopt": adopt})
+        except Exception:  # noqa: BLE001 - registration failed: clean up
+            logger.exception("node-resident put registration failed; "
+                             "falling back to head-stored put")
+            # BOTH stores must release the orphan: an unadopted arena
+            # entry has no bookkeeping — nothing would ever free it.
+            if daemon is not None:
+                daemon._table.free(key)
+            elif arena is not None:
+                arena.delete(key)
+            return None
         return self._refs_from_hex([reply["ref"]])[0]
 
     def get(self, refs: List[ObjectRef],
@@ -682,6 +754,14 @@ class ClientSession:
                 return {"payload": None}
         if op == "put":
             ref = rt.put(serialization.deserialize(msg["payload"]))
+            self._pin([ref])
+            return {"ref": ref.hex()}
+        if op == "put_remote":
+            # Distributed-ownership put: bytes already live in the
+            # creating node's table; register the directory entry only.
+            ref = rt.register_remote_put(
+                NodeID(bytes.fromhex(msg["node"])), msg["key"],
+                int(msg["size"]), adopt=bool(msg.get("adopt")))
             self._pin([ref])
             return {"ref": ref.hex()}
         if op == "get":

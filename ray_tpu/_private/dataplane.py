@@ -376,6 +376,24 @@ class NodeObjectTable:
             payload = self._heap.get(key)
         yield payload
 
+    def adopt(self, key: str, size: int) -> bool:
+        """Take bookkeeping ownership of an arena entry written directly
+        by a sibling process (worker-subprocess put): register its size
+        so spill liveness sees it, and confirm residency. The re-check
+        closes the race with a spill pass discarding the pre-adoption
+        entry (its liveness check fails for keys without _sizes).
+        False = already evicted — the caller must fall back."""
+        if self._arena is None or not self._arena.contains(key):
+            return False
+        with self._lock:
+            self._sizes[key] = size
+            self._doomed.discard(key)
+        if self.contains(key):
+            return True
+        with self._lock:
+            self._sizes.pop(key, None)
+        return False
+
     def _reclaim_if_doomed(self, key: str) -> None:
         """Freed-while-pinned entries reclaim when a read pin drops —
         without this, a quiet workload (no further _make_room passes)

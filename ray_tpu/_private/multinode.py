@@ -31,6 +31,7 @@ elsewhere through the normal node-death path.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import socket
@@ -38,6 +39,8 @@ import struct
 import threading
 import traceback
 from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu._private import wire as _wire
 
 logger = logging.getLogger(__name__)
 
@@ -179,6 +182,11 @@ class NodeConnection:
         actually carrying the bytes."""
         req_id = self._next_req()
         msg["req_id"] = req_id
+        # Outbound control frames are schema-checked at the SOURCE: a
+        # drifted field fails here with the offending name, not on the
+        # daemon as an opaque handler error (reference: the proto
+        # contract enforces this at compile time).
+        _wire.validate_message(msg)
         waiter = _Pending()
         with self._lock:
             if self._closed:
@@ -215,6 +223,7 @@ class NodeConnection:
         """Send with req_id 0 — the daemon's reply (if any) is dropped by
         the recv loop. Never blocks on the daemon (GC/teardown paths)."""
         msg["req_id"] = 0
+        _wire.validate_message(msg)
         try:
             _send_frame(self._sock, _dumps(msg), self._send_lock)
         except OSError:
@@ -391,6 +400,7 @@ class NodeConnection:
             msg["num_returns"] = spec.num_returns
         if lease_id is not None:
             msg["lease_id"] = lease_id
+        _wire.validate_message(msg)
         with self._lock:
             closed = self._closed
             if not closed:
@@ -454,6 +464,16 @@ class NodeConnection:
 
     def free_object(self, key: str) -> None:
         self._fire_and_forget({"type": "free_object", "key": key})
+
+    def adopt_object(self, key: str, size: int) -> bool:
+        """Ask the daemon to take BOOKKEEPING ownership of an arena
+        entry a sibling worker process wrote directly into the shared
+        shm (distributed-ownership puts): registers its size so spill
+        liveness sees it, and confirms the payload is still resident.
+        False = already evicted/absent — the caller must fall back."""
+        reply = self._request({"type": "adopt_object", "key": key,
+                              "size": int(size)})
+        return bool(_loads(reply["value"]))
 
     def drop_lease(self, lease_id: str) -> None:
         """The head released this lease: the daemon retires its serial
@@ -764,6 +784,22 @@ class HeadServer:
                     sock.close()
                 return
             assert register["type"] == "register", register
+            # Version handshake (reference: node_manager.proto contract
+            # is compiled in; here it travels explicitly): a daemon
+            # from another release is REJECTED with a clear error, not
+            # left to fail on some later frame's missing field.
+            try:
+                _wire.check_peer_protocol(register.get("protocol"),
+                                          f"node daemon at {addr}")
+            except _wire.ProtocolMismatch as exc:
+                logger.error("rejecting daemon registration: %s", exc)
+                with contextlib.suppress(OSError):
+                    _send_frame(sock, _dumps({
+                        "type": "register_rejected",
+                        "error": str(exc),
+                        "head_protocol": _wire.PROTOCOL_VERSION}))
+                sock.close()
+                return
             conn = NodeConnection(sock, tuple(addr),
                                   register["resources"],
                                   register.get("labels"),
@@ -1228,7 +1264,8 @@ class NodeDaemon:
                 # ray_tpu API calls (see _private/client_runtime.py).
                 self._pool = WorkerProcessPool(
                     store_name=self._table.arena_name,
-                    head_address=self.head_address)
+                    head_address=self.head_address,
+                    node_id_hex=self.node_id_hex)
             return self._pool
 
     def _task_uses_worker_process(self, msg: dict) -> bool:
@@ -1499,6 +1536,14 @@ class NodeDaemon:
             elif kind == "free_object":
                 self._table.free(msg["key"])
                 self._reply(sock, req_id, value=None)
+            elif kind == "adopt_object":
+                # Worker-process put (distributed ownership): the worker
+                # wrote the payload straight into the shared arena; this
+                # node takes lifetime ownership (spill-liveness
+                # bookkeeping lives with the table's own lock
+                # discipline, dataplane.NodeObjectTable.adopt).
+                self._reply(sock, req_id, value=self._table.adopt(
+                    msg["key"], msg["size"]))
             elif kind == "profile":
                 # Self-sampled stacks (reference: profile_manager.py
                 # py-spy-on-demand, here cooperative — no ptrace).
@@ -1601,6 +1646,8 @@ class NodeDaemon:
                 self._session_registered = False
                 try:
                     self._serve_once()
+                except _wire.ProtocolMismatch:
+                    raise  # permanent: retrying a version rejection spins
                 except (ConnectionError, OSError) as exc:
                     if self._session_registered:
                         pass  # live session dropped; fall through, retry
@@ -1668,6 +1715,7 @@ class NodeDaemon:
             self._object_server_host = local_ip
         _send_frame(self._sock, _dumps({
             "type": "register",
+            "protocol": _wire.PROTOCOL_VERSION,
             "resources": self.resources,
             "labels": self.labels,
             "object_addr": (local_ip, self._object_server.port),
@@ -1676,6 +1724,10 @@ class NodeDaemon:
             "resident_actors": list(self._actors.keys()),
         }), self._send_lock)
         ack = _loads(_recv_frame(self._sock))
+        if ack.get("type") == "register_rejected":
+            # Version mismatch: surface the head's words and STOP —
+            # reconnect-retrying a permanent rejection would spin.
+            raise _wire.ProtocolMismatch(ack["error"])
         assert ack["type"] == "registered", ack
         self.node_id_hex = ack["node_id"]
         self._session_registered = True
@@ -1701,6 +1753,10 @@ class NodeDaemon:
         try:
             while not self._stop.is_set():
                 msg = _loads(_recv_frame(self._sock))
+                # Inbound control frames are schema-checked before any
+                # handler sees them: a head from another build fails
+                # HERE with the exact field, not deep in a handler.
+                _wire.validate_message(msg)
                 if msg.get("type") == "shutdown":
                     self._stop.set()
                     break

@@ -12,6 +12,12 @@ from typing import Optional
 
 from ray_tpu._private.ids import ObjectID, TaskID
 
+#: Borrow auto-bind backoff: when binding the client runtime fails (head
+#: briefly unreachable), don't re-attempt a blocking connect inside
+#: EVERY ref construction — and never fail silently.
+_bind_failed_at = 0.0
+_BIND_RETRY_S = 5.0
+
 
 class ObjectRef:
     __slots__ = ("_id", "_owner_hint", "_registered", "__weakref__")
@@ -24,8 +30,29 @@ class ObjectRef:
         # value when the count hits zero.
         self._registered = False
         try:
-            from ray_tpu._private.worker import global_worker
-            runtime = getattr(global_worker, "_runtime", None)
+            from ray_tpu._private import worker as _worker
+            runtime = getattr(_worker.global_worker, "_runtime", None)
+            if runtime is None and \
+                    _worker._client_context_address() is not None:
+                # Daemon/worker context with no runtime bound yet:
+                # deserializing a ref IS the borrow moment — without
+                # binding (and sending ref_add), the creator's session
+                # closing would free an object this process still
+                # holds (reference: borrower registration on
+                # deserialization, reference_count.h borrowed_refs).
+                import time as _time
+                global _bind_failed_at
+                if _time.monotonic() - _bind_failed_at >= _BIND_RETRY_S:
+                    try:
+                        runtime = _worker.global_worker.runtime
+                    except Exception:  # noqa: BLE001 - head unreachable
+                        _bind_failed_at = _time.monotonic()
+                        import logging
+                        logging.getLogger("ray_tpu").warning(
+                            "could not bind the client runtime to "
+                            "register a borrowed ref %s — its borrow is "
+                            "NOT tracked until a later API call binds",
+                            object_id.hex()[:16])
             if runtime is not None:
                 runtime.refs.add_local(object_id)
                 self._registered = True

@@ -619,6 +619,35 @@ class Runtime:
         self.refs.add_owned(oid)
         return ObjectRef(oid)
 
+    def register_remote_put(self, node_id: NodeID, key: str,
+                            size: int, adopt: bool) -> ObjectRef:
+        """Distributed-ownership put: the VALUE already sits in
+        ``node_id``'s object table (written by daemon- or worker-side
+        user code); the head records only the DIRECTORY entry and mints
+        the ref (reference: owner-is-creator, reference_count.h:61 —
+        the creating node serves the bytes; losing that node loses the
+        object, exactly the reference's owner-failure model). ``adopt``
+        asks the daemon to take bookkeeping ownership first (worker-
+        process writers bypass the daemon's table accounting)."""
+        conn = self._remote_nodes.get(node_id)
+        if conn is None:
+            raise KeyError(f"node {node_id.hex()[:12]} is not connected")
+        if adopt and not conn.adopt_object(key, size):
+            raise KeyError(
+                f"object {key} no longer resident on "
+                f"{node_id.hex()[:12]} (evicted before adoption)")
+        with self._lock:
+            self._put_index += 1
+            idx = self._put_index
+        oid = ObjectID.for_put(TaskID.for_normal_task(self.job_id), idx)
+        from ray_tpu._private.multinode import RemoteValueStub
+        stub = RemoteValueStub(conn, key, size)
+        with self._lock:
+            self._remote_values[oid] = (node_id, key)
+        self.store.put_remote(oid, stub.fetch, size)
+        self.refs.add_owned(oid)
+        return ObjectRef(oid)
+
     def get(self, refs: List[ObjectRef], timeout: Optional[float]) -> List[Any]:
         import time as _time
         deadline = None if timeout is None else _time.monotonic() + timeout

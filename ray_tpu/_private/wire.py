@@ -1,0 +1,166 @@
+"""Typed wire schema for the head↔daemon control channel (phase 1).
+
+Analog of the reference's proto contract (src/ray/protobuf/
+node_manager.proto:352 + core_worker.proto): every control message has a
+declared type with a field schema, and peers perform a PROTOCOL VERSION
+handshake at registration — a daemon from a different release is
+rejected with a clear error instead of failing later with an opaque
+unpickling or KeyError deep inside a handler. Pickle remains the
+ENVELOPE (this runtime's frames are cloudpickle dicts) and user
+payloads stay opaque bytes; what this module adds is the versioned,
+validated CONTRACT for the control fields around them.
+
+Raising the version: bump PROTOCOL_VERSION whenever a message type is
+added/removed or a field changes meaning. Additive OPTIONAL fields may
+keep the version (old peers ignore unknown fields; validation here
+accepts extras for exactly that reason).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+#: Bump on any incompatible control-channel change (see module doc).
+PROTOCOL_VERSION = 1
+
+
+class WireSchemaError(ValueError):
+    """A control message does not match its declared schema."""
+
+
+_ANY = object()  # payload fields: opaque, any type
+_STR = (str,)
+_INT = (int,)
+_NUM = (int, float)
+_BOOL = (bool,)
+_BYTES = (bytes,)
+_DICT = (dict,)
+_LIST = (list, tuple)
+_OPT_STR = (str, type(None))
+_OPT_BYTES = (bytes, type(None))
+
+#: type name -> {field: (allowed types | _ANY, required)}. Extra fields
+#: are ALLOWED (additive evolution); wrong types and missing required
+#: fields are not.
+SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
+    # -- session establishment -----------------------------------------
+    "register": {
+        "protocol": (_INT, True),
+        "resources": (_DICT, True),
+        "labels": ((dict, type(None)), False),
+        "object_addr": (_LIST, False),
+        "store_name": (_OPT_STR, False),
+        "resident_actors": (_LIST, False),
+    },
+    "registered": {"node_id": (_STR, True)},
+    "register_rejected": {"error": (_STR, True),
+                          "head_protocol": (_INT, True)},
+    "health_channel": {"node_id": (_STR, True)},
+    "client_runtime": {},  # fields owned by client_runtime.py
+    "client_registered": {"job_id": (_STR, True),
+                          "session_id": (_STR, True)},
+    # -- task / actor execution (head -> daemon) -----------------------
+    "execute_task": {
+        "req_id": (_INT, True),
+        "fn_id": (_BYTES, True),
+        "fn_bytes": (_OPT_BYTES, False),
+        "payload": (_BYTES, True),   # pickled user args: opaque
+        "name": (_STR, False),
+        "task_id": (_STR, False),
+        "runtime_env": ((dict, type(None)), False),
+        "tpu_ids": ((list, tuple, type(None)), False),
+        "num_cpus": (_NUM, False),
+        "store_limit": (_INT, False),
+        "num_returns": (_INT, False),
+        "lease_id": (_STR, False),
+    },
+    "create_actor": {
+        "req_id": (_INT, True),
+        "actor_id": (_STR, True),
+        "fn_id": (_BYTES, True),
+        "fn_bytes": (_OPT_BYTES, False),
+        "payload": (_BYTES, True),
+        "name": (_STR, False),
+        "task_id": (_STR, False),
+        "runtime_env": ((dict, type(None)), False),
+        "tpu_ids": ((list, tuple, type(None)), False),
+    },
+    "actor_call": {
+        "req_id": (_INT, True),
+        "actor_id": (_STR, True),
+        "method": (_STR, True),
+        "payload": (_BYTES, True),
+        "name": (_STR, False),
+        "store_limit": (_INT, False),
+        "num_returns": (_INT, False),
+    },
+    "destroy_actor": {"actor_id": (_STR, True)},
+    # -- object plane (head -> daemon) ---------------------------------
+    "fetch_object": {"req_id": (_INT, True), "key": (_STR, True)},
+    "free_object": {"key": (_STR, True)},
+    "adopt_object": {"req_id": (_INT, True), "key": (_STR, True),
+                     "size": (_INT, True)},
+    # -- leases / control ----------------------------------------------
+    "drop_lease": {"lease_id": (_STR, True)},
+    "spill_lease": {"lease_id": (_STR, True)},
+    "unspill_lease": {"lease_id": (_STR, True)},
+    "stats": {"req_id": (_INT, True)},
+    "profile": {"req_id": (_INT, True), "duration": (_NUM, False),
+                "hz": (_INT, False), "fmt": (_STR, False)},
+    "shutdown": {},
+    # -- liveness ------------------------------------------------------
+    "ping": {"cluster_digest": ((dict, type(None)), False)},
+    "pong": {"sync": (_ANY, False)},
+    # -- internal completion marker (never crosses the wire) -----------
+    "died": {},
+}
+
+
+def validate_message(msg: Dict[str, Any]) -> None:
+    """Validate one control message against its type's schema. Raises
+    WireSchemaError naming the exact field. Reply frames (req_id +
+    ok/value/error, no "type") are validated by shape separately."""
+    mtype = msg.get("type")
+    if mtype is None:
+        # Reply frame: {"req_id": int, "ok": bool, ...}.
+        if "req_id" not in msg:
+            raise WireSchemaError(
+                f"frame has neither type nor req_id: {sorted(msg)}")
+        if not isinstance(msg["req_id"], int):
+            raise WireSchemaError("reply req_id must be int")
+        return
+    spec = SCHEMAS.get(mtype)
+    if spec is None:
+        raise WireSchemaError(
+            f"unknown control message type {mtype!r} (peer from another "
+            f"protocol version? this side speaks v{PROTOCOL_VERSION})")
+    for field, (types, required) in spec.items():
+        if field not in msg:
+            if required:
+                raise WireSchemaError(
+                    f"{mtype}: missing required field {field!r}")
+            continue
+        if types is _ANY:
+            continue
+        value = msg[field]
+        if not isinstance(value, types):
+            raise WireSchemaError(
+                f"{mtype}: field {field!r} must be "
+                f"{'/'.join(t.__name__ for t in types)}, got "
+                f"{type(value).__name__}")
+
+
+class ProtocolMismatch(ConnectionError):
+    """Peer speaks a different control-protocol version."""
+
+
+def check_peer_protocol(peer_version, peer_desc: str) -> None:
+    """Raise ProtocolMismatch with a clear, actionable error when the
+    peer's handshake version differs (reference: the GRPC contract is
+    compiled in; here the handshake carries it explicitly)."""
+    if peer_version != PROTOCOL_VERSION:
+        raise ProtocolMismatch(
+            f"{peer_desc} speaks control protocol "
+            f"v{peer_version if peer_version is not None else '<pre-1>'} "
+            f"but this process speaks v{PROTOCOL_VERSION}; upgrade the "
+            "older side — mixed-version clusters are not supported")

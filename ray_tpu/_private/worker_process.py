@@ -347,18 +347,25 @@ class WorkerProcessPool:
 
     def __init__(self, store_name: Optional[str] = None,
                  max_workers: int = 64,
-                 head_address=None):
+                 head_address=None, node_id_hex: Optional[str] = None):
         self.store_name = store_name
         self.max_workers = max_workers
         # Workers inherit the head address so nested ray_tpu API calls in
         # user code bind a ClientRuntime wired to the head (the connected-
         # runtime property; _private/client_runtime.py) instead of
-        # auto-initializing an isolated split-brain runtime.
+        # auto-initializing an isolated split-brain runtime. The node id
+        # lets worker-side puts register THIS node as the bytes' owner
+        # (distributed ownership; stale after a head restart, in which
+        # case registration fails and puts fall back to head-stored).
         self._env_overrides: Optional[Dict[str, str]] = None
+        overrides = {}
         if head_address is not None:
             host, port = tuple(head_address)
-            self._env_overrides = {
-                "RAY_TPU_HEAD_ADDRESS": f"{host}:{port}"}
+            overrides["RAY_TPU_HEAD_ADDRESS"] = f"{host}:{port}"
+        if node_id_hex:
+            overrides["RAY_TPU_NODE_ID"] = node_id_hex
+        if overrides:
+            self._env_overrides = overrides
         self._idle: Dict[str, list] = {}
         self._all: list = []
         self._lock = threading.Lock()
@@ -537,8 +544,16 @@ class ProcessActorInstance:
 # ---------------------------------------------------------------------------
 
 
+#: The _WorkerMain serving THIS worker process (None elsewhere): lets
+#: the client runtime reach the shared shm arena for node-resident puts
+#: (distributed ownership — client_runtime._put_node_resident).
+_current_executor: Optional["_WorkerMain"] = None
+
+
 class _WorkerMain:
     def __init__(self, sock: socket.socket, store_name: Optional[str]):
+        global _current_executor
+        _current_executor = self
         self.sock = sock
         self.store_name = store_name
         self._arena = None

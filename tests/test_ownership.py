@@ -1,0 +1,178 @@
+"""Distributed ownership (phase 2): node-resident puts + borrowing.
+
+Big values created by daemon/worker-side user code STAY on the creating
+node (the head holds only a directory entry); refs survive the death of
+the SESSION that created/observed them as long as some holder remains,
+and a borrower on a third node keeps an object alive after the creator's
+session closes (reference: owner-is-creator + borrowing protocol,
+src/ray/core_worker/reference_count.h:61)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _spawn_daemon(port, *, num_cpus=2, resources=None):
+    cmd = [sys.executable, "-m", "ray_tpu._private.multinode",
+           "--address", f"127.0.0.1:{port}",
+           "--num-cpus", str(num_cpus)]
+    if resources:
+        cmd += ["--resources", json.dumps(resources)]
+    return subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def _wait_for_resource(name, amount, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if ray_tpu.cluster_resources().get(name, 0) >= amount:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"resource {name} never appeared")
+
+
+@pytest.fixture
+def ab_daemons(ray_start_regular):
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    pa = _spawn_daemon(port, resources={"site_a": 10})
+    pb = _spawn_daemon(port, resources={"site_b": 10})
+    try:
+        _wait_for_resource("site_a", 10)
+        _wait_for_resource("site_b", 10)
+        yield
+    finally:
+        for p in (pa, pb):
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=10)
+
+
+def _head_runtime():
+    return ray_tpu._private.worker.global_worker.runtime
+
+
+def test_worker_put_stays_node_resident(ab_daemons):
+    """A big worker-side put never ships its bytes through the head:
+    the head records a directory entry pointing at the creating node."""
+    @ray_tpu.remote(resources={"site_a": 1},
+                    runtime_env={"worker_process": True})
+    def producer():
+        import ray_tpu as rt
+        return rt.put(np.arange(1 << 18, dtype=np.int64))  # 2MB
+
+    ref = ray_tpu.get(producer.remote(), timeout=60)
+    rt = _head_runtime()
+    with rt._lock:
+        assert ref.object_id() in rt._remote_values, (
+            "worker put was head-stored, not node-resident")
+    arr = ray_tpu.get(ref, timeout=60)
+    assert int(arr[-1]) == (1 << 18) - 1
+
+
+def test_ref_outlives_creating_session(ab_daemons):
+    """The ref survives the death of the worker process (client session)
+    that created it: the NODE owns the bytes, the driver's handle holds
+    the refcount — killing the observer/creator session must not free
+    or lose the object."""
+    @ray_tpu.remote(resources={"site_a": 1},
+                    runtime_env={"worker_process": True})
+    def producer():
+        import os
+
+        import ray_tpu as rt
+        ref = rt.put(np.full(1 << 18, 7, dtype=np.int64))
+        return ref, os.getpid()
+
+    ref, pid = ray_tpu.get(producer.remote(), timeout=60)
+    assert pid != os.getpid()
+    os.kill(pid, signal.SIGKILL)  # creator session dies abruptly
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+            time.sleep(0.1)
+        except ProcessLookupError:
+            break
+    time.sleep(1.0)  # session teardown + pin drops settle
+    arr = ray_tpu.get(ref, timeout=60)
+    assert int(arr[0]) == 7 and arr.shape == (1 << 18,)
+
+
+def test_borrower_keeps_object_alive_after_creator_closes(ab_daemons):
+    """Borrowing across nodes: worker on A creates the object and hands
+    the REF (not the value) to an actor on B; the creator worker is
+    killed and the driver never holds a handle — B's borrow must keep
+    the object alive and readable."""
+    @ray_tpu.remote(resources={"site_b": 1})
+    class Holder:
+        def __init__(self):
+            self.box = None
+
+        def hold(self, box):
+            self.box = box  # [ref] — borrow registered on deserialize
+            return True
+
+        def read(self):
+            import ray_tpu as rt
+            (ref,) = self.box
+            arr = rt.get(ref, timeout=60)
+            return int(arr[0]), int(arr.shape[0])
+
+    holder = Holder.options(name="holder", lifetime="detached").remote()
+
+    @ray_tpu.remote(resources={"site_a": 1},
+                    runtime_env={"worker_process": True})
+    def producer():
+        import os
+
+        import ray_tpu as rt
+        ref = rt.put(np.full(1 << 18, 42, dtype=np.int64))
+        h = rt.get_actor("holder")
+        rt.get(h.hold.remote([ref]))  # ref inside a container: no deref
+        return os.getpid()
+
+    pid = ray_tpu.get(producer.remote(), timeout=60)
+    os.kill(pid, signal.SIGKILL)  # creator session gone
+    time.sleep(1.5)  # teardown + ref notices settle
+    value, length = ray_tpu.get(holder.read.remote(), timeout=60)
+    assert (value, length) == (42, 1 << 18)
+    ray_tpu.kill(holder)
+
+
+def test_in_daemon_put_is_node_resident(ab_daemons):
+    """Same property for in-daemon execution contexts (no worker
+    subprocess): the daemon's own table holds the bytes."""
+    @ray_tpu.remote(resources={"site_b": 1},
+                    runtime_env={"worker_process": False})
+    def producer():
+        import ray_tpu as rt
+        return rt.put(b"\xcd" * (2 << 20))
+
+    ref = ray_tpu.get(producer.remote(), timeout=60)
+    rt = _head_runtime()
+    with rt._lock:
+        assert ref.object_id() in rt._remote_values
+    assert ray_tpu.get(ref, timeout=60) == b"\xcd" * (2 << 20)
+
+
+def test_small_puts_stay_inline(ab_daemons):
+    """Below the node-resident threshold, puts ship inline to the head
+    (a directory round trip per tiny object would be pure overhead)."""
+    @ray_tpu.remote(resources={"site_a": 1})
+    def producer():
+        import ray_tpu as rt
+        return rt.put({"small": 1})
+
+    ref = ray_tpu.get(producer.remote(), timeout=60)
+    rt = _head_runtime()
+    with rt._lock:
+        assert ref.object_id() not in rt._remote_values
+    assert ray_tpu.get(ref, timeout=60) == {"small": 1}
