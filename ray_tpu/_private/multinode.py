@@ -13,15 +13,22 @@ connection death standing in for raylet health-check failure
 `Runtime.remove_node`, which drives the existing retry / actor-restart /
 lineage-reconstruction machinery.
 
-Execution model ("remote call proxy"): scheduling, refcounting, retries,
-and result ownership all stay on the head; only the *user-code call*
-(`fn(*args)`, `cls(*args)`, `instance.method(*args)`) crosses the wire.
-A head worker thread blocks on the RPC while the daemon burns its own
-CPUs — so a task scheduled onto a remote node consumes that node's
-resources, exactly like a leased worker in the reference. Results return
-inline in the reply (the reference's small-result path,
-core_worker.cc PushTaskReply); daemon-resident big-object storage is the
-chunked ObjectManager pull, out of scope for this layer.
+Execution model: scheduling, retries, and the object DIRECTORY stay on
+the head; only the *user-code call* (`fn(*args)`, `cls(*args)`,
+`instance.method(*args)`) crosses the wire. Normal tasks dispatch
+ASYNC — `execute_task_async` + per-connection completion drainers, no
+head thread parked per in-flight call (reference: callback-driven
+direct task transport) — and same-class tasks stream onto worker
+LEASES whose daemon-side serial executors order execution locally
+(one accounted acquisition ↔ one running task; blocked nested gets
+spill/unspill the queue). Actor calls hold one head executor thread
+per actor-concurrency slot — the ordering authority, mirroring the
+reference's one-worker-per-actor model; thread count scales with
+actors, never with queued tasks (1M queued tasks = 3 threads,
+tests/test_core.py deep-queue envelope). Small results return inline
+in the reply (core_worker.cc PushTaskReply); big results stay
+daemon-resident and travel the chunked data plane (dataplane.py), as
+do node-resident distributed-ownership puts.
 
 Daemons run actors too: the instance lives in the daemon process
 (constructed there), and the head-side actor executor proxies each method

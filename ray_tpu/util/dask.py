@@ -1,0 +1,193 @@
+"""Dask-on-ray_tpu scheduler (reference: python/ray/util/dask/scheduler.py
+ray_dask_get): execute a dask task graph on the cluster by mapping every
+graph task to a ray_tpu task, with inter-task data passed as ObjectRefs
+(no materialization through the driver between stages).
+
+Dask graphs are PLAIN DICTS — ``{key: task}`` where a task is
+``(callable, arg1, ...)``, keys may be strings OR tuples like
+``('chunk', 0)`` (every dask collection uses tuple keys), values may be
+lists of computations, and args may be keys, nested tasks, nested
+lists, or literals (the "dask graph protocol"; dask/core.py). That
+protocol needs nothing from dask itself, so this scheduler works
+standalone and plugs into real dask as::
+
+    import dask
+
+    dask.config.set(scheduler=ray_tpu.util.dask.ray_dask_get)
+    df.sum().compute()          # dask collections now run on the cluster
+
+Each graph task becomes ONE ray_tpu task whose args are the ObjectRefs
+of its dependencies — the scheduler builds the whole task DAG up front
+and lets the runtime's dependency resolution drive execution order
+(maximal parallelism, zero driver-side barriers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Hashable, List
+
+import ray_tpu
+
+__all__ = ["ray_dask_get", "is_dask_task"]
+
+
+def is_dask_task(value: Any) -> bool:
+    """A dask-protocol task: a tuple whose head is callable."""
+    return isinstance(value, tuple) and bool(value) and callable(value[0])
+
+
+def _is_key(value: Any, dsk: Dict[Hashable, Any]) -> bool:
+    """Keys are any HASHABLE graph members — strings or tuples like
+    ``('chunk-name', 0)`` (membership first: a tuple arg that matches a
+    key is a reference, per dask.core.get semantics)."""
+    try:
+        return value in dsk
+    except TypeError:
+        return False  # unhashable (e.g. list): never a key
+
+
+def _execute_task(func, *resolved):
+    """Worker-side shim: top-level ObjectRef args arrive already
+    materialized (the runtime resolves dependencies); nested ref lists
+    ride a _ListResolver and materialize here."""
+    resolved = [a.resolve() if isinstance(a, _ListResolver) else a
+                for a in resolved]
+    return func(*resolved)
+
+
+def _rebuild(arg: Any, refs: Dict[Hashable, Any], dsk) -> Any:
+    """Substitute keys with their (ref) results; recurse into lists
+    (dask nests args in lists) and INLINE nested tasks. Key membership
+    is checked BEFORE task-shape: ``('x', 1)`` could be both."""
+    if _is_key(arg, dsk):
+        return refs[arg]
+    if is_dask_task(arg):
+        # Inline task (dask emits these for cheap ops): execute its
+        # callable with recursively rebuilt args — but any ref among
+        # them must materialize first, so resolve driver-side.
+        func = arg[0]
+        sub = [_rebuild(a, refs, dsk) for a in arg[1:]]
+        sub = [ray_tpu.get(s) if isinstance(s, ray_tpu.ObjectRef) else s
+               for s in sub]
+        return func(*sub)
+    if isinstance(arg, list):
+        return [_rebuild(a, refs, dsk) for a in arg]
+    return arg
+
+
+def ray_dask_get(dsk: Dict[Hashable, Any], keys, **_kwargs):
+    """The dask ``get`` entry point (reference: scheduler.py:42
+    ray_dask_get): submit every graph task as a ray_tpu task (deps as
+    refs), then materialize ``keys``. ``keys`` may be a single key or
+    arbitrarily nested lists of keys (dask collection protocol)."""
+    refs: Dict[Hashable, Any] = {}
+
+    remote_exec = ray_tpu.remote(_execute_task)
+    for key in toposort(dsk):
+        task = dsk[key]
+        if _is_key(task, dsk):
+            refs[key] = refs[task]  # alias entry
+        elif is_dask_task(task):
+            func = task[0]
+            args = [_rebuild(a, refs, dsk) for a in task[1:]]
+            # Nested lists of refs must materialize worker-side; the
+            # runtime only auto-resolves TOP-LEVEL ref args. Wrap lists
+            # in a resolver task argument.
+            args = [_ListResolver(a)
+                    if isinstance(a, list) and _contains_ref_deep(a)
+                    else a for a in args]
+            refs[key] = remote_exec.remote(func, *args)
+        elif isinstance(task, list):
+            # List VALUE = list of computations (dask graph spec).
+            refs[key] = _rebuild(task, refs, dsk)
+        else:
+            refs[key] = task  # literal
+
+    def walk(v):
+        if isinstance(v, ray_tpu.ObjectRef):
+            return ray_tpu.get(v)
+        if isinstance(v, list):
+            return [walk(x) for x in v]
+        return v
+
+    def materialize(k):
+        if isinstance(k, list):
+            return [materialize(x) for x in k]
+        return walk(refs[k])
+
+    return materialize(keys)
+
+
+def toposort(dsk: Dict[Hashable, Any]) -> List[Hashable]:
+    """Dependency order over the graph's keys. Iterative DFS — real
+    dask workloads chain thousands of tasks, far past the recursion
+    limit. Cycles raise ValueError."""
+    deps: Dict[Hashable, List[Hashable]] = {}
+
+    def find(value, out):
+        if _is_key(value, dsk):
+            out.append(value)
+            return
+        if isinstance(value, (tuple, list)):
+            items = value[1:] if is_dask_task(value) else value
+            for v in items:
+                find(v, out)
+
+    for key, task in dsk.items():
+        out: List[Hashable] = []
+        find(task, out)
+        deps[key] = out
+
+    order: List[Hashable] = []
+    done: set = set()
+    in_progress: set = set()
+    for root in dsk:
+        if root in done:
+            continue
+        stack: List[tuple] = [(root, iter(deps[root]))]
+        in_progress.add(root)
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for child in it:
+                if child in done:
+                    continue
+                if child in in_progress:
+                    raise ValueError(
+                        f"dask graph has a cycle through {child!r}")
+                in_progress.add(child)
+                stack.append((child, iter(deps[child])))
+                advanced = True
+                break
+            if not advanced:
+                stack.pop()
+                in_progress.discard(node)
+                done.add(node)
+                order.append(node)
+    return order
+
+
+class _ListResolver:
+    """Arg wrapper: a nested list containing ObjectRefs. The runtime
+    passes it through opaquely; _execute_task resolves it worker-side
+    (connected runtime: get works from any execution context)."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def resolve(self):
+        def walk(v):
+            if isinstance(v, _ListResolver):
+                return v.resolve()
+            if isinstance(v, list):
+                return [walk(x) for x in v]
+            if isinstance(v, ray_tpu.ObjectRef):
+                return ray_tpu.get(v)
+            return v
+        return walk(self.value)
+
+
+def _contains_ref_deep(value: Any) -> bool:
+    if isinstance(value, list):
+        return any(_contains_ref_deep(v) for v in value)
+    return isinstance(value, ray_tpu.ObjectRef)
