@@ -38,6 +38,7 @@ NotifyDirectCallTaskBlocked → raylet resource release).
 from __future__ import annotations
 
 import collections
+import contextlib
 import hashlib
 import logging
 import os
@@ -48,6 +49,7 @@ import types
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import serialization
+from ray_tpu._private import wire as _wire
 from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,
                                   PlacementGroupID, TaskID)
 from ray_tpu._private.multinode import (_dumps, _loads, _recv_frame,
@@ -93,9 +95,14 @@ class ClientConnection:
         self._counter = 0
         self.closed = False
         _send_frame(self._sock, _dumps({"type": "client_runtime",
+                                        "protocol": _wire.PROTOCOL_VERSION,
                                         "pid": os.getpid()}),
                     self._send_lock)
         self.hello = _loads(_recv_frame(self._sock))
+        if self.hello.get("type") == "register_rejected":
+            with contextlib.suppress(OSError):
+                self._sock.close()  # no recv thread exists to close it
+            raise _wire.ProtocolMismatch(self.hello["error"])
         assert self.hello.get("type") == "client_registered", self.hello
         self._recv_thread = threading.Thread(
             target=self._recv_loop, name="ray_tpu-client-recv", daemon=True)
@@ -699,6 +706,10 @@ class ClientSession:
             pass  # client gone; close() runs from the serve loop
 
     def _dispatch(self, msg: dict) -> dict:
+        # Schema check BEFORE dispatch (wire.py CLIENT_SCHEMAS): a
+        # drifted op fails with the exact field name as a normal error
+        # reply, never a KeyError inside a handler.
+        _wire.validate_client_op(msg)
         op = msg["op"]
         rt = self.runtime
         if op == "submit_task":

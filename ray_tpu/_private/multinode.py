@@ -756,7 +756,22 @@ class HeadServer:
                 # A daemon/worker-side user-code process binding a
                 # connected runtime (client_runtime.py) — the anti-
                 # split-brain surface: nested submits, named actors,
-                # refs all resolve against THIS head.
+                # refs all resolve against THIS head. Same version
+                # handshake as daemons: a client from another release
+                # is told exactly why it cannot join.
+                try:
+                    _wire.check_peer_protocol(
+                        register.get("protocol"),
+                        f"client runtime at {addr}")
+                except _wire.ProtocolMismatch as exc:
+                    logger.error("rejecting client runtime: %s", exc)
+                    with contextlib.suppress(OSError):
+                        _send_frame(sock, _dumps({
+                            "type": "register_rejected",
+                            "error": str(exc),
+                            "head_protocol": _wire.PROTOCOL_VERSION}))
+                    sock.close()
+                    return
                 from ray_tpu._private.client_runtime import ClientSession
                 from ray_tpu._private.worker import global_worker as _gw
                 session = ClientSession(

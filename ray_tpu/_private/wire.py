@@ -134,20 +134,81 @@ def validate_message(msg: Dict[str, Any]) -> None:
         raise WireSchemaError(
             f"unknown control message type {mtype!r} (peer from another "
             f"protocol version? this side speaks v{PROTOCOL_VERSION})")
+    _validate_fields(spec, msg, str(mtype))
+
+
+def _validate_fields(spec, msg, label: str) -> None:
+    """One rule set for BOTH channels — required fields, type checks,
+    extras allowed (additive evolution)."""
     for field, (types, required) in spec.items():
         if field not in msg:
             if required:
                 raise WireSchemaError(
-                    f"{mtype}: missing required field {field!r}")
+                    f"{label}: missing required field {field!r}")
             continue
         if types is _ANY:
             continue
         value = msg[field]
         if not isinstance(value, types):
             raise WireSchemaError(
-                f"{mtype}: field {field!r} must be "
+                f"{label}: field {field!r} must be "
                 f"{'/'.join(t.__name__ for t in types)}, got "
                 f"{type(value).__name__}")
+
+
+#: Client-channel op schemas (the ClientRuntime <-> ClientSession
+#: surface): op name -> {field: (types, required)}. Validated server-
+#: side before dispatch — a drifted client op fails with the exact
+#: field name, not a KeyError inside a handler. Extra fields allowed
+#: (additive evolution), user payloads stay opaque bytes.
+CLIENT_SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
+    "submit_task": {"spec": (_BYTES, True)},
+    "submit_actor_task": {"spec": (_BYTES, True)},
+    "create_actor": {"spec": (_BYTES, True), "opts": (_DICT, True)},
+    "actor_info": {"actor_id": (_STR, True)},
+    "get_named_actor": {"name": (_STR, True), "namespace": (_STR, True)},
+    "kill_actor": {"actor_id": (_STR, True), "no_restart": (_BOOL, True)},
+    "cancel": {"ref": (_STR, True), "force": (_BOOL, True)},
+    "reg_fn": {"payload": (_BYTES, True)},
+    "fn_bytes": {"fn_id": (_BYTES, True)},
+    "put": {"payload": (_BYTES, True)},
+    "put_remote": {"node": (_STR, True), "key": (_STR, True),
+                   "size": (_INT, True), "adopt": (_BOOL, False)},
+    "get": {"refs": (_LIST, True),
+            "timeout": ((int, float, type(None)), False),
+            "holding_task": (_OPT_STR, False)},
+    "wait": {"refs": (_LIST, True), "num_returns": (_INT, True),
+             "timeout": ((int, float, type(None)), False)},
+    "contains": {"ref": (_STR, True)},
+    "free": {"refs": (_LIST, True)},
+    "cluster_resources": {},
+    "available_resources": {},
+    "nodes": {},
+    "pg_exists": {"pg_id": (_STR, True)},
+    "create_pg": {"bundles": (_LIST, True), "strategy": (_STR, True),
+                  "name": (_STR, True)},
+    "remove_pg": {"pg_id": (_STR, True)},
+    "task_events": {},
+    "kv_put": {"ns": (_ANY, True), "key": (_ANY, True),
+               "value": (_ANY, True), "overwrite": (_BOOL, True)},
+    "kv_get": {"ns": (_ANY, True), "key": (_ANY, True)},
+    "kv_del": {"ns": (_ANY, True), "key": (_ANY, True)},
+    "kv_keys": {"ns": (_ANY, True), "prefix": (_ANY, False)},
+    "ping": {},
+    "ref_add": {"ref": (_STR, True)},
+    "ref_del": {"ref": (_STR, True)},
+}
+
+
+def validate_client_op(msg: Dict[str, Any]) -> None:
+    """Validate one client-channel request against its op's schema."""
+    op = msg.get("op")
+    spec = CLIENT_SCHEMAS.get(op)
+    if spec is None:
+        raise WireSchemaError(
+            f"unknown client op {op!r} (peer from another protocol "
+            f"version? this side speaks v{PROTOCOL_VERSION})")
+    _validate_fields(spec, msg, f"client op {op}")
 
 
 class ProtocolMismatch(ConnectionError):

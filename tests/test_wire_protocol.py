@@ -106,3 +106,54 @@ run_node("127.0.0.1:{port}", num_cpus=1)
     finally:
         good.kill()
         good.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# Client-channel schemas
+# ---------------------------------------------------------------------------
+
+
+def test_client_op_schemas_cover_every_dispatched_op():
+    """CLIENT_SCHEMAS and ClientSession._dispatch must not drift: every
+    op the session dispatches has a schema and vice versa."""
+    import re
+
+    import ray_tpu._private.client_runtime as cr
+    from ray_tpu._private.wire import CLIENT_SCHEMAS
+    src = open(cr.__file__).read()
+    dispatched = set(re.findall(r'op == "([a-z_]+)"', src))
+    extra_notice_ops = {"ref_add", "ref_del"}
+    missing = dispatched - set(CLIENT_SCHEMAS)
+    assert not missing, f"ops without schemas: {sorted(missing)}"
+    unknown = set(CLIENT_SCHEMAS) - dispatched - extra_notice_ops
+    assert not unknown, f"schemas for undispatched ops: {sorted(unknown)}"
+
+
+def test_client_op_validation():
+    from ray_tpu._private.wire import validate_client_op
+    validate_client_op({"op": "get", "refs": ["ab"], "timeout": None})
+    with pytest.raises(WireSchemaError, match="num_returns"):
+        validate_client_op({"op": "wait", "refs": []})
+    with pytest.raises(WireSchemaError, match="unknown client op"):
+        validate_client_op({"op": "future_op"})
+
+
+def test_version_mismatched_client_runtime_rejected(ray_start_regular):
+    """A daemon/worker from another release binding a client runtime is
+    rejected at the handshake with the head's words."""
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    script = f"""
+import ray_tpu._private.wire as wire
+wire.PROTOCOL_VERSION = 777
+from ray_tpu._private.client_runtime import ClientConnection
+try:
+    ClientConnection(("127.0.0.1", {port}))
+except wire.ProtocolMismatch as exc:
+    print("REJECTED:", exc)
+    raise SystemExit(0)
+raise SystemExit("mismatch accepted")
+"""
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert "v777" in proc.stdout and "upgrade" in proc.stdout
