@@ -303,10 +303,29 @@ class NodeObjectTable:
                         # The caller still gets the bytes — the read
                         # legitimately raced the free.
                         freed_meanwhile = key not in self._sizes
-                    if freed_meanwhile:
-                        self._arena.delete(key)
+                    if freed_meanwhile and not self._arena.delete(key):
+                        # Another reader's pin blocked the delete: doom
+                        # the zombie so the next spill pass retires it
+                        # (else it sits in the no-evict arena forever).
+                        # Doom + liveness check in ONE lock block (as
+                        # free() does): a put() may have revived the key
+                        # since we sampled freed_meanwhile, and a stale
+                        # doomed marker would destroy the live payload.
+                        with self._lock:
+                            if key not in self._sizes:
+                                self._doomed.add(key)
                     with contextlib.suppress(OSError):
                         os.unlink(path)
+                else:
+                    # A pressure pass re-spilled our promoted copy and
+                    # its registration is authoritative — but if it
+                    # wrote a NEW file, the one we read from is now an
+                    # orphan nobody will ever unlink.
+                    with self._lock:
+                        rec_now = self._spilled.get(key)
+                    if rec_now is not None and rec_now[0] != path:
+                        with contextlib.suppress(OSError):
+                            os.unlink(path)
         return data
 
     @property

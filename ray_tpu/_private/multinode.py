@@ -47,6 +47,7 @@ import threading
 import traceback
 from typing import Any, Dict, Optional, Tuple
 
+from ray_tpu._private import procinfo
 from ray_tpu._private import wire as _wire
 
 logger = logging.getLogger(__name__)
@@ -1023,7 +1024,7 @@ def _reap_stale_spill_dirs(parent: str) -> None:
             pid = int(fname.rsplit("_", 1)[1])
         except ValueError:
             continue
-        if pid == os.getpid() or os.path.exists(f"/proc/{pid}"):
+        if pid == os.getpid() or procinfo.pid_alive(pid):
             continue
         shutil.rmtree(os.path.join(parent, fname), ignore_errors=True)
 

@@ -1,0 +1,63 @@
+"""Portable process-liveness probes.
+
+Housekeeping paths (spill-dir reaping, container reaping) must decide
+whether some *other* process is alive. ``/proc/<pid>`` existence is
+Linux-only — on macOS/BSD every pid looks dead, which would rmtree a
+live daemon's spill directory. ``kill(pid, 0)`` is POSIX-portable.
+
+Pid reuse is the second hazard: a recycled pid makes an orphan look
+alive forever. ``start_token`` captures the process start time (Linux
+``/proc/<pid>/stat`` field 22, in clock ticks since boot) so a
+(pid, token) pair uniquely names one process incarnation. Where the
+token is unavailable the callers degrade to liveness-only.
+
+Reference: ray uses pid+start-time pairs for the same reason in its
+worker-liveness checks (src/ray/util/process.h).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def pid_alive(pid: int) -> bool:
+    """True if a process with this pid exists (portable: signal 0)."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    except OSError:
+        return False
+    return True
+
+
+def start_token(pid: int) -> Optional[int]:
+    """Start-time token for pid-recycling detection; None if unknown.
+
+    Field 22 of /proc/<pid>/stat is counted after the final ')' because
+    the comm field (2) may itself contain spaces or parentheses.
+    """
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            data = f.read()
+        rest = data.rsplit(b")", 1)[1].split()
+        return int(rest[19])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def same_process(pid: int, token: Optional[int]) -> bool:
+    """True iff pid is alive AND (when a token is known for both sides)
+    it is the same incarnation that minted the token."""
+    if not pid_alive(pid):
+        return False
+    if token is None:
+        return True  # no token recorded: liveness is all we can check
+    current = start_token(pid)
+    if current is None:
+        return True  # no /proc here: cannot disprove, assume same
+    return current == token

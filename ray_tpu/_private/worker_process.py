@@ -42,6 +42,8 @@ import traceback
 import uuid
 from typing import Any, Dict, Optional
 
+from ray_tpu._private import procinfo
+
 logger = logging.getLogger(__name__)
 
 
@@ -267,7 +269,11 @@ def _spawn_container_worker(store_name: Optional[str],
     cid_dir = os.path.join(tempfile.gettempdir(), "ray_tpu_containers")
     os.makedirs(cid_dir, exist_ok=True)
     _reap_stale_containers_once(engine, cid_dir)
-    cidfile = os.path.join(cid_dir, f"{os.getpid()}-{uuid.uuid4().hex}.cid")
+    token = procinfo.start_token(os.getpid())
+    cidfile = os.path.join(
+        cid_dir,
+        f"{os.getpid()}.{token if token is not None else ''}"
+        f"-{uuid.uuid4().hex}.cid")
     cmd = [engine, "run", "--rm", "-i", "--init", "--network=host",
            "--cidfile", cidfile,
            "-v", "/dev/shm:/dev/shm"]
@@ -324,8 +330,19 @@ def _reap_stale_containers(engine: str, cid_dir: str) -> None:
             continue
         path = os.path.join(cid_dir, fname)
         try:
-            spawner_pid = int(fname.split("-", 1)[0])
-            if os.path.exists(f"/proc/{spawner_pid}"):
+            ident = fname.split("-", 1)[0]
+            # "<pid>.<start_token>" since r5; bare "<pid>" from older
+            # daemons. The token defeats pid recycling: an unrelated
+            # live process that inherited the pid must not keep an
+            # orphaned container alive forever.
+            spawner_token = None
+            if "." in ident:
+                pid_s, tok_s = ident.split(".", 1)
+                spawner_pid = int(pid_s)
+                spawner_token = int(tok_s) if tok_s else None
+            else:
+                spawner_pid = int(ident)
+            if procinfo.same_process(spawner_pid, spawner_token):
                 continue  # spawner alive: its container is legitimate
             with open(path) as f:
                 cid = f.read().strip()

@@ -27,6 +27,7 @@ uses)::
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List
 
 from ray_tpu.autoscaler.node_provider import (NODE_KIND_HEAD,
@@ -34,6 +35,8 @@ from ray_tpu.autoscaler.node_provider import (NODE_KIND_HEAD,
                                               TAG_RAY_NODE_KIND,
                                               TAG_RAY_NODE_STATUS,
                                               TAG_RAY_USER_NODE_TYPE)
+
+logger = logging.getLogger(__name__)
 
 
 def load_cluster_config(path: str) -> Dict[str, Any]:
@@ -82,6 +85,19 @@ def _bootstrap_nodes(provider, config: Dict[str, Any],
     if not (setup or start or config.get("file_mounts")
             or config.get("initialization_commands")):
         return []  # provider self-joins its nodes (gcp_tpu does)
+    if kind == "worker" and start and not head_address:
+        # Exporting RAY_TPU_HEAD_ADDRESS='' would start workers that
+        # silently never join. Fail the bootstrap loudly instead, and
+        # tag update-failed so the next `up` retries these nodes once
+        # a head exists (the retry filter keys off this tag).
+        logger.error(
+            "worker bootstrap skipped for %s: no head address (set "
+            "provider.head_address or bring up a head first)", node_ids)
+        from ray_tpu.autoscaler.updater import STATUS_UPDATE_FAILED
+        for node_id in node_ids:
+            provider.set_node_tags(
+                node_id, {TAG_RAY_NODE_STATUS: STATUS_UPDATE_FAILED})
+        return list(node_ids)
     from ray_tpu.autoscaler.updater import NodeUpdater, run_updaters
     updaters = [NodeUpdater(
         node_id=node_id, provider=provider,
