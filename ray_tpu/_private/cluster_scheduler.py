@@ -94,8 +94,10 @@ class ClusterResourceScheduler:
     # -- membership -------------------------------------------------------
 
     def add_node(self, resources: Dict[str, float], is_head: bool = False,
-                 labels: Optional[dict] = None) -> NodeID:
-        node_id = NodeID.from_random()
+                 labels: Optional[dict] = None,
+                 node_id: Optional[NodeID] = None) -> NodeID:
+        if node_id is None:
+            node_id = NodeID.from_random()
         resources = dict(resources)
         # Every node advertises its identity resource, like the reference's
         # node:<ip> resource used by NodeAffinity internals.

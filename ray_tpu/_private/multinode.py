@@ -77,6 +77,13 @@ def _send_frame(sock: socket.socket, payload: bytes,
         sock.sendall(data)
 
 
+def _close_quiet(sock: socket.socket) -> None:
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
@@ -102,6 +109,150 @@ def _dumps(obj: Any) -> bytes:
 def _loads(data: bytes) -> Any:
     from ray_tpu._private import serialization
     return serialization.deserialize(data)
+
+
+def _args_are_plain(args, kwargs) -> bool:
+    """True when no top-level arg is a data-plane marker (the only
+    place the head ever puts one — see Runtime._resolve_args)."""
+    from ray_tpu._private.dataplane import ObjectMarker
+    markers = (ObjectMarker, RemoteArgMarker)
+    return not (any(isinstance(a, markers) for a in args)
+                or any(isinstance(v, markers) for v in kwargs.values()))
+
+
+class _CoalescingSender:
+    """Single writer for one control socket. Callers enqueue message
+    dicts; the sender thread writes them, coalescing whatever has
+    accumulated into ONE ``batch_type`` frame (reference: the gRPC
+    transport's stream batching amortizes per-message overhead the same
+    way). Under load this collapses N pickle dumps + N sendall syscalls
+    into one of each; when idle the thread wakes per message and sends
+    it solo, so single-task latency pays nothing.
+
+    All writes for the socket MUST go through this object once it is
+    attached — a direct ``_send_frame`` from another thread would
+    interleave bytes mid-frame. The enqueue lock also serializes
+    ``resolver`` callbacks (fn_bytes shipping decisions), which makes
+    the decide-and-order step atomic across submitting threads.
+    """
+
+    MAX_BATCH = 64            # messages per batch frame
+    SOLO_BYTES = 256 * 1024   # payloads this big travel alone
+    MAX_BATCH_BYTES = 1 << 20  # cumulative payload cap per batch
+    QUEUE_CAP_BYTES = 64 << 20  # backpressure: block senders past this
+
+    def __init__(self, sock: socket.socket, batch_type: str,
+                 on_fail=None, name: str = "sender"):
+        self._sock = sock
+        self._batch_type = batch_type
+        self._on_fail = on_fail
+        from collections import deque
+        self._dq: Any = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._queued_bytes = 0
+        self._sending = False  # a popped batch is being written
+        self._thread = threading.Thread(
+            target=self._run, name=f"ray_tpu-{name}", daemon=True)
+        self._thread.start()
+
+    def send(self, msg: dict, resolver=None, nbytes: int = 0) -> bool:
+        """Enqueue; returns False if the sender is closed. ``resolver``
+        runs under the enqueue lock (may mutate msg, may raise — in
+        which case nothing is enqueued). ``nbytes`` is a payload-size
+        hint for batch splitting and backpressure."""
+        with self._cv:
+            while (self._queued_bytes > self.QUEUE_CAP_BYTES
+                   and not self._closed):
+                self._cv.wait(1.0)
+            if self._closed:
+                return False
+            if resolver is not None:
+                resolver(msg)
+            self._dq.append((msg, nbytes))
+            self._queued_bytes += nbytes
+            self._cv.notify_all()
+        return True
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def flush(self, timeout: float = 1.0) -> None:
+        """Best-effort wait for the queue to drain (shutdown paths)."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        with self._cv:
+            while (self._dq or self._sending) and \
+                    _time.monotonic() < deadline:
+                self._cv.wait(0.05)
+
+    def _pop_batch(self):
+        batch = []
+        total = 0
+        while self._dq and len(batch) < self.MAX_BATCH:
+            msg, nb = self._dq[0]
+            if batch and (nb >= self.SOLO_BYTES
+                          or total + nb > self.MAX_BATCH_BYTES):
+                break
+            self._dq.popleft()
+            self._queued_bytes -= nb
+            batch.append(msg)
+            total += nb
+            if nb >= self.SOLO_BYTES:
+                break
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._dq and not self._closed:
+                    self._cv.wait()
+                if not self._dq:
+                    return  # closed and drained
+                batch = self._pop_batch()
+                self._sending = True
+                self._cv.notify_all()  # backpressured senders re-check
+            try:
+                if len(batch) == 1:
+                    _send_frame(self._sock, _dumps(batch[0]))
+                else:
+                    _send_frame(self._sock, _dumps(
+                        {"type": self._batch_type, "req_id": 0,
+                         "msgs": batch}))
+            except OSError:
+                self._done_sending()
+                self.close()
+                if self._on_fail is not None:
+                    try:
+                        self._on_fail()
+                    except Exception:  # noqa: BLE001 - teardown
+                        logger.exception("sender failure handler")
+                return
+            except Exception:  # noqa: BLE001 - one poisoned msg must
+                # not kill the connection: retry each solo, drop the
+                # one that cannot serialize.
+                for msg in batch:
+                    try:
+                        _send_frame(self._sock, _dumps(msg))
+                    except OSError:
+                        self._done_sending()
+                        self.close()
+                        if self._on_fail is not None:
+                            with contextlib.suppress(Exception):
+                                self._on_fail()
+                        return
+                    except Exception:
+                        logger.exception(
+                            "dropping unserializable control frame %s",
+                            msg.get("type"))
+            self._done_sending()
+
+    def _done_sending(self) -> None:
+        with self._cv:
+            self._sending = False
+            self._cv.notify_all()
 
 
 # ---------------------------------------------------------------------------
@@ -171,6 +322,11 @@ class NodeConnection:
         self._completion_q: "_queue.SimpleQueue" = _queue.SimpleQueue()
         self._completion_thread: Optional[threading.Thread] = None
         self._drainer_dead = False  # guarded by self._lock
+        # Single-writer coalescing sender: every outbound frame for this
+        # daemon goes through it (task submits batch under load).
+        self._sender = _CoalescingSender(
+            sock, "task_batch", on_fail=self.close,
+            name=f"send-{address[1]}")
 
     # -- plumbing --------------------------------------------------------
 
@@ -201,20 +357,23 @@ class NodeConnection:
                 raise RemoteNodeDiedError(
                     f"node {self.address} connection is closed")
             self._pending[req_id] = waiter
+        resolver = None
+        if fn_resolver is not None:
+            def resolver(m, _fr=fn_resolver):
+                m["fn_bytes"] = _fr()
         try:
-            with self._send_lock:
-                if fn_resolver is not None:
-                    msg["fn_bytes"] = fn_resolver()
-                _send_frame(self._sock, _dumps(msg))
-        except OSError as exc:
-            with self._lock:
-                self._pending.pop(req_id, None)
-            raise RemoteNodeDiedError(
-                f"node {self.address} send failed: {exc}") from exc
+            sent = self._sender.send(
+                msg, resolver=resolver,
+                nbytes=len(msg.get("payload") or b""))
         except BaseException:
             with self._lock:
                 self._pending.pop(req_id, None)
             raise
+        if not sent:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise RemoteNodeDiedError(
+                f"node {self.address} connection is closed")
         if not waiter.event.wait(timeout):
             with self._lock:
                 self._pending.pop(req_id, None)
@@ -232,10 +391,7 @@ class NodeConnection:
         the recv loop. Never blocks on the daemon (GC/teardown paths)."""
         msg["req_id"] = 0
         _wire.validate_message(msg)
-        try:
-            _send_frame(self._sock, _dumps(msg), self._send_lock)
-        except OSError:
-            pass  # the daemon (and its state) is gone anyway
+        self._sender.send(msg)  # closed sender: daemon is gone anyway
 
     def recv_loop(self) -> None:
         """Reply pump; runs on a daemon thread owned by HeadServer.
@@ -244,19 +400,28 @@ class NodeConnection:
         dispatch) never stalls the reply stream."""
         try:
             while True:
-                reply = _loads(_recv_frame(self._sock))
-                with self._lock:
-                    waiter = self._pending.pop(reply.get("req_id"), None)
-                if waiter is not None:
-                    waiter.reply = reply
-                    if waiter.callback is not None:
-                        self._dispatch_completion(waiter.callback, reply)
-                    else:
-                        waiter.event.set()
-                # Drop locals NOW: an idle connection must not pin the
-                # last task's completion (its callback closes over the
-                # spec, whose args hold ObjectRefs — a refcount leak).
-                del waiter, reply
+                frame = _loads(_recv_frame(self._sock))
+                if frame.get("type") == "reply_batch":
+                    replies = frame["msgs"]
+                else:
+                    replies = (frame,)
+                for reply in replies:
+                    with self._lock:
+                        waiter = self._pending.pop(
+                            reply.get("req_id"), None)
+                    if waiter is not None:
+                        waiter.reply = reply
+                        if waiter.callback is not None:
+                            self._dispatch_completion(waiter.callback,
+                                                      reply)
+                        else:
+                            waiter.event.set()
+                    # Drop locals NOW: an idle connection must not pin
+                    # the last task's completion (its callback closes
+                    # over the spec, whose args hold ObjectRefs — a
+                    # refcount leak).
+                    del waiter, reply
+                del frame, replies
         except (ConnectionError, OSError):
             pass
         finally:
@@ -340,6 +505,7 @@ class NodeConnection:
                 pass
         # After the died-completions above: drainer exits once they ran.
         self._completion_q.put(None)
+        self._sender.close()
 
     # -- user-code proxies ----------------------------------------------
 
@@ -377,7 +543,8 @@ class NodeConnection:
 
     def execute_task_async(self, spec, functions, args, kwargs,
                            store_limit: int, callback,
-                           lease_id: Optional[str] = None) -> None:
+                           lease_id: Optional[str] = None,
+                           class_id: Optional[str] = None) -> None:
         """Send an execute_task request whose reply is delivered to
         ``callback(reply_dict)`` on the completion pool — no head thread
         blocks while the daemon works (the thread-per-call fix; the
@@ -408,6 +575,14 @@ class NodeConnection:
             msg["num_returns"] = spec.num_returns
         if lease_id is not None:
             msg["lease_id"] = lease_id
+        if class_id is not None:
+            msg["class_id"] = class_id
+        if _args_are_plain(args, kwargs):
+            # No object markers anywhere at top level: the daemon can
+            # forward the payload bytes to its worker subprocess without
+            # the unpickle→resolve→repickle round (markers only ever
+            # appear at top level — _resolve_args resolves there).
+            msg["plain_args"] = True
         _wire.validate_message(msg)
         with self._lock:
             closed = self._closed
@@ -418,21 +593,25 @@ class NodeConnection:
             # lock is not reentrant).
             self._dispatch_completion(callback, {"type": "died"})
             return
+        def resolver(m):
+            m["fn_bytes"] = self._function_payload(
+                spec.function_id, functions)
+
         try:
-            with self._send_lock:
-                msg["fn_bytes"] = self._function_payload(
-                    spec.function_id, functions)
-                _send_frame(self._sock, _dumps(msg))
-        except (OSError, ValueError) as exc:
+            sent = self._sender.send(msg, resolver=resolver,
+                                     nbytes=len(msg["payload"]))
+        except ValueError:
             with self._lock:
                 self._pending.pop(req_id, None)
-            if isinstance(exc, ValueError):
-                raise  # unpicklable function: a USER error, raise inline
-            self._dispatch_completion(callback, {"type": "died"})
+            raise  # unpicklable function: a USER error, raise inline
         except BaseException:
             with self._lock:
                 self._pending.pop(req_id, None)
             raise
+        if not sent:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            self._dispatch_completion(callback, {"type": "died"})
 
     def execute_task(self, spec, functions, args, kwargs,
                      store_limit: int = 0) -> Any:
@@ -487,6 +666,15 @@ class NodeConnection:
         """The head released this lease: the daemon retires its serial
         executor and returns the pinned worker subprocess to the pool."""
         self._fire_and_forget({"type": "drop_lease", "lease_id": lease_id})
+
+    def reclaim_tasks(self, class_id: str, max_n: int) -> None:
+        """Spillback: ask the daemon to hand back up to max_n queued
+        tasks of this class (each answers its own req_id with
+        reclaimed=True; the head re-dispatches through the normal
+        completion path)."""
+        self._fire_and_forget({"type": "reclaim_tasks",
+                               "class_id": class_id,
+                               "max_n": int(max_n)})
 
     def spill_lease(self, lease_id: str) -> None:
         """The lease's running task blocked in a nested get (its capacity
@@ -828,25 +1016,26 @@ class HeadServer:
                                   register.get("labels"),
                                   object_addr=register.get("object_addr"),
                                   store_name=register.get("store_name"))
-            # Registration makes the node schedulable, which can
-            # immediately dispatch queued tasks onto this connection
-            # from worker threads. Hold the send lock across
-            # register+ack so the "registered" handshake is ALWAYS
-            # the first frame the daemon reads — task frames queue
-            # behind it.
             conn.rpc_failure_pct = int(
                 self.runtime.config.testing_rpc_failure_pct)
-            with conn._send_lock:
-                # dispatch=False: task sends are INLINE and take this
-                # same send lock — dispatching here would self-deadlock.
-                # The post-ack _dispatch below places queued work.
-                node_id = self.runtime.register_remote_node(
-                    conn, register, dispatch=False)
-                conn.node_id = node_id
-                conn._on_death = self._on_conn_death
-                self._conns[node_id] = conn
-                _send_frame(sock, _dumps({"type": "registered",
-                                          "node_id": node_id.hex()}))
+            # Registration makes the node schedulable, which can
+            # immediately dispatch queued tasks onto this connection
+            # from worker threads. The sender is the socket's single
+            # writer and its queue is FIFO, so enqueueing the ack
+            # BEFORE register_remote_node publishes the conn guarantees
+            # "registered" is the first frame the daemon reads — task
+            # frames queue behind it. (Pre-r5 this held the send lock
+            # instead; the sender thread does not take that lock.)
+            node_id = self.runtime.new_node_id()
+            conn.node_id = node_id
+            conn._sender.send({"type": "registered",
+                               "node_id": node_id.hex()})
+            # dispatch=False: the post-ack _dispatch below places
+            # queued work once the reply pump is running.
+            self.runtime.register_remote_node(
+                conn, register, dispatch=False, node_id=node_id)
+            conn._on_death = self._on_conn_death
+            self._conns[node_id] = conn
         except Exception:  # noqa: BLE001 - one bad join must not
             # strand a half-registered node.
             if node_id is not None:
@@ -905,12 +1094,10 @@ class HeadServer:
             pass
         for conn in list(self._conns.values()):
             conn._on_death = None  # orderly shutdown, not node death
-            try:
-                _send_frame(conn._sock, _dumps({"type": "shutdown",
-                                                "req_id": 0}),
-                            conn._send_lock)
-            except OSError:
-                pass
+            # Through the sender (the socket's single writer), flushed
+            # before close() tears the socket down.
+            conn._sender.send({"type": "shutdown", "req_id": 0})
+            conn._sender.flush()
             conn.close()
         self._conns.clear()
         # Copy first: session.close() removes itself from the list via
@@ -931,48 +1118,179 @@ class HeadServer:
 _current_daemon: Optional["NodeDaemon"] = None
 
 
+class _ClassQueue:
+    """Daemon-LOCAL dispatch queue for one scheduling class (reference:
+    local_task_manager.cc:101 — the raylet owns a per-class queue and
+    dispatches to whichever of its leased workers frees up; the head
+    only grants capacity). Every lease slot of the class pulls from this
+    one FIFO, so the daemon — not the head — decides which worker runs
+    which queued task: a slow task no longer head-of-line-blocks the
+    work the head happened to pipeline behind it on the same lease.
+
+    Blocked-capacity lending: when the head reports a slot's running
+    task blocked in a nested get (spill_lease), that slot's accounted
+    capacity was released head-side — the daemon spins up a TEMPORARY
+    slot against it (the reference's NotifyDirectCallTaskBlocked
+    semantics: a blocked worker's CPU is re-grantable). The temp slot
+    retires on unspill. This keeps the deadlock guarantee (a child
+    queued behind its blocked parent always finds a slot) without
+    draining whole queues onto unbounded threads."""
+
+    def __init__(self, daemon: "NodeDaemon", class_id: str):
+        self._daemon = daemon
+        self.class_id = class_id
+        from collections import deque
+        self.dq: Any = deque()
+        self.cv = threading.Condition()
+        self.slots: set = set()        # live _LeaseExecutor objects
+        self.temp_slots = 0            # live temp-slot threads
+        self._retire_pending = 0       # unspills waiting to retire one
+        self._closed = False           # session over: temp slots exit
+
+    def put(self, item) -> None:
+        with self.cv:
+            self.dq.append(item)
+            self.cv.notify()
+
+    def put_front(self, item) -> None:
+        with self.cv:
+            self.dq.appendleft(item)
+            self.cv.notify()
+
+    def get(self, timeout: float = 0.5):
+        with self.cv:
+            if not self.dq:
+                self.cv.wait(timeout)
+            return self.dq.popleft() if self.dq else None
+
+    def pop_tail(self, max_n: int) -> list:
+        """Reclaim (head spillback): hand back up to max_n NOT-STARTED
+        tasks from the tail — the most recently pipelined, so FIFO
+        fairness for the rest is untouched."""
+        out = []
+        with self.cv:
+            while self.dq and len(out) < max_n:
+                out.append(self.dq.pop())
+        return out
+
+    def qsize(self) -> int:
+        return len(self.dq)
+
+    def spill(self) -> None:
+        """One slot's task blocked head-side: lend its capacity to a
+        temporary slot serving this queue."""
+        with self.cv:
+            self.temp_slots += 1
+        threading.Thread(target=self._temp_loop,
+                         name=f"ray_tpu-temp-{self.class_id}",
+                         daemon=True).start()
+
+    def unspill(self) -> None:
+        """The blocked task resumed: retire one temp slot (after its
+        current task, if it grabbed one)."""
+        with self.cv:
+            self._retire_pending += 1
+            self.cv.notify_all()
+
+    def close(self) -> None:
+        """Session teardown: every temp slot must exit — the head that
+        would have sent the retiring unspill is gone."""
+        with self.cv:
+            self._closed = True
+            self.cv.notify_all()
+
+    def _temp_loop(self) -> None:
+        try:
+            while True:
+                with self.cv:
+                    if self._closed:
+                        return
+                    if self._retire_pending > 0:
+                        self._retire_pending -= 1
+                        return
+                item = self.get(timeout=0.2)
+                if item is None:
+                    continue
+                sock, msg = item
+                # No pinned worker: per-task pool lease (temp slots are
+                # short-lived; pinning would hoard subprocesses).
+                self._daemon._handle_counted(sock, msg)
+        finally:
+            with self.cv:
+                self.temp_slots -= 1
+
+    def drain_to_threads(self) -> None:
+        """Last slot retired with work still queued (head/daemon
+        accounting drift — should not happen): never strand tasks."""
+        while True:
+            with self.cv:
+                if not self.dq:
+                    return
+                sock, msg = self.dq.popleft()
+            threading.Thread(target=self._daemon._handle_counted,
+                             args=(sock, msg), daemon=True).start()
+
+
 class _LeaseExecutor:
     """Daemon-side half of a worker lease (reference: raylet's leased
-    worker + direct_task_transport pipelining): a dedicated thread runs
-    this lease's tasks strictly FIFO — one at a time, matching the single
-    resource acquisition the head holds for the lease — while the head
-    streams queued same-class tasks onto the wire ahead of need. Worker-
-    process tasks pin ONE subprocess for the lease's lifetime (no per-task
-    pool lease/release)."""
+    worker + direct_task_transport pipelining): one dedicated thread =
+    one accounted resource acquisition. In SHARED mode (CPU classes) the
+    thread is a slot on the class's local dispatch queue — the daemon
+    decides which slot runs which task (_ClassQueue). In SERIAL mode
+    (TPU classes, whose tasks carry chip ids the head accounted to THIS
+    lease) it keeps its own strict-FIFO queue, so two tasks holding the
+    same chips can never overlap. Worker-process tasks pin ONE
+    subprocess for the lease's lifetime (no per-task pool traffic)."""
 
-    def __init__(self, daemon: "NodeDaemon", lease_id: str):
+    def __init__(self, daemon: "NodeDaemon", lease_id: str,
+                 cq: Optional[_ClassQueue] = None):
         self._daemon = daemon
         self.lease_id = lease_id
+        self._cq = cq
         import queue as _queue
         self._q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        self._stopping = False
         self.worker_handle = None  # pinned worker subprocess (if any)
         self.worker_python = None
         self.tasks_run = 0
-        # Set while the lease's running task is blocked in a nested get:
-        # tasks that raced onto the wire before the head stopped
-        # attaching must bypass the serial queue, or one could land
-        # behind the blocked parent it is a dependency of. CLEARED by the
-        # head's unspill_lease when the get returns — without that, every
-        # later task would run on its own thread against ONE accounted
-        # acquisition for the lease's remaining life (unbounded node
-        # over-subscription).
+        # SERIAL mode only — set while the lease's running task is
+        # blocked in a nested get: tasks that raced onto the wire before
+        # the head stopped attaching must bypass the serial queue, or
+        # one could land behind the blocked parent it is a dependency
+        # of. CLEARED by the head's unspill_lease when the get returns.
         self.spilled = False
+        if cq is not None:
+            with cq.cv:
+                cq.slots.add(self)
         self._thread = threading.Thread(
-            target=self._run, name=f"ray_tpu-lease-{lease_id}", daemon=True)
+            target=self._run_shared if cq is not None else self._run,
+            name=f"ray_tpu-lease-{lease_id}", daemon=True)
         self._thread.start()
 
     def submit(self, sock, msg: dict) -> None:
-        self._q.put((sock, msg))
+        if self._cq is not None:
+            self._cq.put((sock, msg))
+        else:
+            self._q.put((sock, msg))
 
     def stop(self) -> None:
-        self._q.put(None)
+        self._stopping = True
+        if self._cq is not None:
+            with self._cq.cv:
+                self._cq.cv.notify_all()
+        else:
+            self._q.put(None)
 
     def spill(self) -> None:
-        """The lease's running task blocked in a nested get: move every
-        WAITING task off this serial queue onto its own handler thread
-        (the normal unpinned path — head-side, the blocked task's lease
-        capacity was lent out, so the concurrency is sanctioned). Without
-        this, a child pipelined behind its blocked parent deadlocks."""
+        """The lease's running task blocked in a nested get; its
+        capacity was lent out head-side. SHARED mode: lend it to a temp
+        slot. SERIAL mode: move every waiting task off this serial
+        queue onto its own handler thread (concurrency sanctioned by
+        the released capacity) — a child pipelined behind its blocked
+        parent must never deadlock."""
+        if self._cq is not None:
+            self._cq.spill()
+            return
         self.spilled = True
         import queue as _queue
         while True:
@@ -988,7 +1306,10 @@ class _LeaseExecutor:
                              args=(sock, msg), daemon=True).start()
 
     def unspill(self) -> None:
-        """Resume serial execution (the head cleared lease.blocked)."""
+        """Resume normal capacity (the head cleared lease.blocked)."""
+        if self._cq is not None:
+            self._cq.unspill()
+            return
         self.spilled = False
 
     def _run(self) -> None:
@@ -1000,6 +1321,32 @@ class _LeaseExecutor:
             msg["_lease_exec"] = self  # daemon-local pin context
             self.tasks_run += 1
             self._daemon._handle_counted(sock, msg)
+        self._release_pinned()
+
+    def _run_shared(self) -> None:
+        cq = self._cq
+        try:
+            while True:
+                item = cq.get(timeout=0.5)
+                if self._stopping:
+                    if item is not None:
+                        cq.put_front(item)  # another slot takes it
+                    break
+                if item is None:
+                    continue
+                sock, msg = item
+                msg["_lease_exec"] = self  # daemon-local pin context
+                self.tasks_run += 1
+                self._daemon._handle_counted(sock, msg)
+        finally:
+            with cq.cv:
+                cq.slots.discard(self)
+                last = not cq.slots
+            if last and cq.qsize():
+                cq.drain_to_threads()
+            self._release_pinned()
+
+    def _release_pinned(self) -> None:
         handle = self.worker_handle
         self.worker_handle = None
         if handle is not None:
@@ -1099,6 +1446,12 @@ class NodeDaemon:
         self._session_n = 0
         self._send_lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
+        # Per-session reply sender (socket -> _CoalescingSender): the
+        # single writer for head-bound replies; completions accumulated
+        # by concurrent handler threads coalesce into reply_batch
+        # frames. Handlers of a DEAD session find no sender and fall
+        # back to a direct send into the closed socket (dropped).
+        self._reply_senders: Dict[Any, Any] = {}
         self._stop = threading.Event()
         self.node_id_hex: Optional[str] = None
         # Worker-process pool (reference: raylet WorkerPool): CPU tasks
@@ -1124,6 +1477,10 @@ class NodeDaemon:
         self._inflight = 0
         self._inflight_cpu = 0.0
         self._inflight_lock = threading.Lock()
+        # Daemon-local dispatch queues: class_id -> _ClassQueue (the
+        # node's own task queues; see _ClassQueue docstring). Recv-loop
+        # writes, slot threads read.
+        self._class_queues: Dict[str, _ClassQueue] = {}
         # Live worker leases: lease_id -> _LeaseExecutor (recv-loop only).
         self._lease_executors: Dict[str, _LeaseExecutor] = {}
         self._lease_tasks_total = 0
@@ -1157,9 +1514,38 @@ class NodeDaemon:
                 pass
             return None
 
+        def backlog():
+            # Local dispatch state: per-class queue depth + lent-out
+            # temp slots. The head reads this through the syncer for
+            # spillback decisions and the state API — it does NOT see
+            # the queues directly (they are daemon-owned).
+            classes = {cid: cq.qsize()
+                       for cid, cq in list(self._class_queues.items())}
+            return {"classes": classes,
+                    "queued": sum(classes.values()),
+                    "temp_slots": sum(
+                        cq.temp_slots
+                        for cq in list(self._class_queues.values()))}
+
         self.syncer_reporter.register(_sync.RESOURCE_LOAD, resource_load)
         self.syncer_reporter.register(_sync.OBJECT_STORE, object_store)
         self.syncer_reporter.register(_sync.MEMORY, memory)
+        self.syncer_reporter.register(_sync.BACKLOG, backlog)
+
+    def _reclaim_tasks(self, sock, msg: dict) -> None:
+        """Head spillback (reference: cluster_task_manager.cc spillback):
+        hand back up to max_n queued-not-started tasks of a class so the
+        head can re-dispatch them onto capacity that freed elsewhere.
+        Each reclaimed task's req_id answers {"reclaimed": True} — the
+        head's normal completion path re-routes it."""
+        cq = self._class_queues.get(msg.get("class_id"))
+        popped = (cq.pop_tail(int(msg.get("max_n", 0)))
+                  if cq is not None else [])
+        for psock, pmsg in popped:
+            self._send_reply(psock, {"req_id": pmsg.get("req_id", 0),
+                                     "ok": True, "reclaimed": True})
+        if msg.get("req_id"):
+            self._reply(sock, msg["req_id"], value=len(popped))
 
     def _load_function(self, fn_id: bytes, fn_bytes: Optional[bytes]):
         fn = self._functions.get(fn_id)
@@ -1180,6 +1566,17 @@ class NodeDaemon:
             # function exports in GCS KV for the job's lifetime).
         return fn
 
+    def _send_reply(self, sock, msg: dict, nbytes: int = 0) -> None:
+        """Route a reply through the session's coalescing sender (the
+        socket's single writer). Handlers that outlive their session
+        find no sender and fall back to a direct send into the closed
+        socket — dropped, which is the intent (see _reply's docstring
+        on head restarts)."""
+        sender = self._reply_senders.get(sock)
+        if sender is not None and sender.send(msg, nbytes=nbytes):
+            return
+        _send_frame(sock, _dumps(msg), self._send_lock)
+
     def _reply(self, sock, req_id: int, *, value: Any = None,
                error: Optional[BaseException] = None,
                tb: str = "") -> None:
@@ -1196,9 +1593,11 @@ class NodeDaemon:
                 payload = _dumps((RuntimeError(
                     f"{type(error).__name__}: {error}"), tb))
             msg = {"req_id": req_id, "ok": False, "error": payload}
-        else:
-            msg = {"req_id": req_id, "ok": True, "value": _dumps(value)}
-        _send_frame(sock, _dumps(msg), self._send_lock)
+            self._send_reply(sock, msg, nbytes=len(payload))
+            return
+        payload = _dumps(value)
+        self._send_reply(sock, {"req_id": req_id, "ok": True,
+                                "value": payload}, nbytes=len(payload))
 
     def _reply_result(self, sock, req_id: int, result: Any,
                       store_limit: int, num_returns: int = 1) -> None:
@@ -1212,10 +1611,9 @@ class NodeDaemon:
             # Wrong shape for a multi-return task: the head will raise —
             # describe the actual value here (it is already deserialized)
             # rather than parking an unconsumable stub in the table.
-            _send_frame(sock, _dumps({
+            self._send_reply(sock, {
                 "req_id": req_id, "ok": True,
-                "mismatch_desc": describe_value(result)}),
-                self._send_lock)
+                "mismatch_desc": describe_value(result)})
             return
         if num_returns > 1 and store_limit and \
                 isinstance(result, (tuple, list)) and \
@@ -1232,9 +1630,10 @@ class NodeDaemon:
                                       "size": len(payload)})
                     else:
                         parts.append({"value": payload})
-                _send_frame(sock, _dumps({"req_id": req_id, "ok": True,
-                                          "parts": parts}),
-                            self._send_lock)
+                self._send_reply(
+                    sock, {"req_id": req_id, "ok": True, "parts": parts},
+                    nbytes=sum(len(p.get("value") or b"")
+                               for p in parts))
                 return
             # Small total: the plain inline reply below is cheaper than
             # per-element bookkeeping head-side.
@@ -1244,11 +1643,13 @@ class NodeDaemon:
             # the same name, so it must not collide across nodes.
             key = f"obj-{self._uid}-s{self._session_n}-{req_id}"
             self._table.put(key, payload)
-            msg = {"req_id": req_id, "ok": True, "stored_key": key,
-                   "size": len(payload)}
+            self._send_reply(sock, {"req_id": req_id, "ok": True,
+                                    "stored_key": key,
+                                    "size": len(payload)})
         else:
-            msg = {"req_id": req_id, "ok": True, "value": payload}
-        _send_frame(sock, _dumps(msg), self._send_lock)
+            self._send_reply(sock, {"req_id": req_id, "ok": True,
+                                    "value": payload},
+                             nbytes=len(payload))
 
     def _resolve_markers(self, args, kwargs):
         from ray_tpu._private.dataplane import (PULL_PRIORITY_TASK_ARGS,
@@ -1407,8 +1808,14 @@ class NodeDaemon:
             lease_ex = None  # containerized: never pin
         arg_pins: list = []
         try:
-            args, kwargs, arg_pins = self._resolve_markers_for_worker(
-                *_loads(msg["payload"]))
+            if msg.get("plain_args"):
+                # Head vouched the payload holds no markers: forward the
+                # bytes to the worker untouched (no unpickle→repickle).
+                args_payload = msg["payload"]
+            else:
+                args, kwargs, arg_pins = self._resolve_markers_for_worker(
+                    *_loads(msg["payload"]))
+                args_payload = _dumps((args, kwargs))
             fn_id = msg["fn_id"]
 
             def build(fn_bytes):
@@ -1420,7 +1827,7 @@ class NodeDaemon:
                     "mode": "task",
                     "fn_id": fn_id,
                     "fn_bytes": fn_bytes,
-                    "payload": _dumps((args, kwargs)),
+                    "payload": args_payload,
                     "runtime_env": renv,
                     "name": msg.get("name", "task"),
                     "task_id": msg.get("task_id"),
@@ -1472,16 +1879,18 @@ class NodeDaemon:
             elif store_limit and len(payload) > store_limit:
                 key = f"obj-{self._uid}-s{self._session_n}-{req_id}"
                 self._table.put(key, payload)
-                out = {"req_id": req_id, "ok": True, "stored_key": key,
-                       "size": len(payload)}
-                _send_frame(sock, _dumps(out), self._send_lock)
+                self._send_reply(sock, {"req_id": req_id, "ok": True,
+                                        "stored_key": key,
+                                        "size": len(payload)})
             else:
-                out = {"req_id": req_id, "ok": True, "value": payload}
-                _send_frame(sock, _dumps(out), self._send_lock)
+                self._send_reply(sock, {"req_id": req_id, "ok": True,
+                                        "value": payload},
+                                 nbytes=len(payload))
         else:
-            _send_frame(sock, _dumps(
-                {"req_id": req_id, "ok": False, "error": reply["error"]}),
-                self._send_lock)
+            self._send_reply(
+                sock, {"req_id": req_id, "ok": False,
+                       "error": reply["error"]},
+                nbytes=len(reply["error"]))
 
     #: frame kinds that run user code and hold node resources; data-
     #: plane/control frames (fetch_object, stats, ...) never count.
@@ -1553,9 +1962,9 @@ class NodeDaemon:
                             f"object payload {msg['key']} is not resident "
                             "on this node (already freed?)")
                     data = bytes(raw)
-                _send_frame(sock, _dumps(
-                    {"req_id": req_id, "ok": True, "raw": data}),
-                    self._send_lock)
+                self._send_reply(sock, {"req_id": req_id, "ok": True,
+                                        "raw": data},
+                                 nbytes=len(data))
             elif kind == "free_object":
                 self._table.free(msg["key"])
                 self._reply(sock, req_id, value=None)
@@ -1773,68 +2182,108 @@ class NodeDaemon:
             threading.Thread(target=self._serve_health_channel,
                              name="ray_tpu-daemon-health",
                              daemon=True).start()
+        # Single writer for this session's replies: send failures close
+        # the socket, which pops the recv loop below out of its read.
+        session_sock = self._sock
+        sender = _CoalescingSender(
+            session_sock, "reply_batch",
+            on_fail=lambda: _close_quiet(session_sock),
+            name=f"reply-{self.node_id_hex[:8]}")
+        self._reply_senders[session_sock] = sender
         try:
             while not self._stop.is_set():
-                msg = _loads(_recv_frame(self._sock))
+                frame = _loads(_recv_frame(self._sock))
                 # Inbound control frames are schema-checked before any
                 # handler sees them: a head from another build fails
                 # HERE with the exact field, not deep in a handler.
-                _wire.validate_message(msg)
-                if msg.get("type") == "shutdown":
-                    self._stop.set()
-                    break
-                # Serialize function installation: cache raw bytes here on
-                # the recv thread, not in the handler threads.
-                fb = msg.get("fn_bytes")
-                if fb is not None and msg.get("fn_id") is not None:
-                    self._fn_raw.setdefault(msg["fn_id"], fb)
-                lease_id = msg.get("lease_id")
-                if msg.get("type") == "drop_lease":
-                    ex = self._lease_executors.pop(lease_id, None)
-                    if ex is not None:
-                        ex.stop()
-                elif msg.get("type") == "spill_lease":
-                    ex = self._lease_executors.get(lease_id)
-                    if ex is not None:
-                        ex.spill()
-                elif msg.get("type") == "unspill_lease":
-                    ex = self._lease_executors.get(lease_id)
-                    if ex is not None:
-                        ex.unspill()
-                elif lease_id is not None:
-                    # Leased task: FIFO onto the lease's serial executor —
-                    # no thread spawn, no per-task worker pool traffic.
-                    ex = self._lease_executors.get(lease_id)
-                    if ex is None:
-                        ex = _LeaseExecutor(self, lease_id)
-                        self._lease_executors[lease_id] = ex
-                    self._lease_tasks_total += 1
-                    if ex.spilled:
-                        # Spilled lease (a task blocked in a nested get):
-                        # late frames bypass the serial queue too.
-                        threading.Thread(target=self._handle_counted,
-                                         args=(self._sock, msg),
-                                         daemon=True).start()
-                    else:
-                        ex.submit(self._sock, msg)
+                _wire.validate_message(frame)
+                if frame.get("type") == "task_batch":
+                    msgs = frame["msgs"]
                 else:
-                    # Pass THIS session's socket: a handler outliving the
-                    # session replies into a closed socket (dropped), never
-                    # into a later session whose fresh req_id counter would
-                    # collide with this frame's req_id.
-                    threading.Thread(target=self._handle_counted,
-                                     args=(self._sock, msg),
-                                     daemon=True).start()
+                    msgs = (frame,)
+                for msg in msgs:
+                    if msg is not frame:
+                        _wire.validate_message(msg)
+                    if not self._route_frame(msg):
+                        self._stop.set()
+                        break
         finally:
             # Head session over: its leases are meaningless — retire the
             # executors and return their pinned workers.
+            sender.close()
+            self._reply_senders.pop(session_sock, None)
             for ex in self._lease_executors.values():
                 ex.stop()
             self._lease_executors.clear()
+            # Queued work died with the head; temp slots must not
+            # outlive the session that lent them capacity.
+            for cq in self._class_queues.values():
+                cq.close()
+            self._class_queues.clear()
             try:
                 self._sock.close()
             except OSError:
                 pass
+
+    def _route_frame(self, msg: dict) -> bool:
+        """Route one inbound control message (recv-loop thread only).
+        Returns False for shutdown."""
+        if msg.get("type") == "shutdown":
+            return False
+        # Serialize function installation: cache raw bytes here on
+        # the recv thread, not in the handler threads.
+        fb = msg.get("fn_bytes")
+        if fb is not None and msg.get("fn_id") is not None:
+            self._fn_raw.setdefault(msg["fn_id"], fb)
+        lease_id = msg.get("lease_id")
+        if msg.get("type") == "drop_lease":
+            ex = self._lease_executors.pop(lease_id, None)
+            if ex is not None:
+                ex.stop()
+        elif msg.get("type") == "spill_lease":
+            ex = self._lease_executors.get(lease_id)
+            if ex is not None:
+                ex.spill()
+        elif msg.get("type") == "unspill_lease":
+            ex = self._lease_executors.get(lease_id)
+            if ex is not None:
+                ex.unspill()
+        elif msg.get("type") == "reclaim_tasks":
+            self._reclaim_tasks(self._sock, msg)
+        elif lease_id is not None:
+            # Leased task: onto the class's shared local-dispatch queue
+            # (CPU classes — the daemon picks the slot), or the lease's
+            # strict-FIFO serial executor (TPU classes: chip ids were
+            # accounted to this lease, overlap would double-book them).
+            ex = self._lease_executors.get(lease_id)
+            if ex is None:
+                cq = None
+                class_id = msg.get("class_id")
+                if class_id is not None and not msg.get("tpu_ids"):
+                    cq = self._class_queues.get(class_id)
+                    if cq is None:
+                        cq = _ClassQueue(self, class_id)
+                        self._class_queues[class_id] = cq
+                ex = _LeaseExecutor(self, lease_id, cq)
+                self._lease_executors[lease_id] = ex
+            self._lease_tasks_total += 1
+            if ex.spilled:
+                # Spilled SERIAL lease (a task blocked in a nested get):
+                # late frames bypass the serial queue too.
+                threading.Thread(target=self._handle_counted,
+                                 args=(self._sock, msg),
+                                 daemon=True).start()
+            else:
+                ex.submit(self._sock, msg)
+        else:
+            # Pass THIS session's socket: a handler outliving the
+            # session replies into a closed socket (dropped), never
+            # into a later session whose fresh req_id counter would
+            # collide with this frame's req_id.
+            threading.Thread(target=self._handle_counted,
+                             args=(self._sock, msg),
+                             daemon=True).start()
+        return True
 
 
 def run_node(address: str, *, num_cpus: float = 1.0, num_tpus: float = 0.0,

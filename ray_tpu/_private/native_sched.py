@@ -207,8 +207,10 @@ class NativeClusterResourceScheduler:
     # -- membership -------------------------------------------------------
 
     def add_node(self, resources: Dict[str, float], is_head: bool = False,
-                 labels: Optional[dict] = None) -> NodeID:
-        node_id = NodeID.from_random()
+                 labels: Optional[dict] = None,
+                 node_id: Optional[NodeID] = None) -> NodeID:
+        if node_id is None:
+            node_id = NodeID.from_random()
         resources = dict(resources)
         resources.setdefault(f"node:{node_id.hex()[:12]}", 1.0)
         if is_head:

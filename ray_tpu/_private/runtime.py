@@ -419,11 +419,16 @@ class Runtime:
         # class_key -> live leases. Guarded by self._lock.
         self._leases: Dict[Any, List[_WorkerLease]] = {}
         self._lease_counter = 0
+        # Compact wire names for scheduling classes (shipped with each
+        # leased task so the daemon can group its LOCAL dispatch queues
+        # by class; the full class_key is a rich tuple).
+        self._class_wire_ids: Dict[Any, str] = {}
         # Class keys dispatch saw feasible-but-capacity-blocked in its
         # last full scan: a draining lease releases early iff a class
         # OTHER than its own is starved (lease fairness without churn).
         self._lease_contended: set = set()
-        self.lease_stats = {"created": 0, "attached": 0, "released": 0}
+        self.lease_stats = {"created": 0, "attached": 0, "released": 0,
+                            "reclaimed": 0}
         self._lease_window = max(
             1, int(self.config.max_tasks_in_flight_per_worker))
         self._lease_enabled = bool(self.config.worker_lease_enabled)
@@ -945,6 +950,55 @@ class Runtime:
             conn = self._remote_nodes.get(lease.node_id)
             if conn is not None:
                 conn.drop_lease(lease.lease_id)
+            # Freed capacity + empty head-side queues: pull misplaced
+            # work back from overloaded daemon queues.
+            self._maybe_spillback()
+
+    def _class_wire_id(self, class_key) -> str:
+        """Compact stable name for a scheduling class, shipped on the
+        wire so daemons key their local dispatch queues by it."""
+        with self._lock:
+            cid = self._class_wire_ids.get(class_key)
+            if cid is None:
+                cid = f"k{len(self._class_wire_ids)}"
+                self._class_wire_ids[class_key] = cid
+            return cid
+
+    def _maybe_spillback(self) -> None:
+        """Misplaced-work correction (reference: cluster_task_manager.cc
+        ScheduleAndDispatchTasks spillback): capacity just freed
+        somewhere, the head has nothing queued for a class, yet tasks
+        already pipelined to one node sit in its LOCAL queue behind busy
+        slots. Reclaim the tail; the re-dispatch takes the idle
+        capacity. Only non-PG, non-TPU (shared-queue) classes — serial
+        leases keep strict ownership of their pipelined tasks."""
+        target = None
+        with self._lock:
+            for ck, leases in self._leases.items():
+                if self._ready_by_class.get(ck):
+                    continue  # new capacity will be fed head-side
+                for lease in leases:
+                    if (lease.pg_id is not None or lease.tpu_ids
+                            or lease.blocked or lease.dropped):
+                        continue
+                    extra = lease.inflight - 1
+                    if extra >= 2 and (target is None
+                                       or extra > target[2]):
+                        target = (ck, lease, extra)
+        if target is None:
+            return
+        ck, lease, extra = target
+        # Probe: does idle capacity for this class actually exist? (The
+        # probe acquisition is returned immediately — the reclaimed
+        # tasks re-acquire through the normal dispatch path.)
+        acq = self.scheduler.try_acquire(lease.resources, None, -1)
+        if acq is None:
+            return
+        self.scheduler.release(lease.resources, acq[0], None, acq[1])
+        conn = self._remote_nodes.get(lease.node_id)
+        if conn is not None:
+            conn.reclaim_tasks(self._class_wire_id(ck),
+                               max_n=min(extra, 16))
 
     def _drop_node_leases(self, node_id: NodeID) -> None:
         """Node death: its leases vanish with it — the scheduler already
@@ -1474,7 +1528,9 @@ class Runtime:
                     self._result_store_limit(spec),
                     lambda reply: self._complete_remote_task(spec, conn,
                                                              reply),
-                    lease_id=lease.lease_id if lease is not None else None)
+                    lease_id=lease.lease_id if lease is not None else None,
+                    class_id=(self._class_wire_id(lease.class_key)
+                              if lease is not None else None))
             except BaseException as e:  # noqa: BLE001
                 self._remote_task_error(spec, e)
 
@@ -1490,6 +1546,19 @@ class Runtime:
         """Continuation for an async remote task (runs on the completion
         pool): unpack, store, finish — mirroring _run_normal_task's
         terminal handling without a dedicated thread."""
+        if reply.get("reclaimed"):
+            # Spillback: the daemon handed this queued-not-started task
+            # back (capacity freed elsewhere). Release its lease ride
+            # and re-dispatch — same accounting as a retry, without
+            # consuming a retry attempt.
+            if getattr(spec, "invalidated", False):
+                self._dispatch()
+                return
+            with self._lock:
+                self.lease_stats["reclaimed"] += 1
+            self._finish_task(spec, None, retried=True)
+            self._resolve_dependencies(spec)
+            return
         try:
             if reply.get("type") == "died":
                 from ray_tpu._private.multinode import RemoteNodeDiedError
@@ -2317,6 +2386,7 @@ class Runtime:
         # Bundles orphaned by an earlier node death land here if they fit.
         self.scheduler.reschedule_lost_bundles()
         self._dispatch()  # new capacity may unblock queued tasks
+        self._maybe_spillback()  # ...or absorb misplaced daemon backlog
         return node_id
 
     def start_head_server(self, host: str = "127.0.0.1",
@@ -2366,13 +2436,21 @@ class Runtime:
             return [k for k in self._kv_mem.get(namespace, {})
                     if k.startswith(prefix)]
 
+    def new_node_id(self) -> "NodeID":
+        """Pre-mint a node id (the handshake enqueues the 'registered'
+        ack on the conn's sender BEFORE the node becomes schedulable, so
+        the id must exist before register_remote_node runs)."""
+        return NodeID.from_random()
+
     def register_remote_node(self, conn, info: Optional[dict] = None,
-                             dispatch: bool = True) -> NodeID:
+                             dispatch: bool = True,
+                             node_id: Optional["NodeID"] = None) -> NodeID:
         # The connection must be visible BEFORE dispatch can place tasks
         # on the new node — otherwise a queued task assigned to it would
         # find no conn and silently run head-local.
         node_id = self.scheduler.add_node(dict(conn.resources),
-                                          labels=conn.labels)
+                                          labels=conn.labels,
+                                          node_id=node_id)
         with self._lock:
             self._remote_nodes[node_id] = conn
         # A daemon reconnecting to a RESTARTED head announces the actor

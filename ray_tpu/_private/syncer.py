@@ -29,6 +29,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 RESOURCE_LOAD = "resource_load"
 OBJECT_STORE = "object_store"
 MEMORY = "memory"
+#: Daemon-local dispatch backlog (reference: the raylet reports its
+#: per-class queue depth as resource demand for scheduling/autoscaling).
+BACKLOG = "backlog"
 
 
 class NodeSyncReporter:

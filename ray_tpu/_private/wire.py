@@ -21,7 +21,9 @@ from __future__ import annotations
 from typing import Any, Dict, Tuple
 
 #: Bump on any incompatible control-channel change (see module doc).
-PROTOCOL_VERSION = 1
+#: v2: task_batch / reply_batch coalesced frames (either peer may emit
+#: them, so a v1 peer would fail on an unknown type).
+PROTOCOL_VERSION = 2
 
 
 class WireSchemaError(ValueError):
@@ -73,6 +75,8 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
         "store_limit": (_INT, False),
         "num_returns": (_INT, False),
         "lease_id": (_STR, False),
+        "plain_args": (_BOOL, False),
+        "class_id": (_STR, False),
     },
     "create_actor": {
         "req_id": (_INT, True),
@@ -102,12 +106,20 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
                      "size": (_INT, True)},
     # -- leases / control ----------------------------------------------
     "drop_lease": {"lease_id": (_STR, True)},
+    "reclaim_tasks": {"class_id": (_STR, True), "max_n": (_INT, True)},
     "spill_lease": {"lease_id": (_STR, True)},
     "unspill_lease": {"lease_id": (_STR, True)},
     "stats": {"req_id": (_INT, True)},
     "profile": {"req_id": (_INT, True), "duration": (_NUM, False),
                 "hz": (_INT, False), "fmt": (_STR, False)},
     "shutdown": {},
+    # -- frame coalescing (both directions, v2) ------------------------
+    # A batch frame wraps N control messages that accumulated at the
+    # sender while the socket was busy (one pickle + one syscall for
+    # all of them). Inner messages are validated individually by the
+    # receiver; reply_batch carries type-less reply frames.
+    "task_batch": {"msgs": (_LIST, True)},
+    "reply_batch": {"msgs": (_LIST, True)},
     # -- liveness ------------------------------------------------------
     "ping": {"cluster_digest": ((dict, type(None)), False)},
     "pong": {"sync": (_ANY, False)},
