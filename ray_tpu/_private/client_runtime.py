@@ -319,6 +319,23 @@ def _attached_arena():
     return None
 
 
+def _local_object_addr(daemon=None) -> Optional[Tuple[str, int]]:
+    """This context's object-server address — the OWNER endpoint stamped
+    into owner hints (phase 3). In-daemon: the daemon's server; worker
+    subprocess: the daemon's server via RAY_TPU_OBJECT_ADDR."""
+    if daemon is not None and daemon._object_server is not None and \
+            daemon._object_server_host:
+        return (daemon._object_server_host, daemon._object_server.port)
+    raw = os.environ.get("RAY_TPU_OBJECT_ADDR")
+    if raw and ":" in raw:
+        host, _, port = raw.rpartition(":")
+        try:
+            return (host, int(port))
+        except ValueError:
+            return None
+    return None
+
+
 class ClientRuntime:
     """Head-connected runtime bound by worker.py when user code runs in a
     daemon/worker context. Implements the Runtime surface the API layer
@@ -351,6 +368,9 @@ class ClientRuntime:
         self._put_local_limit = int(
             make_ray_config(None).remote_object_inline_limit_bytes
             or (1 << 20))
+        # Owner-ward resolutions served without a head op (phase 3;
+        # tests assert this moves while head op counters stand still).
+        self.ownerward_gets = 0
         # Ordered ref-notice queue + flusher (see _ClientRefs).
         self._notices: "collections.deque" = collections.deque()
         self._notice_event = threading.Event()
@@ -535,17 +555,106 @@ class ClientRuntime:
             elif arena is not None:
                 arena.delete(key)
             return None
-        return self._refs_from_hex([reply["ref"]])[0]
+        # Owner hint (phase 3): the creator knows the owner — itself.
+        # Any borrower of this ref can then locate/fetch/register
+        # straight against this node's object server, no head op.
+        hint = None
+        addr = _local_object_addr(daemon)
+        if addr is not None and node_hex:
+            hint = (key, addr[0], addr[1], node_hex)
+        oid = ObjectID.from_hex(reply["ref"])
+        # Pin BEFORE constructing (as _refs_from_hex does): the head
+        # pinned this ref before replying; the first local handle must
+        # not send a redundant ref_add.
+        self.refs.mark_pinned(oid)
+        return ObjectRef(oid, owner_hint=hint)
+
+    #: sentinel: owner-ward resolution missed, fall back to the head.
+    _MISS = object()
 
     def get(self, refs: List[ObjectRef],
             timeout: Optional[float]) -> List[Any]:
-        reply = self._conn.request({
-            "op": "get",
-            "refs": [r.hex() for r in refs],
-            "timeout": timeout,
-            "holding_task": self._current_task_id_hex(),
-        })
-        return _loads(reply["values"])
+        # Phase-3 fast path: refs carrying an owner hint resolve
+        # against the OWNER's object server (local read on the owner
+        # node, direct pull elsewhere) — the head is not involved.
+        # Anything unhinted, freed, or owner-dead falls back to the
+        # head op (which waits / reconstructs as before).
+        values: List[Any] = [None] * len(refs)
+        remaining: List[Tuple[int, ObjectRef]] = []
+        for i, r in enumerate(refs):
+            hint = getattr(r, "_owner_hint", None)
+            v = (self._get_ownerward(hint, timeout)
+                 if hint else self._MISS)
+            if v is self._MISS:
+                remaining.append((i, r))
+            else:
+                values[i] = v
+        if remaining:
+            reply = self._conn.request({
+                "op": "get",
+                "refs": [r.hex() for _i, r in remaining],
+                "timeout": timeout,
+                "holding_task": self._current_task_id_hex(),
+            })
+            for (i, _r), v in zip(remaining, _loads(reply["values"])):
+                values[i] = v
+        return values
+
+    def _get_ownerward(self, hint, timeout: Optional[float]) -> Any:
+        """Resolve one hinted ref owner-ward; _MISS on any failure.
+        Network waits are capped by the CALLER's timeout (a get with
+        timeout=0.5 on a dead owner must miss fast and let the head
+        fallback apply the real deadline — never serve 30s of connect
+        retries first)."""
+        from ray_tpu._private import multinode as mn
+        from ray_tpu._private.dataplane import (ObjectPullError,
+                                                fetch_remote_bytes,
+                                                pull_object)
+        try:
+            key, host, port, node_hex = hint
+        except (TypeError, ValueError):
+            return self._MISS
+        net_timeout = 10.0 if timeout is None else max(
+            0.1, min(10.0, timeout))
+        payload = None
+        try:
+            daemon = mn._current_daemon
+            if daemon is not None:
+                if daemon.node_id_hex != node_hex and \
+                        not daemon._table.contains(key):
+                    # Peer-owned: pull into this node's table (cached
+                    # for siblings, admission-bounded) then read local.
+                    pull_object((host, port), key, daemon._table,
+                                timeout=net_timeout, retries=0)
+                with daemon._table.pinned(key) as raw:
+                    if raw is not None:
+                        payload = bytes(raw)
+            else:
+                arena = _attached_arena()
+                if arena is not None and \
+                        os.environ.get("RAY_TPU_NODE_ID") == node_hex:
+                    view = arena.get_bytes(key)
+                    if view is not None:
+                        try:
+                            payload = bytes(view)
+                        finally:
+                            with contextlib.suppress(BufferError):
+                                view.release()
+                            # get_bytes holds an arena refcount the
+                            # caller must return (native_store
+                            # contract) — a leaked pin would make the
+                            # eventual free fail forever.
+                            with contextlib.suppress(Exception):
+                                arena.release(key)
+                if payload is None:
+                    payload = fetch_remote_bytes((host, port), key,
+                                                 timeout=net_timeout)
+        except (ObjectPullError, OSError, ConnectionError):
+            return self._MISS
+        if payload is None:
+            return self._MISS
+        self.ownerward_gets += 1
+        return serialization.deserialize(payload)
 
     def wait(self, refs: List[ObjectRef], num_returns: int,
              timeout: Optional[float], fetch_local: bool = True):
@@ -711,6 +820,11 @@ class ClientSession:
         # reply, never a KeyError inside a handler.
         _wire.validate_client_op(msg)
         op = msg["op"]
+        from ray_tpu._private.event_stats import GLOBAL
+        with GLOBAL.timed(f"client.{op}"):
+            return self._dispatch_op(op, msg)
+
+    def _dispatch_op(self, op: str, msg: dict) -> dict:
         rt = self.runtime
         if op == "submit_task":
             spec = _loads(msg["spec"])

@@ -100,6 +100,15 @@ class NodeObjectTable:
         #: auto-eviction disabled, a pinned entry survives free(); the
         #: next spill pass must DELETE it, never spill-resurrect it.
         self._doomed: set = set()
+        # Owner-side borrow directory (ownership phase 3 — reference:
+        # reference_count.h:61 the OWNER tracks its objects' borrowers).
+        # key -> live borrow count, registered by peers over borrow
+        # channels (ObjectServer '!borrow'); a free() that arrives while
+        # borrows are held DEFERS — bytes survive until the last
+        # borrower releases, even if the head already dropped its
+        # directory entry. Guarded by self._lock.
+        self._borrows: Dict[str, int] = {}
+        self._deferred_free: set = set()
         # Serializes victim selection across concurrent _make_room
         # callers (one spill batch at a time); dict reads never take it.
         self._spill_lock = threading.Lock()
@@ -424,6 +433,21 @@ class NodeObjectTable:
             if key in self._doomed and self._arena.delete(key):
                 self._doomed.discard(key)
 
+    def stat(self, key: str) -> int:
+        """Payload size if resident (any tier), -1 if not — from the
+        bookkeeping records only, never materializing spilled bytes."""
+        with self._lock:
+            s = self._sizes.get(key)
+            if s is not None:
+                return s
+            h = self._heap.get(key)
+            if h is not None:
+                return len(h)
+            rec = self._spilled.get(key)
+            if rec is not None:
+                return rec[1]
+        return -1
+
     def contains(self, key: str) -> bool:
         with self._lock:
             if key in self._doomed:
@@ -435,7 +459,39 @@ class NodeObjectTable:
             return True
         return self._arena is not None and self._arena.contains(key)
 
+    def borrow_add(self, key: str) -> bool:
+        """Owner-side borrow registration: a peer context deserialized a
+        ref to this object. False when the object is already gone (the
+        borrower must fall back to the head's lineage path)."""
+        with self._lock:
+            if key not in self._sizes and key not in self._heap and \
+                    key not in self._spilled:
+                return False
+            self._borrows[key] = self._borrows.get(key, 0) + 1
+            return True
+
+    def borrow_del(self, key: str) -> None:
+        """A borrower released (explicitly or by its channel dying).
+        The LAST release executes any free() deferred while borrowed."""
+        run_free = False
+        with self._lock:
+            n = self._borrows.get(key, 0) - 1
+            if n > 0:
+                self._borrows[key] = n
+            else:
+                self._borrows.pop(key, None)
+                run_free = key in self._deferred_free
+                self._deferred_free.discard(key)
+        if run_free:
+            self.free(key)
+
     def free(self, key: str) -> None:
+        with self._lock:
+            if self._borrows.get(key, 0) > 0:
+                # Owner authority over lifetime: live borrowers keep the
+                # bytes; the actual free runs on the last borrow_del.
+                self._deferred_free.add(key)
+                return
         dead_pin = False
         if self._arena is not None:
             # Read pins are balanced by pinned(); delete fails (-2) only
@@ -648,6 +704,20 @@ class ObjectServer:
             if klen <= 0 or klen > 4096:
                 return  # garbage request; keys are short
             key = _recv_exact(sock, klen).decode()
+            if key == "!borrow":
+                # Persistent borrow channel: this connection IS the
+                # borrower's liveness token (ownership phase 3) — its
+                # death releases everything it registered, exactly like
+                # a head client-session's pins.
+                self._serve_borrow_channel(sock)
+                return
+            if key.startswith("?"):
+                # Location query answered by the OWNER, not the head
+                # (reference: ownership_based_object_directory.h — the
+                # directory asks owners). Size from the records only —
+                # never materializes a spilled payload.
+                sock.sendall(_LEN.pack(self.table.stat(key[1:])))
+                return
             # The pin spans the whole send: a concurrent free cannot
             # recycle the region under us mid-transfer.
             with self.table.pinned(key) as payload:
@@ -671,12 +741,209 @@ class ObjectServer:
             except OSError:
                 pass
 
+    def _serve_borrow_channel(self, sock: socket.socket) -> None:
+        """Channel records: '+<key>' register, '-<key>' release — both
+        ackless one-way notices (the borrower never blocks a hot
+        deserialization path on the owner; a failed registration only
+        costs it the fast path, the head pin still guards lifetime).
+        Connection death releases every borrow the channel holds."""
+        held: Dict[str, int] = {}
+        try:
+            sock.settimeout(None)  # idle channels are normal
+            while True:
+                (rlen,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+                if rlen <= 0 or rlen > 4096:
+                    return
+                rec = _recv_exact(sock, rlen).decode()
+                op, key = rec[0], rec[1:]
+                if op == "+":
+                    if self.table.borrow_add(key):
+                        held[key] = held.get(key, 0) + 1
+                elif op == "-":
+                    n = held.get(key, 0)
+                    if n > 0:
+                        held[key] = n - 1
+                        if held[key] == 0:
+                            del held[key]
+                        self.table.borrow_del(key)
+                else:
+                    return
+        except (OSError, ConnectionError, struct.error):
+            pass
+        finally:
+            for key, n in held.items():
+                for _ in range(n):
+                    self.table.borrow_del(key)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
     def close(self) -> None:
         self._closed = True
         try:
             self._listener.close()
         except OSError:
             pass
+
+
+class BorrowChannel:
+    """Client-side half of an owner borrow channel: one persistent
+    connection to an owner daemon's object server, registering this
+    PROCESS's borrows of that owner's objects. The connection doubles
+    as the liveness lease — if this process dies, the owner releases
+    everything the channel held. Used ONLY by the BorrowChannels
+    flusher thread (and tests) — never from hot paths."""
+
+    def __init__(self, addr: Tuple[str, int], timeout: float = 5.0):
+        self.addr = tuple(addr)
+        self._sock = socket.create_connection(self.addr, timeout=timeout)
+        self._sock.settimeout(timeout)
+        kb = b"!borrow"
+        self._sock.sendall(_LEN.pack(len(kb)) + kb)
+        self._lock = threading.Lock()
+        #: keys this CHANNEL GENERATION successfully registered (count).
+        #: A '-' may only ride the generation its '+' rode: after a
+        #: channel death the owner already released everything it held,
+        #: and sending the stale delete on a successor channel would
+        #: decrement a DIFFERENT borrower's live registration.
+        self.sent_counts: Dict[str, int] = {}
+
+    def add(self, key: str) -> None:
+        rec = ("+" + key).encode()
+        with self._lock:
+            self._sock.sendall(_LEN.pack(len(rec)) + rec)
+            self.sent_counts[key] = self.sent_counts.get(key, 0) + 1
+
+    def delete(self, key: str) -> bool:
+        """Send the release iff this generation holds the borrow."""
+        with self._lock:
+            n = self.sent_counts.get(key, 0)
+            if n <= 0:
+                return False  # registered on a dead predecessor: moot
+            rec = ("-" + key).encode()
+            self._sock.sendall(_LEN.pack(len(rec)) + rec)
+            if n == 1:
+                del self.sent_counts[key]
+            else:
+                self.sent_counts[key] = n - 1
+        return True
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class BorrowChannels:
+    """Process-wide owner-ward borrow notifier (ownership phase 3).
+
+    ``add``/``delete`` only ENQUEUE — they are called from
+    ObjectRef.__init__ (mid-deserialization on hot paths) and
+    ObjectRef.__del__ (any thread, any allocation point, possibly
+    inside cyclic GC), so they must never touch a lock a socket write
+    can hold, never dial, never block. One flusher thread owns every
+    channel: it dials owners (connect timeouts stall only itself),
+    replays records in order, and drops deletes whose registration
+    died with a previous channel generation."""
+
+    def __init__(self):
+        from collections import deque
+        self._q: Any = deque()
+        self._event = threading.Event()
+        self._channels: Dict[Tuple[str, int], BorrowChannel] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._thread_lock = threading.Lock()
+        self._closed = False
+
+    def add(self, addr: Tuple[str, int], key: str) -> None:
+        self._notify(("+", tuple(addr), key))
+
+    def delete(self, addr: Tuple[str, int], key: str) -> None:
+        self._notify(("-", tuple(addr), key))
+
+    def _notify(self, rec) -> None:
+        self._q.append(rec)
+        self._event.set()
+        if self._thread is None:
+            with self._thread_lock:
+                if self._thread is None and not self._closed:
+                    self._thread = threading.Thread(
+                        target=self._flush_loop,
+                        name="ray_tpu-borrow-notices", daemon=True)
+                    self._thread.start()
+
+    def _flush_loop(self) -> None:
+        while not self._closed:
+            self._event.wait()
+            self._event.clear()
+            while True:
+                try:
+                    op, addr, key = self._q.popleft()
+                except IndexError:
+                    break
+                try:
+                    ch = self._channels.get(addr)
+                    if op == "+":
+                        if ch is None:
+                            ch = BorrowChannel(addr)
+                            self._channels[addr] = ch
+                        ch.add(key)
+                    elif ch is not None:
+                        ch.delete(key)
+                except (OSError, ConnectionError, struct.error):
+                    # Owner unreachable / channel died: the owner has
+                    # (or will have) released this generation's borrows;
+                    # lifetime stays guarded by the head pin.
+                    ch = self._channels.pop(addr, None)
+                    if ch is not None:
+                        ch.close()
+
+    def close(self) -> None:
+        self._closed = True
+        self._event.set()
+        for ch in list(self._channels.values()):
+            ch.close()
+        self._channels.clear()
+
+
+#: The process's borrow channels (lazily populated; worker subprocesses
+#: and daemon contexts share one instance per process).
+GLOBAL_BORROWS = BorrowChannels()
+
+
+def stat_remote(addr: Tuple[str, int], key: str,
+                timeout: float = 10.0) -> int:
+    """Owner-ward location query: payload size if resident, -1 if not.
+    Never touches the head (phase-3 'directory asks the owner')."""
+    with socket.create_connection(tuple(addr), timeout=timeout) as sock:
+        sock.settimeout(timeout)
+        kb = ("?" + key).encode()
+        sock.sendall(_LEN.pack(len(kb)) + kb)
+        (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+        return size
+
+
+def fetch_remote_bytes(addr: Tuple[str, int], key: str,
+                       timeout: float = 30.0) -> bytes:
+    """Pull one object's payload straight into memory (contexts without
+    a local NodeObjectTable — e.g. worker subprocesses resolving a
+    borrowed ref). Raises ObjectPullError when absent/unreachable."""
+    try:
+        with socket.create_connection(tuple(addr),
+                                      timeout=timeout) as sock:
+            sock.settimeout(timeout)
+            kb = key.encode()
+            sock.sendall(_LEN.pack(len(kb)) + kb)
+            (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+            if size < 0:
+                raise ObjectPullError(
+                    f"object {key} is not resident on {addr}")
+            return _recv_exact(sock, size)
+    except (OSError, ConnectionError) as exc:
+        raise ObjectPullError(
+            f"direct fetch of {key} from {addr} failed: {exc}") from exc
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:

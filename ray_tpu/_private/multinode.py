@@ -1686,10 +1686,16 @@ class NodeDaemon:
                 from ray_tpu._private.worker_process import WorkerProcessPool
                 # head_address: workers bind a ClientRuntime for nested
                 # ray_tpu API calls (see _private/client_runtime.py).
+                object_addr = None
+                if self._object_server is not None and \
+                        self._object_server_host:
+                    object_addr = (self._object_server_host,
+                                   self._object_server.port)
                 self._pool = WorkerProcessPool(
                     store_name=self._table.arena_name,
                     head_address=self.head_address,
-                    node_id_hex=self.node_id_hex)
+                    node_id_hex=self.node_id_hex,
+                    object_addr=object_addr)
             return self._pool
 
     def _task_uses_worker_process(self, msg: dict) -> bool:

@@ -19,12 +19,57 @@ _bind_failed_at = 0.0
 _BIND_RETRY_S = 5.0
 
 
-class ObjectRef:
-    __slots__ = ("_id", "_owner_hint", "_registered", "__weakref__")
+def _is_local_node(node_hex: str) -> bool:
+    """True when this process IS (or lives on) the hinted owner node."""
+    try:
+        from ray_tpu._private import multinode as _mn
+        daemon = _mn._current_daemon
+        if daemon is not None:
+            return daemon.node_id_hex == node_hex
+        import os as _os
+        return _os.environ.get("RAY_TPU_NODE_ID") == node_hex
+    except Exception:  # noqa: BLE001
+        return False
 
-    def __init__(self, object_id: ObjectID, owner_hint: Optional[str] = None):
+
+def _head_owner_hint(object_id):
+    """Owner hint for a node-resident object, looked up when a HEAD
+    process pickles the ref (ownership phase 3): the hint travels with
+    the ref so any borrower can reach the OWNER's object server for
+    location queries, payload fetches, and borrow registration without
+    a head round-trip (reference: ObjectRef carries owner_address,
+    common.proto ObjectReference.owner_address)."""
+    try:
+        from ray_tpu._private import worker as _worker
+        runtime = getattr(_worker.global_worker, "_runtime", None)
+        rv_map = getattr(runtime, "_remote_values", None)
+        if rv_map is None:
+            return None
+        rv = rv_map.get(object_id)
+        if rv is None:
+            return None
+        node_id, key = rv
+        conn = runtime._remote_nodes.get(node_id)
+        if conn is None or conn.object_addr is None:
+            return None
+        host, port = conn.object_addr
+        return (key, str(host), int(port), node_id.hex())
+    except Exception:  # noqa: BLE001 - hints are best-effort
+        return None
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_hint", "_registered", "_ownerward",
+                 "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_hint=None):
         self._id = object_id
         self._owner_hint = owner_hint
+        # Phase-3 borrow: registered directly with the OWNER daemon
+        # (its object server tracks borrowers; bytes survive a head-side
+        # free while any borrow is held). The head pin (refs.add_local
+        # below) remains the directory-entry refcount.
+        self._ownerward = False
         # Ownership bookkeeping (reference: reference_count.h local refs):
         # every live handle holds one local reference; the owner frees the
         # value when the count hits zero.
@@ -56,10 +101,30 @@ class ObjectRef:
             if runtime is not None:
                 runtime.refs.add_local(object_id)
                 self._registered = True
+                if owner_hint is not None and \
+                        getattr(runtime, "is_client", False) and \
+                        not _is_local_node(owner_hint[3]):
+                    # Client context borrowing ANOTHER node's object:
+                    # register with the OWNER (async notice over the
+                    # process's borrow channel — enqueue only, never a
+                    # dial or send on this path). Self-node refs skip:
+                    # the creator's head pin already guards them and a
+                    # loopback borrow of your own bytes adds nothing.
+                    from ray_tpu._private.dataplane import GLOBAL_BORROWS
+                    key, host, port, _node = owner_hint
+                    GLOBAL_BORROWS.add((host, port), key)
+                    self._ownerward = True
         except Exception:  # noqa: BLE001 - never fail handle creation
             pass
 
     def __del__(self):
+        if getattr(self, "_ownerward", False):
+            try:
+                from ray_tpu._private.dataplane import GLOBAL_BORROWS
+                key, host, port, _node = self._owner_hint
+                GLOBAL_BORROWS.delete((host, port), key)
+            except Exception:  # noqa: BLE001 - interpreter teardown
+                pass
         if not getattr(self, "_registered", False):
             return
         try:
@@ -94,7 +159,12 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __reduce__(self):
-        return (ObjectRef, (self._id, self._owner_hint))
+        hint = self._owner_hint
+        if hint is None:
+            # Head process shipping a node-resident ref: stamp the
+            # owner's address so the receiver can go owner-ward.
+            hint = _head_owner_hint(self._id)
+        return (ObjectRef, (self._id, hint))
 
     # -- future interface -------------------------------------------------
 

@@ -364,7 +364,8 @@ class WorkerProcessPool:
 
     def __init__(self, store_name: Optional[str] = None,
                  max_workers: int = 64,
-                 head_address=None, node_id_hex: Optional[str] = None):
+                 head_address=None, node_id_hex: Optional[str] = None,
+                 object_addr=None):
         self.store_name = store_name
         self.max_workers = max_workers
         # Workers inherit the head address so nested ray_tpu API calls in
@@ -381,6 +382,11 @@ class WorkerProcessPool:
             overrides["RAY_TPU_HEAD_ADDRESS"] = f"{host}:{port}"
         if node_id_hex:
             overrides["RAY_TPU_NODE_ID"] = node_id_hex
+        if object_addr is not None:
+            # This node's object server: worker-side puts stamp it into
+            # owner hints so borrowers can go owner-ward (phase 3).
+            host, port = tuple(object_addr)
+            overrides["RAY_TPU_OBJECT_ADDR"] = f"{host}:{port}"
         if overrides:
             self._env_overrides = overrides
         self._idle: Dict[str, list] = {}

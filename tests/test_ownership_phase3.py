@@ -1,0 +1,149 @@
+"""Ownership phase 3: per-owner object directory.
+
+The creating node is the directory authority for its objects
+(reference: reference_count.h:61 owner-tracks-borrowers +
+ownership_based_object_directory.h — the directory asks OWNERS, not a
+central service). Refs carry an owner hint; borrowers resolve location
+and payload straight against the owner's object server, register
+borrows over an owner-ward channel whose death releases them, and the
+head keeps only node membership plus its directory entry as a failover
+hint."""
+
+import json
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.dataplane import (BorrowChannel, NodeObjectTable,
+                                        ObjectServer, fetch_remote_bytes,
+                                        stat_remote)
+
+
+# ---------------------------------------------------------------------------
+# Owner-side directory unit tests (table + object server)
+# ---------------------------------------------------------------------------
+
+
+def test_borrow_defers_free_until_release():
+    table = NodeObjectTable()
+    table.put("k", b"x" * 100)
+    assert table.borrow_add("k")
+    table.free("k")  # deferred: a borrower holds it
+    with table.pinned("k") as raw:
+        assert raw is not None and len(raw) == 100
+    table.borrow_del("k")  # last release executes the deferred free
+    with table.pinned("k") as raw:
+        assert raw is None
+
+
+def test_borrow_add_fails_for_absent_object():
+    table = NodeObjectTable()
+    assert not table.borrow_add("never-put")
+
+
+def test_owner_location_query_and_direct_fetch():
+    table = NodeObjectTable()
+    table.put("obj", b"payload-bytes")
+    server = ObjectServer(table, host="127.0.0.1")
+    try:
+        addr = ("127.0.0.1", server.port)
+        assert stat_remote(addr, "obj") == len(b"payload-bytes")
+        assert stat_remote(addr, "missing") == -1
+        assert fetch_remote_bytes(addr, "obj") == b"payload-bytes"
+    finally:
+        server.close()
+
+
+def test_borrow_channel_death_releases_borrows():
+    table = NodeObjectTable()
+    table.put("obj", b"z" * 64)
+    server = ObjectServer(table, host="127.0.0.1")
+    try:
+        ch = BorrowChannel(("127.0.0.1", server.port))
+        ch.add("obj")
+        deadline = time.monotonic() + 5
+        while table._borrows.get("obj", 0) != 1:
+            assert time.monotonic() < deadline, "borrow never registered"
+            time.sleep(0.02)
+        table.free("obj")  # deferred
+        with table.pinned("obj") as raw:
+            assert raw is not None
+        ch.close()  # channel death = borrower death
+        deadline = time.monotonic() + 5
+        while table.contains("obj"):
+            assert time.monotonic() < deadline, \
+                "channel death never released the borrow"
+            time.sleep(0.02)
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: owner-ward get without a head op
+# ---------------------------------------------------------------------------
+
+
+def _spawn_daemon(port, *, num_cpus=4, resources=None):
+    cmd = [sys.executable, "-m", "ray_tpu._private.multinode",
+           "--address", f"127.0.0.1:{port}",
+           "--num-cpus", str(num_cpus)]
+    if resources:
+        cmd += ["--resources", json.dumps(resources)]
+    return subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+@pytest.fixture
+def two_daemons(ray_start_regular):
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    procs = [_spawn_daemon(port, num_cpus=2, resources={"own": 4})
+             for _ in range(2)]
+    try:
+        deadline = time.monotonic() + 20
+        while ray_tpu.cluster_resources().get("own", 0) < 8:
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        yield port, procs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=10)
+
+
+def test_ownerward_get_skips_head(two_daemons):
+    """A borrower's get of a node-resident object is served by the
+    OWNER's object server: the client-side owner-ward counter moves,
+    the head's client.get op counter does not."""
+    from ray_tpu._private.event_stats import GLOBAL
+
+    @ray_tpu.remote(resources={"own": 1})
+    def creator():
+        return ray_tpu.put(np.ones(1 << 18, dtype=np.float64))  # 2MB
+
+    @ray_tpu.remote(resources={"own": 1})
+    def reader(wrapped):
+        from ray_tpu._private.worker import global_worker
+        rt = global_worker._runtime
+        before = getattr(rt, "ownerward_gets", 0)
+        val = ray_tpu.get(wrapped[0])
+        return float(val.sum()), getattr(rt, "ownerward_gets", 0) - before
+
+    inner_ref = ray_tpu.get(creator.remote(), timeout=60)
+    assert getattr(inner_ref, "_owner_hint", None) is not None, \
+        "node-resident ref lost its owner hint crossing the head"
+
+    def head_gets():
+        s = GLOBAL.summary().get("client.get")
+        return s["count"] if s else 0
+
+    before = head_gets()
+    total, delta = ray_tpu.get(reader.remote([inner_ref]), timeout=60)
+    assert total == float(1 << 18)
+    assert delta == 1, "reader did not resolve owner-ward"
+    assert head_gets() == before, \
+        "owner-ward get still produced a head client.get op"
