@@ -17,6 +17,7 @@ headline (reference: release/microbenchmark run_microbenchmark.py):
 from __future__ import annotations
 
 import json
+import os
 import time
 
 
@@ -204,7 +205,11 @@ def bench_shuffle_multi_daemon() -> dict:
     procs = []
     try:
         host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
-        store = int(total_bytes * 0.75)  # per daemon; headroom for 2x data
+        # Per-daemon arena sized for input + shuffled output resident at
+        # once (profiling showed the 0.75x arena spent its active time
+        # in _make_room/_spill_one disk churn, not moving bytes). Spill
+        # still covers the overflow tail; it is no longer the main path.
+        store = int(total_bytes * 1.25)
         procs = [subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.multinode",
              "--address", f"127.0.0.1:{port}", "--num-cpus", "8",
@@ -235,12 +240,122 @@ def bench_shuffle_multi_daemon() -> dict:
         from ray_tpu._private.worker import global_worker
         rt = global_worker._runtime
         for conn in rt._remote_nodes.values():
-            stats = conn.get_stats()
-            pulled += stats.get("transfer", {}).get("pulled_bytes", 0)
+            try:  # advisory: a daemon still draining spill I/O after a
+                # big run may miss the stats deadline — never fail the
+                # completed measurement over it
+                stats = conn.get_stats(timeout=30)
+                pulled += stats.get("transfer", {}).get("pulled_bytes", 0)
+            except Exception:  # noqa: BLE001
+                out["shuffle_multi_pulled_mb_partial"] = True
         out["shuffle_multi_mb_per_sec"] = round(total_bytes / 1e6 / dt, 1)
         out["shuffle_multi_data_mb"] = round(total_bytes / 1e6, 1)
         out["shuffle_multi_pulled_mb"] = round(pulled / 1e6, 1)
         out["shuffle_multi_daemons"] = 2
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        ray_tpu.shutdown()
+    return out
+
+
+def bench_envelope() -> dict:
+    """Scalability envelope on one host (reference:
+    release/benchmarks/README.md:5-12 — many_nodes / many_actors /
+    many_pgs / many_tasks, scaled to the box): 25 virtual daemons join
+    the head; then 100 placement groups schedule, 500 actors construct
+    and answer a call each, and 50k trivial tasks run through the full
+    wire path (lease streams, daemon-local dispatch, worker
+    subprocesses bypassed for speed). Records creation/submit/dispatch
+    rates and the head's RSS at peak — the quantitative probe of the
+    head's remaining centralization. Knobs:
+    RAY_TPU_BENCH_ENVELOPE_{DAEMONS,ACTORS,PGS,TASKS}."""
+    import json as _json
+    import os as _os
+    import subprocess
+    import sys
+    import time as _time
+
+    import ray_tpu
+
+    n_daemons = int(_os.environ.get("RAY_TPU_BENCH_ENVELOPE_DAEMONS", 25))
+    n_actors = int(_os.environ.get("RAY_TPU_BENCH_ENVELOPE_ACTORS", 500))
+    n_pgs = int(_os.environ.get("RAY_TPU_BENCH_ENVELOPE_PGS", 100))
+    n_tasks = int(_os.environ.get("RAY_TPU_BENCH_ENVELOPE_TASKS", 50000))
+    out: dict = {"envelope_daemons": n_daemons}
+    ray_tpu.init(num_cpus=1)
+    procs = []
+    try:
+        host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.multinode",
+             "--address", f"127.0.0.1:{port}", "--num-cpus", "2",
+             "--resources", _json.dumps({"env": 1000}),
+             "--object-store-memory", str(64 << 20)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            for _ in range(n_daemons)]
+        deadline = _time.monotonic() + 120
+        t0 = _time.monotonic()
+        while _time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("env", 0) >= \
+                    n_daemons * 1000:
+                break
+            _time.sleep(0.2)
+        else:
+            raise TimeoutError("envelope daemons never all registered")
+        out["envelope_join_s"] = round(_time.monotonic() - t0, 2)
+
+        # -- placement groups (many_pgs) --------------------------------
+        from ray_tpu.util import (placement_group,
+                                  remove_placement_group)
+        t0 = _time.perf_counter()
+        pgs = [placement_group([{"env": 1}], strategy="PACK")
+               for _ in range(n_pgs)]
+        ray_tpu.get([pg.ready() for pg in pgs], timeout=120)
+        out["envelope_pgs_per_sec"] = round(
+            n_pgs / (_time.perf_counter() - t0), 1)
+
+        # -- actors (many_actors) ---------------------------------------
+        @ray_tpu.remote(resources={"env": 1}, num_cpus=0)
+        class Ping:
+            def ping(self):
+                return 1
+
+        t0 = _time.perf_counter()
+        actors = [Ping.remote() for _ in range(n_actors)]
+        ray_tpu.get([a.ping.remote() for a in actors], timeout=300)
+        out["envelope_actors_per_sec"] = round(
+            n_actors / (_time.perf_counter() - t0), 1)
+
+        # -- tasks (many_tasks): full wire path, in-daemon execution ----
+        @ray_tpu.remote(resources={"env": 0.01}, num_cpus=0.01,
+                        runtime_env={"worker_process": False})
+        def tiny(i):
+            return i
+
+        ray_tpu.get([tiny.remote(i) for i in range(200)], timeout=120)
+        t0 = _time.perf_counter()
+        refs = [tiny.remote(i) for i in range(n_tasks)]
+        submit_dt = _time.perf_counter() - t0
+        ray_tpu.get(refs, timeout=1200)
+        total_dt = _time.perf_counter() - t0
+        out["envelope_tasks"] = n_tasks
+        out["envelope_submit_per_sec"] = round(n_tasks / submit_dt, 1)
+        out["envelope_tasks_per_sec"] = round(n_tasks / total_dt, 1)
+
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    out["envelope_head_rss_mb"] = round(
+                        int(line.split()[1]) / 1024, 1)
+                    break
+        for a in actors:
+            ray_tpu.kill(a)
+        for pg in pgs:
+            remove_placement_group(pg)
     finally:
         for p in procs:
             try:
@@ -368,6 +483,54 @@ print(json.dumps({
 algo.stop()
 ray_tpu.shutdown()
 """
+
+
+RLLIB_GROUP_BENCH_SCRIPT = """
+import json, os, time
+BATCH = 2048
+os.environ.pop("XLA_FLAGS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import ray_tpu
+ray_tpu.init(num_cpus=8)
+from ray_tpu.rllib import PPOConfig
+config = (PPOConfig()
+          .environment("CartPole-v1")
+          .rollouts(num_rollout_workers=2, rollout_fragment_length=256)
+          .training(lr=3e-4, train_batch_size=BATCH, num_sgd_iter=4,
+                    sgd_minibatch_size=512, num_learners=2)
+          .debugging(seed=0))
+algo = config.build()
+algo.train()  # warmup: shard actors compile their grad/apply programs
+t0 = time.perf_counter()
+iters = 3
+for _ in range(iters):
+    res = algo.train()
+dt = time.perf_counter() - t0
+print(json.dumps({
+    "rllib_group_env_steps_per_sec": round(iters * BATCH / dt, 1),
+    "rllib_group_num_learners": 2,
+}))
+algo.stop()
+ray_tpu.shutdown()
+"""
+
+
+def bench_rllib_learner_group() -> dict:
+    """PPO through the learner GROUP (num_learners=2 gradient-shard
+    actors; reference: trainer_runner.py): the synchronous-DP update
+    path's end-to-end env-steps/s."""
+    import json as _json
+    import subprocess
+    import sys
+
+    proc = subprocess.run([sys.executable, "-c",
+                           RLLIB_GROUP_BENCH_SCRIPT],
+                          capture_output=True, text=True, timeout=1200)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"rllib group bench failed: {proc.stderr[-1500:]}")
+    return _json.loads(proc.stdout.strip().splitlines()[-1])
 
 
 RLLIB_DAEMON_BENCH_SCRIPT = """
@@ -540,18 +703,37 @@ def _bench_gpt(preset: str, batch: int, seq: int, steps: int,
         state, metrics = step(state, data)
     float(metrics["loss"])  # full device sync (block_until_ready is not
     # sufficient on the remote-tunnel backend)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, data)
-    float(metrics["loss"])
-    dt = time.perf_counter() - t0
-    tokens_per_sec = batch * seq * steps / dt
+    # SEGMENTED timing (r5): one continuous span produced a single dt
+    # with zero distribution info — r03/r04 reported bit-identical
+    # headlines and nothing could distinguish staleness from stability.
+    # Three synced segments cost one extra pipeline drain each but give
+    # a mean/std every run; the std is the tell (a reused/stale number
+    # would repeat exactly, a live run varies at the ms level).
+    n_segments = 3 if steps >= 3 else 1
+    per = max(1, steps // n_segments)
+    seg_times = []
+    for s in range(n_segments):
+        t0 = time.perf_counter()
+        for _ in range(per):
+            state, metrics = step(state, data)
+        float(metrics["loss"])
+        seg_times.append(time.perf_counter() - t0)
+    total_steps = per * n_segments
+    dt = sum(seg_times)
+    tokens_per_sec = batch * seq * total_steps / dt
+    per_step = [t / per for t in seg_times]
+    step_mean = dt / total_steps
+    step_std = (sum((t - step_mean) ** 2 for t in per_step)
+                / len(per_step)) ** 0.5
     # Training FLOPs: 6N per token (fwd+bwd; remat recompute is not
     # counted as useful FLOPs — standard MFU convention) + attention.
     flops_per_token = 6.0 * cfg.num_params() + \
         12 * cfg.n_layers * cfg.d_model * seq
     mfu = tokens_per_sec * flops_per_token / _peak_flops(device)
-    return {"tokens_per_sec": tokens_per_sec, "mfu": mfu}
+    return {"tokens_per_sec": tokens_per_sec, "mfu": mfu,
+            "step_time_mean_s": round(step_mean, 5),
+            "step_time_std_s": round(step_std, 5),
+            "segment_s": [round(t, 4) for t in seg_times]}
 
 
 def bench_gptj6b(device) -> dict:
@@ -609,6 +791,30 @@ def bench_gptj6b(device) -> dict:
     # largest trainable point. The 6b config itself trains with >=2
     # chips under fsdp (dryrun_multichip compiles that program).
     out["gptj6b_note"] = note
+    try:
+        # Mesh proof: lower the REAL 6b fsdp=8 program on the virtual
+        # CPU mesh (own process: it pins jax_platforms=cpu) and record
+        # XLA's per-device memory analysis — "fits with these bytes",
+        # not just "compiles" (__graft_entry__.memory_proof_6b).
+        import json as _json
+        import subprocess
+        import sys
+        here = os.path.dirname(os.path.abspath(__file__))
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import sys, json; sys.path.insert(0, %r); "
+             "import __graft_entry__ as g; "
+             "print(json.dumps(g.memory_proof_6b(8)))" % here],
+            capture_output=True, text=True, timeout=900)
+        if proc.returncode == 0:
+            proof = _json.loads(proc.stdout.strip().splitlines()[-1])
+            out["gptj6b_fsdp8_need_bytes_per_device"] = \
+                proof["per_device_need_bytes"]
+            out["gptj6b_fsdp8_fits_v5e"] = proof["fits"]["v5e"]
+        else:
+            out["gptj6b_proof_error"] = proc.stderr[-500:]
+    except Exception as exc:  # noqa: BLE001
+        out["gptj6b_proof_error"] = repr(exc)[:500]
     # Swept v5e: batch 4/0.5566, 6/0.5685, 8/0.5701 MFU — 8 is the
     # largest that fits with full remat and the knee of the curve.
     m = _bench_gpt("gpt-2.7b", batch=8, seq=1024, steps=4, warmup=2,
@@ -620,6 +826,95 @@ def bench_gptj6b(device) -> dict:
     out["gpt2_7b_tokens_per_sec"] = round(m["tokens_per_sec"], 1)
     out["gpt2_7b_mfu"] = round(m["mfu"], 4)
     return out
+
+
+def _prior_round_bench():
+    """Latest BENCH_r{N}.json next to this file (the driver records one
+    per round); returns its parsed result dict or None."""
+    import glob
+    import re as _re
+    here = os.path.dirname(os.path.abspath(__file__))
+    best_n, best = -1, None
+    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = _re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        n = int(m.group(1))
+        if n > best_n:
+            best_n, best = n, path
+    if best is None:
+        return None, None
+    try:
+        with open(best) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None, None
+    return rec.get("parsed") or rec, os.path.basename(best)
+
+
+def _regression_gate(extra: dict, headline_value: float) -> None:
+    """Compare throughput metrics against the prior round's recorded
+    bench (reference: release microbenchmark trend tracking). A >=10%
+    drop WARNS on stderr and is recorded in extra['regressions'] so it
+    can never again go unnoticed for two rounds (tasks_per_sec fell
+    10,349 -> 7,481 across r02-r04 silently)."""
+    import re as _re
+    import sys as _sys
+    prev, name = _prior_round_bench()
+    if not prev:
+        return
+    extra["regression_baseline"] = name
+    prev_extra = prev.get("extra") or {}
+    regressions = []
+    pattern = _re.compile(r"(per_sec|_qps|_mfu|mb_per_sec)$")
+    for k, old in prev_extra.items():
+        if not isinstance(old, (int, float)) or old <= 0:
+            continue
+        if not pattern.search(k):
+            continue
+        new = extra.get(k)
+        if isinstance(new, (int, float)) and new < 0.9 * old:
+            drop = round(100 * (1 - new / old), 1)
+            regressions.append({"metric": k, "prev": old, "now": new,
+                                "drop_pct": drop})
+            print(f"REGRESSION WARNING: {k} {old} -> {new} "
+                  f"(-{drop}%) vs {name}", file=_sys.stderr)
+    prev_head = prev.get("value")
+    if isinstance(prev_head, (int, float)) and prev_head > 0 and \
+            headline_value < 0.9 * prev_head:
+        drop = round(100 * (1 - headline_value / prev_head), 1)
+        regressions.append({"metric": "headline", "prev": prev_head,
+                            "now": headline_value, "drop_pct": drop})
+        print(f"REGRESSION WARNING: headline {prev_head} -> "
+              f"{headline_value} (-{drop}%) vs {name}", file=_sys.stderr)
+    if regressions:
+        extra["regressions"] = regressions
+
+
+def _recapture_microbench(extra: dict) -> None:
+    """Refresh MICROBENCH.json every bench run (reference:
+    release/microbenchmark runs nightly) so core-ops trends get a data
+    point per round instead of a stale r2-era snapshot."""
+    import datetime
+    import platform
+
+    from ray_tpu._private import ray_perf
+    results = ray_perf.main(duration=1.0)
+    here = os.path.dirname(os.path.abspath(__file__))
+    doc = {
+        "recorded": datetime.date.today().isoformat(),
+        "host": {"machine": platform.machine(),
+                 "cpus": os.cpu_count()},
+        "note": ("Core ops/s microbenchmarks (reference: "
+                 "_private/ray_perf.py:93 + release/microbenchmark). "
+                 "Reproduce: `ray-tpu microbenchmark`. Re-captured by "
+                 "every bench.py run."),
+        "results": results,
+    }
+    with open(os.path.join(here, "MICROBENCH.json"), "w") as f:
+        json.dump(doc, f, indent=1)
+    extra["microbench"] = {r["name"]: round(r["ops_per_s"], 1)
+                           for r in results}
 
 
 def main():
@@ -676,10 +971,13 @@ def main():
         ("rllib", "rllib_env_steps_per_sec", bench_rllib),
         ("rllib_daemon", "rllib_daemon_env_steps_per_sec",
          bench_rllib_daemons),
+        ("rllib_group", "rllib_group_env_steps_per_sec",
+         bench_rllib_learner_group),
         ("shuffle", "shuffle_mb_per_sec", bench_data_shuffle),
         ("serve", "serve_qps", bench_serve),
         ("shuffle_multi", "shuffle_multi_mb_per_sec",
          bench_shuffle_multi_daemon),
+        ("envelope", "envelope_tasks_per_sec", bench_envelope),
     ]
     if on_tpu:
         extras_suite.append(
@@ -693,9 +991,30 @@ def main():
             extra.setdefault(metric, None)
             extra[f"{key}_error"] = repr(exc)[:800]
 
+    try:
+        _recapture_microbench(extra)
+    except Exception as exc:  # noqa: BLE001
+        extra["microbench_error"] = repr(exc)[:800]
+
+    # Run identity + distribution: a stale/reused result is now
+    # distinguishable from a stable one (unique nonce, per-run stddev).
+    import time as _time
+    import uuid as _uuid
+    extra["run_nonce"] = _uuid.uuid4().hex
+    extra["run_unix_time"] = round(_time.time(), 1)
+    for k in ("step_time_mean_s", "step_time_std_s", "segment_s"):
+        if k in head:
+            extra[f"headline_{k}"] = head[k]
+
+    headline_value = round(tokens_per_sec, 1)
+    try:
+        _regression_gate(extra, headline_value)
+    except Exception as exc:  # noqa: BLE001
+        extra["regression_gate_error"] = repr(exc)[:800]
+
     result = {
         "metric": f"{preset}_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
+        "value": headline_value,
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
         "extra": extra,

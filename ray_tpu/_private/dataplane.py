@@ -291,8 +291,14 @@ class NodeObjectTable:
             return None
         self._bump("restored_bytes", size)
         self._bump("restores")
-        promoted = self._arena.put_bytes(key, data) or \
-            (self._make_room(size) and self._arena.put_bytes(key, data))
+        # OPPORTUNISTIC promotion only: when the working set overflows
+        # the arena, forcing room (spilling OTHER live objects to admit
+        # this one) degenerates into restore-A-spills-B / restore-B-
+        # spills-A disk thrash — a 10GB shuffle spent its wall clock in
+        # exactly that loop. A full arena means the read is served from
+        # the bytes in hand; the entry stays on disk (scan-resistant,
+        # like plasma's no-evict-for-reads policy).
+        promoted = self._arena.put_bytes(key, data)
         if promoted:
             # Cleanup must serialize against _spill_one (which runs
             # wholly under _spill_lock): a pressure pass may have
@@ -407,11 +413,18 @@ class NodeObjectTable:
     def adopt(self, key: str, size: int) -> bool:
         """Take bookkeeping ownership of an arena entry written directly
         by a sibling process (worker-subprocess put): register its size
-        so spill liveness sees it, and confirm residency. The re-check
-        closes the race with a spill pass discarding the pre-adoption
-        entry (its liveness check fails for keys without _sizes).
-        False = already evicted — the caller must fall back."""
-        if self._arena is None or not self._arena.contains(key):
+        so spill liveness sees it, and confirm residency. A pressure
+        pass may have SPILLED the pre-adoption entry to disk already —
+        that copy is just as adoptable (the table serves it via
+        _read_spilled); only a truly absent key fails. The re-check
+        closes the race with a spill pass DISCARDING the entry (its
+        liveness check fails for keys without _sizes).
+        False = already evicted everywhere — the caller must fall back."""
+        if self._arena is None:
+            return False
+        with self._lock:
+            spilled = key in self._spilled
+        if not spilled and not self._arena.contains(key):
             return False
         with self._lock:
             self._sizes[key] = size
@@ -419,6 +432,8 @@ class NodeObjectTable:
         if self.contains(key):
             return True
         with self._lock:
+            if key in self._spilled:  # landed on disk mid-adoption
+                return True
             self._sizes.pop(key, None)
         return False
 
@@ -565,15 +580,34 @@ class NodeObjectTable:
                 self._register_spill(key, path, size, drop_arena=False)
                 return
             if off is not None:
-                written = 0
                 try:
-                    while written < size:
-                        chunk = sock.recv(min(CHUNK_SIZE, size - written))
-                        if not chunk:
-                            raise ConnectionError(
-                                "peer closed mid-transfer")
-                        self._arena.write_at(off + written, chunk)
-                        written += len(chunk)
+                    # Zero-copy landing: recv straight into the shm
+                    # mapping (no intermediate bytes + second memcpy).
+                    wview = self._arena.writable_view(off, size)
+                    if wview is not None:
+                        received = 0
+                        try:
+                            while received < size:
+                                n = sock.recv_into(
+                                    wview[received:],
+                                    min(CHUNK_SIZE, size - received))
+                                if n == 0:
+                                    raise ConnectionError(
+                                        "peer closed mid-transfer")
+                                received += n
+                        finally:
+                            with contextlib.suppress(BufferError):
+                                wview.release()
+                    else:
+                        written = 0
+                        while written < size:
+                            chunk = sock.recv(
+                                min(CHUNK_SIZE, size - written))
+                            if not chunk:
+                                raise ConnectionError(
+                                    "peer closed mid-transfer")
+                            self._arena.write_at(off + written, chunk)
+                            written += len(chunk)
                 except BaseException:
                     # Abort, never seal: a seal would momentarily publish
                     # the half-written payload to concurrent readers.
@@ -698,42 +732,49 @@ class ObjectServer:
                              daemon=True).start()
 
     def _serve_one(self, sock: socket.socket) -> None:
+        """Keep-alive request loop: peers pool their connections and
+        issue many pulls per socket (one TCP+thread setup amortized
+        over a whole shuffle, like the reference's persistent
+        object-manager RPC channels). The 30s idle timeout reaps
+        abandoned pooled connections."""
         try:
-            sock.settimeout(30)
-            (klen,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-            if klen <= 0 or klen > 4096:
-                return  # garbage request; keys are short
-            key = _recv_exact(sock, klen).decode()
-            if key == "!borrow":
-                # Persistent borrow channel: this connection IS the
-                # borrower's liveness token (ownership phase 3) — its
-                # death releases everything it registered, exactly like
-                # a head client-session's pins.
-                self._serve_borrow_channel(sock)
-                return
-            if key.startswith("?"):
-                # Location query answered by the OWNER, not the head
-                # (reference: ownership_based_object_directory.h — the
-                # directory asks owners). Size from the records only —
-                # never materializes a spilled payload.
-                sock.sendall(_LEN.pack(self.table.stat(key[1:])))
-                return
-            # The pin spans the whole send: a concurrent free cannot
-            # recycle the region under us mid-transfer.
-            with self.table.pinned(key) as payload:
-                if payload is None:
-                    sock.sendall(_LEN.pack(-1))
+            while True:
+                sock.settimeout(30)
+                (klen,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+                if klen <= 0 or klen > 4096:
+                    return  # garbage request; keys are short
+                key = _recv_exact(sock, klen).decode()
+                if key == "!borrow":
+                    # Persistent borrow channel: this connection IS the
+                    # borrower's liveness token (ownership phase 3) —
+                    # its death releases everything it registered,
+                    # exactly like a head client-session's pins.
+                    self._serve_borrow_channel(sock)
                     return
-                size = len(payload)
-                sock.sendall(_LEN.pack(size))
-                sent = 0
-                while sent < size:
-                    # Transient slices only: nothing may still export the
-                    # pinned view's buffer when the context exits.
-                    sent += sock.send(payload[sent:sent + CHUNK_SIZE])
-            self.table._bump("served_bytes", size)
-            self.table._bump("serves")
-        except (OSError, ConnectionError):
+                if key.startswith("?"):
+                    # Location query answered by the OWNER, not the
+                    # head (reference: ownership_based_object_directory
+                    # — the directory asks owners). Size from the
+                    # records only — never materializes spilled bytes.
+                    sock.sendall(_LEN.pack(self.table.stat(key[1:])))
+                    continue
+                # The pin spans the whole send: a concurrent free
+                # cannot recycle the region under us mid-transfer.
+                with self.table.pinned(key) as payload:
+                    if payload is None:
+                        sock.sendall(_LEN.pack(-1))
+                        continue
+                    size = len(payload)
+                    sock.sendall(_LEN.pack(size))
+                    sent = 0
+                    while sent < size:
+                        # Transient slices only: nothing may still
+                        # export the pinned view's buffer when the
+                        # context exits.
+                        sent += sock.send(payload[sent:sent + CHUNK_SIZE])
+                self.table._bump("served_bytes", size)
+                self.table._bump("serves")
+        except (OSError, ConnectionError, struct.error):
             pass
         finally:
             try:
@@ -917,12 +958,21 @@ def stat_remote(addr: Tuple[str, int], key: str,
                 timeout: float = 10.0) -> int:
     """Owner-ward location query: payload size if resident, -1 if not.
     Never touches the head (phase-3 'directory asks the owner')."""
-    with socket.create_connection(tuple(addr), timeout=timeout) as sock:
-        sock.settimeout(timeout)
+    sock, reused = GLOBAL_PEER_CONNS.acquire(tuple(addr), timeout)
+    try:
         kb = ("?" + key).encode()
         sock.sendall(_LEN.pack(len(kb)) + kb)
         (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-        return size
+    except (OSError, ConnectionError, struct.error):
+        try:
+            sock.close()
+        except OSError:
+            pass
+        if not reused:
+            raise
+        return stat_remote(addr, key, timeout)  # stale pooled socket
+    GLOBAL_PEER_CONNS.release(tuple(addr), sock)
+    return size
 
 
 def fetch_remote_bytes(addr: Tuple[str, int], key: str,
@@ -930,20 +980,35 @@ def fetch_remote_bytes(addr: Tuple[str, int], key: str,
     """Pull one object's payload straight into memory (contexts without
     a local NodeObjectTable — e.g. worker subprocesses resolving a
     borrowed ref). Raises ObjectPullError when absent/unreachable."""
-    try:
-        with socket.create_connection(tuple(addr),
-                                      timeout=timeout) as sock:
-            sock.settimeout(timeout)
+    stale_retry = True
+    while True:
+        sock = reused = None
+        try:
+            sock, reused = GLOBAL_PEER_CONNS.acquire(tuple(addr), timeout)
             kb = key.encode()
             sock.sendall(_LEN.pack(len(kb)) + kb)
             (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
             if size < 0:
+                GLOBAL_PEER_CONNS.release(tuple(addr), sock)
                 raise ObjectPullError(
                     f"object {key} is not resident on {addr}")
-            return _recv_exact(sock, size)
-    except (OSError, ConnectionError) as exc:
-        raise ObjectPullError(
-            f"direct fetch of {key} from {addr} failed: {exc}") from exc
+            data = _recv_exact(sock, size)
+            GLOBAL_PEER_CONNS.release(tuple(addr), sock)
+            return data
+        except ObjectPullError:
+            raise
+        except (OSError, ConnectionError, struct.error) as exc:
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            if reused and stale_retry:
+                stale_retry = False
+                continue
+            raise ObjectPullError(
+                f"direct fetch of {key} from {addr} failed: "
+                f"{exc}") from exc
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -956,44 +1021,112 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+class _PeerConns:
+    """Pooled keep-alive connections to peer object servers. One pull
+    used to pay a fresh TCP handshake + server thread spawn; pooling
+    amortizes both across a shuffle's thousands of pulls (reference:
+    object_manager keeps persistent RPC channels per peer)."""
+
+    MAX_IDLE_PER_ADDR = 8
+
+    def __init__(self):
+        self._idle: Dict[Tuple[str, int], list] = {}
+        self._lock = threading.Lock()
+
+    def acquire(self, addr: Tuple[str, int],
+                timeout: float) -> Tuple[socket.socket, bool]:
+        """Returns (socket, reused). A reused socket may be stale (the
+        server reaped it idle) — the caller retries on a fresh one."""
+        addr = tuple(addr)
+        with self._lock:
+            lst = self._idle.get(addr)
+            if lst:
+                sock = lst.pop()
+                sock.settimeout(timeout)
+                return sock, True
+        sock = socket.create_connection(addr, timeout=timeout)
+        sock.settimeout(timeout)
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        return sock, False
+
+    def release(self, addr: Tuple[str, int], sock: socket.socket) -> None:
+        addr = tuple(addr)
+        with self._lock:
+            lst = self._idle.setdefault(addr, [])
+            if len(lst) < self.MAX_IDLE_PER_ADDR:
+                lst.append(sock)
+                return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            socks = [s for lst in self._idle.values() for s in lst]
+            self._idle.clear()
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+GLOBAL_PEER_CONNS = _PeerConns()
+
+
 def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
                 timeout: float = 30.0, retries: int = 2,
                 priority: int = PULL_PRIORITY_GET) -> None:
     """Pull one object from a peer's object server into the local table
-    (read it back with ``table.pinned``). Retries transient connect
-    failures; raises ObjectPullError when the owner is unreachable or
-    lacks the object. In-flight bytes are bounded by the table's
-    PullAdmission (if set): the size header is read first, admission is
-    acquired for the body (args-first priority), released when the body
-    lands."""
+    (read it back with ``table.pinned``). Connections are pooled and
+    kept alive; a stale pooled socket retries on a fresh one without
+    consuming a retry budget. Raises ObjectPullError when the owner is
+    unreachable or lacks the object. In-flight bytes are bounded by the
+    table's PullAdmission (if set): the size header is read first,
+    admission is acquired for the body (args-first priority), released
+    when the body lands."""
     last: Optional[BaseException] = None
     admission = getattr(table, "admission", None)
-    for _ in range(retries + 1):
+    attempts = 0
+    while attempts <= retries:
+        sock = reused = None
         try:
-            with socket.create_connection(tuple(addr),
-                                          timeout=timeout) as sock:
-                sock.settimeout(timeout)
-                kb = key.encode()
-                sock.sendall(_LEN.pack(len(kb)) + kb)
-                (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-                if size < 0:
-                    raise ObjectPullError(
-                        f"object {key} is not resident on {addr} "
-                        "(freed or evicted before the pull)")
+            sock, reused = GLOBAL_PEER_CONNS.acquire(tuple(addr), timeout)
+            kb = key.encode()
+            sock.sendall(_LEN.pack(len(kb)) + kb)
+            (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+            if size < 0:
+                GLOBAL_PEER_CONNS.release(tuple(addr), sock)
+                raise ObjectPullError(
+                    f"object {key} is not resident on {addr} "
+                    "(freed or evicted before the pull)")
+            if admission is not None:
+                admission.acquire(size, priority)
+            try:
+                table.recv_into(key, size, sock)
+            finally:
                 if admission is not None:
-                    admission.acquire(size, priority)
+                    admission.release(size)
+            table._bump("pulled_bytes", size)
+            table._bump("pulls")
+            GLOBAL_PEER_CONNS.release(tuple(addr), sock)
+            return
+        except ObjectPullError:
+            raise
+        except (OSError, ConnectionError, struct.error) as exc:
+            if sock is not None:
                 try:
-                    table.recv_into(key, size, sock)
-                finally:
-                    if admission is not None:
-                        admission.release(size)
-                table._bump("pulled_bytes", size)
-                table._bump("pulls")
-                return
-        except ObjectPullError as exc:
-            raise exc
-        except (OSError, ConnectionError) as exc:
+                    sock.close()
+                except OSError:
+                    pass
             last = exc
+            if reused:
+                continue  # stale pooled socket: free retry on fresh TCP
+            attempts += 1
             import time
             time.sleep(0.2)
     raise ObjectPullError(

@@ -111,6 +111,34 @@ def _loads(data: bytes) -> Any:
     return serialization.deserialize(data)
 
 
+def _encode_frame(msg: dict) -> bytes:
+    """Typed binary layout for hot-path ops (wire.py phase 2), pickle
+    envelope for everything else."""
+    b = _wire.encode_typed(msg)
+    return b if b is not None else _dumps(msg)
+
+
+def _decode_frames(raw: bytes) -> list:
+    """Decode one wire frame into its message dict(s): binary batches
+    and legacy dict batches both flatten to a list."""
+    parts = _wire.decode_batch(raw)
+    if parts is not None:
+        return [_decode_one(p) for p in parts]
+    msg = _decode_one(raw)
+    if isinstance(msg, dict) and msg.get("type") in ("task_batch",
+                                                     "reply_batch"):
+        # Legacy dict batch: validate the envelope before touching its
+        # fields — a drifted peer fails with the exact field name.
+        _wire.validate_message(msg)
+        return list(msg["msgs"])
+    return [msg]
+
+
+def _decode_one(raw: bytes):
+    msg = _wire.decode_typed(raw)
+    return msg if msg is not None else _loads(raw)
+
+
 def _args_are_plain(args, kwargs) -> bool:
     """True when no top-level arg is a data-plane marker (the only
     place the head ever puts one — see Runtime._resolve_args)."""
@@ -216,11 +244,13 @@ class _CoalescingSender:
                 self._cv.notify_all()  # backpressured senders re-check
             try:
                 if len(batch) == 1:
-                    _send_frame(self._sock, _dumps(batch[0]))
+                    _send_frame(self._sock, _encode_frame(batch[0]))
                 else:
-                    _send_frame(self._sock, _dumps(
-                        {"type": self._batch_type, "req_id": 0,
-                         "msgs": batch}))
+                    # Binary batch: each message encodes ONCE (typed or
+                    # pickle), then the parts concatenate — no second
+                    # pickling of the accumulated payload bytes.
+                    _send_frame(self._sock, _wire.encode_batch(
+                        [_encode_frame(m) for m in batch]))
             except OSError:
                 self._done_sending()
                 self.close()
@@ -235,7 +265,7 @@ class _CoalescingSender:
                 # one that cannot serialize.
                 for msg in batch:
                     try:
-                        _send_frame(self._sock, _dumps(msg))
+                        _send_frame(self._sock, _encode_frame(msg))
                     except OSError:
                         self._done_sending()
                         self.close()
@@ -400,11 +430,7 @@ class NodeConnection:
         dispatch) never stalls the reply stream."""
         try:
             while True:
-                frame = _loads(_recv_frame(self._sock))
-                if frame.get("type") == "reply_batch":
-                    replies = frame["msgs"]
-                else:
-                    replies = (frame,)
+                replies = _decode_frames(_recv_frame(self._sock))
                 for reply in replies:
                     with self._lock:
                         waiter = self._pending.pop(
@@ -421,7 +447,7 @@ class NodeConnection:
                     # over the spec, whose args hold ObjectRefs — a
                     # refcount leak).
                     del waiter, reply
-                del frame, replies
+                del replies
         except (ConnectionError, OSError):
             pass
         finally:
@@ -1463,6 +1489,7 @@ class NodeDaemon:
             "RAY_TPU_DAEMON_WORKER_PROCESSES", "1") != "0"
         self._pool = None
         self._pool_lock = threading.Lock()
+        self._prefetch_pool = None  # lazy; parallel task-arg pulls
         self._prestarted = False
         self._session_registered = False
         self._health_started = False
@@ -1655,6 +1682,7 @@ class NodeDaemon:
         from ray_tpu._private.dataplane import (PULL_PRIORITY_TASK_ARGS,
                                                 ObjectMarker,
                                                 ObjectPullError, pull_object)
+        self._prefetch_marker_args(args, kwargs)
 
         def resolve(a):
             if isinstance(a, (ObjectMarker, RemoteArgMarker)):
@@ -1709,6 +1737,42 @@ class NodeDaemon:
             or renv.get("venv") or renv.get("conda")
             or renv.get("container"))
 
+    def _prefetch_marker_args(self, args, kwargs) -> None:
+        """Pull a task's missing peer-owned argument payloads in
+        PARALLEL before the sequential resolve walk (reference:
+        pull_manager batches a task's arg pulls; one-at-a-time pulls
+        made a 32-arg reduce task pay 32 serial round-trips). Errors
+        are swallowed here — resolve() re-pulls the stragglers and
+        raises with full context."""
+        from ray_tpu._private.dataplane import (PULL_PRIORITY_TASK_ARGS,
+                                                ObjectMarker, pull_object)
+        missing = {}
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, (ObjectMarker, RemoteArgMarker)):
+                owner = getattr(a, "owner_addr", None)
+                if owner is not None and a.key not in missing and \
+                        not self._table.contains(a.key):
+                    missing[a.key] = tuple(owner)
+        if len(missing) < 2:
+            return  # a single pull gains nothing from the pool
+        pool = self._prefetch_pool
+        if pool is None:
+            import concurrent.futures as _cf
+            with self._pool_lock:
+                pool = self._prefetch_pool
+                if pool is None:
+                    # PERSISTENT: a per-task executor would pay thread
+                    # spawn/join on every multi-arg dispatch.
+                    pool = _cf.ThreadPoolExecutor(
+                        8, thread_name_prefix="ray_tpu-prefetch")
+                    self._prefetch_pool = pool
+        futures = [
+            pool.submit(pull_object, owner, key, self._table,
+                        priority=PULL_PRIORITY_TASK_ARGS)
+            for key, owner in missing.items()]
+        for f in futures:
+            f.exception()  # wait; failures re-raise in resolve()
+
     def _resolve_markers_for_worker(self, args, kwargs):
         """Like _resolve_markers, but arena-resident payloads stay as
         ArenaRef markers: the worker attaches the same shm arena and
@@ -1724,6 +1788,7 @@ class NodeDaemon:
                                                 ObjectMarker,
                                                 ObjectPullError, pull_object)
         from ray_tpu._private.worker_process import ArenaRef
+        self._prefetch_marker_args(args, kwargs)
         pinned: list = []
 
         def _pin_in_arena(arena, key) -> bool:
@@ -1824,6 +1889,14 @@ class NodeDaemon:
                 args_payload = _dumps((args, kwargs))
             fn_id = msg["fn_id"]
 
+            # Big results write straight into the shared arena
+            # worker-side (no stdio pipe copy); the daemon adopts the
+            # entries below. Multi-returns split per element in the
+            # worker (a shuffle map's partitions each land separately).
+            arena_limit = 0
+            if self._table.arena_name is not None:
+                arena_limit = int(msg.get("store_limit", 0) or 0)
+
             def build(fn_bytes):
                 renv = {k: v for k, v in (msg.get("runtime_env")
                                           or {}).items()
@@ -1837,6 +1910,8 @@ class NodeDaemon:
                     "runtime_env": renv,
                     "name": msg.get("name", "task"),
                     "task_id": msg.get("task_id"),
+                    "arena_limit": arena_limit,
+                    "num_returns": msg.get("num_returns", 1),
                 }
 
             def fn_payload():
@@ -1872,6 +1947,55 @@ class NodeDaemon:
                     lease_ex.worker_handle = None
             else:
                 pool.release(handle)
+        if reply.get("ok") and "arena_key" in reply:
+            # Worker wrote the result straight into the shared arena:
+            # take bookkeeping ownership and answer the head with a
+            # stub — zero result bytes through daemon or head.
+            key, size = reply["arena_key"], int(reply["size"])
+            if self._table.adopt(key, size):
+                self._send_reply(sock, {"req_id": req_id, "ok": True,
+                                        "stored_key": key, "size": size})
+            else:
+                # Evicted between the worker's put and adoption (only
+                # possible on eviction-mode arenas): ObjectPullError is
+                # system-retriable — the head re-runs the task instead
+                # of surfacing a user failure.
+                from ray_tpu._private.dataplane import ObjectPullError
+                self._reply(sock, req_id, error=ObjectPullError(
+                    f"worker result {key} vanished from the arena "
+                    "before adoption"))
+            return
+        if reply.get("ok") and "parts" in reply:
+            # Per-element worker results: arena entries get adopted;
+            # inline elements bigger than the stub limit still stay
+            # daemon-resident via table.put (arena was full).
+            store_limit = msg.get("store_limit", 0)
+            out_parts = []
+            inline_bytes = 0
+            for i, p in enumerate(reply["parts"]):
+                if "arena_key" in p:
+                    if not self._table.adopt(p["arena_key"], p["size"]):
+                        from ray_tpu._private.dataplane import \
+                            ObjectPullError
+                        self._reply(sock, req_id, error=ObjectPullError(
+                            f"worker result {p['arena_key']} vanished "
+                            "from the arena before adoption"))
+                        return
+                    out_parts.append({"stored_key": p["arena_key"],
+                                      "size": p["size"]})
+                elif store_limit and len(p["value"]) > store_limit:
+                    key = (f"obj-{self._uid}-s{self._session_n}-"
+                           f"{req_id}-r{i}")
+                    self._table.put(key, p["value"])
+                    out_parts.append({"stored_key": key,
+                                      "size": len(p["value"])})
+                else:
+                    out_parts.append({"value": p["value"]})
+                    inline_bytes += len(p["value"])
+            self._send_reply(sock, {"req_id": req_id, "ok": True,
+                                    "parts": out_parts},
+                             nbytes=inline_bytes)
+            return
         if reply.get("ok"):
             payload = reply["value"]
             store_limit = msg.get("store_limit", 0)
@@ -2198,18 +2322,15 @@ class NodeDaemon:
         self._reply_senders[session_sock] = sender
         try:
             while not self._stop.is_set():
-                frame = _loads(_recv_frame(self._sock))
-                # Inbound control frames are schema-checked before any
-                # handler sees them: a head from another build fails
-                # HERE with the exact field, not deep in a handler.
-                _wire.validate_message(frame)
-                if frame.get("type") == "task_batch":
-                    msgs = frame["msgs"]
-                else:
-                    msgs = (frame,)
+                msgs = _decode_frames(_recv_frame(self._sock))
                 for msg in msgs:
-                    if msg is not frame:
-                        _wire.validate_message(msg)
+                    # Inbound control frames are schema-checked before
+                    # any handler sees them: a head from another build
+                    # fails HERE with the exact field, not deep in a
+                    # handler. (Typed binary frames are validated by
+                    # construction, but the decoded dict re-checks —
+                    # one rule set for both encodings.)
+                    _wire.validate_message(msg)
                     if not self._route_frame(msg):
                         self._stop.set()
                         break

@@ -100,6 +100,7 @@ class NativeObjectStore:
             self._map = mmap.mmap(fd, real_size, prot=mmap.PROT_READ)
         finally:
             os.close(fd)
+        self._wmap = None  # lazy write mapping (writable_view)
         self._closed = False
 
     # -- raw bytes -------------------------------------------------------
@@ -137,6 +138,25 @@ class NativeObjectStore:
 
     def write_at(self, offset: int, chunk: bytes) -> None:
         self._lib.shm_store_write(self._handle, offset, chunk, len(chunk))
+
+    def writable_view(self, offset: int, size: int):
+        """Writable memoryview over an UNSEALED create() allocation, so
+        network receives can land straight in shm (recv_into — no
+        intermediate bytes object, no second memcpy). None when a
+        write mapping cannot be made. Only the creating thread may
+        touch the region before seal()."""
+        wmap = self._wmap
+        if wmap is None:
+            try:
+                fd = os.open(f"/dev/shm{self.name}", os.O_RDWR)
+                try:
+                    wmap = mmap.mmap(fd, self.capacity)
+                finally:
+                    os.close(fd)
+                self._wmap = wmap
+            except OSError:
+                return None
+        return memoryview(wmap)[offset:offset + size]
 
     def seal(self, object_id: str) -> None:
         self._lib.shm_store_seal(self._handle, object_id.encode())
@@ -213,6 +233,12 @@ class NativeObjectStore:
         if unlink:
             self._lib.shm_store_unlink(self._handle)
         self._lib.shm_store_close(self._handle)
+        if self._wmap is not None:
+            try:
+                self._wmap.close()
+            except BufferError:
+                pass
+            self._wmap = None
         try:
             self._map.close()
         except BufferError:

@@ -3026,6 +3026,11 @@ class Runtime:
             self._process_pool.shutdown()
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
+        # Pooled data-plane sockets + owner borrow channels die with the
+        # runtime — idle keep-alive connections to (possibly dead)
+        # peers must not outlive it as CLOSE_WAIT fds.
+        from ray_tpu._private import dataplane as _dp
+        _dp.GLOBAL_PEER_CONNS.close()
         # The GC thread must be fully stopped BEFORE the native store is
         # closed: a free() racing close() would touch an unmapped arena
         # (segfault). Wake it, let it observe _shutdown, and join.

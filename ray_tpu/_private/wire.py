@@ -23,7 +23,11 @@ from typing import Any, Dict, Tuple
 #: Bump on any incompatible control-channel change (see module doc).
 #: v2: task_batch / reply_batch coalesced frames (either peer may emit
 #: them, so a v1 peer would fail on an unknown type).
-PROTOCOL_VERSION = 2
+#: v3: typed binary layouts for the hot ops (execute_task, value/
+#: stored/error replies, fetch_object) + binary batch frames — frames
+#: are discriminated by leading magic byte (0x01 typed, 0x02 batch,
+#: 0x80 cloudpickle envelope).
+PROTOCOL_VERSION = 3
 
 
 class WireSchemaError(ValueError):
@@ -237,3 +241,251 @@ def check_peer_protocol(peer_version, peer_desc: str) -> None:
             f"v{peer_version if peer_version is not None else '<pre-1>'} "
             f"but this process speaks v{PROTOCOL_VERSION}; upgrade the "
             "older side — mixed-version clusters are not supported")
+
+
+# ---------------------------------------------------------------------------
+# Phase-2 typed BINARY encodings for the hot-path ops (reference: the
+# proto contract compiles task/result messages to fixed wire layouts,
+# core_worker.proto:389 PushTaskRequest/Reply). The five hottest frame
+# kinds — task push, inline-value result, stored-result stub, error
+# result, and object fetch — get hand-packed struct layouts; user
+# payloads stay opaque bytes inside them (pickled once, by the layer
+# that owns them — the frame itself adds zero pickle tax). Everything
+# else falls back to the cloudpickle envelope.
+#
+# Frame discrimination is by leading magic byte: cloudpickle protocol-2+
+# streams always begin 0x80, so 0x01 (typed) and 0x02 (batch) are
+# unambiguous. decode_typed returns None for non-typed frames.
+# ---------------------------------------------------------------------------
+
+import struct as _struct
+
+MAGIC_TYPED = 0x01
+MAGIC_BATCH = 0x02
+
+_OP_EXECUTE_TASK = 0x01
+_OP_REPLY_VALUE = 0x02
+_OP_REPLY_STORED = 0x03
+_OP_REPLY_ERROR = 0x04
+_OP_REPLY_RAW = 0x05
+_OP_FETCH_OBJECT = 0x06
+
+_HDR = _struct.Struct(">BB")
+_U32 = _struct.Struct(">I")
+_U64 = _struct.Struct(">Q")
+_F64 = _struct.Struct(">d")
+
+_F_PLAIN_ARGS = 1
+_F_LEASE = 2
+_F_CLASS = 4
+_F_FN_BYTES = 8
+_F_EXTRA = 16
+
+#: execute_task fields handled natively; anything else rides the
+#: pickled `extra` tail (runtime_env, tpu_ids) or forces full fallback.
+_EXEC_NATIVE_KEYS = frozenset({
+    "type", "req_id", "fn_id", "payload", "name", "task_id", "num_cpus",
+    "store_limit", "num_returns", "lease_id", "class_id", "plain_args",
+    "fn_bytes", "runtime_env", "tpu_ids"})
+
+
+def _pb(buf: list, b: bytes, wide: bool = False) -> None:
+    buf.append((_U64 if wide else _U32).pack(len(b)))
+    buf.append(b)
+
+
+def _encode_execute_task(msg: Dict[str, Any]):
+    if not _EXEC_NATIVE_KEYS.issuperset(msg):
+        return None  # unknown field: the pickle envelope carries it
+    flags = 0
+    extra = {}
+    if msg.get("runtime_env"):
+        extra["runtime_env"] = msg["runtime_env"]
+    if msg.get("tpu_ids"):
+        extra["tpu_ids"] = msg["tpu_ids"]
+    if msg.get("plain_args"):
+        flags |= _F_PLAIN_ARGS
+    lease = msg.get("lease_id")
+    if lease is not None:
+        flags |= _F_LEASE
+    class_id = msg.get("class_id")
+    if class_id is not None:
+        flags |= _F_CLASS
+    fn_bytes = msg.get("fn_bytes")
+    if fn_bytes is not None:
+        flags |= _F_FN_BYTES
+    if extra:
+        flags |= _F_EXTRA
+    out = [_HDR.pack(MAGIC_TYPED, _OP_EXECUTE_TASK),
+           _U64.pack(msg["req_id"]),
+           _struct.pack(">B", flags),
+           _F64.pack(float(msg.get("num_cpus", 1.0) or 0.0)),
+           _U64.pack(int(msg.get("store_limit", 0) or 0)),
+           _U32.pack(int(msg.get("num_returns", 1) or 1))]
+    _pb(out, msg["fn_id"])
+    _pb(out, msg["payload"], wide=True)
+    _pb(out, (msg.get("name") or "").encode())
+    _pb(out, (msg.get("task_id") or "").encode())
+    if flags & _F_LEASE:
+        _pb(out, lease.encode())
+    if flags & _F_CLASS:
+        _pb(out, class_id.encode())
+    if flags & _F_FN_BYTES:
+        _pb(out, fn_bytes, wide=True)
+    if flags & _F_EXTRA:
+        import pickle as _pickle
+        _pb(out, _pickle.dumps(extra), wide=True)
+    return b"".join(out)
+
+
+class _Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def take(self, st: _struct.Struct):
+        v = st.unpack_from(self.buf, self.pos)
+        self.pos += st.size
+        return v[0] if len(v) == 1 else v
+
+    def take_bytes(self, wide: bool = False) -> bytes:
+        n = self.take(_U64 if wide else _U32)
+        b = self.buf[self.pos:self.pos + n]
+        if len(b) != n:
+            raise WireSchemaError("typed frame truncated")
+        self.pos += n
+        return b
+
+
+def _decode_execute_task(r: "_Reader") -> Dict[str, Any]:
+    msg: Dict[str, Any] = {"type": "execute_task"}
+    msg["req_id"] = r.take(_U64)
+    flags = r.take(_struct.Struct(">B"))
+    msg["num_cpus"] = r.take(_F64)
+    msg["store_limit"] = r.take(_U64)
+    msg["num_returns"] = r.take(_U32)
+    msg["fn_id"] = r.take_bytes()
+    msg["payload"] = r.take_bytes(wide=True)
+    name = r.take_bytes().decode()
+    if name:
+        msg["name"] = name
+    task_id = r.take_bytes().decode()
+    if task_id:
+        msg["task_id"] = task_id
+    if flags & _F_PLAIN_ARGS:
+        msg["plain_args"] = True
+    if flags & _F_LEASE:
+        msg["lease_id"] = r.take_bytes().decode()
+    if flags & _F_CLASS:
+        msg["class_id"] = r.take_bytes().decode()
+    if flags & _F_FN_BYTES:
+        msg["fn_bytes"] = r.take_bytes(wide=True)
+    if flags & _F_EXTRA:
+        import pickle as _pickle
+        msg.update(_pickle.loads(r.take_bytes(wide=True)))
+    return msg
+
+
+def _encode_reply(msg: Dict[str, Any]):
+    keys = set(msg)
+    req_id = msg.get("req_id")
+    if not isinstance(req_id, int) or req_id < 0:
+        return None
+    if msg.get("ok") is True:
+        if keys == {"req_id", "ok", "value"} and \
+                isinstance(msg["value"], bytes):
+            return b"".join([_HDR.pack(MAGIC_TYPED, _OP_REPLY_VALUE),
+                             _U64.pack(req_id), _U64.pack(
+                                 len(msg["value"])), msg["value"]])
+        if keys == {"req_id", "ok", "stored_key", "size"}:
+            kb = msg["stored_key"].encode()
+            return b"".join([_HDR.pack(MAGIC_TYPED, _OP_REPLY_STORED),
+                             _U64.pack(req_id), _U32.pack(len(kb)), kb,
+                             _U64.pack(int(msg["size"]))])
+        if keys == {"req_id", "ok", "raw"} and \
+                isinstance(msg["raw"], bytes):
+            return b"".join([_HDR.pack(MAGIC_TYPED, _OP_REPLY_RAW),
+                             _U64.pack(req_id),
+                             _U64.pack(len(msg["raw"])), msg["raw"]])
+        return None
+    if msg.get("ok") is False and keys == {"req_id", "ok", "error"} and \
+            isinstance(msg["error"], bytes):
+        return b"".join([_HDR.pack(MAGIC_TYPED, _OP_REPLY_ERROR),
+                         _U64.pack(req_id),
+                         _U64.pack(len(msg["error"])), msg["error"]])
+    return None
+
+
+def _encode_fetch_object(msg: Dict[str, Any]):
+    if set(msg) != {"type", "req_id", "key"}:
+        return None
+    kb = msg["key"].encode()
+    return b"".join([_HDR.pack(MAGIC_TYPED, _OP_FETCH_OBJECT),
+                     _U64.pack(msg["req_id"]), _U32.pack(len(kb)), kb])
+
+
+def encode_typed(msg: Dict[str, Any]):
+    """Binary encoding for a hot-path control message, or None when the
+    message must ride the cloudpickle envelope instead. NEVER raises —
+    a shape the layout cannot carry simply falls back."""
+    try:
+        mtype = msg.get("type")
+        if mtype == "execute_task":
+            return _encode_execute_task(msg)
+        if mtype == "fetch_object":
+            return _encode_fetch_object(msg)
+        if mtype is None:
+            return _encode_reply(msg)
+    except Exception:  # noqa: BLE001 - fallback is always correct
+        return None
+    return None
+
+
+def decode_typed(buf: bytes):
+    """Decode a typed (0x01) frame back to its dict form, or None when
+    the frame is not typed (pickle envelope / batch)."""
+    if not buf or buf[0] != MAGIC_TYPED:
+        return None
+    r = _Reader(buf, 1)
+    op = r.take(_struct.Struct(">B"))
+    if op == _OP_EXECUTE_TASK:
+        return _decode_execute_task(r)
+    if op == _OP_REPLY_VALUE:
+        return {"req_id": r.take(_U64), "ok": True,
+                "value": r.take_bytes(wide=True)}
+    if op == _OP_REPLY_STORED:
+        req_id = r.take(_U64)
+        key = r.take_bytes().decode()
+        return {"req_id": req_id, "ok": True, "stored_key": key,
+                "size": r.take(_U64)}
+    if op == _OP_REPLY_RAW:
+        return {"req_id": r.take(_U64), "ok": True,
+                "raw": r.take_bytes(wide=True)}
+    if op == _OP_REPLY_ERROR:
+        return {"req_id": r.take(_U64), "ok": False,
+                "error": r.take_bytes(wide=True)}
+    if op == _OP_FETCH_OBJECT:
+        return {"type": "fetch_object", "req_id": r.take(_U64),
+                "key": r.take_bytes().decode()}
+    raise WireSchemaError(f"unknown typed wire op 0x{op:02x}")
+
+
+def encode_batch(frames) -> bytes:
+    """Pack pre-encoded frames (typed or pickle) into one batch frame."""
+    out = [bytes([MAGIC_BATCH]), _U32.pack(len(frames))]
+    for f in frames:
+        out.append(_U64.pack(len(f)))
+        out.append(f)
+    return b"".join(out)
+
+
+def decode_batch(buf: bytes):
+    """Unpack a batch (0x02) frame into its per-message frames, or None
+    when the frame is not a batch."""
+    if not buf or buf[0] != MAGIC_BATCH:
+        return None
+    r = _Reader(buf, 1)
+    n = r.take(_U32)
+    return [r.take_bytes(wide=True) for _ in range(n)]

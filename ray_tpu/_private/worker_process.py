@@ -713,6 +713,44 @@ class _WorkerMain:
             return None
         return result
 
+    def _result_reply(self, msg: dict, value, _dumps) -> dict:
+        """Build the result reply, writing big payloads STRAIGHT into
+        the shared shm arena (plasma's mission: results land in the
+        store, never in an RPC reply) — the bytes skip the stdio pipe
+        and the daemon's re-pickle; it only adopts the keys. Multi-
+        return tasks split PER ELEMENT (a shuffle map's 32 partitions
+        each become an independent arena entry). Arena-full or shape
+        mismatch falls back to the inline path (the daemon's table.put
+        can spill to disk)."""
+        arena_limit = msg.get("arena_limit", 0)
+        num_returns = msg.get("num_returns", 1)
+        arena = self._get_arena() if arena_limit else None
+        if arena is None:
+            return {"ok": True, "value": _dumps(value)}
+        import uuid as _uuid
+        if num_returns > 1:
+            if not isinstance(value, (tuple, list)) or \
+                    len(value) != num_returns:
+                # Wrong shape: the daemon's mismatch path describes it.
+                return {"ok": True, "value": _dumps(value)}
+            parts = []
+            for el in value:
+                p = _dumps(el)
+                if len(p) > arena_limit:
+                    key = f"wres-{_uuid.uuid4().hex}"
+                    if arena.put_bytes(key, p):
+                        parts.append({"arena_key": key, "size": len(p)})
+                        continue
+                parts.append({"value": p})
+            return {"ok": True, "parts": parts}
+        payload = _dumps(value)
+        if len(payload) > arena_limit:
+            key = f"wres-{_uuid.uuid4().hex}"
+            if arena.put_bytes(key, payload):
+                return {"ok": True, "arena_key": key,
+                        "size": len(payload)}
+        return {"ok": True, "value": payload}
+
     def serve(self) -> None:
         from ray_tpu._private.multinode import (_dumps, _loads, _recv_frame,
                                                 _send_frame)
@@ -729,7 +767,7 @@ class _WorkerMain:
                 continue
             try:
                 value = self._exec(msg)
-                reply = {"ok": True, "value": _dumps(value)}
+                reply = self._result_reply(msg, value, _dumps)
             except BaseException as exc:  # noqa: BLE001 - ship to parent
                 try:
                     payload = _dumps((exc, traceback.format_exc()))

