@@ -40,6 +40,29 @@ def _peak_flops(device) -> float:
     return PEAK_FLOPS["cpu"]
 
 
+
+def _stop_procs(procs) -> None:
+    """SIGTERM first (daemons unlink their shm arenas on it), SIGKILL
+    stragglers: a bare kill() leaks every daemon's arena into /dev/shm
+    (measured 118GB after a day of bench/test churn)."""
+    for p in procs:
+        try:
+            p.terminate()
+        except Exception:  # noqa: BLE001
+            pass
+    import time as _t
+    deadline = _t.monotonic() + 5
+    for p in procs:
+        try:
+            p.wait(timeout=max(0.1, deadline - _t.monotonic()))
+        except Exception:  # noqa: BLE001
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+
+
 def bench_core_ops() -> dict:
     """Core task-throughput microbenchmarks (reference:
     _private/ray_perf.py + release/microbenchmark). Runs on CPU only —
@@ -124,12 +147,7 @@ def bench_core_ops() -> dict:
         out.setdefault("remote_tasks_per_sec", None)
         out["remote_tasks_error"] = repr(exc)[:800]
     finally:
-        for p in procs:
-            try:
-                p.kill()
-                p.wait(timeout=10)
-            except Exception:  # noqa: BLE001
-                pass
+        _stop_procs(procs)
     ray_tpu.shutdown()
     return out
 
@@ -252,12 +270,7 @@ def bench_shuffle_multi_daemon() -> dict:
         out["shuffle_multi_pulled_mb"] = round(pulled / 1e6, 1)
         out["shuffle_multi_daemons"] = 2
     finally:
-        for p in procs:
-            try:
-                p.kill()
-                p.wait(timeout=10)
-            except Exception:  # noqa: BLE001
-                pass
+        _stop_procs(procs)
         ray_tpu.shutdown()
     return out
 
@@ -357,12 +370,7 @@ def bench_envelope() -> dict:
         for pg in pgs:
             remove_placement_group(pg)
     finally:
-        for p in procs:
-            try:
-                p.kill()
-                p.wait(timeout=10)
-            except Exception:  # noqa: BLE001
-                pass
+        _stop_procs(procs)
         ray_tpu.shutdown()
     return out
 
@@ -551,7 +559,15 @@ procs = [subprocess.Popen(
     stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     for _ in range(2)]
 import atexit
-atexit.register(lambda: [p.kill() for p in procs])
+def _atexit_stop():
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=5)
+        except Exception:
+            p.kill()
+atexit.register(_atexit_stop)
 deadline = time.monotonic() + 30
 while time.monotonic() < deadline:
     if ray_tpu.cluster_resources().get("CPU", 0) >= 9:
@@ -589,7 +605,12 @@ print(json.dumps({
 }))
 algo.stop()
 for p in procs:
-    p.kill()
+    p.terminate()  # SIGTERM: daemons unlink their shm arenas
+for p in procs:
+    try:
+        p.wait(timeout=5)
+    except Exception:
+        p.kill()
 ray_tpu.shutdown()
 """
 

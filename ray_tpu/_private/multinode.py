@@ -1452,10 +1452,15 @@ class NodeDaemon:
                 spill_dir, f"ray_tpu_spill_{os.getpid()}")
         self._spill_dir = spill_dir
         # Crashed daemons (SIGKILL/OOM) never run close(): reap sibling
-        # ray_tpu_spill_<pid> dirs whose pid is gone, in the background
-        # (a dead shuffle can leave tens of GB behind).
-        threading.Thread(target=_reap_stale_spill_dirs,
-                         args=(os.path.dirname(spill_dir),),
+        # ray_tpu_spill_<pid> dirs AND /dev/shm arenas whose pid is
+        # gone, in the background (a dead shuffle can leave tens of GB
+        # behind in each).
+        def _reap(parent=os.path.dirname(spill_dir)):
+            _reap_stale_spill_dirs(parent)
+            from ray_tpu._private.native_store import reap_stale_arenas
+            reap_stale_arenas()
+
+        threading.Thread(target=_reap,
                          name="ray_tpu-spill-reaper", daemon=True).start()
         self._table = NodeObjectTable(capacity=object_store_memory,
                                       spill_dir=spill_dir)
@@ -2428,9 +2433,28 @@ def run_node(address: str, *, num_cpus: float = 1.0, num_tpus: float = 0.0,
         node_resources["TPU"] = float(num_tpus)
     if resources:
         node_resources.update(resources)
-    NodeDaemon((host or "127.0.0.1", int(port)), node_resources,
-               labels, object_store_memory=int(object_store_memory),
-               spill_dir=spill_dir).run()
+    daemon = NodeDaemon((host or "127.0.0.1", int(port)), node_resources,
+                        labels,
+                        object_store_memory=int(object_store_memory),
+                        spill_dir=spill_dir)
+
+    # Graceful SIGTERM: pop run() out of its recv loop so its finally
+    # runs the ONE _teardown (arena unlink, pool shutdown, spill-dir
+    # removal). The handler itself must not touch table locks — a
+    # SIGTERM landing mid-_teardown would self-deadlock on the
+    # non-reentrant lock the suspended frame already holds. (SIGKILL
+    # cannot be trapped — the stale reapers cover that.)
+    import signal as _signal
+
+    def _terminate(_signum, _frame):
+        daemon._stop.set()
+        sock = daemon._sock
+        if sock is not None:
+            _close_quiet(sock)
+
+    with contextlib.suppress(ValueError):  # non-main thread: skip
+        _signal.signal(_signal.SIGTERM, _terminate)
+    daemon.run()
 
 
 def _main() -> None:

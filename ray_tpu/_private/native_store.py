@@ -75,6 +75,38 @@ def native_store_available() -> bool:
     return _load() is not None
 
 
+def reap_stale_arenas() -> int:
+    """Unlink /dev/shm arenas whose creating process is dead (the pid
+    is embedded in the name). SIGKILLed daemons/heads cannot unlink
+    their own mappings; without this housekeeping every crashed run
+    leaks its whole arena — measured 118GB of resident shm after one
+    day of test/bench churn, silently starving later runs. Mirrors
+    _reap_stale_spill_dirs (reference: the raylet reclaims its
+    predecessor's store on restart). Returns bytes freed."""
+    import re
+
+    from ray_tpu._private import procinfo
+    freed = 0
+    try:
+        entries = os.listdir("/dev/shm")
+    except OSError:
+        return 0
+    for fname in entries:
+        m = re.match(r"ray_tpu_(\d+)_", fname)
+        if m is None:
+            continue
+        pid = int(m.group(1))
+        if pid == os.getpid() or procinfo.pid_alive(pid):
+            continue
+        path = os.path.join("/dev/shm", fname)
+        try:
+            freed += os.path.getsize(path)
+            os.unlink(path)
+        except OSError:
+            continue
+    return freed
+
+
 class NativeObjectStore:
     """One shm arena. put/get numpy arrays (zero-copy reads) or raw bytes."""
 

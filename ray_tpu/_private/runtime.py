@@ -377,6 +377,15 @@ class Runtime:
             spill_threshold_bytes=int(
                 self.config.object_spilling_threshold_bytes),
             spill_directory=spill_dir)
+        # Housekeeping: arenas/spill of SIGKILLed predecessors never
+        # unlink themselves — a day of test churn measured 118GB of
+        # dead /dev/shm mappings starving live runs.
+        def _reap_stale():
+            from ray_tpu._private.native_store import reap_stale_arenas
+            reap_stale_arenas()
+
+        threading.Thread(target=_reap_stale, name="ray_tpu-arena-reaper",
+                         daemon=True).start()
         self.scheduler = make_cluster_scheduler(
             use_native=self.config.use_native_scheduler)
         self.head_node_id = self.scheduler.add_node(
@@ -418,6 +427,13 @@ class Runtime:
         # Worker leases (reference: direct_task_transport.cc OnWorkerIdle):
         # class_key -> live leases. Guarded by self._lock.
         self._leases: Dict[Any, List[_WorkerLease]] = {}
+        # Attachability index: class_key -> {lease_id: lease} holding
+        # only leases with pipeline room (the envelope workload opens
+        # THOUSANDS of leases per class — a linear scan per attach was
+        # O(leases) on the submit hot path). Maintained by
+        # _lease_avail_update at every inflight/blocked/drop mutation;
+        # _find_lease double-checks before trusting an entry.
+        self._lease_avail: Dict[Any, Dict[str, _WorkerLease]] = {}
         self._lease_counter = 0
         # Compact wire names for scheduling classes (shipped with each
         # leased task so the daemon can group its LOCAL dispatch queues
@@ -886,13 +902,38 @@ class Runtime:
         spec._lease_key = key  # type: ignore[attr-defined]
         return key
 
+    def _lease_attachable(self, lease: _WorkerLease) -> bool:
+        return (not lease.dropped and not lease.blocked
+                and lease.inflight < self._lease_window
+                and lease.node_id in self._remote_nodes)
+
+    def _lease_avail_update(self, lease: _WorkerLease) -> None:
+        """Re-index one lease's attachability (caller holds _lock)."""
+        bucket = self._lease_avail.get(lease.class_key)
+        if self._lease_attachable(lease):
+            if bucket is None:
+                bucket = self._lease_avail[lease.class_key] = {}
+            bucket[lease.lease_id] = lease
+        elif bucket is not None:
+            bucket.pop(lease.lease_id, None)
+            if not bucket:
+                del self._lease_avail[lease.class_key]
+
     def _find_lease(self, class_key) -> Optional[_WorkerLease]:
-        """An attachable live lease for this class (caller holds _lock)."""
-        for lease in self._leases.get(class_key, ()):
-            if not lease.dropped and not lease.blocked \
-                    and lease.inflight < self._lease_window \
-                    and lease.node_id in self._remote_nodes:
+        """An attachable live lease for this class (caller holds _lock).
+        O(1) amortized via the availability index: peek the head entry,
+        pop it if stale (safe — every indexed mutation re-adds through
+        _lease_avail_update). No bucket copy: materializing thousands
+        of entries per attach would re-create the linear scan this
+        index removed."""
+        bucket = self._lease_avail.get(class_key)
+        while bucket:
+            lease_id, lease = next(iter(bucket.items()))
+            if self._lease_attachable(lease):
                 return lease
+            bucket.pop(lease_id, None)
+        if bucket is not None and not bucket:
+            del self._lease_avail[class_key]
         return None
 
     def _lease_task_done(self, spec: TaskSpec, lease: _WorkerLease) -> None:
@@ -907,6 +948,7 @@ class Runtime:
         next_spec = None
         with self._lock:
             lease.inflight -= 1
+            self._lease_avail_update(lease)
             if lease.dropped:
                 return  # node death already tore it down
             if lease.inflight <= 0:
@@ -924,11 +966,13 @@ class Runtime:
                     next_spec._lease = lease  # type: ignore[attr-defined]
                     next_spec._tpu_ids = lease.tpu_ids
                     lease.inflight += 1
+                    self._lease_avail_update(lease)
                     next_spec.invalidated = False
                     next_spec._finalized = False
                     self.lease_stats["attached"] += 1
                 else:
                     lease.dropped = True
+                    self._lease_avail_update(lease)
                     lst = self._leases.get(lease.class_key)
                     if lst is not None:
                         try:
@@ -1009,6 +1053,7 @@ class Runtime:
                 for lease in lst[:]:
                     if lease.node_id == node_id:
                         lease.dropped = True
+                        self._lease_avail_update(lease)
                         lst.remove(lease)
                 if not lst:
                     del self._leases[key]
@@ -1086,6 +1131,7 @@ class Runtime:
                     spec._lease = lease  # type: ignore[attr-defined]
                     spec._tpu_ids = lease.tpu_ids
                     lease.inflight += 1
+                    self._lease_avail_update(lease)
                     spec.invalidated = False
                     spec._finalized = False
                     self.lease_stats["attached"] += 1
@@ -1128,6 +1174,7 @@ class Runtime:
                 bidx, getattr(spec, "_tpu_ids", None))
             self._leases.setdefault(class_key,
                                     []).append(lease)
+            self._lease_avail_update(lease)
             spec._lease = lease  # type: ignore[attr-defined]
             self.lease_stats["created"] += 1
         return (spec, worker)
@@ -1713,6 +1760,7 @@ class Runtime:
             if lease.blocked == 0:
                 lease.blocked = 1  # gate: attaches stay closed
                 return True
+            self._lease_avail_update(lease)
         return False
 
     def _send_unspill_and_open(self, lease) -> None:
@@ -1726,6 +1774,7 @@ class Runtime:
                 conn.unspill_lease(lease.lease_id)
         with self._lock:
             lease.blocked -= 1
+            self._lease_avail_update(lease)
         self._dispatch()
 
     def client_get_release(self, task_id_hex: str) -> Optional[TaskSpec]:
@@ -1756,6 +1805,7 @@ class Runtime:
                 # dispatch attach a same-class child to this lease in
                 # the window, landing it behind its blocked parent.
                 lease.blocked += 1
+                self._lease_avail_update(lease)
         if lease is not None:
             # A leased task blocks its lease's serial executor, so lending
             # out the LEASE's acquisition is safe: nothing else can run on

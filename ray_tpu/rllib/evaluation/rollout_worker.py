@@ -155,11 +155,16 @@ class RolloutWorker:
             SampleBatch.REWARDS, SampleBatch.TERMINATEDS,
             SampleBatch.TRUNCATEDS, SampleBatch.ACTION_LOGP,
             SampleBatch.VF_PREDS, SampleBatch.EPS_ID)}
+        keyed = getattr(self.policy, "compute_actions_keyed", None)
         for _ in range(num_steps):
             obs = np.asarray(self.obs_connectors(self._obs))
-            self._key, sub = jax.random.split(self._key)
-            action, logp, value = self.policy.compute_actions(
-                obs[None], sub)
+            if keyed is not None:
+                action, logp, value, self._key = keyed(obs[None],
+                                                       self._key)
+            else:
+                self._key, sub = jax.random.split(self._key)
+                action, logp, value = self.policy.compute_actions(
+                    obs[None], sub)
             # Recurrent policies publish their PRE-step hidden state per
             # transition (R2D2: the learner re-seeds the recurrence from
             # any stored window start).
@@ -220,13 +225,18 @@ class RolloutWorker:
                 SampleBatch.ACTION_LOGP, SampleBatch.VF_PREDS,
                 SampleBatch.EPS_ID)}
             for _ in range(N)]
+        keyed = getattr(self.policy, "compute_actions_keyed", None)
         for _ in range(steps_per_env):
             obs_batch = np.stack([
                 np.asarray(self._vec_obs_conn[i](self._vec_obs[i]))
                 for i in range(N)])
-            self._key, sub = jax.random.split(self._key)
-            actions, logps, values = self.policy.compute_actions(
-                obs_batch, sub)
+            if keyed is not None:
+                actions, logps, values, self._key = keyed(obs_batch,
+                                                          self._key)
+            else:
+                self._key, sub = jax.random.split(self._key)
+                actions, logps, values = self.policy.compute_actions(
+                    obs_batch, sub)
             for i in range(N):
                 act = actions[i]
                 act_env = (int(act) if self.policy.discrete

@@ -1,0 +1,159 @@
+"""Spark-on-ray_tpu: run a ray_tpu cluster on a Spark cluster's
+executors.
+
+Analog of the reference's ray.util.spark
+(python/ray/util/spark/cluster_init.py:772 setup_ray_cluster /
+:1031 shutdown_ray_cluster): a Spark job's executors each start a
+ray_tpu node daemon that joins a head running on the Spark driver, so
+ray_tpu workloads (Train/Tune/Data) use the Spark cluster's capacity.
+The TPU-native difference: daemons register their accelerator
+resources and the head schedules onto them with the normal
+mesh/sharding machinery — no change to the compute path.
+
+pyspark is NOT bundled with this framework; every entry point degrades
+with a clear error when it is absent. The executor-side launch logic
+(`_start_worker_daemon`) is spark-agnostic — it is exercised directly
+by the test suite and reused by the autoscaler's command runners.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_active: Dict[str, Any] = {"head": None, "spark_job": None}
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+        return pyspark
+    except ImportError as exc:
+        raise ImportError(
+            "ray_tpu.util.spark needs pyspark, which is not installed "
+            "in this environment. Install pyspark, or start workers "
+            "directly with `ray-tpu start --address <head>` (the "
+            "executor-side launch is the same either way).") from exc
+
+
+def _start_worker_daemon(head_address: str, *, num_cpus: float = 1.0,
+                         num_tpus: float = 0.0,
+                         resources: Optional[Dict[str, float]] = None,
+                         object_store_memory: int = 1 << 28,
+                         env: Optional[Dict[str, str]] = None
+                         ) -> subprocess.Popen:
+    """Launch one node daemon joining ``head_address`` — the per-
+    executor body of setup_ray_cluster, callable from any launcher
+    (Spark mapPartitions task, SSH, test)."""
+    import json as _json
+    cmd = [sys.executable, "-m", "ray_tpu._private.multinode",
+           "--address", head_address,
+           "--num-cpus", str(num_cpus),
+           "--object-store-memory", str(int(object_store_memory))]
+    if num_tpus:
+        cmd += ["--num-tpus", str(num_tpus)]
+    if resources:
+        cmd += ["--resources", _json.dumps(resources)]
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    return subprocess.Popen(cmd, env=full_env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def setup_ray_cluster(num_worker_nodes: int, *,
+                      num_cpus_per_node: float = 1.0,
+                      num_tpus_per_node: float = 0.0,
+                      resources_per_node: Optional[Dict[str, float]] = None,
+                      object_store_memory_per_node: int = 1 << 28,
+                      head_port: int = 0,
+                      collect_log_to_path: Optional[str] = None
+                      ) -> Tuple[str, None]:
+    """Start a ray_tpu head on the Spark driver and one node daemon on
+    each of ``num_worker_nodes`` Spark executors (reference:
+    cluster_init.py setup_ray_cluster; the return mirrors its
+    (address, dashboard) tuple shape). Blocks until every worker
+    registered."""
+    import time
+
+    import ray_tpu
+    pyspark = _require_pyspark()
+    spark = pyspark.sql.SparkSession.getActiveSession()
+    if spark is None:
+        raise RuntimeError(
+            "setup_ray_cluster must run inside an active Spark session "
+            "(reference semantics: the head lives on the Spark driver)")
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=1)
+    # Baseline BEFORE workers launch: the readiness check below must
+    # count only capacity the executors add — the head's own CPUs
+    # (whatever init() gave it) would otherwise satisfy it instantly
+    # with zero workers joined.
+    base_cpu = ray_tpu.cluster_resources().get("CPU", 0)
+    host, port = ray_tpu.start_head_server(port=head_port,
+                                           host=_driver_ip())
+    address = f"{host}:{port}"
+
+    def _launch_partition(_it):
+        proc = _start_worker_daemon(
+            address, num_cpus=num_cpus_per_node,
+            num_tpus=num_tpus_per_node,
+            resources=resources_per_node,
+            object_store_memory=object_store_memory_per_node)
+        # The daemon must outlive this Spark task: detach and idle the
+        # task slot (reference: start_ray_node.py keeps the node alive
+        # for the Spark job's lifetime).
+        import time as _t
+        while proc.poll() is None:
+            _t.sleep(10)
+        yield proc.returncode
+
+    sc = spark.sparkContext
+    rdd = sc.parallelize(range(num_worker_nodes), num_worker_nodes)
+    # Async job: the partitions idle for the cluster's lifetime.
+    import threading
+    job = threading.Thread(
+        target=lambda: rdd.mapPartitions(_launch_partition).collect(),
+        name="ray_tpu-spark-launch", daemon=True)
+    job.start()
+    _active["head"] = address
+    _active["spark_job"] = job
+    deadline = time.monotonic() + 120
+    want = base_cpu + num_worker_nodes * num_cpus_per_node
+    while time.monotonic() < deadline:
+        if ray_tpu.cluster_resources().get("CPU", 0) >= want:
+            return address, None
+        time.sleep(0.5)
+    raise TimeoutError(
+        f"spark workers never joined: cluster CPU "
+        f"{ray_tpu.cluster_resources().get('CPU', 0)} < {want}")
+
+
+def shutdown_ray_cluster() -> None:
+    """Tear the spark-hosted cluster down (reference:
+    cluster_init.py:1031). Daemons exit when the head stops."""
+    import ray_tpu
+    _active["head"] = None
+    _active["spark_job"] = None
+    ray_tpu.shutdown()
+
+
+def _driver_ip() -> str:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+MAX_NUM_WORKER_NODES = -1  # reference: sentinel for "all executors"
