@@ -22,7 +22,7 @@ _PLACEMENT_GROUP_ID_SIZE = 12
 
 class BaseID:
     SIZE = 0
-    __slots__ = ("_bytes", "_hash")
+    __slots__ = ("_bytes", "_hash", "_hex")
 
     def __init__(self, binary: bytes):
         if len(binary) != self.SIZE:
@@ -51,7 +51,13 @@ class BaseID:
         return self._bytes
 
     def hex(self) -> str:
-        return self._bytes.hex()
+        # Memoized: ids are immutable and hex() runs ~10x per task on
+        # the submit/event hot paths (wire frames, event records, logs).
+        try:
+            return self._hex
+        except AttributeError:
+            h = self._hex = self._bytes.hex()
+            return h
 
     def __hash__(self):
         return self._hash
@@ -60,7 +66,7 @@ class BaseID:
         return type(other) is type(self) and other._bytes == self._bytes
 
     def __repr__(self):
-        return f"{type(self).__name__}({self._bytes.hex()})"
+        return f"{type(self).__name__}({self.hex()})"
 
     def __reduce__(self):
         return (type(self), (self._bytes,))
