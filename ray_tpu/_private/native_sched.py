@@ -102,22 +102,21 @@ def native_sched_available() -> bool:
     return _load() is not None
 
 
-#: Encoded-resource memo: task resource dicts repeat endlessly (every
-#: same-class task encodes the identical map 3x — feasible/acquire/
-#: release — on the submit hot path). Bounded; key is the items tuple.
-_encode_cache: Dict[tuple, bytes] = {}
+import functools
+
+
+@functools.lru_cache(maxsize=4096)
+def _encode_items(items: tuple) -> bytes:
+    return ";".join(f"{k}={float(v):.10g}" for k, v in items).encode()
 
 
 def _encode(resources: Dict[str, float]) -> bytes:
-    key = tuple(resources.items())
-    enc = _encode_cache.get(key)
-    if enc is None:
-        if len(_encode_cache) > 4096:
-            _encode_cache.clear()
-        enc = _encode_cache[key] = ";".join(
-            f"{k}={float(v):.10g}"
-            for k, v in resources.items()).encode()
-    return enc
+    # Memoized on the items tuple: task resource dicts repeat endlessly
+    # (every same-class task encodes the identical map 3x — feasible/
+    # acquire/release — on the submit hot path). LRU, not clear-all:
+    # >4096 distinct shapes must evict cold entries, never dump the
+    # hot set mid-burst.
+    return _encode_items(tuple(resources.items()))
 
 
 def _read_encoded(fn, *args) -> Dict[str, float]:

@@ -97,5 +97,15 @@ def status_summary() -> str:
                     f"{store.get('objects', 0)}obj")
             if mem.get("rss_bytes"):
                 parts.append(f"rss={mem['rss_bytes'] / 1e6:.0f}MB")
+            backlog = comps.get("backlog", {})
+            if backlog.get("queued") or backlog.get("temp_slots"):
+                # Daemon-LOCAL dispatch queues (round 5): depth the
+                # daemon owns, observed — not managed — by the head.
+                # Temp slots show even at queued=0 (a drained queue
+                # with lent capacity still running is the interesting
+                # divergence).
+                parts.append(f"backlog={backlog.get('queued', 0)}"
+                             + (f"(+{backlog['temp_slots']}tmp)"
+                                if backlog.get("temp_slots") else ""))
             lines.append(f"  {node_id[:12]}: " + " ".join(parts))
     return "\n".join(lines)
