@@ -17,6 +17,7 @@ bundle ledger — happens in C++.
 from __future__ import annotations
 
 import ctypes
+import functools
 import os
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -100,9 +101,6 @@ def native_sched_available() -> bool:
     if os.environ.get("RAY_TPU_NATIVE_SCHED", "1") == "0":
         return False
     return _load() is not None
-
-
-import functools
 
 
 @functools.lru_cache(maxsize=4096)

@@ -124,34 +124,63 @@ def test_backlog_reported_via_syncer(one_daemon):
     ray_tpu.get(refs, timeout=90)
 
 
-def test_spillback_reclaims_misplaced_work(ray_start_regular):
-    """Work pipelined onto a busy node's local queue is reclaimed when
-    capacity appears elsewhere (reference: cluster_task_manager
-    spillback). A second daemon joins mid-burst; the head pulls queued
-    tasks back and re-dispatches them onto it."""
+def _spillback_burst(res_name, *, n_tasks, task_sleep, join_after,
+                     max_retries=0, value=lambda i: i, timeout=120):
+    """Shared spillback harness: saturate one daemon's local queue,
+    join a second mid-burst, return (results, lease_stats)."""
     host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
-    p1 = _spawn_daemon(port, num_cpus=2, resources={"sb": 100})
-    procs = [p1]
+    procs = [_spawn_daemon(port, num_cpus=2, resources={res_name: 100})]
     try:
-        _wait_for_resource("sb", 100)
+        _wait_for_resource(res_name, 100)
 
-        @ray_tpu.remote(resources={"sb": 1}, num_cpus=1)
-        def work(i):
-            time.sleep(0.4)
-            return i
+        @ray_tpu.remote(resources={res_name: 1}, num_cpus=1,
+                        max_retries=max_retries)
+        def work(i, _sleep=task_sleep, _value=value):
+            time.sleep(_sleep)
+            return _value(i)
 
-        refs = [work.remote(i) for i in range(30)]
-        time.sleep(1.0)  # daemon 1's queue is now deep
-        p2 = _spawn_daemon(port, num_cpus=2, resources={"sb": 100})
-        procs.append(p2)
-        out = ray_tpu.get(refs, timeout=120)
-        assert out == list(range(30))
+        refs = [work.remote(i) for i in range(n_tasks)]
+        time.sleep(join_after)  # daemon 1's local queue is now deep
+        procs.append(_spawn_daemon(port, num_cpus=2,
+                                   resources={res_name: 100}))
+        out = ray_tpu.get(refs, timeout=timeout)
         from ray_tpu._private.worker import global_worker
-        stats = global_worker._runtime.lease_stats
-        assert stats.get("reclaimed", 0) > 0, (
-            f"no spillback reclaim happened: {stats}")
+        return out, dict(global_worker._runtime.lease_stats)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
             p.wait(timeout=10)
+
+
+def test_spillback_reclaims_misplaced_work(ray_start_regular):
+    """Work pipelined onto a busy node's local queue is reclaimed when
+    capacity appears elsewhere (reference: cluster_task_manager
+    spillback). A second daemon joins mid-burst; the head pulls queued
+    tasks back and re-dispatches them onto it."""
+    out, stats = _spillback_burst("sb", n_tasks=30, task_sleep=0.4,
+                                  join_after=1.0)
+    assert out == list(range(30))
+    assert stats.get("reclaimed", 0) > 0, (
+        f"no spillback reclaim happened: {stats}")
+
+
+def test_spillback_under_rpc_chaos(ray_start_regular):
+    """Spillback reclaim racing chaos-injected RPC failures AND task
+    completions: reclaimed replies, died completions, and retries all
+    drive the same per-task continuation — every result must still be
+    exactly-once correct (the reclaimed-vs-died race is the sharp edge
+    of the r5 spillback protocol), and the reclaim path must actually
+    have fired (a vacuous pass would not cover the race)."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1,
+                 _system_config={"testing_rpc_failure_pct": 10})
+    try:
+        out, stats = _spillback_burst("sbx", n_tasks=40, task_sleep=0.3,
+                                      join_after=1.0, max_retries=20,
+                                      value=lambda i: i * 7, timeout=180)
+        assert out == [i * 7 for i in range(40)]
+        assert stats.get("reclaimed", 0) > 0, (
+            f"reclaim path never exercised under chaos: {stats}")
+    finally:
+        ray_tpu.shutdown()
