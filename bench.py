@@ -152,6 +152,76 @@ def bench_core_ops() -> dict:
     return out
 
 
+def bench_log_streaming() -> dict:
+    """Driver-side log delivery rate: a subprocess worker emits 50k
+    UNIQUE lines (unique defeats the storm guard — identical lines
+    would collapse to two) and we count arrivals on the pubsub "logs"
+    channel. log_to_driver=False keeps the 50k lines off this process's
+    stdout (the bench emits one JSON line); the monitor publishes
+    either way, so a direct subscriber sees the full stream. A
+    companion task-throughput probe shows logging leaves the dispatch
+    hot path within noise."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    out = {}
+    n_lines = 50_000
+    ray_tpu.init(num_cpus=8, log_to_driver=False)
+    try:
+        rt = global_worker._runtime
+        sub_id = "bench-log-stream"
+        rt.pubsub.subscribe(sub_id, "logs")
+
+        @ray_tpu.remote(runtime_env={"worker_process": True})
+        def chatter(n):
+            import sys as _sys
+            for i in range(n):
+                _sys.stdout.write(f"bench-log-{i:06d}\n")
+            _sys.stdout.flush()
+            return n
+
+        ref = chatter.remote(n_lines)
+        got = 0
+        t0 = _time.perf_counter()
+        deadline = t0 + 120
+        import json as _json
+        while got < n_lines and _time.perf_counter() < deadline:
+            item = rt.pubsub.poll(sub_id, timeout=1.0)
+            if item is None:
+                if got and ray_tpu.wait([ref], timeout=0)[0]:
+                    break  # task done + stream quiet: drops are final
+                continue
+            batch = _json.loads(item[2])
+            got += sum(1 for ln in batch.get("lines", ())
+                       if ln.startswith("bench-log-"))
+        dt = _time.perf_counter() - t0
+        ray_tpu.get(ref, timeout=60)
+        rt.pubsub.drop_subscriber(sub_id)
+        out["log_lines_per_sec"] = round(got / dt, 1) if dt > 0 else None
+        out["log_lines_delivered"] = got
+        out["log_lines_emitted"] = n_lines
+
+        # Throughput with the log subsystem live (compare tasks_per_sec
+        # from bench_core_ops: must be within noise).
+        @ray_tpu.remote
+        def tiny(i):
+            return i
+
+        ray_tpu.get([tiny.remote(i) for i in range(100)])
+        n = 3000
+        best = 0.0
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            ray_tpu.get([tiny.remote(i) for i in range(n)])
+            best = max(best, n / (_time.perf_counter() - t0))
+        out["log_stream_tasks_per_sec"] = round(best, 1)
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
 def bench_data_shuffle() -> dict:
     """Single-host shuffle throughput (reference:
     release_tests.yaml:3447 shuffle nightly — scaled to one host): a
@@ -999,6 +1069,7 @@ def main():
         ("shuffle_multi", "shuffle_multi_mb_per_sec",
          bench_shuffle_multi_daemon),
         ("envelope", "envelope_tasks_per_sec", bench_envelope),
+        ("log_stream", "log_lines_per_sec", bench_log_streaming),
     ]
     if on_tpu:
         extras_suite.append(

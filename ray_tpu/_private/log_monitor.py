@@ -1,0 +1,204 @@
+"""Per-process log tailer: follows capture files created by
+ray_logging, batches new lines, and hands them to a publish callback.
+
+Analog of the reference's python/ray/_private/log_monitor.py, minus the
+one-agent-per-node daemon: here every process that spawns captured
+children (the head runtime, each NodeDaemon) runs its own LogMonitor
+thread over exactly the files it created — so hosts that share a
+session tmpdir never double-stream each other's output.
+
+Guarantees:
+
+- Bounded work per poll: at most ``MAX_BYTES_PER_POLL`` read per file
+  and ``MAX_LINES_PER_BATCH`` lines per published batch (backpressure —
+  a runaway worker can't wedge the daemon's event loop).
+- Storm guard: consecutive identical lines collapse into the first
+  occurrence plus a ``message repeated N times`` summary, so 10k
+  copies of one line cost two published lines.
+- Rotation: when a tailed file outgrows ``MAX_FILE_BYTES`` it is
+  copytruncate-rotated (backups shifted, file truncated in place) —
+  safe because all writers use O_APPEND, so post-truncate writes land
+  at the new EOF.
+- Publish returning False means "transport unavailable": the batch is
+  DROPPED but offsets still advance (logs are best-effort streams; the
+  full text stays on disk for `ray-tpu logs`).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu._private.ray_logging import TASK_MARKER
+
+logger = logging.getLogger(__name__)
+
+MAX_BYTES_PER_POLL = 128 * 1024
+MAX_LINES_PER_BATCH = 500
+#: Per-file size cap before copytruncate rotation.
+MAX_FILE_BYTES = 16 * 1024 * 1024
+BACKUP_COUNT = 3
+POLL_INTERVAL_S = 0.2
+
+
+class _TailState:
+    """Cursor + per-stream metadata for one capture file."""
+
+    __slots__ = ("path", "proc_name", "pid", "source", "pos", "partial",
+                 "task_name", "last_line", "repeat")
+
+    def __init__(self, path: str, proc_name: str, pid: int, source: str):
+        self.path = path
+        self.proc_name = proc_name
+        self.pid = pid
+        self.source = source
+        self.pos = 0
+        self.partial = b""       # trailing bytes with no newline yet
+        self.task_name: Optional[str] = None
+        self.last_line: Optional[str] = None
+        self.repeat = 0          # suppressed duplicates of last_line
+
+
+class LogMonitor:
+    """Tails registered files and publishes line batches.
+
+    ``publish(batch: dict) -> bool`` receives
+    ``{"pid", "proc_name", "source", "task_name", "lines"}`` (the
+    transport stamps node identity). Construct with ``start=False`` and
+    drive :meth:`poll_once` directly in unit tests."""
+
+    def __init__(self, publish: Callable[[Dict[str, Any]], bool], *,
+                 start: bool = True,
+                 max_file_bytes: int = MAX_FILE_BYTES):
+        self._publish = publish
+        self._max_file_bytes = max_file_bytes
+        self._files: Dict[str, _TailState] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._run, name="ray_tpu-log-monitor", daemon=True)
+            self._thread.start()
+
+    # -- registration ------------------------------------------------------
+
+    def add_file(self, path: str, proc_name: str, pid: int,
+                 source: str) -> None:
+        with self._lock:
+            if path not in self._files:
+                self._files[path] = _TailState(path, proc_name, pid, source)
+
+    def remove_file(self, path: str) -> None:
+        with self._lock:
+            self._files.pop(path, None)
+
+    # -- tailing -----------------------------------------------------------
+
+    def poll_once(self) -> int:
+        """One pass over all files; returns total lines published."""
+        with self._lock:
+            states = list(self._files.values())
+        published = 0
+        for st in states:
+            try:
+                published += self._poll_file(st)
+            except Exception:  # noqa: BLE001 - one bad file != dead tailer
+                logger.exception("log tail failed for %s", st.path)
+        return published
+
+    def _poll_file(self, st: _TailState) -> int:
+        try:
+            size = os.path.getsize(st.path)
+        except OSError:
+            return 0  # deleted/renamed away: keep state, file may return
+        if size < st.pos:  # truncated (external rotation): restart
+            st.pos = 0
+            st.partial = b""
+        if size == st.pos:
+            return 0
+        try:
+            with open(st.path, "rb") as f:
+                f.seek(st.pos)
+                chunk = f.read(MAX_BYTES_PER_POLL)
+        except OSError:
+            return 0
+        st.pos += len(chunk)
+        data = st.partial + chunk
+        parts = data.split(b"\n")
+        st.partial = parts.pop()  # b"" when data ended on a newline
+        lines = []
+        for raw in parts:
+            text = raw.decode("utf-8", "replace").rstrip("\r")
+            if text.startswith(TASK_MARKER):  # consume, never forward
+                st.task_name = text[len(TASK_MARKER):] or None
+                continue
+            lines.append(text)
+        n = self._emit(st, lines)
+        if st.pos >= self._max_file_bytes:
+            self._rotate(st)
+        return n
+
+    def _emit(self, st: _TailState, lines: List[str]) -> int:
+        """Apply the storm guard and publish in bounded batches."""
+        out: List[str] = []
+        for line in lines:
+            if line == st.last_line:
+                st.repeat += 1
+                continue
+            out.extend(self._drain_repeat(st))
+            st.last_line = line
+            out.append(line)
+        out.extend(self._drain_repeat(st))
+        total = 0
+        for i in range(0, len(out), MAX_LINES_PER_BATCH):
+            batch = {"pid": st.pid, "proc_name": st.proc_name,
+                     "source": st.source, "task_name": st.task_name,
+                     "lines": out[i:i + MAX_LINES_PER_BATCH]}
+            try:
+                if self._publish(batch):
+                    total += len(batch["lines"])
+            except Exception:  # noqa: BLE001 - drop batch, keep tailing
+                logger.exception("log publish failed")
+        return total
+
+    def _drain_repeat(self, st: _TailState) -> List[str]:
+        if st.repeat == 0:
+            return []
+        n, st.repeat = st.repeat, 0
+        return [f"[log_monitor] message repeated {n} times"]
+
+    # -- rotation ----------------------------------------------------------
+
+    def _rotate(self, st: _TailState) -> None:
+        """Copytruncate: shift backups, truncate in place (writers keep
+        their O_APPEND fds), reset the cursor."""
+        path = st.path
+        try:
+            for i in range(BACKUP_COUNT - 1, 0, -1):
+                src = f"{path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{path}.{i + 1}")
+            with open(path, "rb") as f:
+                data = f.read()
+            with open(f"{path}.1", "wb") as f:
+                f.write(data)
+            os.truncate(path, 0)
+        except OSError:
+            logger.exception("log rotation failed for %s", path)
+        st.pos = 0
+        st.partial = b""
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(POLL_INTERVAL_S):
+            self.poll_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        self.poll_once()  # final drain so short-lived output isn't lost

@@ -328,6 +328,9 @@ class NodeConnection:
         self._shipped_functions: set = set()
         self.node_id = None  # set at registration
         self._on_death = None
+        # Runtime hook for daemon-pushed log_batch frames (no req_id —
+        # the recv loop routes them here instead of the pending table).
+        self.on_log_batch = None
         # Dedicated liveness socket (see HeadServer._health_check_loop):
         # pings must not share the data channel — large frames or a full
         # send buffer would stall them and fake a death (or hide one).
@@ -432,6 +435,18 @@ class NodeConnection:
             while True:
                 replies = _decode_frames(_recv_frame(self._sock))
                 for reply in replies:
+                    if reply.get("type") == "log_batch":
+                        # Daemon-initiated push, not a reply: hand to
+                        # the runtime's log fan-out and move on.
+                        handler = self.on_log_batch
+                        if handler is not None:
+                            try:
+                                handler(self, reply)
+                            except Exception:  # noqa: BLE001
+                                logger.exception("log_batch handling "
+                                                 "failed")
+                        del reply
+                        continue
                     with self._lock:
                         waiter = self._pending.pop(
                             reply.get("req_id"), None)
@@ -1054,8 +1069,11 @@ class HeadServer:
             # instead; the sender thread does not take that lock.)
             node_id = self.runtime.new_node_id()
             conn.node_id = node_id
+            # session_id rides the ack (additive optional field) so the
+            # daemon can join the session's log directory tree.
             conn._sender.send({"type": "registered",
-                               "node_id": node_id.hex()})
+                               "node_id": node_id.hex(),
+                               "session_id": self.runtime.session_id})
             # dispatch=False: the post-ack _dispatch below places
             # queued work once the reply pump is running.
             self.runtime.register_remote_node(
@@ -1498,6 +1516,11 @@ class NodeDaemon:
         self._prestarted = False
         self._session_registered = False
         self._health_started = False
+        # Started once per daemon on the first registration that hands
+        # us a session id (like _health_started): tails this process's
+        # capture files — its own raylet streams + spawned workers —
+        # and ships batches head-ward.
+        self._log_monitor = None
         self._object_server_host: Optional[str] = None
         # Resource-usage sync (reference: common/ray_syncer): changed
         # component snapshots piggyback on health-channel pongs; the
@@ -2246,6 +2269,8 @@ class NodeDaemon:
             self._teardown()
 
     def _teardown(self) -> None:
+        if self._log_monitor is not None:
+            self._log_monitor.stop()
         if self._object_server is not None:
             self._object_server.close()
         if self._pool is not None:
@@ -2300,6 +2325,9 @@ class NodeDaemon:
         self._session_registered = True
         logger.info("Registered with head %s as node %s",
                     self.head_address, self.node_id_hex[:12])
+        session_id = ack.get("session_id")
+        if session_id and self._log_monitor is None:
+            self._start_log_streaming(session_id)
         if self._use_worker_processes and not self._prestarted:
             # Warm the worker pool once per daemon (reference:
             # worker_pool.h PrestartWorkers): leases then pin an
@@ -2356,6 +2384,45 @@ class NodeDaemon:
                 self._sock.close()
             except OSError:
                 pass
+
+    def _start_log_streaming(self, session_id: str) -> None:
+        """Join the driver session's log tree (the registration ack
+        carries the session id): this daemon's own stdout/stderr move
+        into per-proc ``raylet-<pid>`` files, its python logging onto a
+        structured ``raylet-<pid>.log``, and a LogMonitor tails every
+        capture file this process creates (raylet + spawned workers),
+        shipping batches head-ward."""
+        from ray_tpu._private import ray_logging
+        from ray_tpu._private.log_monitor import LogMonitor
+        try:
+            log_dir = ray_logging.setup_session(
+                session_id, f"node-{(self.node_id_hex or '')[:12]}")
+        except OSError:
+            logger.exception("could not join session log dir")
+            return
+        ray_logging.attach_file_logging(log_dir)
+        redirected = ray_logging.redirect_process_streams(log_dir)
+        monitor = LogMonitor(self._publish_log_batch)
+        for path, source in redirected:
+            monitor.add_file(path, "raylet", os.getpid(), source)
+        ray_logging.register_capture_callback(monitor.add_file)
+        self._log_monitor = monitor
+
+    def _publish_log_batch(self, batch: dict) -> bool:
+        """Ship one tail batch through the session's coalescing reply
+        sender (the socket's single writer — log frames interleave
+        safely with task replies). Logs are best-effort: between head
+        sessions there is no sender and the batch is dropped; the full
+        text stays on disk for `ray-tpu logs`."""
+        sock = self._sock
+        sender = self._reply_senders.get(sock) if sock is not None \
+            else None
+        if sender is None:
+            return False
+        msg = dict(batch)
+        msg["type"] = "log_batch"
+        msg["node_id"] = self.node_id_hex or ""
+        return bool(sender.send(msg))
 
     def _route_frame(self, msg: dict) -> bool:
         """Route one inbound control message (recv-loop thread only).

@@ -1,0 +1,377 @@
+"""Session log directories, per-process capture, and driver-side printing.
+
+Analog of the reference's python/ray/_private/ray_logging/ package: every
+session gets a directory under ``<tmpdir>/ray_tpu-sessions/session_<id>``
+with a
+``session_latest`` symlink, worker subprocess stdout/stderr are captured
+to per-proc files inside it (``worker-<uuid>-<pid>.out/.err``), node
+daemons route their own streams there too (``raylet-<pid>.out/.err``),
+and the head's log monitor + the daemons' monitors stream new lines to
+the driver with ``(name pid=, node=)`` prefixes (log_monitor.py carries
+the tailing; this module owns paths, files, redirection, and the driver
+printer).
+
+Layout (shared across all processes of one session on a host)::
+
+    <tmpdir>/ray_tpu-sessions/
+        session_latest -> session_<id>          # most recent driver
+        session_<id>/logs/
+            head/worker-<uuid>-<pid>.out        # head-spawned workers
+            node-<node_id12>/raylet-<pid>.err   # daemon's own stderr
+            node-<node_id12>/worker-...         # daemon-spawned workers
+
+Only the process that CREATED a capture file tails it (explicit
+registration with its LogMonitor) — two daemons on one host share the
+session dir but never double-stream each other's files.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import tempfile
+import threading
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+#: Control line emitted by worker processes at task start so the tailer
+#: can prefix subsequent output with the task's name (the reference gets
+#: this via setproctitle; we ride the captured stream itself). Never
+#: forwarded to the driver.
+TASK_MARKER = "::ray_tpu::task::"
+
+#: Env var that tells a worker subprocess its streams are captured (so
+#: task markers are worth emitting; with inherited streams they would
+#: pollute the user's console).
+MARKER_ENV = "RAY_TPU_LOG_MARKERS"
+
+_lock = threading.Lock()
+_node_log_dir: Optional[str] = None      # this process's dir under logs/
+_session_dir: Optional[str] = None
+# New capture files are announced here so the process's LogMonitor can
+# start tailing them: callback(path, proc_name, pid, source).
+_capture_callback: Optional[Callable[[str, str, int, str], None]] = None
+
+
+# ---------------------------------------------------------------------------
+# Session directory management
+# ---------------------------------------------------------------------------
+
+
+def sessions_root() -> str:
+    # "ray_tpu-sessions", NOT "ray_tpu": a plain /tmp/ray_tpu directory
+    # would shadow the installed package as a namespace package for any
+    # script whose cwd is the tmpdir (import ray_tpu -> empty module).
+    return os.path.join(tempfile.gettempdir(), "ray_tpu-sessions")
+
+
+def session_dir_for(session_id: str) -> str:
+    return os.path.join(sessions_root(), f"session_{session_id}")
+
+
+def setup_session(session_id: str, node_dirname: str) -> str:
+    """Create (or join) the session's log tree and claim a per-node dir.
+    Returns this process's log dir and records it process-globally so
+    worker spawns capture into it. The head passes ``head``; daemons
+    pass ``node-<node_id12>`` once registration hands them the session
+    id. Also repoints the ``session_latest`` symlink (atomic rename, so
+    a concurrent `ray-tpu logs` never sees a dangling link)."""
+    global _node_log_dir, _session_dir
+    sdir = session_dir_for(session_id)
+    log_dir = os.path.join(sdir, "logs", node_dirname)
+    os.makedirs(log_dir, exist_ok=True)
+    link = os.path.join(sessions_root(), "session_latest")
+    try:
+        tmp_link = link + f".{os.getpid()}.{uuid.uuid4().hex[:6]}"
+        os.symlink(os.path.basename(sdir), tmp_link)
+        os.replace(tmp_link, link)
+    except OSError:  # symlink-hostile filesystem: latest lookup degrades
+        pass
+    with _lock:
+        _session_dir = sdir
+        _node_log_dir = log_dir
+    return log_dir
+
+
+def clear_session() -> None:
+    """Forget the process-global session (runtime shutdown): later worker
+    spawns in this process fall back to inherited streams. The files
+    stay on disk for `ray-tpu logs`."""
+    global _node_log_dir, _session_dir, _capture_callback
+    with _lock:
+        _node_log_dir = None
+        _session_dir = None
+        _capture_callback = None
+
+
+def current_log_dir() -> Optional[str]:
+    return _node_log_dir
+
+
+def current_session_dir() -> Optional[str]:
+    return _session_dir
+
+
+def latest_session_dir() -> Optional[str]:
+    """Resolve ``session_latest`` WITHOUT initializing a runtime (the CLI
+    must read the previous driver's logs, not create a fresh empty
+    session)."""
+    cur = _session_dir
+    if cur is not None and os.path.isdir(cur):
+        return cur
+    link = os.path.join(sessions_root(), "session_latest")
+    target = os.path.realpath(link)
+    return target if os.path.isdir(target) else None
+
+
+def register_capture_callback(
+        cb: Optional[Callable[[str, str, int, str], None]]) -> None:
+    """The process's LogMonitor hooks new capture files here."""
+    global _capture_callback
+    with _lock:
+        _capture_callback = cb
+
+
+def _announce(path: str, proc_name: str, pid: int, source: str) -> None:
+    cb = _capture_callback
+    if cb is not None:
+        try:
+            cb(path, proc_name, pid, source)
+        except Exception:  # noqa: BLE001 - capture must not break spawns
+            logger.exception("log capture callback failed")
+
+
+# ---------------------------------------------------------------------------
+# Worker subprocess capture (used by worker_process._spawn_worker)
+# ---------------------------------------------------------------------------
+
+
+class _WorkerCapture:
+    """Open per-source capture files for one worker-to-be. ``finalize
+    (pid)`` after Popen renames them to embed the real pid (the child's
+    fds survive the rename) and registers them with the monitor;
+    ``abort()`` on a failed spawn removes them. Container workers pass
+    ``sources=("err",)`` — their stdout is the protocol pipe."""
+
+    def __init__(self, log_dir: str, sources=("out", "err")):
+        token = uuid.uuid4().hex[:10]
+        self._base = os.path.join(log_dir, f"worker-{token}")
+        # Append mode: rotation is copytruncate-style (log_monitor.py),
+        # and O_APPEND writes land at the new EOF after a truncate.
+        self._files = {source: open(f"{self._base}.{source}", "ab",
+                                    buffering=0) for source in sources}
+        self.out = self._files.get("out")
+        self.err = self._files.get("err")
+
+    def finalize(self, pid: int) -> None:
+        paths = {}
+        for source, f in self._files.items():
+            final = f"{self._base}-{pid}.{source}"
+            try:
+                os.replace(f"{self._base}.{source}", final)
+            except OSError:
+                final = f"{self._base}.{source}"
+            paths[source] = final
+            f.close()  # the child owns the fd now
+        for source, path in paths.items():
+            _announce(path, "worker", pid, source)
+
+    def abort(self) -> None:
+        for source, f in self._files.items():
+            f.close()
+            try:
+                os.unlink(f"{self._base}.{source}")
+            except OSError:
+                pass
+
+
+def open_worker_capture(sources=("out", "err")) -> Optional[_WorkerCapture]:
+    """Capture files for a worker spawn, or None when this process has
+    no session log dir (standalone pool use): the spawn then inherits
+    the parent's streams — never DEVNULL."""
+    log_dir = _node_log_dir
+    if log_dir is None:
+        return None
+    try:
+        return _WorkerCapture(log_dir, sources)
+    except OSError:
+        logger.exception("could not open worker log files")
+        return None
+
+
+def open_launch_capture(tag: str) -> Tuple[Optional[Any], Optional[Any]]:
+    """Capture files for a LAUNCHED daemon process (spark / autoscaler
+    node providers): the daemon re-routes its own streams into the
+    session dir once registered, so these only hold pre-registration
+    output (import errors, argparse failures) — exactly the output that
+    used to vanish into DEVNULL. Returns (out_file, err_file) or
+    (None, None) when no session dir exists (streams inherit)."""
+    log_dir = _node_log_dir
+    if log_dir is None:
+        return None, None
+    token = uuid.uuid4().hex[:10]
+    base = os.path.join(log_dir, f"{tag}-{token}")
+    try:
+        return (open(base + ".out", "ab", buffering=0),
+                open(base + ".err", "ab", buffering=0))
+    except OSError:
+        logger.exception("could not open launch log files")
+        return None, None
+
+
+# ---------------------------------------------------------------------------
+# Daemon self-capture (multinode.NodeDaemon after registration)
+# ---------------------------------------------------------------------------
+
+
+def redirect_process_streams(log_dir: str, proc_name: str = "raylet"
+                             ) -> List[Tuple[str, str]]:
+    """Point this process's stdout/stderr at per-proc files in the
+    session dir (``raylet-<pid>.out/.err``) so in-daemon task prints and
+    crash output are captured like worker output. A tty stream is left
+    alone (interactive `ray-tpu start` keeps its console). Returns
+    [(path, source)] for the streams actually redirected, for the
+    caller to hand its LogMonitor."""
+    redirected = []
+    pid = os.getpid()
+    for source, fd, py_stream in (("out", 1, sys.stdout),
+                                  ("err", 2, sys.stderr)):
+        try:
+            if py_stream is not None and py_stream.isatty():
+                continue
+        except (ValueError, OSError):
+            pass  # closed/odd stream: still safe to redirect the fd
+        path = os.path.join(log_dir, f"{proc_name}-{pid}.{source}")
+        try:
+            f = open(path, "ab", buffering=0)
+            os.dup2(f.fileno(), fd)
+            f.close()
+            # The dup2 swapped the fd under Python's buffered wrapper;
+            # line buffering keeps task print() output streamable.
+            if py_stream is not None:
+                try:
+                    py_stream.reconfigure(line_buffering=True)
+                except (AttributeError, ValueError, OSError):
+                    pass
+            redirected.append((path, source))
+        except OSError:
+            logger.exception("could not redirect %s to %s", source, path)
+    return redirected
+
+
+def attach_file_logging(log_dir: str, proc_name: str = "raylet") -> None:
+    """Move this process's python logging onto a structured file handler
+    (``raylet-<pid>.log`` — deliberately NOT tailed to the driver: a
+    daemon's routine INFO stream is session-dir observability, not
+    driver console traffic). Existing stream handlers are dropped so
+    the captured .err file carries only genuine stderr output."""
+    path = os.path.join(log_dir, f"{proc_name}-{os.getpid()}.log")
+    try:
+        handler = logging.FileHandler(path)
+    except OSError:
+        return
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s\t%(levelname)s\t%(name)s\t%(message)s"))
+    root = logging.getLogger()
+    for h in list(root.handlers):
+        if isinstance(h, logging.StreamHandler) and \
+                not isinstance(h, logging.FileHandler):
+            root.removeHandler(h)
+    root.addHandler(handler)
+    if root.level == logging.NOTSET or root.level > logging.INFO:
+        root.setLevel(logging.INFO)
+
+
+# ---------------------------------------------------------------------------
+# Task markers (worker side)
+# ---------------------------------------------------------------------------
+
+
+def markers_enabled() -> bool:
+    return os.environ.get(MARKER_ENV) == "1"
+
+
+def emit_task_marker(task_name: str) -> None:
+    """Announce the current task on both captured streams so the tailer
+    prefixes subsequent lines with its name. One line, consumed by
+    LogMonitor, never forwarded."""
+    line = f"{TASK_MARKER}{task_name}\n"
+    for stream in (sys.stdout, sys.stderr):
+        try:
+            stream.write(line)
+            stream.flush()
+        except (ValueError, OSError):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Driver-side formatting + printer
+# ---------------------------------------------------------------------------
+
+_COLOR_RESET = "\033[0m"
+#: Prefix color by origin (reference: worker output cyan, raylet-ish
+#: system processes yellow, stderr red) — applied only on a tty.
+_COLORS = {("worker", "out"): "\033[36m",
+           ("worker", "err"): "\033[31m",
+           ("raylet", "out"): "\033[33m",
+           ("raylet", "err"): "\033[31m"}
+
+
+def format_log_batch(batch: Dict[str, Any], color: bool) -> List[str]:
+    """Render one published batch into driver-console lines:
+    ``(name pid=<pid>, node=<node12>) line``."""
+    name = batch.get("task_name") or batch.get("proc_name") or "worker"
+    node = (batch.get("node") or "")[:12]
+    prefix = f"({name} pid={batch.get('pid')}, node={node})"
+    if color:
+        c = _COLORS.get((batch.get("proc_name", "worker"),
+                         batch.get("source", "out")), "\033[36m")
+        prefix = f"{c}{prefix}{_COLOR_RESET}"
+    return [f"{prefix} {line}" for line in batch.get("lines", [])]
+
+
+class DriverLogPrinter:
+    """Subscribes to the runtime's ``logs`` pubsub channel and prints
+    every streamed line to the driver's stdout (``init(log_to_driver=
+    False)`` simply never starts one). Runs on a daemon thread; the
+    pubsub inbox's drop-oldest cap (PyPubsub.MAX_INBOX) bounds memory
+    when the driver console is slower than the log storm."""
+
+    def __init__(self, pubsub, channel: str = "logs"):
+        self._pubsub = pubsub
+        self._sub_id = f"driver-logs-{uuid.uuid4().hex[:8]}"
+        self._stop = threading.Event()
+        pubsub.subscribe(self._sub_id, channel)
+        self._thread = threading.Thread(
+            target=self._run, name="ray_tpu-log-printer", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        import json
+        try:
+            color = sys.stdout.isatty()
+        except (ValueError, OSError):
+            color = False
+        while not self._stop.is_set():
+            item = self._pubsub.poll(self._sub_id, timeout=0.25)
+            if item is None:
+                continue
+            try:
+                batch = json.loads(item[2])
+                out = "\n".join(format_log_batch(batch, color))
+                if out:
+                    sys.stdout.write(out + "\n")
+                    sys.stdout.flush()
+            except Exception:  # noqa: BLE001 - printing must not die
+                logger.exception("driver log printer failed on a batch")
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._pubsub.drop_subscriber(self._sub_id)
+        except Exception:  # noqa: BLE001 - pubsub already torn down
+            pass
+        self._thread.join(timeout=2)

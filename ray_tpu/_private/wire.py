@@ -27,7 +27,9 @@ from typing import Any, Dict, Tuple
 #: stored/error replies, fetch_object) + binary batch frames — frames
 #: are discriminated by leading magic byte (0x01 typed, 0x02 batch,
 #: 0x80 cloudpickle envelope).
-PROTOCOL_VERSION = 3
+#: v4: log_batch frames (daemon -> head log streaming) — a v3 head
+#: would reject the unknown type in validate_message.
+PROTOCOL_VERSION = 4
 
 
 class WireSchemaError(ValueError):
@@ -124,6 +126,18 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
     # receiver; reply_batch carries type-less reply frames.
     "task_batch": {"msgs": (_LIST, True)},
     "reply_batch": {"msgs": (_LIST, True)},
+    # -- log streaming (daemon -> head, v4) ----------------------------
+    # Batched tail output from a node's LogMonitor; the head fans it
+    # out to driver subscribers over pubsub. node_id is stamped by the
+    # daemon; task_name comes from stream markers and may be absent.
+    "log_batch": {
+        "node_id": (_STR, False),
+        "pid": (_INT, True),
+        "proc_name": (_STR, True),
+        "source": (_STR, True),
+        "task_name": (_OPT_STR, False),
+        "lines": (_LIST, True),
+    },
     # -- liveness ------------------------------------------------------
     "ping": {"cluster_digest": ((dict, type(None)), False)},
     "pong": {"sync": (_ANY, False)},

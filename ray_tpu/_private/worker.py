@@ -87,6 +87,7 @@ def init(
     logging_level: int = logging.INFO,
     include_dashboard: Optional[bool] = None,
     runtime_env: Optional[dict] = None,
+    log_to_driver: bool = True,
     _memory: Optional[float] = None,
     _system_config: Optional[dict] = None,
     **kwargs,
@@ -141,7 +142,8 @@ def init(
             num_cpus=num_cpus, num_tpus=num_tpus, memory=_memory,
             resources=resources)
         job_id = JobID.next()
-        runtime = Runtime(node, job_id, system_config=_system_config)
+        runtime = Runtime(node, job_id, system_config=_system_config,
+                          log_to_driver=log_to_driver)
         global_worker.set_runtime(runtime, job_id)
         if namespace:
             global_worker.namespace = namespace

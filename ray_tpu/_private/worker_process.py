@@ -42,7 +42,7 @@ import traceback
 import uuid
 from typing import Any, Dict, Optional
 
-from ray_tpu._private import procinfo
+from ray_tpu._private import procinfo, ray_logging
 
 logger = logging.getLogger(__name__)
 
@@ -239,9 +239,27 @@ def _spawn_worker(store_name: Optional[str],
         except Exception:  # noqa: BLE001 - non-Linux: best effort
             pass
 
-    proc = subprocess.Popen(cmd, env=env, pass_fds=[child_sock.fileno()],
-                            stdout=subprocess.DEVNULL,
-                            preexec_fn=_die_with_parent)
+    # Capture stdout/stderr to per-proc session files (the log monitor
+    # streams them to the driver); without a session the child simply
+    # inherits the parent's streams — output is never swallowed.
+    capture = ray_logging.open_worker_capture()
+    popen_kwargs: Dict[str, Any] = {}
+    if capture is not None:
+        env["PYTHONUNBUFFERED"] = "1"  # print() must reach the tailer
+        env[ray_logging.MARKER_ENV] = "1"
+        popen_kwargs["stdout"] = capture.out
+        popen_kwargs["stderr"] = capture.err
+    try:
+        proc = subprocess.Popen(cmd, env=env,
+                                pass_fds=[child_sock.fileno()],
+                                preexec_fn=_die_with_parent,
+                                **popen_kwargs)
+    except BaseException:
+        if capture is not None:
+            capture.abort()
+        raise
+    if capture is not None:
+        capture.finalize(proc.pid)
     child_sock.close()
     return WorkerHandle(proc, parent_sock)
 
@@ -277,7 +295,14 @@ def _spawn_container_worker(store_name: Optional[str],
     cmd = [engine, "run", "--rm", "-i", "--init", "--network=host",
            "--cidfile", cidfile,
            "-v", "/dev/shm:/dev/shm"]
-    for key in ("RAY_TPU_WORKER", "RAY_TPU_HEAD_ADDRESS"):
+    # Only stderr is capturable here: stdout is the protocol pipe (the
+    # worker's --stdio mode points fd 1 at stderr before user code, so
+    # print() output lands in the captured .err).
+    capture = ray_logging.open_worker_capture(sources=("err",))
+    if capture is not None:
+        env[ray_logging.MARKER_ENV] = "1"
+    for key in ("RAY_TPU_WORKER", "RAY_TPU_HEAD_ADDRESS",
+                ray_logging.MARKER_ENV):
         if env.get(key):
             cmd += ["-e", f"{key}={env[key]}"]
     cmd += list(container.get("run_options") or [])
@@ -294,9 +319,20 @@ def _spawn_container_worker(store_name: Optional[str],
         except Exception:  # noqa: BLE001 - non-Linux: best effort
             pass
 
-    proc = subprocess.Popen(cmd, env=env, stdin=subprocess.PIPE,
-                            stdout=subprocess.PIPE,
-                            preexec_fn=_die_with_parent)
+    popen_kwargs: Dict[str, Any] = {}
+    if capture is not None:
+        popen_kwargs["stderr"] = capture.err
+    try:
+        proc = subprocess.Popen(cmd, env=env, stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE,
+                                preexec_fn=_die_with_parent,
+                                **popen_kwargs)
+    except BaseException:
+        if capture is not None:
+            capture.abort()
+        raise
+    if capture is not None:
+        capture.finalize(proc.pid)
     handle = WorkerHandle(proc, _StdioTransport(proc))
     handle.cidfile = cidfile
     return handle
@@ -665,6 +701,10 @@ class _WorkerMain:
         _task_context.spec = _types.SimpleNamespace(
             _tpu_ids=None, actor_id=None, name=msg.get("name", ""),
             task_id_hex=msg.get("task_id"))
+        if ray_logging.markers_enabled():
+            # Announce the task on the captured streams so the tailer
+            # prefixes its output with the task name, not just the pid.
+            ray_logging.emit_task_marker(msg.get("name", ""))
         pinned_keys: list = []
         try:
             args, kwargs = _loads(msg["payload"])

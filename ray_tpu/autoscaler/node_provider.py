@@ -303,8 +303,21 @@ class DaemonProcessNodeProvider(_RecordNodeProvider):
                                            provider_id})]
             if resources:
                 cmd += ["--resources", json.dumps(resources)]
-            proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
-                                    stderr=subprocess.DEVNULL)
+            # Pre-registration daemon output goes to session launch
+            # logs when a session exists (never DEVNULL — a daemon
+            # that dies before joining must leave its words somewhere);
+            # once registered it re-routes into per-proc raylet files.
+            from ray_tpu._private import ray_logging
+            out_f, err_f = ray_logging.open_launch_capture("autoscaler-daemon")
+            kwargs = {}
+            if out_f is not None:
+                kwargs = {"stdout": out_f, "stderr": err_f}
+            try:
+                proc = subprocess.Popen(cmd, **kwargs)
+            finally:
+                for f in (out_f, err_f):
+                    if f is not None:
+                        f.close()  # the child holds its own copy
             node_tags = dict(tags)
             node_tags.setdefault(TAG_RAY_NODE_STATUS, STATUS_UP_TO_DATE)
             with self._lock:

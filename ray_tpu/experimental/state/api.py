@@ -107,6 +107,97 @@ def summarize_objects() -> Dict[str, Any]:
     return stats
 
 
+def _session_log_root() -> str:
+    """The session whose logs to read: the live one when initialized,
+    else the newest on disk (``session_latest``) — so `ray-tpu logs`
+    works after the driver exits, without creating a fresh session."""
+    import os
+
+    from ray_tpu._private import ray_logging
+    # NOT global_worker.runtime: that property auto-inits a runtime,
+    # which would create (and repoint session_latest to) a fresh empty
+    # session — exactly what a post-mortem `ray-tpu logs` must not do.
+    sdir = None
+    if global_worker._runtime is not None:
+        sdir = ray_logging.current_session_dir()
+    if sdir is None:
+        sdir = ray_logging.latest_session_dir()
+    if sdir is None:
+        raise FileNotFoundError(
+            "no ray_tpu session log directory found (nothing under "
+            f"{ray_logging.sessions_root()})")
+    return os.path.join(sdir, "logs")
+
+
+def list_logs(node_id: Optional[str] = None,
+              filters: Optional[List[tuple]] = None,
+              limit: int = 1000) -> List[Dict[str, Any]]:
+    """Enumerate the session's log files (reference: list_logs over the
+    node's log dir). ``node_id`` matches the per-node directory name
+    prefix ("head", or a node id hex prefix)."""
+    import os
+    root = _session_log_root()
+    out = []
+    try:
+        node_dirs = sorted(os.listdir(root))
+    except OSError:
+        node_dirs = []
+    for node_dir in node_dirs:
+        label = node_dir[5:] if node_dir.startswith("node-") else node_dir
+        if node_id and not label.startswith(node_id) \
+                and not node_dir.startswith(node_id):
+            continue
+        full = os.path.join(root, node_dir)
+        try:
+            fnames = sorted(os.listdir(full))
+        except OSError:
+            continue
+        for fname in fnames:
+            path = os.path.join(full, fname)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            out.append({"node": label, "filename": fname,
+                        "size_bytes": size, "path": path})
+    return _apply_filters(out, filters)[:limit]
+
+
+def get_log(filename: Optional[str] = None,
+            node_id: Optional[str] = None,
+            pid: Optional[int] = None,
+            tail: int = 1000) -> List[str]:
+    """Read the last ``tail`` lines of matching session log files
+    (reference: get_log streams a file from the agent; here the files
+    are host-local). Select by exact ``filename``, ``pid`` (matches the
+    per-proc naming), and/or ``node_id``; ``tail=-1`` reads whole
+    files."""
+    rows = list_logs(node_id=node_id, limit=10_000)
+    pid_tag = str(pid) if pid is not None else None
+    lines: List[str] = []
+    for row in rows:
+        fname = row["filename"]
+        if filename and fname != filename:
+            continue
+        if fname.endswith(".log") and not filename:
+            continue  # structured daemon logs only on explicit request
+        if pid_tag and pid_tag not in \
+                fname.rsplit(".", 1)[0].replace("-", ".").split("."):
+            continue
+        try:
+            with open(row["path"], "rb") as f:
+                data = f.read()
+        except OSError:
+            continue
+        file_lines = data.decode("utf-8", "replace").splitlines()
+        if tail >= 0:
+            file_lines = file_lines[-tail:]
+        lines.extend(file_lines)
+    if tail >= 0:
+        lines = lines[-tail:] if len(lines) > tail else lines
+    return lines
+
+
 def _apply_filters(rows: List[Dict[str, Any]],
                    filters: Optional[List[tuple]]) -> List[Dict[str, Any]]:
     if not filters:

@@ -121,6 +121,43 @@ def cmd_job(args) -> int:
     return 1
 
 
+def cmd_logs(args) -> int:
+    """`ray-tpu logs [filename] [--node/--pid/--tail/--follow]` —
+    read the session's captured per-process logs from disk (reference:
+    `ray logs`, scripts/scripts.py:2390). Deliberately does NOT
+    initialize a runtime: it reads the CURRENT session when run inside
+    a driver, else the newest ``session_latest`` on disk."""
+    import time
+
+    from ray_tpu.experimental.state import api
+    kwargs = dict(filename=args.filename, node_id=args.node,
+                  pid=args.pid)
+    try:
+        if args.list:
+            for row in api.list_logs(node_id=args.node):
+                print(f"{row['node']}\t{row['size_bytes']}\t"
+                      f"{row['filename']}")
+            return 0
+        lines = api.get_log(tail=args.tail, **kwargs)
+    except FileNotFoundError as exc:
+        print(str(exc), file=sys.stderr)
+        return 1
+    for line in lines:
+        print(line)
+    if not args.follow:
+        return 0
+    seen = len(api.get_log(tail=-1, **kwargs))
+    try:
+        while True:
+            time.sleep(1.0)
+            all_lines = api.get_log(tail=-1, **kwargs)
+            for line in all_lines[seen:]:
+                print(line)
+            seen = max(seen, len(all_lines))
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_profile(args) -> int:
     """On-demand CPU profile of this driver process or a node daemon
     (reference: py-spy-backed dashboard profiling); writes a speedscope
@@ -297,6 +334,22 @@ def main(argv=None) -> int:
         pj.add_argument("job_id")
     jsub.add_parser("list")
 
+    p = sub.add_parser("logs", help="read captured session logs "
+                                    "(worker/daemon stdout+stderr)")
+    p.add_argument("filename", nargs="?", default=None,
+                   help="exact log filename (default: all capture "
+                        "files)")
+    p.add_argument("--node", default=None,
+                   help="node id prefix (or 'head') to read")
+    p.add_argument("--pid", type=int, default=None,
+                   help="only files of this process id")
+    p.add_argument("--tail", type=int, default=1000,
+                   help="last N lines (-1 for everything)")
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="keep polling for new lines")
+    p.add_argument("--list", action="store_true",
+                   help="list the session's log files instead")
+
     p = sub.add_parser("profile", help="sample CPU stacks on demand "
                                        "(driver or --node <id>)")
     p.add_argument("--node", default=None,
@@ -361,6 +414,7 @@ def main(argv=None) -> int:
         "metrics": cmd_metrics,
         "devices": cmd_devices,
         "job": cmd_job,
+        "logs": cmd_logs,
         "serve": cmd_serve,
         "dashboard": cmd_dashboard,
         "start": cmd_start,

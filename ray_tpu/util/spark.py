@@ -63,9 +63,21 @@ def _start_worker_daemon(head_address: str, *, num_cpus: float = 1.0,
     full_env = dict(os.environ)
     if env:
         full_env.update(env)
-    return subprocess.Popen(cmd, env=full_env,
-                            stdout=subprocess.DEVNULL,
-                            stderr=subprocess.DEVNULL)
+    # Pre-registration output (import errors, bad args) lands in
+    # session launch-log files when a session exists; the daemon
+    # re-routes its own streams once registered. No DEVNULL: a daemon
+    # that dies before registering must leave its words somewhere.
+    from ray_tpu._private import ray_logging
+    out_f, err_f = ray_logging.open_launch_capture("spark-daemon")
+    kwargs = {}
+    if out_f is not None:
+        kwargs = {"stdout": out_f, "stderr": err_f}
+    try:
+        return subprocess.Popen(cmd, env=full_env, **kwargs)
+    finally:
+        for f in (out_f, err_f):
+            if f is not None:
+                f.close()  # the child holds its own copy
 
 
 def setup_ray_cluster(num_worker_nodes: int, *,

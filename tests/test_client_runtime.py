@@ -186,8 +186,7 @@ def test_daemon_creates_named_actor_visible_on_head(head_with_daemons):
             def get(self):
                 return self.v
 
-        h = Holder.options(name="from-daemon", lifetime="detached") \
-            .remote(123)
+        h = Holder.options(name="from-daemon").remote(123)
         return rt.get(h.get.remote())
 
     assert ray_tpu.get(creator.remote()) == 123

@@ -38,7 +38,7 @@ class Counter:
         self.n += 1
         return self.n
 
-c = Counter.options(name="survivor", lifetime="detached").remote()
+c = Counter.options(name="survivor").remote()
 assert ray_tpu.get(c.inc.remote()) == 1
 assert ray_tpu.get(c.inc.remote()) == 2
 print("READY", flush=True)

@@ -1,0 +1,74 @@
+"""Source lints guarding the log subsystem's invariants.
+
+Two regressions are cheap to introduce and expensive to notice at
+runtime, so CI catches them statically:
+
+1. ``subprocess.Popen(..., stdout=DEVNULL)`` (or stderr) anywhere under
+   ``ray_tpu/`` — discarding child output defeats log capture; route
+   streams through ``ray_logging`` instead.
+2. Bare ``print(`` under ``ray_tpu/_private/`` — framework internals
+   must use the ``logging`` module (or explicit stream writes) so their
+   chatter doesn't masquerade as user task output in the stream.
+"""
+
+import ast
+import os
+
+import ray_tpu
+
+PKG_ROOT = os.path.dirname(os.path.abspath(ray_tpu.__file__))
+
+
+def _py_files(root):
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def _parse(path):
+    with open(path, "rb") as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def _is_devnull(node):
+    return (isinstance(node, ast.Attribute) and node.attr == "DEVNULL") or \
+        (isinstance(node, ast.Name) and node.id == "DEVNULL")
+
+
+def _is_popen(func):
+    return (isinstance(func, ast.Attribute) and func.attr == "Popen") or \
+        (isinstance(func, ast.Name) and func.id == "Popen")
+
+
+def test_no_devnull_popen_in_package():
+    offenders = []
+    for path in _py_files(PKG_ROOT):
+        tree = _parse(path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _is_popen(node.func)):
+                continue
+            for kw in node.keywords:
+                if kw.arg in ("stdout", "stderr") and _is_devnull(kw.value):
+                    rel = os.path.relpath(path, PKG_ROOT)
+                    offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        "Popen with stdout/stderr=DEVNULL discards output the log "
+        "subsystem should capture (use ray_logging.open_worker_capture "
+        "or open_launch_capture): " + ", ".join(offenders))
+
+
+def test_no_bare_print_in_private():
+    offenders = []
+    for path in _py_files(os.path.join(PKG_ROOT, "_private")):
+        tree = _parse(path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id == "print":
+                rel = os.path.relpath(path, PKG_ROOT)
+                offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        "bare print() in ray_tpu/_private/ — use logging (or an "
+        "explicit sys.stdout.write for CLI-facing output): "
+        + ", ".join(offenders))

@@ -126,7 +126,7 @@ def test_borrower_keeps_object_alive_after_creator_closes(ab_daemons):
             arr = rt.get(ref, timeout=60)
             return int(arr[0]), int(arr.shape[0])
 
-    holder = Holder.options(name="holder", lifetime="detached").remote()
+    holder = Holder.options(name="holder").remote()
 
     @ray_tpu.remote(resources={"site_a": 1},
                     runtime_env={"worker_process": True})
