@@ -445,6 +445,71 @@ def bench_envelope() -> dict:
     return out
 
 
+def bench_detached_restart() -> dict:
+    """Detached-actor failover latency: a GCS-owned detached actor lives
+    on a daemon; the daemon is SIGKILLed and a replacement joins. The
+    metric is kill -> first successful call on the restarted instance,
+    i.e. the full death-detection + reschedule + re-init + reply path an
+    operator sees when a node hosting a long-lived service dies."""
+    import json as _json
+    import subprocess
+    import sys
+    import time as _time
+
+    import ray_tpu
+
+    out = {}
+    ray_tpu.init(num_cpus=1)
+    procs = []
+
+    def _spawn_daemon(port):
+        return subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.multinode",
+             "--address", f"127.0.0.1:{port}", "--num-cpus", "2",
+             "--resources", _json.dumps({"det": 1})],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    try:
+        host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+        procs.append(_spawn_daemon(port))
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("det", 0) >= 1:
+                break
+            _time.sleep(0.1)
+        else:
+            raise TimeoutError("daemon never registered")
+
+        @ray_tpu.remote(resources={"det": 1}, max_restarts=1)
+        class Svc:
+            def ping(self):
+                return "pong"
+
+        svc = Svc.options(name="bench-det", lifetime="detached").remote()
+        assert ray_tpu.get(svc.ping.remote(), timeout=60) == "pong"
+
+        procs[0].kill()
+        procs[0].wait(timeout=10)
+        t0 = _time.perf_counter()
+        procs.append(_spawn_daemon(port))
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:
+            try:
+                if ray_tpu.get(svc.ping.remote(), timeout=10) == "pong":
+                    break
+            except Exception:  # noqa: BLE001 - restart still in flight
+                _time.sleep(0.05)
+        else:
+            raise TimeoutError("detached actor never restarted")
+        out["detached_actor_restart_ms"] = round(
+            (_time.perf_counter() - t0) * 1e3, 1)
+        ray_tpu.kill(svc, no_restart=True)
+    finally:
+        _stop_procs(procs)
+        ray_tpu.shutdown()
+    return out
+
+
 def bench_serve() -> dict:
     """Serving-plane throughput/latency (reference: release/serve_tests
     autoscaling_single_deployment + single_deployment_1k_noop_replica):
@@ -1069,6 +1134,8 @@ def main():
         ("shuffle_multi", "shuffle_multi_mb_per_sec",
          bench_shuffle_multi_daemon),
         ("envelope", "envelope_tasks_per_sec", bench_envelope),
+        ("detached_restart", "detached_actor_restart_ms",
+         bench_detached_restart),
         ("log_stream", "log_lines_per_sec", bench_log_streaming),
     ]
     if on_tpu:

@@ -735,6 +735,10 @@ class ClientSession:
         self._send_lock = threading.Lock()
         self._plock = threading.Lock()
         self._pinned: Dict[ObjectID, ObjectRef] = {}
+        # Actors this session created (reference: ownership — a non-
+        # detached actor dies with its creator). Reaped on close();
+        # detached actors are never tracked here.
+        self._created_actors: set = set()
         self._closed = False
         self._on_close = on_close
 
@@ -762,6 +766,21 @@ class ClientSession:
                 return
             self._closed = True
             self._pinned.clear()  # handles die → refcounts decrement
+            created = list(self._created_actors)
+            self._created_actors.clear()
+        # Client disconnect reaps the actors this session created —
+        # EXCEPT detached ones, whose lifetime the GCS owns (they were
+        # never tracked). Double-check liveness/lifetime against the
+        # runtime: a handle may have been killed or re-created since.
+        for actor_id in created:
+            state = self.runtime.actor_state(actor_id)
+            if state is None or state.dead or state.detached:
+                continue
+            try:
+                self.runtime.kill_actor(actor_id, no_restart=True)
+            except Exception:  # noqa: BLE001 - teardown best effort
+                logger.exception("failed to reap client actor %s",
+                                 actor_id.hex()[:12])
         try:
             self._sock.close()
         except OSError:
@@ -843,11 +862,26 @@ class ClientSession:
             return {"refs": [r.hex() for r in refs]}
         if op == "create_actor":
             spec = _loads(msg["spec"])
+            opts = msg["opts"]
+            # get_if_exists may hand back an actor some OTHER session
+            # (or the head driver) created — this session must not adopt
+            # its lifetime. Resolve the name first to tell apart.
+            existing = None
+            if opts.get("name") and opts.get("get_if_exists"):
+                try:
+                    existing = rt.get_named_actor(
+                        opts["name"], opts.get("namespace") or "default")
+                except ValueError:
+                    existing = None
             # No re-mint needed: creation task ids derive deterministically
             # from the actor id (TaskID.for_actor_creation — 8 random
             # actor bytes, zero unique part), a shape head-minted normal/
             # actor task ids can never take.
-            actor_id = rt.create_actor(spec, **msg["opts"])
+            actor_id = rt.create_actor(spec, **opts)
+            if actor_id != existing and opts.get("lifetime") != "detached":
+                with self._plock:
+                    if not self._closed:
+                        self._created_actors.add(actor_id)
             return {"actor_id": actor_id.hex()}
         if op == "actor_info":
             state = rt.actor_state(ActorID(bytes.fromhex(msg["actor_id"])))
@@ -857,7 +891,8 @@ class ClientSession:
                     "fn_id": state.creation_spec.function_id,
                     "name": state.name, "namespace": state.namespace,
                     "dead": state.dead,
-                    "num_restarts": state.num_restarts}
+                    "num_restarts": state.num_restarts,
+                    "lifetime": state.lifetime}
         if op == "get_named_actor":
             actor_id = rt.get_named_actor(msg["name"], msg["namespace"])
             return {"actor_id": actor_id.hex()}

@@ -93,13 +93,19 @@ class GcsStore:
                      max_restarts: int, max_concurrency: int,
                      cls_bytes: Optional[bytes] = None,
                      resources: Optional[Dict[str, float]] = None,
-                     concurrency_groups: Optional[Dict[str, int]] = None
-                     ) -> None:
+                     concurrency_groups: Optional[Dict[str, int]] = None,
+                     lifetime: Optional[str] = None,
+                     num_restarts: int = 0,
+                     creation_payload: Optional[bytes] = None) -> None:
         """cls_bytes: the pickled actor class, so a restarted head can
         rebuild handles (method introspection) for rebound actors.
         resources: the creation-time reservation, re-acquired on the
         actor's node at rebind so a restarted head cannot double-book
-        what the resident instance still consumes."""
+        what the resident instance still consumes.
+        lifetime/num_restarts/creation_payload: detached actors carry
+        their full restart budget AND pickled __init__ (args, kwargs)
+        across head restarts — a rebound detached actor can still be
+        restarted elsewhere after its node dies."""
         with self._lock:
             self.actors[actor_id_hex] = {
                 "name": name, "namespace": namespace,
@@ -108,7 +114,21 @@ class GcsStore:
                 "cls_bytes": cls_bytes,
                 "resources": dict(resources or {}),
                 "concurrency_groups": dict(concurrency_groups or {}),
+                "lifetime": lifetime,
+                "num_restarts": num_restarts,
+                "creation_payload": creation_payload,
             }
+            self._save_locked()
+
+    def update_actor(self, actor_id_hex: str, **fields: Any) -> None:
+        """Merge fields into an existing record (restart-budget burn-down:
+        ``num_restarts`` must survive a SECOND head restart too). No-op
+        for unknown actors — a racing kill wins."""
+        with self._lock:
+            rec = self.actors.get(actor_id_hex)
+            if rec is None:
+                return
+            rec.update(fields)
             self._save_locked()
 
     def remove_actor(self, actor_id_hex: str) -> None:

@@ -1130,17 +1130,24 @@ class HeadServer:
         from ray_tpu._private.event_stats import GLOBAL
         return GLOBAL.summary()
 
-    def stop(self) -> None:
+    def stop(self, keep_nodes=()) -> None:
+        """``keep_nodes``: node ids hosting detached actors. Those
+        daemons get NO shutdown frame — just a socket close, which their
+        run() loop treats as connection loss: resident actors are kept
+        for the reconnect window so a restarted head (same port +
+        gcs_store_path) can rebind them."""
         self._closed = True
+        keep = set(keep_nodes or ())
         try:
             self._listener.close()
         except OSError:
             pass
-        for conn in list(self._conns.values()):
+        for node_id, conn in list(self._conns.items()):
             conn._on_death = None  # orderly shutdown, not node death
-            # Through the sender (the socket's single writer), flushed
-            # before close() tears the socket down.
-            conn._sender.send({"type": "shutdown", "req_id": 0})
+            if node_id not in keep:
+                # Through the sender (the socket's single writer),
+                # flushed before close() tears the socket down.
+                conn._sender.send({"type": "shutdown", "req_id": 0})
             conn._sender.flush()
             conn.close()
         self._conns.clear()
@@ -2201,7 +2208,14 @@ class NodeDaemon:
         # ray_tpu.get_tpu_ids() works inside remotely executed tasks.
         import types
 
+        from ray_tpu._private import ray_logging
         from ray_tpu._private.runtime import _task_context
+        name = msg.get("name") or ""
+        if name and ray_logging.markers_enabled():
+            # In-daemon execution writes to the daemon's captured
+            # streams; the marker attributes subsequent output to this
+            # task (actor calls: `Cls.method pid=` driver prefixes).
+            ray_logging.emit_task_marker(name)
         _task_context.spec = types.SimpleNamespace(
             _tpu_ids=msg.get("tpu_ids"), actor_id=None,
             name=msg.get("name", ""),
@@ -2402,6 +2416,12 @@ class NodeDaemon:
             return
         ray_logging.attach_file_logging(log_dir)
         redirected = ray_logging.redirect_process_streams(log_dir)
+        if redirected:
+            # Streams are captured (not a tty): in-daemon task/actor
+            # execution can announce task names via stream markers —
+            # actor calls show `Cls.method pid=` in driver streaming
+            # like worker-subprocess output does.
+            os.environ[ray_logging.MARKER_ENV] = "1"
         monitor = LogMonitor(self._publish_log_batch)
         for path, source in redirected:
             monitor.add_file(path, "raylet", os.getpid(), source)

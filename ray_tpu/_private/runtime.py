@@ -289,7 +289,8 @@ class ActorState:
     def __init__(self, actor_id: ActorID, creation_spec: TaskSpec,
                  max_restarts: int, max_concurrency: int, name: str = "",
                  namespace: str = "",
-                 concurrency_groups: Optional[Dict[str, int]] = None):
+                 concurrency_groups: Optional[Dict[str, int]] = None,
+                 lifetime: Optional[str] = None):
         self.actor_id = actor_id
         self.creation_spec = creation_spec
         self.max_restarts = max_restarts
@@ -298,6 +299,11 @@ class ActorState:
         self.concurrency_groups = dict(concurrency_groups or {})
         self.name = name
         self.namespace = namespace
+        # GCS-owned lifetime (reference: gcs_actor_manager detached
+        # actors): "detached" actors are NOT reaped on driver exit or
+        # client disconnect; only kill(no_restart=True) removes them.
+        self.lifetime = lifetime
+        self.detached = lifetime == "detached"
         self.executor: Optional[Executor] = None
         self.instance: Any = None  # thread backend: the live instance
         self.dead = False
@@ -1891,12 +1897,20 @@ class Runtime:
                      max_concurrency: int, name: str = "",
                      namespace: str = "default",
                      get_if_exists: bool = False,
-                     concurrency_groups: Optional[Dict[str, int]] = None
-                     ) -> ActorID:
+                     concurrency_groups: Optional[Dict[str, int]] = None,
+                     lifetime: Optional[str] = None) -> ActorID:
         actor_id = spec.actor_id
+        if lifetime == "detached" and not name:
+            # A detached actor is reachable ONLY through the named-actor
+            # registry once its creator exits — an anonymous one would
+            # be an unkillable orphan.
+            raise ValueError(
+                "detached actors must be created with a name "
+                "(.options(name=..., lifetime='detached'))")
         state = ActorState(actor_id, spec, max_restarts, max_concurrency,
                            name, namespace,
-                           concurrency_groups=concurrency_groups)
+                           concurrency_groups=concurrency_groups,
+                           lifetime=lifetime)
         with self._lock:
             # Uniqueness check + registration atomically, so concurrent
             # creates with the same name cannot both succeed.
@@ -1917,11 +1931,24 @@ class Runtime:
                 cls_bytes = self.functions.get_bytes(spec.function_id)
             except KeyError:
                 cls_bytes = None  # unpicklable: cannot survive restarts
+            creation_payload = None
+            if lifetime == "detached":
+                # Detached actors must be restartable AFTER a head
+                # restart — persist the __init__ args so the rebound
+                # creation spec is re-runnable (best effort: unpicklable
+                # args degrade to rebind-without-restart).
+                try:
+                    creation_payload = serialization.serialize(
+                        (spec.args, spec.kwargs))
+                except Exception:  # noqa: BLE001
+                    creation_payload = None
             self.gcs_store.record_actor(
                 actor_id.hex(), name, namespace, max_restarts,
                 max_concurrency, cls_bytes=cls_bytes,
                 resources=dict(spec.resources or {}),
-                concurrency_groups=concurrency_groups)
+                concurrency_groups=concurrency_groups,
+                lifetime=lifetime,
+                creation_payload=creation_payload)
         spec.return_ids = [ObjectID.for_return(spec.task_id, 1)]
         self._register_task_refs(spec)
         self._record_event(spec, "SUBMITTED")
@@ -2047,6 +2074,10 @@ class Runtime:
                 if state.name:
                     self._named_actors.pop((state.namespace, state.name),
                                            None)
+            if state.name and self.gcs_store is not None:
+                # A never-constructed actor must not be rebound after a
+                # head restart (detached or not).
+                self.gcs_store.remove_actor(state.actor_id.hex())
         with self._lock:
             if self._inflight.get(spec.task_id) is spec:
                 self._inflight.pop(spec.task_id, None)
@@ -2318,6 +2349,11 @@ class Runtime:
                 self._drain_actor_seq(state, seq_state)
         for spec in unfinished:
             self._store_error(spec, cause)
+        if state.name and self.gcs_store is not None:
+            # Burn down the persisted budget too: the count must survive
+            # a head restart (detached actors keep restarting after one).
+            self.gcs_store.update_actor(state.actor_id.hex(),
+                                        num_restarts=state.num_restarts)
         # Re-run the creation task (a fresh TaskSpec attempt on the same
         # actor id); resources were never released, so dispatch reuses the
         # original reservation by running creation on a pool worker directly.
@@ -2614,23 +2650,50 @@ class Runtime:
                 # Name check and registration happen under ONE lock
                 # acquisition: a concurrent create_actor can never claim
                 # the name between our check and our insert.
+                lifetime = rec.get("lifetime")
+                creation_args: tuple = ()
+                creation_kwargs: dict = {}
+                max_restarts = 0
+                if lifetime == "detached":
+                    # Detached records carry the pickled __init__ args,
+                    # so the rebound actor keeps its FULL restart budget
+                    # — a later node death re-runs the creation
+                    # elsewhere. Undecodable payload degrades to
+                    # rebind-without-restart (max_restarts=0), matching
+                    # plain named actors.
+                    payload = rec.get("creation_payload")
+                    if payload is not None:
+                        try:
+                            creation_args, creation_kwargs = \
+                                serialization.deserialize(payload)
+                            max_restarts = rec["max_restarts"]
+                        except Exception:  # noqa: BLE001
+                            creation_args, creation_kwargs = (), {}
                 spec = TaskSpec(
                     task_id=TaskID.for_normal_task(self.job_id),
                     kind=TaskKind.ACTOR_CREATION, function_id=fn_id,
-                    args=(), kwargs={}, resources=resources,
+                    args=creation_args, kwargs=creation_kwargs,
+                    resources=resources,
                     num_returns=1, name=rec["name"] or "actor",
                     actor_id=actor_id)
+                # The creation never re-runs on THIS head unless the
+                # node dies — but the restart clone goes through the
+                # normal creation path, which seals return_ids[0].
+                spec.return_ids = [ObjectID.for_return(spec.task_id, 1)]
                 # Node-death bookkeeping must see where the instance
                 # lives, and release needs the acquire marker.
                 spec._node_id = node_id  # type: ignore[attr-defined]
                 spec._acquired_bundle = -1  # type: ignore[attr-defined]
-                # Rebound actors cannot be restarted in place (their
-                # creation args died with the old head) — max_restarts=0.
-                state = ActorState(actor_id, spec, 0,
+                # Non-detached rebound actors cannot be restarted in
+                # place (their creation args died with the old head) —
+                # max_restarts=0.
+                state = ActorState(actor_id, spec, max_restarts,
                                    rec["max_concurrency"],
                                    rec["name"], rec["namespace"],
                                    concurrency_groups=rec.get(
-                                       "concurrency_groups"))
+                                       "concurrency_groups"),
+                                   lifetime=lifetime)
+                state.num_restarts = int(rec.get("num_restarts") or 0)
                 state.instance = RemoteActorInstance(conn, actor_id)
                 state.executor = self._make_actor_executor(state)
                 state.created.set()
@@ -2984,9 +3047,15 @@ class Runtime:
             self._store_error(spec, cause)
         if not can_restart:
             with self._lock:
-                if state.name:
+                if state.name and not state.detached:
+                    # Detached actors keep their registry entry even when
+                    # the restart budget is spent: ONLY kill() removes it
+                    # (get_actor still resolves; calls raise ActorDied).
                     self._named_actors.pop((state.namespace, state.name), None)
             return
+        if state.name and self.gcs_store is not None:
+            self.gcs_store.update_actor(state.actor_id.hex(),
+                                        num_restarts=state.num_restarts)
         # Re-dispatch a CLONE of the creation task through the normal path so
         # the actor comes up on an alive node with a fresh acquisition. The
         # original spec stays invalidated: if its __init__ is still running
@@ -3127,8 +3196,38 @@ class Runtime:
                 rec = dict(rec, status="FINISHED",
                            end_time=time.time())
                 self.gcs_store.record_job(self._gcs_job_key, rec)
+        # Detached actors survive an orderly shutdown (reference: GCS-
+        # owned lifetime): their host daemons are closed WITHOUT the
+        # shutdown frame — the daemon treats it as connection loss,
+        # keeps the resident instance, and a later head on the same
+        # port + gcs_store_path rebinds it. Non-detached named actors
+        # are reaped for real: registry record removed (no rebind after
+        # an orderly exit) and resident instances on surviving daemons
+        # destroyed.
+        with self._lock:
+            remote_nodes = dict(self._remote_nodes)
+            actors = list(self._actors.values())
+        detached_nodes = set()
+        for state in actors:
+            node_id = getattr(state.creation_spec, "_node_id", None)
+            if state.detached and not state.dead \
+                    and node_id in remote_nodes:
+                detached_nodes.add(node_id)
+        for state in actors:
+            if state.detached and not state.dead:
+                continue
+            node_id = getattr(state.creation_spec, "_node_id", None)
+            if state.name and self.gcs_store is not None:
+                self.gcs_store.remove_actor(state.actor_id.hex())
+            if node_id in detached_nodes and not state.dead:
+                # This daemon outlives the driver; don't leave a zombie
+                # resident instance it would re-announce on reconnect.
+                try:
+                    remote_nodes[node_id].destroy_actor(state.actor_id)
+                except Exception:  # noqa: BLE001 - best effort
+                    pass
         if self._head_server is not None:
-            self._head_server.stop()
+            self._head_server.stop(keep_nodes=detached_nodes)
             self._head_server = None
         with self._lock:
             self._remote_nodes.clear()

@@ -80,14 +80,12 @@ def validate_options(options: Dict[str, Any], for_actor: bool) -> Dict[str, Any]
                 not isinstance(num_returns, int) or num_returns < 0):
             raise ValueError("num_returns must be a non-negative int or 'dynamic'")
     lifetime = options.get("lifetime")
-    if lifetime not in (None, "non_detached"):
-        # A silently ignored lifetime="detached" is worse than a clean
-        # error: the actor would die with the driver while the user
-        # planned around it surviving.
-        if lifetime == "detached":
-            raise ValueError("detached actors not yet supported")
+    if lifetime not in (None, "non_detached", "detached"):
         raise ValueError(
-            f"lifetime must be None or 'non_detached', got {lifetime!r}")
+            "lifetime must be None, 'non_detached' or 'detached', "
+            f"got {lifetime!r}")
+    if lifetime == "detached" and not for_actor:
+        raise ValueError("lifetime='detached' is only valid for actors")
     if for_actor:
         max_restarts = options.get("max_restarts")
         if max_restarts is not None and (

@@ -187,6 +187,7 @@ class ActorClass:
             namespace=namespace,
             get_if_exists=get_if_exists,
             concurrency_groups=options.get("concurrency_groups"),
+            lifetime=options.get("lifetime"),
         )
         return ActorHandle(actual_id, self._cls, name)
 

@@ -12,6 +12,8 @@ Routes (all JSON unless noted):
   GET  /api/v0/{actors,tasks,objects,nodes,placement_groups} — state API
   GET  /api/v0/tasks/summarize — task state counts
   GET  /metrics                — Prometheus text format
+  GET  /api/logs               — session log tails (?node_id=&pid=
+                                 &filename=&tail=; ?list=1 enumerates)
   GET  /api/jobs/              — list jobs
   POST /api/jobs/              — submit {entrypoint, runtime_env?}
   GET  /api/jobs/{id}          — job detail
@@ -59,6 +61,7 @@ class DashboardHead:
             for path in ("/api/version", "/api/cluster_status",
                          "/api/v0/actors", "/api/v0/tasks",
                          "/api/v0/nodes", "/api/jobs/", "/metrics",
+                         "/api/logs?list=1",
                          "/api/serve/applications", "/api/timeline",
                          "/api/event_stats"))
         return web.Response(
@@ -118,6 +121,32 @@ class DashboardHead:
     async def _timeline(self, request):
         from ray_tpu._private.state import timeline
         return self._json(timeline())
+
+    async def _logs(self, request):
+        """Session log files over HTTP (reference: dashboard
+        /api/v0/logs backed by the log agent; here the head reads the
+        session dir directly). ``?list=1`` enumerates the capture
+        files; otherwise returns the tail of files matching
+        ``?node_id=&pid=&filename=&tail=``."""
+        import asyncio
+
+        from ray_tpu.experimental.state import api as state_api
+        node_id = request.query.get("node_id")
+        try:
+            if request.query.get("list"):
+                rows = await asyncio.to_thread(
+                    state_api.list_logs, node_id)
+                return self._json({"result": rows})
+            pid = request.query.get("pid")
+            tail = int(request.query.get("tail", 1000))
+            lines = await asyncio.to_thread(
+                state_api.get_log, request.query.get("filename"),
+                node_id, int(pid) if pid is not None else None, tail)
+            return self._json({"result": lines})
+        except FileNotFoundError as exc:
+            return self._json({"error": str(exc)}, status=404)
+        except ValueError as exc:
+            return self._json({"error": str(exc)}, status=400)
 
     # jobs ---------------------------------------------------------------
 
@@ -283,6 +312,7 @@ class DashboardHead:
         app.router.add_get("/api/v0/tasks/summarize", self._summarize_tasks)
         app.router.add_get("/api/v0/{resource}", self._state)
         app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/api/logs", self._logs)
         app.router.add_get("/api/timeline", self._timeline)
         app.router.add_get("/api/event_stats", self._event_stats)
         app.router.add_get("/api/jobs/", self._jobs_list)

@@ -33,6 +33,7 @@ def list_actors(filters: Optional[List[tuple]] = None,
                 "ALIVE" if state.created.is_set() else "PENDING_CREATION"),
             "name": state.name,
             "namespace": state.namespace,
+            "lifetime": state.lifetime or "non_detached",
             "num_restarts": state.num_restarts,
             "pending_tasks": len(state.unfinished),
         }

@@ -56,6 +56,35 @@ def cmd_list(args) -> int:
     return 0
 
 
+def cmd_actors(args) -> int:
+    """`ray-tpu actors [--detached]` — list actors with lifetime;
+    --detached shows only GCS-owned survivors (the ones an operator
+    must `ray_tpu.kill()` explicitly post-mortem)."""
+    _ensure_init()
+    from ray_tpu.experimental.state import api
+    filters = [("lifetime", "=", "detached")] if args.detached else None
+    rows = api.list_actors(filters=filters)
+    if args.json:
+        print(json.dumps(rows, indent=2, default=str))
+        return 0
+    if not rows:
+        print("no matching actors")
+        return 0
+    hdr = ("ACTOR_ID", "CLASS", "NAME", "NAMESPACE", "LIFETIME",
+           "STATE", "RESTARTS")
+    widths = [max(len(hdr[i]), *(len(str(r[k])) for r in rows))
+              for i, k in enumerate(("actor_id", "class_name", "name",
+                                     "namespace", "lifetime", "state",
+                                     "num_restarts"))]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    print(fmt.format(*hdr))
+    for r in rows:
+        print(fmt.format(r["actor_id"], r["class_name"], r["name"],
+                         r["namespace"], r["lifetime"], r["state"],
+                         r["num_restarts"]))
+    return 0
+
+
 def cmd_summary(args) -> int:
     _ensure_init()
     from ray_tpu.experimental.state import api
@@ -315,6 +344,11 @@ def main(argv=None) -> int:
     p = sub.add_parser("list", help="list cluster state")
     p.add_argument("resource", choices=["actors", "tasks", "objects",
                                         "nodes", "placement-groups"])
+    p = sub.add_parser("actors", help="list actors (lifetime-aware)")
+    p.add_argument("--detached", action="store_true",
+                   help="only GCS-owned detached actors")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
     p = sub.add_parser("summary", help="summarize cluster state")
     p.add_argument("resource", choices=["tasks", "objects"])
     sub.add_parser("metrics", help="print Prometheus metrics")
@@ -410,6 +444,7 @@ def main(argv=None) -> int:
         "memory": cmd_memory,
         "timeline": cmd_timeline,
         "list": cmd_list,
+        "actors": cmd_actors,
         "summary": cmd_summary,
         "metrics": cmd_metrics,
         "devices": cmd_devices,

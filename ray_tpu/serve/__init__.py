@@ -192,14 +192,21 @@ _proxy_port: Optional[int] = None
 
 def start(detached: bool = False, host: str = "127.0.0.1",
           port: int = 8000, **_ignored):
-    """Start the HTTP proxy (reference: serve.start / http_options)."""
+    """Start the HTTP proxy (reference: serve.start / http_options).
+
+    ``detached=True`` gives the proxy actor the GCS-owned detached
+    lifetime: it survives the starting driver's exit (and a head
+    restart with ``gcs_store_path``) and is torn down only by
+    ``ray_tpu.kill(proxy, no_restart=True)``."""
     global _proxy, _proxy_port
     if _proxy is not None:
         return _proxy
     from ray_tpu.serve._private.http_proxy import HTTPProxyActor
     cls = ray_tpu.remote(HTTPProxyActor)
-    _proxy = cls.options(name="_serve_http_proxy",
-                         get_if_exists=True).remote(host, port)
+    opts = {"name": "_serve_http_proxy", "get_if_exists": True}
+    if detached:
+        opts["lifetime"] = "detached"
+    _proxy = cls.options(**opts).remote(host, port)
     _proxy_port = ray_tpu.get(_proxy.ready.remote())
     return _proxy
 

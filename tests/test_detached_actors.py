@@ -1,0 +1,288 @@
+"""Detached actors: GCS-owned lifetime (reference: gcs_actor_manager
+detached actors, OSDI'18 §4.3). A named actor created with
+``lifetime="detached"`` survives its creating driver's orderly exit,
+survives a head restart (``gcs_store_path``), restarts within its
+``max_restarts`` budget after daemon death, and is removed ONLY by
+``ray_tpu.kill(actor, no_restart=True)``. Non-detached named actors are
+reaped on driver exit / client disconnect."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------------
+# Option validation + state API surface
+# ---------------------------------------------------------------------------
+
+
+def test_detached_requires_name(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    with pytest.raises(ValueError, match="name"):
+        A.options(lifetime="detached").remote()
+    with pytest.raises(ValueError, match="lifetime"):
+        A.options(name="x", lifetime="sticky").remote()
+
+
+def test_detached_lifetime_in_state_api_and_kill(ray_start_regular):
+    from ray_tpu.experimental.state import api as state_api
+
+    @ray_tpu.remote
+    class Reg:
+        def ping(self):
+            return "pong"
+
+    plain = Reg.options(name="plain-reg").remote()
+    det = Reg.options(name="det-reg", lifetime="detached").remote()
+    assert ray_tpu.get(det.ping.remote()) == "pong"
+
+    rows = {r["name"]: r for r in state_api.list_actors()}
+    assert rows["det-reg"]["lifetime"] == "detached"
+    assert rows["plain-reg"]["lifetime"] == "non_detached"
+    only_det = state_api.list_actors(
+        filters=[("lifetime", "=", "detached")])
+    assert [r["name"] for r in only_det] == ["det-reg"]
+
+    # kill(no_restart=True) is the removal path: the registry entry
+    # goes away and the name is rebindable.
+    ray_tpu.kill(det, no_restart=True)
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("det-reg")
+    ray_tpu.kill(plain, no_restart=True)
+
+
+def test_cli_actors_detached_filter(ray_start_regular, capsys):
+    from ray_tpu.scripts.cli import main as cli_main
+
+    @ray_tpu.remote
+    class CliActor:
+        def ping(self):
+            return "pong"
+
+    det = CliActor.options(name="cli-det", lifetime="detached").remote()
+    CliActor.options(name="cli-plain").remote()
+    assert ray_tpu.get(det.ping.remote()) == "pong"
+    assert cli_main(["actors", "--detached", "--json"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert [r["name"] for r in rows] == ["cli-det"]
+    ray_tpu.kill(det, no_restart=True)
+
+
+# ---------------------------------------------------------------------------
+# (a) client disconnect: detached survives, non-detached is reaped
+# ---------------------------------------------------------------------------
+
+CLIENT_DRIVER = """
+import ray_tpu
+ray_tpu.init()  # RAY_TPU_HEAD_ADDRESS set -> binds a ClientRuntime
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+    def inc(self):
+        self.n += 1
+        return self.n
+
+det = Counter.options(name="client-det", lifetime="detached").remote()
+plain = Counter.options(name="client-plain").remote()
+assert ray_tpu.get(det.inc.remote()) == 1
+assert ray_tpu.get(det.inc.remote()) == 2
+assert ray_tpu.get(plain.inc.remote()) == 1
+print("CLIENT_READY", flush=True)
+"""
+
+
+def test_detached_survives_client_disconnect(ray_start_regular):
+    port = _free_port()
+    ray_tpu.start_head_server(port=port, host="127.0.0.1")
+    env = dict(os.environ, RAY_TPU_HEAD_ADDRESS=f"127.0.0.1:{port}")
+    client = subprocess.Popen(
+        [sys.executable, "-c", CLIENT_DRIVER], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        out = client.stdout.readline()
+        assert "CLIENT_READY" in out, f"client never came up: {out!r}"
+        client.wait(timeout=30)  # exits -> session drops
+        assert client.returncode == 0
+
+        # The detached actor survived the disconnect, state intact.
+        det = ray_tpu.get_actor("client-det")
+        assert ray_tpu.get(det.inc.remote(), timeout=30) == 3
+
+        # The plain named actor is reaped when its session closes.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                ray_tpu.get_actor("client-plain")
+                time.sleep(0.1)
+            except ValueError:
+                break
+        else:
+            raise AssertionError(
+                "non-detached client actor survived its session")
+        ray_tpu.kill(det, no_restart=True)
+    finally:
+        if client.poll() is None:
+            client.kill()
+        client.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# (b)+(c) chaos: orderly driver exit -> head restart -> daemon death
+# ---------------------------------------------------------------------------
+
+DRIVER1 = """
+import sys, time
+import ray_tpu
+
+path, port = sys.argv[1], int(sys.argv[2])
+ray_tpu.init(num_cpus=2, _system_config={"gcs_store_path": path})
+ray_tpu.start_head_server(port=port, host="127.0.0.1")
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline:
+    if ray_tpu.cluster_resources().get("remote", 0) >= 2:
+        break
+    time.sleep(0.1)
+else:
+    raise TimeoutError("daemon never joined")
+
+@ray_tpu.remote(resources={"remote": 1}, max_restarts=2)
+class Counter:
+    def __init__(self):
+        self.n = 0
+    def inc(self):
+        self.n += 1
+        return self.n
+
+svc = Counter.options(name="svc", lifetime="detached").remote()
+keeper = Counter.options(name="keeper").remote()
+assert ray_tpu.get(svc.inc.remote()) == 1
+assert ray_tpu.get(svc.inc.remote()) == 2
+assert ray_tpu.get(keeper.inc.remote()) == 1
+print("READY", flush=True)
+ray_tpu.shutdown()  # ORDERLY exit: detached survives, keeper dies
+print("SHUTDOWN_OK", flush=True)
+"""
+
+
+def test_detached_survives_driver_exit_head_restart_daemon_death(tmp_path):
+    store = str(tmp_path / "gcs.pkl")
+    port = _free_port()
+
+    driver1 = subprocess.Popen(
+        [sys.executable, "-c", DRIVER1, store, str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    daemon_cmd = [sys.executable, "-m", "ray_tpu._private.multinode",
+                  "--address", f"127.0.0.1:{port}", "--num-cpus", "2",
+                  "--resources", json.dumps({"remote": 2})]
+    daemon = subprocess.Popen(daemon_cmd, stdout=subprocess.DEVNULL,
+                              stderr=subprocess.DEVNULL)
+    daemon2 = None
+    try:
+        line = driver1.stdout.readline()
+        assert "READY" in line, f"driver1 never came up: {line!r}"
+        line = driver1.stdout.readline()
+        assert "SHUTDOWN_OK" in line, f"driver1 shutdown failed: {line!r}"
+        driver1.wait(timeout=15)
+        assert driver1.returncode == 0
+
+        # The daemon hosting the detached actor did NOT get the
+        # shutdown frame: it is alive, in its reconnect window.
+        time.sleep(0.5)
+        assert daemon.poll() is None, \
+            "daemon hosting a detached actor died on ray_tpu.shutdown()"
+
+        # Fresh driver, same store + port: the daemon reconnects and
+        # the head rebinds the detached actor from its GCS record.
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=2, _system_config={"gcs_store_path": store})
+        ray_tpu.start_head_server(port=port, host="127.0.0.1")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("remote", 0) >= 2:
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("daemon never reconnected to new head")
+
+        svc = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                svc = ray_tpu.get_actor("svc")
+                break
+            except ValueError:
+                time.sleep(0.2)
+        assert svc is not None, "detached actor never rebound"
+        # Pre-exit state preserved: the resident instance kept counting.
+        assert ray_tpu.get(svc.inc.remote(), timeout=30) == 3
+
+        # Negative: the non-detached named actor was reaped by the
+        # orderly driver exit — no registry entry, no GCS record.
+        with pytest.raises(ValueError):
+            ray_tpu.get_actor("keeper")
+
+        # The rebound record kept the restart budget: kill the daemon,
+        # add a replacement node, and the actor restarts there.
+        daemon.kill()
+        daemon.wait(timeout=10)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("remote", 0) < 2:
+                break
+            time.sleep(0.2)
+        daemon2 = subprocess.Popen(daemon_cmd, stdout=subprocess.DEVNULL,
+                                   stderr=subprocess.DEVNULL)
+        value = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                value = ray_tpu.get(svc.inc.remote(), timeout=10)
+                break
+            except Exception:
+                time.sleep(0.3)
+        assert value == 1, f"actor never restarted on the new node: {value}"
+
+        from ray_tpu._private.worker import global_worker
+        rt = global_worker.runtime
+        state = rt.actor_state(svc._actor_id)
+        assert state.num_restarts == 1
+        assert state.detached
+
+        # kill(no_restart=True) is the ONLY removal path: registry
+        # entry and persisted record both go away.
+        ray_tpu.kill(svc, no_restart=True)
+        with pytest.raises(ValueError):
+            ray_tpu.get_actor("svc")
+        assert svc._actor_id.hex() not in rt.gcs_store.actors
+    finally:
+        for p in (driver1, daemon, daemon2):
+            if p is not None and p.poll() is None:
+                p.kill()
+        for p in (driver1, daemon, daemon2):
+            if p is not None:
+                p.wait(timeout=10)
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass

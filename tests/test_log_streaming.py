@@ -123,18 +123,21 @@ def test_format_log_batch_prefix():
     assert "\033[31m" in colored[0] and "\033[0m" in colored[0]
 
 
-def test_detached_lifetime_raises(ray_start_regular):
+def test_detached_lifetime_spellings(ray_start_regular):
     @ray_tpu.remote
     class A:
         def ping(self):
             return 1
 
-    with pytest.raises(ValueError,
-                       match="detached actors not yet supported"):
-        A.options(name="nope", lifetime="detached").remote()
-    # The supported spellings still work.
+    with pytest.raises(ValueError, match="lifetime"):
+        A.options(name="nope", lifetime="bogus").remote()
+    # The supported spellings work (detached semantics are covered in
+    # test_detached_actors.py).
     a = A.options(lifetime="non_detached").remote()
     assert ray_tpu.get(a.ping.remote()) == 1
+    d = A.options(name="det-spelling", lifetime="detached").remote()
+    assert ray_tpu.get(d.ping.remote()) == 1
+    ray_tpu.kill(d, no_restart=True)
 
 
 # ---------------------------------------------------------------------------

@@ -40,6 +40,14 @@ def test_dashboard_endpoints(ray_start_regular):
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
             assert resp.status == 200
+        # log files over HTTP: enumerate session captures and tail by
+        # node (empty here — in-process workers write no capture files —
+        # but the endpoint must answer with the right shape).
+        logs = _get_json(port, "/api/logs?list=1")["result"]
+        assert isinstance(logs, list)
+        assert all("filename" in r and "node" in r for r in logs)
+        tail = _get_json(port, "/api/logs?node_id=head&tail=5")["result"]
+        assert isinstance(tail, list)
         # unknown resource → 404
         with pytest.raises(urllib.error.HTTPError):
             _get_json(port, "/api/v0/bogus")
