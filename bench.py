@@ -222,6 +222,54 @@ def bench_log_streaming() -> dict:
     return out
 
 
+def bench_metrics_overhead() -> dict:
+    """Task throughput with metrics export ON (aggressive 0.5s tick so
+    the agent actually works during the probe) vs OFF (interval 0): the
+    core-runtime instrumentation + export pipeline must stay within
+    noise of the uninstrumented path."""
+    import os
+    import time as _time
+
+    import ray_tpu
+
+    def _throughput() -> float:
+        @ray_tpu.remote
+        def tiny(i):
+            return i
+
+        ray_tpu.get([tiny.remote(i) for i in range(200)])  # warmup
+        n = 2000
+        best = 0.0
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            ray_tpu.get([tiny.remote(i) for i in range(n)])
+            best = max(best, n / (_time.perf_counter() - t0))
+        return best
+
+    key = "RAY_TPU_METRICS_EXPORT_INTERVAL_S"
+    prev = os.environ.get(key)
+    try:
+        os.environ[key] = "0.5"
+        ray_tpu.init(num_cpus=8)
+        on = _throughput()
+        ray_tpu.shutdown()
+        os.environ[key] = "0"
+        ray_tpu.init(num_cpus=8)
+        off = _throughput()
+        ray_tpu.shutdown()
+    finally:
+        if prev is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = prev
+    out = {"metrics_on_tasks_per_sec": round(on, 1),
+           "metrics_off_tasks_per_sec": round(off, 1)}
+    # Positive = export costs throughput; best-of-3 noise is a few %.
+    out["metrics_overhead_pct"] = (
+        round(100.0 * (off - on) / off, 2) if off else None)
+    return out
+
+
 def bench_data_shuffle() -> dict:
     """Single-host shuffle throughput (reference:
     release_tests.yaml:3447 shuffle nightly — scaled to one host): a
@@ -1137,6 +1185,8 @@ def main():
         ("detached_restart", "detached_actor_restart_ms",
          bench_detached_restart),
         ("log_stream", "log_lines_per_sec", bench_log_streaming),
+        ("metrics_overhead", "metrics_overhead_pct",
+         bench_metrics_overhead),
     ]
     if on_tpu:
         extras_suite.append(

@@ -1,0 +1,147 @@
+"""Built-in ``ray_tpu_*`` runtime metrics.
+
+Analog of the reference's core-runtime stats (stats/metric_defs.h:
+tasks, scheduler, object store, and worker-pool series every Ray
+process emits). Each accessor lazily (re-)binds the metric through the
+registry so ``ray_tpu.util.metrics.clear_registry()`` in tests cannot
+orphan the instrumentation: the next event simply re-registers.
+
+Counters are incremented at the runtime's choke points (task state
+transitions, spills, restarts, log batches); level-style gauges are
+refreshed by per-agent collector callbacks right before each snapshot
+(``MetricsAgent.add_collector``) so hot paths stay untouched.
+"""
+
+from __future__ import annotations
+
+# ray_tpu.util.metrics is imported inside each accessor: importing it at
+# module scope would execute ray_tpu.util/__init__ (which pulls
+# placement_group -> _private.worker) while _private modules that
+# instrument themselves are still initializing - a circular import.
+
+# -- tasks / scheduler ----------------------------------------------------
+
+
+def tasks_submitted() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter("ray_tpu_tasks_submitted_total",
+                   "Tasks submitted to the runtime.")
+
+
+def tasks_started() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter("ray_tpu_tasks_started_total",
+                   "Tasks that began executing.")
+
+
+def tasks_finished() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter("ray_tpu_tasks_finished_total",
+                   "Tasks that finished successfully.")
+
+
+def tasks_failed() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter("ray_tpu_tasks_failed_total",
+                   "Tasks that failed (after retries).")
+
+
+_TASK_STATUS_COUNTERS = {
+    "SUBMITTED": tasks_submitted,
+    "RUNNING": tasks_started,
+    "FINISHED": tasks_finished,
+    "FAILED": tasks_failed,
+}
+
+
+def record_task_event(status: str) -> None:
+    """Map a task state transition onto its counter (no-op for statuses
+    that are not terminal/throughput signals, e.g. OOM_RETRY)."""
+    accessor = _TASK_STATUS_COUNTERS.get(status)
+    if accessor is not None:
+        accessor().inc()
+
+
+def scheduler_pending_tasks() -> Gauge:
+    from ray_tpu.util.metrics import Gauge
+    return Gauge("ray_tpu_scheduler_pending_tasks",
+                 "Tasks queued waiting for resources or leases.")
+
+
+def alive_nodes() -> Gauge:
+    from ray_tpu.util.metrics import Gauge
+    return Gauge("ray_tpu_alive_nodes", "Nodes currently alive.")
+
+
+def actors_gauge() -> Gauge:
+    from ray_tpu.util.metrics import Gauge
+    return Gauge("ray_tpu_actors", "Live actors registered at the head.")
+
+
+def actor_restarts() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_actor_restarts_total",
+        "Actor restarts, including detached-actor rebinds after a head "
+        "restart.", tag_keys=("kind",))
+
+
+# -- object store ---------------------------------------------------------
+
+
+def object_store_bytes() -> Gauge:
+    from ray_tpu.util.metrics import Gauge
+    return Gauge("ray_tpu_object_store_bytes",
+                 "Bytes resident in the local object store.")
+
+
+def object_spilled_bytes() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter("ray_tpu_object_spilled_bytes_total",
+                   "Bytes spilled from the object store to disk.")
+
+
+def object_store_hits() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter("ray_tpu_object_store_hits_total",
+                   "Object reads served from memory (plasma-analog hit).")
+
+
+def object_store_misses() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_object_store_misses_total",
+        "Object reads that had to restore a spilled payload from disk.")
+
+
+# -- worker pool ----------------------------------------------------------
+
+
+def worker_pool_size() -> Gauge:
+    from ray_tpu.util.metrics import Gauge
+    return Gauge("ray_tpu_worker_pool_size",
+                 "Live worker subprocesses in this process's pool.")
+
+
+def worker_lease_wait() -> Histogram:
+    from ray_tpu.util.metrics import Histogram
+    return Histogram(
+        "ray_tpu_worker_lease_wait_seconds",
+        "Seconds a lease request waited for a worker subprocess.",
+        boundaries=[0.001, 0.01, 0.05, 0.25, 1, 5, 30])
+
+
+# -- log subsystem --------------------------------------------------------
+
+
+def log_lines() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter("ray_tpu_log_monitor_lines_total",
+                   "Log lines published by this node's log monitor.")
+
+
+def log_lines_dropped() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_log_monitor_lines_dropped_total",
+        "Log lines dropped by backpressure (publish returned False).")

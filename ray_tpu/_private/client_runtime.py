@@ -454,6 +454,7 @@ class ClientRuntime:
                 "exists": True, "fn_id": spec.function_id,
                 "name": options.get("name", ""),
                 "namespace": options.get("namespace", "default"),
+                "class_name": (spec.name or "").rsplit(".", 1)[0],
                 "dead": False, "num_restarts": 0,
             }
         return actor_id
@@ -464,6 +465,7 @@ class ClientRuntime:
         info = {"exists": reply["exists"], "fn_id": reply.get("fn_id"),
                 "name": reply.get("name", ""),
                 "namespace": reply.get("namespace", "default"),
+                "class_name": reply.get("class_name", ""),
                 "dead": reply.get("dead", False),
                 "num_restarts": reply.get("num_restarts", 0)}
         if info["exists"]:
@@ -484,6 +486,7 @@ class ClientRuntime:
                 function_id=info["fn_id"], _tpu_ids=None, _node_id=None),
             dead=info["dead"], name=info["name"],
             namespace=info["namespace"],
+            class_name=info.get("class_name", ""),
             num_restarts=info["num_restarts"])
 
     def get_named_actor(self, name: str,
@@ -890,6 +893,7 @@ class ClientSession:
             return {"exists": True,
                     "fn_id": state.creation_spec.function_id,
                     "name": state.name, "namespace": state.namespace,
+                    "class_name": getattr(state, "class_name", ""),
                     "dead": state.dead,
                     "num_restarts": state.num_restarts,
                     "lifetime": state.lifetime}

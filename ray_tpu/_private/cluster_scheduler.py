@@ -479,6 +479,14 @@ class ClusterResourceScheduler:
                 "num_placement_groups": len(self._pgs),
             }
 
+    def record_metrics(self) -> None:
+        """Refresh cluster-level gauges (called by the head's metrics-
+        agent collector before each export snapshot)."""
+        from ray_tpu._private import builtin_metrics
+        with self._lock:
+            alive = len(self._node_order)
+        builtin_metrics.alive_nodes().set(alive)
+
 
 def make_cluster_scheduler(use_native: bool = True):
     """Native C++ engine (src/ray_tpu_native/sched.cc) when it builds;

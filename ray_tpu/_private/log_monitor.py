@@ -31,6 +31,7 @@ import os
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
+from ray_tpu._private import builtin_metrics
 from ray_tpu._private.ray_logging import TASK_MARKER
 
 logger = logging.getLogger(__name__)
@@ -153,6 +154,7 @@ class LogMonitor:
             out.append(line)
         out.extend(self._drain_repeat(st))
         total = 0
+        dropped = 0
         for i in range(0, len(out), MAX_LINES_PER_BATCH):
             batch = {"pid": st.pid, "proc_name": st.proc_name,
                      "source": st.source, "task_name": st.task_name,
@@ -160,8 +162,15 @@ class LogMonitor:
             try:
                 if self._publish(batch):
                     total += len(batch["lines"])
+                else:
+                    dropped += len(batch["lines"])
             except Exception:  # noqa: BLE001 - drop batch, keep tailing
+                dropped += len(batch["lines"])
                 logger.exception("log publish failed")
+        if total:
+            builtin_metrics.log_lines().inc(total)
+        if dropped:
+            builtin_metrics.log_lines_dropped().inc(dropped)
         return total
 
     def _drain_repeat(self, st: _TailState) -> List[str]:

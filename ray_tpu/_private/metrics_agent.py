@@ -1,0 +1,271 @@
+"""Cluster metrics export: per-process agent + head-side cluster registry.
+
+Analog of the reference's per-node metrics agent (dashboard/agent.py +
+stats/metric_exporter.cc): every Ray process pushes its OpenCensus view
+deltas to a local agent and Prometheus scrapes one endpoint per node
+with ``Node``/``Component`` tags. Here the topology is simpler — one
+scrape for the whole cluster:
+
+* :class:`MetricsAgent` runs in every worker and daemon (and the head
+  driver). On an interval (``RAY_TPU_METRICS_EXPORT_INTERVAL_S``,
+  default 5s, ``<= 0`` disables) it snapshots the process-local registry
+  (``util/metrics.py``), diffs against the previous snapshot, drains
+  finished tracing spans, and hands the batch to a ``publish`` callback:
+  daemons ship ``metrics_batch`` wire frames over the coalescing reply
+  sender (the log subsystem's channel), workers buffer batches that
+  piggyback on task replies, and the head publishes straight into its
+  :class:`ClusterMetrics`.
+* :class:`ClusterMetrics` (head only) merges batches per origin
+  ``(node_id, pid, component)`` — values are cumulative, so merge is
+  overwrite — and renders the cluster-wide Prometheus exposition with
+  ``node_id``/``pid``/``component`` labels. Origins of a dead node are
+  evicted once the staleness window passes
+  (``RAY_TPU_METRICS_STALENESS_S``, default 30s).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_INTERVAL_S = 5.0
+DEFAULT_STALENESS_S = 30.0
+#: Every Nth tick ships the full snapshot instead of a diff, healing any
+#: batch a dying connection dropped (frames are best-effort).
+FULL_REFRESH_TICKS = 12
+#: Retained remote spans (matches util/tracing._MAX_SPANS).
+MAX_CLUSTER_SPANS = 100_000
+
+
+def export_interval_s() -> float:
+    """The agent tick interval; ``<= 0`` disables export entirely."""
+    raw = os.environ.get("RAY_TPU_METRICS_EXPORT_INTERVAL_S", "")
+    if not raw:
+        return DEFAULT_INTERVAL_S
+    try:
+        return float(raw)
+    except ValueError:
+        return DEFAULT_INTERVAL_S
+
+
+def staleness_s() -> float:
+    raw = os.environ.get("RAY_TPU_METRICS_STALENESS_S", "")
+    try:
+        return float(raw) if raw else DEFAULT_STALENESS_S
+    except ValueError:
+        return DEFAULT_STALENESS_S
+
+
+class MetricsAgent:
+    """Interval snapshot/diff/publish loop for one process's registry.
+
+    ``publish(batch: dict) -> bool`` receives ``{"pid", "component",
+    "metrics", "spans"}`` (no ``type``/``node_id`` — the transport stamps
+    those) and returns False when the batch was dropped; the agent then
+    resends the full snapshot on the next tick so the head re-converges.
+    ``start=False`` leaves polling to the caller (tests, and the worker
+    loop which flushes on every task reply).
+    """
+
+    def __init__(self, publish: Callable[[dict], bool], *,
+                 component: str, interval_s: Optional[float] = None,
+                 start: bool = True):
+        self._publish = publish
+        self.component = component
+        self.pid = os.getpid()
+        self.interval_s = (export_interval_s() if interval_s is None
+                           else interval_s)
+        self._collectors: List[Callable[[], None]] = []
+        self._prev: Optional[List[Dict[str, Any]]] = None
+        self._span_cursor = 0
+        self._ticks = 0
+        self._force_full = False
+        self._poll_lock = threading.Lock()
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start and self.interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"ray_tpu-metrics-agent-{component}")
+            self._thread.start()
+
+    @property
+    def enabled(self) -> bool:
+        return self.interval_s > 0
+
+    def add_collector(self, fn: Callable[[], None]) -> None:
+        """Register a callback run right before each snapshot — the place
+        level-style gauges (queue depth, pool size, store bytes) are
+        refreshed without touching any hot path."""
+        self._collectors.append(fn)
+
+    def _loop(self) -> None:
+        while not self._stop_event.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 - export must never kill host
+                logger.exception("metrics agent poll failed")
+
+    def poll_once(self, force_full: bool = False) -> bool:
+        """One snapshot/diff/publish cycle. Returns True when a non-empty
+        batch was handed to (and accepted by) the publish callback."""
+        with self._poll_lock:
+            for fn in self._collectors:
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 - a bad gauge is not fatal
+                    logger.exception("metrics collector failed")
+            cur = _metrics.snapshot()
+            full = (force_full or self._force_full or self._prev is None
+                    or self._ticks % FULL_REFRESH_TICKS == 0)
+            batch_metrics = cur if full else _metrics.diff_snapshot(
+                self._prev, cur)
+            self._ticks += 1
+            self._prev = cur
+            spans, self._span_cursor = _tracing.drain_finished_spans(
+                self._span_cursor)
+            if not batch_metrics and not spans:
+                return False
+            batch = {"pid": self.pid, "component": self.component,
+                     "metrics": batch_metrics, "spans": spans}
+            sent = bool(self._publish(batch))
+            # A dropped frame means the head may now hold stale series:
+            # resend everything once the channel recovers.
+            self._force_full = not sent
+            return sent
+
+    def stop(self, drain: bool = True) -> None:
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if drain:
+            try:
+                self.poll_once(force_full=True)
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
+
+
+class _Origin:
+    __slots__ = ("entries", "last_seen", "dead_at")
+
+    def __init__(self):
+        self.entries: Dict[str, Dict[str, Any]] = {}
+        self.last_seen = time.monotonic()
+        self.dead_at: Optional[float] = None
+
+
+class ClusterMetrics:
+    """Head-side cluster registry: merged per-origin snapshots + spans."""
+
+    def __init__(self, staleness: Optional[float] = None):
+        self._lock = threading.Lock()
+        self._origins: Dict[Tuple[str, int, str], _Origin] = {}
+        self._spans: deque = deque(maxlen=MAX_CLUSTER_SPANS)
+        self.staleness = staleness_s() if staleness is None else staleness
+
+    def update(self, node_id: str, batch: Dict[str, Any]) -> None:
+        """Merge one ``metrics_batch`` payload. Cumulative values make the
+        merge an overwrite per (metric, series key)."""
+        key = (node_id or "", int(batch.get("pid", 0)),
+               str(batch.get("component", "")))
+        with self._lock:
+            origin = self._origins.get(key)
+            if origin is None:
+                origin = self._origins[key] = _Origin()
+            origin.last_seen = time.monotonic()
+            origin.dead_at = None  # a publishing origin is alive
+            for entry in batch.get("metrics", ()):
+                name = entry.get("name")
+                if not name:
+                    continue
+                held = origin.entries.get(name)
+                if held is None or held.get("type") != entry.get("type"):
+                    held = origin.entries[name] = {
+                        "name": name, "type": entry.get("type"),
+                        "desc": entry.get("desc", ""),
+                        "tag_keys": tuple(entry.get("tag_keys") or ()),
+                        "series": {},
+                    }
+                    if entry.get("type") == "histogram":
+                        held["boundaries"] = tuple(
+                            entry.get("boundaries") or ())
+                        held["buckets"] = {}
+                        held["sums"] = {}
+                        held["counts"] = {}
+                held["series"].update(entry.get("series", {}))
+                if entry.get("type") == "histogram":
+                    for field in ("buckets", "sums", "counts"):
+                        held[field].update(entry.get(field, {}))
+        for span in batch.get("spans", ()):
+            stamped = dict(span)
+            stamped["node_id"] = node_id or ""
+            stamped["pid"] = batch.get("pid", 0)
+            stamped["component"] = batch.get("component", "")
+            self._spans.append(stamped)
+
+    def mark_node_dead(self, node_id: str) -> None:
+        """Start the staleness clock for every origin of a dead node; the
+        series stay scrapeable through the window (Prometheus gets a last
+        look) and are evicted after it."""
+        now = time.monotonic()
+        with self._lock:
+            for (nid, _pid, _comp), origin in self._origins.items():
+                if nid == node_id and origin.dead_at is None:
+                    origin.dead_at = now
+
+    def evict_stale(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            dead = [key for key, origin in self._origins.items()
+                    if origin.dead_at is not None
+                    and now - origin.dead_at > self.staleness]
+            for key in dead:
+                del self._origins[key]
+
+    def origins(self) -> List[Tuple[str, int, str]]:
+        with self._lock:
+            return list(self._origins)
+
+    def render(self) -> str:
+        """The cluster-wide Prometheus exposition: every origin's series
+        with node_id/pid/component labels appended."""
+        self.evict_stale()
+        groups = []
+        with self._lock:
+            for (node_id, pid, component), origin in self._origins.items():
+                extra = {"node_id": node_id, "pid": str(pid),
+                         "component": component}
+                for entry in origin.entries.values():
+                    groups.append((entry, extra))
+        return _metrics.render_exposition(groups)
+
+    def chrome_spans(self) -> List[Dict[str, Any]]:
+        """Remote spans as chrome://tracing complete events (merged into
+        /api/timeline next to the head's task events)."""
+        out = []
+        for s in list(self._spans):
+            end = s.get("end_time") or s.get("start_time", 0.0)
+            out.append({
+                "name": s.get("name", ""),
+                "cat": "remote_trace",
+                "ph": "X",
+                "ts": s.get("start_time", 0.0) * 1e6,
+                "dur": max(0.0, (end - s.get("start_time", 0.0))) * 1e6,
+                "pid": f"node:{(s.get('node_id') or 'head')[:12]}"
+                       f"/{s.get('component', '')}-{s.get('pid', 0)}",
+                "tid": s.get("span_id", ""),
+                "args": dict(s.get("attributes") or {},
+                             trace_id=s.get("trace_id", ""),
+                             parent_id=s.get("parent_id")),
+            })
+        return out

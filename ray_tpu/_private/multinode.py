@@ -328,9 +328,10 @@ class NodeConnection:
         self._shipped_functions: set = set()
         self.node_id = None  # set at registration
         self._on_death = None
-        # Runtime hook for daemon-pushed log_batch frames (no req_id —
-        # the recv loop routes them here instead of the pending table).
+        # Runtime hooks for daemon-pushed frames (no req_id — the recv
+        # loop routes them here instead of the pending table).
         self.on_log_batch = None
+        self.on_metrics_batch = None
         # Dedicated liveness socket (see HeadServer._health_check_loop):
         # pings must not share the data channel — large frames or a full
         # send buffer would stall them and fake a death (or hide one).
@@ -435,16 +436,19 @@ class NodeConnection:
             while True:
                 replies = _decode_frames(_recv_frame(self._sock))
                 for reply in replies:
-                    if reply.get("type") == "log_batch":
+                    kind = reply.get("type")
+                    if kind in ("log_batch", "metrics_batch"):
                         # Daemon-initiated push, not a reply: hand to
-                        # the runtime's log fan-out and move on.
-                        handler = self.on_log_batch
+                        # the runtime's fan-out and move on.
+                        handler = (self.on_log_batch
+                                   if kind == "log_batch"
+                                   else self.on_metrics_batch)
                         if handler is not None:
                             try:
                                 handler(self, reply)
                             except Exception:  # noqa: BLE001
-                                logger.exception("log_batch handling "
-                                                 "failed")
+                                logger.exception("%s handling failed",
+                                                 kind)
                         del reply
                         continue
                     with self._lock:
@@ -1528,6 +1532,10 @@ class NodeDaemon:
         # capture files — its own raylet streams + spawned workers —
         # and ships batches head-ward.
         self._log_monitor = None
+        # Interval exporter for this daemon's metric registry (plus the
+        # batches its leased workers piggyback on task replies); ships
+        # metrics_batch frames through the session's reply sender.
+        self._metrics_agent = None
         self._object_server_host: Optional[str] = None
         # Resource-usage sync (reference: common/ray_syncer): changed
         # component snapshots piggyback on health-channel pongs; the
@@ -1759,6 +1767,9 @@ class NodeDaemon:
                     head_address=self.head_address,
                     node_id_hex=self.node_id_hex,
                     object_addr=object_addr)
+                # Worker metric batches hop worker -> this daemon ->
+                # head, keeping the worker's own pid/component labels.
+                self._pool.metrics_sink = self._publish_metrics_batch
             return self._pool
 
     def _task_uses_worker_process(self, msg: dict) -> bool:
@@ -2285,6 +2296,8 @@ class NodeDaemon:
     def _teardown(self) -> None:
         if self._log_monitor is not None:
             self._log_monitor.stop()
+        if self._metrics_agent is not None:
+            self._metrics_agent.stop()
         if self._object_server is not None:
             self._object_server.close()
         if self._pool is not None:
@@ -2342,6 +2355,12 @@ class NodeDaemon:
         session_id = ack.get("session_id")
         if session_id and self._log_monitor is None:
             self._start_log_streaming(session_id)
+        if self._metrics_agent is None:
+            from ray_tpu._private.metrics_agent import MetricsAgent
+            agent = MetricsAgent(self._publish_metrics_batch,
+                                 component="daemon")
+            agent.add_collector(self._collect_daemon_metrics)
+            self._metrics_agent = agent
         if self._use_worker_processes and not self._prestarted:
             # Warm the worker pool once per daemon (reference:
             # worker_pool.h PrestartWorkers): leases then pin an
@@ -2443,6 +2462,29 @@ class NodeDaemon:
         msg["type"] = "log_batch"
         msg["node_id"] = self.node_id_hex or ""
         return bool(sender.send(msg))
+
+    def _publish_metrics_batch(self, batch: dict) -> bool:
+        """Ship one metrics batch (the daemon's own registry snapshot,
+        or a worker's piggybacked batch) through the session's reply
+        sender. Returning False (no live head session) makes the agent
+        resend a full snapshot once the channel recovers."""
+        sock = self._sock
+        sender = self._reply_senders.get(sock) if sock is not None \
+            else None
+        if sender is None:
+            return False
+        msg = dict(batch)
+        msg["type"] = "metrics_batch"
+        msg["node_id"] = self.node_id_hex or ""
+        return bool(sender.send(msg))
+
+    def _collect_daemon_metrics(self) -> None:
+        """Refresh daemon-side gauges before each export snapshot."""
+        pool = self._pool
+        if pool is not None:
+            record = getattr(pool, "record_metrics", None)
+            if record is not None:
+                record()
 
     def _route_frame(self, msg: dict) -> bool:
         """Route one inbound control message (recv-loop thread only).

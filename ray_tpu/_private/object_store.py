@@ -27,6 +27,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
+from ray_tpu._private import builtin_metrics
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import GetTimeoutError, ObjectFreedError, ObjectLostError
 
@@ -251,6 +252,8 @@ class ObjectStore:
                 self._total_bytes -= victim.size_bytes
                 self._spilled_bytes += victim.size_bytes
                 self._spill_count += 1
+                spilled_now = victim.size_bytes
+            builtin_metrics.object_spilled_bytes().inc(spilled_now)
 
     def _restore(self, entry: _Entry, object_id: ObjectID) -> Any:
         """Load a spilled value back (reference: spilled-object restore)."""
@@ -402,6 +405,10 @@ class ObjectStore:
             needs_restore = (entry.spilled_path is not None
                              and value is None)
         if needs_restore:
+            builtin_metrics.object_store_misses().inc()
+        else:
+            builtin_metrics.object_store_hits().inc()
+        if needs_restore:
             value = self._restore(entry, object_id)
             if value is None:
                 raise ObjectFreedError(
@@ -542,3 +549,9 @@ class ObjectStore:
                 "num_sealed": sealed,
                 "total_serialized_bytes": self._total_bytes,
             }
+
+    def record_metrics(self) -> None:
+        """Refresh the resident-bytes gauge (metrics-agent collector)."""
+        with self._lock:
+            resident = self._total_bytes
+        builtin_metrics.object_store_bytes().set(resident)

@@ -293,6 +293,10 @@ class ActorState:
                  lifetime: Optional[str] = None):
         self.actor_id = actor_id
         self.creation_spec = creation_spec
+        # Human-readable class name ("Cls" from the creation task's
+        # "Cls.__init__") — travels over the actor_info client op so
+        # client-session handles can name tasks without loading the class.
+        self.class_name = (creation_spec.name or "").rsplit(".", 1)[0]
         self.max_restarts = max_restarts
         self.num_restarts = 0
         self.max_concurrency = max_concurrency
@@ -564,6 +568,17 @@ class Runtime:
             if log_to_driver:
                 self._log_printer = ray_logging.DriverLogPrinter(
                     self.pubsub)
+        # Cluster metrics pipeline (reference: dashboard/agent.py + the
+        # core's metric_exporter, collapsed to ONE scrape): the head
+        # holds the cluster registry; its own agent publishes this
+        # process's series straight into it, daemons and workers arrive
+        # as metrics_batch frames / reply piggybacks.
+        from ray_tpu._private.metrics_agent import (ClusterMetrics,
+                                                    MetricsAgent)
+        self._cluster_metrics = ClusterMetrics()
+        self._metrics_agent = MetricsAgent(
+            self._publish_head_metrics, component="driver")
+        self._metrics_agent.add_collector(self._collect_head_metrics)
 
     # ------------------------------------------------------------------
     # Object API
@@ -2318,6 +2333,8 @@ class Runtime:
         in-flight tasks, and re-run the creation task on a fresh executor
         (reference: max_restarts semantics, gcs_actor_manager.h:88 — state is
         lost unless the actor checkpoints itself)."""
+        from ray_tpu._private import builtin_metrics
+        builtin_metrics.actor_restarts().inc(tags={"kind": "restart"})
         cause = ActorDiedError(
             state.actor_id,
             f"Actor {state.actor_id} is restarting; in-flight tasks failed.")
@@ -2582,6 +2599,61 @@ class Runtime:
         batch["node"] = node
         self.pubsub.publish("logs", "", json.dumps(batch))
 
+    # ------------------------------------------------------------------
+    # Cluster metrics (one Prometheus scrape for the whole cluster)
+    # ------------------------------------------------------------------
+
+    def _publish_head_metrics(self, batch: dict) -> bool:
+        """Sink for this process's own metrics agent AND for batches its
+        pool workers piggyback on task replies: merge locally under the
+        head's node id."""
+        self._cluster_metrics.update(self.head_node_id.hex(), batch)
+        return True
+
+    def _metrics_batch_from_node(self, conn, msg: dict) -> None:
+        """Wire sink for daemon-pushed metrics_batch frames (assigned to
+        conn.on_metrics_batch at registration; recv-thread — merge is a
+        dict update, no blocking work)."""
+        node = msg.get("node_id") or ""
+        if not node and conn.node_id is not None:
+            node = conn.node_id.hex()
+        self._cluster_metrics.update(node, msg)
+
+    def _collect_head_metrics(self) -> None:
+        """Refresh head-side gauges right before each export snapshot —
+        level-style series (queue depth, store bytes, pool size, actor
+        count) cost nothing on the hot paths this way."""
+        from ray_tpu._private import builtin_metrics, scheduler as _sched
+        with self._lock:
+            pending = sum(1 for _ in self._ready_specs_locked())
+            actors = sum(1 for a in self._actors.values() if not a.dead)
+        _sched.record_queue_depth(pending)
+        builtin_metrics.actors_gauge().set(actors)
+        record = getattr(self.scheduler, "record_metrics", None)
+        if record is not None:  # native scheduler variant may lack it
+            record()
+        self.store.record_metrics()
+        pool = self._process_pool
+        if pool is not None:
+            pool.record_metrics()
+
+    def cluster_metrics_text(self) -> str:
+        """The cluster-wide Prometheus exposition: a fresh head snapshot
+        merged with the latest daemon/worker batches (remote origins are
+        as fresh as their export interval)."""
+        agent = self._metrics_agent
+        if agent is not None:  # None after shutdown(): render what's held
+            try:
+                agent.poll_once()
+            except Exception:  # noqa: BLE001 - scrape must not fail on this
+                logger.exception("head metrics poll failed")
+        return self._cluster_metrics.render()
+
+    def cluster_chrome_spans(self) -> List[dict]:
+        """Remote worker/daemon spans (shipped in metrics_batch frames)
+        as chrome://tracing events for /api/timeline."""
+        return self._cluster_metrics.chrome_spans()
+
     def register_remote_node(self, conn, info: Optional[dict] = None,
                              dispatch: bool = True,
                              node_id: Optional["NodeID"] = None) -> NodeID:
@@ -2591,8 +2663,10 @@ class Runtime:
         node_id = self.scheduler.add_node(dict(conn.resources),
                                           labels=conn.labels,
                                           node_id=node_id)
-        # Daemon-pushed log batches flow into the driver fan-out.
+        # Daemon-pushed log/metrics batches flow into the driver fan-out
+        # and the cluster metrics registry.
         conn.on_log_batch = self._log_batch_from_node
+        conn.on_metrics_batch = self._metrics_batch_from_node
         with self._lock:
             self._remote_nodes[node_id] = conn
         # A daemon reconnecting to a RESTARTED head announces the actor
@@ -2726,6 +2800,10 @@ class Runtime:
         # any new scheduling).
         if resources:
             self.scheduler.force_acquire(resources, node_id)
+        from ray_tpu._private import builtin_metrics
+        builtin_metrics.actor_restarts().inc(tags={
+            "kind": ("detached_rebind"
+                     if rec.get("lifetime") == "detached" else "rebind")})
         logger.info("Rebound daemon-resident actor %s (%s) after head "
                     "restart", rec["name"] or actor_hex[:12],
                     actor_hex[:12])
@@ -2733,6 +2811,9 @@ class Runtime:
     def unregister_remote_node(self, node_id: NodeID) -> None:
         with self._lock:
             self._remote_nodes.pop(node_id, None)
+        # Start the staleness clock on the node's series: Prometheus
+        # gets a last look, then they fall out of the exposition.
+        self._cluster_metrics.mark_node_dead(node_id.hex())
         self.remove_node(node_id)
 
     def _remote_conn(self, spec: TaskSpec):
@@ -2796,6 +2877,10 @@ class Runtime:
                 self._process_pool = WorkerProcessPool(
                     store_name=native.name if native is not None else None,
                     head_address=head_addr)
+                # Batches head-pool workers piggyback on task replies
+                # merge straight into the cluster registry (the workers
+                # run on the head node).
+                self._process_pool.metrics_sink = self._publish_head_metrics
             return self._process_pool
 
     def _use_process_worker(self, spec: TaskSpec) -> bool:
@@ -3146,6 +3231,9 @@ class Runtime:
 
     def _record_event(self, spec: TaskSpec, status: str) -> None:
         import time as _time
+
+        from ray_tpu._private import builtin_metrics
+        builtin_metrics.record_task_event(status)
         if len(self._task_events) < self._cfg_max_task_events:
             self._task_events.append({
                 "task_id": spec.task_id.hex(),
@@ -3183,6 +3271,10 @@ class Runtime:
         # in this process don't write into a dead session's directory
         # (the files themselves stay for `ray-tpu logs`).
         from ray_tpu._private import ray_logging
+        if self._metrics_agent is not None:
+            # No drain: the only sink is this runtime's own registry.
+            self._metrics_agent.stop(drain=False)
+            self._metrics_agent = None
         if self._log_monitor is not None:
             self._log_monitor.stop()
             self._log_monitor = None

@@ -20,6 +20,15 @@ from ray_tpu.exceptions import PlacementGroupError
 _EPS = 1e-9
 
 
+def record_queue_depth(pending: int) -> None:
+    """Refresh the ``ray_tpu_scheduler_pending_tasks`` gauge. The ready
+    queues live with the runtime's dispatch loop, but the gauge belongs
+    to the scheduler it describes; the runtime's metrics-agent collector
+    calls this right before each export snapshot."""
+    from ray_tpu._private import builtin_metrics
+    builtin_metrics.scheduler_pending_tasks().set(pending)
+
+
 def _fits(avail: Dict[str, float], need: Dict[str, float]) -> bool:
     return all(avail.get(k, 0.0) + _EPS >= v for k, v in need.items())
 

@@ -39,6 +39,14 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
                 "tid": ev["task_id"][:8],
                 "args": {"status": ev["status"]},
             })
+    # Cross-process spans: the head's own tracing buffer plus worker/
+    # daemon spans shipped over the metrics pipeline — one timeline for
+    # the whole cluster.
+    from ray_tpu.util import tracing as _tracing
+    trace.extend(_tracing.export_chrome_trace())
+    spans_fn = getattr(rt, "cluster_chrome_spans", None)
+    if spans_fn is not None:
+        trace.extend(spans_fn())
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -29,7 +29,9 @@ from typing import Any, Dict, Tuple
 #: 0x80 cloudpickle envelope).
 #: v4: log_batch frames (daemon -> head log streaming) — a v3 head
 #: would reject the unknown type in validate_message.
-PROTOCOL_VERSION = 4
+#: v5: metrics_batch frames (worker/daemon -> head metrics + span
+#: export) — a v4 head would reject the unknown type.
+PROTOCOL_VERSION = 5
 
 
 class WireSchemaError(ValueError):
@@ -137,6 +139,18 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
         "source": (_STR, True),
         "task_name": (_OPT_STR, False),
         "lines": (_LIST, True),
+    },
+    # -- metrics export (daemon -> head, v5) ---------------------------
+    # One process's registry snapshot diff (util/metrics.py snapshot
+    # entries — cumulative values, merged by overwrite at the head) plus
+    # any tracing spans that ended since the last frame. node_id is
+    # stamped by the daemon; component tells head/daemon/worker apart.
+    "metrics_batch": {
+        "node_id": (_STR, False),
+        "pid": (_INT, True),
+        "component": (_STR, True),
+        "metrics": (_LIST, True),
+        "spans": (_LIST, False),
     },
     # -- liveness ------------------------------------------------------
     "ping": {"cluster_digest": ((dict, type(None)), False)},

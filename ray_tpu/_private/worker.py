@@ -270,7 +270,8 @@ def get_actor(name: str, namespace: Optional[str] = None):
         # from a client runtime): the handle still works — methods bind by
         # name, the class is only cosmetic here.
         cls = None
-    return ActorHandle(actor_id, cls, name=name)
+    return ActorHandle(actor_id, cls, name=name,
+                       class_name=getattr(state, "class_name", ""))
 
 
 def cluster_resources() -> Dict[str, float]:

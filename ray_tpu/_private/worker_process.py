@@ -38,11 +38,12 @@ import subprocess
 import sys
 import tempfile
 import threading
+import time
 import traceback
 import uuid
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
-from ray_tpu._private import procinfo, ray_logging
+from ray_tpu._private import builtin_metrics, procinfo, ray_logging
 
 logger = logging.getLogger(__name__)
 
@@ -135,6 +136,10 @@ class WorkerHandle:
         self.actor_id: Optional[str] = None  # dedicated actor worker
         self.current_task: Optional[Any] = None  # task_id while executing
         self.shipped: set = set()  # fn_ids this worker has cached
+        # Workers can't push unsolicited frames (strict request/reply),
+        # so their metrics agent buffers batches that piggyback on task
+        # replies; the pool points this at the host's forwarder.
+        self.metrics_sink: Optional[Callable[[dict], Any]] = None
         self._lock = threading.Lock()
 
     def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
@@ -160,6 +165,15 @@ class WorkerHandle:
                     self.sock.settimeout(None)
                 except OSError:
                     pass
+        if isinstance(reply, dict):
+            batches = reply.pop("metrics_batch", None)
+            sink = self.metrics_sink
+            if batches and sink is not None:
+                for batch in batches:
+                    try:
+                        sink(batch)
+                    except Exception:  # noqa: BLE001 - metrics never fail a task
+                        logger.exception("worker metrics forward failed")
         return reply
 
     def kill(self, wait: bool = True) -> None:
@@ -430,6 +444,11 @@ class WorkerProcessPool:
         self._lock = threading.Lock()
         self._available = threading.Condition(self._lock)
         self._closed = False
+        # Forwarder handed to every leased worker: batches the workers
+        # piggyback on task replies flow through here to the head's
+        # cluster registry (directly on the head; via metrics_batch
+        # frames from a daemon).
+        self.metrics_sink: Optional[Callable[[dict], Any]] = None
         # ALL spawns go through this single long-lived thread:
         # PR_SET_PDEATHSIG binds to the spawning THREAD, so a worker
         # forked from an ephemeral handler thread is SIGKILLed the
@@ -452,6 +471,7 @@ class WorkerProcessPool:
         key = python_exe or ""
         if container:
             key += f"|container:{container.get('image')}"
+        lease_start = time.monotonic()
         while True:
             evict = None
             with self._lock:
@@ -460,7 +480,7 @@ class WorkerProcessPool:
                     while idle:
                         w = idle.pop()
                         if not w.dead and w.proc.poll() is None:
-                            return w
+                            return self._leased(w, lease_start)
                         # Died while parked: without this, it counts
                         # toward max_workers forever (capacity leak).
                         w.dead = True
@@ -499,9 +519,21 @@ class WorkerProcessPool:
                     pass  # fall through; stop below
                 else:
                     self._all.append(w)
-                    return w
+                    return self._leased(w, lease_start)
             w.stop()
             raise WorkerCrashedError("worker pool is shut down")
+
+    def _leased(self, w: WorkerHandle, lease_start: float) -> WorkerHandle:
+        w.metrics_sink = self.metrics_sink
+        builtin_metrics.worker_lease_wait().observe(
+            time.monotonic() - lease_start)
+        return w
+
+    def record_metrics(self) -> None:
+        """Refresh the pool-size gauge (metrics-agent collector)."""
+        with self._lock:
+            alive = len([w for w in self._all if not w.dead])
+        builtin_metrics.worker_pool_size().set(alive)
 
     def prestart(self, n: int) -> None:
         """Spawn up to ``n`` base-interpreter workers into the idle pool
@@ -619,6 +651,37 @@ class _WorkerMain:
         self._arena_tried = False
         self._functions: Dict[bytes, Any] = {}
         self._actor = None  # dedicated actor instance
+        # Metrics export rides task replies (workers cannot push
+        # unsolicited frames): the agent runs with no thread, serve()
+        # polls it at most once per interval and attaches buffered
+        # batches to the next reply; the parent forwards them head-ward.
+        from ray_tpu._private.metrics_agent import MetricsAgent
+        self._metrics_buffer: list = []
+        self._metrics_agent = MetricsAgent(
+            self._buffer_metrics_batch, component="worker", start=False)
+        self._last_metrics_poll = 0.0
+
+    def _buffer_metrics_batch(self, batch: dict) -> bool:
+        self._metrics_buffer.append(batch)
+        # Bounded: an idle stretch can't pile up batches (the periodic
+        # full refresh re-converges the head after any drop).
+        del self._metrics_buffer[:-8]
+        return True
+
+    def _attach_metrics(self, reply: dict) -> None:
+        agent = self._metrics_agent
+        if not agent.enabled:
+            return
+        now = time.monotonic()
+        if now - self._last_metrics_poll >= agent.interval_s:
+            self._last_metrics_poll = now
+            try:
+                agent.poll_once()
+            except Exception:  # noqa: BLE001 - metrics never fail a task
+                logger.exception("worker metrics poll failed")
+        if self._metrics_buffer:
+            reply["metrics_batch"] = self._metrics_buffer[:]
+            del self._metrics_buffer[:]
 
     def _get_arena(self):
         if not self._arena_tried:
@@ -803,7 +866,9 @@ class _WorkerMain:
             if kind == "exit":
                 return
             if kind == "ping":
-                _send_frame(self.sock, _dumps({"ok": True, "pid": os.getpid()}))
+                reply = {"ok": True, "pid": os.getpid()}
+                self._attach_metrics(reply)
+                _send_frame(self.sock, _dumps(reply))
                 continue
             try:
                 value = self._exec(msg)
@@ -816,6 +881,7 @@ class _WorkerMain:
                         f"{type(exc).__name__}: {exc}"),
                         traceback.format_exc()))
                 reply = {"ok": False, "error": payload}
+            self._attach_metrics(reply)
             try:
                 _send_frame(self.sock, _dumps(reply))
             except (OSError, ConnectionError):

@@ -47,12 +47,17 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, cls: Optional[type] = None,
-                 name: str = ""):
+                 name: str = "", class_name: str = ""):
         import itertools
         import uuid
         self._actor_id = actor_id
         self._cls = cls
         self._name = name
+        # Task-naming fallback when the class itself isn't importable
+        # (client sessions rebind handles by id; the actor_info op streams
+        # the class name so tasks still read "Cls.method", not
+        # "Actor.method").
+        self._class_name = class_name or (cls.__name__ if cls else "")
         # Per-handle ordering state (each handle instance gets its own
         # sequence, matching the reference's per-handle call ordering).
         # itertools.count.__next__ is atomic, so concurrent .remote() calls
@@ -87,8 +92,7 @@ class ActorHandle:
             kwargs=dict(kwargs),
             resources={},
             num_returns=num_returns,
-            name=f"{(self._cls.__name__ if self._cls else 'Actor')}."
-                 f"{method_name}",
+            name=f"{self._class_name or 'Actor'}.{method_name}",
             max_retries=0,
             actor_id=self._actor_id,
             method_name=method_name,
@@ -107,7 +111,7 @@ class ActorHandle:
         return (_rebind_actor_handle, (self._actor_id, self._name))
 
     def __repr__(self):
-        cls_name = self._cls.__name__ if self._cls else "Actor"
+        cls_name = self._class_name or "Actor"
         return f"ActorHandle({cls_name}, {self._actor_id.hex()})"
 
     def _ray_kill(self, no_restart: bool = True):
@@ -118,12 +122,14 @@ def _rebind_actor_handle(actor_id: ActorID, name: str) -> ActorHandle:
     runtime = global_worker.runtime
     state = runtime.actor_state(actor_id)
     cls = None
+    class_name = ""
     if state is not None:
         try:
             cls = runtime.functions.load(state.creation_spec.function_id)
         except KeyError:
             cls = None
-    return ActorHandle(actor_id, cls, name)
+        class_name = getattr(state, "class_name", "")
+    return ActorHandle(actor_id, cls, name, class_name=class_name)
 
 
 class ActorClass:

@@ -11,6 +11,7 @@ by a default panel get an auto-generated one, so custom
 
 from __future__ import annotations
 
+import re
 from typing import Any, Dict, List, Optional
 
 # (title, promql expr, unit) — the curated core panels (reference:
@@ -21,10 +22,22 @@ _DEFAULT_PANELS = [
     ("Tasks failed / s", "rate(ray_tpu_tasks_failed_total[1m])", "ops"),
     ("Scheduler queue depth", "ray_tpu_scheduler_pending_tasks", "short"),
     ("Object store bytes", "ray_tpu_object_store_bytes", "bytes"),
-    ("Object spilled bytes", "ray_tpu_object_spilled_bytes_total",
-     "bytes"),
+    ("Object spilled bytes / s",
+     "rate(ray_tpu_object_spilled_bytes_total[1m])", "Bps"),
+    ("Object store hit rate",
+     "rate(ray_tpu_object_store_hits_total[5m]) / "
+     "(rate(ray_tpu_object_store_hits_total[5m]) + "
+     "rate(ray_tpu_object_store_misses_total[5m]))", "percentunit"),
     ("Node count", "ray_tpu_alive_nodes", "short"),
     ("Actor count", "ray_tpu_actors", "short"),
+    ("Actor restarts / s", "rate(ray_tpu_actor_restarts_total[5m])",
+     "ops"),
+    ("Worker pool size", "ray_tpu_worker_pool_size", "short"),
+    ("Worker lease wait p95 (s)",
+     "histogram_quantile(0.95, "
+     "rate(ray_tpu_worker_lease_wait_seconds_bucket[5m]))", "s"),
+    ("Log lines / s", "rate(ray_tpu_log_monitor_lines_total[1m])",
+     "ops"),
     ("Data-plane pulled bytes / s",
      "rate(ray_tpu_dataplane_pulled_bytes_total[1m])", "Bps"),
 ]
@@ -53,7 +66,14 @@ def generate_dashboard(extra_metrics: Optional[List[str]] = None
     for i, (title, expr, unit) in enumerate(_DEFAULT_PANELS):
         panels.append(_panel(pid, title, expr, unit,
                              x=(i % 2) * 12, y=(i // 2) * 8))
-        covered.add(expr.split("(")[-1].split("[")[0].rstrip(")"))
+        # Every metric family a curated expr touches counts as covered
+        # (hit-rate/quantile exprs reference several; suffixes like
+        # _bucket reduce to the registry's family name).
+        for ref in re.findall(r"ray_tpu[a-zA-Z0-9_]*", expr):
+            covered.add(ref)
+            for suffix in ("_bucket", "_total"):
+                if ref.endswith(suffix):
+                    covered.add(ref[:-len(suffix)])
         pid += 1
     # Auto-panels for live registry metrics without a curated panel.
     names = list(extra_metrics or [])

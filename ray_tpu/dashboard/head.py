@@ -106,11 +106,23 @@ class DashboardHead:
         return self._json({"result": state_api.summarize_tasks()})
 
     async def _metrics(self, request):
+        """One Prometheus scrape for the whole cluster: the head's
+        registry plus every daemon/worker batch, labeled node_id/pid/
+        component. Falls back to the process-local exposition when no
+        runtime is up (tools context)."""
+        import asyncio
+
         from aiohttp import web
 
-        from ray_tpu.util.metrics import export_prometheus
-        return web.Response(text=export_prometheus(),
-                            content_type="text/plain")
+        from ray_tpu._private.worker import global_worker
+        runtime = getattr(global_worker, "_runtime", None)
+        text_fn = getattr(runtime, "cluster_metrics_text", None)
+        if text_fn is not None:
+            text = await asyncio.to_thread(text_fn)
+        else:
+            from ray_tpu.util.metrics import export_prometheus
+            text = export_prometheus()
+        return web.Response(text=text, content_type="text/plain")
 
     async def _event_stats(self, request):
         """Per-handler latency/queue stats of the control plane

@@ -95,8 +95,21 @@ def cmd_summary(args) -> int:
 
 
 def cmd_metrics(args) -> int:
-    from ray_tpu.util.metrics import export_prometheus
-    print(export_prometheus())
+    if getattr(args, "grafana", False):
+        from ray_tpu.dashboard.grafana import generate_dashboard
+        print(json.dumps(generate_dashboard(), indent=2))
+        return 0
+    _ensure_init()
+    from ray_tpu._private.worker import global_worker
+    runtime = getattr(global_worker, "_runtime", None)
+    text_fn = getattr(runtime, "cluster_metrics_text", None)
+    if text_fn is not None:
+        # Cluster-wide exposition: every node/worker's series with
+        # node_id/pid/component labels (what /metrics serves).
+        print(text_fn())
+    else:
+        from ray_tpu.util.metrics import export_prometheus
+        print(export_prometheus())
     return 0
 
 
@@ -351,7 +364,11 @@ def main(argv=None) -> int:
                    help="machine-readable output")
     p = sub.add_parser("summary", help="summarize cluster state")
     p.add_argument("resource", choices=["tasks", "objects"])
-    sub.add_parser("metrics", help="print Prometheus metrics")
+    p = sub.add_parser("metrics",
+                       help="print cluster-wide Prometheus metrics")
+    p.add_argument("--grafana", action="store_true",
+                   help="print the generated Grafana dashboard JSON "
+                        "instead of the exposition")
     sub.add_parser("devices", help="list visible accelerator devices")
 
     p = sub.add_parser("job", help="submit and manage jobs")

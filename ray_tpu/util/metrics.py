@@ -5,13 +5,19 @@ Gauge, Histogram over the C++ OpenCensus pipeline, stats/metric.h). Here a
 process-local registry aggregates tagged series; ``export_prometheus``
 renders the text exposition format the reference's metrics agent serves to
 Prometheus.
+
+Cluster export rides :func:`snapshot` / :func:`diff_snapshot`: every
+worker/daemon's metrics agent (``_private/metrics_agent.py``) snapshots
+this registry on an interval and ships the changed series to the head,
+which merges them (tagged ``node_id``/``pid``/``component``) into one
+cluster-wide exposition via :func:`render_exposition`.
 """
 
 from __future__ import annotations
 
 import bisect
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 _REGISTRY: Dict[str, "Metric"] = {}
 _REGISTRY_LOCK = threading.Lock()
@@ -33,11 +39,21 @@ class Metric:
         with _REGISTRY_LOCK:
             existing = _REGISTRY.get(name)
             if existing is not None:
-                # Re-registration returns the same series store (the
-                # reference keys metrics globally by name too).
+                # Re-registration with the SAME signature returns the same
+                # series store (the reference keys metrics globally by name
+                # too); a conflicting signature is a programming error that
+                # used to be silently swallowed.
+                if self._signature() != existing._signature():
+                    raise ValueError(
+                        f"metric {name!r} already registered with a "
+                        f"different signature: existing "
+                        f"{existing._signature()}, new {self._signature()}")
                 self.__dict__ = existing.__dict__
             else:
                 _REGISTRY[name] = self
+
+    def _signature(self) -> Tuple:
+        return (self.metric_type, self.description, self.tag_keys)
 
     def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
         self._default_tags = dict(tags)
@@ -91,6 +107,10 @@ class Histogram(Metric):
             self._sums: Dict[Tuple[str, ...], float] = {}
             self._counts: Dict[Tuple[str, ...], int] = {}
 
+    def _signature(self) -> Tuple:
+        return (self.metric_type, self.description, self.tag_keys,
+                tuple(self.boundaries))
+
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
         key = self._key(tags)
@@ -126,31 +146,174 @@ def registry() -> Dict[str, Metric]:
 
 
 def clear_registry() -> None:
+    """Test hook: forget every registered metric. Live Metric objects keep
+    working but stop being exported; the next registration under a name
+    starts a fresh series store."""
     with _REGISTRY_LOCK:
         _REGISTRY.clear()
 
 
-def export_prometheus() -> str:
-    """Prometheus text exposition of every registered metric (what the
-    reference's per-node metrics agent serves, metrics_agent.py:189)."""
+# ---------------------------------------------------------------------------
+# Snapshots (the unit the metrics agents ship over the wire)
+# ---------------------------------------------------------------------------
+
+
+def snapshot() -> List[Dict[str, Any]]:
+    """Picklable snapshot of every registered metric: one dict per metric
+    with its full series state (histograms include buckets/sums/counts).
+    This is what a metrics agent diffs and ships in ``metrics_batch``
+    frames."""
+    out: List[Dict[str, Any]] = []
+    for _name, metric in sorted(registry().items()):
+        with metric._lock:
+            entry: Dict[str, Any] = {
+                "name": metric.name,
+                "type": metric.metric_type,
+                "desc": metric.description,
+                "tag_keys": tuple(metric.tag_keys),
+                "series": dict(metric._series),
+            }
+            if isinstance(metric, Histogram):
+                entry["boundaries"] = tuple(metric.boundaries)
+                entry["buckets"] = {k: list(v)
+                                    for k, v in metric._buckets.items()}
+                entry["sums"] = dict(metric._sums)
+                entry["counts"] = dict(metric._counts)
+        out.append(entry)
+    return out
+
+
+def diff_snapshot(prev: Optional[List[Dict[str, Any]]],
+                  cur: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """The entries (and within them only the series) that changed between
+    two :func:`snapshot` results. Values are CUMULATIVE, so the receiver
+    merges by overwrite — a dropped diff frame heals on the next change or
+    full refresh."""
+    if not prev:
+        return list(cur)
+    prev_by = {e["name"]: e for e in prev}
+    out: List[Dict[str, Any]] = []
+    for entry in cur:
+        old = prev_by.get(entry["name"])
+        if old is None or old.get("type") != entry.get("type"):
+            out.append(entry)
+            continue
+        changed = {k for k, v in entry["series"].items()
+                   if old["series"].get(k) != v}
+        if entry["type"] == "histogram":
+            changed |= {k for k, v in entry.get("counts", {}).items()
+                        if old.get("counts", {}).get(k) != v}
+        if not changed:
+            continue
+        slim = {k: v for k, v in entry.items()
+                if k not in ("series", "buckets", "sums", "counts")}
+        slim["series"] = {k: v for k, v in entry["series"].items()
+                          if k in changed}
+        if entry["type"] == "histogram":
+            for field in ("buckets", "sums", "counts"):
+                slim[field] = {k: v for k, v in entry.get(field, {}).items()
+                               if k in changed}
+        out.append(slim)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+
+def _sanitize(name: str) -> str:
+    return name.replace("-", "_").replace(".", "_")
+
+
+def _escape_help(text: str) -> str:
+    """HELP text is one line by contract: escape backslash and newline."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _fmt(value: float) -> str:
+    f = float(value)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _label_str(keys: Sequence[str], values: Sequence[str],
+               extra: Optional[Dict[str, str]] = None) -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in zip(keys, values)]
+    if extra:
+        parts += [f'{k}="{_escape_label(v)}"' for k, v in extra.items()]
+    return ",".join(parts)
+
+
+def _render_entry(lines: List[str], safe: str, entry: Dict[str, Any],
+                  extra: Optional[Dict[str, str]]) -> None:
+    tag_keys = tuple(entry.get("tag_keys") or ())
+    if entry.get("type") == "histogram":
+        boundaries = list(entry.get("boundaries") or ())
+        counts = entry.get("counts", {})
+        sums = entry.get("sums", {})
+        for key, buckets in entry.get("buckets", {}).items():
+            base = _label_str(tag_keys, key, extra)
+            sep = "," if base else ""
+            run = 0
+            for bound, n in zip(boundaries, buckets):
+                run += n
+                lines.append(
+                    f'{safe}_bucket{{{base}{sep}le="{_fmt(bound)}"}} '
+                    f"{run}")
+            lines.append(f'{safe}_bucket{{{base}{sep}le="+Inf"}} '
+                         f"{counts.get(key, run)}")
+            lines.append(
+                f"{safe}_sum{'{' + base + '}' if base else ''} "
+                f"{_fmt(sums.get(key, 0.0))}")
+            lines.append(
+                f"{safe}_count{'{' + base + '}' if base else ''} "
+                f"{counts.get(key, 0)}")
+        return
+    for key, value in entry.get("series", {}).items():
+        labels = _label_str(tag_keys, key, extra)
+        if labels:
+            lines.append(f"{safe}{{{labels}}} {_fmt(value)}")
+        else:
+            lines.append(f"{safe} {_fmt(value)}")
+
+
+def render_exposition(
+        groups: Iterable[Tuple[Dict[str, Any],
+                               Optional[Dict[str, str]]]]) -> str:
+    """Prometheus text exposition from snapshot entries. ``groups`` is an
+    iterable of (snapshot entry, extra label dict or None); entries for
+    the same metric name (e.g. from different nodes) are merged under one
+    HELP/TYPE header. Extra labels (node_id/pid/component) are appended
+    to every series of their entry."""
+    by_name: Dict[str, List[Tuple[Dict[str, Any],
+                                  Optional[Dict[str, str]]]]] = {}
+    for entry, extra in groups:
+        by_name.setdefault(entry["name"], []).append((entry, extra))
     lines: List[str] = []
-    for name, metric in sorted(registry().items()):
-        safe = name.replace("-", "_").replace(".", "_")
-        if metric.description:
-            lines.append(f"# HELP {safe} {metric.description}")
-        lines.append(f"# TYPE {safe} {metric.metric_type}")
-        for key, value in metric.series().items():
-            if metric.tag_keys:
-                tags = ",".join(f'{k}="{v}"'
-                                for k, v in zip(metric.tag_keys, key))
-                lines.append(f"{safe}{{{tags}}} {value}")
-            else:
-                lines.append(f"{safe} {value}")
-        if isinstance(metric, Histogram):
-            for key, count in metric._counts.items():
-                tags = ",".join(f'{k}="{v}"'
-                                for k, v in zip(metric.tag_keys, key))
-                prefix = f"{safe}_count{{{tags}}}" if tags else \
-                    f"{safe}_count"
-                lines.append(f"{prefix} {count}")
+    for name in sorted(by_name):
+        items = by_name[name]
+        safe = _sanitize(name)
+        first = items[0][0]
+        if first.get("desc"):
+            lines.append(f"# HELP {safe} {_escape_help(first['desc'])}")
+        lines.append(f"# TYPE {safe} {first.get('type', 'untyped')}")
+        for entry, extra in items:
+            if entry.get("type") != first.get("type"):
+                continue  # conflicting family type from another origin
+            _render_entry(lines, safe, entry, extra)
     return "\n".join(lines) + "\n"
+
+
+def export_prometheus() -> str:
+    """Prometheus text exposition of every metric registered in THIS
+    process (what the reference's per-node metrics agent serves,
+    metrics_agent.py:189). The head's dashboard serves the cluster-merged
+    variant via ``_private/metrics_agent.ClusterMetrics``."""
+    return render_exposition((entry, None) for entry in snapshot())

@@ -33,10 +33,24 @@ class Span:
     start_time: float
     end_time: Optional[float] = None
     attributes: Dict[str, Any] = field(default_factory=dict)
+    # Set once the span has been drained into a metrics_batch frame, so a
+    # long-open span ahead of it in the buffer cannot cause re-shipping.
+    shipped: bool = field(default=False, repr=False, compare=False)
 
     def end(self) -> None:
         if self.end_time is None:
             self.end_time = time.time()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_time": self.start_time,
+            "end_time": self.end_time,
+            "attributes": dict(self.attributes),
+        }
 
 
 def enable_tracing() -> None:
@@ -125,6 +139,30 @@ def continue_context(ctx: Optional[Dict[str, str]], name: str):
     finally:
         span.end()
         _state.span = prev
+
+
+def drain_finished_spans(cursor: int = 0) -> tuple:
+    """Ended, not-yet-shipped spans at or after ``cursor``, as plain
+    dicts, plus the new cursor (the metrics agent's incremental export:
+    spans ride ``metrics_batch`` frames to the head so /api/timeline can
+    render cross-process task spans). Open spans are left in place and
+    revisited on the next drain; the cursor only advances past the prefix
+    whose spans are all shipped."""
+    out: List[Dict[str, Any]] = []
+    with _lock:
+        cursor = max(0, min(cursor, len(_spans)))
+        new_cursor = cursor
+        advancing = True
+        for i in range(cursor, len(_spans)):
+            span = _spans[i]
+            if span.end_time is None:
+                advancing = False
+            elif not span.shipped:
+                span.shipped = True
+                out.append(span.to_dict())
+            if advancing:
+                new_cursor = i + 1
+    return out, new_cursor
 
 
 def get_spans(trace_id: Optional[str] = None) -> List[Span]:

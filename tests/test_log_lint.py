@@ -9,6 +9,10 @@ runtime, so CI catches them statically:
 2. Bare ``print(`` under ``ray_tpu/_private/`` — framework internals
    must use the ``logging`` module (or explicit stream writes) so their
    chatter doesn't masquerade as user task output in the stream.
+3. ``time.time() - t0`` latency math under ``ray_tpu/_private/`` —
+   wall-clock deltas jump on NTP steps; durations feeding metrics must
+   use ``time.monotonic()``/``perf_counter()`` (and then belong in a
+   ``util.metrics`` Histogram, not an ad-hoc accumulator).
 """
 
 import ast
@@ -56,6 +60,39 @@ def test_no_devnull_popen_in_package():
         "Popen with stdout/stderr=DEVNULL discards output the log "
         "subsystem should capture (use ray_logging.open_worker_capture "
         "or open_launch_capture): " + ", ".join(offenders))
+
+
+def _is_time_time(node):
+    """A ``time.time()`` (or bare ``time()``) call expression."""
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr == "time" and \
+            isinstance(func.value, ast.Name) and func.value.id == "time"
+    return isinstance(func, ast.Name) and func.id == "time"
+
+
+def test_no_wall_clock_latency_math_in_private():
+    """No ``time.time()`` operand inside a subtraction in _private/:
+    duration accounting must be monotonic (and go through
+    util.metrics), never ad-hoc wall-clock deltas."""
+    offenders = []
+    for path in _py_files(os.path.join(PKG_ROOT, "_private")):
+        tree = _parse(path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.BinOp) and
+                    isinstance(node.op, ast.Sub)):
+                continue
+            for operand in (node.left, node.right):
+                if _is_time_time(operand):
+                    rel = os.path.relpath(path, PKG_ROOT)
+                    offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        "time.time() used in a subtraction in ray_tpu/_private/ — "
+        "latency/duration accounting must use time.monotonic() or "
+        "time.perf_counter() and report through util.metrics: "
+        + ", ".join(offenders))
 
 
 def test_no_bare_print_in_private():

@@ -1,0 +1,432 @@
+"""Cluster metrics pipeline: registry semantics, Prometheus exposition
+conformance, agent diff/full export, head-side cluster merge with
+staleness eviction, worker reply piggybacking, and the 2-node
+acceptance scrape (one endpoint serving series from every node)."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as um
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    um.clear_registry()
+    yield
+    um.clear_registry()
+
+
+def _spawn_daemon(port, *, num_cpus=2, resources=None):
+    cmd = [sys.executable, "-m", "ray_tpu._private.multinode",
+           "--address", f"127.0.0.1:{port}",
+           "--num-cpus", str(num_cpus)]
+    if resources:
+        cmd += ["--resources", json.dumps(resources)]
+    return subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def _wait_for_resource(name, amount, timeout=20):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if ray_tpu.cluster_resources().get(name, 0) >= amount:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"resource {name}>={amount} never appeared: "
+        f"{ray_tpu.cluster_resources()}")
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_reregistration_identical_signature_shares_series():
+    c1 = um.Counter("reg_requests", "requests", tag_keys=("route",))
+    c1.inc(3, tags={"route": "/a"})
+    c2 = um.Counter("reg_requests", "requests", tag_keys=("route",))
+    c2.inc(4, tags={"route": "/a"})
+    assert c1.series() == c2.series() == {("/a",): 7.0}
+
+
+def test_reregistration_conflict_raises():
+    um.Counter("reg_conflict", "first description")
+    with pytest.raises(ValueError, match="different signature"):
+        um.Counter("reg_conflict", "other description")
+    with pytest.raises(ValueError, match="different signature"):
+        um.Gauge("reg_conflict", "first description")
+    um.Counter("reg_tagged", "d", tag_keys=("a",))
+    with pytest.raises(ValueError, match="different signature"):
+        um.Counter("reg_tagged", "d", tag_keys=("a", "b"))
+
+
+def test_histogram_boundary_conflict_raises():
+    um.Histogram("reg_hist", "d", boundaries=[1, 2, 3])
+    with pytest.raises(ValueError, match="different signature"):
+        um.Histogram("reg_hist", "d", boundaries=[1, 2])
+    # identical boundaries re-register fine (any order)
+    um.Histogram("reg_hist", "d", boundaries=[3, 2, 1])
+
+
+def test_clear_registry_starts_fresh():
+    c = um.Counter("reg_fresh", "d")
+    c.inc(5)
+    um.clear_registry()
+    assert um.registry() == {}
+    c2 = um.Counter("reg_fresh", "a new life")  # no conflict after clear
+    assert c2.series() == {}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition conformance
+# ---------------------------------------------------------------------------
+
+
+def _sample_exposition():
+    c = um.Counter("expo_requests_total", "handled requests",
+                   tag_keys=("route",))
+    c.inc(3, tags={"route": "/a"})
+    c.inc(2, tags={"route": "/b"})
+    g = um.Gauge("expo_inflight", "in-flight requests")
+    g.set(7)
+    h = um.Histogram("expo_latency_seconds", "request latency",
+                     boundaries=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.5, 0.5, 20.0):
+        h.observe(v)
+    return um.export_prometheus()
+
+
+def test_exposition_parses():
+    text = _sample_exposition()
+    try:
+        from prometheus_client.parser import text_string_to_metric_families
+    except ImportError:
+        _assert_exposition_by_regex(text)
+        return
+    families = {f.name: f for f in text_string_to_metric_families(text)}
+    # the parser strips _total from counter family names
+    counter = families.get("expo_requests") or families["expo_requests_total"]
+    by_route = {s.labels["route"]: s.value for s in counter.samples
+                if s.name.endswith("_total") or s.name == "expo_requests"}
+    assert by_route == {"/a": 3.0, "/b": 2.0}
+    assert families["expo_inflight"].samples[0].value == 7.0
+    hist = families["expo_latency_seconds"]
+    samples = {(s.name, s.labels.get("le")): s.value for s in hist.samples}
+    assert samples[("expo_latency_seconds_bucket", "+Inf")] == 4.0
+    assert samples[("expo_latency_seconds_count", None)] == 4.0
+    assert samples[("expo_latency_seconds_sum", None)] == \
+        pytest.approx(21.05)
+
+
+def _assert_exposition_by_regex(text):
+    """Strict structural checks when prometheus_client is unavailable."""
+    assert "# TYPE expo_requests_total counter" in text
+    assert 'expo_requests_total{route="/a"} 3' in text
+    assert "# TYPE expo_inflight gauge" in text
+    assert "expo_inflight 7" in text
+    assert "# TYPE expo_latency_seconds histogram" in text
+    buckets = re.findall(
+        r'expo_latency_seconds_bucket\{le="([^"]+)"\} (\d+)', text)
+    assert [b[0] for b in buckets] == ["0.1", "1", "10", "+Inf"]
+    counts = [int(b[1]) for b in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert counts[-1] == 4
+    assert "expo_latency_seconds_sum 21.05" in text
+    assert "expo_latency_seconds_count 4" in text
+    # no bare series line for the histogram base name
+    assert not re.search(r"^expo_latency_seconds \d", text, re.M)
+
+
+def test_histogram_cumulative_buckets_always():
+    # regex checks run unconditionally — the parser path (above) is
+    # looser about cumulative ordering
+    _assert_exposition_by_regex(_sample_exposition())
+
+
+def test_help_and_label_escaping():
+    um.Counter("expo_escaped", 'line1\nline2 \\ "quoted"',
+               tag_keys=("k",)).inc(1, tags={"k": 'v"1\n2'})
+    text = um.export_prometheus()
+    help_lines = [l for l in text.splitlines()
+                  if l.startswith("# HELP expo_escaped")]
+    assert help_lines == [
+        '# HELP expo_escaped line1\\nline2 \\\\ "quoted"']
+    assert 'expo_escaped{k="v\\"1\\n2"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# Snapshots + agent
+# ---------------------------------------------------------------------------
+
+
+def test_diff_snapshot_ships_only_changes():
+    a = um.Counter("snap_a", "d")
+    um.Counter("snap_b", "d").inc(1)
+    a.inc(1)
+    prev = um.snapshot()
+    a.inc(2)
+    cur = um.snapshot()
+    diff = um.diff_snapshot(prev, cur)
+    assert [e["name"] for e in diff] == ["snap_a"]
+    assert diff[0]["series"] == {(): 3.0}
+    assert um.diff_snapshot(cur, um.snapshot()) == []
+
+
+def test_metrics_agent_full_then_diff_then_recovery():
+    from ray_tpu._private.metrics_agent import MetricsAgent
+    published = []
+    ok = [True]
+
+    def publish(batch):
+        published.append(batch)
+        return ok[0]
+
+    agent = MetricsAgent(publish, component="test", interval_s=999,
+                         start=False)
+    c = um.Counter("agent_ticks", "d")
+    c.inc()
+    assert agent.poll_once()
+    assert published[-1]["component"] == "test"
+    assert published[-1]["pid"] == os.getpid()
+    assert any(e["name"] == "agent_ticks" for e in
+               published[-1]["metrics"])
+    # no change -> nothing published
+    n = len(published)
+    assert not agent.poll_once()
+    assert len(published) == n
+    # a dropped batch forces a FULL resend once the channel recovers
+    c.inc()
+    ok[0] = False
+    assert not agent.poll_once()
+    ok[0] = True
+    um.Counter("agent_other", "d").inc()
+    assert agent.poll_once()
+    assert {e["name"] for e in published[-1]["metrics"]} >= \
+        {"agent_ticks", "agent_other"}
+
+
+def test_agent_ships_finished_spans():
+    from ray_tpu._private.metrics_agent import ClusterMetrics, MetricsAgent
+    from ray_tpu.util import tracing
+    published = []
+    agent = MetricsAgent(lambda b: published.append(b) or True,
+                         component="test", interval_s=999, start=False)
+    agent.poll_once(force_full=True)  # drain pre-existing spans
+    published.clear()
+    tracing.enable_tracing()
+    try:
+        with tracing.start_span("unit_span"):
+            pass
+    finally:
+        tracing.disable_tracing()
+    assert agent.poll_once()
+    names = [s["name"] for b in published for s in b["spans"]]
+    assert "unit_span" in names
+    cm = ClusterMetrics(staleness=30)
+    cm.update("nodeff", published[-1])
+    events = cm.chrome_spans()
+    assert any(e["name"] == "unit_span" and
+               e["pid"].startswith("node:nodeff"[:17]) for e in events)
+
+
+def test_cluster_metrics_merge_and_staleness_eviction():
+    from ray_tpu._private.metrics_agent import ClusterMetrics
+    cm = ClusterMetrics(staleness=0.2)
+    batch = {"pid": 1, "component": "daemon", "metrics": [
+        {"name": "cm_series", "type": "counter", "desc": "d",
+         "tag_keys": (), "series": {(): 5.0}}], "spans": []}
+    cm.update("node_a", batch)
+    cm.update("node_b", dict(batch, pid=2))
+    text = cm.render()
+    assert 'cm_series{node_id="node_a",pid="1",component="daemon"} 5' \
+        in text
+    assert 'node_id="node_b"' in text
+    # overwrite merge: a fresher cumulative value replaces the held one
+    cm.update("node_a", {"pid": 1, "component": "daemon", "metrics": [
+        {"name": "cm_series", "type": "counter", "desc": "d",
+         "tag_keys": (), "series": {(): 9.0}}]})
+    assert 'cm_series{node_id="node_a",pid="1",component="daemon"} 9' \
+        in cm.render()
+    cm.mark_node_dead("node_b")
+    # still scrapeable inside the window
+    assert 'node_id="node_b"' in cm.render()
+    time.sleep(0.3)
+    text = cm.render()
+    assert 'node_id="node_b"' not in text
+    assert 'node_id="node_a"' in text
+
+
+# ---------------------------------------------------------------------------
+# Runtime integration
+# ---------------------------------------------------------------------------
+
+
+def test_builtin_counters_on_head(ray_start_regular):
+    @ray_tpu.remote
+    def ok(i):
+        return i
+
+    ray_tpu.get([ok.remote(i) for i in range(5)])
+    from ray_tpu._private.worker import global_worker
+    rt = global_worker.runtime
+    text = rt.cluster_metrics_text()
+    m = re.search(r'ray_tpu_tasks_finished_total\{node_id="([0-9a-f]+)"'
+                  r',pid="\d+",component="driver"\} (\d+)', text)
+    assert m, text
+    assert int(m.group(2)) >= 5
+    assert m.group(1) == rt.head_node_id.hex()
+    assert "ray_tpu_tasks_submitted_total" in text
+    assert "ray_tpu_scheduler_pending_tasks" in text
+    assert "ray_tpu_object_store_bytes" in text
+
+
+def test_user_counter_piggybacks_on_worker_replies(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_METRICS_EXPORT_INTERVAL_S", "0.05")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    try:
+        @ray_tpu.remote(runtime_env={"worker_process": True})
+        def hit():
+            from ray_tpu.util.metrics import Counter
+            Counter("test_worker_hits_total", "worker hits").inc()
+            return os.getpid()
+
+        pid = ray_tpu.get(hit.remote())
+        assert pid != os.getpid()
+        from ray_tpu._private.worker import global_worker
+        rt = global_worker.runtime
+        text = ""
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            text = rt.cluster_metrics_text()
+            if "test_worker_hits_total" in text:
+                break
+            ray_tpu.get(hit.remote())  # another reply carries the batch
+            time.sleep(0.1)
+        assert re.search(r'test_worker_hits_total\{node_id="[0-9a-f]+",'
+                         r'pid="%d",component="worker"\}' % pid, text), \
+            text
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_cluster_scrape_two_nodes_and_eviction(monkeypatch):
+    """The acceptance path: one scrape serves a built-in counter from
+    the head AND a user counter from the non-head node, with distinct
+    node_id labels; killing the node evicts its series after the
+    staleness window."""
+    monkeypatch.setenv("RAY_TPU_METRICS_EXPORT_INTERVAL_S", "0.2")
+    monkeypatch.setenv("RAY_TPU_METRICS_STALENESS_S", "1.0")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    proc = None
+    try:
+        host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+        proc = _spawn_daemon(port, num_cpus=2, resources={"remote": 2})
+        _wait_for_resource("remote", 2)
+
+        @ray_tpu.remote(resources={"remote": 1},
+                        runtime_env={"worker_process": False})
+        def hit():
+            from ray_tpu.util.metrics import Counter
+            Counter("test_remote_hits_total", "remote hits").inc()
+            return os.getpid()
+
+        ray_tpu.get([hit.remote() for _ in range(4)], timeout=60)
+        from ray_tpu._private.worker import global_worker
+        rt = global_worker.runtime
+        head_node = rt.head_node_id.hex()
+        daemon_node = None
+        text = ""
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            text = rt.cluster_metrics_text()
+            m = re.search(
+                r'test_remote_hits_total\{node_id="([0-9a-f]+)"', text)
+            if m and "ray_tpu_tasks_finished_total" in text:
+                daemon_node = m.group(1)
+                break
+            time.sleep(0.1)
+        assert daemon_node, f"daemon series never arrived:\n{text}"
+        assert daemon_node != head_node
+        assert re.search(r'ray_tpu_tasks_finished_total\{node_id="%s"'
+                         % head_node, text)
+        # node death -> staleness clock -> eviction
+        proc.kill()
+        proc.wait(timeout=10)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            text = rt.cluster_metrics_text()
+            if "test_remote_hits_total" not in text:
+                break
+            time.sleep(0.2)
+        assert "test_remote_hits_total" not in text
+        assert re.search(r'ray_tpu_tasks_finished_total\{node_id="%s"'
+                         % head_node, text)
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Actor task naming through the client actor_info path (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_actor_handle_class_name_fallback(ray_start_regular):
+    @ray_tpu.remote
+    class NamedThing:
+        def poke(self):
+            return 1
+
+    h = NamedThing.remote()
+    from ray_tpu._private.worker import global_worker
+    rt = global_worker.runtime
+    assert rt.actor_state(h._actor_id).class_name == "NamedThing"
+    # a handle that could NOT load the class still names tasks by class
+    from ray_tpu.actor import ActorHandle
+    blind = ActorHandle(h._actor_id, None, class_name="NamedThing")
+    assert ray_tpu.get(blind.poke.remote()) == 1
+    assert "NamedThing" in repr(blind)
+    assert any(ev["name"] == "NamedThing.poke"
+               for ev in rt.task_events())
+
+
+def test_client_session_actor_tasks_named_by_class(ray_start_regular):
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    proc = _spawn_daemon(port, num_cpus=2, resources={"remote": 2})
+    try:
+        _wait_for_resource("remote", 2)
+
+        @ray_tpu.remote
+        class Named:
+            def ping(self):
+                return "pong"
+
+        a = Named.options(name="cn_actor").remote()
+        assert ray_tpu.get(a.ping.remote()) == "pong"
+
+        @ray_tpu.remote(resources={"remote": 1},
+                        runtime_env={"worker_process": False})
+        def probe():
+            import ray_tpu as rt
+            h = rt.get_actor("cn_actor")
+            return h._class_name, rt.get(h.ping.remote())
+
+        cls_name, pong = ray_tpu.get(probe.remote(), timeout=60)
+        assert pong == "pong"
+        assert cls_name == "Named"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=10)
