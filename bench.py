@@ -1056,19 +1056,17 @@ def _prior_round_bench():
     return rec.get("parsed") or rec, os.path.basename(best)
 
 
-def _regression_gate(extra: dict, headline_value: float) -> None:
-    """Compare throughput metrics against the prior round's recorded
-    bench (reference: release microbenchmark trend tracking). A >=10%
-    drop WARNS on stderr and is recorded in extra['regressions'] so it
-    can never again go unnoticed for two rounds (tasks_per_sec fell
-    10,349 -> 7,481 across r02-r04 silently)."""
+def compare_rounds(prev: dict, extra: dict, headline_value,
+                   threshold: float = 0.10) -> list:
+    """Pure comparator behind the regression gate: throughput metrics
+    (``*per_sec``/``*_qps``/``*_mfu``/``*mb_per_sec`` keys of the prior
+    round's extras, plus the headline value) that dropped by more than
+    ``threshold`` (a fraction: 0.10 = 10%). Improvements, non-numeric
+    values, and metrics absent from either side are ignored. Returns
+    [{metric, prev, now, drop_pct}, ...]."""
     import re as _re
-    import sys as _sys
-    prev, name = _prior_round_bench()
-    if not prev:
-        return
-    extra["regression_baseline"] = name
-    prev_extra = prev.get("extra") or {}
+    floor = 1.0 - threshold
+    prev_extra = (prev or {}).get("extra") or {}
     regressions = []
     pattern = _re.compile(r"(per_sec|_qps|_mfu|mb_per_sec)$")
     for k, old in prev_extra.items():
@@ -1077,20 +1075,37 @@ def _regression_gate(extra: dict, headline_value: float) -> None:
         if not pattern.search(k):
             continue
         new = extra.get(k)
-        if isinstance(new, (int, float)) and new < 0.9 * old:
+        if isinstance(new, (int, float)) and new < floor * old:
             drop = round(100 * (1 - new / old), 1)
             regressions.append({"metric": k, "prev": old, "now": new,
                                 "drop_pct": drop})
-            print(f"REGRESSION WARNING: {k} {old} -> {new} "
-                  f"(-{drop}%) vs {name}", file=_sys.stderr)
-    prev_head = prev.get("value")
+    prev_head = (prev or {}).get("value")
     if isinstance(prev_head, (int, float)) and prev_head > 0 and \
-            headline_value < 0.9 * prev_head:
+            isinstance(headline_value, (int, float)) and \
+            headline_value < floor * prev_head:
         drop = round(100 * (1 - headline_value / prev_head), 1)
         regressions.append({"metric": "headline", "prev": prev_head,
                             "now": headline_value, "drop_pct": drop})
-        print(f"REGRESSION WARNING: headline {prev_head} -> "
-              f"{headline_value} (-{drop}%) vs {name}", file=_sys.stderr)
+    return regressions
+
+
+def _regression_gate(extra: dict, headline_value: float) -> None:
+    """Compare throughput metrics against the prior round's recorded
+    bench (reference: release microbenchmark trend tracking). A >=10%
+    drop WARNS on stderr and is recorded in extra['regressions'] so it
+    can never again go unnoticed for two rounds (tasks_per_sec fell
+    10,349 -> 7,481 across r02-r04 silently)."""
+    import sys as _sys
+    prev, name = _prior_round_bench()
+    if not prev:
+        return
+    extra["regression_baseline"] = name
+    regressions = compare_rounds(prev, extra, headline_value,
+                                 threshold=0.10)
+    for r in regressions:
+        print(f"REGRESSION WARNING: {r['metric']} {r['prev']} -> "
+              f"{r['now']} (-{r['drop_pct']}%) vs {name}",
+              file=_sys.stderr)
     if regressions:
         extra["regressions"] = regressions
 
@@ -1121,7 +1136,24 @@ def _recapture_microbench(extra: dict) -> None:
                            for r in results}
 
 
-def main():
+def _parse_args(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="ray_tpu benchmark suite (one JSON result on stdout)")
+    ap.add_argument(
+        "--check-regressions", action="store_true",
+        help="exit nonzero when any throughput metric dropped more than "
+             "the regression threshold vs the prior round's BENCH file")
+    ap.add_argument(
+        "--regression-threshold", type=float, default=20.0,
+        metavar="PCT",
+        help="drop percentage that fails --check-regressions "
+             "(default: 20)")
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    args = _parse_args(argv)
     import jax
 
     from ray_tpu.parallel.train_step import (default_optimizer,
@@ -1229,6 +1261,18 @@ def main():
         "extra": extra,
     }
     print(json.dumps(result))
+
+    if args.check_regressions:
+        import sys as _sys
+        prev, name = _prior_round_bench()
+        gated = compare_rounds(prev, extra, headline_value,
+                               threshold=args.regression_threshold / 100.0)
+        if gated:
+            print(f"FAIL: {len(gated)} metric(s) regressed more than "
+                  f"{args.regression_threshold}% vs {name}: "
+                  + ", ".join(r["metric"] for r in gated),
+                  file=_sys.stderr)
+            _sys.exit(1)
 
 
 if __name__ == "__main__":

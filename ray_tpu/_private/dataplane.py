@@ -35,10 +35,60 @@ import struct
 import threading
 from typing import Dict, Optional, Tuple
 
+from ray_tpu._private import builtin_metrics
+
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct(">q")  # signed: -1 = not found
 CHUNK_SIZE = 4 << 20  # reference: object_manager default chunk ~5MB
+
+#: Chunked parallel pulls (reference: object_manager.proto chunked
+#: transfer + pull_manager.h): payloads above the chunk threshold are
+#: fetched as concurrent ranged reads over pooled sockets. Defaults
+#: mirror ray_config.py (pull_chunk_bytes / pull_parallelism); daemons
+#: push their RayConfig values here via :func:`configure_pulls`, and the
+#: RAY_TPU_PULL_CHUNK_BYTES / RAY_TPU_PULL_PARALLELISM env vars override
+#: either (so worker subprocesses tune without a config handle).
+DEFAULT_PULL_CHUNK_BYTES = 4 << 20
+DEFAULT_PULL_PARALLELISM = 4
+_pull_cfg: Dict[str, int] = {}
+
+#: Peers whose object server predates the ranged-read op (protocol v5):
+#: after one fallback round-trip per address, pulls skip the probe.
+_ranged_unsupported: set = set()
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw:
+        try:
+            return int(float(raw))
+        except ValueError:
+            pass
+    return default
+
+
+def pull_chunk_bytes() -> int:
+    """Ranged-read chunk size; <= 0 disables chunked pulls."""
+    return _env_int("RAY_TPU_PULL_CHUNK_BYTES",
+                    _pull_cfg.get("chunk_bytes", DEFAULT_PULL_CHUNK_BYTES))
+
+
+def pull_parallelism() -> int:
+    """Max concurrent ranged-read sockets per pull."""
+    return max(1, _env_int("RAY_TPU_PULL_PARALLELISM",
+                           _pull_cfg.get("parallelism",
+                                         DEFAULT_PULL_PARALLELISM)))
+
+
+def configure_pulls(chunk_bytes: Optional[int] = None,
+                    parallelism: Optional[int] = None) -> None:
+    """Install config-table values as this process's pull defaults
+    (env vars still win; see pull_chunk_bytes/pull_parallelism)."""
+    if chunk_bytes is not None:
+        _pull_cfg["chunk_bytes"] = int(chunk_bytes)
+    if parallelism is not None:
+        _pull_cfg["parallelism"] = int(parallelism)
 
 
 class ObjectPullError(ConnectionError):
@@ -368,6 +418,53 @@ class NodeObjectTable:
         with self._lock:
             self._heap[key] = bytes(payload)
 
+    def put_parts(self, key: str, parts, size: Optional[int] = None) -> None:
+        """Store a payload given as a list of bytes-like parts, laid down
+        contiguously in ONE arena allocation (the serialize_oob path:
+        pickle header + raw array buffers land with a single copy each,
+        never joined into an intermediate full-size bytes). Falls back to
+        ``put`` of the joined payload when the arena can't take it."""
+        if size is None:
+            size = sum(len(p) for p in parts)
+        if self._arena is not None:
+            with self._lock:
+                self._sizes[key] = size
+                self._doomed.discard(key)
+            dup = type(self._arena).DUPLICATE
+            off = self._arena.create(key, size)
+            if off is dup:
+                return  # already stored (idempotent puts, same as put)
+            if off is None and self._spill_dir is not None and \
+                    self._make_room(size):
+                off = self._arena.create(key, size)
+                if off is dup:
+                    return
+            if off is not None:
+                try:
+                    wview = self._arena.writable_view(off, size)
+                    pos = 0
+                    if wview is not None:
+                        try:
+                            for p in parts:
+                                n = len(p)
+                                wview[pos:pos + n] = p
+                                pos += n
+                        finally:
+                            with contextlib.suppress(BufferError):
+                                wview.release()
+                    else:
+                        for p in parts:
+                            self._arena.write_at(off + pos, bytes(p))
+                            pos += len(p)
+                except BaseException:
+                    self._arena.abort(key)
+                    with self._lock:
+                        self._sizes.pop(key, None)
+                    raise
+                self._arena.seal(key)
+                return
+        self.put(key, b"".join(bytes(p) for p in parts))
+
     @contextlib.contextmanager
     def pinned(self, key: str):
         """Context manager yielding the payload (a zero-copy shm view when
@@ -544,90 +641,59 @@ class NodeObjectTable:
         with self._lock:
             self.stats[counter] += n
 
-    def recv_into(self, key: str, size: int, sock: socket.socket) -> None:
-        """Stream ``size`` bytes from ``sock`` into the table — straight
-        into the shm arena when possible (no full-size heap staging)."""
+    def begin_recv(self, key: str, size: int) -> "_RecvLanding":
+        """Open an offset-ranged landing for ``size`` incoming bytes:
+        an unsealed arena allocation when it fits (chunks recv straight
+        into disjoint slices of the shm mapping), a preallocated spill
+        file written via ``pwrite`` when it doesn't, a heap buffer with
+        no arena. Disjoint ranges may be filled concurrently by multiple
+        chunk threads; the single coordinating caller then ``commit``s
+        (publish) or ``abort``s (no trace left)."""
         with self._lock:
             # Re-receiving a key freed-while-pinned revives it (same as
             # put): a stale doomed marker would make the next spill pass
             # DELETE the live payload instead of spilling it.
             self._doomed.discard(key)
         if self._arena is not None:
+            dup = type(self._arena).DUPLICATE
             off = self._arena.create(key, size)
             if off is None and self._spill_dir is not None and \
                     self._make_room(size):
                 off = self._arena.create(key, size)
-            if off is None and self._spill_dir is not None:
-                # Won't fit even after spilling: stream to disk directly.
+            if off is dup:
+                # Key already stored (racing re-pull): drain the bytes
+                # into a scratch landing whose commit is a no-op — the
+                # resident payload wins, same as put's idempotence.
+                return _RecvLanding(self, key, size,
+                                    buf=bytearray(size), discard=True)
+            if off is not None:
+                wview = self._arena.writable_view(off, size)
+                return _RecvLanding(self, key, size, wview=wview, off=off)
+            if self._spill_dir is not None:
+                # Won't fit even after spilling: land on disk directly.
                 path = self._spill_path(key)
+                fd = os.open(path + ".tmp",
+                             os.O_RDWR | os.O_CREAT | os.O_TRUNC, 0o600)
                 try:
-                    with open(path + ".tmp", "wb") as f:
-                        read = 0
-                        while read < size:
-                            chunk = sock.recv(min(CHUNK_SIZE, size - read))
-                            if not chunk:
-                                raise ConnectionError(
-                                    "peer closed mid-transfer")
-                            f.write(chunk)
-                            read += len(chunk)
-                except BaseException:
+                    os.ftruncate(fd, size)
+                except OSError:
                     with contextlib.suppress(OSError):
+                        os.close(fd)
                         os.unlink(path + ".tmp")
                     raise
-                os.replace(path + ".tmp", path)
-                with self._lock:
-                    self._sizes[key] = size
-                self._register_spill(key, path, size, drop_arena=False)
-                return
-            if off is not None:
-                try:
-                    # Zero-copy landing: recv straight into the shm
-                    # mapping (no intermediate bytes + second memcpy).
-                    wview = self._arena.writable_view(off, size)
-                    if wview is not None:
-                        received = 0
-                        try:
-                            while received < size:
-                                n = sock.recv_into(
-                                    wview[received:],
-                                    min(CHUNK_SIZE, size - received))
-                                if n == 0:
-                                    raise ConnectionError(
-                                        "peer closed mid-transfer")
-                                received += n
-                        finally:
-                            with contextlib.suppress(BufferError):
-                                wview.release()
-                    else:
-                        written = 0
-                        while written < size:
-                            chunk = sock.recv(
-                                min(CHUNK_SIZE, size - written))
-                            if not chunk:
-                                raise ConnectionError(
-                                    "peer closed mid-transfer")
-                            self._arena.write_at(off + written, chunk)
-                            written += len(chunk)
-                except BaseException:
-                    # Abort, never seal: a seal would momentarily publish
-                    # the half-written payload to concurrent readers.
-                    self._arena.abort(key)
-                    raise
-                self._arena.seal(key)
-                with self._lock:
-                    self._sizes[key] = size
-                return
-        buf = bytearray(size)
-        view = memoryview(buf)
-        read = 0
-        while read < size:
-            n = sock.recv_into(view[read:], min(CHUNK_SIZE, size - read))
-            if n == 0:
-                raise ConnectionError("peer closed mid-transfer")
-            read += n
-        with self._lock:
-            self._heap[key] = bytes(buf)
-            self._sizes[key] = size
+                return _RecvLanding(self, key, size, fd=fd, path=path)
+        return _RecvLanding(self, key, size, buf=bytearray(size))
+
+    def recv_into(self, key: str, size: int, sock: socket.socket) -> None:
+        """Stream ``size`` bytes from ``sock`` into the table — straight
+        into the shm arena when possible (no full-size heap staging)."""
+        landing = self.begin_recv(key, size)
+        try:
+            landing.recv_range(sock, 0, size)
+        except BaseException:
+            landing.abort()
+            raise
+        landing.commit()
 
     def close(self) -> None:
         if self._arena is not None:
@@ -645,6 +711,122 @@ class NodeObjectTable:
             except OSError:
                 pass
         self._heap.clear()
+
+
+class _RecvLanding:
+    """One in-progress streamed landing (see NodeObjectTable.begin_recv).
+
+    Three backends, chosen by the table:
+
+    * **arena** — unsealed create() allocation; ranges recv_into
+      disjoint slices of one writable shm mapping (zero staging copies).
+      writable_view's single-writer caveat is about the allocation as a
+      whole — disjoint slices from different chunk threads never alias.
+    * **disk** — preallocated ``<spill>.tmp`` file; ranges recv into a
+      scratch buffer and ``os.pwrite`` at their offset, committed with
+      an atomic rename + spill registration.
+    * **heap** — preallocated bytearray (no arena available).
+
+    ``commit`` publishes (seal / rename / heap insert) and ``abort``
+    leaves no half-written entry behind — a failed pull must never be
+    readable."""
+
+    __slots__ = ("_table", "key", "size", "_wview", "_off", "_fd",
+                 "_path", "_buf", "_discard")
+
+    def __init__(self, table: NodeObjectTable, key: str, size: int, *,
+                 wview=None, off: Optional[int] = None,
+                 fd: Optional[int] = None, path: Optional[str] = None,
+                 buf: Optional[bytearray] = None, discard: bool = False):
+        self._table = table
+        self.key = key
+        self.size = size
+        self._wview = wview
+        self._off = off
+        self._fd = fd
+        self._path = path
+        self._buf = buf
+        self._discard = discard
+
+    def recv_range(self, sock: socket.socket, offset: int,
+                   length: int) -> None:
+        """Receive exactly ``length`` bytes from ``sock`` into
+        [offset, offset+length) of the landing. Thread-safe for
+        disjoint ranges."""
+        if self._wview is not None:
+            view = self._wview[offset:offset + length]
+        elif self._buf is not None:
+            view = memoryview(self._buf)[offset:offset + length]
+        else:
+            view = None
+        if view is not None:
+            received = 0
+            while received < length:
+                n = sock.recv_into(view[received:],
+                                   min(CHUNK_SIZE, length - received))
+                if n == 0:
+                    raise ConnectionError("peer closed mid-transfer")
+                received += n
+            return
+        # No writable mapping: stage through a scratch buffer, flushing
+        # to the arena (write_at) or the spill file (pwrite) per chunk.
+        scratch = bytearray(min(CHUNK_SIZE, length))
+        sview = memoryview(scratch)
+        written = 0
+        while written < length:
+            want = min(len(scratch), length - written)
+            n = sock.recv_into(sview[:want], want)
+            if n == 0:
+                raise ConnectionError("peer closed mid-transfer")
+            if self._fd is not None:
+                os.pwrite(self._fd, sview[:n], offset + written)
+            else:
+                self._table._arena.write_at(self._off + offset + written,
+                                            bytes(sview[:n]))
+            written += n
+
+    def commit(self) -> None:
+        table = self._table
+        if self._discard:
+            return  # duplicate landing: the resident payload wins
+        if self._fd is not None:
+            os.close(self._fd)
+            os.replace(self._path + ".tmp", self._path)
+            with table._lock:
+                table._sizes[self.key] = self.size
+            table._register_spill(self.key, self._path, self.size,
+                                  drop_arena=False)
+            return
+        if self._buf is not None:
+            with table._lock:
+                table._heap[self.key] = bytes(self._buf)
+                table._sizes[self.key] = self.size
+            return
+        if self._wview is not None:
+            with contextlib.suppress(BufferError):
+                self._wview.release()
+            self._wview = None
+        table._arena.seal(self.key)
+        with table._lock:
+            table._sizes[self.key] = self.size
+
+    def abort(self) -> None:
+        """Discard without publishing: abort the unsealed arena entry /
+        unlink the tmp spill file. Never raises."""
+        try:
+            if self._fd is not None:
+                with contextlib.suppress(OSError):
+                    os.close(self._fd)
+                with contextlib.suppress(OSError):
+                    os.unlink(self._path + ".tmp")
+            elif self._buf is None:
+                if self._wview is not None:
+                    with contextlib.suppress(BufferError):
+                        self._wview.release()
+                    self._wview = None
+                self._table._arena.abort(self.key)
+        except Exception:  # noqa: BLE001 - abort is best-effort cleanup
+            pass
 
 
 #: Pull priority classes (reference: pull_manager.h BundlePriority —
@@ -703,9 +885,16 @@ class PullAdmission:
 class ObjectServer:
     """Serves chunked object pulls from this node's table to peers.
 
-    Protocol (one request per connection, like one chunked gRPC stream):
-    client sends a length-prefixed key; server replies an 8-byte signed
-    size (-1 = not here), then the raw payload.
+    Protocol: client sends a length-prefixed key; server replies an
+    8-byte signed size (-1 = not here), then the raw payload. Special
+    key forms: ``?<key>`` (stat: size reply only), ``!borrow`` (switch
+    the connection to a borrow channel), and — protocol v6 — the
+    ranged-read op ``@<offset>:<length>:<key>`` replying ``length``
+    then exactly that payload slice. Ranged reads are deliberately
+    encoded as ordinary key strings: a v5 server treats one as an
+    unknown key and answers -1 with its framing intact, so a v6 puller
+    falls back to the whole-object fetch without desyncing the pooled
+    connection.
 
     The caller binds this to the SAME interface the daemon advertises to
     the head (its head-facing IP) — never unconditionally 0.0.0.0: object
@@ -758,6 +947,9 @@ class ObjectServer:
                     # records only — never materializes spilled bytes.
                     sock.sendall(_LEN.pack(self.table.stat(key[1:])))
                     continue
+                if key.startswith("@"):
+                    self._serve_ranged(sock, key)
+                    continue
                 # The pin spans the whole send: a concurrent free
                 # cannot recycle the region under us mid-transfer.
                 with self.table.pinned(key) as payload:
@@ -774,6 +966,7 @@ class ObjectServer:
                         sent += sock.send(payload[sent:sent + CHUNK_SIZE])
                 self.table._bump("served_bytes", size)
                 self.table._bump("serves")
+                builtin_metrics.record_transfer_out(size)
         except (OSError, ConnectionError, struct.error):
             pass
         finally:
@@ -781,6 +974,33 @@ class ObjectServer:
                 sock.close()
             except OSError:
                 pass
+
+    def _serve_ranged(self, sock: socket.socket, key: str) -> None:
+        """Ranged-read op (v6): ``@<offset>:<length>:<key>`` replies the
+        slice length then payload[offset:offset+length]. A request the
+        object can't satisfy (gone, or it changed size since the
+        puller's stat) answers -1 — the puller aborts its landing and
+        restarts from a fresh stat."""
+        try:
+            off_s, len_s, real = key[1:].split(":", 2)
+            offset, length = int(off_s), int(len_s)
+        except ValueError as exc:
+            raise ConnectionError(f"malformed ranged request {key!r}"
+                                  ) from exc
+        with self.table.pinned(real) as payload:
+            if payload is None or offset < 0 or length <= 0 or \
+                    offset + length > len(payload):
+                sock.sendall(_LEN.pack(-1))
+                return
+            sock.sendall(_LEN.pack(length))
+            end = offset + length
+            sent = offset
+            while sent < end:
+                sent += sock.send(
+                    payload[sent:min(sent + CHUNK_SIZE, end)])
+        self.table._bump("served_bytes", length)
+        self.table._bump("serves")
+        builtin_metrics.record_transfer_out(length)
 
     def _serve_borrow_channel(self, sock: socket.socket) -> None:
         """Channel records: '+<key>' register, '-<key>' release — both
@@ -958,28 +1178,36 @@ def stat_remote(addr: Tuple[str, int], key: str,
                 timeout: float = 10.0) -> int:
     """Owner-ward location query: payload size if resident, -1 if not.
     Never touches the head (phase-3 'directory asks the owner')."""
-    sock, reused = GLOBAL_PEER_CONNS.acquire(tuple(addr), timeout)
-    try:
-        kb = ("?" + key).encode()
-        sock.sendall(_LEN.pack(len(kb)) + kb)
-        (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    except (OSError, ConnectionError, struct.error):
+    stale_retry = True
+    while True:
+        sock = reused = None
         try:
-            sock.close()
-        except OSError:
-            pass
-        if not reused:
+            sock, reused = GLOBAL_PEER_CONNS.acquire(tuple(addr), timeout)
+            kb = ("?" + key).encode()
+            sock.sendall(_LEN.pack(len(kb)) + kb)
+            (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+            GLOBAL_PEER_CONNS.release(tuple(addr), sock)
+            return size
+        except (OSError, ConnectionError, struct.error):
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            if reused and stale_retry:
+                stale_retry = False
+                continue  # stale pooled socket: one retry on fresh TCP
             raise
-        return stat_remote(addr, key, timeout)  # stale pooled socket
-    GLOBAL_PEER_CONNS.release(tuple(addr), sock)
-    return size
 
 
 def fetch_remote_bytes(addr: Tuple[str, int], key: str,
-                       timeout: float = 30.0) -> bytes:
+                       timeout: float = 30.0) -> bytearray:
     """Pull one object's payload straight into memory (contexts without
     a local NodeObjectTable — e.g. worker subprocesses resolving a
-    borrowed ref). Raises ObjectPullError when absent/unreachable."""
+    borrowed ref). Returns a bytes-like buffer (a bytearray: the body
+    recv_into's one preallocation, skipping the bytes() copy a borrowed
+    multi-MB payload used to pay). Raises ObjectPullError when
+    absent/unreachable."""
     stale_retry = True
     while True:
         sock = reused = None
@@ -992,8 +1220,9 @@ def fetch_remote_bytes(addr: Tuple[str, int], key: str,
                 GLOBAL_PEER_CONNS.release(tuple(addr), sock)
                 raise ObjectPullError(
                     f"object {key} is not resident on {addr}")
-            data = _recv_exact(sock, size)
+            data = _recv_exact_into(sock, bytearray(size))
             GLOBAL_PEER_CONNS.release(tuple(addr), sock)
+            builtin_metrics.record_transfer_in(size)
             return data
         except ObjectPullError:
             raise
@@ -1011,14 +1240,22 @@ def fetch_remote_bytes(addr: Tuple[str, int], key: str,
                 f"{exc}") from exc
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+def _recv_exact_into(sock: socket.socket, buf: bytearray) -> bytearray:
+    """Fill ``buf`` from the socket via recv_into — no per-chunk bytes
+    objects, no growth copies."""
+    view = memoryview(buf)
+    n = len(buf)
+    read = 0
+    while read < n:
+        m = sock.recv_into(view[read:], n - read)
+        if m == 0:
             raise ConnectionError("connection closed")
-        buf.extend(chunk)
-    return bytes(buf)
+        read += m
+    return buf
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    return bytes(_recv_exact_into(sock, bytearray(n)))
 
 
 class _PeerConns:
@@ -1078,42 +1315,166 @@ class _PeerConns:
 GLOBAL_PEER_CONNS = _PeerConns()
 
 
+def _fetch_chunk(addr: Tuple[str, int], key: str, landing: _RecvLanding,
+                 offset: int, length: int, timeout: float) -> bool:
+    """One ranged read straight into the landing's [offset, offset+len)
+    slice, over a pooled socket. Returns False when the server answered
+    -1 — a v5 peer (ranged keys are unknown keys to it) or an object
+    that vanished/changed size since the stat."""
+    stale_retry = True
+    while True:
+        sock = reused = None
+        try:
+            sock, reused = GLOBAL_PEER_CONNS.acquire(addr, timeout)
+            kb = f"@{offset}:{length}:{key}".encode()
+            sock.sendall(_LEN.pack(len(kb)) + kb)
+            (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+            if n < 0:
+                GLOBAL_PEER_CONNS.release(addr, sock)
+                return False
+            if n != length:
+                raise ConnectionError(
+                    f"ranged read of {key} returned {n}, wanted {length}")
+            landing.recv_range(sock, offset, length)
+            GLOBAL_PEER_CONNS.release(addr, sock)
+            return True
+        except (OSError, ConnectionError, struct.error):
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            if reused and stale_retry:
+                stale_retry = False
+                continue  # stale pooled socket: one retry on fresh TCP
+            raise
+
+
+def _pull_chunked(addr: Tuple[str, int], key: str, table: NodeObjectTable,
+                  size: int, timeout: float, admission, priority: int
+                  ) -> bool:
+    """Chunked parallel pull: split [0, size) into pull_chunk_bytes()
+    ranges and fetch them concurrently over up to pull_parallelism()
+    pooled sockets, each chunk landing straight in its slice of the shm
+    arena (or spill file / heap buffer). Returns False when the peer
+    lacks the ranged op (v5) — the caller falls back to the whole-object
+    fetch. Admission covers the WHOLE object for its entire flight, same
+    as the monolithic path, so parallel chunks can't oversubscribe the
+    inflight-bytes budget."""
+    chunk = pull_chunk_bytes()
+    ranges = [(off, min(chunk, size - off)) for off in range(0, size, chunk)]
+    if admission is not None:
+        admission.acquire(size, priority)
+    landing = None
+    ok = False
+    try:
+        landing = table.begin_recv(key, size)
+        # Probe with the first chunk on this thread: one -1 here means a
+        # v5 peer (or a vanished object) and nothing has been spawned.
+        if not _fetch_chunk(addr, key, landing, ranges[0][0],
+                            ranges[0][1], timeout):
+            return False
+        rest = ranges[1:]
+        if rest:
+            from collections import deque
+            queue = deque(rest)
+            failed = threading.Event()
+            errors: list = []
+
+            def fetch_worker() -> None:
+                while not failed.is_set():
+                    try:
+                        off, ln = queue.popleft()
+                    except IndexError:
+                        return
+                    try:
+                        if not _fetch_chunk(addr, key, landing, off, ln,
+                                            timeout):
+                            raise ObjectPullError(
+                                f"peer {addr} dropped range {off} of "
+                                f"{key} mid-pull")
+                    except BaseException as exc:  # noqa: BLE001
+                        errors.append(exc)
+                        failed.set()
+                        return
+
+            nworkers = min(pull_parallelism(), len(rest))
+            if nworkers <= 1:
+                fetch_worker()
+            else:
+                threads = [threading.Thread(
+                    target=fetch_worker, daemon=True,
+                    name=f"ray_tpu-pull-chunk-{i}")
+                    for i in range(nworkers)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            if errors:
+                raise errors[0]
+        landing.commit()
+        ok = True
+        table._bump("pulled_bytes", size)
+        table._bump("pulls")
+        builtin_metrics.record_transfer_in(size)
+        builtin_metrics.record_pull_chunks(len(ranges))
+        return True
+    finally:
+        if not ok and landing is not None:
+            landing.abort()
+        if admission is not None:
+            admission.release(size)
+
+
 def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
                 timeout: float = 30.0, retries: int = 2,
-                priority: int = PULL_PRIORITY_GET) -> None:
+                priority: int = PULL_PRIORITY_GET,
+                size_hint: int = 0) -> None:
     """Pull one object from a peer's object server into the local table
     (read it back with ``table.pinned``). Connections are pooled and
     kept alive; a stale pooled socket retries on a fresh one without
     consuming a retry budget. Raises ObjectPullError when the owner is
     unreachable or lacks the object. In-flight bytes are bounded by the
-    table's PullAdmission (if set): the size header is read first,
-    admission is acquired for the body (args-first priority), released
-    when the body lands."""
+    table's PullAdmission (if set): the size is learned first (stat or
+    size header), admission is acquired for the body (args-first
+    priority), released when the body lands.
+
+    ``size_hint`` (callers pass the ObjectMarker size) routes payloads
+    above pull_chunk_bytes() through the chunked parallel path — one
+    authoritative stat round-trip, then concurrent ranged reads. Pulls
+    without a hint (or small ones) keep the single-socket flow with no
+    extra round-trip. A v5 peer (no ranged op) degrades to the
+    whole-object fetch once, then is remembered."""
     last: Optional[BaseException] = None
     admission = getattr(table, "admission", None)
+    addr = tuple(addr)
     attempts = 0
     while attempts <= retries:
         sock = reused = None
         try:
-            sock, reused = GLOBAL_PEER_CONNS.acquire(tuple(addr), timeout)
-            kb = key.encode()
-            sock.sendall(_LEN.pack(len(kb)) + kb)
-            (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-            if size < 0:
-                GLOBAL_PEER_CONNS.release(tuple(addr), sock)
-                raise ObjectPullError(
-                    f"object {key} is not resident on {addr} "
-                    "(freed or evicted before the pull)")
-            if admission is not None:
-                admission.acquire(size, priority)
-            try:
-                table.recv_into(key, size, sock)
-            finally:
-                if admission is not None:
-                    admission.release(size)
-            table._bump("pulled_bytes", size)
-            table._bump("pulls")
-            GLOBAL_PEER_CONNS.release(tuple(addr), sock)
+            chunk = pull_chunk_bytes()
+            if chunk > 0 and size_hint > chunk and \
+                    addr not in _ranged_unsupported:
+                size = stat_remote(addr, key, timeout)
+                if size < 0:
+                    raise ObjectPullError(
+                        f"object {key} is not resident on {addr} "
+                        "(freed or evicted before the pull)")
+                fell_back = False
+                if size > chunk:
+                    if _pull_chunked(addr, key, table, size, timeout,
+                                     admission, priority):
+                        return
+                    fell_back = True
+                # Whole-object path below; a success after a ranged
+                # refusal means the peer is v5 — skip future probes.
+                sock, reused = GLOBAL_PEER_CONNS.acquire(addr, timeout)
+                _pull_whole(addr, key, table, sock, admission, priority)
+                if fell_back:
+                    _ranged_unsupported.add(addr)
+                return
+            sock, reused = GLOBAL_PEER_CONNS.acquire(addr, timeout)
+            _pull_whole(addr, key, table, sock, admission, priority)
             return
         except ObjectPullError:
             raise
@@ -1132,3 +1493,29 @@ def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
     raise ObjectPullError(
         f"pull of {key} from {addr} failed after {retries + 1} "
         f"attempts: {last}")
+
+
+def _pull_whole(addr: Tuple[str, int], key: str, table: NodeObjectTable,
+                sock: socket.socket, admission, priority: int) -> None:
+    """The monolithic single-socket pull: size header, then the body
+    streamed into the table. The caller owns socket acquisition and
+    error handling (its stale-socket retry convention)."""
+    kb = key.encode()
+    sock.sendall(_LEN.pack(len(kb)) + kb)
+    (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if size < 0:
+        GLOBAL_PEER_CONNS.release(addr, sock)
+        raise ObjectPullError(
+            f"object {key} is not resident on {addr} "
+            "(freed or evicted before the pull)")
+    if admission is not None:
+        admission.acquire(size, priority)
+    try:
+        table.recv_into(key, size, sock)
+    finally:
+        if admission is not None:
+            admission.release(size)
+    table._bump("pulled_bytes", size)
+    table._bump("pulls")
+    builtin_metrics.record_transfer_in(size)
+    GLOBAL_PEER_CONNS.release(addr, sock)

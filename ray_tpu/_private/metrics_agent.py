@@ -84,7 +84,11 @@ class MetricsAgent:
         self.pid = os.getpid()
         self.interval_s = (export_interval_s() if interval_s is None
                            else interval_s)
-        self._collectors: List[Callable[[], None]] = []
+        # Every agent folds the hot-path fast cells before snapshotting,
+        # so built-in counters bumped via dict adds reach the registry.
+        from ray_tpu._private import builtin_metrics
+        self._collectors: List[Callable[[], None]] = [
+            builtin_metrics.flush_fast_counters]
         self._prev: Optional[List[Dict[str, Any]]] = None
         self._span_cursor = 0
         self._ticks = 0

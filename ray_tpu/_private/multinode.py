@@ -45,6 +45,7 @@ import socket
 import struct
 import threading
 import traceback
+from time import monotonic as _monotonic
 from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu._private import procinfo
@@ -109,6 +110,24 @@ def _dumps(obj: Any) -> bytes:
 def _loads(data: bytes) -> Any:
     from ray_tpu._private import serialization
     return serialization.deserialize(data)
+
+
+def _dumps_parts(obj: Any) -> list:
+    """Serialize into bytes-like parts (serialization.serialize_parts):
+    big array payloads keep their data buffers as views so the object
+    table can lay them into the arena with one memcpy."""
+    from ray_tpu._private import serialization
+    return serialization.serialize_parts(obj)
+
+
+def _parts_size(parts: list) -> int:
+    return sum(len(p) for p in parts)
+
+
+def _join_parts(parts: list) -> bytes:
+    if len(parts) == 1 and isinstance(parts[0], bytes):
+        return parts[0]
+    return b"".join(bytes(p) for p in parts)
 
 
 def _encode_frame(msg: dict) -> bytes:
@@ -338,6 +357,9 @@ class NodeConnection:
         self.health_sock: Optional[socket.socket] = None
         import time
         self.registered_at = time.monotonic()
+        # Updated by recv_loop on every inbound frame batch; the head's
+        # health sweep reads it as proof of life when pings time out.
+        self.last_frame_at = self.registered_at
         # Chaos injection (reference: RAY_testing_* fault flags): each
         # request fails with this probability — exercised by the chaos
         # tests to prove retries survive a flaky control plane.
@@ -435,6 +457,12 @@ class NodeConnection:
         try:
             while True:
                 replies = _decode_frames(_recv_frame(self._sock))
+                # Liveness evidence for the health sweep: a node whose
+                # data channel is actively delivering frames is alive no
+                # matter how starved its ping thread is (GB-scale
+                # transfers on an oversubscribed host can stall the
+                # health channel long past the miss threshold).
+                self.last_frame_at = _monotonic()
                 for reply in replies:
                     kind = reply.get("type")
                     if kind in ("log_batch", "metrics_batch"):
@@ -952,6 +980,17 @@ class HeadServer:
                         self.syncer.apply(node_id.hex(), sync)
                     misses[node_id] = 0
                 except (OSError, ConnectionError, TimeoutError):
+                    # A timed-out ping on a node whose DATA channel
+                    # delivered a frame within the timeout window is a
+                    # starved health thread, not a dead node (GB-scale
+                    # transfers on oversubscribed hosts do this). Falsely
+                    # declaring death here cancels in-flight tasks and
+                    # triggers object reconstruction — far worse than a
+                    # late detection.
+                    if time.monotonic() - conn.last_frame_at \
+                            < self._hb_timeout:
+                        misses[node_id] = 0
+                        continue
                     misses[node_id] = misses.get(node_id, 0) + 1
                     if misses[node_id] >= self._hb_threshold:
                         logger.warning(
@@ -1459,9 +1498,15 @@ class NodeDaemon:
         # stay here — in the shm arena when available — until freed;
         # peer daemons pull them directly over the object server (which
         # binds lazily in run(), on the head-facing interface).
+        from ray_tpu._private import dataplane
         from ray_tpu._private.dataplane import (NodeObjectTable,
                                                 PullAdmission)
         from ray_tpu._private.ray_config import make_ray_config
+        _cfg = make_ray_config(None)
+        # Pull tuning travels through RayConfig so the flag pipeline
+        # (env > system config > defaults) governs the data plane too.
+        dataplane.configure_pulls(int(_cfg.pull_chunk_bytes),
+                                  int(_cfg.pull_parallelism))
         # Disk spill keeps memory pressure from ever LOSING a block
         # (reference: raylet spill/restore, local_object_manager.h).
         # Directory precedence: explicit arg > the object_spilling_
@@ -1469,8 +1514,7 @@ class NodeDaemon:
         # a user pointing spill at NVMe scratch gets BOTH stores there)
         # > a per-daemon dir under the system temp dir.
         if spill_dir is None:
-            spill_dir = make_ray_config(None).object_spilling_directory \
-                or None
+            spill_dir = _cfg.object_spilling_directory or None
         if spill_dir is None:
             import tempfile
             spill_dir = os.path.join(
@@ -1496,7 +1540,7 @@ class NodeDaemon:
         # Pull admission control (reference: pull_manager.h:52): bounds
         # bytes in flight into this node, task args first.
         self._table.admission = PullAdmission(
-            int(make_ray_config(None).pull_manager_max_inflight_bytes))
+            int(_cfg.pull_manager_max_inflight_bytes))
         self._object_server = None
         import uuid as _uuid
         self._uid = _uuid.uuid4().hex[:8]
@@ -1688,18 +1732,19 @@ class NodeDaemon:
         if num_returns > 1 and store_limit and \
                 isinstance(result, (tuple, list)) and \
                 len(result) == num_returns:
-            payloads = [_dumps(element) for element in result]
-            if sum(map(len, payloads)) > store_limit:
+            element_parts = [_dumps_parts(element) for element in result]
+            sizes = [_parts_size(pp) for pp in element_parts]
+            if sum(sizes) > store_limit:
                 parts = []
-                for i, payload in enumerate(payloads):
-                    if len(payload) > store_limit:
+                for i, (pp, size) in enumerate(zip(element_parts, sizes)):
+                    if size > store_limit:
                         key = (f"obj-{self._uid}-s{self._session_n}-"
                                f"{req_id}-r{i}")
-                        self._table.put(key, payload)
+                        self._table.put_parts(key, pp, size=size)
                         parts.append({"stored_key": key,
-                                      "size": len(payload)})
+                                      "size": size})
                     else:
-                        parts.append({"value": payload})
+                        parts.append({"value": _join_parts(pp)})
                 self._send_reply(
                     sock, {"req_id": req_id, "ok": True, "parts": parts},
                     nbytes=sum(len(p.get("value") or b"")
@@ -1707,19 +1752,20 @@ class NodeDaemon:
                 return
             # Small total: the plain inline reply below is cheaper than
             # per-element bookkeeping head-side.
-        payload = _dumps(result)
-        if store_limit and len(payload) > store_limit:
+        result_parts = _dumps_parts(result)
+        size = _parts_size(result_parts)
+        if store_limit and size > store_limit:
             # Globally unique key: peer daemons cache pulled copies under
             # the same name, so it must not collide across nodes.
             key = f"obj-{self._uid}-s{self._session_n}-{req_id}"
-            self._table.put(key, payload)
+            self._table.put_parts(key, result_parts, size=size)
             self._send_reply(sock, {"req_id": req_id, "ok": True,
                                     "stored_key": key,
-                                    "size": len(payload)})
+                                    "size": size})
         else:
             self._send_reply(sock, {"req_id": req_id, "ok": True,
-                                    "value": payload},
-                             nbytes=len(payload))
+                                    "value": _join_parts(result_parts)},
+                             nbytes=size)
 
     def _resolve_markers(self, args, kwargs):
         from ray_tpu._private.dataplane import (PULL_PRIORITY_TASK_ARGS,
@@ -1740,7 +1786,8 @@ class NodeDaemon:
                 # Direct peer pull — the head never sees these bytes
                 # (reference: ObjectManager node-to-node chunked pull).
                 pull_object(tuple(owner), a.key, self._table,
-                            priority=PULL_PRIORITY_TASK_ARGS)
+                            priority=PULL_PRIORITY_TASK_ARGS,
+                            size_hint=getattr(a, "size", 0) or 0)
                 with self._table.pinned(a.key) as payload:
                     if payload is None:  # evicted immediately (pressure)
                         raise ObjectPullError(
@@ -1798,7 +1845,8 @@ class NodeDaemon:
                 owner = getattr(a, "owner_addr", None)
                 if owner is not None and a.key not in missing and \
                         not self._table.contains(a.key):
-                    missing[a.key] = tuple(owner)
+                    missing[a.key] = (tuple(owner),
+                                      getattr(a, "size", 0) or 0)
         if len(missing) < 2:
             return  # a single pull gains nothing from the pool
         pool = self._prefetch_pool
@@ -1814,8 +1862,8 @@ class NodeDaemon:
                     self._prefetch_pool = pool
         futures = [
             pool.submit(pull_object, owner, key, self._table,
-                        priority=PULL_PRIORITY_TASK_ARGS)
-            for key, owner in missing.items()]
+                        priority=PULL_PRIORITY_TASK_ARGS, size_hint=size)
+            for key, (owner, size) in missing.items()]
         for f in futures:
             f.exception()  # wait; failures re-raise in resolve()
 
@@ -1857,7 +1905,8 @@ class NodeDaemon:
                             f"object payload {a.key} is not resident on "
                             "this node (already freed?)")
                     pull_object(tuple(owner), a.key, self._table,
-                                priority=PULL_PRIORITY_TASK_ARGS)
+                                priority=PULL_PRIORITY_TASK_ARGS,
+                                size_hint=getattr(a, "size", 0) or 0)
                 arena = self._table._arena
                 if arena is not None:
                     if _pin_in_arena(arena, a.key):

@@ -159,11 +159,60 @@ class NativeObjectStore:
 
     # -- chunked writes (node-to-node pulls stream straight into shm) ----
 
-    def create(self, object_id: str, size: int) -> Optional[int]:
-        """Reserve an unsealed allocation; returns its arena offset (None
-        on failure/duplicate). Complete with write_at + seal."""
+    def put_parts(self, object_id: str, parts, size: Optional[int] = None
+                  ) -> bool:
+        """Lay a sequence of bytes-like parts down contiguously as one
+        sealed object — the OOB serialization path: header + raw array
+        buffers land with one memcpy each, never joined into an
+        intermediate full-payload bytes object."""
+        if size is None:
+            size = sum(len(p) for p in parts)
         off = self._lib.shm_store_create(self._handle, object_id.encode(),
                                          size)
+        if off == -2:
+            return True  # already stored (idempotent puts)
+        if off < 0:
+            return False
+        wview = self.writable_view(off, size)
+        try:
+            pos = 0
+            if wview is not None:
+                for p in parts:
+                    n = len(p)
+                    wview[pos:pos + n] = p
+                    pos += n
+            else:
+                for p in parts:
+                    chunk = bytes(p)
+                    self._lib.shm_store_write(self._handle, off + pos,
+                                              chunk, len(chunk))
+                    pos += len(chunk)
+        except BaseException:
+            self.abort(object_id)
+            raise
+        finally:
+            if wview is not None:
+                try:
+                    wview.release()
+                except BufferError:
+                    pass
+        self._lib.shm_store_seal(self._handle, object_id.encode())
+        return True
+
+    #: create() result when the key is already stored. Distinct from
+    #: None (no room): a duplicate put is an idempotent no-op, while a
+    #: full arena means the caller should spill and retry.
+    DUPLICATE = "duplicate"
+
+    def create(self, object_id: str, size: int):
+        """Reserve an unsealed allocation; returns its arena offset,
+        ``NativeObjectStore.DUPLICATE`` when the key already exists
+        (idempotent re-put — do NOT write), or None when there is no
+        room. Complete with write_at + seal."""
+        off = self._lib.shm_store_create(self._handle, object_id.encode(),
+                                         size)
+        if off == -2:
+            return self.DUPLICATE
         if off < 0:
             return None
         return off

@@ -405,9 +405,9 @@ class ObjectStore:
             needs_restore = (entry.spilled_path is not None
                              and value is None)
         if needs_restore:
-            builtin_metrics.object_store_misses().inc()
+            builtin_metrics.record_store_miss()
         else:
-            builtin_metrics.object_store_hits().inc()
+            builtin_metrics.record_store_hit()
         if needs_restore:
             value = self._restore(entry, object_id)
             if value is None:

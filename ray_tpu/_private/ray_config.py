@@ -26,6 +26,8 @@ _PY_DEFAULTS: Dict[str, Any] = {
     "worker_lease_enabled": True,
     "max_tasks_in_flight_per_worker": 10,
     "pull_manager_max_inflight_bytes": 268435456,
+    "pull_chunk_bytes": 4194304,
+    "pull_parallelism": 4,
     "worker_prestart_count": 1,
     "worker_cap_multiplier": 8,
     "worker_cap_min": 64,

@@ -35,7 +35,7 @@ import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from ray_tpu._private import serialization
+from ray_tpu._private import builtin_metrics, serialization
 from ray_tpu._private.cluster_scheduler import (ClusterResourceScheduler,
                                                 make_cluster_scheduler)
 from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,
@@ -3230,16 +3230,13 @@ class Runtime:
     # ------------------------------------------------------------------
 
     def _record_event(self, spec: TaskSpec, status: str) -> None:
-        import time as _time
-
-        from ray_tpu._private import builtin_metrics
         builtin_metrics.record_task_event(status)
         if len(self._task_events) < self._cfg_max_task_events:
             self._task_events.append({
                 "task_id": spec.task_id.hex(),
                 "name": spec.name,
                 "status": status,
-                "time": _time.time(),
+                "time": time.time(),
             })
         # State transitions fan out on the pubsub hub (reference:
         # TaskEventBuffer flush → GcsTaskManager → subscribers).

@@ -10,11 +10,36 @@ C++ store) restores zero-copy.
 
 from __future__ import annotations
 
-import io
+import os
 import pickle
+import struct
 from typing import Any, List, Tuple
 
 import cloudpickle
+
+# Out-of-band frame: pickle-5 header plus its raw buffers laid down
+# contiguously, so a large numpy/JAX array is written into the store
+# with ONE memcpy of the data instead of pickle's full-payload copy.
+#
+#   magic b"\x0bOB1" | >I nbufs | >Q header_len | nbufs x >Q buffer_len
+#   | pickle header | buffers, each preceded by zero padding to the
+#   next 64-byte offset (so restored arrays stay cache-line aligned).
+#
+# The first magic byte 0x0b is not a valid first pickle opcode frame
+# byte (protocol-2+ pickles start with 0x80), so ``deserialize`` can
+# sniff the format from the payload alone — every existing call site
+# keeps working whether the writer framed OOB or not.
+_OOB_MAGIC = b"\x0bOB1"
+_OOB_HEAD = struct.Struct(">IQ")
+_OOB_LEN = struct.Struct(">Q")
+_OOB_ALIGN = 64
+
+
+def _oob_min_bytes() -> int:
+    try:
+        return int(os.environ.get("RAY_TPU_OOB_MIN_BYTES", "65536"))
+    except ValueError:
+        return 65536
 
 
 class Serializer:
@@ -29,10 +54,67 @@ class Serializer:
             value, protocol=5, buffer_callback=buffers.append)
         return payload, buffers
 
-    def deserialize(self, payload: bytes, buffers=None) -> Any:
+    def serialize_parts(self, value: Any) -> List[Any]:
+        """Serialize into a list of bytes-like parts whose concatenation
+        is the stored payload. Values carrying big pickle-5 buffers
+        (numpy/JAX arrays) come back as an OOB frame — meta + header +
+        the raw buffer views, uncopied — so the store can lay them down
+        with a single data memcpy. Everything else (or small buffers,
+        or non-contiguous ones) degrades to ``[serialize(value)]``."""
+        try:
+            header, buffers = self.serialize_oob(value)
+        except Exception:
+            return [self.serialize(value)]
+        if not buffers:
+            return [header]
+        try:
+            raws = [b.raw() for b in buffers]
+        except BufferError:
+            # Non-contiguous buffer (e.g. a sliced array): plain pickle.
+            return [self.serialize(value)]
+        total = sum(r.nbytes for r in raws)
+        if total < _oob_min_bytes():
+            return [self.serialize(value)]
+        meta = bytearray(_OOB_MAGIC)
+        meta += _OOB_HEAD.pack(len(raws), len(header))
+        for r in raws:
+            meta += _OOB_LEN.pack(r.nbytes)
+        parts: List[Any] = [bytes(meta), header]
+        pos = len(meta) + len(header)
+        for r in raws:
+            pad = (-pos) % _OOB_ALIGN
+            if pad:
+                parts.append(b"\x00" * pad)
+                pos += pad
+            parts.append(r)
+            pos += r.nbytes
+        return parts
+
+    def deserialize(self, payload, buffers=None) -> Any:
         if buffers:
             return pickle.loads(payload, buffers=buffers)
+        if (len(payload) >= len(_OOB_MAGIC)
+                and bytes(payload[:len(_OOB_MAGIC)]) == _OOB_MAGIC):
+            return self._deserialize_oob(memoryview(payload))
         return pickle.loads(payload)
+
+    def _deserialize_oob(self, mv: memoryview) -> Any:
+        off = len(_OOB_MAGIC)
+        nbufs, hlen = _OOB_HEAD.unpack_from(mv, off)
+        off += _OOB_HEAD.size
+        lens = [_OOB_LEN.unpack_from(mv, off + i * _OOB_LEN.size)[0]
+                for i in range(nbufs)]
+        off += nbufs * _OOB_LEN.size
+        header = bytes(mv[off:off + hlen])
+        off += hlen
+        bufs: List[bytes] = []
+        for ln in lens:
+            off += (-off) % _OOB_ALIGN
+            # Copy out of the (possibly pinned/mmap'd) view: restored
+            # arrays must outlive the store entry they were read from.
+            bufs.append(bytes(mv[off:off + ln]))
+            off += ln
+        return pickle.loads(header, buffers=bufs)
 
 
 _serializer = Serializer()
@@ -42,7 +124,11 @@ def serialize(value: Any) -> bytes:
     return _serializer.serialize(value)
 
 
-def deserialize(payload: bytes) -> Any:
+def serialize_parts(value: Any) -> List[Any]:
+    return _serializer.serialize_parts(value)
+
+
+def deserialize(payload) -> Any:
     return _serializer.deserialize(payload)
 
 

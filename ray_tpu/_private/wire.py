@@ -31,7 +31,12 @@ from typing import Any, Dict, Tuple
 #: would reject the unknown type in validate_message.
 #: v5: metrics_batch frames (worker/daemon -> head metrics + span
 #: export) — a v4 head would reject the unknown type.
-PROTOCOL_VERSION = 5
+#: v6: data-plane ranged-read op — the object server accepts
+#: "@{offset}:{length}:{key}" requests so pullers fetch large objects
+#: as parallel chunks. Encoded as an ordinary key lookup, so a v5
+#: server replies -1 (unknown key) with framing intact and a v6 puller
+#: degrades to the whole-object fetch; control schemas are unchanged.
+PROTOCOL_VERSION = 6
 
 
 class WireSchemaError(ValueError):

@@ -471,7 +471,11 @@ class WorkerProcessPool:
         key = python_exe or ""
         if container:
             key += f"|container:{container.get('image')}"
-        lease_start = time.monotonic()
+        # The common case — an idle worker is parked — must not pay two
+        # monotonic reads plus a locked histogram observe per lease: the
+        # clock starts only once the request actually waits, spawns, or
+        # evicts; an immediate hit records a plain int add.
+        lease_start: Optional[float] = None
         while True:
             evict = None
             with self._lock:
@@ -488,6 +492,8 @@ class WorkerProcessPool:
                             self._all.remove(w)
                     if self._closed:
                         raise WorkerCrashedError("worker pool is shut down")
+                    if lease_start is None:
+                        lease_start = time.monotonic()
                     if len([w for w in self._all if not w.dead]) \
                             < self.max_workers:
                         break
@@ -523,10 +529,14 @@ class WorkerProcessPool:
             w.stop()
             raise WorkerCrashedError("worker pool is shut down")
 
-    def _leased(self, w: WorkerHandle, lease_start: float) -> WorkerHandle:
+    def _leased(self, w: WorkerHandle,
+                lease_start: Optional[float]) -> WorkerHandle:
         w.metrics_sink = self.metrics_sink
-        builtin_metrics.worker_lease_wait().observe(
-            time.monotonic() - lease_start)
+        if lease_start is None:
+            builtin_metrics.record_lease_immediate()
+        else:
+            builtin_metrics.worker_lease_wait().observe(
+                time.monotonic() - lease_start)
         return w
 
     def record_metrics(self) -> None:
@@ -825,34 +835,38 @@ class _WorkerMain:
         each become an independent arena entry). Arena-full or shape
         mismatch falls back to the inline path (the daemon's table.put
         can spill to disk)."""
+        from ray_tpu._private import serialization
         arena_limit = msg.get("arena_limit", 0)
         num_returns = msg.get("num_returns", 1)
         arena = self._get_arena() if arena_limit else None
         if arena is None:
             return {"ok": True, "value": _dumps(value)}
         import uuid as _uuid
+
+        def _one(el) -> dict:
+            # serialize_parts keeps big array buffers as raw views: an
+            # arena-bound result is laid down header+buffers in one
+            # allocation with a single data memcpy (no full-payload
+            # pickle copy on this end).
+            pp = serialization.serialize_parts(el)
+            size = sum(len(p) for p in pp)
+            if size > arena_limit:
+                key = f"wres-{_uuid.uuid4().hex}"
+                if arena.put_parts(key, pp, size=size):
+                    return {"arena_key": key, "size": size}
+            if len(pp) == 1 and isinstance(pp[0], bytes):
+                return {"value": pp[0]}
+            return {"value": b"".join(bytes(p) for p in pp)}
+
         if num_returns > 1:
             if not isinstance(value, (tuple, list)) or \
                     len(value) != num_returns:
                 # Wrong shape: the daemon's mismatch path describes it.
                 return {"ok": True, "value": _dumps(value)}
-            parts = []
-            for el in value:
-                p = _dumps(el)
-                if len(p) > arena_limit:
-                    key = f"wres-{_uuid.uuid4().hex}"
-                    if arena.put_bytes(key, p):
-                        parts.append({"arena_key": key, "size": len(p)})
-                        continue
-                parts.append({"value": p})
-            return {"ok": True, "parts": parts}
-        payload = _dumps(value)
-        if len(payload) > arena_limit:
-            key = f"wres-{_uuid.uuid4().hex}"
-            if arena.put_bytes(key, payload):
-                return {"ok": True, "arena_key": key,
-                        "size": len(payload)}
-        return {"ok": True, "value": payload}
+            return {"ok": True, "parts": [_one(el) for el in value]}
+        reply = _one(value)
+        reply["ok"] = True
+        return reply
 
     def serve(self) -> None:
         from ray_tpu._private.multinode import (_dumps, _loads, _recv_frame,

@@ -40,6 +40,10 @@ _DEFAULT_PANELS = [
      "ops"),
     ("Data-plane pulled bytes / s",
      "rate(ray_tpu_dataplane_pulled_bytes_total[1m])", "Bps"),
+    ("Object transfer bytes / s (by direction)",
+     "sum by (direction) (rate(ray_tpu_object_transfer_bytes_total[1m]))",
+     "Bps"),
+    ("Pull chunks / s", "rate(ray_tpu_pull_chunks_total[1m])", "ops"),
 ]
 
 

@@ -72,6 +72,11 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     // Pull admission control (reference: pull_manager.h:52): bound on
     // bytes simultaneously in flight into one node's object table.
     FLAG_INT(pull_manager_max_inflight_bytes, 268435456),
+    // Chunked parallel pulls: objects above pull_chunk_bytes are
+    // fetched as concurrent ranged reads over up to pull_parallelism
+    // pooled sockets per peer (0 chunk bytes disables chunking).
+    FLAG_INT(pull_chunk_bytes, 4194304),
+    FLAG_INT(pull_parallelism, 4),
     FLAG_INT(worker_prestart_count, 1),
     FLAG_INT(worker_cap_multiplier, 8),
     FLAG_INT(worker_cap_min, 64),

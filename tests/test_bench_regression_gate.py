@@ -1,0 +1,83 @@
+"""bench.py regression comparator: pure-function unit tests (the gate
+behind ``bench.py --check-regressions``)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_BENCH_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "bench.py")
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location("_bench_gate", _BENCH_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_bench_gate"] = mod
+    try:
+        spec.loader.exec_module(mod)
+        yield mod
+    finally:
+        sys.modules.pop("_bench_gate", None)
+
+
+def _prev(extra=None, value=None):
+    return {"value": value, "extra": extra or {}}
+
+
+def test_drop_beyond_threshold_flagged(bench):
+    prev = _prev({"tasks_per_sec": 1000.0})
+    got = bench.compare_rounds(prev, {"tasks_per_sec": 700.0}, None,
+                               threshold=0.20)
+    assert len(got) == 1
+    assert got[0]["metric"] == "tasks_per_sec"
+    assert got[0]["prev"] == 1000.0
+    assert got[0]["now"] == 700.0
+    assert got[0]["drop_pct"] == 30.0
+
+
+def test_drop_within_threshold_passes(bench):
+    prev = _prev({"tasks_per_sec": 1000.0})
+    assert bench.compare_rounds(prev, {"tasks_per_sec": 850.0}, None,
+                                threshold=0.20) == []
+    # ... but the same drop fails a tighter gate.
+    assert len(bench.compare_rounds(prev, {"tasks_per_sec": 850.0}, None,
+                                    threshold=0.10)) == 1
+
+
+def test_improvement_ignored(bench):
+    prev = _prev({"shuffle_mb_per_sec": 100.0}, value=50.0)
+    got = bench.compare_rounds(prev, {"shuffle_mb_per_sec": 400.0}, 60.0,
+                               threshold=0.10)
+    assert got == []
+
+
+def test_only_throughput_suffixes_compared(bench):
+    prev = _prev({
+        "detached_actor_restart_ms": 10.0,   # latency: lower is better
+        "run_unix_time": 1e9,
+        "gpt410m_mfu": 0.5,
+    })
+    extra = {"detached_actor_restart_ms": 500.0, "run_unix_time": 1.0,
+             "gpt410m_mfu": 0.1}
+    got = bench.compare_rounds(prev, extra, None, threshold=0.10)
+    assert [r["metric"] for r in got] == ["gpt410m_mfu"]
+
+
+def test_headline_compared(bench):
+    prev = _prev({}, value=100.0)
+    got = bench.compare_rounds(prev, {}, 70.0, threshold=0.20)
+    assert [r["metric"] for r in got] == ["headline"]
+    assert bench.compare_rounds(prev, {}, 85.0, threshold=0.20) == []
+
+
+def test_missing_prev_or_values_ignored(bench):
+    assert bench.compare_rounds(None, {"tasks_per_sec": 1.0}, 1.0) == []
+    assert bench.compare_rounds({}, {"tasks_per_sec": 1.0}, 1.0) == []
+    # prev metric absent from the current run: not a regression.
+    prev = _prev({"tasks_per_sec": 1000.0, "serve_qps": None}, value=None)
+    assert bench.compare_rounds(prev, {}, None, threshold=0.10) == []
+    # non-numeric current value (a recorded failure) is skipped too.
+    assert bench.compare_rounds(prev, {"tasks_per_sec": None}, None) == []

@@ -1,0 +1,249 @@
+"""Chunked parallel pulls (protocol v6 ranged reads): byte-identical
+landings, mid-chunk peer death, admission bounds, and the v5
+whole-object fallback (reference: ObjectManager chunked transfer,
+object_manager.proto + pull_manager.h)."""
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from ray_tpu._private import builtin_metrics, dataplane
+from ray_tpu._private.dataplane import (NodeObjectTable, ObjectPullError,
+                                        ObjectServer, PullAdmission,
+                                        pull_object)
+
+_LEN = struct.Struct(">q")
+
+
+@pytest.fixture
+def small_chunks(monkeypatch):
+    """Chunk at 64 KB with 4 sockets so modest payloads exercise the
+    multi-chunk machinery."""
+    monkeypatch.setenv("RAY_TPU_PULL_CHUNK_BYTES", str(64 * 1024))
+    monkeypatch.setenv("RAY_TPU_PULL_PARALLELISM", "4")
+
+
+def _patterned(n: int) -> bytes:
+    # Position-dependent bytes: any chunk landing at the wrong offset
+    # (or dropped) changes the payload, unlike a constant fill.
+    return bytes((i * 31 + (i >> 8)) & 0xFF for i in range(n))
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ConnectionError("closed")
+        buf += part
+    return buf
+
+
+def test_chunked_pull_lands_byte_identical(small_chunks):
+    src = NodeObjectTable()
+    server = ObjectServer(src, host="127.0.0.1")
+    try:
+        payload = _patterned(1 << 20)  # 16 chunks at 64 KB
+        src.put("big", payload)
+        dst = NodeObjectTable()
+        chunks_before = builtin_metrics._fast_chunks["n"]
+        pull_object(("127.0.0.1", server.port), "big", dst,
+                    size_hint=len(payload))
+        with dst.pinned("big") as got:
+            assert got is not None
+            assert bytes(got) == payload
+        # The transfer really went through the ranged op, not one recv.
+        assert builtin_metrics._fast_chunks["n"] - chunks_before == 16
+    finally:
+        server.close()
+
+
+def test_small_and_hintless_pulls_stay_whole(small_chunks):
+    """Below the chunk threshold (or without a size hint) the pull is
+    the classic single-request fetch — no extra stat round-trip."""
+    src = NodeObjectTable()
+    server = ObjectServer(src, host="127.0.0.1")
+    try:
+        src.put("small", b"x" * 1024)
+        src.put("nohint", _patterned(1 << 20))
+        dst = NodeObjectTable()
+        chunks_before = builtin_metrics._fast_chunks["n"]
+        pull_object(("127.0.0.1", server.port), "small", dst,
+                    size_hint=1024)
+        pull_object(("127.0.0.1", server.port), "nohint", dst)
+        with dst.pinned("small") as got:
+            assert bytes(got) == b"x" * 1024
+        with dst.pinned("nohint") as got:
+            assert bytes(got) == _patterned(1 << 20)
+        assert builtin_metrics._fast_chunks["n"] == chunks_before
+    finally:
+        server.close()
+
+
+class _FlakyRangedServer:
+    """Speaks the object-server framing but dies halfway through every
+    ranged body: stats answer correctly, ``@`` requests reply the full
+    length then close after half the bytes."""
+
+    def __init__(self, payload: bytes):
+        self.payload = payload
+        self._listener = socket.create_server(("127.0.0.1", 0))
+        self.port = self._listener.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(sock,),
+                             daemon=True).start()
+
+    def _serve(self, sock):
+        try:
+            while True:
+                (klen,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+                key = _recv_exact(sock, klen).decode()
+                if key.startswith("?"):
+                    sock.sendall(_LEN.pack(len(self.payload)))
+                elif key.startswith("@"):
+                    _, length, _ = key[1:].split(":", 2)
+                    length = int(length)
+                    sock.sendall(_LEN.pack(length)
+                                 + self.payload[:length // 2])
+                    return  # half the body, then the peer "dies"
+                else:
+                    sock.sendall(_LEN.pack(len(self.payload))
+                                 + self.payload)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            sock.close()
+
+    def close(self):
+        self._listener.close()
+
+
+def test_peer_death_mid_chunk_raises_and_leaves_no_entry(small_chunks):
+    flaky = _FlakyRangedServer(_patterned(256 * 1024))
+    try:
+        dst = NodeObjectTable()
+        with pytest.raises(ObjectPullError):
+            pull_object(("127.0.0.1", flaky.port), "vic", dst,
+                        retries=0, size_hint=256 * 1024)
+        # No half-written landing may ever become visible.
+        assert not dst.contains("vic")
+        with dst.pinned("vic") as got:
+            assert got is None
+    finally:
+        flaky.close()
+
+
+def test_admission_bounds_concurrent_chunked_pulls(small_chunks):
+    """Two concurrent chunked pulls against a budget of exactly one
+    object: admission is taken for the WHOLE object, so parallel chunks
+    can never stack both bodies in flight."""
+    src = NodeObjectTable()
+    server = ObjectServer(src, host="127.0.0.1")
+    try:
+        size = 1 << 20
+        for key in ("a", "b"):
+            src.put(key, _patterned(size))
+        dst = NodeObjectTable()
+        dst.admission = PullAdmission(size)
+        errs = []
+
+        def pull_one(key):
+            try:
+                pull_object(("127.0.0.1", server.port), key, dst,
+                            size_hint=size)
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=pull_one, args=(k,),
+                                    daemon=True) for k in ("a", "b")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errs, errs
+        for key in ("a", "b"):
+            with dst.pinned(key) as got:
+                assert bytes(got) == _patterned(size)
+        assert dst.admission.stats["peak_inflight"] <= size, \
+            dst.admission.stats
+        assert dst.admission.stats["admitted"] == 2
+    finally:
+        server.close()
+
+
+class _LegacyV5Server:
+    """A pre-v6 object server: whole-object lookups and ``?`` stats
+    only. A ranged ``@...`` request is just an unknown key -> -1, with
+    framing intact (exactly how a real v5 peer behaves)."""
+
+    def __init__(self, objects):
+        self.objects = objects
+        self.ranged_refusals = 0
+        self._listener = socket.create_server(("127.0.0.1", 0))
+        self.port = self._listener.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while True:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(sock,),
+                             daemon=True).start()
+
+    def _serve(self, sock):
+        try:
+            while True:
+                (klen,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+                key = _recv_exact(sock, klen).decode()
+                if key.startswith("?"):
+                    obj = self.objects.get(key[1:])
+                    sock.sendall(_LEN.pack(-1 if obj is None else len(obj)))
+                    continue
+                obj = self.objects.get(key)
+                if obj is None:
+                    if key.startswith("@"):
+                        self.ranged_refusals += 1
+                    sock.sendall(_LEN.pack(-1))
+                    continue
+                sock.sendall(_LEN.pack(len(obj)) + obj)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            sock.close()
+
+    def close(self):
+        self._listener.close()
+
+
+def test_v5_peer_falls_back_to_whole_object(small_chunks):
+    payload = _patterned(512 * 1024)
+    legacy = _LegacyV5Server({"old": payload})
+    addr = ("127.0.0.1", None)
+    try:
+        addr = ("127.0.0.1", legacy.port)
+        dst = NodeObjectTable()
+        pull_object(addr, "old", dst, size_hint=len(payload))
+        with dst.pinned("old") as got:
+            assert bytes(got) == payload
+        assert legacy.ranged_refusals == 1
+        # The peer is remembered as pre-v6: later big pulls skip the probe.
+        assert addr in dataplane._ranged_unsupported
+        dst2 = NodeObjectTable()
+        pull_object(addr, "old", dst2, size_hint=len(payload))
+        with dst2.pinned("old") as got:
+            assert bytes(got) == payload
+        assert legacy.ranged_refusals == 1  # no second probe
+    finally:
+        dataplane._ranged_unsupported.discard(addr)
+        legacy.close()
