@@ -558,6 +558,61 @@ def bench_detached_restart() -> dict:
     return out
 
 
+def bench_channel_reconnect() -> dict:
+    """Session-channel self-healing latency: chaos closes the head->
+    daemon socket mid-stream and the metric is faulted submit -> result
+    of the same task, i.e. break detection + daemon re-dial + resume
+    handshake + ring replay. Bounds the stall a transient network blip
+    adds to in-flight work (vs. the node death + task retry it used to
+    cost)."""
+    import json as _json
+    import subprocess
+    import sys
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu._private import chaos
+
+    out = {}
+    ray_tpu.init(num_cpus=1)
+    procs = []
+    try:
+        host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.multinode",
+             "--address", f"127.0.0.1:{port}", "--num-cpus", "2",
+             "--resources", _json.dumps({"chan": 1})],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("chan", 0) >= 1:
+                break
+            _time.sleep(0.1)
+        else:
+            raise TimeoutError("daemon never registered")
+
+        @ray_tpu.remote(resources={"chan": 1})
+        def ping(x):
+            return x
+
+        # Warm the lease/worker path so the faulted sample only measures
+        # the channel recovery, not worker spawn.
+        assert ray_tpu.get(ping.remote(0), timeout=60) == 0
+
+        chaos.configure("sock_close:site=head.send:times=1")
+        try:
+            t0 = _time.perf_counter()
+            assert ray_tpu.get(ping.remote(1), timeout=120) == 1
+            out["channel_reconnect_ms"] = round(
+                (_time.perf_counter() - t0) * 1e3, 1)
+        finally:
+            chaos.reset()
+    finally:
+        _stop_procs(procs)
+        ray_tpu.shutdown()
+    return out
+
+
 def bench_serve() -> dict:
     """Serving-plane throughput/latency (reference: release/serve_tests
     autoscaling_single_deployment + single_deployment_1k_noop_replica):
@@ -1216,6 +1271,8 @@ def main(argv=None):
         ("envelope", "envelope_tasks_per_sec", bench_envelope),
         ("detached_restart", "detached_actor_restart_ms",
          bench_detached_restart),
+        ("channel_reconnect", "channel_reconnect_ms",
+         bench_channel_reconnect),
         ("log_stream", "log_lines_per_sec", bench_log_streaming),
         ("metrics_overhead", "metrics_overhead_pct",
          bench_metrics_overhead),

@@ -248,3 +248,34 @@ def log_lines_dropped() -> Counter:
     return Counter(
         "ray_tpu_log_monitor_lines_dropped_total",
         "Log lines dropped by backpressure (publish returned False).")
+
+
+# -- channel resilience ----------------------------------------------------
+# Rare-path events (a reconnect is news, not load): plain lazy
+# accessors, no fast cells. Incremented from channel.py attach/send
+# paths and the dataplane's pooled-socket retry classification.
+
+
+def channel_reconnects() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_channel_reconnects_total",
+        "Successful session-channel resumes (socket re-dialed and "
+        "re-attached without node death).")
+
+
+def channel_frames_resent() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_channel_frames_resent_total",
+        "Unacked frames replayed from the resend ring after a channel "
+        "resume.")
+
+
+def channel_send_retries() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_channel_send_retries_total",
+        "Transient transport errors classified as retryable (channel "
+        "send breaks, stale pooled-socket retries) instead of "
+        "escalating to node death or pull failure.")

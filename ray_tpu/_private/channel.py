@@ -1,0 +1,348 @@
+"""Resilient session channel: acked frames, resend ring, reconnect.
+
+A :class:`ResilientChannel` wraps one TCP socket of a head<->daemon
+session. Every outbound frame is wrapped in a wire-protocol v7 seq
+envelope (``wire.wrap_seq``) carrying a monotonic per-session sequence
+number plus a cumulative ack of the highest inbound sequence seen, and
+is held in a bounded resend ring until the peer acks it. Acks piggyback
+on regular traffic; a pure ack (seq 0) is emitted from the receive path
+after ``ACK_EVERY`` unacked inbound frames so one-directional streams
+still prune the peer's ring.
+
+When a send or recv hits a transient transport error the channel closes
+the socket, flips to ``broken``, and raises :class:`ChannelBroken`; the
+frame that failed is already in the ring. The daemon side then re-dials
+the head with backoff+jitter and a ``resume`` handshake inside
+``RAY_TPU_CHANNEL_RECONNECT_WINDOW_S``; both sides :meth:`attach` the
+fresh socket and replay only the frames past the peer's last-seen
+sequence. Receivers drop ``seq <= in_seq`` duplicates, giving
+exactly-once delivery in order. Node death fires only after the window
+is exhausted (:meth:`wait_recovered` closes the channel) or the daemon
+is confirmed gone via the health channel.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import random
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from ray_tpu._private import chaos
+from ray_tpu._private import wire as _wire
+
+logger = logging.getLogger(__name__)
+
+# Emit a pure ack after this many unacked inbound frames (keeps the
+# peer's resend ring pruned under one-directional traffic).
+ACK_EVERY = 32
+
+_LEN = struct.Struct(">Q")
+_MAX_FRAME = 1 << 34
+
+
+class ChannelBroken(ConnectionError):
+    """Transient transport failure; unacked frames are preserved in the
+    resend ring and replayed by the next :meth:`ResilientChannel.attach`."""
+
+
+class ChannelClosed(ConnectionError):
+    """Channel permanently closed; no recovery will happen."""
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Classify an exception from a socket op as a transient transport
+    error (worth a reconnect/retry) rather than a programming error."""
+    return isinstance(exc, (OSError, struct.error, EOFError))
+
+
+class Backoff:
+    """Exponential backoff with jitter (anti-thundering-herd).
+
+    ``next()`` returns a delay drawn uniformly from [base/2, base],
+    with base doubling from ``initial`` up to ``cap``. Pass a seeded
+    ``rng`` for deterministic tests.
+    """
+
+    def __init__(self, initial: float = 0.2, cap: float = 2.0, rng=None):
+        self._initial = float(initial)
+        self._cap = float(cap)
+        self._rng = rng if rng is not None else random.Random()
+        self._attempt = 0
+
+    def next(self) -> float:
+        base = min(self._cap, self._initial * (2.0 ** self._attempt))
+        self._attempt += 1
+        return base * (0.5 + 0.5 * self._rng.random())
+
+    def sleep(self) -> float:
+        delay = self.next()
+        time.sleep(delay)
+        return delay
+
+    def reset(self) -> None:
+        self._attempt = 0
+
+
+def close_socket(sock) -> None:
+    """shutdown+close, quietly (shutdown pops any blocked reader)."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed connection")
+        got += r
+    return bytes(buf)
+
+
+def recv_raw_frame(sock) -> bytes:
+    """Read one length-prefixed frame (same framing as multinode)."""
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if length > _MAX_FRAME:
+        raise ConnectionError(f"oversized frame: {length} bytes")
+    return _recv_exact(sock, length)
+
+
+class _ResendRing:
+    """Bounded byte-budget ring of unacked outbound frames.
+
+    Overflow evicts oldest-first and records the eviction point; a
+    resume from a peer that had not acked past it is refused (the
+    channel can no longer replay losslessly → node death, exactly the
+    pre-channel behaviour)."""
+
+    def __init__(self, cap_bytes: int):
+        self._frames: collections.deque = collections.deque()
+        self._bytes = 0
+        self.cap_bytes = int(cap_bytes)
+        self.evicted_to = 0
+
+    def append(self, seq: int, payload: bytes) -> None:
+        self._frames.append((seq, payload))
+        self._bytes += len(payload)
+        # Keep at least the newest frame even if it alone beats the
+        # budget, so a single oversized frame can still be replayed.
+        while self._bytes > self.cap_bytes and len(self._frames) > 1:
+            old_seq, old_payload = self._frames.popleft()
+            self._bytes -= len(old_payload)
+            self.evicted_to = old_seq
+
+    def prune(self, acked_seq: int) -> None:
+        while self._frames and self._frames[0][0] <= acked_seq:
+            _, payload = self._frames.popleft()
+            self._bytes -= len(payload)
+
+    def can_resume_from(self, peer_last_seq: int) -> bool:
+        return peer_last_seq >= self.evicted_to
+
+    def frames_after(self, peer_last_seq: int) -> List[Tuple[int, bytes]]:
+        return [(s, p) for s, p in self._frames if s > peer_last_seq]
+
+    def __len__(self) -> int:
+        return len(self._frames)
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+
+class ResilientChannel:
+    """One side of a resumable head<->daemon session channel."""
+
+    def __init__(self, sock, *, site: str, ring_bytes: int,
+                 window_s: float):
+        self._cv = threading.Condition(threading.Lock())
+        self._sock = sock
+        self._site = site
+        self._ring = _ResendRing(ring_bytes)
+        self.window_s = float(window_s)
+        self.out_seq = 0
+        self.in_seq = 0
+        self._acked_in = 0
+        self.broken = False
+        self.closed = False
+        self.broken_at: Optional[float] = None
+        self.generation = 0
+        self.reconnects = 0
+
+    # ------------------------------------------------------------- send
+    def send_frame(self, payload) -> None:
+        """Ring-then-send: the frame is sequenced and ring-buffered
+        before the socket write, so a failed write (ChannelBroken) is
+        still replayed by the next attach — callers never resend."""
+        payload = bytes(payload)
+        with self._cv:
+            if self.closed:
+                raise ChannelClosed("channel closed")
+            self.out_seq += 1
+            seq = self.out_seq
+            self._ring.append(seq, payload)
+            if self.broken:
+                raise ChannelBroken("channel broken (frame held for replay)")
+            self._write_locked(seq, payload)
+
+    def _write_locked(self, seq: int, payload: bytes) -> None:
+        sock = self._sock
+        wrapped = _wire.wrap_seq(seq, self.in_seq, payload)
+        self._acked_in = self.in_seq
+        try:
+            if chaos.ACTIVE:
+                chaos.maybe_inject(self._site + ".send", sock)
+            sock.sendall(_LEN.pack(len(wrapped)) + wrapped)
+        except Exception as exc:
+            if not is_transient(exc):
+                raise
+            self._mark_broken_locked(sock, exc)
+            self._count("channel_send_retries")
+            raise ChannelBroken(f"send failed: {exc}") from exc
+
+    # ------------------------------------------------------------- recv
+    def recv_frame(self) -> bytes:
+        """Return the next inbound payload, transparently consuming pure
+        acks and dropping replayed duplicates (exactly-once)."""
+        while True:
+            with self._cv:
+                if self.closed:
+                    raise ChannelClosed("channel closed")
+                if self.broken:
+                    raise ChannelBroken("channel broken")
+                sock = self._sock
+                gen = self.generation
+            try:
+                if chaos.ACTIVE:
+                    chaos.maybe_inject(self._site + ".recv", sock)
+                raw = recv_raw_frame(sock)
+            except Exception as exc:
+                if not is_transient(exc):
+                    raise
+                with self._cv:
+                    if self.closed:
+                        raise ChannelClosed("channel closed") from exc
+                    if gen != self.generation:
+                        continue  # re-attached under us: read the new sock
+                    self._mark_broken_locked(sock, exc)
+                raise ChannelBroken(f"recv failed: {exc}") from exc
+            unwrapped = _wire.unwrap_seq(raw)
+            if unwrapped is None:
+                return raw  # raw handshake frame: pass through
+            seq, ack, inner = unwrapped
+            with self._cv:
+                self._ring.prune(ack)
+                if seq == 0:
+                    continue  # pure ack
+                if seq <= self.in_seq:
+                    continue  # duplicate from a replay
+                self.in_seq = seq
+                if (self.in_seq - self._acked_in >= ACK_EVERY
+                        and not self.broken and not self.closed):
+                    try:
+                        self._write_locked(0, b"")
+                    except ChannelBroken:
+                        pass  # deliver this frame; next recv reports it
+            return inner
+
+    # ------------------------------------------------------- transitions
+    def _mark_broken_locked(self, sock, exc=None) -> None:
+        if self.closed or self.broken or sock is not self._sock:
+            return
+        self.broken = True
+        self.broken_at = time.monotonic()
+        close_socket(sock)
+        self._cv.notify_all()
+        logger.info("channel[%s] broken: %s", self._site, exc)
+
+    def wait_recovered(self) -> bool:
+        """Park until the channel is re-attached (True) or closed /
+        window exhausted (False). Exhaustion closes the channel."""
+        with self._cv:
+            while True:
+                if self.closed:
+                    return False
+                if not self.broken:
+                    return True
+                deadline = ((self.broken_at or time.monotonic())
+                            + self.window_s)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    logger.warning(
+                        "channel[%s] reconnect window (%.1fs) exhausted",
+                        self._site, self.window_s)
+                    self._close_locked()
+                    return False
+                self._cv.wait(min(remaining, 0.5))
+
+    def attach(self, sock, peer_last_seq: int) -> bool:
+        """Adopt a fresh socket after a resume handshake, replaying
+        unacked frames past ``peer_last_seq``. False if the ring can no
+        longer replay losslessly or the channel is closed."""
+        peer_last_seq = int(peer_last_seq)
+        with self._cv:
+            if self.closed:
+                return False
+            self._ring.prune(peer_last_seq)
+            if not self._ring.can_resume_from(peer_last_seq):
+                logger.warning(
+                    "channel[%s] resume refused: ring evicted past peer "
+                    "seq %d", self._site, peer_last_seq)
+                return False
+            old, self._sock = self._sock, sock
+            self.generation += 1
+            self.broken = False
+            self.broken_at = None
+            self.reconnects += 1
+            replay = self._ring.frames_after(peer_last_seq)
+            self._count("channel_reconnects")
+            if replay:
+                self._count("channel_frames_resent", len(replay))
+            self._cv.notify_all()
+            if old is not sock:
+                close_socket(old)
+            logger.info("channel[%s] resumed (gen %d, %d frame(s) replayed)",
+                        self._site, self.generation, len(replay))
+            try:
+                for seq, payload in replay:
+                    self._write_locked(seq, payload)
+            except ChannelBroken:
+                pass  # broke again mid-replay; the next attach retries
+            return True
+
+    def close(self) -> None:
+        with self._cv:
+            self._close_locked()
+
+    def _close_locked(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        close_socket(self._sock)
+        self._cv.notify_all()
+
+    # ---------------------------------------------------------- helpers
+    def unacked(self) -> int:
+        with self._cv:
+            return len(self._ring)
+
+    @staticmethod
+    def _count(name: str, n: int = 1) -> None:
+        try:
+            from ray_tpu._private import builtin_metrics
+            getattr(builtin_metrics, name)().inc(n)
+        except Exception:  # metrics must never break transport recovery
+            pass

@@ -1,0 +1,160 @@
+"""Deterministic fault injection for transport paths.
+
+Enabled via the ``RAY_TPU_CHAOS`` environment variable (inherited by
+daemon subprocesses) or programmatically via :func:`configure`::
+
+    RAY_TPU_CHAOS="send_oserror:p=0.05:seed=7"
+    RAY_TPU_CHAOS="sock_close:site=head.send:after=5:times=1;delay_ms:ms=20"
+
+Spec grammar: ops separated by ``;``; each op is ``KIND[:k=v...]``.
+
+Kinds
+    send_oserror   raise an OSError from a ``*.send`` site
+    recv_oserror   raise an OSError from a ``*.recv`` site
+    sock_close     shutdown+close the socket at the site, then raise
+    delay_ms       sleep ``ms`` milliseconds at the site
+
+Params
+    p      firing probability per matching call (default 1.0)
+    seed   per-op RNG seed — same seed, same call sequence, same fires
+    site   substring filter on the injection-site name
+    after  skip the first N matching calls
+    times  fire at most N times (0 = unlimited)
+    ms     sleep duration for delay_ms (default 10)
+
+Sites: ``head.send`` / ``head.recv`` (head side of a session channel),
+``daemon.send`` / ``daemon.recv`` (daemon side), ``pull.send``
+(dataplane pooled pull sockets).
+
+Hot paths guard on the module-level :data:`ACTIVE` flag, so with chaos
+disabled the per-frame cost is a single attribute read and no call.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import socket
+import threading
+import time
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+ACTIVE = False
+
+_LOCK = threading.Lock()
+_OPS: List["_Op"] = []
+_DEFAULT_SEED = 0xC4A05
+_KINDS = ("send_oserror", "recv_oserror", "sock_close", "delay_ms")
+
+
+class ChaosError(OSError):
+    """Injected transport failure (distinguishable from real ones)."""
+
+
+class _Op:
+    __slots__ = ("kind", "p", "site", "after", "times", "ms", "rng",
+                 "seen", "fired")
+
+    def __init__(self, kind: str, params: dict):
+        self.kind = kind
+        self.p = float(params.get("p", 1.0))
+        self.site = params.get("site", "")
+        self.after = int(params.get("after", 0))
+        self.times = int(params.get("times", 0))
+        self.ms = float(params.get("ms", 10.0))
+        self.rng = random.Random(int(params.get("seed", _DEFAULT_SEED)))
+        self.seen = 0
+        self.fired = 0
+
+
+def configure(spec: Optional[str]) -> List[_Op]:
+    """Parse a chaos spec string, replacing any previous configuration.
+
+    An empty/None spec disables injection entirely.
+    """
+    global ACTIVE
+    ops = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        kind = fields[0].strip()
+        if kind not in _KINDS:
+            raise ValueError(
+                f"unknown chaos op {kind!r} (expected one of {_KINDS})")
+        params = {}
+        for field in fields[1:]:
+            key, _, value = field.partition("=")
+            params[key.strip()] = value.strip()
+        ops.append(_Op(kind, params))
+    with _LOCK:
+        _OPS[:] = ops
+        ACTIVE = bool(ops)
+    return list(ops)
+
+
+def reset() -> None:
+    """Disable injection and drop all configured ops."""
+    configure("")
+
+
+def stats() -> List[dict]:
+    """Per-op match/fire counters (for asserting a fault really fired)."""
+    with _LOCK:
+        return [{"kind": op.kind, "site": op.site, "seen": op.seen,
+                 "fired": op.fired} for op in _OPS]
+
+
+def maybe_inject(site: str, sock=None) -> None:
+    """Evaluate the active ops at an injection site.
+
+    May sleep, close ``sock``, or raise :class:`ChaosError`. Callers
+    must guard with ``if chaos.ACTIVE:`` to keep disabled-path cost at
+    one attribute read.
+    """
+    fire = None
+    with _LOCK:
+        for op in _OPS:
+            if op.site and op.site not in site:
+                continue
+            if op.kind == "send_oserror" and ".send" not in site:
+                continue
+            if op.kind == "recv_oserror" and ".recv" not in site:
+                continue
+            op.seen += 1
+            if op.seen <= op.after:
+                continue
+            if op.times and op.fired >= op.times:
+                continue
+            if op.p < 1.0 and op.rng.random() >= op.p:
+                continue
+            op.fired += 1
+            fire = op
+            break
+    if fire is None:
+        return
+    if fire.kind == "delay_ms":
+        time.sleep(fire.ms / 1000.0)
+        return
+    if fire.kind == "sock_close" and sock is not None:
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+    raise ChaosError(f"chaos[{fire.kind}] injected at {site}")
+
+
+_env_spec = os.environ.get("RAY_TPU_CHAOS", "")
+if _env_spec:
+    try:
+        configure(_env_spec)
+    except ValueError:
+        logger.warning("ignoring malformed RAY_TPU_CHAOS spec %r", _env_spec)

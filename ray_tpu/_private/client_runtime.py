@@ -53,6 +53,7 @@ from ray_tpu._private import wire as _wire
 from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID,
                                   PlacementGroupID, TaskID)
 from ray_tpu._private.multinode import (_dumps, _loads, _recv_frame,
+                                        _send_frame_best_effort,
                                         _send_frame)
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.task_spec import TaskKind
@@ -160,10 +161,8 @@ class ClientConnection:
         """Fire-and-forget (req_id 0: the session handles it inline and
         never replies)."""
         msg["req_id"] = 0
-        try:
-            _send_frame(self._sock, _dumps(msg), self._send_lock)
-        except OSError:
-            pass  # connection gone; session death drops the pins anyway
+        # connection gone => session death drops the pins anyway
+        _send_frame_best_effort(self._sock, _dumps(msg), self._send_lock)
 
     def close(self) -> None:
         with self._lock:
@@ -831,10 +830,8 @@ class ClientSession:
                     f"{type(exc).__name__}: {exc}"),
                     traceback.format_exc()))
             reply = {"req_id": req_id, "ok": False, "error": payload}
-        try:
-            _send_frame(self._sock, _dumps(reply), self._send_lock)
-        except OSError:
-            pass  # client gone; close() runs from the serve loop
+        # client gone => close() runs from the serve loop
+        _send_frame_best_effort(self._sock, _dumps(reply), self._send_lock)
 
     def _dispatch(self, msg: dict) -> dict:
         # Schema check BEFORE dispatch (wire.py CLIENT_SCHEMAS): a

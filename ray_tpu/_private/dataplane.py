@@ -36,6 +36,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from ray_tpu._private import builtin_metrics
+from ray_tpu._private import chaos
 
 logger = logging.getLogger(__name__)
 
@@ -1174,30 +1175,54 @@ class BorrowChannels:
 GLOBAL_BORROWS = BorrowChannels()
 
 
-def stat_remote(addr: Tuple[str, int], key: str,
-                timeout: float = 10.0) -> int:
-    """Owner-ward location query: payload size if resident, -1 if not.
-    Never touches the head (phase-3 'directory asks the owner')."""
+def _pooled_rpc(addr: Tuple[str, int], timeout: float, op):
+    """Run ``op(sock)`` over a pooled peer socket with the shared
+    transient-error classification (channel.is_transient): one free
+    retry on a fresh connection when a REUSED pooled socket turns out
+    stale (peer closed it since release), chaos injection at the
+    ``pull.send`` site, socket hygiene on failure. ``op`` releases the
+    socket back to the pool itself on success — only it knows whether
+    the protocol exchange completed cleanly."""
+    from ray_tpu._private.channel import is_transient
+    addr = tuple(addr)
     stale_retry = True
     while True:
-        sock = reused = None
+        sock = None
+        reused = False
         try:
-            sock, reused = GLOBAL_PEER_CONNS.acquire(tuple(addr), timeout)
-            kb = ("?" + key).encode()
-            sock.sendall(_LEN.pack(len(kb)) + kb)
-            (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-            GLOBAL_PEER_CONNS.release(tuple(addr), sock)
-            return size
-        except (OSError, ConnectionError, struct.error):
+            sock, reused = GLOBAL_PEER_CONNS.acquire(addr, timeout)
+            if chaos.ACTIVE:
+                chaos.maybe_inject("pull.send", sock)
+            return op(sock)
+        except ObjectPullError:
+            raise  # protocol-level miss, not a transport failure
+        except BaseException as exc:
             if sock is not None:
                 try:
                     sock.close()
                 except OSError:
                     pass
-            if reused and stale_retry:
+            if is_transient(exc) and reused and stale_retry:
                 stale_retry = False
+                builtin_metrics.channel_send_retries().inc()
                 continue  # stale pooled socket: one retry on fresh TCP
             raise
+
+
+def stat_remote(addr: Tuple[str, int], key: str,
+                timeout: float = 10.0) -> int:
+    """Owner-ward location query: payload size if resident, -1 if not.
+    Never touches the head (phase-3 'directory asks the owner')."""
+    addr = tuple(addr)
+
+    def op(sock):
+        kb = ("?" + key).encode()
+        sock.sendall(_LEN.pack(len(kb)) + kb)
+        (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+        GLOBAL_PEER_CONNS.release(addr, sock)
+        return size
+
+    return _pooled_rpc(addr, timeout, op)
 
 
 def fetch_remote_bytes(addr: Tuple[str, int], key: str,
@@ -1208,36 +1233,29 @@ def fetch_remote_bytes(addr: Tuple[str, int], key: str,
     recv_into's one preallocation, skipping the bytes() copy a borrowed
     multi-MB payload used to pay). Raises ObjectPullError when
     absent/unreachable."""
-    stale_retry = True
-    while True:
-        sock = reused = None
-        try:
-            sock, reused = GLOBAL_PEER_CONNS.acquire(tuple(addr), timeout)
-            kb = key.encode()
-            sock.sendall(_LEN.pack(len(kb)) + kb)
-            (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-            if size < 0:
-                GLOBAL_PEER_CONNS.release(tuple(addr), sock)
-                raise ObjectPullError(
-                    f"object {key} is not resident on {addr}")
-            data = _recv_exact_into(sock, bytearray(size))
-            GLOBAL_PEER_CONNS.release(tuple(addr), sock)
-            builtin_metrics.record_transfer_in(size)
-            return data
-        except ObjectPullError:
-            raise
-        except (OSError, ConnectionError, struct.error) as exc:
-            if sock is not None:
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-            if reused and stale_retry:
-                stale_retry = False
-                continue
+    addr = tuple(addr)
+
+    def op(sock):
+        kb = key.encode()
+        sock.sendall(_LEN.pack(len(kb)) + kb)
+        (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+        if size < 0:
+            GLOBAL_PEER_CONNS.release(addr, sock)
             raise ObjectPullError(
-                f"direct fetch of {key} from {addr} failed: "
-                f"{exc}") from exc
+                f"object {key} is not resident on {addr}")
+        data = _recv_exact_into(sock, bytearray(size))
+        GLOBAL_PEER_CONNS.release(addr, sock)
+        builtin_metrics.record_transfer_in(size)
+        return data
+
+    try:
+        return _pooled_rpc(addr, timeout, op)
+    except ObjectPullError:
+        raise
+    except (OSError, ConnectionError, struct.error) as exc:
+        raise ObjectPullError(
+            f"direct fetch of {key} from {addr} failed: "
+            f"{exc}") from exc
 
 
 def _recv_exact_into(sock: socket.socket, buf: bytearray) -> bytearray:
@@ -1321,33 +1339,21 @@ def _fetch_chunk(addr: Tuple[str, int], key: str, landing: _RecvLanding,
     slice, over a pooled socket. Returns False when the server answered
     -1 — a v5 peer (ranged keys are unknown keys to it) or an object
     that vanished/changed size since the stat."""
-    stale_retry = True
-    while True:
-        sock = reused = None
-        try:
-            sock, reused = GLOBAL_PEER_CONNS.acquire(addr, timeout)
-            kb = f"@{offset}:{length}:{key}".encode()
-            sock.sendall(_LEN.pack(len(kb)) + kb)
-            (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-            if n < 0:
-                GLOBAL_PEER_CONNS.release(addr, sock)
-                return False
-            if n != length:
-                raise ConnectionError(
-                    f"ranged read of {key} returned {n}, wanted {length}")
-            landing.recv_range(sock, offset, length)
+    def op(sock):
+        kb = f"@{offset}:{length}:{key}".encode()
+        sock.sendall(_LEN.pack(len(kb)) + kb)
+        (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+        if n < 0:
             GLOBAL_PEER_CONNS.release(addr, sock)
-            return True
-        except (OSError, ConnectionError, struct.error):
-            if sock is not None:
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-            if reused and stale_retry:
-                stale_retry = False
-                continue  # stale pooled socket: one retry on fresh TCP
-            raise
+            return False
+        if n != length:
+            raise ConnectionError(
+                f"ranged read of {key} returned {n}, wanted {length}")
+        landing.recv_range(sock, offset, length)
+        GLOBAL_PEER_CONNS.release(addr, sock)
+        return True
+
+    return _pooled_rpc(addr, timeout, op)
 
 
 def _pull_chunked(addr: Tuple[str, int], key: str, table: NodeObjectTable,
@@ -1445,10 +1451,12 @@ def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
     without a hint (or small ones) keep the single-socket flow with no
     extra round-trip. A v5 peer (no ranged op) degrades to the
     whole-object fetch once, then is remembered."""
+    from ray_tpu._private.channel import Backoff
     last: Optional[BaseException] = None
     admission = getattr(table, "admission", None)
     addr = tuple(addr)
     attempts = 0
+    bo = Backoff(0.2, 2.0)
     while attempts <= retries:
         sock = reused = None
         try:
@@ -1469,11 +1477,15 @@ def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
                 # Whole-object path below; a success after a ranged
                 # refusal means the peer is v5 — skip future probes.
                 sock, reused = GLOBAL_PEER_CONNS.acquire(addr, timeout)
+                if chaos.ACTIVE:
+                    chaos.maybe_inject("pull.send", sock)
                 _pull_whole(addr, key, table, sock, admission, priority)
                 if fell_back:
                     _ranged_unsupported.add(addr)
                 return
             sock, reused = GLOBAL_PEER_CONNS.acquire(addr, timeout)
+            if chaos.ACTIVE:
+                chaos.maybe_inject("pull.send", sock)
             _pull_whole(addr, key, table, sock, admission, priority)
             return
         except ObjectPullError:
@@ -1485,11 +1497,11 @@ def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
                 except OSError:
                     pass
             last = exc
+            builtin_metrics.channel_send_retries().inc()
             if reused:
                 continue  # stale pooled socket: free retry on fresh TCP
             attempts += 1
-            import time
-            time.sleep(0.2)
+            bo.sleep()  # jittered: concurrent pullers spread out
     raise ObjectPullError(
         f"pull of {key} from {addr} failed after {retries + 1} "
         f"attempts: {last}")

@@ -78,6 +78,20 @@ def _send_frame(sock: socket.socket, payload: bytes,
         sock.sendall(data)
 
 
+def _send_frame_best_effort(sock: socket.socket, payload: bytes,
+                            lock: Optional[threading.Lock] = None) -> bool:
+    """Send a frame whose loss is acceptable (rejection notices,
+    fire-and-forget teardown messages to possibly-dead peers). Returns
+    False instead of raising on transport failure. Frames that must
+    arrive go through a ResilientChannel / _CoalescingSender instead —
+    the log lint bans ad-hoc OSError suppression around _send_frame."""
+    try:
+        _send_frame(sock, payload, lock)
+        return True
+    except OSError:
+        return False
+
+
 def _close_quiet(sock: socket.socket) -> None:
     try:
         sock.close()
@@ -188,9 +202,11 @@ class _CoalescingSender:
     MAX_BATCH_BYTES = 1 << 20  # cumulative payload cap per batch
     QUEUE_CAP_BYTES = 64 << 20  # backpressure: block senders past this
 
-    def __init__(self, sock: socket.socket, batch_type: str,
+    def __init__(self, transport, batch_type: str,
                  on_fail=None, name: str = "sender"):
-        self._sock = sock
+        if isinstance(transport, socket.socket):
+            transport = _SocketTransport(transport)
+        self._transport = transport
         self._batch_type = batch_type
         self._on_fail = on_fail
         from collections import deque
@@ -252,6 +268,7 @@ class _CoalescingSender:
         return batch
 
     def _run(self) -> None:
+        from ray_tpu._private.channel import ChannelBroken
         while True:
             with self._cv:
                 while not self._dq and not self._closed:
@@ -263,45 +280,84 @@ class _CoalescingSender:
                 self._cv.notify_all()  # backpressured senders re-check
             try:
                 if len(batch) == 1:
-                    _send_frame(self._sock, _encode_frame(batch[0]))
+                    self._transport.send_frame(_encode_frame(batch[0]))
                 else:
                     # Binary batch: each message encodes ONCE (typed or
                     # pickle), then the parts concatenate — no second
                     # pickling of the accumulated payload bytes.
-                    _send_frame(self._sock, _wire.encode_batch(
+                    self._transport.send_frame(_wire.encode_batch(
                         [_encode_frame(m) for m in batch]))
+            except ChannelBroken:
+                # The frame already sits in the channel's resend ring
+                # and is replayed by the resume attach; park until the
+                # channel recovers. Only a closed channel / exhausted
+                # reconnect window escalates to on_fail (node death).
+                self._done_sending()
+                if self._transport.wait_recovered():
+                    continue
+                self._fail()
+                return
             except OSError:
                 self._done_sending()
-                self.close()
-                if self._on_fail is not None:
-                    try:
-                        self._on_fail()
-                    except Exception:  # noqa: BLE001 - teardown
-                        logger.exception("sender failure handler")
+                self._fail()
                 return
             except Exception:  # noqa: BLE001 - one poisoned msg must
                 # not kill the connection: retry each solo, drop the
                 # one that cannot serialize.
-                for msg in batch:
-                    try:
-                        _send_frame(self._sock, _encode_frame(msg))
-                    except OSError:
-                        self._done_sending()
-                        self.close()
-                        if self._on_fail is not None:
-                            with contextlib.suppress(Exception):
-                                self._on_fail()
-                        return
-                    except Exception:
-                        logger.exception(
-                            "dropping unserializable control frame %s",
-                            msg.get("type"))
+                if not self._send_solo(batch):
+                    return
             self._done_sending()
+
+    def _send_solo(self, batch) -> bool:
+        from ray_tpu._private.channel import ChannelBroken
+        for msg in batch:
+            try:
+                self._transport.send_frame(_encode_frame(msg))
+            except ChannelBroken:
+                if self._transport.wait_recovered():
+                    continue  # ringed frame replays on resume
+                self._done_sending()
+                self._fail()
+                return False
+            except OSError:
+                self._done_sending()
+                self._fail()
+                return False
+            except Exception:
+                logger.exception(
+                    "dropping unserializable control frame %s",
+                    msg.get("type"))
+        return True
+
+    def _fail(self) -> None:
+        self.close()
+        if self._on_fail is not None:
+            try:
+                self._on_fail()
+            except Exception:  # noqa: BLE001 - teardown
+                logger.exception("sender failure handler")
 
     def _done_sending(self) -> None:
         with self._cv:
             self._sending = False
             self._cv.notify_all()
+
+
+class _SocketTransport:
+    """Raw-socket transport for :class:`_CoalescingSender` users whose
+    channels do not resume (client sessions, worker IPC)."""
+
+    __slots__ = ("_sock", "_lock")
+
+    def __init__(self, sock: socket.socket, lock=None):
+        self._sock = sock
+        self._lock = lock
+
+    def send_frame(self, payload: bytes) -> None:
+        _send_frame(self._sock, payload, self._lock)
+
+    def wait_recovered(self) -> bool:
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -330,8 +386,23 @@ class NodeConnection:
     def __init__(self, sock: socket.socket, address: Tuple[str, int],
                  resources: Dict[str, float], labels: Optional[dict],
                  object_addr: Optional[Tuple[str, int]] = None,
-                 store_name: Optional[str] = None):
+                 store_name: Optional[str] = None,
+                 reconnect_window_s: float = 30.0,
+                 resend_ring_bytes: int = 64 << 20):
+        from ray_tpu._private.channel import ResilientChannel
         self._sock = sock
+        # Resilient session channel: all post-handshake traffic (both
+        # directions) flows through it; a transient socket failure
+        # parks senders until the daemon re-dials and resumes instead
+        # of cascading into remove_node.
+        self.channel = ResilientChannel(
+            sock, site="head", ring_bytes=resend_ring_bytes,
+            window_s=reconnect_window_s)
+        import uuid
+        # Capability for the resume handshake: the daemon must present
+        # it to re-attach, so a stray/imposter dial cannot hijack a
+        # session.
+        self.channel_token = uuid.uuid4().hex
         self.address = address
         self.resources = resources
         self.labels = labels or {}
@@ -381,7 +452,7 @@ class NodeConnection:
         # Single-writer coalescing sender: every outbound frame for this
         # daemon goes through it (task submits batch under load).
         self._sender = _CoalescingSender(
-            sock, "task_batch", on_fail=self.close,
+            self.channel, "task_batch", on_fail=self.close,
             name=f"send-{address[1]}")
 
     # -- plumbing --------------------------------------------------------
@@ -454,9 +525,22 @@ class NodeConnection:
         Callback-mode completions are handed to this connection's
         drainer thread so a slow continuation (deserialize + store +
         dispatch) never stalls the reply stream."""
+        from ray_tpu._private.channel import ChannelBroken, ChannelClosed
         try:
             while True:
-                replies = _decode_frames(_recv_frame(self._sock))
+                try:
+                    raw = self.channel.recv_frame()
+                except ChannelBroken:
+                    # Transient transport failure: the daemon re-dials
+                    # and resumes within the reconnect window. Node
+                    # death fires only when the window closes (or the
+                    # health sweep confirms the process is gone).
+                    if self.channel.wait_recovered():
+                        continue
+                    break
+                except ChannelClosed:
+                    break
+                replies = _decode_frames(raw)
                 # Liveness evidence for the health sweep: a node whose
                 # data channel is actively delivering frames is alive no
                 # matter how starved its ping thread is (GB-scale
@@ -567,10 +651,7 @@ class NodeConnection:
                 self._dispatch_completion(waiter.callback, waiter.reply)
             else:
                 waiter.event.set()
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self.channel.close()  # wakes parked senders/receivers, closes sock
         if self.health_sock is not None:
             try:
                 self.health_sock.close()
@@ -980,6 +1061,19 @@ class HeadServer:
                         self.syncer.apply(node_id.hex(), sync)
                     misses[node_id] = 0
                 except (OSError, ConnectionError, TimeoutError):
+                    if conn.channel.broken:
+                        # Session channel broken AND the dedicated
+                        # health channel cannot reach the daemon: the
+                        # process is gone. Don't burn the rest of the
+                        # reconnect window waiting for a resume that
+                        # can never come.
+                        logger.warning(
+                            "Node %s: broken session channel and failed "
+                            "health ping; declaring it dead",
+                            node_id.hex()[:12])
+                        misses.pop(node_id, None)
+                        conn.close()  # → on_death → remove_node
+                        continue
                     # A timed-out ping on a node whose DATA channel
                     # delivered a frame within the timeout window is a
                     # starved health thread, not a dead node (GB-scale
@@ -1038,11 +1132,10 @@ class HeadServer:
                         f"client runtime at {addr}")
                 except _wire.ProtocolMismatch as exc:
                     logger.error("rejecting client runtime: %s", exc)
-                    with contextlib.suppress(OSError):
-                        _send_frame(sock, _dumps({
-                            "type": "register_rejected",
-                            "error": str(exc),
-                            "head_protocol": _wire.PROTOCOL_VERSION}))
+                    _send_frame_best_effort(sock, _dumps({
+                        "type": "register_rejected",
+                        "error": str(exc),
+                        "head_protocol": _wire.PROTOCOL_VERSION}))
                     sock.close()
                     return
                 from ray_tpu._private.client_runtime import ClientSession
@@ -1066,6 +1159,9 @@ class HeadServer:
                 GLOBAL.record("head.client_session",
                               _time.monotonic() - _t0)
                 return
+            if register.get("type") == "resume":
+                self._handle_resume(sock, addr, register, _t0)
+                return
             if register.get("type") == "health_channel":
                 # Second connection from an already-registered daemon,
                 # reserved for liveness pings. (Snapshot: recv/health
@@ -1088,18 +1184,21 @@ class HeadServer:
                                           f"node daemon at {addr}")
             except _wire.ProtocolMismatch as exc:
                 logger.error("rejecting daemon registration: %s", exc)
-                with contextlib.suppress(OSError):
-                    _send_frame(sock, _dumps({
-                        "type": "register_rejected",
-                        "error": str(exc),
-                        "head_protocol": _wire.PROTOCOL_VERSION}))
+                _send_frame_best_effort(sock, _dumps({
+                    "type": "register_rejected",
+                    "error": str(exc),
+                    "head_protocol": _wire.PROTOCOL_VERSION}))
                 sock.close()
                 return
-            conn = NodeConnection(sock, tuple(addr),
-                                  register["resources"],
-                                  register.get("labels"),
-                                  object_addr=register.get("object_addr"),
-                                  store_name=register.get("store_name"))
+            cfg = self.runtime.config
+            conn = NodeConnection(
+                sock, tuple(addr),
+                register["resources"],
+                register.get("labels"),
+                object_addr=register.get("object_addr"),
+                store_name=register.get("store_name"),
+                reconnect_window_s=float(cfg.channel_reconnect_window_s),
+                resend_ring_bytes=int(cfg.channel_resend_ring_bytes))
             conn.rpc_failure_pct = int(
                 self.runtime.config.testing_rpc_failure_pct)
             # Registration makes the node schedulable, which can
@@ -1116,7 +1215,8 @@ class HeadServer:
             # daemon can join the session's log directory tree.
             conn._sender.send({"type": "registered",
                                "node_id": node_id.hex(),
-                               "session_id": self.runtime.session_id})
+                               "session_id": self.runtime.session_id,
+                               "channel_token": conn.channel_token})
             # dispatch=False: the post-ack _dispatch below places
             # queued work once the reply pump is running.
             self.runtime.register_remote_node(
@@ -1151,6 +1251,61 @@ class HeadServer:
         GLOBAL.record("head.handshake", _time.monotonic() - _t0)
         logger.info("Node daemon %s joined as %s with %s",
                     addr, node_id.hex()[:12], register["resources"])
+
+    def _handle_resume(self, sock: socket.socket, addr, register: dict,
+                       _t0: float) -> None:
+        """Re-attach a daemon's broken session channel (wire v7).
+
+        Raw (un-enveloped) handshake: validate protocol + node id +
+        channel token, reply ``resumed`` with our last-seen seq, then
+        attach the fresh socket — the attach replays every unacked
+        frame past the daemon's position. Any rejection sends the
+        daemon back to a full re-register, which keeps head-restart
+        rebinds (detached actors) as fast as before."""
+        import time as _time
+
+        from ray_tpu._private.event_stats import GLOBAL
+        try:
+            _wire.check_peer_protocol(register.get("protocol"),
+                                      f"resuming daemon at {addr}")
+        except _wire.ProtocolMismatch as exc:
+            _send_frame_best_effort(sock, _dumps({
+                "type": "resume_rejected", "error": str(exc)}))
+            sock.close()
+            return
+        conn = None
+        for cand in list(self._conns.values()):
+            if cand.node_id is not None and \
+                    cand.node_id.hex() == register.get("node_id"):
+                conn = cand
+                break
+        if conn is None or conn._closed or \
+                register.get("token") != conn.channel_token:
+            _send_frame_best_effort(sock, _dumps({
+                "type": "resume_rejected",
+                "error": "unknown session (node removed or head "
+                         "restarted); re-register"}))
+            sock.close()
+            return
+        # Raw reply BEFORE attach: the daemon reads it to learn our
+        # last-seen seq; the replayed (enveloped) frames follow it.
+        try:
+            _send_frame(sock, _dumps({"type": "resumed",
+                                      "last_seq": conn.channel.in_seq}))
+        except OSError:
+            sock.close()
+            return
+        if not conn.channel.attach(sock, int(register.get("last_seq", 0))):
+            # Resend ring evicted past the daemon's position (or the
+            # channel is closed): lossless replay is impossible, so the
+            # session is unrecoverable — node death, as before v7.
+            conn.close()
+            _close_quiet(sock)
+            return
+        conn.last_frame_at = _monotonic()
+        GLOBAL.record("head.channel_resume", _time.monotonic() - _t0)
+        logger.info("Node %s resumed its session channel",
+                    conn.node_id.hex()[:12] if conn.node_id else addr)
 
     def _client_sessions_discard(self, session) -> None:
         """Dead client sessions must not accumulate under worker churn."""
@@ -1550,11 +1705,14 @@ class NodeDaemon:
         self._session_n = 0
         self._send_lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
-        # Per-session reply sender (socket -> _CoalescingSender): the
+        # The session's ResilientChannel (survives resume socket
+        # swaps); handlers and publish paths key on it, not the socket.
+        self._chan = None
+        # Per-session reply sender (channel -> _CoalescingSender): the
         # single writer for head-bound replies; completions accumulated
         # by concurrent handler threads coalesce into reply_batch
         # frames. Handlers of a DEAD session find no sender and fall
-        # back to a direct send into the closed socket (dropped).
+        # back to a direct send into the closed channel (dropped).
         self._reply_senders: Dict[Any, Any] = {}
         self._stop = threading.Event()
         self.node_id_hex: Optional[str] = None
@@ -1680,16 +1838,21 @@ class NodeDaemon:
             # function exports in GCS KV for the job's lifetime).
         return fn
 
-    def _send_reply(self, sock, msg: dict, nbytes: int = 0) -> None:
+    def _send_reply(self, session, msg: dict, nbytes: int = 0) -> None:
         """Route a reply through the session's coalescing sender (the
-        socket's single writer). Handlers that outlive their session
+        channel's single writer). Handlers that outlive their session
         find no sender and fall back to a direct send into the closed
-        socket — dropped, which is the intent (see _reply's docstring
-        on head restarts)."""
-        sender = self._reply_senders.get(sock)
+        channel — which raises and gets dropped, the intent (see
+        _reply's docstring on head restarts). ``session`` is the
+        ResilientChannel the request arrived on (a raw socket for
+        legacy callers)."""
+        sender = self._reply_senders.get(session)
         if sender is not None and sender.send(msg, nbytes=nbytes):
             return
-        _send_frame(sock, _dumps(msg), self._send_lock)
+        if hasattr(session, "send_frame"):
+            session.send_frame(_dumps(msg))
+        else:
+            _send_frame(session, _dumps(msg), self._send_lock)
 
     def _reply(self, sock, req_id: int, *, value: Any = None,
                error: Optional[BaseException] = None,
@@ -2236,8 +2399,8 @@ class NodeDaemon:
         The connect retries with backoff — the head declares nodes that
         never open this channel dead, so one refused connect (listener
         backlog during a mass join) must not be fatal."""
-        import time
-        backoff = 0.2
+        from ray_tpu._private.channel import Backoff
+        bo = Backoff(0.2, 5.0)
         while not self._stop.is_set():
             try:
                 hc = socket.create_connection(self.head_address,
@@ -2245,6 +2408,7 @@ class NodeDaemon:
                 hc.settimeout(None)
                 _send_frame(hc, _dumps({"type": "health_channel",
                                         "node_id": self.node_id_hex}))
+                bo.reset()  # connected: a later drop backs off afresh
                 # New channel == new peer state, BOTH directions: re-ship
                 # every component snapshot (a restarted head starts from
                 # nothing) and forget the old head's digest (the new
@@ -2260,8 +2424,7 @@ class NodeDaemon:
                          "sync": self.syncer_reporter.poll()}))
                 return
             except (ConnectionError, OSError):
-                time.sleep(backoff)
-                backoff = min(backoff * 2, 5.0)
+                bo.sleep()
 
     def _run_in_env(self, msg: dict, fn, args, kwargs):
         # Publish the head-assigned chip ids through the worker context so
@@ -2300,11 +2463,16 @@ class NodeDaemon:
         restart + resubscribe). An orderly head shutdown frame exits
         immediately."""
         import time as _time
+
+        from ray_tpu._private.channel import Backoff
         global _current_daemon
         _current_daemon = self
         ever_registered = False
         deadline = _time.monotonic() + max(reconnect_window, 0.0)
-        backoff = 0.2
+        # Jittered backoff: after a head restart every daemon in the
+        # cluster re-dials at once — without jitter they'd hammer the
+        # fresh listener in lockstep (thundering herd).
+        bo = Backoff(0.2, 2.0)
         try:
             while not self._stop.is_set():
                 self._session_registered = False
@@ -2324,7 +2492,7 @@ class NodeDaemon:
                     ever_registered = True
                     # A real session dropped — fresh reconnect window.
                     deadline = _time.monotonic() + reconnect_window
-                    backoff = 0.2
+                    bo.reset()
                 if reconnect_window <= 0 or _time.monotonic() >= deadline:
                     if not ever_registered:
                         raise ConnectionError(
@@ -2334,8 +2502,7 @@ class NodeDaemon:
                         "Head %s unreachable for %.0fs; daemon exiting",
                         self.head_address, reconnect_window)
                     break
-                _time.sleep(backoff)
-                backoff = min(backoff * 2, 2.0)
+                bo.sleep()
         finally:
             # Any exit path — orderly shutdown, window expiry, or an
             # unexpected error (corrupt frame, bad ack) — releases the
@@ -2391,13 +2558,28 @@ class NodeDaemon:
             # A restarted head (gcs persistence) rebinds these.
             "resident_actors": list(self._actors.keys()),
         }), self._send_lock)
-        ack = _loads(_recv_frame(self._sock))
+        # Everything after the raw register frame flows through the
+        # resilient channel (v7 seq envelopes): the head's first
+        # enveloped frame is the "registered" ack at seq 1.
+        from ray_tpu._private.channel import (ChannelBroken,
+                                              ResilientChannel)
+        from ray_tpu._private.ray_config import make_ray_config
+        _ccfg = make_ray_config(None)
+        chan = ResilientChannel(
+            self._sock, site="daemon",
+            ring_bytes=int(_ccfg.channel_resend_ring_bytes),
+            window_s=float(_ccfg.channel_reconnect_window_s))
+        self._chan = chan
+        # register_rejected arrives raw (the head never built a
+        # channel for a rejected dial); recv_frame passes it through.
+        ack = _loads(chan.recv_frame())
         if ack.get("type") == "register_rejected":
             # Version mismatch: surface the head's words and STOP —
             # reconnect-retrying a permanent rejection would spin.
             raise _wire.ProtocolMismatch(ack["error"])
         assert ack["type"] == "registered", ack
         self.node_id_hex = ack["node_id"]
+        channel_token = ack.get("channel_token")
         self._session_registered = True
         logger.info("Registered with head %s as node %s",
                     self.head_address, self.node_id_hex[:12])
@@ -2427,17 +2609,30 @@ class NodeDaemon:
             threading.Thread(target=self._serve_health_channel,
                              name="ray_tpu-daemon-health",
                              daemon=True).start()
-        # Single writer for this session's replies: send failures close
-        # the socket, which pops the recv loop below out of its read.
-        session_sock = self._sock
+        # Single writer for this session's replies, keyed by the CHANNEL
+        # (stable across resume socket swaps). A send failure parks the
+        # sender until resume; only window exhaustion closes the channel,
+        # which pops the recv loop below out of its read.
         sender = _CoalescingSender(
-            session_sock, "reply_batch",
-            on_fail=lambda: _close_quiet(session_sock),
+            chan, "reply_batch", on_fail=chan.close,
             name=f"reply-{self.node_id_hex[:8]}")
-        self._reply_senders[session_sock] = sender
+        self._reply_senders[chan] = sender
         try:
             while not self._stop.is_set():
-                msgs = _decode_frames(_recv_frame(self._sock))
+                try:
+                    raw = chan.recv_frame()
+                except ChannelBroken:
+                    if self._stop.is_set():
+                        break
+                    # Transient transport failure: re-dial and resume —
+                    # the session (lease executors, resident actors,
+                    # class queues) survives; unacked frames replay on
+                    # both sides. Only a failed resume tears down.
+                    if self._try_resume(chan, channel_token):
+                        continue
+                    raise ConnectionError(
+                        "session channel lost (resume failed)")
+                msgs = _decode_frames(raw)
                 for msg in msgs:
                     # Inbound control frames are schema-checked before
                     # any handler sees them: a head from another build
@@ -2453,7 +2648,8 @@ class NodeDaemon:
             # Head session over: its leases are meaningless — retire the
             # executors and return their pinned workers.
             sender.close()
-            self._reply_senders.pop(session_sock, None)
+            self._reply_senders.pop(chan, None)
+            chan.close()
             for ex in self._lease_executors.values():
                 ex.stop()
             self._lease_executors.clear()
@@ -2466,6 +2662,57 @@ class NodeDaemon:
                 self._sock.close()
             except OSError:
                 pass
+
+    def _try_resume(self, chan, token: Optional[str]) -> bool:
+        """Re-dial the head and resume a broken session channel.
+
+        True: the channel re-attached (session state survives, unacked
+        frames replayed both ways). False: resume impossible — rejected
+        by the head, window exhausted, or orderly stop — and the caller
+        tears the session down for a full re-register."""
+        import time as _time
+
+        from ray_tpu._private.channel import Backoff, close_socket
+        if not token:
+            return False
+        deadline = (chan.broken_at or _time.monotonic()) + chan.window_s
+        bo = Backoff(0.2, 2.0)
+        while not self._stop.is_set() and _time.monotonic() < deadline:
+            sock = None
+            try:
+                sock = socket.create_connection(self.head_address,
+                                                timeout=5)
+                sock.settimeout(10)
+                try:
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                except OSError:
+                    pass
+                _send_frame(sock, _dumps({
+                    "type": "resume",
+                    "protocol": _wire.PROTOCOL_VERSION,
+                    "node_id": self.node_id_hex,
+                    "token": token,
+                    "last_seq": chan.in_seq}))
+                reply = _loads(_recv_frame(sock))
+                if reply.get("type") != "resumed":
+                    # Head restarted / node already declared dead: a
+                    # full re-register is the right (and fast) path.
+                    logger.warning("channel resume rejected: %s",
+                                   reply.get("error"))
+                    close_socket(sock)
+                    return False
+                sock.settimeout(None)
+                if chan.attach(sock, int(reply.get("last_seq", 0))):
+                    self._sock = sock  # SIGTERM handler pops the reader
+                    return True
+                close_socket(sock)
+                return False
+            except (ConnectionError, OSError):
+                if sock is not None:
+                    close_socket(sock)
+                bo.sleep()
+        return False
 
     def _start_log_streaming(self, session_id: str) -> None:
         """Join the driver session's log tree (the registration ack
@@ -2502,8 +2749,8 @@ class NodeDaemon:
         safely with task replies). Logs are best-effort: between head
         sessions there is no sender and the batch is dropped; the full
         text stays on disk for `ray-tpu logs`."""
-        sock = self._sock
-        sender = self._reply_senders.get(sock) if sock is not None \
+        chan = self._chan
+        sender = self._reply_senders.get(chan) if chan is not None \
             else None
         if sender is None:
             return False
@@ -2517,8 +2764,8 @@ class NodeDaemon:
         or a worker's piggybacked batch) through the session's reply
         sender. Returning False (no live head session) makes the agent
         resend a full snapshot once the channel recovers."""
-        sock = self._sock
-        sender = self._reply_senders.get(sock) if sock is not None \
+        chan = self._chan
+        sender = self._reply_senders.get(chan) if chan is not None \
             else None
         if sender is None:
             return False
@@ -2559,7 +2806,7 @@ class NodeDaemon:
             if ex is not None:
                 ex.unspill()
         elif msg.get("type") == "reclaim_tasks":
-            self._reclaim_tasks(self._sock, msg)
+            self._reclaim_tasks(self._chan, msg)
         elif lease_id is not None:
             # Leased task: onto the class's shared local-dispatch queue
             # (CPU classes — the daemon picks the slot), or the lease's
@@ -2581,17 +2828,17 @@ class NodeDaemon:
                 # Spilled SERIAL lease (a task blocked in a nested get):
                 # late frames bypass the serial queue too.
                 threading.Thread(target=self._handle_counted,
-                                 args=(self._sock, msg),
+                                 args=(self._chan, msg),
                                  daemon=True).start()
             else:
-                ex.submit(self._sock, msg)
+                ex.submit(self._chan, msg)
         else:
-            # Pass THIS session's socket: a handler outliving the
-            # session replies into a closed socket (dropped), never
+            # Pass THIS session's channel: a handler outliving the
+            # session replies into a closed channel (dropped), never
             # into a later session whose fresh req_id counter would
             # collide with this frame's req_id.
             threading.Thread(target=self._handle_counted,
-                             args=(self._sock, msg),
+                             args=(self._chan, msg),
                              daemon=True).start()
         return True
 

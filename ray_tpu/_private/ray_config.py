@@ -46,6 +46,11 @@ _PY_DEFAULTS: Dict[str, Any] = {
     "health_check_timeout_ms": 10000,
     "health_check_failure_threshold": 5,
     "node_death_grace_ms": 0,
+    # Resilient session channels (wire v7): a broken head<->daemon
+    # socket is re-dialed and resumed within this window before node
+    # death fires; unacked frames wait in a ring of this many bytes.
+    "channel_reconnect_window_s": 30.0,
+    "channel_resend_ring_bytes": 67108864,
     "metrics_report_interval_ms": 10_000,
     "task_events_enabled": True,
     "memory_monitor_refresh_ms": 250,

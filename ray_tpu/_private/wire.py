@@ -36,7 +36,13 @@ from typing import Any, Dict, Tuple
 #: as parallel chunks. Encoded as an ordinary key lookup, so a v5
 #: server replies -1 (unknown key) with framing intact and a v6 puller
 #: degrades to the whole-object fetch; control schemas are unchanged.
-PROTOCOL_VERSION = 6
+#: v7: resilient session channels — post-handshake frames are wrapped
+#: in a seq envelope (0x03 magic: sequence number + cumulative ack)
+#: and held in a resend ring until acked; a broken channel is re-dialed
+#: and resumed via the raw resume/resumed handshake instead of
+#: declaring the node dead. A v6 peer would neither envelope its frames
+#: nor understand the resume message, so the version must not match.
+PROTOCOL_VERSION = 7
 
 
 class WireSchemaError(ValueError):
@@ -67,9 +73,19 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
         "store_name": (_OPT_STR, False),
         "resident_actors": (_LIST, False),
     },
-    "registered": {"node_id": (_STR, True)},
+    "registered": {"node_id": (_STR, True),
+                   "channel_token": (_OPT_STR, False)},
     "register_rejected": {"error": (_STR, True),
                           "head_protocol": (_INT, True)},
+    # -- channel resume (raw, un-enveloped handshake frames; v7) -------
+    "resume": {
+        "protocol": (_INT, True),
+        "node_id": (_STR, True),
+        "token": (_STR, True),
+        "last_seq": (_INT, True),
+    },
+    "resumed": {"last_seq": (_INT, True)},
+    "resume_rejected": {"error": (_STR, True)},
     "health_channel": {"node_id": (_STR, True)},
     "client_runtime": {},  # fields owned by client_runtime.py
     "client_registered": {"job_id": (_STR, True),
@@ -295,6 +311,27 @@ import struct as _struct
 
 MAGIC_TYPED = 0x01
 MAGIC_BATCH = 0x02
+MAGIC_SEQ = 0x03
+
+# Seq envelope (v7): (magic, seq u64, ack u64) prefix on every
+# post-handshake session frame. seq is the sender's monotonic frame
+# number (0 = pure ack, empty inner payload); ack is the highest seq
+# the sender has received from the peer (cumulative, prunes the peer's
+# resend ring).
+_SEQ = _struct.Struct(">BQQ")
+
+
+def wrap_seq(seq: int, ack: int, payload: bytes) -> bytes:
+    """Prefix a frame payload with the v7 seq envelope."""
+    return _SEQ.pack(MAGIC_SEQ, seq, ack) + payload
+
+
+def unwrap_seq(payload: bytes):
+    """(seq, ack, inner) for enveloped frames, None for raw ones."""
+    if len(payload) >= _SEQ.size and payload[0] == MAGIC_SEQ:
+        _, seq, ack = _SEQ.unpack_from(payload)
+        return seq, ack, payload[_SEQ.size:]
+    return None
 
 _OP_EXECUTE_TASK = 0x01
 _OP_REPLY_VALUE = 0x02

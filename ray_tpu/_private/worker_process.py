@@ -197,12 +197,10 @@ class WorkerHandle:
 
     def stop(self) -> None:
         """Graceful shutdown (idle workers at pool teardown)."""
-        from ray_tpu._private.multinode import _dumps, _send_frame
+        from ray_tpu._private.multinode import (_dumps,
+                                                _send_frame_best_effort)
         self.dead = True
-        try:
-            _send_frame(self.sock, _dumps({"type": "exit"}))
-        except OSError:
-            pass
+        _send_frame_best_effort(self.sock, _dumps({"type": "exit"}))
         try:
             self.proc.wait(timeout=5)
         except subprocess.TimeoutExpired:

@@ -32,6 +32,12 @@ _DEFAULT_PANELS = [
     ("Actor count", "ray_tpu_actors", "short"),
     ("Actor restarts / s", "rate(ray_tpu_actor_restarts_total[5m])",
      "ops"),
+    ("Channel reconnects / s",
+     "rate(ray_tpu_channel_reconnects_total[5m])", "ops"),
+    ("Channel frames resent / s",
+     "rate(ray_tpu_channel_frames_resent_total[5m])", "ops"),
+    ("Channel send retries / s",
+     "rate(ray_tpu_channel_send_retries_total[5m])", "ops"),
     ("Worker pool size", "ray_tpu_worker_pool_size", "short"),
     ("Worker lease wait p95 (s)",
      "histogram_quantile(0.95, "

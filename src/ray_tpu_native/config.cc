@@ -104,6 +104,11 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     FLAG_INT(health_check_timeout_ms, 10000),
     FLAG_INT(health_check_failure_threshold, 5),
     FLAG_INT(node_death_grace_ms, 0),
+    // Resilient session channels (wire v7): reconnect-and-resume
+    // window before a broken channel escalates to node death, and the
+    // byte budget of the unacked-frame resend ring.
+    FLAG_DBL(channel_reconnect_window_s, 30.0),
+    FLAG_INT(channel_resend_ring_bytes, 67108864),
     // -- metrics / events --
     FLAG_INT(metrics_report_interval_ms, 10000),
     FLAG_BOOL(task_events_enabled, true),

@@ -1,0 +1,451 @@
+"""Self-healing session channels: acked frames, resend ring, resume.
+
+Unit layer: ResilientChannel over socketpairs (exactly-once replay,
+duplicate suppression, ack pruning, ring-overflow refusal), the chaos
+spec grammar and its determinism, and the jittered Backoff helper.
+
+Integration layer: real head + daemon subprocesses with deterministic
+faults injected via ``ray_tpu._private.chaos`` — a transient send
+failure must NOT kill the node (the pre-channel behaviour), a socket
+cut mid-stream must preserve exactly-once ordered delivery, and a
+daemon that is genuinely dead must still be declared dead promptly.
+"""
+
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import chaos
+from ray_tpu._private.channel import (ACK_EVERY, Backoff, ChannelBroken,
+                                      ResilientChannel, _ResendRing,
+                                      close_socket, is_transient)
+
+
+def _spawn_daemon(port, *, num_cpus=2, resources=None, env=None):
+    cmd = [sys.executable, "-m", "ray_tpu._private.multinode",
+           "--address", f"127.0.0.1:{port}",
+           "--num-cpus", str(num_cpus)]
+    if resources:
+        cmd += ["--resources", json.dumps(resources)]
+    full_env = None
+    if env:
+        full_env = dict(os.environ)
+        full_env.update(env)
+    return subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL, env=full_env)
+
+
+def _wait_for_resource(name, amount, timeout=20):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if ray_tpu.cluster_resources().get(name, 0) >= amount:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"resource {name}>={amount} never appeared: "
+        f"{ray_tpu.cluster_resources()}")
+
+
+def _counter_total(accessor):
+    from ray_tpu._private import builtin_metrics
+    return sum(getattr(builtin_metrics, accessor)().series().values())
+
+
+def _stop(p):
+    if p.poll() is None:
+        p.kill()
+    p.wait(timeout=10)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+# ---------------------------------------------------------------- channel
+
+
+def _pair(ring_bytes=1 << 20, window_s=5.0):
+    a_sock, b_sock = socket.socketpair()
+    a = ResilientChannel(a_sock, site="head", ring_bytes=ring_bytes,
+                         window_s=window_s)
+    b = ResilientChannel(b_sock, site="daemon", ring_bytes=ring_bytes,
+                         window_s=window_s)
+    return a, b, a_sock, b_sock
+
+
+def test_channel_roundtrip_and_piggyback_ack_pruning():
+    a, b, *_ = _pair()
+    try:
+        a.send_frame(b"hello")
+        assert b.recv_frame() == b"hello"
+        assert a.unacked() == 1  # b has not talked back yet
+        b.send_frame(b"world")  # piggybacks ack of seq 1
+        assert a.recv_frame() == b"world"
+        assert a.unacked() == 0
+        assert b.unacked() == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_channel_pure_ack_after_ack_every():
+    a, b, *_ = _pair()
+    try:
+        n = ACK_EVERY + 8
+        for i in range(n):
+            b.send_frame(f"f{i}".encode())
+        for i in range(n):
+            assert a.recv_frame() == f"f{i}".encode()
+        # Exactly one pure ack went out, at the ACK_EVERY-th frame.
+        assert a._acked_in == ACK_EVERY
+        a.send_frame(b"done")  # piggyback ack of everything
+        assert b.recv_frame() == b"done"
+        assert b.unacked() == 0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_channel_break_attach_replays_exactly_once_in_order():
+    a, b, a_sock, _ = _pair()
+    try:
+        a.send_frame(b"m1")
+        assert b.recv_frame() == b"m1"
+        close_socket(a_sock)  # the blip
+        with pytest.raises(ChannelBroken):
+            a.send_frame(b"m2")  # fails mid-write: already ringed
+        assert a.broken
+        with pytest.raises(ChannelBroken):
+            a.send_frame(b"m3")  # while broken: ringed for replay
+        assert a.unacked() == 3  # m1 never acked either
+
+        a2, b2 = socket.socketpair()
+        assert b.attach(b2, peer_last_seq=a.in_seq)
+        assert a.attach(a2, peer_last_seq=b.in_seq)  # replays m2, m3
+        assert not a.broken
+        assert b.recv_frame() == b"m2"
+        assert b.recv_frame() == b"m3"
+        assert a.reconnects == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_channel_duplicate_replay_is_suppressed():
+    a, b, a_sock, _ = _pair()
+    try:
+        a.send_frame(b"m1")
+        a.send_frame(b"m2")
+        assert b.recv_frame() == b"m1"
+        assert b.recv_frame() == b"m2"
+        # Resume claiming the peer only saw seq 1: m2 is replayed even
+        # though b already consumed it; b must drop the duplicate.
+        a2, b2 = socket.socketpair()
+        assert b.attach(b2, peer_last_seq=0)
+        assert a.attach(a2, peer_last_seq=1)
+        a.send_frame(b"m3")
+        assert b.recv_frame() == b"m3"  # duplicate m2 silently skipped
+        assert b.in_seq == 3
+    finally:
+        a.close()
+        b.close()
+
+
+def test_ring_overflow_refuses_lossy_resume():
+    ring = _ResendRing(10)
+    ring.append(1, b"x" * 8)
+    ring.append(2, b"y" * 8)  # evicts seq 1
+    assert ring.evicted_to == 1
+    assert not ring.can_resume_from(0)  # would need the evicted frame
+    assert ring.can_resume_from(1)
+    assert ring.frames_after(1) == [(2, b"y" * 8)]
+
+    # Channel-level: a peer that never acked past the eviction point
+    # cannot resume; the window then closes the channel (node death).
+    a, b, a_sock, _ = _pair(ring_bytes=16, window_s=0.2)
+    try:
+        a.send_frame(b"A" * 12)
+        a.send_frame(b"B" * 12)  # evicts the first frame
+        close_socket(a_sock)
+        with pytest.raises(ChannelBroken):
+            a.send_frame(b"C")
+        a2, _b2 = socket.socketpair()
+        assert not a.attach(a2, peer_last_seq=0)
+        assert not a.wait_recovered()  # window exhausts -> closed
+        assert a.closed
+    finally:
+        a.close()
+        b.close()
+
+
+def test_oversized_single_frame_still_replayable():
+    ring = _ResendRing(4)
+    ring.append(1, b"z" * 64)  # alone beats the budget: kept anyway
+    assert len(ring) == 1
+    assert ring.frames_after(0) == [(1, b"z" * 64)]
+
+
+def test_is_transient_classification():
+    assert is_transient(OSError("boom"))
+    assert is_transient(ConnectionResetError())
+    assert is_transient(EOFError())
+    import struct as _struct
+    assert is_transient(_struct.error("short read"))
+    assert not is_transient(ValueError("bug"))
+    assert not is_transient(KeyError("bug"))
+
+
+# ---------------------------------------------------------------- backoff
+
+
+def test_backoff_seeded_determinism():
+    d1 = [Backoff(0.1, 1.0, rng=random.Random(7)).next() for _ in range(1)]
+    b1 = Backoff(0.1, 1.0, rng=random.Random(7))
+    b2 = Backoff(0.1, 1.0, rng=random.Random(7))
+    assert [b1.next() for _ in range(6)] == [b2.next() for _ in range(6)]
+    assert d1[0] == Backoff(0.1, 1.0, rng=random.Random(7)).next()
+
+
+def test_backoff_growth_and_jitter_bounds():
+    b = Backoff(0.1, 1.0, rng=random.Random(3))
+    bases = [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+    for base in bases:
+        d = b.next()
+        assert base / 2 <= d <= base, (d, base)
+    b.reset()
+    assert b.next() <= 0.1
+
+
+# ------------------------------------------------------------------ chaos
+
+
+def test_chaos_spec_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        chaos.configure("flip_bits:p=1")
+    assert not chaos.ACTIVE
+
+
+def test_chaos_after_times_and_stats():
+    chaos.configure("send_oserror:site=z.send:after=2:times=1")
+    chaos.maybe_inject("z.send")  # 1: within 'after'
+    chaos.maybe_inject("z.send")  # 2: within 'after'
+    with pytest.raises(chaos.ChaosError):
+        chaos.maybe_inject("z.send")  # 3: fires
+    chaos.maybe_inject("z.send")  # 4: 'times' exhausted
+    (st,) = chaos.stats()
+    assert st["fired"] == 1 and st["seen"] == 4
+
+
+def test_chaos_site_and_kind_filtering():
+    chaos.configure("send_oserror:site=head.send")
+    chaos.maybe_inject("daemon.send")  # wrong site
+    chaos.maybe_inject("head.recv")  # send op never fires at a recv site
+    with pytest.raises(chaos.ChaosError):
+        chaos.maybe_inject("head.send")
+
+
+def test_chaos_probability_is_seed_deterministic():
+    def run():
+        chaos.configure("send_oserror:p=0.4:seed=42:site=x.send")
+        fired = []
+        for i in range(50):
+            try:
+                chaos.maybe_inject("x.send")
+                fired.append(False)
+            except chaos.ChaosError:
+                fired.append(True)
+        return fired
+    first, second = run(), run()
+    assert first == second
+    assert any(first) and not all(first)
+
+
+def test_chaos_delay_and_sock_close():
+    chaos.configure("delay_ms:ms=40:site=slow")
+    t0 = time.perf_counter()
+    chaos.maybe_inject("slow.send")
+    assert time.perf_counter() - t0 >= 0.03
+
+    chaos.configure("sock_close:site=cut")
+    a, b = socket.socketpair()
+    try:
+        with pytest.raises(chaos.ChaosError):
+            chaos.maybe_inject("cut.send", a)
+        assert a.fileno() == -1  # really closed, peer will see EOF
+    finally:
+        close_socket(a)
+        close_socket(b)
+
+
+# ------------------------------------------------------------ integration
+
+
+def test_transient_send_oserror_does_not_kill_node(ray_start_regular):
+    """ISSUE regression target: a single transient OSError on the head's
+    session send must resume the channel, not remove the node."""
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    p = _spawn_daemon(port, resources={"res": 2})
+    try:
+        _wait_for_resource("res", 2)
+
+        import numpy as np
+
+        @ray_tpu.remote(resources={"res": 1})
+        def triple(x):
+            return x * 3
+
+        @ray_tpu.remote(resources={"res": 1})
+        def checksum(arr):
+            return float(arr.sum())
+
+        assert ray_tpu.get(triple.remote(1), timeout=60) == 3  # warm path
+        failed0 = _counter_total("tasks_failed")
+        reconnects0 = _counter_total("channel_reconnects")
+
+        chaos.configure("send_oserror:site=head.send:times=1")
+        # A mid-transfer mix: small control frames plus ~1MB payloads in
+        # flight when the injected OSError hits the session send.
+        big = np.ones(128 * 1024, np.float64)
+        sums = [checksum.remote(big) for _ in range(4)]
+        results = ray_tpu.get([triple.remote(i) for i in range(20)],
+                              timeout=120)
+        assert ray_tpu.get(sums, timeout=120) == [float(big.size)] * 4
+        chaos.reset()
+
+        assert results == [i * 3 for i in range(20)]
+        assert p.poll() is None, "daemon must survive a transient blip"
+        assert ray_tpu.cluster_resources().get("res", 0) == 2
+        assert _counter_total("channel_reconnects") >= reconnects0 + 1
+        assert _counter_total("tasks_failed") == failed0
+        assert _counter_total("channel_frames_resent") >= 1
+    finally:
+        _stop(p)
+
+
+def test_sock_close_midstream_exactly_once_in_order(ray_start_regular):
+    """Cut the socket mid-stream between coalesced batches: every actor
+    call lands exactly once, in submission order (the resend ring holds
+    unacked frames; the daemon drops replayed duplicates)."""
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    p = _spawn_daemon(port, resources={"res": 2})
+    try:
+        _wait_for_resource("res", 2)
+
+        @ray_tpu.remote(resources={"res": 1})
+        class Acc:
+            def __init__(self):
+                self.items = []
+
+            def add(self, i):
+                self.items.append(i)
+                return len(self.items)
+
+            def get(self):
+                return list(self.items)
+
+        acc = Acc.remote()
+        assert ray_tpu.get(acc.add.remote(-1), timeout=60) == 1  # warm
+
+        chaos.configure("sock_close:site=head.send:after=3:times=1")
+        refs = [acc.add.remote(i) for i in range(30)]
+        counts = ray_tpu.get(refs, timeout=120)
+        chaos.reset()
+
+        # Counts are the actor-side list length at each call: strictly
+        # increasing iff no call was duplicated or reordered.
+        assert counts == list(range(2, 32))
+        assert ray_tpu.get(acc.get.remote(), timeout=60) == \
+            [-1] + list(range(30))
+        assert p.poll() is None
+    finally:
+        _stop(p)
+
+
+def test_daemon_side_break_resumes(ray_start_regular):
+    """Fault the DAEMON's reply sends (via RAY_TPU_CHAOS in its env):
+    the daemon re-dials the head, resumes, and replays its replies."""
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    p = _spawn_daemon(
+        port, resources={"res": 2},
+        env={"RAY_TPU_CHAOS": "sock_close:site=daemon.send:after=4:times=1"})
+    try:
+        _wait_for_resource("res", 2)
+
+        @ray_tpu.remote(resources={"res": 1})
+        def echo(x):
+            return x
+
+        results = ray_tpu.get([echo.remote(i) for i in range(16)],
+                              timeout=120)
+        assert results == list(range(16))
+        assert p.poll() is None
+        assert ray_tpu.cluster_resources().get("res", 0) == 2
+    finally:
+        _stop(p)
+
+
+def test_dead_daemon_is_still_declared_dead():
+    """The grace window must not mask real death: channel broken + one
+    failed health ping => node removed promptly, long before the 30s
+    reconnect window."""
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1, num_tpus=0, _memory=1e9,
+                 _system_config={"health_check_period_ms": 150,
+                                 "health_check_timeout_ms": 300,
+                                 "health_check_failure_threshold": 3})
+    p = None
+    try:
+        host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+        p = _spawn_daemon(port, resources={"res": 2})
+        _wait_for_resource("res", 2)
+        p.kill()
+        p.wait(timeout=10)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("res", 0) == 0:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                "dead daemon's resources never released: "
+                f"{ray_tpu.cluster_resources()}")
+    finally:
+        if p is not None:
+            _stop(p)
+        ray_tpu.shutdown()
+
+
+def test_chaos_inactive_hot_path_never_calls_inject(ray_start_regular,
+                                                    monkeypatch):
+    """No measurable overhead when disabled: with ACTIVE False the
+    transport hot paths must not even CALL maybe_inject (they guard on
+    the flag), proven by making any call blow up."""
+    assert not chaos.ACTIVE
+
+    def _boom(*_a, **_k):
+        raise AssertionError("maybe_inject called while chaos inactive")
+
+    monkeypatch.setattr(chaos, "maybe_inject", _boom)
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    p = _spawn_daemon(port, resources={"res": 2})
+    try:
+        _wait_for_resource("res", 2)
+
+        @ray_tpu.remote(resources={"res": 1})
+        def inc(x):
+            return x + 1
+
+        assert ray_tpu.get([inc.remote(i) for i in range(8)],
+                           timeout=60) == list(range(1, 9))
+    finally:
+        _stop(p)

@@ -13,6 +13,13 @@ runtime, so CI catches them statically:
    wall-clock deltas jump on NTP steps; durations feeding metrics must
    use ``time.monotonic()``/``perf_counter()`` (and then belong in a
    ``util.metrics`` Histogram, not an ad-hoc accumulator).
+4. Swallowed ``_send_frame`` failures under ``ray_tpu/_private/`` —
+   ``with contextlib.suppress(OSError): _send_frame(...)`` or
+   ``try: _send_frame(...) except OSError: pass`` silently drops a
+   control frame that the resilient-channel layer could have held for
+   replay. Fire-and-forget sites must call
+   ``multinode._send_frame_best_effort`` (which reports the drop via
+   its return value); session traffic must ride a ResilientChannel.
 """
 
 import ast
@@ -93,6 +100,73 @@ def test_no_wall_clock_latency_math_in_private():
         "latency/duration accounting must use time.monotonic() or "
         "time.perf_counter() and report through util.metrics: "
         + ", ".join(offenders))
+
+
+def _calls_send_frame(body):
+    """Any ``_send_frame(...)`` call anywhere under the given stmts
+    (``x._send_frame`` attribute calls count too)."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = getattr(func, "id", None) or getattr(func, "attr", None)
+            if name == "_send_frame":
+                return True
+    return False
+
+
+def _mentions_oserror(node):
+    """True if the exception spec names OSError (or a subclass commonly
+    used for socket failures) — directly or inside a tuple."""
+    names = {"OSError", "ConnectionError", "BrokenPipeError",
+             "ConnectionResetError", "socket.error"}
+    if node is None:
+        return False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == "error" and \
+                isinstance(sub.value, ast.Name) and sub.value.id == "socket":
+            return True
+    return False
+
+
+def test_no_suppressed_send_frame_in_private():
+    """No silently-swallowed ``_send_frame`` failures in _private/:
+    neither ``with contextlib.suppress(OSError): _send_frame(...)`` nor
+    ``try: _send_frame(...) except OSError: pass``. Use
+    ``_send_frame_best_effort`` (fire-and-forget, reports the drop) or
+    a ResilientChannel (holds the frame for replay)."""
+    offenders = []
+    for path in _py_files(os.path.join(PKG_ROOT, "_private")):
+        tree = _parse(path)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    ctx = item.context_expr
+                    if not isinstance(ctx, ast.Call):
+                        continue
+                    name = getattr(ctx.func, "id", None) or \
+                        getattr(ctx.func, "attr", None)
+                    if name == "suppress" and \
+                            any(_mentions_oserror(a) for a in ctx.args) \
+                            and _calls_send_frame(node.body):
+                        rel = os.path.relpath(path, PKG_ROOT)
+                        offenders.append(f"{rel}:{node.lineno}")
+            elif isinstance(node, ast.Try):
+                if not _calls_send_frame(node.body):
+                    continue
+                for handler in node.handlers:
+                    if _mentions_oserror(handler.type) and \
+                            all(isinstance(s, ast.Pass)
+                                for s in handler.body):
+                        rel = os.path.relpath(path, PKG_ROOT)
+                        offenders.append(f"{rel}:{handler.lineno}")
+    assert not offenders, (
+        "swallowed _send_frame failure in ray_tpu/_private/ — use "
+        "_send_frame_best_effort for fire-and-forget frames or a "
+        "ResilientChannel for session traffic: " + ", ".join(offenders))
 
 
 def test_no_bare_print_in_private():
