@@ -1087,28 +1087,86 @@ def bench_gptj6b(device) -> dict:
     return out
 
 
+def bench_frame_path() -> dict:
+    """Channel frame-path microbench over a socketpair — no cluster, so
+    the v7 envelope + framing cost is visible in isolation.
+
+    ``frame_send_mb_per_sec``: 8 MB payloads through
+    ResilientChannel.send_parts (scatter-gather sendmsg, ring by
+    reference — the zero-copy path the shuffle bench rides).
+    ``frame_send_small_per_sec``: 128 B frames (joined sendall path —
+    what tasks_per_sec rides)."""
+    import socket as _socket
+    import threading as _threading
+    import time as _time
+
+    from ray_tpu._private.channel import ResilientChannel
+
+    out = {}
+    a_sock, b_sock = _socket.socketpair()
+    tx = ResilientChannel(a_sock, site="head", ring_bytes=1 << 30,
+                          window_s=5.0)
+    rx = ResilientChannel(b_sock, site="daemon", ring_bytes=1 << 30,
+                          window_s=5.0)
+    try:
+        def _drain(n):
+            for _ in range(n):
+                rx.recv_frame()
+
+        payload = memoryview(bytes(8 << 20))
+        n_big = 24
+        t = _threading.Thread(target=_drain, args=(n_big,), daemon=True)
+        t.start()
+        t0 = _time.perf_counter()
+        for _ in range(n_big):
+            tx.send_parts(payload)
+        t.join()
+        out["frame_send_mb_per_sec"] = round(
+            n_big * 8 / (_time.perf_counter() - t0), 1)
+
+        small = b"x" * 128
+        n_small = 20000
+        t = _threading.Thread(target=_drain, args=(n_small,), daemon=True)
+        t.start()
+        t0 = _time.perf_counter()
+        for _ in range(n_small):
+            tx.send_parts(small)
+        t.join()
+        out["frame_send_small_per_sec"] = round(
+            n_small / (_time.perf_counter() - t0), 1)
+    finally:
+        tx.close()
+        rx.close()
+    return out
+
+
 def _prior_round_bench():
-    """Latest BENCH_r{N}.json next to this file (the driver records one
-    per round); returns its parsed result dict or None."""
+    """Latest USABLE BENCH_r{N}.json next to this file (the driver
+    records one per round); returns its parsed result dict or None.
+    Rounds whose record carries no comparable numbers — parsed is null
+    and the raw record has neither extras nor a headline value (e.g. a
+    truncated capture) — are skipped, so the gate baselines against the
+    newest round that can actually be compared."""
     import glob
     import re as _re
     here = os.path.dirname(os.path.abspath(__file__))
-    best_n, best = -1, None
+    rounds = []
     for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
         m = _re.search(r"BENCH_r(\d+)\.json$", path)
-        if not m:
+        if m:
+            rounds.append((int(m.group(1)), path))
+    for _, path in sorted(rounds, reverse=True):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
             continue
-        n = int(m.group(1))
-        if n > best_n:
-            best_n, best = n, path
-    if best is None:
-        return None, None
-    try:
-        with open(best) as f:
-            rec = json.load(f)
-    except (OSError, ValueError):
-        return None, None
-    return rec.get("parsed") or rec, os.path.basename(best)
+        parsed = rec.get("parsed") or rec
+        if isinstance(parsed, dict) and (
+                isinstance(parsed.get("extra"), dict)
+                or isinstance(parsed.get("value"), (int, float))):
+            return parsed, os.path.basename(path)
+    return None, None
 
 
 def compare_rounds(prev: dict, extra: dict, headline_value,
@@ -1276,6 +1334,7 @@ def main(argv=None):
         ("log_stream", "log_lines_per_sec", bench_log_streaming),
         ("metrics_overhead", "metrics_overhead_pct",
          bench_metrics_overhead),
+        ("frame_path", "frame_send_mb_per_sec", bench_frame_path),
     ]
     if on_tpu:
         extras_suite.append(

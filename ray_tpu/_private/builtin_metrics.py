@@ -37,6 +37,7 @@ _fast_store = {"hit": 0, "miss": 0}
 _fast_transfer = {"in": 0, "out": 0}
 _fast_chunks = {"n": 0}
 _fast_lease_immediate = {"n": 0}
+_fast_channel = {"bytes": 0, "acks": 0}
 
 
 def record_store_hit() -> None:
@@ -57,6 +58,16 @@ def record_transfer_out(nbytes: int) -> None:
 
 def record_pull_chunks(n: int) -> None:
     _fast_chunks["n"] += n
+
+
+def record_channel_bytes_sent(nbytes: int) -> None:
+    """Every ResilientChannel write (header + payload bytes): one dict
+    int add on the frame send path, folded at flush."""
+    _fast_channel["bytes"] += nbytes
+
+
+def record_channel_ack_sent() -> None:
+    _fast_channel["acks"] += 1
 
 
 def record_lease_immediate() -> None:
@@ -88,6 +99,14 @@ def flush_fast_counters() -> None:
     if n:
         _fast_chunks["n"] -= n
         pull_chunks().inc(n)
+    n = _fast_channel["bytes"]
+    if n:
+        _fast_channel["bytes"] -= n
+        channel_bytes_sent().inc(n)
+    n = _fast_channel["acks"]
+    if n:
+        _fast_channel["acks"] -= n
+        channel_acks_sent().inc(n)
     n = _fast_lease_immediate["n"]
     if n:
         _fast_lease_immediate["n"] -= n
@@ -279,3 +298,19 @@ def channel_send_retries() -> Counter:
         "Transient transport errors classified as retryable (channel "
         "send breaks, stale pooled-socket retries) instead of "
         "escalating to node death or pull failure.")
+
+
+def channel_bytes_sent() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_channel_bytes_sent_total",
+        "Bytes written to session channels (seq envelope + payload), "
+        "fed by the per-frame fast cell.")
+
+
+def channel_acks_sent() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_channel_acks_sent_total",
+        "Pure ack frames (seq 0) flushed by the deferred-ack timer — "
+        "acks piggybacked on regular traffic are not counted here.")

@@ -5,9 +5,19 @@ session. Every outbound frame is wrapped in a wire-protocol v7 seq
 envelope (``wire.wrap_seq``) carrying a monotonic per-session sequence
 number plus a cumulative ack of the highest inbound sequence seen, and
 is held in a bounded resend ring until the peer acks it. Acks piggyback
-on regular traffic; a pure ack (seq 0) is emitted from the receive path
-after ``ACK_EVERY`` unacked inbound frames so one-directional streams
-still prune the peer's ring.
+on regular traffic; after ``ack_every`` unacked inbound frames an ack
+becomes *pending* and is carried by the next outbound frame or, if none
+goes out within ``ack_flush_ms``, flushed as a pure ack (seq 0) by a
+background timer — the receive path itself never writes, so
+one-directional streams still prune the peer's ring without a
+synchronous send under the recv lock.
+
+Sends are zero-copy: :meth:`ResilientChannel.send_parts` packs the
+length prefix + seq envelope into a small reusable header buffer and
+hands caller buffers straight to ``socket.sendmsg`` scatter-gather
+(:func:`sock_send_parts`); the resend ring snapshots only frames at or
+below ``SENDMSG_THRESHOLD`` bytes and keeps larger frames by reference
+(callers own those buffers until acked).
 
 When a send or recv hits a transient transport error the channel closes
 the socket, flips to ``broken``, and raises :class:`ChannelBroken`; the
@@ -25,6 +35,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import os
 import random
 import socket
 import struct
@@ -38,11 +49,69 @@ from ray_tpu._private import wire as _wire
 logger = logging.getLogger(__name__)
 
 # Emit a pure ack after this many unacked inbound frames (keeps the
-# peer's resend ring pruned under one-directional traffic).
+# peer's resend ring pruned under one-directional traffic). Default for
+# the `channel_ack_every` config flag; the ack is deferred — piggybacked
+# on the next outbound frame or flushed by a timer after
+# `channel_ack_flush_ms` — never written synchronously from recv.
 ACK_EVERY = 32
+ACK_FLUSH_MS = 20
+
+# Frames whose payload totals at or below this many bytes are sent as
+# one joined buffer (`sendall`) and SNAPSHOTTED into the resend ring —
+# one small memcpy beats sendmsg iovec setup, and callers may reuse
+# their buffers immediately. Larger frames go scatter-gather by
+# reference: zero payload copies, but the caller's buffers must stay
+# stable until the peer acks (the ownership rule).
+SENDMSG_THRESHOLD = int(
+    os.environ.get("RAY_TPU_CHANNEL_SENDMSG_THRESHOLD", 65536))
+
+# POSIX guarantees at least 16 iovecs; Linux allows 1024. Batches with
+# more parts are written in successive sendmsg calls.
+_IOV_MAX = 1024
 
 _LEN = struct.Struct(">Q")
 _MAX_FRAME = 1 << 34
+
+_BUFFER_TYPES = (bytes, bytearray, memoryview)
+
+
+def _nbytes(payload) -> int:
+    """Byte length of a ring entry: one buffer or a tuple of parts."""
+    if isinstance(payload, _BUFFER_TYPES):
+        return len(payload)
+    return sum(len(p) for p in payload)
+
+
+def sock_send_parts(sock, parts, *, threshold: Optional[int] = None) -> int:
+    """Write a sequence of buffers to ``sock`` without joining them.
+
+    At or below ``threshold`` total bytes (or when the socket lacks
+    ``sendmsg``) the parts are joined once and written with ``sendall``
+    — for small frames one memcpy is cheaper than iovec setup. Above it
+    the buffers are handed to the kernel via scatter-gather
+    ``sendmsg``, advancing past partial writes with memoryview slices:
+    payload bytes are never copied in userspace. Returns the total byte
+    count written."""
+    total = sum(len(p) for p in parts)
+    if threshold is None:
+        threshold = SENDMSG_THRESHOLD
+    sendmsg = getattr(sock, "sendmsg", None)
+    if sendmsg is None or total <= threshold:
+        sock.sendall(b"".join(parts))
+        return total
+    views = [memoryview(p).cast("B") for p in parts if len(p)]
+    idx, n = 0, len(views)
+    while idx < n:
+        sent = sendmsg(views[idx:idx + _IOV_MAX])
+        while sent > 0:
+            v = views[idx]
+            if sent >= len(v):
+                sent -= len(v)
+                idx += 1
+            else:
+                views[idx] = v[sent:]
+                sent = 0
+    return total
 
 
 class ChannelBroken(ConnectionError):
@@ -134,20 +203,23 @@ class _ResendRing:
         self.cap_bytes = int(cap_bytes)
         self.evicted_to = 0
 
-    def append(self, seq: int, payload: bytes) -> None:
+    def append(self, seq: int, payload) -> None:
+        """``payload`` is one buffer (snapshotted small frame) or a
+        tuple of parts held BY REFERENCE (large frame — the sender's
+        buffers, never copied; accounted by summed part length)."""
         self._frames.append((seq, payload))
-        self._bytes += len(payload)
+        self._bytes += _nbytes(payload)
         # Keep at least the newest frame even if it alone beats the
         # budget, so a single oversized frame can still be replayed.
         while self._bytes > self.cap_bytes and len(self._frames) > 1:
             old_seq, old_payload = self._frames.popleft()
-            self._bytes -= len(old_payload)
+            self._bytes -= _nbytes(old_payload)
             self.evicted_to = old_seq
 
     def prune(self, acked_seq: int) -> None:
         while self._frames and self._frames[0][0] <= acked_seq:
             _, payload = self._frames.popleft()
-            self._bytes -= len(payload)
+            self._bytes -= _nbytes(payload)
 
     def can_resume_from(self, peer_last_seq: int) -> bool:
         return peer_last_seq >= self.evicted_to
@@ -167,15 +239,29 @@ class ResilientChannel:
     """One side of a resumable head<->daemon session channel."""
 
     def __init__(self, sock, *, site: str, ring_bytes: int,
-                 window_s: float):
+                 window_s: float, ack_every: Optional[int] = None,
+                 ack_flush_ms: Optional[int] = None):
         self._cv = threading.Condition(threading.Lock())
         self._sock = sock
         self._site = site
         self._ring = _ResendRing(ring_bytes)
         self.window_s = float(window_s)
+        self.ack_every = int(
+            ack_every if ack_every is not None
+            else os.environ.get("RAY_TPU_CHANNEL_ACK_EVERY", ACK_EVERY))
+        self.ack_flush_ms = int(
+            ack_flush_ms if ack_flush_ms is not None
+            else os.environ.get("RAY_TPU_CHANNEL_ACK_FLUSH_MS",
+                                ACK_FLUSH_MS))
         self.out_seq = 0
         self.in_seq = 0
         self._acked_in = 0
+        # Reused header buffer: length prefix + seq envelope, packed in
+        # place under self._cv for every write (no per-frame allocation,
+        # no prepend copy).
+        self._hdr = bytearray(_LEN.size + _wire.SEQ_SIZE)
+        self._ack_pending = False
+        self._ack_thread: Optional[threading.Thread] = None
         self.broken = False
         self.closed = False
         self.broken_at: Optional[float] = None
@@ -184,34 +270,57 @@ class ResilientChannel:
 
     # ------------------------------------------------------------- send
     def send_frame(self, payload) -> None:
-        """Ring-then-send: the frame is sequenced and ring-buffered
-        before the socket write, so a failed write (ChannelBroken) is
-        still replayed by the next attach — callers never resend."""
-        payload = bytes(payload)
+        """Ring-then-send for a single pre-joined payload buffer."""
+        self.send_parts(payload if isinstance(payload, bytes)
+                        else bytes(payload))
+
+    def send_parts(self, *parts) -> None:
+        """Ring-then-send, zero-copy: the frame is sequenced and
+        ring-buffered before the socket write, so a failed write
+        (ChannelBroken) is still replayed by the next attach — callers
+        never resend.
+
+        Ownership rule: frames totaling <= SENDMSG_THRESHOLD bytes are
+        snapshotted (joined) into the ring, so callers may reuse their
+        buffers immediately. Larger frames are ringed BY REFERENCE and
+        written with scatter-gather sendmsg — the caller's buffers must
+        stay stable until the peer acks (replay after a reconnect sends
+        whatever the buffers then contain)."""
         with self._cv:
             if self.closed:
                 raise ChannelClosed("channel closed")
             self.out_seq += 1
             seq = self.out_seq
-            self._ring.append(seq, payload)
+            if _nbytes(parts) <= SENDMSG_THRESHOLD:
+                entry = b"".join(parts)  # snapshot: buffers reusable now
+            else:
+                entry = parts  # by reference: stable-buffer rule applies
+            self._ring.append(seq, entry)
             if self.broken:
                 raise ChannelBroken("channel broken (frame held for replay)")
-            self._write_locked(seq, payload)
+            self._write_locked(seq, entry)
 
-    def _write_locked(self, seq: int, payload: bytes) -> None:
+    def _write_locked(self, seq: int, payload) -> None:
         sock = self._sock
-        wrapped = _wire.wrap_seq(seq, self.in_seq, payload)
+        parts = ((payload,) if isinstance(payload, _BUFFER_TYPES)
+                 else tuple(payload))
+        body = _nbytes(parts)
+        hdr = self._hdr  # safe to reuse: all writes run under self._cv
+        _LEN.pack_into(hdr, 0, _wire.SEQ_SIZE + body)
+        _wire.pack_seq_into(hdr, _LEN.size, seq, self.in_seq)
         self._acked_in = self.in_seq
+        self._ack_pending = False
         try:
             if chaos.ACTIVE:
                 chaos.maybe_inject(self._site + ".send", sock)
-            sock.sendall(_LEN.pack(len(wrapped)) + wrapped)
+            sock_send_parts(sock, (hdr,) + parts)
         except Exception as exc:
             if not is_transient(exc):
                 raise
             self._mark_broken_locked(sock, exc)
             self._count("channel_send_retries")
             raise ChannelBroken(f"send failed: {exc}") from exc
+        self._record_sent(len(hdr) + body, seq == 0)
 
     # ------------------------------------------------------------- recv
     def recv_frame(self) -> bytes:
@@ -250,13 +359,48 @@ class ResilientChannel:
                 if seq <= self.in_seq:
                     continue  # duplicate from a replay
                 self.in_seq = seq
-                if (self.in_seq - self._acked_in >= ACK_EVERY
+                if (self.in_seq - self._acked_in >= self.ack_every
                         and not self.broken and not self.closed):
+                    # Deferred: piggybacks on the next outbound frame,
+                    # or the flusher writes a pure ack after
+                    # ack_flush_ms. Never a synchronous write here.
+                    self._schedule_ack_locked()
+            return inner
+
+    def _schedule_ack_locked(self) -> None:
+        if self._ack_pending:
+            return
+        self._ack_pending = True
+        t = self._ack_thread
+        if t is None or not t.is_alive():
+            t = threading.Thread(target=self._ack_flush_loop,
+                                 name=f"chan-ack-{self._site}",
+                                 daemon=True)
+            self._ack_thread = t
+            t.start()
+        else:
+            self._cv.notify_all()
+
+    def _ack_flush_loop(self) -> None:
+        """Flush deferred pure acks that no outbound frame piggybacked
+        within the flush interval. A failed flush goes through
+        _write_locked, which marks the channel broken exactly once and
+        counts it in channel_send_retries — never swallowed silently."""
+        while True:
+            with self._cv:
+                while not (self._ack_pending or self.closed):
+                    self._cv.wait(1.0)
+                if self.closed:
+                    return
+            time.sleep(self.ack_flush_ms / 1000.0)  # piggyback grace
+            with self._cv:
+                if self.closed:
+                    return
+                if self._ack_pending and not self.broken:
                     try:
                         self._write_locked(0, b"")
                     except ChannelBroken:
-                        pass  # deliver this frame; next recv reports it
-            return inner
+                        pass  # marked broken + counted by _write_locked
 
     # ------------------------------------------------------- transitions
     def _mark_broken_locked(self, sock, exc=None) -> None:
@@ -346,3 +490,26 @@ class ResilientChannel:
             getattr(builtin_metrics, name)().inc(n)
         except Exception:  # metrics must never break transport recovery
             pass
+
+    @staticmethod
+    def _record_sent(nbytes: int, is_ack: bool) -> None:
+        """Hot-path counters via the lock-free fast cells (folded into
+        ray_tpu_channel_bytes_sent_total / _acks_sent_total by the
+        metrics agent's flush)."""
+        global _metrics_mod
+        m = _metrics_mod
+        if m is None:
+            try:
+                from ray_tpu._private import builtin_metrics as m
+            except Exception:
+                return
+            _metrics_mod = m
+        try:
+            m.record_channel_bytes_sent(nbytes)
+            if is_ack:
+                m.record_channel_ack_sent()
+        except Exception:  # metrics must never break transport
+            pass
+
+
+_metrics_mod = None

@@ -37,11 +37,18 @@ from typing import Dict, Optional, Tuple
 
 from ray_tpu._private import builtin_metrics
 from ray_tpu._private import chaos
+from ray_tpu._private.channel import sock_send_parts
 
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct(">q")  # signed: -1 = not found
 CHUNK_SIZE = 4 << 20  # reference: object_manager default chunk ~5MB
+
+
+def _send_prefixed(sock, header: bytes, *parts) -> None:
+    """Small-frame request/record writes: one scatter-gather call (joins
+    below the sendmsg threshold) instead of materializing header+body."""
+    sock_send_parts(sock, (header, *parts))
 
 #: Chunked parallel pulls (reference: object_manager.proto chunked
 #: transfer + pull_manager.h): payloads above the chunk threshold are
@@ -958,13 +965,13 @@ class ObjectServer:
                         sock.sendall(_LEN.pack(-1))
                         continue
                     size = len(payload)
-                    sock.sendall(_LEN.pack(size))
-                    sent = 0
-                    while sent < size:
-                        # Transient slices only: nothing may still
-                        # export the pinned view's buffer when the
-                        # context exits.
-                        sent += sock.send(payload[sent:sent + CHUNK_SIZE])
+                    # One scatter-gather write: size header + the pinned
+                    # arena view go arena->kernel with zero intermediate
+                    # copies (sendmsg advances past partial writes with
+                    # transient memoryview slices only; nothing exports
+                    # the buffer past the context exit).
+                    sock_send_parts(
+                        sock, (_LEN.pack(size), memoryview(payload)))
                 self.table._bump("served_bytes", size)
                 self.table._bump("serves")
                 builtin_metrics.record_transfer_out(size)
@@ -993,12 +1000,11 @@ class ObjectServer:
                     offset + length > len(payload):
                 sock.sendall(_LEN.pack(-1))
                 return
-            sock.sendall(_LEN.pack(length))
-            end = offset + length
-            sent = offset
-            while sent < end:
-                sent += sock.send(
-                    payload[sent:min(sent + CHUNK_SIZE, end)])
+            # Header + the requested slice in one scatter-gather write
+            # (memoryview slice: no copy of the pinned region).
+            sock_send_parts(
+                sock, (_LEN.pack(length),
+                       memoryview(payload)[offset:offset + length]))
         self.table._bump("served_bytes", length)
         self.table._bump("serves")
         builtin_metrics.record_transfer_out(length)
@@ -1062,7 +1068,7 @@ class BorrowChannel:
         self._sock = socket.create_connection(self.addr, timeout=timeout)
         self._sock.settimeout(timeout)
         kb = b"!borrow"
-        self._sock.sendall(_LEN.pack(len(kb)) + kb)
+        _send_prefixed(self._sock, _LEN.pack(len(kb)), kb)
         self._lock = threading.Lock()
         #: keys this CHANNEL GENERATION successfully registered (count).
         #: A '-' may only ride the generation its '+' rode: after a
@@ -1074,7 +1080,7 @@ class BorrowChannel:
     def add(self, key: str) -> None:
         rec = ("+" + key).encode()
         with self._lock:
-            self._sock.sendall(_LEN.pack(len(rec)) + rec)
+            _send_prefixed(self._sock, _LEN.pack(len(rec)), rec)
             self.sent_counts[key] = self.sent_counts.get(key, 0) + 1
 
     def delete(self, key: str) -> bool:
@@ -1084,7 +1090,7 @@ class BorrowChannel:
             if n <= 0:
                 return False  # registered on a dead predecessor: moot
             rec = ("-" + key).encode()
-            self._sock.sendall(_LEN.pack(len(rec)) + rec)
+            _send_prefixed(self._sock, _LEN.pack(len(rec)), rec)
             if n == 1:
                 del self.sent_counts[key]
             else:
@@ -1217,7 +1223,7 @@ def stat_remote(addr: Tuple[str, int], key: str,
 
     def op(sock):
         kb = ("?" + key).encode()
-        sock.sendall(_LEN.pack(len(kb)) + kb)
+        _send_prefixed(sock, _LEN.pack(len(kb)), kb)
         (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
         GLOBAL_PEER_CONNS.release(addr, sock)
         return size
@@ -1237,7 +1243,7 @@ def fetch_remote_bytes(addr: Tuple[str, int], key: str,
 
     def op(sock):
         kb = key.encode()
-        sock.sendall(_LEN.pack(len(kb)) + kb)
+        _send_prefixed(sock, _LEN.pack(len(kb)), kb)
         (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
         if size < 0:
             GLOBAL_PEER_CONNS.release(addr, sock)
@@ -1341,7 +1347,7 @@ def _fetch_chunk(addr: Tuple[str, int], key: str, landing: _RecvLanding,
     that vanished/changed size since the stat."""
     def op(sock):
         kb = f"@{offset}:{length}:{key}".encode()
-        sock.sendall(_LEN.pack(len(kb)) + kb)
+        _send_prefixed(sock, _LEN.pack(len(kb)), kb)
         (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
         if n < 0:
             GLOBAL_PEER_CONNS.release(addr, sock)
@@ -1513,7 +1519,7 @@ def _pull_whole(addr: Tuple[str, int], key: str, table: NodeObjectTable,
     streamed into the table. The caller owns socket acquisition and
     error handling (its stale-socket retry convention)."""
     kb = key.encode()
-    sock.sendall(_LEN.pack(len(kb)) + kb)
+    _send_prefixed(sock, _LEN.pack(len(kb)), kb)
     (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if size < 0:
         GLOBAL_PEER_CONNS.release(addr, sock)

@@ -68,14 +68,24 @@ class RemoteNodeDiedError(RuntimeError):
 # ---------------------------------------------------------------------------
 
 
-def _send_frame(sock: socket.socket, payload: bytes,
-                lock: Optional[threading.Lock] = None) -> None:
-    data = _FRAME.pack(len(payload)) + payload
+def _send_frame_parts(sock: socket.socket, parts,
+                      lock: Optional[threading.Lock] = None) -> None:
+    """Length-prefix and write a frame given as buffer parts — payload
+    buffers go to the kernel by scatter-gather (channel.sock_send_parts)
+    without being joined behind the length prefix."""
+    from ray_tpu._private.channel import sock_send_parts
+    total = sum(len(p) for p in parts)
+    hdr = _FRAME.pack(total)
     if lock is not None:
         with lock:
-            sock.sendall(data)
+            sock_send_parts(sock, (hdr, *parts))
     else:
-        sock.sendall(data)
+        sock_send_parts(sock, (hdr, *parts))
+
+
+def _send_frame(sock: socket.socket, payload: bytes,
+                lock: Optional[threading.Lock] = None) -> None:
+    _send_frame_parts(sock, (payload,), lock)
 
 
 def _send_frame_best_effort(sock: socket.socket, payload: bytes,
@@ -144,11 +154,17 @@ def _join_parts(parts: list) -> bytes:
     return b"".join(bytes(p) for p in parts)
 
 
+def _encode_frame_parts(msg: dict) -> list:
+    """Typed binary layout for hot-path ops (wire.py phase 2) as a part
+    list — payload bytes stay by reference — pickle envelope for
+    everything else."""
+    parts = _wire.encode_typed_parts(msg)
+    return parts if parts is not None else [_dumps(msg)]
+
+
 def _encode_frame(msg: dict) -> bytes:
-    """Typed binary layout for hot-path ops (wire.py phase 2), pickle
-    envelope for everything else."""
-    b = _wire.encode_typed(msg)
-    return b if b is not None else _dumps(msg)
+    """Joined form of :func:`_encode_frame_parts`."""
+    return _join_parts(_encode_frame_parts(msg))
 
 
 def _decode_frames(raw: bytes) -> list:
@@ -280,13 +296,15 @@ class _CoalescingSender:
                 self._cv.notify_all()  # backpressured senders re-check
             try:
                 if len(batch) == 1:
-                    self._transport.send_frame(_encode_frame(batch[0]))
+                    self._transport.send_parts(
+                        *_encode_frame_parts(batch[0]))
                 else:
                     # Binary batch: each message encodes ONCE (typed or
-                    # pickle), then the parts concatenate — no second
-                    # pickling of the accumulated payload bytes.
-                    self._transport.send_frame(_wire.encode_batch(
-                        [_encode_frame(m) for m in batch]))
+                    # pickle) into a part list; the batch frame is just
+                    # those parts behind per-frame length prefixes — the
+                    # accumulated payload bytes are never re-joined.
+                    self._transport.send_parts(*_wire.encode_batch_parts(
+                        [_encode_frame_parts(m) for m in batch]))
             except ChannelBroken:
                 # The frame already sits in the channel's resend ring
                 # and is replayed by the resume attach; park until the
@@ -312,7 +330,7 @@ class _CoalescingSender:
         from ray_tpu._private.channel import ChannelBroken
         for msg in batch:
             try:
-                self._transport.send_frame(_encode_frame(msg))
+                self._transport.send_parts(*_encode_frame_parts(msg))
             except ChannelBroken:
                 if self._transport.wait_recovered():
                     continue  # ringed frame replays on resume
@@ -354,7 +372,10 @@ class _SocketTransport:
         self._lock = lock
 
     def send_frame(self, payload: bytes) -> None:
-        _send_frame(self._sock, payload, self._lock)
+        _send_frame_parts(self._sock, (payload,), self._lock)
+
+    def send_parts(self, *parts) -> None:
+        _send_frame_parts(self._sock, parts, self._lock)
 
     def wait_recovered(self) -> bool:
         return False
@@ -388,7 +409,9 @@ class NodeConnection:
                  object_addr: Optional[Tuple[str, int]] = None,
                  store_name: Optional[str] = None,
                  reconnect_window_s: float = 30.0,
-                 resend_ring_bytes: int = 64 << 20):
+                 resend_ring_bytes: int = 64 << 20,
+                 ack_every: Optional[int] = None,
+                 ack_flush_ms: Optional[int] = None):
         from ray_tpu._private.channel import ResilientChannel
         self._sock = sock
         # Resilient session channel: all post-handshake traffic (both
@@ -397,7 +420,8 @@ class NodeConnection:
         # of cascading into remove_node.
         self.channel = ResilientChannel(
             sock, site="head", ring_bytes=resend_ring_bytes,
-            window_s=reconnect_window_s)
+            window_s=reconnect_window_s, ack_every=ack_every,
+            ack_flush_ms=ack_flush_ms)
         import uuid
         # Capability for the resume handshake: the daemon must present
         # it to re-attach, so a stray/imposter dial cannot hijack a
@@ -1198,7 +1222,9 @@ class HeadServer:
                 object_addr=register.get("object_addr"),
                 store_name=register.get("store_name"),
                 reconnect_window_s=float(cfg.channel_reconnect_window_s),
-                resend_ring_bytes=int(cfg.channel_resend_ring_bytes))
+                resend_ring_bytes=int(cfg.channel_resend_ring_bytes),
+                ack_every=int(cfg.channel_ack_every),
+                ack_flush_ms=int(cfg.channel_ack_flush_ms))
             conn.rpc_failure_pct = int(
                 self.runtime.config.testing_rpc_failure_pct)
             # Registration makes the node schedulable, which can
@@ -1849,6 +1875,10 @@ class NodeDaemon:
         sender = self._reply_senders.get(session)
         if sender is not None and sender.send(msg, nbytes=nbytes):
             return
+        if isinstance(msg.get("value"), (list, tuple)):
+            # OOB part-list values only flow through the typed encoder;
+            # the raw fallback pickles the dict, so join first.
+            msg = dict(msg, value=_join_parts(list(msg["value"])))
         if hasattr(session, "send_frame"):
             session.send_frame(_dumps(msg))
         else:
@@ -1926,8 +1956,10 @@ class NodeDaemon:
                                     "stored_key": key,
                                     "size": size})
         else:
+            # Part list straight through: the typed reply encoder hands
+            # the pickle-5 OOB buffers to send_parts unjoined.
             self._send_reply(sock, {"req_id": req_id, "ok": True,
-                                    "value": _join_parts(result_parts)},
+                                    "value": result_parts},
                              nbytes=size)
 
     def _resolve_markers(self, args, kwargs):
@@ -2568,7 +2600,9 @@ class NodeDaemon:
         chan = ResilientChannel(
             self._sock, site="daemon",
             ring_bytes=int(_ccfg.channel_resend_ring_bytes),
-            window_s=float(_ccfg.channel_reconnect_window_s))
+            window_s=float(_ccfg.channel_reconnect_window_s),
+            ack_every=int(_ccfg.channel_ack_every),
+            ack_flush_ms=int(_ccfg.channel_ack_flush_ms))
         self._chan = chan
         # register_rejected arrives raw (the head never built a
         # channel for a rejected dial); recv_frame passes it through.

@@ -51,6 +51,11 @@ _PY_DEFAULTS: Dict[str, Any] = {
     # death fires; unacked frames wait in a ring of this many bytes.
     "channel_reconnect_window_s": 30.0,
     "channel_resend_ring_bytes": 67108864,
+    # Deferred acks: after this many unacked inbound frames an ack goes
+    # pending, piggybacking on the next outbound frame or flushed as a
+    # pure ack once the interval expires.
+    "channel_ack_every": 32,
+    "channel_ack_flush_ms": 20,
     "metrics_report_interval_ms": 10_000,
     "task_events_enabled": True,
     "memory_monitor_refresh_ms": 250,

@@ -321,6 +321,18 @@ MAGIC_SEQ = 0x03
 _SEQ = _struct.Struct(">BQQ")
 
 
+#: Size of the seq envelope; channel pre-sizes its reusable header
+#: buffer with this so the envelope is packed in place, never prepended.
+SEQ_SIZE = _SEQ.size
+
+
+def pack_seq_into(buf, offset: int, seq: int, ack: int) -> None:
+    """Pack the v7 seq envelope into a caller-owned header buffer
+    (zero-copy framing: the payload is never re-materialized to prepend
+    the envelope)."""
+    _SEQ.pack_into(buf, offset, MAGIC_SEQ, seq, ack)
+
+
 def wrap_seq(seq: int, ack: int, payload: bytes) -> bytes:
     """Prefix a frame payload with the v7 seq envelope."""
     return _SEQ.pack(MAGIC_SEQ, seq, ack) + payload
@@ -342,6 +354,7 @@ _OP_FETCH_OBJECT = 0x06
 
 _HDR = _struct.Struct(">BB")
 _U32 = _struct.Struct(">I")
+_BATCH_HDR = _struct.Struct(">BI")  # MAGIC_BATCH + frame count
 _U64 = _struct.Struct(">Q")
 _F64 = _struct.Struct(">d")
 
@@ -405,7 +418,7 @@ def _encode_execute_task(msg: Dict[str, Any]):
     if flags & _F_EXTRA:
         import pickle as _pickle
         _pb(out, _pickle.dumps(extra), wide=True)
-    return b"".join(out)
+    return out
 
 
 class _Reader:
@@ -464,27 +477,35 @@ def _encode_reply(msg: Dict[str, Any]):
     if not isinstance(req_id, int) or req_id < 0:
         return None
     if msg.get("ok") is True:
-        if keys == {"req_id", "ok", "value"} and \
-                isinstance(msg["value"], bytes):
-            return b"".join([_HDR.pack(MAGIC_TYPED, _OP_REPLY_VALUE),
-                             _U64.pack(req_id), _U64.pack(
-                                 len(msg["value"])), msg["value"]])
+        if keys == {"req_id", "ok", "value"}:
+            v = msg["value"]
+            if isinstance(v, (list, tuple)):
+                # Pickle-5 OOB part list (serialization.serialize_parts):
+                # the buffers ride behind the length word by reference,
+                # never joined sender-side.
+                return [_HDR.pack(MAGIC_TYPED, _OP_REPLY_VALUE),
+                        _U64.pack(req_id),
+                        _U64.pack(sum(len(p) for p in v)), *v]
+            if isinstance(v, bytes):
+                return [_HDR.pack(MAGIC_TYPED, _OP_REPLY_VALUE),
+                        _U64.pack(req_id), _U64.pack(len(v)), v]
+            return None
         if keys == {"req_id", "ok", "stored_key", "size"}:
             kb = msg["stored_key"].encode()
-            return b"".join([_HDR.pack(MAGIC_TYPED, _OP_REPLY_STORED),
-                             _U64.pack(req_id), _U32.pack(len(kb)), kb,
-                             _U64.pack(int(msg["size"]))])
+            return [_HDR.pack(MAGIC_TYPED, _OP_REPLY_STORED),
+                    _U64.pack(req_id), _U32.pack(len(kb)), kb,
+                    _U64.pack(int(msg["size"]))]
         if keys == {"req_id", "ok", "raw"} and \
                 isinstance(msg["raw"], bytes):
-            return b"".join([_HDR.pack(MAGIC_TYPED, _OP_REPLY_RAW),
-                             _U64.pack(req_id),
-                             _U64.pack(len(msg["raw"])), msg["raw"]])
+            return [_HDR.pack(MAGIC_TYPED, _OP_REPLY_RAW),
+                    _U64.pack(req_id), _U64.pack(len(msg["raw"])),
+                    msg["raw"]]
         return None
     if msg.get("ok") is False and keys == {"req_id", "ok", "error"} and \
             isinstance(msg["error"], bytes):
-        return b"".join([_HDR.pack(MAGIC_TYPED, _OP_REPLY_ERROR),
-                         _U64.pack(req_id),
-                         _U64.pack(len(msg["error"])), msg["error"]])
+        return [_HDR.pack(MAGIC_TYPED, _OP_REPLY_ERROR),
+                _U64.pack(req_id), _U64.pack(len(msg["error"])),
+                msg["error"]]
     return None
 
 
@@ -492,14 +513,16 @@ def _encode_fetch_object(msg: Dict[str, Any]):
     if set(msg) != {"type", "req_id", "key"}:
         return None
     kb = msg["key"].encode()
-    return b"".join([_HDR.pack(MAGIC_TYPED, _OP_FETCH_OBJECT),
-                     _U64.pack(msg["req_id"]), _U32.pack(len(kb)), kb])
+    return [_HDR.pack(MAGIC_TYPED, _OP_FETCH_OBJECT),
+            _U64.pack(msg["req_id"]), _U32.pack(len(kb)), kb]
 
 
-def encode_typed(msg: Dict[str, Any]):
-    """Binary encoding for a hot-path control message, or None when the
-    message must ride the cloudpickle envelope instead. NEVER raises —
-    a shape the layout cannot carry simply falls back."""
+def encode_typed_parts(msg: Dict[str, Any]):
+    """Part list for a hot-path control message — header/length structs
+    as small bytes objects, user payload buffers BY REFERENCE (never
+    copied) — or None when the message must ride the cloudpickle
+    envelope instead. NEVER raises — a shape the layout cannot carry
+    simply falls back."""
     try:
         mtype = msg.get("type")
         if mtype == "execute_task":
@@ -511,6 +534,12 @@ def encode_typed(msg: Dict[str, Any]):
     except Exception:  # noqa: BLE001 - fallback is always correct
         return None
     return None
+
+
+def encode_typed(msg: Dict[str, Any]):
+    """Joined form of :func:`encode_typed_parts` (or None)."""
+    parts = encode_typed_parts(msg)
+    return b"".join(parts) if parts is not None else None
 
 
 def decode_typed(buf: bytes):
@@ -542,13 +571,20 @@ def decode_typed(buf: bytes):
     raise WireSchemaError(f"unknown typed wire op 0x{op:02x}")
 
 
+def encode_batch_parts(frames_parts) -> list:
+    """Flat part list for a batch frame built from per-message part
+    lists — payload buffers stay by reference, only the batch header
+    and per-frame length prefixes are materialized."""
+    out = [_BATCH_HDR.pack(MAGIC_BATCH, len(frames_parts))]
+    for parts in frames_parts:
+        out.append(_U64.pack(sum(len(p) for p in parts)))
+        out.extend(parts)
+    return out
+
+
 def encode_batch(frames) -> bytes:
-    """Pack pre-encoded frames (typed or pickle) into one batch frame."""
-    out = [bytes([MAGIC_BATCH]), _U32.pack(len(frames))]
-    for f in frames:
-        out.append(_U64.pack(len(f)))
-        out.append(f)
-    return b"".join(out)
+    """Pack pre-encoded (joined) frames into one joined batch frame."""
+    return b"".join(encode_batch_parts([[f] for f in frames]))
 
 
 def decode_batch(buf: bytes):

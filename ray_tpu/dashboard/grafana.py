@@ -38,6 +38,10 @@ _DEFAULT_PANELS = [
      "rate(ray_tpu_channel_frames_resent_total[5m])", "ops"),
     ("Channel send retries / s",
      "rate(ray_tpu_channel_send_retries_total[5m])", "ops"),
+    ("Channel bytes sent / s",
+     "rate(ray_tpu_channel_bytes_sent_total[1m])", "Bps"),
+    ("Channel pure acks / s",
+     "rate(ray_tpu_channel_acks_sent_total[1m])", "ops"),
     ("Worker pool size", "ray_tpu_worker_pool_size", "short"),
     ("Worker lease wait p95 (s)",
      "histogram_quantile(0.95, "

@@ -109,6 +109,11 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     // byte budget of the unacked-frame resend ring.
     FLAG_DBL(channel_reconnect_window_s, 30.0),
     FLAG_INT(channel_resend_ring_bytes, 67108864),
+    // Deferred acks: pending after channel_ack_every unacked inbound
+    // frames, flushed as a pure ack after channel_ack_flush_ms unless
+    // an outbound frame piggybacked it first.
+    FLAG_INT(channel_ack_every, 32),
+    FLAG_INT(channel_ack_flush_ms, 20),
     // -- metrics / events --
     FLAG_INT(metrics_report_interval_ms, 10000),
     FLAG_BOOL(task_events_enabled, true),

@@ -17,6 +17,7 @@ import random
 import socket
 import subprocess
 import sys
+import threading
 import time
 
 import pytest
@@ -106,10 +107,47 @@ def test_channel_pure_ack_after_ack_every():
             b.send_frame(f"f{i}".encode())
         for i in range(n):
             assert a.recv_frame() == f"f{i}".encode()
-        # Exactly one pure ack went out, at the ACK_EVERY-th frame.
+        # The ack is deferred: pending at ACK_EVERY, flushed as a pure
+        # ack by the timer within ack_flush_ms (no outbound traffic to
+        # piggyback on). b's recv loop consumes it and prunes its ring.
+        assert a._ack_pending
+        got = {}
+        t = threading.Thread(
+            target=lambda: got.setdefault("frame", b.recv_frame()),
+            daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5.0
+        while b.unacked() > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert b.unacked() == 0  # pure ack arrived and pruned the ring
+        assert a._acked_in >= ACK_EVERY
+        assert not a._ack_pending
+        a.send_frame(b"done")  # piggybacks any later acks
+        t.join(timeout=5)
+        assert got.get("frame") == b"done"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_channel_ack_piggybacks_before_flush_timer():
+    # With a long flush interval, an outbound frame sent right after
+    # the threshold carries the ack — no pure ack is ever written.
+    a_sock, b_sock = socket.socketpair()
+    a = ResilientChannel(a_sock, site="head", ring_bytes=1 << 20,
+                         window_s=5.0, ack_flush_ms=5000)
+    b = ResilientChannel(b_sock, site="daemon", ring_bytes=1 << 20,
+                         window_s=5.0)
+    try:
+        for i in range(ACK_EVERY):
+            b.send_frame(f"f{i}".encode())
+        for i in range(ACK_EVERY):
+            assert a.recv_frame() == f"f{i}".encode()
+        assert a._ack_pending
+        a.send_frame(b"reply")  # piggyback beats the 5s timer
+        assert not a._ack_pending
         assert a._acked_in == ACK_EVERY
-        a.send_frame(b"done")  # piggyback ack of everything
-        assert b.recv_frame() == b"done"
+        assert b.recv_frame() == b"reply"
         assert b.unacked() == 0
     finally:
         a.close()

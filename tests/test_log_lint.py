@@ -20,6 +20,14 @@ runtime, so CI catches them statically:
    replay. Fire-and-forget sites must call
    ``multinode._send_frame_best_effort`` (which reports the drop via
    its return value); session traffic must ride a ResilientChannel.
+5. Length-prefix concatenation ``X.pack(len(y)) + y`` under
+   ``ray_tpu/_private/`` — materializes a payload-sized copy per frame
+   just to glue a header on. The zero-copy path packs the header into
+   its own small buffer and hands both to ``channel.sock_send_parts``
+   (scatter-gather ``sendmsg``) — or ``ResilientChannel.send_parts``
+   for session traffic.
+6. ``sock.sendall(a + b)`` under ``ray_tpu/_private/`` — same copy in
+   disguise; pass the parts to ``sock_send_parts`` instead.
 """
 
 import ast
@@ -167,6 +175,71 @@ def test_no_suppressed_send_frame_in_private():
         "swallowed _send_frame failure in ray_tpu/_private/ — use "
         "_send_frame_best_effort for fire-and-forget frames or a "
         "ResilientChannel for session traffic: " + ", ".join(offenders))
+
+
+def _is_pack_call(node):
+    """A ``<struct>.pack(...)`` (or ``pack_into``-free bare ``pack``)
+    call expression."""
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    name = getattr(func, "id", None) or getattr(func, "attr", None)
+    return name == "pack"
+
+
+def _contains_len_call(node):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Name) and sub.func.id == "len":
+            return True
+    return False
+
+
+def test_no_length_prefix_concat_in_private():
+    """No ``X.pack(len(y)) + y`` in _private/: gluing a length prefix
+    onto a payload with ``+`` copies the whole payload. Pack the header
+    into its own buffer and scatter-gather both parts through
+    ``channel.sock_send_parts`` (or ``ResilientChannel.send_parts``)."""
+    offenders = []
+    for path in _py_files(os.path.join(PKG_ROOT, "_private")):
+        tree = _parse(path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.BinOp) and
+                    isinstance(node.op, ast.Add)):
+                continue
+            for side in (node.left, node.right):
+                if _is_pack_call(side) and \
+                        any(_contains_len_call(a) for a in side.args):
+                    rel = os.path.relpath(path, PKG_ROOT)
+                    offenders.append(f"{rel}:{node.lineno}")
+                    break
+    assert not offenders, (
+        "length-prefix concatenation (X.pack(len(y)) + y) in "
+        "ray_tpu/_private/ copies the payload — send header and payload "
+        "as separate parts via channel.sock_send_parts / "
+        "ResilientChannel.send_parts: " + ", ".join(offenders))
+
+
+def test_no_sendall_concat_in_private():
+    """No ``sock.sendall(a + b)`` in _private/: the ``+`` materializes
+    the joined frame. Hand the parts to ``channel.sock_send_parts``
+    (it joins below the sendmsg threshold, scatter-gathers above)."""
+    offenders = []
+    for path in _py_files(os.path.join(PKG_ROOT, "_private")):
+        tree = _parse(path)
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr == "sendall"):
+                continue
+            if any(isinstance(a, ast.BinOp) and isinstance(a.op, ast.Add)
+                   for a in node.args):
+                rel = os.path.relpath(path, PKG_ROOT)
+                offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        "sendall(a + b) in ray_tpu/_private/ copies the joined frame — "
+        "use channel.sock_send_parts(sock, (a, b)) instead: "
+        + ", ".join(offenders))
 
 
 def test_no_bare_print_in_private():
