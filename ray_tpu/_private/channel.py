@@ -15,9 +15,13 @@ synchronous send under the recv lock.
 Sends are zero-copy: :meth:`ResilientChannel.send_parts` packs the
 length prefix + seq envelope into a small reusable header buffer and
 hands caller buffers straight to ``socket.sendmsg`` scatter-gather
-(:func:`sock_send_parts`); the resend ring snapshots only frames at or
-below ``SENDMSG_THRESHOLD`` bytes and keeps larger frames by reference
-(callers own those buffers until acked).
+(:func:`sock_send_parts`). The resend ring joins frames at or below
+``SENDMSG_THRESHOLD`` bytes into one snapshot; above it, parts that are
+provably immutable (``bytes``) are kept by reference while mutable
+parts (bytearrays, pickle-5 OOB views over live array memory) are
+snapshotted — so callers may reuse or mutate their buffers the moment
+``send_parts`` returns, and a replay after a reconnect is always
+byte-identical to the original send.
 
 When a send or recv hits a transient transport error the channel closes
 the socket, flips to ``broken``, and raises :class:`ChannelBroken`; the
@@ -58,10 +62,10 @@ ACK_FLUSH_MS = 20
 
 # Frames whose payload totals at or below this many bytes are sent as
 # one joined buffer (`sendall`) and SNAPSHOTTED into the resend ring —
-# one small memcpy beats sendmsg iovec setup, and callers may reuse
-# their buffers immediately. Larger frames go scatter-gather by
-# reference: zero payload copies, but the caller's buffers must stay
-# stable until the peer acks (the ownership rule).
+# one small memcpy beats sendmsg iovec setup. Larger frames go
+# scatter-gather with zero payload copies on the wire; the ring keeps
+# immutable `bytes` parts by reference and snapshots everything else
+# (see ResilientChannel.send_parts).
 SENDMSG_THRESHOLD = int(
     os.environ.get("RAY_TPU_CHANNEL_SENDMSG_THRESHOLD", 65536))
 
@@ -75,11 +79,30 @@ _MAX_FRAME = 1 << 34
 _BUFFER_TYPES = (bytes, bytearray, memoryview)
 
 
+def _buf_len(p) -> int:
+    """Byte length of one buffer part. len() of a memoryview counts
+    ELEMENTS, not bytes — a non-'B'-format view (a float array's view)
+    would corrupt length prefixes and ring accounting."""
+    return p.nbytes if isinstance(p, memoryview) else len(p)
+
+
 def _nbytes(payload) -> int:
     """Byte length of a ring entry: one buffer or a tuple of parts."""
     if isinstance(payload, _BUFFER_TYPES):
-        return len(payload)
-    return sum(len(p) for p in payload)
+        return _buf_len(payload)
+    return sum(_buf_len(p) for p in payload)
+
+
+def _ring_stable(p) -> bool:
+    """True when the resend ring may hold ``p`` by reference: the
+    bytes are provably immutable (`bytes`, or a view whose exporting
+    object is `bytes`). Anything else — a bytearray, a pickle-5 OOB
+    view over an actor's live array — can be mutated by its owner
+    after send_parts returns, and a ringed reference would replay the
+    MUTATED bytes after a reconnect (exactly-once delivery of wrong
+    data); such parts are snapshotted into the ring instead."""
+    return isinstance(p, bytes) or (
+        isinstance(p, memoryview) and isinstance(p.obj, bytes))
 
 
 def sock_send_parts(sock, parts, *, threshold: Optional[int] = None) -> int:
@@ -92,14 +115,14 @@ def sock_send_parts(sock, parts, *, threshold: Optional[int] = None) -> int:
     ``sendmsg``, advancing past partial writes with memoryview slices:
     payload bytes are never copied in userspace. Returns the total byte
     count written."""
-    total = sum(len(p) for p in parts)
+    total = sum(_buf_len(p) for p in parts)
     if threshold is None:
         threshold = SENDMSG_THRESHOLD
     sendmsg = getattr(sock, "sendmsg", None)
     if sendmsg is None or total <= threshold:
         sock.sendall(b"".join(parts))
         return total
-    views = [memoryview(p).cast("B") for p in parts if len(p)]
+    views = [memoryview(p).cast("B") for p in parts if _buf_len(p)]
     idx, n = 0, len(views)
     while idx < n:
         sent = sendmsg(views[idx:idx + _IOV_MAX])
@@ -204,9 +227,10 @@ class _ResendRing:
         self.evicted_to = 0
 
     def append(self, seq: int, payload) -> None:
-        """``payload`` is one buffer (snapshotted small frame) or a
-        tuple of parts held BY REFERENCE (large frame — the sender's
-        buffers, never copied; accounted by summed part length)."""
+        """``payload`` is one buffer (joined small frame) or a tuple of
+        parts (large frame — immutable `bytes` by reference, mutable
+        parts already snapshotted by send_parts; accounted by summed
+        part byte length)."""
         self._frames.append((seq, payload))
         self._bytes += _nbytes(payload)
         # Keep at least the newest frame even if it alone beats the
@@ -224,7 +248,7 @@ class _ResendRing:
     def can_resume_from(self, peer_last_seq: int) -> bool:
         return peer_last_seq >= self.evicted_to
 
-    def frames_after(self, peer_last_seq: int) -> List[Tuple[int, bytes]]:
+    def frames_after(self, peer_last_seq: int) -> List[Tuple[int, object]]:
         return [(s, p) for s, p in self._frames if s > peer_last_seq]
 
     def __len__(self) -> int:
@@ -280,25 +304,28 @@ class ResilientChannel:
         (ChannelBroken) is still replayed by the next attach — callers
         never resend.
 
-        Ownership rule: frames totaling <= SENDMSG_THRESHOLD bytes are
-        snapshotted (joined) into the ring, so callers may reuse their
-        buffers immediately. Larger frames are ringed BY REFERENCE and
-        written with scatter-gather sendmsg — the caller's buffers must
-        stay stable until the peer acks (replay after a reconnect sends
-        whatever the buffers then contain)."""
+        Ownership rule: callers may reuse or mutate their buffers as
+        soon as this returns. Frames totaling <= SENDMSG_THRESHOLD
+        bytes are joined into one ring snapshot; above it the first
+        write scatter-gathers the CALLER'S buffers (zero payload
+        copies on the hot path) while the ring keeps immutable `bytes`
+        parts by reference and snapshots mutable parts — a reconnect
+        replay therefore always carries the bytes as they were at send
+        time, never a later mutation."""
         with self._cv:
             if self.closed:
                 raise ChannelClosed("channel closed")
             self.out_seq += 1
             seq = self.out_seq
             if _nbytes(parts) <= SENDMSG_THRESHOLD:
-                entry = b"".join(parts)  # snapshot: buffers reusable now
+                entry = b"".join(parts)
             else:
-                entry = parts  # by reference: stable-buffer rule applies
+                entry = tuple(p if _ring_stable(p) else bytes(p)
+                              for p in parts)
             self._ring.append(seq, entry)
             if self.broken:
                 raise ChannelBroken("channel broken (frame held for replay)")
-            self._write_locked(seq, entry)
+            self._write_locked(seq, parts)
 
     def _write_locked(self, seq: int, payload) -> None:
         sock = self._sock
@@ -388,7 +415,11 @@ class ResilientChannel:
         counts it in channel_send_retries — never swallowed silently."""
         while True:
             with self._cv:
-                while not (self._ack_pending or self.closed):
+                # A broken channel parks here (attach notifies) rather
+                # than waking every ack_flush_ms to skip the flush for
+                # the whole reconnect window.
+                while not ((self._ack_pending and not self.broken)
+                           or self.closed):
                     self._cv.wait(1.0)
                 if self.closed:
                     return

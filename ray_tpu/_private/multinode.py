@@ -74,7 +74,7 @@ def _send_frame_parts(sock: socket.socket, parts,
     buffers go to the kernel by scatter-gather (channel.sock_send_parts)
     without being joined behind the length prefix."""
     from ray_tpu._private.channel import sock_send_parts
-    total = sum(len(p) for p in parts)
+    total = _parts_size(parts)
     hdr = _FRAME.pack(total)
     if lock is not None:
         with lock:
@@ -144,8 +144,10 @@ def _dumps_parts(obj: Any) -> list:
     return serialization.serialize_parts(obj)
 
 
-def _parts_size(parts: list) -> int:
-    return sum(len(p) for p in parts)
+def _parts_size(parts) -> int:
+    # memoryview len() counts elements, not bytes (non-'B' formats).
+    return sum(p.nbytes if isinstance(p, memoryview) else len(p)
+               for p in parts)
 
 
 def _join_parts(parts: list) -> bytes:

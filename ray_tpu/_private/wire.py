@@ -358,6 +358,12 @@ _BATCH_HDR = _struct.Struct(">BI")  # MAGIC_BATCH + frame count
 _U64 = _struct.Struct(">Q")
 _F64 = _struct.Struct(">d")
 
+
+def _part_len(p) -> int:
+    """Byte length of a part — memoryview len() counts elements, not
+    bytes, so a non-'B'-format view would corrupt length words."""
+    return p.nbytes if isinstance(p, memoryview) else len(p)
+
 _F_PLAIN_ARGS = 1
 _F_LEASE = 2
 _F_CLASS = 4
@@ -485,7 +491,7 @@ def _encode_reply(msg: Dict[str, Any]):
                 # never joined sender-side.
                 return [_HDR.pack(MAGIC_TYPED, _OP_REPLY_VALUE),
                         _U64.pack(req_id),
-                        _U64.pack(sum(len(p) for p in v)), *v]
+                        _U64.pack(sum(_part_len(p) for p in v)), *v]
             if isinstance(v, bytes):
                 return [_HDR.pack(MAGIC_TYPED, _OP_REPLY_VALUE),
                         _U64.pack(req_id), _U64.pack(len(v)), v]
@@ -577,7 +583,7 @@ def encode_batch_parts(frames_parts) -> list:
     and per-frame length prefixes are materialized."""
     out = [_BATCH_HDR.pack(MAGIC_BATCH, len(frames_parts))]
     for parts in frames_parts:
-        out.append(_U64.pack(sum(len(p) for p in parts)))
+        out.append(_U64.pack(sum(_part_len(p) for p in parts)))
         out.extend(parts)
     return out
 

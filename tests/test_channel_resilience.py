@@ -110,7 +110,9 @@ def test_channel_pure_ack_after_ack_every():
         # The ack is deferred: pending at ACK_EVERY, flushed as a pure
         # ack by the timer within ack_flush_ms (no outbound traffic to
         # piggyback on). b's recv loop consumes it and prunes its ring.
-        assert a._ack_pending
+        # (No _ack_pending assert here: the background flusher may
+        # legitimately have flushed already on a slow machine — the
+        # piggyback test pins the timer to observe the pending state.)
         got = {}
         t = threading.Thread(
             target=lambda: got.setdefault("frame", b.recv_frame()),

@@ -6,10 +6,12 @@ The contract under test (channel.py):
 * ``sock_send_parts`` joins below the small-frame threshold (one memcpy
   beats iovec setup) and scatter-gathers above it — the payload buffer
   reaching ``sendmsg`` is the CALLER'S buffer, not a copy.
-* The resend ring snapshots small frames (callers may reuse their
-  buffers immediately) and holds large frames by reference (callers own
-  those buffers until the peer acks) — replay after a reconnect is
-  byte-identical for snapshots and for stable large buffers.
+* The resend ring joins small frames into one snapshot and, above the
+  threshold, keeps immutable `bytes` parts by reference while
+  snapshotting mutable parts (bytearrays, views over live array
+  memory) — callers may reuse their buffers as soon as send_parts
+  returns, and replay after a reconnect is byte-identical to the
+  original send even if the caller mutated a buffer in between.
 * Acks are deferred: pending at ``ack_every``, piggybacked or timer-
   flushed; a failed flush marks the channel broken exactly once and is
   counted in channel_send_retries (never silently swallowed).
@@ -146,16 +148,56 @@ def test_ring_snapshots_small_frames_buffer_reusable():
     assert entry == b"stable-contents!"
 
 
-def test_ring_keeps_large_frames_by_reference():
+def test_ring_keeps_large_immutable_frames_by_reference():
     sock = _FakeSock()
     ch = ResilientChannel(sock, site="test", ring_bytes=1 << 30,
                           window_s=5.0)
-    payload = bytearray(SENDMSG_THRESHOLD * 2)
+    payload = bytes(SENDMSG_THRESHOLD * 2)
     ch.send_parts(payload)
     seq, entry = ch._ring._frames[-1]
     assert isinstance(entry, tuple)
-    assert entry[0] is payload  # by reference: stable-buffer rule
+    assert entry[0] is payload  # immutable bytes: safe by reference
     assert ch._ring.nbytes == len(payload)
+
+
+def test_ring_snapshots_large_mutable_parts_wire_stays_zero_copy():
+    """A large frame whose parts view MUTABLE memory (the daemon reply
+    path hands pickle-5 OOB views over an actor's live arrays): the
+    first write still scatter-gathers the caller's buffer (zero-copy
+    hot path), but the ring entry is a private snapshot — a later
+    mutation by the owner cannot corrupt a replay."""
+    sock = _FakeSock()
+    ch = ResilientChannel(sock, site="test", ring_bytes=1 << 30,
+                          window_s=5.0)
+    backing = bytearray(b"\xab" * (SENDMSG_THRESHOLD * 2))
+    view = memoryview(backing)
+    ch.send_parts(b"hdr", view)
+    # Zero-copy first write: sendmsg saw a view over the caller's buffer.
+    owners = [b.obj for b in sock.sendmsg_buffers
+              if isinstance(b, memoryview)]
+    assert any(o is backing or o is view for o in owners)
+    seq, entry = ch._ring._frames[-1]
+    assert entry[0] is not None and bytes(entry[0]) == b"hdr"
+    assert isinstance(entry[1], bytes)  # snapshot, not the live view
+    backing[:3] = b"XYZ"  # owner mutates after send: allowed
+    assert entry[1][:3] == b"\xab\xab\xab"
+
+
+def test_non_byte_format_memoryview_framing():
+    """Part lengths are counted in BYTES even for a non-'B'-format view
+    (len() of a float view counts elements — the framing landmine)."""
+    import array
+    floats = array.array("d", [1.5, -2.25, 3.0, 0.125])
+    view = memoryview(floats)
+    assert len(view) == 4 and view.nbytes == 32
+    sock = _FakeSock()
+    n = sock_send_parts(sock, (b"hdr", view), threshold=0)
+    assert n == 3 + 32
+    assert bytes(sock.received) == b"hdr" + floats.tobytes()
+    sock2 = _FakeSock()
+    n2 = sock_send_parts(sock2, (b"hdr", view))  # join path
+    assert n2 == 3 + 32
+    assert bytes(sock2.received) == b"hdr" + floats.tobytes()
 
 
 def _pair(**kw):
@@ -190,10 +232,41 @@ def test_small_frame_replay_byte_identity_after_caller_overwrite():
         b.close()
 
 
+def test_large_mutable_frame_replay_byte_identity_after_overwrite():
+    """The corruption scenario a by-reference-only ring would hit: an
+    actor returns a view over its live array, the frame is cut
+    mid-flight, the actor mutates the array, the channel reconnects.
+    The replay must deliver the ORIGINAL bytes (the ring snapshotted
+    the mutable part), not the mutated ones."""
+    a, b, a_sock, _ = _pair()
+    try:
+        a.send_frame(b"m1")
+        assert b.recv_frame() == b"m1"
+        close_socket(a_sock)
+        backing = bytearray(bytes(range(256)) * (SENDMSG_THRESHOLD // 128))
+        original = bytes(backing)
+        with pytest.raises(ChannelBroken):
+            a.send_parts(memoryview(backing))
+        backing[:] = b"\x00" * len(backing)  # "actor" mutates its array
+        got = {}
+        t = threading.Thread(
+            target=lambda: got.setdefault("frame", b.recv_frame()),
+            daemon=True)
+        a2, b2 = socket.socketpair()
+        assert b.attach(b2, peer_last_seq=a.in_seq)
+        t.start()
+        assert a.attach(a2, peer_last_seq=b.in_seq)  # replays the frame
+        t.join(timeout=10)
+        assert got.get("frame") == original
+    finally:
+        a.close()
+        b.close()
+
+
 def test_large_frame_replay_byte_identity_with_stable_buffer():
-    """By-reference semantics across a reconnect: a large frame held in
-    the ring replays byte-identically as long as the caller kept the
-    buffer stable (the documented ownership rule)."""
+    """By-reference semantics across a reconnect: a large immutable
+    `bytes` frame held in the ring by reference replays
+    byte-identically."""
     a, b, a_sock, _ = _pair()
     try:
         a.send_frame(b"m1")
