@@ -679,6 +679,94 @@ def bench_serve() -> dict:
     return out
 
 
+def bench_serve_chaos() -> dict:
+    """Availability under replica churn (ISSUE 7 acceptance: serve stays
+    up): hammer a 3-replica deployment from worker threads while a
+    killer thread kills a RUNNING replica every second. Transparent
+    router failover + controller replacement should hold the
+    client-visible error rate at zero with bounded tail latency;
+    serve_chaos_qps counts only SUCCESSFUL requests so a regression in
+    either throughput or availability moves the gated metric."""
+    import concurrent.futures
+    import random as _random
+    import threading
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    out = {}
+    ray_tpu.init(num_cpus=8)
+    try:
+        @serve.deployment(num_replicas=3, max_concurrent_queries=4,
+                          name="chaoswork")
+        class Work:
+            def __call__(self, x):
+                _time.sleep(0.004)
+                return x
+
+        handle = serve.run(Work.bind())
+        assert ray_tpu.get(handle.remote(0), timeout=60) == 0
+        controller = get_or_create_controller()
+        stop = threading.Event()
+        kills = [0]
+
+        def killer():
+            while not stop.wait(1.0):
+                try:
+                    states = ray_tpu.get(
+                        controller.replica_states.remote("chaoswork"),
+                        timeout=10)
+                    running = [s for s in states
+                               if s["state"] == "RUNNING"]
+                    if len(running) <= 1:
+                        continue  # leave at least one replica serving
+                    victim = _random.choice(running)
+                    ray_tpu.kill(ray_tpu.get_actor(victim["name"]))
+                    kills[0] += 1
+                except Exception:  # noqa: BLE001 - victim already gone
+                    pass
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        lat, errors, submitted = [], [0], [0]
+
+        def one(i):
+            t0 = _time.perf_counter()
+            try:
+                if ray_tpu.get(handle.remote(i), timeout=30) != i:
+                    raise AssertionError("wrong serve result")
+                lat.append(_time.perf_counter() - t0)
+            except Exception:  # noqa: BLE001 - client-visible failure
+                errors[0] += 1
+
+        duration = 6.0
+        t0 = _time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            futs = []
+            while _time.perf_counter() - t0 < duration:
+                futs.append(pool.submit(one, submitted[0]))
+                submitted[0] += 1
+                _time.sleep(0.002)
+            for f in futs:
+                f.result()
+        wall = _time.perf_counter() - t0
+        stop.set()
+        kt.join(timeout=5)
+        lat.sort()
+        out["serve_chaos_qps"] = round(len(lat) / wall, 1)
+        out["serve_chaos_error_rate"] = round(
+            errors[0] / max(1, submitted[0]), 4)
+        out["serve_chaos_p95_ms"] = round(
+            lat[int(len(lat) * 0.95)] * 1000, 2) if lat else None
+        out["serve_chaos_kills"] = kills[0]
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+    return out
+
+
 RLLIB_BENCH_SCRIPT = """
 import json, os, time
 BATCH = 2048
@@ -1324,6 +1412,8 @@ def main(argv=None):
          bench_rllib_learner_group),
         ("shuffle", "shuffle_mb_per_sec", bench_data_shuffle),
         ("serve", "serve_qps", bench_serve),
+        ("serve_availability_under_chaos", "serve_chaos_qps",
+         bench_serve_chaos),
         ("shuffle_multi", "shuffle_multi_mb_per_sec",
          bench_shuffle_multi_daemon),
         ("envelope", "envelope_tasks_per_sec", bench_envelope),

@@ -300,6 +300,49 @@ def channel_send_retries() -> Counter:
         "escalating to node death or pull failure.")
 
 
+# -- serve resilience ------------------------------------------------------
+# Control-plane events (a failover or a drain is news, not load): plain
+# lazy accessors, no fast cells. Incremented from the serve router's
+# completion callbacks and the controller's lifecycle loop.
+
+
+def serve_failovers() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_serve_failovers_total",
+        "Serve requests transparently re-assigned to another replica "
+        "after a system failure (actor death / object loss) — never "
+        "application exceptions.")
+
+
+def serve_drained() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_serve_drained_total",
+        "Replicas retired through the DRAINING state, by outcome "
+        "(clean = in-flight requests reached zero; timeout = killed "
+        "with requests still running after the drain window).",
+        tag_keys=("outcome",))
+
+
+def serve_health_check_failures() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_serve_health_check_failures_total",
+        "Failed replica health probes (check_health raised or timed "
+        "out); a replica is replaced after the consecutive-failure "
+        "threshold.")
+
+
+def serve_shed() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_serve_shed_total",
+        "Serve requests fast-failed with BackPressureError because the "
+        "deployment's max_queued_requests cap was hit (HTTP 503 via "
+        "the proxy).")
+
+
 def channel_bytes_sent() -> Counter:
     from ray_tpu.util.metrics import Counter
     return Counter(

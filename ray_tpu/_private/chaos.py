@@ -13,6 +13,9 @@ Kinds
     recv_oserror   raise an OSError from a ``*.recv`` site
     sock_close     shutdown+close the socket at the site, then raise
     delay_ms       sleep ``ms`` milliseconds at the site
+    kill           raise :class:`ChaosKill` from a ``*kill`` site (serve
+                   replicas treat it as sudden death: the actor plays
+                   dead from then on, exercising failover/replacement)
 
 Params
     p      firing probability per matching call (default 1.0)
@@ -24,7 +27,9 @@ Params
 
 Sites: ``head.send`` / ``head.recv`` (head side of a session channel),
 ``daemon.send`` / ``daemon.recv`` (daemon side), ``pull.send``
-(dataplane pooled pull sockets).
+(dataplane pooled pull sockets), ``serve.replica_kill`` /
+``serve.replica_delay_ms`` (serve replica request path — evaluated at
+the top of every ``handle_request``).
 
 Hot paths guard on the module-level :data:`ACTIVE` flag, so with chaos
 disabled the per-frame cost is a single attribute read and no call.
@@ -47,11 +52,16 @@ ACTIVE = False
 _LOCK = threading.Lock()
 _OPS: List["_Op"] = []
 _DEFAULT_SEED = 0xC4A05
-_KINDS = ("send_oserror", "recv_oserror", "sock_close", "delay_ms")
+_KINDS = ("send_oserror", "recv_oserror", "sock_close", "delay_ms", "kill")
 
 
 class ChaosError(OSError):
     """Injected transport failure (distinguishable from real ones)."""
+
+
+class ChaosKill(ChaosError):
+    """Injected sudden-death signal (serve replicas catch this and play
+    dead — every subsequent call raises ActorDiedError)."""
 
 
 class _Op:
@@ -125,6 +135,8 @@ def maybe_inject(site: str, sock=None) -> None:
                 continue
             if op.kind == "recv_oserror" and ".recv" not in site:
                 continue
+            if op.kind == "kill" and "kill" not in site:
+                continue
             op.seen += 1
             if op.seen <= op.after:
                 continue
@@ -140,6 +152,8 @@ def maybe_inject(site: str, sock=None) -> None:
     if fire.kind == "delay_ms":
         time.sleep(fire.ms / 1000.0)
         return
+    if fire.kind == "kill":
+        raise ChaosKill(f"chaos[kill] injected at {site}")
     if fire.kind == "sock_close" and sock is not None:
         try:
             sock.shutdown(socket.SHUT_RDWR)

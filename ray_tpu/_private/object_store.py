@@ -21,6 +21,7 @@ store.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -30,6 +31,8 @@ from typing import Any, Callable, Dict, Optional
 from ray_tpu._private import builtin_metrics
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import GetTimeoutError, ObjectFreedError, ObjectLostError
+
+logger = logging.getLogger(__name__)
 
 
 def _estimate_size(value: Any) -> int:
@@ -69,6 +72,10 @@ class _Entry:
     # copies locally, the primary stays pinned on the producing node).
     remote_fetch: Optional[Callable[[], Any]] = None
     fetching: bool = False  # one pull at a time; other getters wait
+    # Completion callbacks (reference: memory_store GetAsync): fired once,
+    # outside the store lock, when the entry seals. None until someone
+    # subscribes, so entries that nobody watches pay one attribute read.
+    seal_callbacks: Optional[list] = None
 
 
 class ObjectStore:
@@ -129,6 +136,43 @@ class ObjectStore:
                 self._entries[object_id] = entry
             return entry
 
+    # -- seal subscriptions ----------------------------------------------
+
+    def on_sealed(self, object_id: ObjectID,
+                  callback: Callable[[ObjectID], None]) -> None:
+        """Invoke ``callback(object_id)`` once the entry seals (value,
+        error, free, or shutdown fail-all). Fires immediately if already
+        sealed; otherwise from whichever thread seals the entry, outside
+        the store lock. The event-driven analog of the reference memory
+        store's GetAsync — waiters (e.g. the serve router's completion
+        tracking) subscribe instead of polling ``wait``."""
+        entry = self._entry(object_id)
+        with self._lock:
+            if not entry.event.is_set():
+                if entry.seal_callbacks is None:
+                    entry.seal_callbacks = []
+                entry.seal_callbacks.append(callback)
+                return
+        self._fire_seal_callbacks([callback], object_id)
+
+    @staticmethod
+    def _take_seal_callbacks(entry: _Entry) -> Optional[list]:
+        """Detach the callback list (call with the store lock held)."""
+        cbs = entry.seal_callbacks
+        entry.seal_callbacks = None
+        return cbs
+
+    @staticmethod
+    def _fire_seal_callbacks(cbs: Optional[list], object_id: ObjectID) -> None:
+        if not cbs:
+            return
+        for cb in cbs:
+            try:
+                cb(object_id)
+            except Exception:  # noqa: BLE001 - subscriber bug must not
+                logger.exception(      # poison the sealing thread
+                    "seal callback for %s raised", object_id.hex())
+
     # -- write side -------------------------------------------------------
 
     def put_inline(self, object_id: ObjectID, value: Any,
@@ -156,6 +200,8 @@ class ObjectStore:
             entry.is_exception = is_exception
             entry.create_time = time.time()
             entry.event.set()
+            cbs = self._take_seal_callbacks(entry)
+        self._fire_seal_callbacks(cbs, object_id)
         self._maybe_spill()
 
     def put_remote(self, object_id: ObjectID, fetch_fn: Callable[[], Any],
@@ -172,6 +218,8 @@ class ObjectStore:
             entry.size_bytes = size_bytes
             entry.create_time = time.time()
             entry.event.set()
+            cbs = self._take_seal_callbacks(entry)
+        self._fire_seal_callbacks(cbs, object_id)
 
     def is_materialized(self, object_id: ObjectID) -> bool:
         """True when the value is locally available (not a pending remote
@@ -193,6 +241,8 @@ class ObjectStore:
             entry.create_time = time.time()
             self._total_bytes += len(payload)
             entry.event.set()
+            cbs = self._take_seal_callbacks(entry)
+        self._fire_seal_callbacks(cbs, object_id)
         self._maybe_spill()
 
     # -- spilling ---------------------------------------------------------
@@ -454,6 +504,7 @@ class ObjectStore:
     # -- lifecycle --------------------------------------------------------
 
     def free(self, object_ids) -> None:
+        fired = []  # (callbacks, oid) — entries freed before ever sealing
         with self._lock:
             for oid in object_ids:
                 entry = self._entries.get(oid)
@@ -461,6 +512,9 @@ class ObjectStore:
                     if entry.freed:
                         continue  # idempotent: never double-settle accounting
                     entry.freed = True
+                    cbs = self._take_seal_callbacks(entry)
+                    if cbs:
+                        fired.append((cbs, oid))
                     if entry.in_native and self._native is not None:
                         if entry.value is not None:
                             self._native.release(oid.hex())
@@ -481,6 +535,8 @@ class ObjectStore:
                     entry.serialized = None
                     entry.remote_fetch = None
                     entry.event.set()
+        for cbs, oid in fired:
+            self._fire_seal_callbacks(cbs, oid)
 
     def invalidate(self, object_ids) -> None:
         """Un-seal objects whose primary copy was lost (node death) so a
@@ -528,13 +584,19 @@ class ObjectStore:
     def fail_all_pending(self, exc: BaseException) -> None:
         """Seal every unsealed entry with the given error (used at shutdown so
         blocked gets raise instead of hanging forever)."""
+        fired = []
         with self._lock:
-            for entry in self._entries.values():
+            for oid, entry in self._entries.items():
                 if not entry.event.is_set():
                     entry.value = exc
                     entry.deserialized = True
                     entry.is_exception = True
                     entry.event.set()
+                    cbs = self._take_seal_callbacks(entry)
+                    if cbs:
+                        fired.append((cbs, oid))
+        for cbs, oid in fired:
+            self._fire_seal_callbacks(cbs, oid)
 
     def evict_all(self) -> None:
         with self._lock:

@@ -56,6 +56,19 @@ _PY_DEFAULTS: Dict[str, Any] = {
     # pure ack once the interval expires.
     "channel_ack_every": 32,
     "channel_ack_flush_ms": 20,
+    # Serve resilience (controller lifecycle + router failover): replica
+    # startup is bounded and retried against a per-deployment budget;
+    # DRAINING replicas get this long to finish in-flight requests;
+    # health checks run in parallel every period and a replica is
+    # replaced after this many consecutive failures; a failed-over
+    # request is retried on another replica at most this many times.
+    "serve_startup_timeout_s": 30.0,
+    "serve_start_budget": 3,
+    "serve_drain_timeout_s": 30.0,
+    "serve_health_check_period_s": 1.0,
+    "serve_health_check_timeout_s": 5.0,
+    "serve_health_failure_threshold": 3,
+    "serve_failover_retries": 3,
     "metrics_report_interval_ms": 10_000,
     "task_events_enabled": True,
     "memory_monitor_refresh_ms": 250,

@@ -685,6 +685,45 @@ class Runtime:
         self.refs.add_owned(oid)
         return ObjectRef(oid)
 
+    def create_promise(self) -> ObjectRef:
+        """Mint an owned but UNSEALED object (a promise): ``get`` blocks
+        until someone settles it via :meth:`fulfill_promise`. The serve
+        router hands these to callers so the caller-visible ref survives
+        replica failover — the ref's identity is decoupled from any one
+        actor-task attempt (reference: serve router replica_result
+        wrappers over retried assignments)."""
+        with self._lock:
+            self._put_index += 1
+            idx = self._put_index
+        oid = ObjectID.for_put(TaskID.for_normal_task(self.job_id), idx)
+        self.store._entry(oid)  # create the unsealed entry now
+        self.refs.add_owned(oid)
+        return ObjectRef(oid)
+
+    def fulfill_promise(self, ref: ObjectRef, value: Any = None,
+                        exception: Optional[BaseException] = None,
+                        alias: Optional[ObjectRef] = None) -> None:
+        """Settle a promise minted by :meth:`create_promise`.
+
+        Exactly one of ``value`` / ``exception`` / ``alias`` semantics
+        applies; the store's first-write-wins seal makes racing settles
+        (e.g. a deadline expiry vs. a completing replica) safe. With
+        ``alias`` the promise resolves to whatever the alias ref holds,
+        materialized lazily through the store's remote-fetch hook: the
+        closure pins the alias ref until the value (or error) is read."""
+        oid = ref.object_id()
+        if alias is not None:
+            inner = alias  # closure keeps the aliased ref (and oid) alive
+
+            def _fetch(timeout=None):
+                return self.store.get(inner.object_id(), timeout=timeout)
+
+            self.store.put_remote(oid, _fetch, 0)
+        elif exception is not None:
+            self.store.put_inline(oid, exception, is_exception=True)
+        else:
+            self.store.put_inline(oid, value)
+
     def register_remote_put(self, node_id: NodeID, key: str,
                             size: int, adopt: bool) -> ObjectRef:
         """Distributed-ownership put: the VALUE already sits in

@@ -42,6 +42,14 @@ _DEFAULT_PANELS = [
      "rate(ray_tpu_channel_bytes_sent_total[1m])", "Bps"),
     ("Channel pure acks / s",
      "rate(ray_tpu_channel_acks_sent_total[1m])", "ops"),
+    ("Serve failovers / s", "rate(ray_tpu_serve_failovers_total[5m])",
+     "ops"),
+    ("Serve replicas drained / s (by outcome)",
+     "sum by (outcome) (rate(ray_tpu_serve_drained_total[5m]))", "ops"),
+    ("Serve health-check failures / s",
+     "rate(ray_tpu_serve_health_check_failures_total[5m])", "ops"),
+    ("Serve requests shed / s", "rate(ray_tpu_serve_shed_total[1m])",
+     "ops"),
     ("Worker pool size", "ray_tpu_worker_pool_size", "short"),
     ("Worker lease wait p95 (s)",
      "histogram_quantile(0.95, "

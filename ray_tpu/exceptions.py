@@ -83,6 +83,23 @@ class ObjectFreedError(RayError):
     pass
 
 
+class BackPressureError(RayError):
+    """A serve deployment's request queue is full: the router fast-fails
+    instead of queueing unboundedly (reference: serve/exceptions.py
+    BackPressureError, raised when ``max_queued_requests`` is exceeded).
+    The HTTP proxy maps this to 503 with a Retry-After header."""
+
+    def __init__(self, num_queued: int = 0, max_queued: int = 0,
+                 deployment: str = ""):
+        self.num_queued = num_queued
+        self.max_queued = max_queued
+        self.deployment = deployment
+        super().__init__(
+            f"Request dropped by deployment {deployment or '<unknown>'}: "
+            f"{num_queued} requests outstanding >= max_queued_requests="
+            f"{max_queued}. Retry later or raise max_queued_requests.")
+
+
 class GetTimeoutError(RayError, TimeoutError):
     """``get`` did not complete within the requested timeout."""
 

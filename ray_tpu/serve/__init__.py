@@ -16,12 +16,14 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import ray_tpu
+from ray_tpu.exceptions import BackPressureError
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle
 
-__all__ = ["Application", "Deployment", "DeploymentHandle", "batch",
-           "delete", "deployment", "get_app_handle", "get_deployment_handle",
-           "ingress", "run", "shutdown", "status", "start"]
+__all__ = ["Application", "BackPressureError", "Deployment",
+           "DeploymentHandle", "batch", "delete", "deployment",
+           "get_app_handle", "get_deployment_handle", "ingress", "run",
+           "shutdown", "status", "start"]
 
 
 class Deployment:
@@ -75,6 +77,7 @@ class Deployment:
             autoscaling,
             version,
             cfg.get("user_config"),
+            cfg.get("max_queued_requests", -1),
         ))
 
 
@@ -93,12 +96,18 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: Optional[int] = None,
                ray_actor_options: Optional[dict] = None,
                max_concurrent_queries: int = 100,
+               max_queued_requests: int = -1,
                autoscaling_config: Optional[dict] = None,
                route_prefix: Optional[str] = "__default__",
                user_config: Any = None,
                version: Optional[str] = None,
                **_ignored):
-    """``@serve.deployment`` / ``@serve.deployment(num_replicas=...)``."""
+    """``@serve.deployment`` / ``@serve.deployment(num_replicas=...)``.
+
+    ``max_queued_requests`` caps router-side queueing: once a
+    deployment has that many requests outstanding beyond its replicas'
+    concurrent capacity, further requests fast-fail with
+    :class:`BackPressureError` (-1 = unlimited, the default)."""
 
     def decorate(target):
         dep_name = name or target.__name__
@@ -106,6 +115,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             "num_replicas": num_replicas,
             "ray_actor_options": ray_actor_options,
             "max_concurrent_queries": max_concurrent_queries,
+            "max_queued_requests": max_queued_requests,
             "autoscaling_config": autoscaling_config,
             "user_config": user_config,
             "version": version,
@@ -248,8 +258,14 @@ def shutdown() -> None:
     except ValueError:
         controller = None
     if controller is not None:
-        ray_tpu.get(controller.shutdown.remote())
-        ray_tpu.kill(controller)
+        try:
+            ray_tpu.get(controller.shutdown.remote())
+        except Exception:  # noqa: BLE001 - controller already dead
+            pass
+        try:
+            ray_tpu.kill(controller)
+        except Exception:  # noqa: BLE001
+            pass
     if _proxy is not None:
         try:
             ray_tpu.get(_proxy.shutdown.remote())

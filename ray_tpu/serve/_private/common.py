@@ -1,0 +1,65 @@
+"""Shared serve-internal helpers: replica lifecycle states, the
+system-failure classification that gates router failover, and config
+access (analog of the reference's serve/_private/common.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from ray_tpu.exceptions import (ActorError, NodeDiedError, ObjectLostError,
+                                WorkerCrashedError)
+
+# Replica lifecycle (reference: serve/_private/common.py ReplicaState):
+# STARTING -> RUNNING -> DRAINING -> STOPPED. Only RUNNING replicas are
+# published to routers; DRAINING replicas finish in-flight work, then die.
+STARTING = "STARTING"
+RUNNING = "RUNNING"
+DRAINING = "DRAINING"
+STOPPED = "STOPPED"
+
+# What counts as "the infrastructure failed" (retry elsewhere) versus
+# "the application raised" (surface to the caller unchanged). TaskError
+# wraps application exceptions and is deliberately NOT here.
+_SYSTEM_FAILURES = (ActorError, ObjectLostError, NodeDiedError,
+                    WorkerCrashedError)
+
+
+def is_system_failure(exc: BaseException) -> bool:
+    if isinstance(exc, _SYSTEM_FAILURES):
+        return True
+    # A replica that REFUSES work (draining, chaos-dead) raises
+    # ActorDiedError from inside the method body; the actor executor
+    # wraps in-method exceptions in TaskError, so classify the cause too.
+    return isinstance(getattr(exc, "cause", None), _SYSTEM_FAILURES)
+
+
+def serve_config(name: str, default: Any) -> Any:
+    """Read a serve flag with the standard precedence: runtime config
+    (native/python flag table, already env-overridden) when a runtime is
+    up, else the raw ``RAY_TPU_<name>`` env var, else the default."""
+    try:
+        from ray_tpu._private.worker import global_worker
+        runtime = global_worker._runtime
+        cfg = getattr(runtime, "config", None)
+        if cfg is not None:
+            return cfg.get(name)
+    except Exception:  # noqa: BLE001 - fall back to the env var
+        pass
+    env = os.environ.get(f"RAY_TPU_{name}")
+    if env is None:
+        return default
+    if isinstance(default, bool):
+        return env.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        try:
+            return int(float(env))
+        except ValueError:
+            return default
+    if isinstance(default, float):
+        try:
+            return float(env)
+        except ValueError:
+            return default
+    return env

@@ -6,20 +6,57 @@ state (deployments, replica counts), reconciles actual replica actors
 toward it, restarts failed replicas, and serves membership (with a version
 counter standing in for the reference's LongPollHost push channel,
 _private/long_poll.py:68 — routers poll the version and refresh on change).
+
+Replica lifecycle (reference: deployment_state.py ReplicaState):
+STARTING -> RUNNING -> DRAINING -> STOPPED. Only RUNNING replicas are
+published to routers. Scale-down and redeploy never hard-kill a serving
+replica: victims are marked DRAINING (they refuse new work, routers drop
+them on the membership push), the control loop polls ``num_ongoing`` down
+to zero bounded by ``serve_drain_timeout_s``, and only then kills.
+Rolling redeploy starts the new generation first and retires the old one
+once the replacements are RUNNING. Replica startup is bounded by
+``serve_startup_timeout_s`` and retried against ``serve_start_budget``;
+health checks probe the user-overridable ``check_health()`` in parallel
+every ``serve_health_check_period_s`` and replace replicas after
+``serve_health_failure_threshold`` consecutive failures.
 """
 
 from __future__ import annotations
 
+import asyncio
 import logging
-import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private import builtin_metrics
+from ray_tpu.serve._private.common import (DRAINING, RUNNING, STARTING,
+                                           STOPPED, is_system_failure,
+                                           serve_config)
 from ray_tpu.serve._private.replica import ReplicaActor
 
 logger = logging.getLogger("ray_tpu.serve")
 
 CONTROLLER_NAME = "_serve_controller"
+
+
+class ReplicaState:
+    """One replica actor's lifecycle record."""
+
+    __slots__ = ("handle", "name", "state", "version", "health_failures",
+                 "drain_deadline")
+
+    def __init__(self, handle, name: str, version: str):
+        self.handle = handle
+        self.name = name  # runtime actor name (get_actor-able)
+        self.state = STARTING
+        self.version = version
+        self.health_failures = 0
+        self.drain_deadline: Optional[float] = None  # loop.time(), DRAINING
+
+    def snapshot(self) -> dict:
+        return {"name": self.name, "state": self.state,
+                "version": self.version,
+                "health_failures": self.health_failures}
 
 
 class DeploymentInfo:
@@ -28,7 +65,8 @@ class DeploymentInfo:
                  ray_actor_options: dict, route_prefix: Optional[str],
                  max_concurrent_queries: int,
                  autoscaling_config: Optional[dict], version: str,
-                 user_config: Optional[Any] = None):
+                 user_config: Optional[Any] = None,
+                 max_queued_requests: int = -1):
         self.name = name
         self.deployment_def_bytes = deployment_def_bytes
         self.init_args = init_args
@@ -40,12 +78,22 @@ class DeploymentInfo:
         self.autoscaling_config = autoscaling_config
         self.version = version
         self.user_config = user_config
-        self.replicas: List[Any] = []  # live ActorHandles
+        self.max_queued_requests = max_queued_requests
+        self.replicas: List[ReplicaState] = []
+
+    def running(self) -> List[ReplicaState]:
+        return [r for r in self.replicas if r.state == RUNNING]
+
+
+async def _get_async(refs, timeout):
+    """Await a blocking ray_tpu.get off the controller's event loop (a
+    single loop serves every long-poll; it must never block)."""
+    return await asyncio.to_thread(ray_tpu.get, refs, timeout=timeout)
 
 
 class ServeController:
     """deploy/delete mutate desired state; a reconcile pass runs after every
-    mutation and periodically from the autoscale tick."""
+    mutation; a background control loop runs health checks and drains."""
 
     def __init__(self):
         self._deployments: Dict[str, DeploymentInfo] = {}
@@ -55,6 +103,8 @@ class ServeController:
         # LongPollHost): created lazily inside the actor's event loop;
         # replaced on every bump so each change wakes ALL parked waiters.
         self._changed = None
+        self._reconcile_lock: Optional[asyncio.Lock] = None
+        self._control_task = None
 
     def _bump_membership(self) -> None:
         self._membership_version += 1
@@ -63,6 +113,14 @@ class ServeController:
         if ev is not None:
             ev.set()
 
+    def _ensure_background(self) -> None:
+        """Start the health/drain control loop (lazily: __init__ may run
+        before the actor's event loop owns this coroutine context)."""
+        if self._reconcile_lock is None:
+            self._reconcile_lock = asyncio.Lock()
+        if self._control_task is None or self._control_task.done():
+            self._control_task = asyncio.ensure_future(self._control_loop())
+
     # -- desired state ---------------------------------------------------
 
     async def deploy(self, name: str, deployment_def_bytes: bytes,
@@ -70,31 +128,37 @@ class ServeController:
                      ray_actor_options: dict, route_prefix: Optional[str],
                      max_concurrent_queries: int,
                      autoscaling_config: Optional[dict],
-                     version: str, user_config: Optional[Any] = None) -> bool:
+                     version: str, user_config: Optional[Any] = None,
+                     max_queued_requests: int = -1) -> bool:
+        self._ensure_background()
         existing = self._deployments.get(name)
         info = DeploymentInfo(name, deployment_def_bytes, init_args,
                               init_kwargs, num_replicas, ray_actor_options,
                               route_prefix, max_concurrent_queries,
                               autoscaling_config, version,
-                              user_config=user_config)
+                              user_config=user_config,
+                              max_queued_requests=max_queued_requests)
         if existing is not None:
             if existing.version == version and \
                     existing.num_replicas == num_replicas:
+                if existing.max_queued_requests != max_queued_requests:
+                    existing.max_queued_requests = max_queued_requests
+                    self._bump_membership()
                 if existing.user_config != user_config:
                     # Same code/scale, new user_config: deliver it via
                     # reconfigure() without replica churn.
                     existing.user_config = user_config
                     if user_config is not None:
-                        ray_tpu.get([r.reconfigure.remote(user_config)
-                                     for r in existing.replicas])
+                        await _get_async(
+                            [r.handle.reconfigure.remote(user_config)
+                             for r in existing.replicas
+                             if r.state in (STARTING, RUNNING)], None)
                     return True
                 return False
-            # Code/config change: replace replicas (simple rolling=all).
-            info.replicas = [] if existing.version != version else \
-                existing.replicas
-            if existing.version != version:
-                for r in existing.replicas:
-                    ray_tpu.kill(r)
+            # Code or scale changed: adopt the existing replica set and
+            # reconcile — the rolling path starts the new generation
+            # before draining the old one (never a hard kill).
+            info.replicas = existing.replicas
         self._deployments[name] = info
         await self._reconcile(name)
         return True
@@ -103,77 +167,283 @@ class ServeController:
         info = self._deployments.pop(name, None)
         if info is None:
             return False
-        for r in info.replicas:
-            ray_tpu.kill(r)
+        # Unpublish first (routers and the proxy drop it on the push),
+        # then drain in-flight work bounded by the drain window.
         self._bump_membership()
+        victims = [r for r in info.replicas if r.state != STOPPED]
+        for rs in victims:
+            self._begin_drain(rs)
+        await self._drain_and_stop(victims)
         return True
 
     async def shutdown(self) -> bool:
+        if self._control_task is not None:
+            self._control_task.cancel()
+            self._control_task = None
         for name in list(self._deployments):
             await self.delete_deployment(name)
         return True
 
+    # -- replica lifecycle ------------------------------------------------
+
+    def _start_replica(self, info: DeploymentInfo) -> ReplicaState:
+        self._replica_seq += 1
+        cls = ray_tpu.remote(ReplicaActor)
+        opts = dict(info.ray_actor_options)
+        opts.setdefault("max_concurrency", info.max_concurrent_queries)
+        actor_name = f"_serve_replica::{info.name}::{self._replica_seq}"
+        opts["name"] = actor_name
+        opts["max_restarts"] = 3
+        handle = cls.options(**opts).remote(
+            info.name, info.deployment_def_bytes, info.init_args,
+            info.init_kwargs)
+        rs = ReplicaState(handle, actor_name, info.version)
+        info.replicas.append(rs)
+        return rs
+
+    def _stop_replica(self, info: DeploymentInfo, rs: ReplicaState) -> None:
+        rs.state = STOPPED
+        try:
+            ray_tpu.kill(rs.handle, no_restart=True)
+        except Exception:  # noqa: BLE001 - already dead
+            pass
+        if rs in info.replicas:
+            info.replicas.remove(rs)
+
+    def _begin_drain(self, rs: ReplicaState) -> None:
+        """DRAINING: refuse new requests (in-flight ones finish), wait
+        for num_ongoing to hit zero, then die — bounded by the window."""
+        if rs.state == DRAINING:
+            return
+        rs.state = DRAINING
+        rs.drain_deadline = asyncio.get_event_loop().time() + \
+            serve_config("serve_drain_timeout_s", 30.0)
+        try:
+            rs.handle.set_draining.remote()  # push; poll loop re-pushes
+        except Exception:  # noqa: BLE001 - replica already gone
+            pass
+
+    async def _drain_outcome(self, rs: ReplicaState) -> Optional[str]:
+        """None = still draining; else the serve_drained outcome tag."""
+        try:
+            n = (await _get_async([rs.handle.num_ongoing.remote()], 5))[0]
+        except Exception:  # noqa: BLE001 - died while draining
+            return "dead"
+        if n == 0:
+            return "clean"
+        if asyncio.get_event_loop().time() >= (rs.drain_deadline or 0):
+            return "timeout"
+        return None
+
+    async def _drain_and_stop(self, victims: List[ReplicaState]) -> None:
+        """Inline drain (delete/shutdown path): bounded by each victim's
+        drain deadline, immediate when idle."""
+        remaining = [r for r in victims if r.state == DRAINING]
+        while remaining:
+            still = []
+            for rs in remaining:
+                outcome = await self._drain_outcome(rs)
+                if outcome is None:
+                    still.append(rs)
+                    continue
+                self._finish_drain(None, rs, outcome)
+            if not still:
+                return
+            remaining = still
+            await asyncio.sleep(0.05)
+
+    def _finish_drain(self, info: Optional[DeploymentInfo],
+                      rs: ReplicaState, outcome: str) -> None:
+        rs.state = STOPPED
+        try:
+            ray_tpu.kill(rs.handle, no_restart=True)
+        except Exception:  # noqa: BLE001
+            pass
+        if info is not None and rs in info.replicas:
+            info.replicas.remove(rs)
+        builtin_metrics.serve_drained().inc(tags={"outcome": outcome})
+
     # -- reconciliation --------------------------------------------------
 
     async def _reconcile(self, name: str) -> None:
+        self._ensure_background()
+        async with self._reconcile_lock:
+            await self._reconcile_locked(name)
+
+    async def _reconcile_locked(self, name: str) -> None:
         info = self._deployments.get(name)
         if info is None:
             return
-        new_replicas = []
-        while len(info.replicas) < info.num_replicas:
-            self._replica_seq += 1
-            cls = ray_tpu.remote(ReplicaActor)
-            opts = dict(info.ray_actor_options)
-            opts.setdefault("max_concurrency", info.max_concurrent_queries)
-            opts["name"] = f"_serve_replica::{name}::{self._replica_seq}"
-            opts["max_restarts"] = 3
-            replica = cls.options(**opts).remote(
-                name, info.deployment_def_bytes, info.init_args,
-                info.init_kwargs)
-            info.replicas.append(replica)
-            new_replicas.append(replica)
-        while len(info.replicas) > info.num_replicas:
-            victim = info.replicas.pop()
-            ray_tpu.kill(victim)
+        # 1. Start missing current-generation replicas (rolling: the old
+        #    generation keeps serving while these come up).
+        current = [r for r in info.replicas
+                   if r.version == info.version
+                   and r.state in (STARTING, RUNNING)]
+        for _ in range(max(0, info.num_replicas - len(current))):
+            self._start_replica(info)
+        # 2. Bounded parallel startup wait (raises on exhausted budget).
+        new_running = await self._wait_for_startup(info)
+        # 3. Retire old-generation and excess replicas via draining.
+        victims = [r for r in info.replicas
+                   if r.state in (STARTING, RUNNING)
+                   and r.version != info.version]
+        current_running = [r for r in info.replicas
+                           if r.version == info.version
+                           and r.state == RUNNING]
+        excess = len(current_running) - info.num_replicas
+        if excess > 0:
+            # Newest first: the longest-lived replicas keep serving.
+            victims.extend(current_running[-excess:])
+        for rs in victims:
+            self._begin_drain(rs)
+        # 4. Publish the new membership in one push.
         self._bump_membership()
-        # Wait for replicas to become ready so run() returns a usable app.
-        for r in info.replicas:
-            ray_tpu.get(r.ready.remote())
-        if info.user_config is not None and new_replicas:
-            # user_config reaches NEW replicas via reconfigure(); existing
-            # ones already have it (re-sending on every health tick would
-            # re-run potentially expensive reloads).
-            ray_tpu.get([r.reconfigure.remote(info.user_config)
-                         for r in new_replicas])
+        # 5. user_config reaches NEW replicas via reconfigure(); existing
+        #    ones already have it (re-sending on every pass would re-run
+        #    potentially expensive reloads).
+        if info.user_config is not None and new_running:
+            await _get_async(
+                [r.handle.reconfigure.remote(info.user_config)
+                 for r in new_running if r.state == RUNNING], None)
 
-    async def check_health(self, name: str) -> int:
-        """Probe replicas; restart any that died. Returns live count
-        (reference: deployment_state health-check loop)."""
-        info = self._deployments.get(name)
-        if info is None:
-            return 0
-        live = []
-        for r in info.replicas:
+    async def _wait_for_startup(self, info: DeploymentInfo
+                                ) -> List[ReplicaState]:
+        """Wait (in parallel) for STARTING replicas of the current
+        version; kill-and-recreate failures against the start budget.
+        Returns the replicas that newly reached RUNNING."""
+        timeout = serve_config("serve_startup_timeout_s", 30.0)
+        budget = serve_config("serve_start_budget", 3)
+        became_running: List[ReplicaState] = []
+        last_error: Optional[BaseException] = None
+        while True:
+            starting = [r for r in info.replicas
+                        if r.state == STARTING
+                        and r.version == info.version]
+            if not starting:
+                return became_running
+
+            async def _ready(rs: ReplicaState) -> Optional[BaseException]:
+                try:
+                    await _get_async([rs.handle.ready.remote()], timeout)
+                    return None
+                except Exception as exc:  # noqa: BLE001 - hung/crashed
+                    return exc
+
+            results = await asyncio.gather(*[_ready(r) for r in starting])
+            failed = []
+            for rs, exc in zip(starting, results):
+                if exc is None:
+                    rs.state = RUNNING
+                    became_running.append(rs)
+                else:
+                    last_error = exc
+                    failed.append(rs)
+            if not failed:
+                continue
+            for rs in failed:
+                logger.warning(
+                    "Replica %s of %s failed to start (%s); killing and "
+                    "recreating.", rs.name, info.name, last_error)
+                self._stop_replica(info, rs)
+            if budget < len(failed):
+                raise RuntimeError(
+                    f"Deployment {info.name!r} failed to start: replicas "
+                    f"did not become ready within "
+                    f"serve_startup_timeout_s={timeout}s and the "
+                    f"serve_start_budget of retries is exhausted. Last "
+                    f"error: {type(last_error).__name__}: {last_error}")
+            budget -= len(failed)
+            for _ in failed:
+                self._start_replica(info)
+
+    # -- health / drain control loop --------------------------------------
+
+    async def _control_loop(self) -> None:
+        while True:
+            await asyncio.sleep(
+                serve_config("serve_health_check_period_s", 1.0))
             try:
-                ray_tpu.get([r.ready.remote()], timeout=5)
-                live.append(r)
-            except Exception:  # noqa: BLE001 - dead replica
-                logger.warning("Replica of %s failed health check", name)
-        info.replicas = live
-        await self._reconcile(name)
-        return len(live)
+                await self._health_pass()
+                await self._drain_pass()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - the loop must survive
+                logger.exception("serve control loop pass failed")
+
+    async def _probe(self, rs: ReplicaState,
+                     timeout: float) -> Optional[BaseException]:
+        try:
+            await _get_async([rs.handle.check_health.remote()], timeout)
+            return None
+        except Exception as exc:  # noqa: BLE001 - classified by caller
+            return exc
+
+    async def _health_pass(self) -> None:
+        timeout = serve_config("serve_health_check_timeout_s", 5.0)
+        threshold = serve_config("serve_health_failure_threshold", 3)
+        for name in list(self._deployments):
+            info = self._deployments.get(name)
+            if info is None:
+                continue
+            running = info.running()
+            if not running:
+                continue
+            results = await asyncio.gather(
+                *[self._probe(rs, timeout) for rs in running])
+            changed = False
+            for rs, exc in zip(running, results):
+                if exc is None:
+                    rs.health_failures = 0
+                    continue
+                rs.health_failures += 1
+                builtin_metrics.serve_health_check_failures().inc()
+                logger.warning(
+                    "Replica %s of %s failed health check (%d/%d): %s",
+                    rs.name, name, rs.health_failures, threshold, exc)
+                if is_system_failure(exc):
+                    # The actor itself is gone — draining is pointless.
+                    self._stop_replica(info, rs)
+                    changed = True
+                elif rs.health_failures >= threshold:
+                    self._begin_drain(rs)
+                    changed = True
+            if changed:
+                self._bump_membership()
+                await self._reconcile(name)  # start replacements now
+
+    async def _drain_pass(self) -> None:
+        for name in list(self._deployments):
+            info = self._deployments.get(name)
+            if info is None:
+                continue
+            for rs in [r for r in info.replicas if r.state == DRAINING]:
+                outcome = await self._drain_outcome(rs)
+                if outcome is not None:
+                    self._finish_drain(info, rs, outcome)
 
     # -- membership / routing -------------------------------------------
 
     async def membership_version(self) -> int:
         return self._membership_version
 
+    def _membership(self, info: DeploymentInfo):
+        return (self._membership_version,
+                [r.handle for r in info.replicas if r.state == RUNNING],
+                info.max_concurrent_queries, info.max_queued_requests)
+
     async def get_replicas(self, name: str):
         info = self._deployments.get(name)
         if info is None:
             raise ValueError(f"Deployment {name!r} does not exist")
-        return (self._membership_version, info.replicas,
-                info.max_concurrent_queries)
+        return self._membership(info)
+
+    async def replica_states(self, name: str) -> List[dict]:
+        """Lifecycle introspection (tests, chaos benches: find real
+        replica actor names to kill)."""
+        info = self._deployments.get(name)
+        if info is None:
+            return []
+        return [r.snapshot() for r in info.replicas]
 
     async def listen_for_change(self, key, last_version: int,
                                 timeout_s: float = 30.0):
@@ -182,7 +452,6 @@ class ServeController:
         keepalive timeout), then returns the current snapshot for
         ``key`` — ("replicas", name) or "routes". Routers/proxies call
         this from a background thread; the REQUEST path never does."""
-        import asyncio
         loop = asyncio.get_event_loop()
         deadline = loop.time() + timeout_s
         while self._membership_version <= last_version:
@@ -202,15 +471,14 @@ class ServeController:
         if info is None:
             # None (not []) = "no such deployment": routers fail requests
             # fast instead of waiting out the replica-appearance window.
-            return (self._membership_version, None, 1)
-        return (self._membership_version, list(info.replicas),
-                info.max_concurrent_queries)
+            return (self._membership_version, None, 1, -1)
+        return self._membership(info)
 
     async def list_deployments(self) -> Dict[str, dict]:
         return {
             name: {
                 "num_replicas": info.num_replicas,
-                "live_replicas": len(info.replicas),
+                "live_replicas": len(info.running()),
                 "route_prefix": info.route_prefix,
                 "version": info.version,
                 "autoscaling_config": info.autoscaling_config,
@@ -230,20 +498,24 @@ class ServeController:
         replicas sized to ongoing-requests / target). Called periodically by
         the proxy or tests."""
         decisions = {}
-        for name, info in self._deployments.items():
+        for name, info in list(self._deployments.items()):
             cfg = info.autoscaling_config
             if not cfg:
                 continue
             target = cfg.get("target_num_ongoing_requests_per_replica", 1)
             min_r = cfg.get("min_replicas", 1)
             max_r = cfg.get("max_replicas", max(info.num_replicas, 1))
-            total_ongoing = 0
-            for r in info.replicas:
+
+            async def _ongoing(rs: ReplicaState) -> int:
                 try:
-                    total_ongoing += ray_tpu.get(
-                        [r.num_ongoing.remote()], timeout=5)[0]
+                    return (await _get_async(
+                        [rs.handle.num_ongoing.remote()], 5))[0]
                 except Exception:  # noqa: BLE001
-                    pass
+                    return 0
+
+            counts = await asyncio.gather(
+                *[_ongoing(r) for r in info.running()])
+            total_ongoing = sum(counts)
             desired = max(min_r, min(max_r, round(total_ongoing / target)
                                      if target else min_r))
             if desired != info.num_replicas:

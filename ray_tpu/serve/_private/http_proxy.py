@@ -95,8 +95,12 @@ class HTTPProxyActor:
         return prefix in self._routes
 
     async def _wait_for_routes(self, timeout: float = 15.0) -> None:
+        # Wait only for the FIRST membership push (a request racing proxy
+        # startup): once a push has arrived (version >= 0), an empty
+        # table is authoritative — e.g. after deleting the last
+        # deployment — and must 404 immediately, not stall here.
         deadline = asyncio.get_event_loop().time() + timeout
-        while not self._routes and \
+        while self._version < 0 and \
                 asyncio.get_event_loop().time() < deadline:
             await asyncio.sleep(0.05)
 
@@ -120,10 +124,25 @@ class HTTPProxyActor:
         body = await request.read()
         req = Request(request.method, path, dict(request.query),
                       body, dict(request.headers))
-        ref = handle.remote(req)
+        from ray_tpu.exceptions import BackPressureError, GetTimeoutError
         try:
+            ref = handle.remote(req)
             result = await asyncio.to_thread(
                 lambda: ray_tpu.get([ref], timeout=60)[0])
+        except BackPressureError as e:
+            # Overload sheds, it doesn't error: clients should back off
+            # and retry (reference: serve proxy 503 on BackPressureError).
+            return web.json_response({"error": str(e)}, status=503,
+                                     headers={"Retry-After": "1"})
+        except GetTimeoutError as e:
+            # A handle.options(timeout_s=...) deadline (or the proxy's
+            # own 60s cap) expired before a replica answered.
+            return web.json_response({"error": str(e)}, status=504)
+        except ValueError as e:
+            # Router-side "deployment does not exist": the route table
+            # is mid-refresh after a delete. Application ValueErrors
+            # arrive wrapped in TaskError, so this is unambiguous.
+            return web.json_response({"error": str(e)}, status=404)
         except Exception as e:  # noqa: BLE001 - surface as 500
             return web.json_response({"error": str(e)}, status=500)
         if isinstance(result, bytes):

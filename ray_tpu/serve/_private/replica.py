@@ -5,6 +5,21 @@ unwraps the deployment definition (class or function), constructs it once
 (handles to other deployments arrive through init args — the DAG
 composition path), then serves `handle_request` calls. Async methods are
 awaited; `@serve.batch` methods batch transparently (serve/batching.py).
+
+Lifecycle hooks (reference: replica.py check_health + drain protocol):
+``check_health`` probes the user's ``check_health()`` when the deployment
+defines one; ``set_draining`` flips the replica into drain mode — new
+requests are refused with ActorDiedError (a SYSTEM failure, so routers
+transparently fail them over to the new generation) while in-flight ones
+run to completion and ``num_ongoing`` counts them down for the
+controller's drain poll.
+
+Chaos sites ``serve.replica_kill`` / ``serve.replica_delay_ms`` are
+evaluated at the top of every ``handle_request``: a ``kill`` op makes
+the replica play dead (every subsequent call raises ActorDiedError, the
+same signal a genuinely killed actor produces), a ``delay_ms`` op
+stalls the event loop — a whole-replica slowdown, the "slow replica"
+failure mode.
 """
 
 from __future__ import annotations
@@ -12,6 +27,9 @@ from __future__ import annotations
 import asyncio
 import inspect
 from typing import Any
+
+from ray_tpu._private import chaos
+from ray_tpu.exceptions import ActorDiedError
 
 
 class ReplicaActor:
@@ -27,11 +45,41 @@ class ReplicaActor:
             self._callable = deployment_def(*(init_args or ()),
                                             **(init_kwargs or {}))
         self._ongoing = 0
+        self._draining = False
+        self._chaos_dead = False
+
+    def _refuse(self, why: str) -> ActorDiedError:
+        # The router classifies ActorDiedError (directly, or as the cause
+        # inside the executor's TaskError wrapper) as a SYSTEM failure
+        # and transparently fails the request over to another replica.
+        return ActorDiedError(
+            message=f"Replica of {self._deployment_name} is {why}.")
 
     async def ready(self) -> bool:
+        if self._chaos_dead:
+            raise self._refuse("dead (chaos kill)")
         return True
 
     async def num_ongoing(self) -> int:
+        return self._ongoing
+
+    async def check_health(self) -> bool:
+        """Controller health probe: defers to the deployment's own
+        ``check_health()`` when defined (sync or async); raising (or a
+        chaos kill) marks the probe failed."""
+        if self._chaos_dead:
+            raise self._refuse("dead (chaos kill)")
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            result = fn()
+            if inspect.iscoroutine(result):
+                await result
+        return True
+
+    async def set_draining(self) -> int:
+        """Enter drain mode; returns the in-flight count at that moment
+        (the controller polls num_ongoing until it reaches zero)."""
+        self._draining = True
         return self._ongoing
 
     async def reconfigure(self, user_config: Any) -> bool:
@@ -43,6 +91,16 @@ class ReplicaActor:
         return True
 
     async def handle_request(self, method_name: str, args, kwargs):
+        if chaos.ACTIVE:
+            chaos.maybe_inject("serve.replica_delay_ms")
+            try:
+                chaos.maybe_inject("serve.replica_kill")
+            except chaos.ChaosKill:
+                self._chaos_dead = True
+        if self._chaos_dead:
+            raise self._refuse("dead (chaos kill)")
+        if self._draining:
+            raise self._refuse("draining")
         self._ongoing += 1
         try:
             if self._is_function:

@@ -5,22 +5,66 @@ Analog of the reference's serve/_private/router.py:261 (assign_request
 :298) + _private/long_poll.py:68 LongPollClient: membership is PUSHED to
 the router through a controller long-poll running on a background thread,
 and per-replica load is tracked ROUTER-LOCALLY (incremented at assignment,
-decremented when the assigned ObjectRef completes). The request path does
+decremented when the assigned call completes). The request path does
 zero controller RPCs: pick the less-loaded of two random replicas
 (power-of-two choices) from the local table and call it.
+
+Resilience (reference: router retry_exception_types + serve's
+max_queued_requests cap):
+
+* **Transparent failover** — ``assign_request`` returns a router-minted
+  PROMISE ref, not the raw replica-call ref. The router remembers
+  ``(method, args, kwargs)`` per outstanding request; when the replica
+  call seals with a SYSTEM failure (actor death / object loss — never an
+  application exception) the request is re-dispatched to another live
+  replica under a per-request retry budget, and the caller's ref simply
+  resolves later. Completion is event-driven via the object store's
+  seal callbacks — no polling thread, nothing added to the hot path.
+* **Deadlines** — ``handle.options(timeout_s=...)`` arms a lazy timer;
+  expiry settles the promise with GetTimeoutError, best-effort cancels
+  the in-flight replica call, and drains the load-table charge.
+* **Backpressure** — with ``max_queued_requests`` set on the deployment,
+  requests beyond (replicas x max_concurrent_queries) + cap fast-fail
+  with BackPressureError instead of queueing unboundedly.
 """
 
 from __future__ import annotations
 
+import heapq
 import logging
 import random
 import threading
 import time
-from typing import Any, Dict, List
+from collections import deque
+from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private import builtin_metrics
+from ray_tpu.exceptions import BackPressureError, GetTimeoutError
+from ray_tpu.serve._private.common import is_system_failure, serve_config
 
 logger = logging.getLogger("ray_tpu.serve")
+
+# How long an evicted-by-failure replica stays unpickable while the
+# (possibly stale) membership table still lists it.
+_SUSPECT_TTL_S = 5.0
+
+
+class _PendingRequest:
+    __slots__ = ("req_id", "method", "args", "kwargs", "promise", "inner",
+                 "replica_hex", "retries_left", "deadline")
+
+    def __init__(self, req_id: int, method: str, args, kwargs, promise,
+                 retries_left: int, deadline: Optional[float]):
+        self.req_id = req_id
+        self.method = method
+        self.args = args
+        self.kwargs = kwargs
+        self.promise = promise
+        self.inner = None  # ObjectRef of the current replica-call attempt
+        self.replica_hex: Optional[str] = None  # charged replica
+        self.retries_left = retries_left
+        self.deadline = deadline  # monotonic, None = no deadline
 
 
 class Router:
@@ -30,19 +74,40 @@ class Router:
         self._version = -1
         self._replicas: List[Any] = []
         self._max_queries = 1
+        self._max_queued = -1  # -1 = unlimited (no shedding)
         self._lock = threading.Lock()
         # actor_id hex -> requests assigned by THIS router still in
         # flight (reference: router-local num_ongoing, no replica RPCs).
         self._ongoing: Dict[str, int] = {}
-        self._outstanding: Dict[Any, str] = {}  # ObjectRef -> actor hex
-        self._have_work = threading.Event()
+        # req_id -> _PendingRequest: every accepted, unsettled request.
+        self._requests: Dict[int, _PendingRequest] = {}
+        self._req_seq = 0
+        # Replicas evicted after a system failure: hex -> monotonic
+        # expiry. Keeps a dead replica unpickable while the membership
+        # table is stale (the controller needs a health tick to notice).
+        self._suspect: Dict[str, float] = {}
         self._have_replicas = threading.Event()
         self._polled = threading.Event()  # first membership answer seen
         self._known = True  # deployment exists, per last poll
         self._stop = False
         self._threads_started = False
+        # Failover re-dispatch queue: seal callbacks run on whatever
+        # thread sealed the result and must not block in pick_replica's
+        # membership waits, so a dedicated worker re-dispatches.
+        self._retry_queue: deque = deque()
+        self._retry_wake = threading.Event()
+        self._retry_thread_started = False
+        # Deadline timer (lazy: only requests with timeout_s pay for it).
+        self._timer_heap: List[tuple] = []  # (deadline, req_id)
+        self._timer_cond = threading.Condition(self._lock)
+        self._timer_thread_started = False
 
-    # -- background membership + completion tracking --------------------
+    @staticmethod
+    def _runtime():
+        from ray_tpu._private.worker import global_worker
+        return global_worker.runtime
+
+    # -- background membership tracking ----------------------------------
 
     def _ensure_threads(self) -> None:
         if self._threads_started:
@@ -53,8 +118,6 @@ class Router:
             self._threads_started = True
         threading.Thread(target=self._poll_loop, daemon=True,
                          name=f"serve-router-poll-{self._name}").start()
-        threading.Thread(target=self._drain_loop, daemon=True,
-                         name=f"serve-router-drain-{self._name}").start()
 
     def _poll_loop(self) -> None:
         """Long-poll membership (reference: LongPollClient): blocks in
@@ -63,7 +126,7 @@ class Router:
         from ray_tpu.exceptions import ActorError
         while not self._stop:
             try:
-                ver, replicas, max_q = ray_tpu.get(
+                ver, replicas, max_q, max_queued = ray_tpu.get(
                     self._controller.listen_for_change.remote(
                         ("replicas", self._name), self._version),
                     timeout=90)
@@ -76,54 +139,153 @@ class Router:
                 self._version = ver
                 self._known = replicas is not None
                 self._replicas = list(replicas or ())
+                self._max_queries = max_q
+                self._max_queued = max_queued
                 live = set()
                 for r in self._replicas:
                     hexid = r._actor_id.hex()
                     live.add(hexid)
                     self._ongoing.setdefault(hexid, 0)
+                # Prune stale charges AND stale suspicions for replicas
+                # that left membership (long-lived routers must not bias
+                # power-of-two picks on ghosts).
                 for gone in set(self._ongoing) - live:
                     del self._ongoing[gone]
-                self._max_queries = max_q
+                for gone in set(self._suspect) - live:
+                    del self._suspect[gone]
             if self._replicas:
                 self._have_replicas.set()
             else:
                 self._have_replicas.clear()
             self._polled.set()
 
-    def _drain_loop(self) -> None:
-        """Decrement router-local load as assigned calls complete (the
-        thread owns the waiting; the request path never blocks)."""
+    def _ensure_retry_thread(self) -> None:
+        if self._retry_thread_started:
+            return
+        with self._lock:
+            if self._retry_thread_started:
+                return
+            self._retry_thread_started = True
+        threading.Thread(target=self._retry_loop, daemon=True,
+                         name=f"serve-router-retry-{self._name}").start()
+
+    def _retry_loop(self) -> None:
         while not self._stop:
-            with self._lock:
-                refs = list(self._outstanding)
-            if not refs:
-                self._have_work.wait(timeout=0.5)
-                self._have_work.clear()
+            if not self._retry_queue:
+                self._retry_wake.wait(timeout=1.0)
+                self._retry_wake.clear()
                 continue
             try:
-                # BLOCK for the first completion (condition-wait inside
-                # the runtime, not a 50ms poll — a router per deployment
-                # must not burn constant CPU), then scoop every other
-                # already-done ref in one non-blocking sweep.
-                done, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.5)
-                if done and len(refs) > 1:
-                    done, _ = ray_tpu.wait(refs, num_returns=len(refs),
-                                           timeout=0)
-            except Exception:  # noqa: BLE001 - shutdown window
-                time.sleep(0.05)
+                pending = self._retry_queue.popleft()
+            except IndexError:
                 continue
-            if not done:
-                continue
-            with self._lock:
-                for ref in done:
-                    hexid = self._outstanding.pop(ref, None)
-                    if hexid is not None and hexid in self._ongoing:
-                        self._ongoing[hexid] = max(
-                            0, self._ongoing[hexid] - 1)
+            try:
+                self._dispatch(pending)
+            except Exception as exc:  # noqa: BLE001 - no replica to take it
+                self._settle(pending.req_id, exception=exc)
+
+    def _ensure_timer_thread(self) -> None:
+        if self._timer_thread_started:
+            return
+        with self._lock:
+            if self._timer_thread_started:
+                return
+            self._timer_thread_started = True
+        threading.Thread(target=self._timer_loop, daemon=True,
+                         name=f"serve-router-timer-{self._name}").start()
+
+    def _timer_loop(self) -> None:
+        while not self._stop:
+            with self._timer_cond:
+                while self._timer_heap and \
+                        self._timer_heap[0][0] <= time.monotonic():
+                    _, req_id = heapq.heappop(self._timer_heap)
+                    pending = self._requests.get(req_id)
+                    if pending is None:
+                        continue
+                    # Settle outside the lock (fulfill + cancel).
+                    threading.Thread(
+                        target=self._expire, args=(req_id,),
+                        daemon=True).start()
+                wait = 1.0
+                if self._timer_heap:
+                    wait = max(0.0,
+                               self._timer_heap[0][0] - time.monotonic())
+                self._timer_cond.wait(timeout=min(wait, 1.0))
+
+    def _expire(self, req_id: int) -> None:
+        with self._lock:
+            pending = self._requests.get(req_id)
+            inner = pending.inner if pending is not None else None
+        if pending is None:
+            return
+        self._settle(req_id, exception=GetTimeoutError(
+            f"Serve request to {self._name!r} did not complete within "
+            f"its timeout_s deadline."))
+        if inner is not None:
+            try:  # best-effort: free the replica slot early
+                ray_tpu.cancel(inner)
+            except Exception:  # noqa: BLE001
+                pass
 
     def stop(self) -> None:
         self._stop = True
-        self._have_work.set()
+        self._retry_wake.set()
+        with self._timer_cond:
+            self._timer_cond.notify_all()
+
+    # -- completion / settlement -----------------------------------------
+
+    def _uncharge(self, hexid: Optional[str]) -> None:
+        """Caller holds self._lock."""
+        if hexid is not None and hexid in self._ongoing:
+            self._ongoing[hexid] = max(0, self._ongoing[hexid] - 1)
+
+    def _settle(self, req_id: int, *, alias=None, exception=None) -> None:
+        """Resolve the caller-visible promise and drop the request from
+        the load table. Idempotent: first settle wins (the store's
+        first-write-wins seal backs this up for racing settles)."""
+        with self._lock:
+            pending = self._requests.pop(req_id, None)
+            if pending is None:
+                return
+            self._uncharge(pending.replica_hex)
+            pending.replica_hex = None
+        self._runtime().fulfill_promise(pending.promise, alias=alias,
+                                        exception=exception)
+
+    def _on_sealed(self, req_id: int, ref) -> None:
+        """Seal callback for one replica-call attempt: runs on whatever
+        thread sealed the result. Classifies the outcome; system
+        failures re-dispatch (failover), everything else resolves the
+        caller's promise by aliasing the attempt's ref."""
+        with self._lock:
+            pending = self._requests.get(req_id)
+            if pending is None or pending.inner is not ref:
+                return  # settled/superseded: accounting already done
+            hexid = pending.replica_hex
+            self._uncharge(hexid)
+            pending.replica_hex = None
+        try:
+            exc = self._runtime().store.get_if_exception(ref.object_id())
+        except Exception:  # noqa: BLE001 - undeserializable error payload
+            exc = None
+        if exc is not None and is_system_failure(exc) \
+                and pending.retries_left > 0 and not self._stop:
+            with self._lock:
+                pending.retries_left -= 1
+                if hexid is not None:
+                    # Keep the dead replica unpickable while membership
+                    # is stale; the poll loop clears it on refresh.
+                    self._suspect[hexid] = time.monotonic() + _SUSPECT_TTL_S
+            builtin_metrics.serve_failovers().inc()
+            logger.info("Failing over a request to %s after: %s",
+                        self._name, exc)
+            self._ensure_retry_thread()
+            self._retry_queue.append(pending)
+            self._retry_wake.set()
+            return
+        self._settle(req_id, alias=ref)
 
     # -- request path (zero controller RPCs) -----------------------------
 
@@ -144,6 +306,14 @@ class Router:
             if not replicas:
                 raise RuntimeError(
                     f"Deployment {self._name!r} has no live replicas")
+            if self._suspect:
+                now = time.monotonic()
+                healthy = [r for r in replicas
+                           if self._suspect.get(r._actor_id.hex(), 0) <= now]
+                # A fully-suspect table still dispatches (a retry against
+                # a suspect beats failing the request outright).
+                if healthy:
+                    replicas = healthy
             if len(replicas) == 1:
                 choice = replicas[0]
             else:
@@ -156,20 +326,80 @@ class Router:
             self._ongoing[hexid] = self._ongoing.get(hexid, 0) + 1
         return choice
 
-    def assign_request(self, method_name: str, args, kwargs):
-        """Returns an ObjectRef of the replica call."""
+    def _dispatch(self, pending: _PendingRequest) -> None:
+        """Charge a replica, submit the call, subscribe to completion.
+        Used for both first dispatch and failover re-dispatch."""
         replica = self.pick_replica()
+        hexid = replica._actor_id.hex()
         try:
-            ref = replica.handle_request.remote(method_name, args, kwargs)
+            ref = replica.handle_request.remote(
+                pending.method, pending.args, pending.kwargs)
         except BaseException:
             # The pick already charged this replica; a failed submit has
             # no completing ref to drain the charge back.
             with self._lock:
-                hexid = replica._actor_id.hex()
-                if hexid in self._ongoing:
-                    self._ongoing[hexid] = max(0, self._ongoing[hexid] - 1)
+                self._uncharge(hexid)
             raise
         with self._lock:
-            self._outstanding[ref] = replica._actor_id.hex()
-        self._have_work.set()
-        return ref
+            live = self._requests.get(pending.req_id)
+            if live is not pending:
+                # Expired/settled while we were picking: the settle path
+                # already drained the OLD charge; drain the one we just
+                # took and abandon the attempt.
+                self._uncharge(hexid)
+                return
+            pending.inner = ref
+            pending.replica_hex = hexid
+        self._runtime().store.on_sealed(
+            ref.object_id(),
+            lambda _oid, rid=pending.req_id, r=ref: self._on_sealed(rid, r))
+
+    def assign_request(self, method_name: str, args, kwargs,
+                       timeout_s: Optional[float] = None,
+                       max_retries: Optional[int] = None):
+        """Returns a promise ObjectRef that resolves to the request's
+        result — across failover re-dispatches if needed."""
+        self._ensure_threads()
+        with self._lock:
+            max_queued = self._max_queued
+            if max_queued is not None and max_queued >= 0 \
+                    and self._replicas:
+                capacity = len(self._replicas) * max(1, self._max_queries)
+                outstanding = len(self._requests)
+                if outstanding >= capacity + max_queued:
+                    shed = BackPressureError(
+                        num_queued=outstanding - capacity,
+                        max_queued=max_queued, deployment=self._name)
+                else:
+                    shed = None
+            else:
+                shed = None
+        if shed is not None:
+            builtin_metrics.serve_shed().inc()
+            raise shed
+        if max_retries is None:
+            max_retries = serve_config("serve_failover_retries", 3)
+        runtime = self._runtime()
+        promise = runtime.create_promise()
+        deadline = None
+        if timeout_s is not None:
+            deadline = time.monotonic() + timeout_s
+        with self._lock:
+            self._req_seq += 1
+            pending = _PendingRequest(self._req_seq, method_name, args,
+                                      kwargs, promise, max_retries,
+                                      deadline)
+            self._requests[pending.req_id] = pending
+        try:
+            self._dispatch(pending)
+        except BaseException:
+            with self._lock:
+                self._requests.pop(pending.req_id, None)
+            raise
+        if deadline is not None:
+            self._ensure_timer_thread()
+            with self._timer_cond:
+                heapq.heappush(self._timer_heap,
+                               (deadline, pending.req_id))
+                self._timer_cond.notify_all()
+        return promise

@@ -5,6 +5,12 @@ Analog of the reference's serve/handle.py RayServeHandle:
 ``handle.method.remote(...)`` targets a specific method. Handles pickle by
 name and re-bind through the controller, so they can be passed into other
 deployments (DAG composition) or tasks.
+
+``handle.options(timeout_s=..., max_retries=...)`` returns a configured
+handle sharing the same router (reference: handle.options): timeout_s
+arms a per-request deadline (expiry raises GetTimeoutError at get),
+max_retries caps transparent failover re-dispatches for requests issued
+through that handle.
 """
 
 from __future__ import annotations
@@ -13,6 +19,8 @@ from typing import Any, Optional
 
 from ray_tpu.serve._private.router import Router
 
+_HANDLE_OPTIONS = ("timeout_s", "max_retries")
+
 
 class _MethodCaller:
     def __init__(self, handle: "DeploymentHandle", method_name: str):
@@ -20,8 +28,10 @@ class _MethodCaller:
         self._method_name = method_name
 
     def remote(self, *args, **kwargs):
-        return self._handle._router.assign_request(
-            self._method_name, args, kwargs)
+        h = self._handle
+        return h._router.assign_request(
+            self._method_name, args, kwargs,
+            timeout_s=h._timeout_s, max_retries=h._max_retries)
 
 
 class DeploymentHandle:
@@ -31,20 +41,47 @@ class DeploymentHandle:
         self.deployment_name = deployment_name
         self._controller = controller or get_or_create_controller()
         self._router = Router(self._controller, deployment_name)
+        self._timeout_s: Optional[float] = None
+        self._max_retries: Optional[int] = None
 
     def remote(self, *args, **kwargs):
-        return self._router.assign_request("__call__", args, kwargs)
+        return self._router.assign_request(
+            "__call__", args, kwargs,
+            timeout_s=self._timeout_s, max_retries=self._max_retries)
 
     def __getattr__(self, item):
         if item.startswith("_"):
             raise AttributeError(item)
         return _MethodCaller(self, item)
 
-    def options(self, **_kwargs) -> "DeploymentHandle":
-        return self
+    def options(self, **kwargs) -> "DeploymentHandle":
+        """A configured copy SHARING this handle's router (and therefore
+        its membership long-poll and load table) — options never spawn
+        new control-plane traffic. Unknown keys raise TypeError instead
+        of being silently dropped."""
+        unknown = set(kwargs) - set(_HANDLE_OPTIONS)
+        if unknown:
+            raise TypeError(
+                f"Unknown DeploymentHandle options {sorted(unknown)}; "
+                f"supported: {list(_HANDLE_OPTIONS)}")
+        clone = DeploymentHandle.__new__(DeploymentHandle)
+        clone.deployment_name = self.deployment_name
+        clone._controller = self._controller
+        clone._router = self._router
+        clone._timeout_s = kwargs.get("timeout_s", self._timeout_s)
+        clone._max_retries = kwargs.get("max_retries", self._max_retries)
+        return clone
+
+    @classmethod
+    def _rebuild(cls, deployment_name: str, timeout_s, max_retries):
+        handle = cls(deployment_name)
+        handle._timeout_s = timeout_s
+        handle._max_retries = max_retries
+        return handle
 
     def __reduce__(self):
-        return (DeploymentHandle, (self.deployment_name,))
+        return (DeploymentHandle._rebuild,
+                (self.deployment_name, self._timeout_s, self._max_retries))
 
     def __repr__(self):
         return f"DeploymentHandle({self.deployment_name!r})"

@@ -21,6 +21,7 @@ class DeploymentSchema:
     name: str
     num_replicas: Optional[int] = None
     max_concurrent_queries: Optional[int] = None
+    max_queued_requests: Optional[int] = None
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     autoscaling_config: Optional[Dict[str, Any]] = None
     user_config: Optional[Dict[str, Any]] = None
@@ -31,6 +32,11 @@ class DeploymentSchema:
         if self.num_replicas is not None and self.num_replicas < 0:
             raise ValueError(
                 f"num_replicas must be >= 0, got {self.num_replicas}")
+        if self.max_queued_requests is not None \
+                and self.max_queued_requests < -1:
+            raise ValueError(
+                f"max_queued_requests must be >= -1 (-1 = unlimited), "
+                f"got {self.max_queued_requests}")
         if self.autoscaling_config:
             mn = self.autoscaling_config.get("min_replicas", 1)
             mx = self.autoscaling_config.get("max_replicas", mn)
@@ -114,6 +120,8 @@ def apply_config(config: Dict[str, Any]):
             if o.max_concurrent_queries is not None:
                 dep._config["max_concurrent_queries"] = \
                     o.max_concurrent_queries
+            if o.max_queued_requests is not None:
+                dep._config["max_queued_requests"] = o.max_queued_requests
             if o.autoscaling_config is not None:
                 dep._config["autoscaling_config"] = o.autoscaling_config
             if o.ray_actor_options:

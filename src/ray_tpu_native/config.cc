@@ -114,6 +114,17 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     // an outbound frame piggybacked it first.
     FLAG_INT(channel_ack_every, 32),
     FLAG_INT(channel_ack_flush_ms, 20),
+    // -- serve resilience --
+    // Bounded replica startup (retried against the start budget),
+    // graceful-drain window, parallel health-check cadence/threshold,
+    // and the router's per-request failover retry budget.
+    FLAG_DBL(serve_startup_timeout_s, 30.0),
+    FLAG_INT(serve_start_budget, 3),
+    FLAG_DBL(serve_drain_timeout_s, 30.0),
+    FLAG_DBL(serve_health_check_period_s, 1.0),
+    FLAG_DBL(serve_health_check_timeout_s, 5.0),
+    FLAG_INT(serve_health_failure_threshold, 3),
+    FLAG_INT(serve_failover_retries, 3),
     // -- metrics / events --
     FLAG_INT(metrics_report_interval_ms, 10000),
     FLAG_BOOL(task_events_enabled, true),
