@@ -613,6 +613,72 @@ def bench_channel_reconnect() -> dict:
     return out
 
 
+def bench_object_recovery() -> dict:
+    """Durable-spill recovery latency: a daemon spills its only copy of
+    a large result through session:// storage, then dies by SIGKILL; the
+    metric is kill -> get() completion, i.e. death detection + node
+    removal + tiered recovery (spill-URI restore, NOT producer
+    re-execution). Bounds the stall node loss adds to a consumer of a
+    spilled object."""
+    import json as _json
+    import os as _os
+    import signal as _signal
+    import subprocess
+    import sys
+    import time as _time
+
+    import numpy as _np
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+
+    out = {}
+    ray_tpu.init(num_cpus=1)
+    procs = []
+    try:
+        host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+        env = dict(_os.environ)
+        env["RAY_TPU_object_spill_uri"] = "session://"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.multinode",
+             "--address", f"127.0.0.1:{port}", "--num-cpus", "2",
+             "--resources", _json.dumps({"spillnode": 1}),
+             "--object-store-memory", str(4 * 1024 * 1024)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("spillnode", 0) >= 1:
+                break
+            _time.sleep(0.1)
+        else:
+            raise TimeoutError("daemon never registered")
+
+        @ray_tpu.remote(resources={"spillnode": 1})
+        def produce():
+            return _np.arange(1024 * 1024, dtype=_np.int64)  # 8 MB
+
+        ref = produce.remote()
+        runtime = global_worker.runtime
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            if runtime._spill_uris_by_key:
+                break
+            _time.sleep(0.02)
+        else:
+            raise TimeoutError("spill URI never announced")
+        procs[0].send_signal(_signal.SIGKILL)
+        t0 = _time.perf_counter()
+        value = ray_tpu.get(ref, timeout=120)
+        out["object_recovery_ms"] = round(
+            (_time.perf_counter() - t0) * 1e3, 1)
+        assert int(value[-1]) == 1024 * 1024 - 1
+    finally:
+        _stop_procs(procs)
+        ray_tpu.shutdown()
+    return out
+
+
 def bench_serve() -> dict:
     """Serving-plane throughput/latency (reference: release/serve_tests
     autoscaling_single_deployment + single_deployment_1k_noop_replica):
@@ -1421,6 +1487,7 @@ def main(argv=None):
          bench_detached_restart),
         ("channel_reconnect", "channel_reconnect_ms",
          bench_channel_reconnect),
+        ("object_recovery", "object_recovery_ms", bench_object_recovery),
         ("log_stream", "log_lines_per_sec", bench_log_streaming),
         ("metrics_overhead", "metrics_overhead_pct",
          bench_metrics_overhead),

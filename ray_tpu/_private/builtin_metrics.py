@@ -204,6 +204,27 @@ def object_spilled_bytes() -> Counter:
                    "Bytes spilled from the object store to disk.")
 
 
+def object_restores() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_object_restores_total",
+        "Lost-object recoveries by the tier that paid for them: "
+        "replica = re-pointed at another in-memory holder, spill = "
+        "payload read back from a surviving spill URI, lineage = "
+        "producer task re-executed (the most expensive tier).",
+        tag_keys=("source",))
+
+
+def object_spill_failures() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_object_spill_failures_total",
+        "Spill-backend IO failures by op (write = spill kept the "
+        "in-memory copy instead; restore = tier miss, recovery fell "
+        "down a tier). Includes chaos-injected io_oserror faults.",
+        tag_keys=("op",))
+
+
 def object_store_hits() -> Counter:
     from ray_tpu.util.metrics import Counter
     return Counter("ray_tpu_object_store_hits_total",

@@ -16,6 +16,9 @@ Kinds
     kill           raise :class:`ChaosKill` from a ``*kill`` site (serve
                    replicas treat it as sudden death: the actor plays
                    dead from then on, exercising failover/replacement)
+    io_oserror     raise an OSError from a ``*_error`` storage-IO site
+                   (spill writes/restores; degrades a tier instead of
+                   failing the caller)
 
 Params
     p      firing probability per matching call (default 1.0)
@@ -29,7 +32,8 @@ Sites: ``head.send`` / ``head.recv`` (head side of a session channel),
 ``daemon.send`` / ``daemon.recv`` (daemon side), ``pull.send``
 (dataplane pooled pull sockets), ``serve.replica_kill`` /
 ``serve.replica_delay_ms`` (serve replica request path — evaluated at
-the top of every ``handle_request``).
+the top of every ``handle_request``), ``spill.write_error`` /
+``spill.restore_error`` (spill-backend IO, see _private/spill.py).
 
 Hot paths guard on the module-level :data:`ACTIVE` flag, so with chaos
 disabled the per-frame cost is a single attribute read and no call.
@@ -52,7 +56,8 @@ ACTIVE = False
 _LOCK = threading.Lock()
 _OPS: List["_Op"] = []
 _DEFAULT_SEED = 0xC4A05
-_KINDS = ("send_oserror", "recv_oserror", "sock_close", "delay_ms", "kill")
+_KINDS = ("send_oserror", "recv_oserror", "sock_close", "delay_ms", "kill",
+          "io_oserror")
 
 
 class ChaosError(OSError):
@@ -136,6 +141,8 @@ def maybe_inject(site: str, sock=None) -> None:
             if op.kind == "recv_oserror" and ".recv" not in site:
                 continue
             if op.kind == "kill" and "kill" not in site:
+                continue
+            if op.kind == "io_oserror" and "_error" not in site:
                 continue
             op.seen += 1
             if op.seen <= op.after:

@@ -111,15 +111,22 @@ class ObjectMarker:
 
     ``owner_addr is None`` means "local to the target daemon" (the
     plasma-local read). Otherwise the executing daemon pulls from
-    ``owner_addr`` (a peer daemon's object server)."""
+    ``owner_addr`` (a peer daemon's object server). ``alt_addrs`` are
+    additional known holders (replica copies learned by the head's
+    location table): a pull that loses ``owner_addr`` mid-flight fails
+    over to them chunk-by-chunk instead of erroring into
+    reconstruction. ``spill_uri`` is a durable spilled copy any node
+    can restore when every holder is gone."""
 
-    __slots__ = ("key", "owner_addr", "size")
+    __slots__ = ("key", "owner_addr", "size", "alt_addrs", "spill_uri")
 
     def __init__(self, key: str, owner_addr: Optional[Tuple[str, int]] = None,
-                 size: int = 0):
+                 size: int = 0, alt_addrs=(), spill_uri: Optional[str] = None):
         self.key = key
         self.owner_addr = owner_addr
         self.size = size
+        self.alt_addrs = tuple(alt_addrs)
+        self.spill_uri = spill_uri
 
 
 class NodeObjectTable:
@@ -135,7 +142,7 @@ class NodeObjectTable:
     Losing an object then requires node death, not a busy shuffle."""
 
     def __init__(self, capacity: int = 0, arena_name: Optional[str] = None,
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None, spill_backend=None):
         self._heap: Dict[str, bytes] = {}
         self._lock = threading.Lock()
         self._arena = None
@@ -172,6 +179,14 @@ class NodeObjectTable:
         self._spill_lock = threading.Lock()
         self._spill_seq = 0  # per-write spill filename uniquifier
         self._spill_dir: Optional[str] = None
+        self._spill_backend = None  # _private.spill.SpillBackend
+        #: key -> durable spill URI, announced to the head so recovery
+        #: can restore the payload after this daemon dies.
+        self._spill_uris: Dict[str, str] = {}
+        # Daemon-installed notices (fired outside self._lock): the head
+        # learns durable spill URIs through these.
+        self.on_spilled = None  # fn(key, uri, size)
+        self.on_unspilled = None  # fn(key)
         if capacity > 0:
             try:
                 from ray_tpu._private.native_store import NativeObjectStore
@@ -179,24 +194,37 @@ class NodeObjectTable:
                                                 name=arena_name)
             except Exception:  # noqa: BLE001 - no compiler → heap fallback
                 self._arena = None
-        if self._arena is not None and spill_dir:
-            os.makedirs(spill_dir, exist_ok=True)
-            self._spill_dir = spill_dir
+        if self._arena is not None and (spill_dir or spill_backend
+                                        is not None):
+            if spill_backend is None:
+                from ray_tpu._private.spill import FileSpillBackend
+                spill_backend = FileSpillBackend(spill_dir)
+            self._spill_backend = spill_backend
+            self._spill_dir = spill_backend.root
             self._arena.set_evict_disabled(True)
+
+    def set_spill_backend(self, backend) -> None:
+        """Swap the backend for FUTURE spill writes (the daemon upgrades
+        file:// → session:// once registration hands it the session id).
+        Already-written records carry absolute paths, so they stay
+        readable under the old root."""
+        if self._arena is None or backend is None:
+            return
+        self._spill_backend = backend
+        self._spill_dir = backend.root
+        self._arena.set_evict_disabled(True)
 
     # -- disk spill / restore -------------------------------------------
 
-    def _spill_path(self, key: str) -> str:
-        # Unique per WRITE, not per key: free() unlinks its popped
+    def _spill_name(self, key: str) -> str:
+        # Unique per WRITE, not per key: free() deletes its popped
         # record's path outside the lock, so a deterministic name would
-        # let that deferred unlink destroy a racing re-put's fresh
+        # let that deferred delete destroy a racing re-put's fresh
         # spill file. Each record carries its own path.
         with self._lock:
             self._spill_seq += 1
             seq = self._spill_seq
-        return os.path.join(
-            self._spill_dir,
-            f"{hashlib.sha1(key.encode()).hexdigest()}-{seq}")
+        return f"{hashlib.sha1(key.encode()).hexdigest()}-{seq}"
 
     def _spill_one(self, key: str) -> int:
         """Copy one sealed arena object to disk and drop the arena copy.
@@ -227,16 +255,14 @@ class NodeObjectTable:
         if view is None:
             return 0
         size = len(view)
-        path = self._spill_path(key)
+        backend = self._spill_backend
         try:
-            with open(path + ".tmp", "wb") as f:
-                f.write(view)
-            os.replace(path + ".tmp", path)
+            # Atomic write-then-rename + fsync live in the backend, as
+            # do the spill.write_error chaos site and failure counter.
+            uri = backend.write(self._spill_name(key), view)
         except OSError:
-            logger.exception("spill of %s failed; keeping in-arena copy",
-                             key)
-            with contextlib.suppress(OSError):
-                os.unlink(path + ".tmp")
+            logger.warning("spill of %s failed; keeping in-arena copy",
+                           key)
             return 0
         finally:
             try:
@@ -244,10 +270,12 @@ class NodeObjectTable:
             except BufferError:
                 pass
             self._arena.release(key)
-        return self._register_spill(key, path, size, drop_arena=True)
+        return self._register_spill(key, backend.path_for(uri), size,
+                                    drop_arena=True, uri=uri)
 
     def _register_spill(self, key: str, path: str, size: int,
-                        drop_arena: bool) -> int:
+                        drop_arena: bool, uri: Optional[str] = None
+                        ) -> int:
         """Commit a written spill file: register it, drop the arena copy
         (when one exists), and honor a free() that raced the disk write
         — our read pin made free's arena delete fail and set _doomed, so
@@ -259,20 +287,28 @@ class NodeObjectTable:
         one whose arena delete SUCCEEDED in the window between
         _spill_one's pin release and this registration, leaving no
         doomed marker — means the file must be discarded, never
-        registered."""
+        registered.
+
+        A registration through a DURABLE backend announces its URI via
+        ``on_spilled`` — the head records it in the object location
+        table so node death can restore instead of re-executing."""
+        durable = (uri is not None and self._spill_backend is not None
+                   and self._spill_backend.durable)
         with self._lock:
             live = key in self._sizes
             if live:
                 self._spilled[key] = (path, size)
+                if durable:
+                    self._spill_uris[key] = uri
         if not live:
-            with contextlib.suppress(OSError):
-                os.unlink(path)
+            self._spill_backend.delete_path(path)
             return 0
         deleted = self._arena.delete(key) if drop_arena else True
         with self._lock:
             doomed_now = key in self._doomed
             if doomed_now:
                 self._spilled.pop(key, None)
+                self._spill_uris.pop(key, None)
                 if deleted:
                     # Fully reclaimed. A FAILED delete keeps the
                     # tombstone: the arena copy survives (reader pin)
@@ -280,9 +316,13 @@ class NodeObjectTable:
                     # spill, it.
                     self._doomed.discard(key)
         if doomed_now:
-            with contextlib.suppress(OSError):
-                os.unlink(path)
+            self._spill_backend.delete_path(path)
             return size if deleted else 0
+        if durable and self.on_spilled is not None:
+            try:
+                self.on_spilled(key, uri, size)
+            except Exception:  # noqa: BLE001 - notice is best-effort
+                logger.exception("spill notice for %s failed", key)
         if not deleted:
             # Pinned by a concurrent reader: both copies stay (harmless —
             # the arena copy wins on read until pressure retries us).
@@ -315,20 +355,18 @@ class NodeObjectTable:
         return freed_any
 
     def _spill_payload(self, key: str, payload: bytes) -> bool:
-        """Write a payload that cannot fit the arena straight to disk.
-        False when the spill filesystem itself fails (caller falls back
-        to the heap — degraded, but the object is never lost)."""
-        path = self._spill_path(key)
+        """Write a payload that cannot fit the arena straight through
+        the spill backend. False when the backend itself fails (caller
+        falls back to the heap — degraded, but the object is never
+        lost)."""
+        backend = self._spill_backend
         try:
-            with open(path + ".tmp", "wb") as f:
-                f.write(payload)
-            os.replace(path + ".tmp", path)
+            uri = backend.write(self._spill_name(key), payload)
         except OSError:
-            logger.exception("direct spill of %s failed", key)
-            with contextlib.suppress(OSError):
-                os.unlink(path + ".tmp")
+            logger.warning("direct spill of %s failed", key)
             return False
-        self._register_spill(key, path, len(payload), drop_arena=False)
+        self._register_spill(key, backend.path_for(uri), len(payload),
+                             drop_arena=False, uri=uri)
         return True
 
     def _read_spilled(self, key: str) -> Optional[bytes]:
@@ -339,13 +377,12 @@ class NodeObjectTable:
         if rec is None:
             return None
         path, size = rec
-        try:
-            with open(path, "rb") as f:
-                data = f.read()
-        except OSError:
-            # Lost a promote race (winner popped the record and unlinked
-            # the file) or freed for real — the CALLER re-checks the
-            # arena before concluding the object is gone.
+        data = self._spill_backend.read_path(path, size)
+        if data is None:
+            # Lost a promote race (winner popped the record and deleted
+            # the file), freed for real, or an injected restore fault —
+            # the CALLER re-checks the arena before concluding the
+            # object is gone.
             return None
         self._bump("restored_bytes", size)
         self._bump("restores")
@@ -369,13 +406,21 @@ class NodeObjectTable:
                 if self._arena.contains(key):
                     with self._lock:
                         self._spilled.pop(key, None)
+                        unspilled = self._spill_uris.pop(key, None)
                         # free() may have raced the promote (it popped
-                        # _sizes/_spilled and unlinked the file while we
+                        # _sizes/_spilled and deleted the file while we
                         # held the payload): with eviction disabled the
                         # promoted copy would otherwise live forever.
                         # The caller still gets the bytes — the read
                         # legitimately raced the free.
                         freed_meanwhile = key not in self._sizes
+                    if unspilled is not None and \
+                            self.on_unspilled is not None:
+                        try:
+                            self.on_unspilled(key)
+                        except Exception:  # noqa: BLE001 - best-effort
+                            logger.exception(
+                                "unspill notice for %s failed", key)
                     if freed_meanwhile and not self._arena.delete(key):
                         # Another reader's pin blocked the delete: doom
                         # the zombie so the next spill pass retires it
@@ -387,18 +432,16 @@ class NodeObjectTable:
                         with self._lock:
                             if key not in self._sizes:
                                 self._doomed.add(key)
-                    with contextlib.suppress(OSError):
-                        os.unlink(path)
+                    self._spill_backend.delete_path(path)
                 else:
                     # A pressure pass re-spilled our promoted copy and
                     # its registration is authoritative — but if it
                     # wrote a NEW file, the one we read from is now an
-                    # orphan nobody will ever unlink.
+                    # orphan nobody will ever delete.
                     with self._lock:
                         rec_now = self._spilled.get(key)
                     if rec_now is not None and rec_now[0] != path:
-                        with contextlib.suppress(OSError):
-                            os.unlink(path)
+                        self._spill_backend.delete_path(path)
         return data
 
     @property
@@ -553,6 +596,11 @@ class NodeObjectTable:
             if key in self._doomed and self._arena.delete(key):
                 self._doomed.discard(key)
 
+    def spill_uri_for(self, key: str) -> Optional[str]:
+        """The durable spill URI for a resident key, if one exists."""
+        with self._lock:
+            return self._spill_uris.get(key)
+
     def stat(self, key: str) -> int:
         """Payload size if resident (any tier), -1 if not — from the
         bookkeeping records only, never materializing spilled bytes."""
@@ -632,12 +680,15 @@ class NodeObjectTable:
                 self._doomed.add(key)
             self._sizes.pop(key, None)
             rec = self._spilled.pop(key, None)
+            unspilled = self._spill_uris.pop(key, None)
             self._heap.pop(key, None)
         if rec is not None:
+            self._spill_backend.delete_path(rec[0])
+        if unspilled is not None and self.on_unspilled is not None:
             try:
-                os.unlink(rec[0])
-            except OSError:
-                pass
+                self.on_unspilled(key)
+            except Exception:  # noqa: BLE001 - notice is best-effort
+                logger.exception("unspill notice for %s failed", key)
 
     def usage(self) -> Dict[str, int]:
         with self._lock:
@@ -678,18 +729,18 @@ class NodeObjectTable:
                 wview = self._arena.writable_view(off, size)
                 return _RecvLanding(self, key, size, wview=wview, off=off)
             if self._spill_dir is not None:
-                # Won't fit even after spilling: land on disk directly.
-                path = self._spill_path(key)
-                fd = os.open(path + ".tmp",
-                             os.O_RDWR | os.O_CREAT | os.O_TRUNC, 0o600)
+                # Won't fit even after spilling: land on backend storage
+                # directly (chaos spill.write_error covers the open; a
+                # failed landing falls back to the heap below).
                 try:
-                    os.ftruncate(fd, size)
+                    sl = self._spill_backend.create_landing(
+                        self._spill_name(key), size)
                 except OSError:
-                    with contextlib.suppress(OSError):
-                        os.close(fd)
-                        os.unlink(path + ".tmp")
-                    raise
-                return _RecvLanding(self, key, size, fd=fd, path=path)
+                    logger.warning(
+                        "spill landing for %s failed; landing on heap",
+                        key)
+                else:
+                    return _RecvLanding(self, key, size, slanding=sl)
         return _RecvLanding(self, key, size, buf=bytearray(size))
 
     def recv_into(self, key: str, size: int, sock: socket.socket) -> None:
@@ -713,11 +764,10 @@ class NodeObjectTable:
         with self._lock:
             spilled = list(self._spilled.values())
             self._spilled.clear()
+            self._spill_uris.clear()
         for path, _size in spilled:
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+            if self._spill_backend is not None:
+                self._spill_backend.delete_path(path)
         self._heap.clear()
 
 
@@ -740,19 +790,20 @@ class _RecvLanding:
     readable."""
 
     __slots__ = ("_table", "key", "size", "_wview", "_off", "_fd",
-                 "_path", "_buf", "_discard")
+                 "_path", "_buf", "_discard", "_sl")
 
     def __init__(self, table: NodeObjectTable, key: str, size: int, *,
                  wview=None, off: Optional[int] = None,
-                 fd: Optional[int] = None, path: Optional[str] = None,
+                 slanding=None,
                  buf: Optional[bytearray] = None, discard: bool = False):
         self._table = table
         self.key = key
         self.size = size
         self._wview = wview
         self._off = off
-        self._fd = fd
-        self._path = path
+        self._sl = slanding  # _private.spill.SpillLanding (disk backend)
+        self._fd = slanding.fd if slanding is not None else None
+        self._path = slanding.path if slanding is not None else None
         self._buf = buf
         self._discard = discard
 
@@ -797,13 +848,12 @@ class _RecvLanding:
         table = self._table
         if self._discard:
             return  # duplicate landing: the resident payload wins
-        if self._fd is not None:
-            os.close(self._fd)
-            os.replace(self._path + ".tmp", self._path)
+        if self._sl is not None:
+            self._sl.commit()  # fsync + atomic rename in the backend
             with table._lock:
                 table._sizes[self.key] = self.size
-            table._register_spill(self.key, self._path, self.size,
-                                  drop_arena=False)
+            table._register_spill(self.key, self._sl.path, self.size,
+                                  drop_arena=False, uri=self._sl.uri)
             return
         if self._buf is not None:
             with table._lock:
@@ -822,11 +872,8 @@ class _RecvLanding:
         """Discard without publishing: abort the unsealed arena entry /
         unlink the tmp spill file. Never raises."""
         try:
-            if self._fd is not None:
-                with contextlib.suppress(OSError):
-                    os.close(self._fd)
-                with contextlib.suppress(OSError):
-                    os.unlink(self._path + ".tmp")
+            if self._sl is not None:
+                self._sl.abort()
             elif self._buf is None:
                 if self._wview is not None:
                     with contextlib.suppress(BufferError):
@@ -1362,7 +1409,7 @@ def _fetch_chunk(addr: Tuple[str, int], key: str, landing: _RecvLanding,
     return _pooled_rpc(addr, timeout, op)
 
 
-def _pull_chunked(addr: Tuple[str, int], key: str, table: NodeObjectTable,
+def _pull_chunked(addrs, key: str, table: NodeObjectTable,
                   size: int, timeout: float, admission, priority: int
                   ) -> bool:
     """Chunked parallel pull: split [0, size) into pull_chunk_bytes()
@@ -1372,20 +1419,67 @@ def _pull_chunked(addr: Tuple[str, int], key: str, table: NodeObjectTable,
     lacks the ranged op (v5) — the caller falls back to the whole-object
     fetch. Admission covers the WHOLE object for its entire flight, same
     as the monolithic path, so parallel chunks can't oversubscribe the
-    inflight-bytes budget."""
+    inflight-bytes budget.
+
+    ``addrs`` is the candidate holder list (primary first). A holder
+    that dies MID-PULL doesn't fail the pull: the shared cursor
+    advances past it and the remaining chunks resume from the next
+    holder — already-landed ranges are kept, nothing restarts
+    (reference: pull_manager retries against other location-table
+    holders)."""
+    addrs = [tuple(a) for a in addrs]
     chunk = pull_chunk_bytes()
     ranges = [(off, min(chunk, size - off)) for off in range(0, size, chunk)]
     if admission is not None:
         admission.acquire(size, priority)
     landing = None
     ok = False
+    # Shared failover cursor: chunk workers read the current holder and
+    # advance it (once) past a dead one. Monotonic — a holder that
+    # failed anyone is never retried within this pull.
+    cur = {"i": 0}
+    adv_lock = threading.Lock()
+
+    def fetch_with_failover(off: int, ln: int) -> None:
+        i = cur["i"]
+        while True:
+            holder = addrs[min(i, len(addrs) - 1)]
+            fail: BaseException
+            try:
+                if _fetch_chunk(holder, key, landing, off, ln, timeout):
+                    return
+                fail = ObjectPullError(
+                    f"peer {holder} dropped range {off} of {key} "
+                    "mid-pull")
+            except (OSError, ConnectionError, struct.error) as exc:
+                fail = exc
+            with adv_lock:
+                if cur["i"] == i:
+                    cur["i"] = i + 1
+                i = cur["i"]
+            if i >= len(addrs):
+                raise ObjectPullError(
+                    f"all {len(addrs)} holder(s) failed pulling range "
+                    f"{off} of {key}: {fail}") from fail
+            logger.info("pull of %s range %d failing over to holder %s",
+                        key, off, addrs[i])
+
     try:
         landing = table.begin_recv(key, size)
-        # Probe with the first chunk on this thread: one -1 here means a
-        # v5 peer (or a vanished object) and nothing has been spawned.
-        if not _fetch_chunk(addr, key, landing, ranges[0][0],
-                            ranges[0][1], timeout):
-            return False
+        # Probe with the first chunk on this thread: a -1 here means a
+        # v5 peer (or a vanished object) and nothing has been spawned —
+        # but a DEAD primary fails over to the next holder right away.
+        while True:
+            try:
+                if not _fetch_chunk(addrs[cur["i"]], key, landing,
+                                    ranges[0][0], ranges[0][1], timeout):
+                    return False
+                break
+            except (OSError, ConnectionError, struct.error):
+                with adv_lock:
+                    cur["i"] += 1
+                if cur["i"] >= len(addrs):
+                    raise
         rest = ranges[1:]
         if rest:
             from collections import deque
@@ -1400,11 +1494,7 @@ def _pull_chunked(addr: Tuple[str, int], key: str, table: NodeObjectTable,
                     except IndexError:
                         return
                     try:
-                        if not _fetch_chunk(addr, key, landing, off, ln,
-                                            timeout):
-                            raise ObjectPullError(
-                                f"peer {addr} dropped range {off} of "
-                                f"{key} mid-pull")
+                        fetch_with_failover(off, ln)
                     except BaseException as exc:  # noqa: BLE001
                         errors.append(exc)
                         failed.set()
@@ -1441,14 +1531,14 @@ def _pull_chunked(addr: Tuple[str, int], key: str, table: NodeObjectTable,
 def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
                 timeout: float = 30.0, retries: int = 2,
                 priority: int = PULL_PRIORITY_GET,
-                size_hint: int = 0) -> None:
+                size_hint: int = 0, fallback_addrs=()) -> None:
     """Pull one object from a peer's object server into the local table
     (read it back with ``table.pinned``). Connections are pooled and
     kept alive; a stale pooled socket retries on a fresh one without
-    consuming a retry budget. Raises ObjectPullError when the owner is
-    unreachable or lacks the object. In-flight bytes are bounded by the
-    table's PullAdmission (if set): the size is learned first (stat or
-    size header), admission is acquired for the body (args-first
+    consuming a retry budget. Raises ObjectPullError when every holder
+    is unreachable or lacks the object. In-flight bytes are bounded by
+    the table's PullAdmission (if set): the size is learned first (stat
+    or size header), admission is acquired for the body (args-first
     priority), released when the body lands.
 
     ``size_hint`` (callers pass the ObjectMarker size) routes payloads
@@ -1456,7 +1546,47 @@ def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
     authoritative stat round-trip, then concurrent ranged reads. Pulls
     without a hint (or small ones) keep the single-socket flow with no
     extra round-trip. A v5 peer (no ranged op) degrades to the
-    whole-object fetch once, then is remembered."""
+    whole-object fetch once, then is remembered.
+
+    ``fallback_addrs`` are additional known holders (ObjectMarker
+    ``alt_addrs``, fed by the head's location table): a failed or
+    mid-flight-dead primary fails over to them — inside the chunked
+    path the remaining chunks simply resume from the next holder —
+    instead of erroring into lineage reconstruction (reference:
+    pull_manager retrying across object-directory locations)."""
+    candidates = [tuple(addr)]
+    for alt in fallback_addrs or ():
+        alt = tuple(alt)
+        if alt not in candidates:
+            candidates.append(alt)
+    last: Optional[BaseException] = None
+    for i, cand in enumerate(candidates):
+        try:
+            _pull_object_once(cand, key, table, timeout, retries,
+                              priority, size_hint,
+                              others=candidates[i + 1:])
+            return
+        except (ObjectPullError, OSError, ConnectionError,
+                struct.error) as exc:
+            last = exc
+            if i + 1 < len(candidates):
+                logger.info("pull of %s from %s failed (%s); failing "
+                            "over to %s", key, cand, exc,
+                            candidates[i + 1])
+    if isinstance(last, ObjectPullError):
+        raise last
+    raise ObjectPullError(
+        f"pull of {key} failed on all {len(candidates)} holder(s): "
+        f"{last}") from last
+
+
+def _pull_object_once(addr: Tuple[str, int], key: str,
+                      table: NodeObjectTable, timeout: float,
+                      retries: int, priority: int, size_hint: int,
+                      others=()) -> None:
+    """One holder's pull attempt (retry/backoff loop against a single
+    primary; ``others`` ride along into the chunked path for mid-pull
+    chunk failover)."""
     from ray_tpu._private.channel import Backoff
     last: Optional[BaseException] = None
     admission = getattr(table, "admission", None)
@@ -1476,8 +1606,8 @@ def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
                         "(freed or evicted before the pull)")
                 fell_back = False
                 if size > chunk:
-                    if _pull_chunked(addr, key, table, size, timeout,
-                                     admission, priority):
+                    if _pull_chunked([addr, *others], key, table, size,
+                                     timeout, admission, priority):
                         return
                     fell_back = True
                 # Whole-object path below; a success after a ranged

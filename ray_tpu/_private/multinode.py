@@ -448,6 +448,8 @@ class NodeConnection:
         # loop routes them here instead of the pending table).
         self.on_log_batch = None
         self.on_metrics_batch = None
+        self.on_object_spilled = None
+        self.on_object_unspilled = None
         # Dedicated liveness socket (see HeadServer._health_check_loop):
         # pings must not share the data channel — large frames or a full
         # send buffer would stall them and fake a death (or hide one).
@@ -575,12 +577,16 @@ class NodeConnection:
                 self.last_frame_at = _monotonic()
                 for reply in replies:
                     kind = reply.get("type")
-                    if kind in ("log_batch", "metrics_batch"):
+                    if kind in ("log_batch", "metrics_batch",
+                                "object_spilled", "object_unspilled"):
                         # Daemon-initiated push, not a reply: hand to
                         # the runtime's fan-out and move on.
-                        handler = (self.on_log_batch
-                                   if kind == "log_batch"
-                                   else self.on_metrics_batch)
+                        handler = {
+                            "log_batch": self.on_log_batch,
+                            "metrics_batch": self.on_metrics_batch,
+                            "object_spilled": self.on_object_spilled,
+                            "object_unspilled": self.on_object_unspilled,
+                        }[kind]
                         if handler is not None:
                             try:
                                 handler(self, reply)
@@ -1720,6 +1726,26 @@ class NodeDaemon:
                          name="ray_tpu-spill-reaper", daemon=True).start()
         self._table = NodeObjectTable(capacity=object_store_memory,
                                       spill_dir=spill_dir)
+        # Durable spill tier (reference: local_object_manager.h external
+        # storage): a configured spill URI swaps the table's backend so
+        # spilled payloads survive this daemon's death. session:// needs
+        # the head's session id — upgraded at registration; other
+        # schemes (file://, mock-s3://, registered remotes) bind now.
+        self._spill_uri = str(_cfg.object_spill_uri or "")
+        if self._spill_uri and \
+                not self._spill_uri.startswith("session://"):
+            from ray_tpu._private.spill import backend_for_uri
+            try:
+                self._table.set_spill_backend(backend_for_uri(
+                    self._spill_uri, fallback_dir=spill_dir))
+            except ValueError:
+                logger.exception("invalid object_spill_uri %r; keeping "
+                                 "the local spill directory",
+                                 self._spill_uri)
+        # Durable-spill announcements ride the session's reply sender;
+        # the head records URIs in its object location table.
+        self._table.on_spilled = self._announce_spilled
+        self._table.on_unspilled = self._announce_unspilled
         # Pull admission control (reference: pull_manager.h:52): bounds
         # bytes in flight into this node, task args first.
         self._table.admission = PullAdmission(
@@ -1964,10 +1990,52 @@ class NodeDaemon:
                                     "value": result_parts},
                              nbytes=size)
 
-    def _resolve_markers(self, args, kwargs):
+    def _pull_marker(self, a) -> None:
+        """Land a marker argument's payload in the local table: direct
+        peer pull with holder failover (the marker's alt_addrs are the
+        head's other known in-memory holders), then the durable spill
+        URI as the last data-plane resort — only when every tier misses
+        does the caller's error escalate into lineage reconstruction."""
         from ray_tpu._private.dataplane import (PULL_PRIORITY_TASK_ARGS,
-                                                ObjectMarker,
-                                                ObjectPullError, pull_object)
+                                                ObjectPullError,
+                                                pull_object)
+        owner = getattr(a, "owner_addr", None)
+        spill_uri = getattr(a, "spill_uri", None)
+        try:
+            if owner is None:
+                raise KeyError(
+                    f"object payload {a.key} is not resident on "
+                    "this node (already freed?)")
+            # Direct peer pull — the head never sees these bytes
+            # (reference: ObjectManager node-to-node chunked pull).
+            pull_object(tuple(owner), a.key, self._table,
+                        priority=PULL_PRIORITY_TASK_ARGS,
+                        size_hint=getattr(a, "size", 0) or 0,
+                        fallback_addrs=getattr(a, "alt_addrs", ()) or ())
+            return
+        except (ObjectPullError, KeyError, OSError) as exc:
+            if not spill_uri:
+                raise
+            from ray_tpu._private.spill import read_uri
+            payload = read_uri(spill_uri,
+                               getattr(a, "size", 0) or 0)
+            if payload is None:
+                raise ObjectPullError(
+                    f"object {a.key}: every holder failed ({exc}) and "
+                    f"its spill URI {spill_uri} is unreadable") from exc
+            logger.warning("restored %s from spill URI %s after holder "
+                           "failure: %s", a.key, spill_uri, exc)
+            self._table.put(a.key, payload)
+            try:
+                from ray_tpu._private import builtin_metrics
+                builtin_metrics.object_restores().inc(
+                    tags={"source": "spill"})
+            except Exception:  # noqa: BLE001 - accounting only
+                pass
+
+    def _resolve_markers(self, args, kwargs):
+        from ray_tpu._private.dataplane import (ObjectMarker,
+                                                ObjectPullError)
         self._prefetch_marker_args(args, kwargs)
 
         def resolve(a):
@@ -1975,16 +2043,7 @@ class NodeDaemon:
                 with self._table.pinned(a.key) as payload:
                     if payload is not None:
                         return _loads(payload)
-                owner = getattr(a, "owner_addr", None)
-                if owner is None:
-                    raise KeyError(
-                        f"object payload {a.key} is not resident on "
-                        "this node (already freed?)")
-                # Direct peer pull — the head never sees these bytes
-                # (reference: ObjectManager node-to-node chunked pull).
-                pull_object(tuple(owner), a.key, self._table,
-                            priority=PULL_PRIORITY_TASK_ARGS,
-                            size_hint=getattr(a, "size", 0) or 0)
+                self._pull_marker(a)
                 with self._table.pinned(a.key) as payload:
                     if payload is None:  # evicted immediately (pressure)
                         raise ObjectPullError(
@@ -2043,7 +2102,8 @@ class NodeDaemon:
                 if owner is not None and a.key not in missing and \
                         not self._table.contains(a.key):
                     missing[a.key] = (tuple(owner),
-                                      getattr(a, "size", 0) or 0)
+                                      getattr(a, "size", 0) or 0,
+                                      getattr(a, "alt_addrs", ()) or ())
         if len(missing) < 2:
             return  # a single pull gains nothing from the pool
         pool = self._prefetch_pool
@@ -2059,8 +2119,9 @@ class NodeDaemon:
                     self._prefetch_pool = pool
         futures = [
             pool.submit(pull_object, owner, key, self._table,
-                        priority=PULL_PRIORITY_TASK_ARGS, size_hint=size)
-            for key, (owner, size) in missing.items()]
+                        priority=PULL_PRIORITY_TASK_ARGS, size_hint=size,
+                        fallback_addrs=alts)
+            for key, (owner, size, alts) in missing.items()]
         for f in futures:
             f.exception()  # wait; failures re-raise in resolve()
 
@@ -2075,9 +2136,8 @@ class NodeDaemon:
         resolve and the worker's read (plasma semantics: an argument of
         a dispatched task holds a reference, local_task_manager.cc pins
         args for the task's runtime)."""
-        from ray_tpu._private.dataplane import (PULL_PRIORITY_TASK_ARGS,
-                                                ObjectMarker,
-                                                ObjectPullError, pull_object)
+        from ray_tpu._private.dataplane import (ObjectMarker,
+                                                ObjectPullError)
         from ray_tpu._private.worker_process import ArenaRef
         self._prefetch_marker_args(args, kwargs)
         pinned: list = []
@@ -2096,14 +2156,7 @@ class NodeDaemon:
         def resolve(a):
             if isinstance(a, (ObjectMarker, RemoteArgMarker)):
                 if not self._table.contains(a.key):
-                    owner = getattr(a, "owner_addr", None)
-                    if owner is None:
-                        raise KeyError(
-                            f"object payload {a.key} is not resident on "
-                            "this node (already freed?)")
-                    pull_object(tuple(owner), a.key, self._table,
-                                priority=PULL_PRIORITY_TASK_ARGS,
-                                size_hint=getattr(a, "size", 0) or 0)
+                    self._pull_marker(a)
                 arena = self._table._arena
                 if arena is not None:
                     if _pin_in_arena(arena, a.key):
@@ -2620,6 +2673,17 @@ class NodeDaemon:
         logger.info("Registered with head %s as node %s",
                     self.head_address, self.node_id_hex[:12])
         session_id = ack.get("session_id")
+        if session_id and self._spill_uri.startswith("session://"):
+            # session:// roots under the driver session's shared dir —
+            # only now (ack in hand) is the session id known. Earlier
+            # spills (pre-registration work) stay on their local-dir
+            # records; only new writes land durably.
+            from ray_tpu._private.spill import SessionSpillBackend
+            try:
+                self._table.set_spill_backend(
+                    SessionSpillBackend(session_id))
+            except OSError:
+                logger.exception("could not enable session:// spill")
         if session_id and self._log_monitor is None:
             self._start_log_streaming(session_id)
         if self._metrics_agent is None:
@@ -2778,6 +2842,27 @@ class NodeDaemon:
             monitor.add_file(path, "raylet", os.getpid(), source)
         ray_logging.register_capture_callback(monitor.add_file)
         self._log_monitor = monitor
+
+    def _announce_spilled(self, key: str, uri: str, size: int) -> None:
+        """Durable-spill notice (NodeObjectTable.on_spilled): the head
+        adds the URI to its location table so this daemon's death
+        restores the object from disk instead of re-running lineage.
+        Best-effort between sessions — a re-register re-announces
+        nothing, but the spill record survives on disk either way."""
+        chan = self._chan
+        sender = self._reply_senders.get(chan) if chan is not None \
+            else None
+        if sender is not None:
+            sender.send({"type": "object_spilled", "key": key,
+                         "uri": uri, "size": int(size)})
+
+    def _announce_unspilled(self, key: str) -> None:
+        """Retraction (restore-promotion or free deleted the file)."""
+        chan = self._chan
+        sender = self._reply_senders.get(chan) if chan is not None \
+            else None
+        if sender is not None:
+            sender.send({"type": "object_unspilled", "key": key})
 
     def _publish_log_batch(self, batch: dict) -> bool:
         """Ship one tail batch through the session's coalescing reply

@@ -34,6 +34,11 @@ from ray_tpu.exceptions import GetTimeoutError, ObjectFreedError, ObjectLostErro
 
 logger = logging.getLogger(__name__)
 
+# _restore's tier-miss sentinel: the spilled payload is gone (missing /
+# truncated / injected restore error). Distinct from None, which means
+# a concurrent free() won.
+_RESTORE_MISS = object()
+
 
 def _estimate_size(value: Any) -> int:
     """Cheap size estimate for inline values — exact for the payloads that
@@ -64,8 +69,9 @@ class _Entry:
     in_native: bool = False
     size_bytes: int = 0
     create_time: float = 0.0
-    spilled_path: Optional[str] = None
-    pinned: bool = False  # restored-and-read objects are not re-spilled
+    spilled_path: Optional[str] = None  # spill URI (see _private/spill.py)
+    spilled_len: int = 0  # on-disk payload length (truncation check)
+    pinned: bool = False  # unpicklable values are never spill victims
     # Sealed-but-elsewhere (node-daemon resident, multinode data plane):
     # get() materializes through this callable exactly once. The daemon
     # keeps the primary copy until the ref drops (plasma semantics: a get
@@ -86,16 +92,25 @@ class ObjectStore:
     def __init__(self, deserializer: Optional[Callable[[bytes], Any]] = None,
                  native_capacity: int = 0, use_native: bool = True,
                  spill_threshold_bytes: int = 0,
-                 spill_directory: Optional[str] = None):
+                 spill_directory: Optional[str] = None,
+                 spill_backend=None):
         self._entries: Dict[ObjectID, _Entry] = {}
         self._lock = threading.Lock()
         self._deserializer = deserializer
         self._total_bytes = 0
         # Spilling (reference: raylet LocalObjectManager spill/restore +
         # plasma fallback allocation): past the threshold, the coldest
-        # sealed values are cloudpickled to disk and restored on get.
+        # sealed values are cloudpickled through the spill backend and
+        # restored on get. ``spill_backend`` (a _private.spill.SpillBackend)
+        # wins over the legacy ``spill_directory`` (file:// over that dir).
         self._spill_threshold = spill_threshold_bytes
         self._spill_dir = spill_directory
+        self._spill_backend = spill_backend
+        # Invoked (outside get()'s lock) when a restore tier-misses:
+        # returns True if recovery (invalidate + lineage reconstruction)
+        # was initiated — the getter loops back and waits for the
+        # re-seal. Installed by the runtime.
+        self.restore_miss_hook: Optional[Callable[[ObjectID], bool]] = None
         self._spilled_bytes = 0
         self._spill_count = 0
         self._restore_count = 0
@@ -221,6 +236,23 @@ class ObjectStore:
             cbs = self._take_seal_callbacks(entry)
         self._fire_seal_callbacks(cbs, object_id)
 
+    def replace_remote_fetch(self, object_id: ObjectID,
+                             fetch_fn: Callable[[], Any],
+                             size_bytes: int = 0) -> bool:
+        """Re-point a sealed-but-remote entry at another holder's fetch
+        (the replica recovery tier: the original holder died but a
+        byte-identical copy survives on a peer). No-op — returns False —
+        if the value already materialized locally or the entry is gone."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or entry.freed or not entry.event.is_set() \
+                    or entry.remote_fetch is None:
+                return False
+            entry.remote_fetch = fetch_fn
+            if size_bytes:
+                entry.size_bytes = size_bytes
+            return True
+
     def is_materialized(self, object_id: ObjectID) -> bool:
         """True when the value is locally available (not a pending remote
         fetch) — node death cannot lose a materialized object."""
@@ -247,13 +279,27 @@ class ObjectStore:
 
     # -- spilling ---------------------------------------------------------
 
+    def _backend(self):
+        """The spill backend, built lazily (file:// over the legacy
+        directory when no explicit backend was injected)."""
+        if self._spill_backend is None:
+            from ray_tpu._private.spill import FileSpillBackend
+            self._spill_backend = FileSpillBackend(self._spill_dir)
+        return self._spill_backend
+
     def _maybe_spill(self) -> None:
-        """Spill coldest sealed values to disk while over the threshold
-        (reference: raylet/local_object_manager.h SpillObjects). Victims are
-        serialized outside the lock; a racing free/invalidate wins."""
-        if not self._spill_threshold or self._spill_dir is None:
+        """Spill coldest sealed values through the backend while over the
+        threshold (reference: raylet/local_object_manager.h SpillObjects).
+        Victims are serialized outside the lock; a racing free/invalidate
+        wins. A victim whose earlier spill file is still valid is dropped
+        by reference — no re-serialize, no re-write (the restored-object
+        re-spill path)."""
+        if not self._spill_threshold or (
+                self._spill_dir is None and self._spill_backend is None):
             return
         import cloudpickle
+
+        from ray_tpu._private.spill import SpillFailure
         while True:
             with self._lock:
                 if self._total_bytes <= self._spill_threshold:
@@ -265,7 +311,6 @@ class ObjectStore:
                 for oid in list(self._spill_order):
                     entry = self._entries.get(oid)
                     if entry is None or entry.freed or entry.pinned \
-                            or entry.spilled_path is not None \
                             or entry.value is None \
                             or entry.serialized is not None:
                         # serialized retained → spilling frees no memory
@@ -278,6 +323,14 @@ class ObjectStore:
                     break
                 if victim is None:
                     return
+                if victim.spilled_path is not None:
+                    # Restored-and-since-idle: the on-disk payload is
+                    # still valid, so drop the memory copy by reference.
+                    victim.value = None
+                    self._total_bytes -= victim.size_bytes
+                    self._spilled_bytes += victim.size_bytes
+                    self._spill_count += 1
+                    continue
                 value = victim.value
             try:
                 payload = cloudpickle.dumps(value)
@@ -285,36 +338,64 @@ class ObjectStore:
                 with self._lock:
                     victim.pinned = True
                 continue
-            os.makedirs(self._spill_dir, exist_ok=True)
-            path = os.path.join(self._spill_dir,
-                                f"spilled-{victim_id.hex()}.bin")
-            with open(path, "w+b") as f:
-                f.write(payload)
+            try:
+                uri = self._backend().write(
+                    f"spilled-{victim_id.hex()}.bin", payload)
+            except SpillFailure:
+                # Degrade gracefully: the value stays in memory (the
+                # backend already counted the failure); the victim left
+                # _spill_order so we don't hot-loop on a broken disk.
+                continue
             with self._lock:
                 if victim.freed or not victim.event.is_set():
-                    try:
-                        os.unlink(path)
-                    except OSError:
-                        pass
-                    continue
-                victim.spilled_path = path
-                victim.value = None
-                self._total_bytes -= victim.size_bytes
-                self._spilled_bytes += victim.size_bytes
-                self._spill_count += 1
-                spilled_now = victim.size_bytes
+                    pass  # racing free/invalidate won; drop the file
+                else:
+                    victim.spilled_path = uri
+                    victim.spilled_len = len(payload)
+                    victim.value = None
+                    self._total_bytes -= victim.size_bytes
+                    self._spilled_bytes += victim.size_bytes
+                    self._spill_count += 1
+                    spilled_now = victim.size_bytes
+                    uri = None
+            if uri is not None:
+                self._backend().delete(uri)
+                continue
             builtin_metrics.object_spilled_bytes().inc(spilled_now)
 
     def _restore(self, entry: _Entry, object_id: ObjectID) -> Any:
-        """Load a spilled value back (reference: spilled-object restore)."""
+        """Load a spilled value back (reference: spilled-object restore).
+
+        Returns ``None`` when a concurrent ``free()`` won, and the
+        :data:`_RESTORE_MISS` sentinel on a tier miss (file missing /
+        truncated / injected restore fault) — the caller falls down the
+        recovery hierarchy instead of seeing an exception.
+
+        The spill file stays valid after a successful restore (no
+        unlink, ``spilled_path`` kept), so renewed memory pressure can
+        drop the copy again by reference — the restored-object pinning
+        leak fix."""
         import cloudpickle
-        try:
-            with open(entry.spilled_path, "rb") as f:
-                value = cloudpickle.loads(f.read())
-        except OSError as exc:
-            raise ObjectLostError(
-                f"Object {object_id.hex()} was spilled to "
-                f"{entry.spilled_path} which is no longer readable: {exc}")
+        payload = self._backend().read(entry.spilled_path,
+                                       entry.spilled_len)
+        if payload is not None:
+            try:
+                value = cloudpickle.loads(payload)
+            except Exception:  # noqa: BLE001 - torn/corrupt payload
+                payload = None
+        if payload is None:
+            logger.warning(
+                "spilled payload for %s (%s) is unreadable; treating as "
+                "a tier miss", object_id.hex(), entry.spilled_path)
+            with self._lock:
+                if entry.freed:
+                    return None
+                if entry.spilled_path is not None:
+                    if entry.value is None:
+                        self._spilled_bytes -= entry.size_bytes
+                    entry.spilled_path = None
+                return entry.value if entry.value is not None \
+                    else _RESTORE_MISS
         with self._lock:
             if entry.freed:
                 # A concurrent free() won: don't resurrect or touch the
@@ -322,10 +403,13 @@ class ObjectStore:
                 return None
             if entry.value is None and entry.spilled_path is not None:
                 entry.value = value
-                entry.pinned = True  # a reader holds it now; don't re-spill
                 self._total_bytes += entry.size_bytes
                 self._spilled_bytes -= entry.size_bytes
                 self._restore_count += 1
+                # Re-eligible for (by-reference) re-spill once pressure
+                # returns and no reader is mid-get.
+                if self._spill_threshold and entry.size_bytes > 0:
+                    self._spill_order[object_id] = None
             return entry.value
 
     def spill_stats(self) -> dict:
@@ -401,7 +485,7 @@ class ObjectStore:
                 raise GetTimeoutError(
                     f"Get timed out pulling remote object "
                     f"{object_id.hex()} after {timeout}s.")
-            except BaseException:
+            except BaseException as fetch_exc:
                 with self._lock:
                     entry.fetching = False
                     # Node death may have raced us: if the entry was
@@ -409,6 +493,21 @@ class ObjectStore:
                     # wait for the new value instead of failing the get.
                     raced = (entry.remote_fetch is not fetch
                              or not entry.event.is_set())
+                if not raced and isinstance(fetch_exc, ObjectLostError):
+                    # The holder died mid-fetch but recovery hasn't
+                    # settled this entry yet. remove_node ALWAYS settles
+                    # it — re-points the fetch at a replica, restores
+                    # from a spill URI, invalidates for a lineage retry,
+                    # or seals the loss — so wait briefly for the
+                    # verdict instead of racing it to the caller.
+                    grace = time.monotonic() + 10.0
+                    if deadline is not None:
+                        grace = min(grace, deadline)
+                    while not raced and time.monotonic() < grace:
+                        time.sleep(0.01)
+                        with self._lock:
+                            raced = (entry.remote_fetch is not fetch
+                                     or not entry.event.is_set())
                 if raced:
                     continue
                 raise
@@ -464,6 +563,27 @@ class ObjectStore:
                 raise ObjectFreedError(
                     f"Object {object_id.hex()} was freed and is no "
                     "longer available.")
+            if value is _RESTORE_MISS:
+                # Tier miss: the spill copy is gone. Hand the loss to
+                # the runtime's recovery hook (invalidate + lineage
+                # re-execution) and re-enter the get to wait for the
+                # re-seal; without a hook the loss is terminal.
+                hook = self.restore_miss_hook
+                recovering = False
+                if hook is not None:
+                    try:
+                        recovering = bool(hook(object_id))
+                    except Exception:  # noqa: BLE001 - hook bug ≠ hang
+                        logger.exception("restore-miss hook raised")
+                if recovering:
+                    remaining = (None if deadline is None
+                                 else max(0.0,
+                                          deadline - time.monotonic()))
+                    return self.get(object_id, remaining)
+                raise ObjectLostError(
+                    f"Object {object_id.hex()} was spilled but its "
+                    "payload is no longer readable and no lineage "
+                    "recovery is available.")
         if not entry.deserialized:
             if self._deserializer is None:
                 raise ObjectLostError(object_id.hex())
@@ -505,6 +625,7 @@ class ObjectStore:
 
     def free(self, object_ids) -> None:
         fired = []  # (callbacks, oid) — entries freed before ever sealing
+        doomed_uris = []  # spill files deleted outside the lock
         with self._lock:
             for oid in object_ids:
                 entry = self._entries.get(oid)
@@ -520,10 +641,7 @@ class ObjectStore:
                             self._native.release(oid.hex())
                         self._native.delete(oid.hex())
                     if entry.spilled_path is not None:
-                        try:
-                            os.unlink(entry.spilled_path)
-                        except OSError:
-                            pass
+                        doomed_uris.append(entry.spilled_path)
                         if entry.value is None:
                             self._spilled_bytes -= entry.size_bytes
                         entry.spilled_path = None
@@ -535,6 +653,8 @@ class ObjectStore:
                     entry.serialized = None
                     entry.remote_fetch = None
                     entry.event.set()
+        for uri in doomed_uris:
+            self._backend().delete(uri)
         for cbs, oid in fired:
             self._fire_seal_callbacks(cbs, oid)
 
@@ -544,6 +664,7 @@ class ObjectStore:
         waiting on the same entry and wake when the reconstructed value is
         sealed (reference: object_recovery_manager.h:68-94 — a lost object
         returns to 'pending' while its creating task is resubmitted)."""
+        doomed_uris = []
         with self._lock:
             for oid in object_ids:
                 entry = self._entries.get(oid)
@@ -559,10 +680,7 @@ class ObjectStore:
                         self._native.release(oid.hex())
                     self._native.delete(oid.hex())
                 if entry.spilled_path is not None:
-                    try:
-                        os.unlink(entry.spilled_path)
-                    except OSError:
-                        pass
+                    doomed_uris.append(entry.spilled_path)
                     if entry.value is None:
                         self._spilled_bytes -= entry.size_bytes
                     entry.spilled_path = None
@@ -580,6 +698,8 @@ class ObjectStore:
                 entry.pinned = False
                 entry.remote_fetch = None
                 entry.event.clear()
+        for uri in doomed_uris:
+            self._backend().delete(uri)
 
     def fail_all_pending(self, exc: BaseException) -> None:
         """Seal every unsealed entry with the given error (used at shutdown so

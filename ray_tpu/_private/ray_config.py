@@ -40,6 +40,10 @@ _PY_DEFAULTS: Dict[str, Any] = {
     "object_store_full_delay_ms": 100,
     "object_spilling_threshold_bytes": 0,
     "object_spilling_directory": "",
+    # Spill-backend URI: "" = per-process file:// dir (legacy),
+    # "session://" = host-shared session dir (survives daemon death),
+    # "mock-s3://<bucket>" = local stand-in for remote object storage.
+    "object_spill_uri": "",
     "remote_object_inline_limit_bytes": 1 << 20,
     "gc_sweep_interval_ms": 500,
     "health_check_period_ms": 3000,

@@ -380,6 +380,23 @@ class Runtime:
         spill_dir = (self.config.object_spilling_directory
                      or os.path.join(tempfile.gettempdir(), "ray_tpu_spill",
                                      self.session_id))
+        # Durable spill tier (reference: external storage behind the
+        # raylet's LocalObjectManager): object_spill_uri routes spill
+        # writes through a pluggable backend — session:// / mock-s3://
+        # records survive process death and feed tiered recovery. An
+        # unset/invalid URI keeps the plain per-session directory.
+        spill_backend = None
+        _spill_uri = str(self.config.object_spill_uri or "")
+        if _spill_uri:
+            from ray_tpu._private.spill import backend_for_uri
+            try:
+                spill_backend = backend_for_uri(
+                    _spill_uri, session_id=self.session_id,
+                    fallback_dir=spill_dir)
+            except (ValueError, OSError):
+                logger.exception(
+                    "invalid object_spill_uri %r; using the local "
+                    "spill directory", _spill_uri)
         self.store = ObjectStore(
             deserializer=serialization.deserialize,
             native_capacity=int(node_resources.memory_bytes *
@@ -387,7 +404,12 @@ class Runtime:
             use_native=self.config.use_native_object_store,
             spill_threshold_bytes=int(
                 self.config.object_spilling_threshold_bytes),
-            spill_directory=spill_dir)
+            spill_directory=spill_dir,
+            spill_backend=spill_backend)
+        # A head-local spilled entry whose file vanished (chaos, scrubbed
+        # tmpdir) falls down to the lineage tier instead of surfacing an
+        # IO error from get().
+        self.store.restore_miss_hook = self._restore_from_lineage
         # Housekeeping: arenas/spill of SIGKILLed predecessors never
         # unlink themselves — a day of test churn measured 118GB of
         # dead /dev/shm mappings starving live runs.
@@ -475,6 +497,17 @@ class Runtime:
         # + object_recovery_manager.h). Bounded; puts are not reconstructable.
         self._lineage: Dict[ObjectID, TaskSpec] = {}
         self._object_locations: Dict[ObjectID, NodeID] = {}
+        # Tiered-recovery location data (reference: the ownership-based
+        # object directory tracking ALL holders, not just the primary):
+        # _object_replicas — other daemons known to hold an in-memory
+        # copy (learned when a task's marker arg was pulled there);
+        # _spill_uris_by_key — durable spill URIs announced by daemons
+        # (object_spilled frames), keyed by the daemon object key;
+        # _remote_keys — key → ObjectID reverse map for those frames.
+        # Node death walks replica → spill → lineage, cheapest first.
+        self._object_replicas: Dict[ObjectID, Dict[NodeID, None]] = {}
+        self._spill_uris_by_key: Dict[str, Tuple[str, int]] = {}
+        self._remote_keys: Dict[str, ObjectID] = {}
         # Ownership/reference counting (reference: reference_count.h):
         # ObjectRef handles hold local refs, pending tasks hold dependency
         # refs; when an owned object's counts hit zero its value is freed
@@ -607,9 +640,12 @@ class Runtime:
             for oid in oids:
                 self._lineage.pop(oid, None)
                 self._object_locations.pop(oid, None)
+                self._object_replicas.pop(oid, None)
                 rv = self._remote_values.pop(oid, None)
                 if rv is not None:
                     remote_frees.append(rv[1])
+                    self._remote_keys.pop(rv[1], None)
+                    self._spill_uris_by_key.pop(rv[1], None)
         # Broadcast: peer daemons may hold PULLED copies of the object
         # beyond the primary (the data plane caches pulls locally), so
         # every node gets the eviction notice (reference: object pubsub
@@ -1399,14 +1435,42 @@ class Runtime:
                     rv = self._remote_values.get(oid)
                     owner_conn = (self._remote_nodes.get(rv[0])
                                   if rv is not None else None)
+                    alt_addrs = ()
+                    spill_uri = None
+                    if rv is not None:
+                        # Every OTHER live holder rides the marker as a
+                        # failover candidate, and a durable spill URI as
+                        # the last data-plane resort — a mid-pull holder
+                        # death resumes instead of erroring into
+                        # reconstruction.
+                        reps = self._object_replicas.get(oid)
+                        if reps:
+                            alt_addrs = tuple(
+                                c.object_addr
+                                for nid in reps
+                                if nid != rv[0] and nid != conn.node_id
+                                and (c := self._remote_nodes.get(nid))
+                                is not None and c.object_addr is not None)
+                        rec = self._spill_uris_by_key.get(rv[1])
+                        if rec is not None:
+                            spill_uri = rec[0]
                 if rv is not None and \
                         not self.store.is_materialized(oid):
                     if rv[0] == conn.node_id:
                         return ObjectMarker(rv[1])
                     if owner_conn is not None and \
                             owner_conn.object_addr is not None:
+                        # The executing daemon will pull a copy: note the
+                        # (oid, key) so task completion can register it
+                        # as an in-memory replica holder.
+                        pulls = getattr(spec, "_marker_pulls", None)
+                        if pulls is None:
+                            pulls = spec._marker_pulls = []
+                        pulls.append((oid, rv[1]))
                         return ObjectMarker(rv[1],
-                                            owner_addr=owner_conn.object_addr)
+                                            owner_addr=owner_conn.object_addr,
+                                            alt_addrs=alt_addrs,
+                                            spill_uri=spill_uri)
             if to_process and self.store.native_array_key(oid) is not None:
                 from ray_tpu._private.worker_process import ArenaArrayRef
                 # The task's dependency pin keeps the entry alive until
@@ -1439,6 +1503,20 @@ class Runtime:
                         self._cfg_obj_loc_max:
                     for oid in spec.return_ids:
                         self._object_locations[oid] = node_id
+                # Marker args the daemon pulled are now in-memory
+                # REPLICAS there (the data plane caches pulls): register
+                # the extra holder so node death can re-point the fetch
+                # instead of re-executing (bounded like the location
+                # table; replicas are an optimization, never required).
+                pulls = getattr(spec, "_marker_pulls", None)
+                if pulls and node_id in self._remote_nodes:
+                    for oid, _key in pulls:
+                        if oid in self._remote_values and \
+                                self._remote_values[oid][0] != node_id \
+                                and len(self._object_replicas) < \
+                                self._cfg_obj_loc_max:
+                            self._object_replicas.setdefault(
+                                oid, {})[node_id] = None
         n = spec.num_returns
         if n == 0:
             return
@@ -1521,10 +1599,12 @@ class Runtime:
             if getattr(spec, "invalidated", False):
                 return
             self._remote_values[oid] = (stub.conn.node_id, stub.key)
+            self._remote_keys[stub.key] = oid
             self.store.put_remote(oid, stub.fetch, stub.size)
         if not self.refs.has(oid):
             with self._lock:
                 self._remote_values.pop(oid, None)
+                self._remote_keys.pop(stub.key, None)
             self.store.free([oid])
             drop()
 
@@ -2638,6 +2718,22 @@ class Runtime:
         batch["node"] = node
         self.pubsub.publish("logs", "", json.dumps(batch))
 
+    def _object_spilled_from_node(self, conn, msg: dict) -> None:
+        """Wire sink for object_spilled frames: a daemon wrote this key
+        through a DURABLE backend — the URI joins the location table so
+        the daemon's death restores from disk instead of re-executing
+        lineage (recv-thread: dict insert only). Bounded like the other
+        location maps; past the cap recovery just falls down a tier."""
+        with self._lock:
+            if len(self._spill_uris_by_key) < self._cfg_obj_loc_max:
+                self._spill_uris_by_key[msg["key"]] = (
+                    msg["uri"], int(msg.get("size", 0)))
+
+    def _object_unspilled_from_node(self, conn, msg: dict) -> None:
+        """Retraction: restore-promotion or a free deleted the file."""
+        with self._lock:
+            self._spill_uris_by_key.pop(msg["key"], None)
+
     # ------------------------------------------------------------------
     # Cluster metrics (one Prometheus scrape for the whole cluster)
     # ------------------------------------------------------------------
@@ -2703,9 +2799,12 @@ class Runtime:
                                           labels=conn.labels,
                                           node_id=node_id)
         # Daemon-pushed log/metrics batches flow into the driver fan-out
-        # and the cluster metrics registry.
+        # and the cluster metrics registry; durable-spill announcements
+        # feed the object location table for tiered recovery.
         conn.on_log_batch = self._log_batch_from_node
         conn.on_metrics_batch = self._metrics_batch_from_node
+        conn.on_object_spilled = self._object_spilled_from_node
+        conn.on_object_unspilled = self._object_unspilled_from_node
         with self._lock:
             self._remote_nodes[node_id] = conn
         # A daemon reconnecting to a RESTARTED head announces the actor
@@ -3213,26 +3312,136 @@ class Runtime:
 
     def _recover_remote_values(self, node_id: NodeID) -> None:
         """Daemon-resident result payloads die with their daemon: values
-        the head already materialized are safe; the rest reconstruct from
-        lineage (within retry budget) or seal ObjectLostError."""
+        the head already materialized are safe; the rest walk the
+        recovery tiers — another in-memory replica holder, then a
+        durable spill URI, then lineage re-execution — and only a full
+        miss seals ObjectLostError."""
         with self._lock:
-            lost = [oid for oid, (nid, _k) in self._remote_values.items()
-                    if nid == node_id]
-            for oid in lost:
+            lost = [(oid, k) for oid, (nid, k)
+                    in self._remote_values.items() if nid == node_id]
+            for oid, key in lost:
                 self._remote_values.pop(oid, None)
-        self._reconstruct_or_seal(lost, node_id,
-                                  skip=self.store.is_materialized)
+                self._remote_keys.pop(key, None)
+            # The dead daemon's cached replicas died with it.
+            for reps in self._object_replicas.values():
+                reps.pop(node_id, None)
+        self._reconstruct_or_seal([oid for oid, _k in lost], node_id,
+                                  skip=self.store.is_materialized,
+                                  keys=dict(lost))
+
+    def _recover_from_replica(self, oid: ObjectID, key: str,
+                              node_id: NodeID) -> bool:
+        """Tier 1: another daemon pulled a copy of this object at some
+        point — if it is STILL resident there (the cache is evictable,
+        so ask), re-point the head's lazy fetch at that holder: no IO,
+        no re-execution (reference: object directory giving the pull
+        manager its next location)."""
+        from ray_tpu._private.dataplane import stat_remote
+        from ray_tpu._private.multinode import RemoteValueStub
+        with self._lock:
+            holders = [(nid, self._remote_nodes.get(nid))
+                       for nid in (self._object_replicas.get(oid) or {})
+                       if nid != node_id]
+        for nid, conn in holders:
+            if conn is None or conn.object_addr is None:
+                continue
+            try:
+                size = stat_remote(conn.object_addr, key, timeout=5.0)
+            except (OSError, ConnectionError):
+                continue
+            if size < 0:
+                continue  # evicted there since the pull
+            stub = RemoteValueStub(conn, key, size)
+            if not self.store.replace_remote_fetch(oid, stub.fetch,
+                                                   size):
+                return False  # entry freed/materialized meanwhile
+            with self._lock:
+                self._remote_values[oid] = (nid, key)
+                self._remote_keys[key] = oid
+            builtin_metrics.object_restores().inc(
+                tags={"source": "replica"})
+            logger.warning(
+                "object %s survives node %s death on replica holder %s",
+                oid.hex()[:12], node_id.hex()[:12], nid.hex()[:12])
+            return True
+        return False
+
+    def _recover_from_spill(self, oid: ObjectID, key: str,
+                            node_id: NodeID) -> bool:
+        """Tier 2: the dead daemon had spilled this object through a
+        durable backend — any node (here: the head) can read the URI
+        back. Restores eagerly into the head store; the producer task
+        does NOT re-run. A missing/truncated file is a tier miss."""
+        with self._lock:
+            rec = self._spill_uris_by_key.pop(key, None)
+        if rec is None:
+            return False
+        uri, size = rec
+        from ray_tpu._private.multinode import _loads
+        from ray_tpu._private.spill import read_uri
+        payload = read_uri(uri, size)
+        if payload is None:
+            return False  # unreadable: fall down to lineage
+        try:
+            value = _loads(payload)
+        except Exception:  # noqa: BLE001 - corrupt payload = tier miss
+            logger.exception("spilled payload %s is corrupt", uri)
+            return False
+        self.store.invalidate([oid])
+        self.store.put_inline(oid, value)
+        builtin_metrics.object_restores().inc(tags={"source": "spill"})
+        logger.warning(
+            "restored object %s from spill URI %s after node %s death",
+            oid.hex()[:12], uri, node_id.hex()[:12])
+        return True
+
+    def _restore_from_lineage(self, oid: ObjectID) -> bool:
+        """ObjectStore restore-miss hook: a head-local spilled entry's
+        file is gone (chaos, scrubbed tmpdir). Re-execute the creating
+        task — get() re-enters and waits for the re-seal. False when no
+        usable lineage exists (the store then raises ObjectLostError)."""
+        with self._lock:
+            spec = self._lineage.get(oid)
+        if spec is None or spec.kind == TaskKind.ACTOR_TASK or \
+                getattr(spec, "invalidated", False) or \
+                spec.attempt_number >= spec.max_retries:
+            return False
+        logger.warning(
+            "spilled payload of object %s is unreadable; re-executing "
+            "task %s from lineage", oid.hex()[:12], spec.name)
+        clone = spec.clone_for_retry()
+        with self._lock:
+            for roid in clone.return_ids:
+                if roid in self._lineage:
+                    self._lineage[roid] = clone
+        self.store.invalidate(list(clone.return_ids))
+        builtin_metrics.object_restores().inc(tags={"source": "lineage"})
+        self._register_task_refs(clone)
+        self._resolve_dependencies(clone)
+        return True
 
     def _reconstruct_or_seal(self, lost: List[ObjectID], node_id: NodeID,
-                             skip) -> None:
-        """Shared node-death recovery policy: each lost object either
-        re-executes its creating task from lineage (within retry budget)
-        or seals ObjectLostError (reference: object_recovery_manager.h)."""
+                             skip, keys: Optional[Dict[ObjectID, str]]
+                             = None) -> None:
+        """Shared node-death recovery policy, cheapest tier first: an
+        object with another in-memory replica holder re-points its
+        fetch; one with a durable spill URI restores from disk; the
+        rest re-execute their creating task from lineage (within retry
+        budget) or seal ObjectLostError (reference:
+        object_recovery_manager.h + local_object_manager spill URLs).
+        ``keys`` maps lost oids to their daemon object keys (the handle
+        the replica/spill location tables are keyed by)."""
         to_reconstruct: Dict[TaskID, TaskSpec] = {}
         plain_lost: List[ObjectID] = []
         for oid in lost:
             if skip(oid):
                 continue
+            key = (keys or {}).get(oid)
+            if key is not None:
+                if self._recover_from_replica(oid, key, node_id):
+                    continue
+                if self._recover_from_spill(oid, key, node_id):
+                    continue
             spec = self._lineage.get(oid)
             if spec is None or spec.kind == TaskKind.ACTOR_TASK or \
                     getattr(spec, "invalidated", False) or \
@@ -3243,6 +3452,8 @@ class Runtime:
                 plain_lost.append(oid)
             else:
                 to_reconstruct[spec.task_id] = spec
+                builtin_metrics.object_restores().inc(
+                    tags={"source": "lineage"})
         invalidate = [oid for spec in to_reconstruct.values()
                       for oid in spec.return_ids]
         self.store.invalidate(invalidate)

@@ -42,7 +42,10 @@ from typing import Any, Dict, Tuple
 #: and resumed via the raw resume/resumed handshake instead of
 #: declaring the node dead. A v6 peer would neither envelope its frames
 #: nor understand the resume message, so the version must not match.
-PROTOCOL_VERSION = 7
+#: v8: object_spilled / object_unspilled frames (daemon -> head durable
+#: spill-location announcements feeding tiered object recovery) — a v7
+#: head would reject the unknown type in validate_message.
+PROTOCOL_VERSION = 8
 
 
 class WireSchemaError(ValueError):
@@ -173,6 +176,17 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
         "metrics": (_LIST, True),
         "spans": (_LIST, False),
     },
+    # -- durable spill announcements (daemon -> head, v8) --------------
+    # A daemon spilled an object through a DURABLE backend (session://
+    # or a remote store): the URI joins the head's location table so
+    # node death restores from disk instead of re-executing lineage.
+    # object_unspilled retracts it (restore-promotion or free).
+    "object_spilled": {
+        "key": (_STR, True),
+        "uri": (_STR, True),
+        "size": (_INT, True),
+    },
+    "object_unspilled": {"key": (_STR, True)},
     # -- liveness ------------------------------------------------------
     "ping": {"cluster_digest": ((dict, type(None)), False)},
     "pong": {"sync": (_ANY, False)},

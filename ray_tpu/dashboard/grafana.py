@@ -24,6 +24,11 @@ _DEFAULT_PANELS = [
     ("Object store bytes", "ray_tpu_object_store_bytes", "bytes"),
     ("Object spilled bytes / s",
      "rate(ray_tpu_object_spilled_bytes_total[1m])", "Bps"),
+    ("Object restores / s (by recovery tier)",
+     "sum by (source) (rate(ray_tpu_object_restores_total[5m]))", "ops"),
+    ("Object spill failures / s (by op)",
+     "sum by (op) (rate(ray_tpu_object_spill_failures_total[5m]))",
+     "ops"),
     ("Object store hit rate",
      "rate(ray_tpu_object_store_hits_total[5m]) / "
      "(rate(ray_tpu_object_store_hits_total[5m]) + "

@@ -91,6 +91,9 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     FLAG_INT(object_store_full_delay_ms, 100),
     FLAG_INT(object_spilling_threshold_bytes, 0),  // 0 = disabled
     FLAG_STR(object_spilling_directory, ""),
+    // Spill-backend URI ("" = per-process file:// dir; "session://" =
+    // host-shared session dir that survives daemon death; "mock-s3://b").
+    FLAG_STR(object_spill_uri, ""),
     // Results bigger than this stay in the producing node daemon's store
     // and are fetched lazily (0 = always return inline).
     FLAG_INT(remote_object_inline_limit_bytes, 1048576),

@@ -28,6 +28,10 @@ runtime, so CI catches them statically:
    for session traffic.
 6. ``sock.sendall(a + b)`` under ``ray_tpu/_private/`` — same copy in
    disguise; pass the parts to ``sock_send_parts`` instead.
+7. Direct spill IO (``open(..., "wb")`` / ``os.remove``) in the object
+   stores — spill bytes must flow through ``_private/spill.py``'s
+   ``SpillBackend`` so crash-safe atomic writes, chaos injection, and
+   failure accounting cover every spill path.
 """
 
 import ast
@@ -240,6 +244,46 @@ def test_no_sendall_concat_in_private():
         "sendall(a + b) in ray_tpu/_private/ copies the joined frame — "
         "use channel.sock_send_parts(sock, (a, b)) instead: "
         + ", ".join(offenders))
+
+
+def test_no_direct_spill_io_outside_backend():
+    """No raw spill IO in the stores: every write-binary ``open`` and
+    every ``os.remove``/``os.unlink`` in ``object_store.py`` and
+    ``dataplane.py`` must flow through a ``SpillBackend``
+    (``_private/spill.py``) — that's where atomic write-then-rename,
+    fsync, the ``spill.write_error``/``spill.restore_error`` chaos
+    sites, and the failure counters live. A direct ``open(..., "wb")``
+    bypasses all four."""
+    offenders = []
+    for name in ("object_store.py", "dataplane.py"):
+        path = os.path.join(PKG_ROOT, "_private", name)
+        tree = _parse(path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            fname = getattr(func, "id", None) or getattr(func, "attr", None)
+            bad = False
+            if fname == "open":
+                for arg in node.args[1:2] + [kw.value for kw in node.keywords
+                                             if kw.arg == "mode"]:
+                    if isinstance(arg, ast.Constant) and \
+                            isinstance(arg.value, str) and \
+                            "w" in arg.value and "b" in arg.value:
+                        bad = True
+            elif fname in ("remove", "unlink") and \
+                    isinstance(func, ast.Attribute) and \
+                    isinstance(func.value, ast.Name) and \
+                    func.value.id == "os":
+                bad = True
+            if bad:
+                rel = os.path.relpath(path, PKG_ROOT)
+                offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        "direct spill IO in the object stores — binary writes and "
+        "unlinks of spill files must go through a SpillBackend "
+        "(ray_tpu/_private/spill.py) so atomicity, chaos injection, and "
+        "failure accounting cover them: " + ", ".join(offenders))
 
 
 def test_no_bare_print_in_private():

@@ -1,5 +1,10 @@
 """Object spilling, memory monitor + OOM killing, and pubsub tests."""
 
+import json
+import os
+import signal
+import subprocess
+import sys
 import threading
 import time
 
@@ -56,6 +61,75 @@ def test_spill_end_to_end_via_system_config(tmp_path):
         np.testing.assert_array_equal(
             ray_tpu.get(ref), np.full(16 * 1024, i, np.uint8))
     ray_tpu.shutdown()
+
+
+def _wait_for(predicate, timeout=30, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"{what} never became true")
+
+
+def test_daemon_death_restores_from_durable_spill(ray_start_regular,
+                                                  tmp_path):
+    """Chaos acceptance for the spill tier: a daemon forced to spill its
+    (only) copy of a big result through ``session://`` dies by SIGKILL;
+    ``get()`` is byte-identical, the restore is counted with
+    ``{source="spill"}``, and the producer is NOT re-executed."""
+    from ray_tpu._private import builtin_metrics
+    from ray_tpu._private.worker import global_worker
+
+    runtime = global_worker.runtime
+    host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+    marker = tmp_path / "producer-runs.txt"
+    env = dict(os.environ)
+    env["RAY_TPU_object_spill_uri"] = "session://"
+    # A 4 MB arena cannot hold the 8 MB result: the daemon spills it
+    # straight through the (durable) session backend and announces the
+    # URI to the head.
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.multinode",
+         "--address", f"127.0.0.1:{port}",
+         "--num-cpus", "2",
+         "--resources", json.dumps({"remote": 1}),
+         "--object-store-memory", str(4 * 1024 * 1024)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        _wait_for(lambda: ray_tpu.cluster_resources().get("remote", 0) >= 1,
+                  what="daemon registration")
+
+        @ray_tpu.remote(resources={"remote": 1})
+        def produce(path):
+            with open(path, "a") as f:
+                f.write("ran\n")
+            return np.arange(1024 * 1024, dtype=np.int64)  # 8 MB
+
+        ref = produce.remote(str(marker))
+        # The durable spill URI must reach the head's location table
+        # BEFORE we kill the only holder.
+        _wait_for(lambda: runtime._spill_uris_by_key,
+                  what="object_spilled announcement")
+        restores = builtin_metrics.object_restores().series()
+        spill_restores_before = restores.get(("spill",), 0.0)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        # Node removal runs the tiered recovery (no replicas, so the
+        # spill tier restores from the session:// URI).
+        _wait_for(lambda: ray_tpu.cluster_resources().get("remote", 0) == 0,
+                  what="node removal")
+        value = ray_tpu.get(ref, timeout=60)
+        np.testing.assert_array_equal(
+            value, np.arange(1024 * 1024, dtype=np.int64))
+        assert marker.read_text().count("ran") == 1, \
+            "producer must not be re-executed when a spill copy exists"
+        restores = builtin_metrics.object_restores().series()
+        assert restores.get(("spill",), 0.0) == spill_restores_before + 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=10)
 
 
 # -- memory monitor / OOM -------------------------------------------------
