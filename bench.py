@@ -679,6 +679,68 @@ def bench_object_recovery() -> dict:
     return out
 
 
+def bench_train_gang_restart() -> dict:
+    """Train gang-restart latency: a chaos ``train.worker_kill`` takes a
+    rank down mid-run and the metric is the longest gap between
+    consecutive driver-side result rounds — i.e. death detection +
+    gang shutdown + backoff + restart + resume from the durable
+    checkpoint to the first post-restart report. Latency-gated (an
+    INCREASE beyond threshold regresses; see compare_rounds)."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu._private import chaos
+    from ray_tpu.air.checkpoint import Checkpoint
+    from ray_tpu.air.config import FailureConfig, ScalingConfig
+    from ray_tpu.train._internal.backend_executor import BackendExecutor
+    from ray_tpu.train._internal.checkpoint_manager import \
+        CheckpointManager
+    from ray_tpu.train.backend import BackendConfig
+
+    def loop(config):
+        from ray_tpu.air import session
+        ckpt = session.get_checkpoint()
+        start = ckpt.to_dict()["step"] if ckpt else 0
+        for step in range(start, 8):
+            session.report(
+                {"step": step},
+                checkpoint=Checkpoint.from_dict({"step": step + 1}))
+
+    out = {}
+    ray_tpu.init(num_cpus=4)
+    storage = _tempfile.mkdtemp(prefix="bench_train_gang_")
+    try:
+        manager = CheckpointManager(storage, "bench-gang")
+        executor = BackendExecutor(
+            BackendConfig(), ScalingConfig(num_workers=2),
+            FailureConfig(max_failures=2), checkpoint_manager=manager)
+        executor.start()
+        round_times = []
+
+        def on_result(metrics):
+            round_times.append(_time.perf_counter())
+            return True
+
+        # 2 matching calls per start_training + 2 per result round: the
+        # 7th lands in round 3's gather, after two durable checkpoints.
+        chaos.configure("kill:site=train.worker_kill:after=6:times=1")
+        try:
+            result = executor.run(loop, {}, {"trial_id": "bench-gang"},
+                                  result_callback=on_result)
+        finally:
+            chaos.reset()
+            executor.shutdown()
+        assert result.metrics["step"] == 7, result.metrics
+        gaps = [b - a for a, b in zip(round_times, round_times[1:])]
+        out["train_gang_restart_ms"] = round(max(gaps) * 1e3, 1)
+    finally:
+        ray_tpu.shutdown()
+        _shutil.rmtree(storage, ignore_errors=True)
+    return out
+
+
 def bench_serve() -> dict:
     """Serving-plane throughput/latency (reference: release/serve_tests
     autoscaling_single_deployment + single_deployment_1k_noop_replica):
@@ -1323,14 +1385,24 @@ def _prior_round_bench():
     return None, None
 
 
+# Latency metrics gated by NAME, not suffix: `_ms` extras are mostly
+# informational (detached_actor_restart_ms etc. must stay ungated — see
+# test_only_throughput_suffixes_compared); these few regress when they
+# INCREASE beyond the threshold.
+_LATENCY_GATED = ("train_gang_restart_ms",)
+
+
 def compare_rounds(prev: dict, extra: dict, headline_value,
                    threshold: float = 0.10) -> list:
     """Pure comparator behind the regression gate: throughput metrics
     (``*per_sec``/``*_qps``/``*_mfu``/``*mb_per_sec`` keys of the prior
     round's extras, plus the headline value) that dropped by more than
-    ``threshold`` (a fraction: 0.10 = 10%). Improvements, non-numeric
-    values, and metrics absent from either side are ignored. Returns
-    [{metric, prev, now, drop_pct}, ...]."""
+    ``threshold`` (a fraction: 0.10 = 10%), plus the explicitly
+    allowlisted ``_LATENCY_GATED`` metrics when they ROSE by more than
+    ``threshold``. Improvements, non-numeric values, and metrics absent
+    from either side are ignored. Returns
+    [{metric, prev, now, drop_pct}, ...] (a latency rise is recorded as
+    a negative drop_pct)."""
     import re as _re
     floor = 1.0 - threshold
     prev_extra = (prev or {}).get("extra") or {}
@@ -1344,6 +1416,15 @@ def compare_rounds(prev: dict, extra: dict, headline_value,
         new = extra.get(k)
         if isinstance(new, (int, float)) and new < floor * old:
             drop = round(100 * (1 - new / old), 1)
+            regressions.append({"metric": k, "prev": old, "now": new,
+                                "drop_pct": drop})
+    for k in _LATENCY_GATED:
+        old = prev_extra.get(k)
+        new = extra.get(k)
+        if not isinstance(old, (int, float)) or old <= 0:
+            continue
+        if isinstance(new, (int, float)) and new > (1.0 + threshold) * old:
+            drop = round(100 * (1 - new / old), 1)  # negative = rise
             regressions.append({"metric": k, "prev": old, "now": new,
                                 "drop_pct": drop})
     prev_head = (prev or {}).get("value")
@@ -1488,6 +1569,8 @@ def main(argv=None):
         ("channel_reconnect", "channel_reconnect_ms",
          bench_channel_reconnect),
         ("object_recovery", "object_recovery_ms", bench_object_recovery),
+        ("train_gang_restart", "train_gang_restart_ms",
+         bench_train_gang_restart),
         ("log_stream", "log_lines_per_sec", bench_log_streaming),
         ("metrics_overhead", "metrics_overhead_pct",
          bench_metrics_overhead),

@@ -364,6 +364,30 @@ def serve_shed() -> Counter:
         "the proxy).")
 
 
+# -- train fault tolerance -------------------------------------------------
+# Gang lifecycle events (a restart or a persisted checkpoint is news,
+# not load): plain lazy accessors, no fast cells. Incremented from the
+# BackendExecutor restart loop and the durable CheckpointManager.
+
+
+def train_gang_restarts() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_train_gang_restarts_total",
+        "Whole-gang train restarts from the latest checkpoint, by cause "
+        "(system = worker/daemon death or failed liveness probe; app = "
+        "the train loop raised).",
+        tag_keys=("cause",))
+
+
+def train_checkpoints_persisted() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_train_checkpoints_persisted_total",
+        "Reported train checkpoints persisted durably through the "
+        "storage_path spill backend (what a gang restart resumes from).")
+
+
 def channel_bytes_sent() -> Counter:
     from ray_tpu.util.metrics import Counter
     return Counter(

@@ -33,7 +33,12 @@ Sites: ``head.send`` / ``head.recv`` (head side of a session channel),
 (dataplane pooled pull sockets), ``serve.replica_kill`` /
 ``serve.replica_delay_ms`` (serve replica request path — evaluated at
 the top of every ``handle_request``), ``spill.write_error`` /
-``spill.restore_error`` (spill-backend IO, see _private/spill.py).
+``spill.restore_error`` (spill-backend IO, see _private/spill.py),
+``train.worker_kill`` / ``train.result_delay_ms`` /
+``train.ping_delay_ms`` / ``train.start_delay_ms`` (train-worker gang
+RPCs, see train/_internal/worker_group.py — a fired kill makes the
+rank play dead so the BackendExecutor's system-failure gang restart is
+exercised deterministically).
 
 Hot paths guard on the module-level :data:`ACTIVE` flag, so with chaos
 disabled the per-frame cost is a single attribute read and no call.

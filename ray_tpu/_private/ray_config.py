@@ -73,6 +73,13 @@ _PY_DEFAULTS: Dict[str, Any] = {
     "serve_health_check_timeout_s": 5.0,
     "serve_health_failure_threshold": 3,
     "serve_failover_retries": 3,
+    # Train fault tolerance: a gang round with no result for this long
+    # liveness-probes every pending rank and treats failed probes as a
+    # system failure (gang restart from the latest durable checkpoint);
+    # a gang restart waits this long for the full worker complement
+    # before shrinking to ScalingConfig.min_workers.
+    "train_hang_timeout_s": 60.0,
+    "train_restart_wait_s": 30.0,
     "metrics_report_interval_ms": 10_000,
     "task_events_enabled": True,
     "memory_monitor_refresh_ms": 250,
@@ -116,6 +123,39 @@ def _configure(lib) -> None:
     lib.rcfg_set.argtypes = [P, C, C]
     lib.rcfg_dump.restype = L
     lib.rcfg_dump.argtypes = [P, ctypes.c_char_p, L]
+
+
+def runtime_config_value(name: str, default: Any) -> Any:
+    """Read a flag with the standard precedence: the live runtime's
+    config table (native/python, env + _system_config already applied)
+    when a runtime is up, else the raw ``RAY_TPU_<name>`` env var
+    coerced to the default's type, else the default. Shared by serve
+    (``serve_config``) and train (hang/restart knobs) so components
+    read flags identically with or without an initialized runtime."""
+    try:
+        from ray_tpu._private.worker import global_worker
+        runtime = global_worker._runtime
+        cfg = getattr(runtime, "config", None)
+        if cfg is not None:
+            return cfg.get(name)
+    except Exception:  # noqa: BLE001 - fall back to the env var
+        pass
+    env = os.environ.get(f"RAY_TPU_{name}")
+    if env is None:
+        return default
+    if isinstance(default, bool):
+        return env.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        try:
+            return int(float(env))
+        except ValueError:
+            return default
+    if isinstance(default, float):
+        try:
+            return float(env)
+        except ValueError:
+            return default
+    return env
 
 
 def native_config_available() -> bool:

@@ -1,18 +1,29 @@
-"""AIR Checkpoint: dict / directory / sharded-array forms.
+"""AIR Checkpoint: dict / directory / sharded-array / URI forms.
 
 Analog of the reference's python/ray/air/checkpoint.py:63 (Checkpoint with
 to_dict/from_dict/to_directory/from_directory/uri conversions). The TPU-native
 addition is first-class **sharded jax pytrees** via orbax — a 6B-param state
 sharded over a mesh round-trips without ever being gathered onto one host
 (`from_sharded_state` / `restore_sharded_state`).
+
+URI checkpoints (``to_uri``/``from_uri``) persist the payload through the
+pluggable spill backends (``file://`` / ``session://`` / ``mock-s3://``,
+_private/spill.py) with crash-safe atomic writes, so a gang restart can
+restore from a location that survives the reporting node's death. A
+``from_uri`` checkpoint is lazy: nothing is fetched until the first
+``to_dict``/``to_directory``/``restore_sharded_state``, so handing one to
+every rank of a restarted gang costs one small pickle, not one payload
+copy per rank.
 """
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import pickle
 import shutil
+import tarfile
 import tempfile
 import uuid
 from typing import Any, Dict, Optional
@@ -21,16 +32,22 @@ _DICT_FILE = "checkpoint_dict.pkl"
 _METADATA_FILE = "ckpt_metadata.json"
 _SHARDED_DIR = "sharded_state"
 
+# URI-payload envelope versioning (one pickled dict per checkpoint file).
+_PAYLOAD_KIND_DICT = "dict"
+_PAYLOAD_KIND_DIR = "directory"
+
 
 class Checkpoint:
     def __init__(self, data: Optional[Dict[str, Any]] = None,
-                 directory: Optional[str] = None):
-        if (data is None) == (directory is None):
+                 directory: Optional[str] = None,
+                 uri: Optional[str] = None):
+        if sum(x is not None for x in (data, directory, uri)) != 1:
             raise ValueError(
-                "Provide exactly one of data= or directory= "
-                "(use from_dict/from_directory)")
+                "Provide exactly one of data=, directory= or uri= "
+                "(use from_dict/from_directory/from_uri)")
         self._data = data
         self._directory = directory
+        self._uri = uri
         self.id = uuid.uuid4().hex[:8]
 
     # -- constructors -----------------------------------------------------
@@ -42,6 +59,13 @@ class Checkpoint:
     @classmethod
     def from_directory(cls, directory: str) -> "Checkpoint":
         return cls(directory=str(directory))
+
+    @classmethod
+    def from_uri(cls, uri: str) -> "Checkpoint":
+        """A lazy handle on a checkpoint persisted at a spill URI
+        (``to_uri``'s return value). The payload is fetched on first
+        access, from any process that can resolve the URI's backend."""
+        return cls(uri=str(uri))
 
     @classmethod
     def from_sharded_state(cls, state: Any, directory: str,
@@ -66,9 +90,70 @@ class Checkpoint:
             json.dump(meta, f)
         return cls.from_directory(directory)
 
+    # -- URI persistence (durable checkpoints) ----------------------------
+
+    @property
+    def uri(self) -> Optional[str]:
+        """The spill URI this checkpoint was persisted at/loaded from."""
+        return self._uri
+
+    def _payload_bytes(self) -> bytes:
+        """One self-describing pickle: dict checkpoints carry the dict,
+        directory checkpoints carry a tar of the tree (orbax sharded
+        state included)."""
+        self._hydrate()
+        if self._data is not None:
+            return pickle.dumps(
+                {"kind": _PAYLOAD_KIND_DICT, "data": self._data},
+                protocol=pickle.HIGHEST_PROTOCOL)
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tar:
+            tar.add(self._directory, arcname=".")
+        return pickle.dumps(
+            {"kind": _PAYLOAD_KIND_DIR, "tar": buf.getvalue()},
+            protocol=pickle.HIGHEST_PROTOCOL)
+
+    def to_uri(self, uri: str) -> str:
+        """Persist this checkpoint's payload at a spill URI (crash-safe
+        atomic write through the URI's backend: ``file://`` /
+        ``session://`` / ``mock-s3://`` or any registered scheme).
+        Returns the canonical URI — feed it to :meth:`from_uri` on any
+        node that can resolve the backend."""
+        from ray_tpu._private import spill
+        backend = spill.reader_for_uri(uri)
+        if backend is None:
+            raise ValueError(f"no spill backend can write {uri!r}")
+        _, rest = uri.partition("://")[::2]
+        filename = os.path.basename(rest.rstrip("/"))
+        if not filename:
+            raise ValueError(f"checkpoint URI needs a filename: {uri!r}")
+        out = backend.write(filename, self._payload_bytes())
+        self._uri = out
+        return out
+
+    def _hydrate(self) -> None:
+        """Materialize a lazy URI checkpoint into dict/directory form."""
+        if self._data is not None or self._directory is not None:
+            return
+        from ray_tpu._private import spill
+        payload = spill.read_uri(self._uri)
+        if payload is None:
+            raise ValueError(
+                f"Checkpoint payload at {self._uri} is missing or "
+                "unreadable (storage lost after the run that wrote it?)")
+        envelope = pickle.loads(payload)
+        if envelope.get("kind") == _PAYLOAD_KIND_DICT:
+            self._data = envelope["data"]
+            return
+        directory = tempfile.mkdtemp(prefix="ray_tpu_ckpt_uri_")
+        with tarfile.open(fileobj=io.BytesIO(envelope["tar"])) as tar:
+            tar.extractall(directory)
+        self._directory = directory
+
     # -- accessors --------------------------------------------------------
 
     def to_dict(self) -> Dict[str, Any]:
+        self._hydrate()
         if self._data is not None:
             return dict(self._data)
         path = os.path.join(self._directory, _DICT_FILE)
@@ -81,6 +166,7 @@ class Checkpoint:
             "checkpoints.")
 
     def to_directory(self, path: Optional[str] = None) -> str:
+        self._hydrate()
         if self._directory is not None:
             if path and os.path.abspath(path) != self._directory:
                 shutil.copytree(self._directory, path, dirs_exist_ok=True)
@@ -99,6 +185,7 @@ class Checkpoint:
         logging.getLogger("absl").setLevel(logging.WARNING)
         import orbax.checkpoint as ocp
 
+        self._hydrate()
         if self._directory is None:
             raise ValueError("Sharded restore requires a directory checkpoint")
         path = os.path.join(self._directory, _SHARDED_DIR)
@@ -107,6 +194,8 @@ class Checkpoint:
 
     @property
     def extra_metadata(self) -> Dict[str, Any]:
+        if self._uri is not None:
+            self._hydrate()
         if self._directory is None:
             return {}
         path = os.path.join(self._directory, _METADATA_FILE)
@@ -116,5 +205,5 @@ class Checkpoint:
         return {}
 
     def __repr__(self):
-        src = self._directory if self._directory else "<dict>"
+        src = self._uri or self._directory or "<dict>"
         return f"Checkpoint(id={self.id}, source={src})"

@@ -22,6 +22,11 @@ class ScalingConfig:
     """
 
     num_workers: int = 1
+    # Elastic lower bound: after a failure, the gang may restart with
+    # fewer workers (down to this) when the cluster shrank and the full
+    # complement cannot be re-placed within RAY_TPU_train_restart_wait_s.
+    # None -> no elasticity (restart always needs num_workers).
+    min_workers: Optional[int] = None
     use_tpu: bool = False
     tpus_per_worker: Optional[float] = None
     resources_per_worker: Optional[Dict[str, float]] = None
@@ -54,7 +59,15 @@ class ScalingConfig:
 
 @dataclass
 class FailureConfig:
-    """reference: air/config.py FailureConfig (max_failures)."""
+    """reference: air/config.py FailureConfig (max_failures).
+
+    ``max_failures`` bounds gang restarts: 0 fails fast on the first
+    failure (the original cause stays chained on the raised
+    ``TrainingFailedError``), N allows N restarts, and -1 retries
+    forever (reference semantics). Every restart resumes from the
+    newest checkpoint reported so far — the durable one persisted under
+    ``RunConfig.storage_path`` when configured, else the in-memory
+    latest."""
 
     max_failures: int = 0
 

@@ -55,6 +55,11 @@ _DEFAULT_PANELS = [
      "rate(ray_tpu_serve_health_check_failures_total[5m])", "ops"),
     ("Serve requests shed / s", "rate(ray_tpu_serve_shed_total[1m])",
      "ops"),
+    ("Train gang restarts / s (by cause)",
+     "sum by (cause) (rate(ray_tpu_train_gang_restarts_total[5m]))",
+     "ops"),
+    ("Train checkpoints persisted / s",
+     "rate(ray_tpu_train_checkpoints_persisted_total[5m])", "ops"),
     ("Worker pool size", "ray_tpu_worker_pool_size", "short"),
     ("Worker lease wait p95 (s)",
      "histogram_quantile(0.95, "

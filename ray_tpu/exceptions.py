@@ -114,6 +114,27 @@ class RuntimeEnvSetupError(RayError):
     pass
 
 
+# What counts as "the infrastructure failed" (safe to retry elsewhere /
+# gang-restart) versus "the application raised" (surface to the caller
+# unchanged). TaskError wraps application exceptions and is deliberately
+# NOT here — but its ``cause`` may be one of these (a replica refusing
+# work, a worker observing its peer's death), so classification walks
+# one level into the cause. Shared by serve failover
+# (serve/_private/router.py) and train gang recovery
+# (train/_internal/backend_executor.py): one definition, one behavior.
+SYSTEM_FAILURES = (ActorError, ObjectLostError, NodeDiedError,
+                   WorkerCrashedError)
+
+
+def is_system_failure(exc: BaseException) -> bool:
+    """True if ``exc`` is an infrastructure failure (actor/node/worker
+    death, object loss) rather than an application exception —
+    including when it travels as the ``cause`` of a :class:`TaskError`."""
+    if isinstance(exc, SYSTEM_FAILURES):
+        return True
+    return isinstance(getattr(exc, "cause", None), SYSTEM_FAILURES)
+
+
 class OutOfMemoryError(RayError):
     pass
 

@@ -1,15 +1,18 @@
-"""Shared serve-internal helpers: replica lifecycle states, the
-system-failure classification that gates router failover, and config
-access (analog of the reference's serve/_private/common.py).
+"""Shared serve-internal helpers: replica lifecycle states and config
+access (analog of the reference's serve/_private/common.py). The
+system-failure classification that gates router failover moved to
+``ray_tpu.exceptions.is_system_failure`` so train gang recovery shares
+it; it is re-exported here for existing importers.
 """
 
 from __future__ import annotations
 
-import os
 from typing import Any
 
-from ray_tpu.exceptions import (ActorError, NodeDiedError, ObjectLostError,
-                                WorkerCrashedError)
+# Re-exported for serve-internal callers: the classification itself
+# lives in ray_tpu.exceptions so train gang recovery and serve failover
+# share one definition.
+from ray_tpu.exceptions import is_system_failure  # noqa: F401
 
 # Replica lifecycle (reference: serve/_private/common.py ReplicaState):
 # STARTING -> RUNNING -> DRAINING -> STOPPED. Only RUNNING replicas are
@@ -19,47 +22,12 @@ RUNNING = "RUNNING"
 DRAINING = "DRAINING"
 STOPPED = "STOPPED"
 
-# What counts as "the infrastructure failed" (retry elsewhere) versus
-# "the application raised" (surface to the caller unchanged). TaskError
-# wraps application exceptions and is deliberately NOT here.
-_SYSTEM_FAILURES = (ActorError, ObjectLostError, NodeDiedError,
-                    WorkerCrashedError)
-
-
-def is_system_failure(exc: BaseException) -> bool:
-    if isinstance(exc, _SYSTEM_FAILURES):
-        return True
-    # A replica that REFUSES work (draining, chaos-dead) raises
-    # ActorDiedError from inside the method body; the actor executor
-    # wraps in-method exceptions in TaskError, so classify the cause too.
-    return isinstance(getattr(exc, "cause", None), _SYSTEM_FAILURES)
-
 
 def serve_config(name: str, default: Any) -> Any:
     """Read a serve flag with the standard precedence: runtime config
     (native/python flag table, already env-overridden) when a runtime is
-    up, else the raw ``RAY_TPU_<name>`` env var, else the default."""
-    try:
-        from ray_tpu._private.worker import global_worker
-        runtime = global_worker._runtime
-        cfg = getattr(runtime, "config", None)
-        if cfg is not None:
-            return cfg.get(name)
-    except Exception:  # noqa: BLE001 - fall back to the env var
-        pass
-    env = os.environ.get(f"RAY_TPU_{name}")
-    if env is None:
-        return default
-    if isinstance(default, bool):
-        return env.lower() in ("1", "true", "yes", "on")
-    if isinstance(default, int):
-        try:
-            return int(float(env))
-        except ValueError:
-            return default
-    if isinstance(default, float):
-        try:
-            return float(env)
-        except ValueError:
-            return default
-    return env
+    up, else the raw ``RAY_TPU_<name>`` env var, else the default.
+    Thin alias over the shared ``runtime_config_value`` (the same
+    precedence train's fault-tolerance knobs use)."""
+    from ray_tpu._private.ray_config import runtime_config_value
+    return runtime_config_value(name, default)

@@ -40,8 +40,9 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu._private import builtin_metrics
-from ray_tpu.exceptions import BackPressureError, GetTimeoutError
-from ray_tpu.serve._private.common import is_system_failure, serve_config
+from ray_tpu.exceptions import (BackPressureError, GetTimeoutError,
+                                is_system_failure)
+from ray_tpu.serve._private.common import serve_config
 
 logger = logging.getLogger("ray_tpu.serve")
 

@@ -6,17 +6,44 @@ get_next_results:414 gathers one result per worker per round). Gang
 fault-tolerance is TPU-shaped: a mesh/slice fails as a unit, so recovery
 restarts the WHOLE worker group from the latest checkpoint (SURVEY.md §7
 hard parts), not one worker.
+
+Failure handling covers both halves of the reference contract
+(backend_executor poll loop + TrainingWorkerError gang restart):
+
+* **Application errors** travel inside result payloads and surface as
+  ``TrainingFailedError(cause_kind="app")``.
+* **System failures** — a worker/daemon that actually dies raises
+  ``ActorDiedError``/``NodeDiedError``/… straight out of the gang RPCs
+  (``start_training``, ``get_next_result``, ``on_training_start``).
+  Every such RPC is wrapped and classified with the shared
+  ``ray_tpu.exceptions.is_system_failure`` (same helper as serve
+  failover), so a SIGKILLed rank takes the gang-restart path too,
+  resuming from ``latest_checkpoint`` — the durable URI checkpoint when
+  a ``CheckpointManager`` is attached.
+* **Hangs** — the result gather is ``ray_tpu.wait``-based (one dead or
+  hung worker can't wedge the round behind rank order), and after
+  ``RAY_TPU_train_hang_timeout_s`` without any result every pending
+  rank is liveness-probed (``ping``); a failed probe is treated as a
+  system failure.
+
+Restarts are **elastic and bounded**: jittered ``Backoff`` between
+attempts, a ``RAY_TPU_train_restart_wait_s`` bounded wait for resources,
+and ``ScalingConfig.min_workers`` lets the gang come back smaller when
+the cluster shrank.
 """
 
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ray_tpu._private.channel import Backoff
+from ray_tpu._private.ray_config import runtime_config_value
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import FailureConfig, ScalingConfig
 from ray_tpu.air.result import Result
-from ray_tpu.exceptions import RayError
+from ray_tpu.exceptions import RayError, is_system_failure
 from ray_tpu.train._internal.worker_group import WorkerGroup
 from ray_tpu.train.backend import BackendConfig
 
@@ -24,14 +51,34 @@ logger = logging.getLogger("ray_tpu.train")
 
 
 class TrainingFailedError(RayError):
-    pass
+    """Training failed. ``latest_checkpoint`` carries the newest
+    checkpoint reported before the failure (a durable URI checkpoint
+    when a storage_path was configured); ``cause_kind`` is ``"system"``
+    (infrastructure died / hung) or ``"app"`` (the train loop raised).
+    The original failure stays chained as ``__cause__``."""
+
+    def __init__(self, message: str = "",
+                 latest_checkpoint: Optional[Checkpoint] = None,
+                 cause_kind: str = "app"):
+        super().__init__(message)
+        self.latest_checkpoint = latest_checkpoint
+        self.cause_kind = cause_kind
+
+
+def _count_gang_restart(cause: str) -> None:
+    try:
+        from ray_tpu._private import builtin_metrics
+        builtin_metrics.train_gang_restarts().inc(tags={"cause": cause})
+    except Exception:  # noqa: BLE001 - metrics never break recovery
+        pass
 
 
 class BackendExecutor:
     def __init__(self, backend_config: BackendConfig,
                  scaling_config: ScalingConfig,
                  failure_config: Optional[FailureConfig] = None,
-                 result_timeout: Optional[float] = None):
+                 result_timeout: Optional[float] = None,
+                 checkpoint_manager: Optional[Any] = None):
         self.backend_config = backend_config
         self.backend = backend_config.backend_cls()
         self.scaling_config = scaling_config
@@ -39,14 +86,22 @@ class BackendExecutor:
         # None = block indefinitely between reports (first steps of large
         # models can spend many minutes in XLA compilation).
         self.result_timeout = result_timeout
+        # Persists reported checkpoints through a durable spill backend
+        # (train/_internal/checkpoint_manager.py); None keeps the
+        # process-local dict/directory behavior.
+        self.checkpoint_manager = checkpoint_manager
         self.worker_group: Optional[WorkerGroup] = None
+        self._num_workers = scaling_config.num_workers
 
-    def start(self) -> None:
+    def start(self, num_workers: Optional[int] = None) -> None:
+        if num_workers is not None:
+            self._num_workers = num_workers
+        n = self._num_workers
         self.worker_group = WorkerGroup(
-            self.scaling_config.num_workers,
+            n,
             self.scaling_config.worker_resources(),
             self.scaling_config.placement_strategy,
-            bundles=self.scaling_config.as_placement_group_bundles(),
+            bundles=self.scaling_config.as_placement_group_bundles()[:n],
             runtime_env=getattr(self.scaling_config, "runtime_env", None))
         self.backend.on_start(self.worker_group, self.backend_config)
 
@@ -59,8 +114,13 @@ class BackendExecutor:
 
         result_callback receives each per-round rank-0 metrics dict; if it
         returns False, training is stopped early.
+
+        ``FailureConfig.max_failures``: 0 fails fast (original cause
+        chained), N allows N gang restarts, -1 retries forever. Each
+        restart resumes from the newest checkpoint reported so far.
         """
         failures_left = self.failure_config.max_failures
+        restart_backoff = Backoff(initial=0.5, cap=10.0)
         while True:
             try:
                 return self._run_once(train_fn, config, trial_info,
@@ -71,44 +131,193 @@ class BackendExecutor:
                 if failures_left == 0:
                     raise
                 failures_left -= 1 if failures_left > 0 else 0
+                cause = getattr(e, "cause_kind", "app")
+                _count_gang_restart(cause)
                 logger.warning(
-                    "Training failed (%s); gang-restarting worker group "
-                    "from %s (%d retries left)", e,
-                    latest, failures_left)
+                    "Training failed (%s, cause=%s); gang-restarting worker "
+                    "group from %s (%s retries left)", e, cause, latest,
+                    "inf" if failures_left < 0 else failures_left)
                 checkpoint = latest or checkpoint
                 self.shutdown()
-                self.start()
+                # Jittered pause so N drivers restarting against one
+                # shrunken cluster don't stampede the scheduler.
+                time.sleep(restart_backoff.next())
+                self._restart_elastic()
+
+    # -- elastic restart ---------------------------------------------------
+
+    def _placeable_workers(self, desired: int) -> int:
+        """How many train workers the cluster could place right now,
+        judged by available resources against one worker's demand."""
+        import ray_tpu
+        try:
+            avail = ray_tpu.available_resources()
+        except Exception:  # noqa: BLE001 - no introspection: assume full
+            return desired
+        need = self.scaling_config.worker_resources()
+        fits = desired
+        for key, per_worker in need.items():
+            if per_worker <= 0:
+                continue
+            fits = min(fits, int(avail.get(key, 0.0) // per_worker))
+        return fits
+
+    def _restart_elastic(self) -> None:
+        """Re-create the worker group, waiting a bounded
+        ``RAY_TPU_train_restart_wait_s`` for the full complement and
+        shrinking down to ``ScalingConfig.min_workers`` if the cluster
+        cannot place it (e.g. the failed slice has not been replaced)."""
+        desired = self.scaling_config.num_workers
+        minimum = self.scaling_config.min_workers or desired
+        wait_s = float(runtime_config_value("train_restart_wait_s", 30.0))
+        deadline = time.monotonic() + max(0.0, wait_s)
+        last_exc: Optional[BaseException] = None
+        fit = 0
+        while True:
+            fit = self._placeable_workers(desired)
+            # Hold out for the full complement until the deadline; only
+            # then settle for an elastic (>= minimum) gang.
+            settle = time.monotonic() >= deadline
+            if fit >= desired or (settle and fit >= minimum):
+                n = desired if fit >= desired else max(minimum, fit)
+                if n < desired:
+                    logger.warning(
+                        "Elastic gang restart with %d/%d workers "
+                        "(min_workers=%d): cluster shrank and "
+                        "train_restart_wait_s=%ss expired", n, desired,
+                        minimum, wait_s)
+                try:
+                    self.start(num_workers=n)
+                    return
+                except Exception as exc:  # noqa: BLE001
+                    # Raced a node death between sizing and reservation
+                    # (the scheduler can refuse the placement group it
+                    # just advertised room for). Clean up and re-size.
+                    last_exc = exc
+                    logger.warning(
+                        "gang restart with %d workers failed (%s); "
+                        "re-sizing", n, exc)
+                    self.shutdown()
+            if settle:
+                break
+            time.sleep(0.25)
+        err = TrainingFailedError(
+            f"cluster cannot place even min_workers={minimum} train "
+            f"workers (room for {fit}) within "
+            f"train_restart_wait_s={wait_s}s", cause_kind="system")
+        if last_exc is not None:
+            err.__cause__ = last_exc
+        raise err
+
+    # -- failure classification --------------------------------------------
+
+    def _system_failure(self, exc: BaseException,
+                        latest_checkpoint: Optional[Checkpoint]
+                        ) -> TrainingFailedError:
+        err = TrainingFailedError(
+            f"system failure in training gang: "
+            f"{type(exc).__name__}: {exc}",
+            latest_checkpoint=latest_checkpoint, cause_kind="system")
+        err.__cause__ = exc
+        return err
+
+    def _probe_liveness(self, ranks: List[int],
+                        hang_timeout: float) -> List[int]:
+        """Ping every pending rank with a bounded get; any failure
+        (dead actor, lost node, probe timeout) marks the rank dead."""
+        import ray_tpu
+        probe_timeout = max(0.2, min(5.0, hang_timeout))
+        refs = {rank: self.worker_group.workers[rank].ping.remote()
+                for rank in ranks}
+        dead = []
+        for rank, ref in refs.items():
+            try:
+                ray_tpu.get(ref, timeout=probe_timeout)
+            except BaseException as exc:  # noqa: BLE001
+                logger.warning("liveness probe of train rank %d failed: %s",
+                               rank, exc)
+                dead.append(rank)
+        return dead
+
+    def _drain(self, pending: Dict[Any, int],
+               latest_checkpoint: Optional[Checkpoint],
+               on_payload: Callable[[int, Any], None]) -> None:
+        """Gather every pending ref with ``ray_tpu.wait`` (no rank-order
+        blocking: whichever rank finishes — or dies — first is observed
+        first). System failures raise ``TrainingFailedError``; after
+        ``RAY_TPU_train_hang_timeout_s`` with no result, unresponsive
+        ranks (failed liveness probe) are treated the same way."""
+        import ray_tpu
+        hang_timeout = float(
+            runtime_config_value("train_hang_timeout_s", 60.0))
+        slice_s = min(1.0, hang_timeout / 4.0) if hang_timeout > 0 else 1.0
+        last_progress = time.monotonic()
+        while pending:
+            ready, _ = ray_tpu.wait(list(pending), num_returns=1,
+                                    timeout=slice_s)
+            if ready:
+                last_progress = time.monotonic()
+                for ref in ready:
+                    rank = pending.pop(ref)
+                    try:
+                        payload = ray_tpu.get(ref)
+                    except BaseException as exc:  # noqa: BLE001
+                        if is_system_failure(exc):
+                            raise self._system_failure(
+                                exc, latest_checkpoint) from exc
+                        raise
+                    on_payload(rank, payload)
+                continue
+            if hang_timeout > 0 and \
+                    time.monotonic() - last_progress >= hang_timeout:
+                dead = self._probe_liveness(sorted(pending.values()),
+                                            hang_timeout)
+                if dead:
+                    exc = TimeoutError(
+                        f"train ranks {dead} produced no result for "
+                        f"{hang_timeout}s and failed their liveness "
+                        "probe")
+                    raise self._system_failure(exc, latest_checkpoint)
+                # Alive but slow (XLA compile, giant step): keep waiting.
+                last_progress = time.monotonic()
+
+    # -- one gang attempt --------------------------------------------------
 
     def _run_once(self, train_fn, config, trial_info, checkpoint,
                   dataset_shards_per_worker, result_callback) -> Result:
         group = self.worker_group
-        self.backend.on_training_start(group, self.backend_config)
-        starts = []
+        latest_checkpoint = checkpoint
+        try:
+            self.backend.on_training_start(group, self.backend_config)
+        except BaseException as exc:  # noqa: BLE001
+            if is_system_failure(exc):
+                raise self._system_failure(exc, latest_checkpoint) from exc
+            raise
+        starts: Dict[Any, int] = {}
         for rank, worker in enumerate(group.workers):
             shards = (dataset_shards_per_worker[rank]
-                      if dataset_shards_per_worker else None)
-            starts.append(worker.start_training.remote(
-                train_fn, config, trial_info, checkpoint, shards))
-        import ray_tpu
-        ray_tpu.get(starts)
+                      if dataset_shards_per_worker and
+                      rank < len(dataset_shards_per_worker) else None)
+            starts[worker.start_training.remote(
+                train_fn, config, trial_info, checkpoint, shards)] = rank
+        self._drain(starts, latest_checkpoint, lambda rank, payload: None)
 
         history: List[Dict[str, Any]] = []
-        latest_checkpoint = checkpoint
         final_error: Optional[BaseException] = None
         stop_sent = False
         finished = [False] * len(group.workers)
         while not all(finished):
-            # Submit one result request to every live worker, then gather —
-            # a single round-trip per round, not N sequential ones.
-            refs = {
-                rank: group.workers[rank].get_next_result.remote(
-                    self.result_timeout)
+            # Submit one result request to every live worker, then gather
+            # via wait — a dead/hung rank 0 can't stall detection of the
+            # other ranks' results.
+            pending = {
+                group.workers[rank].get_next_result.remote(
+                    self.result_timeout): rank
                 for rank in range(len(group.workers)) if not finished[rank]
             }
-            round_payloads: Dict[int, dict] = {
-                rank: ray_tpu.get(ref, timeout=None)
-                for rank, ref in refs.items()
-            }
+            round_payloads: Dict[int, dict] = {}
+            self._drain(pending, latest_checkpoint,
+                        round_payloads.__setitem__)
             for rank, payload in round_payloads.items():
                 if payload.get("timeout"):
                     final_error = TimeoutError(
@@ -122,14 +331,24 @@ class BackendExecutor:
                         logger.error("Worker %d failed:\n%s", rank,
                                      payload.get("traceback", ""))
             if final_error is not None:
-                err = TrainingFailedError(str(final_error))
-                err.latest_checkpoint = latest_checkpoint
+                err = TrainingFailedError(
+                    str(final_error), latest_checkpoint=latest_checkpoint,
+                    cause_kind="app")
                 err.__cause__ = final_error
                 raise err
-            for payload in round_payloads.values():
+            # Persist at most one checkpoint per round (ranks report
+            # replicas of the same state; rank 0 is canonical).
+            for rank in sorted(round_payloads):
+                payload = round_payloads[rank]
                 if not payload.get("finished") and \
                         payload.get("checkpoint") is not None:
-                    latest_checkpoint = payload["checkpoint"]
+                    reported = payload["checkpoint"]
+                    if self.checkpoint_manager is not None:
+                        latest_checkpoint = self.checkpoint_manager.register(
+                            reported, payload.get("metrics"))
+                    else:
+                        latest_checkpoint = reported
+                    break
             # Rank 0's stream is canonical for metrics (reference behavior);
             # rounds after rank 0 finishes aren't recorded.
             rank0 = round_payloads.get(0)

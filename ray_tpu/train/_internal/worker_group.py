@@ -14,15 +14,24 @@ import threading
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private import chaos
 from ray_tpu.air import session as air_session
 from ray_tpu.air.session import StopSession, _Session
+from ray_tpu.exceptions import ActorDiedError
 from ray_tpu.util.placement_group import (PlacementGroup, placement_group,
                                           remove_placement_group)
 
 
 @ray_tpu.remote
 class TrainWorker:
-    """One rank of the training gang."""
+    """One rank of the training gang.
+
+    Chaos sites ``train.worker_kill`` / ``train.result_delay_ms`` /
+    ``train.ping_delay_ms`` are evaluated at the top of the driver-facing
+    RPCs: a fired kill makes this worker play dead (every subsequent
+    call raises ActorDiedError — the same observable behavior as a real
+    SIGKILLed rank), which the BackendExecutor classifies as a system
+    failure and answers with a gang restart."""
 
     def __init__(self, world_rank: int, world_size: int):
         self.world_rank = world_rank
@@ -30,6 +39,19 @@ class TrainWorker:
         self.session: Optional[_Session] = None
         self.thread: Optional[threading.Thread] = None
         self.env: Dict[str, str] = {}
+        self._chaos_dead = False
+
+    def _chaos_gate(self, delay_site: str) -> None:
+        if chaos.ACTIVE:
+            chaos.maybe_inject(delay_site)
+            try:
+                chaos.maybe_inject("train.worker_kill")
+            except chaos.ChaosKill:
+                self._chaos_dead = True
+        if self._chaos_dead:
+            raise ActorDiedError(
+                message=f"train worker rank {self.world_rank} is dead "
+                        "(chaos kill)")
 
     def setup_env(self, env: Dict[str, str]) -> None:
         """Backend hook: set process env (e.g. jax.distributed coordinator)."""
@@ -46,10 +68,19 @@ class TrainWorker:
         from ray_tpu.train.jax import distributed_init_if_needed
         distributed_init_if_needed()
 
+    def ping(self) -> bool:
+        """Liveness probe for the executor's hang detector: cheap, and
+        subject to the same chaos gate as the result path, so a
+        chaos-killed or chaos-hung worker fails its probe the way a
+        SIGKILLed one would."""
+        self._chaos_gate("train.ping_delay_ms")
+        return True
+
     def start_training(self, train_fn: Callable, config: dict,
                        trial_info: dict,
                        checkpoint=None, dataset_shards: Optional[dict] = None
                        ) -> None:
+        self._chaos_gate("train.start_delay_ms")
         self.session = _Session(
             world_rank=self.world_rank,
             world_size=self.world_size,
@@ -101,6 +132,7 @@ class TrainWorker:
         continue. timeout=None blocks indefinitely (a dead train thread
         always pushes a finished sentinel, so this cannot hang silently);
         pass a float to surface report gaps as {'timeout': True}."""
+        self._chaos_gate("train.result_delay_ms")
         import queue as _q
         try:
             item = self.session.result_queue.get(timeout=timeout)

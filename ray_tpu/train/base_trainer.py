@@ -112,10 +112,30 @@ class DataParallelTrainer(BaseTrainer):
         return self._fit_with_callback(None)
 
     def _fit_with_callback(self, callback) -> Result:
+        run_config = self.run_config
+        # Durable checkpoints: with a storage_path, every reported
+        # checkpoint is persisted through the spill backends and a new
+        # run under the same RunConfig.name auto-resumes from the
+        # newest one (reference: trainer restoration via run(name=...)).
+        checkpoint_manager = None
+        resume = self.resume_from_checkpoint
+        if run_config is not None and run_config.storage_path:
+            from ray_tpu.train._internal.checkpoint_manager import \
+                CheckpointManager
+            checkpoint_manager = CheckpointManager(
+                run_config.storage_path, run_config.name or "train",
+                run_config.checkpoint_config)
+            if resume is None:
+                resume = checkpoint_manager.latest()
+                if resume is not None:
+                    import logging
+                    logging.getLogger("ray_tpu.train").info(
+                        "Auto-resuming run %r from durable checkpoint %s",
+                        run_config.name or "train", resume.uri)
         executor = BackendExecutor(
             self.backend_config, self.scaling_config,
-            (self.run_config.failure_config
-             if self.run_config else None))
+            (run_config.failure_config if run_config else None),
+            checkpoint_manager=checkpoint_manager)
         executor.start()
         trial_info = {"trial_id": uuid.uuid4().hex[:8],
                       "trial_name": self.run_config.name or "train"}
@@ -124,7 +144,7 @@ class DataParallelTrainer(BaseTrainer):
                 self.train_loop_per_worker,
                 self.train_loop_config,
                 trial_info,
-                checkpoint=self.resume_from_checkpoint,
+                checkpoint=resume,
                 dataset_shards_per_worker=self._shard_datasets(
                     self.scaling_config.num_workers),
                 result_callback=callback,

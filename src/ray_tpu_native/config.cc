@@ -128,6 +128,13 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     FLAG_DBL(serve_health_check_timeout_s, 5.0),
     FLAG_INT(serve_health_failure_threshold, 3),
     FLAG_INT(serve_failover_retries, 3),
+    // -- train fault tolerance --
+    // Hang detector: a result round idle this long liveness-probes the
+    // pending ranks (failed probe => system failure, gang restart);
+    // restart waits this long for full resources before shrinking to
+    // ScalingConfig.min_workers.
+    FLAG_DBL(train_hang_timeout_s, 60.0),
+    FLAG_DBL(train_restart_wait_s, 30.0),
     // -- metrics / events --
     FLAG_INT(metrics_report_interval_ms, 10000),
     FLAG_BOOL(task_events_enabled, true),

@@ -1,0 +1,538 @@
+"""Train fault tolerance: durable checkpoints, system-failure gang
+recovery, elastic restarts (ISSUE 10).
+
+Covers the whole contract end to end: shared failure classification
+(the serve-router helper promoted to ray_tpu.exceptions), the
+CheckpointManager's durable persistence/pruning/auto-resume over the
+spill backends, chaos-injected worker death taking the gang-restart
+path, hang detection via liveness probes, elastic restarts at
+ScalingConfig.min_workers, FailureConfig.max_failures semantics (0 /
+N / -1), the bench latency gate, and a multinode acceptance run that
+SIGKILLs a daemon hosting a train worker mid-run.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import cloudpickle
+import pytest
+
+import ray_tpu
+
+# Daemon subprocesses cannot import the tests/ directory — ship this
+# module's train loops by value (same idiom as test_train_multiprocess).
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+from ray_tpu._private import builtin_metrics, chaos  # noqa: E402
+from ray_tpu.air import (Checkpoint, CheckpointConfig, FailureConfig,  # noqa: E402
+                         RunConfig, ScalingConfig, session)
+from ray_tpu.exceptions import (ActorDiedError, NodeDiedError,  # noqa: E402
+                                ObjectLostError, TaskError,
+                                WorkerCrashedError, is_system_failure)
+from ray_tpu.train import DataParallelTrainer  # noqa: E402
+from ray_tpu.train._internal.backend_executor import (  # noqa: E402
+    BackendExecutor, TrainingFailedError)
+from ray_tpu.train._internal.checkpoint_manager import (  # noqa: E402
+    CheckpointManager, normalize_storage_uri)
+from ray_tpu.train.backend import BackendConfig  # noqa: E402
+
+
+def _counter_total(counter, tag_substr=None):
+    if tag_substr is None:
+        return sum(counter.series().values())
+    return sum(v for k, v in counter.series().items()
+               if any(tag_substr in str(part) for part in k))
+
+
+def _set_flag(name, value):
+    """Set a live runtime-config flag (what runtime_config_value reads
+    when a runtime is up)."""
+    from ray_tpu._private.worker import global_worker
+    global_worker._runtime.config.set(name, value)
+
+
+# ---------------------------------------------------------------------------
+# Failure classification (shared helper, satellite a)
+# ---------------------------------------------------------------------------
+
+
+def test_is_system_failure_classification():
+    assert is_system_failure(ActorDiedError(message="gone"))
+    assert is_system_failure(ObjectLostError("obj lost"))
+    assert is_system_failure(NodeDiedError("node died"))
+    assert is_system_failure(WorkerCrashedError("crash"))
+    assert not is_system_failure(RuntimeError("app bug"))
+    assert not is_system_failure(ValueError("bad input"))
+
+
+def test_is_system_failure_unwraps_task_error_cause():
+    wrapped = TaskError(ActorDiedError(message="gone"))
+    assert is_system_failure(wrapped)
+    app = TaskError(ValueError("app bug"))
+    assert not is_system_failure(app)
+
+
+def test_serve_reexports_shared_helper():
+    """The serve router's classifier IS the shared helper, not a copy."""
+    from ray_tpu.serve._private import common
+    assert common.is_system_failure is is_system_failure
+
+
+# ---------------------------------------------------------------------------
+# Durable checkpoints: Checkpoint.to_uri/from_uri + CheckpointManager
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_uri_roundtrip_dict(tmp_path):
+    ckpt = Checkpoint.from_dict({"step": 7, "w": [1.0, 2.0]})
+    uri = ckpt.to_uri(f"file://{tmp_path}/ck-000001.ckpt")
+    assert uri.startswith("file://")
+    restored = Checkpoint.from_uri(uri)
+    assert restored.to_dict() == {"step": 7, "w": [1.0, 2.0]}
+
+
+def test_checkpoint_uri_roundtrip_directory(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "weights.bin").write_bytes(b"\x00\x01\x02")
+    (src / "meta.json").write_text('{"step": 3}')
+    ckpt = Checkpoint.from_directory(str(src))
+    uri = ckpt.to_uri(f"file://{tmp_path}/dir-ck.ckpt")
+    out = Checkpoint.from_uri(uri).to_directory()
+    assert open(os.path.join(out, "weights.bin"), "rb").read() == \
+        b"\x00\x01\x02"
+    assert json.load(open(os.path.join(out, "meta.json")))["step"] == 3
+
+
+def test_checkpoint_uri_roundtrip_mock_s3(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_MOCK_S3_DIR", str(tmp_path / "s3"))
+    ckpt = Checkpoint.from_dict({"step": 11})
+    uri = ckpt.to_uri("mock-s3://ckpts/run-a.ckpt")
+    assert uri.startswith("mock-s3://ckpts/")
+    assert Checkpoint.from_uri(uri).to_dict() == {"step": 11}
+
+
+def test_normalize_storage_uri(tmp_path):
+    assert normalize_storage_uri(str(tmp_path)) == f"file://{tmp_path}"
+    assert normalize_storage_uri("mock-s3://b/prefix") == "mock-s3://b/prefix"
+
+
+def test_checkpoint_manager_roundtrip_and_index(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), "run-a")
+    assert mgr.latest() is None
+    for step in range(3):
+        durable = mgr.register(Checkpoint.from_dict({"step": step}),
+                               metrics={"step": step})
+        assert durable.uri and durable.uri.startswith("file://")
+    assert mgr.latest().to_dict() == {"step": 2}
+    # A brand-new manager for the SAME run finds the index and resumes
+    # the sequence — this is what Trainer auto-resume rides on.
+    mgr2 = CheckpointManager(str(tmp_path), "run-a")
+    assert mgr2.latest().to_dict() == {"step": 2}
+    mgr2.register(Checkpoint.from_dict({"step": 3}))
+    assert mgr2.latest().to_dict() == {"step": 3}
+    # Different run name, same storage: isolated.
+    assert CheckpointManager(str(tmp_path), "run-b").latest() is None
+
+
+def test_checkpoint_manager_num_to_keep(tmp_path):
+    mgr = CheckpointManager(
+        str(tmp_path), "keepers",
+        CheckpointConfig(num_to_keep=2))
+    for step in range(5):
+        mgr.register(Checkpoint.from_dict({"step": step}))
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".ckpt")]
+    assert len(files) == 2, files
+    assert mgr.latest().to_dict() == {"step": 4}
+
+
+def test_checkpoint_manager_score_pruning(tmp_path):
+    mgr = CheckpointManager(
+        str(tmp_path), "scored",
+        CheckpointConfig(num_to_keep=2, checkpoint_score_attribute="acc"))
+    accs = [0.1, 0.9, 0.5, 0.2]
+    for step, acc in enumerate(accs):
+        mgr.register(Checkpoint.from_dict({"step": step}),
+                     metrics={"acc": acc})
+    # Best-by-score survives pruning; the newest is ALWAYS retained
+    # (it's what a gang restart resumes from).
+    assert mgr.best().to_dict() == {"step": 1}       # acc=0.9
+    assert mgr.latest().to_dict() == {"step": 3}     # newest
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".ckpt")]
+    assert len(files) == 2, files
+
+
+def test_checkpoint_manager_mock_s3(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_MOCK_S3_DIR", str(tmp_path / "s3"))
+    mgr = CheckpointManager("mock-s3://train-bucket", "cloud-run")
+    durable = mgr.register(Checkpoint.from_dict({"step": 1}))
+    assert durable.uri.startswith("mock-s3://train-bucket/")
+    assert CheckpointManager("mock-s3://train-bucket",
+                             "cloud-run").latest().to_dict() == {"step": 1}
+
+
+# ---------------------------------------------------------------------------
+# Gang recovery under chaos (tentpole) + max_failures semantics
+# ---------------------------------------------------------------------------
+
+
+def _step_loop(total):
+    def loop():
+        ckpt = session.get_checkpoint()
+        start = ckpt.to_dict()["step"] if ckpt else 0
+        for i in range(start, total):
+            session.report({"step": i},
+                           checkpoint=Checkpoint.from_dict({"step": i + 1}))
+    return loop
+
+
+def test_chaos_worker_kill_gang_restart_durable(ray_start_regular, tmp_path):
+    """A chaos-killed rank surfaces as ActorDiedError out of the gang
+    RPC, classifies as a SYSTEM failure, and the whole gang restarts
+    from the latest DURABLE checkpoint; both counters increment."""
+    restarts_before = _counter_total(
+        builtin_metrics.train_gang_restarts(), "system")
+    persisted_before = _counter_total(
+        builtin_metrics.train_checkpoints_persisted())
+
+    trainer = DataParallelTrainer(
+        _step_loop(8),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="chaos-kill", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1)))
+    # 2 kill-gate evaluations per start_training + 2 per result round:
+    # the 7th call lands in round 3's gather, after two durable
+    # checkpoints have been persisted.
+    chaos.configure("kill:site=train.worker_kill:after=6:times=1")
+    try:
+        result = trainer.fit()
+        killed = any(op["fired"] for op in chaos.stats())
+    finally:
+        chaos.reset()
+    assert killed, "chaos kill never fired"
+    assert result.metrics["step"] == 7
+    assert result.checkpoint.to_dict() == {"step": 8}
+    assert _counter_total(builtin_metrics.train_gang_restarts(),
+                          "system") >= restarts_before + 1
+    assert _counter_total(
+        builtin_metrics.train_checkpoints_persisted()) > persisted_before
+    # The restart really resumed from storage: durable files exist.
+    assert any(f.endswith(".ckpt") for f in os.listdir(tmp_path))
+
+
+def test_hang_timeout_liveness_probe(ray_start_regular):
+    """A rank that stops producing results AND fails its liveness probe
+    is treated as a system failure (gang restart path), bounded by
+    RAY_TPU_train_hang_timeout_s — not an indefinite hang."""
+    _set_flag("train_hang_timeout_s", 0.5)
+    restarts_before = _counter_total(
+        builtin_metrics.train_gang_restarts(), "system")
+    trainer = DataParallelTrainer(
+        _step_loop(4),
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=0)))
+    # Wedge the result path for 8s AND the ping probe, so the hang
+    # detector's probe times out -> system failure, fail-fast.
+    chaos.configure("delay_ms:site=train.result:ms=8000:times=1;"
+                    "delay_ms:site=train.ping:ms=8000:times=2")
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(TrainingFailedError) as excinfo:
+            trainer.fit()
+    finally:
+        chaos.reset()
+    elapsed = time.monotonic() - t0
+    assert excinfo.value.cause_kind == "system"
+    assert "liveness" in str(excinfo.value)
+    assert elapsed < 6.0, f"hang detector too slow: {elapsed:.1f}s"
+    # max_failures=0 fails fast: no restart was attempted.
+    assert _counter_total(builtin_metrics.train_gang_restarts(),
+                          "system") == restarts_before
+
+
+def test_slow_but_alive_worker_is_not_killed(ray_start_regular):
+    """The hang timer resets when probes pass: a slow step (XLA compile)
+    must NOT be misclassified as a dead rank."""
+    _set_flag("train_hang_timeout_s", 0.3)
+    trainer = DataParallelTrainer(
+        _step_loop(2),
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=0)))
+    # Result path stalls ~1.2s (4x the hang timeout) but pings answer.
+    chaos.configure("delay_ms:site=train.result:ms=1200:times=1")
+    try:
+        result = trainer.fit()
+    finally:
+        chaos.reset()
+    assert result.metrics["step"] == 1
+
+
+def test_system_failure_max_failures_zero_fails_fast(ray_start_regular):
+    """A SYSTEM failure under max_failures=0 fails fast too, with the
+    original infrastructure error chained as __cause__."""
+    trainer = DataParallelTrainer(
+        _step_loop(4),
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=0)))
+    chaos.configure("kill:site=train.worker_kill:after=2:times=1")
+    try:
+        with pytest.raises(TrainingFailedError) as excinfo:
+            trainer.fit()
+    finally:
+        chaos.reset()
+    assert excinfo.value.cause_kind == "system"
+    assert is_system_failure(excinfo.value.__cause__)
+
+
+def test_max_failures_zero_fails_fast_with_cause(ray_start_regular):
+    def loop():
+        session.report({"ok": 1})
+        raise ValueError("boom at step 1")
+
+    trainer = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=0)))
+    with pytest.raises(TrainingFailedError) as excinfo:
+        trainer.fit()
+    assert excinfo.value.cause_kind == "app"
+    assert "boom at step 1" in str(excinfo.value)
+    assert isinstance(excinfo.value.__cause__, ValueError)
+
+
+def test_max_failures_infinite_retries(ray_start_regular, tmp_path):
+    """max_failures=-1 retries forever; each restart resumes from the
+    newest durable checkpoint."""
+    marker = tmp_path / "attempts"
+
+    def loop(config):
+        with open(config["marker"], "a") as f:
+            f.write("x")
+        attempt = os.path.getsize(config["marker"])
+        ckpt = session.get_checkpoint()
+        start = ckpt.to_dict()["step"] if ckpt else 0
+        for i in range(start, 5):
+            session.report({"step": i, "attempt": attempt},
+                           checkpoint=Checkpoint.from_dict({"step": i + 1}))
+            if attempt <= 3 and i == attempt - 1:
+                raise RuntimeError(f"attempt {attempt} dies after step {i}")
+
+    trainer = DataParallelTrainer(
+        loop, train_loop_config={"marker": str(marker)},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="forever", storage_path=str(tmp_path / "store"),
+            failure_config=FailureConfig(max_failures=-1)))
+    result = trainer.fit()
+    assert result.metrics["step"] == 4
+    assert result.metrics["attempt"] == 4          # three failed attempts
+    assert result.checkpoint.to_dict() == {"step": 5}
+
+
+def test_auto_resume_same_run_name(ray_start_regular, tmp_path):
+    """A new Trainer under the same RunConfig.name resumes from the
+    newest durable checkpoint without resume_from_checkpoint."""
+    run = RunConfig(name="resumable", storage_path=str(tmp_path))
+    first = DataParallelTrainer(
+        _step_loop(3), scaling_config=ScalingConfig(num_workers=1),
+        run_config=run)
+    r1 = first.fit()
+    assert r1.metrics["step"] == 2
+
+    second = DataParallelTrainer(
+        _step_loop(5), scaling_config=ScalingConfig(num_workers=1),
+        run_config=run)
+    r2 = second.fit()
+    # Started at step 3 (the durable checkpoint), so only 2 rounds ran.
+    assert r2.metrics["step"] == 4
+    assert len(r2.metrics_history) == 2
+    assert r2.checkpoint.to_dict() == {"step": 5}
+
+
+# ---------------------------------------------------------------------------
+# Elastic restarts (ScalingConfig.min_workers)
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_restart_shrinks_to_min_workers(ray_start_regular,
+                                                monkeypatch):
+    def loop():
+        if session.get_world_size() == 4:
+            raise RuntimeError("slice lost")
+        session.report({"world": session.get_world_size()})
+
+    _set_flag("train_restart_wait_s", 0.1)
+    monkeypatch.setattr(BackendExecutor, "_placeable_workers",
+                        lambda self, desired: 2)
+    executor = BackendExecutor(
+        BackendConfig(),
+        ScalingConfig(num_workers=4, min_workers=2),
+        FailureConfig(max_failures=1))
+    executor.start()
+    try:
+        result = executor.run(loop, {}, {"trial_id": "elastic"})
+    finally:
+        executor.shutdown()
+    assert result.metrics["world"] == 2
+
+
+def test_elastic_restart_below_min_workers_fails(ray_start_regular,
+                                                 monkeypatch):
+    _set_flag("train_restart_wait_s", 0.1)
+    monkeypatch.setattr(BackendExecutor, "_placeable_workers",
+                        lambda self, desired: 1)
+    executor = BackendExecutor(
+        BackendConfig(),
+        ScalingConfig(num_workers=4, min_workers=2),
+        FailureConfig(max_failures=5))
+
+    def loop():
+        raise RuntimeError("always dies")
+
+    executor.start()
+    try:
+        with pytest.raises(TrainingFailedError) as excinfo:
+            executor.run(loop, {}, {"trial_id": "too-small"})
+    finally:
+        executor.shutdown()
+    assert excinfo.value.cause_kind == "system"
+    assert "min_workers=2" in str(excinfo.value)
+
+
+# ---------------------------------------------------------------------------
+# Bench latency gate (satellite f)
+# ---------------------------------------------------------------------------
+
+
+def test_compare_rounds_gates_gang_restart_latency():
+    import bench
+    prev = {"extra": {"train_gang_restart_ms": 500.0,
+                      "detached_actor_restart_ms": 10.0}, "value": 100.0}
+    worse = {"train_gang_restart_ms": 900.0,
+             "detached_actor_restart_ms": 800.0}
+    flagged = bench.compare_rounds(prev, worse, 100.0, threshold=0.10)
+    # Only the allowlisted latency metric regresses on an increase;
+    # other *_ms extras stay informational.
+    assert [r["metric"] for r in flagged] == ["train_gang_restart_ms"]
+    assert flagged[0]["drop_pct"] < 0  # recorded as a rise
+    better = {"train_gang_restart_ms": 300.0,
+              "detached_actor_restart_ms": 800.0}
+    assert bench.compare_rounds(prev, better, 100.0, threshold=0.10) == []
+
+
+# ---------------------------------------------------------------------------
+# Multinode acceptance: SIGKILL a daemon hosting a train worker mid-run
+# ---------------------------------------------------------------------------
+
+
+def train_loop_multinode(config):
+    ckpt = session.get_checkpoint()
+    start = ckpt.to_dict()["step"] if ckpt else 0
+    for i in range(start, config["steps"]):
+        time.sleep(0.15)
+        session.report({"step": i},
+                       checkpoint=Checkpoint.from_dict({"step": i + 1}))
+
+
+def _spawn_train_daemon(port):
+    env = dict(os.environ)
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.multinode",
+         "--address", f"127.0.0.1:{port}", "--num-cpus", "2",
+         "--resources", json.dumps({"trainslot": 1})],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def _wait_for(predicate, timeout, msg):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.1)
+    raise TimeoutError(msg)
+
+
+def test_multinode_daemon_sigkill_gang_recovery(tmp_path, monkeypatch):
+    """Acceptance: two daemons each host one train rank; one daemon is
+    SIGKILLed mid-run. The gang restarts (elastically, down to
+    min_workers=1) from the durable mock-s3 checkpoint and finishes the
+    FULL step count; the system-cause restart counter increments."""
+    monkeypatch.setenv("RAY_TPU_MOCK_S3_DIR", str(tmp_path / "s3"))
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1, num_tpus=0, _memory=1e9, _system_config={
+        "health_check_period_ms": 200,
+        "health_check_timeout_ms": 1000,
+        "health_check_failure_threshold": 3,
+        "train_hang_timeout_s": 2.0,
+        "train_restart_wait_s": 8.0,
+    })
+    procs = []
+    steps = 12
+    restarts_before = _counter_total(
+        builtin_metrics.train_gang_restarts(), "system")
+    persisted_before = _counter_total(
+        builtin_metrics.train_checkpoints_persisted())
+    try:
+        host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+        procs = [_spawn_train_daemon(port) for _ in range(2)]
+        _wait_for(
+            lambda: ray_tpu.cluster_resources().get("trainslot", 0) >= 2,
+            30, "daemons never registered")
+
+        trainer = DataParallelTrainer(
+            train_loop_multinode, train_loop_config={"steps": steps},
+            scaling_config=ScalingConfig(
+                num_workers=2, min_workers=1,
+                resources_per_worker={"CPU": 1, "trainslot": 1}),
+            run_config=RunConfig(
+                name="sigkill-acceptance",
+                storage_path="mock-s3://acceptance",
+                failure_config=FailureConfig(max_failures=4)))
+
+        holder = {}
+
+        def _fit():
+            try:
+                holder["result"] = trainer.fit()
+            except BaseException as exc:  # noqa: BLE001
+                holder["error"] = exc
+
+        fit_thread = threading.Thread(target=_fit, daemon=True)
+        fit_thread.start()
+
+        # Wait until at least two checkpoints landed durably, then
+        # SIGKILL one daemon (a whole node dies, taking its rank).
+        _wait_for(
+            lambda: _counter_total(
+                builtin_metrics.train_checkpoints_persisted())
+            >= persisted_before + 2,
+            30, "no durable checkpoint before the kill")
+        procs[0].send_signal(signal.SIGKILL)
+
+        fit_thread.join(timeout=120)
+        assert not fit_thread.is_alive(), "fit() never returned"
+        assert "error" not in holder, f"fit failed: {holder.get('error')!r}"
+        result = holder["result"]
+        # Full step count despite the mid-run node death...
+        assert result.metrics["step"] == steps - 1
+        assert result.checkpoint.to_dict() == {"step": steps}
+        # ...restored from durable storage via a system-cause restart.
+        assert _counter_total(builtin_metrics.train_gang_restarts(),
+                              "system") >= restarts_before + 1
+        assert _counter_total(
+            builtin_metrics.train_checkpoints_persisted()) > \
+            persisted_before + 2
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                p.kill()
+        ray_tpu.shutdown()
