@@ -270,6 +270,76 @@ def bench_metrics_overhead() -> dict:
     return out
 
 
+def bench_tracing_overhead() -> dict:
+    """Task throughput at three head-of-trace sampling rates: tracing
+    fully off (the default-path hard gate — the unsampled hot path is
+    one attribute read and must stay within noise of baseline), every
+    trace sampled (rate 1.0, the worst case), and production-style 1%
+    sampling. Mirrors bench_metrics_overhead."""
+    import os
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    def _throughput() -> float:
+        @ray_tpu.remote
+        def tiny(i):
+            return i
+
+        ray_tpu.get([tiny.remote(i) for i in range(200)])  # warmup
+        n = 2000
+        best = 0.0
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            ray_tpu.get([tiny.remote(i) for i in range(n)])
+            best = max(best, n / (_time.perf_counter() - t0))
+        return best
+
+    key = "RAY_TPU_TRACE_SAMPLE_RATE"
+    prev = os.environ.get(key)
+
+    def _run(rate) -> float:
+        if rate is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = str(rate)
+        tracing.set_sample_rate(None)  # drop the cached resolution
+        ray_tpu.init(num_cpus=8)
+        try:
+            if rate is not None:
+                tracing.enable_tracing()
+            return _throughput()
+        finally:
+            ray_tpu.shutdown()
+            tracing.disable_tracing()
+            tracing.clear_spans()
+
+    try:
+        off = _run(None)          # tracing never enabled: the default path
+        sampled = _run(1.0)       # every task traced end to end
+        one_pct = _run(0.01)      # production-style head sampling
+    finally:
+        if prev is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = prev
+        tracing.set_sample_rate(None)
+    out = {
+        # The throughput-key naming (`_per_sec`) opts this into the
+        # regression auto-gate: a drop in the tracing-off number means
+        # the disabled path grew a cost, which is the one hard no.
+        "tracing_off_tasks_per_sec": round(off, 1),
+        "tracing_sampled_tasks_per_sec": round(sampled, 1),
+        "tracing_1pct_tasks_per_sec": round(one_pct, 1),
+    }
+    out["tracing_overhead_pct"] = (
+        round(100.0 * (off - sampled) / off, 2) if off else None)
+    out["tracing_1pct_overhead_pct"] = (
+        round(100.0 * (off - one_pct) / off, 2) if off else None)
+    return out
+
+
 def bench_data_shuffle() -> dict:
     """Single-host shuffle throughput (reference:
     release_tests.yaml:3447 shuffle nightly — scaled to one host): a
@@ -1574,6 +1644,8 @@ def main(argv=None):
         ("log_stream", "log_lines_per_sec", bench_log_streaming),
         ("metrics_overhead", "metrics_overhead_pct",
          bench_metrics_overhead),
+        ("tracing_overhead", "tracing_overhead_pct",
+         bench_tracing_overhead),
         ("frame_path", "frame_send_mb_per_sec", bench_frame_path),
     ]
     if on_tpu:

@@ -274,6 +274,21 @@ def worker_lease_wait() -> Histogram:
         boundaries=[0.001, 0.01, 0.05, 0.25, 1, 5, 30])
 
 
+# -- distributed tracing ---------------------------------------------------
+
+
+def trace_stage_seconds() -> Histogram:
+    from ray_tpu.util.metrics import Histogram
+    return Histogram(
+        "ray_tpu_trace_stage_seconds",
+        "Span durations by pipeline stage (submit/queue/lease/pull/"
+        "execute/store/serve_dispatch/serve_handle), observed by the "
+        "head's trace assembler as sampled spans arrive — the "
+        "critical-path attribution behind `ray-tpu trace --summary`.",
+        boundaries=[0.0001, 0.001, 0.01, 0.1, 1, 10, 100],
+        tag_keys=("stage",))
+
+
 # -- log subsystem --------------------------------------------------------
 
 

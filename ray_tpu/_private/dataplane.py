@@ -1559,25 +1559,31 @@ def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
         alt = tuple(alt)
         if alt not in candidates:
             candidates.append(alt)
-    last: Optional[BaseException] = None
-    for i, cand in enumerate(candidates):
-        try:
-            _pull_object_once(cand, key, table, timeout, retries,
-                              priority, size_hint,
-                              others=candidates[i + 1:])
-            return
-        except (ObjectPullError, OSError, ConnectionError,
-                struct.error) as exc:
-            last = exc
-            if i + 1 < len(candidates):
-                logger.info("pull of %s from %s failed (%s); failing "
-                            "over to %s", key, cand, exc,
-                            candidates[i + 1])
-    if isinstance(last, ObjectPullError):
-        raise last
-    raise ObjectPullError(
-        f"pull of {key} failed on all {len(candidates)} holder(s): "
-        f"{last}") from last
+    # Traced only under an active sampled span (a traced task resolving
+    # its args); untraced pulls pay one thread-local read.
+    from ray_tpu.util import tracing
+    with tracing.child_span("data::pull",
+                            {"stage": "pull", "key": key,
+                             "size_hint": size_hint}):
+        last: Optional[BaseException] = None
+        for i, cand in enumerate(candidates):
+            try:
+                _pull_object_once(cand, key, table, timeout, retries,
+                                  priority, size_hint,
+                                  others=candidates[i + 1:])
+                return
+            except (ObjectPullError, OSError, ConnectionError,
+                    struct.error) as exc:
+                last = exc
+                if i + 1 < len(candidates):
+                    logger.info("pull of %s from %s failed (%s); failing "
+                                "over to %s", key, cand, exc,
+                                candidates[i + 1])
+        if isinstance(last, ObjectPullError):
+            raise last
+        raise ObjectPullError(
+            f"pull of {key} failed on all {len(candidates)} holder(s): "
+            f"{last}") from last
 
 
 def _pull_object_once(addr: Tuple[str, int], key: str,

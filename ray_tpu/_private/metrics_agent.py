@@ -172,9 +172,13 @@ class ClusterMetrics:
     """Head-side cluster registry: merged per-origin snapshots + spans."""
 
     def __init__(self, staleness: Optional[float] = None):
+        from ray_tpu._private.trace_assembler import TraceAssembler
         self._lock = threading.Lock()
         self._origins: Dict[Tuple[str, int, str], _Origin] = {}
         self._spans: deque = deque(maxlen=MAX_CLUSTER_SPANS)
+        # Every span converging here (head agent, daemon frames, worker
+        # piggybacks) also feeds trace assembly, keyed by trace_id.
+        self.traces = TraceAssembler()
         self.staleness = staleness_s() if staleness is None else staleness
 
     def update(self, node_id: str, batch: Dict[str, Any]) -> None:
@@ -216,6 +220,7 @@ class ClusterMetrics:
             stamped["pid"] = batch.get("pid", 0)
             stamped["component"] = batch.get("component", "")
             self._spans.append(stamped)
+            self.traces.add_span(stamped)
 
     def mark_node_dead(self, node_id: str) -> None:
         """Start the staleness clock for every origin of a dead node; the
@@ -258,13 +263,16 @@ class ClusterMetrics:
         /api/timeline next to the head's task events)."""
         out = []
         for s in list(self._spans):
-            end = s.get("end_time") or s.get("start_time", 0.0)
+            dur = s.get("duration")
+            if dur is None:  # pre-monotonic peers ship no duration
+                end = s.get("end_time") or s.get("start_time", 0.0)
+                dur = end - s.get("start_time", 0.0)
             out.append({
                 "name": s.get("name", ""),
                 "cat": "remote_trace",
                 "ph": "X",
                 "ts": s.get("start_time", 0.0) * 1e6,
-                "dur": max(0.0, (end - s.get("start_time", 0.0))) * 1e6,
+                "dur": max(0.0, dur) * 1e6,
                 "pid": f"node:{(s.get('node_id') or 'head')[:12]}"
                        f"/{s.get('component', '')}-{s.get('pid', 0)}",
                 "tid": s.get("span_id", ""),

@@ -56,6 +56,19 @@ logger = logging.getLogger(__name__)
 _FRAME = struct.Struct(">Q")
 _MAX_FRAME = 1 << 34  # 16 GiB sanity bound
 
+#: Shared stateless no-op context: the untraced daemon execute path pays
+#: one dict read and zero allocations for tracing.
+_NULL_SPAN = contextlib.nullcontext()
+
+
+def _trace_span(ctx: Optional[dict], name: str, stage: str):
+    """A continue_context span when the request carries a sampled trace
+    context (propagated from the driver), the shared no-op otherwise."""
+    if ctx is None:
+        return _NULL_SPAN
+    from ray_tpu.util import tracing
+    return tracing.continue_context(ctx, name, {"stage": stage})
+
 
 class RemoteNodeDiedError(RuntimeError):
     """The node connection dropped while a call was in flight. NOT a
@@ -759,6 +772,12 @@ class NodeConnection:
         }
         if isinstance(spec.num_returns, int) and spec.num_returns > 1:
             msg["num_returns"] = spec.num_returns
+        trace_ctx = getattr(spec, "trace_ctx", None)
+        if trace_ctx is not None:
+            # Cross-process propagation: the daemon parents its execute
+            # span to the head-side submit span (extra wire fields are
+            # additive — schema validation allows them).
+            msg["trace_ctx"] = trace_ctx
         if lease_id is not None:
             msg["lease_id"] = lease_id
         if class_id is not None:
@@ -810,7 +829,7 @@ class NodeConnection:
             raise RemoteNodeDiedError(
                 f"injected RPC failure (testing_rpc_failure_pct="
                 f"{self.rpc_failure_pct})")
-        reply = self._request({
+        msg = {
             "type": "execute_task",
             "fn_id": spec.function_id,
             "payload": _dumps((args, kwargs)),
@@ -821,7 +840,11 @@ class NodeConnection:
             "store_limit": store_limit,
             "num_returns": (spec.num_returns if
                             isinstance(spec.num_returns, int) else 1),
-        }, fn_resolver=lambda: self._function_payload(
+        }
+        trace_ctx = getattr(spec, "trace_ctx", None)
+        if trace_ctx is not None:
+            msg["trace_ctx"] = trace_ctx
+        reply = self._request(msg, fn_resolver=lambda: self._function_payload(
             spec.function_id, functions))
         return self._unpack(reply, spec.name)
 
@@ -895,8 +918,9 @@ class NodeConnection:
 
     def call_actor_method(self, actor_id, method_name, name,
                           args, kwargs, store_limit: int = 0,
-                          num_returns: int = 1) -> Any:
-        reply = self._request({
+                          num_returns: int = 1,
+                          trace_ctx: Optional[dict] = None) -> Any:
+        msg = {
             "type": "actor_call",
             "actor_id": actor_id.hex(),
             "method": method_name,
@@ -904,7 +928,10 @@ class NodeConnection:
             "name": name,
             "store_limit": store_limit,
             "num_returns": num_returns,
-        })
+        }
+        if trace_ctx is not None:
+            msg["trace_ctx"] = trace_ctx
+        reply = self._request(msg)
         return self._unpack(reply, name)
 
     def destroy_actor(self, actor_id) -> None:
@@ -991,9 +1018,16 @@ class RemoteActorInstance:
     def bind_method(self, method_name: str, task_name: str,
                     store_limit: int = 0, num_returns: int = 1):
         def call(*args, **kwargs):
+            # The closure runs INSIDE the head-side actor_task:: span
+            # (_run_actor_task's continue_context): propagate THAT span
+            # so the daemon-side span parents to it across the wire.
+            # span_context (not inject_context) — an untraced call must
+            # not mint a new root at this internal layer.
+            from ray_tpu.util import tracing
             return self.conn.call_actor_method(
                 self.actor_id, method_name, task_name, args, kwargs,
-                store_limit, num_returns=num_returns)
+                store_limit, num_returns=num_returns,
+                trace_ctx=tracing.span_context(tracing.current_span()))
         return call
 
 
@@ -2229,8 +2263,11 @@ class NodeDaemon:
                 # bytes to the worker untouched (no unpickle→repickle).
                 args_payload = msg["payload"]
             else:
-                args, kwargs, arg_pins = self._resolve_markers_for_worker(
-                    *_loads(msg["payload"]))
+                with _trace_span(msg.get("trace_ctx"),
+                                 "data::resolve_args", "pull"):
+                    args, kwargs, arg_pins = \
+                        self._resolve_markers_for_worker(
+                            *_loads(msg["payload"]))
                 args_payload = _dumps((args, kwargs))
             fn_id = msg["fn_id"]
 
@@ -2257,6 +2294,10 @@ class NodeDaemon:
                     "task_id": msg.get("task_id"),
                     "arena_limit": arena_limit,
                     "num_returns": msg.get("num_returns", 1),
+                    # Second hop of the propagation: the worker
+                    # subprocess parents its execute span to the same
+                    # driver-side context.
+                    "trace_ctx": msg.get("trace_ctx"),
                 }
 
             def fn_payload():
@@ -2395,34 +2436,50 @@ class NodeDaemon:
                 if self._task_uses_worker_process(msg):
                     self._execute_on_worker(sock, msg, req_id)
                     return
+                ctx = msg.get("trace_ctx")
                 fn = self._load_function(msg["fn_id"], msg.get("fn_bytes"))
-                args, kwargs = self._resolve_markers(
-                    *_loads(msg["payload"]))
-                result = self._run_in_env(msg, fn, args, kwargs)
+                # Marker resolution is the daemon's arg-pull stage:
+                # data-plane pulls inside record as child spans of it.
+                with _trace_span(ctx, "data::resolve_args", "pull"):
+                    args, kwargs = self._resolve_markers(
+                        *_loads(msg["payload"]))
+                with _trace_span(ctx, f"task::{msg.get('name', '')}",
+                                 "execute"):
+                    result = self._run_in_env(msg, fn, args, kwargs)
                 self._reply_result(sock, req_id, result,
                                    msg.get("store_limit", 0),
                                    msg.get("num_returns", 1))
             elif kind == "create_actor":
+                ctx = msg.get("trace_ctx")
                 cls = self._load_function(msg["fn_id"], msg.get("fn_bytes"))
-                args, kwargs = self._resolve_markers(
-                    *_loads(msg["payload"]))
-                instance = self._run_in_env(msg, cls, args, kwargs)
+                with _trace_span(ctx, "data::resolve_args", "pull"):
+                    args, kwargs = self._resolve_markers(
+                        *_loads(msg["payload"]))
+                with _trace_span(ctx, f"actor_init::{msg.get('name', '')}",
+                                 "execute"):
+                    instance = self._run_in_env(msg, cls, args, kwargs)
                 self._actors[msg["actor_id"]] = instance
                 self._actor_tpu_ids[msg["actor_id"]] = msg.get("tpu_ids")
                 self._reply(sock, req_id, value=None)
             elif kind == "actor_call":
+                ctx = msg.get("trace_ctx")
                 instance = self._actors[msg["actor_id"]]
                 method = getattr(instance, msg["method"])
-                args, kwargs = self._resolve_markers(
-                    *_loads(msg["payload"]))
+                with _trace_span(ctx, "data::resolve_args", "pull"):
+                    args, kwargs = self._resolve_markers(
+                        *_loads(msg["payload"]))
                 # Methods inherit the chips reserved at actor creation.
                 msg = dict(msg,
                            tpu_ids=self._actor_tpu_ids.get(msg["actor_id"]))
-                result = self._run_in_env(msg, method, args, kwargs)
-                import inspect
-                if inspect.iscoroutine(result):
-                    import asyncio
-                    result = asyncio.run(result)
+                # The span brackets the coroutine run too (async actor
+                # methods execute inside asyncio.run, not at call time).
+                with _trace_span(ctx, f"actor_task::{msg.get('name', '')}",
+                                 "execute"):
+                    result = self._run_in_env(msg, method, args, kwargs)
+                    import inspect
+                    if inspect.iscoroutine(result):
+                        import asyncio
+                        result = asyncio.run(result)
                 self._reply_result(sock, req_id, result,
                                    msg.get("store_limit", 0),
                                    msg.get("num_returns", 1))

@@ -24,16 +24,32 @@ __all__ = ["sample_self", "folded_to_speedscope", "profile_self",
 
 
 def sample_self(duration_s: float = 5.0, hz: int = 100,
-                skip_profiler: bool = True) -> Dict[str, int]:
+                skip_profiler: bool = True,
+                stats: Optional[dict] = None) -> Dict[str, int]:
     """Sample every thread's Python stack for ``duration_s`` seconds at
     ``hz``; returns collapsed stacks ("thr;outer;...;inner" -> count,
-    flamegraph.pl / speedscope input format)."""
+    flamegraph.pl / speedscope input format).
+
+    The sampler sleeps to the NEXT ABSOLUTE tick, not for a fixed
+    period: ``sleep(period)`` after each sample would add the walk cost
+    of every deep stack to the interval, silently dropping the
+    effective rate below ``hz``. When a walk overruns one or more
+    ticks, the missed ticks are skipped (not compressed into a burst)
+    so samples stay evenly spaced. Pass a ``stats`` dict to receive
+    ``{"ticks", "elapsed_s", "achieved_hz"}`` — the honest rate, which
+    the speedscope export reports and uses to weight samples."""
     counts: Dict[str, int] = {}
     me = threading.get_ident()
     names = {t.ident: t.name for t in threading.enumerate()}
     period = 1.0 / max(hz, 1)
-    deadline = time.monotonic() + duration_s
-    while time.monotonic() < deadline:
+    t0 = time.monotonic()
+    deadline = t0 + duration_s
+    next_tick = t0
+    ticks = 0
+    while True:
+        now = time.monotonic()
+        if now >= deadline:
+            break
         for ident, frame in sys._current_frames().items():
             if skip_profiler and ident == me:
                 continue
@@ -48,19 +64,33 @@ def sample_self(duration_s: float = 5.0, hz: int = 100,
             name = names.get(ident) or str(ident)
             key = ";".join([name] + stack[::-1])
             counts[key] = counts.get(key, 0) + 1
-        time.sleep(period)
+        ticks += 1
+        next_tick += period
+        now = time.monotonic()
+        while next_tick <= now:  # overran: skip missed ticks, stay on grid
+            next_tick += period
+        time.sleep(max(0.0, min(next_tick, deadline) - now))
+    if stats is not None:
+        elapsed = max(time.monotonic() - t0, 1e-9)
+        stats["ticks"] = ticks
+        stats["elapsed_s"] = elapsed
+        stats["achieved_hz"] = ticks / elapsed
     return counts
 
 
 def folded_to_speedscope(counts: Dict[str, int], name: str = "ray_tpu",
-                         hz: int = 100) -> dict:
+                         hz: int = 100,
+                         achieved_hz: Optional[float] = None) -> dict:
     """Collapsed stacks -> a speedscope 'sampled' profile document
-    (https://www.speedscope.app file-format-schema)."""
+    (https://www.speedscope.app file-format-schema). When the sampler's
+    measured ``achieved_hz`` is known, it weights the samples (each
+    sample represents the real inter-tick interval, not the requested
+    one) and is reported in the document."""
     frame_index: Dict[str, int] = {}
     frames: List[dict] = []
     samples: List[List[int]] = []
     weights: List[float] = []
-    dt = 1.0 / max(hz, 1)
+    dt = 1.0 / max(achieved_hz or hz, 1e-9)
     for key, count in sorted(counts.items()):
         stack_ids = []
         for part in key.split(";"):
@@ -86,17 +116,21 @@ def folded_to_speedscope(counts: Dict[str, int], name: str = "ray_tpu",
         "name": name,
         "activeProfileIndex": 0,
         "exporter": "ray_tpu-profiler",
+        "requestedHz": hz,
+        "achievedHz": achieved_hz,
     }
 
 
 def profile_self(duration_s: float = 5.0, hz: int = 100,
                  fmt: str = "folded"):
     """One-call self-profile: 'folded' text or 'speedscope' dict."""
-    counts = sample_self(duration_s, hz)
+    stats: dict = {}
+    counts = sample_self(duration_s, hz, stats=stats)
     if fmt == "folded":
         return "\n".join(f"{k} {v}" for k, v in sorted(counts.items()))
     if fmt == "speedscope":
-        return folded_to_speedscope(counts, hz=hz)
+        return folded_to_speedscope(counts, hz=hz,
+                                    achieved_hz=stats.get("achieved_hz"))
     raise ValueError(f"unknown profile format {fmt!r}")
 
 

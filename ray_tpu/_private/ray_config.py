@@ -81,6 +81,11 @@ _PY_DEFAULTS: Dict[str, Any] = {
     "train_hang_timeout_s": 60.0,
     "train_restart_wait_s": 30.0,
     "metrics_report_interval_ms": 10_000,
+    # Distributed tracing: head-of-trace sampling probability (decided
+    # once at the driver, carried in the propagated context) and how
+    # many assembled traces the head retains before evicting oldest.
+    "trace_sample_rate": 1.0,
+    "trace_retention": 1000,
     "task_events_enabled": True,
     "memory_monitor_refresh_ms": 250,
     "memory_usage_threshold": 0.95,

@@ -879,8 +879,23 @@ class Runtime:
         from ray_tpu.util import tracing
         if tracing.is_tracing_enabled():
             # Propagate the caller's span context inside the spec
-            # (reference: tracing_helper.py _DictPropagator).
-            spec.trace_ctx = tracing.inject_context()
+            # (reference: tracing_helper.py _DictPropagator). With no
+            # active caller span this is the HEAD of a trace:
+            # inject_context makes the sampling decision once, and an
+            # unsampled submit carries no context at all.
+            ctx = tracing.inject_context()
+            if ctx is not None:
+                import time as _time
+                with tracing.continue_context(
+                        ctx, "driver::submit",
+                        {"stage": "submit", "task": spec.name}) as span:
+                    spec.trace_ctx = tracing.span_context(span)
+                    spec._trace_submit_mono = _time.monotonic()  # type: ignore[attr-defined]
+                    spec._trace_submit_wall = span.start_time  # type: ignore[attr-defined]
+                    return self._submit_task_inner(spec)
+        return self._submit_task_inner(spec)
+
+    def _submit_task_inner(self, spec: TaskSpec) -> List[ObjectRef]:
         n = 1 if spec.num_returns == "dynamic" else spec.num_returns
         spec.return_ids = [
             ObjectID.for_return(spec.task_id, i + 1) for i in range(max(n, 1))]
@@ -1303,6 +1318,9 @@ class Runtime:
         """Launch tail (outside the lock) for a _try_launch_locked hit."""
         import time as _time
         spec._start_time = _time.monotonic()  # type: ignore[attr-defined]
+        ctx = getattr(spec, "trace_ctx", None)
+        if ctx is not None:
+            self._record_trace_sched_spans(spec, ctx)
         self._record_event(spec, "RUNNING")
         if worker is None:
             self._submit_remote_async(spec)
@@ -1310,6 +1328,30 @@ class Runtime:
             worker.submit(lambda s=spec, w=worker: self._run_actor_creation(s, w))
         else:
             worker.submit(lambda s=spec, w=worker: self._run_normal_task(s, w))
+
+    def _record_trace_sched_spans(self, spec: TaskSpec, ctx: dict) -> None:
+        """Retroactive scheduler spans for a traced task at launch:
+        ``sched::queue_wait`` covering submit -> launch (monotonic
+        duration anchored at the submit span's wall time) and a
+        zero-length ``sched::lease_grant`` marker carrying the lease
+        identity (the grant itself is an instant in this scheduler — the
+        waiting shows up in queue_wait)."""
+        mono0 = getattr(spec, "_trace_submit_mono", None)
+        if mono0 is None:
+            return
+        from ray_tpu.util import tracing
+        wait = spec._start_time - mono0
+        wall0 = getattr(spec, "_trace_submit_wall", 0.0)
+        tracing.record_complete_span(
+            "sched::queue_wait", ctx, wall_start=wall0, duration=wait,
+            attributes={"stage": "queue", "task": spec.name})
+        lease = getattr(spec, "_lease", None)
+        if lease is not None:
+            tracing.record_complete_span(
+                "sched::lease_grant", ctx, wall_start=wall0 + wait,
+                duration=0.0,
+                attributes={"stage": "lease", "task": spec.name,
+                            "lease_id": lease.lease_id})
 
     def _queue_ready_locked(self, spec: TaskSpec) -> None:
         ck = self._lease_class(spec)
@@ -1484,6 +1526,22 @@ class Runtime:
         return args, kwargs
 
     def _store_results(self, spec: TaskSpec, result: Any) -> None:
+        ctx = getattr(spec, "trace_ctx", None)
+        if ctx is None:
+            return self._store_results_inner(spec, result)
+        import time as _time
+        from ray_tpu.util import tracing
+        wall = _time.time()
+        mono0 = _time.monotonic()
+        try:
+            return self._store_results_inner(spec, result)
+        finally:
+            tracing.record_complete_span(
+                "task::store_result", ctx, wall_start=wall,
+                duration=_time.monotonic() - mono0,
+                attributes={"stage": "store", "task": spec.name})
+
+    def _store_results_inner(self, spec: TaskSpec, result: Any) -> None:
         if getattr(spec, "invalidated", False):
             # The task's node died while it ran; a retry owns the return
             # objects now (reference: a worker on a dead node can't deliver).
@@ -1657,7 +1715,7 @@ class Runtime:
                 from ray_tpu.util import tracing
                 with tracing.continue_context(
                         getattr(spec, "trace_ctx", None),
-                        f"task::{spec.name}"):
+                        f"task::{spec.name}", {"stage": "execute"}):
                     # Remote tasks apply runtime_env daemon-side (the
                     # request carries it) and process-worker tasks apply
                     # it worker-side (where a pip venv is active); only
@@ -2234,7 +2292,22 @@ class Runtime:
                     f"{sorted(gstate.concurrency_groups) or 'none'}")
         from ray_tpu.util import tracing
         if tracing.is_tracing_enabled():
-            spec.trace_ctx = tracing.inject_context()
+            # Same head-of-trace discipline as submit_task: the sampling
+            # decision is made once here; unsampled calls stay bare.
+            ctx = tracing.inject_context()
+            if ctx is not None:
+                import time as _time
+                with tracing.continue_context(
+                        ctx, "driver::submit",
+                        {"stage": "submit", "task": spec.name,
+                         "actor": spec.actor_id.hex()[:8]}) as span:
+                    spec.trace_ctx = tracing.span_context(span)
+                    spec._trace_submit_mono = _time.monotonic()  # type: ignore[attr-defined]
+                    spec._trace_submit_wall = span.start_time  # type: ignore[attr-defined]
+                    return self._submit_actor_task_inner(spec)
+        return self._submit_actor_task_inner(spec)
+
+    def _submit_actor_task_inner(self, spec: TaskSpec) -> List[ObjectRef]:
         n = max(spec.num_returns, 1) if spec.num_returns != "dynamic" else 1
         spec.return_ids = [
             ObjectID.for_return(spec.task_id, i + 1) for i in range(n)]
@@ -2364,12 +2437,32 @@ class Runtime:
             self._finish_actor_task(spec, state)
             return None
 
+        ctx = getattr(spec, "trace_ctx", None)
+        if ctx is not None and \
+                getattr(spec, "_trace_submit_mono", None) is not None:
+            import time as _time
+            from ray_tpu.util import tracing as _tr
+            _tr.record_complete_span(
+                "sched::queue_wait", ctx,
+                wall_start=getattr(spec, "_trace_submit_wall", 0.0),
+                duration=_time.monotonic() - spec._trace_submit_mono,
+                attributes={"stage": "queue", "task": spec.name})
+
         if asyncio.iscoroutinefunction(method):
             async def _acall():
                 try:
                     _task_context.spec = spec
                     try:
-                        result = await method(*args, **kwargs)
+                        from ray_tpu.util import tracing
+                        # Thread-local context on an asyncio loop:
+                        # concurrent requests on one replica may see an
+                        # interleaved ACTIVE span, but per-span parenting
+                        # stays correct because the ctx rides the spec.
+                        with tracing.continue_context(
+                                getattr(spec, "trace_ctx", None),
+                                f"actor_task::{spec.name}",
+                                {"stage": "execute"}):
+                            result = await method(*args, **kwargs)
                     finally:
                         _task_context.spec = None
                     self._store_results(spec, result)
@@ -2393,7 +2486,7 @@ class Runtime:
                 from ray_tpu.util import tracing
                 with tracing.continue_context(
                         getattr(spec, "trace_ctx", None),
-                        f"actor_task::{spec.name}"):
+                        f"actor_task::{spec.name}", {"stage": "execute"}):
                     result = method(*args, **kwargs)
             finally:
                 _task_context.spec = None
@@ -2788,6 +2881,38 @@ class Runtime:
         """Remote worker/daemon spans (shipped in metrics_batch frames)
         as chrome://tracing events for /api/timeline."""
         return self._cluster_metrics.chrome_spans()
+
+    def _flush_trace_spans(self) -> None:
+        """Pull this process's pending finished spans into the assembler
+        before a trace read — remote origins stay as fresh as their
+        export interval, but the head's own spans need not wait a tick."""
+        agent = self._metrics_agent
+        if agent is not None:
+            try:
+                agent.poll_once()
+            except Exception:  # noqa: BLE001 - reads must not fail on this
+                logger.exception("head trace flush failed")
+
+    def trace_list(self, limit: Optional[int] = None) -> List[dict]:
+        self._flush_trace_spans()
+        return self._cluster_metrics.traces.list_traces(limit)
+
+    def trace_get(self, trace_id: str) -> Optional[dict]:
+        self._flush_trace_spans()
+        return self._cluster_metrics.traces.get_trace(trace_id)
+
+    def trace_summary(self) -> dict:
+        self._flush_trace_spans()
+        return self._cluster_metrics.traces.summary()
+
+    def trace_perfetto(self, trace_id: Optional[str] = None) -> List[dict]:
+        self._flush_trace_spans()
+        return self._cluster_metrics.traces.perfetto(trace_id)
+
+    def trace_flow_events(self) -> List[dict]:
+        """Cross-process flow (s/f) arrows for /api/timeline."""
+        self._flush_trace_spans()
+        return self._cluster_metrics.traces.flow_events()
 
     def register_remote_node(self, conn, info: Optional[dict] = None,
                              dispatch: bool = True,

@@ -47,6 +47,12 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     spans_fn = getattr(rt, "cluster_chrome_spans", None)
     if spans_fn is not None:
         trace.extend(spans_fn())
+    # Flow events (ph s/f) drawn between parent and child spans whose
+    # origins differ — the arrows that make a cross-process trace legible
+    # in Perfetto instead of disconnected slices.
+    flows_fn = getattr(rt, "trace_flow_events", None)
+    if flows_fn is not None:
+        trace.extend(flows_fn())
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

@@ -625,12 +625,17 @@ class ProcessActorInstance:
         from ray_tpu._private import serialization
 
         def call(*args, **kwargs):
+            # Runs inside the head-side actor_task:: span
+            # (_run_actor_task's continue_context): propagate it so the
+            # worker-process span parents across the process boundary.
+            from ray_tpu.util import tracing
             return run_on_worker(self.handle, {
                 "type": "exec",
                 "mode": "actor_call",
                 "method": method_name,
                 "payload": serialization.serialize((args, kwargs)),
                 "name": task_name,
+                "trace_ctx": tracing.span_context(tracing.current_span()),
             })
         return call
 
@@ -785,11 +790,22 @@ class _WorkerMain:
             renv = msg.get("runtime_env")
 
             def invoke():
-                result = fn(*args, **kwargs)
-                import inspect
-                if inspect.iscoroutine(result):
-                    import asyncio
-                    result = asyncio.run(result)
+                # Final hop of cross-process propagation: the span ships
+                # back piggybacked on this reply's metrics_batch. ctx is
+                # None on every untraced task (one dict read).
+                from ray_tpu.util import tracing
+                prefix = ("actor_task" if mode == "actor_call" else
+                          "actor_init" if mode == "actor_init" else
+                          "task")
+                with tracing.continue_context(
+                        msg.get("trace_ctx"),
+                        f"{prefix}::{msg.get('name', '')}",
+                        {"stage": "execute"}):
+                    result = fn(*args, **kwargs)
+                    import inspect
+                    if inspect.iscoroutine(result):
+                        import asyncio
+                        result = asyncio.run(result)
                 return result
 
             if renv:

@@ -66,6 +66,13 @@ _DEFAULT_PANELS = [
      "rate(ray_tpu_worker_lease_wait_seconds_bucket[5m]))", "s"),
     ("Log lines / s", "rate(ray_tpu_log_monitor_lines_total[1m])",
      "ops"),
+    ("Trace stage p95 latency (s)",
+     "histogram_quantile(0.95, sum by (le, stage) "
+     "(rate(ray_tpu_trace_stage_seconds_bucket[5m])))", "s"),
+    ("Trace stage time share",
+     "sum by (stage) (rate(ray_tpu_trace_stage_seconds_sum[5m])) / "
+     "ignoring (stage) group_left sum "
+     "(rate(ray_tpu_trace_stage_seconds_sum[5m]))", "percentunit"),
     ("Data-plane pulled bytes / s",
      "rate(ray_tpu_dataplane_pulled_bytes_total[1m])", "Bps"),
     ("Object transfer bytes / s (by direction)",

@@ -22,6 +22,8 @@ Routes (all JSON unless noted):
   GET  /api/serve/applications — Serve status
   PUT  /api/serve/applications — apply declarative Serve config
   GET  /api/timeline           — chrome://tracing events
+  GET  /api/traces             — assembled distributed traces (?limit=)
+  GET  /api/traces/{trace_id}  — one trace: spans, stages, origins
   GET  /api/event_stats        — control-plane handler latency stats
   GET  /                       — minimal HTML index
 """
@@ -63,7 +65,7 @@ class DashboardHead:
                          "/api/v0/nodes", "/api/jobs/", "/metrics",
                          "/api/logs?list=1",
                          "/api/serve/applications", "/api/timeline",
-                         "/api/event_stats"))
+                         "/api/traces", "/api/event_stats"))
         return web.Response(
             text=f"<html><body><h2>ray_tpu dashboard</h2><ul>{rows}</ul>"
                  "</body></html>",
@@ -133,6 +135,53 @@ class DashboardHead:
     async def _timeline(self, request):
         from ray_tpu._private.state import timeline
         return self._json(timeline())
+
+    async def _traces_list(self, request):
+        """Assembled distributed traces, newest first (the head-side
+        trace assembler merges spans arriving on metrics_batch frames
+        per trace_id). ``?limit=N`` caps the listing;
+        ``?summary=1`` returns the cluster-level stage breakdown
+        instead (what `ray-tpu trace --summary` prints)."""
+        import asyncio
+
+        from ray_tpu._private.worker import global_worker
+        runtime = getattr(global_worker, "_runtime", None)
+        if runtime is None:
+            return self._json({"error": "runtime not initialized"},
+                              status=503)
+        if request.query.get("summary"):
+            return self._json(await asyncio.to_thread(
+                runtime.trace_summary))
+        limit = request.query.get("limit")
+        rows = await asyncio.to_thread(
+            runtime.trace_list, int(limit) if limit else None)
+        return self._json({"traces": rows})
+
+    async def _traces_get(self, request):
+        """One assembled trace: spans sorted by start time, per-stage
+        breakdown, participating origins. ``?fmt=perfetto`` returns
+        Chrome-trace/Perfetto JSON (slices + cross-process flow
+        events) loadable in ui.perfetto.dev."""
+        import asyncio
+
+        from ray_tpu._private.worker import global_worker
+        runtime = getattr(global_worker, "_runtime", None)
+        if runtime is None:
+            return self._json({"error": "runtime not initialized"},
+                              status=503)
+        trace_id = request.match_info["trace_id"]
+        if request.query.get("fmt") == "perfetto":
+            events = await asyncio.to_thread(runtime.trace_perfetto,
+                                             trace_id)
+            if not events:
+                return self._json({"error": f"no trace {trace_id!r}"},
+                                  status=404)
+            return self._json({"traceEvents": events})
+        trace = await asyncio.to_thread(runtime.trace_get, trace_id)
+        if trace is None:
+            return self._json({"error": f"no trace {trace_id!r}"},
+                              status=404)
+        return self._json(trace)
 
     async def _logs(self, request):
         """Session log files over HTTP (reference: dashboard
@@ -326,6 +375,8 @@ class DashboardHead:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/api/logs", self._logs)
         app.router.add_get("/api/timeline", self._timeline)
+        app.router.add_get("/api/traces", self._traces_list)
+        app.router.add_get("/api/traces/{trace_id}", self._traces_get)
         app.router.add_get("/api/event_stats", self._event_stats)
         app.router.add_get("/api/jobs/", self._jobs_list)
         app.router.add_post("/api/jobs/", self._jobs_submit)

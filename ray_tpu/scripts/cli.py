@@ -42,6 +42,97 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """`ray-tpu trace [--id TRACE_ID | --tail N | --summary]
+    [--perfetto out.json]` — inspect assembled distributed traces (the
+    head merges spans shipped on metrics frames per trace_id; see
+    /api/traces). Default lists recent traces; --id shows one trace's
+    span tree + stage breakdown; --summary prints the cluster-level
+    critical-path attribution; --perfetto writes Chrome-trace JSON with
+    cross-process flow arrows for ui.perfetto.dev."""
+    _ensure_init()
+    from ray_tpu._private.worker import global_worker
+    rt = global_worker.runtime
+
+    def _fmt_s(sec):
+        return f"{sec * 1000:.2f}ms" if sec < 1.0 else f"{sec:.3f}s"
+
+    if args.perfetto:
+        events = rt.trace_perfetto(args.id)
+        if not events:
+            print("no matching trace spans" if args.id
+                  else "no trace spans assembled yet")
+            return 1
+        with open(args.perfetto, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        print(f"Wrote {len(events)} events to {args.perfetto} "
+              "(open in ui.perfetto.dev)")
+        return 0
+    if args.summary:
+        summary = rt.trace_summary()
+        print(f"traces assembled: {summary['traces']}")
+        stages = summary["stages"]
+        if not stages:
+            return 0
+        hdr = ("STAGE", "COUNT", "TOTAL", "SHARE", "P50", "P95")
+        rows = [(stage, str(s["count"]), _fmt_s(s["total_s"]),
+                 f"{s['share'] * 100:.1f}%", _fmt_s(s["p50_s"]),
+                 _fmt_s(s["p95_s"]))
+                for stage, s in sorted(stages.items(),
+                                       key=lambda kv: -kv[1]["total_s"])]
+        widths = [max(len(hdr[i]), *(len(r[i]) for r in rows))
+                  for i in range(len(hdr))]
+        fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+        print(fmt.format(*hdr))
+        for r in rows:
+            print(fmt.format(*r))
+        return 0
+    if args.id:
+        trace = rt.trace_get(args.id)
+        if trace is None:
+            print(f"no trace {args.id!r}")
+            return 1
+        print(f"trace {trace['trace_id']}: {trace['span_count']} spans, "
+              f"{_fmt_s(trace['duration_s'])} across "
+              f"{len(trace['origins'])} origin(s)")
+        for stage, s in sorted(trace["stages"].items(),
+                               key=lambda kv: -kv[1]["total_s"]):
+            print(f"  {stage:<14} x{s['count']:<4} "
+                  f"{_fmt_s(s['total_s']):>10}  "
+                  f"{s['share'] * 100:5.1f}%")
+        # Indent each span under its parent (the cross-process chain).
+        by_id = {s["span_id"]: s for s in trace["spans"]}
+        t0 = trace["start_time"]
+
+        def depth(span):
+            d, seen = 0, set()
+            while span.get("parent_id") in by_id:
+                if span["span_id"] in seen:
+                    break
+                seen.add(span["span_id"])
+                span = by_id[span["parent_id"]]
+                d += 1
+            return d
+        for s in trace["spans"]:
+            dur = s.get("duration") or 0.0
+            origin = (f"{(s.get('node_id') or 'head')[:8]}/"
+                      f"{s.get('component', '?')}-{s.get('pid', 0)}")
+            print(f"  {'  ' * depth(s)}{s['name']} "
+                  f"[+{_fmt_s(max(0.0, s['start_time'] - t0))} "
+                  f"{_fmt_s(dur)}] @{origin}")
+        return 0
+    rows = rt.trace_list(args.tail)
+    if not rows:
+        print("no traces assembled yet (is tracing enabled and sampled?)")
+        return 0
+    for r in rows:
+        print(f"{r['trace_id']}  {r['root']:<28} "
+              f"{r['span_count']:>3} spans  "
+              f"{_fmt_s(r['duration_s']):>10}  "
+              f"origins={len(r['origins'])}")
+    return 0
+
+
 def cmd_list(args) -> int:
     _ensure_init()
     from ray_tpu.experimental.state import api
@@ -354,6 +445,17 @@ def main(argv=None) -> int:
     sub.add_parser("memory", help="object store summary")
     p = sub.add_parser("timeline", help="dump chrome://tracing JSON")
     p.add_argument("-o", "--output", default=None)
+    p = sub.add_parser("trace", help="inspect assembled distributed "
+                                     "traces (cross-process spans)")
+    p.add_argument("--id", default=None,
+                   help="show one trace's span tree + stage breakdown")
+    p.add_argument("--tail", type=int, default=20,
+                   help="list the N most recent traces (default 20)")
+    p.add_argument("--summary", action="store_true",
+                   help="cluster-level per-stage critical-path breakdown")
+    p.add_argument("--perfetto", default=None, metavar="OUT_JSON",
+                   help="write Chrome-trace JSON (slices + flow arrows); "
+                        "combine with --id for a single trace")
     p = sub.add_parser("list", help="list cluster state")
     p.add_argument("resource", choices=["actors", "tasks", "objects",
                                         "nodes", "placement-groups"])
@@ -460,6 +562,7 @@ def main(argv=None) -> int:
         "status": cmd_status,
         "memory": cmd_memory,
         "timeline": cmd_timeline,
+        "trace": cmd_trace,
         "list": cmd_list,
         "actors": cmd_actors,
         "summary": cmd_summary,

@@ -30,6 +30,7 @@ from typing import Any
 
 from ray_tpu._private import chaos
 from ray_tpu.exceptions import ActorDiedError
+from ray_tpu.util import tracing
 
 
 class ReplicaActor:
@@ -107,14 +108,23 @@ class ReplicaActor:
                 fn = self._callable
             else:
                 fn = getattr(self._callable, method_name or "__call__")
-            if inspect.iscoroutinefunction(fn):
-                return await fn(*args, **kwargs)
-            # Sync handlers run off the event loop so concurrent requests
-            # overlap and num_ongoing reflects true load (reference:
-            # replica.py runs sync callables in a thread pool).
-            result = await asyncio.to_thread(fn, *args, **kwargs)
-            if inspect.iscoroutine(result):
-                result = await result
-            return result
+            # Parent is the actor-task execute span the runtime opened for
+            # this handle_request call; untraced requests see no parent and
+            # the child_span is a no-op.
+            with tracing.child_span(
+                    "serve::replica_handler",
+                    {"stage": "serve_handle",
+                     "deployment": self._deployment_name,
+                     "method": method_name or "__call__"}):
+                if inspect.iscoroutinefunction(fn):
+                    return await fn(*args, **kwargs)
+                # Sync handlers run off the event loop so concurrent
+                # requests overlap and num_ongoing reflects true load
+                # (reference: replica.py runs sync callables in a thread
+                # pool).
+                result = await asyncio.to_thread(fn, *args, **kwargs)
+                if inspect.iscoroutine(result):
+                    result = await result
+                return result
         finally:
             self._ongoing -= 1

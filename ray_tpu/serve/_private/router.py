@@ -53,10 +53,11 @@ _SUSPECT_TTL_S = 5.0
 
 class _PendingRequest:
     __slots__ = ("req_id", "method", "args", "kwargs", "promise", "inner",
-                 "replica_hex", "retries_left", "deadline")
+                 "replica_hex", "retries_left", "deadline", "trace_ctx")
 
     def __init__(self, req_id: int, method: str, args, kwargs, promise,
-                 retries_left: int, deadline: Optional[float]):
+                 retries_left: int, deadline: Optional[float],
+                 trace_ctx: Optional[dict] = None):
         self.req_id = req_id
         self.method = method
         self.args = args
@@ -66,6 +67,9 @@ class _PendingRequest:
         self.replica_hex: Optional[str] = None  # charged replica
         self.retries_left = retries_left
         self.deadline = deadline  # monotonic, None = no deadline
+        # Trace context captured at assignment; rides the request so a
+        # failover re-dispatch stays in the same trace.
+        self.trace_ctx = trace_ctx
 
 
 class Router:
@@ -333,8 +337,16 @@ class Router:
         replica = self.pick_replica()
         hexid = replica._actor_id.hex()
         try:
-            ref = replica.handle_request.remote(
-                pending.method, pending.args, pending.kwargs)
+            # The dispatch span makes the actor submit inside it inherit
+            # the request's trace: the replica-side handler span parents
+            # here across the hop (also on failover re-dispatches).
+            from ray_tpu.util import tracing
+            with tracing.continue_context(
+                    pending.trace_ctx, "serve::router_dispatch",
+                    {"stage": "serve_dispatch", "deployment": self._name,
+                     "replica": hexid[:8]}):
+                ref = replica.handle_request.remote(
+                    pending.method, pending.args, pending.kwargs)
         except BaseException:
             # The pick already charged this replica; a failed submit has
             # no completing ref to drain the charge back.
@@ -385,11 +397,17 @@ class Router:
         deadline = None
         if timeout_s is not None:
             deadline = time.monotonic() + timeout_s
+        # Head-of-trace sampling for serve traffic: each request roots
+        # (or joins) a trace here; unsampled requests carry None and the
+        # whole serve path stays bare.
+        from ray_tpu.util import tracing
+        trace_ctx = (tracing.inject_context()
+                     if tracing.is_tracing_enabled() else None)
         with self._lock:
             self._req_seq += 1
             pending = _PendingRequest(self._req_seq, method_name, args,
                                       kwargs, promise, max_retries,
-                                      deadline)
+                                      deadline, trace_ctx)
             self._requests[pending.req_id] = pending
         try:
             self._dispatch(pending)

@@ -6,11 +6,27 @@ _DictPropagator :160): an OTel-compatible-shaped but dependency-free span
 recorder. Enable with ``enable_tracing()``; every task/actor call then
 records a span parented to the caller's active span, and ``get_spans()`` /
 ``export_chrome_trace()`` expose the tree.
+
+Cross-process model (Dapper-style): the driver makes the sampling
+decision ONCE per trace (``RAY_TPU_TRACE_SAMPLE_RATE``, head-of-trace
+sampling) and serializes ``{trace_id, parent_id, sampled}`` into the
+task spec / request metadata; every downstream hop parents its spans to
+the carried context. Unsampled requests carry no context at all, so the
+remote side's cost is a single attribute read. Finished spans ride
+``metrics_batch`` frames to the head, where the trace assembler
+(_private/trace_assembler.py) merges them per trace_id.
+
+Timing: ``start_time`` is a wall-clock ANCHOR (for cross-process
+alignment on one timeline); ``duration`` is measured monotonically so an
+NTP step mid-span cannot corrupt it. ``end_time`` is derived
+(anchor + duration), never a second wall-clock read.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
+import random
 import threading
 import time
 import uuid
@@ -22,6 +38,9 @@ _lock = threading.Lock()
 _spans: List["Span"] = []
 _enabled = False
 _MAX_SPANS = 100_000
+#: Resolved sample rate; None = not yet resolved (lazy: env/config may
+#: not be final at import time).
+_sample_rate: Optional[float] = None
 
 
 @dataclass
@@ -30,16 +49,20 @@ class Span:
     trace_id: str
     span_id: str
     parent_id: Optional[str]
-    start_time: float
-    end_time: Optional[float] = None
+    start_time: float  # wall-clock anchor (cross-process alignment only)
+    end_time: Optional[float] = None  # derived: start_time + duration
+    duration: Optional[float] = None  # monotonic, NTP-step-proof
     attributes: Dict[str, Any] = field(default_factory=dict)
     # Set once the span has been drained into a metrics_batch frame, so a
     # long-open span ahead of it in the buffer cannot cause re-shipping.
     shipped: bool = field(default=False, repr=False, compare=False)
+    # Monotonic start, never serialized (meaningless across processes).
+    _mono: float = field(default=0.0, repr=False, compare=False)
 
     def end(self) -> None:
         if self.end_time is None:
-            self.end_time = time.time()
+            self.duration = time.monotonic() - self._mono
+            self.end_time = self.start_time + self.duration
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -49,8 +72,20 @@ class Span:
             "parent_id": self.parent_id,
             "start_time": self.start_time,
             "end_time": self.end_time,
+            "duration": self.duration,
             "attributes": dict(self.attributes),
         }
+
+
+class _Unsampled:
+    """Thread-local sentinel: the active trace drew NOT-sampled. Keeps
+    the head-of-trace decision sticky for nested local spans (a child of
+    an unsampled root must not re-draw and start recording mid-trace)."""
+
+    __slots__ = ()
+
+
+_UNSAMPLED = _Unsampled()
 
 
 def enable_tracing() -> None:
@@ -73,8 +108,44 @@ def clear_spans() -> None:
         _spans.clear()
 
 
+def set_sample_rate(rate: Optional[float]) -> None:
+    """Override the head-of-trace sampling rate (None = re-resolve from
+    env/config on next use). Tests and the overhead bench use this."""
+    global _sample_rate
+    _sample_rate = None if rate is None else max(0.0, min(1.0, float(rate)))
+
+
+def sample_rate() -> float:
+    """The head-of-trace sampling probability (``RAY_TPU_TRACE_SAMPLE_RATE``
+    env var / ``trace_sample_rate`` config flag; default 1.0 — every
+    trace records once tracing is enabled). Resolved lazily and cached."""
+    global _sample_rate
+    rate = _sample_rate
+    if rate is None:
+        raw = os.environ.get("RAY_TPU_TRACE_SAMPLE_RATE")
+        if raw is None:
+            raw = os.environ.get("RAY_TPU_trace_sample_rate")
+        try:
+            rate = float(raw) if raw is not None else 1.0
+        except ValueError:
+            rate = 1.0
+        rate = max(0.0, min(1.0, rate))
+        _sample_rate = rate
+    return rate
+
+
+def _draw_sampled() -> bool:
+    rate = sample_rate()
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return random.random() < rate
+
+
 def current_span() -> Optional[Span]:
-    return getattr(_state, "span", None)
+    span = getattr(_state, "span", None)
+    return None if span is _UNSAMPLED else span
 
 
 def _record(span: Span) -> None:
@@ -83,24 +154,46 @@ def _record(span: Span) -> None:
             _spans.append(span)
 
 
+def _new_span(name: str, trace_id: str, parent_id: Optional[str],
+              attributes: Optional[Dict[str, Any]] = None) -> Span:
+    return Span(
+        name=name,
+        trace_id=trace_id,
+        span_id=uuid.uuid4().hex[:8],
+        parent_id=parent_id,
+        start_time=time.time(),
+        attributes=dict(attributes or {}),
+        _mono=time.monotonic(),
+    )
+
+
 @contextlib.contextmanager
 def start_span(name: str, attributes: Optional[Dict[str, Any]] = None):
     """Open a span as the thread's active context; nested spans (and remote
-    tasks submitted inside) are parented to it."""
+    tasks submitted inside) are parented to it. A ROOT span (no active
+    parent) makes the head-of-trace sampling decision; the verdict sticks
+    for everything nested under it."""
     if not _enabled:
         yield None
         return
-    parent = current_span()
-    span = Span(
-        name=name,
-        trace_id=parent.trace_id if parent else uuid.uuid4().hex[:16],
-        span_id=uuid.uuid4().hex[:8],
-        parent_id=parent.span_id if parent else None,
-        start_time=time.time(),
-        attributes=dict(attributes or {}),
+    prev = getattr(_state, "span", None)
+    if prev is _UNSAMPLED:
+        yield None
+        return
+    if prev is None and not _draw_sampled():
+        _state.span = _UNSAMPLED
+        try:
+            yield None
+        finally:
+            _state.span = None
+        return
+    span = _new_span(
+        name,
+        trace_id=prev.trace_id if prev else uuid.uuid4().hex[:16],
+        parent_id=prev.span_id if prev else None,
+        attributes=attributes,
     )
     _record(span)
-    prev = parent
     _state.span = span
     try:
         yield span
@@ -109,30 +202,108 @@ def start_span(name: str, attributes: Optional[Dict[str, Any]] = None):
         _state.span = prev
 
 
-def inject_context() -> Optional[Dict[str, str]]:
+def inject_context() -> Optional[Dict[str, Any]]:
     """Serialize the active span context for a task spec (the reference's
-    _DictPropagator.inject_current_context)."""
-    span = current_span()
-    if not _enabled or span is None:
+    _DictPropagator.inject_current_context). With no active span this IS
+    the head of a trace: the sampling decision is made here, once, and an
+    unsampled draw returns None — remote hops then pay one attribute read
+    and nothing else."""
+    if not _enabled:
         return None
-    return {"trace_id": span.trace_id, "parent_id": span.span_id}
+    span = getattr(_state, "span", None)
+    if span is _UNSAMPLED:
+        return None
+    if span is not None:
+        return {"trace_id": span.trace_id, "parent_id": span.span_id,
+                "sampled": True}
+    if not _draw_sampled():
+        return None
+    return {"trace_id": uuid.uuid4().hex[:16], "parent_id": None,
+            "sampled": True}
+
+
+def span_context(span: Optional[Span]) -> Optional[Dict[str, Any]]:
+    """A propagation context parented to ``span`` (for threading a
+    specific span — e.g. the driver-submit span — into a wire message
+    without touching thread-local state)."""
+    if span is None:
+        return None
+    return {"trace_id": span.trace_id, "parent_id": span.span_id,
+            "sampled": True}
+
+
+def _ctx_sampled(ctx: Optional[Dict[str, Any]]) -> bool:
+    # Contexts from pre-sampling peers carry no flag: treat as sampled
+    # (they were only injected when tracing was on).
+    return bool(ctx) and bool(ctx.get("sampled", True))
 
 
 @contextlib.contextmanager
-def continue_context(ctx: Optional[Dict[str, str]], name: str):
-    """Worker-side: run a task under the caller's trace context."""
-    if not _enabled or ctx is None:
+def continue_context(ctx: Optional[Dict[str, Any]], name: str,
+                     attributes: Optional[Dict[str, Any]] = None):
+    """Worker-side: run a task under the caller's trace context.
+
+    Deliberately NOT gated on the local ``_enabled`` flag: a carried
+    sampled context IS the enablement signal — the driver made the
+    decision, and daemons/workers (where enable_tracing was never
+    called) record purely because the request asked them to."""
+    if not _ctx_sampled(ctx):
         yield None
         return
+    span = _new_span(name, trace_id=ctx["trace_id"],
+                     parent_id=ctx.get("parent_id"),
+                     attributes=attributes)
+    _record(span)
+    prev = getattr(_state, "span", None)
+    _state.span = span
+    try:
+        yield span
+    finally:
+        span.end()
+        _state.span = prev
+
+
+def record_complete_span(name: str, ctx: Optional[Dict[str, Any]], *,
+                         wall_start: float, duration: float,
+                         attributes: Optional[Dict[str, Any]] = None
+                         ) -> Optional[Span]:
+    """Record an already-finished span under ``ctx`` retroactively —
+    for stages measured across callbacks (queue wait, result store)
+    where no ``with`` block brackets the interval. ``wall_start`` is the
+    anchor; ``duration`` must come from monotonic deltas. Like
+    continue_context, gated on the context alone, not ``_enabled``."""
+    if not _ctx_sampled(ctx):
+        return None
+    duration = max(0.0, float(duration))
     span = Span(
         name=name,
         trace_id=ctx["trace_id"],
         span_id=uuid.uuid4().hex[:8],
         parent_id=ctx.get("parent_id"),
-        start_time=time.time(),
+        start_time=wall_start,
+        end_time=wall_start + duration,
+        duration=duration,
+        attributes=dict(attributes or {}),
     )
     _record(span)
-    prev = current_span()
+    return span
+
+
+@contextlib.contextmanager
+def child_span(name: str, attributes: Optional[Dict[str, Any]] = None):
+    """A span recorded ONLY under an active sampled parent (data-plane
+    helpers like object pulls: traced when a traced task triggers them,
+    free when nothing is tracing this request). The parent — not the
+    local ``_enabled`` flag — is the gate, so pulls inside a propagated
+    remote span record too."""
+    parent = current_span()
+    if parent is None:
+        yield None
+        return
+    span = _new_span(name, trace_id=parent.trace_id,
+                     parent_id=parent.span_id, attributes=attributes)
+    _record(span)
+    prev = parent
     _state.span = span
     try:
         yield span
@@ -178,13 +349,13 @@ def export_chrome_trace() -> List[Dict[str, Any]]:
     the state API already emits)."""
     out = []
     for s in get_spans():
-        end = s.end_time or time.time()
+        dur = s.duration if s.duration is not None else 0.0
         out.append({
             "name": s.name,
             "cat": "trace",
             "ph": "X",
             "ts": s.start_time * 1e6,
-            "dur": (end - s.start_time) * 1e6,
+            "dur": dur * 1e6,
             "pid": s.trace_id,
             "tid": s.span_id,
             "args": s.attributes,

@@ -137,6 +137,10 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     FLAG_DBL(train_restart_wait_s, 30.0),
     // -- metrics / events --
     FLAG_INT(metrics_report_interval_ms, 10000),
+    // Distributed tracing: head-of-trace sampling probability and the
+    // number of assembled traces the head retains (oldest evicted).
+    FLAG_DBL(trace_sample_rate, 1.0),
+    FLAG_INT(trace_retention, 1000),
     FLAG_BOOL(task_events_enabled, true),
     // -- memory monitor / OOM killing --
     FLAG_INT(memory_monitor_refresh_ms, 250),

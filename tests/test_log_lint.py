@@ -9,10 +9,12 @@ runtime, so CI catches them statically:
 2. Bare ``print(`` under ``ray_tpu/_private/`` — framework internals
    must use the ``logging`` module (or explicit stream writes) so their
    chatter doesn't masquerade as user task output in the stream.
-3. ``time.time() - t0`` latency math under ``ray_tpu/_private/`` —
-   wall-clock deltas jump on NTP steps; durations feeding metrics must
-   use ``time.monotonic()``/``perf_counter()`` (and then belong in a
-   ``util.metrics`` Histogram, not an ad-hoc accumulator).
+3. ``time.time() - t0`` latency math under ``ray_tpu/_private/`` (and
+   in ``ray_tpu/util/tracing.py``, where span durations were once
+   wall-clock pairs) — wall-clock deltas jump on NTP steps; durations
+   feeding metrics must use ``time.monotonic()``/``perf_counter()``
+   (and then belong in a ``util.metrics`` Histogram, not an ad-hoc
+   accumulator).
 4. Swallowed ``_send_frame`` failures under ``ray_tpu/_private/`` —
    ``with contextlib.suppress(OSError): _send_frame(...)`` or
    ``try: _send_frame(...) except OSError: pass`` silently drops a
@@ -93,11 +95,15 @@ def _is_time_time(node):
 
 
 def test_no_wall_clock_latency_math_in_private():
-    """No ``time.time()`` operand inside a subtraction in _private/:
-    duration accounting must be monotonic (and go through
-    util.metrics), never ad-hoc wall-clock deltas."""
+    """No ``time.time()`` operand inside a subtraction in _private/
+    (or in util/tracing.py, where span durations were once wall-clock
+    pairs an NTP step could corrupt): duration accounting must be
+    monotonic (and go through util.metrics), never ad-hoc wall-clock
+    deltas."""
     offenders = []
-    for path in _py_files(os.path.join(PKG_ROOT, "_private")):
+    lint_paths = list(_py_files(os.path.join(PKG_ROOT, "_private"))) + \
+        [os.path.join(PKG_ROOT, "util", "tracing.py")]
+    for path in lint_paths:
         tree = _parse(path)
         for node in ast.walk(tree):
             if not (isinstance(node, ast.BinOp) and

@@ -136,16 +136,20 @@ def test_tracing_spans_propagate(ray_start_regular):
         deadline = time.monotonic() + 5
         while time.monotonic() < deadline:
             spans = tracing.get_spans(trace_id=root.trace_id)
-            if len(spans) >= 2:
+            if len(spans) >= 3:
                 break
             time.sleep(0.05)
         names = {s.name for s in spans}
         assert "driver_op" in names
         assert any(n.startswith("task::") and "traced_task" in n
                    for n in names)
+        # Chain: driver_op -> driver::submit -> task::traced_task (the
+        # submit span is the pipeline's first instrumented stage).
+        submit = next(s for s in spans if s.name == "driver::submit")
+        assert submit.parent_id == root.span_id
         child = next(s for s in spans
                      if s.name.startswith("task::") and "traced_task" in s.name)
-        assert child.parent_id == root.span_id
+        assert child.parent_id == submit.span_id
         events = tracing.export_chrome_trace()
         assert any("traced_task" in e["name"] for e in events)
     finally:
