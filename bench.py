@@ -684,12 +684,16 @@ def bench_channel_reconnect() -> dict:
 
 
 def bench_object_recovery() -> dict:
-    """Durable-spill recovery latency: a daemon spills its only copy of
-    a large result through session:// storage, then dies by SIGKILL; the
-    metric is kill -> get() completion, i.e. death detection + node
-    removal + tiered recovery (spill-URI restore, NOT producer
-    re-execution). Bounds the stall node loss adds to a consumer of a
-    spilled object."""
+    """Durable-spill recovery latency, split into its two components: a
+    daemon spills its only copy of a large result through session://
+    storage, then dies by SIGKILL. ``node_death_detect_ms`` is kill ->
+    the membership table's death declaration (the fenced-membership
+    detection path: channel break wakes the probe loop, hard probe
+    failure declares); ``object_restore_ms`` is the subsequent ``get()``
+    completion (node removal + tiered recovery via spill-URI restore,
+    NOT producer re-execution). Both are latency-gated so a detection
+    regression is visible on its own instead of hiding inside the
+    restore time."""
     import json as _json
     import os as _os
     import signal as _signal
@@ -737,11 +741,27 @@ def bench_object_recovery() -> dict:
             _time.sleep(0.02)
         else:
             raise TimeoutError("spill URI never announced")
-        procs[0].send_signal(_signal.SIGKILL)
-        t0 = _time.perf_counter()
-        value = ray_tpu.get(ref, timeout=120)
-        out["object_recovery_ms"] = round(
-            (_time.perf_counter() - t0) * 1e3, 1)
+        import threading as _threading
+        declared = _threading.Event()
+
+        def _on_member_event(event):
+            if event.get("event") == "dead":
+                declared.set()
+
+        runtime.membership.subscribe(_on_member_event)
+        try:
+            procs[0].send_signal(_signal.SIGKILL)
+            t0 = _time.perf_counter()
+            if not declared.wait(timeout=30):
+                raise TimeoutError("node death never declared")
+            out["node_death_detect_ms"] = round(
+                (_time.perf_counter() - t0) * 1e3, 1)
+            t1 = _time.perf_counter()
+            value = ray_tpu.get(ref, timeout=120)
+            out["object_restore_ms"] = round(
+                (_time.perf_counter() - t1) * 1e3, 1)
+        finally:
+            runtime.membership.unsubscribe(_on_member_event)
         assert int(value[-1]) == 1024 * 1024 - 1
     finally:
         _stop_procs(procs)
@@ -1459,7 +1479,8 @@ def _prior_round_bench():
 # informational (detached_actor_restart_ms etc. must stay ungated — see
 # test_only_throughput_suffixes_compared); these few regress when they
 # INCREASE beyond the threshold.
-_LATENCY_GATED = ("train_gang_restart_ms",)
+_LATENCY_GATED = ("train_gang_restart_ms", "node_death_detect_ms",
+                  "object_restore_ms")
 
 
 def compare_rounds(prev: dict, extra: dict, headline_value,
@@ -1638,7 +1659,7 @@ def main(argv=None):
          bench_detached_restart),
         ("channel_reconnect", "channel_reconnect_ms",
          bench_channel_reconnect),
-        ("object_recovery", "object_recovery_ms", bench_object_recovery),
+        ("object_recovery", "node_death_detect_ms", bench_object_recovery),
         ("train_gang_restart", "train_gang_restart_ms",
          bench_train_gang_restart),
         ("log_stream", "log_lines_per_sec", bench_log_streaming),

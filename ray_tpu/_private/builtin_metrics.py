@@ -336,6 +336,30 @@ def channel_send_retries() -> Counter:
         "escalating to node death or pull failure.")
 
 
+# -- membership fencing (wire v9) ------------------------------------------
+
+
+def frames_fenced() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_frames_fenced_total",
+        "Frames and handshakes rejected because they carried a dead "
+        "incarnation's epoch (or came from a session the head no "
+        "longer knows): stale-envelope drops, fenced resume attempts, "
+        "and unknown-node health-channel announces. Counted, never "
+        "applied — and never per-frame log spam.")
+
+
+def node_deaths() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_node_deaths_total",
+        "Node incarnations declared dead, by how the detector decided "
+        "(hard = process-gone evidence; suspicion = accrual phi over "
+        "threshold; lease = hard silence bound).",
+        tag_keys=("kind",))
+
+
 # -- serve resilience ------------------------------------------------------
 # Control-plane events (a failover or a drain is news, not load): plain
 # lazy accessors, no fast cells. Incremented from the serve router's

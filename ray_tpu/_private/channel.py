@@ -280,6 +280,14 @@ class ResilientChannel:
         self.out_seq = 0
         self.in_seq = 0
         self._acked_in = 0
+        # v9 membership fencing: the session incarnation's node_epoch,
+        # stamped into every outbound seq envelope once the owner learns
+        # it (head: at registration, before the ack is sent; daemon: from
+        # the registered ack). 0 = not yet learned — pre-registration
+        # frames are never fenced. An inbound enveloped frame stamped
+        # with a DIFFERENT non-zero epoch is from another incarnation of
+        # this session: dropped and counted, never applied.
+        self.epoch = 0
         # Reused header buffer: length prefix + seq envelope, packed in
         # place under self._cv for every write (no per-frame allocation,
         # no prepend copy).
@@ -334,7 +342,7 @@ class ResilientChannel:
         body = _nbytes(parts)
         hdr = self._hdr  # safe to reuse: all writes run under self._cv
         _LEN.pack_into(hdr, 0, _wire.SEQ_SIZE + body)
-        _wire.pack_seq_into(hdr, _LEN.size, seq, self.in_seq)
+        _wire.pack_seq_into(hdr, _LEN.size, seq, self.in_seq, self.epoch)
         self._acked_in = self.in_seq
         self._ack_pending = False
         try:
@@ -378,7 +386,14 @@ class ResilientChannel:
             unwrapped = _wire.unwrap_seq(raw)
             if unwrapped is None:
                 return raw  # raw handshake frame: pass through
-            seq, ack, inner = unwrapped
+            seq, ack, epoch, inner = unwrapped
+            if self.epoch and epoch and epoch != self.epoch:
+                # Stale incarnation (v9 fencing): a frame from a
+                # previous life of this session must never be applied —
+                # its ack must not prune our ring either (the acked
+                # state belongs to the dead incarnation).
+                self._count("frames_fenced")
+                continue
             with self._cv:
                 self._ring.prune(ack)
                 if seq == 0:

@@ -19,6 +19,20 @@ Kinds
     io_oserror     raise an OSError from a ``*_error`` storage-IO site
                    (spill writes/restores; degrades a tier instead of
                    failing the caller)
+    partition      network partition: blackhole every matching ``.send``
+                   / ``.recv`` call by raising :class:`ChaosPartition`
+                   (an unreachable peer, NOT a reset — the membership
+                   layer classifies it like a probe timeout). Scope
+                   with ``site`` (``head`` = everything the head sends
+                   or receives on its session/health channels →
+                   bidirectional head↔daemon partition; ``daemon`` =
+                   the daemon's side; ``pull`` = the daemon↔daemon data
+                   plane). ``ms`` (default 0 = no window) arms a heal
+                   timer on the first fire: matching calls are
+                   blackholed for ``ms`` milliseconds, then the
+                   partition heals and never fires again — partition →
+                   suspicion → death declaration → heal → fenced
+                   re-register, in one deterministic spec.
 
 Params
     p      firing probability per matching call (default 1.0)
@@ -26,11 +40,16 @@ Params
     site   substring filter on the injection-site name
     after  skip the first N matching calls
     times  fire at most N times (0 = unlimited)
-    ms     sleep duration for delay_ms (default 10)
+    ms     sleep duration for delay_ms (default 10); heal-after
+           duration for partition (default 0 = never heals)
 
 Sites: ``head.send`` / ``head.recv`` (head side of a session channel),
 ``daemon.send`` / ``daemon.recv`` (daemon side), ``pull.send``
-(dataplane pooled pull sockets), ``serve.replica_kill`` /
+(dataplane pooled pull sockets), ``head.health.send`` /
+``head.health.recv`` (head-side liveness probe), ``daemon.health.send``
+/ ``daemon.health.recv`` (daemon health-channel loop),
+``daemon.resume.send`` (resume handshake — a partition must also block
+the daemon's attempt to re-attach its broken session), ``serve.replica_kill`` /
 ``serve.replica_delay_ms`` (serve replica request path — evaluated at
 the top of every ``handle_request``), ``spill.write_error`` /
 ``spill.restore_error`` (spill-backend IO, see _private/spill.py),
@@ -62,7 +81,7 @@ _LOCK = threading.Lock()
 _OPS: List["_Op"] = []
 _DEFAULT_SEED = 0xC4A05
 _KINDS = ("send_oserror", "recv_oserror", "sock_close", "delay_ms", "kill",
-          "io_oserror")
+          "io_oserror", "partition")
 
 
 class ChaosError(OSError):
@@ -74,9 +93,18 @@ class ChaosKill(ChaosError):
     dead — every subsequent call raises ActorDiedError)."""
 
 
+class ChaosPartition(ChaosError):
+    """Injected network partition: the peer is unreachable, not reset.
+
+    Channels treat it like any transient OSError (mark broken, park for
+    resume); the membership layer classifies it like a probe TIMEOUT —
+    evidence of partition feeding the suspicion score, never the
+    immediate process-is-gone death path."""
+
+
 class _Op:
     __slots__ = ("kind", "p", "site", "after", "times", "ms", "rng",
-                 "seen", "fired")
+                 "seen", "fired", "started")
 
     def __init__(self, kind: str, params: dict):
         self.kind = kind
@@ -84,10 +112,14 @@ class _Op:
         self.site = params.get("site", "")
         self.after = int(params.get("after", 0))
         self.times = int(params.get("times", 0))
-        self.ms = float(params.get("ms", 10.0))
+        # delay_ms: sleep duration. partition: heal-after window from
+        # the first fire (0 = the partition never heals on its own).
+        self.ms = float(params.get("ms",
+                                   0.0 if kind == "partition" else 10.0))
         self.rng = random.Random(int(params.get("seed", _DEFAULT_SEED)))
         self.seen = 0
         self.fired = 0
+        self.started: Optional[float] = None  # partition: first-fire time
 
 
 def configure(spec: Optional[str]) -> List[_Op]:
@@ -149,14 +181,28 @@ def maybe_inject(site: str, sock=None) -> None:
                 continue
             if op.kind == "io_oserror" and "_error" not in site:
                 continue
+            if (op.kind == "partition" and ".send" not in site
+                    and ".recv" not in site):
+                continue
             op.seen += 1
             if op.seen <= op.after:
+                continue
+            if op.kind == "partition" and op.started is not None:
+                # Window armed on the first fire: every matching call is
+                # blackholed until ``ms`` elapses, then the partition
+                # heals for good (p/times no longer consulted).
+                if (time.monotonic() - op.started) * 1000.0 < op.ms:
+                    op.fired += 1
+                    fire = op
+                    break
                 continue
             if op.times and op.fired >= op.times:
                 continue
             if op.p < 1.0 and op.rng.random() >= op.p:
                 continue
             op.fired += 1
+            if op.kind == "partition" and op.ms > 0 and op.started is None:
+                op.started = time.monotonic()
             fire = op
             break
     if fire is None:
@@ -166,6 +212,8 @@ def maybe_inject(site: str, sock=None) -> None:
         return
     if fire.kind == "kill":
         raise ChaosKill(f"chaos[kill] injected at {site}")
+    if fire.kind == "partition":
+        raise ChaosPartition(f"chaos[partition] injected at {site}")
     if fire.kind == "sock_close" and sock is not None:
         try:
             sock.shutdown(socket.SHUT_RDWR)

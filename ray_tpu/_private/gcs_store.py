@@ -37,6 +37,12 @@ class GcsStore:
         #                 "max_concurrency"}
         self.actors: Dict[str, Dict[str, Any]] = {}
         self.jobs: Dict[str, Dict[str, Any]] = {}
+        # node_id hex → incarnation epoch (v9 membership fencing). The
+        # counter is the max recorded value, so a restarted head keeps
+        # minting ABOVE every epoch it handed out in a previous life —
+        # a partitioned daemon returning across a head restart is still
+        # recognizably stale.
+        self.node_epochs: Dict[str, int] = {}
         if os.path.exists(path):
             try:
                 with open(path, "rb") as f:
@@ -44,6 +50,7 @@ class GcsStore:
                 self.kv = data.get("kv", {})
                 self.actors = data.get("actors", {})
                 self.jobs = data.get("jobs", {})
+                self.node_epochs = data.get("node_epochs", {})
             except Exception:  # noqa: BLE001 - torn file: start fresh
                 pass
 
@@ -53,10 +60,24 @@ class GcsStore:
                     exist_ok=True)
         with open(tmp, "wb") as f:
             pickle.dump({"kv": self.kv, "actors": self.actors,
-                         "jobs": self.jobs}, f)
+                         "jobs": self.jobs,
+                         "node_epochs": self.node_epochs}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+
+    # -- node epochs (v9 membership fencing) ---------------------------
+
+    def record_node_epoch(self, node_id_hex: str, epoch: int) -> None:
+        with self._lock:
+            self.node_epochs[node_id_hex] = int(epoch)
+            self._save_locked()
+
+    def max_node_epoch(self) -> int:
+        """Floor for the head's epoch counter: mint strictly above
+        every epoch any previous head life handed out."""
+        with self._lock:
+            return max(self.node_epochs.values(), default=0)
 
     # -- internal KV (reference: gcs_kv_manager.h InternalKV) ----------
 

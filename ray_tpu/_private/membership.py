@@ -1,0 +1,235 @@
+"""Fenced cluster membership: epochs, leases, accrual suspicion.
+
+The analog of the reference's GCS node manager + health check manager
+(gcs_node_manager.cc registration/death bookkeeping,
+gcs_health_check_manager.h liveness) with two upgrades the reference
+also carries:
+
+* **Epoch fencing** (reference: raylet restarts get a new node id; GCS
+  rejects RPCs from dead incarnations). Every daemon registration mints
+  a monotonically increasing ``node_epoch``; the epoch rides the wire-v9
+  seq envelope and the resume handshake, so a daemon that was declared
+  dead — then comes back from the other side of a partition — cannot
+  re-attach its old session or replay stale frames. It gets a
+  ``fenced`` reply and must re-register as a *new* incarnation; its old
+  actors were declared dead exactly once when the lease expired.
+
+* **Accrual suspicion** (Hayashibara et al.'s phi-accrual detector, the
+  SWIM-family alternative to fixed ping/timeout): instead of "N missed
+  pings at a fixed period", every piece of channel liveness — frame
+  arrivals, acks, metrics_batch pushes, health pongs — feeds a per-node
+  inter-arrival history, and the suspicion score is how improbable the
+  current silence is *given that node's own observed cadence*. A node
+  that routinely goes quiet for 10s during XLA compiles earns a long
+  mean interval and is not falsely declared; a node that chattered
+  every 50ms and went silent crosses the threshold in well under a
+  second. A hard lease (``RAY_TPU_node_lease_s``) bounds detection from
+  above no matter what the history says.
+
+The head owns one :class:`MembershipTable`; each registered node gets a
+:class:`NodeLiveness`. Death/join events fan out to in-process
+subscribers (serve controller, train BackendExecutor) and to the
+``membership`` pubsub channel, so consumers react to a push instead of
+discovering death via their next failed RPC.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+#: ln(10) — phi is a base-10 log-improbability (phi==9 means the
+#: observed silence had probability ~1e-9 under the node's cadence).
+_LN10 = math.log(10.0)
+
+
+class AccrualDetector:
+    """Simplified phi-accrual over an exponential inter-arrival model.
+
+    ``record()`` feeds one liveness arrival; ``phi(now)`` returns the
+    suspicion score for the silence since the last arrival:
+    ``phi = t_silent / (mean_interval * ln 10)`` — the -log10 of the
+    probability that an exponential process with the observed mean
+    stays silent for ``t_silent``. The mean is clamped below by
+    ``floor_s`` (the probe period) so a burst of sub-millisecond frame
+    arrivals cannot make a routine 100ms pause look like death."""
+
+    def __init__(self, window: int = 64, floor_s: float = 0.25):
+        self._intervals: collections.deque = collections.deque(
+            maxlen=window)
+        self._floor = float(floor_s)
+        self.last_arrival = time.monotonic()
+
+    def record(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        gap = now - self.last_arrival
+        if gap > 0:
+            self._intervals.append(gap)
+        self.last_arrival = now
+
+    def mean_interval(self) -> float:
+        if not self._intervals:
+            return self._floor
+        return max(self._floor,
+                   sum(self._intervals) / len(self._intervals))
+
+    def phi(self, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        silent = now - self.last_arrival
+        if silent <= 0:
+            return 0.0
+        return silent / (self.mean_interval() * _LN10)
+
+
+class NodeLiveness:
+    """One node incarnation's liveness state at the head."""
+
+    __slots__ = ("node_id_hex", "epoch", "detector", "soft_failures",
+                 "registered_at")
+
+    def __init__(self, node_id_hex: str, epoch: int,
+                 probe_period_s: float = 0.25):
+        self.node_id_hex = node_id_hex
+        self.epoch = epoch
+        self.detector = AccrualDetector(floor_s=probe_period_s)
+        #: Consecutive soft probe failures (timeouts / blackholed sends)
+        #: — evidence of partition, not process death. Reset on any
+        #: arrival.
+        self.soft_failures = 0
+        self.registered_at = time.monotonic()
+
+    def record_arrival(self, now: Optional[float] = None) -> None:
+        self.detector.record(now)
+        self.soft_failures = 0
+
+    def silent_for(self, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        return now - self.detector.last_arrival
+
+    def phi(self, now: Optional[float] = None) -> float:
+        return self.detector.phi(now)
+
+
+class MembershipTable:
+    """Head-side membership: incarnation epochs, liveness, fan-out.
+
+    Epochs are minted monotonically (persisted through the gcs_store's
+    ``node_epochs`` table when one is attached, so a restarted head
+    keeps fencing incarnations it registered in a previous life) and a
+    declared death moves the epoch into the fenced set — a ``resume``
+    or frame carrying a fenced epoch is dropped and counted, never
+    applied."""
+
+    def __init__(self, gcs_store=None):
+        self._lock = threading.Lock()
+        self._gcs_store = gcs_store
+        self._epoch_counter = 0
+        if gcs_store is not None:
+            self._epoch_counter = gcs_store.max_node_epoch()
+        #: node_id hex -> live NodeLiveness (current incarnation only).
+        self._live: Dict[str, NodeLiveness] = {}
+        #: Epochs whose incarnation was declared dead: any frame or
+        #: resume stamped with one of these is fenced.
+        self._fenced_epochs: set = set()
+        self._subscribers: List[Callable[[dict], None]] = []
+        #: Monotonic event version (serve/train long-pollers compare it).
+        self.version = 0
+
+    # -- epochs ---------------------------------------------------------
+
+    def mint_epoch(self, node_id_hex: str,
+                   probe_period_s: float = 0.25) -> int:
+        """Register a (new incarnation of a) node: next epoch, recorded
+        durably when a gcs_store is attached."""
+        with self._lock:
+            self._epoch_counter += 1
+            epoch = self._epoch_counter
+            if self._gcs_store is not None:
+                try:
+                    self._gcs_store.record_node_epoch(node_id_hex, epoch)
+                except OSError:
+                    logger.exception("could not persist node epoch")
+            self._live[node_id_hex] = NodeLiveness(
+                node_id_hex, epoch, probe_period_s=probe_period_s)
+            self.version += 1
+        self._publish({"event": "joined", "node_id": node_id_hex,
+                       "epoch": epoch})
+        return epoch
+
+    def current_epoch(self, node_id_hex: str) -> Optional[int]:
+        with self._lock:
+            live = self._live.get(node_id_hex)
+            return live.epoch if live is not None else None
+
+    def is_fenced(self, epoch: int) -> bool:
+        """True for an epoch whose incarnation was DECLARED DEAD here.
+
+        Deliberately narrow: an epoch this head never minted (a daemon
+        re-registering across a head restart) is NOT fenced — that
+        daemon's resident actors are exactly what the gcs_store rebind
+        path exists to recover. Fencing targets one thing only: an
+        incarnation whose lease this head expired coming back from the
+        far side of a partition."""
+        if epoch <= 0:
+            return False  # 0 = epoch unknown/not yet learned
+        with self._lock:
+            return epoch in self._fenced_epochs
+
+    def declare_dead(self, node_id_hex: str, reason: str = "") -> bool:
+        """Fence the node's current incarnation. Returns True exactly
+        once per incarnation — the caller runs the death cascade only
+        on True, so a racing health sweep and channel-death handler
+        cannot declare the same incarnation dead twice."""
+        with self._lock:
+            live = self._live.pop(node_id_hex, None)
+            if live is None:
+                return False
+            self._fenced_epochs.add(live.epoch)
+            self.version += 1
+            epoch = live.epoch
+        self._publish({"event": "dead", "node_id": node_id_hex,
+                       "epoch": epoch, "reason": reason})
+        return True
+
+    # -- liveness -------------------------------------------------------
+
+    def liveness(self, node_id_hex: str) -> Optional[NodeLiveness]:
+        with self._lock:
+            return self._live.get(node_id_hex)
+
+    def record_arrival(self, node_id_hex: str) -> None:
+        live = self.liveness(node_id_hex)
+        if live is not None:
+            live.record_arrival()
+
+    # -- fan-out --------------------------------------------------------
+
+    def subscribe(self, fn: Callable[[dict], None]) -> None:
+        """In-process push subscription (serve controller, train
+        BackendExecutor). ``fn`` runs on the publisher's thread — it
+        must be quick and must not raise."""
+        with self._lock:
+            self._subscribers.append(fn)
+
+    def unsubscribe(self, fn: Callable[[dict], None]) -> None:
+        with self._lock:
+            try:
+                self._subscribers.remove(fn)
+            except ValueError:
+                pass
+
+    def _publish(self, event: dict) -> None:
+        with self._lock:
+            subs = list(self._subscribers)
+        for fn in subs:
+            try:
+                fn(dict(event))
+            except Exception:  # noqa: BLE001 - one bad subscriber must
+                # not break membership bookkeeping for the rest.
+                logger.exception("membership subscriber failed")

@@ -48,6 +48,7 @@ import traceback
 from time import monotonic as _monotonic
 from typing import Any, Dict, Optional, Tuple
 
+from ray_tpu._private import chaos as _chaos
 from ray_tpu._private import procinfo
 from ray_tpu._private import wire as _wire
 
@@ -457,6 +458,10 @@ class NodeConnection:
         self._shipped_functions: set = set()
         self.node_id = None  # set at registration
         self._on_death = None
+        # Set by HeadServer: a broken session channel wakes the
+        # membership loop NOW — a SIGKILLed daemon is probed (and
+        # declared dead) in probe-timeout time, not on the next sweep.
+        self.on_channel_broken = None
         # Runtime hooks for daemon-pushed frames (no req_id — the recv
         # loop routes them here instead of the pending table).
         self.on_log_batch = None
@@ -575,7 +580,11 @@ class NodeConnection:
                     # Transient transport failure: the daemon re-dials
                     # and resumes within the reconnect window. Node
                     # death fires only when the window closes (or the
-                    # health sweep confirms the process is gone).
+                    # membership loop confirms the process is gone —
+                    # woken immediately via the hook).
+                    hook = self.on_channel_broken
+                    if hook is not None:
+                        hook()
                     if self.channel.wait_recovered():
                         continue
                     break
@@ -1046,19 +1055,22 @@ class HeadServer:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="ray_tpu-head-server",
             daemon=True)
-        # Liveness probing (reference: gcs_health_check_manager.h — the
-        # GCS health-checks every raylet): EOF catches a dead process,
-        # but a HUNG daemon keeps its socket open; periodic pings with a
-        # miss threshold convert that into node death too.
-        self._hb_period = float(
-            runtime.config.health_check_period_ms) / 1000.0
-        self._hb_timeout = float(
-            getattr(runtime.config, "health_check_timeout_ms",
-                    10 * runtime.config.health_check_period_ms)) / 1000.0
-        self._hb_threshold = int(
-            runtime.config.health_check_failure_threshold)
+        # Liveness (reference: gcs_health_check_manager.h, upgraded to
+        # accrual suspicion + a hard lease — _private/membership.py):
+        # EOF catches a dead process; the per-period health probe plus
+        # free channel-frame evidence feed each node's phi score, so a
+        # hung daemon crosses the suspicion threshold (or the lease)
+        # instead of a fixed miss count. A broken session channel sets
+        # _probe_wake for an immediate probe (sub-second SIGKILL
+        # detection at the 0.25s default period).
+        cfg = runtime.config
+        self._probe_period = float(cfg.health_probe_period_s)
+        self._probe_timeout = float(cfg.health_probe_timeout_s)
+        self._lease_s = float(cfg.node_lease_s)
+        self._suspicion = float(cfg.node_suspicion_threshold)
+        self._probe_wake = threading.Event()
         self._hb_thread = threading.Thread(
-            target=self._health_check_loop, name="ray_tpu-head-health",
+            target=self._membership_loop, name="ray_tpu-head-health",
             daemon=True)
         # Cluster-wide usage view fed by daemon pong piggybacks
         # (reference: ray_syncer receiver side in the GCS).
@@ -1067,98 +1079,136 @@ class HeadServer:
 
     def start(self) -> Tuple[str, int]:
         self._accept_thread.start()
-        if self._hb_period > 0:
+        if self._probe_period > 0:
             self._hb_thread.start()
         return self.address
 
-    def _health_check_loop(self) -> None:
-        """Sequential sweep with per-node socket timeouts: simple and
-        correct for the node counts this head targets; many
-        simultaneously-hung nodes would stretch a sweep (the reference
-        uses per-node async timers for that regime)."""
-        import time
-        misses: Dict[Any, int] = {}
+    def _membership_loop(self) -> None:
+        """Suspicion-driven liveness (see _private/membership.py).
+
+        Every ``health_probe_period_s`` (or immediately, when a broken
+        session channel sets ``_probe_wake``): fold channel activity
+        into each node's accrual detector — frames are free liveness
+        evidence, no probe needed for a chatty node — then ping the
+        dedicated health socket with ``health_probe_timeout_s``.
+        Failures classify HARD (reset/refused while the session channel
+        is also broken: the process is gone, declare now) or SOFT
+        (timeout or blackholed partition: evidence feeding the phi
+        score). Death fires at ``node_suspicion_threshold`` or,
+        unconditionally, once silence exceeds ``node_lease_s``."""
         digest_sent: Dict[Any, int] = {}
-        # A daemon that never opens its health channel gets this long
-        # before it's declared unobservable (covers hang-before-connect).
-        channel_grace = self._hb_period * (self._hb_threshold + 5)
         from ray_tpu._private.event_stats import GLOBAL
         while not self._closed:
-            time.sleep(self._hb_period)
-            sweep_timer = GLOBAL.timed("head.health_sweep")
-            sweep_timer.__enter__()
-            current = list(self._conns.items())
-            # Departed nodes (EOF path, grace kill) must not leak entries.
-            alive_ids = {nid for nid, _ in current}
-            for nid in list(misses):
-                if nid not in alive_ids:
-                    misses.pop(nid, None)
-            for nid in list(digest_sent):
-                if nid not in alive_ids:
-                    digest_sent.pop(nid, None)
-            # One digest per sweep, shipped to a node only when newer
-            # than what it last acked (the only-changed rule the
-            # daemon->head direction already follows).
-            digest = self.syncer.digest()
-            for node_id, conn in current:
-                hc = conn.health_sock
-                if hc is None:
-                    if time.monotonic() - conn.registered_at > \
-                            channel_grace:
-                        logger.warning(
-                            "Node %s never opened its health channel; "
-                            "declaring it dead", node_id.hex()[:12])
-                        conn.close()
-                    continue  # channel still connecting — grace period
-                try:
-                    # Tiny frames on the dedicated socket: bounded by the
-                    # socket timeout, never queued behind data transfers
-                    # and never contending for the data send lock.
-                    hc.settimeout(self._hb_timeout)
-                    ping: dict = {"type": "ping"}
-                    if digest["version"] > digest_sent.get(node_id, -1):
-                        ping["cluster_digest"] = digest
-                    _send_frame(hc, _dumps(ping))
-                    pong = _loads(_recv_frame(hc))
-                    if "cluster_digest" in ping:
-                        digest_sent[node_id] = digest["version"]
-                    sync = pong.get("sync")
-                    if sync:
-                        self.syncer.apply(node_id.hex(), sync)
-                    misses[node_id] = 0
-                except (OSError, ConnectionError, TimeoutError):
-                    if conn.channel.broken:
-                        # Session channel broken AND the dedicated
-                        # health channel cannot reach the daemon: the
-                        # process is gone. Don't burn the rest of the
-                        # reconnect window waiting for a resume that
-                        # can never come.
-                        logger.warning(
-                            "Node %s: broken session channel and failed "
-                            "health ping; declaring it dead",
-                            node_id.hex()[:12])
-                        misses.pop(node_id, None)
-                        conn.close()  # → on_death → remove_node
-                        continue
-                    # A timed-out ping on a node whose DATA channel
-                    # delivered a frame within the timeout window is a
-                    # starved health thread, not a dead node (GB-scale
-                    # transfers on oversubscribed hosts do this). Falsely
-                    # declaring death here cancels in-flight tasks and
-                    # triggers object reconstruction — far worse than a
-                    # late detection.
-                    if time.monotonic() - conn.last_frame_at \
-                            < self._hb_timeout:
-                        misses[node_id] = 0
-                        continue
-                    misses[node_id] = misses.get(node_id, 0) + 1
-                    if misses[node_id] >= self._hb_threshold:
-                        logger.warning(
-                            "Node %s missed %d health checks; declaring "
-                            "it dead", node_id.hex()[:12],
-                            misses.pop(node_id))
-                        conn.close()  # → on_death → remove_node
-            sweep_timer.__exit__()
+            self._probe_wake.wait(self._probe_period)
+            self._probe_wake.clear()
+            if self._closed:
+                return
+            with GLOBAL.timed("head.health_sweep"):
+                current = list(self._conns.items())
+                # Departed nodes (EOF path) must not leak entries.
+                alive_ids = {nid for nid, _ in current}
+                for nid in list(digest_sent):
+                    if nid not in alive_ids:
+                        digest_sent.pop(nid, None)
+                # One digest per sweep, shipped to a node only when
+                # newer than what it last acked (the only-changed rule
+                # the daemon->head direction already follows).
+                digest = self.syncer.digest()
+                for node_id, conn in current:
+                    self._probe_node(node_id, conn, digest, digest_sent)
+
+    def _probe_node(self, node_id, conn: NodeConnection, digest: dict,
+                    digest_sent: Dict[Any, int]) -> None:
+        import time
+        membership = self.runtime.membership
+        live = membership.liveness(node_id.hex())
+        if live is None:
+            return  # already declared dead (racing close)
+        # Channel traffic is free liveness: any frame batch the recv
+        # loop saw since our last look counts as an arrival — a node
+        # mid-transfer (or mid-XLA-compile, pushing metrics_batch
+        # heartbeats) never needs its ping answered to stay alive.
+        if conn.last_frame_at > live.detector.last_arrival:
+            live.record_arrival(conn.last_frame_at)
+        hc = conn.health_sock
+        hard = soft = None
+        if hc is None:
+            # Health channel still connecting: no probe possible — only
+            # the hard lease bounds how long we wait for it.
+            if time.monotonic() - max(conn.registered_at,
+                                      live.detector.last_arrival) \
+                    > self._lease_s:
+                if membership.declare_dead(
+                        node_id.hex(), "no health channel within lease"):
+                    from ray_tpu._private import builtin_metrics
+                    builtin_metrics.node_deaths().inc(
+                        tags={"kind": "lease"})
+                    logger.warning(
+                        "Node %s never opened its health channel within "
+                        "the %.1fs lease; declaring it dead",
+                        node_id.hex()[:12], self._lease_s)
+                    conn.close()
+            return
+        try:
+            # Tiny frames on the dedicated socket: bounded by the socket
+            # timeout, never queued behind data transfers and never
+            # contending for the data send lock.
+            hc.settimeout(self._probe_timeout)
+            if _chaos.ACTIVE:
+                _chaos.maybe_inject("head.health.send", hc)
+            ping: dict = {"type": "ping"}
+            if digest["version"] > digest_sent.get(node_id, -1):
+                ping["cluster_digest"] = digest
+            _send_frame(hc, _dumps(ping))
+            if _chaos.ACTIVE:
+                _chaos.maybe_inject("head.health.recv", hc)
+            pong = _loads(_recv_frame(hc))
+            if "cluster_digest" in ping:
+                digest_sent[node_id] = digest["version"]
+            sync = pong.get("sync")
+            if sync:
+                self.syncer.apply(node_id.hex(), sync)
+            live.record_arrival()
+            return
+        except (_chaos.ChaosPartition, TimeoutError) as exc:
+            # Unreachable, not provably dead: a partition heals, a
+            # starved pong thread recovers. Evidence, not a verdict.
+            soft = exc
+        except (ConnectionError, OSError) as exc:
+            hard = exc
+        if hard is not None and conn.channel.broken:
+            # Session channel broken AND the dedicated health socket
+            # actively refused/reset: the process is gone. Declare now
+            # instead of burning the reconnect window waiting for a
+            # resume that can never come.
+            if membership.declare_dead(
+                    node_id.hex(), f"process gone: {hard}"):
+                from ray_tpu._private import builtin_metrics
+                builtin_metrics.node_deaths().inc(tags={"kind": "hard"})
+                logger.warning(
+                    "Node %s: broken session channel and failed health "
+                    "ping (%s); declaring it dead",
+                    node_id.hex()[:12], hard)
+                conn.close()  # → on_death → remove_node
+            return
+        live.soft_failures += 1
+        now = time.monotonic()
+        silent = live.silent_for(now)
+        phi = live.phi(now)
+        if silent <= self._lease_s and phi < self._suspicion:
+            return
+        kind = "lease" if silent > self._lease_s else "suspicion"
+        if membership.declare_dead(
+                node_id.hex(),
+                f"{kind}: phi={phi:.1f} silent={silent:.2f}s "
+                f"soft_failures={live.soft_failures}"):
+            from ray_tpu._private import builtin_metrics
+            builtin_metrics.node_deaths().inc(tags={"kind": kind})
+            logger.warning(
+                "Node %s declared dead (%s: phi=%.1f after %.2fs of "
+                "silence, %d failed probes)", node_id.hex()[:12], kind,
+                phi, silent, live.soft_failures)
+            conn.close()  # → on_death → remove_node
 
     def _accept_loop(self) -> None:
         while not self._closed:
@@ -1238,6 +1288,12 @@ class HeadServer:
                         conn.health_sock = sock
                         break
                 else:
+                    # A declared-dead (or never-known) incarnation's
+                    # health thread re-announcing: fence it — counted,
+                    # not warned per-announce (a partitioned daemon's
+                    # reconnect loop would spam the log).
+                    from ray_tpu._private import builtin_metrics
+                    builtin_metrics.frames_fenced().inc()
                     sock.close()
                 return
             assert register["type"] == "register", register
@@ -1279,23 +1335,34 @@ class HeadServer:
             # instead; the sender thread does not take that lock.)
             node_id = self.runtime.new_node_id()
             conn.node_id = node_id
+            # Mint this incarnation's epoch (fenced membership, wire
+            # v9) and stamp the channel BEFORE the ack goes out: every
+            # enveloped frame of this session carries the epoch, and
+            # the ack teaches the daemon its incarnation.
+            epoch = self.runtime.membership.mint_epoch(
+                node_id.hex(), probe_period_s=self._probe_period or 0.25)
+            conn.channel.epoch = epoch
             # session_id rides the ack (additive optional field) so the
             # daemon can join the session's log directory tree.
             conn._sender.send({"type": "registered",
                                "node_id": node_id.hex(),
                                "session_id": self.runtime.session_id,
-                               "channel_token": conn.channel_token})
+                               "channel_token": conn.channel_token,
+                               "node_epoch": epoch})
             # dispatch=False: the post-ack _dispatch below places
             # queued work once the reply pump is running.
             self.runtime.register_remote_node(
                 conn, register, dispatch=False, node_id=node_id)
             conn._on_death = self._on_conn_death
+            conn.on_channel_broken = self._probe_wake.set
             self._conns[node_id] = conn
         except Exception:  # noqa: BLE001 - one bad join must not
             # strand a half-registered node.
             if node_id is not None:
                 self._conns.pop(node_id, None)
                 try:
+                    self.runtime.membership.declare_dead(
+                        node_id.hex(), "registration failed")
                     self.runtime.unregister_remote_node(node_id)
                 except Exception:  # noqa: BLE001
                     logger.exception("rollback of failed node "
@@ -1339,6 +1406,24 @@ class HeadServer:
         except _wire.ProtocolMismatch as exc:
             _send_frame_best_effort(sock, _dumps({
                 "type": "resume_rejected", "error": str(exc)}))
+            sock.close()
+            return
+        epoch = int(register.get("epoch") or 0)
+        if epoch and self.runtime.membership.is_fenced(epoch):
+            # A declared-dead incarnation back from the far side of a
+            # partition: its session (and its actors) died exactly once
+            # when the lease expired. The FENCED verdict (vs a generic
+            # rejection) tells the daemon to drop its stale residents
+            # and re-register as a fresh incarnation.
+            from ray_tpu._private import builtin_metrics
+            builtin_metrics.frames_fenced().inc()
+            logger.info(
+                "Fencing resume from dead incarnation %d of node %s",
+                epoch, str(register.get("node_id"))[:12])
+            _send_frame_best_effort(sock, _dumps({
+                "type": "fenced", "epoch": epoch,
+                "error": "incarnation declared dead; re-register as a "
+                         "new node"}))
             sock.close()
             return
         conn = None
@@ -1388,6 +1473,11 @@ class HeadServer:
         self._conns.pop(conn.node_id, None)
         if conn.node_id is not None:
             self.syncer.remove_node(conn.node_id.hex())
+            # EOF/teardown paths reach here without the membership loop:
+            # fence the incarnation (exactly-once — a racing probe's
+            # declare_dead already returned True and this is a no-op).
+            self.runtime.membership.declare_dead(
+                conn.node_id.hex(), "connection closed")
         self.runtime.unregister_remote_node(conn.node_id)
 
     def event_stats(self):
@@ -1403,6 +1493,7 @@ class HeadServer:
         for the reconnect window so a restarted head (same port +
         gcs_store_path) can rebind them."""
         self._closed = True
+        self._probe_wake.set()  # membership loop exits promptly
         keep = set(keep_nodes or ())
         try:
             self._listener.close()
@@ -1804,6 +1895,11 @@ class NodeDaemon:
         self._reply_senders: Dict[Any, Any] = {}
         self._stop = threading.Event()
         self.node_id_hex: Optional[str] = None
+        # Incarnation epoch from the registration ack (wire v9): stamps
+        # every enveloped frame; carried by resume ("am I still this
+        # incarnation?") and by the next register as prev_epoch (so a
+        # head that fenced us can sweep any stale residue).
+        self._node_epoch = 0
         # Worker-process pool (reference: raylet WorkerPool): CPU tasks
         # run in real worker subprocesses by default — crash isolation
         # for the node; a segfaulting task kills one worker, not the
@@ -2560,9 +2656,13 @@ class NodeDaemon:
                 self.syncer_reporter.reset_peer()
                 self.cluster_digest.reset()
                 while not self._stop.is_set():
+                    if _chaos.ACTIVE:
+                        _chaos.maybe_inject("daemon.health.recv", hc)
                     ping = _loads(_recv_frame(hc))
                     self.cluster_digest.apply(
                         ping.get("cluster_digest"))
+                    if _chaos.ACTIVE:
+                        _chaos.maybe_inject("daemon.health.send", hc)
                     _send_frame(hc, _dumps(
                         {"type": "pong",
                          "sync": self.syncer_reporter.poll()}))
@@ -2701,6 +2801,10 @@ class NodeDaemon:
             "store_name": self._table.arena_name,
             # A restarted head (gcs persistence) rebinds these.
             "resident_actors": list(self._actors.keys()),
+            # Our previous incarnation (0 = first life): a head that
+            # fenced that epoch knows any residue we still carry is
+            # stale and must not be rebound.
+            "prev_epoch": self._node_epoch,
         }), self._send_lock)
         # Everything after the raw register frame flows through the
         # resilient channel (v7 seq envelopes): the head's first
@@ -2726,6 +2830,12 @@ class NodeDaemon:
         assert ack["type"] == "registered", ack
         self.node_id_hex = ack["node_id"]
         channel_token = ack.get("channel_token")
+        # Adopt the minted incarnation epoch (v9): every frame we send
+        # from here on is stamped with it, so a head that later fences
+        # this incarnation drops (and counts) stale frames instead of
+        # applying them.
+        self._node_epoch = int(ack.get("node_epoch") or 0)
+        chan.epoch = self._node_epoch
         self._session_registered = True
         logger.info("Registered with head %s as node %s",
                     self.head_address, self.node_id_hex[:12])
@@ -2845,13 +2955,37 @@ class NodeDaemon:
                                     socket.TCP_NODELAY, 1)
                 except OSError:
                     pass
+                if _chaos.ACTIVE:
+                    # A partition must blackhole the resume path too —
+                    # otherwise a "partitioned" daemon could quietly
+                    # re-attach mid-blackhole.
+                    _chaos.maybe_inject("daemon.resume.send", sock)
                 _send_frame(sock, _dumps({
                     "type": "resume",
                     "protocol": _wire.PROTOCOL_VERSION,
                     "node_id": self.node_id_hex,
                     "token": token,
+                    "epoch": self._node_epoch,
                     "last_seq": chan.in_seq}))
                 reply = _loads(_recv_frame(sock))
+                if reply.get("type") == "fenced":
+                    # This incarnation was declared dead while we were
+                    # unreachable; head-side, its actors died with it
+                    # (exactly once). Drop the stale residents NOW so
+                    # the coming re-registration cannot offer them for
+                    # rebinding — a restarted copy may already be
+                    # running elsewhere, and two live instances of one
+                    # detached actor is the split-brain this fence
+                    # exists to prevent.
+                    logger.warning(
+                        "session fenced (incarnation %d declared dead); "
+                        "dropping %d stale resident actors and "
+                        "re-registering", self._node_epoch,
+                        len(self._actors))
+                    self._actors.clear()
+                    self._actor_tpu_ids.clear()
+                    close_socket(sock)
+                    return False
                 if reply.get("type") != "resumed":
                     # Head restarted / node already declared dead: a
                     # full re-register is the right (and fast) path.

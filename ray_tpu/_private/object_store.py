@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
 from ray_tpu._private import builtin_metrics
+from ray_tpu._private.channel import Backoff
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import GetTimeoutError, ObjectFreedError, ObjectLostError
 
@@ -441,6 +442,7 @@ class ObjectStore:
         entry = self._entry(object_id)
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
+        busy_backoff = Backoff(initial=0.002, cap=0.05)
         while True:
             remaining = (None if deadline is None
                          else max(0.0, deadline - time.monotonic()))
@@ -469,7 +471,7 @@ class ObjectStore:
                     raise GetTimeoutError(
                         f"Get timed out waiting for remote object "
                         f"{object_id.hex()} after {timeout}s.")
-                time.sleep(0.01)
+                busy_backoff.sleep()
                 continue
             if fetch is None:
                 break
@@ -503,8 +505,9 @@ class ObjectStore:
                     grace = time.monotonic() + 10.0
                     if deadline is not None:
                         grace = min(grace, deadline)
+                    settle_backoff = Backoff(initial=0.002, cap=0.05)
                     while not raced and time.monotonic() < grace:
-                        time.sleep(0.01)
+                        settle_backoff.sleep()
                         with self._lock:
                             raced = (entry.remote_fetch is not fetch
                                      or not entry.event.is_set())

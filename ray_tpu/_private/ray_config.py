@@ -50,6 +50,17 @@ _PY_DEFAULTS: Dict[str, Any] = {
     "health_check_timeout_ms": 10000,
     "health_check_failure_threshold": 5,
     "node_death_grace_ms": 0,
+    # Fenced membership / fast failure detection (wire v9, see
+    # _private/membership.py): the head probes each node's health
+    # socket every period with this timeout; channel frames feed the
+    # accrual detector for free. Death fires when the suspicion score
+    # (phi, a -log10 improbability of the observed silence) crosses the
+    # threshold, or unconditionally once a node is silent past the hard
+    # lease.
+    "health_probe_timeout_s": 1.0,
+    "health_probe_period_s": 0.25,
+    "node_lease_s": 10.0,
+    "node_suspicion_threshold": 8.0,
     # Resilient session channels (wire v7): a broken head<->daemon
     # socket is re-dialed and resumed within this window before node
     # death fires; unacked frames wait in a ring of this many bytes.

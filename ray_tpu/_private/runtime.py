@@ -568,6 +568,15 @@ class Runtime:
                 "status": "RUNNING",
                 "start_time": time.time(),
             })
+        # Fenced membership (wire v9, _private/membership.py): every
+        # daemon registration mints an incarnation epoch here; the
+        # HeadServer's suspicion loop and death paths declare through
+        # this table (exactly-once per incarnation), and join/death
+        # events fan out to in-process subscribers (serve controller,
+        # train executor) plus the "membership" pubsub channel.
+        from ray_tpu._private.membership import MembershipTable
+        self.membership = MembershipTable(self.gcs_store)
+        self.membership.subscribe(self._membership_event)
         # Deferred-free queue: ObjectRef.__del__ can fire at any point —
         # including inside the store's non-reentrant lock when a freed value
         # drops the last handle to another object — so handle-death frees
@@ -2789,6 +2798,16 @@ class Runtime:
     # subscribes there.
     # ------------------------------------------------------------------
 
+    def _membership_event(self, event: dict) -> None:
+        """Membership fan-out sink (subscribed at init): node join/death
+        events reach long-poll consumers on the "membership" pubsub
+        channel keyed by node id — serve controllers and train executors
+        react to a push instead of discovering death via their next
+        failed RPC. Runs on the declarer's thread: publish only."""
+        import json
+        self.pubsub.publish("membership", str(event.get("node_id", "")),
+                            json.dumps(event))
+
     def _publish_log_batch(self, batch: dict) -> bool:
         """Head-local LogMonitor sink: stamp head identity, fan out."""
         import json
@@ -2935,12 +2954,36 @@ class Runtime:
         # A daemon reconnecting to a RESTARTED head announces the actor
         # instances it still hosts; rebind the persisted named ones so
         # get_actor(name) answers again (reference: GCS restart +
-        # RayletNotifyGCSRestart resubscription).
-        for actor_hex in (info or {}).get("resident_actors") or []:
-            try:
-                self._rebind_remote_actor(conn, node_id, actor_hex)
-            except Exception:  # noqa: BLE001 - best effort per actor
-                logger.exception("failed to rebind actor %s", actor_hex)
+        # RayletNotifyGCSRestart resubscription). EXCEPT when the
+        # daemon's previous incarnation was fenced (declared dead after
+        # a partition): those residents died exactly once with that
+        # incarnation — a restarted copy may already run elsewhere, so
+        # rebinding (or even leaving) the stale instances would
+        # double-run detached-actor side effects. Destroy them instead.
+        residents = (info or {}).get("resident_actors") or []
+        prev_epoch = int((info or {}).get("prev_epoch") or 0)
+        if residents and prev_epoch and \
+                self.membership.is_fenced(prev_epoch):
+            logger.warning(
+                "Node %s re-registered from fenced incarnation %d: "
+                "destroying %d stale resident actor(s) instead of "
+                "rebinding", node_id.hex()[:12], prev_epoch,
+                len(residents))
+            stale_ids = [ActorID(bytes.fromhex(h)) for h in residents]
+            # Deferred: the handshake path calls with dispatch=False and
+            # the registration ack must reach the daemon first (see the
+            # stale-name destroy below for the same pattern).
+            threading.Thread(
+                target=lambda: [conn.destroy_actor(aid)
+                                for aid in stale_ids],
+                name="ray_tpu-fenced-actor-destroy", daemon=True).start()
+        else:
+            for actor_hex in residents:
+                try:
+                    self._rebind_remote_actor(conn, node_id, actor_hex)
+                except Exception:  # noqa: BLE001 - best effort per actor
+                    logger.exception("failed to rebind actor %s",
+                                     actor_hex)
         self.scheduler.reschedule_lost_bundles()
         if dispatch:
             # NOT under the caller's conn._send_lock (the handshake path
@@ -3275,10 +3318,18 @@ class Runtime:
         if not isinstance(exc, RemoteNodeDiedError):
             return False
         import time as _time
-        for _ in range(100):
+
+        from ray_tpu._private.channel import Backoff
+        # Jittered backoff, not a fixed-cadence spin: the death handler
+        # usually invalidates within a millisecond or two, and under a
+        # mass node death dozens of waiter threads polling in lockstep
+        # contend on the spec locks the handler needs.
+        bo = Backoff(0.002, 0.1)
+        deadline = _time.monotonic() + 5.0
+        while _time.monotonic() < deadline:
             if getattr(spec, "invalidated", False):
                 return True
-            _time.sleep(0.05)
+            bo.sleep()
         return bool(getattr(spec, "invalidated", False))
 
     def remove_node(self, node_id: NodeID) -> None:

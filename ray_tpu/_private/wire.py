@@ -45,7 +45,12 @@ from typing import Any, Dict, Tuple
 #: v8: object_spilled / object_unspilled frames (daemon -> head durable
 #: spill-location announcements feeding tiered object recovery) — a v7
 #: head would reject the unknown type in validate_message.
-PROTOCOL_VERSION = 8
+#: v9: fenced membership — the seq envelope grows a u32 node_epoch
+#: field (a v8 peer would misparse every enveloped frame), the
+#: registered ack and the resume handshake carry the incarnation epoch,
+#: and a new raw ``fenced`` reply rejects resumes from declared-dead
+#: incarnations (the daemon must re-register as a new incarnation).
+PROTOCOL_VERSION = 9
 
 
 class WireSchemaError(ValueError):
@@ -75,9 +80,15 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
         "object_addr": (_LIST, False),
         "store_name": (_OPT_STR, False),
         "resident_actors": (_LIST, False),
+        # The daemon's previous incarnation epoch (0 = first join): a
+        # returning daemon whose old epoch was fenced must not have its
+        # stale resident actors rebound (they were declared dead when
+        # the lease expired — rebinding would resurrect zombies).
+        "prev_epoch": (_INT, False),
     },
     "registered": {"node_id": (_STR, True),
-                   "channel_token": (_OPT_STR, False)},
+                   "channel_token": (_OPT_STR, False),
+                   "node_epoch": (_INT, False)},
     "register_rejected": {"error": (_STR, True),
                           "head_protocol": (_INT, True)},
     # -- channel resume (raw, un-enveloped handshake frames; v7) -------
@@ -86,9 +97,15 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
         "node_id": (_STR, True),
         "token": (_STR, True),
         "last_seq": (_INT, True),
+        "epoch": (_INT, False),
     },
     "resumed": {"last_seq": (_INT, True)},
     "resume_rejected": {"error": (_STR, True)},
+    # A resume (or frame) from a declared-dead incarnation: the daemon
+    # must drop its session state and re-register as a NEW incarnation
+    # (v9 membership fencing — distinct from resume_rejected so the
+    # daemon knows its resident actors were already declared dead).
+    "fenced": {"error": (_STR, True), "epoch": (_INT, False)},
     "health_channel": {"node_id": (_STR, True)},
     "client_runtime": {},  # fields owned by client_runtime.py
     "client_registered": {"job_id": (_STR, True),
@@ -327,12 +344,15 @@ MAGIC_TYPED = 0x01
 MAGIC_BATCH = 0x02
 MAGIC_SEQ = 0x03
 
-# Seq envelope (v7): (magic, seq u64, ack u64) prefix on every
-# post-handshake session frame. seq is the sender's monotonic frame
-# number (0 = pure ack, empty inner payload); ack is the highest seq
-# the sender has received from the peer (cumulative, prunes the peer's
-# resend ring).
-_SEQ = _struct.Struct(">BQQ")
+# Seq envelope (v7, extended v9): (magic, seq u64, ack u64, epoch u32)
+# prefix on every post-handshake session frame. seq is the sender's
+# monotonic frame number (0 = pure ack, empty inner payload); ack is
+# the highest seq the sender has received from the peer (cumulative,
+# prunes the peer's resend ring); epoch is the session incarnation's
+# node_epoch (v9 fencing: a frame stamped with a stale incarnation is
+# dropped and counted, never applied; 0 = epoch not yet learned,
+# pre-registration handshake traffic only).
+_SEQ = _struct.Struct(">BQQI")
 
 
 #: Size of the seq envelope; channel pre-sizes its reusable header
@@ -340,23 +360,25 @@ _SEQ = _struct.Struct(">BQQ")
 SEQ_SIZE = _SEQ.size
 
 
-def pack_seq_into(buf, offset: int, seq: int, ack: int) -> None:
-    """Pack the v7 seq envelope into a caller-owned header buffer
+def pack_seq_into(buf, offset: int, seq: int, ack: int,
+                  epoch: int = 0) -> None:
+    """Pack the seq envelope into a caller-owned header buffer
     (zero-copy framing: the payload is never re-materialized to prepend
     the envelope)."""
-    _SEQ.pack_into(buf, offset, MAGIC_SEQ, seq, ack)
+    _SEQ.pack_into(buf, offset, MAGIC_SEQ, seq, ack, epoch)
 
 
-def wrap_seq(seq: int, ack: int, payload: bytes) -> bytes:
-    """Prefix a frame payload with the v7 seq envelope."""
-    return _SEQ.pack(MAGIC_SEQ, seq, ack) + payload
+def wrap_seq(seq: int, ack: int, payload: bytes, epoch: int = 0) -> bytes:
+    """Prefix a frame payload with the seq envelope."""
+    return _SEQ.pack(MAGIC_SEQ, seq, ack, epoch) + payload
 
 
 def unwrap_seq(payload: bytes):
-    """(seq, ack, inner) for enveloped frames, None for raw ones."""
+    """(seq, ack, epoch, inner) for enveloped frames, None for raw
+    ones."""
     if len(payload) >= _SEQ.size and payload[0] == MAGIC_SEQ:
-        _, seq, ack = _SEQ.unpack_from(payload)
-        return seq, ack, payload[_SEQ.size:]
+        _, seq, ack, epoch = _SEQ.unpack_from(payload)
+        return seq, ack, epoch, payload[_SEQ.size:]
     return None
 
 _OP_EXECUTE_TASK = 0x01

@@ -105,6 +105,13 @@ class ServeController:
         self._changed = None
         self._reconcile_lock: Optional[asyncio.Lock] = None
         self._control_task = None
+        # Node-death push (membership subsystem): a declared node death
+        # wakes the control loop for an immediate health pass instead
+        # of waiting out the rest of the period — replicas on the dead
+        # node are replaced in push-latency, not poll-latency.
+        self._node_event: Optional[asyncio.Event] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._membership_subscribed = False
 
     def _bump_membership(self) -> None:
         self._membership_version += 1
@@ -118,8 +125,40 @@ class ServeController:
         before the actor's event loop owns this coroutine context)."""
         if self._reconcile_lock is None:
             self._reconcile_lock = asyncio.Lock()
+        if self._node_event is None:
+            self._node_event = asyncio.Event()
+            self._loop = asyncio.get_event_loop()
+        if not self._membership_subscribed:
+            self._membership_subscribed = True
+            self._subscribe_membership()
         if self._control_task is None or self._control_task.done():
             self._control_task = asyncio.ensure_future(self._control_loop())
+
+    def _subscribe_membership(self) -> None:
+        """Subscribe to the head runtime's membership table when it is
+        reachable in-process (the controller is a head-resident actor).
+        Best effort: without it the control loop still catches node
+        death on its next periodic pass."""
+        try:
+            from ray_tpu._private.worker import global_worker
+            membership = getattr(global_worker._runtime, "membership",
+                                 None)
+        except Exception:  # noqa: BLE001 - no in-process runtime
+            membership = None
+        if membership is not None:
+            membership.subscribe(self._on_membership_event)
+
+    def _on_membership_event(self, event: dict) -> None:
+        """Runs on the DECLARER's thread (membership fan-out): hop to
+        the controller's event loop and wake the control loop."""
+        if event.get("event") != "dead":
+            return
+        loop, ev = self._loop, self._node_event
+        if loop is not None and ev is not None:
+            try:
+                loop.call_soon_threadsafe(ev.set)
+            except RuntimeError:
+                pass  # loop already closed (controller shutting down)
 
     # -- desired state ---------------------------------------------------
 
@@ -360,8 +399,17 @@ class ServeController:
 
     async def _control_loop(self) -> None:
         while True:
-            await asyncio.sleep(
-                serve_config("serve_health_check_period_s", 1.0))
+            # Period-bounded wait that a membership death push cuts
+            # short: replicas on a declared-dead node are probed (and
+            # replaced) immediately.
+            try:
+                await asyncio.wait_for(
+                    self._node_event.wait(),
+                    timeout=serve_config(
+                        "serve_health_check_period_s", 1.0))
+            except asyncio.TimeoutError:
+                pass
+            self._node_event.clear()
             try:
                 await self._health_pass()
                 await self._drain_pass()

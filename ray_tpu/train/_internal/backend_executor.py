@@ -35,6 +35,7 @@ the cluster shrank.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -92,6 +93,10 @@ class BackendExecutor:
         self.checkpoint_manager = checkpoint_manager
         self.worker_group: Optional[WorkerGroup] = None
         self._num_workers = scaling_config.num_workers
+        # Set by a membership death push: the result gather probes
+        # pending ranks immediately instead of waiting out the full
+        # train_hang_timeout_s.
+        self._node_death = threading.Event()
 
     def start(self, num_workers: Optional[int] = None) -> None:
         if num_workers is not None:
@@ -121,28 +126,53 @@ class BackendExecutor:
         """
         failures_left = self.failure_config.max_failures
         restart_backoff = Backoff(initial=0.5, cap=10.0)
-        while True:
-            try:
-                return self._run_once(train_fn, config, trial_info,
-                                      checkpoint, dataset_shards_per_worker,
-                                      result_callback)
-            except TrainingFailedError as e:
-                latest = getattr(e, "latest_checkpoint", None)
-                if failures_left == 0:
-                    raise
-                failures_left -= 1 if failures_left > 0 else 0
-                cause = getattr(e, "cause_kind", "app")
-                _count_gang_restart(cause)
-                logger.warning(
-                    "Training failed (%s, cause=%s); gang-restarting worker "
-                    "group from %s (%s retries left)", e, cause, latest,
-                    "inf" if failures_left < 0 else failures_left)
-                checkpoint = latest or checkpoint
-                self.shutdown()
-                # Jittered pause so N drivers restarting against one
-                # shrunken cluster don't stampede the scheduler.
-                time.sleep(restart_backoff.next())
-                self._restart_elastic()
+        membership = self._subscribe_membership()
+        try:
+            while True:
+                try:
+                    return self._run_once(train_fn, config, trial_info,
+                                          checkpoint,
+                                          dataset_shards_per_worker,
+                                          result_callback)
+                except TrainingFailedError as e:
+                    latest = getattr(e, "latest_checkpoint", None)
+                    if failures_left == 0:
+                        raise
+                    failures_left -= 1 if failures_left > 0 else 0
+                    cause = getattr(e, "cause_kind", "app")
+                    _count_gang_restart(cause)
+                    logger.warning(
+                        "Training failed (%s, cause=%s); gang-restarting "
+                        "worker group from %s (%s retries left)", e, cause,
+                        latest,
+                        "inf" if failures_left < 0 else failures_left)
+                    checkpoint = latest or checkpoint
+                    self.shutdown()
+                    # Jittered pause so N drivers restarting against one
+                    # shrunken cluster don't stampede the scheduler.
+                    time.sleep(restart_backoff.next())
+                    self._restart_elastic()
+        finally:
+            if membership is not None:
+                membership.unsubscribe(self._on_membership_event)
+
+    def _subscribe_membership(self):
+        """Subscribe to the head's membership table for node-death
+        pushes when the driver runs in the head process. Best effort:
+        without it the hang-timeout probe still catches dead ranks."""
+        try:
+            from ray_tpu._private.worker import global_worker
+            membership = getattr(global_worker._runtime, "membership",
+                                 None)
+        except Exception:  # noqa: BLE001 - no in-process runtime
+            return None
+        if membership is not None:
+            membership.subscribe(self._on_membership_event)
+        return membership
+
+    def _on_membership_event(self, event: dict) -> None:
+        if event.get("event") == "dead":
+            self._node_death.set()
 
     # -- elastic restart ---------------------------------------------------
 
@@ -268,15 +298,23 @@ class BackendExecutor:
                         raise
                     on_payload(rank, payload)
                 continue
-            if hang_timeout > 0 and \
-                    time.monotonic() - last_progress >= hang_timeout:
+            pushed = self._node_death.is_set()
+            if pushed:
+                self._node_death.clear()
+            if pushed or (hang_timeout > 0 and
+                          time.monotonic() - last_progress >= hang_timeout):
+                # Probe now: either a membership death push arrived (a
+                # node this gang may live on was declared dead — no
+                # reason to wait out the hang timeout) or the gang has
+                # been silent past the timeout.
                 dead = self._probe_liveness(sorted(pending.values()),
-                                            hang_timeout)
+                                            hang_timeout or 5.0)
                 if dead:
+                    why = ("a node was declared dead" if pushed else
+                           f"no result for {hang_timeout}s")
                     exc = TimeoutError(
-                        f"train ranks {dead} produced no result for "
-                        f"{hang_timeout}s and failed their liveness "
-                        "probe")
+                        f"train ranks {dead} failed their liveness "
+                        f"probe ({why})")
                     raise self._system_failure(exc, latest_checkpoint)
                 # Alive but slow (XLA compile, giant step): keep waiting.
                 last_progress = time.monotonic()

@@ -107,6 +107,14 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     FLAG_INT(health_check_timeout_ms, 10000),
     FLAG_INT(health_check_failure_threshold, 5),
     FLAG_INT(node_death_grace_ms, 0),
+    // Fenced membership (wire v9, _private/membership.py): per-period
+    // health probes with a bounded timeout feed an accrual (phi)
+    // suspicion score; death at the phi threshold, or unconditionally
+    // at the hard lease.
+    FLAG_DBL(health_probe_timeout_s, 1.0),
+    FLAG_DBL(health_probe_period_s, 0.25),
+    FLAG_DBL(node_lease_s, 10.0),
+    FLAG_DBL(node_suspicion_threshold, 8.0),
     // Resilient session channels (wire v7): reconnect-and-resume
     // window before a broken channel escalates to node death, and the
     // byte budget of the unacked-frame resend ring.

@@ -34,6 +34,13 @@ runtime, so CI catches them statically:
    stores — spill bytes must flow through ``_private/spill.py``'s
    ``SpillBackend`` so crash-safe atomic writes, chaos injection, and
    failure accounting cover every spill path.
+8. Fixed-delay ``time.sleep(<constant>)`` inside a loop under
+   ``ray_tpu/_private/`` — a constant-period retry/poll loop has no
+   jitter (N waiters wake in lockstep and stampede whatever they are
+   polling) and no exponential growth (hot-spins at the constant rate
+   forever). Retry loops must pace themselves with ``channel.Backoff``
+   (jittered, capped, resettable); legitimate pacing sites compute
+   their delay (``next_tick - now``, ``ms / 1000``) and are untouched.
 """
 
 import ast
@@ -290,6 +297,46 @@ def test_no_direct_spill_io_outside_backend():
         "unlinks of spill files must go through a SpillBackend "
         "(ray_tpu/_private/spill.py) so atomicity, chaos injection, and "
         "failure accounting cover them: " + ", ".join(offenders))
+
+
+def _is_constant_time_sleep(node):
+    """A ``time.sleep(<numeric literal>)`` (also ``_time.sleep``) call —
+    the fingerprint of a fixed-period retry/poll loop. Computed delays
+    (``Backoff.next()``, ``next_tick - now``, ``ms / 1000.0``) don't
+    match."""
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if not (isinstance(func, ast.Attribute) and func.attr == "sleep" and
+            isinstance(func.value, ast.Name) and
+            func.value.id in ("time", "_time")):
+        return False
+    return bool(node.args) and isinstance(node.args[0], ast.Constant) and \
+        isinstance(node.args[0].value, (int, float))
+
+
+def test_no_fixed_sleep_retry_loops_in_private():
+    """No ``while ...: time.sleep(0.01)``-style loops in _private/:
+    a constant sleep in a loop is an unjittered, non-backing-off retry —
+    under contention every waiter wakes in lockstep and the loop spins
+    at full rate for its whole lifetime. Use ``channel.Backoff``
+    (jittered exponential with a cap) and call ``.sleep()``."""
+    offenders = []
+    for path in _py_files(os.path.join(PKG_ROOT, "_private")):
+        tree = _parse(path)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.While, ast.For)):
+                continue
+            for stmt in node.body:
+                for sub in ast.walk(stmt):
+                    if _is_constant_time_sleep(sub):
+                        rel = os.path.relpath(path, PKG_ROOT)
+                        offenders.append(f"{rel}:{sub.lineno}")
+    assert not offenders, (
+        "fixed-delay time.sleep(<constant>) inside a loop in "
+        "ray_tpu/_private/ — retry/poll loops must pace themselves with "
+        "the jittered channel.Backoff (backoff.sleep()), not a constant "
+        "period: " + ", ".join(sorted(set(offenders))))
 
 
 def test_no_bare_print_in_private():

@@ -302,13 +302,14 @@ def test_daemon_resident_value_reconstructed_on_death(
 
 def test_hung_daemon_detected_by_health_checks(ray_start_regular):
     """A SIGSTOPped daemon keeps its socket open but stops replying; the
-    head's health-check loop (gcs_health_check_manager analog) declares
-    it dead and the node leaves the cluster."""
+    head's membership loop (accrual suspicion + hard lease,
+    gcs_health_check_manager analog) declares it dead and the node
+    leaves the cluster."""
     ray_tpu.shutdown()
     ray_tpu.init(num_cpus=2, num_tpus=0,
-                 _system_config={"health_check_period_ms": 150,
-                                 "health_check_timeout_ms": 300,
-                                 "health_check_failure_threshold": 3})
+                 _system_config={"health_probe_period_s": 0.05,
+                                 "health_probe_timeout_s": 0.3,
+                                 "node_lease_s": 3.0})
     host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
     p = _spawn_daemon(port, num_cpus=2, resources={"remote": 2})
     try:

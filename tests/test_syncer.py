@@ -146,7 +146,7 @@ def test_cluster_usage_converges(ray_start_regular):
     objects."""
     ray_tpu.shutdown()
     ray_tpu.init(num_cpus=2, num_tpus=0,
-                 _system_config={"health_check_period_ms": 100,
+                 _system_config={"health_probe_period_s": 0.1,
                                  # Big results stay daemon-resident so
                                  # the object_store component has bytes.
                                  "remote_object_inline_limit_bytes": 1000})
@@ -193,8 +193,7 @@ def test_cluster_usage_converges(ray_start_regular):
 def test_cluster_usage_drops_dead_nodes(ray_start_regular):
     ray_tpu.shutdown()
     ray_tpu.init(num_cpus=2, num_tpus=0,
-                 _system_config={"health_check_period_ms": 100,
-                                 "health_check_failure_threshold": 3})
+                 _system_config={"health_probe_period_s": 0.1})
     host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
     p = _spawn_daemon(port, num_cpus=2, resources={"remote": 2})
     try:
@@ -224,7 +223,7 @@ def test_status_summary_includes_synced_usage(ray_start_regular):
     """`ray-tpu status` surfaces the gossiped per-node usage."""
     ray_tpu.shutdown()
     ray_tpu.init(num_cpus=2, num_tpus=0,
-                 _system_config={"health_check_period_ms": 100})
+                 _system_config={"health_probe_period_s": 0.1})
     host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
     p = _spawn_daemon(port, num_cpus=2, resources={"remote": 2})
     try:
