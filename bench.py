@@ -340,6 +340,86 @@ def bench_tracing_overhead() -> dict:
     return out
 
 
+def bench_timeseries_overhead() -> dict:
+    """Task throughput with the head time-series store ON (default
+    window, aggressive 0.5s export tick so samples actually land in the
+    rings) vs OFF (window 0 disables ingest entirely), plus the raw
+    ingest cost of the store itself. The `_per_sec` keys opt into the
+    regression auto-gate: the store must stay within noise of the
+    disabled path."""
+    import os
+    import time as _time
+
+    import ray_tpu
+
+    def _throughput() -> float:
+        @ray_tpu.remote
+        def tiny(i):
+            return i
+
+        ray_tpu.get([tiny.remote(i) for i in range(200)])  # warmup
+        n = 2000
+        best = 0.0
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            ray_tpu.get([tiny.remote(i) for i in range(n)])
+            best = max(best, n / (_time.perf_counter() - t0))
+        return best
+
+    export_key = "RAY_TPU_METRICS_EXPORT_INTERVAL_S"
+    window_key = "RAY_TPU_TIMESERIES_WINDOW_S"
+    prev = {k: os.environ.get(k) for k in (export_key, window_key)}
+    def _arm(window: str) -> float:
+        if window:
+            os.environ[window_key] = window
+        else:
+            os.environ.pop(window_key, None)  # default: store on
+        ray_tpu.init(num_cpus=8)
+        try:
+            return _throughput()
+        finally:
+            ray_tpu.shutdown()
+
+    try:
+        os.environ[export_key] = "0.5"
+        # Throwaway pass: the FIRST init in a process pays one-time
+        # costs (thread pools, lazy imports) that would otherwise be
+        # billed entirely to whichever arm runs first. Then alternate
+        # the arms so slow machine phases hit both equally.
+        _arm("")
+        on = off = 0.0
+        for _ in range(2):
+            on = max(on, _arm(""))
+            off = max(off, _arm("0"))
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    out = {"timeseries_on_tasks_per_sec": round(on, 1),
+           "timeseries_off_tasks_per_sec": round(off, 1)}
+    out["timeseries_overhead_pct"] = (
+        round(100.0 * (off - on) / off, 2) if off else None)
+
+    # Ingest microbench: cumulative counter samples pushed straight into
+    # a standalone store — the per-sample cost the metrics path pays.
+    from ray_tpu._private.timeseries import TimeSeriesStore
+    store = TimeSeriesStore(window_s=300, max_series=4096, staleness=600)
+    n = 10_000
+    entry = [{"name": "bench_ingest_total", "type": "counter", "desc": "",
+              "tag_keys": ("k",), "series": {}}]
+    t0 = _time.perf_counter()
+    base = _time.monotonic()
+    for i in range(n):
+        entry[0]["series"] = {(str(i % 64),): float(i)}
+        store.ingest_batch("bench", 1, "driver", entry,
+                           now=base + i * 0.001)
+    elapsed = _time.perf_counter() - t0
+    out["timeseries_ingest_samples_per_sec"] = round(n / elapsed, 1)
+    return out
+
+
 def bench_data_shuffle() -> dict:
     """Single-host shuffle throughput (reference:
     release_tests.yaml:3447 shuffle nightly — scaled to one host): a
@@ -1667,6 +1747,8 @@ def main(argv=None):
          bench_metrics_overhead),
         ("tracing_overhead", "tracing_overhead_pct",
          bench_tracing_overhead),
+        ("timeseries_overhead", "timeseries_overhead_pct",
+         bench_timeseries_overhead),
         ("frame_path", "frame_send_mb_per_sec", bench_frame_path),
     ]
     if on_tpu:

@@ -24,6 +24,8 @@ decrements by the amount it read rather than zeroing the cell.
 
 from __future__ import annotations
 
+from typing import Optional
+
 # ray_tpu.util.metrics is imported inside each accessor: importing it at
 # module scope would execute ray_tpu.util/__init__ (which pulls
 # placement_group -> _private.worker) while _private modules that
@@ -33,6 +35,10 @@ from __future__ import annotations
 
 _fast_task_events = {"SUBMITTED": 0, "RUNNING": 0, "FINISHED": 0,
                      "FAILED": 0}
+# (node_id_hex, status) -> count: per-node task transitions, the series
+# behind `ray-tpu top`'s per-node submit/finish rates. Unbounded only by
+# node count x 4 statuses.
+_fast_node_task_events: dict = {}
 _fast_store = {"hit": 0, "miss": 0}
 _fast_transfer = {"in": 0, "out": 0}
 _fast_chunks = {"n": 0}
@@ -86,6 +92,11 @@ def flush_fast_counters() -> None:
         if n:
             _fast_task_events[status] -= n
             _TASK_STATUS_COUNTERS[status]().inc(n)
+    for (node_hex, status), n in list(_fast_node_task_events.items()):
+        if n:
+            _fast_node_task_events[(node_hex, status)] -= n
+            node_task_events().inc(
+                n, tags={"node_id": node_hex, "status": status})
     for kind, n in list(_fast_store.items()):
         if n:
             _fast_store[kind] -= n
@@ -156,13 +167,29 @@ _TASK_STATUS_COUNTERS = {
 }
 
 
-def record_task_event(status: str) -> None:
+def node_task_events() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_node_task_events_total",
+        "Task state transitions attributed to the executing node; the "
+        "windowed rate per (node_id, status) feeds `ray-tpu top`'s "
+        "per-node submit/finish columns.",
+        tag_keys=("node_id", "status"))
+
+
+def record_task_event(status: str,
+                      node_hex: Optional[str] = None) -> None:
     """Map a task state transition onto its counter (no-op for statuses
     that are not terminal/throughput signals, e.g. OOM_RETRY). This is
-    on the per-task submit/execute fast path: one dict int add, folded
-    into the real counters by ``flush_fast_counters``."""
+    on the per-task submit/execute fast path: one dict int add (two
+    when the executing node is known), folded into the real counters by
+    ``flush_fast_counters``."""
     if status in _fast_task_events:
         _fast_task_events[status] += 1
+        if node_hex:
+            key = (node_hex, status)
+            _fast_node_task_events[key] = \
+                _fast_node_task_events.get(key, 0) + 1
 
 
 def scheduler_pending_tasks() -> Gauge:
@@ -401,6 +428,64 @@ def serve_shed() -> Counter:
         "Serve requests fast-failed with BackPressureError because the "
         "deployment's max_queued_requests cap was hit (HTTP 503 via "
         "the proxy).")
+
+
+# -- serve signal plane ----------------------------------------------------
+# Per-deployment traffic series the autoscaler reads from the head's
+# time-series store (qps, p95, queue depth, replica count). Incremented
+# from the router's assign/settle path — serve settles are not the task
+# hot path, so these touch the registry directly.
+
+
+def serve_requests() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_serve_requests_total",
+        "Serve requests settled (completed or raised), per deployment; "
+        "the windowed rate of this series is the deployment's qps.",
+        tag_keys=("deployment",))
+
+
+def serve_request_latency() -> "Histogram":
+    from ray_tpu.util.metrics import Histogram
+    return Histogram(
+        "ray_tpu_serve_request_latency_seconds",
+        "End-to-end serve request latency at the router (assign to "
+        "settle, including queueing and retries).",
+        boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0,
+                    2.5, 5.0, 10.0, 30.0),
+        tag_keys=("deployment",))
+
+
+def serve_queue_depth() -> Gauge:
+    from ray_tpu.util.metrics import Gauge
+    return Gauge(
+        "ray_tpu_serve_queue_depth",
+        "Outstanding (assigned, unsettled) serve requests at a router, "
+        "per deployment.",
+        tag_keys=("deployment",))
+
+
+def serve_replicas() -> Gauge:
+    from ray_tpu.util.metrics import Gauge
+    return Gauge(
+        "ray_tpu_serve_replicas",
+        "Replica count in the router's current routing table, per "
+        "deployment (refreshed on every controller long-poll).",
+        tag_keys=("deployment",))
+
+
+# -- control-loop saturation -----------------------------------------------
+
+
+def loop_lag() -> Gauge:
+    from ray_tpu.util.metrics import Gauge
+    return Gauge(
+        "ray_tpu_loop_lag_seconds",
+        "Scheduling lag of a control loop: how far past its intended "
+        "period/deadline the loop actually woke (head membership sweep, "
+        "dashboard asyncio loop, metrics agent ticks).",
+        tag_keys=("loop",))
 
 
 # -- train fault tolerance -------------------------------------------------

@@ -203,6 +203,21 @@ class MembershipTable:
         with self._lock:
             return self._live.get(node_id_hex)
 
+    def snapshot(self) -> List[dict]:
+        """Read-only view of every live incarnation for status surfaces
+        (``/api/cluster_status``, ``ray-tpu status``/``top``): epoch,
+        current phi suspicion, and the silence since the last liveness
+        arrival."""
+        with self._lock:
+            live = list(self._live.values())
+        now = time.monotonic()
+        return [{"node_id": lv.node_id_hex,
+                 "epoch": lv.epoch,
+                 "phi": round(lv.phi(now), 3),
+                 "last_heartbeat_age_s": round(lv.silent_for(now), 3),
+                 "soft_failures": lv.soft_failures}
+                for lv in live]
+
     def record_arrival(self, node_id_hex: str) -> None:
         live = self.liveness(node_id_hex)
         if live is not None:

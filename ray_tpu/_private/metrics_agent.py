@@ -113,7 +113,20 @@ class MetricsAgent:
         self._collectors.append(fn)
 
     def _loop(self) -> None:
-        while not self._stop_event.wait(self.interval_s):
+        from ray_tpu._private import builtin_metrics
+        while True:
+            t0 = time.monotonic()
+            if self._stop_event.wait(self.interval_s):
+                return
+            # Tick drift doubles as a per-process saturation gauge: a
+            # GIL-starved or blocked process wakes late and the lag
+            # series shows it cluster-wide.
+            lag = (time.monotonic() - t0) - self.interval_s
+            try:
+                builtin_metrics.loop_lag().set(
+                    max(0.0, lag), tags={"loop": f"agent.{self.component}"})
+            except Exception:  # noqa: BLE001 - gauge is best-effort
+                pass
             try:
                 self.poll_once()
             except Exception:  # noqa: BLE001 - export must never kill host
@@ -160,12 +173,15 @@ class MetricsAgent:
 
 
 class _Origin:
-    __slots__ = ("entries", "last_seen", "dead_at")
+    __slots__ = ("entries", "last_seen", "dead_at", "event_stats")
 
     def __init__(self):
         self.entries: Dict[str, Dict[str, Any]] = {}
         self.last_seen = time.monotonic()
         self.dead_at: Optional[float] = None
+        # Latest EventStats summary shipped inside this origin's
+        # metrics_batch frames (daemon control loops), if any.
+        self.event_stats: Optional[Dict[str, Any]] = None
 
 
 class ClusterMetrics:
@@ -173,6 +189,7 @@ class ClusterMetrics:
 
     def __init__(self, staleness: Optional[float] = None):
         from ray_tpu._private.trace_assembler import TraceAssembler
+        from ray_tpu._private.timeseries import TimeSeriesStore
         self._lock = threading.Lock()
         self._origins: Dict[Tuple[str, int, str], _Origin] = {}
         self._spans: deque = deque(maxlen=MAX_CLUSTER_SPANS)
@@ -180,6 +197,9 @@ class ClusterMetrics:
         # piggybacks) also feeds trace assembly, keyed by trace_id.
         self.traces = TraceAssembler()
         self.staleness = staleness_s() if staleness is None else staleness
+        # Windowed history behind runtime.get_timeseries / serve stats /
+        # `ray-tpu top` — every merged sample is also appended here.
+        self.timeseries = TimeSeriesStore(staleness=self.staleness)
 
     def update(self, node_id: str, batch: Dict[str, Any]) -> None:
         """Merge one ``metrics_batch`` payload. Cumulative values make the
@@ -214,6 +234,11 @@ class ClusterMetrics:
                 if entry.get("type") == "histogram":
                     for field in ("buckets", "sums", "counts"):
                         held[field].update(entry.get(field, {}))
+            stats = batch.get("event_stats")
+            if stats:
+                origin.event_stats = stats
+        self.timeseries.ingest_batch(
+            key[0], key[1], key[2], batch.get("metrics", ()))
         for span in batch.get("spans", ()):
             stamped = dict(span)
             stamped["node_id"] = node_id or ""
@@ -231,6 +256,7 @@ class ClusterMetrics:
             for (nid, _pid, _comp), origin in self._origins.items():
                 if nid == node_id and origin.dead_at is None:
                     origin.dead_at = now
+        self.timeseries.mark_node_dead(node_id)
 
     def evict_stale(self) -> None:
         now = time.monotonic()
@@ -240,6 +266,20 @@ class ClusterMetrics:
                     and now - origin.dead_at > self.staleness]
             for key in dead:
                 del self._origins[key]
+        self.timeseries.evict_stale()
+
+    def cluster_event_stats(self) -> Dict[str, Dict[str, Any]]:
+        """EventStats summaries shipped in metrics_batch frames, keyed
+        ``"<node_id>:<component>"`` (latest writer wins per handler)."""
+        with self._lock:
+            out: Dict[str, Dict[str, Any]] = {}
+            ordered = sorted(self._origins.items(),
+                             key=lambda kv: kv[1].last_seen)
+            for (nid, _pid, comp), origin in ordered:
+                if origin.event_stats:
+                    out.setdefault(f"{nid}:{comp}", {}).update(
+                        origin.event_stats)
+            return out
 
     def origins(self) -> List[Tuple[str, int, str]]:
         with self._lock:

@@ -1098,11 +1098,25 @@ class HeadServer:
         unconditionally, once silence exceeds ``node_lease_s``."""
         digest_sent: Dict[Any, int] = {}
         from ray_tpu._private.event_stats import GLOBAL
+        from ray_tpu._private import builtin_metrics
+        import time as _time
         while not self._closed:
-            self._probe_wake.wait(self._probe_period)
+            t_wait = _time.monotonic()
+            woken = self._probe_wake.wait(self._probe_period)
             self._probe_wake.clear()
             if self._closed:
                 return
+            if not woken:
+                # Head saturation signal: how far past the intended
+                # period the sweep actually woke (early wakes excluded —
+                # they are on purpose). A busy/GIL-starved head shows up
+                # here before anything times out.
+                lag = (_time.monotonic() - t_wait) - self._probe_period
+                try:
+                    builtin_metrics.loop_lag().set(
+                        max(0.0, lag), tags={"loop": "head.membership"})
+                except Exception:  # noqa: BLE001 - gauge is best-effort
+                    pass
             with GLOBAL.timed("head.health_sweep"):
                 current = list(self._conns.items())
                 # Departed nodes (EOF path) must not leak entries.
@@ -2510,15 +2524,23 @@ class NodeDaemon:
         {"execute_task", "create_actor", "actor_call"})
 
     def _handle_counted(self, sock, msg: dict) -> None:
+        import time as _time
+
+        from ray_tpu._private.event_stats import GLOBAL
         counted = msg.get("type") in self._USER_CODE_KINDS
         cpus = float(msg.get("num_cpus", 1.0)) if counted else 0.0
         if counted:
             with self._inflight_lock:
                 self._inflight += 1
                 self._inflight_cpu += cpus
+        _t0 = _time.monotonic()
         try:
             self._handle(sock, msg)
         finally:
+            # Per-handler daemon EventStats ride the next metrics_batch
+            # to the head (/api/event_stats "cluster" view).
+            GLOBAL.record(f"daemon.{msg.get('type') or 'frame'}",
+                          _time.monotonic() - _t0)
             if counted:
                 with self._inflight_lock:
                     self._inflight -= 1
@@ -3084,6 +3106,15 @@ class NodeDaemon:
         msg = dict(batch)
         msg["type"] = "metrics_batch"
         msg["node_id"] = self.node_id_hex or ""
+        if msg.get("component") == "daemon":
+            # Piggyback this daemon's control-loop EventStats (additive
+            # wire-v9 field) so /api/event_stats sees every node, not
+            # just the head process. Worker batches relayed through the
+            # same sink keep their own identity — no stats attached.
+            from ray_tpu._private.event_stats import GLOBAL
+            stats = GLOBAL.summary()
+            if stats:
+                msg["event_stats"] = stats
         return bool(sender.send(msg))
 
     def _collect_daemon_metrics(self) -> None:

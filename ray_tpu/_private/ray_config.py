@@ -97,6 +97,11 @@ _PY_DEFAULTS: Dict[str, Any] = {
     # many assembled traces the head retains before evicting oldest.
     "trace_sample_rate": 1.0,
     "trace_retention": 1000,
+    # Head-side windowed time-series store (timeseries.py): retention
+    # window in seconds (<= 0 disables the store) and the bound on
+    # distinct label sets held before new series are dropped+counted.
+    "timeseries_window_s": 300.0,
+    "timeseries_max_series": 4096,
     "task_events_enabled": True,
     "memory_monitor_refresh_ms": 250,
     "memory_usage_threshold": 0.95,

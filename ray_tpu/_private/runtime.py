@@ -2933,6 +2933,139 @@ class Runtime:
         self._flush_trace_spans()
         return self._cluster_metrics.traces.flow_events()
 
+    # -- time-series signal plane (timeseries.py) ----------------------
+
+    def get_timeseries(self, name: str,
+                       labels: Optional[Dict[str, str]] = None,
+                       window: Optional[float] = None,
+                       step: Optional[float] = None) -> dict:
+        """Windowed history + per-series summaries (reset-safe counter
+        rates, gauge last/avg, histogram p50/p95) for one metric from
+        the head's time-series store. The head's own registry is polled
+        first so driver-side series are as fresh as the call."""
+        self._flush_trace_spans()  # poll_once: fold + snapshot head
+        return self._cluster_metrics.timeseries.query(
+            name, labels=labels, window=window, step=step)
+
+    def serve_stats(self, window: Optional[float] = None) -> dict:
+        """Per-deployment traffic rollup over ``window`` seconds (default
+        30): qps, p50/p95/mean latency, mean queue depth, replica count.
+        The drop-in input for a metrics-driven replica autoscaler."""
+        self._flush_trace_spans()
+        w = 30.0 if window is None else float(window)
+        ts = self._cluster_metrics.timeseries
+        qps = ts.counter_rate("ray_tpu_serve_requests_total",
+                              window=w, group_by="deployment")
+        lat = ts.histogram_stats("ray_tpu_serve_request_latency_seconds",
+                                 window=w, group_by="deployment")
+        queue = ts.gauge_stats("ray_tpu_serve_queue_depth",
+                               window=w, group_by="deployment")
+        replicas = ts.gauge_stats("ray_tpu_serve_replicas",
+                                  window=w, group_by="deployment")
+        deployments = {}
+        for name in (set(qps) | set(lat) | set(queue) | set(replicas)):
+            if not name:
+                continue
+            h = lat.get(name, {})
+            deployments[name] = {
+                "qps": qps.get(name, 0.0),
+                "p50_s": h.get("p50", 0.0),
+                "p95_s": h.get("p95", 0.0),
+                "mean_latency_s": h.get("mean", 0.0),
+                "requests": h.get("count", 0),
+                # Queue depths are additive across routers; replica
+                # counts are replicated views — max, not sum.
+                "mean_queue_depth": queue.get(name, {}).get("avg_sum", 0.0),
+                "replicas": int(replicas.get(name, {}).get("last_max", 0)),
+            }
+        return {"window_s": w, "deployments": deployments}
+
+    def membership_snapshot(self) -> List[dict]:
+        """Read-only membership internals (epoch / phi / heartbeat age)
+        per live node, for status surfaces."""
+        return self.membership.snapshot()
+
+    def cluster_event_stats(self) -> Dict[str, dict]:
+        """EventStats summaries shipped inside metrics_batch frames,
+        keyed ``"<node_id>:<component>"`` (daemon control loops)."""
+        return self._cluster_metrics.cluster_event_stats()
+
+    def top_snapshot(self, window: Optional[float] = None) -> dict:
+        """One `ray-tpu top` frame, rendered entirely from windowed
+        store history: per-node usage + membership + task rates, object
+        store bytes/spill rate, per-deployment serve stats, control-loop
+        lag gauges."""
+        self._flush_trace_spans()
+        w = 30.0 if window is None else float(window)
+        ts = self._cluster_metrics.timeseries
+        node_rates: Dict[str, Dict[str, float]] = {}
+        for status in ("SUBMITTED", "FINISHED", "FAILED"):
+            rates = ts.counter_rate(
+                "ray_tpu_node_task_events_total",
+                labels={"status": status}, window=w, group_by="node_id")
+            for node_hex, rate in rates.items():
+                node_rates.setdefault(node_hex, {})[status.lower()] = rate
+        usage = {}
+        srv = getattr(self, "_head_server", None)
+        if srv is not None:
+            usage = srv.syncer.digest().get("nodes", {})
+        membership = {row["node_id"]: row
+                      for row in self.membership.snapshot()}
+        nodes = []
+        for node in self.scheduler.nodes_snapshot():
+            hexid = node.get("NodeID", "")
+            live = membership.get(hexid, {})
+            used = usage.get(hexid, {})
+            rates = node_rates.get(hexid, {})
+            nodes.append({
+                "node_id": hexid,
+                "alive": node.get("Alive", False),
+                "resources": node.get("Resources", {}),
+                "epoch": live.get("epoch"),
+                "phi": live.get("phi"),
+                "last_heartbeat_age_s": live.get("last_heartbeat_age_s"),
+                "rss_bytes": used.get("memory", {}).get("rss_bytes"),
+                "object_store": used.get("object_store", {}),
+                "resource_load": used.get("resource_load", {}),
+                "tasks_submitted_per_s": rates.get("submitted", 0.0),
+                "tasks_finished_per_s": rates.get("finished", 0.0),
+                "tasks_failed_per_s": rates.get("failed", 0.0),
+            })
+        tasks = {
+            "submitted_per_s": sum(ts.counter_rate(
+                "ray_tpu_tasks_submitted_total", window=w).values()),
+            "finished_per_s": sum(ts.counter_rate(
+                "ray_tpu_tasks_finished_total", window=w).values()),
+            "failed_per_s": sum(ts.counter_rate(
+                "ray_tpu_tasks_failed_total", window=w).values()),
+        }
+        objects = {
+            "store_bytes": ts.gauge_stats(
+                "ray_tpu_object_store_bytes",
+                window=w).get("", {}).get("last_sum", 0.0),
+            "spill_bytes_per_s": sum(ts.counter_rate(
+                "ray_tpu_object_spilled_bytes_total", window=w).values()),
+            "restores_per_s": sum(ts.counter_rate(
+                "ray_tpu_object_restores_total", window=w).values()),
+        }
+        loops = {
+            key: stats["last_max"]
+            for key, stats in ts.gauge_stats(
+                "ray_tpu_loop_lag_seconds", window=w,
+                group_by="loop").items() if key}
+        return {
+            "window_s": w,
+            "nodes": nodes,
+            "tasks": tasks,
+            "objects": objects,
+            "serve": self.serve_stats(window=w)["deployments"],
+            "loops": loops,
+            "timeseries": {
+                "series": ts.series_count(),
+                "dropped_series": ts.dropped_series,
+            },
+        }
+
     def register_remote_node(self, conn, info: Optional[dict] = None,
                              dispatch: bool = True,
                              node_id: Optional["NodeID"] = None) -> NodeID:
@@ -3656,12 +3789,18 @@ class Runtime:
     # ------------------------------------------------------------------
 
     def _record_event(self, spec: TaskSpec, status: str) -> None:
-        builtin_metrics.record_task_event(status)
+        # Node attribution: set at _try_launch (None for pre-placement
+        # SUBMITTED events) — feeds the per-node rate series and the
+        # state API's node_id column.
+        nid = getattr(spec, "_node_id", None)
+        node_hex = nid.hex() if nid is not None else None
+        builtin_metrics.record_task_event(status, node_hex)
         if len(self._task_events) < self._cfg_max_task_events:
             self._task_events.append({
                 "task_id": spec.task_id.hex(),
                 "name": spec.name,
                 "status": status,
+                "node_id": node_hex,
                 "time": time.time(),
             })
         # State transitions fan out on the pubsub hub (reference:

@@ -122,4 +122,18 @@ def status_summary() -> str:
                              + (f"(+{backlog['temp_slots']}tmp)"
                                 if backlog.get("temp_slots") else ""))
             lines.append(f"  {node_id[:12]}: " + " ".join(parts))
+    # Membership internals (PR 11), read-only: incarnation epoch, phi
+    # suspicion, and the silence since the last liveness arrival.
+    rt = global_worker.runtime
+    snap_fn = getattr(rt, "membership_snapshot", None)
+    rows = snap_fn() if snap_fn is not None else []
+    if rows:
+        lines.append("Membership:")
+        for row in sorted(rows, key=lambda r: r["node_id"]):
+            lines.append(
+                f"  {row['node_id'][:12]}: epoch={row['epoch']} "
+                f"phi={row['phi']:.2f} "
+                f"hb_age={row['last_heartbeat_age_s']:.1f}s"
+                + (f" soft_failures={row['soft_failures']}"
+                   if row.get("soft_failures") else ""))
     return "\n".join(lines)

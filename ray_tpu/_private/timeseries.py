@@ -1,0 +1,436 @@
+"""Head-side windowed time-series store for cluster metrics.
+
+PR 3's pipeline only ever exposes the *latest* merged sample per series
+(one Prometheus scrape of :class:`ClusterMetrics`); the reference punts
+history to an external Prometheus. A TPU-native cluster must close the
+autoscaling loop and diagnose head saturation with zero external infra,
+so :meth:`ClusterMetrics.update` feeds every arriving sample into this
+store:
+
+* Per series — keyed ``(metric_name, sorted label items)`` where labels
+  are the metric's tag values plus the origin's ``node_id``/``pid``/
+  ``component`` — a raw ring at ~1s buckets plus 10s and 60s rollup
+  rings, all bounded by the retention window
+  (``RAY_TPU_TIMESERIES_WINDOW_S``, default 300s; ``<= 0`` disables the
+  store entirely).
+* Derivations over any window: counter → rate that is reset-safe across
+  process restarts (a value drop counts the new value as the delta,
+  never a negative), gauge → last/avg/max, histogram → windowed
+  p50/p95 by diffing cumulative bucket counts against the sample at the
+  window start.
+* Bounded memory: at most ``RAY_TPU_TIMESERIES_MAX_SERIES`` series
+  (default 4096; extra series are counted in ``dropped_series``, not
+  stored), and staleness eviction wired to membership death pushes —
+  ``mark_node_dead`` starts the clock for every series carrying that
+  ``node_id`` label, idle series age out after the window passes (safe:
+  agents resend full snapshots every ~60s, re-stamping live series).
+
+All internal timestamps are ``time.monotonic()`` — query responses
+carry ``now`` so callers can turn point timestamps into ages.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+DEFAULT_WINDOW_S = 300.0
+DEFAULT_MAX_SERIES = 4096
+#: Raw ring horizon: the most recent slice keeps ~1s resolution; the
+#: 10s/60s rollups carry the rest of the window.
+RAW_HORIZON_S = 120.0
+ROLLUP_STEPS = (10, 60)
+
+
+def configured_window_s() -> float:
+    """Retention window; honors the documented uppercase env spelling
+    first, then the flag table (live runtime config > env > default)."""
+    raw = os.environ.get("RAY_TPU_TIMESERIES_WINDOW_S", "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    from ray_tpu._private.ray_config import runtime_config_value
+    return float(runtime_config_value("timeseries_window_s",
+                                      DEFAULT_WINDOW_S))
+
+
+def configured_max_series() -> int:
+    raw = os.environ.get("RAY_TPU_TIMESERIES_MAX_SERIES", "")
+    if raw:
+        try:
+            return int(float(raw))
+        except ValueError:
+            pass
+    from ray_tpu._private.ray_config import runtime_config_value
+    return int(runtime_config_value("timeseries_max_series",
+                                    DEFAULT_MAX_SERIES))
+
+
+class _Series:
+    """One labelled stream: raw ring + per-step rollup rings.
+
+    Points are ``[bucket_ts, last, sum, count]`` — cumulative metric
+    value plus fold stats so coarse steps keep gauge averages honest.
+    Histogram points store ``(bucket_counts_tuple, sum, count)`` as
+    ``last`` (cumulative, diffed at query time)."""
+
+    __slots__ = ("name", "kind", "labels", "boundaries",
+                 "raw", "rollups", "last_seen", "dead_at")
+
+    def __init__(self, name: str, kind: str, labels: Dict[str, str],
+                 boundaries: Tuple[float, ...], window_s: float):
+        self.name = name
+        self.kind = kind
+        self.labels = labels
+        self.boundaries = boundaries
+        raw_len = int(min(window_s, RAW_HORIZON_S)) + 2
+        self.raw: deque = deque(maxlen=max(raw_len, 4))
+        self.rollups: Dict[int, deque] = {
+            step: deque(maxlen=int(window_s // step) + 2)
+            for step in ROLLUP_STEPS}
+        self.last_seen = time.monotonic()
+        self.dead_at: Optional[float] = None
+
+    def append(self, now: float, value: Any) -> None:
+        self.last_seen = now
+        self._fold(self.raw, now - now % 1.0, value)
+        for step, ring in self.rollups.items():
+            self._fold(ring, now - now % step, value)
+
+    @staticmethod
+    def _fold(ring: deque, bucket_ts: float, value: Any) -> None:
+        if ring and ring[-1][0] == bucket_ts:
+            point = ring[-1]
+            point[1] = value
+            if isinstance(value, (int, float)):
+                point[2] += value
+            point[3] += 1
+        else:
+            total = value if isinstance(value, (int, float)) else 0.0
+            ring.append([bucket_ts, value, total, 1])
+
+    def _ring_for(self, window: float,
+                  step: Optional[float]) -> Tuple[deque, float]:
+        """Pick the finest ring whose horizon covers ``window`` (or the
+        one matching an explicit ``step``)."""
+        if step is not None:
+            if step < ROLLUP_STEPS[0]:
+                return self.raw, 1.0
+            chosen = ROLLUP_STEPS[0]
+            for s in ROLLUP_STEPS:
+                if step >= s:
+                    chosen = s
+            return self.rollups[chosen], float(chosen)
+        if window <= RAW_HORIZON_S:
+            return self.raw, 1.0
+        return self.rollups[ROLLUP_STEPS[0]], float(ROLLUP_STEPS[0])
+
+    def window_points(self, now: float, window: float,
+                      step: Optional[float] = None) -> List[list]:
+        """Points inside ``[now - window, now]`` plus one baseline point
+        just before the window start (rate/diff anchors)."""
+        ring, _res = self._ring_for(window, step)
+        start = now - window
+        pts = list(ring)
+        idx = bisect.bisect_left([p[0] for p in pts], start)
+        baseline = max(0, idx - 1)
+        return pts[baseline:]
+
+    # -- derivations ---------------------------------------------------
+
+    def rate(self, now: float, window: float) -> float:
+        """Reset-safe counter rate: sum of positive deltas (a drop means
+        the process restarted — the new cumulative value IS the delta)
+        over the observed span."""
+        pts = self.window_points(now, window)
+        if len(pts) < 2:
+            return 0.0
+        total = 0.0
+        for prev, cur in zip(pts, pts[1:]):
+            delta = cur[1] - prev[1]
+            total += delta if delta >= 0 else cur[1]
+        span = pts[-1][0] - pts[0][0]
+        return total / span if span > 0 else 0.0
+
+    def gauge_summary(self, now: float, window: float) -> Dict[str, float]:
+        pts = self.window_points(now, window)
+        if not pts:
+            return {"last": 0.0, "avg": 0.0, "max": 0.0}
+        total = sum(p[2] for p in pts)
+        count = sum(p[3] for p in pts)
+        return {"last": float(pts[-1][1]),
+                "avg": total / count if count else 0.0,
+                "max": max(float(p[1]) for p in pts)}
+
+    def histogram_delta(self, now: float, window: float
+                        ) -> Tuple[List[float], float, int]:
+        """Windowed (bucket_deltas, sum_delta, count_delta): current
+        cumulative state minus the baseline at the window start, clamped
+        at zero per bucket so restarts never go negative."""
+        pts = self.window_points(now, window)
+        if len(pts) < 2:
+            # A lone sample carries cumulative state from before the
+            # window — without a baseline there is no derivable delta
+            # (same rule counter rates follow).
+            return [], 0.0, 0
+        cur_b, cur_s, cur_c = pts[-1][1]
+        base_b, base_s, base_c = pts[0][1]
+        if len(base_b) != len(cur_b):
+            base_b = (0.0,) * len(cur_b)
+        deltas = [max(0.0, c - b) for c, b in zip(cur_b, base_b)]
+        return deltas, max(0.0, cur_s - base_s), max(0, cur_c - base_c)
+
+    def percentile(self, now: float, window: float, q: float) -> float:
+        deltas, _s, _c = self.histogram_delta(now, window)
+        return _bucket_percentile(self.boundaries, deltas, q)
+
+
+def _bucket_percentile(boundaries: Tuple[float, ...],
+                       buckets: Iterable[float], q: float) -> float:
+    """The util/metrics.py bucket-walk: smallest boundary whose
+    cumulative count reaches q% of the total."""
+    buckets = list(buckets)
+    total = sum(buckets)
+    if total <= 0 or not boundaries:
+        return 0.0
+    target = (q / 100.0) * total
+    cum = 0.0
+    for i, c in enumerate(buckets):
+        cum += c
+        if cum >= target:
+            return boundaries[min(i, len(boundaries) - 1)]
+    return boundaries[-1]
+
+
+class TimeSeriesStore:
+    """Bounded windowed store every scale-era signal reads from."""
+
+    def __init__(self, window_s: Optional[float] = None,
+                 max_series: Optional[int] = None,
+                 staleness: float = 30.0):
+        self.window_s = (configured_window_s() if window_s is None
+                         else float(window_s))
+        self.max_series = (configured_max_series() if max_series is None
+                           else int(max_series))
+        self.staleness = staleness
+        self.enabled = self.window_s > 0
+        self.dropped_series = 0
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                           _Series] = {}
+
+    # -- ingest --------------------------------------------------------
+
+    def ingest_batch(self, node_id: str, pid: int, component: str,
+                     entries: Iterable[Dict[str, Any]],
+                     now: Optional[float] = None) -> None:
+        """Feed one metrics_batch's entries (snapshot or diff — values
+        are cumulative either way)."""
+        if not self.enabled:
+            return
+        if now is None:
+            now = time.monotonic()
+        origin = {"node_id": node_id or "", "pid": str(pid),
+                  "component": component or ""}
+        with self._lock:
+            for entry in entries:
+                name = entry.get("name")
+                kind = entry.get("type")
+                if not name or not kind:
+                    continue
+                tag_keys = tuple(entry.get("tag_keys") or ())
+                boundaries = tuple(entry.get("boundaries") or ())
+                if kind == "histogram":
+                    sums = entry.get("sums", {})
+                    counts = entry.get("counts", {})
+                    for skey, bucket_counts in (
+                            entry.get("buckets") or {}).items():
+                        value = (tuple(float(c) for c in bucket_counts),
+                                 float(sums.get(skey, 0.0)),
+                                 int(counts.get(skey, 0)))
+                        self._append(name, kind, tag_keys, skey, origin,
+                                     boundaries, now, value)
+                else:
+                    for skey, value in (entry.get("series") or {}).items():
+                        self._append(name, kind, tag_keys, skey, origin,
+                                     boundaries, now, float(value))
+
+    def _append(self, name: str, kind: str, tag_keys: Tuple[str, ...],
+                series_key: Any, origin: Dict[str, str],
+                boundaries: Tuple[float, ...], now: float,
+                value: Any) -> None:
+        labels = dict(origin)
+        if isinstance(series_key, (tuple, list)):
+            labels.update(zip(tag_keys, (str(v) for v in series_key)))
+        key = (name, tuple(sorted(labels.items())))
+        series = self._series.get(key)
+        if series is None:
+            if len(self._series) >= self.max_series:
+                self.dropped_series += 1
+                return
+            series = self._series[key] = _Series(
+                name, kind, labels, boundaries, self.window_s)
+        elif series.kind != kind:
+            series = self._series[key] = _Series(
+                name, kind, labels, boundaries, self.window_s)
+        series.dead_at = None
+        series.append(now, value)
+
+    # -- eviction ------------------------------------------------------
+
+    def mark_node_dead(self, node_id: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for series in self._series.values():
+                if (series.labels.get("node_id") == node_id
+                        and series.dead_at is None):
+                    series.dead_at = now
+
+    def evict_stale(self) -> None:
+        if not self.enabled:
+            return
+        now = time.monotonic()
+        idle_horizon = max(self.window_s, RAW_HORIZON_S)
+        with self._lock:
+            doomed = [key for key, s in self._series.items()
+                      if (s.dead_at is not None
+                          and now - s.dead_at > self.staleness)
+                      or now - s.last_seen > idle_horizon]
+            for key in doomed:
+                del self._series[key]
+
+    # -- queries -------------------------------------------------------
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted({name for name, _labels in self._series})
+
+    def _select(self, name: str,
+                labels: Optional[Dict[str, str]]) -> List[_Series]:
+        with self._lock:
+            out = []
+            for (sname, _lkey), series in self._series.items():
+                if sname != name:
+                    continue
+                if labels and any(series.labels.get(k) != str(v)
+                                  for k, v in labels.items()):
+                    continue
+                out.append(series)
+            return out
+
+    def query(self, name: str, labels: Optional[Dict[str, str]] = None,
+              window: Optional[float] = None,
+              step: Optional[float] = None) -> Dict[str, Any]:
+        """Raw points + a per-series summary for every matching series.
+        Timestamps are monotonic; ``now`` anchors them."""
+        now = time.monotonic()
+        w = self.window_s if window is None else min(float(window),
+                                                    self.window_s)
+        result: List[Dict[str, Any]] = []
+        for series in self._select(name, labels):
+            pts = series.window_points(now, w, step)
+            row: Dict[str, Any] = {
+                "labels": dict(series.labels),
+                "kind": series.kind,
+            }
+            if series.kind == "histogram":
+                deltas, sum_d, count_d = series.histogram_delta(now, w)
+                row["points"] = [[p[0], p[1][2]] for p in pts]  # counts
+                row["summary"] = {
+                    "count": count_d, "sum": sum_d,
+                    "rate": count_d / w if w > 0 else 0.0,
+                    "p50": _bucket_percentile(series.boundaries, deltas, 50),
+                    "p95": _bucket_percentile(series.boundaries, deltas, 95),
+                }
+            else:
+                row["points"] = [[p[0], p[1]] for p in pts]
+                if series.kind == "counter":
+                    row["summary"] = {"rate": series.rate(now, w),
+                                      "last": float(pts[-1][1])
+                                      if pts else 0.0}
+                else:
+                    row["summary"] = series.gauge_summary(now, w)
+            result.append(row)
+        return {"name": name, "window_s": w, "now": now, "series": result}
+
+    def counter_rate(self, name: str,
+                     labels: Optional[Dict[str, str]] = None,
+                     window: Optional[float] = None,
+                     group_by: Optional[str] = None) -> Dict[str, float]:
+        """Summed windowed rates, grouped by one label (or "" for all)."""
+        now = time.monotonic()
+        w = self.window_s if window is None else min(float(window),
+                                                    self.window_s)
+        out: Dict[str, float] = {}
+        for series in self._select(name, labels):
+            key = series.labels.get(group_by, "") if group_by else ""
+            out[key] = out.get(key, 0.0) + series.rate(now, w)
+        return out
+
+    def gauge_stats(self, name: str,
+                    labels: Optional[Dict[str, str]] = None,
+                    window: Optional[float] = None,
+                    group_by: Optional[str] = None
+                    ) -> Dict[str, Dict[str, float]]:
+        """Per-group {last_sum, last_max, avg_sum, avg_max} — sum for
+        additive gauges (queue depth, bytes), max for replicated views
+        (replica count seen by several routers)."""
+        now = time.monotonic()
+        w = self.window_s if window is None else min(float(window),
+                                                    self.window_s)
+        out: Dict[str, Dict[str, float]] = {}
+        for series in self._select(name, labels):
+            key = series.labels.get(group_by, "") if group_by else ""
+            summ = series.gauge_summary(now, w)
+            g = out.setdefault(key, {"last_sum": 0.0, "last_max": 0.0,
+                                     "avg_sum": 0.0, "avg_max": 0.0})
+            g["last_sum"] += summ["last"]
+            g["last_max"] = max(g["last_max"], summ["last"])
+            g["avg_sum"] += summ["avg"]
+            g["avg_max"] = max(g["avg_max"], summ["avg"])
+        return out
+
+    def histogram_stats(self, name: str,
+                        labels: Optional[Dict[str, str]] = None,
+                        window: Optional[float] = None,
+                        group_by: Optional[str] = None
+                        ) -> Dict[str, Dict[str, float]]:
+        """Per-group windowed {count, sum, mean, rate, p50, p95} with
+        bucket deltas merged across series before the percentile walk."""
+        now = time.monotonic()
+        w = self.window_s if window is None else min(float(window),
+                                                    self.window_s)
+        merged: Dict[str, Dict[str, Any]] = {}
+        for series in self._select(name, labels):
+            key = series.labels.get(group_by, "") if group_by else ""
+            deltas, sum_d, count_d = series.histogram_delta(now, w)
+            m = merged.setdefault(key, {"buckets": [], "sum": 0.0,
+                                        "count": 0,
+                                        "boundaries": series.boundaries})
+            if len(m["buckets"]) < len(deltas):
+                m["buckets"] += [0.0] * (len(deltas) - len(m["buckets"]))
+            for i, d in enumerate(deltas):
+                m["buckets"][i] += d
+            m["sum"] += sum_d
+            m["count"] += count_d
+        out: Dict[str, Dict[str, float]] = {}
+        for key, m in merged.items():
+            count = m["count"]
+            out[key] = {
+                "count": count, "sum": m["sum"],
+                "mean": m["sum"] / count if count else 0.0,
+                "rate": count / w if w > 0 else 0.0,
+                "p50": _bucket_percentile(m["boundaries"], m["buckets"], 50),
+                "p95": _bucket_percentile(m["boundaries"], m["buckets"], 95),
+            }
+        return out

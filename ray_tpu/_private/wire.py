@@ -192,6 +192,11 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
         "component": (_STR, True),
         "metrics": (_LIST, True),
         "spans": (_LIST, False),
+        # Additive (post-v9): the publishing process's EventStats
+        # summary ({handler: count/run/queue percentiles}) — daemons
+        # piggyback control-loop visibility on the frames they already
+        # send; older peers simply omit it.
+        "event_stats": (_DICT, False),
     },
     # -- durable spill announcements (daemon -> head, v8) --------------
     # A daemon spilled an object through a DURABLE backend (session://

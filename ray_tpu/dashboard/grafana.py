@@ -55,6 +55,18 @@ _DEFAULT_PANELS = [
      "rate(ray_tpu_serve_health_check_failures_total[5m])", "ops"),
     ("Serve requests shed / s", "rate(ray_tpu_serve_shed_total[1m])",
      "ops"),
+    ("Serve qps (by deployment)",
+     "sum by (deployment) (rate(ray_tpu_serve_requests_total[1m]))",
+     "ops"),
+    ("Serve p95 latency (by deployment)",
+     "histogram_quantile(0.95, sum by (le, deployment) "
+     "(rate(ray_tpu_serve_request_latency_seconds_bucket[5m])))", "s"),
+    ("Serve queue depth (by deployment)",
+     "sum by (deployment) (ray_tpu_serve_queue_depth)", "short"),
+    ("Serve replicas (by deployment)",
+     "max by (deployment) (ray_tpu_serve_replicas)", "short"),
+    ("Head loop lag (by loop)",
+     "max by (loop) (ray_tpu_loop_lag_seconds)", "s"),
     ("Train gang restarts / s (by cause)",
      "sum by (cause) (rate(ray_tpu_train_gang_restarts_total[5m]))",
      "ops"),

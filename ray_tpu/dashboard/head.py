@@ -25,11 +25,18 @@ Routes (all JSON unless noted):
   GET  /api/traces             — assembled distributed traces (?limit=)
   GET  /api/traces/{trace_id}  — one trace: spans, stages, origins
   GET  /api/event_stats        — control-plane handler latency stats
+                                 (local head process + per-node merge)
+  GET  /api/timeseries         — windowed metric history from the head
+                                 store (?name=&window=&step=&label.k=v;
+                                 no name lists the stored series)
+  GET  /api/serve/stats        — per-deployment qps/p95/queue/replicas
+                                 rollup (?window=, default 30s)
   GET  /                       — minimal HTML index
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import threading
@@ -65,7 +72,8 @@ class DashboardHead:
                          "/api/v0/nodes", "/api/jobs/", "/metrics",
                          "/api/logs?list=1",
                          "/api/serve/applications", "/api/timeline",
-                         "/api/traces", "/api/event_stats"))
+                         "/api/traces", "/api/event_stats",
+                         "/api/timeseries", "/api/serve/stats"))
         return web.Response(
             text=f"<html><body><h2>ray_tpu dashboard</h2><ul>{rows}</ul>"
                  "</body></html>",
@@ -82,10 +90,20 @@ class DashboardHead:
 
     async def _cluster_status(self, request):
         import ray_tpu
+        from ray_tpu._private.worker import global_worker
+        runtime = getattr(global_worker, "_runtime", None)
+        # Membership internals (PR 11) read-only: per-node incarnation
+        # epoch, phi suspicion, and silence since the last liveness
+        # arrival — keyed by node_id for joins against `nodes`.
+        membership = {}
+        snap = getattr(runtime, "membership_snapshot", None)
+        if snap is not None:
+            membership = {row["node_id"]: row for row in snap()}
         return self._json({
             "cluster_resources": ray_tpu.cluster_resources(),
             "available_resources": ray_tpu.available_resources(),
             "nodes": ray_tpu.nodes(),
+            "membership": membership,
         })
 
     async def _state(self, request):
@@ -128,9 +146,64 @@ class DashboardHead:
 
     async def _event_stats(self, request):
         """Per-handler latency/queue stats of the control plane
-        (reference: RAY_event_stats / instrumented_io_context dumps)."""
+        (reference: RAY_event_stats / instrumented_io_context dumps).
+        ``local`` is this (head) process; ``cluster`` merges the
+        summaries daemons piggyback on metrics_batch frames, keyed
+        ``"<node_id>:<component>"``."""
         from ray_tpu._private.event_stats import GLOBAL
-        return self._json(GLOBAL.summary())
+        from ray_tpu._private.worker import global_worker
+        runtime = getattr(global_worker, "_runtime", None)
+        cluster = {}
+        fn = getattr(runtime, "cluster_event_stats", None)
+        if fn is not None:
+            cluster = await asyncio.to_thread(fn)
+        return self._json({"local": GLOBAL.summary(), "cluster": cluster})
+
+    async def _timeseries(self, request):
+        """Windowed history for one metric from the head's time-series
+        store: ``?name=`` (required), ``?window=`` seconds, ``?step=``
+        resolution (1/10/60), optional ``?label.key=value`` filters."""
+        from ray_tpu._private.worker import global_worker
+        runtime = getattr(global_worker, "_runtime", None)
+        if runtime is None:
+            return self._json({"error": "no runtime"}, status=503)
+        name = request.query.get("name")
+        if not name:
+            store = runtime._cluster_metrics.timeseries
+            return self._json({"series_names": store.names(),
+                               "series": store.series_count(),
+                               "dropped_series": store.dropped_series})
+        window = step = None
+        try:
+            if request.query.get("window"):
+                window = float(request.query["window"])
+            if request.query.get("step"):
+                step = float(request.query["step"])
+        except ValueError:
+            return self._json({"error": "window/step must be numbers"},
+                              status=400)
+        labels = {k[len("label."):]: v for k, v in request.query.items()
+                  if k.startswith("label.")}
+        result = await asyncio.to_thread(
+            runtime.get_timeseries, name, labels or None, window, step)
+        return self._json(result)
+
+    async def _serve_stats(self, request):
+        """Per-deployment qps/p95/queue/replica rollup over ``?window=``
+        seconds (default 30) — the autoscaler's polling input."""
+        from ray_tpu._private.worker import global_worker
+        runtime = getattr(global_worker, "_runtime", None)
+        if runtime is None:
+            return self._json({"error": "no runtime"}, status=503)
+        window = None
+        try:
+            if request.query.get("window"):
+                window = float(request.query["window"])
+        except ValueError:
+            return self._json({"error": "window must be a number"},
+                              status=400)
+        return self._json(
+            await asyncio.to_thread(runtime.serve_stats, window))
 
     async def _timeline(self, request):
         from ray_tpu._private.state import timeline
@@ -378,6 +451,8 @@ class DashboardHead:
         app.router.add_get("/api/traces", self._traces_list)
         app.router.add_get("/api/traces/{trace_id}", self._traces_get)
         app.router.add_get("/api/event_stats", self._event_stats)
+        app.router.add_get("/api/timeseries", self._timeseries)
+        app.router.add_get("/api/serve/stats", self._serve_stats)
         app.router.add_get("/api/jobs/", self._jobs_list)
         app.router.add_post("/api/jobs/", self._jobs_submit)
         app.router.add_get("/api/jobs/{job_id}", self._jobs_get)
@@ -413,7 +488,24 @@ class DashboardHead:
                 self._runner = runner
                 self.bound_port = runner.addresses[0][1]
 
+            async def lag_probe():
+                # Asyncio loop saturation: sleep a fixed period and
+                # gauge how late the wakeup lands — slow handlers or a
+                # starved thread show up as dashboard loop lag.
+                from ray_tpu._private import builtin_metrics
+                period = 1.0
+                while True:
+                    t0 = loop.time()
+                    await asyncio.sleep(period)
+                    lag = (loop.time() - t0) - period
+                    try:
+                        builtin_metrics.loop_lag().set(
+                            max(0.0, lag), tags={"loop": "dashboard"})
+                    except Exception:  # noqa: BLE001 - best-effort
+                        pass
+
             loop.run_until_complete(setup())
+            self._lag_task = loop.create_task(lag_probe())
             ready.set()
             loop.run_forever()
 
@@ -428,6 +520,9 @@ class DashboardHead:
         import asyncio
         if self._loop is not None:
             async def teardown():
+                task = getattr(self, "_lag_task", None)
+                if task is not None:
+                    task.cancel()
                 if self._runner is not None:
                     await self._runner.cleanup()
             fut = asyncio.run_coroutine_threadsafe(teardown(), self._loop)

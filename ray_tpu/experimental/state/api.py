@@ -48,13 +48,25 @@ def list_tasks(filters: Optional[List[tuple]] = None,
     for ev in rt.task_events():
         row = latest.setdefault(ev["task_id"], {
             "task_id": ev["task_id"], "name": ev["name"],
-            "state": None, "start_time": None, "end_time": None})
+            "state": None, "node_id": None, "start_time": None,
+            "end_time": None, "duration_s": None, "_last_time": 0.0})
         row["state"] = ev["status"]
+        row["_last_time"] = ev["time"]
+        if ev.get("node_id"):
+            row["node_id"] = ev["node_id"]
         if ev["status"] == "RUNNING":
             row["start_time"] = ev["time"]
         elif ev["status"] in ("FINISHED", "FAILED"):
             row["end_time"] = ev["time"]
-    return _apply_filters(list(latest.values()), filters)[:limit]
+            if row["start_time"] is not None:
+                row["duration_s"] = ev["time"] - row["start_time"]
+    # Most-recent-first, and the limit applies AFTER the sort — dict
+    # (insertion) order would keep the oldest tasks and drop the newest.
+    rows = sorted(latest.values(), key=lambda r: r["_last_time"],
+                  reverse=True)
+    for row in rows:
+        del row["_last_time"]
+    return _apply_filters(rows, filters)[:limit]
 
 
 def list_objects(filters: Optional[List[tuple]] = None,

@@ -33,6 +33,119 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def _render_top_frame(snap: dict) -> str:
+    """One `ray-tpu top` frame from runtime.top_snapshot(): every number
+    is a windowed derivation from the head's time-series store."""
+    lines = []
+    tasks = snap.get("tasks", {})
+    objects = snap.get("objects", {})
+    ts_meta = snap.get("timeseries", {})
+    lines.append(
+        f"ray-tpu top — window {snap.get('window_s', 0):g}s — "
+        f"{len(snap.get('nodes', []))} node(s) — "
+        f"{ts_meta.get('series', 0)} series "
+        f"({ts_meta.get('dropped_series', 0)} dropped)")
+    lines.append(
+        f"tasks/s  submitted {tasks.get('submitted_per_s', 0.0):.2f}  "
+        f"finished {tasks.get('finished_per_s', 0.0):.2f}  "
+        f"failed {tasks.get('failed_per_s', 0.0):.2f}")
+    lines.append(
+        f"objects  store {_fmt_bytes(objects.get('store_bytes'))}  "
+        f"spill/s {_fmt_bytes(objects.get('spill_bytes_per_s'))}  "
+        f"restores/s {objects.get('restores_per_s', 0.0):.2f}")
+    loops = snap.get("loops", {})
+    if loops:
+        lines.append("loop lag  " + "  ".join(
+            f"{name} {lag * 1000:.1f}ms"
+            for name, lag in sorted(loops.items())))
+    nodes = snap.get("nodes", [])
+    if nodes:
+        lines.append("")
+        rows = []
+        for n in nodes:
+            cpu = n.get("resources", {}).get("CPU", 0)
+            rows.append((
+                n.get("node_id", "")[:12],
+                "yes" if n.get("alive") else "NO",
+                "-" if n.get("epoch") is None else str(n["epoch"]),
+                "-" if n.get("phi") is None else f"{n['phi']:.2f}",
+                "-" if n.get("last_heartbeat_age_s") is None
+                else f"{n['last_heartbeat_age_s']:.1f}s",
+                f"{cpu:g}",
+                _fmt_bytes(n.get("rss_bytes")),
+                f"{n.get('tasks_submitted_per_s', 0.0):.2f}",
+                f"{n.get('tasks_finished_per_s', 0.0):.2f}",
+            ))
+        hdr = ("NODE", "ALIVE", "EPOCH", "PHI", "HB_AGE", "CPU",
+               "RSS", "SUB/S", "FIN/S")
+        widths = [max(len(hdr[i]), *(len(r[i]) for r in rows))
+                  for i in range(len(hdr))]
+        fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+        lines.append(fmt.format(*hdr))
+        for r in rows:
+            lines.append(fmt.format(*r))
+    serve = snap.get("serve", {})
+    if serve:
+        lines.append("")
+        rows = []
+        for name in sorted(serve):
+            d = serve[name]
+            rows.append((
+                name,
+                str(d.get("replicas", 0)),
+                f"{d.get('qps', 0.0):.2f}",
+                f"{d.get('p50_s', 0.0) * 1000:.1f}ms",
+                f"{d.get('p95_s', 0.0) * 1000:.1f}ms",
+                f"{d.get('mean_queue_depth', 0.0):.1f}",
+            ))
+        hdr = ("DEPLOYMENT", "REPLICAS", "QPS", "P50", "P95", "QUEUE")
+        widths = [max(len(hdr[i]), *(len(r[i]) for r in rows))
+                  for i in range(len(hdr))]
+        fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+        lines.append(fmt.format(*hdr))
+        for r in rows:
+            lines.append(fmt.format(*r))
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """`ray-tpu top [--once] [--interval S] [--window S] [--json]` —
+    live cluster view rendered entirely from the head's windowed
+    time-series store: per-node usage/epoch/suspicion + task rates,
+    object-store bytes and spill rate, per-deployment qps/p95/queue,
+    control-loop lag."""
+    import time as _time
+
+    _ensure_init()
+    from ray_tpu._private.worker import global_worker
+    rt = global_worker.runtime
+    while True:
+        snap = rt.top_snapshot(window=args.window)
+        if args.json:
+            print(json.dumps(snap, indent=2, default=str))
+        else:
+            if not args.once:
+                print("\x1b[2J\x1b[H", end="")  # clear + home
+            print(_render_top_frame(snap))
+        if args.once:
+            return 0
+        try:
+            _time.sleep(max(0.2, args.interval))
+        except KeyboardInterrupt:
+            return 0
+
+
 def cmd_timeline(args) -> int:
     _ensure_init()
     from ray_tpu._private.state import timeline
@@ -442,6 +555,16 @@ def main(argv=None) -> int:
     sub = parser.add_subparsers(dest="command", required=True)
 
     sub.add_parser("status", help="cluster resource + task summary")
+    p = sub.add_parser("top", help="live cluster view from the head's "
+                                   "windowed time-series store")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period in seconds (default 2)")
+    p.add_argument("--window", type=float, default=None,
+                   help="derivation window in seconds (default 30)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the raw snapshot as JSON")
     sub.add_parser("memory", help="object store summary")
     p = sub.add_parser("timeline", help="dump chrome://tracing JSON")
     p.add_argument("-o", "--output", default=None)
@@ -560,6 +683,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     handler = {
         "status": cmd_status,
+        "top": cmd_top,
         "memory": cmd_memory,
         "timeline": cmd_timeline,
         "trace": cmd_trace,

@@ -539,6 +539,18 @@ class ServeController:
                 for name, info in self._deployments.items()
                 if info.route_prefix}
 
+    async def deployment_stats(self, window: float = 30.0) -> dict:
+        """Windowed per-deployment traffic rollup (qps, p50/p95, mean
+        queue depth, replica count) from the head's time-series store —
+        the signal a metrics-driven autoscaling policy polls instead of
+        fanning RPCs out to every replica."""
+        from ray_tpu._private.worker import global_worker
+        runtime = getattr(global_worker, "_runtime", None)
+        stats_fn = getattr(runtime, "serve_stats", None)
+        if stats_fn is None:
+            return {"window_s": window, "deployments": {}}
+        return stats_fn(window=window)
+
     # -- autoscaling -----------------------------------------------------
 
     async def autoscale_tick(self) -> Dict[str, int]:

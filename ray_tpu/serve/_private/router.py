@@ -53,12 +53,14 @@ _SUSPECT_TTL_S = 5.0
 
 class _PendingRequest:
     __slots__ = ("req_id", "method", "args", "kwargs", "promise", "inner",
-                 "replica_hex", "retries_left", "deadline", "trace_ctx")
+                 "replica_hex", "retries_left", "deadline", "trace_ctx",
+                 "t_start")
 
     def __init__(self, req_id: int, method: str, args, kwargs, promise,
                  retries_left: int, deadline: Optional[float],
                  trace_ctx: Optional[dict] = None):
         self.req_id = req_id
+        self.t_start = time.monotonic()  # feeds the latency histogram
         self.method = method
         self.args = args
         self.kwargs = kwargs
@@ -158,6 +160,8 @@ class Router:
                     del self._ongoing[gone]
                 for gone in set(self._suspect) - live:
                     del self._suspect[gone]
+            builtin_metrics.serve_replicas().set(
+                len(self._replicas), tags={"deployment": self._name})
             if self._replicas:
                 self._have_replicas.set()
             else:
@@ -256,6 +260,12 @@ class Router:
                 return
             self._uncharge(pending.replica_hex)
             pending.replica_hex = None
+            depth = len(self._requests)
+        tags = {"deployment": self._name}
+        builtin_metrics.serve_requests().inc(tags=tags)
+        builtin_metrics.serve_request_latency().observe(
+            time.monotonic() - pending.t_start, tags=tags)
+        builtin_metrics.serve_queue_depth().set(depth, tags=tags)
         self._runtime().fulfill_promise(pending.promise, alias=alias,
                                         exception=exception)
 
@@ -409,6 +419,9 @@ class Router:
                                       kwargs, promise, max_retries,
                                       deadline, trace_ctx)
             self._requests[pending.req_id] = pending
+            depth = len(self._requests)
+        builtin_metrics.serve_queue_depth().set(
+            depth, tags={"deployment": self._name})
         try:
             self._dispatch(pending)
         except BaseException:

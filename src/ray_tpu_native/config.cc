@@ -149,6 +149,10 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     // number of assembled traces the head retains (oldest evicted).
     FLAG_DBL(trace_sample_rate, 1.0),
     FLAG_INT(trace_retention, 1000),
+    // Head-side windowed time-series store: retention window seconds
+    // (<= 0 disables) and the cap on distinct series held.
+    FLAG_DBL(timeseries_window_s, 300.0),
+    FLAG_INT(timeseries_max_series, 4096),
     FLAG_BOOL(task_events_enabled, true),
     // -- memory monitor / OOM killing --
     FLAG_INT(memory_monitor_refresh_ms, 250),

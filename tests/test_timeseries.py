@@ -1,0 +1,458 @@
+"""Windowed time-series store + signal plane: downsampling alignment,
+reset-safe counter rates, membership-driven eviction, bounded memory
+under series churn, the /api/timeseries + /api/serve/stats endpoints,
+membership internals in /api/cluster_status, cluster EventStats merge,
+and the `ray-tpu top --once` acceptance path on a 2-daemon cluster."""
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as um
+from ray_tpu._private.timeseries import TimeSeriesStore
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    um.clear_registry()
+    yield
+    um.clear_registry()
+
+
+def _spawn_daemon(port, *, num_cpus=2, resources=None):
+    cmd = [sys.executable, "-m", "ray_tpu._private.multinode",
+           "--address", f"127.0.0.1:{port}",
+           "--num-cpus", str(num_cpus)]
+    if resources:
+        cmd += ["--resources", json.dumps(resources)]
+    return subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def _wait_for_resource(name, amount, timeout=20):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if ray_tpu.cluster_resources().get(name, 0) >= amount:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"resource {name}>={amount} never appeared: "
+        f"{ray_tpu.cluster_resources()}")
+
+
+def _counter_entry(name, value, tag_keys=(), key=()):
+    return [{"name": name, "type": "counter", "desc": "",
+             "tag_keys": tuple(tag_keys), "series": {tuple(key): float(value)}}]
+
+
+def _gauge_entry(name, value):
+    return [{"name": name, "type": "gauge", "desc": "", "tag_keys": (),
+             "series": {(): float(value)}}]
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+# ---------------------------------------------------------------------------
+# Store unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_downsampling_alignment_raw_10s_60s():
+    """Raw ~1s points fold into 10s and 60s rollups whose bucket
+    timestamps are step-aligned and whose last/sum/count agree with the
+    raw samples that fell into each bucket."""
+    store = TimeSeriesStore(window_s=600, max_series=16, staleness=30)
+    t0 = time.monotonic()
+    t0 -= t0 % 60  # minute-aligned start makes expectations exact
+    n = 180
+    for i in range(n):
+        store.ingest_batch("n1", 1, "daemon",
+                           _gauge_entry("ts_g", i), now=t0 + i)
+    series = store._series[("ts_g", tuple(sorted({
+        "node_id": "n1", "pid": "1", "component": "daemon"}.items())))]
+    raw = list(series.raw)
+    r10 = list(series.rollups[10])
+    r60 = list(series.rollups[60])
+    assert all(p[0] % 1 == 0 for p in raw)
+    assert all(p[0] % 10 == 0 for p in r10)
+    assert all(p[0] % 60 == 0 for p in r60)
+    # Raw keeps the recent ~2-minute slice at full resolution; rollups
+    # cover the whole run.
+    assert len(raw) <= 122
+    assert raw[-1][1] == n - 1
+    # Each full 10s bucket folded exactly 10 raw samples; its `last` is
+    # the final sample and its sum/count give the in-bucket average.
+    full = [p for p in r10 if p[0] >= t0 and p[0] + 10 <= t0 + n]
+    assert len(full) == n // 10
+    for p in full:
+        i0 = int(p[0] - t0)
+        assert p[3] == 10
+        assert p[1] == i0 + 9
+        assert p[2] == sum(range(i0, i0 + 10))
+    full60 = [p for p in r60 if p[0] >= t0 and p[0] + 60 <= t0 + n]
+    assert len(full60) == n // 60
+    assert all(p[3] == 60 for p in full60)
+    # Query picks the ring by step: raw for step<10, rollups otherwise.
+    q_raw = store.query("ts_g", window=60, step=1)
+    q_10 = store.query("ts_g", window=120, step=10)
+    q_60 = store.query("ts_g", window=600, step=60)
+    assert all(p[0] % 10 == 0 for p in q_10["series"][0]["points"])
+    assert all(p[0] % 60 == 0 for p in q_60["series"][0]["points"])
+    assert len(q_raw["series"][0]["points"]) > \
+        len(q_10["series"][0]["points"]) >= len(q_60["series"][0]["points"])
+
+
+def test_counter_reset_safe_rate():
+    """A cumulative counter that drops (process restart) contributes its
+    new value as the delta — never a negative rate."""
+    store = TimeSeriesStore(window_s=300, max_series=16, staleness=30)
+    now = time.monotonic()
+    t0 = now - 40
+    # 20s at +10/s, then a restart to 0 and 20s at +5/s.
+    for i in range(20):
+        store.ingest_batch("n1", 1, "daemon",
+                           _counter_entry("ts_c_total", 10 * i), now=t0 + i)
+    for i in range(20):
+        store.ingest_batch("n1", 1, "daemon",
+                           _counter_entry("ts_c_total", 5 * i),
+                           now=t0 + 20 + i)
+    rate = store.counter_rate("ts_c_total", window=60)[""]
+    # 190 before the reset + 95 after, over the 39s observed span.
+    assert rate == pytest.approx((190 + 95) / 39, rel=1e-6)
+    assert rate > 0
+
+
+def test_gauge_and_histogram_windowed_derivations():
+    store = TimeSeriesStore(window_s=300, max_series=16, staleness=30)
+    now = time.monotonic()
+    for i in range(10):
+        store.ingest_batch("n1", 1, "daemon",
+                           _gauge_entry("ts_g2", i), now=now - 10 + i)
+    g = store.gauge_stats("ts_g2", window=30)[""]
+    assert g["last_max"] == 9.0
+    assert g["avg_sum"] == pytest.approx(4.5)
+    hist = {"name": "ts_h_seconds", "type": "histogram", "desc": "",
+            "tag_keys": ("deployment",), "boundaries": (0.01, 0.1, 1.0),
+            "series": {("d",): 0.5},
+            "buckets": {("d",): [5, 10, 85, 0]},
+            "sums": {("d",): 40.0}, "counts": {("d",): 100}}
+    store.ingest_batch("n1", 2, "driver", [hist], now=now - 5)
+    h2 = dict(hist)
+    h2["buckets"] = {("d",): [10, 60, 130, 0]}
+    h2["sums"] = {("d",): 80.0}
+    h2["counts"] = {("d",): 200}
+    store.ingest_batch("n1", 2, "driver", [h2], now=now)
+    st = store.histogram_stats("ts_h_seconds", window=30,
+                               group_by="deployment")["d"]
+    # Window deltas: [5, 50, 45, 0] of 100 obs -> p50 at 0.1, p95 at 1.0.
+    assert st["count"] == 100
+    assert st["mean"] == pytest.approx(0.4)
+    assert st["p50"] == pytest.approx(0.1)
+    assert st["p95"] == pytest.approx(1.0)
+
+
+def test_dead_node_series_evicted_on_membership_push():
+    """A membership death push starts the staleness clock for every
+    series carrying that node_id; they are gone after the window (the
+    runtime wires MembershipTable death events to mark_node_dead)."""
+    from ray_tpu._private.membership import MembershipTable
+    from ray_tpu._private.metrics_agent import ClusterMetrics
+
+    cm = ClusterMetrics(staleness=0.2)
+    table = MembershipTable()
+    table.mint_epoch("aa" * 8)
+
+    def on_event(ev):  # the runtime's _membership_event equivalent
+        if ev.get("event") == "dead":
+            cm.mark_node_dead(ev["node_id"])
+
+    table.subscribe(on_event)
+    cm.update("aa" * 8, {"pid": 1, "component": "daemon",
+                         "metrics": _counter_entry("ts_dead_total", 5)})
+    cm.update("bb" * 8, {"pid": 1, "component": "daemon",
+                         "metrics": _counter_entry("ts_live_total", 5)})
+    assert cm.timeseries.series_count() == 2
+    assert table.declare_dead("aa" * 8, reason="test")
+    time.sleep(0.3)
+    cm.evict_stale()
+    assert cm.timeseries.series_count() == 1
+    names = cm.timeseries.names()
+    assert names == ["ts_live_total"]
+
+
+def test_bounded_memory_under_series_churn(monkeypatch):
+    """At most max_series distinct label sets are held; the rest are
+    counted, not stored — and ring buffers stay bounded no matter how
+    many samples one series receives."""
+    monkeypatch.setenv("RAY_TPU_TIMESERIES_MAX_SERIES", "10")
+    store = TimeSeriesStore(window_s=300, staleness=30)
+    assert store.max_series == 10
+    now = time.monotonic()
+    for i in range(100):
+        store.ingest_batch(
+            "n1", 1, "daemon",
+            _counter_entry("ts_churn_total", i, tag_keys=("k",),
+                           key=(f"v{i}",)), now=now)
+    assert store.series_count() == 10
+    assert store.dropped_series == 90
+    # One series hammered for far longer than the window stays bounded.
+    for i in range(2000):
+        store.ingest_batch("n1", 1, "daemon",
+                           _gauge_entry("ts_hammer", i), now=now - 2000 + i)
+    key = ("ts_hammer", tuple(sorted({
+        "node_id": "n1", "pid": "1", "component": "daemon"}.items())))
+    series = store._series.get(key)
+    if series is not None:  # may have been dropped by the series cap
+        assert len(series.raw) <= series.raw.maxlen
+        for step, ring in series.rollups.items():
+            assert len(ring) <= ring.maxlen
+
+
+def test_window_knob_disables_store(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_TIMESERIES_WINDOW_S", "0")
+    store = TimeSeriesStore(staleness=30)
+    assert not store.enabled
+    store.ingest_batch("n1", 1, "daemon", _gauge_entry("ts_off", 1))
+    assert store.series_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# Runtime + HTTP surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_runtime_get_timeseries_reset_safe(ray_start_regular):
+    """Acceptance: runtime.get_timeseries derives a reset-safe rate
+    across a simulated process restart."""
+    from ray_tpu._private.worker import global_worker
+    rt = global_worker.runtime
+    store = rt._cluster_metrics.timeseries
+    now = time.monotonic()
+    for i in range(10):
+        store.ingest_batch("cc" * 8, 7, "daemon",
+                           _counter_entry("ts_restart_total", 100 * i),
+                           now=now - 20 + i)
+    for i in range(10):
+        store.ingest_batch("cc" * 8, 8, "daemon",  # same labels, reset
+                           _counter_entry("ts_restart_total", 50 * i),
+                           now=now - 10 + i)
+    out = rt.get_timeseries("ts_restart_total", window=60)
+    assert out["name"] == "ts_restart_total"
+    rates = [s["summary"]["rate"] for s in out["series"]]
+    assert all(r >= 0 for r in rates)
+    assert sum(rates) > 0
+    # pid differs so the restart lands on a sibling series; filtering by
+    # label narrows to one.
+    narrowed = rt.get_timeseries("ts_restart_total", labels={"pid": "8"},
+                                 window=60)
+    assert len(narrowed["series"]) == 1
+    assert narrowed["series"][0]["summary"]["rate"] == \
+        pytest.approx(450 / 9, rel=1e-6)
+
+
+def test_dashboard_timeseries_and_serve_stats_shape(ray_start_regular):
+    from ray_tpu.dashboard.head import DashboardHead
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get([noop.remote() for _ in range(5)])
+    from ray_tpu._private.worker import global_worker
+    rt = global_worker.runtime
+    rt.cluster_metrics_text()  # fold + snapshot the head registry
+    time.sleep(1.1)  # a second scrape lands in a later 1s bucket
+    ray_tpu.get([noop.remote() for _ in range(5)])
+    rt.cluster_metrics_text()
+    head = DashboardHead(port=0)
+    port = head.start()
+    try:
+        listing = _get_json(port, "/api/timeseries")
+        assert "ray_tpu_tasks_finished_total" in listing["series_names"]
+        assert listing["series"] >= 1
+        out = _get_json(
+            port, "/api/timeseries?name=ray_tpu_tasks_finished_total"
+                  "&window=60&step=1")
+        assert out["name"] == "ray_tpu_tasks_finished_total"
+        assert out["window_s"] == 60
+        assert out["series"], out
+        row = out["series"][0]
+        assert row["kind"] == "counter"
+        assert row["labels"]["component"] == "driver"
+        assert len(row["points"]) >= 2
+        assert row["summary"]["rate"] > 0
+        # label filter: a bogus node_id matches nothing
+        empty = _get_json(
+            port, "/api/timeseries?name=ray_tpu_tasks_finished_total"
+                  "&label.node_id=ffff")
+        assert empty["series"] == []
+        # bad params -> 400, not a stack trace
+        with pytest.raises(urllib.error.HTTPError):
+            _get_json(port, "/api/timeseries?name=x&window=abc")
+        stats = _get_json(port, "/api/serve/stats?window=30")
+        assert stats["window_s"] == 30
+        assert "deployments" in stats
+        status = _get_json(port, "/api/cluster_status")
+        assert "membership" in status
+        ev = _get_json(port, "/api/event_stats")
+        assert "local" in ev and "cluster" in ev
+    finally:
+        head.stop()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: 2-daemon cluster under load -> `ray-tpu top --once`
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_top_once_two_daemon_cluster(monkeypatch, capsys):
+    monkeypatch.setenv("RAY_TPU_METRICS_EXPORT_INTERVAL_S", "0.2")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    from ray_tpu import serve
+    procs = []
+    try:
+        host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+        procs = [_spawn_daemon(port, num_cpus=2, resources={"remote": 2})
+                 for _ in range(2)]
+        _wait_for_resource("remote", 4)
+
+        @ray_tpu.remote(resources={"remote": 1},
+                        runtime_env={"worker_process": False})
+        def work(x):
+            return x * 2
+
+        @serve.deployment(num_replicas=2)
+        def echo(x):
+            return {"got": x}
+
+        handle = serve.run(echo.bind())
+        from ray_tpu._private.worker import global_worker
+        rt = global_worker.runtime
+        # Two+ load rounds with store samples between them: rates must
+        # come from windowed history, not a single scrape.
+        for _ in range(3):
+            ray_tpu.get([work.remote(i) for i in range(8)], timeout=60)
+            ray_tpu.get([handle.remote(i) for i in range(10)], timeout=60)
+            rt.cluster_metrics_text()  # head agent sample -> store
+            time.sleep(1.1)
+        snap = rt.top_snapshot(window=60)
+        daemon_rows = [n for n in snap["nodes"]
+                       if n["node_id"] != rt.head_node_id.hex()]
+        assert len(daemon_rows) == 2
+        assert sum(n["tasks_finished_per_s"] for n in daemon_rows) > 0
+        # Daemons carry membership internals; phi/heartbeat are live.
+        for n in daemon_rows:
+            assert n["epoch"] is not None
+            assert n["phi"] is not None
+        assert snap["tasks"]["finished_per_s"] > 0
+        assert "echo" in snap["serve"], snap["serve"]
+        assert snap["serve"]["echo"]["qps"] > 0
+        assert snap["serve"]["echo"]["p95_s"] > 0
+        assert snap["serve"]["echo"]["replicas"] >= 1
+        # The CLI frame renders from the same snapshot.
+        from ray_tpu.scripts.cli import cmd_top
+        rc = cmd_top(argparse.Namespace(once=True, interval=2.0,
+                                        window=60.0, json=False))
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "ray-tpu top" in out
+        assert "DEPLOYMENT" in out and "echo" in out
+        assert "NODE" in out and "SUB/S" in out
+        # `ray-tpu status` shows the membership lines too.
+        from ray_tpu._private.state import status_summary
+        text = status_summary()
+        assert "Membership:" in text
+        assert "epoch=" in text and "phi=" in text
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=10)
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Satellites: list_tasks recency/limit/node_id, daemon EventStats merge
+# ---------------------------------------------------------------------------
+
+
+def test_list_tasks_recency_limit_duration_node(ray_start_regular):
+    from ray_tpu.experimental.state import api
+
+    @ray_tpu.remote
+    def first():
+        return 1
+
+    @ray_tpu.remote
+    def second():
+        time.sleep(0.05)
+        return 2
+
+    ray_tpu.get(first.remote())
+    time.sleep(0.02)
+    ray_tpu.get(second.remote())
+    rows = api.list_tasks(limit=1)
+    assert len(rows) == 1
+    # limit applies AFTER the recency sort: the newest task survives.
+    assert rows[0]["name"].endswith("second")
+    assert rows[0]["state"] == "FINISHED"
+    assert rows[0]["duration_s"] is not None
+    assert rows[0]["duration_s"] >= 0.05
+    assert "node_id" in rows[0]
+    all_rows = api.list_tasks()
+    by_name = {r["name"].rsplit(".", 1)[-1]: r for r in all_rows}
+    assert by_name["first"]["duration_s"] is not None
+
+
+def test_daemon_event_stats_merged(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_METRICS_EXPORT_INTERVAL_S", "0.2")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    proc = None
+    try:
+        host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+        proc = _spawn_daemon(port, num_cpus=2, resources={"remote": 2})
+        _wait_for_resource("remote", 2)
+
+        @ray_tpu.remote(resources={"remote": 1},
+                        runtime_env={"worker_process": False})
+        def hit():
+            return 1
+
+        ray_tpu.get([hit.remote() for _ in range(4)], timeout=60)
+        from ray_tpu._private.worker import global_worker
+        rt = global_worker.runtime
+        merged = {}
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            merged = rt.cluster_event_stats()
+            if any(k.endswith(":daemon") for k in merged):
+                break
+            time.sleep(0.2)
+        daemon_keys = [k for k in merged if k.endswith(":daemon")]
+        assert daemon_keys, merged
+        stats = merged[daemon_keys[0]]
+        assert stats  # {handler: {count, mean_run_ms, ...}}
+        sample = next(iter(stats.values()))
+        assert "count" in sample and "mean_run_ms" in sample
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+        ray_tpu.shutdown()
