@@ -44,6 +44,9 @@ _fast_transfer = {"in": 0, "out": 0}
 _fast_chunks = {"n": 0}
 _fast_lease_immediate = {"n": 0}
 _fast_channel = {"bytes": 0, "acks": 0}
+# Continuous-profiler stack walks: bumped every sampler tick (hz rate),
+# folded into ray_tpu_profile_samples_total at each snapshot.
+_fast_profile = {"samples": 0}
 
 
 def record_store_hit() -> None:
@@ -74,6 +77,12 @@ def record_channel_bytes_sent(nbytes: int) -> None:
 
 def record_channel_ack_sent() -> None:
     _fast_channel["acks"] += 1
+
+
+def record_profile_samples(n: int) -> None:
+    """Stacks walked by one ProfilerAgent tick: a dict int add on the
+    sampler thread, folded at flush."""
+    _fast_profile["samples"] += n
 
 
 def record_lease_immediate() -> None:
@@ -118,6 +127,10 @@ def flush_fast_counters() -> None:
     if n:
         _fast_channel["acks"] -= n
         channel_acks_sent().inc(n)
+    n = _fast_profile["samples"]
+    if n:
+        _fast_profile["samples"] -= n
+        profile_samples().inc(n)
     n = _fast_lease_immediate["n"]
     if n:
         _fast_lease_immediate["n"] -= n
@@ -486,6 +499,27 @@ def loop_lag() -> Gauge:
         "period/deadline the loop actually woke (head membership sweep, "
         "dashboard asyncio loop, metrics agent ticks).",
         tag_keys=("loop",))
+
+
+# -- continuous profiling --------------------------------------------------
+
+
+def profile_samples() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_profile_samples_total",
+        "Thread stacks sampled by this process's continuous "
+        "ProfilerAgent (profiling.py; RAY_TPU_PROFILE_HZ ticks x "
+        "threads walked).")
+
+
+def profile_batches_dropped() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_profile_batches_dropped_total",
+        "profile_batch publishes that failed (no live head session / "
+        "full sender); the samples are refunded into the accumulator "
+        "and ride the next tick.")
 
 
 # -- train fault tolerance -------------------------------------------------

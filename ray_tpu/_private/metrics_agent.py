@@ -78,12 +78,23 @@ class MetricsAgent:
 
     def __init__(self, publish: Callable[[dict], bool], *,
                  component: str, interval_s: Optional[float] = None,
-                 start: bool = True):
+                 start: bool = True,
+                 publish_profile: Optional[Callable[[dict], bool]] = None):
         self._publish = publish
         self.component = component
         self.pid = os.getpid()
         self.interval_s = (export_interval_s() if interval_s is None
                            else interval_s)
+        # Continuous profiling rides the metrics cadence: when the host
+        # supplies a profile transport, the agent owns a ProfilerAgent
+        # and drains it into `publish_profile` every tick. A zero
+        # RAY_TPU_PROFILE_HZ leaves _profiler None and the whole plane
+        # dormant.
+        self._publish_profile = publish_profile
+        self._profiler = None
+        if publish_profile is not None:
+            from ray_tpu._private import profiling
+            self._profiler = profiling.ensure_profiler(component)
         # Every agent folds the hot-path fast cells before snapshotting,
         # so built-in counters bumped via dict adds reach the registry.
         from ray_tpu._private import builtin_metrics
@@ -150,6 +161,7 @@ class MetricsAgent:
             self._prev = cur
             spans, self._span_cursor = _tracing.drain_finished_spans(
                 self._span_cursor)
+            self._maybe_publish_profile()
             if not batch_metrics and not spans:
                 return False
             batch = {"pid": self.pid, "component": self.component,
@@ -159,6 +171,35 @@ class MetricsAgent:
             # resend everything once the channel recovers.
             self._force_full = not sent
             return sent
+
+    def _maybe_publish_profile(self) -> None:
+        """Drain the process profiler into its transport. A dropped
+        frame refunds the stacks into the live window (they merge with
+        the next drain) and bumps the drop counter — sample weight is
+        never silently lost."""
+        if self._profiler is None or self._publish_profile is None:
+            return
+        try:
+            window = self._profiler.drain()
+        except Exception:  # noqa: BLE001 - profiling is best-effort
+            return
+        if not window:
+            return
+        batch = {"pid": self.pid, "component": self.component,
+                 "stacks": window["stacks"],
+                 "samples": window["samples"],
+                 "duration_s": window["duration_s"]}
+        try:
+            sent = bool(self._publish_profile(batch))
+        except Exception:  # noqa: BLE001 - transport must not kill polls
+            sent = False
+        if not sent:
+            from ray_tpu._private import builtin_metrics
+            self._profiler.refund(window["stacks"])
+            try:
+                builtin_metrics.profile_batches_dropped().inc()
+            except Exception:  # noqa: BLE001 - counter is best-effort
+                pass
 
     def stop(self, drain: bool = True) -> None:
         self._stop_event.set()
@@ -170,6 +211,13 @@ class MetricsAgent:
                 self.poll_once(force_full=True)
             except Exception:  # noqa: BLE001 - teardown is best-effort
                 pass
+        if self._profiler is not None:
+            from ray_tpu._private import profiling
+            if profiling.global_profiler() is self._profiler:
+                profiling.shutdown_profiler()
+            else:
+                self._profiler.stop()
+            self._profiler = None
 
 
 class _Origin:
@@ -190,6 +238,7 @@ class ClusterMetrics:
     def __init__(self, staleness: Optional[float] = None):
         from ray_tpu._private.trace_assembler import TraceAssembler
         from ray_tpu._private.timeseries import TimeSeriesStore
+        from ray_tpu._private.profile_store import ProfileStore
         self._lock = threading.Lock()
         self._origins: Dict[Tuple[str, int, str], _Origin] = {}
         self._spans: deque = deque(maxlen=MAX_CLUSTER_SPANS)
@@ -200,6 +249,9 @@ class ClusterMetrics:
         # Windowed history behind runtime.get_timeseries / serve stats /
         # `ray-tpu top` — every merged sample is also appended here.
         self.timeseries = TimeSeriesStore(staleness=self.staleness)
+        # Continuous-profiling plane: profile_batch frames land here and
+        # the loop-lag flight recorder watches every merged lag sample.
+        self.profiles = ProfileStore(staleness=self.staleness)
 
     def update(self, node_id: str, batch: Dict[str, Any]) -> None:
         """Merge one ``metrics_batch`` payload. Cumulative values make the
@@ -239,6 +291,19 @@ class ClusterMetrics:
                 origin.event_stats = stats
         self.timeseries.ingest_batch(
             key[0], key[1], key[2], batch.get("metrics", ()))
+        # Flight recorder: any loop-lag sample crossing the configured
+        # threshold snapshots the lagging origin's hot stacks while the
+        # window still holds them.
+        for entry in batch.get("metrics", ()):
+            if entry.get("name") != "ray_tpu_loop_lag_seconds":
+                continue
+            for tag_vals, lag in entry.get("series", {}).items():
+                loop = tag_vals[0] if tag_vals else ""
+                try:
+                    self.profiles.observe_loop_lag(
+                        str(loop), float(lag), key[0], key[1], key[2])
+                except Exception:  # noqa: BLE001 - recorder is best-effort
+                    logger.exception("flight recorder observe failed")
         for span in batch.get("spans", ()):
             stamped = dict(span)
             stamped["node_id"] = node_id or ""
@@ -246,6 +311,14 @@ class ClusterMetrics:
             stamped["component"] = batch.get("component", "")
             self._spans.append(stamped)
             self.traces.add_span(stamped)
+
+    def update_profile(self, node_id: str, batch: Dict[str, Any]) -> None:
+        """Merge one ``profile_batch`` payload into the profile store."""
+        self.profiles.ingest(
+            node_id or "", int(batch.get("pid", 0)),
+            str(batch.get("component", "")),
+            batch.get("stacks") or {},
+            samples=int(batch.get("samples", 0)))
 
     def mark_node_dead(self, node_id: str) -> None:
         """Start the staleness clock for every origin of a dead node; the
@@ -257,6 +330,7 @@ class ClusterMetrics:
                 if nid == node_id and origin.dead_at is None:
                     origin.dead_at = now
         self.timeseries.mark_node_dead(node_id)
+        self.profiles.mark_node_dead(node_id)
 
     def evict_stale(self) -> None:
         now = time.monotonic()
@@ -267,6 +341,7 @@ class ClusterMetrics:
             for key in dead:
                 del self._origins[key]
         self.timeseries.evict_stale()
+        self.profiles.evict_stale()
 
     def cluster_event_stats(self) -> Dict[str, Dict[str, Any]]:
         """EventStats summaries shipped in metrics_batch frames, keyed

@@ -466,6 +466,7 @@ class NodeConnection:
         # loop routes them here instead of the pending table).
         self.on_log_batch = None
         self.on_metrics_batch = None
+        self.on_profile_batch = None
         self.on_object_spilled = None
         self.on_object_unspilled = None
         # Dedicated liveness socket (see HeadServer._health_check_loop):
@@ -600,12 +601,14 @@ class NodeConnection:
                 for reply in replies:
                     kind = reply.get("type")
                     if kind in ("log_batch", "metrics_batch",
-                                "object_spilled", "object_unspilled"):
+                                "profile_batch", "object_spilled",
+                                "object_unspilled"):
                         # Daemon-initiated push, not a reply: hand to
                         # the runtime's fan-out and move on.
                         handler = {
                             "log_batch": self.on_log_batch,
                             "metrics_batch": self.on_metrics_batch,
+                            "profile_batch": self.on_profile_batch,
                             "object_spilled": self.on_object_spilled,
                             "object_unspilled": self.on_object_unspilled,
                         }[kind]
@@ -953,12 +956,16 @@ class NodeConnection:
         return _loads(reply["value"])
 
     def profile(self, duration: float = 5.0, hz: int = 100,
-                fmt: str = "folded"):
+                fmt: str = "folded", pid: Optional[int] = None):
         """Ask the daemon to sample ITS OWN stacks (cooperative remote
-        profiling; reference: dashboard profile endpoints)."""
-        reply = self._request(
-            {"type": "profile", "duration": duration, "hz": hz,
-             "fmt": fmt}, timeout=duration + 30)
+        profiling; reference: dashboard profile endpoints). ``pid``
+        retargets the burst at one of the daemon's pool workers — the
+        daemon relays a profile request over that worker's pipe."""
+        msg = {"type": "profile", "duration": duration, "hz": hz,
+               "fmt": fmt}
+        if pid is not None:
+            msg["pid"] = int(pid)
+        reply = self._request(msg, timeout=duration + 30)
         return _loads(reply["value"])
 
 
@@ -2217,6 +2224,7 @@ class NodeDaemon:
                 # Worker metric batches hop worker -> this daemon ->
                 # head, keeping the worker's own pid/component labels.
                 self._pool.metrics_sink = self._publish_metrics_batch
+                self._pool.profile_sink = self._publish_profile_batch
             return self._pool
 
     def _task_uses_worker_process(self, msg: dict) -> bool:
@@ -2628,12 +2636,27 @@ class NodeDaemon:
                     msg["key"], msg["size"]))
             elif kind == "profile":
                 # Self-sampled stacks (reference: profile_manager.py
-                # py-spy-on-demand, here cooperative — no ptrace).
+                # py-spy-on-demand, here cooperative — no ptrace). A
+                # pid field retargets the burst at a pool worker via
+                # its request pipe. Runs on a per-message thread
+                # (_route_frame), so the seconds-long burst never
+                # stalls the daemon recv loop.
                 from ray_tpu._private.profiling import profile_self
-                self._reply(sock, req_id, value=profile_self(
-                    min(float(msg.get("duration", 5.0)), 60.0),
-                    int(msg.get("hz", 100)),
-                    msg.get("fmt", "folded")))
+                from ray_tpu._private.ray_config import \
+                    runtime_config_value
+                cap = float(runtime_config_value(
+                    "profile_max_duration_s", 60.0))
+                duration = min(float(msg.get("duration", 5.0)), cap)
+                hz = int(msg.get("hz", 100))
+                fmt = msg.get("fmt", "folded")
+                pid = msg.get("pid")
+                if pid is not None and int(pid) != os.getpid():
+                    self._reply(sock, req_id,
+                                value=self._profile_worker(
+                                    int(pid), duration, hz, fmt))
+                else:
+                    self._reply(sock, req_id, value=profile_self(
+                        duration, hz, fmt))
             elif kind == "stats":
                 self._reply(sock, req_id, value={
                     "transfer": dict(self._table.stats),
@@ -2877,8 +2900,9 @@ class NodeDaemon:
             self._start_log_streaming(session_id)
         if self._metrics_agent is None:
             from ray_tpu._private.metrics_agent import MetricsAgent
-            agent = MetricsAgent(self._publish_metrics_batch,
-                                 component="daemon")
+            agent = MetricsAgent(
+                self._publish_metrics_batch, component="daemon",
+                publish_profile=self._publish_profile_batch)
             agent.add_collector(self._collect_daemon_metrics)
             self._metrics_agent = agent
         if self._use_worker_processes and not self._prestarted:
@@ -3116,6 +3140,52 @@ class NodeDaemon:
             if stats:
                 msg["event_stats"] = stats
         return bool(sender.send(msg))
+
+    def _publish_profile_batch(self, batch: dict) -> bool:
+        """Ship one folded-stack window (the daemon's own profiler, or
+        a worker's piggybacked window) as a ``profile_batch`` push.
+        Additive post-v9: an old head's recv loop drops the unknown
+        push type on the floor, so mixed clusters stay compatible."""
+        chan = self._chan
+        sender = self._reply_senders.get(chan) if chan is not None \
+            else None
+        if sender is None:
+            return False
+        msg = dict(batch)
+        msg["type"] = "profile_batch"
+        msg["node_id"] = self.node_id_hex or ""
+        return bool(sender.send(msg))
+
+    def _profile_worker(self, pid: int, duration: float, hz: int,
+                        fmt: str):
+        """Relay a profile burst to the pool worker owning ``pid`` over
+        its request pipe (cooperative — the worker samples itself, no
+        ptrace/py-spy needed on the node). The pipe is one-in-flight: a
+        worker mid-task starts sampling when its current task ends."""
+        pool = self._pool
+        handle = None
+        if pool is not None:
+            for w in list(pool._all):
+                if w.pid == pid:
+                    handle = w
+                    break
+        if handle is None:
+            raise ValueError(
+                f"pid {pid} is not a live worker of this node")
+        reply = handle.request({"type": "profile", "duration": duration,
+                                "hz": hz},
+                               timeout=duration + 30)
+        if not reply.get("ok"):
+            raise RuntimeError(reply.get("error")
+                               or "worker profile failed")
+        counts = reply.get("stacks") or {}
+        if fmt == "dict":
+            return counts
+        if fmt == "speedscope":
+            from ray_tpu._private.profiling import folded_to_speedscope
+            return folded_to_speedscope(counts, name=f"worker-{pid}",
+                                        hz=hz)
+        return "\n".join(f"{k} {v}" for k, v in sorted(counts.items()))
 
     def _collect_daemon_metrics(self) -> None:
         """Refresh daemon-side gauges before each export snapshot."""

@@ -1,0 +1,353 @@
+"""Head-side store for the cluster's continuous profiles.
+
+The per-process :class:`~ray_tpu._private.profiling.ProfilerAgent`
+ships folded stacks as ``profile_batch`` frames on the metrics cadence;
+this store merges them per origin ``(node_id, pid, component)`` into
+bounded WINDOWED buckets (reference: Google-Wide Profiling's
+always-on collector; the reference Ray dashboard only has on-demand
+py-spy, so saturation incidents there are unattributable after the
+fact). It serves three surfaces:
+
+* Merged flamegraphs — :meth:`flame` renders the last ``window``
+  seconds across any origin filter as collapsed text or a speedscope
+  document, each stack rooted at ``component@node/pid`` so one graph
+  shows where the whole cluster burns CPU.
+* Window-vs-window diffs — :meth:`diff` subtracts the previous window's
+  weights from the current one per stack ("what got hot since then").
+* The loop-lag FLIGHT RECORDER — :meth:`observe_loop_lag` watches every
+  ingested ``ray_tpu_loop_lag_seconds`` sample; a crossing of
+  ``RAY_TPU_PROFILE_FLIGHT_LAG_S`` snapshots the lagging origin's hot
+  stacks from the live window into a bounded incident ring
+  (``/api/profile/incidents``, ``ray-tpu profile --report``) — the
+  gauge spike arrives already annotated with named functions.
+
+Bounds mirror ``timeseries.py``: at most ``profile_max_series``
+origins, at most ``profile_max_stacks`` distinct stacks per bucket
+(overflow folds into a ``<truncated>`` leaf and is counted), retention
+``RAY_TPU_PROFILE_WINDOW_S`` (``<= 0`` disables the store), dead-node
+eviction off the membership death push, and a ``profile_max_incidents``
+ring. All timestamps are ``time.monotonic()``; reads report ages.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_WINDOW_S = 300.0
+DEFAULT_MAX_ORIGINS = 256
+DEFAULT_MAX_STACKS = 2000
+DEFAULT_FLIGHT_LAG_S = 1.0
+DEFAULT_MAX_INCIDENTS = 32
+#: Bucket width: the merge granularity for windows and diffs. Fine
+#: enough that a 60s diff window sees several buckets, coarse enough
+#: that a 300s window is ~10 dicts per origin.
+BUCKET_S = 30.0
+#: Stacks kept per flight-recorder incident.
+INCIDENT_TOP_N = 20
+#: Minimum spacing between incidents for the SAME loop gauge — a
+#: saturated loop re-crossing the threshold every tick must not flood
+#: the ring with near-identical snapshots.
+INCIDENT_COOLDOWN_S = 30.0
+
+_TRUNCATED_KEY = "<truncated>"
+
+
+def _cfg(env: str, flag: str, default: float) -> float:
+    raw = os.environ.get(env, "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    from ray_tpu._private.ray_config import runtime_config_value
+    return float(runtime_config_value(flag, default))
+
+
+def configured_window_s() -> float:
+    return _cfg("RAY_TPU_PROFILE_WINDOW_S", "profile_window_s",
+                DEFAULT_WINDOW_S)
+
+
+def configured_max_origins() -> int:
+    return int(_cfg("RAY_TPU_PROFILE_MAX_SERIES", "profile_max_series",
+                    DEFAULT_MAX_ORIGINS))
+
+
+def configured_max_stacks() -> int:
+    return int(_cfg("RAY_TPU_PROFILE_MAX_STACKS", "profile_max_stacks",
+                    DEFAULT_MAX_STACKS))
+
+
+def configured_flight_lag_s() -> float:
+    """Flight-recorder trigger threshold; ``<= 0`` disables it."""
+    return _cfg("RAY_TPU_PROFILE_FLIGHT_LAG_S", "profile_flight_lag_s",
+                DEFAULT_FLIGHT_LAG_S)
+
+
+def configured_max_incidents() -> int:
+    return int(_cfg("RAY_TPU_PROFILE_MAX_INCIDENTS",
+                    "profile_max_incidents", DEFAULT_MAX_INCIDENTS))
+
+
+class _OriginProfile:
+    """One process's windowed folded-stack buckets."""
+
+    __slots__ = ("buckets", "last_seen", "dead_at", "samples")
+
+    def __init__(self, maxlen: int):
+        # deque of [bucket_start_mono, {folded_stack: count}] — append
+        # only at the tail; maxlen retires buckets past the window.
+        self.buckets: deque = deque(maxlen=maxlen)
+        self.last_seen = time.monotonic()
+        self.dead_at: Optional[float] = None
+        self.samples = 0  # lifetime stack walks merged into this origin
+
+
+class ProfileStore:
+    """Bounded windowed folded-stack buckets per cluster origin."""
+
+    def __init__(self, window_s: Optional[float] = None,
+                 max_origins: Optional[int] = None,
+                 max_stacks: Optional[int] = None,
+                 staleness: Optional[float] = None,
+                 bucket_s: float = BUCKET_S):
+        self.window_s = (configured_window_s() if window_s is None
+                         else float(window_s))
+        self.max_origins = (configured_max_origins() if max_origins is None
+                            else int(max_origins))
+        self.max_stacks = (configured_max_stacks() if max_stacks is None
+                           else int(max_stacks))
+        self.staleness = (30.0 if staleness is None else float(staleness))
+        self.bucket_s = float(bucket_s)
+        self.enabled = self.window_s > 0
+        self._lock = threading.Lock()
+        self._origins: Dict[Tuple[str, int, str], _OriginProfile] = {}
+        self.dropped_origins = 0
+        self.dropped_stacks = 0
+        self._incidents: deque = deque(maxlen=configured_max_incidents())
+        self._incident_last: Dict[str, float] = {}  # loop -> mono ts
+
+    # -- ingest ----------------------------------------------------------
+
+    def _buckets_per_origin(self) -> int:
+        return max(2, int(self.window_s / self.bucket_s) + 1)
+
+    def ingest(self, node_id: str, pid: int, component: str,
+               stacks: Dict[str, int], samples: int = 0,
+               now: Optional[float] = None) -> None:
+        """Merge one ``profile_batch`` payload into its origin's current
+        bucket. Per-bucket distinct-stack count is capped: overflow
+        weight folds into ``<truncated>`` (total sample weight stays
+        honest even when stack shapes churn without bound)."""
+        if not self.enabled or not stacks:
+            return
+        now = time.monotonic() if now is None else now
+        key = (node_id or "", int(pid or 0), component or "")
+        with self._lock:
+            origin = self._origins.get(key)
+            if origin is None:
+                if len(self._origins) >= self.max_origins:
+                    self.dropped_origins += 1
+                    return
+                origin = self._origins[key] = _OriginProfile(
+                    self._buckets_per_origin())
+            origin.last_seen = now
+            origin.dead_at = None  # a publishing origin is alive
+            bucket_ts = now - (now % self.bucket_s)
+            if origin.buckets and origin.buckets[-1][0] >= bucket_ts:
+                bucket = origin.buckets[-1][1]
+            else:
+                bucket = {}
+                origin.buckets.append([bucket_ts, bucket])
+            for stack, count in stacks.items():
+                if stack in bucket or len(bucket) < self.max_stacks:
+                    bucket[stack] = bucket.get(stack, 0) + int(count)
+                else:
+                    bucket[_TRUNCATED_KEY] = \
+                        bucket.get(_TRUNCATED_KEY, 0) + int(count)
+                    self.dropped_stacks += 1
+            walked = int(samples or 0) or sum(
+                int(c) for c in stacks.values())
+            origin.samples += walked
+
+    # -- membership / bounds ---------------------------------------------
+
+    def mark_node_dead(self, node_id: str) -> None:
+        """Start the staleness clock for the node's origins (wired to
+        the membership death push, like the time-series store)."""
+        now = time.monotonic()
+        with self._lock:
+            for (nid, _pid, _comp), origin in self._origins.items():
+                if nid == node_id and origin.dead_at is None:
+                    origin.dead_at = now
+
+    def evict_stale(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            dead = [key for key, origin in self._origins.items()
+                    if (origin.dead_at is not None
+                        and now - origin.dead_at > self.staleness)
+                    or now - origin.last_seen > self.window_s
+                    + self.staleness]
+            for key in dead:
+                del self._origins[key]
+
+    def origins(self) -> List[Tuple[str, int, str]]:
+        with self._lock:
+            return list(self._origins)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "origins": len(self._origins),
+                "dropped_origins": self.dropped_origins,
+                "dropped_stacks": self.dropped_stacks,
+                "incidents": len(self._incidents),
+                "window_s": self.window_s,
+            }
+
+    # -- queries ---------------------------------------------------------
+
+    def merged(self, component: Optional[str] = None,
+               node_id: Optional[str] = None,
+               start_age_s: Optional[float] = None,
+               end_age_s: float = 0.0,
+               prefix_origin: bool = True,
+               now: Optional[float] = None) -> Dict[str, int]:
+        """Folded stacks merged across matching origins over the age
+        range ``[end_age_s, start_age_s]`` seconds ago (default: the
+        whole retention window). ``prefix_origin`` roots every stack at
+        ``component@node8/pid`` so the merged flamegraph keeps cluster
+        attribution."""
+        now = time.monotonic() if now is None else now
+        start_age = self.window_s if start_age_s is None \
+            else float(start_age_s)
+        oldest = now - start_age
+        newest = now - float(end_age_s)
+        merged: Dict[str, int] = {}
+        with self._lock:
+            items = list(self._origins.items())
+        for (nid, pid, comp), origin in items:
+            if component is not None and comp != component:
+                continue
+            if node_id is not None and not nid.startswith(node_id):
+                continue
+            root = f"{comp}@{nid[:8] or 'head'}/{pid}"
+            for bucket_ts, stacks in list(origin.buckets):
+                # A bucket counts if it overlaps the age range at all.
+                if bucket_ts + self.bucket_s <= oldest or \
+                        bucket_ts > newest:
+                    continue
+                for stack, count in stacks.items():
+                    key = f"{root};{stack}" if prefix_origin else stack
+                    merged[key] = merged.get(key, 0) + count
+        return merged
+
+    def flame(self, component: Optional[str] = None,
+              node_id: Optional[str] = None,
+              window: Optional[float] = None, fmt: str = "folded"):
+        """Merged cluster/per-component flamegraph over the last
+        ``window`` seconds: collapsed text ('folded'), a speedscope
+        document ('speedscope'), or the raw mapping ('dict')."""
+        counts = self.merged(component=component, node_id=node_id,
+                             start_age_s=window)
+        if fmt == "dict":
+            return counts
+        if fmt == "speedscope":
+            from ray_tpu._private.profiling import folded_to_speedscope
+            return folded_to_speedscope(counts, name="ray_tpu-cluster")
+        if fmt == "folded":
+            return "\n".join(f"{k} {v}"
+                             for k, v in sorted(counts.items()))
+        raise ValueError(f"unknown flame format {fmt!r}")
+
+    def diff(self, window: float = 60.0,
+             component: Optional[str] = None,
+             node_id: Optional[str] = None,
+             limit: int = 50) -> List[Dict[str, Any]]:
+        """Window-vs-window stack diff: current ``window`` seconds vs
+        the ``window`` before it, sorted by weight delta (descending) —
+        "which stacks got hot". Entries carry current/previous counts."""
+        w = float(window)
+        cur = self.merged(component=component, node_id=node_id,
+                          start_age_s=w)
+        prev = self.merged(component=component, node_id=node_id,
+                           start_age_s=2 * w, end_age_s=w)
+        out = []
+        for stack in set(cur) | set(prev):
+            c, p = cur.get(stack, 0), prev.get(stack, 0)
+            if c == p:
+                continue
+            out.append({"stack": stack, "current": c, "previous": p,
+                        "delta": c - p})
+        out.sort(key=lambda e: -abs(e["delta"]))
+        return out[:max(1, int(limit))]
+
+    def top_stacks(self, component: Optional[str] = None,
+                   node_id: Optional[str] = None,
+                   window: Optional[float] = None,
+                   n: int = INCIDENT_TOP_N) -> List[List[Any]]:
+        counts = self.merged(component=component, node_id=node_id,
+                             start_age_s=window)
+        ranked = sorted(counts.items(), key=lambda kv: -kv[1])
+        return [[stack, count] for stack, count in ranked[:n]]
+
+    # -- flight recorder -------------------------------------------------
+
+    def observe_loop_lag(self, loop: str, lag_s: float, node_id: str,
+                         pid: int, component: str,
+                         now: Optional[float] = None) -> bool:
+        """Feed one ``ray_tpu_loop_lag_seconds`` sample; a threshold
+        crossing snapshots the lagging origin's hot stacks from the
+        current window into the incident ring. Returns True when an
+        incident was recorded."""
+        if not self.enabled:
+            return False
+        threshold = configured_flight_lag_s()
+        if threshold <= 0 or lag_s < threshold:
+            return False
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            last = self._incident_last.get(loop)
+            if last is not None and now - last < INCIDENT_COOLDOWN_S:
+                return False
+            self._incident_last[loop] = now
+        # The lagging component's view first (this origin's node +
+        # component); empty — e.g. lag arrived before any profile
+        # batch — falls back to the whole cluster so the incident is
+        # never stackless when ANY profile data exists.
+        stacks = self.top_stacks(component=component or None,
+                                 node_id=node_id or None)
+        scope = "origin"
+        if not stacks:
+            stacks = self.top_stacks()
+            scope = "cluster"
+        incident = {
+            "loop": loop,
+            "lag_s": float(lag_s),
+            "threshold_s": threshold,
+            "node_id": node_id or "",
+            "pid": int(pid or 0),
+            "component": component or "",
+            "window_s": self.window_s,
+            "scope": scope,
+            "top_stacks": stacks,
+            "recorded_mono": now,
+        }
+        with self._lock:
+            self._incidents.appendleft(incident)
+        return True
+
+    def incidents(self) -> List[Dict[str, Any]]:
+        """Newest-first incident ring; ``age_s`` replaces the internal
+        monotonic stamp so callers never see raw monotonic values."""
+        now = time.monotonic()
+        with self._lock:
+            rows = [dict(rec) for rec in self._incidents]
+        for rec in rows:
+            rec["age_s"] = max(0.0, now - rec.pop("recorded_mono"))
+        return rows

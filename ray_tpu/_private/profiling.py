@@ -1,4 +1,4 @@
-"""On-demand CPU profiling: stack sampling without external tooling.
+"""CPU profiling: stack sampling without external tooling.
 
 Analog of the reference's dashboard profiling endpoints
 (dashboard/modules/reporter/profile_manager.py:54 — py-spy flamegraphs /
@@ -10,17 +10,65 @@ the target process to sample itself: node daemons answer a ``profile``
 control message (multinode.py), so ``ray-tpu profile --node <id>``
 needs no ptrace and no extra binaries. When py-spy IS installed, it is
 preferred for arbitrary pids (native stacks, no cooperation needed).
+
+Beyond the on-demand path, :class:`ProfilerAgent` runs a CONTINUOUS
+low-rate sampler in every process (reference: Google-Wide Profiling —
+always-on fleet sampling at a rate cheap enough to never turn off).
+Samples accumulate as folded stacks tagged per thread with a
+running/waiting annotation; the metrics cadence drains them into
+``profile_batch`` frames toward the head's profile store
+(``_private/profile_store.py``). ``RAY_TPU_PROFILE_HZ`` (flag
+``profile_hz``) sets the rate; ``0`` disables the sampler entirely.
+
+Sampler loops here must use ABSOLUTE-DEADLINE scheduling (sleep to the
+next grid tick, skip missed ticks) — a constant-period ``sleep`` adds
+every stack walk's cost to the interval and silently decays the rate;
+an AST lint (tests/test_log_lint.py) bans constant ``time.sleep``
+arguments anywhere in this module.
 """
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import time
 from typing import Dict, List, Optional
 
 __all__ = ["sample_self", "folded_to_speedscope", "profile_self",
-           "pyspy_available", "profile_pid_pyspy"]
+           "pyspy_available", "profile_pid_pyspy", "merge_folded",
+           "ProfilerAgent", "configured_profile_hz", "ensure_profiler",
+           "global_profiler", "shutdown_profiler"]
+
+#: Default continuous-sampling rate: low enough that walking a handful
+#: of thread stacks costs well under 1% CPU, high enough that a 5s
+#: metrics tick ships ~50 samples per process.
+DEFAULT_PROFILE_HZ = 10.0
+
+
+def configured_profile_hz() -> float:
+    """Continuous sampler rate; honors the documented uppercase env
+    spelling first, then the flag table (live runtime config > env >
+    default). ``<= 0`` disables the always-on sampler."""
+    raw = os.environ.get("RAY_TPU_PROFILE_HZ", "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    from ray_tpu._private.ray_config import runtime_config_value
+    return float(runtime_config_value("profile_hz", DEFAULT_PROFILE_HZ))
+
+
+def merge_folded(dst: Dict[str, int], src: Dict[str, int]
+                 ) -> Dict[str, int]:
+    """Merge folded-stack counts ``src`` into ``dst`` (in place; also
+    returned). Addition is associative and commutative, so batches can
+    merge in any grouping/order — the property the head-side store and
+    the cluster-burst fan-in both rely on."""
+    for key, count in src.items():
+        dst[key] = dst.get(key, 0) + count
+    return dst
 
 
 def sample_self(duration_s: float = 5.0, hz: int = 100,
@@ -123,15 +171,179 @@ def folded_to_speedscope(counts: Dict[str, int], name: str = "ray_tpu",
 
 def profile_self(duration_s: float = 5.0, hz: int = 100,
                  fmt: str = "folded"):
-    """One-call self-profile: 'folded' text or 'speedscope' dict."""
+    """One-call self-profile: 'folded' text, 'speedscope' dict, or the
+    raw 'dict' mapping (what cluster bursts ship so the head can merge
+    before rendering)."""
     stats: dict = {}
     counts = sample_self(duration_s, hz, stats=stats)
+    if fmt == "dict":
+        return counts
     if fmt == "folded":
         return "\n".join(f"{k} {v}" for k, v in sorted(counts.items()))
     if fmt == "speedscope":
         return folded_to_speedscope(counts, hz=hz,
                                     achieved_hz=stats.get("achieved_hz"))
     raise ValueError(f"unknown profile format {fmt!r}")
+
+
+#: Innermost-frame function names that mean the thread is parked, not
+#: burning CPU — the running/waiting annotation distinguishes "the loop
+#: is hot" from "the loop is blocked on IO/a lock" in flamegraphs.
+_WAIT_FRAME_NAMES = frozenset({
+    "wait", "wait_for", "sleep", "select", "poll", "epoll", "kqueue",
+    "accept", "recv", "recv_into", "recvfrom", "read", "read1",
+    "readinto", "readline", "acquire", "join", "get", "settimeout",
+    "flush", "dowait", "_recv_msg", "recv_frame",
+})
+
+
+class ProfilerAgent:
+    """Always-on background stack sampler for THIS process.
+
+    Walks ``sys._current_frames()`` at ``hz`` on a daemon thread and
+    accumulates folded stacks keyed
+    ``"<thread> [running|waiting];outer;...;inner"``. The transport
+    drains on the metrics cadence via :meth:`drain` and refunds failed
+    publishes via :meth:`refund` so samples survive a dropped frame.
+    ``hz <= 0`` builds a disabled agent (no thread, drains are empty).
+    """
+
+    def __init__(self, component: str, hz: Optional[float] = None,
+                 start: bool = True):
+        self.component = component
+        self.hz = configured_profile_hz() if hz is None else float(hz)
+        self.pid = os.getpid()
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._samples = 0  # stack walks accumulated since last drain
+        self._window_t0 = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start and self.hz > 0:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"ray_tpu-profiler-{component}")
+            self._thread.start()
+
+    @property
+    def enabled(self) -> bool:
+        return self.hz > 0 and not self._stop.is_set()
+
+    def _loop(self) -> None:
+        from ray_tpu._private import builtin_metrics
+        period = 1.0 / max(self.hz, 1e-3)
+        next_tick = time.monotonic()
+        me = threading.get_ident()
+        while not self._stop.is_set():
+            now = time.monotonic()
+            if now < next_tick:
+                # Event wait doubles as the pacing sleep: a stop() wakes
+                # the loop immediately instead of after one more period.
+                if self._stop.wait(next_tick - now):
+                    return
+            try:
+                walked = self._sample_once(me)
+                builtin_metrics.record_profile_samples(walked)
+            except Exception:  # noqa: BLE001 - sampling must never kill host
+                pass
+            next_tick += period
+            now = time.monotonic()
+            while next_tick <= now:  # overran: skip ticks, stay on grid
+                next_tick += period
+
+    def _sample_once(self, skip_ident: Optional[int] = None) -> int:
+        """One walk over every thread; returns the number of stacks
+        sampled. Public for tests and tick-less (worker) callers."""
+        names = {t.ident: t.name for t in threading.enumerate()}
+        walked = 0
+        fresh: Dict[str, int] = {}
+        for ident, frame in sys._current_frames().items():
+            if ident == skip_ident:
+                continue
+            stack: List[str] = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                stack.append(f"{code.co_name} "
+                             f"({code.co_filename.rsplit('/', 1)[-1]}:"
+                             f"{f.f_lineno})")
+                f = f.f_back
+            if not stack:
+                continue
+            # stack[0] is the INNERMOST frame: a leaf parked in a wait
+            # primitive marks the whole sample as blocked, anything
+            # else as on-CPU (approximate — the GIL was held by someone
+            # else during the walk — but cheap and overwhelmingly right
+            # for the park-vs-burn question).
+            leaf = stack[0].split(" ", 1)[0]
+            state = "waiting" if leaf in _WAIT_FRAME_NAMES else "running"
+            name = names.get(ident) or str(ident)
+            key = ";".join([f"{name} [{state}]"] + stack[::-1])
+            fresh[key] = fresh.get(key, 0) + 1
+            walked += 1
+        if fresh:
+            with self._lock:
+                merge_folded(self._counts, fresh)
+                self._samples += walked
+        return walked
+
+    def drain(self) -> Optional[dict]:
+        """Take (and clear) the accumulated stacks. Returns
+        ``{"stacks", "samples", "duration_s"}`` or None when empty."""
+        now = time.monotonic()
+        with self._lock:
+            if not self._counts:
+                self._window_t0 = now
+                return None
+            stacks, self._counts = self._counts, {}
+            samples, self._samples = self._samples, 0
+            t0, self._window_t0 = self._window_t0, now
+        return {"stacks": stacks, "samples": samples,
+                "duration_s": max(0.0, now - t0)}
+
+    def refund(self, stacks: Dict[str, int]) -> None:
+        """Merge a failed-publish batch back into the accumulator so a
+        dropped frame loses no samples (they ship on the next tick)."""
+        with self._lock:
+            merge_folded(self._counts, stacks)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+_agent_lock = threading.Lock()
+_agent: Optional[ProfilerAgent] = None
+
+
+def ensure_profiler(component: str) -> Optional[ProfilerAgent]:
+    """Start (or return) this process's singleton ProfilerAgent. None
+    when the configured rate disables sampling."""
+    global _agent
+    with _agent_lock:
+        if _agent is not None and _agent.enabled:
+            return _agent
+        agent = ProfilerAgent(component)
+        if not agent.enabled:
+            return None
+        _agent = agent
+        return agent
+
+
+def global_profiler() -> Optional[ProfilerAgent]:
+    return _agent
+
+
+def shutdown_profiler() -> None:
+    """Stop and forget the process profiler (runtime shutdown; a later
+    ``ensure_profiler`` starts a fresh one)."""
+    global _agent
+    with _agent_lock:
+        agent, _agent = _agent, None
+    if agent is not None:
+        agent.stop()
 
 
 def pyspy_available() -> bool:

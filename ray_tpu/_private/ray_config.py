@@ -102,6 +102,19 @@ _PY_DEFAULTS: Dict[str, Any] = {
     # distinct label sets held before new series are dropped+counted.
     "timeseries_window_s": 300.0,
     "timeseries_max_series": 4096,
+    # Continuous profiling plane (profiling.py + profile_store.py):
+    # per-process sample rate (0 disables), head-side retention window
+    # (<= 0 disables the store), origin/per-bucket stack caps, the
+    # loop-lag threshold that trips the flight recorder (<= 0 disables
+    # it), its incident-ring bound, and the cap on an on-demand burst's
+    # duration (dashboard/daemon profile endpoints).
+    "profile_hz": 10.0,
+    "profile_window_s": 300.0,
+    "profile_max_series": 256,
+    "profile_max_stacks": 2000,
+    "profile_flight_lag_s": 1.0,
+    "profile_max_incidents": 32,
+    "profile_max_duration_s": 60.0,
     "task_events_enabled": True,
     "memory_monitor_refresh_ms": 250,
     "memory_usage_threshold": 0.95,

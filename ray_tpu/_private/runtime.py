@@ -619,7 +619,8 @@ class Runtime:
                                                     MetricsAgent)
         self._cluster_metrics = ClusterMetrics()
         self._metrics_agent = MetricsAgent(
-            self._publish_head_metrics, component="driver")
+            self._publish_head_metrics, component="driver",
+            publish_profile=self._publish_head_profile)
         self._metrics_agent.add_collector(self._collect_head_metrics)
 
     # ------------------------------------------------------------------
@@ -2866,6 +2867,23 @@ class Runtime:
             node = conn.node_id.hex()
         self._cluster_metrics.update(node, msg)
 
+    def _publish_head_profile(self, batch: dict) -> bool:
+        """Sink for the head profiler's windows AND for windows head
+        pool workers piggyback on task replies: straight into the
+        profile store under the head's node id."""
+        self._cluster_metrics.update_profile(self.head_node_id.hex(),
+                                             batch)
+        return True
+
+    def _profile_batch_from_node(self, conn, msg: dict) -> None:
+        """Wire sink for daemon-pushed profile_batch frames (assigned to
+        conn.on_profile_batch at registration; recv-thread — merge is a
+        dict update, no blocking work)."""
+        node = msg.get("node_id") or ""
+        if not node and conn.node_id is not None:
+            node = conn.node_id.hex()
+        self._cluster_metrics.update_profile(node, msg)
+
     def _collect_head_metrics(self) -> None:
         """Refresh head-side gauges right before each export snapshot —
         level-style series (queue depth, store bytes, pool size, actor
@@ -3066,6 +3084,128 @@ class Runtime:
             },
         }
 
+    # -- continuous profiling plane (profile_store.py) ------------------
+
+    def profile_flame(self, component: Optional[str] = None,
+                      node: Optional[str] = None,
+                      window: Optional[float] = None,
+                      fmt: str = "folded"):
+        """Merged cluster/per-component flamegraph from the continuous
+        windows ('folded' | 'speedscope' | 'dict'). The head's own
+        profiler is drained first so driver stacks are as fresh as the
+        call."""
+        self._flush_trace_spans()  # poll_once also ships head profiles
+        return self._cluster_metrics.profiles.flame(
+            component=component, node_id=node, window=window, fmt=fmt)
+
+    def profile_diff(self, window: float = 60.0,
+                     component: Optional[str] = None,
+                     node: Optional[str] = None,
+                     limit: int = 50) -> List[dict]:
+        """Window-vs-window stack diff ("what got hot")."""
+        self._flush_trace_spans()
+        return self._cluster_metrics.profiles.diff(
+            window=window, component=component, node_id=node,
+            limit=limit)
+
+    def profile_incidents(self) -> List[dict]:
+        """The loop-lag flight recorder's incident ring, newest first."""
+        return self._cluster_metrics.profiles.incidents()
+
+    def profile_stats(self) -> dict:
+        return self._cluster_metrics.profiles.stats()
+
+    def profile_cluster(self, duration: float = 10.0, hz: int = 100,
+                        fmt: str = "folded"):
+        """Synchronized on-demand burst: fan a profile request to every
+        live daemon IN PARALLEL while the head samples itself, and merge
+        the folded stacks with ``component@node/pid`` roots (same shape
+        as the continuous store's flame output)."""
+        from ray_tpu._private.profiling import (folded_to_speedscope,
+                                                sample_self)
+        with self._lock:
+            conns = dict(self._remote_nodes)
+        merged: Dict[str, int] = {}
+        merge_lock = threading.Lock()
+        head_hex = self.head_node_id.hex()[:8]
+
+        def _merge(root: str, counts: Dict[str, int]) -> None:
+            with merge_lock:
+                for stack, n in counts.items():
+                    key = f"{root};{stack}"
+                    merged[key] = merged.get(key, 0) + int(n)
+
+        def _one_node(node_id, conn):
+            try:
+                counts = conn.profile(duration=duration, hz=hz,
+                                      fmt="dict")
+            except Exception:  # noqa: BLE001 - a dead node skips the burst
+                logger.exception("profile burst failed for node %s",
+                                 node_id.hex()[:8])
+                return
+            _merge(f"daemon@{node_id.hex()[:8]}/0", counts or {})
+
+        threads = [threading.Thread(target=_one_node, args=(nid, conn),
+                                    daemon=True,
+                                    name=f"profile-burst-{i}")
+                   for i, (nid, conn) in enumerate(conns.items())]
+        for t in threads:
+            t.start()
+        _merge(f"driver@{head_hex}/{os.getpid()}",
+               sample_self(duration, hz))
+        for t in threads:
+            t.join(timeout=duration + 60)
+        if fmt == "dict":
+            return merged
+        if fmt == "speedscope":
+            return folded_to_speedscope(merged, name="ray_tpu-burst",
+                                        hz=hz)
+        return "\n".join(f"{k} {v}"
+                         for k, v in sorted(merged.items()))
+
+    def profile_pid(self, pid: int, duration: float = 5.0,
+                    hz: int = 100, fmt: str = "folded"):
+        """Profile one process of the cluster by pid: the head itself,
+        a head pool worker over its request pipe, or any daemon-owned
+        worker via the owning daemon's burst endpoint (``--pid``
+        without py-spy). Daemons are tried in turn — the one that knows
+        the pid answers; the rest raise and are skipped."""
+        from ray_tpu._private.profiling import (folded_to_speedscope,
+                                                profile_self, sample_self)
+        if int(pid) == os.getpid():
+            return profile_self(duration, hz, fmt)
+        pool = self._process_pool
+        if pool is not None:
+            for w in list(pool._all):
+                if w.pid == int(pid) and not w.dead:
+                    reply = w.request(
+                        {"type": "profile", "duration": duration,
+                         "hz": hz}, timeout=duration + 30)
+                    if not reply.get("ok"):
+                        raise RuntimeError(reply.get("error")
+                                           or "worker profile failed")
+                    counts = reply.get("stacks") or {}
+                    if fmt == "dict":
+                        return counts
+                    if fmt == "speedscope":
+                        return folded_to_speedscope(
+                            counts, name=f"worker-{pid}", hz=hz)
+                    return "\n".join(
+                        f"{k} {v}" for k, v in sorted(counts.items()))
+        with self._lock:
+            conns = list(self._remote_nodes.items())
+        errors = []
+        for node_id, conn in conns:
+            try:
+                return conn.profile(duration=duration, hz=hz, fmt=fmt,
+                                    pid=int(pid))
+            except Exception as exc:  # noqa: BLE001 - not this node's pid
+                errors.append(f"{node_id.hex()[:8]}: {exc}")
+        detail = "; ".join(errors) if errors else "no live daemons"
+        raise ValueError(
+            f"pid {pid} is not a known worker/daemon of this cluster "
+            f"({detail})")
+
     def register_remote_node(self, conn, info: Optional[dict] = None,
                              dispatch: bool = True,
                              node_id: Optional["NodeID"] = None) -> NodeID:
@@ -3080,6 +3220,7 @@ class Runtime:
         # feed the object location table for tiered recovery.
         conn.on_log_batch = self._log_batch_from_node
         conn.on_metrics_batch = self._metrics_batch_from_node
+        conn.on_profile_batch = self._profile_batch_from_node
         conn.on_object_spilled = self._object_spilled_from_node
         conn.on_object_unspilled = self._object_unspilled_from_node
         with self._lock:
@@ -3320,6 +3461,8 @@ class Runtime:
                 # merge straight into the cluster registry (the workers
                 # run on the head node).
                 self._process_pool.metrics_sink = self._publish_head_metrics
+                self._process_pool.profile_sink = \
+                    self._publish_head_profile
             return self._process_pool
 
     def _use_process_worker(self, spec: TaskSpec) -> bool:

@@ -50,6 +50,10 @@ from typing import Any, Dict, Tuple
 #: registered ack and the resume handshake carry the incarnation epoch,
 #: and a new raw ``fenced`` reply rejects resumes from declared-dead
 #: incarnations (the daemon must re-register as a new incarnation).
+#: (still v9) additive since: metrics_batch.event_stats,
+#: profile_batch push frames, profile.pid burst targeting — optional
+#: fields / head-bound pushes old peers drop harmlessly, per the rule
+#: above.
 PROTOCOL_VERSION = 9
 
 
@@ -159,8 +163,13 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
     "spill_lease": {"lease_id": (_STR, True)},
     "unspill_lease": {"lease_id": (_STR, True)},
     "stats": {"req_id": (_INT, True)},
+    # ``pid`` (additive, post-v9) retargets the burst at one of the
+    # daemon's pool workers (cooperative sampling over the worker pipe);
+    # absent/0 samples the daemon itself. fmt "dict" returns the raw
+    # folded-count mapping for head-side merging (cluster bursts).
     "profile": {"req_id": (_INT, True), "duration": (_NUM, False),
-                "hz": (_INT, False), "fmt": (_STR, False)},
+                "hz": (_INT, False), "fmt": (_STR, False),
+                "pid": (_INT, False)},
     "shutdown": {},
     # -- frame coalescing (both directions, v2) ------------------------
     # A batch frame wraps N control messages that accumulated at the
@@ -197,6 +206,22 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
         # piggyback control-loop visibility on the frames they already
         # send; older peers simply omit it.
         "event_stats": (_DICT, False),
+    },
+    # -- continuous profiling (daemon -> head, additive post-v9) -------
+    # Folded stacks the origin's ProfilerAgent accumulated since its
+    # last metrics tick ("thread [state];outer;...;inner" -> count),
+    # shipped on the metrics cadence exactly like metrics_batch. Safe
+    # without a version bump: daemon->head pushes are routed by type in
+    # the head's recv loop, and an older head silently drops unknown
+    # push frames (no req_id -> no pending waiter), losing only the
+    # feature, never the session.
+    "profile_batch": {
+        "node_id": (_STR, False),
+        "pid": (_INT, True),
+        "component": (_STR, True),
+        "stacks": (_DICT, True),
+        "samples": (_INT, False),
+        "duration_s": (_NUM, False),
     },
     # -- durable spill announcements (daemon -> head, v8) --------------
     # A daemon spilled an object through a DURABLE backend (session://

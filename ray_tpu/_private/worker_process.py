@@ -140,6 +140,9 @@ class WorkerHandle:
         # so their metrics agent buffers batches that piggyback on task
         # replies; the pool points this at the host's forwarder.
         self.metrics_sink: Optional[Callable[[dict], Any]] = None
+        # Same piggyback for continuous-profiling windows (folded
+        # stacks accumulated by the worker's ProfilerAgent).
+        self.profile_sink: Optional[Callable[[dict], Any]] = None
         self._lock = threading.Lock()
 
     def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
@@ -174,6 +177,14 @@ class WorkerHandle:
                         sink(batch)
                     except Exception:  # noqa: BLE001 - metrics never fail a task
                         logger.exception("worker metrics forward failed")
+            profiles = reply.pop("profile_batch", None)
+            psink = self.profile_sink
+            if profiles and psink is not None:
+                for batch in profiles:
+                    try:
+                        psink(batch)
+                    except Exception:  # noqa: BLE001 - profiling never fails a task
+                        logger.exception("worker profile forward failed")
         return reply
 
     def kill(self, wait: bool = True) -> None:
@@ -447,6 +458,7 @@ class WorkerProcessPool:
         # cluster registry (directly on the head; via metrics_batch
         # frames from a daemon).
         self.metrics_sink: Optional[Callable[[dict], Any]] = None
+        self.profile_sink: Optional[Callable[[dict], Any]] = None
         # ALL spawns go through this single long-lived thread:
         # PR_SET_PDEATHSIG binds to the spawning THREAD, so a worker
         # forked from an ephemeral handler thread is SIGKILLed the
@@ -530,6 +542,7 @@ class WorkerProcessPool:
     def _leased(self, w: WorkerHandle,
                 lease_start: Optional[float]) -> WorkerHandle:
         w.metrics_sink = self.metrics_sink
+        w.profile_sink = self.profile_sink
         if lease_start is None:
             builtin_metrics.record_lease_immediate()
         else:
@@ -670,8 +683,13 @@ class _WorkerMain:
         # batches to the next reply; the parent forwards them head-ward.
         from ray_tpu._private.metrics_agent import MetricsAgent
         self._metrics_buffer: list = []
+        self._profile_buffer: list = []
+        # publish_profile makes the agent own a ProfilerAgent for this
+        # worker: sampling runs continuously on its own thread even
+        # between tasks; the windows ride task replies like metrics.
         self._metrics_agent = MetricsAgent(
-            self._buffer_metrics_batch, component="worker", start=False)
+            self._buffer_metrics_batch, component="worker", start=False,
+            publish_profile=self._buffer_profile_batch)
         self._last_metrics_poll = 0.0
 
     def _buffer_metrics_batch(self, batch: dict) -> bool:
@@ -679,6 +697,16 @@ class _WorkerMain:
         # Bounded: an idle stretch can't pile up batches (the periodic
         # full refresh re-converges the head after any drop).
         del self._metrics_buffer[:-8]
+        return True
+
+    def _buffer_profile_batch(self, batch: dict) -> bool:
+        # Bounded like metrics — but a squeezed-out window would be
+        # real sample loss, so a full buffer REFUSES the batch instead:
+        # the agent refunds the stacks into the live window and they
+        # merge into the next drain.
+        if len(self._profile_buffer) >= 8:
+            return False
+        self._profile_buffer.append(batch)
         return True
 
     def _attach_metrics(self, reply: dict) -> None:
@@ -695,6 +723,9 @@ class _WorkerMain:
         if self._metrics_buffer:
             reply["metrics_batch"] = self._metrics_buffer[:]
             del self._metrics_buffer[:]
+        if self._profile_buffer:
+            reply["profile_batch"] = self._profile_buffer[:]
+            del self._profile_buffer[:]
 
     def _get_arena(self):
         if not self._arena_tried:
@@ -897,6 +928,30 @@ class _WorkerMain:
                 reply = {"ok": True, "pid": os.getpid()}
                 self._attach_metrics(reply)
                 _send_frame(self.sock, _dumps(reply))
+                continue
+            if kind == "profile":
+                # On-demand burst relayed by the owning daemon
+                # (`ray-tpu profile --pid`): sample our own stacks at
+                # the requested rate and reply with the raw folded
+                # mapping. Strict request/reply holds: this occupies
+                # the pipe for the duration, like any task would.
+                try:
+                    from ray_tpu._private.profiling import sample_self
+                    # skip_profiler=False: a worker may be just this
+                    # serve thread — skipping the sampling thread would
+                    # return an EMPTY profile for any idle worker.
+                    counts = sample_self(
+                        min(float(msg.get("duration", 5.0)), 60.0),
+                        int(msg.get("hz", 100)), skip_profiler=False)
+                    reply = {"ok": True, "pid": os.getpid(),
+                             "stacks": counts}
+                except BaseException as exc:  # noqa: BLE001 - ship to parent
+                    reply = {"ok": False,
+                             "error": f"{type(exc).__name__}: {exc}"}
+                try:
+                    _send_frame(self.sock, _dumps(reply))
+                except (OSError, ConnectionError):
+                    return
                 continue
             try:
                 value = self._exec(msg)

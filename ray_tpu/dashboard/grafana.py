@@ -47,6 +47,11 @@ _DEFAULT_PANELS = [
      "rate(ray_tpu_channel_bytes_sent_total[1m])", "Bps"),
     ("Channel pure acks / s",
      "rate(ray_tpu_channel_acks_sent_total[1m])", "ops"),
+    ("Profile samples / s (by component)",
+     "sum by (component) (rate(ray_tpu_profile_samples_total[1m]))",
+     "ops"),
+    ("Profile batches dropped / s",
+     "rate(ray_tpu_profile_batches_dropped_total[5m])", "ops"),
     ("Serve failovers / s", "rate(ray_tpu_serve_failovers_total[5m])",
      "ops"),
     ("Serve replicas drained / s (by outcome)",

@@ -384,28 +384,59 @@ class DashboardHead:
         from ray_tpu._private.profiling import (profile_pid_pyspy,
                                                 profile_self,
                                                 pyspy_available)
-        duration = min(float(request.query.get("duration", 5)), 60.0)
-        hz = int(request.query.get("hz", 100))
+        from ray_tpu._private.ray_config import runtime_config_value
+        # Malformed knobs are the CALLER's error: answer 400 with the
+        # offending name, never an unhandled 500.
+        try:
+            duration = float(request.query.get("duration", 5))
+            hz = int(request.query.get("hz", 100))
+        except ValueError:
+            return self._json(
+                {"error": "duration and hz must be numeric"}, status=400)
+        if duration <= 0 or hz <= 0:
+            return self._json(
+                {"error": "duration and hz must be positive"}, status=400)
+        duration = min(duration,
+                       float(runtime_config_value(
+                           "profile_max_duration_s", 60.0)))
         fmt = request.query.get("fmt", "folded")
         node_id = request.query.get("node_id")
         pid = request.query.get("pid")
+        if pid is not None:
+            try:
+                pid = int(pid)
+            except ValueError:
+                return self._json({"error": "pid must be an integer"},
+                                  status=400)
         try:
             if pid is not None:
                 import os
-                if int(pid) != os.getpid() and not pyspy_available():
-                    return self._json(
-                        {"error": "profiling a foreign pid needs py-spy "
-                                  "on PATH; use node_id= for daemons "
-                                  "(cooperative sampling) or omit pid "
-                                  "for the head process"}, status=501)
                 if int(pid) == os.getpid():
                     result = await asyncio.to_thread(
                         profile_self, duration, hz, fmt)
                 else:
-                    raw = await asyncio.to_thread(
-                        profile_pid_pyspy, int(pid), duration, fmt)
-                    from aiohttp import web
-                    return web.Response(body=raw)
+                    # Cluster pids (pool workers, any daemon's workers)
+                    # resolve cooperatively through the owning process's
+                    # burst endpoint; py-spy is only needed for pids the
+                    # cluster does not know.
+                    from ray_tpu._private.worker import global_worker
+                    runtime = global_worker.runtime
+                    try:
+                        result = await asyncio.to_thread(
+                            runtime.profile_pid, int(pid), duration, hz,
+                            fmt)
+                    except ValueError:
+                        if not pyspy_available():
+                            return self._json(
+                                {"error": "pid is not a cluster worker "
+                                          "and py-spy is not on PATH; "
+                                          "use node_id= for daemons or "
+                                          "omit pid for the head "
+                                          "process"}, status=501)
+                        raw = await asyncio.to_thread(
+                            profile_pid_pyspy, int(pid), duration, fmt)
+                        from aiohttp import web
+                        return web.Response(body=raw)
             elif node_id is not None:
                 from ray_tpu._private.worker import global_worker
                 runtime = global_worker.runtime
@@ -429,6 +460,67 @@ class DashboardHead:
             return self._json(result)
         from aiohttp import web
         return web.Response(text=result)
+
+    async def _profile_flame(self, request):
+        """Merged flamegraph from the continuous profiling windows
+        (tentpole surface): ?component=driver|daemon|worker, ?node= (hex
+        prefix), ?window=s, ?fmt=folded|speedscope|dict."""
+        from ray_tpu._private.worker import global_worker
+        import asyncio
+        fmt = request.query.get("fmt", "folded")
+        component = request.query.get("component")
+        node = request.query.get("node")
+        window = request.query.get("window")
+        if window is not None:
+            try:
+                window = float(window)
+            except ValueError:
+                return self._json({"error": "window must be numeric"},
+                                  status=400)
+            if window <= 0:
+                return self._json({"error": "window must be positive"},
+                                  status=400)
+        runtime = global_worker.runtime
+        try:
+            result = await asyncio.to_thread(
+                runtime.profile_flame, component, node, window, fmt)
+        except ValueError as exc:
+            return self._json({"error": str(exc)}, status=400)
+        if fmt == "folded":
+            from aiohttp import web
+            return web.Response(text=result)
+        return self._json(result)
+
+    async def _profile_diff(self, request):
+        """Window-vs-window stack diff: ?window=s (default 60),
+        ?component=, ?node=, ?limit=."""
+        from ray_tpu._private.worker import global_worker
+        import asyncio
+        try:
+            window = float(request.query.get("window", 60))
+            limit = int(request.query.get("limit", 50))
+        except ValueError:
+            return self._json(
+                {"error": "window and limit must be numeric"}, status=400)
+        if window <= 0 or limit <= 0:
+            return self._json(
+                {"error": "window and limit must be positive"},
+                status=400)
+        runtime = global_worker.runtime
+        rows = await asyncio.to_thread(
+            runtime.profile_diff, window,
+            request.query.get("component"), request.query.get("node"),
+            limit)
+        return self._json({"window_s": window, "diff": rows})
+
+    async def _profile_incidents(self, request):
+        """The loop-lag flight recorder's ring, newest first."""
+        from ray_tpu._private.worker import global_worker
+        runtime = global_worker.runtime
+        return self._json({
+            "incidents": runtime.profile_incidents(),
+            "stats": runtime.profile_stats(),
+        })
 
     async def _grafana(self, request):
         """Generated Grafana dashboard JSON over this cluster's
@@ -464,6 +556,10 @@ class DashboardHead:
         app.router.add_post("/api/workflows/events/{event_key}",
                             self._workflow_trigger_event)
         app.router.add_get("/api/profile", self._profile)
+        app.router.add_get("/api/profile/flame", self._profile_flame)
+        app.router.add_get("/api/profile/diff", self._profile_diff)
+        app.router.add_get("/api/profile/incidents",
+                           self._profile_incidents)
         app.router.add_get("/api/grafana_dashboard", self._grafana)
         return app
 

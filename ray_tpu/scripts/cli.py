@@ -405,17 +405,45 @@ def cmd_logs(args) -> int:
 
 
 def cmd_profile(args) -> int:
-    """On-demand CPU profile of this driver process or a node daemon
-    (reference: py-spy-backed dashboard profiling); writes a speedscope
-    JSON (open at speedscope.app) or collapsed flamegraph stacks."""
+    """CPU profiles, four ways: this driver process (default), a node
+    daemon (--node), any cluster worker by pid (--pid, cooperative —
+    resolved through the owning daemon, no py-spy needed), or the whole
+    cluster at once (--cluster, synchronized burst fanned to every live
+    daemon + the head, merged). --report instead prints the loop-lag
+    flight recorder's incidents. Writes a speedscope JSON (open at
+    speedscope.app) or collapsed flamegraph stacks."""
     _ensure_init()
     import json as _json
 
     from ray_tpu._private.profiling import profile_self
+    from ray_tpu._private.worker import global_worker
+    runtime = global_worker.runtime
+    if args.report:
+        incidents = runtime.profile_incidents()
+        if not incidents:
+            print("no loop-lag incidents recorded")
+            return 0
+        for inc in incidents:
+            print(f"loop={inc['loop']} lag={inc['lag_s']:.3f}s "
+                  f"(threshold {inc['threshold_s']:.3f}s) "
+                  f"component={inc['component'] or '?'} "
+                  f"node={inc['node_id'][:8] or 'head'} "
+                  f"pid={inc['pid']} scope={inc['scope']} "
+                  f"{inc['age_s']:.0f}s ago")
+            for stack, weight in inc["top_stacks"][:10]:
+                print(f"  {weight:>8}  {stack}")
+        return 0
     fmt = "speedscope" if args.output.endswith(".json") else "folded"
-    if args.node:
-        from ray_tpu._private.worker import global_worker
-        runtime = global_worker.runtime
+    if args.cluster:
+        result = runtime.profile_cluster(args.duration, args.hz, fmt)
+    elif args.pid is not None:
+        try:
+            result = runtime.profile_pid(args.pid, args.duration,
+                                         args.hz, fmt)
+        except ValueError as exc:
+            print(exc)
+            return 1
+    elif args.node:
         conn = None
         for nid, c in runtime._remote_nodes.items():
             if nid.hex().startswith(args.node):
@@ -627,10 +655,21 @@ def main(argv=None) -> int:
                    help="list the session's log files instead")
 
     p = sub.add_parser("profile", help="sample CPU stacks on demand "
-                                       "(driver or --node <id>)")
+                                       "(driver, --node <id>, --pid, "
+                                       "--cluster) or --report the "
+                                       "loop-lag flight recorder")
     p.add_argument("--node", default=None,
                    help="node id prefix to profile (default: this "
                         "process)")
+    p.add_argument("--pid", type=int, default=None,
+                   help="profile a cluster worker by pid, resolved "
+                        "through its owning daemon (no py-spy needed)")
+    p.add_argument("--cluster", action="store_true",
+                   help="synchronized burst: every live daemon + the "
+                        "head sample together, merged into one graph")
+    p.add_argument("--report", action="store_true",
+                   help="print the loop-lag flight recorder's "
+                        "incidents instead of sampling")
     p.add_argument("--duration", type=float, default=5.0)
     p.add_argument("--hz", type=int, default=100)
     p.add_argument("--output", default="profile.speedscope.json",

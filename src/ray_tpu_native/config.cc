@@ -153,6 +153,18 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     // (<= 0 disables) and the cap on distinct series held.
     FLAG_DBL(timeseries_window_s, 300.0),
     FLAG_INT(timeseries_max_series, 4096),
+    // Continuous profiling: per-process sample hz (0 disables),
+    // head-side retention window (<= 0 disables the store), origin /
+    // per-bucket stack caps, the loop-lag flight-recorder threshold
+    // (<= 0 disables) + incident-ring bound, and the on-demand burst
+    // duration cap.
+    FLAG_DBL(profile_hz, 10.0),
+    FLAG_DBL(profile_window_s, 300.0),
+    FLAG_INT(profile_max_series, 256),
+    FLAG_INT(profile_max_stacks, 2000),
+    FLAG_DBL(profile_flight_lag_s, 1.0),
+    FLAG_INT(profile_max_incidents, 32),
+    FLAG_DBL(profile_max_duration_s, 60.0),
     FLAG_BOOL(task_events_enabled, true),
     // -- memory monitor / OOM killing --
     FLAG_INT(memory_monitor_refresh_ms, 250),

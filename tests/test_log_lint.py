@@ -339,6 +339,26 @@ def test_no_fixed_sleep_retry_loops_in_private():
         "period: " + ", ".join(sorted(set(offenders))))
 
 
+def test_no_constant_sleep_in_profiling_samplers():
+    """STRICTER than the loop-only lint above, scoped to
+    ``_private/profiling.py``: no ``time.sleep(<constant>)`` ANYWHERE
+    in the module (loop or not). Samplers must pace themselves by
+    absolute deadline (``sleep(next_tick - now)`` like ``sample_self``,
+    or ``Event.wait(next_tick - now)`` like ``ProfilerAgent``) — a
+    fixed-period sleep adds every stack walk's cost to the interval and
+    silently drops the effective rate below the requested hz."""
+    path = os.path.join(PKG_ROOT, "_private", "profiling.py")
+    tree = _parse(path)
+    offenders = [f"profiling.py:{node.lineno}"
+                 for node in ast.walk(tree)
+                 if _is_constant_time_sleep(node)]
+    assert not offenders, (
+        "time.sleep(<constant>) in ray_tpu/_private/profiling.py — "
+        "samplers must use absolute-deadline scheduling "
+        "(sleep/wait(next_tick - now)), never a fixed period: "
+        + ", ".join(offenders))
+
+
 def test_no_bare_print_in_private():
     offenders = []
     for path in _py_files(os.path.join(PKG_ROOT, "_private")):
