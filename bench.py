@@ -420,6 +420,88 @@ def bench_timeseries_overhead() -> dict:
     return out
 
 
+def bench_alerting_overhead() -> dict:
+    """Task throughput with the alert engine ON (aggressive 0.05s eval
+    period + 0.5s export tick so evaluations actually happen under the
+    workload) vs OFF (period 0 leaves the engine dormant), plus the raw
+    rule-evaluation rate over a populated store. The `_per_sec` keys
+    opt into the regression auto-gate: evaluating the built-in rule set
+    every merge tick must stay within noise of the disabled path."""
+    import os
+    import time as _time
+
+    import ray_tpu
+
+    def _throughput() -> float:
+        @ray_tpu.remote
+        def tiny(i):
+            return i
+
+        ray_tpu.get([tiny.remote(i) for i in range(200)])  # warmup
+        n = 2000
+        best = 0.0
+        for _ in range(3):
+            t0 = _time.perf_counter()
+            ray_tpu.get([tiny.remote(i) for i in range(n)])
+            best = max(best, n / (_time.perf_counter() - t0))
+        return best
+
+    export_key = "RAY_TPU_METRICS_EXPORT_INTERVAL_S"
+    period_key = "RAY_TPU_ALERT_EVAL_PERIOD_S"
+    prev = {k: os.environ.get(k) for k in (export_key, period_key)}
+
+    def _arm(period: str) -> float:
+        os.environ[period_key] = period
+        ray_tpu.init(num_cpus=8)
+        try:
+            return _throughput()
+        finally:
+            ray_tpu.shutdown()
+
+    try:
+        os.environ[export_key] = "0.5"
+        # Throwaway pass (same reasoning as bench_timeseries_overhead):
+        # first init pays one-time costs; then alternate the arms so
+        # slow machine phases hit both equally.
+        _arm("0.05")
+        on = off = 0.0
+        for _ in range(2):
+            on = max(on, _arm("0.05"))
+            off = max(off, _arm("0"))
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    out = {"alerting_on_tasks_per_sec": round(on, 1),
+           "alerting_off_tasks_per_sec": round(off, 1)}
+    out["alerting_overhead_pct"] = (
+        round(100.0 * (off - on) / off, 2) if off else None)
+
+    # Evaluation microbench: the built-in rule set stepped against a
+    # standalone store holding live series — the per-tick cost the
+    # ClusterMetrics.update path pays.
+    from ray_tpu._private.alerting import AlertEngine
+    from ray_tpu._private.timeseries import TimeSeriesStore
+    store = TimeSeriesStore(window_s=300, max_series=4096, staleness=600)
+    entry = [{"name": "ray_tpu_node_deaths_total", "type": "counter",
+              "desc": "", "tag_keys": (), "series": {}}]
+    base = _time.monotonic()
+    for i in range(120):
+        entry[0]["series"] = {(): float(i)}
+        store.ingest_batch("bench", 1, "driver", entry,
+                           now=base + i * 0.5)
+    engine = AlertEngine(period_s=3600.0)
+    n = 2000
+    t0 = _time.perf_counter()
+    for i in range(n):
+        engine.evaluate(store, now=base + 60.0 + i * 0.001)
+    elapsed = _time.perf_counter() - t0
+    out["alerting_evals_per_sec"] = round(n / elapsed, 1)
+    return out
+
+
 def bench_profiling_overhead() -> dict:
     """Task throughput with the continuous profiler ON (default hz,
     aggressive 0.5s export tick so windows actually ship) vs OFF
@@ -1832,6 +1914,8 @@ def main(argv=None):
          bench_tracing_overhead),
         ("timeseries_overhead", "timeseries_overhead_pct",
          bench_timeseries_overhead),
+        ("alerting_overhead", "alerting_overhead_pct",
+         bench_alerting_overhead),
         ("profiling_overhead", "profiling_overhead_pct",
          bench_profiling_overhead),
         ("frame_path", "frame_send_mb_per_sec", bench_frame_path),

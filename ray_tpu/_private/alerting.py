@@ -1,0 +1,549 @@
+"""Alert rule engine over the head's windowed time-series store.
+
+Analog of the reference dashboard's alerting surface paired with a
+Prometheus-style rule evaluator, TPU-first: rules are evaluated
+head-locally on the existing ``ClusterMetrics.update`` cadence (no new
+wires, no scrape round-trip) against the :class:`TimeSeriesStore`
+derivations that already power ``ray-tpu top``.
+
+Rule grammar (the ``expr`` string)::
+
+    TERM  := FUNC(metric[, by=label])
+    FUNC  := rate | gauge_max | gauge_avg | p50 | p95 | hist_rate
+             | hist_mean
+    EXPR  := TERM OP NUMBER | TERM / TERM OP NUMBER
+    OP    := > | >= | < | <= | ==
+
+``rate`` is the reset-safe counter rate; ``gauge_max``/``gauge_avg``
+read ``gauge_stats`` (max of lasts / windowed average);
+``p50``/``p95``/``hist_rate``/``hist_mean`` read ``histogram_stats``.
+``by=label`` fans the rule out per label value — each group value is an
+independent alert instance (label-keyed dedup comes free: one instance
+per ``(rule, group)``).
+
+Two rule kinds:
+
+* :class:`AlertRule` — threshold: the expr must breach continuously for
+  ``for_s`` before ``pending`` promotes to ``firing``.
+* :class:`BurnRateRule` — multi-window SLO burn: the expr is evaluated
+  over a fast AND a slow window; the burn rate (``value / objective``)
+  must exceed ``burn_threshold`` in BOTH windows to fire (the fast
+  window gives responsiveness, the slow window keeps one spike from
+  paging).
+
+State machine per instance: ``pending -> firing -> resolved``, with a
+per-rule ``cooldown_s`` after a resolve before the same instance may
+fire again (anti-flap), a bounded firing history
+(``RAY_TPU_ALERT_MAX_FIRING_HISTORY``), every transition mirrored into
+the cluster event journal and counted in
+``ray_tpu_alerts_transitions_total{state}``. Rules may attach a typed
+``scale_hint`` (``{"deployment", "direction"}``) surfaced to
+subscribers — the serve controller records these for its autoscaler.
+
+Evaluation is gated by ``RAY_TPU_ALERT_EVAL_PERIOD_S`` (0 disables the
+engine entirely — the bench's off arm).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_EVAL_PERIOD_S = 5.0
+DEFAULT_MAX_FIRING_HISTORY = 256
+#: Resolved instances linger this long in snapshots before eviction.
+RESOLVED_RETENTION_S = 300.0
+
+_TERM_RE = re.compile(
+    r"\s*(?P<func>[a-z_0-9]+)\(\s*(?P<metric>[A-Za-z_][\w.]*)"
+    r"(?:\s*,\s*by\s*=\s*(?P<by>[A-Za-z_]\w*))?\s*\)\s*")
+_OP_RE = re.compile(r"(>=|<=|==|>|<)")
+
+_OPS: Dict[str, Callable[[float, float], bool]] = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+}
+
+_FUNCS = ("rate", "gauge_max", "gauge_avg", "p50", "p95", "hist_rate",
+          "hist_mean")
+
+
+def configured_eval_period_s() -> float:
+    """Engine cadence; honors the documented uppercase env spelling
+    first, then the flag table. ``<= 0`` disables evaluation."""
+    raw = os.environ.get("RAY_TPU_ALERT_EVAL_PERIOD_S", "")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    from ray_tpu._private.ray_config import runtime_config_value
+    return float(runtime_config_value("alert_eval_period_s",
+                                      DEFAULT_EVAL_PERIOD_S))
+
+
+def configured_max_firing_history() -> int:
+    raw = os.environ.get("RAY_TPU_ALERT_MAX_FIRING_HISTORY", "")
+    if raw:
+        try:
+            return int(float(raw))
+        except ValueError:
+            pass
+    from ray_tpu._private.ray_config import runtime_config_value
+    return int(runtime_config_value("alert_max_firing_history",
+                                    DEFAULT_MAX_FIRING_HISTORY))
+
+
+# ---------------------------------------------------------------------------
+# Expr parsing / evaluation
+# ---------------------------------------------------------------------------
+
+
+class _Term:
+    __slots__ = ("func", "metric", "by")
+
+    def __init__(self, func: str, metric: str, by: Optional[str]):
+        if func not in _FUNCS:
+            raise ValueError(f"unknown derivation {func!r} "
+                             f"(one of {', '.join(_FUNCS)})")
+        self.func = func
+        self.metric = metric
+        self.by = by
+
+    def evaluate(self, ts, window: float) -> Dict[str, float]:
+        """Per-group values; groups with no data are absent (a rule over
+        a silent metric simply does not breach)."""
+        if self.func == "rate":
+            return ts.counter_rate(self.metric, window=window,
+                                   group_by=self.by)
+        if self.func in ("gauge_max", "gauge_avg"):
+            stats = ts.gauge_stats(self.metric, window=window,
+                                   group_by=self.by)
+            field = "last_max" if self.func == "gauge_max" else "avg_sum"
+            return {k: float(v[field]) for k, v in stats.items()
+                    if v.get(field) is not None}
+        field = {"p50": "p50", "p95": "p95", "hist_rate": "rate",
+                 "hist_mean": "mean"}[self.func]
+        stats = ts.histogram_stats(self.metric, window=window,
+                                   group_by=self.by)
+        return {k: float(v[field]) for k, v in stats.items()
+                if v.get(field) is not None}
+
+
+def _parse_term(text: str) -> _Term:
+    m = _TERM_RE.fullmatch(text)
+    if m is None:
+        raise ValueError(f"bad alert term {text!r} "
+                         "(expected FUNC(metric[, by=label]))")
+    return _Term(m.group("func"), m.group("metric"), m.group("by"))
+
+
+class Expr:
+    """A parsed rule expression: one term (or a term ratio) compared to
+    a constant. ``evaluate`` returns per-group observed values plus the
+    breach verdict per group."""
+
+    def __init__(self, text: str):
+        self.text = text
+        parts = _OP_RE.split(text, maxsplit=1)
+        if len(parts) != 3:
+            raise ValueError(
+                f"bad alert expr {text!r} (expected TERM OP NUMBER)")
+        lhs, self.op, rhs = parts
+        if self.op not in _OPS:
+            raise ValueError(f"bad comparison {self.op!r}")
+        try:
+            self.threshold = float(rhs)
+        except ValueError:
+            raise ValueError(
+                f"alert threshold must be a number, got {rhs!r}") from None
+        num, sep, den = lhs.partition("/")
+        self.numerator = _parse_term(num)
+        self.denominator = _parse_term(den) if sep else None
+
+    def values(self, ts, window: float) -> Dict[str, float]:
+        num = self.numerator.evaluate(ts, window)
+        if self.denominator is None:
+            return num
+        den = self.denominator.evaluate(ts, window)
+        out = {}
+        for key, n in num.items():
+            d = den.get(key)
+            if d is None and len(den) == 1 and self.denominator.by is None:
+                d = next(iter(den.values()))  # ungrouped denominator
+            if d and d > 0:
+                out[key] = n / d
+            elif n > 0:
+                # Failures with zero successes: the worst ratio, not a
+                # silent divide-by-zero skip.
+                out[key] = float("inf")
+        return out
+
+    def breaches(self, value: float) -> bool:
+        return _OPS[self.op](value, self.threshold)
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+
+class AlertRule:
+    """Threshold rule: ``expr`` must breach continuously for ``for_s``."""
+
+    kind = "threshold"
+
+    def __init__(self, name: str, expr: str, *, for_s: float = 0.0,
+                 window_s: float = 60.0, severity: str = "warning",
+                 cooldown_s: float = 60.0,
+                 labels: Optional[Dict[str, str]] = None,
+                 message: Optional[str] = None,
+                 scale_hint: Optional[Dict[str, str]] = None):
+        if not name:
+            raise ValueError("alert rule needs a name")
+        self.name = name
+        self.expr = Expr(expr)
+        self.for_s = max(0.0, float(for_s))
+        self.window_s = float(window_s)
+        self.severity = severity if severity in ("info", "warning", "error",
+                                                 "critical") else "warning"
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self.labels = dict(labels or {})
+        self.message = message or f"{name}: {expr}"
+        self.scale_hint = dict(scale_hint) if scale_hint else None
+
+    def evaluate(self, ts) -> Dict[str, float]:
+        """group key -> observed value, breaching groups only."""
+        vals = self.expr.values(ts, self.window_s)
+        return {k: v for k, v in vals.items() if self.expr.breaches(v)}
+
+    def describe(self) -> Dict[str, Any]:
+        return {"name": self.name, "kind": self.kind,
+                "expr": self.expr.text, "for_s": self.for_s,
+                "window_s": self.window_s, "severity": self.severity,
+                "cooldown_s": self.cooldown_s,
+                "threshold": self.expr.threshold}
+
+    def hint_for(self, key: str) -> Optional[Dict[str, str]]:
+        if self.scale_hint is None:
+            return None
+        hint = dict(self.scale_hint)
+        if key and "deployment" not in hint:
+            hint["deployment"] = key
+        return hint
+
+
+class BurnRateRule(AlertRule):
+    """Multi-window SLO burn: expr / objective must exceed
+    ``burn_threshold`` in BOTH the fast and the slow window."""
+
+    kind = "burn_rate"
+
+    def __init__(self, name: str, expr: str, *, objective: float,
+                 fast_window_s: float = 60.0, slow_window_s: float = 300.0,
+                 burn_threshold: float = 1.0, **kwargs):
+        kwargs.setdefault("window_s", fast_window_s)
+        super().__init__(name, expr, **kwargs)
+        if objective <= 0:
+            raise ValueError("burn-rate objective must be > 0")
+        self.objective = float(objective)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.burn_threshold = float(burn_threshold)
+
+    def evaluate(self, ts) -> Dict[str, float]:
+        fast = self.expr.values(ts, self.fast_window_s)
+        slow = self.expr.values(ts, self.slow_window_s)
+        out = {}
+        for key, v in fast.items():
+            sv = slow.get(key)
+            if sv is None:
+                continue
+            fast_burn = v / self.objective
+            if (fast_burn > self.burn_threshold
+                    and sv / self.objective > self.burn_threshold):
+                out[key] = fast_burn
+        return out
+
+    def describe(self) -> Dict[str, Any]:
+        d = super().describe()
+        d.update(objective=self.objective,
+                 fast_window_s=self.fast_window_s,
+                 slow_window_s=self.slow_window_s,
+                 burn_threshold=self.burn_threshold)
+        return d
+
+
+def builtin_rules() -> List[AlertRule]:
+    """The rules every cluster ships with. Conservative thresholds —
+    operators tune via ``runtime.add_alert_rule`` (same name replaces)."""
+    return [
+        AlertRule(
+            "node_down", "rate(ray_tpu_node_deaths_total) > 0",
+            window_s=60.0, for_s=0.0, severity="critical",
+            cooldown_s=30.0,
+            message="node death(s) declared in the last minute"),
+        AlertRule(
+            "head_loop_lag",
+            "gauge_max(ray_tpu_loop_lag_seconds, by=loop) > 1.0",
+            window_s=60.0, for_s=10.0, severity="warning",
+            message="a control loop is waking >1s late (saturated head?)"),
+        AlertRule(
+            "spill_failures",
+            "rate(ray_tpu_object_spill_failures_total) > 0",
+            window_s=120.0, for_s=0.0, severity="warning",
+            message="object spill/restore IO is failing"),
+        AlertRule(
+            "checkpoint_persist_failures",
+            "rate(ray_tpu_train_checkpoint_persist_failures_total) > 0",
+            window_s=120.0, for_s=0.0, severity="error",
+            message="train checkpoints are failing to persist durably"),
+        BurnRateRule(
+            "serve_p95_burn",
+            "p95(ray_tpu_serve_request_latency_seconds, by=deployment) > 0",
+            objective=0.5, fast_window_s=60.0, slow_window_s=300.0,
+            burn_threshold=1.0, for_s=10.0, severity="warning",
+            scale_hint={"direction": "up"},
+            message="serve p95 latency is burning its 500ms objective"),
+        BurnRateRule(
+            "serve_error_burn",
+            "rate(ray_tpu_serve_failovers_total) / "
+            "rate(ray_tpu_serve_requests_total) > 0",
+            objective=0.05, fast_window_s=60.0, slow_window_s=300.0,
+            burn_threshold=1.0, for_s=0.0, severity="error",
+            message="serve system-failure rate is burning its 5% objective"),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+
+class _Instance:
+    __slots__ = ("state", "value", "pending_since", "fired_at",
+                 "resolved_at", "last_breach")
+
+    def __init__(self):
+        self.state = "pending"
+        self.value = 0.0
+        self.pending_since: Optional[float] = None
+        self.fired_at: Optional[float] = None
+        self.resolved_at: Optional[float] = None
+        self.last_breach: Optional[float] = None
+
+
+def _render_alert(rule: AlertRule, key: str, inst: _Instance,
+                  now: float) -> Dict[str, Any]:
+    alert = {
+        "rule": rule.name, "key": key, "state": inst.state,
+        "severity": rule.severity, "value": inst.value,
+        "threshold": rule.expr.threshold, "kind": rule.kind,
+        "message": rule.message, "labels": dict(rule.labels),
+        "since_s": max(0.0, now - (inst.pending_since or now)),
+    }
+    if isinstance(rule, BurnRateRule):
+        alert["threshold"] = rule.burn_threshold
+        alert["objective"] = rule.objective
+    hint = rule.hint_for(key)
+    if hint:
+        alert["scale_hint"] = hint
+    return alert
+
+
+class AlertEngine:
+    """Evaluates the rule table against a TimeSeriesStore on the
+    ClusterMetrics merge cadence; owns per-instance state machines."""
+
+    def __init__(self, period_s: Optional[float] = None,
+                 max_history: Optional[int] = None, journal=None):
+        self.period_s = (configured_eval_period_s() if period_s is None
+                         else period_s)
+        self.enabled = self.period_s > 0
+        self.journal = journal
+        self._lock = threading.Lock()
+        self._rules: Dict[str, AlertRule] = {}
+        self._instances: Dict[tuple, _Instance] = {}
+        hist = (configured_max_firing_history() if max_history is None
+                else max_history)
+        self._history: deque = deque(maxlen=max(1, hist))
+        self._subscribers: List[Callable[[Dict[str, Any]], None]] = []
+        self._last_eval: Optional[float] = None
+        for rule in builtin_rules():
+            self._rules[rule.name] = rule
+
+    # -- rule table -------------------------------------------------------
+
+    def add_rule(self, rule: AlertRule) -> None:
+        """Install (or replace, by name) a rule; its instances reset."""
+        with self._lock:
+            self._rules[rule.name] = rule
+            for key in [k for k in self._instances if k[0] == rule.name]:
+                del self._instances[key]
+
+    def remove_rule(self, name: str) -> bool:
+        with self._lock:
+            existed = self._rules.pop(name, None) is not None
+            for key in [k for k in self._instances if k[0] == name]:
+                del self._instances[key]
+        return existed
+
+    def rules(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r.describe() for r in self._rules.values()]
+
+    def subscribe(self, fn: Callable[[Dict[str, Any]], None]) -> None:
+        """``fn(alert_dict)`` on every pending->firing and
+        firing->resolved transition (the serve controller's scale_hint
+        hook rides this)."""
+        with self._lock:
+            self._subscribers.append(fn)
+
+    # -- evaluation -------------------------------------------------------
+
+    def maybe_evaluate(self, ts, now: Optional[float] = None) -> bool:
+        """Rate-limited entry point (called from ClusterMetrics.update);
+        True when a full evaluation ran."""
+        if not self.enabled:
+            return False
+        now = time.monotonic() if now is None else now
+        if self._last_eval is not None and \
+                now - self._last_eval < self.period_s:
+            return False
+        self.evaluate(ts, now=now)
+        return True
+
+    def evaluate(self, ts, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        self._last_eval = now
+        transitions = []
+        with self._lock:
+            rules = list(self._rules.values())
+        for rule in rules:
+            try:
+                breaching = rule.evaluate(ts)
+            except Exception:  # noqa: BLE001 - one bad rule can't stop eval
+                logger.exception("alert rule %s evaluation failed",
+                                 rule.name)
+                continue
+            transitions.extend(self._step_rule(rule, breaching, now))
+        for alert in transitions:
+            self._announce(alert)
+
+    def _step_rule(self, rule: AlertRule, breaching: Dict[str, float],
+                   now: float) -> List[Dict[str, Any]]:
+        """Advance every instance of one rule; returns transition
+        records to announce outside the lock."""
+        out = []
+        with self._lock:
+            for key, value in breaching.items():
+                ikey = (rule.name, key)
+                inst = self._instances.get(ikey)
+                if inst is None or inst.state == "resolved":
+                    # A resolve starts the cooldown clock: within it a
+                    # new breach parks in pending (dedup/anti-flap)
+                    # regardless of for_s.
+                    prev = inst
+                    inst = self._instances[ikey] = _Instance()
+                    if prev is not None and prev.resolved_at is not None:
+                        inst.resolved_at = prev.resolved_at
+                    inst.pending_since = now
+                inst.value = value
+                inst.last_breach = now
+                if inst.state == "pending":
+                    held = now - (inst.pending_since or now)
+                    cooling = (inst.resolved_at is not None and
+                               now - inst.resolved_at < rule.cooldown_s)
+                    if held >= rule.for_s and not cooling:
+                        inst.state = "firing"
+                        inst.fired_at = now
+                        out.append(self._alert_dict_locked(
+                            rule, key, inst, now))
+            # Instances whose rule stopped breaching resolve (firing) or
+            # drop (pending never fired); stale resolved entries age out.
+            for ikey in list(self._instances):
+                rname, key = ikey
+                if rname != rule.name or key in breaching:
+                    continue
+                inst = self._instances[ikey]
+                if inst.state == "firing":
+                    inst.state = "resolved"
+                    inst.resolved_at = now
+                    out.append(self._alert_dict_locked(rule, key, inst, now))
+                elif inst.state == "pending":
+                    del self._instances[ikey]
+                elif inst.resolved_at is not None and \
+                        now - inst.resolved_at > RESOLVED_RETENTION_S:
+                    del self._instances[ikey]
+        return out
+
+    def _alert_dict_locked(self, rule: AlertRule, key: str,
+                           inst: _Instance, now: float) -> Dict[str, Any]:
+        """A transition record: rendered AND appended to the bounded
+        firing history (only _step_rule calls this, on fire/resolve)."""
+        alert = _render_alert(rule, key, inst, now)
+        self._history.append(dict(alert))
+        return alert
+
+    def _announce(self, alert: Dict[str, Any]) -> None:
+        """Count, journal, and fan out one transition (outside the
+        instance lock — subscribers may call back into the engine)."""
+        try:
+            from ray_tpu._private import builtin_metrics
+            builtin_metrics.record_alert_transition(alert["state"])
+        except Exception:  # noqa: BLE001 - counter is best-effort
+            pass
+        if self.journal is not None:
+            sev = alert["severity"] if alert["state"] == "firing" else "info"
+            key_part = f"[{alert['key']}]" if alert["key"] else ""
+            self.journal.record(
+                "alerting",
+                f"alert {alert['rule']}{key_part} -> {alert['state']} "
+                f"(value={alert['value']:.4g})",
+                severity=sev,
+                labels={"rule": alert["rule"], "key": alert["key"],
+                        "state": alert["state"]})
+        with self._lock:
+            subs = list(self._subscribers)
+        for fn in subs:
+            try:
+                fn(dict(alert))
+            except Exception:  # noqa: BLE001 - a bad subscriber is not fatal
+                logger.exception("alert subscriber failed")
+
+    # -- read -------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Active instances + rule table + bounded firing history, all
+        ages relative (monotonic discipline)."""
+        now = time.monotonic()
+        with self._lock:
+            alerts = []
+            for (rname, key), inst in self._instances.items():
+                rule = self._rules.get(rname)
+                if rule is None:
+                    continue
+                alerts.append(_render_alert(rule, key, inst, now))
+            order = {"firing": 0, "pending": 1, "resolved": 2}
+            alerts.sort(key=lambda a: (order.get(a["state"], 3), a["rule"]))
+            return {
+                "enabled": self.enabled,
+                "period_s": self.period_s,
+                "alerts": alerts,
+                "firing": [a for a in alerts if a["state"] == "firing"],
+                "rules": [r.describe() for r in self._rules.values()],
+                "history": list(self._history),
+            }
+
+    def firing(self) -> List[Dict[str, Any]]:
+        return self.snapshot()["firing"]

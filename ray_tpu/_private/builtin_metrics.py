@@ -47,6 +47,22 @@ _fast_channel = {"bytes": 0, "acks": 0}
 # Continuous-profiler stack walks: bumped every sampler tick (hz rate),
 # folded into ray_tpu_profile_samples_total at each snapshot.
 _fast_profile = {"samples": 0}
+# Alerting plane cells: state -> transition count and severity -> event
+# count. Transitions happen inside ClusterMetrics.update's merge path
+# and journal appends can ride task/spill hot paths, so both stay
+# dict adds until flush.
+_fast_alert_transitions: dict = {}
+_fast_cluster_events: dict = {}
+
+
+def record_alert_transition(state: str) -> None:
+    _fast_alert_transitions[state] = \
+        _fast_alert_transitions.get(state, 0) + 1
+
+
+def record_cluster_event(severity: str) -> None:
+    _fast_cluster_events[severity] = \
+        _fast_cluster_events.get(severity, 0) + 1
 
 
 def record_store_hit() -> None:
@@ -131,6 +147,14 @@ def flush_fast_counters() -> None:
     if n:
         _fast_profile["samples"] -= n
         profile_samples().inc(n)
+    for state, n in list(_fast_alert_transitions.items()):
+        if n:
+            _fast_alert_transitions[state] -= n
+            alerts_transitions().inc(n, tags={"state": state})
+    for severity, n in list(_fast_cluster_events.items()):
+        if n:
+            _fast_cluster_events[severity] -= n
+            cluster_events().inc(n, tags={"severity": severity})
     n = _fast_lease_immediate["n"]
     if n:
         _fast_lease_immediate["n"] -= n
@@ -522,6 +546,27 @@ def profile_batches_dropped() -> Counter:
         "and ride the next tick.")
 
 
+# -- alerting plane / cluster events ---------------------------------------
+
+
+def alerts_transitions() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_alerts_transitions_total",
+        "Alert state-machine transitions by the state entered (firing = "
+        "a rule breached past its hold; resolved = the breach cleared).",
+        tag_keys=("state",))
+
+
+def cluster_events() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_cluster_events_total",
+        "Events appended to the head's cluster event journal "
+        "(_private/events.py), by severity.",
+        tag_keys=("severity",))
+
+
 # -- train fault tolerance -------------------------------------------------
 # Gang lifecycle events (a restart or a persisted checkpoint is news,
 # not load): plain lazy accessors, no fast cells. Incremented from the
@@ -544,6 +589,16 @@ def train_checkpoints_persisted() -> Counter:
         "ray_tpu_train_checkpoints_persisted_total",
         "Reported train checkpoints persisted durably through the "
         "storage_path spill backend (what a gang restart resumes from).")
+
+
+def train_checkpoint_persist_failures() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_train_checkpoint_persist_failures_total",
+        "Reported checkpoints whose durable persist raised SpillFailure "
+        "(training continues on the in-memory copy; a gang restart "
+        "would resume from an older checkpoint). Watched by the "
+        "checkpoint_persist_failures alert rule.")
 
 
 def channel_bytes_sent() -> Counter:

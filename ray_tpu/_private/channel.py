@@ -501,6 +501,17 @@ class ResilientChannel:
             self._count("channel_reconnects")
             if replay:
                 self._count("channel_frames_resent", len(replay))
+            try:
+                from ray_tpu._private import events
+                events.emit(
+                    "channel",
+                    f"channel[{self._site}] resumed (gen "
+                    f"{self.generation}, {len(replay)} frame(s) replayed)",
+                    severity="warning",
+                    labels={"site": self._site,
+                            "frames_replayed": len(replay)})
+            except Exception:  # noqa: BLE001 - journal never breaks resume
+                pass
             self._cv.notify_all()
             if old is not sock:
                 close_socket(old)

@@ -1,0 +1,337 @@
+"""Structured cluster event journal.
+
+Analog of the reference's GCS-side event/export subsystem
+(src/ray/util/event.h + dashboard event modules): significant cluster
+transitions — the things we previously only *counted* — become typed
+records an operator (or the alerting plane) can read back in order:
+membership joins/deaths/fencing, serve replica lifecycle and drain
+outcomes, train gang restarts, object spill/restore tiers, channel
+reconnects, flight-recorder incidents, and every alert state
+transition.
+
+Two halves:
+
+* A **process-local pending buffer**: :func:`emit` appends a sanitized
+  record to a small bounded deque from any process (head, daemon,
+  worker). ``MetricsAgent.poll_once`` drains it into each
+  ``metrics_batch`` (the ``"events"`` field, riding the existing
+  transport exactly like the EventStats piggyback), refunding on a
+  dropped frame — no new wire frames, no hot-path registry work.
+* The head-side :class:`EventJournal`: ``ClusterMetrics.update``
+  ingests piggybacked events, stamps the origin node id, assigns a
+  monotonic ``seq``, and appends to a bounded ring
+  (``RAY_TPU_EVENTS_MAX``, <= 0 disables). With
+  ``RAY_TPU_EVENTS_SPILL_URI`` set, the ring is persisted as JSONL
+  through the spill-backend URI system (atomic write-then-rename), so
+  a ``session://`` or ``mock-s3://`` journal survives head restarts
+  and is reloaded on construction.
+
+Timestamps are ``time.monotonic()`` stamped at head ingest (the
+emitting process's clock is meaningless here); reads report ``age_s``.
+Severities: ``info`` < ``warning`` < ``error`` < ``critical``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+SEVERITIES = ("info", "warning", "error", "critical")
+
+DEFAULT_EVENTS_MAX = 2048
+#: Pending events a single process buffers between agent ticks; beyond
+#: this the oldest are dropped (and counted in the drained batch).
+PENDING_MAX = 512
+#: Label hygiene bounds: events cross process boundaries and land in a
+#: long-lived ring, so label cardinality and value size are capped at
+#: emit time — a misbehaving caller cannot bloat the journal.
+MAX_LABELS = 16
+MAX_VALUE_LEN = 128
+MAX_MESSAGE_LEN = 512
+#: Durable persistence throttle: at most one ring rewrite per this many
+#: seconds (the ring is bounded, so each write is small and atomic).
+PERSIST_MIN_INTERVAL_S = 2.0
+PERSIST_FILENAME = "cluster_events.jsonl"
+
+
+def configured_events_max() -> int:
+    """Journal ring bound; honors the documented uppercase env spelling
+    first, then the flag table (live runtime config > env > default)."""
+    raw = os.environ.get("RAY_TPU_EVENTS_MAX", "")
+    if raw:
+        try:
+            return int(float(raw))
+        except ValueError:
+            pass
+    from ray_tpu._private.ray_config import runtime_config_value
+    return int(runtime_config_value("events_max", DEFAULT_EVENTS_MAX))
+
+
+def configured_spill_uri() -> str:
+    raw = os.environ.get("RAY_TPU_EVENTS_SPILL_URI")
+    if raw is not None:
+        return raw
+    from ray_tpu._private.ray_config import runtime_config_value
+    return str(runtime_config_value("events_spill_uri", ""))
+
+
+def sanitize_labels(labels: Optional[Dict[str, Any]]) -> Dict[str, str]:
+    """str->str coercion with bounded cardinality and value length."""
+    out: Dict[str, str] = {}
+    if not labels:
+        return out
+    for k, v in labels.items():
+        if len(out) >= MAX_LABELS:
+            break
+        out[str(k)[:MAX_VALUE_LEN]] = str(v)[:MAX_VALUE_LEN]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Process-local pending buffer (any process; drained by the MetricsAgent)
+# ---------------------------------------------------------------------------
+
+_pending: deque = deque(maxlen=PENDING_MAX)
+_pending_lock = threading.Lock()
+
+
+def emit(source: str, message: str, *, severity: str = "info",
+         node_id: Optional[str] = None,
+         labels: Optional[Dict[str, Any]] = None) -> None:
+    """Queue one event from this process. Cheap (a deque append under a
+    lock), never raises — instrumentation must not break its host."""
+    try:
+        if severity not in SEVERITIES:
+            severity = "info"
+        rec = {
+            "severity": severity,
+            "source": str(source)[:MAX_VALUE_LEN],
+            "message": str(message)[:MAX_MESSAGE_LEN],
+            "labels": sanitize_labels(labels),
+        }
+        if node_id:
+            rec["node_id"] = str(node_id)
+        with _pending_lock:
+            _pending.append(rec)
+    except Exception:  # noqa: BLE001 - emitters must never be hurt
+        pass
+
+
+def drain_pending() -> List[Dict[str, Any]]:
+    """Take (and clear) this process's queued events — called by
+    ``MetricsAgent.poll_once`` when building a batch."""
+    with _pending_lock:
+        if not _pending:
+            return []
+        out = list(_pending)
+        _pending.clear()
+        return out
+
+
+def refund_pending(events: List[Dict[str, Any]]) -> None:
+    """Re-queue events whose batch was dropped (a broken channel); they
+    ride the next tick instead of vanishing."""
+    if not events:
+        return
+    with _pending_lock:
+        _pending.extendleft(reversed(events))
+
+
+# ---------------------------------------------------------------------------
+# Head-side journal
+# ---------------------------------------------------------------------------
+
+
+class EventJournal:
+    """Bounded, ordered ring of cluster events with optional durable
+    persistence through a spill-backend URI."""
+
+    def __init__(self, maxlen: Optional[int] = None,
+                 spill_uri: Optional[str] = None):
+        self.maxlen = configured_events_max() if maxlen is None else maxlen
+        self.enabled = self.maxlen > 0
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(1, self.maxlen))
+        self._seq = 0
+        self.dropped = 0  # emitted while the journal was disabled/full
+        self.spill_uri = (configured_spill_uri() if spill_uri is None
+                          else spill_uri)
+        self._backend = None
+        self._persist_at = 0.0  # monotonic time of the last persist
+        self._dirty = False
+        if self.enabled and self.spill_uri:
+            self._open_backend()
+            self._load()
+
+    # -- persistence ------------------------------------------------------
+
+    def _open_backend(self) -> None:
+        try:
+            from ray_tpu._private import spill
+            self._backend = spill.backend_for_uri(self.spill_uri)
+        except Exception:  # noqa: BLE001 - journal degrades to in-memory
+            logger.warning("event journal: cannot open spill backend %r; "
+                           "journal is in-memory only", self.spill_uri,
+                           exc_info=True)
+            self._backend = None
+
+    def _load(self) -> None:
+        """Reload a persisted journal (head restart with a durable URI).
+        Restored events keep their seq/labels; ages restart from load
+        time (monotonic clocks don't survive the process)."""
+        if self._backend is None:
+            return
+        try:
+            data = self._backend.read(
+                self._backend.uri_for(PERSIST_FILENAME))
+        except Exception:  # noqa: BLE001 - a torn journal is a fresh one
+            data = None
+        if not data:
+            return
+        now = time.monotonic()
+        restored = []
+        for line in data.decode("utf-8", "replace").splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            rec["time"] = now
+            rec["restored"] = True
+            restored.append(rec)
+        with self._lock:
+            for rec in restored[-self.maxlen:]:
+                self._ring.append(rec)
+                self._seq = max(self._seq, int(rec.get("seq", 0)))
+
+    def _maybe_persist_locked(self, now: float, force: bool = False) -> None:
+        if self._backend is None or not self._dirty:
+            return
+        if not force and now - self._persist_at < PERSIST_MIN_INTERVAL_S:
+            return
+        payload = "\n".join(
+            json.dumps({k: v for k, v in rec.items() if k != "time"})
+            for rec in self._ring).encode()
+        try:
+            self._backend.write(PERSIST_FILENAME, payload)
+            self._persist_at = now
+            self._dirty = False
+        except Exception:  # noqa: BLE001 - spill layer already counted it
+            # Leave dirty: the next record retries after the throttle.
+            self._persist_at = now
+
+    def flush(self) -> None:
+        """Force-persist the ring (tests and head teardown)."""
+        with self._lock:
+            self._maybe_persist_locked(time.monotonic(), force=True)
+
+    # -- ingest -----------------------------------------------------------
+
+    def record(self, source: str, message: str, *, severity: str = "info",
+               node_id: str = "", labels: Optional[Dict[str, Any]] = None,
+               now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """Append one event (head-local emitters call this directly);
+        returns the stored record, or None when the journal is off."""
+        if not self.enabled:
+            self.dropped += 1
+            return None
+        if severity not in SEVERITIES:
+            severity = "info"
+        now = time.monotonic() if now is None else now
+        rec = {
+            "severity": severity,
+            "source": str(source)[:MAX_VALUE_LEN],
+            "node_id": str(node_id or ""),
+            "message": str(message)[:MAX_MESSAGE_LEN],
+            "labels": sanitize_labels(labels),
+            "time": now,
+        }
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring.append(rec)
+            self._dirty = True
+            self._maybe_persist_locked(now)
+        try:
+            from ray_tpu._private import builtin_metrics
+            builtin_metrics.record_cluster_event(severity)
+        except Exception:  # noqa: BLE001 - counter is best-effort
+            pass
+        return rec
+
+    def ingest(self, node_id: str, events: List[Dict[str, Any]]) -> None:
+        """Merge piggybacked events from one metrics_batch; the transport
+        node id wins unless the emitter stamped a subject node."""
+        for ev in events or ():
+            if not isinstance(ev, dict):
+                continue
+            self.record(
+                ev.get("source", ""), ev.get("message", ""),
+                severity=ev.get("severity", "info"),
+                node_id=ev.get("node_id") or node_id or "",
+                labels=ev.get("labels"))
+
+    # -- read -------------------------------------------------------------
+
+    def query(self, *, severity: Optional[str] = None,
+              source: Optional[str] = None,
+              node_id: Optional[str] = None,
+              since_seq: Optional[int] = None,
+              limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Filtered, seq-ordered events (oldest first); each row carries
+        ``age_s`` instead of its raw monotonic timestamp. ``severity``
+        is a floor: ``warning`` returns warning and above."""
+        if severity is not None and severity not in SEVERITIES:
+            raise ValueError(f"unknown severity {severity!r} "
+                             f"(one of {', '.join(SEVERITIES)})")
+        floor = SEVERITIES.index(severity) if severity else 0
+        now = time.monotonic()
+        with self._lock:
+            rows = list(self._ring)
+        out = []
+        for rec in rows:
+            if SEVERITIES.index(rec.get("severity", "info")) < floor:
+                continue
+            if source and rec.get("source") != source:
+                continue
+            if node_id and rec.get("node_id") != node_id:
+                continue
+            if since_seq is not None and rec.get("seq", 0) <= since_seq:
+                continue
+            row = {k: v for k, v in rec.items() if k != "time"}
+            row["age_s"] = max(0.0, now - rec.get("time", now))
+            out.append(row)
+        if limit is not None and limit >= 0:
+            out = out[-limit:]
+        return out
+
+    def annotations(self, *, limit: int = 200) -> List[Dict[str, Any]]:
+        """Grafana annotations-style rows derived from the journal:
+        ``{text, tags, age_s}`` — the dashboard layer converts age to an
+        absolute epoch-ms ``time`` at the HTTP boundary (wall clocks
+        stay out of _private/)."""
+        out = []
+        for rec in self.query(limit=limit):
+            tags = [rec.get("severity", "info"),
+                    rec.get("source", "")]
+            if rec.get("node_id"):
+                tags.append(f"node:{rec['node_id'][:12]}")
+            out.append({"text": rec.get("message", ""),
+                        "tags": [t for t in tags if t],
+                        "age_s": rec["age_s"]})
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            count, seq = len(self._ring), self._seq
+        return {"count": count, "seq": seq, "max": self.maxlen,
+                "dropped": self.dropped, "enabled": self.enabled,
+                "spill_uri": self.spill_uri}

@@ -162,14 +162,23 @@ class MetricsAgent:
             spans, self._span_cursor = _tracing.drain_finished_spans(
                 self._span_cursor)
             self._maybe_publish_profile()
-            if not batch_metrics and not spans:
+            # Cluster events ride the same frames as metrics (the
+            # EventStats piggyback pattern): drain this process's
+            # pending buffer into the batch, refund on a dropped frame.
+            from ray_tpu._private import events as _events
+            pending_events = _events.drain_pending()
+            if not batch_metrics and not spans and not pending_events:
                 return False
             batch = {"pid": self.pid, "component": self.component,
                      "metrics": batch_metrics, "spans": spans}
+            if pending_events:
+                batch["events"] = pending_events
             sent = bool(self._publish(batch))
             # A dropped frame means the head may now hold stale series:
             # resend everything once the channel recovers.
             self._force_full = not sent
+            if not sent and pending_events:
+                _events.refund_pending(pending_events)
             return sent
 
     def _maybe_publish_profile(self) -> None:
@@ -252,6 +261,13 @@ class ClusterMetrics:
         # Continuous-profiling plane: profile_batch frames land here and
         # the loop-lag flight recorder watches every merged lag sample.
         self.profiles = ProfileStore(staleness=self.staleness)
+        # Alerting plane: the journal collects piggybacked cluster
+        # events; the engine evaluates its rule table against the
+        # time-series store on this merge cadence (period-gated).
+        from ray_tpu._private.events import EventJournal
+        from ray_tpu._private.alerting import AlertEngine
+        self.events = EventJournal()
+        self.alerts = AlertEngine(journal=self.events)
 
     def update(self, node_id: str, batch: Dict[str, Any]) -> None:
         """Merge one ``metrics_batch`` payload. Cumulative values make the
@@ -300,10 +316,28 @@ class ClusterMetrics:
             for tag_vals, lag in entry.get("series", {}).items():
                 loop = tag_vals[0] if tag_vals else ""
                 try:
-                    self.profiles.observe_loop_lag(
+                    recorded = self.profiles.observe_loop_lag(
                         str(loop), float(lag), key[0], key[1], key[2])
+                    if recorded:
+                        # Flight-recorder incidents are journal-worthy:
+                        # the lag and origin land next to the alert the
+                        # head_loop_lag rule may raise from them.
+                        self.events.record(
+                            "flight_recorder",
+                            f"loop {loop} lagged {float(lag):.2f}s "
+                            f"(stacks snapshotted)",
+                            severity="warning", node_id=key[0],
+                            labels={"loop": str(loop),
+                                    "component": key[2]})
                 except Exception:  # noqa: BLE001 - recorder is best-effort
                     logger.exception("flight recorder observe failed")
+        events = batch.get("events")
+        if events:
+            self.events.ingest(node_id or "", events)
+        try:
+            self.alerts.maybe_evaluate(self.timeseries)
+        except Exception:  # noqa: BLE001 - alerting must not break merges
+            logger.exception("alert evaluation failed")
         for span in batch.get("spans", ()):
             stamped = dict(span)
             stamped["node_id"] = node_id or ""

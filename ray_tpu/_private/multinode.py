@@ -1313,8 +1313,13 @@ class HeadServer:
                     # health thread re-announcing: fence it — counted,
                     # not warned per-announce (a partitioned daemon's
                     # reconnect loop would spam the log).
-                    from ray_tpu._private import builtin_metrics
+                    from ray_tpu._private import builtin_metrics, events
                     builtin_metrics.frames_fenced().inc()
+                    events.emit(
+                        "membership", "fenced unknown health-channel "
+                        "announce", severity="warning",
+                        node_id=str(register.get("node_id", "")),
+                        labels={"kind": "health_channel"})
                     sock.close()
                 return
             assert register["type"] == "register", register
@@ -1436,8 +1441,14 @@ class HeadServer:
             # when the lease expired. The FENCED verdict (vs a generic
             # rejection) tells the daemon to drop its stale residents
             # and re-register as a fresh incarnation.
-            from ray_tpu._private import builtin_metrics
+            from ray_tpu._private import builtin_metrics, events
             builtin_metrics.frames_fenced().inc()
+            events.emit(
+                "membership",
+                f"fenced resume from dead incarnation {epoch}",
+                severity="warning",
+                node_id=str(register.get("node_id", "")),
+                labels={"kind": "resume", "epoch": epoch})
             logger.info(
                 "Fencing resume from dead incarnation %d of node %s",
                 epoch, str(register.get("node_id"))[:12])

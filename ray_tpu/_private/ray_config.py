@@ -115,6 +115,15 @@ _PY_DEFAULTS: Dict[str, Any] = {
     "profile_flight_lag_s": 1.0,
     "profile_max_incidents": 32,
     "profile_max_duration_s": 60.0,
+    # Alerting plane + cluster event journal (alerting.py / events.py):
+    # rule-evaluation cadence on the ClusterMetrics merge path (<= 0
+    # disables the engine), the bound on retained alert transitions,
+    # the journal ring size (<= 0 disables the journal), and an
+    # optional spill-backend URI for durable journal persistence.
+    "alert_eval_period_s": 5.0,
+    "alert_max_firing_history": 256,
+    "events_max": 2048,
+    "events_spill_uri": "",
     "task_events_enabled": True,
     "memory_monitor_refresh_ms": 250,
     "memory_usage_threshold": 0.95,

@@ -2808,6 +2808,28 @@ class Runtime:
         import json
         self.pubsub.publish("membership", str(event.get("node_id", "")),
                             json.dumps(event))
+        # Journal the transition (head-local journal: direct append, no
+        # piggyback latency). Joins are news; deaths are errors.
+        kind = event.get("event", "")
+        node_hex = str(event.get("node_id", ""))
+        metrics = getattr(self, "_cluster_metrics", None)
+        if metrics is None:  # an event before the pipeline exists
+            return
+        journal = metrics.events
+        if kind == "joined":
+            journal.record(
+                "membership", f"node {node_hex[:12]} joined "
+                f"(epoch {event.get('epoch')})",
+                severity="info", node_id=node_hex,
+                labels={"epoch": event.get("epoch", "")})
+        elif kind == "dead":
+            journal.record(
+                "membership", f"node {node_hex[:12]} declared dead "
+                f"({event.get('reason', 'unknown')}, "
+                f"epoch {event.get('epoch')})",
+                severity="error", node_id=node_hex,
+                labels={"reason": event.get("reason", ""),
+                        "epoch": event.get("epoch", "")})
 
     def _publish_log_batch(self, batch: dict) -> bool:
         """Head-local LogMonitor sink: stamp head identity, fan out."""
@@ -3071,6 +3093,14 @@ class Runtime:
             for key, stats in ts.gauge_stats(
                 "ray_tpu_loop_lag_seconds", window=w,
                 group_by="loop").items() if key}
+        # Firing alerts ride the same snapshot so `ray-tpu top`'s banner
+        # costs no extra round-trip (evaluation is period-gated).
+        cm = self._cluster_metrics
+        try:
+            cm.alerts.maybe_evaluate(ts)
+        except Exception:  # noqa: BLE001 - a bad rule must not break top
+            logger.exception("alert evaluation in top_snapshot failed")
+        firing = cm.alerts.firing()
         return {
             "window_s": w,
             "nodes": nodes,
@@ -3078,11 +3108,66 @@ class Runtime:
             "objects": objects,
             "serve": self.serve_stats(window=w)["deployments"],
             "loops": loops,
+            "alerts": {
+                "firing": firing,
+                "firing_count": len(firing),
+                "rules": [a["rule"] for a in firing],
+            },
             "timeseries": {
                 "series": ts.series_count(),
                 "dropped_series": ts.dropped_series,
             },
         }
+
+    # -- alerting plane + cluster event journal --------------------------
+
+    def alerts_snapshot(self) -> dict:
+        """Active alert instances, rule table, and firing history from
+        the head's alert engine. The head's own registry is polled
+        first (fresh head samples) and an evaluation is forced so the
+        answer reflects the store as of this call, not the last merge
+        tick."""
+        self._flush_trace_spans()
+        cm = self._cluster_metrics
+        try:
+            cm.alerts.maybe_evaluate(cm.timeseries)
+        except Exception:  # noqa: BLE001 - reads must not fail on eval
+            logger.exception("alert evaluation on read failed")
+        return cm.alerts.snapshot()
+
+    def add_alert_rule(self, rule) -> None:
+        """Install (or replace, by name) a user alert rule — an
+        ``alerting.AlertRule`` / ``BurnRateRule`` instance."""
+        self._cluster_metrics.alerts.add_rule(rule)
+
+    def remove_alert_rule(self, name: str) -> bool:
+        return self._cluster_metrics.alerts.remove_rule(name)
+
+    def subscribe_alerts(self, fn) -> None:
+        """``fn(alert_dict)`` on every firing/resolved transition (the
+        serve controller's scale_hint hook)."""
+        self._cluster_metrics.alerts.subscribe(fn)
+
+    def cluster_events(self, severity: Optional[str] = None,
+                       source: Optional[str] = None,
+                       node_id: Optional[str] = None,
+                       since_seq: Optional[int] = None,
+                       limit: Optional[int] = None) -> List[dict]:
+        """Filtered journal rows (oldest first, ``age_s`` stamped). The
+        head agent is polled first so head-emitted events don't wait an
+        export tick."""
+        self._flush_trace_spans()
+        return self._cluster_metrics.events.query(
+            severity=severity, source=source, node_id=node_id,
+            since_seq=since_seq, limit=limit)
+
+    def cluster_events_stats(self) -> dict:
+        return self._cluster_metrics.events.stats()
+
+    def cluster_event_annotations(self, limit: int = 200) -> List[dict]:
+        """Grafana annotations-style feed derived from the journal."""
+        self._flush_trace_spans()
+        return self._cluster_metrics.events.annotations(limit=limit)
 
     # -- continuous profiling plane (profile_store.py) ------------------
 
@@ -3812,6 +3897,11 @@ class Runtime:
                 self._remote_keys[key] = oid
             builtin_metrics.object_restores().inc(
                 tags={"source": "replica"})
+            self._cluster_metrics.events.record(
+                "objects", f"object {oid.hex()[:12]} re-pointed at "
+                f"replica holder {nid.hex()[:12]}",
+                severity="info", node_id=node_id.hex(),
+                labels={"tier": "replica"})
             logger.warning(
                 "object %s survives node %s death on replica holder %s",
                 oid.hex()[:12], node_id.hex()[:12], nid.hex()[:12])
@@ -3842,6 +3932,11 @@ class Runtime:
         self.store.invalidate([oid])
         self.store.put_inline(oid, value)
         builtin_metrics.object_restores().inc(tags={"source": "spill"})
+        self._cluster_metrics.events.record(
+            "objects", f"object {oid.hex()[:12]} restored from durable "
+            f"spill after node {node_id.hex()[:12]} death",
+            severity="info", node_id=node_id.hex(),
+            labels={"tier": "spill"})
         logger.warning(
             "restored object %s from spill URI %s after node %s death",
             oid.hex()[:12], uri, node_id.hex()[:12])
@@ -3868,6 +3963,10 @@ class Runtime:
                     self._lineage[roid] = clone
         self.store.invalidate(list(clone.return_ids))
         builtin_metrics.object_restores().inc(tags={"source": "lineage"})
+        self._cluster_metrics.events.record(
+            "objects", f"object {oid.hex()[:12]} re-executing producer "
+            f"task {spec.name} from lineage (spill unreadable)",
+            severity="warning", labels={"tier": "lineage"})
         self._register_task_refs(clone)
         self._resolve_dependencies(clone)
         return True

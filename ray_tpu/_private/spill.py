@@ -384,7 +384,11 @@ def read_uri(uri: str, expected_size: int = 0) -> Optional[bytes]:
 
 def _count_failure(op: str) -> None:
     try:
-        from ray_tpu._private import builtin_metrics
+        from ray_tpu._private import builtin_metrics, events
         builtin_metrics.object_spill_failures().inc(tags={"op": op})
+        # Journal-worthy: spill IO failing is how durable tiers silently
+        # degrade to lineage re-execution. Rides the next metrics tick.
+        events.emit("spill", f"spill backend {op} failure",
+                    severity="warning", labels={"op": op})
     except Exception:  # noqa: BLE001 - metrics must never break spill IO
         pass

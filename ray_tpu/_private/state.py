@@ -136,4 +136,20 @@ def status_summary() -> str:
                 f"hb_age={row['last_heartbeat_age_s']:.1f}s"
                 + (f" soft_failures={row['soft_failures']}"
                    if row.get("soft_failures") else ""))
+    # Firing alerts (alerting plane): `ray-tpu status` answers "is the
+    # cluster healthy" without a dashboard round-trip.
+    alerts_fn = getattr(rt, "alerts_snapshot", None)
+    if alerts_fn is not None:
+        try:
+            firing = alerts_fn().get("firing", [])
+        except Exception:  # noqa: BLE001 - status must still answer
+            firing = []
+        if firing:
+            lines.append(f"Alerts firing ({len(firing)}):")
+            for a in firing:
+                key = f"[{a['key']}]" if a.get("key") else ""
+                lines.append(
+                    f"  {a['rule']}{key}: {a.get('severity', '')} "
+                    f"value={a.get('value', 0):.4g} "
+                    f"for {a.get('since_s', 0):.0f}s")
     return "\n".join(lines)

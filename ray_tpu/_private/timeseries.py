@@ -81,7 +81,7 @@ class _Series:
     ``last`` (cumulative, diffed at query time)."""
 
     __slots__ = ("name", "kind", "labels", "boundaries",
-                 "raw", "rollups", "last_seen", "dead_at")
+                 "raw", "rollups", "last_seen", "dead_at", "born")
 
     def __init__(self, name: str, kind: str, labels: Dict[str, str],
                  boundaries: Tuple[float, ...], window_s: float):
@@ -96,9 +96,12 @@ class _Series:
             for step in ROLLUP_STEPS}
         self.last_seen = time.monotonic()
         self.dead_at: Optional[float] = None
+        self.born: Optional[float] = None  # first sample's timestamp
 
     def append(self, now: float, value: Any) -> None:
         self.last_seen = now
+        if self.born is None:
+            self.born = now
         self._fold(self.raw, now - now % 1.0, value)
         for step, ring in self.rollups.items():
             self._fold(ring, now - now % step, value)
@@ -147,16 +150,26 @@ class _Series:
     def rate(self, now: float, window: float) -> float:
         """Reset-safe counter rate: sum of positive deltas (a drop means
         the process restarted — the new cumulative value IS the delta)
-        over the observed span."""
+        over the observed span. A series BORN inside the window gets an
+        implicit 0 baseline: a counter cell exists only after its first
+        inc, so its first cumulative sample is in-window activity (the
+        first node death must rate > 0, not anchor the baseline)."""
         pts = self.window_points(now, window)
-        if len(pts) < 2:
+        if not pts:
             return 0.0
         total = 0.0
+        if self.born is not None and pts[0][0] <= self.born:
+            total += pts[0][1]
         for prev, cur in zip(pts, pts[1:]):
             delta = cur[1] - prev[1]
             total += delta if delta >= 0 else cur[1]
         span = pts[-1][0] - pts[0][0]
-        return total / span if span > 0 else 0.0
+        if span <= 0:
+            # Lone birth bucket: spread the credit over the elapsed
+            # window so the first evaluation already sees the spike.
+            span = max(1.0, min(window, now - pts[0][0]))
+            return total / span if total > 0 else 0.0
+        return total / span
 
     def gauge_summary(self, now: float, window: float) -> Dict[str, float]:
         pts = self.window_points(now, window)

@@ -47,6 +47,12 @@ _DEFAULT_PANELS = [
      "rate(ray_tpu_channel_bytes_sent_total[1m])", "Bps"),
     ("Channel pure acks / s",
      "rate(ray_tpu_channel_acks_sent_total[1m])", "ops"),
+    ("Alert transitions / s (by state)",
+     "sum by (state) (rate(ray_tpu_alerts_transitions_total[5m]))",
+     "ops"),
+    ("Cluster events / s (by severity)",
+     "sum by (severity) (rate(ray_tpu_cluster_events_total[5m]))",
+     "ops"),
     ("Profile samples / s (by component)",
      "sum by (component) (rate(ray_tpu_profile_samples_total[1m]))",
      "ops"),
@@ -163,6 +169,17 @@ def generate_dashboard(extra_metrics: Optional[List[str]] = None
             "name": "datasource",
             "type": "datasource",
             "query": "prometheus",
+        }]},
+        # The event journal doubles as the annotation source: the
+        # dashboard head serves Grafana-shaped rows ({time: epoch-ms,
+        # text, tags}) at GET /api/events?fmt=annotations for a JSON
+        # datasource; severity/source/node ride along as tags.
+        "annotations": {"list": [{
+            "name": "cluster events",
+            "enable": True,
+            "iconColor": "red",
+            "hide": False,
+            "target": {"type": "tags", "tags": ["error", "critical"]},
         }]},
         "panels": panels,
     }

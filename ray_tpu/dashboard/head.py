@@ -31,6 +31,13 @@ Routes (all JSON unless noted):
                                  no name lists the stored series)
   GET  /api/serve/stats        — per-deployment qps/p95/queue/replicas
                                  rollup (?window=, default 30s)
+  GET  /api/alerts             — alert engine snapshot: active
+                                 instances, rule table (?history=1
+                                 adds the transition history)
+  GET  /api/events             — cluster event journal (?severity=
+                                 floor &source=&node_id=&since_seq=
+                                 &limit=; ?fmt=annotations returns a
+                                 Grafana annotations feed, epoch ms)
   GET  /                       — minimal HTML index
 """
 
@@ -73,7 +80,8 @@ class DashboardHead:
                          "/api/logs?list=1",
                          "/api/serve/applications", "/api/timeline",
                          "/api/traces", "/api/event_stats",
-                         "/api/timeseries", "/api/serve/stats"))
+                         "/api/timeseries", "/api/serve/stats",
+                         "/api/alerts", "/api/events"))
         return web.Response(
             text=f"<html><body><h2>ray_tpu dashboard</h2><ul>{rows}</ul>"
                  "</body></html>",
@@ -99,11 +107,24 @@ class DashboardHead:
         snap = getattr(runtime, "membership_snapshot", None)
         if snap is not None:
             membership = {row["node_id"]: row for row in snap()}
+        # Firing alerts ride along so one status poll answers "is the
+        # cluster healthy" without a second round-trip.
+        alerts = {"firing": [], "firing_count": 0}
+        alerts_fn = getattr(runtime, "alerts_snapshot", None)
+        if alerts_fn is not None:
+            try:
+                firing = [a for a in
+                          (await asyncio.to_thread(alerts_fn))["alerts"]
+                          if a.get("state") == "firing"]
+                alerts = {"firing": firing, "firing_count": len(firing)}
+            except Exception:  # noqa: BLE001 - status must still answer
+                pass
         return self._json({
             "cluster_resources": ray_tpu.cluster_resources(),
             "available_resources": ray_tpu.available_resources(),
             "nodes": ray_tpu.nodes(),
             "membership": membership,
+            "alerts": alerts,
         })
 
     async def _state(self, request):
@@ -522,6 +543,56 @@ class DashboardHead:
             "stats": runtime.profile_stats(),
         })
 
+    async def _alerts(self, request):
+        """Alert engine snapshot: active instances (firing → pending →
+        resolved), rule table, ``?history=1`` adds the bounded
+        transition history."""
+        from ray_tpu._private.worker import global_worker
+        runtime = getattr(global_worker, "_runtime", None)
+        if runtime is None:
+            return self._json({"error": "no runtime"}, status=503)
+        snap = await asyncio.to_thread(runtime.alerts_snapshot)
+        if not request.query.get("history"):
+            snap.pop("history", None)
+        return self._json(snap)
+
+    async def _events(self, request):
+        """Cluster event journal. Filters: ``?severity=`` (a floor —
+        ``warning`` includes error/critical), ``?source=``,
+        ``?node_id=``, ``?since_seq=``, ``?limit=``.
+        ``?fmt=annotations`` returns a Grafana annotations-style feed;
+        journal rows are monotonic-stamped, so the epoch-ms conversion
+        happens here at the HTTP boundary."""
+        import time
+
+        from ray_tpu._private.worker import global_worker
+        runtime = getattr(global_worker, "_runtime", None)
+        if runtime is None:
+            return self._json({"error": "no runtime"}, status=503)
+        q = request.query
+        try:
+            since_seq = int(q["since_seq"]) if q.get("since_seq") else None
+            limit = int(q["limit"]) if q.get("limit") else None
+        except ValueError:
+            return self._json(
+                {"error": "since_seq and limit must be integers"},
+                status=400)
+        if q.get("fmt") == "annotations":
+            rows = await asyncio.to_thread(
+                runtime.cluster_event_annotations, limit or 200)
+            now_ms = int(time.time() * 1000)
+            for row in rows:
+                row["time"] = now_ms - int(row.pop("age_s", 0.0) * 1000)
+            return self._json({"annotations": rows})
+        try:
+            rows = await asyncio.to_thread(
+                runtime.cluster_events, q.get("severity"),
+                q.get("source"), q.get("node_id"), since_seq, limit)
+        except ValueError as exc:
+            return self._json({"error": str(exc)}, status=400)
+        return self._json({"events": rows,
+                           "stats": runtime.cluster_events_stats()})
+
     async def _grafana(self, request):
         """Generated Grafana dashboard JSON over this cluster's
         Prometheus metrics (reference:
@@ -560,6 +631,8 @@ class DashboardHead:
         app.router.add_get("/api/profile/diff", self._profile_diff)
         app.router.add_get("/api/profile/incidents",
                            self._profile_incidents)
+        app.router.add_get("/api/alerts", self._alerts)
+        app.router.add_get("/api/events", self._events)
         app.router.add_get("/api/grafana_dashboard", self._grafana)
         return app
 

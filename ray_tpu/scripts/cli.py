@@ -56,6 +56,10 @@ def _render_top_frame(snap: dict) -> str:
         f"{len(snap.get('nodes', []))} node(s) — "
         f"{ts_meta.get('series', 0)} series "
         f"({ts_meta.get('dropped_series', 0)} dropped)")
+    alerts = snap.get("alerts", {})
+    if alerts.get("firing_count"):
+        rules = ", ".join(sorted(set(alerts.get("rules", []))))
+        lines.append(f"ALERTS FIRING: {alerts['firing_count']} ({rules})")
     lines.append(
         f"tasks/s  submitted {tasks.get('submitted_per_s', 0.0):.2f}  "
         f"finished {tasks.get('finished_per_s', 0.0):.2f}  "
@@ -404,6 +408,93 @@ def cmd_logs(args) -> int:
         return 0
 
 
+def cmd_alerts(args) -> int:
+    """`ray-tpu alerts [--history] [--json]` — active alert instances
+    (firing → pending → resolved) and the rule table from the head's
+    alert engine; every number comes from the time-series store."""
+    _ensure_init()
+    from ray_tpu._private.worker import global_worker
+    snap = global_worker.runtime.alerts_snapshot()
+    if args.json:
+        print(json.dumps(snap, indent=2, default=str))
+        return 0
+    print(f"alerting {'enabled' if snap.get('enabled') else 'DISABLED'} — "
+          f"eval period {snap.get('period_s', 0):g}s — "
+          f"{len(snap.get('rules', []))} rule(s) — "
+          f"{len(snap.get('firing', []))} firing")
+    alerts = snap.get("alerts", [])
+    if alerts:
+        rows = [(a.get("state", "").upper(), a.get("rule", ""),
+                 a.get("key") or "-", a.get("severity", ""),
+                 f"{a.get('value', 0):.4g}", f"{a.get('since_s', 0):.0f}s")
+                for a in alerts]
+        hdr = ("STATE", "RULE", "KEY", "SEVERITY", "VALUE", "SINCE")
+        widths = [max(len(hdr[i]), *(len(r[i]) for r in rows))
+                  for i in range(len(hdr))]
+        fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+        print(fmt.format(*hdr))
+        for r in rows:
+            print(fmt.format(*r))
+    else:
+        print("no active alert instances")
+    if args.history:
+        for h in snap.get("history", []):
+            print(f"{h.get('since_s', 0):>8.1f}s ago  "
+                  f"{h.get('state', ''):<9} {h.get('rule', '')}"
+                  f"[{h.get('key') or '-'}] value={h.get('value', 0):.4g}")
+    return 0
+
+
+def cmd_events(args) -> int:
+    """`ray-tpu events [--severity S] [--source S] [--node N]
+    [--limit N] [--follow] [--json]` — the head's cluster event
+    journal (membership, serve, train, spill, alert transitions)."""
+    import time as _time
+
+    _ensure_init()
+    from ray_tpu._private.worker import global_worker
+    rt = global_worker.runtime
+
+    def _print(rows) -> None:
+        for ev in rows:
+            if args.json:
+                print(json.dumps(ev, default=str))
+                continue
+            labels = ev.get("labels") or {}
+            extra = " ".join(f"{k}={v}"
+                             for k, v in sorted(labels.items()))
+            node = (ev.get("node_id") or "")[:12] or "-"
+            print(f"{ev.get('seq', 0):>6}  {ev.get('age_s', 0):>7.1f}s  "
+                  f"{ev.get('severity', ''):<8} "
+                  f"{ev.get('source', ''):<14} {node:<12}  "
+                  f"{ev.get('message', '')}"
+                  + (f"  [{extra}]" if extra else ""))
+
+    try:
+        rows = rt.cluster_events(severity=args.severity,
+                                 source=args.source, node_id=args.node,
+                                 limit=args.limit)
+    except ValueError as exc:
+        print(str(exc), file=sys.stderr)
+        return 1
+    _print(rows)
+    if not args.follow:
+        return 0
+    last_seq = rows[-1]["seq"] if rows else 0
+    try:
+        while True:
+            _time.sleep(1.0)
+            fresh = rt.cluster_events(severity=args.severity,
+                                      source=args.source,
+                                      node_id=args.node,
+                                      since_seq=last_seq)
+            _print(fresh)
+            if fresh:
+                last_seq = fresh[-1]["seq"]
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_profile(args) -> int:
     """CPU profiles, four ways: this driver process (default), a node
     daemon (--node), any cluster worker by pid (--pid, cooperative —
@@ -654,6 +745,28 @@ def main(argv=None) -> int:
     p.add_argument("--list", action="store_true",
                    help="list the session's log files instead")
 
+    p = sub.add_parser("alerts", help="active alerts + rule table from "
+                                      "the head's alert engine")
+    p.add_argument("--history", action="store_true",
+                   help="also print the bounded transition history")
+    p.add_argument("--json", action="store_true",
+                   help="emit the raw snapshot as JSON")
+    p = sub.add_parser("events", help="cluster event journal "
+                                      "(membership, serve, train, "
+                                      "spill, alert transitions)")
+    p.add_argument("--severity", default=None,
+                   help="minimum severity (info/warning/error/critical)")
+    p.add_argument("--source", default=None,
+                   help="only events from this subsystem")
+    p.add_argument("--node", default=None,
+                   help="only events stamped with this node id")
+    p.add_argument("--limit", type=int, default=None,
+                   help="last N matching events")
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="keep polling for new events (by seq)")
+    p.add_argument("--json", action="store_true",
+                   help="one JSON object per line")
+
     p = sub.add_parser("profile", help="sample CPU stacks on demand "
                                        "(driver, --node <id>, --pid, "
                                        "--cluster) or --report the "
@@ -741,6 +854,8 @@ def main(argv=None) -> int:
         "microbenchmark": cmd_microbenchmark,
         "profile": cmd_profile,
         "grafana-dashboards": cmd_grafana,
+        "alerts": cmd_alerts,
+        "events": cmd_events,
     }[args.command]
     return handler(args)
 

@@ -28,7 +28,7 @@ import logging
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
-from ray_tpu._private import builtin_metrics
+from ray_tpu._private import builtin_metrics, events
 from ray_tpu.serve._private.common import (DRAINING, RUNNING, STARTING,
                                            STOPPED, is_system_failure,
                                            serve_config)
@@ -112,6 +112,12 @@ class ServeController:
         self._node_event: Optional[asyncio.Event] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._membership_subscribed = False
+        # Scale hints pushed by the alerting plane (typed scale_hint
+        # alerts, e.g. serve_p95_burn): latest firing hint per
+        # deployment, cleared on resolve. Input signal for a future
+        # autoscaler; surfaced via scale_hints() today.
+        self._scale_hints: Dict[str, dict] = {}
+        self._alerts_subscribed = False
 
     def _bump_membership(self) -> None:
         self._membership_version += 1
@@ -131,6 +137,9 @@ class ServeController:
         if not self._membership_subscribed:
             self._membership_subscribed = True
             self._subscribe_membership()
+        if not self._alerts_subscribed:
+            self._alerts_subscribed = True
+            self._subscribe_alerts()
         if self._control_task is None or self._control_task.done():
             self._control_task = asyncio.ensure_future(self._control_loop())
 
@@ -147,6 +156,40 @@ class ServeController:
             membership = None
         if membership is not None:
             membership.subscribe(self._on_membership_event)
+
+    def _subscribe_alerts(self) -> None:
+        """Subscribe to the head alert engine for typed scale_hint
+        alerts (same in-process best-effort reach as membership)."""
+        try:
+            from ray_tpu._private.worker import global_worker
+            global_worker._runtime.subscribe_alerts(self._on_alert)
+        except Exception:  # noqa: BLE001 - no in-process alert plane
+            pass
+
+    def _on_alert(self, alert: dict) -> None:
+        """Runs on the head metrics-update thread: record/clear the
+        latest scale hint per deployment. No replica churn here — the
+        hint is advisory input for the autoscaler, not a command."""
+        hint = alert.get("scale_hint")
+        if not isinstance(hint, dict):
+            return
+        deployment = str(hint.get("deployment")
+                         or (alert.get("labels") or {}).get("deployment")
+                         or alert.get("key") or "")
+        if not deployment:
+            return
+        if alert.get("state") == "firing":
+            self._scale_hints[deployment] = {
+                "direction": hint.get("direction", "up"),
+                "rule": alert.get("rule"),
+                "value": alert.get("value"),
+            }
+        elif alert.get("state") == "resolved":
+            self._scale_hints.pop(deployment, None)
+
+    def scale_hints(self) -> Dict[str, dict]:
+        """Latest firing scale hints, keyed by deployment."""
+        return dict(self._scale_hints)
 
     def _on_membership_event(self, event: dict) -> None:
         """Runs on the DECLARER's thread (membership fan-out): hop to
@@ -238,6 +281,9 @@ class ServeController:
             info.init_kwargs)
         rs = ReplicaState(handle, actor_name, info.version)
         info.replicas.append(rs)
+        events.emit("serve", f"replica {actor_name} starting",
+                    labels={"deployment": info.name, "replica": actor_name,
+                            "version": info.version})
         return rs
 
     def _stop_replica(self, info: DeploymentInfo, rs: ReplicaState) -> None:
@@ -248,6 +294,9 @@ class ServeController:
             pass
         if rs in info.replicas:
             info.replicas.remove(rs)
+        events.emit("serve", f"replica {rs.name} stopped",
+                    severity="warning",
+                    labels={"deployment": info.name, "replica": rs.name})
 
     def _begin_drain(self, rs: ReplicaState) -> None:
         """DRAINING: refuse new requests (in-flight ones finish), wait
@@ -257,6 +306,8 @@ class ServeController:
         rs.state = DRAINING
         rs.drain_deadline = asyncio.get_event_loop().time() + \
             serve_config("serve_drain_timeout_s", 30.0)
+        events.emit("serve", f"replica {rs.name} draining",
+                    labels={"replica": rs.name})
         try:
             rs.handle.set_draining.remote()  # push; poll loop re-pushes
         except Exception:  # noqa: BLE001 - replica already gone
@@ -301,6 +352,9 @@ class ServeController:
         if info is not None and rs in info.replicas:
             info.replicas.remove(rs)
         builtin_metrics.serve_drained().inc(tags={"outcome": outcome})
+        events.emit("serve", f"replica {rs.name} drained ({outcome})",
+                    severity="info" if outcome == "clean" else "warning",
+                    labels={"replica": rs.name, "outcome": outcome})
 
     # -- reconciliation --------------------------------------------------
 

@@ -68,8 +68,10 @@ class TrainingFailedError(RayError):
 
 def _count_gang_restart(cause: str) -> None:
     try:
-        from ray_tpu._private import builtin_metrics
+        from ray_tpu._private import builtin_metrics, events
         builtin_metrics.train_gang_restarts().inc(tags={"cause": cause})
+        events.emit("train", f"gang restart ({cause} failure)",
+                    severity="warning", labels={"cause": cause})
     except Exception:  # noqa: BLE001 - metrics never break recovery
         pass
 

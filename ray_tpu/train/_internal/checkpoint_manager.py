@@ -32,6 +32,16 @@ from ray_tpu.air.config import CheckpointConfig
 logger = logging.getLogger("ray_tpu.train")
 
 
+def _count_persist_failure(what: str) -> None:
+    try:
+        from ray_tpu._private import builtin_metrics, events
+        builtin_metrics.train_checkpoint_persist_failures().inc()
+        events.emit("train", f"durable checkpoint {what} write failed",
+                    severity="error", labels={"what": what})
+    except Exception:  # noqa: BLE001 - accounting never breaks training
+        pass
+
+
 def normalize_storage_uri(storage_path: str) -> str:
     """``RunConfig.storage_path`` → spill URI: plain paths become
     absolute ``file://`` URIs; anything with a scheme passes through."""
@@ -104,6 +114,7 @@ class CheckpointManager:
             # The checkpoint itself landed; a stale index only costs
             # auto-resume precision, never training progress.
             logger.warning("checkpoint index write failed: %s", exc)
+            _count_persist_failure("index")
 
     # -- registration ------------------------------------------------------
 
@@ -122,6 +133,7 @@ class CheckpointManager:
             logger.warning(
                 "durable checkpoint write failed (%s); gang restart will "
                 "fall back to the in-memory checkpoint", exc)
+            _count_persist_failure("checkpoint")
             return checkpoint
         score = None
         attr = self.config.checkpoint_score_attribute

@@ -165,6 +165,14 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     FLAG_DBL(profile_flight_lag_s, 1.0),
     FLAG_INT(profile_max_incidents, 32),
     FLAG_DBL(profile_max_duration_s, 60.0),
+    // Alerting plane + cluster event journal: evaluation cadence on the
+    // head merge path (<= 0 disables), retained transition bound, the
+    // journal ring size (<= 0 disables), and an optional spill-backend
+    // URI for durable journal persistence.
+    FLAG_DBL(alert_eval_period_s, 5.0),
+    FLAG_INT(alert_max_firing_history, 256),
+    FLAG_INT(events_max, 2048),
+    FLAG_STR(events_spill_uri, ""),
     FLAG_BOOL(task_events_enabled, true),
     // -- memory monitor / OOM killing --
     FLAG_INT(memory_monitor_refresh_ms, 250),

@@ -1,0 +1,667 @@
+"""Alerting plane + cluster event journal: rule grammar and burn-rate
+math over synthetic TimeSeriesStore history, the pending->firing->
+resolved state machine (hold, flap dedup, cooldown), journal bounds /
+label hygiene / durable persistence round-trip, the /api/alerts and
+/api/events endpoints, and a 2-daemon SIGKILL acceptance where the
+node_down alert fires and the journal records the death."""
+
+import argparse
+import json
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as um
+from ray_tpu._private import alerting, events
+from ray_tpu._private.alerting import (AlertEngine, AlertRule,
+                                       BurnRateRule, Expr)
+from ray_tpu._private.events import EventJournal
+from ray_tpu._private.timeseries import TimeSeriesStore
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    um.clear_registry()
+    yield
+    um.clear_registry()
+
+
+def _spawn_daemon(port, *, num_cpus=2, resources=None):
+    cmd = [sys.executable, "-m", "ray_tpu._private.multinode",
+           "--address", f"127.0.0.1:{port}",
+           "--num-cpus", str(num_cpus)]
+    if resources:
+        cmd += ["--resources", json.dumps(resources)]
+    return subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def _wait_for_resource(name, amount, timeout=20):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if ray_tpu.cluster_resources().get(name, 0) >= amount:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"resource {name}>={amount} never appeared: "
+        f"{ray_tpu.cluster_resources()}")
+
+
+def _counter_entry(name, value, tag_keys=(), key=()):
+    return [{"name": name, "type": "counter", "desc": "",
+             "tag_keys": tuple(tag_keys),
+             "series": {tuple(key): float(value)}}]
+
+
+def _gauge_entry(name, value):
+    return [{"name": name, "type": "gauge", "desc": "", "tag_keys": (),
+             "series": {(): float(value)}}]
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _store_with_gauge(value, n=10):
+    """A store whose gauge held `value` over the last ~n seconds."""
+    store = TimeSeriesStore(window_s=300, max_series=64, staleness=600)
+    now = time.monotonic()
+    for i in range(n):
+        store.ingest_batch("n1", 1, "daemon",
+                           _gauge_entry("al_g", value), now=now - n + i)
+    return store
+
+
+# ---------------------------------------------------------------------------
+# Expr grammar
+# ---------------------------------------------------------------------------
+
+
+def test_expr_grammar_parses_and_rejects():
+    e = Expr("rate(x_total) > 0.5")
+    assert e.op == ">" and e.threshold == 0.5
+    assert e.numerator.func == "rate" and e.numerator.by is None
+    e2 = Expr("gauge_max(ray_tpu_loop_lag_seconds, by=loop) >= 1")
+    assert e2.numerator.by == "loop"
+    ratio = Expr("rate(err_total) / rate(req_total) > 0.05")
+    assert ratio.denominator is not None
+    for bad in ("rate(x_total)",           # no comparison
+                "nope(x_total) > 1",       # unknown derivation
+                "rate(x_total) > banana",  # non-numeric threshold
+                "x_total > 1"):            # bare metric, no FUNC()
+        with pytest.raises(ValueError):
+            Expr(bad)
+
+
+def test_expr_ratio_broadcast_and_zero_denominator():
+    store = TimeSeriesStore(window_s=300, max_series=64, staleness=600)
+    now = time.monotonic()
+    # errors fan out by deployment; requests are ungrouped (broadcast).
+    for i in range(10):
+        store.ingest_batch(
+            "n1", 1, "daemon",
+            _counter_entry("al_err_total", 2 * i,
+                           tag_keys=("deployment",), key=("a",)),
+            now=now - 10 + i)
+        store.ingest_batch("n1", 1, "daemon",
+                           _counter_entry("al_req_total", 10 * i),
+                           now=now - 10 + i)
+    e = Expr("rate(al_err_total, by=deployment) / "
+             "rate(al_req_total) > 0.1")
+    vals = e.values(store, 60)
+    assert vals["a"] == pytest.approx(0.2, rel=1e-6)
+    # Zero-traffic denominator with live errors: worst ratio, not a
+    # silent skip.
+    empty = TimeSeriesStore(window_s=300, max_series=64, staleness=600)
+    for i in range(10):
+        empty.ingest_batch(
+            "n1", 1, "daemon",
+            _counter_entry("al_err_total", 2 * i,
+                           tag_keys=("deployment",), key=("a",)),
+            now=now - 10 + i)
+        empty.ingest_batch("n1", 1, "daemon",
+                           _counter_entry("al_req_total", 0),
+                           now=now - 10 + i)
+    assert e.values(empty, 60)["a"] == float("inf")
+
+
+def test_newborn_counter_series_rates_above_zero():
+    """A counter cell exists only after its first inc, so the series is
+    born already at value 1 and stays flat (the node_deaths shape). The
+    birth gets an implicit 0 baseline: the rate must be > 0 while the
+    birth bucket is in the window, and decay to 0 once it ages out
+    (which is what resolves the node_down alert)."""
+    store = TimeSeriesStore(window_s=300, max_series=16, staleness=600)
+    now = time.monotonic()
+    for i in range(5):
+        store.ingest_batch("n1", 1, "head",
+                           _counter_entry("nb_deaths_total", 1),
+                           now=now - 5 + i)
+    assert store.counter_rate("nb_deaths_total", window=60)[""] > 0
+    (series,) = [s for k, s in store._series.items()
+                 if k[0] == "nb_deaths_total"]
+    assert series.rate(now, 60) > 0
+    assert series.rate(now + 120, 60) == 0.0  # birth aged out
+
+
+# ---------------------------------------------------------------------------
+# State machine: hold, resolve, cooldown/flap dedup
+# ---------------------------------------------------------------------------
+
+
+def test_threshold_rule_pending_hold_then_fire_then_resolve():
+    engine = AlertEngine(period_s=3600.0, max_history=16)
+    transitions = []
+    engine.subscribe(lambda a: transitions.append((a["state"], a["rule"])))
+    rule = AlertRule("hot", "gauge_max(al_g) > 5", for_s=10.0,
+                     window_s=60.0, cooldown_s=0.0)
+    engine.add_rule(rule)
+    breach = _store_with_gauge(9.0)
+    calm = _store_with_gauge(1.0)
+    t0 = time.monotonic()
+    engine.evaluate(breach, now=t0)
+    snap = engine.snapshot()
+    (inst,) = [a for a in snap["alerts"] if a["rule"] == "hot"]
+    assert inst["state"] == "pending"          # held, not fired yet
+    assert transitions == []
+    engine.evaluate(breach, now=t0 + 11)       # hold satisfied
+    (inst,) = [a for a in engine.snapshot()["alerts"]
+               if a["rule"] == "hot"]
+    assert inst["state"] == "firing"
+    assert inst["value"] == pytest.approx(9.0)
+    assert inst["threshold"] == 5.0
+    assert ("firing", "hot") in transitions
+    assert [a["rule"] for a in engine.firing()] == ["hot"]
+    engine.evaluate(calm, now=t0 + 20)         # breach gone -> resolved
+    (inst,) = [a for a in engine.snapshot()["alerts"]
+               if a["rule"] == "hot"]
+    assert inst["state"] == "resolved"
+    assert transitions[-1] == ("resolved", "hot")
+    assert engine.firing() == []
+    # Both transitions landed in the bounded history.
+    states = [h["state"] for h in engine.snapshot()["history"]
+              if h["rule"] == "hot"]
+    assert states == ["firing", "resolved"]
+
+
+def test_cooldown_parks_reborn_breach_in_pending():
+    engine = AlertEngine(period_s=3600.0, max_history=16)
+    rule = AlertRule("flappy", "gauge_max(al_g) > 5", for_s=0.0,
+                     window_s=60.0, cooldown_s=60.0)
+    engine.add_rule(rule)
+    breach = _store_with_gauge(9.0)
+    calm = _store_with_gauge(1.0)
+    t0 = time.monotonic()
+    engine.evaluate(breach, now=t0)            # for_s=0 -> fires at once
+    assert [a["rule"] for a in engine.firing()] == ["flappy"]
+    engine.evaluate(calm, now=t0 + 5)          # resolve starts cooldown
+    assert engine.firing() == []
+    engine.evaluate(breach, now=t0 + 10)       # re-breach inside cooldown
+    (inst,) = [a for a in engine.snapshot()["alerts"]
+               if a["rule"] == "flappy"]
+    assert inst["state"] == "pending"          # parked, anti-flap
+    engine.evaluate(breach, now=t0 + 70)       # cooldown over -> fires
+    assert [a["rule"] for a in engine.firing()] == ["flappy"]
+
+
+def test_pending_never_fired_drops_silently():
+    engine = AlertEngine(period_s=3600.0, max_history=16)
+    engine.add_rule(AlertRule("hold", "gauge_max(al_g) > 5", for_s=30.0,
+                              window_s=60.0))
+    t0 = time.monotonic()
+    engine.evaluate(_store_with_gauge(9.0), now=t0)
+    engine.evaluate(_store_with_gauge(1.0), now=t0 + 5)  # blip ended
+    assert [a for a in engine.snapshot()["alerts"]
+            if a["rule"] == "hold"] == []
+    assert engine.snapshot()["history"] == []
+
+
+def test_label_keyed_dedup_per_group_instances():
+    store = TimeSeriesStore(window_s=300, max_series=64, staleness=600)
+    now = time.monotonic()
+    for i in range(10):
+        for dep, step in (("a", 10), ("b", 0)):
+            store.ingest_batch(
+                "n1", 1, "daemon",
+                _counter_entry("al_dep_total", step * i,
+                               tag_keys=("deployment",), key=(dep,)),
+                now=now - 10 + i)
+    engine = AlertEngine(period_s=3600.0, max_history=16)
+    engine.add_rule(AlertRule(
+        "busy", "rate(al_dep_total, by=deployment) > 1", for_s=0.0,
+        window_s=60.0))
+    engine.evaluate(store, now=now)
+    firing = engine.firing()
+    assert [a["key"] for a in firing] == ["a"]  # b never breached
+    engine.evaluate(store, now=now + 1)         # still firing, no dup
+    assert len([h for h in engine.snapshot()["history"]
+                if h["rule"] == "busy"]) == 1
+
+
+def test_maybe_evaluate_respects_period_and_disable():
+    engine = AlertEngine(period_s=5.0, max_history=16)
+    store = _store_with_gauge(1.0)
+    t0 = time.monotonic()
+    assert engine.maybe_evaluate(store, now=t0) is True
+    assert engine.maybe_evaluate(store, now=t0 + 1) is False  # gated
+    assert engine.maybe_evaluate(store, now=t0 + 6) is True
+    off = AlertEngine(period_s=0.0)
+    assert off.enabled is False
+    assert off.maybe_evaluate(store, now=t0) is False
+
+
+def test_user_rule_replaces_builtin_and_removes():
+    engine = AlertEngine(period_s=3600.0)
+    names = [r["name"] for r in engine.rules()]
+    assert "node_down" in names and "serve_p95_burn" in names
+    engine.add_rule(AlertRule("node_down",
+                              "rate(ray_tpu_node_deaths_total) > 5",
+                              window_s=30.0))
+    (nd,) = [r for r in engine.rules() if r["name"] == "node_down"]
+    assert nd["threshold"] == 5.0 and nd["window_s"] == 30.0
+    assert engine.remove_rule("node_down") is True
+    assert engine.remove_rule("node_down") is False
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate math
+# ---------------------------------------------------------------------------
+
+
+def _burn_store(flat_s, rising_s, rate_per_s):
+    """Counter flat for `flat_s`, then rising at `rate_per_s`."""
+    store = TimeSeriesStore(window_s=600, max_series=64, staleness=900)
+    now = time.monotonic()
+    t0 = now - flat_s - rising_s
+    for i in range(0, flat_s, 5):
+        store.ingest_batch("n1", 1, "daemon",
+                           _counter_entry("sl_err_total", 0), now=t0 + i)
+    for i in range(0, rising_s + 1, 5):
+        store.ingest_batch("n1", 1, "daemon",
+                           _counter_entry("sl_err_total", rate_per_s * i),
+                           now=t0 + flat_s + i)
+    return store
+
+
+def test_burn_rate_requires_both_windows():
+    rule = BurnRateRule("burn", "rate(sl_err_total) > 0", objective=1.0,
+                        fast_window_s=60.0, slow_window_s=300.0,
+                        burn_threshold=1.0, for_s=0.0)
+    # A fresh 60s spike at 2/s: fast burn 2x, slow burn ~0.4x -> quiet.
+    spike = _burn_store(flat_s=240, rising_s=60, rate_per_s=2)
+    assert rule.evaluate(spike) == {}
+    # Sustained 2/s across the whole slow window: both burn -> fires,
+    # reported value is the fast burn.
+    sustained = _burn_store(flat_s=0, rising_s=300, rate_per_s=2)
+    out = rule.evaluate(sustained)
+    assert out[""] == pytest.approx(2.0, rel=0.1)
+    # The rendered alert carries burn-rate fields.
+    engine = AlertEngine(period_s=3600.0)
+    engine.add_rule(rule)
+    engine.evaluate(sustained, now=time.monotonic())
+    (alert,) = engine.firing()
+    assert alert["kind"] == "burn_rate"
+    assert alert["threshold"] == 1.0 and alert["objective"] == 1.0
+
+
+def test_burn_rate_rejects_bad_objective():
+    with pytest.raises(ValueError):
+        BurnRateRule("bad", "rate(x_total) > 0", objective=0.0)
+
+
+def test_scale_hint_attached_per_deployment_group():
+    store = TimeSeriesStore(window_s=300, max_series=64, staleness=600)
+    now = time.monotonic()
+    for i in range(10):
+        store.ingest_batch(
+            "n1", 1, "daemon",
+            _counter_entry("al_hint_total", 10 * i,
+                           tag_keys=("deployment",), key=("echo",)),
+            now=now - 10 + i)
+    engine = AlertEngine(period_s=3600.0)
+    seen = []
+    engine.subscribe(seen.append)
+    engine.add_rule(AlertRule(
+        "hinted", "rate(al_hint_total, by=deployment) > 1", for_s=0.0,
+        window_s=60.0, scale_hint={"direction": "up"}))
+    engine.evaluate(store, now=now)
+    (alert,) = [a for a in seen if a["rule"] == "hinted"]
+    assert alert["scale_hint"] == {"direction": "up",
+                                   "deployment": "echo"}
+
+
+# ---------------------------------------------------------------------------
+# Event journal
+# ---------------------------------------------------------------------------
+
+
+def test_journal_bounds_seq_and_filters():
+    j = EventJournal(maxlen=5, spill_uri="")
+    for i in range(10):
+        j.record("test", f"event {i}",
+                 severity="warning" if i % 2 else "info",
+                 node_id="aa" * 16 if i < 8 else "bb" * 16)
+    stats = j.stats()
+    assert stats["count"] == 5 and stats["seq"] == 10
+    rows = j.query()
+    assert [r["seq"] for r in rows] == [6, 7, 8, 9, 10]  # oldest evicted
+    assert all(r["age_s"] >= 0 for r in rows)
+    assert all("time" not in r for r in rows)
+    # Severity is a floor; bad severities are a caller error.
+    warn = j.query(severity="warning")
+    assert all(r["severity"] == "warning" for r in warn)
+    with pytest.raises(ValueError):
+        j.query(severity="loud")
+    # node/source/since/limit filters compose.
+    assert [r["seq"] for r in j.query(node_id="bb" * 16)] == [9, 10]
+    assert j.query(source="other") == []
+    assert [r["seq"] for r in j.query(since_seq=8)] == [9, 10]
+    assert [r["seq"] for r in j.query(limit=2)] == [9, 10]
+
+
+def test_journal_disabled_counts_drops():
+    j = EventJournal(maxlen=0, spill_uri="")
+    assert j.enabled is False
+    assert j.record("test", "nope") is None
+    assert j.stats()["dropped"] == 1
+    assert j.query() == []
+
+
+def test_journal_label_hygiene():
+    j = EventJournal(maxlen=10, spill_uri="")
+    labels = {f"k{i}": "v" * 500 for i in range(40)}
+    rec = j.record("test", "m" * 2000, labels=labels)
+    assert len(rec["labels"]) == events.MAX_LABELS
+    assert all(len(v) <= events.MAX_VALUE_LEN
+               for v in rec["labels"].values())
+    assert len(rec["message"]) == events.MAX_MESSAGE_LEN
+
+
+def test_journal_ingest_stamps_transport_node():
+    j = EventJournal(maxlen=10, spill_uri="")
+    j.ingest("cc" * 16, [
+        {"source": "serve", "message": "replica up", "severity": "info"},
+        {"source": "membership", "message": "fenced", "severity": "warning",
+         "node_id": "dd" * 16},
+        "not-a-dict",  # tolerated, skipped
+    ])
+    rows = j.query()
+    assert rows[0]["node_id"] == "cc" * 16   # transport id wins
+    assert rows[1]["node_id"] == "dd" * 16   # emitter-stamped subject wins
+
+
+def test_journal_persistence_round_trip(tmp_path):
+    uri = f"file://{tmp_path}"
+    j = EventJournal(maxlen=10, spill_uri=uri)
+    for i in range(4):
+        j.record("test", f"durable {i}", severity="error",
+                 labels={"i": i})
+    j.flush()
+    assert (tmp_path / "cluster_events.jsonl").exists()
+    # A new journal over the same URI restores rows, seq continuity,
+    # and marks them restored.
+    j2 = EventJournal(maxlen=10, spill_uri=uri)
+    rows = j2.query()
+    assert [r["message"] for r in rows] == [f"durable {i}"
+                                            for i in range(4)]
+    assert all(r["restored"] for r in rows)
+    assert all(r["labels"] == {"i": str(i)}
+               for i, r in enumerate(rows))
+    nxt = j2.record("test", "post-restart")
+    assert nxt["seq"] == 5  # continues after the restored seq
+
+
+def test_journal_annotations_shape():
+    j = EventJournal(maxlen=10, spill_uri="")
+    j.record("membership", "node dead", severity="error",
+             node_id="ee" * 16)
+    (row,) = j.annotations()
+    assert row["text"] == "node dead"
+    assert row["tags"] == ["error", "membership", f"node:{'ee' * 6}"]
+    assert row["age_s"] >= 0
+
+
+def test_pending_buffer_emit_drain_refund():
+    events.drain_pending()  # isolate from other tests' leftovers
+    events.emit("test", "one", severity="warning", labels={"k": 1})
+    events.emit("test", "two", severity="not-a-severity")
+    got = events.drain_pending()
+    assert [e["message"] for e in got] == ["one", "two"]
+    assert got[0]["labels"] == {"k": "1"}
+    assert got[1]["severity"] == "info"  # coerced
+    assert events.drain_pending() == []
+    events.refund_pending(got)
+    events.emit("test", "three")
+    assert [e["message"] for e in events.drain_pending()] == \
+        ["one", "two", "three"]
+
+
+def test_alert_transitions_mirror_into_journal_and_counters():
+    j = EventJournal(maxlen=32, spill_uri="")
+    engine = AlertEngine(period_s=3600.0, journal=j)
+    engine.add_rule(AlertRule("hot", "gauge_max(al_g) > 5", for_s=0.0,
+                              window_s=60.0, severity="critical",
+                              cooldown_s=0.0))
+    t0 = time.monotonic()
+    engine.evaluate(_store_with_gauge(9.0), now=t0)
+    engine.evaluate(_store_with_gauge(1.0), now=t0 + 5)
+    rows = j.query(source="alerting")
+    assert len(rows) == 2
+    assert "-> firing" in rows[0]["message"]
+    assert rows[0]["severity"] == "critical"  # firing carries the rule's
+    assert "-> resolved" in rows[1]["message"]
+    assert rows[1]["severity"] == "info"      # resolves are calm
+    assert rows[0]["labels"]["rule"] == "hot"
+    # Fast-counter cells folded into the registry counters on flush.
+    from ray_tpu._private import builtin_metrics
+    builtin_metrics.flush_fast_counters()
+    assert sum(builtin_metrics.alerts_transitions()
+               ._series.values()) >= 2
+    assert sum(builtin_metrics.cluster_events()
+               ._series.values()) >= 2
+
+
+# ---------------------------------------------------------------------------
+# Runtime integration + HTTP endpoints
+# ---------------------------------------------------------------------------
+
+
+def test_runtime_alerts_and_events_surfaces(ray_start_regular):
+    from ray_tpu._private.worker import global_worker
+    rt = global_worker.runtime
+    cm = rt._cluster_metrics
+    # A synthetic breach lands in the head store; a user rule over it
+    # fires on the forced evaluation inside alerts_snapshot().
+    now = time.monotonic()
+    for i in range(10):
+        cm.timeseries.ingest_batch(
+            "n1", 1, "daemon", _counter_entry("it_breach_total", 10 * i),
+            now=now - 10 + i)
+    rt.add_alert_rule(AlertRule("it_rule", "rate(it_breach_total) > 1",
+                                for_s=0.0, window_s=60.0))
+    snap = rt.alerts_snapshot()
+    assert snap["enabled"] is True
+    assert "it_rule" in [a["rule"] for a in snap["firing"]]
+    assert "node_down" in [r["name"] for r in snap["rules"]]
+    # The journal carries the transition; cluster_events reads it back.
+    rows = rt.cluster_events(source="alerting")
+    assert any("it_rule" in r["message"] for r in rows)
+    assert rt.cluster_events_stats()["count"] >= 1
+    # top_snapshot exposes the firing banner data.
+    top = rt.top_snapshot(window=60)
+    assert top["alerts"]["firing_count"] >= 1
+    assert "it_rule" in top["alerts"]["rules"]
+    # The CLI renders the one-line banner from the same snapshot.
+    from ray_tpu.scripts.cli import _render_top_frame
+    frame = _render_top_frame(top)
+    assert "ALERTS FIRING" in frame and "it_rule" in frame
+    # `ray-tpu status` appends the firing lines.
+    from ray_tpu._private.state import status_summary
+    assert "Alerts firing" in status_summary()
+    rt.remove_alert_rule("it_rule")
+
+
+def test_dashboard_alerts_and_events_endpoints(ray_start_regular):
+    from ray_tpu.dashboard.head import DashboardHead
+    from ray_tpu._private.worker import global_worker
+    rt = global_worker.runtime
+    rt._cluster_metrics.events.record(
+        "test", "endpoint probe", severity="warning", node_id="ab" * 16)
+    head = DashboardHead(port=0)
+    port = head.start()
+    try:
+        alerts = _get_json(port, "/api/alerts")
+        assert alerts["enabled"] is True
+        assert {"alerts", "firing", "rules", "period_s"} <= set(alerts)
+        assert "history" not in alerts  # opt-in
+        with_hist = _get_json(port, "/api/alerts?history=1")
+        assert "history" in with_hist
+        ev = _get_json(port, "/api/events")
+        assert ev["stats"]["count"] >= 1
+        probe = [r for r in ev["events"]
+                 if r["message"] == "endpoint probe"]
+        assert probe and probe[0]["severity"] == "warning"
+        # Filters thread through; bad params are 400s, not tracebacks.
+        warn = _get_json(port, "/api/events?severity=warning&limit=5")
+        assert all(r["severity"] != "info" for r in warn["events"])
+        for bad in ("/api/events?severity=loud",
+                    "/api/events?since_seq=abc",
+                    "/api/events?limit=abc"):
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                _get_json(port, bad)
+            assert exc_info.value.code == 400
+        # Annotations feed: epoch-ms stamped at the HTTP boundary.
+        ann = _get_json(port, "/api/events?fmt=annotations")
+        assert ann["annotations"]
+        row = ann["annotations"][-1]
+        assert abs(row["time"] - time.time() * 1000) < 60_000
+        assert "warning" in row["tags"]
+        # cluster_status carries the firing rollup.
+        status = _get_json(port, "/api/cluster_status")
+        assert "alerts" in status
+        assert "firing_count" in status["alerts"]
+    finally:
+        head.stop()
+
+
+def test_grafana_dashboard_has_alerting_panels(ray_start_regular):
+    from ray_tpu.dashboard.grafana import generate_dashboard
+    dash = generate_dashboard()
+    titles = [p["title"] for p in dash["panels"]]
+    assert "Alert transitions / s (by state)" in titles
+    assert "Cluster events / s (by severity)" in titles
+    assert dash["annotations"]["list"][0]["name"] == "cluster events"
+
+
+def test_config_knobs_exist_in_py_defaults():
+    from ray_tpu._private.ray_config import _PY_DEFAULTS
+    assert _PY_DEFAULTS["alert_eval_period_s"] == 5.0
+    assert _PY_DEFAULTS["alert_max_firing_history"] == 256
+    assert _PY_DEFAULTS["events_max"] == 2048
+    assert _PY_DEFAULTS["events_spill_uri"] == ""
+    # Env spellings override the flag table.
+    import os
+    os.environ["RAY_TPU_ALERT_EVAL_PERIOD_S"] = "0.25"
+    os.environ["RAY_TPU_EVENTS_MAX"] = "7"
+    try:
+        assert alerting.configured_eval_period_s() == 0.25
+        assert events.configured_events_max() == 7
+    finally:
+        del os.environ["RAY_TPU_ALERT_EVAL_PERIOD_S"]
+        del os.environ["RAY_TPU_EVENTS_MAX"]
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: SIGKILL a daemon -> node_down fires, journal records it
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_node_down_alert_two_daemon_sigkill(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_METRICS_EXPORT_INTERVAL_S", "0.2")
+    monkeypatch.setenv("RAY_TPU_ALERT_EVAL_PERIOD_S", "0.5")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    procs = []
+    try:
+        host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+        procs = [_spawn_daemon(port, num_cpus=2, resources={"remote": 2})
+                 for _ in range(2)]
+        _wait_for_resource("remote", 4)
+        from ray_tpu._private.worker import global_worker
+        rt = global_worker.runtime
+        # Shrink node_down's window (same-name replace) so the resolve
+        # leg stays test-sized; semantics are unchanged.
+        rt.add_alert_rule(AlertRule(
+            "node_down", "rate(ray_tpu_node_deaths_total) > 0",
+            window_s=15.0, for_s=0.0, severity="critical",
+            cooldown_s=0.0,
+            message="node death(s) declared in the last minute"))
+        # Joins are journaled.
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            joins = rt.cluster_events(source="membership")
+            if len([r for r in joins if "joined" in r["message"]]) >= 2:
+                break
+            time.sleep(0.2)
+        joins = rt.cluster_events(source="membership")
+        assert len([r for r in joins if "joined" in r["message"]]) >= 2
+
+        procs[0].send_signal(signal.SIGKILL)
+        # The alert must fire shortly after the death declaration.
+        deadline = time.monotonic() + 60
+        fired = None
+        while time.monotonic() < deadline:
+            rt.cluster_metrics_text()  # head registry sample -> store
+            firing = rt.alerts_snapshot()["firing"]
+            fired = next((a for a in firing if a["rule"] == "node_down"),
+                         None)
+            if fired is not None:
+                break
+            time.sleep(0.3)
+        assert fired is not None, "node_down never fired"
+        assert fired["severity"] == "critical"
+        # The journal recorded the death with the dead node's id.
+        deaths = [r for r in rt.cluster_events(source="membership",
+                                               severity="error")
+                  if "dead" in r["message"]]
+        assert deaths, rt.cluster_events(source="membership")
+        assert deaths[-1]["node_id"]
+        assert deaths[-1]["labels"].get("reason")
+        # The transition was mirrored into the journal too.
+        assert any("node_down" in r["message"] and "firing" in r["message"]
+                   for r in rt.cluster_events(source="alerting"))
+        # After the death leaves the (shrunken) window, the alert
+        # resolves on its own.
+        deadline = time.monotonic() + 60
+        resolved = False
+        while time.monotonic() < deadline:
+            rt.cluster_metrics_text()
+            snap = rt.alerts_snapshot()
+            nd = [a for a in snap["alerts"] if a["rule"] == "node_down"]
+            if nd and nd[0]["state"] == "resolved":
+                resolved = True
+                break
+            time.sleep(0.5)
+        assert resolved, "node_down never resolved"
+        # The surviving daemon still runs tasks.
+        @ray_tpu.remote(resources={"remote": 1},
+                        runtime_env={"worker_process": False})
+        def ping():
+            return "ok"
+
+        assert ray_tpu.get(ping.remote(), timeout=30) == "ok"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=10)
+        ray_tpu.shutdown()
