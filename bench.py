@@ -1230,6 +1230,116 @@ def bench_serve_chaos() -> dict:
     return out
 
 
+def bench_serve_autoscale() -> dict:
+    """Self-driving serve plane (ISSUE 16 acceptance): a closed-loop
+    client ramp against an autoscaled deployment (1..8 replicas, sized
+    purely by the controller's autoscale pass over windowed queue
+    depth) — serve_autoscale_qps is the sustained successful-request
+    rate once the plane has walked itself up, with the p95 and the
+    replica count it reached recorded alongside; plus fixed-vs-adaptive
+    micro-batching through the same latency budget (adaptive sheds the
+    wait timeout under light load, so its p95 should sit well under the
+    fixed queue's)."""
+    import asyncio
+    import concurrent.futures
+    import os
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve._private.controller import get_or_create_controller
+
+    out = {}
+    knobs = {
+        "RAY_TPU_serve_autoscale_interval_s": "0.25",
+        "RAY_TPU_serve_autoscale_window_s": "2",
+        "RAY_TPU_serve_autoscale_downscale_delay_s": "30",
+        "RAY_TPU_metrics_report_interval_ms": "200",
+    }
+    saved = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    ray_tpu.init(num_cpus=8)
+    try:
+        # 10ms IO-shaped work, concurrency cap 2: one replica tops out
+        # at ~200 QPS, so 16 closed-loop clients build real queue depth
+        # and sustained QPS tracks the replica count the autoscaler
+        # reaches (same replica-bound regime as bench_serve, but here
+        # NOBODY sets num_replicas — the controller walks it up alone).
+        @serve.deployment(max_concurrent_queries=2, autoscaling_config={
+            "min_replicas": 1, "max_replicas": 8,
+            "target_ongoing_requests": 2}, name="autowork")
+        class Work:
+            def __call__(self, x):
+                _time.sleep(0.010)
+                return x
+
+        handle = serve.run(Work.bind())
+
+        def one(i):
+            t0 = _time.perf_counter()
+            ray_tpu.get(handle.remote(i), timeout=30)
+            return _time.perf_counter() - t0
+
+        for i in range(10):
+            one(i)
+        # Baseline second at 1 replica, then the ramp: total n chosen so
+        # the scaled-up steady state dominates the tail half.
+        n, workers = 1600, 16
+        lat = []
+        t0 = _time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(workers) as pool:
+            for dt in pool.map(one, range(n)):
+                lat.append(dt)
+        wall = _time.perf_counter() - t0
+        tail = sorted(lat[n // 2:])  # steady state: post-ramp half
+        out["serve_autoscale_qps"] = round(n / wall, 1)
+        out["serve_autoscale_p95_ms"] = round(
+            tail[int(len(tail) * 0.95)] * 1000, 2)
+        status = ray_tpu.get(
+            get_or_create_controller().autoscale_status.remote(),
+            timeout=10)
+        out["serve_autoscale_replicas_peak"] = \
+            status["autowork"]["target"]
+        serve.shutdown()
+
+        # Fixed vs adaptive micro-batching, light sequential load: the
+        # fixed queue eats its full 30ms wait per batch; the adaptive
+        # one (10ms budget) halves the wait until p95 fits. p95 over
+        # the LAST half so adaptation has converged.
+        async def batch_p95(target_latency_s):
+            from ray_tpu.serve.batching import _BatchQueue
+
+            async def fn(items):
+                await asyncio.sleep(0.002)
+                return items
+
+            q = _BatchQueue(fn, max_batch_size=16, timeout_s=0.03,
+                            target_latency_s=target_latency_s,
+                            name="bench")
+            samples = []
+            for i in range(60):
+                t0 = _time.perf_counter()
+                await q.submit(i)
+                samples.append(_time.perf_counter() - t0)
+            tail = sorted(samples[30:])
+            return tail[int(len(tail) * 0.95)]
+
+        fixed = asyncio.run(batch_p95(None))
+        adaptive = asyncio.run(batch_p95(0.010))
+        out["serve_batch_fixed_p95_ms"] = round(fixed * 1000, 2)
+        out["serve_batch_adaptive_p95_ms"] = round(adaptive * 1000, 2)
+        out["serve_batch_adaptive_speedup"] = round(
+            fixed / max(adaptive, 1e-9), 2)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        ray_tpu.shutdown()
+    return out
+
+
 RLLIB_BENCH_SCRIPT = """
 import json, os, time
 BATCH = 2048
@@ -1836,6 +1946,41 @@ def _parse_args(argv=None):
     return ap.parse_args(argv)
 
 
+def _with_watchdog(fn, timeout_s=None):
+    """Run one extras-suite bench under a SIGALRM watchdog.
+
+    The multi-daemon benches can wedge (not fail) when a starved daemon
+    is declared dead mid-shuffle and recovery livelocks — an exception
+    guard alone never fires and the whole round hangs. The alarm raises
+    TimeoutError in the main thread, which unwinds through the bench's
+    own ``finally`` (daemon teardown, runtime shutdown) and is recorded
+    as that extra's error like any other failure. The handler re-arms a
+    short grace alarm so a teardown that also wedges cannot re-hang the
+    round. Tune via RAY_TPU_BENCH_EXTRA_TIMEOUT_S (default 600; 0
+    disables)."""
+    import os as _os
+    import signal as _signal
+
+    if timeout_s is None:
+        timeout_s = int(float(
+            _os.environ.get("RAY_TPU_BENCH_EXTRA_TIMEOUT_S", "600")))
+    if timeout_s <= 0 or not hasattr(_signal, "SIGALRM"):
+        return fn()
+
+    def _on_alarm(signum, frame):
+        _signal.alarm(120)  # grace window for the bench's own cleanup
+        raise TimeoutError(
+            f"bench extra exceeded {timeout_s}s watchdog")
+
+    old = _signal.signal(_signal.SIGALRM, _on_alarm)
+    _signal.alarm(timeout_s)
+    try:
+        return fn()
+    finally:
+        _signal.alarm(0)
+        _signal.signal(_signal.SIGALRM, old)
+
+
 def main(argv=None):
     args = _parse_args(argv)
     import jax
@@ -1897,6 +2042,8 @@ def main(argv=None):
         ("serve", "serve_qps", bench_serve),
         ("serve_availability_under_chaos", "serve_chaos_qps",
          bench_serve_chaos),
+        ("serve_autoscale", "serve_autoscale_qps",
+         bench_serve_autoscale),
         ("shuffle_multi", "shuffle_multi_mb_per_sec",
          bench_shuffle_multi_daemon),
         ("envelope", "envelope_tasks_per_sec", bench_envelope),
@@ -1927,7 +2074,7 @@ def main(argv=None):
             ("gptj6b", "gptj6b_params", lambda: bench_gptj6b(device)))
     for key, metric, fn in extras_suite:
         try:
-            extra.update(fn())
+            extra.update(_with_watchdog(fn))
         except Exception as exc:  # noqa: BLE001
             extra.setdefault(metric, None)
             extra[f"{key}_error"] = repr(exc)[:800]

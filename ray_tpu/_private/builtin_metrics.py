@@ -512,6 +512,69 @@ def serve_replicas() -> Gauge:
         tag_keys=("deployment",))
 
 
+# -- serve autoscaler + batching engines -----------------------------------
+# Actuation-plane series: the controller's autoscale pass sets the
+# target gauge every pass (so target-vs-actual graphs exist at steady
+# state) and counts actuated decisions; the batching engines gauge
+# their live operating point.
+
+
+def serve_target_replicas() -> Gauge:
+    from ray_tpu.util.metrics import Gauge
+    return Gauge(
+        "ray_tpu_serve_target_replicas",
+        "Autoscaler's desired replica count per deployment (compare "
+        "with ray_tpu_serve_replicas for target-vs-actual).",
+        tag_keys=("deployment",))
+
+
+def serve_autoscale_decisions() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_serve_autoscale_decisions_total",
+        "Actuated autoscaling decisions (replica target changed), per "
+        "deployment and direction.",
+        tag_keys=("deployment", "direction"))
+
+
+def serve_batch_size() -> Gauge:
+    from ray_tpu.util.metrics import Gauge
+    return Gauge(
+        "ray_tpu_serve_batch_size",
+        "Size of the last executed @serve.batch batch, per batched "
+        "function (adaptive batching moves this with load).",
+        tag_keys=("fn",))
+
+
+def serve_batch_size_limit() -> Gauge:
+    from ray_tpu.util.metrics import Gauge
+    return Gauge(
+        "ray_tpu_serve_batch_size_limit",
+        "Current adaptive max-batch-size operating point of a "
+        "@serve.batch queue (AIMD-tuned against the latency budget).",
+        tag_keys=("fn",))
+
+
+def serve_decode_active_slots() -> Gauge:
+    from ray_tpu.util.metrics import Gauge
+    return Gauge(
+        "ray_tpu_serve_decode_active_slots",
+        "Occupied slots in a continuous-batching decode loop, per "
+        "engine (fixed-shape pjit batch; free slots admit new "
+        "sequences at iteration boundaries).",
+        tag_keys=("engine",))
+
+
+def serve_decode_admitted() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_serve_decode_admitted_total",
+        "Sequences admitted into a continuous-batching decode loop, "
+        "by admission kind (fresh = loop was idle, running = joined a "
+        "live decode batch at an iteration boundary).",
+        tag_keys=("engine", "kind"))
+
+
 # -- control-loop saturation -----------------------------------------------
 
 

@@ -1,0 +1,18 @@
+"""Version compatibility shims for jax APIs the kernels lean on.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to top-level
+``jax.shard_map`` (and renamed its replication check ``check_rep`` ->
+``check_vma``) around jax 0.6. Kernel code imports the new spelling
+from here so it runs on both sides of the move.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map  # noqa: F401 - jax >= 0.6
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)

@@ -84,6 +84,20 @@ _PY_DEFAULTS: Dict[str, Any] = {
     "serve_health_check_timeout_s": 5.0,
     "serve_health_failure_threshold": 3,
     "serve_failover_retries": 3,
+    # Serve autoscaling (actuation plane): the controller runs an
+    # autoscale pass every interval (<=0 disables), sizing each
+    # autoscaled deployment from windowed queue-depth/qps/p95 stats;
+    # cluster-default up/down delays apply when the deployment's
+    # autoscaling_config doesn't override them; firing scale_hint
+    # alerts expire after the TTL so a dead alert engine can't pin a
+    # hint forever. Batch queues with no declared target adapt against
+    # the cluster-wide latency budget (0 = fixed batching).
+    "serve_autoscale_interval_s": 2.0,
+    "serve_autoscale_window_s": 15.0,
+    "serve_autoscale_upscale_delay_s": 0.0,
+    "serve_autoscale_downscale_delay_s": 10.0,
+    "serve_scale_hint_ttl_s": 120.0,
+    "serve_batch_target_latency_ms": 0.0,
     # Train fault tolerance: a gang round with no result for this long
     # liveness-probes every pending rank and treats failed probes as a
     # system failure (gang restart from the latest durable checkpoint);

@@ -3002,11 +3002,14 @@ class Runtime:
                                window=w, group_by="deployment")
         replicas = ts.gauge_stats("ray_tpu_serve_replicas",
                                   window=w, group_by="deployment")
+        targets = ts.gauge_stats("ray_tpu_serve_target_replicas",
+                                 window=w, group_by="deployment")
         deployments = {}
         for name in (set(qps) | set(lat) | set(queue) | set(replicas)):
             if not name:
                 continue
             h = lat.get(name, {})
+            tgt = targets.get(name, {}).get("last_max")
             deployments[name] = {
                 "qps": qps.get(name, 0.0),
                 "p50_s": h.get("p50", 0.0),
@@ -3017,6 +3020,9 @@ class Runtime:
                 # counts are replicated views — max, not sum.
                 "mean_queue_depth": queue.get(name, {}).get("avg_sum", 0.0),
                 "replicas": int(replicas.get(name, {}).get("last_max", 0)),
+                # Autoscaler-set target (None: not an autoscaled
+                # deployment, or no autoscale pass in the window yet).
+                "target_replicas": None if tgt is None else int(tgt),
             }
         return {"window_s": w, "deployments": deployments}
 

@@ -136,6 +136,24 @@ def status_summary() -> str:
                 f"hb_age={row['last_heartbeat_age_s']:.1f}s"
                 + (f" soft_failures={row['soft_failures']}"
                    if row.get("soft_failures") else ""))
+    # Serve deployments: target-vs-actual replicas straight from the
+    # signal plane, so a scale-up in flight is visible as target>actual.
+    serve_fn = getattr(rt, "serve_stats", None)
+    if serve_fn is not None:
+        try:
+            deployments = serve_fn().get("deployments", {})
+        except Exception:  # noqa: BLE001 - status must still answer
+            deployments = {}
+        if deployments:
+            lines.append("Serve:")
+            for name, d in sorted(deployments.items()):
+                target = d.get("target_replicas")
+                lines.append(
+                    f"  {name}: replicas={d.get('replicas', 0)}"
+                    + ("" if target is None else f" target={target}")
+                    + f" qps={d.get('qps', 0.0):.2f}"
+                    f" p95={d.get('p95_s', 0.0) * 1000:.1f}ms"
+                    f" queue={d.get('mean_queue_depth', 0.0):.1f}")
     # Firing alerts (alerting plane): `ray-tpu status` answers "is the
     # cluster healthy" without a dashboard round-trip.
     alerts_fn = getattr(rt, "alerts_snapshot", None)

@@ -76,6 +76,13 @@ _DEFAULT_PANELS = [
      "sum by (deployment) (ray_tpu_serve_queue_depth)", "short"),
     ("Serve replicas (by deployment)",
      "max by (deployment) (ray_tpu_serve_replicas)", "short"),
+    ("Serve target replicas (by deployment)",
+     "max by (deployment) (ray_tpu_serve_target_replicas)", "short"),
+    ("Serve autoscale decisions / min (by direction)",
+     "sum by (direction) "
+     "(rate(ray_tpu_serve_autoscale_decisions_total[5m])) * 60", "ops"),
+    ("Serve batch size (by fn)",
+     "max by (fn) (ray_tpu_serve_batch_size)", "short"),
     ("Head loop lag (by loop)",
      "max by (loop) (ray_tpu_loop_lag_seconds)", "s"),
     ("Train gang restarts / s (by cause)",

@@ -75,8 +75,9 @@ def make_ring_attention(mesh, axis_name: str = "sp", causal: bool = True,
     the sequence dimension is sharded (over ``axis_name``); callers running
     under a larger mesh pass explicit ``q_spec``/``kv_spec`` for the
     batch/head axes (e.g. the model's ring path, models/gpt.py)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from ray_tpu._private.jax_compat import shard_map
 
     if q_spec is None:
         q_spec = P(None, axis_name, None, None)

@@ -21,8 +21,9 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from ray_tpu._private.jax_compat import shard_map
 
 
 def _full_causal_attention(q, k, v):

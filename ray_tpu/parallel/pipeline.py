@@ -20,8 +20,9 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from ray_tpu._private.jax_compat import shard_map
 
 
 def stage_param_specs(param_tree, axis: str = "pp"):

@@ -105,15 +105,18 @@ def _render_top_frame(snap: dict) -> str:
         rows = []
         for name in sorted(serve):
             d = serve[name]
+            target = d.get("target_replicas")
             rows.append((
                 name,
                 str(d.get("replicas", 0)),
+                "-" if target is None else str(target),
                 f"{d.get('qps', 0.0):.2f}",
                 f"{d.get('p50_s', 0.0) * 1000:.1f}ms",
                 f"{d.get('p95_s', 0.0) * 1000:.1f}ms",
                 f"{d.get('mean_queue_depth', 0.0):.1f}",
             ))
-        hdr = ("DEPLOYMENT", "REPLICAS", "QPS", "P50", "P95", "QUEUE")
+        hdr = ("DEPLOYMENT", "REPLICAS", "TARGET", "QPS", "P50", "P95",
+               "QUEUE")
         widths = [max(len(hdr[i]), *(len(r[i]) for r in rows))
                   for i in range(len(hdr))]
         fmt = "  ".join(f"{{:<{w}}}" for w in widths)

@@ -18,12 +18,13 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import ray_tpu
 from ray_tpu.exceptions import BackPressureError
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.continuous_batching import ContinuousBatcher
 from ray_tpu.serve.handle import DeploymentHandle
 
-__all__ = ["Application", "BackPressureError", "Deployment",
-           "DeploymentHandle", "batch", "delete", "deployment",
-           "get_app_handle", "get_deployment_handle", "ingress", "run",
-           "shutdown", "status", "start"]
+__all__ = ["Application", "BackPressureError", "ContinuousBatcher",
+           "Deployment", "DeploymentHandle", "batch", "delete",
+           "deployment", "get_app_handle", "get_deployment_handle",
+           "ingress", "run", "shutdown", "status", "start"]
 
 
 class Deployment:

@@ -1,0 +1,201 @@
+"""Serve autoscaler: the actuation half of the signal plane.
+
+Analog of the reference's serve/_private/autoscaling_policy.py (replicas
+sized to ongoing-requests / target) split the same way the reference
+splits it: a PURE decision engine (:class:`AutoscalePolicy`, unit-testable
+with injected clocks and stats) and a thin actuation pass the controller's
+control loop runs on the ``serve_autoscale_interval_s`` cadence.
+
+Inputs per deployment, all windowed from the head's time-series store
+(``controller.deployment_stats()`` → ``runtime.serve_stats``):
+
+* **queue depth** — mean outstanding requests across routers (in-flight +
+  queued), the primary load signal: ``desired = ceil(load / target)``.
+* **p95 burn** — if the deployment declares ``target_p95_ms`` and the
+  windowed p95 exceeds it under traffic, the policy forces at least one
+  step up even when the queue-depth math says "enough".
+* **scale hints** — typed ``scale_hint`` alerts (e.g. ``serve_p95_burn``)
+  recorded by the controller: a firing "up" hint forces at least one step
+  up and blocks scale-down entirely. Hints are TTL-aged
+  (``serve_scale_hint_ttl_s``) so a dead alert engine cannot pin a
+  deployment's hint forever.
+
+Stability comes from hysteresis and cooldown, not smoothing: scale-up is
+immediate after ``upscale_delay_s`` of cooldown (default 0 — saturating
+traffic must not wait), scale-down requires the downscale verdict to hold
+*continuously* for ``downscale_delay_s`` AND that long since the last
+action, so a traffic dip between bursts never drops replicas. Targets are
+always clamped to the deployment's ``[min_replicas, max_replicas]``.
+
+Actuation goes through the ordinary reconcile path: scale-up starts
+STARTING replicas, scale-down marks victims DRAINING (in-flight requests
+finish, bounded by ``serve_drain_timeout_s``) — the autoscaler never drops
+a request. Every decision is journaled (``source="autoscale"``) and
+counted in ``ray_tpu_serve_autoscale_decisions_total{direction}``; the
+per-deployment target lands in the ``ray_tpu_serve_target_replicas``
+gauge so target-vs-actual is one Grafana panel.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+# autoscaling_config keys a deployment may declare. Unknown keys are a
+# config error (schema.validate + normalize both enforce it): a typo'd
+# "max_replica" silently defaulting is how autoscalers run away.
+KNOWN_CONFIG_KEYS = frozenset({
+    "min_replicas", "max_replicas",
+    "target_ongoing_requests",
+    # Reference-Ray spelling, kept as an alias.
+    "target_num_ongoing_requests_per_replica",
+    "target_p95_ms",
+    "upscale_delay_s", "downscale_delay_s",
+})
+
+
+def normalize_config(cfg: Dict[str, Any], *,
+                     current_replicas: int = 1,
+                     default_upscale_delay_s: float = 0.0,
+                     default_downscale_delay_s: float = 10.0
+                     ) -> Dict[str, Any]:
+    """Validate + fill an ``autoscaling_config`` dict. Raises ValueError
+    on unknown keys or inconsistent bounds. Pure."""
+    unknown = set(cfg) - KNOWN_CONFIG_KEYS
+    if unknown:
+        raise ValueError(
+            f"Unknown autoscaling_config keys {sorted(unknown)}; "
+            f"supported: {sorted(KNOWN_CONFIG_KEYS)}")
+    min_r = int(cfg.get("min_replicas", 1))
+    max_r = int(cfg.get("max_replicas", max(current_replicas, min_r, 1)))
+    if min_r < 1:
+        raise ValueError(f"min_replicas must be >= 1, got {min_r}")
+    if min_r > max_r:
+        raise ValueError(
+            f"min_replicas ({min_r}) > max_replicas ({max_r})")
+    target = cfg.get("target_ongoing_requests",
+                     cfg.get("target_num_ongoing_requests_per_replica", 2))
+    target = float(target)
+    if target <= 0:
+        raise ValueError(
+            f"target_ongoing_requests must be > 0, got {target}")
+    p95 = cfg.get("target_p95_ms")
+    if p95 is not None and float(p95) <= 0:
+        raise ValueError(f"target_p95_ms must be > 0, got {p95}")
+    up_delay = float(cfg.get("upscale_delay_s", default_upscale_delay_s))
+    down_delay = float(cfg.get("downscale_delay_s",
+                               default_downscale_delay_s))
+    if up_delay < 0 or down_delay < 0:
+        raise ValueError("autoscaling delays must be >= 0")
+    return {
+        "min_replicas": min_r,
+        "max_replicas": max_r,
+        "target_ongoing_requests": target,
+        "target_p95_ms": None if p95 is None else float(p95),
+        "upscale_delay_s": up_delay,
+        "downscale_delay_s": down_delay,
+    }
+
+
+@dataclass
+class Decision:
+    """One autoscaling verdict for one deployment."""
+
+    target: int
+    direction: str  # "up" | "down" | "none"
+    reason: str
+
+    @property
+    def changed(self) -> bool:
+        return self.direction != "none"
+
+
+class _DeploymentScaleState:
+    """Per-deployment hysteresis memory (pure-policy side)."""
+
+    __slots__ = ("last_scale_t", "down_since")
+
+    def __init__(self):
+        self.last_scale_t: Optional[float] = None  # None = never scaled
+        self.down_since: Optional[float] = None
+
+
+class AutoscalePolicy:
+    """Pure decision engine: no clocks, no RPCs, no metrics — callers
+    inject ``now`` and windowed ``stats``, making every branch a unit
+    test (target computation, hysteresis, cooldown, clamps, hint
+    override)."""
+
+    def __init__(self):
+        self._state: Dict[str, _DeploymentScaleState] = {}
+
+    def forget(self, name: str) -> None:
+        """Drop hysteresis state for a deleted deployment."""
+        self._state.pop(name, None)
+
+    def desired_replicas(self, cfg: Dict[str, Any], current: int,
+                         stats: Optional[Dict[str, Any]],
+                         hint: Optional[Dict[str, Any]]) -> tuple:
+        """The raw (pre-hysteresis) target: ``ceil(load / target)``
+        with the p95-burn and scale-hint floors, clamped to bounds.
+        Returns (desired, reason). Pure and stateless."""
+        min_r, max_r = cfg["min_replicas"], cfg["max_replicas"]
+        stats = stats or {}
+        load = float(stats.get("mean_queue_depth", 0.0) or 0.0)
+        qps = float(stats.get("qps", 0.0) or 0.0)
+        desired = math.ceil(load / cfg["target_ongoing_requests"])
+        reason = (f"queue_depth={load:.2f} "
+                  f"target={cfg['target_ongoing_requests']:g}")
+        # p95 burn: latency over budget under live traffic forces at
+        # least one step up even if the queue math is satisfied.
+        p95_budget = cfg.get("target_p95_ms")
+        if p95_budget and qps > 0:
+            p95_ms = float(stats.get("p95_s", 0.0) or 0.0) * 1000.0
+            if p95_ms > p95_budget and desired <= current:
+                desired = current + 1
+                reason = (f"p95_burn {p95_ms:.1f}ms > "
+                          f"{p95_budget:g}ms budget")
+        # Scale-hint override: a firing "up" hint (alert plane) floors
+        # the target at one step up; resolution/TTL clears it.
+        if hint is not None and hint.get("direction", "up") == "up":
+            if desired <= current:
+                desired = current + 1
+                reason = f"scale_hint:{hint.get('rule', '?')}"
+        return max(min_r, min(max_r, desired)), reason
+
+    def decide(self, name: str, *, current: int, cfg: Dict[str, Any],
+               stats: Optional[Dict[str, Any]],
+               hint: Optional[Dict[str, Any]], now: float) -> Decision:
+        """Full decision: raw target + hysteresis/cooldown. ``cfg`` must
+        be :func:`normalize_config` output; ``current`` is the DESIRED
+        replica count (actuation-in-progress must not double-trigger)."""
+        st = self._state.setdefault(name, _DeploymentScaleState())
+        desired, reason = self.desired_replicas(cfg, current, stats, hint)
+        since_scale = (math.inf if st.last_scale_t is None
+                       else now - st.last_scale_t)
+        if desired > current:
+            st.down_since = None
+            if since_scale < cfg["upscale_delay_s"]:
+                return Decision(current, "none",
+                                f"cooldown ({reason})")
+            st.last_scale_t = now
+            return Decision(desired, "up", reason)
+        if desired < current:
+            # Hint in force = never down (even a "down" raw verdict):
+            # the alert plane says this deployment is burning.
+            if hint is not None and hint.get("direction", "up") == "up":
+                st.down_since = None
+                return Decision(current, "none", "scale_hint holds")
+            if st.down_since is None:
+                st.down_since = now
+            held = now - st.down_since
+            if held < cfg["downscale_delay_s"] or \
+                    since_scale < cfg["downscale_delay_s"]:
+                return Decision(current, "none",
+                                f"downscale held {held:.1f}s ({reason})")
+            st.down_since = None
+            st.last_scale_t = now
+            return Decision(desired, "down", reason)
+        st.down_since = None
+        return Decision(current, "none", reason)

@@ -25,10 +25,12 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu._private import builtin_metrics, events
+from ray_tpu.serve._private import autoscaler as autoscaler_mod
 from ray_tpu.serve._private.common import (DRAINING, RUNNING, STARTING,
                                            STOPPED, is_system_failure,
                                            serve_config)
@@ -114,10 +116,17 @@ class ServeController:
         self._membership_subscribed = False
         # Scale hints pushed by the alerting plane (typed scale_hint
         # alerts, e.g. serve_p95_burn): latest firing hint per
-        # deployment, cleared on resolve. Input signal for a future
-        # autoscaler; surfaced via scale_hints() today.
+        # deployment. Cleared on alert resolve AND TTL-aged
+        # (serve_scale_hint_ttl_s) so a dead alert engine cannot pin a
+        # deployment's hint forever. Input to the autoscaler policy.
         self._scale_hints: Dict[str, dict] = {}
         self._alerts_subscribed = False
+        # Autoscaler (serve/_private/autoscaler.py): pure policy state
+        # plus the control-loop cadence marker. Decisions actuate
+        # through the ordinary reconcile path (STARTING replicas on the
+        # way up, DRAINING on the way down).
+        self._autoscale_policy = autoscaler_mod.AutoscalePolicy()
+        self._next_autoscale_t = 0.0
 
     def _bump_membership(self) -> None:
         self._membership_version += 1
@@ -183,13 +192,30 @@ class ServeController:
                 "direction": hint.get("direction", "up"),
                 "rule": alert.get("rule"),
                 "value": alert.get("value"),
+                "t": time.monotonic(),
             }
         elif alert.get("state") == "resolved":
             self._scale_hints.pop(deployment, None)
 
-    def scale_hints(self) -> Dict[str, dict]:
-        """Latest firing scale hints, keyed by deployment."""
+    def _live_scale_hints(self) -> Dict[str, dict]:
+        """Firing scale hints younger than serve_scale_hint_ttl_s;
+        expired ones are dropped on read (a crashed alert engine never
+        delivers the resolve, so age is the backstop)."""
+        ttl = serve_config("serve_scale_hint_ttl_s", 120.0)
+        now = time.monotonic()
+        for name in [n for n, h in self._scale_hints.items()
+                     if ttl > 0 and now - h.get("t", now) > ttl]:
+            hint = self._scale_hints.pop(name)
+            events.emit("autoscale",
+                        f"scale hint for {name} expired after {ttl:g}s "
+                        f"(rule {hint.get('rule')})",
+                        labels={"deployment": name,
+                                "rule": str(hint.get("rule"))})
         return dict(self._scale_hints)
+
+    def scale_hints(self) -> Dict[str, dict]:
+        """Latest firing (unexpired) scale hints, keyed by deployment."""
+        return self._live_scale_hints()
 
     def _on_membership_event(self, event: dict) -> None:
         """Runs on the DECLARER's thread (membership fan-out): hop to
@@ -213,6 +239,11 @@ class ServeController:
                      version: str, user_config: Optional[Any] = None,
                      max_queued_requests: int = -1) -> bool:
         self._ensure_background()
+        if autoscaling_config:
+            # Fail the deploy fast on a bad config (unknown key, bad
+            # bounds) instead of skipping silent autoscale passes.
+            autoscaler_mod.normalize_config(
+                autoscaling_config, current_replicas=num_replicas)
         existing = self._deployments.get(name)
         info = DeploymentInfo(name, deployment_def_bytes, init_args,
                               init_kwargs, num_replicas, ray_actor_options,
@@ -249,6 +280,7 @@ class ServeController:
         info = self._deployments.pop(name, None)
         if info is None:
             return False
+        self._autoscale_policy.forget(name)
         # Unpublish first (routers and the proxy drop it on the push),
         # then drain in-flight work bounded by the drain window.
         self._bump_membership()
@@ -467,6 +499,7 @@ class ServeController:
             try:
                 await self._health_pass()
                 await self._drain_pass()
+                await self._maybe_autoscale()
             except asyncio.CancelledError:
                 raise
             except Exception:  # noqa: BLE001 - the loop must survive
@@ -584,6 +617,7 @@ class ServeController:
                 "route_prefix": info.route_prefix,
                 "version": info.version,
                 "autoscaling_config": info.autoscaling_config,
+                "autoscaled": bool(info.autoscaling_config),
             }
             for name, info in self._deployments.items()
         }
@@ -606,6 +640,99 @@ class ServeController:
         return stats_fn(window=window)
 
     # -- autoscaling -----------------------------------------------------
+
+    async def _maybe_autoscale(self) -> None:
+        """Cadence gate for the autoscaling pass inside the control
+        loop (the health/drain loop runs every
+        serve_health_check_period_s; autoscaling on its own, slower,
+        serve_autoscale_interval_s clock; <= 0 disables it)."""
+        interval = serve_config("serve_autoscale_interval_s", 2.0)
+        if interval <= 0:
+            return
+        now = asyncio.get_event_loop().time()
+        if now < self._next_autoscale_t:
+            return
+        self._next_autoscale_t = now + interval
+        await self._autoscale_pass()
+
+    def _apply_autoscale_decision(self, info: DeploymentInfo,
+                                  decision) -> None:
+        """Record one actuated decision: counter + journal row. The
+        target gauge is set unconditionally by the caller so
+        target-vs-actual graphs exist even at steady state."""
+        direction = decision.direction
+        old = info.num_replicas
+        info.num_replicas = decision.target
+        builtin_metrics.serve_autoscale_decisions().inc(
+            tags={"deployment": info.name, "direction": direction})
+        events.emit(
+            "autoscale",
+            f"deployment {info.name}: {old} -> {decision.target} "
+            f"replicas ({decision.reason})",
+            labels={"deployment": info.name, "direction": direction,
+                    "from": str(old), "to": str(decision.target),
+                    "reason": decision.reason[:120]})
+        logger.info("Autoscaling %s: %d -> %d replicas (%s)",
+                    info.name, old, decision.target, decision.reason)
+
+    async def _autoscale_pass(self) -> Dict[str, int]:
+        """One pass of the closed loop: windowed deployment stats +
+        live scale hints -> pure policy -> reconcile. Scale-down goes
+        through DRAINING (in-flight requests finish); scale-up starts
+        replicas through the bounded-startup path."""
+        window = serve_config("serve_autoscale_window_s", 15.0)
+        try:
+            stats = (await self.deployment_stats(window=window)).get(
+                "deployments", {})
+        except Exception:  # noqa: BLE001 - no signal plane: skip pass
+            stats = {}
+        hints = self._live_scale_hints()
+        now = asyncio.get_event_loop().time()
+        targets: Dict[str, int] = {}
+        for name, info in list(self._deployments.items()):
+            if not info.autoscaling_config:
+                continue
+            try:
+                cfg = autoscaler_mod.normalize_config(
+                    info.autoscaling_config,
+                    current_replicas=info.num_replicas,
+                    default_upscale_delay_s=serve_config(
+                        "serve_autoscale_upscale_delay_s", 0.0),
+                    default_downscale_delay_s=serve_config(
+                        "serve_autoscale_downscale_delay_s", 10.0))
+            except ValueError:
+                logger.exception("Invalid autoscaling_config on %s; "
+                                 "skipping", name)
+                continue
+            decision = self._autoscale_policy.decide(
+                name, current=info.num_replicas, cfg=cfg,
+                stats=stats.get(name), hint=hints.get(name), now=now)
+            targets[name] = decision.target
+            builtin_metrics.serve_target_replicas().set(
+                decision.target, tags={"deployment": name})
+            if decision.changed:
+                self._apply_autoscale_decision(info, decision)
+                await self._reconcile(name)
+        return targets
+
+    async def autoscale_status(self) -> Dict[str, dict]:
+        """Target-vs-actual per autoscaled deployment (status/top
+        surfaces): desired target, RUNNING count, bounds, live hint."""
+        hints = self._live_scale_hints()
+        out = {}
+        for name, info in self._deployments.items():
+            cfg = info.autoscaling_config
+            if not cfg:
+                continue
+            out[name] = {
+                "target": info.num_replicas,
+                "running": len(info.running()),
+                "min_replicas": cfg.get("min_replicas", 1),
+                "max_replicas": cfg.get("max_replicas",
+                                        info.num_replicas),
+                "scale_hint": hints.get(name),
+            }
+        return out
 
     async def autoscale_tick(self) -> Dict[str, int]:
         """One autoscaling pass (reference: _private/autoscaling_policy.py:
@@ -632,8 +759,15 @@ class ServeController:
             total_ongoing = sum(counts)
             desired = max(min_r, min(max_r, round(total_ongoing / target)
                                      if target else min_r))
+            builtin_metrics.serve_target_replicas().set(
+                desired, tags={"deployment": name})
             if desired != info.num_replicas:
-                info.num_replicas = desired
+                self._apply_autoscale_decision(
+                    info, autoscaler_mod.Decision(
+                        desired,
+                        "up" if desired > info.num_replicas else "down",
+                        f"manual tick: ongoing={total_ongoing} "
+                        f"target={target:g}"))
                 await self._reconcile(name)
             decisions[name] = info.num_replicas
         return decisions

@@ -38,11 +38,13 @@ class DeploymentSchema:
                 f"max_queued_requests must be >= -1 (-1 = unlimited), "
                 f"got {self.max_queued_requests}")
         if self.autoscaling_config:
-            mn = self.autoscaling_config.get("min_replicas", 1)
-            mx = self.autoscaling_config.get("max_replicas", mn)
-            if mn > mx:
-                raise ValueError(
-                    f"min_replicas ({mn}) > max_replicas ({mx})")
+            # Full validation (unknown keys, bounds, targets, delays)
+            # lives in the autoscaler policy module; failing here keeps
+            # `serve deploy` errors at config-parse time.
+            from ray_tpu.serve._private import autoscaler
+            autoscaler.normalize_config(
+                self.autoscaling_config,
+                current_replicas=self.num_replicas or 1)
 
 
 @dataclass

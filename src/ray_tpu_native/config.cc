@@ -136,6 +136,17 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     FLAG_DBL(serve_health_check_timeout_s, 5.0),
     FLAG_INT(serve_health_failure_threshold, 3),
     FLAG_INT(serve_failover_retries, 3),
+    // -- serve autoscaling + batching --
+    // Controller autoscale-pass cadence (<=0 disables) and the stats
+    // window it sizes from; cluster-default up/down hysteresis delays;
+    // scale-hint TTL (dead alert engine can't pin a hint); cluster
+    // latency budget for adaptive batch queues (0 = fixed batching).
+    FLAG_DBL(serve_autoscale_interval_s, 2.0),
+    FLAG_DBL(serve_autoscale_window_s, 15.0),
+    FLAG_DBL(serve_autoscale_upscale_delay_s, 0.0),
+    FLAG_DBL(serve_autoscale_downscale_delay_s, 10.0),
+    FLAG_DBL(serve_scale_hint_ttl_s, 120.0),
+    FLAG_DBL(serve_batch_target_latency_ms, 0.0),
     // -- train fault tolerance --
     // Hang detector: a result round idle this long liveness-probes the
     // pending ranks (failed probe => system failure, gang restart);
