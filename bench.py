@@ -1014,6 +1014,93 @@ def bench_object_recovery() -> dict:
     return out
 
 
+def bench_head_failover() -> dict:
+    """Head failover recovery latency: a subprocess driver owns the head
+    (gcs_store-backed) with one daemon joined, then dies by SIGKILL.
+    ``head_failover_recovery_ms`` is kill -> first task RESULT computed
+    on the daemon under a NEW head on the same port + store — i.e. store
+    replay, head rebirth, the daemon's jittered re-dial + re-register,
+    and one scheduled round-trip. Latency-gated: this is the window a
+    supervisor-restarted head adds to in-flight work."""
+    import json as _json
+    import os as _os
+    import signal as _signal
+    import socket as _socket
+    import subprocess
+    import sys
+    import tempfile as _tempfile
+    import time as _time
+
+    import ray_tpu
+
+    driver1 = """
+import sys, time
+import ray_tpu
+path, port = sys.argv[1], int(sys.argv[2])
+ray_tpu.init(num_cpus=1, _system_config={"gcs_store_path": path})
+ray_tpu.start_head_server(port=port, host="127.0.0.1")
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline:
+    if ray_tpu.cluster_resources().get("fo", 0) >= 1:
+        break
+    time.sleep(0.1)
+else:
+    raise TimeoutError("daemon never joined")
+print("READY", flush=True)
+time.sleep(3600)
+"""
+    out = {}
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    tmp = _tempfile.mkdtemp(prefix="ray_tpu_bench_failover_")
+    store = _os.path.join(tmp, "gcs.bin")
+    procs = []
+    try:
+        head1 = subprocess.Popen(
+            [sys.executable, "-c", driver1, store, str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        procs.append(head1)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.multinode",
+             "--address", f"127.0.0.1:{port}", "--num-cpus", "2",
+             "--resources", _json.dumps({"fo": 1})],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        line = head1.stdout.readline()
+        if "READY" not in line:
+            raise RuntimeError(f"first head never came up: {line!r}")
+
+        head1.send_signal(_signal.SIGKILL)
+        head1.wait(timeout=10)
+        t0 = _time.perf_counter()
+
+        ray_tpu.init(num_cpus=1,
+                     _system_config={"gcs_store_path": store})
+        ray_tpu.start_head_server(port=port, host="127.0.0.1")
+        deadline = _time.monotonic() + 120
+        while _time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("fo", 0) >= 1:
+                break
+            _time.sleep(0.05)
+        else:
+            raise TimeoutError("daemon never re-registered")
+
+        @ray_tpu.remote(resources={"fo": 1})
+        def ping(x):
+            return x
+
+        assert ray_tpu.get(ping.remote(7), timeout=60) == 7
+        out["head_failover_recovery_ms"] = round(
+            (_time.perf_counter() - t0) * 1e3, 1)
+    finally:
+        _stop_procs(procs)
+        ray_tpu.shutdown()
+        import shutil as _shutil
+        _shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def bench_train_gang_restart() -> dict:
     """Train gang-restart latency: a chaos ``train.worker_kill`` takes a
     rank down mid-run and the metric is the longest gap between
@@ -1835,7 +1922,7 @@ def _prior_round_bench():
 # test_only_throughput_suffixes_compared); these few regress when they
 # INCREASE beyond the threshold.
 _LATENCY_GATED = ("train_gang_restart_ms", "node_death_detect_ms",
-                  "object_restore_ms")
+                  "object_restore_ms", "head_failover_recovery_ms")
 
 
 def compare_rounds(prev: dict, extra: dict, headline_value,
@@ -2052,6 +2139,8 @@ def main(argv=None):
         ("channel_reconnect", "channel_reconnect_ms",
          bench_channel_reconnect),
         ("object_recovery", "node_death_detect_ms", bench_object_recovery),
+        ("head_failover", "head_failover_recovery_ms",
+         bench_head_failover),
         ("train_gang_restart", "train_gang_restart_ms",
          bench_train_gang_restart),
         ("log_stream", "log_lines_per_sec", bench_log_streaming),

@@ -424,6 +424,53 @@ def node_deaths() -> Counter:
         tag_keys=("kind",))
 
 
+# -- head failover ---------------------------------------------------------
+# Rare-path events (a head recovery is news): plain lazy accessors.
+# Incremented from gcs_store load, the runtime's recovery path, and the
+# node daemon's re-dial loop.
+
+
+def gcs_corrupt_records() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_gcs_corrupt_records_total",
+        "gcs_store records skipped at load because they were truncated "
+        "or failed their CRC (torn write through kill -9, disk "
+        "corruption). Skipped with a warning, never fatal: the rest of "
+        "the snapshot still restores.")
+
+
+def head_recoveries() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_head_recoveries_total",
+        "Head processes that started against a gcs_store with prior "
+        "state and rehydrated the control plane from it (membership "
+        "epochs, actor/serve/job records, object spill URIs).")
+
+
+def head_recovery_replayed() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_head_recovery_replayed_total",
+        "Records replayed from the gcs_store during a head recovery, "
+        "by table (kv, actors, jobs, node_epochs, serve_deployments, "
+        "spill_uris, object_replicas).",
+        tag_keys=("kind",))
+
+
+def daemon_redials() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_daemon_redials_total",
+        "Daemon re-dial attempts against a lost head session, by how "
+        "they ended: resumed (same head, channel re-attached), "
+        "reregistered (full re-register — head restarted or resume "
+        "rejected), gave_up (head_failover_window_s exhausted; the "
+        "daemon exits).",
+        tag_keys=("outcome",))
+
+
 # -- serve resilience ------------------------------------------------------
 # Control-plane events (a failover or a drain is news, not load): plain
 # lazy accessors, no fast cells. Incremented from the serve router's

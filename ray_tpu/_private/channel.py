@@ -38,6 +38,7 @@ is confirmed gone via the health channel.
 from __future__ import annotations
 
 import collections
+import errno
 import logging
 import os
 import random
@@ -150,6 +151,18 @@ def is_transient(exc: BaseException) -> bool:
     """Classify an exception from a socket op as a transient transport
     error (worth a reconnect/retry) rather than a programming error."""
     return isinstance(exc, (OSError, struct.error, EOFError))
+
+
+def connection_refused(exc: BaseException) -> bool:
+    """True when a dial failed because NOTHING is listening (RST on
+    connect). For a session resume this is decisive: the head process
+    is gone, its channel ring died with it, and no amount of in-window
+    retrying can ever resume — the caller should fall through to the
+    full re-register/re-dial path (which a REBORN head can answer)."""
+    if isinstance(exc, ConnectionRefusedError):
+        return True
+    return isinstance(exc, OSError) and exc.errno in (
+        errno.ECONNREFUSED, errno.ECONNABORTED)
 
 
 class Backoff:

@@ -2,13 +2,35 @@
 
 The analog of the reference's GCS storage layer (gcs_server.cc:523 —
 in-memory vs Redis store; gcs/store_client/redis_store_client.h): the
-head persists its control-plane tables (internal KV, named-actor
-registry, job records) to a single file, atomically rewritten on every
-mutation. A NEW driver started with the same ``gcs_store_path`` (and
-head port) restores them: daemons reconnect with their resident actor
-ids, the head rebinds named actors to the live daemon instances, and
-``get_actor(name)`` answers again — head death is no longer cluster
-death.
+head persists its control-plane tables to a single file, atomically
+rewritten on every mutation. A NEW driver started with the same
+``gcs_store_path`` (and head port) restores them: daemons reconnect
+with their resident actor ids, the head rebinds named actors to the
+live daemon instances, serve deployments redeploy from their persisted
+configs, and durable spill URIs rejoin the object directory — head
+death is no longer cluster death.
+
+On-disk format (v2): a magic header followed by independently framed
+records — ``[u32 length][u32 crc32][pickle((kind, key, value))]``.
+Every write goes tmp → flush+fsync → ``os.replace`` (the same
+discipline as spill.py), so the file is always a complete snapshot;
+per-record CRCs mean a flipped byte or a truncated tail costs only the
+damaged records, which are skipped with a counted warning
+(``ray_tpu_gcs_corrupt_records_total``) instead of raising at load.
+Legacy v1 files (one monolithic pickle) still load.
+
+Tables:
+
+* ``kv`` — internal KV (reference: gcs_kv_manager.h InternalKV)
+* ``actors`` — named/detached actor records (rebind after restart)
+* ``jobs`` — driver job records (GcsJobManager analog)
+* ``node_epochs`` — incarnation epochs (wire-v9 fencing floor)
+* ``serve`` — serve deployment configs + autoscaler targets
+* ``spill_uris`` / ``object_replicas`` — the durable half of the
+  object directory (spill-URI restore survives head death; replica
+  holders are recovered for accounting — their node ids are reminted
+  on re-registration)
+* ``meta`` — head incarnation counter + last-recovery record
 
 State that is deliberately NOT persisted (matching the reference's
 in-memory-GCS behavior for non-table state): in-flight tasks, object
@@ -18,23 +40,49 @@ the driver that owned them is gone.
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
+import struct
 import threading
-from typing import Any, Dict, Optional
+import time
+import zlib
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+#: v2 header. v1 files begin with a pickle opcode (0x80), never this.
+_MAGIC = b"RTGCS2\n"
+_FRAME = struct.Struct("<II")  # payload length, crc32(payload)
+
+#: Replica-holder updates arrive on hot paths (pull-learn); they are a
+#: cache, not the durable tier, so their saves coalesce to at most one
+#: rewrite per this many seconds (any unthrottled save flushes them).
+_THROTTLE_S = 1.0
+
+
+def _count_corrupt(n: int = 1) -> None:
+    """Best-effort metric bump (the store must work in tools/tests
+    without a metrics registry)."""
+    try:
+        from ray_tpu._private import builtin_metrics
+        builtin_metrics.gcs_corrupt_records().inc(n)
+    except Exception:  # noqa: BLE001 - metrics are optional here
+        pass
 
 
 class GcsStore:
-    """One pickle file holding all persisted tables. Mutations rewrite
-    atomically (tmp + rename) — the file is always a consistent
-    snapshot, even through kill -9."""
+    """One record-framed file holding all persisted tables. Mutations
+    rewrite atomically (tmp + fsync + rename) — the file is always a
+    consistent snapshot, even through kill -9; a damaged record is
+    skipped at load, never fatal."""
 
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
         self.kv: Dict[str, Dict[bytes, bytes]] = {}
         # actor_id hex → {"name", "namespace", "max_restarts",
-        #                 "max_concurrency"}
+        #                 "max_concurrency", ...}
         self.actors: Dict[str, Dict[str, Any]] = {}
         self.jobs: Dict[str, Dict[str, Any]] = {}
         # node_id hex → incarnation epoch (v9 membership fencing). The
@@ -43,28 +91,222 @@ class GcsStore:
         # a partitioned daemon returning across a head restart is still
         # recognizably stale.
         self.node_epochs: Dict[str, int] = {}
+        # deployment name → serve deployment record (pickled def +
+        # init payload + scale target); the authoritative copy the
+        # serve controller replays after a head restart.
+        self.serve_deployments: Dict[str, Dict[str, Any]] = {}
+        # daemon object key → (uri, size): durable spill locations
+        # announced by daemons — the restore tier that still works when
+        # BOTH the head and the spilling daemon died.
+        self.spill_uris: Dict[str, Tuple[str, int]] = {}
+        # object_id hex → [node_id hex, ...]: in-memory replica holders.
+        # Recovered for accounting only (node ids are reminted when
+        # daemons re-register), and saved throttled — they are learned
+        # on pull paths and must not fsync per update.
+        self.object_replicas: Dict[str, list] = {}
+        # {"incarnation": int, "last_recovery": {...}} — bumped by
+        # begin_head_incarnation() once per head life.
+        self.meta: Dict[str, Any] = {}
+        #: Records skipped at load (CRC mismatch / truncated tail /
+        #: undecodable payload). Also counted into
+        #: ray_tpu_gcs_corrupt_records_total.
+        self.corrupt_records = 0
+        #: True when the file existed and yielded at least one record —
+        #: the signal that this head is a RECOVERY, not a first boot.
+        self.had_prior_state = False
+        self._dirty = False
+        self._last_save = 0.0
         if os.path.exists(path):
+            self._load()
+
+    # -- load ----------------------------------------------------------
+
+    def _load(self) -> None:
+        try:
+            with open(self.path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            logger.exception("could not read gcs store %s", self.path)
+            return
+        if not blob:
+            return
+        if blob.startswith(_MAGIC):
+            n = 0
+            for kind, key, value in self._iter_file_records(blob):
+                self._apply_record(kind, key, value)
+                n += 1
+            self.had_prior_state = n > 0
+            return
+        # Legacy v1: one monolithic pickle of the table dict.
+        try:
+            data = pickle.loads(blob)
+            self.kv = data.get("kv", {})
+            self.actors = data.get("actors", {})
+            self.jobs = data.get("jobs", {})
+            self.node_epochs = data.get("node_epochs", {})
+            self.had_prior_state = bool(
+                self.kv or self.actors or self.jobs or self.node_epochs)
+        except Exception:  # noqa: BLE001 - torn v1 file: start fresh,
+            # but COUNT it — silent data loss is the bug this format
+            # replaces.
+            self.corrupt_records += 1
+            _count_corrupt()
+            logger.warning(
+                "gcs store %s is unreadable (legacy format, torn "
+                "write?); starting fresh", self.path)
+
+    def _iter_file_records(self, blob: bytes
+                           ) -> Iterator[Tuple[str, Any, Any]]:
+        """Yield intact (kind, key, value) records; skip+count damaged
+        ones. A bad payload with intact framing only loses itself; a
+        truncated tail loses the records past the tear."""
+        off = len(_MAGIC)
+        end = len(blob)
+        while off < end:
+            if off + _FRAME.size > end:
+                self._note_corrupt("truncated record header")
+                return
+            length, crc = _FRAME.unpack_from(blob, off)
+            off += _FRAME.size
+            payload = blob[off:off + length]
+            off += length
+            if len(payload) < length:
+                self._note_corrupt("truncated record payload")
+                return
+            if zlib.crc32(payload) != crc:
+                self._note_corrupt("crc mismatch")
+                continue
             try:
-                with open(path, "rb") as f:
-                    data = pickle.load(f)
-                self.kv = data.get("kv", {})
-                self.actors = data.get("actors", {})
-                self.jobs = data.get("jobs", {})
-                self.node_epochs = data.get("node_epochs", {})
-            except Exception:  # noqa: BLE001 - torn file: start fresh
-                pass
+                kind, key, value = pickle.loads(payload)
+            except Exception:  # noqa: BLE001 - undecodable record
+                self._note_corrupt("undecodable payload")
+                continue
+            yield kind, key, value
+
+    def _note_corrupt(self, why: str) -> None:
+        self.corrupt_records += 1
+        _count_corrupt()
+        logger.warning("gcs store %s: skipping corrupt record (%s)",
+                       self.path, why)
+
+    def _apply_record(self, kind: str, key: Any, value: Any) -> None:
+        if kind == "kv":
+            ns, k = key
+            self.kv.setdefault(ns, {})[k] = value
+        elif kind == "actor":
+            self.actors[key] = value
+        elif kind == "job":
+            self.jobs[key] = value
+        elif kind == "node_epoch":
+            self.node_epochs[key] = int(value)
+        elif kind == "serve":
+            self.serve_deployments[key] = value
+        elif kind == "spill_uri":
+            self.spill_uris[key] = (value[0], int(value[1]))
+        elif kind == "object_replicas":
+            self.object_replicas[key] = list(value)
+        elif kind == "meta":
+            self.meta[key] = value
+        # Unknown kinds from a newer build are ignored (and dropped on
+        # the next rewrite) rather than fatal — forward compatibility.
+
+    # -- save ----------------------------------------------------------
+
+    def _iter_records(self) -> Iterator[Tuple[str, Any, Any]]:
+        for ns, table in self.kv.items():
+            for k, v in table.items():
+                yield "kv", (ns, k), v
+        for key, rec in self.actors.items():
+            yield "actor", key, rec
+        for key, rec in self.jobs.items():
+            yield "job", key, rec
+        for key, epoch in self.node_epochs.items():
+            yield "node_epoch", key, epoch
+        for key, rec in self.serve_deployments.items():
+            yield "serve", key, rec
+        for key, rec in self.spill_uris.items():
+            yield "spill_uri", key, rec
+        for key, rec in self.object_replicas.items():
+            yield "object_replicas", key, rec
+        for key, rec in self.meta.items():
+            yield "meta", key, rec
 
     def _save_locked(self) -> None:
         tmp = self.path + ".tmp"
         os.makedirs(os.path.dirname(os.path.abspath(self.path)),
                     exist_ok=True)
         with open(tmp, "wb") as f:
-            pickle.dump({"kv": self.kv, "actors": self.actors,
-                         "jobs": self.jobs,
-                         "node_epochs": self.node_epochs}, f)
+            f.write(_MAGIC)
+            for kind, key, value in self._iter_records():
+                try:
+                    payload = pickle.dumps((kind, key, value))
+                except Exception:  # noqa: BLE001 - one unpicklable
+                    # record must not take the whole snapshot down.
+                    logger.warning("gcs store: dropping unpicklable "
+                                   "%s record %r", kind, key)
+                    continue
+                f.write(_FRAME.pack(len(payload), zlib.crc32(payload)))
+                f.write(payload)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+        self._dirty = False
+        self._last_save = time.monotonic()
+
+    def _save_throttled_locked(self) -> None:
+        """Coalesced save for hot-path cache tables (replica holders):
+        at most one rewrite per _THROTTLE_S; anything deferred flushes
+        with the next unthrottled save. Losing <1s of replica-holder
+        updates to a crash is fine — they are an optimization tier."""
+        if time.monotonic() - self._last_save >= _THROTTLE_S:
+            self._save_locked()
+        else:
+            self._dirty = True
+
+    def flush(self) -> None:
+        """Write any deferred (throttled) updates now."""
+        with self._lock:
+            if self._dirty:
+                self._save_locked()
+
+    def counts(self) -> Dict[str, int]:
+        """Per-table record counts (recovery accounting + status)."""
+        with self._lock:
+            return {
+                "kv": sum(len(t) for t in self.kv.values()),
+                "actors": len(self.actors),
+                "jobs": len(self.jobs),
+                "node_epochs": len(self.node_epochs),
+                "serve_deployments": len(self.serve_deployments),
+                "spill_uris": len(self.spill_uris),
+                "object_replicas": len(self.object_replicas),
+            }
+
+    # -- head incarnations (failover accounting) -----------------------
+
+    def head_incarnation(self) -> int:
+        with self._lock:
+            return int((self.meta.get("head") or {}).get(
+                "incarnation", 0))
+
+    def begin_head_incarnation(
+            self, recovery: Optional[Dict[str, Any]] = None) -> int:
+        """Bump the head incarnation counter (once per head life) and
+        record the recovery summary; returns the new incarnation."""
+        with self._lock:
+            rec = dict(self.meta.get("head") or {})
+            inc = int(rec.get("incarnation", 0)) + 1
+            rec["incarnation"] = inc
+            rec["started_at"] = time.time()
+            if recovery is not None:
+                rec["last_recovery"] = recovery
+            self.meta["head"] = rec
+            self._save_locked()
+            return inc
+
+    def last_recovery(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return (self.meta.get("head") or {}).get("last_recovery")
 
     # -- node epochs (v9 membership fencing) ---------------------------
 
@@ -163,3 +405,45 @@ class GcsStore:
         with self._lock:
             self.jobs[job_id] = record
             self._save_locked()
+
+    # -- serve deployments ---------------------------------------------
+
+    def record_serve_deployment(self, name: str,
+                                record: Dict[str, Any]) -> None:
+        """The controller persists the full deploy payload (pickled def,
+        init args, scale target, autoscaling config) so a head restart
+        can replay the deploy against a fresh controller."""
+        with self._lock:
+            self.serve_deployments[name] = record
+            self._save_locked()
+
+    def remove_serve_deployment(self, name: str) -> None:
+        with self._lock:
+            if self.serve_deployments.pop(name, None) is not None:
+                self._save_locked()
+
+    # -- object directory (durable tiers) ------------------------------
+
+    def record_spill_uri(self, key: str, uri: str, size: int) -> None:
+        with self._lock:
+            self.spill_uris[key] = (uri, int(size))
+            self._save_locked()
+
+    def remove_spill_uri(self, key: str) -> None:
+        with self._lock:
+            if self.spill_uris.pop(key, None) is not None:
+                # Retractions ride the throttle: a mass free must not
+                # fsync per object.
+                self._save_throttled_locked()
+
+    def record_object_replica(self, oid_hex: str, node_hex: str) -> None:
+        with self._lock:
+            holders = self.object_replicas.setdefault(oid_hex, [])
+            if node_hex not in holders:
+                holders.append(node_hex)
+                self._save_throttled_locked()
+
+    def remove_object_replicas(self, oid_hex: str) -> None:
+        with self._lock:
+            if self.object_replicas.pop(oid_hex, None) is not None:
+                self._save_throttled_locked()

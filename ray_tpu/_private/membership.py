@@ -130,8 +130,17 @@ class MembershipTable:
         self._lock = threading.Lock()
         self._gcs_store = gcs_store
         self._epoch_counter = 0
+        #: Rehydration accounting (head failover): the epoch floor
+        #: inherited from previous head lives (every new epoch is
+        #: minted strictly above it) and how many prior node
+        #: incarnations the store remembered. Status/recovery surfaces
+        #: read these; 0/0 on a first boot.
+        self.recovered_epoch_floor = 0
+        self.prior_node_count = 0
         if gcs_store is not None:
             self._epoch_counter = gcs_store.max_node_epoch()
+            self.recovered_epoch_floor = self._epoch_counter
+            self.prior_node_count = len(gcs_store.node_epochs)
         #: node_id hex -> live NodeLiveness (current incarnation only).
         self._live: Dict[str, NodeLiveness] = {}
         #: Epochs whose incarnation was declared dead: any frame or

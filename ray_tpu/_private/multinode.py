@@ -2754,19 +2754,28 @@ class NodeDaemon:
         finally:
             _task_context.spec = None
 
-    def run(self, reconnect_window: float = 60.0) -> None:
+    def run(self, reconnect_window: Optional[float] = None) -> None:
         """Connect, register, and serve. On connection loss (head died
         or restarted) the daemon KEEPS its actors and object table and
         retries the head address for ``reconnect_window`` seconds — a
         restarted head (gcs_store_path persistence) rebinds the resident
         actors on re-registration (reference: raylet surviving GCS
         restart + resubscribe). An orderly head shutdown frame exits
-        immediately."""
+        immediately.
+
+        ``reconnect_window=None`` (the CLI default) reads
+        ``RAY_TPU_head_failover_window_s`` — wide enough (120s) for a
+        supervisor-restarted or standby head to come up, replay its
+        gcs_store, and accept this daemon's re-registration."""
         import time as _time
 
         from ray_tpu._private.channel import Backoff
         global _current_daemon
         _current_daemon = self
+        if reconnect_window is None:
+            from ray_tpu._private.ray_config import runtime_config_value
+            reconnect_window = float(
+                runtime_config_value("head_failover_window_s", 120.0))
         ever_registered = False
         deadline = _time.monotonic() + max(reconnect_window, 0.0)
         # Jittered backoff: after a head restart every daemon in the
@@ -2801,6 +2810,12 @@ class NodeDaemon:
                     logger.warning(
                         "Head %s unreachable for %.0fs; daemon exiting",
                         self.head_address, reconnect_window)
+                    try:
+                        from ray_tpu._private import builtin_metrics
+                        builtin_metrics.daemon_redials().inc(
+                            tags={"outcome": "gave_up"})
+                    except Exception:  # noqa: BLE001 - exit path
+                        pass
                     break
                 bo.sleep()
         finally:
@@ -2893,6 +2908,16 @@ class NodeDaemon:
         self._node_epoch = int(ack.get("node_epoch") or 0)
         chan.epoch = self._node_epoch
         self._session_registered = True
+        if getattr(self, "_was_registered", False):
+            # A re-registration (head restarted, or resume window blew):
+            # the failover loop delivered us to a live head again.
+            try:
+                from ray_tpu._private import builtin_metrics
+                builtin_metrics.daemon_redials().inc(
+                    tags={"outcome": "reregistered"})
+            except Exception:  # noqa: BLE001 - metrics best-effort
+                pass
+        self._was_registered = True
         logger.info("Registered with head %s as node %s",
                     self.head_address, self.node_id_hex[:12])
         session_id = ack.get("session_id")
@@ -2953,6 +2978,12 @@ class NodeDaemon:
                     # class queues) survives; unacked frames replay on
                     # both sides. Only a failed resume tears down.
                     if self._try_resume(chan, channel_token):
+                        try:
+                            from ray_tpu._private import builtin_metrics
+                            builtin_metrics.daemon_redials().inc(
+                                tags={"outcome": "resumed"})
+                        except Exception:  # noqa: BLE001
+                            pass
                         continue
                     raise ConnectionError(
                         "session channel lost (resume failed)")
@@ -2996,11 +3027,13 @@ class NodeDaemon:
         tears the session down for a full re-register."""
         import time as _time
 
-        from ray_tpu._private.channel import Backoff, close_socket
+        from ray_tpu._private.channel import (Backoff, close_socket,
+                                              connection_refused)
         if not token:
             return False
         deadline = (chan.broken_at or _time.monotonic()) + chan.window_s
         bo = Backoff(0.2, 2.0)
+        refused = 0
         while not self._stop.is_set() and _time.monotonic() < deadline:
             sock = None
             try:
@@ -3056,9 +3089,27 @@ class NodeDaemon:
                     return True
                 close_socket(sock)
                 return False
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError) as exc:
                 if sock is not None:
                     close_socket(sock)
+                if connection_refused(exc):
+                    # Nothing is LISTENING at the head address: the head
+                    # process is gone, and with it the channel ring this
+                    # resume would replay into. Burning the rest of the
+                    # resume window here would eat into the failover
+                    # window — bail to the outer re-register loop, which
+                    # keeps re-dialing for head_failover_window_s and
+                    # can join a REBORN head. A couple of confirmations
+                    # guard against one stray RST during a restart race.
+                    refused += 1
+                    if refused >= 3:
+                        logger.warning(
+                            "head %s refused %d consecutive resume "
+                            "dials (process gone); falling back to "
+                            "re-register", self.head_address, refused)
+                        return False
+                else:
+                    refused = 0
                 bo.sleep()
         return False
 

@@ -66,6 +66,12 @@ _PY_DEFAULTS: Dict[str, Any] = {
     # death fires; unacked frames wait in a ring of this many bytes.
     "channel_reconnect_window_s": 30.0,
     "channel_resend_ring_bytes": 67108864,
+    # Head failover: a daemon whose session breaks against a DEAD head
+    # (resume impossible) keeps re-dialing the head address with
+    # jittered backoff for this long before giving up — wide enough
+    # for a supervisor-restarted or standby head to come up, replay
+    # the gcs_store, and accept re-registrations.
+    "head_failover_window_s": 120.0,
     # Deferred acks: after this many unacked inbound frames an ack goes
     # pending, piggybacking on the next outbound frame or flushed as a
     # pure ack once the interval expires.

@@ -577,6 +577,22 @@ class Runtime:
         from ray_tpu._private.membership import MembershipTable
         self.membership = MembershipTable(self.gcs_store)
         self.membership.subscribe(self._membership_event)
+        # Head failover (reference: GCS server restart replaying its
+        # persistent store before serving): when the store carries a
+        # previous head life's state, rehydrate the control plane NOW —
+        # before the head server accepts any daemon traffic. Membership
+        # already floored its epoch counter above every prior epoch;
+        # here the object directory's durable tiers come back, dead
+        # serve-generation actor records are retired (the fresh
+        # controller redeploys from the serve table instead), and the
+        # head incarnation counter + recovery summary land back in the
+        # store for status surfaces.
+        self._head_incarnation = 0
+        self._head_recovery: Optional[Dict[str, Any]] = None
+        self._recovered_object_replicas: Dict[str, list] = {}
+        self._serve_rehydrate_started = False
+        if self.gcs_store is not None:
+            self._recover_from_store()
         # Deferred-free queue: ObjectRef.__del__ can fire at any point —
         # including inside the store's non-reentrant lock when a freed value
         # drops the last handle to another object — so handle-death frees
@@ -618,10 +634,156 @@ class Runtime:
         from ray_tpu._private.metrics_agent import (ClusterMetrics,
                                                     MetricsAgent)
         self._cluster_metrics = ClusterMetrics()
+        self._journal_head_recovery()
         self._metrics_agent = MetricsAgent(
             self._publish_head_metrics, component="driver",
             publish_profile=self._publish_head_profile)
         self._metrics_agent.add_collector(self._collect_head_metrics)
+
+    # ------------------------------------------------------------------
+    # Head failover recovery
+    # ------------------------------------------------------------------
+
+    def _recover_from_store(self) -> None:
+        """Rehydrate head state from the gcs_store before serving.
+
+        Runs in __init__, before start_head_server can accept a single
+        daemon — so everything a re-registering daemon's handshake
+        touches (epoch floor, actor records, object directory) is
+        already in its recovered shape. Replayed tiers:
+
+        * spill URIs — durable by definition (the bytes live in the
+          spill dir, not in any process), so they go straight back into
+          the live ``_spill_uris_by_key`` table and tiered recovery can
+          restore from them immediately.
+        * replica holders — node ids are re-minted when daemons
+          re-register, so the recorded NodeID hexes are stale; they are
+          kept in a side table for status/debugging only, never in the
+          live ``_object_replicas`` map.
+        * serve actor records — controller/replica actors belong to the
+          dead head's serve generation; their records are dropped so
+          re-registering daemons don't rebind zombies (the daemon
+          destroys them instead) and the fresh controller redeploys
+          from the durable serve table.
+        """
+        store = self.gcs_store
+        counts = store.counts()
+        recovery: Optional[Dict[str, Any]] = None
+        if store.had_prior_state:
+            # Spill URIs: live again immediately.
+            spills = dict(store.spill_uris)
+            self._spill_uris_by_key.update(spills)
+            # Replica holders: stale node identities → side table only.
+            self._recovered_object_replicas = {
+                k: list(v) for k, v in store.object_replicas.items()}
+            # Serve-generation actors died with the old head; retire
+            # their records (detached *user* actors keep theirs — that
+            # is the exactly-once incarnation guarantee).
+            purged = [aid for aid, rec in list(store.actors.items())
+                      if str(rec.get("name") or "").startswith(
+                          ("_serve_controller", "_serve_replica::"))]
+            for aid in purged:
+                store.remove_actor(aid)
+            recovery = {
+                "at": time.time(),
+                "epoch_floor": self.membership.recovered_epoch_floor,
+                "corrupt_records": store.corrupt_records,
+                "replayed": {
+                    "kv": counts["kv"],
+                    "actors": counts["actors"] - len(purged),
+                    "jobs": counts["jobs"],
+                    "node_epochs": counts["node_epochs"],
+                    "serve_deployments": counts["serve_deployments"],
+                    "spill_uris": len(spills),
+                    "object_replicas": len(
+                        self._recovered_object_replicas),
+                },
+            }
+        else:
+            self._recovered_object_replicas = {}
+        self._head_incarnation = store.begin_head_incarnation(recovery)
+        self._head_recovery = recovery
+        if recovery is not None:
+            try:
+                from ray_tpu._private import builtin_metrics
+                builtin_metrics.head_recoveries().inc()
+                for kind, n in recovery["replayed"].items():
+                    if n:
+                        builtin_metrics.head_recovery_replayed().inc(
+                            n, tags={"kind": kind})
+            except Exception:  # noqa: BLE001 - metrics must not block boot
+                logger.exception("head recovery metrics failed")
+            logger.warning(
+                "head recovered from gcs_store %s: incarnation %d, "
+                "epoch floor %d, replayed %s (%d corrupt records "
+                "skipped)", store.path, self._head_incarnation,
+                recovery["epoch_floor"], recovery["replayed"],
+                recovery["corrupt_records"])
+
+    def head_recovery_info(self) -> Dict[str, Any]:
+        """Status surface: head incarnation + last recovery summary."""
+        info: Dict[str, Any] = {
+            "incarnation": self._head_incarnation,
+            "recovered": self._head_recovery is not None,
+            "last_recovery": self._head_recovery,
+            "prior_node_count": getattr(
+                self.membership, "prior_node_count", 0),
+        }
+        return info
+
+    def _journal_head_recovery(self) -> None:
+        """Emit the ``head_recovered`` journal event. Called from
+        __init__ right after the cluster journal exists (the recovery
+        itself ran earlier, before any daemon traffic)."""
+        rec = self._head_recovery
+        if rec is None:
+            return
+        labels = {"incarnation": str(self._head_incarnation),
+                  "epoch_floor": str(rec["epoch_floor"])}
+        labels.update({f"replayed_{k}": str(v)
+                       for k, v in rec["replayed"].items() if v})
+        try:
+            self._cluster_metrics.events.record(
+                "head", "head_recovered", severity="warning",
+                labels=labels)
+        except Exception:  # noqa: BLE001 - journal is best-effort
+            logger.exception("could not journal head recovery")
+
+    def maybe_rehydrate_serve_async(self) -> None:
+        """Redeploy persisted serve applications in the background.
+
+        Triggered once per runtime, after the worker wiring is attached
+        (deploys go through the normal actor API). The controller's
+        deploy retry budget absorbs daemons that re-register after us:
+        a replica needing a daemon's resources just stays pending until
+        that daemon's resources come back."""
+        if self.gcs_store is None or self._serve_rehydrate_started:
+            return
+        if not self.gcs_store.serve_deployments:
+            return
+        self._serve_rehydrate_started = True
+        t = threading.Thread(target=self._rehydrate_serve,
+                             name="ray_tpu-serve-rehydrate", daemon=True)
+        t.start()
+
+    def _rehydrate_serve(self) -> None:
+        try:
+            from ray_tpu.serve import _redeploy_from_records
+            records = dict(self.gcs_store.serve_deployments)
+            n = _redeploy_from_records(records)
+            if n:
+                logger.warning(
+                    "serve rehydrated %d deployment(s) from gcs_store",
+                    n)
+                try:
+                    self._cluster_metrics.events.record(
+                        "serve", "serve_rehydrated", severity="info",
+                        labels={"deployments": str(n)})
+                except Exception:  # noqa: BLE001
+                    pass
+        except Exception:  # noqa: BLE001 - rehydration is best-effort;
+            # the deployments stay in the store for the next attempt.
+            logger.exception("serve rehydration failed")
 
     # ------------------------------------------------------------------
     # Object API
@@ -645,6 +807,7 @@ class Runtime:
             return
         self.store.free(oids)
         remote_frees = []
+        had_spill_uri = []
         with self._lock:
             all_conns = list(self._remote_nodes.values())
             for oid in oids:
@@ -655,7 +818,19 @@ class Runtime:
                 if rv is not None:
                     remote_frees.append(rv[1])
                     self._remote_keys.pop(rv[1], None)
-                    self._spill_uris_by_key.pop(rv[1], None)
+                    if self._spill_uris_by_key.pop(rv[1], None) \
+                            is not None:
+                        had_spill_uri.append(rv[1])
+        # Retract the durable object-directory mirror (throttled saves
+        # inside the store: a mass free coalesces to one fsync).
+        if self.gcs_store is not None:
+            try:
+                for key in had_spill_uri:
+                    self.gcs_store.remove_spill_uri(key)
+                for oid in oids:
+                    self.gcs_store.remove_object_replicas(oid.hex())
+            except OSError:
+                pass
         # Broadcast: peer daemons may hold PULLED copies of the object
         # beyond the primary (the data plane caches pulls locally), so
         # every node gets the eviction notice (reference: object pubsub
@@ -1585,6 +1760,15 @@ class Runtime:
                                 self._cfg_obj_loc_max:
                             self._object_replicas.setdefault(
                                 oid, {})[node_id] = None
+                            # Throttled durable mirror (head failover
+                            # accounting; holders are advisory after a
+                            # head restart since node ids re-mint).
+                            if self.gcs_store is not None:
+                                try:
+                                    self.gcs_store.record_object_replica(
+                                        oid.hex(), node_id.hex())
+                                except OSError:
+                                    pass
         n = spec.num_returns
         if n == 0:
             return
@@ -2859,15 +3043,31 @@ class Runtime:
         the daemon's death restores from disk instead of re-executing
         lineage (recv-thread: dict insert only). Bounded like the other
         location maps; past the cap recovery just falls down a tier."""
+        recorded = False
         with self._lock:
             if len(self._spill_uris_by_key) < self._cfg_obj_loc_max:
                 self._spill_uris_by_key[msg["key"]] = (
                     msg["uri"], int(msg.get("size", 0)))
+                recorded = True
+        # Spill URIs are the object directory's durable tier: mirror
+        # them into the gcs_store so a REBORN head can still restore
+        # from disk (head failover keeps tiered recovery working).
+        if recorded and self.gcs_store is not None:
+            try:
+                self.gcs_store.record_spill_uri(
+                    msg["key"], msg["uri"], int(msg.get("size", 0)))
+            except OSError:
+                logger.exception("could not persist spill URI")
 
     def _object_unspilled_from_node(self, conn, msg: dict) -> None:
         """Retraction: restore-promotion or a free deleted the file."""
         with self._lock:
             self._spill_uris_by_key.pop(msg["key"], None)
+        if self.gcs_store is not None:
+            try:
+                self.gcs_store.remove_spill_uri(msg["key"])
+            except OSError:
+                logger.exception("could not retract spill URI")
 
     # ------------------------------------------------------------------
     # Cluster metrics (one Prometheus scrape for the whole cluster)
@@ -3343,12 +3543,33 @@ class Runtime:
                                 for aid in stale_ids],
                 name="ray_tpu-fenced-actor-destroy", daemon=True).start()
         else:
+            unrecoverable = []
             for actor_hex in residents:
                 try:
-                    self._rebind_remote_actor(conn, node_id, actor_hex)
+                    if not self._rebind_remote_actor(conn, node_id,
+                                                     actor_hex):
+                        unrecoverable.append(actor_hex)
                 except Exception:  # noqa: BLE001 - best effort per actor
                     logger.exception("failed to rebind actor %s",
                                      actor_hex)
+            if unrecoverable and self.gcs_store is not None:
+                # Residents with no surviving record (e.g. serve
+                # replicas of the dead head's generation, whose records
+                # the recovery retired) are zombies: nothing can ever
+                # route to them again, but they'd keep holding the
+                # daemon's resources. Destroy them — deferred for the
+                # same ack-ordering reason as the fenced path above.
+                logger.warning(
+                    "Node %s announced %d resident actor(s) with no "
+                    "surviving record: destroying", node_id.hex()[:12],
+                    len(unrecoverable))
+                dead_ids = [ActorID(bytes.fromhex(h))
+                            for h in unrecoverable]
+                threading.Thread(
+                    target=lambda: [conn.destroy_actor(aid)
+                                    for aid in dead_ids],
+                    name="ray_tpu-unrecoverable-actor-destroy",
+                    daemon=True).start()
         self.scheduler.reschedule_lost_bundles()
         if dispatch:
             # NOT under the caller's conn._send_lock (the handshake path
@@ -3359,12 +3580,18 @@ class Runtime:
         return node_id
 
     def _rebind_remote_actor(self, conn, node_id: NodeID,
-                             actor_hex: str) -> None:
+                             actor_hex: str) -> bool:
+        """Rebind one daemon-announced resident actor. Returns True when
+        the resident stays valid (rebound, same-life refresh, or handled
+        another way); False means no record survives for it and the
+        caller should destroy the zombie instance."""
         from ray_tpu._private.multinode import RemoteActorInstance
         rec = (self.gcs_store.actors.get(actor_hex)
                if self.gcs_store is not None else None)
         if rec is None:
-            return  # not a persisted actor (or persistence disabled)
+            # Not a persisted actor (or persistence disabled). With a
+            # store attached, "no record" means retired/unrecoverable.
+            return self.gcs_store is None
         actor_id = ActorID(bytes.fromhex(actor_hex))
         cls_bytes = rec.get("cls_bytes")
         if cls_bytes is not None:
@@ -3382,15 +3609,19 @@ class Runtime:
                 # connection.
                 existing.instance = RemoteActorInstance(conn, actor_id)
                 existing.creation_spec._node_id = node_id  # type: ignore
-                return
+                return True
             if existing is not None:
-                return  # died in this head's eyes; do not resurrect
+                # Died in this head's eyes; do not resurrect — and tell
+                # the caller so the zombie instance is torn down.
+                return False
             name_owner = self._named_actors.get(
                 (rec["namespace"], rec["name"])) if rec["name"] else None
             if name_owner is not None and name_owner != actor_id:
                 stale = True  # handled below, outside the lock
             elif cls_bytes is None:
-                return  # unpicklable class: handles cannot be rebuilt
+                # Unpicklable class: handles cannot be rebuilt, but the
+                # instance is alive and harmless — leave it be.
+                return True
             else:
                 # Name check and registration happen under ONE lock
                 # acquisition: a concurrent create_actor can never claim
@@ -3463,7 +3694,7 @@ class Runtime:
             threading.Thread(
                 target=lambda: conn.destroy_actor(actor_id),
                 name="ray_tpu-stale-actor-destroy", daemon=True).start()
-            return
+            return True
         # The resident instance still consumes its creation resources on
         # that node — re-reserve them so the restarted head cannot
         # double-book the chips/CPUs (force: the node just (re)joined
@@ -3478,6 +3709,7 @@ class Runtime:
         logger.info("Rebound daemon-resident actor %s (%s) after head "
                     "restart", rec["name"] or actor_hex[:12],
                     actor_hex[:12])
+        return True
 
     def unregister_remote_node(self, node_id: NodeID) -> None:
         with self._lock:
@@ -4098,6 +4330,11 @@ class Runtime:
                 rec = dict(rec, status="FINISHED",
                            end_time=time.time())
                 self.gcs_store.record_job(self._gcs_job_key, rec)
+            # Land any throttled object-directory writes before exit.
+            try:
+                self.gcs_store.flush()
+            except OSError:
+                pass
         # Detached actors survive an orderly shutdown (reference: GCS-
         # owned lifetime): their host daemons are closed WITHOUT the
         # shutdown frame — the daemon treats it as connection loss,

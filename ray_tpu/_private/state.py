@@ -122,9 +122,33 @@ def status_summary() -> str:
                              + (f"(+{backlog['temp_slots']}tmp)"
                                 if backlog.get("temp_slots") else ""))
             lines.append(f"  {node_id[:12]}: " + " ".join(parts))
+    # Head incarnation + last failover recovery (gcs_store-backed):
+    # "which head life is this, and what did it replay coming up".
+    rt = global_worker.runtime
+    head_fn = getattr(rt, "head_recovery_info", None)
+    if head_fn is not None:
+        try:
+            head = head_fn()
+        except Exception:  # noqa: BLE001 - status must still answer
+            head = None
+        if head and head.get("incarnation"):
+            line = f"Head: incarnation={head['incarnation']}"
+            rec = head.get("last_recovery")
+            if rec:
+                import time as _time
+                replayed = sum((rec.get("replayed") or {}).values())
+                when = _time.strftime(
+                    "%Y-%m-%d %H:%M:%S",
+                    _time.localtime(rec.get("at", 0)))
+                line += (f" last_recovery(at={when} "
+                         f"epoch_floor={rec.get('epoch_floor', 0)} "
+                         f"replayed={replayed}")
+                if rec.get("corrupt_records"):
+                    line += f" corrupt={rec['corrupt_records']}"
+                line += ")"
+            lines.append(line)
     # Membership internals (PR 11), read-only: incarnation epoch, phi
     # suspicion, and the silence since the last liveness arrival.
-    rt = global_worker.runtime
     snap_fn = getattr(rt, "membership_snapshot", None)
     rows = snap_fn() if snap_fn is not None else []
     if rows:

@@ -149,6 +149,14 @@ def init(
             global_worker.namespace = namespace
         logging.basicConfig(level=logging_level)
         atexit.register(_atexit_shutdown)
+        # Head failover: with persisted serve deployments in the
+        # gcs_store, replay them in the background now that the worker
+        # wiring is attached (deploys run through the normal actor API).
+        try:
+            runtime.maybe_rehydrate_serve_async()
+        except Exception:  # noqa: BLE001 - rehydration is best-effort
+            logging.getLogger(__name__).exception(
+                "serve rehydration trigger failed")
         return ClientContext(global_worker)
 
 

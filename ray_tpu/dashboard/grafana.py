@@ -58,6 +58,13 @@ _DEFAULT_PANELS = [
      "ops"),
     ("Profile batches dropped / s",
      "rate(ray_tpu_profile_batches_dropped_total[5m])", "ops"),
+    ("Head recoveries", "ray_tpu_head_recoveries_total", "short"),
+    ("Head recovery records replayed (by kind)",
+     "sum by (kind) (ray_tpu_head_recovery_replayed_total)", "short"),
+    ("Daemon re-dials / s (by outcome)",
+     "sum by (outcome) (rate(ray_tpu_daemon_redials_total[5m]))", "ops"),
+    ("GCS corrupt records skipped",
+     "ray_tpu_gcs_corrupt_records_total", "short"),
     ("Serve failovers / s", "rate(ray_tpu_serve_failovers_total[5m])",
      "ops"),
     ("Serve replicas drained / s (by outcome)",

@@ -248,6 +248,47 @@ def delete(name: str) -> None:
     ray_tpu.get(controller.delete_deployment.remote(name))
 
 
+def _redeploy_from_records(records: Dict[str, dict]) -> int:
+    """Replay persisted deployment records against a fresh controller.
+
+    Head-failover rehydration (runtime._rehydrate_serve calls this on a
+    background thread after init): each record is the FULL deploy
+    payload the old head's controller persisted, so the replay is an
+    ordinary ``deploy`` — replicas needing resources from daemons that
+    have not re-registered yet simply ride the controller's bounded
+    startup retries. Returns how many deployments replayed."""
+    import logging
+
+    import cloudpickle
+
+    from ray_tpu.serve._private.controller import get_or_create_controller
+    logger = logging.getLogger("ray_tpu.serve")
+    controller = get_or_create_controller()
+    n = 0
+    for name, rec in sorted(records.items()):
+        try:
+            init_args, init_kwargs = cloudpickle.loads(
+                rec["init_payload"])
+            ray_tpu.get(controller.deploy.remote(
+                name,
+                rec["deployment_def_bytes"],
+                init_args, init_kwargs,
+                rec.get("num_replicas") or 1,
+                rec.get("ray_actor_options") or {},
+                rec.get("route_prefix"),
+                rec.get("max_concurrent_queries", 100),
+                rec.get("autoscaling_config"),
+                rec.get("version") or uuid.uuid4().hex,
+                rec.get("user_config"),
+                rec.get("max_queued_requests", -1),
+            ))
+            n += 1
+        except Exception:  # noqa: BLE001 - best effort per deployment;
+            # the record stays in the store for the next head life.
+            logger.exception("could not rehydrate deployment %r", name)
+    return n
+
+
 def shutdown() -> None:
     global _proxy, _proxy_port
     from ray_tpu.serve._private.controller import (CONTROLLER_NAME,

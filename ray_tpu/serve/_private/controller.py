@@ -152,6 +152,64 @@ class ServeController:
         if self._control_task is None or self._control_task.done():
             self._control_task = asyncio.ensure_future(self._control_loop())
 
+    # -- durable desired state (head failover) ---------------------------
+
+    def _gcs_store(self):
+        """The head runtime's gcs_store, when reachable in-process (the
+        controller is a head-resident actor). None = persistence off."""
+        try:
+            from ray_tpu._private.worker import global_worker
+            return getattr(global_worker._runtime, "gcs_store", None)
+        except Exception:  # noqa: BLE001 - no in-process runtime
+            return None
+
+    def _persist_deployment(self, info: "DeploymentInfo") -> None:
+        """Write the FULL deploy payload to the gcs_store so a head
+        reborn on the same store can replay the deploy against a fresh
+        controller (reference: serve checkpointing its desired state
+        into the GCS KV). Best-effort: unpicklable init args degrade to
+        in-memory-only desired state, logged once per deploy."""
+        store = self._gcs_store()
+        if store is None:
+            return
+        import cloudpickle
+        try:
+            payload = cloudpickle.dumps((info.init_args,
+                                         info.init_kwargs))
+        except Exception:  # noqa: BLE001 - user args may not pickle
+            logger.warning(
+                "deployment %r has unpicklable init args; it will NOT "
+                "survive a head restart", info.name)
+            return
+        try:
+            store.record_serve_deployment(info.name, {
+                "name": info.name,
+                "deployment_def_bytes": info.deployment_def_bytes,
+                "init_payload": payload,
+                "num_replicas": info.num_replicas,
+                "ray_actor_options": dict(info.ray_actor_options or {}),
+                "route_prefix": info.route_prefix,
+                "max_concurrent_queries": info.max_concurrent_queries,
+                "autoscaling_config": info.autoscaling_config,
+                "version": info.version,
+                "user_config": info.user_config,
+                "max_queued_requests": info.max_queued_requests,
+                "recorded_at": time.time(),
+            })
+        except OSError:
+            logger.exception("could not persist deployment %r",
+                             info.name)
+
+    def _unpersist_deployment(self, name: str) -> None:
+        store = self._gcs_store()
+        if store is None:
+            return
+        try:
+            store.remove_serve_deployment(name)
+        except OSError:
+            logger.exception("could not remove deployment record %r",
+                             name)
+
     def _subscribe_membership(self) -> None:
         """Subscribe to the head runtime's membership table when it is
         reachable in-process (the controller is a head-resident actor).
@@ -266,13 +324,16 @@ class ServeController:
                             [r.handle.reconfigure.remote(user_config)
                              for r in existing.replicas
                              if r.state in (STARTING, RUNNING)], None)
+                    self._persist_deployment(existing)
                     return True
+                self._persist_deployment(existing)
                 return False
             # Code or scale changed: adopt the existing replica set and
             # reconcile — the rolling path starts the new generation
             # before draining the old one (never a hard kill).
             info.replicas = existing.replicas
         self._deployments[name] = info
+        self._persist_deployment(info)
         await self._reconcile(name)
         return True
 
@@ -280,6 +341,9 @@ class ServeController:
         info = self._deployments.pop(name, None)
         if info is None:
             return False
+        # An explicit delete (or serve.shutdown) retires the durable
+        # record too — only a CRASHED head leaves records to replay.
+        self._unpersist_deployment(name)
         self._autoscale_policy.forget(name)
         # Unpublish first (routers and the proxy drop it on the push),
         # then drain in-flight work bounded by the drain window.
@@ -663,6 +727,10 @@ class ServeController:
         direction = decision.direction
         old = info.num_replicas
         info.num_replicas = decision.target
+        # The autoscaler target is desired state too: persist it so a
+        # reborn head resumes at the scaled target, not the deploy-time
+        # replica count.
+        self._persist_deployment(info)
         builtin_metrics.serve_autoscale_decisions().inc(
             tags={"deployment": info.name, "direction": direction})
         events.emit(

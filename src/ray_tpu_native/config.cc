@@ -120,6 +120,11 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     // byte budget of the unacked-frame resend ring.
     FLAG_DBL(channel_reconnect_window_s, 30.0),
     FLAG_INT(channel_resend_ring_bytes, 67108864),
+    // Head failover: how long a daemon keeps re-dialing a dead head
+    // (jittered backoff) before giving up -- the window a restarted
+    // or standby head has to replay the gcs_store and accept
+    // re-registrations.
+    FLAG_DBL(head_failover_window_s, 120.0),
     // Deferred acks: pending after channel_ack_every unacked inbound
     // frames, flushed as a pure ack after channel_ack_flush_ms unless
     // an outbound frame piggybacked it first.

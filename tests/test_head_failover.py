@@ -1,0 +1,540 @@
+"""Head failover: kill -9 the head mid-run and finish the job.
+
+Three layers, mirroring the recovery path itself:
+
+* GcsStore v2 on-disk format — record-framed, CRC-checked, atomic
+  rewrites: round-trip of every table, corruption/truncation costs only
+  the damaged records, legacy v1 files still load, and the head
+  incarnation counter survives lives.
+* Rehydration units — a fresh Runtime on a prior life's store restores
+  spill URIs, floors membership epochs, journals ``head_recovered``,
+  and replays persisted serve deployments.
+* Chaos acceptance — SIGKILL the head subprocess mid-run: the daemon
+  re-registers against a new head on the same port + store, the
+  detached actor answers with its state (exactly one incarnation), the
+  serve deployment keeps answering, and a fresh task set finishes.
+"""
+
+import json
+import os
+import pickle
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import time
+import zlib
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.gcs_store import _FRAME, _MAGIC, GcsStore
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _populated_store(path):
+    store = GcsStore(path)
+    store.kv_put("ns", b"k1", b"v1")
+    store.record_actor("aa" * 8, name="det", namespace="default",
+                      max_restarts=3, max_concurrency=1,
+                      cls_bytes=b"cls", resources={"remote": 1},
+                      lifetime="detached", num_restarts=1,
+                      creation_payload=b"args")
+    store.record_job("job-1", {"job_id": "01", "status": "RUNNING",
+                               "start_time": 1.0, "pid": 42})
+    store.record_node_epoch("bb" * 8, 7)
+    store.record_serve_deployment("Echo", {"name": "Echo",
+                                           "num_replicas": 2,
+                                           "version": "v1"})
+    store.record_spill_uri("key-1", "file:///tmp/spill/1", 123)
+    store.record_object_replica("cc" * 8, "dd" * 8)
+    store.flush()
+    return store
+
+
+# ---------------------------------------------------------------------
+# GcsStore v2 format
+# ---------------------------------------------------------------------
+
+def test_gcs_store_v2_round_trip(tmp_path):
+    path = str(tmp_path / "gcs.bin")
+    _populated_store(path)
+    with open(path, "rb") as f:
+        assert f.read(len(_MAGIC)) == _MAGIC
+
+    loaded = GcsStore(path)
+    assert loaded.had_prior_state
+    assert loaded.corrupt_records == 0
+    assert loaded.kv_get("ns", b"k1") == b"v1"
+    assert loaded.actors["aa" * 8]["lifetime"] == "detached"
+    assert loaded.actors["aa" * 8]["num_restarts"] == 1
+    assert loaded.jobs["job-1"]["status"] == "RUNNING"
+    assert loaded.node_epochs["bb" * 8] == 7
+    assert loaded.max_node_epoch() == 7
+    assert loaded.serve_deployments["Echo"]["num_replicas"] == 2
+    assert loaded.spill_uris["key-1"] == ("file:///tmp/spill/1", 123)
+    assert loaded.object_replicas["cc" * 8] == ["dd" * 8]
+
+
+def test_gcs_store_corrupt_record_skipped(tmp_path):
+    """A flipped byte inside ONE record's payload fails that record's
+    CRC; every other record still loads."""
+    path = str(tmp_path / "gcs.bin")
+    _populated_store(path)
+    with open(path, "rb") as f:
+        blob = bytearray(f.read())
+    # Find the frame whose payload decodes to the kv record and flip a
+    # byte inside that payload (framing intact, CRC now wrong).
+    off = len(_MAGIC)
+    while off < len(blob):
+        length, _crc = _FRAME.unpack_from(blob, off)
+        payload_at = off + _FRAME.size
+        payload = bytes(blob[payload_at:payload_at + length])
+        if pickle.loads(payload)[0] == "kv":
+            blob[payload_at + length // 2] ^= 0xFF
+            break
+        off = payload_at + length
+    else:
+        pytest.fail("kv record not found in store file")
+    with open(path, "wb") as f:
+        f.write(blob)
+
+    loaded = GcsStore(path)
+    assert loaded.corrupt_records == 1
+    assert loaded.kv_get("ns", b"k1") is None  # the damaged record
+    # Everything else survived.
+    assert loaded.had_prior_state
+    assert loaded.jobs["job-1"]["status"] == "RUNNING"
+    assert loaded.spill_uris["key-1"] == ("file:///tmp/spill/1", 123)
+    assert loaded.node_epochs["bb" * 8] == 7
+
+
+def test_gcs_store_truncated_tail(tmp_path):
+    """A torn write (truncated tail) loses only the final records."""
+    path = str(tmp_path / "gcs.bin")
+    _populated_store(path)
+    with open(path, "rb") as f:
+        blob = f.read()
+    with open(path, "wb") as f:
+        f.write(blob[:len(blob) - 5])
+
+    loaded = GcsStore(path)
+    assert loaded.had_prior_state
+    assert loaded.corrupt_records == 1  # the torn tail record
+    # Early records intact.
+    assert loaded.kv_get("ns", b"k1") == b"v1"
+
+
+def test_gcs_store_corruption_metric(tmp_path):
+    path = str(tmp_path / "gcs.bin")
+    _populated_store(path)
+    with open(path, "rb") as f:
+        blob = f.read()
+    with open(path, "wb") as f:
+        f.write(blob[:len(blob) - 3])
+    from ray_tpu._private import builtin_metrics
+    counter = builtin_metrics.gcs_corrupt_records()
+    before = sum(counter._series.values()) if counter._series else 0.0
+    GcsStore(path)
+    after = sum(counter._series.values())
+    assert after == before + 1
+
+
+def test_gcs_store_legacy_v1_load(tmp_path):
+    """A v1 monolithic-pickle file (pre-framing) still loads."""
+    path = str(tmp_path / "gcs.pkl")
+    v1 = {"kv": {"ns": {b"k": b"v"}},
+          "actors": {"ee" * 8: {"name": "old", "namespace": "default"}},
+          "jobs": {"j": {"status": "FINISHED"}},
+          "node_epochs": {"ff" * 8: 3}}
+    with open(path, "wb") as f:
+        pickle.dump(v1, f)
+
+    loaded = GcsStore(path)
+    assert loaded.had_prior_state
+    assert loaded.kv_get("ns", b"k") == b"v"
+    assert loaded.actors["ee" * 8]["name"] == "old"
+    assert loaded.max_node_epoch() == 3
+    # A save upgrades the file to v2 in place.
+    loaded.kv_put("ns", b"k2", b"v2")
+    with open(path, "rb") as f:
+        assert f.read(len(_MAGIC)) == _MAGIC
+    assert GcsStore(path).kv_get("ns", b"k") == b"v"
+
+
+def test_head_incarnation_counter(tmp_path):
+    path = str(tmp_path / "gcs.bin")
+    store = GcsStore(path)
+    assert store.head_incarnation() == 0
+    assert store.begin_head_incarnation() == 1
+    assert store.begin_head_incarnation(
+        {"at": 2.0, "replayed": {"kv": 1}}) == 2
+    # Both the counter and the recovery summary survive a reload.
+    loaded = GcsStore(path)
+    assert loaded.head_incarnation() == 2
+    assert loaded.last_recovery()["replayed"]["kv"] == 1
+
+
+def test_throttled_replica_saves_flush(tmp_path):
+    """Replica-holder updates coalesce (hot path) but flush() lands
+    them durably."""
+    path = str(tmp_path / "gcs.bin")
+    store = GcsStore(path)
+    store.kv_put("ns", b"seed", b"1")  # unthrottled: file exists now
+    for i in range(50):
+        store.record_object_replica(f"{i:02d}" * 8, "aa" * 8)
+    store.flush()
+    assert len(GcsStore(path).object_replicas) == 50
+
+
+# ---------------------------------------------------------------------
+# Rehydration units
+# ---------------------------------------------------------------------
+
+def test_membership_epoch_floor(tmp_path):
+    from ray_tpu._private.membership import MembershipTable
+    path = str(tmp_path / "gcs.bin")
+    store = GcsStore(path)
+    store.record_node_epoch("aa" * 8, 4)
+    store.record_node_epoch("bb" * 8, 9)
+
+    table = MembershipTable(GcsStore(path))
+    assert table.recovered_epoch_floor == 9
+    assert table.prior_node_count == 2
+    # New epochs mint strictly above every prior life's epoch.
+    assert table.mint_epoch("cc" * 8) == 10
+    # Epochs this head never minted are NOT fenced (the rebind path
+    # depends on re-registering daemons passing the fence).
+    assert not table.is_fenced(4)
+    assert not table.is_fenced(9)
+
+
+def test_runtime_recovery_rehydrates(tmp_path):
+    """A fresh runtime on a prior life's store: incarnation bumps,
+    spill URIs rejoin the live object directory, serve-generation actor
+    records are retired, and the journal carries head_recovered."""
+    store_path = str(tmp_path / "gcs.bin")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1,
+                 _system_config={"gcs_store_path": store_path})
+    from ray_tpu._private.worker import global_worker
+    rt = global_worker._runtime
+    info = rt.head_recovery_info()
+    assert info["incarnation"] == 1
+    assert info["last_recovery"] is None
+    rt.gcs_store.record_spill_uri("key-9", "file:///tmp/s9", 77)
+    rt.gcs_store.record_object_replica("ab" * 8, "cd" * 8)
+    # Stale serve-generation records from the "dead" head's life.
+    rt.gcs_store.record_actor("11" * 8, name="_serve_controller",
+                              namespace="default", max_restarts=0,
+                              max_concurrency=1, cls_bytes=b"x",
+                              resources={})
+    rt.gcs_store.record_actor("22" * 8, name="_serve_replica::Echo::1",
+                              namespace="default", max_restarts=0,
+                              max_concurrency=1, cls_bytes=b"x",
+                              resources={})
+    ray_tpu.shutdown()
+
+    ray_tpu.init(num_cpus=1,
+                 _system_config={"gcs_store_path": store_path})
+    try:
+        rt2 = global_worker._runtime
+        info = rt2.head_recovery_info()
+        assert info["incarnation"] == 2
+        assert info["recovered"]
+        rec = info["last_recovery"]
+        assert rec["replayed"]["spill_uris"] == 1
+        # Live spill table rehydrated; replica holders side-table only.
+        assert rt2._spill_uris_by_key["key-9"] == ("file:///tmp/s9", 77)
+        assert rt2._recovered_object_replicas == {"ab" * 8: ["cd" * 8]}
+        assert "ab" * 8 not in {o.hex() for o in rt2._object_replicas}
+        # Serve-generation actor records retired at recovery.
+        assert "11" * 8 not in rt2.gcs_store.actors
+        assert "22" * 8 not in rt2.gcs_store.actors
+        # Journal event with replay counts.
+        evs = [e for e in rt2.cluster_events()
+               if e.get("message") == "head_recovered"]
+        assert evs, "head_recovered never journaled"
+        assert evs[0]["labels"]["incarnation"] == "2"
+        assert evs[0]["labels"]["replayed_spill_uris"] == "1"
+        # Status surface shows the incarnation + recovery line.
+        from ray_tpu._private.state import status_summary
+        summary = status_summary()
+        assert "Head: incarnation=2" in summary
+        assert "last_recovery" in summary
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_recovery_metrics(tmp_path):
+    store_path = str(tmp_path / "gcs.bin")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=1,
+                 _system_config={"gcs_store_path": store_path})
+    from ray_tpu._private.worker import global_worker
+    global_worker._runtime.gcs_store.kv_put("ns", b"a", b"b")
+    ray_tpu.shutdown()
+
+    from ray_tpu._private import builtin_metrics
+    recoveries = builtin_metrics.head_recoveries()
+    replayed = builtin_metrics.head_recovery_replayed()
+    before = sum(recoveries._series.values()) \
+        if recoveries._series else 0.0
+    ray_tpu.init(num_cpus=1,
+                 _system_config={"gcs_store_path": store_path})
+    try:
+        assert sum(recoveries._series.values()) == before + 1
+        kinds = {tags: v for tags, v in replayed._series.items()}
+        assert any("kv" in str(t) for t in kinds), kinds
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_serve_deployments_rehydrate(tmp_path):
+    """Persisted serve deployments replay against a fresh head: deploy
+    in life 1 (records written by the controller), hard-restart the
+    runtime, and the deployment answers again in life 2 without any
+    redeploy from user code."""
+    from ray_tpu import serve
+    store_path = str(tmp_path / "gcs.bin")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"gcs_store_path": store_path})
+
+    @serve.deployment(num_replicas=1)
+    class Echo:
+        def __call__(self, x):
+            return ("echo", x)
+
+    handle = serve.run(Echo.bind())
+    assert ray_tpu.get(handle.remote(1), timeout=30) == ("echo", 1)
+    from ray_tpu._private.worker import global_worker
+    rec = global_worker._runtime.gcs_store.serve_deployments["Echo"]
+    assert rec["num_replicas"] == 1
+    assert rec["version"]
+    ray_tpu.shutdown()
+
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"gcs_store_path": store_path})
+    try:
+        deadline = time.monotonic() + 60
+        answer = None
+        while time.monotonic() < deadline:
+            try:
+                h2 = serve.get_deployment_handle("Echo")
+                answer = ray_tpu.get(h2.remote(2), timeout=10)
+                break
+            except Exception:  # noqa: BLE001 - replicas still starting
+                time.sleep(0.3)
+        assert answer == ("echo", 2), answer
+        # serve.delete retires the durable record: no replay next life.
+        serve.delete("Echo")
+        assert "Echo" not in \
+            global_worker._runtime.gcs_store.serve_deployments
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        ray_tpu.shutdown()
+
+
+def test_autoscale_target_persisted(tmp_path):
+    """The autoscaler's target lands in the durable record, so a reborn
+    head resumes at the scaled target (unit: the controller persistence
+    hook, driven directly)."""
+    store_path = str(tmp_path / "gcs.bin")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2,
+                 _system_config={"gcs_store_path": store_path})
+    try:
+        from ray_tpu import serve
+
+        @serve.deployment(num_replicas=1)
+        def f(x):
+            return x
+
+        serve.run(f.bind())
+        from ray_tpu._private.worker import global_worker
+        store = global_worker._runtime.gcs_store
+        assert store.serve_deployments["f"]["num_replicas"] == 1
+        controller = ray_tpu.get_actor("_serve_controller")
+        # Redeploy at a new scale through the public API: the record
+        # follows the desired state.
+        serve.run(f.options(num_replicas=2).bind())
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if store.serve_deployments["f"]["num_replicas"] == 2:
+                break
+            time.sleep(0.2)
+        assert store.serve_deployments["f"]["num_replicas"] == 2
+        assert controller is not None
+    finally:
+        try:
+            from ray_tpu import serve
+            serve.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        ray_tpu.shutdown()
+
+
+def test_connection_refused_classifier():
+    import errno
+
+    from ray_tpu._private.channel import connection_refused
+    assert connection_refused(ConnectionRefusedError())
+    assert connection_refused(OSError(errno.ECONNREFUSED, "refused"))
+    assert not connection_refused(OSError(errno.ETIMEDOUT, "timeout"))
+    assert not connection_refused(ConnectionResetError(
+        errno.ECONNRESET, "reset"))
+    assert not connection_refused(ValueError("nope"))
+
+
+# ---------------------------------------------------------------------
+# Chaos acceptance: SIGKILL the head mid-run, finish the job
+# ---------------------------------------------------------------------
+
+DRIVER1 = """
+import sys, time
+import ray_tpu
+from ray_tpu import serve
+
+path, port = sys.argv[1], int(sys.argv[2])
+ray_tpu.init(num_cpus=2, _system_config={"gcs_store_path": path})
+ray_tpu.start_head_server(port=port, host="127.0.0.1")
+deadline = time.monotonic() + 30
+while time.monotonic() < deadline:
+    if ray_tpu.cluster_resources().get("remote", 0) >= 3:
+        break
+    time.sleep(0.1)
+else:
+    raise TimeoutError("daemon never joined")
+
+@ray_tpu.remote(resources={"remote": 1})
+class Counter:
+    def __init__(self):
+        self.n = 0
+    def inc(self):
+        self.n += 1
+        return self.n
+
+c = Counter.options(name="survivor", lifetime="detached").remote()
+assert ray_tpu.get(c.inc.remote()) == 1
+assert ray_tpu.get(c.inc.remote()) == 2
+
+@serve.deployment(num_replicas=1)
+class Echo:
+    def __call__(self, x):
+        return ("echo", x)
+
+h = serve.run(Echo.bind())
+assert ray_tpu.get(h.remote(0), timeout=30) == ("echo", 0)
+print("READY", flush=True)
+time.sleep(3600)
+"""
+
+
+def test_head_sigkill_mid_run_job_finishes(tmp_path):
+    """The acceptance path end to end: head dies by SIGKILL with a
+    detached actor, a serve deployment, and daemon capacity in play; a
+    new head on the same port + store takes over and the job finishes —
+    actor state intact (exactly one incarnation), serve answering, and
+    a fresh batch of daemon-resource tasks completing."""
+    store = str(tmp_path / "gcs.bin")
+    port = _free_port()
+
+    driver1 = subprocess.Popen(
+        [sys.executable, "-c", DRIVER1, store, str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.multinode",
+         "--address", f"127.0.0.1:{port}", "--num-cpus", "4",
+         "--resources", json.dumps({"remote": 3})],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        line = driver1.stdout.readline()
+        assert "READY" in line, f"driver1 never came up: {line!r}"
+        assert os.path.exists(store)
+
+        # kill -9 the head mid-run.
+        driver1.send_signal(signal.SIGKILL)
+        driver1.wait(timeout=10)
+
+        # New head: same port, same store. Recovery replays the store
+        # BEFORE serving; the daemon's failover loop re-registers.
+        ray_tpu.shutdown()
+        ray_tpu.init(num_cpus=2,
+                     _system_config={"gcs_store_path": store})
+        from ray_tpu._private.worker import global_worker
+        rt = global_worker._runtime
+        info = rt.head_recovery_info()
+        assert info["incarnation"] == 2, info
+        assert info["recovered"], info
+        ray_tpu.start_head_server(port=port, host="127.0.0.1")
+
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("remote", 0) >= 3:
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError("daemon never re-registered")
+
+        # Detached actor: state intact, exactly one incarnation (the
+        # count continues from the pre-kill value — a double-running
+        # clone would answer 1).
+        deadline = time.monotonic() + 30
+        actor = None
+        while time.monotonic() < deadline:
+            try:
+                actor = ray_tpu.get_actor("survivor")
+                break
+            except ValueError:
+                time.sleep(0.2)
+        assert actor is not None, "detached actor never rebound"
+        assert ray_tpu.get(actor.inc.remote(), timeout=30) == 3
+
+        # Serve: the persisted deployment rehydrates and answers again.
+        from ray_tpu import serve
+        deadline = time.monotonic() + 90
+        answer = None
+        while time.monotonic() < deadline:
+            try:
+                h = serve.get_deployment_handle("Echo")
+                answer = ray_tpu.get(h.remote(5), timeout=10)
+                break
+            except Exception:  # noqa: BLE001 - rehydrate in flight
+                time.sleep(0.3)
+        assert answer == ("echo", 5), answer
+
+        # The pending work finishes: a task set needing the daemon's
+        # resources completes under the new head.
+        @ray_tpu.remote(resources={"remote": 1})
+        def work(i):
+            return i * i
+
+        results = ray_tpu.get([work.remote(i) for i in range(20)],
+                              timeout=120)
+        assert results == [i * i for i in range(20)]
+
+        # Recovery observability: journal event + incarnation surface.
+        evs = [e for e in rt.cluster_events()
+               if e.get("message") == "head_recovered"]
+        assert evs, "head_recovered never journaled"
+    finally:
+        for p in (driver1, daemon):
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=10)
+        try:
+            ray_tpu.shutdown()
+        except Exception:  # noqa: BLE001
+            pass

@@ -27,6 +27,9 @@ def test_defaults(config_cls):
     assert cfg.task_events_enabled is True
     assert cfg.ici_topology == ""
     assert cfg.testing_submit_delay_us == 0
+    # Head-failover knob: daemons keep re-dialing a dead head for this
+    # long (much wider than the 30s channel resume window).
+    assert cfg.head_failover_window_s == 120.0
 
 
 def test_overrides(config_cls):
