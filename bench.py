@@ -585,6 +585,82 @@ def bench_profiling_overhead() -> dict:
     return out
 
 
+def bench_flow_overhead() -> dict:
+    """Task throughput with the dataplane flow recorder ON vs OFF
+    (flow.set_enabled toggled inside one live runtime, same paired
+    on/off methodology as the profiling bench), plus the raw
+    record() rate — the per-transfer cost every pull/serve pays. The
+    `_per_sec` keys opt into the regression auto-gate; the acceptance
+    bar is <= 2% cost."""
+    import os
+    import statistics as _stats
+    import time as _time
+
+    import ray_tpu
+
+    export_key = "RAY_TPU_METRICS_EXPORT_INTERVAL_S"
+    prev = os.environ.get(export_key)
+    try:
+        os.environ[export_key] = "0.5"
+        ray_tpu.init(num_cpus=8)
+        try:
+            from ray_tpu._private import flow as _flow
+
+            @ray_tpu.remote
+            def tiny(i):
+                return i
+
+            def _tput_once(n: int = 400) -> float:
+                t0 = _time.perf_counter()
+                ray_tpu.get([tiny.remote(i) for i in range(n)])
+                return n / (_time.perf_counter() - t0)
+
+            for _ in range(5):
+                _tput_once()  # warmup / one-time init costs
+            ratios = []
+            off = 0.0
+            for r in range(50):
+                if r % 2 == 0:
+                    _flow.set_enabled(True)
+                    on_t = _tput_once()
+                    _flow.set_enabled(False)
+                    off_t = _tput_once()
+                else:
+                    _flow.set_enabled(False)
+                    off_t = _tput_once()
+                    _flow.set_enabled(True)
+                    on_t = _tput_once()
+                ratios.append(on_t / off_t)
+                off = max(off, off_t)
+            _flow.set_enabled(True)
+
+            # Raw ledger microbench: record() calls/s straight into a
+            # dedicated recorder (no transport) — the absolute cost a
+            # pull path pays per completed transfer.
+            rec = _flow.FlowRecorder(max_records=4096)
+            n = 20000
+            t0 = _time.perf_counter()
+            for i in range(n):
+                rec.record(key=f"k{i % 64}", nbytes=1 << 20,
+                           duration_s=0.01, direction="in",
+                           peer=("10.0.0.1", 9000), chunks=4,
+                           parallelism=4)
+            records = n / (_time.perf_counter() - t0)
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        if prev is None:
+            os.environ.pop(export_key, None)
+        else:
+            os.environ[export_key] = prev
+    ratio = _stats.median(ratios)
+    out = {"flow_on_tasks_per_sec": round(off * ratio, 1),
+           "flow_off_tasks_per_sec": round(off, 1)}
+    out["flow_overhead_pct"] = round(100.0 * (1.0 - ratio), 2)
+    out["flow_records_per_sec"] = round(records, 1)
+    return out
+
+
 def bench_data_shuffle() -> dict:
     """Single-host shuffle throughput (reference:
     release_tests.yaml:3447 shuffle nightly — scaled to one host): a
@@ -652,6 +728,11 @@ def bench_shuffle_multi_daemon() -> dict:
     n_blocks = max(8, min(32, int((total_bytes / (2 << 20)) ** 0.5)))
     row_bytes = 1024
     rows = total_bytes // row_bytes
+    # Fast export tick so the daemons' flow_batch frames (the per-link
+    # matrix embedded below) land head-side within the wait loop.
+    export_key = "RAY_TPU_METRICS_EXPORT_INTERVAL_S"
+    prev_export = _os.environ.get(export_key)
+    _os.environ[export_key] = "0.5"
     ray_tpu.init(num_cpus=1)  # head out of the compute: daemons do the work
     procs = []
     try:
@@ -702,9 +783,34 @@ def bench_shuffle_multi_daemon() -> dict:
         out["shuffle_multi_data_mb"] = round(total_bytes / 1e6, 1)
         out["shuffle_multi_pulled_mb"] = round(pulled / 1e6, 1)
         out["shuffle_multi_daemons"] = 2
+        # Embed the per-link flow matrix so the BENCH record answers
+        # "where did those MB/s go" per node pair. Daemon flow batches
+        # arrive on the export cadence; wait briefly for them.
+        flows = {}
+        flow_deadline = _time.monotonic() + 15
+        while _time.monotonic() < flow_deadline:
+            flows = rt.flows_snapshot()
+            if any(lk.get("bytes_total", 0) > 0
+                   for lk in flows.get("links", [])):
+                break
+            _time.sleep(0.5)
+        out["shuffle_multi_link_matrix"] = [
+            {"src": lk["src"][:12], "dst": lk["dst"][:12],
+             "mbps": round(lk["mbps"], 2),
+             "bytes_total": lk["bytes_total"],
+             "failovers": lk["failovers"], "p95_s": round(lk["p95_s"], 4)}
+            for lk in flows.get("links", [])[:8]]
+        out["shuffle_multi_top_fanout"] = [
+            {"key": o["key"][:24], "fanout": o["fanout"],
+             "bytes_total": o["bytes_total"]}
+            for o in flows.get("objects", [])[:5]]
     finally:
         _stop_procs(procs)
         ray_tpu.shutdown()
+        if prev_export is None:
+            _os.environ.pop(export_key, None)
+        else:
+            _os.environ[export_key] = prev_export
     return out
 
 
@@ -2154,6 +2260,7 @@ def main(argv=None):
          bench_alerting_overhead),
         ("profiling_overhead", "profiling_overhead_pct",
          bench_profiling_overhead),
+        ("flow_overhead", "flow_records_per_sec", bench_flow_overhead),
         ("frame_path", "frame_send_mb_per_sec", bench_frame_path),
     ]
     if on_tpu:

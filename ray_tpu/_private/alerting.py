@@ -287,6 +287,8 @@ class BurnRateRule(AlertRule):
 def builtin_rules() -> List[AlertRule]:
     """The rules every cluster ships with. Conservative thresholds —
     operators tune via ``runtime.add_alert_rule`` (same name replaces)."""
+    from ray_tpu._private import flow as _flow
+    fanout_n = _flow.configured_fanout_nodes()
     return [
         AlertRule(
             "node_down", "rate(ray_tpu_node_deaths_total) > 0",
@@ -322,6 +324,28 @@ def builtin_rules() -> List[AlertRule]:
             objective=0.05, fast_window_s=60.0, slow_window_s=300.0,
             burn_threshold=1.0, for_s=0.0, severity="error",
             message="serve system-failure rate is burning its 5% objective"),
+        # Dataplane flow plane (flow.py). The stalled gauge is
+        # synthesized BY the FlowStore: 1.0 iff a link moved bytes in
+        # the window AND its windowed MB/s is below
+        # flow_slow_link_mbps — "slow while bytes in flight" as one
+        # restamped value, so the rule resolves as soon as the link
+        # goes idle or speeds back up.
+        AlertRule(
+            "slow_link",
+            "gauge_max(ray_tpu_transfer_link_stalled, by=link) >= 1",
+            window_s=30.0, for_s=5.0, severity="warning",
+            cooldown_s=30.0,
+            message="an object-transfer link is moving bytes below the "
+                    "slow-link MB/s floor (saturated NIC? chaos?)"),
+        AlertRule(
+            "hot_object_fanout",
+            "gauge_max(ray_tpu_object_fanout_nodes, by=key) >= "
+            f"{fanout_n}",
+            window_s=60.0, for_s=0.0, severity="warning",
+            cooldown_s=60.0,
+            message=f"a single object was pulled by >={fanout_n} nodes "
+                    "in the window (broadcast amplification — consider "
+                    "a tree broadcast)"),
     ]
 
 

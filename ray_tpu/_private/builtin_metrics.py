@@ -656,6 +656,27 @@ def profile_batches_dropped() -> Counter:
         "and ride the next tick.")
 
 
+# -- dataplane flow observability ------------------------------------------
+
+
+def flow_batches_dropped() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_flow_batches_dropped_total",
+        "flow_batch publishes that failed (no live head session / full "
+        "sender); the transfer records are refunded into the "
+        "FlowRecorder and ride the next tick.")
+
+
+def transfer_inflight_bytes() -> Gauge:
+    from ray_tpu.util.metrics import Gauge
+    return Gauge(
+        "ray_tpu_transfer_inflight_bytes",
+        "Object payload bytes currently mid-pull in this process "
+        "(admission granted, body not yet landed) — the FlowRecorder's "
+        "in-flight gauge.")
+
+
 # -- alerting plane / cluster events ---------------------------------------
 
 

@@ -33,10 +33,12 @@ import os
 import socket
 import struct
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 from ray_tpu._private import builtin_metrics
 from ray_tpu._private import chaos
+from ray_tpu._private import flow as _flow
 from ray_tpu._private.channel import sock_send_parts
 
 logger = logging.getLogger(__name__)
@@ -1007,6 +1009,7 @@ class ObjectServer:
                     continue
                 # The pin spans the whole send: a concurrent free
                 # cannot recycle the region under us mid-transfer.
+                t0 = time.monotonic()
                 with self.table.pinned(key) as payload:
                     if payload is None:
                         sock.sendall(_LEN.pack(-1))
@@ -1021,7 +1024,8 @@ class ObjectServer:
                         sock, (_LEN.pack(size), memoryview(payload)))
                 self.table._bump("served_bytes", size)
                 self.table._bump("serves")
-                builtin_metrics.record_transfer_out(size)
+                self._record_serve(sock, key, size,
+                                   time.monotonic() - t0)
         except (OSError, ConnectionError, struct.error):
             pass
         finally:
@@ -1042,6 +1046,7 @@ class ObjectServer:
         except ValueError as exc:
             raise ConnectionError(f"malformed ranged request {key!r}"
                                   ) from exc
+        t0 = time.monotonic()
         with self.table.pinned(real) as payload:
             if payload is None or offset < 0 or length <= 0 or \
                     offset + length > len(payload):
@@ -1054,7 +1059,24 @@ class ObjectServer:
                        memoryview(payload)[offset:offset + length]))
         self.table._bump("served_bytes", length)
         self.table._bump("serves")
-        builtin_metrics.record_transfer_out(length)
+        self._record_serve(sock, real, length, time.monotonic() - t0)
+
+    @staticmethod
+    def _record_serve(sock: socket.socket, key: str, size: int,
+                      duration_s: float) -> None:
+        """One egress ledger entry per served request. The server only
+        knows the peer's ephemeral port, so these records aggregate
+        into per-node egress totals head-side (never matrix cells)."""
+        try:
+            peer = sock.getpeername()
+        except OSError:
+            peer = None
+        try:
+            _flow.global_flow_recorder().record(
+                key=key, nbytes=size, duration_s=duration_s,
+                direction="out", peer=peer)
+        except Exception:  # noqa: BLE001 - accounting must not kill serves
+            pass
 
     def _serve_borrow_channel(self, sock: socket.socket) -> None:
         """Channel records: '+<key>' register, '-<key>' release — both
@@ -1287,6 +1309,7 @@ def fetch_remote_bytes(addr: Tuple[str, int], key: str,
     multi-MB payload used to pay). Raises ObjectPullError when
     absent/unreachable."""
     addr = tuple(addr)
+    t0 = time.monotonic()
 
     def op(sock):
         kb = key.encode()
@@ -1298,7 +1321,13 @@ def fetch_remote_bytes(addr: Tuple[str, int], key: str,
                 f"object {key} is not resident on {addr}")
         data = _recv_exact_into(sock, bytearray(size))
         GLOBAL_PEER_CONNS.release(addr, sock)
-        builtin_metrics.record_transfer_in(size)
+        try:
+            _flow.global_flow_recorder().record(
+                key=key, nbytes=size,
+                duration_s=time.monotonic() - t0,
+                direction="in", peer=addr)
+        except Exception:  # noqa: BLE001 - accounting must not fail a pull
+            pass
         return data
 
     try:
@@ -1410,8 +1439,8 @@ def _fetch_chunk(addr: Tuple[str, int], key: str, landing: _RecvLanding,
 
 
 def _pull_chunked(addrs, key: str, table: NodeObjectTable,
-                  size: int, timeout: float, admission, priority: int
-                  ) -> bool:
+                  size: int, timeout: float, admission, priority: int,
+                  stats: Optional[dict] = None) -> bool:
     """Chunked parallel pull: split [0, size) into pull_chunk_bytes()
     ranges and fetch them concurrently over up to pull_parallelism()
     pooled sockets, each chunk landing straight in its slice of the shm
@@ -1432,6 +1461,7 @@ def _pull_chunked(addrs, key: str, table: NodeObjectTable,
     ranges = [(off, min(chunk, size - off)) for off in range(0, size, chunk)]
     if admission is not None:
         admission.acquire(size, priority)
+    _flow.global_flow_recorder().begin(size)
     landing = None
     ok = False
     # Shared failover cursor: chunk workers read the current holder and
@@ -1501,6 +1531,8 @@ def _pull_chunked(addrs, key: str, table: NodeObjectTable,
                         return
 
             nworkers = min(pull_parallelism(), len(rest))
+            if stats is not None:
+                stats["parallelism"] = max(1, nworkers)
             if nworkers <= 1:
                 fetch_worker()
             else:
@@ -1518,12 +1550,16 @@ def _pull_chunked(addrs, key: str, table: NodeObjectTable,
         ok = True
         table._bump("pulled_bytes", size)
         table._bump("pulls")
-        builtin_metrics.record_transfer_in(size)
-        builtin_metrics.record_pull_chunks(len(ranges))
+        if stats is not None:
+            stats["bytes"] = size
+            stats["chunks"] = len(ranges)
+            stats["failovers"] = stats.get("failovers", 0) + \
+                min(cur["i"], len(addrs) - 1)
         return True
     finally:
         if not ok and landing is not None:
             landing.abort()
+        _flow.global_flow_recorder().end(size)
         if admission is not None:
             admission.release(size)
 
@@ -1562,15 +1598,40 @@ def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
     # Traced only under an active sampled span (a traced task resolving
     # its args); untraced pulls pay one thread-local read.
     from ray_tpu.util import tracing
+    # One typed flow record per pull — the ledger the head aggregates
+    # into the per-link matrix. Inner paths fill `stats`; the record
+    # (and the span's transfer attributes) are stamped here, once,
+    # whether the pull landed or exhausted every holder.
+    stats = {"bytes": 0, "chunks": 1, "parallelism": 1, "failovers": 0}
+    t0 = time.monotonic()
+
+    def _finish(span, peer, outcome: str) -> None:
+        if span is not None:
+            span.attributes["bytes"] = stats["bytes"]
+            span.attributes["chunks"] = stats["chunks"]
+            span.attributes["sources_used"] = stats["failovers"] + 1
+            span.attributes["failovers"] = stats["failovers"]
+        try:
+            _flow.global_flow_recorder().record(
+                key=key, nbytes=stats["bytes"],
+                duration_s=time.monotonic() - t0, direction="in",
+                peer=peer, chunks=stats["chunks"],
+                parallelism=stats["parallelism"],
+                failovers=stats["failovers"], outcome=outcome)
+        except Exception:  # noqa: BLE001 - accounting must not fail a pull
+            pass
+
     with tracing.child_span("data::pull",
                             {"stage": "pull", "key": key,
-                             "size_hint": size_hint}):
+                             "size_hint": size_hint}) as span:
         last: Optional[BaseException] = None
         for i, cand in enumerate(candidates):
             try:
                 _pull_object_once(cand, key, table, timeout, retries,
                                   priority, size_hint,
-                                  others=candidates[i + 1:])
+                                  others=candidates[i + 1:], stats=stats)
+                stats["failovers"] += i
+                _finish(span, cand, "ok")
                 return
             except (ObjectPullError, OSError, ConnectionError,
                     struct.error) as exc:
@@ -1579,6 +1640,8 @@ def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
                     logger.info("pull of %s from %s failed (%s); failing "
                                 "over to %s", key, cand, exc,
                                 candidates[i + 1])
+        stats["failovers"] += len(candidates) - 1
+        _finish(span, candidates[0], "error")
         if isinstance(last, ObjectPullError):
             raise last
         raise ObjectPullError(
@@ -1589,7 +1652,7 @@ def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
 def _pull_object_once(addr: Tuple[str, int], key: str,
                       table: NodeObjectTable, timeout: float,
                       retries: int, priority: int, size_hint: int,
-                      others=()) -> None:
+                      others=(), stats: Optional[dict] = None) -> None:
     """One holder's pull attempt (retry/backoff loop against a single
     primary; ``others`` ride along into the chunked path for mid-pull
     chunk failover)."""
@@ -1613,7 +1676,8 @@ def _pull_object_once(addr: Tuple[str, int], key: str,
                 fell_back = False
                 if size > chunk:
                     if _pull_chunked([addr, *others], key, table, size,
-                                     timeout, admission, priority):
+                                     timeout, admission, priority,
+                                     stats=stats):
                         return
                     fell_back = True
                 # Whole-object path below; a success after a ranged
@@ -1621,14 +1685,16 @@ def _pull_object_once(addr: Tuple[str, int], key: str,
                 sock, reused = GLOBAL_PEER_CONNS.acquire(addr, timeout)
                 if chaos.ACTIVE:
                     chaos.maybe_inject("pull.send", sock)
-                _pull_whole(addr, key, table, sock, admission, priority)
+                _pull_whole(addr, key, table, sock, admission, priority,
+                            stats=stats)
                 if fell_back:
                     _ranged_unsupported.add(addr)
                 return
             sock, reused = GLOBAL_PEER_CONNS.acquire(addr, timeout)
             if chaos.ACTIVE:
                 chaos.maybe_inject("pull.send", sock)
-            _pull_whole(addr, key, table, sock, admission, priority)
+            _pull_whole(addr, key, table, sock, admission, priority,
+                        stats=stats)
             return
         except ObjectPullError:
             raise
@@ -1650,7 +1716,8 @@ def _pull_object_once(addr: Tuple[str, int], key: str,
 
 
 def _pull_whole(addr: Tuple[str, int], key: str, table: NodeObjectTable,
-                sock: socket.socket, admission, priority: int) -> None:
+                sock: socket.socket, admission, priority: int,
+                stats: Optional[dict] = None) -> None:
     """The monolithic single-socket pull: size header, then the body
     streamed into the table. The caller owns socket acquisition and
     error handling (its stale-socket retry convention)."""
@@ -1664,12 +1731,15 @@ def _pull_whole(addr: Tuple[str, int], key: str, table: NodeObjectTable,
             "(freed or evicted before the pull)")
     if admission is not None:
         admission.acquire(size, priority)
+    _flow.global_flow_recorder().begin(size)
     try:
         table.recv_into(key, size, sock)
     finally:
+        _flow.global_flow_recorder().end(size)
         if admission is not None:
             admission.release(size)
     table._bump("pulled_bytes", size)
     table._bump("pulls")
-    builtin_metrics.record_transfer_in(size)
+    if stats is not None:
+        stats["bytes"] = size
     GLOBAL_PEER_CONNS.release(addr, sock)

@@ -1,0 +1,663 @@
+"""Dataplane flow observability: per-transfer ledger + head-side
+per-link bandwidth matrix.
+
+The cluster already counts transfer bytes as ONE scalar
+(``ray_tpu_object_transfer_bytes_total{direction}``) — enough to know
+the dataplane moved data, useless for knowing *which link* carried it.
+This module is the missing accounting (reference: Ray's object manager
+keeps exactly this per-transfer bookkeeping inside its pull manager /
+PushManager to drive pull scheduling):
+
+* :class:`FlowRecorder` — one per process, passive (no thread). Every
+  object transfer the dataplane completes (pull, chunked pull, ranged
+  serve, spill restore) calls :meth:`FlowRecorder.record` with one
+  typed flow record ``{key, bytes, src, dst, duration, chunks,
+  parallelism, failovers, tier, outcome}``. Records buffer in a
+  bounded deque and ship on the existing metrics cadence as additive
+  ``flow_batch`` push frames (same drain/refund contract as PR 14's
+  profile windows: a failed publish refunds the records, drops are
+  counted in ``ray_tpu_flow_batches_dropped_total``). The recorder is
+  ALSO the single place the cluster-scalar fast counters
+  (``record_transfer_in/out``, ``record_pull_chunks``) get bumped —
+  an AST lint bans those calls elsewhere in ``_private/`` so future
+  dataplane paths cannot silently bypass the ledger.
+
+* :class:`FlowStore` — head-side aggregate (bounded, membership-aware
+  like ProfileStore): a per-link matrix keyed ``(src_node, dst_node)``
+  with windowed MB/s, p95 transfer latency, chunk/failover/error
+  counts, plus a per-object fan-out table surfacing broadcast
+  amplification (one object pulled by N nodes = the O(N) sends a
+  tree broadcast would collapse). The store synthesizes queryable
+  series into the head's :class:`TimeSeriesStore` —
+  ``ray_tpu_transfer_link_bytes_total{src,dst}`` (+ chunk/failover
+  counters), ``ray_tpu_transfer_link_mbps{link}``,
+  ``ray_tpu_transfer_link_stalled{link}`` and
+  ``ray_tpu_object_fanout_nodes{key}`` — restamped every publish tick
+  (zero when idle) so the ``slow_link`` / ``hot_object_fanout`` alert
+  rules both fire AND resolve promptly.
+
+Attribution: the PULLER knows both ends of a transfer (its own node +
+the holder address it pulled from), so link cells are built from
+pull-side records; ``FlowStore.note_node`` learns each node's object
+server address at registration to resolve ``host:port`` → node id.
+Serve-side records carry only the peer's ephemeral port, so they
+aggregate into per-node egress totals instead of inventing half-blind
+matrix cells.
+
+Knobs (``RAY_TPU_FLOW_*`` env > runtime flag table > default):
+``flow_max_records`` (per-process buffer, 0 disables recording),
+``flow_window_s``, ``flow_max_links``, ``flow_max_objects``,
+``flow_slow_link_mbps``, ``flow_fanout_nodes``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_MAX_RECORDS = 4096
+DEFAULT_WINDOW_S = 60.0
+DEFAULT_MAX_LINKS = 512
+DEFAULT_MAX_OBJECTS = 512
+DEFAULT_SLOW_LINK_MBPS = 1.0
+DEFAULT_FANOUT_NODES = 8
+#: Dead-node link state is evicted this long after the death push
+#: (matches ProfileStore/TimeSeriesStore staleness semantics).
+DEFAULT_STALENESS_S = 30.0
+
+TIERS = ("replica", "spill", "inline")
+OUTCOMES = ("ok", "error")
+
+
+def _cfg(env: str, flag: str, default):
+    """Env spelling first (documented RAY_TPU_FLOW_*), then the live
+    flag table (runtime config > env > default) — the same precedence
+    every observability plane uses."""
+    raw = os.environ.get(env, "")
+    if raw:
+        try:
+            return type(default)(float(raw)) if not isinstance(
+                default, str) else raw
+        except (TypeError, ValueError):
+            pass
+    from ray_tpu._private.ray_config import runtime_config_value
+    return runtime_config_value(flag, default)
+
+
+def configured_max_records() -> int:
+    return int(_cfg("RAY_TPU_FLOW_MAX_RECORDS", "flow_max_records",
+                    DEFAULT_MAX_RECORDS))
+
+
+def configured_window_s() -> float:
+    return float(_cfg("RAY_TPU_FLOW_WINDOW_S", "flow_window_s",
+                      DEFAULT_WINDOW_S))
+
+
+def configured_max_links() -> int:
+    return int(_cfg("RAY_TPU_FLOW_MAX_LINKS", "flow_max_links",
+                    DEFAULT_MAX_LINKS))
+
+
+def configured_max_objects() -> int:
+    return int(_cfg("RAY_TPU_FLOW_MAX_OBJECTS", "flow_max_objects",
+                    DEFAULT_MAX_OBJECTS))
+
+
+def configured_slow_link_mbps() -> float:
+    return float(_cfg("RAY_TPU_FLOW_SLOW_LINK_MBPS",
+                      "flow_slow_link_mbps", DEFAULT_SLOW_LINK_MBPS))
+
+
+def configured_fanout_nodes() -> int:
+    return int(_cfg("RAY_TPU_FLOW_FANOUT_NODES", "flow_fanout_nodes",
+                    DEFAULT_FANOUT_NODES))
+
+
+def _addr_str(addr) -> str:
+    if not addr:
+        return ""
+    if isinstance(addr, (tuple, list)) and len(addr) == 2:
+        return f"{addr[0]}:{addr[1]}"
+    return str(addr)
+
+
+# ---------------------------------------------------------------------------
+# Per-process recorder
+# ---------------------------------------------------------------------------
+
+
+class FlowRecorder:
+    """Bounded per-process transfer ledger with drain/refund shipping
+    semantics. Passive: no thread, no timer — the process's existing
+    MetricsAgent drains it on the export cadence."""
+
+    def __init__(self, max_records: Optional[int] = None):
+        self.max_records = (configured_max_records()
+                            if max_records is None else int(max_records))
+        self.enabled = self.max_records > 0
+        self._lock = threading.Lock()
+        self._records: deque = deque()
+        self.dropped = 0  # records squeezed out by the buffer bound
+        self._inflight = 0  # bytes currently mid-transfer (pull side)
+
+    # -- in-flight gauge ------------------------------------------------
+
+    def begin(self, nbytes: int) -> None:
+        """A transfer of ``nbytes`` entered flight (admission granted)."""
+        with self._lock:
+            self._inflight += max(0, int(nbytes))
+        self._set_inflight_gauge()
+
+    def end(self, nbytes: int) -> None:
+        with self._lock:
+            self._inflight = max(0, self._inflight - max(0, int(nbytes)))
+        self._set_inflight_gauge()
+
+    def _set_inflight_gauge(self) -> None:
+        try:
+            from ray_tpu._private import builtin_metrics
+            builtin_metrics.transfer_inflight_bytes().set(self._inflight)
+        except Exception:  # noqa: BLE001 - accounting must not fail a pull
+            pass
+
+    @property
+    def inflight_bytes(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    # -- the ledger -----------------------------------------------------
+
+    def record(self, *, key: str, nbytes: int, duration_s: float,
+               direction: str, peer: Any = None, chunks: int = 1,
+               parallelism: int = 1, failovers: int = 0,
+               tier: str = "replica", outcome: str = "ok") -> None:
+        """One completed (or terminally failed) object transfer.
+
+        This is the SINGLE place the cluster-scalar transfer fast
+        counters get bumped (lint-enforced), so the per-link ledger and
+        the existing ``object_transfer_bytes`` metric can never drift
+        apart. Failed transfers land in the ledger with
+        ``outcome="error"`` but bump no byte counters — no bytes moved.
+        """
+        if tier not in TIERS:
+            raise ValueError(f"unknown flow tier {tier!r} "
+                             f"(one of {', '.join(TIERS)})")
+        if outcome not in OUTCOMES:
+            raise ValueError(f"unknown flow outcome {outcome!r} "
+                             f"(one of {', '.join(OUTCOMES)})")
+        nbytes = int(nbytes)
+        chunks = max(1, int(chunks))
+        if outcome == "ok":
+            try:
+                from ray_tpu._private import builtin_metrics
+                if direction == "in":
+                    builtin_metrics.record_transfer_in(nbytes)
+                    if chunks > 1:
+                        builtin_metrics.record_pull_chunks(chunks)
+                else:
+                    builtin_metrics.record_transfer_out(nbytes)
+            except Exception:  # noqa: BLE001 - accounting only
+                pass
+        if not self.enabled:
+            return
+        peer_s = _addr_str(peer)
+        rec = {
+            "key": str(key),
+            "bytes": nbytes,
+            "src": peer_s if direction == "in" else "",
+            "dst": peer_s if direction == "out" else "",
+            "duration": float(max(0.0, duration_s)),
+            "chunks": chunks,
+            "parallelism": max(1, int(parallelism)),
+            "failovers": max(0, int(failovers)),
+            "tier": tier,
+            "direction": direction,
+            "outcome": outcome,
+        }
+        with self._lock:
+            self._records.append(rec)
+            while len(self._records) > self.max_records:
+                self._records.popleft()
+                self.dropped += 1
+
+    def drain(self) -> Optional[List[dict]]:
+        """Return-and-clear the buffered records (``None`` when empty)."""
+        with self._lock:
+            if not self._records:
+                return None
+            out = list(self._records)
+            self._records.clear()
+        return out
+
+    def refund(self, records: List[dict]) -> None:
+        """Put a failed publish's records back at the FRONT so order is
+        kept; the bound still applies (oldest squeezed out, counted)."""
+        if not records:
+            return
+        with self._lock:
+            self._records.extendleft(reversed(records))
+            while len(self._records) > self.max_records:
+                self._records.popleft()
+                self.dropped += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"buffered": len(self._records),
+                    "dropped": self.dropped,
+                    "inflight_bytes": self._inflight,
+                    "enabled": self.enabled,
+                    "max_records": self.max_records}
+
+
+_recorder_lock = threading.Lock()
+_recorder: Optional[FlowRecorder] = None
+
+
+def global_flow_recorder() -> FlowRecorder:
+    """The process-wide recorder (created on first use; recording is a
+    no-op beyond the fast counters when ``flow_max_records <= 0``)."""
+    global _recorder
+    rec = _recorder
+    if rec is None:
+        with _recorder_lock:
+            rec = _recorder
+            if rec is None:
+                rec = _recorder = FlowRecorder()
+    return rec
+
+
+def set_enabled(enabled: bool) -> None:
+    """Flip recording live (bench on/off arms; the buffer is kept)."""
+    rec = global_flow_recorder()
+    rec.enabled = bool(enabled) and rec.max_records > 0
+
+
+def shutdown_flow_recorder() -> None:
+    """Drop the singleton (tests re-reading knobs)."""
+    global _recorder
+    with _recorder_lock:
+        _recorder = None
+
+
+# ---------------------------------------------------------------------------
+# Head-side store
+# ---------------------------------------------------------------------------
+
+
+class _Link:
+    """One directed matrix cell (src_node -> dst_node)."""
+
+    __slots__ = ("bytes_total", "records_total", "chunks_total",
+                 "failovers_total", "errors_total", "samples",
+                 "last_seen", "dead_at")
+
+    def __init__(self):
+        self.bytes_total = 0
+        self.records_total = 0
+        self.chunks_total = 0
+        self.failovers_total = 0
+        self.errors_total = 0
+        #: (t, bytes, duration_s) per record, trimmed to the window.
+        self.samples: deque = deque()
+        self.last_seen = time.monotonic()
+        self.dead_at: Optional[float] = None
+
+    def trim(self, now: float, window: float) -> None:
+        cutoff = now - window
+        while self.samples and self.samples[0][0] < cutoff:
+            self.samples.popleft()
+
+    def windowed(self, now: float, window: float) -> Tuple[int, float]:
+        """(window_bytes, mbps) over ``window`` seconds."""
+        self.trim(now, window)
+        wbytes = sum(s[1] for s in self.samples)
+        return wbytes, (wbytes / window) / (1024.0 * 1024.0)
+
+    def p95_s(self) -> float:
+        durs = sorted(s[2] for s in self.samples)
+        if not durs:
+            return 0.0
+        return durs[min(len(durs) - 1, int(0.95 * len(durs)))]
+
+
+class _ObjectFanout:
+    """One object's pull fan-out: which nodes pulled it, how much."""
+
+    __slots__ = ("nodes", "bytes_total", "pulls", "last_seen")
+
+    def __init__(self):
+        self.nodes: Dict[str, float] = {}  # dst node -> last pull ts
+        self.bytes_total = 0
+        self.pulls = 0
+        self.last_seen = time.monotonic()
+
+    def fanout(self, now: float, window: float) -> int:
+        cutoff = now - window
+        return sum(1 for t in self.nodes.values() if t >= cutoff)
+
+
+class FlowStore:
+    """Bounded head-side aggregation of flow records into a per-link
+    matrix + per-object fan-out table, with membership-driven eviction
+    and TimeSeriesStore series synthesis."""
+
+    #: Minimum seconds between series publishes on the passive
+    #: (ClusterMetrics.update) path; flow-batch arrivals publish
+    #: immediately.
+    PUBLISH_MIN_INTERVAL_S = 1.0
+
+    def __init__(self, window_s: Optional[float] = None,
+                 max_links: Optional[int] = None,
+                 max_objects: Optional[int] = None,
+                 staleness: float = DEFAULT_STALENESS_S,
+                 slow_link_mbps: Optional[float] = None):
+        self.window_s = max(1.0, configured_window_s()
+                            if window_s is None else float(window_s))
+        self.max_links = (configured_max_links() if max_links is None
+                          else int(max_links))
+        self.max_objects = (configured_max_objects()
+                            if max_objects is None else int(max_objects))
+        self.staleness = staleness
+        self.slow_link_mbps = (configured_slow_link_mbps()
+                               if slow_link_mbps is None
+                               else float(slow_link_mbps))
+        self._lock = threading.Lock()
+        self._links: "OrderedDict[Tuple[str, str], _Link]" = OrderedDict()
+        self._objects: "OrderedDict[str, _ObjectFanout]" = OrderedDict()
+        #: object-server "host:port" -> node id hex (taught by the
+        #: runtime at node registration; the puller records addresses).
+        self._addr_to_node: Dict[str, str] = {}
+        #: per-node egress/ingress byte totals (serve-side records land
+        #: here — the server only knows the peer's ephemeral port).
+        self._egress: Dict[str, int] = {}
+        self._ingress: Dict[str, int] = {}
+        self.dropped_links = 0
+        self.dropped_objects = 0
+        self.batches = 0
+        self.records = 0
+        self._last_publish = 0.0
+        #: gauge label sets stamped last publish — restamped to 0 once
+        #: after going idle so alert groups resolve instead of pinning
+        #: on a stale last value.
+        self._published_links: set = set()
+        self._published_keys: set = set()
+
+    # -- identity -------------------------------------------------------
+
+    def note_node(self, node_id_hex: str, object_addr) -> None:
+        """Teach the store a node's object-server address (registration
+        time) so pull records' holder addresses resolve to node ids."""
+        addr = _addr_str(object_addr)
+        if addr and node_id_hex:
+            with self._lock:
+                self._addr_to_node[addr] = node_id_hex
+
+    def _resolve(self, addr: str) -> str:
+        return self._addr_to_node.get(addr, addr)
+
+    # -- ingest ---------------------------------------------------------
+
+    def ingest(self, node_id: str, batch: dict) -> None:
+        """Merge one ``flow_batch`` (origin ``node_id`` is the emitting
+        process's node — the dst of its pulls, the src of its serves)."""
+        records = batch.get("records") or []
+        if not records:
+            return
+        now = time.monotonic()
+        node = node_id or ""
+        with self._lock:
+            self.batches += 1
+            for rec in records:
+                if not isinstance(rec, dict):
+                    continue
+                self.records += 1
+                nbytes = int(rec.get("bytes") or 0)
+                ok = rec.get("outcome") != "error"
+                if rec.get("direction") == "out":
+                    if nbytes and ok:
+                        self._egress[node] = \
+                            self._egress.get(node, 0) + nbytes
+                    continue
+                if nbytes and ok:
+                    self._ingress[node] = \
+                        self._ingress.get(node, 0) + nbytes
+                # Fan-out is tracked BEFORE the link-cap gate: a hot
+                # object stays visible even when its cells were
+                # squeezed out of a full matrix.
+                key = str(rec.get("key") or "")
+                if key and ok:
+                    self._touch_object(key, node, nbytes, now)
+                src = self._resolve(str(rec.get("src") or "")) \
+                    or "unknown"
+                link = self._link_for(src, node)
+                if link is None:
+                    continue
+                link.last_seen = now
+                link.records_total += 1
+                link.chunks_total += max(1, int(rec.get("chunks") or 1))
+                link.failovers_total += int(rec.get("failovers") or 0)
+                if not ok:
+                    link.errors_total += 1
+                else:
+                    link.bytes_total += nbytes
+                    link.samples.append(
+                        (now, nbytes, float(rec.get("duration") or 0.0)))
+                link.trim(now, self.window_s)
+
+    def _link_for(self, src: str, dst: str) -> Optional[_Link]:
+        lk = (src, dst)
+        link = self._links.get(lk)
+        if link is None:
+            if len(self._links) >= self.max_links:
+                self.dropped_links += 1
+                return None
+            link = self._links[lk] = _Link()
+        self._links.move_to_end(lk)
+        return link
+
+    def _touch_object(self, key: str, node: str, nbytes: int,
+                      now: float) -> None:
+        obj = self._objects.get(key)
+        if obj is None:
+            while len(self._objects) >= self.max_objects:
+                self._objects.popitem(last=False)  # LRU
+                self.dropped_objects += 1
+            obj = self._objects[key] = _ObjectFanout()
+        self._objects.move_to_end(key)
+        obj.nodes[node] = now
+        obj.bytes_total += nbytes
+        obj.pulls += 1
+        obj.last_seen = now
+
+    # -- membership / bounds --------------------------------------------
+
+    def mark_node_dead(self, node_id: str) -> None:
+        """Start the staleness clock for every link touching the node
+        (same contract as ProfileStore/TimeSeriesStore: agents restamp
+        live state, dead state ages out)."""
+        now = time.monotonic()
+        with self._lock:
+            for (src, dst), link in self._links.items():
+                if node_id in (src, dst) and link.dead_at is None:
+                    link.dead_at = now
+            stale = [a for a, n in self._addr_to_node.items()
+                     if n == node_id]
+            for a in stale:
+                del self._addr_to_node[a]
+
+    def evict_stale(self) -> None:
+        now = time.monotonic()
+        idle_horizon = max(4 * self.window_s, 300.0)
+        with self._lock:
+            doomed = [k for k, l in self._links.items()
+                      if (l.dead_at is not None
+                          and now - l.dead_at > self.staleness)
+                      or now - l.last_seen > idle_horizon]
+            for k in doomed:
+                del self._links[k]
+            gone = [k for k, o in self._objects.items()
+                    if now - o.last_seen > idle_horizon]
+            for k in gone:
+                del self._objects[k]
+
+    # -- series synthesis ----------------------------------------------
+
+    def maybe_publish(self, ts) -> None:
+        """Throttled restamp on the passive update cadence — keeps the
+        link/fanout gauges decaying toward zero while traffic is idle,
+        which is what lets ``slow_link``/``hot_object_fanout`` resolve."""
+        now = time.monotonic()
+        if now - self._last_publish < self.PUBLISH_MIN_INTERVAL_S:
+            return
+        self.publish_series(ts)
+
+    def publish_series(self, ts) -> None:
+        """Synthesize the link/fan-out series into the head
+        TimeSeriesStore (origin component="flow"). Counters are
+        cumulative store totals; gauges are windowed and restamped
+        EVERY publish (idle => 0) so alert groups go quiet by value,
+        not by series eviction."""
+        now = time.monotonic()
+        self._last_publish = now
+        with self._lock:
+            bytes_series: Dict[tuple, float] = {}
+            chunk_series: Dict[tuple, float] = {}
+            failover_series: Dict[tuple, float] = {}
+            mbps_series: Dict[tuple, float] = {}
+            stalled_series: Dict[tuple, float] = {}
+            live_links: set = set()
+            for (src, dst), link in self._links.items():
+                skey = (src, dst)
+                bytes_series[skey] = float(link.bytes_total)
+                chunk_series[skey] = float(link.chunks_total)
+                failover_series[skey] = float(link.failovers_total)
+                wbytes, mbps = link.windowed(now, self.window_s)
+                lkey = (f"{src}->{dst}",)
+                live_links.add(lkey)
+                mbps_series[lkey] = mbps
+                stalled_series[lkey] = float(
+                    wbytes > 0 and mbps < self.slow_link_mbps)
+            fanout_series: Dict[tuple, float] = {}
+            live_keys: set = set()
+            for key, obj in self._objects.items():
+                kkey = (key,)
+                live_keys.add(kkey)
+                fanout_series[kkey] = float(
+                    obj.fanout(now, self.window_s))
+            # One final 0 for label sets that fell out of the store so
+            # their alert groups read idle, then stop stamping them.
+            for lkey in self._published_links - live_links:
+                mbps_series[lkey] = 0.0
+                stalled_series[lkey] = 0.0
+            for kkey in self._published_keys - live_keys:
+                fanout_series[kkey] = 0.0
+            self._published_links = live_links
+            self._published_keys = live_keys
+        entries = [
+            {"name": "ray_tpu_transfer_link_bytes_total",
+             "type": "counter", "tag_keys": ("src", "dst"),
+             "series": bytes_series},
+            {"name": "ray_tpu_transfer_link_chunks_total",
+             "type": "counter", "tag_keys": ("src", "dst"),
+             "series": chunk_series},
+            {"name": "ray_tpu_transfer_link_failovers_total",
+             "type": "counter", "tag_keys": ("src", "dst"),
+             "series": failover_series},
+            {"name": "ray_tpu_transfer_link_mbps", "type": "gauge",
+             "tag_keys": ("link",), "series": mbps_series},
+            {"name": "ray_tpu_transfer_link_stalled", "type": "gauge",
+             "tag_keys": ("link",), "series": stalled_series},
+            {"name": "ray_tpu_object_fanout_nodes", "type": "gauge",
+             "tag_keys": ("key",), "series": fanout_series},
+        ]
+        entries = [e for e in entries if e["series"]]
+        if entries:
+            ts.ingest_batch("", 0, "flow", entries, now=now)
+
+    # -- reads ----------------------------------------------------------
+
+    def snapshot(self, window: Optional[float] = None) -> dict:
+        """The `/api/flows` / `ray-tpu xfer` document: link matrix rows
+        (MB/s windowed), fan-out rows, per-node egress/ingress, store
+        stats."""
+        now = time.monotonic()
+        w = self.window_s if window is None else max(1.0, float(window))
+        with self._lock:
+            links = []
+            for (src, dst), link in self._links.items():
+                wbytes, mbps = link.windowed(now, min(w, self.window_s))
+                links.append({
+                    "src": src, "dst": dst,
+                    "mbps": mbps,
+                    "window_bytes": wbytes,
+                    "bytes_total": link.bytes_total,
+                    "records": link.records_total,
+                    "chunks": link.chunks_total,
+                    "failovers": link.failovers_total,
+                    "errors": link.errors_total,
+                    "p95_s": link.p95_s(),
+                    "age_s": max(0.0, now - link.last_seen),
+                })
+            objects = []
+            for key, obj in self._objects.items():
+                objects.append({
+                    "key": key,
+                    "fanout": obj.fanout(now, min(w, self.window_s)),
+                    "nodes": sorted(obj.nodes),
+                    "bytes_total": obj.bytes_total,
+                    "pulls": obj.pulls,
+                    "age_s": max(0.0, now - obj.last_seen),
+                })
+            out = {
+                "window_s": min(w, self.window_s),
+                "links": sorted(links, key=lambda r: -r["mbps"]),
+                "objects": sorted(objects,
+                                  key=lambda r: (-r["fanout"],
+                                                 -r["bytes_total"])),
+                "egress": dict(self._egress),
+                "ingress": dict(self._ingress),
+                "stats": {
+                    "links": len(self._links),
+                    "objects": len(self._objects),
+                    "dropped_links": self.dropped_links,
+                    "dropped_objects": self.dropped_objects,
+                    "batches": self.batches,
+                    "records": self.records,
+                },
+            }
+        return out
+
+    def summary_line(self) -> dict:
+        """The compact `ray-tpu top` transfer line: total windowed MB/s,
+        active link count, hottest link, max fan-out."""
+        snap = self.snapshot()
+        active = [r for r in snap["links"] if r["window_bytes"] > 0]
+        top = active[0] if active else None
+        hot = snap["objects"][0] if snap["objects"] else None
+        return {
+            "mbps_total": sum(r["mbps"] for r in active),
+            "links_active": len(active),
+            "top_link": (None if top is None else {
+                "src": top["src"], "dst": top["dst"],
+                "mbps": top["mbps"]}),
+            "max_fanout": (None if hot is None or hot["fanout"] < 2
+                           else {"key": hot["key"],
+                                 "fanout": hot["fanout"]}),
+        }
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "links": len(self._links),
+                "objects": len(self._objects),
+                "dropped_links": self.dropped_links,
+                "dropped_objects": self.dropped_objects,
+                "batches": self.batches,
+                "records": self.records,
+                "window_s": self.window_s,
+                "slow_link_mbps": self.slow_link_mbps,
+            }

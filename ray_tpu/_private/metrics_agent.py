@@ -79,7 +79,8 @@ class MetricsAgent:
     def __init__(self, publish: Callable[[dict], bool], *,
                  component: str, interval_s: Optional[float] = None,
                  start: bool = True,
-                 publish_profile: Optional[Callable[[dict], bool]] = None):
+                 publish_profile: Optional[Callable[[dict], bool]] = None,
+                 publish_flow: Optional[Callable[[dict], bool]] = None):
         self._publish = publish
         self.component = component
         self.pid = os.getpid()
@@ -95,6 +96,10 @@ class MetricsAgent:
         if publish_profile is not None:
             from ray_tpu._private import profiling
             self._profiler = profiling.ensure_profiler(component)
+        # Dataplane flow ledger rides the same cadence: the process's
+        # FlowRecorder is drained into `publish_flow` every tick, with
+        # refund-on-drop so transfer records are never silently lost.
+        self._publish_flow = publish_flow
         # Every agent folds the hot-path fast cells before snapshotting,
         # so built-in counters bumped via dict adds reach the registry.
         from ray_tpu._private import builtin_metrics
@@ -162,6 +167,7 @@ class MetricsAgent:
             spans, self._span_cursor = _tracing.drain_finished_spans(
                 self._span_cursor)
             self._maybe_publish_profile()
+            self._maybe_publish_flow()
             # Cluster events ride the same frames as metrics (the
             # EventStats piggyback pattern): drain this process's
             # pending buffer into the batch, refund on a dropped frame.
@@ -207,6 +213,34 @@ class MetricsAgent:
             self._profiler.refund(window["stacks"])
             try:
                 builtin_metrics.profile_batches_dropped().inc()
+            except Exception:  # noqa: BLE001 - counter is best-effort
+                pass
+
+    def _maybe_publish_flow(self) -> None:
+        """Drain the process FlowRecorder into its transport. A dropped
+        frame refunds the records into the buffer (they ride the next
+        tick) and bumps the drop counter — transfer accounting is never
+        silently lost."""
+        if self._publish_flow is None:
+            return
+        from ray_tpu._private import flow
+        try:
+            records = flow.global_flow_recorder().drain()
+        except Exception:  # noqa: BLE001 - flow plane is best-effort
+            return
+        if not records:
+            return
+        batch = {"pid": self.pid, "component": self.component,
+                 "records": records}
+        try:
+            sent = bool(self._publish_flow(batch))
+        except Exception:  # noqa: BLE001 - transport must not kill polls
+            sent = False
+        if not sent:
+            from ray_tpu._private import builtin_metrics
+            flow.global_flow_recorder().refund(records)
+            try:
+                builtin_metrics.flow_batches_dropped().inc()
             except Exception:  # noqa: BLE001 - counter is best-effort
                 pass
 
@@ -268,6 +302,11 @@ class ClusterMetrics:
         from ray_tpu._private.alerting import AlertEngine
         self.events = EventJournal()
         self.alerts = AlertEngine(journal=self.events)
+        # Dataplane flow plane: flow_batch frames land here; the store
+        # keeps the per-link matrix / fan-out table and restamps its
+        # synthesized series into the time-series store each merge tick.
+        from ray_tpu._private.flow import FlowStore
+        self.flows = FlowStore()
 
     def update(self, node_id: str, batch: Dict[str, Any]) -> None:
         """Merge one ``metrics_batch`` payload. Cumulative values make the
@@ -334,6 +373,13 @@ class ClusterMetrics:
         events = batch.get("events")
         if events:
             self.events.ingest(node_id or "", events)
+        # Restamp flow gauges (link mbps / stalled / fan-out) on the
+        # merge cadence so idle links decay to zero and alert rules see
+        # fresh values even when no new flow_batch arrives.
+        try:
+            self.flows.maybe_publish(self.timeseries)
+        except Exception:  # noqa: BLE001 - flow plane must not break merges
+            logger.exception("flow series publish failed")
         try:
             self.alerts.maybe_evaluate(self.timeseries)
         except Exception:  # noqa: BLE001 - alerting must not break merges
@@ -354,6 +400,12 @@ class ClusterMetrics:
             batch.get("stacks") or {},
             samples=int(batch.get("samples", 0)))
 
+    def update_flows(self, node_id: str, batch: Dict[str, Any]) -> None:
+        """Merge one ``flow_batch`` payload into the flow store and
+        restamp its synthesized series immediately (throttled inside)."""
+        self.flows.ingest(node_id or "", batch)
+        self.flows.maybe_publish(self.timeseries)
+
     def mark_node_dead(self, node_id: str) -> None:
         """Start the staleness clock for every origin of a dead node; the
         series stay scrapeable through the window (Prometheus gets a last
@@ -365,6 +417,7 @@ class ClusterMetrics:
                     origin.dead_at = now
         self.timeseries.mark_node_dead(node_id)
         self.profiles.mark_node_dead(node_id)
+        self.flows.mark_node_dead(node_id)
 
     def evict_stale(self) -> None:
         now = time.monotonic()
@@ -376,6 +429,7 @@ class ClusterMetrics:
                 del self._origins[key]
         self.timeseries.evict_stale()
         self.profiles.evict_stale()
+        self.flows.evict_stale()
 
     def cluster_event_stats(self) -> Dict[str, Dict[str, Any]]:
         """EventStats summaries shipped in metrics_batch frames, keyed

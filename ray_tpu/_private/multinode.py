@@ -467,6 +467,7 @@ class NodeConnection:
         self.on_log_batch = None
         self.on_metrics_batch = None
         self.on_profile_batch = None
+        self.on_flow_batch = None
         self.on_object_spilled = None
         self.on_object_unspilled = None
         # Dedicated liveness socket (see HeadServer._health_check_loop):
@@ -601,14 +602,15 @@ class NodeConnection:
                 for reply in replies:
                     kind = reply.get("type")
                     if kind in ("log_batch", "metrics_batch",
-                                "profile_batch", "object_spilled",
-                                "object_unspilled"):
+                                "profile_batch", "flow_batch",
+                                "object_spilled", "object_unspilled"):
                         # Daemon-initiated push, not a reply: hand to
                         # the runtime's fan-out and move on.
                         handler = {
                             "log_batch": self.on_log_batch,
                             "metrics_batch": self.on_metrics_batch,
                             "profile_batch": self.on_profile_batch,
+                            "flow_batch": self.on_flow_batch,
                             "object_spilled": self.on_object_spilled,
                             "object_unspilled": self.on_object_unspilled,
                         }[kind]
@@ -862,12 +864,32 @@ class NodeConnection:
 
     def fetch_object(self, key: str,
                      timeout: Optional[float] = None) -> bytes:
+        t0 = _monotonic()
         reply = self._request({"type": "fetch_object", "key": key},
                               timeout=timeout)
+        from ray_tpu._private import flow
         if not reply["ok"]:
+            try:
+                flow.global_flow_recorder().record(
+                    key=key, nbytes=0, duration_s=_monotonic() - t0,
+                    direction="in",
+                    peer=self.object_addr or self.address,
+                    outcome="error")
+            except Exception:  # noqa: BLE001 - accounting only
+                pass
             exc, remote_tb = _loads(reply["error"])
             raise exc
         self.head_fetch_bytes += len(reply["raw"])
+        # Head-side fetches ride the session channel, not the dataplane
+        # pull path — they are object transfers all the same, so they
+        # land in the flow ledger with the daemon as src.
+        try:
+            flow.global_flow_recorder().record(
+                key=key, nbytes=len(reply["raw"]),
+                duration_s=_monotonic() - t0, direction="in",
+                peer=self.object_addr or self.address)
+        except Exception:  # noqa: BLE001 - accounting only
+            pass
         return reply["raw"]
 
     def free_object(self, key: str) -> None:
@@ -2178,7 +2200,9 @@ class NodeDaemon:
         except (ObjectPullError, KeyError, OSError) as exc:
             if not spill_uri:
                 raise
+            import time as _time
             from ray_tpu._private.spill import read_uri
+            t0 = _time.monotonic()
             payload = read_uri(spill_uri,
                                getattr(a, "size", 0) or 0)
             if payload is None:
@@ -2189,9 +2213,16 @@ class NodeDaemon:
                            "failure: %s", a.key, spill_uri, exc)
             self._table.put(a.key, payload)
             try:
-                from ray_tpu._private import builtin_metrics
+                from ray_tpu._private import builtin_metrics, flow
                 builtin_metrics.object_restores().inc(
                     tags={"source": "spill"})
+                # Spill restores are transfers too: the ledger entry
+                # carries tier="spill" and a synthetic "spill" peer, so
+                # the head's matrix shows restore bandwidth per node.
+                flow.global_flow_recorder().record(
+                    key=a.key, nbytes=len(payload),
+                    duration_s=_time.monotonic() - t0,
+                    direction="in", peer="spill", tier="spill")
             except Exception:  # noqa: BLE001 - accounting only
                 pass
 
@@ -2236,6 +2267,7 @@ class NodeDaemon:
                 # head, keeping the worker's own pid/component labels.
                 self._pool.metrics_sink = self._publish_metrics_batch
                 self._pool.profile_sink = self._publish_profile_batch
+                self._pool.flow_sink = self._publish_flow_batch
             return self._pool
 
     def _task_uses_worker_process(self, msg: dict) -> bool:
@@ -2938,7 +2970,8 @@ class NodeDaemon:
             from ray_tpu._private.metrics_agent import MetricsAgent
             agent = MetricsAgent(
                 self._publish_metrics_batch, component="daemon",
-                publish_profile=self._publish_profile_batch)
+                publish_profile=self._publish_profile_batch,
+                publish_flow=self._publish_flow_batch)
             agent.add_collector(self._collect_daemon_metrics)
             self._metrics_agent = agent
         if self._use_worker_processes and not self._prestarted:
@@ -3215,6 +3248,21 @@ class NodeDaemon:
             return False
         msg = dict(batch)
         msg["type"] = "profile_batch"
+        msg["node_id"] = self.node_id_hex or ""
+        return bool(sender.send(msg))
+
+    def _publish_flow_batch(self, batch: dict) -> bool:
+        """Ship one drained transfer-ledger window (this daemon's own
+        FlowRecorder, or a worker's piggybacked batch) as a
+        ``flow_batch`` push. Additive post-v9: an old head's recv loop
+        drops the unknown push type on the floor."""
+        chan = self._chan
+        sender = self._reply_senders.get(chan) if chan is not None \
+            else None
+        if sender is None:
+            return False
+        msg = dict(batch)
+        msg["type"] = "flow_batch"
         msg["node_id"] = self.node_id_hex or ""
         return bool(sender.send(msg))
 

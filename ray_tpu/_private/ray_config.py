@@ -144,6 +144,16 @@ _PY_DEFAULTS: Dict[str, Any] = {
     "alert_max_firing_history": 256,
     "events_max": 2048,
     "events_spill_uri": "",
+    # Dataplane flow observability (flow.py): per-process transfer
+    # ledger bound (0 disables recording; fast counters still tick),
+    # head-side matrix window and cardinality caps, and the thresholds
+    # behind the slow_link / hot_object_fanout built-in alert rules.
+    "flow_max_records": 4096,
+    "flow_window_s": 60.0,
+    "flow_max_links": 512,
+    "flow_max_objects": 512,
+    "flow_slow_link_mbps": 1.0,
+    "flow_fanout_nodes": 8,
     "task_events_enabled": True,
     "memory_monitor_refresh_ms": 250,
     "memory_usage_threshold": 0.95,

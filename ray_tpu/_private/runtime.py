@@ -637,7 +637,8 @@ class Runtime:
         self._journal_head_recovery()
         self._metrics_agent = MetricsAgent(
             self._publish_head_metrics, component="driver",
-            publish_profile=self._publish_head_profile)
+            publish_profile=self._publish_head_profile,
+            publish_flow=self._publish_head_flow)
         self._metrics_agent.add_collector(self._collect_head_metrics)
 
     # ------------------------------------------------------------------
@@ -3106,6 +3107,23 @@ class Runtime:
             node = conn.node_id.hex()
         self._cluster_metrics.update_profile(node, msg)
 
+    def _publish_head_flow(self, batch: dict) -> bool:
+        """Sink for the head's own transfer-ledger drains AND for
+        batches head pool workers piggyback on task replies: straight
+        into the flow store under the head's node id."""
+        self._cluster_metrics.update_flows(self.head_node_id.hex(),
+                                           batch)
+        return True
+
+    def _flow_batch_from_node(self, conn, msg: dict) -> None:
+        """Wire sink for daemon-pushed flow_batch frames (assigned to
+        conn.on_flow_batch at registration; recv-thread — ingestion is
+        bounded dict work, no blocking)."""
+        node = msg.get("node_id") or ""
+        if not node and conn.node_id is not None:
+            node = conn.node_id.hex()
+        self._cluster_metrics.update_flows(node, msg)
+
     def _collect_head_metrics(self) -> None:
         """Refresh head-side gauges right before each export snapshot —
         level-style series (queue depth, store bytes, pool size, actor
@@ -3314,6 +3332,7 @@ class Runtime:
             "objects": objects,
             "serve": self.serve_stats(window=w)["deployments"],
             "loops": loops,
+            "transfer": cm.flows.summary_line(),
             "alerts": {
                 "firing": firing,
                 "firing_count": len(firing),
@@ -3405,6 +3424,18 @@ class Runtime:
 
     def profile_stats(self) -> dict:
         return self._cluster_metrics.profiles.stats()
+
+    # -- dataplane flow plane (flow.py) ---------------------------------
+
+    def flows_snapshot(self, window: Optional[float] = None) -> dict:
+        """The per-link transfer matrix + per-object fan-out table
+        (`/api/flows`, `ray-tpu xfer`). The head's own ledger is
+        drained first so driver-side pulls are as fresh as the call."""
+        self._flush_trace_spans()  # poll_once also ships head flows
+        return self._cluster_metrics.flows.snapshot(window=window)
+
+    def flow_stats(self) -> dict:
+        return self._cluster_metrics.flows.stats()
 
     def profile_cluster(self, duration: float = 10.0, hz: int = 100,
                         fmt: str = "folded"):
@@ -3512,8 +3543,15 @@ class Runtime:
         conn.on_log_batch = self._log_batch_from_node
         conn.on_metrics_batch = self._metrics_batch_from_node
         conn.on_profile_batch = self._profile_batch_from_node
+        conn.on_flow_batch = self._flow_batch_from_node
         conn.on_object_spilled = self._object_spilled_from_node
         conn.on_object_unspilled = self._object_unspilled_from_node
+        # Teach the flow store the node's object-server address so the
+        # holder addresses in pull records resolve to node ids (link
+        # matrix cells read node->node, not host:port->node).
+        if getattr(conn, "object_addr", None):
+            self._cluster_metrics.flows.note_node(
+                node_id.hex(), conn.object_addr)
         with self._lock:
             self._remote_nodes[node_id] = conn
         # A daemon reconnecting to a RESTARTED head announces the actor
@@ -3786,6 +3824,7 @@ class Runtime:
                 self._process_pool.metrics_sink = self._publish_head_metrics
                 self._process_pool.profile_sink = \
                     self._publish_head_profile
+                self._process_pool.flow_sink = self._publish_head_flow
             return self._process_pool
 
     def _use_process_worker(self, spec: TaskSpec) -> bool:

@@ -218,10 +218,25 @@ class TraceAssembler:
         trace: per-stage count / total seconds / share / p50 / p95."""
         traces = self._snapshot()
         durations: Dict[str, List[float]] = {}
+        transfer_s = 0.0
+        transfer_bytes = 0
+        transfer_pulls = 0
         for spans in traces.values():
             for s in spans:
                 durations.setdefault(span_stage(s), []).append(
                     _span_duration(s))
+                # data::pull spans carry the flow plane's enrichment
+                # (bytes/chunks/failovers) — roll them up so the
+                # summary answers "how much of the critical path is
+                # object transfer, and how many bytes was that".
+                if s.get("name") == "data::pull":
+                    transfer_s += _span_duration(s)
+                    transfer_pulls += 1
+                    attrs = s.get("attributes") or {}
+                    try:
+                        transfer_bytes += int(attrs.get("bytes") or 0)
+                    except (TypeError, ValueError):
+                        pass
         grand = sum(sum(v) for v in durations.values()) or 1.0
         stages = {}
         for stage in sorted(durations):
@@ -234,7 +249,16 @@ class TraceAssembler:
                 "p50_s": round(_percentile(vals, 0.50), 6),
                 "p95_s": round(_percentile(vals, 0.95), 6),
             }
-        return {"traces": len(traces), "stages": stages}
+        return {
+            "traces": len(traces),
+            "stages": stages,
+            "transfer": {
+                "pulls": transfer_pulls,
+                "total_s": round(transfer_s, 6),
+                "share": round(transfer_s / grand, 4),
+                "bytes": transfer_bytes,
+            },
+        }
 
     def _flow_events_for(self, spans: List[Dict[str, Any]]
                          ) -> List[Dict[str, Any]]:

@@ -51,7 +51,8 @@ from typing import Any, Dict, Tuple
 #: and a new raw ``fenced`` reply rejects resumes from declared-dead
 #: incarnations (the daemon must re-register as a new incarnation).
 #: (still v9) additive since: metrics_batch.event_stats,
-#: profile_batch push frames, profile.pid burst targeting — optional
+#: profile_batch push frames, profile.pid burst targeting,
+#: flow_batch push frames (dataplane transfer ledger) — optional
 #: fields / head-bound pushes old peers drop harmlessly, per the rule
 #: above.
 PROTOCOL_VERSION = 9
@@ -222,6 +223,18 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
         "stacks": (_DICT, True),
         "samples": (_INT, False),
         "duration_s": (_NUM, False),
+    },
+    # -- dataplane flow ledger (daemon -> head, additive post-v9) ------
+    # Typed per-transfer records ({key, bytes, src, dst, duration,
+    # chunks, parallelism, failovers, tier, direction, outcome}) the
+    # origin's FlowRecorder accumulated since its last metrics tick,
+    # shipped on the metrics cadence exactly like profile_batch. Same
+    # compatibility story: an older head drops the unknown push type.
+    "flow_batch": {
+        "node_id": (_STR, False),
+        "pid": (_INT, True),
+        "component": (_STR, True),
+        "records": (_LIST, True),
     },
     # -- durable spill announcements (daemon -> head, v8) --------------
     # A daemon spilled an object through a DURABLE backend (session://

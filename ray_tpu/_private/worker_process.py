@@ -143,6 +143,8 @@ class WorkerHandle:
         # Same piggyback for continuous-profiling windows (folded
         # stacks accumulated by the worker's ProfilerAgent).
         self.profile_sink: Optional[Callable[[dict], Any]] = None
+        # And for the worker's transfer-ledger drains (FlowRecorder).
+        self.flow_sink: Optional[Callable[[dict], Any]] = None
         self._lock = threading.Lock()
 
     def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
@@ -185,6 +187,14 @@ class WorkerHandle:
                         psink(batch)
                     except Exception:  # noqa: BLE001 - profiling never fails a task
                         logger.exception("worker profile forward failed")
+            flows = reply.pop("flow_batch", None)
+            fsink = self.flow_sink
+            if flows and fsink is not None:
+                for batch in flows:
+                    try:
+                        fsink(batch)
+                    except Exception:  # noqa: BLE001 - flow accounting never fails a task
+                        logger.exception("worker flow forward failed")
         return reply
 
     def kill(self, wait: bool = True) -> None:
@@ -459,6 +469,7 @@ class WorkerProcessPool:
         # frames from a daemon).
         self.metrics_sink: Optional[Callable[[dict], Any]] = None
         self.profile_sink: Optional[Callable[[dict], Any]] = None
+        self.flow_sink: Optional[Callable[[dict], Any]] = None
         # ALL spawns go through this single long-lived thread:
         # PR_SET_PDEATHSIG binds to the spawning THREAD, so a worker
         # forked from an ephemeral handler thread is SIGKILLed the
@@ -543,6 +554,7 @@ class WorkerProcessPool:
                 lease_start: Optional[float]) -> WorkerHandle:
         w.metrics_sink = self.metrics_sink
         w.profile_sink = self.profile_sink
+        w.flow_sink = self.flow_sink
         if lease_start is None:
             builtin_metrics.record_lease_immediate()
         else:
@@ -684,12 +696,14 @@ class _WorkerMain:
         from ray_tpu._private.metrics_agent import MetricsAgent
         self._metrics_buffer: list = []
         self._profile_buffer: list = []
+        self._flow_buffer: list = []
         # publish_profile makes the agent own a ProfilerAgent for this
         # worker: sampling runs continuously on its own thread even
         # between tasks; the windows ride task replies like metrics.
         self._metrics_agent = MetricsAgent(
             self._buffer_metrics_batch, component="worker", start=False,
-            publish_profile=self._buffer_profile_batch)
+            publish_profile=self._buffer_profile_batch,
+            publish_flow=self._buffer_flow_batch)
         self._last_metrics_poll = 0.0
 
     def _buffer_metrics_batch(self, batch: dict) -> bool:
@@ -709,6 +723,14 @@ class _WorkerMain:
         self._profile_buffer.append(batch)
         return True
 
+    def _buffer_flow_batch(self, batch: dict) -> bool:
+        # A squeezed-out batch would be dropped transfer records, so a
+        # full buffer REFUSES (the agent refunds into the recorder).
+        if len(self._flow_buffer) >= 8:
+            return False
+        self._flow_buffer.append(batch)
+        return True
+
     def _attach_metrics(self, reply: dict) -> None:
         agent = self._metrics_agent
         if not agent.enabled:
@@ -726,6 +748,9 @@ class _WorkerMain:
         if self._profile_buffer:
             reply["profile_batch"] = self._profile_buffer[:]
             del self._profile_buffer[:]
+        if self._flow_buffer:
+            reply["flow_batch"] = self._flow_buffer[:]
+            del self._flow_buffer[:]
 
     def _get_arena(self):
         if not self._arena_tried:

@@ -116,6 +116,17 @@ _DEFAULT_PANELS = [
      "sum by (direction) (rate(ray_tpu_object_transfer_bytes_total[1m]))",
      "Bps"),
     ("Pull chunks / s", "rate(ray_tpu_pull_chunks_total[1m])", "ops"),
+    # Dataplane flow plane (flow.py): the head-synthesized per-link
+    # series — a heatmap-able bytes rate per (src,dst) cell, the
+    # windowed per-link MB/s gauge, and the top fan-out objects that
+    # mark broadcast amplification.
+    ("Transfer link bytes / s (src->dst heatmap)",
+     "sum by (src, dst) (rate(ray_tpu_transfer_link_bytes_total[1m]))",
+     "Bps"),
+    ("Per-link transfer MB/s",
+     "max by (link) (ray_tpu_transfer_link_mbps)", "MBs"),
+    ("Top fan-out objects (nodes pulling one object)",
+     "topk(10, max by (key) (ray_tpu_object_fanout_nodes))", "short"),
 ]
 
 

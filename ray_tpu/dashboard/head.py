@@ -81,7 +81,7 @@ class DashboardHead:
                          "/api/serve/applications", "/api/timeline",
                          "/api/traces", "/api/event_stats",
                          "/api/timeseries", "/api/serve/stats",
-                         "/api/alerts", "/api/events"))
+                         "/api/alerts", "/api/events", "/api/flows"))
         return web.Response(
             text=f"<html><body><h2>ray_tpu dashboard</h2><ul>{rows}</ul>"
                  "</body></html>",
@@ -556,6 +556,25 @@ class DashboardHead:
             snap.pop("history", None)
         return self._json(snap)
 
+    async def _flows(self, request):
+        """Dataplane flow plane: the per-link transfer matrix (windowed
+        MB/s, p95 latency, failover/error counts per src->dst node
+        pair), the per-object fan-out table, and per-node egress/
+        ingress totals. ``?window=`` narrows the MB/s window (clamped
+        to the store's)."""
+        from ray_tpu._private.worker import global_worker
+        runtime = getattr(global_worker, "_runtime", None)
+        if runtime is None:
+            return self._json({"error": "no runtime"}, status=503)
+        q = request.query
+        try:
+            window = float(q["window"]) if q.get("window") else None
+        except ValueError:
+            return self._json({"error": "window must be a number"},
+                              status=400)
+        snap = await asyncio.to_thread(runtime.flows_snapshot, window)
+        return self._json(snap)
+
     async def _events(self, request):
         """Cluster event journal. Filters: ``?severity=`` (a floor —
         ``warning`` includes error/critical), ``?source=``,
@@ -633,6 +652,7 @@ class DashboardHead:
                            self._profile_incidents)
         app.router.add_get("/api/alerts", self._alerts)
         app.router.add_get("/api/events", self._events)
+        app.router.add_get("/api/flows", self._flows)
         app.router.add_get("/api/grafana_dashboard", self._grafana)
         return app
 

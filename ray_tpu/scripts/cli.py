@@ -68,6 +68,20 @@ def _render_top_frame(snap: dict) -> str:
         f"objects  store {_fmt_bytes(objects.get('store_bytes'))}  "
         f"spill/s {_fmt_bytes(objects.get('spill_bytes_per_s'))}  "
         f"restores/s {objects.get('restores_per_s', 0.0):.2f}")
+    xfer = snap.get("transfer") or {}
+    if xfer.get("links_active"):
+        top_link = xfer.get("top_link") or {}
+        line = (f"transfer {xfer.get('mbps_total', 0.0):.2f}MB/s over "
+                f"{xfer['links_active']} link(s)")
+        if top_link:
+            line += (f"  top {top_link.get('src', '')[:12]}->"
+                     f"{top_link.get('dst', '')[:12]} "
+                     f"{top_link.get('mbps', 0.0):.2f}MB/s")
+        hot = xfer.get("max_fanout") or {}
+        if hot:
+            line += (f"  fanout {hot.get('key', '')[:16]} x"
+                     f"{hot.get('fanout', 0)}")
+        lines.append(line)
     loops = snap.get("loops", {})
     if loops:
         lines.append("loop lag  " + "  ".join(
@@ -448,6 +462,70 @@ def cmd_alerts(args) -> int:
     return 0
 
 
+def cmd_xfer(args) -> int:
+    """`ray-tpu xfer [--links|--objects] [--window S] [--json]` — the
+    dataplane flow plane: per-link transfer matrix (windowed MB/s, p95
+    latency, failovers/errors per src->dst node pair) and the
+    per-object pull fan-out table (broadcast amplification)."""
+    _ensure_init()
+    from ray_tpu._private.worker import global_worker
+    snap = global_worker.runtime.flows_snapshot(window=args.window)
+    if args.json:
+        print(json.dumps(snap, indent=2, default=str))
+        return 0
+    stats = snap.get("stats", {})
+    print(f"transfer ledger — window {snap.get('window_s', 0):g}s — "
+          f"{stats.get('links', 0)} link(s), "
+          f"{stats.get('objects', 0)} object(s), "
+          f"{stats.get('records', 0)} record(s) merged")
+    show_links = not args.objects
+    show_objects = not args.links
+    links = snap.get("links", [])
+    if show_links:
+        if links:
+            rows = [(lk.get("src", "")[:12] or "-",
+                     lk.get("dst", "")[:12] or "-",
+                     f"{lk.get('mbps', 0.0):.2f}",
+                     _fmt_bytes(lk.get("window_bytes")),
+                     _fmt_bytes(lk.get("bytes_total")),
+                     str(lk.get("records", 0)),
+                     f"{lk.get('p95_s', 0.0) * 1000:.1f}ms",
+                     str(lk.get("failovers", 0)),
+                     str(lk.get("errors", 0)))
+                    for lk in links]
+            hdr = ("SRC", "DST", "MB/S", "WINDOW", "TOTAL", "PULLS",
+                   "P95", "FAILOVER", "ERR")
+            widths = [max(len(hdr[i]), *(len(r[i]) for r in rows))
+                      for i in range(len(hdr))]
+            fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+            print(fmt.format(*hdr))
+            for r in rows:
+                print(fmt.format(*r))
+        else:
+            print("no transfer links recorded")
+    objects = snap.get("objects", [])
+    if show_objects:
+        if show_links:
+            print()
+        if objects:
+            rows = [(o.get("key", "")[:24],
+                     str(o.get("fanout", 0)),
+                     str(len(o.get("nodes", []))),
+                     _fmt_bytes(o.get("bytes_total")),
+                     str(o.get("pulls", 0)))
+                    for o in objects]
+            hdr = ("OBJECT", "FANOUT", "NODES", "BYTES", "PULLS")
+            widths = [max(len(hdr[i]), *(len(r[i]) for r in rows))
+                      for i in range(len(hdr))]
+            fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+            print(fmt.format(*hdr))
+            for r in rows:
+                print(fmt.format(*r))
+        else:
+            print("no object fan-out recorded")
+    return 0
+
+
 def cmd_events(args) -> int:
     """`ray-tpu events [--severity S] [--source S] [--node N]
     [--limit N] [--follow] [--json]` — the head's cluster event
@@ -754,6 +832,16 @@ def main(argv=None) -> int:
                    help="also print the bounded transition history")
     p.add_argument("--json", action="store_true",
                    help="emit the raw snapshot as JSON")
+    p = sub.add_parser("xfer", help="dataplane flow plane: per-link "
+                                    "transfer matrix + object fan-out")
+    p.add_argument("--links", action="store_true",
+                   help="only the per-link MB/s matrix")
+    p.add_argument("--objects", action="store_true",
+                   help="only the per-object fan-out table")
+    p.add_argument("--window", type=float, default=None,
+                   help="MB/s window in seconds (clamped to the store's)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the raw snapshot as JSON")
     p = sub.add_parser("events", help="cluster event journal "
                                       "(membership, serve, train, "
                                       "spill, alert transitions)")
@@ -858,6 +946,7 @@ def main(argv=None) -> int:
         "profile": cmd_profile,
         "grafana-dashboards": cmd_grafana,
         "alerts": cmd_alerts,
+        "xfer": cmd_xfer,
         "events": cmd_events,
     }[args.command]
     return handler(args)

@@ -189,6 +189,15 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     FLAG_INT(alert_max_firing_history, 256),
     FLAG_INT(events_max, 2048),
     FLAG_STR(events_spill_uri, ""),
+    // Dataplane flow observability: per-process transfer ledger bound
+    // (0 disables recording), head-side matrix window + cardinality
+    // caps, slow_link / hot_object_fanout alert thresholds.
+    FLAG_INT(flow_max_records, 4096),
+    FLAG_DBL(flow_window_s, 60.0),
+    FLAG_INT(flow_max_links, 512),
+    FLAG_INT(flow_max_objects, 512),
+    FLAG_DBL(flow_slow_link_mbps, 1.0),
+    FLAG_INT(flow_fanout_nodes, 8),
     FLAG_BOOL(task_events_enabled, true),
     // -- memory monitor / OOM killing --
     FLAG_INT(memory_monitor_refresh_ms, 250),

@@ -1,0 +1,706 @@
+"""Dataplane flow observability: the per-process FlowRecorder ledger
+(typed records, bounds, drain/refund shipping), the flow_batch wire
+schema, the head-side FlowStore (per-link matrix aggregation, address
+resolution, membership eviction, bounded memory, series synthesis with
+idle restamping), the slow_link / hot_object_fanout builtin alert
+rules (chaos-testable via delay_ms), data::pull span enrichment + the
+trace summary's transfer share, the /api/flows endpoint and `ray-tpu
+xfer` CLI, and a 2-daemon acceptance run asserting a nonzero resolved
+link cell plus a fan-out row from live cross-node pulls."""
+
+import json
+import struct
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as um
+from ray_tpu._private import builtin_metrics, chaos, flow
+from ray_tpu._private.dataplane import NodeObjectTable, ObjectServer, \
+    pull_object
+from ray_tpu._private.flow import FlowRecorder, FlowStore
+from ray_tpu._private.timeseries import TimeSeriesStore
+
+_LEN = struct.Struct(">q")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    um.clear_registry()
+    flow.shutdown_flow_recorder()
+    yield
+    flow.shutdown_flow_recorder()
+    um.clear_registry()
+
+
+def _spawn_daemon(port, *, num_cpus=2, resources=None, env=None):
+    import os
+    cmd = [sys.executable, "-m", "ray_tpu._private.multinode",
+           "--address", f"127.0.0.1:{port}",
+           "--num-cpus", str(num_cpus)]
+    if resources:
+        cmd += ["--resources", json.dumps(resources)]
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    return subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL, env=full_env)
+
+
+def _wait_for_resource(name, amount, timeout=20):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if ray_tpu.cluster_resources().get(name, 0) >= amount:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"resource {name}>={amount} never appeared: "
+        f"{ray_tpu.cluster_resources()}")
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def _pull_batch(node, records):
+    return {"pid": 1, "component": "daemon", "records": records}
+
+
+def _rec(key="obj1", nbytes=1024, duration=0.01, src="10.0.0.1:7000",
+         direction="in", **kw):
+    rec = {"key": key, "bytes": nbytes, "duration": duration,
+           "src": src if direction == "in" else "",
+           "dst": "" if direction == "in" else src,
+           "chunks": 1, "parallelism": 1, "failovers": 0,
+           "tier": "replica", "direction": direction, "outcome": "ok"}
+    rec.update(kw)
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# FlowRecorder: record / bounds / drain / refund
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_record_drain_refund():
+    rec = FlowRecorder(max_records=100)
+    for i in range(3):
+        rec.record(key=f"k{i}", nbytes=10 * (i + 1), duration_s=0.01,
+                   direction="in", peer=("10.0.0.1", 7000 + i))
+    batch = rec.drain()
+    assert batch is not None and len(batch) == 3
+    assert batch[0]["key"] == "k0" and batch[0]["bytes"] == 10
+    assert batch[0]["src"] == "10.0.0.1:7000" and batch[0]["dst"] == ""
+    assert batch[0]["tier"] == "replica" and batch[0]["outcome"] == "ok"
+    assert rec.drain() is None  # drained clean
+    # A failed publish refunds at the FRONT: order preserved vs newer.
+    rec.record(key="newer", nbytes=1, duration_s=0.0, direction="in")
+    rec.refund(batch)
+    again = rec.drain()
+    assert [r["key"] for r in again] == ["k0", "k1", "k2", "newer"]
+
+
+def test_recorder_bounded_drops_oldest():
+    rec = FlowRecorder(max_records=5)
+    for i in range(9):
+        rec.record(key=f"k{i}", nbytes=1, duration_s=0.0, direction="in")
+    assert rec.dropped == 4
+    batch = rec.drain()
+    assert [r["key"] for r in batch] == [f"k{i}" for i in range(4, 9)]
+    # Refund over the bound squeezes the oldest refunded records out.
+    rec.refund(batch + [_rec(key="extra")])
+    assert rec.stats()["buffered"] == 5
+    assert rec.stats()["dropped"] == 5
+
+
+def test_recorder_validates_tier_and_outcome():
+    rec = FlowRecorder(max_records=10)
+    with pytest.raises(ValueError):
+        rec.record(key="k", nbytes=1, duration_s=0.0, direction="in",
+                   tier="warp")
+    with pytest.raises(ValueError):
+        rec.record(key="k", nbytes=1, duration_s=0.0, direction="in",
+                   outcome="maybe")
+    rec.record(key="k", nbytes=1, duration_s=0.0, direction="in",
+               tier="spill", outcome="error")
+    (r,) = rec.drain()
+    assert r["tier"] == "spill" and r["outcome"] == "error"
+
+
+def test_disabled_recorder_still_bumps_fast_counters():
+    """flow_max_records=0 turns the ledger off but the recorder stays
+    the single bump site for the cluster transfer scalars — disabling
+    flow must not zero ray_tpu_object_transfer_bytes."""
+    rec = FlowRecorder(max_records=0)
+    assert not rec.enabled
+    in0 = builtin_metrics._fast_transfer["in"]
+    out0 = builtin_metrics._fast_transfer["out"]
+    chunks0 = builtin_metrics._fast_chunks["n"]
+    rec.record(key="k", nbytes=100, duration_s=0.0, direction="in",
+               chunks=4)
+    rec.record(key="k", nbytes=50, duration_s=0.0, direction="out")
+    assert builtin_metrics._fast_transfer["in"] - in0 == 100
+    assert builtin_metrics._fast_transfer["out"] - out0 == 50
+    assert builtin_metrics._fast_chunks["n"] - chunks0 == 4
+    assert rec.drain() is None  # nothing buffered
+
+
+def test_error_outcome_bumps_no_byte_counters():
+    rec = FlowRecorder(max_records=10)
+    in0 = builtin_metrics._fast_transfer["in"]
+    rec.record(key="k", nbytes=100, duration_s=0.0, direction="in",
+               outcome="error")
+    assert builtin_metrics._fast_transfer["in"] == in0  # no bytes moved
+    (r,) = rec.drain()
+    assert r["outcome"] == "error"
+
+
+def test_inflight_gauge_begin_end():
+    rec = FlowRecorder(max_records=10)
+    rec.begin(1000)
+    rec.begin(500)
+    assert rec.inflight_bytes == 1500
+    gauge = builtin_metrics.transfer_inflight_bytes()
+    assert gauge.series().get((), 0) == 1500
+    rec.end(1000)
+    rec.end(9999)  # over-release clamps at zero, never negative
+    assert rec.inflight_bytes == 0
+    assert gauge.series().get((), 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# Wire schema (additive post-v9)
+# ---------------------------------------------------------------------------
+
+
+def test_wire_flow_batch_schema():
+    from ray_tpu._private import wire
+
+    wire.validate_message({"type": "flow_batch", "node_id": "aa",
+                           "pid": 1, "component": "daemon",
+                           "records": [_rec()]})
+    # node_id is optional (the head stamps it from the channel), the
+    # payload fields are not.
+    wire.validate_message({"type": "flow_batch", "pid": 1,
+                           "component": "daemon", "records": []})
+    with pytest.raises(wire.WireSchemaError):
+        wire.validate_message({"type": "flow_batch", "pid": 1,
+                               "component": "daemon"})
+    with pytest.raises(wire.WireSchemaError):
+        wire.validate_message({"type": "flow_batch", "node_id": "aa",
+                               "pid": 1, "component": "daemon",
+                               "records": "nope"})
+
+
+# ---------------------------------------------------------------------------
+# FlowStore: matrix aggregation, eviction, bounds, series synthesis
+# ---------------------------------------------------------------------------
+
+
+def test_flowstore_link_aggregation_resolves_addresses():
+    store = FlowStore(window_s=60, max_links=16, max_objects=16)
+    store.note_node("aa" * 8, ("10.0.0.1", 7000))
+    store.ingest("bb" * 8, _pull_batch("bb" * 8, [
+        _rec(key="obj1", nbytes=1 << 20, duration=0.1),
+        _rec(key="obj1", nbytes=1 << 20, duration=0.2, chunks=4,
+             failovers=1),
+        _rec(key="obj2", nbytes=100, duration=0.0, outcome="error"),
+    ]))
+    snap = store.snapshot()
+    (link,) = snap["links"]
+    assert link["src"] == "aa" * 8  # host:port resolved to node id
+    assert link["dst"] == "bb" * 8
+    assert link["bytes_total"] == 2 << 20  # error record moved no bytes
+    assert link["records"] == 3
+    assert link["chunks"] == 6
+    assert link["failovers"] == 1
+    assert link["errors"] == 1
+    assert link["mbps"] > 0
+    assert link["p95_s"] >= 0.1
+    # obj1 pulled by one node; the errored obj2 never lands in fan-out.
+    assert [o["key"] for o in snap["objects"]] == ["obj1"]
+    assert snap["objects"][0]["pulls"] == 2
+    assert snap["ingress"]["bb" * 8] == 2 << 20
+
+
+def test_flowstore_serve_records_land_in_egress_not_matrix():
+    """The serving side only knows the peer's ephemeral port — its
+    records feed per-node egress totals, never half-blind matrix
+    cells."""
+    store = FlowStore(window_s=60, max_links=16, max_objects=16)
+    store.ingest("aa" * 8, _pull_batch("aa" * 8, [
+        _rec(key="obj1", nbytes=500, direction="out",
+             src="10.0.0.9:51312")]))
+    snap = store.snapshot()
+    assert snap["links"] == []
+    assert snap["egress"] == {"aa" * 8: 500}
+
+
+def test_flowstore_fanout_counts_distinct_nodes():
+    store = FlowStore(window_s=60, max_links=16, max_objects=16)
+    for i in range(5):
+        store.ingest(f"{i:02d}" * 8, _pull_batch(f"{i:02d}" * 8, [
+            _rec(key="broadcast", nbytes=1000)]))
+    snap = store.snapshot()
+    (obj,) = snap["objects"]
+    assert obj["fanout"] == 5
+    assert len(obj["nodes"]) == 5
+    assert obj["pulls"] == 5
+    summary = store.summary_line()
+    assert summary["links_active"] == 5
+    assert summary["max_fanout"] == {"key": "broadcast", "fanout": 5}
+
+
+def test_flowstore_dead_node_links_evicted():
+    store = FlowStore(window_s=60, max_links=16, max_objects=16,
+                      staleness=0.1)
+    store.note_node("aa" * 8, ("10.0.0.1", 7000))
+    store.ingest("bb" * 8, _pull_batch("bb" * 8, [_rec()]))
+    store.ingest("cc" * 8, _pull_batch("cc" * 8, [
+        _rec(src="10.0.0.2:7000")]))
+    assert len(store.snapshot()["links"]) == 2
+    store.mark_node_dead("aa" * 8)
+    time.sleep(0.15)
+    store.evict_stale()
+    (survivor,) = store.snapshot()["links"]
+    assert survivor["dst"] == "cc" * 8
+    # The dead node's address mapping is purged too: a reused address
+    # must not resolve to the dead node id.
+    store.ingest("bb" * 8, _pull_batch("bb" * 8, [_rec()]))
+    assert any(lk["src"] == "10.0.0.1:7000"
+               for lk in store.snapshot()["links"])
+
+
+def test_flowstore_bounded_links_and_object_churn():
+    store = FlowStore(window_s=60, max_links=3, max_objects=4)
+    for i in range(10):
+        store.ingest("aa" * 8, _pull_batch("aa" * 8, [
+            _rec(key=f"k{i}", src=f"10.0.0.{i}:7000")]))
+    stats = store.stats()
+    assert stats["links"] == 3
+    assert store.dropped_links == 7
+    # Objects are LRU: only the 4 most recent keys survive the churn.
+    assert stats["objects"] == 4
+    assert store.dropped_objects == 6
+    keys = [o["key"] for o in store.snapshot()["objects"]]
+    assert set(keys) == {"k6", "k7", "k8", "k9"}
+
+
+def test_publish_series_restamps_and_zero_stamps_departed():
+    """Gauges restamp EVERY publish (idle decays to 0 by value) and a
+    label set that leaves the store gets one final 0 so its alert
+    group resolves instead of pinning on the stale last value."""
+    ts = TimeSeriesStore(window_s=300, max_series=64, staleness=600)
+    store = FlowStore(window_s=1.0, max_links=16, max_objects=16,
+                      staleness=0.05, slow_link_mbps=10.0)
+    store.note_node("aa" * 8, ("10.0.0.1", 7000))
+    store.ingest("bb" * 8, _pull_batch("bb" * 8, [
+        _rec(nbytes=1 << 20, duration=0.5)]))
+    store.publish_series(ts)
+    link = f"{'aa' * 8}->{'bb' * 8}"
+    g = ts.gauge_stats("ray_tpu_transfer_link_mbps", group_by="link")
+    assert g[link]["last_max"] == pytest.approx(1.0, rel=0.01)
+    # 1 MB over a 1 s window < 10 MB/s floor -> the link reads stalled.
+    s = ts.gauge_stats("ray_tpu_transfer_link_stalled", group_by="link")
+    assert s[link]["last_max"] == 1.0
+    assert ts.gauge_stats("ray_tpu_object_fanout_nodes",
+                          group_by="key")["obj1"]["last_max"] == 1.0
+    # Window passes -> same labels restamp to 0 (wbytes==0 clears the
+    # stall flag too: no bytes in window is idle, not slow).
+    time.sleep(1.1)
+    store.publish_series(ts)
+    g = ts.gauge_stats("ray_tpu_transfer_link_mbps", group_by="link")
+    assert g[link]["last_max"] == 0.0
+    assert ts.gauge_stats("ray_tpu_transfer_link_stalled",
+                          group_by="link")[link]["last_max"] == 0.0
+    # The whole link leaves the store -> one final zero stamp.
+    store.mark_node_dead("aa" * 8)
+    time.sleep(0.1)
+    store.evict_stale()
+    store.publish_series(ts)
+    g = ts.gauge_stats("ray_tpu_transfer_link_mbps", group_by="link")
+    assert g[link]["last_max"] == 0.0
+    # Counters are cumulative store totals with src/dst labels.
+    q = ts.query("ray_tpu_transfer_link_bytes_total",
+                 labels={"src": "aa" * 8, "dst": "bb" * 8})
+    assert q["series"] and \
+        q["series"][0]["points"][-1][1] == float(1 << 20)
+
+
+# ---------------------------------------------------------------------------
+# Builtin alert rules: slow_link + hot_object_fanout
+# ---------------------------------------------------------------------------
+
+
+def test_builtin_rules_include_flow_rules(monkeypatch):
+    from ray_tpu._private.alerting import builtin_rules
+
+    names = {r.name for r in builtin_rules()}
+    assert {"slow_link", "hot_object_fanout"} <= names
+    hot = next(r for r in builtin_rules()
+               if r.name == "hot_object_fanout")
+    assert ">= 8" in hot.expr.text
+    monkeypatch.setenv("RAY_TPU_FLOW_FANOUT_NODES", "3")
+    hot = next(r for r in builtin_rules()
+               if r.name == "hot_object_fanout")
+    assert ">= 3" in hot.expr.text
+
+
+def test_slow_link_alert_fires_and_resolves():
+    from ray_tpu._private.alerting import AlertEngine, builtin_rules
+
+    engine = AlertEngine(period_s=3600.0, max_history=16)
+    rule = next(r for r in builtin_rules() if r.name == "slow_link")
+    engine.add_rule(rule)
+    ts = TimeSeriesStore(window_s=300, max_series=64, staleness=600)
+    store = FlowStore(window_s=1.0, max_links=16, max_objects=16,
+                      slow_link_mbps=50.0)
+    store.note_node("aa" * 8, ("10.0.0.1", 7000))
+    store.ingest("bb" * 8, _pull_batch("bb" * 8, [
+        _rec(nbytes=1 << 20, duration=0.8)]))  # 1 MB/s << 50 floor
+    store.publish_series(ts)
+    t0 = time.monotonic()
+    engine.evaluate(ts, now=t0)  # for_s=5 -> pending hold
+    (inst,) = [a for a in engine.snapshot()["alerts"]
+               if a["rule"] == "slow_link"]
+    assert inst["state"] == "pending"
+    engine.evaluate(ts, now=t0 + 6)
+    assert [a["rule"] for a in engine.firing()] == ["slow_link"]
+    # Traffic stops, the window drains, the restamp drops the gauge to
+    # 0 -> the alert RESOLVES by value (the chaos-recovery contract).
+    time.sleep(1.1)
+    store.publish_series(ts)
+    engine.evaluate(ts, now=t0 + 20)
+    assert engine.firing() == []
+    (inst,) = [a for a in engine.snapshot()["alerts"]
+               if a["rule"] == "slow_link"]
+    assert inst["state"] == "resolved"
+
+
+def test_hot_object_fanout_alert_fires(monkeypatch):
+    from ray_tpu._private.alerting import AlertEngine, builtin_rules
+
+    monkeypatch.setenv("RAY_TPU_FLOW_FANOUT_NODES", "4")
+    engine = AlertEngine(period_s=3600.0, max_history=16)
+    rule = next(r for r in builtin_rules()
+                if r.name == "hot_object_fanout")
+    engine.add_rule(rule)
+    ts = TimeSeriesStore(window_s=300, max_series=64, staleness=600)
+    store = FlowStore(window_s=60.0, max_links=16, max_objects=16)
+    for i in range(4):
+        store.ingest(f"{i:02d}" * 8, _pull_batch(f"{i:02d}" * 8, [
+            _rec(key="broadcast", nbytes=1000)]))
+    store.publish_series(ts)
+    engine.evaluate(ts)  # for_s=0 -> fires at once
+    assert [a["rule"] for a in engine.firing()] == ["hot_object_fanout"]
+
+
+def test_chaos_delay_slows_recorded_pull():
+    """The delay_ms chaos site sits on the pull send path, so injected
+    latency lands in the flow record's duration — which is exactly
+    what makes slow_link testable without a slow network."""
+    chaos.configure("delay_ms:ms=60:site=pull.send")
+    src = NodeObjectTable()
+    server = ObjectServer(src, host="127.0.0.1")
+    try:
+        payload = bytes(64 * 1024)
+        src.put("slowobj", payload)
+        dst = NodeObjectTable()
+        rec = flow.global_flow_recorder()
+        rec.drain()  # start from a clean ledger
+        pull_object(("127.0.0.1", server.port), "slowobj", dst,
+                    size_hint=len(payload))
+        batch = rec.drain()
+    finally:
+        chaos.reset()
+        server.close()
+    ours = [r for r in batch or [] if r["key"] == "slowobj"
+            and r["direction"] == "in"]
+    assert ours, batch
+    assert ours[0]["bytes"] == len(payload)
+    assert ours[0]["duration"] >= 0.05
+    # Fed through the store, the delayed link reads stalled.
+    ts = TimeSeriesStore(window_s=300, max_series=64, staleness=600)
+    store = FlowStore(window_s=60.0, max_links=16, max_objects=16,
+                      slow_link_mbps=10 ** 6)
+    store.ingest("bb" * 8, _pull_batch("bb" * 8, ours))
+    store.publish_series(ts)
+    stalled = ts.gauge_stats("ray_tpu_transfer_link_stalled",
+                             group_by="link")
+    assert any(v["last_max"] == 1.0 for v in stalled.values())
+
+
+# ---------------------------------------------------------------------------
+# data::pull span enrichment + trace summary transfer share
+# ---------------------------------------------------------------------------
+
+
+def test_pull_span_carries_flow_attributes():
+    from ray_tpu.util import tracing
+
+    tracing.enable_tracing()
+    tracing.set_sample_rate(1.0)
+    src = NodeObjectTable()
+    server = ObjectServer(src, host="127.0.0.1")
+    try:
+        payload = bytes(range(256)) * 512  # 128 KB
+        src.put("spanobj", payload)
+        dst = NodeObjectTable()
+        with tracing.start_span("test_root"):
+            pull_object(("127.0.0.1", server.port), "spanobj", dst,
+                        size_hint=len(payload))
+        spans, _cursor = tracing.drain_finished_spans(0)
+    finally:
+        tracing.set_sample_rate(None)
+        tracing.disable_tracing()
+        server.close()
+    pulls = [s for s in spans if s["name"] == "data::pull"
+             and s["attributes"].get("key") == "spanobj"]
+    assert pulls, [s["name"] for s in spans]
+    attrs = pulls[-1]["attributes"]
+    assert attrs["bytes"] == len(payload)
+    assert attrs["chunks"] >= 1
+    assert attrs["sources_used"] == 1
+    assert attrs["failovers"] == 0
+
+
+def test_trace_summary_transfer_share():
+    from ray_tpu._private.trace_assembler import TraceAssembler
+
+    asm = TraceAssembler(retention=10)
+    base = {"trace_id": "t1", "node_id": "aa" * 8, "pid": 1,
+            "start_time": 100.0}
+    asm.add_span({**base, "span_id": "s1", "name": "task::run",
+                  "duration": 3.0, "end_time": 103.0, "attributes": {}})
+    asm.add_span({**base, "span_id": "s2", "parent_id": "s1",
+                  "name": "data::pull", "duration": 1.0,
+                  "end_time": 101.0,
+                  "attributes": {"bytes": 4096, "chunks": 2}})
+    summ = asm.summary()
+    xfer = summ["transfer"]
+    assert xfer["pulls"] == 1
+    assert xfer["bytes"] == 4096
+    assert xfer["total_s"] == pytest.approx(1.0)
+    assert xfer["share"] == pytest.approx(0.25)  # 1s of 4s total
+
+
+# ---------------------------------------------------------------------------
+# Config knobs: python defaults + native flag table parity
+# ---------------------------------------------------------------------------
+
+
+def test_flow_knobs_in_py_defaults_and_native_table():
+    import os
+
+    from ray_tpu._private.ray_config import _PY_DEFAULTS
+
+    expected = {"flow_max_records": 4096, "flow_window_s": 60.0,
+                "flow_max_links": 512, "flow_max_objects": 512,
+                "flow_slow_link_mbps": 1.0, "flow_fanout_nodes": 8}
+    for knob, default in expected.items():
+        assert _PY_DEFAULTS.get(knob) == default, knob
+    cc = os.path.join(os.path.dirname(os.path.abspath(ray_tpu.__file__)),
+                      os.pardir, "src", "ray_tpu_native", "config.cc")
+    with open(cc) as f:
+        text = f.read()
+    for knob in expected:
+        assert knob in text, f"{knob} missing from config.cc kDefaults"
+
+
+def test_flow_knob_env_precedence(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FLOW_MAX_RECORDS", "7")
+    monkeypatch.setenv("RAY_TPU_FLOW_SLOW_LINK_MBPS", "2.5")
+    assert flow.configured_max_records() == 7
+    assert flow.configured_slow_link_mbps() == 2.5
+    rec = FlowRecorder()
+    assert rec.max_records == 7
+
+
+# ---------------------------------------------------------------------------
+# /api/flows + CLI (head-local runtime)
+# ---------------------------------------------------------------------------
+
+
+def _seed_head_flows(rt):
+    store = rt._cluster_metrics.flows
+    store.note_node("aa" * 8, ("10.0.0.1", 7000))
+    store.ingest("bb" * 8, _pull_batch("bb" * 8, [
+        _rec(key="seeded", nbytes=1 << 20, duration=0.1),
+        _rec(key="seeded", nbytes=1 << 20, duration=0.2)]))
+    store.ingest("cc" * 8, _pull_batch("cc" * 8, [
+        _rec(key="seeded", nbytes=1 << 20, duration=0.1)]))
+
+
+def test_api_flows_endpoint(ray_start_regular):
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.dashboard.head import DashboardHead
+
+    rt = global_worker.runtime
+    _seed_head_flows(rt)
+    head = DashboardHead(port=0)
+    port = head.start()
+    try:
+        status, body = _get(port, "/api/flows")
+        assert status == 200
+        snap = json.loads(body)
+        assert snap["window_s"] > 0
+        srcs = {lk["src"] for lk in snap["links"]}
+        assert "aa" * 8 in srcs
+        assert any(lk["bytes_total"] == 1 << 20
+                   for lk in snap["links"])
+        (obj,) = [o for o in snap["objects"] if o["key"] == "seeded"]
+        assert obj["fanout"] == 2
+        # window knob narrows the view; a malformed one is a 400.
+        status, body = _get(port, "/api/flows?window=5")
+        assert json.loads(body)["window_s"] == 5.0
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(port, "/api/flows?window=abc")
+        assert err.value.code == 400
+    finally:
+        head.stop()
+
+
+def test_cli_xfer_tables_and_json(ray_start_regular, capsys):
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.scripts import cli
+
+    _seed_head_flows(global_worker.runtime)
+    assert cli.main(["xfer"]) == 0
+    out = capsys.readouterr().out
+    assert "transfer ledger" in out
+    assert "SRC" in out and "MB/S" in out and "FAILOVER" in out
+    assert ("aa" * 8)[:12] in out
+    assert "OBJECT" in out and "FANOUT" in out
+    assert "seeded" in out
+    assert cli.main(["xfer", "--links"]) == 0
+    out = capsys.readouterr().out
+    assert "SRC" in out and "OBJECT" not in out
+    assert cli.main(["xfer", "--json"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["links"] and snap["objects"]
+
+
+def test_top_frame_renders_transfer_line():
+    from ray_tpu.scripts.cli import _render_top_frame
+
+    snap = {"window_s": 60, "nodes": [], "tasks": {}, "objects": {},
+            "timeseries": {}, "alerts": {}, "loops": {},
+            "transfer": {"mbps_total": 12.5, "links_active": 3,
+                         "top_link": {"src": "aa" * 8, "dst": "bb" * 8,
+                                      "mbps": 9.0},
+                         "max_fanout": {"key": "hotobj", "fanout": 6}}}
+    frame = _render_top_frame(snap)
+    assert "transfer 12.50MB/s over 3 link(s)" in frame
+    assert f"top {('aa' * 8)[:12]}->{('bb' * 8)[:12]} 9.00MB/s" in frame
+    assert "fanout hotobj x6" in frame
+    # No active links -> the line stays out of the frame entirely.
+    snap["transfer"] = {"mbps_total": 0.0, "links_active": 0,
+                        "top_link": None, "max_fanout": None}
+    assert "transfer" not in _render_top_frame(snap)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: live 2-daemon cluster -> resolved link cell + fan-out row
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_flow_matrix_two_daemon_cluster(monkeypatch):
+    """Cross-node pulls on a real 2-daemon cluster populate the head's
+    flow matrix with a nonzero RESOLVED link cell (node-id src AND
+    dst), the same object pulled from two nodes shows fanout >= 2, and
+    /api/flows + `ray-tpu xfer` both render it."""
+    monkeypatch.setenv("RAY_TPU_METRICS_EXPORT_INTERVAL_S", "0.2")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.dashboard.head import DashboardHead
+    procs = []
+    head = None
+    try:
+        host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+        env = {"RAY_TPU_METRICS_EXPORT_INTERVAL_S": "0.2"}
+        procs = [
+            _spawn_daemon(port, num_cpus=2, resources={"a": 2}, env=env),
+            _spawn_daemon(port, num_cpus=2, resources={"b": 2}, env=env),
+        ]
+        _wait_for_resource("a", 2)
+        _wait_for_resource("b", 2)
+
+        @ray_tpu.remote(resources={"a": 1},
+                        runtime_env={"worker_process": False})
+        def produce():
+            return bytes(4 << 20)  # over the inline limit: stays on a
+
+        @ray_tpu.remote(resources={"b": 1},
+                        runtime_env={"worker_process": False})
+        def consume(blob):
+            return len(blob)
+
+        ref = produce.remote()
+        assert ray_tpu.get(consume.remote(ref), timeout=60) == 4 << 20
+        # The head pulls the same object too: a SECOND distinct dst
+        # node for the fan-out table.
+        assert len(ray_tpu.get(ref, timeout=60)) == 4 << 20
+
+        rt = global_worker.runtime
+
+        def converged():
+            snap = rt.flows_snapshot()
+            cells = [lk for lk in snap["links"]
+                     if lk["bytes_total"] >= 4 << 20
+                     and ":" not in lk["src"] and ":" not in lk["dst"]
+                     and lk["src"] not in ("", "unknown")]
+            hot = [o for o in snap["objects"] if o["fanout"] >= 2]
+            return snap, cells, hot
+
+        deadline = time.monotonic() + 30
+        while True:
+            snap, cells, hot = converged()
+            if cells and hot:
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"flow matrix never converged: {snap}")
+            time.sleep(0.5)
+        assert cells[0]["src"] != cells[0]["dst"]
+        assert hot[0]["bytes_total"] >= 8 << 20  # two 4 MB pulls
+
+        # The same matrix through the public faces.
+        head = DashboardHead(port=0)
+        dport = head.start()
+        status, body = _get(dport, "/api/flows")
+        assert status == 200
+        api = json.loads(body)
+        assert any(lk["bytes_total"] >= 4 << 20 for lk in api["links"])
+        assert any(o["fanout"] >= 2 for o in api["objects"])
+        from ray_tpu.scripts import cli
+        import io
+        import contextlib
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert cli.main(["xfer"]) == 0
+        out = buf.getvalue()
+        assert "transfer ledger" in out
+        assert cells[0]["src"][:12] in out
+        # The top frame carries the transfer summary line.
+        top = rt.top_snapshot()
+        assert top["transfer"]["links_active"] >= 1
+    finally:
+        if head is not None:
+            head.stop()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        ray_tpu.shutdown()

@@ -41,6 +41,11 @@ runtime, so CI catches them statically:
    forever). Retry loops must pace themselves with ``channel.Backoff``
    (jittered, capped, resettable); legitimate pacing sites compute
    their delay (``next_tick - now``, ``ms / 1000``) and are untouched.
+9. Direct ``record_transfer_in``/``record_transfer_out``/
+   ``record_pull_chunks`` calls under ``ray_tpu/_private/`` outside
+   ``flow.py`` — transfer accounting must go through
+   ``FlowRecorder.record`` so the per-link flow ledger and the cluster
+   transfer scalars can never drift apart.
 """
 
 import ast
@@ -356,6 +361,39 @@ def test_no_constant_sleep_in_profiling_samplers():
         "time.sleep(<constant>) in ray_tpu/_private/profiling.py — "
         "samplers must use absolute-deadline scheduling "
         "(sleep/wait(next_tick - now)), never a fixed period: "
+        + ", ".join(offenders))
+
+
+def test_no_transfer_byte_counters_outside_flow():
+    """Transfer-byte accounting in _private/ must flow through the
+    :class:`FlowRecorder` (``_private/flow.py``): no direct
+    ``record_transfer_in``/``record_transfer_out``/``record_pull_chunks``
+    calls anywhere else. The recorder is the single place the cluster
+    scalars get bumped, so the per-link ledger and
+    ``ray_tpu_object_transfer_bytes`` can never drift apart — an ad-hoc
+    counter bump in a new dataplane path would be bytes the flow matrix
+    never saw."""
+    banned = {"record_transfer_in", "record_transfer_out",
+              "record_pull_chunks"}
+    allowed = {"flow.py", "builtin_metrics.py"}  # ledger + definitions
+    offenders = []
+    for path in _py_files(os.path.join(PKG_ROOT, "_private")):
+        if os.path.basename(path) in allowed:
+            continue
+        tree = _parse(path)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = getattr(func, "id", None) or getattr(func, "attr", None)
+            if name in banned:
+                rel = os.path.relpath(path, PKG_ROOT)
+                offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        "direct transfer byte-counter call in ray_tpu/_private/ — "
+        "account completed transfers through "
+        "flow.global_flow_recorder().record(...) so the per-link "
+        "ledger sees every byte the cluster scalar sees: "
         + ", ".join(offenders))
 
 
