@@ -734,6 +734,11 @@ def bench_shuffle_multi_daemon() -> dict:
     prev_export = _os.environ.get(export_key)
     _os.environ[export_key] = "0.5"
     ray_tpu.init(num_cpus=1)  # head out of the compute: daemons do the work
+    # Span recording feeds the per-stage time split below; the carried
+    # trace context makes daemon-side spans ride metrics_batch frames
+    # back to the head's assembler.
+    from ray_tpu.util import tracing as _tracing
+    _tracing.enable_tracing()
     procs = []
     try:
         host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
@@ -804,13 +809,140 @@ def bench_shuffle_multi_daemon() -> dict:
             {"key": o["key"][:24], "fanout": o["fanout"],
              "bytes_total": o["bytes_total"]}
             for o in flows.get("objects", [])[:5]]
+        # Per-stage time split from the run's assembled traces: how the
+        # shuffle's wall clock divided between queueing, argument pulls,
+        # and map/reduce execute — the "where did the time go" answer
+        # next to the raw MB/s.
+        try:
+            stages = rt.trace_summary().get("stages", {})
+            out["shuffle_multi_stage_split"] = {
+                stage: {"total_s": round(s["total_s"], 2),
+                        "share": round(s["share"], 3)}
+                for stage, s in sorted(
+                    stages.items(),
+                    key=lambda kv: -kv[1]["total_s"])[:8]}
+        except Exception:  # noqa: BLE001 - advisory attribution only
+            out["shuffle_multi_stage_split"] = None
     finally:
         _stop_procs(procs)
         ray_tpu.shutdown()
+        _tracing.disable_tracing()
+        _tracing.clear_spans()
         if prev_export is None:
             _os.environ.pop(export_key, None)
         else:
             _os.environ[export_key] = prev_export
+    return out
+
+
+def bench_broadcast() -> dict:
+    """Spanning-tree broadcast: one head-resident blob replicated onto
+    4 daemons through the collective dataplane (head seeds only its
+    ``fanout`` direct children; deeper nodes cascade node-to-node).
+    Reports aggregate replication MB/s, the tree depth, and the head's
+    egress share. Size via RAY_TPU_BENCH_BROADCAST_MB (default 128)."""
+    import os as _os
+    import subprocess
+    import sys
+    import time as _time
+
+    import numpy as np
+
+    import ray_tpu
+
+    out: dict = {}
+    size = int(float(_os.environ.get(
+        "RAY_TPU_BENCH_BROADCAST_MB", "128")) * 1e6)
+    n_daemons = 4
+    ray_tpu.init(num_cpus=1)
+    procs = []
+    try:
+        host, port = ray_tpu.start_head_server(port=0, host="127.0.0.1")
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.multinode",
+             "--address", f"127.0.0.1:{port}", "--num-cpus", "2",
+             "--object-store-memory", str(4 * size)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            for _ in range(n_daemons)]
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            if ray_tpu.cluster_resources().get("CPU", 0) >= \
+                    1 + 2 * n_daemons:
+                break
+            _time.sleep(0.1)
+        else:
+            raise TimeoutError("broadcast daemons never registered")
+        blob = np.random.default_rng(0).random(size // 8)
+        ref = ray_tpu.put(blob)
+        t0 = _time.perf_counter()
+        tree = ray_tpu.broadcast(ref)
+        dt = _time.perf_counter() - t0
+        assert tree["nodes"] == n_daemons, tree
+        out["broadcast_mb_per_sec"] = round(
+            tree["size"] * tree["nodes"] / 1e6 / dt, 1)
+        out["broadcast_tree_depth"] = tree["depth"]
+        out["broadcast_nodes"] = tree["nodes"]
+        out["broadcast_data_mb"] = round(tree["size"] / 1e6, 1)
+        # Head egress = fanout direct children x size; everything deeper
+        # moved node-to-node.
+        head_edges = sum(1 for e in tree["edges"]
+                         if e["ok"] and e["src"] == "head")
+        out["broadcast_head_egress_mb"] = round(
+            head_edges * tree["size"] / 1e6, 1)
+    finally:
+        _stop_procs(procs)
+        ray_tpu.shutdown()
+    return out
+
+
+def bench_pull_striped() -> dict:
+    """Striped multi-source pull: one object resident on 4 in-process
+    object servers, pulled with chunk stripes spread across all holders
+    concurrently vs pinned to a single source. Loopback sockets, so the
+    numbers measure the striping machinery, not a NIC. Size via
+    RAY_TPU_BENCH_STRIPE_MB (default 256)."""
+    import os as _os
+    import time as _time
+
+    from ray_tpu._private.dataplane import (NodeObjectTable, ObjectServer,
+                                            pull_object)
+
+    out: dict = {}
+    size = int(float(_os.environ.get(
+        "RAY_TPU_BENCH_STRIPE_MB", "256")) * 1e6)
+    payload = bytes(bytearray(_os.urandom(1 << 20)) * (size >> 20))
+    size = len(payload)
+    src = NodeObjectTable()
+    src.put("blob", payload)
+    servers = [ObjectServer(src, host="127.0.0.1") for _ in range(4)]
+    addrs = [("127.0.0.1", s.port) for s in servers]
+    prev = {k: _os.environ.get(k) for k in
+            ("RAY_TPU_PULL_CHUNK_BYTES", "RAY_TPU_PULL_PARALLELISM",
+             "RAY_TPU_PULL_STRIPE_MAX_SOURCES")}
+    _os.environ["RAY_TPU_PULL_CHUNK_BYTES"] = str(4 << 20)
+    _os.environ["RAY_TPU_PULL_PARALLELISM"] = "8"
+    try:
+        for label, nsources in (("single", 1), ("striped", 4)):
+            _os.environ["RAY_TPU_PULL_STRIPE_MAX_SOURCES"] = str(nsources)
+            best = 0.0
+            for _ in range(3):
+                dst = NodeObjectTable()
+                t0 = _time.perf_counter()
+                pull_object(addrs[0], "blob", dst, size_hint=size,
+                            fallback_addrs=addrs[1:])
+                dt = _time.perf_counter() - t0
+                with dst.pinned("blob") as got:
+                    assert len(got) == size
+                best = max(best, size / 1e6 / dt)
+            out[f"pull_{label}_mb_per_sec"] = round(best, 1)
+    finally:
+        for s in servers:
+            s.close()
+        for k, v in prev.items():
+            if v is None:
+                _os.environ.pop(k, None)
+            else:
+                _os.environ[k] = v
     return out
 
 
@@ -2239,6 +2371,8 @@ def main(argv=None):
          bench_serve_autoscale),
         ("shuffle_multi", "shuffle_multi_mb_per_sec",
          bench_shuffle_multi_daemon),
+        ("broadcast", "broadcast_mb_per_sec", bench_broadcast),
+        ("pull_striped", "pull_striped_mb_per_sec", bench_pull_striped),
         ("envelope", "envelope_tasks_per_sec", bench_envelope),
         ("detached_restart", "detached_actor_restart_ms",
          bench_detached_restart),

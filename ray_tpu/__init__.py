@@ -14,7 +14,7 @@ cancel, get_actor, ...``.
 from ray_tpu import exceptions
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.worker import (ClientContext, available_resources,
-                                     cluster_usage,
+                                     broadcast, cluster_usage,
                                      cancel, cluster_resources, free, get,
                                      get_actor, get_tpu_ids, init,
                                      is_initialized, kill, nodes, put,
@@ -37,6 +37,7 @@ __all__ = [
     "RemoteFunction",
     "__version__",
     "available_resources",
+    "broadcast",
     "cluster_usage",
     "cancel",
     "cluster_resources",

@@ -321,6 +321,35 @@ def pull_chunks() -> Counter:
         "Ranged chunks fetched by the chunked parallel pull path.")
 
 
+def broadcast_trees() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_broadcast_trees_total",
+        "Spanning-tree push broadcasts issued by the head (explicit "
+        "ray_tpu.broadcast hints + auto-triggered hot-object fan-out).")
+
+
+def push_bytes() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_push_bytes_total",
+        "Bytes replicated through push_object broadcast directives "
+        "(head seed sends + tree-edge forwards), as acknowledged by "
+        "completing nodes.")
+
+
+def lease_locality() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_lease_locality_total",
+        "Locality-aware placement outcomes for tasks with remote "
+        "argument bytes: local = landed on the node holding the "
+        "largest share, spillback = preferred node was over the "
+        "spillback threshold or lost the acquire, remote = no usable "
+        "preference (holders dead or sizes unknown).",
+        tag_keys=("outcome",))
+
+
 # -- worker pool ----------------------------------------------------------
 
 

@@ -61,6 +61,7 @@ def _send_prefixed(sock, header: bytes, *parts) -> None:
 #: either (so worker subprocesses tune without a config handle).
 DEFAULT_PULL_CHUNK_BYTES = 4 << 20
 DEFAULT_PULL_PARALLELISM = 4
+DEFAULT_PULL_STRIPE_MAX_SOURCES = 4
 _pull_cfg: Dict[str, int] = {}
 
 #: Peers whose object server predates the ranged-read op (protocol v5):
@@ -91,14 +92,26 @@ def pull_parallelism() -> int:
                                          DEFAULT_PULL_PARALLELISM)))
 
 
+def pull_stripe_max_sources() -> int:
+    """How many distinct holders one chunked pull stripes ranges across
+    concurrently. 1 restores the pre-striping behavior (alternate
+    holders are failover-only)."""
+    return max(1, _env_int("RAY_TPU_PULL_STRIPE_MAX_SOURCES",
+                           _pull_cfg.get("stripe_max_sources",
+                                         DEFAULT_PULL_STRIPE_MAX_SOURCES)))
+
+
 def configure_pulls(chunk_bytes: Optional[int] = None,
-                    parallelism: Optional[int] = None) -> None:
+                    parallelism: Optional[int] = None,
+                    stripe_max_sources: Optional[int] = None) -> None:
     """Install config-table values as this process's pull defaults
     (env vars still win; see pull_chunk_bytes/pull_parallelism)."""
     if chunk_bytes is not None:
         _pull_cfg["chunk_bytes"] = int(chunk_bytes)
     if parallelism is not None:
         _pull_cfg["parallelism"] = int(parallelism)
+    if stripe_max_sources is not None:
+        _pull_cfg["stripe_max_sources"] = int(stripe_max_sources)
 
 
 class ObjectPullError(ConnectionError):
@@ -629,6 +642,34 @@ class NodeObjectTable:
             return True
         return self._arena is not None and self._arena.contains(key)
 
+    def servable(self, key: str) -> int:
+        """Size if the object can be SERVED right now (sealed in the
+        arena, on the heap, or spilled to disk), -1 otherwise. Differs
+        from ``stat``: ``put`` records the size before the payload bytes
+        land/seal, so a stat-positive key may still be mid-copy — the
+        wait op must not wake a puller onto an unsealed entry."""
+        with self._lock:
+            if key in self._doomed:
+                return -1
+            h = self._heap.get(key)
+            if h is not None:
+                return len(h)
+            rec = self._spilled.get(key)
+            if rec is not None:
+                return rec[1]
+        if self._arena is not None:
+            view = self._arena.get_bytes(key)  # None until sealed
+            if view is not None:
+                try:
+                    return len(view)
+                finally:
+                    try:
+                        view.release()
+                    except BufferError:
+                        pass
+                    self._arena.release(key)
+        return -1
+
     def borrow_add(self, key: str) -> bool:
         """Owner-side borrow registration: a peer context deserialized a
         ref to this object. False when the object is already gone (the
@@ -1007,6 +1048,9 @@ class ObjectServer:
                 if key.startswith("@"):
                     self._serve_ranged(sock, key)
                     continue
+                if key.startswith("~"):
+                    self._serve_wait(sock, key)
+                    continue
                 # The pin spans the whole send: a concurrent free
                 # cannot recycle the region under us mid-transfer.
                 t0 = time.monotonic()
@@ -1060,6 +1104,32 @@ class ObjectServer:
         self.table._bump("served_bytes", length)
         self.table._bump("serves")
         self._record_serve(sock, real, length, time.monotonic() - t0)
+
+    def _serve_wait(self, sock: socket.socket, key: str) -> None:
+        """Blocking stat op: ``~<timeout_ms>:<key>`` parks until the
+        object is resident (tree-broadcast children start pulling the
+        moment their parent's copy commits, instead of polling), then
+        replies its size; -1 at the timeout. Encoded as an ordinary key
+        so a pre-wait peer answers -1 with framing intact and the
+        caller degrades to client-side retry."""
+        try:
+            ms_s, real = key[1:].split(":", 1)
+            deadline = time.monotonic() + max(0, int(ms_s)) / 1000.0
+        except ValueError as exc:
+            raise ConnectionError(f"malformed wait request {key!r}"
+                                  ) from exc
+        from ray_tpu._private.channel import Backoff
+        bo = Backoff(0.02, 0.25)
+        while True:
+            # servable, not stat: put() records the size before the
+            # payload seals, and waking a puller mid-copy hands it a
+            # "not resident" miss on a GB-scale landing.
+            size = self.table.servable(real)
+            if size >= 0 or self._closed or \
+                    time.monotonic() >= deadline:
+                sock.sendall(_LEN.pack(size))
+                return
+            bo.sleep()
 
     @staticmethod
     def _record_serve(sock: socket.socket, key: str, size: int,
@@ -1300,6 +1370,37 @@ def stat_remote(addr: Tuple[str, int], key: str,
     return _pooled_rpc(addr, timeout, op)
 
 
+def wait_remote(addr: Tuple[str, int], key: str,
+                timeout: float = 30.0) -> int:
+    """Block until ``key`` is resident on the peer (the tree-broadcast
+    wait: a child's pull parks on its parent's object server until the
+    parent's own copy lands). Returns the size, or -1 when the timeout
+    expires with the object still absent. Server-side waits go in short
+    rounds so pooled-socket timeouts stay tight and a peer that predates
+    the wait op (instant -1) degrades to client-side polling."""
+    addr = tuple(addr)
+    deadline = time.monotonic() + max(0.0, timeout)
+    round_s = 5.0
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return stat_remote(addr, key, timeout=round_s)
+        wait_ms = int(min(remaining, round_s) * 1000)
+
+        def op(sock, wait_ms=wait_ms):
+            kb = f"~{wait_ms}:{key}".encode()
+            _send_prefixed(sock, _LEN.pack(len(kb)), kb)
+            (size,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+            GLOBAL_PEER_CONNS.release(addr, sock)
+            return size
+
+        size = _pooled_rpc(addr, round_s + 10.0, op)
+        if size >= 0:
+            return size
+        # A pre-wait peer answers instantly: don't spin a hot loop.
+        time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
+
+
 def fetch_remote_bytes(addr: Tuple[str, int], key: str,
                        timeout: float = 30.0) -> bytearray:
     """Pull one object's payload straight into memory (contexts without
@@ -1441,21 +1542,28 @@ def _fetch_chunk(addr: Tuple[str, int], key: str, landing: _RecvLanding,
 def _pull_chunked(addrs, key: str, table: NodeObjectTable,
                   size: int, timeout: float, admission, priority: int,
                   stats: Optional[dict] = None) -> bool:
-    """Chunked parallel pull: split [0, size) into pull_chunk_bytes()
-    ranges and fetch them concurrently over up to pull_parallelism()
-    pooled sockets, each chunk landing straight in its slice of the shm
-    arena (or spill file / heap buffer). Returns False when the peer
-    lacks the ranged op (v5) — the caller falls back to the whole-object
-    fetch. Admission covers the WHOLE object for its entire flight, same
-    as the monolithic path, so parallel chunks can't oversubscribe the
-    inflight-bytes budget.
+    """Chunked parallel pull, STRIPED across holders: split [0, size)
+    into pull_chunk_bytes() ranges and fetch them concurrently over up
+    to pull_parallelism() pooled sockets, each chunk landing straight in
+    its slice of the shm arena (or spill file / heap buffer). Returns
+    False when the peer lacks the ranged op (v5) — the caller falls back
+    to the whole-object fetch. Admission covers the WHOLE object for its
+    entire flight, same as the monolithic path, so parallel chunks can't
+    oversubscribe the inflight-bytes budget.
 
-    ``addrs`` is the candidate holder list (primary first). A holder
-    that dies MID-PULL doesn't fail the pull: the shared cursor
-    advances past it and the remaining chunks resume from the next
-    holder — already-landed ranges are kept, nothing restarts
-    (reference: pull_manager retries against other location-table
-    holders)."""
+    ``addrs`` is the candidate holder list (primary first). Every live
+    holder — up to pull_stripe_max_sources() — serves ranges
+    CONCURRENTLY: workers are spread round-robin over the stripe set
+    (the per-holder inflight cap: each worker keeps at most one ranged
+    read outstanding) but all pop from ONE shared range queue, so a
+    slow holder's workers simply claim fewer ranges while fast holders'
+    workers drain the tail (work-stealing without a rebalancer). A
+    holder that dies MID-PULL doesn't fail the pull: it joins a
+    monotonic dead set — never retried within this pull, the old shared
+    cursor's guarantee generalized to many sources — and its workers
+    re-prefer the next live holder; already-landed ranges are kept,
+    nothing restarts (reference: pull_manager retries against other
+    location-table holders)."""
     addrs = [tuple(a) for a in addrs]
     chunk = pull_chunk_bytes()
     ranges = [(off, min(chunk, size - off)) for off in range(0, size, chunk)]
@@ -1464,51 +1572,63 @@ def _pull_chunked(addrs, key: str, table: NodeObjectTable,
     _flow.global_flow_recorder().begin(size)
     landing = None
     ok = False
-    # Shared failover cursor: chunk workers read the current holder and
-    # advance it (once) past a dead one. Monotonic — a holder that
-    # failed anyone is never retried within this pull.
-    cur = {"i": 0}
-    adv_lock = threading.Lock()
+    dead: set = set()
+    served: Dict[Tuple[str, int], int] = {}
+    book_lock = threading.Lock()
 
-    def fetch_with_failover(off: int, ln: int) -> None:
-        i = cur["i"]
+    def live_from(start_i: int):
+        """First live holder at/after ``start_i`` (wrapping), else
+        None — workers stay pinned to their stripe slot until it dies."""
+        with book_lock:
+            for j in range(len(addrs)):
+                h = addrs[(start_i + j) % len(addrs)]
+                if h not in dead:
+                    return h
+        return None
+
+    def fetch_with_failover(off: int, ln: int, prefer_i: int) -> None:
+        fail: Optional[BaseException] = None
         while True:
-            holder = addrs[min(i, len(addrs) - 1)]
-            fail: BaseException
+            holder = live_from(prefer_i)
+            if holder is None:
+                raise ObjectPullError(
+                    f"all {len(addrs)} holder(s) failed pulling range "
+                    f"{off} of {key}: {fail}") from fail
             try:
                 if _fetch_chunk(holder, key, landing, off, ln, timeout):
+                    with book_lock:
+                        served[holder] = served.get(holder, 0) + ln
                     return
                 fail = ObjectPullError(
                     f"peer {holder} dropped range {off} of {key} "
                     "mid-pull")
             except (OSError, ConnectionError, struct.error) as exc:
                 fail = exc
-            with adv_lock:
-                if cur["i"] == i:
-                    cur["i"] = i + 1
-                i = cur["i"]
-            if i >= len(addrs):
-                raise ObjectPullError(
-                    f"all {len(addrs)} holder(s) failed pulling range "
-                    f"{off} of {key}: {fail}") from fail
-            logger.info("pull of %s range %d failing over to holder %s",
-                        key, off, addrs[i])
+            with book_lock:
+                dead.add(holder)
+            logger.info("pull of %s range %d failing over past dead "
+                        "holder %s", key, off, holder)
 
     try:
         landing = table.begin_recv(key, size)
         # Probe with the first chunk on this thread: a -1 here means a
         # v5 peer (or a vanished object) and nothing has been spawned —
         # but a DEAD primary fails over to the next holder right away.
+        probe_i = 0
         while True:
+            holder = addrs[probe_i]
             try:
-                if not _fetch_chunk(addrs[cur["i"]], key, landing,
+                if not _fetch_chunk(holder, key, landing,
                                     ranges[0][0], ranges[0][1], timeout):
                     return False
+                with book_lock:
+                    served[holder] = served.get(holder, 0) + ranges[0][1]
                 break
             except (OSError, ConnectionError, struct.error):
-                with adv_lock:
-                    cur["i"] += 1
-                if cur["i"] >= len(addrs):
+                with book_lock:
+                    dead.add(holder)
+                probe_i += 1
+                if probe_i >= len(addrs):
                     raise
         rest = ranges[1:]
         if rest:
@@ -1516,15 +1636,21 @@ def _pull_chunked(addrs, key: str, table: NodeObjectTable,
             queue = deque(rest)
             failed = threading.Event()
             errors: list = []
+            # The stripe set: the first max_sources candidates. Dead
+            # ones are skipped by live_from at fetch time, so a stripe
+            # slot over a corpse degrades to the next live holder
+            # instead of shrinking the worker pool.
+            nsources = min(pull_stripe_max_sources(), len(addrs))
 
-            def fetch_worker() -> None:
+            def fetch_worker(slot: int) -> None:
+                prefer_i = slot % nsources
                 while not failed.is_set():
                     try:
                         off, ln = queue.popleft()
                     except IndexError:
                         return
                     try:
-                        fetch_with_failover(off, ln)
+                        fetch_with_failover(off, ln, prefer_i)
                     except BaseException as exc:  # noqa: BLE001
                         errors.append(exc)
                         failed.set()
@@ -1534,10 +1660,10 @@ def _pull_chunked(addrs, key: str, table: NodeObjectTable,
             if stats is not None:
                 stats["parallelism"] = max(1, nworkers)
             if nworkers <= 1:
-                fetch_worker()
+                fetch_worker(0)
             else:
                 threads = [threading.Thread(
-                    target=fetch_worker, daemon=True,
+                    target=fetch_worker, args=(i,), daemon=True,
                     name=f"ray_tpu-pull-chunk-{i}")
                     for i in range(nworkers)]
                 for t in threads:
@@ -1553,8 +1679,11 @@ def _pull_chunked(addrs, key: str, table: NodeObjectTable,
         if stats is not None:
             stats["bytes"] = size
             stats["chunks"] = len(ranges)
-            stats["failovers"] = stats.get("failovers", 0) + \
-                min(cur["i"], len(addrs) - 1)
+            stats["failovers"] = stats.get("failovers", 0) + len(dead)
+            stats["sources_used"] = max(
+                1, sum(1 for n in served.values() if n > 0))
+            stats["striped"] = {f"{a[0]}:{a[1]}": n
+                                for a, n in served.items() if n > 0}
         return True
     finally:
         if not ok and landing is not None:
@@ -1567,7 +1696,8 @@ def _pull_chunked(addrs, key: str, table: NodeObjectTable,
 def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
                 timeout: float = 30.0, retries: int = 2,
                 priority: int = PULL_PRIORITY_GET,
-                size_hint: int = 0, fallback_addrs=()) -> None:
+                size_hint: int = 0, fallback_addrs=(),
+                tier: str = "replica") -> None:
     """Pull one object from a peer's object server into the local table
     (read it back with ``table.pinned``). Connections are pooled and
     kept alive; a stale pooled socket retries on a fresh one without
@@ -1585,11 +1715,18 @@ def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
     whole-object fetch once, then is remembered.
 
     ``fallback_addrs`` are additional known holders (ObjectMarker
-    ``alt_addrs``, fed by the head's location table): a failed or
-    mid-flight-dead primary fails over to them — inside the chunked
-    path the remaining chunks simply resume from the next holder —
-    instead of erroring into lineage reconstruction (reference:
-    pull_manager retrying across object-directory locations)."""
+    ``alt_addrs``, fed by the head's location table). Inside the
+    chunked path they are STRIPED: up to pull_stripe_max_sources()
+    holders serve disjoint ranges concurrently (the aggregate pull
+    rides every replica's NIC, not just the primary's), and a failed
+    or mid-flight-dead holder's remaining chunks simply resume from
+    the next live one instead of erroring into lineage reconstruction
+    (reference: pull_manager retrying across object-directory
+    locations; PushManager's multi-source chunk scheduling).
+
+    ``tier`` labels this pull's flow-ledger record ("replica" for
+    ordinary marker pulls, "push" when a broadcast tree is forwarding
+    through this node)."""
     candidates = [tuple(addr)]
     for alt in fallback_addrs or ():
         alt = tuple(alt)
@@ -1609,7 +1746,8 @@ def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
         if span is not None:
             span.attributes["bytes"] = stats["bytes"]
             span.attributes["chunks"] = stats["chunks"]
-            span.attributes["sources_used"] = stats["failovers"] + 1
+            span.attributes["sources_used"] = stats.get(
+                "sources_used", stats["failovers"] + 1)
             span.attributes["failovers"] = stats["failovers"]
         try:
             _flow.global_flow_recorder().record(
@@ -1617,7 +1755,8 @@ def pull_object(addr: Tuple[str, int], key: str, table: NodeObjectTable,
                 duration_s=time.monotonic() - t0, direction="in",
                 peer=peer, chunks=stats["chunks"],
                 parallelism=stats["parallelism"],
-                failovers=stats["failovers"], outcome=outcome)
+                failovers=stats["failovers"], tier=tier,
+                outcome=outcome)
         except Exception:  # noqa: BLE001 - accounting must not fail a pull
             pass
 

@@ -68,7 +68,7 @@ DEFAULT_FANOUT_NODES = 8
 #: (matches ProfileStore/TimeSeriesStore staleness semantics).
 DEFAULT_STALENESS_S = 30.0
 
-TIERS = ("replica", "spill", "inline")
+TIERS = ("replica", "spill", "inline", "push")
 OUTCOMES = ("ok", "error")
 
 
@@ -385,6 +385,17 @@ class FlowStore:
         #: on a stale last value.
         self._published_links: set = set()
         self._published_keys: set = set()
+        #: The most recent broadcast's spanning tree (runtime-taught at
+        #: broadcast completion; `ray-tpu xfer --tree` joins its edges
+        #: against the link matrix for per-edge MB/s).
+        self._last_broadcast: Optional[dict] = None
+
+    def note_broadcast(self, tree: dict) -> None:
+        """Record the spanning tree of a completed push broadcast
+        ({key, size, fanout, depth, root, edges=[{src, dst, ok,
+        failovers}...]})."""
+        with self._lock:
+            self._last_broadcast = dict(tree, recorded_at=time.monotonic())
 
     # -- identity -------------------------------------------------------
 
@@ -612,8 +623,14 @@ class FlowStore:
                     "pulls": obj.pulls,
                     "age_s": max(0.0, now - obj.last_seen),
                 })
+            broadcast = None
+            if self._last_broadcast is not None:
+                broadcast = dict(self._last_broadcast)
+                broadcast["age_s"] = max(
+                    0.0, now - broadcast.pop("recorded_at", now))
             out = {
                 "window_s": min(w, self.window_s),
+                "broadcast": broadcast,
                 "links": sorted(links, key=lambda r: -r["mbps"]),
                 "objects": sorted(objects,
                                   key=lambda r: (-r["fanout"],

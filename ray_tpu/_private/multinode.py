@@ -905,6 +905,27 @@ class NodeConnection:
                               "size": int(size)})
         return bool(_loads(reply["value"]))
 
+    def push_object(self, key: str, size: int, *,
+                    data: Optional[bytes] = None, parent=None, alts=(),
+                    wait_timeout_s: float = 60.0,
+                    timeout: Optional[float] = None) -> dict:
+        """Tree-broadcast directive: replicate ``key`` onto this daemon.
+        ``data`` seeds the payload inline (the head feeding its direct
+        tree children); otherwise the daemon blocking-waits on
+        ``parent``'s object server and pulls, re-parenting through
+        ``alts`` if the parent dies mid-broadcast. Blocks until the
+        daemon acks the landed copy — the reply IS the completion
+        notice that updates the head's replica table."""
+        reply = self._request({
+            "type": "push_object", "key": key, "size": int(size),
+            "data": data,
+            "parent": list(parent) if parent else None,
+            "alts": [list(a) for a in alts],
+            "wait_timeout_s": float(wait_timeout_s),
+        }, timeout=timeout)
+        return _loads(reply["value"]) if reply["ok"] else \
+            self._unpack(reply, f"push_object {key}")
+
     def drop_lease(self, lease_id: str) -> None:
         """The head released this lease: the daemon retires its serial
         executor and returns the pinned worker subprocess to the pool."""
@@ -2226,6 +2247,66 @@ class NodeDaemon:
             except Exception:  # noqa: BLE001 - accounting only
                 pass
 
+    def _handle_push_object(self, msg: dict) -> dict:
+        """One spanning-tree broadcast edge landing on this node. Either
+        the payload rides inline (``data``: head seeding a direct child)
+        or this node blocking-waits on its ``parent``'s object server
+        until the parent's own copy arrives, then pulls node-to-node.
+        A dead parent re-parents through ``alts`` (grandparent, then
+        root), so one SIGKILL orphans a subtree for exactly one failover
+        instead of killing the broadcast."""
+        import time as _time
+
+        from ray_tpu._private import flow
+        from ray_tpu._private.dataplane import (PULL_PRIORITY_TASK_ARGS,
+                                                ObjectPullError, pull_object,
+                                                wait_remote)
+        key = msg["key"]
+        if self._table.stat(key) >= 0:
+            return {"bytes": 0, "failovers": 0, "secs": 0.0,
+                    "already": True}
+        data = msg.get("data")
+        if data is not None:
+            t0 = _time.monotonic()
+            self._table.put(key, data)
+            secs = _time.monotonic() - t0
+            try:
+                # Head-seeded edges are the only ones that cost head
+                # egress: the synthetic "head" peer makes them a
+                # distinct row in the flow matrix.
+                flow.global_flow_recorder().record(
+                    key=key, nbytes=len(data), duration_s=secs,
+                    direction="in", peer="head", tier="push")
+            except Exception:  # noqa: BLE001 - accounting only
+                pass
+            return {"bytes": len(data), "failovers": 0, "secs": secs}
+        wait_s = float(msg.get("wait_timeout_s", 60.0))
+        candidates = []
+        if msg.get("parent"):
+            candidates.append(tuple(msg["parent"]))
+        candidates.extend(tuple(a) for a in msg.get("alts", ()))
+        last_exc: Optional[BaseException] = None
+        for i, cand in enumerate(candidates):
+            try:
+                got = wait_remote(cand, key, timeout=wait_s)
+                if got < 0:
+                    raise ObjectPullError(
+                        f"object {key} never landed on parent "
+                        f"{cand[0]}:{cand[1]} within {wait_s:.0f}s")
+                t0 = _time.monotonic()
+                pull_object(cand, key, self._table,
+                            priority=PULL_PRIORITY_TASK_ARGS,
+                            size_hint=got,
+                            fallback_addrs=candidates[i + 1:],
+                            tier="push")
+                return {"bytes": got, "failovers": i,
+                        "secs": _time.monotonic() - t0}
+            except (ObjectPullError, OSError, ConnectionError) as exc:
+                last_exc = exc
+        raise ObjectPullError(
+            f"broadcast push of {key} failed: no parent in "
+            f"{candidates!r} produced the object") from last_exc
+
     def _resolve_markers(self, args, kwargs):
         from ray_tpu._private.dataplane import (ObjectMarker,
                                                 ObjectPullError)
@@ -2677,6 +2758,12 @@ class NodeDaemon:
                 # discipline, dataplane.NodeObjectTable.adopt).
                 self._reply(sock, req_id, value=self._table.adopt(
                     msg["key"], msg["size"]))
+            elif kind == "push_object":
+                # Tree broadcast (runs on this frame's own _route_frame
+                # thread, so a GB-scale landing never stalls the recv
+                # loop).
+                self._reply(sock, req_id,
+                            value=self._handle_push_object(msg))
             elif kind == "profile":
                 # Self-sampled stacks (reference: profile_manager.py
                 # py-spy-on-demand, here cooperative — no ptrace). A

@@ -254,6 +254,14 @@ class ObjectStore:
                 entry.size_bytes = size_bytes
             return True
 
+    def size_of(self, object_id: ObjectID) -> int:
+        """Known payload size in bytes (0 when unknown/absent). Remote
+        stub entries carry the daemon-reported size, so the head can
+        score argument-byte locality without materializing anything."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return 0 if entry is None or entry.freed else entry.size_bytes
+
     def is_materialized(self, object_id: ObjectID) -> bool:
         """True when the value is locally available (not a pending remote
         fetch) — node death cannot lose a materialized object."""

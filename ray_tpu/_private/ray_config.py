@@ -154,6 +154,14 @@ _PY_DEFAULTS: Dict[str, Any] = {
     "flow_max_objects": 512,
     "flow_slow_link_mbps": 1.0,
     "flow_fanout_nodes": 8,
+    # Collective dataplane: spanning-tree push broadcast fan-out (children
+    # per node; <= 0 disables broadcast), the cap on holders a striped
+    # multi-source pull reads from concurrently (1 = failover-only), and
+    # the utilization past which locality-aware placement spills a task
+    # away from the node holding its argument bytes.
+    "broadcast_fanout": 2,
+    "pull_stripe_max_sources": 4,
+    "locality_spillback_threshold": 0.85,
     "task_events_enabled": True,
     "memory_monitor_refresh_ms": 250,
     "memory_usage_threshold": 0.95,

@@ -489,6 +489,8 @@ class Runtime:
         self._cfg_lineage_max = int(self.config.lineage_max_entries)
         self._cfg_obj_loc_max = int(
             self.config.object_locations_max_entries)
+        self._cfg_locality_spillback = float(
+            self.config.locality_spillback_threshold)
         # ObjectID → (NodeID, daemon object key) for results resident on
         # node daemons (fetched lazily; see ObjectStore.put_remote).
         self._remote_values: Dict[ObjectID, Tuple[NodeID, str]] = {}
@@ -508,6 +510,15 @@ class Runtime:
         self._object_replicas: Dict[ObjectID, Dict[NodeID, None]] = {}
         self._spill_uris_by_key: Dict[str, Tuple[str, int]] = {}
         self._remote_keys: Dict[str, ObjectID] = {}
+        # Collective dataplane (tree broadcast): objects already pushed
+        # through a spanning tree (head-resident ones keep materialized
+        # values yet still ship as replica markers — see _resolve_args),
+        # distinct consumer nodes seen per object (the auto-broadcast
+        # demand signal), and the in-flight guard so demand spikes fire
+        # one tree, not one per queued pull.
+        self._broadcasted: Dict[ObjectID, None] = {}
+        self._pull_demand: Dict[ObjectID, Dict[NodeID, None]] = {}
+        self._broadcast_inflight: Dict[ObjectID, None] = {}
         # Ownership/reference counting (reference: reference_count.h):
         # ObjectRef handles hold local refs, pending tasks hold dependency
         # refs; when an owned object's counts hit zero its value is freed
@@ -815,6 +826,9 @@ class Runtime:
                 self._lineage.pop(oid, None)
                 self._object_locations.pop(oid, None)
                 self._object_replicas.pop(oid, None)
+                self._broadcasted.pop(oid, None)
+                self._pull_demand.pop(oid, None)
+                self._broadcast_inflight.pop(oid, None)
                 rv = self._remote_values.pop(oid, None)
                 if rv is not None:
                     remote_frees.append(rv[1])
@@ -1435,9 +1449,35 @@ class Runtime:
                     "demand).", spec.name, spec.resources,
                     self.scheduler.total)
             return None
+        # Locality-aware placement: with no explicit strategy, prefer
+        # (softly) the node already holding the largest share of this
+        # task's argument bytes — the args become local table reads
+        # instead of cross-node pulls. An overloaded preferred node
+        # spills the task back to the hybrid order.
+        launch_strategy = spec.scheduling_strategy
+        locality_node = None
+        if pg_id is None and launch_strategy is None:
+            locality_node = self._locality_preference(spec)
+            if locality_node is not None:
+                state = self.scheduler.node(locality_node)
+                if state is None or not state.alive:
+                    self._count_locality("remote")
+                    locality_node = None
+                elif state.utilization() >= self._cfg_locality_spillback:
+                    self._count_locality("spillback")
+                    locality_node = None
+                else:
+                    from ray_tpu.util.scheduling_strategies import (
+                        NodeAffinitySchedulingStrategy)
+                    launch_strategy = NodeAffinitySchedulingStrategy(
+                        node_id=locality_node.hex(), soft=True)
         acquired = self.scheduler.try_acquire(
             spec.resources, pg_id, bundle,
-            strategy=spec.scheduling_strategy)
+            strategy=launch_strategy)
+        if locality_node is not None and acquired is not None:
+            self._count_locality(
+                "local" if acquired[0] == locality_node
+                else "spillback")
         if acquired is None:
             # No idle capacity: fall back to pipelining onto a live lease
             # of this class (reference: pipelining SUPPLEMENTS additional
@@ -1446,6 +1486,10 @@ class Runtime:
             if class_key is not None:
                 lease = self._find_lease(class_key)
                 if lease is not None:
+                    if locality_node is not None:
+                        self._count_locality(
+                            "local" if lease.node_id == locality_node
+                            else "spillback")
                     self._inflight[spec.task_id] = spec
                     spec._node_id = lease.node_id
                     spec._acquired_bundle = lease.bidx
@@ -1499,6 +1543,37 @@ class Runtime:
             spec._lease = lease  # type: ignore[attr-defined]
             self.lease_stats["created"] += 1
         return (spec, worker)
+
+    def _locality_preference(self, spec: TaskSpec) -> Optional[NodeID]:
+        """The node holding the largest share of the task's ObjectRef
+        argument bytes (primary holders + broadcast/pull replicas), or
+        None when no argument lives on a daemon. Caller holds _lock."""
+        per_node: Dict[NodeID, int] = {}
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if not isinstance(a, ObjectRef):
+                continue
+            oid = a.object_id()
+            rv = self._remote_values.get(oid)
+            if rv is None:
+                continue
+            size = self.store.size_of(oid)
+            if size <= 0:
+                continue
+            per_node[rv[0]] = per_node.get(rv[0], 0) + size
+            for nid in (self._object_replicas.get(oid) or ()):
+                if nid != rv[0]:
+                    per_node[nid] = per_node.get(nid, 0) + size
+        if not per_node:
+            return None
+        return max(per_node.items(), key=lambda kv: kv[1])[0]
+
+    @staticmethod
+    def _count_locality(outcome: str) -> None:
+        try:
+            builtin_metrics.lease_locality().inc(
+                tags={"outcome": outcome})
+        except Exception:  # noqa: BLE001 - accounting only
+            pass
 
     def _launch(self, spec: TaskSpec, worker) -> None:
         """Launch tail (outside the lock) for a _try_launch_locked hit."""
@@ -1682,8 +1757,13 @@ class Runtime:
                         rec = self._spill_uris_by_key.get(rv[1])
                         if rec is not None:
                             spill_uri = rec[0]
+                # Broadcasted head-resident objects stay materialized at
+                # the head AND ship as markers: the consumer daemon's
+                # local table (tree push already landed a replica) or a
+                # nearby holder serves the bytes, never the head again.
                 if rv is not None and \
-                        not self.store.is_materialized(oid):
+                        (oid in self._broadcasted or
+                         not self.store.is_materialized(oid)):
                     if rv[0] == conn.node_id:
                         return ObjectMarker(rv[1])
                     if owner_conn is not None and \
@@ -1691,6 +1771,7 @@ class Runtime:
                         # The executing daemon will pull a copy: note the
                         # (oid, key) so task completion can register it
                         # as an in-memory replica holder.
+                        self._note_pull_demand(oid, conn.node_id)
                         pulls = getattr(spec, "_marker_pulls", None)
                         if pulls is None:
                             pulls = spec._marker_pulls = []
@@ -1699,6 +1780,12 @@ class Runtime:
                                             owner_addr=owner_conn.object_addr,
                                             alt_addrs=alt_addrs,
                                             spill_uri=spill_uri)
+            if conn is not None and \
+                    self.store.size_of(oid) >= self._cfg_inline_limit:
+                # Head-resident payload about to ship inline to a
+                # daemon: head egress. Enough distinct consumer nodes
+                # flips the object to a broadcast tree.
+                self._note_pull_demand(oid, conn.node_id)
             if to_process and self.store.native_array_key(oid) is not None:
                 from ray_tpu._private.worker_process import ArenaArrayRef
                 # The task's dependency pin keeps the entry alive until
@@ -1710,6 +1797,37 @@ class Runtime:
         args = [resolve(a) for a in spec.args]
         kwargs = {k: resolve(v) for k, v in spec.kwargs.items()}
         return args, kwargs
+
+    #: distinct consumer nodes before an object auto-upgrades from
+    #: point-to-point pulls to one spanning-tree broadcast.
+    _AUTO_BROADCAST_MIN_CONSUMERS = 4
+
+    def _note_pull_demand(self, oid: ObjectID, node_id: NodeID) -> None:
+        """Auto-broadcast trigger: the same object heading to its Nth
+        distinct node is a fan-out workload — O(N) transfers out of one
+        source become one bounded-fanout tree (O(log N) depth, source
+        egress capped at fanout x size)."""
+        with self._lock:
+            nodes = self._pull_demand.setdefault(oid, {})
+            nodes[node_id] = None
+            if len(nodes) < self._AUTO_BROADCAST_MIN_CONSUMERS or \
+                    oid in self._broadcasted or \
+                    oid in self._broadcast_inflight:
+                return
+            self._broadcast_inflight[oid] = None
+        threading.Thread(target=self._broadcast_bg, args=(oid,),
+                         daemon=True, name="auto-broadcast").start()
+
+    def _broadcast_bg(self, oid: ObjectID) -> None:
+        try:
+            self._broadcast_object(oid)
+        except Exception:  # noqa: BLE001 - broadcast is an optimization
+            logger.exception("auto-broadcast of %s failed; consumers "
+                             "fall back to point-to-point pulls",
+                             oid.hex()[:12])
+        finally:
+            with self._lock:
+                self._broadcast_inflight.pop(oid, None)
 
     def _store_results(self, spec: TaskSpec, result: Any) -> None:
         ctx = getattr(spec, "trace_ctx", None)
@@ -3426,6 +3544,166 @@ class Runtime:
         return self._cluster_metrics.profiles.stats()
 
     # -- dataplane flow plane (flow.py) ---------------------------------
+
+    def broadcast(self, ref: ObjectRef,
+                  fanout: Optional[int] = None) -> dict:
+        """Replicate one object onto every live daemon through a
+        bounded-fanout spanning tree (reference: collective broadcast —
+        the head stops being the serial source). A daemon-owned object
+        roots the tree at its holder; a head-resident one seeds only its
+        ``fanout`` direct children inline (head egress = fanout x size,
+        flat in cluster width) and every deeper node waits on its
+        parent's object server and pulls node-to-node. Blocks until the
+        whole tree settles; returns a summary dict (nodes, depth,
+        edges)."""
+        return self._broadcast_object(ref.object_id(), fanout=fanout)
+
+    def _broadcast_object(self, oid: ObjectID,
+                          fanout: Optional[int] = None) -> dict:
+        import time as _time
+        from ray_tpu._private.multinode import _dumps
+        fanout = max(1, int(fanout if fanout is not None
+                            else self.config.broadcast_fanout))
+        t_start = _time.monotonic()
+        with self._lock:
+            rv = self._remote_values.get(oid)
+            conns = {nid: c for nid, c in self._remote_nodes.items()
+                     if getattr(c, "object_addr", None) is not None}
+            holders = set(self._object_replicas.get(oid) or ())
+        payload = None
+        root_id = None
+        root_addr = None
+        if rv is not None:
+            root_id, key = rv
+            holders.add(root_id)
+            size = self.store.size_of(oid)
+            root_conn = conns.get(root_id)
+            if root_conn is None:
+                raise ValueError(
+                    f"cannot broadcast {oid.hex()[:12]}: its holder "
+                    "node is not connected")
+        else:
+            # Head-resident: serialize once, seed direct children with
+            # the bytes inline (the head has no object server to pull
+            # from), deeper nodes cascade peer-to-peer.
+            payload = _dumps(self.store.get(oid))
+            size = len(payload)
+            key = f"bcast-{oid.hex()}"
+        targets = [nid for nid in conns if nid not in holders]
+        summary = {"key": key, "size": size, "fanout": fanout,
+                   "nodes": 0, "depth": 0, "edges": []}
+        if not targets:
+            return summary
+
+        def addr(nid):
+            return tuple(conns[nid].object_addr)
+
+        if root_id is not None:
+            root_addr = addr(root_id)
+        # Array-indexed k-ary tree over [root?] + targets: parent of
+        # position p is (p-1)//fanout. Head-rooted trees have no
+        # position 0 holder — the first `fanout` targets sit at depth 1
+        # (seeded inline) and position i parents onto (i-fanout)//fanout.
+        plan = []  # (nid, parent_addr|None, alts, depth)
+        depth_of: Dict[int, int] = {}
+        root_alt = root_addr if root_id is not None else addr(targets[0])
+        for i, nid in enumerate(targets):
+            if root_id is not None:
+                pos = i + 1
+                parent_pos = (pos - 1) // fanout
+                parent = (root_addr if parent_pos == 0
+                          else addr(targets[parent_pos - 1]))
+                gp_pos = (parent_pos - 1) // fanout
+                grandp = (None if parent_pos == 0 else
+                          root_addr if gp_pos == 0
+                          else addr(targets[gp_pos - 1]))
+                depth = depth_of[pos] = \
+                    depth_of.get(parent_pos, 0) + 1
+            elif i < fanout:
+                parent = grandp = None  # head-seeded, depth 1
+                depth = depth_of[i] = 1
+            else:
+                parent_i = (i - fanout) // fanout
+                parent = addr(targets[parent_i])
+                grandp = (addr(targets[(parent_i - fanout) // fanout])
+                          if parent_i >= fanout else None)
+                depth = depth_of[i] = depth_of[parent_i] + 1
+            # Re-parenting ladder for a mid-tree death: grandparent
+            # first, then the tree root — one failover per orphaned
+            # subtree, never a dead broadcast.
+            me = addr(nid)
+            alts = [a for a in (grandp, root_alt)
+                    if a is not None and a != parent and a != me]
+            alts = list(dict.fromkeys(alts))
+            plan.append((nid, parent, alts, depth))
+        results: Dict[NodeID, Optional[dict]] = {}
+        res_lock = threading.Lock()
+
+        def _one(nid, parent, alts, depth):
+            try:
+                if parent is None and payload is not None:
+                    r = conns[nid].push_object(key, size, data=payload)
+                else:
+                    r = conns[nid].push_object(
+                        key, size, parent=parent, alts=alts,
+                        wait_timeout_s=30.0 + 15.0 * depth)
+            except Exception as exc:  # noqa: BLE001 - per-edge failure
+                logger.warning("broadcast push of %s to node %s failed:"
+                               " %s", key, nid.hex()[:12], exc)
+                r = None
+            with res_lock:
+                results[nid] = r
+
+        threads = [threading.Thread(target=_one, args=p, daemon=True,
+                                    name=f"broadcast-edge-{i}")
+                   for i, p in enumerate(plan)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        hex_of = {addr(nid): nid.hex() for nid in conns}
+        edges = []
+        for nid, parent, _alts, depth in plan:
+            r = results.get(nid)
+            edges.append({
+                "src": ("head" if parent is None and root_id is None
+                        else hex_of.get(parent, "?")),
+                "dst": nid.hex(), "depth": depth, "ok": r is not None,
+                "bytes": 0 if r is None else size,
+                "failovers": 0 if r is None else r.get("failovers", 0),
+                "secs": None if r is None else r.get("secs"),
+            })
+        ok_nodes = [nid for nid, r in results.items() if r is not None]
+        with self._lock:
+            if root_id is None and ok_nodes and \
+                    oid not in self._remote_values:
+                # The object now lives on daemons too: future consumers
+                # get replica markers instead of head-inlined payloads.
+                self._remote_values[oid] = (ok_nodes[0], key)
+                self._remote_keys[key] = oid
+                self._broadcasted[oid] = None
+            for nid in ok_nodes:
+                if (root_id is None or nid != root_id) and \
+                        len(self._object_replicas) < \
+                        self._cfg_obj_loc_max:
+                    self._object_replicas.setdefault(oid, {})[nid] = None
+        if self.gcs_store is not None:
+            try:
+                for nid in ok_nodes:
+                    self.gcs_store.record_object_replica(
+                        oid.hex(), nid.hex())
+            except OSError:
+                pass
+        builtin_metrics.broadcast_trees().inc()
+        if ok_nodes:
+            builtin_metrics.push_bytes().inc(size * len(ok_nodes))
+        summary.update(
+            nodes=len(ok_nodes),
+            depth=max((e["depth"] for e in edges), default=0),
+            edges=edges, root=(root_id.hex() if root_id else "head"),
+            duration_s=_time.monotonic() - t_start)
+        self._cluster_metrics.flows.note_broadcast(summary)
+        return summary
 
     def flows_snapshot(self, window: Optional[float] = None) -> dict:
         """The per-link transfer matrix + per-object fan-out table

@@ -54,7 +54,12 @@ from typing import Any, Dict, Tuple
 #: profile_batch push frames, profile.pid burst targeting,
 #: flow_batch push frames (dataplane transfer ledger) — optional
 #: fields / head-bound pushes old peers drop harmlessly, per the rule
-#: above.
+#: above; push_object frames (collective-dataplane tree broadcast:
+#: head->daemon directives an old daemon answers with "unknown message
+#: type", which the head's broadcast treats as a per-node miss, never a
+#: session failure) and the "~<ms>:<key>" blocking-wait object-server
+#: op (an ordinary key to an old server: instant -1, the waiter
+#: degrades to client-side polling).
 PROTOCOL_VERSION = 9
 
 
@@ -158,6 +163,24 @@ SCHEMAS: Dict[str, Dict[str, Tuple[Any, bool]]] = {
     "free_object": {"key": (_STR, True)},
     "adopt_object": {"req_id": (_INT, True), "key": (_STR, True),
                      "size": (_INT, True)},
+    # Tree-broadcast directive (additive post-v9): replicate ``key``
+    # onto this daemon. Either ``data`` carries the payload inline (the
+    # head seeding its direct children — head egress is fanout x size,
+    # not N x size) or the daemon blocking-waits on ``parent`` (an
+    # object-server [host, port]) until the parent's copy lands, then
+    # pulls — ``alts`` (grandparent/root servers) are the re-parenting
+    # failover path when an interior tree node dies mid-broadcast. The
+    # reply (bytes/failovers) is the completion notice that streams
+    # replica-table updates back as nodes finish.
+    "push_object": {
+        "req_id": (_INT, True),
+        "key": (_STR, True),
+        "size": (_INT, True),
+        "data": (_OPT_BYTES, False),
+        "parent": ((list, tuple, type(None)), False),
+        "alts": (_LIST, False),
+        "wait_timeout_s": (_NUM, False),
+    },
     # -- leases / control ----------------------------------------------
     "drop_lease": {"lease_id": (_STR, True)},
     "reclaim_tasks": {"class_id": (_STR, True), "max_n": (_INT, True)},

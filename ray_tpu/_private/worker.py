@@ -223,6 +223,19 @@ def put(value: Any) -> ObjectRef:
     return global_worker.runtime.put(value)
 
 
+def broadcast(object_ref: ObjectRef, *,
+              fanout: Optional[int] = None) -> dict:
+    """Eagerly replicate ``object_ref``'s payload onto every live node
+    through a bounded-fanout spanning tree (collective dataplane). A
+    hint, not a requirement: tasks using the ref afterwards read a
+    local replica instead of pulling from one source. Returns a summary
+    dict ({"nodes", "depth", "edges", ...}) describing the tree."""
+    if not isinstance(object_ref, ObjectRef):
+        raise TypeError("broadcast() expects an ObjectRef, got "
+                        f"{type(object_ref).__name__}")
+    return global_worker.runtime.broadcast(object_ref, fanout=fanout)
+
+
 def get(object_refs: Union[ObjectRef, Sequence[ObjectRef]],
         *, timeout: Optional[float] = None) -> Any:
     is_single = isinstance(object_refs, ObjectRef)

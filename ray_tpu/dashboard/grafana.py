@@ -127,6 +127,16 @@ _DEFAULT_PANELS = [
      "max by (link) (ray_tpu_transfer_link_mbps)", "MBs"),
     ("Top fan-out objects (nodes pulling one object)",
      "topk(10, max by (key) (ray_tpu_object_fanout_nodes))", "short"),
+    # Collective dataplane: spanning-tree broadcasts launched, bytes
+    # moved over the push tier, and how often locality placement lands
+    # a task next to its argument bytes vs spilling it elsewhere.
+    ("Broadcast trees / s", "rate(ray_tpu_broadcast_trees_total[5m])",
+     "ops"),
+    ("Broadcast push bytes / s",
+     "rate(ray_tpu_push_bytes_total[1m])", "Bps"),
+    ("Lease locality outcomes / s",
+     "sum by (outcome) (rate(ray_tpu_lease_locality_total[5m]))",
+     "ops"),
 ]
 
 

@@ -463,15 +463,52 @@ def cmd_alerts(args) -> int:
 
 
 def cmd_xfer(args) -> int:
-    """`ray-tpu xfer [--links|--objects] [--window S] [--json]` — the
-    dataplane flow plane: per-link transfer matrix (windowed MB/s, p95
-    latency, failovers/errors per src->dst node pair) and the
-    per-object pull fan-out table (broadcast amplification)."""
+    """`ray-tpu xfer [--links|--objects|--tree] [--window S] [--json]`
+    — the dataplane flow plane: per-link transfer matrix (windowed
+    MB/s, p95 latency, failovers/errors per src->dst node pair), the
+    per-object pull fan-out table (broadcast amplification), and the
+    last broadcast's spanning tree with per-edge MB/s."""
     _ensure_init()
     from ray_tpu._private.worker import global_worker
     snap = global_worker.runtime.flows_snapshot(window=args.window)
     if args.json:
         print(json.dumps(snap, indent=2, default=str))
+        return 0
+    if args.tree:
+        bc = snap.get("broadcast")
+        if not bc:
+            print("no broadcast recorded")
+            return 0
+        print(f"last broadcast — key {bc.get('key', '?')[:32]}, "
+              f"{_fmt_bytes(bc.get('size'))} to {bc.get('nodes', 0)} "
+              f"node(s), fanout {bc.get('fanout', '?')}, depth "
+              f"{bc.get('depth', 0)}, {bc.get('age_s', 0.0):.0f}s ago")
+        children: dict = {}
+        for e in bc.get("edges", []):
+            children.setdefault(e.get("src", "?"), []).append(e)
+
+        def _edge_line(e) -> str:
+            secs = e.get("secs")
+            rate = (f"{e.get('bytes', 0) / secs / 1e6:.1f} MB/s"
+                    if secs else "-")
+            line = f"{e.get('dst', '?')[:12]}  " \
+                   f"[{'ok' if e.get('ok') else 'FAILED'}, {rate}"
+            if e.get("failovers"):
+                line += f", {e['failovers']} failover(s)"
+            return line + "]"
+
+        def _walk(src: str, prefix: str) -> None:
+            kids = children.get(src, [])
+            for i, e in enumerate(kids):
+                last = i == len(kids) - 1
+                print(prefix + ("`-- " if last else "|-- ")
+                      + _edge_line(e))
+                _walk(e.get("dst", ""),
+                      prefix + ("    " if last else "|   "))
+
+        root = bc.get("root", "head")
+        print(root if root == "head" else root[:12])
+        _walk(root, "")
         return 0
     stats = snap.get("stats", {})
     print(f"transfer ledger — window {snap.get('window_s', 0):g}s — "
@@ -838,6 +875,9 @@ def main(argv=None) -> int:
                    help="only the per-link MB/s matrix")
     p.add_argument("--objects", action="store_true",
                    help="only the per-object fan-out table")
+    p.add_argument("--tree", action="store_true",
+                   help="render the last broadcast's spanning tree "
+                        "with per-edge MB/s")
     p.add_argument("--window", type=float, default=None,
                    help="MB/s window in seconds (clamped to the store's)")
     p.add_argument("--json", action="store_true",

@@ -198,6 +198,11 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     FLAG_INT(flow_max_objects, 512),
     FLAG_DBL(flow_slow_link_mbps, 1.0),
     FLAG_INT(flow_fanout_nodes, 8),
+    // Collective dataplane: broadcast tree fan-out, striped-pull source
+    // cap, locality placement spillback utilization threshold.
+    FLAG_INT(broadcast_fanout, 2),
+    FLAG_INT(pull_stripe_max_sources, 4),
+    FLAG_DBL(locality_spillback_threshold, 0.85),
     FLAG_BOOL(task_events_enabled, true),
     // -- memory monitor / OOM killing --
     FLAG_INT(memory_monitor_refresh_ms, 250),
