@@ -1401,6 +1401,141 @@ def bench_train_gang_restart() -> dict:
     return out
 
 
+def bench_sharded_checkpoint() -> dict:
+    """Sharded checkpoint save/restore at bench scale vs the monolithic
+    path, plus elastic-shrink throughput retention. A ~48 MB synthetic
+    param tree is saved (a) monolithically through
+    ``CheckpointManager.register`` (one rank-0 writer for the full
+    tree) and (b) as 4 per-rank shard files written by parallel threads
+    with the manifest committed last; restore reassembles the full tree
+    from the shards. ``train_ckpt_save_ms`` / ``train_ckpt_restore_ms``
+    are latency-gated (an INCREASE beyond threshold regresses — see
+    compare_rounds); the monolithic baseline rides along so the
+    sharded-beats-monolithic acceptance is visible in every round. The
+    retention extra shrinks an 8-rank sharded run to a 4-rank gang via
+    reshard-on-restart and reports the per-rank step-rate kept."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import time as _time
+    from concurrent.futures import ThreadPoolExecutor
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.air.checkpoint import Checkpoint
+    from ray_tpu.train._internal import sharded_checkpoint as sc
+    from ray_tpu.train._internal.checkpoint_manager import \
+        CheckpointManager
+
+    # One runtime for both halves — checkpoint-manager journal/metric
+    # emission lazily boots a runtime, and a second init() would throw.
+    ray_tpu.init(num_cpus=8)
+
+    world = 4
+    # 12 x (1024 x 1024) f32 layers = 48 MB, big enough that write
+    # bandwidth (not fixed overhead) decides the comparison.
+    state = {f"layer{i:02d}": {"w": np.random.default_rng(i)
+             .standard_normal((1024, 1024)).astype(np.float32)}
+             for i in range(12)}
+    out = {}
+    tmp = _tempfile.mkdtemp(prefix="bench_shard_ckpt_")
+    try:
+        mgr = CheckpointManager(tmp, "bench-shard")
+        t0 = _time.perf_counter()
+        mgr.register(Checkpoint.from_dict({"state": state}))
+        out["train_ckpt_save_monolithic_ms"] = round(
+            (_time.perf_counter() - t0) * 1e3, 1)
+
+        # On a real gang each rank already holds only its shard, so
+        # extraction is not part of the measured save path.
+        flat, structure = sc.flatten_tree(state)
+        specs = sc.default_specs(flat)
+        axes = [("fsdp", world)]
+        shards = [sc.extract_local_shard(flat, specs, axes, r)
+                  for r in range(world)]
+        seq = mgr.next_seq_base()
+        t0 = _time.perf_counter()
+        with ThreadPoolExecutor(max_workers=world) as pool:
+            records = list(pool.map(
+                lambda r: sc.write_shard(mgr._backend, "bench-shard",
+                                         seq, r, shards[r]),
+                range(world)))
+        meta = sc.build_tree_meta(flat, structure, specs, axes,
+                                  extra={"step": 1})
+        handle = mgr.register_sharded(seq, meta, records)
+        out["train_ckpt_save_ms"] = round(
+            (_time.perf_counter() - t0) * 1e3, 1)
+        assert handle is not None
+
+        t0 = _time.perf_counter()
+        restored = handle.load_full()
+        out["train_ckpt_restore_ms"] = round(
+            (_time.perf_counter() - t0) * 1e3, 1)
+        rflat, _ = sc.flatten_tree(restored)
+        assert all(np.array_equal(np.asarray(rflat[p]),
+                                  np.asarray(flat[p])) for p in flat)
+    finally:
+        _shutil.rmtree(tmp, ignore_errors=True)
+
+    # Elastic shrink retention: 8 ranks checkpoint sharded, the gang
+    # loses placement down to 4, resumes via reshard and keeps going.
+    from ray_tpu.air.config import FailureConfig, ScalingConfig
+    from ray_tpu.train._internal.backend_executor import BackendExecutor
+    from ray_tpu.train.backend import BackendConfig
+
+    def loop(config):
+        from ray_tpu.air import session
+        ckpt = session.get_checkpoint()
+        start = ckpt.to_dict()["step"] if ckpt else 0
+        w = session.get_world_size()
+        for i in range(start, 12):
+            session.report_sharded(
+                {"step": i, "world": w},
+                {"w": np.full((256, 16), float(i), np.float32)},
+                extra={"step": i + 1})
+            if w == 8 and i + 1 >= 4:
+                raise RuntimeError("slice lost")
+
+    storage = _tempfile.mkdtemp(prefix="bench_shard_shrink_")
+    orig_placeable = BackendExecutor._placeable_workers
+    try:
+        from ray_tpu._private.worker import global_worker
+        global_worker._runtime.config.set("train_restart_wait_s", 0.1)
+        # Only consulted on restart: the replacement gang caps at 4.
+        BackendExecutor._placeable_workers = lambda self, desired: 4
+        manager = CheckpointManager(storage, "bench-shrink")
+        executor = BackendExecutor(
+            BackendConfig(), ScalingConfig(num_workers=8, min_workers=4),
+            FailureConfig(max_failures=1), checkpoint_manager=manager)
+        executor.start()
+        rounds = []
+
+        def on_result(metrics):
+            rounds.append((_time.perf_counter(), metrics.get("world")))
+            return True
+
+        result = executor.run(loop, {}, {"trial_id": "bench-shrink"},
+                              result_callback=on_result)
+        executor.shutdown()
+        assert result.metrics["step"] == 11, result.metrics
+        assert result.metrics["world"] == 4, result.metrics
+
+        def _per_rank_rate(w):
+            ts = [t for t, ww in rounds if ww == w]
+            gaps = [b - a for a, b in zip(ts, ts[1:])]
+            return (len(gaps) / sum(gaps) / w) if gaps else 0.0
+
+        r8, r4 = _per_rank_rate(8), _per_rank_rate(4)
+        if r8 > 0:
+            out["train_shrink_mfu_retention_pct"] = round(
+                100.0 * r4 / r8, 1)
+    finally:
+        BackendExecutor._placeable_workers = orig_placeable
+        ray_tpu.shutdown()
+        _shutil.rmtree(storage, ignore_errors=True)
+    return out
+
+
 def bench_serve() -> dict:
     """Serving-plane throughput/latency (reference: release/serve_tests
     autoscaling_single_deployment + single_deployment_1k_noop_replica):
@@ -2160,7 +2295,8 @@ def _prior_round_bench():
 # test_only_throughput_suffixes_compared); these few regress when they
 # INCREASE beyond the threshold.
 _LATENCY_GATED = ("train_gang_restart_ms", "node_death_detect_ms",
-                  "object_restore_ms", "head_failover_recovery_ms")
+                  "object_restore_ms", "head_failover_recovery_ms",
+                  "train_ckpt_save_ms", "train_ckpt_restore_ms")
 
 
 def compare_rounds(prev: dict, extra: dict, headline_value,
@@ -2383,6 +2519,7 @@ def main(argv=None):
          bench_head_failover),
         ("train_gang_restart", "train_gang_restart_ms",
          bench_train_gang_restart),
+        ("sharded_ckpt", "train_ckpt_save_ms", bench_sharded_checkpoint),
         ("log_stream", "log_lines_per_sec", bench_log_streaming),
         ("metrics_overhead", "metrics_overhead_pct",
          bench_metrics_overhead),

@@ -761,6 +761,59 @@ def train_checkpoint_persist_failures() -> Counter:
         "checkpoint_persist_failures alert rule.")
 
 
+# -- sharded checkpoints ---------------------------------------------------
+# Per-rank sharded saves (train/_internal/sharded_checkpoint.py): every
+# rank writes only its local shard, the manifest commit is driver-side.
+
+
+def train_ckpt_shard_bytes() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_train_ckpt_shard_bytes_total",
+        "Bytes of checkpoint shard files written, by rank — N live rank "
+        "labels per save is the signature of the parallel sharded path "
+        "(a single-writer monolithic save only moves rank 0).",
+        tag_keys=("rank",))
+
+
+def train_ckpt_save_seconds() -> Histogram:
+    from ray_tpu.util.metrics import Histogram
+    return Histogram(
+        "ray_tpu_train_ckpt_save_seconds",
+        "End-to-end sharded save wall time: slowest rank's shard write "
+        "plus the manifest commit.",
+        boundaries=(0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0, 600.0))
+
+
+def train_ckpt_restore_seconds() -> Histogram:
+    from ray_tpu.util.metrics import Histogram
+    return Histogram(
+        "ray_tpu_train_ckpt_restore_seconds",
+        "Per-rank sharded checkpoint restore wall time (byte-range "
+        "reads + reassembly; includes reshard overlap math when the "
+        "mesh changed).",
+        boundaries=(0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0, 600.0))
+
+
+def train_reshards() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_train_reshards_total",
+        "Sharded-checkpoint resumes by mesh-change direction: shrink "
+        "(elastic gang came back smaller), grow, or same (plain "
+        "restart).",
+        tag_keys=("direction",))
+
+
+def train_ckpt_orphans_gc() -> Counter:
+    from ray_tpu.util.metrics import Counter
+    return Counter(
+        "ray_tpu_train_ckpt_orphans_gc_total",
+        "Orphaned checkpoint files garbage-collected at index load: "
+        "shard files no committed manifest references (mid-save crash "
+        "debris) and manifests with missing/corrupt shards.")
+
+
 def channel_bytes_sent() -> Counter:
     from ray_tpu.util.metrics import Counter
     return Counter(

@@ -57,7 +57,12 @@ the top of every ``handle_request``), ``spill.write_error`` /
 ``train.ping_delay_ms`` / ``train.start_delay_ms`` (train-worker gang
 RPCs, see train/_internal/worker_group.py — a fired kill makes the
 rank play dead so the BackendExecutor's system-failure gang restart is
-exercised deterministically).
+exercised deterministically), ``train.ckpt_shard_write_error`` /
+``train.ckpt_shard_kill`` (per-rank sharded checkpoint writes, see
+train/_internal/sharded_checkpoint.py — an injected ``io_oserror``
+fails that rank's shard write cleanly so the save attempt aborts
+without committing, while a kill takes the rank down mid-save to prove
+torn shard sets stay uncommitted and get garbage-collected).
 
 Hot paths guard on the module-level :data:`ACTIVE` flag, so with chaos
 disabled the per-frame cost is a single attribute read and no call.

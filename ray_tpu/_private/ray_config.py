@@ -111,6 +111,13 @@ _PY_DEFAULTS: Dict[str, Any] = {
     # before shrinking to ScalingConfig.min_workers.
     "train_hang_timeout_s": 60.0,
     "train_restart_wait_s": 30.0,
+    # Sharded checkpoints: reader-side fan-out of per-parameter loads,
+    # whether full-block restores/GC validate crc32 checksums, and
+    # whether a gang whose size differs from the saved mesh may resume
+    # by resharding (off = refuse instead of silently reshaping).
+    "train_ckpt_shard_parallelism": 8,
+    "train_ckpt_verify_checksums": True,
+    "train_reshard_on_restart": True,
     "metrics_report_interval_ms": 10_000,
     # Distributed tracing: head-of-trace sampling probability (decided
     # once at the driver, carried in the propagated context) and how

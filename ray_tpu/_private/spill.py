@@ -165,6 +165,50 @@ class SpillBackend:
             return None
         return data
 
+    def read_range(self, uri: str, offset: int, length: int
+                   ) -> Optional[bytes]:
+        """Read ``length`` bytes at ``offset`` from a spilled payload —
+        the byte-range primitive behind sharded-checkpoint resharding
+        (a restarted gang pulls only the slices it needs from each
+        saved shard, not whole files). Same tier-miss contract as
+        :meth:`read`: ``None`` on a missing/short file or an injected
+        restore error."""
+        path = self.path_for(uri)
+        try:
+            if chaos.ACTIVE:
+                chaos.maybe_inject("spill.restore_error")
+            with open(path, "rb") as f:
+                data = os.pread(f.fileno(), length, offset)
+        except OSError:
+            _count_failure("restore")
+            return None
+        if len(data) < length:
+            _count_failure("restore")
+            logger.warning(
+                "spilled payload %s truncated (%d < %d bytes at +%d)",
+                path, len(data), length, offset)
+            return None
+        return data
+
+    def list_files(self, prefix: str = ""):
+        """Filenames under this backend's root starting with ``prefix``
+        (``.tmp`` turds excluded) — lets index loaders reconcile what
+        storage actually holds against what was committed (orphan-shard
+        garbage collection). Returns [] when the root doesn't exist."""
+        try:
+            names = os.listdir(self._root)
+        except OSError:
+            return []
+        return sorted(n for n in names
+                      if n.startswith(prefix) and not n.endswith(".tmp"))
+
+    def size_of(self, uri: str) -> Optional[int]:
+        """On-storage byte size of a spilled payload (None if missing)."""
+        try:
+            return os.stat(self.path_for(uri)).st_size
+        except OSError:
+            return None
+
     # -- landing (chunked recv straight to backend storage) ---------------
 
     def create_landing(self, filename: str, size: int) -> "SpillLanding":

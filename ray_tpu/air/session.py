@@ -27,7 +27,8 @@ class _Session:
                  local_rank: int = 0, trial_id: str = "",
                  trial_name: str = "", config: Optional[dict] = None,
                  checkpoint: Optional[Checkpoint] = None,
-                 dataset_shards: Optional[dict] = None):
+                 dataset_shards: Optional[dict] = None,
+                 ckpt_ctx: Optional[dict] = None):
         self.world_rank = world_rank
         self.world_size = world_size
         self.local_rank = local_rank
@@ -36,6 +37,15 @@ class _Session:
         self.config = config or {}
         self.loaded_checkpoint = checkpoint
         self.dataset_shards = dataset_shards or {}
+        # Sharded-checkpoint context from the BackendExecutor: the run
+        # name, storage URI, and agreed seq base every rank writes its
+        # shard files under (see report_sharded).
+        self.ckpt_ctx = ckpt_ctx
+        # Set by the TrainWorker so a chaos kill fired inside a shard
+        # write makes the whole rank play dead, not just the one call.
+        self.on_chaos_kill = None
+        self._shard_reports = 0
+        self._shard_backend = None
         # Size-1 queue: the worker blocks in report() until the driver drains
         # (reference: train/_internal/session.py:63 queue.Queue(1)).
         self.result_queue: "queue.Queue" = queue.Queue(1)
@@ -44,15 +54,68 @@ class _Session:
         self.finished = False
 
     def report(self, metrics: Dict[str, Any],
-               checkpoint: Optional[Checkpoint] = None) -> None:
+               checkpoint: Optional[Checkpoint] = None,
+               shard: Optional[dict] = None) -> None:
         if self.stop_requested:
             raise StopSession()
-        self.result_queue.put({"metrics": dict(metrics),
-                               "checkpoint": checkpoint})
+        result = {"metrics": dict(metrics), "checkpoint": checkpoint}
+        if shard is not None:
+            result["shard"] = shard
+        self.result_queue.put(result)
         self.continue_event.wait()
         self.continue_event.clear()
         if self.stop_requested:
             raise StopSession()
+
+    def report_sharded(self, metrics: Dict[str, Any], state: Any,
+                       specs: Optional[dict] = None,
+                       axes_items=None,
+                       extra: Optional[Dict[str, Any]] = None) -> None:
+        """Report metrics plus THIS RANK's checkpoint shard.
+
+        Phase one of the two-phase sharded save: the rank extracts its
+        local parameter blocks from ``state`` (per ``specs``; default:
+        dim 0 of every array over an ``fsdp`` axis of ``world_size``)
+        and writes one ``.shard-<rank>`` file through the run's spill
+        backend. The shard record rides the ordinary result payload to
+        the driver as the write's ack; the driver commits the manifest
+        only once every rank acked. A failed write reports
+        ``{"error": ...}`` instead — the driver fails that save attempt
+        cleanly and training continues from the previous checkpoint.
+        """
+        from ray_tpu._private import chaos, spill
+        from ray_tpu.train._internal import sharded_checkpoint as sc
+        ctx = self.ckpt_ctx
+        if ctx is None:
+            raise RuntimeError(
+                "report_sharded needs a sharded-checkpoint context: run "
+                "under a trainer with RunConfig.storage_path set")
+        if self._shard_backend is None:
+            self._shard_backend = spill.backend_for_uri(
+                ctx["storage_uri"], session_id=ctx.get("session_id", ""))
+        seq = int(ctx["seq_base"]) + self._shard_reports
+        self._shard_reports += 1
+        if axes_items is None:
+            axes_items = [("fsdp", self.world_size)]
+        flat, structure = sc.flatten_tree(state)
+        if specs is None:
+            specs = sc.default_specs(flat, axis=axes_items[0][0])
+        try:
+            local = sc.extract_local_shard(flat, specs, axes_items,
+                                           self.world_rank)
+            record = sc.write_shard(self._shard_backend, ctx["run"], seq,
+                                    self.world_rank, local)
+        except chaos.ChaosKill:
+            if self.on_chaos_kill is not None:
+                self.on_chaos_kill()
+            raise
+        except spill.SpillFailure as exc:
+            record = {"seq": seq, "rank": self.world_rank,
+                      "error": str(exc)}
+        if self.world_rank == 0 and "error" not in record:
+            record["tree_meta"] = sc.build_tree_meta(
+                flat, structure, specs, axes_items, extra)
+        self.report(metrics, shard=record)
 
 
 # One session per OS thread: train workers are actor threads, so
@@ -82,6 +145,15 @@ def _require_session() -> _Session:
 def report(metrics: Dict[str, Any],
            checkpoint: Optional[Checkpoint] = None) -> None:
     _require_session().report(metrics, checkpoint)
+
+
+def report_sharded(metrics: Dict[str, Any], state: Any,
+                   specs: Optional[dict] = None, axes_items=None,
+                   extra: Optional[Dict[str, Any]] = None) -> None:
+    """Report metrics + this rank's shard of ``state`` (per-rank sharded
+    checkpointing; commits when every rank of the round has reported)."""
+    _require_session().report_sharded(metrics, state, specs=specs,
+                                      axes_items=axes_items, extra=extra)
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

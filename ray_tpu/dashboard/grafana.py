@@ -97,6 +97,12 @@ _DEFAULT_PANELS = [
      "ops"),
     ("Train checkpoints persisted / s",
      "rate(ray_tpu_train_checkpoints_persisted_total[5m])", "ops"),
+    ("Train ckpt shard write bytes / s (by rank)",
+     "sum by (rank) (rate(ray_tpu_train_ckpt_shard_bytes_total[5m]))",
+     "Bps"),
+    ("Train reshards / s (by direction)",
+     "sum by (direction) (rate(ray_tpu_train_reshards_total[5m]))",
+     "ops"),
     ("Worker pool size", "ray_tpu_worker_pool_size", "short"),
     ("Worker lease wait p95 (s)",
      "histogram_quantile(0.95, "

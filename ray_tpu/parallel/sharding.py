@@ -66,6 +66,74 @@ def context_parallel_rules() -> ShardingRules:
     return ShardingRules(sequence="sp")
 
 
+# Shard-slice math (checkpoint resharding) ------------------------------
+# Pure-index GSPMD block partitioning: given a parameter's global shape,
+# a PartitionSpec-like spec, and a mesh described as ordered
+# (axis, size) pairs, compute which index block one mesh coordinate
+# owns. Balanced ``array_split`` boundaries (first ``S % N`` shards get
+# one extra row) so a checkpoint saved on 8 ranks can be resharded onto
+# 6 — elastic shrink/grow never requires divisibility.
+
+
+def axis_split_bounds(dim_size: int, num_shards: int):
+    """[(start, stop)] per shard along one dimension, balanced."""
+    if num_shards <= 0:
+        raise ValueError(f"num_shards must be positive, got {num_shards}")
+    base, extra = divmod(dim_size, num_shards)
+    bounds = []
+    start = 0
+    for i in range(num_shards):
+        stop = start + base + (1 if i < extra else 0)
+        bounds.append((start, stop))
+        start = stop
+    return bounds
+
+
+def _spec_dim_axes(dim_spec) -> Tuple[str, ...]:
+    """Normalize one dimension's spec entry to a tuple of mesh axes."""
+    if dim_spec is None:
+        return ()
+    if isinstance(dim_spec, str):
+        return (dim_spec,)
+    return tuple(dim_spec)
+
+
+def shard_slices(global_shape, spec, axes, coords) -> Tuple[slice, ...]:
+    """The index block one mesh position owns under ``spec``.
+
+    ``axes`` maps mesh axis name -> size; ``coords`` maps axis name ->
+    this position's index on that axis. A dimension sharded over a
+    tuple of axes composes them row-major (same ordering GSPMD uses).
+    Dimensions with no spec entry (or None) are fully replicated.
+    """
+    out = []
+    for d, size in enumerate(global_shape):
+        dim_axes = _spec_dim_axes(spec[d]) if d < len(spec) else ()
+        n = 1
+        idx = 0
+        for name in dim_axes:
+            n *= int(axes[name])
+            idx = idx * int(axes[name]) + int(coords[name])
+        if n <= 1:
+            out.append(slice(0, size))
+        else:
+            start, stop = axis_split_bounds(size, n)[idx]
+            out.append(slice(start, stop))
+    return tuple(out)
+
+
+def slices_overlap(a, b):
+    """Intersection of two same-rank slice tuples, or None if empty."""
+    out = []
+    for sa, sb in zip(a, b):
+        start = max(sa.start, sb.start)
+        stop = min(sa.stop, sb.stop)
+        if start >= stop:
+            return None
+        out.append(slice(start, stop))
+    return tuple(out)
+
+
 # Helpers ---------------------------------------------------------------
 
 def named_sharding(mesh, spec: PartitionSpec) -> NamedSharding:

@@ -4,6 +4,7 @@ from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
 from ray_tpu.air.result import Result
 from ray_tpu.train.backend import Backend, BackendConfig
 from ray_tpu.train.base_trainer import BaseTrainer, DataParallelTrainer
+from ray_tpu.train._internal.sharded_checkpoint import ShardedCheckpoint
 from ray_tpu.train.jax import JaxBackendConfig, JaxTrainer, prepare_mesh
 from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
 
@@ -23,5 +24,6 @@ __all__ = [
     "Result",
     "RunConfig",
     "ScalingConfig",
+    "ShardedCheckpoint",
     "prepare_mesh",
 ]

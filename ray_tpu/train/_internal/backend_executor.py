@@ -323,10 +323,108 @@ class BackendExecutor:
 
     # -- one gang attempt --------------------------------------------------
 
+    def _reshard_accounting(self, checkpoint, new_world: int) -> None:
+        """When the gang resumes a sharded checkpoint, record whether
+        the mesh changed — and refuse if resharding was disabled."""
+        from ray_tpu.train._internal.sharded_checkpoint import \
+            ShardedCheckpoint
+        if not isinstance(checkpoint, ShardedCheckpoint):
+            return
+        saved = checkpoint.world_size
+        direction = "same" if new_world == saved else \
+            ("shrink" if new_world < saved else "grow")
+        if direction != "same" and not bool(
+                runtime_config_value("train_reshard_on_restart", True)):
+            # Deliberately NOT a TrainingFailedError: a config veto must
+            # not be retried away by the gang-restart loop.
+            raise RuntimeError(
+                f"checkpoint seq={checkpoint.seq} was saved on {saved} "
+                f"ranks but the gang now has {new_world} and "
+                f"train_reshard_on_restart is disabled")
+        try:
+            from ray_tpu._private import builtin_metrics, events
+            builtin_metrics.train_reshards().inc(
+                tags={"direction": direction})
+            events.emit(
+                "train",
+                f"resuming sharded checkpoint seq={checkpoint.seq} on "
+                f"{new_world} rank(s) (saved on {saved}: {direction})",
+                severity="warning" if direction != "same" else "info",
+                labels={"event": "reshard", "direction": direction,
+                        "saved_world": str(saved),
+                        "new_world": str(new_world)})
+        except Exception:  # noqa: BLE001 - accounting never breaks resume
+            pass
+
+    def _ckpt_ctx(self) -> Optional[dict]:
+        """The sharded-save context handed to every rank: run identity,
+        storage URI, and the seq base this attempt's saves start at."""
+        mgr = self.checkpoint_manager
+        if mgr is None:
+            return None
+        return {"run": mgr.run_name, "storage_uri": mgr.base_uri,
+                "session_id": getattr(mgr._backend, "session_id", ""),
+                "seq_base": mgr.next_seq_base()}
+
+    def _commit_sharded(self, shard_acks: Dict[int, dict], world: int,
+                        metrics: Optional[dict]):
+        """Phase two of a sharded save: commit iff EVERY rank acked a
+        clean shard write under one agreed seq. Anything less — a rank
+        that reported an error, a missing ack, disagreeing seqs — fails
+        this save attempt cleanly (the previous committed checkpoint
+        still stands) and never writes a manifest."""
+        records = [shard_acks[r] for r in sorted(shard_acks)]
+        errors = {r["rank"]: r["error"] for r in records if r.get("error")}
+        seqs = {int(r["seq"]) for r in records}
+        why = None
+        if errors:
+            why = f"shard write failed on rank(s) {sorted(errors)}: " \
+                  f"{list(errors.values())[0]}"
+        elif len(shard_acks) != world:
+            why = f"only {len(shard_acks)}/{world} ranks acked a shard"
+        elif len(seqs) != 1:
+            why = f"ranks disagree on save seq: {sorted(seqs)}"
+        elif not any("tree_meta" in r for r in records):
+            why = "no rank supplied the tree metadata"
+        if why is not None:
+            logger.warning("sharded save attempt not committed: %s", why)
+            try:
+                from ray_tpu._private import builtin_metrics, events
+                builtin_metrics.train_checkpoint_persist_failures().inc()
+                events.emit("train", f"sharded save aborted: {why}",
+                            severity="error",
+                            labels={"event": "ckpt_abort",
+                                    "seq": str(min(seqs)) if seqs else ""})
+            except Exception:  # noqa: BLE001
+                pass
+            return None
+        if self.checkpoint_manager is None:
+            logger.warning("sharded save reported but no checkpoint "
+                           "manager is attached; dropping")
+            return None
+        seq = seqs.pop()
+        meta = next(r["tree_meta"] for r in records if "tree_meta" in r)
+        t0 = time.perf_counter()
+        handle = self.checkpoint_manager.register_sharded(
+            seq, meta, records, metrics=metrics)
+        if handle is not None:
+            # Wall time of the save: the slowest rank's shard write
+            # plus the manifest commit.
+            elapsed = max(float(r.get("write_s", 0.0)) for r in records) \
+                + (time.perf_counter() - t0)
+            try:
+                from ray_tpu._private import builtin_metrics
+                builtin_metrics.train_ckpt_save_seconds().observe(elapsed)
+            except Exception:  # noqa: BLE001
+                pass
+        return handle
+
     def _run_once(self, train_fn, config, trial_info, checkpoint,
                   dataset_shards_per_worker, result_callback) -> Result:
         group = self.worker_group
         latest_checkpoint = checkpoint
+        self._reshard_accounting(checkpoint, len(group.workers))
+        ckpt_ctx = self._ckpt_ctx()
         try:
             self.backend.on_training_start(group, self.backend_config)
         except BaseException as exc:  # noqa: BLE001
@@ -339,7 +437,8 @@ class BackendExecutor:
                       if dataset_shards_per_worker and
                       rank < len(dataset_shards_per_worker) else None)
             starts[worker.start_training.remote(
-                train_fn, config, trial_info, checkpoint, shards)] = rank
+                train_fn, config, trial_info, checkpoint, shards,
+                ckpt_ctx)] = rank
         self._drain(starts, latest_checkpoint, lambda rank, payload: None)
 
         history: List[Dict[str, Any]] = []
@@ -389,6 +488,17 @@ class BackendExecutor:
                     else:
                         latest_checkpoint = reported
                     break
+            # Sharded saves: each live rank's payload carries its shard
+            # write ack; all acks clean -> commit the manifest.
+            shard_acks = {rank: p["shard"]
+                          for rank, p in round_payloads.items()
+                          if not p.get("finished") and p.get("shard")}
+            if shard_acks:
+                committed = self._commit_sharded(
+                    shard_acks, len(group.workers),
+                    round_payloads.get(0, {}).get("metrics"))
+                if committed is not None:
+                    latest_checkpoint = committed
             # Rank 0's stream is canonical for metrics (reference behavior);
             # rounds after rank 0 finishes aren't recorded.
             rank0 = round_payloads.get(0)

@@ -53,6 +53,9 @@ class TrainWorker:
                 message=f"train worker rank {self.world_rank} is dead "
                         "(chaos kill)")
 
+    def _mark_chaos_dead(self) -> None:
+        self._chaos_dead = True
+
     def setup_env(self, env: Dict[str, str]) -> None:
         """Backend hook: set process env (e.g. jax.distributed coordinator)."""
         import os
@@ -78,7 +81,8 @@ class TrainWorker:
 
     def start_training(self, train_fn: Callable, config: dict,
                        trial_info: dict,
-                       checkpoint=None, dataset_shards: Optional[dict] = None
+                       checkpoint=None, dataset_shards: Optional[dict] = None,
+                       ckpt_ctx: Optional[dict] = None
                        ) -> None:
         self._chaos_gate("train.start_delay_ms")
         self.session = _Session(
@@ -90,7 +94,13 @@ class TrainWorker:
             config=config,
             checkpoint=checkpoint,
             dataset_shards=dataset_shards,
+            ckpt_ctx=ckpt_ctx,
         )
+        # A chaos kill fired mid-shard-write takes the whole rank down:
+        # the session flags the actor dead, so every later RPC raises
+        # ActorDiedError — the same observable behavior as a real
+        # SIGKILL landing between a shard write and its ack.
+        self.session.on_chaos_kill = self._mark_chaos_dead
         sess = self.session
         # The actor's runtime_env env_vars are APPLIED around this
         # method call only — but the train loop runs in a thread that

@@ -159,6 +159,12 @@ const std::unordered_map<std::string, Flag> kDefaults = {
     // ScalingConfig.min_workers.
     FLAG_DBL(train_hang_timeout_s, 60.0),
     FLAG_DBL(train_restart_wait_s, 30.0),
+    // Sharded checkpoints: per-parameter restore fan-out, crc32
+    // verification on full-block reads/GC, and whether a resized gang
+    // may resume by resharding (off = refuse).
+    FLAG_INT(train_ckpt_shard_parallelism, 8),
+    FLAG_BOOL(train_ckpt_verify_checksums, true),
+    FLAG_BOOL(train_reshard_on_restart, true),
     // -- metrics / events --
     FLAG_INT(metrics_report_interval_ms, 10000),
     // Distributed tracing: head-of-trace sampling probability and the

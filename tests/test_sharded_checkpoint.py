@@ -1,0 +1,594 @@
+"""Sharded, crash-safe, reshardable train checkpoints (ISSUE 20).
+
+Covers the whole two-phase-commit contract: per-rank shard writes
+through the spill backends with the rank-0 manifest written last as the
+commit record, uncommitted shard sets invisible to ``latest()`` and
+garbage-collected on the next index load, checksum rejection of corrupt
+shards, chaos ``io_oserror`` on a shard write failing that save attempt
+cleanly, a SIGKILLed-rank-mid-save gang restart that resumes the last
+committed checkpoint, elastic shrink (8 -> 4) resuming via reshard with
+numerically identical parameters, ``num_to_keep`` pruning that removes
+manifest + all shards, the mock-s3 backend, and the new config knobs.
+"""
+
+import os
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import ray_tpu
+
+# Actor threads may unpickle these train loops outside the tests/
+# package — ship this module by value (same idiom as the other train
+# suites).
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+from ray_tpu._private import builtin_metrics, chaos, events, spill  # noqa: E402
+from ray_tpu.air import (CheckpointConfig, FailureConfig, RunConfig,  # noqa: E402
+                         ScalingConfig, session)
+from ray_tpu.train import DataParallelTrainer, ShardedCheckpoint  # noqa: E402
+from ray_tpu.train._internal import sharded_checkpoint as sc  # noqa: E402
+from ray_tpu.train._internal.backend_executor import (  # noqa: E402
+    BackendExecutor, TrainingFailedError)
+from ray_tpu.train._internal.checkpoint_manager import (  # noqa: E402
+    CheckpointManager)
+from ray_tpu.train.backend import BackendConfig  # noqa: E402
+
+
+def _counter_total(counter, tag_substr=None):
+    if tag_substr is None:
+        return sum(counter.series().values())
+    return sum(v for k, v in counter.series().items()
+               if any(tag_substr in str(part) for part in k))
+
+
+def _set_flag(name, value):
+    from ray_tpu._private.worker import global_worker
+    global_worker._runtime.config.set(name, value)
+
+
+def _state_at(step):
+    """Deterministic full training state as a function of the step —
+    every rank can recompute it, so restores are checkable exactly."""
+    base = np.arange(13 * 4, dtype=np.float32).reshape(13, 4)
+    return {"w": base * float(step + 1),
+            "b": np.full((7,), float(step), np.float32),
+            "opt": [np.ascontiguousarray(base.T) / float(step + 1),
+                    np.float32(step)]}
+
+
+def _trees_equal(a, b):
+    fa, _ = sc.flatten_tree(a)
+    fb, _ = sc.flatten_tree(b)
+    if set(fa) != set(fb):
+        return False
+    return all(np.array_equal(np.asarray(fa[p]), np.asarray(fb[p]))
+               for p in fa)
+
+
+def _save_sharded(backend, run, seq, state, world, extra=None):
+    """Write all shards + commit a manifest directly (no gang)."""
+    flat, structure = sc.flatten_tree(state)
+    axes = [("fsdp", world)]
+    specs = sc.default_specs(flat)
+    records = [
+        sc.write_shard(backend, run, seq, rank,
+                       sc.extract_local_shard(flat, specs, axes, rank))
+        for rank in range(world)
+    ]
+    meta = sc.build_tree_meta(flat, structure, specs, axes, extra=extra)
+    manifest = sc.build_manifest(run, seq, meta, records)
+    uri = sc.write_manifest(backend, run, seq, manifest)
+    return manifest, uri, records
+
+
+# ---------------------------------------------------------------------------
+# Shard math
+# ---------------------------------------------------------------------------
+
+
+def test_axis_split_bounds_balanced():
+    assert sc.axis_split_bounds(8, 4) == [(0, 2), (2, 4), (4, 6), (6, 8)]
+    # Non-divisible: the first S % N shards carry one extra row and the
+    # bounds tile the dimension exactly — the property resharding needs.
+    bounds = sc.axis_split_bounds(13, 6)
+    assert bounds[0] == (0, 3)
+    assert bounds[-1] == (11, 13)
+    assert [b - a for a, b in bounds] == [3, 2, 2, 2, 2, 2]
+    # More shards than rows: trailing shards own empty ranges.
+    assert sc.axis_split_bounds(2, 4)[-1] == (2, 2)
+    with pytest.raises(ValueError):
+        sc.axis_split_bounds(4, 0)
+
+
+def test_shard_slices_and_overlap():
+    axes = {"dp": 2, "fsdp": 2}
+    # Dim 0 sharded over a tuple of axes composes row-major.
+    spec = [["dp", "fsdp"], []]
+    blocks = [sc.shard_slices((8, 3), spec, axes,
+                              {"dp": d, "fsdp": f})
+              for d in range(2) for f in range(2)]
+    assert [b[0] for b in blocks] == [
+        slice(0, 2), slice(2, 4), slice(4, 6), slice(6, 8)]
+    assert all(b[1] == slice(0, 3) for b in blocks)
+    assert sc.slices_overlap((slice(0, 4),), (slice(2, 6),)) == \
+        (slice(2, 4),)
+    assert sc.slices_overlap((slice(0, 2),), (slice(2, 6),)) is None
+    # 0-d leaves: empty slice tuples overlap as () — NOT None.
+    assert sc.slices_overlap((), ()) == ()
+
+
+def test_normalize_spec_accepts_partition_spec():
+    from jax.sharding import PartitionSpec
+    assert sc.normalize_spec(PartitionSpec("fsdp", None), 2) == \
+        [["fsdp"], []]
+    assert sc.normalize_spec(PartitionSpec(("dp", "fsdp")), 2) == \
+        [["dp", "fsdp"], []]
+    assert sc.normalize_spec(None, 2) == [[], []]
+
+
+# ---------------------------------------------------------------------------
+# Manifest round-trip + restore/reshard (no cluster needed)
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_roundtrip_and_full_restore(tmp_path):
+    backend = spill.FileSpillBackend(str(tmp_path))
+    state = _state_at(5)
+    manifest, uri, records = _save_sharded(backend, "rt", 3, state, 8,
+                                           extra={"step": 5})
+    assert len(records) == 8
+    ck = ShardedCheckpoint.from_manifest_uri(uri)
+    assert ck.seq == 3 and ck.world_size == 8
+    assert ck.extra == {"step": 5}
+    assert ck.to_dict() == {"step": 5}
+    restored = ck.load_full()
+    assert _trees_equal(restored, state)
+    # Container types survive the structure skeleton.
+    assert isinstance(restored, dict) and isinstance(restored["opt"], list)
+    # Monolithic payload APIs are refused, loudly.
+    with pytest.raises(ValueError, match="load_for_rank"):
+        ck.to_directory()
+
+
+@pytest.mark.parametrize("new_world", [6, 4])
+def test_reshard_numerical_identity(tmp_path, new_world):
+    """A checkpoint saved on 8 ranks reassembles bit-identically on 6
+    or 4 — per-rank blocks pulled as byte ranges from the old shards."""
+    backend = spill.FileSpillBackend(str(tmp_path))
+    state = _state_at(2)
+    manifest, uri, _ = _save_sharded(backend, "rs", 1, state, 8)
+    ck = ShardedCheckpoint.from_manifest_uri(uri)
+    new_axes = [("fsdp", new_world)]
+    reassembled = {p: np.empty(tuple(m["shape"]), np.dtype(m["dtype"]))
+                   for p, m in manifest["params"].items()}
+    for rank in range(new_world):
+        local, _ = sc.flatten_tree(ck.load_for_rank(rank, new_world))
+        coords = sc.rank_coords(rank, new_axes)
+        for p, arr in local.items():
+            slc = sc.shard_slices(tuple(manifest["params"][p]["shape"]),
+                                  manifest["specs"][p], dict(new_axes),
+                                  coords)
+            reassembled[p][slc] = arr
+    flat, structure = sc.flatten_tree(state)
+    for p in flat:
+        assert np.array_equal(np.asarray(flat[p]), reassembled[p]), p
+
+
+def test_checksum_rejection(tmp_path):
+    backend = spill.FileSpillBackend(str(tmp_path))
+    manifest, uri, records = _save_sharded(backend, "crc", 1,
+                                           _state_at(0), 2)
+    # Corrupt one shard in place (same size, so only the crc catches it).
+    victim = backend.path_for(backend.uri_for(records[1]["file"]))
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.seek(size // 2)
+        f.write(b"\xff\xff\xff\xff")
+    ck = ShardedCheckpoint.from_manifest_uri(uri)
+    with pytest.raises(ValueError, match="checksum"):
+        ck.load_full(verify=True)
+
+
+# ---------------------------------------------------------------------------
+# Two-phase commit: visibility, orphan GC, adoption, pruning
+# ---------------------------------------------------------------------------
+
+
+def test_uncommitted_shards_invisible_and_gcd(tmp_path):
+    """Shard files without a manifest (rank died before the commit) are
+    invisible to latest() and swept by the next index load."""
+    mgr = CheckpointManager(str(tmp_path), "torn")
+    backend = mgr._backend
+    flat, structure = sc.flatten_tree(_state_at(1))
+    specs = sc.default_specs(flat)
+    for rank in range(2):  # both shards land, the manifest never does
+        sc.write_shard(backend, "torn", 1, rank,
+                       sc.extract_local_shard(flat, specs,
+                                              [("fsdp", 2)], rank))
+    assert mgr.latest() is None
+    assert len(backend.list_files("train-torn-ckpt-")) == 2
+    orphans_before = _counter_total(builtin_metrics.train_ckpt_orphans_gc())
+    events.drain_pending()
+    mgr2 = CheckpointManager(str(tmp_path), "torn")
+    assert mgr2.latest() is None
+    assert backend.list_files("train-torn-ckpt-") == []
+    assert _counter_total(builtin_metrics.train_ckpt_orphans_gc()) >= \
+        orphans_before + 2
+    assert any("orphan" in e["message"] for e in events.drain_pending())
+
+
+def test_corrupt_shard_uncommits_manifest_on_gc(tmp_path):
+    """A committed manifest whose shard fails its checksum is
+    uncommitted by GC: manifest + shards removed, latest() falls back."""
+    mgr = CheckpointManager(str(tmp_path), "bitrot")
+    backend = mgr._backend
+    _save_sharded(backend, "bitrot", 1, _state_at(0), 2)  # good, older
+    _, _, records = _save_sharded(backend, "bitrot", 2, _state_at(1), 2)
+    victim = backend.path_for(backend.uri_for(records[0]["file"]))
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.seek(size // 2)
+        f.write(b"\x00\x00\x00\x00")
+    mgr2 = CheckpointManager(str(tmp_path), "bitrot")
+    latest = mgr2.latest()
+    assert isinstance(latest, ShardedCheckpoint)
+    assert latest.seq == 1  # seq 2 was uncommitted by GC
+    names = backend.list_files("train-bitrot-ckpt-")
+    assert not any("000002" in n for n in names), names
+
+
+def test_committed_manifest_adopted_into_index(tmp_path):
+    """Crash AFTER the manifest write but BEFORE the index write: the
+    checkpoint IS committed (manifest = commit record); the next index
+    load adopts it."""
+    mgr = CheckpointManager(str(tmp_path), "adopt")
+    _save_sharded(mgr._backend, "adopt", 4, _state_at(3), 2,
+                  extra={"step": 3})
+    # mgr's in-memory index never saw it; a fresh load reconciles.
+    mgr2 = CheckpointManager(str(tmp_path), "adopt")
+    latest = mgr2.latest()
+    assert isinstance(latest, ShardedCheckpoint)
+    assert latest.seq == 4 and latest.extra == {"step": 3}
+    assert mgr2.next_seq_base() == 5
+    assert _trees_equal(latest.load_full(), _state_at(3))
+
+
+def test_register_sharded_commits_and_prunes_all_files(tmp_path):
+    """register_sharded writes the manifest last and num_to_keep
+    pruning deletes manifest + every shard of evicted checkpoints —
+    never the newest committed one."""
+    mgr = CheckpointManager(str(tmp_path), "prune",
+                            CheckpointConfig(num_to_keep=1))
+    backend = mgr._backend
+    for seq in (1, 2):
+        state = _state_at(seq)
+        flat, structure = sc.flatten_tree(state)
+        specs = sc.default_specs(flat)
+        records = [
+            sc.write_shard(backend, "prune", seq, rank,
+                           sc.extract_local_shard(flat, specs,
+                                                  [("fsdp", 2)], rank))
+            for rank in range(2)
+        ]
+        meta = sc.build_tree_meta(flat, structure, specs,
+                                  [("fsdp", 2)], extra={"step": seq})
+        handle = mgr.register_sharded(seq, meta, records)
+        assert isinstance(handle, ShardedCheckpoint)
+    names = backend.list_files("train-prune-ckpt-")
+    # Only seq 2 survives: 1 manifest + 2 shards.
+    assert all("000002" in n for n in names), names
+    assert len(names) == 3, names
+    latest = mgr.latest()
+    assert latest.seq == 2
+    assert _trees_equal(latest.load_full(), _state_at(2))
+
+
+def test_register_sharded_refuses_partial_gang(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), "partial")
+    flat, structure = sc.flatten_tree(_state_at(0))
+    specs = sc.default_specs(flat)
+    rec = sc.write_shard(mgr._backend, "partial", 1, 1,
+                         sc.extract_local_shard(flat, specs,
+                                                [("fsdp", 2)], 1))
+    meta = sc.build_tree_meta(flat, structure, specs, [("fsdp", 2)])
+    with pytest.raises(ValueError, match="contiguous"):
+        mgr.register_sharded(1, meta, [rec])  # rank 0 missing
+
+
+def test_chaos_io_oserror_fails_write_keeps_prior(tmp_path):
+    """An injected IO error on a shard write surfaces as SpillFailure
+    (the save attempt fails cleanly); the previously committed
+    checkpoint is untouched and restorable."""
+    backend = spill.FileSpillBackend(str(tmp_path))
+    manifest, uri, _ = _save_sharded(backend, "io", 1, _state_at(7), 2,
+                                     extra={"step": 7})
+    flat, _ = sc.flatten_tree(_state_at(8))
+    specs = sc.default_specs(flat)
+    chaos.configure(
+        "io_oserror:site=train.ckpt_shard_write_error:times=1")
+    try:
+        with pytest.raises(spill.SpillFailure):
+            sc.write_shard(backend, "io", 2, 0,
+                           sc.extract_local_shard(flat, specs,
+                                                  [("fsdp", 2)], 0))
+    finally:
+        chaos.reset()
+    prior = ShardedCheckpoint.from_manifest_uri(uri)
+    assert _trees_equal(prior.load_full(), _state_at(7))
+
+
+def test_mock_s3_backend_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_MOCK_S3_DIR", str(tmp_path / "s3"))
+    mgr = CheckpointManager("mock-s3://ckpt-bucket", "cloudy")
+    flat, structure = sc.flatten_tree(_state_at(1))
+    specs = sc.default_specs(flat)
+    records = [
+        sc.write_shard(mgr._backend, "cloudy", 1, rank,
+                       sc.extract_local_shard(flat, specs,
+                                              [("fsdp", 2)], rank))
+        for rank in range(2)
+    ]
+    meta = sc.build_tree_meta(flat, structure, specs, [("fsdp", 2)],
+                              extra={"step": 1})
+    handle = mgr.register_sharded(1, meta, records)
+    assert handle.uri.startswith("mock-s3://ckpt-bucket/")
+    # A brand-new manager (fresh process analog) restores through the
+    # same bucket URI.
+    latest = CheckpointManager("mock-s3://ckpt-bucket", "cloudy").latest()
+    assert isinstance(latest, ShardedCheckpoint)
+    assert _trees_equal(latest.load_full(), _state_at(1))
+
+
+def test_config_knobs_present():
+    from ray_tpu._private.ray_config import _PY_DEFAULTS
+    assert _PY_DEFAULTS["train_ckpt_shard_parallelism"] == 8
+    assert _PY_DEFAULTS["train_ckpt_verify_checksums"] is True
+    assert _PY_DEFAULTS["train_reshard_on_restart"] is True
+
+
+def test_shard_parallelism_one_still_loads(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_train_ckpt_shard_parallelism", "1")
+    backend = spill.FileSpillBackend(str(tmp_path))
+    _, uri, _ = _save_sharded(backend, "serial", 1, _state_at(4), 4)
+    ck = ShardedCheckpoint.from_manifest_uri(uri)
+    assert _trees_equal(ck.load_full(), _state_at(4))
+
+
+# ---------------------------------------------------------------------------
+# End-to-end through the gang (report_sharded -> two-phase commit)
+# ---------------------------------------------------------------------------
+
+
+def _sharded_loop(total):
+    def loop():
+        rank = session.get_world_rank()
+        world = session.get_world_size()
+        ckpt = session.get_checkpoint()
+        start = 0
+        resume_ok = 1.0
+        if ckpt is not None:
+            start = ckpt.to_dict()["step"]
+            # The restore path every rank takes on (re)start: my block
+            # under the CURRENT mesh, resharded from the saved one.
+            local, _ = sc.flatten_tree(ckpt.load_for_rank(rank, world))
+            flat, _ = sc.flatten_tree(_state_at(start))
+            specs = sc.default_specs(flat)
+            expected = sc.extract_local_shard(flat, specs,
+                                              [("fsdp", world)], rank)
+            for p, arr in expected.items():
+                if not np.array_equal(arr, np.asarray(local[p])):
+                    resume_ok = 0.0
+        for i in range(start, total):
+            session.report_sharded(
+                {"step": i, "world": world, "resume_ok": resume_ok},
+                _state_at(i + 1), extra={"step": i + 1})
+    return loop
+
+
+def test_sharded_train_end_to_end(ray_start_regular, tmp_path):
+    """4 ranks each write their own shard file every save; the driver
+    commits the manifest after all acks; metrics/journal record it."""
+    persisted_before = _counter_total(
+        builtin_metrics.train_checkpoints_persisted())
+    saves_hist = builtin_metrics.train_ckpt_save_seconds()
+    saves_before = sum(saves_hist._counts.values())
+    events.drain_pending()
+
+    trainer = DataParallelTrainer(
+        _sharded_loop(3),
+        scaling_config=ScalingConfig(num_workers=4),
+        run_config=RunConfig(name="shard-e2e",
+                             storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.metrics["step"] == 2
+    ck = result.checkpoint
+    assert isinstance(ck, ShardedCheckpoint)
+    assert ck.world_size == 4 and ck.extra == {"step": 3}
+    assert _trees_equal(ck.load_full(), _state_at(3))
+
+    # N parallel per-rank shard files on storage, per-rank byte counters.
+    names = [n for n in os.listdir(tmp_path) if ".shard-" in n]
+    assert {n.rsplit("-", 1)[1] for n in names} >= \
+        {"0000", "0001", "0002", "0003"}
+    shard_bytes = builtin_metrics.train_ckpt_shard_bytes().series()
+    ranks_seen = {part for key in shard_bytes for part in key}
+    assert {"0", "1", "2", "3"} <= ranks_seen
+    assert _counter_total(
+        builtin_metrics.train_checkpoints_persisted()) >= \
+        persisted_before + 3
+    assert sum(saves_hist._counts.values()) >= saves_before + 3
+    msgs = [e["message"] for e in events.drain_pending()]
+    assert any("sharded checkpoint" in m and "committed" in m
+               for m in msgs), msgs
+
+    # A fresh run under the same name auto-resumes from the commit.
+    second = DataParallelTrainer(
+        _sharded_loop(5),
+        scaling_config=ScalingConfig(num_workers=4),
+        run_config=RunConfig(name="shard-e2e",
+                             storage_path=str(tmp_path)))
+    r2 = second.fit()
+    assert r2.metrics["step"] == 4
+    assert r2.metrics["resume_ok"] == 1.0
+    assert len(r2.metrics_history) == 2  # started at step 3
+    assert r2.checkpoint.extra == {"step": 5}
+
+
+def test_chaos_shard_write_error_save_aborts_cleanly(ray_start_regular,
+                                                    tmp_path):
+    """One rank's shard write raises: that save attempt aborts without
+    a manifest, training continues, later saves commit normally."""
+    failures_before = _counter_total(
+        builtin_metrics.train_checkpoint_persist_failures())
+    trainer = DataParallelTrainer(
+        _sharded_loop(3),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="shard-io", storage_path=str(tmp_path)))
+    chaos.configure(
+        "io_oserror:site=train.ckpt_shard_write_error:times=1")
+    try:
+        result = trainer.fit()
+        fired = any(op["fired"] for op in chaos.stats())
+    finally:
+        chaos.reset()
+    assert fired, "chaos io error never fired"
+    assert result.metrics["step"] == 2
+    # The first save (step 1) aborted; the run's last save committed.
+    assert result.checkpoint.extra == {"step": 3}
+    assert _trees_equal(result.checkpoint.load_full(), _state_at(3))
+    assert _counter_total(
+        builtin_metrics.train_checkpoint_persist_failures()) >= \
+        failures_before + 1
+    # No torn seq-1 manifest on storage.
+    manifests = [n for n in os.listdir(tmp_path) if n.endswith(".manifest")]
+    assert not any("000001" in n for n in manifests), manifests
+
+
+def test_chaos_sigkill_rank_mid_save_acceptance(ray_start_regular,
+                                                tmp_path):
+    """ISSUE 20 chaos acceptance: SIGKILL one rank mid-save -> the
+    partial save never commits, the gang restarts, resume loads the
+    last COMMITTED checkpoint, and the next index load GCs the torn
+    shard set."""
+    restarts_before = _counter_total(
+        builtin_metrics.train_gang_restarts(), "system")
+    trainer = DataParallelTrainer(
+        _sharded_loop(4),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            name="shard-kill", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1)))
+    # after=2: save 1's two shard writes pass, then the first rank to
+    # reach save 2's kill gate dies with its shard unwritten — the
+    # other rank's seq-2 shard becomes commit-less debris.
+    chaos.configure("kill:site=train.ckpt_shard_kill:after=2:times=1")
+    try:
+        result = trainer.fit()
+        fired = any(op["fired"] for op in chaos.stats())
+    finally:
+        chaos.reset()
+    assert fired, "chaos kill never fired"
+    # The run finished its full target on the restarted gang.
+    assert result.metrics["step"] == 3
+    assert result.metrics["resume_ok"] == 1.0
+    assert result.checkpoint.extra == {"step": 4}
+    assert _trees_equal(result.checkpoint.load_full(), _state_at(4))
+    assert _counter_total(builtin_metrics.train_gang_restarts(),
+                          "system") >= restarts_before + 1
+    events.drain_pending()
+    # The torn shard set is debris until the next index load sweeps it.
+    mgr = CheckpointManager(str(tmp_path), "shard-kill")
+    latest = mgr.latest()
+    assert isinstance(latest, ShardedCheckpoint)
+    assert latest.extra == {"step": 4}
+    committed = {f for e in mgr._tracked for f in e.get("files", [])} | \
+        {os.path.basename(e["uri"].split("://", 1)[1])
+         for e in mgr._tracked}
+    leftover = [n for n in mgr._backend.list_files("train-shard-kill-ckpt-")
+                if ".shard-" in n or n.endswith(".manifest")]
+    assert all(n in committed for n in leftover), (leftover, committed)
+
+
+def test_elastic_shrink_reshard_acceptance(ray_start_regular, monkeypatch,
+                                           tmp_path):
+    """ISSUE 20 elastic acceptance: mid-run shrink 8 -> min_workers 4
+    resumes via reshard with numerically identical params and finishes
+    the full target step count; reshards_total{shrink} increments."""
+    shrink_before = _counter_total(builtin_metrics.train_reshards(),
+                                   "shrink")
+    _set_flag("train_restart_wait_s", 0.1)
+    monkeypatch.setattr(BackendExecutor, "_placeable_workers",
+                        lambda self, desired: 4)
+
+    def loop():
+        rank = session.get_world_rank()
+        world = session.get_world_size()
+        ckpt = session.get_checkpoint()
+        start = 0
+        resume_ok = 1.0
+        if ckpt is not None:
+            start = ckpt.to_dict()["step"]
+            local, _ = sc.flatten_tree(ckpt.load_for_rank(rank, world))
+            flat, _ = sc.flatten_tree(_state_at(start))
+            specs = sc.default_specs(flat)
+            expected = sc.extract_local_shard(flat, specs,
+                                              [("fsdp", world)], rank)
+            for p, arr in expected.items():
+                if not np.array_equal(arr, np.asarray(local[p])):
+                    resume_ok = 0.0
+        for i in range(start, 4):
+            session.report_sharded(
+                {"step": i, "world": world, "resume_ok": resume_ok},
+                _state_at(i + 1), extra={"step": i + 1})
+            if world == 8 and i + 1 >= 2:
+                raise RuntimeError("slice lost")
+
+    mgr = CheckpointManager(str(tmp_path), "elastic-shrink")
+    executor = BackendExecutor(
+        BackendConfig(),
+        ScalingConfig(num_workers=8, min_workers=4),
+        FailureConfig(max_failures=1),
+        checkpoint_manager=mgr)
+    executor.start()
+    try:
+        result = executor.run(loop, {}, {"trial_id": "shrink"})
+    finally:
+        executor.shutdown()
+    # Finished the FULL target on the 4-rank gang.
+    assert result.metrics["step"] == 3
+    assert result.metrics["world"] == 4
+    assert result.metrics["resume_ok"] == 1.0
+    ck = result.checkpoint
+    assert isinstance(ck, ShardedCheckpoint)
+    assert ck.world_size == 4 and ck.extra == {"step": 4}
+    assert _trees_equal(ck.load_full(), _state_at(4))
+    assert _counter_total(builtin_metrics.train_reshards(), "shrink") >= \
+        shrink_before + 1
+
+
+def test_reshard_on_restart_disabled_refuses(ray_start_regular, tmp_path):
+    """With train_reshard_on_restart off, a gang sized differently from
+    the saved mesh refuses to resume (a config veto, not a retryable
+    TrainingFailedError)."""
+    backend = spill.FileSpillBackend(str(tmp_path))
+    _, uri, _ = _save_sharded(backend, "frozen", 1, _state_at(1), 2,
+                              extra={"step": 1})
+    ck = ShardedCheckpoint.from_manifest_uri(uri)
+    executor = BackendExecutor(BackendConfig(),
+                               ScalingConfig(num_workers=1))
+    _set_flag("train_reshard_on_restart", False)
+    try:
+        with pytest.raises(RuntimeError,
+                           match="train_reshard_on_restart"):
+            executor._reshard_accounting(ck, new_world=1)
+        # Same-size resume is always allowed.
+        executor._reshard_accounting(ck, new_world=2)
+    finally:
+        _set_flag("train_reshard_on_restart", True)
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main(["-v", "-x", __file__]))
